@@ -1,0 +1,25 @@
+"""spark-rapids-tpu: a TPU-native accelerated SQL engine with the
+capabilities of the RAPIDS Accelerator for Apache Spark.
+
+Top half (planner, spill/retry memory model, shuffle SPI, differential test
+oracle) reproduces the reference architecture (see SURVEY.md); bottom half is
+TPU-first: Arrow-layout columns in HBM as JAX arrays, kernels as XLA/Pallas
+programs with static capacities + dynamic row counts, ICI collectives for the
+distributed exchange.
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# The engine requires x64 mode: Spark LongType/DoubleType are 64-bit and JAX
+# otherwise silently downcasts int64->int32 / float64->float32 at upload.
+# (On real TPU hardware f64 is emulated as float32 pairs — a documented
+# precision divergence for DoubleType, mirroring the reference's
+# variableFloatAgg-style caveats; integral types emulate exactly.)
+_jax.config.update("jax_enable_x64", True)
+
+from spark_rapids_tpu import types  # noqa: F401
+from spark_rapids_tpu.config import RapidsConf  # noqa: F401
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema  # noqa: F401
+from spark_rapids_tpu.columnar.column import DeviceColumn  # noqa: F401

@@ -1,0 +1,205 @@
+"""pyarrow ⇄ device-batch interop.
+
+The host staging layer: file readers (io/) decode Parquet/ORC/CSV/JSON into
+Arrow on host CPU threads (the TPU analog of the reference's HostMemoryBuffer
+assembly in MultiFileCloudParquetPartitionReader, GpuParquetScan.scala:3134),
+and this module uploads Arrow buffers into canonical DeviceColumns; writers
+run the reverse.
+"""
+from __future__ import annotations
+
+import decimal as _decimal
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
+
+_ARROW_TO_SQL = {
+    pa.bool_(): T.BOOLEAN,
+    pa.int8(): T.BYTE,
+    pa.int16(): T.SHORT,
+    pa.int32(): T.INT,
+    pa.int64(): T.LONG,
+    pa.float32(): T.FLOAT,
+    pa.float64(): T.DOUBLE,
+    pa.string(): T.STRING,
+    pa.large_string(): T.STRING,
+    pa.binary(): T.BINARY,
+    pa.date32(): T.DATE,
+}
+
+
+def arrow_type_to_sql(at: pa.DataType) -> T.DataType:
+    if at in _ARROW_TO_SQL:
+        return _ARROW_TO_SQL[at]
+    if pa.types.is_timestamp(at):
+        return T.TIMESTAMP
+    if pa.types.is_decimal(at):
+        return T.DecimalType(at.precision, at.scale)
+    if pa.types.is_dictionary(at):
+        return arrow_type_to_sql(at.value_type)
+    raise NotImplementedError(f"unsupported arrow type: {at}")
+
+
+def sql_type_to_arrow(dt: T.DataType) -> pa.DataType:
+    if isinstance(dt, T.BooleanType):
+        return pa.bool_()
+    if isinstance(dt, T.ByteType):
+        return pa.int8()
+    if isinstance(dt, T.ShortType):
+        return pa.int16()
+    if isinstance(dt, T.IntegerType):
+        return pa.int32()
+    if isinstance(dt, T.LongType):
+        return pa.int64()
+    if isinstance(dt, T.FloatType):
+        return pa.float32()
+    if isinstance(dt, T.DoubleType):
+        return pa.float64()
+    if isinstance(dt, T.StringType):
+        return pa.string()
+    if isinstance(dt, T.BinaryType):
+        return pa.binary()
+    if isinstance(dt, T.DateType):
+        return pa.date32()
+    if isinstance(dt, T.TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    if isinstance(dt, T.DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    raise NotImplementedError(f"unsupported sql type: {dt}")
+
+
+def _chunked_to_array(col) -> pa.Array:
+    if isinstance(col, pa.ChunkedArray):
+        return col.combine_chunks()
+    return col
+
+
+def arrow_column_to_device(arr: pa.Array, dtype: T.DataType,
+                           capacity: int) -> DeviceColumn:
+    arr = _chunked_to_array(arr)
+    n = len(arr)
+    if pa.types.is_dictionary(arr.type):
+        arr = arr.dictionary_decode()
+    if dtype.variable_width:
+        if pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
+            arr = arr.cast(pa.string() if pa.types.is_large_string(arr.type) else pa.binary())
+        # Fast path: Arrow string arrays already hold the exact
+        # int32-offsets + bytes layout DeviceColumn wants; slice the raw
+        # buffers into numpy views instead of round-tripping Python objects.
+        bufs = arr.buffers()
+        off_view = np.frombuffer(bufs[1], dtype=np.int32)[arr.offset : arr.offset + n + 1]
+        base = off_view[0] if n > 0 else 0
+        data_all = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] is not None else np.zeros(0, np.uint8)
+        total = int(off_view[n] - base) if n > 0 else 0
+        if arr.null_count:
+            validity = np.asarray(arr.is_valid())
+        else:
+            validity = np.ones((n,), dtype=np.bool_)
+        cap = capacity
+        bcap = round_up_pow2(max(total, 1))
+        offsets = np.zeros((cap + 1,), dtype=np.int32)
+        offsets[: n + 1] = off_view - base
+        offsets[n + 1 :] = offsets[n]
+        datab = np.zeros((bcap,), dtype=np.uint8)
+        if total:
+            datab[:total] = data_all[base : base + total]
+        validity_full = np.zeros((cap,), dtype=np.bool_)
+        validity_full[:n] = validity
+        return DeviceColumn(
+            data=jnp.asarray(datab),
+            validity=jnp.asarray(validity_full),
+            dtype=dtype,
+            offsets=jnp.asarray(offsets),
+        )
+    if isinstance(dtype, T.TimestampType):
+        arr = arr.cast(pa.timestamp("us"))
+        np_vals = arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
+    elif isinstance(dtype, T.DateType):
+        np_vals = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+    elif isinstance(dtype, T.DecimalType):
+        if dtype.uses_two_limbs:
+            raise NotImplementedError("decimal precision > 18 upload")
+        np_vals = np.array(
+            [0 if v is None else int((v * (10 ** dtype.scale)).to_integral_value())
+             for v in arr.to_pylist()],
+            dtype=np.int64,
+        )
+    else:
+        # fill_null keeps nulls from surfacing as NaN/garbage in to_numpy;
+        # DeviceColumn.from_numpy re-zeroes null slots for canonical padding.
+        null_fill = False if pa.types.is_boolean(arr.type) else 0
+        filled = arr.fill_null(null_fill) if arr.null_count else arr
+        np_vals = filled.to_numpy(zero_copy_only=False)
+        if np_vals.dtype != dtype.np_dtype:
+            np_vals = np_vals.astype(dtype.np_dtype)
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+    else:
+        validity = np.ones((n,), dtype=np.bool_)
+    return DeviceColumn.from_numpy(np_vals, dtype, validity, capacity=capacity)
+
+
+def arrow_to_batch(table, capacity: Optional[int] = None) -> ColumnarBatch:
+    if isinstance(table, pa.RecordBatch):
+        table = pa.Table.from_batches([table])
+    n = table.num_rows
+    cap = capacity if capacity is not None else round_up_pow2(max(n, 1))
+    names, dtypes, cols = [], [], []
+    for field, col in zip(table.schema, table.columns):
+        dt = arrow_type_to_sql(field.type)
+        names.append(field.name)
+        dtypes.append(dt)
+        cols.append(arrow_column_to_device(col, dt, cap))
+    return ColumnarBatch(
+        tuple(cols), jnp.asarray(n, dtype=jnp.int32), Schema(tuple(names), tuple(dtypes))
+    )
+
+
+def batch_to_arrow(batch: ColumnarBatch) -> pa.Table:
+    n = batch.host_num_rows()
+    arrays = []
+    fields = []
+    for name, dtype, col in zip(batch.schema.names, batch.schema.dtypes, batch.columns):
+        at = sql_type_to_arrow(dtype)
+        if dtype.variable_width:
+            # Build from raw buffers: offsets/data download straight into an
+            # Arrow StringArray without Python-object round-trips.
+            offsets = np.asarray(col.offsets)[: n + 1]
+            nbytes = int(offsets[n]) if n > 0 else 0
+            data = np.asarray(col.data)[:nbytes]
+            valid = np.asarray(col.validity)[:n]
+            validity_buf = pa.array(valid).buffers()[1]
+            arr = pa.Array.from_buffers(
+                pa.string() if isinstance(dtype, T.StringType) else pa.binary(),
+                n,
+                [validity_buf, pa.py_buffer(offsets.tobytes()), pa.py_buffer(data.tobytes())],
+            )
+            # Null rows may carry nonzero extents after gathers; normalize to
+            # empty so results match the CPU oracle exactly.
+            if not valid.all():
+                arr = pa.compute.if_else(pa.array(valid), arr, pa.scalar(None, type=arr.type))
+            arrays.append(arr.cast(at) if arr.type != at else arr)
+        else:
+            data, valid = col.to_numpy(n)
+            if isinstance(dtype, T.DecimalType):
+                pyvals = [
+                    None if not valid[i] else _decimal.Decimal(int(data[i])).scaleb(-dtype.scale)
+                    for i in range(n)
+                ]
+                arrays.append(pa.array(pyvals, type=at))
+            elif isinstance(dtype, (T.DateType, T.TimestampType)):
+                base = pa.array(np.asarray(data), type=pa.int32() if isinstance(dtype, T.DateType) else pa.int64())
+                casted = base.cast(at)
+                mask = pa.array(np.asarray(valid))
+                arrays.append(pa.compute.if_else(mask, casted, pa.scalar(None, type=at)))
+            else:
+                arrays.append(pa.array(np.asarray(data), type=at,
+                                       mask=~np.asarray(valid)))
+        fields.append(pa.field(name, at))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))

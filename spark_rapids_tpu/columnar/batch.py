@@ -1,0 +1,158 @@
+"""Columnar batch: the unit of work flowing between execs.
+
+TPU analog of Spark's ``ColumnarBatch`` of ``GpuColumnVector``s (reference:
+GpuColumnVector.java:1-1255, SpillableColumnarBatch.scala).  A batch is a
+pytree of DeviceColumns plus one dynamic scalar ``num_rows``; the schema
+(names + types) is static aux data so whole operator pipelines jit cleanly
+over batches.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    names: Tuple[str, ...]
+    dtypes: Tuple[T.DataType, ...]
+
+    def __post_init__(self):
+        assert len(self.names) == len(self.dtypes)
+
+    def __len__(self):
+        return len(self.names)
+
+    def index_of(self, name: str) -> int:
+        try:
+            return self.names.index(name)
+        except ValueError:
+            raise KeyError(f"no column named {name!r}; have {self.names}")
+
+    def dtype_of(self, name: str) -> T.DataType:
+        return self.dtypes[self.index_of(name)]
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}:{d!r}" for n, d in zip(self.names, self.dtypes))
+        return f"Schema({inner})"
+
+    @staticmethod
+    def of(**kv: T.DataType) -> "Schema":
+        return Schema(tuple(kv.keys()), tuple(kv.values()))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ColumnarBatch:
+    columns: Tuple[DeviceColumn, ...]
+    num_rows: jax.Array          # scalar int32, dynamic
+    schema: Schema               # static
+
+    def tree_flatten(self):
+        return (self.columns, self.num_rows), self.schema
+
+    @classmethod
+    def tree_unflatten(cls, schema, children):
+        columns, num_rows = children
+        return cls(columns=tuple(columns), num_rows=num_rows, schema=schema)
+
+    @property
+    def capacity(self) -> int:
+        if self.columns:
+            return self.columns[0].capacity
+        return 0
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def column(self, name: str) -> DeviceColumn:
+        return self.columns[self.schema.index_of(name)]
+
+    def live_mask(self) -> jax.Array:
+        """Boolean [capacity] mask of rows < num_rows."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+
+    def host_num_rows(self) -> int:
+        return int(self.num_rows)
+
+    def device_size_bytes(self) -> int:
+        total = 0
+        for c in self.columns:
+            total += c.data.size * c.data.dtype.itemsize
+            total += c.validity.size
+            if c.offsets is not None:
+                total += c.offsets.size * 4
+        return total
+
+    # -- host interop -------------------------------------------------------
+
+    @staticmethod
+    def from_pydict(data: Dict[str, list], schema: Schema,
+                    capacity: Optional[int] = None) -> "ColumnarBatch":
+        n = len(next(iter(data.values()))) if data else 0
+        cap = capacity if capacity is not None else round_up_pow2(max(n, 1))
+        cols = []
+        for name, dtype in zip(schema.names, schema.dtypes):
+            vals = data[name]
+            if dtype.variable_width:
+                cols.append(DeviceColumn.from_strings(vals, capacity=cap, dtype=dtype))
+            else:
+                arr = np.zeros((n,), dtype=dtype.np_dtype)
+                valid = np.ones((n,), dtype=np.bool_)
+                for i, v in enumerate(vals):
+                    if v is None:
+                        valid[i] = False
+                    else:
+                        arr[i] = v
+                cols.append(DeviceColumn.from_numpy(arr, dtype, valid, capacity=cap))
+        return ColumnarBatch(tuple(cols), jnp.asarray(n, dtype=jnp.int32), schema)
+
+    @staticmethod
+    def from_arrow(table, capacity: Optional[int] = None) -> "ColumnarBatch":
+        """pyarrow.Table/RecordBatch → device batch (host decode + upload)."""
+        from spark_rapids_tpu.columnar import arrow as arrow_interop
+        return arrow_interop.arrow_to_batch(table, capacity=capacity)
+
+    def to_arrow(self):
+        from spark_rapids_tpu.columnar import arrow as arrow_interop
+        return arrow_interop.batch_to_arrow(self)
+
+    def to_pydict(self) -> Dict[str, list]:
+        n = self.host_num_rows()
+        return {name: col.to_pylist(n) for name, col in zip(self.schema.names, self.columns)}
+
+    def canonicalize(self) -> "ColumnarBatch":
+        return ColumnarBatch(
+            tuple(c.canonicalize(self.num_rows) for c in self.columns),
+            self.num_rows,
+            self.schema,
+        )
+
+    @staticmethod
+    def empty(schema: Schema, capacity: int = 1) -> "ColumnarBatch":
+        cols = tuple(DeviceColumn.empty(d, capacity, byte_capacity=capacity)
+                     for d in schema.dtypes)
+        return ColumnarBatch(cols, jnp.asarray(0, dtype=jnp.int32), schema)
+
+    def select(self, names: Sequence[str]) -> "ColumnarBatch":
+        idxs = [self.schema.index_of(n) for n in names]
+        return ColumnarBatch(
+            tuple(self.columns[i] for i in idxs),
+            self.num_rows,
+            Schema(tuple(names), tuple(self.schema.dtypes[i] for i in idxs)),
+        )
+
+    def with_columns(self, cols: Sequence[DeviceColumn], names: Sequence[str]) -> "ColumnarBatch":
+        return ColumnarBatch(
+            tuple(cols),
+            self.num_rows,
+            Schema(tuple(names), tuple(c.dtype for c in cols)),
+        )

@@ -1,0 +1,240 @@
+"""Device column representation.
+
+The TPU analog of the reference's `GpuColumnVector.java` (a Spark ColumnVector
+wrapping a cudf device column).  Here a column is a small pytree of JAX arrays
+resident in HBM:
+
+  * fixed-width types: ``data[f32/i64/...][capacity]`` + ``validity[bool][capacity]``
+  * strings/binary:    ``offsets[i32][capacity+1]`` + ``data[u8][byte_capacity]``
+                       + ``validity[bool][capacity]``
+
+**Static-shape discipline (the XLA contract).**  Arrays are sized to a static
+*capacity*; the live row count is a dynamic scalar carried by the enclosing
+batch.  Rows at index >= num_rows are *padding*: validity False, data zeroed,
+string offsets flat.  Every kernel must preserve this canonical padding so
+results are bit-deterministic and hashable regardless of capacity.  This is
+how the build answers the reference's dynamic-output-size problem (filters,
+joins) without dynamic shapes: kernels return (arrays, valid_count) at fixed
+capacity, and the retry framework re-runs with a larger capacity on overflow
+(the TPU analog of GpuSplitAndRetryOOM, RmmRapidsRetryIterator.scala:37).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+
+def round_up_pow2(n: int) -> int:
+    """Bucket capacities to powers of two to bound XLA recompiles."""
+    if n <= 1:
+        return 1
+    return 1 << (int(n - 1).bit_length())
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DeviceColumn:
+    """One SQL column in HBM.  A pytree: jit-traceable, shardable."""
+
+    data: jax.Array                  # [capacity] or [byte_capacity] for strings
+    validity: jax.Array              # [capacity] bool, True = non-null
+    dtype: T.DataType                # static
+    offsets: Optional[jax.Array] = None  # [capacity+1] int32, strings only
+
+    def tree_flatten(self):
+        if self.offsets is not None:
+            return (self.data, self.validity, self.offsets), self.dtype
+        return (self.data, self.validity), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, dtype, children):
+        if len(children) == 3:
+            data, validity, offsets = children
+            return cls(data=data, validity=validity, dtype=dtype, offsets=offsets)
+        data, validity = children
+        return cls(data=data, validity=validity, dtype=dtype, offsets=None)
+
+    @property
+    def capacity(self) -> int:
+        if self.offsets is not None:
+            return self.offsets.shape[0] - 1
+        return self.data.shape[0]
+
+    @property
+    def byte_capacity(self) -> int:
+        assert self.offsets is not None
+        return self.data.shape[0]
+
+    @property
+    def is_string_like(self) -> bool:
+        return self.offsets is not None
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def empty(dtype: T.DataType, capacity: int, byte_capacity: int = 0) -> "DeviceColumn":
+        if dtype.variable_width:
+            return DeviceColumn(
+                data=jnp.zeros((byte_capacity,), dtype=jnp.uint8),
+                validity=jnp.zeros((capacity,), dtype=jnp.bool_),
+                dtype=dtype,
+                offsets=jnp.zeros((capacity + 1,), dtype=jnp.int32),
+            )
+        return DeviceColumn(
+            data=jnp.zeros((capacity,), dtype=dtype.jnp_dtype),
+            validity=jnp.zeros((capacity,), dtype=jnp.bool_),
+            dtype=dtype,
+        )
+
+    @staticmethod
+    def from_numpy(
+        values: np.ndarray,
+        dtype: T.DataType,
+        validity: Optional[np.ndarray] = None,
+        capacity: Optional[int] = None,
+    ) -> "DeviceColumn":
+        """Host→HBM upload of a fixed-width column with optional null mask."""
+        assert not dtype.variable_width
+        n = len(values)
+        cap = capacity if capacity is not None else round_up_pow2(max(n, 1))
+        data = np.zeros((cap,), dtype=dtype.np_dtype)
+        valid = np.zeros((cap,), dtype=np.bool_)
+        if validity is None:
+            validity = np.ones((n,), dtype=np.bool_)
+        validity = np.asarray(validity, dtype=np.bool_)
+        v = np.asarray(values)
+        if v.dtype != dtype.np_dtype:
+            # zero null slots before the cast (they may hold NaN/garbage)
+            v = np.where(validity, v, np.zeros_like(v))
+            v = v.astype(dtype.np_dtype)
+        # canonical padding: null slots hold zero
+        v = np.where(validity, v, np.zeros_like(v))
+        data[:n] = v
+        valid[:n] = validity
+        return DeviceColumn(data=jnp.asarray(data), validity=jnp.asarray(valid), dtype=dtype)
+
+    @staticmethod
+    def from_strings(
+        values,
+        validity: Optional[np.ndarray] = None,
+        capacity: Optional[int] = None,
+        byte_capacity: Optional[int] = None,
+        dtype: T.DataType = T.STRING,
+    ) -> "DeviceColumn":
+        """Host→HBM upload of a string column (list of str/bytes/None)."""
+        n = len(values)
+        enc = []
+        valid = np.ones((n,), dtype=np.bool_)
+        for i, v in enumerate(values):
+            if v is None:
+                enc.append(b"")
+                valid[i] = False
+            elif isinstance(v, bytes):
+                enc.append(v)
+            else:
+                enc.append(str(v).encode("utf-8"))
+        if validity is not None:
+            valid &= np.asarray(validity, dtype=np.bool_)
+            enc = [b"" if not valid[i] else enc[i] for i in range(n)]
+        lengths = np.array([len(b) for b in enc], dtype=np.int64)
+        total = int(lengths.sum())
+        cap = capacity if capacity is not None else round_up_pow2(max(n, 1))
+        bcap = byte_capacity if byte_capacity is not None else round_up_pow2(max(total, 1))
+        offsets = np.zeros((cap + 1,), dtype=np.int32)
+        np.cumsum(lengths, out=offsets[1 : n + 1])
+        offsets[n + 1 :] = offsets[n]
+        datab = np.zeros((bcap,), dtype=np.uint8)
+        if total:
+            datab[:total] = np.frombuffer(b"".join(enc), dtype=np.uint8)
+        validity_full = np.zeros((cap,), dtype=np.bool_)
+        validity_full[:n] = valid
+        return DeviceColumn(
+            data=jnp.asarray(datab),
+            validity=jnp.asarray(validity_full),
+            dtype=dtype,
+            offsets=jnp.asarray(offsets),
+        )
+
+    # -- host download ------------------------------------------------------
+
+    def to_numpy(self, num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+        """HBM→host download: (values, validity) truncated to num_rows."""
+        assert not self.dtype.variable_width
+        data = np.asarray(self.data)[:num_rows]
+        valid = np.asarray(self.validity)[:num_rows]
+        return data, valid
+
+    def to_pylist(self, num_rows: int):
+        if self.dtype.variable_width:
+            offsets = np.asarray(self.offsets)
+            data = np.asarray(self.data)
+            valid = np.asarray(self.validity)
+            out = []
+            for i in range(num_rows):
+                if not valid[i]:
+                    out.append(None)
+                else:
+                    b = data[offsets[i] : offsets[i + 1]].tobytes()
+                    out.append(b if isinstance(self.dtype, T.BinaryType) else b.decode("utf-8"))
+            return out
+        data, valid = self.to_numpy(num_rows)
+        out = []
+        for i in range(num_rows):
+            out.append(data[i].item() if valid[i] else None)
+        return out
+
+    # -- canonicalization ---------------------------------------------------
+
+    def canonicalize(self, num_rows) -> "DeviceColumn":
+        """Re-establish canonical padding: zero data in null/pad slots.
+
+        Must be applied by any kernel whose scatter/gather may leave garbage
+        in dead slots, so downstream hashing/serialization is deterministic.
+
+        String canonical form: offsets are flat past num_rows and bytes past
+        offsets[num_rows] are zeroed.  (Null rows *inside* the live region may
+        keep nonzero extents — hashing/serialization must skip by validity.)
+        """
+        idx = jnp.arange(self.capacity, dtype=jnp.int32)
+        live = idx < num_rows
+        valid = self.validity & live
+        if self.offsets is not None:
+            end = self.offsets[num_rows]
+            oidx = jnp.arange(self.capacity + 1, dtype=jnp.int32)
+            offsets = jnp.where(oidx <= num_rows, self.offsets, end)
+            bidx = jnp.arange(self.byte_capacity, dtype=jnp.int32)
+            data = jnp.where(bidx < end, self.data, jnp.uint8(0))
+            return DeviceColumn(data, valid, self.dtype, offsets)
+        zero = jnp.zeros((), dtype=self.data.dtype)
+        data = jnp.where(valid, self.data, zero)
+        return DeviceColumn(data, valid, self.dtype)
+
+    def with_capacity(self, capacity: int, byte_capacity: Optional[int] = None) -> "DeviceColumn":
+        """Grow (or shrink) the static capacity, preserving contents."""
+        if self.offsets is not None:
+            bcap = byte_capacity if byte_capacity is not None else self.byte_capacity
+            data = jnp.zeros((bcap,), dtype=jnp.uint8).at[: min(bcap, self.byte_capacity)].set(
+                self.data[: min(bcap, self.byte_capacity)]
+            )
+            offsets = jnp.zeros((capacity + 1,), dtype=jnp.int32)
+            ncopy = min(capacity + 1, self.offsets.shape[0])
+            offsets = offsets.at[:ncopy].set(self.offsets[:ncopy])
+            if capacity + 1 > ncopy:
+                offsets = offsets.at[ncopy:].set(self.offsets[ncopy - 1])
+            validity = jnp.zeros((capacity,), dtype=jnp.bool_)
+            validity = validity.at[: min(capacity, self.capacity)].set(
+                self.validity[: min(capacity, self.capacity)]
+            )
+            return DeviceColumn(data, validity, self.dtype, offsets)
+        data = jnp.zeros((capacity,), dtype=self.data.dtype)
+        validity = jnp.zeros((capacity,), dtype=jnp.bool_)
+        ncopy = min(capacity, self.capacity)
+        data = data.at[:ncopy].set(self.data[:ncopy])
+        validity = validity.at[:ncopy].set(self.validity[:ncopy])
+        return DeviceColumn(data, validity, self.dtype)

@@ -1,0 +1,345 @@
+"""Spark SQL data-type hierarchy for the TPU accelerator.
+
+Mirrors the type surface the reference supports (reference: TypeSig in
+sql-plugin/src/main/scala/com/nvidia/spark/rapids/TypeChecks.scala:125) but is
+designed TPU-first: every type carries its device representation (a JAX dtype
+for fixed-width types; offsets+bytes for strings) so columns are plain JAX
+arrays that XLA can tile.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType:
+    """Base of the SQL type lattice.
+
+    Fixed-width types map 1:1 onto a JAX dtype stored in HBM.  Variable-width
+    types (StringType, BinaryType) are stored Arrow-style as an int32 offsets
+    vector plus a uint8 byte buffer.
+    """
+
+    #: device dtype of the primary data buffer (None for nested types)
+    jnp_dtype = None
+    #: numpy dtype used for host staging
+    np_dtype = None
+    #: True when the column is (offsets, bytes) rather than one buffer
+    variable_width = False
+    #: SQL name, matches Spark's `DataType.simpleString`
+    sql_name = "unknown"
+    #: byte width of one element of the primary buffer
+    byte_width = 0
+
+    def __repr__(self) -> str:
+        return self.sql_name
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self))
+
+    @property
+    def is_numeric(self) -> bool:
+        return isinstance(self, NumericType)
+
+    @property
+    def is_integral(self) -> bool:
+        return isinstance(self, IntegralType)
+
+    @property
+    def is_floating(self) -> bool:
+        return isinstance(self, FractionalType) and not isinstance(self, DecimalType)
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class BooleanType(DataType):
+    jnp_dtype = jnp.bool_
+    np_dtype = np.bool_
+    sql_name = "boolean"
+    byte_width = 1
+
+
+class ByteType(IntegralType):
+    jnp_dtype = jnp.int8
+    np_dtype = np.int8
+    sql_name = "tinyint"
+    byte_width = 1
+
+
+class ShortType(IntegralType):
+    jnp_dtype = jnp.int16
+    np_dtype = np.int16
+    sql_name = "smallint"
+    byte_width = 2
+
+
+class IntegerType(IntegralType):
+    jnp_dtype = jnp.int32
+    np_dtype = np.int32
+    sql_name = "int"
+    byte_width = 4
+
+
+class LongType(IntegralType):
+    jnp_dtype = jnp.int64
+    np_dtype = np.int64
+    sql_name = "bigint"
+    byte_width = 8
+
+
+class FloatType(FractionalType):
+    jnp_dtype = jnp.float32
+    np_dtype = np.float32
+    sql_name = "float"
+    byte_width = 4
+
+
+class DoubleType(FractionalType):
+    jnp_dtype = jnp.float64
+    np_dtype = np.float64
+    sql_name = "double"
+    byte_width = 8
+
+
+class DateType(DataType):
+    """Days since epoch, int32 on device (Spark's DateType physical repr)."""
+
+    jnp_dtype = jnp.int32
+    np_dtype = np.int32
+    sql_name = "date"
+    byte_width = 4
+
+
+class TimestampType(DataType):
+    """Microseconds since epoch UTC, int64 on device."""
+
+    jnp_dtype = jnp.int64
+    np_dtype = np.int64
+    sql_name = "timestamp"
+    byte_width = 8
+
+
+class StringType(DataType):
+    """UTF-8 bytes, Arrow layout: int32 offsets[n+1] + uint8 data[nbytes]."""
+
+    jnp_dtype = jnp.uint8
+    np_dtype = np.uint8
+    variable_width = True
+    sql_name = "string"
+    byte_width = 1
+
+
+class BinaryType(DataType):
+    jnp_dtype = jnp.uint8
+    np_dtype = np.uint8
+    variable_width = True
+    sql_name = "binary"
+    byte_width = 1
+
+
+class NullType(DataType):
+    jnp_dtype = jnp.int8
+    np_dtype = np.int8
+    sql_name = "void"
+    byte_width = 1
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class DecimalType(FractionalType):
+    """Decimal(precision, scale).
+
+    Device repr: int64 unscaled value for precision <= 18 (Spark's
+    Decimal64 fast path); precision 19..38 is stored as two int64 limbs
+    (emulated int128) — kernels in kernels/decimal.py.
+    """
+
+    precision: int = 10
+    scale: int = 0
+    sql_name = "decimal"
+    variable_width = False
+
+    MAX_PRECISION = 38
+    MAX_LONG_DIGITS = 18
+
+    def __post_init__(self):
+        if not (1 <= self.precision <= self.MAX_PRECISION):
+            raise ValueError(f"decimal precision out of range: {self.precision}")
+        if not (0 <= self.scale <= self.precision):
+            raise ValueError(f"decimal scale out of range: {self.scale}")
+
+    @property
+    def jnp_dtype(self):  # type: ignore[override]
+        return jnp.int64
+
+    @property
+    def np_dtype(self):  # type: ignore[override]
+        return np.int64
+
+    @property
+    def byte_width(self):  # type: ignore[override]
+        return 8 if self.precision <= self.MAX_LONG_DIGITS else 16
+
+    @property
+    def uses_two_limbs(self) -> bool:
+        return self.precision > self.MAX_LONG_DIGITS
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, DecimalType)
+            and other.precision == self.precision
+            and other.scale == self.scale
+        )
+
+    def __hash__(self) -> int:
+        return hash((DecimalType, self.precision, self.scale))
+
+    def __repr__(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ArrayType(DataType):
+    """List<element>.  Arrow layout: int32 offsets[n+1] + child column."""
+
+    element_type: DataType = None  # type: ignore[assignment]
+    contains_null: bool = True
+    variable_width = True
+    sql_name = "array"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ArrayType) and other.element_type == self.element_type
+
+    def __hash__(self) -> int:
+        return hash((ArrayType, self.element_type))
+
+    def __repr__(self) -> str:
+        return f"array<{self.element_type!r}>"
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class StructField:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class StructType(DataType):
+    fields: tuple = ()
+    sql_name = "struct"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, StructType) and other.fields == self.fields
+
+    def __hash__(self) -> int:
+        return hash((StructType, self.fields))
+
+    def __repr__(self) -> str:
+        inner = ",".join(f"{f.name}:{f.dtype!r}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def field_names(self):
+        return [f.name for f in self.fields]
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class MapType(DataType):
+    key_type: DataType = None  # type: ignore[assignment]
+    value_type: DataType = None  # type: ignore[assignment]
+    value_contains_null: bool = True
+    variable_width = True
+    sql_name = "map"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, MapType)
+            and other.key_type == self.key_type
+            and other.value_type == self.value_type
+        )
+
+    def __hash__(self) -> int:
+        return hash((MapType, self.key_type, self.value_type))
+
+    def __repr__(self) -> str:
+        return f"map<{self.key_type!r},{self.value_type!r}>"
+
+
+# Singletons, mirroring Spark's object types.
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+STRING = StringType()
+BINARY = BinaryType()
+NULL = NullType()
+
+_BY_NAME = {
+    "boolean": BOOLEAN,
+    "tinyint": BYTE,
+    "byte": BYTE,
+    "smallint": SHORT,
+    "short": SHORT,
+    "int": INT,
+    "integer": INT,
+    "bigint": LONG,
+    "long": LONG,
+    "float": FLOAT,
+    "double": DOUBLE,
+    "date": DATE,
+    "timestamp": TIMESTAMP,
+    "string": STRING,
+    "binary": BINARY,
+    "void": NULL,
+}
+
+
+def type_from_name(name: str) -> DataType:
+    name = name.strip().lower()
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    if name.startswith("decimal"):
+        if "(" in name:
+            inner = name[name.index("(") + 1 : name.rindex(")")]
+            p, s = inner.split(",")
+            return DecimalType(int(p), int(s))
+        return DecimalType()
+    raise ValueError(f"unknown SQL type name: {name}")
+
+
+_NUMERIC_WIDEN_ORDER = [ByteType(), ShortType(), IntegerType(), LongType(), FloatType(), DoubleType()]
+
+
+def numeric_promote(a: DataType, b: DataType) -> DataType:
+    """Spark's binary-arithmetic promotion for non-decimal numeric types."""
+    if a == b:
+        return a
+    ia = _NUMERIC_WIDEN_ORDER.index(a)
+    ib = _NUMERIC_WIDEN_ORDER.index(b)
+    return _NUMERIC_WIDEN_ORDER[max(ia, ib)]

@@ -1,0 +1,62 @@
+"""Test fixtures.
+
+Tests run on CPU with 8 virtual devices so multi-chip sharding paths compile
+and execute without TPU hardware (the same trick the driver's
+dryrun_multichip uses).  Differential fixtures mirror the reference's
+with_cpu_session/with_gpu_session oracle (reference:
+integration_tests/src/main/python/spark_session.py:145-158) and the
+@inject_oom fault-injection marker (conftest.py:177).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The container's sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon (the real TPU tunnel), so env vars are too late here;
+# post-import config updates still work because backends init lazily.
+# Tests run on CPU with 8 virtual devices: fast compiles, true float64
+# (bit-exactness oracle), and the multi-chip sharding paths execute.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "inject_oom: inject synthetic retry/split OOMs into the device arena "
+        "mid-query; the differential oracle then proves retry correctness "
+        "(reference: spark.rapids.sql.test.injectRetryOOM).",
+    )
+    config.addinivalue_line(
+        "markers",
+        "allow_non_gpu(*names): permit the listed execs/exprs to fall back "
+        "to CPU in the plan-shape assertion.",
+    )
+
+
+@pytest.fixture(autouse=True)
+def _seeded_rng():
+    np.random.seed(0)
+
+
+@pytest.fixture(autouse=True)
+def _inject_oom_marker(request):
+    """Activate OOM injection for tests marked @pytest.mark.inject_oom."""
+    marker = request.node.get_closest_marker("inject_oom")
+    if marker is None:
+        yield
+        return
+    from spark_rapids_tpu.memory import retry as retry_mod
+
+    retry_mod.enable_oom_injection(num_ooms=1, skip=0, kind="retry")
+    try:
+        yield
+    finally:
+        retry_mod.disable_oom_injection()

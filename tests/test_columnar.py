@@ -1,0 +1,106 @@
+"""Unit tests for the columnar substrate (Column/Batch/Arrow interop)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
+
+
+def test_round_up_pow2():
+    assert round_up_pow2(1) == 1
+    assert round_up_pow2(2) == 2
+    assert round_up_pow2(3) == 4
+    assert round_up_pow2(1000) == 1024
+    assert round_up_pow2(1024) == 1024
+
+
+def test_fixed_width_roundtrip():
+    col = DeviceColumn.from_numpy(
+        np.array([1, 2, 3, 4], dtype=np.int64), T.LONG,
+        validity=np.array([True, False, True, True]))
+    assert col.capacity == 4
+    assert col.to_pylist(4) == [1, None, 3, 4]
+    # null slots hold canonical zero
+    assert np.asarray(col.data)[1] == 0
+
+
+def test_string_roundtrip():
+    col = DeviceColumn.from_strings(["hello", None, "", "world!"])
+    assert col.to_pylist(4) == ["hello", None, "", "world!"]
+    offs = np.asarray(col.offsets)
+    assert offs[-1] == offs[4]  # padding offsets are flat
+
+
+def test_batch_pydict_roundtrip():
+    schema = Schema.of(a=T.INT, b=T.DOUBLE, s=T.STRING)
+    batch = ColumnarBatch.from_pydict(
+        {"a": [1, None, 3], "b": [1.5, 2.5, None], "s": ["x", "y", None]}, schema)
+    assert batch.host_num_rows() == 3
+    assert batch.capacity == 4
+    out = batch.to_pydict()
+    assert out == {"a": [1, None, 3], "b": [1.5, 2.5, None], "s": ["x", "y", None]}
+
+
+def test_arrow_roundtrip():
+    tbl = pa.table({
+        "i": pa.array([1, 2, None], type=pa.int32()),
+        "l": pa.array([10, None, 30], type=pa.int64()),
+        "f": pa.array([1.0, None, 3.0], type=pa.float64()),
+        "s": pa.array(["a", None, "ccc"]),
+        "b": pa.array([True, False, None]),
+    })
+    batch = ColumnarBatch.from_arrow(tbl)
+    back = batch.to_arrow()
+    assert back.equals(tbl)
+
+
+def test_arrow_timestamp_date():
+    import datetime
+    tbl = pa.table({
+        "d": pa.array([datetime.date(2020, 1, 1), None], type=pa.date32()),
+        "t": pa.array([datetime.datetime(2020, 1, 1, 12, 0, 0), None],
+                      type=pa.timestamp("us", tz="UTC")),
+    })
+    batch = ColumnarBatch.from_arrow(tbl)
+    assert batch.schema.dtypes == (T.DATE, T.TIMESTAMP)
+    back = batch.to_arrow()
+    assert back.equals(tbl)
+
+
+def test_batch_is_pytree():
+    import jax
+    schema = Schema.of(a=T.INT)
+    batch = ColumnarBatch.from_pydict({"a": [1, 2, 3]}, schema)
+
+    @jax.jit
+    def bump(b: ColumnarBatch) -> ColumnarBatch:
+        col = b.columns[0]
+        new = DeviceColumn(col.data + 1, col.validity, col.dtype)
+        return ColumnarBatch((new,), b.num_rows, b.schema)
+
+    out = bump(batch)
+    assert out.to_pydict() == {"a": [2, 3, 4]}
+
+
+def test_with_capacity_grow():
+    col = DeviceColumn.from_strings(["ab", "cde"])
+    grown = col.with_capacity(8, byte_capacity=32)
+    assert grown.capacity == 8
+    assert grown.to_pylist(2) == ["ab", "cde"]
+    num = DeviceColumn.from_numpy(np.array([5, 6], dtype=np.int32), T.INT)
+    grown2 = num.with_capacity(16)
+    assert grown2.to_pylist(2) == [5, 6]
+
+
+def test_config_system():
+    from spark_rapids_tpu.config import (RapidsConf, BATCH_SIZE_BYTES,
+                                         generate_config_docs)
+    c = RapidsConf({"spark.rapids.sql.batchSizeBytes": "512m",
+                    "spark.rapids.sql.enabled": "false"})
+    assert c.get(BATCH_SIZE_BYTES) == 512 << 20
+    assert not c.sql_enabled
+    assert RapidsConf().sql_enabled
+    docs = generate_config_docs()
+    assert "spark.rapids.sql.batchSizeBytes" in docs

@@ -1,0 +1,205 @@
+"""Group-by kernels: sort-based segmented aggregation.
+
+TPU replacement for cuDF's hash groupby (`Table.groupBy`, reference
+consumption: GpuAggregateExec.scala:360 `AggHelper`).  On TPU a sort +
+segmented-reduce maps better onto XLA's fixed-shape world than an
+open-addressing hash table: `jnp.lexsort` is a single fused variadic sort,
+and `jax.ops.segment_*` are native scatter-reduces.
+
+Spark grouping semantics honored here:
+  * null keys form their own group (null == null for grouping);
+  * -0.0 and 0.0 group together; all NaNs group together
+    (keys are normalized before comparison);
+  * output group order is unspecified (ours: key sort order) — the
+    differential oracle sorts before comparing, as the reference's
+    integration tests do via ignore_order.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.kernels.selection import compaction_map, gather_batch, gather_column
+from spark_rapids_tpu.kernels.sort import SortOrder, sort_indices
+
+
+def normalize_key_column(col: DeviceColumn) -> DeviceColumn:
+    """Normalize float keys so bit-compare == Spark group equality."""
+    if isinstance(col.dtype, (T.FloatType, T.DoubleType)):
+        d = col.data
+        d = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)      # -0.0 -> 0.0
+        d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, d.dtype), d)  # canonical NaN
+        return DeviceColumn(d, col.validity, col.dtype, col.offsets)
+    return col
+
+
+def _rows_equal_prev(col: DeviceColumn) -> jax.Array:
+    """[capacity] bool: row i equals row i-1 in this column (null==null).
+    Relies on canonical padding (null data slots are zero) and on float keys
+    being normalized, so a bit/data comparison is exact."""
+    assert not col.is_string_like, "use _string_rows_equal_prev"
+    if isinstance(col.dtype, (T.FloatType, T.DoubleType)):
+        w = jnp.uint64 if col.data.dtype == jnp.float64 else jnp.uint32
+        bits = jax.lax.bitcast_convert_type(col.data, w)
+        eq = bits == jnp.roll(bits, 1)
+    else:
+        eq = col.data == jnp.roll(col.data, 1)
+    same_null = col.validity == jnp.roll(col.validity, 1)
+    return eq & same_null
+
+
+def _string_rows_equal_prev(col: DeviceColumn, max_bytes: int) -> jax.Array:
+    from spark_rapids_tpu.kernels.sort import _string_data_keys
+    chunks = _string_data_keys(col, SortOrder(True), max_bytes)
+    starts = col.offsets[:-1]
+    lengths = col.offsets[1:] - starts
+    eq = lengths == jnp.roll(lengths, 1)
+    for c in chunks:
+        eq = eq & (c == jnp.roll(c, 1))
+    same_null = col.validity == jnp.roll(col.validity, 1)
+    return eq & same_null
+
+
+@dataclasses.dataclass
+class GroupedLayout:
+    """Result of the grouping phase: the batch sorted by keys plus segment
+    structure.  Aggregations are segment reductions over this layout."""
+
+    sorted_batch: ColumnarBatch
+    segment_ids: jax.Array       # int32 [capacity], 0-based; padding rows -> last
+    num_groups: jax.Array        # scalar int32
+    boundary: jax.Array          # bool [capacity], True at first row of group
+
+
+def group_rows(
+    batch: ColumnarBatch,
+    key_cols: Sequence[int],
+    string_max_bytes: Optional[int] = None,
+) -> GroupedLayout:
+    """Sort rows by keys and delimit groups.
+
+    string_max_bytes must cover the longest live string key or distinct
+    groups silently merge; None derives it from the data (host sync).
+    """
+    if string_max_bytes is None:
+        from spark_rapids_tpu.kernels import strings as strkern
+        string_max_bytes = strkern.live_string_bucket_for_batch(batch, key_cols)
+    # normalize keys (in a copy of the batch) before sorting/comparison
+    cols = list(batch.columns)
+    for ci in key_cols:
+        cols[ci] = normalize_key_column(cols[ci])
+    nb = ColumnarBatch(tuple(cols), batch.num_rows, batch.schema)
+
+    orders = [SortOrder(True, True) for _ in key_cols]
+    idx = sort_indices(nb, key_cols, orders, string_max_bytes)
+    sb = gather_batch(nb, idx, nb.num_rows)
+
+    live = sb.live_mask()
+    eq = jnp.ones((sb.capacity,), dtype=jnp.bool_)
+    for ci in key_cols:
+        col = sb.columns[ci]
+        if col.is_string_like:
+            eq = eq & _string_rows_equal_prev(col, string_max_bytes)
+        else:
+            eq = eq & _rows_equal_prev(col)
+    first_row = jnp.arange(sb.capacity, dtype=jnp.int32) == 0
+    boundary = live & (first_row | ~eq)
+    segment_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    segment_ids = jnp.where(live, segment_ids, sb.capacity - 1)
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    return GroupedLayout(sb, segment_ids.astype(jnp.int32), num_groups, boundary)
+
+
+# -- segment reductions -----------------------------------------------------
+
+def seg_count_valid(col: DeviceColumn, layout: GroupedLayout) -> Tuple[jax.Array, jax.Array]:
+    """COUNT(col): number of non-null values per group -> (int64, validity)."""
+    live = layout.sorted_batch.live_mask()
+    contrib = (col.validity & live).astype(jnp.int64)
+    out = jax.ops.segment_sum(contrib, layout.segment_ids, num_segments=col.capacity)
+    return out, jnp.ones((col.capacity,), jnp.bool_)
+
+
+def seg_count_star(layout: GroupedLayout) -> Tuple[jax.Array, jax.Array]:
+    cap = layout.sorted_batch.capacity
+    live = layout.sorted_batch.live_mask()
+    out = jax.ops.segment_sum(live.astype(jnp.int64), layout.segment_ids, num_segments=cap)
+    return out, jnp.ones((cap,), jnp.bool_)
+
+
+def seg_sum(col: DeviceColumn, layout: GroupedLayout, out_dtype) -> Tuple[jax.Array, jax.Array]:
+    """SUM: nulls ignored; all-null group -> null; int64 overflow wraps
+    (non-ANSI Spark)."""
+    live = layout.sorted_batch.live_mask()
+    valid = col.validity & live
+    vals = col.data.astype(out_dtype)
+    contrib = jnp.where(valid, vals, jnp.zeros((), out_dtype))
+    out = jax.ops.segment_sum(contrib, layout.segment_ids, num_segments=col.capacity)
+    nvalid = jax.ops.segment_sum(valid.astype(jnp.int32), layout.segment_ids,
+                                 num_segments=col.capacity)
+    return out, nvalid > 0
+
+
+def _extreme(dtype, is_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if is_min else -jnp.inf, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(True if is_min else False, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if is_min else info.min, dtype=dtype)
+
+
+def seg_min(col: DeviceColumn, layout: GroupedLayout) -> Tuple[jax.Array, jax.Array]:
+    live = layout.sorted_batch.live_mask()
+    valid = col.validity & live
+    ident = _extreme(col.data.dtype, is_min=True)
+    contrib = jnp.where(valid, col.data, ident)
+    if col.data.dtype == jnp.bool_:
+        out = jax.ops.segment_min(contrib.astype(jnp.int8), layout.segment_ids,
+                                  num_segments=col.capacity).astype(jnp.bool_)
+    else:
+        out = jax.ops.segment_min(contrib, layout.segment_ids, num_segments=col.capacity)
+    nvalid = jax.ops.segment_sum(valid.astype(jnp.int32), layout.segment_ids,
+                                 num_segments=col.capacity)
+    return out, nvalid > 0
+
+
+def seg_max(col: DeviceColumn, layout: GroupedLayout) -> Tuple[jax.Array, jax.Array]:
+    live = layout.sorted_batch.live_mask()
+    valid = col.validity & live
+    ident = _extreme(col.data.dtype, is_min=False)
+    contrib = jnp.where(valid, col.data, ident)
+    if col.data.dtype == jnp.bool_:
+        out = jax.ops.segment_max(contrib.astype(jnp.int8), layout.segment_ids,
+                                  num_segments=col.capacity).astype(jnp.bool_)
+    else:
+        out = jax.ops.segment_max(contrib, layout.segment_ids, num_segments=col.capacity)
+    nvalid = jax.ops.segment_sum(valid.astype(jnp.int32), layout.segment_ids,
+                                 num_segments=col.capacity)
+    return out, nvalid > 0
+
+
+def group_keys_output(layout: GroupedLayout, key_cols: Sequence[int]) -> List[DeviceColumn]:
+    """Gather the first row of each group for the key output columns."""
+    indices, count = compaction_map(layout.boundary)
+    return [
+        gather_column(layout.sorted_batch.columns[ci], indices, count)
+        for ci in key_cols
+    ]
+
+
+def finalize_agg_column(values: jax.Array, validity: jax.Array,
+                        num_groups: jax.Array, dtype: T.DataType) -> DeviceColumn:
+    """Trim a [capacity] segment-reduce result to canonical form."""
+    cap = values.shape[0]
+    live = jnp.arange(cap, dtype=jnp.int32) < num_groups
+    valid = validity & live
+    data = jnp.where(valid, values, jnp.zeros((), values.dtype))
+    return DeviceColumn(data, valid, dtype)

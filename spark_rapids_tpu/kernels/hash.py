@@ -1,0 +1,293 @@
+"""Spark-bit-exact hash kernels.
+
+TPU replacement for the reference's native hash kernels
+(`com.nvidia.spark.rapids.jni.Hash`, consumed by HashFunctions.scala and
+GpuHashPartitioningBase.scala).  Bit-exactness with Spark's
+Murmur3_x86_32(seed=42) is REQUIRED for partitioning correctness: a CPU
+Spark stage and a TPU stage must route identical keys to identical reduce
+partitions.
+
+Implemented from the MurmurHash3 spec plus Spark's documented field-chaining
+semantics (each column's hash seeds the next; null fields leave the running
+hash unchanged; trailing string bytes are mixed one-at-a-time sign-extended).
+All arithmetic is done in uint32 lanes on the VPU; results are reinterpreted
+as int32 at the end.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_M5 = np.uint32(0xE6546B64)
+
+DEFAULT_SEED = 42
+
+
+def _rotl32(x, r: int):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k1(k1):
+    k1 = k1 * _C1
+    k1 = _rotl32(k1, 15)
+    k1 = k1 * _C2
+    return k1
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ _mix_k1(k1)
+    h1 = _rotl32(h1, 13)
+    h1 = h1 * jnp.uint32(5) + _M5
+    return h1
+
+
+def _fmix(h1, length_bytes):
+    h1 = h1 ^ jnp.uint32(length_bytes)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> 16)
+    return h1
+
+
+def _hash_int(value_u32, seed_u32):
+    """Murmur3 of one 4-byte block (Spark hashInt)."""
+    return _fmix(_mix_h1(seed_u32, value_u32), 4)
+
+
+def _hash_long(value_u64, seed_u32):
+    """Spark hashLong: low word then high word, length 8."""
+    low = value_u64.astype(jnp.uint32)
+    high = (value_u64 >> 32).astype(jnp.uint32)
+    h1 = _mix_h1(seed_u32, low)
+    h1 = _mix_h1(h1, high)
+    return _fmix(h1, 8)
+
+
+def _f32_bits(x):
+    """float32 bits with Spark's -0.0 → 0.0 normalization."""
+    x = jnp.where(x == jnp.float32(0.0), jnp.float32(0.0), x)
+    return jax.lax.bitcast_convert_type(x, jnp.uint32)
+
+
+def _f64_bits(x):
+    x = jnp.where(x == jnp.float64(0.0), jnp.float64(0.0), x)
+    return jax.lax.bitcast_convert_type(x, jnp.uint64)
+
+
+def hash_fixed_width(col: DeviceColumn, seeds: jax.Array) -> jax.Array:
+    """Chain one fixed-width column into running per-row hashes.
+
+    seeds: uint32 [capacity] running hash; returns updated uint32 [capacity].
+    Null rows pass the seed through unchanged (Spark semantics).
+    """
+    dt = col.dtype
+    if isinstance(dt, T.BooleanType):
+        v = col.data.astype(jnp.uint32)  # true→1, false→0
+        h = _hash_int(v, seeds)
+    elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        # sign-extend to int32 then reinterpret
+        v = col.data.astype(jnp.int32).astype(jnp.uint32)
+        h = _hash_int(v, seeds)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        v = col.data.astype(jnp.int64).astype(jnp.uint64)
+        h = _hash_long(v, seeds)
+    elif isinstance(dt, T.FloatType):
+        h = _hash_int(_f32_bits(col.data), seeds)
+    elif isinstance(dt, T.DoubleType):
+        h = _hash_long(_f64_bits(col.data), seeds)
+    elif isinstance(dt, T.DecimalType) and not dt.uses_two_limbs:
+        # Spark hashes small decimals as their unscaled long
+        v = col.data.astype(jnp.uint64)
+        h = _hash_long(v, seeds)
+    else:
+        raise NotImplementedError(f"murmur3 for {dt!r}")
+    return jnp.where(col.validity, h, seeds)
+
+
+def hash_string(col: DeviceColumn, seeds: jax.Array, max_bytes: int) -> jax.Array:
+    """Chain a string column into running hashes (Spark hashUnsafeBytes).
+
+    Strategy: gather each row's bytes into a padded [capacity, max_bytes]
+    tile (max_bytes is a static power-of-two bucket >= the longest string;
+    the caller picks it from host-side metadata), then mix 4-byte
+    little-endian words followed by one-at-a-time sign-extended tail bytes,
+    all vectorized across rows on the VPU.
+    """
+    cap = col.capacity
+    starts = col.offsets[:-1]
+    lengths = col.offsets[1:] - starts
+    # [cap, max_bytes] byte tile; out-of-range -> 0 (masked later)
+    pos = jnp.arange(max_bytes, dtype=jnp.int32)[None, :]
+    byte_idx = starts[:, None] + pos
+    inb = pos < lengths[:, None]
+    byte_idx = jnp.clip(byte_idx, 0, col.data.shape[0] - 1)
+    tile = jnp.where(inb, col.data[byte_idx], jnp.uint8(0))
+
+    n_words = max_bytes // 4
+    words = (
+        tile[:, 0::4].astype(jnp.uint32)
+        | (tile[:, 1::4].astype(jnp.uint32) << 8)
+        | (tile[:, 2::4].astype(jnp.uint32) << 16)
+        | (tile[:, 3::4].astype(jnp.uint32) << 24)
+    )
+    aligned_words = (lengths // 4).astype(jnp.int32)
+
+    def word_step(i, h1):
+        use = i < aligned_words
+        mixed = _mix_h1(h1, words[:, i])
+        return jnp.where(use, mixed, h1)
+
+    h1 = jax.lax.fori_loop(0, n_words, word_step, seeds)
+
+    # tail bytes, each mixed as a sign-extended int (Spark's per-byte tail)
+    def tail_step(i, h1):
+        use = i < lengths
+        b = tile[jnp.arange(cap), jnp.minimum(i, max_bytes - 1)]
+        sb = b.astype(jnp.int8).astype(jnp.int32).astype(jnp.uint32)
+        mixed = _mix_h1(h1, sb)
+        in_tail = (i >= aligned_words * 4) & use
+        return jnp.where(in_tail, mixed, h1)
+
+    h1 = jax.lax.fori_loop(0, max_bytes, tail_step, h1)
+    h = _fmix_rows(h1, lengths)
+    return jnp.where(col.validity, h, seeds)
+
+
+def _fmix_rows(h1, lengths):
+    h1 = h1 ^ lengths.astype(jnp.uint32)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> 16)
+    return h1
+
+
+def murmur3_hash(
+    columns: Sequence[DeviceColumn],
+    seed: int = DEFAULT_SEED,
+    string_max_bytes: int = 64,
+) -> jax.Array:
+    """Row hashes of the given key columns, Spark Murmur3Hash semantics.
+
+    Returns int32 [capacity].  Padding rows hash deterministically (their
+    canonical zero contents) but are never used by callers, which mask by
+    num_rows.
+    """
+    cap = columns[0].capacity
+    h = jnp.full((cap,), np.uint32(np.uint32(seed)), dtype=jnp.uint32)
+    for col in columns:
+        if col.is_string_like:
+            h = hash_string(col, h, string_max_bytes)
+        else:
+            h = hash_fixed_width(col, h)
+    return h.astype(jnp.int32)
+
+
+def pmod(hashes: jax.Array, num_partitions: int) -> jax.Array:
+    """Spark's Pmod(hash, n): non-negative modulus for partition routing."""
+    n = jnp.int32(num_partitions)
+    m = hashes % n
+    return jnp.where(m < 0, m + n, m)
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python reference (the differential oracle for the kernels above).
+# ---------------------------------------------------------------------------
+
+def _py_rotl(x: int, r: int) -> int:
+    x &= 0xFFFFFFFF
+    return ((x << r) | (x >> (32 - r))) & 0xFFFFFFFF
+
+
+def _py_mix_k1(k1: int) -> int:
+    k1 = (k1 * 0xCC9E2D51) & 0xFFFFFFFF
+    k1 = _py_rotl(k1, 15)
+    k1 = (k1 * 0x1B873593) & 0xFFFFFFFF
+    return k1
+
+
+def _py_mix_h1(h1: int, k1: int) -> int:
+    h1 = (h1 ^ _py_mix_k1(k1)) & 0xFFFFFFFF
+    h1 = _py_rotl(h1, 13)
+    h1 = (h1 * 5 + 0xE6546B64) & 0xFFFFFFFF
+    return h1
+
+
+def _py_fmix(h1: int, length: int) -> int:
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & 0xFFFFFFFF
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & 0xFFFFFFFF
+    h1 ^= h1 >> 16
+    return h1
+
+
+def py_hash_int(value: int, seed: int) -> int:
+    return _py_fmix(_py_mix_h1(seed, value & 0xFFFFFFFF), 4)
+
+
+def py_hash_long(value: int, seed: int) -> int:
+    value &= 0xFFFFFFFFFFFFFFFF
+    h1 = _py_mix_h1(seed, value & 0xFFFFFFFF)
+    h1 = _py_mix_h1(h1, value >> 32)
+    return _py_fmix(h1, 8)
+
+
+def py_hash_bytes(data: bytes, seed: int) -> int:
+    h1 = seed
+    n = len(data)
+    aligned = n - (n % 4)
+    for i in range(0, aligned, 4):
+        word = int.from_bytes(data[i : i + 4], "little")
+        h1 = _py_mix_h1(h1, word)
+    for i in range(aligned, n):
+        b = data[i]
+        if b >= 128:
+            b -= 256  # sign extension
+        h1 = _py_mix_h1(h1, b & 0xFFFFFFFF)
+    return _py_fmix(h1, n)
+
+
+def py_murmur3_row(values, dtypes, seed: int = DEFAULT_SEED) -> int:
+    """Reference row hash over python values (None = null = skipped)."""
+    import struct
+
+    h = seed & 0xFFFFFFFF
+    for v, dt in zip(values, dtypes):
+        if v is None:
+            continue
+        if isinstance(dt, T.BooleanType):
+            h = py_hash_int(1 if v else 0, h)
+        elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+            h = py_hash_int(int(v), h)
+        elif isinstance(dt, (T.LongType, T.TimestampType)):
+            h = py_hash_long(int(v), h)
+        elif isinstance(dt, T.FloatType):
+            f = 0.0 if v == 0.0 else float(np.float32(v))
+            bits = struct.unpack("<I", struct.pack("<f", f))[0]
+            h = py_hash_int(bits, h)
+        elif isinstance(dt, T.DoubleType):
+            d = 0.0 if v == 0.0 else float(v)
+            bits = struct.unpack("<Q", struct.pack("<d", d))[0]
+            h = py_hash_long(bits, h)
+        elif isinstance(dt, T.StringType):
+            h = py_hash_bytes(v.encode("utf-8") if isinstance(v, str) else v, h)
+        elif isinstance(dt, T.DecimalType) and not dt.uses_two_limbs:
+            h = py_hash_long(int(v), h)
+        else:
+            raise NotImplementedError(f"py murmur3 for {dt!r}")
+    res = h & 0xFFFFFFFF
+    return res - (1 << 32) if res >= (1 << 31) else res

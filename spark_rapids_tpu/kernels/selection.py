@@ -1,0 +1,271 @@
+"""Row-selection kernels: gather and filter-compaction.
+
+TPU replacement for cuDF's gather/apply_boolean_mask kernels (reference
+consumption: GpuColumnVector-backed `Table.gather` / filter inside
+basicPhysicalOperators.scala:1334).  Everything is static-shape: a gather
+produces a fixed-capacity output plus a dynamic valid count; padding slots
+are canonical (validity False, zero data, flat offsets).
+
+The gather-map representation (int32 row indices + count) is the same seam
+the reference's join and filter kernels share, so joins reuse these kernels
+for their apply step.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+OOB = jnp.int32(2**31 - 1)  # sentinel for "no source row"
+
+
+@jax.tree_util.register_pytree_node_class
+class OverflowStatus:
+    """Capacity-overflow report from a kernel whose output size is
+    data-dependent (gather with repeats, concat, join expansion).
+
+    The TPU analog of the reference's GpuSplitAndRetryOOM signal
+    (RmmRapidsRetryIterator.scala:37): kernels always run to completion at
+    static capacity, but report the sizes they actually needed; the host-side
+    retry framework compares against the static capacities and re-runs at
+    larger capacity when exceeded.  Results accompanied by an exceeded status
+    are garbage and must be discarded.
+    """
+
+    def __init__(self, required_rows, required_bytes=()):
+        self.required_rows = required_rows          # scalar int32/int64
+        self.required_bytes = tuple(required_bytes)  # per string column
+
+    def tree_flatten(self):
+        return (self.required_rows, self.required_bytes), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1])
+
+    def exceeded(self, row_capacity: int, byte_capacities) -> bool:
+        """Host-side check (forces a sync of a few scalars)."""
+        if int(self.required_rows) > row_capacity:
+            return True
+        for req, cap in zip(self.required_bytes, byte_capacities):
+            if int(req) > cap:
+                return True
+        return False
+
+
+def compaction_map(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Build a gather map packing rows where ``mask`` is True to the front.
+
+    mask: bool [capacity] (must already exclude padding rows).
+    Returns (indices int32 [capacity], count int32 scalar); indices[j] for
+    j >= count are OOB.  Stable: preserves row order (required for Spark
+    filter semantics and for the ordered-by-partition shuffle slice).
+    """
+    cap = mask.shape[0]
+    mask_i = mask.astype(jnp.int32)
+    dest = jnp.cumsum(mask_i) - mask_i  # exclusive prefix sum
+    count = jnp.sum(mask_i)
+    src = jnp.arange(cap, dtype=jnp.int32)
+    indices = jnp.full((cap,), OOB, dtype=jnp.int32)
+    scatter_to = jnp.where(mask, dest, cap)  # cap = dropped
+    indices = indices.at[scatter_to].set(src, mode="drop")
+    return indices, count
+
+
+def gather_column(
+    col: DeviceColumn,
+    indices: jax.Array,
+    count: jax.Array,
+    out_capacity: Optional[int] = None,
+    out_byte_capacity: Optional[int] = None,
+) -> DeviceColumn:
+    """Gather rows of one column by a gather map.
+
+    indices: int32 [out_capacity] source row ids (OOB => null/pad output).
+    count: scalar int32, number of live output rows.
+    """
+    out_cap = out_capacity if out_capacity is not None else indices.shape[0]
+    if indices.shape[0] < out_cap:
+        idx = jnp.concatenate([
+            indices.astype(jnp.int32),
+            jnp.full((out_cap - indices.shape[0],), OOB, dtype=jnp.int32),
+        ])
+    else:
+        idx = indices[:out_cap]
+    live = jnp.arange(out_cap, dtype=jnp.int32) < count
+    inb = (idx >= 0) & (idx < col.capacity) & live
+    safe = jnp.where(inb, idx, 0)
+    validity = jnp.where(inb, col.validity[safe], False)
+
+    if not col.is_string_like:
+        data = jnp.where(validity, col.data[safe], jnp.zeros((), col.data.dtype))
+        return DeviceColumn(data, validity, col.dtype)
+
+    # strings: rebuild offsets from gathered lengths, then gather bytes.
+    # NOTE: gathered bytes may exceed out_byte_capacity (repeated indices);
+    # use gather_column_checked / gather_batch_checked when indices can
+    # repeat — the unchecked variant truncates silently.
+    starts = col.offsets[:-1]
+    lengths = col.offsets[1:] - starts
+    glen = jnp.where(validity, lengths[safe], 0)
+    new_offsets = jnp.zeros((out_cap + 1,), dtype=jnp.int32)
+    new_offsets = new_offsets.at[1:].set(jnp.cumsum(glen))
+    total = new_offsets[out_cap]
+
+    bcap = out_byte_capacity if out_byte_capacity is not None else col.byte_capacity
+    # for each output byte position, find its row then its source byte
+    bpos = jnp.arange(bcap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets, bpos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, out_cap - 1)
+    within = bpos - new_offsets[row]
+    src_byte = starts[safe[row]] + within
+    src_byte = jnp.clip(src_byte, 0, col.data.shape[0] - 1)
+    data = jnp.where(bpos < total, col.data[src_byte], jnp.uint8(0))
+    return DeviceColumn(data, validity, col.dtype, new_offsets)
+
+
+def gather_batch(
+    batch: ColumnarBatch,
+    indices: jax.Array,
+    count: jax.Array,
+    out_capacity: Optional[int] = None,
+) -> ColumnarBatch:
+    """Gather without overflow reporting.  Safe when indices are a
+    permutation/subset of source rows (sort, filter, partition): output bytes
+    then never exceed source byte capacity.  For maps with repeats (joins,
+    expand) use gather_batch_checked."""
+    cols = tuple(
+        gather_column(c, indices, count, out_capacity=out_capacity)
+        for c in batch.columns
+    )
+    return ColumnarBatch(cols, count.astype(jnp.int32), batch.schema)
+
+
+def required_gather_bytes(col: DeviceColumn, indices: jax.Array, count: jax.Array) -> jax.Array:
+    """Total bytes the gather output needs (before any truncation)."""
+    out_cap = indices.shape[0]
+    idx = indices
+    live = jnp.arange(out_cap, dtype=jnp.int32) < count
+    inb = (idx >= 0) & (idx < col.capacity) & live
+    safe = jnp.where(inb, idx, 0)
+    valid = jnp.where(inb, col.validity[safe], False)
+    lengths = col.offsets[1:] - col.offsets[:-1]
+    return jnp.sum(jnp.where(valid, lengths[safe], 0)).astype(jnp.int64)
+
+
+def gather_batch_checked(
+    batch: ColumnarBatch,
+    indices: jax.Array,
+    count: jax.Array,
+    out_capacity: Optional[int] = None,
+    out_byte_capacities: Optional[Sequence[int]] = None,
+) -> Tuple[ColumnarBatch, OverflowStatus]:
+    """Gather that reports the sizes it needed; use when indices can repeat.
+
+    On `status.exceeded(...)` the caller must discard the result and re-run
+    with grown capacities (the retry framework's capacity-split path).
+    """
+    out_cap = out_capacity if out_capacity is not None else indices.shape[0]
+    string_cols = [i for i, c in enumerate(batch.columns) if c.is_string_like]
+    byte_caps = dict(zip(
+        string_cols,
+        out_byte_capacities if out_byte_capacities is not None
+        else [batch.columns[i].byte_capacity for i in string_cols],
+    ))
+    cols = tuple(
+        gather_column(
+            c, indices, count, out_capacity=out_cap,
+            out_byte_capacity=byte_caps.get(i),
+        )
+        for i, c in enumerate(batch.columns)
+    )
+    req_bytes = tuple(
+        required_gather_bytes(batch.columns[i], indices, count) for i in string_cols
+    )
+    status = OverflowStatus(count.astype(jnp.int64), req_bytes)
+    return ColumnarBatch(cols, count.astype(jnp.int32), batch.schema), status
+
+
+def filter_batch(batch: ColumnarBatch, predicate: jax.Array) -> ColumnarBatch:
+    """Apply a boolean predicate column (already null-filtered: null => False)
+    and compact survivors to the front.  Matches Spark FilterExec semantics
+    (reference: GpuFilterExec, basicPhysicalOperators.scala:1334)."""
+    mask = predicate & batch.live_mask()
+    indices, count = compaction_map(mask)
+    return gather_batch(batch, indices, count)
+
+
+def concat_batches_device(
+    batches: Sequence[ColumnarBatch], out_capacity: int
+) -> Tuple[ColumnarBatch, OverflowStatus]:
+    """Concatenate same-schema batches into one batch of the given capacity.
+
+    The TPU analog of the reference's coalesce kernel (GpuCoalesceBatches
+    .scala:260): builds one gather from stacked inputs.  Inputs are
+    normalized to a common capacity.  Returns (batch, status): if total live
+    rows exceed out_capacity, the batch is truncated (num_rows clamped) and
+    status.required_rows carries the true total for the retry framework.
+    String bytes never overflow (output byte capacity = sum of inputs).
+    """
+    assert batches, "need at least one batch"
+    schema = batches[0].schema
+    n_in = len(batches)
+    counts = jnp.stack([b.num_rows for b in batches])
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+    required_rows = offs[n_in]
+    total = jnp.minimum(required_rows, jnp.int32(out_capacity))
+
+    out_cols = []
+    for ci, dtype in enumerate(schema.dtypes):
+        cols = [b.columns[ci] for b in batches]
+        # normalize per-input capacities so buffers stack
+        max_cap = max(c.capacity for c in cols)
+        if dtype.variable_width:
+            max_bcap = max(c.byte_capacity for c in cols)
+            cols = [
+                c if c.capacity == max_cap and c.byte_capacity == max_bcap
+                else c.with_capacity(max_cap, max_bcap)
+                for c in cols
+            ]
+        else:
+            cols = [c if c.capacity == max_cap else c.with_capacity(max_cap)
+                    for c in cols]
+        if dtype.variable_width:
+            stacked_off = jnp.stack([c.offsets for c in cols])        # [n_in, cap+1]
+            stacked_dat = jnp.stack([c.data for c in cols])           # [n_in, bcap]
+            stacked_val = jnp.stack([c.validity for c in cols])       # [n_in, cap]
+            out_bcap = sum(c.byte_capacity for c in cols)
+            pos = jnp.arange(out_capacity, dtype=jnp.int32)
+            which = jnp.searchsorted(offs, pos, side="right").astype(jnp.int32) - 1
+            which = jnp.clip(which, 0, n_in - 1)
+            within = jnp.clip(pos - offs[which], 0, cols[0].capacity - 1)
+            live = pos < total
+            validity = jnp.where(live, stacked_val[which, within], False)
+            row_len = stacked_off[which, within + 1] - stacked_off[which, within]
+            lengths = jnp.where(live, row_len, 0)
+            new_offsets = jnp.zeros((out_capacity + 1,), jnp.int32).at[1:].set(jnp.cumsum(lengths))
+            bpos = jnp.arange(out_bcap, dtype=jnp.int32)
+            brow = jnp.clip(jnp.searchsorted(new_offsets, bpos, side="right").astype(jnp.int32) - 1,
+                            0, out_capacity - 1)
+            src_in_batch = stacked_off[which[brow], within[brow]] + (bpos - new_offsets[brow])
+            src_in_batch = jnp.clip(src_in_batch, 0, cols[0].byte_capacity - 1)
+            data = jnp.where(bpos < new_offsets[out_capacity],
+                             stacked_dat[which[brow], src_in_batch], jnp.uint8(0))
+            out_cols.append(DeviceColumn(data, validity, dtype, new_offsets))
+        else:
+            stacked = jnp.stack([c.data for c in cols])               # [n_in, cap]
+            stacked_val = jnp.stack([c.validity for c in cols])
+            pos = jnp.arange(out_capacity, dtype=jnp.int32)
+            which = jnp.searchsorted(offs, pos, side="right").astype(jnp.int32) - 1
+            which = jnp.clip(which, 0, n_in - 1)
+            within = jnp.clip(pos - offs[which], 0, cols[0].capacity - 1)
+            live = pos < total
+            validity = jnp.where(live, stacked_val[which, within], False)
+            data = jnp.where(validity, stacked[which, within], jnp.zeros((), stacked.dtype))
+            out_cols.append(DeviceColumn(data, validity, dtype))
+    batch = ColumnarBatch(tuple(out_cols), total.astype(jnp.int32), schema)
+    return batch, OverflowStatus(required_rows.astype(jnp.int64))

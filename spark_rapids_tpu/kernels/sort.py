@@ -1,0 +1,145 @@
+"""Sort kernels: multi-key lexicographic argsort with Spark ordering rules.
+
+TPU replacement for cuDF's `Table.orderBy` (reference consumption:
+GpuSortExec.scala:87).  Ordering semantics match Spark's SortExec:
+
+  * ASC NULLS FIRST is Spark's default (NULLS LAST for DESC); all four null
+    orderings supported, and NULLS FIRST/LAST is absolute (not affected by
+    the direction of the data ordering).
+  * Floats use Java Double.compare's total order: -0.0 < 0.0 and NaN sorts
+    greater than +Inf.
+  * Stable (ties keep input order), so partial sorts compose.
+
+Strategy: each key column contributes (null_key, data_key...) integer keys to
+one stable jnp.lexsort (XLA variadic sort); a liveness key sinks padding rows
+to the end.  Strings are ranked by byte chunks packed 7-bytes-per-uint64 in
+9-bit lanes (byte+1, 0 = past-end) so 'ab' < 'ab\\x00' orders correctly;
+max_bytes is a static bucket — the planner falls back for longer sort keys.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.kernels.selection import gather_batch
+
+
+class SortOrder:
+    """Direction + null placement of one sort key."""
+
+    def __init__(self, ascending: bool = True, nulls_first: Optional[bool] = None):
+        self.ascending = ascending
+        # Spark default: NULLS FIRST for ASC, NULLS LAST for DESC
+        self.nulls_first = nulls_first if nulls_first is not None else ascending
+
+    def __repr__(self):
+        return (f"{'ASC' if self.ascending else 'DESC'} "
+                f"NULLS {'FIRST' if self.nulls_first else 'LAST'}")
+
+
+def _float_total_order_bits(x: jax.Array) -> jax.Array:
+    """Map float32/float64 to same-width uint preserving Java's
+    Float/Double.compare total order (-0.0 < 0.0, NaN above +Inf)."""
+    if x.dtype == jnp.float64:
+        bits = jax.lax.bitcast_convert_type(x, jnp.uint64)
+        sign = jnp.uint64(1) << 63
+        return jnp.where((bits & sign) != 0, ~bits, bits | sign)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    sign = jnp.uint32(1) << 31
+    return jnp.where((bits & sign) != 0, ~bits, bits | sign)
+
+
+def _signed_to_unsigned(x: jax.Array) -> jax.Array:
+    """Order-preserving signed→unsigned (offset by flipping the sign bit)."""
+    return x.astype(jnp.int64).astype(jnp.uint64) ^ (jnp.uint64(1) << 63)
+
+
+def _data_key_fixed(col: DeviceColumn, order: SortOrder) -> jax.Array:
+    dt = col.dtype
+    if isinstance(dt, T.BooleanType):
+        k = col.data.astype(jnp.uint64)
+    elif isinstance(dt, T.FloatType):
+        k = _float_total_order_bits(col.data).astype(jnp.uint64)
+    elif isinstance(dt, T.DoubleType):
+        k = _float_total_order_bits(col.data)
+    else:
+        k = _signed_to_unsigned(col.data)
+    if not order.ascending:
+        k = ~k
+    # null rows get a constant so they never perturb less-significant keys
+    return jnp.where(col.validity, k, jnp.uint64(0))
+
+
+def _null_key(col: DeviceColumn, order: SortOrder) -> jax.Array:
+    if order.nulls_first:
+        return jnp.where(col.validity, jnp.uint8(1), jnp.uint8(0))
+    return jnp.where(col.validity, jnp.uint8(0), jnp.uint8(1))
+
+
+BYTES_PER_CHUNK = 7  # 9-bit lanes (byte value + 1; 0 = past end) in a uint64
+
+
+def _string_data_keys(col: DeviceColumn, order: SortOrder, max_bytes: int) -> List[jax.Array]:
+    """uint64 chunk keys, most-significant chunk first.  Lexicographic byte
+    order == unsigned comparison of the chunk sequence (Spark
+    UTF8String.binaryCompare)."""
+    starts = col.offsets[:-1]
+    lengths = col.offsets[1:] - starts
+    n_chunks = max(1, -(-max_bytes // BYTES_PER_CHUNK))
+    keys = []
+    for c in range(n_chunks):
+        chunk = jnp.zeros((col.capacity,), dtype=jnp.uint64)
+        for b in range(BYTES_PER_CHUNK):
+            pos = c * BYTES_PER_CHUNK + b
+            idx = jnp.clip(starts + pos, 0, col.data.shape[0] - 1)
+            lane = jnp.where(
+                pos < lengths, col.data[idx].astype(jnp.uint64) + 1, jnp.uint64(0)
+            )
+            chunk = (chunk << 9) | lane
+        if not order.ascending:
+            chunk = ~chunk
+        keys.append(jnp.where(col.validity, chunk, jnp.uint64(0)))
+    return keys
+
+
+def sort_indices(
+    batch: ColumnarBatch,
+    key_cols: Sequence[int],
+    orders: Sequence[SortOrder],
+    string_max_bytes: Optional[int] = None,
+) -> jax.Array:
+    """Stable argsort of live rows by the given keys; padding rows at end.
+    Returns int32 [capacity] gather indices.
+
+    string_max_bytes must cover the longest live string key or ordering
+    truncates; None derives it from the data (host sync)."""
+    if string_max_bytes is None:
+        from spark_rapids_tpu.kernels import strings as strkern
+        string_max_bytes = strkern.live_string_bucket_for_batch(batch, key_cols)
+    keys = []  # least significant first (jnp.lexsort: last key is primary)
+    for ci, order in zip(reversed(list(key_cols)), reversed(list(orders))):
+        col = batch.columns[ci]
+        if col.is_string_like:
+            for chunk in reversed(_string_data_keys(col, order, string_max_bytes)):
+                keys.append(chunk)
+        else:
+            keys.append(_data_key_fixed(col, order))
+        keys.append(_null_key(col, order))
+    live = batch.live_mask()
+    keys.append(jnp.where(live, jnp.uint8(0), jnp.uint8(1)))
+    return jnp.lexsort(tuple(keys)).astype(jnp.int32)
+
+
+def sort_batch(
+    batch: ColumnarBatch,
+    key_cols: Sequence[int],
+    orders: Sequence[SortOrder],
+    string_max_bytes: Optional[int] = None,
+) -> ColumnarBatch:
+    idx = sort_indices(batch, key_cols, orders, string_max_bytes)
+    return gather_batch(batch, idx, batch.num_rows)

@@ -1,0 +1,46 @@
+"""String-column metadata helpers shared by hash/sort/groupby kernels.
+
+Those kernels process string bytes through a static [capacity, max_bytes]
+tiling; an undersized max_bytes silently truncates (wrong hashes, merged
+groups).  The contract: callers derive max_bytes from the data via
+`live_string_bucket` (one tiny device->host sync) or track a bound through
+the plan; kernels trust the bucket.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+MIN_BUCKET = 16
+
+
+def max_live_string_bytes(col: DeviceColumn, num_rows) -> jax.Array:
+    """Length in bytes of the longest live string (device scalar, int32)."""
+    lengths = col.offsets[1:] - col.offsets[:-1]
+    live = jnp.arange(col.capacity, dtype=jnp.int32) < num_rows
+    return jnp.max(jnp.where(live & col.validity, lengths, 0)).astype(jnp.int32)
+
+
+def bucket_for(max_len: int) -> int:
+    """Power-of-two bucket >= max_len (bounds XLA recompile variants)."""
+    b = MIN_BUCKET
+    while b < max_len:
+        b <<= 1
+    return b
+
+
+def live_string_bucket(col: DeviceColumn, num_rows) -> int:
+    """Host-side bucket for one column (forces a scalar sync)."""
+    return bucket_for(int(max_live_string_bytes(col, num_rows)))
+
+
+def live_string_bucket_for_batch(batch, col_indices) -> int:
+    """Common bucket covering several string columns of a batch."""
+    m = 0
+    for ci in col_indices:
+        col = batch.columns[ci]
+        if col.is_string_like:
+            m = max(m, int(max_live_string_bytes(col, batch.num_rows)))
+    return bucket_for(m)

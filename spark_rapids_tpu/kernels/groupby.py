@@ -157,14 +157,27 @@ def _extreme(dtype, is_min: bool):
 
 
 def seg_min(col: DeviceColumn, layout: GroupedLayout) -> Tuple[jax.Array, jax.Array]:
+    """Spark MIN: NaN sorts greater than everything (Spark's total order), so
+    MIN returns the smallest non-NaN value and is NaN only for all-NaN
+    groups.  segment_min's native NaN propagation would be wrong here."""
     live = layout.sorted_batch.live_mask()
     valid = col.validity & live
     ident = _extreme(col.data.dtype, is_min=True)
-    contrib = jnp.where(valid, col.data, ident)
-    if col.data.dtype == jnp.bool_:
+    if jnp.issubdtype(col.data.dtype, jnp.floating):
+        nonnan = valid & ~jnp.isnan(col.data)
+        contrib = jnp.where(nonnan, col.data, ident)
+        out = jax.ops.segment_min(contrib, layout.segment_ids,
+                                  num_segments=col.capacity)
+        any_nonnan = jax.ops.segment_sum(
+            nonnan.astype(jnp.int32), layout.segment_ids,
+            num_segments=col.capacity) > 0
+        out = jnp.where(any_nonnan, out, jnp.full((), jnp.nan, col.data.dtype))
+    elif col.data.dtype == jnp.bool_:
+        contrib = jnp.where(valid, col.data, ident)
         out = jax.ops.segment_min(contrib.astype(jnp.int8), layout.segment_ids,
                                   num_segments=col.capacity).astype(jnp.bool_)
     else:
+        contrib = jnp.where(valid, col.data, ident)
         out = jax.ops.segment_min(contrib, layout.segment_ids, num_segments=col.capacity)
     nvalid = jax.ops.segment_sum(valid.astype(jnp.int32), layout.segment_ids,
                                  num_segments=col.capacity)
@@ -172,14 +185,27 @@ def seg_min(col: DeviceColumn, layout: GroupedLayout) -> Tuple[jax.Array, jax.Ar
 
 
 def seg_max(col: DeviceColumn, layout: GroupedLayout) -> Tuple[jax.Array, jax.Array]:
+    """Spark MAX: NaN is the greatest value, so any valid NaN in the group
+    makes the result NaN (explicitly, not via float-max propagation, whose
+    NaN behavior XLA does not guarantee)."""
     live = layout.sorted_batch.live_mask()
     valid = col.validity & live
     ident = _extreme(col.data.dtype, is_min=False)
-    contrib = jnp.where(valid, col.data, ident)
-    if col.data.dtype == jnp.bool_:
+    if jnp.issubdtype(col.data.dtype, jnp.floating):
+        isnan = jnp.isnan(col.data)
+        contrib = jnp.where(valid & ~isnan, col.data, ident)
+        out = jax.ops.segment_max(contrib, layout.segment_ids,
+                                  num_segments=col.capacity)
+        any_nan = jax.ops.segment_sum(
+            (valid & isnan).astype(jnp.int32), layout.segment_ids,
+            num_segments=col.capacity) > 0
+        out = jnp.where(any_nan, jnp.full((), jnp.nan, col.data.dtype), out)
+    elif col.data.dtype == jnp.bool_:
+        contrib = jnp.where(valid, col.data, ident)
         out = jax.ops.segment_max(contrib.astype(jnp.int8), layout.segment_ids,
                                   num_segments=col.capacity).astype(jnp.bool_)
     else:
+        contrib = jnp.where(valid, col.data, ident)
         out = jax.ops.segment_max(contrib, layout.segment_ids, num_segments=col.capacity)
     nvalid = jax.ops.segment_sum(valid.astype(jnp.int32), layout.segment_ids,
                                  num_segments=col.capacity)

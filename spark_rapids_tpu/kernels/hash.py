@@ -124,6 +124,7 @@ def hash_string(col: DeviceColumn, seeds: jax.Array, max_bytes: int) -> jax.Arra
     little-endian words followed by one-at-a-time sign-extended tail bytes,
     all vectorized across rows on the VPU.
     """
+    max_bytes = (max_bytes + 3) & ~3  # word-packing needs a multiple of 4
     cap = col.capacity
     starts = col.offsets[:-1]
     lengths = col.offsets[1:] - starts
@@ -160,18 +161,8 @@ def hash_string(col: DeviceColumn, seeds: jax.Array, max_bytes: int) -> jax.Arra
         return jnp.where(in_tail, mixed, h1)
 
     h1 = jax.lax.fori_loop(0, max_bytes, tail_step, h1)
-    h = _fmix_rows(h1, lengths)
+    h = _fmix(h1, lengths.astype(jnp.uint32))
     return jnp.where(col.validity, h, seeds)
-
-
-def _fmix_rows(h1, lengths):
-    h1 = h1 ^ lengths.astype(jnp.uint32)
-    h1 = h1 ^ (h1 >> 16)
-    h1 = h1 * jnp.uint32(0x85EBCA6B)
-    h1 = h1 ^ (h1 >> 13)
-    h1 = h1 * jnp.uint32(0xC2B2AE35)
-    h1 = h1 ^ (h1 >> 16)
-    return h1
 
 
 def murmur3_hash(

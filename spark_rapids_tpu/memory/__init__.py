@@ -1,0 +1,63 @@
+"""Memory runtime: arena accounting, spill, retry-on-OOM, task gating.
+
+The TPU analog of the reference's L1 device/memory runtime
+(GpuDeviceManager, GpuSemaphore, SpillFramework, RmmRapidsRetryIterator —
+see SURVEY.md §1 L1 and §3.5).
+"""
+from spark_rapids_tpu.memory.arena import (  # noqa: F401
+    CpuRetryOOM,
+    DeviceArena,
+    TpuOOM,
+    TpuRetryOOM,
+    TpuSplitAndRetryOOM,
+    device_arena,
+)
+from spark_rapids_tpu.memory.retry import (  # noqa: F401
+    disable_oom_injection,
+    enable_oom_injection,
+    with_capacity_retry,
+    with_retry,
+    with_retry_no_split,
+)
+from spark_rapids_tpu.memory.semaphore import tpu_semaphore  # noqa: F401
+from spark_rapids_tpu.memory.spill import (  # noqa: F401
+    SpillableBatchHandle,
+    SpillFramework,
+    make_spillable,
+    spill_framework,
+)
+
+
+def initialize_memory(conf) -> None:
+    """Apply a RapidsConf snapshot to the memory runtime.
+
+    Analog of the executor-plugin memory init (reference: Plugin.scala:657-690
+    -> GpuDeviceManager.initializeGpuAndMemory): retry attempts, concurrent
+    device tasks, host spill limit, and test OOM injection.
+    """
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.memory import retry as _retry, semaphore as _sem
+
+    _retry.MAX_RETRIES = conf.retry_max_attempts
+    _sem.configure(conf.concurrent_tpu_tasks)
+    spill_framework().host_limit_bytes = conf.get(C.HOST_SPILL_STORAGE_SIZE)
+    # injectRetryOOM accepts: false | true | retry[:num[:skip]] | split[:num[:skip]]
+    # (reference parse: RapidsConf.scala:3041-3083)
+    spec = conf.test_inject_retry_oom.strip().lower()
+    if spec in ("", "false", "0", "no"):
+        device_arena().clear_injection()
+    else:
+        kind, num, skip = "retry", 1, 0
+        if spec not in ("true", "1", "yes"):
+            parts = spec.split(":")
+            kind = parts[0]
+            if len(parts) > 1:
+                num = int(parts[1])
+            if len(parts) > 2:
+                skip = int(parts[2])
+        if kind not in ("retry", "split"):
+            raise ValueError(
+                "spark.rapids.sql.test.injectRetryOOM: unknown kind "
+                f"{kind!r} (expected retry|split|true|false, optionally "
+                "kind:num:skip)")
+        device_arena().inject_ooms(num, skip=skip, kind=kind)

@@ -1,0 +1,175 @@
+"""Device memory arena: budget accounting + OOM signaling for HBM.
+
+TPU analog of the reference's RMM pool + RmmSpark per-task tracking
+(reference: GpuDeviceManager.scala:362-456 pool setup;
+com.nvidia.spark.rapids.jni.RmmSpark consumed by RmmRapidsRetryIterator.scala:31).
+
+JAX/XLA owns the physical HBM allocator, so this layer is a *bookkeeping*
+arena: execs register the batches they hold, the arena enforces a byte
+budget, and when a reservation would exceed the budget it (1) asks the spill
+framework to evict device handles in priority order and then (2) raises
+``TpuRetryOOM`` / ``TpuSplitAndRetryOOM`` into the calling task — exactly the
+control flow the reference gets from the RMM alloc-failed callback
+(DeviceMemoryEventHandler.scala) + RmmSpark's thread state machine.
+
+The same arena implements the synthetic OOM-injection hooks that the
+differential test oracle relies on (reference: RapidsConf.scala:3041-3083
+``spark.rapids.sql.test.injectRetryOOM``; pytest marker ``@inject_oom``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+
+class TpuOOM(RuntimeError):
+    """Base class for retryable device-memory pressure signals."""
+
+
+class TpuRetryOOM(TpuOOM):
+    """Retry the whole operation after spilling (reference: GpuRetryOOM)."""
+
+
+class TpuSplitAndRetryOOM(TpuOOM):
+    """Split the input and retry per piece (reference: GpuSplitAndRetryOOM).
+
+    Also raised when a static-capacity kernel output overflowed and the
+    capacity escalation hit its configured ceiling.
+    """
+
+
+class CpuRetryOOM(TpuOOM):
+    """Host-memory analog (reference: CpuRetryOOM)."""
+
+
+class _Injection:
+    """Synthetic-OOM state (reference: RmmSpark OOM injection)."""
+
+    def __init__(self, num_ooms: int, skip: int, kind: str):
+        assert kind in ("retry", "split")
+        self.remaining = num_ooms
+        self.skip = skip
+        self.kind = kind
+
+
+_RETRY_SCOPE = threading.local()
+
+
+def enter_retry_scope() -> None:
+    _RETRY_SCOPE.depth = getattr(_RETRY_SCOPE, "depth", 0) + 1
+
+
+def exit_retry_scope() -> None:
+    _RETRY_SCOPE.depth = getattr(_RETRY_SCOPE, "depth", 1) - 1
+
+
+def in_retry_scope() -> bool:
+    """Injected OOMs only fire inside a retry-covered region — code outside
+    withRetry has no recovery path, and the reference's injection likewise
+    targets retry-wrapped allocation sites (AllocationRetryCoverageTracker
+    asserts every real allocation site is covered)."""
+    return getattr(_RETRY_SCOPE, "depth", 0) > 0
+
+
+class DeviceArena:
+    """Byte-budget bookkeeping for one device ("one TPU chip ≈ one executor").
+
+    Thread-safe; tasks reserve/release logical allocations.  ``spill_cb`` is
+    installed by the SpillFramework: called with the number of bytes needed,
+    returns the number of bytes actually freed.
+    """
+
+    def __init__(self, budget_bytes: int = 0):
+        # budget 0 = unlimited (tests set a small budget to exercise spill)
+        self.budget_bytes = budget_bytes
+        self.used_bytes = 0
+        self.peak_bytes = 0
+        self._lock = threading.RLock()
+        self._spill_cb: Optional[Callable[[int], int]] = None
+        self._injection: Optional[_Injection] = None
+
+    # -- spill integration ---------------------------------------------------
+
+    def set_spill_callback(self, cb: Optional[Callable[[int], int]]) -> None:
+        with self._lock:
+            self._spill_cb = cb
+
+    # -- OOM injection -------------------------------------------------------
+
+    def inject_ooms(self, num_ooms: int, skip: int = 0, kind: str = "retry") -> None:
+        with self._lock:
+            self._injection = _Injection(num_ooms, skip, kind)
+
+    def clear_injection(self) -> None:
+        with self._lock:
+            self._injection = None
+
+    def maybe_throw_injected(self) -> None:
+        """Called from allocation points and retry blocks."""
+        if not in_retry_scope():
+            return
+        with self._lock:
+            inj = self._injection
+            if inj is None:
+                return
+            if inj.skip > 0:
+                inj.skip -= 1
+                return
+            if inj.remaining <= 0:
+                return
+            inj.remaining -= 1
+            kind = inj.kind
+        if kind == "retry":
+            raise TpuRetryOOM("injected retry OOM")
+        raise TpuSplitAndRetryOOM("injected split-and-retry OOM")
+
+    # -- reservations --------------------------------------------------------
+
+    def reserve(self, nbytes: int) -> None:
+        """Account nbytes of device residency; spill-then-throw on pressure.
+
+        The spill callback is invoked WITHOUT the arena lock held: spilling
+        takes per-handle locks whose holders may themselves be waiting on
+        the arena lock (materialize -> reserve), so calling out under the
+        lock would be an ABBA deadlock.
+        """
+        self.maybe_throw_injected()
+        with self._lock:
+            needed = 0
+            if self.budget_bytes and self.used_bytes + nbytes > self.budget_bytes:
+                needed = self.used_bytes + nbytes - self.budget_bytes
+            cb = self._spill_cb
+        if needed:
+            freed = cb(needed) if cb else 0
+        with self._lock:
+            if self.budget_bytes and self.used_bytes + nbytes > self.budget_bytes:
+                # mirror DeviceMemoryEventHandler: if the spill made no
+                # progress, surface a retryable OOM to the task
+                if needed and freed <= 0:
+                    raise TpuSplitAndRetryOOM(
+                        f"device arena over budget: need {nbytes}b, "
+                        f"used {self.used_bytes}b of {self.budget_bytes}b, "
+                        f"nothing left to spill")
+                raise TpuRetryOOM(
+                    "device arena over budget after spilling "
+                    f"{freed if needed else 0}b")
+            self.used_bytes += nbytes
+            self.peak_bytes = max(self.peak_bytes, self.used_bytes)
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.used_bytes -= nbytes
+            assert self.used_bytes >= 0, "arena release underflow"
+
+
+_GLOBAL_ARENA = DeviceArena()
+
+
+def device_arena() -> DeviceArena:
+    return _GLOBAL_ARENA
+
+
+def configure(budget_bytes: int) -> None:
+    """(Re)configure the global arena budget (startup-only in the reference;
+    here tests reconfigure freely)."""
+    _GLOBAL_ARENA.budget_bytes = budget_bytes

@@ -1,0 +1,49 @@
+"""Per-task metrics: retry counts, spill volumes, watermarks.
+
+Reference analog: GpuTaskMetrics.scala:245-338 (semaphore wait, retry
+count/time, spill to host/disk, read-spill, max device/host/disk memory
+watermarks), surfaced per task via Spark accumulators.  Here a thread-local
+holds the active task's metrics; the session aggregates them per query.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict
+
+
+@dataclasses.dataclass
+class TaskMetrics:
+    retry_count: int = 0
+    split_retry_count: int = 0
+    capacity_retry_count: int = 0
+    semaphore_wait_ns: int = 0
+    op_time_ns: int = 0
+
+    def merge(self, other: "TaskMetrics") -> None:
+        self.retry_count += other.retry_count
+        self.split_retry_count += other.split_retry_count
+        self.capacity_retry_count += other.capacity_retry_count
+        self.semaphore_wait_ns += other.semaphore_wait_ns
+        self.op_time_ns += other.op_time_ns
+
+    def as_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+_TLS = threading.local()
+
+
+def get() -> TaskMetrics:
+    m = getattr(_TLS, "metrics", None)
+    if m is None:
+        m = TaskMetrics()
+        _TLS.metrics = m
+    return m
+
+
+def reset() -> TaskMetrics:
+    """Reset the current task's metrics and return the previous ones."""
+    prev = get()
+    _TLS.metrics = TaskMetrics()
+    return prev

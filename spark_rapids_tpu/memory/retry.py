@@ -1,0 +1,149 @@
+"""Retry-on-OOM control flow: the resilience core.
+
+Reproduces the reference's RmmRapidsRetryIterator contract (reference:
+RmmRapidsRetryIterator.scala:37,66 — ``withRetry``/``withRetryNoSplit``/
+split-and-retry) on top of the TPU arena/spill layers, plus the one retry
+axis the reference does not need: **capacity escalation**.  XLA kernels have
+static output shapes, so data-dependent outputs (filter, join, concat)
+return ``(result, OverflowStatus)`` at a fixed capacity; when the status
+reports overflow we discard and re-run at the next power-of-two capacity —
+the same discard-and-rerun discipline as GpuSplitAndRetryOOM, pointed the
+other direction (grow output instead of split input).
+
+Requirements on ``fn`` mirror the reference: it must be idempotent (safe to
+re-run), and its inputs must be spillable handles so a retry can materialize
+them again after a spill.
+
+OOM injection (``@inject_oom`` tests): enable_oom_injection routes to the
+arena's synthetic-OOM state (reference: spark.rapids.sql.test.injectRetryOOM,
+RapidsConf.scala:3041-3083).
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple, TypeVar
+
+from spark_rapids_tpu.memory.arena import (
+    TpuOOM,
+    TpuRetryOOM,
+    TpuSplitAndRetryOOM,
+    device_arena,
+    enter_retry_scope,
+    exit_retry_scope,
+)
+from spark_rapids_tpu.memory import metrics as task_metrics
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+# defaults; initialize_memory(conf) overrides from spark.rapids.sql.retry.*
+MAX_RETRIES = 8
+MAX_SPLIT_DEPTH = 32
+
+
+def enable_oom_injection(num_ooms: int = 1, skip: int = 0, kind: str = "retry") -> None:
+    device_arena().inject_ooms(num_ooms, skip=skip, kind=kind)
+
+
+def disable_oom_injection() -> None:
+    device_arena().clear_injection()
+
+
+def with_retry_no_split(fn: Callable[[], T]) -> T:
+    """Run fn; on TpuRetryOOM spill and re-run (no split path).
+    Reference: withRetryNoSplit (RmmRapidsRetryIterator.scala:66)."""
+    from spark_rapids_tpu.memory.spill import spill_framework
+
+    last: Optional[TpuOOM] = None
+    enter_retry_scope()
+    try:
+        for attempt in range(MAX_RETRIES):
+            try:
+                device_arena().maybe_throw_injected()
+                return fn()
+            except TpuRetryOOM as e:
+                last = e
+                task_metrics.get().retry_count += 1
+                spill_framework().spill_device(1 << 62)  # spill all spillable
+            except TpuSplitAndRetryOOM as e:
+                raise TpuSplitAndRetryOOM(
+                    "split-and-retry OOM in a no-split context") from e
+        raise last  # type: ignore[misc]
+    finally:
+        exit_retry_scope()
+
+
+def with_retry(
+    inputs: Sequence[T],
+    fn: Callable[[T], U],
+    split_policy: Optional[Callable[[T], List[T]]] = None,
+) -> List[U]:
+    """Run fn over each input; on retry-OOM spill and re-run; on
+    split-and-retry-OOM apply split_policy and recurse per piece.
+    Reference: withRetry (RmmRapidsRetryIterator.scala:37).
+    """
+    from spark_rapids_tpu.memory.spill import spill_framework
+
+    out: List[U] = []
+    queue: List[Tuple[T, int]] = [(i, 0) for i in inputs]
+    enter_retry_scope()
+    try:
+        while queue:
+            item, depth = queue.pop(0)
+            attempts = 0
+            while True:
+                try:
+                    device_arena().maybe_throw_injected()
+                    out.append(fn(item))
+                    break
+                except TpuRetryOOM:
+                    attempts += 1
+                    task_metrics.get().retry_count += 1
+                    if attempts >= MAX_RETRIES:
+                        raise
+                    spill_framework().spill_device(1 << 62)
+                except TpuSplitAndRetryOOM:
+                    task_metrics.get().split_retry_count += 1
+                    if split_policy is None:
+                        raise
+                    # depth bound: split_policy isn't guaranteed to shrink
+                    # items, so an unbounded split would never terminate
+                    if depth >= MAX_SPLIT_DEPTH:
+                        raise
+                    pieces = split_policy(item)
+                    if len(pieces) <= 1:
+                        raise
+                    queue = [(p, depth + 1) for p in pieces] + queue
+                    break
+    finally:
+        exit_retry_scope()
+    return out
+
+
+def with_capacity_retry(
+    run: Callable[[int], T],
+    check: Callable[[T], Optional[int]],
+    initial_capacity: int,
+    max_capacity: int = 1 << 28,
+) -> T:
+    """Static-capacity escalation loop for data-dependent output sizes.
+
+    ``run(capacity)`` executes the kernel at the given static capacity and
+    returns a result; ``check(result)`` returns None if it fit, or the
+    required capacity if it overflowed (a few-scalar device sync).  Grows in
+    powers of two up to max_capacity, then raises TpuSplitAndRetryOOM so an
+    outer with_retry can split the *input* instead.
+    """
+    from spark_rapids_tpu.columnar.column import round_up_pow2
+
+    cap = max(initial_capacity, 1)
+    while True:
+        result = run(cap)
+        required = check(result)
+        if required is None:
+            return result
+        task_metrics.get().capacity_retry_count += 1
+        new_cap = max(round_up_pow2(required), cap)
+        if new_cap > max_capacity or new_cap == cap:
+            raise TpuSplitAndRetryOOM(
+                f"output needs capacity {required} > max {max_capacity}")
+        cap = new_cap

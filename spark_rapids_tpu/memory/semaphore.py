@@ -1,0 +1,90 @@
+"""Device semaphore: gate how many tasks use the chip concurrently.
+
+Reference analog: GpuSemaphore/PrioritySemaphore
+(GpuSemaphore.scala:183,512; PrioritySemaphore.scala:26) gated by
+``spark.rapids.sql.concurrentGpuTasks``.  Tasks acquire before device work
+and may release while doing host-side work (e.g. Parquet footer parsing or
+Python UDFs), maximizing chip occupancy without oversubscribing HBM.
+
+Priority: lower task-attempt id first (matches the reference's TaskPriority
+— older tasks win so progress is monotonic); ties FIFO.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+
+from spark_rapids_tpu.memory import metrics as task_metrics
+
+
+class PrioritySemaphore:
+    def __init__(self, permits: int):
+        self._permits = permits
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._waiters = []  # heap of (priority, seq)
+        self._seq = itertools.count()
+
+    def acquire(self, priority: int = 0) -> None:
+        start = time.monotonic_ns()
+        with self._cv:
+            ticket = (priority, next(self._seq))
+            heapq.heappush(self._waiters, ticket)
+            while not (self._permits > 0 and self._waiters[0] == ticket):
+                self._cv.wait()
+            heapq.heappop(self._waiters)
+            self._permits -= 1
+            if self._permits > 0 and self._waiters:
+                # wake the next head: it may have re-slept while we were
+                # still queued even though a permit is free
+                self._cv.notify_all()
+        task_metrics.get().semaphore_wait_ns += time.monotonic_ns() - start
+
+    def release(self) -> None:
+        with self._cv:
+            self._permits += 1
+            self._cv.notify_all()
+
+
+class TpuSemaphore:
+    """Per-process singleton gating concurrent device tasks."""
+
+    def __init__(self, concurrent_tasks: int = 2):
+        self._sem = PrioritySemaphore(concurrent_tasks)
+        self._tls = threading.local()
+
+    def acquire_if_necessary(self, priority: int = 0) -> None:
+        if getattr(self._tls, "held", 0) == 0:
+            self._sem.acquire(priority)
+        self._tls.held = getattr(self._tls, "held", 0) + 1
+
+    def release_if_necessary(self) -> None:
+        held = getattr(self._tls, "held", 0)
+        if held <= 0:
+            return
+        self._tls.held = held - 1
+        if self._tls.held == 0:
+            self._sem.release()
+
+    @contextmanager
+    def held(self, priority: int = 0):
+        self.acquire_if_necessary(priority)
+        try:
+            yield
+        finally:
+            self.release_if_necessary()
+
+
+_SEMAPHORE = TpuSemaphore()
+
+
+def tpu_semaphore() -> TpuSemaphore:
+    return _SEMAPHORE
+
+
+def configure(concurrent_tasks: int) -> None:
+    global _SEMAPHORE
+    _SEMAPHORE = TpuSemaphore(concurrent_tasks)

@@ -1,0 +1,318 @@
+"""Spill framework: handle-based device -> host -> disk stores.
+
+Reproduces the reference's SpillFramework semantics (reference:
+spill/SpillFramework.scala:54-130 header contract, SpillableDeviceStore:1742,
+SpillableHostStore:1482, DiskHandleStore:1754) in TPU terms:
+
+  * An exec that must hold a batch across other work wraps it in a
+    ``SpillableBatchHandle`` and drops its direct reference.
+  * The handle owns the data; ``materialize()`` brings it back to the device
+    (possibly re-uploading from host or disk) and ``close()`` releases every
+    tier.
+  * The device store can *spill* a handle: download arrays to host numpy
+    (releasing HBM accounting), or further to disk (npz), in priority order —
+    least-recently-materialized first, mirroring the reference's
+    TaskPriority-ordered spill.
+  * Spill is driven by the arena's pressure callback and is also directly
+    callable (tests, shuffle).
+
+Device arrays here are JAX arrays; "download" is jax.device_get and
+"upload" is jnp.asarray — the host/disk formats are plain numpy, the same
+role HostMemoryBuffer/RapidsDiskBlockManager play in the reference.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.memory.arena import device_arena
+
+
+def _batch_to_host(batch: ColumnarBatch) -> Tuple[dict, Schema]:
+    """Device batch -> dict of numpy arrays (full capacity, canonical)."""
+    arrays = {}
+    for i, col in enumerate(batch.columns):
+        arrays[f"data_{i}"] = np.asarray(col.data)
+        arrays[f"valid_{i}"] = np.asarray(col.validity)
+        if col.offsets is not None:
+            arrays[f"offsets_{i}"] = np.asarray(col.offsets)
+    arrays["num_rows"] = np.asarray(batch.num_rows)
+    return arrays, batch.schema
+
+
+def _host_to_batch(arrays: dict, schema: Schema) -> ColumnarBatch:
+    cols = []
+    for i, dtype in enumerate(schema.dtypes):
+        cols.append(DeviceColumn(
+            data=jnp.asarray(arrays[f"data_{i}"]),
+            validity=jnp.asarray(arrays[f"valid_{i}"]),
+            dtype=dtype,
+            offsets=jnp.asarray(arrays[f"offsets_{i}"]) if f"offsets_{i}" in arrays else None,
+        ))
+    return ColumnarBatch(tuple(cols), jnp.asarray(arrays["num_rows"], dtype=jnp.int32), schema)
+
+
+class SpillableBatchHandle:
+    """Owning handle over a batch that may live on device, host, or disk.
+
+    Reference analog: SpillableColumnarBatch.scala over
+    SpillableColumnarBatchHandle (SpillFramework.scala:674).
+    """
+
+    def __init__(self, batch: ColumnarBatch, framework: "SpillFramework",
+                 priority: int = 0):
+        self._fw = framework
+        self._lock = threading.RLock()
+        self._device: Optional[ColumnarBatch] = batch
+        self._host: Optional[Tuple[dict, Schema]] = None
+        self._disk_path: Optional[str] = None
+        self._schema = batch.schema
+        self.priority = priority
+        self.last_use = time.monotonic()
+        self.size_bytes = batch.device_size_bytes()
+        self.closed = False
+        self._pins = 0
+        device_arena().reserve(self.size_bytes)
+        framework._register(self)
+
+    # -- tier movement -------------------------------------------------------
+
+    def spill_to_host(self) -> int:
+        """Device -> host.  Returns device bytes freed (0 if not on device).
+
+        Pinned handles (a caller holds the materialized batch) refuse to
+        spill: the borrower's JAX arrays would keep the HBM alive anyway, so
+        releasing the arena accounting would undercount real residency
+        (reference analog: refcounted spillability, SpillFramework.scala:54-130).
+        """
+        with self._lock:
+            if self._device is None or self.closed or self._pins > 0:
+                return 0
+            self._host = _batch_to_host(self._device)
+            self._device = None
+            device_arena().release(self.size_bytes)
+            self._fw.metrics.spill_to_host_bytes += self.size_bytes
+            return self.size_bytes
+
+    def spill_to_disk(self) -> int:
+        """Host -> disk.  Returns host bytes freed."""
+        with self._lock:
+            if self._host is None or self.closed:
+                return 0
+            arrays, _ = self._host
+            fd, path = tempfile.mkstemp(suffix=".npz", dir=self._fw.spill_dir)
+            os.close(fd)
+            np.savez(path, **arrays)
+            self._disk_path = path
+            freed = sum(a.nbytes for a in arrays.values())
+            self._host = None
+            self._fw.metrics.spill_to_disk_bytes += freed
+            return freed
+
+    def materialize(self) -> ColumnarBatch:
+        """Bring the batch back to the device and return it.  The handle
+        keeps ownership (call close() when done).
+
+        Lock discipline: ``arena.reserve`` may call back into the spill
+        framework (framework lock -> handle locks), so it is NEVER invoked
+        while this handle's lock is held — reserve first, then re-check
+        state under the lock (dropping the extra reservation if another
+        thread won the race).
+        """
+        with self._lock:
+            assert not self.closed, "materialize after close"
+            self.last_use = time.monotonic()
+            if self._device is not None:
+                self._pins += 1
+                return self._device
+        device_arena().reserve(self.size_bytes)  # may spill / raise TpuOOM
+        with self._lock:
+            if self.closed:
+                device_arena().release(self.size_bytes)
+                raise AssertionError("handle closed during materialize")
+            if self._device is not None:  # concurrent materialize won
+                device_arena().release(self.size_bytes)
+                self._pins += 1
+                return self._device
+            if self._host is None and self._disk_path is not None:
+                with np.load(self._disk_path) as z:
+                    arrays = {k: z[k] for k in z.files}
+                self._host = (arrays, self._schema)
+                os.unlink(self._disk_path)
+                self._disk_path = None
+                self._fw.metrics.read_spill_bytes += sum(
+                    a.nbytes for a in arrays.values())
+            assert self._host is not None
+            batch = _host_to_batch(*self._host)
+            self._device = batch
+            self._host = None
+            self._pins += 1
+            self.last_use = time.monotonic()
+            return batch
+
+    def unpin(self) -> None:
+        """Declare the batch returned by materialize() no longer in use,
+        making the handle spillable again."""
+        with self._lock:
+            if self._pins > 0:
+                self._pins -= 1
+
+    @contextmanager
+    def borrowed(self):
+        """``with h.borrowed() as batch:`` — pinned for the block only."""
+        batch = self.materialize()
+        try:
+            yield batch
+        finally:
+            self.unpin()
+
+    def release_device_copy(self) -> ColumnarBatch:
+        """Materialize and transfer ownership out (handle closes)."""
+        batch = self.materialize()  # pins, so no spill can intervene
+        with self._lock:
+            assert self._device is batch
+            self._device = None
+            self.closed = True
+        self._fw._unregister(self)
+        # accounting ownership passes to the caller's scope; release here
+        device_arena().release(self.size_bytes)
+        return batch
+
+    def on_device(self) -> bool:
+        with self._lock:
+            return self._device is not None
+
+    def host_nbytes(self) -> int:
+        with self._lock:
+            if self._host is None:
+                return 0
+            return sum(a.nbytes for a in self._host[0].values())
+
+    def close(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            if self._device is not None:
+                device_arena().release(self.size_bytes)
+                self._device = None
+            self._host = None
+            if self._disk_path is not None:
+                try:
+                    os.unlink(self._disk_path)
+                except OSError:
+                    pass
+                self._disk_path = None
+        self._fw._unregister(self)
+
+
+class SpillMetrics:
+    def __init__(self):
+        self.spill_to_host_bytes = 0
+        self.spill_to_disk_bytes = 0
+        self.read_spill_bytes = 0
+
+
+class SpillFramework:
+    """Registry of spillable handles + the arena pressure callback."""
+
+    def __init__(self, spill_dir: Optional[str] = None, host_limit_bytes: int = 0):
+        self._lock = threading.RLock()
+        self._handles: List[SpillableBatchHandle] = []
+        self._owns_spill_dir = spill_dir is None
+        self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="tpu_spill_")
+        self.host_limit_bytes = host_limit_bytes
+        self.metrics = SpillMetrics()
+        # only take the arena pressure callback if nobody holds it: a
+        # directly-constructed framework must not disarm the singleton's
+        # eviction path for handles it doesn't manage
+        if device_arena()._spill_cb is None:
+            device_arena().set_spill_callback(self.spill_device)
+
+    def _register(self, h: SpillableBatchHandle) -> None:
+        with self._lock:
+            self._handles.append(h)
+
+    def _unregister(self, h: SpillableBatchHandle) -> None:
+        with self._lock:
+            if h in self._handles:
+                self._handles.remove(h)
+
+    def _snapshot(self) -> List[SpillableBatchHandle]:
+        """Copy the handle list under the framework lock; all per-handle
+        inspection happens after the lock is dropped (handles take their own
+        locks, which must never nest inside the framework lock)."""
+        with self._lock:
+            return list(self._handles)
+
+    def spill_device(self, need_bytes: int) -> int:
+        """Spill device-resident handles (oldest-use first) until
+        need_bytes freed or nothing left.  Reference:
+        SpillableDeviceStore.spill (SpillFramework.scala:1742)."""
+        freed = 0
+        candidates = sorted(
+            [h for h in self._snapshot() if h.on_device()],
+            key=lambda h: (h.priority, h.last_use))
+        for h in candidates:
+            if freed >= need_bytes:
+                break
+            freed += h.spill_to_host()
+        if self.host_limit_bytes:
+            self._enforce_host_limit()
+        return freed
+
+    def _enforce_host_limit(self) -> None:
+        sized = [(h, h.host_nbytes()) for h in self._snapshot()]
+        hosted = sorted([hs for hs in sized if hs[1] > 0],
+                        key=lambda hs: (hs[0].priority, hs[0].last_use))
+        total = sum(nb for _, nb in hosted)
+        for h, _ in hosted:
+            if total <= self.host_limit_bytes:
+                break
+            total -= h.spill_to_disk()
+
+    def spill_all_to_disk(self) -> None:
+        for h in self._snapshot():
+            h.spill_to_host()
+            h.spill_to_disk()
+
+    def close(self) -> None:
+        global _FRAMEWORK
+        for h in list(self._handles):
+            h.close()
+        # only disarm the arena callback if we still own it
+        if device_arena()._spill_cb == self.spill_device:
+            device_arena().set_spill_callback(None)
+        if self._owns_spill_dir:
+            shutil.rmtree(self.spill_dir, ignore_errors=True)
+        if _FRAMEWORK is self:
+            _FRAMEWORK = None
+
+
+_FRAMEWORK: Optional[SpillFramework] = None
+
+
+def spill_framework() -> SpillFramework:
+    global _FRAMEWORK
+    if _FRAMEWORK is None:
+        _FRAMEWORK = SpillFramework()
+    # re-arm the arena pressure callback if a directly-constructed framework
+    # grabbed it and was closed (leaving it None)
+    if device_arena()._spill_cb is None:
+        device_arena().set_spill_callback(_FRAMEWORK.spill_device)
+    return _FRAMEWORK
+
+
+def make_spillable(batch: ColumnarBatch, priority: int = 0) -> SpillableBatchHandle:
+    return SpillableBatchHandle(batch, spill_framework(), priority=priority)

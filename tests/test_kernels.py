@@ -322,3 +322,27 @@ def test_hash_partition_long_strings_auto_bucket():
         for v in rows[offs[p]:offs[p + 1]]:
             h = hk.py_murmur3_row([v], [T.STRING])
             assert ((h % 8) + 8) % 8 == p
+
+
+def test_groupby_min_max_nan_spark_semantics():
+    """Spark's total order puts NaN above +Inf: MIN skips NaN unless the
+    whole group is NaN; MAX returns NaN if any value is NaN."""
+    import math
+    import jax.numpy as jnp
+    schema = Schema.of(k=T.INT, v=T.DOUBLE)
+    nan = float("nan")
+    data = {"k": [1, 1, 2, 2, 3], "v": [nan, 1.0, nan, nan, 5.0]}
+    batch = ColumnarBatch.from_pydict(data, schema)
+    layout = gb.group_rows(batch, [0])
+    keys = gb.group_keys_output(layout, [0])
+    n = int(layout.num_groups)
+    vcol = layout.sorted_batch.columns[1]
+    mn, mnv = gb.seg_min(vcol, layout)
+    mx, mxv = gb.seg_max(vcol, layout)
+    mins = gb.finalize_agg_column(mn, mnv, layout.num_groups, T.DOUBLE).to_pylist(n)
+    maxs = gb.finalize_agg_column(mx, mxv, layout.num_groups, T.DOUBLE).to_pylist(n)
+    got = {k: (mins[i], maxs[i]) for i, k in enumerate(keys[0].to_pylist(n))}
+    assert got[1][0] == 1.0           # min skips NaN
+    assert math.isnan(got[1][1])      # max is NaN (NaN greatest)
+    assert math.isnan(got[2][0]) and math.isnan(got[2][1])  # all-NaN group
+    assert got[3] == (5.0, 5.0)

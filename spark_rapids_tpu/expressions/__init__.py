@@ -1,0 +1,57 @@
+"""Expression DSL surface (the analog of pyspark.sql.functions)."""
+from spark_rapids_tpu.expressions.core import (
+    Alias,
+    BoundReference,
+    Col,
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+    Literal,
+    col,
+    lit,
+    output_name,
+)
+from spark_rapids_tpu.expressions.arithmetic import (
+    Abs,
+    Add,
+    Divide,
+    IntegralDivide,
+    Multiply,
+    Remainder,
+    Subtract,
+    UnaryMinus,
+)
+from spark_rapids_tpu.expressions.predicates import (
+    And,
+    Coalesce,
+    EqualNullSafe,
+    EqualTo,
+    GreaterThan,
+    GreaterThanOrEqual,
+    In,
+    IsNotNull,
+    IsNull,
+    LessThan,
+    LessThanOrEqual,
+    Not,
+    Or,
+)
+from spark_rapids_tpu.expressions.casts import Cast
+from spark_rapids_tpu.expressions.conditional import CaseWhen, If
+from spark_rapids_tpu.expressions.aggregates import (
+    AggregateFunction,
+    Average,
+    Count,
+    Max,
+    Min,
+    Sum,
+    avg,
+    count,
+    find_aggregates,
+    is_aggregate,
+    max_,
+    min_,
+    sum_,
+)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,271 @@
+"""Declarative aggregate functions.
+
+Reference: org/apache/spark/sql/rapids/aggregate/aggregateFunctions.scala
+(GpuSum, GpuCount, GpuMin, GpuMax, GpuAverage...).  Each function declares
+its update/merge buffer plan the way the reference's AggHelper consumes
+CudfAggregate pairs (GpuAggregateExec.scala:360): a list of
+(buffer dtype, update-op) slots, a merge-op per slot (update and merge may
+differ: count updates by counting, merges by summing), and a finalize step
+over buffer columns.  The exec layer lowers these onto segmented-reduction
+kernels (kernels/groupby.py) for grouped aggs or whole-batch reductions for
+global aggs.
+
+Type rules follow Spark: sum(integral) -> LONG, sum(fractional) -> DOUBLE,
+count -> LONG (never null), avg -> DOUBLE with (sum double, count long)
+buffers, min/max keep the input type.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.core import Expression
+
+# update/merge op kinds the kernel layer implements
+SUM = "sum"
+COUNT_VALID = "count_valid"  # counts non-null inputs
+COUNT_STAR = "count_star"    # counts rows
+MIN = "min"
+MAX = "max"
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferSlot:
+    """One aggregation buffer column."""
+
+    dtype: T.DataType
+    update_op: str   # how raw input rows fold into this buffer
+    merge_op: str    # how partial buffers fold together (sum for counts)
+
+
+class AggregateFunction(Expression):
+    """Base: children[0] (if any) is the input value expression."""
+
+    name = "agg"
+
+    @property
+    def input(self) -> Optional[Expression]:
+        return self.children[0] if self.children else None
+
+    def with_children(self, children):
+        return type(self)(children[0]) if children else type(self)()
+
+    @property
+    def buffers(self) -> Tuple[BufferSlot, ...]:
+        raise NotImplementedError
+
+    def finalize_np(self, bufs: List[Tuple[np.ndarray, np.ndarray]]):
+        """(values, validity) per buffer -> final (values, validity), numpy."""
+        raise NotImplementedError
+
+    def finalize_jnp(self, bufs):
+        """Same on jnp arrays (device)."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        inner = repr(self.input) if self.input is not None else "*"
+        return f"{self.name}({inner})"
+
+
+class Sum(AggregateFunction):
+    name = "sum"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        cdt = self.input.dtype
+        if cdt.is_integral or isinstance(cdt, T.BooleanType):
+            return T.LONG
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True  # empty/all-null group -> null
+
+    @property
+    def buffers(self):
+        return (BufferSlot(self.dtype, SUM, SUM),
+                BufferSlot(T.LONG, COUNT_VALID, SUM))
+
+    def finalize_np(self, bufs):
+        (s, _), (n, _) = bufs
+        return s, n > 0
+
+    def finalize_jnp(self, bufs):
+        (s, _), (n, _) = bufs
+        return s, n > 0
+
+
+class Count(AggregateFunction):
+    """count(expr) counts non-null; Count.star() counts rows."""
+
+    name = "count"
+
+    def __init__(self, child: Optional[Expression] = None):
+        self.children = (child,) if child is not None else ()
+
+    @staticmethod
+    def star() -> "Count":
+        return Count(None)
+
+    def with_children(self, children):
+        return Count(children[0] if children else None)
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def buffers(self):
+        op = COUNT_STAR if self.input is None else COUNT_VALID
+        return (BufferSlot(T.LONG, op, SUM),)
+
+    def finalize_np(self, bufs):
+        (n, _), = bufs
+        return n, np.ones(n.shape, np.bool_)
+
+    def finalize_jnp(self, bufs):
+        import jax.numpy as jnp
+        (n, _), = bufs
+        return n, jnp.ones(n.shape, jnp.bool_)
+
+
+class Min(AggregateFunction):
+    name = "min"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return self.input.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def buffers(self):
+        return (BufferSlot(self.dtype, MIN, MIN),
+                BufferSlot(T.LONG, COUNT_VALID, SUM))
+
+    def finalize_np(self, bufs):
+        (v, _), (n, _) = bufs
+        return v, n > 0
+
+    def finalize_jnp(self, bufs):
+        (v, _), (n, _) = bufs
+        return v, n > 0
+
+
+class Max(AggregateFunction):
+    name = "max"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return self.input.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def buffers(self):
+        return (BufferSlot(self.dtype, MAX, MAX),
+                BufferSlot(T.LONG, COUNT_VALID, SUM))
+
+    def finalize_np(self, bufs):
+        (v, _), (n, _) = bufs
+        return v, n > 0
+
+    def finalize_jnp(self, bufs):
+        (v, _), (n, _) = bufs
+        return v, n > 0
+
+
+class Average(AggregateFunction):
+    name = "avg"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def buffers(self):
+        return (BufferSlot(T.DOUBLE, SUM, SUM),
+                BufferSlot(T.LONG, COUNT_VALID, SUM))
+
+    def finalize_np(self, bufs):
+        (s, _), (n, _) = bufs
+        valid = n > 0
+        with np.errstate(all="ignore"):
+            vals = s / np.where(valid, n, 1)
+        return vals, valid
+
+    def finalize_jnp(self, bufs):
+        import jax.numpy as jnp
+        (s, _), (n, _) = bufs
+        valid = n > 0
+        vals = s / jnp.where(valid, n, 1).astype(s.dtype)
+        return vals, valid
+
+
+def is_aggregate(e: Expression) -> bool:
+    return isinstance(e, AggregateFunction)
+
+
+def find_aggregates(e: Expression) -> List[AggregateFunction]:
+    """All aggregate calls inside an output expression tree."""
+    if is_aggregate(e):
+        return [e]
+    out: List[AggregateFunction] = []
+    for c in e.children:
+        out += find_aggregates(c)
+    return out
+
+
+# DSL helpers
+def sum_(e) -> Sum:
+    from spark_rapids_tpu.expressions.core import col
+    return Sum(col(e) if isinstance(e, str) else e)
+
+
+def count(e=None) -> Count:
+    from spark_rapids_tpu.expressions.core import col
+    if e is None:
+        return Count.star()
+    return Count(col(e) if isinstance(e, str) else e)
+
+
+def min_(e) -> Min:
+    from spark_rapids_tpu.expressions.core import col
+    return Min(col(e) if isinstance(e, str) else e)
+
+
+def max_(e) -> Max:
+    from spark_rapids_tpu.expressions.core import col
+    return Max(col(e) if isinstance(e, str) else e)
+
+
+def avg(e) -> Average:
+    from spark_rapids_tpu.expressions.core import col
+    return Average(col(e) if isinstance(e, str) else e)

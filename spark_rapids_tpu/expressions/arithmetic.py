@@ -1,0 +1,240 @@
+"""Arithmetic expression twins with Spark (non-ANSI) semantics.
+
+Reference: sql-plugin/.../arithmetic.scala (GpuAdd, GpuSubtract, GpuMultiply,
+GpuDivide, GpuIntegralDivide, GpuRemainder, GpuUnaryMinus, GpuAbs...).
+
+Spark semantics encoded here (the compatibility spec, docs/compatibility.md):
+  * integral +,-,* wrap on overflow (two's complement — XLA integer ops
+    already wrap, matching the JVM);
+  * Divide always produces DOUBLE for non-decimal inputs and returns NULL
+    when the divisor is 0 (Spark DivModLike.isZero guard — this applies to
+    doubles too: 1.0/0.0 IS NULL in Spark SQL);
+  * IntegralDivide (`div`) produces LONG, NULL on zero divisor;
+  * Remainder keeps the promoted input type, NULL on zero divisor;
+  * other double math follows IEEE-754 (Infinity/NaN flow through).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.core import (
+    BinaryExpression,
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+    UnaryExpression,
+    cpu_null_propagating,
+    cpu_zero_invalid,
+    make_column,
+    null_propagating,
+)
+
+
+def _promote(a: T.DataType, b: T.DataType) -> T.DataType:
+    return T.numeric_promote(a, b)
+
+
+class BinaryArithmetic(BinaryExpression):
+    """Common machinery: promote inputs, propagate nulls elementwise."""
+
+    @property
+    def dtype(self) -> T.DataType:
+        return _promote(self.left.dtype, self.right.dtype)
+
+    def _op(self, lhs, rhs):
+        raise NotImplementedError
+
+    def _np_op(self, lhs, rhs):
+        return self._op(lhs, rhs)
+
+    def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        out_dt = self.dtype
+        lhs = lc.data.astype(out_dt.jnp_dtype)
+        rhs = rc.data.astype(out_dt.jnp_dtype)
+        validity = null_propagating([lc.validity, rc.validity])
+        return make_column(self._op(lhs, rhs), validity, out_dt)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lval = self.left.eval_cpu(ctx)
+        rv, rval = self.right.eval_cpu(ctx)
+        out_dt = self.dtype
+        lhs = lv.astype(out_dt.np_dtype)
+        rhs = rv.astype(out_dt.np_dtype)
+        validity = cpu_null_propagating([lval, rval])
+        with np.errstate(all="ignore"):
+            vals = self._np_op(lhs, rhs)
+        return cpu_zero_invalid(vals.astype(out_dt.np_dtype), validity), validity
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def _op(self, lhs, rhs):
+        return lhs + rhs
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def _op(self, lhs, rhs):
+        return lhs - rhs
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def _op(self, lhs, rhs):
+        return lhs * rhs
+
+
+class Divide(BinaryExpression):
+    """Spark Divide: double result, NULL on zero divisor."""
+
+    symbol = "/"
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        lhs = lc.data.astype(jnp.float64)
+        rhs = rc.data.astype(jnp.float64)
+        zero_div = rhs == 0
+        validity = null_propagating([lc.validity, rc.validity]) & ~zero_div
+        safe = jnp.where(zero_div, jnp.ones((), rhs.dtype), rhs)
+        return make_column(lhs / safe, validity, T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lval = self.left.eval_cpu(ctx)
+        rv, rval = self.right.eval_cpu(ctx)
+        lhs = lv.astype(np.float64)
+        rhs = rv.astype(np.float64)
+        zero_div = rhs == 0
+        validity = cpu_null_propagating([lval, rval]) & ~zero_div
+        with np.errstate(all="ignore"):
+            vals = lhs / np.where(zero_div, 1.0, rhs)
+        return cpu_zero_invalid(vals, validity), validity
+
+
+class IntegralDivide(BinaryExpression):
+    """Spark `div`: long result, NULL on zero divisor, truncation toward
+    zero (JVM semantics, not floor)."""
+
+    symbol = "div"
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        lhs = lc.data.astype(jnp.int64)
+        rhs = rc.data.astype(jnp.int64)
+        zero_div = rhs == 0
+        validity = null_propagating([lc.validity, rc.validity]) & ~zero_div
+        safe = jnp.where(zero_div, jnp.ones((), jnp.int64), rhs)
+        # JVM integer division truncates toward zero; lax div matches C
+        quotient = jnp.sign(lhs) * jnp.sign(safe) * (jnp.abs(lhs) // jnp.abs(safe))
+        return make_column(quotient, validity, T.LONG)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lval = self.left.eval_cpu(ctx)
+        rv, rval = self.right.eval_cpu(ctx)
+        lhs = lv.astype(np.int64)
+        rhs = rv.astype(np.int64)
+        zero_div = rhs == 0
+        validity = cpu_null_propagating([lval, rval]) & ~zero_div
+        safe = np.where(zero_div, 1, rhs)
+        with np.errstate(all="ignore"):
+            q = np.sign(lhs) * np.sign(safe) * (np.abs(lhs) // np.abs(safe))
+        return cpu_zero_invalid(q.astype(np.int64), validity), validity
+
+
+class Remainder(BinaryArithmetic):
+    """Spark %: JVM remainder (sign of dividend), NULL on zero divisor."""
+
+    symbol = "%"
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        out_dt = self.dtype
+        lhs = lc.data.astype(out_dt.jnp_dtype)
+        rhs = rc.data.astype(out_dt.jnp_dtype)
+        zero_div = rhs == 0
+        validity = null_propagating([lc.validity, rc.validity]) & ~zero_div
+        one = jnp.ones((), rhs.dtype)
+        safe = jnp.where(zero_div, one, rhs)
+        if out_dt.is_floating:
+            rem = jnp.where(validity, lhs - jnp.trunc(lhs / safe) * safe, 0)
+        else:
+            # JVM %: sign follows dividend
+            rem = jnp.sign(lhs) * (jnp.abs(lhs) % jnp.abs(safe))
+        return make_column(rem, validity, out_dt)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lval = self.left.eval_cpu(ctx)
+        rv, rval = self.right.eval_cpu(ctx)
+        out_dt = self.dtype
+        lhs = lv.astype(out_dt.np_dtype)
+        rhs = rv.astype(out_dt.np_dtype)
+        zero_div = rhs == 0
+        validity = cpu_null_propagating([lval, rval]) & ~zero_div
+        safe = np.where(zero_div, 1, rhs).astype(rhs.dtype)
+        with np.errstate(all="ignore"):
+            if out_dt.is_floating:
+                rem = lhs - np.trunc(lhs / safe) * safe
+            else:
+                rem = np.sign(lhs) * (np.abs(lhs) % np.abs(safe))
+        return cpu_zero_invalid(rem.astype(out_dt.np_dtype), validity), validity
+
+
+class UnaryMinus(UnaryExpression):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        return make_column(-c.data, c.validity, c.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        with np.errstate(all="ignore"):
+            out = -v
+        return cpu_zero_invalid(out, valid), valid
+
+
+class Abs(UnaryExpression):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        return make_column(jnp.abs(c.data), c.validity, c.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        with np.errstate(all="ignore"):
+            out = np.abs(v)
+        return cpu_zero_invalid(out, valid), valid

@@ -1,0 +1,119 @@
+"""Cast expression twin.
+
+Reference: sql-plugin/.../GpuCast.scala:286 (recursive doCast dispatch).
+This covers the numeric/boolean/date/timestamp lattice; string casts are
+kernel work tracked in kernels/strings.py and tagged unsupported by the
+planner until they land (the reference gates ambitious casts behind
+spark.rapids.sql.castFloatToString.enabled etc. the same way).
+
+Semantics (non-ANSI legacy cast, docs/compatibility.md):
+  * int -> narrower int truncates/wraps (JVM);
+  * float/double -> integral truncates toward zero; NaN -> 0; out-of-range
+    saturates to min/max of the target (Spark casts via java long clamp);
+  * numeric -> boolean: value != 0;  boolean -> numeric: 0/1;
+  * date -> timestamp: midnight UTC; timestamp -> date: floor days.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.core import (
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+    UnaryExpression,
+    cpu_zero_invalid,
+    make_column,
+)
+
+MICROS_PER_DAY = 86400 * 1000 * 1000
+
+_INT_RANGE = {
+    T.BYTE: (-(2**7), 2**7 - 1),
+    T.SHORT: (-(2**15), 2**15 - 1),
+    T.INT: (-(2**31), 2**31 - 1),
+    T.LONG: (-(2**63), 2**63 - 1),
+}
+
+
+class Cast(UnaryExpression):
+    def __init__(self, child: Expression, dtype: T.DataType):
+        super().__init__(child)
+        self._dtype = dtype
+
+    def with_children(self, children):
+        return Cast(children[0], self._dtype)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def __repr__(self):
+        return f"cast({self.child!r} AS {self._dtype!r})"
+
+    @staticmethod
+    def supported(src: T.DataType, dst: T.DataType) -> bool:
+        if src == dst:
+            return True
+        fixed = lambda d: (d.is_numeric and not isinstance(d, T.DecimalType)) \
+            or isinstance(d, T.BooleanType)
+        if fixed(src) and fixed(dst):
+            return True
+        if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+            return True
+        if isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+            return True
+        return False
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        src, dst = c.dtype, self._dtype
+        if src == dst:
+            return c
+        data = c.data
+        if isinstance(src, T.BooleanType):
+            out = data.astype(dst.jnp_dtype)
+        elif isinstance(dst, T.BooleanType):
+            out = data != 0
+        elif isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+            out = data.astype(jnp.int64) * MICROS_PER_DAY
+        elif isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+            out = jnp.floor_divide(data, MICROS_PER_DAY).astype(jnp.int32)
+        elif src.is_floating and dst.is_integral:
+            lo, hi = _INT_RANGE[dst]
+            x = jnp.nan_to_num(data, nan=0.0, posinf=float(hi), neginf=float(lo))
+            x = jnp.clip(jnp.trunc(x), float(lo), float(hi))
+            out = x.astype(dst.jnp_dtype)
+        else:
+            out = data.astype(dst.jnp_dtype)
+        return make_column(out, c.validity, dst)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        src, dst = self.child.dtype, self._dtype
+        if src == dst:
+            return v, valid
+        with np.errstate(all="ignore"):
+            if isinstance(src, T.BooleanType):
+                out = v.astype(dst.np_dtype)
+            elif isinstance(dst, T.BooleanType):
+                out = v != 0
+            elif isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+                out = v.astype(np.int64) * MICROS_PER_DAY
+            elif isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+                out = np.floor_divide(v, MICROS_PER_DAY).astype(np.int32)
+            elif src.is_floating and dst.is_integral:
+                lo, hi = _INT_RANGE[dst]
+                x = np.trunc(np.nan_to_num(v, nan=0.0))
+                # compare in float, assign in int: float(hi) rounds up to
+                # 2^63 for LONG and astype would wrap, not saturate
+                mid = np.clip(x, float(lo), float(hi - 1024) if hi > 2**53 else float(hi))
+                out = mid.astype(dst.np_dtype)
+                out = np.where(x >= float(hi), hi, out)
+                out = np.where(x <= float(lo), lo, out)
+                out = out.astype(dst.np_dtype)
+            else:
+                out = v.astype(dst.np_dtype)
+        return cpu_zero_invalid(out, valid), valid

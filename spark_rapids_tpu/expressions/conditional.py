@@ -1,0 +1,145 @@
+"""Conditional expression twins: If, CaseWhen.
+
+Reference: sql-plugin/.../conditionalExpressions.scala (GpuIf, GpuCaseWhen).
+Both branches evaluate eagerly over the whole batch and select elementwise —
+exactly what the reference does on GPU (no lazy row-at-a-time branching) and
+what XLA wants (select fuses into neighbours).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.core import (
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+    cpu_zero_invalid,
+    make_column,
+)
+
+
+class If(Expression):
+    def __init__(self, predicate: Expression, if_true: Expression,
+                 if_false: Expression):
+        self.predicate = predicate
+        self.if_true = if_true
+        self.if_false = if_false
+        self.children = (predicate, if_true, if_false)
+
+    def with_children(self, children):
+        return If(*children)
+
+    @property
+    def dtype(self):
+        return self.if_true.dtype
+
+    def eval(self, ctx: EvalContext):
+        p = self.predicate.eval(ctx)
+        t = self.if_true.eval(ctx)
+        f = self.if_false.eval(ctx)
+        out_dt = self.dtype
+        # null predicate selects the else branch (Spark If semantics)
+        take_true = p.data & p.validity
+        vals = jnp.where(take_true, t.data.astype(out_dt.jnp_dtype),
+                         f.data.astype(out_dt.jnp_dtype))
+        validity = jnp.where(take_true, t.validity, f.validity)
+        return make_column(vals, validity, out_dt)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        pv, pval = self.predicate.eval_cpu(ctx)
+        tv, tval = self.if_true.eval_cpu(ctx)
+        fv, fval = self.if_false.eval_cpu(ctx)
+        take_true = pv.astype(np.bool_) & pval
+        if tv.dtype == object or fv.dtype == object:
+            vals = np.where(take_true, tv, fv)
+        else:
+            out_dt = self.dtype
+            vals = np.where(take_true, tv.astype(out_dt.np_dtype),
+                            fv.astype(out_dt.np_dtype))
+        validity = np.where(take_true, tval, fval)
+        return cpu_zero_invalid(vals, validity), validity
+
+    def __repr__(self):
+        return f"if({self.predicate!r}, {self.if_true!r}, {self.if_false!r})"
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 [WHEN c2 THEN v2]... [ELSE e] END."""
+
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        self.branches = tuple((c, v) for c, v in branches)
+        self.else_value = else_value
+        kids: List[Expression] = []
+        for c, v in self.branches:
+            kids += [c, v]
+        if else_value is not None:
+            kids.append(else_value)
+        self.children = tuple(kids)
+
+    def with_children(self, children):
+        n = len(self.branches)
+        branches = [(children[2 * i], children[2 * i + 1]) for i in range(n)]
+        else_v = children[2 * n] if len(children) > 2 * n else None
+        return CaseWhen(branches, else_v)
+
+    @property
+    def dtype(self):
+        return self.branches[0][1].dtype
+
+    @property
+    def nullable(self):
+        if self.else_value is None:
+            return True
+        return any(v.nullable for _, v in self.branches) or self.else_value.nullable
+
+    def eval(self, ctx: EvalContext):
+        out_dt = self.dtype
+        vals = jnp.zeros((ctx.capacity,), out_dt.jnp_dtype)
+        validity = jnp.zeros((ctx.capacity,), jnp.bool_)
+        if self.else_value is not None:
+            e = self.else_value.eval(ctx)
+            vals = e.data.astype(out_dt.jnp_dtype)
+            validity = e.validity
+        decided = jnp.zeros((ctx.capacity,), jnp.bool_)
+        # first matching branch wins: walk in order, take where undecided
+        for cond, value in self.branches:
+            c = cond.eval(ctx)
+            v = value.eval(ctx)
+            take = c.data & c.validity & ~decided
+            vals = jnp.where(take, v.data.astype(out_dt.jnp_dtype), vals)
+            validity = jnp.where(take, v.validity, validity)
+            decided = decided | (c.data & c.validity)
+        return make_column(vals, validity, out_dt)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        out_dt = self.dtype
+        n = ctx.num_rows
+        is_obj = out_dt.variable_width
+        vals = np.zeros((n,), object if is_obj else out_dt.np_dtype)
+        validity = np.zeros((n,), np.bool_)
+        if self.else_value is not None:
+            ev, evalid = self.else_value.eval_cpu(ctx)
+            vals = ev.copy() if is_obj else ev.astype(out_dt.np_dtype)
+            validity = evalid.copy()
+        decided = np.zeros((n,), np.bool_)
+        for cond, value in self.branches:
+            cv, cval = cond.eval_cpu(ctx)
+            vv, vval = value.eval_cpu(ctx)
+            take = cv.astype(np.bool_) & cval & ~decided
+            if is_obj:
+                vals[take] = vv[take]
+            else:
+                vals = np.where(take, vv.astype(out_dt.np_dtype), vals)
+            validity = np.where(take, vval, validity)
+            decided |= cv.astype(np.bool_) & cval
+        return cpu_zero_invalid(vals, validity), validity
+
+    def __repr__(self):
+        parts = " ".join(f"WHEN {c!r} THEN {v!r}" for c, v in self.branches)
+        tail = f" ELSE {self.else_value!r}" if self.else_value is not None else ""
+        return f"CASE {parts}{tail} END"

@@ -1,0 +1,1 @@
+from spark_rapids_tpu.api.session import DataFrame, GroupedData, TpuSession

@@ -1,0 +1,167 @@
+"""User-facing session + DataFrame API.
+
+The standalone framework's equivalent of a SparkSession with the plugin
+installed: the same query runs on the TPU engine when
+``spark.rapids.sql.enabled`` is true and on the CPU oracle engine when
+false — which is exactly how the reference's differential harness flips
+engines (reference: integration_tests/src/main/python/spark_session.py:
+145-158 with_cpu_session/with_gpu_session).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.expressions.core import Col, Expression, col, lit
+from spark_rapids_tpu.kernels.sort import SortOrder
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.cpu_engine import CpuEngine
+from spark_rapids_tpu.plan.engine import TpuEngine
+from spark_rapids_tpu.planner.overrides import explain_query, plan_query
+
+
+def _to_expr(e) -> Expression:
+    if isinstance(e, Expression):
+        return e
+    if isinstance(e, str):
+        return col(e)
+    return lit(e)
+
+
+class TpuSession:
+    def __init__(self, conf: Optional[Dict[str, str]] = None):
+        self.conf = RapidsConf(conf or {})
+
+    def set_conf(self, key: str, value) -> None:
+        self.conf = self.conf.with_overrides(**{key: value})
+
+    # -- data sources -------------------------------------------------------
+
+    def create_dataframe(self, data, schema: Optional[Schema] = None,
+                         num_partitions: int = 1) -> "DataFrame":
+        """data: dict of lists, pyarrow Table, or list of ColumnarBatches."""
+        if isinstance(data, dict):
+            assert schema is not None, "dict data needs a Schema"
+            batch = ColumnarBatch.from_pydict(data, schema)
+            batches = [batch]
+        elif isinstance(data, list) and data and isinstance(data[0], ColumnarBatch):
+            batches = data
+            schema = batches[0].schema
+        else:  # pyarrow
+            batch = ColumnarBatch.from_arrow(data)
+            batches = [batch]
+            schema = batch.schema
+        # split into partitions round-robin by batch
+        parts: List[List[ColumnarBatch]] = [[] for _ in range(num_partitions)]
+        for i, b in enumerate(batches):
+            parts[i % num_partitions].append(b)
+        return DataFrame(L.InMemoryRelation(parts, schema), self)
+
+    def read_parquet(self, *paths: str,
+                     columns: Optional[Sequence[str]] = None) -> "DataFrame":
+        from spark_rapids_tpu.io.parquet import parquet_schema
+        schema = parquet_schema(paths[0], columns)
+        return DataFrame(
+            L.ParquetRelation(paths, schema,
+                              tuple(columns) if columns else None), self)
+
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", keys: Sequence[Expression]):
+        self.df = df
+        self.keys = [_to_expr(k) for k in keys]
+
+    def agg(self, *aggs) -> "DataFrame":
+        return DataFrame(
+            L.Aggregate(self.keys, [_to_expr(a) for a in aggs],
+                        self.df.plan), self.df.session)
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session: TpuSession):
+        self.plan = plan
+        self.session = session
+
+    @property
+    def schema(self) -> Schema:
+        return self.plan.schema
+
+    # -- transformations ----------------------------------------------------
+
+    def select(self, *exprs) -> "DataFrame":
+        return DataFrame(L.Project([_to_expr(e) for e in exprs], self.plan),
+                         self.session)
+
+    def filter(self, condition) -> "DataFrame":
+        return DataFrame(L.Filter(_to_expr(condition), self.plan), self.session)
+
+    where = filter
+
+    def with_column(self, name: str, expr) -> "DataFrame":
+        exprs = [col(n) for n in self.schema.names if n != name]
+        exprs.append(_to_expr(expr).alias(name))
+        return self.select(*exprs)
+
+    def group_by(self, *keys) -> GroupedData:
+        return GroupedData(self, [_to_expr(k) for k in keys])
+
+    def agg(self, *aggs) -> "DataFrame":
+        return DataFrame(L.Aggregate([], [_to_expr(a) for a in aggs],
+                                     self.plan), self.session)
+
+    def order_by(self, *orders) -> "DataFrame":
+        parsed: List[Tuple[Expression, SortOrder]] = []
+        for o in orders:
+            if isinstance(o, tuple):
+                e, so = o
+                parsed.append((_to_expr(e), so))
+            else:
+                parsed.append((_to_expr(o), SortOrder(True)))
+        return DataFrame(L.Sort(parsed, self.plan), self.session)
+
+    sort = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(n, self.plan), self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(L.Union([self.plan, other.plan]), self.session)
+
+    def repartition(self, num_partitions: int, *keys) -> "DataFrame":
+        return DataFrame(
+            L.Repartition(num_partitions, [_to_expr(k) for k in keys],
+                          self.plan), self.session)
+
+    def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+            lkeys = [col(k) for k in on]
+            rkeys = [col(k) for k in on]
+        else:
+            lkeys, rkeys = on
+        return DataFrame(
+            L.Join(self.plan, other.plan, lkeys, rkeys, join_type=how),
+            self.session)
+
+    # -- actions ------------------------------------------------------------
+
+    def collect(self) -> List[tuple]:
+        if self.session.conf.sql_enabled:
+            exec_plan, _ = plan_query(self.plan, self.session.conf)
+            return TpuEngine(self.session.conf).collect(exec_plan)
+        return CpuEngine(self.session.conf.shuffle_partitions).collect(self.plan)
+
+    def explain(self) -> str:
+        return explain_query(self.plan, self.session.conf)
+
+    def physical_plan(self):
+        exec_plan, meta = plan_query(self.plan, self.session.conf)
+        return exec_plan
+
+    def count(self) -> int:
+        from spark_rapids_tpu.expressions.aggregates import count
+        rows = self.agg(count()).collect()
+        return rows[0][0]

@@ -44,29 +44,33 @@ class EvalContext:
 
 
 class CpuEvalContext:
-    """Host-oracle context: dict of column name -> (values, validity).
+    """Host-oracle context: per-ordinal (values, validity) numpy pairs.
 
     Fixed-width values are numpy arrays; strings are object arrays of
-    str/None.  validity is bool numpy.
+    str/None.  validity is bool numpy.  Storage is ordinal-indexed because
+    schemas may carry duplicate names after a join (as in Spark).
     """
 
-    def __init__(self, columns, num_rows: int, schema: Schema):
-        self.columns = columns
+    def __init__(self, cols, num_rows: int, schema: Schema):
+        self.cols = list(cols)          # [(values, validity), ...]
         self.num_rows = num_rows
         self.schema = schema
+
+    def col(self, ordinal: int):
+        return self.cols[ordinal]
 
     @staticmethod
     def from_batch(batch: ColumnarBatch) -> "CpuEvalContext":
         n = batch.host_num_rows()
-        cols = {}
-        for name, col in zip(batch.schema.names, batch.columns):
+        cols = []
+        for col in batch.columns:
             if col.dtype.variable_width:
-                vals = np.array(col.to_pylist(n), dtype=object)
+                vals = np.array(col.to_pylist(n) + [None], dtype=object)[:-1]
                 valid = np.array([v is not None for v in vals], dtype=np.bool_)
             else:
                 vals, valid = col.to_numpy(n)
                 vals = vals.copy()
-            cols[name] = (vals, valid)
+            cols.append((vals, valid))
         return CpuEvalContext(cols, n, batch.schema)
 
 
@@ -276,8 +280,7 @@ class BoundReference(Expression):
         return ctx.batch.columns[self.ordinal]
 
     def eval_cpu(self, ctx: CpuEvalContext):
-        name = ctx.schema.names[self.ordinal]
-        vals, valid = ctx.columns[name]
+        vals, valid = ctx.col(self.ordinal)
         return vals, valid
 
     def references(self):

@@ -1,0 +1,117 @@
+"""Parquet read/write for the TPU engine.
+
+Reference: parquet/GpuParquetScan.scala — PERFILE reader (:3631), footer
+parse + row-group pruning, chunked batching (:3409);
+GpuParquetFileFormat.scala for writes.
+
+TPU lowering per SURVEY.md §2.1: host decode (Arrow C++ via pyarrow — a
+native columnar decoder, not a Python loop) feeding HBM upload; the decode
+runs OFF the device semaphore, only the upload path touches the device.
+Row-group pruning by min/max statistics mirrors the reference's footer
+filter; a Pallas page-decoder is the north-star follow-on.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.arrow import arrow_to_batch, batch_to_arrow, arrow_type_to_sql
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+
+
+def parquet_schema(path: str, columns: Optional[Sequence[str]] = None) -> Schema:
+    pf = pq.ParquetFile(path)
+    arrow_schema = pf.schema_arrow
+    names = []
+    dtypes = []
+    for field in arrow_schema:
+        if columns and field.name not in columns:
+            continue
+        names.append(field.name)
+        dtypes.append(arrow_type_to_sql(field.type))
+    if columns:
+        order = {n: i for i, n in enumerate(columns)}
+        pairs = sorted(zip(names, dtypes), key=lambda p: order[p[0]])
+        names = [p[0] for p in pairs]
+        dtypes = [p[1] for p in pairs]
+    return Schema(tuple(names), tuple(dtypes))
+
+
+def _stats_allow(row_group, col_index: int, lo, hi) -> bool:
+    """Can this row group contain values in [lo, hi]?  (min/max pruning)"""
+    col = row_group.column(col_index)
+    stats = col.statistics
+    if stats is None or not stats.has_min_max:
+        return True
+    if hi is not None and stats.min is not None and stats.min > hi:
+        return False
+    if lo is not None and stats.max is not None and stats.max < lo:
+        return False
+    return True
+
+
+def read_parquet_batches(
+    path: str,
+    columns: Optional[Sequence[str]] = None,
+    batch_size_rows: int = 1 << 20,
+    range_filters: Optional[dict] = None,
+) -> Iterator[ColumnarBatch]:
+    """Stream one file as device batches of ~batch_size_rows.
+
+    range_filters: {column: (lo, hi)} predicate-pushdown hints used for
+    row-group pruning only (exact filtering stays in the Filter exec —
+    same contract as the reference's footer filter).
+    """
+    pf = pq.ParquetFile(path)
+    groups: List[int] = []
+    meta = pf.metadata
+    name_to_idx = {meta.schema.column(i).name: i
+                   for i in range(meta.schema.num_columns)}
+    for rg in range(meta.num_row_groups):
+        row_group = meta.row_group(rg)
+        keep = True
+        if range_filters:
+            for cname, (lo, hi) in range_filters.items():
+                ci = name_to_idx.get(cname)
+                if ci is not None and not _stats_allow(row_group, ci, lo, hi):
+                    keep = False
+                    break
+        if keep:
+            groups.append(rg)
+    if not groups:
+        return
+    for record_batch in pf.iter_batches(batch_size=batch_size_rows,
+                                        row_groups=groups,
+                                        columns=list(columns) if columns else None):
+        table = pa.Table.from_batches([record_batch])
+        yield arrow_to_batch(table)
+
+
+def write_parquet(batches, path: str, schema: Optional[Schema] = None) -> int:
+    """Device batches -> one parquet file; returns rows written.
+
+    (ColumnarOutputWriter.scala analog: download + host encode.)
+    """
+    writer = None
+    rows = 0
+    try:
+        for batch in batches:
+            table = batch_to_arrow(batch)
+            if writer is None:
+                writer = pq.ParquetWriter(path, table.schema)
+            writer.write_table(table)
+            rows += batch.host_num_rows()
+        if writer is None and schema is not None:
+            from spark_rapids_tpu.columnar.arrow import sql_type_to_arrow
+            empty = pa.table({n: pa.array([], type=sql_type_to_arrow(d))
+                              for n, d in zip(schema.names, schema.dtypes)})
+            writer = pq.ParquetWriter(path, empty.schema)
+            writer.write_table(empty)
+    finally:
+        if writer is not None:
+            writer.close()
+    return rows

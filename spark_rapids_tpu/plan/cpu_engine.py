@@ -1,0 +1,478 @@
+"""CPU reference engine: the differential-test oracle.
+
+The reference's integration tests run every query twice — once on CPU Spark,
+once on the plugin — and demand identical results (reference:
+integration_tests/src/main/python/asserts.py, spark_session.py:145-158).
+This standalone framework has no CPU Spark to lean on, so this module IS the
+CPU side: a deliberately simple, row-wise-obvious numpy interpreter of the
+same logical plans, implementing Spark SQL semantics (three-valued logic,
+NaN ordering, null-first sort, murmur3 partitioning) with independent code.
+Keep it boring: its value is being easy to audit, not fast.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions.core import (
+    CpuEvalContext,
+    Expression,
+    cpu_zero_invalid,
+)
+from spark_rapids_tpu.expressions.aggregates import (
+    COUNT_STAR,
+    COUNT_VALID,
+    MAX,
+    MIN,
+    SUM,
+    AggregateFunction,
+)
+from spark_rapids_tpu.kernels.hash import py_murmur3_row
+from spark_rapids_tpu.kernels.sort import SortOrder
+from spark_rapids_tpu.plan import logical as L
+
+
+class CpuTable:
+    """One partition of rows on the host."""
+
+    def __init__(self, cols: List[Tuple[np.ndarray, np.ndarray]],
+                 num_rows: int, schema: Schema):
+        self.cols = cols
+        self.num_rows = num_rows
+        self.schema = schema
+
+    def ctx(self) -> CpuEvalContext:
+        return CpuEvalContext(self.cols, self.num_rows, self.schema)
+
+    @staticmethod
+    def from_batch(batch: ColumnarBatch) -> "CpuTable":
+        ctx = CpuEvalContext.from_batch(batch)
+        return CpuTable(ctx.cols, ctx.num_rows, batch.schema)
+
+    @staticmethod
+    def empty(schema: Schema) -> "CpuTable":
+        cols = []
+        for dt in schema.dtypes:
+            dtype = object if dt.variable_width else np.dtype(dt.np_dtype)
+            cols.append((np.zeros((0,), dtype), np.zeros((0,), np.bool_)))
+        return CpuTable(cols, 0, schema)
+
+    @staticmethod
+    def concat(tables: Sequence["CpuTable"], schema: Schema) -> "CpuTable":
+        tables = [t for t in tables]
+        if not tables:
+            return CpuTable.empty(schema)
+        cols = []
+        for i in range(len(schema)):
+            vals = np.concatenate([t.cols[i][0] for t in tables])
+            valid = np.concatenate([t.cols[i][1] for t in tables])
+            cols.append((vals, valid))
+        return CpuTable(cols, sum(t.num_rows for t in tables), schema)
+
+    def take(self, idx: np.ndarray) -> "CpuTable":
+        cols = [(v[idx], m[idx]) for v, m in self.cols]
+        return CpuTable(cols, len(idx), self.schema)
+
+    def rows(self) -> List[tuple]:
+        out = []
+        for r in range(self.num_rows):
+            row = []
+            for (v, m), dt in zip(self.cols, self.schema.dtypes):
+                if not m[r]:
+                    row.append(None)
+                elif v.dtype == object:
+                    row.append(v[r])
+                else:
+                    row.append(v[r].item())
+            out.append(tuple(row))
+        return out
+
+
+def _norm_key(value, valid, dtype: T.DataType):
+    """Grouping/join key normalization with Spark semantics: null is one
+    group; NaN == NaN; -0.0 == 0.0 (Spark NormalizeFloatingNumbers)."""
+    if not valid:
+        return ("\0null",)
+    if isinstance(dtype, (T.FloatType, T.DoubleType)):
+        f = float(value)
+        if math.isnan(f):
+            return ("\0nan",)
+        if f == 0.0:
+            return (0.0,)
+        return (f,)
+    if isinstance(value, np.generic):
+        return (value.item(),)
+    return (value,)
+
+
+def _row_key(table: CpuTable, key_cols, r: int):
+    return tuple(
+        _norm_key(vals[r], valid[r], dt)
+        for (vals, valid), dt in key_cols
+    )
+
+
+class _SortKey:
+    """Comparator wrapper implementing Spark's total order per column."""
+
+    __slots__ = ("rank", "val")
+
+    def __init__(self, rank: int, val):
+        self.rank = rank   # 0 = null slot, 1 = value (asc space)
+        self.val = val
+
+    def __lt__(self, other):
+        if self.rank != other.rank:
+            return self.rank < other.rank
+        return self.val < other.val
+
+    def __eq__(self, other):
+        return self.rank == other.rank and self.val == other.val
+
+
+def _sort_key_for(value, valid, dtype: T.DataType, order: SortOrder):
+    asc = order.ascending
+    nulls_first = order.nulls_first
+    # null rank: before values if nulls_first else after
+    if not valid:
+        return _SortKey(-1 if nulls_first else 1, 0)
+    v = value.item() if isinstance(value, np.generic) else value
+    if isinstance(dtype, (T.FloatType, T.DoubleType)):
+        f = float(v)
+        if math.isnan(f):
+            # NaN largest among values
+            return _SortKey(0, (1, 0) if asc else (-1, 0))
+        v = (0, -f) if not asc else (0, f)
+        return _SortKey(0, v)
+    if isinstance(dtype, (T.StringType, T.BinaryType)):
+        b = v.encode("utf-8") if isinstance(v, str) else v
+        if not asc:
+            # invert bytes for descending compare
+            b = bytes(255 - x for x in b) + b"\xff"
+        return _SortKey(0, b)
+    if not asc:
+        v = -v
+    return _SortKey(0, v)
+
+
+class CpuEngine:
+    """Executes a logical plan; returns partitions of CpuTables."""
+
+    def __init__(self, shuffle_partitions: int = 4):
+        self.shuffle_partitions = shuffle_partitions
+
+    def execute(self, plan: L.LogicalPlan) -> List[CpuTable]:
+        return self._exec(plan)
+
+    def collect(self, plan: L.LogicalPlan) -> List[tuple]:
+        parts = self._exec(plan)
+        out: List[tuple] = []
+        for p in parts:
+            out.extend(p.rows())
+        return out
+
+    # -- node dispatch ------------------------------------------------------
+
+    def _exec(self, plan: L.LogicalPlan) -> List[CpuTable]:
+        m = getattr(self, "_exec_" + type(plan).__name__.lower(), None)
+        if m is None:
+            raise NotImplementedError(f"CPU engine: {type(plan).__name__}")
+        return m(plan)
+
+    def _exec_inmemoryrelation(self, plan: L.InMemoryRelation):
+        out = []
+        for part in plan.partitions:
+            tables = [CpuTable.from_batch(b) for b in part]
+            out.append(CpuTable.concat(tables, plan.schema))
+        return out or [CpuTable.empty(plan.schema)]
+
+    def _exec_parquetrelation(self, plan: L.ParquetRelation):
+        import pyarrow.parquet as pq
+        from spark_rapids_tpu.columnar import arrow as arrow_interop
+        out = []
+        for path in plan.paths:
+            table = pq.read_table(path, columns=list(plan.column_pruning)
+                                  if plan.column_pruning else None)
+            batch = arrow_interop.arrow_to_batch(table)
+            out.append(CpuTable.from_batch(batch))
+        return out or [CpuTable.empty(plan.schema)]
+
+    def _exec_project(self, plan: L.Project):
+        out = []
+        for t in self._exec(plan.child):
+            ctx = t.ctx()
+            cols = [e.eval_cpu(ctx) for e in plan.exprs]
+            cols = [(cpu_zero_invalid(v, m), m) for v, m in cols]
+            out.append(CpuTable(cols, t.num_rows, plan.schema))
+        return out
+
+    def _exec_filter(self, plan: L.Filter):
+        out = []
+        for t in self._exec(plan.child):
+            v, m = plan.condition.eval_cpu(t.ctx())
+            keep = v.astype(np.bool_) & m
+            out.append(t.take(np.nonzero(keep)[0]))
+        return out
+
+    def _exec_aggregate(self, plan: L.Aggregate):
+        child_parts = self._exec(plan.child)
+        t = CpuTable.concat(child_parts, plan.child.schema)
+        ctx = t.ctx()
+        key_evals = [(e.eval_cpu(ctx), e.dtype) for e in plan.group_exprs]
+        # evaluate each aggregate's input over the full table once
+        agg_inputs = {}
+        for agg in plan.aggregates:
+            if agg.input is not None and id(agg) not in agg_inputs:
+                agg_inputs[id(agg)] = agg.input.eval_cpu(ctx)
+
+        groups: Dict[tuple, List[int]] = {}
+        order: List[tuple] = []
+        if plan.group_exprs:
+            for r in range(t.num_rows):
+                k = _row_key(t, key_evals, r)
+                if k not in groups:
+                    groups[k] = []
+                    order.append(k)
+                groups[k].append(r)
+        else:
+            order = [()]
+            groups[()] = list(range(t.num_rows))
+
+        n_groups = len(order)
+        # group key output columns
+        out_cols: List[Tuple[np.ndarray, np.ndarray]] = []
+        for (vals, valid), dt in key_evals:
+            gv = np.zeros((n_groups,), object if dt.variable_width else dt.np_dtype)
+            gm = np.zeros((n_groups,), np.bool_)
+            for gi, k in enumerate(order):
+                r0 = groups[k][0]
+                gm[gi] = valid[r0]
+                if valid[r0]:
+                    gv[gi] = vals[r0]
+            out_cols.append((cpu_zero_invalid(gv, gm), gm))
+
+        # per-aggregate buffers -> finalized columns
+        finalized: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for agg in plan.aggregates:
+            bufs = []
+            for slot in agg.buffers:
+                bv = np.zeros((n_groups,), slot.dtype.np_dtype)
+                bm = np.ones((n_groups,), np.bool_)
+                for gi, k in enumerate(order):
+                    idx = np.array(groups[k], dtype=np.int64)
+                    if slot.update_op == COUNT_STAR:
+                        bv[gi] = len(idx)
+                        continue
+                    vals, valid = agg_inputs[id(agg)]
+                    sel = idx[valid[idx]] if len(idx) else idx
+                    if slot.update_op == COUNT_VALID:
+                        bv[gi] = len(sel)
+                    elif len(sel) == 0:
+                        bv[gi] = 0
+                    elif slot.update_op == SUM:
+                        with np.errstate(all="ignore"):
+                            bv[gi] = vals[sel].astype(slot.dtype.np_dtype).sum()
+                    elif slot.update_op == MIN:
+                        bv[gi] = _extreme_np(vals[sel], slot.dtype, is_min=True)
+                    elif slot.update_op == MAX:
+                        bv[gi] = _extreme_np(vals[sel], slot.dtype, is_min=False)
+                    else:
+                        raise NotImplementedError(slot.update_op)
+                bufs.append((bv, bm))
+            fv, fm = agg.finalize_np(bufs)
+            finalized[id(agg)] = (fv.astype(agg.dtype.np_dtype)
+                                  if fv.dtype != object else fv, fm)
+
+        # evaluate output agg expressions with aggregates substituted
+        result_ctx = CpuEvalContext([], n_groups, Schema((), ()))
+        for e in plan.agg_exprs:
+            sub = _substitute_aggs(e, finalized)
+            v, m = sub.eval_cpu(result_ctx)
+            out_cols.append((cpu_zero_invalid(v, m), m))
+        return [CpuTable(out_cols, n_groups, plan.schema)]
+
+    def _exec_sort(self, plan: L.Sort):
+        parts = self._exec(plan.child)
+        if plan.global_sort:
+            parts = [CpuTable.concat(parts, plan.child.schema)]
+        out = []
+        for t in parts:
+            ctx = t.ctx()
+            evals = [(e.eval_cpu(ctx), e.dtype, o) for e, o in plan.orders]
+            def keyfn(r):
+                return tuple(
+                    _sort_key_for(vals[r], valid[r], dt, o)
+                    for (vals, valid), dt, o in evals
+                )
+            idx = sorted(range(t.num_rows), key=keyfn)
+            out.append(t.take(np.array(idx, dtype=np.int64)))
+        return out
+
+    def _exec_limit(self, plan: L.Limit):
+        parts = self._exec(plan.child)
+        t = CpuTable.concat(parts, plan.child.schema)
+        return [t.take(np.arange(min(plan.n, t.num_rows)))]
+
+    def _exec_union(self, plan: L.Union):
+        out = []
+        for c in plan.children:
+            out.extend(self._exec(c))
+        return out
+
+    def _exec_repartition(self, plan: L.Repartition):
+        parts = self._exec(plan.child)
+        n_out = plan.num_partitions
+        buckets: List[List[CpuTable]] = [[] for _ in range(n_out)]
+        for t in parts:
+            if not plan.keys:
+                # round-robin starting at partition hash-of-position
+                assign = np.arange(t.num_rows, dtype=np.int64) % n_out
+            else:
+                ctx = t.ctx()
+                key_evals = [(e.eval_cpu(ctx), e.dtype) for e in plan.keys]
+                assign = np.zeros((t.num_rows,), np.int64)
+                for r in range(t.num_rows):
+                    vals = []
+                    dts = []
+                    for (v, m), dt in key_evals:
+                        vals.append(v[r].item() if (m[r] and v.dtype != object)
+                                    else (v[r] if m[r] else None))
+                        dts.append(dt)
+                    h = py_murmur3_row(vals, dts)
+                    assign[r] = h % n_out if h % n_out >= 0 else h % n_out
+            for p in range(n_out):
+                buckets[p].append(t.take(np.nonzero(assign == p)[0]))
+        return [CpuTable.concat(bs, plan.schema) for bs in buckets]
+
+    def _exec_join(self, plan: L.Join):
+        left = CpuTable.concat(self._exec(plan.left), plan.left.schema)
+        right = CpuTable.concat(self._exec(plan.right), plan.right.schema)
+        lctx, rctx = left.ctx(), right.ctx()
+        lkeys = [(e.eval_cpu(lctx), e.dtype) for e in plan.left_keys]
+        rkeys = [(e.eval_cpu(rctx), e.dtype) for e in plan.right_keys]
+
+        def keyof(key_evals, r):
+            return tuple(_norm_key(v[r], m[r], dt) for (v, m), dt in key_evals)
+
+        def has_null_key(key_evals, r):
+            return any(not m[r] for (v, m), _ in key_evals)
+
+        build: Dict[tuple, List[int]] = {}
+        for r in range(right.num_rows):
+            if has_null_key(rkeys, r):
+                continue  # null keys never match in equi-joins
+            build.setdefault(keyof(rkeys, r), []).append(r)
+
+        lidx: List[int] = []
+        ridx: List[int] = []   # -1 = null-extended
+        rmatched = np.zeros((right.num_rows,), np.bool_)
+        jt = plan.join_type
+        for r in range(left.num_rows):
+            matches = ([] if has_null_key(lkeys, r)
+                       else build.get(keyof(lkeys, r), []))
+            if jt == "inner":
+                for m in matches:
+                    lidx.append(r)
+                    ridx.append(m)
+            elif jt in ("left", "full"):
+                if matches:
+                    for m in matches:
+                        lidx.append(r)
+                        ridx.append(m)
+                        rmatched[m] = True
+                else:
+                    lidx.append(r)
+                    ridx.append(-1)
+            elif jt == "right":
+                for m in matches:
+                    lidx.append(r)
+                    ridx.append(m)
+                    rmatched[m] = True
+            elif jt == "left_semi":
+                if matches:
+                    lidx.append(r)
+            elif jt == "left_anti":
+                if not matches:
+                    lidx.append(r)
+            elif jt == "cross":
+                for m in range(right.num_rows):
+                    lidx.append(r)
+                    ridx.append(m)
+        if jt in ("right", "full"):
+            for m in range(right.num_rows):
+                if not rmatched[m]:
+                    lidx.append(-1)
+                    ridx.append(m)
+
+        if jt in ("left_semi", "left_anti"):
+            out = left.take(np.array(lidx, dtype=np.int64))
+            return [out]
+
+        la = np.array(lidx, dtype=np.int64)
+        ra = np.array(ridx, dtype=np.int64)
+        cols = []
+        for (v, m) in left.cols:
+            gv = v[np.clip(la, 0, None)] if len(la) else v[:0]
+            gm = np.where(la >= 0, m[np.clip(la, 0, None)], False) if len(la) else m[:0]
+            cols.append((cpu_zero_invalid(gv, gm), gm))
+        for (v, m) in right.cols:
+            gv = v[np.clip(ra, 0, None)] if len(ra) else v[:0]
+            gm = np.where(ra >= 0, m[np.clip(ra, 0, None)], False) if len(ra) else m[:0]
+            cols.append((cpu_zero_invalid(gv, gm), gm))
+        joined = CpuTable(cols, len(la), plan.schema)
+        if plan.condition is not None:
+            v, m = plan.condition.eval_cpu(joined.ctx())
+            if jt != "inner":
+                raise NotImplementedError(
+                    "CPU oracle: residual condition on outer joins")
+            joined = joined.take(np.nonzero(v.astype(np.bool_) & m)[0])
+        return [joined]
+
+
+def _extreme_np(vals: np.ndarray, dtype: T.DataType, is_min: bool):
+    if vals.dtype == object:
+        return min(vals) if is_min else max(vals)
+    if np.issubdtype(vals.dtype, np.floating):
+        # Spark min/max: NaN is the largest value
+        has_nan = np.isnan(vals).any()
+        if has_nan and not is_min:
+            return np.nan
+        clean = vals[~np.isnan(vals)]
+        if len(clean) == 0:
+            return np.nan
+        return clean.min() if is_min else clean.max()
+    return vals.min() if is_min else vals.max()
+
+
+class _Precomputed(Expression):
+    """Internal: a finalized aggregate result column."""
+
+    def __init__(self, values, validity, dtype):
+        self.values = values
+        self.validity = validity
+        self._dtype = dtype
+        self.children = ()
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def eval_cpu(self, ctx):
+        return self.values, self.validity
+
+    def __repr__(self):
+        return "<agg-result>"
+
+
+def _substitute_aggs(e: Expression, finalized) -> Expression:
+    if isinstance(e, AggregateFunction):
+        v, m = finalized[id(e)]
+        return _Precomputed(v, m, e.dtype)
+    if not e.children:
+        return e
+    return e.with_children(tuple(_substitute_aggs(c, finalized)
+                                 for c in e.children))

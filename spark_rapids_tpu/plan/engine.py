@@ -1,0 +1,46 @@
+"""TPU engine driver: runs a physical exec tree.
+
+The local stand-in for Spark's task scheduler: partitions are tasks; the
+TPU semaphore (memory/semaphore.py, GpuSemaphore.scala:240 analog) gates
+device concurrency when tasks run on a thread pool.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory.semaphore import tpu_semaphore
+from spark_rapids_tpu.plan.execs.base import TpuExec
+
+
+class TpuEngine:
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        self.conf = conf or RapidsConf()
+
+    def execute(self, plan: TpuExec) -> List[List[ColumnarBatch]]:
+        """Materialize all partitions (list of batches per partition)."""
+        nparts = plan.num_partitions()
+
+        def run_one(p: int) -> List[ColumnarBatch]:
+            sem = tpu_semaphore()
+            sem.acquire_if_necessary()
+            try:
+                return list(plan.execute_partition(p))
+            finally:
+                sem.release_if_necessary()
+
+        threads = min(nparts, max(self.conf.concurrent_tpu_tasks, 1))
+        if threads <= 1 or nparts <= 1:
+            return [run_one(p) for p in range(nparts)]
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            return list(pool.map(run_one, range(nparts)))
+
+    def collect(self, plan: TpuExec) -> List[tuple]:
+        from spark_rapids_tpu.plan.cpu_engine import CpuTable
+        rows: List[tuple] = []
+        for part in self.execute(plan):
+            for batch in part:
+                rows.extend(CpuTable.from_batch(batch).rows())
+        return rows

@@ -1,0 +1,317 @@
+"""Hash-aggregate exec: two-phase (partial/final) columnar aggregation.
+
+Reference: GpuAggregateExec.scala — AggHelper's update/merge split (:360),
+first-pass iterator (:730), merge-on-concat (:130-147).  The TPU lowering
+replaces cuDF's hash groupby with sort-based segmented reduction
+(kernels/groupby.py) — a shape-static pipeline XLA maps onto sorts and
+scatter-reduces.
+
+Modes (matching Spark's physical agg modes the reference plans):
+  * partial:  raw rows -> (keys..., buffer slots...) partial batches
+  * final:    partial batches -> finalized output (after a key shuffle)
+  * complete: both fused (single-partition plans)
+
+The per-batch partial step and the merge step are each one jitted function;
+group count is dynamic, capacities static.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
+from spark_rapids_tpu.expressions.core import (
+    EvalContext,
+    Expression,
+)
+from spark_rapids_tpu.expressions.aggregates import (
+    COUNT_STAR,
+    COUNT_VALID,
+    MAX,
+    MIN,
+    SUM,
+    AggregateFunction,
+)
+from spark_rapids_tpu.kernels import groupby as G
+from spark_rapids_tpu.kernels.selection import concat_batches_device
+from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_split
+from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+
+
+class _DeviceAggResult(Expression):
+    """Internal: finalized aggregate column injected into output-expression
+    eval (the device twin of the CPU oracle's substitution)."""
+
+    def __init__(self, column: DeviceColumn):
+        self.column = column
+        self.children = ()
+
+    @property
+    def dtype(self):
+        return self.column.dtype
+
+    def eval(self, ctx):
+        return self.column
+
+    def __repr__(self):
+        return "<agg-result>"
+
+
+def _substitute(e: Expression, mapping) -> Expression:
+    if isinstance(e, AggregateFunction):
+        return _DeviceAggResult(mapping[id(e)])
+    if not e.children:
+        return e
+    return e.with_children(tuple(_substitute(c, mapping) for c in e.children))
+
+
+def _seg_update(op: str, col: Optional[DeviceColumn], layout: G.GroupedLayout,
+                out_dtype: T.DataType):
+    if op == COUNT_STAR:
+        return G.seg_count_star(layout)
+    assert col is not None
+    if op == COUNT_VALID:
+        return G.seg_count_valid(col, layout)
+    if op == SUM:
+        return G.seg_sum(col, layout, out_dtype.jnp_dtype)
+    if op == MIN:
+        return G.seg_min(col, layout)
+    if op == MAX:
+        return G.seg_max(col, layout)
+    raise NotImplementedError(op)
+
+
+def _global_update(op: str, col: Optional[DeviceColumn], live, out_dtype):
+    """Whole-batch reduction to one group (no keys)."""
+    if op == COUNT_STAR:
+        return jnp.sum(live.astype(jnp.int64)), jnp.bool_(True)
+    assert col is not None
+    valid = col.validity & live
+    if op == COUNT_VALID:
+        return jnp.sum(valid.astype(jnp.int64)), jnp.bool_(True)
+    nvalid = jnp.sum(valid.astype(jnp.int32))
+    if op == SUM:
+        vals = col.data.astype(out_dtype.jnp_dtype)
+        return jnp.sum(jnp.where(valid, vals, 0)), nvalid > 0
+    if op in (MIN, MAX):
+        dt = col.data.dtype
+        is_min = op == MIN
+        if jnp.issubdtype(dt, jnp.floating):
+            isnan = jnp.isnan(col.data)
+            ident = G._extreme(dt, is_min)
+            contrib = jnp.where(valid & ~isnan, col.data, ident)
+            red = jnp.min(contrib) if is_min else jnp.max(contrib)
+            if is_min:
+                any_nonnan = jnp.sum((valid & ~isnan).astype(jnp.int32)) > 0
+                red = jnp.where(any_nonnan, red, jnp.full((), jnp.nan, dt))
+            else:
+                any_nan = jnp.sum((valid & isnan).astype(jnp.int32)) > 0
+                red = jnp.where(any_nan, jnp.full((), jnp.nan, dt), red)
+            return red, nvalid > 0
+        ident = G._extreme(dt if dt != jnp.bool_ else jnp.bool_, is_min)
+        contrib = jnp.where(valid, col.data, ident)
+        if dt == jnp.bool_:
+            contrib = contrib.astype(jnp.int8)
+        red = jnp.min(contrib) if is_min else jnp.max(contrib)
+        if dt == jnp.bool_:
+            red = red.astype(jnp.bool_)
+        return red, nvalid > 0
+    raise NotImplementedError(op)
+
+
+class TpuHashAggregateExec(TpuExec):
+    def __init__(self, group_exprs: Sequence[Expression],
+                 agg_exprs: Sequence[Expression],
+                 aggregates: List[AggregateFunction],
+                 child: TpuExec, schema: Schema, mode: str = "complete",
+                 target_capacity: int = 1 << 16):
+        self.group_exprs = tuple(group_exprs)
+        self.agg_exprs = tuple(agg_exprs)
+        self.aggregates = list(aggregates)
+        self.mode = mode
+        self.target_capacity = target_capacity
+        # buffer layout: per aggregate, per slot -> one partial column
+        self.slot_specs = []   # (agg_index, slot)
+        for ai, agg in enumerate(self.aggregates):
+            for slot in agg.buffers:
+                self.slot_specs.append((ai, slot))
+        nkeys = len(self.group_exprs)
+        partial_names = tuple(f"_k{i}" for i in range(nkeys)) + tuple(
+            f"_buf{i}" for i in range(len(self.slot_specs)))
+        partial_dtypes = tuple(e.dtype for e in self.group_exprs) + tuple(
+            s.dtype for _, s in self.slot_specs)
+        self.partial_schema = Schema(partial_names, partial_dtypes)
+        out_schema = self.partial_schema if mode == "partial" else schema
+        super().__init__((child,), out_schema)
+        self._jit_partial = jax.jit(self._partial_step)
+        self._jit_merge = jax.jit(self._merge_step)
+        self._jit_finalize = jax.jit(self._finalize)
+
+    # -- device steps -------------------------------------------------------
+
+    def _partial_step(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Raw rows -> one partial batch (keys + buffers), grouped in-batch."""
+        ctx = EvalContext(batch)
+        key_cols = tuple(e.eval(ctx) for e in self.group_exprs)
+        agg_in = {}
+        for agg in self.aggregates:
+            if agg.input is not None and id(agg) not in agg_in:
+                agg_in[id(agg)] = agg.input.eval(ctx)
+        nkeys = len(key_cols)
+
+        if nkeys == 0:
+            live = batch.live_mask()
+            cols = []
+            for ai, slot in self.slot_specs:
+                agg = self.aggregates[ai]
+                col = agg_in.get(id(agg))
+                v, valid = _global_update(slot.update_op, col, live, slot.dtype)
+                data = jnp.where(valid, v, jnp.zeros((), v.dtype))
+                cols.append(DeviceColumn(
+                    jnp.reshape(data.astype(slot.dtype.jnp_dtype), (1,)),
+                    jnp.reshape(valid, (1,)), slot.dtype))
+            return ColumnarBatch(tuple(cols), jnp.int32(1), self.partial_schema)
+
+        # grouped: pack keys + inputs into a work batch, sort-group, reduce
+        work_cols = list(key_cols)
+        col_of_agg = {}
+        for agg in self.aggregates:
+            if agg.input is not None:
+                col_of_agg[id(agg)] = len(work_cols)
+                work_cols.append(agg_in[id(agg)])
+        work_names = tuple(f"c{i}" for i in range(len(work_cols)))
+        work = ColumnarBatch(tuple(work_cols), batch.num_rows,
+                             Schema(work_names, tuple(c.dtype for c in work_cols)))
+        layout = G.group_rows(work, list(range(nkeys)), string_max_bytes=0)
+        out_keys = G.group_keys_output(layout, list(range(nkeys)))
+        cols = list(out_keys)
+        for ai, slot in self.slot_specs:
+            agg = self.aggregates[ai]
+            col = (layout.sorted_batch.columns[col_of_agg[id(agg)]]
+                   if agg.input is not None else None)
+            v, valid = _seg_update(slot.update_op, col, layout, slot.dtype)
+            cols.append(G.finalize_agg_column(
+                v.astype(slot.dtype.jnp_dtype), valid, layout.num_groups,
+                slot.dtype))
+        return ColumnarBatch(tuple(cols), layout.num_groups, self.partial_schema)
+
+    def _merge_step(self, partial: ColumnarBatch) -> ColumnarBatch:
+        """Concatenated partial batches -> merged partial batch."""
+        nkeys = len(self.group_exprs)
+        if nkeys == 0:
+            live = partial.live_mask()
+            cols = []
+            for si, (ai, slot) in enumerate(self.slot_specs):
+                col = partial.columns[nkeys + si]
+                v, valid = _global_update(slot.merge_op, col, live, slot.dtype)
+                data = jnp.where(valid, v, jnp.zeros((), v.dtype))
+                cols.append(DeviceColumn(
+                    jnp.reshape(data.astype(slot.dtype.jnp_dtype), (1,)),
+                    jnp.reshape(valid, (1,)), slot.dtype))
+            return ColumnarBatch(tuple(cols), jnp.int32(1), self.partial_schema)
+        layout = G.group_rows(partial, list(range(nkeys)), string_max_bytes=0)
+        out_keys = G.group_keys_output(layout, list(range(nkeys)))
+        cols = list(out_keys)
+        for si, (ai, slot) in enumerate(self.slot_specs):
+            col = layout.sorted_batch.columns[nkeys + si]
+            v, valid = _seg_update(slot.merge_op, col, layout, slot.dtype)
+            cols.append(G.finalize_agg_column(
+                v.astype(slot.dtype.jnp_dtype), valid, layout.num_groups,
+                slot.dtype))
+        return ColumnarBatch(tuple(cols), layout.num_groups, self.partial_schema)
+
+    def _finalize(self, merged: ColumnarBatch) -> ColumnarBatch:
+        """Merged partials -> final output batch (keys + output exprs)."""
+        nkeys = len(self.group_exprs)
+        mapping = {}
+        si = 0
+        for agg in self.aggregates:
+            bufs = []
+            for slot in agg.buffers:
+                c = merged.columns[nkeys + si]
+                bufs.append((c.data, c.validity))
+                si += 1
+            v, valid = agg.finalize_jnp(bufs)
+            live = merged.live_mask()
+            valid = valid & live
+            v = jnp.where(valid, v.astype(agg.dtype.jnp_dtype),
+                          jnp.zeros((), agg.dtype.jnp_dtype))
+            mapping[id(agg)] = DeviceColumn(v, valid, agg.dtype)
+        out_cols = list(merged.columns[:nkeys])
+        ctx = EvalContext(merged)
+        for e in self.agg_exprs:
+            sub = _substitute(e, mapping)
+            out_cols.append(sub.eval(ctx))
+        return ColumnarBatch(tuple(out_cols), merged.num_rows, self.schema)
+
+    # -- host-side orchestration -------------------------------------------
+
+    def _identity_partial(self) -> ColumnarBatch:
+        """The empty-input global-agg row: count 0, null value slots
+        (Spark: global agg over empty input yields one row)."""
+        cols = []
+        for ai, slot in self.slot_specs:
+            data = jnp.zeros((1,), slot.dtype.jnp_dtype)
+            valid = jnp.zeros((1,), jnp.bool_)
+            if slot.update_op == COUNT_STAR or slot.update_op == COUNT_VALID:
+                valid = jnp.ones((1,), jnp.bool_)
+            cols.append(DeviceColumn(data, valid, slot.dtype))
+        return ColumnarBatch(tuple(cols), jnp.int32(1), self.partial_schema)
+
+    def _partials_for(self, idx: int) -> List[ColumnarBatch]:
+        out = []
+        for batch in self.children[0].execute_partition(idx):
+            if self.mode in ("partial", "complete"):
+                out.append(with_retry_no_split(lambda: self._jit_partial(batch)))
+            else:
+                out.append(batch)   # already partial-format
+        return out
+
+    def _merge_partials(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
+        if len(partials) == 1:
+            merged_in = partials[0]
+        else:
+            total = sum(p.host_num_rows() for p in partials)
+            cap0 = round_up_pow2(max(total, 1))
+
+            def run(cap):
+                return concat_batches_device(partials, cap)
+
+            def check(res):
+                _, status = res
+                need = int(status.required_rows)
+                return None if need <= res[0].capacity else need
+
+            merged_in, _ = with_capacity_retry(run, check, cap0)
+        return with_retry_no_split(lambda: self._jit_merge(merged_in))
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        with timed(self.op_time):
+            partials = self._partials_for(idx)
+            if self.mode == "partial":
+                # Spark emits one initial-buffer row per empty partition for
+                # global aggregates, so the final phase always sees input
+                if not partials and len(self.group_exprs) == 0:
+                    partials = [self._identity_partial()]
+                for p in partials:
+                    self.output_rows.add(p.host_num_rows())
+                    yield self._count_out(p)
+                return
+            if not partials:
+                if len(self.group_exprs) == 0:
+                    partials = [self._identity_partial()]
+                else:
+                    return
+            merged = self._merge_partials(partials)
+            out = with_retry_no_split(lambda: self._jit_finalize(merged))
+        self.output_rows.add(out.host_num_rows())
+        yield self._count_out(out)
+
+    def describe(self):
+        keys = ", ".join(map(repr, self.group_exprs))
+        aggs = ", ".join(map(repr, self.agg_exprs))
+        return f"TpuHashAggregate[{self.mode}, keys=[{keys}], aggs=[{aggs}]]"

@@ -1,0 +1,103 @@
+"""Physical exec base: the TPU analog of GpuExec.
+
+Reference: GpuExec.scala:107 — a columnar plan node producing an
+RDD[ColumnarBatch] per partition, with standard metrics (op time, output
+rows/batches) and semaphore acquisition before device work.
+
+Execution model: ``num_partitions()`` partitions, each computed by
+``execute_partition(idx)`` yielding device ColumnarBatches.  The local task
+runner (plan/engine.py) maps partitions onto a thread pool with the TPU
+semaphore gating device concurrency (GpuSemaphore.scala:240 analog).
+
+Jit discipline: each exec builds its device computation as pure functions of
+batch pytrees and jits them once per (schema, capacity-bucket); capacities
+are bucketed to powers of two (columnar/column.py round_up_pow2) so XLA
+recompiles stay bounded while batch sizes vary.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+
+
+class Metric:
+    def __init__(self, name: str, level: str = "MODERATE"):
+        self.name = name
+        self.level = level
+        self.value = 0
+
+    def add(self, v) -> None:
+        self.value += v
+
+
+class MetricSet:
+    """Per-exec metrics registry (GpuMetrics.scala:89 analog)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+
+    def metric(self, name: str, level: str = "MODERATE") -> Metric:
+        if name not in self._metrics:
+            self._metrics[name] = Metric(name, level)
+        return self._metrics[name]
+
+    def snapshot(self) -> Dict[str, int]:
+        return {k: m.value for k, m in self._metrics.items()}
+
+
+class TpuExec:
+    """Base physical operator."""
+
+    def __init__(self, children: Tuple["TpuExec", ...], schema: Schema):
+        self.children = children
+        self._schema = schema
+        self.metrics = MetricSet()
+        # standard metric names (GpuExec.scala:196-206)
+        self.op_time = self.metrics.metric("opTime", "ESSENTIAL")
+        self.output_rows = self.metrics.metric("numOutputRows", "ESSENTIAL")
+        self.output_batches = self.metrics.metric("numOutputBatches")
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        if self.children:
+            return self.children[0].num_partitions()
+        return 1
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError(type(self).__name__)
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def describe(self) -> str:
+        return self.node_name()
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.describe()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def _count_out(self, batch: ColumnarBatch) -> ColumnarBatch:
+        self.output_batches.add(1)
+        return batch
+
+
+class timed:
+    """Context manager adding wall time to a metric (NvtxWithMetrics analog)."""
+
+    def __init__(self, metric: Metric):
+        self.metric = metric
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.metric.add(time.perf_counter_ns() - self.t0)
+        return False

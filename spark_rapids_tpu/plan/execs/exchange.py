@@ -1,0 +1,147 @@
+"""Shuffle exchange exec (v1: in-process, host-staged-optional).
+
+Reference: GpuShuffleExchangeExecBase.scala:174 (device-side partition/slice
+then hand off to the shuffle manager) + RapidsShuffleInternalManagerBase.
+This v1 is the CACHE_ONLY-mode analog (RapidsCachingWriter:1618): map tasks
+slice batches on device and park each partition's slice in the shuffle
+catalog as a *spillable* handle; reduce tasks concat their partition's
+slices.  The transport SPI seam for ICI/multi-host lives in shuffle/ and
+plugs in here without changing this exec.
+
+Partition routing is bit-exact Spark murmur3/pmod (kernels/partition.py), so
+results agree with the CPU oracle row-for-row.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import round_up_pow2
+from spark_rapids_tpu.expressions.core import EvalContext, Expression
+from spark_rapids_tpu.kernels.partition import hash_partition, round_robin_partition
+from spark_rapids_tpu.kernels.selection import (
+    concat_batches_device,
+    gather_batch,
+)
+from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_split
+from spark_rapids_tpu.memory.spill import SpillableBatchHandle, make_spillable
+from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    def __init__(self, num_partitions: int, keys: Sequence[Expression],
+                 child: TpuExec, schema: Optional[Schema] = None):
+        super().__init__((child,), schema or child.schema)
+        self.out_partitions = num_partitions
+        self.keys = tuple(keys)
+        self._lock = threading.Lock()
+        self._materialized: Optional[List[List[SpillableBatchHandle]]] = None
+
+        def slice_step(batch: ColumnarBatch):
+            """Device: append key columns, partition, return reordered batch
+            + per-partition counts."""
+            if not self.keys:
+                return round_robin_partition(batch, self.out_partitions)
+            ctx = EvalContext(batch)
+            key_cols = tuple(k.eval(ctx) for k in self.keys)
+            work = ColumnarBatch(
+                tuple(batch.columns) + key_cols, batch.num_rows,
+                Schema(tuple(batch.schema.names) +
+                       tuple(f"_pk{i}" for i in range(len(key_cols))),
+                       tuple(batch.schema.dtypes) +
+                       tuple(c.dtype for c in key_cols)))
+            reordered, counts = hash_partition(
+                work, list(range(len(batch.schema), len(work.schema))),
+                self.out_partitions, string_max_bytes=0)
+            # drop the key columns again
+            out = ColumnarBatch(reordered.columns[:len(batch.schema)],
+                                reordered.num_rows, batch.schema)
+            return out, counts
+
+        self._jit_slice = jax.jit(slice_step)
+
+    def num_partitions(self) -> int:
+        return self.out_partitions
+
+    # -- map side -----------------------------------------------------------
+
+    def _materialize(self) -> List[List[SpillableBatchHandle]]:
+        with self._lock:
+            if self._materialized is not None:
+                return self._materialized
+            buckets: List[List[SpillableBatchHandle]] = [
+                [] for _ in range(self.out_partitions)]
+            child = self.children[0]
+            for in_part in range(child.num_partitions()):
+                for batch in child.execute_partition(in_part):
+                    with timed(self.op_time):
+                        reordered, counts = with_retry_no_split(
+                            lambda: self._jit_slice(batch))
+                        host_counts = np.asarray(counts)
+                        offsets = np.zeros(self.out_partitions + 1, np.int64)
+                        np.cumsum(host_counts, out=offsets[1:])
+                        for p in range(self.out_partitions):
+                            cnt = int(host_counts[p])
+                            if cnt == 0:
+                                continue
+                            cap = round_up_pow2(cnt)
+                            idx = jnp.arange(cap, dtype=jnp.int32) + jnp.int32(offsets[p])
+                            piece = gather_batch(reordered, idx,
+                                                 jnp.int32(cnt), out_capacity=cap)
+                            buckets[p].append(make_spillable(piece))
+            self._materialized = buckets
+            return buckets
+
+    # -- reduce side --------------------------------------------------------
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        buckets = self._materialize()
+        handles = buckets[idx]
+        if not handles:
+            return
+        batches = [h.materialize() for h in handles]
+        if len(batches) == 1:
+            out = batches[0]
+        else:
+            total = sum(b.host_num_rows() for b in batches)
+            cap0 = round_up_pow2(max(total, 1))
+
+            def run(cap):
+                return concat_batches_device(batches, cap)
+
+            def check(res):
+                need = int(res[1].required_rows)
+                return None if need <= res[0].capacity else need
+
+            out, _ = with_capacity_retry(run, check, cap0)
+        self.output_rows.add(out.host_num_rows())
+        yield self._count_out(out)
+
+    def describe(self):
+        keys = ", ".join(map(repr, self.keys))
+        return f"TpuShuffleExchange[{self.out_partitions}, keys=[{keys}]]"
+
+
+class TpuSinglePartitionExec(TpuExec):
+    """Gather all child partitions into one (SinglePartition exchange)."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__((child,), child.schema)
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        child = self.children[0]
+        for p in range(child.num_partitions()):
+            for batch in child.execute_partition(p):
+                self.output_rows.add(batch.host_num_rows())
+                yield self._count_out(batch)
+
+    def describe(self):
+        return "TpuSinglePartition"

@@ -1,0 +1,69 @@
+"""CPU fallback exec: run an unsupported logical subtree on the host oracle
+engine and upload its result.
+
+The analog of leaving Catalyst nodes on CPU with GpuRowToColumnarExec
+inserted above them (reference: GpuTransitionOverrides.scala:50,
+GpuRowToColumnarExec.scala:940).  Columns come back as device batches so
+TPU execs can sit on top seamlessly.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+
+
+def cpu_table_to_batch(table) -> ColumnarBatch:
+    """CpuTable -> device ColumnarBatch upload."""
+    import jax.numpy as jnp
+    cols: List[DeviceColumn] = []
+    for (vals, valid), dt in zip(table.cols, table.schema.dtypes):
+        if dt.variable_width:
+            cols.append(DeviceColumn.from_strings(
+                list(vals), validity=valid, dtype=dt))
+        else:
+            cols.append(DeviceColumn.from_numpy(vals, dt, valid))
+    # normalize capacities
+    if cols:
+        cap = max(c.capacity for c in cols)
+        cols = [c if c.capacity == cap else c.with_capacity(cap) for c in cols]
+    return ColumnarBatch(tuple(cols),
+                         jnp.asarray(table.num_rows, dtype=jnp.int32),
+                         table.schema)
+
+
+class TpuCpuFallbackExec(TpuExec):
+    def __init__(self, logical_plan, conf):
+        super().__init__((), logical_plan.schema)
+        self.logical_plan = logical_plan
+        self.conf = conf
+        self._parts = None
+
+    def _materialize(self):
+        if self._parts is None:
+            from spark_rapids_tpu.plan.cpu_engine import CpuEngine
+            engine = CpuEngine(self.conf.shuffle_partitions)
+            self._parts = engine.execute(self.logical_plan)
+        return self._parts
+
+    def num_partitions(self) -> int:
+        return max(len(self._materialize()), 1)
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        parts = self._materialize()
+        if idx >= len(parts):
+            return
+        t = parts[idx]
+        if t.num_rows == 0:
+            return
+        with timed(self.op_time):
+            batch = cpu_table_to_batch(t)
+        self.output_rows.add(batch.host_num_rows())
+        yield self._count_out(batch)
+
+    def describe(self):
+        return f"TpuCpuFallback[{self.logical_plan.node_name()}]"

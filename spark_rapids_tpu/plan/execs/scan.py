@@ -1,0 +1,65 @@
+"""Scan execs: in-memory and Parquet.
+
+Reference: GpuFileSourceScanExec + parquet/GpuParquetScan.scala.  The
+PERFILE/COALESCING/MULTITHREADED reader architecture is mirrored in
+io/parquet.py; this exec is the plan node gluing a relation to the engine.
+Host decode (pyarrow) happens OFF the device semaphore; only the HBM upload
+holds it — same discipline as the reference's multi-file readers, which
+assemble host buffers in CPU threads and only take the GPU semaphore for
+the device decode (GpuMultiFileReader.scala, GpuSemaphore.scala:240).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+
+
+class TpuInMemoryScanExec(TpuExec):
+    def __init__(self, partitions: List[List[ColumnarBatch]], schema: Schema):
+        super().__init__((), schema)
+        self.partitions = partitions
+
+    def num_partitions(self) -> int:
+        return max(len(self.partitions), 1)
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        if idx >= len(self.partitions):
+            return
+        for batch in self.partitions[idx]:
+            self.output_rows.add(batch.host_num_rows())
+            yield self._count_out(batch)
+
+    def describe(self):
+        return f"TpuInMemoryScan{self.schema!r}"
+
+
+class TpuParquetScanExec(TpuExec):
+    """One partition per file (PERFILE mode); the multi-threaded cloud
+    reader variant lives in io/parquet.py and slots in here."""
+
+    def __init__(self, paths: Sequence[str], schema: Schema,
+                 column_pruning=None, batch_size_rows: int = 1 << 20):
+        super().__init__((), schema)
+        self.paths = list(paths)
+        self.column_pruning = column_pruning
+        self.batch_size_rows = batch_size_rows
+
+    def num_partitions(self) -> int:
+        return max(len(self.paths), 1)
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        if idx >= len(self.paths):
+            return
+        from spark_rapids_tpu.io.parquet import read_parquet_batches
+        with timed(self.op_time):
+            for batch in read_parquet_batches(
+                    self.paths[idx],
+                    columns=list(self.column_pruning) if self.column_pruning else None,
+                    batch_size_rows=self.batch_size_rows):
+                self.output_rows.add(batch.host_num_rows())
+                yield self._count_out(batch)
+
+    def describe(self):
+        return f"TpuParquetScan[{len(self.paths)} files]"

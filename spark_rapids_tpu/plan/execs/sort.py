@@ -1,0 +1,112 @@
+"""Sort + limit execs.
+
+Reference: GpuSortExec.scala (:44 one-batch sort; out-of-core merge at :137
+is the follow-on once spillable pending queues land here), limit.scala.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import round_up_pow2
+from spark_rapids_tpu.expressions.core import EvalContext, Expression
+from spark_rapids_tpu.kernels.selection import concat_batches_device, gather_batch
+from spark_rapids_tpu.kernels.sort import SortOrder, sort_indices
+from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_split
+from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+
+
+class TpuSortExec(TpuExec):
+    """Sorts each partition (planner puts a single-partition exchange below
+    for global sorts; range partitioning is the scalable follow-on)."""
+
+    def __init__(self, orders: Sequence[Tuple[Expression, SortOrder]],
+                 child: TpuExec):
+        super().__init__((child,), child.schema)
+        self.orders = tuple(orders)
+
+        def run(batch: ColumnarBatch) -> ColumnarBatch:
+            ctx = EvalContext(batch)
+            key_cols = tuple(e.eval(ctx) for e, _ in self.orders)
+            work = ColumnarBatch(
+                tuple(batch.columns) + key_cols, batch.num_rows,
+                Schema(tuple(batch.schema.names) +
+                       tuple(f"_sk{i}" for i in range(len(key_cols))),
+                       tuple(batch.schema.dtypes) +
+                       tuple(c.dtype for c in key_cols)))
+            nbase = len(batch.schema)
+            idx = sort_indices(work, list(range(nbase, nbase + len(key_cols))),
+                               [o for _, o in self.orders], string_max_bytes=0)
+            sorted_work = gather_batch(work, idx, batch.num_rows)
+            return ColumnarBatch(sorted_work.columns[:nbase],
+                                 batch.num_rows, batch.schema)
+
+        self._run = jax.jit(run)
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        batches = list(self.children[0].execute_partition(idx))
+        if not batches:
+            return
+        with timed(self.op_time):
+            if len(batches) == 1:
+                merged = batches[0]
+            else:
+                total = sum(b.host_num_rows() for b in batches)
+                cap0 = round_up_pow2(max(total, 1))
+
+                def run(cap):
+                    return concat_batches_device(batches, cap)
+
+                def check(res):
+                    need = int(res[1].required_rows)
+                    return None if need <= res[0].capacity else need
+
+                merged, _ = with_capacity_retry(run, check, cap0)
+            out = with_retry_no_split(lambda: self._run(merged))
+        self.output_rows.add(out.host_num_rows())
+        yield self._count_out(out)
+
+    def describe(self):
+        inner = ", ".join(f"{e!r} {'ASC' if o.ascending else 'DESC'}"
+                          for e, o in self.orders)
+        return f"TpuSort[{inner}]"
+
+
+class TpuLimitExec(TpuExec):
+    """Global limit: take the first n rows across partitions in order."""
+
+    def __init__(self, n: int, child: TpuExec):
+        super().__init__((child,), child.schema)
+        self.n = n
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        remaining = self.n
+        child = self.children[0]
+        for p in range(child.num_partitions()):
+            if remaining <= 0:
+                return
+            for batch in child.execute_partition(p):
+                if remaining <= 0:
+                    return
+                nrows = batch.host_num_rows()
+                if nrows <= remaining:
+                    remaining -= nrows
+                    self.output_rows.add(nrows)
+                    yield self._count_out(batch)
+                else:
+                    take = remaining
+                    remaining = 0
+                    idx_arr = jnp.arange(batch.capacity, dtype=jnp.int32)
+                    out = gather_batch(batch, idx_arr, jnp.int32(take))
+                    self.output_rows.add(take)
+                    yield self._count_out(out)
+                    return
+
+    def describe(self):
+        return f"TpuLimit[{self.n}]"

@@ -1,0 +1,251 @@
+"""Logical plan: what the user asked for, engine-agnostic.
+
+The reference rides on Spark's Catalyst plans; this standalone framework
+carries its own minimal logical algebra with the same node vocabulary
+(Project/Filter/Aggregate/Sort/Join/Exchange...) so the planner layer can
+reproduce the reference's rewrite architecture (GpuOverrides.scala:4423
+wrapPlan -> tag -> convert) against it, and the CPU engine can interpret
+the same plans as the differential oracle.
+
+Schemas resolve eagerly: every node knows its output Schema at construction,
+so expression binding errors surface at plan time, not execute time.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions.core import Alias, Expression, output_name
+from spark_rapids_tpu.expressions.aggregates import find_aggregates
+from spark_rapids_tpu.kernels.sort import SortOrder
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def describe(self) -> str:
+        return self.node_name()
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.describe()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+
+class InMemoryRelation(LogicalPlan):
+    """Leaf: data already materialized as host/device batches, partitioned."""
+
+    def __init__(self, partitions: Sequence[List[ColumnarBatch]], schema: Schema):
+        self.partitions = list(partitions)
+        self._schema = schema
+        self.children = ()
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"InMemoryRelation{self._schema!r} x{len(self.partitions)} partitions"
+
+
+class ParquetRelation(LogicalPlan):
+    """Leaf: parquet files on disk (or object store)."""
+
+    def __init__(self, paths: Sequence[str], schema: Schema,
+                 column_pruning: Optional[Tuple[str, ...]] = None):
+        self.paths = tuple(paths)
+        self._schema = schema
+        self.column_pruning = column_pruning
+        self.children = ()
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"ParquetRelation[{len(self.paths)} files]{self._schema!r}"
+
+
+class Project(LogicalPlan):
+    def __init__(self, exprs: Sequence[Expression], child: LogicalPlan):
+        self.exprs = tuple(e.bind(child.schema) for e in exprs)
+        self.child = child
+        self.children = (child,)
+        names = tuple(output_name(e, i) for i, e in enumerate(exprs))
+        self._schema = Schema(names, tuple(e.dtype for e in self.exprs))
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"Project[{', '.join(map(repr, self.exprs))}]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        self.condition = condition.bind(child.schema)
+        if not isinstance(self.condition.dtype, T.BooleanType):
+            raise TypeError(f"filter condition must be boolean, got "
+                            f"{self.condition.dtype!r}")
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return f"Filter[{self.condition!r}]"
+
+
+class Aggregate(LogicalPlan):
+    """Group-by aggregate.  agg_exprs are output expressions that may mix
+    aggregate calls and (for grouped aggs) grouping refs, e.g.
+    Alias(Sum(col('x') * 2) / Count(col('x')), 'r')."""
+
+    def __init__(self, group_exprs: Sequence[Expression],
+                 agg_exprs: Sequence[Expression], child: LogicalPlan):
+        self.group_exprs = tuple(e.bind(child.schema) for e in group_exprs)
+        self.agg_exprs = tuple(e.bind(child.schema) for e in agg_exprs)
+        self.child = child
+        self.children = (child,)
+        names = []
+        dtypes = []
+        for i, e in enumerate(list(group_exprs) + list(agg_exprs)):
+            names.append(output_name(e, i))
+        for e in list(self.group_exprs) + list(self.agg_exprs):
+            dtypes.append(e.dtype)
+        self._schema = Schema(tuple(names), tuple(dtypes))
+        self.aggregates = [a for e in self.agg_exprs for a in find_aggregates(e)]
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return (f"Aggregate[keys=[{', '.join(map(repr, self.group_exprs))}], "
+                f"aggs=[{', '.join(map(repr, self.agg_exprs))}]]")
+
+
+class Sort(LogicalPlan):
+    def __init__(self, orders: Sequence[Tuple[Expression, SortOrder]],
+                 child: LogicalPlan, global_sort: bool = True):
+        self.orders = tuple((e.bind(child.schema), o) for e, o in orders)
+        self.global_sort = global_sort
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    def describe(self):
+        inner = ", ".join(f"{e!r} {'ASC' if o.ascending else 'DESC'}"
+                          for e, o in self.orders)
+        return f"Sort[{inner}]{'' if self.global_sort else ' (per-partition)'}"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        self.n = n
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return f"Limit[{self.n}]"
+
+
+JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti", "cross")
+
+
+class Join(LogicalPlan):
+    """Equi-join on key expression pairs plus optional residual condition."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence[Expression], right_keys: Sequence[Expression],
+                 join_type: str = "inner",
+                 condition: Optional[Expression] = None):
+        assert join_type in JOIN_TYPES, join_type
+        self.left = left
+        self.right = right
+        self.left_keys = tuple(e.bind(left.schema) for e in left_keys)
+        self.right_keys = tuple(e.bind(right.schema) for e in right_keys)
+        self.join_type = join_type
+        self.children = (left, right)
+        self._schema = self._output_schema()
+        self.condition = (condition.bind(self._schema)
+                          if condition is not None else None)
+
+    def _output_schema(self) -> Schema:
+        if self.join_type in ("left_semi", "left_anti"):
+            return self.left.schema
+        names = list(self.left.schema.names)
+        dtypes = list(self.left.schema.dtypes)
+        for n, d in zip(self.right.schema.names, self.right.schema.dtypes):
+            # disambiguate duplicate names Spark-style suffixing is caller's
+            # job; keep both with the same name is allowed in Spark
+            names.append(n)
+            dtypes.append(d)
+        return Schema(tuple(names), tuple(dtypes))
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        keys = ", ".join(f"{l!r}={r!r}" for l, r in
+                         zip(self.left_keys, self.right_keys))
+        cond = f", cond={self.condition!r}" if self.condition is not None else ""
+        return f"Join[{self.join_type}, {keys}{cond}]"
+
+
+class Union(LogicalPlan):
+    def __init__(self, plans: Sequence[LogicalPlan]):
+        assert plans
+        first = plans[0].schema
+        for p in plans[1:]:
+            if tuple(p.schema.dtypes) != tuple(first.dtypes):
+                raise TypeError("UNION inputs must have identical schemas")
+        self.children = tuple(plans)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Union[{len(self.children)}]"
+
+
+class Repartition(LogicalPlan):
+    """Exchange: hash-partition child rows into num_partitions by keys
+    (round-robin when keys empty)."""
+
+    def __init__(self, num_partitions: int, keys: Sequence[Expression],
+                 child: LogicalPlan):
+        self.num_partitions = num_partitions
+        self.keys = tuple(e.bind(child.schema) for e in keys)
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return (f"Repartition[{self.num_partitions}, "
+                f"keys=[{', '.join(map(repr, self.keys))}]]")

@@ -1,0 +1,1 @@
+from spark_rapids_tpu.planner.overrides import PlanMeta, explain_query, plan_query

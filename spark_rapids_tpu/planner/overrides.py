@@ -1,0 +1,278 @@
+"""Plan rewrite: logical plan -> TPU physical plan with tagging + fallback.
+
+The reference's architecture reproduced: a meta tree wraps every plan node
+and expression (RapidsMeta.scala:648/:1112), tagging collects can't-run
+reasons (willNotWorkOnGpu, RapidsMeta.scala:324), explain prints per-node
+"will/won't run" lines (GpuOverrides.scala:5138-5147), and conversion emits
+the TPU exec tree (convertToGpu).  Unsupported subtrees fall back to the CPU
+oracle engine with an upload boundary — the analog of leaving Catalyst nodes
+on CPU with row/columnar transitions inserted (GpuTransitionOverrides).
+
+Two-phase aggregates and exchanges are planned here the way Spark+reference
+plan them: partial agg -> hash exchange on keys -> final agg; global sort
+gets a single-partition exchange below it (range partitioning is the
+follow-on).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expressions import core as E
+from spark_rapids_tpu.expressions import aggregates as A
+from spark_rapids_tpu.expressions.arithmetic import (
+    Abs, Add, Divide, IntegralDivide, Multiply, Remainder, Subtract, UnaryMinus)
+from spark_rapids_tpu.expressions.casts import Cast
+from spark_rapids_tpu.expressions.conditional import CaseWhen, If
+from spark_rapids_tpu.expressions.predicates import (
+    And, Coalesce, EqualNullSafe, EqualTo, GreaterThan, GreaterThanOrEqual,
+    In, IsNotNull, IsNull, LessThan, LessThanOrEqual, Not, Or)
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.execs.base import TpuExec
+from spark_rapids_tpu.plan.execs.basic import (
+    TpuFilterExec, TpuProjectExec, TpuUnionExec)
+from spark_rapids_tpu.plan.execs.aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.plan.execs.exchange import (
+    TpuShuffleExchangeExec, TpuSinglePartitionExec)
+from spark_rapids_tpu.plan.execs.scan import (
+    TpuInMemoryScanExec, TpuParquetScanExec)
+from spark_rapids_tpu.plan.execs.sort import TpuLimitExec, TpuSortExec
+
+# expression classes with device twins; the TypeSig-style dtype gate is
+# checked separately (supported_dtype)
+_SUPPORTED_EXPRS = {
+    E.Alias, E.BoundReference, E.Literal,
+    Add, Subtract, Multiply, Divide, IntegralDivide, Remainder, UnaryMinus, Abs,
+    And, Or, Not, IsNull, IsNotNull, In, Coalesce,
+    EqualTo, EqualNullSafe, LessThan, LessThanOrEqual, GreaterThan,
+    GreaterThanOrEqual,
+    If, CaseWhen, Cast,
+    A.Sum, A.Count, A.Min, A.Max, A.Average,
+}
+
+# dtypes device kernels fully support in compute today (strings flow through
+# scans/shuffles/sorts but string *functions* are still landing)
+_COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
+               T.LongType, T.FloatType, T.DoubleType, T.DateType,
+               T.TimestampType, T.NullType)
+
+
+def _dtype_ok(dt: T.DataType) -> bool:
+    return isinstance(dt, _COMPUTE_OK)
+
+
+class ExprMeta:
+    """BaseExprMeta analog: tags one expression node."""
+
+    def __init__(self, expr: E.Expression):
+        self.expr = expr
+        self.children = [ExprMeta(c) for c in expr.children]
+        self.reasons: List[str] = []
+
+    def will_not_work(self, reason: str) -> None:
+        self.reasons.append(reason)
+
+    def tag(self) -> None:
+        e = self.expr
+        if type(e) not in _SUPPORTED_EXPRS:
+            self.will_not_work(f"expression {type(e).__name__} is not supported")
+        else:
+            try:
+                if not _dtype_ok(e.dtype):
+                    self.will_not_work(
+                        f"produces unsupported type {e.dtype!r}")
+            except (TypeError, NotImplementedError):
+                pass
+            if isinstance(e, Cast) and not Cast.supported(e.child.dtype, e.dtype):
+                self.will_not_work(
+                    f"cast {e.child.dtype!r} -> {e.dtype!r} is not supported")
+        for c in self.children:
+            c.tag()
+
+    @property
+    def can_run(self) -> bool:
+        return not self.reasons and all(c.can_run for c in self.children)
+
+    def explain_lines(self, prefix: str = "") -> List[str]:
+        out = []
+        for r in self.reasons:
+            out.append(f"{prefix}!Expression {self.expr!r} cannot run on TPU "
+                       f"because {r}")
+        for c in self.children:
+            out.extend(c.explain_lines(prefix))
+        return out
+
+
+class PlanMeta:
+    """SparkPlanMeta analog: tags one plan node and its expressions."""
+
+    def __init__(self, plan: L.LogicalPlan, conf: RapidsConf):
+        self.plan = plan
+        self.conf = conf
+        self.children = [PlanMeta(c, conf) for c in plan.children]
+        self.reasons: List[str] = []
+        self.expr_metas: List[ExprMeta] = [
+            ExprMeta(e) for e in self._expressions()]
+
+    def _expressions(self) -> List[E.Expression]:
+        p = self.plan
+        if isinstance(p, L.Project):
+            return list(p.exprs)
+        if isinstance(p, L.Filter):
+            return [p.condition]
+        if isinstance(p, L.Aggregate):
+            return list(p.group_exprs) + list(p.agg_exprs)
+        if isinstance(p, L.Sort):
+            return [e for e, _ in p.orders]
+        if isinstance(p, L.Repartition):
+            return list(p.keys)
+        if isinstance(p, L.Join):
+            out = list(p.left_keys) + list(p.right_keys)
+            if p.condition is not None:
+                out.append(p.condition)
+            return out
+        return []
+
+    def will_not_work(self, reason: str) -> None:
+        self.reasons.append(reason)
+
+    def tag(self) -> None:
+        p = self.plan
+        for em in self.expr_metas:
+            em.tag()
+        if isinstance(p, L.Join):
+            self.will_not_work("join execution on TPU is not implemented yet")
+        if isinstance(p, L.Aggregate):
+            for e in p.group_exprs:
+                if not _dtype_ok(e.dtype):
+                    self.will_not_work(
+                        f"grouping key type {e.dtype!r} not supported yet")
+            for e in p.agg_exprs:
+                for sub in _non_agg_leaf_refs(e):
+                    self.will_not_work(
+                        f"non-aggregate column {sub!r} in aggregate output")
+        if isinstance(p, L.Sort):
+            for e, _ in p.orders:
+                if not _dtype_ok(e.dtype):
+                    self.will_not_work(
+                        f"sort key type {e.dtype!r} not supported yet")
+        if isinstance(p, L.Repartition):
+            for e in p.keys:
+                if not _dtype_ok(e.dtype):
+                    self.will_not_work(
+                        f"partition key type {e.dtype!r} not supported yet")
+        for c in self.children:
+            c.tag()
+
+    @property
+    def this_can_run(self) -> bool:
+        return not self.reasons and all(em.can_run for em in self.expr_metas)
+
+    @property
+    def can_run(self) -> bool:
+        return self.this_can_run and all(c.can_run for c in self.children)
+
+    def explain(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        mark = "*" if self.this_can_run else "!"
+        lines = [f"{pad}{mark}Exec <{self.plan.node_name()}> "
+                 f"{'will' if self.this_can_run else 'will NOT'} run on TPU"]
+        for r in self.reasons:
+            lines.append(f"{pad}  @reason: {r}")
+        for em in self.expr_metas:
+            lines.extend(em.explain_lines(pad + "  "))
+        for c in self.children:
+            lines.append(c.explain(indent + 1))
+        return "\n".join(lines)
+
+    # -- conversion ---------------------------------------------------------
+
+    def convert(self) -> TpuExec:
+        """Emit the physical plan: TPU execs where possible, CPU-fallback
+        islands elsewhere."""
+        if not self.this_can_run:
+            return self._fallback()
+        p = self.plan
+        if isinstance(p, L.InMemoryRelation):
+            return TpuInMemoryScanExec(p.partitions, p.schema)
+        if isinstance(p, L.ParquetRelation):
+            return TpuParquetScanExec(p.paths, p.schema, p.column_pruning,
+                                      self.conf.batch_size_rows)
+        if isinstance(p, L.Project):
+            child = self.children[0].convert()
+            return TpuProjectExec(p.exprs, child, p.schema)
+        if isinstance(p, L.Filter):
+            return TpuFilterExec(p.condition, self.children[0].convert())
+        if isinstance(p, L.Union):
+            return TpuUnionExec(tuple(c.convert() for c in self.children),
+                                p.schema)
+        if isinstance(p, L.Limit):
+            return TpuLimitExec(p.n, self.children[0].convert())
+        if isinstance(p, L.Repartition):
+            return TpuShuffleExchangeExec(p.num_partitions, p.keys,
+                                          self.children[0].convert())
+        if isinstance(p, L.Sort):
+            child = self.children[0].convert()
+            if p.global_sort and child.num_partitions() > 1:
+                child = TpuSinglePartitionExec(child)
+            return TpuSortExec(p.orders, child)
+        if isinstance(p, L.Aggregate):
+            return self._convert_aggregate(p)
+        return self._fallback()
+
+    def _convert_aggregate(self, p: L.Aggregate) -> TpuExec:
+        child = self.children[0].convert()
+        single = child.num_partitions() == 1
+        if single:
+            return TpuHashAggregateExec(
+                p.group_exprs, p.agg_exprs, p.aggregates, child, p.schema,
+                mode="complete")
+        partial = TpuHashAggregateExec(
+            p.group_exprs, p.agg_exprs, p.aggregates, child, p.schema,
+            mode="partial")
+        if p.group_exprs:
+            nkeys = len(p.group_exprs)
+            key_refs = [E.BoundReference(i, p.group_exprs[i].dtype, f"_k{i}")
+                        for i in range(nkeys)]
+            exchange: TpuExec = TpuShuffleExchangeExec(
+                self.conf.shuffle_partitions, key_refs, partial)
+        else:
+            exchange = TpuSinglePartitionExec(partial)
+        return TpuHashAggregateExec(
+            p.group_exprs, p.agg_exprs, p.aggregates, exchange, p.schema,
+            mode="final")
+
+    def _fallback(self) -> TpuExec:
+        from spark_rapids_tpu.plan.execs.fallback import TpuCpuFallbackExec
+        return TpuCpuFallbackExec(self.plan, self.conf)
+
+
+def _non_agg_leaf_refs(e: E.Expression) -> List[E.Expression]:
+    """Column refs in agg output exprs that are outside aggregate calls."""
+    if isinstance(e, A.AggregateFunction):
+        return []
+    if isinstance(e, (E.BoundReference, E.Col)):
+        return [e]
+    out = []
+    for c in e.children:
+        out.extend(_non_agg_leaf_refs(c))
+    return out
+
+
+def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
+               ) -> Tuple[TpuExec, PlanMeta]:
+    """wrapAndTagPlan + convert (GpuOverrides.scala:4423,:5148 analog)."""
+    conf = conf or RapidsConf()
+    meta = PlanMeta(plan, conf)
+    meta.tag()
+    exec_plan = meta.convert()
+    return exec_plan, meta
+
+
+def explain_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None) -> str:
+    conf = conf or RapidsConf()
+    meta = PlanMeta(plan, conf)
+    meta.tag()
+    return meta.explain()

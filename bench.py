@@ -1,0 +1,56 @@
+"""Benchmark: TPC-H q6 throughput on the TPU engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: q6 rows/sec through the full engine path (filter + aggregate over
+generated lineitem, SURVEY.md §6 gate #1).  vs_baseline is the speedup over
+the CPU oracle engine executing the same logical plan on the same data —
+the stand-in for CPU Spark until a cluster baseline exists (the reference
+repo itself publishes no absolute numbers, BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.testing import tpch
+
+    n_rows = 2_000_000
+    batches = tpch.gen_lineitem(n_rows, batch_rows=1 << 19)
+
+    tpu_sess = TpuSession({"spark.rapids.sql.enabled": "true"})
+    cpu_sess = TpuSession({"spark.rapids.sql.enabled": "false"})
+
+    def run(sess):
+        df = tpch.q6(sess.create_dataframe(list(batches), num_partitions=2))
+        return df.collect()
+
+    # warmup (compile) + correctness cross-check
+    tpu_rows = run(tpu_sess)
+    t0 = time.perf_counter()
+    tpu_rows = run(tpu_sess)
+    tpu_time = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    cpu_rows = run(cpu_sess)
+    cpu_time = time.perf_counter() - t0
+
+    assert abs(tpu_rows[0][0] - cpu_rows[0][0]) < 1e-6 * abs(cpu_rows[0][0]), \
+        (tpu_rows, cpu_rows)
+
+    rows_per_sec = n_rows / tpu_time
+    print(json.dumps({
+        "metric": "tpch_q6_rows_per_sec",
+        "value": round(rows_per_sec),
+        "unit": "rows/s",
+        "vs_baseline": round(cpu_time / tpu_time, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

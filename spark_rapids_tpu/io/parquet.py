@@ -70,7 +70,7 @@ def read_parquet_batches(
     groups: List[int] = []
     meta = pf.metadata
     name_to_idx = {meta.schema.column(i).name: i
-                   for i in range(meta.schema.num_columns)}
+                   for i in range(len(meta.schema))}
     for rg in range(meta.num_row_groups):
         row_group = meta.row_group(rg)
         keep = True

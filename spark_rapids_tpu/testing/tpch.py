@@ -1,0 +1,130 @@
+"""Deterministic TPC-H-style data generation + query definitions.
+
+The analog of the reference's datagen module (datagen/.../bigDataGen.scala:
+deterministic, seed-stable, skew-controllable data for scale tests) plus
+the mortgage/scaletest benchmark harness role
+(integration_tests/.../mortgage/MortgageSpark.scala).
+
+Column value distributions follow the TPC-H spec shapes (uniform discounts
+0.00-0.10, quantities 1-50, shipdate 1992-1998) so selectivities match the
+official queries; this is generation from the spec, not a copy of any
+generator code.
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def _days(y, m, d) -> int:
+    return (datetime.date(y, m, d) - EPOCH).days
+
+
+LINEITEM_SCHEMA = Schema.of(
+    l_orderkey=T.LONG,
+    l_partkey=T.LONG,
+    l_suppkey=T.LONG,
+    l_linenumber=T.INT,
+    l_quantity=T.DOUBLE,
+    l_extendedprice=T.DOUBLE,
+    l_discount=T.DOUBLE,
+    l_tax=T.DOUBLE,
+    l_shipdate=T.DATE,
+    l_commitdate=T.DATE,
+    l_receiptdate=T.DATE,
+)
+
+# TPC-H SF1 lineitem is ~6M rows; rows_per_sf lets tests dial size down
+ROWS_PER_SF = 6_001_215
+
+
+def gen_lineitem(num_rows: int, seed: int = 42,
+                 batch_rows: int = 1 << 20) -> List[ColumnarBatch]:
+    """Generate lineitem batches with TPC-H value distributions."""
+    out = []
+    remaining = num_rows
+    chunk_id = 0
+    while remaining > 0:
+        n = min(batch_rows, remaining)
+        rng = np.random.RandomState(seed + chunk_id * 7919)
+        orderkey = rng.randint(1, max(num_rows // 4, 2), n).astype(np.int64)
+        partkey = rng.randint(1, 200_000, n).astype(np.int64)
+        suppkey = rng.randint(1, 10_000, n).astype(np.int64)
+        linenumber = rng.randint(1, 8, n).astype(np.int32)
+        quantity = rng.randint(1, 51, n).astype(np.float64)
+        extendedprice = np.round(rng.uniform(900.0, 105_000.0, n), 2)
+        discount = np.round(rng.randint(0, 11, n) * 0.01, 2)
+        tax = np.round(rng.randint(0, 9, n) * 0.01, 2)
+        ship_lo, ship_hi = _days(1992, 1, 2), _days(1998, 12, 1)
+        shipdate = rng.randint(ship_lo, ship_hi, n).astype(np.int32)
+        commitdate = shipdate + rng.randint(-30, 31, n).astype(np.int32)
+        receiptdate = shipdate + rng.randint(1, 31, n).astype(np.int32)
+        cols = {
+            "l_orderkey": orderkey,
+            "l_partkey": partkey,
+            "l_suppkey": suppkey,
+            "l_linenumber": linenumber,
+            "l_quantity": quantity,
+            "l_extendedprice": extendedprice,
+            "l_discount": discount,
+            "l_tax": tax,
+            "l_shipdate": shipdate,
+            "l_commitdate": commitdate,
+            "l_receiptdate": receiptdate,
+        }
+        from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
+        import jax.numpy as jnp
+        cap = round_up_pow2(n)
+        device_cols = tuple(
+            DeviceColumn.from_numpy(cols[name], dt, capacity=cap)
+            for name, dt in zip(LINEITEM_SCHEMA.names, LINEITEM_SCHEMA.dtypes))
+        out.append(ColumnarBatch(device_cols, jnp.asarray(n, jnp.int32),
+                                 LINEITEM_SCHEMA))
+        remaining -= n
+        chunk_id += 1
+    return out
+
+
+def q6(df):
+    """TPC-H Q6: forecast revenue change.
+
+    select sum(l_extendedprice * l_discount) as revenue from lineitem
+    where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01'
+      and l_discount between 0.05 and 0.07 and l_quantity < 24
+    """
+    from spark_rapids_tpu.expressions import col, lit, sum_
+    d94 = _days(1994, 1, 1)
+    d95 = _days(1995, 1, 1)
+    return (df.filter(
+                (col("l_shipdate") >= lit(d94, T.DATE))
+                & (col("l_shipdate") < lit(d95, T.DATE))
+                & (col("l_discount") >= lit(0.05))
+                & (col("l_discount") <= lit(0.07))
+                & (col("l_quantity") < lit(24.0)))
+            .agg((sum_(col("l_extendedprice") * col("l_discount")))
+                 .alias("revenue")))
+
+
+def q1(df):
+    """TPC-H Q1: pricing summary report (scan + filter + wide group-agg)."""
+    from spark_rapids_tpu.expressions import avg, col, count, lit, sum_
+    cutoff = _days(1998, 9, 2)
+    disc_price = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    charge = disc_price * (lit(1.0) + col("l_tax"))
+    return (df.filter(col("l_shipdate") <= lit(cutoff, T.DATE))
+            .group_by("l_linenumber")     # stand-in flags until strings land
+            .agg(sum_("l_quantity").alias("sum_qty"),
+                 sum_("l_extendedprice").alias("sum_base_price"),
+                 sum_(disc_price).alias("sum_disc_price"),
+                 sum_(charge).alias("sum_charge"),
+                 avg("l_quantity").alias("avg_qty"),
+                 avg("l_extendedprice").alias("avg_price"),
+                 avg("l_discount").alias("avg_disc"),
+                 count().alias("count_order")))

@@ -1,0 +1,81 @@
+"""TPC-H differential tests — Milestone A of SURVEY.md §7: q6 bit-identical
+between the TPU engine and the CPU oracle, under the pytest differential
+harness, plus q1 (wide grouped agg) and the parquet round trip."""
+import os
+
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.testing import tpch
+from tests.test_queries import assert_tpu_cpu_equal
+
+N_ROWS = 50_000
+
+
+def lineitem_df(sess, num_partitions=3):
+    batches = tpch.gen_lineitem(N_ROWS, batch_rows=N_ROWS // 4 + 1)
+    return sess.create_dataframe(batches, num_partitions=num_partitions)
+
+
+def test_q6():
+    rows = assert_tpu_cpu_equal(lambda s: tpch.q6(lineitem_df(s)))
+    assert len(rows) == 1
+    assert rows[0][0] is not None and rows[0][0] > 0
+
+
+def test_q1():
+    rows = assert_tpu_cpu_equal(lambda s: tpch.q1(lineitem_df(s)))
+    assert len(rows) == 7  # linenumbers 1..7
+
+
+@pytest.mark.inject_oom
+def test_q6_with_injected_oom():
+    assert_tpu_cpu_equal(lambda s: tpch.q6(lineitem_df(s)))
+
+
+def test_q6_from_parquet(tmp_path):
+    from spark_rapids_tpu.io.parquet import write_parquet
+    batches = tpch.gen_lineitem(N_ROWS, batch_rows=N_ROWS // 3 + 1)
+    path = os.path.join(tmp_path, "lineitem.parquet")
+    write_parquet(batches, path)
+
+    def build(s):
+        return tpch.q6(s.read_parquet(path))
+
+    rows = assert_tpu_cpu_equal(build)
+    assert len(rows) == 1
+
+
+def test_parquet_roundtrip(tmp_path):
+    from spark_rapids_tpu.io.parquet import read_parquet_batches, write_parquet
+    from spark_rapids_tpu.plan.cpu_engine import CpuTable
+    batches = tpch.gen_lineitem(5_000, batch_rows=1_500)
+    path = os.path.join(tmp_path, "rt.parquet")
+    assert write_parquet(batches, path) == 5_000
+    back = list(read_parquet_batches(path, batch_size_rows=2_000))
+    orig_rows = [r for b in batches for r in CpuTable.from_batch(b).rows()]
+    back_rows = [r for b in back for r in CpuTable.from_batch(b).rows()]
+    assert orig_rows == back_rows
+
+
+def test_parquet_row_group_pruning(tmp_path):
+    """min/max stats pruning mirrors the reference's footer filter."""
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.io.parquet import read_parquet_batches, write_parquet
+    batches = tpch.gen_lineitem(40_000, batch_rows=10_000)
+    path = os.path.join(tmp_path, "pruned.parquet")
+    # one row group per batch
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.arrow import batch_to_arrow
+    writer = None
+    for b in batches:
+        t = batch_to_arrow(b)
+        if writer is None:
+            writer = pq.ParquetWriter(path, t.schema)
+        writer.write_table(t, row_group_size=10_000)
+    writer.close()
+    all_batches = list(read_parquet_batches(path))
+    pruned = list(read_parquet_batches(
+        path, range_filters={"l_orderkey": (10**12, None)}))
+    assert sum(b.host_num_rows() for b in all_batches) == 40_000
+    assert pruned == []  # no row group can contain such keys

@@ -1,0 +1,197 @@
+// Shuffle wire-format serializer ("tpu-kudo").
+//
+// The native analog of the reference's Kudo serializer
+// (spark-rapids-jni kudo::KudoSerializer, consumed via
+// GpuColumnarBatchSerializer.scala:169-189 and merged via
+// jni/kudo/KudoHostMergeResultWrapper.scala): a compact columnar batch
+// wire format with a cheap concat-merge, sitting on the shuffle hot path.
+// Design is original; only the role matches.
+//
+// Layout (little-endian):
+//   header:  magic u32 'TKD1' | num_cols u32 | num_rows u64 | col metas
+//   per col: dtype_code u8 | has_offsets u8 | pad u16 |
+//            validity_bytes u64 | offsets_bytes u64 | data_bytes u64
+//   body:    per col: validity bitmap (1 bit/row, LSB first) |
+//            offsets (i32 (rows+1), only if has_offsets) | data bytes
+//
+// Validity is bit-packed on the wire (8x smaller than the bool arrays the
+// device uses), mirroring the reference's choice of compact wire masks.
+//
+// Exported C ABI (ctypes-friendly):
+//   tk_serialized_size, tk_serialize      one batch -> wire buffer
+//   tk_merge_size, tk_merge               N wire buffers -> one batch's
+//                                         host arrays (concat merge)
+//   tk_row_count, tk_col_count            header peeks
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static const uint32_t TK_MAGIC = 0x54414431u;  // 'TAD1'
+
+struct TkCol {
+  const uint8_t* validity;   // bool per row (as bytes), length num_rows
+  const int32_t* offsets;    // rows+1 entries or nullptr
+  const uint8_t* data;       // data_bytes payload
+  uint64_t data_bytes;       // fixed: rows*width; strings: offsets[rows]
+  uint8_t dtype_code;
+};
+
+static uint64_t bitmap_bytes(uint64_t rows) { return (rows + 7) / 8; }
+
+static uint64_t col_body_bytes(const TkCol* c, uint64_t rows) {
+  uint64_t n = bitmap_bytes(rows) + c->data_bytes;
+  if (c->offsets) n += (rows + 1) * sizeof(int32_t);
+  return n;
+}
+
+uint64_t tk_serialized_size(const TkCol* cols, uint32_t num_cols,
+                            uint64_t rows) {
+  uint64_t n = 16 + (uint64_t)num_cols * 28;
+  for (uint32_t i = 0; i < num_cols; i++) n += col_body_bytes(&cols[i], rows);
+  return n;
+}
+
+// Serialize one batch.  Returns bytes written.
+uint64_t tk_serialize(const TkCol* cols, uint32_t num_cols, uint64_t rows,
+                      uint8_t* out) {
+  uint8_t* p = out;
+  memcpy(p, &TK_MAGIC, 4); p += 4;
+  memcpy(p, &num_cols, 4); p += 4;
+  memcpy(p, &rows, 8); p += 8;
+  for (uint32_t i = 0; i < num_cols; i++) {
+    const TkCol* c = &cols[i];
+    uint8_t has_off = c->offsets ? 1 : 0;
+    uint16_t pad = 0;
+    uint64_t vb = bitmap_bytes(rows);
+    uint64_t ob = has_off ? (rows + 1) * sizeof(int32_t) : 0;
+    memcpy(p, &c->dtype_code, 1); p += 1;
+    memcpy(p, &has_off, 1); p += 1;
+    memcpy(p, &pad, 2); p += 2;
+    memcpy(p, &vb, 8); p += 8;
+    memcpy(p, &ob, 8); p += 8;
+    memcpy(p, &c->data_bytes, 8); p += 8;
+  }
+  for (uint32_t i = 0; i < num_cols; i++) {
+    const TkCol* c = &cols[i];
+    uint64_t vb = bitmap_bytes(rows);
+    memset(p, 0, vb);
+    for (uint64_t r = 0; r < rows; r++)
+      if (c->validity[r]) p[r >> 3] |= (uint8_t)(1u << (r & 7));
+    p += vb;
+    if (c->offsets) {
+      memcpy(p, c->offsets, (rows + 1) * sizeof(int32_t));
+      p += (rows + 1) * sizeof(int32_t);
+    }
+    memcpy(p, c->data, c->data_bytes);
+    p += c->data_bytes;
+  }
+  return (uint64_t)(p - out);
+}
+
+uint64_t tk_row_count(const uint8_t* buf) {
+  uint64_t rows; memcpy(&rows, buf + 8, 8); return rows;
+}
+
+uint32_t tk_col_count(const uint8_t* buf) {
+  uint32_t n; memcpy(&n, buf + 4, 4); return n;
+}
+
+// ---- merge ---------------------------------------------------------------
+
+struct TkView {                 // parsed per-column view into a wire buffer
+  const uint8_t* validity_bits;
+  const int32_t* offsets;
+  const uint8_t* data;
+  uint64_t data_bytes;
+  uint8_t dtype_code;
+  uint8_t has_offsets;
+};
+
+static void parse(const uint8_t* buf, uint32_t num_cols, uint64_t rows,
+                  TkView* views) {
+  const uint8_t* meta = buf + 16;
+  const uint8_t* body = meta + (uint64_t)num_cols * 28;
+  for (uint32_t i = 0; i < num_cols; i++) {
+    const uint8_t* m = meta + (uint64_t)i * 28;
+    TkView* v = &views[i];
+    memcpy(&v->dtype_code, m, 1);
+    memcpy(&v->has_offsets, m + 1, 1);
+    uint64_t vb, ob, db;
+    memcpy(&vb, m + 4, 8);
+    memcpy(&ob, m + 12, 8);
+    memcpy(&db, m + 20, 8);
+    v->validity_bits = body;
+    v->offsets = v->has_offsets ? (const int32_t*)(body + vb) : nullptr;
+    v->data = body + vb + ob;
+    v->data_bytes = db;
+    body += vb + ob + db;
+  }
+}
+
+// Output arrays for one merged column (caller-allocated, capacity-padded
+// with zeros: the canonical-padding contract the device columns require).
+struct TkOut {
+  uint8_t* validity;     // bool bytes [row_capacity]
+  int32_t* offsets;      // [row_capacity+1] or nullptr
+  uint8_t* data;         // [data_capacity]
+  uint64_t row_capacity;
+  uint64_t data_capacity;
+};
+
+// Total rows / per-col data bytes across buffers (for sizing the merge).
+void tk_merge_size(const uint8_t** bufs, uint32_t n_bufs,
+                   uint64_t* total_rows, uint64_t* col_data_bytes /*[cols]*/) {
+  *total_rows = 0;
+  uint32_t cols = n_bufs ? tk_col_count(bufs[0]) : 0;
+  for (uint32_t c = 0; c < cols; c++) col_data_bytes[c] = 0;
+  for (uint32_t b = 0; b < n_bufs; b++) {
+    uint64_t rows = tk_row_count(bufs[b]);
+    *total_rows += rows;
+    TkView views[256];
+    parse(bufs[b], cols, rows, views);
+    for (uint32_t c = 0; c < cols; c++) col_data_bytes[c] += views[c].data_bytes;
+  }
+}
+
+// Concat-merge wire buffers into host column arrays (the reference's
+// KudoHostMerge step).  Returns merged row count.
+uint64_t tk_merge(const uint8_t** bufs, uint32_t n_bufs, TkOut* outs,
+                  uint32_t num_cols) {
+  uint64_t row_base = 0;
+  uint64_t* data_base = new uint64_t[num_cols]();
+  for (uint32_t b = 0; b < n_bufs; b++) {
+    uint64_t rows = tk_row_count(bufs[b]);
+    TkView views[256];
+    parse(bufs[b], num_cols, rows, views);
+    for (uint32_t c = 0; c < num_cols; c++) {
+      const TkView* v = &views[c];
+      TkOut* o = &outs[c];
+      for (uint64_t r = 0; r < rows; r++)
+        o->validity[row_base + r] =
+            (v->validity_bits[r >> 3] >> (r & 7)) & 1;
+      if (v->offsets && o->offsets) {
+        int32_t base = (int32_t)data_base[c];
+        for (uint64_t r = 0; r < rows; r++)
+          o->offsets[row_base + r + 1] = v->offsets[r + 1] + base;
+      }
+      memcpy(o->data + data_base[c], v->data, v->data_bytes);
+      data_base[c] += v->data_bytes;
+    }
+    row_base += rows;
+  }
+  // flatten offsets over the padding tail
+  for (uint32_t c = 0; c < num_cols; c++) {
+    TkOut* o = &outs[c];
+    if (o->offsets) {
+      int32_t last = o->offsets[row_base];
+      for (uint64_t r = row_base; r < o->row_capacity; r++)
+        o->offsets[r + 1] = last;
+    }
+  }
+  delete[] data_base;
+  return row_base;
+}
+
+}  // extern "C"

@@ -1,0 +1,126 @@
+// Row <-> columnar converters.
+//
+// Native analog of the reference's RowConversion JNI kernels
+// (com.nvidia.spark.rapids.jni.RowConversion, consumed by
+// GpuRowToColumnarExec.scala:577 / GpuColumnarToRowExec.scala:251): the
+// row/column boundary is a hot path and must not be a Python loop.
+//
+// Row format ("TRow", UnsafeRow-inspired but original): per row
+//   null bitset  : ceil(nfields/8) bytes, bit f set = field f IS NULL
+//   fixed section: 8 bytes per field; fixed-width values are stored
+//                  zero-extended; variable-width fields store
+//                  (u32 offset | u32 length) packed in the slot, offset
+//                  relative to the row start
+//   var section  : variable bytes, 8-byte aligned row end
+//
+// Exported C ABI: trow_sizes / trow_from_columns / trow_to_columns.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+struct RcCol {
+  uint8_t* validity;      // bool bytes [capacity]
+  int32_t* offsets;       // [capacity+1] or nullptr (fixed width)
+  uint8_t* data;          // fixed: capacity*width; var: byte buffer
+  uint32_t byte_width;    // fixed-width element size (0 for var)
+};
+
+static uint64_t align8(uint64_t n) { return (n + 7) & ~7ull; }
+
+// Per-row total sizes for a batch (fills row_sizes[rows]); returns total.
+uint64_t trow_sizes(const RcCol* cols, uint32_t nfields, uint64_t rows,
+                    uint64_t* row_sizes) {
+  uint64_t null_bytes = (nfields + 7) / 8;
+  uint64_t fixed = align8(null_bytes) + 8ull * nfields;
+  uint64_t total = 0;
+  for (uint64_t r = 0; r < rows; r++) {
+    uint64_t var = 0;
+    for (uint32_t f = 0; f < nfields; f++) {
+      const RcCol* c = &cols[f];
+      if (c->offsets && c->validity[r])
+        var += align8((uint64_t)(c->offsets[r + 1] - c->offsets[r]));
+    }
+    row_sizes[r] = fixed + var;
+    total += row_sizes[r];
+  }
+  return total;
+}
+
+// Columns -> packed rows.  out must hold trow_sizes() bytes; row_offsets
+// gets rows+1 entries.
+void trow_from_columns(const RcCol* cols, uint32_t nfields, uint64_t rows,
+                       uint8_t* out, uint64_t* row_offsets) {
+  uint64_t null_bytes = (nfields + 7) / 8;
+  uint64_t fixed_off = align8(null_bytes);
+  uint64_t pos = 0;
+  for (uint64_t r = 0; r < rows; r++) {
+    row_offsets[r] = pos;
+    uint8_t* row = out + pos;
+    memset(row, 0, fixed_off);
+    uint64_t var_off = fixed_off + 8ull * nfields;
+    for (uint32_t f = 0; f < nfields; f++) {
+      const RcCol* c = &cols[f];
+      uint8_t* slot = row + fixed_off + 8ull * f;
+      if (!c->validity[r]) {
+        row[f >> 3] |= (uint8_t)(1u << (f & 7));
+        memset(slot, 0, 8);
+        continue;
+      }
+      if (c->offsets) {
+        uint32_t len = (uint32_t)(c->offsets[r + 1] - c->offsets[r]);
+        uint32_t off32 = (uint32_t)var_off;
+        memcpy(slot, &off32, 4);
+        memcpy(slot + 4, &len, 4);
+        memcpy(row + var_off, c->data + c->offsets[r], len);
+        uint64_t a = align8(len);
+        if (a > len) memset(row + var_off + len, 0, a - len);
+        var_off += a;
+      } else {
+        memset(slot, 0, 8);
+        memcpy(slot, c->data + (uint64_t)r * c->byte_width, c->byte_width);
+      }
+    }
+    pos += var_off;
+  }
+  row_offsets[rows] = pos;
+}
+
+// Packed rows -> columns.  Caller sizes the output buffers (var data
+// capacity from the row bytes total).  Returns total var bytes written to
+// each var column via out cols' offsets.
+void trow_to_columns(const uint8_t* rows_buf, const uint64_t* row_offsets,
+                     uint64_t rows, RcCol* cols, uint32_t nfields) {
+  uint64_t null_bytes = (nfields + 7) / 8;
+  uint64_t fixed_off = align8(null_bytes);
+  for (uint32_t f = 0; f < nfields; f++)
+    if (cols[f].offsets) cols[f].offsets[0] = 0;
+  for (uint64_t r = 0; r < rows; r++) {
+    const uint8_t* row = rows_buf + row_offsets[r];
+    for (uint32_t f = 0; f < nfields; f++) {
+      RcCol* c = &cols[f];
+      bool is_null = (row[f >> 3] >> (f & 7)) & 1;
+      c->validity[r] = is_null ? 0 : 1;
+      const uint8_t* slot = row + fixed_off + 8ull * f;
+      if (c->offsets) {
+        int32_t prev = c->offsets[r];
+        if (is_null) {
+          c->offsets[r + 1] = prev;
+        } else {
+          uint32_t off32, len;
+          memcpy(&off32, slot, 4);
+          memcpy(&len, slot + 4, 4);
+          memcpy(c->data + prev, row + off32, len);
+          c->offsets[r + 1] = prev + (int32_t)len;
+        }
+      } else if (!is_null) {
+        memcpy(c->data + (uint64_t)r * c->byte_width, slot, c->byte_width);
+      } else {
+        memset(c->data + (uint64_t)r * c->byte_width, 0, c->byte_width);
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -100,8 +100,12 @@ class DataFrame:
     where = filter
 
     def with_column(self, name: str, expr) -> "DataFrame":
+        from spark_rapids_tpu.expressions.window import WindowExpression
+        e = _to_expr(expr)
+        if isinstance(e, WindowExpression):
+            return DataFrame(L.Window([e.alias(name)], self.plan), self.session)
         exprs = [col(n) for n in self.schema.names if n != name]
-        exprs.append(_to_expr(expr).alias(name))
+        exprs.append(e.alias(name))
         return self.select(*exprs)
 
     def group_by(self, *keys) -> GroupedData:

@@ -157,11 +157,13 @@ SHUFFLE_PARTITIONS = conf("spark.sql.shuffle.partitions").doc(
 ).int_conf(16)
 
 SHUFFLE_MODE = conf("spark.rapids.shuffle.mode").doc(
-    "MULTITHREADED: host-staged threaded shuffle (reference MT mode, "
-    "RapidsShuffleInternalManagerBase.scala). ICI: gang-scheduled "
-    "device-to-device all-to-all exchange over the TPU interconnect "
-    "(replaces the reference's UCX mode)."
-).string_conf("MULTITHREADED")
+    "CACHE_ONLY: partition slices stay device-resident as spillable handles "
+    "(reference CACHE_ONLY / RapidsCachingWriter shape — the fast in-process "
+    "path). MULTITHREADED: host-staged threaded shuffle over the tpu-kudo "
+    "wire format (reference MT mode, RapidsShuffleInternalManagerBase"
+    ".scala). ICI: gang-scheduled device-to-device all-to-all over the TPU "
+    "interconnect (replaces the reference's UCX mode)."
+).string_conf("CACHE_ONLY")
 
 SHUFFLE_WRITER_THREADS = conf("spark.rapids.shuffle.multiThreaded.writer.threads").doc(
     "Serializer/writer thread-pool size for the multithreaded shuffle."
@@ -267,6 +269,18 @@ class RapidsConf:
     @property
     def shuffle_mode(self) -> str:
         return (self.get(SHUFFLE_MODE) or "MULTITHREADED").upper()
+
+    @property
+    def shuffle_writer_threads(self) -> int:
+        return self.get(SHUFFLE_WRITER_THREADS)
+
+    @property
+    def shuffle_reader_threads(self) -> int:
+        return self.get(SHUFFLE_READER_THREADS)
+
+    @property
+    def shuffle_codec(self) -> str:
+        return (self.get(SHUFFLE_COMPRESSION_CODEC) or "none").lower()
 
     @property
     def concurrent_tpu_tasks(self) -> int:

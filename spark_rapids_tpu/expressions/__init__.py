@@ -55,3 +55,26 @@ from spark_rapids_tpu.expressions.aggregates import (
 )
 
 __all__ = [n for n in dir() if not n.startswith("_")]
+from spark_rapids_tpu.expressions.strings import (
+    ConcatStrings,
+    Contains,
+    EndsWith,
+    Length,
+    Like,
+    Lower,
+    StartsWith,
+    Substring,
+    Trim,
+    Upper,
+)
+from spark_rapids_tpu.expressions.window import (
+    DenseRank,
+    Lag,
+    Lead,
+    Rank,
+    RowNumber,
+    WindowExpression,
+    WindowFrame,
+    WindowSpec,
+    over,
+)

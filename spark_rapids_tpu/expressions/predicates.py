@@ -51,6 +51,9 @@ class BinaryComparison(BinaryExpression):
     def _compare(self, lhs, rhs, xp):
         raise NotImplementedError
 
+    # ordering rank used for the device string path: 0 = lt, 1 = eq, 2 = gt
+    _string_ranks = None   # subclasses set accepted ranks
+
     def _prep(self, ctx: EvalContext):
         lc = self.left.eval(ctx)
         rc = self.right.eval(ctx)
@@ -68,6 +71,16 @@ class BinaryComparison(BinaryExpression):
                 cpu_null_propagating([lval, rval]), cdt)
 
     def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        if lc.is_string_like or rc.is_string_like:
+            assert lc.is_string_like and rc.is_string_like
+            rank = _string_cmp_rank(lc, rc)
+            validity = null_propagating([lc.validity, rc.validity])
+            vals = jnp.zeros((ctx.capacity,), jnp.bool_)
+            for r in self._string_ranks:
+                vals = vals | (rank == r)
+            return make_column(vals, validity, T.BOOLEAN)
         lhs, rhs, validity, cdt = self._prep(ctx)
         vals = self._compare(lhs, rhs, jnp, _is_float(cdt))
         return make_column(vals, validity, T.BOOLEAN)
@@ -91,6 +104,7 @@ class BinaryComparison(BinaryExpression):
 
 class EqualTo(BinaryComparison):
     symbol = "="
+    _string_ranks = (1,)
 
     def _compare(self, lhs, rhs, xp, is_float):
         eq = lhs == rhs
@@ -104,6 +118,7 @@ class EqualTo(BinaryComparison):
 
 class LessThan(BinaryComparison):
     symbol = "<"
+    _string_ranks = (0,)
 
     def _compare(self, lhs, rhs, xp, is_float):
         lt = lhs < rhs
@@ -119,6 +134,7 @@ class LessThan(BinaryComparison):
 
 class GreaterThan(BinaryComparison):
     symbol = ">"
+    _string_ranks = (2,)
 
     def _compare(self, lhs, rhs, xp, is_float):
         return LessThan._compare(self, rhs, lhs, xp, is_float)
@@ -129,6 +145,7 @@ class GreaterThan(BinaryComparison):
 
 class LessThanOrEqual(BinaryComparison):
     symbol = "<="
+    _string_ranks = (0, 1)
 
     def _compare(self, lhs, rhs, xp, is_float):
         return LessThan._compare(self, lhs, rhs, xp, is_float) | \
@@ -140,6 +157,7 @@ class LessThanOrEqual(BinaryComparison):
 
 class GreaterThanOrEqual(BinaryComparison):
     symbol = ">="
+    _string_ranks = (1, 2)
 
     def _compare(self, lhs, rhs, xp, is_float):
         return LessThan._compare(self, rhs, lhs, xp, is_float) | \
@@ -345,6 +363,26 @@ class In(Expression):
 
     def __repr__(self):
         return f"{self.value!r} IN {tuple(self.items)!r}"
+
+
+def _string_cmp_rank(a, b, max_bytes: int = 512) -> jnp.ndarray:
+    """Elementwise string ordering rank: 0 = a<b, 1 = a==b, 2 = a>b, by
+    UTF-8 byte order (Spark UTF8String.binaryCompare).  Compares the sort
+    kernel's packed chunk keys most-significant first; max_bytes caps the
+    static chunk count (the planner falls back beyond it)."""
+    from spark_rapids_tpu.kernels.sort import SortOrder, _string_data_keys
+    bound = min(max(a.byte_capacity, b.byte_capacity, 1), max_bytes)
+    ka = _string_data_keys(a, SortOrder(True), bound)
+    kb = _string_data_keys(b, SortOrder(True), bound)
+    cap = a.capacity
+    decided = jnp.zeros((cap,), jnp.bool_)
+    rank = jnp.ones((cap,), jnp.int8)   # default eq
+    for ca, cb in zip(ka, kb):
+        ne = (ca != cb) & ~decided
+        rank = jnp.where(ne & (ca < cb), jnp.int8(0), rank)
+        rank = jnp.where(ne & (ca > cb), jnp.int8(2), rank)
+        decided = decided | (ca != cb)
+    return rank
 
 
 def _string_eq(a, b) -> jnp.ndarray:

@@ -1,0 +1,316 @@
+"""String expression twins.
+
+Reference: org/apache/spark/sql/rapids/stringFunctions.scala (GpuLength,
+GpuUpper/GpuLower, GpuSubstring, GpuConcat, GpuStartsWith/EndsWith/
+GpuContains, GpuLike, GpuStringTrim).
+
+Device caveats mirrored from the reference's compatibility gates:
+upper/lower are ASCII-only on device (the reference gates full-Unicode
+behind incompatibleOps too); LIKE supports the literal/prefix/suffix/
+contains pattern family — the general regex path arrives with the regex
+transpiler (RegexParser.scala analog).  The planner tags anything outside
+these shapes for CPU fallback.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expressions.core import (
+    BinaryExpression,
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+    Literal,
+    UnaryExpression,
+    cpu_null_propagating,
+    make_column,
+)
+from spark_rapids_tpu.kernels import strings as SK
+
+
+def _obj(vals) -> np.ndarray:
+    out = np.empty((len(vals),), dtype=object)
+    out[:] = vals
+    return out
+
+
+class Length(UnaryExpression):
+    """Character count (Spark length)."""
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        n = SK.char_length(c, ctx.batch.num_rows)
+        return make_column(n, c.validity & ctx.live_mask(), T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        out = np.array([len(x) if m else 0 for x, m in zip(v, valid)],
+                       dtype=np.int32)
+        return out, valid.copy()
+
+
+class Upper(UnaryExpression):
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        return SK.upper_ascii(self.child.eval(ctx))
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        return _obj([x.upper() if m else None for x, m in zip(v, valid)]), valid
+
+
+class Lower(UnaryExpression):
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        return SK.lower_ascii(self.child.eval(ctx))
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        return _obj([x.lower() if m else None for x, m in zip(v, valid)]), valid
+
+
+class Substring(Expression):
+    """SUBSTRING(str, pos[, len]) — 1-based, character semantics."""
+
+    def __init__(self, child: Expression, pos: Expression,
+                 length: Optional[Expression] = None):
+        from spark_rapids_tpu.expressions.core import lit
+        self.child = child
+        self.pos = pos if isinstance(pos, Expression) else lit(pos)
+        self.length = (length if isinstance(length, Expression) or length is None
+                       else lit(length))
+        self.children = ((child, self.pos, self.length)
+                        if self.length is not None else (child, self.pos))
+
+    def with_children(self, children):
+        return Substring(children[0], children[1],
+                         children[2] if len(children) > 2 else None)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        pos = self.pos.eval(ctx).data.astype(jnp.int32)
+        if self.length is not None:
+            ln = self.length.eval(ctx).data.astype(jnp.int32)
+        else:
+            ln = jnp.full((ctx.capacity,), 2**30, dtype=jnp.int32)
+        out = SK.substring_chars(c, ctx.batch.num_rows, pos, ln)
+        return DeviceColumn(out.data, c.validity & ctx.live_mask(),
+                            T.STRING, out.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        pv, _ = self.pos.eval_cpu(ctx)
+        if self.length is not None:
+            lv, _ = self.length.eval_cpu(ctx)
+        else:
+            lv = np.full((ctx.num_rows,), 2**30)
+        out = []
+        for x, m, p, l in zip(v, valid, pv, lv):
+            if not m:
+                out.append(None)
+                continue
+            p = int(p)
+            l = max(int(l), 0)
+            n = len(x)
+            s0 = p - 1 if p > 0 else (n + p if p < 0 else 0)
+            e0 = s0 + l
+            s0 = max(s0, 0)
+            out.append(x[s0:max(e0, s0)])
+        return _obj(out), valid.copy()
+
+    def __repr__(self):
+        return f"substring({self.child!r}, {self.pos!r}, {self.length!r})"
+
+
+class ConcatStrings(BinaryExpression):
+    """Two-way string concat (variadic concat folds into a chain)."""
+
+    symbol = "||"
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        a = self.left.eval(ctx)
+        b = self.right.eval(ctx)
+        return SK.concat_strings(a, b, ctx.batch.num_rows)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, avalid = self.left.eval_cpu(ctx)
+        bv, bvalid = self.right.eval_cpu(ctx)
+        valid = cpu_null_propagating([avalid, bvalid])
+        return _obj([a + b if m else None
+                     for a, b, m in zip(av, bv, valid)]), valid
+
+
+class _LiteralPatternPredicate(BinaryExpression):
+    """Base for startswith/endswith/contains with a literal pattern."""
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def _pattern_bytes(self) -> bytes:
+        assert isinstance(self.right, Literal), \
+            "planner must fall back for non-literal patterns"
+        v = self.right.value
+        return v.encode("utf-8") if isinstance(v, str) else (v or b"")
+
+    def _device(self, col: DeviceColumn, pattern: bytes, ctx) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def _py(self, s: str, p: str) -> bool:
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext):
+        c = self.left.eval(ctx)
+        hits = self._device(c, self._pattern_bytes(), ctx)
+        validity = c.validity & ctx.live_mask()
+        if self.right.nullable and self.right.value is None:
+            validity = jnp.zeros_like(validity)
+        return make_column(hits, validity, T.BOOLEAN)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.left.eval_cpu(ctx)
+        p = self.right.value
+        if p is None:
+            z = np.zeros((ctx.num_rows,), np.bool_)
+            return z, z.copy()
+        out = np.array([self._py(x, p) if m else False
+                        for x, m in zip(v, valid)], dtype=np.bool_)
+        return out, valid.copy()
+
+
+class StartsWith(_LiteralPatternPredicate):
+    symbol = "STARTSWITH"
+
+    def _device(self, col, pattern, ctx):
+        return SK.startswith_literal(col, pattern)
+
+    def _py(self, s, p):
+        return s.startswith(p)
+
+
+class EndsWith(_LiteralPatternPredicate):
+    symbol = "ENDSWITH"
+
+    def _device(self, col, pattern, ctx):
+        return SK.endswith_literal(col, pattern)
+
+    def _py(self, s, p):
+        return s.endswith(p)
+
+
+class Contains(_LiteralPatternPredicate):
+    symbol = "CONTAINS"
+
+    def _device(self, col, pattern, ctx):
+        return SK.contains_literal(col, pattern, ctx.batch.num_rows)
+
+    def _py(self, s, p):
+        return p in s
+
+
+class Like(Expression):
+    """SQL LIKE limited to the shapes the reference's regex rewrite also
+    fast-paths (RegexRewriteUtils): 'lit', 'lit%', '%lit', '%lit%'.
+    Anything else (interior %/_ wildcards) is tagged for fallback."""
+
+    def __init__(self, child: Expression, pattern: str):
+        self.child = child
+        self.pattern = pattern
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Like(children[0], self.pattern)
+
+    @staticmethod
+    def supported_pattern(pattern: str) -> bool:
+        inner = pattern
+        if inner.startswith("%"):
+            inner = inner[1:]
+        if inner.endswith("%") and not inner.endswith(r"\%"):
+            inner = inner[:-1]
+        return "%" not in inner and "_" not in inner
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def _shape(self):
+        p = self.pattern
+        starts_pct = p.startswith("%")
+        ends_pct = p.endswith("%")
+        inner = p[1 if starts_pct else 0: len(p) - 1 if ends_pct else len(p)]
+        return starts_pct, ends_pct, inner
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        sp, ep, inner = self._shape()
+        pat = inner.encode("utf-8")
+        if sp and ep:
+            hits = SK.contains_literal(c, pat, ctx.batch.num_rows)
+        elif ep:
+            hits = SK.startswith_literal(c, pat)
+        elif sp:
+            hits = SK.endswith_literal(c, pat)
+        else:
+            from spark_rapids_tpu.kernels.strings import byte_length
+            hits = SK.startswith_literal(c, pat) & (byte_length(c) == len(pat))
+        return make_column(hits, c.validity & ctx.live_mask(), T.BOOLEAN)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        sp, ep, inner = self._shape()
+
+        def match(s):
+            if sp and ep:
+                return inner in s
+            if ep:
+                return s.startswith(inner)
+            if sp:
+                return s.endswith(inner)
+            return s == inner
+        out = np.array([match(x) if m else False for x, m in zip(v, valid)],
+                       dtype=np.bool_)
+        return out, valid.copy()
+
+    def __repr__(self):
+        return f"({self.child!r} LIKE {self.pattern!r})"
+
+
+class Trim(UnaryExpression):
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        out = SK.trim_ws(c, ctx.batch.num_rows)
+        return DeviceColumn(out.data, c.validity & ctx.live_mask(),
+                            T.STRING, out.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        return _obj([x.strip(" ") if m else None
+                     for x, m in zip(v, valid)]), valid.copy()

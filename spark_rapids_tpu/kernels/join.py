@@ -1,0 +1,221 @@
+"""Equi-join kernels: gather-map production for all join types.
+
+TPU replacement for cuDF's join gather-map kernels (reference consumption:
+GpuHashJoin.scala:545,564 `leftSemiJoinGatherMap` etc., applied via
+`Table.gather`).  The contract is the same as the reference's: the join
+kernel produces (left_indices, right_indices, count) gather maps; applying
+them is the shared gather kernel (kernels/selection.py), so join output
+assembly reuses the filter/sort machinery.
+
+Strategy — sort-merge under the hood (the inverse of the reference, which
+plans sort-merge joins AS hash joins, GpuSortMergeJoinMeta.scala): both
+sides' keys are concatenated, lex-sorted once (XLA variadic sort — the
+shape-static operation TPUs like), segment boundaries delimit equal-key
+runs, and per-row match counts + first-match positions fall out of segment
+reductions.  Expansion to pairs is an offsets + searchsorted gather with a
+static output capacity and an OverflowStatus for the capacity-retry loop
+(the GpuSplitAndRetryOOM analog pointed at output growth).
+
+Spark join semantics honored:
+  * null keys never match (no null == null in equi-joins);
+  * NaN == NaN matches; -0.0 == 0.0 matches (keys are normalized);
+  * left_anti emits null-keyed left rows (they match nothing);
+  * outer joins null-extend the other side (OOB index -> null columns).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.kernels.groupby import normalize_key_column
+from spark_rapids_tpu.kernels.selection import OOB, OverflowStatus
+from spark_rapids_tpu.kernels.sort import SortOrder, _data_key_fixed
+
+JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti", "cross")
+_ASC = SortOrder(True, True)
+
+
+def _key_arrays(col: DeviceColumn, live: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(uint64 order key, null key) for one key column slice."""
+    c = normalize_key_column(col)
+    data_key = _data_key_fixed(c, _ASC)
+    null_key = jnp.where(c.validity, jnp.uint8(1), jnp.uint8(0))
+    return data_key, null_key
+
+
+def join_gather_maps(
+    left: ColumnarBatch,
+    left_keys: Sequence[int],
+    right: ColumnarBatch,
+    right_keys: Sequence[int],
+    join_type: str,
+    out_capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, OverflowStatus]:
+    """Produce (left_idx[OC], right_idx[OC], count, status).
+
+    OOB in either map means "null-extend that side" for the row pair.
+    status.required_rows is the true pair count; if it exceeds out_capacity
+    the maps are truncated and must be retried at larger capacity.
+    """
+    assert join_type in JOIN_TYPES, join_type
+    CL, CR = left.capacity, right.capacity
+    left_live = left.live_mask()
+    right_live = right.live_mask()
+
+    if join_type == "cross":
+        # live rows are contiguous: pair (i, j) directly, no sort needed
+        counts = jnp.where(left_live, right.num_rows, 0).astype(jnp.int64)
+        offsets = jnp.concatenate([jnp.zeros((1,), jnp.int64), jnp.cumsum(counts)])
+        total = offsets[CL]
+        k = jnp.arange(out_capacity, dtype=jnp.int64)
+        row = jnp.clip(jnp.searchsorted(offsets, k, side="right") - 1, 0, CL - 1)
+        j = k - offsets[row]
+        livek = k < total
+        li = jnp.where(livek, row, OOB).astype(jnp.int32)
+        ri = jnp.where(livek, j, OOB).astype(jnp.int32)
+        return li, ri, jnp.minimum(total, out_capacity).astype(jnp.int32), \
+            OverflowStatus(total)
+
+    TC = CL + CR
+    # combined per-key sort keys
+    sort_keys: List[jax.Array] = []   # least significant first for lexsort
+    any_null = jnp.zeros((TC,), jnp.bool_)
+    live = jnp.concatenate([left_live, right_live])
+    side = jnp.concatenate([jnp.zeros((CL,), jnp.uint8), jnp.ones((CR,), jnp.uint8)])
+    orig = jnp.concatenate([jnp.arange(CL, dtype=jnp.int32),
+                            jnp.arange(CR, dtype=jnp.int32)])
+    per_col_keys = []
+    for lk, rk in zip(left_keys, right_keys):
+        lc = normalize_key_column(left.columns[lk])
+        rc = normalize_key_column(right.columns[rk])
+        assert not lc.is_string_like, "string join keys not yet supported"
+        cdt = lc.dtype if lc.dtype == rc.dtype else T.numeric_promote(lc.dtype, rc.dtype)
+        ldat = lc.data.astype(cdt.jnp_dtype)
+        rdat = rc.data.astype(cdt.jnp_dtype)
+        data = jnp.concatenate([ldat, rdat])
+        valid = jnp.concatenate([lc.validity, rc.validity])
+        kcol = DeviceColumn(data, valid, cdt)
+        dk = _data_key_fixed(normalize_key_column(kcol), _ASC)
+        per_col_keys.append(dk)
+        any_null = any_null | ~valid
+    eligible = live & ~any_null
+
+    # lexsort: primary = eligibility (eligible first), then keys, side last
+    # (left rows of a segment precede right rows), position stability free
+    sort_keys.append(side)                       # least significant
+    for dk in reversed(per_col_keys):
+        sort_keys.append(dk)
+    sort_keys.append(jnp.where(eligible, jnp.uint8(0), jnp.uint8(1)))  # primary
+    order = jnp.lexsort(tuple(sort_keys)).astype(jnp.int32)
+
+    s_elig = eligible[order]
+    s_side = side[order]
+    s_orig = orig[order]
+    pos = jnp.arange(TC, dtype=jnp.int32)
+
+    # segment boundaries among eligible rows (keys equal check via sort keys)
+    eq_prev = jnp.ones((TC,), jnp.bool_)
+    for dk in per_col_keys:
+        sk = dk[order]
+        eq_prev = eq_prev & (sk == jnp.roll(sk, 1))
+    first = pos == 0
+    boundary = s_elig & (first | ~eq_prev)
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg = jnp.where(s_elig, seg, TC - 1)          # sentinel for ineligible
+
+    is_l = s_elig & (s_side == 0)
+    is_r = s_elig & (s_side == 1)
+    cl_seg = jax.ops.segment_sum(is_l.astype(jnp.int32), seg, num_segments=TC)
+    cr_seg = jax.ops.segment_sum(is_r.astype(jnp.int32), seg, num_segments=TC)
+    seg_start = jax.ops.segment_min(jnp.where(s_elig, pos, TC), seg,
+                                    num_segments=TC)
+
+    # per-original-left-row: match count M and sorted position of first
+    # right-side match (FIRSTR)
+    M = jnp.zeros((CL,), jnp.int32)
+    FIRSTR = jnp.zeros((CL,), jnp.int32)
+    l_orig_safe = jnp.where(is_l, s_orig, CL)
+    M = M.at[l_orig_safe].set(jnp.where(is_l, cr_seg[seg], 0), mode="drop")
+    FIRSTR = FIRSTR.at[l_orig_safe].set(
+        jnp.where(is_l, seg_start[seg] + cl_seg[seg], 0), mode="drop")
+
+    # per-original-right-row: matched flag (for right/full outer append)
+    r_matched = jnp.zeros((CR,), jnp.bool_)
+    r_orig_safe = jnp.where(is_r, s_orig, CR)
+    r_matched = r_matched.at[r_orig_safe].set(
+        jnp.where(is_r, cl_seg[seg] > 0, False), mode="drop")
+
+    # left-driven counts per join type
+    if join_type == "inner" or join_type == "right":
+        counts = M
+    elif join_type in ("left", "full"):
+        counts = jnp.maximum(M, 1)
+    elif join_type == "left_semi":
+        counts = jnp.minimum(M, 1)
+    elif join_type == "left_anti":
+        counts = (M == 0).astype(jnp.int32)
+    else:
+        raise AssertionError(join_type)
+    counts = jnp.where(left_live, counts, 0).astype(jnp.int64)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int64), jnp.cumsum(counts)])
+    total_left = offsets[CL]
+
+    if join_type in ("right", "full"):
+        r_unmatched = right_live & ~r_matched
+        a_counts = r_unmatched.astype(jnp.int64)
+        a_offsets = jnp.concatenate([jnp.zeros((1,), jnp.int64),
+                                     jnp.cumsum(a_counts)])
+        total_append = a_offsets[CR]
+    else:
+        a_offsets = None
+        total_append = jnp.int64(0)
+    required = total_left + total_append
+
+    k = jnp.arange(out_capacity, dtype=jnp.int64)
+    in_left_region = k < total_left
+    # left-driven region
+    lrow = jnp.clip(jnp.searchsorted(offsets, k, side="right") - 1, 0, CL - 1)
+    j = (k - offsets[lrow]).astype(jnp.int32)
+    has_match = j < M[lrow]
+    rpos = jnp.clip(FIRSTR[lrow] + j, 0, TC - 1)
+    r_of_pair = jnp.where(has_match, s_orig[rpos], OOB)
+    if join_type in ("left_semi", "left_anti"):
+        r_of_pair = jnp.full((out_capacity,), OOB, dtype=jnp.int32)
+    li = jnp.where(in_left_region, lrow.astype(jnp.int32), OOB)
+    ri = jnp.where(in_left_region, r_of_pair, OOB)
+
+    if join_type in ("right", "full"):
+        ka = k - total_left
+        in_append = (~in_left_region) & (k < required)
+        arow = jnp.clip(jnp.searchsorted(a_offsets, ka, side="right") - 1,
+                        0, CR - 1)
+        li = jnp.where(in_append, OOB, li)
+        ri = jnp.where(in_append, arow.astype(jnp.int32), ri)
+
+    count = jnp.minimum(required, out_capacity).astype(jnp.int32)
+    return li, ri, count, OverflowStatus(required)
+
+
+def apply_gather_maps(
+    left: ColumnarBatch,
+    right: ColumnarBatch,
+    li: jax.Array,
+    ri: jax.Array,
+    count: jax.Array,
+    schema: Schema,
+    join_type: str,
+    out_capacity: int,
+) -> ColumnarBatch:
+    """Assemble the joined batch from gather maps (Table.gather analog)."""
+    from spark_rapids_tpu.kernels.selection import gather_column
+    cols = [gather_column(c, li, count, out_capacity=out_capacity)
+            for c in left.columns]
+    if join_type not in ("left_semi", "left_anti"):
+        cols += [gather_column(c, ri, count, out_capacity=out_capacity)
+                 for c in right.columns]
+    return ColumnarBatch(tuple(cols), count.astype(jnp.int32), schema)

@@ -44,3 +44,220 @@ def live_string_bucket_for_batch(batch, col_indices) -> int:
         if col.is_string_like:
             m = max(m, int(max_live_string_bytes(col, batch.num_rows)))
     return bucket_for(m)
+
+
+# ---------------------------------------------------------------------------
+# String compute kernels.
+#
+# TPU replacement for the cuDF string kernels consumed by
+# org/apache/spark/sql/rapids/stringFunctions.scala (substring, upper/lower,
+# concat, startswith/endswith/contains, trim, char length).  All shapes are
+# static: outputs reuse/deterministically combine input byte capacities, so
+# no overflow-retry is needed for these ops.
+#
+# Byte->row attribution pattern shared by all kernels: byte position p
+# belongs to row searchsorted(offsets, p, 'right')-1; per-row reductions are
+# segment ops over that row id.  UTF-8 character structure comes from the
+# lead-byte mask ((b & 0xC0) != 0x80) — char counts and char slicing are
+# segment sums/ranks of lead bytes (Spark's length()/substring() are
+# character-based, docs/compatibility.md).
+
+
+def _row_of_byte(col: DeviceColumn) -> jax.Array:
+    """int32 [byte_capacity]: owning row of each byte position (clipped)."""
+    bpos = jnp.arange(col.byte_capacity, dtype=jnp.int32)
+    row = jnp.searchsorted(col.offsets, bpos, side="right").astype(jnp.int32) - 1
+    return jnp.clip(row, 0, col.capacity - 1)
+
+
+def _live_byte_mask(col: DeviceColumn, num_rows) -> jax.Array:
+    """bool [byte_capacity]: byte belongs to a live row's payload."""
+    bpos = jnp.arange(col.byte_capacity, dtype=jnp.int32)
+    return bpos < col.offsets[num_rows]
+
+
+def char_length(col: DeviceColumn, num_rows) -> jax.Array:
+    """UTF-8 character count per row (int32 [capacity])."""
+    row = _row_of_byte(col)
+    livebyte = _live_byte_mask(col, num_rows)
+    lead = (col.data & jnp.uint8(0xC0)) != jnp.uint8(0x80)
+    contrib = (livebyte & lead).astype(jnp.int32)
+    return jax.ops.segment_sum(contrib, row, num_segments=col.capacity)
+
+
+def byte_length(col: DeviceColumn) -> jax.Array:
+    return (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+
+
+def upper_ascii(col: DeviceColumn) -> DeviceColumn:
+    """UPPER over ASCII + Latin-1 (UTF-8 'C3 xx' pairs, whose case change
+    keeps byte length).  Scripts beyond Latin-1 pass through unchanged —
+    the same class of case-mapping gap the reference documents behind its
+    incompatible-ops gates."""
+    d = col.data
+    prev = jnp.roll(d, 1).at[0].set(jnp.uint8(0))
+    is_lower = (d >= jnp.uint8(ord("a"))) & (d <= jnp.uint8(ord("z")))
+    # Latin-1: U+00E0..U+00FE lowercase (except ÷ U+00F7) second byte
+    lat = (prev == jnp.uint8(0xC3)) & (d >= jnp.uint8(0xA0)) & \
+        (d <= jnp.uint8(0xBE)) & (d != jnp.uint8(0xB7))
+    out = jnp.where(is_lower | lat, d - jnp.uint8(32), d)
+    return DeviceColumn(out, col.validity, col.dtype, col.offsets)
+
+
+def lower_ascii(col: DeviceColumn) -> DeviceColumn:
+    """LOWER with the same ASCII + Latin-1 coverage as upper_ascii."""
+    d = col.data
+    prev = jnp.roll(d, 1).at[0].set(jnp.uint8(0))
+    is_upper = (d >= jnp.uint8(ord("A"))) & (d <= jnp.uint8(ord("Z")))
+    # Latin-1: U+00C0..U+00DE uppercase (except × U+00D7) second byte
+    lat = (prev == jnp.uint8(0xC3)) & (d >= jnp.uint8(0x80)) & \
+        (d <= jnp.uint8(0x9E)) & (d != jnp.uint8(0x97))
+    out = jnp.where(is_upper | lat, d + jnp.uint8(32), d)
+    return DeviceColumn(out, col.validity, col.dtype, col.offsets)
+
+
+def _compact_bytes(col: DeviceColumn, keep: jax.Array, num_rows) -> DeviceColumn:
+    """Drop bytes where ~keep, preserving order; rebuild offsets.  Output
+    byte capacity == input byte capacity (a subset never grows)."""
+    from spark_rapids_tpu.kernels.selection import compaction_map
+    row = _row_of_byte(col)
+    keep = keep & _live_byte_mask(col, num_rows)
+    new_len = jax.ops.segment_sum(keep.astype(jnp.int32), row,
+                                  num_segments=col.capacity)
+    live = jnp.arange(col.capacity, dtype=jnp.int32) < num_rows
+    new_len = jnp.where(live, new_len, 0)
+    new_offsets = jnp.zeros((col.capacity + 1,), jnp.int32)
+    new_offsets = new_offsets.at[1:].set(jnp.cumsum(new_len))
+    idx, cnt = compaction_map(keep)
+    bcap = col.byte_capacity
+    src = jnp.clip(idx, 0, bcap - 1)
+    livebyte = jnp.arange(bcap, dtype=jnp.int32) < cnt
+    data = jnp.where(livebyte, col.data[src], jnp.uint8(0))
+    return DeviceColumn(data, col.validity, col.dtype, new_offsets)
+
+
+def substring_chars(col: DeviceColumn, num_rows, start: jax.Array,
+                    length: jax.Array) -> DeviceColumn:
+    """Spark SUBSTRING semantics over characters, vectorized per byte.
+
+    start: int32 [capacity] 1-based (negative = from end, 0 treated as 1);
+    length: int32 [capacity] (<0 -> empty).  Reference: GpuSubstring in
+    stringFunctions.scala.
+    """
+    row = _row_of_byte(col)
+    lead = (col.data & jnp.uint8(0xC0)) != jnp.uint8(0x80)
+    nchars = char_length(col, num_rows)
+    # char rank of each byte within its row (0-based): inclusive cumsum of
+    # lead bytes minus count before row start
+    lead_i = lead.astype(jnp.int32) & _live_byte_mask(col, num_rows).astype(jnp.int32)
+    cum = jnp.cumsum(lead_i)
+    row_start_cum = cum[jnp.clip(col.offsets[:-1] - 1, 0, None)]
+    row_start_cum = jnp.where(col.offsets[:-1] == 0, 0, row_start_cum)
+    char_rank = cum - 1 - row_start_cum[row]   # 0-based char index of byte
+    n_r = nchars[row]
+    s = start[row]
+    l = length[row]
+    # Spark: pos 0/1 -> first char; negative counts from the end
+    s0 = jnp.where(s > 0, s - 1, jnp.where(s < 0, n_r + s, 0))
+    e0 = s0 + jnp.maximum(l, 0)
+    s0c = jnp.clip(s0, 0, n_r)
+    e0c = jnp.clip(e0, 0, n_r)
+    keep = (char_rank >= s0c) & (char_rank < e0c)
+    return _compact_bytes(col, keep, num_rows)
+
+
+def concat_strings(a: DeviceColumn, b: DeviceColumn, num_rows) -> DeviceColumn:
+    """Row-wise concat; null if either side null (Spark concat)."""
+    alen = byte_length(a)
+    blen = byte_length(b)
+    validity = a.validity & b.validity
+    live = jnp.arange(a.capacity, dtype=jnp.int32) < num_rows
+    new_len = jnp.where(validity & live, alen + blen, 0)
+    offsets = jnp.zeros((a.capacity + 1,), jnp.int32).at[1:].set(
+        jnp.cumsum(new_len))
+    bcap = a.byte_capacity + b.byte_capacity
+    bpos = jnp.arange(bcap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, bpos, side="right").astype(jnp.int32) - 1,
+                   0, a.capacity - 1)
+    within = bpos - offsets[row]
+    from_a = within < alen[row]
+    src_a = jnp.clip(a.offsets[:-1][row] + within, 0, a.byte_capacity - 1)
+    src_b = jnp.clip(b.offsets[:-1][row] + within - alen[row], 0,
+                     b.byte_capacity - 1)
+    data = jnp.where(from_a, a.data[src_a], b.data[src_b])
+    data = jnp.where(bpos < offsets[a.capacity], data, jnp.uint8(0))
+    return DeviceColumn(data, validity, a.dtype, offsets)
+
+
+def _pattern_hits(col: DeviceColumn, pattern: bytes) -> jax.Array:
+    """bool [byte_capacity]: pattern matches starting at byte p, entirely
+    inside p's row.  Static small pattern (a literal)."""
+    m = len(pattern)
+    bcap = col.byte_capacity
+    bpos = jnp.arange(bcap, dtype=jnp.int32)
+    row = _row_of_byte(col)
+    row_end = col.offsets[1:][row]
+    hit = (bpos + m) <= row_end
+    for i, pb in enumerate(pattern):
+        idx = jnp.clip(bpos + i, 0, bcap - 1)
+        hit = hit & (col.data[idx] == jnp.uint8(pb))
+    return hit
+
+
+def contains_literal(col: DeviceColumn, pattern: bytes, num_rows) -> jax.Array:
+    """bool [capacity]: row contains the literal byte pattern."""
+    if len(pattern) == 0:
+        return jnp.ones((col.capacity,), jnp.bool_)
+    hits = _pattern_hits(col, pattern) & _live_byte_mask(col, num_rows)
+    row = _row_of_byte(col)
+    # segment_sum: empty segments yield 0 (segment_max's empty-segment
+    # identity is INT_MIN, which is truthy)
+    return jax.ops.segment_sum(hits.astype(jnp.int32), row,
+                               num_segments=col.capacity) > 0
+
+
+def startswith_literal(col: DeviceColumn, pattern: bytes) -> jax.Array:
+    m = len(pattern)
+    if m == 0:
+        return jnp.ones((col.capacity,), jnp.bool_)
+    starts = col.offsets[:-1]
+    lengths = col.offsets[1:] - starts
+    ok = lengths >= m
+    for i, pb in enumerate(pattern):
+        idx = jnp.clip(starts + i, 0, col.byte_capacity - 1)
+        ok = ok & (col.data[idx] == jnp.uint8(pb))
+    return ok
+
+
+def endswith_literal(col: DeviceColumn, pattern: bytes) -> jax.Array:
+    m = len(pattern)
+    if m == 0:
+        return jnp.ones((col.capacity,), jnp.bool_)
+    starts = col.offsets[:-1]
+    ends = col.offsets[1:]
+    lengths = ends - starts
+    ok = lengths >= m
+    for i, pb in enumerate(pattern):
+        idx = jnp.clip(ends - m + i, 0, col.byte_capacity - 1)
+        ok = ok & (col.data[idx] == jnp.uint8(pb))
+    return ok
+
+
+def trim_ws(col: DeviceColumn, num_rows) -> DeviceColumn:
+    """Spark TRIM: strip ASCII space (0x20) from both ends (Spark trims
+    space only, not all whitespace)."""
+    starts = col.offsets[:-1]
+    ends = col.offsets[1:]
+    row = _row_of_byte(col)
+    bpos = jnp.arange(col.byte_capacity, dtype=jnp.int32)
+    is_space = col.data == jnp.uint8(0x20)
+    # leading run: space and all bytes before it in the row are spaces
+    nonspace = (~is_space) & _live_byte_mask(col, num_rows)
+    # first/last non-space position per row
+    INF = jnp.int32(2**30)
+    first_ns = jax.ops.segment_min(jnp.where(nonspace, bpos, INF), row,
+                                   num_segments=col.capacity)
+    last_ns = jax.ops.segment_max(jnp.where(nonspace, bpos, -1), row,
+                                  num_segments=col.capacity)
+    keep = (bpos >= first_ns[row]) & (bpos <= last_ns[row])
+    return _compact_bytes(col, keep, num_rows)

@@ -1,0 +1,199 @@
+"""Window kernels: segmented scans over partition-sorted batches.
+
+TPU replacement for cuDF's window kernels (reference consumption:
+window/GpuWindowExec.scala:145, BasicWindowCalc, GpuRunningWindowExec).
+On TPU a window computation is: one lexsort by (partition keys, order
+keys), then segmented prefix scans / reductions — all shape-static XLA ops
+(cumsum, associative_scan, segment_*).
+
+Spark frame semantics honored:
+  * the default frame with ORDER BY is RANGE UNBOUNDED PRECEDING..CURRENT
+    ROW, which includes *peer* rows (order-key ties) — running aggregates
+    evaluate at the last peer of each run;
+  * ROWS frames are positional;
+  * rank counts from the first peer, dense_rank counts runs.
+
+Layout contract: all functions below take arrays indexed by *sorted
+position* plus the segmentation structure from `window_layout`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+
+@dataclasses.dataclass
+class WindowLayout:
+    """Segmentation of a partition-sorted batch."""
+
+    seg: jax.Array          # int32 [cap] partition id per sorted pos
+    seg_start: jax.Array    # int32 [cap] first pos of this pos's partition
+    seg_end: jax.Array      # int32 [cap] one-past-last pos of the partition
+    run_id: jax.Array       # int32 [cap] peer-run id (partition+order ties)
+    run_first: jax.Array    # int32 [cap] first pos of this pos's peer run
+    run_last: jax.Array     # int32 [cap] last pos of this pos's peer run
+    live: jax.Array         # bool [cap]
+    pos: jax.Array          # int32 [cap] = arange
+
+
+def window_layout(part_boundary: jax.Array, peer_boundary: jax.Array,
+                  live: jax.Array) -> WindowLayout:
+    """part_boundary/peer_boundary: bool [cap] at sorted positions, True at
+    the first row of each partition / peer run (padding False)."""
+    cap = part_boundary.shape[0]
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    seg = jnp.cumsum(part_boundary.astype(jnp.int32)) - 1
+    seg = jnp.where(live, seg, cap - 1)
+    seg_start_by_id = jax.ops.segment_min(jnp.where(live, pos, cap), seg,
+                                          num_segments=cap)
+    seg_end_by_id = jax.ops.segment_max(jnp.where(live, pos + 1, -1), seg,
+                                        num_segments=cap)
+    run = jnp.cumsum(peer_boundary.astype(jnp.int32)) - 1
+    run = jnp.where(live, run, cap - 1)
+    run_first_by_id = jax.ops.segment_min(jnp.where(live, pos, cap), run,
+                                          num_segments=cap)
+    run_last_by_id = jax.ops.segment_max(jnp.where(live, pos, -1), run,
+                                         num_segments=cap)
+    return WindowLayout(
+        seg=seg,
+        seg_start=seg_start_by_id[seg],
+        seg_end=seg_end_by_id[seg],
+        run_id=run,
+        run_first=run_first_by_id[run],
+        run_last=run_last_by_id[run],
+        live=live,
+        pos=pos,
+    )
+
+
+def row_number(layout: WindowLayout) -> jax.Array:
+    return jnp.where(layout.live, layout.pos - layout.seg_start + 1, 0)
+
+
+def rank(layout: WindowLayout) -> jax.Array:
+    return jnp.where(layout.live, layout.run_first - layout.seg_start + 1, 0)
+
+
+def dense_rank(layout: WindowLayout) -> jax.Array:
+    run_at_seg_start = layout.run_id[layout.seg_start]
+    return jnp.where(layout.live, layout.run_id - run_at_seg_start + 1, 0)
+
+
+def _prefix_sum(values: jax.Array, valid: jax.Array, dtype) -> jax.Array:
+    """Inclusive prefix sum of valid values (whole array)."""
+    contrib = jnp.where(valid, values.astype(dtype), jnp.zeros((), dtype))
+    return jnp.cumsum(contrib)
+
+
+def _at_or_zero(prefix: jax.Array, idx: jax.Array):
+    """prefix[idx] with idx == -1 -> 0."""
+    safe = jnp.clip(idx, 0, prefix.shape[0] - 1)
+    return jnp.where(idx >= 0, prefix[safe], jnp.zeros((), prefix.dtype))
+
+
+def running_sum_range(values: jax.Array, valid: jax.Array,
+                      layout: WindowLayout, dtype) -> Tuple[jax.Array, jax.Array]:
+    """RANGE UNBOUNDED PRECEDING..CURRENT ROW sum (peers included):
+    evaluate the prefix at the last peer of each run."""
+    ps = _prefix_sum(values, valid & layout.live, dtype)
+    pc = jnp.cumsum((valid & layout.live).astype(jnp.int64))
+    upper = layout.run_last
+    lower = layout.seg_start - 1
+    s = _at_or_zero(ps, upper) - _at_or_zero(ps, lower)
+    n = _at_or_zero(pc, upper) - _at_or_zero(pc, lower)
+    return s, n   # n = count of valid values in frame (validity: n > 0)
+
+
+def rows_frame_sum(values: jax.Array, valid: jax.Array, layout: WindowLayout,
+                   preceding: Optional[int], following: Optional[int],
+                   dtype) -> Tuple[jax.Array, jax.Array]:
+    """ROWS BETWEEN <preceding> PRECEDING AND <following> FOLLOWING
+    (None = unbounded on that side)."""
+    ps = _prefix_sum(values, valid & layout.live, dtype)
+    pc = jnp.cumsum((valid & layout.live).astype(jnp.int64))
+    if following is None:
+        upper = layout.seg_end - 1
+    else:
+        upper = jnp.minimum(layout.pos + following, layout.seg_end - 1)
+    if preceding is None:
+        lower = layout.seg_start - 1
+    else:
+        lower = jnp.maximum(layout.pos - preceding, layout.seg_start) - 1
+    s = _at_or_zero(ps, upper) - _at_or_zero(ps, lower)
+    n = _at_or_zero(pc, upper) - _at_or_zero(pc, lower)
+    return s, n
+
+
+def _segmented_scan(values: jax.Array, is_start: jax.Array, combine):
+    """Generic inclusive segmented scan via associative_scan with resets."""
+    def op(a, b):
+        a_flag, a_val = a
+        b_flag, b_val = b
+        val = jnp.where(b_flag, b_val, combine(a_val, b_val))
+        return (a_flag | b_flag, val)
+    flags, out = jax.lax.associative_scan(op, (is_start, values))
+    return out
+
+
+def running_min_range(values: jax.Array, valid: jax.Array,
+                      layout: WindowLayout, ident) -> jax.Array:
+    v = jnp.where(valid & layout.live, values, ident)
+    scanned = _segmented_scan(v, layout.pos == layout.seg_start, jnp.minimum)
+    return scanned[layout.run_last]
+
+
+def running_max_range(values: jax.Array, valid: jax.Array,
+                      layout: WindowLayout, ident) -> jax.Array:
+    v = jnp.where(valid & layout.live, values, ident)
+    scanned = _segmented_scan(v, layout.pos == layout.seg_start, jnp.maximum)
+    return scanned[layout.run_last]
+
+
+def whole_partition_agg(values: jax.Array, valid: jax.Array,
+                        layout: WindowLayout, op: str, dtype):
+    """UNBOUNDED PRECEDING..UNBOUNDED FOLLOWING (value broadcast)."""
+    cap = values.shape[0]
+    contrib_valid = valid & layout.live
+    if op == "sum":
+        by_id = jax.ops.segment_sum(
+            jnp.where(contrib_valid, values.astype(dtype), 0), layout.seg,
+            num_segments=cap)
+    elif op == "count":
+        by_id = jax.ops.segment_sum(contrib_valid.astype(jnp.int64),
+                                    layout.seg, num_segments=cap)
+    elif op == "min":
+        by_id = jax.ops.segment_min(
+            jnp.where(contrib_valid, values, jnp.asarray(jnp.inf, values.dtype)
+                      if jnp.issubdtype(values.dtype, jnp.floating)
+                      else jnp.iinfo(values.dtype).max),
+            layout.seg, num_segments=cap)
+    elif op == "max":
+        by_id = jax.ops.segment_max(
+            jnp.where(contrib_valid, values, jnp.asarray(-jnp.inf, values.dtype)
+                      if jnp.issubdtype(values.dtype, jnp.floating)
+                      else jnp.iinfo(values.dtype).min),
+            layout.seg, num_segments=cap)
+    else:
+        raise NotImplementedError(op)
+    n_by_id = jax.ops.segment_sum(contrib_valid.astype(jnp.int64), layout.seg,
+                                  num_segments=cap)
+    return by_id[layout.seg], n_by_id[layout.seg]
+
+
+def shift(values: jax.Array, validity: jax.Array, layout: WindowLayout,
+          offset: int):
+    """LEAD(offset>0)/LAG(offset<0): value at pos+offset within the same
+    partition, else null."""
+    cap = values.shape[0]
+    idx = layout.pos + offset
+    in_seg = (idx >= layout.seg_start) & (idx < layout.seg_end) & layout.live
+    safe = jnp.clip(idx, 0, cap - 1)
+    vals = jnp.where(in_seg, values[safe], jnp.zeros((), values.dtype))
+    valid = in_seg & jnp.where(in_seg, validity[safe], False)
+    return vals, valid

@@ -348,6 +348,144 @@ class CpuEngine:
                 buckets[p].append(t.take(np.nonzero(assign == p)[0]))
         return [CpuTable.concat(bs, plan.schema) for bs in buckets]
 
+    def _exec_window(self, plan: L.Window):
+        """Row-wise obvious window implementation: python loop per
+        partition run — the oracle for the segmented-scan kernels."""
+        from spark_rapids_tpu.expressions.core import Alias
+        from spark_rapids_tpu.expressions.window import (
+            DenseRank, Lag, Lead, Rank, RowNumber, WindowExpression)
+        from spark_rapids_tpu.expressions.aggregates import AggregateFunction
+
+        t = CpuTable.concat(self._exec(plan.child), plan.child.schema)
+        ctx = t.ctx()
+        spec = plan.spec
+        pkeys = [(e.eval_cpu(ctx), e.dtype) for e in spec.partition_by]
+        okeys = [(e.eval_cpu(ctx), e.dtype, o) for e, o in spec.order_by]
+
+        # sort rows by (pkeys, okeys) with Spark ordering
+        def keyfn(r):
+            pk = tuple(_norm_key(v[r], m[r], dt) for (v, m), dt in pkeys)
+            ok = tuple(_sort_key_for(v[r], m[r], dt, o)
+                       for (v, m), dt, o in okeys)
+            return (pk, ok)
+
+        def pkey_of(r):
+            return tuple(_norm_key(v[r], m[r], dt) for (v, m), dt in pkeys)
+
+        def okey_of(r):
+            return tuple(_norm_key(v[r], m[r], dt) for (v, m), dt, _ in okeys)
+
+        idx = sorted(range(t.num_rows),
+                     key=lambda r: (tuple(
+                         _SortKey(0, _norm_key(v[r], m[r], dt))
+                         if False else _sort_key_for(v[r], m[r], dt,
+                                                     SortOrder(True))
+                         for (v, m), dt in pkeys),
+                         tuple(_sort_key_for(v[r], m[r], dt, o)
+                               for (v, m), dt, o in okeys)))
+        sorted_t = t.take(np.array(idx, dtype=np.int64))
+        sctx = sorted_t.ctx()
+
+        # partition runs over sorted order
+        runs: List[Tuple[int, int]] = []
+        start = 0
+        for i in range(1, t.num_rows + 1):
+            if i == t.num_rows or pkey_of(idx[i]) != pkey_of(idx[i - 1]):
+                runs.append((start, i))
+                start = i
+        out_cols = list(sorted_t.cols)
+        n = t.num_rows
+
+        for e in plan.window_exprs:
+            inner = e.child if isinstance(e, Alias) else e
+            assert isinstance(inner, WindowExpression)
+            fn = inner.function
+            vals = np.zeros((n,), object if inner.dtype.variable_width
+                            else inner.dtype.np_dtype)
+            valid = np.zeros((n,), np.bool_)
+            for (lo, hi) in runs:
+                rows = list(range(lo, hi))
+                # peer runs (order-key ties) within the partition
+                peers = []
+                s = 0
+                for i in range(1, len(rows) + 1):
+                    if i == len(rows) or okey_of(idx[lo + i]) != okey_of(idx[lo + i - 1]):
+                        peers.append((s, i))
+                        s = i
+                peer_of = {}
+                for pi, (ps, pe) in enumerate(peers):
+                    for i in range(ps, pe):
+                        peer_of[i] = (pi, ps, pe)
+                if isinstance(fn, RowNumber):
+                    for i in range(len(rows)):
+                        vals[lo + i] = i + 1
+                        valid[lo + i] = True
+                elif isinstance(fn, Rank):
+                    for i in range(len(rows)):
+                        vals[lo + i] = peer_of[i][1] + 1
+                        valid[lo + i] = True
+                elif isinstance(fn, DenseRank):
+                    for i in range(len(rows)):
+                        vals[lo + i] = peer_of[i][0] + 1
+                        valid[lo + i] = True
+                elif isinstance(fn, (Lead, Lag)):
+                    cv, cm = fn.child.eval_cpu(sctx)
+                    off = fn.offset if isinstance(fn, Lead) and not isinstance(fn, Lag) else -fn.offset
+                    for i in range(len(rows)):
+                        j = i + off
+                        if 0 <= j < len(rows) and cm[lo + j]:
+                            vals[lo + i] = cv[lo + j]
+                            valid[lo + i] = True
+                elif isinstance(fn, AggregateFunction):
+                    cv, cm = (fn.input.eval_cpu(sctx) if fn.input is not None
+                              else (np.zeros((n,)), np.ones((n,), np.bool_)))
+                    frame = inner.spec.frame
+                    for i in range(len(rows)):
+                        if frame.is_unbounded_both():
+                            f_lo, f_hi = 0, len(rows)
+                        elif frame.kind == "range" and frame.is_unbounded_to_current():
+                            f_lo, f_hi = 0, peer_of[i][2]
+                        else:  # rows frame
+                            f_lo = (0 if frame.start is None
+                                    else max(i + frame.start, 0))
+                            f_hi = (len(rows) if frame.end is None
+                                    else min(i + frame.end + 1, len(rows)))
+                        sel = [lo + j for j in range(f_lo, f_hi)]
+                        sub_v = np.array([cv[s] for s in sel
+                                          if cm[s]])
+                        bufs = []
+                        from spark_rapids_tpu.expressions.aggregates import (
+                            COUNT_STAR, COUNT_VALID, MAX, MIN, SUM)
+                        for slot in fn.buffers:
+                            if slot.update_op == COUNT_STAR:
+                                bv = np.array([len(sel)], slot.dtype.np_dtype)
+                            elif slot.update_op == COUNT_VALID:
+                                bv = np.array([len(sub_v)], slot.dtype.np_dtype)
+                            elif len(sub_v) == 0:
+                                bv = np.array([0], slot.dtype.np_dtype)
+                            elif slot.update_op == SUM:
+                                with np.errstate(all="ignore"):
+                                    bv = np.array(
+                                        [sub_v.astype(slot.dtype.np_dtype).sum()],
+                                        slot.dtype.np_dtype)
+                            elif slot.update_op == MIN:
+                                bv = np.array([_extreme_np(sub_v, slot.dtype, True)],
+                                              slot.dtype.np_dtype)
+                            elif slot.update_op == MAX:
+                                bv = np.array([_extreme_np(sub_v, slot.dtype, False)],
+                                              slot.dtype.np_dtype)
+                            else:
+                                raise NotImplementedError(slot.update_op)
+                            bufs.append((bv, np.ones((1,), np.bool_)))
+                        fv, fm = fn.finalize_np(bufs)
+                        if fm[0]:
+                            vals[lo + i] = fv[0]
+                            valid[lo + i] = True
+                else:
+                    raise NotImplementedError(type(fn).__name__)
+            out_cols.append((cpu_zero_invalid(vals, valid), valid))
+        return [CpuTable(out_cols, n, plan.schema)]
+
     def _exec_join(self, plan: L.Join):
         left = CpuTable.concat(self._exec(plan.left), plan.left.schema)
         right = CpuTable.concat(self._exec(plan.right), plan.right.schema)
@@ -414,15 +552,24 @@ class CpuEngine:
 
         la = np.array(lidx, dtype=np.int64)
         ra = np.array(ridx, dtype=np.int64)
-        cols = []
-        for (v, m) in left.cols:
-            gv = v[np.clip(la, 0, None)] if len(la) else v[:0]
-            gm = np.where(la >= 0, m[np.clip(la, 0, None)], False) if len(la) else m[:0]
-            cols.append((cpu_zero_invalid(gv, gm), gm))
-        for (v, m) in right.cols:
-            gv = v[np.clip(ra, 0, None)] if len(ra) else v[:0]
-            gm = np.where(ra >= 0, m[np.clip(ra, 0, None)], False) if len(ra) else m[:0]
-            cols.append((cpu_zero_invalid(gv, gm), gm))
+
+        def gather_side(cols_in, idx):
+            out = []
+            for (v, m) in cols_in:
+                if len(idx) == 0:
+                    out.append((v[:0], m[:0]))
+                    continue
+                if v.shape[0] == 0:   # null-extending against an empty side
+                    gv = np.zeros((len(idx),), v.dtype)
+                    gm = np.zeros((len(idx),), np.bool_)
+                else:
+                    safe = np.clip(idx, 0, v.shape[0] - 1)
+                    gv = v[safe]
+                    gm = np.where(idx >= 0, m[safe], False)
+                out.append((cpu_zero_invalid(gv, gm), gm))
+            return out
+
+        cols = gather_side(left.cols, la) + gather_side(right.cols, ra)
         joined = CpuTable(cols, len(la), plan.schema)
         if plan.condition is not None:
             v, m = plan.condition.eval_cpu(joined.ctx())

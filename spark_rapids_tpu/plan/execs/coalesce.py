@@ -1,0 +1,34 @@
+"""Batch coalescing helper + exec.
+
+Reference: GpuCoalesceBatches.scala:260 (concat to target size goals with
+retry) and GpuShuffleCoalesceExec.scala:72.  The capacity-retry loop is the
+static-shape analog of the reference's concat-with-retry.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import round_up_pow2
+from spark_rapids_tpu.kernels.selection import concat_batches_device
+from spark_rapids_tpu.memory.retry import with_capacity_retry
+
+
+def coalesce_to_one(batches: List[ColumnarBatch]) -> Optional[ColumnarBatch]:
+    """Concat same-schema batches into one (None for empty input)."""
+    if not batches:
+        return None
+    if len(batches) == 1:
+        return batches[0]
+    total = sum(b.host_num_rows() for b in batches)
+    cap0 = round_up_pow2(max(total, 1))
+
+    def run(cap):
+        return concat_batches_device(batches, cap)
+
+    def check(res):
+        need = int(res[1].required_rows)
+        return None if need <= res[0].capacity else need
+
+    out, _ = with_capacity_retry(run, check, cap0)
+    return out

@@ -34,13 +34,30 @@ from spark_rapids_tpu.plan.execs.base import TpuExec, timed
 
 
 class TpuShuffleExchangeExec(TpuExec):
+    """Two shuffle manager modes, mirroring the reference's mode switch
+    (RapidsShuffleInternalManagerBase.scala:1751):
+
+      * CACHE_ONLY: partition slices stay device-resident as spillable
+        handles in the in-process catalog (RapidsCachingWriter analog);
+      * MULTITHREADED: slices are serialized to the tpu-kudo host wire
+        format on a writer thread pool and merged back on read
+        (RapidsShuffleThreadedWriterBase/ReaderBase analog) — the mode
+        that generalizes to multi-host transports.
+    """
+
     def __init__(self, num_partitions: int, keys: Sequence[Expression],
-                 child: TpuExec, schema: Optional[Schema] = None):
+                 child: TpuExec, schema: Optional[Schema] = None,
+                 mode: str = "CACHE_ONLY", writer_threads: int = 4,
+                 codec: str = "none"):
         super().__init__((child,), schema or child.schema)
         self.out_partitions = num_partitions
         self.keys = tuple(keys)
+        self.mode = mode
+        self.writer_threads = writer_threads
+        self.codec = codec
         self._lock = threading.Lock()
         self._materialized: Optional[List[List[SpillableBatchHandle]]] = None
+        self._wire: Optional[List[List[bytes]]] = None
 
         def slice_step(batch: ColumnarBatch):
             """Device: append key columns, partition, return reordered batch
@@ -70,36 +87,69 @@ class TpuShuffleExchangeExec(TpuExec):
 
     # -- map side -----------------------------------------------------------
 
+    def _slices(self):
+        """Device-side slice of every input batch -> (partition, piece)."""
+        child = self.children[0]
+        for in_part in range(child.num_partitions()):
+            for batch in child.execute_partition(in_part):
+                with timed(self.op_time):
+                    reordered, counts = with_retry_no_split(
+                        lambda: self._jit_slice(batch))
+                    host_counts = np.asarray(counts)
+                    offsets = np.zeros(self.out_partitions + 1, np.int64)
+                    np.cumsum(host_counts, out=offsets[1:])
+                    for p in range(self.out_partitions):
+                        cnt = int(host_counts[p])
+                        if cnt == 0:
+                            continue
+                        cap = round_up_pow2(cnt)
+                        idx = jnp.arange(cap, dtype=jnp.int32) + jnp.int32(offsets[p])
+                        piece = gather_batch(reordered, idx,
+                                             jnp.int32(cnt), out_capacity=cap)
+                        yield p, piece
+
     def _materialize(self) -> List[List[SpillableBatchHandle]]:
         with self._lock:
             if self._materialized is not None:
                 return self._materialized
             buckets: List[List[SpillableBatchHandle]] = [
                 [] for _ in range(self.out_partitions)]
-            child = self.children[0]
-            for in_part in range(child.num_partitions()):
-                for batch in child.execute_partition(in_part):
-                    with timed(self.op_time):
-                        reordered, counts = with_retry_no_split(
-                            lambda: self._jit_slice(batch))
-                        host_counts = np.asarray(counts)
-                        offsets = np.zeros(self.out_partitions + 1, np.int64)
-                        np.cumsum(host_counts, out=offsets[1:])
-                        for p in range(self.out_partitions):
-                            cnt = int(host_counts[p])
-                            if cnt == 0:
-                                continue
-                            cap = round_up_pow2(cnt)
-                            idx = jnp.arange(cap, dtype=jnp.int32) + jnp.int32(offsets[p])
-                            piece = gather_batch(reordered, idx,
-                                                 jnp.int32(cnt), out_capacity=cap)
-                            buckets[p].append(make_spillable(piece))
+            for p, piece in self._slices():
+                buckets[p].append(make_spillable(piece))
             self._materialized = buckets
+            return buckets
+
+    def _materialize_wire(self) -> List[List[bytes]]:
+        """MULTITHREADED writer: serialize slices on a thread pool."""
+        from concurrent.futures import ThreadPoolExecutor
+        from spark_rapids_tpu.shuffle.serializer import serialize_batch
+        with self._lock:
+            if self._wire is not None:
+                return self._wire
+            buckets: List[List[bytes]] = [[] for _ in range(self.out_partitions)]
+            with ThreadPoolExecutor(max_workers=self.writer_threads) as pool:
+                futures = []
+                for p, piece in self._slices():
+                    futures.append((p, pool.submit(
+                        serialize_batch, piece, self.codec)))
+                for p, fut in futures:
+                    buckets[p].append(fut.result())
+            self._wire = buckets
             return buckets
 
     # -- reduce side --------------------------------------------------------
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        if self.mode == "MULTITHREADED":
+            from spark_rapids_tpu.shuffle.serializer import merge_batches
+            buffers = self._materialize_wire()[idx]
+            if not buffers:
+                return
+            with timed(self.op_time):
+                out = merge_batches(buffers, self.schema)
+            self.output_rows.add(out.host_num_rows())
+            yield self._count_out(out)
+            return
         buckets = self._materialize()
         handles = buckets[idx]
         if not handles:

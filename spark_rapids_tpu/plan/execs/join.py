@@ -1,0 +1,180 @@
+"""Join execs.
+
+Reference: GpuShuffledHashJoinExec / GpuBroadcastHashJoinExecBase /
+GpuShuffledSizedHashJoinExec (org/apache/spark/sql/rapids/execution/
+GpuHashJoin.scala — gather-map iterators at :1136).
+
+TpuShuffledHashJoinExec: both sides arrive hash-partitioned on the join
+keys (the planner inserts the exchanges); partition i joins left[i] x
+right[i] with the sort-merge gather-map kernel (kernels/join.py) under the
+capacity-retry loop.  TpuBroadcastHashJoinExec materializes the whole build
+side once (the broadcast) and streams the other side's partitions.
+"""
+from __future__ import annotations
+
+import threading
+from functools import lru_cache
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import round_up_pow2
+from spark_rapids_tpu.expressions.core import EvalContext, Expression
+from spark_rapids_tpu.kernels.join import apply_gather_maps, join_gather_maps
+from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_split
+from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
+
+
+class _JoinKernel:
+    """jit cache over (out_capacity static, shapes implicit)."""
+
+    def __init__(self, left_key_idx, right_key_idx, join_type: str,
+                 schema: Schema):
+        self.left_key_idx = tuple(left_key_idx)
+        self.right_key_idx = tuple(right_key_idx)
+        self.join_type = join_type
+        self.schema = schema
+
+        @lru_cache(maxsize=64)
+        def jitted(out_capacity: int):
+            def run(l: ColumnarBatch, r: ColumnarBatch):
+                li, ri, count, status = join_gather_maps(
+                    l, self.left_key_idx, r, self.right_key_idx,
+                    self.join_type, out_capacity)
+                out = apply_gather_maps(l, r, li, ri, count, self.schema,
+                                        self.join_type, out_capacity)
+                return out, status
+            return jax.jit(run)
+
+        self._jitted = jitted
+
+    def __call__(self, l: ColumnarBatch, r: ColumnarBatch) -> ColumnarBatch:
+        nl, nr = l.host_num_rows(), r.host_num_rows()
+        if self.join_type == "cross":
+            guess = max(nl * max(nr, 1), 1)
+        elif self.join_type in ("left_semi", "left_anti"):
+            guess = max(nl, 1)
+        else:
+            guess = max(nl + nr, 1)
+
+        def run(cap):
+            return with_retry_no_split(lambda: self._jitted(cap)(l, r))
+
+        def check(res):
+            need = int(res[1].required_rows)
+            return None if need <= res[0].capacity else need
+
+        out, _ = with_capacity_retry(run, check, round_up_pow2(guess))
+        return out
+
+
+class TpuShuffledHashJoinExec(TpuExec):
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 join_type: str, schema: Schema):
+        super().__init__((left, right), schema)
+        self.join_type = join_type
+        # keys are bound refs into each side's schema; resolve ordinals
+        self.left_key_idx = [self._ordinal(k, left.schema) for k in left_keys]
+        self.right_key_idx = [self._ordinal(k, right.schema) for k in right_keys]
+        self._kernel = _JoinKernel(self.left_key_idx, self.right_key_idx,
+                                   join_type, schema)
+
+    @staticmethod
+    def _ordinal(key: Expression, schema: Schema) -> int:
+        from spark_rapids_tpu.expressions.core import BoundReference
+        assert isinstance(key, BoundReference), \
+            "planner must project non-trivial join keys first"
+        return key.ordinal
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions()
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        left = coalesce_to_one(list(self.children[0].execute_partition(idx)))
+        right = coalesce_to_one(list(self.children[1].execute_partition(idx)))
+        if left is None and right is None:
+            return
+        if left is None:
+            if self.join_type in ("inner", "left", "left_semi", "left_anti",
+                                  "cross"):
+                return
+            left = ColumnarBatch.empty(self.children[0].schema)
+        if right is None:
+            if self.join_type in ("inner", "right", "cross"):
+                return
+            if self.join_type == "left_semi":
+                return
+            right = ColumnarBatch.empty(self.children[1].schema)
+        with timed(self.op_time):
+            out = self._kernel(left, right)
+        if out.host_num_rows() == 0:
+            return
+        self.output_rows.add(out.host_num_rows())
+        yield self._count_out(out)
+
+    def describe(self):
+        return (f"TpuShuffledHashJoin[{self.join_type}, "
+                f"lkeys={self.left_key_idx}, rkeys={self.right_key_idx}]")
+
+
+class TpuBroadcastHashJoinExec(TpuExec):
+    """Streams the left side; the right (build) side is materialized whole
+    once and joined against every stream partition."""
+
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 join_type: str, schema: Schema):
+        assert join_type in ("inner", "left", "left_semi", "left_anti",
+                             "cross"), \
+            "broadcast build side must be on the null-extending side"
+        super().__init__((left, right), schema)
+        self.join_type = join_type
+        self.left_key_idx = [TpuShuffledHashJoinExec._ordinal(k, left.schema)
+                             for k in left_keys]
+        self.right_key_idx = [TpuShuffledHashJoinExec._ordinal(k, right.schema)
+                              for k in right_keys]
+        self._kernel = _JoinKernel(self.left_key_idx, self.right_key_idx,
+                                   join_type, schema)
+        self._lock = threading.Lock()
+        self._build: Optional[ColumnarBatch] = None
+        self._build_done = False
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions()
+
+    def _build_side(self) -> Optional[ColumnarBatch]:
+        with self._lock:
+            if not self._build_done:
+                batches = []
+                right = self.children[1]
+                for p in range(right.num_partitions()):
+                    batches.extend(right.execute_partition(p))
+                self._build = coalesce_to_one(batches)
+                self._build_done = True
+            return self._build
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        build = self._build_side()
+        left = coalesce_to_one(list(self.children[0].execute_partition(idx)))
+        if left is None:
+            return
+        if build is None:
+            if self.join_type in ("inner", "cross", "left_semi"):
+                return
+            build = ColumnarBatch.empty(self.children[1].schema)
+        with timed(self.op_time):
+            out = self._kernel(left, build)
+        if out.host_num_rows() == 0:
+            return
+        self.output_rows.add(out.host_num_rows())
+        yield self._count_out(out)
+
+    def describe(self):
+        return (f"TpuBroadcastHashJoin[{self.join_type}, "
+                f"lkeys={self.left_key_idx}, rkeys={self.right_key_idx}]")

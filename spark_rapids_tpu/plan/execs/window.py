@@ -1,0 +1,175 @@
+"""Window exec: partition-sorted segmented-scan evaluation.
+
+Reference: window/GpuWindowExec.scala:145 (sorted window calc),
+GpuRunningWindowExec (running frames).  The planner co-locates window
+partitions via a hash exchange on the partition keys (as Spark plans
+Window) so each task sees whole partitions; one lexsort + segmented scans
+(kernels/window.py) produce every window column in a single jitted step.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expressions.core import Alias, EvalContext, Expression
+from spark_rapids_tpu.expressions.aggregates import (
+    Average, Count, Max, Min, Sum)
+from spark_rapids_tpu.expressions.window import (
+    DenseRank, Lag, Lead, Rank, RowNumber, WindowExpression, WindowFrame)
+from spark_rapids_tpu.kernels import window as WK
+from spark_rapids_tpu.kernels.groupby import (
+    _rows_equal_prev, normalize_key_column)
+from spark_rapids_tpu.kernels.selection import gather_batch
+from spark_rapids_tpu.kernels.sort import SortOrder, sort_indices
+from spark_rapids_tpu.memory.retry import with_retry_no_split
+from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
+
+
+def _unwrap(e: Expression) -> WindowExpression:
+    return e.child if isinstance(e, Alias) else e
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, window_exprs: Sequence[Expression], child: TpuExec,
+                 schema: Schema):
+        super().__init__((child,), schema)
+        self.window_exprs = tuple(window_exprs)
+        self.spec = _unwrap(self.window_exprs[0]).spec
+        self._run = jax.jit(self._step)
+
+    def _step(self, batch: ColumnarBatch) -> ColumnarBatch:
+        ctx = EvalContext(batch)
+        spec = self.spec
+        pcols = [normalize_key_column(e.eval(ctx)) for e in spec.partition_by]
+        ocols = [normalize_key_column(e.eval(ctx)) for e, _ in spec.order_by]
+        nbase = len(batch.schema)
+        work_cols = tuple(batch.columns) + tuple(pcols) + tuple(ocols)
+        work = ColumnarBatch(
+            work_cols, batch.num_rows,
+            Schema(tuple(batch.schema.names)
+                   + tuple(f"_p{i}" for i in range(len(pcols)))
+                   + tuple(f"_o{i}" for i in range(len(ocols))),
+                   tuple(c.dtype for c in work_cols)))
+        key_idx = list(range(nbase, nbase + len(pcols) + len(ocols)))
+        orders = ([SortOrder(True, True)] * len(pcols)
+                  + [o for _, o in spec.order_by])
+        idx = sort_indices(work, key_idx, orders, string_max_bytes=0)
+        sw = gather_batch(work, idx, work.num_rows)
+        live = sw.live_mask()
+        first = jnp.arange(sw.capacity, dtype=jnp.int32) == 0
+
+        part_eq = jnp.ones((sw.capacity,), jnp.bool_)
+        for i in range(len(pcols)):
+            part_eq = part_eq & _rows_equal_prev(sw.columns[nbase + i])
+        peer_eq = part_eq
+        for i in range(len(ocols)):
+            peer_eq = peer_eq & _rows_equal_prev(
+                sw.columns[nbase + len(pcols) + i])
+        part_boundary = live & (first | ~part_eq)
+        peer_boundary = live & (first | ~peer_eq)
+        layout = WK.window_layout(part_boundary, peer_boundary, live)
+
+        sorted_input = ColumnarBatch(sw.columns[:nbase], sw.num_rows,
+                                     batch.schema)
+        sctx = EvalContext(sorted_input)
+        out_cols: List[DeviceColumn] = list(sorted_input.columns)
+        for e in self.window_exprs:
+            out_cols.append(self._window_column(_unwrap(e), layout, sctx))
+        return ColumnarBatch(tuple(out_cols), sw.num_rows, self.schema)
+
+    def _window_column(self, we: WindowExpression, layout: WK.WindowLayout,
+                       sctx: EvalContext) -> DeviceColumn:
+        fn = we.function
+        frame = we.spec.frame
+        if isinstance(fn, RowNumber):
+            return DeviceColumn(WK.row_number(layout), layout.live, T.INT)
+        if isinstance(fn, DenseRank):
+            return DeviceColumn(WK.dense_rank(layout), layout.live, T.INT)
+        if isinstance(fn, Rank):
+            return DeviceColumn(WK.rank(layout), layout.live, T.INT)
+        if isinstance(fn, (Lead, Lag)):
+            c = fn.child.eval(sctx)
+            off = fn.offset if not isinstance(fn, Lag) else -fn.offset
+            vals, valid = WK.shift(c.data, c.validity, layout, off)
+            return DeviceColumn(
+                jnp.where(valid, vals, jnp.zeros((), vals.dtype)),
+                valid, fn.dtype)
+
+        # aggregate window functions
+        out_dt = fn.dtype
+        if fn.input is not None:
+            c = fn.input.eval(sctx)
+            vals, valid = c.data, c.validity
+        else:
+            vals = jnp.zeros((layout.pos.shape[0],), jnp.int64)
+            valid = jnp.ones((layout.pos.shape[0],), jnp.bool_)
+
+        def from_sum_count(s, n):
+            if isinstance(fn, Count):
+                return DeviceColumn(n.astype(jnp.int64), layout.live, T.LONG)
+            if isinstance(fn, Average):
+                ok = (n > 0) & layout.live
+                avg = s.astype(jnp.float64) / jnp.where(n > 0, n, 1)
+                return DeviceColumn(jnp.where(ok, avg, 0.0), ok, T.DOUBLE)
+            ok = (n > 0) & layout.live
+            sv = s.astype(out_dt.jnp_dtype)
+            return DeviceColumn(jnp.where(ok, sv, jnp.zeros((), sv.dtype)),
+                                ok, out_dt)
+
+        sum_dt = (jnp.float64 if out_dt.is_floating or isinstance(fn, Average)
+                  else jnp.int64)
+        if frame.is_unbounded_both():
+            if isinstance(fn, (Min, Max)):
+                op = "min" if isinstance(fn, Min) else "max"
+                v, n = WK.whole_partition_agg(vals, valid, layout, op, sum_dt)
+                ok = (n > 0) & layout.live
+                return DeviceColumn(jnp.where(ok, v, jnp.zeros((), v.dtype)),
+                                    ok, out_dt)
+            op = "count" if isinstance(fn, Count) else "sum"
+            s, n = WK.whole_partition_agg(vals, valid, layout, "sum", sum_dt)
+            return from_sum_count(s, n)
+        if frame.kind == "range" and frame.is_unbounded_to_current():
+            if isinstance(fn, Min):
+                ident = jnp.asarray(jnp.inf, vals.dtype) \
+                    if jnp.issubdtype(vals.dtype, jnp.floating) \
+                    else jnp.iinfo(vals.dtype).max
+                v = WK.running_min_range(vals, valid, layout, ident)
+                _, n = WK.running_sum_range(vals, valid, layout, sum_dt)
+                ok = (n > 0) & layout.live
+                return DeviceColumn(jnp.where(ok, v, jnp.zeros((), v.dtype)),
+                                    ok, out_dt)
+            if isinstance(fn, Max):
+                ident = jnp.asarray(-jnp.inf, vals.dtype) \
+                    if jnp.issubdtype(vals.dtype, jnp.floating) \
+                    else jnp.iinfo(vals.dtype).min
+                v = WK.running_max_range(vals, valid, layout, ident)
+                _, n = WK.running_sum_range(vals, valid, layout, sum_dt)
+                ok = (n > 0) & layout.live
+                return DeviceColumn(jnp.where(ok, v, jnp.zeros((), v.dtype)),
+                                    ok, out_dt)
+            s, n = WK.running_sum_range(vals, valid, layout, sum_dt)
+            return from_sum_count(s, n)
+        # ROWS frame
+        s, n = WK.rows_frame_sum(
+            vals, valid, layout,
+            None if frame.start is None else -frame.start,
+            frame.end, sum_dt)
+        return from_sum_count(s, n)
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        merged = coalesce_to_one(list(self.children[0].execute_partition(idx)))
+        if merged is None:
+            return
+        with timed(self.op_time):
+            out = with_retry_no_split(lambda: self._run(merged))
+        self.output_rows.add(out.host_num_rows())
+        yield self._count_out(out)
+
+    def describe(self):
+        return f"TpuWindow[{', '.join(map(repr, self.window_exprs))}]"

@@ -231,6 +231,38 @@ class Union(LogicalPlan):
         return f"Union[{len(self.children)}]"
 
 
+class Window(LogicalPlan):
+    """Append window-function columns.  All window_exprs must share one
+    WindowSpec partitioning (Spark splits differing specs into separate
+    Window nodes; our frontend does the same)."""
+
+    def __init__(self, window_exprs: Sequence[Expression], child: LogicalPlan):
+        from spark_rapids_tpu.expressions.window import WindowExpression
+        self.window_exprs = tuple(e.bind(child.schema) for e in window_exprs)
+        self.child = child
+        self.children = (child,)
+        names = list(child.schema.names)
+        dtypes = list(child.schema.dtypes)
+        for i, e in enumerate(self.window_exprs):
+            names.append(output_name(e, len(names)))
+            dtypes.append(e.dtype)
+        self._schema = Schema(tuple(names), tuple(dtypes))
+
+        def unwrap(e):
+            return e.child if isinstance(e, Alias) else e
+        specs = [unwrap(e).spec for e in self.window_exprs
+                 if isinstance(unwrap(e), WindowExpression)]
+        assert specs, "Window node needs window expressions"
+        self.spec = specs[0]
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"Window[{', '.join(map(repr, self.window_exprs))}]"
+
+
 class Repartition(LogicalPlan):
     """Exchange: hash-partition child rows into num_partitions by keys
     (round-robin when keys empty)."""

@@ -40,6 +40,10 @@ from spark_rapids_tpu.plan.execs.scan import (
     TpuInMemoryScanExec, TpuParquetScanExec)
 from spark_rapids_tpu.plan.execs.sort import TpuLimitExec, TpuSortExec
 
+from spark_rapids_tpu.expressions.strings import (
+    Contains, ConcatStrings, EndsWith, Length, Like, Lower, StartsWith,
+    Substring, Trim, Upper)
+
 # expression classes with device twins; the TypeSig-style dtype gate is
 # checked separately (supported_dtype)
 _SUPPORTED_EXPRS = {
@@ -50,17 +54,30 @@ _SUPPORTED_EXPRS = {
     GreaterThanOrEqual,
     If, CaseWhen, Cast,
     A.Sum, A.Count, A.Min, A.Max, A.Average,
+    Length, Upper, Lower, Substring, ConcatStrings, Trim,
+    StartsWith, EndsWith, Contains, Like,
 }
 
-# dtypes device kernels fully support in compute today (strings flow through
-# scans/shuffles/sorts but string *functions* are still landing)
+from spark_rapids_tpu.expressions.window import (
+    DenseRank, Lag, Lead, Rank, RowNumber, WindowExpression)
+
+_SUPPORTED_EXPRS |= {WindowExpression, RowNumber, Rank, DenseRank, Lead, Lag}
+
+# dtypes device kernels support in expression compute
 _COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
                T.LongType, T.FloatType, T.DoubleType, T.DateType,
-               T.TimestampType, T.NullType)
+               T.TimestampType, T.NullType, T.StringType)
 
 
 def _dtype_ok(dt: T.DataType) -> bool:
     return isinstance(dt, _COMPUTE_OK)
+
+
+def _key_dtype_ok(dt: T.DataType) -> bool:
+    """Sort/group/partition/join keys: fixed-width only for now — the
+    string-key paths need the max-bytes bucket threaded through the execs
+    (kernels support it; the exec wiring is the follow-on)."""
+    return _dtype_ok(dt) and not dt.variable_width
 
 
 class ExprMeta:
@@ -83,11 +100,19 @@ class ExprMeta:
                 if not _dtype_ok(e.dtype):
                     self.will_not_work(
                         f"produces unsupported type {e.dtype!r}")
-            except (TypeError, NotImplementedError):
+            except (TypeError, ValueError, NotImplementedError):
                 pass
             if isinstance(e, Cast) and not Cast.supported(e.child.dtype, e.dtype):
                 self.will_not_work(
                     f"cast {e.child.dtype!r} -> {e.dtype!r} is not supported")
+            if isinstance(e, (StartsWith, EndsWith, Contains)) and \
+                    not isinstance(e.right, E.Literal):
+                self.will_not_work(
+                    "non-literal match patterns are not supported yet")
+            if isinstance(e, Like) and not Like.supported_pattern(e.pattern):
+                self.will_not_work(
+                    f"LIKE pattern {e.pattern!r} needs the general regex "
+                    "engine (only prefix/suffix/contains shapes run on TPU)")
         for c in self.children:
             c.tag()
 
@@ -118,6 +143,8 @@ class PlanMeta:
 
     def _expressions(self) -> List[E.Expression]:
         p = self.plan
+        if isinstance(p, L.Window):
+            return [e for e in p.window_exprs]
         if isinstance(p, L.Project):
             return list(p.exprs)
         if isinstance(p, L.Filter):
@@ -143,10 +170,31 @@ class PlanMeta:
         for em in self.expr_metas:
             em.tag()
         if isinstance(p, L.Join):
-            self.will_not_work("join execution on TPU is not implemented yet")
+            for e in list(p.left_keys) + list(p.right_keys):
+                if not _key_dtype_ok(e.dtype):
+                    self.will_not_work(
+                        f"join key type {e.dtype!r} not supported yet")
+                if not isinstance(e, E.BoundReference):
+                    self.will_not_work(
+                        f"computed join key {e!r} not supported yet "
+                        "(project it first)")
+            for lk, rk in zip(p.left_keys, p.right_keys):
+                try:
+                    if not (lk.dtype == rk.dtype):
+                        # mixed-type keys hash-partition differently on the
+                        # two sides; Spark inserts casts at analysis — our
+                        # frontend should too (follow-on), fall back for now
+                        self.will_not_work(
+                            f"join key types differ: {lk.dtype!r} vs "
+                            f"{rk.dtype!r} (add explicit casts)")
+                except (TypeError, ValueError, NotImplementedError):
+                    pass
+            if p.condition is not None and p.join_type != "inner":
+                self.will_not_work(
+                    "residual join conditions only supported for inner joins")
         if isinstance(p, L.Aggregate):
             for e in p.group_exprs:
-                if not _dtype_ok(e.dtype):
+                if not _key_dtype_ok(e.dtype):
                     self.will_not_work(
                         f"grouping key type {e.dtype!r} not supported yet")
             for e in p.agg_exprs:
@@ -155,14 +203,16 @@ class PlanMeta:
                         f"non-aggregate column {sub!r} in aggregate output")
         if isinstance(p, L.Sort):
             for e, _ in p.orders:
-                if not _dtype_ok(e.dtype):
+                if not _key_dtype_ok(e.dtype):
                     self.will_not_work(
                         f"sort key type {e.dtype!r} not supported yet")
         if isinstance(p, L.Repartition):
             for e in p.keys:
-                if not _dtype_ok(e.dtype):
+                if not _key_dtype_ok(e.dtype):
                     self.will_not_work(
                         f"partition key type {e.dtype!r} not supported yet")
+        if isinstance(p, L.Window):
+            self._tag_window(p)
         for c in self.children:
             c.tag()
 
@@ -211,8 +261,8 @@ class PlanMeta:
         if isinstance(p, L.Limit):
             return TpuLimitExec(p.n, self.children[0].convert())
         if isinstance(p, L.Repartition):
-            return TpuShuffleExchangeExec(p.num_partitions, p.keys,
-                                          self.children[0].convert())
+            return self._exchange(p.num_partitions, p.keys,
+                                  self.children[0].convert())
         if isinstance(p, L.Sort):
             child = self.children[0].convert()
             if p.global_sort and child.num_partitions() > 1:
@@ -220,7 +270,89 @@ class PlanMeta:
             return TpuSortExec(p.orders, child)
         if isinstance(p, L.Aggregate):
             return self._convert_aggregate(p)
+        if isinstance(p, L.Join):
+            return self._convert_join(p)
+        if isinstance(p, L.Window):
+            return self._convert_window(p)
         return self._fallback()
+
+    def _tag_window(self, p: "L.Window") -> None:
+        from spark_rapids_tpu.expressions.window import (
+            DenseRank, Lag, Lead, Rank, RowNumber, WindowExpression)
+        from spark_rapids_tpu.expressions.aggregates import (
+            Average, Count, Max, Min, Sum)
+        spec = p.spec
+        for e in spec.partition_by:
+            if not _key_dtype_ok(e.dtype):
+                self.will_not_work(
+                    f"window partition key type {e.dtype!r} not supported yet")
+        for e, _ in spec.order_by:
+            if not _key_dtype_ok(e.dtype):
+                self.will_not_work(
+                    f"window order key type {e.dtype!r} not supported yet")
+        for e in p.window_exprs:
+            inner = e.child if isinstance(e, E.Alias) else e
+            if not isinstance(inner, WindowExpression):
+                self.will_not_work(
+                    f"window output {e!r} must be a window expression")
+                continue
+            if repr(inner.spec) != repr(spec):
+                self.will_not_work(
+                    "mixed window specs in one Window node")
+            fn = inner.function
+            frame = inner.spec.frame
+            if isinstance(fn, (RowNumber, Rank, DenseRank, Lead, Lag)):
+                continue
+            if isinstance(fn, (Sum, Count, Average)):
+                if frame.kind == "range" and not (
+                        frame.is_unbounded_to_current()
+                        or frame.is_unbounded_both()):
+                    self.will_not_work(
+                        f"range frame {frame} not supported for {fn!r}")
+                continue
+            if isinstance(fn, (Min, Max)):
+                if not (frame.is_unbounded_both()
+                        or (frame.kind == "range"
+                            and frame.is_unbounded_to_current())):
+                    self.will_not_work(
+                        f"bounded frames for {fn!r} need the sliding "
+                        "min/max kernel (follow-on)")
+                continue
+            self.will_not_work(f"window function {fn!r} not supported")
+
+    def _convert_window(self, p: "L.Window") -> TpuExec:
+        from spark_rapids_tpu.plan.execs.window import TpuWindowExec
+        child = self.children[0].convert()
+        if child.num_partitions() > 1:
+            if p.spec.partition_by:
+                child = self._exchange(self.conf.shuffle_partitions,
+                                       p.spec.partition_by, child)
+            else:
+                child = TpuSinglePartitionExec(child)
+        return TpuWindowExec(p.window_exprs, child, p.schema)
+
+    def _convert_join(self, p: L.Join) -> TpuExec:
+        from spark_rapids_tpu.plan.execs.basic import TpuFilterExec
+        from spark_rapids_tpu.plan.execs.join import TpuShuffledHashJoinExec
+        left = self.children[0].convert()
+        right = self.children[1].convert()
+        nparts = self.conf.shuffle_partitions
+        if p.join_type == "cross":
+            from spark_rapids_tpu.plan.execs.exchange import (
+                TpuSinglePartitionExec)
+            left = TpuSinglePartitionExec(left)
+            right = TpuSinglePartitionExec(right)
+        else:
+            # co-partition both sides on the join keys (the reference's
+            # shuffled hash join shape, GpuShuffledSizedHashJoinExec)
+            if left.num_partitions() > 1 or right.num_partitions() > 1:
+                left = self._exchange(nparts, p.left_keys, left)
+                right = self._exchange(nparts, p.right_keys, right)
+        join: TpuExec = TpuShuffledHashJoinExec(
+            left, right, p.left_keys, p.right_keys, p.join_type, p.schema)
+        if p.condition is not None:
+            join = TpuFilterExec(p.condition, join)
+        return join
 
     def _convert_aggregate(self, p: L.Aggregate) -> TpuExec:
         child = self.children[0].convert()
@@ -236,13 +368,22 @@ class PlanMeta:
             nkeys = len(p.group_exprs)
             key_refs = [E.BoundReference(i, p.group_exprs[i].dtype, f"_k{i}")
                         for i in range(nkeys)]
-            exchange: TpuExec = TpuShuffleExchangeExec(
+            exchange: TpuExec = self._exchange(
                 self.conf.shuffle_partitions, key_refs, partial)
         else:
             exchange = TpuSinglePartitionExec(partial)
         return TpuHashAggregateExec(
             p.group_exprs, p.agg_exprs, p.aggregates, exchange, p.schema,
             mode="final")
+
+    def _exchange(self, nparts, keys, child) -> TpuExec:
+        mode = self.conf.shuffle_mode
+        if mode not in ("CACHE_ONLY", "MULTITHREADED"):
+            mode = "CACHE_ONLY"   # ICI mode is planned per-stage, not here yet
+        return TpuShuffleExchangeExec(
+            nparts, keys, child, mode=mode,
+            writer_threads=self.conf.shuffle_writer_threads,
+            codec=self.conf.shuffle_codec)
 
     def _fallback(self) -> TpuExec:
         from spark_rapids_tpu.plan.execs.fallback import TpuCpuFallbackExec

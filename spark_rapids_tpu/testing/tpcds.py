@@ -1,0 +1,152 @@
+"""Deterministic TPC-DS-style tables + the BASELINE gate queries (q3, q5
+subset, q14a subset shapes).
+
+Same stance as testing/tpch.py: distributions follow the TPC-DS spec shapes
+(surrogate-keyed dims, fact rows clustered on dates) so join selectivities
+and group cardinalities are realistic; generation code is original.
+
+The string dimension columns (brand names etc.) are generated as integers
+until string compute lands — the join/agg shapes the gate measures are
+unaffected.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+
+STORE_SALES_SCHEMA = Schema.of(
+    ss_sold_date_sk=T.INT,
+    ss_item_sk=T.INT,
+    ss_customer_sk=T.INT,
+    ss_store_sk=T.INT,
+    ss_quantity=T.INT,
+    ss_ext_sales_price=T.DOUBLE,
+    ss_net_profit=T.DOUBLE,
+)
+
+DATE_DIM_SCHEMA = Schema.of(
+    d_date_sk=T.INT,
+    d_year=T.INT,
+    d_moy=T.INT,
+)
+
+ITEM_SCHEMA = Schema.of(
+    i_item_sk=T.INT,
+    i_brand_id=T.INT,
+    i_manufact_id=T.INT,
+    i_category_id=T.INT,
+)
+
+
+def gen_date_dim() -> ColumnarBatch:
+    """One row per day 1998-2003 (like the real dim's surrogate keys)."""
+    n = 6 * 365
+    sk = np.arange(2450000, 2450000 + n, dtype=np.int32)
+    year = 1998 + (np.arange(n) // 365)
+    moy = 1 + (np.arange(n) % 365) // 31
+    return ColumnarBatch.from_pydict(
+        {"d_date_sk": sk.tolist(), "d_year": year.tolist(),
+         "d_moy": np.minimum(moy, 12).tolist()},
+        DATE_DIM_SCHEMA)
+
+
+def gen_item(n_items: int = 2000, seed: int = 11) -> ColumnarBatch:
+    rng = np.random.RandomState(seed)
+    return ColumnarBatch.from_pydict(
+        {"i_item_sk": list(range(1, n_items + 1)),
+         "i_brand_id": rng.randint(1, 100, n_items).tolist(),
+         "i_manufact_id": rng.randint(1, 120, n_items).tolist(),
+         "i_category_id": rng.randint(1, 12, n_items).tolist()},
+        ITEM_SCHEMA)
+
+
+def gen_store_sales(n_rows: int, n_items: int = 2000, seed: int = 13,
+                    batch_rows: int = 1 << 19) -> List[ColumnarBatch]:
+    out = []
+    remaining = n_rows
+    chunk = 0
+    while remaining > 0:
+        n = min(batch_rows, remaining)
+        rng = np.random.RandomState(seed + 31 * chunk)
+        date_sk = (2450000 + rng.randint(0, 6 * 365, n)).astype(np.int32)
+        item_sk = (1 + rng.randint(0, n_items, n)).astype(np.int32)
+        data = {
+            "ss_sold_date_sk": date_sk,
+            "ss_item_sk": item_sk,
+            "ss_customer_sk": (1 + rng.randint(0, 50_000, n)).astype(np.int32),
+            "ss_store_sk": (1 + rng.randint(0, 50, n)).astype(np.int32),
+            "ss_quantity": rng.randint(1, 100, n).astype(np.int32),
+            "ss_ext_sales_price": np.round(rng.uniform(1.0, 300.0, n), 2),
+            "ss_net_profit": np.round(rng.uniform(-100.0, 200.0, n), 2),
+        }
+        # a few percent null fact keys, as in real data
+        validity = {}
+        null_mask = rng.rand(n) < 0.02
+        from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
+        import jax.numpy as jnp
+        cap = round_up_pow2(n)
+        cols = []
+        for name, dt in zip(STORE_SALES_SCHEMA.names, STORE_SALES_SCHEMA.dtypes):
+            valid = ~null_mask if name == "ss_customer_sk" else np.ones(n, bool)
+            cols.append(DeviceColumn.from_numpy(data[name], dt, valid,
+                                                capacity=cap))
+        out.append(ColumnarBatch(tuple(cols), jnp.asarray(n, jnp.int32),
+                                 STORE_SALES_SCHEMA))
+        remaining -= n
+        chunk += 1
+    return out
+
+
+def q3(store_sales_df, date_dim_df, item_df):
+    """TPC-DS Q3 shape: fact x date_dim x item, filter, group, agg, sort.
+
+    select d_year, i_brand_id, sum(ss_ext_sales_price) sum_agg
+    from store_sales join date_dim on ss_sold_date_sk = d_date_sk
+                     join item on ss_item_sk = i_item_sk
+    where i_manufact_id = 28 and d_moy = 11
+    group by d_year, i_brand_id order by d_year, sum_agg desc
+    """
+    from spark_rapids_tpu.expressions import col, lit, sum_
+    from spark_rapids_tpu.kernels.sort import SortOrder
+    joined = (store_sales_df
+              .join(date_dim_df, on=([col("ss_sold_date_sk")],
+                                     [col("d_date_sk")]))
+              .join(item_df, on=([col("ss_item_sk")], [col("i_item_sk")])))
+    return (joined
+            .filter((col("i_manufact_id") == lit(28)) & (col("d_moy") == lit(11)))
+            .group_by("d_year", "i_brand_id")
+            .agg(sum_("ss_ext_sales_price").alias("sum_agg"))
+            .order_by(("d_year", SortOrder(True)),
+                      ("sum_agg", SortOrder(False)),
+                      ("i_brand_id", SortOrder(True))))
+
+
+def q5_subset(store_sales_df, date_dim_df):
+    """The store-channel leg of TPC-DS Q5: per-store rollup of sales and
+    profit over a date window."""
+    from spark_rapids_tpu.expressions import col, lit, sum_
+    return (store_sales_df
+            .join(date_dim_df, on=([col("ss_sold_date_sk")],
+                                   [col("d_date_sk")]))
+            .filter((col("d_year") == lit(2000)) & (col("d_moy") <= lit(2)))
+            .group_by("ss_store_sk")
+            .agg(sum_("ss_ext_sales_price").alias("sales"),
+                 sum_("ss_net_profit").alias("profit")))
+
+
+def q14a_subset(store_sales_df, item_df):
+    """Q14a's cross-channel core: per (brand, category) sales with a
+    semi-join item filter."""
+    from spark_rapids_tpu.expressions import avg, col, count, lit, sum_
+    hot_items = (item_df.filter(col("i_category_id") <= lit(3))
+                 .select("i_item_sk", "i_brand_id", "i_category_id"))
+    return (store_sales_df
+            .join(hot_items, on=([col("ss_item_sk")], [col("i_item_sk")]))
+            .group_by("i_brand_id", "i_category_id")
+            .agg(sum_(col("ss_ext_sales_price")).alias("sales"),
+                 count().alias("n"),
+                 avg("ss_quantity").alias("avg_qty")))

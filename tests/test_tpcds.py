@@ -1,0 +1,49 @@
+"""TPC-DS gate differential tests (BASELINE gate #2: multi-stage + shuffle
+joins correct)."""
+import pytest
+
+from spark_rapids_tpu.testing import tpcds
+from tests.test_queries import assert_tpu_cpu_equal
+
+N_FACT = 60_000
+
+
+def dfs(s):
+    ss = s.create_dataframe(
+        tpcds.gen_store_sales(N_FACT, batch_rows=N_FACT // 3 + 1),
+        num_partitions=3)
+    dd = s.create_dataframe([tpcds.gen_date_dim()], num_partitions=1)
+    it = s.create_dataframe([tpcds.gen_item()], num_partitions=1)
+    return ss, dd, it
+
+
+def test_q3():
+    def build(s):
+        ss, dd, it = dfs(s)
+        return tpcds.q3(ss, dd, it)
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows, "q3 must select something at this scale"
+
+
+def test_q5_subset():
+    def build(s):
+        ss, dd, _ = dfs(s)
+        return tpcds.q5_subset(ss, dd)
+    rows = assert_tpu_cpu_equal(build)
+    assert rows
+
+
+def test_q14a_subset():
+    def build(s):
+        ss, _, it = dfs(s)
+        return tpcds.q14a_subset(ss, it)
+    rows = assert_tpu_cpu_equal(build)
+    assert rows
+
+
+@pytest.mark.inject_oom
+def test_q3_with_injected_oom():
+    def build(s):
+        ss, dd, it = dfs(s)
+        return tpcds.q3(ss, dd, it)
+    assert_tpu_cpu_equal(build, ignore_order=False)

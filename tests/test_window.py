@@ -1,0 +1,98 @@
+"""Window function differential tests (segmented-scan kernels vs the
+row-wise python oracle)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import (
+    DenseRank,
+    Lag,
+    Lead,
+    Rank,
+    RowNumber,
+    WindowFrame,
+    avg,
+    col,
+    count,
+    max_,
+    min_,
+    over,
+    sum_,
+)
+from tests.test_queries import assert_tpu_cpu_equal
+
+SCHEMA = Schema.of(k=T.INT, t=T.INT, v=T.LONG, x=T.DOUBLE)
+
+
+def wdf(s, n=300, nkeys=11, parts=3, seed=2):
+    rng = np.random.RandomState(seed)
+    data = {
+        "k": rng.randint(0, nkeys, n).tolist(),
+        "t": rng.randint(0, 40, n).tolist(),   # duplicate order keys = peers
+        "v": rng.randint(-100, 100, n).tolist(),
+        "x": rng.randn(n).tolist(),
+    }
+    for cname in ("v", "x"):
+        for i in rng.choice(n, n // 9, replace=False):
+            data[cname][i] = None
+    batches = [ColumnarBatch.from_pydict(
+        {c: vals[o:o + 100] for c, vals in data.items()}, SCHEMA)
+        for o in range(0, n, 100)]
+    return s.create_dataframe(batches, num_partitions=parts)
+
+
+WINDOW_EXPRS = [
+    over(RowNumber(), partition_by=["k"], order_by=["t"]),
+    over(Rank(), partition_by=["k"], order_by=["t"]),
+    over(DenseRank(), partition_by=["k"], order_by=["t"]),
+    over(sum_("v"), partition_by=["k"], order_by=["t"]),       # running range
+    over(count("v"), partition_by=["k"], order_by=["t"]),
+    over(avg("v"), partition_by=["k"], order_by=["t"]),
+    over(min_("v"), partition_by=["k"], order_by=["t"]),
+    over(max_("x"), partition_by=["k"], order_by=["t"]),
+    over(sum_("v"), partition_by=["k"]),                        # whole part.
+    over(count(), partition_by=["k"]),
+    over(Lead(col("v"), 1), partition_by=["k"], order_by=["t"]),
+    over(Lag(col("v"), 2), partition_by=["k"], order_by=["t"]),
+    over(sum_("v"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("rows", -2, 0)),                     # moving sum
+    over(avg("x"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("rows", -3, 3)),
+    over(count(), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("rows", None, 0)),                   # rows running
+]
+
+
+@pytest.mark.parametrize("wexpr", WINDOW_EXPRS, ids=lambda e: repr(e)[:70])
+def test_window_functions(wexpr):
+    assert_tpu_cpu_equal(lambda s: wdf(s).with_column("w", wexpr))
+
+
+def test_window_no_partition():
+    assert_tpu_cpu_equal(
+        lambda s: wdf(s, n=120).with_column(
+            "w", over(RowNumber(), order_by=["t", "v"])))
+
+
+def test_window_runs_on_tpu():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = wdf(s).with_column(
+        "w", over(sum_("v"), partition_by=["k"], order_by=["t"])).explain()
+    assert "will NOT" not in e, e
+
+
+def test_bounded_min_falls_back():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    we = over(min_("v"), partition_by=["k"], order_by=["t"],
+              frame=WindowFrame("rows", -2, 0))
+    assert "will NOT" in wdf(s).with_column("w", we).explain()
+    assert_tpu_cpu_equal(lambda sess: wdf(sess).with_column("w", we))
+
+
+@pytest.mark.inject_oom
+def test_window_with_injected_oom():
+    assert_tpu_cpu_equal(
+        lambda s: wdf(s).with_column(
+            "w", over(sum_("v"), partition_by=["k"], order_by=["t"])))

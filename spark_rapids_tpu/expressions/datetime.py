@@ -1,0 +1,274 @@
+"""Date/time expression twins.
+
+Reference: sql-plugin/.../datetimeExpressions.scala (GpuYear, GpuMonth,
+GpuDayOfMonth, GpuDateAdd, GpuDateDiff, GpuHour...; tz database at
+GpuTimeZoneDB).
+
+Device representation (types.py): DATE = int32 days since epoch,
+TIMESTAMP = int64 microseconds since epoch UTC.  Field extraction uses the
+civil-from-days algorithm (Howard Hinnant's public-domain construction) —
+pure integer arithmetic, so it vectorizes to one fused XLA kernel.
+Timestamp fields are UTC (session-timezone support arrives with the tz
+database port; the planner can gate when that matters).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.core import (
+    BinaryExpression,
+    CpuEvalContext,
+    EvalContext,
+    UnaryExpression,
+    cpu_null_propagating,
+    cpu_zero_invalid,
+    make_column,
+    null_propagating,
+)
+
+MICROS_PER_SECOND = 1_000_000
+MICROS_PER_DAY = 86400 * MICROS_PER_SECOND
+
+
+def _civil_from_days(z, xp):
+    """days since 1970-01-01 -> (year, month [1,12], day [1,31])."""
+    z = z.astype(xp.int64) + 719468
+    era = xp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097                               # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)      # [0, 365]
+    mp = (5 * doy + 2) // 153                            # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                    # [1, 31]
+    m = xp.where(mp < 10, mp + 3, mp - 9)                # [1, 12]
+    y = xp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _doy(days, xp):
+    y, m, d = _civil_from_days(days, xp)
+    jan1 = _days_from_civil(y, xp.full(y.shape, 1, xp.int64),
+                            xp.full(y.shape, 1, xp.int64), xp)
+    return (days.astype(xp.int64) - jan1 + 1)
+
+
+def _days_from_civil(y, m, d, xp):
+    """(year, month, day) -> days since epoch (inverse of the above)."""
+    y = y.astype(xp.int64) - (m <= 2)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+class _DateField(UnaryExpression):
+    @property
+    def dtype(self):
+        return T.INT
+
+    def _field(self, days, xp):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        days = c.data
+        if isinstance(c.dtype, T.TimestampType):
+            days = jnp.floor_divide(days, MICROS_PER_DAY)
+        out = self._field(days, jnp).astype(jnp.int32)
+        return make_column(out, c.validity, T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        days = v.astype(np.int64)
+        if isinstance(self.child.dtype, T.TimestampType):
+            days = np.floor_divide(days, MICROS_PER_DAY)
+        out = self._field(days, np).astype(np.int32)
+        return cpu_zero_invalid(out, valid), valid
+
+
+class Year(_DateField):
+    def _field(self, days, xp):
+        return _civil_from_days(days, xp)[0]
+
+
+class Month(_DateField):
+    def _field(self, days, xp):
+        return _civil_from_days(days, xp)[1]
+
+
+class DayOfMonth(_DateField):
+    def _field(self, days, xp):
+        return _civil_from_days(days, xp)[2]
+
+
+class DayOfWeek(_DateField):
+    """Spark dayofweek: 1 = Sunday ... 7 = Saturday."""
+
+    def _field(self, days, xp):
+        return ((days.astype(xp.int64) + 4) % 7) + 1
+
+
+class DayOfYear(_DateField):
+    def _field(self, days, xp):
+        return _doy(days, xp)
+
+
+class Quarter(_DateField):
+    def _field(self, days, xp):
+        return (_civil_from_days(days, xp)[1] + 2) // 3
+
+
+class _TimestampField(UnaryExpression):
+    @property
+    def dtype(self):
+        return T.INT
+
+    def _field(self, micros_of_day, xp):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        mod = c.data - jnp.floor_divide(c.data, MICROS_PER_DAY) * MICROS_PER_DAY
+        out = self._field(mod, jnp).astype(jnp.int32)
+        return make_column(out, c.validity, T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        x = v.astype(np.int64)
+        mod = x - np.floor_divide(x, MICROS_PER_DAY) * MICROS_PER_DAY
+        out = self._field(mod, np).astype(np.int32)
+        return cpu_zero_invalid(out, valid), valid
+
+
+class Hour(_TimestampField):
+    def _field(self, mod, xp):
+        return mod // (3600 * MICROS_PER_SECOND)
+
+
+class Minute(_TimestampField):
+    def _field(self, mod, xp):
+        return (mod // (60 * MICROS_PER_SECOND)) % 60
+
+
+class Second(_TimestampField):
+    def _field(self, mod, xp):
+        return (mod // MICROS_PER_SECOND) % 60
+
+
+class DateAdd(BinaryExpression):
+    """date_add(date, days) -> date.  DateSub negates."""
+
+    symbol = "date_add"
+    _sign = 1
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+    def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        out = lc.data + self._sign * rc.data.astype(jnp.int32)
+        return make_column(out, null_propagating([lc.validity, rc.validity]),
+                           T.DATE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lval = self.left.eval_cpu(ctx)
+        rv, rval = self.right.eval_cpu(ctx)
+        validity = cpu_null_propagating([lval, rval])
+        out = lv.astype(np.int32) + self._sign * rv.astype(np.int32)
+        return cpu_zero_invalid(out, validity), validity
+
+
+class DateSub(DateAdd):
+    symbol = "date_sub"
+    _sign = -1
+
+
+class DateDiff(BinaryExpression):
+    """datediff(end, start) -> int days."""
+
+    symbol = "datediff"
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        out = (lc.data - rc.data).astype(jnp.int32)
+        return make_column(out, null_propagating([lc.validity, rc.validity]),
+                           T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lval = self.left.eval_cpu(ctx)
+        rv, rval = self.right.eval_cpu(ctx)
+        validity = cpu_null_propagating([lval, rval])
+        out = (lv.astype(np.int64) - rv.astype(np.int64)).astype(np.int32)
+        return cpu_zero_invalid(out, validity), validity
+
+
+class AddMonths(BinaryExpression):
+    """add_months(date, n): civil month arithmetic with day clamping to the
+    target month's last day (Spark semantics)."""
+
+    symbol = "add_months"
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+    def _compute(self, days, months, xp):
+        y, m, d = _civil_from_days(days, xp)
+        total = (y * 12 + (m - 1)) + months.astype(xp.int64)
+        ny = xp.where(total >= 0, total, total - 11) // 12
+        nm = total - ny * 12 + 1
+        # clamp day to last day of the target month
+        first_next = _days_from_civil(
+            xp.where(nm == 12, ny + 1, ny), xp.where(nm == 12, 1, nm + 1),
+            xp.full(ny.shape, 1, xp.int64), xp)
+        last_day = first_next - _days_from_civil(
+            ny, nm, xp.full(ny.shape, 1, xp.int64), xp)
+        nd = xp.minimum(d, last_day)
+        return _days_from_civil(ny, nm, nd, xp).astype(xp.int32)
+
+    def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        out = self._compute(lc.data, rc.data, jnp)
+        return make_column(out, null_propagating([lc.validity, rc.validity]),
+                           T.DATE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lval = self.left.eval_cpu(ctx)
+        rv, rval = self.right.eval_cpu(ctx)
+        validity = cpu_null_propagating([lval, rval])
+        out = self._compute(lv.astype(np.int64), rv.astype(np.int64), np)
+        return cpu_zero_invalid(out, validity), validity
+
+
+class LastDay(UnaryExpression):
+    @property
+    def dtype(self):
+        return T.DATE
+
+    def _compute(self, days, xp):
+        y, m, _ = _civil_from_days(days, xp)
+        first_next = _days_from_civil(
+            xp.where(m == 12, y + 1, y), xp.where(m == 12, 1, m + 1),
+            xp.full(y.shape, 1, xp.int64), xp)
+        return (first_next - 1).astype(xp.int32)
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        return make_column(self._compute(c.data, jnp), c.validity, T.DATE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        out = self._compute(v.astype(np.int64), np)
+        return cpu_zero_invalid(out, valid), valid

@@ -1,0 +1,298 @@
+"""Math expression twins.
+
+Reference: sql-plugin/.../mathExpressions.scala (GpuSqrt, GpuLog, GpuPow,
+GpuRound, GpuFloor/GpuCeil...).
+
+Spark semantics encoded:
+  * ln/log of a non-positive value is NULL (Hive lineage), not NaN;
+  * sqrt(-x) is NaN (IEEE flows through);
+  * floor/ceil of double return BIGINT;
+  * round is HALF_UP on the decimal representation — implemented via
+    scaled rounding; exactly matches for the |x| < 2^52 range the planner
+    allows.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.core import (
+    BinaryExpression,
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+    UnaryExpression,
+    cpu_null_propagating,
+    cpu_zero_invalid,
+    make_column,
+    null_propagating,
+)
+
+
+class _UnaryDouble(UnaryExpression):
+    """value -> double elementwise, nulls propagate, IEEE flows through."""
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def _op(self, x, xp):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        x = c.data.astype(jnp.float64)
+        return make_column(self._op(x, jnp), c.validity, T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        with np.errstate(all="ignore"):
+            out = self._op(v.astype(np.float64), np)
+        return cpu_zero_invalid(out, valid), valid
+
+
+class Sqrt(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.sqrt(x)
+
+
+class Cbrt(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.cbrt(x)
+
+
+class Exp(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.exp(x)
+
+
+class Sin(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.sin(x)
+
+
+class Cos(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.cos(x)
+
+
+class Tan(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.tan(x)
+
+
+class Atan(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.arctan(x)
+
+
+class Signum(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.sign(x)
+
+
+class Log(UnaryExpression):
+    """ln(x); NULL for x <= 0 (Spark/Hive), NaN never escapes."""
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        x = c.data.astype(jnp.float64)
+        ok = x > 0
+        validity = c.validity & ok
+        out = jnp.log(jnp.where(ok, x, 1.0))
+        return make_column(out, validity, T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        x = v.astype(np.float64)
+        ok = x > 0
+        validity = valid & ok
+        with np.errstate(all="ignore"):
+            out = np.log(np.where(ok, x, 1.0))
+        return cpu_zero_invalid(out, validity), validity
+
+
+class Log10(Log):
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        x = c.data.astype(jnp.float64)
+        ok = x > 0
+        return make_column(jnp.log10(jnp.where(ok, x, 1.0)),
+                           c.validity & ok, T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        x = v.astype(np.float64)
+        ok = x > 0
+        with np.errstate(all="ignore"):
+            out = np.log10(np.where(ok, x, 1.0))
+        return cpu_zero_invalid(out, valid & ok), valid & ok
+
+
+class Pow(BinaryExpression):
+    symbol = "^"
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        out = jnp.power(lc.data.astype(jnp.float64),
+                        rc.data.astype(jnp.float64))
+        return make_column(out, null_propagating([lc.validity, rc.validity]),
+                           T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lval = self.left.eval_cpu(ctx)
+        rv, rval = self.right.eval_cpu(ctx)
+        validity = cpu_null_propagating([lval, rval])
+        with np.errstate(all="ignore"):
+            out = np.power(lv.astype(np.float64), rv.astype(np.float64))
+        return cpu_zero_invalid(out, validity), validity
+
+
+class Floor(UnaryExpression):
+    """floor(double) -> bigint (Spark); integral input passes through."""
+
+    @property
+    def dtype(self):
+        return T.LONG if self.child.dtype.is_floating else self.child.dtype
+
+    def _round(self, x, xp):
+        return xp.floor(x)
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        if not c.dtype.is_floating:
+            return c
+        x = self._round(c.data.astype(jnp.float64), jnp)
+        x = jnp.nan_to_num(x, nan=0.0, posinf=float(2**63 - 1024),
+                           neginf=float(-(2**63)))
+        return make_column(x.astype(jnp.int64), c.validity, T.LONG)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        if not self.child.dtype.is_floating:
+            return v, valid
+        with np.errstate(all="ignore"):
+            x = self._round(v.astype(np.float64), np)
+        x = np.nan_to_num(x, nan=0.0, posinf=float(2**63 - 1024),
+                          neginf=float(-(2**63)))
+        return cpu_zero_invalid(x.astype(np.int64), valid), valid
+
+
+class Ceil(Floor):
+    def _round(self, x, xp):
+        return xp.ceil(x)
+
+
+class Round(Expression):
+    """round(x, scale) HALF_UP (Spark's default BigDecimal mode)."""
+
+    def __init__(self, child: Expression, scale: int = 0):
+        self.child = child
+        self.scale = scale
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Round(children[0], self.scale)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def _half_up(self, x, xp):
+        factor = 10.0 ** self.scale
+        scaled = x * factor
+        # HALF_UP: away from zero on .5 (numpy/xla round() is half-even)
+        return xp.sign(scaled) * xp.floor(xp.abs(scaled) + 0.5) / factor
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        if c.dtype.is_integral and self.scale >= 0:
+            return c
+        x = self._half_up(c.data.astype(jnp.float64), jnp)
+        if c.dtype.is_integral:
+            x = x.astype(c.dtype.jnp_dtype)
+        elif isinstance(c.dtype, T.FloatType):
+            x = x.astype(jnp.float32)
+        return make_column(x, c.validity, c.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        dt = self.child.dtype
+        if dt.is_integral and self.scale >= 0:
+            return v, valid
+        with np.errstate(all="ignore"):
+            x = self._half_up(v.astype(np.float64), np)
+        if dt.is_integral:
+            x = x.astype(dt.np_dtype)
+        elif isinstance(dt, T.FloatType):
+            x = x.astype(np.float32)
+        return cpu_zero_invalid(x, valid), valid
+
+    def __repr__(self):
+        return f"round({self.child!r}, {self.scale})"
+
+
+class IsNaN(UnaryExpression):
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        live = ctx.live_mask()
+        return make_column(jnp.isnan(c.data) & c.validity, live, T.BOOLEAN)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        with np.errstate(invalid="ignore"):
+            out = np.isnan(v.astype(np.float64)) & valid
+        return out, np.ones_like(valid)
+
+
+class NanVl(BinaryExpression):
+    """nanvl(a, b): b when a is NaN else a (Spark GpuNanvl)."""
+
+    symbol = "nanvl"
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        l = lc.data.astype(jnp.float64)
+        r = rc.data.astype(jnp.float64)
+        isnan = jnp.isnan(l)
+        vals = jnp.where(isnan, r, l)
+        validity = jnp.where(isnan, lc.validity & rc.validity, lc.validity)
+        return make_column(vals, validity, T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lval = self.left.eval_cpu(ctx)
+        rv, rval = self.right.eval_cpu(ctx)
+        l = lv.astype(np.float64)
+        r = rv.astype(np.float64)
+        with np.errstate(invalid="ignore"):
+            isnan = np.isnan(l)
+        vals = np.where(isnan, r, l)
+        validity = np.where(isnan, lval & rval, lval)
+        return cpu_zero_invalid(vals, validity), validity

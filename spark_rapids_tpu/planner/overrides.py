@@ -63,6 +63,17 @@ from spark_rapids_tpu.expressions.window import (
 
 _SUPPORTED_EXPRS |= {WindowExpression, RowNumber, Rank, DenseRank, Lead, Lag}
 
+from spark_rapids_tpu.expressions import math as M
+from spark_rapids_tpu.expressions import datetime as DT
+
+_SUPPORTED_EXPRS |= {
+    M.Sqrt, M.Cbrt, M.Exp, M.Sin, M.Cos, M.Tan, M.Atan, M.Signum,
+    M.Log, M.Log10, M.Pow, M.Floor, M.Ceil, M.Round, M.IsNaN, M.NanVl,
+    DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.DayOfYear,
+    DT.Quarter, DT.Hour, DT.Minute, DT.Second, DT.DateAdd, DT.DateSub,
+    DT.DateDiff, DT.AddMonths, DT.LastDay,
+}
+
 # dtypes device kernels support in expression compute
 _COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
                T.LongType, T.FloatType, T.DoubleType, T.DateType,

@@ -67,6 +67,26 @@ class TpuSession:
             L.ParquetRelation(paths, schema,
                               tuple(columns) if columns else None), self)
 
+    def _read_file(self, paths, fmt, columns, schema, **options):
+        from spark_rapids_tpu.io.formats import infer_schema
+        sch = infer_schema(paths[0], fmt, columns, schema, **options)
+        return DataFrame(
+            L.FileRelation(paths, fmt, sch,
+                           tuple(columns) if columns else None, options),
+            self)
+
+    def read_csv(self, *paths: str, columns=None, schema=None,
+                 **options) -> "DataFrame":
+        return self._read_file(paths, "csv", columns, schema, **options)
+
+    def read_json(self, *paths: str, columns=None, schema=None,
+                  **options) -> "DataFrame":
+        return self._read_file(paths, "json", columns, schema, **options)
+
+    def read_orc(self, *paths: str, columns=None, schema=None,
+                 **options) -> "DataFrame":
+        return self._read_file(paths, "orc", columns, schema, **options)
+
 
 class GroupedData:
     def __init__(self, df: "DataFrame", keys: Sequence[Expression]):
@@ -164,6 +184,22 @@ class DataFrame:
     def physical_plan(self):
         exec_plan, meta = plan_query(self.plan, self.session.conf)
         return exec_plan
+
+    def _collect_batches(self):
+        """Materialize as device batches (the ColumnarRdd analog: zero-copy
+        handoff to ML frameworks, reference sql-plugin-api ColumnarRdd.scala)."""
+        exec_plan, _ = plan_query(self.plan, self.session.conf)
+        return TpuEngine(self.session.conf).execute(exec_plan)
+
+    def write_parquet(self, path: str) -> int:
+        from spark_rapids_tpu.io.parquet import write_parquet
+        batches = [b for part in self._collect_batches() for b in part]
+        return write_parquet(batches, path, schema=self.schema)
+
+    def write_file(self, path: str, fmt: str) -> int:
+        from spark_rapids_tpu.io.formats import write_file
+        batches = [b for part in self._collect_batches() for b in part]
+        return write_file(batches, path, fmt, schema=self.schema)
 
     def count(self) -> int:
         from spark_rapids_tpu.expressions.aggregates import count

@@ -1,0 +1,108 @@
+"""UDF support: trace-to-expression compilation + row fallback.
+
+The reference compiles JVM lambda *bytecode* into Catalyst expressions so
+UDFs plan onto the GPU (udf-compiler/.../CatalystExpressionBuilder.scala,
+LambdaReflection.scala).  The TPU-native equivalent needs no bytecode work:
+a Python UDF is *traced* — called once with symbolic Expression arguments.
+If every operation the function performs is part of the expression DSL
+(arithmetic, comparisons, boolean ops, our function library), the result IS
+the expression tree and the UDF plans natively, fuses into XLA, and never
+touches Python at execution time.
+
+Functions that escape the DSL (data-dependent Python control flow, foreign
+libraries) become a PythonRowUDF: the planner tags it (like the reference
+tags untranslatable UDFs) and the query runs it on the CPU fallback path —
+same contract as Spark executing a black-box UDF row-wise.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.core import (
+    CpuEvalContext,
+    Expression,
+    cpu_zero_invalid,
+)
+
+
+class PythonRowUDF(Expression):
+    """Black-box Python function applied row-wise (CPU only)."""
+
+    def __init__(self, fn: Callable, return_type: T.DataType, args):
+        self.fn = fn
+        self.return_type = return_type
+        self.children = tuple(args)
+
+    def with_children(self, children):
+        return PythonRowUDF(self.fn, self.return_type, children)
+
+    @property
+    def dtype(self):
+        return self.return_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        arg_evals = [c.eval_cpu(ctx) for c in self.children]
+        n = ctx.num_rows
+        is_obj = self.return_type.variable_width
+        vals = np.zeros((n,), object if is_obj else self.return_type.np_dtype)
+        valid = np.zeros((n,), np.bool_)
+        for r in range(n):
+            args = [v[r] if m[r] else None for v, m in arg_evals]
+            args = [a.item() if isinstance(a, np.generic) else a for a in args]
+            out = self.fn(*args)
+            if out is not None:
+                vals[r] = out
+                valid[r] = True
+        return cpu_zero_invalid(vals, valid), valid
+
+    def __repr__(self):
+        name = getattr(self.fn, "__name__", "udf")
+        return f"pyudf:{name}({', '.join(map(repr, self.children))})"
+
+
+class TracedUDF:
+    """Callable produced by @tpu_udf: builds an expression per call site."""
+
+    def __init__(self, fn: Callable, return_type: Optional[T.DataType]):
+        self.fn = fn
+        self.return_type = return_type
+        self.__name__ = getattr(fn, "__name__", "udf")
+
+    def __call__(self, *args) -> Expression:
+        from spark_rapids_tpu.expressions.core import col, lit
+        exprs = [col(a) if isinstance(a, str)
+                 else (a if isinstance(a, Expression) else lit(a))
+                 for a in args]
+        try:
+            out = self.fn(*exprs)
+            if isinstance(out, Expression):
+                return out   # fully traced: plans natively
+        except Exception:
+            pass
+        assert self.return_type is not None, (
+            f"UDF {self.__name__} is not expressible in the expression DSL; "
+            "give it an explicit return_type so it can run as a row UDF")
+        return PythonRowUDF(self.fn, self.return_type, exprs)
+
+
+def tpu_udf(fn: Optional[Callable] = None, *,
+            return_type: Optional[T.DataType] = None):
+    """Decorator: ``@tpu_udf`` or ``@tpu_udf(return_type=T.INT)``.
+
+    The resulting callable takes columns/expressions and returns an
+    Expression — traced into the native DSL when possible, a row UDF
+    otherwise.
+    """
+    if fn is not None:
+        return TracedUDF(fn, return_type)
+
+    def wrap(f):
+        return TracedUDF(f, return_type)
+    return wrap

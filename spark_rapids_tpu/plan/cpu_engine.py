@@ -201,6 +201,18 @@ class CpuEngine:
             out.append(CpuTable.from_batch(batch))
         return out or [CpuTable.empty(plan.schema)]
 
+    def _exec_filerelation(self, plan: L.FileRelation):
+        from spark_rapids_tpu.io import formats as F
+        out = []
+        for path in plan.paths:
+            batches = list(F.read_batches(
+                path, plan.fmt,
+                columns=plan.column_pruning, schema=plan.schema,
+                **plan.options))
+            out.append(CpuTable.concat(
+                [CpuTable.from_batch(b) for b in batches], plan.schema))
+        return out or [CpuTable.empty(plan.schema)]
+
     def _exec_project(self, plan: L.Project):
         out = []
         for t in self._exec(plan.child):

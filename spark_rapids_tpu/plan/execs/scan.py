@@ -63,3 +63,36 @@ class TpuParquetScanExec(TpuExec):
 
     def describe(self):
         return f"TpuParquetScan[{len(self.paths)} files]"
+
+
+class TpuFileScanExec(TpuExec):
+    """csv/json/orc scan: one partition per file, host-native Arrow decode
+    feeding device upload (GpuCSVScan/GpuOrcScan/GpuJsonReadCommon analog)."""
+
+    def __init__(self, paths: Sequence[str], fmt: str, schema: Schema,
+                 column_pruning=None, options=None,
+                 batch_size_rows: int = 1 << 20):
+        super().__init__((), schema)
+        self.paths = list(paths)
+        self.fmt = fmt
+        self.column_pruning = column_pruning
+        self.options = dict(options or {})
+        self.batch_size_rows = batch_size_rows
+
+    def num_partitions(self) -> int:
+        return max(len(self.paths), 1)
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        if idx >= len(self.paths):
+            return
+        from spark_rapids_tpu.io import formats as F
+        with timed(self.op_time):
+            for batch in F.read_batches(
+                    self.paths[idx], self.fmt,
+                    columns=self.column_pruning, schema=self.schema,
+                    batch_size_rows=self.batch_size_rows, **self.options):
+                self.output_rows.add(batch.host_num_rows())
+                yield self._count_out(batch)
+
+    def describe(self):
+        return f"TpuFileScan[{self.fmt}, {len(self.paths)} files]"

@@ -76,6 +76,28 @@ class ParquetRelation(LogicalPlan):
         return f"ParquetRelation[{len(self.paths)} files]{self._schema!r}"
 
 
+class FileRelation(LogicalPlan):
+    """Leaf: csv/json/orc files (parquet has its dedicated relation with
+    row-group pruning)."""
+
+    def __init__(self, paths: Sequence[str], fmt: str, schema: Schema,
+                 column_pruning: Optional[Tuple[str, ...]] = None,
+                 options: Optional[dict] = None):
+        self.paths = tuple(paths)
+        self.fmt = fmt
+        self._schema = schema
+        self.column_pruning = column_pruning
+        self.options = dict(options or {})
+        self.children = ()
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"FileRelation[{self.fmt}, {len(self.paths)} files]{self._schema!r}"
+
+
 class Project(LogicalPlan):
     def __init__(self, exprs: Sequence[Expression], child: LogicalPlan):
         self.exprs = tuple(e.bind(child.schema) for e in exprs)

@@ -261,6 +261,10 @@ class PlanMeta:
         if isinstance(p, L.ParquetRelation):
             return TpuParquetScanExec(p.paths, p.schema, p.column_pruning,
                                       self.conf.batch_size_rows)
+        if isinstance(p, L.FileRelation):
+            from spark_rapids_tpu.plan.execs.scan import TpuFileScanExec
+            return TpuFileScanExec(p.paths, p.fmt, p.schema, p.column_pruning,
+                                   p.options, self.conf.batch_size_rows)
         if isinstance(p, L.Project):
             child = self.children[0].convert()
             return TpuProjectExec(p.exprs, child, p.schema)

@@ -1,0 +1,60 @@
+"""UDF tests: trace-to-native compilation and row-UDF CPU fallback
+(udf-compiler analog)."""
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import If, col, lit, tpu_udf
+from tests.test_queries import assert_tpu_cpu_equal
+
+SCHEMA = Schema.of(a=T.INT, b=T.INT)
+
+
+def df(s, n=150):
+    rng = np.random.RandomState(4)
+    data = {"a": rng.randint(-100, 100, n).tolist(),
+            "b": rng.randint(1, 50, n).tolist()}
+    for i in rng.choice(n, 15, replace=False):
+        data["a"][i] = None
+    return s.create_dataframe(data, SCHEMA, num_partitions=2)
+
+
+@tpu_udf
+def affine(x, y):
+    return x * lit(3) + y - lit(7)
+
+
+@tpu_udf
+def clamped(x):
+    return If(x > lit(50), lit(50), x)
+
+
+@tpu_udf(return_type=T.INT)
+def opaque(x, y):
+    # data-dependent python control flow: not traceable
+    if x is None or y is None:
+        return None
+    return int(str(x * y)[-1])
+
+
+def test_traced_udf_plans_natively():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = df(s).select(affine(col("a"), col("b")).alias("r")).explain()
+    assert "will NOT" not in e, e
+    assert "pyudf" not in e
+
+
+def test_traced_udf_differential():
+    assert_tpu_cpu_equal(
+        lambda s: df(s).select(col("a"), affine(col("a"), col("b")).alias("r"),
+                               clamped(col("b")).alias("c")))
+
+
+def test_opaque_udf_falls_back_and_is_correct():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    plan = df(s).select(col("a"), opaque(col("a"), col("b")).alias("r"))
+    assert "will NOT" in plan.explain()
+    assert_tpu_cpu_equal(
+        lambda sess: df(sess).select(
+            col("a"), opaque(col("a"), col("b")).alias("r")))

@@ -87,6 +87,12 @@ class TpuSession:
                  **options) -> "DataFrame":
         return self._read_file(paths, "orc", columns, schema, **options)
 
+    def read_delta(self, table_path: str,
+                   version: Optional[int] = None) -> "DataFrame":
+        from spark_rapids_tpu.io.delta import load_snapshot
+        snapshot = load_snapshot(table_path, version)
+        return DataFrame(L.DeltaRelation(table_path, snapshot), self)
+
 
 class GroupedData:
     def __init__(self, df: "DataFrame", keys: Sequence[Expression]):

@@ -1,0 +1,74 @@
+"""Delta scan execution: parquet data files + partition-value columns.
+
+Reference: delta-lake/common/.../GpuDeltaParquetFileFormatUtils.scala —
+the GPU Delta scan is the parquet scan plus metadata columns; partition
+values come from the log, not the files.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.io.delta import DeltaSnapshot, partition_value_to_python
+from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+
+
+def read_delta_file_batch(path: str, pvals, snapshot: DeltaSnapshot
+                          ) -> ColumnarBatch:
+    """One add-file -> device batch in snapshot schema order."""
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.columnar.arrow import arrow_to_batch
+    data_cols = [n for n in snapshot.schema.names
+                 if n not in snapshot.partition_columns]
+    table = pq.read_table(path, columns=data_cols)
+    batch = arrow_to_batch(table)
+    n = batch.host_num_rows()
+    cap = batch.capacity if batch.columns else 1
+    cols = []
+    for name, dt in zip(snapshot.schema.names, snapshot.schema.dtypes):
+        if name in snapshot.partition_columns:
+            value = partition_value_to_python(pvals.get(name), dt)
+            if dt.variable_width:
+                cols.append(DeviceColumn.from_strings(
+                    [value] * n, capacity=cap, dtype=dt))
+            else:
+                arr = np.zeros((n,), dt.np_dtype)
+                valid = np.zeros((n,), np.bool_)
+                if value is not None:
+                    arr[:] = value
+                    valid[:] = True
+                cols.append(DeviceColumn.from_numpy(arr, dt, valid,
+                                                    capacity=cap))
+        else:
+            cols.append(batch.column(name))
+    return ColumnarBatch(tuple(cols), jnp.asarray(n, jnp.int32),
+                         snapshot.schema)
+
+
+class TpuDeltaScanExec(TpuExec):
+    def __init__(self, table_path: str, snapshot: DeltaSnapshot,
+                 schema: Schema):
+        super().__init__((), schema)
+        self.table_path = table_path
+        self.snapshot = snapshot
+
+    def num_partitions(self) -> int:
+        return max(len(self.snapshot.files), 1)
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        if idx >= len(self.snapshot.files):
+            return
+        path, pvals = self.snapshot.files[idx]
+        with timed(self.op_time):
+            batch = read_delta_file_batch(path, pvals, self.snapshot)
+        self.output_rows.add(batch.num_rows)
+        yield self._count_out(batch)
+
+    def describe(self):
+        return (f"TpuDeltaScan[{self.table_path}@v{self.snapshot.version}, "
+                f"{len(self.snapshot.files)} files]")

@@ -201,6 +201,14 @@ class CpuEngine:
             out.append(CpuTable.from_batch(batch))
         return out or [CpuTable.empty(plan.schema)]
 
+    def _exec_deltarelation(self, plan: L.DeltaRelation):
+        from spark_rapids_tpu.io.delta_scan import read_delta_file_batch
+        out = []
+        for path, pvals in plan.snapshot.files:
+            batch = read_delta_file_batch(path, pvals, plan.snapshot)
+            out.append(CpuTable.from_batch(batch))
+        return out or [CpuTable.empty(plan.schema)]
+
     def _exec_filerelation(self, plan: L.FileRelation):
         from spark_rapids_tpu.io import formats as F
         out = []

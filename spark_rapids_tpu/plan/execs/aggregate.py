@@ -275,18 +275,8 @@ class TpuHashAggregateExec(TpuExec):
         if len(partials) == 1:
             merged_in = partials[0]
         else:
-            total = sum(p.host_num_rows() for p in partials)
-            cap0 = round_up_pow2(max(total, 1))
-
-            def run(cap):
-                return concat_batches_device(partials, cap)
-
-            def check(res):
-                _, status = res
-                need = int(status.required_rows)
-                return None if need <= res[0].capacity else need
-
-            merged_in, _ = with_capacity_retry(run, check, cap0)
+            cap = round_up_pow2(max(sum(p.capacity for p in partials), 1))
+            merged_in, _ = concat_batches_device(partials, cap)
         return with_retry_no_split(lambda: self._jit_merge(merged_in))
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
@@ -308,7 +298,7 @@ class TpuHashAggregateExec(TpuExec):
                     return
             merged = self._merge_partials(partials)
             out = with_retry_no_split(lambda: self._jit_finalize(merged))
-        self.output_rows.add(out.host_num_rows())
+        self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
     def describe(self):

@@ -27,9 +27,23 @@ class Metric:
         self.name = name
         self.level = level
         self.value = 0
+        self._lazy: list = []
 
     def add(self, v) -> None:
-        self.value += v
+        """Accepts ints or device scalars.  Device scalars are accumulated
+        unresolved and only synced at snapshot time — a metric must never
+        force a device round-trip on the hot path (the analog of the
+        reference keeping metrics off the kernel path, GpuMetrics.scala)."""
+        if isinstance(v, (int, float)):
+            self.value += v
+        else:
+            self._lazy.append(v)
+
+    def resolve(self) -> int:
+        if self._lazy:
+            self.value += sum(int(x) for x in self._lazy)
+            self._lazy.clear()
+        return self.value
 
 
 class MetricSet:
@@ -44,7 +58,7 @@ class MetricSet:
         return self._metrics[name]
 
     def snapshot(self) -> Dict[str, int]:
-        return {k: m.value for k, m in self._metrics.items()}
+        return {k: m.resolve() for k, m in self._metrics.items()}
 
 
 class TpuExec:

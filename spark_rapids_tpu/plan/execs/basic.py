@@ -39,7 +39,7 @@ class TpuProjectExec(TpuExec):
         for batch in self.children[0].execute_partition(idx):
             with timed(self.op_time):
                 out = with_retry_no_split(lambda: self._run(batch))
-            self.output_rows.add(out.host_num_rows())
+            self.output_rows.add(out.num_rows)
             yield self._count_out(out)
 
     def describe(self):
@@ -65,7 +65,7 @@ class TpuFilterExec(TpuExec):
         for batch in self.children[0].execute_partition(idx):
             with timed(self.op_time):
                 out = with_retry_no_split(lambda: self._run(batch))
-            self.output_rows.add(out.host_num_rows())
+            self.output_rows.add(out.num_rows)
             yield self._count_out(out)
 
     def describe(self):
@@ -88,7 +88,7 @@ class TpuUnionExec(TpuExec):
                 for batch in c.execute_partition(idx):
                     # re-schema: union output names come from the first child
                     out = ColumnarBatch(batch.columns, batch.num_rows, self.schema)
-                    self.output_rows.add(out.host_num_rows())
+                    self.output_rows.add(out.num_rows)
                     yield self._count_out(out)
                 return
             idx -= n

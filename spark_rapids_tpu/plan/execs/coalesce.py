@@ -20,15 +20,8 @@ def coalesce_to_one(batches: List[ColumnarBatch]) -> Optional[ColumnarBatch]:
         return None
     if len(batches) == 1:
         return batches[0]
-    total = sum(b.host_num_rows() for b in batches)
-    cap0 = round_up_pow2(max(total, 1))
-
-    def run(cap):
-        return concat_batches_device(batches, cap)
-
-    def check(res):
-        need = int(res[1].required_rows)
-        return None if need <= res[0].capacity else need
-
-    out, _ = with_capacity_retry(run, check, cap0)
+    # size by the sum of static capacities: an upper bound on live rows, so
+    # the concat can never overflow and needs no device sync or retry
+    cap = round_up_pow2(max(sum(b.capacity for b in batches), 1))
+    out, _ = concat_batches_device(batches, cap)
     return out

@@ -147,7 +147,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 return
             with timed(self.op_time):
                 out = merge_batches(buffers, self.schema)
-            self.output_rows.add(out.host_num_rows())
+            self.output_rows.add(out.num_rows)
             yield self._count_out(out)
             return
         buckets = self._materialize()
@@ -158,18 +158,9 @@ class TpuShuffleExchangeExec(TpuExec):
         if len(batches) == 1:
             out = batches[0]
         else:
-            total = sum(b.host_num_rows() for b in batches)
-            cap0 = round_up_pow2(max(total, 1))
-
-            def run(cap):
-                return concat_batches_device(batches, cap)
-
-            def check(res):
-                need = int(res[1].required_rows)
-                return None if need <= res[0].capacity else need
-
-            out, _ = with_capacity_retry(run, check, cap0)
-        self.output_rows.add(out.host_num_rows())
+            cap = round_up_pow2(max(sum(b.capacity for b in batches), 1))
+            out, _ = concat_batches_device(batches, cap)
+        self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
     def describe(self):
@@ -190,7 +181,7 @@ class TpuSinglePartitionExec(TpuExec):
         child = self.children[0]
         for p in range(child.num_partitions()):
             for batch in child.execute_partition(p):
-                self.output_rows.add(batch.host_num_rows())
+                self.output_rows.add(batch.num_rows)
                 yield self._count_out(batch)
 
     def describe(self):

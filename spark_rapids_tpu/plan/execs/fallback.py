@@ -62,7 +62,7 @@ class TpuCpuFallbackExec(TpuExec):
             return
         with timed(self.op_time):
             batch = cpu_table_to_batch(t)
-        self.output_rows.add(batch.host_num_rows())
+        self.output_rows.add(batch.num_rows)
         yield self._count_out(batch)
 
     def describe(self):

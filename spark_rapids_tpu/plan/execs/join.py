@@ -52,7 +52,7 @@ class _JoinKernel:
         self._jitted = jitted
 
     def __call__(self, l: ColumnarBatch, r: ColumnarBatch) -> ColumnarBatch:
-        nl, nr = l.host_num_rows(), r.host_num_rows()
+        nl, nr = l.capacity, r.capacity   # static bound: no device sync
         if self.join_type == "cross":
             guess = max(nl * max(nr, 1), 1)
         elif self.join_type in ("left_semi", "left_anti"):
@@ -112,9 +112,7 @@ class TpuShuffledHashJoinExec(TpuExec):
             right = ColumnarBatch.empty(self.children[1].schema)
         with timed(self.op_time):
             out = self._kernel(left, right)
-        if out.host_num_rows() == 0:
-            return
-        self.output_rows.add(out.host_num_rows())
+        self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
     def describe(self):
@@ -170,9 +168,7 @@ class TpuBroadcastHashJoinExec(TpuExec):
             build = ColumnarBatch.empty(self.children[1].schema)
         with timed(self.op_time):
             out = self._kernel(left, build)
-        if out.host_num_rows() == 0:
-            return
-        self.output_rows.add(out.host_num_rows())
+        self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
     def describe(self):

@@ -28,7 +28,7 @@ class TpuInMemoryScanExec(TpuExec):
         if idx >= len(self.partitions):
             return
         for batch in self.partitions[idx]:
-            self.output_rows.add(batch.host_num_rows())
+            self.output_rows.add(batch.num_rows)
             yield self._count_out(batch)
 
     def describe(self):
@@ -58,7 +58,7 @@ class TpuParquetScanExec(TpuExec):
                     self.paths[idx],
                     columns=list(self.column_pruning) if self.column_pruning else None,
                     batch_size_rows=self.batch_size_rows):
-                self.output_rows.add(batch.host_num_rows())
+                self.output_rows.add(batch.num_rows)
                 yield self._count_out(batch)
 
     def describe(self):
@@ -91,7 +91,7 @@ class TpuFileScanExec(TpuExec):
                     self.paths[idx], self.fmt,
                     columns=self.column_pruning, schema=self.schema,
                     batch_size_rows=self.batch_size_rows, **self.options):
-                self.output_rows.add(batch.host_num_rows())
+                self.output_rows.add(batch.num_rows)
                 yield self._count_out(batch)
 
     def describe(self):

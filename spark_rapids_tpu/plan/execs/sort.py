@@ -54,19 +54,10 @@ class TpuSortExec(TpuExec):
             if len(batches) == 1:
                 merged = batches[0]
             else:
-                total = sum(b.host_num_rows() for b in batches)
-                cap0 = round_up_pow2(max(total, 1))
-
-                def run(cap):
-                    return concat_batches_device(batches, cap)
-
-                def check(res):
-                    need = int(res[1].required_rows)
-                    return None if need <= res[0].capacity else need
-
-                merged, _ = with_capacity_retry(run, check, cap0)
+                cap = round_up_pow2(max(sum(b.capacity for b in batches), 1))
+                merged, _ = concat_batches_device(batches, cap)
             out = with_retry_no_split(lambda: self._run(merged))
-        self.output_rows.add(out.host_num_rows())
+        self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
     def describe(self):

@@ -168,7 +168,7 @@ class TpuWindowExec(TpuExec):
             return
         with timed(self.op_time):
             out = with_retry_no_split(lambda: self._run(merged))
-        self.output_rows.add(out.host_num_rows())
+        self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
     def describe(self):

@@ -98,6 +98,24 @@ class FileRelation(LogicalPlan):
         return f"FileRelation[{self.fmt}, {len(self.paths)} files]{self._schema!r}"
 
 
+class DeltaRelation(LogicalPlan):
+    """Leaf: a Delta Lake table snapshot (io/delta.py log replay)."""
+
+    def __init__(self, table_path: str, snapshot):
+        self.table_path = table_path
+        self.snapshot = snapshot
+        self._schema = snapshot.schema
+        self.children = ()
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return (f"DeltaRelation[{self.table_path}@v{self.snapshot.version}, "
+                f"{len(self.snapshot.files)} files]")
+
+
 class Project(LogicalPlan):
     def __init__(self, exprs: Sequence[Expression], child: LogicalPlan):
         self.exprs = tuple(e.bind(child.schema) for e in exprs)

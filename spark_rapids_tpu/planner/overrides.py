@@ -265,6 +265,9 @@ class PlanMeta:
             from spark_rapids_tpu.plan.execs.scan import TpuFileScanExec
             return TpuFileScanExec(p.paths, p.fmt, p.schema, p.column_pruning,
                                    p.options, self.conf.batch_size_rows)
+        if isinstance(p, L.DeltaRelation):
+            from spark_rapids_tpu.io.delta_scan import TpuDeltaScanExec
+            return TpuDeltaScanExec(p.table_path, p.snapshot, p.schema)
         if isinstance(p, L.Project):
             child = self.children[0].convert()
             return TpuProjectExec(p.exprs, child, p.schema)

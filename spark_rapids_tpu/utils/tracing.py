@@ -1,0 +1,99 @@
+"""Named trace ranges with a documented registry.
+
+Reference: NvtxRangeWithDoc.scala (911 LoC) — every profiling range has a
+registered name + docstring, emitted into docs so traces are navigable
+(docs/dev/nvtx_profiling.md).  The TPU twin emits
+jax.profiler.TraceAnnotation ranges (visible in XLA/Perfetto traces) plus a
+lightweight in-process span log usable without a profiler attached.
+
+Usage:
+    with trace_range("agg.partial", "per-batch update aggregation"):
+        ...
+Registered names + docs are dumped by tools/generate_docs.py.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_registry: Dict[str, str] = {}
+_lock = threading.Lock()
+
+
+class SpanLog:
+    """In-process span collector (enable() to start; snapshot() to read)."""
+
+    def __init__(self):
+        self.enabled = False
+        self._spans: List[Tuple[str, float, float]] = []
+        self._lock = threading.Lock()
+
+    def record(self, name: str, t0: float, t1: float) -> None:
+        if self.enabled:
+            with self._lock:
+                self._spans.append((name, t0, t1))
+
+    def snapshot(self) -> List[Tuple[str, float, float]]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def summary(self) -> Dict[str, Tuple[int, float]]:
+        """name -> (count, total seconds)."""
+        out: Dict[str, Tuple[int, float]] = {}
+        for name, t0, t1 in self.snapshot():
+            c, t = out.get(name, (0, 0.0))
+            out[name] = (c + 1, t + (t1 - t0))
+        return out
+
+
+span_log = SpanLog()
+
+
+def register_range(name: str, doc: str) -> None:
+    with _lock:
+        if name in _registry and _registry[name] != doc:
+            raise ValueError(f"trace range {name!r} re-registered with a "
+                             "different doc")
+        _registry[name] = doc
+
+
+def registered_ranges() -> Dict[str, str]:
+    with _lock:
+        return dict(_registry)
+
+
+@contextlib.contextmanager
+def trace_range(name: str, doc: Optional[str] = None):
+    """Named range: registers (once), annotates the XLA trace, logs a span."""
+    if doc is not None and name not in _registry:
+        register_range(name, doc)
+    t0 = time.perf_counter()
+    try:
+        import jax.profiler
+        cm = jax.profiler.TraceAnnotation(name)
+    except Exception:
+        cm = contextlib.nullcontext()
+    with cm:
+        yield
+    span_log.record(name, t0, time.perf_counter())
+
+
+def generate_ranges_doc() -> str:
+    lines = [
+        "# Trace range registry",
+        "",
+        "Generated from spark_rapids_tpu.utils.tracing (the "
+        "NvtxRangeWithDoc analog: every named range documents itself).",
+        "",
+        "| Range | What it covers |",
+        "|---|---|",
+    ]
+    for name in sorted(_registry):
+        lines.append(f"| `{name}` | {_registry[name]} |")
+    return "\n".join(lines) + "\n"

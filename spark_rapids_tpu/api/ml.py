@@ -1,0 +1,71 @@
+"""ML framework handoff: zero-copy columnar batches out of a query.
+
+Reference: sql-plugin-api ColumnarRdd.scala:26-54 — `DataFrame ->
+RDD[cudf.Table]` so XGBoost consumes GPU data without a host round trip.
+The TPU twin hands query results to JAX-native training directly (the
+batches ARE jax arrays — literally zero copy), and to torch via dlpack.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+
+def columnar_batches(df) -> List[ColumnarBatch]:
+    """All result batches, device-resident (the ColumnarRdd analog)."""
+    return [b for part in df._collect_batches() for b in part]
+
+
+def to_jax_arrays(df, columns=None) -> Tuple[dict, "object"]:
+    """Query result as {name: jax array} of live rows + validity dict.
+
+    Zero-copy on device: slicing a jax array is a device view operation;
+    nothing moves to the host.
+    """
+    batches = columnar_batches(df)
+    names = columns or list(df.schema.names)
+    import jax.numpy as jnp
+    cols = {n: [] for n in names}
+    valids = {n: [] for n in names}
+    for b in batches:
+        n_rows = b.host_num_rows()
+        for name in names:
+            c = b.column(name)
+            assert not c.is_string_like, \
+                "string columns have no dense tensor form"
+            cols[name].append(c.data[:n_rows])
+            valids[name].append(c.validity[:n_rows])
+    data = {n: jnp.concatenate(v) if v else jnp.zeros((0,))
+            for n, v in cols.items()}
+    validity = {n: jnp.concatenate(v) if v else jnp.zeros((0,), bool)
+                for n, v in valids.items()}
+    return data, validity
+
+
+def to_feature_matrix(df, feature_columns, label_column=None):
+    """(features [n, k] f32 jax array, labels or None) — the DMatrix-style
+    handoff for gradient-boosting / NN training on device."""
+    import jax.numpy as jnp
+    data, _ = to_jax_arrays(
+        df, list(feature_columns) + ([label_column] if label_column else []))
+    feats = jnp.stack([data[c].astype(jnp.float32)
+                       for c in feature_columns], axis=1)
+    labels = data[label_column] if label_column else None
+    return feats, labels
+
+
+def to_torch(df, feature_columns, label_column=None):
+    """Torch tensors via dlpack (no host copy where the backend allows)."""
+    import torch
+    feats, labels = to_feature_matrix(df, feature_columns, label_column)
+    try:
+        tf = torch.from_dlpack(feats)
+        tl = torch.from_dlpack(labels) if labels is not None else None
+    except Exception:
+        tf = torch.as_tensor(np.asarray(feats))
+        tl = (torch.as_tensor(np.asarray(labels))
+              if labels is not None else None)
+    return tf, tl

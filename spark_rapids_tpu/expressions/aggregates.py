@@ -26,6 +26,7 @@ from spark_rapids_tpu.expressions.core import Expression
 
 # update/merge op kinds the kernel layer implements
 SUM = "sum"
+SUM_SQ = "sum_sq"            # sum of squares (variance/stddev buffers)
 COUNT_VALID = "count_valid"  # counts non-null inputs
 COUNT_STAR = "count_star"    # counts rows
 MIN = "min"
@@ -269,3 +270,98 @@ def max_(e) -> Max:
 def avg(e) -> Average:
     from spark_rapids_tpu.expressions.core import col
     return Average(col(e) if isinstance(e, str) else e)
+
+
+class VarianceBase(AggregateFunction):
+    """Shared (sum, sum_sq, n) buffer plan.
+
+    Reference: aggregateFunctions.scala GpuStddevSamp/GpuVariancePop etc.
+    Finalize uses the textbook M2 identity; the differential harness
+    compares floats approximately, as the reference's tests do.
+    """
+
+    name = "var"
+    _sample = True    # sample (n-1) vs population (n)
+    _sqrt = False     # stddev applies sqrt
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def buffers(self):
+        return (BufferSlot(T.DOUBLE, SUM, SUM),
+                BufferSlot(T.DOUBLE, SUM_SQ, SUM),
+                BufferSlot(T.LONG, COUNT_VALID, SUM))
+
+    def _finish(self, s, sq, n, xp):
+        denom_ok = n > (1 if self._sample else 0)
+        nf = xp.where(n > 0, n, 1).astype("float64") if xp is np else             xp.where(n > 0, n, 1).astype(s.dtype)
+        m2 = sq - (s * s) / nf
+        m2 = xp.maximum(m2, 0.0)   # clamp negative rounding residue
+        div = (nf - 1) if self._sample else nf
+        var = m2 / xp.where(denom_ok, div, 1)
+        if self._sqrt:
+            var = xp.sqrt(var)
+        return var, denom_ok
+
+    def finalize_np(self, bufs):
+        (s, _), (sq, _), (n, _) = bufs
+        with np.errstate(all="ignore"):
+            v, ok = self._finish(s.astype(np.float64), sq.astype(np.float64),
+                                 n, np)
+        return v, ok
+
+    def finalize_jnp(self, bufs):
+        import jax.numpy as jnp
+        (s, _), (sq, _), (n, _) = bufs
+        return self._finish(s, sq, n, jnp)
+
+
+class VarianceSamp(VarianceBase):
+    name = "var_samp"
+    _sample = True
+
+
+class VariancePop(VarianceBase):
+    name = "var_pop"
+    _sample = False
+
+
+class StddevSamp(VarianceBase):
+    name = "stddev_samp"
+    _sample = True
+    _sqrt = True
+
+
+class StddevPop(VarianceBase):
+    name = "stddev_pop"
+    _sample = False
+    _sqrt = True
+
+
+def var_samp(e):
+    from spark_rapids_tpu.expressions.core import col
+    return VarianceSamp(col(e) if isinstance(e, str) else e)
+
+
+def var_pop(e):
+    from spark_rapids_tpu.expressions.core import col
+    return VariancePop(col(e) if isinstance(e, str) else e)
+
+
+def stddev(e):
+    from spark_rapids_tpu.expressions.core import col
+    return StddevSamp(col(e) if isinstance(e, str) else e)
+
+
+def stddev_pop(e):
+    from spark_rapids_tpu.expressions.core import col
+    return StddevPop(col(e) if isinstance(e, str) else e)

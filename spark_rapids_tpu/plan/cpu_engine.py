@@ -29,6 +29,7 @@ from spark_rapids_tpu.expressions.aggregates import (
     MAX,
     MIN,
     SUM,
+    SUM_SQ,
     AggregateFunction,
 )
 from spark_rapids_tpu.kernels.hash import py_murmur3_row
@@ -296,6 +297,10 @@ class CpuEngine:
                     elif slot.update_op == SUM:
                         with np.errstate(all="ignore"):
                             bv[gi] = vals[sel].astype(slot.dtype.np_dtype).sum()
+                    elif slot.update_op == SUM_SQ:
+                        with np.errstate(all="ignore"):
+                            x = vals[sel].astype(np.float64)
+                            bv[gi] = (x * x).sum()
                     elif slot.update_op == MIN:
                         bv[gi] = _extreme_np(vals[sel], slot.dtype, is_min=True)
                     elif slot.update_op == MAX:

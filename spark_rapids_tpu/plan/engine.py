@@ -32,10 +32,13 @@ class TpuEngine:
                 sem.release_if_necessary()
 
         threads = min(nparts, max(self.conf.concurrent_tpu_tasks, 1))
-        if threads <= 1 or nparts <= 1:
-            return [run_one(p) for p in range(nparts)]
-        with ThreadPoolExecutor(max_workers=threads) as pool:
-            return list(pool.map(run_one, range(nparts)))
+        try:
+            if threads <= 1 or nparts <= 1:
+                return [run_one(p) for p in range(nparts)]
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                return list(pool.map(run_one, range(nparts)))
+        finally:
+            plan.cleanup()
 
     def collect(self, plan: TpuExec) -> List[tuple]:
         from spark_rapids_tpu.plan.cpu_engine import CpuTable

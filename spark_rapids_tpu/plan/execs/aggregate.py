@@ -34,6 +34,7 @@ from spark_rapids_tpu.expressions.aggregates import (
     MAX,
     MIN,
     SUM,
+    SUM_SQ,
     AggregateFunction,
 )
 from spark_rapids_tpu.kernels import groupby as G
@@ -78,6 +79,12 @@ def _seg_update(op: str, col: Optional[DeviceColumn], layout: G.GroupedLayout,
         return G.seg_count_valid(col, layout)
     if op == SUM:
         return G.seg_sum(col, layout, out_dtype.jnp_dtype)
+    if op == SUM_SQ:
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+        sq = col.data.astype(out_dtype.jnp_dtype)
+        sq = jnp.where(col.validity, sq * sq, 0)
+        sq_col = DeviceColumn(sq, col.validity, out_dtype)
+        return G.seg_sum(sq_col, layout, out_dtype.jnp_dtype)
     if op == MIN:
         return G.seg_min(col, layout)
     if op == MAX:
@@ -97,6 +104,9 @@ def _global_update(op: str, col: Optional[DeviceColumn], live, out_dtype):
     if op == SUM:
         vals = col.data.astype(out_dtype.jnp_dtype)
         return jnp.sum(jnp.where(valid, vals, 0)), nvalid > 0
+    if op == SUM_SQ:
+        vals = col.data.astype(out_dtype.jnp_dtype)
+        return jnp.sum(jnp.where(valid, vals * vals, 0)), nvalid > 0
     if op in (MIN, MAX):
         dt = col.data.dtype
         is_min = op == MIN

@@ -101,6 +101,13 @@ class TpuExec:
         self.output_batches.add(1)
         return batch
 
+    def cleanup(self) -> None:
+        """Release retained resources (shuffle catalogs, broadcast builds)
+        after the query finishes — the ShuffleCleanupManager analog
+        (Plugin.scala:497-521).  Recurses the exec tree."""
+        for c in self.children:
+            c.cleanup()
+
 
 class timed:
     """Context manager adding wall time to a metric (NvtxWithMetrics analog)."""

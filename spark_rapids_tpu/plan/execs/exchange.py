@@ -163,6 +163,16 @@ class TpuShuffleExchangeExec(TpuExec):
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
+    def cleanup(self) -> None:
+        with self._lock:
+            if self._materialized is not None:
+                for bucket in self._materialized:
+                    for h in bucket:
+                        h.close()
+                self._materialized = None
+            self._wire = None
+        super().cleanup()
+
     def describe(self):
         keys = ", ".join(map(repr, self.keys))
         return f"TpuShuffleExchange[{self.out_partitions}, keys=[{keys}]]"

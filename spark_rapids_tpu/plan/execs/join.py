@@ -171,6 +171,12 @@ class TpuBroadcastHashJoinExec(TpuExec):
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
+    def cleanup(self) -> None:
+        with self._lock:
+            self._build = None
+            self._build_done = False
+        super().cleanup()
+
     def describe(self):
         return (f"TpuBroadcastHashJoin[{self.join_type}, "
                 f"lkeys={self.left_key_idx}, rkeys={self.right_key_idx}]")

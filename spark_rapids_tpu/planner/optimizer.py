@@ -1,0 +1,150 @@
+"""Logical optimizations applied before planning.
+
+The role Catalyst's optimizer plays for the reference (plus the pieces of
+GpuTransitionOverrides/CostBasedOptimizer that reshape plans): today a
+column-pruning pass — scans materialize only columns some ancestor actually
+references, and parquet/file relations push the pruning into the file
+reader itself.
+
+Expressions in our logical nodes are bound by ordinal, so pruning rebuilds
+the tree through name-based unbinding; plans with duplicate column names
+anywhere (post-join self-joins) are left untouched (correct, just
+unpruned).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set
+
+from spark_rapids_tpu.expressions.core import (
+    Alias, BoundReference, Col, Expression)
+from spark_rapids_tpu.plan import logical as L
+
+
+def _unbind(e: Expression) -> Expression:
+    if isinstance(e, BoundReference):
+        return Col(e.name)
+    if not e.children:
+        return e
+    return e.with_children(tuple(_unbind(c) for c in e.children))
+
+
+def _names_unique(plan: L.LogicalPlan) -> bool:
+    names = plan.schema.names
+    if len(set(names)) != len(names):
+        return False
+    return all(_names_unique(c) for c in plan.children)
+
+
+def prune_columns(plan: L.LogicalPlan) -> L.LogicalPlan:
+    if not _names_unique(plan):
+        return plan
+    return _prune(plan, set(plan.schema.names))
+
+
+def _exprs_refs(exprs) -> Set[str]:
+    out: Set[str] = set()
+    for e in exprs:
+        out |= e.references()
+    return out
+
+
+def _prune(plan: L.LogicalPlan, required: Set[str]) -> L.LogicalPlan:
+    p = plan
+    if isinstance(p, (L.InMemoryRelation, L.ParquetRelation, L.FileRelation,
+                      L.DeltaRelation)):
+        have = list(p.schema.names)
+        keep = [n for n in have if n in required]
+        if len(keep) == len(have) or not keep:
+            return p
+        if isinstance(p, L.ParquetRelation):
+            from spark_rapids_tpu.columnar.batch import Schema
+            idx = [p.schema.index_of(n) for n in keep]
+            return L.ParquetRelation(
+                p.paths, Schema(tuple(keep),
+                                tuple(p.schema.dtypes[i] for i in idx)),
+                tuple(keep))
+        if isinstance(p, L.FileRelation):
+            from spark_rapids_tpu.columnar.batch import Schema
+            idx = [p.schema.index_of(n) for n in keep]
+            return L.FileRelation(
+                p.paths, p.fmt,
+                Schema(tuple(keep), tuple(p.schema.dtypes[i] for i in idx)),
+                tuple(keep), p.options)
+        # in-memory / delta: select on top (BoundReference re-pick is
+        # zero-copy in the exec)
+        return L.Project([Col(n) for n in keep], p)
+
+    if isinstance(p, L.Project):
+        need_mine = {n for n in p.schema.names if n in required}
+        kept = [(e, n) for e, n in zip(p.exprs, p.schema.names)
+                if n in need_mine] or [(p.exprs[0], p.schema.names[0])]
+        child_req = _exprs_refs(e for e, _ in kept)
+        child = _prune(p.child, child_req)
+        return L.Project([_unbind(e).alias(n) for e, n in kept], child)
+
+    if isinstance(p, L.Filter):
+        child_req = set(required) | _exprs_refs([p.condition])
+        child = _prune(p.child, child_req)
+        return L.Filter(_unbind(p.condition), child)
+
+    if isinstance(p, L.Aggregate):
+        child_req = _exprs_refs(list(p.group_exprs) + list(p.agg_exprs))
+        child = _prune(p.child, child_req)
+        nkeys = len(p.group_exprs)
+        names = p.schema.names
+        return L.Aggregate(
+            [_unbind(e).alias(names[i]) if not isinstance(e, Alias) else _unbind(e)
+             for i, e in enumerate(p.group_exprs)],
+            [_unbind(e) if isinstance(e, Alias)
+             else _unbind(e).alias(names[nkeys + i])
+             for i, e in enumerate(p.agg_exprs)],
+            child)
+
+    if isinstance(p, L.Sort):
+        child_req = set(required) | _exprs_refs(e for e, _ in p.orders)
+        child = _prune(p.child, child_req)
+        return L.Sort([(_unbind(e), o) for e, o in p.orders], child,
+                      p.global_sort)
+
+    if isinstance(p, L.Limit):
+        return L.Limit(p.n, _prune(p.child, required))
+
+    if isinstance(p, L.Union):
+        # positional semantics across children; keep unpruned for now
+        return p
+
+    if isinstance(p, L.Repartition):
+        child_req = set(required) | _exprs_refs(p.keys)
+        child = _prune(p.child, child_req)
+        return L.Repartition(p.num_partitions, [_unbind(k) for k in p.keys],
+                             child)
+
+    if isinstance(p, L.Window):
+        child_req = set(required) | _exprs_refs(p.window_exprs)
+        # window output appends to the child's schema: the child must still
+        # produce everything required that isn't a window column
+        win_names = set(p.schema.names) - set(p.child.schema.names)
+        child_req -= win_names
+        child_req &= set(p.child.schema.names) | set()
+        child_req |= {n for n in required if n in p.child.schema.names}
+        child = _prune(p.child, child_req or set(p.child.schema.names))
+        return L.Window([_unbind(e) for e in p.window_exprs], child)
+
+    if isinstance(p, L.Join):
+        lreq = ({n for n in required if n in p.left.schema.names}
+                | _exprs_refs(p.left_keys))
+        rreq = ({n for n in required if n in p.right.schema.names}
+                | _exprs_refs(p.right_keys))
+        if p.condition is not None:
+            crefs = p.condition.references()
+            lreq |= {n for n in crefs if n in p.left.schema.names}
+            rreq |= {n for n in crefs if n in p.right.schema.names}
+        left = _prune(p.left, lreq)
+        right = _prune(p.right, rreq)
+        return L.Join(left, right,
+                      [_unbind(k) for k in p.left_keys],
+                      [_unbind(k) for k in p.right_keys],
+                      p.join_type,
+                      _unbind(p.condition) if p.condition is not None else None)
+
+    return p

@@ -54,6 +54,7 @@ _SUPPORTED_EXPRS = {
     GreaterThanOrEqual,
     If, CaseWhen, Cast,
     A.Sum, A.Count, A.Min, A.Max, A.Average,
+    A.VarianceSamp, A.VariancePop, A.StddevSamp, A.StddevPop,
     Length, Upper, Lower, Substring, ConcatStrings, Trim,
     StartsWith, EndsWith, Contains, Like,
 }
@@ -423,7 +424,9 @@ def _non_agg_leaf_refs(e: E.Expression) -> List[E.Expression]:
 def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
                ) -> Tuple[TpuExec, PlanMeta]:
     """wrapAndTagPlan + convert (GpuOverrides.scala:4423,:5148 analog)."""
+    from spark_rapids_tpu.planner.optimizer import prune_columns
     conf = conf or RapidsConf()
+    plan = prune_columns(plan)
     meta = PlanMeta(plan, conf)
     meta.tag()
     exec_plan = meta.convert()

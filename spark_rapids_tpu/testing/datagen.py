@@ -1,0 +1,125 @@
+"""Deterministic, shape-controllable data generation.
+
+The datagen module analog (reference: datagen/.../bigDataGen.scala — seed-
+stable generation with controllable nulls/cardinality/skew, used by scale
+tests) plus FuzzerUtils (tests/.../FuzzerUtils.scala — random schemas and
+batches for fuzz suites).
+
+Every generator is a pure function of (seed, row index) shape parameters,
+so regenerating any slice is reproducible — the property the reference's
+scale tests rely on.
+"""
+from __future__ import annotations
+
+import string
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+
+FUZZ_DTYPES: List[T.DataType] = [
+    T.BOOLEAN, T.BYTE, T.SHORT, T.INT, T.LONG, T.FLOAT, T.DOUBLE,
+    T.DATE, T.TIMESTAMP, T.STRING,
+]
+
+
+class ColumnSpec:
+    def __init__(self, dtype: T.DataType, null_fraction: float = 0.1,
+                 cardinality: Optional[int] = None, zipf: float = 0.0,
+                 special_values: bool = True):
+        self.dtype = dtype
+        self.null_fraction = null_fraction
+        self.cardinality = cardinality
+        self.zipf = zipf          # >0: skewed key distribution
+        self.special_values = special_values
+
+
+def _gen_values(spec: ColumnSpec, n: int, rng: np.random.RandomState):
+    dt = spec.dtype
+    if spec.cardinality:
+        if spec.zipf > 0:
+            ranks = rng.zipf(1.0 + spec.zipf, n)
+            base = (ranks % spec.cardinality).astype(np.int64)
+        else:
+            base = rng.randint(0, spec.cardinality, n).astype(np.int64)
+    else:
+        base = None
+
+    if isinstance(dt, T.BooleanType):
+        return (rng.rand(n) > 0.5) if base is None else (base % 2 == 0)
+    if dt.is_integral:
+        info = np.iinfo(dt.np_dtype)
+        if base is not None:
+            return base.astype(dt.np_dtype)
+        vals = rng.randint(info.min // 2, info.max // 2, n).astype(dt.np_dtype)
+        if spec.special_values and n >= 4:
+            vals[0], vals[1], vals[2] = info.min, info.max, 0
+        return vals
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        vals = (rng.randn(n) * 10.0 ** rng.randint(-2, 6, n).astype(np.float64)).astype(dt.np_dtype)
+        if base is not None:
+            vals = base.astype(dt.np_dtype)
+        elif spec.special_values and n >= 6:
+            vals[0], vals[1], vals[2] = np.nan, np.inf, -np.inf
+            vals[3], vals[4] = 0.0, -0.0
+        return vals
+    if isinstance(dt, T.DateType):
+        return rng.randint(-30000, 40000, n).astype(np.int32)
+    if isinstance(dt, T.TimestampType):
+        return rng.randint(-2**48, 2**48, n).astype(np.int64)
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        alphabet = string.ascii_letters + string.digits + " _%"
+        out = []
+        for i in range(n):
+            if base is not None:
+                ln = int(base[i] % 12)
+                r2 = np.random.RandomState(int(base[i]) % (2**31))
+            else:
+                ln = int(rng.randint(0, 16))
+                r2 = rng
+            out.append("".join(alphabet[j] for j in
+                               r2.randint(0, len(alphabet), ln)))
+        if spec.special_values and n >= 2:
+            out[0] = ""
+        return out
+    raise NotImplementedError(repr(dt))
+
+
+def gen_batch(schema: Schema, specs: Sequence[ColumnSpec], n: int,
+              seed: int = 0) -> ColumnarBatch:
+    rng = np.random.RandomState(seed)
+    data: Dict[str, list] = {}
+    for name, spec in zip(schema.names, specs):
+        vals = _gen_values(spec, n, rng)
+        vals = list(vals) if not isinstance(vals, list) else vals
+        if spec.null_fraction > 0:
+            for i in rng.choice(n, int(n * spec.null_fraction), replace=False):
+                vals[i] = None
+        data[name] = vals
+    return ColumnarBatch.from_pydict(data, schema)
+
+
+def random_schema(rng: np.random.RandomState, max_cols: int = 5):
+    """(schema, specs): a fuzz schema with at least one group-able column."""
+    ncols = rng.randint(2, max_cols + 1)
+    names = []
+    dtypes = []
+    specs = []
+    for i in range(ncols):
+        dt = FUZZ_DTYPES[rng.randint(0, len(FUZZ_DTYPES))]
+        names.append(f"c{i}")
+        dtypes.append(dt)
+        specs.append(ColumnSpec(
+            dt,
+            null_fraction=float(rng.choice([0.0, 0.1, 0.35])),
+            cardinality=(int(rng.choice([3, 17, 1000]))
+                         if rng.rand() < 0.5 else None),
+            zipf=float(rng.choice([0.0, 1.2])),
+        ))
+    # force column 0 usable as a fixed-width grouping/join key
+    if dtypes[0].variable_width:
+        dtypes[0] = T.INT
+        specs[0] = ColumnSpec(T.INT, null_fraction=0.1, cardinality=13)
+    return Schema(tuple(names), tuple(dtypes)), specs

@@ -1,0 +1,40 @@
+"""ColumnarRdd-analog tests: zero-copy handoff of query results to JAX and
+torch."""
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api import ml
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expressions import col, lit
+
+
+def df(s):
+    rng = np.random.RandomState(5)
+    n = 200
+    data = {
+        "f1": rng.randn(n).tolist(),
+        "f2": rng.randn(n).tolist(),
+        "y": rng.randint(0, 2, n).tolist(),
+    }
+    return s.create_dataframe(data, Schema.of(f1=T.DOUBLE, f2=T.DOUBLE,
+                                              y=T.INT), num_partitions=2)
+
+
+def test_to_jax_arrays():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    q = df(s).filter(col("f1") > lit(0.0))
+    data, validity = ml.to_jax_arrays(q)
+    n = int(validity["f1"].shape[0])
+    assert n == len(q.collect())
+    assert float(np.asarray(data["f1"]).min()) > 0.0
+
+
+def test_feature_matrix_and_torch():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    q = df(s)
+    feats, labels = ml.to_feature_matrix(q, ["f1", "f2"], "y")
+    assert feats.shape == (200, 2)
+    tf, tl = ml.to_torch(q, ["f1", "f2"], "y")
+    assert tuple(tf.shape) == (200, 2)
+    assert int(tl.sum()) == sum(r[2] for r in q.collect())

@@ -197,9 +197,7 @@ def test_repartition_preserves_rows():
         lambda s: source(s).repartition(5, col("k")))
 
 
-def test_join_falls_back_to_cpu():
-    """Joins aren't on TPU yet: they must still produce correct results via
-    the CPU fallback island, and explain must say why."""
+def test_join_agg_pipeline_runs_on_tpu():
     def build(s):
         left = source(s, seed=3)
         right = source(s, seed=4).group_by("k").agg(sum_("v").alias("rv"))
@@ -207,9 +205,7 @@ def test_join_falls_back_to_cpu():
 
     assert_tpu_cpu_equal(build)
     tpu = TpuSession({"spark.rapids.sql.enabled": "true"})
-    explain = build(tpu).explain()
-    assert "will NOT run on TPU" in explain
-    assert "join" in explain.lower()
+    assert "will NOT" not in build(tpu).explain()
 
 
 def test_explain_marks_supported_plan():

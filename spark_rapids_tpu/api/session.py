@@ -164,6 +164,11 @@ class DataFrame:
             L.Repartition(num_partitions, [_to_expr(k) for k in keys],
                           self.plan), self.session)
 
+    def map_batches(self, fn, schema: Schema) -> "DataFrame":
+        """Arrow-batch python transform: fn(pyarrow.Table) -> pyarrow.Table
+        producing `schema` (pandas interop: use table.to_pandas() inside)."""
+        return DataFrame(L.MapBatches(fn, schema, self.plan), self.session)
+
     def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
         if isinstance(on, str):
             on = [on]

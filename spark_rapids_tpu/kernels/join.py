@@ -55,6 +55,7 @@ def join_gather_maps(
     right_keys: Sequence[int],
     join_type: str,
     out_capacity: int,
+    string_max_bytes: int = 0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, OverflowStatus]:
     """Produce (left_idx[OC], right_idx[OC], count, status).
 
@@ -93,7 +94,21 @@ def join_gather_maps(
     for lk, rk in zip(left_keys, right_keys):
         lc = normalize_key_column(left.columns[lk])
         rc = normalize_key_column(right.columns[rk])
-        assert not lc.is_string_like, "string join keys not yet supported"
+        if lc.is_string_like:
+            # string keys: compare via the sort kernel's packed byte-chunk
+            # keys, computed per side at a shared bucket then concatenated —
+            # equality of chunk sequences == byte equality when the bucket
+            # covers the longest live key (caller contract)
+            from spark_rapids_tpu.kernels.sort import _string_data_keys
+            assert string_max_bytes > 0, \
+                "string join keys need a string_max_bytes bucket"
+            lchunks = _string_data_keys(lc, _ASC, string_max_bytes)
+            rchunks = _string_data_keys(rc, _ASC, string_max_bytes)
+            for lch, rch in zip(lchunks, rchunks):
+                per_col_keys.append(jnp.concatenate([lch, rch]))
+            valid = jnp.concatenate([lc.validity, rc.validity])
+            any_null = any_null | ~valid
+            continue
         cdt = lc.dtype if lc.dtype == rc.dtype else T.numeric_promote(lc.dtype, rc.dtype)
         ldat = lc.data.astype(cdt.jnp_dtype)
         rdat = rc.data.astype(cdt.jnp_dtype)
@@ -210,12 +225,35 @@ def apply_gather_maps(
     schema: Schema,
     join_type: str,
     out_capacity: int,
-) -> ColumnarBatch:
-    """Assemble the joined batch from gather maps (Table.gather analog)."""
-    from spark_rapids_tpu.kernels.selection import gather_column
-    cols = [gather_column(c, li, count, out_capacity=out_capacity)
-            for c in left.columns]
+    byte_capacities: Optional[dict] = None,
+) -> Tuple[ColumnarBatch, OverflowStatus]:
+    """Assemble the joined batch from gather maps (Table.gather analog).
+
+    Join maps repeat source rows, so string gathers can exceed any static
+    byte capacity; byte_capacities maps output column ordinal -> byte
+    capacity, and the returned status carries the true byte requirements
+    for the capacity-retry loop.
+    """
+    from spark_rapids_tpu.kernels.selection import (
+        gather_column, required_gather_bytes)
+    byte_capacities = byte_capacities or {}
+    cols = []
+    req_bytes = []
+    sides = [(left, li)]
     if join_type not in ("left_semi", "left_anti"):
-        cols += [gather_column(c, ri, count, out_capacity=out_capacity)
-                 for c in right.columns]
-    return ColumnarBatch(tuple(cols), count.astype(jnp.int32), schema)
+        sides.append((right, ri))
+    out_idx = 0
+    for side_batch, idx in sides:
+        for c in side_batch.columns:
+            if c.is_string_like:
+                bcap = byte_capacities.get(out_idx, c.byte_capacity)
+                cols.append(gather_column(c, idx, count,
+                                          out_capacity=out_capacity,
+                                          out_byte_capacity=bcap))
+                req_bytes.append(required_gather_bytes(c, idx, count))
+            else:
+                cols.append(gather_column(c, idx, count,
+                                          out_capacity=out_capacity))
+            out_idx += 1
+    return (ColumnarBatch(tuple(cols), count.astype(jnp.int32), schema),
+            OverflowStatus(count.astype(jnp.int64), req_bytes))

@@ -373,6 +373,19 @@ class CpuEngine:
                 buckets[p].append(t.take(np.nonzero(assign == p)[0]))
         return [CpuTable.concat(bs, plan.schema) for bs in buckets]
 
+    def _exec_mapbatches(self, plan: L.MapBatches):
+        from spark_rapids_tpu.columnar.arrow import arrow_to_batch
+        out = []
+        for t in self._exec(plan.child):
+            if t.num_rows == 0:
+                out.append(CpuTable.empty(plan.schema))
+                continue
+            from spark_rapids_tpu.plan.execs.fallback import cpu_table_to_batch
+            table = cpu_table_to_batch(t).to_arrow()
+            result = plan.fn(table)
+            out.append(CpuTable.from_batch(arrow_to_batch(result)))
+        return out
+
     def _exec_window(self, plan: L.Window):
         """Row-wise obvious window implementation: python loop per
         partition run — the oracle for the segmented-scan kernels."""

@@ -40,7 +40,7 @@ from spark_rapids_tpu.expressions.aggregates import (
 from spark_rapids_tpu.kernels import groupby as G
 from spark_rapids_tpu.kernels.selection import concat_batches_device
 from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_split
-from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+from spark_rapids_tpu.plan.execs.base import TpuExec, string_key_bucket, timed
 
 
 class _DeviceAggResult(Expression):
@@ -157,13 +157,34 @@ class TpuHashAggregateExec(TpuExec):
         self.partial_schema = Schema(partial_names, partial_dtypes)
         out_schema = self.partial_schema if mode == "partial" else schema
         super().__init__((child,), out_schema)
-        self._jit_partial = jax.jit(self._partial_step)
-        self._jit_merge = jax.jit(self._merge_step)
+        from functools import lru_cache, partial as _partial
+        self._jit_partial_by_bucket = lru_cache(maxsize=16)(
+            lambda bucket: jax.jit(_partial(self._partial_step,
+                                            string_bucket=bucket)))
+        self._jit_merge_by_bucket = lru_cache(maxsize=16)(
+            lambda bucket: jax.jit(_partial(self._merge_step,
+                                            string_bucket=bucket)))
+        self._jit_partial = lambda b: self._jit_partial_by_bucket(
+            string_key_bucket(b, self.group_exprs))(b)
+        self._jit_merge = lambda b: self._jit_merge_by_bucket(
+            self._merge_bucket(b))(b)
         self._jit_finalize = jax.jit(self._finalize)
 
     # -- device steps -------------------------------------------------------
 
-    def _partial_step(self, batch: ColumnarBatch) -> ColumnarBatch:
+    def _merge_bucket(self, partial: ColumnarBatch) -> int:
+        from spark_rapids_tpu.kernels import strings as SK
+        m = 0
+        has_string = False
+        for i in range(len(self.group_exprs)):
+            c = partial.columns[i]
+            if c.is_string_like:
+                has_string = True
+                m = max(m, int(SK.max_live_string_bytes(c, partial.num_rows)))
+        return SK.bucket_for(m) if has_string else 0
+
+    def _partial_step(self, batch: ColumnarBatch,
+                      string_bucket: int = 0) -> ColumnarBatch:
         """Raw rows -> one partial batch (keys + buffers), grouped in-batch."""
         ctx = EvalContext(batch)
         key_cols = tuple(e.eval(ctx) for e in self.group_exprs)
@@ -196,7 +217,8 @@ class TpuHashAggregateExec(TpuExec):
         work_names = tuple(f"c{i}" for i in range(len(work_cols)))
         work = ColumnarBatch(tuple(work_cols), batch.num_rows,
                              Schema(work_names, tuple(c.dtype for c in work_cols)))
-        layout = G.group_rows(work, list(range(nkeys)), string_max_bytes=0)
+        layout = G.group_rows(work, list(range(nkeys)),
+                              string_max_bytes=string_bucket)
         out_keys = G.group_keys_output(layout, list(range(nkeys)))
         cols = list(out_keys)
         for ai, slot in self.slot_specs:
@@ -209,7 +231,8 @@ class TpuHashAggregateExec(TpuExec):
                 slot.dtype))
         return ColumnarBatch(tuple(cols), layout.num_groups, self.partial_schema)
 
-    def _merge_step(self, partial: ColumnarBatch) -> ColumnarBatch:
+    def _merge_step(self, partial: ColumnarBatch,
+                    string_bucket: int = 0) -> ColumnarBatch:
         """Concatenated partial batches -> merged partial batch."""
         nkeys = len(self.group_exprs)
         if nkeys == 0:
@@ -223,7 +246,8 @@ class TpuHashAggregateExec(TpuExec):
                     jnp.reshape(data.astype(slot.dtype.jnp_dtype), (1,)),
                     jnp.reshape(valid, (1,)), slot.dtype))
             return ColumnarBatch(tuple(cols), jnp.int32(1), self.partial_schema)
-        layout = G.group_rows(partial, list(range(nkeys)), string_max_bytes=0)
+        layout = G.group_rows(partial, list(range(nkeys)),
+                              string_max_bytes=string_bucket)
         out_keys = G.group_keys_output(layout, list(range(nkeys)))
         cols = list(out_keys)
         for si, (ai, slot) in enumerate(self.slot_specs):

@@ -122,3 +122,20 @@ class timed:
     def __exit__(self, *exc):
         self.metric.add(time.perf_counter_ns() - self.t0)
         return False
+
+
+def string_key_bucket(batch, exprs) -> int:
+    """Shared max-bytes bucket over BoundReference string key expressions
+    (one tiny device sync per string key; 0 when no string keys).  The
+    planner restricts string keys to plain column refs so the bucket is
+    computable before the jitted kernel runs."""
+    from spark_rapids_tpu.expressions.core import BoundReference
+    from spark_rapids_tpu.kernels import strings as SK
+    m = 0
+    has_string = False
+    for e in exprs:
+        if isinstance(e, BoundReference) and e.dtype.variable_width:
+            has_string = True
+            m = max(m, int(SK.max_live_string_bytes(
+                batch.columns[e.ordinal], batch.num_rows)))
+    return SK.bucket_for(m) if has_string else 0

@@ -30,7 +30,7 @@ from spark_rapids_tpu.kernels.selection import (
 )
 from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_split
 from spark_rapids_tpu.memory.spill import SpillableBatchHandle, make_spillable
-from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+from spark_rapids_tpu.plan.execs.base import TpuExec, string_key_bucket, timed
 
 
 class TpuShuffleExchangeExec(TpuExec):
@@ -59,7 +59,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self._materialized: Optional[List[List[SpillableBatchHandle]]] = None
         self._wire: Optional[List[List[bytes]]] = None
 
-        def slice_step(batch: ColumnarBatch):
+        def slice_step(batch: ColumnarBatch, string_bucket: int = 0):
             """Device: append key columns, partition, return reordered batch
             + per-partition counts."""
             if not self.keys:
@@ -74,13 +74,17 @@ class TpuShuffleExchangeExec(TpuExec):
                        tuple(c.dtype for c in key_cols)))
             reordered, counts = hash_partition(
                 work, list(range(len(batch.schema), len(work.schema))),
-                self.out_partitions, string_max_bytes=0)
+                self.out_partitions, string_max_bytes=string_bucket)
             # drop the key columns again
             out = ColumnarBatch(reordered.columns[:len(batch.schema)],
                                 reordered.num_rows, batch.schema)
             return out, counts
 
-        self._jit_slice = jax.jit(slice_step)
+        from functools import lru_cache, partial as _p
+        self._slice_by_bucket = lru_cache(maxsize=16)(
+            lambda bucket: jax.jit(_p(slice_step, string_bucket=bucket)))
+        self._jit_slice = lambda b: self._slice_by_bucket(
+            string_key_bucket(b, self.keys))(b)
 
     def num_partitions(self) -> int:
         return self.out_partitions

@@ -29,7 +29,8 @@ from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
 
 
 class _JoinKernel:
-    """jit cache over (out_capacity static, shapes implicit)."""
+    """jit cache over (out_capacity, byte capacities, string bucket) —
+    all static; shapes implicit via jax.jit retracing."""
 
     def __init__(self, left_key_idx, right_key_idx, join_type: str,
                  schema: Schema):
@@ -39,17 +40,44 @@ class _JoinKernel:
         self.schema = schema
 
         @lru_cache(maxsize=64)
-        def jitted(out_capacity: int):
+        def jitted(out_capacity: int, byte_caps: tuple, bucket: int):
             def run(l: ColumnarBatch, r: ColumnarBatch):
                 li, ri, count, status = join_gather_maps(
                     l, self.left_key_idx, r, self.right_key_idx,
-                    self.join_type, out_capacity)
-                out = apply_gather_maps(l, r, li, ri, count, self.schema,
-                                        self.join_type, out_capacity)
-                return out, status
+                    self.join_type, out_capacity,
+                    string_max_bytes=bucket)
+                out, gstatus = apply_gather_maps(
+                    l, r, li, ri, count, self.schema, self.join_type,
+                    out_capacity, dict(byte_caps))
+                return out, status, gstatus
             return jax.jit(run)
 
         self._jitted = jitted
+
+    def _string_out_cols(self, l: ColumnarBatch, r: ColumnarBatch):
+        """output ordinal -> source byte capacity for string columns."""
+        out = {}
+        idx = 0
+        sides = [l] if self.join_type in ("left_semi", "left_anti") else [l, r]
+        for side in sides:
+            for c in side.columns:
+                if c.is_string_like:
+                    out[idx] = c.byte_capacity
+                idx += 1
+        return out
+
+    def _key_bucket(self, l: ColumnarBatch, r: ColumnarBatch) -> int:
+        from spark_rapids_tpu.kernels import strings as SK
+        m = 0
+        has_string = False
+        for lk, rk in zip(self.left_key_idx, self.right_key_idx):
+            if l.columns[lk].is_string_like:
+                has_string = True
+                m = max(m, int(SK.max_live_string_bytes(l.columns[lk],
+                                                        l.num_rows)))
+                m = max(m, int(SK.max_live_string_bytes(r.columns[rk],
+                                                        r.num_rows)))
+        return SK.bucket_for(m) if has_string else 0
 
     def __call__(self, l: ColumnarBatch, r: ColumnarBatch) -> ColumnarBatch:
         nl, nr = l.capacity, r.capacity   # static bound: no device sync
@@ -59,16 +87,28 @@ class _JoinKernel:
             guess = max(nl, 1)
         else:
             guess = max(nl + nr, 1)
-
-        def run(cap):
-            return with_retry_no_split(lambda: self._jitted(cap)(l, r))
-
-        def check(res):
-            need = int(res[1].required_rows)
-            return None if need <= res[0].capacity else need
-
-        out, _ = with_capacity_retry(run, check, round_up_pow2(guess))
-        return out
+        bucket = self._key_bucket(l, r)
+        cap = round_up_pow2(guess)
+        byte_caps = dict(self._string_out_cols(l, r))
+        from spark_rapids_tpu.columnar.column import round_up_pow2 as rup
+        from spark_rapids_tpu.memory.arena import TpuSplitAndRetryOOM
+        for _ in range(24):
+            out, status, gstatus = with_retry_no_split(
+                lambda: self._jitted(cap, tuple(sorted(byte_caps.items())),
+                                     bucket)(l, r))
+            need_rows = int(status.required_rows)
+            ok = need_rows <= cap
+            if ok and gstatus.required_bytes:
+                string_ords = sorted(byte_caps)
+                for ordv, req in zip(string_ords, gstatus.required_bytes):
+                    if int(req) > byte_caps[ordv]:
+                        byte_caps[ordv] = rup(int(req))
+                        ok = False
+            if ok:
+                return out
+            if need_rows > cap:
+                cap = rup(need_rows)
+        raise TpuSplitAndRetryOOM("join output would not fit after retries")
 
 
 class TpuShuffledHashJoinExec(TpuExec):

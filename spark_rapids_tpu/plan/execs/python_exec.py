@@ -1,0 +1,41 @@
+"""Arrow-bridged Python transforms on device batches.
+
+Reference: org/apache/spark/sql/rapids/execution/python/ — GpuArrowEval
+PythonExec (BatchProducer at :223), map/flatMap-in-pandas variants, and
+PythonWorkerSemaphore (the device semaphore is released while Python runs
+so other tasks can use the chip).
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from spark_rapids_tpu.columnar.arrow import arrow_to_batch
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.memory.semaphore import tpu_semaphore
+from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+
+
+class TpuMapBatchesExec(TpuExec):
+    def __init__(self, fn, child: TpuExec, schema: Schema):
+        super().__init__((child,), schema)
+        self.fn = fn
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        for batch in self.children[0].execute_partition(idx):
+            with timed(self.op_time):
+                table = batch.to_arrow()     # device -> host Arrow
+                sem = tpu_semaphore()
+                # release the device while Python crunches host data
+                # (PythonWorkerSemaphore.scala analog)
+                sem.release_if_necessary()
+                try:
+                    result = self.fn(table)
+                finally:
+                    sem.acquire_if_necessary()
+                out = arrow_to_batch(result)  # host Arrow -> device
+            self.output_rows.add(out.num_rows)
+            yield self._count_out(out)
+
+    def describe(self):
+        name = getattr(self.fn, "__name__", "fn")
+        return f"TpuMapBatches[{name}]"

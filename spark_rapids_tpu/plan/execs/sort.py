@@ -16,7 +16,7 @@ from spark_rapids_tpu.expressions.core import EvalContext, Expression
 from spark_rapids_tpu.kernels.selection import concat_batches_device, gather_batch
 from spark_rapids_tpu.kernels.sort import SortOrder, sort_indices
 from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_split
-from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+from spark_rapids_tpu.plan.execs.base import TpuExec, string_key_bucket, timed
 
 
 class TpuSortExec(TpuExec):
@@ -27,24 +27,31 @@ class TpuSortExec(TpuExec):
                  child: TpuExec):
         super().__init__((child,), child.schema)
         self.orders = tuple(orders)
+        from functools import lru_cache
 
-        def run(batch: ColumnarBatch) -> ColumnarBatch:
-            ctx = EvalContext(batch)
-            key_cols = tuple(e.eval(ctx) for e, _ in self.orders)
-            work = ColumnarBatch(
-                tuple(batch.columns) + key_cols, batch.num_rows,
-                Schema(tuple(batch.schema.names) +
-                       tuple(f"_sk{i}" for i in range(len(key_cols))),
-                       tuple(batch.schema.dtypes) +
-                       tuple(c.dtype for c in key_cols)))
-            nbase = len(batch.schema)
-            idx = sort_indices(work, list(range(nbase, nbase + len(key_cols))),
-                               [o for _, o in self.orders], string_max_bytes=0)
-            sorted_work = gather_batch(work, idx, batch.num_rows)
-            return ColumnarBatch(sorted_work.columns[:nbase],
-                                 batch.num_rows, batch.schema)
+        @lru_cache(maxsize=16)
+        def jitted(bucket: int):
+            def run(batch: ColumnarBatch) -> ColumnarBatch:
+                ctx = EvalContext(batch)
+                key_cols = tuple(e.eval(ctx) for e, _ in self.orders)
+                work = ColumnarBatch(
+                    tuple(batch.columns) + key_cols, batch.num_rows,
+                    Schema(tuple(batch.schema.names) +
+                           tuple(f"_sk{i}" for i in range(len(key_cols))),
+                           tuple(batch.schema.dtypes) +
+                           tuple(c.dtype for c in key_cols)))
+                nbase = len(batch.schema)
+                idx = sort_indices(
+                    work, list(range(nbase, nbase + len(key_cols))),
+                    [o for _, o in self.orders], string_max_bytes=bucket)
+                sorted_work = gather_batch(work, idx, batch.num_rows)
+                return ColumnarBatch(sorted_work.columns[:nbase],
+                                     batch.num_rows, batch.schema)
+            return jax.jit(run)
 
-        self._run = jax.jit(run)
+        self._jitted = jitted
+        self._run = lambda b: jitted(
+            string_key_bucket(b, [e for e, _ in self.orders]))(b)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         batches = list(self.children[0].execute_partition(idx))

@@ -27,7 +27,7 @@ from spark_rapids_tpu.kernels.groupby import (
 from spark_rapids_tpu.kernels.selection import gather_batch
 from spark_rapids_tpu.kernels.sort import SortOrder, sort_indices
 from spark_rapids_tpu.memory.retry import with_retry_no_split
-from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+from spark_rapids_tpu.plan.execs.base import TpuExec, string_key_bucket, timed
 from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
 
 
@@ -41,9 +41,15 @@ class TpuWindowExec(TpuExec):
         super().__init__((child,), schema)
         self.window_exprs = tuple(window_exprs)
         self.spec = _unwrap(self.window_exprs[0]).spec
-        self._run = jax.jit(self._step)
+        from functools import lru_cache, partial as _p
+        self._run_by_bucket = lru_cache(maxsize=16)(
+            lambda bucket: jax.jit(_p(self._step, string_bucket=bucket)))
+        self._run = lambda b: self._run_by_bucket(string_key_bucket(
+            b, list(self.spec.partition_by)
+            + [e for e, _ in self.spec.order_by]))(b)
 
-    def _step(self, batch: ColumnarBatch) -> ColumnarBatch:
+    def _step(self, batch: ColumnarBatch,
+              string_bucket: int = 0) -> ColumnarBatch:
         ctx = EvalContext(batch)
         spec = self.spec
         pcols = [normalize_key_column(e.eval(ctx)) for e in spec.partition_by]
@@ -59,18 +65,25 @@ class TpuWindowExec(TpuExec):
         key_idx = list(range(nbase, nbase + len(pcols) + len(ocols)))
         orders = ([SortOrder(True, True)] * len(pcols)
                   + [o for _, o in spec.order_by])
-        idx = sort_indices(work, key_idx, orders, string_max_bytes=0)
+        idx = sort_indices(work, key_idx, orders,
+                           string_max_bytes=string_bucket)
         sw = gather_batch(work, idx, work.num_rows)
         live = sw.live_mask()
         first = jnp.arange(sw.capacity, dtype=jnp.int32) == 0
 
+        from spark_rapids_tpu.kernels.groupby import _string_rows_equal_prev
+
+        def eq_prev(col):
+            if col.is_string_like:
+                return _string_rows_equal_prev(col, string_bucket)
+            return _rows_equal_prev(col)
+
         part_eq = jnp.ones((sw.capacity,), jnp.bool_)
         for i in range(len(pcols)):
-            part_eq = part_eq & _rows_equal_prev(sw.columns[nbase + i])
+            part_eq = part_eq & eq_prev(sw.columns[nbase + i])
         peer_eq = part_eq
         for i in range(len(ocols)):
-            peer_eq = peer_eq & _rows_equal_prev(
-                sw.columns[nbase + len(pcols) + i])
+            peer_eq = peer_eq & eq_prev(sw.columns[nbase + len(pcols) + i])
         part_boundary = live & (first | ~part_eq)
         peer_boundary = live & (first | ~peer_eq)
         layout = WK.window_layout(part_boundary, peer_boundary, live)

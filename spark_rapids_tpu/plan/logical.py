@@ -271,6 +271,30 @@ class Union(LogicalPlan):
         return f"Union[{len(self.children)}]"
 
 
+class MapBatches(LogicalPlan):
+    """Arrow-batch Python transform: fn(pyarrow.Table) -> pyarrow.Table.
+
+    The pandas/Arrow UDF exec analog (reference:
+    org/apache/spark/sql/rapids/execution/python/GpuArrowEvalPythonExec
+    .scala:223 and the map-in-pandas variants): device batches cross to the
+    Python world through Arrow, the declared schema is the contract back.
+    """
+
+    def __init__(self, fn, schema: Schema, child: LogicalPlan):
+        self.fn = fn
+        self._schema = schema
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        name = getattr(self.fn, "__name__", "fn")
+        return f"MapBatches[{name}]"
+
+
 class Window(LogicalPlan):
     """Append window-function columns.  All window_exprs must share one
     WindowSpec partitioning (Spark splits differing specs into separate

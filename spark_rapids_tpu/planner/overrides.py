@@ -86,10 +86,23 @@ def _dtype_ok(dt: T.DataType) -> bool:
 
 
 def _key_dtype_ok(dt: T.DataType) -> bool:
-    """Sort/group/partition/join keys: fixed-width only for now — the
-    string-key paths need the max-bytes bucket threaded through the execs
-    (kernels support it; the exec wiring is the follow-on)."""
     return _dtype_ok(dt) and not dt.variable_width
+
+
+def _key_expr_ok(e: "E.Expression") -> bool:
+    """Sort/group/partition/join key gate: any fixed-width expression, or a
+    *plain column reference* for strings (the execs compute the max-bytes
+    bucket from the referenced column before the jitted kernel runs; a
+    computed string key has no pre-computable bucket yet)."""
+    try:
+        dt = e.dtype
+    except (TypeError, ValueError, NotImplementedError):
+        return False
+    if not _dtype_ok(dt):
+        return False
+    if dt.variable_width:
+        return isinstance(e, E.BoundReference)
+    return True
 
 
 class ExprMeta:
@@ -183,9 +196,9 @@ class PlanMeta:
             em.tag()
         if isinstance(p, L.Join):
             for e in list(p.left_keys) + list(p.right_keys):
-                if not _key_dtype_ok(e.dtype):
+                if not _key_expr_ok(e):
                     self.will_not_work(
-                        f"join key type {e.dtype!r} not supported yet")
+                        f"join key {e!r} not supported yet")
                 if not isinstance(e, E.BoundReference):
                     self.will_not_work(
                         f"computed join key {e!r} not supported yet "
@@ -206,23 +219,23 @@ class PlanMeta:
                     "residual join conditions only supported for inner joins")
         if isinstance(p, L.Aggregate):
             for e in p.group_exprs:
-                if not _key_dtype_ok(e.dtype):
+                if not _key_expr_ok(e):
                     self.will_not_work(
-                        f"grouping key type {e.dtype!r} not supported yet")
+                        f"grouping key {e!r} not supported yet")
             for e in p.agg_exprs:
                 for sub in _non_agg_leaf_refs(e):
                     self.will_not_work(
                         f"non-aggregate column {sub!r} in aggregate output")
         if isinstance(p, L.Sort):
             for e, _ in p.orders:
-                if not _key_dtype_ok(e.dtype):
+                if not _key_expr_ok(e):
                     self.will_not_work(
-                        f"sort key type {e.dtype!r} not supported yet")
+                        f"sort key {e!r} not supported yet")
         if isinstance(p, L.Repartition):
             for e in p.keys:
-                if not _key_dtype_ok(e.dtype):
+                if not _key_expr_ok(e):
                     self.will_not_work(
-                        f"partition key type {e.dtype!r} not supported yet")
+                        f"partition key {e!r} not supported yet")
         if isinstance(p, L.Window):
             self._tag_window(p)
         for c in self.children:
@@ -293,6 +306,11 @@ class PlanMeta:
             return self._convert_join(p)
         if isinstance(p, L.Window):
             return self._convert_window(p)
+        if isinstance(p, L.MapBatches):
+            from spark_rapids_tpu.plan.execs.python_exec import (
+                TpuMapBatchesExec)
+            return TpuMapBatchesExec(p.fn, self.children[0].convert(),
+                                     p.schema)
         return self._fallback()
 
     def _tag_window(self, p: "L.Window") -> None:
@@ -302,13 +320,13 @@ class PlanMeta:
             Average, Count, Max, Min, Sum)
         spec = p.spec
         for e in spec.partition_by:
-            if not _key_dtype_ok(e.dtype):
+            if not _key_expr_ok(e):
                 self.will_not_work(
-                    f"window partition key type {e.dtype!r} not supported yet")
+                    f"window partition key {e!r} not supported yet")
         for e, _ in spec.order_by:
-            if not _key_dtype_ok(e.dtype):
+            if not _key_expr_ok(e):
                 self.will_not_work(
-                    f"window order key type {e.dtype!r} not supported yet")
+                    f"window order key {e!r} not supported yet")
         for e in p.window_exprs:
             inner = e.child if isinstance(e, E.Alias) else e
             if not isinstance(inner, WindowExpression):

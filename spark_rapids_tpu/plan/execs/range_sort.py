@@ -1,0 +1,193 @@
+"""Range-partitioned global sort.
+
+Reference: GpuRangePartitioner.scala + GpuSortExec — sample the sort keys,
+pick range boundaries, exchange rows so partition i holds keys < partition
+i+1's, sort each partition locally; the concatenation of partitions in
+order IS the global order, and no single device ever holds the whole
+dataset (the scalable path the single-partition sort lacks).
+
+Key encoding: every fixed-width sort key maps to a uint64 whose unsigned
+order equals Spark's column order including direction (kernels/sort.py
+`_data_key_fixed`), with a separate null rank honoring NULLS FIRST/LAST;
+string keys contribute packed byte-chunk keys.  Row destinations come from
+lexicographic comparison against the (static, small) boundary list — B-1
+vectorized compares, no searchsorted-over-tuples needed.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import round_up_pow2
+from spark_rapids_tpu.expressions.core import EvalContext, Expression
+from spark_rapids_tpu.kernels.selection import gather_batch
+from spark_rapids_tpu.kernels.sort import SortOrder, _data_key_fixed, _null_key, _string_data_keys
+from spark_rapids_tpu.kernels.groupby import normalize_key_column
+from spark_rapids_tpu.memory.retry import with_retry_no_split
+from spark_rapids_tpu.memory.spill import SpillableBatchHandle, make_spillable
+from spark_rapids_tpu.plan.execs.base import TpuExec, string_key_bucket, timed
+from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
+from spark_rapids_tpu.plan.execs.sort import TpuSortExec
+
+SAMPLE_PER_PARTITION = 64
+
+
+class TpuRangeSortExec(TpuExec):
+    """Global sort over N output partitions (range exchange + local sort)."""
+
+    def __init__(self, orders: Sequence[Tuple[Expression, SortOrder]],
+                 child: TpuExec, num_partitions: int):
+        super().__init__((child,), child.schema)
+        self.orders = tuple(orders)
+        self.out_partitions = max(num_partitions, 1)
+        self._lock = threading.Lock()
+        self._buckets: Optional[List[List[SpillableBatchHandle]]] = None
+        self._local_sort = TpuSortExec(self.orders, child)  # reuse its jit
+
+        def encode(batch: ColumnarBatch, bucket: int):
+            """Per-row encoded key arrays (most-significant first)."""
+            ctx = EvalContext(batch)
+            keys = []
+            for e, o in self.orders:
+                c = normalize_key_column(e.eval(ctx))
+                keys.append(_null_key(c, o).astype(jnp.uint64))
+                if c.is_string_like:
+                    keys.extend(_string_data_keys(c, o, bucket))
+                else:
+                    keys.append(_data_key_fixed(c, o))
+            return tuple(keys)
+
+        from functools import lru_cache, partial as _p
+        self._encode_by_bucket = lru_cache(maxsize=16)(
+            lambda b: jax.jit(_p(encode, bucket=b)))
+
+        def route(batch: ColumnarBatch, boundaries: tuple, bucket: int):
+            """dest partition per row + reorder by dest (stable)."""
+            keys = encode(batch, bucket)
+            cap = batch.capacity
+            dest = jnp.zeros((cap,), jnp.int32)
+            for b in boundaries:   # static small list of key tuples
+                gt = jnp.zeros((cap,), jnp.bool_)
+                eq = jnp.ones((cap,), jnp.bool_)
+                for k, bv in zip(keys, b):
+                    kv = jnp.uint64(bv)
+                    gt = gt | (eq & (k > kv))
+                    eq = eq & (k == kv)
+                dest = dest + (gt | eq).astype(jnp.int32)
+            live = batch.live_mask()
+            dest = jnp.where(live, dest, jnp.int32(self.out_partitions))
+            order = jnp.lexsort((dest,)).astype(jnp.int32)
+            out = gather_batch(batch, order, batch.num_rows)
+            counts = jax.ops.segment_sum(
+                live.astype(jnp.int32), dest,
+                num_segments=self.out_partitions + 1)[:self.out_partitions]
+            return out, counts
+
+        self._route_cache = {}
+
+        def routed(bucket: int, boundaries: tuple):
+            key = (bucket, boundaries)
+            if key not in self._route_cache:
+                self._route_cache[key] = jax.jit(
+                    _p(route, boundaries=boundaries, bucket=bucket))
+            return self._route_cache[key]
+
+        self._routed = routed
+
+    def num_partitions(self) -> int:
+        return self.out_partitions
+
+    # -- boundary sampling ---------------------------------------------------
+
+    def _sample_and_bucket(self, batches: List[ColumnarBatch]):
+        bucket = 0
+        for b in batches:
+            bucket = max(bucket, string_key_bucket(
+                b, [e for e, _ in self.orders]))
+        samples: List[np.ndarray] = []
+        n_keys = None
+        for b in batches:
+            keys = self._encode_by_bucket(bucket)(b)
+            n_keys = len(keys)
+            cap = keys[0].shape[0]
+            stride = max(cap // SAMPLE_PER_PARTITION, 1)
+            idx = np.arange(0, cap, stride)
+            live = np.asarray(b.live_mask())[idx]
+            rows = np.stack([np.asarray(k)[idx] for k in keys], axis=1)
+            samples.append(rows[live])
+        if n_keys is None:
+            return bucket, ()
+        all_rows = (np.concatenate(samples) if samples
+                    else np.zeros((0, n_keys), np.uint64))
+        if len(all_rows) == 0 or self.out_partitions == 1:
+            return bucket, ()
+        order = np.lexsort(tuple(all_rows[:, i]
+                                 for i in range(n_keys - 1, -1, -1)))
+        sorted_rows = all_rows[order]
+        boundaries = []
+        for p in range(1, self.out_partitions):
+            pos = min(len(sorted_rows) - 1,
+                      (p * len(sorted_rows)) // self.out_partitions)
+            boundaries.append(tuple(int(x) for x in sorted_rows[pos]))
+        # dedupe (equal boundaries collapse partitions, still correct)
+        return bucket, tuple(dict.fromkeys(boundaries))
+
+    def _materialize(self) -> List[List[SpillableBatchHandle]]:
+        with self._lock:
+            if self._buckets is not None:
+                return self._buckets
+            child = self.children[0]
+            batches: List[ColumnarBatch] = []
+            for p in range(child.num_partitions()):
+                batches.extend(child.execute_partition(p))
+            buckets: List[List[SpillableBatchHandle]] = [
+                [] for _ in range(self.out_partitions)]
+            if batches:
+                bucket, boundaries = self._sample_and_bucket(batches)
+                route = self._routed(bucket, boundaries)
+                for b in batches:
+                    reordered, counts = with_retry_no_split(lambda: route(b))
+                    host_counts = np.asarray(counts)
+                    offsets = np.zeros(self.out_partitions + 1, np.int64)
+                    np.cumsum(host_counts, out=offsets[1:])
+                    for p in range(self.out_partitions):
+                        cnt = int(host_counts[p])
+                        if cnt == 0:
+                            continue
+                        cap = round_up_pow2(cnt)
+                        idx = jnp.arange(cap, dtype=jnp.int32) + \
+                            jnp.int32(offsets[p])
+                        piece = gather_batch(reordered, idx, jnp.int32(cnt),
+                                             out_capacity=cap)
+                        buckets[p].append(make_spillable(piece))
+            self._buckets = buckets
+            return buckets
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        handles = self._materialize()[idx]
+        if not handles:
+            return
+        with timed(self.op_time):
+            merged = coalesce_to_one([h.materialize() for h in handles])
+            out = with_retry_no_split(lambda: self._local_sort._run(merged))
+        self.output_rows.add(out.num_rows)
+        yield self._count_out(out)
+
+    def cleanup(self) -> None:
+        with self._lock:
+            if self._buckets is not None:
+                for bucket in self._buckets:
+                    for h in bucket:
+                        h.close()
+                self._buckets = None
+        super().cleanup()
+
+    def describe(self):
+        inner = ", ".join(f"{e!r} {'ASC' if o.ascending else 'DESC'}"
+                          for e, o in self.orders)
+        return f"TpuRangeSort[{self.out_partitions}, {inner}]"

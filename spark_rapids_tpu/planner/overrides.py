@@ -298,7 +298,12 @@ class PlanMeta:
         if isinstance(p, L.Sort):
             child = self.children[0].convert()
             if p.global_sort and child.num_partitions() > 1:
-                child = TpuSinglePartitionExec(child)
+                from spark_rapids_tpu.plan.execs.range_sort import (
+                    TpuRangeSortExec)
+                return TpuRangeSortExec(
+                    p.orders, child,
+                    min(self.conf.shuffle_partitions,
+                        child.num_partitions()))
             return TpuSortExec(p.orders, child)
         if isinstance(p, L.Aggregate):
             return self._convert_aggregate(p)

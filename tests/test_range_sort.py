@@ -1,0 +1,51 @@
+"""Range-partitioned global sort tests (GpuRangePartitioner analog)."""
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.kernels.sort import SortOrder
+from tests.test_queries import assert_tpu_cpu_equal, source
+from tests.test_strings import strings_df
+
+
+def test_global_sort_is_range_partitioned():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    plan = source(s).order_by(("v", SortOrder(True))).physical_plan()
+    assert "TpuRangeSort" in plan.tree_string()
+
+
+def test_range_sort_correct_asc_desc():
+    assert_tpu_cpu_equal(
+        lambda s: source(s).order_by(("v", SortOrder(False)),
+                                     ("k", SortOrder(True))),
+        ignore_order=False)
+
+
+def test_range_sort_nulls_last():
+    assert_tpu_cpu_equal(
+        lambda s: source(s).order_by(
+            ("x", SortOrder(True, nulls_first=False)),
+            ("v", SortOrder(True))),
+        ignore_order=False)
+
+
+def test_range_sort_string_keys():
+    assert_tpu_cpu_equal(
+        lambda s: strings_df(s, parts=3).order_by(
+            ("s", SortOrder(True)), ("n", SortOrder(True)),
+            ("t", SortOrder(True))),
+        ignore_order=False)
+
+
+def test_range_sort_skewed_distribution():
+    def build(s):
+        rng = np.random.RandomState(1)
+        n = 900
+        vals = np.where(rng.rand(n) < 0.8, 7, rng.randint(0, 1000, n))
+        batches = [ColumnarBatch.from_pydict(
+            {"v": vals[o:o + 300].tolist()}, Schema.of(v=T.LONG))
+            for o in range(0, n, 300)]
+        return s.create_dataframe(batches, num_partitions=3).order_by(
+            ("v", SortOrder(True)))
+    assert_tpu_cpu_equal(build, ignore_order=False)

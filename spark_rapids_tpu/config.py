@@ -179,6 +179,13 @@ SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").doc(
     "runs on host in the native library)."
 ).string_conf("none")
 
+BROADCAST_ROW_THRESHOLD = conf("spark.rapids.sql.join.broadcastRowThreshold").doc(
+    "Estimated build-side row count below which a join plans as a broadcast "
+    "hash join instead of a shuffled hash join (the role of Spark's "
+    "autoBroadcastJoinThreshold for the reference's "
+    "GpuBroadcastHashJoinExec)."
+).int_conf(500_000)
+
 TEST_INJECT_RETRY_OOM = conf("spark.rapids.sql.test.injectRetryOOM").doc(
     "Fault injection: make the allocator throw synthetic retry OOMs "
     "(reference: RapidsConf.scala:3041-3083, used by the @inject_oom pytest "
@@ -269,6 +276,10 @@ class RapidsConf:
     @property
     def shuffle_mode(self) -> str:
         return (self.get(SHUFFLE_MODE) or "MULTITHREADED").upper()
+
+    @property
+    def broadcast_row_threshold(self) -> int:
+        return self.get(BROADCAST_ROW_THRESHOLD)
 
     @property
     def shuffle_writer_threads(self) -> int:

@@ -375,10 +375,24 @@ class PlanMeta:
 
     def _convert_join(self, p: L.Join) -> TpuExec:
         from spark_rapids_tpu.plan.execs.basic import TpuFilterExec
-        from spark_rapids_tpu.plan.execs.join import TpuShuffledHashJoinExec
+        from spark_rapids_tpu.plan.execs.join import (
+            TpuBroadcastHashJoinExec, TpuShuffledHashJoinExec)
         left = self.children[0].convert()
         right = self.children[1].convert()
         nparts = self.conf.shuffle_partitions
+        # broadcast choice: small build (right) side + a join type whose
+        # null-extension never targets the broadcast side (the reference's
+        # build-side constraint, GpuBroadcastHashJoinExecBase)
+        broadcastable = p.join_type in ("inner", "left", "left_semi",
+                                        "left_anti", "cross")
+        if (broadcastable
+                and _estimate_rows(p.right) <= self.conf.broadcast_row_threshold
+                and left.num_partitions() > 1):
+            join: TpuExec = TpuBroadcastHashJoinExec(
+                left, right, p.left_keys, p.right_keys, p.join_type, p.schema)
+            if p.condition is not None:
+                join = TpuFilterExec(p.condition, join)
+            return join
         if p.join_type == "cross":
             from spark_rapids_tpu.plan.execs.exchange import (
                 TpuSinglePartitionExec)
@@ -390,7 +404,7 @@ class PlanMeta:
             if left.num_partitions() > 1 or right.num_partitions() > 1:
                 left = self._exchange(nparts, p.left_keys, left)
                 right = self._exchange(nparts, p.right_keys, right)
-        join: TpuExec = TpuShuffledHashJoinExec(
+        join = TpuShuffledHashJoinExec(
             left, right, p.left_keys, p.right_keys, p.join_type, p.schema)
         if p.condition is not None:
             join = TpuFilterExec(p.condition, join)
@@ -430,6 +444,34 @@ class PlanMeta:
     def _fallback(self) -> TpuExec:
         from spark_rapids_tpu.plan.execs.fallback import TpuCpuFallbackExec
         return TpuCpuFallbackExec(self.plan, self.conf)
+
+
+def _estimate_rows(plan: L.LogicalPlan) -> int:
+    """Crude cardinality estimate for broadcast decisions (the role of the
+    reference's build-side stats, GpuHashJoin.scala:1111)."""
+    p = plan
+    if isinstance(p, L.InMemoryRelation):
+        return sum(b.host_num_rows() for part in p.partitions for b in part)
+    if isinstance(p, L.ParquetRelation):
+        try:
+            import pyarrow.parquet as pq
+            return sum(pq.ParquetFile(path).metadata.num_rows
+                       for path in p.paths)
+        except Exception:
+            return 1 << 62
+    if isinstance(p, L.Filter):
+        return max(_estimate_rows(p.child) // 2, 1)
+    if isinstance(p, L.Aggregate):
+        return max(_estimate_rows(p.child) // 3, 1)
+    if isinstance(p, L.Limit):
+        return min(p.n, _estimate_rows(p.child))
+    if isinstance(p, L.Join):
+        return max(_estimate_rows(p.left), _estimate_rows(p.right))
+    if isinstance(p, L.Union):
+        return sum(_estimate_rows(c) for c in p.children)
+    if p.children:
+        return _estimate_rows(p.children[0])
+    return 1 << 62
 
 
 def _non_agg_leaf_refs(e: E.Expression) -> List[E.Expression]:

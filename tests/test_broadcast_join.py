@@ -1,0 +1,34 @@
+"""Broadcast hash join planning + correctness."""
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.expressions import col, count, sum_
+from tests.test_joins import left_df, right_df
+from tests.test_queries import assert_tpu_cpu_equal
+
+
+def test_small_build_side_plans_broadcast():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    plan = left_df(s).join(right_df(s), "k").physical_plan()
+    t = plan.tree_string()
+    assert "TpuBroadcastHashJoin" in t, t
+    assert "TpuShuffleExchange" not in t, t
+
+
+def test_large_build_side_plans_shuffled():
+    s = TpuSession({"spark.rapids.sql.enabled": "true",
+                    "spark.rapids.sql.join.broadcastRowThreshold": "10"})
+    plan = left_df(s).join(right_df(s), "k").physical_plan()
+    assert "TpuShuffledHashJoin" in plan.tree_string()
+
+
+def test_broadcast_join_differential_all_types():
+    for how in ("inner", "left", "left_semi", "left_anti"):
+        assert_tpu_cpu_equal(
+            lambda s: left_df(s).join(right_df(s), "k", how=how))
+
+
+def test_right_outer_never_broadcasts_right_build():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    plan = left_df(s).join(right_df(s), "k", how="right").physical_plan()
+    assert "TpuShuffledHashJoin" in plan.tree_string()
+    assert_tpu_cpu_equal(
+        lambda sess: left_df(sess).join(right_df(sess), "k", how="right"))

@@ -80,6 +80,11 @@ class Sum(AggregateFunction):
     @property
     def dtype(self):
         cdt = self.input.dtype
+        if isinstance(cdt, T.DecimalType):
+            # Spark: sum(decimal(p,s)) -> decimal(p+10, s); beyond
+            # Decimal64 range the planner falls the aggregate back
+            return T.DecimalType(min(cdt.precision + 10,
+                                     T.DecimalType.MAX_PRECISION), cdt.scale)
         if cdt.is_integral or isinstance(cdt, T.BooleanType):
             return T.LONG
         return T.DOUBLE

@@ -36,11 +36,58 @@ def _promote(a: T.DataType, b: T.DataType) -> T.DataType:
     return T.numeric_promote(a, b)
 
 
+def decimal_add_result(a: T.DecimalType, b: T.DecimalType) -> T.DecimalType:
+    """Spark DecimalPrecision: add/sub result type."""
+    scale = max(a.scale, b.scale)
+    precision = max(a.precision - a.scale, b.precision - b.scale) + scale + 1
+    return T.DecimalType(min(precision, T.DecimalType.MAX_PRECISION), scale)
+
+
+def decimal_mul_result(a: T.DecimalType, b: T.DecimalType) -> T.DecimalType:
+    scale = a.scale + b.scale
+    precision = a.precision + b.precision + 1
+    return T.DecimalType(min(precision, T.DecimalType.MAX_PRECISION),
+                         min(scale, T.DecimalType.MAX_PRECISION))
+
+
+def _rescale_unscaled(x, from_scale: int, to_scale: int, xp):
+    """int64 unscaled value rescale (to_scale >= from_scale)."""
+    if to_scale == from_scale:
+        return x
+    return x * (10 ** (to_scale - from_scale))
+
+
+def _overflow_null(vals, validity, precision: int, xp):
+    """Spark non-ANSI decimal overflow -> null."""
+    bound = 10 ** precision
+    ok = (vals < bound) & (vals > -bound)
+    return validity & ok
+
+
 class BinaryArithmetic(BinaryExpression):
-    """Common machinery: promote inputs, propagate nulls elementwise."""
+    """Common machinery: promote inputs, propagate nulls elementwise.
+
+    Decimal path (Decimal64, precision <= 18 — SURVEY.md §2.1 decimal
+    kernels): operands rescale to the Spark result scale as int64 unscaled
+    values, overflow beyond the result precision yields NULL (non-ANSI).
+    The planner gates result precisions > 18 until the two-limb int128
+    kernels land."""
+
+    _decimal_capable = False
+
+    def _is_decimal(self) -> bool:
+        return (isinstance(self.left.dtype, T.DecimalType)
+                or isinstance(self.right.dtype, T.DecimalType))
 
     @property
     def dtype(self) -> T.DataType:
+        if self._is_decimal():
+            l, r = self.left.dtype, self.right.dtype
+            assert isinstance(l, T.DecimalType) and isinstance(r, T.DecimalType), \
+                "mixed decimal/non-decimal arithmetic needs casts"
+            if type(self).__name__ == "Multiply":
+                return decimal_mul_result(l, r)
+            return decimal_add_result(l, r)
         return _promote(self.left.dtype, self.right.dtype)
 
     def _op(self, lhs, rhs):
@@ -49,22 +96,47 @@ class BinaryArithmetic(BinaryExpression):
     def _np_op(self, lhs, rhs):
         return self._op(lhs, rhs)
 
+    def _decimal_operands(self, ldata, rdata, xp):
+        l, r = self.left.dtype, self.right.dtype
+        out_dt = self.dtype
+        if type(self).__name__ == "Multiply":
+            return ldata.astype(xp.int64), rdata.astype(xp.int64)
+        return (_rescale_unscaled(ldata.astype(xp.int64), l.scale,
+                                  out_dt.scale, xp),
+                _rescale_unscaled(rdata.astype(xp.int64), r.scale,
+                                  out_dt.scale, xp))
+
     def eval(self, ctx: EvalContext):
         lc = self.left.eval(ctx)
         rc = self.right.eval(ctx)
         out_dt = self.dtype
+        validity = null_propagating([lc.validity, rc.validity])
+        if self._is_decimal():
+            assert self._decimal_capable, \
+                f"{type(self).__name__} has no decimal path (planner gap)"
+            lhs, rhs = self._decimal_operands(lc.data, rc.data, jnp)
+            vals = self._op(lhs, rhs)
+            validity = _overflow_null(vals, validity,
+                                      min(out_dt.precision, 18), jnp)
+            return make_column(vals, validity, out_dt)
         lhs = lc.data.astype(out_dt.jnp_dtype)
         rhs = rc.data.astype(out_dt.jnp_dtype)
-        validity = null_propagating([lc.validity, rc.validity])
         return make_column(self._op(lhs, rhs), validity, out_dt)
 
     def eval_cpu(self, ctx: CpuEvalContext):
         lv, lval = self.left.eval_cpu(ctx)
         rv, rval = self.right.eval_cpu(ctx)
         out_dt = self.dtype
+        validity = cpu_null_propagating([lval, rval])
+        if self._is_decimal():
+            lhs, rhs = self._decimal_operands(lv, rv, np)
+            with np.errstate(all="ignore"):
+                vals = self._np_op(lhs, rhs)
+            validity = _overflow_null(vals, validity,
+                                      min(out_dt.precision, 18), np)
+            return cpu_zero_invalid(vals.astype(np.int64), validity), validity
         lhs = lv.astype(out_dt.np_dtype)
         rhs = rv.astype(out_dt.np_dtype)
-        validity = cpu_null_propagating([lval, rval])
         with np.errstate(all="ignore"):
             vals = self._np_op(lhs, rhs)
         return cpu_zero_invalid(vals.astype(out_dt.np_dtype), validity), validity
@@ -72,6 +144,7 @@ class BinaryArithmetic(BinaryExpression):
 
 class Add(BinaryArithmetic):
     symbol = "+"
+    _decimal_capable = True
 
     def _op(self, lhs, rhs):
         return lhs + rhs
@@ -79,6 +152,7 @@ class Add(BinaryArithmetic):
 
 class Subtract(BinaryArithmetic):
     symbol = "-"
+    _decimal_capable = True
 
     def _op(self, lhs, rhs):
         return lhs - rhs
@@ -86,6 +160,7 @@ class Subtract(BinaryArithmetic):
 
 class Multiply(BinaryArithmetic):
     symbol = "*"
+    _decimal_capable = True
 
     def _op(self, lhs, rhs):
         return lhs * rhs

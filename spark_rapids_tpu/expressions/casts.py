@@ -59,7 +59,14 @@ class Cast(UnaryExpression):
             return True
         fixed = lambda d: (d.is_numeric and not isinstance(d, T.DecimalType)) \
             or isinstance(d, T.BooleanType)
+        dec64 = lambda d: isinstance(d, T.DecimalType) and d.precision <= 18
         if fixed(src) and fixed(dst):
+            return True
+        if dec64(src) and dec64(dst):
+            return True
+        if dec64(src) and (dst.is_integral or dst.is_floating):
+            return True
+        if (src.is_integral or isinstance(src, T.BooleanType)) and dec64(dst):
             return True
         if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
             return True
@@ -86,6 +93,12 @@ class Cast(UnaryExpression):
             x = jnp.nan_to_num(data, nan=0.0, posinf=float(hi), neginf=float(lo))
             x = jnp.clip(jnp.trunc(x), float(lo), float(hi))
             out = x.astype(dst.jnp_dtype)
+        elif isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
+            out, validity = _decimal_cast(data.astype(jnp.int64)
+                                          if isinstance(src, T.DecimalType)
+                                          else data,
+                                          c.validity, src, dst, jnp)
+            return make_column(out, validity, dst)
         else:
             out = data.astype(dst.jnp_dtype)
         return make_column(out, c.validity, dst)
@@ -104,6 +117,11 @@ class Cast(UnaryExpression):
                 out = v.astype(np.int64) * MICROS_PER_DAY
             elif isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
                 out = np.floor_divide(v, MICROS_PER_DAY).astype(np.int32)
+            elif isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
+                out, validity = _decimal_cast(
+                    v.astype(np.int64) if isinstance(src, T.DecimalType)
+                    else v, valid, src, dst, np)
+                return cpu_zero_invalid(out, validity), validity
             elif src.is_floating and dst.is_integral:
                 lo, hi = _INT_RANGE[dst]
                 x = np.trunc(np.nan_to_num(v, nan=0.0))
@@ -117,3 +135,33 @@ class Cast(UnaryExpression):
             else:
                 out = v.astype(dst.np_dtype)
         return cpu_zero_invalid(out, valid), valid
+
+
+def _decimal_cast(data, validity, src: T.DataType, dst: T.DataType, xp):
+    """Decimal64 cast lattice: rescale with HALF_UP on scale loss and
+    overflow -> NULL (Spark non-ANSI), plus decimal<->int/float."""
+    from spark_rapids_tpu.expressions.arithmetic import _overflow_null
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
+        ds = dst.scale - src.scale
+        if ds >= 0:
+            out = data * (10 ** ds)
+        else:
+            f = 10 ** (-ds)
+            # HALF_UP away from zero: sign * ((|v| + f/2) // f)
+            absd = xp.abs(data)
+            out = xp.sign(data) * ((absd + f // 2) // f)
+        validity = _overflow_null(out, validity, min(dst.precision, 18), xp)
+        return out, validity
+    if isinstance(src, T.DecimalType):
+        f = 10 ** src.scale
+        if dst.is_floating or isinstance(dst, T.DoubleType):
+            return (data.astype(xp.float64) / f).astype(dst.jnp_dtype
+                    if xp is not np else dst.np_dtype), validity
+        # -> integral: truncate toward zero
+        q = xp.where(data >= 0, data // f, -((-data) // f))
+        return q.astype(dst.jnp_dtype if xp is not np else dst.np_dtype), \
+            validity
+    # integral/boolean -> decimal
+    out = data.astype(xp.int64) * (10 ** dst.scale)
+    validity = _overflow_null(out, validity, min(dst.precision, 18), xp)
+    return out, validity

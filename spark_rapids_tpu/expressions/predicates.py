@@ -40,6 +40,11 @@ def _cmp_dtype(l: T.DataType, r: T.DataType) -> T.DataType:
         return r
     if isinstance(r, T.NullType):
         return l
+    if isinstance(l, T.DecimalType) and isinstance(r, T.DecimalType):
+        scale = max(l.scale, r.scale)
+        intd = max(l.precision - l.scale, r.precision - r.scale)
+        return T.DecimalType(min(intd + scale, T.DecimalType.MAX_PRECISION),
+                             scale)
     return T.numeric_promote(l, r)
 
 
@@ -58,8 +63,16 @@ class BinaryComparison(BinaryExpression):
         lc = self.left.eval(ctx)
         rc = self.right.eval(ctx)
         cdt = _cmp_dtype(lc.dtype, rc.dtype)
+        validity = null_propagating([lc.validity, rc.validity])
+        if isinstance(cdt, T.DecimalType):
+            from spark_rapids_tpu.expressions.arithmetic import _rescale_unscaled
+            lhs = _rescale_unscaled(lc.data.astype(jnp.int64),
+                                    lc.dtype.scale, cdt.scale, jnp)
+            rhs = _rescale_unscaled(rc.data.astype(jnp.int64),
+                                    rc.dtype.scale, cdt.scale, jnp)
+            return lhs, rhs, validity, T.LONG
         return (lc.data.astype(cdt.jnp_dtype), rc.data.astype(cdt.jnp_dtype),
-                null_propagating([lc.validity, rc.validity]), cdt)
+                validity, cdt)
 
     def _prep_cpu(self, ctx: CpuEvalContext):
         lv, lval = self.left.eval_cpu(ctx)
@@ -67,8 +80,16 @@ class BinaryComparison(BinaryExpression):
         if lv.dtype == object or rv.dtype == object:
             return lv, rv, cpu_null_propagating([lval, rval]), T.STRING
         cdt = _cmp_dtype(self.left.dtype, self.right.dtype)
+        validity = cpu_null_propagating([lval, rval])
+        if isinstance(cdt, T.DecimalType):
+            from spark_rapids_tpu.expressions.arithmetic import _rescale_unscaled
+            lhs = _rescale_unscaled(lv.astype(np.int64),
+                                    self.left.dtype.scale, cdt.scale, np)
+            rhs = _rescale_unscaled(rv.astype(np.int64),
+                                    self.right.dtype.scale, cdt.scale, np)
+            return lhs, rhs, validity, T.LONG
         return (lv.astype(cdt.np_dtype), rv.astype(cdt.np_dtype),
-                cpu_null_propagating([lval, rval]), cdt)
+                validity, cdt)
 
     def eval(self, ctx: EvalContext):
         lc = self.left.eval(ctx)

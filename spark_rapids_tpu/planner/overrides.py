@@ -82,6 +82,10 @@ _COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
 
 
 def _dtype_ok(dt: T.DataType) -> bool:
+    if isinstance(dt, T.DecimalType):
+        # Decimal64 fast path (Spark's long-backed decimals); 128-bit
+        # two-limb kernels are the follow-on
+        return dt.precision <= T.DecimalType.MAX_LONG_DIGITS
     return isinstance(dt, _COMPUTE_OK)
 
 

@@ -146,13 +146,14 @@ void tk_merge_size(const uint8_t** bufs, uint32_t n_bufs,
   *total_rows = 0;
   uint32_t cols = n_bufs ? tk_col_count(bufs[0]) : 0;
   for (uint32_t c = 0; c < cols; c++) col_data_bytes[c] = 0;
+  TkView* views = new TkView[cols ? cols : 1];
   for (uint32_t b = 0; b < n_bufs; b++) {
     uint64_t rows = tk_row_count(bufs[b]);
     *total_rows += rows;
-    TkView views[256];
     parse(bufs[b], cols, rows, views);
     for (uint32_t c = 0; c < cols; c++) col_data_bytes[c] += views[c].data_bytes;
   }
+  delete[] views;
 }
 
 // Concat-merge wire buffers into host column arrays (the reference's
@@ -161,9 +162,9 @@ uint64_t tk_merge(const uint8_t** bufs, uint32_t n_bufs, TkOut* outs,
                   uint32_t num_cols) {
   uint64_t row_base = 0;
   uint64_t* data_base = new uint64_t[num_cols]();
+  TkView* views = new TkView[num_cols ? num_cols : 1];
   for (uint32_t b = 0; b < n_bufs; b++) {
     uint64_t rows = tk_row_count(bufs[b]);
-    TkView views[256];
     parse(bufs[b], num_cols, rows, views);
     for (uint32_t c = 0; c < num_cols; c++) {
       const TkView* v = &views[c];
@@ -190,6 +191,7 @@ uint64_t tk_merge(const uint8_t** bufs, uint32_t n_bufs, TkOut* outs,
         o->offsets[r + 1] = last;
     }
   }
+  delete[] views;
   delete[] data_base;
   return row_base;
 }

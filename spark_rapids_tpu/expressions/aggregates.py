@@ -26,7 +26,8 @@ from spark_rapids_tpu.expressions.core import Expression
 
 # update/merge op kinds the kernel layer implements
 SUM = "sum"
-SUM_SQ = "sum_sq"            # sum of squares (variance/stddev buffers)
+M2 = "m2"                    # sum of squared deviations from the group mean
+M2_MERGE = "m2_merge"        # Chan's parallel merge of partial M2 buffers
 COUNT_VALID = "count_valid"  # counts non-null inputs
 COUNT_STAR = "count_star"    # counts rows
 MIN = "min"
@@ -278,11 +279,13 @@ def avg(e) -> Average:
 
 
 class VarianceBase(AggregateFunction):
-    """Shared (sum, sum_sq, n) buffer plan.
+    """Shared (sum, M2, n) buffer plan.
 
     Reference: aggregateFunctions.scala GpuStddevSamp/GpuVariancePop etc.
-    Finalize uses the textbook M2 identity; the differential harness
-    compares floats approximately, as the reference's tests do.
+    M2 = sum of squared deviations from the group mean, merged with Chan's
+    parallel formula (M2 = sum_i M2_i + n_i*(mean_i - mean)^2) — the
+    textbook sum/sum-of-squares identity cancels catastrophically when
+    mean >> stddev, matching the reference's Welford-style numerics instead.
     """
 
     name = "var"
@@ -303,31 +306,28 @@ class VarianceBase(AggregateFunction):
     @property
     def buffers(self):
         return (BufferSlot(T.DOUBLE, SUM, SUM),
-                BufferSlot(T.DOUBLE, SUM_SQ, SUM),
+                BufferSlot(T.DOUBLE, M2, M2_MERGE),
                 BufferSlot(T.LONG, COUNT_VALID, SUM))
 
-    def _finish(self, s, sq, n, xp):
+    def _finish(self, m2, n, xp):
         denom_ok = n > (1 if self._sample else 0)
-        nf = xp.where(n > 0, n, 1).astype("float64") if xp is np else             xp.where(n > 0, n, 1).astype(s.dtype)
-        m2 = sq - (s * s) / nf
-        m2 = xp.maximum(m2, 0.0)   # clamp negative rounding residue
+        nf = xp.where(n > 0, n, 1).astype(m2.dtype)
         div = (nf - 1) if self._sample else nf
-        var = m2 / xp.where(denom_ok, div, 1)
+        var = xp.maximum(m2, 0.0) / xp.where(denom_ok, div, 1)
         if self._sqrt:
             var = xp.sqrt(var)
         return var, denom_ok
 
     def finalize_np(self, bufs):
-        (s, _), (sq, _), (n, _) = bufs
+        (_s, _), (m2, _), (n, _) = bufs
         with np.errstate(all="ignore"):
-            v, ok = self._finish(s.astype(np.float64), sq.astype(np.float64),
-                                 n, np)
+            v, ok = self._finish(m2.astype(np.float64), n, np)
         return v, ok
 
     def finalize_jnp(self, bufs):
         import jax.numpy as jnp
-        (s, _), (sq, _), (n, _) = bufs
-        return self._finish(s, sq, n, jnp)
+        (_s, _), (m2, _), (n, _) = bufs
+        return self._finish(m2, n, jnp)
 
 
 class VarianceSamp(VarianceBase):

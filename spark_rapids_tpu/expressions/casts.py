@@ -90,9 +90,16 @@ class Cast(UnaryExpression):
             out = jnp.floor_divide(data, MICROS_PER_DAY).astype(jnp.int32)
         elif src.is_floating and dst.is_integral:
             lo, hi = _INT_RANGE[dst]
-            x = jnp.nan_to_num(data, nan=0.0, posinf=float(hi), neginf=float(lo))
-            x = jnp.clip(jnp.trunc(x), float(lo), float(hi))
-            out = x.astype(dst.jnp_dtype)
+            x = jnp.trunc(jnp.nan_to_num(data, nan=0.0))
+            # compare in float, assign in int: float(hi) rounds up to
+            # 2^63 for LONG and astype of an out-of-range float is
+            # implementation-defined — clip to a representable bound
+            # first, then saturate exactly with where()
+            mid = jnp.clip(x, float(lo),
+                           float(hi - 1024) if hi > 2**53 else float(hi))
+            out = mid.astype(dst.jnp_dtype)
+            out = jnp.where(x >= float(hi), jnp.asarray(hi, dst.jnp_dtype), out)
+            out = jnp.where(x <= float(lo), jnp.asarray(lo, dst.jnp_dtype), out)
         elif isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
             out, validity = _decimal_cast(data.astype(jnp.int64)
                                           if isinstance(src, T.DecimalType)
@@ -144,6 +151,10 @@ def _decimal_cast(data, validity, src: T.DataType, dst: T.DataType, xp):
     if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
         ds = dst.scale - src.scale
         if ds >= 0:
+            # pre-scale bound check: a wrapped int64 product can land back
+            # inside the precision bound and read as valid-but-wrong
+            bound = (10 ** min(dst.precision, 18) - 1) // (10 ** ds)
+            validity = validity & (data <= bound) & (data >= -bound)
             out = data * (10 ** ds)
         else:
             f = 10 ** (-ds)
@@ -161,7 +172,9 @@ def _decimal_cast(data, validity, src: T.DataType, dst: T.DataType, xp):
         q = xp.where(data >= 0, data // f, -((-data) // f))
         return q.astype(dst.jnp_dtype if xp is not np else dst.np_dtype), \
             validity
-    # integral/boolean -> decimal
-    out = data.astype(xp.int64) * (10 ** dst.scale)
-    validity = _overflow_null(out, validity, min(dst.precision, 18), xp)
+    # integral/boolean -> decimal (pre-scale bound check as above)
+    d64 = data.astype(xp.int64)
+    bound = (10 ** min(dst.precision, 18) - 1) // (10 ** dst.scale)
+    validity = validity & (d64 <= bound) & (d64 >= -bound)
+    out = d64 * (10 ** dst.scale)
     return out, validity

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import json
 import os
+import re
+import urllib.parse
 from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu import types as T
@@ -69,10 +71,12 @@ def load_snapshot(table_path: str,
     log_dir = os.path.join(table_path, "_delta_log")
     commits = []
     checkpoints = []
+    # exactly 20-digit commit files: `n.checkpoint.<uuid>.json` (v2
+    # checkpoints) and compacted logs also end in .json but are not commits
     for name in os.listdir(log_dir):
-        if name.endswith(".json") and name[:20].isdigit():
+        if re.fullmatch(r"\d{20}\.json", name):
             commits.append((int(name[:20]), os.path.join(log_dir, name)))
-        elif name.endswith(".checkpoint.parquet") and name[:20].isdigit():
+        elif re.fullmatch(r"\d{20}\.checkpoint\.parquet", name):
             checkpoints.append((int(name[:20]), os.path.join(log_dir, name)))
     commits.sort()
     if version is None:
@@ -94,10 +98,10 @@ def load_snapshot(table_path: str,
                 meta = row["metaData"]
             add = row.get("add")
             if add and add.get("path"):
-                live[add["path"]] = add
+                live[urllib.parse.unquote(add["path"])] = add
             rm = row.get("remove")
             if rm and rm.get("path"):
-                live.pop(rm["path"], None)
+                live.pop(urllib.parse.unquote(rm["path"]), None)
 
     for v, path in commits:
         if v <= base_version or v > version:
@@ -110,17 +114,19 @@ def load_snapshot(table_path: str,
                 if "metaData" in action:
                     meta = action["metaData"]
                 elif "add" in action:
-                    live[action["add"]["path"]] = action["add"]
+                    live[urllib.parse.unquote(action["add"]["path"])] = \
+                        action["add"]
                 elif "remove" in action:
-                    live.pop(action["remove"]["path"], None)
+                    live.pop(urllib.parse.unquote(action["remove"]["path"]),
+                             None)
 
     if meta is None:
         raise ValueError(f"delta log at {log_dir} has no metaData action")
     schema = _parse_schema_string(meta["schemaString"])
     part_cols = list(meta.get("partitionColumns") or [])
     files = []
-    for add in live.values():
-        files.append((os.path.join(table_path, add["path"]),
+    for rel_path, add in live.items():
+        files.append((os.path.join(table_path, rel_path),
                       dict(add.get("partitionValues") or {})))
     files.sort()
     return DeltaSnapshot(schema, part_cols, files, version)

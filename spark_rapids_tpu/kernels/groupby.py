@@ -147,6 +147,46 @@ def seg_sum(col: DeviceColumn, layout: GroupedLayout, out_dtype) -> Tuple[jax.Ar
     return out, nvalid > 0
 
 
+def seg_m2_update(col: DeviceColumn, layout: GroupedLayout) -> Tuple[jax.Array, jax.Array]:
+    """M2 = sum((x - group_mean)^2) per group, two-pass segmented.
+
+    The two-pass form avoids the sum-of-squares cancellation the textbook
+    identity suffers when mean >> stddev (reference: Welford/Chan numerics
+    in aggregateFunctions.scala GpuStddevSamp)."""
+    live = layout.sorted_batch.live_mask()
+    valid = col.validity & live
+    x = col.data.astype(jnp.float64)
+    cap = col.capacity
+    n = jax.ops.segment_sum(valid.astype(jnp.float64), layout.segment_ids,
+                            num_segments=cap)
+    s = jax.ops.segment_sum(jnp.where(valid, x, 0.0), layout.segment_ids,
+                            num_segments=cap)
+    mean = s / jnp.maximum(n, 1.0)
+    d = x - mean[layout.segment_ids]
+    m2 = jax.ops.segment_sum(jnp.where(valid, d * d, 0.0),
+                             layout.segment_ids, num_segments=cap)
+    return m2, n > 0
+
+
+def seg_m2_merge(m2col: DeviceColumn, scol: DeviceColumn, ncol: DeviceColumn,
+                 layout: GroupedLayout) -> Tuple[jax.Array, jax.Array]:
+    """Chan's parallel merge: M2 = sum_i M2_i + n_i*(mean_i - mean)^2."""
+    live = layout.sorted_batch.live_mask()
+    valid = m2col.validity & live
+    n_i = jnp.where(valid, ncol.data.astype(jnp.float64), 0.0)
+    s_i = jnp.where(valid, scol.data.astype(jnp.float64), 0.0)
+    m2_i = jnp.where(valid, m2col.data.astype(jnp.float64), 0.0)
+    cap = m2col.capacity
+    n = jax.ops.segment_sum(n_i, layout.segment_ids, num_segments=cap)
+    s = jax.ops.segment_sum(s_i, layout.segment_ids, num_segments=cap)
+    mean = s / jnp.maximum(n, 1.0)
+    mean_i = s_i / jnp.maximum(n_i, 1.0)
+    delta = mean_i - mean[layout.segment_ids]
+    contrib = jnp.where(valid, m2_i + n_i * delta * delta, 0.0)
+    m2 = jax.ops.segment_sum(contrib, layout.segment_ids, num_segments=cap)
+    return m2, n > 0
+
+
 def _extreme(dtype, is_min: bool):
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.array(jnp.inf if is_min else -jnp.inf, dtype=dtype)

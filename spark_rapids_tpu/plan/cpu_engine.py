@@ -29,7 +29,7 @@ from spark_rapids_tpu.expressions.aggregates import (
     MAX,
     MIN,
     SUM,
-    SUM_SQ,
+    M2,
     AggregateFunction,
 )
 from spark_rapids_tpu.kernels.hash import py_murmur3_row
@@ -297,10 +297,11 @@ class CpuEngine:
                     elif slot.update_op == SUM:
                         with np.errstate(all="ignore"):
                             bv[gi] = vals[sel].astype(slot.dtype.np_dtype).sum()
-                    elif slot.update_op == SUM_SQ:
+                    elif slot.update_op == M2:
                         with np.errstate(all="ignore"):
                             x = vals[sel].astype(np.float64)
-                            bv[gi] = (x * x).sum()
+                            d = x - x.mean()
+                            bv[gi] = (d * d).sum()
                     elif slot.update_op == MIN:
                         bv[gi] = _extreme_np(vals[sel], slot.dtype, is_min=True)
                     elif slot.update_op == MAX:

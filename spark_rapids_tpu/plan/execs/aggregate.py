@@ -31,10 +31,11 @@ from spark_rapids_tpu.expressions.core import (
 from spark_rapids_tpu.expressions.aggregates import (
     COUNT_STAR,
     COUNT_VALID,
+    M2,
+    M2_MERGE,
     MAX,
     MIN,
     SUM,
-    SUM_SQ,
     AggregateFunction,
 )
 from spark_rapids_tpu.kernels import groupby as G
@@ -79,12 +80,8 @@ def _seg_update(op: str, col: Optional[DeviceColumn], layout: G.GroupedLayout,
         return G.seg_count_valid(col, layout)
     if op == SUM:
         return G.seg_sum(col, layout, out_dtype.jnp_dtype)
-    if op == SUM_SQ:
-        from spark_rapids_tpu.columnar.column import DeviceColumn
-        sq = col.data.astype(out_dtype.jnp_dtype)
-        sq = jnp.where(col.validity, sq * sq, 0)
-        sq_col = DeviceColumn(sq, col.validity, out_dtype)
-        return G.seg_sum(sq_col, layout, out_dtype.jnp_dtype)
+    if op == M2:
+        return G.seg_m2_update(col, layout)
     if op == MIN:
         return G.seg_min(col, layout)
     if op == MAX:
@@ -104,9 +101,12 @@ def _global_update(op: str, col: Optional[DeviceColumn], live, out_dtype):
     if op == SUM:
         vals = col.data.astype(out_dtype.jnp_dtype)
         return jnp.sum(jnp.where(valid, vals, 0)), nvalid > 0
-    if op == SUM_SQ:
-        vals = col.data.astype(out_dtype.jnp_dtype)
-        return jnp.sum(jnp.where(valid, vals * vals, 0)), nvalid > 0
+    if op == M2:
+        x = col.data.astype(jnp.float64)
+        nf = jnp.sum(valid.astype(jnp.float64))
+        mean = jnp.sum(jnp.where(valid, x, 0.0)) / jnp.maximum(nf, 1.0)
+        d = x - mean
+        return jnp.sum(jnp.where(valid, d * d, 0.0)), nvalid > 0
     if op in (MIN, MAX):
         dt = col.data.dtype
         is_min = op == MIN
@@ -133,6 +133,21 @@ def _global_update(op: str, col: Optional[DeviceColumn], live, out_dtype):
     raise NotImplementedError(op)
 
 
+def _global_m2_merge(m2col: DeviceColumn, scol: DeviceColumn,
+                     ncol: DeviceColumn, live):
+    """Chan's merge over all partial rows, one output group (no keys)."""
+    valid = m2col.validity & live
+    n_i = jnp.where(valid, ncol.data.astype(jnp.float64), 0.0)
+    s_i = jnp.where(valid, scol.data.astype(jnp.float64), 0.0)
+    m2_i = jnp.where(valid, m2col.data.astype(jnp.float64), 0.0)
+    n = jnp.sum(n_i)
+    mean = jnp.sum(s_i) / jnp.maximum(n, 1.0)
+    mean_i = s_i / jnp.maximum(n_i, 1.0)
+    delta = mean_i - mean
+    m2 = jnp.sum(jnp.where(valid, m2_i + n_i * delta * delta, 0.0))
+    return m2, n > 0
+
+
 class TpuHashAggregateExec(TpuExec):
     def __init__(self, group_exprs: Sequence[Expression],
                  agg_exprs: Sequence[Expression],
@@ -146,8 +161,10 @@ class TpuHashAggregateExec(TpuExec):
         self.target_capacity = target_capacity
         # buffer layout: per aggregate, per slot -> one partial column
         self.slot_specs = []   # (agg_index, slot)
+        self._slot_pos = {}    # agg_index -> [slot indices into slot_specs]
         for ai, agg in enumerate(self.aggregates):
             for slot in agg.buffers:
+                self._slot_pos.setdefault(ai, []).append(len(self.slot_specs))
                 self.slot_specs.append((ai, slot))
         nkeys = len(self.group_exprs)
         partial_names = tuple(f"_k{i}" for i in range(nkeys)) + tuple(
@@ -171,6 +188,23 @@ class TpuHashAggregateExec(TpuExec):
         self._jit_finalize = jax.jit(self._finalize)
 
     # -- device steps -------------------------------------------------------
+
+    def _m2_companions(self, ai: int):
+        """Slot indices of the M2 buffer's sum and count companions,
+        resolved by op kind (not position) so a buffer-layout change in the
+        aggregate fails loudly here instead of merging the wrong columns."""
+        s_si = n_si = None
+        for si in self._slot_pos[ai]:
+            _, slot = self.slot_specs[si]
+            if slot.update_op == SUM:
+                s_si = si
+            elif slot.update_op == COUNT_VALID:
+                n_si = si
+        if s_si is None or n_si is None:
+            raise AssertionError(
+                f"M2_MERGE needs SUM and COUNT_VALID companion buffers "
+                f"on aggregate {self.aggregates[ai]!r}")
+        return s_si, n_si
 
     def _merge_bucket(self, partial: ColumnarBatch) -> int:
         from spark_rapids_tpu.kernels import strings as SK
@@ -240,7 +274,14 @@ class TpuHashAggregateExec(TpuExec):
             cols = []
             for si, (ai, slot) in enumerate(self.slot_specs):
                 col = partial.columns[nkeys + si]
-                v, valid = _global_update(slot.merge_op, col, live, slot.dtype)
+                if slot.merge_op == M2_MERGE:
+                    s_si, n_si = self._m2_companions(ai)
+                    v, valid = _global_m2_merge(
+                        col, partial.columns[nkeys + s_si],
+                        partial.columns[nkeys + n_si], live)
+                else:
+                    v, valid = _global_update(slot.merge_op, col, live,
+                                              slot.dtype)
                 data = jnp.where(valid, v, jnp.zeros((), v.dtype))
                 cols.append(DeviceColumn(
                     jnp.reshape(data.astype(slot.dtype.jnp_dtype), (1,)),
@@ -252,7 +293,13 @@ class TpuHashAggregateExec(TpuExec):
         cols = list(out_keys)
         for si, (ai, slot) in enumerate(self.slot_specs):
             col = layout.sorted_batch.columns[nkeys + si]
-            v, valid = _seg_update(slot.merge_op, col, layout, slot.dtype)
+            if slot.merge_op == M2_MERGE:
+                s_si, n_si = self._m2_companions(ai)
+                v, valid = G.seg_m2_merge(
+                    col, layout.sorted_batch.columns[nkeys + s_si],
+                    layout.sorted_batch.columns[nkeys + n_si], layout)
+            else:
+                v, valid = _seg_update(slot.merge_op, col, layout, slot.dtype)
             cols.append(G.finalize_agg_column(
                 v.astype(slot.dtype.jnp_dtype), valid, layout.num_groups,
                 slot.dtype))

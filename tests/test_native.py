@@ -55,6 +55,21 @@ def test_kudo_python_fallback_roundtrip(monkeypatch):
     assert CpuTable.from_batch(merged).rows() == expect
 
 
+def test_kudo_merge_wide_schema():
+    """>256 columns: the merge path must heap-size its per-column views
+    (a fixed 256-view stack array would silently corrupt memory here)."""
+    ncols = 300
+    wide = Schema(tuple(f"c{i}" for i in range(ncols)), (T.INT,) * ncols)
+    n = 17
+    data = {f"c{i}": [(i * 1000 + r) for r in range(n)] for i in range(ncols)}
+    batch = ColumnarBatch.from_pydict(data, wide)
+    bufs = [ser.serialize_batch(batch), ser.serialize_batch(batch)]
+    merged = ser.merge_batches(bufs, wide)
+    got = CpuTable.from_batch(merged).rows()
+    expect = CpuTable.from_batch(batch).rows() * 2
+    assert got == expect
+
+
 def test_native_and_python_merge_agree():
     batches = [make_batch(seed) for seed in range(2)]
     bufs = [ser.serialize_batch(b) for b in batches]

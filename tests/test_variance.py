@@ -17,6 +17,31 @@ def test_grouped_variance():
             var_samp("v").alias("vs"), stddev("v").alias("sd")))
 
 
+def test_variance_large_mean_no_cancellation():
+    """mean >> stddev: the textbook sum-of-squares identity collapses to 0
+    here; the M2/Chan buffer plan must recover the true unit variance."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import Schema
+
+    def build(s):
+        base = 1.0e8
+        vals = [base + float(i % 7) - 3.0 for i in range(4096)]
+        ks = [i % 3 for i in range(4096)]
+        df = s.create_dataframe({"k": ks, "v": vals},
+                                Schema.of(k=T.INT, v=T.DOUBLE),
+                                num_partitions=4)
+        return df.group_by("k").agg(var_pop("v").alias("vp"))
+    rows = assert_tpu_cpu_equal(build)
+    import numpy as np
+    vals = np.array([base + float(i % 7) - 3.0
+                     for base in [1.0e8] for i in range(4096)])
+    ks = np.array([i % 3 for i in range(4096)])
+    for k, vp in rows:
+        expect = vals[ks == k].var()
+        assert expect > 1.0   # the data really has spread
+        assert abs(vp - expect) < 1e-4 * expect, (k, vp, expect)
+
+
 def test_variance_single_row_group_is_null():
     from spark_rapids_tpu import types as T
     from spark_rapids_tpu.columnar.batch import Schema

@@ -1,56 +1,164 @@
-"""Benchmark: TPC-H q6 throughput on the TPU engine.
+"""Benchmark: TPC-H throughput on the TPU engine.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Metric: q6 rows/sec through the full engine path (filter + aggregate over
-generated lineitem, SURVEY.md §6 gate #1).  vs_baseline is the speedup over
-the CPU oracle engine executing the same logical plan on the same data —
-the stand-in for CPU Spark until a cluster baseline exists (the reference
-repo itself publishes no absolute numbers, BASELINE.md).
+Metric: geometric-mean rows/sec over TPC-H q6 (scan+filter+sum, SURVEY.md
+§6 gate #1) and q1 (group-by heavy) through the full engine path.
+vs_baseline is the geomean speedup over the CPU oracle engine executing the
+same logical plans on the same data — the stand-in for CPU Spark until a
+cluster baseline exists (the reference repo publishes no absolute numbers,
+BASELINE.md).
+
+Resilience contract (VERDICT round 1 #1): this script NEVER exits non-zero
+and NEVER hangs.  The measured run happens in a child process under a
+timeout; if the TPU (axon tunnel) backend fails or stalls, it falls back to
+the CPU backend and reports the failure in the JSON instead of crashing.
 """
 from __future__ import annotations
 
 import json
+import math
+import os
+import subprocess
+import sys
 import time
 
+CHILD_ENV = "SPARK_RAPIDS_TPU_BENCH_CHILD"
+N_ROWS = int(os.environ.get("SPARK_RAPIDS_TPU_BENCH_ROWS", 2_000_000))
+TPU_TIMEOUT_S = int(os.environ.get("SPARK_RAPIDS_TPU_BENCH_TIMEOUT", 1200))
+CPU_TIMEOUT_S = 900
 
-def main() -> None:
+
+def _child_main(backend: str) -> None:
+    """Run the measured benchmark on `backend` and print the JSON line."""
     import jax
+
+    if backend == "cpu":
+        # the container sitecustomize pins jax_platforms=axon; env vars are
+        # not honored, only a pre-first-use config update works
+        jax.config.update("jax_platforms", "cpu")
+    # touch the backend early so init failures are fast and attributable
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
 
     from spark_rapids_tpu.api.session import TpuSession
     from spark_rapids_tpu.testing import tpch
 
-    n_rows = 2_000_000
-    batches = tpch.gen_lineitem(n_rows, batch_rows=1 << 19)
-
+    batches = tpch.gen_lineitem(N_ROWS, batch_rows=1 << 19)
     tpu_sess = TpuSession({"spark.rapids.sql.enabled": "true"})
     cpu_sess = TpuSession({"spark.rapids.sql.enabled": "false"})
 
-    def run(sess):
-        df = tpch.q6(sess.create_dataframe(list(batches), num_partitions=2))
-        return df.collect()
+    queries = {"q6": tpch.q6, "q1": tpch.q1}
+    per_query = {}
+    speedups = []
+    rates = []
+    for name, qfn in queries.items():
+        def run(sess):
+            df = qfn(sess.create_dataframe(list(batches), num_partitions=2))
+            return df.collect()
 
-    # warmup (compile) + correctness cross-check
-    tpu_rows = run(tpu_sess)
-    t0 = time.perf_counter()
-    tpu_rows = run(tpu_sess)
-    tpu_time = time.perf_counter() - t0
+        tpu_rows = run(tpu_sess)        # warmup: compile + correctness
+        t0 = time.perf_counter()
+        tpu_rows = run(tpu_sess)
+        tpu_time = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    cpu_rows = run(cpu_sess)
-    cpu_time = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        cpu_rows = run(cpu_sess)
+        cpu_time = time.perf_counter() - t0
 
-    assert abs(tpu_rows[0][0] - cpu_rows[0][0]) < 1e-6 * abs(cpu_rows[0][0]), \
-        (tpu_rows, cpu_rows)
+        # correctness cross-check against the oracle before reporting perf
+        assert len(tpu_rows) == len(cpu_rows), (name, tpu_rows, cpu_rows)
+        for tr, cr in zip(sorted(map(tuple, tpu_rows)),
+                          sorted(map(tuple, cpu_rows))):
+            for a, b in zip(tr, cr):
+                if isinstance(a, float):
+                    assert b == b and abs(a - b) <= 1e-6 * max(1.0, abs(b)), \
+                        (name, tr, cr)
+                else:
+                    assert a == b, (name, tr, cr)
 
-    rows_per_sec = n_rows / tpu_time
+        rate = N_ROWS / tpu_time
+        per_query[name] = {"rows_per_sec": round(rate),
+                           "tpu_s": round(tpu_time, 4),
+                           "oracle_s": round(cpu_time, 4)}
+        rates.append(rate)
+        speedups.append(cpu_time / tpu_time)
+
+    def geo(xs):
+        return float(math.exp(sum(map(math.log, xs)) / len(xs)))
+
     print(json.dumps({
-        "metric": "tpch_q6_rows_per_sec",
-        "value": round(rows_per_sec),
+        "metric": "tpch_q6_q1_geomean_rows_per_sec",
+        "value": round(geo(rates)),
         "unit": "rows/s",
-        "vs_baseline": round(cpu_time / tpu_time, 3),
+        "vs_baseline": round(geo(speedups), 3),
+        "backend": platform,
+        "n_devices": n_dev,
+        "queries": per_query,
+    }))
+
+
+def _try_backend(backend: str, timeout_s: int):
+    """Run the child under a hard timeout; return parsed JSON or error info."""
+    env = dict(os.environ)
+    env[CHILD_ENV] = f"{backend}@{os.getpid()}"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, f"{backend}: timeout after {timeout_s}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        return None, f"{backend}: rc={proc.returncode}: " + " | ".join(tail)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"{backend}: no JSON line in output"
+
+
+def main() -> None:
+    # child mode only when OUR parent set the marker (backend@parent_pid);
+    # a leftover exported var must not bypass the timeout/fallback harness
+    child = os.environ.pop(CHILD_ENV, None)
+    if child and "@" in child:
+        backend, _, pid = child.partition("@")
+        if pid == str(os.getppid()):
+            _child_main(backend)
+            return
+
+    errors = []
+    for backend, timeout_s in (("tpu", TPU_TIMEOUT_S), ("cpu", CPU_TIMEOUT_S)):
+        result, err = _try_backend(backend, timeout_s)
+        if result is not None:
+            if errors:
+                result["backend_errors"] = errors
+            print(json.dumps(result))
+            return
+        errors.append(err)
+
+    # both backends failed: still exit 0 with a diagnostic line the driver
+    # can record (a crash here would zero out the round's perf evidence)
+    print(json.dumps({
+        "metric": "tpch_q6_q1_geomean_rows_per_sec",
+        "value": 0,
+        "unit": "rows/s",
+        "vs_baseline": 0.0,
+        "error": errors,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — resilience contract, see module doc
+        print(json.dumps({
+            "metric": "tpch_q6_q1_geomean_rows_per_sec",
+            "value": 0, "unit": "rows/s", "vs_baseline": 0.0,
+            "error": [f"harness: {type(e).__name__}: {e}"],
+        }))
+    sys.exit(0)

@@ -148,46 +148,20 @@ def _global_m2_merge(m2col: DeviceColumn, scol: DeviceColumn,
     return m2, n > 0
 
 
-class TpuHashAggregateExec(TpuExec):
-    def __init__(self, group_exprs: Sequence[Expression],
-                 agg_exprs: Sequence[Expression],
-                 aggregates: List[AggregateFunction],
-                 child: TpuExec, schema: Schema, mode: str = "complete",
-                 target_capacity: int = 1 << 16):
-        self.group_exprs = tuple(group_exprs)
-        self.agg_exprs = tuple(agg_exprs)
-        self.aggregates = list(aggregates)
-        self.mode = mode
-        self.target_capacity = target_capacity
-        # buffer layout: per aggregate, per slot -> one partial column
-        self.slot_specs = []   # (agg_index, slot)
-        self._slot_pos = {}    # agg_index -> [slot indices into slot_specs]
-        for ai, agg in enumerate(self.aggregates):
-            for slot in agg.buffers:
-                self._slot_pos.setdefault(ai, []).append(len(self.slot_specs))
-                self.slot_specs.append((ai, slot))
-        nkeys = len(self.group_exprs)
-        partial_names = tuple(f"_k{i}" for i in range(nkeys)) + tuple(
-            f"_buf{i}" for i in range(len(self.slot_specs)))
-        partial_dtypes = tuple(e.dtype for e in self.group_exprs) + tuple(
-            s.dtype for _, s in self.slot_specs)
-        self.partial_schema = Schema(partial_names, partial_dtypes)
-        out_schema = self.partial_schema if mode == "partial" else schema
-        super().__init__((child,), out_schema)
-        from functools import lru_cache, partial as _partial
-        self._jit_partial_by_bucket = lru_cache(maxsize=16)(
-            lambda bucket: jax.jit(_partial(self._partial_step,
-                                            string_bucket=bucket)))
-        self._jit_merge_by_bucket = lru_cache(maxsize=16)(
-            lambda bucket: jax.jit(_partial(self._merge_step,
-                                            string_bucket=bucket)))
-        self._jit_partial = lambda b: self._jit_partial_by_bucket(
-            string_key_bucket(b, self.group_exprs))(b)
-        self._jit_merge = lambda b: self._jit_merge_by_bucket(
-            self._merge_bucket(b))(b)
-        self._jit_finalize = jax.jit(self._finalize)
+class _AggDeviceSpec:
+    """The aggregate's device-step parameters + pure step functions,
+    detached from the exec so shared_jit-cached steps never pin the exec
+    tree (and its scan input data) in the global cache."""
 
-    # -- device steps -------------------------------------------------------
+    def __init__(self, group_exprs, agg_exprs, aggregates, slot_specs,
+                 slot_pos, partial_schema, out_schema):
+        self.group_exprs = group_exprs
+        self.agg_exprs = agg_exprs
+        self.aggregates = aggregates
+        self.slot_specs = slot_specs
+        self._slot_pos = slot_pos
+        self.partial_schema = partial_schema
+        self.schema = out_schema
 
     def _m2_companions(self, ai: int):
         """Slot indices of the M2 buffer's sum and count companions,
@@ -328,6 +302,55 @@ class TpuHashAggregateExec(TpuExec):
             sub = _substitute(e, mapping)
             out_cols.append(sub.eval(ctx))
         return ColumnarBatch(tuple(out_cols), merged.num_rows, self.schema)
+
+
+class TpuHashAggregateExec(TpuExec):
+    def __init__(self, group_exprs: Sequence[Expression],
+                 agg_exprs: Sequence[Expression],
+                 aggregates: List[AggregateFunction],
+                 child: TpuExec, schema: Schema, mode: str = "complete",
+                 target_capacity: int = 1 << 16):
+        self.group_exprs = tuple(group_exprs)
+        self.agg_exprs = tuple(agg_exprs)
+        self.aggregates = list(aggregates)
+        self.mode = mode
+        self.target_capacity = target_capacity
+        # buffer layout: per aggregate, per slot -> one partial column
+        self.slot_specs = []   # (agg_index, slot)
+        slot_pos = {}          # agg_index -> [slot indices into slot_specs]
+        for ai, agg in enumerate(self.aggregates):
+            for slot in agg.buffers:
+                slot_pos.setdefault(ai, []).append(len(self.slot_specs))
+                self.slot_specs.append((ai, slot))
+        nkeys = len(self.group_exprs)
+        partial_names = tuple(f"_k{i}" for i in range(nkeys)) + tuple(
+            f"_buf{i}" for i in range(len(self.slot_specs)))
+        partial_dtypes = tuple(e.dtype for e in self.group_exprs) + tuple(
+            s.dtype for _, s in self.slot_specs)
+        self.partial_schema = Schema(partial_names, partial_dtypes)
+        out_schema = self.partial_schema if mode == "partial" else schema
+        super().__init__((child,), out_schema)
+        spec = _AggDeviceSpec(self.group_exprs, self.agg_exprs,
+                              self.aggregates, self.slot_specs, slot_pos,
+                              self.partial_schema, out_schema)
+        self._spec = spec
+        from functools import partial as _partial
+        from spark_rapids_tpu.plan.execs.base import (
+            exprs_cache_key, schema_cache_key, shared_jit)
+        key = ("agg|" + mode
+               + "|" + schema_cache_key(child.schema)
+               + "|" + schema_cache_key(self.partial_schema)
+               + "|" + schema_cache_key(out_schema)
+               + "|" + exprs_cache_key(self.group_exprs)
+               + "|" + exprs_cache_key(self.agg_exprs))
+        self._jit_partial = lambda b, _k=key: shared_jit(
+            f"{_k}|partial|{(bkt := string_key_bucket(b, spec.group_exprs))}",
+            lambda: _partial(spec._partial_step, string_bucket=bkt))(b)
+        self._jit_merge = lambda b, _k=key: shared_jit(
+            f"{_k}|merge|{(bkt := spec._merge_bucket(b))}",
+            lambda: _partial(spec._merge_step, string_bucket=bkt))(b)
+        self._jit_finalize = lambda b, _k=key: shared_jit(
+            f"{_k}|finalize", lambda: spec._finalize)(b)
 
     # -- host-side orchestration -------------------------------------------
 

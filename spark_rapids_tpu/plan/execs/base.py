@@ -16,10 +16,95 @@ recompiles stay bounded while batch sizes vary.
 """
 from __future__ import annotations
 
+import collections
 import time
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+
+
+# -- cross-query jit sharing --------------------------------------------------
+#
+# jax.jit functions created per exec INSTANCE recompile on every new query
+# even when the plan is identical (the reference pays codegen once per plan
+# shape via Spark's codegen cache; we get the analog by keying jitted step
+# functions on a canonical plan signature).  The cache holds the jit wrapper
+# (and therefore its XLA executables); an LRU bound keeps memory in check.
+
+_JIT_CACHE: "collections.OrderedDict[str, object]" = collections.OrderedDict()
+_JIT_CACHE_MAX = 512
+_JIT_CACHE_LOCK = __import__("threading").Lock()
+
+
+def shared_jit(key: str, make_fn: Callable[[], Callable], **jit_kwargs):
+    """Return a jitted function shared by all execs with the same plan key.
+
+    ``make_fn`` is only called on a cache miss; the key must fully determine
+    the computation (expression tree incl. dtypes, schemas, static params).
+
+    CONTRACT: the function ``make_fn`` returns must NOT close over an exec
+    instance (``self``) — cached entries outlive queries, and an exec pins
+    its children chain down to the scan's input batches.  Close over the
+    plan parameters (exprs, schemas) only.
+    """
+    with _JIT_CACHE_LOCK:
+        fn = _JIT_CACHE.get(key)
+        if fn is not None:
+            _JIT_CACHE.move_to_end(key)
+            return fn
+    import jax
+    made = jax.jit(make_fn(), **jit_kwargs)
+    with _JIT_CACHE_LOCK:
+        fn = _JIT_CACHE.setdefault(key, made)   # racer may have won; reuse
+        _JIT_CACHE.move_to_end(key)
+        if len(_JIT_CACHE) > _JIT_CACHE_MAX:
+            _JIT_CACHE.popitem(last=False)
+    return fn
+
+
+def expr_cache_key(e) -> str:
+    """Canonical signature of a bound expression tree for shared_jit keys.
+
+    repr() alone is unsafe (lit(5) INT vs LONG print the same), so walk the
+    tree recording class names, dtypes, and scalar attributes."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expressions.core import Expression
+    atoms: List[str] = []
+
+    def walk(x):
+        atoms.append(type(x).__name__)
+        try:
+            atoms.append(repr(x.dtype))
+        except Exception:
+            atoms.append("?")
+        for k in sorted(vars(x)):
+            if k == "children":
+                continue
+            v = vars(x)[k]
+            if isinstance(v, Expression) or (
+                    isinstance(v, tuple) and v
+                    and all(isinstance(t, Expression) for t in v)):
+                continue  # reached via children
+            if isinstance(v, (str, int, float, bool, bytes, type(None),
+                              T.DataType)):
+                atoms.append(f"{k}={v!r}")
+            else:
+                atoms.append(f"{k}~{type(v).__name__}:{v!r}")
+        atoms.append("(")
+        for c in x.children:
+            walk(c)
+        atoms.append(")")
+
+    walk(e)
+    return "|".join(atoms)
+
+
+def exprs_cache_key(exprs) -> str:
+    return ";".join(expr_cache_key(e) for e in exprs)
+
+
+def schema_cache_key(s: Schema) -> str:
+    return repr(s)
 
 
 class Metric:

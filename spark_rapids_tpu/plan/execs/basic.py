@@ -19,7 +19,14 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
 from spark_rapids_tpu.expressions.core import EvalContext, Expression
 from spark_rapids_tpu.kernels.selection import compaction_map, gather_batch
 from spark_rapids_tpu.memory.retry import with_retry_no_split
-from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+from spark_rapids_tpu.plan.execs.base import (
+    TpuExec,
+    expr_cache_key,
+    exprs_cache_key,
+    schema_cache_key,
+    shared_jit,
+    timed,
+)
 
 
 class TpuProjectExec(TpuExec):
@@ -27,13 +34,16 @@ class TpuProjectExec(TpuExec):
                  schema: Schema):
         super().__init__((child,), schema)
         self.exprs = tuple(exprs)
+        exprs_t, out_schema = self.exprs, schema   # no self-capture (cache pins)
 
         def run(batch: ColumnarBatch) -> ColumnarBatch:
             ctx = EvalContext(batch)
-            cols = tuple(e.eval(ctx) for e in self.exprs)
-            return ColumnarBatch(cols, batch.num_rows, self.schema)
+            cols = tuple(e.eval(ctx) for e in exprs_t)
+            return ColumnarBatch(cols, batch.num_rows, out_schema)
 
-        self._run = jax.jit(run)
+        self._run = shared_jit(
+            f"project|{schema_cache_key(child.schema)}|"
+            f"{exprs_cache_key(self.exprs)}", lambda: run)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         for batch in self.children[0].execute_partition(idx):
@@ -51,15 +61,19 @@ class TpuFilterExec(TpuExec):
         super().__init__((child,), child.schema)
         self.condition = condition
 
+        cond = condition   # no self-capture (cache pins)
+
         def run(batch: ColumnarBatch) -> ColumnarBatch:
-            pred = self.condition.eval(EvalContext(batch))
+            pred = cond.eval(EvalContext(batch))
             mask = pred.data & pred.validity & batch.live_mask()
             indices, count = compaction_map(mask)
             # output capacity = input capacity: a filter never grows, so
             # there is no overflow path here
             return gather_batch(batch, indices, count)
 
-        self._run = jax.jit(run)
+        self._run = shared_jit(
+            f"filter|{schema_cache_key(child.schema)}|"
+            f"{expr_cache_key(condition)}", lambda: run)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         for batch in self.children[0].execute_partition(idx):

@@ -59,13 +59,15 @@ class TpuShuffleExchangeExec(TpuExec):
         self._materialized: Optional[List[List[SpillableBatchHandle]]] = None
         self._wire: Optional[List[List[bytes]]] = None
 
+        keys_t, n_out = self.keys, self.out_partitions  # no self-capture
+
         def slice_step(batch: ColumnarBatch, string_bucket: int = 0):
             """Device: append key columns, partition, return reordered batch
             + per-partition counts."""
-            if not self.keys:
-                return round_robin_partition(batch, self.out_partitions)
+            if not keys_t:
+                return round_robin_partition(batch, n_out)
             ctx = EvalContext(batch)
-            key_cols = tuple(k.eval(ctx) for k in self.keys)
+            key_cols = tuple(k.eval(ctx) for k in keys_t)
             work = ColumnarBatch(
                 tuple(batch.columns) + key_cols, batch.num_rows,
                 Schema(tuple(batch.schema.names) +
@@ -74,17 +76,20 @@ class TpuShuffleExchangeExec(TpuExec):
                        tuple(c.dtype for c in key_cols)))
             reordered, counts = hash_partition(
                 work, list(range(len(batch.schema), len(work.schema))),
-                self.out_partitions, string_max_bytes=string_bucket)
+                n_out, string_max_bytes=string_bucket)
             # drop the key columns again
             out = ColumnarBatch(reordered.columns[:len(batch.schema)],
                                 reordered.num_rows, batch.schema)
             return out, counts
 
-        from functools import lru_cache, partial as _p
-        self._slice_by_bucket = lru_cache(maxsize=16)(
-            lambda bucket: jax.jit(_p(slice_step, string_bucket=bucket)))
-        self._jit_slice = lambda b: self._slice_by_bucket(
-            string_key_bucket(b, self.keys))(b)
+        from functools import partial as _p
+        from spark_rapids_tpu.plan.execs.base import (
+            exprs_cache_key, schema_cache_key, shared_jit)
+        key = (f"exchange|{num_partitions}|{schema_cache_key(child.schema)}|"
+               f"{exprs_cache_key(self.keys)}")
+        self._jit_slice = lambda b, _k=key: shared_jit(
+            f"{_k}|{(bkt := string_key_bucket(b, self.keys))}",
+            lambda: _p(slice_step, string_bucket=bkt))(b)
 
     def num_partitions(self) -> int:
         return self.out_partitions

@@ -39,7 +39,11 @@ class _JoinKernel:
         self.join_type = join_type
         self.schema = schema
 
-        @lru_cache(maxsize=64)
+        from spark_rapids_tpu.plan.execs.base import (
+            schema_cache_key, shared_jit)
+        base_key = (f"join|{self.left_key_idx}|{self.right_key_idx}|"
+                    f"{join_type}|{schema_cache_key(schema)}")
+
         def jitted(out_capacity: int, byte_caps: tuple, bucket: int):
             def run(l: ColumnarBatch, r: ColumnarBatch):
                 li, ri, count, status = join_gather_maps(
@@ -50,9 +54,11 @@ class _JoinKernel:
                     l, r, li, ri, count, self.schema, self.join_type,
                     out_capacity, dict(byte_caps))
                 return out, status, gstatus
-            return jax.jit(run)
+            return run
 
-        self._jitted = jitted
+        self._jitted = lambda out_capacity, byte_caps, bucket: shared_jit(
+            f"{base_key}|{out_capacity}|{byte_caps}|{bucket}",
+            lambda: jitted(out_capacity, byte_caps, bucket))
 
     def _string_out_cols(self, l: ColumnarBatch, r: ColumnarBatch):
         """output ordinal -> source byte capacity for string columns."""

@@ -49,11 +49,14 @@ class TpuRangeSortExec(TpuExec):
         self._buckets: Optional[List[List[SpillableBatchHandle]]] = None
         self._local_sort = TpuSortExec(self.orders, child)  # reuse its jit
 
+        orders = self.orders           # no self-capture (cache pins)
+        n_out = self.out_partitions
+
         def encode(batch: ColumnarBatch, bucket: int):
             """Per-row encoded key arrays (most-significant first)."""
             ctx = EvalContext(batch)
             keys = []
-            for e, o in self.orders:
+            for e, o in orders:
                 c = normalize_key_column(e.eval(ctx))
                 keys.append(_null_key(c, o).astype(jnp.uint64))
                 if c.is_string_like:
@@ -62,40 +65,48 @@ class TpuRangeSortExec(TpuExec):
                     keys.append(_data_key_fixed(c, o))
             return tuple(keys)
 
-        from functools import lru_cache, partial as _p
-        self._encode_by_bucket = lru_cache(maxsize=16)(
-            lambda b: jax.jit(_p(encode, bucket=b)))
+        from functools import partial as _p
+        from spark_rapids_tpu.plan.execs.base import (
+            exprs_cache_key, schema_cache_key, shared_jit)
+        plan_key = (f"rangesort|{self.out_partitions}|"
+                    f"{schema_cache_key(child.schema)}|"
+                    f"{exprs_cache_key(e for e, _ in self.orders)}|"
+                    f"{','.join(f'{o.ascending}:{o.nulls_first}' for _, o in self.orders)}")
+        self._encode_by_bucket = lambda b: shared_jit(
+            f"{plan_key}|encode|{b}", lambda: _p(encode, bucket=b))
 
-        def route(batch: ColumnarBatch, boundaries: tuple, bucket: int):
-            """dest partition per row + reorder by dest (stable)."""
+        def route(batch: ColumnarBatch, bounds: jax.Array, bucket: int):
+            """dest partition per row + reorder by dest (stable).
+
+            bounds is a DYNAMIC [n_bounds, n_keys] uint64 array (sampled per
+            query) so changing boundaries never recompiles; the comparison is
+            a vectorized lexicographic >= against every boundary at once."""
             keys = encode(batch, bucket)
-            cap = batch.capacity
-            dest = jnp.zeros((cap,), jnp.int32)
-            for b in boundaries:   # static small list of key tuples
-                gt = jnp.zeros((cap,), jnp.bool_)
-                eq = jnp.ones((cap,), jnp.bool_)
-                for k, bv in zip(keys, b):
-                    kv = jnp.uint64(bv)
-                    gt = gt | (eq & (k > kv))
-                    eq = eq & (k == kv)
-                dest = dest + (gt | eq).astype(jnp.int32)
+            K = jnp.stack(keys, axis=1)               # [cap, nk]
+            lt = K[:, None, :] < bounds[None]         # [cap, nb, nk]
+            eq = K[:, None, :] == bounds[None]
+            # prefix_eq[..., k] = all positions before k equal
+            prefix_eq = jnp.cumprod(
+                jnp.concatenate([jnp.ones_like(eq[..., :1]), eq[..., :-1]],
+                                axis=-1), axis=-1).astype(jnp.bool_)
+            lt_lex = jnp.any(prefix_eq & lt, axis=-1)  # [cap, nb]
+            dest = jnp.sum((~lt_lex).astype(jnp.int32), axis=1)
             live = batch.live_mask()
-            dest = jnp.where(live, dest, jnp.int32(self.out_partitions))
+            dest = jnp.where(live, dest, jnp.int32(n_out))
             order = jnp.lexsort((dest,)).astype(jnp.int32)
             out = gather_batch(batch, order, batch.num_rows)
             counts = jax.ops.segment_sum(
                 live.astype(jnp.int32), dest,
-                num_segments=self.out_partitions + 1)[:self.out_partitions]
+                num_segments=n_out + 1)[:n_out]
             return out, counts
 
-        self._route_cache = {}
-
         def routed(bucket: int, boundaries: tuple):
-            key = (bucket, boundaries)
-            if key not in self._route_cache:
-                self._route_cache[key] = jax.jit(
-                    _p(route, boundaries=boundaries, bucket=bucket))
-            return self._route_cache[key]
+            n_keys = len(boundaries[0]) if boundaries else 1
+            bounds = jnp.asarray(
+                np.array(boundaries, np.uint64).reshape(-1, n_keys))
+            fn = shared_jit(f"{plan_key}|route|{bucket}|{bounds.shape}",
+                            lambda: _p(route, bucket=bucket))
+            return lambda b: fn(b, bounds)
 
         self._routed = routed
 

@@ -27,13 +27,15 @@ class TpuSortExec(TpuExec):
                  child: TpuExec):
         super().__init__((child,), child.schema)
         self.orders = tuple(orders)
-        from functools import lru_cache
+        from spark_rapids_tpu.plan.execs.base import (
+            exprs_cache_key, schema_cache_key, shared_jit)
 
-        @lru_cache(maxsize=16)
-        def jitted(bucket: int):
+        orders = self.orders   # no self-capture (cache pins the exec tree)
+
+        def make_run(bucket: int):
             def run(batch: ColumnarBatch) -> ColumnarBatch:
                 ctx = EvalContext(batch)
-                key_cols = tuple(e.eval(ctx) for e, _ in self.orders)
+                key_cols = tuple(e.eval(ctx) for e, _ in orders)
                 work = ColumnarBatch(
                     tuple(batch.columns) + key_cols, batch.num_rows,
                     Schema(tuple(batch.schema.names) +
@@ -43,15 +45,18 @@ class TpuSortExec(TpuExec):
                 nbase = len(batch.schema)
                 idx = sort_indices(
                     work, list(range(nbase, nbase + len(key_cols))),
-                    [o for _, o in self.orders], string_max_bytes=bucket)
+                    [o for _, o in orders], string_max_bytes=bucket)
                 sorted_work = gather_batch(work, idx, batch.num_rows)
                 return ColumnarBatch(sorted_work.columns[:nbase],
                                      batch.num_rows, batch.schema)
-            return jax.jit(run)
+            return run
 
-        self._jitted = jitted
-        self._run = lambda b: jitted(
-            string_key_bucket(b, [e for e, _ in self.orders]))(b)
+        key = (f"sort|{schema_cache_key(child.schema)}|"
+               f"{exprs_cache_key(e for e, _ in self.orders)}|"
+               f"{','.join(f'{o.ascending}:{o.nulls_first}' for _, o in self.orders)}")
+        self._run = lambda b, _k=key: shared_jit(
+            f"{_k}|{(bkt := string_key_bucket(b, [e for e, _ in self.orders]))}",
+            lambda: make_run(bkt))(b)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         batches = list(self.children[0].execute_partition(idx))

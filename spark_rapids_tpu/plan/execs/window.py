@@ -35,18 +35,14 @@ def _unwrap(e: Expression) -> WindowExpression:
     return e.child if isinstance(e, Alias) else e
 
 
-class TpuWindowExec(TpuExec):
-    def __init__(self, window_exprs: Sequence[Expression], child: TpuExec,
-                 schema: Schema):
-        super().__init__((child,), schema)
-        self.window_exprs = tuple(window_exprs)
-        self.spec = _unwrap(self.window_exprs[0]).spec
-        from functools import lru_cache, partial as _p
-        self._run_by_bucket = lru_cache(maxsize=16)(
-            lambda bucket: jax.jit(_p(self._step, string_bucket=bucket)))
-        self._run = lambda b: self._run_by_bucket(string_key_bucket(
-            b, list(self.spec.partition_by)
-            + [e for e, _ in self.spec.order_by]))(b)
+class _WindowDeviceSpec:
+    """Device-step parameters + pure step functions, detached from the exec
+    so shared_jit-cached steps never pin the exec tree (see base.shared_jit)."""
+
+    def __init__(self, window_exprs, spec, schema):
+        self.window_exprs = window_exprs
+        self.spec = spec
+        self.schema = schema
 
     def _step(self, batch: ColumnarBatch,
               string_bucket: int = 0) -> ColumnarBatch:
@@ -174,6 +170,24 @@ class TpuWindowExec(TpuExec):
             None if frame.start is None else -frame.start,
             frame.end, sum_dt)
         return from_sum_count(s, n)
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, window_exprs: Sequence[Expression], child: TpuExec,
+                 schema: Schema):
+        super().__init__((child,), schema)
+        self.window_exprs = tuple(window_exprs)
+        self.spec = _unwrap(self.window_exprs[0]).spec
+        dspec = _WindowDeviceSpec(self.window_exprs, self.spec, schema)
+        from functools import partial as _p
+        from spark_rapids_tpu.plan.execs.base import (
+            exprs_cache_key, schema_cache_key, shared_jit)
+        key = (f"window|{schema_cache_key(child.schema)}|"
+               f"{schema_cache_key(schema)}|"
+               f"{exprs_cache_key(self.window_exprs)}")
+        self._run = lambda b, _k=key: shared_jit(
+            f"{_k}|{(bkt := string_key_bucket(b, list(self.spec.partition_by) + [e for e, _ in self.spec.order_by]))}",
+            lambda: _p(dspec._step, string_bucket=bkt))(b)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         merged = coalesce_to_one(list(self.children[0].execute_partition(idx)))

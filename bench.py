@@ -22,6 +22,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 CHILD_ENV = "SPARK_RAPIDS_TPU_BENCH_CHILD"
 N_ROWS = int(os.environ.get("SPARK_RAPIDS_TPU_BENCH_ROWS", 2_000_000))
@@ -121,15 +122,18 @@ def _try_backend(backend: str, timeout_s: int):
     return None, f"{backend}: no JSON line in output"
 
 
-def main() -> None:
-    # child mode only when OUR parent set the marker (backend@parent_pid);
-    # a leftover exported var must not bypass the timeout/fallback harness
+def _child_mode() -> Optional[str]:
+    """Backend name when OUR parent spawned us (backend@parent_pid); a
+    leftover exported var must not bypass the timeout/fallback harness."""
     child = os.environ.pop(CHILD_ENV, None)
     if child and "@" in child:
         backend, _, pid = child.partition("@")
         if pid == str(os.getppid()):
-            _child_main(backend)
-            return
+            return backend
+    return None
+
+
+def main() -> None:
 
     errors = []
     for backend, timeout_s in (("tpu", TPU_TIMEOUT_S), ("cpu", CPU_TIMEOUT_S)):
@@ -153,6 +157,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    _backend = _child_mode()
+    if _backend is not None:
+        # child: crash loudly (rc!=0) so the parent falls back to the next
+        # backend — a swallowed child error would read as a valid result
+        _child_main(_backend)
+        sys.exit(0)
     try:
         main()
     except Exception as e:  # noqa: BLE001 — resilience contract, see module doc

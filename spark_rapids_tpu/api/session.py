@@ -31,8 +31,15 @@ def _to_expr(e) -> Expression:
 
 
 class TpuSession:
-    def __init__(self, conf: Optional[Dict[str, str]] = None):
+    def __init__(self, conf: Optional[Dict[str, str]] = None, mesh=None):
+        """mesh: optional jax.sharding.Mesh.  With
+        spark.rapids.shuffle.mode=ICI, supported queries execute SPMD over
+        the mesh as one XLA program with all-to-all shuffle collectives
+        (parallel/stage.py); unsupported plan shapes fall back to the
+        task-parallel single-device engine, mirroring the reference's
+        shuffle-manager mode switch."""
         self.conf = RapidsConf(conf or {})
+        self.mesh = mesh
 
     def set_conf(self, key: str, value) -> None:
         self.conf = self.conf.with_overrides(**{key: value})
@@ -186,6 +193,20 @@ class DataFrame:
     def collect(self) -> List[tuple]:
         if self.session.conf.sql_enabled:
             exec_plan, _ = plan_query(self.plan, self.session.conf)
+            if (self.session.conf.shuffle_mode == "ICI"
+                    and self.session.mesh is not None):
+                from spark_rapids_tpu.parallel.stage import (
+                    IciQueryExecutor, UnsupportedSpmd)
+                from spark_rapids_tpu.plan.cpu_engine import CpuTable
+                try:
+                    shards = IciQueryExecutor(
+                        self.session.mesh).execute(exec_plan)
+                    rows: List[tuple] = []
+                    for b in shards:
+                        rows.extend(CpuTable.from_batch(b).rows())
+                    return rows
+                except UnsupportedSpmd:
+                    pass   # mode switch: fall back to the task engine
             return TpuEngine(self.session.conf).collect(exec_plan)
         return CpuEngine(self.session.conf.shuffle_partitions).collect(self.plan)
 

@@ -22,7 +22,6 @@ schemas shard — not just fixed-width demo columns.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -183,9 +182,6 @@ def ici_exchange(
     This is the standalone entry used by tests and the transport; the stage
     compiler inlines exchange_shard_step directly into fused stage programs.
     """
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as PS
-
     axis = axis_name or mesh.axis_names[0]
     P = mesh.devices.size
     assert len(shards) == P, (len(shards), P)

@@ -214,11 +214,13 @@ def string_key_bucket(batch, exprs) -> int:
     (one tiny device sync per string key; 0 when no string keys).  The
     planner restricts string keys to plain column refs so the bucket is
     computable before the jitted kernel runs."""
-    from spark_rapids_tpu.expressions.core import BoundReference
+    from spark_rapids_tpu.expressions.core import Alias, BoundReference
     from spark_rapids_tpu.kernels import strings as SK
     m = 0
     has_string = False
     for e in exprs:
+        while isinstance(e, Alias):
+            e = e.child
         if isinstance(e, BoundReference) and e.dtype.variable_width:
             has_string = True
             m = max(m, int(SK.max_live_string_bytes(

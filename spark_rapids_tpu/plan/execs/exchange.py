@@ -29,8 +29,22 @@ from spark_rapids_tpu.kernels.selection import (
     gather_batch,
 )
 from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_split
-from spark_rapids_tpu.memory.spill import SpillableBatchHandle, make_spillable
 from spark_rapids_tpu.plan.execs.base import TpuExec, string_key_bucket, timed
+
+
+def append_key_columns(batch: ColumnarBatch, keys):
+    """Evaluate partition-key expressions and append them as columns;
+    returns (work_batch, key ordinals).  Shared by the task-engine slice
+    step and the SPMD stage compiler."""
+    ctx = EvalContext(batch)
+    key_cols = tuple(k.eval(ctx) for k in keys)
+    work = ColumnarBatch(
+        tuple(batch.columns) + key_cols, batch.num_rows,
+        Schema(tuple(batch.schema.names) +
+               tuple(f"_pk{i}" for i in range(len(key_cols))),
+               tuple(batch.schema.dtypes) +
+               tuple(c.dtype for c in key_cols)))
+    return work, list(range(len(batch.schema), len(work.schema)))
 
 
 class TpuShuffleExchangeExec(TpuExec):
@@ -56,8 +70,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.writer_threads = writer_threads
         self.codec = codec
         self._lock = threading.Lock()
-        self._materialized: Optional[List[List[SpillableBatchHandle]]] = None
-        self._wire: Optional[List[List[bytes]]] = None
+        self._transport = None   # built lazily per query (the SPI seam)
 
         keys_t, n_out = self.keys, self.out_partitions  # no self-capture
 
@@ -66,17 +79,9 @@ class TpuShuffleExchangeExec(TpuExec):
             + per-partition counts."""
             if not keys_t:
                 return round_robin_partition(batch, n_out)
-            ctx = EvalContext(batch)
-            key_cols = tuple(k.eval(ctx) for k in keys_t)
-            work = ColumnarBatch(
-                tuple(batch.columns) + key_cols, batch.num_rows,
-                Schema(tuple(batch.schema.names) +
-                       tuple(f"_pk{i}" for i in range(len(key_cols))),
-                       tuple(batch.schema.dtypes) +
-                       tuple(c.dtype for c in key_cols)))
+            work, key_idx = append_key_columns(batch, keys_t)
             reordered, counts = hash_partition(
-                work, list(range(len(batch.schema), len(work.schema))),
-                n_out, string_max_bytes=string_bucket)
+                work, key_idx, n_out, string_max_bytes=string_bucket)
             # drop the key columns again
             out = ColumnarBatch(reordered.columns[:len(batch.schema)],
                                 reordered.num_rows, batch.schema)
@@ -117,53 +122,28 @@ class TpuShuffleExchangeExec(TpuExec):
                                              jnp.int32(cnt), out_capacity=cap)
                         yield p, piece
 
-    def _materialize(self) -> List[List[SpillableBatchHandle]]:
+    def _materialize(self):
+        """Run the map side once, writing slices through the transport SPI
+        (RapidsShuffleTransport.scala:303 analog — the data plane is
+        pluggable; this exec never touches its storage)."""
+        from spark_rapids_tpu.shuffle.transport import make_transport
         with self._lock:
-            if self._materialized is not None:
-                return self._materialized
-            buckets: List[List[SpillableBatchHandle]] = [
-                [] for _ in range(self.out_partitions)]
-            for p, piece in self._slices():
-                buckets[p].append(make_spillable(piece))
-            self._materialized = buckets
-            return buckets
-
-    def _materialize_wire(self) -> List[List[bytes]]:
-        """MULTITHREADED writer: serialize slices on a thread pool."""
-        from concurrent.futures import ThreadPoolExecutor
-        from spark_rapids_tpu.shuffle.serializer import serialize_batch
-        with self._lock:
-            if self._wire is not None:
-                return self._wire
-            buckets: List[List[bytes]] = [[] for _ in range(self.out_partitions)]
-            with ThreadPoolExecutor(max_workers=self.writer_threads) as pool:
-                futures = []
-                for p, piece in self._slices():
-                    futures.append((p, pool.submit(
-                        serialize_batch, piece, self.codec)))
-                for p, fut in futures:
-                    buckets[p].append(fut.result())
-            self._wire = buckets
-            return buckets
+            if self._transport is None:
+                t = make_transport(self.mode, self.out_partitions,
+                                   self.schema, self.writer_threads,
+                                   self.codec)
+                t.write(self._slices())
+                self._transport = t
+            return self._transport
 
     # -- reduce side --------------------------------------------------------
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
-        if self.mode == "MULTITHREADED":
-            from spark_rapids_tpu.shuffle.serializer import merge_batches
-            buffers = self._materialize_wire()[idx]
-            if not buffers:
-                return
-            with timed(self.op_time):
-                out = merge_batches(buffers, self.schema)
-            self.output_rows.add(out.num_rows)
-            yield self._count_out(out)
+        transport = self._materialize()
+        with timed(self.op_time):
+            batches = transport.read(idx)
+        if not batches:
             return
-        buckets = self._materialize()
-        handles = buckets[idx]
-        if not handles:
-            return
-        batches = [h.materialize() for h in handles]
         if len(batches) == 1:
             out = batches[0]
         else:
@@ -174,12 +154,9 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def cleanup(self) -> None:
         with self._lock:
-            if self._materialized is not None:
-                for bucket in self._materialized:
-                    for h in bucket:
-                        h.close()
-                self._materialized = None
-            self._wire = None
+            if self._transport is not None:
+                self._transport.cleanup()
+                self._transport = None
         super().cleanup()
 
     def describe(self):

@@ -19,6 +19,26 @@ from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_spl
 from spark_rapids_tpu.plan.execs.base import TpuExec, string_key_bucket, timed
 
 
+def sort_step(orders, batch: ColumnarBatch, bucket: int) -> ColumnarBatch:
+    """Pure device sort of one batch by `orders` (shared by the task-engine
+    exec and the SPMD stage compiler — one body, two engines)."""
+    ctx = EvalContext(batch)
+    key_cols = tuple(e.eval(ctx) for e, _ in orders)
+    work = ColumnarBatch(
+        tuple(batch.columns) + key_cols, batch.num_rows,
+        Schema(tuple(batch.schema.names) +
+               tuple(f"_sk{i}" for i in range(len(key_cols))),
+               tuple(batch.schema.dtypes) +
+               tuple(c.dtype for c in key_cols)))
+    nbase = len(batch.schema)
+    idx = sort_indices(
+        work, list(range(nbase, nbase + len(key_cols))),
+        [o for _, o in orders], string_max_bytes=bucket)
+    sorted_work = gather_batch(work, idx, batch.num_rows)
+    return ColumnarBatch(sorted_work.columns[:nbase],
+                         batch.num_rows, batch.schema)
+
+
 class TpuSortExec(TpuExec):
     """Sorts each partition (planner puts a single-partition exchange below
     for global sorts; range partitioning is the scalable follow-on)."""
@@ -34,21 +54,7 @@ class TpuSortExec(TpuExec):
 
         def make_run(bucket: int):
             def run(batch: ColumnarBatch) -> ColumnarBatch:
-                ctx = EvalContext(batch)
-                key_cols = tuple(e.eval(ctx) for e, _ in orders)
-                work = ColumnarBatch(
-                    tuple(batch.columns) + key_cols, batch.num_rows,
-                    Schema(tuple(batch.schema.names) +
-                           tuple(f"_sk{i}" for i in range(len(key_cols))),
-                           tuple(batch.schema.dtypes) +
-                           tuple(c.dtype for c in key_cols)))
-                nbase = len(batch.schema)
-                idx = sort_indices(
-                    work, list(range(nbase, nbase + len(key_cols))),
-                    [o for _, o in orders], string_max_bytes=bucket)
-                sorted_work = gather_batch(work, idx, batch.num_rows)
-                return ColumnarBatch(sorted_work.columns[:nbase],
-                                     batch.num_rows, batch.schema)
+                return sort_step(orders, batch, bucket)
             return run
 
         key = (f"sort|{schema_cache_key(child.schema)}|"

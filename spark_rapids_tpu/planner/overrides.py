@@ -105,6 +105,8 @@ def _key_expr_ok(e: "E.Expression") -> bool:
     if not _dtype_ok(dt):
         return False
     if dt.variable_width:
+        while isinstance(e, E.Alias):
+            e = e.child
         return isinstance(e, E.BoundReference)
     return True
 
@@ -439,7 +441,10 @@ class PlanMeta:
     def _exchange(self, nparts, keys, child) -> TpuExec:
         mode = self.conf.shuffle_mode
         if mode not in ("CACHE_ONLY", "MULTITHREADED"):
-            mode = "CACHE_ONLY"   # ICI mode is planned per-stage, not here yet
+            # ICI mode executes whole queries SPMD (parallel/stage.py inlines
+            # the all-to-all into the program); when a plan falls back to the
+            # task engine, its exchanges run CACHE_ONLY
+            mode = "CACHE_ONLY"
         return TpuShuffleExchangeExec(
             nparts, keys, child, mode=mode,
             writer_threads=self.conf.shuffle_writer_threads,

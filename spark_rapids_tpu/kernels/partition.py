@@ -29,14 +29,19 @@ def hash_partition(
     key_cols: Sequence[int],
     num_partitions: int,
     string_max_bytes: Optional[int] = None,
+    seed: int = hash_kernels.DEFAULT_SEED,
 ) -> Tuple[ColumnarBatch, jax.Array]:
     """Returns (reordered_batch, partition_row_counts[int32 num_partitions]).
 
     Rows are stably reordered so partition p occupies rows
     [offsets[p], offsets[p+1]) where offsets = exclusive cumsum of counts.
-    Matches Spark HashPartitioning routing bit-for-bit (murmur3 seed 42,
-    pmod), which is required for CPU/TPU shuffle interop and the
-    differential oracle.
+    With the default seed it matches Spark HashPartitioning routing
+    bit-for-bit (murmur3 seed 42, pmod), which is required for CPU/TPU
+    shuffle interop and the differential oracle.  Out-of-core operators
+    sub-partition with a DIFFERENT seed so re-partitioning data that already
+    arrived through a seed-42 exchange still spreads across buckets
+    (the reference's repartition level discipline,
+    GpuAggregateExec.scala:290 / GpuSubPartitionHashJoin.scala).
 
     string_max_bytes=None derives the bucket from the data (host sync);
     routing is bit-exactness-critical so an undersized bucket is never
@@ -46,7 +51,8 @@ def hash_partition(
         string_max_bytes = strkern.live_string_bucket_for_batch(batch, key_cols)
     live = batch.live_mask()
     h = hash_kernels.murmur3_hash(
-        [batch.columns[ci] for ci in key_cols], string_max_bytes=string_max_bytes
+        [batch.columns[ci] for ci in key_cols], seed=seed,
+        string_max_bytes=string_max_bytes
     )
     part = hash_kernels.pmod(h, num_partitions)
     part = jnp.where(live, part, jnp.int32(num_partitions))  # padding last

@@ -51,17 +51,14 @@ REPLICATED = "replicated"  # every device holds identical full data
 
 
 class _Caps:
-    """Per-node static capacity plan + overflow feedback."""
+    """Per-node static capacity plan (overflow feedback flows through the
+    builder's feedback list, which execute() consumes)."""
 
     def __init__(self):
         self.caps: Dict[str, int] = {}
-        self.feedback: List[Tuple[str, jax.Array]] = []
 
     def get(self, key: str, default: int) -> int:
         return self.caps.setdefault(key, default)
-
-    def report(self, key: str, required: jax.Array):
-        self.feedback.append((key, required))
 
 
 class IciQueryExecutor:

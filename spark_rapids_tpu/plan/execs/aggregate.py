@@ -309,7 +309,7 @@ class TpuHashAggregateExec(TpuExec):
                  agg_exprs: Sequence[Expression],
                  aggregates: List[AggregateFunction],
                  child: TpuExec, schema: Schema, mode: str = "complete",
-                 target_capacity: int = 1 << 16):
+                 target_capacity: int = 1 << 20):
         self.group_exprs = tuple(group_exprs)
         self.agg_exprs = tuple(agg_exprs)
         self.aggregates = list(aggregates)
@@ -400,10 +400,81 @@ class TpuHashAggregateExec(TpuExec):
                     partials = [self._identity_partial()]
                 else:
                     return
+        total = sum(p.capacity for p in partials)
+        if total > self.target_capacity:
+            yield from self._execute_out_of_core(partials, total)
+            return
+        with timed(self.op_time):
             merged = self._merge_partials(partials)
             out = with_retry_no_split(lambda: self._jit_finalize(merged))
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
+
+    def _execute_out_of_core(self, partials: List[ColumnarBatch],
+                             total: int) -> Iterator[ColumnarBatch]:
+        """Merge a partial set larger than one capacity bucket.
+
+        Grouped: hash-repartition the partials on the grouping keys (with
+        the sub-partition seed, NOT the shuffle seed) into spillable
+        buckets and merge+finalize each bucket independently — key-disjoint
+        buckets make the union of bucket outputs exactly the in-core
+        answer.  Reference: repartition-based aggregation on oversized
+        merge sets, GpuAggregateExec.scala:290.
+
+        Global (no keys): tree-merge in chunks of target_capacity rows.
+        """
+        from spark_rapids_tpu.memory.spill import make_spillable
+        from spark_rapids_tpu.plan.execs.out_of_core import (
+            close_all, num_sub_buckets, sub_partition_spillable)
+
+        nkeys = len(self.group_exprs)
+        if nkeys == 0:
+            # chunks bounded by accumulated ROW capacity, not batch count:
+            # each merge's concat stays within one capacity bucket
+            while len(partials) > 1:
+                nxt, group, acc = [], [], 0
+                for p in partials + [None]:
+                    if p is not None and (
+                            not group
+                            or acc + p.capacity <= self.target_capacity):
+                        group.append(p)
+                        acc += p.capacity
+                        continue
+                    with timed(self.op_time):
+                        nxt.append(self._merge_partials(group))
+                    if p is not None:
+                        group, acc = [p], p.capacity
+                partials = nxt
+            with timed(self.op_time):
+                out = with_retry_no_split(
+                    lambda: self._jit_finalize(partials[0]))
+            self.output_rows.add(out.num_rows)
+            yield self._count_out(out)
+            return
+
+        n_b = num_sub_buckets(total, self.target_capacity)
+        with timed(self.op_time):
+            handles = [make_spillable(p) for p in partials]
+            del partials
+            buckets = sub_partition_spillable(
+                (h.release_device_copy() for h in handles),
+                list(range(nkeys)), n_b, self.partial_schema)
+        try:
+            for q in buckets:
+                if not q:
+                    continue
+                with timed(self.op_time):
+                    batches = [h.materialize() for h in q]
+                    merged = self._merge_partials(batches)
+                    for h in q:
+                        h.unpin()
+                        h.close()
+                    out = with_retry_no_split(
+                        lambda: self._jit_finalize(merged))
+                self.output_rows.add(out.num_rows)
+                yield self._count_out(out)
+        finally:
+            close_all(buckets)
 
     def describe(self):
         keys = ", ".join(map(repr, self.group_exprs))

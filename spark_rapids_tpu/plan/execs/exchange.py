@@ -62,13 +62,14 @@ class TpuShuffleExchangeExec(TpuExec):
     def __init__(self, num_partitions: int, keys: Sequence[Expression],
                  child: TpuExec, schema: Optional[Schema] = None,
                  mode: str = "CACHE_ONLY", writer_threads: int = 4,
-                 codec: str = "none"):
+                 codec: str = "none", target_rows: int = 1 << 20):
         super().__init__((child,), schema or child.schema)
         self.out_partitions = num_partitions
         self.keys = tuple(keys)
         self.mode = mode
         self.writer_threads = writer_threads
         self.codec = codec
+        self.target_rows = max(int(target_rows), 1)
         self._lock = threading.Lock()
         self._transport = None   # built lazily per query (the SPI seam)
 
@@ -139,18 +140,34 @@ class TpuShuffleExchangeExec(TpuExec):
     # -- reduce side --------------------------------------------------------
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        """Reduce side: coalesce fetched slices up to the batch target and
+        stream them (GpuShuffleCoalesceExec.scala:72's target-size goal) —
+        an oversized reduce partition arrives as several batches so the
+        downstream operator's out-of-core path can engage instead of one
+        unbounded concat."""
         transport = self._materialize()
         with timed(self.op_time):
             batches = transport.read(idx)
         if not batches:
             return
-        if len(batches) == 1:
-            out = batches[0]
-        else:
-            cap = round_up_pow2(max(sum(b.capacity for b in batches), 1))
-            out, _ = concat_batches_device(batches, cap)
-        self.output_rows.add(out.num_rows)
-        yield self._count_out(out)
+        group: List[ColumnarBatch] = []
+        acc = 0
+        for b in batches + [None]:
+            if b is not None and (not group or acc + b.capacity <= self.target_rows):
+                group.append(b)
+                acc += b.capacity
+                continue
+            with timed(self.op_time):
+                if len(group) == 1:
+                    out = group[0]
+                else:
+                    cap = round_up_pow2(max(acc, 1))
+                    out, _ = concat_batches_device(group, cap)
+            self.output_rows.add(out.num_rows)
+            yield self._count_out(out)
+            if b is not None:
+                group = [b]
+                acc = b.capacity
 
     def cleanup(self) -> None:
         with self._lock:

@@ -118,12 +118,21 @@ class _JoinKernel:
 
 
 class TpuShuffledHashJoinExec(TpuExec):
+    """Joins co-partitioned sides; when a partition's combined rows exceed
+    ``target_rows``, both sides are hash-sub-partitioned on the join keys
+    (with the sub-partition seed) into spillable co-buckets joined pairwise
+    — equal keys always share a bucket, so the union of bucket outputs is
+    exactly the single-batch join for every equi-join type.  Reference:
+    GpuSubPartitionHashJoin.scala."""
+
     def __init__(self, left: TpuExec, right: TpuExec,
                  left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
-                 join_type: str, schema: Schema):
+                 join_type: str, schema: Schema,
+                 target_rows: int = 1 << 20):
         super().__init__((left, right), schema)
         self.join_type = join_type
+        self.target_rows = max(int(target_rows), 1)
         # keys are bound refs into each side's schema; resolve ordinals
         self.left_key_idx = [self._ordinal(k, left.schema) for k in left_keys]
         self.right_key_idx = [self._ordinal(k, right.schema) for k in right_keys]
@@ -140,26 +149,73 @@ class TpuShuffledHashJoinExec(TpuExec):
     def num_partitions(self) -> int:
         return self.children[0].num_partitions()
 
-    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
-        left = coalesce_to_one(list(self.children[0].execute_partition(idx)))
-        right = coalesce_to_one(list(self.children[1].execute_partition(idx)))
+    def _join_pair(self, left: Optional[ColumnarBatch],
+                   right: Optional[ColumnarBatch]) -> Optional[ColumnarBatch]:
+        """Join one (possibly absent) batch pair with the join type's
+        empty-side semantics; returns None when no output is possible."""
         if left is None and right is None:
-            return
+            return None
         if left is None:
             if self.join_type in ("inner", "left", "left_semi", "left_anti",
                                   "cross"):
-                return
+                return None
             left = ColumnarBatch.empty(self.children[0].schema)
         if right is None:
-            if self.join_type in ("inner", "right", "cross"):
-                return
-            if self.join_type == "left_semi":
-                return
+            if self.join_type in ("inner", "right", "cross", "left_semi"):
+                return None
             right = ColumnarBatch.empty(self.children[1].schema)
+        return self._kernel(left, right)
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        left_batches = list(self.children[0].execute_partition(idx))
+        right_batches = list(self.children[1].execute_partition(idx))
+        total = (sum(b.capacity for b in left_batches)
+                 + sum(b.capacity for b in right_batches))
+        if (total > self.target_rows and self.join_type != "cross"
+                and self.left_key_idx):
+            yield from self._execute_out_of_core(left_batches, right_batches,
+                                                 total)
+            return
         with timed(self.op_time):
-            out = self._kernel(left, right)
+            out = self._join_pair(coalesce_to_one(left_batches),
+                                  coalesce_to_one(right_batches))
+        if out is None:
+            return
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
+
+    def _execute_out_of_core(self, left_batches, right_batches,
+                             total) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.plan.execs.out_of_core import (
+            close_all, num_sub_buckets, sub_partition_spillable)
+        n_b = num_sub_buckets(total, self.target_rows)
+        with timed(self.op_time):
+            lbuckets = sub_partition_spillable(
+                iter(left_batches), self.left_key_idx, n_b,
+                self.children[0].schema)
+            del left_batches
+            rbuckets = sub_partition_spillable(
+                iter(right_batches), self.right_key_idx, n_b,
+                self.children[1].schema)
+            del right_batches
+        try:
+            for lq, rq in zip(lbuckets, rbuckets):
+                with timed(self.op_time):
+                    left = (coalesce_to_one([h.materialize() for h in lq])
+                            if lq else None)
+                    right = (coalesce_to_one([h.materialize() for h in rq])
+                             if rq else None)
+                    out = self._join_pair(left, right)
+                    for h in lq + rq:
+                        h.unpin()
+                        h.close()
+                if out is None:
+                    continue
+                self.output_rows.add(out.num_rows)
+                yield self._count_out(out)
+        finally:
+            close_all(lbuckets)
+            close_all(rbuckets)
 
     def describe(self):
         return (f"TpuShuffledHashJoin[{self.join_type}, "
@@ -173,12 +229,14 @@ class TpuBroadcastHashJoinExec(TpuExec):
     def __init__(self, left: TpuExec, right: TpuExec,
                  left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
-                 join_type: str, schema: Schema):
+                 join_type: str, schema: Schema,
+                 target_rows: int = 1 << 20):
         assert join_type in ("inner", "left", "left_semi", "left_anti",
                              "cross"), \
             "broadcast build side must be on the null-extending side"
         super().__init__((left, right), schema)
         self.join_type = join_type
+        self.target_rows = max(int(target_rows), 1)
         self.left_key_idx = [TpuShuffledHashJoinExec._ordinal(k, left.schema)
                              for k in left_keys]
         self.right_key_idx = [TpuShuffledHashJoinExec._ordinal(k, right.schema)
@@ -205,17 +263,33 @@ class TpuBroadcastHashJoinExec(TpuExec):
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         build = self._build_side()
-        left = coalesce_to_one(list(self.children[0].execute_partition(idx)))
-        if left is None:
+        stream = list(self.children[0].execute_partition(idx))
+        if not stream:
             return
         if build is None:
             if self.join_type in ("inner", "cross", "left_semi"):
                 return
             build = ColumnarBatch.empty(self.children[1].schema)
-        with timed(self.op_time):
-            out = self._kernel(left, build)
-        self.output_rows.add(out.num_rows)
-        yield self._count_out(out)
+        # every broadcastable join type decomposes by stream-side rows, so
+        # an oversized stream partition is joined chunk-at-a-time instead
+        # of coalescing past the batch target (the reference streams the
+        # probe side per batch, GpuHashJoin.scala:1868)
+        chunks: List[List[ColumnarBatch]] = [[]]
+        acc = 0
+        for b in stream:
+            if chunks[-1] and acc + b.capacity > self.target_rows:
+                chunks.append([])
+                acc = 0
+            chunks[-1].append(b)
+            acc += b.capacity
+        for group in chunks:
+            if not group:
+                continue
+            left = coalesce_to_one(group)
+            with timed(self.op_time):
+                out = self._kernel(left, build)
+            self.output_rows.add(out.num_rows)
+            yield self._count_out(out)
 
     def cleanup(self) -> None:
         with self._lock:

@@ -1,0 +1,131 @@
+"""Out-of-core substrate: process partitions larger than one capacity bucket.
+
+The reference's "any input size on fixed memory" property (SURVEY §5.7)
+comes from three operator-level mechanisms, each reproduced here in TPU
+terms on top of the spill/retry substrate:
+
+  * aggregate bucket-overflow repartition (GpuAggregateExec.scala:290):
+    when the merge set is too big, hash-repartition it into sub-buckets
+    with a DIFFERENT hash seed and merge each bucket independently;
+  * sub-partitioned hash join (GpuSubPartitionHashJoin.scala): partition
+    both sides on the join keys into co-buckets and join pairwise;
+  * out-of-core sort (GpuSortExec.scala:137): the reference merge-sorts
+    spillable sorted runs; the TPU-first equivalent is a range-bucketed
+    distribution sort (sampled splitters, the same machinery as the range
+    exchange) — buckets are statically shaped, spillable, and sorted one
+    at a time, which maps onto XLA better than an N-way streaming merge.
+
+Every helper here keeps at most O(bucket) rows on device at a time; queued
+data lives in SpillableBatchHandles so the arena pressure callback can push
+it to host/disk.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import round_up_pow2
+from spark_rapids_tpu.kernels.partition import hash_partition
+from spark_rapids_tpu.kernels.selection import gather_batch
+from spark_rapids_tpu.memory.retry import with_retry_no_split
+from spark_rapids_tpu.memory.spill import SpillableBatchHandle, make_spillable
+
+# Sub-partitioning must NOT reuse the shuffle's routing seed (42): data on
+# one shuffle partition all has hash%P equal, so a same-seed repartition
+# would be degenerate.  The reference picks a new hash level per recursion;
+# one alternate seed suffices here because sub-partitioning never recurses
+# onto its own output with the same seed and bucket count.
+SUB_PARTITION_SEED = 0x5F3759DF
+
+
+def num_sub_buckets(total_rows: int, target_rows: int, cap: int = 256) -> int:
+    """Power-of-two bucket count so each bucket lands near target_rows."""
+    if target_rows <= 0:
+        return 1
+    need = (total_rows + target_rows - 1) // target_rows
+    return min(round_up_pow2(max(need, 1)), cap)
+
+
+def slice_by_counts(
+    reordered: ColumnarBatch, counts: jax.Array, num_buckets: int
+) -> List[Optional[ColumnarBatch]]:
+    """Slice a partition-ordered batch into per-bucket batches.
+
+    One host sync of `num_buckets` scalars decides each slice's static
+    capacity (pow2-bucketed so the gather kernels stay cached).  Empty
+    buckets yield None.
+    """
+    host_counts = np.asarray(counts)
+    offsets = np.zeros(num_buckets + 1, np.int64)
+    np.cumsum(host_counts, out=offsets[1:])
+    out: List[Optional[ColumnarBatch]] = []
+    for p in range(num_buckets):
+        cnt = int(host_counts[p])
+        if cnt == 0:
+            out.append(None)
+            continue
+        cap = round_up_pow2(cnt)
+        idx = jnp.arange(cap, dtype=jnp.int32) + jnp.int32(int(offsets[p]))
+        out.append(gather_batch(reordered, idx, jnp.int32(cnt),
+                                out_capacity=cap))
+    return out
+
+
+def _partition_step(schema: Schema, key_idx: Tuple[int, ...],
+                    num_buckets: int, string_bucket: int):
+    def run(batch: ColumnarBatch):
+        return hash_partition(
+            batch, list(key_idx), num_buckets,
+            string_max_bytes=string_bucket if string_bucket else 64,
+            seed=SUB_PARTITION_SEED)
+    return run
+
+
+def sub_partition_spillable(
+    batches: Iterator[ColumnarBatch],
+    key_idx: Sequence[int],
+    num_buckets: int,
+    schema: Schema,
+) -> List[List[SpillableBatchHandle]]:
+    """Hash-repartition a stream of batches into spillable bucket queues.
+
+    Processes one input batch at a time (device residency = one batch +
+    its reordering); slices go straight into spillable handles so queued
+    buckets can leave HBM under pressure.
+    """
+    from spark_rapids_tpu.kernels import strings as SK
+    from spark_rapids_tpu.plan.execs.base import schema_cache_key, shared_jit
+
+    key_idx = tuple(key_idx)
+    buckets: List[List[SpillableBatchHandle]] = [[] for _ in range(num_buckets)]
+    for batch in batches:
+        sb = 0
+        has_string = False
+        for ci in key_idx:
+            c = batch.columns[ci]
+            if c.is_string_like:
+                has_string = True
+                sb = max(sb, int(SK.max_live_string_bytes(c, batch.num_rows)))
+        string_bucket = SK.bucket_for(sb) if has_string else 0
+        fn = shared_jit(
+            f"subpart|{schema_cache_key(schema)}|{key_idx}|{num_buckets}"
+            f"|{string_bucket}",
+            lambda: _partition_step(schema, key_idx, num_buckets,
+                                    string_bucket))
+        reordered, counts = with_retry_no_split(lambda: fn(batch))
+        for p, piece in enumerate(slice_by_counts(reordered, counts,
+                                                  num_buckets)):
+            if piece is not None:
+                buckets[p].append(make_spillable(piece))
+    return buckets
+
+
+def close_all(buckets: List[List[SpillableBatchHandle]]) -> None:
+    for q in buckets:
+        for h in q:
+            h.close()
+        q.clear()

@@ -1,4 +1,4 @@
-"""Range-partitioned global sort.
+"""Range-partitioned global sort + the shared range-bucketing machinery.
 
 Reference: GpuRangePartitioner.scala + GpuSortExec — sample the sort keys,
 pick range boundaries, exchange rows so partition i holds keys < partition
@@ -12,11 +12,16 @@ order equals Spark's column order including direction (kernels/sort.py
 string keys contribute packed byte-chunk keys.  Row destinations come from
 lexicographic comparison against the (static, small) boundary list — B-1
 vectorized compares, no searchsorted-over-tuples needed.
+
+The module-level helpers (make_encoder / make_router / sample_boundaries)
+are shared with the out-of-core single-partition sort (plan/execs/sort.py),
+which uses the same bucketing as a distribution sort within one partition
+(the TPU answer to GpuSortExec.scala:137's merge sort).
 """
 from __future__ import annotations
 
 import threading
-from typing import Iterator, List, Optional, Sequence, Tuple
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +42,141 @@ from spark_rapids_tpu.plan.execs.sort import TpuSortExec
 SAMPLE_PER_PARTITION = 64
 
 
+def _encode_fn(orders: Tuple[Tuple[Expression, SortOrder], ...]):
+    def encode(batch: ColumnarBatch, bucket: int):
+        """Per-row encoded key arrays (most-significant first)."""
+        ctx = EvalContext(batch)
+        keys = []
+        for e, o in orders:
+            c = normalize_key_column(e.eval(ctx))
+            keys.append(_null_key(c, o).astype(jnp.uint64))
+            if c.is_string_like:
+                keys.extend(_string_data_keys(c, o, bucket))
+            else:
+                keys.append(_data_key_fixed(c, o))
+        return tuple(keys)
+    return encode
+
+
+def _plan_key(orders, schema: Schema, n_out: int) -> str:
+    from spark_rapids_tpu.plan.execs.base import (
+        exprs_cache_key, schema_cache_key)
+    return (f"rangesort|{n_out}|{schema_cache_key(schema)}|"
+            f"{exprs_cache_key(e for e, _ in orders)}|"
+            f"{','.join(f'{o.ascending}:{o.nulls_first}' for _, o in orders)}")
+
+
+def make_encoder(orders, schema: Schema):
+    """bucket -> jitted fn(batch) -> tuple of uint64 key arrays."""
+    from functools import partial as _p
+    from spark_rapids_tpu.plan.execs.base import shared_jit
+    orders = tuple(orders)
+    pk = _plan_key(orders, schema, 0)
+    encode = _encode_fn(orders)
+    return lambda b: shared_jit(f"{pk}|encode|{b}", lambda: _p(encode, bucket=b))
+
+
+def make_router(orders, schema: Schema, n_out: int):
+    """(bucket, boundaries) -> fn(batch) -> (reordered_batch, counts).
+
+    boundaries is a tuple of per-boundary uint64 tuples; it enters the
+    jitted function as a DYNAMIC array input so re-sampling never
+    recompiles.  Rows compare lexicographically against every boundary at
+    once; equal keys always land in the same bucket (ties never split),
+    which is what makes bucket-at-a-time sorting equivalent to a stable
+    sort of the whole input.
+    """
+    from functools import partial as _p
+    from spark_rapids_tpu.plan.execs.base import shared_jit
+    orders = tuple(orders)
+    pk = _plan_key(orders, schema, n_out)
+    encode = _encode_fn(orders)
+
+    def route(batch: ColumnarBatch, bounds: jax.Array, bucket: int):
+        keys = encode(batch, bucket)
+        K = jnp.stack(keys, axis=1)               # [cap, nk]
+        lt = K[:, None, :] < bounds[None]         # [cap, nb, nk]
+        eq = K[:, None, :] == bounds[None]
+        # prefix_eq[..., k] = all positions before k equal
+        prefix_eq = jnp.cumprod(
+            jnp.concatenate([jnp.ones_like(eq[..., :1]), eq[..., :-1]],
+                            axis=-1), axis=-1).astype(jnp.bool_)
+        lt_lex = jnp.any(prefix_eq & lt, axis=-1)  # [cap, nb]
+        dest = jnp.sum((~lt_lex).astype(jnp.int32), axis=1)
+        live = batch.live_mask()
+        dest = jnp.where(live, dest, jnp.int32(n_out))
+        order = jnp.lexsort((dest,)).astype(jnp.int32)
+        out = gather_batch(batch, order, batch.num_rows)
+        counts = jax.ops.segment_sum(
+            live.astype(jnp.int32), dest,
+            num_segments=n_out + 1)[:n_out]
+        return out, counts
+
+    def routed(bucket: int, boundaries: tuple):
+        n_keys = len(boundaries[0]) if boundaries else 1
+        bounds = jnp.asarray(
+            np.array(boundaries, np.uint64).reshape(-1, n_keys))
+        fn = shared_jit(f"{pk}|route|{bucket}|{bounds.shape}",
+                        lambda: _p(route, bucket=bucket))
+        return lambda b: fn(b, bounds)
+
+    return routed
+
+
+def sample_boundaries(batches: List[ColumnarBatch], orders, encoder,
+                      n_out: int):
+    """Sample encoded keys from every batch and pick n_out-1 splitters.
+    Returns (string_bucket, boundaries tuple)."""
+    bucket = 0
+    for b in batches:
+        bucket = max(bucket, string_key_bucket(b, [e for e, _ in orders]))
+    samples: List[np.ndarray] = []
+    n_keys = None
+    for b in batches:
+        keys = encoder(bucket)(b)
+        n_keys = len(keys)
+        cap = keys[0].shape[0]
+        stride = max(cap // SAMPLE_PER_PARTITION, 1)
+        idx = np.arange(0, cap, stride)
+        live = np.asarray(b.live_mask())[idx]
+        rows = np.stack([np.asarray(k)[idx] for k in keys], axis=1)
+        samples.append(rows[live])
+    if n_keys is None:
+        return bucket, ()
+    all_rows = (np.concatenate(samples) if samples
+                else np.zeros((0, n_keys), np.uint64))
+    if len(all_rows) == 0 or n_out == 1:
+        return bucket, ()
+    order = np.lexsort(tuple(all_rows[:, i]
+                             for i in range(n_keys - 1, -1, -1)))
+    sorted_rows = all_rows[order]
+    boundaries = []
+    for p in range(1, n_out):
+        pos = min(len(sorted_rows) - 1, (p * len(sorted_rows)) // n_out)
+        boundaries.append(tuple(int(x) for x in sorted_rows[pos]))
+    # dedupe (equal boundaries collapse partitions, still correct)
+    return bucket, tuple(dict.fromkeys(boundaries))
+
+
+def range_bucket_spillable(batches: Iterator[ColumnarBatch], orders,
+                           schema: Schema, n_out: int,
+                           sample_batches: List[ColumnarBatch],
+                           ) -> List[List[SpillableBatchHandle]]:
+    """Route a stream of batches into n_out spillable range buckets."""
+    encoder = make_encoder(orders, schema)
+    bucket, boundaries = sample_boundaries(sample_batches, orders, encoder,
+                                           n_out)
+    route = make_router(orders, schema, n_out)(bucket, boundaries)
+    from spark_rapids_tpu.plan.execs.out_of_core import slice_by_counts
+    buckets: List[List[SpillableBatchHandle]] = [[] for _ in range(n_out)]
+    for b in batches:
+        reordered, counts = with_retry_no_split(lambda: route(b))
+        for p, piece in enumerate(slice_by_counts(reordered, counts, n_out)):
+            if piece is not None:
+                buckets[p].append(make_spillable(piece))
+    return buckets
+
+
 class TpuRangeSortExec(TpuExec):
     """Global sort over N output partitions (range exchange + local sort)."""
 
@@ -49,104 +189,8 @@ class TpuRangeSortExec(TpuExec):
         self._buckets: Optional[List[List[SpillableBatchHandle]]] = None
         self._local_sort = TpuSortExec(self.orders, child)  # reuse its jit
 
-        orders = self.orders           # no self-capture (cache pins)
-        n_out = self.out_partitions
-
-        def encode(batch: ColumnarBatch, bucket: int):
-            """Per-row encoded key arrays (most-significant first)."""
-            ctx = EvalContext(batch)
-            keys = []
-            for e, o in orders:
-                c = normalize_key_column(e.eval(ctx))
-                keys.append(_null_key(c, o).astype(jnp.uint64))
-                if c.is_string_like:
-                    keys.extend(_string_data_keys(c, o, bucket))
-                else:
-                    keys.append(_data_key_fixed(c, o))
-            return tuple(keys)
-
-        from functools import partial as _p
-        from spark_rapids_tpu.plan.execs.base import (
-            exprs_cache_key, schema_cache_key, shared_jit)
-        plan_key = (f"rangesort|{self.out_partitions}|"
-                    f"{schema_cache_key(child.schema)}|"
-                    f"{exprs_cache_key(e for e, _ in self.orders)}|"
-                    f"{','.join(f'{o.ascending}:{o.nulls_first}' for _, o in self.orders)}")
-        self._encode_by_bucket = lambda b: shared_jit(
-            f"{plan_key}|encode|{b}", lambda: _p(encode, bucket=b))
-
-        def route(batch: ColumnarBatch, bounds: jax.Array, bucket: int):
-            """dest partition per row + reorder by dest (stable).
-
-            bounds is a DYNAMIC [n_bounds, n_keys] uint64 array (sampled per
-            query) so changing boundaries never recompiles; the comparison is
-            a vectorized lexicographic >= against every boundary at once."""
-            keys = encode(batch, bucket)
-            K = jnp.stack(keys, axis=1)               # [cap, nk]
-            lt = K[:, None, :] < bounds[None]         # [cap, nb, nk]
-            eq = K[:, None, :] == bounds[None]
-            # prefix_eq[..., k] = all positions before k equal
-            prefix_eq = jnp.cumprod(
-                jnp.concatenate([jnp.ones_like(eq[..., :1]), eq[..., :-1]],
-                                axis=-1), axis=-1).astype(jnp.bool_)
-            lt_lex = jnp.any(prefix_eq & lt, axis=-1)  # [cap, nb]
-            dest = jnp.sum((~lt_lex).astype(jnp.int32), axis=1)
-            live = batch.live_mask()
-            dest = jnp.where(live, dest, jnp.int32(n_out))
-            order = jnp.lexsort((dest,)).astype(jnp.int32)
-            out = gather_batch(batch, order, batch.num_rows)
-            counts = jax.ops.segment_sum(
-                live.astype(jnp.int32), dest,
-                num_segments=n_out + 1)[:n_out]
-            return out, counts
-
-        def routed(bucket: int, boundaries: tuple):
-            n_keys = len(boundaries[0]) if boundaries else 1
-            bounds = jnp.asarray(
-                np.array(boundaries, np.uint64).reshape(-1, n_keys))
-            fn = shared_jit(f"{plan_key}|route|{bucket}|{bounds.shape}",
-                            lambda: _p(route, bucket=bucket))
-            return lambda b: fn(b, bounds)
-
-        self._routed = routed
-
     def num_partitions(self) -> int:
         return self.out_partitions
-
-    # -- boundary sampling ---------------------------------------------------
-
-    def _sample_and_bucket(self, batches: List[ColumnarBatch]):
-        bucket = 0
-        for b in batches:
-            bucket = max(bucket, string_key_bucket(
-                b, [e for e, _ in self.orders]))
-        samples: List[np.ndarray] = []
-        n_keys = None
-        for b in batches:
-            keys = self._encode_by_bucket(bucket)(b)
-            n_keys = len(keys)
-            cap = keys[0].shape[0]
-            stride = max(cap // SAMPLE_PER_PARTITION, 1)
-            idx = np.arange(0, cap, stride)
-            live = np.asarray(b.live_mask())[idx]
-            rows = np.stack([np.asarray(k)[idx] for k in keys], axis=1)
-            samples.append(rows[live])
-        if n_keys is None:
-            return bucket, ()
-        all_rows = (np.concatenate(samples) if samples
-                    else np.zeros((0, n_keys), np.uint64))
-        if len(all_rows) == 0 or self.out_partitions == 1:
-            return bucket, ()
-        order = np.lexsort(tuple(all_rows[:, i]
-                                 for i in range(n_keys - 1, -1, -1)))
-        sorted_rows = all_rows[order]
-        boundaries = []
-        for p in range(1, self.out_partitions):
-            pos = min(len(sorted_rows) - 1,
-                      (p * len(sorted_rows)) // self.out_partitions)
-            boundaries.append(tuple(int(x) for x in sorted_rows[pos]))
-        # dedupe (equal boundaries collapse partitions, still correct)
-        return bucket, tuple(dict.fromkeys(boundaries))
 
     def _materialize(self) -> List[List[SpillableBatchHandle]]:
         with self._lock:
@@ -156,26 +200,12 @@ class TpuRangeSortExec(TpuExec):
             batches: List[ColumnarBatch] = []
             for p in range(child.num_partitions()):
                 batches.extend(child.execute_partition(p))
-            buckets: List[List[SpillableBatchHandle]] = [
-                [] for _ in range(self.out_partitions)]
             if batches:
-                bucket, boundaries = self._sample_and_bucket(batches)
-                route = self._routed(bucket, boundaries)
-                for b in batches:
-                    reordered, counts = with_retry_no_split(lambda: route(b))
-                    host_counts = np.asarray(counts)
-                    offsets = np.zeros(self.out_partitions + 1, np.int64)
-                    np.cumsum(host_counts, out=offsets[1:])
-                    for p in range(self.out_partitions):
-                        cnt = int(host_counts[p])
-                        if cnt == 0:
-                            continue
-                        cap = round_up_pow2(cnt)
-                        idx = jnp.arange(cap, dtype=jnp.int32) + \
-                            jnp.int32(offsets[p])
-                        piece = gather_batch(reordered, idx, jnp.int32(cnt),
-                                             out_capacity=cap)
-                        buckets[p].append(make_spillable(piece))
+                buckets = range_bucket_spillable(
+                    iter(batches), self.orders, child.schema,
+                    self.out_partitions, batches)
+            else:
+                buckets = [[] for _ in range(self.out_partitions)]
             self._buckets = buckets
             return buckets
 
@@ -186,6 +216,8 @@ class TpuRangeSortExec(TpuExec):
         with timed(self.op_time):
             merged = coalesce_to_one([h.materialize() for h in handles])
             out = with_retry_no_split(lambda: self._local_sort._run(merged))
+            for h in handles:
+                h.unpin()
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 

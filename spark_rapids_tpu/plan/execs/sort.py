@@ -41,12 +41,22 @@ def sort_step(orders, batch: ColumnarBatch, bucket: int) -> ColumnarBatch:
 
 class TpuSortExec(TpuExec):
     """Sorts each partition (planner puts a single-partition exchange below
-    for global sorts; range partitioning is the scalable follow-on)."""
+    for global sorts).
+
+    Out-of-core: when a partition's rows exceed ``target_rows``, the input
+    is range-bucketed with sampled splitters (the same machinery as the
+    range exchange) into spillable buckets that are sorted one at a time
+    and emitted in order — the TPU distribution-sort answer to the
+    reference's spillable-pending-queue merge sort (GpuSortExec.scala:137,
+    OutOfCoreBatch:241).  Ties never split across buckets, so the output
+    equals a stable sort of the concatenated input.
+    """
 
     def __init__(self, orders: Sequence[Tuple[Expression, SortOrder]],
-                 child: TpuExec):
+                 child: TpuExec, target_rows: int = 1 << 20):
         super().__init__((child,), child.schema)
         self.orders = tuple(orders)
+        self.target_rows = max(int(target_rows), 1)
         from spark_rapids_tpu.plan.execs.base import (
             exprs_cache_key, schema_cache_key, shared_jit)
 
@@ -68,15 +78,48 @@ class TpuSortExec(TpuExec):
         batches = list(self.children[0].execute_partition(idx))
         if not batches:
             return
+        total = sum(b.capacity for b in batches)
+        if total > self.target_rows:
+            yield from self._execute_out_of_core(batches, total)
+            return
         with timed(self.op_time):
             if len(batches) == 1:
                 merged = batches[0]
             else:
-                cap = round_up_pow2(max(sum(b.capacity for b in batches), 1))
+                cap = round_up_pow2(max(total, 1))
                 merged, _ = concat_batches_device(batches, cap)
             out = with_retry_no_split(lambda: self._run(merged))
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
+
+    def _execute_out_of_core(self, batches: List[ColumnarBatch],
+                             total: int) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
+        from spark_rapids_tpu.plan.execs.out_of_core import (
+            close_all, num_sub_buckets)
+        from spark_rapids_tpu.plan.execs.range_sort import (
+            range_bucket_spillable)
+
+        n_out = num_sub_buckets(total, self.target_rows)
+        with timed(self.op_time):
+            buckets = range_bucket_spillable(
+                iter(batches), self.orders, self.schema, n_out, batches)
+            del batches  # queued data now lives in spillable handles
+        try:
+            for q in buckets:
+                if not q:
+                    continue
+                with timed(self.op_time):
+                    merged = coalesce_to_one([h.materialize() for h in q])
+                    out = with_retry_no_split(lambda: self._run(merged))
+                    for h in q:
+                        h.unpin()
+                        h.close()
+                    q.clear()
+                self.output_rows.add(out.num_rows)
+                yield self._count_out(out)
+        finally:
+            close_all(buckets)
 
     def describe(self):
         inner = ", ".join(f"{e!r} {'ASC' if o.ascending else 'DESC'}"

@@ -310,7 +310,8 @@ class PlanMeta:
                     p.orders, child,
                     min(self.conf.shuffle_partitions,
                         child.num_partitions()))
-            return TpuSortExec(p.orders, child)
+            return TpuSortExec(p.orders, child,
+                               target_rows=self.conf.batch_size_rows)
         if isinstance(p, L.Aggregate):
             return self._convert_aggregate(p)
         if isinstance(p, L.Join):
@@ -395,7 +396,8 @@ class PlanMeta:
                 and _estimate_rows(p.right) <= self.conf.broadcast_row_threshold
                 and left.num_partitions() > 1):
             join: TpuExec = TpuBroadcastHashJoinExec(
-                left, right, p.left_keys, p.right_keys, p.join_type, p.schema)
+                left, right, p.left_keys, p.right_keys, p.join_type, p.schema,
+                target_rows=self.conf.batch_size_rows)
             if p.condition is not None:
                 join = TpuFilterExec(p.condition, join)
             return join
@@ -411,7 +413,8 @@ class PlanMeta:
                 left = self._exchange(nparts, p.left_keys, left)
                 right = self._exchange(nparts, p.right_keys, right)
         join = TpuShuffledHashJoinExec(
-            left, right, p.left_keys, p.right_keys, p.join_type, p.schema)
+            left, right, p.left_keys, p.right_keys, p.join_type, p.schema,
+            target_rows=self.conf.batch_size_rows)
         if p.condition is not None:
             join = TpuFilterExec(p.condition, join)
         return join
@@ -422,10 +425,10 @@ class PlanMeta:
         if single:
             return TpuHashAggregateExec(
                 p.group_exprs, p.agg_exprs, p.aggregates, child, p.schema,
-                mode="complete")
+                mode="complete", target_capacity=self.conf.batch_size_rows)
         partial = TpuHashAggregateExec(
             p.group_exprs, p.agg_exprs, p.aggregates, child, p.schema,
-            mode="partial")
+            mode="partial", target_capacity=self.conf.batch_size_rows)
         if p.group_exprs:
             nkeys = len(p.group_exprs)
             key_refs = [E.BoundReference(i, p.group_exprs[i].dtype, f"_k{i}")
@@ -436,7 +439,7 @@ class PlanMeta:
             exchange = TpuSinglePartitionExec(partial)
         return TpuHashAggregateExec(
             p.group_exprs, p.agg_exprs, p.aggregates, exchange, p.schema,
-            mode="final")
+            mode="final", target_capacity=self.conf.batch_size_rows)
 
     def _exchange(self, nparts, keys, child) -> TpuExec:
         mode = self.conf.shuffle_mode
@@ -448,7 +451,8 @@ class PlanMeta:
         return TpuShuffleExchangeExec(
             nparts, keys, child, mode=mode,
             writer_threads=self.conf.shuffle_writer_threads,
-            codec=self.conf.shuffle_codec)
+            codec=self.conf.shuffle_codec,
+            target_rows=self.conf.batch_size_rows)
 
     def _fallback(self) -> TpuExec:
         from spark_rapids_tpu.plan.execs.fallback import TpuCpuFallbackExec

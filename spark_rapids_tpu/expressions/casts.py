@@ -53,6 +53,16 @@ class Cast(UnaryExpression):
     def __repr__(self):
         return f"cast({self.child!r} AS {self._dtype!r})"
 
+    @property
+    def uses_string_bucket(self) -> bool:
+        """String-source casts parse through the [capacity, bucket] byte
+        window, so the exec must thread a static bucket (EvalContext)."""
+        try:
+            return isinstance(self.child.dtype, T.StringType) and \
+                not isinstance(self._dtype, T.StringType)
+        except (TypeError, ValueError, NotImplementedError):
+            return False
+
     @staticmethod
     def supported(src: T.DataType, dst: T.DataType) -> bool:
         if src == dst:
@@ -72,6 +82,18 @@ class Cast(UnaryExpression):
             return True
         if isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
             return True
+        # string parse casts (kernels/cast_strings.py; GpuCast.scala:286)
+        if isinstance(src, T.StringType) and (
+                dst.is_integral or dst.is_floating
+                or isinstance(dst, (T.DateType, T.BooleanType))):
+            return True
+        # formatting casts; float->string stays off (Java Double.toString
+        # formatting differences — the reference gates it behind
+        # spark.rapids.sql.castFloatToString.enabled for the same reason)
+        if isinstance(dst, T.StringType) and (
+                src.is_integral
+                or isinstance(src, (T.DateType, T.BooleanType))):
+            return True
         return False
 
     def eval(self, ctx: EvalContext):
@@ -79,6 +101,10 @@ class Cast(UnaryExpression):
         src, dst = c.dtype, self._dtype
         if src == dst:
             return c
+        if isinstance(src, T.StringType):
+            return self._eval_from_string(c, ctx, dst)
+        if isinstance(dst, T.StringType):
+            return self._eval_to_string(c, ctx, src)
         data = c.data
         if isinstance(src, T.BooleanType):
             out = data.astype(dst.jnp_dtype)
@@ -110,11 +136,51 @@ class Cast(UnaryExpression):
             out = data.astype(dst.jnp_dtype)
         return make_column(out, c.validity, dst)
 
+    def _eval_from_string(self, c, ctx: EvalContext, dst: T.DataType):
+        from spark_rapids_tpu.kernels import cast_strings as CS
+        assert ctx.string_bucket > 0, \
+            "string cast evaluated without a string bucket in EvalContext"
+        L = ctx.string_bucket
+        live = ctx.live_mask()
+        if dst.is_integral:
+            vals, ok = CS.parse_integral(c, L)
+            lo, hi = _INT_RANGE[_int_key(dst)]
+            ok = ok & (vals >= lo) & (vals <= hi)
+            return make_column(
+                jnp.where(ok, vals, 0).astype(dst.jnp_dtype),
+                c.validity & ok & live, dst)
+        if dst.is_floating:
+            vals, ok = CS.parse_double(c, L)
+            return make_column(vals.astype(dst.jnp_dtype),
+                               c.validity & ok & live, dst)
+        if isinstance(dst, T.DateType):
+            days, ok = CS.parse_date(c, L)
+            return make_column(days, c.validity & ok & live, dst)
+        if isinstance(dst, T.BooleanType):
+            vals, ok = CS.parse_bool(c, L)
+            return make_column(vals, c.validity & ok & live, dst)
+        raise NotImplementedError(f"cast string -> {dst!r}")
+
+    def _eval_to_string(self, c, ctx: EvalContext, src: T.DataType):
+        from spark_rapids_tpu.kernels import cast_strings as CS
+        validity = c.validity & ctx.live_mask()
+        if isinstance(src, T.BooleanType):
+            return CS.bool_to_string(c.data, validity)
+        if isinstance(src, T.DateType):
+            return CS.date_to_string(c.data, validity)
+        if src.is_integral:
+            return CS.long_to_string(c.data.astype(jnp.int64), validity)
+        raise NotImplementedError(f"cast {src!r} -> string")
+
     def eval_cpu(self, ctx: CpuEvalContext):
         v, valid = self.child.eval_cpu(ctx)
         src, dst = self.child.dtype, self._dtype
         if src == dst:
             return v, valid
+        if isinstance(src, T.StringType):
+            return _cpu_from_string(v, valid, dst)
+        if isinstance(dst, T.StringType):
+            return _cpu_to_string(v, valid, src)
         with np.errstate(all="ignore"):
             if isinstance(src, T.BooleanType):
                 out = v.astype(dst.np_dtype)
@@ -142,6 +208,126 @@ class Cast(UnaryExpression):
             else:
                 out = v.astype(dst.np_dtype)
         return cpu_zero_invalid(out, valid), valid
+
+
+def _int_key(dst: T.DataType):
+    """_INT_RANGE is keyed by the singleton type instances; map an
+    arbitrary integral dtype instance onto its key."""
+    for k in _INT_RANGE:
+        if k == dst:
+            return k
+    raise KeyError(dst)
+
+
+_WS = "".join(chr(c) for c in range(0x21))
+_INT_RE = __import__("re").compile(r"^[+-]?(\d+(\.\d*)?|\.\d+)$")
+_FLOAT_RE = __import__("re").compile(
+    r"^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$")
+_DATE_RE = __import__("re").compile(
+    r"^(\d{4})(?:-(\d{1,2})(?:-(\d{1,2}))?)?$")
+_SPECIAL_FLOATS = {
+    "inf": float("inf"), "+inf": float("inf"), "-inf": float("-inf"),
+    "infinity": float("inf"), "+infinity": float("inf"),
+    "-infinity": float("-inf"), "nan": float("nan"),
+}
+
+
+def _cpu_from_string(v, valid, dst: T.DataType):
+    """Host-oracle string parse, independent of the device kernels (so the
+    differential tests check the kernels, not themselves)."""
+    import datetime as _dt
+    n = len(v)
+    out_valid = np.zeros((n,), np.bool_)
+
+    def rows():
+        for s, m in zip(v, valid):
+            yield s.strip(_WS) if m and s is not None else None
+
+    if dst.is_integral:
+        lo, hi = _INT_RANGE[_int_key(dst)]
+        out = np.zeros((n,), dst.np_dtype)
+        for i, tok in enumerate(rows()):
+            if not tok or not _INT_RE.match(tok):
+                continue
+            neg = tok[0] == "-"
+            body = tok.lstrip("+-")
+            int_part = body.split(".")[0]
+            val = int(int_part) if int_part else 0
+            if neg:
+                val = -val
+            if lo <= val <= hi:
+                out[i] = val
+                out_valid[i] = True
+        return out, out_valid
+    if dst.is_floating:
+        out = np.zeros((n,), dst.np_dtype)
+        for i, tok in enumerate(rows()):
+            if not tok:
+                continue
+            sp = _SPECIAL_FLOATS.get(tok.lower())
+            if sp is not None:
+                out[i] = sp
+                out_valid[i] = True
+            elif _FLOAT_RE.match(tok):
+                out[i] = float(tok)
+                out_valid[i] = True
+        return out, out_valid
+    if isinstance(dst, T.DateType):
+        epoch = _dt.date(1970, 1, 1).toordinal()
+        out = np.zeros((n,), np.int32)
+        for i, tok in enumerate(rows()):
+            if not tok:
+                continue
+            m = _DATE_RE.match(tok)
+            if not m:
+                continue
+            y, mo, d = int(m.group(1)), int(m.group(2) or 1), int(m.group(3) or 1)
+            try:
+                out[i] = _dt.date(y, mo, d).toordinal() - epoch
+                out_valid[i] = True
+            except ValueError:
+                pass
+        return out, out_valid
+    if isinstance(dst, T.BooleanType):
+        out = np.zeros((n,), np.bool_)
+        for i, tok in enumerate(rows()):
+            if not tok:
+                continue
+            tl = tok.lower()
+            if tl in ("t", "true", "y", "yes", "1"):
+                out[i] = True
+                out_valid[i] = True
+            elif tl in ("f", "false", "n", "no", "0"):
+                out_valid[i] = True
+        return out, out_valid
+    raise NotImplementedError(f"cpu cast string -> {dst!r}")
+
+
+def _cpu_to_string(v, valid, src: T.DataType):
+    import datetime as _dt
+    n = len(v)
+    out = np.empty((n,), object)
+    if isinstance(src, T.BooleanType):
+        for i, m in enumerate(valid):
+            out[i] = ("true" if v[i] else "false") if m else None
+    elif isinstance(src, T.DateType):
+        epoch = _dt.date(1970, 1, 1).toordinal()
+        for i, m in enumerate(valid):
+            out[i] = (_dt.date.fromordinal(epoch + int(v[i])).isoformat()
+                      if m else None)
+    elif src.is_integral:
+        for i, m in enumerate(valid):
+            out[i] = str(int(v[i])) if m else None
+    elif src.is_floating:
+        # CPU-only path (float->string is tagged off the device plan, like
+        # the reference's castFloatToString.enabled default).  NOTE: python
+        # float formatting, not Java Double.toString — self-consistent for
+        # the oracle, flagged in docs/compatibility notes.
+        for i, m in enumerate(valid):
+            out[i] = str(float(v[i])) if m else None
+    else:
+        raise NotImplementedError(f"cpu cast {src!r} -> string")
+    return out, valid.copy()
 
 
 def _decimal_cast(data, validity, src: T.DataType, dst: T.DataType, xp):

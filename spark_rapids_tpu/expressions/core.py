@@ -33,11 +33,24 @@ from spark_rapids_tpu.columnar.column import DeviceColumn
 
 
 class EvalContext:
-    """Device-eval context: the input batch plus cached subresults."""
+    """Device-eval context: the input batch plus cached subresults.
 
-    def __init__(self, batch: ColumnarBatch):
+    ``string_bucket`` is a STATIC (trace-time) byte bound covering the
+    longest live string the regex/byte-window expressions will see; execs
+    whose expression trees contain such nodes compute it host-side before
+    entering jit (plan/execs/base.py regex_bucket) and key their jit cache
+    on it."""
+
+    def __init__(self, batch: ColumnarBatch, string_bucket: int = 0,
+                 trace_consts=None):
         self.batch = batch
         self.capacity = batch.capacity
+        self.string_bucket = string_bucket
+        # {id(expr): [traced arrays]} — per-expression device constants
+        # (DFA tables) passed as jit arguments (plan/execs/base.py
+        # collect_trace_consts); expressions fall back to their host
+        # constants when absent (eager use)
+        self.trace_consts = trace_consts or {}
 
     def live_mask(self) -> jax.Array:
         return self.batch.live_mask()

@@ -232,26 +232,46 @@ class Contains(_LiteralPatternPredicate):
 
 
 class Like(Expression):
-    """SQL LIKE limited to the shapes the reference's regex rewrite also
-    fast-paths (RegexRewriteUtils): 'lit', 'lit%', '%lit', '%lit%'.
-    Anything else (interior %/_ wildcards) is tagged for fallback."""
+    """SQL LIKE.  The shapes the reference's regex rewrite fast-paths
+    (RegexRewriteUtils: 'lit', 'lit%', '%lit', '%lit%') lower to the
+    dedicated prefix/suffix/contains kernels; every other pattern compiles
+    to a full-match byte-DFA (regex/automata.py compile_like) and runs
+    through the dfa_match kernel — the TPU analog of the reference
+    transpiling LIKE into cuDF regex."""
 
     def __init__(self, child: Expression, pattern: str):
         self.child = child
         self.pattern = pattern
         self.children = (child,)
+        self._fast = Like.supported_pattern(pattern)
+        self.uses_string_bucket = not self._fast
+        self._dfa = None
 
     def with_children(self, children):
         return Like(children[0], self.pattern)
 
     @staticmethod
     def supported_pattern(pattern: str) -> bool:
+        """Shapes with dedicated kernels (no DFA needed)."""
         inner = pattern
         if inner.startswith("%"):
             inner = inner[1:]
         if inner.endswith("%") and not inner.endswith(r"\%"):
             inner = inner[:-1]
-        return "%" not in inner and "_" not in inner
+        return ("%" not in inner and "_" not in inner
+                and "\\" not in inner)
+
+    def _compiled(self):
+        if self._dfa is None:
+            from spark_rapids_tpu.regex import compile_like
+            self._dfa = compile_like(self.pattern)
+        return self._dfa
+
+    def trace_consts(self):
+        if not self._fast:
+            c = self._compiled()
+            return [c.table, c.accept]
+        return []
 
     @property
     def dtype(self):
@@ -266,6 +286,9 @@ class Like(Expression):
 
     def eval(self, ctx: EvalContext):
         c = self.child.eval(ctx)
+        if not self._fast:
+            hits = _dfa_eval(self, self._compiled(), c, ctx)
+            return make_column(hits, c.validity & ctx.live_mask(), T.BOOLEAN)
         sp, ep, inner = self._shape()
         pat = inner.encode("utf-8")
         if sp and ep:
@@ -279,8 +302,34 @@ class Like(Expression):
             hits = SK.startswith_literal(c, pat) & (byte_length(c) == len(pat))
         return make_column(hits, c.validity & ctx.live_mask(), T.BOOLEAN)
 
+    def _py_like_regex(self) -> str:
+        import re as _re
+        out, i = ["(?s:"], 0
+        p = self.pattern
+        while i < len(p):
+            ch = p[i]
+            if ch == "\\" and i + 1 < len(p):
+                out.append(_re.escape(p[i + 1]))
+                i += 2
+                continue
+            if ch == "%":
+                out.append(".*")
+            elif ch == "_":
+                out.append(".")
+            else:
+                out.append(_re.escape(ch))
+            i += 1
+        out.append(")")
+        return "".join(out)
+
     def eval_cpu(self, ctx: CpuEvalContext):
         v, valid = self.child.eval_cpu(ctx)
+        if not self._fast:
+            import re as _re
+            rx = _re.compile(self._py_like_regex())
+            out = np.array([rx.fullmatch(x) is not None if m else False
+                            for x, m in zip(v, valid)], dtype=np.bool_)
+            return out, valid.copy()
         sp, ep, inner = self._shape()
 
         def match(s):
@@ -299,6 +348,75 @@ class Like(Expression):
         return f"({self.child!r} LIKE {self.pattern!r})"
 
 
+def _dfa_eval(expr, compiled, col: DeviceColumn, ctx: EvalContext):
+    """Shared device-side DFA run (bucket must have been threaded by the
+    exec; a zero bucket means the plan failed to do so — fail loudly rather
+    than silently truncating rows).  The transition/accept tables arrive as
+    jit arguments via ctx.trace_consts (closed-over concrete arrays would
+    be hoisted into executable parameters — the jax-0.9 multi-wrapper
+    dispatch hazard noted in kernels/cast_strings.py)."""
+    assert ctx.string_bucket > 0, \
+        "regex expression evaluated without a string bucket in EvalContext"
+    consts = ctx.trace_consts.get(id(expr))
+    if consts is None:
+        import jax.numpy as _jnp
+        consts = [_jnp.asarray(compiled.table), _jnp.asarray(compiled.accept)]
+    table, accept = consts
+    return SK.dfa_match(col, ctx.batch.num_rows, table, accept,
+                        compiled.start, ctx.string_bucket)
+
+
+class RLike(Expression):
+    """Spark RLIKE: java.util.regex find() over a literal pattern.
+
+    Device path: host-compiled byte-DFA + the dfa_match scan kernel
+    (reference: cuDF regex via the RegexParser transpiler, with
+    per-pattern supportability tagging — unsupported patterns make the
+    planner fall back, planner/overrides.py)."""
+
+    uses_string_bucket = True
+
+    def __init__(self, child: Expression, pattern: str):
+        self.child = child
+        self.pattern = pattern
+        self.children = (child,)
+        self._dfa = None
+
+    def with_children(self, children):
+        return RLike(children[0], self.pattern)
+
+    def _compiled(self):
+        if self._dfa is None:
+            from spark_rapids_tpu.regex import compile_regex
+            self._dfa = compile_regex(self.pattern, mode="search")
+        return self._dfa
+
+    def trace_consts(self):
+        c = self._compiled()
+        return [c.table, c.accept]
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        hits = _dfa_eval(self, self._compiled(), c, ctx)
+        return make_column(hits, c.validity & ctx.live_mask(), T.BOOLEAN)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        import re as _re
+        from spark_rapids_tpu.regex import to_python_pattern
+        rx = _re.compile(to_python_pattern(self.pattern), _re.ASCII)
+        v, valid = self.child.eval_cpu(ctx)
+        out = np.array([rx.search(x) is not None if m else False
+                        for x, m in zip(v, valid)], dtype=np.bool_)
+        return out, valid.copy()
+
+    def __repr__(self):
+        return f"({self.child!r} RLIKE {self.pattern!r})"
+
+
 class Trim(UnaryExpression):
     @property
     def dtype(self):
@@ -314,3 +432,339 @@ class Trim(UnaryExpression):
         v, valid = self.child.eval_cpu(ctx)
         return _obj([x.strip(" ") if m else None
                      for x, m in zip(v, valid)]), valid.copy()
+
+
+class LTrim(UnaryExpression):
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        out = SK.ltrim_ws(c, ctx.batch.num_rows)
+        return DeviceColumn(out.data, c.validity & ctx.live_mask(),
+                            T.STRING, out.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        return _obj([x.lstrip(" ") if m else None
+                     for x, m in zip(v, valid)]), valid.copy()
+
+
+class RTrim(UnaryExpression):
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        out = SK.rtrim_ws(c, ctx.batch.num_rows)
+        return DeviceColumn(out.data, c.validity & ctx.live_mask(),
+                            T.STRING, out.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        return _obj([x.rstrip(" ") if m else None
+                     for x, m in zip(v, valid)]), valid.copy()
+
+
+class Reverse(UnaryExpression):
+    """Character-level reverse (stringFunctions.scala GpuStringReverse)."""
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        out = SK.reverse_chars(c, ctx.batch.num_rows)
+        return DeviceColumn(out.data, c.validity & ctx.live_mask(),
+                            T.STRING, out.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        return _obj([x[::-1] if m else None
+                     for x, m in zip(v, valid)]), valid.copy()
+
+
+class InitCap(UnaryExpression):
+    """ASCII initcap (device ASCII-only, like Upper/Lower)."""
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        out = SK.initcap_ascii(c, ctx.batch.num_rows)
+        return DeviceColumn(out.data, c.validity & ctx.live_mask(),
+                            T.STRING, out.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+
+        def ic(s):
+            out, prev_sp = [], True
+            for ch in s:
+                if prev_sp and "a" <= ch <= "z":
+                    out.append(ch.upper())
+                elif not prev_sp and "A" <= ch <= "Z":
+                    out.append(ch.lower())
+                else:
+                    out.append(ch)
+                prev_sp = ch == " "
+            return "".join(out)
+        return _obj([ic(x) if m else None
+                     for x, m in zip(v, valid)]), valid.copy()
+
+
+class StringReplace(Expression):
+    """replace(str, search, replace) with literal search/replace.
+    Device path: non-overlapping left-to-right window kernel
+    (kernels/strings.py replace_literal)."""
+
+    uses_string_bucket = True
+
+    def __init__(self, child: Expression, search: str, replacement: str = ""):
+        self.child = child
+        self.search = search
+        self.replacement = replacement
+        self.children = (child,)
+
+    def with_children(self, children):
+        return StringReplace(children[0], self.search, self.replacement)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        if not self.search:
+            return c
+        assert ctx.string_bucket > 0, "replace needs the string bucket"
+        out = SK.replace_literal(c, ctx.batch.num_rows,
+                                 self.search.encode("utf-8"),
+                                 self.replacement.encode("utf-8"),
+                                 ctx.string_bucket)
+        return DeviceColumn(out.data, c.validity & ctx.live_mask(),
+                            T.STRING, out.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        if not self.search:
+            return v, valid
+        return _obj([x.replace(self.search, self.replacement) if m else None
+                     for x, m in zip(v, valid)]), valid.copy()
+
+    def __repr__(self):
+        return (f"replace({self.child!r}, {self.search!r}, "
+                f"{self.replacement!r})")
+
+
+class StringLocate(Expression):
+    """locate(substr, str[, pos]): 1-based char index, 0 when absent.
+    substr and pos are literals on device."""
+
+    def __init__(self, substr: str, child: Expression, pos: int = 1):
+        self.child = child
+        self.substr = substr
+        self.pos = pos
+        self.children = (child,)
+
+    def with_children(self, children):
+        return StringLocate(self.substr, children[0], self.pos)
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        start = jnp.full((ctx.capacity,), jnp.int32(self.pos))
+        hits = SK.first_occurrence_char(
+            c, self.substr.encode("utf-8"), ctx.batch.num_rows,
+            start_char=start)
+        hits = jnp.where(jnp.int32(self.pos) >= 1, hits, 0)
+        return make_column(hits.astype(jnp.int32),
+                           c.validity & ctx.live_mask(), T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+
+        def loc(s):
+            if self.pos < 1:
+                return 0
+            i = s.find(self.substr, self.pos - 1)
+            return 0 if i < 0 else i + 1
+        out = np.array([loc(x) if m else 0 for x, m in zip(v, valid)],
+                       dtype=np.int32)
+        return out, valid.copy()
+
+    def __repr__(self):
+        return f"locate({self.substr!r}, {self.child!r}, {self.pos})"
+
+
+class StringInstr(StringLocate):
+    """instr(str, substr) == locate(substr, str, 1)."""
+
+    def __init__(self, child: Expression, substr: str):
+        super().__init__(substr, child, 1)
+
+    def with_children(self, children):
+        return StringInstr(children[0], self.substr)
+
+    def __repr__(self):
+        return f"instr({self.child!r}, {self.substr!r})"
+
+
+class Ascii(UnaryExpression):
+    """Codepoint of the first character (0 for empty)."""
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        starts = c.offsets[:-1]
+        lens = c.offsets[1:] - starts
+        b0 = c.data[jnp.clip(starts, 0, c.byte_capacity - 1)].astype(jnp.int32)
+        b1 = c.data[jnp.clip(starts + 1, 0, c.byte_capacity - 1)].astype(jnp.int32)
+        b2 = c.data[jnp.clip(starts + 2, 0, c.byte_capacity - 1)].astype(jnp.int32)
+        b3 = c.data[jnp.clip(starts + 3, 0, c.byte_capacity - 1)].astype(jnp.int32)
+        cp = jnp.where(
+            b0 < 0x80, b0,
+            jnp.where(b0 < 0xE0,
+                      ((b0 & 0x1F) << 6) | (b1 & 0x3F),
+                      jnp.where(b0 < 0xF0,
+                                ((b0 & 0x0F) << 12) | ((b1 & 0x3F) << 6)
+                                | (b2 & 0x3F),
+                                ((b0 & 0x07) << 18) | ((b1 & 0x3F) << 12)
+                                | ((b2 & 0x3F) << 6) | (b3 & 0x3F))))
+        cp = jnp.where(lens > 0, cp, 0)
+        return make_column(cp.astype(jnp.int32),
+                           c.validity & ctx.live_mask(), T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        out = np.array([(ord(x[0]) if x else 0) if m else 0
+                        for x, m in zip(v, valid)], dtype=np.int32)
+        return out, valid.copy()
+
+
+class StringRepeat(Expression):
+    """repeat(str, n) with literal n (static growth bound)."""
+
+    def __init__(self, child: Expression, n: int):
+        self.child = child
+        self.n = int(n)
+        self.children = (child,)
+
+    def with_children(self, children):
+        return StringRepeat(children[0], self.n)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        n = jnp.full((ctx.capacity,), jnp.int32(self.n))
+        out_bcap = max(c.byte_capacity * max(self.n, 1), 16)
+        out, _req = SK.repeat_string(c, ctx.batch.num_rows, n, out_bcap)
+        return DeviceColumn(out.data, c.validity & ctx.live_mask(),
+                            T.STRING, out.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        return _obj([x * max(self.n, 0) if m else None
+                     for x, m in zip(v, valid)]), valid.copy()
+
+    def __repr__(self):
+        return f"repeat({self.child!r}, {self.n})"
+
+
+class _Pad(Expression):
+    left_pad = True
+
+    def __init__(self, child: Expression, length: int, pad: str = " "):
+        self.child = child
+        self.length = int(length)
+        self.pad = pad
+        self.children = (child,)
+
+    def with_children(self, children):
+        return type(self)(children[0], self.length, self.pad)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        tgt = jnp.full((ctx.capacity,), jnp.int32(max(self.length, 0)))
+        out_bcap = c.byte_capacity + ctx.capacity * max(self.length, 1)
+        out, _req = SK.pad_chars(c, ctx.batch.num_rows, tgt,
+                                 self.pad.encode("utf-8"), self.left_pad,
+                                 out_bcap)
+        return DeviceColumn(out.data, c.validity & ctx.live_mask(),
+                            T.STRING, out.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        n = max(self.length, 0)
+
+        def pad_one(s):
+            if len(s) >= n or not self.pad:
+                return s[:n]
+            fill = n - len(s)
+            padding = (self.pad * (fill // len(self.pad) + 1))[:fill]
+            return padding + s if self.left_pad else s + padding
+        return _obj([pad_one(x) if m else None
+                     for x, m in zip(v, valid)]), valid.copy()
+
+    def __repr__(self):
+        name = "lpad" if self.left_pad else "rpad"
+        return f"{name}({self.child!r}, {self.length}, {self.pad!r})"
+
+
+class Lpad(_Pad):
+    left_pad = True
+
+
+class Rpad(_Pad):
+    left_pad = False
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, cols...): join non-null values (nulls skipped)."""
+
+    def __init__(self, sep: str, *children: Expression):
+        self.sep = sep
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return ConcatWs(self.sep, *children)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        cols = [c.eval(ctx) for c in self.children]
+        return SK.concat_ws(cols, self.sep.encode("utf-8"),
+                            ctx.batch.num_rows)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        evs = [c.eval_cpu(ctx) for c in self.children]
+        n = ctx.num_rows
+        out = []
+        for i in range(n):
+            parts = [v[i] for v, m in evs if m[i]]
+            out.append(self.sep.join(parts))
+        return _obj(out), np.ones((n,), np.bool_)
+
+    def __repr__(self):
+        inner = ", ".join(map(repr, self.children))
+        return f"concat_ws({self.sep!r}, {inner})"

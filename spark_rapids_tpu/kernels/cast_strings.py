@@ -1,0 +1,344 @@
+"""String cast kernels: parse string->numeric/date/bool, format ->string.
+
+TPU replacement for the reference's CastStrings JNI kernels (consumed by
+GpuCast.scala:286,1650).  Parsing runs over the [capacity, max_len] byte
+window (kernels/strings.py string_byte_matrix) with the window bound
+threaded statically through EvalContext.string_bucket; everything is
+branch-free elementwise/scan work XLA maps well.
+
+Semantics follow Spark's NON-ANSI legacy cast (docs/compatibility.md):
+invalid input -> NULL (never an error), integral parse trims chars <=0x20
+(UTF8String.trimAll), accepts an optional fraction which truncates toward
+zero, overflow -> NULL; double parse accepts inf/infinity/nan special
+literals case-insensitively with optional sign; date parse accepts
+yyyy[-m[m][-d[d]]].
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.kernels.strings import string_byte_matrix
+
+_BIG = 1 << 20   # python int: a module-level jnp array would be hoisted
+# as an executable parameter and trip jax 0.9 fastpath/compile-cache sharing
+
+
+def _token_bounds(mat: jax.Array, lens: jax.Array):
+    """Whitespace-trimmed token [first, last] per row (inclusive), plus the
+    has_content flag.  Spark trims every char <= 0x20."""
+    cap, L = mat.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_row = pos < lens[:, None]
+    nonws = (mat > 0x20) & in_row
+    first = jnp.min(jnp.where(nonws, pos, _BIG), axis=1)
+    last = jnp.max(jnp.where(nonws, pos, -1), axis=1)
+    return first, last, last >= first
+
+
+def _sign_split(mat, first, last):
+    """Optional +/- at token start; returns (neg, digit_start)."""
+    cap, L = mat.shape
+    sb = mat[jnp.arange(cap), jnp.clip(first, 0, L - 1)].astype(jnp.int32)
+    has_sign = (sb == ord("-")) | (sb == ord("+"))
+    neg = sb == ord("-")
+    return neg, first + has_sign.astype(jnp.int32), has_sign
+
+
+def parse_integral(col: DeviceColumn, max_len: int
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """-> (int64 values truncated toward zero, parse_ok bool [capacity]).
+
+    Callers apply target-width bounds (int/short/byte) on top."""
+    mat, lens = string_byte_matrix(col, max_len)
+    cap, L = mat.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    b = mat.astype(jnp.int32)
+    first, last, has_content = _token_bounds(mat, lens)
+    neg, dstart, _ = _sign_split(mat, first, last)
+
+    in_tok = (pos >= dstart[:, None]) & (pos <= last[:, None])
+    is_dot = (b == ord(".")) & in_tok
+    ndots = jnp.sum(is_dot, axis=1)
+    dotpos = jnp.min(jnp.where(is_dot, pos, _BIG), axis=1)
+    int_end = jnp.where(ndots > 0, dotpos - 1, last)
+    is_digit = (b >= ord("0")) & (b <= ord("9"))
+    int_part = (pos >= dstart[:, None]) & (pos <= int_end[:, None])
+    frac_part = (pos > dotpos[:, None]) & (pos <= last[:, None]) & \
+        (ndots[:, None] > 0)
+    n_int = jnp.sum(int_part & in_tok, axis=1)
+    n_frac = jnp.sum(frac_part, axis=1)
+    ok = (has_content & (ndots <= 1)
+          & jnp.all(jnp.where((int_part | frac_part) & in_tok,
+                              is_digit, True), axis=1)
+          & ((n_int + n_frac) > 0))
+
+    # magnitude accumulation in uint64 (lets "-9223372036854775808" parse)
+    active = int_part & is_digit & in_tok
+    digits = jnp.where(active, b - ord("0"), 0).astype(jnp.uint64)
+
+    def step(carry, xs):
+        mag, ovf = carry
+        d, act = xs
+        limit = (jnp.uint64(2**64 - 1) - d) // jnp.uint64(10)
+        ovf = ovf | (act & (mag > limit))
+        mag = jnp.where(act, mag * jnp.uint64(10) + d, mag)
+        return (mag, ovf), None
+
+    mag0 = jnp.zeros((cap,), jnp.uint64)
+    ovf0 = jnp.zeros((cap,), jnp.bool_)
+    (mag, ovf), _ = jax.lax.scan(
+        step, (mag0, ovf0), (jnp.transpose(digits), jnp.transpose(active)))
+    limit = jnp.uint64(2**63 - 1) + neg.astype(jnp.uint64)
+    ok = ok & ~ovf & (mag <= limit)
+    val = jnp.where(neg, -(mag.astype(jnp.int64)), mag.astype(jnp.int64))
+    return jnp.where(ok, val, 0), ok
+
+
+def _token_matches(mat, first, last, word: bytes):
+    """Case-insensitive ASCII match of token[first..last] against word."""
+    cap, L = mat.shape
+    n = len(word)
+    length_ok = (last - first + 1) == n
+    hit = length_ok
+    for i, wb in enumerate(word):
+        idx = jnp.clip(first + i, 0, L - 1)
+        c = mat[jnp.arange(cap), idx].astype(jnp.int32)
+        lower = jnp.where((c >= ord("A")) & (c <= ord("Z")), c + 32, c)
+        hit = hit & (lower == wb)
+    return hit
+
+
+def parse_double(col: DeviceColumn, max_len: int
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """-> (float64 values, parse_ok bool).  Mantissa capped at 15
+    significant digits (f64-exact); extra digits shift the exponent."""
+    mat, lens = string_byte_matrix(col, max_len)
+    cap, L = mat.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    b = mat.astype(jnp.int32)
+    first, last, has_content = _token_bounds(mat, lens)
+    neg, dstart, _ = _sign_split(mat, first, last)
+
+    # special literals (with the sign already stripped)
+    inf_hit = (_token_matches(mat, dstart, last, b"inf")
+               | _token_matches(mat, dstart, last, b"infinity"))
+    nan_hit = _token_matches(mat, first, last, b"nan")   # no sign on NaN
+
+    # exponent marker
+    is_e = ((b == ord("e")) | (b == ord("E"))) & \
+        (pos >= dstart[:, None]) & (pos <= last[:, None])
+    n_e = jnp.sum(is_e, axis=1)
+    epos = jnp.min(jnp.where(is_e, pos, _BIG), axis=1)
+    mant_end = jnp.where(n_e > 0, epos - 1, last)
+
+    is_digit = (b >= ord("0")) & (b <= ord("9"))
+    is_dot = b == ord(".")
+    mant_span = (pos >= dstart[:, None]) & (pos <= mant_end[:, None])
+    dot_in_mant = is_dot & mant_span
+    ndots = jnp.sum(dot_in_mant, axis=1)
+    dotpos = jnp.min(jnp.where(dot_in_mant, pos, _BIG), axis=1)
+    mant_digit = mant_span & is_digit
+    n_mant = jnp.sum(mant_digit, axis=1)
+    mant_ok = (ndots <= 1) & (n_mant > 0) & \
+        jnp.all(jnp.where(mant_span, is_digit | dot_in_mant, True), axis=1)
+
+    # exponent part: optional sign + >=1 digits
+    es = epos + 1
+    e_sb = mat[jnp.arange(cap), jnp.clip(es, 0, L - 1)].astype(jnp.int32)
+    e_signed = (e_sb == ord("-")) | (e_sb == ord("+"))
+    e_neg = e_sb == ord("-")
+    eds = es + e_signed.astype(jnp.int32)
+    exp_span = (pos >= eds[:, None]) & (pos <= last[:, None])
+    n_exp = jnp.sum(exp_span & is_digit, axis=1)
+    exp_ok = jnp.where(n_e > 0,
+                       (n_exp > 0) & (n_exp <= 9)
+                       & jnp.all(jnp.where(exp_span, is_digit, True), axis=1)
+                       & (eds <= last),
+                       True)
+
+    # accumulate the mantissa (first 15 significant digits: f64-exact) and
+    # the decimal-exponent adjustment in one pass; leading zeros are
+    # skipped, saturated integer digits scale the value up, and fraction
+    # digits consumed (or skipped as leading zeros) scale it down
+    SIG = 15
+
+    def step(carry, xs):
+        mant, nsig, e_adj = carry
+        d, act, after_dot = xs
+        lead_zero = act & (mant == 0) & (d == 0)
+        take = act & ~lead_zero & (nsig < SIG)
+        saturated = act & ~lead_zero & (nsig >= SIG)
+        mant = jnp.where(take, mant * 10 + d, mant)
+        nsig = jnp.where(take, nsig + 1, nsig)
+        e_adj = e_adj + jnp.where(saturated & ~after_dot, 1, 0)
+        e_adj = e_adj - jnp.where((take | lead_zero) & after_dot, 1, 0)
+        return (mant, nsig, e_adj), None
+
+    after_dot = (pos > dotpos[:, None]) & (ndots[:, None] > 0)
+    d64 = jnp.where(mant_digit, b - ord("0"), 0).astype(jnp.int64)
+    (mant, _, e_adj), _ = jax.lax.scan(
+        step,
+        (jnp.zeros((cap,), jnp.int64), jnp.zeros((cap,), jnp.int32),
+         jnp.zeros((cap,), jnp.int64)),
+        (jnp.transpose(d64), jnp.transpose(mant_digit),
+         jnp.transpose(after_dot)))
+
+    exp_digits = jnp.where(exp_span & is_digit, b - ord("0"), 0)
+    weights = (10 ** jnp.clip(last[:, None] - pos, 0, 9)).astype(jnp.int64)
+    exp_val = jnp.sum(jnp.where(exp_span & is_digit,
+                                exp_digits.astype(jnp.int64) * weights, 0),
+                      axis=1)
+    exp_val = jnp.where(e_neg, -exp_val, exp_val)
+    exp_val = jnp.where(n_e > 0, exp_val, 0)
+
+    e_total = exp_val + e_adj
+    e_clip = jnp.clip(e_total, -400, 400).astype(jnp.float64)
+    value = mant.astype(jnp.float64) * jnp.power(jnp.float64(10.0), e_clip)
+    value = jnp.where(neg, -value, value)
+
+    num_ok = has_content & mant_ok & exp_ok & (n_e <= 1)
+    inf_v = jnp.where(neg, -jnp.inf, jnp.inf)
+    ok = has_content & (num_ok | inf_hit | nan_hit)
+    value = jnp.where(inf_hit, inf_v, value)
+    value = jnp.where(nan_hit, jnp.float64(np.nan), value)
+    return jnp.where(ok, value, 0.0), ok
+
+
+def parse_date(col: DeviceColumn, max_len: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    """yyyy[-m[m][-d[d]]] -> (epoch days int32, parse_ok)."""
+    from spark_rapids_tpu.expressions.datetime import _days_from_civil
+
+    mat, lens = string_byte_matrix(col, max_len)
+    cap, L = mat.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    b = mat.astype(jnp.int32)
+    first, last, has_content = _token_bounds(mat, lens)
+    in_tok = (pos >= first[:, None]) & (pos <= last[:, None])
+    is_digit = (b >= ord("0")) & (b <= ord("9"))
+    is_dash = (b == ord("-")) & in_tok
+    ndash = jnp.sum(is_dash, axis=1)
+    d1 = jnp.min(jnp.where(is_dash, pos, _BIG), axis=1)
+    d2 = jnp.max(jnp.where(is_dash, pos, -1), axis=1)
+
+    def seg_value(lo, hi):
+        """Digits value of token[lo..hi]; also returns length."""
+        span = (pos >= lo[:, None]) & (pos <= hi[:, None]) & in_tok
+        w = 10 ** jnp.clip(hi[:, None] - pos, 0, 9).astype(jnp.int64)
+        val = jnp.sum(jnp.where(span & is_digit,
+                                (b - ord("0")).astype(jnp.int64) * w, 0),
+                      axis=1)
+        n = jnp.sum(span, axis=1)
+        all_digits = jnp.all(jnp.where(span, is_digit, True), axis=1)
+        return val, n, all_digits
+
+    y_end = jnp.where(ndash >= 1, d1 - 1, last)
+    m_end = jnp.where(ndash >= 2, d2 - 1, last)
+    y, yn, yok = seg_value(first, y_end)
+    m, mn, mok = seg_value(d1 + 1, m_end)
+    d, dn, dok = seg_value(d2 + 1, last)
+    m = jnp.where(ndash >= 1, m, 1)
+    d = jnp.where(ndash >= 2, d, 1)
+    mn_ok = jnp.where(ndash >= 1, (mn >= 1) & (mn <= 2) & mok, True)
+    dn_ok = jnp.where(ndash >= 2, (dn >= 1) & (dn <= 2) & dok, True)
+
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    dim = jnp.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                    jnp.int64)[jnp.clip(m - 1, 0, 11)]
+    dim = jnp.where((m == 2) & leap, 29, dim)
+    ok = (has_content & (ndash <= 2) & (yn == 4) & yok & mn_ok & dn_ok
+          & (m >= 1) & (m <= 12) & (d >= 1) & (d <= dim))
+    days = _days_from_civil(y, m, d, jnp).astype(jnp.int32)
+    return jnp.where(ok, days, 0), ok
+
+
+_BOOL_TRUE = [b"t", b"true", b"y", b"yes", b"1"]
+_BOOL_FALSE = [b"f", b"false", b"n", b"no", b"0"]
+
+
+def parse_bool(col: DeviceColumn, max_len: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    mat, lens = string_byte_matrix(col, max_len)
+    first, last, has_content = _token_bounds(mat, lens)
+    t = jnp.zeros((mat.shape[0],), jnp.bool_)
+    f = jnp.zeros((mat.shape[0],), jnp.bool_)
+    for w in _BOOL_TRUE:
+        t = t | _token_matches(mat, first, last, w)
+    for w in _BOOL_FALSE:
+        f = f | _token_matches(mat, first, last, w)
+    ok = has_content & (t | f)
+    return t, ok
+
+
+# -- formatting (x -> string) ------------------------------------------------
+
+def build_string_column(mat: jax.Array, out_lens: jax.Array,
+                        validity: jax.Array) -> DeviceColumn:
+    """[capacity, W] byte matrix + per-row lengths -> STRING column with
+    byte capacity capacity*W."""
+    from spark_rapids_tpu import types as T
+    cap, W = mat.shape
+    lens = jnp.where(validity, out_lens, 0).astype(jnp.int32)
+    offsets = jnp.zeros((cap + 1,), jnp.int32).at[1:].set(jnp.cumsum(lens))
+    bcap = cap * W
+    bpos = jnp.arange(bcap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, bpos, side="right") - 1,
+                   0, cap - 1).astype(jnp.int32)
+    within = jnp.clip(bpos - offsets[row], 0, W - 1)
+    data = jnp.where(bpos < offsets[cap], mat[row, within], jnp.uint8(0))
+    return DeviceColumn(data, validity, T.STRING, offsets)
+
+
+_POW10_U64 = np.array([10**k for k in range(20)], np.uint64)
+
+
+def long_to_string(vals: jax.Array, validity: jax.Array) -> DeviceColumn:
+    """int64 -> decimal string (handles LONG_MIN via uint64 magnitude)."""
+    cap = vals.shape[0]
+    W = 20
+    neg = vals < 0
+    mag = jnp.where(neg, -(vals.astype(jnp.int64)), vals).astype(jnp.uint64)
+    pow10 = jnp.asarray(_POW10_U64)
+    nd = 1 + jnp.sum((mag[:, None] >= pow10[None, 1:]).astype(jnp.int32),
+                     axis=1)
+    length = nd + neg.astype(jnp.int32)
+    j = jnp.arange(W, dtype=jnp.int32)[None, :]
+    digit_exp = jnp.clip(length[:, None] - 1 - j, 0, 19)
+    digit = (mag[:, None] // pow10[digit_exp]) % jnp.uint64(10)
+    ch = (jnp.uint8(ord("0")) + digit.astype(jnp.uint8))
+    ch = jnp.where((j == 0) & neg[:, None], jnp.uint8(ord("-")), ch)
+    return build_string_column(ch, length, validity)
+
+
+def date_to_string(days: jax.Array, validity: jax.Array) -> DeviceColumn:
+    """epoch days -> 'yyyy-MM-dd' (years 0..9999)."""
+    from spark_rapids_tpu.expressions.datetime import _civil_from_days
+    y, m, d = _civil_from_days(days.astype(jnp.int64), jnp)
+    y = jnp.clip(y, 0, 9999)
+    cap = days.shape[0]
+    digs = jnp.stack([
+        y // 1000 % 10, y // 100 % 10, y // 10 % 10, y % 10,
+        jnp.full((cap,), -1, jnp.int64),
+        m // 10, m % 10,
+        jnp.full((cap,), -1, jnp.int64),
+        d // 10, d % 10,
+    ], axis=1)
+    ch = jnp.where(digs < 0, jnp.uint8(ord("-")),
+                   jnp.uint8(ord("0")) + digs.astype(jnp.uint8))
+    return build_string_column(ch, jnp.full((cap,), 10, jnp.int32), validity)
+
+
+def bool_to_string(vals: jax.Array, validity: jax.Array) -> DeviceColumn:
+    cap = vals.shape[0]
+    true_b = np.frombuffer(b"true\x00", np.uint8)
+    false_b = np.frombuffer(b"false", np.uint8)
+    mat = jnp.where(vals[:, None],
+                    jnp.asarray(true_b)[None, :],
+                    jnp.asarray(false_b)[None, :])
+    lens = jnp.where(vals, 4, 5).astype(jnp.int32)
+    return build_string_column(mat, lens, validity)

@@ -20,7 +20,12 @@ import jax.numpy as jnp
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import DeviceColumn
 
-OOB = jnp.int32(2**31 - 1)  # sentinel for "no source row"
+# sentinel for "no source row".  np (not jnp): a module-level device-array
+# constant closed over by traced functions gets hoisted into executables as
+# a parameter, which trips jax 0.9's dispatch when equivalent computations
+# are traced under more than one jit wrapper (see kernels/cast_strings.py)
+import numpy as _np
+OOB = _np.int32(2**31 - 1)
 
 
 @jax.tree_util.register_pytree_node_class

@@ -8,8 +8,11 @@ the plan; kernels trust the bucket.
 """
 from __future__ import annotations
 
+from typing import Tuple
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu.columnar.column import DeviceColumn
 
@@ -261,3 +264,332 @@ def trim_ws(col: DeviceColumn, num_rows) -> DeviceColumn:
                                   num_segments=col.capacity)
     keep = (bpos >= first_ns[row]) & (bpos <= last_ns[row])
     return _compact_bytes(col, keep, num_rows)
+
+
+def string_byte_matrix(col: DeviceColumn, max_len: int):
+    """Per-row byte windows: ([capacity, max_len] uint8, lengths int32).
+
+    Bytes beyond a row's length are zero; max_len must cover the longest
+    live row (callers derive it via live_string_bucket)."""
+    starts = col.offsets[:-1]
+    lens = col.offsets[1:] - starts
+    idx = starts[:, None] + jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    within = jnp.arange(max_len, dtype=jnp.int32)[None, :] < lens[:, None]
+    idx = jnp.clip(idx, 0, max(col.byte_capacity - 1, 0))
+    mat = jnp.where(within, col.data[idx], jnp.uint8(0))
+    return mat, lens
+
+
+def dfa_match(col: DeviceColumn, num_rows, table: jax.Array, accept: jax.Array,
+              start_state: int, max_len: int) -> jax.Array:
+    """Run a byte-DFA over every row; returns bool [capacity] match flags.
+
+    The TPU lowering of cuDF's regex kernel (reference consumption:
+    stringFunctions.scala RLIKE/regexp family): the host compiles the
+    pattern to a dense [S, 256] transition table (regex/automata.py) and
+    the device advances all rows in lockstep with one table gather per
+    byte position (`lax.scan` over the byte axis — rows parallel, steps
+    bounded by the string bucket).  Padding bytes beyond a row's length
+    leave its state untouched, so short rows simply finish early.
+    """
+    mat, lens = string_byte_matrix(col, max_len)
+    cap = col.capacity
+    state0 = jnp.full((cap,), jnp.int32(start_state))
+
+    def step(state, xs):
+        j, col_bytes = xs
+        nxt = table[state, col_bytes.astype(jnp.int32)]
+        return jnp.where(j < lens, nxt, state), None
+
+    xs = (jnp.arange(max_len, dtype=jnp.int32), jnp.transpose(mat))
+    state, _ = jax.lax.scan(step, state0, xs)
+    return accept[state]
+
+
+def ltrim_ws(col: DeviceColumn, num_rows) -> DeviceColumn:
+    """Spark LTRIM: strip leading ASCII spaces."""
+    row = _row_of_byte(col)
+    bpos = jnp.arange(col.byte_capacity, dtype=jnp.int32)
+    nonspace = (col.data != jnp.uint8(0x20)) & _live_byte_mask(col, num_rows)
+    INF = jnp.int32(2**30)
+    first_ns = jax.ops.segment_min(jnp.where(nonspace, bpos, INF), row,
+                                   num_segments=col.capacity)
+    keep = bpos >= first_ns[row]
+    return _compact_bytes(col, keep, num_rows)
+
+
+def rtrim_ws(col: DeviceColumn, num_rows) -> DeviceColumn:
+    """Spark RTRIM: strip trailing ASCII spaces."""
+    row = _row_of_byte(col)
+    bpos = jnp.arange(col.byte_capacity, dtype=jnp.int32)
+    nonspace = (col.data != jnp.uint8(0x20)) & _live_byte_mask(col, num_rows)
+    last_ns = jax.ops.segment_max(jnp.where(nonspace, bpos, -1), row,
+                                  num_segments=col.capacity)
+    keep = bpos <= last_ns[row]
+    return _compact_bytes(col, keep, num_rows)
+
+
+def reverse_chars(col: DeviceColumn, num_rows) -> DeviceColumn:
+    """Character-level reverse (multi-byte chars keep internal byte order)."""
+    row = _row_of_byte(col)
+    starts = col.offsets[:-1]
+    ends = col.offsets[1:]
+    bpos = jnp.arange(col.byte_capacity, dtype=jnp.int32)
+    lead = (col.data & jnp.uint8(0xC0)) != jnp.uint8(0x80)
+    live = _live_byte_mask(col, num_rows)
+    # char start position of each byte (within the flat buffer)
+    char_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(lead & live, bpos, -1))
+    char_start = jnp.maximum(char_start, starts[row])
+    # char length, recorded at each char's START position: the last byte of
+    # the char contributes (i - char_start[i]) + 1.  A row's final char is
+    # followed by dead padding whose carried char_start compares equal, so
+    # the liveness edge also terminates a char.
+    is_last_in_char = jnp.concatenate([
+        (char_start[1:] != char_start[:-1]) | ~live[1:],
+        jnp.ones((1,), jnp.bool_)])
+    clen = jnp.where(is_last_in_char & live, bpos - char_start + 1, 0)
+    char_len = jax.ops.segment_max(
+        jnp.where(live, clen, 0),
+        jnp.clip(char_start, 0, col.byte_capacity - 1),
+        num_segments=col.byte_capacity)
+    # mirrored DESTINATION of each byte:
+    # row_start + (row_end - char_start - char_len) + in-char offset;
+    # scatter (the map is not an involution for multi-byte chars)
+    cs = char_start
+    cl = char_len[jnp.clip(cs, 0, col.byte_capacity - 1)]
+    mirrored = starts[row] + (ends[row] - cs - cl) + (bpos - cs)
+    dest = jnp.where(live, jnp.clip(mirrored, 0, col.byte_capacity - 1),
+                     col.byte_capacity)
+    data = jnp.zeros((col.byte_capacity,), jnp.uint8).at[dest].set(
+        col.data, mode="drop")
+    return DeviceColumn(data, col.validity, col.dtype, col.offsets)
+
+
+def initcap_ascii(col: DeviceColumn, num_rows) -> DeviceColumn:
+    """Spark INITCAP (ASCII letters): uppercase the first letter of each
+    whitespace-separated word, lowercase the rest."""
+    prev = jnp.concatenate([jnp.full((1,), jnp.uint8(0x20), jnp.uint8),
+                            col.data[:-1]])
+    row = _row_of_byte(col)
+    row_first = col.offsets[:-1][row] == jnp.arange(col.byte_capacity,
+                                                    dtype=jnp.int32)
+    after_space = (prev == jnp.uint8(0x20)) | row_first
+    b = col.data
+    is_lower = (b >= jnp.uint8(0x61)) & (b <= jnp.uint8(0x7A))
+    is_upper = (b >= jnp.uint8(0x41)) & (b <= jnp.uint8(0x5A))
+    up = jnp.where(is_lower & after_space, b - jnp.uint8(0x20), b)
+    data = jnp.where(is_upper & ~after_space, up + jnp.uint8(0x20), up)
+    return DeviceColumn(data, col.validity, col.dtype, col.offsets)
+
+
+def first_occurrence_char(col: DeviceColumn, pattern: bytes, num_rows,
+                          start_char=None) -> jax.Array:
+    """1-based char index of the first occurrence of `pattern` at/after
+    1-based char `start_char` (default 1); 0 if absent (Spark instr/locate
+    semantics).  Empty pattern -> start position."""
+    row = _row_of_byte(col)
+    starts = col.offsets[:-1]
+    live = _live_byte_mask(col, num_rows)
+    lead = ((col.data & jnp.uint8(0xC0)) != jnp.uint8(0x80)) & live
+    bpos = jnp.arange(col.byte_capacity, dtype=jnp.int32)
+    # char rank (0-based) of each byte within its row
+    cum = jnp.cumsum(lead.astype(jnp.int32))
+    row_start_cum = cum[jnp.clip(starts - 1, 0, None)]
+    row_start_cum = jnp.where(starts == 0, 0, row_start_cum)
+    char_rank = cum - 1 - row_start_cum[row]
+    if start_char is None:
+        start0 = jnp.zeros((col.capacity,), jnp.int32)
+    else:
+        start0 = jnp.maximum(start_char.astype(jnp.int32) - 1, 0)
+    if len(pattern) == 0:
+        n = char_length(col, num_rows)
+        return jnp.where(start0 <= n, start0 + 1, 0)
+    hits = _pattern_hits(col, pattern) & live & lead
+    eligible = hits & (char_rank >= start0[row])
+    INF = jnp.int32(2**30)
+    first = jax.ops.segment_min(jnp.where(eligible, char_rank, INF), row,
+                                num_segments=col.capacity)
+    return jnp.where(first >= INF, 0, first + 1)
+
+
+def repeat_string(col: DeviceColumn, num_rows, n: jax.Array,
+                  out_byte_capacity: int) -> Tuple[DeviceColumn, jax.Array]:
+    """str repeated n times per row (n<=0 -> empty).  Returns (column,
+    required_bytes) — callers run under capacity retry."""
+    starts = col.offsets[:-1]
+    lens = col.offsets[1:] - starts
+    live = jnp.arange(col.capacity, dtype=jnp.int32) < num_rows
+    reps = jnp.maximum(n.astype(jnp.int64), 0)
+    out_len = jnp.where(live & col.validity, lens.astype(jnp.int64) * reps, 0)
+    required = jnp.sum(out_len)
+    offsets = jnp.zeros((col.capacity + 1,), jnp.int32).at[1:].set(
+        jnp.cumsum(out_len).astype(jnp.int32))
+    bpos = jnp.arange(out_byte_capacity, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, bpos, side="right") - 1,
+                   0, col.capacity - 1).astype(jnp.int32)
+    within = bpos - offsets[row]
+    ln = jnp.maximum(lens[row], 1)
+    src = starts[row] + within % ln
+    src = jnp.clip(src, 0, col.byte_capacity - 1)
+    data = jnp.where(bpos < offsets[col.capacity], col.data[src],
+                     jnp.uint8(0))
+    return (DeviceColumn(data, col.validity, col.dtype, offsets),
+            required)
+
+
+def pad_chars(col: DeviceColumn, num_rows, target_len: jax.Array,
+              pad: bytes, left: bool,
+              out_byte_capacity: int) -> Tuple[DeviceColumn, jax.Array]:
+    """Spark LPAD/RPAD (character semantics, ASCII pad strings): pad or
+    truncate each row to target_len characters."""
+    if len(pad) == 0:
+        pad = b" "   # empty pad: Spark truncates only; spaces never emitted
+        pad_allowed = False
+    else:
+        pad_allowed = True
+    row0 = _row_of_byte(col)
+    starts = col.offsets[:-1]
+    lens = col.offsets[1:] - starts
+    live = jnp.arange(col.capacity, dtype=jnp.int32) < num_rows
+    nchars = char_length(col, num_rows)
+    tgt = jnp.maximum(target_len.astype(jnp.int32), 0)
+    keep_chars = jnp.minimum(nchars, tgt)
+    pad_chars_n = jnp.where(pad_allowed, jnp.maximum(tgt - nchars, 0), 0)
+    # byte length of the kept prefix: bytes whose char_rank < keep_chars
+    lead = ((col.data & jnp.uint8(0xC0)) != jnp.uint8(0x80)) & \
+        _live_byte_mask(col, num_rows)
+    cum = jnp.cumsum(lead.astype(jnp.int32))
+    rsc = cum[jnp.clip(starts - 1, 0, None)]
+    rsc = jnp.where(starts == 0, 0, rsc)
+    char_rank = cum - 1 - rsc[row0]
+    keep_byte = char_rank < keep_chars[row0]
+    keep_bytes_n = jax.ops.segment_sum(
+        (keep_byte & _live_byte_mask(col, num_rows)).astype(jnp.int32),
+        row0, num_segments=col.capacity)
+    out_len = jnp.where(live & col.validity,
+                        keep_bytes_n + pad_chars_n, 0)
+    offsets = jnp.zeros((col.capacity + 1,), jnp.int32).at[1:].set(
+        jnp.cumsum(out_len))
+    required = jnp.sum(out_len.astype(jnp.int64))
+    pad_arr = jnp.asarray(np.frombuffer(pad, np.uint8))
+    bpos = jnp.arange(out_byte_capacity, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, bpos, side="right") - 1,
+                   0, col.capacity - 1).astype(jnp.int32)
+    within = bpos - offsets[row]
+    if left:
+        in_pad = within < pad_chars_n[row]
+        pad_idx = within % len(pad)
+        src_off = within - pad_chars_n[row]
+    else:
+        in_pad = within >= keep_bytes_n[row]
+        pad_idx = (within - keep_bytes_n[row]) % len(pad)
+        src_off = within
+    src = jnp.clip(starts[row] + src_off, 0, col.byte_capacity - 1)
+    data = jnp.where(in_pad, pad_arr[pad_idx], col.data[src])
+    data = jnp.where(bpos < offsets[col.capacity], data, jnp.uint8(0))
+    return (DeviceColumn(data, col.validity, col.dtype, offsets), required)
+
+
+def replace_literal(col: DeviceColumn, num_rows, search: bytes,
+                    replace: bytes, max_len: int) -> DeviceColumn:
+    """Spark replace(str, search, replace) with literal arguments:
+    left-to-right non-overlapping occurrences.  Works over the per-row
+    [capacity, max_len] byte window (max_len = the threaded string bucket);
+    output window is max_len * max(1, ceil(len(replace)/len(search)))
+    so growth never truncates."""
+    m = len(search)
+    assert m >= 1, "empty search is identity (planner folds it)"
+    mr = len(replace)
+    mat, lens = string_byte_matrix(col, max_len)
+    cap, L = mat.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_row = pos < lens[:, None]
+    # window-level pattern hits (complete match within the row)
+    hit = (pos + m) <= lens[:, None]
+    for i, pb in enumerate(search):
+        idx = jnp.clip(pos + i, 0, L - 1)
+        hit = hit & (jnp.take_along_axis(mat, idx, axis=1) == jnp.uint8(pb))
+    # greedy non-overlapping selection: countdown scan over the window
+    def step(cd, xs):
+        h = xs
+        take = h & (cd == 0)
+        cd = jnp.where(take, m - 1, jnp.maximum(cd - 1, 0))
+        return cd, take
+    _, taken_t = jax.lax.scan(step, jnp.zeros((cap,), jnp.int32),
+                              jnp.transpose(hit))
+    taken = jnp.transpose(taken_t)          # [cap, L] match starts
+    # last taken start at/before each position (cummax along the window)
+    last_take = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(taken, pos, -1), axis=1)
+    inside = (last_take >= 0) & (pos - last_take < m) & (pos > last_take)
+    emit = jnp.where(taken, mr, jnp.where(inside | ~in_row, 0, 1))
+    out_len = jnp.sum(emit, axis=1).astype(jnp.int32)
+    emit_off = jnp.cumsum(emit, axis=1) - emit   # exclusive, per row
+    W_out = L * max(1, -(-mr // m))
+    j = jnp.arange(W_out, dtype=jnp.int32)[None, :]
+    # source window byte for each output position: first i whose inclusive
+    # emitted-bytes cumsum exceeds j (plateaus skip emit==0 positions)
+    cum_incl = jnp.cumsum(emit, axis=1)     # [cap, L] ascending
+    src_i = jax.vmap(lambda cu, jj: jnp.clip(
+        jnp.searchsorted(cu, jj, side="right"), 0, L - 1))(
+        cum_incl, jnp.broadcast_to(j, (cap, W_out)))
+    off_in = j - jnp.take_along_axis(emit_off, src_i, axis=1)
+    src_taken = jnp.take_along_axis(taken, src_i, axis=1)
+    repl_arr = (jnp.asarray(np.frombuffer(replace, np.uint8))
+                if mr else jnp.zeros((1,), jnp.uint8))
+    out_byte = jnp.where(
+        src_taken,
+        repl_arr[jnp.clip(off_in, 0, max(mr - 1, 0))],
+        jnp.take_along_axis(mat, src_i, axis=1))
+    out_byte = jnp.where(j < out_len[:, None], out_byte, jnp.uint8(0))
+    from spark_rapids_tpu.kernels.cast_strings import build_string_column
+    out = build_string_column(out_byte, out_len, col.validity)
+    return DeviceColumn(out.data, col.validity, col.dtype, out.offsets)
+
+
+def concat_ws(cols, sep: bytes, num_rows) -> DeviceColumn:
+    """Spark concat_ws(sep, cols...): join NON-NULL values with sep (nulls
+    are skipped, not propagated; all-null/empty -> empty string, not null).
+    """
+    k = len(cols)
+    assert k >= 1
+    cap = cols[0].capacity
+    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    lens = [c.offsets[1:] - c.offsets[:-1] for c in cols]
+    valid = [c.validity & live for c in cols]
+    vlens = [jnp.where(v, l, 0) for v, l in zip(valid, lens)]
+    nvalid = sum(v.astype(jnp.int32) for v in valid)
+    total = sum(vlens) + len(sep) * jnp.maximum(nvalid - 1, 0)
+    out_len = jnp.where(live, total, 0).astype(jnp.int32)
+    offsets = jnp.zeros((cap + 1,), jnp.int32).at[1:].set(jnp.cumsum(out_len))
+    bcap = int(sum(c.byte_capacity for c in cols)) + cap * len(sep) * max(k - 1, 0)
+    bpos = jnp.arange(bcap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, bpos, side="right") - 1,
+                   0, cap - 1).astype(jnp.int32)
+    within = bpos - offsets[row]
+    out = jnp.zeros((bcap,), jnp.uint8)
+    sep_arr = (jnp.asarray(np.frombuffer(sep, np.uint8)) if sep
+               else jnp.zeros((1,), jnp.uint8))
+    # walk the 2k-1 segments (value, sep, value, ...) with running starts
+    seg_start = jnp.zeros((cap,), jnp.int32)
+    seen_valid = jnp.zeros((cap,), jnp.int32)
+    for ci, c in enumerate(cols):
+        if ci > 0 and sep:
+            sep_here = valid[ci] & (seen_valid > 0)
+            sep_len = jnp.where(sep_here, len(sep), 0)
+            in_seg = (within >= seg_start[row]) & \
+                (within < (seg_start + sep_len)[row])
+            out = jnp.where(in_seg, sep_arr[jnp.clip(
+                (within - seg_start[row]) % len(sep), 0, len(sep) - 1)], out)
+            seg_start = seg_start + sep_len
+        vl = vlens[ci]
+        in_seg = (within >= seg_start[row]) & (within < (seg_start + vl)[row])
+        src = jnp.clip(c.offsets[:-1][row] + (within - seg_start[row]),
+                       0, c.byte_capacity - 1)
+        out = jnp.where(in_seg, c.data[src], out)
+        seg_start = seg_start + vl
+        seen_valid = seen_valid + valid[ci].astype(jnp.int32)
+    out = jnp.where(bpos < offsets[cap], out, jnp.uint8(0))
+    from spark_rapids_tpu import types as T
+    return DeviceColumn(out, live, T.STRING, offsets)

@@ -209,6 +209,100 @@ class timed:
         return False
 
 
+def collect_trace_consts(exprs):
+    """Gather per-expression device constants (e.g. compiled DFA tables)
+    from an expression tree, in deterministic walk order.
+
+    These must enter jitted step functions as ARGUMENTS, not closed-over
+    concrete arrays: a closed-over array becomes a hoisted executable
+    parameter, which trips jax-0.9 dispatch when equivalent computations
+    are traced under more than one jit wrapper (kernels/cast_strings.py
+    note).  Returns a flat list of arrays; bind_trace_consts() re-attaches
+    them inside the trace by repeating the same walk.
+    """
+    out = []
+
+    def walk(e):
+        tc = getattr(e, "trace_consts", None)
+        if tc is not None:
+            out.extend(tc())
+        for c in e.children:
+            walk(c)
+    for e in exprs:
+        walk(e)
+    return out
+
+
+def bind_trace_consts(exprs, arrays):
+    """exprs + flat (possibly traced) array list -> {id(expr): [arrays]}."""
+    mapping = {}
+    it = iter(arrays)
+
+    def walk(e):
+        tc = getattr(e, "trace_consts", None)
+        if tc is not None:
+            n = len(tc())
+            mapping[id(e)] = [next(it) for _ in range(n)]
+        for c in e.children:
+            walk(c)
+    for e in exprs:
+        walk(e)
+    return mapping
+
+
+def tree_uses_string_bucket(exprs) -> bool:
+    """Does any expression subtree contain a byte-window (regex/DFA) node
+    that needs a static string bucket threaded through EvalContext?"""
+    def walk(e) -> bool:
+        if getattr(e, "uses_string_bucket", False):
+            return True
+        return any(walk(c) for c in e.children)
+    return any(walk(e) for e in exprs)
+
+
+def regex_bucket(batch, exprs) -> int:
+    """STATIC byte bound for the regex/byte-window expressions in `exprs`:
+    the max live string length over the batch's string columns, maxed with
+    any string-literal byte length in the trees (a CASE branch returning a
+    literal longer than every column value must still fit the window).
+    Safe for the non-growing string children the planner admits under
+    regex nodes.  Returns 0 when no subtree needs one (no device sync)."""
+    if not tree_uses_string_bucket(exprs):
+        return 0
+    from spark_rapids_tpu.expressions.core import Literal
+    from spark_rapids_tpu.kernels import strings as SK
+    m = 0
+    for col in batch.columns:
+        if col.is_string_like:
+            m = max(m, int(SK.max_live_string_bytes(col, batch.num_rows)))
+
+    def walk(e):
+        nonlocal m
+        if isinstance(e, Literal) and isinstance(e.value, str):
+            m = max(m, len(e.value.encode("utf-8")))
+        for c in e.children:
+            walk(c)
+    for e in exprs:
+        walk(e)
+    return SK.bucket_for(m)
+
+
+def jit_bucketed_step(key: str, exprs, make_call):
+    """Shared project/filter wiring: collect trace consts once, then per
+    batch compute the static regex bucket, key the shared_jit cache on it,
+    and invoke with (batch, consts).  ``make_call(string_bucket)`` returns
+    the traceable fn(batch, consts)."""
+    import jax.numpy as _jnp
+    exprs = tuple(exprs)
+    consts = tuple(_jnp.asarray(a) for a in collect_trace_consts(exprs))
+
+    def call(batch):
+        bkt = regex_bucket(batch, exprs)
+        fn = shared_jit(f"{key}|{bkt}", lambda: make_call(bkt))
+        return fn(batch, consts)
+    return call
+
+
 def string_key_bucket(batch, exprs) -> int:
     """Shared max-bytes bucket over BoundReference string key expressions
     (one tiny device sync per string key; 0 when no string keys).  The

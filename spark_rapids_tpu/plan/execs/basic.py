@@ -36,14 +36,21 @@ class TpuProjectExec(TpuExec):
         self.exprs = tuple(exprs)
         exprs_t, out_schema = self.exprs, schema   # no self-capture (cache pins)
 
-        def run(batch: ColumnarBatch) -> ColumnarBatch:
-            ctx = EvalContext(batch)
+        from functools import partial as _p
+        from spark_rapids_tpu.plan.execs.base import (
+            bind_trace_consts, jit_bucketed_step)
+
+        def run(batch: ColumnarBatch, consts, string_bucket: int = 0
+                ) -> ColumnarBatch:
+            ctx = EvalContext(batch, string_bucket=string_bucket,
+                              trace_consts=bind_trace_consts(exprs_t, consts))
             cols = tuple(e.eval(ctx) for e in exprs_t)
             return ColumnarBatch(cols, batch.num_rows, out_schema)
 
-        self._run = shared_jit(
-            f"project|{schema_cache_key(child.schema)}|"
-            f"{exprs_cache_key(self.exprs)}", lambda: run)
+        key = (f"project|{schema_cache_key(child.schema)}|"
+               f"{exprs_cache_key(self.exprs)}")
+        self._run = jit_bucketed_step(
+            key, self.exprs, lambda bkt: _p(run, string_bucket=bkt))
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         for batch in self.children[0].execute_partition(idx):
@@ -62,18 +69,25 @@ class TpuFilterExec(TpuExec):
         self.condition = condition
 
         cond = condition   # no self-capture (cache pins)
+        from functools import partial as _p
+        from spark_rapids_tpu.plan.execs.base import (
+            bind_trace_consts, jit_bucketed_step)
 
-        def run(batch: ColumnarBatch) -> ColumnarBatch:
-            pred = cond.eval(EvalContext(batch))
+        def run(batch: ColumnarBatch, consts, string_bucket: int = 0
+                ) -> ColumnarBatch:
+            ctx = EvalContext(batch, string_bucket=string_bucket,
+                              trace_consts=bind_trace_consts([cond], consts))
+            pred = cond.eval(ctx)
             mask = pred.data & pred.validity & batch.live_mask()
             indices, count = compaction_map(mask)
             # output capacity = input capacity: a filter never grows, so
             # there is no overflow path here
             return gather_batch(batch, indices, count)
 
-        self._run = shared_jit(
-            f"filter|{schema_cache_key(child.schema)}|"
-            f"{expr_cache_key(condition)}", lambda: run)
+        key = (f"filter|{schema_cache_key(child.schema)}|"
+               f"{expr_cache_key(condition)}")
+        self._run = jit_bucketed_step(
+            key, [condition], lambda bkt: _p(run, string_bucket=bkt))
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         for batch in self.children[0].execute_partition(idx):

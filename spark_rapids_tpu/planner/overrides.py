@@ -41,8 +41,10 @@ from spark_rapids_tpu.plan.execs.scan import (
 from spark_rapids_tpu.plan.execs.sort import TpuLimitExec, TpuSortExec
 
 from spark_rapids_tpu.expressions.strings import (
-    Contains, ConcatStrings, EndsWith, Length, Like, Lower, StartsWith,
-    Substring, Trim, Upper)
+    Ascii, ConcatStrings, ConcatWs, Contains, EndsWith, InitCap, Length,
+    Like, Lower, Lpad, LTrim, RLike, RTrim, Reverse, Rpad, StartsWith,
+    StringInstr, StringLocate, StringRepeat, StringReplace, Substring,
+    Trim, Upper)
 
 # expression classes with device twins; the TypeSig-style dtype gate is
 # checked separately (supported_dtype)
@@ -55,9 +57,24 @@ _SUPPORTED_EXPRS = {
     If, CaseWhen, Cast,
     A.Sum, A.Count, A.Min, A.Max, A.Average,
     A.VarianceSamp, A.VariancePop, A.StddevSamp, A.StddevPop,
-    Length, Upper, Lower, Substring, ConcatStrings, Trim,
-    StartsWith, EndsWith, Contains, Like,
+    Length, Upper, Lower, Substring, ConcatStrings, Trim, LTrim, RTrim,
+    StartsWith, EndsWith, Contains, Like, RLike, Reverse, InitCap,
+    StringReplace, StringLocate, StringInstr, Ascii, StringRepeat,
+    Lpad, Rpad, ConcatWs,
 }
+
+# string producers that never grow byte lengths: safe under a regex/DFA
+# node whose string bucket is derived from the batch's source columns
+_NON_GROWING_STRING_EXPRS = {
+    E.Alias, E.BoundReference, E.Literal, Upper, Lower, Trim, Substring,
+    If, CaseWhen, Coalesce,
+}
+
+
+def _regex_child_ok(e) -> bool:
+    if type(e) not in _NON_GROWING_STRING_EXPRS:
+        return False
+    return all(_regex_child_ok(c) for c in e.children)
 
 from spark_rapids_tpu.expressions.window import (
     DenseRank, Lag, Lead, Rank, RowNumber, WindowExpression)
@@ -136,14 +153,52 @@ class ExprMeta:
             if isinstance(e, Cast) and not Cast.supported(e.child.dtype, e.dtype):
                 self.will_not_work(
                     f"cast {e.child.dtype!r} -> {e.dtype!r} is not supported")
+            if isinstance(e, Cast) and getattr(
+                    e, "uses_string_bucket", False) and \
+                    not _regex_child_ok(e.child):
+                self.will_not_work(
+                    f"string cast over {e.child!r}: only non-growing "
+                    "string inputs supported (project it first)")
             if isinstance(e, (StartsWith, EndsWith, Contains)) and \
                     not isinstance(e.right, E.Literal):
                 self.will_not_work(
                     "non-literal match patterns are not supported yet")
-            if isinstance(e, Like) and not Like.supported_pattern(e.pattern):
+            if isinstance(e, ConcatWs):
+                for c in e.children:
+                    try:
+                        if not isinstance(c.dtype, T.StringType):
+                            self.will_not_work(
+                                f"concat_ws over non-string {c!r}")
+                    except (TypeError, ValueError, NotImplementedError):
+                        pass
+            if isinstance(e, StringRepeat) and e.n > 64:
                 self.will_not_work(
-                    f"LIKE pattern {e.pattern!r} needs the general regex "
-                    "engine (only prefix/suffix/contains shapes run on TPU)")
+                    f"repeat({e.n}) exceeds the static growth bound")
+            if isinstance(e, (Lpad, Rpad)):
+                if e.length > 1 << 16:
+                    self.will_not_work("pad length exceeds the static bound")
+                if any(ord(ch) > 0x7F for ch in e.pad):
+                    self.will_not_work(
+                        "non-ASCII pad strings pad by bytes on device "
+                        "(character padding needs the multi-byte kernel)")
+            if isinstance(e, StringReplace) and not _regex_child_ok(
+                    e.children[0]):
+                self.will_not_work(
+                    f"replace over {e.children[0]!r}: only non-growing "
+                    "string inputs supported (project it first)")
+            if isinstance(e, (Like, RLike)) and getattr(
+                    e, "uses_string_bucket", False):
+                from spark_rapids_tpu.regex import RegexUnsupported
+                try:
+                    e._compiled()
+                except RegexUnsupported as ex:
+                    self.will_not_work(
+                        f"pattern {e.pattern!r} outside the supported "
+                        f"regex dialect: {ex}")
+                if not _regex_child_ok(e.children[0]):
+                    self.will_not_work(
+                        f"regex over {e.children[0]!r}: only non-growing "
+                        "string inputs supported (project it first)")
         for c in self.children:
             c.tag()
 
@@ -200,6 +255,16 @@ class PlanMeta:
         p = self.plan
         for em in self.expr_metas:
             em.tag()
+        if not isinstance(p, (L.Project, L.Filter)):
+            # regex/DFA expressions need the string bucket threading that
+            # only the project/filter execs implement
+            from spark_rapids_tpu.plan.execs.base import (
+                tree_uses_string_bucket)
+            for e in self._expressions():
+                if tree_uses_string_bucket([e]):
+                    self.will_not_work(
+                        f"regex expression {e!r} only supported in "
+                        "project/filter (move it there)")
         if isinstance(p, L.Join):
             for e in list(p.left_keys) + list(p.right_keys):
                 if not _key_expr_ok(e):

@@ -1,0 +1,62 @@
+"""Regex engine: Java-regex subset -> byte-level DFA for device matching.
+
+The reference ships a full Java-regex parser + transpiler into cuDF's regex
+dialect with per-pattern supportability tagging (RegexParser.scala:696,
+CudfRegexTranspiler); unsupported patterns fall back to CPU.  The TPU
+answer replaces the target dialect with a **compiled DFA**: patterns are
+parsed and lowered on the host to a dense byte-transition table, and the
+device match is a `lax.scan` over per-row byte windows — rows in parallel,
+one table gather per step (kernels/strings.py dfa_match).  Patterns the
+parser or the DFA budget cannot handle raise RegexUnsupported, which the
+planner turns into the same CPU-fallback tagging as the reference.
+
+Match modes (what RLIKE/LIKE/regexp_like need):
+  * search ("contains"): Spark RLIKE — unanchored java.util.regex find()
+  * full: entire string must match (LIKE lowering, regexp full-match)
+Anchors ^/$ are honored at pattern boundaries and rewrite the mode.
+"""
+from spark_rapids_tpu.regex.parser import RegexUnsupported, parse
+from spark_rapids_tpu.regex.automata import (
+    CompiledRegex,
+    compile_like,
+    compile_regex,
+)
+
+
+def is_supported(pattern: str) -> bool:
+    try:
+        compile_regex(pattern)
+        return True
+    except RegexUnsupported:
+        return False
+
+
+def to_python_pattern(pattern: str) -> str:
+    """Translate the supported Java-regex dialect to Python `re` source for
+    the CPU oracle (use with re.ASCII so \\d/\\w/\\s match Java's defaults).
+    The one source-level difference is '.': Java excludes all five line
+    terminators, Python only \\n."""
+    out = []
+    i = 0
+    in_class = False
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "\\" and i + 1 < len(pattern):
+            out.append(pattern[i:i + 2])
+            i += 2
+            continue
+        if c == "[" and not in_class:
+            in_class = True
+        elif c == "]" and in_class:
+            in_class = False
+        elif c == "." and not in_class:
+            out.append("[^\\n\\r\\u0085\\u2028\\u2029]")
+            i += 1
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+__all__ = ["CompiledRegex", "RegexUnsupported", "compile_like",
+           "compile_regex", "is_supported", "parse", "to_python_pattern"]

@@ -5,9 +5,8 @@ Same stance as testing/tpch.py: distributions follow the TPC-DS spec shapes
 (surrogate-keyed dims, fact rows clustered on dates) so join selectivities
 and group cardinalities are realistic; generation code is original.
 
-The string dimension columns (brand names etc.) are generated as integers
-until string compute lands — the join/agg shapes the gate measures are
-unaffected.
+Dimension string columns (i_brand, i_category, d_day_name) are real
+strings, as in the spec — q3 groups on i_brand the way the real query does.
 """
 from __future__ import annotations
 
@@ -32,13 +31,16 @@ DATE_DIM_SCHEMA = Schema.of(
     d_date_sk=T.INT,
     d_year=T.INT,
     d_moy=T.INT,
+    d_day_name=T.STRING,
 )
 
 ITEM_SCHEMA = Schema.of(
     i_item_sk=T.INT,
     i_brand_id=T.INT,
+    i_brand=T.STRING,
     i_manufact_id=T.INT,
     i_category_id=T.INT,
+    i_category=T.STRING,
 )
 
 
@@ -48,19 +50,29 @@ def gen_date_dim() -> ColumnarBatch:
     sk = np.arange(2450000, 2450000 + n, dtype=np.int32)
     year = 1998 + (np.arange(n) // 365)
     moy = 1 + (np.arange(n) % 365) // 31
+    day_names = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+                 "Friday", "Saturday"]
     return ColumnarBatch.from_pydict(
         {"d_date_sk": sk.tolist(), "d_year": year.tolist(),
-         "d_moy": np.minimum(moy, 12).tolist()},
+         "d_moy": np.minimum(moy, 12).tolist(),
+         "d_day_name": [day_names[i % 7] for i in range(n)]},
         DATE_DIM_SCHEMA)
 
 
 def gen_item(n_items: int = 2000, seed: int = 11) -> ColumnarBatch:
     rng = np.random.RandomState(seed)
+    cats = ["Home", "Books", "Electronics", "Jewelry", "Music", "Shoes",
+            "Sports", "Women", "Men", "Children", "Hobbies"]
+    brand_id = rng.randint(1, 100, n_items)
+    manu_id = rng.randint(1, 120, n_items)
+    cat_id = rng.randint(1, 12, n_items)
     return ColumnarBatch.from_pydict(
         {"i_item_sk": list(range(1, n_items + 1)),
-         "i_brand_id": rng.randint(1, 100, n_items).tolist(),
-         "i_manufact_id": rng.randint(1, 120, n_items).tolist(),
-         "i_category_id": rng.randint(1, 12, n_items).tolist()},
+         "i_brand_id": brand_id.tolist(),
+         "i_brand": [f"Brand#{b}{m % 10}" for b, m in zip(brand_id, manu_id)],
+         "i_manufact_id": manu_id.tolist(),
+         "i_category_id": cat_id.tolist(),
+         "i_category": [cats[(c - 1) % 11] for c in cat_id]},
         ITEM_SCHEMA)
 
 
@@ -118,7 +130,7 @@ def q3(store_sales_df, date_dim_df, item_df):
               .join(item_df, on=([col("ss_item_sk")], [col("i_item_sk")])))
     return (joined
             .filter((col("i_manufact_id") == lit(28)) & (col("d_moy") == lit(11)))
-            .group_by("d_year", "i_brand_id")
+            .group_by("d_year", "i_brand_id", "i_brand")
             .agg(sum_("ss_ext_sales_price").alias("sum_agg"))
             .order_by(("d_year", SortOrder(True)),
                       ("sum_agg", SortOrder(False)),
@@ -143,10 +155,11 @@ def q14a_subset(store_sales_df, item_df):
     semi-join item filter."""
     from spark_rapids_tpu.expressions import avg, col, count, lit, sum_
     hot_items = (item_df.filter(col("i_category_id") <= lit(3))
-                 .select("i_item_sk", "i_brand_id", "i_category_id"))
+                 .select("i_item_sk", "i_brand_id", "i_category",
+                         "i_category_id"))
     return (store_sales_df
             .join(hot_items, on=([col("ss_item_sk")], [col("i_item_sk")]))
-            .group_by("i_brand_id", "i_category_id")
+            .group_by("i_brand_id", "i_category")
             .agg(sum_(col("ss_ext_sales_price")).alias("sales"),
                  count().alias("n"),
                  avg("ss_quantity").alias("avg_qty")))

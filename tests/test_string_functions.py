@@ -1,0 +1,79 @@
+"""Differential tests for the extended string function family.
+
+Reference analog: string_test.py over stringFunctions.scala (GpuStringReplace,
+GpuStringLocate/Instr, GpuStringLPad/RPad, GpuStringRepeat, GpuInitCap,
+GpuStringReverse, GpuStringTrimLeft/Right, GpuAscii, GpuConcatWs).
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import (
+    Ascii, ConcatWs, InitCap, Lpad, LTrim, Reverse, Rpad, StringInstr,
+    StringLocate, StringRepeat, StringReplace, RTrim, col,
+)
+
+from test_queries import assert_tpu_cpu_equal
+
+VALS = ["hello world", "  padded  ", "", "a", "ababab", "The Quick brown",
+        "x,y,z", "aaa", "Mixed CASE text", None, "tab\there", "ünïcode",
+        "ends with space ", " leading", "a.b.c.d", "no-match", None,
+        "ααβ", "repeatrepeat", "...dots..."]
+
+
+def _src(sess, extra_col=False):
+    data = {"s": list(VALS)}
+    schema = Schema.of(s=T.STRING)
+    if extra_col:
+        data["t"] = [("T" + (v or "")) if i % 3 else None
+                     for i, v in enumerate(VALS)]
+        schema = Schema.of(s=T.STRING, t=T.STRING)
+    return sess.create_dataframe(
+        [ColumnarBatch.from_pydict(data, schema)], num_partitions=1)
+
+
+@pytest.mark.parametrize("make", [
+    lambda: LTrim(col("s")),
+    lambda: RTrim(col("s")),
+    lambda: Reverse(col("s")),
+    lambda: InitCap(col("s")),
+    lambda: Ascii(col("s")),
+    lambda: StringReplace(col("s"), "a", "XY"),
+    lambda: StringReplace(col("s"), "ab", ""),
+    lambda: StringReplace(col("s"), ".", "--"),
+    lambda: StringReplace(col("s"), "aa", "b"),
+    lambda: StringInstr(col("s"), "b"),
+    lambda: StringInstr(col("s"), "zzz"),
+    lambda: StringInstr(col("s"), ""),
+    lambda: StringLocate("a", col("s"), 3),
+    lambda: StringLocate("a", col("s"), 0),
+    lambda: StringRepeat(col("s"), 3),
+    lambda: StringRepeat(col("s"), 0),
+    lambda: Lpad(col("s"), 8, "*"),
+    lambda: Lpad(col("s"), 3, "xy"),
+    lambda: Rpad(col("s"), 8, "*"),
+    lambda: Rpad(col("s"), 0, "z"),
+], ids=["ltrim", "rtrim", "reverse", "initcap", "ascii", "replace",
+        "replace-del", "replace-dot", "replace-aa", "instr", "instr-miss",
+        "instr-empty", "locate3", "locate0", "repeat3", "repeat0",
+        "lpad", "lpad-trunc", "rpad", "rpad0"])
+def test_string_fn(make):
+    assert_tpu_cpu_equal(
+        lambda s: _src(s).select(col("s"), make().alias("r")))
+
+
+def test_concat_ws():
+    assert_tpu_cpu_equal(
+        lambda s: _src(s, extra_col=True).select(
+            col("s"), col("t"),
+            ConcatWs("-", col("s"), col("t")).alias("r"),
+            ConcatWs("", col("s"), col("t"), col("s")).alias("r2")))
+
+
+def test_string_fns_run_on_tpu():
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = _src(s).select(StringReplace(col("s"), "a", "b").alias("r"),
+                       Reverse(col("s")).alias("v")).explain()
+    assert "will NOT" not in e, e

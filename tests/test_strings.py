@@ -85,11 +85,12 @@ def test_string_exprs_run_on_tpu():
     assert "will NOT" not in e, e
 
 
-def test_general_like_falls_back():
+def test_general_like_runs_on_tpu():
+    # interior wildcards now compile to the byte-DFA (regex engine) instead
+    # of falling back
     s = TpuSession({"spark.rapids.sql.enabled": "true"})
     df = strings_df(s).select(Like(col("s"), "a_b%c").alias("r"))
-    assert "will NOT" in df.explain()
-    # and still correct via fallback
+    assert "will NOT" not in df.explain(), df.explain()
     assert_tpu_cpu_equal(
         lambda sess: strings_df(sess).select(
             col("s"), Like(col("s"), "a_b%c").alias("r")))

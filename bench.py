@@ -43,20 +43,35 @@ def _child_main(backend: str) -> None:
     platform = jax.devices()[0].platform
 
     from spark_rapids_tpu.api.session import TpuSession
-    from spark_rapids_tpu.testing import tpch
+    from spark_rapids_tpu.testing import tpcds, tpch
 
     batches = tpch.gen_lineitem(N_ROWS, batch_rows=1 << 19)
+    fact = tpcds.gen_store_sales(N_ROWS, batch_rows=1 << 19)
+    date_dim = tpcds.gen_date_dim()
+    item = tpcds.gen_item()
     tpu_sess = TpuSession({"spark.rapids.sql.enabled": "true"})
     cpu_sess = TpuSession({"spark.rapids.sql.enabled": "false"})
 
-    queries = {"q6": tpch.q6, "q1": tpch.q1}
-    per_query = {}
-    speedups = []
-    rates = []
-    for name, qfn in queries.items():
+    def _tpch(qfn):
         def run(sess):
             df = qfn(sess.create_dataframe(list(batches), num_partitions=2))
             return df.collect()
+        return run
+
+    def _q3(sess):
+        # join-heavy gate query (BASELINE #2/#3 metric):
+        # fact x date_dim x item -> filter -> group -> sort
+        df = tpcds.q3(
+            sess.create_dataframe(list(fact), num_partitions=2),
+            sess.create_dataframe([date_dim], num_partitions=1),
+            sess.create_dataframe([item], num_partitions=1))
+        return df.collect()
+
+    queries = {"q6": _tpch(tpch.q6), "q1": _tpch(tpch.q1), "q3": _q3}
+    per_query = {}
+    speedups = []
+    rates = []
+    for name, run in queries.items():
 
         tpu_rows = run(tpu_sess)        # warmup: compile + correctness
         t0 = time.perf_counter()
@@ -89,7 +104,7 @@ def _child_main(backend: str) -> None:
         return float(math.exp(sum(map(math.log, xs)) / len(xs)))
 
     print(json.dumps({
-        "metric": "tpch_q6_q1_geomean_rows_per_sec",
+        "metric": "tpch_q6_q1_tpcds_q3_geomean_rows_per_sec",
         "value": round(geo(rates)),
         "unit": "rows/s",
         "vs_baseline": round(geo(speedups), 3),
@@ -148,7 +163,7 @@ def main() -> None:
     # both backends failed: still exit 0 with a diagnostic line the driver
     # can record (a crash here would zero out the round's perf evidence)
     print(json.dumps({
-        "metric": "tpch_q6_q1_geomean_rows_per_sec",
+        "metric": "tpch_q6_q1_tpcds_q3_geomean_rows_per_sec",
         "value": 0,
         "unit": "rows/s",
         "vs_baseline": 0.0,

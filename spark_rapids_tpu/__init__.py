@@ -19,6 +19,27 @@ import jax as _jax
 # variableFloatAgg-style caveats; integral types emulate exactly.)
 _jax.config.update("jax_enable_x64", True)
 
+# Serialize XLA compilation: two engine threads compiling concurrently
+# segfault inside jaxlib 0.9's CPU backend_compile_and_load (observed
+# repeatedly under the task thread pool; both faulting stacks sit in
+# backend_compile_and_load).  Execution stays fully parallel — only the
+# compile step takes the lock, and compiles are cached afterwards.
+# Private-API patch, pinned to the baked-in jax version of this image.
+import threading as _threading
+
+import jax._src.compiler as _jax_compiler
+
+if not getattr(_jax_compiler, "_srtpu_compile_lock_installed", False):
+    _compile_lock = _threading.Lock()
+    _orig_backend_compile = _jax_compiler.backend_compile_and_load
+
+    def _serialized_backend_compile(*args, **kwargs):
+        with _compile_lock:
+            return _orig_backend_compile(*args, **kwargs)
+
+    _jax_compiler.backend_compile_and_load = _serialized_backend_compile
+    _jax_compiler._srtpu_compile_lock_installed = True
+
 from spark_rapids_tpu import types  # noqa: F401
 from spark_rapids_tpu.config import RapidsConf  # noqa: F401
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema  # noqa: F401

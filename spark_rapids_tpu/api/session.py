@@ -40,6 +40,11 @@ class TpuSession:
         shuffle-manager mode switch."""
         self.conf = RapidsConf(conf or {})
         self.mesh = mesh
+        # executor-init analog (Plugin.scala:657-690): apply memory/
+        # semaphore/injection settings from this session's conf
+        from spark_rapids_tpu.memory import initialize_memory
+        initialize_memory(self.conf)
+        self.last_query_metrics = None
 
     def set_conf(self, key: str, value) -> None:
         self.conf = self.conf.with_overrides(**{key: value})
@@ -111,6 +116,41 @@ class GroupedData:
             L.Aggregate(self.keys, [_to_expr(a) for a in aggs],
                         self.df.plan), self.df.session)
 
+    def apply_in_pandas(self, fn, schema: Schema) -> "DataFrame":
+        """pyspark applyInPandas analog (grouped map): repartition on the
+        grouping keys, then fn(pandas.DataFrame) per key group.
+        Reference: GpuFlatMapGroupsInPandasExec."""
+        import pyarrow as pa
+        from spark_rapids_tpu.expressions.core import Col
+
+        key_names = []
+        for k in self.keys:
+            assert isinstance(k, Col), \
+                "apply_in_pandas keys must be plain columns"
+            key_names.append(k.name)
+
+        def _wrapper(table):
+            pdf = table.to_pandas()
+            outs = []
+            for _, group in pdf.groupby(key_names, dropna=False,
+                                        sort=True):
+                res = fn(group)
+                if len(res):
+                    outs.append(res)
+            import pandas as pd
+            merged = (pd.concat(outs, ignore_index=True) if outs
+                      else pd.DataFrame(
+                          {n: pd.Series(dtype=object)
+                           for n in schema.names}))
+            return pa.Table.from_pandas(merged, preserve_index=False)
+        _wrapper.__name__ = getattr(fn, "__name__", "apply_in_pandas")
+
+        nparts = self.df.session.conf.shuffle_partitions
+        repart = L.Repartition(nparts, list(self.keys), self.df.plan)
+        return DataFrame(
+            L.MapBatches(_wrapper, schema, repart, whole_partition=True),
+            self.df.session)
+
 
 class DataFrame:
     def __init__(self, plan: L.LogicalPlan, session: TpuSession):
@@ -176,6 +216,20 @@ class DataFrame:
         producing `schema` (pandas interop: use table.to_pandas() inside)."""
         return DataFrame(L.MapBatches(fn, schema, self.plan), self.session)
 
+    def map_in_pandas(self, fn, schema: Schema) -> "DataFrame":
+        """pyspark mapInPandas analog: fn(pandas.DataFrame) ->
+        pandas.DataFrame producing `schema`; rides the Arrow bridge with
+        the device semaphore released while Python runs
+        (GpuArrowEvalPythonExec/PythonWorkerSemaphore analog)."""
+        import pyarrow as pa
+
+        def _wrapper(table):
+            result = fn(table.to_pandas())
+            return pa.Table.from_pandas(result, preserve_index=False)
+        _wrapper.__name__ = getattr(fn, "__name__", "map_in_pandas")
+        return DataFrame(L.MapBatches(_wrapper, schema, self.plan),
+                         self.session)
+
     def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
         if isinstance(on, str):
             on = [on]
@@ -207,7 +261,10 @@ class DataFrame:
                     return rows
                 except UnsupportedSpmd:
                     pass   # mode switch: fall back to the task engine
-            return TpuEngine(self.session.conf).collect(exec_plan)
+            engine = TpuEngine(self.session.conf)
+            out = engine.collect(exec_plan)
+            self.session.last_query_metrics = engine.last_metrics
+            return out
         return CpuEngine(self.session.conf.shuffle_partitions).collect(self.plan)
 
     def explain(self) -> str:
@@ -221,7 +278,41 @@ class DataFrame:
         """Materialize as device batches (the ColumnarRdd analog: zero-copy
         handoff to ML frameworks, reference sql-plugin-api ColumnarRdd.scala)."""
         exec_plan, _ = plan_query(self.plan, self.session.conf)
-        return TpuEngine(self.session.conf).execute(exec_plan)
+        engine = TpuEngine(self.session.conf)
+        out = engine.execute(exec_plan)
+        self.session.last_query_metrics = engine.last_metrics
+        return out
+
+    def collect_partitions(self):
+        """Device batches per partition on either engine (the writer's
+        input seam; CPU-oracle results upload through Arrow)."""
+        if self.session.conf.sql_enabled:
+            return self._collect_batches()
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        tables = CpuEngine(
+            self.session.conf.shuffle_partitions).execute(self.plan)
+        out = []
+        for t in tables:
+            data = {}
+            for (vals, valid), name in zip(t.cols, t.schema.names):
+                data[name] = [v if m else None
+                              for v, m in zip(vals.tolist(), valid.tolist())]
+            out.append([ColumnarBatch.from_pydict(data, t.schema)])
+        return out
+
+    def write(self, path: str, fmt: str = "parquet",
+              partition_by=(), mode: str = "error"):
+        """Write with dynamic partitioning + the commit protocol
+        (GpuFileFormatDataWriter.scala analog)."""
+        from spark_rapids_tpu.io.writer import write_dataframe
+        return write_dataframe(self, path, fmt=fmt,
+                               partition_by=partition_by, mode=mode)
+
+    def write_delta(self, path: str, mode: str = "error",
+                    partition_by=()):
+        """Write this DataFrame as a Delta table commit (create or append)."""
+        from spark_rapids_tpu.io.delta_write import write_delta
+        return write_delta(self, path, mode=mode, partition_by=partition_by)
 
     def write_parquet(self, path: str) -> int:
         from spark_rapids_tpu.io.parquet import write_parquet

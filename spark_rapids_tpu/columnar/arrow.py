@@ -187,6 +187,14 @@ def batch_to_arrow(batch: ColumnarBatch) -> pa.Table:
             arrays.append(arr.cast(at) if arr.type != at else arr)
         else:
             data, valid = col.to_numpy(n)
+            # force OWNING host copies: np.asarray over a jax CPU array is
+            # a zero-copy view, and pa.array wraps primitive numpy arrays
+            # zero-copy too — an Arrow table silently referencing jax
+            # buffer memory corrupts the heap if the buffer is reclaimed
+            # while the table is alive (intermittent segfaults under the
+            # engine thread pool)
+            data = np.array(data, copy=True)
+            valid = np.array(valid, copy=True)
             if isinstance(dtype, T.DecimalType):
                 pyvals = [
                     None if not valid[i] else _decimal.Decimal(int(data[i])).scaleb(-dtype.scale)

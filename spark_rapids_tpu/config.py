@@ -230,9 +230,14 @@ MULTITHREAD_READ_NUM_THREADS = conf("spark.rapids.sql.multiThreadedRead.numThrea
 ).int_conf(8)
 
 LORE_DUMP_IDS = conf("spark.rapids.sql.lore.idsToDump").doc(
-    "LORE-style debug replay: comma-separated exec ids whose input batches "
-    "are dumped for offline replay (reference: lore/)."
+    "LORE-style debug replay: comma-separated exec ids (see explain() "
+    "output, [loreId=N]) whose OUTPUT batches are dumped as parquet for "
+    "offline replay via tools/lore_replay.py (reference: lore/)."
 ).string_conf(None)
+
+LORE_DUMP_PATH = conf("spark.rapids.sql.lore.dumpPath").doc(
+    "Directory receiving LORE batch dumps (one subdir per exec id)."
+).string_conf("/tmp/spark_rapids_tpu_lore")
 
 TEST_RETRY_CONTEXT_CHECK = conf("spark.rapids.sql.test.retryContextCheck.enabled").doc(
     "Assert that every device allocation site is covered by a retry block "
@@ -296,6 +301,33 @@ class RapidsConf:
     @property
     def concurrent_tpu_tasks(self) -> int:
         return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def multithreaded_read_threads(self) -> int:
+        return self.get(MULTITHREAD_READ_NUM_THREADS)
+
+    @property
+    def metrics_level(self) -> str:
+        return (self.get(METRICS_LEVEL) or "MODERATE").upper()
+
+    @property
+    def variable_float_agg_enabled(self) -> bool:
+        return self.get(IMPROVED_FLOAT_OPS)
+
+    @property
+    def lore_dump_ids(self):
+        raw = self.get(LORE_DUMP_IDS)
+        if not raw:
+            return set()
+        return {int(x) for x in str(raw).split(",") if x.strip()}
+
+    @property
+    def lore_dump_path(self) -> str:
+        return self.get(LORE_DUMP_PATH)
+
+    @property
+    def retry_context_check(self) -> bool:
+        return self.get(TEST_RETRY_CONTEXT_CHECK)
 
     @property
     def retry_max_attempts(self) -> int:

@@ -370,3 +370,16 @@ def stddev(e):
 def stddev_pop(e):
     from spark_rapids_tpu.expressions.core import col
     return StddevPop(col(e) if isinstance(e, str) else e)
+
+
+class BoolAnd(Min):
+    """bool_and/every: true iff every non-null value is true — MIN over
+    booleans (Spark GpuMin specialization)."""
+
+    name = "bool_and"
+
+
+class BoolOr(Max):
+    """bool_or/any/some: MAX over booleans."""
+
+    name = "bool_or"

@@ -1,0 +1,139 @@
+"""Bitwise expression twins.
+
+Reference: sql-plugin/.../bitwise.scala (GpuBitwiseAnd/Or/Xor/Not,
+GpuShiftLeft/Right/RightUnsigned).  Shift semantics follow Java: the shift
+amount is masked to the operand width (x << 65 == x << 1 for long), and
+>>> is the unsigned shift.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.core import (
+    BinaryExpression,
+    CpuEvalContext,
+    EvalContext,
+    UnaryExpression,
+    cpu_null_propagating,
+    cpu_zero_invalid,
+    make_column,
+    null_propagating,
+)
+
+
+class _BitwiseBinary(BinaryExpression):
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    def _op(self, a, b, xp):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext):
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        dt = self.dtype.jnp_dtype
+        out = self._op(l.data.astype(dt), r.data.astype(dt), jnp)
+        return make_column(out.astype(dt), null_propagating([l.validity, r.validity]),
+                           self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lm = self.left.eval_cpu(ctx)
+        rv, rm = self.right.eval_cpu(ctx)
+        valid = cpu_null_propagating([lm, rm])
+        dt = self.dtype.np_dtype
+        out = self._op(lv.astype(dt), rv.astype(dt), np).astype(dt)
+        return cpu_zero_invalid(out, valid), valid
+
+
+class BitwiseAnd(_BitwiseBinary):
+    symbol = "&"
+
+    def _op(self, a, b, xp):
+        return a & b
+
+
+class BitwiseOr(_BitwiseBinary):
+    symbol = "|"
+
+    def _op(self, a, b, xp):
+        return a | b
+
+
+class BitwiseXor(_BitwiseBinary):
+    symbol = "^"
+
+    def _op(self, a, b, xp):
+        return a ^ b
+
+
+class BitwiseNot(UnaryExpression):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        return make_column(~c.data, c.validity & ctx.live_mask(), self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        return cpu_zero_invalid(~v, valid), valid
+
+
+def _width_bits(dtype) -> int:
+    return 64 if isinstance(dtype, T.LongType) else 32
+
+
+class _Shift(BinaryExpression):
+    """Shift amount is an INT, masked to the operand width (Java)."""
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    def _shift(self, a, n, bits, xp):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext):
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        bits = _width_bits(self.dtype)
+        n = (r.data.astype(jnp.int32) & (bits - 1))
+        out = self._shift(l.data, n, bits, jnp)
+        return make_column(out.astype(self.dtype.jnp_dtype),
+                           null_propagating([l.validity, r.validity]), self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lm = self.left.eval_cpu(ctx)
+        rv, rm = self.right.eval_cpu(ctx)
+        valid = cpu_null_propagating([lm, rm])
+        bits = _width_bits(self.dtype)
+        n = rv.astype(np.int64) & (bits - 1)
+        out = self._shift(lv, n, bits, np).astype(self.dtype.np_dtype)
+        return cpu_zero_invalid(out, valid), valid
+
+
+class ShiftLeft(_Shift):
+    symbol = "<<"
+
+    def _shift(self, a, n, bits, xp):
+        u = a.astype(xp.uint64 if bits == 64 else xp.uint32)
+        return (u << n.astype(u.dtype)).astype(a.dtype)
+
+
+class ShiftRight(_Shift):
+    symbol = ">>"
+
+    def _shift(self, a, n, bits, xp):
+        return a >> n.astype(a.dtype)
+
+
+class ShiftRightUnsigned(_Shift):
+    symbol = ">>>"
+
+    def _shift(self, a, n, bits, xp):
+        u = a.astype(xp.uint64 if bits == 64 else xp.uint32)
+        return (u >> n.astype(u.dtype)).astype(a.dtype)

@@ -1,0 +1,90 @@
+"""Expression-level CPU bridge: run one unsupported expression subtree on
+the host inside an otherwise-device plan.
+
+Reference: GpuCpuBridgeExpression.scala + willRunViaCpuBridgeReasons
+(RapidsMeta.scala:141) — instead of failing the whole plan node over one
+expression, the planner wraps the offending subtree; at eval time the
+input batch round-trips device -> host, the subtree evaluates through its
+CPU-oracle implementation, and the result uploads back.  Gated by
+spark.rapids.sql.expression.cpuBridge.enabled.
+
+A project/filter containing a bridge runs its step EAGERLY (not under
+jax.jit): the host round-trip cannot live inside a traced program.  The
+device expressions around the bridge still execute as XLA ops — they just
+dispatch op-by-op, the same slow-path trade the reference makes (row-wise
+bridge eval inside a columnar plan).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expressions.core import (
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+    make_column,
+)
+
+
+class CpuBridgeExpression(Expression):
+    """Evaluates its child subtree on the CPU via eval_cpu."""
+
+    is_cpu_bridge = True
+
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    def with_children(self, children):
+        return CpuBridgeExpression(children[0])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.plan.cpu_engine import CpuTable
+
+        import jax.core
+
+        batch = ctx.batch
+        if isinstance(batch.num_rows, jax.core.Tracer):
+            raise RuntimeError(
+                "CpuBridgeExpression evaluated under jax.jit; bridged "
+                "steps must run eagerly (plan/execs/base.py "
+                "jit_bucketed_step)")
+        table = CpuTable.from_batch(batch)
+        vals, valid = self.child.eval_cpu(table.ctx())
+        dt = self.dtype
+        cap = batch.capacity
+        n = int(batch.num_rows)
+        if dt.variable_width:
+            py = [v if m else None for v, m in zip(vals[:n], valid[:n])]
+            py += [None] * (cap - n)
+            col = DeviceColumn.from_strings(py, capacity=cap, dtype=dt)
+            live = ctx.live_mask()
+            return DeviceColumn(col.data, col.validity & live, dt,
+                                col.offsets)
+        data = np.zeros((cap,), dt.np_dtype)
+        vmask = np.zeros((cap,), np.bool_)
+        data[:n] = np.where(valid[:n], np.asarray(vals[:n], dt.np_dtype), 0)
+        vmask[:n] = valid[:n]
+        return make_column(jnp.asarray(data),
+                           jnp.asarray(vmask) & ctx.live_mask(), dt)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        return self.child.eval_cpu(ctx)
+
+    def __repr__(self):
+        return f"cpu_bridge({self.child!r})"
+
+
+def tree_has_bridge(exprs) -> bool:
+    def walk(e) -> bool:
+        if getattr(e, "is_cpu_bridge", False):
+            return True
+        return any(walk(c) for c in e.children)
+    return any(walk(e) for e in exprs)

@@ -220,11 +220,12 @@ def _int_key(dst: T.DataType):
 
 
 _WS = "".join(chr(c) for c in range(0x21))
-_INT_RE = __import__("re").compile(r"^[+-]?(\d+(\.\d*)?|\.\d+)$")
+_INT_RE = __import__("re").compile(
+    r"^[+-]?(\d+(\.\d*)?|\.\d+)$", __import__("re").ASCII)
 _FLOAT_RE = __import__("re").compile(
-    r"^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$")
+    r"^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$", __import__("re").ASCII)
 _DATE_RE = __import__("re").compile(
-    r"^(\d{4})(?:-(\d{1,2})(?:-(\d{1,2}))?)?$")
+    r"^(\d{4})(?:-(\d{1,2})(?:-(\d{1,2}))?)?$", __import__("re").ASCII)
 _SPECIAL_FLOATS = {
     "inf": float("inf"), "+inf": float("inf"), "-inf": float("-inf"),
     "infinity": float("inf"), "+infinity": float("inf"),
@@ -313,8 +314,13 @@ def _cpu_to_string(v, valid, src: T.DataType):
     elif isinstance(src, T.DateType):
         epoch = _dt.date(1970, 1, 1).toordinal()
         for i, m in enumerate(valid):
-            out[i] = (_dt.date.fromordinal(epoch + int(v[i])).isoformat()
-                      if m else None)
+            if not m:
+                out[i] = None
+                continue
+            try:
+                out[i] = _dt.date.fromordinal(epoch + int(v[i])).isoformat()
+            except (ValueError, OverflowError):
+                out[i] = None   # outside year [1, 9999]: null on both engines
     elif src.is_integral:
         for i, m in enumerate(valid):
             out[i] = str(int(v[i])) if m else None

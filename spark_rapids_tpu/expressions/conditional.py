@@ -14,6 +14,7 @@ import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.expressions.core import (
+    BinaryExpression,
     CpuEvalContext,
     EvalContext,
     Expression,
@@ -44,6 +45,9 @@ class If(Expression):
         out_dt = self.dtype
         # null predicate selects the else branch (Spark If semantics)
         take_true = p.data & p.validity
+        if out_dt.variable_width:
+            from spark_rapids_tpu.kernels.strings import select_strings
+            return select_strings(take_true, t, f, ctx.batch.num_rows)
         vals = jnp.where(take_true, t.data.astype(out_dt.jnp_dtype),
                          f.data.astype(out_dt.jnp_dtype))
         validity = jnp.where(take_true, t.validity, f.validity)
@@ -99,6 +103,8 @@ class CaseWhen(Expression):
 
     def eval(self, ctx: EvalContext):
         out_dt = self.dtype
+        if out_dt.variable_width:
+            return self._eval_strings(ctx)
         vals = jnp.zeros((ctx.capacity,), out_dt.jnp_dtype)
         validity = jnp.zeros((ctx.capacity,), jnp.bool_)
         if self.else_value is not None:
@@ -115,6 +121,27 @@ class CaseWhen(Expression):
             validity = jnp.where(take, v.validity, validity)
             decided = decided | (c.data & c.validity)
         return make_column(vals, validity, out_dt)
+
+    def _eval_strings(self, ctx: EvalContext):
+        """Variable-width branches fold right-to-left through the string
+        select kernel (buffers cannot be jnp.where'd element-wise)."""
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+        from spark_rapids_tpu.kernels.strings import select_strings
+        if self.else_value is not None:
+            acc = self.else_value.eval(ctx)
+        else:
+            # all-null empty strings
+            first = self.branches[0][1].eval(ctx)
+            acc = DeviceColumn(
+                jnp.zeros_like(first.data),
+                jnp.zeros((ctx.capacity,), jnp.bool_), first.dtype,
+                jnp.zeros((ctx.capacity + 1,), jnp.int32))
+        for cond, value in reversed(self.branches):
+            c = cond.eval(ctx)
+            v = value.eval(ctx)
+            take = c.data & c.validity
+            acc = select_strings(take, v, acc, ctx.batch.num_rows)
+        return acc
 
     def eval_cpu(self, ctx: CpuEvalContext):
         out_dt = self.dtype
@@ -143,3 +170,134 @@ class CaseWhen(Expression):
         parts = " ".join(f"WHEN {c!r} THEN {v!r}" for c, v in self.branches)
         tail = f" ELSE {self.else_value!r}" if self.else_value is not None else ""
         return f"CASE {parts}{tail} END"
+
+
+class NullIf(BinaryExpression):
+    """nullif(a, b): NULL when a == b else a (Spark rewrites to CASE)."""
+
+    symbol = "NULLIF"
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    def eval(self, ctx: EvalContext):
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        eq = (l.data == r.data) & l.validity & r.validity
+        validity = l.validity & ~eq & ctx.live_mask()
+        return make_column(l.data, validity, self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lm = self.left.eval_cpu(ctx)
+        rv, rm = self.right.eval_cpu(ctx)
+        eq = np.array([bool(a == b) if (m1 and m2) else False
+                       for a, b, m1, m2 in zip(lv, rv, lm, rm)])
+        valid = lm & ~eq
+        return cpu_zero_invalid(lv.copy() if lv.dtype == object else lv,
+                                valid), valid
+
+
+class Nvl2(Expression):
+    """nvl2(c, a, b): a when c is not null else b."""
+
+    def __init__(self, cond: Expression, if_notnull: Expression,
+                 if_null: Expression):
+        self.cond = cond
+        self.if_notnull = if_notnull
+        self.if_null = if_null
+        self.children = (cond, if_notnull, if_null)
+
+    def with_children(self, children):
+        return Nvl2(*children)
+
+    @property
+    def dtype(self):
+        return self.if_notnull.dtype
+
+    def eval(self, ctx: EvalContext):
+        from spark_rapids_tpu.expressions.predicates import IsNotNull
+        return If(IsNotNull(self.cond), self.if_notnull,
+                  self.if_null).eval(ctx)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        from spark_rapids_tpu.expressions.predicates import IsNotNull
+        return If(IsNotNull(self.cond), self.if_notnull,
+                  self.if_null).eval_cpu(ctx)
+
+    def __repr__(self):
+        return f"nvl2({self.cond!r}, {self.if_notnull!r}, {self.if_null!r})"
+
+
+class _Extremum(Expression):
+    """least/greatest over N children: nulls skipped, NULL only when all
+    null; NaN is the LARGEST value (Spark total order)."""
+
+    prefer_greater = True
+
+    def __init__(self, *children: Expression):
+        assert len(children) >= 2
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return type(self)(*children)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval(self, ctx: EvalContext):
+        cols = [c.eval(ctx) for c in self.children]
+        dt = self.dtype.jnp_dtype
+        floating = self.dtype.is_floating
+        acc_v = cols[0].data.astype(dt)
+        acc_m = cols[0].validity
+        for c in cols[1:]:
+            v = c.data.astype(dt)
+            if floating:
+                v_nan = jnp.isnan(v)
+                a_nan = jnp.isnan(acc_v)
+                if self.prefer_greater:
+                    wins = v_nan | (~a_nan & (v > acc_v))
+                else:
+                    wins = ~v_nan & (a_nan | (v < acc_v))
+            else:
+                wins = (v > acc_v) if self.prefer_greater else (v < acc_v)
+            take = c.validity & (~acc_m | wins)
+            acc_v = jnp.where(take, v, acc_v)
+            acc_m = acc_m | c.validity
+        return make_column(acc_v, acc_m & ctx.live_mask(), self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        import math as _math
+        evs = [c.eval_cpu(ctx) for c in self.children]
+        n = ctx.num_rows
+        floating = self.dtype.is_floating
+        out = np.zeros((n,), self.dtype.np_dtype)
+        validity = np.zeros((n,), np.bool_)
+
+        def rank(x):
+            if floating and _math.isnan(float(x)):
+                return (1, 0.0)
+            return (0, x)
+
+        for i in range(n):
+            vals = [v[i] for v, m in evs if m[i]]
+            if not vals:
+                continue
+            validity[i] = True
+            out[i] = (max(vals, key=rank) if self.prefer_greater
+                      else min(vals, key=rank))
+        return out, validity
+
+    def __repr__(self):
+        name = "greatest" if self.prefer_greater else "least"
+        return f"{name}({', '.join(map(repr, self.children))})"
+
+
+class Greatest(_Extremum):
+    prefer_greater = True
+
+
+class Least(_Extremum):
+    prefer_greater = False

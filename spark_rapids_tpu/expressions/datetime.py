@@ -21,6 +21,7 @@ from spark_rapids_tpu.expressions.core import (
     BinaryExpression,
     CpuEvalContext,
     EvalContext,
+    Expression,
     UnaryExpression,
     cpu_null_propagating,
     cpu_zero_invalid,
@@ -272,3 +273,304 @@ class LastDay(UnaryExpression):
         v, valid = self.child.eval_cpu(ctx)
         out = self._compute(v.astype(np.int64), np)
         return cpu_zero_invalid(out, valid), valid
+
+
+class WeekOfYear(UnaryExpression):
+    """ISO-8601 week number (Spark weekofyear)."""
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def _compute(self, days, xp):
+        # ISO week: Thursday of this week determines the ISO year; epoch
+        # day 0 (1970-01-01) was a Thursday, so dow(Mon=0) = (days+3) % 7
+        dow = (days + 3) % 7
+        thursday = days - dow + 3
+        ty, _, _ = _civil_from_days(thursday, xp)
+        jan1 = _days_from_civil(ty, xp.full(days.shape, 1, xp.int64),
+                                xp.full(days.shape, 1, xp.int64), xp)
+        return ((thursday - jan1) // 7 + 1).astype(xp.int32)
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        out = self._compute(c.data.astype(jnp.int64), jnp)
+        return make_column(out, c.validity & ctx.live_mask(), T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        import datetime as _dt
+        epoch = _dt.date(1970, 1, 1)
+        out = np.array([( epoch + _dt.timedelta(days=int(x))
+                         ).isocalendar()[1] if m else 0
+                        for x, m in zip(v, valid)], np.int32)
+        return out, valid.copy()
+
+
+class MakeDate(Expression):
+    """make_date(y, m, d) -> date; NULL on invalid (non-ANSI)."""
+
+    def __init__(self, year: Expression, month: Expression, day: Expression):
+        self.children = (year, month, day)
+
+    def with_children(self, children):
+        return MakeDate(*children)
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+    def eval(self, ctx: EvalContext):
+        y = self.children[0].eval(ctx)
+        m = self.children[1].eval(ctx)
+        d = self.children[2].eval(ctx)
+        yy = y.data.astype(jnp.int64)
+        mm = m.data.astype(jnp.int64)
+        dd = d.data.astype(jnp.int64)
+        leap = ((yy % 4 == 0) & (yy % 100 != 0)) | (yy % 400 == 0)
+        dim = jnp.asarray(np.array(
+            [31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31], np.int64))[
+            jnp.clip(mm - 1, 0, 11)]
+        dim = jnp.where((mm == 2) & leap, 29, dim)
+        ok = ((yy >= 1) & (yy <= 9999) & (mm >= 1) & (mm <= 12)
+              & (dd >= 1) & (dd <= dim))
+        days = _days_from_civil(yy, jnp.clip(mm, 1, 12),
+                                jnp.clip(dd, 1, 31), jnp).astype(jnp.int32)
+        validity = (y.validity & m.validity & d.validity & ok
+                    & ctx.live_mask())
+        return make_column(jnp.where(ok, days, 0), validity, T.DATE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        import datetime as _dt
+        ys, ym = self.children[0].eval_cpu(ctx)
+        ms, mm_ = self.children[1].eval_cpu(ctx)
+        ds, dm = self.children[2].eval_cpu(ctx)
+        n = ctx.num_rows
+        epoch = _dt.date(1970, 1, 1)
+        out = np.zeros((n,), np.int32)
+        validity = np.zeros((n,), np.bool_)
+        for i in range(n):
+            if not (ym[i] and mm_[i] and dm[i]):
+                continue
+            try:
+                out[i] = (_dt.date(int(ys[i]), int(ms[i]), int(ds[i]))
+                          - epoch).days
+                validity[i] = True
+            except ValueError:
+                pass
+        return out, validity
+
+    def __repr__(self):
+        y, m, d = self.children
+        return f"make_date({y!r}, {m!r}, {d!r})"
+
+
+class TruncDate(UnaryExpression):
+    """trunc(date, fmt) for fmt in YEAR/YYYY/YY, QUARTER, MONTH/MM/MON,
+    WEEK (Monday); fmt is a constructor literal."""
+
+    def __init__(self, child: Expression, fmt: str):
+        super().__init__(child)
+        self.fmt = fmt.upper()
+
+    def with_children(self, children):
+        return TruncDate(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+    def _compute(self, days, xp):
+        y, m, d = _civil_from_days(days, xp)
+        one = xp.full(days.shape, 1, xp.int64)
+        if self.fmt in ("YEAR", "YYYY", "YY"):
+            return _days_from_civil(y, one, one, xp)
+        if self.fmt == "QUARTER":
+            qm = ((m - 1) // 3) * 3 + 1
+            return _days_from_civil(y, qm, one, xp)
+        if self.fmt in ("MONTH", "MM", "MON"):
+            return _days_from_civil(y, m, one, xp)
+        if self.fmt == "WEEK":
+            dow = (days + 3) % 7   # Monday = 0
+            return days - dow
+        raise ValueError(self.fmt)
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        out = self._compute(c.data.astype(jnp.int64), jnp).astype(jnp.int32)
+        return make_column(out, c.validity & ctx.live_mask(), T.DATE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        out = self._compute(v.astype(np.int64), np).astype(np.int32)
+        return cpu_zero_invalid(out, valid), valid.copy()
+
+    def __repr__(self):
+        return f"trunc({self.child!r}, {self.fmt!r})"
+
+
+_DAY_NAMES = ["MON", "TUE", "WED", "THU", "FRI", "SAT", "SUN"]
+
+
+class NextDay(UnaryExpression):
+    """next_day(date, dayOfWeek-literal): the next date strictly after
+    `date` falling on the given weekday."""
+
+    def __init__(self, child: Expression, day_name: str):
+        super().__init__(child)
+        self.day_name = day_name
+        key = day_name.strip().upper()[:3]
+        if key not in _DAY_NAMES:
+            raise ValueError(f"bad day name {day_name!r}")
+        self.target = _DAY_NAMES.index(key)   # Monday = 0
+
+    def with_children(self, children):
+        return NextDay(children[0], self.day_name)
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+    def _compute(self, days, xp):
+        dow = (days + 3) % 7    # Monday = 0
+        delta = (self.target - dow) % 7
+        delta = xp.where(delta == 0, 7, delta)
+        return (days + delta).astype(xp.int32)
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        out = self._compute(c.data.astype(jnp.int64), jnp)
+        return make_column(out, c.validity & ctx.live_mask(), T.DATE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        out = self._compute(v.astype(np.int64), np)
+        return cpu_zero_invalid(out, valid), valid.copy()
+
+    def __repr__(self):
+        return f"next_day({self.child!r}, {self.day_name!r})"
+
+
+class MonthsBetween(BinaryExpression):
+    """months_between(end, start) over DATEs: whole-month difference plus
+    day fraction /31; integer when both are the last day of their months
+    or share the day-of-month (Spark semantics, roundOff=false)."""
+
+    symbol = "months_between"
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def _compute(self, d1, d2, xp):
+        y1, m1, day1 = _civil_from_days(d1, xp)
+        y2, m2, day2 = _civil_from_days(d2, xp)
+
+        def last_day(y, m, d):
+            one = xp.full(y.shape, 1, xp.int64)
+            nxt_y = xp.where(m == 12, y + 1, y)
+            nxt_m = xp.where(m == 12, one, m + 1)
+            first_next = _days_from_civil(nxt_y, nxt_m, one, xp)
+            first_this = _days_from_civil(y, m, one, xp)
+            return (first_next - first_this)
+
+        months = (y1 - y2) * 12 + (m1 - m2)
+        both_last = (day1 == last_day(y1, m1, day1)) & \
+            (day2 == last_day(y2, m2, day2))
+        same_day = day1 == day2
+        frac = (day1 - day2).astype(xp.float64) / 31.0
+        out = months.astype(xp.float64) + xp.where(
+            both_last | same_day, 0.0, frac)
+        return out
+
+    def eval(self, ctx: EvalContext):
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        out = self._compute(lc.data.astype(jnp.int64),
+                            rc.data.astype(jnp.int64), jnp)
+        return make_column(out, null_propagating([lc.validity, rc.validity]),
+                           T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lval = self.left.eval_cpu(ctx)
+        rv, rval = self.right.eval_cpu(ctx)
+        validity = cpu_null_propagating([lval, rval])
+        out = self._compute(lv.astype(np.int64), rv.astype(np.int64), np)
+        return cpu_zero_invalid(out, validity), validity
+
+
+class _TsScalar(UnaryExpression):
+    """Elementwise timestamp<->integer transforms."""
+
+    out_dtype = T.LONG
+
+    @property
+    def dtype(self):
+        return self.out_dtype
+
+    def _op(self, x, xp):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        out = self._op(c.data.astype(jnp.int64), jnp)
+        return make_column(out, c.validity & ctx.live_mask(), self.out_dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        out = self._op(v.astype(np.int64), np)
+        return cpu_zero_invalid(out, valid), valid.copy()
+
+
+class UnixSeconds(_TsScalar):
+    """unix_seconds(ts): micros -> floor seconds."""
+
+    def _op(self, x, xp):
+        return x // MICROS_PER_SECOND
+
+
+class UnixMillis(_TsScalar):
+    def _op(self, x, xp):
+        return x // 1000
+
+
+class UnixMicros(_TsScalar):
+    def _op(self, x, xp):
+        return x
+
+
+class SecondsToTimestamp(_TsScalar):
+    out_dtype = T.TIMESTAMP
+
+    def _op(self, x, xp):
+        return x * MICROS_PER_SECOND
+
+
+class MillisToTimestamp(_TsScalar):
+    out_dtype = T.TIMESTAMP
+
+    def _op(self, x, xp):
+        return x * 1000
+
+
+class MicrosToTimestamp(_TsScalar):
+    out_dtype = T.TIMESTAMP
+
+    def _op(self, x, xp):
+        return x
+
+
+class UnixDate(_TsScalar):
+    """unix_date(date): days since epoch as INT."""
+
+    out_dtype = T.INT
+
+    def _op(self, x, xp):
+        return x.astype(xp.int32)
+
+
+class DateFromUnixDate(_TsScalar):
+    out_dtype = T.DATE
+
+    def _op(self, x, xp):
+        return x.astype(xp.int32)

@@ -296,3 +296,268 @@ class NanVl(BinaryExpression):
         vals = np.where(isnan, r, l)
         validity = np.where(isnan, lval & rval, lval)
         return cpu_zero_invalid(vals, validity), validity
+
+
+class Asin(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.arcsin(x)
+
+
+class Acos(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.arccos(x)
+
+
+class Sinh(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.sinh(x)
+
+
+class Cosh(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.cosh(x)
+
+
+class Tanh(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.tanh(x)
+
+
+class Asinh(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.arcsinh(x)
+
+
+class Acosh(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.arccosh(x)
+
+
+class Atanh(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.arctanh(x)
+
+
+class Log2(_UnaryDouble):
+    """NULL for non-positive input (Hive lineage, like ln/log10)."""
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        x = c.data.astype(jnp.float64)
+        ok = x > 0
+        out = jnp.log2(jnp.where(ok, x, 1.0))
+        return make_column(out, c.validity & ok, T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        with np.errstate(all="ignore"):
+            x = v.astype(np.float64)
+            ok = x > 0
+            out = np.log2(np.where(ok, x, 1.0))
+        valid = valid & ok
+        return cpu_zero_invalid(out, valid), valid
+
+
+class Log1p(_UnaryDouble):
+    """NULL for input <= -1 (Spark GpuLogarithmPlusOne semantics)."""
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        x = c.data.astype(jnp.float64)
+        ok = x > -1.0
+        out = jnp.log1p(jnp.where(ok, x, 0.0))
+        return make_column(out, c.validity & ok, T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        with np.errstate(all="ignore"):
+            x = v.astype(np.float64)
+            ok = x > -1.0
+            out = np.log1p(np.where(ok, x, 0.0))
+        valid = valid & ok
+        return cpu_zero_invalid(out, valid), valid
+
+
+class Expm1(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.expm1(x)
+
+
+class Rint(_UnaryDouble):
+    """Math.rint: round half to even, stays double."""
+
+    def _op(self, x, xp):
+        return xp.round(x)
+
+
+class Degrees(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.degrees(x)
+
+
+class Radians(_UnaryDouble):
+    def _op(self, x, xp):
+        return xp.radians(x)
+
+
+class Cot(_UnaryDouble):
+    def _op(self, x, xp):
+        return 1.0 / xp.tan(x)
+
+
+class Sec(_UnaryDouble):
+    def _op(self, x, xp):
+        return 1.0 / xp.cos(x)
+
+
+class Csc(_UnaryDouble):
+    def _op(self, x, xp):
+        return 1.0 / xp.sin(x)
+
+
+class _BinaryDouble(BinaryExpression):
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def _op(self, a, b, xp):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext):
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        out = self._op(l.data.astype(jnp.float64),
+                       r.data.astype(jnp.float64), jnp)
+        return make_column(out, null_propagating([l.validity, r.validity]), T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lm = self.left.eval_cpu(ctx)
+        rv, rm = self.right.eval_cpu(ctx)
+        valid = cpu_null_propagating([lm, rm])
+        with np.errstate(all="ignore"):
+            out = self._op(lv.astype(np.float64), rv.astype(np.float64), np)
+        return cpu_zero_invalid(out, valid), valid
+
+
+class Atan2(_BinaryDouble):
+    symbol = "ATAN2"
+
+    def _op(self, a, b, xp):
+        return xp.arctan2(a, b)
+
+
+class Hypot(_BinaryDouble):
+    symbol = "HYPOT"
+
+    def _op(self, a, b, xp):
+        return xp.hypot(a, b)
+
+
+class Pmod(BinaryExpression):
+    """Positive modulus: ((a % b) + b) % b; NULL on b == 0 (non-ANSI)."""
+
+    symbol = "PMOD"
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    def eval(self, ctx: EvalContext):
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        dt = self.dtype.jnp_dtype
+        a = l.data.astype(dt)
+        b = r.data.astype(dt)
+        nz = b != 0
+        safe_b = jnp.where(nz, b, jnp.ones((), dt))
+        # Spark pmod: r = a % b (TRUNC mod, sign of a); if r < 0 then
+        # (r + b) % b — which only changes r when b > 0 (for b < 0 the
+        # second trunc-mod hands r back)
+        if self.dtype.is_floating:
+            t = jnp.fmod(a, safe_b)
+        else:
+            # exact integer floor-mod -> trunc-mod (float trunc-division
+            # would lose precision for big int64)
+            f = a - (a // safe_b) * safe_b        # sign of b
+            t = jnp.where((f != 0) & ((f < 0) != (safe_b < 0)),
+                          f - safe_b, f)          # sign of a
+        out = jnp.where((t < 0) & (safe_b > 0), t + safe_b, t)
+        validity = null_propagating([l.validity, r.validity]) & nz
+        return make_column(out, validity, self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        lv, lm = self.left.eval_cpu(ctx)
+        rv, rm = self.right.eval_cpu(ctx)
+        valid = cpu_null_propagating([lm, rm]) & (rv != 0)
+        with np.errstate(all="ignore"):
+            safe = np.where(rv != 0, rv, 1)
+            if self.dtype.is_floating:
+                t = np.fmod(lv, safe)
+            else:
+                f = lv - (lv // safe) * safe
+                t = np.where((f != 0) & ((f < 0) != (safe < 0)), f - safe, f)
+            out = np.where((t < 0) & (safe > 0), t + safe, t)
+            out = out.astype(self.dtype.np_dtype)
+        return cpu_zero_invalid(out, valid), valid
+
+
+_FACTORIALS = [1]
+for _i in range(1, 21):
+    _FACTORIALS.append(_FACTORIALS[-1] * _i)
+
+
+class Factorial(UnaryExpression):
+    """factorial(n) for n in [0, 20]; NULL outside (Spark semantics)."""
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        n = c.data.astype(jnp.int64)
+        ok = (n >= 0) & (n <= 20)
+        table = jnp.asarray(np.array(_FACTORIALS, np.int64))
+        out = table[jnp.clip(n, 0, 20)]
+        return make_column(jnp.where(ok, out, 0), c.validity & ok, T.LONG)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        n = v.astype(np.int64)
+        ok = (n >= 0) & (n <= 20)
+        out = np.array(_FACTORIALS, np.int64)[np.clip(n, 0, 20)]
+        valid = valid & ok
+        return cpu_zero_invalid(out, valid), valid
+
+
+class LogBase(BinaryExpression):
+    """log(base, x): NULL unless base > 0, base != 1, x > 0."""
+
+    symbol = "LOG"
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def eval(self, ctx: EvalContext):
+        b = self.left.eval(ctx)
+        x = self.right.eval(ctx)
+        bb = b.data.astype(jnp.float64)
+        xx = x.data.astype(jnp.float64)
+        ok = (bb > 0) & (bb != 1.0) & (xx > 0)
+        out = jnp.log(jnp.where(xx > 0, xx, 1.0)) / \
+            jnp.log(jnp.where((bb > 0) & (bb != 1.0), bb, 2.0))
+        return make_column(out, null_propagating([b.validity, x.validity]) & ok, T.DOUBLE)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        bv, bm = self.left.eval_cpu(ctx)
+        xv, xm = self.right.eval_cpu(ctx)
+        valid = cpu_null_propagating([bm, xm])
+        with np.errstate(all="ignore"):
+            bb = bv.astype(np.float64)
+            xx = xv.astype(np.float64)
+            ok = (bb > 0) & (bb != 1.0) & (xx > 0)
+            out = np.log(np.where(xx > 0, xx, 1.0)) / \
+                np.log(np.where((bb > 0) & (bb != 1.0), bb, 2.0))
+        valid = valid & ok
+        return cpu_zero_invalid(out, valid), valid

@@ -269,7 +269,10 @@ class Like(Expression):
 
     def trace_consts(self):
         if not self._fast:
-            c = self._compiled()
+            try:
+                c = self._compiled()
+            except Exception:
+                return []   # bridged/fallback: tables never needed
             return [c.table, c.accept]
         return []
 
@@ -392,7 +395,10 @@ class RLike(Expression):
         return self._dfa
 
     def trace_consts(self):
-        c = self._compiled()
+        try:
+            c = self._compiled()
+        except Exception:
+            return []   # bridged/fallback: tables never needed
         return [c.table, c.accept]
 
     @property
@@ -404,10 +410,27 @@ class RLike(Expression):
         hits = _dfa_eval(self, self._compiled(), c, ctx)
         return make_column(hits, c.validity & ctx.live_mask(), T.BOOLEAN)
 
+    def cpu_evaluable(self) -> bool:
+        r"""Can the host oracle run this pattern?  Gates the CPU bridge:
+        Java-only syntax (e.g. \p{...}) compiles under neither engine and
+        must not be routed to a path that would crash."""
+        import re as _re
+        from spark_rapids_tpu.regex import to_python_pattern
+        try:
+            _re.compile(to_python_pattern(self.pattern), _re.ASCII)
+            return True
+        except _re.error:
+            return False
+
     def eval_cpu(self, ctx: CpuEvalContext):
         import re as _re
         from spark_rapids_tpu.regex import to_python_pattern
-        rx = _re.compile(to_python_pattern(self.pattern), _re.ASCII)
+        try:
+            rx = _re.compile(to_python_pattern(self.pattern), _re.ASCII)
+        except _re.error as ex:
+            raise NotImplementedError(
+                f"pattern {self.pattern!r} uses Java-only regex syntax "
+                f"supported by neither engine: {ex}") from ex
         v, valid = self.child.eval_cpu(ctx)
         out = np.array([rx.search(x) is not None if m else False
                         for x, m in zip(v, valid)], dtype=np.bool_)
@@ -768,3 +791,289 @@ class ConcatWs(Expression):
     def __repr__(self):
         inner = ", ".join(map(repr, self.children))
         return f"concat_ws({self.sep!r}, {inner})"
+
+
+class Left(UnaryExpression):
+    """left(str, n-literal): first n characters (n <= 0 -> empty)."""
+
+    def __init__(self, child: Expression, n: int):
+        super().__init__(child)
+        self.n = int(n)
+
+    def with_children(self, children):
+        return Left(children[0], self.n)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _as_substring(self):
+        return Substring(self.child, 1, max(self.n, 0))
+
+    def eval(self, ctx: EvalContext):
+        return self._as_substring().eval(ctx)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        return self._as_substring().eval_cpu(ctx)
+
+    def __repr__(self):
+        return f"left({self.child!r}, {self.n})"
+
+
+class Right(UnaryExpression):
+    """right(str, n-literal): last n characters."""
+
+    def __init__(self, child: Expression, n: int):
+        super().__init__(child)
+        self.n = int(n)
+
+    def with_children(self, children):
+        return Right(children[0], self.n)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _as_substring(self):
+        if self.n <= 0:
+            return Substring(self.child, 1, 0)
+        return Substring(self.child, -self.n, self.n)
+
+    def eval(self, ctx: EvalContext):
+        return self._as_substring().eval(ctx)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        return self._as_substring().eval_cpu(ctx)
+
+    def __repr__(self):
+        return f"right({self.child!r}, {self.n})"
+
+
+class OctetLength(UnaryExpression):
+    """Byte length (Length is character-based)."""
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        return make_column(SK.byte_length(c),
+                           c.validity & ctx.live_mask(), T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        out = np.array([len(x.encode("utf-8")) if m else 0
+                        for x, m in zip(v, valid)], np.int32)
+        return out, valid.copy()
+
+
+class BitLength(UnaryExpression):
+    @property
+    def dtype(self):
+        return T.INT
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        return make_column(SK.byte_length(c) * 8,
+                           c.validity & ctx.live_mask(), T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        out = np.array([len(x.encode("utf-8")) * 8 if m else 0
+                        for x, m in zip(v, valid)], np.int32)
+        return out, valid.copy()
+
+
+class Translate(UnaryExpression):
+    """translate(str, from, to) with ASCII literal from/to: per-char map,
+    chars beyond to's length are DELETED (Spark semantics)."""
+
+    def __init__(self, child: Expression, src: str, dst: str):
+        super().__init__(child)
+        assert all(ord(ch) < 128 for ch in src + dst), \
+            "planner gates non-ASCII translate"
+        self.src = src
+        self.dst = dst
+
+    def with_children(self, children):
+        return Translate(children[0], self.src, self.dst)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        import jax
+        c = self.child.eval(ctx)
+        lut = np.arange(256, dtype=np.uint8)
+        delete = np.zeros(256, np.bool_)
+        seen = set()
+        for i, ch in enumerate(self.src):
+            if ch in seen:      # first occurrence wins (Java)
+                continue
+            seen.add(ch)
+            if i < len(self.dst):
+                lut[ord(ch)] = ord(self.dst[i])
+            else:
+                delete[ord(ch)] = True
+        mapped = jnp.asarray(lut)[c.data.astype(jnp.int32)]
+        col2 = DeviceColumn(mapped, c.validity, c.dtype, c.offsets)
+        if not delete.any():
+            out = col2
+        else:
+            keep = ~jnp.asarray(delete)[c.data.astype(jnp.int32)]
+            out = SK._compact_bytes(col2, keep, ctx.batch.num_rows)
+        return DeviceColumn(out.data, c.validity & ctx.live_mask(),
+                            T.STRING, out.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        table = {}
+        for i, ch in enumerate(self.src):
+            if ch in table:
+                continue
+            table[ch] = self.dst[i] if i < len(self.dst) else None
+        def tr(s):
+            return "".join(table.get(ch, ch) for ch in s
+                           if table.get(ch, ch) is not None)
+        return _obj([tr(x) if m else None
+                     for x, m in zip(v, valid)]), valid.copy()
+
+    def __repr__(self):
+        return f"translate({self.child!r}, {self.src!r}, {self.dst!r})"
+
+
+class Empty2Null(UnaryExpression):
+    """'' -> NULL (Spark's writer-side Empty2Null)."""
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        nonempty = SK.byte_length(c) > 0
+        return DeviceColumn(c.data, c.validity & nonempty & ctx.live_mask(),
+                            T.STRING, c.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        valid2 = valid & np.array([bool(x) if m else False
+                                   for x, m in zip(v, valid)])
+        return _obj([x if m else None for x, m in zip(v, valid2)]), valid2
+
+
+class Concat(Expression):
+    """Variadic string concat (null if ANY input is null) — folds through
+    the pairwise concat kernel."""
+
+    def __init__(self, *children: Expression):
+        assert len(children) >= 1
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return Concat(*children)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        acc = self.children[0].eval(ctx)
+        for c in self.children[1:]:
+            acc = SK.concat_strings(acc, c.eval(ctx), ctx.batch.num_rows)
+        live = ctx.live_mask()
+        return DeviceColumn(acc.data, acc.validity & live, T.STRING,
+                            acc.offsets)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        evs = [c.eval_cpu(ctx) for c in self.children]
+        valid = cpu_null_propagating([m for _, m in evs])
+        out = []
+        for i in range(ctx.num_rows):
+            out.append("".join(v[i] for v, _ in evs) if valid[i] else None)
+        return _obj(out), valid
+
+    def __repr__(self):
+        return f"concat({', '.join(map(repr, self.children))})"
+
+
+class GetJsonObject(UnaryExpression):
+    """get_json_object(json, path) for $.a.b[0]-style paths.
+
+    HOST-ONLY: runs through the CPU bridge (the reference accelerates this
+    with the JSONUtils native kernel, GpuGetJsonObject.scala; a byte-level
+    device JSON scanner is the follow-on).  Scalars return their unquoted
+    form; objects/arrays re-serialize compact; missing/invalid -> NULL.
+    """
+
+    def __init__(self, child: Expression, path: str):
+        super().__init__(child)
+        self.path = path
+        self._steps = self._parse_path(path)
+
+    def with_children(self, children):
+        return GetJsonObject(children[0], self.path)
+
+    @staticmethod
+    def _parse_path(path: str):
+        import re as _re
+        if not path.startswith("$"):
+            return None
+        steps = []
+        rest = path[1:]
+        pat = _re.compile(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]")
+        pos = 0
+        while pos < len(rest):
+            m = pat.match(rest, pos)
+            if not m:
+                return None
+            steps.append(m.group(1) if m.group(1) is not None
+                         else int(m.group(2)))
+            pos = m.end()
+        return steps
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext):
+        raise NotImplementedError(
+            "get_json_object is host-only (CPU bridge)")
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        import json as _json
+        v, valid = self.child.eval_cpu(ctx)
+        out = []
+        ok = np.zeros((ctx.num_rows,), np.bool_)
+        for i, (s, m) in enumerate(zip(v, valid)):
+            res = None
+            if m and self._steps is not None:
+                try:
+                    node = _json.loads(s)
+                    for step in self._steps:
+                        if isinstance(step, str) and isinstance(node, dict):
+                            node = node[step]
+                        elif isinstance(step, int) and isinstance(node, list):
+                            node = node[step]
+                        else:
+                            raise KeyError(step)
+                    if node is None:
+                        res = None
+                    elif isinstance(node, str):
+                        res = node
+                    elif isinstance(node, bool):
+                        res = "true" if node else "false"
+                    elif isinstance(node, (dict, list)):
+                        res = _json.dumps(node, separators=(",", ":"))
+                    else:
+                        res = str(node)
+                except (ValueError, KeyError, IndexError, TypeError):
+                    res = None
+            out.append(res)
+            ok[i] = res is not None
+        return _obj(out), ok
+
+    def __repr__(self):
+        return f"get_json_object({self.child!r}, {self.path!r})"

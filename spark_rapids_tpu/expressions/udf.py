@@ -106,3 +106,53 @@ def tpu_udf(fn: Optional[Callable] = None, *,
     def wrap(f):
         return TracedUDF(f, return_type)
     return wrap
+
+
+class PandasScalarUDF(Expression):
+    """Scalar pandas UDF: fn(pandas.Series, ...) -> pandas.Series.
+
+    HOST-ONLY expression — inside a device plan it executes through the
+    CPU bridge (the reference runs these in an Arrow-fed Python worker,
+    GpuArrowEvalPythonExec.scala:223; trace-compiled UDFs that CAN lower
+    to device expressions use TraceCompiledUDF instead)."""
+
+    def __init__(self, fn, dtype, *children):
+        self.fn = fn
+        self._dtype = dtype
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return PandasScalarUDF(self.fn, self._dtype, *children)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def eval(self, ctx):
+        raise NotImplementedError(
+            "PandasScalarUDF is host-only (CPU bridge)")
+
+    def eval_cpu(self, ctx):
+        import pandas as pd
+
+        series = []
+        for c in self.children:
+            v, m = c.eval_cpu(ctx)
+            vals = [x if ok else None for x, ok in zip(v, m)]
+            series.append(pd.Series(vals))
+        result = self.fn(*series)
+        if not isinstance(result, pd.Series):
+            result = pd.Series(result)
+        validity = (~result.isna()).to_numpy()
+        if self._dtype.variable_width:
+            out = np.empty((len(result),), object)
+            out[:] = [x if ok else None
+                      for x, ok in zip(result.tolist(), validity)]
+            return out, validity
+        filled = result.fillna(0)
+        out = filled.to_numpy().astype(self._dtype.np_dtype)
+        return out, validity
+
+    def __repr__(self):
+        name = getattr(self.fn, "__name__", "udf")
+        return f"pandas_udf:{name}({', '.join(map(repr, self.children))})"

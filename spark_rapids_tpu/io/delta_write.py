@@ -1,0 +1,242 @@
+"""Delta Lake write path: create/append/overwrite commits + MERGE INTO.
+
+Reference: delta-lake/delta-33x/.../GpuOptimisticTransaction.scala (write +
+commit), GpuMergeIntoCommand.scala (MERGE).  This implements the open Delta
+protocol directly: parquet data files written through the commit protocol
+(io/writer.py), then one JSON action file appended to _delta_log —
+`protocol` + `metaData` on create, `remove`+`add` on overwrite/MERGE,
+`add` on append.  Old data files are never deleted (time travel reads
+them through load_snapshot).
+
+MERGE runs as engine joins (the reference plans MERGE as a join + row
+processor, GpuRapidsProcessDeltaMergeJoinExec):
+  result = (target ANTI-JOIN source)                       -- untouched rows
+         ∪ (source SEMI-JOIN target)   when_matched=update_all
+         ∪ (source ANTI-JOIN target)   when_not_matched=insert_all
+then a full rewrite commit (remove all live files, add the new ones).
+Matched rows vanish under when_matched=delete.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.parse
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import Schema
+
+_TYPE_NAMES = {
+    T.BOOLEAN: "boolean", T.BYTE: "byte", T.SHORT: "short",
+    T.INT: "integer", T.LONG: "long", T.FLOAT: "float",
+    T.DOUBLE: "double", T.DATE: "date", T.TIMESTAMP: "timestamp",
+    T.STRING: "string", T.BINARY: "binary",
+}
+
+
+def _type_name(dt: T.DataType) -> str:
+    if isinstance(dt, T.DecimalType):
+        return f"decimal({dt.precision},{dt.scale})"
+    for k, v in _TYPE_NAMES.items():
+        if k == dt:
+            return v
+    raise NotImplementedError(f"delta write type {dt!r}")
+
+
+def schema_to_delta_json(schema: Schema) -> str:
+    return json.dumps({
+        "type": "struct",
+        "fields": [{"name": n, "type": _type_name(d), "nullable": True,
+                    "metadata": {}}
+                   for n, d in zip(schema.names, schema.dtypes)],
+    })
+
+
+def _log_dir(table_path: str) -> str:
+    return os.path.join(table_path, "_delta_log")
+
+
+def _current_version(table_path: str) -> int:
+    """Latest committed version, or -1 for a fresh table."""
+    import re
+    ld = _log_dir(table_path)
+    if not os.path.isdir(ld):
+        return -1
+    versions = [int(n[:20]) for n in os.listdir(ld)
+                if re.fullmatch(r"\d{20}\.json", n)]
+    return max(versions, default=-1)
+
+
+def _partition_values_of(pdir: str) -> Dict[str, Optional[str]]:
+    from spark_rapids_tpu.io.writer import HIVE_DEFAULT_PARTITION
+    out: Dict[str, Optional[str]] = {}
+    if not pdir:
+        return out
+    for seg in pdir.split(os.sep):
+        k, _, v = seg.partition("=")
+        out[k] = None if v == HIVE_DEFAULT_PARTITION else \
+            urllib.parse.unquote(v)
+    return out
+
+
+def _write_data_files(df, table_path: str, partition_by: Sequence[str]):
+    """Write df's partitions as parquet into the table dir (via the
+    two-phase protocol); returns [(rel_path, partitionValues, rows, size)].
+    """
+    from spark_rapids_tpu.io.writer import (
+        FileCommitProtocol, PartitionedWriter)
+    os.makedirs(table_path, exist_ok=True)
+    protocol = FileCommitProtocol(table_path)
+    protocol.setup_job()
+    writers = []
+    try:
+        for task_id, batches in enumerate(df.collect_partitions()):
+            w = PartitionedWriter(protocol, task_id, df.schema,
+                                  list(partition_by), "parquet")
+            writers.append(w)
+            for b in batches:
+                w.write_batch(b)
+            w.close()
+        protocol.commit_job()
+    except BaseException:
+        protocol.abort_job()
+        raise
+    out = []
+    for w in writers:
+        for rel, pdir, rows in w.files_written:
+            size = os.path.getsize(os.path.join(table_path, rel))
+            out.append((rel, _partition_values_of(pdir), rows, size))
+    return out
+
+
+def _commit(table_path: str, version: int, actions: List[dict]) -> None:
+    ld = _log_dir(table_path)
+    os.makedirs(ld, exist_ok=True)
+    path = os.path.join(ld, f"{version:020d}.json")
+    if os.path.exists(path):
+        raise FileExistsError(
+            f"concurrent delta commit detected at version {version}")
+    tmp = path + f".tmp.{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w") as f:
+        for a in actions:
+            f.write(json.dumps(a) + "\n")
+    os.replace(tmp, path)
+
+
+def _add_action(rel: str, pvals: Dict[str, Optional[str]], rows: int,
+                size: int) -> dict:
+    return {"add": {
+        "path": urllib.parse.quote(rel),
+        "partitionValues": pvals,
+        "size": size,
+        "modificationTime": int(time.time() * 1000),
+        "dataChange": True,
+        "stats": json.dumps({"numRecords": rows}),
+    }}
+
+
+def write_delta(df, table_path: str, mode: str = "error",
+                partition_by: Sequence[str] = ()) -> int:
+    """Create/append/overwrite a Delta table from a DataFrame.
+    Returns the committed version."""
+    version = _current_version(table_path)
+    exists = version >= 0
+    if exists and mode == "error":
+        raise FileExistsError(f"delta table {table_path} already exists")
+    files = _write_data_files(df, table_path, partition_by)
+    actions: List[dict] = []
+    if not exists:
+        actions.append({"protocol": {"minReaderVersion": 1,
+                                     "minWriterVersion": 2}})
+        actions.append({"metaData": {
+            "id": uuid.uuid4().hex,
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": schema_to_delta_json(df.schema),
+            "partitionColumns": list(partition_by),
+            "configuration": {},
+            "createdTime": int(time.time() * 1000),
+        }})
+    elif mode == "overwrite":
+        from spark_rapids_tpu.io.delta import load_snapshot
+        snap = load_snapshot(table_path)
+        for abs_path, pvals in snap.files:
+            rel = os.path.relpath(abs_path, table_path)
+            actions.append({"remove": {
+                "path": urllib.parse.quote(rel),
+                "deletionTimestamp": int(time.time() * 1000),
+                "dataChange": True}})
+    elif mode != "append":
+        raise ValueError(f"unknown delta write mode {mode!r}")
+    for rel, pvals, rows, size in files:
+        actions.append(_add_action(rel, pvals, rows, size))
+    actions.append({"commitInfo": {
+        "timestamp": int(time.time() * 1000),
+        "operation": "WRITE" if exists else "CREATE TABLE AS SELECT",
+        "operationParameters": {"mode": mode},
+    }})
+    new_version = version + 1
+    _commit(table_path, new_version, actions)
+    return new_version
+
+
+def merge_into(session, table_path: str, source_df, on: Sequence[str],
+               when_matched: Optional[str] = "update_all",
+               when_not_matched: Optional[str] = "insert_all") -> int:
+    """MERGE INTO target USING source ON target.k = source.k.
+
+    when_matched: 'update_all' (UPDATE SET *), 'delete', or None;
+    when_not_matched: 'insert_all' (INSERT *), or None.
+    Full-rewrite transaction; returns the committed version.
+    Reference: GpuMergeIntoCommand.scala (delta-lake/delta-33x).
+    """
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.io.delta import load_snapshot
+
+    snap = load_snapshot(table_path)
+    target = session.read_delta(table_path)
+    schema = target.schema
+    if tuple(source_df.schema.names) != tuple(schema.names):
+        source_df = source_df.select(*[col(n) for n in schema.names])
+    keys = [col(k) for k in on]
+
+    if when_matched is None:
+        # insert-only MERGE: matched target rows stay untouched
+        pieces = [target]
+    else:
+        pieces = [target.join(source_df, on=(keys, keys), how="left_anti")]
+        if when_matched == "update_all":
+            pieces.append(source_df.join(target, on=(keys, keys),
+                                         how="left_semi"))
+        elif when_matched != "delete":
+            raise ValueError(f"when_matched={when_matched!r}")
+    if when_not_matched == "insert_all":
+        pieces.append(source_df.join(target, on=(keys, keys),
+                                     how="left_anti"))
+    elif when_not_matched is not None:
+        raise ValueError(f"when_not_matched={when_not_matched!r}")
+
+    result = pieces[0]
+    for p in pieces[1:]:
+        result = result.union(p)
+
+    files = _write_data_files(result, table_path, snap.partition_columns)
+    actions: List[dict] = []
+    for abs_path, _pv in snap.files:
+        rel = os.path.relpath(abs_path, table_path)
+        actions.append({"remove": {
+            "path": urllib.parse.quote(rel),
+            "deletionTimestamp": int(time.time() * 1000),
+            "dataChange": True}})
+    for rel, pvals, rows, size in files:
+        actions.append(_add_action(rel, pvals, rows, size))
+    actions.append({"commitInfo": {
+        "timestamp": int(time.time() * 1000),
+        "operation": "MERGE",
+        "operationParameters": {"matched": when_matched or "none",
+                                "notMatched": when_not_matched or "none"},
+    }})
+    new_version = snap.version + 1
+    _commit(table_path, new_version, actions)
+    return new_version

@@ -17,6 +17,13 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
+# all consumed submodules import at module load: first-importing a pyarrow
+# extension module on a reader-pool/engine thread concurrently with device
+# work corrupts the process (see plan/execs/lore.py note)
+import pyarrow.csv as _pcsv_preload       # noqa: F401
+import pyarrow.json as _pjson_preload     # noqa: F401
+import pyarrow.orc as _porc_preload       # noqa: F401
+import pyarrow.parquet as _pq_preload     # noqa: F401
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.arrow import (
@@ -74,12 +81,12 @@ def _csv_options(options):
                 convert_options=convert)
 
 
-def read_batches(path: str, fmt: str,
-                 columns: Optional[Sequence[str]] = None,
-                 batch_size_rows: int = 1 << 20,
-                 schema: Optional[Schema] = None,
-                 **options) -> Iterator[ColumnarBatch]:
-    """Stream one file as device batches."""
+def iter_arrow(path: str, fmt: str,
+               columns: Optional[Sequence[str]] = None,
+               batch_size_rows: int = 1 << 20,
+               schema: Optional[Schema] = None,
+               **options) -> Iterator[pa.Table]:
+    """HOST side of the csv/json/orc scan (reader-pool safe, no device)."""
     if fmt == "csv":
         import pyarrow.csv as pcsv
         table = pcsv.read_csv(path, **_csv_options(options))
@@ -102,6 +109,17 @@ def read_batches(path: str, fmt: str,
         chunk = table.slice(off, batch_size_rows)
         if chunk.num_rows == 0 and off > 0:
             break
+        yield chunk
+
+
+def read_batches(path: str, fmt: str,
+                 columns: Optional[Sequence[str]] = None,
+                 batch_size_rows: int = 1 << 20,
+                 schema: Optional[Schema] = None,
+                 **options) -> Iterator[ColumnarBatch]:
+    """Stream one file as device batches."""
+    for chunk in iter_arrow(path, fmt, columns, batch_size_rows, schema,
+                            **options):
         yield arrow_to_batch(chunk)
 
 
@@ -138,3 +156,75 @@ def write_file(batches, path: str, fmt: str,
     else:
         raise ValueError(fmt)
     return rows
+
+
+class _ParquetIncWriter:
+    def __init__(self, path: str, schema: Schema):
+        import pyarrow.parquet as _pq
+        self.path = path
+        self.schema = schema
+        self._writer = None
+        self._pq = _pq
+
+    def write(self, batch) -> int:
+        table = batch_to_arrow(batch)
+        if self._writer is None:
+            self._writer = self._pq.ParquetWriter(self.path, table.schema)
+        self._writer.write_table(table)
+        return batch.host_num_rows()
+
+    def close(self) -> None:
+        if self._writer is None:
+            # schema-only empty file
+            empty = pa.table({n: pa.array([], type=sql_type_to_arrow(d))
+                              for n, d in zip(self.schema.names,
+                                              self.schema.dtypes)})
+            self._writer = self._pq.ParquetWriter(self.path, empty.schema)
+            self._writer.write_table(empty)
+        self._writer.close()
+
+
+class _BufferedIncWriter:
+    """csv/json/orc incremental writer: buffers arrow tables, encodes on
+    close (these codecs have no cheap append path in pyarrow)."""
+
+    def __init__(self, path: str, fmt: str, schema: Schema):
+        self.path = path
+        self.fmt = fmt
+        self.schema = schema
+        self.tables = []
+
+    def write(self, batch) -> int:
+        self.tables.append(batch_to_arrow(batch))
+        return batch.host_num_rows()
+
+    def close(self) -> None:
+        from spark_rapids_tpu.io.formats import write_file
+        if self.tables:
+            table = pa.concat_tables(self.tables)
+        else:
+            table = pa.table({n: pa.array([], type=sql_type_to_arrow(d))
+                              for n, d in zip(self.schema.names,
+                                              self.schema.dtypes)})
+        if self.fmt == "csv":
+            import pyarrow.csv as pcsv
+            pcsv.write_csv(table, self.path)
+        elif self.fmt == "orc":
+            import pyarrow.orc as porc
+            porc.write_table(table, self.path)
+        elif self.fmt == "json":
+            import json as _json
+            with open(self.path, "w") as f:
+                for row in table.to_pylist():
+                    f.write(_json.dumps(
+                        {k: v for k, v in row.items()
+                         if v is not None}) + "\n")
+        else:
+            raise ValueError(self.fmt)
+
+
+def open_writer(path: str, fmt: str, schema: Schema):
+    """Incremental per-file writer handle (ColumnarOutputWriter analog)."""
+    if fmt == "parquet":
+        return _ParquetIncWriter(path, schema)
+    return _BufferedIncWriter(path, fmt, schema)

@@ -54,13 +54,14 @@ def _stats_allow(row_group, col_index: int, lo, hi) -> bool:
     return True
 
 
-def read_parquet_batches(
+def iter_parquet_arrow(
     path: str,
     columns: Optional[Sequence[str]] = None,
     batch_size_rows: int = 1 << 20,
     range_filters: Optional[dict] = None,
-) -> Iterator[ColumnarBatch]:
-    """Stream one file as device batches of ~batch_size_rows.
+) -> Iterator[pa.Table]:
+    """HOST side of the scan: footer parse, row-group pruning, page decode
+    to Arrow tables — safe to run on the reader pool with no semaphore.
 
     range_filters: {column: (lo, hi)} predicate-pushdown hints used for
     row-group pruning only (exact filtering stays in the Filter exec —
@@ -87,7 +88,18 @@ def read_parquet_batches(
     for record_batch in pf.iter_batches(batch_size=batch_size_rows,
                                         row_groups=groups,
                                         columns=list(columns) if columns else None):
-        table = pa.Table.from_batches([record_batch])
+        yield pa.Table.from_batches([record_batch])
+
+
+def read_parquet_batches(
+    path: str,
+    columns: Optional[Sequence[str]] = None,
+    batch_size_rows: int = 1 << 20,
+    range_filters: Optional[dict] = None,
+) -> Iterator[ColumnarBatch]:
+    """Stream one file as DEVICE batches (host decode + upload, serial)."""
+    for table in iter_parquet_arrow(path, columns, batch_size_rows,
+                                    range_filters):
         yield arrow_to_batch(table)
 
 

@@ -199,6 +199,8 @@ def parse_double(col: DeviceColumn, max_len: int
     e_total = exp_val + e_adj
     e_clip = jnp.clip(e_total, -400, 400).astype(jnp.float64)
     value = mant.astype(jnp.float64) * jnp.power(jnp.float64(10.0), e_clip)
+    # zero mantissa with a huge exponent must not become 0 * inf = NaN
+    value = jnp.where(mant == 0, jnp.float64(0.0), value)
     value = jnp.where(neg, -value, value)
 
     num_ok = has_content & mant_ok & exp_ok & (n_e <= 1)
@@ -251,7 +253,10 @@ def parse_date(col: DeviceColumn, max_len: int
     dim = jnp.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
                     jnp.int64)[jnp.clip(m - 1, 0, 11)]
     dim = jnp.where((m == 2) & leap, 29, dim)
-    ok = (has_content & (ndash <= 2) & (yn == 4) & yok & mn_ok & dn_ok
+    # y >= 1: ISO year 0 exists in Java's proleptic calendar but not in
+    # the CPU oracle (python datetime MINYEAR=1) — align on rejecting it
+    ok = (has_content & (ndash <= 2) & (yn == 4) & yok & (y >= 1)
+          & mn_ok & dn_ok
           & (m >= 1) & (m <= 12) & (d >= 1) & (d <= dim))
     days = _days_from_civil(y, m, d, jnp).astype(jnp.int32)
     return jnp.where(ok, days, 0), ok
@@ -316,10 +321,13 @@ def long_to_string(vals: jax.Array, validity: jax.Array) -> DeviceColumn:
 
 
 def date_to_string(days: jax.Array, validity: jax.Array) -> DeviceColumn:
-    """epoch days -> 'yyyy-MM-dd' (years 0..9999)."""
+    """epoch days -> 'yyyy-MM-dd'.  Years outside [1, 9999] go NULL on
+    BOTH engines (python datetime cannot represent them; Java would format
+    '+10000-...' — documented divergence, null instead of wrong output)."""
     from spark_rapids_tpu.expressions.datetime import _civil_from_days
     y, m, d = _civil_from_days(days.astype(jnp.int64), jnp)
-    y = jnp.clip(y, 0, 9999)
+    validity = validity & (y >= 1) & (y <= 9999)
+    y = jnp.clip(y, 1, 9999)
     cap = days.shape[0]
     digs = jnp.stack([
         y // 1000 % 10, y // 100 % 10, y // 10 % 10, y % 10,

@@ -593,3 +593,31 @@ def concat_ws(cols, sep: bytes, num_rows) -> DeviceColumn:
     out = jnp.where(bpos < offsets[cap], out, jnp.uint8(0))
     from spark_rapids_tpu import types as T
     return DeviceColumn(out, live, T.STRING, offsets)
+
+
+def select_strings(mask: jax.Array, a: DeviceColumn, b: DeviceColumn,
+                   num_rows) -> DeviceColumn:
+    """Row-wise string choice: mask ? a : b (If/CaseWhen over strings).
+
+    Variable-width columns cannot be jnp.where'd buffer-wise; the output
+    rebuilds offsets from the chosen per-row lengths and gathers bytes
+    from whichever source each row selected.  Output byte capacity =
+    a.byte_capacity + b.byte_capacity (safe bound, no overflow path).
+    """
+    cap = a.capacity
+    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    a_len = a.offsets[1:] - a.offsets[:-1]
+    b_len = b.offsets[1:] - b.offsets[:-1]
+    lens = jnp.where(live, jnp.where(mask, a_len, b_len), 0)
+    offsets = jnp.zeros((cap + 1,), jnp.int32).at[1:].set(jnp.cumsum(lens))
+    bcap = a.byte_capacity + b.byte_capacity
+    bpos = jnp.arange(bcap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, bpos, side="right") - 1,
+                   0, cap - 1).astype(jnp.int32)
+    within = bpos - offsets[row]
+    src_a = jnp.clip(a.offsets[:-1][row] + within, 0, a.byte_capacity - 1)
+    src_b = jnp.clip(b.offsets[:-1][row] + within, 0, b.byte_capacity - 1)
+    data = jnp.where(mask[row], a.data[src_a], b.data[src_b])
+    data = jnp.where(bpos < offsets[cap], data, jnp.uint8(0))
+    validity = jnp.where(mask, a.validity, b.validity) & live
+    return DeviceColumn(data, validity, a.dtype, offsets)

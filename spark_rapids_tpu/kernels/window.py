@@ -197,3 +197,114 @@ def shift(values: jax.Array, validity: jax.Array, layout: WindowLayout,
     vals = jnp.where(in_seg, values[safe], jnp.zeros((), values.dtype))
     valid = in_seg & jnp.where(in_seg, validity[safe], False)
     return vals, valid
+
+
+def frame_bounds_rows(layout: WindowLayout, preceding: Optional[int],
+                      following: Optional[int]):
+    """(lower, upper) inclusive position bounds of a ROWS frame."""
+    if following is None:
+        upper = layout.seg_end - 1
+    else:
+        upper = jnp.minimum(layout.pos + following, layout.seg_end - 1)
+    if preceding is None:
+        lower = layout.seg_start
+    else:
+        lower = jnp.maximum(layout.pos - preceding, layout.seg_start)
+    return lower, upper
+
+
+def frame_bounds_range(order_vals: jax.Array, layout: WindowLayout,
+                       preceding, following):
+    """(lower, upper) inclusive bounds of RANGE BETWEEN x PRECEDING AND y
+    FOLLOWING over a numeric ORDER BY column (already partition-sorted).
+
+    preceding/following: python scalars (None = unbounded).  Row i's frame
+    holds rows j in i's partition with order[j] in
+    [order[i]-preceding, order[i]+following] — found by a vectorized
+    in-segment binary search (rows parallel, log2(cap) gather steps).
+    """
+    cap = order_vals.shape[0]
+
+    def bsearch(target, side_left: bool):
+        lo = layout.seg_start
+        hi = layout.seg_end          # exclusive
+        steps = max(cap.bit_length(), 1)
+        def step(_, carry):
+            lo, hi = carry
+            open_ = lo < hi            # converged rows must not move again
+            mid = (lo + hi) // 2
+            v = order_vals[jnp.clip(mid, 0, cap - 1)]
+            go_right = (v < target) if side_left else (v <= target)
+            lo = jnp.where(open_ & go_right, mid + 1, lo)
+            hi = jnp.where(open_ & ~go_right, mid, hi)
+            return lo, hi
+        lo, hi = jax.lax.fori_loop(0, steps, step, (lo, hi))
+        return lo
+
+    if preceding is None:
+        lower = layout.seg_start
+    else:
+        lower = bsearch(order_vals - preceding, True)
+    if following is None:
+        upper = layout.seg_end - 1
+    else:
+        upper = bsearch(order_vals + following, False) - 1
+    return lower, upper
+
+
+def bounded_sum_count(values: jax.Array, valid: jax.Array,
+                      layout: WindowLayout, lower: jax.Array,
+                      upper: jax.Array, dtype):
+    """Sum + valid-count over inclusive [lower, upper] position frames."""
+    ps = _prefix_sum(values, valid & layout.live, dtype)
+    pc = jnp.cumsum((valid & layout.live).astype(jnp.int64))
+    s = _at_or_zero(ps, upper) - _at_or_zero(ps, lower - 1)
+    n = _at_or_zero(pc, upper) - _at_or_zero(pc, lower - 1)
+    empty = upper < lower
+    return jnp.where(empty, jnp.zeros((), s.dtype), s), \
+        jnp.where(empty, 0, n)
+
+
+def bounded_min_max(values: jax.Array, valid: jax.Array,
+                    layout: WindowLayout, lower: jax.Array,
+                    upper: jax.Array, is_min: bool):
+    """Min/max over inclusive [lower, upper] frames via a sparse table
+    (doubling min-tables: O(n log n) build, O(1) query per row — the TPU
+    shape of cuDF's fixed-window min/max kernels)."""
+    cap = values.shape[0]
+    ident = None
+    dt = values.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        ident = jnp.asarray(jnp.inf if is_min else -jnp.inf, dt)
+    elif dt == jnp.bool_:
+        values = values.astype(jnp.int8)
+        dt = jnp.int8
+        ident = jnp.asarray(1 if is_min else 0, dt)
+    else:
+        info = jnp.iinfo(dt)
+        ident = jnp.asarray(info.max if is_min else info.min, dt)
+    combine = jnp.minimum if is_min else jnp.maximum
+    base = jnp.where(valid & layout.live, values, ident)
+
+    levels = [base]
+    k = 1
+    while k < cap:
+        prev = levels[-1]
+        shifted = jnp.concatenate([prev[k:], jnp.full((k,), ident, dt)])
+        levels.append(combine(prev, shifted))
+        k <<= 1
+    table = jnp.stack(levels)          # [L, cap]; level l covers 2^l rows
+
+    length = jnp.maximum(upper - lower + 1, 0)
+    # floor(log2(length)) via float exponent (exact for lengths < 2^24)
+    lvl = jnp.where(length > 0,
+                    jnp.floor(jnp.log2(jnp.maximum(
+                        length.astype(jnp.float64), 1.0))).astype(jnp.int32),
+                    0)
+    lvl = jnp.clip(lvl, 0, len(levels) - 1)
+    span = (1 << lvl.astype(jnp.int64)).astype(jnp.int32)
+    a = table[lvl, jnp.clip(lower, 0, cap - 1)]
+    b = table[lvl, jnp.clip(upper - span + 1, 0, cap - 1)]
+    out = combine(a, b)
+    empty = length <= 0
+    return jnp.where(empty, ident, out), ~empty

@@ -41,8 +41,13 @@ def initialize_memory(conf) -> None:
     _retry.MAX_RETRIES = conf.retry_max_attempts
     _sem.configure(conf.concurrent_tpu_tasks)
     spill_framework().host_limit_bytes = conf.get(C.HOST_SPILL_STORAGE_SIZE)
+    device_arena().check_retry_context = conf.retry_context_check
     # injectRetryOOM accepts: false | true | retry[:num[:skip]] | split[:num[:skip]]
-    # (reference parse: RapidsConf.scala:3041-3083)
+    # (reference parse: RapidsConf.scala:3041-3083).  Only an EXPLICIT key
+    # touches the injection state: the @inject_oom test marker arms it
+    # directly and a later session init must not disarm it.
+    if conf.raw(C.TEST_INJECT_RETRY_OOM.key) is None:
+        return
     spec = conf.test_inject_retry_oom.strip().lower()
     if spec in ("", "false", "0", "no"):
         device_arena().clear_injection()

@@ -84,6 +84,9 @@ class DeviceArena:
         self.budget_bytes = budget_bytes
         self.used_bytes = 0
         self.peak_bytes = 0
+        # retryContextCheck.enabled: assert every reserve() happens inside
+        # a withRetry scope (AllocationRetryCoverageTracker analog)
+        self.check_retry_context = False
         self._lock = threading.RLock()
         self._spill_cb: Optional[Callable[[int], int]] = None
         self._injection: Optional[_Injection] = None
@@ -133,6 +136,12 @@ class DeviceArena:
         the arena lock (materialize -> reserve), so calling out under the
         lock would be an ABBA deadlock.
         """
+        if self.check_retry_context and not in_retry_scope():
+            raise AssertionError(
+                "allocation outside a retry scope with "
+                "spark.rapids.sql.test.retryContextCheck.enabled (the "
+                "AllocationRetryCoverageTracker analog: every allocation "
+                "site must be withRetry-covered)")
         self.maybe_throw_injected()
         with self._lock:
             needed = 0

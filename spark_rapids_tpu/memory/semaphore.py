@@ -78,7 +78,8 @@ class TpuSemaphore:
             self.release_if_necessary()
 
 
-_SEMAPHORE = TpuSemaphore()
+_SEMAPHORE_SIZE = 2
+_SEMAPHORE = TpuSemaphore(_SEMAPHORE_SIZE)
 
 
 def tpu_semaphore() -> TpuSemaphore:
@@ -86,5 +87,11 @@ def tpu_semaphore() -> TpuSemaphore:
 
 
 def configure(concurrent_tasks: int) -> None:
-    global _SEMAPHORE
+    """Resize the process semaphore.  No-op when the size is unchanged —
+    session init calls this (Plugin.scala:657 analog) and must not drop
+    permits held by a query running on another thread."""
+    global _SEMAPHORE, _SEMAPHORE_SIZE
+    if concurrent_tasks == _SEMAPHORE_SIZE:
+        return
     _SEMAPHORE = TpuSemaphore(concurrent_tasks)
+    _SEMAPHORE_SIZE = concurrent_tasks

@@ -40,14 +40,18 @@ from spark_rapids_tpu.memory.arena import device_arena
 
 
 def _batch_to_host(batch: ColumnarBatch) -> Tuple[dict, Schema]:
-    """Device batch -> dict of numpy arrays (full capacity, canonical)."""
+    """Device batch -> dict of numpy arrays (full capacity, canonical).
+
+    OWNING copies, not np.asarray views: on the CPU backend a view would
+    silently pin the jax buffer alive (spill would free nothing, and the
+    arena release would under-count residency)."""
     arrays = {}
     for i, col in enumerate(batch.columns):
-        arrays[f"data_{i}"] = np.asarray(col.data)
-        arrays[f"valid_{i}"] = np.asarray(col.validity)
+        arrays[f"data_{i}"] = np.array(col.data, copy=True)
+        arrays[f"valid_{i}"] = np.array(col.validity, copy=True)
         if col.offsets is not None:
-            arrays[f"offsets_{i}"] = np.asarray(col.offsets)
-    arrays["num_rows"] = np.asarray(batch.num_rows)
+            arrays[f"offsets_{i}"] = np.array(col.offsets, copy=True)
+    arrays["num_rows"] = np.array(batch.num_rows, copy=True)
     return arrays, batch.schema
 
 

@@ -479,11 +479,36 @@ class CpuEngine:
                     cv, cm = (fn.input.eval_cpu(sctx) if fn.input is not None
                               else (np.zeros((n,)), np.ones((n,), np.bool_)))
                     frame = inner.spec.frame
+                    okv = None
+                    if frame.kind == "range" and not (
+                            frame.is_unbounded_both()
+                            or frame.is_unbounded_to_current()):
+                        oe, oord = inner.spec.order_by[0]
+                        if not oord.ascending:
+                            raise NotImplementedError(
+                                "descending bounded RANGE window frames "
+                                "are not supported (both engines)")
+                        okv, _okm = oe.eval_cpu(sctx)
                     for i in range(len(rows)):
                         if frame.is_unbounded_both():
                             f_lo, f_hi = 0, len(rows)
                         elif frame.kind == "range" and frame.is_unbounded_to_current():
                             f_lo, f_hi = 0, peer_of[i][2]
+                        elif okv is not None:
+                            # bounded RANGE over the order value (ascending)
+                            ki = okv[lo + i]
+                            vlo = None if frame.start is None else ki + frame.start
+                            vhi = None if frame.end is None else ki + frame.end
+                            f_lo, f_hi = 0, len(rows)
+                            if vlo is not None:
+                                while f_lo < len(rows) and \
+                                        okv[lo + f_lo] < vlo:
+                                    f_lo += 1
+                            if vhi is not None:
+                                f_hi = f_lo
+                                while f_hi < len(rows) and \
+                                        okv[lo + f_hi] <= vhi:
+                                    f_hi += 1
                         else:  # rows frame
                             f_lo = (0 if frame.start is None
                                     else max(i + frame.start, 0))

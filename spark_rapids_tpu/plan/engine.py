@@ -18,6 +18,7 @@ from spark_rapids_tpu.plan.execs.base import TpuExec
 class TpuEngine:
     def __init__(self, conf: Optional[RapidsConf] = None):
         self.conf = conf or RapidsConf()
+        self.last_metrics = None
 
     def execute(self, plan: TpuExec) -> List[List[ColumnarBatch]]:
         """Materialize all partitions (list of batches per partition)."""
@@ -38,7 +39,18 @@ class TpuEngine:
             with ThreadPoolExecutor(max_workers=threads) as pool:
                 return list(pool.map(run_one, range(nparts)))
         finally:
+            self.last_metrics = self._metrics_report(plan)
             plan.cleanup()
+
+    def _metrics_report(self, plan: TpuExec, _out=None, _depth=0):
+        """Per-exec metric snapshots at the configured verbosity
+        (spark.rapids.sql.metrics.level; GpuMetrics levels analog)."""
+        level = self.conf.metrics_level
+        out = _out if _out is not None else []
+        out.append((plan.describe(), _depth, plan.metrics.snapshot(level)))
+        for c in plan.children:
+            self._metrics_report(c, out, _depth + 1)
+        return out
 
     def collect(self, plan: TpuExec) -> List[tuple]:
         from spark_rapids_tpu.plan.cpu_engine import CpuTable

@@ -78,7 +78,9 @@ def expr_cache_key(e) -> str:
         except Exception:
             atoms.append("?")
         for k in sorted(vars(x)):
-            if k == "children":
+            if k == "children" or k.startswith("_"):
+                # private attrs are derived caches (e.g. a compiled DFA);
+                # the public fields (pattern, dtype, ...) determine them
                 continue
             v = vars(x)[k]
             if isinstance(v, Expression) or (
@@ -131,6 +133,9 @@ class Metric:
         return self.value
 
 
+_METRIC_LEVELS = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
+
+
 class MetricSet:
     """Per-exec metrics registry (GpuMetrics.scala:89 analog)."""
 
@@ -142,8 +147,12 @@ class MetricSet:
             self._metrics[name] = Metric(name, level)
         return self._metrics[name]
 
-    def snapshot(self) -> Dict[str, int]:
-        return {k: m.resolve() for k, m in self._metrics.items()}
+    def snapshot(self, level: str = "DEBUG") -> Dict[str, int]:
+        """Metrics at or below the requested verbosity
+        (spark.rapids.sql.metrics.level: ESSENTIAL < MODERATE < DEBUG)."""
+        cut = _METRIC_LEVELS.get(level.upper(), 2)
+        return {k: m.resolve() for k, m in self._metrics.items()
+                if _METRIC_LEVELS.get(m.level, 1) <= cut}
 
 
 class TpuExec:
@@ -269,21 +278,37 @@ def regex_bucket(batch, exprs) -> int:
     regex nodes.  Returns 0 when no subtree needs one (no device sync)."""
     if not tree_uses_string_bucket(exprs):
         return 0
-    from spark_rapids_tpu.expressions.core import Literal
+    from spark_rapids_tpu.expressions.core import BoundReference, Literal
     from spark_rapids_tpu.kernels import strings as SK
-    m = 0
-    for col in batch.columns:
-        if col.is_string_like:
-            m = max(m, int(SK.max_live_string_bytes(col, batch.num_rows)))
+
+    # only the string columns/literals referenced UNDER bucket-consuming
+    # nodes matter: syncing every string column would inflate the window
+    # (and the jit variant count) with unrelated long columns
+    ordinals = set()
+    lit_len = [0]
+
+    def collect(e):
+        if isinstance(e, BoundReference) and getattr(
+                e.dtype, "variable_width", False):
+            ordinals.add(e.ordinal)
+        if isinstance(e, Literal) and isinstance(e.value, str):
+            lit_len[0] = max(lit_len[0], len(e.value.encode("utf-8")))
+        for c in e.children:
+            collect(c)
 
     def walk(e):
-        nonlocal m
-        if isinstance(e, Literal) and isinstance(e.value, str):
-            m = max(m, len(e.value.encode("utf-8")))
+        if getattr(e, "uses_string_bucket", False):
+            collect(e)
+            return
         for c in e.children:
             walk(c)
     for e in exprs:
         walk(e)
+    m = lit_len[0]
+    for ci in ordinals:
+        col = batch.columns[ci]
+        if col.is_string_like:
+            m = max(m, int(SK.max_live_string_bytes(col, batch.num_rows)))
     return SK.bucket_for(m)
 
 
@@ -293,8 +318,16 @@ def jit_bucketed_step(key: str, exprs, make_call):
     and invoke with (batch, consts).  ``make_call(string_bucket)`` returns
     the traceable fn(batch, consts)."""
     import jax.numpy as _jnp
+    from spark_rapids_tpu.expressions.bridge import tree_has_bridge
     exprs = tuple(exprs)
     consts = tuple(_jnp.asarray(a) for a in collect_trace_consts(exprs))
+
+    if tree_has_bridge(exprs):
+        # CPU-bridged steps run EAGERLY: the host round-trip inside
+        # CpuBridgeExpression cannot live under jax.jit; surrounding
+        # device expressions still execute as (op-by-op) XLA
+        return lambda batch: make_call(regex_bucket(batch, exprs))(
+            batch, consts)
 
     def call(batch):
         bkt = regex_bucket(batch, exprs)

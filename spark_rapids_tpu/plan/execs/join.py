@@ -300,3 +300,99 @@ class TpuBroadcastHashJoinExec(TpuExec):
     def describe(self):
         return (f"TpuBroadcastHashJoin[{self.join_type}, "
                 f"lkeys={self.left_key_idx}, rkeys={self.right_key_idx}]")
+
+
+class TpuAdaptiveJoinExec(TpuExec):
+    """Runtime join-strategy choice from MATERIALIZED build-side size.
+
+    The planner emits this when the static cardinality estimate sits in
+    the ambiguous zone around the broadcast threshold: the build (right)
+    side materializes first, its ACTUAL row count picks broadcast vs
+    shuffled, and the inner exec runs over in-memory scans of the
+    materialized batches.  The reference's sized-join build-side choice
+    from exchange statistics (GpuShuffledSizedHashJoinExec.scala:829) and
+    AQE's runtime re-plan, in one node.
+    """
+
+    def __init__(self, left: TpuExec, right: TpuExec, left_keys, right_keys,
+                 join_type: str, schema: Schema,
+                 broadcast_threshold: int, shuffle_partitions: int,
+                 writer_threads: int = 4, codec: str = "none",
+                 target_rows: int = 1 << 20):
+        super().__init__((left, right), schema)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.broadcast_threshold = broadcast_threshold
+        self.shuffle_partitions = shuffle_partitions
+        self.writer_threads = writer_threads
+        self.codec = codec
+        self.target_rows = target_rows
+        self._lock = threading.Lock()
+        self._inner: Optional[TpuExec] = None
+        self.chosen: Optional[str] = None   # exposed for tests/explain
+
+    def _decide(self) -> TpuExec:
+        with self._lock:
+            if self._inner is not None:
+                return self._inner
+            from spark_rapids_tpu.memory.semaphore import tpu_semaphore
+            from spark_rapids_tpu.plan.execs.exchange import (
+                TpuShuffleExchangeExec)
+            from spark_rapids_tpu.plan.execs.scan import TpuInMemoryScanExec
+
+            right = self.children[1]
+            # materializing the build side is device work: hold the
+            # semaphore like any task would (the engine may reach here from
+            # num_partitions(), before its own per-task acquisition)
+            with tpu_semaphore().held():
+                right_parts = [list(right.execute_partition(p))
+                               for p in range(right.num_partitions())]
+            build_rows = sum(b.host_num_rows()
+                             for part in right_parts for b in part)
+            right_scan = TpuInMemoryScanExec(right_parts,
+                                             self.children[1].schema)
+            left = self.children[0]
+            if build_rows <= self.broadcast_threshold:
+                self.chosen = "broadcast"
+                self._inner = TpuBroadcastHashJoinExec(
+                    left, right_scan, self.left_keys, self.right_keys,
+                    self.join_type, self.schema,
+                    target_rows=self.target_rows)
+            else:
+                self.chosen = "shuffled"
+                lex = TpuShuffleExchangeExec(
+                    self.shuffle_partitions, self.left_keys, left,
+                    writer_threads=self.writer_threads, codec=self.codec,
+                    target_rows=self.target_rows)
+                rex = TpuShuffleExchangeExec(
+                    self.shuffle_partitions, self.right_keys, right_scan,
+                    writer_threads=self.writer_threads, codec=self.codec,
+                    target_rows=self.target_rows)
+                self._inner = TpuShuffledHashJoinExec(
+                    lex, rex, self.left_keys, self.right_keys,
+                    self.join_type, self.schema,
+                    target_rows=self.target_rows)
+            return self._inner
+
+    def num_partitions(self) -> int:
+        return self._decide().num_partitions()
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        inner = self._decide()
+        for batch in inner.execute_partition(idx):
+            self.output_rows.add(batch.num_rows)
+            yield self._count_out(batch)
+
+    def cleanup(self) -> None:
+        with self._lock:
+            if self._inner is not None:
+                self._inner.cleanup()
+                self._inner = None
+                self.chosen = None
+        super().cleanup()
+
+    def describe(self):
+        return (f"TpuAdaptiveJoin[{self.join_type}, "
+                f"threshold={self.broadcast_threshold}"
+                + (f", chosen={self.chosen}" if self.chosen else "") + "]")

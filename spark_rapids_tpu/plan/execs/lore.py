@@ -1,0 +1,61 @@
+"""LORE: dump an exec's output batches for offline replay.
+
+Reference: lore/ (GpuLore tagging at GpuOverrides.scala:5149 + the LORE
+dump/replay workflow).  plan_query assigns every exec a preorder loreId
+(shown in the exec tree); ids listed in spark.rapids.sql.lore.idsToDump
+get a pass-through wrapper that writes each output batch as parquet under
+<dumpPath>/loreId-N/.  tools/lore_replay.py loads a dump back as a
+DataFrame so the downstream subplan can be debugged in isolation.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+# import at module load (main thread): first-importing pyarrow.parquet on
+# an engine worker thread concurrently with device work corrupts the
+# process (observed as later pq.read_table segfaults)
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.plan.execs.base import TpuExec
+
+
+class TpuLoreDumpExec(TpuExec):
+    def __init__(self, child: TpuExec, lore_id: int, dump_path: str):
+        super().__init__((child,), child.schema)
+        self.lore_id = lore_id
+        self.dump_dir = os.path.join(dump_path, f"loreId-{lore_id}")
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions()
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.columnar.arrow import batch_to_arrow
+        os.makedirs(self.dump_dir, exist_ok=True)
+        for i, batch in enumerate(self.children[0].execute_partition(idx)):
+            path = os.path.join(self.dump_dir,
+                                f"part-{idx:04d}-batch-{i:04d}.parquet")
+            pq.write_table(batch_to_arrow(batch), path)
+            yield batch
+
+    def describe(self):
+        return f"TpuLoreDump[id={self.lore_id} -> {self.dump_dir}]"
+
+
+def apply_lore(root: TpuExec, conf) -> TpuExec:
+    """Assign preorder lore ids; wrap the ids selected for dumping."""
+    ids = conf.lore_dump_ids
+    path = conf.lore_dump_path
+    counter = [0]
+
+    def walk(node: TpuExec) -> TpuExec:
+        my_id = counter[0]
+        counter[0] += 1
+        node.lore_id = my_id
+        node.children = tuple(walk(c) for c in node.children)
+        if my_id in ids:
+            return TpuLoreDumpExec(node, my_id, path)
+        return node
+
+    return walk(root)

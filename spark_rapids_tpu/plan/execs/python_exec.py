@@ -16,12 +16,29 @@ from spark_rapids_tpu.plan.execs.base import TpuExec, timed
 
 
 class TpuMapBatchesExec(TpuExec):
-    def __init__(self, fn, child: TpuExec, schema: Schema):
+    def __init__(self, fn, child: TpuExec, schema: Schema,
+                 whole_partition: bool = False):
         super().__init__((child,), schema)
         self.fn = fn
+        self.whole_partition = whole_partition
+
+    def _input_batches(self, idx: int):
+        if not self.whole_partition:
+            yield from self.children[0].execute_partition(idx)
+            return
+        # grouped-map: one Arrow table per partition (host-side concat —
+        # cheaper than a device coalesce we would immediately download)
+        import pyarrow as pa
+        tables = [b.to_arrow()
+                  for b in self.children[0].execute_partition(idx)]
+        if not tables:
+            return
+        merged = pa.concat_tables(tables)
+        from spark_rapids_tpu.columnar.arrow import arrow_to_batch
+        yield arrow_to_batch(merged)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
-        for batch in self.children[0].execute_partition(idx):
+        for batch in self._input_batches(idx):
             with timed(self.op_time):
                 table = batch.to_arrow()     # device -> host Arrow
                 sem = tpu_semaphore()

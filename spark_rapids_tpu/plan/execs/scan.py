@@ -35,64 +35,115 @@ class TpuInMemoryScanExec(TpuExec):
         return f"TpuInMemoryScan{self.schema!r}"
 
 
-class TpuParquetScanExec(TpuExec):
-    """One partition per file (PERFILE mode); the multi-threaded cloud
-    reader variant lives in io/parquet.py and slots in here."""
+class _PooledScanExec(TpuExec):
+    """Shared scan body: host decode on the reader thread pool, device
+    upload under the semaphore.
+
+    While the task waits for the next decoded Arrow chunk it RELEASES the
+    TPU semaphore (the engine acquires one count per task) so another
+    task's device work can proceed — the reference's discipline of
+    acquiring only at device entry (GpuSemaphore.scala:240,
+    MultiFileCloudParquetPartitionReader).  Decode of chunk N+1 overlaps
+    the consumer's device compute on chunk N via the prefetch queue.
+    """
+
+    def _host_iter(self, idx: int):
+        raise NotImplementedError
+
+    def _scan_batches(self, idx: int,
+                      reader_threads: int) -> Iterator[ColumnarBatch]:
+        import queue as _q
+
+        from spark_rapids_tpu.columnar.arrow import arrow_to_batch
+        from spark_rapids_tpu.io.reader_pool import prefetched
+        from spark_rapids_tpu.memory.semaphore import tpu_semaphore
+        from spark_rapids_tpu.utils.tracing import trace_range
+
+        sem = tpu_semaphore()
+        it = prefetched(lambda: self._host_iter(idx), reader_threads)
+        while True:
+            # wait for decode OFF the semaphore
+            sem.release_if_necessary()
+            try:
+                with trace_range("scan.wait",
+                                 "task waiting for a decoded chunk "
+                                 "(semaphore released)"):
+                    table = next(it)
+            except StopIteration:
+                sem.acquire_if_necessary()   # restore the engine's count
+                return
+            sem.acquire_if_necessary()
+            with timed(self.op_time), \
+                    trace_range("scan.upload",
+                                "Arrow host chunk -> HBM batch upload "
+                                "(semaphore held)"):
+                batch = arrow_to_batch(table)
+            self.output_rows.add(batch.num_rows)
+            yield self._count_out(batch)
+
+
+class TpuParquetScanExec(_PooledScanExec):
+    """One partition per file; host decode runs MULTITHREADED-style on the
+    shared reader pool (GpuParquetScan.scala:3134 analog)."""
 
     def __init__(self, paths: Sequence[str], schema: Schema,
-                 column_pruning=None, batch_size_rows: int = 1 << 20):
+                 column_pruning=None, batch_size_rows: int = 1 << 20,
+                 reader_threads: int = 8):
         super().__init__((), schema)
         self.paths = list(paths)
         self.column_pruning = column_pruning
         self.batch_size_rows = batch_size_rows
+        self.reader_threads = reader_threads
 
     def num_partitions(self) -> int:
         return max(len(self.paths), 1)
 
+    def _host_iter(self, idx: int):
+        from spark_rapids_tpu.io.parquet import iter_parquet_arrow
+        return iter_parquet_arrow(
+            self.paths[idx],
+            columns=list(self.column_pruning) if self.column_pruning else None,
+            batch_size_rows=self.batch_size_rows)
+
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         if idx >= len(self.paths):
             return
-        from spark_rapids_tpu.io.parquet import read_parquet_batches
-        with timed(self.op_time):
-            for batch in read_parquet_batches(
-                    self.paths[idx],
-                    columns=list(self.column_pruning) if self.column_pruning else None,
-                    batch_size_rows=self.batch_size_rows):
-                self.output_rows.add(batch.num_rows)
-                yield self._count_out(batch)
+        yield from self._scan_batches(idx, self.reader_threads)
 
     def describe(self):
         return f"TpuParquetScan[{len(self.paths)} files]"
 
 
-class TpuFileScanExec(TpuExec):
+class TpuFileScanExec(_PooledScanExec):
     """csv/json/orc scan: one partition per file, host-native Arrow decode
-    feeding device upload (GpuCSVScan/GpuOrcScan/GpuJsonReadCommon analog)."""
+    on the reader pool feeding device upload (GpuCSVScan/GpuOrcScan/
+    GpuJsonReadCommon analog)."""
 
     def __init__(self, paths: Sequence[str], fmt: str, schema: Schema,
                  column_pruning=None, options=None,
-                 batch_size_rows: int = 1 << 20):
+                 batch_size_rows: int = 1 << 20, reader_threads: int = 8):
         super().__init__((), schema)
         self.paths = list(paths)
         self.fmt = fmt
         self.column_pruning = column_pruning
         self.options = dict(options or {})
         self.batch_size_rows = batch_size_rows
+        self.reader_threads = reader_threads
 
     def num_partitions(self) -> int:
         return max(len(self.paths), 1)
 
+    def _host_iter(self, idx: int):
+        from spark_rapids_tpu.io import formats as F
+        return F.iter_arrow(
+            self.paths[idx], self.fmt,
+            columns=self.column_pruning, schema=self.schema,
+            batch_size_rows=self.batch_size_rows, **self.options)
+
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         if idx >= len(self.paths):
             return
-        from spark_rapids_tpu.io import formats as F
-        with timed(self.op_time):
-            for batch in F.read_batches(
-                    self.paths[idx], self.fmt,
-                    columns=self.column_pruning, schema=self.schema,
-                    batch_size_rows=self.batch_size_rows, **self.options):
-                self.output_rows.add(batch.num_rows)
-                yield self._count_out(batch)
+        yield from self._scan_batches(idx, self.reader_threads)
 
     def describe(self):
         return f"TpuFileScan[{self.fmt}, {len(self.paths)} files]"

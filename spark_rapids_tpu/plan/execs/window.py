@@ -131,8 +131,60 @@ class _WindowDeviceSpec:
             return DeviceColumn(jnp.where(ok, sv, jnp.zeros((), sv.dtype)),
                                 ok, out_dt)
 
+        def bounded(lower, upper):
+            """Any [lower, upper]-position frame: sum/count via prefix
+            sums, min/max via the sparse-table kernel."""
+            if isinstance(fn, (Min, Max)):
+                is_min = isinstance(fn, Min)
+                v_in = vals
+                nonnan_valid = valid
+                if jnp.issubdtype(vals.dtype, jnp.floating):
+                    isnan = jnp.isnan(vals)
+                    nonnan_valid = valid & ~isnan
+                    if is_min:
+                        # Spark: NaN is the LARGEST value — min ignores it
+                        # unless the frame is all-NaN
+                        v_in = jnp.where(isnan, jnp.inf, vals)
+                v, _ = WK.bounded_min_max(v_in, valid if not is_min
+                                          else nonnan_valid,
+                                          layout, lower, upper, is_min)
+                _, n = WK.bounded_sum_count(vals, valid, layout, lower,
+                                            upper, sum_dt)
+                ok = (n > 0) & layout.live
+                if jnp.issubdtype(vals.dtype, jnp.floating) and is_min:
+                    _, n_nonnan = WK.bounded_sum_count(
+                        vals, nonnan_valid, layout, lower, upper, sum_dt)
+                    v = jnp.where((n > 0) & (n_nonnan == 0),
+                                  jnp.asarray(jnp.nan, v.dtype), v)
+                if jnp.issubdtype(vals.dtype, jnp.floating) and not is_min:
+                    # any NaN in frame -> NaN: maximum() propagates only
+                    # when NaN is scanned; the sparse table uses maximum
+                    # so propagation already holds
+                    pass
+                v = v.astype(out_dt.jnp_dtype)
+                return DeviceColumn(
+                    jnp.where(ok, v, jnp.zeros((), v.dtype)), ok, out_dt)
+            s, n = WK.bounded_sum_count(vals, valid, layout, lower, upper,
+                                        sum_dt)
+            return from_sum_count(s, n)
+
         sum_dt = (jnp.float64 if out_dt.is_floating or isinstance(fn, Average)
                   else jnp.int64)
+        if frame.kind == "range" and not (
+                frame.is_unbounded_both()
+                or frame.is_unbounded_to_current()):
+            # bounded RANGE frame over the single numeric order key
+            # (planner guarantees one ascending fixed-width key)
+            okey = we.spec.order_by[0][0].eval(sctx)
+            lower, upper = WK.frame_bounds_range(
+                okey.data, layout,
+                None if frame.start is None else -frame.start, frame.end)
+            return bounded(lower, upper)
+        if frame.kind == "rows" and isinstance(fn, (Min, Max)):
+            lower, upper = WK.frame_bounds_rows(
+                layout,
+                None if frame.start is None else -frame.start, frame.end)
+            return bounded(lower, upper)
         if frame.is_unbounded_both():
             if isinstance(fn, (Min, Max)):
                 op = "min" if isinstance(fn, Min) else "max"
@@ -174,10 +226,11 @@ class _WindowDeviceSpec:
 
 class TpuWindowExec(TpuExec):
     def __init__(self, window_exprs: Sequence[Expression], child: TpuExec,
-                 schema: Schema):
+                 schema: Schema, target_rows: int = 1 << 20):
         super().__init__((child,), schema)
         self.window_exprs = tuple(window_exprs)
         self.spec = _unwrap(self.window_exprs[0]).spec
+        self.target_rows = max(int(target_rows), 1)
         dspec = _WindowDeviceSpec(self.window_exprs, self.spec, schema)
         from functools import partial as _p
         from spark_rapids_tpu.plan.execs.base import (
@@ -190,13 +243,63 @@ class TpuWindowExec(TpuExec):
             lambda: _p(dspec._step, string_bucket=bkt))(b)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
-        merged = coalesce_to_one(list(self.children[0].execute_partition(idx)))
-        if merged is None:
+        batches = list(self.children[0].execute_partition(idx))
+        if not batches:
             return
+        total = sum(b.capacity for b in batches)
+        if total > self.target_rows and self._partition_ordinals() is not None:
+            yield from self._execute_out_of_core(batches, total)
+            return
+        merged = coalesce_to_one(batches)
         with timed(self.op_time):
             out = with_retry_no_split(lambda: self._run(merged))
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
+
+    def _partition_ordinals(self):
+        """Column ordinals of the PARTITION BY keys, or None if any key is
+        not a plain reference (then the key-batched path can't route)."""
+        from spark_rapids_tpu.expressions.core import Alias, BoundReference
+        if not self.spec.partition_by:
+            return None
+        out = []
+        for e in self.spec.partition_by:
+            while isinstance(e, Alias):
+                e = e.child
+            if not isinstance(e, BoundReference):
+                return None
+            out.append(e.ordinal)
+        return out
+
+    def _execute_out_of_core(self, batches, total) -> Iterator[ColumnarBatch]:
+        """Key-batched windows (GpuKeyBatchingIterator.scala:37 analog):
+        hash-repartition the input on the PARTITION BY keys into spillable
+        key-disjoint buckets and window each bucket independently — frames
+        never cross partition values, so the union of bucket outputs is
+        exactly the single-batch answer."""
+        from spark_rapids_tpu.plan.execs.out_of_core import (
+            close_all, num_sub_buckets, sub_partition_spillable)
+        n_b = num_sub_buckets(total, self.target_rows)
+        with timed(self.op_time):
+            buckets = sub_partition_spillable(
+                iter(batches), self._partition_ordinals(), n_b,
+                self.children[0].schema)
+            del batches
+        try:
+            for q in buckets:
+                if not q:
+                    continue
+                with timed(self.op_time):
+                    merged = coalesce_to_one([h.materialize() for h in q])
+                    out = with_retry_no_split(lambda: self._run(merged))
+                    for h in q:
+                        h.unpin()
+                        h.close()
+                    q.clear()
+                self.output_rows.add(out.num_rows)
+                yield self._count_out(out)
+        finally:
+            close_all(buckets)
 
     def describe(self):
         return f"TpuWindow[{', '.join(map(repr, self.window_exprs))}]"

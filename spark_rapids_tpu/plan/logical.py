@@ -280,11 +280,15 @@ class MapBatches(LogicalPlan):
     Python world through Arrow, the declared schema is the contract back.
     """
 
-    def __init__(self, fn, schema: Schema, child: LogicalPlan):
+    def __init__(self, fn, schema: Schema, child: LogicalPlan,
+                 whole_partition: bool = False):
         self.fn = fn
         self._schema = schema
         self.child = child
         self.children = (child,)
+        # grouped-map (applyInPandas) needs every row of a key in ONE fn
+        # call: the exec concatenates the partition's batches first
+        self.whole_partition = whole_partition
 
     @property
     def schema(self):

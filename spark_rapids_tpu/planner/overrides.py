@@ -72,6 +72,15 @@ _NON_GROWING_STRING_EXPRS = {
 
 
 def _regex_child_ok(e) -> bool:
+    """Only STRING-typed subtrees feed bytes into a regex/byte-window
+    kernel, so only they must be non-growing; non-string children (an If
+    predicate, a substring position) are unconstrained."""
+    try:
+        dt = e.dtype
+    except (TypeError, ValueError, NotImplementedError):
+        return False
+    if not getattr(dt, "variable_width", False):
+        return True
     if type(e) not in _NON_GROWING_STRING_EXPRS:
         return False
     return all(_regex_child_ok(c) for c in e.children)
@@ -87,9 +96,32 @@ from spark_rapids_tpu.expressions import datetime as DT
 _SUPPORTED_EXPRS |= {
     M.Sqrt, M.Cbrt, M.Exp, M.Sin, M.Cos, M.Tan, M.Atan, M.Signum,
     M.Log, M.Log10, M.Pow, M.Floor, M.Ceil, M.Round, M.IsNaN, M.NanVl,
+    M.Asin, M.Acos, M.Sinh, M.Cosh, M.Tanh, M.Asinh, M.Acosh, M.Atanh,
+    M.Log2, M.Log1p, M.Expm1, M.Rint, M.Degrees, M.Radians, M.Cot,
+    M.Sec, M.Csc, M.Atan2, M.Hypot, M.Pmod, M.Factorial, M.LogBase,
     DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.DayOfYear,
     DT.Quarter, DT.Hour, DT.Minute, DT.Second, DT.DateAdd, DT.DateSub,
     DT.DateDiff, DT.AddMonths, DT.LastDay,
+    DT.WeekOfYear, DT.MakeDate, DT.TruncDate, DT.NextDay, DT.MonthsBetween,
+    DT.UnixSeconds, DT.UnixMillis, DT.UnixMicros, DT.SecondsToTimestamp,
+    DT.MillisToTimestamp, DT.MicrosToTimestamp, DT.UnixDate,
+    DT.DateFromUnixDate,
+}
+
+from spark_rapids_tpu.expressions.bitwise import (
+    BitwiseAnd, BitwiseNot, BitwiseOr, BitwiseXor, ShiftLeft, ShiftRight,
+    ShiftRightUnsigned)
+from spark_rapids_tpu.expressions.conditional import (
+    Greatest, Least, NullIf, Nvl2)
+from spark_rapids_tpu.expressions.strings import (
+    BitLength, Concat, Empty2Null, Left, OctetLength, Right, Translate)
+
+_SUPPORTED_EXPRS |= {
+    BitwiseAnd, BitwiseOr, BitwiseXor, BitwiseNot, ShiftLeft, ShiftRight,
+    ShiftRightUnsigned,
+    NullIf, Nvl2, Greatest, Least,
+    Left, Right, OctetLength, BitLength, Translate, Empty2Null, Concat,
+    A.BoolAnd, A.BoolOr,
 }
 
 # dtypes device kernels support in expression compute
@@ -129,15 +161,77 @@ def _key_expr_ok(e: "E.Expression") -> bool:
 
 
 class ExprMeta:
-    """BaseExprMeta analog: tags one expression node."""
+    """BaseExprMeta analog: tags one expression node.
 
-    def __init__(self, expr: E.Expression):
+    ``allow_bridge``: in project/filter positions an unsupported subtree
+    may run through the expression-level CPU bridge instead of failing the
+    whole node (GpuCpuBridgeExpression.scala analog, gated by
+    spark.rapids.sql.expression.cpuBridge.enabled).
+    """
+
+    def __init__(self, expr: E.Expression, conf: Optional[RapidsConf] = None,
+                 allow_bridge: bool = False):
         self.expr = expr
-        self.children = [ExprMeta(c) for c in expr.children]
+        self.conf = conf
+        self.allow_bridge = allow_bridge
+        self.children = [ExprMeta(c, conf, allow_bridge)
+                         for c in expr.children]
         self.reasons: List[str] = []
+        self.bridged = False
 
     def will_not_work(self, reason: str) -> None:
         self.reasons.append(reason)
+
+    def _bridgeable(self) -> bool:
+        if not (self.allow_bridge and self.conf is not None
+                and self.conf.cpu_bridge_enabled):
+            return False
+        # every node of the subtree must be host-evaluable (e.g. a regex
+        # pattern must compile under the CPU oracle's engine)
+        def host_ok(e) -> bool:
+            ce = getattr(e, "cpu_evaluable", None)
+            if ce is not None and not ce():
+                return False
+            return all(host_ok(c) for c in e.children)
+        if not host_ok(self.expr):
+            return False
+        from spark_rapids_tpu.expressions.aggregates import find_aggregates
+        from spark_rapids_tpu.expressions.window import WindowExpression
+
+        def structural(e) -> bool:
+            if isinstance(e, WindowExpression):
+                return True
+            return any(structural(c) for c in e.children)
+        if find_aggregates(self.expr) or structural(self.expr):
+            return False
+        try:
+            return _dtype_ok(self.expr.dtype)
+        except (TypeError, ValueError, NotImplementedError):
+            return False
+
+    def resolve_bridges(self) -> bool:
+        """Bottom-up: bridge the smallest failing subtrees; returns whether
+        this subtree can run (natively or via bridge)."""
+        children_ok = all(c.resolve_bridges() for c in self.children)
+        if not self.reasons and children_ok:
+            return True
+        if self._bridgeable():
+            self.bridged = True
+            return True
+        return False
+
+    def transformed(self) -> E.Expression:
+        """The expression with bridge wrappers applied."""
+        if self.bridged:
+            from spark_rapids_tpu.expressions.bridge import (
+                CpuBridgeExpression)
+            return CpuBridgeExpression(self.expr)
+        if not self.children:
+            return self.expr
+        new_children = tuple(c.transformed() for c in self.children)
+        if all(n is o for n, o in zip(new_children, self.expr.children)):
+            return self.expr
+        return self.expr.with_children(new_children)
 
     def tag(self) -> None:
         e = self.expr
@@ -163,6 +257,14 @@ class ExprMeta:
                     not isinstance(e.right, E.Literal):
                 self.will_not_work(
                     "non-literal match patterns are not supported yet")
+            if isinstance(e, (NullIf, Greatest, Least)):
+                try:
+                    if e.children[0].dtype.variable_width:
+                        self.will_not_work(
+                            f"{type(e).__name__} over strings needs the "
+                            "byte-comparator kernel (CPU bridge covers it)")
+                except (TypeError, ValueError, NotImplementedError):
+                    pass
             if isinstance(e, ConcatWs):
                 for c in e.children:
                     try:
@@ -204,10 +306,18 @@ class ExprMeta:
 
     @property
     def can_run(self) -> bool:
+        if self.bridged:
+            return True
         return not self.reasons and all(c.can_run for c in self.children)
 
     def explain_lines(self, prefix: str = "") -> List[str]:
         out = []
+        if self.bridged:
+            why = "; ".join(self.reasons + [r for c in self.children
+                                            for r in c.reasons])
+            out.append(f"{prefix}*Expression {self.expr!r} will run via "
+                       f"the CPU bridge ({why})")
+            return out
         for r in self.reasons:
             out.append(f"{prefix}!Expression {self.expr!r} cannot run on TPU "
                        f"because {r}")
@@ -224,8 +334,9 @@ class PlanMeta:
         self.conf = conf
         self.children = [PlanMeta(c, conf) for c in plan.children]
         self.reasons: List[str] = []
+        allow_bridge = isinstance(plan, (L.Project, L.Filter))
         self.expr_metas: List[ExprMeta] = [
-            ExprMeta(e) for e in self._expressions()]
+            ExprMeta(e, conf, allow_bridge) for e in self._expressions()]
 
     def _expressions(self) -> List[E.Expression]:
         p = self.plan
@@ -255,6 +366,7 @@ class PlanMeta:
         p = self.plan
         for em in self.expr_metas:
             em.tag()
+            em.resolve_bridges()
         if not isinstance(p, (L.Project, L.Filter)):
             # regex/DFA expressions need the string bucket threading that
             # only the project/filter execs implement
@@ -297,6 +409,21 @@ class PlanMeta:
                 for sub in _non_agg_leaf_refs(e):
                     self.will_not_work(
                         f"non-aggregate column {sub!r} in aggregate output")
+            if not self.conf.variable_float_agg_enabled:
+                from spark_rapids_tpu.expressions.aggregates import (
+                    find_aggregates)
+                for e in p.agg_exprs:
+                    for agg in find_aggregates(e):
+                        try:
+                            fl = (agg.input is not None
+                                  and agg.input.dtype.is_floating)
+                        except (TypeError, ValueError, NotImplementedError):
+                            fl = False
+                        if fl and isinstance(agg, (A.Sum, A.Average)):
+                            self.will_not_work(
+                                f"{agg!r} over floats disabled: device "
+                                "two-phase ordering varies (spark.rapids."
+                                "sql.variableFloatAgg.enabled=false)")
         if isinstance(p, L.Sort):
             for e, _ in p.orders:
                 if not _key_expr_ok(e):
@@ -344,20 +471,26 @@ class PlanMeta:
         if isinstance(p, L.InMemoryRelation):
             return TpuInMemoryScanExec(p.partitions, p.schema)
         if isinstance(p, L.ParquetRelation):
-            return TpuParquetScanExec(p.paths, p.schema, p.column_pruning,
-                                      self.conf.batch_size_rows)
+            return TpuParquetScanExec(
+                p.paths, p.schema, p.column_pruning,
+                self.conf.batch_size_rows,
+                reader_threads=self.conf.multithreaded_read_threads)
         if isinstance(p, L.FileRelation):
             from spark_rapids_tpu.plan.execs.scan import TpuFileScanExec
-            return TpuFileScanExec(p.paths, p.fmt, p.schema, p.column_pruning,
-                                   p.options, self.conf.batch_size_rows)
+            return TpuFileScanExec(
+                p.paths, p.fmt, p.schema, p.column_pruning, p.options,
+                self.conf.batch_size_rows,
+                reader_threads=self.conf.multithreaded_read_threads)
         if isinstance(p, L.DeltaRelation):
             from spark_rapids_tpu.io.delta_scan import TpuDeltaScanExec
             return TpuDeltaScanExec(p.table_path, p.snapshot, p.schema)
         if isinstance(p, L.Project):
             child = self.children[0].convert()
-            return TpuProjectExec(p.exprs, child, p.schema)
+            exprs = [em.transformed() for em in self.expr_metas]
+            return TpuProjectExec(exprs, child, p.schema)
         if isinstance(p, L.Filter):
-            return TpuFilterExec(p.condition, self.children[0].convert())
+            cond = self.expr_metas[0].transformed()
+            return TpuFilterExec(cond, self.children[0].convert())
         if isinstance(p, L.Union):
             return TpuUnionExec(tuple(c.convert() for c in self.children),
                                 p.schema)
@@ -387,7 +520,8 @@ class PlanMeta:
             from spark_rapids_tpu.plan.execs.python_exec import (
                 TpuMapBatchesExec)
             return TpuMapBatchesExec(p.fn, self.children[0].convert(),
-                                     p.schema)
+                                     p.schema,
+                                     whole_partition=p.whole_partition)
         return self._fallback()
 
     def _tag_window(self, p: "L.Window") -> None:
@@ -417,20 +551,27 @@ class PlanMeta:
             frame = inner.spec.frame
             if isinstance(fn, (RowNumber, Rank, DenseRank, Lead, Lag)):
                 continue
-            if isinstance(fn, (Sum, Count, Average)):
+            if isinstance(fn, (Sum, Count, Average, Min, Max)):
                 if frame.kind == "range" and not (
                         frame.is_unbounded_to_current()
                         or frame.is_unbounded_both()):
-                    self.will_not_work(
-                        f"range frame {frame} not supported for {fn!r}")
-                continue
-            if isinstance(fn, (Min, Max)):
-                if not (frame.is_unbounded_both()
-                        or (frame.kind == "range"
-                            and frame.is_unbounded_to_current())):
-                    self.will_not_work(
-                        f"bounded frames for {fn!r} need the sliding "
-                        "min/max kernel (follow-on)")
+                    # bounded RANGE: binary search over the single order
+                    # value (kernels/window.py frame_bounds_range) — needs
+                    # one ascending fixed-width non-float key
+                    ob = inner.spec.order_by
+                    ok = (len(ob) == 1 and ob[0][1].ascending)
+                    if ok:
+                        try:
+                            dt = ob[0][0].dtype
+                            ok = (not dt.variable_width
+                                  and not dt.is_floating)
+                        except (TypeError, ValueError,
+                                NotImplementedError):
+                            ok = False
+                    if not ok:
+                        self.will_not_work(
+                            f"bounded range frame {frame} needs a single "
+                            "ascending fixed-width non-float order key")
                 continue
             self.will_not_work(f"window function {fn!r} not supported")
 
@@ -443,7 +584,8 @@ class PlanMeta:
                                        p.spec.partition_by, child)
             else:
                 child = TpuSinglePartitionExec(child)
-        return TpuWindowExec(p.window_exprs, child, p.schema)
+        return TpuWindowExec(p.window_exprs, child, p.schema,
+                             target_rows=self.conf.batch_size_rows)
 
     def _convert_join(self, p: L.Join) -> TpuExec:
         from spark_rapids_tpu.plan.execs.basic import TpuFilterExec
@@ -457,11 +599,28 @@ class PlanMeta:
         # build-side constraint, GpuBroadcastHashJoinExecBase)
         broadcastable = p.join_type in ("inner", "left", "left_semi",
                                         "left_anti", "cross")
-        if (broadcastable
-                and _estimate_rows(p.right) <= self.conf.broadcast_row_threshold
-                and left.num_partitions() > 1):
+        est = _estimate_rows(p.right)
+        thr = self.conf.broadcast_row_threshold
+        if broadcastable and left.num_partitions() > 1 and est <= thr:
             join: TpuExec = TpuBroadcastHashJoinExec(
                 left, right, p.left_keys, p.right_keys, p.join_type, p.schema,
+                target_rows=self.conf.batch_size_rows)
+            if p.condition is not None:
+                join = TpuFilterExec(p.condition, join)
+            return join
+        if (broadcastable and left.num_partitions() > 1
+                and p.join_type != "cross" and est <= thr * 8):
+            # ambiguous zone: the static estimate can't be trusted either
+            # way — defer the broadcast-vs-shuffled choice to runtime,
+            # decided from the MATERIALIZED build-side row count
+            # (GpuShuffledSizedHashJoinExec.scala:829 / AQE analog)
+            from spark_rapids_tpu.plan.execs.join import TpuAdaptiveJoinExec
+            join = TpuAdaptiveJoinExec(
+                left, right, p.left_keys, p.right_keys, p.join_type,
+                p.schema, broadcast_threshold=thr,
+                shuffle_partitions=nparts,
+                writer_threads=self.conf.shuffle_writer_threads,
+                codec=self.conf.shuffle_codec,
                 target_rows=self.conf.batch_size_rows)
             if p.condition is not None:
                 join = TpuFilterExec(p.condition, join)
@@ -573,6 +732,10 @@ def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
     meta = PlanMeta(plan, conf)
     meta.tag()
     exec_plan = meta.convert()
+    # LORE id assignment + dump wrapping (GpuLore.tagForLore analog,
+    # GpuOverrides.scala:5149)
+    from spark_rapids_tpu.plan.execs.lore import apply_lore
+    exec_plan = apply_lore(exec_plan, conf)
     return exec_plan, meta
 
 

@@ -30,6 +30,7 @@ from spark_rapids_tpu.regex.parser import (
     Concat,
     Dot,
     Empty,
+    Grouped,
     Node,
     Pattern,
     RegexUnsupported,
@@ -117,6 +118,8 @@ def _emit(nfa: _Nfa, node: Node) -> Tuple[int, int]:
                 nfa.add_range(cur, lo, hi, nxt)
                 cur = nxt
         return start, end
+    if isinstance(node, Grouped):
+        return _emit(nfa, node.child)
     if isinstance(node, Concat):
         start, end = None, None
         for part in node.parts:

@@ -65,6 +65,13 @@ class Dot(Node):
 
 
 @dataclass
+class Grouped(Node):
+    """(...) / (?:...): transparent for matching, but marks that an inner
+    alternation is NOT top-level (anchor binding)."""
+    child: Node = None
+
+
+@dataclass
 class Concat(Node):
     parts: List[Node] = field(default_factory=list)
 
@@ -94,9 +101,10 @@ _PREDEF = {
     "s": [(0x09, 0x0D), (0x20, 0x20)],
 }
 
+# NOTE: no "0" entry — Java treats \0n as an OCTAL escape, which the
+# dialect rejects (the alphanumeric-escape check catches it)
 _ESCAPE_LITERALS = {
     "n": 0x0A, "r": 0x0D, "t": 0x09, "f": 0x0C, "a": 0x07, "e": 0x1B,
-    "0": 0x00,
 }
 
 _MAX_REPEAT = 64   # {m,n} expansion budget (DFA size guard)
@@ -149,6 +157,13 @@ class _Parser:
             self.i += 1
         if self.i != len(self.p):
             self.error(f"unparsed tail {self.p[self.i:]!r}")
+        if (anchored_start or anchored_end) and isinstance(body, Alt):
+            # Java binds ^/$ to only the first/last ALTERNATIVE of a bare
+            # top-level alternation; anchoring the whole Alt would be a
+            # wrong answer, so reject (grouped "(a|b)$" parses as Grouped
+            # and stays supported)
+            raise RegexUnsupported(
+                f"anchor with top-level alternation in {self.p!r}")
         return Pattern(body, anchored_start, anchored_end)
 
     def alt(self) -> Node:
@@ -172,24 +187,27 @@ class _Parser:
 
     def quantified(self) -> Node:
         atom = self.atom()
-        while True:
-            c = self.peek()
-            if c == "*":
-                self.next()
-                atom = Repeat(atom, 0, None)
-            elif c == "+":
-                self.next()
-                atom = Repeat(atom, 1, None)
-            elif c == "?":
-                self.next()
-                atom = Repeat(atom, 0, 1)
-            elif c == "{":
-                atom = Repeat(atom, *self.braces())
-            else:
-                return atom
-            nxt = self.peek()
-            if nxt in ("?", "+") and isinstance(atom, Repeat):
-                self.error("lazy/possessive quantifiers unsupported")
+        c = self.peek()
+        if c == "*":
+            self.next()
+            atom = Repeat(atom, 0, None)
+        elif c == "+":
+            self.next()
+            atom = Repeat(atom, 1, None)
+        elif c == "?":
+            self.next()
+            atom = Repeat(atom, 0, 1)
+        elif c == "{":
+            atom = Repeat(atom, *self.braces())
+        else:
+            return atom
+        nxt = self.peek()
+        if nxt in ("?", "+"):
+            self.error("lazy/possessive quantifiers unsupported")
+        if nxt in ("*", "{"):
+            # Java rejects stacked quantifiers (a**, a{2}{3})
+            self.error("stacked quantifiers")
+        return atom
 
     def braces(self) -> Tuple[int, Optional[int]]:
         assert self.next() == "{"
@@ -223,7 +241,7 @@ class _Parser:
             inner = self.alt()
             if not self.eat(")"):
                 self.error("unclosed group")
-            return inner
+            return Grouped(inner)
         if c == "[":
             return self.char_class()
         if c == ".":
@@ -282,7 +300,11 @@ class _Parser:
             c = self.peek()
             if c is None:
                 self.error("unclosed character class")
-            if c == "]" and not first:
+            if c == "]":
+                if first:
+                    # Java rejects []...] (']' is NOT a literal first
+                    # member, unlike POSIX)
+                    self.error("empty character class")
                 self.next()
                 break
             first = False

@@ -1,0 +1,112 @@
+"""Adaptive join: runtime broadcast-vs-shuffled choice from the
+materialized build-side size (GpuShuffledSizedHashJoinExec.scala:829 /
+AQE analog).  The key test: the static estimate is WRONG and the runtime
+choice fixes it."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.plan.execs.join import TpuAdaptiveJoinExec
+from spark_rapids_tpu.planner.overrides import plan_query
+
+from test_queries import assert_tpu_cpu_equal
+
+SCHEMA = Schema.of(k=T.INT, v=T.LONG)
+
+
+def _df(sess, n, seed, parts=3):
+    rng = np.random.RandomState(seed)
+    return sess.create_dataframe(
+        [ColumnarBatch.from_pydict(
+            {"k": rng.randint(0, 50, n).tolist(),
+             "v": rng.randint(0, 10**6, n).tolist()}, SCHEMA)],
+        num_partitions=parts)
+
+
+def _adaptive_of(plan):
+    """Find the adaptive exec in a physical tree."""
+    if isinstance(plan, TpuAdaptiveJoinExec):
+        return plan
+    for c in plan.children:
+        found = _adaptive_of(c)
+        if found is not None:
+            return found
+    return None
+
+
+def _build(sess, n_right, filtered=True):
+    left = _df(sess, 400, seed=1)
+    right = _df(sess, n_right, seed=2, parts=1)
+    r = right.select(col("k").alias("rk"), col("v").alias("rv"))
+    if filtered:
+        # the filter makes the static estimate (rows // 2) WRONG in both
+        # directions: a selective filter keeps ~2% (estimate 8x too big),
+        # a pass-through filter keeps ~100% (estimate 2x too small)
+        r = r.filter(col("rv") >= lit(0))
+    return left.join(r, on=([col("k")], [col("rk")]), how="inner")
+
+
+def test_static_estimate_wrong_runtime_broadcasts():
+    """Estimate says 'too big to broadcast' (ambiguous zone); the actual
+    build side is tiny after a selective filter -> runtime broadcasts."""
+    sess = TpuSession({"spark.rapids.sql.enabled": "true",
+                       "spark.rapids.sql.join.broadcastRowThreshold": "64"})
+    left = _df(sess, 400, seed=1)
+    right = _df(sess, 300, seed=2, parts=1)      # estimate 300//2=150 > 64
+    r = (right.select(col("k").alias("rk"), col("v").alias("rv"))
+         .filter(col("rv") < lit(20_000)))       # actually keeps ~2% -> ~6
+    df = left.join(r, on=([col("k")], [col("rk")]), how="inner")
+    plan, _ = plan_query(df.plan, sess.conf)
+    ad = _adaptive_of(plan)
+    assert ad is not None, plan.tree_string()
+    rows = df.collect()
+    plan2, _ = plan_query(df.plan, sess.conf)
+    ad2 = _adaptive_of(plan2)
+    ad2.num_partitions()   # forces the decision
+    assert ad2.chosen == "broadcast", ad2.describe()
+    ad2.cleanup()
+
+
+def test_static_estimate_wrong_runtime_shuffles():
+    """Estimate says 'small enough' is impossible here: estimate is 150
+    (ambiguous), actual is 300 (> threshold) -> runtime shuffles."""
+    sess = TpuSession({"spark.rapids.sql.enabled": "true",
+                       "spark.rapids.sql.join.broadcastRowThreshold": "64"})
+    left = _df(sess, 400, seed=1)
+    right = _df(sess, 300, seed=2, parts=1)
+    r = (right.select(col("k").alias("rk"), col("v").alias("rv"))
+         .filter(col("rv") >= lit(0)))           # keeps everything: 300
+    df = left.join(r, on=([col("k")], [col("rk")]), how="inner")
+    plan, _ = plan_query(df.plan, sess.conf)
+    ad = _adaptive_of(plan)
+    assert ad is not None, plan.tree_string()
+    ad.num_partitions()
+    assert ad.chosen == "shuffled", ad.describe()
+    ad.cleanup()
+
+
+@pytest.mark.parametrize("n_right", [40, 2000])
+def test_adaptive_join_differential(n_right):
+    """Both runtime outcomes produce oracle-identical results."""
+    def build(s):
+        # TPU session uses a threshold landing n_right in the ambiguous
+        # zone; the CPU oracle ignores the rapids keys entirely
+        return _build(s, n_right)
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    tpu = TpuSession({"spark.rapids.sql.enabled": "true",
+                      "spark.rapids.sql.join.broadcastRowThreshold": "256"})
+    from test_queries import _normalize
+    assert _normalize(build(tpu).collect()) == _normalize(build(cpu).collect())
+
+
+@pytest.mark.inject_oom
+def test_adaptive_join_inject_oom():
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    tpu = TpuSession({"spark.rapids.sql.enabled": "true",
+                      "spark.rapids.sql.join.broadcastRowThreshold": "256"})
+    from test_queries import _normalize
+    assert _normalize(_build(tpu, 500).collect()) == \
+        _normalize(_build(cpu, 500).collect())

@@ -122,11 +122,20 @@ def test_cast_roundtrip_filter():
         .select(col("s"), Cast(col("s"), T.LONG).alias("v")))
 
 
-def test_float_to_string_falls_back():
+def test_float_to_string_off_device():
+    # float->string formatting is not a device cast (Java Double.toString
+    # differences); it runs via the CPU bridge, or falls back whole-node
+    # when the bridge is disabled
     s = TpuSession({"spark.rapids.sql.enabled": "true"})
     df = _num_source(s, [1.5, 2.5], T.DOUBLE).select(
         Cast(col("v"), T.STRING).alias("s"))
-    assert "will NOT" in df.explain()
+    assert "CPU bridge" in df.explain()
     assert_tpu_cpu_equal(
         lambda sess: _num_source(sess, [1.5, None, -2.0], T.DOUBLE).select(
             Cast(col("v"), T.STRING).alias("s")))
+    s2 = TpuSession({"spark.rapids.sql.enabled": "true",
+                     "spark.rapids.sql.expression.cpuBridge.enabled":
+                         "false"})
+    df2 = _num_source(s2, [1.5, 2.5], T.DOUBLE).select(
+        Cast(col("v"), T.STRING).alias("s"))
+    assert "will NOT" in df2.explain()

@@ -1,0 +1,155 @@
+"""Differential tests for the round-2 expression expansion: extended math,
+bitwise, null/extremum conditionals, datetime extensions, string length/
+slice family, and the host-only get_json_object via the CPU bridge."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions import math as M
+from spark_rapids_tpu.expressions import datetime as DT
+from spark_rapids_tpu.expressions.bitwise import (
+    BitwiseAnd, BitwiseNot, BitwiseOr, BitwiseXor, ShiftLeft, ShiftRight,
+    ShiftRightUnsigned)
+from spark_rapids_tpu.expressions.conditional import (
+    Greatest, Least, NullIf, Nvl2)
+from spark_rapids_tpu.expressions.strings import (
+    BitLength, Concat, Empty2Null, GetJsonObject, Left, OctetLength, Right,
+    Translate)
+
+from test_queries import assert_tpu_cpu_equal
+
+SCHEMA = Schema.of(i=T.INT, l=T.LONG, x=T.DOUBLE, d=T.DATE, ts=T.TIMESTAMP,
+                   s=T.STRING)
+
+
+def src(sess, n=120, seed=5):
+    rng = np.random.RandomState(seed)
+    data = {
+        "i": rng.randint(-100, 100, n).tolist(),
+        "l": rng.randint(-10**12, 10**12, n).tolist(),
+        "x": (rng.randn(n) * 3).tolist(),
+        "d": rng.randint(-3000, 30000, n).tolist(),
+        "ts": (rng.randint(0, 2**40, n) * 1000).tolist(),
+        "s": [f"ab{i%7}c" if i % 5 else "" for i in range(n)],
+    }
+    data["x"][0] = float("nan")
+    data["x"][1] = float("inf")
+    data["i"][2] = 0
+    for cname in data:
+        for idx in rng.choice(n, n // 8, replace=False):
+            data[cname][idx] = None
+    return sess.create_dataframe(
+        [ColumnarBatch.from_pydict(data, SCHEMA)], num_partitions=1)
+
+
+MATH_EXPRS = [
+    M.Asin(col("x")), M.Acos(col("x")), M.Sinh(col("x")), M.Cosh(col("x")),
+    M.Tanh(col("x")), M.Asinh(col("x")), M.Acosh(col("x")),
+    M.Atanh(col("x")), M.Log2(col("x")), M.Log1p(col("x")),
+    M.Expm1(col("x")), M.Rint(col("x")), M.Degrees(col("x")),
+    M.Radians(col("x")), M.Cot(col("x")), M.Sec(col("x")), M.Csc(col("x")),
+    M.Atan2(col("x"), col("i")), M.Hypot(col("x"), col("i")),
+    M.Pmod(col("i"), lit(7)), M.Pmod(col("l"), lit(-13)),
+    M.Pmod(col("x"), lit(2.5)), M.Factorial(col("i")),
+    M.LogBase(lit(3.0), col("x")),
+]
+
+
+@pytest.mark.parametrize("e", MATH_EXPRS, ids=lambda e: repr(e)[:40])
+def test_math_family(e):
+    assert_tpu_cpu_equal(lambda s: src(s).select(e.alias("r")))
+
+
+BITWISE_EXPRS = [
+    BitwiseAnd(col("i"), lit(0x5A)), BitwiseOr(col("l"), lit(1)),
+    BitwiseXor(col("i"), col("i")), BitwiseNot(col("l")),
+    ShiftLeft(col("i"), lit(3)), ShiftLeft(col("l"), lit(65)),
+    ShiftRight(col("i"), lit(2)), ShiftRight(col("l"), lit(7)),
+    ShiftRightUnsigned(col("i"), lit(2)),
+    ShiftRightUnsigned(col("l"), lit(9)),
+]
+
+
+@pytest.mark.parametrize("e", BITWISE_EXPRS, ids=lambda e: repr(e)[:40])
+def test_bitwise_family(e):
+    assert_tpu_cpu_equal(lambda s: src(s).select(e.alias("r")))
+
+
+COND_EXPRS = [
+    NullIf(col("i"), lit(0)), NullIf(col("x"), col("x")),
+    Nvl2(col("i"), col("l"), lit(-1)),
+    Greatest(col("i"), lit(5), BitwiseNot(col("i"))),
+    Least(col("i"), lit(5), BitwiseNot(col("i"))),
+    Greatest(col("x"), lit(0.0)), Least(col("x"), lit(0.0)),
+]
+
+
+@pytest.mark.parametrize("e", COND_EXPRS, ids=lambda e: repr(e)[:40])
+def test_conditional_family(e):
+    assert_tpu_cpu_equal(lambda s: src(s).select(e.alias("r")))
+
+
+DT_EXPRS = [
+    DT.WeekOfYear(col("d")), DT.TruncDate(col("d"), "YEAR"),
+    DT.TruncDate(col("d"), "MONTH"), DT.TruncDate(col("d"), "QUARTER"),
+    DT.TruncDate(col("d"), "WEEK"), DT.NextDay(col("d"), "monday"),
+    DT.NextDay(col("d"), "FRI"),
+    DT.MonthsBetween(col("d"), DT.DateAdd(col("d"), lit(40))),
+    DT.MakeDate(lit(2021), col("i"), col("i")),
+    DT.UnixSeconds(col("ts")), DT.UnixMillis(col("ts")),
+    DT.UnixMicros(col("ts")), DT.SecondsToTimestamp(col("i")),
+    DT.MillisToTimestamp(col("l")), DT.MicrosToTimestamp(col("l")),
+    DT.UnixDate(col("d")), DT.DateFromUnixDate(col("i")),
+]
+
+
+@pytest.mark.parametrize("e", DT_EXPRS, ids=lambda e: repr(e)[:40])
+def test_datetime_family(e):
+    assert_tpu_cpu_equal(lambda s: src(s).select(e.alias("r")))
+
+
+STR_EXPRS = [
+    Left(col("s"), 2), Left(col("s"), 0), Right(col("s"), 3),
+    Right(col("s"), 99), OctetLength(col("s")), BitLength(col("s")),
+    Translate(col("s"), "abc", "XY"), Translate(col("s"), "b", "bb"[:1]),
+    Empty2Null(col("s")),
+    Concat(col("s"), lit("-"), col("s")),
+]
+
+
+@pytest.mark.parametrize("e", STR_EXPRS, ids=lambda e: repr(e)[:40])
+def test_string_family(e):
+    assert_tpu_cpu_equal(lambda s: src(s).select(col("s"), e.alias("r")))
+
+
+def test_bool_and_or_aggs():
+    from spark_rapids_tpu.expressions.aggregates import BoolAnd, BoolOr
+    from spark_rapids_tpu.expressions.predicates import IsNotNull
+    assert_tpu_cpu_equal(
+        lambda s: src(s).group_by(col("i"))
+        .agg(BoolAnd((col("l") > lit(0)).alias("p")).alias("ba"),
+             BoolOr((col("l") > lit(0)).alias("p")).alias("bo")))
+
+
+def test_get_json_object_via_bridge():
+    from spark_rapids_tpu.api.session import TpuSession
+    docs = ['{"a": 1, "b": {"c": "x"}}', '{"a": [10, 20]}', "not json",
+            '{"b": {"c": null}}', None, '{"a": {"deep": [1, {"z": true}]}}']
+    schema = Schema.of(j=T.STRING)
+
+    def jsrc(s):
+        return s.create_dataframe(
+            [ColumnarBatch.from_pydict({"j": docs}, schema)],
+            num_partitions=1)
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = jsrc(s).select(GetJsonObject(col("j"), "$.a").alias("r")).explain()
+    assert "CPU bridge" in e, e
+    assert_tpu_cpu_equal(
+        lambda sess: jsrc(sess).select(
+            col("j"),
+            GetJsonObject(col("j"), "$.a").alias("a"),
+            GetJsonObject(col("j"), "$.b.c").alias("bc"),
+            GetJsonObject(col("j"), "$.a[1]").alias("a1"),
+            GetJsonObject(col("j"), "$.a.deep[1].z").alias("z")))

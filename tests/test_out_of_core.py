@@ -203,3 +203,14 @@ def test_ooc_spill_pressure():
         assert fw.metrics.spill_to_disk_bytes >= 0
     finally:
         fw.host_limit_bytes = old_limit
+
+
+def test_ooc_window_key_batched():
+    from spark_rapids_tpu.expressions import WindowFrame, min_, over, sum_
+    assert_ooc_equal(
+        lambda s: big_source(s, nkeys=200).with_column(
+            "w", over(sum_("v"), partition_by=["k"], order_by=["v"])))
+    assert_ooc_equal(
+        lambda s: big_source(s, nkeys=200).with_column(
+            "w", over(min_("v"), partition_by=["k"], order_by=["v"],
+                      frame=WindowFrame("rows", -3, 3))))

@@ -1,0 +1,100 @@
+"""Pandas-UDF exec family: scalar UDFs (via the CPU bridge), mapInPandas,
+and grouped applyInPandas — differential across engines.
+
+Reference analog: udf_test / grouped-map tests over
+org/apache/spark/sql/rapids/execution/python/ (GpuArrowEvalPythonExec,
+GpuFlatMapGroupsInPandasExec)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.udf import PandasScalarUDF
+
+from test_queries import assert_tpu_cpu_equal
+
+SCHEMA = Schema.of(k=T.INT, v=T.LONG, x=T.DOUBLE)
+
+
+def src(sess, n=300, parts=3, seed=3):
+    rng = np.random.RandomState(seed)
+    data = {
+        "k": rng.randint(0, 9, n).tolist(),
+        "v": rng.randint(-1000, 1000, n).tolist(),
+        "x": rng.randn(n).tolist(),
+    }
+    for idx in rng.choice(n, n // 10, replace=False):
+        data["v"][idx] = None
+    batches = [ColumnarBatch.from_pydict(
+        {c: vals[o:o + 64] for c, vals in data.items()}, SCHEMA)
+        for o in range(0, n, 64)]
+    return sess.create_dataframe(batches, num_partitions=parts)
+
+
+def test_scalar_pandas_udf_bridges():
+    def plus_tax(v, x):
+        return v * 1.1 + x
+
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = src(s).select(
+        PandasScalarUDF(plus_tax, T.DOUBLE, col("v"), col("x"))
+        .alias("r")).explain()
+    assert "CPU bridge" in e, e
+    assert_tpu_cpu_equal(
+        lambda sess: src(sess).select(
+            col("v"),
+            PandasScalarUDF(plus_tax, T.DOUBLE, col("v"), col("x"))
+            .alias("r")))
+
+
+def test_scalar_pandas_udf_string_result():
+    def label(k):
+        return k.map(lambda x: None if x is None else f"grp-{int(x)}")
+
+    assert_tpu_cpu_equal(
+        lambda sess: src(sess).select(
+            col("k"), PandasScalarUDF(label, T.STRING, col("k")).alias("s")))
+
+
+def test_map_in_pandas():
+    def normalize(pdf):
+        pdf = pdf.copy()
+        pdf["x"] = pdf["x"] - pdf["x"].mean()
+        return pdf
+
+    # per-batch semantics differ between engines only through batch
+    # boundaries; make it deterministic by mapping a single partition
+    assert_tpu_cpu_equal(
+        lambda sess: src(sess, parts=1)
+        .map_in_pandas(lambda pdf: pdf[pdf["k"] > 3], SCHEMA))
+
+
+def test_apply_in_pandas_grouped_map():
+    out_schema = Schema.of(k=T.INT, total=T.DOUBLE, n=T.LONG)
+
+    def summarize(group):
+        return pd.DataFrame({
+            "k": [group["k"].iloc[0]],
+            "total": [group["x"].sum()],
+            "n": [len(group)],
+        })
+
+    assert_tpu_cpu_equal(
+        lambda sess: src(sess).group_by(col("k"))
+        .apply_in_pandas(summarize, out_schema))
+
+
+def test_apply_in_pandas_expanding():
+    """fn returning multiple rows per group."""
+    out_schema = Schema.of(k=T.INT, x=T.DOUBLE)
+
+    def top2(group):
+        top = group.nlargest(2, "x")
+        return pd.DataFrame({"k": top["k"], "x": top["x"]})
+
+    assert_tpu_cpu_equal(
+        lambda sess: src(sess).group_by(col("k"))
+        .apply_in_pandas(top2, out_schema))

@@ -171,13 +171,42 @@ def test_like_host_dfa_semantics():
         assert compiled.match_host(s.encode("utf-8")) == want, (pattern, s)
 
 
-def test_rlike_unsupported_falls_back():
+def test_rlike_unsupported_bridges_or_falls_back():
+    # backreferences exceed the DFA dialect; with the CPU bridge enabled
+    # (default) the expression runs host-side inside the device plan, and
+    # with it disabled the whole node falls back
     s = TpuSession({"spark.rapids.sql.enabled": "true"})
     df = _strings_source(s).select(RLike(col("s"), r"(a)\1").alias("m"))
-    assert "will NOT" in df.explain()
+    assert "CPU bridge" in df.explain()
     assert_tpu_cpu_equal(
         lambda sess: _strings_source(sess).select(
             col("s"), RLike(col("s"), r"(a)\1").alias("m")))
+    s2 = TpuSession({"spark.rapids.sql.enabled": "true",
+                     "spark.rapids.sql.expression.cpuBridge.enabled":
+                         "false"})
+    df2 = _strings_source(s2).select(RLike(col("s"), r"(a)\1").alias("m"))
+    assert "will NOT" in df2.explain()
+
+
+def test_host_only_pattern_bridges():
+    # possessive quantifiers: outside the DFA dialect but Python 3.11+ re
+    # runs them with Java semantics — the bridge picks them up
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = _strings_source(s).select(RLike(col("s"), "a*+b").alias("m"))
+    assert "CPU bridge" in df.explain()
+    assert_tpu_cpu_equal(
+        lambda sess: _strings_source(sess).select(
+            col("s"), RLike(col("s"), "a*+b").alias("m")))
+
+
+def test_java_only_pattern_never_bridges():
+    # \p{...} classes compile under NEITHER engine: the cpu_evaluable gate
+    # must refuse the bridge so the plan falls back whole-node (where the
+    # CPU engine raises a clear error only if actually executed)
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = _strings_source(s).select(RLike(col("s"), r"\p{Alpha}+").alias("m"))
+    e = df.explain()
+    assert "CPU bridge" not in e and "will NOT" in e, e
 
 
 def test_rlike_over_projected_string():
@@ -204,18 +233,25 @@ def test_java_metachar_escapes_rejected():
         assert not is_supported(p), p
 
 
-def test_cast_over_growing_string_falls_back():
+def test_cast_over_growing_string_bridges():
     from spark_rapids_tpu import types as T
     from spark_rapids_tpu.api.session import TpuSession
     from spark_rapids_tpu.expressions import Cast, ConcatStrings
     s = TpuSession({"spark.rapids.sql.enabled": "true"})
     df = _strings_source(s).select(
         Cast(ConcatStrings(col("s"), col("s")), T.LONG).alias("v"))
-    assert "will NOT" in df.explain()
-    # correctness preserved through the fallback
+    # the device window cannot cover a grown string; the CPU bridge takes
+    # the subtree (bridge off => whole-node fallback)
+    assert "CPU bridge" in df.explain()
     assert_tpu_cpu_equal(
         lambda sess: _strings_source(sess, extra=["12", "34"]).select(
             Cast(ConcatStrings(col("s"), col("s")), T.LONG).alias("v")))
+    s2 = TpuSession({"spark.rapids.sql.enabled": "true",
+                     "spark.rapids.sql.expression.cpuBridge.enabled":
+                         "false"})
+    df2 = _strings_source(s2).select(
+        Cast(ConcatStrings(col("s"), col("s")), T.LONG).alias("v"))
+    assert "will NOT" in df2.explain()
 
 
 def test_case_literal_widens_regex_bucket():

@@ -62,6 +62,28 @@ WINDOW_EXPRS = [
          frame=WindowFrame("rows", -3, 3)),
     over(count(), partition_by=["k"], order_by=["t"],
          frame=WindowFrame("rows", None, 0)),                   # rows running
+    # bounded ROWS min/max (sparse-table sliding kernel)
+    over(min_("v"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("rows", -2, 0)),
+    over(max_("v"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("rows", -3, 3)),
+    over(min_("x"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("rows", -4, 1)),
+    over(max_("x"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("rows", 0, 2)),
+    # bounded RANGE frames over the order value (binary-search bounds)
+    over(sum_("v"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("range", -5, 5)),
+    over(count("v"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("range", -3, 0)),
+    over(avg("v"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("range", -10, -2)),
+    over(min_("v"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("range", -4, 4)),
+    over(max_("v"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("range", None, 3)),
+    over(sum_("v"), partition_by=["k"], order_by=["t"],
+         frame=WindowFrame("range", -2, None)),
 ]
 
 
@@ -83,10 +105,21 @@ def test_window_runs_on_tpu():
     assert "will NOT" not in e, e
 
 
-def test_bounded_min_falls_back():
+def test_bounded_frames_run_on_tpu():
     s = TpuSession({"spark.rapids.sql.enabled": "true"})
     we = over(min_("v"), partition_by=["k"], order_by=["t"],
               frame=WindowFrame("rows", -2, 0))
+    assert "will NOT" not in wdf(s).with_column("w", we).explain()
+    we2 = over(sum_("v"), partition_by=["k"], order_by=["t"],
+               frame=WindowFrame("range", -5, 5))
+    assert "will NOT" not in wdf(s).with_column("w", we2).explain()
+
+
+def test_bounded_range_float_key_falls_back():
+    # float order keys keep the NaN/rounding hazards off the device path
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    we = over(sum_("v"), partition_by=["k"], order_by=["x"],
+              frame=WindowFrame("range", -1, 1))
     assert "will NOT" in wdf(s).with_column("w", we).explain()
     assert_tpu_cpu_equal(lambda sess: wdf(sess).with_column("w", we))
 

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Replay a LORE dump: load the batches an exec produced back into a
+DataFrame for isolated debugging (reference: lore/ replay workflow).
+
+Usage:
+    from tools.lore_replay import load_lore
+    df = load_lore(session, "/tmp/spark_rapids_tpu_lore/loreId-3")
+    df.filter(...).collect()   # re-run just the downstream subplan
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def load_lore(session, dump_dir: str):
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+    batches = []
+    for name in sorted(os.listdir(dump_dir)):
+        if name.endswith(".parquet"):
+            table = pq.read_table(os.path.join(dump_dir, name))
+            batches.append(ColumnarBatch.from_arrow(table))
+    if not batches:
+        raise FileNotFoundError(f"no LORE batches under {dump_dir}")
+    return session.create_dataframe(batches,
+                                    num_partitions=max(len(batches), 1))
+
+
+if __name__ == "__main__":
+    from spark_rapids_tpu.api.session import TpuSession
+    sess = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = load_lore(sess, sys.argv[1])
+    for row in df.limit(20).collect():
+        print(row)
+    print("...", df.count(), "rows total")

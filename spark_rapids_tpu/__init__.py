@@ -40,6 +40,25 @@ if not getattr(_jax_compiler, "_srtpu_compile_lock_installed", False):
     _jax_compiler.backend_compile_and_load = _serialized_backend_compile
     _jax_compiler._srtpu_compile_lock_installed = True
 
+# Persistent XLA compilation cache: the engine is compile-heavy (per
+# capacity-bucket specialization), and jaxlib 0.9's CPU backend has a rare
+# native crash under concurrent compile+execute load — caching both speeds
+# reruns dramatically and shrinks the crash window.  Opt out with
+# SPARK_RAPIDS_TPU_NO_COMPILE_CACHE=1.
+import os as _os
+
+if not _os.environ.get("SPARK_RAPIDS_TPU_NO_COMPILE_CACHE"):
+    try:
+        _cache_dir = _os.environ.get(
+            "SPARK_RAPIDS_TPU_COMPILE_CACHE",
+            _os.path.expanduser("~/.cache/spark_rapids_tpu_xla"))
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass  # cache is an optimization; never fail import over it
+
 from spark_rapids_tpu import types  # noqa: F401
 from spark_rapids_tpu.config import RapidsConf  # noqa: F401
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema  # noqa: F401

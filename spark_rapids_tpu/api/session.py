@@ -211,6 +211,16 @@ class DataFrame:
             L.Repartition(num_partitions, [_to_expr(k) for k in keys],
                           self.plan), self.session)
 
+    def explode(self, expr, alias: str = "col", outer: bool = False,
+                pos: bool = False, pos_alias: str = "pos") -> "DataFrame":
+        """Append explode(expr) rows: child columns + [pos] + element column
+        (Spark's select('*', explode(c)); GenerateExec)."""
+        from spark_rapids_tpu.expressions.collections import Explode, PosExplode
+        gen = (PosExplode if pos else Explode)(_to_expr(expr))
+        return DataFrame(
+            L.Generate(gen, self.plan, outer=outer, alias=alias,
+                       pos_alias=pos_alias), self.session)
+
     def map_batches(self, fn, schema: Schema) -> "DataFrame":
         """Arrow-batch python transform: fn(pyarrow.Table) -> pyarrow.Table
         producing `schema` (pandas interop: use table.to_pandas() inside)."""

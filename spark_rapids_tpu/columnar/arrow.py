@@ -43,6 +43,8 @@ def arrow_type_to_sql(at: pa.DataType) -> T.DataType:
         return T.DecimalType(at.precision, at.scale)
     if pa.types.is_dictionary(at):
         return arrow_type_to_sql(at.value_type)
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return T.ArrayType(arrow_type_to_sql(at.value_type))
     raise NotImplementedError(f"unsupported arrow type: {at}")
 
 
@@ -71,6 +73,8 @@ def sql_type_to_arrow(dt: T.DataType) -> pa.DataType:
         return pa.timestamp("us", tz="UTC")
     if isinstance(dt, T.DecimalType):
         return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, T.ArrayType):
+        return pa.list_(sql_type_to_arrow(dt.element_type))
     raise NotImplementedError(f"unsupported sql type: {dt}")
 
 
@@ -86,6 +90,10 @@ def arrow_column_to_device(arr: pa.Array, dtype: T.DataType,
     n = len(arr)
     if pa.types.is_dictionary(arr.type):
         arr = arr.dictionary_decode()
+    if isinstance(dtype, T.ArrayType):
+        # List<elem> upload via python objects (list columns are cold-path
+        # inputs; the hot scan columns are primitives/strings)
+        return DeviceColumn.from_arrays(arr.to_pylist(), dtype, capacity=capacity)
     if dtype.variable_width:
         if pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
             arr = arr.cast(pa.string() if pa.types.is_large_string(arr.type) else pa.binary())
@@ -167,7 +175,9 @@ def batch_to_arrow(batch: ColumnarBatch) -> pa.Table:
     fields = []
     for name, dtype, col in zip(batch.schema.names, batch.schema.dtypes, batch.columns):
         at = sql_type_to_arrow(dtype)
-        if dtype.variable_width:
+        if isinstance(dtype, T.ArrayType):
+            arrays.append(pa.array(col.to_pylist(n), type=at))
+        elif dtype.variable_width:
             # Build from raw buffers: offsets/data download straight into an
             # Arrow StringArray without Python-object round-trips.
             offsets = np.asarray(col.offsets)[: n + 1]

@@ -90,6 +90,8 @@ class ColumnarBatch:
             total += c.validity.size
             if c.offsets is not None:
                 total += c.offsets.size * 4
+            if c.child_validity is not None:
+                total += c.child_validity.size
         return total
 
     # -- host interop -------------------------------------------------------
@@ -102,7 +104,9 @@ class ColumnarBatch:
         cols = []
         for name, dtype in zip(schema.names, schema.dtypes):
             vals = data[name]
-            if dtype.variable_width:
+            if isinstance(dtype, T.ArrayType):
+                cols.append(DeviceColumn.from_arrays(vals, dtype, capacity=cap))
+            elif dtype.variable_width:
                 cols.append(DeviceColumn.from_strings(vals, capacity=cap, dtype=dtype))
             else:
                 arr = np.zeros((n,), dtype=dtype.np_dtype)

@@ -40,20 +40,37 @@ def round_up_pow2(n: int) -> int:
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class DeviceColumn:
-    """One SQL column in HBM.  A pytree: jit-traceable, shardable."""
+    """One SQL column in HBM.  A pytree: jit-traceable, shardable.
 
-    data: jax.Array                  # [capacity] or [byte_capacity] for strings
+    Three layouts (reference: GpuColumnVector.java over cudf column views):
+      * fixed-width:  data[cap] + validity[cap]
+      * string/binary: offsets[cap+1] + data[byte_cap u8] + validity[cap]
+      * array<fixed-width elem>: offsets[cap+1] + data[elem_cap of elem dtype]
+        + child_validity[elem_cap] + validity[cap] — same segmented layout as
+        strings, so gather/concat/partition reuse the offsets machinery.
+    """
+
+    data: jax.Array                  # [capacity]; [byte_capacity] for strings;
+                                     # [elem_capacity] for arrays
     validity: jax.Array              # [capacity] bool, True = non-null
     dtype: T.DataType                # static
-    offsets: Optional[jax.Array] = None  # [capacity+1] int32, strings only
+    offsets: Optional[jax.Array] = None  # [capacity+1] int32, strings/arrays
+    child_validity: Optional[jax.Array] = None  # [elem_capacity] bool, arrays
 
     def tree_flatten(self):
+        if self.child_validity is not None:
+            return (self.data, self.validity, self.offsets,
+                    self.child_validity), self.dtype
         if self.offsets is not None:
             return (self.data, self.validity, self.offsets), self.dtype
         return (self.data, self.validity), self.dtype
 
     @classmethod
     def tree_unflatten(cls, dtype, children):
+        if len(children) == 4:
+            data, validity, offsets, child_validity = children
+            return cls(data=data, validity=validity, dtype=dtype,
+                       offsets=offsets, child_validity=child_validity)
         if len(children) == 3:
             data, validity, offsets = children
             return cls(data=data, validity=validity, dtype=dtype, offsets=offsets)
@@ -68,17 +85,31 @@ class DeviceColumn:
 
     @property
     def byte_capacity(self) -> int:
+        """Element-slot capacity of the variable-width child buffer (bytes
+        for strings, elements for arrays)."""
         assert self.offsets is not None
         return self.data.shape[0]
 
     @property
     def is_string_like(self) -> bool:
-        return self.offsets is not None
+        return self.offsets is not None and self.child_validity is None
+
+    @property
+    def is_array(self) -> bool:
+        return self.child_validity is not None
 
     # -- constructors -------------------------------------------------------
 
     @staticmethod
     def empty(dtype: T.DataType, capacity: int, byte_capacity: int = 0) -> "DeviceColumn":
+        if isinstance(dtype, T.ArrayType):
+            return DeviceColumn(
+                data=jnp.zeros((byte_capacity,), dtype=dtype.element_type.jnp_dtype),
+                validity=jnp.zeros((capacity,), dtype=jnp.bool_),
+                dtype=dtype,
+                offsets=jnp.zeros((capacity + 1,), dtype=jnp.int32),
+                child_validity=jnp.zeros((byte_capacity,), dtype=jnp.bool_),
+            )
         if dtype.variable_width:
             return DeviceColumn(
                 data=jnp.zeros((byte_capacity,), dtype=jnp.uint8),
@@ -161,6 +192,63 @@ class DeviceColumn:
             offsets=jnp.asarray(offsets),
         )
 
+    @staticmethod
+    def from_arrays(
+        values,
+        dtype: T.DataType,
+        capacity: Optional[int] = None,
+        elem_capacity: Optional[int] = None,
+    ) -> "DeviceColumn":
+        """Host→HBM upload of an array<fixed-width> column.
+
+        ``values`` is a sequence of rows; each row is None (null array) or a
+        sequence of element values where None marks a null element.
+        """
+        assert isinstance(dtype, T.ArrayType)
+        et = dtype.element_type
+        assert not et.variable_width, "array elements must be fixed-width"
+        n = len(values)
+        valid = np.ones((n,), dtype=np.bool_)
+        lengths = np.zeros((n,), dtype=np.int64)
+        flat_vals: list = []
+        flat_valid: list = []
+        for i, row in enumerate(values):
+            if row is None:
+                valid[i] = False
+                continue
+            lengths[i] = len(row)
+            for e in row:
+                if e is None:
+                    flat_vals.append(0)
+                    flat_valid.append(False)
+                else:
+                    flat_vals.append(e)
+                    flat_valid.append(True)
+        total = int(lengths.sum())
+        cap = capacity if capacity is not None else round_up_pow2(max(n, 1))
+        ecap = elem_capacity if elem_capacity is not None else round_up_pow2(max(total, 1))
+        offsets = np.zeros((cap + 1,), dtype=np.int32)
+        np.cumsum(lengths, out=offsets[1 : n + 1])
+        offsets[n + 1 :] = offsets[n]
+        data = np.zeros((ecap,), dtype=et.np_dtype)
+        cvalid = np.zeros((ecap,), dtype=np.bool_)
+        if total:
+            ev = np.asarray(flat_valid, dtype=np.bool_)
+            raw = np.asarray(flat_vals)
+            if raw.dtype != et.np_dtype:
+                raw = np.where(ev, raw, np.zeros_like(raw)).astype(et.np_dtype)
+            data[:total] = np.where(ev, raw, np.zeros_like(raw))
+            cvalid[:total] = ev
+        validity_full = np.zeros((cap,), dtype=np.bool_)
+        validity_full[:n] = valid
+        return DeviceColumn(
+            data=jnp.asarray(data),
+            validity=jnp.asarray(validity_full),
+            dtype=dtype,
+            offsets=jnp.asarray(offsets),
+            child_validity=jnp.asarray(cvalid),
+        )
+
     # -- host download ------------------------------------------------------
 
     def to_numpy(self, num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -171,6 +259,20 @@ class DeviceColumn:
         return data, valid
 
     def to_pylist(self, num_rows: int):
+        if self.is_array:
+            offsets = np.asarray(self.offsets)
+            data = np.asarray(self.data)
+            valid = np.asarray(self.validity)
+            cvalid = np.asarray(self.child_validity)
+            out = []
+            for i in range(num_rows):
+                if not valid[i]:
+                    out.append(None)
+                else:
+                    s, e = offsets[i], offsets[i + 1]
+                    out.append([data[j].item() if cvalid[j] else None
+                                for j in range(s, e)])
+            return out
         if self.dtype.variable_width:
             offsets = np.asarray(self.offsets)
             data = np.asarray(self.data)
@@ -209,7 +311,12 @@ class DeviceColumn:
             oidx = jnp.arange(self.capacity + 1, dtype=jnp.int32)
             offsets = jnp.where(oidx <= num_rows, self.offsets, end)
             bidx = jnp.arange(self.byte_capacity, dtype=jnp.int32)
-            data = jnp.where(bidx < end, self.data, jnp.uint8(0))
+            zero = jnp.zeros((), dtype=self.data.dtype)
+            data = jnp.where(bidx < end, self.data, zero)
+            if self.child_validity is not None:
+                cvalid = jnp.where(bidx < end, self.child_validity, False)
+                data = jnp.where(cvalid, data, zero)
+                return DeviceColumn(data, valid, self.dtype, offsets, cvalid)
             return DeviceColumn(data, valid, self.dtype, offsets)
         zero = jnp.zeros((), dtype=self.data.dtype)
         data = jnp.where(valid, self.data, zero)
@@ -219,8 +326,9 @@ class DeviceColumn:
         """Grow (or shrink) the static capacity, preserving contents."""
         if self.offsets is not None:
             bcap = byte_capacity if byte_capacity is not None else self.byte_capacity
-            data = jnp.zeros((bcap,), dtype=jnp.uint8).at[: min(bcap, self.byte_capacity)].set(
-                self.data[: min(bcap, self.byte_capacity)]
+            ncopyb = min(bcap, self.byte_capacity)
+            data = jnp.zeros((bcap,), dtype=self.data.dtype).at[:ncopyb].set(
+                self.data[:ncopyb]
             )
             offsets = jnp.zeros((capacity + 1,), dtype=jnp.int32)
             ncopy = min(capacity + 1, self.offsets.shape[0])
@@ -231,7 +339,12 @@ class DeviceColumn:
             validity = validity.at[: min(capacity, self.capacity)].set(
                 self.validity[: min(capacity, self.capacity)]
             )
-            return DeviceColumn(data, validity, self.dtype, offsets)
+            cvalid = None
+            if self.child_validity is not None:
+                cvalid = jnp.zeros((bcap,), dtype=jnp.bool_).at[:ncopyb].set(
+                    self.child_validity[:ncopyb]
+                )
+            return DeviceColumn(data, validity, self.dtype, offsets, cvalid)
         data = jnp.zeros((capacity,), dtype=self.data.dtype)
         validity = jnp.zeros((capacity,), dtype=jnp.bool_)
         ncopy = min(capacity, self.capacity)

@@ -61,6 +61,13 @@ class CpuBridgeExpression(Expression):
         dt = self.dtype
         cap = batch.capacity
         n = int(batch.num_rows)
+        if isinstance(dt, T.ArrayType):
+            py = [v if m else None for v, m in zip(vals[:n], valid[:n])]
+            py += [None] * (cap - n)
+            col = DeviceColumn.from_arrays(py, dt, capacity=cap)
+            live = ctx.live_mask()
+            return DeviceColumn(col.data, col.validity & live, dt,
+                                col.offsets, col.child_validity)
         if dt.variable_width:
             py = [v if m else None for v, m in zip(vals[:n], valid[:n])]
             py += [None] * (cap - n)

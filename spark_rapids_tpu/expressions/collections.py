@@ -1,0 +1,1005 @@
+"""Collection (array) expression twins + higher-order functions.
+
+Reference: org/apache/spark/sql/rapids/collectionOperations.scala (GpuSize,
+GpuArrayContains, GpuSortArray, GpuArrayMin/Max, GpuElementAt, GpuSlice,
+GpuArrayRepeat, GpuArrayRemove, GpuArrayDistinct, GpuArraysOverlap, GpuSequence)
+and higherOrderFunctions.scala (GpuArrayTransform, GpuArrayFilter,
+GpuArrayExists, GpuArrayForAll, GpuArrayAggregate — the lambda machinery
+GpuNamedLambdaVariable/GpuLambdaFunction).
+
+TPU design: arrays are segmented flat buffers, so HOF lambdas are evaluated
+ONCE over the whole element buffer (a single vectorized expression eval at
+element granularity) — no per-row dispatch.  Outer row columns referenced by
+a lambda body are broadcast to element level with one gather.  Ops whose
+device shapes would be data-dependent in unbounded ways (sequence,
+arrays_overlap, set ops) are host-evaluated: the planner routes them through
+the expression-level CPU bridge (expressions/bridge.py).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions.core import (
+    BinaryExpression,
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+    Literal,
+    UnaryExpression,
+    make_column,
+)
+from spark_rapids_tpu.kernels import collections as CK
+
+
+def _obj(vals) -> np.ndarray:
+    out = np.empty((len(vals),), dtype=object)
+    out[:] = vals
+    return out
+
+
+def _elem_dtype(e: Expression) -> T.DataType:
+    dt = e.dtype
+    assert isinstance(dt, T.ArrayType), dt
+    return dt.element_type
+
+
+class Size(UnaryExpression):
+    """size(array).  Spark default (legacy.sizeOfNull=true): size(null) = -1
+    with a non-null result (collectionOperations.scala GpuSize)."""
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        lens = CK.lengths(c)
+        live = jnp.arange(c.capacity, dtype=jnp.int32) < ctx.batch.num_rows
+        out = jnp.where(c.validity, lens, jnp.int32(-1))
+        out = jnp.where(live, out, 0)
+        return DeviceColumn(out, live, T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, valid = self.child.eval_cpu(ctx)
+        out = np.array([len(x) if m else -1 for x, m in zip(v, valid)],
+                       dtype=np.int32)
+        return out, np.ones((len(v),), np.bool_)
+
+
+class ArrayContains(BinaryExpression):
+    """array_contains(arr, value); value must not grow (fixed-width)."""
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def eval(self, ctx: EvalContext):
+        arr = self.left.eval(ctx)
+        val = self.right.eval(ctx)
+        found, valid = CK.segment_contains(
+            arr, val.data, val.validity, ctx.batch.num_rows)
+        return DeviceColumn(found, valid, T.BOOLEAN)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.left.eval_cpu(ctx)
+        bv, bm = self.right.eval_cpu(ctx)
+        out = np.zeros((len(av),), np.bool_)
+        valid = np.zeros((len(av),), np.bool_)
+        for i in range(len(av)):
+            if not am[i] or not bm[i]:
+                continue
+            row = av[i]
+            needle = bv[i] if bv.dtype != object else bv[i]
+            hit = any(e is not None and e == needle for e in row)
+            has_null = any(e is None for e in row)
+            if hit:
+                out[i] = True
+                valid[i] = True
+            elif not has_null:
+                valid[i] = True
+        return out, valid
+
+
+class ArrayPosition(BinaryExpression):
+    """array_position(arr, value): 1-based first index, 0 when absent."""
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    def eval(self, ctx: EvalContext):
+        arr = self.left.eval(ctx)
+        val = self.right.eval(ctx)
+        pos, valid = CK.segment_position(
+            arr, val.data, val.validity, ctx.batch.num_rows)
+        return DeviceColumn(pos, valid, T.LONG)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.left.eval_cpu(ctx)
+        bv, bm = self.right.eval_cpu(ctx)
+        out = np.zeros((len(av),), np.int64)
+        valid = am & bm
+        for i in range(len(av)):
+            if not valid[i]:
+                continue
+            for j, e in enumerate(av[i]):
+                if e is not None and e == bv[i]:
+                    out[i] = j + 1
+                    break
+        return out, valid
+
+
+class GetArrayItem(BinaryExpression):
+    """arr[i], 0-based; out-of-range or null element -> null (non-ANSI)."""
+
+    @property
+    def dtype(self):
+        return _elem_dtype(self.left)
+
+    def eval(self, ctx: EvalContext):
+        arr = self.left.eval(ctx)
+        idx = self.right.eval(ctx)
+        lens = CK.lengths(arr)
+        i = idx.data.astype(jnp.int32)
+        ok = arr.validity & idx.validity & (i >= 0) & (i < lens)
+        src = jnp.clip(arr.offsets[:-1] + jnp.where(ok, i, 0), 0,
+                       arr.byte_capacity - 1)
+        validity = ok & arr.child_validity[src]
+        live = jnp.arange(arr.capacity, dtype=jnp.int32) < ctx.batch.num_rows
+        validity = validity & live
+        return make_column(arr.data[src], validity, self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.left.eval_cpu(ctx)
+        iv, im = self.right.eval_cpu(ctx)
+        et = self.dtype
+        out_obj = []
+        valid = np.zeros((len(av),), np.bool_)
+        for i in range(len(av)):
+            v = None
+            if am[i] and im[i] and 0 <= int(iv[i]) < len(av[i]):
+                v = av[i][int(iv[i])]
+            out_obj.append(v)
+            valid[i] = v is not None
+        if et.variable_width or isinstance(et, T.ArrayType):
+            return _obj(out_obj), valid
+        out = np.array([0 if v is None else v for v in out_obj],
+                       dtype=et.np_dtype)
+        return out, valid
+
+
+class ElementAt(BinaryExpression):
+    """element_at(arr, i): 1-based, negative indexes from the end;
+    out-of-range -> null (non-ANSI behavior)."""
+
+    @property
+    def dtype(self):
+        return _elem_dtype(self.left)
+
+    def eval(self, ctx: EvalContext):
+        arr = self.left.eval(ctx)
+        idx = self.right.eval(ctx)
+        lens = CK.lengths(arr)
+        i = idx.data.astype(jnp.int32)
+        zero_based = jnp.where(i > 0, i - 1, lens + i)
+        ok = (arr.validity & idx.validity & (i != 0)
+              & (zero_based >= 0) & (zero_based < lens))
+        src = jnp.clip(arr.offsets[:-1] + jnp.where(ok, zero_based, 0), 0,
+                       arr.byte_capacity - 1)
+        validity = ok & arr.child_validity[src]
+        live = jnp.arange(arr.capacity, dtype=jnp.int32) < ctx.batch.num_rows
+        validity = validity & live
+        return make_column(arr.data[src], validity, self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.left.eval_cpu(ctx)
+        iv, im = self.right.eval_cpu(ctx)
+        et = self.dtype
+        out_obj = []
+        valid = np.zeros((len(av),), np.bool_)
+        for i in range(len(av)):
+            v = None
+            if am[i] and im[i] and int(iv[i]) != 0:
+                k = int(iv[i])
+                z = k - 1 if k > 0 else len(av[i]) + k
+                if 0 <= z < len(av[i]):
+                    v = av[i][z]
+            out_obj.append(v)
+            valid[i] = v is not None
+        if et.variable_width or isinstance(et, T.ArrayType):
+            return _obj(out_obj), valid
+        out = np.array([0 if v is None else v for v in out_obj],
+                       dtype=et.np_dtype)
+        return out, valid
+
+
+class _ArrayMinMax(UnaryExpression):
+    IS_MIN = True
+
+    @property
+    def dtype(self):
+        return _elem_dtype(self.child)
+
+    def eval(self, ctx: EvalContext):
+        arr = self.child.eval(ctx)
+        vals, valid = CK.segment_reduce_minmax(
+            arr, ctx.batch.num_rows, self.IS_MIN)
+        return DeviceColumn(vals, valid, self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.child.eval_cpu(ctx)
+        et = self.dtype
+        out = np.zeros((len(av),), et.np_dtype)
+        valid = np.zeros((len(av),), np.bool_)
+        pick = min if self.IS_MIN else max
+        for i in range(len(av)):
+            if not am[i]:
+                continue
+            elems = [e for e in av[i] if e is not None]
+            if not elems:
+                continue
+            # Spark ordering: NaN greater than everything
+            if et.is_floating:
+                nans = [e for e in elems if e != e]
+                finite = [e for e in elems if e == e]
+                if self.IS_MIN:
+                    r = min(finite) if finite else nans[0]
+                else:
+                    r = nans[0] if nans else max(finite)
+            else:
+                r = pick(elems)
+            out[i] = r
+            valid[i] = True
+        return out, valid
+
+
+class ArrayMin(_ArrayMinMax):
+    IS_MIN = True
+
+
+class ArrayMax(_ArrayMinMax):
+    IS_MIN = False
+
+
+class SortArray(BinaryExpression):
+    """sort_array(arr, asc): asc -> nulls first, desc -> nulls last."""
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    def _asc(self) -> bool:
+        assert isinstance(self.right, Literal)
+        return bool(self.right.value)
+
+    def eval(self, ctx: EvalContext):
+        arr = self.left.eval(ctx)
+        return CK.segment_sort(arr, ctx.batch.num_rows, self._asc())
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.left.eval_cpu(ctx)
+        asc = self._asc()
+        out = []
+        for i in range(len(av)):
+            if not am[i]:
+                out.append(None)
+                continue
+            nulls = [e for e in av[i] if e is None]
+            vals = sorted([e for e in av[i] if e is not None], reverse=not asc)
+            out.append(nulls + vals if asc else vals + nulls)
+        return _obj(out), am.copy()
+
+
+class ArrayDistinct(UnaryExpression):
+    """array_distinct: first-occurrence order, one null kept."""
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext):
+        arr = self.child.eval(ctx)
+        return CK.segment_distinct(arr, ctx.batch.num_rows)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.child.eval_cpu(ctx)
+        out = []
+        for i in range(len(av)):
+            if not am[i]:
+                out.append(None)
+                continue
+            seen = set()
+            saw_null = False
+            row = []
+            for e in av[i]:
+                if e is None:
+                    if not saw_null:
+                        saw_null = True
+                        row.append(None)
+                elif e not in seen:
+                    seen.add(e)
+                    row.append(e)
+            out.append(row)
+        return _obj(out), am.copy()
+
+
+class ArrayRemove(BinaryExpression):
+    """array_remove(arr, v): drop elements equal to v; nulls kept; null v
+    -> null result (Spark)."""
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    def eval(self, ctx: EvalContext):
+        arr = self.left.eval(ctx)
+        val = self.right.eval(ctx)
+        rows = CK.element_row_ids(arr)
+        keep = ~(arr.child_validity & (arr.data == val.data[rows]))
+        out = CK.segment_filter(arr, keep, ctx.batch.num_rows)
+        validity = out.validity & val.validity
+        return DeviceColumn(out.data, validity, out.dtype, out.offsets,
+                            out.child_validity)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.left.eval_cpu(ctx)
+        bv, bm = self.right.eval_cpu(ctx)
+        out = []
+        valid = am & bm
+        for i in range(len(av)):
+            if not valid[i]:
+                out.append(None)
+                continue
+            out.append([e for e in av[i] if e is None or e != bv[i]])
+        return _obj(out), valid
+
+
+class Slice(Expression):
+    """slice(arr, start, length): 1-based start, negative from end."""
+
+    def __init__(self, arr: Expression, start: Expression, length: Expression):
+        self.children = (arr, start, length)
+
+    def with_children(self, children):
+        return Slice(*children)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval(self, ctx: EvalContext):
+        arr = self.children[0].eval(ctx)
+        st = self.children[1].eval(ctx)
+        ln = self.children[2].eval(ctx)
+        lens = CK.lengths(arr)
+        s = st.data.astype(jnp.int32)
+        l = jnp.maximum(ln.data.astype(jnp.int32), 0)
+        zs = jnp.where(s > 0, s - 1, lens + s)       # 0-based slice start
+        ok = arr.validity & st.validity & ln.validity & (s != 0)
+        # out-of-range start (either direction) -> empty array, not null
+        # (Spark Slice semantics)
+        new_lens = jnp.where(ok & (zs >= 0), jnp.clip(lens - zs, 0, None), 0)
+        new_lens = jnp.minimum(new_lens, l)
+        zs = jnp.maximum(zs, 0)
+        live = jnp.arange(arr.capacity, dtype=jnp.int32) < ctx.batch.num_rows
+        new_lens = jnp.where(live, new_lens, 0)
+        new_offsets = jnp.zeros((arr.capacity + 1,), jnp.int32).at[1:].set(
+            jnp.cumsum(new_lens))
+        ecap = arr.byte_capacity
+        pos = jnp.arange(ecap, dtype=jnp.int32)
+        row = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right")
+                       .astype(jnp.int32) - 1, 0, arr.capacity - 1)
+        within = pos - new_offsets[row]
+        src = jnp.clip(arr.offsets[row] + zs[row] + within, 0, ecap - 1)
+        total = new_offsets[ctx.batch.num_rows]
+        live_e = pos < total
+        cvalid = jnp.where(live_e, arr.child_validity[src], False)
+        zero = jnp.zeros((), arr.data.dtype)
+        data = jnp.where(cvalid, arr.data[src], zero)
+        return DeviceColumn(data, ok & live, self.dtype, new_offsets, cvalid)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.children[0].eval_cpu(ctx)
+        sv, sm = self.children[1].eval_cpu(ctx)
+        lv, lm = self.children[2].eval_cpu(ctx)
+        out = []
+        valid = np.zeros((len(av),), np.bool_)
+        for i in range(len(av)):
+            if not (am[i] and sm[i] and lm[i]) or int(sv[i]) == 0:
+                out.append(None)
+                continue
+            s = int(sv[i])
+            z = s - 1 if s > 0 else len(av[i]) + s
+            valid[i] = True
+            if z < 0:
+                out.append([])   # out-of-range start -> empty (Spark)
+                continue
+            out.append(av[i][z : z + max(int(lv[i]), 0)])
+        return _obj(out), valid
+
+    def __repr__(self):
+        a, s, l = self.children
+        return f"slice({a!r}, {s!r}, {l!r})"
+
+
+class CreateArray(Expression):
+    """array(e1, ..., ek) — fixed per-row length k."""
+
+    def __init__(self, *children: Expression):
+        assert children, "array() needs at least one element"
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return CreateArray(*children)
+
+    @property
+    def dtype(self):
+        return T.ArrayType(self.children[0].dtype)
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext):
+        cols = [c.eval(ctx) for c in self.children]
+        k = len(cols)
+        cap = ctx.capacity
+        data = jnp.stack([c.data for c in cols], axis=1).reshape(-1)
+        cvalid = jnp.stack([c.validity for c in cols], axis=1).reshape(-1)
+        live = jnp.arange(cap, dtype=jnp.int32) < ctx.batch.num_rows
+        cvalid = cvalid & jnp.repeat(live, k)
+        zero = jnp.zeros((), data.dtype)
+        data = jnp.where(cvalid, data, zero)
+        offsets = (jnp.minimum(jnp.arange(cap + 1, dtype=jnp.int32),
+                               ctx.batch.num_rows.astype(jnp.int32)) * k)
+        return DeviceColumn(data, live, self.dtype, offsets, cvalid)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        evs = [c.eval_cpu(ctx) for c in self.children]
+        n = len(evs[0][0])
+        out = []
+        for i in range(n):
+            out.append([v[i].item() if m[i] and v.dtype != object
+                        else (v[i] if m[i] else None)
+                        for v, m in evs])
+        return _obj(out), np.ones((n,), np.bool_)
+
+    def __repr__(self):
+        return f"array({', '.join(map(repr, self.children))})"
+
+
+class ArrayRepeat(BinaryExpression):
+    """array_repeat(e, n) with literal n (static element bound)."""
+
+    @property
+    def dtype(self):
+        return T.ArrayType(self.left.dtype)
+
+    def _n(self) -> Optional[int]:
+        assert isinstance(self.right, Literal)
+        if self.right.value is None:
+            return None   # array_repeat(x, null) -> null (Spark)
+        return max(int(self.right.value), 0)
+
+    def eval(self, ctx: EvalContext):
+        v = self.left.eval(ctx)
+        k = self._n()
+        cap = ctx.capacity
+        live = jnp.arange(cap, dtype=jnp.int32) < ctx.batch.num_rows
+        if k == 0 or k is None:
+            et = self.dtype.element_type
+            validity = live if k == 0 else jnp.zeros((cap,), jnp.bool_)
+            return DeviceColumn(
+                jnp.zeros((1,), et.jnp_dtype), validity, self.dtype,
+                jnp.zeros((cap + 1,), jnp.int32),
+                jnp.zeros((1,), jnp.bool_))
+        data = jnp.repeat(v.data, k)
+        cvalid = jnp.repeat(v.validity, k) & jnp.repeat(live, k)
+        zero = jnp.zeros((), data.dtype)
+        data = jnp.where(cvalid, data, zero)
+        offsets = (jnp.minimum(jnp.arange(cap + 1, dtype=jnp.int32),
+                               ctx.batch.num_rows.astype(jnp.int32)) * k)
+        return DeviceColumn(data, live, self.dtype, offsets, cvalid)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, m = self.left.eval_cpu(ctx)
+        k = self._n()
+        if k is None:
+            return (_obj([None] * len(v)), np.zeros((len(v),), np.bool_))
+        out = []
+        for i in range(len(v)):
+            e = (v[i].item() if v.dtype != object else v[i]) if m[i] else None
+            out.append([e] * k)
+        return _obj(out), np.ones((len(v),), np.bool_)
+
+
+class ArraysOverlap(BinaryExpression):
+    """arrays_overlap — host-only (unbounded pairwise compare); runs via
+    the CPU bridge on device plans."""
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.left.eval_cpu(ctx)
+        bv, bm = self.right.eval_cpu(ctx)
+        out = np.zeros((len(av),), np.bool_)
+        valid = np.zeros((len(av),), np.bool_)
+        for i in range(len(av)):
+            if not (am[i] and bm[i]):
+                continue
+            aset = {e for e in av[i] if e is not None}
+            bset = {e for e in bv[i] if e is not None}
+            hit = bool(aset & bset)
+            anull = len(aset) != len(av[i]) or len(bset) != len(bv[i])
+            if hit:
+                out[i] = True
+                valid[i] = True
+            elif not (anull and av[i] and bv[i]):
+                valid[i] = True
+        return out, valid
+
+
+class Sequence(Expression):
+    """sequence(start, stop[, step]) — host-only (data-dependent length);
+    runs via the CPU bridge on device plans (GpuSequence)."""
+
+    def __init__(self, start: Expression, stop: Expression,
+                 step: Optional[Expression] = None):
+        self.children = (start, stop) if step is None else (start, stop, step)
+
+    def with_children(self, children):
+        return Sequence(*children)
+
+    @property
+    def dtype(self):
+        return T.ArrayType(self.children[0].dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        evs = [c.eval_cpu(ctx) for c in self.children]
+        n = len(evs[0][0])
+        out = []
+        valid = np.zeros((n,), np.bool_)
+        for i in range(n):
+            if not all(m[i] for _, m in evs):
+                out.append(None)
+                continue
+            start, stop = int(evs[0][0][i]), int(evs[1][0][i])
+            step = int(evs[2][0][i]) if len(evs) > 2 else (
+                1 if stop >= start else -1)
+            if step == 0 or (stop - start) * step < 0 and start != stop:
+                out.append(None)
+                continue
+            valid[i] = True
+            row = list(range(start, stop + (1 if step > 0 else -1), step))
+            out.append(row)
+        return _obj(out), valid
+
+    def __repr__(self):
+        return f"sequence({', '.join(map(repr, self.children))})"
+
+
+# ---------------------------------------------------------------------------
+# Higher-order functions
+# ---------------------------------------------------------------------------
+
+
+class NamedLambdaVariable(Expression):
+    """A lambda-bound variable (GpuNamedLambdaVariable).  Identity-keyed:
+    eval looks itself up in the context's lambda bindings."""
+
+    _counter = [0]
+
+    def __init__(self, name: str, dtype: T.DataType, nullable_: bool = True):
+        self.name = name
+        self._dtype = dtype
+        self._nullable = nullable_
+        NamedLambdaVariable._counter[0] += 1
+        self.var_id = NamedLambdaVariable._counter[0]
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def bind(self, schema):
+        return self
+
+    def eval(self, ctx: EvalContext):
+        col = getattr(ctx, "lambda_bindings", {}).get(self.var_id)
+        assert col is not None, f"unbound lambda variable {self.name}"
+        return col
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        pair = getattr(ctx, "lambda_bindings", {}).get(self.var_id)
+        assert pair is not None, f"unbound lambda variable {self.name}"
+        return pair
+
+    def references(self):
+        return set()
+
+    def __repr__(self):
+        return self.name
+
+
+class _HigherOrder(BinaryExpression):
+    """Base: (array, lambda-body) where the body references NamedLambdaVariable
+    instances stored on the node.  Construct via the .make() classmethods that
+    accept a Python callable building the body from fresh variables."""
+
+    def __init__(self, arr: Expression, body: Expression,
+                 elem_var: NamedLambdaVariable,
+                 idx_var: Optional[NamedLambdaVariable] = None):
+        super().__init__(arr, body)
+        self.elem_var = elem_var
+        self.idx_var = idx_var
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1], self.elem_var, self.idx_var)
+
+    @classmethod
+    def make(cls, arr: Expression, fn: Callable,
+             elem_dtype: Optional[T.DataType] = None):
+        """fn(elem_var [, idx_var]) -> body expression.  elem_dtype may be
+        omitted for unbound `arr` — it is resolved when bind() runs."""
+        if elem_dtype is None:
+            try:
+                elem_dtype = _elem_dtype(arr)
+            except Exception:
+                elem_dtype = T.NULL
+        x = NamedLambdaVariable("x", elem_dtype)
+        import inspect
+        nargs = len(inspect.signature(fn).parameters)
+        if nargs >= 2:
+            i = NamedLambdaVariable("i", T.INT, nullable_=False)
+            return cls(arr, fn(x, i), x, i)
+        return cls(arr, fn(x), x, None)
+
+    def bind(self, schema):
+        arr = self.left.bind(schema)
+        et = arr.dtype.element_type
+        if self.elem_var.dtype == et:
+            return type(self)(arr, self.right.bind(schema),
+                              self.elem_var, self.idx_var)
+        # resolve the element variable's dtype now the array child is bound.
+        # Expressions are immutable: substitute a fresh variable into the
+        # body rather than mutating the shared one (a mutated var would
+        # corrupt other bound copies of this lambda).
+        fresh = NamedLambdaVariable(self.elem_var.name, et,
+                                    self.elem_var._nullable)
+
+        def sub(e):
+            if (isinstance(e, NamedLambdaVariable)
+                    and e.var_id == self.elem_var.var_id):
+                return fresh
+            ch = tuple(sub(c) for c in e.children)
+            if all(n is o for n, o in zip(ch, e.children)):
+                return e
+            return e.with_children(ch)
+        body = sub(self.right).bind(schema)
+        return type(self)(arr, body, fresh, self.idx_var)
+
+    # -- element-level evaluation helpers -----------------------------------
+
+    def _element_ctx(self, ctx: EvalContext, arr: DeviceColumn) -> EvalContext:
+        """Build an element-granularity EvalContext: every outer column the
+        body references is gathered to element level; the lambda vars bind
+        to the element buffer / position."""
+        rows = CK.element_row_ids(arr)
+        live = CK.element_live_mask(arr, ctx.batch.num_rows)
+        from spark_rapids_tpu.expressions.core import BoundReference
+
+        def _ordinals(e, out):
+            if isinstance(e, BoundReference):
+                out.add(e.ordinal)
+            for c in e.children:
+                _ordinals(c, out)
+            return out
+        refs = _ordinals(self.right, set())
+        cols = []
+        for ordinal, c in enumerate(ctx.batch.columns):
+            if ordinal in refs and c.offsets is None:
+                data = jnp.where(live, c.data[rows],
+                                 jnp.zeros((), c.data.dtype))
+                valid = jnp.where(live, c.validity[rows], False)
+                cols.append(DeviceColumn(data, valid, c.dtype))
+            else:
+                # unreferenced (or unsupported var-width): placeholder
+                cols.append(DeviceColumn.empty(
+                    T.INT if c.offsets is not None else c.dtype,
+                    arr.byte_capacity))
+        total = arr.offsets[ctx.batch.num_rows]
+        ebatch = ColumnarBatch(tuple(cols), total.astype(jnp.int32),
+                               ctx.batch.schema)
+        ectx = EvalContext(ebatch, string_bucket=ctx.string_bucket,
+                           trace_consts=ctx.trace_consts)
+        elem_col = DeviceColumn(arr.data, arr.child_validity & live,
+                                arr.dtype.element_type)
+        bindings = {self.elem_var.var_id: elem_col}
+        if self.idx_var is not None:
+            within = (jnp.arange(arr.byte_capacity, dtype=jnp.int32)
+                      - arr.offsets[rows])
+            bindings[self.idx_var.var_id] = DeviceColumn(
+                jnp.where(live, within, 0), live, T.INT)
+        ectx.lambda_bindings = bindings
+        return ectx
+
+    def _cpu_rows(self, ctx: CpuEvalContext):
+        av, am = self.left.eval_cpu(ctx)
+        return av, am
+
+    def _cpu_eval_body(self, ctx: CpuEvalContext, elems, idxs):
+        """Evaluate the body over a flat list of elements; outer refs are
+        broadcast by row id."""
+        n = len(elems)
+        rowids = np.array([r for _, r in elems], dtype=np.int64)
+        cols = []
+        for (v, m) in ctx.cols:
+            cols.append((v[rowids] if n else v[:0],
+                         m[rowids] if n else m[:0]))
+        ectx = CpuEvalContext(cols, n, ctx.schema)
+        et = self.elem_var.dtype
+        evalid = np.array([e is not None for e, _ in elems], np.bool_)
+        if et.variable_width or isinstance(et, T.ArrayType):
+            evals = _obj([e for e, _ in elems])
+        else:
+            evals = np.array([0 if e is None else e for e, _ in elems],
+                             dtype=et.np_dtype)
+        bindings = {self.elem_var.var_id: (evals, evalid)}
+        if self.idx_var is not None:
+            bindings[self.idx_var.var_id] = (
+                np.asarray(idxs, np.int32), np.ones((n,), np.bool_))
+        ectx.lambda_bindings = bindings
+        return self.right.eval_cpu(ectx)
+
+    def _cpu_flat(self, ctx: CpuEvalContext):
+        """(elements flat list [(value,row_id)], idxs, per-row slices)."""
+        av, am = self.left.eval_cpu(ctx)
+        elems, idxs, slices = [], [], []
+        for i in range(len(av)):
+            if not am[i]:
+                slices.append(None)
+                continue
+            start = len(elems)
+            for j, e in enumerate(av[i]):
+                elems.append((e, i))
+                idxs.append(j)
+            slices.append((start, len(elems)))
+        return am, elems, idxs, slices
+
+    def __repr__(self):
+        return (f"{type(self).__name__.lower()}({self.left!r}, "
+                f"{self.elem_var!r} -> {self.right!r})")
+
+
+class ArrayTransform(_HigherOrder):
+    """transform(arr, x -> expr) (GpuArrayTransform)."""
+
+    @property
+    def dtype(self):
+        return T.ArrayType(self.right.dtype)
+
+    def eval(self, ctx: EvalContext):
+        arr = self.left.eval(ctx)
+        ectx = self._element_ctx(ctx, arr)
+        res = self.right.eval(ectx)
+        live = CK.element_live_mask(arr, ctx.batch.num_rows)
+        cvalid = res.validity & live
+        zero = jnp.zeros((), res.data.dtype)
+        data = jnp.where(cvalid, res.data, zero)
+        return DeviceColumn(data, arr.validity, self.dtype, arr.offsets,
+                            cvalid)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        am, elems, idxs, slices = self._cpu_flat(ctx)
+        bv, bm = self._cpu_eval_body(ctx, elems, idxs)
+        out = []
+        for sl in slices:
+            if sl is None:
+                out.append(None)
+                continue
+            s, e = sl
+            row = []
+            for j in range(s, e):
+                if bm[j]:
+                    row.append(bv[j].item() if bv.dtype != object else bv[j])
+                else:
+                    row.append(None)
+            out.append(row)
+        return _obj(out), am.copy()
+
+
+class ArrayFilter(_HigherOrder):
+    """filter(arr, x -> pred) (GpuArrayFilter)."""
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    def eval(self, ctx: EvalContext):
+        arr = self.left.eval(ctx)
+        ectx = self._element_ctx(ctx, arr)
+        pred = self.right.eval(ectx)
+        keep = pred.data & pred.validity
+        return CK.segment_filter(arr, keep, ctx.batch.num_rows)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        am, elems, idxs, slices = self._cpu_flat(ctx)
+        bv, bm = self._cpu_eval_body(ctx, elems, idxs)
+        out = []
+        for sl in slices:
+            if sl is None:
+                out.append(None)
+                continue
+            s, e = sl
+            out.append([elems[j][0] for j in range(s, e)
+                        if bm[j] and bool(bv[j])])
+        return _obj(out), am.copy()
+
+
+class _ExistsForAll(_HigherOrder):
+    IS_EXISTS = True
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def eval(self, ctx: EvalContext):
+        arr = self.left.eval(ctx)
+        ectx = self._element_ctx(ctx, arr)
+        pred = self.right.eval(ectx)
+        live = CK.element_live_mask(arr, ctx.batch.num_rows)
+        rows = CK.element_row_ids(arr)
+        p_true = pred.data & pred.validity & live
+        p_null = (~pred.validity) & live
+        if not self.IS_EXISTS:
+            p_true = (~pred.data) & pred.validity & live  # any FALSE
+        any_hit = jax.ops.segment_max(p_true.astype(jnp.int32), rows,
+                                      num_segments=arr.capacity) > 0
+        any_null = jax.ops.segment_max(p_null.astype(jnp.int32), rows,
+                                       num_segments=arr.capacity) > 0
+        liver = jnp.arange(arr.capacity, dtype=jnp.int32) < ctx.batch.num_rows
+        validity = arr.validity & liver & (any_hit | ~any_null)
+        if self.IS_EXISTS:
+            out = any_hit
+        else:
+            out = ~any_hit
+        return make_column(out, validity, T.BOOLEAN)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        am, elems, idxs, slices = self._cpu_flat(ctx)
+        bv, bm = self._cpu_eval_body(ctx, elems, idxs)
+        out = np.zeros((len(slices),), np.bool_)
+        valid = np.zeros((len(slices),), np.bool_)
+        for i, sl in enumerate(slices):
+            if sl is None:
+                continue
+            s, e = sl
+            hit = any(bm[j] and bool(bv[j]) == self.IS_EXISTS
+                      for j in range(s, e))
+            has_null = any(not bm[j] for j in range(s, e))
+            if hit:
+                out[i] = self.IS_EXISTS
+                valid[i] = True
+            elif not has_null:
+                out[i] = not self.IS_EXISTS
+                valid[i] = True
+        return out, valid
+
+
+class ArrayExists(_ExistsForAll):
+    IS_EXISTS = True
+
+
+class ArrayForAll(_ExistsForAll):
+    IS_EXISTS = False
+
+
+class ArrayAggregate(Expression):
+    """aggregate(arr, init, (acc, x) -> merge) — host-only sequential fold;
+    runs via the CPU bridge on device plans (GpuArrayAggregate)."""
+
+    def __init__(self, arr: Expression, init: Expression, body: Expression,
+                 acc_var: NamedLambdaVariable, elem_var: NamedLambdaVariable):
+        self.children = (arr, init, body)
+        self.acc_var = acc_var
+        self.elem_var = elem_var
+
+    def with_children(self, children):
+        return ArrayAggregate(children[0], children[1], children[2],
+                              self.acc_var, self.elem_var)
+
+    @classmethod
+    def make(cls, arr: Expression, init: Expression, fn: Callable,
+             elem_dtype: T.DataType, acc_dtype: T.DataType):
+        acc = NamedLambdaVariable("acc", acc_dtype)
+        x = NamedLambdaVariable("x", elem_dtype)
+        return cls(arr, init, fn(acc, x), acc, x)
+
+    @property
+    def dtype(self):
+        return self.children[2].dtype
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        av, am = self.children[0].eval_cpu(ctx)
+        iv, im = self.children[1].eval_cpu(ctx)
+        dt = self.dtype
+        n = len(av)
+        out_obj = []
+        valid = np.zeros((n,), np.bool_)
+        for i in range(n):
+            if not am[i]:
+                out_obj.append(None)
+                continue
+            acc_v = iv[i].item() if iv.dtype != object else iv[i]
+            acc_m = bool(im[i])
+            for e in av[i]:
+                cols = [(v[[i]], m[[i]]) for v, m in ctx.cols]
+                ectx = CpuEvalContext(cols, 1, ctx.schema)
+                et = self.elem_var.dtype
+                if et.variable_width:
+                    ev = _obj([e])
+                else:
+                    ev = np.array([0 if e is None else e], dtype=et.np_dtype)
+                adt = self.acc_var.dtype
+                if adt.variable_width:
+                    av_ = _obj([acc_v])
+                else:
+                    av_ = np.array([0 if not acc_m else acc_v],
+                                   dtype=adt.np_dtype)
+                ectx.lambda_bindings = {
+                    self.acc_var.var_id: (av_, np.array([acc_m])),
+                    self.elem_var.var_id: (ev, np.array([e is not None])),
+                }
+                rv, rm = self.children[2].eval_cpu(ectx)
+                acc_m = bool(rm[0])
+                acc_v = (rv[0].item() if rv.dtype != object else rv[0]) \
+                    if acc_m else None
+            out_obj.append(acc_v if acc_m else None)
+            valid[i] = acc_m
+        if dt.variable_width or isinstance(dt, T.ArrayType):
+            return _obj(out_obj), valid
+        out = np.array([0 if v is None else v for v in out_obj],
+                       dtype=dt.np_dtype)
+        return out, valid
+
+    def references(self):
+        return set().union(*(c.references() for c in self.children))
+
+    def __repr__(self):
+        return (f"aggregate({self.children[0]!r}, {self.children[1]!r}, "
+                f"({self.acc_var!r}, {self.elem_var!r}) -> "
+                f"{self.children[2]!r})")
+
+
+# -- generator expressions (planned into TpuGenerateExec) -------------------
+
+
+class Explode(UnaryExpression):
+    """explode(arr) generator (GpuExplode, GpuGenerateExec.scala)."""
+
+    POS = False
+    OUTER = False
+
+    @property
+    def dtype(self):
+        return _elem_dtype(self.child)
+
+
+class PosExplode(Explode):
+    POS = True

@@ -1,0 +1,287 @@
+"""Segmented kernels over array<T> columns.
+
+TPU replacement for cuDF's list-column kernels (reference consumption:
+collectionOperations.scala — GpuSize, GpuArrayContains, GpuSortArray,
+GpuElementAt, GpuSlice; higherOrderFunctions.scala — GpuArrayTransform,
+GpuArrayFilter, GpuArrayExists; GpuGenerateExec.scala — explode/posexplode).
+
+Design: an array column is the same segmented (offsets + flat child buffer)
+layout strings use, so every kernel here is a vectorized computation over the
+flat element buffer plus a `searchsorted(offsets, ...)` element→row map —
+no per-row loops, fully static shapes, MXU/VPU-friendly.  Per-row reductions
+use `jax.ops.segment_*` with the row map as segment ids.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.kernels.selection import OOB
+
+
+def element_row_ids(col: DeviceColumn) -> jax.Array:
+    """int32 [elem_cap] mapping each element slot to its row (clipped)."""
+    ecap = col.byte_capacity
+    pos = jnp.arange(ecap, dtype=jnp.int32)
+    row = jnp.searchsorted(col.offsets, pos, side="right").astype(jnp.int32) - 1
+    return jnp.clip(row, 0, col.capacity - 1)
+
+
+def element_live_mask(col: DeviceColumn, num_rows) -> jax.Array:
+    """bool [elem_cap]: True for element slots belonging to live rows."""
+    ecap = col.byte_capacity
+    pos = jnp.arange(ecap, dtype=jnp.int32)
+    return pos < col.offsets[num_rows]
+
+
+def lengths(col: DeviceColumn) -> jax.Array:
+    """int32 [cap] per-row element counts (0 for null rows by canon)."""
+    return col.offsets[1:] - col.offsets[:-1]
+
+
+def explode_maps(
+    col: DeviceColumn, num_rows, outer: bool, out_capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Gather maps for explode/posexplode over an array column.
+
+    Returns (row_map, elem_map, pos, count):
+      row_map  int32 [out_capacity] — source ROW id per output row (for
+               gathering the child's other columns; OOB past count)
+      elem_map int32 [out_capacity] — source ELEMENT slot per output row
+               (OOB = emit a null element: outer rows with empty/null arrays)
+      pos      int32 [out_capacity] — 0-based position within the array
+      count    int32 scalar — live output rows (true required size; caller
+               checks against out_capacity for the retry framework)
+
+    Row order is preserved and elements stay in array order, matching
+    Spark's GenerateExec row production (GpuGenerateExec.scala:33).
+    """
+    lens = lengths(col)
+    idx = jnp.arange(col.capacity, dtype=jnp.int32)
+    live_row = idx < num_rows
+    if outer:
+        # null/empty arrays still emit one row (with a null element)
+        out_lens = jnp.where(live_row, jnp.maximum(lens, 1), 0)
+    else:
+        out_lens = jnp.where(live_row, lens, 0)
+    out_offsets = jnp.zeros((col.capacity + 1,), jnp.int32).at[1:].set(
+        jnp.cumsum(out_lens))
+    count = out_offsets[col.capacity]
+
+    p = jnp.arange(out_capacity, dtype=jnp.int32)
+    row = jnp.searchsorted(out_offsets, p, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, col.capacity - 1)
+    within = p - out_offsets[row]
+    has_elem = within < lens[row]
+    elem = jnp.where(has_elem, col.offsets[row] + within, OOB)
+    live_out = p < count
+    row_map = jnp.where(live_out, row, OOB)
+    elem_map = jnp.where(live_out, elem, OOB)
+    pos = jnp.where(live_out & has_elem, within, 0)
+    return row_map, elem_map, pos, count
+
+
+def gather_elements(
+    col: DeviceColumn, elem_map: jax.Array, count: jax.Array
+) -> DeviceColumn:
+    """Build the exploded element column: one element value per output row.
+
+    elem_map OOB slots (outer-mode empty arrays, padding) become nulls.
+    """
+    out_cap = elem_map.shape[0]
+    live = jnp.arange(out_cap, dtype=jnp.int32) < count
+    inb = (elem_map >= 0) & (elem_map < col.byte_capacity) & live
+    safe = jnp.where(inb, elem_map, 0)
+    validity = jnp.where(inb, col.child_validity[safe], False)
+    zero = jnp.zeros((), col.data.dtype)
+    data = jnp.where(validity, col.data[safe], zero)
+    return DeviceColumn(data, validity, col.dtype.element_type)
+
+
+def segment_filter(
+    col: DeviceColumn, keep: jax.Array, num_rows
+) -> DeviceColumn:
+    """Keep elements where `keep` (bool [elem_cap]) is True, preserving
+    per-row order; rebuild offsets (GpuArrayFilter)."""
+    rows = element_row_ids(col)
+    live = element_live_mask(col, num_rows)
+    k = keep & live
+    # new per-row counts -> new offsets
+    counts = jax.ops.segment_sum(k.astype(jnp.int32), rows,
+                                 num_segments=col.capacity)
+    new_offsets = jnp.zeros((col.capacity + 1,), jnp.int32).at[1:].set(
+        jnp.cumsum(counts))
+    # stable compaction of kept elements (global order == per-row order
+    # because the element buffer is already row-sorted)
+    ecap = col.byte_capacity
+    ki = k.astype(jnp.int32)
+    dest = jnp.cumsum(ki) - ki
+    src = jnp.arange(ecap, dtype=jnp.int32)
+    emap = jnp.full((ecap,), OOB, dtype=jnp.int32)
+    emap = emap.at[jnp.where(k, dest, ecap)].set(src, mode="drop")
+    total = new_offsets[num_rows]
+    inb = (emap >= 0) & (emap < ecap) & (jnp.arange(ecap, dtype=jnp.int32) < total)
+    safe = jnp.where(inb, emap, 0)
+    cvalid = jnp.where(inb, col.child_validity[safe], False)
+    zero = jnp.zeros((), col.data.dtype)
+    data = jnp.where(cvalid, col.data[safe], zero)
+    return DeviceColumn(data, col.validity, col.dtype, new_offsets, cvalid)
+
+
+def segment_reduce_minmax(
+    col: DeviceColumn, num_rows, is_min: bool
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-row min/max over non-null elements (array_min/array_max).
+
+    Returns (values [cap], validity [cap]); rows whose array is null or has
+    no non-null element are null.  Float semantics follow Spark: NaN is
+    greater than any other value (matches Spark's ordering-based min/max).
+    """
+    rows = element_row_ids(col)
+    live = element_live_mask(col, num_rows)
+    ok = col.child_validity & live
+    dt = col.data.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        # total order: NaN above +inf (Spark/Java compare)
+        big = jnp.array(jnp.inf, dt)
+        nan_rank = jnp.isnan(col.data)
+        data = jnp.where(nan_rank, big, col.data)  # NaN -> +inf for compare
+    else:
+        data = col.data
+    if is_min:
+        fill = (jnp.array(jnp.inf, dt) if jnp.issubdtype(dt, jnp.floating)
+                else jnp.array(jnp.iinfo(dt).max, dt))
+        masked = jnp.where(ok, data, fill)
+        out = jax.ops.segment_min(masked, rows, num_segments=col.capacity)
+    else:
+        fill = (jnp.array(-jnp.inf, dt) if jnp.issubdtype(dt, jnp.floating)
+                else jnp.array(jnp.iinfo(dt).min, dt))
+        masked = jnp.where(ok, data, fill)
+        out = jax.ops.segment_max(masked, rows, num_segments=col.capacity)
+    if jnp.issubdtype(dt, jnp.floating):
+        # restore NaN where the winning value was NaN: max picked +inf that
+        # stood for NaN iff some element was NaN and result == +inf
+        has_nan = jax.ops.segment_max(
+            (jnp.isnan(col.data) & ok).astype(jnp.int32), rows,
+            num_segments=col.capacity) > 0
+        if is_min:
+            all_nan = jax.ops.segment_min(
+                jnp.where(ok, jnp.isnan(col.data).astype(jnp.int32), 1),
+                rows, num_segments=col.capacity) > 0
+            out = jnp.where(all_nan & has_nan, jnp.array(jnp.nan, dt), out)
+        else:
+            out = jnp.where(has_nan, jnp.array(jnp.nan, dt), out)
+    any_ok = jax.ops.segment_max(ok.astype(jnp.int32), rows,
+                                 num_segments=col.capacity) > 0
+    validity = col.validity & any_ok
+    idx = jnp.arange(col.capacity, dtype=jnp.int32)
+    validity = validity & (idx < num_rows)
+    out = jnp.where(validity, out, jnp.zeros((), dt))
+    return out, validity
+
+
+def segment_any_null(col: DeviceColumn, num_rows) -> jax.Array:
+    """bool [cap]: row's array contains at least one null element."""
+    rows = element_row_ids(col)
+    live = element_live_mask(col, num_rows)
+    isnull = (~col.child_validity) & live
+    return jax.ops.segment_max(isnull.astype(jnp.int32), rows,
+                               num_segments=col.capacity) > 0
+
+
+def segment_contains(
+    col: DeviceColumn, value_per_row: jax.Array, value_valid: jax.Array,
+    num_rows,
+) -> Tuple[jax.Array, jax.Array]:
+    """array_contains(arr, v) with Spark null semantics.
+
+    value_per_row: [cap] the needle broadcast per row.  Returns
+    (found bool [cap], validity bool [cap]): null array or null needle ->
+    null; found -> true; not found -> null if array has null elems else
+    false (GpuArrayContains, collectionOperations.scala).
+    """
+    rows = element_row_ids(col)
+    live = element_live_mask(col, num_rows)
+    ok = col.child_validity & live
+    eq = ok & (col.data == value_per_row[rows])
+    found = jax.ops.segment_max(eq.astype(jnp.int32), rows,
+                                num_segments=col.capacity) > 0
+    has_null = segment_any_null(col, num_rows)
+    idx = jnp.arange(col.capacity, dtype=jnp.int32)
+    liver = idx < num_rows
+    validity = col.validity & value_valid & liver & (found | ~has_null)
+    return found & validity, validity
+
+
+def segment_position(
+    col: DeviceColumn, value_per_row: jax.Array, value_valid: jax.Array,
+    num_rows,
+) -> Tuple[jax.Array, jax.Array]:
+    """array_position: 1-based index of first match, 0 if absent; null when
+    array or needle is null."""
+    rows = element_row_ids(col)
+    live = element_live_mask(col, num_rows)
+    ok = col.child_validity & live
+    eq = ok & (col.data == value_per_row[rows])
+    within = jnp.arange(col.byte_capacity, dtype=jnp.int32) - col.offsets[rows]
+    big = jnp.int32(2**31 - 1)
+    cand = jnp.where(eq, within, big)
+    first = jax.ops.segment_min(cand, rows, num_segments=col.capacity)
+    posn = jnp.where(first == big, 0, first + 1).astype(jnp.int64)
+    idx = jnp.arange(col.capacity, dtype=jnp.int32)
+    validity = col.validity & value_valid & (idx < num_rows)
+    return jnp.where(validity, posn, 0), validity
+
+
+def segment_sort(col: DeviceColumn, num_rows, ascending: bool) -> DeviceColumn:
+    """sort_array: sort elements within each row.  Spark semantics: asc ->
+    nulls first, desc -> nulls last (collectionOperations.scala GpuSortArray).
+    """
+    from spark_rapids_tpu.kernels.sort import _data_key_fixed, _null_key
+    from spark_rapids_tpu.kernels.sort import SortOrder
+    rows = element_row_ids(col)
+    live = element_live_mask(col, num_rows)
+    order = SortOrder(ascending=ascending, nulls_first=ascending)
+    ecol = DeviceColumn(col.data, col.child_validity & live,
+                        col.dtype.element_type)
+    dkey = _data_key_fixed(ecol, order)
+    nkey = _null_key(ecol, order)
+    # stable lexsort: primary = row (dead slots sink past every live row),
+    # then null placement, then value
+    rkey = jnp.where(live, rows, jnp.int32(col.capacity))
+    perm = jnp.lexsort((dkey, nkey, rkey))
+    total = col.offsets[num_rows]
+    live_after = jnp.arange(col.byte_capacity, dtype=jnp.int32) < total
+    data = col.data[perm]
+    cvalid = col.child_validity[perm] & live_after
+    zero = jnp.zeros((), col.data.dtype)
+    data = jnp.where(cvalid, data, zero)
+    return DeviceColumn(data, col.validity, col.dtype, col.offsets, cvalid)
+
+
+def segment_distinct(col: DeviceColumn, num_rows) -> DeviceColumn:
+    """array_distinct: drop duplicate values per row, keeping FIRST
+    occurrence order (Spark semantics).  One null element is kept."""
+    rows = element_row_ids(col)
+    live = element_live_mask(col, num_rows)
+    ecap = col.byte_capacity
+    within = jnp.arange(ecap, dtype=jnp.int32) - col.offsets[rows]
+    # sort by (row, validity desc? no: value, then position) to find, per
+    # duplicate group, the smallest position
+    vkey = col.data
+    nullk = (~col.child_validity).astype(jnp.int32)
+    rkey = jnp.where(live, rows, jnp.int32(col.capacity))
+    perm = jnp.lexsort((within, vkey, nullk, rkey))
+    srow = rkey[perm]
+    sval = vkey[perm]
+    snull = nullk[perm]
+    slive = live[perm]
+    prev_same = (jnp.arange(ecap) > 0) & (srow == jnp.roll(srow, 1)) & \
+                (sval == jnp.roll(sval, 1)) & (snull == jnp.roll(snull, 1))
+    first_occurrence = slive & ~prev_same
+    # map back to element order: keep[perm[i]] = first_occurrence[i]
+    keep = jnp.zeros((ecap,), jnp.bool_).at[perm].set(first_occurrence)
+    return segment_filter(col, keep, num_rows)

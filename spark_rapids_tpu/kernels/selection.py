@@ -106,14 +106,15 @@ def gather_column(
     safe = jnp.where(inb, idx, 0)
     validity = jnp.where(inb, col.validity[safe], False)
 
-    if not col.is_string_like:
+    if col.offsets is None:
         data = jnp.where(validity, col.data[safe], jnp.zeros((), col.data.dtype))
         return DeviceColumn(data, validity, col.dtype)
 
-    # strings: rebuild offsets from gathered lengths, then gather bytes.
-    # NOTE: gathered bytes may exceed out_byte_capacity (repeated indices);
-    # use gather_column_checked / gather_batch_checked when indices can
-    # repeat — the unchecked variant truncates silently.
+    # strings/arrays: rebuild offsets from gathered lengths, then gather the
+    # child buffer (bytes for strings, elements for arrays).
+    # NOTE: gathered child slots may exceed out_byte_capacity (repeated
+    # indices); use gather_batch_checked when indices can repeat — the
+    # unchecked variant truncates silently.
     starts = col.offsets[:-1]
     lengths = col.offsets[1:] - starts
     glen = jnp.where(validity, lengths[safe], 0)
@@ -122,14 +123,20 @@ def gather_column(
     total = new_offsets[out_cap]
 
     bcap = out_byte_capacity if out_byte_capacity is not None else col.byte_capacity
-    # for each output byte position, find its row then its source byte
+    # for each output child position, find its row then its source position
     bpos = jnp.arange(bcap, dtype=jnp.int32)
     row = jnp.searchsorted(new_offsets, bpos, side="right").astype(jnp.int32) - 1
     row = jnp.clip(row, 0, out_cap - 1)
     within = bpos - new_offsets[row]
     src_byte = starts[safe[row]] + within
     src_byte = jnp.clip(src_byte, 0, col.data.shape[0] - 1)
-    data = jnp.where(bpos < total, col.data[src_byte], jnp.uint8(0))
+    zero = jnp.zeros((), dtype=col.data.dtype)
+    live_child = bpos < total
+    data = jnp.where(live_child, col.data[src_byte], zero)
+    if col.child_validity is not None:
+        cvalid = jnp.where(live_child, col.child_validity[src_byte], False)
+        data = jnp.where(cvalid, data, zero)
+        return DeviceColumn(data, validity, col.dtype, new_offsets, cvalid)
     return DeviceColumn(data, validity, col.dtype, new_offsets)
 
 
@@ -175,7 +182,7 @@ def gather_batch_checked(
     with grown capacities (the retry framework's capacity-split path).
     """
     out_cap = out_capacity if out_capacity is not None else indices.shape[0]
-    string_cols = [i for i, c in enumerate(batch.columns) if c.is_string_like]
+    string_cols = [i for i, c in enumerate(batch.columns) if c.offsets is not None]
     byte_caps = dict(zip(
         string_cols,
         out_byte_capacities if out_byte_capacities is not None
@@ -243,6 +250,9 @@ def concat_batches_device(
             stacked_off = jnp.stack([c.offsets for c in cols])        # [n_in, cap+1]
             stacked_dat = jnp.stack([c.data for c in cols])           # [n_in, bcap]
             stacked_val = jnp.stack([c.validity for c in cols])       # [n_in, cap]
+            is_arr = cols[0].child_validity is not None
+            if is_arr:
+                stacked_cval = jnp.stack([c.child_validity for c in cols])
             out_bcap = sum(c.byte_capacity for c in cols)
             pos = jnp.arange(out_capacity, dtype=jnp.int32)
             which = jnp.searchsorted(offs, pos, side="right").astype(jnp.int32) - 1
@@ -258,9 +268,17 @@ def concat_batches_device(
                             0, out_capacity - 1)
             src_in_batch = stacked_off[which[brow], within[brow]] + (bpos - new_offsets[brow])
             src_in_batch = jnp.clip(src_in_batch, 0, cols[0].byte_capacity - 1)
-            data = jnp.where(bpos < new_offsets[out_capacity],
-                             stacked_dat[which[brow], src_in_batch], jnp.uint8(0))
-            out_cols.append(DeviceColumn(data, validity, dtype, new_offsets))
+            zero = jnp.zeros((), stacked_dat.dtype)
+            live_child = bpos < new_offsets[out_capacity]
+            data = jnp.where(live_child,
+                             stacked_dat[which[brow], src_in_batch], zero)
+            if is_arr:
+                cval = jnp.where(live_child,
+                                 stacked_cval[which[brow], src_in_batch], False)
+                data = jnp.where(cval, data, zero)
+                out_cols.append(DeviceColumn(data, validity, dtype, new_offsets, cval))
+            else:
+                out_cols.append(DeviceColumn(data, validity, dtype, new_offsets))
         else:
             stacked = jnp.stack([c.data for c in cols])               # [n_in, cap]
             stacked_val = jnp.stack([c.validity for c in cols])

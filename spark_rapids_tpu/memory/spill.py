@@ -51,6 +51,8 @@ def _batch_to_host(batch: ColumnarBatch) -> Tuple[dict, Schema]:
         arrays[f"valid_{i}"] = np.array(col.validity, copy=True)
         if col.offsets is not None:
             arrays[f"offsets_{i}"] = np.array(col.offsets, copy=True)
+        if col.child_validity is not None:
+            arrays[f"cvalid_{i}"] = np.array(col.child_validity, copy=True)
     arrays["num_rows"] = np.array(batch.num_rows, copy=True)
     return arrays, batch.schema
 
@@ -63,6 +65,7 @@ def _host_to_batch(arrays: dict, schema: Schema) -> ColumnarBatch:
             validity=jnp.asarray(arrays[f"valid_{i}"]),
             dtype=dtype,
             offsets=jnp.asarray(arrays[f"offsets_{i}"]) if f"offsets_{i}" in arrays else None,
+            child_validity=jnp.asarray(arrays[f"cvalid_{i}"]) if f"cvalid_{i}" in arrays else None,
         ))
     return ColumnarBatch(tuple(cols), jnp.asarray(arrays["num_rows"], dtype=jnp.int32), schema)
 

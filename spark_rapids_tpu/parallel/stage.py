@@ -73,6 +73,17 @@ class IciQueryExecutor:
 
     def execute(self, root) -> List[ColumnarBatch]:
         """Run the plan; returns the result as a list of host-side batches."""
+        from spark_rapids_tpu import types as T
+
+        def _no_arrays(node):
+            if any(isinstance(d, T.ArrayType) for d in node.schema.dtypes):
+                # the SPMD exchange kernels route variable-width data by
+                # string byte layout; array child buffers need their own
+                # redistribution step (follow-on) — task engine handles them
+                raise UnsupportedSpmd("array column in SPMD stage")
+            for c in node.children:
+                _no_arrays(c)
+        _no_arrays(root)
         inputs, in_kinds = [], []
         caps = _Caps()
         string_bucket = 0

@@ -374,6 +374,43 @@ class CpuEngine:
                 buckets[p].append(t.take(np.nonzero(assign == p)[0]))
         return [CpuTable.concat(bs, plan.schema) for bs in buckets]
 
+    def _exec_generate(self, plan: L.Generate):
+        """Row-wise explode/posexplode oracle (GpuGenerateExec semantics)."""
+        gen = plan.generator
+        out = []
+        for t in self._exec(plan.child):
+            av, am = gen.child.eval_cpu(t.ctx())
+            rows_idx, poss, elems = [], [], []
+            for i in range(t.num_rows):
+                arr = av[i] if am[i] else None
+                if arr:
+                    for j, e in enumerate(arr):
+                        rows_idx.append(i)
+                        poss.append(j)
+                        elems.append(e)
+                elif plan.outer:
+                    rows_idx.append(i)
+                    poss.append(None)
+                    elems.append(None)
+            idx = np.array(rows_idx, np.int64)
+            base = t.take(idx)
+            cols = list(base.cols)
+            if gen.POS:
+                pv = np.array([0 if p is None else p for p in poss], np.int32)
+                pm = np.array([p is not None for p in poss], np.bool_)
+                cols.append((pv, pm))
+            et = gen.dtype
+            em = np.array([e is not None for e in elems], np.bool_)
+            if et.variable_width or isinstance(et, T.ArrayType):
+                ev = np.empty((len(elems),), object)
+                ev[:] = elems
+            else:
+                ev = np.array([0 if e is None else e for e in elems],
+                              dtype=et.np_dtype)
+            cols.append((ev, em))
+            out.append(CpuTable(cols, len(idx), plan.schema))
+        return out
+
     def _exec_mapbatches(self, plan: L.MapBatches):
         from spark_rapids_tpu.columnar.arrow import arrow_to_batch
         out = []

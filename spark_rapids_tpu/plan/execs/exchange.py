@@ -66,6 +66,12 @@ class TpuShuffleExchangeExec(TpuExec):
         super().__init__((child,), schema or child.schema)
         self.out_partitions = num_partitions
         self.keys = tuple(keys)
+        from spark_rapids_tpu import types as T
+        if mode == "MULTITHREADED" and any(
+                isinstance(d, T.ArrayType) for d in self.schema.dtypes):
+            # the kudo wire format carries fixed-width + string columns;
+            # array payloads stay device-resident (CACHE_ONLY slices)
+            mode = "CACHE_ONLY"
         self.mode = mode
         self.writer_threads = writer_threads
         self.codec = codec

@@ -21,8 +21,12 @@ def cpu_table_to_batch(table) -> ColumnarBatch:
     """CpuTable -> device ColumnarBatch upload."""
     import jax.numpy as jnp
     cols: List[DeviceColumn] = []
+    from spark_rapids_tpu import types as T
     for (vals, valid), dt in zip(table.cols, table.schema.dtypes):
-        if dt.variable_width:
+        if isinstance(dt, T.ArrayType):
+            cols.append(DeviceColumn.from_arrays(
+                [v if m else None for v, m in zip(vals, valid)], dt))
+        elif dt.variable_width:
             cols.append(DeviceColumn.from_strings(
                 list(vals), validity=valid, dtype=dt))
         else:

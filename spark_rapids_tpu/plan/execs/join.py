@@ -61,13 +61,14 @@ class _JoinKernel:
             lambda: jitted(out_capacity, byte_caps, bucket))
 
     def _string_out_cols(self, l: ColumnarBatch, r: ColumnarBatch):
-        """output ordinal -> source byte capacity for string columns."""
+        """output ordinal -> source child capacity for variable-width
+        (string/array) columns."""
         out = {}
         idx = 0
         sides = [l] if self.join_type in ("left_semi", "left_anti") else [l, r]
         for side in sides:
             for c in side.columns:
-                if c.is_string_like:
+                if c.offsets is not None:
                     out[idx] = c.byte_capacity
                 idx += 1
         return out

@@ -254,6 +254,41 @@ class Join(LogicalPlan):
         return f"Join[{self.join_type}, {keys}{cond}]"
 
 
+class Generate(LogicalPlan):
+    """Generator node: child rows × generator output (Spark GenerateExec,
+    reference GpuGenerateExec.scala:33).  Output = child columns + [pos] +
+    the generated element column."""
+
+    def __init__(self, generator: Expression, child: LogicalPlan,
+                 outer: bool = False, alias: str = "col",
+                 pos_alias: str = "pos"):
+        from spark_rapids_tpu.expressions.collections import Explode
+        self.generator = generator.bind(child.schema)
+        assert isinstance(self.generator, Explode), \
+            f"unsupported generator: {generator!r}"
+        self.outer = outer
+        self.alias = alias
+        self.pos_alias = pos_alias
+        self.child = child
+        self.children = (child,)
+        names = list(child.schema.names)
+        dtypes = list(child.schema.dtypes)
+        if self.generator.POS:
+            names.append(pos_alias)
+            dtypes.append(T.INT)
+        names.append(alias)
+        dtypes.append(self.generator.dtype)
+        self._schema = Schema(tuple(names), tuple(dtypes))
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        kind = "posexplode" if self.generator.POS else "explode"
+        return f"Generate[{'outer ' if self.outer else ''}{kind}({self.generator.child!r})]"
+
+
 class Union(LogicalPlan):
     def __init__(self, plans: Sequence[LogicalPlan]):
         assert plans

@@ -124,6 +124,20 @@ _SUPPORTED_EXPRS |= {
     A.BoolAnd, A.BoolOr,
 }
 
+from spark_rapids_tpu.expressions.collections import (
+    ArrayContains, ArrayDistinct, ArrayExists, ArrayFilter, ArrayForAll,
+    ArrayMax, ArrayMin, ArrayPosition, ArrayRemove, ArrayRepeat,
+    ArrayTransform, CreateArray, ElementAt, Explode, GetArrayItem,
+    NamedLambdaVariable, PosExplode, Size, Slice, SortArray, _HigherOrder)
+
+_SUPPORTED_EXPRS |= {
+    Size, ArrayContains, ArrayPosition, GetArrayItem, ElementAt,
+    ArrayMin, ArrayMax, SortArray, ArrayDistinct, ArrayRemove, Slice,
+    CreateArray, ArrayRepeat,
+    ArrayTransform, ArrayFilter, ArrayExists, ArrayForAll,
+    NamedLambdaVariable, Explode, PosExplode,
+}
+
 # dtypes device kernels support in expression compute
 _COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
                T.LongType, T.FloatType, T.DoubleType, T.DateType,
@@ -135,6 +149,13 @@ def _dtype_ok(dt: T.DataType) -> bool:
         # Decimal64 fast path (Spark's long-backed decimals); 128-bit
         # two-limb kernels are the follow-on
         return dt.precision <= T.DecimalType.MAX_LONG_DIGITS
+    if isinstance(dt, T.ArrayType):
+        # array<fixed-width> uses the segmented string layout; nested
+        # arrays / array<string> need child-offset stacking (follow-on)
+        et = dt.element_type
+        return (et is not None and not et.variable_width
+                and not isinstance(et, (T.ArrayType, T.StructType, T.MapType))
+                and _dtype_ok(et))
     return isinstance(dt, _COMPUTE_OK)
 
 
@@ -301,6 +322,59 @@ class ExprMeta:
                     self.will_not_work(
                         f"regex over {e.children[0]!r}: only non-growing "
                         "string inputs supported (project it first)")
+            if isinstance(e, (ArrayContains, ArrayPosition, ArrayRemove)):
+                try:
+                    if e.right.dtype.variable_width:
+                        self.will_not_work(
+                            f"{type(e).__name__} needle must be fixed-width")
+                except (TypeError, ValueError, NotImplementedError):
+                    pass
+            if isinstance(e, SortArray) and not isinstance(
+                    e.right, E.Literal):
+                self.will_not_work("sort_array direction must be a literal")
+            if isinstance(e, ArrayRepeat):
+                if not isinstance(e.right, E.Literal):
+                    self.will_not_work(
+                        "array_repeat count must be a literal (static "
+                        "element bound)")
+                elif e.right.value is not None and int(e.right.value) > 1 << 16:
+                    self.will_not_work(
+                        "array_repeat count exceeds the static bound")
+            if isinstance(e, (ArrayMin, ArrayMax)):
+                try:
+                    et = e.child.dtype.element_type
+                    if isinstance(et, T.BooleanType):
+                        self.will_not_work(
+                            f"{type(e).__name__} over boolean elements")
+                except (TypeError, ValueError, NotImplementedError,
+                        AttributeError):
+                    pass
+            if isinstance(e, CreateArray):
+                try:
+                    if len({repr(c.dtype) for c in e.children}) > 1:
+                        self.will_not_work(
+                            "array() elements must share one type "
+                            "(add explicit casts)")
+                except (TypeError, ValueError, NotImplementedError):
+                    pass
+            if isinstance(e, _HigherOrder):
+                body = e.right
+
+                def _body_bad(x) -> Optional[str]:
+                    if isinstance(x, _HigherOrder):
+                        return "nested higher-order functions"
+                    if isinstance(x, E.BoundReference):
+                        if x.dtype.variable_width:
+                            return (f"lambda body references variable-width "
+                                    f"outer column {x!r}")
+                    for c in x.children:
+                        r = _body_bad(c)
+                        if r:
+                            return r
+                    return None
+                bad = _body_bad(body)
+                if bad:
+                    self.will_not_work(f"{bad} not supported on device")
         for c in self.children:
             c.tag()
 
@@ -334,7 +408,7 @@ class PlanMeta:
         self.conf = conf
         self.children = [PlanMeta(c, conf) for c in plan.children]
         self.reasons: List[str] = []
-        allow_bridge = isinstance(plan, (L.Project, L.Filter))
+        allow_bridge = isinstance(plan, (L.Project, L.Filter, L.Generate))
         self.expr_metas: List[ExprMeta] = [
             ExprMeta(e, conf, allow_bridge) for e in self._expressions()]
 
@@ -346,6 +420,8 @@ class PlanMeta:
             return list(p.exprs)
         if isinstance(p, L.Filter):
             return [p.condition]
+        if isinstance(p, L.Generate):
+            return [p.generator]
         if isinstance(p, L.Aggregate):
             return list(p.group_exprs) + list(p.agg_exprs)
         if isinstance(p, L.Sort):
@@ -491,6 +567,11 @@ class PlanMeta:
         if isinstance(p, L.Filter):
             cond = self.expr_metas[0].transformed()
             return TpuFilterExec(cond, self.children[0].convert())
+        if isinstance(p, L.Generate):
+            from spark_rapids_tpu.plan.execs.generate import TpuGenerateExec
+            gen = self.expr_metas[0].transformed()
+            return TpuGenerateExec(gen, p.outer, self.children[0].convert(),
+                                   p.schema)
         if isinstance(p, L.Union):
             return TpuUnionExec(tuple(c.convert() for c in self.children),
                                 p.schema)

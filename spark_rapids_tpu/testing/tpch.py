@@ -27,15 +27,23 @@ def _days(y, m, d) -> int:
     return (datetime.date(y, m, d) - EPOCH).days
 
 
+# Money/quantity columns are decimal(12,2) — the official TPC-H schema.
+# This matters doubly on TPU: the axon backend emulates float64 (double-
+# double over f32 pairs) and is NOT bit-exact, so predicate boundaries like
+# `l_discount >= 0.05` can flip whole value buckets under f64; decimal64
+# columns are int64 on device, making filters/joins/group-bys exact.  Sums
+# of products still run in f64 (within differential tolerance).
+DEC12_2 = T.DecimalType(12, 2)
+
 LINEITEM_SCHEMA = Schema.of(
     l_orderkey=T.LONG,
     l_partkey=T.LONG,
     l_suppkey=T.LONG,
     l_linenumber=T.INT,
-    l_quantity=T.DOUBLE,
-    l_extendedprice=T.DOUBLE,
-    l_discount=T.DOUBLE,
-    l_tax=T.DOUBLE,
+    l_quantity=DEC12_2,
+    l_extendedprice=DEC12_2,
+    l_discount=DEC12_2,
+    l_tax=DEC12_2,
     l_shipdate=T.DATE,
     l_commitdate=T.DATE,
     l_receiptdate=T.DATE,
@@ -58,10 +66,12 @@ def gen_lineitem(num_rows: int, seed: int = 42,
         partkey = rng.randint(1, 200_000, n).astype(np.int64)
         suppkey = rng.randint(1, 10_000, n).astype(np.int64)
         linenumber = rng.randint(1, 8, n).astype(np.int32)
-        quantity = rng.randint(1, 51, n).astype(np.float64)
-        extendedprice = np.round(rng.uniform(900.0, 105_000.0, n), 2)
-        discount = np.round(rng.randint(0, 11, n) * 0.01, 2)
-        tax = np.round(rng.randint(0, 9, n) * 0.01, 2)
+        # unscaled decimal(12,2) ints: value = unscaled / 100
+        quantity = (rng.randint(1, 51, n) * 100).astype(np.int64)
+        extendedprice = np.round(
+            rng.uniform(900.0, 105_000.0, n) * 100).astype(np.int64)
+        discount = rng.randint(0, 11, n).astype(np.int64)
+        tax = rng.randint(0, 9, n).astype(np.int64)
         ship_lo, ship_hi = _days(1992, 1, 2), _days(1998, 12, 1)
         shipdate = rng.randint(ship_lo, ship_hi, n).astype(np.int32)
         commitdate = shipdate + rng.randint(-30, 31, n).astype(np.int32)
@@ -99,32 +109,39 @@ def q6(df):
     where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01'
       and l_discount between 0.05 and 0.07 and l_quantity < 24
     """
-    from spark_rapids_tpu.expressions import col, lit, sum_
+    from spark_rapids_tpu.expressions import Cast, col, lit, sum_
     d94 = _days(1994, 1, 1)
     d95 = _days(1995, 1, 1)
+    # decimal predicates compare unscaled int64 on device (exact on TPU);
+    # the product runs in f64 (decimal(12,2)^2 would need decimal128)
+    price = Cast(col("l_extendedprice"), T.DOUBLE)
+    disc = Cast(col("l_discount"), T.DOUBLE)
     return (df.filter(
                 (col("l_shipdate") >= lit(d94, T.DATE))
                 & (col("l_shipdate") < lit(d95, T.DATE))
-                & (col("l_discount") >= lit(0.05))
-                & (col("l_discount") <= lit(0.07))
-                & (col("l_quantity") < lit(24.0)))
-            .agg((sum_(col("l_extendedprice") * col("l_discount")))
-                 .alias("revenue")))
+                & (col("l_discount") >= lit(5, DEC12_2))
+                & (col("l_discount") <= lit(7, DEC12_2))
+                & (col("l_quantity") < lit(2400, DEC12_2)))
+            .agg((sum_(price * disc)).alias("revenue")))
 
 
 def q1(df):
     """TPC-H Q1: pricing summary report (scan + filter + wide group-agg)."""
-    from spark_rapids_tpu.expressions import avg, col, count, lit, sum_
+    from spark_rapids_tpu.expressions import Cast, avg, col, count, lit, sum_
     cutoff = _days(1998, 9, 2)
-    disc_price = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
-    charge = disc_price * (lit(1.0) + col("l_tax"))
+    qty = Cast(col("l_quantity"), T.DOUBLE)
+    price = Cast(col("l_extendedprice"), T.DOUBLE)
+    disc = Cast(col("l_discount"), T.DOUBLE)
+    tax = Cast(col("l_tax"), T.DOUBLE)
+    disc_price = price * (lit(1.0) - disc)
+    charge = disc_price * (lit(1.0) + tax)
     return (df.filter(col("l_shipdate") <= lit(cutoff, T.DATE))
             .group_by("l_linenumber")     # stand-in flags until strings land
-            .agg(sum_("l_quantity").alias("sum_qty"),
-                 sum_("l_extendedprice").alias("sum_base_price"),
+            .agg(sum_(qty).alias("sum_qty"),
+                 sum_(price).alias("sum_base_price"),
                  sum_(disc_price).alias("sum_disc_price"),
                  sum_(charge).alias("sum_charge"),
-                 avg("l_quantity").alias("avg_qty"),
-                 avg("l_extendedprice").alias("avg_price"),
-                 avg("l_discount").alias("avg_disc"),
+                 avg(qty).alias("avg_qty"),
+                 avg(price).alias("avg_price"),
+                 avg(disc).alias("avg_disc"),
                  count().alias("count_order")))

@@ -1,0 +1,239 @@
+"""Array/collection expressions + Generate exec: device vs CPU-oracle
+differential tests.
+
+Reference strategy: integration_tests/src/main/python/collection_ops_test.py
+and generate_expr_test.py — same op surface, assert_gpu_and_cpu_are_equal
+pattern (here: device engine vs CpuEngine on identical inputs).
+"""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.core import Alias
+from spark_rapids_tpu.expressions.collections import (
+    ArrayAggregate, ArrayContains, ArrayDistinct, ArrayExists, ArrayFilter,
+    ArrayForAll, ArrayMax, ArrayMin, ArrayPosition, ArrayRemove, ArrayRepeat,
+    ArraysOverlap, ArrayTransform, CreateArray, ElementAt, GetArrayItem,
+    Sequence, Size, Slice, SortArray)
+
+SCHEMA = Schema.of(a=T.ArrayType(T.INT), b=T.ArrayType(T.DOUBLE), x=T.INT)
+DATA = {
+    "a": [[1, 2, 3], [None, 5], None, [], [7, 7, 2, None, 2], [0], [9, -3]],
+    "b": [[1.5, float("nan")], None, [2.0], [], [-0.0, 0.0, None],
+          [float("nan"), 1.0], [3.25]],
+    "x": [10, 20, None, 40, 50, 60, 70],
+}
+
+
+def both(fn):
+    tpu = TpuSession({"spark.rapids.sql.enabled": "true"})
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    got = fn(tpu)
+    expect = fn(cpu)
+    assert len(got) == len(expect), (got, expect)
+    def eq(gv, ev):
+        if isinstance(gv, float) and isinstance(ev, float):
+            return (gv != gv and ev != ev) or gv == ev
+        if isinstance(gv, list) and isinstance(ev, list):
+            return len(gv) == len(ev) and all(eq(a, b) for a, b in zip(gv, ev))
+        return gv == ev
+
+    for g, e in zip(got, expect):
+        assert len(g) == len(e), (g, e)
+        for gv, ev in zip(g, e):
+            assert eq(gv, ev), (g, e)
+    return got
+
+
+def _df(sess, data=None, schema=None, parts=1):
+    return sess.create_dataframe(data or DATA, schema or SCHEMA,
+                                 num_partitions=parts)
+
+
+def test_size_contains_element():
+    rows = both(lambda s: _df(s).select(
+        Alias(Size(col("a")), "sz"),
+        Alias(ArrayContains(col("a"), lit(2)), "c"),
+        Alias(ElementAt(col("a"), lit(2)), "e2"),
+        Alias(ElementAt(col("a"), lit(-1)), "em1"),
+        Alias(GetArrayItem(col("a"), lit(0)), "g0"),
+        Alias(ArrayPosition(col("a"), lit(2)), "p"),
+    ).collect())
+    assert rows[0] == (3, True, 2, 3, 1, 2)
+    assert rows[2] == (-1, None, None, None, None, None)
+
+
+def test_minmax_sort_distinct_remove():
+    both(lambda s: _df(s).select(
+        Alias(ArrayMin(col("a")), "mn"),
+        Alias(ArrayMax(col("a")), "mx"),
+        Alias(SortArray(col("a"), lit(True)), "sa"),
+        Alias(SortArray(col("a"), lit(False)), "sd"),
+        Alias(ArrayDistinct(col("a")), "dd"),
+        Alias(ArrayRemove(col("a"), lit(2)), "rm"),
+    ).collect())
+
+
+def test_float_minmax_nan_semantics():
+    both(lambda s: _df(s).select(
+        Alias(ArrayMin(col("b")), "mn"),
+        Alias(ArrayMax(col("b")), "mx"),
+    ).collect())
+
+
+def test_slice_repeat_create():
+    both(lambda s: _df(s).select(
+        Alias(Slice(col("a"), lit(1), lit(2)), "s12"),
+        Alias(Slice(col("a"), lit(-2), lit(5)), "sm2"),
+        Alias(Slice(col("a"), lit(3), lit(0)), "s30"),
+        Alias(ArrayRepeat(col("x"), lit(3)), "rp"),
+        Alias(CreateArray(col("x"), col("x") + lit(1), lit(None, T.INT)), "ca"),
+    ).collect())
+
+
+def test_explode_inner_and_outer():
+    both(lambda s: _df(s).explode(col("a"), alias="e").collect())
+    both(lambda s: _df(s).explode(col("a"), alias="e", outer=True).collect())
+
+
+def test_posexplode_and_downstream_agg():
+    # explode feeds a group-by: Generate composes with exchange + aggregate
+    def q(s):
+        df = _df(s, parts=2).explode(col("a"), alias="e", pos=True)
+        return (df.group_by(col("e"))
+                  .agg(Alias(__import__("spark_rapids_tpu.expressions",
+                                        fromlist=["sum_"]).sum_(col("pos")), "sp"))
+                  .order_by(col("e")).collect())
+    both(q)
+
+
+def test_explode_computed_array():
+    both(lambda s: _df(s).explode(
+        CreateArray(col("x"), col("x") * lit(2)), alias="e").collect())
+
+
+def test_transform_filter_exists_forall():
+    both(lambda s: _df(s).select(
+        Alias(ArrayTransform.make(col("a"), lambda x: x * lit(2)), "t"),
+        Alias(ArrayTransform.make(col("a"), lambda x: x + col("x")), "tc"),
+        Alias(ArrayTransform.make(col("a"), lambda x, i: x * lit(0) + i), "ti"),
+        Alias(ArrayFilter.make(col("a"), lambda x: x > lit(2)), "f"),
+        Alias(ArrayExists.make(col("a"), lambda x: x > lit(4)), "ex"),
+        Alias(ArrayForAll.make(col("a"), lambda x: x > lit(0)), "fa"),
+    ).collect())
+
+
+def test_bridge_only_collection_ops():
+    """sequence / arrays_overlap / aggregate run via the CPU bridge on the
+    device engine (no device kernels — data-dependent output bounds)."""
+    def q(s):
+        return _df(s).select(
+            Alias(Sequence(lit(1), col("x") % lit(4) + lit(1)), "sq"),
+            Alias(ArraysOverlap(col("a"), CreateArray(lit(2), lit(9))), "ov"),
+            Alias(ArrayAggregate.make(
+                col("a"), lit(0), lambda acc, x: acc + x,
+                T.INT, T.INT), "ag"),
+        ).collect()
+    both(q)
+
+
+def test_bridge_explain_mentions_bridge():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = _df(s).select(Alias(Sequence(lit(1), col("x")), "sq"))
+    assert "CPU bridge" in df.explain()
+
+
+def test_arrays_ride_through_shuffle_and_sort():
+    def q(s):
+        df = _df(s, parts=3).repartition(4, col("x"))
+        return df.order_by(col("x")).collect()
+    both(q)
+
+
+def test_arrays_through_join_payload():
+    def q(s):
+        left = _df(s, parts=2)
+        right = s.create_dataframe(
+            {"x": [10, 20, 50], "y": [1.0, 2.0, 5.0]},
+            Schema.of(x=T.INT, y=T.DOUBLE))
+        return left.join(right, on=["x"]).order_by(col("x")).collect()
+    both(q)
+
+
+def test_arrays_filter_union_limit():
+    def q(s):
+        df = _df(s).filter(Size(col("a")) > lit(1))
+        return df.union(_df(s)).limit(8).collect()
+    both(q)
+
+
+def test_explode_empty_partition():
+    def q(s):
+        df = s.create_dataframe(
+            {"a": [], "b": [], "x": []}, SCHEMA)
+        return df.explode(col("a")).collect()
+    both(q)
+
+
+def test_array_roundtrip_arrow():
+    import pyarrow as pa
+    b = ColumnarBatch.from_pydict(DATA, SCHEMA)
+    t = b.to_arrow()
+    assert t.column("a").to_pylist() == DATA["a"]
+    back = ColumnarBatch.from_arrow(t)
+    assert back.to_pydict()["a"] == DATA["a"]
+    assert back.to_pydict()["b"][0][0] == 1.5
+
+
+def test_posexplode_outer_null_pos():
+    """pos must be NULL (not 0) for outer-generated empty/null-array rows."""
+    rows = both(lambda s: _df(s).explode(
+        col("a"), alias="e", pos=True, outer=True).collect())
+    null_rows = [r for r in rows if r[-1] is None and r[0] in (None, [])]
+    assert null_rows and all(r[-2] is None for r in null_rows), rows
+
+
+def test_array_repeat_null_count():
+    both(lambda s: _df(s).select(
+        Alias(ArrayRepeat(col("x"), lit(None, T.INT)), "r")).collect())
+
+
+def test_slice_negative_overshoot_is_empty():
+    rows = both(lambda s: _df(s).select(
+        Alias(Slice(col("a"), lit(-50), lit(2)), "s")).collect())
+    assert rows[0] == ([],)
+
+
+def test_hof_rebind_does_not_mutate():
+    """Binding the same lambda against two schemas must not corrupt the
+    first bound copy (expression immutability)."""
+    t = ArrayTransform.make(col("a"), lambda x: x * lit(2))
+    s1 = Schema.of(a=T.ArrayType(T.INT))
+    s2 = Schema.of(a=T.ArrayType(T.DOUBLE))
+    b1 = t.bind(s1)
+    b2 = t.bind(s2)
+    assert repr(b1.elem_var.dtype) == "int", b1.elem_var.dtype
+    assert repr(b2.elem_var.dtype) == "double", b2.elem_var.dtype
+    assert repr(b1.dtype) == "array<int>"
+
+
+def test_array_spill_roundtrip():
+    from spark_rapids_tpu.memory.spill import _batch_to_host, _host_to_batch
+    b = ColumnarBatch.from_pydict(DATA, SCHEMA)
+    arrays, schema = _batch_to_host(b)
+    back = _host_to_batch(arrays, schema)
+    assert back.columns[0].is_array
+    assert back.to_pydict()["a"] == DATA["a"]
+
+
+def test_explode_grows_capacity():
+    # one row with a big array: output rows >> input capacity forces the
+    # capacity-escalation path
+    n = 300
+    data = {"a": [list(range(n)), [1]], "x": [1, 2]}
+    sch = Schema.of(a=T.ArrayType(T.INT), x=T.INT)
+    rows = both(lambda s: s.create_dataframe(data, sch)
+                .explode(col("a")).collect())
+    assert len(rows) == n + 1

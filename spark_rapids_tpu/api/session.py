@@ -99,6 +99,13 @@ class TpuSession:
                  **options) -> "DataFrame":
         return self._read_file(paths, "orc", columns, schema, **options)
 
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: int = 1) -> "DataFrame":
+        """spark.range analog: device-generated LONG ids (GpuRangeExec)."""
+        if end is None:
+            start, end = 0, start
+        return DataFrame(L.Range(start, end, step, num_partitions), self)
+
     def read_delta(self, table_path: str,
                    version: Optional[int] = None) -> "DataFrame":
         from spark_rapids_tpu.io.delta import load_snapshot
@@ -107,14 +114,54 @@ class TpuSession:
 
 
 class GroupedData:
-    def __init__(self, df: "DataFrame", keys: Sequence[Expression]):
+    def __init__(self, df: "DataFrame", keys: Sequence[Expression],
+                 grouping_sets=None):
         self.df = df
         self.keys = [_to_expr(k) for k in keys]
+        #: None = plain group-by; else list of frozensets of included key
+        #: ordinals (rollup/cube/grouping sets)
+        self.grouping_sets = grouping_sets
 
     def agg(self, *aggs) -> "DataFrame":
-        return DataFrame(
-            L.Aggregate(self.keys, [_to_expr(a) for a in aggs],
-                        self.df.plan), self.df.session)
+        if self.grouping_sets is None:
+            return DataFrame(
+                L.Aggregate(self.keys, [_to_expr(a) for a in aggs],
+                            self.df.plan), self.df.session)
+        return self._grouping_sets_agg([_to_expr(a) for a in aggs])
+
+    def _grouping_sets_agg(self, aggs) -> "DataFrame":
+        """rollup/cube: Expand (one projection per grouping set, excluded
+        keys nulled + a grouping-id column) -> Aggregate on keys+gid ->
+        project the gid away.  Spark's ExpandExec+Aggregate plan shape
+        (reference GpuExpandExec.scala)."""
+        from spark_rapids_tpu.expressions.core import Col, Literal
+        child = self.df.plan
+        key_names = []
+        for k in self.keys:
+            assert isinstance(k, Col), "rollup/cube keys must be columns"
+            key_names.append(k.name)
+        nkeys = len(key_names)
+        names = list(child.schema.names) + ["_gid"]
+        projections = []
+        for included in self.grouping_sets:
+            gid = 0
+            for i in range(nkeys):
+                if i not in included:
+                    gid |= 1 << (nkeys - 1 - i)
+            proj = []
+            for n in child.schema.names:
+                if n in key_names and key_names.index(n) not in included:
+                    proj.append(Literal(None, child.schema.dtype_of(n)))
+                else:
+                    proj.append(col(n))
+            proj.append(Literal(gid, T.INT))
+            projections.append(proj)
+        expanded = L.Expand(projections, names, child)
+        # _gid participates in grouping but not in the output (Spark drops
+        # spark_grouping_id unless grouping_id() is selected explicitly)
+        agg = L.Aggregate(list(self.keys) + [col("_gid")], aggs, expanded)
+        keep = [col(n) for n in agg.schema.names if n != "_gid"]
+        return DataFrame(L.Project(keep, agg), self.df.session)
 
     def apply_in_pandas(self, fn, schema: Schema) -> "DataFrame":
         """pyspark applyInPandas analog (grouped map): repartition on the
@@ -180,6 +227,40 @@ class DataFrame:
         exprs = [col(n) for n in self.schema.names if n != name]
         exprs.append(e.alias(name))
         return self.select(*exprs)
+
+    def rollup(self, *keys) -> GroupedData:
+        """Hierarchical grouping sets: (k1..kn), (k1..kn-1), ..., ()."""
+        n = len(keys)
+        sets = [frozenset(range(i)) for i in range(n, -1, -1)]
+        return GroupedData(self, [_to_expr(k) for k in keys],
+                           grouping_sets=sets)
+
+    def cube(self, *keys) -> GroupedData:
+        """All 2^n grouping-set combinations of the keys."""
+        import itertools
+        n = len(keys)
+        sets = [frozenset(c) for r in range(n, -1, -1)
+                for c in itertools.combinations(range(n), r)]
+        return GroupedData(self, [_to_expr(k) for k in keys],
+                           grouping_sets=sets)
+
+    def expand(self, projections, names) -> "DataFrame":
+        """Raw Expand node (one output row per projection per input row)."""
+        return DataFrame(
+            L.Expand([[_to_expr(e) for e in p] for p in projections],
+                     list(names), self.plan), self.session)
+
+    def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
+        return DataFrame(L.Sample(fraction, seed, self.plan), self.session)
+
+    def persist(self) -> "DataFrame":
+        """Materialize once and reuse (the InMemoryTableScan / cached
+        batch analog: reference GpuInMemoryTableScanExec.scala).  Batches
+        are collected per partition on the current engine and become an
+        InMemoryRelation source for subsequent queries."""
+        parts = self.collect_partitions()
+        return DataFrame(L.InMemoryRelation(
+            [list(p) for p in parts], self.schema), self.session)
 
     def group_by(self, *keys) -> GroupedData:
         return GroupedData(self, [_to_expr(k) for k in keys])

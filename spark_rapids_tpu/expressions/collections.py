@@ -100,7 +100,7 @@ class ArrayContains(BinaryExpression):
             if not am[i] or not bm[i]:
                 continue
             row = av[i]
-            needle = bv[i] if bv.dtype != object else bv[i]
+            needle = bv[i]
             hit = any(e is not None and e == needle for e in row)
             has_null = any(e is None for e in row)
             if hit:
@@ -327,8 +327,12 @@ class ArrayDistinct(UnaryExpression):
                     if not saw_null:
                         saw_null = True
                         row.append(None)
-                elif e not in seen:
-                    seen.add(e)
+                    continue
+                # Spark equality: NaN == NaN, -0.0 == 0.0
+                k = "nan" if (isinstance(e, float) and e != e) \
+                    else (e + 0 if isinstance(e, float) else e)
+                if k not in seen:
+                    seen.add(k)
                     row.append(e)
             out.append(row)
         return _obj(out), am.copy()
@@ -543,7 +547,8 @@ class ArraysOverlap(BinaryExpression):
             aset = {e for e in av[i] if e is not None}
             bset = {e for e in bv[i] if e is not None}
             hit = bool(aset & bset)
-            anull = len(aset) != len(av[i]) or len(bset) != len(bv[i])
+            anull = (any(e is None for e in av[i])
+                     or any(e is None for e in bv[i]))
             if hit:
                 out[i] = True
                 valid[i] = True

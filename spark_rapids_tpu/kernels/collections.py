@@ -272,6 +272,17 @@ def segment_distinct(col: DeviceColumn, num_rows) -> DeviceColumn:
     # sort by (row, validity desc? no: value, then position) to find, per
     # duplicate group, the smallest position
     vkey = col.data
+    if jnp.issubdtype(vkey.dtype, jnp.floating):
+        # Spark equality for distinct: -0.0 == 0.0, NaN == NaN — normalize
+        # to canonical bit patterns before the bitwise group compare
+        # -0.0 -> 0.0 (an explicit select: XLA folds x+0.0 to x, which
+        # would keep the sign bit)
+        x = jnp.where(vkey == 0, jnp.zeros((), vkey.dtype), vkey)
+        uint = jnp.uint64 if x.dtype == jnp.float64 else jnp.uint32
+        bits = jax.lax.bitcast_convert_type(x, uint)
+        nan_bits = jax.lax.bitcast_convert_type(
+            jnp.array(jnp.nan, x.dtype), uint)
+        vkey = jnp.where(jnp.isnan(x), nan_bits, bits)
     nullk = (~col.child_validity).astype(jnp.int32)
     rkey = jnp.where(live, rows, jnp.int32(col.capacity))
     perm = jnp.lexsort((within, vkey, nullk, rkey))

@@ -374,6 +374,48 @@ class CpuEngine:
                 buckets[p].append(t.take(np.nonzero(assign == p)[0]))
         return [CpuTable.concat(bs, plan.schema) for bs in buckets]
 
+    def _exec_expand(self, plan: L.Expand):
+        out = []
+        for t in self._exec(plan.child):
+            pieces = []
+            for proj in plan.projections:
+                cols = []
+                for e, dt in zip(proj, plan.schema.dtypes):
+                    v, m = e.eval_cpu(t.ctx())
+                    if v.dtype == object and not (dt.variable_width
+                                                  or isinstance(dt, T.ArrayType)):
+                        v = np.array([0 if x is None else x for x in v],
+                                     dtype=dt.np_dtype)
+                    elif v.dtype != object and not dt.variable_width \
+                            and not isinstance(dt, T.ArrayType) \
+                            and v.dtype != np.dtype(dt.np_dtype):
+                        v = v.astype(dt.np_dtype)
+                    cols.append((v, m))
+                pieces.append(CpuTable(cols, t.num_rows, plan.schema))
+            out.append(CpuTable.concat(pieces, plan.schema))
+        return out
+
+    def _exec_range(self, plan: L.Range):
+        total = max(0, -(-(plan.end - plan.start) // plan.step))
+        per = -(-total // plan.num_partitions)
+        out = []
+        for p in range(plan.num_partitions):
+            lo = plan.start + p * per * plan.step
+            n = min(per, max(0, total - p * per))
+            vals = lo + np.arange(n, dtype=np.int64) * plan.step
+            out.append(CpuTable([(vals, np.ones((n,), np.bool_))], n,
+                                plan.schema))
+        return out
+
+    def _exec_sample(self, plan: L.Sample):
+        from spark_rapids_tpu.plan.execs.misc import sample_mask_uniform
+        out = []
+        for p, t in enumerate(self._exec(plan.child)):
+            u = sample_mask_uniform(plan.seed, p, 0, t.num_rows, np)
+            keep = np.nonzero(u < plan.fraction)[0]
+            out.append(t.take(keep))
+        return out
+
     def _exec_generate(self, plan: L.Generate):
         """Row-wise explode/posexplode oracle (GpuGenerateExec semantics)."""
         gen = plan.generator

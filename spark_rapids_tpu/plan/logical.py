@@ -289,6 +289,83 @@ class Generate(LogicalPlan):
         return f"Generate[{'outer ' if self.outer else ''}{kind}({self.generator.child!r})]"
 
 
+class Expand(LogicalPlan):
+    """Each input row emitted once per projection (Spark ExpandExec;
+    reference GpuExpandExec.scala).  The substrate for rollup/cube."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str], child: LogicalPlan):
+        assert projections and all(
+            len(p) == len(names) for p in projections)
+        self.projections = tuple(
+            tuple(e.bind(child.schema) for e in p) for p in projections)
+        self.child = child
+        self.children = (child,)
+        dtypes = []
+        for i in range(len(names)):
+            dts = [p[i].dtype for p in self.projections]
+            dt = dts[0]
+            for d in dts[1:]:
+                if isinstance(dt, T.NullType):
+                    dt = d
+                else:
+                    assert isinstance(d, T.NullType) or d == dt, \
+                        f"expand column {names[i]}: {d!r} vs {dt!r}"
+            dtypes.append(dt)
+        self._schema = Schema(tuple(names), tuple(dtypes))
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"Expand[{len(self.projections)} projections]"
+
+
+class Range(LogicalPlan):
+    """Device-generated id range (Spark RangeExec; GpuRangeExec in
+    basicPhysicalOperators.scala:526)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1):
+        assert step != 0
+        self.start, self.end, self.step = int(start), int(end), int(step)
+        self.num_partitions = max(int(num_partitions), 1)
+        self.children = ()
+        self._schema = Schema(("id",), (T.LONG,))
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"Range[{self.start}, {self.end}, step={self.step}]"
+
+
+class Sample(LogicalPlan):
+    """Bernoulli row sampling (Spark SampleExec; GpuSampleExec).
+
+    Deterministic hash-based row selection keyed on (seed, partition,
+    row offset) — the device and oracle engines agree bit-for-bit; the
+    sequence differs from Spark's XORShiftRandom draw order (the reference
+    GPU sampler also re-draws on device rather than replaying the CPU
+    stream)."""
+
+    def __init__(self, fraction: float, seed: int, child: LogicalPlan):
+        assert 0.0 <= fraction <= 1.0
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return f"Sample[{self.fraction}, seed={self.seed}]"
+
+
 class Union(LogicalPlan):
     def __init__(self, plans: Sequence[LogicalPlan]):
         assert plans

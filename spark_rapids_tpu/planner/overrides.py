@@ -174,6 +174,11 @@ def _key_expr_ok(e: "E.Expression") -> bool:
         return False
     if not _dtype_ok(dt):
         return False
+    if isinstance(dt, T.ArrayType):
+        # arrays have no sort/hash key encoding yet (row-equality over
+        # nested data needs child-aware comparators; reference gates this
+        # per-op in TypeSig too)
+        return False
     if dt.variable_width:
         while isinstance(e, E.Alias):
             e = e.child
@@ -422,6 +427,8 @@ class PlanMeta:
             return [p.condition]
         if isinstance(p, L.Generate):
             return [p.generator]
+        if isinstance(p, L.Expand):
+            return [e for proj in p.projections for e in proj]
         if isinstance(p, L.Aggregate):
             return list(p.group_exprs) + list(p.agg_exprs)
         if isinstance(p, L.Sort):
@@ -572,6 +579,21 @@ class PlanMeta:
             gen = self.expr_metas[0].transformed()
             return TpuGenerateExec(gen, p.outer, self.children[0].convert(),
                                    p.schema)
+        if isinstance(p, L.Expand):
+            from spark_rapids_tpu.plan.execs.misc import TpuExpandExec
+            k = len(p.projections[0])
+            transformed = [em.transformed() for em in self.expr_metas]
+            projs = [transformed[i * k:(i + 1) * k]
+                     for i in range(len(p.projections))]
+            return TpuExpandExec(projs, self.children[0].convert(), p.schema)
+        if isinstance(p, L.Range):
+            from spark_rapids_tpu.plan.execs.misc import TpuRangeExec
+            return TpuRangeExec(p.start, p.end, p.step, p.num_partitions,
+                                p.schema, self.conf.batch_size_rows)
+        if isinstance(p, L.Sample):
+            from spark_rapids_tpu.plan.execs.misc import TpuSampleExec
+            return TpuSampleExec(p.fraction, p.seed,
+                                 self.children[0].convert())
         if isinstance(p, L.Union):
             return TpuUnionExec(tuple(c.convert() for c in self.children),
                                 p.schema)

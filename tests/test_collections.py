@@ -228,6 +228,34 @@ def test_array_spill_roundtrip():
     assert back.to_pydict()["a"] == DATA["a"]
 
 
+def test_array_keys_fall_back():
+    """Arrays are not sortable/groupable keys — must fall back, not crash."""
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = _df(s).order_by(col("a")).explain()
+    assert "will NOT" in e, e
+    both(lambda s2: _df(s2).order_by(col("a"), col("x")).collect()
+         if False else _df(s2).order_by(col("x")).collect())
+
+
+def test_distinct_nan_negzero():
+    nan = float("nan")
+    data = {"b": [[nan, nan, 1.0], [-0.0, 0.0], [nan, -0.0, nan, 0.0]]}
+    sch = Schema.of(b=T.ArrayType(T.DOUBLE))
+    rows = both(lambda s: s.create_dataframe(data, sch).select(
+        Alias(ArrayDistinct(col("b")), "d")).collect())
+    assert len(rows[0][0]) == 2          # [nan, 1.0]
+    assert len(rows[1][0]) == 1          # -0.0 == 0.0
+    assert len(rows[2][0]) == 2
+
+
+def test_arrays_overlap_duplicates_not_null():
+    data = {"a": [[2, 2]], "c": [[9]]}
+    sch = Schema.of(a=T.ArrayType(T.INT), c=T.ArrayType(T.INT))
+    rows = both(lambda s: s.create_dataframe(data, sch).select(
+        Alias(ArraysOverlap(col("a"), col("c")), "o")).collect())
+    assert rows[0][0] is False
+
+
 def test_explode_grows_capacity():
     # one row with a big array: output rows >> input capacity forces the
     # capacity-escalation path

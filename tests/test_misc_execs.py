@@ -1,0 +1,96 @@
+"""Expand / Range / Sample / rollup / cube / persist differential tests.
+
+Reference strategy: integration_tests hash_aggregate_test.py (rollup/cube),
+sample_test.py, expand_exec_test.py.
+"""
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expressions import col, lit, sum_, count, avg
+from spark_rapids_tpu.expressions.core import Alias, Literal
+from tests.test_queries import assert_tpu_cpu_equal
+
+SCHEMA = Schema.of(k=T.INT, g=T.INT, v=T.LONG)
+
+
+def _df(s, n=300, parts=3, nulls=True):
+    rng = np.random.RandomState(7)
+    k = rng.randint(0, 5, n).tolist()
+    g = rng.randint(0, 3, n).tolist()
+    v = rng.randint(-100, 100, n).tolist()
+    if nulls:
+        for i in rng.choice(n, n // 10, replace=False):
+            k[i] = None
+    batches = []
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    for o in range(0, n, 64):
+        batches.append(ColumnarBatch.from_pydict(
+            {"k": k[o:o+64], "g": g[o:o+64], "v": v[o:o+64]}, SCHEMA))
+    return s.create_dataframe(batches, num_partitions=parts)
+
+
+def test_range():
+    assert_tpu_cpu_equal(lambda s: s.range(100), ignore_order=False)
+    assert_tpu_cpu_equal(lambda s: s.range(5, 64, 3, num_partitions=4))
+    assert_tpu_cpu_equal(lambda s: s.range(10, 0, -2))
+    rows = assert_tpu_cpu_equal(
+        lambda s: s.range(1000, num_partitions=3)
+        .filter(col("id") % lit(7) == lit(0))
+        .agg(Alias(count(), "n"), Alias(sum_(col("id")), "s")))
+    assert rows[0][0] == 143
+
+
+def test_expand_raw():
+    assert_tpu_cpu_equal(lambda s: _df(s).expand(
+        [[col("k"), col("v"), lit(0)],
+         [Literal(None, T.INT), col("v"), lit(1)]],
+        ["k", "v", "tag"]))
+
+
+def test_rollup():
+    rows = assert_tpu_cpu_equal(lambda s: _df(s).rollup("k", "g").agg(
+        Alias(sum_(col("v")), "s"), Alias(count(), "n")))
+    # grand-total row present exactly once
+    totals = [r for r in rows if r[0] is None and r[1] is None and
+              r[3] == 300]
+    assert len(totals) == 1, rows
+
+
+def test_cube():
+    rows = assert_tpu_cpu_equal(lambda s: _df(s).cube("k", "g").agg(
+        Alias(count(), "n"), Alias(avg(col("v")), "a")))
+    # cube has (k,g), (k), (g), () slices; () slice counts all rows
+    assert any(r[0] is None and r[1] is None and r[2] == 300 for r in rows)
+
+
+def test_sample():
+    rows = assert_tpu_cpu_equal(
+        lambda s: _df(s, n=1000, parts=2).sample(0.25, seed=11))
+    assert 150 < len(rows) < 350
+    # deterministic across runs
+    rows2 = assert_tpu_cpu_equal(
+        lambda s: _df(s, n=1000, parts=2).sample(0.25, seed=11))
+    assert rows == rows2
+    assert_tpu_cpu_equal(lambda s: _df(s).sample(0.0))
+    assert len(assert_tpu_cpu_equal(
+        lambda s: _df(s, n=100, parts=1).sample(1.0))) == 100
+
+
+def test_persist_reuse():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    cached = _df(s).filter(col("g") == lit(1)).persist()
+    a = cached.agg(Alias(count(), "n")).collect()
+    b = cached.agg(Alias(count(), "n")).collect()
+    assert a == b
+    o = TpuSession({"spark.rapids.sql.enabled": "false"})
+    expect = _df(o).filter(col("g") == lit(1)).agg(
+        Alias(count(), "n")).collect()
+    assert a == expect
+
+
+def test_rollup_plan_uses_expand_on_device():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = _df(s).rollup("k").agg(Alias(count(), "n")).explain()
+    assert "Expand" in e and "will NOT" not in e, e

@@ -141,29 +141,38 @@ class GroupedData:
             assert isinstance(k, Col), "rollup/cube keys must be columns"
             key_names.append(k.name)
         nkeys = len(key_names)
-        names = list(child.schema.names) + ["_gid"]
+        # Spark's ExpandExec keeps the original attributes (aggregate
+        # inputs read them un-nulled) and adds SEPARATE per-set nulled
+        # grouping copies + the grouping id
+        names = (list(child.schema.names)
+                 + [f"_gk{i}" for i in range(nkeys)] + ["_gid"])
         projections = []
         for included in self.grouping_sets:
             gid = 0
             for i in range(nkeys):
                 if i not in included:
                     gid |= 1 << (nkeys - 1 - i)
-            proj = []
-            for n in child.schema.names:
-                if n in key_names and key_names.index(n) not in included:
-                    proj.append(Literal(None, child.schema.dtype_of(n)))
+            proj = [col(n) for n in child.schema.names]
+            for i, kn in enumerate(key_names):
+                if i in included:
+                    proj.append(col(kn))
                 else:
-                    proj.append(col(n))
+                    proj.append(Literal(None, child.schema.dtype_of(kn)))
             proj.append(Literal(gid, T.INT))
             projections.append(proj)
         expanded = L.Expand(projections, names, child)
-        # _gid participates in grouping but not in the output (Spark drops
-        # spark_grouping_id unless grouping_id() is selected explicitly)
-        agg = L.Aggregate(list(self.keys) + [col("_gid")], aggs, expanded)
+        # group on the nulled copies + _gid; _gid stays out of the output
+        # (Spark drops spark_grouping_id unless grouping_id() is selected)
+        from spark_rapids_tpu.expressions.core import Alias
+        group_keys = [Alias(col(f"_gk{i}"), key_names[i])
+                      for i in range(nkeys)] + [col("_gid")]
+        agg = L.Aggregate(group_keys, aggs, expanded)
         keep = [col(n) for n in agg.schema.names if n != "_gid"]
         return DataFrame(L.Project(keep, agg), self.df.session)
 
     def apply_in_pandas(self, fn, schema: Schema) -> "DataFrame":
+        assert self.grouping_sets is None, \
+            "rollup/cube support agg() only (Spark parity)"
         """pyspark applyInPandas analog (grouped map): repartition on the
         grouping keys, then fn(pandas.DataFrame) per key group.
         Reference: GpuFlatMapGroupsInPandasExec."""
@@ -252,6 +261,26 @@ class DataFrame:
 
     def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
         return DataFrame(L.Sample(fraction, seed, self.plan), self.session)
+
+    def build_bloom(self, expr, expected_items: int, fpp: float = 0.03):
+        """Build a Spark-wire-compatible bloom filter over a LONG column —
+        the build half of the runtime-filter pair (BloomFilterAggregate;
+        reference GpuBloomFilter.scala).  Probe with
+        expressions.hashing.BloomFilterMightContain(value_expr, bloom)."""
+        import numpy as np
+        from spark_rapids_tpu.expressions.core import Alias
+        from spark_rapids_tpu.kernels import bloom as BK
+        num_bits = BK.optimal_num_bits(expected_items, fpp)
+        k = BK.optimal_num_hashes(expected_items, num_bits)
+        parts = self.select(Alias(_to_expr(expr), "_b")).collect_partitions()
+        bits = None
+        for part in parts:
+            for b in part:
+                bits = BK.build_bits(b.columns[0], b.num_rows, num_bits, k,
+                                     bits)
+        host = (np.asarray(bits) if bits is not None
+                else np.zeros((num_bits,), np.bool_))
+        return BK.PyBloomFilter(num_bits, k, np.array(host, copy=True))
 
     def persist(self) -> "DataFrame":
         """Materialize once and reuse (the InMemoryTableScan / cached

@@ -44,6 +44,16 @@ def _obj(vals) -> np.ndarray:
     return out
 
 
+def _sql_eq(a, b) -> bool:
+    """Spark SQL equality on host values: NaN == NaN, -0.0 == 0.0."""
+    if a is None or b is None:
+        return False
+    if isinstance(a, float) and isinstance(b, float):
+        if a != a and b != b:
+            return True
+    return a == b
+
+
 def _elem_dtype(e: Expression) -> T.DataType:
     dt = e.dtype
     assert isinstance(dt, T.ArrayType), dt
@@ -101,7 +111,7 @@ class ArrayContains(BinaryExpression):
                 continue
             row = av[i]
             needle = bv[i]
-            hit = any(e is not None and e == needle for e in row)
+            hit = any(_sql_eq(e, needle) for e in row)
             has_null = any(e is None for e in row)
             if hit:
                 out[i] = True
@@ -134,7 +144,7 @@ class ArrayPosition(BinaryExpression):
             if not valid[i]:
                 continue
             for j, e in enumerate(av[i]):
-                if e is not None and e == bv[i]:
+                if _sql_eq(e, bv[i]):
                     out[i] = j + 1
                     break
         return out, valid
@@ -350,7 +360,8 @@ class ArrayRemove(BinaryExpression):
         arr = self.left.eval(ctx)
         val = self.right.eval(ctx)
         rows = CK.element_row_ids(arr)
-        keep = ~(arr.child_validity & (arr.data == val.data[rows]))
+        keep = ~(arr.child_validity
+                 & CK.elem_equals(arr.data, val.data[rows]))
         out = CK.segment_filter(arr, keep, ctx.batch.num_rows)
         validity = out.validity & val.validity
         return DeviceColumn(out.data, validity, out.dtype, out.offsets,
@@ -365,7 +376,8 @@ class ArrayRemove(BinaryExpression):
             if not valid[i]:
                 out.append(None)
                 continue
-            out.append([e for e in av[i] if e is None or e != bv[i]])
+            out.append([e for e in av[i]
+                        if e is None or not _sql_eq(e, bv[i])])
         return _obj(out), valid
 
 
@@ -544,8 +556,14 @@ class ArraysOverlap(BinaryExpression):
         for i in range(len(av)):
             if not (am[i] and bm[i]):
                 continue
-            aset = {e for e in av[i] if e is not None}
-            bset = {e for e in bv[i] if e is not None}
+            def _k(e):
+                if isinstance(e, float):
+                    if e != e:
+                        return "nan"
+                    return e + 0
+                return e
+            aset = {_k(e) for e in av[i] if e is not None}
+            bset = {_k(e) for e in bv[i] if e is not None}
             hit = bool(aset & bset)
             anull = (any(e is None for e in av[i])
                      or any(e is None for e in bv[i]))

@@ -1,0 +1,156 @@
+"""Hash + sketch expression twins: murmur3 hash(), xxhash64(), bloom
+might_contain, and approx_count_distinct (HLL++).
+
+Reference: HashFunctions.scala (GpuMurmur3Hash, GpuXxHash64),
+GpuBloomFilterMightContain.scala, aggregate/GpuHyperLogLogPlusPlus.scala.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expressions.core import (
+    CpuEvalContext, EvalContext, Expression, UnaryExpression, make_column)
+from spark_rapids_tpu.kernels import hash as HK
+
+
+class _HashBase(Expression):
+    """hash(e1, ..., en) with a static seed."""
+
+    SEED = 42
+    OUT = T.INT
+
+    def __init__(self, *children: Expression, seed: Optional[int] = None):
+        assert children, "hash() needs at least one input"
+        self.children = tuple(children)
+        self.seed = self.SEED if seed is None else int(seed)
+
+    def with_children(self, children):
+        return type(self)(*children, seed=self.seed)
+
+    @property
+    def dtype(self):
+        return self.OUT
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def uses_string_bucket(self):
+        """String inputs hash through a [rows, bucket] byte tile; the exec
+        threads the static bucket via EvalContext (base.py regex_bucket)."""
+        try:
+            return any(getattr(c.dtype, "variable_width", False)
+                       for c in self.children)
+        except (TypeError, ValueError, NotImplementedError):
+            return False
+
+    def _device_cols(self, ctx: EvalContext) -> List[DeviceColumn]:
+        return [c.eval(ctx) for c in self.children]
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        evs = [c.eval_cpu(ctx) for c in self.children]
+        dts = [c.dtype for c in self.children]
+        n = len(evs[0][0])
+        out = np.zeros((n,), self.OUT.np_dtype)
+        for r in range(n):
+            vals = [None if not m[r] else
+                    (v[r] if v.dtype == object else v[r].item())
+                    for v, m in evs]
+            out[r] = self._py_row(vals, dts)
+        return out, np.ones((n,), np.bool_)
+
+    def __repr__(self):
+        return (f"{type(self).__name__.lower()}"
+                f"({', '.join(map(repr, self.children))})")
+
+
+class Murmur3Hash(_HashBase):
+    """Spark hash(...) — Murmur3_x86_32, seed 42."""
+
+    OUT = T.INT
+
+    def eval(self, ctx: EvalContext):
+        cols = self._device_cols(ctx)
+        h = HK.murmur3_hash(cols, seed=self.seed,
+                            string_max_bytes=max(ctx.string_bucket, 4) or 64)
+        return make_column(h, ctx.live_mask(), T.INT)
+
+    def _py_row(self, vals, dts):
+        return HK.py_murmur3_row(vals, dts, seed=self.seed)
+
+
+class XxHash64(_HashBase):
+    """Spark xxhash64(...) — XXH64, seed 42."""
+
+    OUT = T.LONG
+
+    def eval(self, ctx: EvalContext):
+        cols = self._device_cols(ctx)
+        h = HK.xxhash64(cols, seed=self.seed,
+                        string_max_bytes=max(ctx.string_bucket, 4) or 64)
+        return make_column(h, ctx.live_mask(), T.LONG)
+
+    def _py_row(self, vals, dts):
+        return HK.py_xxhash64_row(vals, dts, seed=self.seed)
+
+
+class BloomFilterMightContain(UnaryExpression):
+    """might_contain(<built filter>, value) — the probe half of the
+    runtime-filter pair (GpuBloomFilterMightContain.scala).
+
+    The filter is a host-side PyBloomFilter (from DataFrame.build_bloom or
+    kernels.bloom.deserialize of Spark's wire bytes); its bit vector enters
+    jitted programs via the trace-consts protocol.
+    """
+
+    def __init__(self, child: Expression, bloom):
+        super().__init__(child)
+        self.bloom = bloom      # PyBloomFilter
+        self._bits_dev = None
+
+    def with_children(self, children):
+        return BloomFilterMightContain(children[0], self.bloom)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def trace_consts(self):
+        if self._bits_dev is None:
+            self._bits_dev = jnp.asarray(self.bloom.bits)
+        return [self._bits_dev]
+
+    def eval(self, ctx: EvalContext):
+        from spark_rapids_tpu.kernels import bloom as BK
+        c = self.child.eval(ctx)
+        consts = ctx.trace_consts.get(id(self))
+        bits = consts[0] if consts else self.trace_consts()[0]
+        hit = BK.might_contain(bits, c, self.bloom.k)
+        validity = c.validity & ctx.live_mask()
+        return make_column(hit & validity, validity, T.BOOLEAN)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, m = self.child.eval_cpu(ctx)
+        out = np.zeros((len(v),), np.bool_)
+        for i in range(len(v)):
+            if m[i]:
+                out[i] = self.bloom.might_contain(int(v[i]))
+        return out, m.copy()
+
+    def __repr__(self):
+        return f"might_contain({self.child!r})"
+
+
+# HLL++ helpers live in kernels/hll.py; re-exported for the aggregate decl
+from spark_rapids_tpu.kernels.hll import (  # noqa: F401
+    estimate_np as hll_estimate_np,
+    p_from_rsd as hll_p_from_rsd,
+    update_np as hll_update_np,
+)

@@ -1,0 +1,167 @@
+"""Bloom filter build/probe with Spark BloomFilterImpl semantics.
+
+Reference: GpuBloomFilter.scala + GpuBloomFilterMightContain.scala (the
+runtime-filter join pushdown pair) over Spark's
+`org.apache.spark.util.sketch.BloomFilterImpl`.
+
+Spark's put/mightContain for longs:
+    h1 = Murmur3_x86_32.hashLong(item, 0)
+    h2 = Murmur3_x86_32.hashLong(item, h1)
+    for i in 1..k: combined = h1 + i*h2; if combined < 0: combined = ~combined
+                   bit = combined % numBits
+and the serialized stream (java DataOutputStream, big-endian) is
+    int version=1, int numHashFunctions, int numWords, long[numWords] words
+— both reproduced here bit-for-bit, so a filter built on TPU matches one
+built by Spark on the same input modulo word layout, and `serialize` output
+can be fed to Spark's BloomFilterImpl.readFrom.
+
+TPU design: the bit array lives as a bool[numBits] device vector during
+build (scatter-set, then OR-merge across batches); the probe is a pure
+gather — both shapes XLA handles natively.  Word packing happens only at
+serialization time on host.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.kernels.hash import (
+    _hash_long, py_hash_long)
+
+
+def optimal_num_bits(expected_items: int, fpp: float = 0.03) -> int:
+    """Spark BloomFilter.optimalNumOfBits."""
+    import math
+    n = max(expected_items, 1)
+    bits = int(-n * math.log(fpp) / (math.log(2) ** 2))
+    # Spark's BitArray allocates whole 64-bit words and bitSize() is
+    # words*64 — the modulo in the hash walk uses the rounded size
+    return max(64, (bits + 63) // 64 * 64)
+
+
+def optimal_num_hashes(expected_items: int, num_bits: int) -> int:
+    """Spark BloomFilter.optimalNumOfHashFunctions."""
+    import math
+    n = max(expected_items, 1)
+    k = int(round(num_bits / n * math.log(2)))
+    return max(1, k)
+
+
+def _bit_positions(values_u64, validity, num_bits: int, k: int):
+    """[k, capacity] bit indices for each value (Spark combined-hash walk)."""
+    zero = jnp.zeros_like(values_u64, dtype=jnp.uint32)
+    h1 = _hash_long(values_u64, zero)
+    h2 = _hash_long(values_u64, h1)
+    h1i = h1.astype(jnp.int32)
+    h2i = h2.astype(jnp.int32)
+    outs = []
+    for i in range(1, k + 1):
+        combined = h1i + jnp.int32(i) * h2i
+        combined = jnp.where(combined < 0, ~combined, combined)
+        outs.append(combined.astype(jnp.int64) % num_bits)
+    return jnp.stack(outs), validity
+
+
+def build_bits(col: DeviceColumn, num_rows, num_bits: int, k: int,
+               bits: Optional[jax.Array] = None) -> jax.Array:
+    """Fold one LONG column into a bool[num_bits] filter (jit-safe)."""
+    v = col.data.astype(jnp.int64).astype(jnp.uint64)
+    live = (jnp.arange(col.capacity, dtype=jnp.int32) < num_rows)
+    valid = col.validity & live
+    pos, _ = _bit_positions(v, valid, num_bits, k)
+    if bits is None:
+        bits = jnp.zeros((num_bits,), jnp.bool_)
+    drop = jnp.int64(num_bits)   # scatter target for masked rows
+    for i in range(pos.shape[0]):
+        idx = jnp.where(valid, pos[i], drop)
+        bits = bits.at[idx].set(True, mode="drop")
+    return bits
+
+
+def might_contain(bits: jax.Array, col: DeviceColumn, k: int) -> jax.Array:
+    """bool [capacity]: True when all k bits are set (possible member)."""
+    num_bits = bits.shape[0]
+    v = col.data.astype(jnp.int64).astype(jnp.uint64)
+    pos, _ = _bit_positions(v, col.validity, num_bits, k)
+    hit = jnp.ones((col.capacity,), jnp.bool_)
+    for i in range(pos.shape[0]):
+        hit = hit & bits[pos[i]]
+    return hit
+
+
+def serialize(bits_np: np.ndarray, k: int) -> bytes:
+    """Spark BloomFilterImpl.writeTo stream (version 1, big-endian)."""
+    num_bits = bits_np.shape[0]
+    num_words = (num_bits + 63) // 64
+    words = np.zeros((num_words,), dtype=np.uint64)
+    set_idx = np.nonzero(bits_np)[0]
+    np.bitwise_or.at(words, set_idx // 64,
+                     (np.uint64(1) << (set_idx % 64).astype(np.uint64)))
+    out = [struct.pack(">iii", 1, k, num_words)]
+    out.append(words.astype(">u8").tobytes())
+    return b"".join(out)
+
+
+def deserialize(buf: bytes):
+    """-> (bits bool ndarray, k)."""
+    version, k, num_words = struct.unpack(">iii", buf[:12])
+    assert version == 1, f"unsupported bloom version {version}"
+    words = np.frombuffer(buf[12:12 + num_words * 8], dtype=">u8") \
+        .astype(np.uint64)
+    num_bits = num_words * 64
+    idx = np.arange(num_bits, dtype=np.uint64)
+    bits = (words[idx // 64] >> (idx % 64)) & np.uint64(1)
+    return bits.astype(np.bool_), k
+
+
+# -- python oracle -----------------------------------------------------------
+
+def py_bit_positions(value: int, num_bits: int, k: int):
+    h1 = py_hash_long(value, 0)
+    h2 = py_hash_long(value, h1)
+    h1 = h1 - (1 << 32) if h1 >= (1 << 31) else h1
+    h2 = h2 - (1 << 32) if h2 >= (1 << 31) else h2
+    out = []
+    for i in range(1, k + 1):
+        combined = h1 + i * h2
+        combined &= 0xFFFFFFFF
+        if combined >= (1 << 31):
+            combined -= (1 << 32)
+        if combined < 0:
+            combined = ~combined
+        out.append(combined % num_bits)
+    return out
+
+
+class PyBloomFilter:
+    """Host-side oracle + container (also what df.build_bloom returns)."""
+
+    def __init__(self, num_bits: int, k: int,
+                 bits: Optional[np.ndarray] = None):
+        self.num_bits = num_bits
+        self.k = k
+        self.bits = bits if bits is not None \
+            else np.zeros((num_bits,), np.bool_)
+
+    def put(self, value: int) -> None:
+        for b in py_bit_positions(int(value), self.num_bits, self.k):
+            self.bits[b] = True
+
+    def might_contain(self, value: int) -> bool:
+        return all(self.bits[b]
+                   for b in py_bit_positions(int(value), self.num_bits,
+                                             self.k))
+
+    def serialize(self) -> bytes:
+        return serialize(self.bits, self.k)
+
+    @staticmethod
+    def from_bytes(buf: bytes) -> "PyBloomFilter":
+        bits, k = deserialize(buf)
+        return PyBloomFilter(bits.shape[0], k, bits)

@@ -192,6 +192,15 @@ def segment_any_null(col: DeviceColumn, num_rows) -> jax.Array:
                                num_segments=col.capacity) > 0
 
 
+def elem_equals(data: jax.Array, needle: jax.Array) -> jax.Array:
+    """Spark SQL equality over element buffers: NaN == NaN (and IEEE gives
+    -0.0 == 0.0 already)."""
+    eq = data == needle
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        eq = eq | (jnp.isnan(data) & jnp.isnan(needle))
+    return eq
+
+
 def segment_contains(
     col: DeviceColumn, value_per_row: jax.Array, value_valid: jax.Array,
     num_rows,
@@ -206,7 +215,7 @@ def segment_contains(
     rows = element_row_ids(col)
     live = element_live_mask(col, num_rows)
     ok = col.child_validity & live
-    eq = ok & (col.data == value_per_row[rows])
+    eq = ok & elem_equals(col.data, value_per_row[rows])
     found = jax.ops.segment_max(eq.astype(jnp.int32), rows,
                                 num_segments=col.capacity) > 0
     has_null = segment_any_null(col, num_rows)
@@ -225,7 +234,7 @@ def segment_position(
     rows = element_row_ids(col)
     live = element_live_mask(col, num_rows)
     ok = col.child_validity & live
-    eq = ok & (col.data == value_per_row[rows])
+    eq = ok & elem_equals(col.data, value_per_row[rows])
     within = jnp.arange(col.byte_capacity, dtype=jnp.int32) - col.offsets[rows]
     big = jnp.int32(2**31 - 1)
     cand = jnp.where(eq, within, big)

@@ -282,3 +282,297 @@ def py_murmur3_row(values, dtypes, seed: int = DEFAULT_SEED) -> int:
             raise NotImplementedError(f"py murmur3 for {dt!r}")
     res = h & 0xFFFFFFFF
     return res - (1 << 32) if res >= (1 << 31) else res
+
+
+# ---------------------------------------------------------------------------
+# xxHash64 (Spark XxHash64 expression semantics, seed chaining like murmur3)
+# Reference: HashFunctions.scala GpuXxHash64 over spark.sql.catalyst.XXH64.
+# ---------------------------------------------------------------------------
+
+_XP1 = np.uint64(0x9E3779B185EBCA87)
+_XP2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_XP3 = np.uint64(0x165667B19E3779F9)
+_XP4 = np.uint64(0x85EBCA77C2B2AE63)
+_XP5 = np.uint64(0x27D4EB2F165667C5)
+
+XXHASH64_DEFAULT_SEED = 42
+
+
+def _rotl64(x, r: int):
+    return (x << r) | (x >> (64 - r))
+
+
+def _xx_fmix(h):
+    h = h ^ (h >> 33)
+    h = h * _XP2
+    h = h ^ (h >> 29)
+    h = h * _XP3
+    h = h ^ (h >> 32)
+    return h
+
+
+def _xx_hash_int(value_u32, seed_u64):
+    """XXH64.hashInt: 4-byte input."""
+    h = seed_u64 + _XP5 + jnp.uint64(4)
+    h = h ^ (value_u32.astype(jnp.uint64) * _XP1)
+    h = _rotl64(h, 23) * _XP2 + _XP3
+    return _xx_fmix(h)
+
+
+def _xx_hash_long(value_u64, seed_u64):
+    """XXH64.hashLong: 8-byte input."""
+    h = seed_u64 + _XP5 + jnp.uint64(8)
+    k1 = _rotl64(value_u64 * _XP2, 31) * _XP1
+    h = h ^ k1
+    h = _rotl64(h, 27) * _XP1 + _XP4
+    return _xx_fmix(h)
+
+
+def xxhash64_fixed_width(col: DeviceColumn, seeds: jax.Array) -> jax.Array:
+    """Chain one fixed-width column into running uint64 hashes.
+
+    Spark's XxHash64 hashes byte/short/int as 4-byte ints and
+    long/timestamp/double/decimal64 as 8-byte longs; nulls pass the seed
+    through (XXH64.scala via HashExpression.computeHash)."""
+    dt = col.dtype
+    if isinstance(dt, T.BooleanType):
+        h = _xx_hash_int(col.data.astype(jnp.uint32), seeds)
+    elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        h = _xx_hash_int(col.data.astype(jnp.int32).astype(jnp.uint32), seeds)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        h = _xx_hash_long(col.data.astype(jnp.int64).astype(jnp.uint64), seeds)
+    elif isinstance(dt, T.FloatType):
+        h = _xx_hash_int(_f32_bits(col.data), seeds)
+    elif isinstance(dt, T.DoubleType):
+        h = _xx_hash_long(_f64_bits(col.data), seeds)
+    elif isinstance(dt, T.DecimalType) and not dt.uses_two_limbs:
+        h = _xx_hash_long(col.data.astype(jnp.uint64), seeds)
+    else:
+        raise NotImplementedError(f"xxhash64 for {dt!r}")
+    return jnp.where(col.validity, h, seeds)
+
+
+def xxhash64_string(col: DeviceColumn, seeds: jax.Array,
+                    max_bytes: int) -> jax.Array:
+    """Chain a string column: XXH64.hashUnsafeBytes — 32-byte stripes with
+    four accumulators, then 8-byte, 4-byte, and single-byte tails."""
+    max_bytes = (max_bytes + 31) & ~31   # stripe packing
+    cap = col.capacity
+    starts = col.offsets[:-1]
+    lengths = (col.offsets[1:] - starts).astype(jnp.int64)
+    pos = jnp.arange(max_bytes, dtype=jnp.int32)[None, :]
+    byte_idx = jnp.clip(starts[:, None] + pos, 0, col.data.shape[0] - 1)
+    inb = pos < lengths[:, None].astype(jnp.int32)
+    tile = jnp.where(inb, col.data[byte_idx], jnp.uint8(0))
+
+    def le64(o):   # [cap, n] little-endian 8-byte lanes starting at o step 8
+        w = tile[:, o + 0::32].astype(jnp.uint64)
+        for b in range(1, 8):
+            w = w | (tile[:, o + b::32].astype(jnp.uint64) << (8 * b))
+        return w
+
+    lanes = [le64(o) for o in (0, 8, 16, 24)]      # 4 x [cap, n_stripes]
+    n_stripes = max_bytes // 32
+    full_stripes = (lengths // 32).astype(jnp.int32)
+
+    seed64 = seeds
+    v1 = seed64 + _XP1 + _XP2
+    v2 = seed64 + _XP2
+    v3 = seed64
+    v4 = seed64 - _XP1
+
+    def stripe_step(i, vs):
+        v1, v2, v3, v4 = vs
+        use = i < full_stripes
+        nv1 = _rotl64(v1 + lanes[0][:, i] * _XP2, 31) * _XP1
+        nv2 = _rotl64(v2 + lanes[1][:, i] * _XP2, 31) * _XP1
+        nv3 = _rotl64(v3 + lanes[2][:, i] * _XP2, 31) * _XP1
+        nv4 = _rotl64(v4 + lanes[3][:, i] * _XP2, 31) * _XP1
+        return (jnp.where(use, nv1, v1), jnp.where(use, nv2, v2),
+                jnp.where(use, nv3, v3), jnp.where(use, nv4, v4))
+
+    v1, v2, v3, v4 = jax.lax.fori_loop(
+        0, n_stripes, stripe_step, (v1, v2, v3, v4))
+
+    def merge_acc(h, v):
+        h = h ^ (_rotl64(v * _XP2, 31) * _XP1)
+        return h * _XP1 + _XP4
+
+    big = lengths >= 32
+    hbig = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+            + _rotl64(v4, 18))
+    hbig = merge_acc(merge_acc(merge_acc(merge_acc(hbig, v1), v2), v3), v4)
+    h = jnp.where(big, hbig, seed64 + _XP5)
+    h = h + lengths.astype(jnp.uint64)
+
+    # 8-byte tail words from offset (len//32)*32 while >= 8 bytes remain
+    le_all = (
+        tile[:, 0::8].astype(jnp.uint64))
+    for b in range(1, 8):
+        le_all = le_all | (tile[:, b::8].astype(jnp.uint64) << (8 * b))
+    n_words8 = max_bytes // 8
+    word_done = (lengths // 8).astype(jnp.int32)   # words fully available
+
+    def tail8_step(i, h):
+        in_tail = (i >= full_stripes * 4) & (i < word_done)
+        k1 = _rotl64(le_all[:, i] * _XP2, 31) * _XP1
+        mixed = _rotl64(h ^ k1, 27) * _XP1 + _XP4
+        return jnp.where(in_tail, mixed, h)
+
+    h = jax.lax.fori_loop(0, n_words8, tail8_step, h)
+
+    # one 4-byte word if >= 4 bytes remain
+    off4 = (lengths // 8 * 8).astype(jnp.int32)
+    has4 = (lengths - off4.astype(jnp.int64)) >= 4
+    g = jnp.arange(cap, dtype=jnp.int32)
+    o4 = jnp.minimum(off4, max_bytes - 4)
+    w4 = (tile[g, o4].astype(jnp.uint64)
+          | (tile[g, jnp.minimum(o4 + 1, max_bytes - 1)].astype(jnp.uint64) << 8)
+          | (tile[g, jnp.minimum(o4 + 2, max_bytes - 1)].astype(jnp.uint64) << 16)
+          | (tile[g, jnp.minimum(o4 + 3, max_bytes - 1)].astype(jnp.uint64) << 24))
+    h4 = _rotl64(h ^ (w4 * _XP1), 23) * _XP2 + _XP3
+    h = jnp.where(has4, h4, h)
+
+    # remaining single bytes
+    off1 = jnp.where(has4, off4 + 4, off4)
+
+    def tail1_step(i, h):
+        idx = jnp.minimum(off1 + i, max_bytes - 1)
+        in_tail = (off1 + i).astype(jnp.int64) < lengths
+        b = tile[g, idx].astype(jnp.uint64)
+        mixed = _rotl64(h ^ (b * _XP5), 11) * _XP1
+        return jnp.where(in_tail, mixed, h)
+
+    h = jax.lax.fori_loop(0, 8, tail1_step, h)
+    h = _xx_fmix(h)
+    return jnp.where(col.validity, h, seeds)
+
+
+def xxhash64(columns: Sequence[DeviceColumn],
+             seed: int = XXHASH64_DEFAULT_SEED,
+             string_max_bytes: int = 64) -> jax.Array:
+    """Row hashes with Spark XxHash64 semantics; returns int64 [capacity]."""
+    cap = columns[0].capacity
+    h = jnp.full((cap,), np.uint64(seed), dtype=jnp.uint64)
+    for col in columns:
+        if col.is_string_like:
+            h = xxhash64_string(col, h, string_max_bytes)
+        else:
+            h = xxhash64_fixed_width(col, h)
+    return h.astype(jnp.int64)
+
+
+# -- pure-python xxhash64 oracle --------------------------------------------
+
+_M64 = (1 << 64) - 1
+
+
+def _py_rotl64(x, r):
+    x &= _M64
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def _py_xx_fmix(h):
+    h &= _M64
+    h ^= h >> 33
+    h = (h * 0xC2B2AE3D27D4EB4F) & _M64
+    h ^= h >> 29
+    h = (h * 0x165667B19E3779F9) & _M64
+    h ^= h >> 32
+    return h
+
+
+def py_xxhash64_int(value, seed):
+    h = (seed + 0x27D4EB2F165667C5 + 4) & _M64
+    h ^= ((value & 0xFFFFFFFF) * 0x9E3779B185EBCA87) & _M64
+    h = (_py_rotl64(h, 23) * 0xC2B2AE3D27D4EB4F + 0x165667B19E3779F9) & _M64
+    return _py_xx_fmix(h)
+
+
+def py_xxhash64_long(value, seed):
+    h = (seed + 0x27D4EB2F165667C5 + 8) & _M64
+    k1 = (_py_rotl64((value & _M64) * 0xC2B2AE3D27D4EB4F, 31)
+          * 0x9E3779B185EBCA87) & _M64
+    h = (_py_rotl64(h ^ k1, 27) * 0x9E3779B185EBCA87
+         + 0x85EBCA77C2B2AE63) & _M64
+    return _py_xx_fmix(h)
+
+
+def py_xxhash64_bytes(data: bytes, seed: int) -> int:
+    P1, P2, P3, P4, P5 = (0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F,
+                          0x165667B19E3779F9, 0x85EBCA77C2B2AE63,
+                          0x27D4EB2F165667C5)
+    n = len(data)
+    off = 0
+    if n >= 32:
+        v1 = (seed + P1 + P2) & _M64
+        v2 = (seed + P2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - P1) & _M64
+        while off + 32 <= n:
+            for i, v in enumerate((v1, v2, v3, v4)):
+                w = int.from_bytes(data[off + i * 8: off + i * 8 + 8],
+                                   "little")
+                v = (_py_rotl64((v + w * P2) & _M64, 31) * P1) & _M64
+                if i == 0:
+                    v1 = v
+                elif i == 1:
+                    v2 = v
+                elif i == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            off += 32
+        h = (_py_rotl64(v1, 1) + _py_rotl64(v2, 7) + _py_rotl64(v3, 12)
+             + _py_rotl64(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            h = (h ^ ((_py_rotl64((v * P2) & _M64, 31) * P1) & _M64)) & _M64
+            h = (h * P1 + P4) & _M64
+    else:
+        h = (seed + P5) & _M64
+    h = (h + n) & _M64
+    while off + 8 <= n:
+        w = int.from_bytes(data[off: off + 8], "little")
+        k1 = (_py_rotl64((w * P2) & _M64, 31) * P1) & _M64
+        h = (_py_rotl64(h ^ k1, 27) * P1 + P4) & _M64
+        off += 8
+    if off + 4 <= n:
+        w = int.from_bytes(data[off: off + 4], "little")
+        h = (_py_rotl64(h ^ ((w * P1) & _M64), 23) * P2 + P3) & _M64
+        off += 4
+    while off < n:
+        h = (_py_rotl64(h ^ ((data[off] * P5) & _M64), 11) * P1) & _M64
+        off += 1
+    return _py_xx_fmix(h)
+
+
+def py_xxhash64_row(values, dtypes, seed: int = XXHASH64_DEFAULT_SEED) -> int:
+    import struct
+    h = seed & _M64
+    for v, dt in zip(values, dtypes):
+        if v is None:
+            continue
+        if isinstance(dt, T.BooleanType):
+            h = py_xxhash64_int(1 if v else 0, h)
+        elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType,
+                             T.DateType)):
+            h = py_xxhash64_int(int(v) & 0xFFFFFFFF, h)
+        elif isinstance(dt, (T.LongType, T.TimestampType)):
+            h = py_xxhash64_long(int(v), h)
+        elif isinstance(dt, T.FloatType):
+            f = 0.0 if v == 0.0 else float(np.float32(v))
+            h = py_xxhash64_int(
+                struct.unpack("<I", struct.pack("<f", f))[0], h)
+        elif isinstance(dt, T.DoubleType):
+            d = 0.0 if v == 0.0 else float(v)
+            h = py_xxhash64_long(
+                struct.unpack("<Q", struct.pack("<d", d))[0], h)
+        elif isinstance(dt, T.StringType):
+            h = py_xxhash64_bytes(
+                v.encode("utf-8") if isinstance(v, str) else v, h)
+        elif isinstance(dt, T.DecimalType) and not dt.uses_two_limbs:
+            h = py_xxhash64_long(int(v), h)
+        else:
+            raise NotImplementedError(f"py xxhash64 for {dt!r}")
+    res = h & _M64
+    return res - (1 << 64) if res >= (1 << 63) else res

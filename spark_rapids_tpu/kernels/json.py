@@ -1,0 +1,334 @@
+"""Vectorized JSON path extraction over string columns.
+
+Reference: GpuGetJsonObject.scala (cuDF JSON path kernel).  TPU design is a
+simdjson-style sequence of data-parallel byte passes over the [rows, bucket]
+byte tile — no per-row parser loop, everything XLA-fusable:
+
+  1. escape mask     — a byte is escaped iff preceded by an odd run of
+                       backslashes (cummax trick, no sequential scan)
+  2. in-string mask  — parity of unescaped quotes (exclusive cumsum)
+  3. depth           — cumsum of structural {{ }} outside strings
+  4. key match       — compare the static `"key"` byte pattern at every
+                       depth-1 position, then check the next structural
+                       char is ':'
+  5. value span      — from the first non-ws byte after ':' to the end of
+                       the scalar (',' or '}' at depth 1) or of the nested
+                       object/array (depth return), quotes stripped and
+                       escapes decoded for string values
+
+Supported paths: `$.k1.k2...` (dotted object fields — each level is one
+application of this kernel to the previous level's output).  Array
+indexing falls back to the CPU bridge (planner gate).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu import types as T
+
+_QUOTE = np.uint8(ord('"'))
+_BSLASH = np.uint8(ord("\\"))
+_LBRACE = np.uint8(ord("{"))
+_RBRACE = np.uint8(ord("}"))
+_LBRACK = np.uint8(ord("["))
+_RBRACK = np.uint8(ord("]"))
+_COLON = np.uint8(ord(":"))
+_COMMA = np.uint8(ord(","))
+
+
+def _byte_tile(col: DeviceColumn, max_bytes: int):
+    """[rows, max_bytes] byte tile + lengths (shared with hash kernels)."""
+    starts = col.offsets[:-1]
+    lengths = col.offsets[1:] - starts
+    pos = jnp.arange(max_bytes, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(starts[:, None] + pos, 0, col.data.shape[0] - 1)
+    inb = pos < lengths[:, None]
+    tile = jnp.where(inb, col.data[idx], jnp.uint8(0))
+    return tile, lengths
+
+
+def _masks(tile):
+    """(escaped, in_string, depth_excl) along axis 1."""
+    n = tile.shape[1]
+    pos = jnp.arange(n, dtype=jnp.int32)[None, :]
+    bs = tile == _BSLASH
+    # last position that is NOT a backslash, up to and including i
+    last_non = jnp.where(~bs, pos, -1)
+    last_non = jax.lax.cummax(last_non, axis=1)
+    # run of backslashes strictly before i ends at i-1: length = (i-1) - last_non[i-1]
+    prev_last = jnp.concatenate(
+        [jnp.full((tile.shape[0], 1), -1, jnp.int32), last_non[:, :-1]],
+        axis=1)
+    run_before = (pos - 1) - prev_last
+    escaped = (run_before % 2) == 1
+    quote = (tile == _QUOTE) & ~escaped
+    # exclusive cumsum parity -> inside a string literal
+    qcum = jnp.cumsum(quote.astype(jnp.int32), axis=1)
+    in_string = ((qcum - quote.astype(jnp.int32)) % 2) == 1
+    structural = ~in_string
+    opens = ((tile == _LBRACE) | (tile == _LBRACK)) & structural
+    closes = ((tile == _RBRACE) | (tile == _RBRACK)) & structural
+    depth_incl = jnp.cumsum(opens.astype(jnp.int32) - closes.astype(jnp.int32),
+                            axis=1)
+    depth_excl = depth_incl - opens.astype(jnp.int32) \
+        + closes.astype(jnp.int32)
+    # depth_excl: depth BEFORE this byte; a top-level key's opening quote
+    # sits at depth_excl == 1 (inside the root object)
+    return escaped, in_string, quote, depth_incl, depth_excl
+
+
+def extract_field(col: DeviceColumn, key: bytes, max_bytes: int
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One `$.key` step from a string column (see extract_field_tile)."""
+    tile, lengths = _byte_tile(col, max_bytes)
+    return extract_field_tile(tile, lengths, key)
+
+
+def extract_field_tile(tile: jax.Array, lengths: jax.Array, key: bytes
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One `$.key` step: (out_tile [rows, max_bytes], out_lengths, found).
+
+    Operates tile->tile so multi-level paths chain without repacking to a
+    string column between levels.  Returns the raw value bytes per row
+    (strings unquoted + unescaped, nested JSON verbatim); found=False rows
+    are null.
+    """
+    rows, max_bytes = tile.shape
+    n = max_bytes
+    pos = jnp.arange(n, dtype=jnp.int32)[None, :]
+    escaped, in_string, quote, depth_incl, depth_excl = _masks(tile)
+
+    # --- locate `"key"` at depth 1 followed by ':' --------------------------
+    pat = np.frombuffer(b'"' + key + b'"', dtype=np.uint8)
+    L = pat.shape[0]
+    match = jnp.ones((rows, n), jnp.bool_)
+    for j, b in enumerate(pat):
+        shifted = jnp.roll(tile, -j, axis=1)
+        shifted = jnp.where(pos + j < n, shifted, jnp.uint8(0))
+        match = match & (shifted == jnp.uint8(b))
+    # opening quote must be structural (not inside another string), the
+    # byte must open a KEY (depth before == 1 in the root object)
+    match = match & quote & ~in_string & (depth_excl == 1)
+    # next structural non-ws byte after the closing quote must be ':'
+    after = pos + L
+    ws = ((tile == 32) | (tile == 9) | (tile == 10) | (tile == 13))
+    nonws_pos = jnp.where(~ws & (pos < lengths[:, None]), pos, n)
+    # for each position q, the first non-ws byte at index >= q:
+    # suffix-min of nonws_pos
+    suffix_min = jax.lax.cummin(nonws_pos[:, ::-1], axis=1)[:, ::-1]
+    colon_at = jnp.take_along_axis(
+        suffix_min, jnp.clip(after, 0, n - 1), axis=1)
+    colon_ok = jnp.take_along_axis(
+        tile, jnp.clip(colon_at, 0, n - 1), axis=1) == _COLON
+    match = match & colon_ok & (after < n)
+
+    found = jnp.any(match, axis=1)
+    key_pos = jnp.argmax(match, axis=1)              # first match per row
+    colon_idx = jnp.take_along_axis(
+        suffix_min, jnp.clip(key_pos + L, 0, n - 1)[:, None], axis=1)[:, 0]
+
+    # --- value span ---------------------------------------------------------
+    vstart = jnp.take_along_axis(
+        suffix_min, jnp.clip(colon_idx + 1, 0, n - 1)[:, None], axis=1)[:, 0]
+    vstart = jnp.clip(vstart, 0, n - 1)
+    r = jnp.arange(rows)
+    first = tile[r, vstart]
+    is_str = first == _QUOTE
+    is_obj = (first == _LBRACE) | (first == _LBRACK)
+
+    # scalar end: first structural ',' or '}' / ']' at depth 1 after vstart
+    stop = (((tile == _COMMA) & (depth_excl == 1))
+            | (((tile == _RBRACE) | (tile == _RBRACK)) & (depth_incl == 0))) \
+        & ~in_string
+    stop_pos = jnp.where(stop & (pos >= vstart[:, None]), pos, n)
+    scalar_end = jnp.min(stop_pos, axis=1)           # exclusive
+    # trim trailing ws from scalars
+    content = (pos < scalar_end[:, None]) & (pos >= vstart[:, None]) & ~ws
+    scalar_end = jnp.where(
+        jnp.any(content, axis=1),
+        jnp.max(jnp.where(content, pos, -1), axis=1) + 1, vstart)
+
+    # string end: the closing unescaped quote
+    closing = quote & (pos > vstart[:, None])
+    str_end = jnp.where(jnp.any(closing, axis=1),
+                        jnp.argmax(closing, axis=1), vstart)  # inclusive idx
+
+    # object/array end: first position where depth returns to 1 after vstart
+    ret = ((depth_incl == 1) & (pos >= vstart[:, None])
+           & (((tile == _RBRACE) | (tile == _RBRACK)) & ~in_string))
+    obj_end = jnp.where(jnp.any(ret, axis=1),
+                        jnp.argmax(ret, axis=1) + 1, vstart)  # exclusive
+
+    out_start = jnp.where(is_str, vstart + 1, vstart)
+    out_end = jnp.where(is_str, str_end,
+                        jnp.where(is_obj, obj_end, scalar_end))
+    out_end = jnp.maximum(out_end, out_start)
+
+    # JSON null scalar -> SQL null
+    is_null_lit = ((tile[r, jnp.clip(out_start, 0, n - 1)] == ord("n"))
+                   & ~is_str & ~is_obj
+                   & (out_end - out_start == 4))
+    found = found & ~is_null_lit & (lengths > 0)
+
+    # --- build output tile: value bytes, escapes decoded for strings -------
+    keep = (pos >= out_start[:, None]) & (pos < out_end[:, None])
+    # drop escape backslashes inside string values
+    drop = is_str[:, None] & (tile == _BSLASH) & ~escaped & keep
+    keep_out = keep & ~drop
+    # map escaped chars: n->\n t->\t r->\r b->\b f->\f (others verbatim)
+    esc_prev = jnp.concatenate(
+        [jnp.zeros((rows, 1), jnp.bool_),
+         ((tile == _BSLASH) & ~escaped)[:, :-1]], axis=1)
+    mapped = tile
+    for src, dst in ((ord("n"), 10), (ord("t"), 9), (ord("r"), 13),
+                     (ord("b"), 8), (ord("f"), 12)):
+        mapped = jnp.where(
+            esc_prev & (tile == src) & is_str[:, None],
+            jnp.uint8(dst), mapped)
+    # compact kept bytes to the left
+    kcum = jnp.cumsum(keep_out.astype(jnp.int32), axis=1)
+    out_len = jnp.where(found, kcum[:, -1], 0)
+    dest = jnp.where(keep_out, kcum - 1, n)
+    out_tile = jnp.zeros((rows, n), jnp.uint8)
+    out_tile = out_tile.at[r[:, None], dest].set(
+        jnp.where(keep_out, mapped, jnp.uint8(0)), mode="drop")
+    return out_tile, out_len, found
+
+
+def tile_to_column(out_tile, out_len, validity) -> DeviceColumn:
+    """Pack a [rows, max_bytes] tile into a canonical string column."""
+    rows, n = out_tile.shape
+    lens = jnp.where(validity, out_len, 0)
+    offsets = jnp.zeros((rows + 1,), jnp.int32).at[1:].set(
+        jnp.cumsum(lens).astype(jnp.int32))
+    total = offsets[rows]
+    bcap = rows * n
+    bpos = jnp.arange(bcap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, bpos, side="right") - 1,
+                   0, rows - 1).astype(jnp.int32)
+    within = bpos - offsets[row]
+    data = jnp.where(bpos < total, out_tile[row, jnp.clip(within, 0, n - 1)],
+                     jnp.uint8(0))
+    return DeviceColumn(data, validity, T.STRING, offsets)
+
+
+# -- python oracle -----------------------------------------------------------
+# A sequential scanner with EXACTLY the device kernel's semantics (raw spans
+# for nested values, literal number text, naive escape decode) so the two
+# engines agree byte-for-byte.  \uXXXX decoding is not performed on either
+# engine (documented divergence from Spark's Jackson path, like the
+# reference's getJsonObject compatibility notes).
+
+
+def _py_scan_field(s: str, key: str) -> Optional[str]:
+    b = s
+    n = len(b)
+    i = 0
+    in_str = False
+    esc = False
+    depth = 0
+    target = '"' + key + '"'
+    while i < n:
+        c = b[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif c == "\\":
+                esc = True
+            elif c == '"':
+                in_str = False
+            i += 1
+            continue
+        if c == '"':
+            if depth == 1 and b.startswith(target, i):
+                j = i + len(target)
+                while j < n and b[j] in " \t\n\r":
+                    j += 1
+                if j < n and b[j] == ":":
+                    return _py_value_span(b, j + 1)
+            in_str = True
+            i += 1
+            continue
+        if c in "{[":
+            depth += 1
+        elif c in "}]":
+            depth -= 1
+        i += 1
+    return None
+
+
+def _py_value_span(b: str, j: int) -> Optional[str]:
+    n = len(b)
+    while j < n and b[j] in " \t\n\r":
+        j += 1
+    if j >= n:
+        return None
+    c = b[j]
+    if c == '"':
+        out = []
+        k = j + 1
+        while k < n:
+            ch = b[k]
+            if ch == "\\" and k + 1 < n:
+                nxt = b[k + 1]
+                out.append({"n": "\n", "t": "\t", "r": "\r", "b": "\b",
+                            "f": "\f"}.get(nxt, nxt))
+                k += 2
+                continue
+            if ch == '"':
+                return "".join(out)
+            out.append(ch)
+            k += 1
+        return "".join(out)
+    if c in "{[":
+        depth = 0
+        in_str = False
+        esc = False
+        k = j
+        while k < n:
+            ch = b[k]
+            if in_str:
+                if esc:
+                    esc = False
+                elif ch == "\\":
+                    esc = True
+                elif ch == '"':
+                    in_str = False
+            elif ch == '"':
+                in_str = True
+            elif ch in "{[":
+                depth += 1
+            elif ch in "}]":
+                depth -= 1
+                if depth == 0:
+                    return b[j:k + 1]
+            k += 1
+        return None
+    # scalar: up to ',' or closing brace at this level
+    k = j
+    while k < n and b[k] not in ",}]":
+        k += 1
+    v = b[j:k].rstrip(" \t\n\r")
+    if v == "null" or v == "":
+        return None
+    return v
+
+
+def py_get_json_object(s: Optional[str], path: str) -> Optional[str]:
+    """get_json_object for `$.k1.k2...` paths (device-consistent scanner)."""
+    if s is None or not path.startswith("$"):
+        return None
+    keys = [k for k in path[1:].split(".") if k]
+    if not keys:
+        return None
+    cur: Optional[str] = s
+    for k in keys:
+        if cur is None:
+            return None
+        cur = _py_scan_field(cur, k)
+    return cur

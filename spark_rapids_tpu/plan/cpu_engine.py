@@ -278,9 +278,21 @@ class CpuEngine:
 
         # per-aggregate buffers -> finalized columns
         finalized: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        from spark_rapids_tpu.expressions.aggregates import HLL_UPDATE
         for agg in plan.aggregates:
             bufs = []
             for slot in agg.buffers:
+                if slot.update_op == HLL_UPDATE:
+                    from spark_rapids_tpu.kernels import hll as HLL
+                    bv = np.empty((n_groups,), object)
+                    bm = np.ones((n_groups,), np.bool_)
+                    vals, valid = agg_inputs[id(agg)]
+                    for gi, k in enumerate(order):
+                        idx = np.array(groups[k], dtype=np.int64)
+                        bv[gi] = HLL.update_np(
+                            vals[idx], valid[idx], agg.p)
+                    bufs.append((bv, bm))
+                    continue
                 bv = np.zeros((n_groups,), slot.dtype.np_dtype)
                 bm = np.ones((n_groups,), np.bool_)
                 for gi, k in enumerate(order):

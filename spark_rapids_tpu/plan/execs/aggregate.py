@@ -31,6 +31,8 @@ from spark_rapids_tpu.expressions.core import (
 from spark_rapids_tpu.expressions.aggregates import (
     COUNT_STAR,
     COUNT_VALID,
+    HLL_MERGE,
+    HLL_UPDATE,
     M2,
     M2_MERGE,
     MAX,
@@ -87,6 +89,30 @@ def _seg_update(op: str, col: Optional[DeviceColumn], layout: G.GroupedLayout,
     if op == MAX:
         return G.seg_max(col, layout)
     raise NotImplementedError(op)
+
+
+def _hll_array_col(regs2d, num_groups, cap: int, m: int) -> DeviceColumn:
+    """Pack [cap, m] registers into a canonical fixed-length array column."""
+    from spark_rapids_tpu import types as T
+    ng = num_groups.astype(jnp.int32) if hasattr(num_groups, "astype") \
+        else jnp.int32(num_groups)
+    offs = jnp.minimum(jnp.arange(cap + 1, dtype=jnp.int32), ng) * m
+    elem_live = jnp.arange(cap * m, dtype=jnp.int32) < ng * m
+    data = jnp.where(elem_live, regs2d.reshape(-1), jnp.int8(0))
+    validity = jnp.arange(cap, dtype=jnp.int32) < ng
+    return DeviceColumn(data, validity,
+                        T.ArrayType(T.ByteType(), contains_null=False),
+                        offs, elem_live)
+
+
+def _hll_regs2d(col: DeviceColumn, cap: int, m: int):
+    """Array-column rows (fixed length m, packed) -> [cap, m] registers."""
+    need = cap * m
+    data = col.data
+    if data.shape[0] < need:
+        data = jnp.concatenate(
+            [data, jnp.zeros((need - data.shape[0],), data.dtype)])
+    return data[:need].reshape(cap, m)
 
 
 def _global_update(op: str, col: Optional[DeviceColumn], live, out_dtype):
@@ -208,6 +234,12 @@ class _AggDeviceSpec:
             for ai, slot in self.slot_specs:
                 agg = self.aggregates[ai]
                 col = agg_in.get(id(agg))
+                if slot.update_op == HLL_UPDATE:
+                    from spark_rapids_tpu.kernels import hll as HLL
+                    regs = HLL.global_update(col, live, agg.p)
+                    cols.append(_hll_array_col(
+                        regs.reshape(1, agg.m), 1, 1, agg.m))
+                    continue
                 v, valid = _global_update(slot.update_op, col, live, slot.dtype)
                 data = jnp.where(valid, v, jnp.zeros((), v.dtype))
                 cols.append(DeviceColumn(
@@ -233,6 +265,12 @@ class _AggDeviceSpec:
             agg = self.aggregates[ai]
             col = (layout.sorted_batch.columns[col_of_agg[id(agg)]]
                    if agg.input is not None else None)
+            if slot.update_op == HLL_UPDATE:
+                from spark_rapids_tpu.kernels import hll as HLL
+                regs2d = HLL.seg_update(col, layout, agg.p)
+                cols.append(_hll_array_col(regs2d, layout.num_groups,
+                                           col.capacity, agg.m))
+                continue
             v, valid = _seg_update(slot.update_op, col, layout, slot.dtype)
             cols.append(G.finalize_agg_column(
                 v.astype(slot.dtype.jnp_dtype), valid, layout.num_groups,
@@ -248,6 +286,15 @@ class _AggDeviceSpec:
             cols = []
             for si, (ai, slot) in enumerate(self.slot_specs):
                 col = partial.columns[nkeys + si]
+                if slot.merge_op == HLL_MERGE:
+                    agg = self.aggregates[ai]
+                    regs2d = _hll_regs2d(col, partial.capacity, agg.m)
+                    keep = (col.validity & live)[:, None]
+                    merged = jnp.max(jnp.where(keep, regs2d, jnp.int8(0)),
+                                     axis=0)
+                    cols.append(_hll_array_col(
+                        merged.reshape(1, agg.m), 1, 1, agg.m))
+                    continue
                 if slot.merge_op == M2_MERGE:
                     s_si, n_si = self._m2_companions(ai)
                     v, valid = _global_m2_merge(
@@ -267,6 +314,19 @@ class _AggDeviceSpec:
         cols = list(out_keys)
         for si, (ai, slot) in enumerate(self.slot_specs):
             col = layout.sorted_batch.columns[nkeys + si]
+            if slot.merge_op == HLL_MERGE:
+                agg = self.aggregates[ai]
+                cap = col.capacity
+                regs2d = _hll_regs2d(col, cap, agg.m)
+                live2 = layout.sorted_batch.live_mask()
+                keep = (col.validity & live2)[:, None]
+                r = jnp.where(keep, regs2d, jnp.int8(0))
+                merged = jax.ops.segment_max(
+                    r, layout.segment_ids, num_segments=cap)
+                merged = jnp.maximum(merged, 0).astype(jnp.int8)
+                cols.append(_hll_array_col(merged, layout.num_groups,
+                                           cap, agg.m))
+                continue
             if slot.merge_op == M2_MERGE:
                 s_si, n_si = self._m2_companions(ai)
                 v, valid = G.seg_m2_merge(
@@ -288,7 +348,11 @@ class _AggDeviceSpec:
             bufs = []
             for slot in agg.buffers:
                 c = merged.columns[nkeys + si]
-                bufs.append((c.data, c.validity))
+                if c.is_array:
+                    bufs.append((_hll_regs2d(c, merged.capacity, agg.m),
+                                 c.validity))
+                else:
+                    bufs.append((c.data, c.validity))
                 si += 1
             v, valid = agg.finalize_jnp(bufs)
             live = merged.live_mask()

@@ -57,6 +57,7 @@ _SUPPORTED_EXPRS = {
     If, CaseWhen, Cast,
     A.Sum, A.Count, A.Min, A.Max, A.Average,
     A.VarianceSamp, A.VariancePop, A.StddevSamp, A.StddevPop,
+    A.ApproximateCountDistinct,
     Length, Upper, Lower, Substring, ConcatStrings, Trim, LTrim, RTrim,
     StartsWith, EndsWith, Contains, Like, RLike, Reverse, InitCap,
     StringReplace, StringLocate, StringInstr, Ascii, StringRepeat,
@@ -137,6 +138,13 @@ _SUPPORTED_EXPRS |= {
     ArrayTransform, ArrayFilter, ArrayExists, ArrayForAll,
     NamedLambdaVariable, Explode, PosExplode,
 }
+
+from spark_rapids_tpu.expressions.hashing import (
+    BloomFilterMightContain, Murmur3Hash, XxHash64)
+from spark_rapids_tpu.expressions.strings import GetJsonObject
+
+_SUPPORTED_EXPRS |= {Murmur3Hash, XxHash64, BloomFilterMightContain,
+                     GetJsonObject}
 
 # dtypes device kernels support in expression compute
 _COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
@@ -327,6 +335,39 @@ class ExprMeta:
                     self.will_not_work(
                         f"regex over {e.children[0]!r}: only non-growing "
                         "string inputs supported (project it first)")
+            if isinstance(e, GetJsonObject):
+                if not e.device_supported_path():
+                    self.will_not_work(
+                        f"JSON path {e.path!r}: device scanner handles "
+                        "dotted object fields only (CPU bridge covers "
+                        "array indexing)")
+                elif not _regex_child_ok(e.child):
+                    self.will_not_work(
+                        f"get_json_object over {e.child!r}: only "
+                        "non-growing string inputs supported")
+            if isinstance(e, BloomFilterMightContain):
+                try:
+                    if not isinstance(e.child.dtype, T.LongType):
+                        self.will_not_work(
+                            "might_contain probes LONG values (Spark "
+                            "BloomFilterImpl putLong semantics)")
+                except (TypeError, ValueError, NotImplementedError):
+                    pass
+            if isinstance(e, (Murmur3Hash, XxHash64)):
+                for c in e.children:
+                    try:
+                        cd = c.dtype
+                        if isinstance(cd, (T.ArrayType, T.StructType,
+                                           T.MapType, T.BinaryType)):
+                            self.will_not_work(
+                                f"{type(e).__name__} over nested/binary "
+                                f"input {c!r} not supported")
+                        elif cd.variable_width and not _regex_child_ok(c):
+                            self.will_not_work(
+                                f"{type(e).__name__} string input {c!r} "
+                                "must be non-growing (project it first)")
+                    except (TypeError, ValueError, NotImplementedError):
+                        pass
             if isinstance(e, (ArrayContains, ArrayPosition, ArrayRemove)):
                 try:
                     if e.right.dtype.variable_width:
@@ -492,6 +533,30 @@ class PlanMeta:
                 for sub in _non_agg_leaf_refs(e):
                     self.will_not_work(
                         f"non-aggregate column {sub!r} in aggregate output")
+            from spark_rapids_tpu.expressions.aggregates import (
+                ApproximateCountDistinct, find_aggregates)
+            for e in p.agg_exprs:
+                for agg in find_aggregates(e):
+                    if not isinstance(agg, ApproximateCountDistinct):
+                        continue
+                    try:
+                        dt = agg.input.dtype
+                        ok = (dt.is_integral or isinstance(
+                            dt, (T.DateType, T.TimestampType, T.BooleanType)))
+                    except (TypeError, ValueError, NotImplementedError):
+                        ok = False
+                    if not ok:
+                        self.will_not_work(
+                            f"approx_count_distinct over {agg.input!r}: "
+                            "device HLL hashes long-representable values "
+                            "(strings/floats fall back)")
+                    elif p.group_exprs and (
+                            self.conf.batch_size_rows * agg.m > (1 << 26)):
+                        self.will_not_work(
+                            "grouped approx_count_distinct needs "
+                            "batchSizeRows * 2^p <= 64M register slots "
+                            f"(have {self.conf.batch_size_rows} * {agg.m}); "
+                            "lower spark.rapids.sql.batchSizeBytes/rows")
             if not self.conf.variable_float_agg_enabled:
                 from spark_rapids_tpu.expressions.aggregates import (
                     find_aggregates)

@@ -144,7 +144,10 @@ def test_get_json_object_via_bridge():
             [ColumnarBatch.from_pydict({"j": docs}, schema)],
             num_partitions=1)
     s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    # dotted paths now run on device (kernels/json.py); indexed paths bridge
     e = jsrc(s).select(GetJsonObject(col("j"), "$.a").alias("r")).explain()
+    assert "will NOT" not in e and "bridge" not in e, e
+    e = jsrc(s).select(GetJsonObject(col("j"), "$.a[1]").alias("r")).explain()
     assert "CPU bridge" in e, e
     assert_tpu_cpu_equal(
         lambda sess: jsrc(sess).select(

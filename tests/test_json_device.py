@@ -1,0 +1,113 @@
+"""Device JSON path scanner vs the sequential oracle scanner.
+
+Reference strategy: integration_tests get_json_test.py.
+"""
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expressions import col
+from spark_rapids_tpu.expressions.core import Alias
+from spark_rapids_tpu.expressions.strings import GetJsonObject
+from tests.test_queries import assert_tpu_cpu_equal
+
+DOCS = [
+    '{"a": 1, "b": "x"}',
+    '{"a": {"b": 42, "c": "deep"}, "b": 2}',
+    '{"b": "only-b"}',
+    '{"a": "hello world"}',
+    '{"a": "esc\\"quote and \\\\slash and \\nnewline"}',
+    '{"a": null}',
+    '{"a": [1, 2, 3], "b": {"a": "nested-a"}}',
+    '{"aa": 5, "a": 6}',
+    '{ "a" : {  "b" : "spaced" } }',
+    "not json at all",
+    "",
+    None,
+    '{"x": {"a": "wrong level"}}',
+    '{"a": true, "t": false}',
+    '{"a": -12.5e3}',
+    '{"key with space": 1, "a": "after odd key"}',
+]
+
+SCHEMA = Schema.of(j=T.STRING, i=T.INT)
+
+
+def _df(s):
+    return s.create_dataframe(
+        {"j": DOCS, "i": list(range(len(DOCS)))}, SCHEMA)
+
+
+def test_top_level_fields():
+    rows = assert_tpu_cpu_equal(
+        lambda s: _df(s).select(
+            col("i"),
+            Alias(GetJsonObject(col("j"), "$.a"), "a"),
+            Alias(GetJsonObject(col("j"), "$.b"), "b")),
+        ignore_order=False)
+    byi = {r[0]: r for r in rows}
+    assert byi[0][1] == "1" and byi[0][2] == "x"
+    assert byi[2][1] is None and byi[2][2] == "only-b"
+    assert byi[3][1] == "hello world"
+    assert byi[4][1] == 'esc"quote and \\slash and \nnewline'
+    assert byi[5][1] is None              # JSON null -> SQL null
+    assert byi[9][1] is None and byi[11][1] is None
+    assert byi[13][1] == "true"
+    assert byi[14][1] == "-12.5e3"
+
+
+def test_nested_path_and_raw_spans():
+    rows = assert_tpu_cpu_equal(
+        lambda s: _df(s).select(
+            col("i"),
+            Alias(GetJsonObject(col("j"), "$.a.b"), "ab"),
+            Alias(GetJsonObject(col("j"), "$.a"), "a")),
+        ignore_order=False)
+    byi = {r[0]: r for r in rows}
+    assert byi[1][1] == "42"
+    assert byi[1][2] == '{"b": 42, "c": "deep"}'   # raw span
+    assert byi[8][1] == "spaced"
+    assert byi[12][1] is None                       # wrong nesting level
+    assert byi[6][1] is None                        # a is an array
+
+
+def test_device_plan_and_bridge_split():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = _df(s).select(
+        Alias(GetJsonObject(col("j"), "$.a.b"), "r")).explain()
+    assert "will NOT" not in e and "bridge" not in e, e
+    e2 = _df(s).select(
+        Alias(GetJsonObject(col("j"), "$.a[0]"), "r")).explain()
+    assert "CPU bridge" in e2, e2
+
+
+def test_array_index_via_bridge_differential():
+    assert_tpu_cpu_equal(lambda s: _df(s).select(
+        Alias(GetJsonObject(col("j"), "$.a[1]"), "r")))
+
+
+def test_fuzzy_random_docs():
+    rng = np.random.RandomState(5)
+    keys = ["a", "bb", "c_d"]
+    docs = []
+    for _ in range(200):
+        parts = []
+        for k in keys:
+            r = rng.randint(0, 5)
+            if r == 0:
+                continue
+            if r == 1:
+                parts.append(f'"{k}": {rng.randint(-99, 99)}')
+            elif r == 2:
+                parts.append(f'"{k}": "s{rng.randint(0, 9)}"')
+            elif r == 3:
+                parts.append(f'"{k}": {{"a": {rng.randint(0, 9)}}}')
+            else:
+                parts.append(f'"{k}": null')
+        docs.append("{" + ", ".join(parts) + "}")
+    sch = Schema.of(j=T.STRING)
+    assert_tpu_cpu_equal(lambda s: s.create_dataframe({"j": docs}, sch)
+                         .select(Alias(GetJsonObject(col("j"), "$.a"), "a"),
+                                 Alias(GetJsonObject(col("j"), "$.bb"), "b"),
+                                 Alias(GetJsonObject(col("j"), "$.a.a"), "aa")))

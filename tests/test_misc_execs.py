@@ -65,6 +65,19 @@ def test_cube():
     assert any(r[0] is None and r[1] is None and r[2] == 300 for r in rows)
 
 
+def test_rollup_aggregate_over_key_column():
+    """Aggregates read the un-nulled key attribute (Spark ExpandExec keeps
+    originals and adds separate nulled grouping copies)."""
+    def q(s):
+        df = s.create_dataframe({"k": [1, 2], "v": [10, 20]},
+                                Schema.of(k=T.INT, v=T.LONG))
+        return df.rollup("k").agg(Alias(sum_(col("k")), "sk"),
+                                  Alias(count(col("k")), "ck"))
+    rows = assert_tpu_cpu_equal(q)
+    total = [r for r in rows if r[0] is None]
+    assert total == [(None, 3, 2)], rows
+
+
 def test_sample():
     rows = assert_tpu_cpu_equal(
         lambda s: _df(s, n=1000, parts=2).sample(0.25, seed=11))

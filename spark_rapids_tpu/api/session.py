@@ -106,6 +106,37 @@ class TpuSession:
             start, end = 0, start
         return DataFrame(L.Range(start, end, step, num_partitions), self)
 
+    def read_iceberg(self, table_path: str,
+                     snapshot_id: Optional[int] = None,
+                     as_of_ms: Optional[int] = None,
+                     prune: Optional[Dict] = None) -> "DataFrame":
+        """Iceberg snapshot read with optional time travel and file-level
+        min/max pruning ({col: (lo, hi)} conjunctive ranges)."""
+        from spark_rapids_tpu.io.iceberg import (
+            IcebergTable, _current_struct, field_ids, prune_files)
+        table = IcebergTable.load(table_path)
+        snap = table.snapshot(snapshot_id=snapshot_id, as_of_ms=as_of_ms)
+        files = snap.data_files()
+        if prune:
+            files = prune_files(files, snap.schema, prune,
+                                ids=field_ids(_current_struct(snap.meta)))
+        return DataFrame(L.IcebergRelation(table_path, snap, files), self)
+
+    def read_avro(self, *paths: str, columns=None) -> "DataFrame":
+        """Avro container scan (reference GpuAvroScan analog): records
+        decode host-side through io/avro.py and upload as one batch per
+        file."""
+        from spark_rapids_tpu.io import avro as A
+        batches = []
+        for p in paths:
+            _, records, sch = A.read_container(p)
+            table = A.records_to_arrow(records, sch)
+            if columns:
+                table = table.select(list(columns))
+            batches.append(ColumnarBatch.from_arrow(table))
+        return self.create_dataframe(batches,
+                                     num_partitions=max(len(batches), 1))
+
     def read_delta(self, table_path: str,
                    version: Optional[int] = None) -> "DataFrame":
         from spark_rapids_tpu.io.delta import load_snapshot
@@ -433,6 +464,13 @@ class DataFrame:
         """Write this DataFrame as a Delta table commit (create or append)."""
         from spark_rapids_tpu.io.delta_write import write_delta
         return write_delta(self, path, mode=mode, partition_by=partition_by)
+
+    def write_iceberg(self, path: str, mode: str = "error") -> int:
+        """Commit this DataFrame to an Iceberg table (create/append/
+        overwrite, copy-on-write).  Returns rows written."""
+        from spark_rapids_tpu.io.iceberg import IcebergWriter
+        writer = IcebergWriter(path, self.schema)
+        return writer.commit(self.collect_partitions(), mode=mode)
 
     def write_parquet(self, path: str) -> int:
         from spark_rapids_tpu.io.parquet import write_parquet

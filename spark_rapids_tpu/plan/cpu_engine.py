@@ -210,6 +210,16 @@ class CpuEngine:
             out.append(CpuTable.from_batch(batch))
         return out or [CpuTable.empty(plan.schema)]
 
+    def _exec_icebergrelation(self, plan: L.IcebergRelation):
+        import pyarrow.parquet as pq
+        from spark_rapids_tpu.columnar.arrow import arrow_to_batch
+        out = []
+        for df in plan.files:
+            table = pq.read_table(df["file_path"],
+                                  columns=list(plan.schema.names))
+            out.append(CpuTable.from_batch(arrow_to_batch(table)))
+        return out or [CpuTable.empty(plan.schema)]
+
     def _exec_filerelation(self, plan: L.FileRelation):
         from spark_rapids_tpu.io import formats as F
         out = []

@@ -116,6 +116,30 @@ class DeltaRelation(LogicalPlan):
                 f"{len(self.snapshot.files)} files]")
 
 
+class IcebergRelation(LogicalPlan):
+    """Leaf: an Iceberg table snapshot (io/iceberg.py metadata layer).
+
+    Files are resolved at plan time from the manifest chain; the physical
+    scan is the pooled parquet reader over them (our writer keeps all
+    columns in the data files, so no partition-constant injection is
+    needed — identity partitions ride along)."""
+
+    def __init__(self, table_path: str, snapshot, files):
+        self.table_path = table_path
+        self.snapshot = snapshot
+        self.files = list(files)          # data-file dicts
+        self._schema = snapshot.schema
+        self.children = ()
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return (f"IcebergRelation[{self.table_path}"
+                f"@{self.snapshot.snapshot_id}, {len(self.files)} files]")
+
+
 class Project(LogicalPlan):
     def __init__(self, exprs: Sequence[Expression], child: LogicalPlan):
         self.exprs = tuple(e.bind(child.schema) for e in exprs)

@@ -632,6 +632,11 @@ class PlanMeta:
         if isinstance(p, L.DeltaRelation):
             from spark_rapids_tpu.io.delta_scan import TpuDeltaScanExec
             return TpuDeltaScanExec(p.table_path, p.snapshot, p.schema)
+        if isinstance(p, L.IcebergRelation):
+            return TpuParquetScanExec(
+                [df["file_path"] for df in p.files], p.schema,
+                None, self.conf.batch_size_rows,
+                reader_threads=self.conf.multithreaded_read_threads)
         if isinstance(p, L.Project):
             child = self.children[0].convert()
             exprs = [em.transformed() for em in self.expr_metas]

@@ -192,6 +192,32 @@ TEST_INJECT_RETRY_OOM = conf("spark.rapids.sql.test.injectRetryOOM").doc(
     "marker). Format: true|false or 'count:N' to throw on the Nth allocation."
 ).string_conf("false")
 
+OPTIMIZER_ENABLED = conf("spark.rapids.sql.optimizer.enabled").doc(
+    "Enable the cost-based optimizer: device-capable plan sections fall "
+    "back to CPU when estimated device cost (incl. transitions) exceeds "
+    "the CPU cost (reference: CostBasedOptimizer.scala)."
+).boolean_conf(False)
+
+OPTIMIZER_CPU_ROW_COST = conf(
+    "spark.rapids.sql.optimizer.cpu.rowCost").doc(
+    "CBO: cost units per row for a CPU operator."
+).double_conf(1.0)
+
+OPTIMIZER_TPU_ROW_COST = conf(
+    "spark.rapids.sql.optimizer.tpu.rowCost").doc(
+    "CBO: cost units per row for a device operator."
+).double_conf(0.05)
+
+OPTIMIZER_TPU_FIXED_COST = conf(
+    "spark.rapids.sql.optimizer.tpu.fixedCost").doc(
+    "CBO: fixed per-operator device cost (jit dispatch overhead)."
+).double_conf(5000.0)
+
+OPTIMIZER_TRANSITION_ROW_COST = conf(
+    "spark.rapids.sql.optimizer.transition.rowCost").doc(
+    "CBO: cost units per row crossing a CPU<->device boundary."
+).double_conf(0.5)
+
 DEVICE_MEMORY_LIMIT = conf("spark.rapids.memory.tpu.allocFraction").doc(
     "Fraction of HBM the arena may use (reference: GpuDeviceManager RMM pool "
     "sizing)."
@@ -309,6 +335,26 @@ class RapidsConf:
     @property
     def metrics_level(self) -> str:
         return (self.get(METRICS_LEVEL) or "MODERATE").upper()
+
+    @property
+    def optimizer_enabled(self) -> bool:
+        return self.get(OPTIMIZER_ENABLED)
+
+    @property
+    def optimizer_cpu_row_cost(self) -> float:
+        return self.get(OPTIMIZER_CPU_ROW_COST)
+
+    @property
+    def optimizer_tpu_row_cost(self) -> float:
+        return self.get(OPTIMIZER_TPU_ROW_COST)
+
+    @property
+    def optimizer_tpu_fixed_cost(self) -> float:
+        return self.get(OPTIMIZER_TPU_FIXED_COST)
+
+    @property
+    def optimizer_transition_row_cost(self) -> float:
+        return self.get(OPTIMIZER_TRANSITION_ROW_COST)
 
     @property
     def variable_float_agg_enabled(self) -> bool:

@@ -350,6 +350,13 @@ class IcebergWriter:
         if prior is not None and mode == "append":
             prev_snap = prior.snapshot()
             for df in prev_snap.data_files():
+                # normalize Iceberg-Java array-form bounds to the map form
+                # this writer's manifest schema serializes
+                df = dict(df)
+                df["lower_bounds"] = _bounds_map(
+                    df.get("lower_bounds")) or None
+                df["upper_bounds"] = _bounds_map(
+                    df.get("upper_bounds")) or None
                 entries.append({"status": STATUS_EXISTING,
                                 "snapshot_id": prev_snap.snapshot_id,
                                 "data_file": df})
@@ -435,6 +442,10 @@ def _physical_value(v, dt: T.DataType):
             and not isinstance(v, _dt.datetime):
         return (v - _dt.date(1970, 1, 1)).days
     if isinstance(dt, T.TimestampType) and isinstance(v, _dt.datetime):
+        if v.tzinfo is None:
+            # bounds are UTC epoch micros; a naive datetime interpreted in
+            # the machine's local zone would shift the prune window
+            v = v.replace(tzinfo=_dt.timezone.utc)
         return int(v.timestamp() * 1_000_000)
     if isinstance(dt, T.DecimalType):
         if isinstance(v, _dec.Decimal):

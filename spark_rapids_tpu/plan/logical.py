@@ -124,11 +124,18 @@ class IcebergRelation(LogicalPlan):
     columns in the data files, so no partition-constant injection is
     needed — identity partitions ride along)."""
 
-    def __init__(self, table_path: str, snapshot, files):
+    def __init__(self, table_path: str, snapshot, files, projection=None):
         self.table_path = table_path
         self.snapshot = snapshot
         self.files = list(files)          # data-file dicts
-        self._schema = snapshot.schema
+        self.projection = tuple(projection) if projection else None
+        if self.projection:
+            idx = [snapshot.schema.index_of(n) for n in self.projection]
+            self._schema = Schema(
+                tuple(self.projection),
+                tuple(snapshot.schema.dtypes[i] for i in idx))
+        else:
+            self._schema = snapshot.schema
         self.children = ()
 
     @property

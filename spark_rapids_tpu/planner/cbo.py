@@ -1,0 +1,149 @@
+"""Cost-based optimizer: keep plan sections on CPU when the device is not
+worth the transitions.
+
+Reference: CostBasedOptimizer.scala:54 — when
+spark.rapids.sql.optimizer.enabled is set, per-operator costs (configurable
+row coefficients) are estimated for the CPU and accelerated plans and
+sections are forced back to CPU when acceleration does not pay.  Mirrors
+the reference's shape: row-count estimation per logical node, cost =
+rows x coefficient, transition penalties at engine boundaries, decisions
+recorded as tagging reasons so explain() shows them.
+
+TPU specifics folded into the default coefficients: a jitted device step
+has a near-fixed dispatch overhead, so tiny inputs lose to the oracle; the
+crossover row count is the fixed-overhead/row-benefit ratio below.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from spark_rapids_tpu.plan import logical as L
+
+
+def estimate_rows(plan: L.LogicalPlan, cache: Optional[Dict] = None) -> float:
+    """Cardinality estimate per logical node (the reference's
+    RowCountPlanVisitor analog; filter selectivity mirrors its
+    DEFAULT_ROW_COUNT-style heuristics)."""
+    cache = cache if cache is not None else {}
+    key = id(plan)
+    if key in cache:
+        return cache[key]
+    if isinstance(plan, L.InMemoryRelation):
+        n = float(sum(b.host_num_rows() for part in plan.partitions
+                      for b in part))
+    elif isinstance(plan, L.ParquetRelation):
+        try:
+            import pyarrow.parquet as pq
+            n = float(sum(pq.ParquetFile(p).metadata.num_rows
+                          for p in plan.paths))
+        except Exception:
+            n = 1_000_000.0
+    elif isinstance(plan, L.IcebergRelation):
+        n = float(sum(df.get("record_count", 0) for df in plan.files)
+                  or 1_000_000.0)
+    elif isinstance(plan, L.Range):
+        n = float(max(0, -(-(plan.end - plan.start) // plan.step)))
+    elif isinstance(plan, L.Filter):
+        n = 0.5 * estimate_rows(plan.child, cache)
+    elif isinstance(plan, L.Sample):
+        n = plan.fraction * estimate_rows(plan.child, cache)
+    elif isinstance(plan, L.Limit):
+        n = min(float(plan.n), estimate_rows(plan.child, cache))
+    elif isinstance(plan, L.Aggregate):
+        base = estimate_rows(plan.child, cache)
+        n = base if not plan.group_exprs else max(base ** 0.5, 1.0)
+    elif isinstance(plan, L.Join):
+        n = max(estimate_rows(plan.left, cache),
+                estimate_rows(plan.right, cache))
+    elif isinstance(plan, L.Union):
+        n = sum(estimate_rows(c, cache) for c in plan.children)
+    elif isinstance(plan, L.Expand):
+        n = len(plan.projections) * estimate_rows(plan.child, cache)
+    elif isinstance(plan, L.Generate):
+        n = 4.0 * estimate_rows(plan.child, cache)   # avg array length guess
+    elif plan.children:
+        n = estimate_rows(plan.children[0], cache)
+    else:
+        n = 1_000_000.0
+    cache[key] = n
+    return n
+
+
+class CostModel:
+    def __init__(self, conf):
+        self.cpu_row_cost = conf.optimizer_cpu_row_cost
+        self.tpu_row_cost = conf.optimizer_tpu_row_cost
+        self.tpu_fixed_cost = conf.optimizer_tpu_fixed_cost
+        self.transition_row_cost = conf.optimizer_transition_row_cost
+
+    def cpu_cost(self, rows: float) -> float:
+        return rows * self.cpu_row_cost
+
+    def tpu_cost(self, rows: float) -> float:
+        return self.tpu_fixed_cost + rows * self.tpu_row_cost
+
+    def transition(self, rows: float) -> float:
+        return rows * self.transition_row_cost
+
+
+def apply_cbo(meta, conf) -> None:
+    """Walk the tagged meta tree; force device-capable nodes back to CPU
+    when tpu cost + boundary transitions exceed the cpu cost.
+
+    Decision granularity is per maximal device-capable subtree (the unit
+    the fallback machinery already materializes as an island)."""
+    if not conf.optimizer_enabled:
+        return
+    model = CostModel(conf)
+    cache: Dict = {}
+
+    def subtree_rows(m) -> float:
+        return estimate_rows(m.plan, cache)
+
+    def device_subtree_cost(m) -> float:
+        """Cost of running this device subtree on TPU.  Recursion follows
+        this_can_run — the granularity the fallback machinery actually
+        executes at (per-node islands) — billing a transition at each
+        engine boundary."""
+        cost = model.tpu_cost(subtree_rows(m))
+        for c in m.children:
+            if c.this_can_run:
+                cost += device_subtree_cost(c)
+            else:
+                cost += model.transition(subtree_rows(c))
+                cost += mixed_cpu_cost(c)
+        return cost
+
+    def mixed_cpu_cost(m) -> float:
+        """Cost of a node running on CPU, with device-capable children
+        still billed as device islands (+ boundary transition)."""
+        cost = model.cpu_cost(subtree_rows(m))
+        for c in m.children:
+            if c.this_can_run:
+                cost += model.transition(subtree_rows(c))
+                cost += device_subtree_cost(c)
+            else:
+                cost += mixed_cpu_cost(c)
+        return cost
+
+    def cpu_subtree_cost(m) -> float:
+        return model.cpu_cost(subtree_rows(m)) + sum(
+            cpu_subtree_cost(c) for c in m.children)
+
+    def walk(m, parent_on_device: bool) -> None:
+        if m.this_can_run and not parent_on_device:
+            # root of a maximal device-capable subtree: compare
+            dev = device_subtree_cost(m) + model.transition(subtree_rows(m))
+            cpu = cpu_subtree_cost(m)
+            if dev >= cpu:
+                m.will_not_work(
+                    f"cost-based fallback: device cost {dev:.0f} >= "
+                    f"cpu cost {cpu:.0f} (rows~{subtree_rows(m):.0f}; "
+                    "spark.rapids.sql.optimizer.enabled)")
+                for c in m.children:
+                    walk(c, False)
+                return
+        for c in m.children:
+            walk(c, m.this_can_run or parent_on_device)
+
+    walk(meta, False)

@@ -51,7 +51,7 @@ def _exprs_refs(exprs) -> Set[str]:
 def _prune(plan: L.LogicalPlan, required: Set[str]) -> L.LogicalPlan:
     p = plan
     if isinstance(p, (L.InMemoryRelation, L.ParquetRelation, L.FileRelation,
-                      L.DeltaRelation)):
+                      L.DeltaRelation, L.IcebergRelation)):
         have = list(p.schema.names)
         keep = [n for n in have if n in required]
         if len(keep) == len(have) or not keep:
@@ -70,6 +70,9 @@ def _prune(plan: L.LogicalPlan, required: Set[str]) -> L.LogicalPlan:
                 p.paths, p.fmt,
                 Schema(tuple(keep), tuple(p.schema.dtypes[i] for i in idx)),
                 tuple(keep), p.options)
+        if isinstance(p, L.IcebergRelation):
+            return L.IcebergRelation(p.table_path, p.snapshot, p.files,
+                                     projection=keep)
         # in-memory / delta: select on top (BoundReference re-pick is
         # zero-copy in the exec)
         return L.Project([Col(n) for n in keep], p)

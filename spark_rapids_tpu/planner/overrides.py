@@ -268,16 +268,23 @@ class ExprMeta:
         return self.expr.with_children(new_children)
 
     def tag(self) -> None:
+        from spark_rapids_tpu.planner.typesig import check_expr, sig_for
         e = self.expr
         if type(e) not in _SUPPORTED_EXPRS:
             self.will_not_work(f"expression {type(e).__name__} is not supported")
         else:
-            try:
-                if not _dtype_ok(e.dtype):
-                    self.will_not_work(
-                        f"produces unsupported type {e.dtype!r}")
-            except (TypeError, ValueError, NotImplementedError):
-                pass
+            # per-op type signature (TypeChecks analog), falling back to
+            # the blanket device-dtype gate for unregistered ops
+            sig_reason = check_expr(e)
+            if sig_reason is not None:
+                self.will_not_work(sig_reason)
+            elif sig_for(type(e)) is None:
+                try:
+                    if not _dtype_ok(e.dtype):
+                        self.will_not_work(
+                            f"produces unsupported type {e.dtype!r}")
+                except (TypeError, ValueError, NotImplementedError):
+                    pass
             if isinstance(e, Cast) and not Cast.supported(e.child.dtype, e.dtype):
                 self.will_not_work(
                     f"cast {e.child.dtype!r} -> {e.dtype!r} is not supported")
@@ -635,7 +642,7 @@ class PlanMeta:
         if isinstance(p, L.IcebergRelation):
             return TpuParquetScanExec(
                 [df["file_path"] for df in p.files], p.schema,
-                None, self.conf.batch_size_rows,
+                p.projection, self.conf.batch_size_rows,
                 reader_threads=self.conf.multithreaded_read_threads)
         if isinstance(p, L.Project):
             child = self.children[0].convert()
@@ -904,6 +911,8 @@ def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
     plan = prune_columns(plan)
     meta = PlanMeta(plan, conf)
     meta.tag()
+    from spark_rapids_tpu.planner.cbo import apply_cbo
+    apply_cbo(meta, conf)
     exec_plan = meta.convert()
     # LORE id assignment + dump wrapping (GpuLore.tagForLore analog,
     # GpuOverrides.scala:5149)
@@ -916,4 +925,6 @@ def explain_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None) -> str
     conf = conf or RapidsConf()
     meta = PlanMeta(plan, conf)
     meta.tag()
+    from spark_rapids_tpu.planner.cbo import apply_cbo
+    apply_cbo(meta, conf)
     return meta.explain()

@@ -1,0 +1,299 @@
+"""Per-op type signatures: the TypeChecks/TypeSig analog.
+
+Reference: sql-plugin/src/main/scala/com/nvidia/spark/rapids/TypeChecks.scala
+(:125 TypeSig atoms + per-op ExprChecks) — a declarative table of which SQL
+types each op supports on device, consulted by the tagging pass and rendered
+into docs/supported_ops.md so docs cannot drift from behavior.
+
+Atoms follow the reference's vocabulary: one atom per SQL type, with
+decimal split into the 64-bit fast path and the two-limb 128-bit path the
+way the reference splits DECIMAL_64/DECIMAL_128.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from spark_rapids_tpu import types as T
+
+ATOMS = ("boolean", "byte", "short", "int", "long", "float", "double",
+         "date", "timestamp", "string", "binary", "decimal64",
+         "decimal128", "null", "array", "struct", "map")
+
+
+def atom_of(dt: T.DataType) -> str:
+    if isinstance(dt, T.DecimalType):
+        return "decimal64" if dt.precision <= T.DecimalType.MAX_LONG_DIGITS \
+            else "decimal128"
+    if isinstance(dt, T.ArrayType):
+        return "array"
+    if isinstance(dt, T.StructType):
+        return "struct"
+    if isinstance(dt, T.MapType):
+        return "map"
+    return {
+        T.BooleanType: "boolean", T.ByteType: "byte", T.ShortType: "short",
+        T.IntegerType: "int", T.LongType: "long", T.FloatType: "float",
+        T.DoubleType: "double", T.DateType: "date",
+        T.TimestampType: "timestamp", T.StringType: "string",
+        T.BinaryType: "binary", T.NullType: "null",
+    }[type(dt)]
+
+
+class TypeSig:
+    """An immutable set of supported type atoms."""
+
+    def __init__(self, *atoms: str, note: str = ""):
+        bad = set(atoms) - set(ATOMS)
+        assert not bad, f"unknown type atoms: {bad}"
+        self.atoms = frozenset(atoms)
+        self.note = note
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(*(self.atoms | other.atoms),
+                       note=self.note or other.note)
+
+    def with_note(self, note: str) -> "TypeSig":
+        return TypeSig(*self.atoms, note=note)
+
+    def supports(self, dt: T.DataType) -> bool:
+        a = atom_of(dt)
+        if a == "array":
+            # array support means array<fixed-width primitive> (the
+            # segmented device layout); nested element types are gated
+            if "array" not in self.atoms:
+                return False
+            et = dt.element_type
+            if et is None or et.variable_width or isinstance(
+                    et, (T.ArrayType, T.StructType, T.MapType)):
+                return False
+            return ELEMENTABLE.supports(et)
+        return a in self.atoms
+
+    def __repr__(self):
+        return "+".join(sorted(self.atoms))
+
+
+BOOL = TypeSig("boolean")
+INTEGRAL = TypeSig("byte", "short", "int", "long")
+FRACTIONAL = TypeSig("float", "double")
+NUMERIC = INTEGRAL + FRACTIONAL
+DEC64 = TypeSig("decimal64")
+NUMERIC_DEC = NUMERIC + DEC64
+DATETIME = TypeSig("date", "timestamp")
+STR = TypeSig("string")
+ORDERED = NUMERIC_DEC + DATETIME + BOOL + STR
+COMMON = ORDERED + TypeSig("null")
+ARR = TypeSig("array")
+ALL_DEVICE = COMMON + ARR          # everything kernels handle today
+ELEMENTABLE = NUMERIC_DEC + DATETIME + BOOL   # array element types
+NONE = TypeSig()
+
+
+class ExprSig:
+    """Input/output signature of one expression class.
+
+    params: per-child signatures (cycled if fewer than children — variadic
+    ops repeat the last); out: result signature."""
+
+    def __init__(self, out: TypeSig, *params: TypeSig, note: str = ""):
+        self.out = out
+        self.params = params
+        self.note = note
+
+    def param_for(self, i: int) -> Optional[TypeSig]:
+        if not self.params:
+            return None
+        return self.params[min(i, len(self.params) - 1)]
+
+
+_SIGS: Dict[type, ExprSig] = {}
+
+
+def sig_for(cls) -> Optional[ExprSig]:
+    return _SIGS.get(cls)
+
+
+def register(cls, sig: ExprSig) -> None:
+    _SIGS[cls] = sig
+
+
+def _build_registry() -> None:
+    from spark_rapids_tpu.expressions import core as E
+    from spark_rapids_tpu.expressions import aggregates as A
+    from spark_rapids_tpu.expressions.arithmetic import (
+        Abs, Add, Divide, IntegralDivide, Multiply, Remainder, Subtract,
+        UnaryMinus)
+    from spark_rapids_tpu.expressions import math as M
+    from spark_rapids_tpu.expressions import datetime as DT
+    from spark_rapids_tpu.expressions import predicates as P
+    from spark_rapids_tpu.expressions import strings as S
+    from spark_rapids_tpu.expressions import collections as C
+    from spark_rapids_tpu.expressions import conditional as CO
+    from spark_rapids_tpu.expressions import bitwise as B
+    from spark_rapids_tpu.expressions import hashing as H
+    from spark_rapids_tpu.expressions import window as W
+    from spark_rapids_tpu.expressions.casts import Cast
+
+    # structural / passthrough
+    register(E.Alias, ExprSig(ALL_DEVICE, ALL_DEVICE))
+    register(E.BoundReference, ExprSig(ALL_DEVICE))
+    register(E.Literal, ExprSig(COMMON))
+    register(Cast, ExprSig(COMMON, COMMON,
+                           note="pairwise support via Cast.supported"))
+
+    for cls in (Add, Subtract, Multiply):
+        register(cls, ExprSig(NUMERIC_DEC, NUMERIC_DEC, NUMERIC_DEC))
+    register(Divide, ExprSig(FRACTIONAL + DEC64, NUMERIC_DEC, NUMERIC_DEC))
+    register(IntegralDivide, ExprSig(TypeSig("long"), INTEGRAL + DEC64,
+                                     INTEGRAL + DEC64))
+    register(Remainder, ExprSig(NUMERIC, NUMERIC, NUMERIC))
+    register(UnaryMinus, ExprSig(NUMERIC_DEC, NUMERIC_DEC))
+    register(Abs, ExprSig(NUMERIC_DEC, NUMERIC_DEC))
+
+    for cls in (P.EqualTo, P.EqualNullSafe, P.LessThan, P.LessThanOrEqual,
+                P.GreaterThan, P.GreaterThanOrEqual):
+        register(cls, ExprSig(BOOL, ORDERED, ORDERED))
+    for cls in (P.And, P.Or, P.Not):
+        register(cls, ExprSig(BOOL, BOOL))
+    for cls in (P.IsNull, P.IsNotNull):
+        register(cls, ExprSig(BOOL, ALL_DEVICE))
+    register(P.In, ExprSig(BOOL, ORDERED))
+    register(P.Coalesce, ExprSig(COMMON, COMMON))
+
+    for cls in (CO.If, CO.CaseWhen):
+        register(cls, ExprSig(COMMON))
+    for cls in (CO.Greatest, CO.Least, CO.NullIf):
+        register(cls, ExprSig(NUMERIC_DEC + DATETIME,
+                              NUMERIC_DEC + DATETIME,
+                              note="strings via CPU bridge"))
+    register(CO.Nvl2, ExprSig(COMMON, COMMON))
+
+    # math: double-valued elementwise
+    for name in ("Sqrt", "Cbrt", "Exp", "Sin", "Cos", "Tan", "Atan", "Log",
+                 "Log10", "Log2", "Log1p", "Expm1", "Asin", "Acos", "Sinh",
+                 "Cosh", "Tanh", "Asinh", "Acosh", "Atanh", "Rint",
+                 "Degrees", "Radians", "Cot", "Sec", "Csc"):
+        register(getattr(M, name), ExprSig(TypeSig("double"), NUMERIC))
+    for name in ("Atan2", "Hypot", "Pow", "LogBase", "NanVl"):
+        register(getattr(M, name), ExprSig(TypeSig("double"),
+                                           NUMERIC, NUMERIC))
+    for name in ("Floor", "Ceil", "Round", "Signum"):
+        register(getattr(M, name), ExprSig(NUMERIC_DEC, NUMERIC_DEC))
+    register(M.IsNaN, ExprSig(BOOL, FRACTIONAL))
+    register(M.Pmod, ExprSig(NUMERIC, NUMERIC, NUMERIC))
+    register(M.Factorial, ExprSig(TypeSig("long"), INTEGRAL))
+
+    # datetime
+    for name in ("Year", "Month", "DayOfMonth", "DayOfWeek", "DayOfYear",
+                 "Quarter", "WeekOfYear"):
+        register(getattr(DT, name), ExprSig(TypeSig("int"), DATETIME))
+    for name in ("Hour", "Minute", "Second"):
+        register(getattr(DT, name),
+                 ExprSig(TypeSig("int"), TypeSig("timestamp")))
+
+    # strings
+    for name in ("Upper", "Lower", "Trim", "LTrim", "RTrim", "Reverse",
+                 "InitCap", "Empty2Null"):
+        register(getattr(S, name), ExprSig(STR, STR))
+    register(S.Length, ExprSig(TypeSig("int"), STR))
+    register(S.Substring, ExprSig(STR, STR, TypeSig("int")))
+    for name in ("StartsWith", "EndsWith", "Contains", "Like", "RLike"):
+        register(getattr(S, name), ExprSig(BOOL, STR, STR))
+    register(S.ConcatStrings, ExprSig(STR, STR))
+    register(S.GetJsonObject, ExprSig(STR, STR,
+                                      note="dotted paths on device; "
+                                      "indexed paths via CPU bridge"))
+
+    # collections
+    register(C.Size, ExprSig(TypeSig("int"), ARR))
+    register(C.ArrayContains, ExprSig(BOOL, ARR, ELEMENTABLE))
+    register(C.ArrayPosition, ExprSig(TypeSig("long"), ARR, ELEMENTABLE))
+    register(C.ArrayMin, ExprSig(ELEMENTABLE, ARR))
+    register(C.ArrayMax, ExprSig(ELEMENTABLE, ARR))
+    register(C.SortArray, ExprSig(ARR, ARR, BOOL))
+    register(C.ArrayDistinct, ExprSig(ARR, ARR))
+    register(C.ArrayRemove, ExprSig(ARR, ARR, ELEMENTABLE))
+    register(C.Slice, ExprSig(ARR, ARR, TypeSig("int"), TypeSig("int")))
+    register(C.GetArrayItem, ExprSig(ELEMENTABLE, ARR, TypeSig("int")))
+    register(C.ElementAt, ExprSig(ELEMENTABLE, ARR, TypeSig("int")))
+    register(C.CreateArray, ExprSig(ARR, ELEMENTABLE))
+    register(C.ArrayRepeat, ExprSig(ARR, ELEMENTABLE, TypeSig("int")))
+    register(C.ArrayTransform, ExprSig(ARR, ARR, ELEMENTABLE + BOOL))
+    register(C.ArrayFilter, ExprSig(ARR, ARR, BOOL))
+    register(C.ArrayExists, ExprSig(BOOL, ARR, BOOL))
+    register(C.ArrayForAll, ExprSig(BOOL, ARR, BOOL))
+
+    # hashing / sketches
+    register(H.Murmur3Hash, ExprSig(TypeSig("int"), ORDERED))
+    register(H.XxHash64, ExprSig(TypeSig("long"), ORDERED))
+    register(H.BloomFilterMightContain, ExprSig(BOOL, TypeSig("long")))
+
+    # aggregates
+    register(A.Sum, ExprSig(TypeSig("long", "double", "decimal64"),
+                            NUMERIC_DEC))
+    register(A.Count, ExprSig(TypeSig("long"), ALL_DEVICE))
+    for cls in (A.Min, A.Max):
+        register(cls, ExprSig(ORDERED, ORDERED))
+    register(A.Average, ExprSig(TypeSig("double"), NUMERIC_DEC))
+    for cls in (A.VarianceSamp, A.VariancePop, A.StddevSamp, A.StddevPop):
+        register(cls, ExprSig(TypeSig("double"), NUMERIC))
+    register(A.ApproximateCountDistinct,
+             ExprSig(TypeSig("long"), INTEGRAL + DATETIME + BOOL,
+                     note="long-representable inputs; strings fall back"))
+    for cls in (A.BoolAnd, A.BoolOr):
+        register(cls, ExprSig(BOOL, BOOL))
+
+    # window functions
+    for cls in (W.RowNumber, W.Rank, W.DenseRank):
+        register(cls, ExprSig(TypeSig("int", "long")))
+    for cls in (W.Lead, W.Lag):
+        register(cls, ExprSig(COMMON, COMMON))
+
+
+_build_registry()
+
+
+def check_expr(e) -> Optional[str]:
+    """Signature check for one bound expression node; None = OK."""
+    sig = _SIGS.get(type(e))
+    if sig is None:
+        return None
+    try:
+        out_dt = e.dtype
+    except (TypeError, ValueError, NotImplementedError):
+        return None
+    if not sig.out.supports(out_dt):
+        return (f"produces {out_dt!r}, outside the supported output "
+                f"signature [{sig.out!r}]")
+    for i, c in enumerate(e.children):
+        p = sig.param_for(i)
+        if p is None:
+            continue
+        try:
+            cd = c.dtype
+        except (TypeError, ValueError, NotImplementedError):
+            continue
+        if isinstance(cd, T.NullType):
+            continue   # typed nulls coerce
+        if not p.supports(cd):
+            return (f"input {i} is {cd!r}, outside the supported "
+                    f"signature [{p!r}]")
+    return None
+
+
+def doc_rows():
+    """(name, kind, input sig, output sig, note) rows for docs."""
+    from spark_rapids_tpu.expressions.aggregates import AggregateFunction
+    from spark_rapids_tpu.expressions.window import WindowFunction
+    out = []
+    for cls, sig in sorted(_SIGS.items(), key=lambda kv: kv[0].__name__):
+        if issubclass(cls, AggregateFunction):
+            kind = "aggregate"
+        elif issubclass(cls, WindowFunction):
+            kind = "window"
+        else:
+            kind = "scalar"
+        params = " ; ".join(repr(p) for p in sig.params) if sig.params \
+            else "—"
+        out.append((cls.__name__, kind, params, repr(sig.out), sig.note))
+    return out

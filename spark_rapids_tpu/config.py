@@ -162,7 +162,10 @@ SHUFFLE_MODE = conf("spark.rapids.shuffle.mode").doc(
     "path). MULTITHREADED: host-staged threaded shuffle over the tpu-kudo "
     "wire format (reference MT mode, RapidsShuffleInternalManagerBase"
     ".scala). ICI: gang-scheduled device-to-device all-to-all over the TPU "
-    "interconnect (replaces the reference's UCX mode)."
+    "interconnect (replaces the reference's UCX mode). MULTIPROCESS: "
+    "TCP block-server data plane with heartbeat peer discovery and a "
+    "flow-controlled fetch iterator (shuffle/net.py — the DCN analog of "
+    "the reference's UCX transport for multi-host clusters)."
 ).string_conf("CACHE_ONLY")
 
 SHUFFLE_WRITER_THREADS = conf("spark.rapids.shuffle.multiThreaded.writer.threads").doc(
@@ -191,6 +194,20 @@ TEST_INJECT_RETRY_OOM = conf("spark.rapids.sql.test.injectRetryOOM").doc(
     "(reference: RapidsConf.scala:3041-3083, used by the @inject_oom pytest "
     "marker). Format: true|false or 'count:N' to throw on the Nth allocation."
 ).string_conf("false")
+
+FILECACHE_ENABLED = conf("spark.rapids.filecache.enabled").doc(
+    "Cache scan input files on local disk, keyed by path+mtime+size with "
+    "LRU eviction (reference: filecache/FileCache.scala — remote scan "
+    "bytes land once per host; repeat scans hit local storage)."
+).boolean_conf(False)
+
+FILECACHE_DIR = conf("spark.rapids.filecache.dir").doc(
+    "Directory for cached scan files."
+).string_conf("/tmp/spark_rapids_tpu_filecache")
+
+FILECACHE_MAX_BYTES = conf("spark.rapids.filecache.maxBytes").doc(
+    "LRU size bound for the file cache."
+).bytes_conf(8 << 30)
 
 OPTIMIZER_ENABLED = conf("spark.rapids.sql.optimizer.enabled").doc(
     "Enable the cost-based optimizer: device-capable plan sections fall "
@@ -335,6 +352,18 @@ class RapidsConf:
     @property
     def metrics_level(self) -> str:
         return (self.get(METRICS_LEVEL) or "MODERATE").upper()
+
+    @property
+    def filecache_enabled(self) -> bool:
+        return self.get(FILECACHE_ENABLED)
+
+    @property
+    def filecache_dir(self) -> str:
+        return self.get(FILECACHE_DIR)
+
+    @property
+    def filecache_max_bytes(self) -> int:
+        return self.get(FILECACHE_MAX_BYTES)
 
     @property
     def optimizer_enabled(self) -> bool:

@@ -67,7 +67,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.out_partitions = num_partitions
         self.keys = tuple(keys)
         from spark_rapids_tpu import types as T
-        if mode == "MULTITHREADED" and any(
+        if mode in ("MULTITHREADED", "MULTIPROCESS") and any(
                 isinstance(d, T.ArrayType) for d in self.schema.dtypes):
             # the kudo wire format carries fixed-width + string columns;
             # array payloads stay device-resident (CACHE_ONLY slices)

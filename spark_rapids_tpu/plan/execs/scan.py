@@ -88,20 +88,25 @@ class TpuParquetScanExec(_PooledScanExec):
 
     def __init__(self, paths: Sequence[str], schema: Schema,
                  column_pruning=None, batch_size_rows: int = 1 << 20,
-                 reader_threads: int = 8):
+                 reader_threads: int = 8, conf=None):
         super().__init__((), schema)
         self.paths = list(paths)
         self.column_pruning = column_pruning
         self.batch_size_rows = batch_size_rows
         self.reader_threads = reader_threads
+        self.conf = conf
 
     def num_partitions(self) -> int:
         return max(len(self.paths), 1)
 
     def _host_iter(self, idx: int):
         from spark_rapids_tpu.io.parquet import iter_parquet_arrow
+        path = self.paths[idx]
+        if self.conf is not None:
+            from spark_rapids_tpu.io.filecache import cached_path
+            path = cached_path(path, self.conf)
         return iter_parquet_arrow(
-            self.paths[idx],
+            path,
             columns=list(self.column_pruning) if self.column_pruning else None,
             batch_size_rows=self.batch_size_rows)
 

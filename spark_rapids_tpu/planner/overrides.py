@@ -629,7 +629,8 @@ class PlanMeta:
             return TpuParquetScanExec(
                 p.paths, p.schema, p.column_pruning,
                 self.conf.batch_size_rows,
-                reader_threads=self.conf.multithreaded_read_threads)
+                reader_threads=self.conf.multithreaded_read_threads,
+                conf=self.conf)
         if isinstance(p, L.FileRelation):
             from spark_rapids_tpu.plan.execs.scan import TpuFileScanExec
             return TpuFileScanExec(
@@ -643,7 +644,8 @@ class PlanMeta:
             return TpuParquetScanExec(
                 [df["file_path"] for df in p.files], p.schema,
                 p.projection, self.conf.batch_size_rows,
-                reader_threads=self.conf.multithreaded_read_threads)
+                reader_threads=self.conf.multithreaded_read_threads,
+                conf=self.conf)
         if isinstance(p, L.Project):
             child = self.children[0].convert()
             exprs = [em.transformed() for em in self.expr_metas]
@@ -847,7 +849,7 @@ class PlanMeta:
 
     def _exchange(self, nparts, keys, child) -> TpuExec:
         mode = self.conf.shuffle_mode
-        if mode not in ("CACHE_ONLY", "MULTITHREADED"):
+        if mode not in ("CACHE_ONLY", "MULTITHREADED", "MULTIPROCESS"):
             # ICI mode executes whole queries SPMD (parallel/stage.py inlines
             # the all-to-all into the program); when a plan falls back to the
             # task engine, its exchanges run CACHE_ONLY

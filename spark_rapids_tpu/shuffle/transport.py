@@ -121,9 +121,29 @@ class IciTransport:
         return ici_exchange(self.mesh, shards, key_idx, self.axis_name)
 
 
+_default_executor = None
+_default_executor_lock = threading.Lock()
+
+
+def process_shuffle_executor():
+    """Lazy process-wide ShuffleExecutor node (MULTIPROCESS mode).  In a
+    real multi-host deployment each worker constructs one with the
+    driver's registry address; standalone it self-registers."""
+    global _default_executor
+    with _default_executor_lock:
+        if _default_executor is None:
+            from spark_rapids_tpu.shuffle.net import ShuffleExecutor
+            _default_executor = ShuffleExecutor(serve_registry=True)
+        return _default_executor
+
+
 def make_transport(mode: str, num_partitions: int, schema: Schema,
                    writer_threads: int = 4,
                    codec: str = "none") -> ShuffleTransport:
     if mode == "MULTITHREADED":
         return KudoWireTransport(num_partitions, schema, writer_threads, codec)
+    if mode == "MULTIPROCESS":
+        from spark_rapids_tpu.shuffle.net import TcpShuffleTransport
+        return TcpShuffleTransport(process_shuffle_executor(),
+                                   num_partitions, schema, codec)
     return CacheOnlyTransport(num_partitions)

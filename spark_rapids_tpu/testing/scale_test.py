@@ -1,0 +1,125 @@
+"""Scale-test harness: parameterized sizes x query set -> JSON report.
+
+Reference: integration_tests/.../scaletest/ScaleTest.scala + TestReport
+.scala — a CLI harness that runs a query matrix at a given scale factor /
+complexity, records per-query wall times and row counts, and emits a JSON
+report for trend tracking.
+
+Run: python -m spark_rapids_tpu.testing.scale_test --scale 0.01
+     --iterations 2 --output report.json [--backend cpu|tpu]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List
+
+
+def _queries(sess, scale: float):
+    """The query matrix: names -> zero-arg runners over generated data."""
+    from spark_rapids_tpu.expressions import col, count, lit, sum_
+    from spark_rapids_tpu.expressions.core import Alias
+    from spark_rapids_tpu.testing import tpcds, tpch
+
+    n = max(int(tpch.ROWS_PER_SF * scale), 1000)
+    lineitem = tpch.gen_lineitem(n, batch_rows=1 << 18)
+    fact = tpcds.gen_store_sales(n, batch_rows=1 << 18)
+    dd = tpcds.gen_date_dim()
+    item = tpcds.gen_item()
+
+    def li():
+        return sess.create_dataframe(list(lineitem), num_partitions=4)
+
+    def q6():
+        return tpch.q6(li()).collect()
+
+    def q1():
+        return tpch.q1(li()).collect()
+
+    def q3():
+        return tpcds.q3(
+            sess.create_dataframe(list(fact), num_partitions=4),
+            sess.create_dataframe([dd], num_partitions=1),
+            sess.create_dataframe([item], num_partitions=1)).collect()
+
+    def wide_agg():
+        return (li().group_by("l_linenumber")
+                .agg(Alias(count(), "n"),
+                     Alias(sum_(col("l_orderkey")), "s")).collect())
+
+    def sort_limit():
+        return li().order_by(col("l_orderkey")).limit(100).collect()
+
+    return {"tpch_q6": q6, "tpch_q1": q1, "tpcds_q3": q3,
+            "wide_agg": wide_agg, "sort_limit": sort_limit}, n
+
+
+def run_scale_test(scale: float = 0.01, iterations: int = 2,
+                   sql_enabled: bool = True,
+                   queries: List[str] = None) -> Dict:
+    """-> TestReport-shaped dict."""
+    from spark_rapids_tpu.api.session import TpuSession
+    sess = TpuSession({"spark.rapids.sql.enabled":
+                       "true" if sql_enabled else "false"})
+    matrix, rows = _queries(sess, scale)
+    if queries:
+        matrix = {k: v for k, v in matrix.items() if k in queries}
+    report = {
+        "harness": "spark-rapids-tpu scale test",
+        "scale_factor": scale,
+        "input_rows": rows,
+        "iterations": iterations,
+        "engine": "tpu" if sql_enabled else "cpu-oracle",
+        "queries": {},
+    }
+    for name, fn in matrix.items():
+        times = []
+        out_rows = 0
+        error = None
+        for it in range(iterations):
+            t0 = time.perf_counter()
+            try:
+                out = fn()
+                out_rows = len(out)
+            except Exception as e:  # noqa: BLE001 — report, don't abort
+                error = f"{type(e).__name__}: {e}"
+                break
+            times.append(time.perf_counter() - t0)
+        entry = {"output_rows": out_rows}
+        if error:
+            entry["error"] = error
+        else:
+            entry["times_s"] = [round(t, 4) for t in times]
+            entry["best_s"] = round(min(times), 4)
+            entry["rows_per_sec"] = round(rows / min(times))
+        report["queries"][name] = entry
+    return report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.01,
+                    help="fraction of TPC-H SF1 rows")
+    ap.add_argument("--iterations", type=int, default=2)
+    ap.add_argument("--output", default="scale_test_report.json")
+    ap.add_argument("--backend", choices=("tpu", "cpu"), default="tpu",
+                    help="jax platform to run on")
+    ap.add_argument("--engine", choices=("device", "oracle"),
+                    default="device",
+                    help="device = accelerated engine, oracle = CPU oracle")
+    ap.add_argument("--queries", nargs="*", default=None)
+    args = ap.parse_args()
+    if args.backend == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    report = run_scale_test(args.scale, args.iterations,
+                            sql_enabled=(args.engine == "device"),
+                            queries=args.queries)
+    with open(args.output, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Public-API surface validation.
+
+Reference: api_validation/ (ApiValidation.scala) — detects signature drift
+between the plugin and the Spark versions it shims.  Standalone analog:
+record the public API surface (session/DataFrame/expression entry points +
+config keys) into tools/generated_files/api_surface.json and fail when the
+live surface drops or changes anything recorded there (additions are fine
+and update the snapshot with --update).
+
+Run: python tools/api_check.py [--update]
+"""
+from __future__ import annotations
+
+import inspect
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+SNAPSHOT = os.path.join(REPO, "tools", "generated_files",
+                        "api_surface.json")
+
+
+def _methods(cls) -> dict:
+    out = {}
+    for name, fn in inspect.getmembers(cls):
+        if name.startswith("_") or not callable(fn):
+            continue
+        try:
+            out[name] = str(inspect.signature(fn))
+        except (TypeError, ValueError):
+            out[name] = "(...)"
+    return out
+
+
+def current_surface() -> dict:
+    from spark_rapids_tpu import expressions as F
+    from spark_rapids_tpu.api.session import DataFrame, GroupedData, TpuSession
+    from spark_rapids_tpu.config import _REGISTRY
+
+    return {
+        "TpuSession": _methods(TpuSession),
+        "DataFrame": _methods(DataFrame),
+        "GroupedData": _methods(GroupedData),
+        "functions": sorted(n for n in dir(F) if not n.startswith("_")),
+        "configs": sorted(_REGISTRY.keys()),
+    }
+
+
+def diff_surface(recorded: dict, live: dict) -> list:
+    problems = []
+    for section in recorded:
+        rec = recorded[section]
+        cur = live.get(section)
+        if isinstance(rec, dict):
+            for name, sig in rec.items():
+                if name not in cur:
+                    problems.append(f"{section}.{name} removed")
+                elif cur[name] != sig:
+                    problems.append(
+                        f"{section}.{name} signature changed: "
+                        f"{sig} -> {cur[name]}")
+        else:
+            missing = set(rec) - set(cur)
+            for m in sorted(missing):
+                problems.append(f"{section}: {m} removed")
+    return problems
+
+
+def main() -> int:
+    live = current_surface()
+    if "--update" in sys.argv or not os.path.exists(SNAPSHOT):
+        with open(SNAPSHOT, "w") as f:
+            json.dump(live, f, indent=1, sort_keys=True)
+        print(f"api surface recorded: {SNAPSHOT}")
+        return 0
+    with open(SNAPSHOT) as f:
+        recorded = json.load(f)
+    problems = diff_surface(recorded, live)
+    if problems:
+        print("API validation FAILED:")
+        for p in problems:
+            print("  -", p)
+        return 1
+    print(f"api surface OK ({sum(len(v) for v in live.values())} entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

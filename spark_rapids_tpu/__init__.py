@@ -54,8 +54,12 @@ if not _os.environ.get("SPARK_RAPIDS_TPU_NO_COMPILE_CACHE"):
             _os.path.expanduser("~/.cache/spark_rapids_tpu_xla"))
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        # only persist programs that are actually expensive to build: tiny
+        # eager primitives round-tripping the disk cache cost more in AOT
+        # load/verify than they save (measured ~0.7s per eager host sync
+        # with a 0-threshold cache)
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # cache is an optimization; never fail import over it
 

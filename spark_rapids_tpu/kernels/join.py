@@ -48,6 +48,88 @@ def _key_arrays(col: DeviceColumn, live: jax.Array) -> Tuple[jax.Array, jax.Arra
     return data_key, null_key
 
 
+def _probe_join_single_key(
+    left: ColumnarBatch, lk: int, right: ColumnarBatch, rk: int,
+    join_type: str, out_capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, OverflowStatus]:
+    """Sorted-build + binary-search probe for one fixed-width key.
+
+    Same maps contract as the general kernel.  Null keys never match;
+    normalize_key_column canonicalizes NaN/-0.0 so uint64 order-key
+    equality == Spark equality.
+    """
+    CL, CR = left.capacity, right.capacity
+    left_live = left.live_mask()
+    right_live = right.live_mask()
+    lc = normalize_key_column(left.columns[lk])
+    rc = normalize_key_column(right.columns[rk])
+    lkey = _data_key_fixed(lc, _ASC)
+    rkey = _data_key_fixed(rc, _ASC)
+    lvalid = lc.validity & left_live
+    rvalid = rc.validity & right_live
+
+    # Sort build rows by (validity DESC, key ASC) — a value sentinel would
+    # collide with a legitimate Long.MAX_VALUE key.  The invalid tail is
+    # then OVERWRITTEN with the max sentinel so the full array stays
+    # monotonic for searchsorted; probes equal to the sentinel still
+    # resolve correctly because hi is clamped to the valid prefix.
+    MAXK = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    invalid_rank = (~rvalid).astype(jnp.uint8)
+    perm = jnp.lexsort((rkey, invalid_rank)).astype(jnp.int32)
+    n_build = jnp.sum(rvalid.astype(jnp.int32))
+    pos_b = jnp.arange(CR, dtype=jnp.int32)
+    sorted_keys = jnp.where(pos_b < n_build, rkey[perm], MAXK)
+
+    lo = jnp.searchsorted(sorted_keys, lkey, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(sorted_keys, lkey, side="right").astype(jnp.int32)
+    # a probe key equal to MAXK's sentinel can only "match" build nulls;
+    # clamp the range to the valid-build prefix
+    lo = jnp.minimum(lo, n_build)
+    hi = jnp.minimum(hi, n_build)
+    matches = jnp.where(lvalid, hi - lo, 0)
+
+    if join_type == "left_semi":
+        mask = left_live & (matches > 0)
+        from spark_rapids_tpu.kernels.selection import compaction_map
+        li, count = compaction_map(mask)
+        li = li[:out_capacity] if li.shape[0] >= out_capacity else \
+            jnp.concatenate([li, jnp.full((out_capacity - li.shape[0],),
+                                          OOB, jnp.int32)])
+        ri = jnp.full((out_capacity,), OOB, jnp.int32)
+        return li, ri, count.astype(jnp.int32), \
+            OverflowStatus(count.astype(jnp.int64))
+    if join_type == "left_anti":
+        mask = left_live & (matches == 0)
+        from spark_rapids_tpu.kernels.selection import compaction_map
+        li, count = compaction_map(mask)
+        li = li[:out_capacity] if li.shape[0] >= out_capacity else \
+            jnp.concatenate([li, jnp.full((out_capacity - li.shape[0],),
+                                          OOB, jnp.int32)])
+        ri = jnp.full((out_capacity,), OOB, jnp.int32)
+        return li, ri, count.astype(jnp.int32), \
+            OverflowStatus(count.astype(jnp.int64))
+
+    # inner / left: expand per-probe match ranges
+    null_extend = join_type == "left"
+    out_counts = jnp.where(left_live,
+                           jnp.maximum(matches, 1) if null_extend
+                           else matches, 0).astype(jnp.int64)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int64),
+                               jnp.cumsum(out_counts)])
+    total = offsets[CL]
+    k = jnp.arange(out_capacity, dtype=jnp.int64)
+    row = jnp.clip(jnp.searchsorted(offsets, k, side="right") - 1,
+                   0, CL - 1).astype(jnp.int32)
+    within = (k - offsets[row]).astype(jnp.int32)
+    has_match = matches[row] > 0
+    bpos = jnp.clip(lo[row] + within, 0, CR - 1)
+    livek = k < total
+    li = jnp.where(livek, row, OOB).astype(jnp.int32)
+    ri = jnp.where(livek & has_match, perm[bpos], OOB).astype(jnp.int32)
+    return li, ri, jnp.minimum(total, out_capacity).astype(jnp.int32), \
+        OverflowStatus(total)
+
+
 def join_gather_maps(
     left: ColumnarBatch,
     left_keys: Sequence[int],
@@ -67,6 +149,18 @@ def join_gather_maps(
     CL, CR = left.capacity, right.capacity
     left_live = left.live_mask()
     right_live = right.live_mask()
+
+    if (join_type in ("inner", "left", "left_semi", "left_anti")
+            and len(left_keys) == 1
+            and not left.columns[left_keys[0]].is_string_like
+            and not right.columns[right_keys[0]].is_string_like):
+        # single fixed-width key: probe the sorted build side by binary
+        # search — O((L+R) log R) instead of a full lexsort of L+R rows.
+        # The shape XLA/TPU likes for broadcast joins: one small sort, two
+        # vectorized searchsorteds, one expansion gather.
+        return _probe_join_single_key(
+            left, left_keys[0], right, right_keys[0], join_type,
+            out_capacity)
 
     if join_type == "cross":
         # live rows are contiguous: pair (i, j) directly, no sort needed

@@ -443,8 +443,9 @@ class TpuHashAggregateExec(TpuExec):
         if len(partials) == 1:
             merged_in = partials[0]
         else:
+            from spark_rapids_tpu.plan.execs.coalesce import concat_batches_jit
             cap = round_up_pow2(max(sum(p.capacity for p in partials), 1))
-            merged_in, _ = concat_batches_device(partials, cap)
+            merged_in = concat_batches_jit(partials, cap)
         return with_retry_no_split(lambda: self._jit_merge(merged_in))
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:

@@ -90,9 +90,13 @@ class TpuFilterExec(TpuExec):
             key, [condition], lambda bkt: _p(run, string_bucket=bkt))
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.plan.execs.coalesce import maybe_shrink
         for batch in self.children[0].execute_partition(idx):
             with timed(self.op_time):
                 out = with_retry_no_split(lambda: self._run(batch))
+                # selective filters leave capacity >> rows; re-bucket so
+                # downstream kernels stop paying the static-shape tax
+                out = maybe_shrink(out)
             self.output_rows.add(out.num_rows)
             yield self._count_out(out)
 

@@ -116,18 +116,13 @@ class TpuShuffleExchangeExec(TpuExec):
                 with timed(self.op_time):
                     reordered, counts = with_retry_no_split(
                         lambda: self._jit_slice(batch))
-                    host_counts = np.asarray(counts)
-                    offsets = np.zeros(self.out_partitions + 1, np.int64)
-                    np.cumsum(host_counts, out=offsets[1:])
-                    for p in range(self.out_partitions):
-                        cnt = int(host_counts[p])
-                        if cnt == 0:
-                            continue
-                        cap = round_up_pow2(cnt)
-                        idx = jnp.arange(cap, dtype=jnp.int32) + jnp.int32(offsets[p])
-                        piece = gather_batch(reordered, idx,
-                                             jnp.int32(cnt), out_capacity=cap)
-                        yield p, piece
+                    from spark_rapids_tpu.plan.execs.out_of_core import (
+                        slice_by_counts)
+                    pieces = slice_by_counts(reordered, counts,
+                                             self.out_partitions)
+                    for p, piece in enumerate(pieces):
+                        if piece is not None:
+                            yield p, piece
 
     def _materialize(self):
         """Run the map side once, writing slices through the transport SPI
@@ -167,8 +162,10 @@ class TpuShuffleExchangeExec(TpuExec):
                 if len(group) == 1:
                     out = group[0]
                 else:
+                    from spark_rapids_tpu.plan.execs.coalesce import (
+                        concat_batches_jit)
                     cap = round_up_pow2(max(acc, 1))
-                    out, _ = concat_batches_device(group, cap)
+                    out = concat_batches_jit(group, cap)
             self.output_rows.add(out.num_rows)
             yield self._count_out(out)
             if b is not None:

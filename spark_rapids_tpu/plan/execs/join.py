@@ -180,6 +180,9 @@ class TpuShuffledHashJoinExec(TpuExec):
         with timed(self.op_time):
             out = self._join_pair(coalesce_to_one(left_batches),
                                   coalesce_to_one(right_batches))
+            if out is not None:
+                from spark_rapids_tpu.plan.execs.coalesce import maybe_shrink
+                out = maybe_shrink(out)
         if out is None:
             return
         self.output_rows.add(out.num_rows)

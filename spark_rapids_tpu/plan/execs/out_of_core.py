@@ -59,9 +59,12 @@ def slice_by_counts(
     capacity (pow2-bucketed so the gather kernels stay cached).  Empty
     buckets yield None.
     """
+    from spark_rapids_tpu.plan.execs.base import schema_cache_key, shared_jit
     host_counts = np.asarray(counts)
     offsets = np.zeros(num_buckets + 1, np.int64)
     np.cumsum(host_counts, out=offsets[1:])
+    bcaps = ",".join(str(c.byte_capacity) for c in reordered.columns
+                     if c.offsets is not None)
     out: List[Optional[ColumnarBatch]] = []
     for p in range(num_buckets):
         cnt = int(host_counts[p])
@@ -69,9 +72,14 @@ def slice_by_counts(
             out.append(None)
             continue
         cap = round_up_pow2(cnt)
-        idx = jnp.arange(cap, dtype=jnp.int32) + jnp.int32(int(offsets[p]))
-        out.append(gather_batch(reordered, idx, jnp.int32(cnt),
-                                out_capacity=cap))
+
+        def slice_piece(rb, off, n, _cap=cap):
+            idx = jnp.arange(_cap, dtype=jnp.int32) + off
+            return gather_batch(rb, idx, n, out_capacity=_cap)
+        key = (f"oocslice|{schema_cache_key(reordered.schema)}|"
+               f"{reordered.capacity}|{bcaps}|{cap}")
+        out.append(shared_jit(key, lambda: slice_piece)(
+            reordered, jnp.int32(int(offsets[p])), jnp.int32(cnt)))
     return out
 
 

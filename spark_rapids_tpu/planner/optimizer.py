@@ -41,6 +41,157 @@ def prune_columns(plan: L.LogicalPlan) -> L.LogicalPlan:
     return _prune(plan, set(plan.schema.names))
 
 
+# -- predicate pushdown -------------------------------------------------------
+
+def _split_conjuncts(e: Expression) -> List[Expression]:
+    from spark_rapids_tpu.expressions.predicates import And
+    if isinstance(e, And):
+        return (_split_conjuncts(e.children[0])
+                + _split_conjuncts(e.children[1]))
+    return [e]
+
+
+def _and_all(conjuncts: List[Expression]) -> Expression:
+    from spark_rapids_tpu.expressions.predicates import And
+    out = conjuncts[0]
+    for c in conjuncts[1:]:
+        out = And(out, c)
+    return out
+
+
+def _deterministic(e: Expression) -> bool:
+    # all our expressions are deterministic today; hook for future rand()
+    return True
+
+
+def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Push filter conjuncts below joins/projects/unions toward the scans.
+
+    Catalyst performs this for the reference before the plugin ever sees
+    the plan (PushDownPredicates); our standalone frontend must do it
+    itself or joins run on unfiltered inputs — which also inflates the
+    static batch CAPACITY every downstream kernel pays for."""
+    if not _names_unique(plan):
+        return plan
+    return _push(plan)
+
+
+def _push(plan: L.LogicalPlan) -> L.LogicalPlan:
+    if isinstance(plan, L.Filter):
+        child = plan.child
+        conjuncts = [_unbind(c) for c in _split_conjuncts(plan.condition)]
+        if isinstance(child, L.Join) and child.join_type == "inner":
+            lnames = set(child.left.schema.names)
+            rnames = set(child.right.schema.names)
+            lpush, rpush, keep = [], [], []
+            for c in conjuncts:
+                refs = c.references()
+                if not _deterministic(c):
+                    keep.append(c)
+                elif refs and refs <= lnames:
+                    lpush.append(c)
+                elif refs and refs <= rnames:
+                    rpush.append(c)
+                else:
+                    keep.append(c)
+            if lpush or rpush:
+                left = child.left
+                right = child.right
+                if lpush:
+                    left = L.Filter(_and_all(lpush), left)
+                if rpush:
+                    right = L.Filter(_and_all(rpush), right)
+                new_join = L.Join(
+                    _push(left), _push(right),
+                    [_unbind(k) for k in child.left_keys],
+                    [_unbind(k) for k in child.right_keys],
+                    join_type=child.join_type,
+                    condition=(_unbind(child.condition)
+                               if child.condition is not None else None))
+                if keep:
+                    return L.Filter(_and_all(keep), new_join)
+                return new_join
+        if isinstance(child, L.Project):
+            # push conjuncts whose references are pass-through columns
+            # (plain col/alias-of-col) below the project
+            passthrough = {}
+            for e, n in zip(child.exprs, child.schema.names):
+                inner = e.child if isinstance(e, Alias) else e
+                if isinstance(inner, (BoundReference, Col)):
+                    passthrough[n] = inner.name
+            push, keep = [], []
+            for c in conjuncts:
+                refs = c.references()
+                if refs and refs <= set(passthrough):
+                    push.append(_rename(c, passthrough))
+                else:
+                    keep.append(c)
+            if push:
+                new_child = L.Filter(_and_all(push), child.child)
+                new_proj = L.Project([_unbind(e) for e in child.exprs],
+                                     _push(new_child))
+                if keep:
+                    return L.Filter(_and_all(keep), new_proj)
+                return new_proj
+        if isinstance(child, L.Filter):
+            # merge adjacent filters, then retry pushing the combined one
+            merged = L.Filter(
+                _and_all(conjuncts + [_unbind(c) for c in _split_conjuncts(
+                    child.condition)]), child.child)
+            if not isinstance(child.child, (L.Filter, L.Join, L.Project,
+                                            L.Union)):
+                return L.Filter(merged.condition, _push(child.child))
+            return _push(merged)
+        if isinstance(child, L.Union):
+            # union children may have different column NAMES (only dtypes
+            # are validated); remap each conjunct by position per child
+            parent_names = child.schema.names
+            pushed = []
+            for u in child.children:
+                mapping = dict(zip(parent_names, u.schema.names))
+                cs = [_rename(_unbind(c), mapping) for c in conjuncts]
+                pushed.append(L.Filter(_and_all(cs), u))
+            return L.Union([_push(p) for p in pushed])
+    return _rebuild(plan, [_push(c) for c in plan.children])
+
+
+def _rename(e: Expression, mapping) -> Expression:
+    if isinstance(e, (Col, BoundReference)):
+        return Col(mapping.get(e.name, e.name))
+    if not e.children:
+        return e
+    return e.with_children(tuple(_rename(c, mapping) for c in e.children))
+
+
+def _rebuild(plan: L.LogicalPlan, children) -> L.LogicalPlan:
+    if all(n is o for n, o in zip(children, plan.children)):
+        return plan
+    # node-specific reconstruction with unbound expressions
+    if isinstance(plan, L.Filter):
+        return L.Filter(_unbind(plan.condition), children[0])
+    if isinstance(plan, L.Project):
+        return L.Project([_unbind(e) for e in plan.exprs], children[0])
+    if isinstance(plan, L.Join):
+        return L.Join(children[0], children[1],
+                      [_unbind(k) for k in plan.left_keys],
+                      [_unbind(k) for k in plan.right_keys],
+                      join_type=plan.join_type,
+                      condition=(_unbind(plan.condition)
+                                 if plan.condition is not None else None))
+    if isinstance(plan, L.Aggregate):
+        return L.Aggregate([_unbind(e) for e in plan.group_exprs],
+                           [_unbind(e) for e in plan.agg_exprs], children[0])
+    if isinstance(plan, L.Sort):
+        return L.Sort([( _unbind(e), o) for e, o in plan.orders],
+                      children[0], global_sort=plan.global_sort)
+    if isinstance(plan, L.Limit):
+        return L.Limit(plan.n, children[0])
+    if isinstance(plan, L.Union):
+        return L.Union(children)
+    # conservative: unknown nodes keep original children (no push through)
+    return plan
+
+
 def _exprs_refs(exprs) -> Set[str]:
     out: Set[str] = set()
     for e in exprs:

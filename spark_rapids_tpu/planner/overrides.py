@@ -908,9 +908,9 @@ def _non_agg_leaf_refs(e: E.Expression) -> List[E.Expression]:
 def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
                ) -> Tuple[TpuExec, PlanMeta]:
     """wrapAndTagPlan + convert (GpuOverrides.scala:4423,:5148 analog)."""
-    from spark_rapids_tpu.planner.optimizer import prune_columns
+    from spark_rapids_tpu.planner.optimizer import prune_columns, push_filters
     conf = conf or RapidsConf()
-    plan = prune_columns(plan)
+    plan = prune_columns(push_filters(plan))
     meta = PlanMeta(plan, conf)
     meta.tag()
     from spark_rapids_tpu.planner.cbo import apply_cbo
@@ -925,6 +925,8 @@ def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
 
 def explain_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None) -> str:
     conf = conf or RapidsConf()
+    from spark_rapids_tpu.planner.optimizer import prune_columns, push_filters
+    plan = prune_columns(push_filters(plan))
     meta = PlanMeta(plan, conf)
     meta.tag()
     from spark_rapids_tpu.planner.cbo import apply_cbo

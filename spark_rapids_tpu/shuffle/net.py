@@ -130,21 +130,26 @@ class HeartbeatRegistry:
             self._next_shuffle += 1
             return self._next_shuffle
 
-    def register(self, executor_id: str, host: str, port: int) -> None:
+    def register(self, executor_id: str, host: str, port: int,
+                 role: str = "worker") -> None:
         with self._lock:
-            self._peers[executor_id] = (host, port, time.time())
+            self._peers[executor_id] = (host, port, time.time(), role)
 
     def heartbeat(self, executor_id: str) -> None:
         with self._lock:
             if executor_id in self._peers:
-                h, p, _ = self._peers[executor_id]
-                self._peers[executor_id] = (h, p, time.time())
+                h, p, _, role = self._peers[executor_id]
+                self._peers[executor_id] = (h, p, time.time(), role)
 
-    def peers(self) -> Dict[str, Tuple[str, int]]:
+    def peers(self, workers_only: bool = False) -> Dict[str, Tuple[str, int]]:
+        """Live peers; workers_only excludes registry-only driver nodes
+        (they serve no map output and must not be fetched from)."""
         now = time.time()
         with self._lock:
-            return {eid: (h, p) for eid, (h, p, seen) in self._peers.items()
-                    if now - seen <= self.timeout_s}
+            return {eid: (h, p)
+                    for eid, (h, p, seen, role) in self._peers.items()
+                    if now - seen <= self.timeout_s
+                    and (not workers_only or role == "worker")}
 
 
 class ShuffleBlockServer:
@@ -182,7 +187,8 @@ class ShuffleBlockServer:
                         "complete": outer.store.is_complete(sid)})
                 elif op == "register" and outer.registry is not None:
                     outer.registry.register(header["executor_id"],
-                                            header["host"], header["port"])
+                                            header["host"], header["port"],
+                                            header.get("role", "worker"))
                     _send_msg(self.request, {"ok": True})
                 elif op == "new_shuffle" and outer.registry is not None:
                     _send_msg(self.request,
@@ -190,7 +196,8 @@ class ShuffleBlockServer:
                 elif op == "heartbeat" and outer.registry is not None:
                     outer.registry.heartbeat(header["executor_id"])
                     _send_msg(self.request,
-                              {"peers": outer.registry.peers()})
+                              {"peers": outer.registry.peers(
+                                  workers_only=True)})
                 else:
                     _send_msg(self.request, {"error": f"bad op {op}"})
 
@@ -244,9 +251,10 @@ class PeerClient:
             _, payload = _recv_msg(sock)
             return payload
 
-    def register(self, executor_id: str, host: str, port: int) -> None:
+    def register(self, executor_id: str, host: str, port: int,
+                 role: str = "worker") -> None:
         _request(self.addr, {"op": "register", "executor_id": executor_id,
-                             "host": host, "port": port})
+                             "host": host, "port": port, "role": role})
 
     def heartbeat(self, executor_id: str) -> Dict[str, Tuple[str, int]]:
         h, _ = _request(self.addr, {"op": "heartbeat",
@@ -313,7 +321,8 @@ class TcpShuffleTransport:
     def __init__(self, executor: "ShuffleExecutor", num_partitions: int,
                  schema: Schema, codec: str = "none",
                  max_inflight_bytes: int = 64 << 20,
-                 shuffle_id: Optional[int] = None):
+                 shuffle_id: Optional[int] = None,
+                 completeness_timeout_s: float = 120.0):
         self.shuffle_id = (shuffle_id if shuffle_id is not None
                            else executor.new_shuffle_id())
         self.executor = executor
@@ -321,6 +330,7 @@ class TcpShuffleTransport:
         self.schema = schema
         self.codec = codec
         self.max_inflight = max_inflight_bytes
+        self.completeness_timeout_s = completeness_timeout_s
 
     def write(self, pieces: Iterable[Tuple[int, ColumnarBatch]]) -> None:
         from spark_rapids_tpu.shuffle.serializer import serialize_batch
@@ -339,9 +349,17 @@ class TcpShuffleTransport:
         blocks = self.executor.store.get(self.shuffle_id, partition)
         remote = self.executor.peer_clients(include_self=False)
         if remote:
+            deadline = time.time() + self.completeness_timeout_s
             for peer in remote:
-                peer.list_blocks(self.shuffle_id, partition,
-                                 require_complete=True)
+                while True:   # no silent partial reads: wait for map side
+                    try:
+                        peer.list_blocks(self.shuffle_id, partition,
+                                         require_complete=True)
+                        break
+                    except RuntimeError:
+                        if time.time() >= deadline:
+                            raise
+                        time.sleep(0.05)
             blocks = blocks + list(BlockFetchIterator(
                 remote, self.shuffle_id, partition, self.max_inflight))
         if not blocks:
@@ -362,8 +380,10 @@ class ShuffleExecutor:
 
     def __init__(self, executor_id: Optional[str] = None,
                  driver_addr: Optional[Tuple[str, int]] = None,
-                 serve_registry: bool = False, host: str = "127.0.0.1"):
+                 serve_registry: bool = False, host: str = "127.0.0.1",
+                 role: str = "worker"):
         self.executor_id = executor_id or f"exec-{os.getpid()}"
+        self.role = role
         self.store = BlockStore()
         self.registry = HeartbeatRegistry() if serve_registry else None
         self.server = ShuffleBlockServer(self.store, self.registry,
@@ -373,18 +393,25 @@ class ShuffleExecutor:
         self._driver = driver_addr
         if driver_addr is not None:
             PeerClient(driver_addr).register(
-                self.executor_id, self.server.addr[0], self.server.addr[1])
+                self.executor_id, self.server.addr[0], self.server.addr[1],
+                role=role)
             self.heartbeat()
         elif self.registry is not None:
-            self.registry.register(self.executor_id, *self.server.addr)
+            self.registry.register(self.executor_id, *self.server.addr,
+                                   role=role)
 
     def heartbeat(self) -> None:
-        """Refresh liveness + learn new peers (executorHeartbeat)."""
+        """Refresh liveness + REPLACE the peer view (executorHeartbeat).
+        Replacing (rather than merging) drops peers the registry has timed
+        out, so one crashed worker doesn't poison every later read."""
         if self._driver is not None:
             peers = PeerClient(self._driver).heartbeat(self.executor_id)
-            self._peers.update(peers)
         elif self.registry is not None:
-            self._peers.update(self.registry.peers())
+            peers = dict(self.registry.peers(workers_only=True))
+        else:
+            return
+        peers[self.executor_id] = self.server.addr
+        self._peers = peers
 
     def peer_clients(self, include_self: bool = True) -> List[PeerClient]:
         return [PeerClient(addr) for eid, addr in self._peers.items()

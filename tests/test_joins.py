@@ -133,3 +133,29 @@ def test_join_skewed_keys():
 def test_join_with_injected_oom():
     assert_tpu_cpu_equal(
         lambda s: left_df(s).join(right_df(s), "k"))
+
+
+def test_probe_join_long_max_key():
+    """Long.MAX_VALUE build keys must not collide with the probe path's
+    invalid-row sentinel (regression: silent wrong matches)."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import Schema
+    MAXL = (1 << 63) - 1
+    left = {"k": [MAXL, 5, None, MAXL - 1], "lv": [1, 2, 3, 4]}
+    right = {"k": [MAXL, None, 7], "rv": [10, 20, 30]}
+    ls = Schema.of(k=T.LONG, lv=T.INT)
+    rs = Schema.of(k=T.LONG, rv=T.INT)
+
+    def q(s, how):
+        l = s.create_dataframe(left, ls)
+        r = s.create_dataframe(right, rs)
+        return l.join(r, on=([col("k")], [col("k")]), how=how).collect()
+    for how in ("inner", "left", "left_semi", "left_anti"):
+        assert_tpu_cpu_equal(lambda s, h=how: _df_like(q, s, h))
+
+
+def _df_like(q, s, how):
+    class _W:
+        def collect(self_inner):
+            return q(s, how)
+    return _W()

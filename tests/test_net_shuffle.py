@@ -50,13 +50,16 @@ def test_block_server_and_fetch():
 
 
 def test_heartbeat_discovery():
-    driver = ShuffleExecutor("driver", serve_registry=True)
+    driver = ShuffleExecutor("driver", serve_registry=True, role="driver")
     try:
         w1 = ShuffleExecutor("w1", driver_addr=driver.server.addr)
         w2 = ShuffleExecutor("w2", driver_addr=driver.server.addr)
         try:
             w1.heartbeat()
-            assert {"driver", "w1", "w2"} <= set(w1._peers)
+            # workers discover each other; the registry-only driver is NOT
+            # in the data-plane peer set (it serves no map output)
+            assert {"w1", "w2"} <= set(w1._peers)
+            assert "driver" not in w1._peers
             # w1 can fetch w2's blocks after discovery
             from spark_rapids_tpu.shuffle.serializer import serialize_batch
             w2.store.put(1, 0, serialize_batch(_batch(0, 5)))

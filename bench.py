@@ -117,7 +117,16 @@ def _child_main(backend: str) -> None:
 def _try_backend(backend: str, timeout_s: int):
     """Run the child under a hard timeout; return parsed JSON or error info."""
     env = dict(os.environ)
-    env[CHILD_ENV] = f"{backend}@{os.getpid()}"
+    env[CHILD_ENV] = f"{backend.split('-')[0]}@{os.getpid()}"
+    if backend == "tpu":
+        # persistent XLA cache across bench runs: TPU compiles are 20-40s
+        # each.  The cache write path can crash natively (jaxlib hazard,
+        # spark_rapids_tpu/__init__.py) — the backend ladder retries tpu
+        # WITHOUT the cache before falling back to cpu
+        env.setdefault("SPARK_RAPIDS_TPU_COMPILE_CACHE",
+                       os.path.expanduser("~/.cache/spark_rapids_tpu_xla"))
+    elif backend == "tpu-nocache":
+        env.pop("SPARK_RAPIDS_TPU_COMPILE_CACHE", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -151,7 +160,13 @@ def _child_mode() -> Optional[str]:
 def main() -> None:
 
     errors = []
-    for backend, timeout_s in (("tpu", TPU_TIMEOUT_S), ("cpu", CPU_TIMEOUT_S)):
+    for backend, timeout_s in (("tpu", TPU_TIMEOUT_S),
+                               ("tpu-nocache", TPU_TIMEOUT_S),
+                               ("cpu", CPU_TIMEOUT_S)):
+        if backend == "tpu-nocache" and errors and "timeout" in errors[-1]:
+            # the tunnel is unreachable, not crashed: a cache-less retry
+            # would just burn another timeout window
+            continue
         result, err = _try_backend(backend, timeout_s)
         if result is not None:
             if errors:

@@ -19,45 +19,55 @@ import jax as _jax
 # variableFloatAgg-style caveats; integral types emulate exactly.)
 _jax.config.update("jax_enable_x64", True)
 
-# Serialize XLA compilation: two engine threads compiling concurrently
-# segfault inside jaxlib 0.9's CPU backend_compile_and_load (observed
-# repeatedly under the task thread pool; both faulting stacks sit in
-# backend_compile_and_load).  Execution stays fully parallel — only the
-# compile step takes the lock, and compiles are cached afterwards.
-# Private-API patch, pinned to the baked-in jax version of this image.
+# Serialize XLA compilation AND persistent-cache executable serialization:
+# jaxlib 0.9's CPU backend segfaults under concurrent compile load (faulting
+# stacks observed in backend_compile_and_load and, with the persistent
+# cache enabled, in compilation_cache.put_executable_and_time).  Wrapping
+# _compile_and_write_cache covers both as one unit.  Execution stays fully
+# parallel — only compile+cache-write takes the lock, and compiles are
+# cached afterwards.  Private-API patch, pinned to the baked-in jax version
+# of this image.
 import threading as _threading
 
 import jax._src.compiler as _jax_compiler
 
 if not getattr(_jax_compiler, "_srtpu_compile_lock_installed", False):
-    _compile_lock = _threading.Lock()
+    # RLock: _compile_and_write_cache calls backend_compile_and_load
+    # internally, and both are wrapped
+    _compile_lock = _threading.RLock()
     _orig_backend_compile = _jax_compiler.backend_compile_and_load
+    _orig_compile_and_write = _jax_compiler._compile_and_write_cache
 
     def _serialized_backend_compile(*args, **kwargs):
         with _compile_lock:
             return _orig_backend_compile(*args, **kwargs)
 
+    def _serialized_compile_and_write(*args, **kwargs):
+        with _compile_lock:
+            return _orig_compile_and_write(*args, **kwargs)
+
     _jax_compiler.backend_compile_and_load = _serialized_backend_compile
+    _jax_compiler._compile_and_write_cache = _serialized_compile_and_write
     _jax_compiler._srtpu_compile_lock_installed = True
 
-# Persistent XLA compilation cache: the engine is compile-heavy (per
-# capacity-bucket specialization), and jaxlib 0.9's CPU backend has a rare
-# native crash under concurrent compile+execute load — caching both speeds
-# reruns dramatically and shrinks the crash window.  Opt out with
-# SPARK_RAPIDS_TPU_NO_COMPILE_CACHE=1.
+# Persistent XLA compilation cache — OPT-IN via
+# SPARK_RAPIDS_TPU_COMPILE_CACHE=<dir>.  It speeds compile-heavy reruns
+# dramatically, but jaxlib 0.9's executable SERIALIZATION (cache write,
+# compilation_cache.put_executable_and_time) segfaults natively when other
+# threads are executing programs — reproduced twice on large string-key
+# join programs under the engine thread pool, and not catchable from
+# Python.  Default off; enable for single-process benchmark/driver runs
+# where compiles are effectively serial.
 import os as _os
 
-if not _os.environ.get("SPARK_RAPIDS_TPU_NO_COMPILE_CACHE"):
+_cache_dir = _os.environ.get("SPARK_RAPIDS_TPU_COMPILE_CACHE")
+if _cache_dir:
     try:
-        _cache_dir = _os.environ.get(
-            "SPARK_RAPIDS_TPU_COMPILE_CACHE",
-            _os.path.expanduser("~/.cache/spark_rapids_tpu_xla"))
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         # only persist programs that are actually expensive to build: tiny
         # eager primitives round-tripping the disk cache cost more in AOT
-        # load/verify than they save (measured ~0.7s per eager host sync
-        # with a 0-threshold cache)
+        # load/verify than they save
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:

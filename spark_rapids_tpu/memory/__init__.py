@@ -42,6 +42,11 @@ def initialize_memory(conf) -> None:
     _sem.configure(conf.concurrent_tpu_tasks)
     spill_framework().host_limit_bytes = conf.get(C.HOST_SPILL_STORAGE_SIZE)
     device_arena().check_retry_context = conf.retry_context_check
+    # HBM-budget sizing from the chip's memory stats (GpuDeviceManager):
+    # always on, like the reference's default-fraction pool sizing —
+    # backends with no memory stats (CPU tests) stay in bookkeeping mode
+    from spark_rapids_tpu.memory.device_manager import initialize_device
+    initialize_device(conf)
     # injectRetryOOM accepts: false | true | retry[:num[:skip]] | split[:num[:skip]]
     # (reference parse: RapidsConf.scala:3041-3083).  Only an EXPLICIT key
     # touches the injection state: the @inject_oom test marker arms it

@@ -92,8 +92,15 @@ class _JoinKernel:
             guess = max(nl * max(nr, 1), 1)
         elif self.join_type in ("left_semi", "left_anti"):
             guess = max(nl, 1)
-        else:
+        elif self.join_type == "full":
+            # full outer can exceed max(L,R) whenever both sides have
+            # unmatched rows; L+R never retries
             guess = max(nl + nr, 1)
+        else:
+            # FK-shaped equi-joins output ~probe-side rows; starting at
+            # L+R doubles every downstream buffer for the common broadcast
+            # case.  The capacity-retry loop grows on real fan-out.
+            guess = max(nl, nr, 1)
         bucket = self._key_bucket(l, r)
         cap = round_up_pow2(guess)
         byte_caps = dict(self._string_out_cols(l, r))

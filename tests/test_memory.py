@@ -175,3 +175,27 @@ def test_pinned_handle_refuses_to_spill():
         assert h.on_device()
     assert h.spill_to_host() == h.size_bytes  # unpinned: spillable again
     h.close()
+
+
+def test_device_manager_probe_and_budget():
+    """GpuDeviceManager analog: probe the chip, size the arena budget from
+    allocFraction when HBM stats exist (CPU backend exposes none ->
+    bookkeeping mode)."""
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.memory.device_manager import (
+        DeviceInfo, initialize_device, probe_device)
+    info = probe_device()
+    assert info.platform
+    # fake a chip with 16GiB to check the sizing math
+    import spark_rapids_tpu.memory.device_manager as DM
+    real = DM.probe_device
+    try:
+        DM.probe_device = lambda: DeviceInfo(None, 16 << 30, "tpu")
+        from spark_rapids_tpu.memory import device_arena
+        before = device_arena().budget_bytes
+        initialize_device(RapidsConf(
+            {"spark.rapids.memory.tpu.allocFraction": "0.5"}))
+        assert device_arena().budget_bytes == 8 << 30
+    finally:
+        DM.probe_device = real
+        device_arena().budget_bytes = before

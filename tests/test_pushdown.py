@@ -1,0 +1,104 @@
+"""Predicate pushdown + capacity shrink: plan shapes and differential
+results.
+
+Reference strategy: Catalyst PushDownPredicates is upstream of the plugin;
+here the standalone frontend owns it, so plan-shape assertions live here.
+"""
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expressions import col, lit, sum_, count
+from spark_rapids_tpu.expressions.core import Alias
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.planner.optimizer import push_filters
+from tests.test_queries import assert_tpu_cpu_equal
+
+LS = Schema.of(k=T.INT, v=T.LONG)
+RS = Schema.of(rk=T.INT, tag=T.INT, s=T.STRING)
+
+
+def _dfs(s, n=300):
+    rng = np.random.RandomState(1)
+    left = s.create_dataframe(
+        {"k": rng.randint(0, 50, n).tolist(),
+         "v": rng.randint(-100, 100, n).tolist()}, LS, num_partitions=2)
+    right = s.create_dataframe(
+        {"rk": list(range(50)), "tag": [i % 4 for i in range(50)],
+         "s": [f"t{i}" for i in range(50)]}, RS)
+    return left, right
+
+
+def _plan_of(df):
+    return push_filters(df.plan)
+
+
+def test_filter_pushes_below_inner_join():
+    s = TpuSession({})
+    left, right = _dfs(s)
+    j = left.join(right, on=([col("k")], [col("rk")]))
+    f = j.filter((col("tag") == lit(2)) & (col("v") > lit(0)))
+    p = _plan_of(f)
+    # both conjuncts reference one side each -> no Filter remains on top
+    assert isinstance(p, L.Join), p.describe()
+    assert isinstance(p.left, L.Filter) and isinstance(p.right, L.Filter)
+
+
+def test_cross_side_conjunct_stays():
+    s = TpuSession({})
+    left, right = _dfs(s)
+    j = left.join(right, on=([col("k")], [col("rk")]))
+    f = j.filter(col("v") > col("tag"))
+    p = _plan_of(f)
+    assert isinstance(p, L.Filter) and isinstance(p.child, L.Join)
+
+
+def test_outer_join_not_pushed():
+    s = TpuSession({})
+    left, right = _dfs(s)
+    j = left.join(right, on=([col("k")], [col("rk")]), how="left")
+    f = j.filter(col("tag") == lit(2))
+    p = _plan_of(f)
+    # pushing a right-side filter below a LEFT join changes semantics
+    assert isinstance(p, L.Filter) and isinstance(p.child, L.Join)
+
+
+def test_push_through_project_renames():
+    s = TpuSession({})
+    left, _ = _dfs(s)
+    proj = left.select(Alias(col("k"), "kk"), (col("v") * lit(2)).alias("vv"))
+    f = proj.filter(col("kk") == lit(3))
+    p = _plan_of(f)
+    assert isinstance(p, L.Project) and isinstance(p.child, L.Filter), \
+        p.describe()
+    # computed-column filters cannot push
+    f2 = proj.filter(col("vv") > lit(0))
+    p2 = _plan_of(f2)
+    assert isinstance(p2, L.Filter) and isinstance(p2.child, L.Project)
+
+
+def test_pushdown_differential_results():
+    def q(s):
+        left, right = _dfs(s)
+        j = left.join(right, on=([col("k")], [col("rk")]))
+        return (j.filter((col("tag") == lit(2)) & (col("v") > lit(0)))
+                 .group_by("tag").agg(Alias(count(), "n"),
+                                      Alias(sum_(col("v")), "sv")))
+    assert_tpu_cpu_equal(q)
+
+
+def test_shrink_preserves_strings():
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.plan.execs.coalesce import maybe_shrink
+    n = 20000
+    data = {"a": list(range(n)), "s": [f"val-{i}" for i in range(n)]}
+    sch = Schema.of(a=T.INT, s=T.STRING)
+    b = ColumnarBatch.from_pydict(data, sch)
+    # filter to a tiny prefix via the engine path
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = s.create_dataframe([b]).filter(col("a") < lit(7))
+    parts = df.collect_partitions()
+    out = parts[0][0]
+    assert out.capacity <= 4096, out.capacity
+    assert out.to_pydict()["s"] == [f"val-{i}" for i in range(7)]

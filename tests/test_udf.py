@@ -54,7 +54,17 @@ def test_traced_udf_differential():
 def test_opaque_udf_falls_back_and_is_correct():
     s = TpuSession({"spark.rapids.sql.enabled": "true"})
     plan = df(s).select(col("a"), opaque(col("a"), col("b")).alias("r"))
-    assert "will NOT" in plan.explain()
+    # an untraceable python UDF leaves the device plan: either the whole
+    # node falls back or (better) just the expression runs via the CPU
+    # bridge while the project stays on device
+    e = plan.explain()
+    assert "will NOT" in e or "CPU bridge" in e, e
     assert_tpu_cpu_equal(
         lambda sess: df(sess).select(
             col("a"), opaque(col("a"), col("b")).alias("r")))
+    # and with the bridge disabled it must be a whole-node fallback
+    s2 = TpuSession({"spark.rapids.sql.enabled": "true",
+                     "spark.rapids.sql.expression.cpuBridge.enabled":
+                     "false"})
+    e2 = df(s2).select(opaque(col("a"), col("b")).alias("r")).explain()
+    assert "will NOT" in e2, e2

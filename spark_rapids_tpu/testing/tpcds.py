@@ -163,3 +163,186 @@ def q14a_subset(store_sales_df, item_df):
             .agg(sum_(col("ss_ext_sales_price")).alias("sales"),
                  count().alias("n"),
                  avg("ss_quantity").alias("avg_qty")))
+
+
+# -- multi-channel tables (q5/q14 fidelity) ----------------------------------
+
+CHANNEL_SALES_SCHEMA = Schema.of(
+    cs_sold_date_sk=T.INT,
+    cs_item_sk=T.INT,
+    cs_channel_sk=T.INT,       # store_sk / catalog_page_sk / web_site_sk
+    cs_quantity=T.INT,
+    cs_ext_sales_price=T.DOUBLE,
+    cs_net_profit=T.DOUBLE,
+)
+
+CHANNEL_RETURNS_SCHEMA = Schema.of(
+    cr_returned_date_sk=T.INT,
+    cr_item_sk=T.INT,
+    cr_channel_sk=T.INT,
+    cr_return_amount=T.DOUBLE,
+    cr_net_loss=T.DOUBLE,
+)
+
+
+def _gen_channel_fact(schema, colspec, n_rows: int, seed: int,
+                      seed_stride: int, batch_rows: int):
+    """Shared chunking loop for the channel fact generators."""
+    from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
+    import jax.numpy as jnp
+    out = []
+    remaining = n_rows
+    chunk = 0
+    while remaining > 0:
+        n = min(batch_rows, remaining)
+        rng = np.random.RandomState(seed + seed_stride * chunk)
+        data = colspec(rng, n)
+        cap = round_up_pow2(n)
+        cols = tuple(DeviceColumn.from_numpy(data[m], dt, capacity=cap)
+                     for m, dt in zip(schema.names, schema.dtypes))
+        out.append(ColumnarBatch(cols, jnp.asarray(n, jnp.int32), schema))
+        remaining -= n
+        chunk += 1
+    return out
+
+
+def gen_channel_sales(n_rows: int, n_items: int = 2000, seed: int = 17,
+                      n_channel: int = 50,
+                      batch_rows: int = 1 << 19) -> List[ColumnarBatch]:
+    """Sales fact for one channel (catalog/web shape == store shape)."""
+    def spec(rng, n):
+        return {
+            "cs_sold_date_sk": (2450000 + rng.randint(0, 6 * 365, n)
+                                ).astype(np.int32),
+            "cs_item_sk": (1 + rng.randint(0, n_items, n)).astype(np.int32),
+            "cs_channel_sk": (1 + rng.randint(0, n_channel, n)
+                              ).astype(np.int32),
+            "cs_quantity": rng.randint(1, 100, n).astype(np.int32),
+            "cs_ext_sales_price": np.round(rng.uniform(1.0, 300.0, n), 2),
+            "cs_net_profit": np.round(rng.uniform(-100.0, 200.0, n), 2),
+        }
+    return _gen_channel_fact(CHANNEL_SALES_SCHEMA, spec, n_rows, seed, 131,
+                             batch_rows)
+
+
+def gen_channel_returns(n_rows: int, n_items: int = 2000, seed: int = 19,
+                        n_channel: int = 50,
+                        batch_rows: int = 1 << 19) -> List[ColumnarBatch]:
+    def spec(rng, n):
+        return {
+            "cr_returned_date_sk": (2450000 + rng.randint(0, 6 * 365, n)
+                                    ).astype(np.int32),
+            "cr_item_sk": (1 + rng.randint(0, n_items, n)).astype(np.int32),
+            "cr_channel_sk": (1 + rng.randint(0, n_channel, n)
+                              ).astype(np.int32),
+            "cr_return_amount": np.round(rng.uniform(1.0, 150.0, n), 2),
+            "cr_net_loss": np.round(rng.uniform(0.5, 80.0, n), 2),
+        }
+    return _gen_channel_fact(CHANNEL_RETURNS_SCHEMA, spec, n_rows, seed, 137,
+                             batch_rows)
+
+
+def q5(channels, date_dim_df):
+    """TPC-DS Q5 (full shape): per-channel sales/returns/profit rollup.
+
+    channels: {name: (sales_df, returns_df)} for the store/catalog/web
+    legs.  Each leg unions sales rows (+price, +profit) with returns rows
+    (+return amount as sales_loss, -net_loss as profit), restricts to a
+    one-month date filter (approximating the reference's 14-day window),
+    aggregates per channel entity, then the final
+    `group by rollup(channel, id)` — exactly the reference query's plan
+    shape (union -> agg -> expand/rollup -> sort).
+    """
+    from spark_rapids_tpu.expressions import col, lit, sum_
+    from spark_rapids_tpu.kernels.sort import SortOrder
+
+    legs = []
+    for name, (sales_df, returns_df) in channels.items():
+        s = sales_df.select(
+            col("cs_sold_date_sk").alias("date_sk"),
+            col("cs_channel_sk").alias("id"),
+            col("cs_ext_sales_price").alias("sales_price"),
+            lit(0.0).alias("return_amt"),
+            col("cs_net_profit").alias("profit"),
+            lit(0.0).alias("net_loss"))
+        r = returns_df.select(
+            col("cr_returned_date_sk").alias("date_sk"),
+            col("cr_channel_sk").alias("id"),
+            lit(0.0).alias("sales_price"),
+            col("cr_return_amount").alias("return_amt"),
+            lit(0.0).alias("profit"),
+            col("cr_net_loss").alias("net_loss"))
+        leg = s.union(r).with_column("channel", lit(name))
+        legs.append(leg)
+    all_rows = legs[0]
+    for leg in legs[1:]:
+        all_rows = all_rows.union(leg)
+    dated = all_rows.join(
+        date_dim_df.filter((col("d_year") == lit(2000))
+                           & (col("d_moy") == lit(1))),
+        on=([col("date_sk")], [col("d_date_sk")]))
+    return (dated.rollup("channel", "id")
+            .agg(sum_("sales_price").alias("sales"),
+                 sum_("return_amt").alias("returns_"),
+                 (sum_("profit") - sum_("net_loss")).alias("profit"))
+            .order_by(("channel", SortOrder(True, True)),
+                      ("id", SortOrder(True, True))))
+
+
+def q14a(store_sales_df, catalog_sales_df, web_sales_df, item_df,
+         avg_threshold=None):
+    """TPC-DS Q14a (full shape): cross-channel items + avg-sales gate.
+
+    cross_items: (brand, class->manufact, category) combos sold in ALL
+    three channels (two left-semi joins — the intersect).  avg_threshold
+    plays the avg_sales scalar subquery: when None it is computed from the
+    union of the three channels' prices (a real scalar-subquery execution,
+    host-materialized like Spark's subquery broadcast).  Final: per
+    channel x brand x category rollup of sales filtered to cross items
+    above the average.
+    """
+    from spark_rapids_tpu.expressions import avg, col, count, lit, sum_
+    from spark_rapids_tpu.kernels.sort import SortOrder
+
+    def branded(sales_df):
+        return sales_df.join(
+            item_df.select("i_item_sk", "i_brand_id", "i_manufact_id",
+                           "i_category_id"),
+            on=([col("cs_item_sk")], [col("i_item_sk")]))
+
+    ss_b = branded(store_sales_df)
+    cs_b = branded(catalog_sales_df)
+    ws_b = branded(web_sales_df)
+
+    keys = ["i_brand_id", "i_manufact_id", "i_category_id"]
+    kcols = lambda: ([col(k) for k in keys], [col(k) for k in keys])
+    cross_items = (ss_b.select(*keys)
+                   .join(cs_b.select(*keys), on=kcols(), how="left_semi")
+                   .join(ws_b.select(*keys), on=kcols(), how="left_semi"))
+
+    if avg_threshold is None:
+        # scalar subquery: average extended sales price over all channels
+        union_prices = (store_sales_df.select("cs_ext_sales_price")
+                        .union(catalog_sales_df.select("cs_ext_sales_price"))
+                        .union(web_sales_df.select("cs_ext_sales_price")))
+        rows = union_prices.agg(
+            avg("cs_ext_sales_price").alias("a")).collect()
+        avg_threshold = rows[0][0]
+
+    legs = []
+    for name, df in (("store", ss_b), ("catalog", cs_b), ("web", ws_b)):
+        leg = (df.filter(col("cs_ext_sales_price") > lit(avg_threshold))
+               .join(cross_items, on=kcols(), how="left_semi")
+               .with_column("channel", lit(name)))
+        legs.append(leg.select("channel", "i_brand_id", "i_category_id",
+                               "cs_ext_sales_price"))
+    all_rows = legs[0]
+    for leg in legs[1:]:
+        all_rows = all_rows.union(leg)
+    return (all_rows.rollup("channel", "i_brand_id", "i_category_id")
+            .agg(sum_("cs_ext_sales_price").alias("sales"),
+                 count().alias("n"))
+            .order_by(("channel", SortOrder(True, True)),
+                      ("i_brand_id", SortOrder(True, True)),
+                      ("i_category_id", SortOrder(True, True)),
+                      ("sales", SortOrder(False))))

@@ -2,6 +2,7 @@
 joins correct)."""
 import pytest
 
+from spark_rapids_tpu.api.session import TpuSession
 from spark_rapids_tpu.testing import tpcds
 from tests.test_queries import assert_tpu_cpu_equal
 
@@ -47,3 +48,64 @@ def test_q3_with_injected_oom():
         ss, dd, it = dfs(s)
         return tpcds.q3(ss, dd, it)
     assert_tpu_cpu_equal(build, ignore_order=False)
+
+
+def test_q5_full_multichannel_rollup():
+    """BASELINE gate 2: full-shape q5 — 3 channel legs of sales+returns
+    unions, date-window join, rollup(channel, id)."""
+    def build(s):
+        channels = {}
+        for i, name in enumerate(("catalog", "store", "web")):
+            sales = s.create_dataframe(
+                tpcds.gen_channel_sales(4000, seed=17 + i),
+                num_partitions=2)
+            rets = s.create_dataframe(
+                tpcds.gen_channel_returns(1500, seed=19 + i),
+                num_partitions=2)
+            channels[name] = (sales, rets)
+        dd = s.create_dataframe([tpcds.gen_date_dim()], num_partitions=1)
+        return tpcds.q5(channels, dd)
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows, "q5 produced no rows"
+    # grand-total row from the rollup
+    assert any(r[0] is None and r[1] is None for r in rows)
+    # channel subtotal rows
+    assert any(r[0] == "store" and r[1] is None for r in rows)
+
+
+def test_q5_device_plan_clean():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    channels = {n: (s.create_dataframe(tpcds.gen_channel_sales(500)),
+                    s.create_dataframe(tpcds.gen_channel_returns(200)))
+                for n in ("store", "web")}
+    dd = s.create_dataframe([tpcds.gen_date_dim()])
+    e = tpcds.q5(channels, dd).explain()
+    assert "will NOT" not in e, e
+
+
+def test_q14a_full_cross_channel():
+    """BASELINE gate 2: full-shape q14a — cross-channel intersect via
+    semi joins, avg-sales scalar subquery, rollup over channels."""
+    def build(s):
+        ss = s.create_dataframe(tpcds.gen_channel_sales(3000, seed=41),
+                                num_partitions=2)
+        cs = s.create_dataframe(tpcds.gen_channel_sales(3000, seed=43),
+                                num_partitions=2)
+        ws = s.create_dataframe(tpcds.gen_channel_sales(3000, seed=47),
+                                num_partitions=2)
+        it = s.create_dataframe([tpcds.gen_item(200)], num_partitions=1)
+        # fixed threshold keeps the differential comparison single-query;
+        # the scalar-subquery path is exercised separately below
+        return tpcds.q14a(ss, cs, ws, it, avg_threshold=150.0)
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows and any(r[0] is None for r in rows)
+
+
+def test_q14a_scalar_subquery_threshold():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    ss = s.create_dataframe(tpcds.gen_channel_sales(2000, seed=41))
+    cs = s.create_dataframe(tpcds.gen_channel_sales(2000, seed=43))
+    ws = s.create_dataframe(tpcds.gen_channel_sales(2000, seed=47))
+    it = s.create_dataframe([tpcds.gen_item(200)])
+    rows = tpcds.q14a(ss, cs, ws, it).collect()   # threshold computed live
+    assert rows

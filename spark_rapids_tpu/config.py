@@ -195,6 +195,13 @@ TEST_INJECT_RETRY_OOM = conf("spark.rapids.sql.test.injectRetryOOM").doc(
     "marker). Format: true|false or 'count:N' to throw on the Nth allocation."
 ).string_conf("false")
 
+HYBRID_PARQUET_ENABLED = conf("spark.rapids.sql.hybrid.parquet.enabled").doc(
+    "Decode parquet through the Arrow Dataset (Acero) streaming scanner "
+    "instead of the per-row-group reader — the analog of the reference's "
+    "velox-backed hybrid CPU scan (hybrid/ module): a different native "
+    "decode engine behind the same scan exec."
+).boolean_conf(False)
+
 FILECACHE_ENABLED = conf("spark.rapids.filecache.enabled").doc(
     "Cache scan input files on local disk, keyed by path+mtime+size with "
     "LRU eviction (reference: filecache/FileCache.scala — remote scan "
@@ -352,6 +359,10 @@ class RapidsConf:
     @property
     def metrics_level(self) -> str:
         return (self.get(METRICS_LEVEL) or "MODERATE").upper()
+
+    @property
+    def hybrid_parquet_enabled(self) -> bool:
+        return self.get(HYBRID_PARQUET_ENABLED)
 
     @property
     def filecache_enabled(self) -> bool:

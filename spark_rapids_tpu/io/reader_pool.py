@@ -89,6 +89,12 @@ def prefetched(host_iter_fn: Callable[[], Iterator], num_threads: int,
                     continue
 
     reader_pool(num_threads).submit(produce)
+    # belt-and-braces: the task-completion hook cancels the producer even
+    # when the abandoning caller never closes the generator (GC-delayed
+    # iterators under the engine's task scope;
+    # memory/task_completion.py, ScalableTaskCompletion analog)
+    from spark_rapids_tpu.memory.task_completion import on_task_completion
+    on_task_completion(cancelled.set)
 
     try:
         while True:

@@ -1,0 +1,78 @@
+"""Task-scoped completion callbacks with error isolation.
+
+Reference: ScalableTaskCompletion.scala — Spark's per-task listener list is
+O(n^2)-prone and swallows ordering, so the reference maintains ONE real
+task listener fanning out to registered callbacks, each isolated so a
+throwing callback cannot starve the rest.  The engine analog: each
+partition-task (plan/engine.py run_one) opens a task scope; execs and
+kernels register cleanup/completion callbacks against the CURRENT task;
+scope exit runs them newest-first, collects errors, and raises one
+aggregate after every callback has run.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+_tls = threading.local()
+
+
+class TaskScope:
+    def __init__(self, task_id: int):
+        self.task_id = task_id
+        self._callbacks: List[Callable] = []
+
+    def on_completion(self, fn: Callable) -> None:
+        self._callbacks.append(fn)
+
+    def _run_all(self) -> List[BaseException]:
+        errors: List[BaseException] = []
+        # newest-first, like RAII unwind order
+        for fn in reversed(self._callbacks):
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — isolate each
+                errors.append(e)
+        self._callbacks.clear()
+        return errors
+
+
+def current_task() -> Optional[TaskScope]:
+    return getattr(_tls, "scope", None)
+
+
+def on_task_completion(fn: Callable) -> bool:
+    """Register against the current task; False when no task is active
+    (caller falls back to immediate/owned cleanup)."""
+    scope = current_task()
+    if scope is None:
+        return False
+    scope.on_completion(fn)
+    return True
+
+
+class task_scope:
+    """Context manager wrapping one partition-task."""
+
+    _next_id = [0]
+    _lock = threading.Lock()
+
+    def __enter__(self) -> TaskScope:
+        with task_scope._lock:
+            task_scope._next_id[0] += 1
+            tid = task_scope._next_id[0]
+        self._prev = getattr(_tls, "scope", None)
+        _tls.scope = TaskScope(tid)
+        return _tls.scope
+
+    def __exit__(self, exc_type, exc, tb):
+        scope = _tls.scope
+        _tls.scope = self._prev
+        errors = scope._run_all()
+        if errors and exc is None:
+            raise RuntimeError(
+                f"{len(errors)} task-completion callback(s) failed: "
+                f"{errors[0]!r}") from errors[0]
+        # with an in-flight exception, completion errors are secondary:
+        # swallow them so the original failure propagates
+        return False

@@ -25,10 +25,12 @@ class TpuEngine:
         nparts = plan.num_partitions()
 
         def run_one(p: int) -> List[ColumnarBatch]:
+            from spark_rapids_tpu.memory.task_completion import task_scope
             sem = tpu_semaphore()
             sem.acquire_if_necessary()
             try:
-                return list(plan.execute_partition(p))
+                with task_scope():
+                    return list(plan.execute_partition(p))
             finally:
                 sem.release_if_necessary()
 

@@ -100,15 +100,18 @@ class TpuParquetScanExec(_PooledScanExec):
         return max(len(self.paths), 1)
 
     def _host_iter(self, idx: int):
-        from spark_rapids_tpu.io.parquet import iter_parquet_arrow
         path = self.paths[idx]
         if self.conf is not None:
             from spark_rapids_tpu.io.filecache import cached_path
             path = cached_path(path, self.conf)
+        cols = list(self.column_pruning) if self.column_pruning else None
+        if self.conf is not None and self.conf.hybrid_parquet_enabled:
+            from spark_rapids_tpu.io.hybrid import iter_hybrid_parquet
+            return iter_hybrid_parquet(
+                path, columns=cols, batch_size_rows=self.batch_size_rows)
+        from spark_rapids_tpu.io.parquet import iter_parquet_arrow
         return iter_parquet_arrow(
-            path,
-            columns=list(self.column_pruning) if self.column_pruning else None,
-            batch_size_rows=self.batch_size_rows)
+            path, columns=cols, batch_size_rows=self.batch_size_rows)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         if idx >= len(self.paths):

@@ -909,12 +909,16 @@ def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
                ) -> Tuple[TpuExec, PlanMeta]:
     """wrapAndTagPlan + convert (GpuOverrides.scala:4423,:5148 analog)."""
     from spark_rapids_tpu.planner.optimizer import prune_columns, push_filters
+    from spark_rapids_tpu.planner.rules import (
+        apply_logical_rules, apply_post_tag_rules)
     conf = conf or RapidsConf()
     plan = prune_columns(push_filters(plan))
+    plan = apply_logical_rules(plan, conf)
     meta = PlanMeta(plan, conf)
     meta.tag()
     from spark_rapids_tpu.planner.cbo import apply_cbo
     apply_cbo(meta, conf)
+    apply_post_tag_rules(meta, conf)
     exec_plan = meta.convert()
     # LORE id assignment + dump wrapping (GpuLore.tagForLore analog,
     # GpuOverrides.scala:5149)

@@ -1,0 +1,64 @@
+"""Planner extension rules: user/library hooks into the plan rewrite.
+
+Reference: the StrategyRules/post-hoc extension points
+(GpuOverrides.scala's postColumnarToRowTransition hooks and the
+`spark.rapids.sql.` rule injection seams) — external modules (Delta,
+Iceberg, hybrid) register extra planning behavior without editing the
+core overrides.
+
+Two hook points, mirroring where the reference's extensions attach:
+
+  * logical rules  — LogicalPlan -> LogicalPlan rewrites, applied after
+    the built-in optimizer passes (pushdown, pruning) and before tagging;
+  * post-tag rules — PlanMeta visitors running after tagging and the CBO,
+    able to add will_not_work reasons or clear-sail markers before
+    conversion.
+
+Rules are registered process-wide (like the reference's ShimLoader-time
+registration) and must be idempotent.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Tuple
+
+_lock = threading.Lock()
+_logical_rules: List[Tuple[str, Callable]] = []
+_post_tag_rules: List[Tuple[str, Callable]] = []
+
+
+def register_logical_rule(name: str, fn: Callable) -> None:
+    """fn(plan: LogicalPlan, conf) -> LogicalPlan."""
+    with _lock:
+        _logical_rules[:] = [(n, f) for n, f in _logical_rules if n != name]
+        _logical_rules.append((name, fn))
+
+
+def register_post_tag_rule(name: str, fn: Callable) -> None:
+    """fn(meta: PlanMeta, conf) -> None (mutate tagging state)."""
+    with _lock:
+        _post_tag_rules[:] = [(n, f) for n, f in _post_tag_rules
+                              if n != name]
+        _post_tag_rules.append((name, fn))
+
+
+def unregister(name: str) -> None:
+    with _lock:
+        _logical_rules[:] = [(n, f) for n, f in _logical_rules if n != name]
+        _post_tag_rules[:] = [(n, f) for n, f in _post_tag_rules
+                              if n != name]
+
+
+def apply_logical_rules(plan, conf):
+    with _lock:
+        rules = list(_logical_rules)
+    for _, fn in rules:
+        plan = fn(plan, conf)
+    return plan
+
+
+def apply_post_tag_rules(meta, conf) -> None:
+    with _lock:
+        rules = list(_post_tag_rules)
+    for _, fn in rules:
+        fn(meta, conf)

@@ -78,39 +78,24 @@ def gen_item(n_items: int = 2000, seed: int = 11) -> ColumnarBatch:
 
 def gen_store_sales(n_rows: int, n_items: int = 2000, seed: int = 13,
                     batch_rows: int = 1 << 19) -> List[ColumnarBatch]:
-    out = []
-    remaining = n_rows
-    chunk = 0
-    while remaining > 0:
-        n = min(batch_rows, remaining)
-        rng = np.random.RandomState(seed + 31 * chunk)
-        date_sk = (2450000 + rng.randint(0, 6 * 365, n)).astype(np.int32)
-        item_sk = (1 + rng.randint(0, n_items, n)).astype(np.int32)
+    def spec(rng, n):
         data = {
-            "ss_sold_date_sk": date_sk,
-            "ss_item_sk": item_sk,
-            "ss_customer_sk": (1 + rng.randint(0, 50_000, n)).astype(np.int32),
+            "ss_sold_date_sk": (2450000 + rng.randint(0, 6 * 365, n)
+                                ).astype(np.int32),
+            "ss_item_sk": (1 + rng.randint(0, n_items, n)).astype(np.int32),
+            "ss_customer_sk": (1 + rng.randint(0, 50_000, n)
+                               ).astype(np.int32),
             "ss_store_sk": (1 + rng.randint(0, 50, n)).astype(np.int32),
             "ss_quantity": rng.randint(1, 100, n).astype(np.int32),
             "ss_ext_sales_price": np.round(rng.uniform(1.0, 300.0, n), 2),
             "ss_net_profit": np.round(rng.uniform(-100.0, 200.0, n), 2),
         }
         # a few percent null fact keys, as in real data
-        validity = {}
         null_mask = rng.rand(n) < 0.02
-        from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
-        import jax.numpy as jnp
-        cap = round_up_pow2(n)
-        cols = []
-        for name, dt in zip(STORE_SALES_SCHEMA.names, STORE_SALES_SCHEMA.dtypes):
-            valid = ~null_mask if name == "ss_customer_sk" else np.ones(n, bool)
-            cols.append(DeviceColumn.from_numpy(data[name], dt, valid,
-                                                capacity=cap))
-        out.append(ColumnarBatch(tuple(cols), jnp.asarray(n, jnp.int32),
-                                 STORE_SALES_SCHEMA))
-        remaining -= n
-        chunk += 1
-    return out
+        validity = {"ss_customer_sk": ~null_mask}
+        return data, validity
+    return _gen_channel_fact(STORE_SALES_SCHEMA, spec, n_rows, seed, 31,
+                             batch_rows)
 
 
 def q3(store_sales_df, date_dim_df, item_df):
@@ -187,7 +172,9 @@ CHANNEL_RETURNS_SCHEMA = Schema.of(
 
 def _gen_channel_fact(schema, colspec, n_rows: int, seed: int,
                       seed_stride: int, batch_rows: int):
-    """Shared chunking loop for the channel fact generators."""
+    """Shared chunking loop for the fact generators.
+
+    colspec(rng, n) -> column dict, or (column dict, {name: validity})."""
     from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
     import jax.numpy as jnp
     out = []
@@ -196,10 +183,13 @@ def _gen_channel_fact(schema, colspec, n_rows: int, seed: int,
     while remaining > 0:
         n = min(batch_rows, remaining)
         rng = np.random.RandomState(seed + seed_stride * chunk)
-        data = colspec(rng, n)
+        spec = colspec(rng, n)
+        data, validity = spec if isinstance(spec, tuple) else (spec, {})
         cap = round_up_pow2(n)
-        cols = tuple(DeviceColumn.from_numpy(data[m], dt, capacity=cap)
-                     for m, dt in zip(schema.names, schema.dtypes))
+        cols = tuple(
+            DeviceColumn.from_numpy(data[m], dt, validity.get(m),
+                                    capacity=cap)
+            for m, dt in zip(schema.names, schema.dtypes))
         out.append(ColumnarBatch(cols, jnp.asarray(n, jnp.int32), schema))
         remaining -= n
         chunk += 1

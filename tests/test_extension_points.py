@@ -1,0 +1,121 @@
+"""Planner extension rules, task-completion callbacks, hybrid scan.
+
+Reference strategy: StrategyRules/post-hoc hook suites,
+ScalableTaskCompletionSuite, hybrid scan integration tests.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expressions import col, lit, count, sum_
+from spark_rapids_tpu.expressions.core import Alias
+from tests.test_queries import assert_tpu_cpu_equal
+
+
+def _df(s, n=200):
+    return s.create_dataframe(
+        {"k": [i % 5 for i in range(n)], "v": list(range(n))},
+        Schema.of(k=T.INT, v=T.LONG), num_partitions=2)
+
+
+def test_logical_rule_rewrites_plan():
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.planner import rules
+
+    seen = []
+
+    def add_limit(plan, conf):
+        seen.append(type(plan).__name__)
+        return L.Limit(7, plan)
+
+    rules.register_logical_rule("test-limit", add_limit)
+    try:
+        s = TpuSession({"spark.rapids.sql.enabled": "true"})
+        rows = _df(s).select(col("v")).collect()
+        assert len(rows) == 7 and seen
+    finally:
+        rules.unregister("test-limit")
+    # unregistered: full results again
+    s2 = TpuSession({"spark.rapids.sql.enabled": "true"})
+    assert len(_df(s2).select(col("v")).collect()) == 200
+
+
+def test_post_tag_rule_forces_fallback():
+    from spark_rapids_tpu.planner import rules
+
+    def no_aggregates(meta, conf):
+        from spark_rapids_tpu.plan import logical as L
+        if isinstance(meta.plan, L.Aggregate):
+            meta.will_not_work("blocked by test post-tag rule")
+        for c in meta.children:
+            no_aggregates(c, conf)
+
+    rules.register_post_tag_rule("test-block-agg", no_aggregates)
+    try:
+        s = TpuSession({"spark.rapids.sql.enabled": "true"})
+        df = _df(s).group_by("k").agg(Alias(count(), "n"))
+        # assert through execution: the blocked aggregate still returns
+        # correct rows via the CPU-fallback island
+        rows = sorted(df.collect())
+        assert rows == sorted(
+            _df(TpuSession({"spark.rapids.sql.enabled": "false"}))
+            .group_by("k").agg(Alias(count(), "n")).collect())
+    finally:
+        rules.unregister("test-block-agg")
+
+
+def test_task_completion_callbacks_run_and_isolate():
+    from spark_rapids_tpu.memory.task_completion import (
+        on_task_completion, task_scope)
+    ran = []
+    with pytest.raises(RuntimeError):
+        with task_scope():
+            on_task_completion(lambda: ran.append("a"))
+            on_task_completion(lambda: 1 / 0)        # must not starve 'a'
+            on_task_completion(lambda: ran.append("b"))
+    assert ran == ["b", "a"]   # newest-first, error isolated
+    # no active scope -> registration reports False
+    assert on_task_completion(lambda: None) is False
+
+
+def test_task_scope_wraps_engine_tasks():
+    from spark_rapids_tpu.memory import task_completion as tc
+    observed = []
+    orig = tc.task_scope.__enter__
+
+    def spy(self):
+        scope = orig(self)
+        observed.append(scope.task_id)
+        return scope
+    tc.task_scope.__enter__ = spy
+    try:
+        s = TpuSession({"spark.rapids.sql.enabled": "true"})
+        _df(s).select(col("v") + lit(1)).collect()
+        assert observed, "engine tasks did not open task scopes"
+    finally:
+        tc.task_scope.__enter__ = orig
+
+
+def test_hybrid_parquet_scan_differential(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    n = 5000
+    pq.write_table(pa.table({
+        "k": [i % 7 for i in range(n)],
+        "v": list(range(n)),
+        "s": [f"s{i % 13}" for i in range(n)]}), str(tmp_path / "h.parquet"))
+
+    def q(sess):
+        return (sess.read_parquet(str(tmp_path / "h.parquet"))
+                .filter(col("v") % lit(3) == lit(0))
+                .group_by("k").agg(Alias(count(), "n"),
+                                   Alias(sum_(col("v")), "sv")))
+
+    hybrid = TpuSession({"spark.rapids.sql.enabled": "true",
+                         "spark.rapids.sql.hybrid.parquet.enabled": "true"})
+    plain = TpuSession({"spark.rapids.sql.enabled": "true"})
+    oracle = TpuSession({"spark.rapids.sql.enabled": "false"})
+    a = sorted(q(hybrid).collect())
+    assert a == sorted(q(plain).collect()) == sorted(q(oracle).collect())

@@ -164,8 +164,11 @@ class GroupedData:
         """rollup/cube: Expand (one projection per grouping set, excluded
         keys nulled + a grouping-id column) -> Aggregate on keys+gid ->
         project the gid away.  Spark's ExpandExec+Aggregate plan shape
-        (reference GpuExpandExec.scala)."""
+        (reference GpuExpandExec.scala).  grouping_id() markers in the
+        aggregate outputs resolve to the internal gid column (Spark's
+        spark_grouping_id bit encoding: bit set = key NOT grouped)."""
         from spark_rapids_tpu.expressions.core import Col, Literal
+        from spark_rapids_tpu.expressions.grouping import GroupingId
         child = self.df.plan
         key_names = []
         for k in self.keys:
@@ -192,13 +195,38 @@ class GroupedData:
             proj.append(Literal(gid, T.INT))
             projections.append(proj)
         expanded = L.Expand(projections, names, child)
-        # group on the nulled copies + _gid; _gid stays out of the output
-        # (Spark drops spark_grouping_id unless grouping_id() is selected)
-        from spark_rapids_tpu.expressions.core import Alias
+        # group on the nulled copies + _gid
+        from spark_rapids_tpu.expressions.core import Alias, output_name
         group_keys = [Alias(col(f"_gk{i}"), key_names[i])
                       for i in range(nkeys)] + [col("_gid")]
-        agg = L.Aggregate(group_keys, aggs, expanded)
+        # grouping_id() outputs read the gid GROUP KEY column through the
+        # final projection (grouping refs cannot ride in the aggregate
+        # outputs); any expression OVER grouping_id with no aggregate
+        # calls moves wholesale to the projection
+        from spark_rapids_tpu.expressions.aggregates import find_aggregates
+        from spark_rapids_tpu.expressions.grouping import (
+            _contains_grouping_id, substitute_grouping_id)
+        real_aggs = []
+        gid_slots = []   # (position in agg list, projection expr)
+        for i, a in enumerate(aggs):
+            if not _contains_grouping_id(a):
+                real_aggs.append(a)
+                continue
+            if find_aggregates(a):
+                raise NotImplementedError(
+                    "grouping_id() mixed with aggregate calls in one "
+                    "output expression; compute them as separate outputs "
+                    "and combine with a select() afterwards")
+            out_name = output_name(a, i)
+            expr = substitute_grouping_id(
+                a.child if isinstance(a, Alias) else a)
+            gid_slots.append((i, Alias(expr, out_name)))
+        agg = L.Aggregate(group_keys, real_aggs, expanded)
+        # _gid is dropped from the output unless grouping_id() asked for it
+        # (Spark drops spark_grouping_id unless selected explicitly)
         keep = [col(n) for n in agg.schema.names if n != "_gid"]
+        for pos, proj_expr in gid_slots:
+            keep.insert(nkeys + pos, proj_expr)
         return DataFrame(L.Project(keep, agg), self.df.session)
 
     def apply_in_pandas(self, fn, schema: Schema) -> "DataFrame":

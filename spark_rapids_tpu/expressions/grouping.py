@@ -1,0 +1,60 @@
+"""grouping_id() — the grouping-set discriminator.
+
+Reference: Spark's GroupingID expression (supported by the reference's
+rollup/cube handling through ExpandExec's gid column).  A marker resolved
+during rollup/cube planning to the internal `_gid` column the Expand
+projections emit; Spark's bit encoding (most-significant bit = first key,
+bit set = key NOT part of this grouping set) is reproduced by
+GroupedData._grouping_sets_agg.
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.core import Col, Expression
+
+
+class GroupingId(Expression):
+    """Marker; only valid inside rollup/cube aggregate outputs."""
+
+    children = ()
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return self
+
+    def bind(self, schema):
+        raise ValueError(
+            "grouping_id() is only valid in rollup()/cube() aggregate "
+            "outputs (Spark: GROUPING__ID outside GROUPING SETS)")
+
+    def __repr__(self):
+        return "grouping_id()"
+
+
+def grouping_id() -> GroupingId:
+    return GroupingId()
+
+
+def _contains_grouping_id(e: Expression) -> bool:
+    if isinstance(e, GroupingId):
+        return True
+    return any(_contains_grouping_id(c) for c in e.children)
+
+
+def substitute_grouping_id(e: Expression) -> Expression:
+    """Replace GroupingId markers with the internal gid column ref."""
+    if isinstance(e, GroupingId):
+        return Col("_gid")
+    if not e.children:
+        return e
+    ch = tuple(substitute_grouping_id(c) for c in e.children)
+    if all(n is o for n, o in zip(ch, e.children)):
+        return e
+    return e.with_children(ch)

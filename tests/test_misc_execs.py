@@ -107,3 +107,46 @@ def test_rollup_plan_uses_expand_on_device():
     s = TpuSession({"spark.rapids.sql.enabled": "true"})
     e = _df(s).rollup("k").agg(Alias(count(), "n")).explain()
     assert "Expand" in e and "will NOT" not in e, e
+
+
+def test_grouping_id():
+    from spark_rapids_tpu.expressions.grouping import grouping_id
+
+    def q(s):
+        df = s.create_dataframe({"k": [1, 1, 2], "g": [10, 20, 20],
+                                 "v": [5, 6, 7]},
+                                Schema.of(k=T.INT, g=T.INT, v=T.LONG))
+        return df.rollup("k", "g").agg(
+            Alias(sum_(col("v")), "sv"),
+            Alias(grouping_id(), "gid"))
+    rows = assert_tpu_cpu_equal(q)
+    by = {(r[0], r[1]): r for r in rows}
+    assert by[(None, None)][3] == 3        # grand total: both bits set
+    assert by[(1, None)][3] == 1           # g not grouped
+    assert by[(1, 10)][3] == 0             # fully grouped
+    # outside grouping sets: loud error
+    import pytest as _pytest
+    s = TpuSession({})
+    with _pytest.raises(ValueError):
+        s.create_dataframe({"k": [1]}, Schema.of(k=T.INT)) \
+            .group_by("k").agg(Alias(grouping_id(), "x")).collect()
+
+
+def test_grouping_id_in_expression():
+    from spark_rapids_tpu.expressions.grouping import grouping_id
+
+    def q(s):
+        df = s.create_dataframe({"k": [1, 2], "v": [5, 6]},
+                                Schema.of(k=T.INT, v=T.LONG))
+        return df.rollup("k").agg(
+            Alias(sum_(col("v")), "sv"),
+            Alias(grouping_id() * lit(10), "gx"))
+    rows = assert_tpu_cpu_equal(q)
+    assert any(r[0] is None and r[2] == 10 for r in rows), rows
+    # mixed aggregate + grouping_id in one expression: loud error
+    import pytest as _pytest
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = s.create_dataframe({"k": [1]}, Schema.of(k=T.INT))
+    with _pytest.raises(NotImplementedError):
+        df.rollup("k").agg(
+            Alias(count() + grouping_id(), "bad")).collect()

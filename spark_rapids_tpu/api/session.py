@@ -192,7 +192,7 @@ class GroupedData:
                     proj.append(col(kn))
                 else:
                     proj.append(Literal(None, child.schema.dtype_of(kn)))
-            proj.append(Literal(gid, T.INT))
+            proj.append(Literal(gid, T.LONG))
             projections.append(proj)
         expanded = L.Expand(projections, names, child)
         # group on the nulled copies + _gid
@@ -409,16 +409,25 @@ class DataFrame:
         return DataFrame(L.MapBatches(_wrapper, schema, self.plan),
                          self.session)
 
-    def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
-        if isinstance(on, str):
-            on = [on]
-        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             condition=None) -> "DataFrame":
+        """Equi-join on `on` keys plus optional residual `condition` (an
+        expression over left-then-right columns, Spark's non-equi join
+        predicate).  `on=None` with a condition is a nested-loop/cartesian
+        join; `how="existence"` appends a boolean `exists` column instead
+        of right columns."""
+        if on is None:
+            lkeys, rkeys = [], []
+        elif isinstance(on, str):
+            lkeys = rkeys = [col(on)]
+        elif isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
             lkeys = [col(k) for k in on]
             rkeys = [col(k) for k in on]
         else:
             lkeys, rkeys = on
         return DataFrame(
-            L.Join(self.plan, other.plan, lkeys, rkeys, join_type=how),
+            L.Join(self.plan, other.plan, lkeys, rkeys, join_type=how,
+                   condition=condition),
             self.session)
 
     # -- actions ------------------------------------------------------------

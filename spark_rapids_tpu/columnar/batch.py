@@ -84,15 +84,16 @@ class ColumnarBatch:
         return int(self.num_rows)
 
     def device_size_bytes(self) -> int:
-        total = 0
-        for c in self.columns:
-            total += c.data.size * c.data.dtype.itemsize
-            total += c.validity.size
+        def col_bytes(c):
+            total = c.data.size * c.data.dtype.itemsize + c.validity.size
             if c.offsets is not None:
                 total += c.offsets.size * 4
             if c.child_validity is not None:
                 total += c.child_validity.size
-        return total
+            if c.children is not None:
+                total += sum(col_bytes(k) for k in c.children)
+            return total
+        return sum(col_bytes(c) for c in self.columns)
 
     # -- host interop -------------------------------------------------------
 
@@ -103,20 +104,8 @@ class ColumnarBatch:
         cap = capacity if capacity is not None else round_up_pow2(max(n, 1))
         cols = []
         for name, dtype in zip(schema.names, schema.dtypes):
-            vals = data[name]
-            if isinstance(dtype, T.ArrayType):
-                cols.append(DeviceColumn.from_arrays(vals, dtype, capacity=cap))
-            elif dtype.variable_width:
-                cols.append(DeviceColumn.from_strings(vals, capacity=cap, dtype=dtype))
-            else:
-                arr = np.zeros((n,), dtype=dtype.np_dtype)
-                valid = np.ones((n,), dtype=np.bool_)
-                for i, v in enumerate(vals):
-                    if v is None:
-                        valid[i] = False
-                    else:
-                        arr[i] = v
-                cols.append(DeviceColumn.from_numpy(arr, dtype, valid, capacity=cap))
+            cols.append(DeviceColumn._from_values(data[name], dtype,
+                                                  capacity=cap))
         return ColumnarBatch(tuple(cols), jnp.asarray(n, dtype=jnp.int32), schema)
 
     @staticmethod

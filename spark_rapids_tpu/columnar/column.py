@@ -42,12 +42,20 @@ def round_up_pow2(n: int) -> int:
 class DeviceColumn:
     """One SQL column in HBM.  A pytree: jit-traceable, shardable.
 
-    Three layouts (reference: GpuColumnVector.java over cudf column views):
+    Five layouts (reference: GpuColumnVector.java over cudf column views):
       * fixed-width:  data[cap] + validity[cap]
       * string/binary: offsets[cap+1] + data[byte_cap u8] + validity[cap]
       * array<fixed-width elem>: offsets[cap+1] + data[elem_cap of elem dtype]
         + child_validity[elem_cap] + validity[cap] — same segmented layout as
         strings, so gather/concat/partition reuse the offsets machinery.
+      * struct<f1,...>: validity[cap] + children (one DeviceColumn per
+        field at the same capacity); data is a 1-byte placeholder so
+        capacity/shape plumbing stays uniform.  The cudf layout exactly
+        (null struct rows keep their field slots, read as null through
+        the struct validity).
+      * map<k,v>: offsets[cap+1] + children (keys, values) at entry
+        capacity + validity[cap]; data is an entry-capacity placeholder
+        (cudf's LIST<STRUCT<K,V>> layout with the struct flattened).
     """
 
     data: jax.Array                  # [capacity]; [byte_capacity] for strings;
@@ -56,26 +64,36 @@ class DeviceColumn:
     dtype: T.DataType                # static
     offsets: Optional[jax.Array] = None  # [capacity+1] int32, strings/arrays
     child_validity: Optional[jax.Array] = None  # [elem_capacity] bool, arrays
+    children: Optional[Tuple["DeviceColumn", ...]] = None  # struct/map
 
     def tree_flatten(self):
-        if self.child_validity is not None:
-            return (self.data, self.validity, self.offsets,
-                    self.child_validity), self.dtype
+        leaves = [self.data, self.validity]
         if self.offsets is not None:
-            return (self.data, self.validity, self.offsets), self.dtype
-        return (self.data, self.validity), self.dtype
+            leaves.append(self.offsets)
+        if self.child_validity is not None:
+            leaves.append(self.child_validity)
+        if self.children is not None:
+            leaves.extend(self.children)
+        aux = (self.dtype, self.offsets is not None,
+               self.child_validity is not None,
+               len(self.children) if self.children is not None else -1)
+        return tuple(leaves), aux
 
     @classmethod
-    def tree_unflatten(cls, dtype, children):
-        if len(children) == 4:
-            data, validity, offsets, child_validity = children
-            return cls(data=data, validity=validity, dtype=dtype,
-                       offsets=offsets, child_validity=child_validity)
-        if len(children) == 3:
-            data, validity, offsets = children
-            return cls(data=data, validity=validity, dtype=dtype, offsets=offsets)
-        data, validity = children
-        return cls(data=data, validity=validity, dtype=dtype, offsets=None)
+    def tree_unflatten(cls, aux, leaves):
+        if not isinstance(aux, tuple):        # legacy aux: bare dtype
+            dtype, has_off, has_cv, n_kids = aux, len(leaves) >= 3, len(leaves) == 4, -1
+        else:
+            dtype, has_off, has_cv, n_kids = aux
+        leaves = list(leaves)
+        data = leaves.pop(0)
+        validity = leaves.pop(0)
+        offsets = leaves.pop(0) if has_off else None
+        child_validity = leaves.pop(0) if has_cv else None
+        children = tuple(leaves) if n_kids >= 0 else None
+        return cls(data=data, validity=validity, dtype=dtype,
+                   offsets=offsets, child_validity=child_validity,
+                   children=children)
 
     @property
     def capacity(self) -> int:
@@ -86,22 +104,50 @@ class DeviceColumn:
     @property
     def byte_capacity(self) -> int:
         """Element-slot capacity of the variable-width child buffer (bytes
-        for strings, elements for arrays)."""
+        for strings, elements for arrays, entries for maps)."""
         assert self.offsets is not None
         return self.data.shape[0]
 
     @property
     def is_string_like(self) -> bool:
-        return self.offsets is not None and self.child_validity is None
+        return (self.offsets is not None and self.child_validity is None
+                and self.children is None)
 
     @property
     def is_array(self) -> bool:
         return self.child_validity is not None
 
+    @property
+    def is_struct(self) -> bool:
+        return self.children is not None and self.offsets is None
+
+    @property
+    def is_map(self) -> bool:
+        return self.children is not None and self.offsets is not None
+
     # -- constructors -------------------------------------------------------
 
     @staticmethod
     def empty(dtype: T.DataType, capacity: int, byte_capacity: int = 0) -> "DeviceColumn":
+        if isinstance(dtype, T.StructType):
+            return DeviceColumn(
+                data=jnp.zeros((capacity,), dtype=jnp.int8),
+                validity=jnp.zeros((capacity,), dtype=jnp.bool_),
+                dtype=dtype,
+                children=tuple(DeviceColumn.empty(f.dtype, capacity,
+                                                  byte_capacity)
+                               for f in dtype.fields),
+            )
+        if isinstance(dtype, T.MapType):
+            ecap = max(byte_capacity, 1)
+            return DeviceColumn(
+                data=jnp.zeros((ecap,), dtype=jnp.uint8),
+                validity=jnp.zeros((capacity,), dtype=jnp.bool_),
+                dtype=dtype,
+                offsets=jnp.zeros((capacity + 1,), dtype=jnp.int32),
+                children=(DeviceColumn.empty(dtype.key_type, ecap, ecap),
+                          DeviceColumn.empty(dtype.value_type, ecap, ecap)),
+            )
         if isinstance(dtype, T.ArrayType):
             return DeviceColumn(
                 data=jnp.zeros((byte_capacity,), dtype=dtype.element_type.jnp_dtype),
@@ -249,6 +295,110 @@ class DeviceColumn:
             child_validity=jnp.asarray(cvalid),
         )
 
+    @staticmethod
+    def _from_values(values, dtype: T.DataType,
+                     capacity: Optional[int] = None) -> "DeviceColumn":
+        """Dispatch host upload by dtype (used recursively for nesting)."""
+        if isinstance(dtype, T.StructType):
+            return DeviceColumn.from_structs(values, dtype, capacity=capacity)
+        if isinstance(dtype, T.MapType):
+            return DeviceColumn.from_maps(values, dtype, capacity=capacity)
+        if isinstance(dtype, T.ArrayType):
+            return DeviceColumn.from_arrays(values, dtype, capacity=capacity)
+        if dtype.variable_width:
+            return DeviceColumn.from_strings(values, capacity=capacity,
+                                             dtype=dtype)
+        n = len(values)
+        arr = np.zeros((n,), dtype=dtype.np_dtype)
+        valid = np.ones((n,), dtype=np.bool_)
+        for i, v in enumerate(values):
+            if v is None:
+                valid[i] = False
+            else:
+                arr[i] = v
+        return DeviceColumn.from_numpy(arr, dtype, valid, capacity=capacity)
+
+    @staticmethod
+    def from_structs(values, dtype: T.DataType,
+                     capacity: Optional[int] = None) -> "DeviceColumn":
+        """Host→HBM upload of a struct column.
+
+        Rows are None (null struct), dicts keyed by field name, or
+        tuples/lists in field order.  Fields of a null struct upload as
+        null so canonical padding holds at every nesting level."""
+        assert isinstance(dtype, T.StructType)
+        n = len(values)
+        cap = capacity if capacity is not None else round_up_pow2(max(n, 1))
+        valid = np.ones((n,), dtype=np.bool_)
+        per_field = [[] for _ in dtype.fields]
+        for i, row in enumerate(values):
+            if row is None:
+                valid[i] = False
+                for fv in per_field:
+                    fv.append(None)
+                continue
+            for j, f in enumerate(dtype.fields):
+                per_field[j].append(row[f.name] if isinstance(row, dict)
+                                    else row[j])
+        children = tuple(
+            DeviceColumn._from_values(per_field[j], f.dtype, capacity=cap)
+            for j, f in enumerate(dtype.fields))
+        validity_full = np.zeros((cap,), dtype=np.bool_)
+        validity_full[:n] = valid
+        return DeviceColumn(
+            data=jnp.zeros((cap,), dtype=jnp.int8),
+            validity=jnp.asarray(validity_full),
+            dtype=dtype,
+            children=children,
+        )
+
+    @staticmethod
+    def from_maps(values, dtype: T.DataType,
+                  capacity: Optional[int] = None,
+                  entry_capacity: Optional[int] = None) -> "DeviceColumn":
+        """Host→HBM upload of a map column.
+
+        Rows are None (null map) or dicts / lists of (key, value) pairs;
+        entry order is preserved (Spark maps are ordered by insertion)."""
+        assert isinstance(dtype, T.MapType)
+        n = len(values)
+        valid = np.ones((n,), dtype=np.bool_)
+        lengths = np.zeros((n,), dtype=np.int64)
+        flat_keys: list = []
+        flat_vals: list = []
+        for i, row in enumerate(values):
+            if row is None:
+                valid[i] = False
+                continue
+            items = list(row.items()) if isinstance(row, dict) else list(row)
+            lengths[i] = len(items)
+            for k, v in items:
+                flat_keys.append(k)
+                flat_vals.append(v)
+        total = int(lengths.sum())
+        cap = capacity if capacity is not None else round_up_pow2(max(n, 1))
+        ecap = (entry_capacity if entry_capacity is not None
+                else round_up_pow2(max(total, 1)))
+        offsets = np.zeros((cap + 1,), dtype=np.int32)
+        np.cumsum(lengths, out=offsets[1: n + 1])
+        offsets[n + 1:] = offsets[n]
+        pad = [None] * (ecap - total)
+        children = (
+            DeviceColumn._from_values(flat_keys + pad, dtype.key_type,
+                                      capacity=ecap),
+            DeviceColumn._from_values(flat_vals + pad, dtype.value_type,
+                                      capacity=ecap),
+        )
+        validity_full = np.zeros((cap,), dtype=np.bool_)
+        validity_full[:n] = valid
+        return DeviceColumn(
+            data=jnp.zeros((ecap,), dtype=jnp.uint8),
+            validity=jnp.asarray(validity_full),
+            dtype=dtype,
+            offsets=jnp.asarray(offsets),
+            children=children,
+        )
+
     # -- host download ------------------------------------------------------
 
     def to_numpy(self, num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -259,6 +409,25 @@ class DeviceColumn:
         return data, valid
 
     def to_pylist(self, num_rows: int):
+        if self.is_struct:
+            valid = np.asarray(self.validity)
+            kids = [c.to_pylist(num_rows) for c in self.children]
+            return [tuple(k[i] for k in kids) if valid[i] else None
+                    for i in range(num_rows)]
+        if self.is_map:
+            offsets = np.asarray(self.offsets)
+            valid = np.asarray(self.validity)
+            nent = int(offsets[num_rows]) if num_rows else 0
+            keys = self.children[0].to_pylist(nent)
+            vals = self.children[1].to_pylist(nent)
+            out = []
+            for i in range(num_rows):
+                if not valid[i]:
+                    out.append(None)
+                else:
+                    s, e = int(offsets[i]), int(offsets[i + 1])
+                    out.append({keys[j]: vals[j] for j in range(s, e)})
+            return out
         if self.is_array:
             offsets = np.asarray(self.offsets)
             data = np.asarray(self.data)
@@ -306,6 +475,17 @@ class DeviceColumn:
         idx = jnp.arange(self.capacity, dtype=jnp.int32)
         live = idx < num_rows
         valid = self.validity & live
+        if self.is_struct:
+            kids = tuple(c.canonicalize(num_rows) for c in self.children)
+            return DeviceColumn(jnp.zeros_like(self.data), valid, self.dtype,
+                                children=kids)
+        if self.is_map:
+            end = self.offsets[num_rows]
+            oidx = jnp.arange(self.capacity + 1, dtype=jnp.int32)
+            offsets = jnp.where(oidx <= num_rows, self.offsets, end)
+            kids = tuple(c.canonicalize(end) for c in self.children)
+            return DeviceColumn(jnp.zeros_like(self.data), valid, self.dtype,
+                                offsets, children=kids)
         if self.offsets is not None:
             end = self.offsets[num_rows]
             oidx = jnp.arange(self.capacity + 1, dtype=jnp.int32)
@@ -324,6 +504,28 @@ class DeviceColumn:
 
     def with_capacity(self, capacity: int, byte_capacity: Optional[int] = None) -> "DeviceColumn":
         """Grow (or shrink) the static capacity, preserving contents."""
+        if self.is_struct:
+            validity = jnp.zeros((capacity,), dtype=jnp.bool_)
+            ncopy = min(capacity, self.capacity)
+            validity = validity.at[:ncopy].set(self.validity[:ncopy])
+            return DeviceColumn(
+                jnp.zeros((capacity,), jnp.int8), validity, self.dtype,
+                children=tuple(c.with_capacity(capacity)
+                               for c in self.children))
+        if self.is_map:
+            bcap = byte_capacity if byte_capacity is not None else self.byte_capacity
+            offsets = jnp.zeros((capacity + 1,), dtype=jnp.int32)
+            ncopy = min(capacity + 1, self.offsets.shape[0])
+            offsets = offsets.at[:ncopy].set(self.offsets[:ncopy])
+            if capacity + 1 > ncopy:
+                offsets = offsets.at[ncopy:].set(self.offsets[ncopy - 1])
+            validity = jnp.zeros((capacity,), dtype=jnp.bool_)
+            nv = min(capacity, self.capacity)
+            validity = validity.at[:nv].set(self.validity[:nv])
+            return DeviceColumn(
+                jnp.zeros((bcap,), jnp.uint8), validity, self.dtype, offsets,
+                children=tuple(c.with_capacity(bcap)
+                               for c in self.children))
         if self.offsets is not None:
             bcap = byte_capacity if byte_capacity is not None else self.byte_capacity
             ncopyb = min(bcap, self.byte_capacity)

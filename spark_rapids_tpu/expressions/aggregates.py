@@ -441,11 +441,12 @@ class ApproximateCountDistinct(AggregateFunction):
 
     def finalize_jnp(self, bufs):
         import jax.numpy as jnp
+
+        from spark_rapids_tpu.kernels.hll import _alpha
         regs, valid = bufs[0]   # [groups, m] int8 (reshaped by the exec)
         m = self.m
-        alpha = 0.7213 / (1.0 + 1.079 / m)
         inv = jnp.power(2.0, -regs.astype(jnp.float64))
-        est = alpha * m * m / jnp.sum(inv, axis=1)
+        est = _alpha(m) * m * m / jnp.sum(inv, axis=1)
         zeros = jnp.sum((regs == 0).astype(jnp.int32), axis=1)
         lc = m * jnp.log(m / jnp.maximum(zeros, 1).astype(jnp.float64))
         est = jnp.where((est <= 2.5 * m) & (zeros != 0), lc, est)

@@ -20,7 +20,9 @@ class GroupingId(Expression):
 
     @property
     def dtype(self):
-        return T.INT
+        # Spark 3.x default: LongType (spark.sql.legacy.integerGroupingId
+        # defaults to false)
+        return T.LONG
 
     @property
     def nullable(self):

@@ -75,14 +75,19 @@ def _hash_long(value_u64, seed_u32):
 
 
 def _f32_bits(x):
-    """float32 bits with Spark's -0.0 → 0.0 normalization."""
+    """float32 bits with Spark's -0.0 → 0.0 normalization and Java
+    floatToIntBits NaN canonicalization (every NaN → 0x7FC00000), so rows
+    holding non-canonical NaNs from externally written files hash like CPU
+    Spark."""
     x = jnp.where(x == jnp.float32(0.0), jnp.float32(0.0), x)
-    return jax.lax.bitcast_convert_type(x, jnp.uint32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return jnp.where(jnp.isnan(x), jnp.uint32(0x7FC00000), bits)
 
 
 def _f64_bits(x):
     x = jnp.where(x == jnp.float64(0.0), jnp.float64(0.0), x)
-    return jax.lax.bitcast_convert_type(x, jnp.uint64)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint64)
+    return jnp.where(jnp.isnan(x), jnp.uint64(0x7FF8000000000000), bits)
 
 
 def hash_fixed_width(col: DeviceColumn, seeds: jax.Array) -> jax.Array:

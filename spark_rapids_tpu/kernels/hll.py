@@ -39,12 +39,33 @@ def row_idx_rho(values_u64, validity, p: int):
     return idx, rho
 
 
+def _alpha(m: int) -> float:
+    """HLL++ paper (Heule et al. 2013) alpha constants, as used by Spark's
+    HyperLogLogPlusPlusHelper: exact values for small m, asymptotic
+    formula otherwise."""
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1.0 + 1.079 / m)
+
+
 def estimate_np(registers: np.ndarray) -> int:
-    """HLL estimate + linear-counting small-range correction (shared)."""
+    """HLL estimate + linear-counting small-range correction (shared).
+
+    Spark additionally subtracts an interpolated empirical bias
+    (RAW_ESTIMATE_DATA/BIAS_DATA, ~2000 doubles) for estimates under 5m and
+    switches to linear counting below per-p THRESHOLDS; those tables are
+    not reproduced here, so the classic 2.5m linear-counting rule is used
+    instead (the paper thresholds assume the bias correction and degrade
+    accuracy without it).  Mid-cardinality estimates can therefore differ
+    slightly from CPU Spark (documented divergence; engine and oracle
+    share this exact function so differential tests are unaffected)."""
     m = registers.shape[0]
-    alpha = 0.7213 / (1.0 + 1.079 / m)
     inv = np.power(2.0, -registers.astype(np.float64))
-    est = alpha * m * m / inv.sum()
+    est = _alpha(m) * m * m / inv.sum()
     zeros = int((registers == 0).sum())
     if est <= 2.5 * m and zeros != 0:
         est = m * np.log(m / float(zeros))

@@ -36,8 +36,91 @@ from spark_rapids_tpu.kernels.groupby import normalize_key_column
 from spark_rapids_tpu.kernels.selection import OOB, OverflowStatus
 from spark_rapids_tpu.kernels.sort import SortOrder, _data_key_fixed
 
-JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti", "cross")
+JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti",
+              "cross", "existence")
 _ASC = SortOrder(True, True)
+
+
+def conditional_join_maps(
+    li: jax.Array, ri: jax.Array, pass_mask: jax.Array,
+    left_live: jax.Array, right_live: jax.Array,
+    join_type: str, out_capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, OverflowStatus, jax.Array]:
+    """Final gather maps for a join with a residual condition.
+
+    Inputs are CANDIDATE pair maps (the inner/cross shape from
+    join_gather_maps) plus a per-pair verdict: pass_mask[k] is True iff
+    candidate pair k is live and its condition evaluated to true.  This is
+    the TPU analog of the reference's conditional gather iterators
+    (GpuHashJoin.scala:1653) — candidates come from the equi-key kernel,
+    the compiled condition prunes them, and join semantics are decided
+    from the pruned set:
+
+      * inner:      the passing pairs;
+      * left/right/full: passing pairs + unmatched-side null extensions;
+      * left_semi:  left rows with >=1 passing pair;
+      * left_anti:  left rows with 0 passing pairs;
+      * existence:  ALL left rows; the 5th return is the per-left-row
+                    exists flag (GpuHashJoin.scala:2426's existence join).
+
+    Returns (li2, ri2, count, status, lmatched[CL]).
+    """
+    from spark_rapids_tpu.kernels.selection import compaction_map
+    CL = left_live.shape[0]
+    CR = right_live.shape[0]
+    PC = li.shape[0]
+    li_safe = jnp.where(pass_mask, li, CL)
+    ri_safe = jnp.where(pass_mask, ri, CR)
+    lmatched = jnp.zeros((CL,), jnp.bool_).at[li_safe].set(
+        True, mode="drop")
+    rmatched = jnp.zeros((CR,), jnp.bool_).at[ri_safe].set(
+        True, mode="drop")
+
+    def _left_only(mask):
+        idx, count = compaction_map(mask)
+        li2 = (idx[:out_capacity] if idx.shape[0] >= out_capacity
+               else jnp.concatenate([
+                   idx, jnp.full((out_capacity - idx.shape[0],), OOB,
+                                 jnp.int32)]))
+        ri2 = jnp.full((out_capacity,), OOB, jnp.int32)
+        return (li2, ri2, jnp.minimum(count, out_capacity).astype(jnp.int32),
+                OverflowStatus(count.astype(jnp.int64)), lmatched)
+
+    if join_type == "left_semi":
+        return _left_only(left_live & lmatched)
+    if join_type == "left_anti":
+        return _left_only(left_live & ~lmatched)
+    if join_type == "existence":
+        return _left_only(left_live)
+
+    # pair region: passing pairs compacted to the front
+    idxA, npass = compaction_map(pass_mask)
+    k = jnp.arange(out_capacity, dtype=jnp.int32)
+    pa = idxA[jnp.clip(jnp.minimum(k, PC - 1), 0, PC - 1)] if PC else k
+    in_a = k < npass
+    li2 = jnp.where(in_a, li[jnp.clip(pa, 0, PC - 1)] if PC else OOB, OOB)
+    ri2 = jnp.where(in_a, ri[jnp.clip(pa, 0, PC - 1)] if PC else OOB, OOB)
+    total = npass.astype(jnp.int64)
+
+    if join_type in ("left", "full"):
+        idxB, nB = compaction_map(left_live & ~lmatched)
+        kb = k - npass
+        rowB = idxB[jnp.clip(kb, 0, CL - 1)]
+        in_b = (~in_a) & (kb < nB)
+        li2 = jnp.where(in_b, rowB, li2)
+        total = total + nB.astype(jnp.int64)
+    if join_type in ("right", "full"):
+        idxC, nC = compaction_map(right_live & ~rmatched)
+        base = total.astype(jnp.int32)
+        kc = k - base
+        rowC = idxC[jnp.clip(kc, 0, CR - 1)]
+        in_c = (k >= base) & (kc < nC)
+        ri2 = jnp.where(in_c, rowC, ri2)
+        li2 = jnp.where(in_c, OOB, li2)
+        total = total + nC.astype(jnp.int64)
+
+    count = jnp.minimum(total, out_capacity).astype(jnp.int32)
+    return li2, ri2, count, OverflowStatus(total), lmatched
 
 
 def _key_arrays(col: DeviceColumn, live: jax.Array) -> Tuple[jax.Array, jax.Array]:

@@ -106,6 +106,39 @@ def gather_column(
     safe = jnp.where(inb, idx, 0)
     validity = jnp.where(inb, col.validity[safe], False)
 
+    if col.is_struct:
+        # struct: same row gather applied to the validity and every field
+        # (cudf gathers struct children with the parent map)
+        kids = tuple(gather_column(c, idx, count, out_capacity=out_cap)
+                     for c in col.children)
+        return DeviceColumn(jnp.zeros((out_cap,), jnp.int8), validity,
+                            col.dtype, children=kids)
+
+    if col.is_map:
+        # map: rebuild offsets from gathered entry counts, then gather the
+        # key/value children by source entry index (the LIST gather with
+        # the child struct flattened)
+        starts = col.offsets[:-1]
+        lengths = col.offsets[1:] - starts
+        glen = jnp.where(validity, lengths[safe], 0)
+        new_offsets = jnp.zeros((out_cap + 1,), dtype=jnp.int32)
+        new_offsets = new_offsets.at[1:].set(jnp.cumsum(glen))
+        total = new_offsets[out_cap]
+        ecap = (out_byte_capacity if out_byte_capacity is not None
+                else col.byte_capacity)
+        epos = jnp.arange(ecap, dtype=jnp.int32)
+        row = jnp.searchsorted(new_offsets, epos,
+                               side="right").astype(jnp.int32) - 1
+        row = jnp.clip(row, 0, out_cap - 1)
+        within = epos - new_offsets[row]
+        src = jnp.clip(starts[safe[row]] + within, 0,
+                       col.byte_capacity - 1)
+        src = jnp.where(epos < total, src, OOB)
+        kids = tuple(gather_column(c, src, total, out_capacity=ecap)
+                     for c in col.children)
+        return DeviceColumn(jnp.zeros((ecap,), jnp.uint8), validity,
+                            col.dtype, new_offsets, children=kids)
+
     if col.offsets is None:
         data = jnp.where(validity, col.data[safe], jnp.zeros((), col.data.dtype))
         return DeviceColumn(data, validity, col.dtype)
@@ -231,9 +264,8 @@ def concat_batches_device(
     required_rows = offs[n_in]
     total = jnp.minimum(required_rows, jnp.int32(out_capacity))
 
-    out_cols = []
-    for ci, dtype in enumerate(schema.dtypes):
-        cols = [b.columns[ci] for b in batches]
+    def concat_cols(cols, dtype) -> DeviceColumn:
+        """Concatenate one column across inputs (recursive for nesting)."""
         # normalize per-input capacities so buffers stack
         max_cap = max(c.capacity for c in cols)
         if dtype.variable_width:
@@ -246,20 +278,29 @@ def concat_batches_device(
         else:
             cols = [c if c.capacity == max_cap else c.with_capacity(max_cap)
                     for c in cols]
+        pos = jnp.arange(out_capacity, dtype=jnp.int32)
+        which = jnp.searchsorted(offs, pos, side="right").astype(jnp.int32) - 1
+        which = jnp.clip(which, 0, n_in - 1)
+        within = jnp.clip(pos - offs[which], 0, cols[0].capacity - 1)
+        live = pos < total
+        stacked_val = jnp.stack([c.validity for c in cols])       # [n_in, cap]
+        validity = jnp.where(live, stacked_val[which, within], False)
+
+        if cols[0].is_struct:
+            kids = tuple(
+                concat_cols([c.children[fi] for c in cols], f.dtype)
+                for fi, f in enumerate(dtype.fields))
+            return DeviceColumn(jnp.zeros((out_capacity,), jnp.int8),
+                                validity, dtype, children=kids)
+
         if dtype.variable_width:
-            stacked_off = jnp.stack([c.offsets for c in cols])        # [n_in, cap+1]
-            stacked_dat = jnp.stack([c.data for c in cols])           # [n_in, bcap]
-            stacked_val = jnp.stack([c.validity for c in cols])       # [n_in, cap]
+            stacked_off = jnp.stack([c.offsets for c in cols])    # [n_in, cap+1]
+            stacked_dat = jnp.stack([c.data for c in cols])       # [n_in, bcap]
             is_arr = cols[0].child_validity is not None
+            is_map = cols[0].children is not None
             if is_arr:
                 stacked_cval = jnp.stack([c.child_validity for c in cols])
             out_bcap = sum(c.byte_capacity for c in cols)
-            pos = jnp.arange(out_capacity, dtype=jnp.int32)
-            which = jnp.searchsorted(offs, pos, side="right").astype(jnp.int32) - 1
-            which = jnp.clip(which, 0, n_in - 1)
-            within = jnp.clip(pos - offs[which], 0, cols[0].capacity - 1)
-            live = pos < total
-            validity = jnp.where(live, stacked_val[which, within], False)
             row_len = stacked_off[which, within + 1] - stacked_off[which, within]
             lengths = jnp.where(live, row_len, 0)
             new_offsets = jnp.zeros((out_capacity + 1,), jnp.int32).at[1:].set(jnp.cumsum(lengths))
@@ -270,25 +311,37 @@ def concat_batches_device(
             src_in_batch = jnp.clip(src_in_batch, 0, cols[0].byte_capacity - 1)
             zero = jnp.zeros((), stacked_dat.dtype)
             live_child = bpos < new_offsets[out_capacity]
+            if is_map:
+                # children gathered per ENTRY from the stacked inputs;
+                # fixed-width key/value children only (TypeSig gate)
+                def gather_child(kids):
+                    skid_d = jnp.stack([k.data for k in kids])
+                    skid_v = jnp.stack([k.validity for k in kids])
+                    kv = jnp.where(live_child,
+                                   skid_v[which[brow], src_in_batch], False)
+                    kd = jnp.where(kv, skid_d[which[brow], src_in_batch],
+                                   jnp.zeros((), skid_d.dtype))
+                    return DeviceColumn(kd, kv, kids[0].dtype)
+                kids = tuple(gather_child([c.children[i] for c in cols])
+                             for i in range(2))
+                return DeviceColumn(jnp.zeros((out_bcap,), jnp.uint8),
+                                    validity, dtype, new_offsets,
+                                    children=kids)
             data = jnp.where(live_child,
                              stacked_dat[which[brow], src_in_batch], zero)
             if is_arr:
                 cval = jnp.where(live_child,
                                  stacked_cval[which[brow], src_in_batch], False)
                 data = jnp.where(cval, data, zero)
-                out_cols.append(DeviceColumn(data, validity, dtype, new_offsets, cval))
-            else:
-                out_cols.append(DeviceColumn(data, validity, dtype, new_offsets))
-        else:
-            stacked = jnp.stack([c.data for c in cols])               # [n_in, cap]
-            stacked_val = jnp.stack([c.validity for c in cols])
-            pos = jnp.arange(out_capacity, dtype=jnp.int32)
-            which = jnp.searchsorted(offs, pos, side="right").astype(jnp.int32) - 1
-            which = jnp.clip(which, 0, n_in - 1)
-            within = jnp.clip(pos - offs[which], 0, cols[0].capacity - 1)
-            live = pos < total
-            validity = jnp.where(live, stacked_val[which, within], False)
-            data = jnp.where(validity, stacked[which, within], jnp.zeros((), stacked.dtype))
-            out_cols.append(DeviceColumn(data, validity, dtype))
+                return DeviceColumn(data, validity, dtype, new_offsets, cval)
+            return DeviceColumn(data, validity, dtype, new_offsets)
+
+        stacked = jnp.stack([c.data for c in cols])               # [n_in, cap]
+        data = jnp.where(validity, stacked[which, within], jnp.zeros((), stacked.dtype))
+        return DeviceColumn(data, validity, dtype)
+
+    out_cols = []
+    for ci, dtype in enumerate(schema.dtypes):
+        out_cols.append(concat_cols([b.columns[ci] for b in batches], dtype))
     batch = ColumnarBatch(tuple(out_cols), total.astype(jnp.int32), schema)
     return batch, OverflowStatus(required_rows.astype(jnp.int64))

@@ -670,54 +670,6 @@ class CpuEngine:
                 continue  # null keys never match in equi-joins
             build.setdefault(keyof(rkeys, r), []).append(r)
 
-        lidx: List[int] = []
-        ridx: List[int] = []   # -1 = null-extended
-        rmatched = np.zeros((right.num_rows,), np.bool_)
-        jt = plan.join_type
-        for r in range(left.num_rows):
-            matches = ([] if has_null_key(lkeys, r)
-                       else build.get(keyof(lkeys, r), []))
-            if jt == "inner":
-                for m in matches:
-                    lidx.append(r)
-                    ridx.append(m)
-            elif jt in ("left", "full"):
-                if matches:
-                    for m in matches:
-                        lidx.append(r)
-                        ridx.append(m)
-                        rmatched[m] = True
-                else:
-                    lidx.append(r)
-                    ridx.append(-1)
-            elif jt == "right":
-                for m in matches:
-                    lidx.append(r)
-                    ridx.append(m)
-                    rmatched[m] = True
-            elif jt == "left_semi":
-                if matches:
-                    lidx.append(r)
-            elif jt == "left_anti":
-                if not matches:
-                    lidx.append(r)
-            elif jt == "cross":
-                for m in range(right.num_rows):
-                    lidx.append(r)
-                    ridx.append(m)
-        if jt in ("right", "full"):
-            for m in range(right.num_rows):
-                if not rmatched[m]:
-                    lidx.append(-1)
-                    ridx.append(m)
-
-        if jt in ("left_semi", "left_anti"):
-            out = left.take(np.array(lidx, dtype=np.int64))
-            return [out]
-
-        la = np.array(lidx, dtype=np.int64)
-        ra = np.array(ridx, dtype=np.int64)
-
         def gather_side(cols_in, idx):
             out = []
             for (v, m) in cols_in:
@@ -734,13 +686,67 @@ class CpuEngine:
                 out.append((cpu_zero_invalid(gv, gm), gm))
             return out
 
+        jt = plan.join_type
+        # 1. candidate pairs: equi-key matches (or all pairs when keyless —
+        #    the nested-loop/cartesian shape)
+        cl: List[int] = []
+        cr: List[int] = []
+        for r in range(left.num_rows):
+            if not plan.left_keys:
+                matches = list(range(right.num_rows))
+            elif has_null_key(lkeys, r):
+                matches = []
+            else:
+                matches = build.get(keyof(lkeys, r), [])
+            for m in matches:
+                cl.append(r)
+                cr.append(m)
+        ca = np.array(cl, dtype=np.int64)
+        cb = np.array(cr, dtype=np.int64)
+
+        # 2. residual condition over the candidate pairs (null -> no match)
+        if plan.condition is not None and jt != "cross":
+            pair = CpuTable(
+                gather_side(left.cols, ca) + gather_side(right.cols, cb),
+                len(ca), plan.pair_schema)
+            v, m = plan.condition.eval_cpu(pair.ctx())
+            passing = v.astype(np.bool_) & m
+            ca, cb = ca[passing], cb[passing]
+
+        # 3. join-type semantics from the passing pair set
+        lmatched = np.zeros((left.num_rows,), np.bool_)
+        rmatched = np.zeros((right.num_rows,), np.bool_)
+        lmatched[ca] = True
+        rmatched[cb] = True
+
+        if jt == "left_semi":
+            return [left.take(np.nonzero(lmatched)[0])]
+        if jt == "left_anti":
+            return [left.take(np.nonzero(~lmatched)[0])]
+        if jt == "existence":
+            out_cols = list(left.cols) + [
+                (lmatched.copy(), np.ones((left.num_rows,), np.bool_))]
+            return [CpuTable(out_cols, left.num_rows, plan.schema)]
+
+        lidx: List[int] = list(ca)
+        ridx: List[int] = list(cb)   # -1 = null-extended
+        if jt in ("left", "full"):
+            for r in np.nonzero(~lmatched)[0]:
+                lidx.append(int(r))
+                ridx.append(-1)
+        if jt in ("right", "full"):
+            for m in np.nonzero(~rmatched)[0]:
+                lidx.append(-1)
+                ridx.append(int(m))
+
+        la = np.array(lidx, dtype=np.int64)
+        ra = np.array(ridx, dtype=np.int64)
         cols = gather_side(left.cols, la) + gather_side(right.cols, ra)
         joined = CpuTable(cols, len(la), plan.schema)
-        if plan.condition is not None:
+        if plan.condition is not None and jt == "cross":
+            # cross + condition filters after the product (Spark plans a
+            # Filter over CartesianProduct)
             v, m = plan.condition.eval_cpu(joined.ctx())
-            if jt != "inner":
-                raise NotImplementedError(
-                    "CPU oracle: residual condition on outer joins")
             joined = joined.take(np.nonzero(v.astype(np.bool_) & m)[0])
         return [joined]
 

@@ -66,11 +66,15 @@ class TpuShuffleExchangeExec(TpuExec):
         super().__init__((child,), schema or child.schema)
         self.out_partitions = num_partitions
         self.keys = tuple(keys)
-        from spark_rapids_tpu import types as T
-        if mode in ("MULTITHREADED", "MULTIPROCESS") and any(
-                isinstance(d, T.ArrayType) for d in self.schema.dtypes):
+        from spark_rapids_tpu.shuffle.serializer import wire_supported
+        if mode == "MULTITHREADED" and not all(
+                wire_supported(d) for d in self.schema.dtypes):
             # the kudo wire format carries fixed-width + string columns;
-            # array payloads stay device-resident (CACHE_ONLY slices)
+            # nested payloads stay device-resident (CACHE_ONLY slices).
+            # Downgrading is safe only because MULTITHREADED is an
+            # in-process transport; MULTIPROCESS must NOT silently fall
+            # back (a remote reduce task would see partial data) — the
+            # transport factory raises instead (ADVICE r2 #1).
             mode = "CACHE_ONLY"
         self.mode = mode
         self.writer_threads = writer_threads

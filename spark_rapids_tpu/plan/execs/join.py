@@ -20,29 +20,93 @@ import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
-from spark_rapids_tpu.columnar.column import round_up_pow2
-from spark_rapids_tpu.expressions.core import EvalContext, Expression
-from spark_rapids_tpu.kernels.join import apply_gather_maps, join_gather_maps
+from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
+from spark_rapids_tpu.expressions.core import (
+    BoundReference, EvalContext, Expression)
+from spark_rapids_tpu.kernels.join import (
+    apply_gather_maps, conditional_join_maps, join_gather_maps)
 from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_split
 from spark_rapids_tpu.plan.execs.base import TpuExec, timed
 from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
 
 
+def _bound_ordinals(e: Expression) -> set:
+    out = set()
+    if isinstance(e, BoundReference):
+        out.add(e.ordinal)
+    for c in e.children:
+        out |= _bound_ordinals(c)
+    return out
+
+
+def _remap_ordinals(e: Expression, mapping: dict) -> Expression:
+    if isinstance(e, BoundReference):
+        return BoundReference(mapping[e.ordinal], e.dtype, e.name)
+    if not e.children:
+        return e
+    ch = tuple(_remap_ordinals(c, mapping) for c in e.children)
+    if all(n is o for n, o in zip(ch, e.children)):
+        return e
+    return e.with_children(ch)
+
+
 class _JoinKernel:
-    """jit cache over (out_capacity, byte capacities, string bucket) —
-    all static; shapes implicit via jax.jit retracing."""
+    """jit cache over (capacities, byte capacities, string bucket) — all
+    static; shapes implicit via jax.jit retracing.
+
+    Two program shapes:
+      * plain equi-join: gather maps + output assembly in one program;
+      * conditional (residual condition and/or existence/nested-loop):
+        candidate pair maps (equi keys, or all pairs when keyless) ->
+        gather ONLY the condition's input columns for the pair batch ->
+        vectorized condition eval -> conditional_join_maps postprocess ->
+        final assembly.  The reference's conditional gather iterators
+        (GpuHashJoin.scala:1653) as a single XLA program.
+    """
 
     def __init__(self, left_key_idx, right_key_idx, join_type: str,
-                 schema: Schema):
+                 schema: Schema, left_schema: Optional[Schema] = None,
+                 right_schema: Optional[Schema] = None,
+                 condition: Optional[Expression] = None):
         self.left_key_idx = tuple(left_key_idx)
         self.right_key_idx = tuple(right_key_idx)
         self.join_type = join_type
         self.schema = schema
+        self.condition = condition
+        self.conditional = (condition is not None
+                            or join_type == "existence"
+                            or not self.left_key_idx)
+        if join_type == "cross":
+            self.conditional = False
 
         from spark_rapids_tpu.plan.execs.base import (
-            schema_cache_key, shared_jit)
+            exprs_cache_key, schema_cache_key, shared_jit)
         base_key = (f"join|{self.left_key_idx}|{self.right_key_idx}|"
                     f"{join_type}|{schema_cache_key(schema)}")
+
+        if self.conditional:
+            assert left_schema is not None and right_schema is not None
+            nl = len(left_schema)
+            ords = sorted(_bound_ordinals(condition)) if condition is not None else []
+            # (side, source ordinal) per condition input, in pair-ordinal order
+            self.cond_inputs = [(0, o) if o < nl else (1, o - nl)
+                                for o in ords]
+            pair_names = tuple(left_schema.names) + tuple(right_schema.names)
+            pair_dtypes = tuple(left_schema.dtypes) + tuple(right_schema.dtypes)
+            self.cond_schema = Schema(tuple(pair_names[o] for o in ords),
+                                      tuple(pair_dtypes[o] for o in ords))
+            self.cond_remapped = (_remap_ordinals(
+                condition, {o: j for j, o in enumerate(ords)})
+                if condition is not None else None)
+            if join_type in ("left_semi", "left_anti", "existence"):
+                self.gather_jt = "left_semi"     # gather left side only
+                self.gather_schema = (Schema(schema.names[:-1],
+                                             schema.dtypes[:-1])
+                                      if join_type == "existence" else schema)
+            else:
+                self.gather_jt = join_type
+                self.gather_schema = schema
+            base_key += f"|cond={exprs_cache_key([condition]) if condition is not None else 'none'}"
 
         def jitted(out_capacity: int, byte_caps: tuple, bucket: int):
             def run(l: ColumnarBatch, r: ColumnarBatch):
@@ -56,22 +120,143 @@ class _JoinKernel:
                 return out, status, gstatus
             return run
 
-        self._jitted = lambda out_capacity, byte_caps, bucket: shared_jit(
-            f"{base_key}|{out_capacity}|{byte_caps}|{bucket}",
-            lambda: jitted(out_capacity, byte_caps, bucket))
+        def jitted_cond(pair_capacity: int, out_capacity: int,
+                        byte_caps: tuple, bucket: int):
+            import jax.numpy as jnp
+
+            from spark_rapids_tpu.kernels.selection import (
+                OOB, gather_column, required_gather_bytes)
+            bc = dict(byte_caps)
+
+            def run(l: ColumnarBatch, r: ColumnarBatch):
+                cand_type = "inner" if self.left_key_idx else "cross"
+                li, ri, cnt, pair_status = join_gather_maps(
+                    l, self.left_key_idx, r, self.right_key_idx,
+                    cand_type, pair_capacity, string_max_bytes=bucket)
+                pair_bytes = []
+                if self.cond_remapped is None:
+                    pass_mask = (li != OOB) & (ri != OOB)
+                else:
+                    cols = []
+                    for j, (side, o) in enumerate(self.cond_inputs):
+                        c = (l if side == 0 else r).columns[o]
+                        idx = li if side == 0 else ri
+                        if c.is_string_like:
+                            cols.append(gather_column(
+                                c, idx, cnt, out_capacity=pair_capacity,
+                                out_byte_capacity=bc[("pair", j)]))
+                            pair_bytes.append(
+                                required_gather_bytes(c, idx, cnt))
+                        else:
+                            cols.append(gather_column(
+                                c, idx, cnt, out_capacity=pair_capacity))
+                    pb = ColumnarBatch(tuple(cols), cnt, self.cond_schema)
+                    cond = self.cond_remapped.eval(EvalContext(pb))
+                    pass_mask = ((li != OOB) & (ri != OOB)
+                                 & cond.validity
+                                 & cond.data.astype(jnp.bool_))
+                li2, ri2, count2, out_status, lmatched = conditional_join_maps(
+                    li, ri, pass_mask, l.live_mask(), r.live_mask(),
+                    self.join_type, out_capacity)
+                final_bc = {o: v for (tag, o), v in bc.items() if tag == "out"}
+                out, gstatus = apply_gather_maps(
+                    l, r, li2, ri2, count2, self.gather_schema,
+                    self.gather_jt, out_capacity, final_bc)
+                if self.join_type == "existence":
+                    live = jnp.arange(out_capacity, dtype=jnp.int32) < count2
+                    safe = jnp.clip(li2, 0, l.capacity - 1)
+                    ex = DeviceColumn(
+                        jnp.where(live, lmatched[safe], False), live,
+                        self.schema.dtypes[-1])
+                    out = ColumnarBatch(tuple(out.columns) + (ex,),
+                                        count2, self.schema)
+                return out, pair_status, out_status, gstatus, tuple(pair_bytes)
+            return run
+
+        if self.conditional:
+            self._jitted_cond = (
+                lambda pair_cap, out_cap, byte_caps, bucket: shared_jit(
+                    f"{base_key}|{pair_cap}|{out_cap}|{byte_caps}|{bucket}",
+                    lambda: jitted_cond(pair_cap, out_cap, byte_caps,
+                                        bucket)))
+        else:
+            self._jitted = lambda out_capacity, byte_caps, bucket: shared_jit(
+                f"{base_key}|{out_capacity}|{byte_caps}|{bucket}",
+                lambda: jitted(out_capacity, byte_caps, bucket))
 
     def _string_out_cols(self, l: ColumnarBatch, r: ColumnarBatch):
         """output ordinal -> source child capacity for variable-width
         (string/array) columns."""
         out = {}
         idx = 0
-        sides = [l] if self.join_type in ("left_semi", "left_anti") else [l, r]
+        sides = ([l] if self.join_type in ("left_semi", "left_anti",
+                                           "existence") else [l, r])
         for side in sides:
             for c in side.columns:
                 if c.offsets is not None:
                     out[idx] = c.byte_capacity
                 idx += 1
         return out
+
+    def _pair_string_cols(self, l: ColumnarBatch, r: ColumnarBatch):
+        """condition-input index -> byte capacity for string inputs."""
+        out = {}
+        for j, (side, o) in enumerate(self.cond_inputs):
+            c = (l if side == 0 else r).columns[o]
+            if c.offsets is not None:
+                out[j] = c.byte_capacity
+        return out
+
+    def _call_conditional(self, l: ColumnarBatch,
+                          r: ColumnarBatch) -> ColumnarBatch:
+        from spark_rapids_tpu.columnar.column import round_up_pow2 as rup
+        from spark_rapids_tpu.memory.arena import TpuSplitAndRetryOOM
+        nl, nr = l.capacity, r.capacity
+        if not self.left_key_idx:
+            # nested-loop candidates are ALL live pairs: exact, no retry
+            pair_cap = rup(max(nl * max(nr, 1), 1))
+        else:
+            pair_cap = rup(max(nl, nr, 1))
+        if self.join_type in ("left_semi", "left_anti", "existence"):
+            out_cap = rup(max(nl, 1))
+        elif self.join_type == "full":
+            out_cap = rup(max(nl + nr, 1))
+        else:
+            out_cap = pair_cap
+        bucket = self._key_bucket(l, r)
+        byte_caps = {("out", o): v
+                     for o, v in self._string_out_cols(l, r).items()}
+        byte_caps.update({("pair", j): v
+                          for j, v in self._pair_string_cols(l, r).items()})
+        for _ in range(24):
+            out, pair_status, out_status, gstatus, pair_bytes = \
+                with_retry_no_split(
+                    lambda: self._jitted_cond(
+                        pair_cap, out_cap,
+                        tuple(sorted(byte_caps.items())), bucket)(l, r))
+            ok = True
+            need_pairs = int(pair_status.required_rows)
+            if need_pairs > pair_cap:
+                pair_cap = rup(need_pairs)
+                ok = False
+            need_out = int(out_status.required_rows)
+            if need_out > out_cap:
+                out_cap = rup(need_out)
+                ok = False
+            pair_keys = sorted(k[1] for k in byte_caps if k[0] == "pair")
+            for j, req in zip(pair_keys, pair_bytes):
+                if int(req) > byte_caps[("pair", j)]:
+                    byte_caps[("pair", j)] = rup(int(req))
+                    ok = False
+            if gstatus.required_bytes:
+                out_keys = sorted(k[1] for k in byte_caps if k[0] == "out")
+                for o, req in zip(out_keys, gstatus.required_bytes):
+                    if int(req) > byte_caps[("out", o)]:
+                        byte_caps[("out", o)] = rup(int(req))
+                        ok = False
+            if ok:
+                return out
+        raise TpuSplitAndRetryOOM("join output would not fit after retries")
 
     def _key_bucket(self, l: ColumnarBatch, r: ColumnarBatch) -> int:
         from spark_rapids_tpu.kernels import strings as SK
@@ -87,6 +272,8 @@ class _JoinKernel:
         return SK.bucket_for(m) if has_string else 0
 
     def __call__(self, l: ColumnarBatch, r: ColumnarBatch) -> ColumnarBatch:
+        if self.conditional:
+            return self._call_conditional(l, r)
         nl, nr = l.capacity, r.capacity   # static bound: no device sync
         if self.join_type == "cross":
             guess = max(nl * max(nr, 1), 1)
@@ -137,15 +324,20 @@ class TpuShuffledHashJoinExec(TpuExec):
                  left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
                  join_type: str, schema: Schema,
-                 target_rows: int = 1 << 20):
+                 target_rows: int = 1 << 20,
+                 condition: Optional[Expression] = None):
         super().__init__((left, right), schema)
         self.join_type = join_type
         self.target_rows = max(int(target_rows), 1)
         # keys are bound refs into each side's schema; resolve ordinals
         self.left_key_idx = [self._ordinal(k, left.schema) for k in left_keys]
         self.right_key_idx = [self._ordinal(k, right.schema) for k in right_keys]
+        self.condition = condition
         self._kernel = _JoinKernel(self.left_key_idx, self.right_key_idx,
-                                   join_type, schema)
+                                   join_type, schema,
+                                   left_schema=left.schema,
+                                   right_schema=right.schema,
+                                   condition=condition)
 
     @staticmethod
     def _ordinal(key: Expression, schema: Schema) -> int:
@@ -165,12 +357,14 @@ class TpuShuffledHashJoinExec(TpuExec):
             return None
         if left is None:
             if self.join_type in ("inner", "left", "left_semi", "left_anti",
-                                  "cross"):
+                                  "cross", "existence"):
                 return None
             left = ColumnarBatch.empty(self.children[0].schema)
         if right is None:
             if self.join_type in ("inner", "right", "cross", "left_semi"):
                 return None
+            # left/full/anti/existence still emit left rows against an
+            # empty build side
             right = ColumnarBatch.empty(self.children[1].schema)
         return self._kernel(left, right)
 
@@ -241,9 +435,10 @@ class TpuBroadcastHashJoinExec(TpuExec):
                  left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
                  join_type: str, schema: Schema,
-                 target_rows: int = 1 << 20):
+                 target_rows: int = 1 << 20,
+                 condition: Optional[Expression] = None):
         assert join_type in ("inner", "left", "left_semi", "left_anti",
-                             "cross"), \
+                             "cross", "existence"), \
             "broadcast build side must be on the null-extending side"
         super().__init__((left, right), schema)
         self.join_type = join_type
@@ -252,8 +447,12 @@ class TpuBroadcastHashJoinExec(TpuExec):
                              for k in left_keys]
         self.right_key_idx = [TpuShuffledHashJoinExec._ordinal(k, right.schema)
                               for k in right_keys]
+        self.condition = condition
         self._kernel = _JoinKernel(self.left_key_idx, self.right_key_idx,
-                                   join_type, schema)
+                                   join_type, schema,
+                                   left_schema=left.schema,
+                                   right_schema=right.schema,
+                                   condition=condition)
         self._lock = threading.Lock()
         self._build: Optional[ColumnarBatch] = None
         self._build_done = False
@@ -329,11 +528,13 @@ class TpuAdaptiveJoinExec(TpuExec):
                  join_type: str, schema: Schema,
                  broadcast_threshold: int, shuffle_partitions: int,
                  writer_threads: int = 4, codec: str = "none",
-                 target_rows: int = 1 << 20):
+                 target_rows: int = 1 << 20,
+                 condition: Optional[Expression] = None):
         super().__init__((left, right), schema)
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
         self.join_type = join_type
+        self.condition = condition
         self.broadcast_threshold = broadcast_threshold
         self.shuffle_partitions = shuffle_partitions
         self.writer_threads = writer_threads
@@ -369,7 +570,8 @@ class TpuAdaptiveJoinExec(TpuExec):
                 self._inner = TpuBroadcastHashJoinExec(
                     left, right_scan, self.left_keys, self.right_keys,
                     self.join_type, self.schema,
-                    target_rows=self.target_rows)
+                    target_rows=self.target_rows,
+                    condition=self.condition)
             else:
                 self.chosen = "shuffled"
                 lex = TpuShuffleExchangeExec(
@@ -383,7 +585,8 @@ class TpuAdaptiveJoinExec(TpuExec):
                 self._inner = TpuShuffledHashJoinExec(
                     lex, rex, self.left_keys, self.right_keys,
                     self.join_type, self.schema,
-                    target_rows=self.target_rows)
+                    target_rows=self.target_rows,
+                    condition=self.condition)
             return self._inner
 
     def num_partitions(self) -> int:

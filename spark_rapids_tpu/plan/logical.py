@@ -241,30 +241,55 @@ class Limit(LogicalPlan):
         return f"Limit[{self.n}]"
 
 
-JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti", "cross")
+JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti",
+              "cross", "existence")
 
 
 class Join(LogicalPlan):
-    """Equi-join on key expression pairs plus optional residual condition."""
+    """Equi-join on key expression pairs plus optional residual condition.
+
+    The residual condition binds against the PAIR schema (left columns then
+    right columns) for every join type — semi/anti/existence conditions
+    reference the right side even though it is absent from the output
+    (Spark's ExistenceJoin / conditional semi-join shapes, reference
+    GpuHashJoin.scala:2426 + the conditional gather iterators at :1653).
+
+    `existence` outputs every left row plus a boolean `exists` column
+    (true when some right row matches keys + condition) — Spark's plan for
+    IN/EXISTS predicates inside disjunctions."""
 
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  left_keys: Sequence[Expression], right_keys: Sequence[Expression],
                  join_type: str = "inner",
-                 condition: Optional[Expression] = None):
+                 condition: Optional[Expression] = None,
+                 exists_name: str = "exists"):
         assert join_type in JOIN_TYPES, join_type
         self.left = left
         self.right = right
         self.left_keys = tuple(e.bind(left.schema) for e in left_keys)
         self.right_keys = tuple(e.bind(right.schema) for e in right_keys)
         self.join_type = join_type
+        self.exists_name = exists_name
         self.children = (left, right)
         self._schema = self._output_schema()
-        self.condition = (condition.bind(self._schema)
+        self.condition = (condition.bind(self.pair_schema)
                           if condition is not None else None)
+
+    @property
+    def pair_schema(self) -> Schema:
+        """left columns ++ right columns: the schema one candidate row pair
+        presents to the residual condition."""
+        return Schema(
+            tuple(self.left.schema.names) + tuple(self.right.schema.names),
+            tuple(self.left.schema.dtypes) + tuple(self.right.schema.dtypes))
 
     def _output_schema(self) -> Schema:
         if self.join_type in ("left_semi", "left_anti"):
             return self.left.schema
+        if self.join_type == "existence":
+            return Schema(
+                tuple(self.left.schema.names) + (self.exists_name,),
+                tuple(self.left.schema.dtypes) + (T.BOOLEAN,))
         names = list(self.left.schema.names)
         dtypes = list(self.left.schema.dtypes)
         for n, d in zip(self.right.schema.names, self.right.schema.dtypes):

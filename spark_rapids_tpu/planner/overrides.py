@@ -72,6 +72,16 @@ _NON_GROWING_STRING_EXPRS = {
 }
 
 
+def _leaf_ref_dtypes(e) -> List[T.DataType]:
+    """dtypes of every column reference in an expression tree."""
+    out = []
+    if isinstance(e, E.BoundReference):
+        out.append(e.dtype)
+    for c in e.children:
+        out.extend(_leaf_ref_dtypes(c))
+    return out
+
+
 def _regex_child_ok(e) -> bool:
     """Only STRING-typed subtrees feed bytes into a regex/byte-window
     kernel, so only they must be non-growing; non-string children (an If
@@ -528,9 +538,22 @@ class PlanMeta:
                             f"{rk.dtype!r} (add explicit casts)")
                 except (TypeError, ValueError, NotImplementedError):
                     pass
-            if p.condition is not None and p.join_type != "inner":
+            if not p.left_keys and p.join_type not in ("cross",) \
+                    and p.condition is None and p.join_type != "existence":
                 self.will_not_work(
-                    "residual join conditions only supported for inner joins")
+                    f"keyless {p.join_type} join without a condition "
+                    "(use cross join)")
+            if p.condition is not None:
+                for ref_dt in _leaf_ref_dtypes(p.condition):
+                    if isinstance(ref_dt, (T.ArrayType, T.StructType,
+                                           T.MapType)):
+                        # the conditional pair gather tracks byte-capacity
+                        # overflow for strings only; nested inputs could
+                        # silently truncate on repeated matches
+                        self.will_not_work(
+                            f"join condition over nested type {ref_dt!r} "
+                            "not supported yet")
+                        break
         if isinstance(p, L.Aggregate):
             for e in p.group_exprs:
                 if not _key_expr_ok(e):
@@ -778,36 +801,43 @@ class PlanMeta:
         nparts = self.conf.shuffle_partitions
         # broadcast choice: small build (right) side + a join type whose
         # null-extension never targets the broadcast side (the reference's
-        # build-side constraint, GpuBroadcastHashJoinExecBase)
+        # build-side constraint, GpuBroadcastHashJoinExecBase; keyless
+        # broadcastable joins are the broadcast nested-loop shape,
+        # GpuBroadcastNestedLoopJoinExecBase)
         broadcastable = p.join_type in ("inner", "left", "left_semi",
-                                        "left_anti", "cross")
+                                        "left_anti", "cross", "existence")
         est = _estimate_rows(p.right)
         thr = self.conf.broadcast_row_threshold
         if broadcastable and left.num_partitions() > 1 and est <= thr:
+            # cross keeps Spark's Filter-over-product shape (the kernel's
+            # conditional path does not run for cross)
+            cross_cond = p.join_type == "cross" and p.condition is not None
             join: TpuExec = TpuBroadcastHashJoinExec(
                 left, right, p.left_keys, p.right_keys, p.join_type, p.schema,
-                target_rows=self.conf.batch_size_rows)
-            if p.condition is not None:
+                target_rows=self.conf.batch_size_rows,
+                condition=None if cross_cond else p.condition)
+            if cross_cond:
                 join = TpuFilterExec(p.condition, join)
             return join
-        if (broadcastable and left.num_partitions() > 1
+        if (broadcastable and left.num_partitions() > 1 and p.left_keys
                 and p.join_type != "cross" and est <= thr * 8):
             # ambiguous zone: the static estimate can't be trusted either
             # way — defer the broadcast-vs-shuffled choice to runtime,
             # decided from the MATERIALIZED build-side row count
             # (GpuShuffledSizedHashJoinExec.scala:829 / AQE analog)
             from spark_rapids_tpu.plan.execs.join import TpuAdaptiveJoinExec
-            join = TpuAdaptiveJoinExec(
+            return TpuAdaptiveJoinExec(
                 left, right, p.left_keys, p.right_keys, p.join_type,
                 p.schema, broadcast_threshold=thr,
                 shuffle_partitions=nparts,
                 writer_threads=self.conf.shuffle_writer_threads,
                 codec=self.conf.shuffle_codec,
-                target_rows=self.conf.batch_size_rows)
-            if p.condition is not None:
-                join = TpuFilterExec(p.condition, join)
-            return join
-        if p.join_type == "cross":
+                target_rows=self.conf.batch_size_rows,
+                condition=p.condition)
+        if p.join_type == "cross" or not p.left_keys:
+            # cartesian / nested-loop: candidate pairs must see every
+            # right row, so both sides collapse to one partition
+            # (GpuCartesianProductExec)
             from spark_rapids_tpu.plan.execs.exchange import (
                 TpuSinglePartitionExec)
             left = TpuSinglePartitionExec(left)
@@ -818,10 +848,12 @@ class PlanMeta:
             if left.num_partitions() > 1 or right.num_partitions() > 1:
                 left = self._exchange(nparts, p.left_keys, left)
                 right = self._exchange(nparts, p.right_keys, right)
-        join = TpuShuffledHashJoinExec(
+        join: TpuExec = TpuShuffledHashJoinExec(
             left, right, p.left_keys, p.right_keys, p.join_type, p.schema,
-            target_rows=self.conf.batch_size_rows)
-        if p.condition is not None:
+            target_rows=self.conf.batch_size_rows,
+            condition=p.condition if p.join_type != "cross" else None)
+        if p.condition is not None and p.join_type == "cross":
+            # cross + condition: Spark's Filter-over-CartesianProduct shape
             join = TpuFilterExec(p.condition, join)
         return join
 

@@ -121,6 +121,27 @@ class HeartbeatRegistry:
         self._peers: Dict[str, Tuple[str, int, float]] = {}
         self.timeout_s = timeout_s
         self._next_shuffle = 0
+        # per-shuffle participation: which executors WILL write map output
+        # (declared at transport construction) and which have finished.
+        # Readers await completeness only from declared participants, so a
+        # registered-but-idle worker can't stall every read
+        # (MapOutputTracker role).
+        self._participants: Dict[int, set] = {}
+        self._map_complete: Dict[int, set] = {}
+
+    def join_shuffle(self, shuffle_id: int, executor_id: str) -> None:
+        with self._lock:
+            self._participants.setdefault(shuffle_id, set()).add(executor_id)
+
+    def map_complete(self, shuffle_id: int, executor_id: str) -> None:
+        with self._lock:
+            self._participants.setdefault(shuffle_id, set()).add(executor_id)
+            self._map_complete.setdefault(shuffle_id, set()).add(executor_id)
+
+    def shuffle_status(self, shuffle_id: int) -> Tuple[List[str], List[str]]:
+        with self._lock:
+            return (sorted(self._participants.get(shuffle_id, ())),
+                    sorted(self._map_complete.get(shuffle_id, ())))
 
     def next_shuffle_id(self) -> int:
         """Driver-coordinated shuffle ids: every host sees the same id for
@@ -129,6 +150,19 @@ class HeartbeatRegistry:
         with self._lock:
             self._next_shuffle += 1
             return self._next_shuffle
+
+    def declare_shuffle(self, shuffle_id: int, participants) -> None:
+        """Coordinator-declared participant set (the MapOutputTracker
+        role): readers wait for exactly these executors' map output.
+        Without a declaration the set accrues dynamically from
+        join_shuffle — correct once every participant has constructed its
+        transport, but a reader racing a slow participant's *construction*
+        can see a complete-looking subset; topologies where that race is
+        possible must declare (the coordinator knows the worker set the
+        query runs on, as Spark's scheduler does)."""
+        with self._lock:
+            self._participants.setdefault(shuffle_id, set()).update(
+                participants)
 
     def register(self, executor_id: str, host: str, port: int,
                  role: str = "worker") -> None:
@@ -193,6 +227,23 @@ class ShuffleBlockServer:
                 elif op == "new_shuffle" and outer.registry is not None:
                     _send_msg(self.request,
                               {"shuffle_id": outer.registry.next_shuffle_id()})
+                elif op == "declare_shuffle" and outer.registry is not None:
+                    outer.registry.declare_shuffle(header["shuffle_id"],
+                                                   header["participants"])
+                    _send_msg(self.request, {"ok": True})
+                elif op == "join_shuffle" and outer.registry is not None:
+                    outer.registry.join_shuffle(header["shuffle_id"],
+                                                header["executor_id"])
+                    _send_msg(self.request, {"ok": True})
+                elif op == "map_complete" and outer.registry is not None:
+                    outer.registry.map_complete(header["shuffle_id"],
+                                                header["executor_id"])
+                    _send_msg(self.request, {"ok": True})
+                elif op == "shuffle_status" and outer.registry is not None:
+                    parts, comp = outer.registry.shuffle_status(
+                        header["shuffle_id"])
+                    _send_msg(self.request,
+                              {"participants": parts, "complete": comp})
                 elif op == "heartbeat" and outer.registry is not None:
                     outer.registry.heartbeat(header["executor_id"])
                     _send_msg(self.request,
@@ -261,6 +312,24 @@ class PeerClient:
                                     "executor_id": executor_id})
         return {k: tuple(v) for k, v in h["peers"].items()}
 
+    def join_shuffle(self, shuffle_id: int, executor_id: str) -> None:
+        _request(self.addr, {"op": "join_shuffle", "shuffle_id": shuffle_id,
+                             "executor_id": executor_id})
+
+    def declare_shuffle(self, shuffle_id: int, participants) -> None:
+        _request(self.addr, {"op": "declare_shuffle",
+                             "shuffle_id": shuffle_id,
+                             "participants": list(participants)})
+
+    def map_complete(self, shuffle_id: int, executor_id: str) -> None:
+        _request(self.addr, {"op": "map_complete", "shuffle_id": shuffle_id,
+                             "executor_id": executor_id})
+
+    def shuffle_status(self, shuffle_id: int) -> Tuple[List[str], List[str]]:
+        h, _ = _request(self.addr, {"op": "shuffle_status",
+                                    "shuffle_id": shuffle_id})
+        return h["participants"], h["complete"]
+
 
 class BlockFetchIterator:
     """Pull all of a partition's blocks from a set of peers under a bounded
@@ -322,7 +391,8 @@ class TcpShuffleTransport:
                  schema: Schema, codec: str = "none",
                  max_inflight_bytes: int = 64 << 20,
                  shuffle_id: Optional[int] = None,
-                 completeness_timeout_s: float = 120.0):
+                 completeness_timeout_s: float = 120.0,
+                 participants=None):
         self.shuffle_id = (shuffle_id if shuffle_id is not None
                            else executor.new_shuffle_id())
         self.executor = executor
@@ -331,6 +401,15 @@ class TcpShuffleTransport:
         self.codec = codec
         self.max_inflight = max_inflight_bytes
         self.completeness_timeout_s = completeness_timeout_s
+        # declare map-side participation up front: readers only await
+        # completeness from executors that actually participate in this
+        # shuffle, so a registered-but-idle worker never stalls reads
+        # (ADVICE r2 #5).  A coordinator that knows the full worker set
+        # passes `participants` so a reader racing a slow worker's
+        # transport construction still waits for it.
+        self.executor.join_shuffle(self.shuffle_id)
+        if participants:
+            self.executor.declare_shuffle(self.shuffle_id, participants)
 
     def write(self, pieces: Iterable[Tuple[int, ColumnarBatch]]) -> None:
         from spark_rapids_tpu.shuffle.serializer import serialize_batch
@@ -338,28 +417,45 @@ class TcpShuffleTransport:
             self.executor.store.put(self.shuffle_id, p,
                                     serialize_batch(piece, self.codec))
         self.executor.store.mark_complete(self.shuffle_id)
+        self.executor.map_complete(self.shuffle_id)
 
     def read(self, partition: int) -> List[ColumnarBatch]:
         from spark_rapids_tpu.shuffle.serializer import merge_batches
         # learn peers that joined since construction, then fetch: own
         # blocks short-circuit through the in-process store, remote blocks
         # stream through the flow-controlled iterator; remote map outputs
-        # must be complete (no silent partial reads)
+        # must be complete (no silent partial reads).  Completeness is
+        # tracked per-participant in the driver registry: only executors
+        # that joined this shuffle are awaited or fetched from.
         self.executor.heartbeat()
         blocks = self.executor.store.get(self.shuffle_id, partition)
-        remote = self.executor.peer_clients(include_self=False)
+        deadline = time.time() + self.completeness_timeout_s
+        while True:
+            participants, complete = self.executor.shuffle_status(
+                self.shuffle_id)
+            if set(participants) <= set(complete):
+                break
+            if time.time() >= deadline:
+                raise RuntimeError(
+                    f"shuffle {self.shuffle_id}: map output incomplete "
+                    f"after {self.completeness_timeout_s}s: "
+                    f"{sorted(set(participants) - set(complete))} pending")
+            time.sleep(0.05)
+        remote = []
+        for eid in complete:
+            if eid == self.executor.executor_id:
+                continue
+            peer = self.executor.peer_client_for(eid)
+            if peer is None:
+                # a participant completed its map output but is no longer
+                # reachable: failing loudly beats silently dropping its
+                # blocks (fetch-failed -> recompute is the upper layer's
+                # job, as in Spark)
+                raise RuntimeError(
+                    f"shuffle {self.shuffle_id}: completed participant "
+                    f"{eid} has no reachable address (peer lost)")
+            remote.append(peer)
         if remote:
-            deadline = time.time() + self.completeness_timeout_s
-            for peer in remote:
-                while True:   # no silent partial reads: wait for map side
-                    try:
-                        peer.list_blocks(self.shuffle_id, partition,
-                                         require_complete=True)
-                        break
-                    except RuntimeError:
-                        if time.time() >= deadline:
-                            raise
-                        time.sleep(0.05)
             blocks = blocks + list(BlockFetchIterator(
                 remote, self.shuffle_id, partition, self.max_inflight))
         if not blocks:
@@ -423,6 +519,38 @@ class ShuffleExecutor:
             return PeerClient(self._driver).new_shuffle_id()
         assert self.registry is not None
         return self.registry.next_shuffle_id()
+
+    def join_shuffle(self, shuffle_id: int) -> None:
+        if self._driver is not None:
+            PeerClient(self._driver).join_shuffle(shuffle_id,
+                                                  self.executor_id)
+        elif self.registry is not None:
+            self.registry.join_shuffle(shuffle_id, self.executor_id)
+
+    def declare_shuffle(self, shuffle_id: int, participants) -> None:
+        if self._driver is not None:
+            PeerClient(self._driver).declare_shuffle(shuffle_id,
+                                                     participants)
+        elif self.registry is not None:
+            self.registry.declare_shuffle(shuffle_id, participants)
+
+    def map_complete(self, shuffle_id: int) -> None:
+        if self._driver is not None:
+            PeerClient(self._driver).map_complete(shuffle_id,
+                                                  self.executor_id)
+        elif self.registry is not None:
+            self.registry.map_complete(shuffle_id, self.executor_id)
+
+    def shuffle_status(self, shuffle_id: int):
+        if self._driver is not None:
+            return PeerClient(self._driver).shuffle_status(shuffle_id)
+        if self.registry is not None:
+            return self.registry.shuffle_status(shuffle_id)
+        return [self.executor_id], [self.executor_id]
+
+    def peer_client_for(self, executor_id: str) -> Optional[PeerClient]:
+        addr = self._peers.get(executor_id)
+        return PeerClient(addr) if addr is not None else None
 
     def close(self) -> None:
         self.server.close()

@@ -26,6 +26,16 @@ from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
 MAGIC = 0x54414431
 
 
+def wire_supported(dt: T.DataType) -> bool:
+    """Column types the kudo wire format can carry: fixed-width and
+    (offsets, bytes) string-likes.  Nested types (array/struct/map) are
+    not wire-serializable yet — cross-process transports must refuse them
+    rather than silently narrowing to an in-process mode."""
+    if isinstance(dt, (T.ArrayType, T.StructType, T.MapType)):
+        return False
+    return dt.np_dtype is not None
+
+
 def _host_cols(batch: ColumnarBatch):
     """Download device batch -> [(validity, offsets|None, data)] trimmed to
     live rows (the wire carries no padding)."""

@@ -143,6 +143,16 @@ def make_transport(mode: str, num_partitions: int, schema: Schema,
     if mode == "MULTITHREADED":
         return KudoWireTransport(num_partitions, schema, writer_threads, codec)
     if mode == "MULTIPROCESS":
+        from spark_rapids_tpu.shuffle.serializer import wire_supported
+        unsupported = [str(d) for d in schema.dtypes
+                       if not wire_supported(d)]
+        if unsupported:
+            # never silently downgrade a cross-process transport: a remote
+            # reduce task would read only its local slices and return
+            # partial results (ADVICE r2 #1)
+            raise NotImplementedError(
+                "MULTIPROCESS shuffle cannot serialize column types "
+                f"{unsupported} on the kudo wire")
         from spark_rapids_tpu.shuffle.net import TcpShuffleTransport
         return TcpShuffleTransport(process_shuffle_executor(),
                                    num_partitions, schema, codec)

@@ -159,3 +159,89 @@ def _df_like(q, s, how):
         def collect(self_inner):
             return q(s, how)
     return _W()
+
+
+# -- conditional joins (residual conditions on every type), existence, and
+#    nested-loop/cartesian shapes (VERDICT r2 #4a) --------------------------
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti", "existence"])
+def test_join_types_with_condition(how):
+    """Residual non-equi condition on every join type: the conditional
+    gather path (reference GpuHashJoin.scala:1653 conditional iterators +
+    :2426 existence join)."""
+    assert_tpu_cpu_equal(
+        lambda s: left_df(s).join(right_df(s), "k", how=how,
+                                  condition=col("lv") < col("rv")))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti",
+                                 "existence"])
+def test_nested_loop_join(how):
+    """Keyless joins with a condition: the broadcast-nested-loop shape
+    (reference GpuBroadcastNestedLoopJoinExecBase)."""
+    assert_tpu_cpu_equal(
+        lambda s: left_df(s, n=80, parts=2).join(
+            right_df(s, n=40, parts=1), None, how=how,
+            condition=col("lv") < col("rv")))
+
+
+def test_nested_loop_right_and_full():
+    """Non-broadcastable keyless joins collapse to one partition
+    (cartesian shape, GpuCartesianProductExec)."""
+    for how in ("right", "full"):
+        assert_tpu_cpu_equal(
+            lambda s, h=how: left_df(s, n=60, parts=2).join(
+                right_df(s, n=30, parts=2), None, how=h,
+                condition=col("lv") < col("rv")))
+
+
+def test_existence_join_no_condition():
+    """Plain existence join: every left row + exists flag."""
+    rows = assert_tpu_cpu_equal(
+        lambda s: left_df(s).join(right_df(s), "k", how="existence"))
+    assert len(rows) == 300          # all left rows, exactly once
+    assert any(r[-1] for r in rows) and not all(r[-1] for r in rows)
+
+
+def test_conditional_join_string_condition_input():
+    """Condition referencing a string column: the pair-batch gather must
+    carry string byte buffers through the byte-capacity retry."""
+    ls = Schema.of(k=T.INT, name=T.STRING)
+    rs = Schema.of(k=T.INT, tag=T.STRING)
+
+    def build(s):
+        l = s.create_dataframe(
+            {"k": [1, 1, 2, 3, None], "name": ["aa", "bb", "cc", None, "ee"]},
+            ls)
+        r = s.create_dataframe(
+            {"k": [1, 2, 2, 4], "tag": ["ab", "bb", None, "zz"]}, rs)
+        return l.join(r, "k", how="left",
+                      condition=col("name") < col("tag"))
+    assert_tpu_cpu_equal(build)
+
+
+def test_conditional_join_with_empty_sides():
+    def empty_right(s):
+        return right_df(s).filter(col("rv") > lit(10**9))
+    for how in ("left", "left_anti", "existence", "full"):
+        assert_tpu_cpu_equal(
+            lambda s, h=how: left_df(s).join(
+                empty_right(s), "k", how=h,
+                condition=col("lv") < col("rv")))
+
+
+@pytest.mark.inject_oom
+def test_conditional_join_with_injected_oom():
+    assert_tpu_cpu_equal(
+        lambda s: left_df(s).join(right_df(s), "k", how="full",
+                                  condition=col("lv") < col("rv")))
+
+
+def test_conditional_join_out_of_core():
+    """Conditional join through the sub-partitioned out-of-core path."""
+    def build(s):
+        s.set_conf("spark.rapids.sql.batchSizeRows", 1 << 7)
+        return left_df(s).join(right_df(s), "k", how="left",
+                               condition=col("lv") < col("rv"))
+    assert_tpu_cpu_equal(build)

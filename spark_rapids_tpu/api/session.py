@@ -433,6 +433,10 @@ class DataFrame:
     # -- actions ------------------------------------------------------------
 
     def collect(self) -> List[tuple]:
+        with self._session_tz_scope():
+            return self._collect_impl()
+
+    def _collect_impl(self) -> List[tuple]:
         if self.session.conf.sql_enabled:
             exec_plan, _ = plan_query(self.plan, self.session.conf)
             if (self.session.conf.shuffle_mode == "ICI"
@@ -462,12 +466,20 @@ class DataFrame:
         exec_plan, meta = plan_query(self.plan, self.session.conf)
         return exec_plan
 
+    def _session_tz_scope(self):
+        """Every plan-executing action runs under the session timezone
+        ambient — written output must agree with collect() output."""
+        from spark_rapids_tpu.config import session_timezone
+        return session_timezone(self.session.conf.raw(
+            "spark.sql.session.timeZone", "UTC"))
+
     def _collect_batches(self):
         """Materialize as device batches (the ColumnarRdd analog: zero-copy
         handoff to ML frameworks, reference sql-plugin-api ColumnarRdd.scala)."""
-        exec_plan, _ = plan_query(self.plan, self.session.conf)
-        engine = TpuEngine(self.session.conf)
-        out = engine.execute(exec_plan)
+        with self._session_tz_scope():
+            exec_plan, _ = plan_query(self.plan, self.session.conf)
+            engine = TpuEngine(self.session.conf)
+            out = engine.execute(exec_plan)
         self.session.last_query_metrics = engine.last_metrics
         return out
 
@@ -477,8 +489,9 @@ class DataFrame:
         if self.session.conf.sql_enabled:
             return self._collect_batches()
         from spark_rapids_tpu.columnar.batch import ColumnarBatch
-        tables = CpuEngine(
-            self.session.conf.shuffle_partitions).execute(self.plan)
+        with self._session_tz_scope():
+            tables = CpuEngine(
+                self.session.conf.shuffle_partitions).execute(self.plan)
         out = []
         for t in tables:
             data = {}

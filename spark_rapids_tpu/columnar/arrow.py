@@ -45,6 +45,15 @@ def arrow_type_to_sql(at: pa.DataType) -> T.DataType:
         return arrow_type_to_sql(at.value_type)
     if pa.types.is_list(at) or pa.types.is_large_list(at):
         return T.ArrayType(arrow_type_to_sql(at.value_type))
+    if pa.types.is_struct(at):
+        return T.StructType(tuple(
+            T.StructField(at.field(i).name,
+                          arrow_type_to_sql(at.field(i).type),
+                          at.field(i).nullable)
+            for i in range(at.num_fields)))
+    if pa.types.is_map(at):
+        return T.MapType(arrow_type_to_sql(at.key_type),
+                         arrow_type_to_sql(at.item_type))
     raise NotImplementedError(f"unsupported arrow type: {at}")
 
 
@@ -75,6 +84,13 @@ def sql_type_to_arrow(dt: T.DataType) -> pa.DataType:
         return pa.decimal128(dt.precision, dt.scale)
     if isinstance(dt, T.ArrayType):
         return pa.list_(sql_type_to_arrow(dt.element_type))
+    if isinstance(dt, T.StructType):
+        return pa.struct([pa.field(f.name, sql_type_to_arrow(f.dtype),
+                                   f.nullable)
+                          for f in dt.fields])
+    if isinstance(dt, T.MapType):
+        return pa.map_(sql_type_to_arrow(dt.key_type),
+                       sql_type_to_arrow(dt.value_type))
     raise NotImplementedError(f"unsupported sql type: {dt}")
 
 
@@ -94,6 +110,14 @@ def arrow_column_to_device(arr: pa.Array, dtype: T.DataType,
         # List<elem> upload via python objects (list columns are cold-path
         # inputs; the hot scan columns are primitives/strings)
         return DeviceColumn.from_arrays(arr.to_pylist(), dtype, capacity=capacity)
+    if isinstance(dtype, T.StructType):
+        rows = [None if v is None else tuple(v[f.name] for f in dtype.fields)
+                for v in arr.to_pylist()]
+        return DeviceColumn.from_structs(rows, dtype, capacity=capacity)
+    if isinstance(dtype, T.MapType):
+        # arrow MapArray rows arrive as lists of (key, value) tuples
+        return DeviceColumn.from_maps(arr.to_pylist(), dtype,
+                                      capacity=capacity)
     if dtype.variable_width:
         if pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
             arr = arr.cast(pa.string() if pa.types.is_large_string(arr.type) else pa.binary())
@@ -175,8 +199,13 @@ def batch_to_arrow(batch: ColumnarBatch) -> pa.Table:
     fields = []
     for name, dtype, col in zip(batch.schema.names, batch.schema.dtypes, batch.columns):
         at = sql_type_to_arrow(dtype)
-        if isinstance(dtype, T.ArrayType):
+        if isinstance(dtype, (T.ArrayType, T.MapType)):
             arrays.append(pa.array(col.to_pylist(n), type=at))
+        elif isinstance(dtype, T.StructType):
+            rows = [None if v is None
+                    else {f.name: v[i] for i, f in enumerate(dtype.fields)}
+                    for v in col.to_pylist(n)]
+            arrays.append(pa.array(rows, type=at))
         elif dtype.variable_width:
             # Build from raw buffers: offsets/data download straight into an
             # Arrow StringArray without Python-object round-trips.

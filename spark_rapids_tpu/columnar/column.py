@@ -129,6 +129,14 @@ class DeviceColumn:
 
     @staticmethod
     def empty(dtype: T.DataType, capacity: int, byte_capacity: int = 0) -> "DeviceColumn":
+        if isinstance(dtype, T.DecimalType) and dtype.uses_two_limbs:
+            return DeviceColumn(
+                data=jnp.zeros((capacity,), dtype=jnp.int8),
+                validity=jnp.zeros((capacity,), dtype=jnp.bool_),
+                dtype=dtype,
+                children=(DeviceColumn.empty(T.LONG, capacity),
+                          DeviceColumn.empty(T.LONG, capacity)),
+            )
         if isinstance(dtype, T.StructType):
             return DeviceColumn(
                 data=jnp.zeros((capacity,), dtype=jnp.int8),
@@ -299,6 +307,9 @@ class DeviceColumn:
     def _from_values(values, dtype: T.DataType,
                      capacity: Optional[int] = None) -> "DeviceColumn":
         """Dispatch host upload by dtype (used recursively for nesting)."""
+        if isinstance(dtype, T.DecimalType) and dtype.uses_two_limbs:
+            return DeviceColumn.from_decimal128(values, dtype,
+                                                capacity=capacity)
         if isinstance(dtype, T.StructType):
             return DeviceColumn.from_structs(values, dtype, capacity=capacity)
         if isinstance(dtype, T.MapType):
@@ -317,6 +328,30 @@ class DeviceColumn:
             else:
                 arr[i] = v
         return DeviceColumn.from_numpy(arr, dtype, valid, capacity=capacity)
+
+    @staticmethod
+    def from_decimal128(values, dtype: T.DataType,
+                        capacity: Optional[int] = None) -> "DeviceColumn":
+        """Host→HBM upload of a two-limb decimal column; rows are unscaled
+        python ints (or None)."""
+        n = len(values)
+        cap = capacity if capacity is not None else round_up_pow2(max(n, 1))
+        hi = np.zeros((cap,), np.int64)
+        lo = np.zeros((cap,), np.int64)
+        valid = np.zeros((cap,), np.bool_)
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            u = int(v) & ((1 << 128) - 1)
+            h = u >> 64
+            l = u & ((1 << 64) - 1)
+            hi[i] = h - (1 << 64) if h >= (1 << 63) else h
+            lo[i] = l - (1 << 64) if l >= (1 << 63) else l
+            valid[i] = True
+        kids = (DeviceColumn(jnp.asarray(hi), jnp.asarray(valid), T.LONG),
+                DeviceColumn(jnp.asarray(lo), jnp.asarray(valid), T.LONG))
+        return DeviceColumn(jnp.zeros((cap,), jnp.int8),
+                            jnp.asarray(valid), dtype, children=kids)
 
     @staticmethod
     def from_structs(values, dtype: T.DataType,
@@ -409,6 +444,18 @@ class DeviceColumn:
         return data, valid
 
     def to_pylist(self, num_rows: int):
+        if self.is_struct and isinstance(self.dtype, T.DecimalType):
+            valid = np.asarray(self.validity)
+            hi = np.asarray(self.children[0].data)
+            lo = np.asarray(self.children[1].data)
+            out = []
+            for i in range(num_rows):
+                if not valid[i]:
+                    out.append(None)
+                else:
+                    out.append((int(hi[i]) << 64)
+                               | (int(lo[i]) & ((1 << 64) - 1)))
+            return out
         if self.is_struct:
             valid = np.asarray(self.validity)
             kids = [c.to_pylist(num_rows) for c in self.children]

@@ -454,3 +454,36 @@ def generate_config_docs() -> str:
         doc = e.doc.replace("\n", " ")
         lines.append(f"| `{e.key}` | {doc} | {default} |")
     return "\n".join(lines) + "\n"
+
+
+# -- session timezone ambient -------------------------------------------------
+# Spark's spark.sql.session.timeZone: datetime field extraction and
+# timestamp->date casts interpret instants in this zone.  Exposed as a
+# process ambient (set around query execution by DataFrame.collect) because
+# expression eval has no conf channel — the same shape as Spark's
+# SQLConf.get session-local lookups.  shared_jit keys on it so compiled
+# programs never leak across zones.
+
+_SESSION_TZ = "UTC"
+
+
+def current_session_timezone() -> str:
+    return _SESSION_TZ
+
+
+class session_timezone:
+    """Context manager scoping the ambient session timezone."""
+
+    def __init__(self, tz: str):
+        self.tz = tz or "UTC"
+
+    def __enter__(self):
+        global _SESSION_TZ
+        self._saved = _SESSION_TZ
+        _SESSION_TZ = self.tz
+        return self
+
+    def __exit__(self, *exc):
+        global _SESSION_TZ
+        _SESSION_TZ = self._saved
+        return False

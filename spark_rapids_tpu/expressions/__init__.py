@@ -170,3 +170,10 @@ from spark_rapids_tpu.expressions.aggregates import (
     approx_count_distinct,
 )
 from spark_rapids_tpu.expressions.grouping import GroupingId, grouping_id
+from spark_rapids_tpu.expressions.structs import (
+    CreateMap, CreateNamedStruct, GetMapValue, GetStructField, MapKeys,
+    MapValues, create_map, map_keys, map_value, map_values, named_struct,
+    struct_field)
+from spark_rapids_tpu.expressions.datetime import (
+    FromUtcTimestamp, ToUtcTimestamp, from_utc_timestamp,
+    to_utc_timestamp)

@@ -32,6 +32,7 @@ COUNT_VALID = "count_valid"  # counts non-null inputs
 COUNT_STAR = "count_star"    # counts rows
 MIN = "min"
 MAX = "max"
+SUM128 = "sum128"            # exact int128 sum of decimal limbs
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,16 +97,23 @@ class Sum(AggregateFunction):
 
     @property
     def buffers(self):
+        dt = self.dtype
+        if isinstance(dt, T.DecimalType) and dt.uses_two_limbs:
+            # int128 limb accumulation; overflow is signalled as a NULL
+            # sum buffer with a non-zero count (Spark's sum/isEmpty
+            # overflow contract post SPARK-28067)
+            return (BufferSlot(dt, SUM128, SUM128),
+                    BufferSlot(T.LONG, COUNT_VALID, SUM))
         return (BufferSlot(self.dtype, SUM, SUM),
                 BufferSlot(T.LONG, COUNT_VALID, SUM))
 
     def finalize_np(self, bufs):
-        (s, _), (n, _) = bufs
-        return s, n > 0
+        (s, s_valid), (n, _) = bufs
+        return s, (n > 0) & s_valid
 
     def finalize_jnp(self, bufs):
-        (s, _), (n, _) = bufs
-        return s, n > 0
+        (s, s_valid), (n, _) = bufs
+        return s, (n > 0) & s_valid
 
 
 class Count(AggregateFunction):

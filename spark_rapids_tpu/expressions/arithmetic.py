@@ -106,6 +106,38 @@ class BinaryArithmetic(BinaryExpression):
                 _rescale_unscaled(rdata.astype(xp.int64), r.scale,
                                   out_dt.scale, xp))
 
+    def _uses_128(self) -> bool:
+        out = self.dtype
+        return (out.uses_two_limbs
+                or self.left.dtype.uses_two_limbs
+                or self.right.dtype.uses_two_limbs)
+
+    def _eval_decimal128(self, lc, rc, validity, out_dt):
+        """Two-limb device path: rescale to the result scale, operate in
+        int128, overflow beyond the result precision -> null."""
+        from spark_rapids_tpu.kernels import decimal as DK
+        ldt, rdt = self.left.dtype, self.right.dtype
+        op = type(self).__name__
+        lh, ll = DK.limbs_of(lc, ldt)
+        rh, rl = DK.limbs_of(rc, rdt)
+        if op == "Multiply":
+            h, l, ov = DK.mul128_checked(lh, ll, rh, rl)
+            validity = validity & ~ov
+            prod_scale = ldt.scale + rdt.scale
+            if out_dt.scale != prod_scale:
+                h, l = DK.rescale(h, l, prod_scale, out_dt.scale)
+        else:
+            lh, ll = DK.rescale(lh, ll, ldt.scale, out_dt.scale)
+            rh, rl = DK.rescale(rh, rl, rdt.scale, out_dt.scale)
+            if op == "Add":
+                h, l = DK.add128(lh, ll, rh, rl)
+            elif op == "Subtract":
+                h, l = DK.sub128(lh, ll, rh, rl)
+            else:
+                raise NotImplementedError(f"decimal128 {op}")
+        validity = validity & ~DK.overflow(h, l, out_dt.precision)
+        return DK.make_column128(h, l, validity, out_dt)
+
     def eval(self, ctx: EvalContext):
         lc = self.left.eval(ctx)
         rc = self.right.eval(ctx)
@@ -114,6 +146,8 @@ class BinaryArithmetic(BinaryExpression):
         if self._is_decimal():
             assert self._decimal_capable, \
                 f"{type(self).__name__} has no decimal path (planner gap)"
+            if self._uses_128():
+                return self._eval_decimal128(lc, rc, validity, out_dt)
             lhs, rhs = self._decimal_operands(lc.data, rc.data, jnp)
             vals = self._op(lhs, rhs)
             validity = _overflow_null(vals, validity,
@@ -129,6 +163,43 @@ class BinaryArithmetic(BinaryExpression):
         out_dt = self.dtype
         validity = cpu_null_propagating([lval, rval])
         if self._is_decimal():
+            if self._uses_128():
+                # exact python-int oracle path (object arrays)
+                ldt, rdt = self.left.dtype, self.right.dtype
+                op = type(self).__name__
+
+                def ints(vs, valid):
+                    return [int(x) if m and x is not None else 0
+                            for x, m in zip(vs, valid)]
+                lo = ints(lv, lval)
+                ro = ints(rv, rval)
+                if op == "Multiply":
+                    vals = [a * b for a, b in zip(lo, ro)]
+                    k = out_dt.scale - (ldt.scale + rdt.scale)
+                else:
+                    sl = 10 ** (out_dt.scale - ldt.scale)
+                    sr = 10 ** (out_dt.scale - rdt.scale)
+                    vals = ([a * sl + b * sr for a, b in zip(lo, ro)]
+                            if op == "Add"
+                            else [a * sl - b * sr for a, b in zip(lo, ro)])
+                    k = 0
+                if k < 0:
+                    d = 10 ** (-k)
+
+                    def half_up(v):
+                        q, r = divmod(abs(v), d)
+                        q += 1 if 2 * r >= d else 0
+                        return -q if v < 0 else q
+                    vals = [half_up(v) for v in vals]
+                elif k > 0:
+                    vals = [v * 10 ** k for v in vals]
+                bound = 10 ** out_dt.precision
+                validity = validity & np.array(
+                    [-bound < v < bound for v in vals], np.bool_)
+                out = np.empty((len(vals),), object)
+                out[:] = [v if m else None
+                          for v, m in zip(vals, validity)]
+                return out, validity
             lhs, rhs = self._decimal_operands(lv, rv, np)
             with np.errstate(all="ignore"):
                 vals = self._np_op(lhs, rhs)

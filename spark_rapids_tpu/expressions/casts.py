@@ -69,14 +69,14 @@ class Cast(UnaryExpression):
             return True
         fixed = lambda d: (d.is_numeric and not isinstance(d, T.DecimalType)) \
             or isinstance(d, T.BooleanType)
-        dec64 = lambda d: isinstance(d, T.DecimalType) and d.precision <= 18
+        dec = lambda d: isinstance(d, T.DecimalType)
         if fixed(src) and fixed(dst):
             return True
-        if dec64(src) and dec64(dst):
+        if dec(src) and dec(dst):
             return True
-        if dec64(src) and (dst.is_integral or dst.is_floating):
+        if dec(src) and (dst.is_integral or dst.is_floating):
             return True
-        if (src.is_integral or isinstance(src, T.BooleanType)) and dec64(dst):
+        if (src.is_integral or isinstance(src, T.BooleanType)) and dec(dst):
             return True
         if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
             return True
@@ -105,6 +105,9 @@ class Cast(UnaryExpression):
             return self._eval_from_string(c, ctx, dst)
         if isinstance(dst, T.StringType):
             return self._eval_to_string(c, ctx, src)
+        if (isinstance(src, T.DecimalType) and src.uses_two_limbs) or \
+                (isinstance(dst, T.DecimalType) and dst.uses_two_limbs):
+            return _decimal128_cast_eval(c, src, dst)
         data = c.data
         if isinstance(src, T.BooleanType):
             out = data.astype(dst.jnp_dtype)
@@ -113,7 +116,10 @@ class Cast(UnaryExpression):
         elif isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
             out = data.astype(jnp.int64) * MICROS_PER_DAY
         elif isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
-            out = jnp.floor_divide(data, MICROS_PER_DAY).astype(jnp.int32)
+            from spark_rapids_tpu.expressions.datetime import (
+                _session_local_jnp)
+            out = jnp.floor_divide(_session_local_jnp(data),
+                                   MICROS_PER_DAY).astype(jnp.int32)
         elif src.is_floating and dst.is_integral:
             lo, hi = _INT_RANGE[dst]
             x = jnp.trunc(jnp.nan_to_num(data, nan=0.0))
@@ -189,7 +195,15 @@ class Cast(UnaryExpression):
             elif isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
                 out = v.astype(np.int64) * MICROS_PER_DAY
             elif isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
-                out = np.floor_divide(v, MICROS_PER_DAY).astype(np.int32)
+                from spark_rapids_tpu.expressions.datetime import (
+                    _session_local_np)
+                out = np.floor_divide(
+                    _session_local_np(v.astype(np.int64)),
+                    MICROS_PER_DAY).astype(np.int32)
+            elif (isinstance(src, T.DecimalType) and src.uses_two_limbs) \
+                    or (isinstance(dst, T.DecimalType)
+                        and dst.uses_two_limbs):
+                return _decimal128_cast_cpu(v, valid, src, dst)
             elif isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
                 out, validity = _decimal_cast(
                     v.astype(np.int64) if isinstance(src, T.DecimalType)
@@ -334,6 +348,100 @@ def _cpu_to_string(v, valid, src: T.DataType):
     else:
         raise NotImplementedError(f"cpu cast {src!r} -> string")
     return out, valid.copy()
+
+
+def _decimal128_cast_cpu(v, valid, src: T.DataType, dst: T.DataType):
+    """Exact python-int oracle for casts touching two-limb decimals."""
+    n = len(v)
+    ints = [int(x) if m and x is not None else 0 for x, m in zip(v, valid)]
+    validity = valid.copy()
+    if isinstance(src, T.DecimalType):
+        if isinstance(dst, T.DecimalType):
+            k = dst.scale - src.scale
+            if k >= 0:
+                out_i = [x * 10 ** k for x in ints]
+            else:
+                d = 10 ** (-k)
+
+                def half_up(x):
+                    q, r = divmod(abs(x), d)
+                    q += 1 if 2 * r >= d else 0
+                    return -q if x < 0 else q
+                out_i = [half_up(x) for x in ints]
+            bound = 10 ** dst.precision
+            validity = validity & np.array(
+                [-bound < x < bound for x in out_i], np.bool_)
+            if dst.uses_two_limbs:
+                out = np.empty((n,), object)
+                out[:] = [x if m else None for x, m in zip(out_i, validity)]
+                return out, validity
+            return (np.array([x if m else 0
+                              for x, m in zip(out_i, validity)],
+                             np.int64), validity)
+        if dst.is_floating:
+            f = 10 ** src.scale
+            return (np.array([x / f for x in ints],
+                             dst.np_dtype), validity)
+        if dst.is_integral:
+            f = 10 ** src.scale
+            out_i = [abs(x) // f * (1 if x >= 0 else -1) for x in ints]
+            lo_b, hi_b = _INT_RANGE[_int_key(dst)]
+            validity = validity & np.array(
+                [lo_b <= x <= hi_b for x in out_i], np.bool_)
+            return (np.array([x if m else 0
+                              for x, m in zip(out_i, validity)],
+                             dst.np_dtype), validity)
+        raise NotImplementedError(f"cast {src!r} -> {dst!r}")
+    out_i = [int(x) * 10 ** dst.scale for x in ints]
+    bound = 10 ** dst.precision
+    validity = validity & np.array([-bound < x < bound for x in out_i],
+                                   np.bool_)
+    out = np.empty((n,), object)
+    out[:] = [x if m else None for x, m in zip(out_i, validity)]
+    return out, validity
+
+
+def _decimal128_cast_eval(c, src: T.DataType, dst: T.DataType):
+    """Casts where either side is a two-limb decimal (device path).
+
+    Spark semantics: rescale with HALF_UP on scale loss, overflow -> NULL
+    (non-ANSI, GpuCast.scala:1650 decimal paths); decimal -> integral
+    truncates toward zero; decimal -> double divides exactly in f64."""
+    from spark_rapids_tpu.kernels import decimal as DK
+    validity = c.validity
+    if isinstance(src, T.DecimalType):
+        h, l = DK.limbs_of(c, src)
+        if isinstance(dst, T.DecimalType):
+            h, l = DK.rescale(h, l, src.scale, dst.scale)
+            validity = validity & ~DK.overflow(h, l, dst.precision)
+            if dst.uses_two_limbs:
+                return DK.make_column128(h, l, validity, dst)
+            v64, fits = DK.narrow64(h, l)
+            validity = validity & fits
+            return make_column(v64, validity, dst)
+        if dst.is_floating:
+            f = DK.to_double(h, l) / (10.0 ** src.scale)
+            return make_column(f.astype(dst.jnp_dtype), validity, dst)
+        if dst.is_integral:
+            s = src.scale
+            while s > 0:        # truncate toward zero, <=9 digits per step
+                step = min(s, 9)
+                h, l = DK.div128_small(h, l, 10 ** step,
+                                       round_half_up=False)
+                s -= step
+            v64, fits = DK.narrow64(h, l)
+            lo_b, hi_b = _INT_RANGE[_int_key(dst)]
+            ok = fits & (v64 >= lo_b) & (v64 <= hi_b)
+            return make_column(
+                jnp.where(ok, v64, 0).astype(dst.jnp_dtype),
+                validity & ok, dst)
+        raise NotImplementedError(f"cast {src!r} -> {dst!r}")
+    # integral/boolean -> decimal128
+    assert isinstance(dst, T.DecimalType) and dst.uses_two_limbs
+    h, l = DK.widen64(c.data.astype(jnp.int64))
+    h, l = DK.rescale(h, l, 0, dst.scale)
+    validity = validity & ~DK.overflow(h, l, dst.precision)
+    return DK.make_column128(h, l, validity, dst)
 
 
 def _decimal_cast(data, validity, src: T.DataType, dst: T.DataType, xp):

@@ -77,9 +77,15 @@ class CpuEvalContext:
         n = batch.host_num_rows()
         cols = []
         for col in batch.columns:
-            if col.dtype.variable_width:
-                vals = np.array(col.to_pylist(n) + [None], dtype=object)[:-1]
-                valid = np.array([v is not None for v in vals], dtype=np.bool_)
+            if col.dtype.variable_width or isinstance(col.dtype,
+                                                      T.StructType) \
+                    or (isinstance(col.dtype, T.DecimalType)
+                        and col.dtype.uses_two_limbs):
+                pylist = col.to_pylist(n)
+                vals = np.empty((n,), dtype=object)
+                vals[:] = pylist
+                valid = np.array([v is not None for v in pylist],
+                                 dtype=np.bool_)
             else:
                 vals, valid = col.to_numpy(n)
                 vals = vals.copy()

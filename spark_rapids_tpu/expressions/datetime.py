@@ -66,6 +66,29 @@ def _days_from_civil(y, m, d, xp):
     return era * 146097 + doe - 719468
 
 
+
+def _session_local_jnp(micros):
+    """Shift UTC epoch-micros into the session timezone's wall clock
+    (no-op for UTC; reference TimeZoneDB use in every field extraction)."""
+    from spark_rapids_tpu.config import current_session_timezone
+    tz = current_session_timezone()
+    if tz in ("UTC", "Etc/UTC", "GMT", "Z", "+00:00"):
+        return micros
+    from spark_rapids_tpu.kernels import timezone as TZ
+    trans, offs = TZ.zone_table(tz)
+    return TZ.utc_to_local_micros(micros.astype(jnp.int64),
+                                  jnp.asarray(trans), jnp.asarray(offs))
+
+
+def _session_local_np(micros):
+    from spark_rapids_tpu.config import current_session_timezone
+    tz = current_session_timezone()
+    if tz in ("UTC", "Etc/UTC", "GMT", "Z", "+00:00"):
+        return micros
+    from spark_rapids_tpu.kernels import timezone as TZ
+    return TZ.np_utc_to_local(micros.astype(np.int64), tz)
+
+
 class _DateField(UnaryExpression):
     @property
     def dtype(self):
@@ -78,7 +101,8 @@ class _DateField(UnaryExpression):
         c = self.child.eval(ctx)
         days = c.data
         if isinstance(c.dtype, T.TimestampType):
-            days = jnp.floor_divide(days, MICROS_PER_DAY)
+            days = jnp.floor_divide(_session_local_jnp(days),
+                                    MICROS_PER_DAY)
         out = self._field(days, jnp).astype(jnp.int32)
         return make_column(out, c.validity, T.INT)
 
@@ -86,7 +110,7 @@ class _DateField(UnaryExpression):
         v, valid = self.child.eval_cpu(ctx)
         days = v.astype(np.int64)
         if isinstance(self.child.dtype, T.TimestampType):
-            days = np.floor_divide(days, MICROS_PER_DAY)
+            days = np.floor_divide(_session_local_np(days), MICROS_PER_DAY)
         out = self._field(days, np).astype(np.int32)
         return cpu_zero_invalid(out, valid), valid
 
@@ -133,13 +157,14 @@ class _TimestampField(UnaryExpression):
 
     def eval(self, ctx: EvalContext):
         c = self.child.eval(ctx)
-        mod = c.data - jnp.floor_divide(c.data, MICROS_PER_DAY) * MICROS_PER_DAY
+        x = _session_local_jnp(c.data)
+        mod = x - jnp.floor_divide(x, MICROS_PER_DAY) * MICROS_PER_DAY
         out = self._field(mod, jnp).astype(jnp.int32)
         return make_column(out, c.validity, T.INT)
 
     def eval_cpu(self, ctx: CpuEvalContext):
         v, valid = self.child.eval_cpu(ctx)
-        x = v.astype(np.int64)
+        x = _session_local_np(v.astype(np.int64))
         mod = x - np.floor_divide(x, MICROS_PER_DAY) * MICROS_PER_DAY
         out = self._field(mod, np).astype(np.int32)
         return cpu_zero_invalid(out, valid), valid
@@ -574,3 +599,71 @@ class DateFromUnixDate(_TsScalar):
 
     def _op(self, x, xp):
         return x.astype(xp.int32)
+
+
+class _TzShift(UnaryExpression):
+    """Base of from_utc_timestamp/to_utc_timestamp: shift epoch-micros by
+    the zone's offset at the instant (kernels/timezone.py transition-table
+    lookup; reference TimeZoneDB.scala:27)."""
+
+    TO_LOCAL = True
+
+    def __init__(self, child: Expression, tz_name: str):
+        super().__init__(child)
+        self.tz_name = tz_name
+
+    def with_children(self, children):
+        return type(self)(children[0], self.tz_name)
+
+    @property
+    def dtype(self):
+        return T.TIMESTAMP
+
+    def eval(self, ctx: EvalContext):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.kernels import timezone as TZ
+        c = self.child.eval(ctx)
+        trans, offs = TZ.zone_table(self.tz_name)
+        trans_d = jnp.asarray(trans)
+        offs_d = jnp.asarray(offs)
+        fn = (TZ.utc_to_local_micros if self.TO_LOCAL
+              else TZ.local_to_utc_micros)
+        out = fn(c.data.astype(jnp.int64), trans_d, offs_d)
+        return make_column(out, c.validity, T.TIMESTAMP)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        from spark_rapids_tpu.kernels import timezone as TZ
+        v, m = self.child.eval_cpu(ctx)
+        fn = TZ.np_utc_to_local if self.TO_LOCAL else TZ.np_local_to_utc
+        out = fn(np.where(m, v.astype(np.int64), 0), self.tz_name)
+        return cpu_zero_invalid(out, m), m
+
+    def __repr__(self):
+        name = ("from_utc_timestamp" if self.TO_LOCAL
+                else "to_utc_timestamp")
+        return f"{name}({self.child!r}, {self.tz_name!r})"
+
+
+class FromUtcTimestamp(_TzShift):
+    """from_utc_timestamp(ts, tz): renders a UTC instant as the zone's
+    wall clock (Spark GpuFromUTCTimestamp)."""
+
+    TO_LOCAL = True
+
+
+class ToUtcTimestamp(_TzShift):
+    """to_utc_timestamp(ts, tz): interprets ts as the zone's wall clock
+    (Spark GpuToUTCTimestamp; overlap/gap per java.time)."""
+
+    TO_LOCAL = False
+
+
+def from_utc_timestamp(e, tz: str) -> FromUtcTimestamp:
+    from spark_rapids_tpu.expressions.core import col as _col
+    return FromUtcTimestamp(_col(e) if isinstance(e, str) else e, tz)
+
+
+def to_utc_timestamp(e, tz: str) -> ToUtcTimestamp:
+    from spark_rapids_tpu.expressions.core import col as _col
+    return ToUtcTimestamp(_col(e) if isinstance(e, str) else e, tz)

@@ -77,6 +77,20 @@ class BinaryComparison(BinaryExpression):
     def _prep_cpu(self, ctx: CpuEvalContext):
         lv, lval = self.left.eval_cpu(ctx)
         rv, rval = self.right.eval_cpu(ctx)
+        ldt, rdt = self.left.dtype, self.right.dtype
+        if isinstance(ldt, T.DecimalType) and isinstance(rdt, T.DecimalType):
+            cdt = _cmp_dtype(ldt, rdt)
+            if (cdt.uses_two_limbs or ldt.uses_two_limbs
+                    or rdt.uses_two_limbs):
+                # exact python-int compare at the common scale
+                def obj(vs, scale):
+                    k = 10 ** (cdt.scale - scale)
+                    out = np.empty((len(vs),), object)
+                    out[:] = [int(x) * k if x is not None else 0
+                              for x in vs]
+                    return out
+                return (obj(lv, ldt.scale), obj(rv, rdt.scale),
+                        cpu_null_propagating([lval, rval]), T.STRING)
         if lv.dtype == object or rv.dtype == object:
             return lv, rv, cpu_null_propagating([lval, rval]), T.STRING
         cdt = _cmp_dtype(self.left.dtype, self.right.dtype)
@@ -102,6 +116,26 @@ class BinaryComparison(BinaryExpression):
             for r in self._string_ranks:
                 vals = vals | (rank == r)
             return make_column(vals, validity, T.BOOLEAN)
+        ldt, rdt = lc.dtype, rc.dtype
+        if isinstance(ldt, T.DecimalType) and isinstance(rdt, T.DecimalType):
+            cdt = _cmp_dtype(ldt, rdt)
+            if (cdt.uses_two_limbs or ldt.uses_two_limbs
+                    or rdt.uses_two_limbs):
+                # int128 compare at the common scale (the int64 path would
+                # silently wrap on wide rescales)
+                from spark_rapids_tpu.kernels import decimal as DK
+                lh, ll = DK.limbs_of(lc, ldt)
+                rh, rl = DK.limbs_of(rc, rdt)
+                lh, ll = DK.rescale(lh, ll, ldt.scale, cdt.scale)
+                rh, rl = DK.rescale(rh, rl, rdt.scale, cdt.scale)
+                lt = DK.lt128(lh, ll, rh, rl)
+                eq = DK.eq128(lh, ll, rh, rl)
+                rank = jnp.where(lt, 0, jnp.where(eq, 1, 2))
+                validity = null_propagating([lc.validity, rc.validity])
+                vals = jnp.zeros((ctx.capacity,), jnp.bool_)
+                for r in self._string_ranks:
+                    vals = vals | (rank == r)
+                return make_column(vals, validity, T.BOOLEAN)
         lhs, rhs, validity, cdt = self._prep(ctx)
         vals = self._compare(lhs, rhs, jnp, _is_float(cdt))
         return make_column(vals, validity, T.BOOLEAN)

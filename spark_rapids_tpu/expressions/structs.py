@@ -1,0 +1,366 @@
+"""Struct and map expression twins.
+
+Reference: org/apache/spark/sql/rapids/complexTypeCreator.scala:35,86,178
+(GpuCreateArray/GpuCreateMap/GpuCreateNamedStruct) and
+complexTypeExtractors.scala (GpuGetStructField, GpuGetMapValue,
+GpuMapKeys/GpuMapValues in collectionOperations.scala).
+
+TPU design: a struct column is its field columns plus a presence mask, so
+CreateNamedStruct is free (column re-grouping, no data movement) and
+GetStructField is a validity AND.  Maps are entry-segmented key/value
+columns; GetMapValue is one vectorized compare over the whole entry plane
+plus a segment-min (first match per row) — no per-row loops.
+
+Divergences (documented): CreateMap does not raise on duplicate or null
+keys (Spark's mapKeyDedupPolicy=EXCEPTION); a null key becomes an entry
+that never matches lookups.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expressions.core import (
+    BinaryExpression,
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+    UnaryExpression,
+)
+
+
+def _zero_invalid(data, validity):
+    return jnp.where(validity, data, jnp.zeros((), data.dtype))
+
+
+def _mask_column(col: DeviceColumn, mask) -> DeviceColumn:
+    """AND a row mask into a column's validity (recursively for nesting),
+    zeroing fixed-width data so canonical padding holds."""
+    valid = col.validity & mask
+    if col.is_struct:
+        return DeviceColumn(col.data, valid, col.dtype,
+                            children=col.children)
+    if col.offsets is not None:
+        return DeviceColumn(col.data, valid, col.dtype, col.offsets,
+                            col.child_validity, col.children)
+    return DeviceColumn(_zero_invalid(col.data, valid), valid, col.dtype)
+
+
+class CreateNamedStruct(Expression):
+    """named_struct(n1, e1, ...) — reference complexTypeCreator.scala:178."""
+
+    def __init__(self, names: Sequence[str], exprs: Sequence[Expression]):
+        assert len(names) == len(exprs) and names
+        self.names = tuple(names)
+        self.children = tuple(exprs)
+
+    @property
+    def dtype(self):
+        return T.StructType(tuple(
+            T.StructField(n, e.dtype, e.nullable)
+            for n, e in zip(self.names, self.children)))
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return CreateNamedStruct(self.names, children)
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        kids = tuple(e.eval(ctx) for e in self.children)
+        live = ctx.live_mask()
+        return DeviceColumn(
+            jnp.zeros((ctx.capacity,), jnp.int8), live, self.dtype,
+            children=kids)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        kids = [e.eval_cpu(ctx) for e in self.children]
+        n = ctx.num_rows
+        out = np.empty((n,), dtype=object)
+        for i in range(n):
+            out[i] = tuple(
+                (v[i].item() if hasattr(v[i], "item") else v[i])
+                if m[i] else None
+                for v, m in kids)
+        return out, np.ones((n,), np.bool_)
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={e!r}" for n, e in zip(self.names,
+                                                       self.children))
+        return f"named_struct({inner})"
+
+
+class GetStructField(Expression):
+    """struct.field — reference complexTypeExtractors.scala GpuGetStructField."""
+
+    def __init__(self, child: Expression, name_or_ordinal):
+        self.child = child
+        self.children = (child,)
+        self._sel = name_or_ordinal
+
+    def _resolve(self) -> Tuple[int, T.DataType]:
+        st = self.child.dtype
+        assert isinstance(st, T.StructType), f"not a struct: {st!r}"
+        i = (st.field_index(self._sel) if isinstance(self._sel, str)
+             else int(self._sel))
+        return i, st.fields[i].dtype
+
+    @property
+    def dtype(self):
+        return self._resolve()[1]
+
+    @property
+    def nullable(self):
+        return True
+
+    def with_children(self, children):
+        return GetStructField(children[0], self._sel)
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        col = self.child.eval(ctx)
+        i, _ = self._resolve()
+        # a null struct reads every field as null
+        return _mask_column(col.children[i], col.validity)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, m = self.child.eval_cpu(ctx)
+        i, dt = self._resolve()
+        n = len(v)
+        valid = np.zeros((n,), np.bool_)
+        if isinstance(dt, (T.StructType, T.MapType, T.ArrayType)) \
+                or dt.variable_width:
+            out = np.empty((n,), dtype=object)
+            out[:] = [None] * n
+        else:
+            out = np.zeros((n,), dt.np_dtype)
+        for r in range(n):
+            if m[r] and v[r] is not None and v[r][i] is not None:
+                out[r] = v[r][i]
+                valid[r] = True
+        return out, valid
+
+    def __repr__(self):
+        return f"{self.child!r}.{self._sel}"
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...) — reference complexTypeCreator.scala:86."""
+
+    def __init__(self, exprs: Sequence[Expression]):
+        assert exprs and len(exprs) % 2 == 0
+        self.children = tuple(exprs)
+
+    @property
+    def dtype(self):
+        return T.MapType(self.children[0].dtype, self.children[1].dtype)
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return CreateMap(children)
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        cap = ctx.capacity
+        m = len(self.children) // 2
+        keys = [self.children[2 * j].eval(ctx) for j in range(m)]
+        vals = [self.children[2 * j + 1].eval(ctx) for j in range(m)]
+        live = ctx.live_mask()
+        # interleave row-major: entries of row i at [i*m, (i+1)*m)
+        kd = jnp.stack([k.data for k in keys], axis=1).reshape(cap * m)
+        kv = jnp.stack([k.validity & live for k in keys],
+                       axis=1).reshape(cap * m)
+        vd = jnp.stack([v.data for v in vals], axis=1).reshape(cap * m)
+        vv = jnp.stack([v.validity & live for v in vals],
+                       axis=1).reshape(cap * m)
+        offsets = (jnp.arange(cap + 1, dtype=jnp.int32)
+                   * jnp.int32(m))
+        end = ctx.batch.num_rows * m
+        offsets = jnp.minimum(offsets, end)
+        dt = self.dtype
+        kids = (DeviceColumn(_zero_invalid(kd, kv), kv, dt.key_type),
+                DeviceColumn(_zero_invalid(vd, vv), vv, dt.value_type))
+        return DeviceColumn(jnp.zeros((cap * m,), jnp.uint8), live, dt,
+                            offsets, children=kids)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        m = len(self.children) // 2
+        keys = [self.children[2 * j].eval_cpu(ctx) for j in range(m)]
+        vals = [self.children[2 * j + 1].eval_cpu(ctx) for j in range(m)]
+        n = ctx.num_rows
+        out = np.empty((n,), dtype=object)
+        for i in range(n):
+            d = {}
+            for (kv, km), (vv, vm) in zip(keys, vals):
+                k = kv[i].item() if hasattr(kv[i], "item") else kv[i]
+                v = (vv[i].item() if hasattr(vv[i], "item") else vv[i]) \
+                    if vm[i] else None
+                d[k if km[i] else None] = v
+            out[i] = d
+        return out, np.ones((n,), np.bool_)
+
+    def __repr__(self):
+        return f"map({', '.join(map(repr, self.children))})"
+
+
+def _entry_rows(col: DeviceColumn):
+    """row index of every entry slot ([entry_capacity] int32)."""
+    ecap = col.byte_capacity
+    epos = jnp.arange(ecap, dtype=jnp.int32)
+    row = jnp.searchsorted(col.offsets, epos,
+                           side="right").astype(jnp.int32) - 1
+    return jnp.clip(row, 0, col.capacity - 1), epos
+
+
+class GetMapValue(BinaryExpression):
+    """map[key] / element_at(map, key) — complexTypeExtractors.scala
+    GpuGetMapValue.  First matching entry's value; null when the map is
+    null, the key is null, or no entry matches."""
+
+    @property
+    def dtype(self):
+        mt = self.left.dtype
+        assert isinstance(mt, T.MapType), mt
+        return mt.value_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        mcol = self.left.eval(ctx)
+        kcol = self.right.eval(ctx)
+        keys, values = mcol.children
+        ecap = mcol.byte_capacity
+        row, epos = _entry_rows(mcol)
+        want_d = kcol.data[row]
+        want_v = kcol.validity[row]
+        end = mcol.offsets[mcol.capacity]
+        live_e = epos < end
+        match = (live_e & keys.validity & want_v
+                 & (keys.data == want_d))
+        first = jax.ops.segment_min(
+            jnp.where(match, epos, jnp.int32(ecap)), row,
+            num_segments=mcol.capacity)
+        found = first < ecap
+        safe = jnp.clip(first, 0, max(ecap - 1, 0))
+        valid = (mcol.validity & kcol.validity & found
+                 & values.validity[safe])
+        data = _zero_invalid(values.data[safe], valid)
+        return DeviceColumn(data, valid, self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        mv, mm = self.left.eval_cpu(ctx)
+        kv, km = self.right.eval_cpu(ctx)
+        n = len(mv)
+        dt = self.dtype
+        valid = np.zeros((n,), np.bool_)
+        out = np.zeros((n,), dt.np_dtype)
+        for i in range(n):
+            if not (mm[i] and km[i]) or mv[i] is None:
+                continue
+            k = kv[i].item() if hasattr(kv[i], "item") else kv[i]
+            if k in mv[i] and mv[i][k] is not None:
+                out[i] = mv[i][k]
+                valid[i] = True
+        return out, valid
+
+    def __repr__(self):
+        return f"{self.left!r}[{self.right!r}]"
+
+
+class _MapProject(UnaryExpression):
+    """Shared shape of map_keys/map_values: the entry child re-exposed as
+    an array column over the same offsets."""
+
+    CHILD_INDEX = 0
+
+    @property
+    def dtype(self):
+        mt = self.child.dtype
+        assert isinstance(mt, T.MapType), mt
+        et = mt.key_type if self.CHILD_INDEX == 0 else mt.value_type
+        return T.ArrayType(et, contains_null=self.CHILD_INDEX == 1)
+
+    @property
+    def nullable(self):
+        return self.child.nullable
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        mcol = self.child.eval(ctx)
+        kid = mcol.children[self.CHILD_INDEX]
+        return DeviceColumn(kid.data, mcol.validity, self.dtype,
+                            mcol.offsets, kid.validity)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        mv, mm = self.child.eval_cpu(ctx)
+        n = len(mv)
+        out = np.empty((n,), dtype=object)
+        for i in range(n):
+            if not mm[i] or mv[i] is None:
+                out[i] = None
+            elif self.CHILD_INDEX == 0:
+                out[i] = list(mv[i].keys())
+            else:
+                out[i] = list(mv[i].values())
+        return out, mm.copy()
+
+
+class MapKeys(_MapProject):
+    CHILD_INDEX = 0
+
+    def __repr__(self):
+        return f"map_keys({self.child!r})"
+
+
+class MapValues(_MapProject):
+    CHILD_INDEX = 1
+
+    def __repr__(self):
+        return f"map_values({self.child!r})"
+
+
+def named_struct(*args):
+    """named_struct('a', col('x'), 'b', col('y')) DSL helper."""
+    from spark_rapids_tpu.expressions.core import col as _col
+    assert len(args) % 2 == 0
+    names = [args[2 * i] for i in range(len(args) // 2)]
+    exprs = [args[2 * i + 1] for i in range(len(args) // 2)]
+    exprs = [_col(e) if isinstance(e, str) else e for e in exprs]
+    return CreateNamedStruct(names, exprs)
+
+
+def struct_field(e, name):
+    from spark_rapids_tpu.expressions.core import col as _col
+    return GetStructField(_col(e) if isinstance(e, str) else e, name)
+
+
+def create_map(*args):
+    from spark_rapids_tpu.expressions.core import col as _col
+    return CreateMap(tuple(_col(e) if isinstance(e, str) else e
+                           for e in args))
+
+
+def map_keys(e):
+    from spark_rapids_tpu.expressions.core import col as _col
+    return MapKeys(_col(e) if isinstance(e, str) else e)
+
+
+def map_values(e):
+    from spark_rapids_tpu.expressions.core import col as _col
+    return MapValues(_col(e) if isinstance(e, str) else e)
+
+
+def map_value(m, k):
+    from spark_rapids_tpu.expressions.core import Literal
+    from spark_rapids_tpu.expressions.core import col as _col
+    if not isinstance(k, Expression):
+        k = Literal(k)
+    return GetMapValue(_col(m) if isinstance(m, str) else m, k)

@@ -1,0 +1,308 @@
+"""Two-limb int128 kernels for Decimal(19..38) — the DECIMAL_128 path.
+
+Reference: the reference leans on cuDF's native DECIMAL128 columns
+(decimalExpressions.scala:40 GpuDecimalType use, GpuCast.scala:1650 decimal
+cast paths).  TPU has no 128-bit integer dtype, so a decimal128 column is
+two int64 limb planes:
+
+    hi: int64[cap]   signed high limb
+    lo: int64[cap]   raw low 64 bits (interpreted unsigned)
+
+carried as `children` of the DeviceColumn (the struct machinery moves,
+spills, serializes and shuffles them for free).  All arithmetic here is
+elementwise VPU work over the limb planes; sums use 32-bit limb splitting
+so `jax.ops.segment_sum` accumulates exactly (192-bit wide) before carry
+propagation.
+
+Overflow semantics are Spark non-ANSI: a result beyond the target precision
+becomes NULL (the caller folds `overflow(...)` into validity).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U64 = jnp.uint64
+I64 = jnp.int64
+_MASK32 = np.uint64(0xFFFFFFFF)
+
+
+def const128(value: int) -> Tuple[np.int64, np.int64]:
+    """Python int -> (hi, lo) two's-complement limbs."""
+    v = value & ((1 << 128) - 1)
+    lo = v & ((1 << 64) - 1)
+    hi = v >> 64
+    if hi >= (1 << 63):
+        hi -= 1 << 64
+    if lo >= (1 << 63):
+        lo -= 1 << 64
+    return np.int64(hi), np.int64(lo)
+
+
+def to_python(hi, lo) -> int:
+    """(hi, lo) scalars -> python int (host-side, tests/oracle)."""
+    h = int(np.int64(hi))
+    l = int(np.uint64(np.int64(lo)))
+    return (h << 64) | l
+
+
+def widen64(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """int64 -> int128 (sign extension)."""
+    x = x.astype(I64)
+    return x >> jnp.int64(63), x
+
+
+def narrow64(hi: jax.Array, lo: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """int128 -> int64 + fits-flag (value representable in one limb)."""
+    fits = hi == (lo >> jnp.int64(63))
+    return lo, fits
+
+
+def add128(ah, al, bh, bl):
+    lo = (al.astype(U64) + bl.astype(U64))
+    carry = (lo < al.astype(U64)).astype(I64)
+    hi = ah + bh + carry
+    return hi, lo.astype(I64)
+
+
+def neg128(h, l):
+    nl = (~l.astype(U64)) + U64(1)
+    nh = ~h + jnp.where(nl == 0, jnp.int64(1), jnp.int64(0))
+    return nh, nl.astype(I64)
+
+
+def sub128(ah, al, bh, bl):
+    nh, nl = neg128(bh, bl)
+    return add128(ah, al, nh, nl)
+
+
+def is_neg(hi) -> jax.Array:
+    return hi < 0
+
+
+def abs128(h, l):
+    nh, nl = neg128(h, l)
+    neg = is_neg(h)
+    return jnp.where(neg, nh, h), jnp.where(neg, nl, l)
+
+
+def eq128(ah, al, bh, bl):
+    return (ah == bh) & (al == bl)
+
+
+def lt128(ah, al, bh, bl):
+    """signed int128 less-than."""
+    return (ah < bh) | ((ah == bh) & (al.astype(U64) < bl.astype(U64)))
+
+
+def _mul_u64(a: jax.Array, b: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """unsigned 64x64 -> (hi, lo) 128-bit product via 32-bit halves."""
+    a = a.astype(U64)
+    b = b.astype(U64)
+    a0 = a & _MASK32
+    a1 = a >> U64(32)
+    b0 = b & _MASK32
+    b1 = b >> U64(32)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> U64(32)) + (p01 & _MASK32) + (p10 & _MASK32)
+    lo = (p00 & _MASK32) | (mid << U64(32))
+    hi = p11 + (p01 >> U64(32)) + (p10 >> U64(32)) + (mid >> U64(32))
+    return hi.astype(I64), lo.astype(I64)
+
+
+def mul128(ah, al, bh, bl):
+    """int128 x int128 -> int128 (mod 2^128; callers bound magnitudes via
+    precision rules so the true product fits when inputs are in range)."""
+    hi, lo = _mul_u64(al, bl)
+    hi = (hi.astype(U64)
+          + al.astype(U64) * bh.astype(U64)
+          + ah.astype(U64) * bl.astype(U64)).astype(I64)
+    return hi, lo
+
+
+def mul128_by_small(h, l, m: int):
+    """int128 * non-negative python int (fits u64)."""
+    mh, ml = widen64(jnp.full_like(h, np.int64(m)))
+    return mul128(h, l, mh, ml)
+
+
+# 10^k constants
+POW10 = [10 ** k for k in range(39)]
+
+
+def overflow(hi, lo, precision: int) -> jax.Array:
+    """|v| >= 10^precision (Spark overflow -> null for non-ANSI)."""
+    bh, bl = const128(POW10[precision])
+    ah, al = abs128(hi, lo)
+    # careful: abs(-2^127) wraps negative; treat top-bit-set abs as overflow
+    wrapped = is_neg(ah)
+    return wrapped | ~lt128(ah, al, jnp.full_like(ah, bh),
+                            jnp.full_like(al, bl))
+
+
+def _divmod_small(h, l, d: int):
+    """unsigned int128 // small positive divisor (< 2^31), via four 32-bit
+    long-division steps.  Inputs interpreted UNSIGNED."""
+    d64 = U64(d)
+    w3 = (h.astype(U64) >> U64(32))
+    w2 = (h.astype(U64) & _MASK32)
+    w1 = (l.astype(U64) >> U64(32))
+    w0 = (l.astype(U64) & _MASK32)
+    q3 = w3 // d64
+    r = w3 % d64
+    acc = (r << U64(32)) | w2
+    q2 = acc // d64
+    r = acc % d64
+    acc = (r << U64(32)) | w1
+    q1 = acc // d64
+    r = acc % d64
+    acc = (r << U64(32)) | w0
+    q0 = acc // d64
+    r = acc % d64
+    qh = ((q3 << U64(32)) | q2).astype(I64)
+    ql = ((q1 << U64(32)) | q0).astype(I64)
+    return qh, ql, r
+
+
+def div128_small(h, l, d: int, round_half_up: bool = True):
+    """signed int128 / small positive int with HALF_UP rounding (Spark
+    Decimal.toPrecision ROUND_HALF_UP).  d < 2^31."""
+    ah, al = abs128(h, l)
+    qh, ql, r = _divmod_small(ah, al, d)
+    if round_half_up:
+        bump = (r * U64(2) >= U64(d))
+        qh, ql = add128(qh, ql, jnp.zeros_like(qh),
+                        bump.astype(I64))
+    neg = is_neg(h)
+    nh, nl = neg128(qh, ql)
+    return jnp.where(neg, nh, qh), jnp.where(neg, nl, ql)
+
+
+def rescale(hi, lo, from_scale: int, to_scale: int):
+    """Multiply/divide by 10^k to change scale (HALF_UP on scale-down)."""
+    k = to_scale - from_scale
+    if k == 0:
+        return hi, lo
+    if k > 0:
+        while k > 0:
+            step = min(k, 18)
+            hi, lo = mul128_by_small(hi, lo, POW10[step])
+            k -= step
+        return hi, lo
+    k = -k
+    # divide by <= 10^9 per step; HALF_UP only on the LAST step (matching
+    # BigDecimal.setScale's single rounding)
+    while k > 9:
+        hi, lo = div128_small(hi, lo, POW10[9], round_half_up=False)
+        k -= 9
+    return div128_small(hi, lo, POW10[k], round_half_up=True)
+
+
+def to_double(hi, lo) -> jax.Array:
+    """int128 -> float64 (|v| < 10^38 so well within double range)."""
+    neg = is_neg(hi)
+    ah, al = abs128(hi, lo)
+    f = (ah.astype(U64).astype(jnp.float64) * jnp.float64(2.0 ** 64)
+         + al.astype(U64).astype(jnp.float64))
+    return jnp.where(neg, -f, f)
+
+
+def limbs_of(col, dt) -> Tuple[jax.Array, jax.Array]:
+    """(hi, lo) limb planes of a decimal DeviceColumn (widening decimal64)."""
+    if dt.uses_two_limbs:
+        return col.children[0].data, col.children[1].data
+    return widen64(col.data)
+
+
+def make_column128(hi, lo, validity, dtype):
+    """Canonical two-limb decimal DeviceColumn (invalid slots zeroed)."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    hi = jnp.where(validity, hi, jnp.int64(0))
+    lo = jnp.where(validity, lo, jnp.int64(0))
+    kids = (DeviceColumn(hi, validity, T.LONG),
+            DeviceColumn(lo, validity, T.LONG))
+    return DeviceColumn(jnp.zeros(hi.shape, jnp.int8), validity, dtype,
+                        children=kids)
+
+
+def mul128_checked(ah, al, bh, bl):
+    """int128 x int128 -> (hi, lo, overflowed): full product with exact
+    128-bit overflow detection (via the 256-bit magnitude product)."""
+    neg = is_neg(ah) ^ is_neg(bh)
+    mh, ml = abs128(ah, al)
+    nh, nl = abs128(bh, bl)
+    p0h, p0l = _mul_u64(ml, nl)
+    p1h, p1l = _mul_u64(ml, nh)
+    p2h, p2l = _mul_u64(mh, nl)
+    p3h, p3l = _mul_u64(mh, nh)
+    s1 = (p0h.astype(U64) + p1l.astype(U64))
+    c1 = s1 < p0h.astype(U64)
+    s2 = s1 + p2l.astype(U64)
+    c2 = s2 < s1
+    hi = s2.astype(I64)
+    lo = p0l
+    carry_out = c1.astype(I64) + c2.astype(I64)
+    over = ((p1h != 0) | (p2h != 0) | (p3h != 0) | (p3l != 0)
+            | (carry_out != 0)
+            | is_neg(hi))        # magnitude >= 2^127 (10^38 < 2^127)
+    rh, rl = neg128(hi, lo)
+    return (jnp.where(neg, rh, hi), jnp.where(neg, rl, lo), over)
+
+
+# -- exact segmented SUM over 32-bit limb planes -----------------------------
+
+def _split_limbs32(hi, lo):
+    """int128 -> six sign-extended 32-bit limbs as int64 planes (192-bit),
+    so per-limb segment sums of up to 2^31 rows never overflow int64."""
+    sign = (hi >> jnp.int64(63))          # 0 or -1
+    w0 = (lo.astype(U64) & _MASK32).astype(I64)
+    w1 = (lo.astype(U64) >> U64(32)).astype(I64)
+    w2 = (hi.astype(U64) & _MASK32).astype(I64)
+    w3 = (hi.astype(U64) >> U64(32)).astype(I64)
+    s32 = (sign.astype(U64) & _MASK32).astype(I64)
+    return [w0, w1, w2, w3, s32, s32]
+
+
+def _carry_join(limbs):
+    """Carry-propagate six int64 limb sums back into (hi, lo) mod 2^128
+    plus an exact-overflow flag vs int128 range."""
+    out = []
+    carry = jnp.zeros_like(limbs[0])
+    for w in limbs:
+        t = w + carry
+        out.append((t.astype(U64) & _MASK32).astype(I64))
+        carry = t >> jnp.int64(32)     # arithmetic shift: signed carries
+    lo = (out[0].astype(U64) | (out[1].astype(U64) << U64(32))).astype(I64)
+    hi = (out[2].astype(U64) | (out[3].astype(U64) << U64(32))).astype(I64)
+    # exact value sign lives in limbs 4..5 (+ final carry); int128-exact iff
+    # those top 64 bits are pure sign extension of hi
+    top = (out[4].astype(U64) | (out[5].astype(U64) << U64(32))).astype(I64)
+    sign_ok = top == (hi >> jnp.int64(63))
+    return hi, lo, ~sign_ok
+
+
+def segment_sum128(hi, lo, weights, segment_ids, num_segments: int):
+    """Exact per-segment sum of int128 values (weights: int32/bool mask
+    applied multiplicatively, e.g. live&valid).  Returns (hi, lo,
+    overflowed_int128) per segment."""
+    w = weights.astype(I64)
+    sums = [jax.ops.segment_sum(limb * w, segment_ids,
+                                num_segments=num_segments)
+            for limb in _split_limbs32(hi, lo)]
+    return _carry_join(sums)
+
+
+def sum128(hi, lo, weights) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Whole-array exact sum -> scalar (hi, lo, overflowed)."""
+    w = weights.astype(I64)
+    sums = [jnp.sum(limb * w, keepdims=True)
+            for limb in _split_limbs32(hi, lo)]
+    h, l, ov = _carry_join(sums)
+    return h[0], l[0], ov[0]

@@ -32,6 +32,10 @@ from spark_rapids_tpu.kernels.sort import SortOrder, sort_indices
 
 def normalize_key_column(col: DeviceColumn) -> DeviceColumn:
     """Normalize float keys so bit-compare == Spark group equality."""
+    if col.is_struct:
+        return DeviceColumn(col.data, col.validity, col.dtype,
+                            children=tuple(normalize_key_column(c)
+                                           for c in col.children))
     if isinstance(col.dtype, (T.FloatType, T.DoubleType)):
         d = col.data
         d = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)      # -0.0 -> 0.0
@@ -45,6 +49,15 @@ def _rows_equal_prev(col: DeviceColumn) -> jax.Array:
     Relies on canonical padding (null data slots are zero) and on float keys
     being normalized, so a bit/data comparison is exact."""
     assert not col.is_string_like, "use _string_rows_equal_prev"
+    if col.is_struct:
+        # struct equality: same presence, and (both null OR all fields
+        # equal) — nested nulls compare equal, like Spark grouping
+        same_null = col.validity == jnp.roll(col.validity, 1)
+        both_valid = col.validity & jnp.roll(col.validity, 1)
+        kid_eq = jnp.ones_like(col.validity)
+        for c in col.children:
+            kid_eq = kid_eq & _rows_equal_prev(c)
+        return same_null & (kid_eq | ~both_valid)
     if isinstance(col.dtype, (T.FloatType, T.DoubleType)):
         w = jnp.uint64 if col.data.dtype == jnp.float64 else jnp.uint32
         bits = jax.lax.bitcast_convert_type(col.data, w)

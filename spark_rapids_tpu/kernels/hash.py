@@ -115,9 +115,67 @@ def hash_fixed_width(col: DeviceColumn, seeds: jax.Array) -> jax.Array:
         # Spark hashes small decimals as their unscaled long
         v = col.data.astype(jnp.uint64)
         h = _hash_long(v, seeds)
+    elif isinstance(dt, T.DecimalType):
+        # precision > 18: Spark hashes BigInteger.toByteArray() — the
+        # big-endian MINIMAL two's-complement byte string — via
+        # hashUnsafeBytes
+        h = _hash_decimal128_bytes(col, seeds)
+    elif isinstance(dt, T.StructType):
+        # Spark's HashExpression on structs: fields chained in order into
+        # the running hash (null fields pass the seed; a null struct
+        # passes it whole)
+        h = seeds
+        for c in col.children:
+            h = hash_fixed_width(c, h)
     else:
         raise NotImplementedError(f"murmur3 for {dt!r}")
     return jnp.where(col.validity, h, seeds)
+
+
+def _hash_decimal128_bytes(col: DeviceColumn, seeds: jax.Array) -> jax.Array:
+    """Murmur3 hashUnsafeBytes over the minimal big-endian two's-complement
+    byte form of a two-limb decimal (Java BigInteger.toByteArray)."""
+    hi = col.children[0].data
+    lo = col.children[1].data
+    u_hi = hi.astype(jnp.uint64)
+    u_lo = lo.astype(jnp.int64).astype(jnp.uint64)
+    planes = []
+    for j in range(8):
+        planes.append((u_hi >> jnp.uint64(8 * (7 - j))) & jnp.uint64(0xFF))
+    for j in range(8):
+        planes.append((u_lo >> jnp.uint64(8 * (7 - j))) & jnp.uint64(0xFF))
+    be = jnp.stack(planes, axis=1).astype(jnp.uint8)     # [cap, 16] BE
+    neg = (hi < 0)[:, None]
+    top = (be & jnp.uint8(0x80)) != 0                    # [cap, 16]
+    fill = jnp.where(neg, jnp.uint8(0xFF), jnp.uint8(0))
+    red = (be[:, :15] == fill) & (top[:, 1:] == neg)     # [cap, 15]
+    run = jnp.cumprod(red.astype(jnp.int32), axis=1)
+    strip = jnp.sum(run, axis=1).astype(jnp.int32)       # leading redundant
+    L = 16 - strip                                       # >= 1
+    pos = jnp.arange(16, dtype=jnp.int32)[None, :]
+    src = jnp.clip(strip[:, None] + pos, 0, 15)
+    tile = jnp.where(pos < L[:, None],
+                     jnp.take_along_axis(be, src, axis=1), jnp.uint8(0))
+    words = (
+        tile[:, 0::4].astype(jnp.uint32)
+        | (tile[:, 1::4].astype(jnp.uint32) << 8)
+        | (tile[:, 2::4].astype(jnp.uint32) << 16)
+        | (tile[:, 3::4].astype(jnp.uint32) << 24)
+    )
+    aligned_words = L // 4
+    h1 = seeds
+    for i in range(4):
+        mixed = _mix_h1(h1, words[:, i])
+        h1 = jnp.where(i < aligned_words, mixed, h1)
+    cap = hi.shape[0]
+    rows = jnp.arange(cap)
+    for i in range(16):
+        b = tile[rows, jnp.minimum(i, 15)]
+        sb = b.astype(jnp.int8).astype(jnp.int32).astype(jnp.uint32)
+        mixed = _mix_h1(h1, sb)
+        in_tail = (i >= aligned_words * 4) & (i < L)
+        h1 = jnp.where(in_tail, mixed, h1)
+    return _fmix(h1, L.astype(jnp.uint32))
 
 
 def hash_string(col: DeviceColumn, seeds: jax.Array, max_bytes: int) -> jax.Array:
@@ -283,6 +341,15 @@ def py_murmur3_row(values, dtypes, seed: int = DEFAULT_SEED) -> int:
             h = py_hash_bytes(v.encode("utf-8") if isinstance(v, str) else v, h)
         elif isinstance(dt, T.DecimalType) and not dt.uses_two_limbs:
             h = py_hash_long(int(v), h)
+        elif isinstance(dt, T.DecimalType):
+            # minimal big-endian two's complement (BigInteger.toByteArray)
+            n = max((int(v).bit_length() // 8) + 1, 1)
+            h = py_hash_bytes(int(v).to_bytes(n, "big", signed=True), h)
+        elif isinstance(dt, T.StructType):
+            h = py_murmur3_row(
+                [None] * len(dt.fields) if v is None else list(v),
+                [f.dtype for f in dt.fields], h)
+            h &= 0xFFFFFFFF
         else:
             raise NotImplementedError(f"py murmur3 for {dt!r}")
     res = h & 0xFFFFFFFF

@@ -235,8 +235,10 @@ def join_gather_maps(
 
     if (join_type in ("inner", "left", "left_semi", "left_anti")
             and len(left_keys) == 1
-            and not left.columns[left_keys[0]].is_string_like
-            and not right.columns[right_keys[0]].is_string_like):
+            and left.columns[left_keys[0]].offsets is None
+            and left.columns[left_keys[0]].children is None
+            and right.columns[right_keys[0]].offsets is None
+            and right.columns[right_keys[0]].children is None):
         # single fixed-width key: probe the sorted build side by binary
         # search — O((L+R) log R) instead of a full lexsort of L+R rows.
         # The shape XLA/TPU likes for broadcast joins: one small sort, two
@@ -271,6 +273,24 @@ def join_gather_maps(
     for lk, rk in zip(left_keys, right_keys):
         lc = normalize_key_column(left.columns[lk])
         rc = normalize_key_column(right.columns[rk])
+        if lc.is_struct:
+            # struct keys: flattened leaf keys per side, concatenated.
+            # Only the TOP-level null disqualifies a row (nested nulls
+            # compare equal in Spark equi-joins, GpuHashJoin's
+            # compareNullsEqual for struct children).  Two-limb decimals
+            # ride the same path with int128 order keys.
+            from spark_rapids_tpu.kernels.sort import (
+                _decimal128_data_keys, _struct_data_keys)
+            flatten = (_decimal128_data_keys
+                       if isinstance(lc.dtype, T.DecimalType)
+                       else _struct_data_keys)
+            lchunks = flatten(lc, _ASC)
+            rchunks = flatten(rc, _ASC)
+            for lch, rch in zip(lchunks, rchunks):
+                per_col_keys.append(jnp.concatenate([lch, rch]))
+            valid = jnp.concatenate([lc.validity, rc.validity])
+            any_null = any_null | ~valid
+            continue
         if lc.is_string_like:
             # string keys: compare via the sort kernel's packed byte-chunk
             # keys, computed per side at a shared bucket then concatenated —
@@ -422,7 +442,10 @@ def apply_gather_maps(
     out_idx = 0
     for side_batch, idx in sides:
         for c in side_batch.columns:
-            if c.is_string_like:
+            if c.offsets is not None:
+                # any segmented payload (string bytes / array elems / map
+                # entries) can exceed its static capacity under repeated
+                # gather indices — track the true requirement for retry
                 bcap = byte_capacities.get(out_idx, c.byte_capacity)
                 cols.append(gather_column(c, idx, count,
                                           out_capacity=out_capacity,

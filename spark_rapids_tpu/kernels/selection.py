@@ -287,9 +287,10 @@ def concat_batches_device(
         validity = jnp.where(live, stacked_val[which, within], False)
 
         if cols[0].is_struct:
+            from spark_rapids_tpu import types as T
             kids = tuple(
-                concat_cols([c.children[fi] for c in cols], f.dtype)
-                for fi, f in enumerate(dtype.fields))
+                concat_cols([c.children[fi] for c in cols], fdt)
+                for fi, fdt in enumerate(T.child_dtypes(dtype)))
             return DeviceColumn(jnp.zeros((out_capacity,), jnp.int8),
                                 validity, dtype, children=kids)
 

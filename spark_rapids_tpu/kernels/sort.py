@@ -81,6 +81,40 @@ def _null_key(col: DeviceColumn, order: SortOrder) -> jax.Array:
     return jnp.where(col.validity, jnp.uint8(0), jnp.uint8(1))
 
 
+def _decimal128_data_keys(col: DeviceColumn,
+                          order: SortOrder) -> List[jax.Array]:
+    """Two-limb decimal order keys: signed hi limb then unsigned lo limb
+    (int128 comparison order), most significant first."""
+    hi, lo = col.children
+    k_hi = _signed_to_unsigned(hi.data)
+    k_lo = lo.data.astype(jnp.int64).astype(jnp.uint64)
+    if not order.ascending:
+        k_hi = ~k_hi
+        k_lo = ~k_lo
+    return [jnp.where(col.validity, k, jnp.uint64(0))
+            for k in (k_hi, k_lo)]
+
+
+def _struct_data_keys(col: DeviceColumn, order: SortOrder) -> List[jax.Array]:
+    """Flatten a struct key column into uint64 leaf keys, most significant
+    first: per field a null-flag key (null field sorts smallest ascending,
+    flipped with the direction like Spark's struct comparator) then the
+    field's data key.  Keys are masked to zero on null STRUCT rows so the
+    lexsort stays stable among them (the struct's own null key has already
+    grouped those rows)."""
+    keys: List[jax.Array] = []
+    for i, f in enumerate(col.dtype.fields):
+        fc = col.children[i]
+        flag = DeviceColumn(fc.validity, jnp.ones_like(col.validity),
+                            T.BOOLEAN)
+        keys.append(_data_key_fixed(flag, order))
+        if fc.is_struct:
+            keys.extend(_struct_data_keys(fc, order))
+        else:
+            keys.append(_data_key_fixed(fc, order))
+    return [jnp.where(col.validity, k, jnp.uint64(0)) for k in keys]
+
+
 BYTES_PER_CHUNK = 7  # 9-bit lanes (byte value + 1; 0 = past end) in a uint64
 
 
@@ -127,6 +161,12 @@ def sort_indices(
         if col.is_string_like:
             for chunk in reversed(_string_data_keys(col, order, string_max_bytes)):
                 keys.append(chunk)
+        elif col.is_struct and isinstance(col.dtype, T.DecimalType):
+            for k in reversed(_decimal128_data_keys(col, order)):
+                keys.append(k)
+        elif col.is_struct:
+            for k in reversed(_struct_data_keys(col, order)):
+                keys.append(k)
         else:
             keys.append(_data_key_fixed(col, order))
         keys.append(_null_key(col, order))

@@ -1,0 +1,133 @@
+"""Timezone conversion kernels: host tz database + device transition lookup.
+
+Reference: org/apache/spark/sql/rapids/TimeZoneDB.scala:27 (the reference
+loads each zone's transition rules to the GPU and converts by binary search;
+cache init at Plugin.scala:651).  The TPU analog: Python's zoneinfo supplies
+the IANA rules on host, each zone compiles once into two device arrays
+(transition instants + UTC offsets), and conversion is one vectorized
+`searchsorted` per batch — no per-row host work.
+
+Semantics match java.time (what Spark uses):
+  * utc -> local: offset of the transition interval containing the instant;
+  * local -> utc: for ambiguous wall times (DST fall-back overlap) the
+    EARLIER offset wins (LocalDateTime.atZone default); for skipped wall
+    times (spring-forward gap) the result shifts forward by the gap.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MICROS_PER_SECOND = 1_000_000
+_MIN_YEAR, _MAX_YEAR = 1900, 2100
+
+
+@functools.lru_cache(maxsize=None)
+def zone_table(tz_name: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(transitions_utc_seconds int64[n], offsets_seconds int32[n]).
+
+    offsets[i] applies to instants in [transitions[i], transitions[i+1]).
+    transitions[0] is a far-past sentinel so searchsorted never underflows.
+    Rules are sampled from zoneinfo over 1900..2100 (Spark's own rebase
+    horizon); fixed-offset zones yield a single interval."""
+    import datetime as dtmod
+    from zoneinfo import ZoneInfo
+
+    tz = ZoneInfo(tz_name)
+    utc = dtmod.timezone.utc
+    transitions = [np.iinfo(np.int64).min // 2]
+    probe = dtmod.datetime(_MIN_YEAR, 1, 1, tzinfo=utc)
+    offsets = [int(probe.astimezone(tz).utcoffset().total_seconds())]
+
+    # walk utc time, bisecting every offset change to the exact second
+    step = dtmod.timedelta(days=14)
+    t = probe
+    end = dtmod.datetime(_MAX_YEAR, 1, 1, tzinfo=utc)
+    cur = offsets[0]
+    while t < end:
+        nxt = min(t + step, end)
+        off = int(nxt.astimezone(tz).utcoffset().total_seconds())
+        if off != cur:
+            lo, hi = t, nxt
+            while hi - lo > dtmod.timedelta(seconds=1):
+                mid = lo + (hi - lo) / 2
+                mid = mid.replace(microsecond=0)
+                if mid <= lo:
+                    break
+                if int(mid.astimezone(tz).utcoffset()
+                       .total_seconds()) == cur:
+                    lo = mid
+                else:
+                    hi = mid
+            transitions.append(int(hi.timestamp()))
+            offsets.append(off)
+            cur = off
+        t = nxt
+    return (np.asarray(transitions, np.int64),
+            np.asarray(offsets, np.int32))
+
+
+def utc_to_local_micros(ts_micros: jax.Array, transitions: jax.Array,
+                        offsets: jax.Array) -> jax.Array:
+    """Shift UTC epoch-micros so civil-field math reads wall-clock time."""
+    secs = jnp.floor_divide(ts_micros, MICROS_PER_SECOND)
+    idx = jnp.clip(
+        jnp.searchsorted(transitions, secs, side="right") - 1,
+        0, transitions.shape[0] - 1)
+    return ts_micros + offsets[idx].astype(jnp.int64) * MICROS_PER_SECOND
+
+
+def local_to_utc_micros(local_micros: jax.Array, transitions: jax.Array,
+                        offsets: jax.Array) -> jax.Array:
+    """Inverse shift with java.time gap/overlap rules (module docstring)."""
+    n = transitions.shape[0]
+    prev_off = jnp.concatenate([offsets[:1], offsets[:-1]])
+    # local wall clock at which the PREVIOUS offset stops applying
+    wall_old_end = transitions + prev_off.astype(jnp.int64)
+    secs = jnp.floor_divide(local_micros, MICROS_PER_SECOND)
+    idx = jnp.clip(jnp.searchsorted(wall_old_end, secs, side="right") - 1,
+                   0, n - 1)
+    utc = local_micros - offsets[idx].astype(jnp.int64) * MICROS_PER_SECOND
+    # gap detection: the chosen interval cannot start before its own
+    # transition; fall back to the previous offset (shift-forward rule)
+    in_gap = jnp.floor_divide(utc, MICROS_PER_SECOND) < transitions[idx]
+    utc_gap = local_micros - prev_off[idx].astype(jnp.int64) * MICROS_PER_SECOND
+    return jnp.where(in_gap, utc_gap, utc)
+
+
+# -- per-row datetime oracle twins (independent implementation: zoneinfo's
+#    own PEP-495 resolution, so the differential test checks the device
+#    transition-table math against the library's answer) ---------------------
+
+def np_utc_to_local(ts_micros: np.ndarray, tz_name: str) -> np.ndarray:
+    import datetime as dtmod
+    from zoneinfo import ZoneInfo
+    tz = ZoneInfo(tz_name)
+    utc = dtmod.timezone.utc
+    out = np.empty(ts_micros.shape, np.int64)
+    for i, t in enumerate(ts_micros):
+        secs = int(t) // MICROS_PER_SECOND
+        dt = dtmod.datetime.fromtimestamp(secs, utc)
+        off = int(dt.astimezone(tz).utcoffset().total_seconds())
+        out[i] = int(t) + off * MICROS_PER_SECOND
+    return out
+
+
+def np_local_to_utc(local_micros: np.ndarray, tz_name: str) -> np.ndarray:
+    import datetime as dtmod
+    from zoneinfo import ZoneInfo
+    tz = ZoneInfo(tz_name)
+    epoch = dtmod.datetime(1970, 1, 1)
+    out = np.empty(local_micros.shape, np.int64)
+    for i, t in enumerate(local_micros):
+        secs, rem = divmod(int(t), MICROS_PER_SECOND)
+        naive = epoch + dtmod.timedelta(seconds=secs)
+        # fold=0: earlier offset for overlaps, pre-gap offset for gaps
+        # (PEP 495 == java.time LocalDateTime.atZone defaults)
+        dt = naive.replace(tzinfo=tz)
+        out[i] = int(dt.timestamp()) * MICROS_PER_SECOND + rem
+    return out

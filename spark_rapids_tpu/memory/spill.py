@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.memory.arena import device_arena
@@ -46,27 +47,47 @@ def _batch_to_host(batch: ColumnarBatch) -> Tuple[dict, Schema]:
     silently pin the jax buffer alive (spill would free nothing, and the
     arena release would under-count residency)."""
     arrays = {}
-    for i, col in enumerate(batch.columns):
-        arrays[f"data_{i}"] = np.array(col.data, copy=True)
-        arrays[f"valid_{i}"] = np.array(col.validity, copy=True)
+
+    def dump_col(col, prefix: str) -> None:
+        arrays[f"{prefix}data"] = np.array(col.data, copy=True)
+        arrays[f"{prefix}valid"] = np.array(col.validity, copy=True)
         if col.offsets is not None:
-            arrays[f"offsets_{i}"] = np.array(col.offsets, copy=True)
+            arrays[f"{prefix}offsets"] = np.array(col.offsets, copy=True)
         if col.child_validity is not None:
-            arrays[f"cvalid_{i}"] = np.array(col.child_validity, copy=True)
+            arrays[f"{prefix}cvalid"] = np.array(col.child_validity,
+                                                 copy=True)
+        if col.children is not None:
+            for k, kid in enumerate(col.children):
+                dump_col(kid, f"{prefix}c{k}_")
+
+    for i, col in enumerate(batch.columns):
+        dump_col(col, f"col{i}_")
     arrays["num_rows"] = np.array(batch.num_rows, copy=True)
     return arrays, batch.schema
 
 
+_child_dtypes = T.child_dtypes
+
+
 def _host_to_batch(arrays: dict, schema: Schema) -> ColumnarBatch:
-    cols = []
-    for i, dtype in enumerate(schema.dtypes):
-        cols.append(DeviceColumn(
-            data=jnp.asarray(arrays[f"data_{i}"]),
-            validity=jnp.asarray(arrays[f"valid_{i}"]),
+    def load_col(dtype, prefix: str) -> DeviceColumn:
+        kid_types = _child_dtypes(dtype)
+        kids = (tuple(load_col(kt, f"{prefix}c{k}_")
+                      for k, kt in enumerate(kid_types))
+                if kid_types is not None else None)
+        return DeviceColumn(
+            data=jnp.asarray(arrays[f"{prefix}data"]),
+            validity=jnp.asarray(arrays[f"{prefix}valid"]),
             dtype=dtype,
-            offsets=jnp.asarray(arrays[f"offsets_{i}"]) if f"offsets_{i}" in arrays else None,
-            child_validity=jnp.asarray(arrays[f"cvalid_{i}"]) if f"cvalid_{i}" in arrays else None,
-        ))
+            offsets=(jnp.asarray(arrays[f"{prefix}offsets"])
+                     if f"{prefix}offsets" in arrays else None),
+            child_validity=(jnp.asarray(arrays[f"{prefix}cvalid"])
+                            if f"{prefix}cvalid" in arrays else None),
+            children=kids,
+        )
+
+    cols = [load_col(dtype, f"col{i}_")
+            for i, dtype in enumerate(schema.dtypes)]
     return ColumnarBatch(tuple(cols), jnp.asarray(arrays["num_rows"], dtype=jnp.int32), schema)
 
 

@@ -102,23 +102,34 @@ def exchange_shard_step(
     send_overflow = jnp.max(counts)          # caller checks > row_quota
     max_byte_need = jnp.int32(0)
 
-    out_cols: List[DeviceColumn] = []
-    for col in reordered.columns:
-        if not col.is_string_like:
-            bucket = col.data[row_idx]                       # [P, Q]
-            bvalid = col.validity[row_idx] & in_bucket
-            rbucket = _a2a(bucket, axis_name)
-            rvalid = _a2a(bvalid, axis_name)
-            data = rbucket[j, i]
-            valid = rvalid[j, i] & row_live
-            data = jnp.where(valid, data, jnp.zeros((), data.dtype))
-            out_cols.append(DeviceColumn(data, valid, col.dtype))
-            continue
+    def exchange_fixed(col: DeviceColumn) -> DeviceColumn:
+        bucket = col.data[row_idx]                       # [P, Q]
+        bvalid = col.validity[row_idx] & in_bucket
+        rbucket = _a2a(bucket, axis_name)
+        rvalid = _a2a(bvalid, axis_name)
+        data = rbucket[j, i]
+        valid = rvalid[j, i] & row_live
+        data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+        return DeviceColumn(data, valid, col.dtype)
 
-        # -- string column ------------------------------------------------
+    def exchange_col(col: DeviceColumn) -> DeviceColumn:
+        nonlocal max_byte_need
+        if col.is_struct:
+            # struct AND two-limb decimal layouts: children recurse, the
+            # presence mask rides as a fixed-width exchange of its own
+            kids = tuple(exchange_col(c) for c in col.children)
+            presence = exchange_fixed(
+                DeviceColumn(jnp.zeros_like(col.data), col.validity,
+                             col.children[0].dtype))
+            return DeviceColumn(jnp.zeros((out_capacity,), jnp.int8),
+                                presence.validity, col.dtype, children=kids)
+        if col.offsets is None:
+            return exchange_fixed(col)
+
+        # -- segmented column (string bytes / array elems / map entries) --
         roff = col.offsets
         lengths = roff[1:] - roff[:-1]                       # [cap]
-        # partition p's bytes are contiguous in the reordered data
+        # partition p's payload is contiguous in the reordered data
         byte_base = roff[offsets[:P]]                        # [P]
         byte_end = roff[offsets[:P] + counts]                # [P]
         byte_len = byte_end - byte_base                      # [P]
@@ -126,16 +137,18 @@ def exchange_shard_step(
 
         blen = lengths[row_idx] * in_bucket                  # [P, Q]
         bvalid = col.validity[row_idx] & in_bucket
-        # payload bytes per bucket
+        # payload slots per bucket
         b = jnp.arange(byte_quota, dtype=jnp.int32)[None, :]
         src_byte = byte_base[:, None] + b                    # [P, B]
         in_bytes = b < byte_len[:, None]
         src_byte = jnp.where(in_bytes, src_byte, col.byte_capacity - 1)
-        bbytes = jnp.where(in_bytes, col.data[src_byte], 0)  # [P, B] u8
+
+        def payload(plane, zero):
+            bb = jnp.where(in_bytes, plane[src_byte], zero)
+            return _a2a(bb, axis_name)
 
         rlen = _a2a(blen, axis_name)
         rvalid = _a2a(bvalid, axis_name)
-        rbytes = _a2a(bbytes, axis_name)
 
         out_len = jnp.where(row_live, rlen[j, i], 0)
         out_off = jnp.concatenate(
@@ -143,7 +156,7 @@ def exchange_shard_step(
              jnp.cumsum(out_len).astype(jnp.int32)])
         valid = rvalid[j, i] & row_live
 
-        # receiver byte layout: bucket-local exclusive byte cumsum
+        # receiver payload layout: bucket-local exclusive cumsum
         rbyte_cum = jnp.concatenate(
             [jnp.zeros((P, 1), jnp.int32),
              jnp.cumsum(rlen, axis=1).astype(jnp.int32)], axis=1)  # [P, Q+1]
@@ -157,9 +170,38 @@ def exchange_shard_step(
         src = rbyte_cum[jb, ib] + within
         byte_live = ob < out_off[out_capacity]
         src = jnp.clip(src, 0, byte_quota - 1)
-        data = jnp.where(byte_live, rbytes[jb, src], 0).astype(jnp.uint8)
-        out_cols.append(DeviceColumn(data, valid, col.dtype, out_off))
 
+        def gather_payload(rplane, dtype=None):
+            d = jnp.where(byte_live, rplane[jb, src],
+                          jnp.zeros((), rplane.dtype))
+            return d if dtype is None else d.astype(dtype)
+
+        if col.is_map:
+            kids = []
+            for kid in col.children:
+                rdat = payload(kid.data, jnp.zeros((), kid.data.dtype))
+                rkv = payload(kid.validity, False)
+                kv = gather_payload(rkv) & byte_live
+                kd = jnp.where(kv, gather_payload(rdat),
+                               jnp.zeros((), kid.data.dtype))
+                kids.append(DeviceColumn(kd, kv, kid.dtype))
+            return DeviceColumn(
+                jnp.zeros((out_byte_capacity,), jnp.uint8), valid,
+                col.dtype, out_off, children=tuple(kids))
+        if col.is_array:
+            rdat = payload(col.data, jnp.zeros((), col.data.dtype))
+            rcv = payload(col.child_validity, False)
+            cv = gather_payload(rcv) & byte_live
+            data = jnp.where(cv, gather_payload(rdat),
+                             jnp.zeros((), col.data.dtype))
+            return DeviceColumn(data, valid, col.dtype, out_off,
+                                child_validity=cv)
+        rbytes = payload(col.data, 0)
+        data = gather_payload(rbytes, jnp.uint8)
+        return DeviceColumn(data, valid, col.dtype, out_off)
+
+    out_cols: List[DeviceColumn] = [exchange_col(c)
+                                    for c in reordered.columns]
     out = ColumnarBatch(tuple(out_cols), total, batch.schema)
     return out, send_overflow, max_byte_need
 
@@ -190,7 +232,7 @@ def ici_exchange(
     byte_caps_by_col = {
         ci: max(s.columns[ci].byte_capacity for s in shards)
         for ci in range(len(schema))
-        if shards[0].columns[ci].is_string_like}
+        if shards[0].columns[ci].offsets is not None}
     shards = [_pad_to_capacity(s, cap, byte_caps_by_col) for s in shards]
 
     if string_max_bytes is None:
@@ -204,7 +246,7 @@ def ici_exchange(
     stacked = _stack_shards(shards)
     row_quota = round_up_pow2(max(2 * cap // P, 16))
     byte_caps = [c.byte_capacity for c in shards[0].columns
-                 if c.is_string_like]
+                 if c.offsets is not None]
     byte_quota = round_up_pow2(max(
         [2 * bc // P for bc in byte_caps] + [64]))
 
@@ -235,10 +277,16 @@ def _pad_to_capacity(b: ColumnarBatch, cap: int,
         for ci, bc in byte_caps_by_col.items():
             c = cols[ci]
             if c.byte_capacity < bc:
+                pad = bc - c.byte_capacity
                 data = jnp.concatenate(
-                    [c.data,
-                     jnp.zeros((bc - c.byte_capacity,), jnp.uint8)])
-                cols[ci] = DeviceColumn(data, c.validity, c.dtype, c.offsets)
+                    [c.data, jnp.zeros((pad,), c.data.dtype)])
+                cv = (jnp.concatenate(
+                    [c.child_validity, jnp.zeros((pad,), jnp.bool_)])
+                    if c.child_validity is not None else None)
+                kids = (tuple(k.with_capacity(bc) for k in c.children)
+                        if c.children is not None else None)
+                cols[ci] = DeviceColumn(data, c.validity, c.dtype,
+                                        c.offsets, cv, kids)
         b = ColumnarBatch(tuple(cols), b.num_rows, b.schema)
     return b
 

@@ -75,15 +75,21 @@ class IciQueryExecutor:
         """Run the plan; returns the result as a list of host-side batches."""
         from spark_rapids_tpu import types as T
 
-        def _no_arrays(node):
-            if any(isinstance(d, T.ArrayType) for d in node.schema.dtypes):
-                # the SPMD exchange kernels route variable-width data by
-                # string byte layout; array child buffers need their own
-                # redistribution step (follow-on) — task engine handles them
-                raise UnsupportedSpmd("array column in SPMD stage")
+        def _nested_ok(dt) -> bool:
+            # the exchange kernels redistribute arrays/maps by the same
+            # segmented-payload machinery as strings and recurse into
+            # struct children; only layouts the device can't represent
+            # fall back
+            from spark_rapids_tpu.planner.typesig import device_representable
+            return device_representable(dt)
+
+        def _check_types(node):
+            for d in node.schema.dtypes:
+                if not _nested_ok(d):
+                    raise UnsupportedSpmd(f"unsupported SPMD column type {d!r}")
             for c in node.children:
-                _no_arrays(c)
-        _no_arrays(root)
+                _check_types(c)
+        _check_types(root)
         inputs, in_kinds = [], []
         caps = _Caps()
         string_bucket = 0

@@ -29,6 +29,7 @@ from spark_rapids_tpu.expressions.aggregates import (
     MAX,
     MIN,
     SUM,
+    SUM128,
     M2,
     AggregateFunction,
 )
@@ -58,7 +59,9 @@ class CpuTable:
     def empty(schema: Schema) -> "CpuTable":
         cols = []
         for dt in schema.dtypes:
-            dtype = object if dt.variable_width else np.dtype(dt.np_dtype)
+            dtype = (object if dt.variable_width
+                     or isinstance(dt, T.StructType)
+                     else np.dtype(dt.np_dtype))
             cols.append((np.zeros((0,), dtype), np.zeros((0,), np.bool_)))
         return CpuTable(cols, 0, schema)
 
@@ -95,9 +98,14 @@ class CpuTable:
 
 def _norm_key(value, valid, dtype: T.DataType):
     """Grouping/join key normalization with Spark semantics: null is one
-    group; NaN == NaN; -0.0 == 0.0 (Spark NormalizeFloatingNumbers)."""
+    group; NaN == NaN; -0.0 == 0.0 (Spark NormalizeFloatingNumbers).
+    Struct keys normalize field-by-field (nested nulls compare equal)."""
     if not valid:
         return ("\0null",)
+    if isinstance(dtype, T.StructType):
+        return tuple(
+            _norm_key(value[i], value[i] is not None, f.dtype)
+            for i, f in enumerate(dtype.fields))
     if isinstance(dtype, (T.FloatType, T.DoubleType)):
         f = float(value)
         if math.isnan(f):
@@ -141,6 +149,14 @@ def _sort_key_for(value, valid, dtype: T.DataType, order: SortOrder):
     # null rank: before values if nulls_first else after
     if not valid:
         return _SortKey(-1 if nulls_first else 1, 0)
+    if isinstance(dtype, T.StructType):
+        # field-by-field comparison; null fields smallest ascending (the
+        # whole comparison flips for DESC, Spark's struct comparator)
+        field_order = SortOrder(asc, nulls_first=asc)
+        return _SortKey(0, tuple(
+            _sort_key_for(value[i], value[i] is not None, f.dtype,
+                          field_order)
+            for i, f in enumerate(dtype.fields)))
     v = value.item() if isinstance(value, np.generic) else value
     if isinstance(dtype, (T.FloatType, T.DoubleType)):
         f = float(v)
@@ -277,7 +293,9 @@ class CpuEngine:
         # group key output columns
         out_cols: List[Tuple[np.ndarray, np.ndarray]] = []
         for (vals, valid), dt in key_evals:
-            gv = np.zeros((n_groups,), object if dt.variable_width else dt.np_dtype)
+            obj = (dt.variable_width or isinstance(dt, T.StructType)
+                   or (isinstance(dt, T.DecimalType) and dt.uses_two_limbs))
+            gv = np.zeros((n_groups,), object if obj else dt.np_dtype)
             gm = np.zeros((n_groups,), np.bool_)
             for gi, k in enumerate(order):
                 r0 = groups[k][0]
@@ -303,7 +321,10 @@ class CpuEngine:
                             vals[idx], valid[idx], agg.p)
                     bufs.append((bv, bm))
                     continue
-                bv = np.zeros((n_groups,), slot.dtype.np_dtype)
+                two_limb = (isinstance(slot.dtype, T.DecimalType)
+                            and slot.dtype.uses_two_limbs)
+                bv = np.zeros((n_groups,),
+                              object if two_limb else slot.dtype.np_dtype)
                 bm = np.ones((n_groups,), np.bool_)
                 for gi, k in enumerate(order):
                     idx = np.array(groups[k], dtype=np.int64)
@@ -316,6 +337,17 @@ class CpuEngine:
                         bv[gi] = len(sel)
                     elif len(sel) == 0:
                         bv[gi] = 0
+                        if two_limb:
+                            bm[gi] = False
+                    elif slot.update_op == SUM128:
+                        # exact python-int sum; overflow past the buffer
+                        # precision -> null (SPARK-28067 contract)
+                        s = sum(int(x) for x in vals[sel])
+                        if abs(s) >= 10 ** slot.dtype.precision:
+                            bm[gi] = False
+                            bv[gi] = None
+                        else:
+                            bv[gi] = s
                     elif slot.update_op == SUM:
                         with np.errstate(all="ignore"):
                             bv[gi] = vals[sel].astype(slot.dtype.np_dtype).sum()
@@ -404,12 +436,14 @@ class CpuEngine:
                 cols = []
                 for e, dt in zip(proj, plan.schema.dtypes):
                     v, m = e.eval_cpu(t.ctx())
-                    if v.dtype == object and not (dt.variable_width
-                                                  or isinstance(dt, T.ArrayType)):
+                    if v.dtype == object and not (
+                            dt.variable_width
+                            or isinstance(dt, (T.ArrayType, T.StructType))):
                         v = np.array([0 if x is None else x for x in v],
                                      dtype=dt.np_dtype)
                     elif v.dtype != object and not dt.variable_width \
-                            and not isinstance(dt, T.ArrayType) \
+                            and not isinstance(dt, (T.ArrayType,
+                                                    T.StructType)) \
                             and v.dtype != np.dtype(dt.np_dtype):
                         v = v.astype(dt.np_dtype)
                     cols.append((v, m))

@@ -38,6 +38,7 @@ from spark_rapids_tpu.expressions.aggregates import (
     MAX,
     MIN,
     SUM,
+    SUM128,
     AggregateFunction,
 )
 from spark_rapids_tpu.kernels import groupby as G
@@ -89,6 +90,49 @@ def _seg_update(op: str, col: Optional[DeviceColumn], layout: G.GroupedLayout,
     if op == MAX:
         return G.seg_max(col, layout)
     raise NotImplementedError(op)
+
+
+def _seg_sum128(col: DeviceColumn, count_col: Optional[DeviceColumn],
+                layout: G.GroupedLayout,
+                out_dtype: T.DataType) -> DeviceColumn:
+    """Exact int128 segmented sum of decimal values (update) or partial
+    sums (merge, count_col given).  A NULL partial sum with a non-zero
+    count is an overflow marker and poisons its group (SPARK-28067
+    semantics); fresh overflow beyond the buffer precision nulls too."""
+    from spark_rapids_tpu.kernels import decimal as DK
+    live = layout.sorted_batch.live_mask()
+    valid = col.validity & live
+    cap = col.capacity
+    hi, lo = DK.limbs_of(col, col.dtype)
+    h, l, ov = DK.segment_sum128(hi, lo, valid, layout.segment_ids, cap)
+    nvalid = jax.ops.segment_sum(valid.astype(jnp.int32),
+                                 layout.segment_ids, num_segments=cap)
+    out_valid = (nvalid > 0) & ~ov
+    if count_col is not None:
+        poison = jax.ops.segment_max(
+            (live & ~col.validity
+             & (count_col.data > 0)).astype(jnp.int32),
+            layout.segment_ids, num_segments=cap) > 0
+        out_valid = out_valid & ~poison
+    out_valid = out_valid & ~DK.overflow(h, l, out_dtype.precision)
+    group_live = jnp.arange(cap, dtype=jnp.int32) < layout.num_groups
+    return DK.make_column128(h, l, out_valid & group_live, out_dtype)
+
+
+def _global_sum128(col: DeviceColumn, count_col: Optional[DeviceColumn],
+                   live, out_dtype: T.DataType) -> DeviceColumn:
+    from spark_rapids_tpu.kernels import decimal as DK
+    valid = col.validity & live
+    hi, lo = DK.limbs_of(col, col.dtype)
+    h, l, ov = DK.sum128(hi, lo, valid)
+    nvalid = jnp.sum(valid.astype(jnp.int32))
+    out_valid = (nvalid > 0) & ~ov
+    if count_col is not None:
+        poison = jnp.any(live & ~col.validity & (count_col.data > 0))
+        out_valid = out_valid & ~poison
+    out_valid = out_valid & ~DK.overflow(h, l, out_dtype.precision)
+    return DK.make_column128(jnp.reshape(h, (1,)), jnp.reshape(l, (1,)),
+                             jnp.reshape(out_valid, (1,)), out_dtype)
 
 
 def _hll_array_col(regs2d, num_groups, cap: int, m: int) -> DeviceColumn:
@@ -206,6 +250,16 @@ class _AggDeviceSpec:
                 f"on aggregate {self.aggregates[ai]!r}")
         return s_si, n_si
 
+    def _count_companion(self, ai: int) -> int:
+        """Slot index of this aggregate's COUNT_VALID companion buffer."""
+        for si in self._slot_pos[ai]:
+            _, slot = self.slot_specs[si]
+            if slot.update_op == COUNT_VALID:
+                return si
+        raise AssertionError(
+            f"SUM128 needs a COUNT_VALID companion buffer on "
+            f"{self.aggregates[ai]!r}")
+
     def _merge_bucket(self, partial: ColumnarBatch) -> int:
         from spark_rapids_tpu.kernels import strings as SK
         m = 0
@@ -240,6 +294,9 @@ class _AggDeviceSpec:
                     cols.append(_hll_array_col(
                         regs.reshape(1, agg.m), 1, 1, agg.m))
                     continue
+                if slot.update_op == SUM128:
+                    cols.append(_global_sum128(col, None, live, slot.dtype))
+                    continue
                 v, valid = _global_update(slot.update_op, col, live, slot.dtype)
                 data = jnp.where(valid, v, jnp.zeros((), v.dtype))
                 cols.append(DeviceColumn(
@@ -271,6 +328,9 @@ class _AggDeviceSpec:
                 cols.append(_hll_array_col(regs2d, layout.num_groups,
                                            col.capacity, agg.m))
                 continue
+            if slot.update_op == SUM128:
+                cols.append(_seg_sum128(col, None, layout, slot.dtype))
+                continue
             v, valid = _seg_update(slot.update_op, col, layout, slot.dtype)
             cols.append(G.finalize_agg_column(
                 v.astype(slot.dtype.jnp_dtype), valid, layout.num_groups,
@@ -294,6 +354,10 @@ class _AggDeviceSpec:
                                      axis=0)
                     cols.append(_hll_array_col(
                         merged.reshape(1, agg.m), 1, 1, agg.m))
+                    continue
+                if slot.merge_op == SUM128:
+                    ncol = partial.columns[nkeys + self._count_companion(ai)]
+                    cols.append(_global_sum128(col, ncol, live, slot.dtype))
                     continue
                 if slot.merge_op == M2_MERGE:
                     s_si, n_si = self._m2_companions(ai)
@@ -327,6 +391,11 @@ class _AggDeviceSpec:
                 cols.append(_hll_array_col(merged, layout.num_groups,
                                            cap, agg.m))
                 continue
+            if slot.merge_op == SUM128:
+                ncol = layout.sorted_batch.columns[
+                    nkeys + self._count_companion(ai)]
+                cols.append(_seg_sum128(col, ncol, layout, slot.dtype))
+                continue
             if slot.merge_op == M2_MERGE:
                 s_si, n_si = self._m2_companions(ai)
                 v, valid = G.seg_m2_merge(
@@ -351,15 +420,23 @@ class _AggDeviceSpec:
                 if c.is_array:
                     bufs.append((_hll_regs2d(c, merged.capacity, agg.m),
                                  c.validity))
+                elif c.children is not None:
+                    bufs.append((c, c.validity))   # two-limb decimal column
                 else:
                     bufs.append((c.data, c.validity))
                 si += 1
             v, valid = agg.finalize_jnp(bufs)
             live = merged.live_mask()
             valid = valid & live
-            v = jnp.where(valid, v.astype(agg.dtype.jnp_dtype),
-                          jnp.zeros((), agg.dtype.jnp_dtype))
-            mapping[id(agg)] = DeviceColumn(v, valid, agg.dtype)
+            if isinstance(v, DeviceColumn):
+                from spark_rapids_tpu.kernels import decimal as DK
+                mapping[id(agg)] = DK.make_column128(
+                    v.children[0].data, v.children[1].data, valid,
+                    agg.dtype)
+            else:
+                v = jnp.where(valid, v.astype(agg.dtype.jnp_dtype),
+                              jnp.zeros((), agg.dtype.jnp_dtype))
+                mapping[id(agg)] = DeviceColumn(v, valid, agg.dtype)
         out_cols = list(merged.columns[:nkeys])
         ctx = EvalContext(merged)
         for e in self.agg_exprs:

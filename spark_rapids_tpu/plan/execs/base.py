@@ -47,6 +47,11 @@ def shared_jit(key: str, make_fn: Callable[[], Callable], **jit_kwargs):
     its children chain down to the scan's input batches.  Close over the
     plan parameters (exprs, schemas) only.
     """
+    from spark_rapids_tpu.config import current_session_timezone
+    # session timezone is an ambient input of datetime extraction programs
+    # (the tz table bakes in as a trace-time constant); key on it so a
+    # tz change never reuses another zone's compiled program
+    key = f"{key}|tz={current_session_timezone()}"
     with _JIT_CACHE_LOCK:
         fn = _JIT_CACHE.get(key)
         if fn is not None:

@@ -141,7 +141,7 @@ class _JoinKernel:
                     for j, (side, o) in enumerate(self.cond_inputs):
                         c = (l if side == 0 else r).columns[o]
                         idx = li if side == 0 else ri
-                        if c.is_string_like:
+                        if c.offsets is not None:
                             cols.append(gather_column(
                                 c, idx, cnt, out_capacity=pair_capacity,
                                 out_byte_capacity=bc[("pair", j)]))
@@ -410,17 +410,57 @@ class TpuShuffledHashJoinExec(TpuExec):
                             if lq else None)
                     right = (coalesce_to_one([h.materialize() for h in rq])
                              if rq else None)
-                    out = self._join_pair(left, right)
+                try:
+                    yield from self._join_bucket_skew_aware(left, right)
+                finally:
+                    # release arena reservations only after the join is
+                    # done with the materialized inputs — closing earlier
+                    # lets the arena admit new work against memory that
+                    # is still physically resident
                     for h in lq + rq:
                         h.unpin()
                         h.close()
-                if out is None:
-                    continue
-                self.output_rows.add(out.num_rows)
-                yield self._count_out(out)
         finally:
             close_all(lbuckets)
             close_all(rbuckets)
+
+    # join types where each LEFT row's output depends only on the full
+    # right side, so a hot-key bucket can be split by left row ranges
+    # (Spark AQE's skew-join split, GpuCustomShuffleReaderExec.scala:39 /
+    # OptimizeSkewedJoin; right/full track right-side matches across the
+    # whole bucket and cannot split this way)
+    _LEFT_SPLITTABLE = ("inner", "left", "left_semi", "left_anti",
+                        "existence")
+
+    def _join_bucket_skew_aware(self, left, right):
+        """Join one co-bucket; a bucket still oversized after hash
+        sub-partitioning (single hot key) splits by probe-side row ranges,
+        each chunk joined against the full build side."""
+        splittable = (self.join_type in self._LEFT_SPLITTABLE
+                      and left is not None and right is not None)
+        if not splittable or left.capacity <= 2 * self.target_rows:
+            with timed(self.op_time):
+                out = self._join_pair(left, right)
+            if out is not None:
+                self.output_rows.add(out.num_rows)
+                yield self._count_out(out)
+            return
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.kernels.selection import gather_batch
+        chunk = round_up_pow2(max(self.target_rows, 1))
+        n_live = left.host_num_rows()
+        for lo in range(0, max(n_live, 1), chunk):
+            with timed(self.op_time):
+                idx = jnp.arange(lo, min(lo + chunk, left.capacity),
+                                 dtype=jnp.int32)
+                cnt = jnp.clip(left.num_rows - lo, 0, idx.shape[0])
+                piece = gather_batch(left, idx, cnt.astype(jnp.int32),
+                                     out_capacity=idx.shape[0])
+                out = self._join_pair(piece, right)
+            if out is not None:
+                self.output_rows.add(out.num_rows)
+                yield self._count_out(out)
 
     def describe(self):
         return (f"TpuShuffledHashJoin[{self.join_type}, "

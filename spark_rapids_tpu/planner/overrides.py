@@ -116,7 +116,7 @@ _SUPPORTED_EXPRS |= {
     DT.WeekOfYear, DT.MakeDate, DT.TruncDate, DT.NextDay, DT.MonthsBetween,
     DT.UnixSeconds, DT.UnixMillis, DT.UnixMicros, DT.SecondsToTimestamp,
     DT.MillisToTimestamp, DT.MicrosToTimestamp, DT.UnixDate,
-    DT.DateFromUnixDate,
+    DT.DateFromUnixDate, DT.FromUtcTimestamp, DT.ToUtcTimestamp,
 }
 
 from spark_rapids_tpu.expressions.bitwise import (
@@ -149,6 +149,15 @@ _SUPPORTED_EXPRS |= {
     NamedLambdaVariable, Explode, PosExplode,
 }
 
+from spark_rapids_tpu.expressions.structs import (
+    CreateMap, CreateNamedStruct, GetMapValue, GetStructField, MapKeys,
+    MapValues)
+
+_SUPPORTED_EXPRS |= {
+    CreateNamedStruct, GetStructField, CreateMap, GetMapValue, MapKeys,
+    MapValues,
+}
+
 from spark_rapids_tpu.expressions.hashing import (
     BloomFilterMightContain, Murmur3Hash, XxHash64)
 from spark_rapids_tpu.expressions.strings import GetJsonObject
@@ -164,9 +173,9 @@ _COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
 
 def _dtype_ok(dt: T.DataType) -> bool:
     if isinstance(dt, T.DecimalType):
-        # Decimal64 fast path (Spark's long-backed decimals); 128-bit
-        # two-limb kernels are the follow-on
-        return dt.precision <= T.DecimalType.MAX_LONG_DIGITS
+        # Decimal64 fast path (long-backed) and two-limb Decimal128 (limb
+        # planes ride the struct machinery; kernels/decimal.py)
+        return True
     if isinstance(dt, T.ArrayType):
         # array<fixed-width> uses the segmented string layout; nested
         # arrays / array<string> need child-offset stacking (follow-on)
@@ -174,11 +183,37 @@ def _dtype_ok(dt: T.DataType) -> bool:
         return (et is not None and not et.variable_width
                 and not isinstance(et, (T.ArrayType, T.StructType, T.MapType))
                 and _dtype_ok(et))
+    if isinstance(dt, T.StructType):
+        return all(_dtype_ok(f.dtype) for f in dt.fields)
+    if isinstance(dt, T.MapType):
+        # v1 map layout: fixed-width keys and values
+        return (_dtype_ok(dt.key_type) and not dt.key_type.variable_width
+                and not isinstance(dt.key_type, (T.ArrayType, T.StructType,
+                                                 T.MapType))
+                and _dtype_ok(dt.value_type)
+                and not dt.value_type.variable_width
+                and not isinstance(dt.value_type,
+                                   (T.ArrayType, T.StructType, T.MapType)))
     return isinstance(dt, _COMPUTE_OK)
 
 
 def _key_dtype_ok(dt: T.DataType) -> bool:
     return _dtype_ok(dt) and not dt.variable_width
+
+
+def _struct_key_ok(dt: T.StructType) -> bool:
+    """struct sort/group/join keys: every leaf fixed-width (string fields
+    would need per-field byte buckets threaded through the kernels)."""
+    for f in dt.fields:
+        if isinstance(f.dtype, T.StructType):
+            if not _struct_key_ok(f.dtype):
+                return False
+        elif f.dtype.variable_width or isinstance(
+                f.dtype, (T.ArrayType, T.MapType)):
+            return False
+        elif not _dtype_ok(f.dtype):
+            return False
+    return True
 
 
 def _key_expr_ok(e: "E.Expression") -> bool:
@@ -197,6 +232,10 @@ def _key_expr_ok(e: "E.Expression") -> bool:
         # nested data needs child-aware comparators; reference gates this
         # per-op in TypeSig too)
         return False
+    if isinstance(dt, T.MapType):
+        return False       # maps are unorderable in Spark too
+    if isinstance(dt, T.StructType):
+        return _struct_key_ok(dt)
     if dt.variable_width:
         while isinstance(e, E.Alias):
             e = e.child
@@ -543,6 +582,20 @@ class PlanMeta:
                 self.will_not_work(
                     f"keyless {p.join_type} join without a condition "
                     "(use cross join)")
+            def _struct_varwidth_leaf(dt):
+                if isinstance(dt, T.StructType):
+                    return any(_struct_varwidth_leaf(f.dtype)
+                               for f in dt.fields)
+                return dt.variable_width
+            for dt in (list(p.left.schema.dtypes)
+                       + list(p.right.schema.dtypes)):
+                if isinstance(dt, T.StructType) and _struct_varwidth_leaf(dt):
+                    # join gathers repeat rows; string buffers nested in
+                    # struct children have no byte-capacity retry yet
+                    self.will_not_work(
+                        f"join over struct payload {dt!r} with "
+                        "variable-width fields not supported yet")
+                    break
             if p.condition is not None:
                 for ref_dt in _leaf_ref_dtypes(p.condition):
                     if isinstance(ref_dt, (T.ArrayType, T.StructType,

@@ -67,6 +67,18 @@ class TypeSig:
                     et, (T.ArrayType, T.StructType, T.MapType)):
                 return False
             return ELEMENTABLE.supports(et)
+        if a == "struct":
+            # struct support means every field is device-representable
+            # (nested structs recurse; the reference gates nesting the
+            # same way through TypeSig.nested, TypeChecks.scala:125)
+            return ("struct" in self.atoms
+                    and all(device_representable(f.dtype)
+                            for f in dt.fields))
+        if a == "map":
+            # v1 map layout: fixed-width keys and values
+            return ("map" in self.atoms
+                    and ELEMENTABLE.supports(dt.key_type)
+                    and ELEMENTABLE.supports(dt.value_type))
         return a in self.atoms
 
     def __repr__(self):
@@ -78,15 +90,35 @@ INTEGRAL = TypeSig("byte", "short", "int", "long")
 FRACTIONAL = TypeSig("float", "double")
 NUMERIC = INTEGRAL + FRACTIONAL
 DEC64 = TypeSig("decimal64")
+DEC128 = TypeSig("decimal128")
 NUMERIC_DEC = NUMERIC + DEC64
 DATETIME = TypeSig("date", "timestamp")
 STR = TypeSig("string")
 ORDERED = NUMERIC_DEC + DATETIME + BOOL + STR
 COMMON = ORDERED + TypeSig("null")
 ARR = TypeSig("array")
-ALL_DEVICE = COMMON + ARR          # everything kernels handle today
+STRUCT = TypeSig("struct")
+MAP = TypeSig("map")
+ALL_DEVICE = COMMON + ARR + STRUCT + MAP + DEC128   # everything kernels handle
 ELEMENTABLE = NUMERIC_DEC + DATETIME + BOOL   # array element types
 NONE = TypeSig()
+
+
+def device_representable(dt: T.DataType) -> bool:
+    """Can this type live in a DeviceColumn at all?  (The blanket layout
+    check; per-op signatures may still be narrower.)"""
+    if isinstance(dt, T.StructType):
+        return all(device_representable(f.dtype) for f in dt.fields)
+    if isinstance(dt, T.MapType):
+        return (ELEMENTABLE.supports(dt.key_type)
+                and ELEMENTABLE.supports(dt.value_type))
+    if isinstance(dt, T.ArrayType):
+        et = dt.element_type
+        return (et is not None and not et.variable_width
+                and not isinstance(et, (T.ArrayType, T.StructType,
+                                        T.MapType))
+                and ELEMENTABLE.supports(et))
+    return COMMON.supports(dt) or isinstance(dt, T.BinaryType)
 
 
 class ExprSig:
@@ -138,11 +170,12 @@ def _build_registry() -> None:
     register(E.Alias, ExprSig(ALL_DEVICE, ALL_DEVICE))
     register(E.BoundReference, ExprSig(ALL_DEVICE))
     register(E.Literal, ExprSig(COMMON))
-    register(Cast, ExprSig(COMMON, COMMON,
+    register(Cast, ExprSig(COMMON + DEC128, COMMON + DEC128,
                            note="pairwise support via Cast.supported"))
 
     for cls in (Add, Subtract, Multiply):
-        register(cls, ExprSig(NUMERIC_DEC, NUMERIC_DEC, NUMERIC_DEC))
+        register(cls, ExprSig(NUMERIC_DEC + DEC128, NUMERIC_DEC + DEC128,
+                              NUMERIC_DEC + DEC128))
     register(Divide, ExprSig(FRACTIONAL + DEC64, NUMERIC_DEC, NUMERIC_DEC))
     register(IntegralDivide, ExprSig(TypeSig("long"), INTEGRAL + DEC64,
                                      INTEGRAL + DEC64))
@@ -152,7 +185,7 @@ def _build_registry() -> None:
 
     for cls in (P.EqualTo, P.EqualNullSafe, P.LessThan, P.LessThanOrEqual,
                 P.GreaterThan, P.GreaterThanOrEqual):
-        register(cls, ExprSig(BOOL, ORDERED, ORDERED))
+        register(cls, ExprSig(BOOL, ORDERED + DEC128, ORDERED + DEC128))
     for cls in (P.And, P.Or, P.Not):
         register(cls, ExprSig(BOOL, BOOL))
     for cls in (P.IsNull, P.IsNotNull):
@@ -190,6 +223,10 @@ def _build_registry() -> None:
     for name in ("Hour", "Minute", "Second"):
         register(getattr(DT, name),
                  ExprSig(TypeSig("int"), TypeSig("timestamp")))
+    for name in ("FromUtcTimestamp", "ToUtcTimestamp"):
+        register(getattr(DT, name),
+                 ExprSig(TypeSig("timestamp"), TypeSig("timestamp"),
+                         note="transition-table lookup on device"))
 
     # strings
     for name in ("Upper", "Lower", "Trim", "LTrim", "RTrim", "Reverse",
@@ -205,7 +242,7 @@ def _build_registry() -> None:
                                       "indexed paths via CPU bridge"))
 
     # collections
-    register(C.Size, ExprSig(TypeSig("int"), ARR))
+    register(C.Size, ExprSig(TypeSig("int"), ARR + MAP))
     register(C.ArrayContains, ExprSig(BOOL, ARR, ELEMENTABLE))
     register(C.ArrayPosition, ExprSig(TypeSig("long"), ARR, ELEMENTABLE))
     register(C.ArrayMin, ExprSig(ELEMENTABLE, ARR))
@@ -223,14 +260,24 @@ def _build_registry() -> None:
     register(C.ArrayExists, ExprSig(BOOL, ARR, BOOL))
     register(C.ArrayForAll, ExprSig(BOOL, ARR, BOOL))
 
+    # structs / maps
+    from spark_rapids_tpu.expressions import structs as ST
+    register(ST.CreateNamedStruct, ExprSig(STRUCT, ALL_DEVICE))
+    register(ST.GetStructField, ExprSig(ALL_DEVICE, STRUCT))
+    register(ST.CreateMap, ExprSig(MAP, ELEMENTABLE))
+    register(ST.GetMapValue, ExprSig(ELEMENTABLE, MAP, ELEMENTABLE))
+    register(ST.MapKeys, ExprSig(ARR, MAP))
+    register(ST.MapValues, ExprSig(ARR, MAP))
+
     # hashing / sketches
     register(H.Murmur3Hash, ExprSig(TypeSig("int"), ORDERED))
     register(H.XxHash64, ExprSig(TypeSig("long"), ORDERED))
     register(H.BloomFilterMightContain, ExprSig(BOOL, TypeSig("long")))
 
     # aggregates
-    register(A.Sum, ExprSig(TypeSig("long", "double", "decimal64"),
-                            NUMERIC_DEC))
+    register(A.Sum, ExprSig(TypeSig("long", "double", "decimal64",
+                                    "decimal128"),
+                            NUMERIC_DEC + DEC128))
     register(A.Count, ExprSig(TypeSig("long"), ALL_DEVICE))
     for cls in (A.Min, A.Max):
         register(cls, ExprSig(ORDERED, ORDERED))

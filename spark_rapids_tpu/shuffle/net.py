@@ -441,6 +441,9 @@ class TcpShuffleTransport:
                     f"after {self.completeness_timeout_s}s: "
                     f"{sorted(set(participants) - set(complete))} pending")
             time.sleep(0.05)
+        # re-learn peers AFTER the wait: a participant may have registered
+        # while we were waiting for map output
+        self.executor.heartbeat()
         remote = []
         for eid in complete:
             if eid == self.executor.executor_id:

@@ -27,12 +27,20 @@ MAGIC = 0x54414431
 
 
 def wire_supported(dt: T.DataType) -> bool:
-    """Column types the kudo wire format can carry: fixed-width and
-    (offsets, bytes) string-likes.  Nested types (array/struct/map) are
-    not wire-serializable yet — cross-process transports must refuse them
-    rather than silently narrowing to an in-process mode."""
-    if isinstance(dt, (T.ArrayType, T.StructType, T.MapType)):
-        return False
+    """Column types the kudo wire format can carry.  Flat columns ride the
+    native writer; struct/map/array columns ride the python writer's
+    recursive framing (struct = validity + field columns; map/array =
+    validity + offsets + entry columns)."""
+    if isinstance(dt, T.StructType):
+        return all(wire_supported(f.dtype) for f in dt.fields)
+    if isinstance(dt, T.MapType):
+        return (wire_supported(dt.key_type) and wire_supported(dt.value_type)
+                and not dt.key_type.variable_width
+                and not dt.value_type.variable_width)
+    if isinstance(dt, T.ArrayType):
+        et = dt.element_type
+        return et is not None and not et.variable_width \
+            and not isinstance(et, (T.ArrayType, T.StructType, T.MapType))
     return dt.np_dtype is not None
 
 
@@ -73,12 +81,21 @@ def _decompress(buf: bytes) -> bytes:
     return payload
 
 
+def _has_nested(schema: Schema) -> bool:
+    return any(T.child_dtypes(d) is not None
+               or isinstance(d, T.ArrayType)
+               for d in schema.dtypes)
+
+
 def serialize_batch(batch: ColumnarBatch, codec: str = "none") -> bytes:
-    cols, n = _host_cols(batch)
-    if native.available():
-        payload = native.kudo_serialize(cols, n)
+    if _has_nested(batch.schema):
+        payload = _py_serialize_nested(batch)
     else:
-        payload = _py_serialize(cols, n)
+        cols, n = _host_cols(batch)
+        if native.available():
+            payload = native.kudo_serialize(cols, n)
+        else:
+            payload = _py_serialize(cols, n)
     return _compress(payload, codec)
 
 
@@ -87,6 +104,8 @@ def merge_batches(buffers: List[bytes], schema: Schema) -> Optional[ColumnarBatc
     import jax.numpy as jnp
     if not buffers:
         return None
+    if _has_nested(schema):
+        return _py_merge_nested([_decompress(b) for b in buffers], schema)
     raw = [_decompress(b) for b in buffers]
     col_specs = [(np.dtype(dt.np_dtype), dt.variable_width)
                  for dt in schema.dtypes]
@@ -200,3 +219,201 @@ def _py_merge(raw: List[bytes], col_specs, row_capacity: int):
                 pos += rows
             out.append((valid, None, data))
     return out, total
+
+
+# ---------------------------------------------------------------------------
+# recursive wire framing for nested schemas (struct/map/array)
+#
+# Column-major depth-first blocks: each block is
+#   validity bits [(n+7)//8] ++ kind-specific payload:
+#     fixed        data[n * itemsize]
+#     string-like  offsets[(n+1)*4] ++ bytes[offsets[n]]
+#     struct       one child block per field (n rows each)
+#     array        offsets ++ (child_validity bits + elem data) over entries
+#     map          offsets ++ key block ++ value block over entries
+# The layout is schema-derived, so the reader needs no per-column metadata
+# beyond the shared (MAGIC2, ncols, rows) header.
+
+MAGIC2 = 0x54414432
+
+
+def _col_host_nested(col, n: int):
+    """Download one device column (recursively) trimmed to n live rows."""
+    valid = np.asarray(col.validity)[:n]
+    if col.is_struct:
+        kids = [_col_host_nested(c, n) for c in col.children]
+        return ("struct", valid, None, kids)
+    if col.is_map:
+        offsets = np.asarray(col.offsets)[:n + 1]
+        ne = int(offsets[n]) if n else 0
+        kids = [_col_host_nested(c, ne) for c in col.children]
+        return ("map", valid, offsets, kids)
+    if col.is_array:
+        offsets = np.asarray(col.offsets)[:n + 1]
+        ne = int(offsets[n]) if n else 0
+        data = np.asarray(col.data)[:ne]
+        cvalid = np.asarray(col.child_validity)[:ne]
+        return ("array", valid, offsets, [("fixed", cvalid, None, data)])
+    if col.offsets is not None:
+        offsets = np.asarray(col.offsets)[:n + 1]
+        nb = int(offsets[n]) if n else 0
+        return ("string", valid, offsets, np.asarray(col.data)[:nb])
+    return ("fixed", valid, None, np.asarray(col.data)[:n])
+
+
+def _write_block(parts: list, block) -> None:
+    kind, valid, offsets, payload = block
+    n = len(valid)
+    vb = (n + 7) // 8
+    parts.append(np.packbits(valid.astype(np.uint8),
+                             bitorder="little").tobytes().ljust(vb, b"\0"))
+    if kind == "fixed":
+        parts.append(np.ascontiguousarray(payload).tobytes())
+    elif kind == "string":
+        parts.append(offsets.astype(np.int32).tobytes())
+        parts.append(np.asarray(payload, np.uint8).tobytes())
+    elif kind in ("struct",):
+        for kid in payload:
+            _write_block(parts, kid)
+    elif kind in ("map", "array"):
+        parts.append(offsets.astype(np.int32).tobytes())
+        for kid in payload:
+            _write_block(parts, kid)
+    else:
+        raise AssertionError(kind)
+
+
+def _py_serialize_nested(batch: ColumnarBatch) -> bytes:
+    n = batch.host_num_rows()
+    parts = [struct.pack("<IIQ", MAGIC2, len(batch.columns), n)]
+    for col in batch.columns:
+        _write_block(parts, _col_host_nested(col, n))
+    return b"".join(parts)
+
+
+class _Reader:
+    def __init__(self, buf: bytes, pos: int = 16):
+        self.buf = buf
+        self.pos = pos
+
+    def bits(self, n: int) -> np.ndarray:
+        vb = (n + 7) // 8
+        raw = np.frombuffer(self.buf, np.uint8, vb, self.pos)
+        self.pos += vb
+        return np.unpackbits(raw, bitorder="little")[:n].astype(np.bool_)
+
+    def i32(self, n: int) -> np.ndarray:
+        out = np.frombuffer(self.buf, np.int32, n, self.pos)
+        self.pos += n * 4
+        return out
+
+    def raw(self, nbytes: int, np_dtype, count: int) -> np.ndarray:
+        out = np.frombuffer(self.buf, np_dtype, count, self.pos)
+        self.pos += nbytes
+        return out
+
+
+def _read_block(r: _Reader, dt: T.DataType, n: int):
+    valid = r.bits(n)
+    kid_types = T.child_dtypes(dt)
+    if kid_types is not None and not isinstance(dt, T.MapType):
+        # struct layout (incl. two-limb decimal128)
+        kids = [_read_block(r, kt, n) for kt in kid_types]
+        return ("struct", valid, None, kids)
+    if isinstance(dt, T.MapType):
+        offsets = r.i32(n + 1)
+        ne = int(offsets[n]) if n else 0
+        kids = [_read_block(r, dt.key_type, ne),
+                _read_block(r, dt.value_type, ne)]
+        return ("map", valid, offsets, kids)
+    if isinstance(dt, T.ArrayType):
+        offsets = r.i32(n + 1)
+        ne = int(offsets[n]) if n else 0
+        kid = _read_block(r, dt.element_type, ne)
+        return ("array", valid, offsets, [kid])
+    if dt.variable_width:
+        offsets = r.i32(n + 1)
+        nb = int(offsets[n]) if n else 0
+        return ("string", valid, offsets, r.raw(nb, np.uint8, nb))
+    w = np.dtype(dt.np_dtype)
+    return ("fixed", valid, None, r.raw(n * w.itemsize, w, n))
+
+
+def _merge_block_list(blocks, dt: T.DataType, row_capacity: int):
+    """Concatenate parsed blocks of one column into a DeviceColumn."""
+    import jax.numpy as jnp
+
+    total = sum(len(b[1]) for b in blocks)
+    valid = np.zeros((row_capacity,), np.bool_)
+    pos = 0
+    for b in blocks:
+        valid[pos:pos + len(b[1])] = b[1]
+        pos += len(b[1])
+    jvalid = jnp.asarray(valid)
+
+    kid_types = T.child_dtypes(dt)
+    if kid_types is not None and not isinstance(dt, T.MapType):
+        kids = tuple(
+            _merge_block_list([b[3][i] for b in blocks], kt, row_capacity)
+            for i, kt in enumerate(kid_types))
+        return DeviceColumn(jnp.zeros((row_capacity,), jnp.int8), jvalid,
+                            dt, children=kids)
+
+    if isinstance(dt, (T.MapType, T.ArrayType)) or dt.variable_width:
+        lengths = np.zeros((row_capacity,), np.int64)
+        pos = 0
+        for b in blocks:
+            o = b[2]
+            nrows = len(b[1])
+            lengths[pos:pos + nrows] = (o[1:nrows + 1].astype(np.int64)
+                                        - o[:nrows].astype(np.int64))
+            pos += nrows
+        offsets = np.zeros((row_capacity + 1,), np.int32)
+        np.cumsum(lengths, out=offsets[1:])
+        ecap = round_up_pow2(max(int(offsets[pos]), 1))
+        joff = jnp.asarray(offsets)
+        if isinstance(dt, T.MapType):
+            kids = tuple(
+                _merge_block_list([b[3][i] for b in blocks],
+                                  (dt.key_type, dt.value_type)[i], ecap)
+                for i in range(2))
+            return DeviceColumn(jnp.zeros((ecap,), jnp.uint8), jvalid, dt,
+                                joff, children=kids)
+        if isinstance(dt, T.ArrayType):
+            kid = _merge_block_list([b[3][0] for b in blocks],
+                                    dt.element_type, ecap)
+            return DeviceColumn(kid.data, jvalid, dt, joff,
+                                child_validity=kid.validity)
+        data = np.zeros((ecap,), np.uint8)
+        p = 0
+        for b in blocks:
+            d = np.asarray(b[3], np.uint8)
+            data[p:p + len(d)] = d
+            p += len(d)
+        return DeviceColumn(jnp.asarray(data), jvalid, dt, joff)
+
+    w = np.dtype(dt.np_dtype)
+    data = np.zeros((row_capacity,), w)
+    pos = 0
+    for b in blocks:
+        data[pos:pos + len(b[3])] = b[3]
+        pos += len(b[3])
+    return DeviceColumn(jnp.asarray(data), jvalid, dt)
+
+
+def _py_merge_nested(raw: List[bytes], schema: Schema) -> ColumnarBatch:
+    import jax.numpy as jnp
+    parsed = []          # per buffer: list of top-level blocks
+    total_rows = 0
+    for buf in raw:
+        magic, ncols, rows = struct.unpack("<IIQ", buf[:16])
+        assert magic == MAGIC2, hex(magic)
+        assert ncols == len(schema)
+        r = _Reader(buf)
+        parsed.append([_read_block(r, dt, rows) for dt in schema.dtypes])
+        total_rows += rows
+    row_capacity = round_up_pow2(max(total_rows, 1))
+    cols = tuple(
+        _merge_block_list([p[i] for p in parsed], dt, row_capacity)
+        for i, dt in enumerate(schema.dtypes))
+    return ColumnarBatch(cols, jnp.asarray(total_rows, jnp.int32), schema)

@@ -336,6 +336,21 @@ def type_from_name(name: str) -> DataType:
 _NUMERIC_WIDEN_ORDER = [ByteType(), ShortType(), IntegerType(), LongType(), FloatType(), DoubleType()]
 
 
+def child_dtypes(dt: DataType):
+    """Child column dtypes of a composite device layout, or None.
+
+    struct -> its field dtypes; map -> (key, value); decimal128 -> two
+    int64 limb planes (hi, lo) — the two-limb emulation rides the struct
+    machinery (gather/concat/spill/wire/shuffle recurse over children)."""
+    if isinstance(dt, StructType):
+        return [f.dtype for f in dt.fields]
+    if isinstance(dt, MapType):
+        return [dt.key_type, dt.value_type]
+    if isinstance(dt, DecimalType) and dt.uses_two_limbs:
+        return [LONG, LONG]
+    return None
+
+
 def numeric_promote(a: DataType, b: DataType) -> DataType:
     """Spark's binary-arithmetic promotion for non-decimal numeric types."""
     if a == b:

@@ -74,17 +74,21 @@ def test_decimal_group_and_sort_keys():
         ignore_order=False)
 
 
-def test_decimal_sum_falls_back_but_correct():
-    """sum(decimal(12,2)) -> decimal(22,2) exceeds Decimal64: the planner
-    must fall back and results must still agree."""
+def test_decimal_sum_promotes_past_64_and_runs_on_device():
+    """sum(decimal(12,2)) -> decimal(22,2) exceeds Decimal64: the two-limb
+    kernels now keep the aggregate on device (was a fallback before
+    decimal128 landed)."""
     s = TpuSession({"spark.rapids.sql.enabled": "true"})
     q = df(s).group_by("k").agg(sum_("a").alias("sa"))
-    assert "will NOT" in q.explain()
+    assert "will NOT" not in q.explain(), q.explain()
     assert_tpu_cpu_equal(
         lambda sess: df(sess).group_by("k").agg(sum_("a").alias("sa")))
 
 
-def test_decimal_overflow_yields_null():
+def test_decimal_add_widens_past_64():
+    """decimal(18,0) + decimal(18,0) -> decimal(19,0): the result now
+    holds 1.8e18 exactly in two limbs (it was a forced NULL when results
+    were capped at precision 18)."""
     schema = Schema(("x", "y"), (T.DecimalType(18, 0), T.DecimalType(18, 0)))
 
     def build(s):
@@ -92,5 +96,5 @@ def test_decimal_overflow_yields_null():
             {"x": [10**17 * 9, 5], "y": [10**17 * 9, 7]}, schema)
         return dfx.select((col("x") + col("y")).alias("s"))
     rows = assert_tpu_cpu_equal(build, ignore_order=False)
-    assert rows[0][0] is None     # 1.8e18 exceeds precision-18 bound
+    assert rows[0][0] == 10**17 * 18
     assert rows[1][0] == 12

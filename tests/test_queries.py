@@ -29,6 +29,12 @@ def _key(row):
                 out.append((2, v))
         elif isinstance(v, (bytes, str)):
             out.append((2, str(v)))
+        elif isinstance(v, dict):
+            # map values: order-insensitive comparable form
+            out.append((2, repr(sorted(v.items(), key=repr))))
+        elif isinstance(v, (tuple, list)):
+            # struct/array values
+            out.append((2, repr(v)))
         else:
             out.append((2, float(v) if isinstance(v, (int, bool)) else v))
     return out

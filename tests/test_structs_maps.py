@@ -1,0 +1,192 @@
+"""Struct and map columns end to end: layout, expressions, keys, shuffle.
+
+Reference strategy: struct_test.py / map_test.py in
+integration_tests/src/main/python plus the nested-type coverage of
+GpuOverrides (complexTypeCreator.scala, complexTypeExtractors.scala).
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import (
+    col, count, create_map, lit, map_keys, map_value, map_values,
+    named_struct, struct_field, sum_)
+from spark_rapids_tpu.expressions.collections import Size
+from spark_rapids_tpu.expressions.core import Alias
+from spark_rapids_tpu.kernels.sort import SortOrder
+from tests.test_queries import assert_tpu_cpu_equal
+
+ST = T.StructType((T.StructField("a", T.INT), T.StructField("b", T.LONG)))
+NST = T.StructType((T.StructField("x", T.DOUBLE), T.StructField("in", ST)))
+MT = T.MapType(T.INT, T.LONG)
+SCHEMA = Schema(("s", "m", "k", "v"), (ST, MT, T.INT, T.LONG))
+
+
+def df(s, n=300, parts=3, seed=5):
+    rng = np.random.RandomState(seed)
+    structs, maps = [], []
+    for i in range(n):
+        if i % 11 == 0:
+            structs.append(None)
+        elif i % 7 == 0:
+            structs.append((None, i % 3))       # null FIELD inside struct
+        else:
+            structs.append((i % 5, i % 3))
+        if i % 13 == 0:
+            maps.append(None)
+        else:
+            maps.append({j: i * 10 + j for j in range(i % 4)})
+    data = {"s": structs, "m": maps,
+            "k": [int(x) for x in rng.randint(0, 6, n)],
+            "v": list(range(n))}
+    batches = [ColumnarBatch.from_pydict(
+        {c: vs[o:o + 100] for c, vs in data.items()}, SCHEMA)
+        for o in range(0, n, 100)]
+    return s.create_dataframe(batches, num_partitions=parts)
+
+
+def test_struct_host_roundtrip():
+    rows = [(1, "x"), None, (None, "z"), (4, None)]
+    st = T.StructType((T.StructField("a", T.INT), T.StructField("b", T.STRING)))
+    b = ColumnarBatch.from_pydict({"s": rows}, Schema(("s",), (st,)))
+    assert b.to_pydict()["s"] == rows
+
+
+def test_nested_struct_roundtrip():
+    rows = [(1.5, (1, 2)), (2.5, None), None, (float("nan"), (None, 7))]
+    b = ColumnarBatch.from_pydict({"s": rows}, Schema(("s",), (NST,)))
+    got = b.to_pydict()["s"]
+    assert got[1] == (2.5, None) and got[2] is None
+    assert got[3][1] == (None, 7)
+
+
+def test_map_host_roundtrip():
+    rows = [{1: 10, 2: 20}, None, {}, {5: None}]
+    b = ColumnarBatch.from_pydict({"m": rows}, Schema(("m",), (MT,)))
+    assert b.to_pydict()["m"] == rows
+
+
+def test_struct_arrow_roundtrip():
+    import pyarrow as pa
+    st = T.StructType((T.StructField("a", T.INT), T.StructField("b", T.STRING)))
+    rows = [(1, "x"), None, (3, None)]
+    b = ColumnarBatch.from_pydict(
+        {"s": rows, "k": [1, 2, 3]}, Schema(("s", "k"), (st, T.INT)))
+    t = b.to_arrow()
+    assert t.column("s").to_pylist() == [
+        {"a": 1, "b": "x"}, None, {"a": 3, "b": None}]
+    back = ColumnarBatch.from_arrow(t)
+    assert back.to_pydict()["s"] == rows
+
+
+def test_create_and_extract_struct():
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(struct_field(named_struct("x", col("k"), "y", col("v")), "y"),
+              "yy"),
+        Alias(col("k"), "k")))
+
+
+def test_get_struct_field_null_struct():
+    """null structs read every field as null."""
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(struct_field(col("s"), "a"), "fa"),
+        Alias(struct_field(col("s"), "b"), "fb")))
+
+
+def test_filter_on_struct_field():
+    assert_tpu_cpu_equal(lambda s: df(s).filter(
+        struct_field(col("s"), "a") > lit(2)))
+
+
+def test_group_by_struct_key():
+    """null structs are one group; structs with null fields group by
+    field equality (nested null == null)."""
+    rows = assert_tpu_cpu_equal(lambda s: df(s).group_by("s").agg(
+        Alias(sum_(col("v")), "sv"), Alias(count(), "n")))
+    assert len(rows) > 3
+
+
+@pytest.mark.parametrize("asc", [True, False])
+def test_sort_by_struct(asc):
+    def q(s):
+        return df(s).sort((col("s"), SortOrder(asc)))
+    assert_tpu_cpu_equal(q, ignore_order=False)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_join_on_struct_key(how):
+    def q(s):
+        l = df(s)
+        r = df(s, n=100, parts=1, seed=9).select(
+            Alias(col("s"), "s2"), Alias(col("v"), "v2"))
+        return l.join(r, on=([col("s")], [col("s2")]), how=how)
+    assert_tpu_cpu_equal(q)
+
+
+def test_struct_through_shuffle_modes():
+    for mode in ("CACHE_ONLY", "MULTITHREADED"):
+        def q(s, m=mode):
+            s.set_conf("spark.rapids.shuffle.mode", m)
+            return df(s).group_by("s").agg(Alias(sum_(col("v")), "sv"))
+        assert_tpu_cpu_equal(q)
+
+
+def test_struct_runs_on_tpu():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = df(s).group_by("s").agg(Alias(sum_(col("v")), "sv")).explain()
+    assert "will NOT" not in e, e
+
+
+def test_create_map_and_lookup():
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(map_value(create_map(lit(1), col("v"),
+                                   lit(2), col("v") + col("v")), lit(2)),
+              "m2"),
+        Alias(col("k"), "k")))
+
+
+def test_map_value_from_column_key():
+    """lookup key varies per row; misses and null maps yield null."""
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(map_value(col("m"), col("k") % lit(4)), "mv"),
+        Alias(col("v"), "v")))
+
+
+def test_map_keys_values_size():
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(map_keys(col("m")), "mk"),
+        Alias(map_values(col("m")), "mv"),
+        Alias(Size(col("m")), "sz")))
+
+
+def test_map_through_shuffle():
+    def q(s):
+        s.set_conf("spark.rapids.shuffle.mode", "MULTITHREADED")
+        return df(s).group_by("k").agg(Alias(count(), "n")) \
+            .join(df(s).select(Alias(col("k"), "k"), Alias(col("m"), "m")),
+                  "k", how="inner")
+    assert_tpu_cpu_equal(q)
+
+
+def test_struct_parquet_roundtrip(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    t = pa.table({
+        "s": [{"a": 1, "b": 2}, None, {"a": None, "b": 4}],
+        "k": [1, 2, 3],
+    })
+    p = str(tmp_path / "structs.parquet")
+    pq.write_table(t, p)
+
+    def q(s):
+        return s.read_parquet(p).select(
+            Alias(struct_field(col("s"), "a"), "fa"), Alias(col("k"), "k"))
+    assert_tpu_cpu_equal(q)
+
+
+@pytest.mark.inject_oom
+def test_struct_group_by_with_injected_oom():
+    assert_tpu_cpu_equal(lambda s: df(s).group_by("s").agg(
+        Alias(sum_(col("v")), "sv")))

@@ -1,0 +1,105 @@
+"""Timezone support: from/to_utc_timestamp + session-timezone extraction.
+
+Reference: TimeZoneDB.scala:27 (device transition tables), Plugin.scala:651
+(cache init).  The oracle side resolves zones per-row through zoneinfo's own
+PEP-495 rules, so these differential tests check the device transition-table
+math against an independent implementation.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import (
+    Cast, col, count, from_utc_timestamp, lit, to_utc_timestamp)
+from spark_rapids_tpu.expressions.core import Alias
+from spark_rapids_tpu.expressions.datetime import Hour, Month, Year
+from tests.test_queries import assert_tpu_cpu_equal
+
+SCHEMA = Schema.of(ts=T.TIMESTAMP, k=T.INT)
+
+
+def df(s, n=400, seed=8, parts=2):
+    rng = np.random.RandomState(seed)
+    # instants spanning 1950..2090 incl. micros around DST boundaries
+    secs = rng.randint(-631152000, 3786912000, n)
+    dst_edges = [1205056800, 1225612800, 1615712400, 1636276800]
+    for i, e in enumerate(dst_edges * 8):
+        secs[i] = e + rng.randint(-7200, 7200)
+    ts = [int(x) * 1_000_000 + int(y) for x, y in
+          zip(secs, rng.randint(0, 10**6, n))]
+    for i in rng.choice(n, n // 10, replace=False):
+        ts[i] = None
+    data = {"ts": ts, "k": rng.randint(0, 5, n).tolist()}
+    return s.create_dataframe(data, SCHEMA, num_partitions=parts)
+
+
+ZONES = ["America/Los_Angeles", "Asia/Kolkata", "Australia/Lord_Howe"]
+
+
+@pytest.mark.parametrize("tz", ZONES)
+def test_from_utc_timestamp(tz):
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(from_utc_timestamp(col("ts"), tz), "local"),
+        Alias(col("k"), "k")))
+
+
+@pytest.mark.parametrize("tz", ZONES)
+def test_to_utc_timestamp(tz):
+    """Wall-clock -> UTC incl. DST gap/overlap rules (fold=0 semantics)."""
+    assert_tpu_cpu_equal(lambda s: df(s).select(
+        Alias(to_utc_timestamp(col("ts"), tz), "utc"),
+        Alias(col("k"), "k")))
+
+
+def test_tz_shift_runs_on_tpu():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    e = df(s).select(
+        Alias(from_utc_timestamp(col("ts"), "Europe/Berlin"), "l")).explain()
+    assert "will NOT" not in e, e
+
+
+@pytest.mark.parametrize("tz", ZONES)
+def test_session_timezone_extraction(tz):
+    """year/month/hour of a timestamp read in the session timezone
+    (spark.sql.session.timeZone)."""
+    def q(s):
+        s.set_conf("spark.sql.session.timeZone", tz)
+        return df(s).select(
+            Alias(Year(col("ts")), "y"),
+            Alias(Month(col("ts")), "m"),
+            Alias(Hour(col("ts")), "h"),
+            Alias(col("k"), "k"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_session_timezone_cast_to_date():
+    def q(s):
+        s.set_conf("spark.sql.session.timeZone", "America/Los_Angeles")
+        return df(s).select(
+            Alias(Cast(col("ts"), T.DATE), "d"), Alias(col("k"), "k"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_session_timezone_change_recompiles():
+    """Two sessions with different zones must not share compiled programs
+    (the jit-cache tz keying)."""
+    rows = {}
+    for tz in ("UTC", "Asia/Kolkata"):
+        s = TpuSession({"spark.rapids.sql.enabled": "true",
+                        "spark.sql.session.timeZone": tz})
+        rows[tz] = sorted(df(s, n=50, parts=1).select(
+            Alias(Hour(col("ts")), "h")).collect(), key=repr)
+    assert rows["UTC"] != rows["Asia/Kolkata"]   # +05:30 shifts hours
+
+
+def test_tz_group_by_local_hour():
+    def q(s):
+        s.set_conf("spark.sql.session.timeZone", "America/Los_Angeles")
+        return df(s).group_by_expr(
+            Alias(Hour(col("ts")), "h")).agg(Alias(count(), "n")) \
+            if hasattr(df(s), "group_by_expr") else \
+            df(s).select(Alias(Hour(col("ts")), "h")) \
+                 .group_by("h").agg(Alias(count(), "n"))
+    assert_tpu_cpu_equal(q)

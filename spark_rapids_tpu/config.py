@@ -279,6 +279,26 @@ MULTITHREAD_READ_NUM_THREADS = conf("spark.rapids.sql.multiThreadedRead.numThrea
     "GpuMultiFileReader.scala)."
 ).int_conf(8)
 
+READER_BATCH_SIZE_BYTES = conf("spark.rapids.sql.reader.batchSizeBytes").doc(
+    "Soft cap on decoded bytes per scan batch: the chunked-reader bound "
+    "that keeps one scan's device footprint independent of file size "
+    "(reference: GpuParquetScan.scala:2523 chunked reader)."
+).int_conf(128 << 20)
+
+PARQUET_COALESCE_RANGES = conf(
+    "spark.rapids.sql.format.parquet.rangeCoalescing.enabled").doc(
+    "Plan the pruned row groups' column-chunk byte ranges from the footer "
+    "and read them as few merged I/O requests (the object-store range "
+    "coalescing of S3InputFile.readVectored / fileio/hadoop)."
+).boolean_conf(False)
+
+ASYNC_WRITE_MAX_INFLIGHT = conf(
+    "spark.rapids.sql.asyncWrite.maxInFlightBytes").doc(
+    "Byte budget of encode/write work allowed in flight behind the device "
+    "loop; 0 writes synchronously (reference: io/async/AsyncOutputStream"
+    ".scala + ThrottlingExecutor.scala)."
+).int_conf(256 << 20)
+
 LORE_DUMP_IDS = conf("spark.rapids.sql.lore.idsToDump").doc(
     "LORE-style debug replay: comma-separated exec ids (see explain() "
     "output, [loreId=N]) whose OUTPUT batches are dumped as parquet for "
@@ -414,6 +434,18 @@ class RapidsConf:
     @property
     def retry_context_check(self) -> bool:
         return self.get(TEST_RETRY_CONTEXT_CHECK)
+
+    @property
+    def reader_batch_size_bytes(self) -> int:
+        return self.get(READER_BATCH_SIZE_BYTES)
+
+    @property
+    def parquet_coalesce_ranges(self) -> bool:
+        return self.get(PARQUET_COALESCE_RANGES)
+
+    @property
+    def async_write_max_inflight(self) -> int:
+        return self.get(ASYNC_WRITE_MAX_INFLIGHT)
 
     @property
     def retry_max_attempts(self) -> int:

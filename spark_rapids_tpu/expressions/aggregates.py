@@ -33,6 +33,8 @@ COUNT_STAR = "count_star"    # counts rows
 MIN = "min"
 MAX = "max"
 SUM128 = "sum128"            # exact int128 sum of decimal limbs
+COLLECT = "collect"          # gather the group's values into an array row
+COLLECT_MERGE = "collect_merge"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -466,3 +468,79 @@ class ApproximateCountDistinct(AggregateFunction):
 def approx_count_distinct(e, rsd: float = 0.05):
     from spark_rapids_tpu.expressions.core import col
     return ApproximateCountDistinct(col(e) if isinstance(e, str) else e, rsd)
+
+
+class Percentile(AggregateFunction):
+    """percentile(col, p) — EXACT percentile with linear interpolation
+    (Spark's Percentile agg; the reference evaluates it via sorted group
+    arrays, aggregate/GpuPercentileEvaluation area).
+
+    Buffer: the group's valid values collected into one array row (the
+    same holistic-buffer shape Spark uses); finalize sorts each row's
+    entries and interpolates at rank p*(n-1)."""
+
+    name = "percentile"
+
+    def __init__(self, child: Expression, percentage: float):
+        assert 0.0 <= percentage <= 1.0, percentage
+        self.children = (child,)
+        self.percentage = float(percentage)
+
+    def with_children(self, children):
+        return Percentile(children[0], self.percentage)
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def buffers(self):
+        return (BufferSlot(T.ArrayType(T.DOUBLE, contains_null=False),
+                           COLLECT, COLLECT_MERGE),)
+
+    def finalize_np(self, bufs):
+        (rows, valid), = bufs    # object array of float lists
+        n = len(rows)
+        out = np.zeros((n,), np.float64)
+        ok = np.zeros((n,), np.bool_)
+        for i in range(n):
+            vals = rows[i]
+            if not valid[i] or vals is None or len(vals) == 0:
+                continue
+            out[i] = float(np.percentile(np.asarray(vals, np.float64),
+                                         self.percentage * 100.0,
+                                         method="linear"))
+            ok[i] = True
+        return out, ok
+
+    def finalize_jnp(self, bufs):
+        import jax.numpy as jnp
+        (col, valid), = bufs     # array DeviceColumn: one row per group
+        from spark_rapids_tpu.kernels.collections import segment_sort
+        cap = col.capacity
+        nrows = jnp.sum(valid.astype(jnp.int32))
+        s = segment_sort(col, nrows, ascending=True)
+        lengths = (s.offsets[1:] - s.offsets[:-1]).astype(jnp.float64)
+        rank = self.percentage * jnp.maximum(lengths - 1.0, 0.0)
+        lo = jnp.floor(rank).astype(jnp.int32)
+        hi = jnp.ceil(rank).astype(jnp.int32)
+        frac = rank - jnp.floor(rank)
+        base = s.offsets[:-1]
+        ecap = max(s.data.shape[0] - 1, 0)
+        lo_v = s.data[jnp.clip(base + lo, 0, ecap)]
+        hi_v = s.data[jnp.clip(base + hi, 0, ecap)]
+        out = lo_v + (hi_v - lo_v) * frac
+        ok = valid & (lengths > 0)
+        return out.astype(jnp.float64), ok
+
+    def __repr__(self):
+        return f"percentile({self.input!r}, {self.percentage})"
+
+
+def percentile(e, p: float) -> Percentile:
+    from spark_rapids_tpu.expressions.core import col as _col
+    return Percentile(_col(e) if isinstance(e, str) else e, p)

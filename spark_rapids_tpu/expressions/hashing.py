@@ -154,3 +154,34 @@ from spark_rapids_tpu.kernels.hll import (  # noqa: F401
     p_from_rsd as hll_p_from_rsd,
     update_np as hll_update_np,
 )
+
+
+class HiveHash(_HashBase):
+    """Spark hive_hash(...) — Hive's polynomial bucketing hash
+    (HashFunctions.scala GpuHiveHash)."""
+
+    OUT = T.INT
+
+    def __init__(self, *children):
+        # hive hash has no seed parameter
+        super().__init__(*children, seed=0)
+
+    def with_children(self, children):
+        return HiveHash(*children)
+
+    def eval(self, ctx: EvalContext):
+        cols = self._device_cols(ctx)
+        h = HK.hive_hash(cols,
+                         string_max_bytes=max(ctx.string_bucket, 4) or 64)
+        return make_column(h, ctx.live_mask(), T.INT)
+
+    def _py_row(self, vals, dts):
+        return HK.py_hive_hash_row(vals, dts)
+
+    def __repr__(self):
+        return f"hive_hash({', '.join(map(repr, self.children))})"
+
+
+def hive_hash(*cols):
+    from spark_rapids_tpu.expressions.core import col as _col
+    return HiveHash(*[_col(c) if isinstance(c, str) else c for c in cols])

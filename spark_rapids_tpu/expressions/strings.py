@@ -1114,3 +1114,188 @@ class GetJsonObject(UnaryExpression):
 
     def __repr__(self):
         return f"get_json_object({self.child!r}, {self.path!r})"
+
+
+class ParseUrl(Expression):
+    """parse_url(url, part[, key]) — java.net.URI-compatible extraction
+    (reference org/apache/spark/sql/rapids/GpuParseUrl.scala).
+
+    Runs through the expression-level CPU bridge in project/filter
+    positions (the reference likewise falls back for several parts);
+    semantics follow Spark: invalid URLs yield NULL, QUERY with a key
+    returns that key's value."""
+
+    PARTS = ("HOST", "PATH", "QUERY", "REF", "PROTOCOL", "FILE",
+             "AUTHORITY", "USERINFO")
+
+    def __init__(self, child: Expression, part: str,
+                 key: "Expression" = None):
+        self.children = (child,) if key is None else (child, key)
+        self.part = part.upper()
+        assert self.part in self.PARTS, part
+
+    def with_children(self, children):
+        return ParseUrl(children[0], self.part,
+                        children[1] if len(children) > 1 else None)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        import re as _re
+        from urllib.parse import urlparse
+
+        v, m = self.children[0].eval_cpu(ctx)
+        key = None
+        if len(self.children) > 1:
+            kv, km = self.children[1].eval_cpu(ctx)
+        n = len(v)
+        out = np.empty((n,), object)
+        out[:] = [None] * n
+        ok = np.zeros((n,), np.bool_)
+        for i in range(n):
+            if not m[i] or v[i] is None:
+                continue
+            try:
+                u = urlparse(str(v[i]))
+            except ValueError:
+                continue
+            part = self.part
+            val = None
+            if part == "PROTOCOL":
+                val = u.scheme or None
+            elif part == "HOST":
+                val = u.hostname
+            elif part == "PATH":
+                val = u.path if u.scheme else None
+            elif part == "QUERY":
+                q = u.query or None
+                if q is not None and len(self.children) > 1:
+                    if not km[i] or kv[i] is None:
+                        q = None
+                    else:
+                        mt = _re.search(
+                            rf"(?:^|&){_re.escape(str(kv[i]))}=([^&]*)", q)
+                        q = mt.group(1) if mt else None
+                val = q
+            elif part == "REF":
+                val = u.fragment or None
+            elif part == "FILE":
+                val = (u.path + ("?" + u.query if u.query else "")
+                       if u.scheme else None)
+            elif part == "AUTHORITY":
+                val = u.netloc or None
+            elif part == "USERINFO":
+                val = (u.username
+                       + (":" + u.password if u.password else "")
+                       if u.username else None)
+            if val is not None:
+                out[i] = val
+                ok[i] = True
+        return out, ok
+
+    def __repr__(self):
+        extra = f", {self.children[1]!r}" if len(self.children) > 1 else ""
+        return f"parse_url({self.children[0]!r}, {self.part!r}{extra})"
+
+
+class Conv(Expression):
+    """conv(num, from_base, to_base) — Spark's NumberConverter (reference
+    org/apache/spark/sql/rapids/stringFunctions GpuConv).  Bases 2..36;
+    negative results follow Spark's unsigned-64 wrap semantics.  CPU
+    bridge execution."""
+
+    def __init__(self, child: Expression, from_base: int, to_base: int):
+        self.children = (child,)
+        self.from_base = int(from_base)
+        self.to_base = int(to_base)
+
+    def with_children(self, children):
+        return Conv(children[0], self.from_base, self.to_base)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+        fb, tb = abs(self.from_base), abs(self.to_base)
+        v, m = self.children[0].eval_cpu(ctx)
+        n = len(v)
+        out = np.empty((n,), object)
+        out[:] = [None] * n
+        ok = np.zeros((n,), np.bool_)
+        if not (2 <= fb <= 36 and 2 <= tb <= 36):
+            return out, ok
+        for i in range(n):
+            if not m[i] or v[i] is None:
+                continue
+            s = str(v[i]).strip()
+            neg = s.startswith("-")
+            if neg:
+                s = s[1:]
+            # longest valid prefix (Spark parses greedily, NULL if none);
+            # magnitude overflow SATURATES to unsigned-64 max (Spark's
+            # NumberConverter.encode overflow rule)
+            U64_MAX = (1 << 64) - 1
+            val = 0
+            seen = False
+            for ch in s:
+                d = digits.find(ch.upper())
+                if d < 0 or d >= fb:
+                    break
+                val = val * fb + d
+                if val > U64_MAX:
+                    val = U64_MAX
+                seen = True
+            if not seen:
+                continue
+            if neg:
+                # negative input: two's-complement wrap into u64 space
+                val = (U64_MAX + 1 - val) & U64_MAX if val else 0
+            if self.to_base < 0:
+                # signed result: reinterpret the u64 as two's complement
+                if val >= 1 << 63:
+                    sval = val - (1 << 64)
+                    sign = "-"
+                    val = -sval
+                else:
+                    sign = ""
+            else:
+                sign = ""
+            if val == 0:
+                out[i] = "0"
+                ok[i] = True
+                continue
+            buf = []
+            while val:
+                buf.append(digits[val % tb])
+                val //= tb
+            out[i] = sign + "".join(reversed(buf))
+            ok[i] = True
+        return out, ok
+
+    def __repr__(self):
+        return f"conv({self.children[0]!r}, {self.from_base}, {self.to_base})"
+
+
+def parse_url(e, part: str, key=None):
+    from spark_rapids_tpu.expressions.core import Literal
+    from spark_rapids_tpu.expressions.core import col as _col
+    e = _col(e) if isinstance(e, str) else e
+    k = Literal(key) if isinstance(key, str) else key
+    return ParseUrl(e, part, k)
+
+
+def conv(e, from_base: int, to_base: int):
+    from spark_rapids_tpu.expressions.core import col as _col
+    return Conv(_col(e) if isinstance(e, str) else e, from_base, to_base)

@@ -59,6 +59,8 @@ def iter_parquet_arrow(
     columns: Optional[Sequence[str]] = None,
     batch_size_rows: int = 1 << 20,
     range_filters: Optional[dict] = None,
+    batch_size_bytes: int = 0,
+    coalesce_ranges: bool = False,
 ) -> Iterator[pa.Table]:
     """HOST side of the scan: footer parse, row-group pruning, page decode
     to Arrow tables — safe to run on the reader pool with no semaphore.
@@ -66,6 +68,12 @@ def iter_parquet_arrow(
     range_filters: {column: (lo, hi)} predicate-pushdown hints used for
     row-group pruning only (exact filtering stays in the Filter exec —
     same contract as the reference's footer filter).
+
+    batch_size_bytes > 0 bounds decoded bytes per batch (the CHUNKED
+    reader, GpuParquetScan.scala:2523): rows-per-batch derives from the
+    file's own rows/bytes ratio so a scan's device footprint is
+    independent of file size.  coalesce_ranges reads the pruned column
+    chunks as few merged I/O requests (io/rangeio.py).
     """
     pf = pq.ParquetFile(path)
     groups: List[int] = []
@@ -85,7 +93,18 @@ def iter_parquet_arrow(
             groups.append(rg)
     if not groups:
         return
-    for record_batch in pf.iter_batches(batch_size=batch_size_rows,
+    rows_per_batch = batch_size_rows
+    if batch_size_bytes > 0 and meta.num_rows:
+        total_bytes = sum(meta.row_group(rg).total_byte_size
+                          for rg in range(meta.num_row_groups))
+        bytes_per_row = max(total_bytes / max(meta.num_rows, 1), 1.0)
+        rows_per_batch = max(min(
+            batch_size_rows, int(batch_size_bytes / bytes_per_row)), 1)
+    if coalesce_ranges:
+        from spark_rapids_tpu.io.rangeio import open_coalesced_parquet
+        src, _ = open_coalesced_parquet(path, groups, columns)
+        pf = pq.ParquetFile(src)
+    for record_batch in pf.iter_batches(batch_size=rows_per_batch,
                                         row_groups=groups,
                                         columns=list(columns) if columns else None):
         yield pa.Table.from_batches([record_batch])

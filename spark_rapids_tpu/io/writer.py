@@ -245,19 +245,46 @@ def write_dataframe(df, path: str, fmt: str = "parquet",
     protocol.setup_job()
     schema = df.schema
     writers: List[PartitionedWriter] = []
+    def task(task_id, batches):
+        w = PartitionedWriter(protocol, task_id, schema, partition_by, fmt)
+        writers.append(w)
+        for b in batches:
+            w.write_batch(b)
+        w.close()
+
+    throttle = None
     try:
         batches_by_part = df.collect_partitions()
-        for task_id, batches in enumerate(batches_by_part):
-            w = PartitionedWriter(protocol, task_id, schema, partition_by,
-                                  fmt)
-            writers.append(w)
-            for b in batches:
-                w.write_batch(b)
-            w.close()
+        budget = df.session.conf.async_write_max_inflight
+        if budget > 0:
+            # write-behind: each task's encode/write runs on the throttled
+            # pool behind the device loop (AsyncOutputStream +
+            # ThrottlingExecutor shape); per-task work stays serialized by
+            # running a whole task per submit
+            from spark_rapids_tpu.io.async_writer import ThrottlingExecutor
+            throttle = ThrottlingExecutor(budget)
+            for task_id, batches in enumerate(batches_by_part):
+                nbytes = sum(b.device_size_bytes() for b in batches)
+                throttle.submit(nbytes, lambda t=task_id, bs=batches:
+                                task(t, bs))
+            throttle.wait()
+        else:
+            for task_id, batches in enumerate(batches_by_part):
+                task(task_id, batches)
         protocol.commit_job()
     except BaseException:
+        if throttle is not None:
+            # drain in-flight tasks BEFORE aborting: rmtree racing live
+            # writers would orphan files / mask the real error
+            try:
+                throttle.wait()
+            except BaseException:
+                pass
         protocol.abort_job()
         raise
+    finally:
+        if throttle is not None:
+            throttle.shutdown()
     out = []
     for w in writers:
         out.extend(w.files_written)

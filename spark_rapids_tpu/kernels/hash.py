@@ -648,3 +648,87 @@ def py_xxhash64_row(values, dtypes, seed: int = XXHASH64_DEFAULT_SEED) -> int:
             raise NotImplementedError(f"py xxhash64 for {dt!r}")
     res = h & _M64
     return res - (1 << 64) if res >= (1 << 63) else res
+
+
+# ---------------------------------------------------------------------------
+# Hive hash (Spark HiveHash expression semantics — the bucketing hash for
+# Hive-compatible writes).  Reference: HashFunctions.scala GpuHiveHash.
+# Per column: int-family = int value; long = (v ^ (v >>> 32)) low word;
+# boolean = 1/0; float = floatToIntBits; double = doubleToLongBits folded
+# like long; string = polynomial 31-hash over UTF-8 bytes; date = days.
+# Rows chain h = h * 31 + col_hash, null contributes 0.
+# ---------------------------------------------------------------------------
+
+def _hive_col_hash(col: DeviceColumn, string_max_bytes: int) -> jax.Array:
+    dt = col.dtype
+    if col.is_string_like:
+        max_bytes = max(string_max_bytes, 1)
+        starts = col.offsets[:-1]
+        lengths = col.offsets[1:] - starts
+        h = jnp.zeros((col.capacity,), jnp.int32)
+        for i in range(max_bytes):
+            idx = jnp.clip(starts + i, 0, col.data.shape[0] - 1)
+            b = col.data[idx].astype(jnp.int8).astype(jnp.int32)
+            h = jnp.where(i < lengths, h * jnp.int32(31) + b, h)
+    elif isinstance(dt, T.BooleanType):
+        h = col.data.astype(jnp.int32)
+    elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        h = col.data.astype(jnp.int32)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        v = col.data.astype(jnp.int64)
+        h = (v ^ ((v >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF))).astype(jnp.int32)
+    elif isinstance(dt, T.FloatType):
+        h = _f32_bits(col.data).astype(jnp.int32)
+    elif isinstance(dt, T.DoubleType):
+        v = _f64_bits(col.data).astype(jnp.int64)
+        h = (v ^ ((v >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF))).astype(jnp.int32)
+    else:
+        raise NotImplementedError(f"hive hash for {dt!r}")
+    return jnp.where(col.validity, h, jnp.int32(0))
+
+
+def hive_hash(columns: Sequence[DeviceColumn],
+              string_max_bytes: int = 64) -> jax.Array:
+    cap = columns[0].capacity
+    h = jnp.zeros((cap,), jnp.int32)
+    for col in columns:
+        h = h * jnp.int32(31) + _hive_col_hash(col, string_max_bytes)
+    return h
+
+
+def py_hive_hash_row(values, dtypes) -> int:
+    """Reference row hash over python values (Hive semantics)."""
+    import struct as _struct
+
+    def i32(x):
+        x &= 0xFFFFFFFF
+        return x - (1 << 32) if x >= (1 << 31) else x
+
+    h = 0
+    for v, dt in zip(values, dtypes):
+        if v is None:
+            ch = 0
+        elif isinstance(dt, T.BooleanType):
+            ch = 1 if v else 0
+        elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType,
+                             T.DateType)):
+            ch = int(v)
+        elif isinstance(dt, (T.LongType, T.TimestampType)):
+            u = int(v) & ((1 << 64) - 1)
+            ch = i32(u ^ (u >> 32))
+        elif isinstance(dt, T.FloatType):
+            f = 0.0 if v == 0.0 else float(np.float32(v))
+            ch = i32(_struct.unpack("<I", _struct.pack("<f", f))[0])
+        elif isinstance(dt, T.DoubleType):
+            d = 0.0 if v == 0.0 else float(v)
+            u = _struct.unpack("<Q", _struct.pack("<d", d))[0]
+            ch = i32(u ^ (u >> 32))
+        elif isinstance(dt, T.StringType):
+            ch = 0
+            for b in (v.encode("utf-8") if isinstance(v, str) else v):
+                sb = b - 256 if b >= 128 else b
+                ch = i32(ch * 31 + sb)
+        else:
+            raise NotImplementedError(f"py hive hash for {dt!r}")
+        h = i32(h * 31 + ch)
+    return h

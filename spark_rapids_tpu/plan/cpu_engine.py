@@ -24,6 +24,7 @@ from spark_rapids_tpu.expressions.core import (
     cpu_zero_invalid,
 )
 from spark_rapids_tpu.expressions.aggregates import (
+    COLLECT,
     COUNT_STAR,
     COUNT_VALID,
     MAX,
@@ -323,8 +324,10 @@ class CpuEngine:
                     continue
                 two_limb = (isinstance(slot.dtype, T.DecimalType)
                             and slot.dtype.uses_two_limbs)
+                holistic = slot.update_op == COLLECT
                 bv = np.zeros((n_groups,),
-                              object if two_limb else slot.dtype.np_dtype)
+                              object if two_limb or holistic
+                              else slot.dtype.np_dtype)
                 bm = np.ones((n_groups,), np.bool_)
                 for gi, k in enumerate(order):
                     idx = np.array(groups[k], dtype=np.int64)
@@ -335,6 +338,8 @@ class CpuEngine:
                     sel = idx[valid[idx]] if len(idx) else idx
                     if slot.update_op == COUNT_VALID:
                         bv[gi] = len(sel)
+                    elif slot.update_op == COLLECT:
+                        bv[gi] = [float(x) for x in vals[sel]]
                     elif len(sel) == 0:
                         bv[gi] = 0
                         if two_limb:

@@ -29,6 +29,8 @@ from spark_rapids_tpu.expressions.core import (
     Expression,
 )
 from spark_rapids_tpu.expressions.aggregates import (
+    COLLECT,
+    COLLECT_MERGE,
     COUNT_STAR,
     COUNT_VALID,
     HLL_MERGE,
@@ -133,6 +135,78 @@ def _global_sum128(col: DeviceColumn, count_col: Optional[DeviceColumn],
     out_valid = out_valid & ~DK.overflow(h, l, out_dtype.precision)
     return DK.make_column128(jnp.reshape(h, (1,)), jnp.reshape(l, (1,)),
                              jnp.reshape(out_valid, (1,)), out_dtype)
+
+
+def _collect_update(col: DeviceColumn, layout: Optional[G.GroupedLayout],
+                    live, num_groups) -> DeviceColumn:
+    """COLLECT buffer update: the group's valid values as one array row
+    (values already contiguous per group in the sorted layout; stable
+    compaction preserves that grouping)."""
+    from spark_rapids_tpu.kernels.selection import compaction_map
+    cap = col.capacity
+    valid = col.validity & live
+    idx, total = compaction_map(valid)
+    ecap = cap
+    vals = col.data.astype(jnp.float64)[jnp.clip(idx, 0, cap - 1)]
+    epos = jnp.arange(ecap, dtype=jnp.int32)
+    cvalid = epos < total
+    data = jnp.where(cvalid, vals, 0.0)
+    if layout is None:
+        offsets = jnp.minimum(
+            jnp.arange(cap + 1, dtype=jnp.int32), 1) * total
+        validity = jnp.arange(cap, dtype=jnp.int32) < 1
+        ng = 1
+    else:
+        counts = jax.ops.segment_sum(valid.astype(jnp.int32),
+                                     layout.segment_ids, num_segments=cap)
+        csum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(counts).astype(jnp.int32)])
+        gidx = jnp.minimum(jnp.arange(cap + 1, dtype=jnp.int32), num_groups)
+        offsets = csum[gidx]
+        validity = jnp.arange(cap, dtype=jnp.int32) < num_groups
+    return DeviceColumn(data, validity,
+                        T.ArrayType(T.DoubleType(), contains_null=False),
+                        offsets, cvalid)
+
+
+def _collect_merge(col: DeviceColumn, layout: Optional[G.GroupedLayout],
+                   live, num_groups) -> DeviceColumn:
+    """COLLECT merge: concatenate partial array rows per group.  Entries
+    of the key-sorted rows are already in segment order; compact away
+    entries of dead rows and rebuild offsets from per-group entry sums."""
+    from spark_rapids_tpu.kernels.collections import (
+        element_live_mask, element_row_ids)
+    cap = col.capacity
+    ecap = col.byte_capacity
+    row_valid = col.validity & live
+    lengths = col.offsets[1:] - col.offsets[:-1]
+    keep_len = jnp.where(row_valid, lengths, 0)
+    erows = element_row_ids(col)
+    nrows = jnp.sum(live.astype(jnp.int32))
+    elive = element_live_mask(col, nrows) & row_valid[erows] \
+        & (col.child_validity
+           if col.child_validity is not None
+           else jnp.ones((ecap,), jnp.bool_))
+    from spark_rapids_tpu.kernels.selection import compaction_map
+    eidx, etotal = compaction_map(elive)
+    data = jnp.where(jnp.arange(ecap, dtype=jnp.int32) < etotal,
+                     col.data[jnp.clip(eidx, 0, ecap - 1)], 0.0)
+    cvalid = jnp.arange(ecap, dtype=jnp.int32) < etotal
+    if layout is None:
+        offsets = jnp.minimum(
+            jnp.arange(cap + 1, dtype=jnp.int32), 1) * etotal
+        validity = jnp.arange(cap, dtype=jnp.int32) < 1
+    else:
+        gcounts = jax.ops.segment_sum(keep_len.astype(jnp.int32),
+                                      layout.segment_ids, num_segments=cap)
+        csum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(gcounts).astype(jnp.int32)])
+        gidx = jnp.minimum(jnp.arange(cap + 1, dtype=jnp.int32), num_groups)
+        offsets = csum[gidx]
+        validity = jnp.arange(cap, dtype=jnp.int32) < num_groups
+    return DeviceColumn(data, validity,
+                        T.ArrayType(T.DoubleType(), contains_null=False),
+                        offsets, cvalid)
 
 
 def _hll_array_col(regs2d, num_groups, cap: int, m: int) -> DeviceColumn:
@@ -297,6 +371,9 @@ class _AggDeviceSpec:
                 if slot.update_op == SUM128:
                     cols.append(_global_sum128(col, None, live, slot.dtype))
                     continue
+                if slot.update_op == COLLECT:
+                    cols.append(_collect_update(col, None, live, 1))
+                    continue
                 v, valid = _global_update(slot.update_op, col, live, slot.dtype)
                 data = jnp.where(valid, v, jnp.zeros((), v.dtype))
                 cols.append(DeviceColumn(
@@ -331,6 +408,11 @@ class _AggDeviceSpec:
             if slot.update_op == SUM128:
                 cols.append(_seg_sum128(col, None, layout, slot.dtype))
                 continue
+            if slot.update_op == COLLECT:
+                live2 = layout.sorted_batch.live_mask()
+                cols.append(_collect_update(col, layout, live2,
+                                            layout.num_groups))
+                continue
             v, valid = _seg_update(slot.update_op, col, layout, slot.dtype)
             cols.append(G.finalize_agg_column(
                 v.astype(slot.dtype.jnp_dtype), valid, layout.num_groups,
@@ -358,6 +440,9 @@ class _AggDeviceSpec:
                 if slot.merge_op == SUM128:
                     ncol = partial.columns[nkeys + self._count_companion(ai)]
                     cols.append(_global_sum128(col, ncol, live, slot.dtype))
+                    continue
+                if slot.merge_op == COLLECT_MERGE:
+                    cols.append(_collect_merge(col, None, live, 1))
                     continue
                 if slot.merge_op == M2_MERGE:
                     s_si, n_si = self._m2_companions(ai)
@@ -396,6 +481,11 @@ class _AggDeviceSpec:
                     nkeys + self._count_companion(ai)]
                 cols.append(_seg_sum128(col, ncol, layout, slot.dtype))
                 continue
+            if slot.merge_op == COLLECT_MERGE:
+                live2 = layout.sorted_batch.live_mask()
+                cols.append(_collect_merge(col, layout, live2,
+                                           layout.num_groups))
+                continue
             if slot.merge_op == M2_MERGE:
                 s_si, n_si = self._m2_companions(ai)
                 v, valid = G.seg_m2_merge(
@@ -417,11 +507,11 @@ class _AggDeviceSpec:
             bufs = []
             for slot in agg.buffers:
                 c = merged.columns[nkeys + si]
-                if c.is_array:
+                if slot.update_op == HLL_UPDATE:
                     bufs.append((_hll_regs2d(c, merged.capacity, agg.m),
                                  c.validity))
-                elif c.children is not None:
-                    bufs.append((c, c.validity))   # two-limb decimal column
+                elif slot.update_op == COLLECT or c.children is not None:
+                    bufs.append((c, c.validity))   # holistic/limb columns
                 else:
                     bufs.append((c.data, c.validity))
                 si += 1
@@ -500,6 +590,14 @@ class TpuHashAggregateExec(TpuExec):
         (Spark: global agg over empty input yields one row)."""
         cols = []
         for ai, slot in self.slot_specs:
+            from spark_rapids_tpu import types as TT
+            if isinstance(slot.dtype, (TT.ArrayType, TT.StructType,
+                                       TT.MapType)) or (
+                    isinstance(slot.dtype, TT.DecimalType)
+                    and slot.dtype.uses_two_limbs):
+                cols.append(DeviceColumn.empty(slot.dtype, 1,
+                                               byte_capacity=1))
+                continue
             data = jnp.zeros((1,), slot.dtype.jnp_dtype)
             valid = jnp.zeros((1,), jnp.bool_)
             if slot.update_op == COUNT_STAR or slot.update_op == COUNT_VALID:

@@ -111,7 +111,11 @@ class TpuParquetScanExec(_PooledScanExec):
                 path, columns=cols, batch_size_rows=self.batch_size_rows)
         from spark_rapids_tpu.io.parquet import iter_parquet_arrow
         return iter_parquet_arrow(
-            path, columns=cols, batch_size_rows=self.batch_size_rows)
+            path, columns=cols, batch_size_rows=self.batch_size_rows,
+            batch_size_bytes=(self.conf.reader_batch_size_bytes
+                              if self.conf is not None else 0),
+            coalesce_ranges=(self.conf is not None
+                             and self.conf.parquet_coalesce_ranges))
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         if idx >= len(self.paths):

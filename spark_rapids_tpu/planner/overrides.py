@@ -162,8 +162,10 @@ from spark_rapids_tpu.expressions.hashing import (
     BloomFilterMightContain, Murmur3Hash, XxHash64)
 from spark_rapids_tpu.expressions.strings import GetJsonObject
 
+from spark_rapids_tpu.expressions.hashing import HiveHash
+
 _SUPPORTED_EXPRS |= {Murmur3Hash, XxHash64, BloomFilterMightContain,
-                     GetJsonObject}
+                     GetJsonObject, HiveHash, A.Percentile}
 
 # dtypes device kernels support in expression compute
 _COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,

@@ -271,6 +271,7 @@ def _build_registry() -> None:
 
     # hashing / sketches
     register(H.Murmur3Hash, ExprSig(TypeSig("int"), ORDERED))
+    register(H.HiveHash, ExprSig(TypeSig("int"), ORDERED))
     register(H.XxHash64, ExprSig(TypeSig("long"), ORDERED))
     register(H.BloomFilterMightContain, ExprSig(BOOL, TypeSig("long")))
 
@@ -289,6 +290,9 @@ def _build_registry() -> None:
                      note="long-representable inputs; strings fall back"))
     for cls in (A.BoolAnd, A.BoolOr):
         register(cls, ExprSig(BOOL, BOOL))
+    register(A.Percentile, ExprSig(TypeSig("double"), NUMERIC,
+                                   note="exact percentile via sorted "
+                                   "group arrays"))
 
     # window functions
     for cls in (W.RowNumber, W.Rank, W.DenseRank):

@@ -122,3 +122,34 @@ def test_approx_count_distinct_accuracy_wide():
             Alias(approx_count_distinct(col("id")), "a"))
     rows = assert_tpu_cpu_equal(q)
     assert abs(rows[0][0] - 20_000) < 0.15 * 20_000, rows
+
+
+def test_hive_hash_differential():
+    """hive_hash over mixed types: device vs python-oracle row hash
+    (HashFunctions.scala GpuHiveHash)."""
+    from spark_rapids_tpu.expressions import hive_hash
+
+    def q(s):
+        return _df(s).select(
+            Alias(hive_hash(col("i"), col("l"), col("d"), col("s")), "h"),
+            Alias(col("i"), "i"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_percentile_exact():
+    """Exact percentile: grouped + global, through the two-phase plan
+    (collect-buffer shuffle), vs numpy linear interpolation."""
+    import numpy as np
+
+    from spark_rapids_tpu.expressions import count, percentile
+
+    def q(s):
+        return _df(s).group_by("g").agg(
+            Alias(percentile(col("l"), 0.5), "p50"),
+            Alias(percentile(col("d"), 0.95), "p95"),
+            Alias(count(), "n"))
+    rows = assert_tpu_cpu_equal(q)
+    assert len(rows) == 4
+    assert_tpu_cpu_equal(lambda s: _df(s).agg(
+        Alias(percentile(col("l"), 0.0), "mn"),
+        Alias(percentile(col("l"), 1.0), "mx")))

@@ -1,0 +1,127 @@
+"""IO pipeline depth: chunked scans, range-coalesced reads, async writes.
+
+Reference: GpuParquetScan.scala:2523 (chunked reader), S3InputFile
+readVectored (range coalescing), io/async/AsyncOutputStream.scala +
+ThrottlingExecutor.scala (write-behind with backpressure).
+"""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.expressions import col, count, lit, sum_
+from spark_rapids_tpu.expressions.core import Alias
+from tests.test_queries import assert_tpu_cpu_equal
+
+
+@pytest.fixture(scope="module")
+def big_parquet(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("iodepth") / "big.parquet")
+    rng = np.random.RandomState(2)
+    n = 200_000
+    t = pa.table({
+        "k": rng.randint(0, 50, n).astype(np.int32),
+        "v": rng.randint(-10**9, 10**9, n).astype(np.int64),
+        "x": rng.randn(n),
+        "s": pa.array([f"row{i % 991}" for i in range(n)]),
+    })
+    pq.write_table(t, path, row_group_size=10_000)
+    return path
+
+
+def test_chunked_scan_bounds_batch_bytes(big_parquet):
+    """batchSizeBytes caps decoded bytes per batch: the scan of a file
+    many times the budget streams in small batches instead of one upload."""
+    s = TpuSession({"spark.rapids.sql.enabled": "true",
+                    "spark.rapids.sql.reader.batchSizeBytes": str(64 << 10)})
+    parts = s.read_parquet(big_parquet).collect_partitions()
+    batches = [b for p in parts for b in p]
+    assert len(batches) > 20, len(batches)    # forced into many chunks
+    assert max(b.device_size_bytes() for b in batches) < (4 << 20)
+    total = sum(b.host_num_rows() for b in batches)
+    assert total == 200_000
+
+
+@pytest.mark.inject_oom
+def test_chunked_scan_differential_with_oom(big_parquet):
+    def q(s):
+        s.set_conf("spark.rapids.sql.reader.batchSizeBytes", str(256 << 10))
+        return s.read_parquet(big_parquet).group_by("k").agg(
+            Alias(sum_(col("v")), "sv"), Alias(count(), "n"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_range_coalescing_plan():
+    from spark_rapids_tpu.io.rangeio import coalesce_ranges
+    ranges = [(0, 100), (150, 100), (10_000_000, 50), (300, 50)]
+    merged = coalesce_ranges(ranges, gap_bytes=1000)
+    assert merged == [(0, 350), (10_000_000, 50)]
+    # budget cap splits oversized merges
+    merged = coalesce_ranges([(0, 60 << 20), (61 << 20, 60 << 20)],
+                             gap_bytes=2 << 20, max_merged_bytes=64 << 20)
+    assert len(merged) == 2
+
+
+def test_range_coalesced_parquet_scan(big_parquet):
+    """The coalesced source must cut request count far below the
+    column-chunk count while decoding identical data."""
+    from spark_rapids_tpu.io.rangeio import (
+        ReadCounter, open_coalesced_parquet, plan_parquet_ranges)
+    meta = pq.ParquetFile(big_parquet).metadata
+    groups = list(range(meta.num_row_groups))
+    n_chunks = len(plan_parquet_ranges(meta, groups))
+    assert n_chunks == meta.num_row_groups * 4
+    src, counter = open_coalesced_parquet(big_parquet, groups)
+    t = pq.ParquetFile(src).read()
+    assert t.num_rows == 200_000
+    assert t.equals(pq.read_table(big_parquet))
+    # 2 footer requests + merged data requests << per-chunk requests
+    assert counter.requests < n_chunks / 4, (counter.requests, n_chunks)
+
+
+def test_coalesced_scan_differential(big_parquet):
+    def q(s):
+        s.set_conf(
+            "spark.rapids.sql.format.parquet.rangeCoalescing.enabled",
+            "true")
+        return s.read_parquet(big_parquet).group_by("k").agg(
+            Alias(count(), "n"), Alias(sum_(col("v")), "sv"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_async_write_throttling_and_correctness(tmp_path):
+    """Write-behind with a tiny byte budget must backpressure, not buffer
+    unboundedly, and produce the same files as the sync path."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+    sch = Schema.of(k=T.INT, v=T.LONG)
+    data = {"k": [i % 4 for i in range(5000)], "v": list(range(5000))}
+
+    outs = {}
+    for label, budget in (("sync", 0), ("async", 1 << 12)):
+        s = TpuSession({
+            "spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.asyncWrite.maxInFlightBytes": str(budget)})
+        d = s.create_dataframe(data, sch, num_partitions=4)
+        p = str(tmp_path / label)
+        d.write(p, fmt="parquet", partition_by=("k",))
+        read = pq.ParquetDataset(p).read()
+        outs[label] = sorted(zip(read.column("v").to_pylist(),), key=repr)
+        assert os.path.exists(os.path.join(p, "_SUCCESS"))
+    assert outs["sync"] == outs["async"]
+    assert len(outs["async"]) == 5000
+
+
+def test_throttling_executor_error_propagates():
+    from spark_rapids_tpu.io.async_writer import ThrottlingExecutor
+    ex = ThrottlingExecutor(1 << 20)
+
+    def boom():
+        raise RuntimeError("sink failed")
+    ex.submit(100, boom)
+    with pytest.raises(RuntimeError, match="sink failed"):
+        ex.wait()
+    ex.shutdown()

@@ -146,3 +146,92 @@ def test_multiprocess_cross_process_fetch():
         for p in procs:
             p.terminate()
         driver.close()
+
+
+def _mp_worker(driver_addr, worker_id, lo, hi, out_q, done_ev):
+    """One executor process: write map output for shuffle 1 (hash-sliced
+    by k), then read its assigned reduce partition from ALL peers and
+    report the partition's (k, sum(v)) groups."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import collections
+
+    from spark_rapids_tpu.kernels.hash import py_murmur3_row
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.shuffle.net import (
+        ShuffleExecutor, TcpShuffleTransport)
+    try:
+        ex = ShuffleExecutor(worker_id, driver_addr=tuple(driver_addr))
+        transport = TcpShuffleTransport(
+            ex, num_partitions=2, schema=SCHEMA, shuffle_id=1,
+            participants=["wA", "wB"], completeness_timeout_s=60.0)
+        # map side: slice local rows by murmur3(k) pmod 2 (Spark routing)
+        rows = [(i % 5, i, f"s{i}") for i in range(lo, hi)]
+        pieces = []
+        for p in range(2):
+            mine = [r for r in rows
+                    if py_murmur3_row([r[0]], [T.INT]) % 2 == p]
+            if mine:
+                pieces.append((p, ColumnarBatch.from_pydict(
+                    {"k": [r[0] for r in mine], "v": [r[1] for r in mine],
+                     "s": [r[2] for r in mine]}, SCHEMA)))
+        transport.write(iter(pieces))
+        # reduce side: wA owns partition 0, wB partition 1
+        part = 0 if worker_id == "wA" else 1
+        batches = transport.read(part)
+        agg = collections.defaultdict(int)
+        for b in batches:
+            d = b.to_pydict()
+            for k, v in zip(d["k"], d["v"]):
+                agg[k] += v
+        out_q.put((worker_id, part, dict(agg)))
+        # keep serving blocks until every reader is done (a worker exit
+        # kills its block server mid-fetch otherwise)
+        done_ev.wait(timeout=120)
+    except Exception as e:                     # surface child failures
+        out_q.put((worker_id, "error", repr(e)))
+
+
+def test_multiprocess_engine_shuffle_differential():
+    """The VERDICT r2 #9 demo: a driver registry + two real worker
+    processes run the map AND reduce sides of one exchange over the TCP
+    data plane (kudo blocks cross process boundaries), and the combined
+    reduce output must equal the single-process answer."""
+    import collections
+
+    from spark_rapids_tpu.kernels.hash import py_murmur3_row
+    from spark_rapids_tpu import types as T
+    ctx = mp.get_context("spawn")
+    driver = ShuffleExecutor("driver", serve_registry=True, role="driver")
+    q = ctx.Queue()
+    done_ev = ctx.Event()
+    procs = []
+    try:
+        for wid, (lo, hi) in (("wA", (0, 120)), ("wB", (120, 300))):
+            p = ctx.Process(target=_mp_worker,
+                            args=(driver.server.addr, wid, lo, hi, q,
+                                  done_ev),
+                            daemon=True)
+            p.start()
+            procs.append(p)
+        results = {}
+        for _ in range(2):
+            wid, part, agg = q.get(timeout=180)
+            assert part != "error", (wid, agg)
+            results[part] = agg
+        # oracle: group all rows in-process, split by the same routing
+        expect = {0: collections.defaultdict(int),
+                  1: collections.defaultdict(int)}
+        for i in range(300):
+            k = i % 5
+            expect[py_murmur3_row([k], [T.INT]) % 2][k] += i
+        assert results[0] == dict(expect[0]), (results[0], dict(expect[0]))
+        assert results[1] == dict(expect[1]), (results[1], dict(expect[1]))
+        done_ev.set()
+    finally:
+        done_ev.set()
+        for p in procs:
+            p.join(timeout=10)
+            p.terminate()
+        driver.close()

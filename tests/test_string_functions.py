@@ -13,6 +13,7 @@ from spark_rapids_tpu.expressions import (
     Ascii, ConcatWs, InitCap, Lpad, LTrim, Reverse, Rpad, StringInstr,
     StringLocate, StringRepeat, StringReplace, RTrim, col,
 )
+from spark_rapids_tpu.expressions.core import Alias
 
 from test_queries import assert_tpu_cpu_equal
 
@@ -77,3 +78,47 @@ def test_string_fns_run_on_tpu():
     e = _src(s).select(StringReplace(col("s"), "a", "b").alias("r"),
                        Reverse(col("s")).alias("v")).explain()
     assert "will NOT" not in e, e
+
+
+def test_parse_url_parts():
+    """parse_url via the CPU bridge: HOST/PROTOCOL/PATH/QUERY(+key)/REF
+    (GpuParseUrl.scala semantics: invalid URLs -> NULL)."""
+    from spark_rapids_tpu.expressions import parse_url
+
+    urls = ["https://u:p@spark.apache.org:8080/a/b?x=1&y=2#f",
+            "http://example.com/only", None, "ftp://h/q?k=v",
+            "no-scheme-here", "https://host"]
+
+    def q(s):
+        d = s.create_dataframe({"u": urls}, Schema.of(u=T.STRING))
+        return d.select(
+            Alias(parse_url(col("u"), "HOST"), "h"),
+            Alias(parse_url(col("u"), "PROTOCOL"), "p"),
+            Alias(parse_url(col("u"), "PATH"), "pa"),
+            Alias(parse_url(col("u"), "QUERY"), "q"),
+            Alias(parse_url(col("u"), "QUERY", "y"), "qy"),
+            Alias(parse_url(col("u"), "REF"), "r"),
+            Alias(parse_url(col("u"), "AUTHORITY"), "au"),
+            Alias(parse_url(col("u"), "USERINFO"), "ui"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0][0] == "spark.apache.org"
+    assert rows[0][4] == "2"
+    assert rows[0][7] == "u:p"
+
+
+def test_conv_number_bases():
+    from spark_rapids_tpu.expressions import conv
+
+    nums = ["101", "-ff", "0", None, "zz", "123abc", "  1a "]
+
+    def q(s):
+        d = s.create_dataframe({"n": nums}, Schema.of(n=T.STRING))
+        return d.select(
+            Alias(conv(col("n"), 16, 10), "hex10"),
+            Alias(conv(col("n"), 2, 16), "bin16"),
+            Alias(conv(col("n"), 36, 10), "b36"),
+            Alias(conv(col("n"), 16, -10), "signed"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0][0] == "257"                     # 0x101
+    assert rows[1][0] == "18446744073709551361"    # -0xff unsigned wrap
+    assert rows[1][3] == "-255"                    # signed target base

@@ -182,3 +182,5 @@ from spark_rapids_tpu.expressions.aggregates import (
 from spark_rapids_tpu.expressions.hashing import HiveHash, hive_hash
 from spark_rapids_tpu.expressions.strings import (
     Conv, ParseUrl, conv, parse_url)
+from spark_rapids_tpu.expressions.window import (
+    CumeDist, FirstValue, LastValue, NthValue, Ntile, PercentRank)

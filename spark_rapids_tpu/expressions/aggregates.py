@@ -213,13 +213,26 @@ class Max(AggregateFunction):
 
 
 class Average(AggregateFunction):
+    """avg: DOUBLE for non-decimal inputs; avg(decimal(p,s)) ->
+    decimal(p+4, s+4) computed exactly over the int128 sum buffer
+    (Spark's Average type rule; the sum buffer is decimal(p+10, s) as in
+    Spark, held two-limb internally)."""
+
     name = "avg"
 
     def __init__(self, child: Expression):
         self.children = (child,)
 
+    def _decimal_in(self):
+        dt = self.input.dtype
+        return dt if isinstance(dt, T.DecimalType) else None
+
     @property
     def dtype(self):
+        d = self._decimal_in()
+        if d is not None:
+            return T.DecimalType(min(d.precision + 4, T.DecimalType.MAX_PRECISION),
+                                 min(d.scale + 4, T.DecimalType.MAX_PRECISION))
         return T.DOUBLE
 
     @property
@@ -228,11 +241,47 @@ class Average(AggregateFunction):
 
     @property
     def buffers(self):
+        d = self._decimal_in()
+        if d is not None:
+            # internal buffer always two-limb so the SUM128 machinery and
+            # wire/concat schemas stay uniform
+            buf = T.DecimalType(
+                min(max(d.precision + 10, T.DecimalType.MAX_LONG_DIGITS + 1),
+                    T.DecimalType.MAX_PRECISION), d.scale)
+            return (BufferSlot(buf, SUM128, SUM128),
+                    BufferSlot(T.LONG, COUNT_VALID, SUM))
         return (BufferSlot(T.DOUBLE, SUM, SUM),
                 BufferSlot(T.LONG, COUNT_VALID, SUM))
 
     def finalize_np(self, bufs):
-        (s, _), (n, _) = bufs
+        (s, s_valid), (n, _) = bufs
+        d = self._decimal_in()
+        if d is not None:
+            out_dt = self.dtype
+            k = 10 ** (out_dt.scale - d.scale)
+            bound = 10 ** out_dt.precision
+            vals = np.empty((len(s),), object)
+            vals[:] = [None] * len(s)
+            ok = np.zeros((len(s),), np.bool_)
+            for i in range(len(s)):
+                if not (n[i] > 0 and s_valid[i]) or s[i] is None:
+                    continue
+                if abs(int(s[i])) >= 10 ** 34:
+                    # scale-up headroom cap (see finalize_jnp)
+                    continue
+                num = int(s[i]) * k
+                cnt = int(n[i])
+                q, r = divmod(abs(num), cnt)
+                q += 1 if 2 * r >= cnt else 0
+                q = -q if num < 0 else q
+                if -bound < q < bound:
+                    vals[i] = q
+                    ok[i] = True
+            if out_dt.uses_two_limbs:
+                return vals, ok
+            out64 = np.array([v if m else 0 for v, m in zip(vals, ok)],
+                             np.int64)
+            return out64, ok
         valid = n > 0
         with np.errstate(all="ignore"):
             vals = s / np.where(valid, n, 1)
@@ -240,6 +289,27 @@ class Average(AggregateFunction):
 
     def finalize_jnp(self, bufs):
         import jax.numpy as jnp
+        d = self._decimal_in()
+        if d is not None:
+            from spark_rapids_tpu.kernels import decimal as DK
+            (scol, s_valid), (n, _) = bufs      # scol: two-limb column
+            out_dt = self.dtype
+            h, l = scol.children[0].data, scol.children[1].data
+            # |sum| must leave 4 digits of headroom for the scale-up to
+            # stay inside int128; beyond that the avg nulls (documented
+            # divergence — only reachable for p >= 24 inputs whose sums
+            # near the decimal(38) bound; Spark's own p+10 sum buffer
+            # overflows to null in the same regime)
+            pre_ov = DK.overflow(h, l, 34)
+            h, l = DK.rescale(h, l, d.scale, out_dt.scale)
+            cnt = jnp.maximum(n.astype(jnp.int64), 1)
+            h, l = DK.div128_small(h, l, cnt, round_half_up=True)
+            valid = ((n > 0) & s_valid & ~pre_ov
+                     & ~DK.overflow(h, l, out_dt.precision))
+            if out_dt.uses_two_limbs:
+                return DK.make_column128(h, l, valid, out_dt), valid
+            v64, fits = DK.narrow64(h, l)
+            return v64, valid & fits
         (s, _), (n, _) = bufs
         valid = n > 0
         vals = s / jnp.where(valid, n, 1).astype(s.dtype)

@@ -195,3 +195,111 @@ def over(function: Expression, partition_by=(), order_by=(),
         else:
             ob.append(o)
     return WindowExpression(function, WindowSpec(pb, ob, frame))
+
+
+class PercentRank(WindowFunction):
+    """(rank - 1) / (partition rows - 1); 0.0 for a single-row partition."""
+
+    name = "percent_rank"
+    children = ()
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return self
+
+
+class CumeDist(WindowFunction):
+    """rows <= current (peers included) / partition rows."""
+
+    name = "cume_dist"
+    children = ()
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return self
+
+
+class Ntile(WindowFunction):
+    """ntile(n): n near-equal buckets, remainder spread to the first ones
+    (Spark NTile semantics)."""
+
+    name = "ntile"
+    children = ()
+
+    def __init__(self, n: int):
+        assert n >= 1, n
+        self.n = int(n)
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return self
+
+    def __repr__(self):
+        return f"ntile({self.n})"
+
+
+class FirstValue(WindowFunction):
+    """first_value(col) over the frame (nulls respected — Spark default)."""
+
+    name = "first_value"
+
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def __repr__(self):
+        return f"{self.name}({self.child!r})"
+
+
+class LastValue(FirstValue):
+    name = "last_value"
+
+
+class NthValue(FirstValue):
+    """nth_value(col, k): k-th row of the frame (1-based), null when the
+    frame has fewer than k rows."""
+
+    name = "nth_value"
+
+    def __init__(self, child: Expression, k: int):
+        assert k >= 1, k
+        super().__init__(child)
+        self.k = int(k)
+
+    def with_children(self, children):
+        return NthValue(children[0], self.k)
+
+    def __repr__(self):
+        return f"nth_value({self.child!r}, {self.k})"

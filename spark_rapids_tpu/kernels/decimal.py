@@ -146,10 +146,11 @@ def overflow(hi, lo, precision: int) -> jax.Array:
                             jnp.full_like(al, bl))
 
 
-def _divmod_small(h, l, d: int):
-    """unsigned int128 // small positive divisor (< 2^31), via four 32-bit
-    long-division steps.  Inputs interpreted UNSIGNED."""
-    d64 = U64(d)
+def _divmod_small(h, l, d):
+    """unsigned int128 // small positive divisor (< 2^32), via four 32-bit
+    long-division steps.  Inputs interpreted UNSIGNED.  `d` may be a
+    python int or a per-row int array (e.g. group counts)."""
+    d64 = (d.astype(U64) if hasattr(d, "astype") else U64(d))
     w3 = (h.astype(U64) >> U64(32))
     w2 = (h.astype(U64) & _MASK32)
     w1 = (l.astype(U64) >> U64(32))
@@ -170,13 +171,14 @@ def _divmod_small(h, l, d: int):
     return qh, ql, r
 
 
-def div128_small(h, l, d: int, round_half_up: bool = True):
-    """signed int128 / small positive int with HALF_UP rounding (Spark
-    Decimal.toPrecision ROUND_HALF_UP).  d < 2^31."""
+def div128_small(h, l, d, round_half_up: bool = True):
+    """signed int128 / small positive divisor with HALF_UP rounding (Spark
+    Decimal.toPrecision ROUND_HALF_UP).  d < 2^32; int or per-row array."""
     ah, al = abs128(h, l)
     qh, ql, r = _divmod_small(ah, al, d)
     if round_half_up:
-        bump = (r * U64(2) >= U64(d))
+        d64 = (d.astype(U64) if hasattr(d, "astype") else U64(d))
+        bump = (r * U64(2) >= d64)
         qh, ql = add128(qh, ql, jnp.zeros_like(qh),
                         bump.astype(I64))
     neg = is_neg(h)

@@ -532,7 +532,8 @@ class CpuEngine:
         partition run — the oracle for the segmented-scan kernels."""
         from spark_rapids_tpu.expressions.core import Alias
         from spark_rapids_tpu.expressions.window import (
-            DenseRank, Lag, Lead, Rank, RowNumber, WindowExpression)
+            CumeDist, DenseRank, FirstValue, Lag, LastValue, Lead, NthValue,
+            Ntile, PercentRank, Rank, RowNumber, WindowExpression)
         from spark_rapids_tpu.expressions.aggregates import AggregateFunction
 
         t = CpuTable.concat(self._exec(plan.child), plan.child.schema)
@@ -607,6 +608,74 @@ class CpuEngine:
                     for i in range(len(rows)):
                         vals[lo + i] = peer_of[i][0] + 1
                         valid[lo + i] = True
+                elif isinstance(fn, PercentRank):
+                    cnt = len(rows)
+                    for i in range(cnt):
+                        vals[lo + i] = (peer_of[i][1] / (cnt - 1)
+                                        if cnt > 1 else 0.0)
+                        valid[lo + i] = True
+                elif isinstance(fn, CumeDist):
+                    cnt = len(rows)
+                    for i in range(cnt):
+                        vals[lo + i] = peer_of[i][2] / cnt
+                        valid[lo + i] = True
+                elif isinstance(fn, Ntile):
+                    cnt = len(rows)
+                    bs, rem = divmod(cnt, fn.n)
+                    for i in range(cnt):
+                        if bs == 0:
+                            b = i + 1
+                        elif i < rem * (bs + 1):
+                            b = i // (bs + 1) + 1
+                        else:
+                            b = rem + (i - rem * (bs + 1)) // bs + 1
+                        vals[lo + i] = b
+                        valid[lo + i] = True
+                elif isinstance(fn, (FirstValue, LastValue, NthValue)):
+                    cv, cm = fn.child.eval_cpu(sctx)
+                    frame = inner.spec.frame
+                    okv = None
+                    if frame.kind == "range" and not (
+                            frame.is_unbounded_both()
+                            or frame.is_unbounded_to_current()):
+                        okv, _ = inner.spec.order_by[0][0].eval_cpu(sctx)
+                    for i in range(len(rows)):
+                        if frame.is_unbounded_both():
+                            f_lo, f_hi = 0, len(rows)
+                        elif frame.kind == "range" and                                 frame.is_unbounded_to_current():
+                            f_lo, f_hi = 0, peer_of[i][2]
+                        elif okv is not None:
+                            ki = okv[lo + i]
+                            vlo = (None if frame.start is None
+                                   else ki + frame.start)
+                            vhi = (None if frame.end is None
+                                   else ki + frame.end)
+                            f_lo, f_hi = 0, len(rows)
+                            if vlo is not None:
+                                while f_lo < len(rows) and                                         okv[lo + f_lo] < vlo:
+                                    f_lo += 1
+                            if vhi is not None:
+                                f_hi = f_lo
+                                while f_hi < len(rows) and                                         okv[lo + f_hi] <= vhi:
+                                    f_hi += 1
+                        else:
+                            f_lo = (0 if frame.start is None
+                                    else max(i + frame.start, 0))
+                            f_hi = (len(rows) if frame.end is None
+                                    else min(i + frame.end + 1, len(rows)))
+                        if f_hi <= f_lo:
+                            continue
+                        if isinstance(fn, NthValue):
+                            j = f_lo + fn.k - 1
+                            if j >= f_hi:
+                                continue
+                        elif isinstance(fn, LastValue):
+                            j = f_hi - 1
+                        else:
+                            j = f_lo
+                        if cm[lo + j]:
+                            vals[lo + i] = cv[lo + j]
+                            valid[lo + i] = True
                 elif isinstance(fn, (Lead, Lag)):
                     cv, cm = fn.child.eval_cpu(sctx)
                     off = fn.offset if isinstance(fn, Lead) and not isinstance(fn, Lag) else -fn.offset

@@ -20,7 +20,8 @@ from spark_rapids_tpu.expressions.core import Alias, EvalContext, Expression
 from spark_rapids_tpu.expressions.aggregates import (
     Average, Count, Max, Min, Sum)
 from spark_rapids_tpu.expressions.window import (
-    DenseRank, Lag, Lead, Rank, RowNumber, WindowExpression, WindowFrame)
+    CumeDist, DenseRank, FirstValue, Lag, LastValue, Lead, NthValue, Ntile,
+    PercentRank, Rank, RowNumber, WindowExpression, WindowFrame)
 from spark_rapids_tpu.kernels import window as WK
 from spark_rapids_tpu.kernels.groupby import (
     _rows_equal_prev, normalize_key_column)
@@ -92,6 +93,38 @@ class _WindowDeviceSpec:
             out_cols.append(self._window_column(_unwrap(e), layout, sctx))
         return ColumnarBatch(tuple(out_cols), sw.num_rows, self.schema)
 
+    def _positional_value(self, fn, frame, we, layout, sctx):
+        """first/last/nth value: gather at the frame-boundary position.
+
+        Frame bounds come from the same machinery the bounded aggregates
+        use; nulls are respected (Spark default)."""
+        c = fn.child.eval(sctx)
+        cap = layout.pos.shape[0]
+        if frame.is_unbounded_both():
+            lower, upper = layout.seg_start, layout.seg_end - 1
+        elif frame.kind == "range" and frame.is_unbounded_to_current():
+            lower, upper = layout.seg_start, layout.run_last
+        elif frame.kind == "rows":
+            lower, upper = WK.frame_bounds_rows(
+                layout, None if frame.start is None else -frame.start,
+                frame.end)
+        else:
+            okey = we.spec.order_by[0][0].eval(sctx)
+            lower, upper = WK.frame_bounds_range(
+                okey.data, layout,
+                None if frame.start is None else -frame.start, frame.end)
+        if isinstance(fn, NthValue):
+            at = lower + jnp.int32(fn.k - 1)
+        elif isinstance(fn, LastValue):
+            at = upper
+        else:
+            at = lower
+        in_frame = (at >= lower) & (at <= upper) & layout.live
+        safe = jnp.clip(at, 0, cap - 1)
+        valid = in_frame & c.validity[safe]
+        vals = jnp.where(valid, c.data[safe], jnp.zeros((), c.data.dtype))
+        return DeviceColumn(vals, valid, fn.dtype)
+
     def _window_column(self, we: WindowExpression, layout: WK.WindowLayout,
                        sctx: EvalContext) -> DeviceColumn:
         fn = we.function
@@ -109,6 +142,33 @@ class _WindowDeviceSpec:
             return DeviceColumn(
                 jnp.where(valid, vals, jnp.zeros((), vals.dtype)),
                 valid, fn.dtype)
+        if isinstance(fn, PercentRank):
+            cnt = (layout.seg_end - layout.seg_start).astype(jnp.float64)
+            rk = (layout.run_first - layout.seg_start).astype(jnp.float64)
+            v = jnp.where(cnt > 1, rk / jnp.maximum(cnt - 1.0, 1.0), 0.0)
+            return DeviceColumn(jnp.where(layout.live, v, 0.0),
+                                layout.live, T.DOUBLE)
+        if isinstance(fn, CumeDist):
+            cnt = (layout.seg_end - layout.seg_start).astype(jnp.float64)
+            le = (layout.run_last + 1 - layout.seg_start).astype(jnp.float64)
+            v = le / jnp.maximum(cnt, 1.0)
+            return DeviceColumn(jnp.where(layout.live, v, 0.0),
+                                layout.live, T.DOUBLE)
+        if isinstance(fn, Ntile):
+            n_t = jnp.int32(fn.n)
+            cnt = layout.seg_end - layout.seg_start
+            r = layout.pos - layout.seg_start
+            bs = cnt // n_t
+            rem = cnt % n_t
+            thr = rem * (bs + 1)
+            big = r // jnp.maximum(bs + 1, 1) + 1
+            small = rem + (r - thr) // jnp.maximum(bs, 1) + 1
+            v = jnp.where(bs == 0, r + 1, jnp.where(r < thr, big, small))
+            return DeviceColumn(
+                jnp.where(layout.live, v.astype(jnp.int32), 0),
+                layout.live, T.INT)
+        if isinstance(fn, (FirstValue, LastValue, NthValue)):
+            return self._positional_value(fn, frame, we, layout, sctx)
 
         # aggregate window functions
         out_dt = fn.dtype

@@ -97,9 +97,12 @@ def _regex_child_ok(e) -> bool:
     return all(_regex_child_ok(c) for c in e.children)
 
 from spark_rapids_tpu.expressions.window import (
-    DenseRank, Lag, Lead, Rank, RowNumber, WindowExpression)
+    CumeDist, DenseRank, FirstValue, Lag, LastValue, Lead, NthValue, Ntile,
+    PercentRank, Rank, RowNumber, WindowExpression)
 
-_SUPPORTED_EXPRS |= {WindowExpression, RowNumber, Rank, DenseRank, Lead, Lag}
+_SUPPORTED_EXPRS |= {WindowExpression, RowNumber, Rank, DenseRank, Lead, Lag,
+                     PercentRank, CumeDist, Ntile, FirstValue, LastValue,
+                     NthValue}
 
 from spark_rapids_tpu.expressions import math as M
 from spark_rapids_tpu.expressions import datetime as DT
@@ -786,7 +789,8 @@ class PlanMeta:
 
     def _tag_window(self, p: "L.Window") -> None:
         from spark_rapids_tpu.expressions.window import (
-            DenseRank, Lag, Lead, Rank, RowNumber, WindowExpression)
+            CumeDist, DenseRank, FirstValue, Lag, LastValue, Lead, NthValue,
+            Ntile, PercentRank, Rank, RowNumber, WindowExpression)
         from spark_rapids_tpu.expressions.aggregates import (
             Average, Count, Max, Min, Sum)
         spec = p.spec
@@ -809,7 +813,27 @@ class PlanMeta:
                     "mixed window specs in one Window node")
             fn = inner.function
             frame = inner.spec.frame
-            if isinstance(fn, (RowNumber, Rank, DenseRank, Lead, Lag)):
+            if isinstance(fn, (RowNumber, Rank, DenseRank, Lead, Lag,
+                               PercentRank, CumeDist, Ntile)):
+                continue
+            if isinstance(fn, (FirstValue, LastValue, NthValue)):
+                try:
+                    if fn.child.dtype.variable_width:
+                        self.will_not_work(
+                            f"{fn.name} over strings needs offset-aware "
+                            "frame gathers (fixed-width inputs only)")
+                except (TypeError, ValueError, NotImplementedError):
+                    pass
+                frame = inner.spec.frame
+                if frame.kind == "range" and not (
+                        frame.is_unbounded_to_current()
+                        or frame.is_unbounded_both()):
+                    ob = inner.spec.order_by
+                    ok = (len(ob) == 1 and ob[0][1].ascending)
+                    if not ok:
+                        self.will_not_work(
+                            f"{fn.name} bounded range frame needs a single "
+                            "ascending order key")
                 continue
             if isinstance(fn, (Sum, Count, Average, Min, Max)):
                 if frame.kind == "range" and not (

@@ -282,7 +282,9 @@ def _build_registry() -> None:
     register(A.Count, ExprSig(TypeSig("long"), ALL_DEVICE))
     for cls in (A.Min, A.Max):
         register(cls, ExprSig(ORDERED, ORDERED))
-    register(A.Average, ExprSig(TypeSig("double"), NUMERIC_DEC))
+    register(A.Average, ExprSig(TypeSig("double", "decimal64",
+                                       "decimal128"),
+                                NUMERIC_DEC + DEC128))
     for cls in (A.VarianceSamp, A.VariancePop, A.StddevSamp, A.StddevPop):
         register(cls, ExprSig(TypeSig("double"), NUMERIC))
     register(A.ApproximateCountDistinct,
@@ -295,10 +297,15 @@ def _build_registry() -> None:
                                    "group arrays"))
 
     # window functions
-    for cls in (W.RowNumber, W.Rank, W.DenseRank):
+    for cls in (W.RowNumber, W.Rank, W.DenseRank, W.Ntile):
         register(cls, ExprSig(TypeSig("int", "long")))
+    for cls in (W.PercentRank, W.CumeDist):
+        register(cls, ExprSig(TypeSig("double")))
     for cls in (W.Lead, W.Lag):
         register(cls, ExprSig(COMMON, COMMON))
+    for cls in (W.FirstValue, W.LastValue, W.NthValue):
+        register(cls, ExprSig(NUMERIC_DEC + DATETIME + BOOL,
+                              NUMERIC_DEC + DATETIME + BOOL))
 
 
 _build_registry()

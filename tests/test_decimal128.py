@@ -183,3 +183,29 @@ def test_decimal128_hash_device_matches_python():
             continue
         want = HK.py_murmur3_row([v], [dt])
         assert int(h[i]) == want, (v, int(h[i]), want)
+
+
+def test_avg_decimal_result_type():
+    """avg(decimal(p,s)) -> decimal(p+4, s+4) computed exactly over the
+    int128 sum (was DOUBLE before — Spark's Average type rule)."""
+    from spark_rapids_tpu.expressions import avg
+    rows = assert_tpu_cpu_equal(lambda s: df(s).group_by("k").agg(
+        Alias(avg(col("c")), "ac"),      # decimal(12,2) -> decimal(16,6)
+        Alias(avg(col("a")), "aa")))     # decimal(25,4) -> decimal(29,8)
+    assert len(rows) == 7
+    # exact cross-check of every group against python ints
+    got = dict((r[0], r[1]) for r in assert_tpu_cpu_equal(
+        lambda ss: df(ss).group_by("k").agg(
+            Alias(avg(col("c")), "ac"))))
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    raw = {}
+    for r in df(cpu).select(Alias(col("k"), "k"),
+                            Alias(col("c"), "c")).collect():
+        raw.setdefault(r[0], []).append(r[1])
+    for k, vals in raw.items():
+        vs = [v for v in vals if v is not None]
+        num = sum(vs) * 10 ** 4
+        q, rr = divmod(abs(num), len(vs))
+        q += 1 if 2 * rr >= len(vs) else 0
+        q = -q if num < 0 else q
+        assert got[k] == q, (k, got[k], q)

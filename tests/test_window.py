@@ -129,3 +129,37 @@ def test_window_with_injected_oom():
     assert_tpu_cpu_equal(
         lambda s: wdf(s).with_column(
             "w", over(sum_("v"), partition_by=["k"], order_by=["t"])))
+
+
+def test_rank_family_extended():
+    """percent_rank / cume_dist / ntile (Spark NTile remainder-first
+    bucketing)."""
+    from spark_rapids_tpu.expressions.window import (
+        CumeDist, Ntile, PercentRank)
+
+    def q(s):
+        return wdf(s).select(
+            col("k"), col("t"),
+            over(PercentRank(), partition_by=["k"],
+                 order_by=["t"]).alias("pr"),
+            over(CumeDist(), partition_by=["k"],
+                 order_by=["t"]).alias("cd"),
+            over(Ntile(3), partition_by=["k"],
+                 order_by=["t"]).alias("nt"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_first_last_nth_value():
+    from spark_rapids_tpu.expressions.window import (
+        FirstValue, LastValue, NthValue)
+
+    def q(s):
+        return wdf(s).select(
+            col("k"), col("t"), col("v"),
+            over(FirstValue(col("v")), partition_by=["k"],
+                 order_by=["t"]).alias("fv"),
+            over(LastValue(col("v")), partition_by=["k"], order_by=["t"],
+                 frame=WindowFrame("range", None, None)).alias("lv"),
+            over(NthValue(col("v"), 2), partition_by=["k"], order_by=["t"],
+                 frame=WindowFrame("rows", 1, 1)).alias("nv"))
+    assert_tpu_cpu_equal(q)

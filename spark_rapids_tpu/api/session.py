@@ -30,6 +30,68 @@ def _to_expr(e) -> Expression:
     return lit(e)
 
 
+def _extract_windows(exprs, plan):
+    """Pull every WindowExpression anywhere inside a projection list into
+    Window node(s) beneath a final Project — Spark's
+    ExtractWindowExpressions analyzer rule as mirrored by GpuWindowExec
+    planning (reference sql-plugin/.../window/GpuWindowExec.scala:145).
+
+    Windows nested inside scalar expressions (``over(...) + 1``) and
+    multiple distinct (partition_by, order_by) specs in one select are
+    supported: specs sharing partitioning/ordering land in one Window node
+    (frames may differ per expression — the exec reads them individually);
+    differing specs chain as stacked Window nodes.  Returns the rewritten
+    projection list (window occurrences replaced by column refs) and the
+    new child plan.
+    """
+    from spark_rapids_tpu.expressions.window import WindowExpression
+
+    found: List[Expression] = []
+
+    def scan(e):
+        if isinstance(e, WindowExpression):
+            found.append(e)
+            return
+        for c in e.children:
+            scan(c)
+
+    for e in exprs:
+        scan(e)
+    if not found:
+        return exprs, plan
+
+    # structural dedupe (identical window exprs share one computed column)
+    names: Dict[str, str] = {}
+    uniq: List[Tuple[str, Expression]] = []
+    for w in found:
+        k = repr(w)
+        if k not in names:
+            names[k] = f"__w{len(uniq)}"
+            uniq.append((k, w))
+
+    # one Window node per shared (partition_by, order_by)
+    groups: Dict[Tuple[str, str], List[Tuple[str, Expression]]] = {}
+    order: List[Tuple[str, str]] = []
+    for k, w in uniq:
+        gk = (repr(w.spec.partition_by), repr(w.spec.order_by))
+        if gk not in groups:
+            groups[gk] = []
+            order.append(gk)
+        groups[gk].append((k, w))
+    for gk in order:
+        plan = L.Window([w.alias(names[k]) for k, w in groups[gk]], plan)
+
+    def rewrite(e):
+        if isinstance(e, WindowExpression):
+            return col(names[repr(e)])
+        kids = tuple(rewrite(c) for c in e.children)
+        if all(n is o for n, o in zip(kids, e.children)):
+            return e
+        return e.with_children(kids)
+
+    return [rewrite(e) for e in exprs], plan
+
+
 class TpuSession:
     def __init__(self, conf: Optional[Dict[str, str]] = None, mesh=None):
         """mesh: optional jax.sharding.Mesh.  With
@@ -279,8 +341,9 @@ class DataFrame:
     # -- transformations ----------------------------------------------------
 
     def select(self, *exprs) -> "DataFrame":
-        return DataFrame(L.Project([_to_expr(e) for e in exprs], self.plan),
-                         self.session)
+        projections, plan = _extract_windows(
+            [_to_expr(e) for e in exprs], self.plan)
+        return DataFrame(L.Project(projections, plan), self.session)
 
     def filter(self, condition) -> "DataFrame":
         return DataFrame(L.Filter(_to_expr(condition), self.plan), self.session)
@@ -288,10 +351,7 @@ class DataFrame:
     where = filter
 
     def with_column(self, name: str, expr) -> "DataFrame":
-        from spark_rapids_tpu.expressions.window import WindowExpression
         e = _to_expr(expr)
-        if isinstance(e, WindowExpression):
-            return DataFrame(L.Window([e.alias(name)], self.plan), self.session)
         exprs = [col(n) for n in self.schema.names if n != name]
         exprs.append(e.alias(name))
         return self.select(*exprs)

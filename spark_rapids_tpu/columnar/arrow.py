@@ -151,9 +151,13 @@ def arrow_column_to_device(arr: pa.Array, dtype: T.DataType,
         )
     if isinstance(dtype, T.TimestampType):
         arr = arr.cast(pa.timestamp("us"))
-        np_vals = arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        # fill nulls BEFORE to_numpy: a null-carrying conversion degrades
+        # to float64, silently corrupting |micros| > 2^53 (pre-1684 dates)
+        np_vals = arr.cast(pa.int64()).fill_null(0).to_numpy(
+            zero_copy_only=False)
     elif isinstance(dtype, T.DateType):
-        np_vals = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+        np_vals = arr.cast(pa.int32()).fill_null(0).to_numpy(
+            zero_copy_only=False)
     elif isinstance(dtype, T.DecimalType):
         if dtype.uses_two_limbs:
             raise NotImplementedError("decimal precision > 18 upload")

@@ -33,6 +33,8 @@ COUNT_STAR = "count_star"    # counts rows
 MIN = "min"
 MAX = "max"
 SUM128 = "sum128"            # exact int128 sum of decimal limbs
+MIN128 = "min128"            # lexicographic two-limb min (decimal128)
+MAX128 = "max128"            # lexicographic two-limb max (decimal128)
 COLLECT = "collect"          # gather the group's values into an array row
 COLLECT_MERGE = "collect_merge"
 
@@ -172,6 +174,10 @@ class Min(AggregateFunction):
 
     @property
     def buffers(self):
+        dt = self.dtype
+        if isinstance(dt, T.DecimalType) and dt.uses_two_limbs:
+            return (BufferSlot(dt, MIN128, MIN128),
+                    BufferSlot(T.LONG, COUNT_VALID, SUM))
         return (BufferSlot(self.dtype, MIN, MIN),
                 BufferSlot(T.LONG, COUNT_VALID, SUM))
 
@@ -200,6 +206,10 @@ class Max(AggregateFunction):
 
     @property
     def buffers(self):
+        dt = self.dtype
+        if isinstance(dt, T.DecimalType) and dt.uses_two_limbs:
+            return (BufferSlot(dt, MAX128, MAX128),
+                    BufferSlot(T.LONG, COUNT_VALID, SUM))
         return (BufferSlot(self.dtype, MAX, MAX),
                 BufferSlot(T.LONG, COUNT_VALID, SUM))
 

@@ -50,6 +50,21 @@ def decimal_mul_result(a: T.DecimalType, b: T.DecimalType) -> T.DecimalType:
                          min(scale, T.DecimalType.MAX_PRECISION))
 
 
+def decimal_div_result(a: T.DecimalType, b: T.DecimalType) -> T.DecimalType:
+    """Spark DecimalPrecision divide result with adjustPrecisionScale
+    (allowPrecisionLoss default): p = p1-s1+s2+scale, scale =
+    max(6, s1+p2+1), then squeeze into MAX_PRECISION preserving integral
+    digits down to a min scale of 6."""
+    scale = max(6, a.scale + b.precision + 1)
+    precision = a.precision - a.scale + b.scale + scale
+    if precision <= T.DecimalType.MAX_PRECISION:
+        return T.DecimalType(precision, scale)
+    int_digits = precision - scale
+    min_scale = min(scale, 6)
+    adj_scale = max(T.DecimalType.MAX_PRECISION - int_digits, min_scale)
+    return T.DecimalType(T.DecimalType.MAX_PRECISION, adj_scale)
+
+
 def _rescale_unscaled(x, from_scale: int, to_scale: int, xp):
     """int64 unscaled value rescale (to_scale >= from_scale)."""
     if to_scale == from_scale:
@@ -238,12 +253,25 @@ class Multiply(BinaryArithmetic):
 
 
 class Divide(BinaryExpression):
-    """Spark Divide: double result, NULL on zero divisor."""
+    """Spark Divide: double result for non-decimal inputs, NULL on zero
+    divisor.  decimal/decimal divides exactly through the 256-bit
+    intermediate kernel with one final HALF_UP rounding to the Spark
+    result scale (reference: GpuDecimalDivide via DecimalUtils,
+    arithmetic.scala:1387)."""
 
     symbol = "/"
 
+    def _is_decimal(self) -> bool:
+        return (isinstance(self.left.dtype, T.DecimalType)
+                or isinstance(self.right.dtype, T.DecimalType))
+
     @property
     def dtype(self):
+        if self._is_decimal():
+            l, r = self.left.dtype, self.right.dtype
+            assert isinstance(l, T.DecimalType) and isinstance(r, T.DecimalType), \
+                "mixed decimal/non-decimal division needs casts"
+            return decimal_div_result(l, r)
         return T.DOUBLE
 
     @property
@@ -253,6 +281,22 @@ class Divide(BinaryExpression):
     def eval(self, ctx: EvalContext):
         lc = self.left.eval(ctx)
         rc = self.right.eval(ctx)
+        if self._is_decimal():
+            from spark_rapids_tpu.kernels import decimal as DK
+            ldt, rdt = self.left.dtype, self.right.dtype
+            out_dt = self.dtype
+            ah, al = DK.limbs_of(lc, ldt)
+            bh, bl = DK.limbs_of(rc, rdt)
+            # unscaled result = round(a / b * 10^(s - s1 + s2))
+            shift = out_dt.scale - ldt.scale + rdt.scale
+            assert shift >= 0, (ldt, rdt, out_dt)
+            h, l, over, zero_div = DK.div128_by_128(ah, al, bh, bl, shift)
+            validity = (null_propagating([lc.validity, rc.validity])
+                        & ~zero_div & ~over
+                        & ~DK.overflow(h, l, out_dt.precision))
+            if out_dt.uses_two_limbs:
+                return DK.make_column128(h, l, validity, out_dt)
+            return make_column(l, validity, out_dt)
         lhs = lc.data.astype(jnp.float64)
         rhs = rc.data.astype(jnp.float64)
         zero_div = rhs == 0
@@ -263,10 +307,41 @@ class Divide(BinaryExpression):
     def eval_cpu(self, ctx: CpuEvalContext):
         lv, lval = self.left.eval_cpu(ctx)
         rv, rval = self.right.eval_cpu(ctx)
+        validity = cpu_null_propagating([lval, rval])
+        if self._is_decimal():
+            ldt, rdt = self.left.dtype, self.right.dtype
+            out_dt = self.dtype
+            shift = out_dt.scale - ldt.scale + rdt.scale
+            bound = 10 ** out_dt.precision
+            vals: list = []
+            ok = np.zeros(len(lv), np.bool_)
+            for i in range(len(lv)):
+                if not validity[i]:
+                    vals.append(None)
+                    continue
+                a, b = int(lv[i]), int(rv[i])
+                if b == 0:
+                    vals.append(None)
+                    continue
+                n = abs(a) * 10 ** shift
+                q, r = divmod(n, abs(b))
+                q += 1 if 2 * r >= abs(b) else 0
+                q = -q if (a < 0) != (b < 0) else q
+                if not (-bound < q < bound):
+                    vals.append(None)
+                    continue
+                vals.append(q)
+                ok[i] = True
+            if out_dt.uses_two_limbs:
+                out = np.empty((len(vals),), object)
+                out[:] = vals
+                return out, ok
+            return (np.array([v if v is not None else 0 for v in vals],
+                             np.int64), ok)
         lhs = lv.astype(np.float64)
         rhs = rv.astype(np.float64)
         zero_div = rhs == 0
-        validity = cpu_null_propagating([lval, rval]) & ~zero_div
+        validity = validity & ~zero_div
         with np.errstate(all="ignore"):
             vals = lhs / np.where(zero_div, 1.0, rhs)
         return cpu_zero_invalid(vals, validity), validity

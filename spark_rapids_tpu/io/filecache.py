@@ -34,7 +34,8 @@ def reset_metrics() -> None:
 
 
 def _entry_name(path: str, st) -> str:
-    key = f"{os.path.abspath(path)}|{st.st_mtime_ns}|{st.st_size}"
+    ident = path if "://" in path else os.path.abspath(path)
+    key = f"{ident}|{st.st_mtime_ns}|{st.st_size}"
     digest = hashlib.sha256(key.encode()).hexdigest()[:32]
     return f"{digest}{os.path.splitext(path)[1]}"
 
@@ -47,10 +48,30 @@ def cached_path(path: str, conf) -> str:
             _metrics["bypass"] += 1
         return path
     cache_dir = conf.filecache_dir
+    from spark_rapids_tpu.io.rangeio import is_remote_path
+    remote = is_remote_path(path)
     try:
         os.makedirs(cache_dir, exist_ok=True)
-        st = os.stat(path)
-    except OSError:
+        if remote:
+            # object-store source: key by (url, size, mtime-or-etag) via
+            # fsspec metadata — the primary use case of the reference's
+            # filecache (remote scan bytes land once per host)
+            import fsspec
+            fs, fpath = fsspec.core.url_to_fs(path)
+            info = fs.info(fpath)
+            stamp = str(info.get("mtime") or info.get("ETag")
+                        or info.get("LastModified") or "")
+
+            class _St:
+                # the raw stamp string feeds _entry_name's sha256 —
+                # NOT hash(), which is salted per process and would
+                # defeat cross-process cache hits
+                st_mtime_ns = stamp
+                st_size = int(info.get("size", 0))
+            st = _St()
+        else:
+            st = os.stat(path)
+    except Exception:
         return path
     entry = os.path.join(cache_dir, _entry_name(path, st))
     with _lock:
@@ -61,9 +82,12 @@ def cached_path(path: str, conf) -> str:
         _metrics["misses"] += 1
     tmp = entry + f".tmp{os.getpid()}"
     try:
-        shutil.copyfile(path, tmp)
+        if remote:
+            fs.get_file(fpath, tmp)
+        else:
+            shutil.copyfile(path, tmp)
         os.replace(tmp, entry)
-    except OSError:
+    except Exception:
         # cache dir full/unwritable: the cache is an optimization — fall
         # back to the source path rather than failing the scan
         try:

@@ -23,8 +23,19 @@ from spark_rapids_tpu.columnar.arrow import arrow_to_batch, batch_to_arrow, arro
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
 
 
+def _open_parquet(path: str) -> pq.ParquetFile:
+    """Local paths open directly; URLs (s3://, gs://, memory://, ...) open
+    through the fsspec ranged source with the footer prefetched — the
+    object-store entry point (S3InputFile.scala analog)."""
+    from spark_rapids_tpu.io.rangeio import (
+        is_remote_path, open_footer, open_source)
+    if is_remote_path(path):
+        return pq.ParquetFile(open_footer(open_source(path)))
+    return pq.ParquetFile(path)
+
+
 def parquet_schema(path: str, columns: Optional[Sequence[str]] = None) -> Schema:
-    pf = pq.ParquetFile(path)
+    pf = _open_parquet(path)
     arrow_schema = pf.schema_arrow
     names = []
     dtypes = []
@@ -75,7 +86,15 @@ def iter_parquet_arrow(
     independent of file size.  coalesce_ranges reads the pruned column
     chunks as few merged I/O requests (io/rangeio.py).
     """
-    pf = pq.ParquetFile(path)
+    from spark_rapids_tpu.io.rangeio import is_remote_path
+    remote = is_remote_path(path)
+    if remote:
+        # object-store scans ALWAYS take the coalesced multithreaded tier:
+        # per-page seeks against an object store are latency death
+        # (the reference routes cloud paths to the MULTITHREADED reader,
+        # GpuParquetScan.scala:3134)
+        coalesce_ranges = True
+    pf = _open_parquet(path)
     groups: List[int] = []
     meta = pf.metadata
     name_to_idx = {meta.schema.column(i).name: i
@@ -104,10 +123,19 @@ def iter_parquet_arrow(
         from spark_rapids_tpu.io.rangeio import open_coalesced_parquet
         src, _ = open_coalesced_parquet(path, groups, columns)
         pf = pq.ParquetFile(src)
+    # LEGACY-calendar files (org.apache.spark.legacyDateTime footer tag)
+    # carry hybrid Julian dates/timestamps: rebase to proleptic Gregorian
+    # on the host path (datetimeRebaseUtils.scala:53-58; VERDICT r3 #4 —
+    # without this, pre-1582 values are silently wrong)
+    from spark_rapids_tpu.io.rebase import needs_rebase, rebase_arrow_table
+    legacy = needs_rebase(meta)
     for record_batch in pf.iter_batches(batch_size=rows_per_batch,
                                         row_groups=groups,
                                         columns=list(columns) if columns else None):
-        yield pa.Table.from_batches([record_batch])
+        table = pa.Table.from_batches([record_batch])
+        if legacy:
+            table = rebase_arrow_table(table)
+        yield table
 
 
 def read_parquet_batches(

@@ -308,3 +308,155 @@ def sum128(hi, lo, weights) -> Tuple[jax.Array, jax.Array, jax.Array]:
             for limb in _split_limbs32(hi, lo)]
     h, l, ov = _carry_join(sums)
     return h[0], l[0], ov[0]
+
+
+# -- segmented MIN/MAX over two limbs ----------------------------------------
+
+def segment_extreme128(hi, lo, valid, segment_ids, num_segments: int,
+                       is_min: bool):
+    """Lexicographic (hi signed, lo unsigned) per-segment min or max of
+    int128 values.  Two segment reductions: extreme of hi, then extreme of
+    lo restricted to rows whose hi equals the segment's winning hi.
+    Returns (hi, lo, any_valid) per segment.  Unlocks min/max(decimal128)
+    aggregation (reference: cudf min/max via GpuMin/GpuMax,
+    aggregate/aggregateFunctions.scala)."""
+    lou = jax.lax.bitcast_convert_type(lo.astype(I64), jnp.uint64)
+    if is_min:
+        ident_h = jnp.int64(0x7FFFFFFFFFFFFFFF)
+        ident_l = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        seg_ext = jax.ops.segment_min
+    else:
+        ident_h = jnp.int64(-0x8000000000000000)
+        ident_l = jnp.uint64(0)
+        seg_ext = jax.ops.segment_max
+    ch = jnp.where(valid, hi, ident_h)
+    mh = seg_ext(ch, segment_ids, num_segments=num_segments)
+    cand = valid & (hi == mh[segment_ids])
+    cl = jnp.where(cand, lou, ident_l)
+    ml = seg_ext(cl, segment_ids, num_segments=num_segments)
+    nvalid = jax.ops.segment_sum(valid.astype(jnp.int32), segment_ids,
+                                 num_segments=num_segments)
+    return mh, jax.lax.bitcast_convert_type(ml, I64), nvalid > 0
+
+
+# -- full 128/128 division (256-bit intermediate) ----------------------------
+
+def _mul_u128_full(ah, al, bh, bl):
+    """unsigned 128 x 128 -> 256-bit product as four uint64 limbs
+    (w3, w2, w1, w0), most significant first."""
+    a3, a2 = (ah.astype(U64) >> U64(32)), (ah.astype(U64) & _MASK32)
+    a1, a0 = (al.astype(U64) >> U64(32)), (al.astype(U64) & _MASK32)
+    b3, b2 = (bh.astype(U64) >> U64(32)), (bh.astype(U64) & _MASK32)
+    b1, b0 = (bl.astype(U64) >> U64(32)), (bl.astype(U64) & _MASK32)
+    A = [a0, a1, a2, a3]
+    B = [b0, b1, b2, b3]
+    # schoolbook over 32-bit digits: eight 32-bit output digits, carries
+    # accumulate safely in uint64 (at most 16 products of < 2^64 summed
+    # digit-wise as (hi<<32 + lo) splits)
+    digits = [jnp.zeros_like(a0) for _ in range(8)]
+    for i in range(4):
+        for j in range(4):
+            p = A[i] * B[j]
+            digits[i + j] = digits[i + j] + (p & _MASK32)
+            digits[i + j + 1] = digits[i + j + 1] + (p >> U64(32))
+    carry = jnp.zeros_like(a0)
+    out = []
+    for d in digits:
+        t = d + carry
+        out.append(t & _MASK32)
+        carry = t >> U64(32)
+    w0 = out[0] | (out[1] << U64(32))
+    w1 = out[2] | (out[3] << U64(32))
+    w2 = out[4] | (out[5] << U64(32))
+    w3 = out[6] | (out[7] << U64(32))
+    return w3, w2, w1, w0
+
+
+def _div256_by_128(w3, w2, w1, w0, dh, dl):
+    """unsigned 256-bit // 128-bit via binary long division (256-step
+    shift-subtract under lax.fori_loop).  PRECONDITION: divisor < 2^127
+    (decimal magnitudes are < 10^38 < 2^127) so the shifted remainder
+    always fits two limbs.  Returns (q3, q2, q1, q0, r1, r0) uint64."""
+    N = jnp.stack([w0, w1, w2, w3])          # limb index = j >> 6
+    dh = dh.astype(U64)
+    dl = dl.astype(U64)
+    zero = jnp.zeros_like(w0)
+    Q = jnp.stack([zero, zero, zero, zero])
+
+    def body(i, state):
+        r1, r0, Q = state
+        j = 255 - i
+        bit = (N[j >> 6] >> (j & 63).astype(U64)) & U64(1)
+        r1 = (r1 << U64(1)) | (r0 >> U64(63))
+        r0 = (r0 << U64(1)) | bit
+        ge = (r1 > dh) | ((r1 == dh) & (r0 >= dl))
+        borrow = (r0 < dl).astype(U64)
+        r0s = r0 - dl
+        r1s = r1 - dh - borrow
+        r1 = jnp.where(ge, r1s, r1)
+        r0 = jnp.where(ge, r0s, r0)
+        qlimb = Q[j >> 6] | (ge.astype(U64) << (j & 63).astype(U64))
+        Q = Q.at[j >> 6].set(qlimb)
+        return r1, r0, Q
+
+    r1, r0, Q = jax.lax.fori_loop(0, 256, body, (zero, zero, Q))
+    return Q[3], Q[2], Q[1], Q[0], r1, r0
+
+
+def div128_by_128(ah, al, bh, bl, pow10_shift: int,
+                  round_half_up: bool = True):
+    """signed (a * 10^pow10_shift) / b with HALF_UP rounding and exact
+    overflow detection: returns (hi, lo, overflowed, zero_divisor).
+
+    The Spark decimal-divide kernel (reference: DecimalUtils
+    divide128 via GpuDecimalDivide, arithmetic.scala:1387): numerator is
+    widened to 256 bits so no precision is lost before the single final
+    rounding.  pow10_shift beyond 38 two-stages through a checked 128-bit
+    multiply — if that overflows, the true quotient exceeds any decimal
+    precision anyway (|b| < 10^38), so the overflow flag is exact.
+    """
+    zero_div = (bh == 0) & (bl == 0)
+    neg = is_neg(ah) ^ is_neg(bh)
+    mh, ml = abs128(ah, al)
+    dh, dl = abs128(bh, bl)
+    over = jnp.zeros(ah.shape, jnp.bool_)
+    shift = pow10_shift
+    if shift > 38:
+        mh, ml, ov1 = mul128_checked(
+            mh, ml, *const_col128(POW10[shift - 38], ah))
+        mh, ml = abs128(mh, ml)   # checked mul preserves sign=positive
+        over = over | ov1
+        shift = 38
+    ph, pl = const_col128(POW10[shift], ah)
+    w3, w2, w1, w0 = _mul_u128_full(mh, ml, ph, pl)
+    safe_dh = jnp.where(zero_div, jnp.zeros_like(dh), dh)
+    safe_dl = jnp.where(zero_div, jnp.ones_like(dl), dl)  # avoid div-by-0
+    q3, q2, q1, q0, r1, r0 = _div256_by_128(w3, w2, w1, w0,
+                                            safe_dh, safe_dl)
+    if round_half_up:
+        # 2*rem >= d  (rem < d < 2^127 so 2*rem fits 128 bits)
+        t1 = (r1 << U64(1)) | (r0 >> U64(63))
+        t0 = r0 << U64(1)
+        bump = (t1 > safe_dh.astype(U64)) | (
+            (t1 == safe_dh.astype(U64)) & (t0 >= safe_dl.astype(U64)))
+        q0n = q0 + bump.astype(U64)
+        carry = (q0n < q0).astype(U64)
+        q1n = q1 + carry
+        carry = (q1n < q1).astype(U64)
+        q2n = q2 + carry
+        carry = (q2n < q2).astype(U64)
+        q3n = q3 + carry
+        q0, q1, q2, q3 = q0n, q1n, q2n, q3n
+    h = q1.astype(I64)
+    l = q0.astype(I64)
+    over = over | (q2 != 0) | (q3 != 0) | is_neg(h)  # magnitude >= 2^127
+    nh, nl = neg128(h, l)
+    h = jnp.where(neg, nh, h)
+    l = jnp.where(neg, nl, l)
+    return h, l, over, zero_div
+
+
+def const_col128(value: int, like: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """int128 constant broadcast to `like`'s shape as (hi, lo) limbs."""
+    hi, lo = const128(value)
+    return jnp.full_like(like, hi), jnp.full_like(like, lo)

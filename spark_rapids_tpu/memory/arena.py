@@ -42,6 +42,53 @@ class CpuRetryOOM(TpuOOM):
     """Host-memory analog (reference: CpuRetryOOM)."""
 
 
+def is_device_oom(exc: BaseException) -> bool:
+    """True when exc is XLA's own out-of-memory failure.
+
+    The arena's budget is bookkeeping; XLA temporaries and fragmentation
+    can exhaust real HBM *outside* the books.  jaxlib surfaces that as an
+    ``XlaRuntimeError`` whose status is RESOURCE_EXHAUSTED.  Matching by
+    class name keeps us independent of jaxlib's module layout (the class
+    moved between jaxlib versions) and lets tests substitute a fake.
+
+    Reference contract: the RMM alloc-failed callback path
+    (DeviceMemoryEventHandler.scala) that turns a real allocator failure
+    into GpuRetryOOM.
+    """
+    names = {t.__name__ for t in type(exc).__mro__}
+    if not ({"XlaRuntimeError", "JaxRuntimeError"} & names):
+        return False
+    msg = str(exc)
+    return ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+            or "out of memory" in msg)
+
+
+def translate_device_oom(fn):
+    """Wrap a device-compute callable so a real XLA RESOURCE_EXHAUSTED
+    becomes ``TpuRetryOOM`` after an emergency spill — entering the same
+    retry/spill control flow as bookkept arena pressure.  Applied to every
+    jitted program by shared_jit (plan/execs/base.py) and honored by the
+    retry loops for non-jit device work (uploads etc.)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 - filtered by is_device_oom
+            if not is_device_oom(e):
+                raise
+            from spark_rapids_tpu.memory import metrics as task_metrics
+            from spark_rapids_tpu.memory.spill import spill_framework
+            task_metrics.get().device_oom_count += 1
+            spill_framework().spill_device(1 << 62)  # emergency: evict all
+            raise TpuRetryOOM(
+                f"XLA RESOURCE_EXHAUSTED translated to retry-OOM: {e}"
+            ) from e
+
+    return wrapper
+
+
 class _Injection:
     """Synthetic-OOM state (reference: RmmSpark OOM injection)."""
 

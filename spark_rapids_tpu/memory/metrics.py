@@ -17,6 +17,7 @@ class TaskMetrics:
     retry_count: int = 0
     split_retry_count: int = 0
     capacity_retry_count: int = 0
+    device_oom_count: int = 0   # real XLA RESOURCE_EXHAUSTED translations
     semaphore_wait_ns: int = 0
     op_time_ns: int = 0
 
@@ -24,6 +25,7 @@ class TaskMetrics:
         self.retry_count += other.retry_count
         self.split_retry_count += other.split_retry_count
         self.capacity_retry_count += other.capacity_retry_count
+        self.device_oom_count += other.device_oom_count
         self.semaphore_wait_ns += other.semaphore_wait_ns
         self.op_time_ns += other.op_time_ns
 

@@ -29,6 +29,7 @@ from spark_rapids_tpu.memory.arena import (
     device_arena,
     enter_retry_scope,
     exit_retry_scope,
+    is_device_oom,
 )
 from spark_rapids_tpu.memory import metrics as task_metrics
 
@@ -67,6 +68,15 @@ def with_retry_no_split(fn: Callable[[], T]) -> T:
             except TpuSplitAndRetryOOM as e:
                 raise TpuSplitAndRetryOOM(
                     "split-and-retry OOM in a no-split context") from e
+            except Exception as e:  # noqa: BLE001 - filtered by is_device_oom
+                # real XLA RESOURCE_EXHAUSTED from non-jit device work
+                # (device_put uploads etc.) — same path as bookkept pressure
+                if not is_device_oom(e):
+                    raise
+                last = TpuRetryOOM(f"device RESOURCE_EXHAUSTED: {e}")
+                task_metrics.get().retry_count += 1
+                task_metrics.get().device_oom_count += 1
+                spill_framework().spill_device(1 << 62)
         raise last  # type: ignore[misc]
     finally:
         exit_retry_scope()
@@ -114,6 +124,18 @@ def with_retry(
                         raise
                     queue = [(p, depth + 1) for p in pieces] + queue
                     break
+                except Exception as e:  # noqa: BLE001 - is_device_oom filter
+                    # real XLA RESOURCE_EXHAUSTED (must come after the
+                    # TpuOOM clauses — Exception would swallow them)
+                    if not is_device_oom(e):
+                        raise
+                    attempts += 1
+                    task_metrics.get().retry_count += 1
+                    task_metrics.get().device_oom_count += 1
+                    if attempts >= MAX_RETRIES:
+                        raise TpuRetryOOM(
+                            f"device RESOURCE_EXHAUSTED: {e}") from e
+                    spill_framework().spill_device(1 << 62)
     finally:
         exit_retry_scope()
     return out

@@ -38,7 +38,9 @@ from spark_rapids_tpu.expressions.aggregates import (
     M2,
     M2_MERGE,
     MAX,
+    MAX128,
     MIN,
+    MIN128,
     SUM,
     SUM128,
     AggregateFunction,
@@ -119,6 +121,31 @@ def _seg_sum128(col: DeviceColumn, count_col: Optional[DeviceColumn],
     out_valid = out_valid & ~DK.overflow(h, l, out_dtype.precision)
     group_live = jnp.arange(cap, dtype=jnp.int32) < layout.num_groups
     return DK.make_column128(h, l, out_valid & group_live, out_dtype)
+
+
+def _seg_extreme128(col: DeviceColumn, layout: G.GroupedLayout,
+                    out_dtype: T.DataType, is_min: bool) -> DeviceColumn:
+    """Segmented min/max over two-limb decimal columns (update AND merge:
+    min of mins is min).  Null inputs/partials are simply excluded."""
+    from spark_rapids_tpu.kernels import decimal as DK
+    live = layout.sorted_batch.live_mask()
+    valid = col.validity & live
+    cap = col.capacity
+    hi, lo = DK.limbs_of(col, col.dtype)
+    h, l, ok = DK.segment_extreme128(hi, lo, valid, layout.segment_ids,
+                                     cap, is_min)
+    group_live = jnp.arange(cap, dtype=jnp.int32) < layout.num_groups
+    return DK.make_column128(h, l, ok & group_live, out_dtype)
+
+
+def _global_extreme128(col: DeviceColumn, live, out_dtype: T.DataType,
+                       is_min: bool) -> DeviceColumn:
+    from spark_rapids_tpu.kernels import decimal as DK
+    valid = col.validity & live
+    hi, lo = DK.limbs_of(col, col.dtype)
+    seg = jnp.zeros(hi.shape, jnp.int32)
+    h, l, ok = DK.segment_extreme128(hi, lo, valid, seg, 1, is_min)
+    return DK.make_column128(h, l, ok, out_dtype)
 
 
 def _global_sum128(col: DeviceColumn, count_col: Optional[DeviceColumn],
@@ -371,6 +398,10 @@ class _AggDeviceSpec:
                 if slot.update_op == SUM128:
                     cols.append(_global_sum128(col, None, live, slot.dtype))
                     continue
+                if slot.update_op in (MIN128, MAX128):
+                    cols.append(_global_extreme128(
+                        col, live, slot.dtype, slot.update_op == MIN128))
+                    continue
                 if slot.update_op == COLLECT:
                     cols.append(_collect_update(col, None, live, 1))
                     continue
@@ -408,6 +439,10 @@ class _AggDeviceSpec:
             if slot.update_op == SUM128:
                 cols.append(_seg_sum128(col, None, layout, slot.dtype))
                 continue
+            if slot.update_op in (MIN128, MAX128):
+                cols.append(_seg_extreme128(col, layout, slot.dtype,
+                                            slot.update_op == MIN128))
+                continue
             if slot.update_op == COLLECT:
                 live2 = layout.sorted_batch.live_mask()
                 cols.append(_collect_update(col, layout, live2,
@@ -440,6 +475,10 @@ class _AggDeviceSpec:
                 if slot.merge_op == SUM128:
                     ncol = partial.columns[nkeys + self._count_companion(ai)]
                     cols.append(_global_sum128(col, ncol, live, slot.dtype))
+                    continue
+                if slot.merge_op in (MIN128, MAX128):
+                    cols.append(_global_extreme128(
+                        col, live, slot.dtype, slot.merge_op == MIN128))
                     continue
                 if slot.merge_op == COLLECT_MERGE:
                     cols.append(_collect_merge(col, None, live, 1))
@@ -480,6 +519,10 @@ class _AggDeviceSpec:
                 ncol = layout.sorted_batch.columns[
                     nkeys + self._count_companion(ai)]
                 cols.append(_seg_sum128(col, ncol, layout, slot.dtype))
+                continue
+            if slot.merge_op in (MIN128, MAX128):
+                cols.append(_seg_extreme128(col, layout, slot.dtype,
+                                            slot.merge_op == MIN128))
                 continue
             if slot.merge_op == COLLECT_MERGE:
                 live2 = layout.sorted_batch.live_mask()

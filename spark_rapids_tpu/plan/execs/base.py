@@ -58,7 +58,10 @@ def shared_jit(key: str, make_fn: Callable[[], Callable], **jit_kwargs):
             _JIT_CACHE.move_to_end(key)
             return fn
     import jax
-    made = jax.jit(make_fn(), **jit_kwargs)
+    from spark_rapids_tpu.memory.arena import translate_device_oom
+    # a REAL XLA RESOURCE_EXHAUSTED from any cached program enters the
+    # retry/spill machinery as TpuRetryOOM (DeviceMemoryEventHandler analog)
+    made = translate_device_oom(jax.jit(make_fn(), **jit_kwargs))
     with _JIT_CACHE_LOCK:
         fn = _JIT_CACHE.setdefault(key, made)   # racer may have won; reuse
         _JIT_CACHE.move_to_end(key)

@@ -176,7 +176,9 @@ def _build_registry() -> None:
     for cls in (Add, Subtract, Multiply):
         register(cls, ExprSig(NUMERIC_DEC + DEC128, NUMERIC_DEC + DEC128,
                               NUMERIC_DEC + DEC128))
-    register(Divide, ExprSig(FRACTIONAL + DEC64, NUMERIC_DEC, NUMERIC_DEC))
+    register(Divide, ExprSig(FRACTIONAL + DEC64 + DEC128,
+                         NUMERIC_DEC + DEC128,
+                         NUMERIC_DEC + DEC128))
     register(IntegralDivide, ExprSig(TypeSig("long"), INTEGRAL + DEC64,
                                      INTEGRAL + DEC64))
     register(Remainder, ExprSig(NUMERIC, NUMERIC, NUMERIC))
@@ -281,7 +283,7 @@ def _build_registry() -> None:
                             NUMERIC_DEC + DEC128))
     register(A.Count, ExprSig(TypeSig("long"), ALL_DEVICE))
     for cls in (A.Min, A.Max):
-        register(cls, ExprSig(ORDERED, ORDERED))
+        register(cls, ExprSig(ORDERED + DEC128, ORDERED + DEC128))
     register(A.Average, ExprSig(TypeSig("double", "decimal64",
                                        "decimal128"),
                                 NUMERIC_DEC + DEC128))

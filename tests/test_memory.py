@@ -199,3 +199,82 @@ def test_device_manager_probe_and_budget():
     finally:
         DM.probe_device = real
         device_arena().budget_bytes = before
+
+
+# -- real XLA RESOURCE_EXHAUSTED translation ---------------------------------
+# (reference contract: DeviceMemoryEventHandler.scala turns a real RMM
+# allocator failure into GpuRetryOOM; here jaxlib's XlaRuntimeError with a
+# RESOURCE_EXHAUSTED status must enter the same retry/spill machinery)
+
+class XlaRuntimeError(RuntimeError):
+    """Stand-in matching jaxlib's class BY NAME (is_device_oom matches the
+    MRO class name so it survives jaxlib module-layout changes)."""
+
+
+def test_is_device_oom_matches_resource_exhausted():
+    from spark_rapids_tpu.memory.arena import is_device_oom
+    assert is_device_oom(XlaRuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "1073741824 bytes."))
+    assert not is_device_oom(XlaRuntimeError("INVALID_ARGUMENT: bad shape"))
+    assert not is_device_oom(RuntimeError("RESOURCE_EXHAUSTED: not xla"))
+
+
+def test_real_oom_translates_to_retry_with_spill():
+    """A raw XlaRuntimeError(RESOURCE_EXHAUSTED) inside with_retry must
+    spill and re-run, not kill the task."""
+    h = make_spillable(mk_batch())
+    calls = {"n": 0}
+
+    def fn(_):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise XlaRuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory allocating 8589934592 "
+                "bytes (fragmentation outside the bookkept arena)")
+        return calls["n"]
+
+    assert with_retry([None], fn) == [2]
+    # the emergency spill evicted the (unpinned) device handle
+    assert not h.on_device()
+
+
+def test_real_oom_translates_in_no_split_path():
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+        return "ok"
+
+    assert with_retry_no_split(fn) == "ok"
+    assert calls["n"] == 2
+
+
+def test_translate_device_oom_wrapper():
+    """shared_jit wraps every cached program with translate_device_oom; the
+    wrapper converts only RESOURCE_EXHAUSTED and passes others through."""
+    from spark_rapids_tpu.memory.arena import translate_device_oom
+
+    @translate_device_oom
+    def boom():
+        raise XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+
+    with pytest.raises(TpuRetryOOM):
+        boom()
+
+    @translate_device_oom
+    def other():
+        raise XlaRuntimeError("INTERNAL: something else")
+
+    with pytest.raises(XlaRuntimeError):
+        other()
+
+
+def test_non_oom_exceptions_propagate_unchanged():
+    def fn(_):
+        raise ValueError("regular bug")
+
+    with pytest.raises(ValueError):
+        with_retry([None], fn)

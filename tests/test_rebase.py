@@ -1,0 +1,125 @@
+"""LEGACY parquet datetime rebase tests (hybrid Julian -> proleptic
+Gregorian; reference: sql-plugin/.../datetimeRebaseUtils.scala:53-58).
+
+A LEGACY-mode file is built in-test: pyarrow writes the raw hybrid day
+counts and the test stamps Spark's ``org.apache.spark.legacyDateTime``
+footer key, exactly what Spark's LEGACY writer produces.
+"""
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.io import rebase as R
+
+
+def _scalar_rebase_days(n: int) -> int:
+    """Independent scalar reference: hybrid day count -> Gregorian."""
+    if n >= R.CUTOVER_DAYS:
+        return n
+    jdn = n + 2440588
+    # Julian calendar date from JDN
+    c = jdn + 32082
+    d = (4 * c + 3) // 1461
+    e = c - (1461 * d) // 4
+    m = (5 * e + 2) // 153
+    day = e - (153 * m + 2) // 5 + 1
+    month = m + 3 - 12 * (m // 10)
+    year = d - 4800 + m // 10
+    return R._greg_days(year, month, day)
+
+
+def test_rebase_table_matches_scalar_reference():
+    rng = np.random.RandomState(3)
+    days = np.concatenate([
+        rng.randint(-500000, R.CUTOVER_DAYS, 500),   # ancient
+        rng.randint(R.CUTOVER_DAYS, 20000, 100),     # modern: no-op
+        np.array([R.CUTOVER_DAYS - 1, R.CUTOVER_DAYS,
+                  R.CUTOVER_DAYS + 1])]).astype(np.int64)
+    got = R.rebase_julian_to_gregorian_days(days)
+    for n, g in zip(days.tolist(), got.tolist()):
+        assert g == _scalar_rebase_days(n), n
+
+
+def test_known_cutover_identity():
+    """Spark's rebase is LABEL-preserving (RebaseDateTime: read the Julian
+    (y,m,d), reinterpret the same label as proleptic Gregorian): the last
+    hybrid day, Julian 1582-10-04, rebases to Gregorian-labeled
+    1582-10-04 — ten days earlier as an instant."""
+    n_julian = R._julian_jdn(1582, 10, 4) - 2440588
+    assert n_julian == R.CUTOVER_DAYS - 1
+    rebased = int(R.rebase_julian_to_gregorian_days(
+        np.array([n_julian], np.int64))[0])
+    assert rebased == R._greg_days(1582, 10, 4) == n_julian - 10
+    # and the first Gregorian day itself is untouched
+    assert int(R.rebase_julian_to_gregorian_days(
+        np.array([R.CUTOVER_DAYS], np.int64))[0]) == R.CUTOVER_DAYS
+
+
+def test_micros_rebase_follows_days():
+    day = R.CUTOVER_DAYS - 777
+    micros = np.array([day * R.MICROS_PER_DAY + 123_456_789], np.int64)
+    got = int(R.rebase_julian_to_gregorian_micros(micros)[0])
+    shifted_day = _scalar_rebase_days(day)
+    assert got == shifted_day * R.MICROS_PER_DAY + 123_456_789
+
+
+def _write_legacy_file(path: str, days, micros):
+    mask = [d is None for d in days]
+    darr = pa.array([0 if d is None else d for d in days], pa.int32(),
+                    mask=np.array(mask)).cast(pa.date32())
+    tarr = pa.array([0 if m is None else m for m in micros], pa.int64(),
+                    mask=np.array(mask)).cast(pa.timestamp("us"))
+    table = pa.table({"d": darr, "ts": tarr})
+    table = table.replace_schema_metadata(
+        {R.LEGACY_KEY.decode(): ""})
+    pq.write_table(table, path)
+
+
+def test_legacy_file_rebased_on_read(tmp_path):
+    """End to end: a file with the LEGACY tag reads back rebased; the same
+    data without the tag reads back raw (CORRECTED mode)."""
+    from spark_rapids_tpu.api.session import TpuSession
+    hybrid_days = [-200000, -150000, R.CUTOVER_DAYS - 1, 0, 18000, None]
+    micros = [(d if d is not None else 0) * R.MICROS_PER_DAY + 55
+              for d in hybrid_days[:-1]] + [None]
+
+    legacy = str(tmp_path / "legacy.parquet")
+    _write_legacy_file(legacy, hybrid_days, micros)
+    plain = str(tmp_path / "plain.parquet")
+    t = pq.read_table(legacy)
+    pq.write_table(t.replace_schema_metadata({}), plain)
+
+    s = TpuSession({"spark.rapids.sql.enabled": "false"})
+    got_legacy = s.read_parquet(legacy).collect()
+    got_plain = s.read_parquet(plain).collect()
+
+    epoch = datetime.date(1970, 1, 1)
+    for row_l, row_p, d in zip(got_legacy, got_plain, hybrid_days):
+        if d is None:
+            assert row_l[0] is None and row_p[0] is None
+            continue
+        expect_days = _scalar_rebase_days(d)
+        # date column: datetime.date can't represent year <= 0; compare
+        # via ordinal difference from a modern anchor
+        if row_l[0] is not None and isinstance(row_l[0], datetime.date):
+            assert (row_l[0] - epoch).days == expect_days
+            assert (row_p[0] - epoch).days == d
+        ts_l, ts_p = row_l[1], row_p[1]
+        if isinstance(ts_l, int):
+            assert ts_l == expect_days * R.MICROS_PER_DAY + 55
+            assert ts_p == d * R.MICROS_PER_DAY + 55
+
+
+def test_tpu_and_oracle_agree_on_legacy_file(tmp_path):
+    from tests.test_queries import assert_tpu_cpu_equal
+    hybrid_days = [-180000, -160000, -141500, 10, 19000]
+    micros = [d * R.MICROS_PER_DAY + 9 for d in hybrid_days]
+    path = str(tmp_path / "legacy2.parquet")
+    _write_legacy_file(path, hybrid_days, micros)
+
+    def q(s):
+        return s.read_parquet(path)
+    assert_tpu_cpu_equal(q)

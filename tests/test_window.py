@@ -163,3 +163,31 @@ def test_first_last_nth_value():
             over(NthValue(col("v"), 2), partition_by=["k"], order_by=["t"],
                  frame=WindowFrame("rows", 1, 1)).alias("nv"))
     assert_tpu_cpu_equal(q)
+
+
+def test_window_nested_in_scalar_expr():
+    """ExtractWindowExpressions: a window buried inside arithmetic plans as
+    Window + post-Project (Spark analyzer rule; GpuWindowExec.scala:145)."""
+    def q(s):
+        return wdf(s).select(
+            col("k"), col("t"),
+            (over(sum_("v"), partition_by=["k"], order_by=["t"])
+             + col("v")).alias("run_plus_v"),
+            (over(RowNumber(), partition_by=["k"], order_by=["t"]) * 10
+             ).alias("rn10"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_two_window_specs_one_select():
+    """Differing (partition_by, order_by) specs in one select chain as
+    stacked Window nodes; identical windows dedupe to one column."""
+    def q(s):
+        w1 = over(sum_("v"), partition_by=["k"], order_by=["t"])
+        return wdf(s).select(
+            col("k"), col("t"),
+            w1.alias("a"),
+            (w1 + 1).alias("a1"),  # same window reused
+            # Rank, not RowNumber: duplicate order keys tie deterministically
+            over(Rank(), partition_by=["t"], order_by=["v"]).alias("b"),
+            over(count(), partition_by=["k"]).alias("c"))
+    assert_tpu_cpu_equal(q)

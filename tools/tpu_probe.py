@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Standing TPU-evidence watcher (VERDICT r3 missing #1).
+
+The axon tunnel to the one real TPU chip has been down for whole sessions;
+when it comes back it may only stay up for minutes.  This script polls
+cheaply and, the moment a probe succeeds, fires the ≤60s SMOKE tier of
+bench.py (q6, one batch) and snapshots the artifact to
+``BENCH_smoke_<ts>.json`` at the repo root — committed evidence that the
+engine executed on real hardware even if the window closes again.
+
+Usage:
+    python tools/tpu_probe.py --once          # single probe(+smoke) pass
+    python tools/tpu_probe.py                 # watch loop (8 min cadence)
+    python tools/tpu_probe.py --full          # also run the full bench
+                                              # after a successful smoke
+
+Never raises; every cycle appends one line to --log (default
+/tmp/tpu_watch.log) so an operator can see the outage pattern.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp, json\n"
+    "d = jax.devices()\n"
+    "x = jnp.ones((256, 256), jnp.bfloat16)\n"
+    "jax.block_until_ready(x @ x)\n"
+    "print(json.dumps({'platform': d[0].platform, 'n_devices': len(d)}))\n"
+)
+
+
+def _last_json(text: str):
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def probe(timeout_s: int = 90):
+    """Return the live platform name ('tpu'/'axon'/...) or None."""
+    try:
+        p = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    if p.returncode != 0:
+        return None
+    out = _last_json(p.stdout)
+    plat = (out or {}).get("platform")
+    return plat if plat and plat != "cpu" else None
+
+
+def run_bench(smoke: bool, timeout_s: int):
+    env = dict(os.environ)
+    if smoke:
+        env["SPARK_RAPIDS_TPU_BENCH_SMOKE"] = "1"
+    else:
+        env.pop("SPARK_RAPIDS_TPU_BENCH_SMOKE", None)
+    try:
+        p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                           capture_output=True, text=True, timeout=timeout_s,
+                           env=env)
+    except subprocess.TimeoutExpired:
+        return None
+    return _last_json(p.stdout)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=480,
+                    help="seconds between probes in watch mode")
+    ap.add_argument("--once", action="store_true")
+    ap.add_argument("--full", action="store_true",
+                    help="after a tpu-backed smoke, also run the full bench "
+                         "and snapshot BENCH_tpu_<ts>.json")
+    ap.add_argument("--log", default="/tmp/tpu_watch.log")
+    ap.add_argument("--probe-timeout", type=int, default=90)
+    ap.add_argument("--smoke-timeout", type=int, default=600)
+    ap.add_argument("--full-timeout", type=int, default=3600)
+    args = ap.parse_args()
+
+    def log(msg: str) -> None:
+        stamp = datetime.datetime.now().isoformat(timespec="seconds")
+        line = f"{stamp} {msg}"
+        print(line, flush=True)
+        try:
+            with open(args.log, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass
+
+    while True:
+        plat = probe(args.probe_timeout)
+        if plat is None:
+            log("probe: no tpu backend")
+        else:
+            log(f"probe: LIVE platform={plat} — running smoke bench")
+            res = run_bench(smoke=True, timeout_s=args.smoke_timeout)
+            ts = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+            if res is not None:
+                path = os.path.join(REPO, f"BENCH_smoke_{ts}.json")
+                with open(path, "w") as f:
+                    json.dump(res, f, indent=1)
+                log(f"smoke: backend={res.get('backend')} "
+                    f"value={res.get('value')} -> {path}")
+                if res.get("backend") == "tpu":
+                    if args.full:
+                        full = run_bench(smoke=False,
+                                         timeout_s=args.full_timeout)
+                        if full is not None:
+                            fpath = os.path.join(REPO, f"BENCH_tpu_{ts}.json")
+                            with open(fpath, "w") as f:
+                                json.dump(full, f, indent=1)
+                            log(f"full: backend={full.get('backend')} "
+                                f"value={full.get('value')} -> {fpath}")
+                    return 0   # evidence captured; watcher's job is done
+            else:
+                log("smoke: bench timed out or produced no JSON")
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,24 @@
+"""Host integration layer (L6): standalone driver/executor deployment.
+
+The reference is a Spark PLUGIN — its L6 is SQLPlugin/ShimLoader plus
+driver & executor plugin processes wired through Spark RPC (reference:
+sql-plugin-api/src/main/scala/com/nvidia/spark/SQLPlugin.scala:27,
+Plugin.scala:444,589).  This framework is standalone, so L6 is a small
+driver/executor process pair of its own:
+
+  * TpuClusterDriver  — executor registry, CONFIG BROADCAST, serialized
+                        logical-plan dispatch, result collection
+                        (RapidsDriverPlugin + driver RPC endpoint analog);
+  * executor_main     — worker loop: register, receive the conf map,
+                        pull tasks, plan + execute the shipped logical
+                        plan over its input split with MULTIPROCESS
+                        shuffle, push results
+                        (RapidsExecutorPlugin analog).
+
+Cross-process shuffle rides the existing TCP block plane (shuffle/net.py)
+with shuffle ids coordinated by the driver registry, exactly like the
+reference's UCX mode hangs off the driver's heartbeat manager
+(RapidsShuffleHeartbeatManager.scala:33).
+"""
+from spark_rapids_tpu.cluster.driver import TpuClusterDriver  # noqa: F401
+from spark_rapids_tpu.cluster.executor import executor_main  # noqa: F401

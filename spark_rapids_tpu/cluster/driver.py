@@ -1,0 +1,153 @@
+"""Cluster driver: registry + config broadcast + plan dispatch.
+
+Reference analog: RapidsDriverPlugin (Plugin.scala:444) — fixes up and
+BROADCASTS the conf map to executors at registration (Plugin.scala:544),
+hosts the RPC endpoint executors talk to (Plugin.scala:450-485), and owns
+the shuffle heartbeat registry (RapidsShuffleHeartbeatManager.scala:33).
+
+Execution contract (v1): every executor plans the SAME pickled logical
+plan with the SAME conf (the planner is deterministic), executes only its
+rank's share of leaf-scan partitions, exchanges cross-process over the
+TCP block plane, and returns the rows of its share of ROOT partitions.
+The driver forces conf that keeps per-executor planning decisions
+identical and data-complete: broadcast joins off (a local-only build side
+would be partial) and AQE partition coalescing off (group boundaries
+would be computed from local sizes).
+"""
+from __future__ import annotations
+
+import pickle
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.shuffle.net import (
+    ShuffleExecutor, _recv_msg, _send_msg)
+
+#: conf forced on every executor so distributed planning stays identical
+#: and data-complete (see module doc)
+_CLUSTER_CONF = {
+    "spark.rapids.shuffle.mode": "MULTIPROCESS",
+    "spark.rapids.sql.join.broadcastRowThreshold": "0",
+    "spark.rapids.sql.adaptive.coalescePartitions.enabled": "false",
+}
+
+
+class TpuClusterDriver:
+    """Driver process object: start, submit queries, close."""
+
+    def __init__(self, conf: Optional[Dict[str, str]] = None,
+                 host: str = "127.0.0.1"):
+        self.conf_map = dict(conf or {})
+        self.conf_map.update(_CLUSTER_CONF)
+        # the driver hosts the shuffle registry too: one address for
+        # executors to register against (Plugin.scala:523-536 shape)
+        self.shuffle = ShuffleExecutor("driver", serve_registry=True,
+                                       role="driver", host=host)
+        self._lock = threading.Lock()
+        self._next_query = 0
+        self._tasks: Dict[str, dict] = {}       # executor_id -> task
+        self._results: Dict[int, Dict[str, object]] = {}
+        self._expected: Dict[int, List[str]] = {}
+
+        driver = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    header, payload = _recv_msg(self.request)
+                except ConnectionError:
+                    return
+                op = header.get("op")
+                if op == "exec_register":
+                    # registration response IS the config broadcast
+                    _send_msg(self.request, {
+                        "ok": True, "conf": driver.conf_map,
+                        "shuffle_addr": list(driver.shuffle.server.addr)})
+                elif op == "get_task":
+                    with driver._lock:
+                        task = driver._tasks.pop(header["executor_id"],
+                                                 None)
+                    if task is None:
+                        _send_msg(self.request, {"task": None})
+                    else:
+                        _send_msg(self.request,
+                                  {"task": {k: v for k, v in task.items()
+                                            if k != "plan"}},
+                                  task["plan"])
+                elif op == "task_result":
+                    qid = header["query_id"]
+                    with driver._lock:
+                        driver._results.setdefault(qid, {})[
+                            header["executor_id"]] = (
+                            header.get("error") or pickle.loads(payload))
+                    _send_msg(self.request, {"ok": True})
+                else:
+                    _send_msg(self.request, {"error": f"bad op {op!r}"})
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, 0), Handler)
+        self.rpc_addr: Tuple[str, int] = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- public --------------------------------------------------------------
+
+    def wait_for_executors(self, n: int, timeout_s: float = 60.0) -> List[str]:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            peers = self.shuffle.registry.peers(workers_only=True)
+            if len(peers) >= n:
+                return sorted(peers)
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"only {len(self.shuffle.registry.peers(workers_only=True))} "
+            f"of {n} executors registered")
+
+    def submit(self, logical_plan, timeout_s: float = 300.0) -> list:
+        """Dispatch one logical plan to every registered executor; block
+        for and combine their row results (rank order)."""
+        executors = sorted(
+            self.shuffle.registry.peers(workers_only=True))
+        assert executors, "no executors registered"
+        world = len(executors)
+        plan_bytes = pickle.dumps(logical_plan)
+        with self._lock:
+            qid = self._next_query
+            self._next_query += 1
+            self._expected[qid] = executors
+            for rank, eid in enumerate(executors):
+                self._tasks[eid] = {"query_id": qid, "rank": rank,
+                                    "world": world,
+                                    "participants": executors,
+                                    "plan": plan_bytes}
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                got = self._results.get(qid, {})
+                if len(got) == world:
+                    break
+            time.sleep(0.05)
+        with self._lock:
+            got = self._results.pop(qid, {})
+            self._expected.pop(qid, None)
+        if len(got) != world:
+            raise TimeoutError(
+                f"query {qid}: {len(got)}/{world} executor results")
+        rows: list = []
+        for eid in executors:
+            r = got[eid]
+            if isinstance(r, str):
+                raise RuntimeError(f"executor {eid} failed: {r}")
+            rows.extend(r)
+        return rows
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self.shuffle.close()

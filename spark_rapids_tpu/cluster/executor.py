@@ -1,0 +1,199 @@
+"""Cluster executor: the worker-process loop.
+
+Reference analog: RapidsExecutorPlugin.init (Plugin.scala:599) — receive
+the driver's conf map, initialize the local device/memory runtime, and
+register with the shuffle heartbeat endpoint; then Spark sends tasks.
+Here the tasks are whole pickled LOGICAL plans: the executor plans them
+locally (deterministic planner + identical broadcast conf => identical
+physical plan on every rank), executes its share, and pushes rows back.
+
+Input split: leaf scans are wrapped so rank r of w serves only partitions
+p with p % w == r; exchange map sides therefore slice local data only,
+and the TCP block plane re-assembles complete reduce partitions across
+processes.  Root output is split the same way.
+"""
+from __future__ import annotations
+
+import pickle
+import time
+import traceback
+from typing import Iterator, Tuple
+
+from spark_rapids_tpu.shuffle.net import _request
+
+
+class _RankFilteredScan:
+    """Wraps a leaf scan so only this rank's partitions yield rows (the
+    executor's input split).  Duck-typed as a TpuExec: parents only call
+    schema/num_partitions/execute_partition/cleanup/describe."""
+
+    def __init__(self, inner, rank: int, world: int):
+        self.inner = inner
+        self.rank = rank
+        self.world = world
+        self.children = inner.children
+
+    @property
+    def schema(self):
+        return self.inner.schema
+
+    def num_partitions(self) -> int:
+        return self.inner.num_partitions()
+
+    def execute_partition(self, idx: int) -> Iterator:
+        if idx % self.world == self.rank:
+            yield from self.inner.execute_partition(idx)
+
+    def cleanup(self) -> None:
+        self.inner.cleanup()
+
+    def describe(self):
+        return (f"RankFilteredScan[{self.rank}/{self.world}, "
+                f"{self.inner.describe()}]")
+
+    def tree_string(self, indent: int = 0) -> str:
+        return " " * indent + self.describe()
+
+
+def _wrap_scans(exec_node, rank: int, world: int):
+    """Rank-split the plan in place: every EXCHANGE's map-side input and
+    every leaf scan serves only partitions p with p % world == rank.
+
+    Splitting exchange inputs (not just leaves) is what keeps stages
+    between two exchanges from running on every rank: without it, both
+    ranks would drive e.g. a final aggregate's full output into the next
+    exchange and the downstream join would see every build row once PER
+    RANK (duplicates).  Exchange READS stay unfiltered — the TCP plane
+    reassembles complete reduce partitions.  Double-wrapping a leaf that
+    already sits under an exchange child is harmless (same predicate)."""
+    from spark_rapids_tpu.plan.execs.exchange import TpuShuffleExchangeExec
+    kids = []
+    for c in exec_node.children:
+        _wrap_scans(c, rank, world)
+        if isinstance(exec_node, TpuShuffleExchangeExec):
+            kids.append(_RankFilteredScan(c, rank, world))
+        elif not c.children:
+            kids.append(_RankFilteredScan(c, rank, world))
+        else:
+            kids.append(c)
+    exec_node.children = tuple(kids)
+
+
+def _check_distributable(physical) -> None:
+    """Cluster v1 moves data between ranks ONLY through hash exchanges.
+    A single-partition gather or a locally-sampled range sort would fold
+    only the local rank's rows and return silently partial results —
+    refuse loudly instead (the networked global-stage path is the
+    follow-on)."""
+    from spark_rapids_tpu.plan.execs.exchange import TpuSinglePartitionExec
+    from spark_rapids_tpu.plan.execs.range_sort import TpuRangeSortExec
+
+    def walk(n):
+        if isinstance(n, (TpuSinglePartitionExec, TpuRangeSortExec)):
+            raise NotImplementedError(
+                f"cluster v1 cannot distribute {type(n).__name__} (global "
+                "single-partition / sampled stages): rewrite with a "
+                "grouped aggregation or collect-and-sort on the driver")
+        for c in n.children:
+            walk(c)
+    walk(physical)
+
+
+def run_task(task: dict, plan_bytes: bytes, conf_map: dict) -> list:
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.memory import initialize_memory
+    from spark_rapids_tpu.plan.cpu_engine import CpuTable
+    from spark_rapids_tpu.planner.overrides import plan_query
+
+    from spark_rapids_tpu.shuffle.transport import (
+        set_cluster_participants, set_cluster_query)
+    rank, world = task["rank"], task["world"]
+    set_cluster_participants(task.get("participants"))
+    set_cluster_query(task["query_id"])
+    conf = RapidsConf(dict(conf_map))
+    initialize_memory(conf)
+    logical = pickle.loads(plan_bytes)
+    physical, _meta = plan_query(logical, conf)
+    if world > 1:
+        _check_distributable(physical)
+        if not physical.children:
+            physical = _RankFilteredScan(physical, rank, world)
+        else:
+            _wrap_scans(physical, rank, world)
+    rows: list = []
+    try:
+        n_out = physical.num_partitions()
+        for p in range(n_out):
+            if p % world != rank:
+                continue
+            for batch in physical.execute_partition(p):
+                rows.extend(CpuTable.from_batch(batch).rows())
+    except Exception:
+        physical.cleanup()
+        raise
+    finally:
+        set_cluster_query(None)
+        set_cluster_participants(None)
+    # NO cleanup on success: this rank's shuffle blocks must outlive ITS
+    # OWN task — a peer may still be fetching them (the reference keeps
+    # shuffle files until the driver's ShuffleCleanupManager says drop,
+    # Plugin.scala:497-521).  The worker loop disposes it before the next
+    # task, when the driver has necessarily collected every rank.
+    return rows, physical
+
+
+def executor_main(driver_rpc_addr: Tuple[str, int],
+                  executor_id: str = None,
+                  stop_check=None,
+                  poll_s: float = 0.1) -> None:
+    """Worker loop: register -> conf broadcast -> pull/run/push tasks.
+    Returns when stop_check() is true (tests) — production workers run
+    until killed, like Spark executors."""
+    from spark_rapids_tpu.shuffle.net import ShuffleExecutor
+    from spark_rapids_tpu.shuffle.transport import (
+        set_process_shuffle_executor)
+
+    reg, _ = _request(driver_rpc_addr, {"op": "exec_register"})
+    conf_map = reg["conf"]
+    shuffle_addr = tuple(reg["shuffle_addr"])
+    node = ShuffleExecutor(executor_id, driver_addr=shuffle_addr)
+    set_process_shuffle_executor(node)
+
+    last_hb = 0.0
+    pending_cleanup = None
+    while not (stop_check and stop_check()):
+        header, payload = _request(
+            driver_rpc_addr, {"op": "get_task",
+                              "executor_id": node.executor_id})
+        task = header.get("task")
+        if task is None:
+            now = time.monotonic()
+            if now - last_hb > 5.0:
+                node.heartbeat()
+                last_hb = now
+            time.sleep(poll_s)
+            continue
+        # previous query fully collected by the driver (it handed us a
+        # new task) -> its shuffle blocks are safe to drop now
+        if pending_cleanup is not None:
+            try:
+                pending_cleanup.cleanup()
+            except Exception:
+                pass
+            pending_cleanup = None
+        try:
+            # refresh the peer view FIRST: reduce-side fetches enumerate
+            # peers, and a task can arrive before the first idle-loop
+            # heartbeat (half-data hazard: completeness is driver-side,
+            # fetch targets are the local view)
+            node.heartbeat()
+            rows, pending_cleanup = run_task(task, payload, conf_map)
+            _request(driver_rpc_addr,
+                     {"op": "task_result", "query_id": task["query_id"],
+                      "executor_id": node.executor_id},
+                     pickle.dumps(rows))
+        except Exception:  # noqa: BLE001 — report, don't kill the worker
+            _request(driver_rpc_addr,
+                     {"op": "task_result", "query_id": task["query_id"],
+                      "executor_id": node.executor_id,
+                      "error": traceback.format_exc()})

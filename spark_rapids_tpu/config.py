@@ -156,6 +156,15 @@ SHUFFLE_PARTITIONS = conf("spark.sql.shuffle.partitions").doc(
     "Number of reduce-side partitions for shuffle exchanges."
 ).int_conf(16)
 
+AQE_COALESCE_PARTITIONS = conf(
+    "spark.rapids.sql.adaptive.coalescePartitions.enabled").doc(
+    "Merge undersized reduce partitions at exchange read time using the "
+    "materialized map-output row counts (AQE partition coalescing; "
+    "reference: GpuCustomShuffleReaderExec.scala:82 reading Spark's "
+    "CoalescedPartitionSpec).  Co-partitioned join sides always merge "
+    "with one shared spec so co-partitioning is preserved."
+).boolean_conf(True)
+
 SHUFFLE_MODE = conf("spark.rapids.shuffle.mode").doc(
     "CACHE_ONLY: partition slices stay device-resident as spillable handles "
     "(reference CACHE_ONLY / RapidsCachingWriter shape — the fast in-process "
@@ -347,6 +356,10 @@ class RapidsConf:
     @property
     def shuffle_partitions(self) -> int:
         return self.get(SHUFFLE_PARTITIONS)
+
+    @property
+    def aqe_coalesce_partitions(self) -> bool:
+        return self.get(AQE_COALESCE_PARTITIONS)
 
     @property
     def shuffle_mode(self) -> str:

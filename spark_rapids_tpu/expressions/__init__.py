@@ -178,7 +178,7 @@ from spark_rapids_tpu.expressions.datetime import (
     FromUtcTimestamp, ToUtcTimestamp, from_utc_timestamp,
     to_utc_timestamp)
 from spark_rapids_tpu.expressions.aggregates import (
-    Percentile, percentile)
+    ApproxPercentile, Percentile, approx_percentile, percentile)
 from spark_rapids_tpu.expressions.hashing import HiveHash, hive_hash
 from spark_rapids_tpu.expressions.strings import (
     Conv, ParseUrl, conv, parse_url)

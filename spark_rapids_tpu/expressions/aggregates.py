@@ -37,6 +37,10 @@ MIN128 = "min128"            # lexicographic two-limb min (decimal128)
 MAX128 = "max128"            # lexicographic two-limb max (decimal128)
 COLLECT = "collect"          # gather the group's values into an array row
 COLLECT_MERGE = "collect_merge"
+TD_MEANS = "td_means"        # t-digest centroid means (approx_percentile)
+TD_WEIGHTS = "td_weights"    # t-digest centroid weights
+TD_MEANS_MERGE = "td_means_merge"
+TD_WEIGHTS_MERGE = "td_weights_merge"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -624,3 +628,81 @@ class Percentile(AggregateFunction):
 def percentile(e, p: float) -> Percentile:
     from spark_rapids_tpu.expressions.core import col as _col
     return Percentile(_col(e) if isinstance(e, str) else e, p)
+
+
+class ApproxPercentile(AggregateFunction):
+    """approx_percentile(col, p[, accuracy]) via t-digest.
+
+    Reference: GpuApproximatePercentile.scala:58-74 — the reference
+    replaces Spark CPU's Greenwald-Khanna summaries with cuDF's t-digest
+    and documents that results agree within the accuracy tolerance, not
+    bitwise.  Same contract here: the digest is mergeable across shuffles
+    (two-phase agg safe) and the answer's rank error is O(1/delta) with
+    tail compression (k1 scale).
+
+    Buffers: centroid means + weights as var-length array rows, plus
+    scalar min/max (tail clamping).  Scalar percentage only; array
+    percentages fall back (planner gate).
+    """
+
+    name = "approx_percentile"
+
+    def __init__(self, child: Expression, percentage: float,
+                 accuracy: int = 10000):
+        assert 0.0 <= percentage <= 1.0, percentage
+        self.children = (child,)
+        self.percentage = float(percentage)
+        self.accuracy = int(accuracy)
+        # delta caps the centroid count; beyond ~500 the array rows cost
+        # more than the rank error buys (reference passes accuracy as the
+        # cuDF delta; we bound it for the static element planes)
+        self.delta = max(20, min(self.accuracy, 500))
+
+    def with_children(self, children):
+        return ApproxPercentile(children[0], self.percentage, self.accuracy)
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def buffers(self):
+        arr = T.ArrayType(T.DOUBLE, contains_null=False)
+        return (BufferSlot(arr, TD_MEANS, TD_MEANS_MERGE),
+                BufferSlot(arr, TD_WEIGHTS, TD_WEIGHTS_MERGE),
+                BufferSlot(T.DOUBLE, MIN, MIN),
+                BufferSlot(T.DOUBLE, MAX, MAX))
+
+    def finalize_np(self, bufs):
+        import numpy as np
+
+        from spark_rapids_tpu.kernels import tdigest as TD
+        (means, mv), (weights, _), (mn, _), (mx, _) = bufs
+        n = len(means)
+        out = np.zeros((n,), np.float64)
+        ok = np.zeros((n,), np.bool_)
+        for i in range(n):
+            if not mv[i] or means[i] is None:
+                continue
+            r = TD.np_interpolate(means[i], weights[i],
+                                  float(mn[i]), float(mx[i]),
+                                  self.percentage)
+            if r is not None:
+                out[i] = r
+                ok[i] = True
+        return out, ok
+
+    def finalize_jnp(self, bufs):
+        from spark_rapids_tpu.kernels import tdigest as TD
+        (mc, _), (wc, _), (mn, mn_ok), (mx, _) = bufs
+        val, ok = TD.interpolate(mc, wc, mn, mx, self.percentage)
+        return val, ok & mn_ok
+
+
+def approx_percentile(e, p: float, accuracy: int = 10000) -> ApproxPercentile:
+    from spark_rapids_tpu.expressions.core import col
+    return ApproxPercentile(col(e) if isinstance(e, str) else e, p, accuracy)

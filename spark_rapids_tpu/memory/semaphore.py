@@ -56,6 +56,10 @@ class TpuSemaphore:
         self._sem = PrioritySemaphore(concurrent_tasks)
         self._tls = threading.local()
 
+    def held_count(self) -> int:
+        """This thread's reentrant hold count (0 for non-task threads)."""
+        return getattr(self._tls, "held", 0)
+
     def acquire_if_necessary(self, priority: int = 0) -> None:
         if getattr(self._tls, "held", 0) == 0:
             self._sem.acquire(priority)

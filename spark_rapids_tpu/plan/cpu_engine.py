@@ -33,6 +33,8 @@ from spark_rapids_tpu.expressions.aggregates import (
     MIN128,
     SUM,
     SUM128,
+    TD_MEANS,
+    TD_WEIGHTS,
     M2,
     AggregateFunction,
 )
@@ -411,7 +413,8 @@ class CpuEngine:
                     continue
                 two_limb = (isinstance(slot.dtype, T.DecimalType)
                             and slot.dtype.uses_two_limbs)
-                holistic = slot.update_op == COLLECT
+                holistic = slot.update_op in (COLLECT, TD_MEANS,
+                                              TD_WEIGHTS)
                 bv = np.zeros((n_groups,),
                               object if two_limb or holistic
                               else slot.dtype.np_dtype)
@@ -427,6 +430,11 @@ class CpuEngine:
                         bv[gi] = len(sel)
                     elif slot.update_op == COLLECT:
                         bv[gi] = [float(x) for x in vals[sel]]
+                    elif slot.update_op in (TD_MEANS, TD_WEIGHTS):
+                        from spark_rapids_tpu.kernels.tdigest import np_digest
+                        ms, ws = np_digest(
+                            np.asarray(vals[sel], np.float64), agg.delta)
+                        bv[gi] = ms if slot.update_op == TD_MEANS else ws
                     elif len(sel) == 0:
                         bv[gi] = 0
                         if two_limb:
@@ -650,9 +658,7 @@ class CpuEngine:
 
         idx = sorted(range(t.num_rows),
                      key=lambda r: (tuple(
-                         _SortKey(0, _norm_key(v[r], m[r], dt))
-                         if False else _sort_key_for(v[r], m[r], dt,
-                                                     SortOrder(True))
+                         _sort_key_for(v[r], m[r], dt, SortOrder(True))
                          for (v, m), dt in pkeys),
                          tuple(_sort_key_for(v[r], m[r], dt, o)
                                for (v, m), dt, o in okeys)))

@@ -43,6 +43,10 @@ from spark_rapids_tpu.expressions.aggregates import (
     MIN128,
     SUM,
     SUM128,
+    TD_MEANS,
+    TD_MEANS_MERGE,
+    TD_WEIGHTS,
+    TD_WEIGHTS_MERGE,
     AggregateFunction,
 )
 from spark_rapids_tpu.kernels import groupby as G
@@ -361,6 +365,17 @@ class _AggDeviceSpec:
             f"SUM128 needs a COUNT_VALID companion buffer on "
             f"{self.aggregates[ai]!r}")
 
+    def _td_companion(self, ai: int, update_op: str) -> int:
+        """Slot index of this aggregate's other t-digest plane (means <->
+        weights): the merge re-clustering needs both."""
+        for si in self._slot_pos[ai]:
+            _, slot = self.slot_specs[si]
+            if slot.update_op == update_op:
+                return si
+        raise AssertionError(
+            f"t-digest merge needs a {update_op} companion buffer on "
+            f"{self.aggregates[ai]!r}")
+
     def _merge_bucket(self, partial: ColumnarBatch) -> int:
         from spark_rapids_tpu.kernels import strings as SK
         m = 0
@@ -405,6 +420,14 @@ class _AggDeviceSpec:
                 if slot.update_op == COLLECT:
                     cols.append(_collect_update(col, None, live, 1))
                     continue
+                if slot.update_op in (TD_MEANS, TD_WEIGHTS):
+                    from spark_rapids_tpu.kernels import tdigest as TDK
+                    agg_ = self.aggregates[ai]
+                    cols.append(TDK.global_update(
+                        col, live, agg_.delta,
+                        "means" if slot.update_op == TD_MEANS
+                        else "weights"))
+                    continue
                 v, valid = _global_update(slot.update_op, col, live, slot.dtype)
                 data = jnp.where(valid, v, jnp.zeros((), v.dtype))
                 cols.append(DeviceColumn(
@@ -448,6 +471,12 @@ class _AggDeviceSpec:
                 cols.append(_collect_update(col, layout, live2,
                                             layout.num_groups))
                 continue
+            if slot.update_op in (TD_MEANS, TD_WEIGHTS):
+                from spark_rapids_tpu.kernels import tdigest as TDK
+                cols.append(TDK.seg_update(
+                    col, layout, agg.delta,
+                    "means" if slot.update_op == TD_MEANS else "weights"))
+                continue
             v, valid = _seg_update(slot.update_op, col, layout, slot.dtype)
             cols.append(G.finalize_agg_column(
                 v.astype(slot.dtype.jnp_dtype), valid, layout.num_groups,
@@ -482,6 +511,17 @@ class _AggDeviceSpec:
                     continue
                 if slot.merge_op == COLLECT_MERGE:
                     cols.append(_collect_merge(col, None, live, 1))
+                    continue
+                if slot.merge_op in (TD_MEANS_MERGE, TD_WEIGHTS_MERGE):
+                    from spark_rapids_tpu.kernels import tdigest as TDK
+                    m_si = self._td_companion(ai, TD_MEANS)
+                    w_si = self._td_companion(ai, TD_WEIGHTS)
+                    mc = partial.columns[nkeys + m_si]
+                    wc = partial.columns[nkeys + w_si]
+                    cols.append(TDK.global_merge(
+                        mc, wc, live, self.aggregates[ai].delta,
+                        "means" if slot.merge_op == TD_MEANS_MERGE
+                        else "weights"))
                     continue
                 if slot.merge_op == M2_MERGE:
                     s_si, n_si = self._m2_companions(ai)
@@ -529,6 +569,17 @@ class _AggDeviceSpec:
                 cols.append(_collect_merge(col, layout, live2,
                                            layout.num_groups))
                 continue
+            if slot.merge_op in (TD_MEANS_MERGE, TD_WEIGHTS_MERGE):
+                from spark_rapids_tpu.kernels import tdigest as TDK
+                m_si = self._td_companion(ai, TD_MEANS)
+                w_si = self._td_companion(ai, TD_WEIGHTS)
+                mc = layout.sorted_batch.columns[nkeys + m_si]
+                wc = layout.sorted_batch.columns[nkeys + w_si]
+                cols.append(TDK.seg_merge(
+                    mc, wc, layout, self.aggregates[ai].delta,
+                    "means" if slot.merge_op == TD_MEANS_MERGE
+                    else "weights"))
+                continue
             if slot.merge_op == M2_MERGE:
                 s_si, n_si = self._m2_companions(ai)
                 v, valid = G.seg_m2_merge(
@@ -553,7 +604,9 @@ class _AggDeviceSpec:
                 if slot.update_op == HLL_UPDATE:
                     bufs.append((_hll_regs2d(c, merged.capacity, agg.m),
                                  c.validity))
-                elif slot.update_op == COLLECT or c.children is not None:
+                elif (slot.update_op in (COLLECT, TD_MEANS,
+                                         TD_WEIGHTS)
+                      or c.children is not None):
                     bufs.append((c, c.validity))   # holistic/limb columns
                 else:
                     bufs.append((c.data, c.validity))

@@ -82,6 +82,9 @@ class TpuShuffleExchangeExec(TpuExec):
         self.target_rows = max(int(target_rows), 1)
         self._lock = threading.Lock()
         self._transport = None   # built lazily per query (the SPI seam)
+        # per-partition row stats cost a host sync per piece: collected
+        # only when an AQE coalescing spec registered interest
+        self._want_part_stats = False
 
         keys_t, n_out = self.keys, self.out_partitions  # no self-capture
 
@@ -113,8 +116,11 @@ class TpuShuffleExchangeExec(TpuExec):
     # -- map side -----------------------------------------------------------
 
     def _slices(self):
-        """Device-side slice of every input batch -> (partition, piece)."""
+        """Device-side slice of every input batch -> (partition, piece).
+        Per-partition row counts are recorded as they stream past — the
+        MapStatus sizes that AQE partition coalescing plans from."""
         child = self.children[0]
+        self._part_rows = [0] * self.out_partitions
         for in_part in range(child.num_partitions()):
             for batch in child.execute_partition(in_part):
                 with timed(self.op_time):
@@ -126,7 +132,16 @@ class TpuShuffleExchangeExec(TpuExec):
                                              self.out_partitions)
                     for p, piece in enumerate(pieces):
                         if piece is not None:
+                            if self._want_part_stats:
+                                self._part_rows[p] += piece.host_num_rows()
                             yield p, piece
+
+    def partition_row_counts(self) -> List[int]:
+        """Materialize the map side and return rows per reduce partition
+        (the runtime statistics AQE coalescing reads)."""
+        self._materialize()
+        return list(getattr(self, "_part_rows",
+                            [0] * self.out_partitions))
 
     def _materialize(self):
         """Run the map side once, writing slices through the transport SPI
@@ -186,6 +201,83 @@ class TpuShuffleExchangeExec(TpuExec):
     def describe(self):
         keys = ", ".join(map(repr, self.keys))
         return f"TpuShuffleExchange[{self.out_partitions}, keys=[{keys}]]"
+
+
+class SharedCoalesceSpec:
+    """ONE contiguous-partition grouping computed from the COMBINED
+    materialized sizes of every exchange feeding a consumer.
+
+    Spark AQE's CoalesceShufflePartitions contract (reference:
+    GpuCustomShuffleReaderExec.scala:82 reading CoalescedPartitionSpec):
+    co-partitioned join sides must merge with the same spec, or partition
+    i on the left no longer holds the same key space as partition i on
+    the right.  Greedy merge of adjacent partitions until the combined
+    row count reaches the target."""
+
+    def __init__(self, target_rows: int):
+        self.target_rows = max(int(target_rows), 1)
+        self.exchanges: List[TpuShuffleExchangeExec] = []
+        self._groups: Optional[List[List[int]]] = None
+        self._lock = threading.Lock()
+
+    def register(self, ex: "TpuShuffleExchangeExec") -> None:
+        ex._want_part_stats = True    # before any materialization (plan
+        self.exchanges.append(ex)     # post-pass runs pre-execution)
+
+    def groups(self) -> List[List[int]]:
+        with self._lock:
+            if self._groups is not None:
+                return self._groups
+            counts = None
+            for ex in self.exchanges:
+                c = ex.partition_row_counts()
+                counts = c if counts is None else \
+                    [a + b for a, b in zip(counts, c)]
+            assert counts is not None, "spec with no registered exchange"
+            groups: List[List[int]] = []
+            cur: List[int] = []
+            acc = 0
+            for p, n in enumerate(counts):
+                cur.append(p)
+                acc += n
+                if acc >= self.target_rows:
+                    groups.append(cur)
+                    cur = []
+                    acc = 0
+            if cur:
+                groups.append(cur)
+            if not groups:
+                groups = [[p] for p in range(len(counts))]
+            self._groups = groups
+            return groups
+
+
+class TpuCoalescedShuffleReaderExec(TpuExec):
+    """Reduce-side adaptive reader: presents the exchange's partitions
+    re-grouped by a SharedCoalesceSpec, so many undersized reduce tasks
+    become few full ones (reference: GpuCustomShuffleReaderExec.scala:26).
+    num_partitions() materializes the map side — exactly the AQE staging
+    point where runtime statistics become available."""
+
+    def __init__(self, exchange: TpuShuffleExchangeExec,
+                 spec: SharedCoalesceSpec):
+        super().__init__((exchange,), exchange.schema)
+        self.spec = spec
+        spec.register(exchange)
+
+    def num_partitions(self) -> int:
+        return len(self.spec.groups())
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        for p in self.spec.groups()[idx]:
+            for batch in self.children[0].execute_partition(p):
+                self.output_rows.add(batch.num_rows)
+                yield self._count_out(batch)
+
+    def describe(self):
+        n = len(self.spec._groups) if self.spec._groups else "?"
+        return (f"TpuCoalescedShuffleReader[{n} of "
+                f"{self.children[0].num_partitions()} partitions]")
 
 
 class TpuSinglePartitionExec(TpuExec):

@@ -200,16 +200,20 @@ class _JoinKernel:
                     lambda: jitted_expand(out_capacity, byte_caps, path)))
 
     def _string_out_cols(self, l: ColumnarBatch, r: ColumnarBatch):
-        """output ordinal -> source child capacity for variable-width
-        (string/array) columns."""
+        """(output ordinal, nested path) -> source plane capacity for EVERY
+        offsets plane in the output columns — top-level strings/arrays AND
+        planes nested inside struct/map children (the capacity-retry
+        unlock for struct{string} / var-width map payloads)."""
+        from spark_rapids_tpu.kernels.selection import (
+            nested_offset_paths, path_plane_capacity)
         out = {}
         idx = 0
         sides = ([l] if self.join_type in ("left_semi", "left_anti",
                                            "existence") else [l, r])
         for side in sides:
             for c in side.columns:
-                if c.offsets is not None:
-                    out[idx] = c.byte_capacity
+                for p in nested_offset_paths(c):
+                    out[(idx, p)] = path_plane_capacity(c, p)
                 idx += 1
         return out
 

@@ -61,25 +61,36 @@ class _PooledScanExec(TpuExec):
 
         sem = tpu_semaphore()
         it = prefetched(lambda: self._host_iter(idx), reader_threads)
-        while True:
-            # wait for decode OFF the semaphore
-            sem.release_if_necessary()
-            try:
-                with trace_range("scan.wait",
-                                 "task waiting for a decoded chunk "
-                                 "(semaphore released)"):
-                    table = next(it)
-            except StopIteration:
-                sem.acquire_if_necessary()   # restore the engine's count
-                return
-            sem.acquire_if_necessary()
-            with timed(self.op_time), \
-                    trace_range("scan.upload",
-                                "Arrow host chunk -> HBM batch upload "
-                                "(semaphore held)"):
-                batch = arrow_to_batch(table)
-            self.output_rows.add(batch.num_rows)
-            yield self._count_out(batch)
+        # the decode cycle releases/reacquires the semaphore; it must
+        # restore the CALLER's hold count on every exit path.  A bare
+        # "+1 on exit" leaked a permanent permit whenever the scan ran on
+        # a non-task thread (e.g. an AQE reader materializing inside
+        # num_partitions()) — two such leaks deadlock the whole engine.
+        restore = sem.held_count()
+        try:
+            while True:
+                # wait for decode OFF the semaphore
+                sem.release_if_necessary()
+                try:
+                    with trace_range("scan.wait",
+                                     "task waiting for a decoded chunk "
+                                     "(semaphore released)"):
+                        table = next(it)
+                except StopIteration:
+                    return
+                sem.acquire_if_necessary()
+                with timed(self.op_time), \
+                        trace_range("scan.upload",
+                                    "Arrow host chunk -> HBM batch upload "
+                                    "(semaphore held)"):
+                    batch = arrow_to_batch(table)
+                self.output_rows.add(batch.num_rows)
+                yield self._count_out(batch)
+        finally:
+            while sem.held_count() > restore:
+                sem.release_if_necessary()
+            while sem.held_count() < restore:
+                sem.acquire_if_necessary()
 
 
 class TpuParquetScanExec(_PooledScanExec):

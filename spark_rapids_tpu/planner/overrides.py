@@ -168,7 +168,8 @@ from spark_rapids_tpu.expressions.strings import GetJsonObject
 from spark_rapids_tpu.expressions.hashing import HiveHash
 
 _SUPPORTED_EXPRS |= {Murmur3Hash, XxHash64, BloomFilterMightContain,
-                     GetJsonObject, HiveHash, A.Percentile}
+                     GetJsonObject, HiveHash, A.Percentile,
+                     A.ApproxPercentile}
 
 # dtypes device kernels support in expression compute
 _COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
@@ -191,14 +192,14 @@ def _dtype_ok(dt: T.DataType) -> bool:
     if isinstance(dt, T.StructType):
         return all(_dtype_ok(f.dtype) for f in dt.fields)
     if isinstance(dt, T.MapType):
-        # v1 map layout: fixed-width keys and values
-        return (_dtype_ok(dt.key_type) and not dt.key_type.variable_width
-                and not isinstance(dt.key_type, (T.ArrayType, T.StructType,
-                                                 T.MapType))
-                and _dtype_ok(dt.value_type)
-                and not dt.value_type.variable_width
-                and not isinstance(dt.value_type,
-                                   (T.ArrayType, T.StructType, T.MapType)))
+        # map layout: primitive or STRING keys/values (string children get
+        # their own offsets plane; nested containers inside maps are the
+        # remaining follow-on)
+        def _entry_ok(et):
+            return (et is not None and _dtype_ok(et)
+                    and not isinstance(et, (T.ArrayType, T.StructType,
+                                            T.MapType)))
+        return _entry_ok(dt.key_type) and _entry_ok(dt.value_type)
     return isinstance(dt, _COMPUTE_OK)
 
 
@@ -587,20 +588,9 @@ class PlanMeta:
                 self.will_not_work(
                     f"keyless {p.join_type} join without a condition "
                     "(use cross join)")
-            def _struct_varwidth_leaf(dt):
-                if isinstance(dt, T.StructType):
-                    return any(_struct_varwidth_leaf(f.dtype)
-                               for f in dt.fields)
-                return dt.variable_width
-            for dt in (list(p.left.schema.dtypes)
-                       + list(p.right.schema.dtypes)):
-                if isinstance(dt, T.StructType) and _struct_varwidth_leaf(dt):
-                    # join gathers repeat rows; string buffers nested in
-                    # struct children have no byte-capacity retry yet
-                    self.will_not_work(
-                        f"join over struct payload {dt!r} with "
-                        "variable-width fields not supported yet")
-                    break
+            # struct payloads with variable-width leaves are fine: nested
+            # gathers carry per-plane byte capacities through the join's
+            # capacity-retry loop (kernels/selection.py byte_caps)
             if p.condition is not None:
                 for ref_dt in _leaf_ref_dtypes(p.condition):
                     if isinstance(ref_dt, (T.ArrayType, T.StructType,
@@ -1031,11 +1021,49 @@ def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
     apply_cbo(meta, conf)
     apply_post_tag_rules(meta, conf)
     exec_plan = meta.convert()
+    exec_plan = _insert_aqe_readers(exec_plan, conf)
     # LORE id assignment + dump wrapping (GpuLore.tagForLore analog,
     # GpuOverrides.scala:5149)
     from spark_rapids_tpu.plan.execs.lore import apply_lore
     exec_plan = apply_lore(exec_plan, conf)
     return exec_plan, meta
+
+
+def _insert_aqe_readers(root: TpuExec, conf: RapidsConf) -> TpuExec:
+    """POST-pass AQE partition coalescing (GpuCustomShuffleReaderExec
+    analog): wrap hash exchanges feeding final aggregates / shuffled joins
+    with runtime coalescing readers.  Runs AFTER every structural planning
+    decision — reader.num_partitions() materializes the map side (that is
+    the AQE staging point), so it must never be consulted at plan time.
+    Join sides share ONE spec so co-partitioning survives the merge.
+    Skipped for ICI sessions: the SPMD program inlines the exchange as an
+    all-to-all with no reduce-task granularity to merge."""
+    if (not conf.aqe_coalesce_partitions
+            or conf.shuffle_mode == "ICI"):
+        return root
+    from spark_rapids_tpu.plan.execs.exchange import (
+        SharedCoalesceSpec, TpuCoalescedShuffleReaderExec,
+        TpuShuffleExchangeExec)
+    from spark_rapids_tpu.plan.execs.join import TpuShuffledHashJoinExec
+
+    def visit(node: TpuExec) -> None:
+        kids = list(node.children)
+        if (isinstance(node, TpuHashAggregateExec)
+                and getattr(node, "mode", None) == "final"
+                and kids and isinstance(kids[0], TpuShuffleExchangeExec)):
+            kids[0] = TpuCoalescedShuffleReaderExec(
+                kids[0], SharedCoalesceSpec(conf.batch_size_rows))
+        elif (isinstance(node, TpuShuffledHashJoinExec) and len(kids) == 2
+              and all(isinstance(k, TpuShuffleExchangeExec)
+                      for k in kids)):
+            spec = SharedCoalesceSpec(conf.batch_size_rows)
+            kids = [TpuCoalescedShuffleReaderExec(k, spec) for k in kids]
+        node.children = tuple(kids)
+        for k in node.children:
+            visit(k)
+
+    visit(root)
+    return root
 
 
 def explain_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None) -> str:

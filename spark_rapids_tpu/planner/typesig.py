@@ -297,6 +297,11 @@ def _build_registry() -> None:
     register(A.Percentile, ExprSig(TypeSig("double"), NUMERIC,
                                    note="exact percentile via sorted "
                                    "group arrays"))
+    register(A.ApproxPercentile,
+             ExprSig(TypeSig("double"), NUMERIC,
+                     note="t-digest; results within accuracy tolerance "
+                     "of Spark (reference documents the same for its "
+                     "cuDF t-digest offload)"))
 
     # window functions
     for cls in (W.RowNumber, W.Rank, W.DenseRank, W.Ntile):

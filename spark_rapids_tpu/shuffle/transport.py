@@ -137,6 +137,41 @@ def process_shuffle_executor():
         return _default_executor
 
 
+_cluster_participants = None
+_cluster_shuffle_seq = None   # [query_id, next_exchange_ordinal]
+
+
+def set_cluster_query(query_id) -> None:
+    """Enter (or leave, with None) a cluster task: exchanges then take
+    DETERMINISTIC shuffle ids (query_id << 16 | ordinal-of-materialization)
+    so every rank names the same exchange identically — a driver-counter
+    allocation would hand each requesting rank a different id and reduce
+    reads would wait on a shuffle nobody else knows (the role of Spark's
+    driver-assigned shuffleId in the reference's heartbeat registry)."""
+    global _cluster_shuffle_seq
+    _cluster_shuffle_seq = [int(query_id), 0] if query_id is not None \
+        else None
+
+
+def set_cluster_participants(participants) -> None:
+    """Full worker set for the current cluster task: transports declare it
+    so a reduce read waits for EVERY participant's map completion, even
+    one that hasn't constructed its transport yet (the coordinator-known-
+    membership case in TcpShuffleTransport's contract)."""
+    global _cluster_participants
+    _cluster_participants = list(participants) if participants else None
+
+
+def set_process_shuffle_executor(executor) -> None:
+    """Install the process-wide shuffle node (cluster executor bootstrap:
+    the node registered with the DRIVER's registry must be the one the
+    engine's exchanges write through — RapidsExecutorPlugin init analog,
+    Plugin.scala:599)."""
+    global _default_executor
+    with _default_executor_lock:
+        _default_executor = executor
+
+
 def make_transport(mode: str, num_partitions: int, schema: Schema,
                    writer_threads: int = 4,
                    codec: str = "none") -> ShuffleTransport:
@@ -154,6 +189,13 @@ def make_transport(mode: str, num_partitions: int, schema: Schema,
                 "MULTIPROCESS shuffle cannot serialize column types "
                 f"{unsupported} on the kudo wire")
         from spark_rapids_tpu.shuffle.net import TcpShuffleTransport
+        sid = None
+        if _cluster_shuffle_seq is not None:
+            qid, ordinal = _cluster_shuffle_seq
+            _cluster_shuffle_seq[1] += 1
+            sid = (qid << 16) | ordinal
         return TcpShuffleTransport(process_shuffle_executor(),
-                                   num_partitions, schema, codec)
+                                   num_partitions, schema, codec,
+                                   shuffle_id=sid,
+                                   participants=_cluster_participants)
     return CacheOnlyTransport(num_partitions)

@@ -1,0 +1,92 @@
+"""AQE small-partition coalescing tests (reference:
+GpuCustomShuffleReaderExec.scala:26,82 — merge undersized reduce
+partitions from materialized map-output statistics)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import col, count, sum_
+from spark_rapids_tpu.expressions.core import Alias
+from tests.test_queries import assert_tpu_cpu_equal
+
+SCHEMA = Schema.of(k=T.INT, v=T.LONG)
+
+
+def small_df(s, n=500, nkeys=40, parts=3):
+    rng = np.random.RandomState(9)
+    data = {"k": rng.randint(0, nkeys, n).tolist(),
+            "v": rng.randint(-50, 50, n).tolist()}
+    return s.create_dataframe(
+        [ColumnarBatch.from_pydict(
+            {c: v[o:o + 200] for c, v in data.items()}, SCHEMA)
+         for o in range(0, n, 200)], num_partitions=parts)
+
+
+def test_agg_coalesces_reduce_tasks():
+    """16 shuffle partitions of a tiny aggregation collapse to ONE reduce
+    task (everything fits one batch target), results identical."""
+    from spark_rapids_tpu.plan.execs.exchange import (
+        TpuCoalescedShuffleReaderExec)
+    from spark_rapids_tpu.planner.overrides import plan_query
+
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = small_df(s).group_by("k").agg(Alias(sum_(col("v")), "sv"),
+                                       Alias(count(), "n"))
+    physical, _ = plan_query(df.plan, s.conf)
+
+    readers = []
+
+    def walk(e):
+        if isinstance(e, TpuCoalescedShuffleReaderExec):
+            readers.append(e)
+        for c in e.children:
+            walk(c)
+    walk(physical)
+    assert readers, "coalesced reader not planned above the agg exchange"
+    r = readers[0]
+    assert r.children[0].num_partitions() == 16   # static shuffle width
+    assert r.num_partitions() == 1                # runtime-merged
+    physical.cleanup()
+
+
+def test_agg_differential_with_coalescing():
+    assert_tpu_cpu_equal(lambda s: small_df(s).group_by("k").agg(
+        Alias(sum_(col("v")), "sv"), Alias(count(), "n")))
+
+
+def test_join_differential_with_shared_spec():
+    """Both join sides read through ONE spec: co-partitioning preserved,
+    results identical to the oracle."""
+    def q(s):
+        left = small_df(s, n=600, nkeys=30)
+        right = small_df(s, n=300, nkeys=30).group_by("k").agg(
+            Alias(count(), "rn"))
+        return left.join(right, on="k", how="inner")
+    # force the shuffled-join path (no broadcast) so the spec engages
+    def q2(s):
+        s.set_conf("spark.rapids.sql.broadcastRowThreshold", "1")
+        return q(s)
+    assert_tpu_cpu_equal(q2)
+
+
+def test_coalescing_off_keeps_static_partitions():
+    from spark_rapids_tpu.plan.execs.exchange import (
+        TpuCoalescedShuffleReaderExec)
+    from spark_rapids_tpu.planner.overrides import plan_query
+    s = TpuSession({"spark.rapids.sql.enabled": "true",
+                    "spark.rapids.sql.adaptive.coalescePartitions.enabled":
+                    "false"})
+    df = small_df(s).group_by("k").agg(Alias(count(), "n"))
+    physical, _ = plan_query(df.plan, s.conf)
+    found = []
+
+    def walk(e):
+        if isinstance(e, TpuCoalescedShuffleReaderExec):
+            found.append(e)
+        for c in e.children:
+            walk(c)
+    walk(physical)
+    assert not found
+    physical.cleanup()

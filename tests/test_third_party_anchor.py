@@ -1,0 +1,90 @@
+"""Third-party anchor tests (VERDICT r3 weak #7): both engines vs PANDAS.
+
+The differential suite proves engine == oracle, but both are this repo's
+code — a shared misunderstanding of Spark semantics would pass silently.
+Pandas is an INDEPENDENT implementation: on clean (null-free) TPC-H data
+its groupby/filter/sum semantics coincide with Spark's, so agreement with
+pandas anchors the two-engine system to an outside truth (the role the
+reference gets for free from running against real Apache Spark,
+integration_tests/.../asserts.py)."""
+import numpy as np
+import pytest
+
+pd = pytest.importorskip("pandas")
+
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.testing import tpch
+
+N = 60_000
+
+
+def _lineitem_frames():
+    batches = tpch.gen_lineitem(N, batch_rows=1 << 14)
+    tables = [b.to_pydict() for b in batches]
+    cols = {k: sum((t[k] for t in tables), []) for k in tables[0]}
+    pdf = pd.DataFrame(cols)
+    return batches, pdf
+
+
+@pytest.fixture(scope="module")
+def data():
+    return _lineitem_frames()
+
+
+def _engine_rows(batches, q):
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    return q(s.create_dataframe(list(batches), num_partitions=2)).collect()
+
+
+def test_q6_matches_pandas(data):
+    batches, pdf = data
+    import datetime
+    epoch = datetime.date(1970, 1, 1)
+    days = pdf["l_shipdate"].map(
+        lambda d: d if isinstance(d, int) else (d - epoch).days)
+    d94 = (datetime.date(1994, 1, 1) - epoch).days
+    d95 = (datetime.date(1995, 1, 1) - epoch).days
+    # decimal(12,2) surfaces as UNSCALED ints from to_pydict
+    disc = pdf["l_discount"].map(float) / 100.0
+    qty = pdf["l_quantity"].map(float) / 100.0
+    price = pdf["l_extendedprice"].map(float) / 100.0
+    mask = ((days >= d94) & (days < d95)
+            & (disc >= 0.05) & (disc <= 0.07) & (qty < 24))
+    expected = float((price[mask] * disc[mask]).sum())
+
+    (row,) = _engine_rows(batches, tpch.q6)
+    assert row[0] == pytest.approx(expected, rel=1e-9)
+
+
+def test_q1_matches_pandas(data):
+    batches, pdf = data
+    import datetime
+    epoch = datetime.date(1970, 1, 1)
+    days = pdf["l_shipdate"].map(
+        lambda d: d if isinstance(d, int) else (d - epoch).days)
+    cutoff = (datetime.date(1998, 9, 2) - epoch).days
+    f = pdf[days <= cutoff].copy()
+    for c in ("l_quantity", "l_extendedprice", "l_discount", "l_tax"):
+        f[c] = f[c].map(float) / 100.0    # unscaled decimal(12,2)
+    f["disc_price"] = f["l_extendedprice"] * (1.0 - f["l_discount"])
+    f["charge"] = f["disc_price"] * (1.0 + f["l_tax"])
+    g = f.groupby("l_linenumber").agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "size"))
+
+    rows = sorted(_engine_rows(batches, tpch.q1))
+    assert len(rows) == len(g)
+    for row in rows:
+        key = row[0]
+        e = g.loc[key]
+        for got, exp in zip(row[1:],
+                            [e.sum_qty, e.sum_base_price, e.sum_disc_price,
+                             e.sum_charge, e.avg_qty, e.avg_price,
+                             e.avg_disc, e.count_order]):
+            assert got == pytest.approx(exp, rel=1e-9), (key, got, exp)

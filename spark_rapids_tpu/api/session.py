@@ -514,7 +514,28 @@ class DataFrame:
                 except UnsupportedSpmd:
                     pass   # mode switch: fall back to the task engine
             engine = TpuEngine(self.session.conf)
-            out = engine.collect(exec_plan)
+            if self.session.conf.profile_enabled:
+                # per-query flamegraph + bubble report (asyncProfiler /
+                # GpuBubbleTimerManager analogs, utils/profiler.py).
+                # Diagnostics must never fail the query: artifact I/O
+                # errors are swallowed (unwritable dir, full disk).
+                from spark_rapids_tpu.utils.profiler import QueryProfiler
+                qp = None
+                try:
+                    qp = QueryProfiler(
+                        self.session.conf.profile_dir).__enter__()
+                except OSError:
+                    pass
+                try:
+                    out = engine.collect(exec_plan)
+                finally:
+                    if qp is not None:
+                        try:
+                            qp.finish(engine.last_metrics)
+                        except OSError:
+                            qp.__exit__()
+            else:
+                out = engine.collect(exec_plan)
             self.session.last_query_metrics = engine.last_metrics
             return out
         return CpuEngine(self.session.conf.shuffle_partitions).collect(self.plan)

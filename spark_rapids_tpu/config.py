@@ -156,6 +156,18 @@ SHUFFLE_PARTITIONS = conf("spark.sql.shuffle.partitions").doc(
     "Number of reduce-side partitions for shuffle exchanges."
 ).int_conf(16)
 
+PROFILE_ENABLED = conf("spark.rapids.profile.enabled").doc(
+    "Per-query profiling: a sampled flamegraph (collapsed stacks, "
+    "flamegraph.pl/speedscope format) plus a bubble/idle report derived "
+    "from per-exec opTime vs wall time (reference: asyncProfiler.scala "
+    "per-stage flamegraphs + GpuBubbleTimerManager)."
+).boolean_conf(False)
+
+PROFILE_DIR = conf("spark.rapids.profile.dir").doc(
+    "Directory for profiling artifacts (query<N>_flame.txt / "
+    "query<N>_bubble.json)."
+).string_conf("tpu_profile")
+
 AQE_COALESCE_PARTITIONS = conf(
     "spark.rapids.sql.adaptive.coalescePartitions.enabled").doc(
     "Merge undersized reduce partitions at exchange read time using the "
@@ -356,6 +368,14 @@ class RapidsConf:
     @property
     def shuffle_partitions(self) -> int:
         return self.get(SHUFFLE_PARTITIONS)
+
+    @property
+    def profile_enabled(self) -> bool:
+        return self.get(PROFILE_ENABLED)
+
+    @property
+    def profile_dir(self) -> str:
+        return self.get(PROFILE_DIR)
 
     @property
     def aqe_coalesce_partitions(self) -> bool:

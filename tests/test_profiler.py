@@ -1,0 +1,68 @@
+"""Profiling-depth tests: sampled flamegraph + bubble report (reference:
+asyncProfiler.scala:58 per-stage flamegraphs;
+metrics/GpuBubbleTimerManager.scala idle accounting)."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import Schema
+
+
+def test_stack_sampler_produces_collapsed_stacks():
+    from spark_rapids_tpu.utils.profiler import StackSampler
+    s = StackSampler(interval_s=0.002)
+    s.start()
+
+    def busy():
+        t0 = time.monotonic()
+        x = 0
+        while time.monotonic() - t0 < 0.15:
+            x += sum(range(200))
+        return x
+    busy()
+    s.stop()
+    lines = s.collapsed_stacks()
+    assert lines, "no samples collected"
+    # collapsed format: "frame;frame;... count"
+    stack, count = lines[0].rsplit(" ", 1)
+    assert int(count) >= 1 and ";" in stack
+    assert any("test_profiler" in ln for ln in lines)
+
+
+def test_bubble_report_math():
+    from spark_rapids_tpu.utils.profiler import bubble_report
+    tree = [("TpuFilter", 0, {"opTime": 30_000_000}),
+            ("TpuScan", 1, {"opTime": 20_000_000}),
+            ("TpuProject", 1, {})]
+    r = bubble_report(tree, wall_ns=100_000_000)
+    assert r["device_busy_ms"] == pytest.approx(50.0)
+    assert r["bubble_ms"] == pytest.approx(50.0)
+    assert r["bubble_fraction"] == pytest.approx(0.5)
+    assert r["top_ops"][0][0] == "TpuFilter"
+
+
+def test_query_profiler_end_to_end(tmp_path):
+    """Conf-gated per-collect profiling: artifacts land in profile.dir."""
+    s = TpuSession({"spark.rapids.sql.enabled": "true",
+                    "spark.rapids.profile.enabled": "true",
+                    "spark.rapids.profile.dir": str(tmp_path)})
+    sch = Schema.of(k=T.INT, v=T.LONG)
+    rng = np.random.RandomState(1)
+    df = s.create_dataframe(
+        {"k": rng.randint(0, 5, 5000).tolist(),
+         "v": rng.randint(-9, 9, 5000).tolist()}, schema=sch)
+    from spark_rapids_tpu.expressions import col, sum_
+    rows = df.group_by("k").agg(sum_(col("v")).alias("sv")).collect()
+    assert len(rows) == 5
+    flames = [f for f in os.listdir(tmp_path) if f.endswith("_flame.txt")]
+    bubbles = [f for f in os.listdir(tmp_path) if f.endswith("_bubble.json")]
+    assert flames and bubbles
+    rep = json.load(open(os.path.join(tmp_path, bubbles[0])))
+    assert rep["wall_ms"] > 0
+    assert 0.0 <= rep["bubble_fraction"] <= 1.0
+    assert "top_ops" in rep

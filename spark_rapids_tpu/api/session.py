@@ -532,8 +532,8 @@ class DataFrame:
                     if qp is not None:
                         try:
                             qp.finish(engine.last_metrics)
-                        except OSError:
-                            qp.__exit__()
+                        except Exception:  # noqa: BLE001 — diagnostics
+                            qp.__exit__()  # must never fail the query
             else:
                 out = engine.collect(exec_plan)
             self.session.last_query_metrics = engine.last_metrics

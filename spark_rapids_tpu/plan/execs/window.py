@@ -284,6 +284,81 @@ class _WindowDeviceSpec:
         return from_sum_count(s, n)
 
 
+#: two-pass unbounded-agg fallback threshold: beyond this many distinct
+#: partition keys the host merge loop dominates and key-batching wins
+_TWO_PASS_MAX_KEYS = 65536
+
+
+def _extreme_merge(x, y, is_min: bool):
+    """Merge two per-batch (value, valid) extremes with Spark's total
+    order (NaN greatest; MIN prefers non-NaN, MAX prefers NaN)."""
+    import math
+    (vx, okx), (vy, oky) = x, y
+    if not okx:
+        return y
+    if not oky:
+        return x
+    x_nan = isinstance(vx, float) and math.isnan(vx)
+    y_nan = isinstance(vy, float) and math.isnan(vy)
+    if is_min:
+        if x_nan:
+            return y
+        if y_nan:
+            return x
+        return x if vx <= vy else y
+    if x_nan:
+        return x
+    if y_nan:
+        return y
+    return x if vx >= vy else y
+
+
+def _merge_slots(a, b, specs):
+    """Combine two hosts' per-key partial states (pass-1 merge)."""
+    out = []
+    i = 0
+    for kind, _inp, _dt in specs:
+        if kind == "count":
+            out.append((a[i][0] + b[i][0], True))
+            i += 1
+        elif kind in ("sum", "average"):
+            (sa, va), (sb, vb) = a[i], b[i]
+            s = (sa + sb) if (va and vb) else (sa if va else sb)
+            out.append((s, va or vb))
+            out.append((a[i + 1][0] + b[i + 1][0], True))
+            i += 2
+        else:
+            out.append(_extreme_merge(a[i], b[i], kind == "min"))
+            i += 1
+    return out
+
+
+def _finalize_slots(slots, specs):
+    """Per-key merged state -> final (value, valid) per window expr."""
+    out = []
+    i = 0
+    for kind, _inp, _dt in specs:
+        if kind == "count":
+            out.append((slots[i][0], True))
+            i += 1
+        elif kind == "sum":
+            s, v = slots[i]
+            n = slots[i + 1][0]
+            ok = bool(n > 0 and v)
+            out.append((s if ok else None, ok))
+            i += 2
+        elif kind == "average":
+            s, v = slots[i]
+            n = slots[i + 1][0]
+            ok = bool(n > 0 and v)
+            out.append(((s / n) if ok else None, ok))
+            i += 2
+        else:
+            out.append(slots[i])
+            i += 1
+    return out
+
+
 class TpuWindowExec(TpuExec):
     def __init__(self, window_exprs: Sequence[Expression], child: TpuExec,
                  schema: Schema, target_rows: int = 1 << 20):
@@ -307,14 +382,296 @@ class TpuWindowExec(TpuExec):
         if not batches:
             return
         total = sum(b.capacity for b in batches)
-        if total > self.target_rows and self._partition_ordinals() is not None:
-            yield from self._execute_out_of_core(batches, total)
-            return
+        if total > self.target_rows:
+            if self._two_pass_capable():
+                # unbounded-agg state machine: handles ONE partition key
+                # larger than any batch (key-batching can't split it)
+                yield from self._execute_two_pass(batches)
+                return
+            if self._partition_ordinals() is not None:
+                yield from self._execute_out_of_core(batches, total)
+                return
         merged = coalesce_to_one(batches)
         with timed(self.op_time):
             out = with_retry_no_split(lambda: self._run(merged))
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
+
+    # -- two-pass UNBOUNDED-to-UNBOUNDED agg windows -------------------------
+    # (reference: window/GpuUnboundedToUnboundedAggWindowExec.scala — the
+    # state machine for partitions larger than any batch: the answer per
+    # row is the PARTITION-constant aggregate, so pass 1 streams batches
+    # through a per-batch grouped partial agg and merges the tiny per-key
+    # states on the host; pass 2 maps them back per batch with an
+    # order-preserving left join.  Memory: O(batch + distinct keys),
+    # independent of partition size.)
+
+    def _two_pass_capable(self) -> bool:
+        if self.spec.partition_by and self._partition_ordinals() is None:
+            return False
+        child_schema = self.children[0].schema
+        for o in (self._partition_ordinals() or []):
+            dt = child_schema.dtypes[o]
+            if dt.variable_width or isinstance(
+                    dt, (T.ArrayType, T.StructType, T.MapType)):
+                return False
+        for e in self.window_exprs:
+            we = _unwrap(e)
+            if not isinstance(we, WindowExpression):
+                return False
+            if not we.spec.frame.is_unbounded_both():
+                return False
+            fn = we.function
+            if not isinstance(fn, (Sum, Count, Min, Max, Average)):
+                return False
+            if fn.input is not None:
+                dt = fn.input.dtype
+                if (dt.variable_width or isinstance(
+                        dt, (T.DecimalType, T.ArrayType, T.StructType,
+                             T.MapType))):
+                    return False
+        return True
+
+    def _fn_specs(self):
+        """(kind, input_expr, out_dtype) per window expression."""
+        out = []
+        for e in self.window_exprs:
+            fn = _unwrap(e).function
+            kind = type(fn).__name__.lower()
+            out.append((kind, fn.input, fn.dtype))
+        return out
+
+    def _totals_step(self, key_ords, specs):
+        """Jitted per-batch partial: keys + per-fn merge buffers."""
+        def step(batch: ColumnarBatch, string_bucket: int = 0):
+            import spark_rapids_tpu.kernels.groupby as G
+            layout = G.group_rows(batch, list(key_ords),
+                                  string_max_bytes=string_bucket)
+            cols: List[jax.Array] = []
+            for c in G.group_keys_output(layout, list(key_ords)):
+                cols.append((c.data, c.validity))
+            sctx = EvalContext(layout.sorted_batch)
+            for kind, inp, out_dt in specs:
+                if inp is None:           # count(*)
+                    n, _ = G.seg_count_star(layout)
+                    cols.append((n.astype(jnp.int64),
+                                 jnp.ones(n.shape, jnp.bool_)))
+                    continue
+                c = inp.eval(sctx)
+                if kind == "count":
+                    n, _ = G.seg_count_valid(c, layout)
+                    cols.append((n.astype(jnp.int64),
+                                 jnp.ones(n.shape, jnp.bool_)))
+                elif kind in ("sum", "average"):
+                    sdt = (jnp.float64 if out_dt.is_floating
+                           or kind == "average" else jnp.int64)
+                    sv, svalid = G.seg_sum(c, layout, sdt)
+                    n, _ = G.seg_count_valid(c, layout)
+                    cols.append((sv, svalid))
+                    cols.append((n.astype(jnp.int64),
+                                 jnp.ones(n.shape, jnp.bool_)))
+                elif kind == "min":
+                    v, valid = G.seg_min(c, layout)
+                    cols.append((v, valid))
+                else:
+                    v, valid = G.seg_max(c, layout)
+                    cols.append((v, valid))
+            return tuple(cols), layout.num_groups
+        return step
+
+    def _execute_two_pass(self, batches) -> Iterator[ColumnarBatch]:
+        import numpy as np
+
+        from spark_rapids_tpu.memory.spill import make_spillable
+        from spark_rapids_tpu.plan.execs.base import (
+            exprs_cache_key, schema_cache_key, shared_jit)
+
+        key_ords = self._partition_ordinals() or []
+        specs = self._fn_specs()
+        child_schema = self.children[0].schema
+        base_key = (f"window2p|{schema_cache_key(child_schema)}|"
+                    f"{exprs_cache_key(self.window_exprs)}")
+        step = self._totals_step(key_ords, specs)
+        handles = [make_spillable(b) for b in batches]
+        del batches
+
+        # pass 1: stream, host-merge tiny per-key states.  Key identity
+        # uses Spark normalization (NaN is ONE group; -0.0 == 0.0) —
+        # python dict identity on raw floats splits NaN groups per batch,
+        # and the device join (which canonicalizes NaN) would then fan
+        # out duplicate rows.
+        import math
+
+        def canon(v):
+            if isinstance(v, float):
+                if math.isnan(v):
+                    return "\0nan"
+                if v == 0.0:
+                    return 0.0
+            return v
+
+        state = {}      # canonical key tuple -> per-slot merge values
+        originals = {}  # canonical key tuple -> representative raw key
+        for h in handles:
+            b = h.materialize()
+            with timed(self.op_time):
+                cols, ngroups = with_retry_no_split(
+                    lambda: shared_jit(
+                        f"{base_key}|p1|{b.capacity}",
+                        lambda: step)(b))
+            h.unpin()
+            ng = int(ngroups)
+            host = [(np.asarray(d)[:ng], np.asarray(v)[:ng])
+                    for d, v in cols]
+            nk = len(key_ords)
+            for g in range(ng):
+                raw = tuple(
+                    (None if not host[i][1][g] else host[i][0][g].item())
+                    for i in range(nk))
+                key = tuple(canon(v) for v in raw)
+                slots = [(host[i][0][g].item(), bool(host[i][1][g]))
+                         for i in range(nk, len(host))]
+                cur = state.get(key)
+                if cur is None:
+                    originals[key] = raw
+                state[key] = slots if cur is None else \
+                    _merge_slots(cur, slots, specs)
+            if len(state) > _TWO_PASS_MAX_KEYS:
+                # high-cardinality partitioning: the per-key host loop
+                # would dominate — key-batching splits such data fine on
+                # device.  The "tiny per-key states" assumption is
+                # CHECKED, not hoped.
+                rebatched = [hh.materialize() for hh in handles]
+                for hh in handles:
+                    hh.unpin()
+                    hh.close()
+                total = sum(bb.capacity for bb in rebatched)
+                yield from self._execute_out_of_core(rebatched, total)
+                return
+
+        # finalize per-key window values (keyed by the REPRESENTATIVE raw
+        # key so NaN re-materializes as a float in the build table)
+        values = {originals[k]: _finalize_slots(sl, specs)
+                  for k, sl in state.items()}
+
+        # pass 2: map values back per batch, order-preserving
+        if not key_ords:
+            (vals,) = [values.get((), [(None, False)] * len(specs))]
+            for h in handles:
+                b = h.materialize()
+                out = self._broadcast_constants(b, vals)
+                h.unpin()
+                h.close()
+                self.output_rows.add(out.num_rows)
+                yield self._count_out(out)
+            return
+
+        build = self._build_values_batch(key_ords, child_schema, values)
+        joiner = self._two_pass_joiner(key_ords, child_schema)
+        for h in handles:
+            b = h.materialize()
+            with timed(self.op_time):
+                out = self._join_values(b, build, joiner, key_ords)
+            h.unpin()
+            h.close()
+            self.output_rows.add(out.num_rows)
+            yield self._count_out(out)
+
+    def _broadcast_constants(self, b: ColumnarBatch, vals):
+        """Empty PARTITION BY: one global group — append constants."""
+        cols = list(b.columns)
+        live = b.live_mask()
+        for (v, valid), (_k, _i, out_dt) in zip(vals, self._fn_specs()):
+            data = jnp.full((b.capacity,),
+                            v if valid and v is not None else 0,
+                            out_dt.jnp_dtype)
+            cols.append(DeviceColumn(
+                jnp.where(live & valid, data,
+                          jnp.zeros((), out_dt.jnp_dtype)),
+                live & bool(valid), out_dt))
+        return ColumnarBatch(tuple(cols), b.num_rows, self.schema)
+
+    def _build_values_batch(self, key_ords, child_schema, values):
+        """Small device table: normalized keys + null flags + values."""
+        import numpy as np
+        keys = list(values.keys())
+        data = {}
+        names = []
+        dtypes = []
+        for i, o in enumerate(key_ords):
+            dt = child_schema.dtypes[o]
+            data[f"_k{i}"] = [0 if k[i] is None else k[i] for k in keys]
+            data[f"_kn{i}"] = [k[i] is None for k in keys]
+            names += [f"_k{i}", f"_kn{i}"]
+            dtypes += [dt, T.BOOLEAN]
+        for j, (_kind, _inp, out_dt) in enumerate(self._fn_specs()):
+            col_vals = []
+            for k in keys:
+                v, valid = values[k][j]
+                col_vals.append(v if valid and v is not None else None)
+            data[f"_w{j}"] = col_vals
+            names.append(f"_w{j}")
+            dtypes.append(out_dt)
+        sch = Schema(tuple(names), tuple(dtypes))
+        return ColumnarBatch.from_pydict(data, sch)
+
+    def _probe_schema(self, key_ords, child_schema) -> Schema:
+        """Input batch + normalized keys + null flags (single source of
+        truth for the probe layout — the joiner and per-batch prep must
+        agree on these ordinals)."""
+        nk = len(key_ords)
+        names = (tuple(child_schema.names)
+                 + tuple(f"_lk{i}" for i in range(nk))
+                 + tuple(f"_lkn{i}" for i in range(nk)))
+        dtypes = (tuple(child_schema.dtypes)
+                  + tuple(child_schema.dtypes[o] for o in key_ords)
+                  + tuple(T.BOOLEAN for _ in key_ords))
+        return Schema(names, dtypes)
+
+    def _two_pass_joiner(self, key_ords, child_schema):
+        from spark_rapids_tpu.plan.execs.join import _JoinKernel
+        nk = len(key_ords)
+        left = self._probe_schema(key_ords, child_schema)
+        right = self._build_values_schema(key_ords, child_schema)
+        join_schema = Schema(tuple(left.names) + tuple(right.names),
+                             tuple(left.dtypes) + tuple(right.dtypes))
+        n = len(child_schema)
+        left_keys = [n + i for i in range(nk)] + \
+            [n + nk + i for i in range(nk)]
+        right_keys = list(range(0, 2 * nk, 2)) + \
+            list(range(1, 2 * nk, 2))
+        return _JoinKernel(left_keys, right_keys, "left", join_schema)
+
+    def _build_values_schema(self, key_ords, child_schema):
+        names = []
+        dtypes = []
+        for i, o in enumerate(key_ords):
+            names += [f"_k{i}", f"_kn{i}"]
+            dtypes += [child_schema.dtypes[o], T.BOOLEAN]
+        for j, (_k, _i, out_dt) in enumerate(self._fn_specs()):
+            names.append(f"_w{j}")
+            dtypes.append(out_dt)
+        return Schema(tuple(names), tuple(dtypes))
+
+    def _join_values(self, b: ColumnarBatch, build, joiner, key_ords):
+        live = b.live_mask()
+        cols = list(b.columns)
+        for o in key_ords:
+            c = b.columns[o]
+            cols.append(DeviceColumn(
+                jnp.where(c.validity, c.data,
+                          jnp.zeros((), c.data.dtype)),
+                live, c.dtype))
+        for o in key_ords:
+            c = b.columns[o]
+            cols.append(DeviceColumn(~c.validity & live, live, T.BOOLEAN))
+        probe = ColumnarBatch(tuple(cols), b.num_rows,
+                              self._probe_schema(key_ords, b.schema))
+        joined = joiner(probe, build)
+        n = len(b.schema)
+        nfn = len(self.window_exprs)
+        out_cols = joined.columns[:n] + joined.columns[-nfn:]
+        return ColumnarBatch(tuple(out_cols), joined.num_rows, self.schema)
 
     def _partition_ordinals(self):
         """Column ordinals of the PARTITION BY keys, or None if any key is

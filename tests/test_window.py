@@ -191,3 +191,114 @@ def test_two_window_specs_one_select():
             over(Rank(), partition_by=["t"], order_by=["v"]).alias("b"),
             over(count(), partition_by=["k"]).alias("c"))
     assert_tpu_cpu_equal(q)
+
+
+def test_unbounded_agg_two_pass_huge_key():
+    """ONE partition key bigger than any batch: key-batching cannot split
+    it; the two-pass unbounded-agg state machine must (reference:
+    GpuUnboundedToUnboundedAggWindowExec.scala).  Differential vs oracle
+    with a tiny batch target forcing the path."""
+    from spark_rapids_tpu.expressions import avg, count, max_, min_, sum_
+
+    def q(s):
+        s.set_conf("spark.rapids.sql.batchSizeRows", "256")
+        rng = np.random.RandomState(8)
+        n = 2000
+        data = {
+            "k": ([1] * (n // 2)                      # one huge key
+                  + rng.randint(2, 6, n - n // 2).tolist()),
+            "v": rng.randint(-50, 50, n).tolist(),
+            "x": rng.randn(n).tolist(),
+        }
+        for i in rng.choice(n, n // 7, replace=False):
+            data["v"][i] = None
+        batches = [ColumnarBatch.from_pydict(
+            {c: vals[o:o + 250] for c, vals in data.items()}, SCHEMA_KVX)
+            for o in range(0, n, 250)]
+        df = s.create_dataframe(batches, num_partitions=2)
+        return df.select(
+            col("k"), col("v"),
+            over(sum_("v"), partition_by=["k"]).alias("sv"),
+            over(count("v"), partition_by=["k"]).alias("nv"),
+            over(count(), partition_by=["k"]).alias("nr"),
+            over(min_("v"), partition_by=["k"]).alias("mn"),
+            over(max_("x"), partition_by=["k"]).alias("mx"),
+            over(avg("v"), partition_by=["k"]).alias("av"))
+    assert_tpu_cpu_equal(q)
+
+
+SCHEMA_KVX = Schema.of(k=T.INT, v=T.LONG, x=T.DOUBLE)
+
+
+def test_unbounded_agg_two_pass_global():
+    """Empty PARTITION BY over many batches: the whole input is one
+    partition — broadcast-constants path."""
+    from spark_rapids_tpu.expressions import count, sum_
+
+    def q(s):
+        s.set_conf("spark.rapids.sql.batchSizeRows", "128")
+        rng = np.random.RandomState(12)
+        n = 1000
+        data = {"k": rng.randint(0, 5, n).tolist(),
+                "v": rng.randint(-9, 9, n).tolist(),
+                "x": rng.randn(n).tolist()}
+        batches = [ColumnarBatch.from_pydict(
+            {c: vals[o:o + 200] for c, vals in data.items()}, SCHEMA_KVX)
+            for o in range(0, n, 200)]
+        df = s.create_dataframe(batches, num_partitions=2)
+        return df.select(col("k"), col("v"),
+                         over(sum_("v")).alias("sv"),
+                         over(count()).alias("n"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_unbounded_agg_two_pass_nan_keys():
+    """NaN partition keys spread over many batches must merge into ONE
+    group (Spark NormalizeFloatingNumbers), not split per batch."""
+    from spark_rapids_tpu.expressions import count, sum_
+    NAN_SCHEMA = Schema.of(k=T.DOUBLE, v=T.LONG)
+
+    def q(s):
+        s.set_conf("spark.rapids.sql.batchSizeRows", "128")
+        rng = np.random.RandomState(5)
+        n = 800
+        ks = [float("nan") if i % 3 == 0 else float(i % 4)
+              for i in range(n)]
+        ks[10] = -0.0
+        ks[20] = 0.0
+        data = {"k": ks, "v": rng.randint(-9, 9, n).tolist()}
+        batches = [ColumnarBatch.from_pydict(
+            {c: vals[o:o + 160] for c, vals in data.items()}, NAN_SCHEMA)
+            for o in range(0, n, 160)]
+        df = s.create_dataframe(batches, num_partitions=2)
+        return df.select(col("v"),
+                         over(sum_("v"), partition_by=["k"]).alias("sv"),
+                         over(count(), partition_by=["k"]).alias("n"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_unbounded_agg_high_cardinality_falls_back():
+    """Near-unique keys: the cardinality guard must route back to the
+    key-batched device path (results identical either way)."""
+    import spark_rapids_tpu.plan.execs.window as W
+    from spark_rapids_tpu.expressions import sum_
+    old = W._TWO_PASS_MAX_KEYS
+    W._TWO_PASS_MAX_KEYS = 16     # force the guard with small data
+    try:
+        def q(s):
+            s.set_conf("spark.rapids.sql.batchSizeRows", "64")
+            rng = np.random.RandomState(6)
+            n = 400
+            data = {"k": list(range(n)),     # unique keys
+                    "t": [0] * n,
+                    "v": rng.randint(-9, 9, n).tolist(),
+                    "x": rng.randn(n).tolist()}
+            batches = [ColumnarBatch.from_pydict(
+                {c: vals[o:o + 100] for c, vals in data.items()}, SCHEMA)
+                for o in range(0, n, 100)]
+            df = s.create_dataframe(batches, num_partitions=2)
+            return df.select(col("k"), col("v"),
+                             over(sum_("v"), partition_by=["k"]).alias("sv"))
+        assert_tpu_cpu_equal(q)
+    finally:
+        W._TWO_PASS_MAX_KEYS = old

@@ -179,10 +179,60 @@ class TpuSession:
         table = IcebergTable.load(table_path)
         snap = table.snapshot(snapshot_id=snapshot_id, as_of_ms=as_of_ms)
         files = snap.data_files()
+        deletes = snap.delete_files()
         if prune:
             files = prune_files(files, snap.schema, prune,
                                 ids=field_ids(_current_struct(snap.meta)))
-        return DataFrame(L.IcebergRelation(table_path, snap, files), self)
+        return DataFrame(
+            L.IcebergRelation(table_path, snap, files, deletes=deletes),
+            self)
+
+    def iceberg_delete(self, table_path: str, predicate) -> int:
+        """DELETE FROM an Iceberg table via v2 position delete files
+        (merge-on-read): matching row ordinals per data file are written
+        as one position-delete parquet + delete manifest in a new
+        snapshot (io/iceberg.py commit_position_deletes).  Returns the
+        new snapshot id, or the current one when nothing matched."""
+        import numpy as np
+        import pyarrow.parquet as pq
+
+        from spark_rapids_tpu.columnar.arrow import arrow_to_batch
+        from spark_rapids_tpu.expressions.core import EvalContext
+        from spark_rapids_tpu.io.iceberg import (
+            DeleteFilter, IcebergTable, _current_struct,
+            commit_position_deletes)
+
+        table = IcebergTable.load(table_path)
+        snap = table.snapshot()
+        struct = _current_struct(snap.meta)
+        id_to_name = {f["id"]: f["name"] for f in struct["fields"]}
+        existing = DeleteFilter(snap.schema, id_to_name,
+                                snap.delete_files())
+        bound = _to_expr(predicate).bind(snap.schema)
+        per_file = {}
+        for df in snap.data_files():
+            # evaluate against PHYSICAL rows so ordinals stay stable
+            # even when earlier delete files already cover some of them
+            at = pq.read_table(df["file_path"],
+                               columns=list(snap.schema.names))
+            batch = arrow_to_batch(at)
+            n = batch.host_num_rows()
+            colv = bound.eval(EvalContext(batch))
+            vals, valid = colv.to_numpy(n)
+            hits = np.nonzero(np.asarray(vals, np.bool_) & valid)[0] \
+                .astype(np.int64)
+            # drop ordinals an applicable position delete already covers,
+            # so re-running the same DELETE is a true no-op
+            covered = [pos for seq, pos in
+                       existing._pos.get(df["file_path"], ())
+                       if seq >= (df.get("_seq") or 0)]
+            if covered:
+                hits = np.setdiff1d(hits, np.concatenate(covered))
+            if len(hits):
+                per_file[df["file_path"]] = hits
+        if not per_file:
+            return snap.snapshot_id
+        return commit_position_deletes(table_path, per_file)
 
     def read_avro(self, *paths: str, columns=None) -> "DataFrame":
         """Avro container scan (reference GpuAvroScan analog): records
@@ -204,6 +254,17 @@ class TpuSession:
         from spark_rapids_tpu.io.delta import load_snapshot
         snapshot = load_snapshot(table_path, version)
         return DataFrame(L.DeltaRelation(table_path, snapshot), self)
+
+    def delta_delete(self, table_path: str, predicate) -> int:
+        """DELETE FROM delta table via deletion vectors (io/delta_write)."""
+        from spark_rapids_tpu.io.delta_write import delete_from
+        return delete_from(self, table_path, _to_expr(predicate))
+
+    def delta_optimize(self, table_path: str,
+                       zorder_by: Sequence[str] = ()) -> int:
+        """OPTIMIZE [ZORDER BY] a delta table (io/delta_write)."""
+        from spark_rapids_tpu.io.delta_write import optimize
+        return optimize(self, table_path, zorder_by=zorder_by)
 
 
 class GroupedData:

@@ -57,12 +57,14 @@ def _parse_schema_string(schema_string: str) -> Schema:
 
 class DeltaSnapshot:
     def __init__(self, schema: Schema, partition_columns: List[str],
-                 files: List[Tuple[str, Dict[str, Optional[str]]]],
+                 files: List[Tuple[str, Dict[str, Optional[str]], object]],
                  version: int):
         self.schema = schema
         self.partition_columns = partition_columns
-        self.files = files            # (abs path, partitionValues)
+        # (abs path, partitionValues, DeletionVectorDescriptor | None)
+        self.files = files
         self.version = version
+        self.table_path: Optional[str] = None   # set by load_snapshot
 
 
 def load_snapshot(table_path: str,
@@ -122,14 +124,20 @@ def load_snapshot(table_path: str,
 
     if meta is None:
         raise ValueError(f"delta log at {log_dir} has no metaData action")
+    from spark_rapids_tpu.io.dv import DeletionVectorDescriptor
     schema = _parse_schema_string(meta["schemaString"])
     part_cols = list(meta.get("partitionColumns") or [])
     files = []
     for rel_path, add in live.items():
+        dv = add.get("deletionVector")
         files.append((os.path.join(table_path, rel_path),
-                      dict(add.get("partitionValues") or {})))
-    files.sort()
-    return DeltaSnapshot(schema, part_cols, files, version)
+                      dict(add.get("partitionValues") or {}),
+                      DeletionVectorDescriptor.from_json(dv) if dv
+                      else None))
+    files.sort(key=lambda t: t[0])
+    snap = DeltaSnapshot(schema, part_cols, files, version)
+    snap.table_path = table_path
+    return snap
 
 
 def partition_value_to_python(raw: Optional[str], dtype: T.DataType):

@@ -18,14 +18,25 @@ from spark_rapids_tpu.io.delta import DeltaSnapshot, partition_value_to_python
 from spark_rapids_tpu.plan.execs.base import TpuExec, timed
 
 
-def read_delta_file_batch(path: str, pvals, snapshot: DeltaSnapshot
-                          ) -> ColumnarBatch:
-    """One add-file -> device batch in snapshot schema order."""
+def read_delta_file_batch(path: str, pvals, snapshot: DeltaSnapshot,
+                          dv=None) -> ColumnarBatch:
+    """One add-file -> device batch in snapshot schema order.
+
+    ``dv`` is an optional DeletionVectorDescriptor; deleted row ordinals
+    are dropped host-side before upload (the decode already runs on host
+    — the reference applies DVs as a row mask at scan the same way,
+    delta-lake/common/.../GpuDeltaParquetFileFormatUtils.scala)."""
+    import pyarrow as pa
     import pyarrow.parquet as pq
     from spark_rapids_tpu.columnar.arrow import arrow_to_batch
     data_cols = [n for n in snapshot.schema.names
                  if n not in snapshot.partition_columns]
     table = pq.read_table(path, columns=data_cols)
+    if dv is not None and dv.cardinality:
+        positions = dv.load_positions(snapshot.table_path or "")
+        keep = np.ones(table.num_rows, np.bool_)
+        keep[positions[positions < table.num_rows]] = False
+        table = table.filter(pa.array(keep))
     batch = arrow_to_batch(table)
     n = batch.host_num_rows()
     cap = batch.capacity if batch.columns else 1
@@ -63,9 +74,9 @@ class TpuDeltaScanExec(TpuExec):
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         if idx >= len(self.snapshot.files):
             return
-        path, pvals = self.snapshot.files[idx]
+        path, pvals, dv = self.snapshot.files[idx]
         with timed(self.op_time):
-            batch = read_delta_file_batch(path, pvals, self.snapshot)
+            batch = read_delta_file_batch(path, pvals, self.snapshot, dv)
         self.output_rows.add(batch.num_rows)
         yield self._count_out(batch)
 

@@ -161,7 +161,7 @@ def write_delta(df, table_path: str, mode: str = "error",
     elif mode == "overwrite":
         from spark_rapids_tpu.io.delta import load_snapshot
         snap = load_snapshot(table_path)
-        for abs_path, pvals in snap.files:
+        for abs_path, pvals, _dv in snap.files:
             rel = os.path.relpath(abs_path, table_path)
             actions.append({"remove": {
                 "path": urllib.parse.quote(rel),
@@ -223,7 +223,7 @@ def merge_into(session, table_path: str, source_df, on: Sequence[str],
 
     files = _write_data_files(result, table_path, snap.partition_columns)
     actions: List[dict] = []
-    for abs_path, _pv in snap.files:
+    for abs_path, _pv, _dv in snap.files:
         rel = os.path.relpath(abs_path, table_path)
         actions.append({"remove": {
             "path": urllib.parse.quote(rel),
@@ -236,6 +236,135 @@ def merge_into(session, table_path: str, source_df, on: Sequence[str],
         "operation": "MERGE",
         "operationParameters": {"matched": when_matched or "none",
                                 "notMatched": when_not_matched or "none"},
+    }})
+    new_version = snap.version + 1
+    _commit(table_path, new_version, actions)
+    return new_version
+
+
+def delete_from(session, table_path: str, predicate) -> int:
+    """DELETE FROM table WHERE predicate, via deletion vectors.
+
+    Matching row ordinals per data file become a roaring-bitmap DV
+    (io/dv.py); the commit re-adds each touched file with its descriptor
+    instead of rewriting data (the reference's DV-backed DELETE path,
+    delta-lake/delta-33x+/.../GpuDeleteCommand.scala with
+    RapidsDeletionVectorStore).  Files whose rows are all deleted are
+    removed outright.  Returns the committed version.
+    """
+    import numpy as np
+
+    from spark_rapids_tpu.expressions.core import EvalContext
+    from spark_rapids_tpu.io.delta import load_snapshot
+    from spark_rapids_tpu.io.delta_scan import read_delta_file_batch
+    from spark_rapids_tpu.io.dv import write_dv_file
+
+    snap = load_snapshot(table_path)
+    bound = predicate.bind(snap.schema)
+    new_positions: Dict[str, "np.ndarray"] = {}
+    removes: List[str] = []
+    pvals_of: Dict[str, Dict[str, Optional[str]]] = {}
+    for abs_path, pvals, old_dv in snap.files:
+        rel = os.path.relpath(abs_path, table_path)
+        # evaluate against PHYSICAL rows (pre-DV) so ordinals stay stable
+        batch = read_delta_file_batch(abs_path, pvals, snap, dv=None)
+        n = batch.host_num_rows()
+        colv = bound.eval(EvalContext(batch))
+        vals, valid = colv.to_numpy(n)
+        hits = np.nonzero(np.asarray(vals, np.bool_) & valid)[0] \
+            .astype(np.int64)
+        old = old_dv.load_positions(table_path) if old_dv is not None \
+            else np.empty(0, np.int64)
+        merged = np.union1d(old, hits)
+        if len(merged) == len(old):
+            continue                      # nothing new deleted in this file
+        if len(merged) >= n:
+            removes.append(rel)
+        else:
+            new_positions[rel] = merged
+            pvals_of[rel] = pvals
+
+    if not new_positions and not removes:
+        return snap.version               # no-op DELETE
+
+    descriptors = write_dv_file(table_path, new_positions) \
+        if new_positions else {}
+    now = int(time.time() * 1000)
+    actions: List[dict] = [{"protocol": {
+        "minReaderVersion": 3, "minWriterVersion": 7,
+        "readerFeatures": ["deletionVectors"],
+        "writerFeatures": ["deletionVectors"]}}]
+    for rel in removes:
+        actions.append({"remove": {"path": urllib.parse.quote(rel),
+                                   "deletionTimestamp": now,
+                                   "dataChange": True}})
+    for rel, desc in descriptors.items():
+        abs_path = os.path.join(table_path, rel)
+        actions.append({"add": {
+            "path": urllib.parse.quote(rel),
+            "partitionValues": pvals_of[rel],
+            "size": os.path.getsize(abs_path),
+            "modificationTime": now,
+            "dataChange": True,
+            "deletionVector": desc.to_json(),
+        }})
+    actions.append({"commitInfo": {"timestamp": now, "operation": "DELETE",
+                                   "operationParameters": {}}})
+    new_version = snap.version + 1
+    _commit(table_path, new_version, actions)
+    return new_version
+
+
+def optimize(session, table_path: str, zorder_by: Sequence[str] = (),
+             buckets: int = 1024) -> int:
+    """OPTIMIZE [ZORDER BY (cols)]: compact live files into fresh ones.
+
+    Plain OPTIMIZE bin-packs every live file (applying any DVs) into the
+    writer's normal output; ZORDER additionally sorts by a Morton key
+    over range-bucket ids of the requested columns (the reference plans
+    this as repartitionByRange(interleavebits(partitionerexpr(col)...)),
+    zorder/ZOrderRules.scala + delta OPTIMIZE executor).  Rewrites carry
+    dataChange=false so streaming readers skip them.  Returns the
+    committed version.
+    """
+    import numpy as np
+
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.zorder import RangeBucketId, ZOrderKey
+    from spark_rapids_tpu.io.delta import load_snapshot
+
+    snap = load_snapshot(table_path)
+    df = session.read_delta(table_path)
+    if zorder_by:
+        # one scan collects every z-order column's split points (the
+        # partitioner-expr analog samples; we read the data once)
+        sampled = df.select(*[col(c) for c in zorder_by]).collect()
+        keys = []
+        for ci, c in enumerate(zorder_by):
+            vals = np.sort(np.asarray(
+                [r[ci] for r in sampled if r[ci] is not None]))
+            if len(vals) > 1:
+                qs = np.linspace(0, 1, min(buckets, len(vals)) + 1)[1:-1]
+                bounds = np.unique(np.quantile(vals, qs, method="nearest"))
+            else:
+                bounds = vals[:0]
+            keys.append(RangeBucketId(col(c), bounds))
+        df = df.order_by(ZOrderKey(keys))
+    files = _write_data_files(df, table_path, snap.partition_columns)
+    now = int(time.time() * 1000)
+    actions: List[dict] = []
+    for abs_path, _pv, _dv in snap.files:
+        rel = os.path.relpath(abs_path, table_path)
+        actions.append({"remove": {"path": urllib.parse.quote(rel),
+                                   "deletionTimestamp": now,
+                                   "dataChange": False}})
+    for rel, pvals, rows, size in files:
+        a = _add_action(rel, pvals, rows, size)
+        a["add"]["dataChange"] = False
+        actions.append(a)
+    actions.append({"commitInfo": {
+        "timestamp": now, "operation": "OPTIMIZE",
+        "operationParameters": {"zOrderBy": json.dumps(list(zorder_by))},
     }})
     new_version = snap.version + 1
     _commit(table_path, new_version, actions)

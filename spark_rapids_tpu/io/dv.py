@@ -1,0 +1,286 @@
+"""Delta Lake deletion vectors: on-disk format codec + store.
+
+Reference behavior: delta-lake/common/src/main/delta-33x-41x/scala/org/
+apache/spark/sql/delta/deletionvectors/RapidsDeletionVectorStore.scala
+(load path: 4-byte BE size, payload = 4-byte LE magic + RoaringBitmapArray
+bytes, 4-byte BE CRC32 of the payload) and the public Delta protocol's
+deletion-vector descriptor (storageType u/i/p, Z85-coded UUID paths).
+
+The bitmap payload is a 64-bit "RoaringBitmapArray" in one of two Delta
+serialization formats:
+  portable (magic 1681511377): i64 LE bitmap count, then per bitmap a
+    4-byte LE key (high-32 bits of the values) + a standard-format 32-bit
+    RoaringBitmap;
+  native (magic 1681511376): i32 LE count, then consecutive standard
+    bitmaps with implicit keys 0..n-1.
+The standard 32-bit RoaringBitmap format (the interoperable spec used by
+every roaring implementation) is parsed/emitted here directly in numpy:
+array containers (sorted u16 lists), bitmap containers (1024 u64 words)
+and run containers ([start, length] u16 pairs).  We always WRITE the
+no-run-container flavor (cookie 12346) inside a portable-format array —
+valid input for any Delta reader — and READ all three container kinds.
+
+Deleted positions are row ordinals within one parquet data file.
+"""
+from __future__ import annotations
+
+import os
+import uuid as _uuid
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+PORTABLE_MAGIC = 1681511377
+NATIVE_MAGIC = 1681511376
+_SERIAL_COOKIE_NO_RUN = 12346
+_SERIAL_COOKIE_RUN = 12347
+
+# ZeroMQ Z85 alphabet (Delta's Base85Codec uses this for UUIDs/inline DVs)
+_Z85_CHARS = ("0123456789abcdefghijklmnopqrstuvwxyz"
+              "ABCDEFGHIJKLMNOPQRSTUVWXYZ.-:+=^!/*?&<>()[]{}@%$#")
+_Z85_INDEX = {c: i for i, c in enumerate(_Z85_CHARS)}
+
+
+def z85_encode(data: bytes) -> str:
+    if len(data) % 4:
+        raise ValueError("z85 requires length % 4 == 0")
+    out = []
+    for i in range(0, len(data), 4):
+        v = int.from_bytes(data[i:i + 4], "big")
+        chunk = []
+        for _ in range(5):
+            chunk.append(_Z85_CHARS[v % 85])
+            v //= 85
+        out.extend(reversed(chunk))
+    return "".join(out)
+
+
+def z85_decode(text: str) -> bytes:
+    if len(text) % 5:
+        raise ValueError("z85 requires length % 5 == 0")
+    out = bytearray()
+    for i in range(0, len(text), 5):
+        v = 0
+        for c in text[i:i + 5]:
+            v = v * 85 + _Z85_INDEX[c]
+        out += v.to_bytes(4, "big")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# standard 32-bit RoaringBitmap (de)serialization
+
+
+def _roaring32_deserialize(buf: bytes, off: int) -> Tuple[np.ndarray, int]:
+    """Parse one standard-format 32-bit bitmap at buf[off:].
+
+    Returns (sorted uint32 values, next offset)."""
+    cookie = int.from_bytes(buf[off:off + 4], "little")
+    off += 4
+    if (cookie & 0xFFFF) == _SERIAL_COOKIE_RUN:
+        size = (cookie >> 16) + 1
+        nbytes = (size + 7) // 8
+        run_bits = np.unpackbits(
+            np.frombuffer(buf, np.uint8, nbytes, off), bitorder="little")
+        off += nbytes
+        has_offsets = size >= 4  # NO_OFFSET_THRESHOLD
+    elif cookie == _SERIAL_COOKIE_NO_RUN:
+        size = int.from_bytes(buf[off:off + 4], "little")
+        off += 4
+        run_bits = np.zeros(size, np.uint8)
+        has_offsets = True
+    else:
+        raise ValueError(f"bad roaring cookie {cookie}")
+    desc = np.frombuffer(buf, "<u2", size * 2, off).reshape(size, 2)
+    off += size * 4
+    if has_offsets:
+        off += size * 4  # containers are sequential; offsets are redundant
+    parts: List[np.ndarray] = []
+    for i in range(size):
+        key = int(desc[i, 0])
+        card = int(desc[i, 1]) + 1
+        if run_bits[i]:
+            n_runs = int.from_bytes(buf[off:off + 2], "little")
+            off += 2
+            runs = np.frombuffer(buf, "<u2", n_runs * 2, off) \
+                .reshape(n_runs, 2).astype(np.uint32)
+            off += n_runs * 4
+            vals = np.concatenate(
+                [np.arange(s, s + ln + 1, dtype=np.uint32)
+                 for s, ln in runs]) if n_runs else \
+                np.empty(0, np.uint32)
+        elif card <= 4096:
+            vals = np.frombuffer(buf, "<u2", card, off).astype(np.uint32)
+            off += card * 2
+        else:
+            words = np.frombuffer(buf, "<u8", 1024, off)
+            off += 8192
+            bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+            vals = np.nonzero(bits)[0].astype(np.uint32)
+        parts.append(vals | np.uint32(key << 16))
+    values = np.concatenate(parts) if parts else np.empty(0, np.uint32)
+    return values, off
+
+
+def _roaring32_serialize(values: np.ndarray) -> bytes:
+    """Serialize sorted unique uint32 values (no-run-container flavor)."""
+    values = np.asarray(values, np.uint32)
+    keys = (values >> 16).astype(np.uint16)
+    lows = values.astype(np.uint16)
+    uk, starts = np.unique(keys, return_index=True)
+    bounds = list(starts) + [len(values)]
+    header = (_SERIAL_COOKIE_NO_RUN).to_bytes(4, "little") + \
+        len(uk).to_bytes(4, "little")
+    desc = bytearray()
+    containers: List[bytes] = []
+    for i, k in enumerate(uk):
+        chunk = lows[bounds[i]:bounds[i + 1]]
+        desc += int(k).to_bytes(2, "little")
+        desc += (len(chunk) - 1).to_bytes(2, "little")
+        if len(chunk) <= 4096:
+            containers.append(chunk.astype("<u2").tobytes())
+        else:
+            bits = np.zeros(65536, np.uint8)
+            bits[chunk.astype(np.int64)] = 1
+            containers.append(
+                np.packbits(bits, bitorder="little").tobytes())
+    # offset header: byte position of each container from stream start
+    base = len(header) + len(desc) + 4 * len(uk)
+    offsets = bytearray()
+    pos = base
+    for c in containers:
+        offsets += pos.to_bytes(4, "little")
+        pos += len(c)
+    return header + bytes(desc) + bytes(offsets) + b"".join(containers)
+
+
+def bitmap_array_deserialize(payload: bytes) -> np.ndarray:
+    """Delta RoaringBitmapArray payload (incl. magic) -> sorted int64."""
+    magic = int.from_bytes(payload[0:4], "little")
+    off = 4
+    parts: List[np.ndarray] = []
+    if magic == PORTABLE_MAGIC:
+        count = int.from_bytes(payload[off:off + 8], "little")
+        off += 8
+        for _ in range(count):
+            key = int.from_bytes(payload[off:off + 4], "little")
+            off += 4
+            vals, off = _roaring32_deserialize(payload, off)
+            parts.append(vals.astype(np.int64) | (np.int64(key) << 32))
+    elif magic == NATIVE_MAGIC:
+        count = int.from_bytes(payload[off:off + 4], "little")
+        off += 4
+        for key in range(count):
+            vals, off = _roaring32_deserialize(payload, off)
+            parts.append(vals.astype(np.int64) | (np.int64(key) << 32))
+    else:
+        raise ValueError(f"unexpected RoaringBitmapArray magic {magic}")
+    if not parts:
+        return np.empty(0, np.int64)
+    return np.sort(np.concatenate(parts))
+
+
+def bitmap_array_serialize(positions: np.ndarray) -> bytes:
+    """Sorted int64 row positions -> portable payload (incl. magic)."""
+    positions = np.unique(np.asarray(positions, np.int64))
+    keys = (positions >> 32).astype(np.int64)
+    out = bytearray(PORTABLE_MAGIC.to_bytes(4, "little"))
+    uk, starts = np.unique(keys, return_index=True)
+    bounds = list(starts) + [len(positions)]
+    out += len(uk).to_bytes(8, "little")
+    for i, k in enumerate(uk):
+        chunk = (positions[bounds[i]:bounds[i + 1]] &
+                 np.int64(0xFFFFFFFF)).astype(np.uint32)
+        out += int(k).to_bytes(4, "little")
+        out += _roaring32_serialize(chunk)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# descriptor + file store
+
+
+@dataclass
+class DeletionVectorDescriptor:
+    storage_type: str                 # 'u' | 'i' | 'p'
+    path_or_inline: str
+    offset: Optional[int]
+    size_in_bytes: int
+    cardinality: int
+
+    @staticmethod
+    def from_json(obj: dict) -> "DeletionVectorDescriptor":
+        return DeletionVectorDescriptor(
+            obj["storageType"], obj["pathOrInlineDv"], obj.get("offset"),
+            obj["sizeInBytes"], obj["cardinality"])
+
+    def to_json(self) -> dict:
+        out = {"storageType": self.storage_type,
+               "pathOrInlineDv": self.path_or_inline,
+               "sizeInBytes": self.size_in_bytes,
+               "cardinality": self.cardinality}
+        if self.offset is not None:
+            out["offset"] = self.offset
+        return out
+
+    def absolute_path(self, table_path: str) -> str:
+        if self.storage_type == "p":
+            return self.path_or_inline
+        if self.storage_type != "u":
+            raise ValueError(f"no path for storageType {self.storage_type}")
+        encoded = self.path_or_inline[-20:]
+        prefix = self.path_or_inline[:-20]
+        u = _uuid.UUID(bytes=z85_decode(encoded))
+        name = f"deletion_vector_{u}.bin"
+        return os.path.join(table_path, prefix, name) if prefix else \
+            os.path.join(table_path, name)
+
+    def load_positions(self, table_path: str) -> np.ndarray:
+        """Sorted int64 deleted row ordinals for the owning data file."""
+        if self.storage_type == "i":
+            payload = z85_decode(self.path_or_inline)
+            return bitmap_array_deserialize(payload[:self.size_in_bytes])
+        with open(self.absolute_path(table_path), "rb") as f:
+            f.seek(self.offset or 0)
+            size = int.from_bytes(f.read(4), "big")
+            if size != self.size_in_bytes:
+                raise ValueError(
+                    f"DV size mismatch: descriptor {self.size_in_bytes}, "
+                    f"file {size}")
+            payload = f.read(size)
+            expected = int.from_bytes(f.read(4), "big", signed=True)
+        actual = np.int32(np.uint32(zlib.crc32(payload) & 0xFFFFFFFF))
+        if int(actual) != expected:
+            raise ValueError("DV checksum mismatch")
+        return bitmap_array_deserialize(payload)
+
+
+def write_dv_file(table_path: str,
+                  per_file_positions: Dict[str, np.ndarray]
+                  ) -> Dict[str, DeletionVectorDescriptor]:
+    """Pack one DV per data file into a single deletion_vector_*.bin.
+
+    Layout (matching the reference loader's expectations): 1-byte format
+    version, then per DV [4-byte BE size][payload][4-byte BE CRC32].
+    Returns {data rel_path: descriptor} with storageType 'u'.
+    """
+    u = _uuid.uuid4()
+    name = f"deletion_vector_{u}.bin"
+    encoded = z85_encode(u.bytes)
+    out: Dict[str, DeletionVectorDescriptor] = {}
+    body = bytearray(b"\x01")           # format version
+    for rel, positions in per_file_positions.items():
+        payload = bitmap_array_serialize(positions)
+        offset = len(body)
+        body += len(payload).to_bytes(4, "big")
+        body += payload
+        crc = np.int32(np.uint32(zlib.crc32(payload) & 0xFFFFFFFF))
+        body += int(crc).to_bytes(4, "big", signed=True)
+        out[rel] = DeletionVectorDescriptor(
+            "u", encoded, offset, len(payload),
+            int(len(np.unique(np.asarray(positions, np.int64)))))
+    with open(os.path.join(table_path, name), "wb") as f:
+        f.write(bytes(body))
+    return out

@@ -9,9 +9,10 @@ reader pool.
 
 Supported: unpartitioned + identity-partitioned tables, append/overwrite
 commits with snapshot lineage, time travel by snapshot id or timestamp,
-file-level min/max pruning from manifest stats.  Gated: merge-on-read
-delete files (v2) raise — the reference gates those the same way
-(copy-on-write only).
+file-level min/max pruning from manifest stats, and v2 merge-on-read
+deletes — position + equality delete files applied at scan through
+DeleteFilter (reference: iceberg/common/.../GpuDeleteFilter.scala) with
+write-side commit_position_deletes / commit_equality_deletes.
 """
 from __future__ import annotations
 
@@ -84,6 +85,8 @@ def _manifest_entry_schema(partition_fields: List[dict]) -> dict:
     part = {"type": "record", "name": "r102", "fields": partition_fields}
     data_file = {
         "type": "record", "name": "r2", "fields": [
+            {"name": "content", "type": "int", "default": 0,
+             "field-id": 134},
             {"name": "file_path", "type": "string", "field-id": 100},
             {"name": "file_format", "type": "string", "field-id": 101},
             {"name": "partition", "type": part, "field-id": 102},
@@ -95,12 +98,17 @@ def _manifest_entry_schema(partition_fields: List[dict]) -> dict:
             {"name": "upper_bounds", "type": ["null", {
                 "type": "map", "values": "bytes"}], "default": None,
              "field-id": 128},
+            {"name": "equality_ids", "type": ["null", {
+                "type": "array", "items": "int"}], "default": None,
+             "field-id": 135},
         ]}
     return {
         "type": "record", "name": "manifest_entry", "fields": [
             {"name": "status", "type": "int", "field-id": 0},
             {"name": "snapshot_id", "type": ["null", "long"],
              "default": None, "field-id": 1},
+            {"name": "sequence_number", "type": ["null", "long"],
+             "default": None, "field-id": 3},
             {"name": "data_file", "type": data_file, "field-id": 2},
         ]}
 
@@ -110,6 +118,9 @@ _MANIFEST_LIST_SCHEMA = {
         {"name": "manifest_path", "type": "string", "field-id": 500},
         {"name": "manifest_length", "type": "long", "field-id": 501},
         {"name": "partition_spec_id", "type": "int", "field-id": 502},
+        {"name": "content", "type": "int", "default": 0, "field-id": 517},
+        {"name": "sequence_number", "type": ["null", "long"],
+         "default": None, "field-id": 515},
         {"name": "added_snapshot_id", "type": ["null", "long"],
          "default": None, "field-id": 503},
         {"name": "added_data_files_count", "type": ["null", "int"],
@@ -130,23 +141,40 @@ class IcebergSnapshot:
         self.snapshot_id = snap["snapshot-id"]
         self.schema = iceberg_to_schema(_current_struct(meta))
 
-    def data_files(self) -> List[dict]:
-        """Live data files: (path, record_count, lower/upper bounds)."""
+    def _live_entries(self) -> List[dict]:
+        """All live manifest entries; each data-file dict gains ``_seq``,
+        its data sequence number (explicit entry field, else inherited
+        from the manifest, else 0 for v1 tables).  Cached: data_files()
+        and delete_files() share one manifest decode per snapshot."""
+        cached = getattr(self, "_entries_cache", None)
+        if cached is not None:
+            return cached
         mlist = self.snapshot["manifest-list"]
         _, manifests, _ = avro.read_container(mlist)
-        files = []
+        out = []
         for mf in manifests:
+            mseq = mf.get("sequence_number") or 0
             _, entries, _ = avro.read_container(mf["manifest_path"])
             for e in entries:
                 if e.get("status", STATUS_ADDED) == STATUS_DELETED:
                     continue
-                df = e["data_file"]
-                if df.get("content", 0) not in (0, None):
-                    raise NotImplementedError(
-                        "merge-on-read delete files not supported "
-                        "(copy-on-write tables only)")
-                files.append(df)
-        return files
+                df = dict(e["data_file"])
+                seq = e.get("sequence_number")
+                df["_seq"] = mseq if seq is None else seq
+                out.append(df)
+        self._entries_cache = out
+        return out
+
+    def data_files(self) -> List[dict]:
+        """Live data files (content 0): path, record_count, bounds, _seq."""
+        return [df for df in self._live_entries()
+                if (df.get("content") or 0) == 0]
+
+    def delete_files(self) -> List[dict]:
+        """Live v2 merge-on-read delete files: content 1 (position) and
+        2 (equality), each with ``_seq`` for applicability checks."""
+        return [df for df in self._live_entries()
+                if (df.get("content") or 0) in (1, 2)]
 
 
 def _current_struct(meta: dict) -> dict:
@@ -291,6 +319,8 @@ class IcebergWriter:
 
         snapshot_id = int(uuid.uuid4().int % (1 << 62))
         now_ms = int(time.time() * 1000)
+        seq = (int(prior.meta.get("last-sequence-number") or 0)
+               if prior is not None else 0) + 1
 
         # 1. data files + per-file stats
         entries = []
@@ -336,6 +366,7 @@ class IcebergWriter:
                 entries.append({
                     "status": STATUS_ADDED,
                     "snapshot_id": snapshot_id,
+                    "sequence_number": seq,
                     "data_file": {
                         "file_path": fpath,
                         "file_format": "PARQUET",
@@ -346,10 +377,11 @@ class IcebergWriter:
                         "upper_bounds": upper or None,
                     }})
 
-        # carry forward prior files on append
+        # carry forward prior files on append (data AND delete files;
+        # each keeps its original data sequence number)
         if prior is not None and mode == "append":
             prev_snap = prior.snapshot()
-            for df in prev_snap.data_files():
+            for df in prev_snap._live_entries():
                 # normalize Iceberg-Java array-form bounds to the map form
                 # this writer's manifest schema serializes
                 df = dict(df)
@@ -359,6 +391,7 @@ class IcebergWriter:
                     df.get("upper_bounds")) or None
                 entries.append({"status": STATUS_EXISTING,
                                 "snapshot_id": prev_snap.snapshot_id,
+                                "sequence_number": df.pop("_seq", 0),
                                 "data_file": df})
 
         # 2. manifest
@@ -373,6 +406,8 @@ class IcebergWriter:
             "manifest_path": mpath,
             "manifest_length": os.path.getsize(mpath),
             "partition_spec_id": 0,
+            "content": 0,
+            "sequence_number": seq,
             "added_snapshot_id": snapshot_id,
             "added_data_files_count": sum(
                 1 for e in entries if e["status"] == STATUS_ADDED),
@@ -409,6 +444,7 @@ class IcebergWriter:
         meta["snapshots"] = snaps
         meta["current-snapshot-id"] = snapshot_id
         meta["last-updated-ms"] = now_ms
+        meta["last-sequence-number"] = seq
         mjson = os.path.join(mdir, f"v{version}.metadata.json")
         tmp = mjson + ".tmp"
         with open(tmp, "w") as f:
@@ -488,3 +524,210 @@ def prune_files(files: List[dict], schema: Schema, predicate,
         if keep:
             out.append(df)
     return out
+
+
+# -- merge-on-read delete application (v2) ------------------------------------
+
+class DeleteFilter:
+    """Applies v2 position + equality delete files to data-file reads.
+
+    Reference: iceberg/common/.../GpuDeleteFilter.scala — the GPU scan
+    wraps each data-file batch with (a) a row-ordinal mask from position
+    deletes targeting that file and (b) an anti-join against equality
+    delete rows.  Sequence rules per the Iceberg spec: a position delete
+    applies to data files with data-seq <= delete-seq; an equality delete
+    applies strictly to OLDER data files (data-seq < delete-seq).
+    """
+
+    def __init__(self, schema: Schema, id_to_name: Dict[int, str],
+                 delete_files: List[dict]):
+        import numpy as np
+        import pyarrow.parquet as pq
+        self.schema = schema
+        # position deletes: {data file path: (positions int64, seq)} merged
+        self._pos: Dict[str, List[Tuple[int, "object"]]] = {}
+        # equality deletes: (seq, [col names], set of value tuples)
+        self._eq: List[Tuple[int, List[str], set]] = []
+        for df in delete_files:
+            seq = df.get("_seq") or 0
+            content = df.get("content") or 0
+            table = pq.read_table(df["file_path"])
+            if content == 1:
+                paths = np.asarray(table.column("file_path").to_pylist(),
+                                   dtype=object)
+                poss = np.asarray(table.column("pos").to_pylist(), np.int64)
+                uniq, inverse = np.unique(paths, return_inverse=True)
+                for i, p in enumerate(uniq):
+                    self._pos.setdefault(str(p), []).append(
+                        (seq, poss[inverse == i]))
+            elif content == 2:
+                ids = df.get("equality_ids") or []
+                names = [id_to_name[i] for i in ids]
+                rows = set(zip(*[table.column(n).to_pylist()
+                                 for n in names])) if names else set()
+                self._eq.append((seq, names, rows))
+
+    @property
+    def has_deletes(self) -> bool:
+        return bool(self._pos or self._eq)
+
+    def eq_columns(self) -> List[str]:
+        out: List[str] = []
+        for _seq, names, _rows in self._eq:
+            for n in names:
+                if n not in out:
+                    out.append(n)
+        return out
+
+    def keep_mask(self, data_file_path: str, data_seq: int, arrow_table):
+        """bool ndarray of rows to keep, or None when nothing applies."""
+        import numpy as np
+        n = arrow_table.num_rows
+        keep = None
+        for seq, positions in self._pos.get(data_file_path, ()):
+            if seq >= data_seq:
+                if keep is None:
+                    keep = np.ones(n, np.bool_)
+                keep[positions[positions < n]] = False
+        for seq, names, rows in self._eq:
+            if seq > data_seq and rows:
+                cols = [arrow_table.column(nm).to_pylist() for nm in names]
+                hit = np.asarray([t in rows for t in zip(*cols)], np.bool_)
+                if keep is None:
+                    keep = np.ones(n, np.bool_)
+                keep &= ~hit
+        return keep
+
+
+POS_DELETE_FIELD_PATH = 2147483546   # reserved field ids (spec)
+POS_DELETE_FIELD_POS = 2147483545
+
+
+def _commit_delete_snapshot(table: "IcebergTable", snap: IcebergSnapshot,
+                            snapshot_id: int, seq: int, delete_entry: dict,
+                            mname: str, rows: int) -> int:
+    """Shared MOR-delete commit tail: write the delete manifest, append it
+    to the prior snapshot's manifest list, and publish new v2 metadata.
+    Used by both position- and equality-delete commits so the commit
+    semantics (atomic tmp+rename publish, version hint, sequence-number
+    bookkeeping) live in one place."""
+    now_ms = int(time.time() * 1000)
+    mdir = os.path.join(table.table_path, "metadata")
+    mpath = os.path.join(mdir, mname)
+    avro.write_container(mpath, _manifest_entry_schema([]), [delete_entry])
+
+    # manifest list = prior snapshot's manifests + the delete manifest
+    _, prior_manifests, _ = avro.read_container(
+        snap.snapshot["manifest-list"])
+    mentries = [dict(mf) for mf in prior_manifests]
+    for mf in mentries:
+        mf.setdefault("content", 0)
+        mf.setdefault("sequence_number", None)
+    mentries.append({
+        "manifest_path": mpath,
+        "manifest_length": os.path.getsize(mpath),
+        "partition_spec_id": 0,
+        "content": 1,
+        "sequence_number": seq,
+        "added_snapshot_id": snapshot_id,
+        "added_data_files_count": 1,
+        "added_rows_count": rows,
+    })
+    lpath = os.path.join(mdir, f"snap-{snapshot_id}.avro")
+    avro.write_container(lpath, _MANIFEST_LIST_SCHEMA, mentries)
+
+    meta = dict(table.meta)
+    meta["format-version"] = 2
+    meta["last-sequence-number"] = seq
+    snaps = list(meta.get("snapshots", []))
+    snaps.append({"snapshot-id": snapshot_id, "timestamp-ms": now_ms,
+                  "sequence-number": seq, "manifest-list": lpath,
+                  "summary": {"operation": "delete"}})
+    meta["snapshots"] = snaps
+    meta["current-snapshot-id"] = snapshot_id
+    meta["last-updated-ms"] = now_ms
+    version = table.version + 1
+    mjson = os.path.join(mdir, f"v{version}.metadata.json")
+    tmp = mjson + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, mjson)
+    with open(os.path.join(mdir, "version-hint.text"), "w") as f:
+        f.write(str(version))
+    return snapshot_id
+
+
+def commit_position_deletes(table_path: str,
+                            per_file_positions: Dict[str, "object"]) -> int:
+    """Write one position-delete parquet + a delete manifest and commit a
+    new snapshot (sequence number above every live data file).
+
+    Returns the new snapshot id."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = IcebergTable.load(table_path)
+    snap = table.snapshot()
+    seq = int(table.meta.get("last-sequence-number") or 0) + 1
+    snapshot_id = int(uuid.uuid4().int % (1 << 62))
+
+    paths: List[str] = []
+    poss: List[int] = []
+    for p, positions in sorted(per_file_positions.items()):
+        for x in np.unique(np.asarray(positions, np.int64)):
+            paths.append(p)
+            poss.append(int(x))
+    dpath = os.path.join(table_path, "data",
+                         f"delete-{snapshot_id}.parquet")
+    pq.write_table(pa.table({"file_path": pa.array(paths, pa.string()),
+                             "pos": pa.array(poss, pa.int64())}), dpath)
+
+    entry = {"status": STATUS_ADDED, "snapshot_id": snapshot_id,
+             "sequence_number": seq,
+             "data_file": {
+                 "content": 1,
+                 "file_path": dpath,
+                 "file_format": "PARQUET",
+                 "partition": {},
+                 "record_count": len(poss),
+                 "file_size_in_bytes": os.path.getsize(dpath),
+                 "lower_bounds": None, "upper_bounds": None,
+                 "equality_ids": None,
+             }}
+    return _commit_delete_snapshot(table, snap, snapshot_id, seq, entry,
+                                   f"m-del-{snapshot_id}.avro", len(poss))
+
+
+def commit_equality_deletes(table_path: str, arrow_table,
+                            eq_columns: List[str]) -> int:
+    """Write an equality-delete parquet (rows to delete, keyed by
+    eq_columns) and commit a new snapshot.  Returns the snapshot id."""
+    import pyarrow.parquet as pq
+
+    table = IcebergTable.load(table_path)
+    snap = table.snapshot()
+    struct = _current_struct(table.meta)
+    ids = field_ids(struct)
+    eq_ids = [ids[c] for c in eq_columns]
+    seq = int(table.meta.get("last-sequence-number") or 0) + 1
+    snapshot_id = int(uuid.uuid4().int % (1 << 62))
+
+    dpath = os.path.join(table_path, "data", f"eqdel-{snapshot_id}.parquet")
+    pq.write_table(arrow_table.select(eq_columns), dpath)
+
+    entry = {"status": STATUS_ADDED, "snapshot_id": snapshot_id,
+             "sequence_number": seq,
+             "data_file": {
+                 "content": 2,
+                 "file_path": dpath,
+                 "file_format": "PARQUET",
+                 "partition": {},
+                 "record_count": arrow_table.num_rows,
+                 "file_size_in_bytes": os.path.getsize(dpath),
+                 "lower_bounds": None, "upper_bounds": None,
+                 "equality_ids": eq_ids,
+             }}
+    return _commit_delete_snapshot(table, snap, snapshot_id, seq, entry,
+                                   f"m-eqdel-{snapshot_id}.avro",
+                                   arrow_table.num_rows)

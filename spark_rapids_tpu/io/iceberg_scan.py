@@ -1,0 +1,74 @@
+"""Iceberg merge-on-read scan: data files + v2 delete-file application.
+
+Reference: iceberg/common/.../GpuSparkBatchQueryScan.scala routes scans
+with delete files through GpuDeleteFilter (position mask + equality
+anti-filter) before batches reach the plan.  Tables without deletes take
+the pooled parquet scan path instead (planner/overrides.py) — this exec
+only exists when the snapshot carries live delete files, mirroring the
+reference's "only pay for MOR when MOR is present" structure.
+
+Deletes are applied host-side at decode time (the mask is per-file and
+the parquet decode is already host-side), then the surviving rows upload
+once.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.io.iceberg import DeleteFilter, _current_struct
+from spark_rapids_tpu.plan.execs.base import TpuExec, timed
+
+
+def read_mor_file_batch(df: dict, delete_filter: DeleteFilter,
+                        schema: Schema,
+                        projection: Optional[List[str]] = None
+                        ) -> ColumnarBatch:
+    """One data file -> batch with position/equality deletes applied."""
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.columnar.arrow import arrow_to_batch
+    want = list(projection) if projection else list(schema.names)
+    # equality-delete columns must be present to evaluate the anti-filter
+    read_cols = list(want)
+    for c in delete_filter.eq_columns():
+        if c not in read_cols and c in schema.names:
+            read_cols.append(c)
+    table = pq.read_table(df["file_path"], columns=read_cols)
+    keep = delete_filter.keep_mask(df["file_path"], df.get("_seq") or 0,
+                                   table)
+    if keep is not None:
+        import pyarrow as pa
+        table = table.filter(pa.array(keep))
+    if read_cols != want:
+        table = table.select(want)
+    return arrow_to_batch(table)
+
+
+class TpuIcebergMorScanExec(TpuExec):
+    def __init__(self, relation, schema: Schema):
+        super().__init__((), schema)
+        self.relation = relation
+        struct = _current_struct(relation.snapshot.meta)
+        id_to_name = {f["id"]: f["name"] for f in struct["fields"]}
+        self.delete_filter = DeleteFilter(
+            relation.snapshot.schema, id_to_name, relation.deletes)
+
+    def num_partitions(self) -> int:
+        return max(len(self.relation.files), 1)
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        if idx >= len(self.relation.files):
+            return
+        df = self.relation.files[idx]
+        with timed(self.op_time):
+            batch = read_mor_file_batch(
+                df, self.delete_filter, self.relation.snapshot.schema,
+                list(self.relation.projection)
+                if self.relation.projection else None)
+        self.output_rows.add(batch.num_rows)
+        yield self._count_out(batch)
+
+    def describe(self):
+        return (f"TpuIcebergMorScan[{self.relation.table_path}, "
+                f"{len(self.relation.files)} files, "
+                f"{len(self.relation.deletes)} delete files]")

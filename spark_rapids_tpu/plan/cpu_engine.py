@@ -311,8 +311,8 @@ class CpuEngine:
     def _exec_deltarelation(self, plan: L.DeltaRelation):
         from spark_rapids_tpu.io.delta_scan import read_delta_file_batch
         out = []
-        for path, pvals in plan.snapshot.files:
-            batch = read_delta_file_batch(path, pvals, plan.snapshot)
+        for path, pvals, dv in plan.snapshot.files:
+            batch = read_delta_file_batch(path, pvals, plan.snapshot, dv)
             out.append(CpuTable.from_batch(batch))
         return out or [CpuTable.empty(plan.schema)]
 
@@ -320,6 +320,20 @@ class CpuEngine:
         import pyarrow.parquet as pq
         from spark_rapids_tpu.columnar.arrow import arrow_to_batch
         out = []
+        if plan.deletes:
+            from spark_rapids_tpu.io.iceberg import (
+                DeleteFilter, _current_struct)
+            from spark_rapids_tpu.io.iceberg_scan import read_mor_file_batch
+            struct = _current_struct(plan.snapshot.meta)
+            id_to_name = {f["id"]: f["name"] for f in struct["fields"]}
+            filt = DeleteFilter(plan.snapshot.schema, id_to_name,
+                                plan.deletes)
+            for df in plan.files:
+                batch = read_mor_file_batch(
+                    df, filt, plan.snapshot.schema,
+                    list(plan.projection) if plan.projection else None)
+                out.append(CpuTable.from_batch(batch))
+            return out or [CpuTable.empty(plan.schema)]
         for df in plan.files:
             table = pq.read_table(df["file_path"],
                                   columns=list(plan.schema.names))

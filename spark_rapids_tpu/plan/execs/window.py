@@ -521,6 +521,15 @@ class TpuWindowExec(TpuExec):
                         lambda: step)(b))
             h.unpin()
             ng = int(ngroups)
+            if len(state) + ng > _TWO_PASS_MAX_KEYS:
+                # high-cardinality partitioning: bail BEFORE paying the
+                # O(groups) host loop below — key-batching splits such
+                # data fine on device.  The "tiny per-key states"
+                # assumption is CHECKED, not hoped.
+                rebatched = [hh.release_device_copy() for hh in handles]
+                total = sum(bb.capacity for bb in rebatched)
+                yield from self._execute_out_of_core(rebatched, total)
+                return
             host = [(np.asarray(d)[:ng], np.asarray(v)[:ng])
                     for d, v in cols]
             nk = len(key_ords)
@@ -536,18 +545,6 @@ class TpuWindowExec(TpuExec):
                     originals[key] = raw
                 state[key] = slots if cur is None else \
                     _merge_slots(cur, slots, specs)
-            if len(state) > _TWO_PASS_MAX_KEYS:
-                # high-cardinality partitioning: the per-key host loop
-                # would dominate — key-batching splits such data fine on
-                # device.  The "tiny per-key states" assumption is
-                # CHECKED, not hoped.
-                rebatched = [hh.materialize() for hh in handles]
-                for hh in handles:
-                    hh.unpin()
-                    hh.close()
-                total = sum(bb.capacity for bb in rebatched)
-                yield from self._execute_out_of_core(rebatched, total)
-                return
 
         # finalize per-key window values (keyed by the REPRESENTATIVE raw
         # key so NaN re-materializes as a float in the build table)

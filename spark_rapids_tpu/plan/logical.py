@@ -124,10 +124,12 @@ class IcebergRelation(LogicalPlan):
     columns in the data files, so no partition-constant injection is
     needed — identity partitions ride along)."""
 
-    def __init__(self, table_path: str, snapshot, files, projection=None):
+    def __init__(self, table_path: str, snapshot, files, projection=None,
+                 deletes=()):
         self.table_path = table_path
         self.snapshot = snapshot
         self.files = list(files)          # data-file dicts
+        self.deletes = list(deletes)      # v2 MOR delete-file dicts
         self.projection = tuple(projection) if projection else None
         if self.projection:
             idx = [snapshot.schema.index_of(n) for n in self.projection]

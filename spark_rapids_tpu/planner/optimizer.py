@@ -223,7 +223,7 @@ def _prune(plan: L.LogicalPlan, required: Set[str]) -> L.LogicalPlan:
                 tuple(keep), p.options)
         if isinstance(p, L.IcebergRelation):
             return L.IcebergRelation(p.table_path, p.snapshot, p.files,
-                                     projection=keep)
+                                     projection=keep, deletes=p.deletes)
         # in-memory / delta: select on top (BoundReference re-pick is
         # zero-copy in the exec)
         return L.Project([Col(n) for n in keep], p)

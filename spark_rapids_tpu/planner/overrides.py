@@ -712,6 +712,10 @@ class PlanMeta:
             from spark_rapids_tpu.io.delta_scan import TpuDeltaScanExec
             return TpuDeltaScanExec(p.table_path, p.snapshot, p.schema)
         if isinstance(p, L.IcebergRelation):
+            if p.deletes:
+                from spark_rapids_tpu.io.iceberg_scan import (
+                    TpuIcebergMorScanExec)
+                return TpuIcebergMorScanExec(p, p.schema)
             return TpuParquetScanExec(
                 [df["file_path"] for df in p.files], p.schema,
                 p.projection, self.conf.batch_size_rows,

@@ -271,6 +271,11 @@ def _build_registry() -> None:
     register(ST.MapKeys, ExprSig(ARR, MAP))
     register(ST.MapValues, ExprSig(ARR, MAP))
 
+    # z-order (OPTIMIZE ZORDER BY sort keys)
+    from spark_rapids_tpu.expressions import zorder as Z
+    register(Z.RangeBucketId, ExprSig(TypeSig("int"), NUMERIC))
+    register(Z.ZOrderKey, ExprSig(TypeSig("long"), INTEGRAL))
+
     # hashing / sketches
     register(H.Murmur3Hash, ExprSig(TypeSig("int"), ORDERED))
     register(H.HiveHash, ExprSig(TypeSig("int"), ORDERED))

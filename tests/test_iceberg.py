@@ -131,3 +131,104 @@ def test_avro_scan(tmp_path):
     got = sorted(s.read_avro(p).collect())
     exp = sorted(o.read_avro(p).collect())
     assert got == exp and len(got) == 20 and got[1] == (1, "v1")
+
+
+# ---------------------------------------------------------------------------
+# v2 merge-on-read deletes
+
+
+def test_position_delete_end_to_end(tmp_path):
+    s, o = _sessions()
+    path = str(tmp_path / "mor1")
+    _df(s, 0, 60).write_iceberg(path, mode="error")
+    s.iceberg_delete(path, col("v") % lit(4) == lit(1))
+    got = sorted(r[1] for r in s.read_iceberg(path).collect())
+    exp = sorted(r[1] for r in o.read_iceberg(path).collect())
+    assert got == exp == [v for v in range(60) if v % 4 != 1]
+    # the data files were NOT rewritten (merge-on-read)
+    from spark_rapids_tpu.io.iceberg import IcebergTable
+    snap = IcebergTable.load(path).snapshot()
+    assert len(snap.delete_files()) == 1
+    assert snap.delete_files()[0]["content"] == 1
+
+
+def test_position_delete_layering(tmp_path):
+    s, _ = _sessions()
+    path = str(tmp_path / "mor2")
+    _df(s, 0, 40).write_iceberg(path, mode="error")
+    s.iceberg_delete(path, col("v") < lit(10))
+    s.iceberg_delete(path, col("v") >= lit(35))
+    got = sorted(r[1] for r in s.read_iceberg(path).collect())
+    assert got == list(range(10, 35))
+
+
+def test_position_delete_time_travel(tmp_path):
+    s, _ = _sessions()
+    path = str(tmp_path / "mor3")
+    _df(s, 0, 30).write_iceberg(path, mode="error")
+    from spark_rapids_tpu.io.iceberg import IcebergTable
+    before = IcebergTable.load(path).snapshot().snapshot_id
+    s.iceberg_delete(path, col("v") == lit(7))
+    assert s.read_iceberg(path).count() == 29
+    assert s.read_iceberg(path, snapshot_id=before).count() == 30
+
+
+def test_equality_delete(tmp_path):
+    import pyarrow as pa
+    s, o = _sessions()
+    path = str(tmp_path / "mor4")
+    _df(s, 0, 50).write_iceberg(path, mode="error")
+    from spark_rapids_tpu.io.iceberg import commit_equality_deletes
+    commit_equality_deletes(
+        path, pa.table({"k": pa.array([2, 4], pa.int32())}), ["k"])
+    got = sorted(r[1] for r in s.read_iceberg(path).collect())
+    exp = sorted(r[1] for r in o.read_iceberg(path).collect())
+    assert got == exp == [v for v in range(50) if v % 5 not in (2, 4)]
+
+
+def test_equality_delete_sequence_scoping(tmp_path):
+    """Rows appended AFTER an equality delete must survive it (data seq
+    >= delete seq -> not applicable, Iceberg spec)."""
+    import pyarrow as pa
+    s, _ = _sessions()
+    path = str(tmp_path / "mor5")
+    _df(s, 0, 25).write_iceberg(path, mode="error")
+    from spark_rapids_tpu.io.iceberg import commit_equality_deletes
+    commit_equality_deletes(
+        path, pa.table({"k": pa.array([1], pa.int32())}), ["k"])
+    # append rows with k values incl. 1: they are NEWER than the delete
+    _df(s, 25, 50).write_iceberg(path, mode="append")
+    got = sorted(r[1] for r in s.read_iceberg(path).collect())
+    old_survivors = [v for v in range(25) if v % 5 != 1]
+    assert got == sorted(old_survivors + list(range(25, 50)))
+
+
+def test_mor_with_projection_dropping_eq_column(tmp_path):
+    """Equality-delete column pruned from the projection must still be
+    read internally to evaluate the filter."""
+    import pyarrow as pa
+    s, o = _sessions()
+    path = str(tmp_path / "mor6")
+    _df(s, 0, 30).write_iceberg(path, mode="error")
+    from spark_rapids_tpu.io.iceberg import commit_equality_deletes
+    commit_equality_deletes(
+        path, pa.table({"k": pa.array([0], pa.int32())}), ["k"])
+    got = sorted(r[0] for r in
+                 s.read_iceberg(path).select(col("v")).collect())
+    exp = sorted(r[0] for r in
+                 o.read_iceberg(path).select(col("v")).collect())
+    assert got == exp == [v for v in range(30) if v % 5 != 0]
+
+
+def test_position_delete_rerun_is_noop(tmp_path):
+    """Re-running the same DELETE predicate must not commit a new
+    snapshot (already-covered ordinals are subtracted)."""
+    s, _ = _sessions()
+    path = str(tmp_path / "mor7")
+    _df(s, 0, 20).write_iceberg(path, mode="error")
+    first = s.iceberg_delete(path, col("v") < lit(5))
+    again = s.iceberg_delete(path, col("v") < lit(5))
+    assert again == first
+    from spark_rapids_tpu.io.iceberg import IcebergTable
+    assert len(IcebergTable.load(path).snapshot().delete_files()) == 1
+    assert s.read_iceberg(path).count() == 15

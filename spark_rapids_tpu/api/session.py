@@ -207,7 +207,7 @@ class TpuSession:
         struct = _current_struct(snap.meta)
         id_to_name = {f["id"]: f["name"] for f in struct["fields"]}
         existing = DeleteFilter(snap.schema, id_to_name,
-                                snap.delete_files())
+                                snap.delete_files(), positions_only=True)
         bound = _to_expr(predicate).bind(snap.schema)
         per_file = {}
         for df in snap.data_files():
@@ -223,11 +223,10 @@ class TpuSession:
                 .astype(np.int64)
             # drop ordinals an applicable position delete already covers,
             # so re-running the same DELETE is a true no-op
-            covered = [pos for seq, pos in
-                       existing._pos.get(df["file_path"], ())
-                       if seq >= (df.get("_seq") or 0)]
-            if covered:
-                hits = np.setdiff1d(hits, np.concatenate(covered))
+            covered = existing.positions_for(df["file_path"],
+                                             df.get("_seq") or 0)
+            if len(covered):
+                hits = np.setdiff1d(hits, covered)
             if len(hits):
                 per_file[df["file_path"]] = hits
         if not per_file:

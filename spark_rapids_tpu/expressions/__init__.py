@@ -184,3 +184,8 @@ from spark_rapids_tpu.expressions.strings import (
     Conv, ParseUrl, conv, parse_url)
 from spark_rapids_tpu.expressions.window import (
     CumeDist, FirstValue, LastValue, NthValue, Ntile, PercentRank)
+from spark_rapids_tpu.expressions.map_hof import (
+    MapFilter, MapZipWith, TransformKeys, TransformValues, ZipWith,
+    map_filter, map_zip_with, transform_keys, transform_values, zip_with)
+from spark_rapids_tpu.expressions.zorder import (
+    RangeBucketId, ZOrderKey)

@@ -68,6 +68,20 @@ class CpuBridgeExpression(Expression):
             live = ctx.live_mask()
             return DeviceColumn(col.data, col.validity & live, dt,
                                 col.offsets, col.child_validity)
+        if isinstance(dt, T.MapType):
+            py = [v if m else None for v, m in zip(vals[:n], valid[:n])]
+            py += [None] * (cap - n)
+            col = DeviceColumn.from_maps(py, dt, capacity=cap)
+            live = ctx.live_mask()
+            return DeviceColumn(col.data, col.validity & live, dt,
+                                col.offsets, children=col.children)
+        if isinstance(dt, T.StructType):
+            py = [v if m else None for v, m in zip(vals[:n], valid[:n])]
+            py += [None] * (cap - n)
+            col = DeviceColumn.from_structs(py, dt, capacity=cap)
+            live = ctx.live_mask()
+            return DeviceColumn(col.data, col.validity & live, dt,
+                                children=col.children)
         if dt.variable_width:
             py = [v if m else None for v, m in zip(vals[:n], valid[:n])]
             py += [None] * (cap - n)

@@ -660,6 +660,36 @@ class NamedLambdaVariable(Expression):
         return self.name
 
 
+def gathered_outer_cols(batch: ColumnarBatch, body, rows, live, total):
+    """Element-granularity view of `batch`: every fixed-width outer column
+    the lambda body references is gathered to element level (shared by
+    array, map, and zip higher-order functions; the planner gates bodies
+    referencing var-width or nested outer columns to the CPU bridge)."""
+    from spark_rapids_tpu.expressions.core import BoundReference
+
+    def _ordinals(e, out):
+        if isinstance(e, BoundReference):
+            out.add(e.ordinal)
+        for c in e.children:
+            _ordinals(c, out)
+        return out
+    refs = _ordinals(body, set())
+    ecap = rows.shape[0]
+    cols = []
+    for ordinal, c in enumerate(batch.columns):
+        if ordinal in refs and c.offsets is None:
+            data = jnp.where(live, c.data[rows],
+                             jnp.zeros((), c.data.dtype))
+            valid = jnp.where(live, c.validity[rows], False)
+            cols.append(DeviceColumn(data, valid, c.dtype))
+        else:
+            # unreferenced (or unsupported var-width): placeholder
+            cols.append(DeviceColumn.empty(
+                T.INT if c.offsets is not None else c.dtype, ecap))
+    return ColumnarBatch(tuple(cols), total.astype(jnp.int32),
+                         batch.schema)
+
+
 class _HigherOrder(BinaryExpression):
     """Base: (array, lambda-body) where the body references NamedLambdaVariable
     instances stored on the node.  Construct via the .make() classmethods that
@@ -725,30 +755,9 @@ class _HigherOrder(BinaryExpression):
         to the element buffer / position."""
         rows = CK.element_row_ids(arr)
         live = CK.element_live_mask(arr, ctx.batch.num_rows)
-        from spark_rapids_tpu.expressions.core import BoundReference
-
-        def _ordinals(e, out):
-            if isinstance(e, BoundReference):
-                out.add(e.ordinal)
-            for c in e.children:
-                _ordinals(c, out)
-            return out
-        refs = _ordinals(self.right, set())
-        cols = []
-        for ordinal, c in enumerate(ctx.batch.columns):
-            if ordinal in refs and c.offsets is None:
-                data = jnp.where(live, c.data[rows],
-                                 jnp.zeros((), c.data.dtype))
-                valid = jnp.where(live, c.validity[rows], False)
-                cols.append(DeviceColumn(data, valid, c.dtype))
-            else:
-                # unreferenced (or unsupported var-width): placeholder
-                cols.append(DeviceColumn.empty(
-                    T.INT if c.offsets is not None else c.dtype,
-                    arr.byte_capacity))
         total = arr.offsets[ctx.batch.num_rows]
-        ebatch = ColumnarBatch(tuple(cols), total.astype(jnp.int32),
-                               ctx.batch.schema)
+        ebatch = gathered_outer_cols(ctx.batch, self.right, rows, live,
+                                     total)
         ectx = EvalContext(ebatch, string_bucket=ctx.string_bucket,
                            trace_consts=ctx.trace_consts)
         elem_col = DeviceColumn(arr.data, arr.child_validity & live,

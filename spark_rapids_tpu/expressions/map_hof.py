@@ -1,0 +1,581 @@
+"""Map + two-array higher-order functions.
+
+Reference: sql-plugin/.../higherOrderFunctions.scala — GpuTransformKeys,
+GpuTransformValues, GpuMapFilter, GpuMapZipWith (via
+com.nvidia.spark.rapids.jni.GpuMapZipWithUtils), and GpuZipWith for
+arrays.  The TPU build reuses the segmented element-context machinery
+from collections.py: lambda variables bind to the key/value entry planes
+(maps share the array layout — offsets + children planes), bodies
+evaluate once over the flat entry buffer, and results keep or rebuild
+the segment offsets.
+
+Divergences (documented): TransformKeys does not raise on duplicate or
+null result keys (Spark's dedup/null policy needs a data-dependent raise
+that XLA cannot express mid-kernel); both engines here keep entries
+as-is, so differential tests stay aligned.  MapZipWith evaluates
+host-side (CPU bridge) like ArrayAggregate — its key-union alignment is
+inherently row-ragged.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expressions.core import (
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+)
+from spark_rapids_tpu.expressions.collections import (
+    NamedLambdaVariable,
+    _obj,
+    gathered_outer_cols as _gathered_outer_cols,
+)
+from spark_rapids_tpu.kernels import collections as CK
+
+
+def _substitute(body: Expression, old: NamedLambdaVariable,
+                new: NamedLambdaVariable) -> Expression:
+    if isinstance(body, NamedLambdaVariable) and body.var_id == old.var_id:
+        return new
+    ch = tuple(_substitute(c, old, new) for c in body.children)
+    if all(n is o for n, o in zip(ch, body.children)):
+        return body
+    return body.with_children(ch)
+
+
+class _MapHigherOrder(Expression):
+    """Base: (map, body) where body references key/value lambda vars."""
+
+    def __init__(self, m: Expression, body: Expression,
+                 key_var: NamedLambdaVariable,
+                 val_var: NamedLambdaVariable):
+        self.children = (m, body)
+        self.key_var = key_var
+        self.val_var = val_var
+
+    @property
+    def map_child(self):
+        return self.children[0]
+
+    @property
+    def body(self):
+        return self.children[1]
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1], self.key_var,
+                          self.val_var)
+
+    @classmethod
+    def make(cls, m: Expression, fn: Callable):
+        """fn(key_var, value_var) -> body expression."""
+        mt = None
+        try:
+            mt = m.dtype
+        except Exception:
+            pass
+        kt = mt.key_type if isinstance(mt, T.MapType) else T.NULL
+        vt = mt.value_type if isinstance(mt, T.MapType) else T.NULL
+        k = NamedLambdaVariable("k", kt, nullable_=False)
+        v = NamedLambdaVariable("v", vt)
+        return cls(m, fn(k, v), k, v)
+
+    def bind(self, schema):
+        m = self.map_child.bind(schema)
+        mt = m.dtype
+        assert isinstance(mt, T.MapType), mt
+        body = self.body
+        k, v = self.key_var, self.val_var
+        if k.dtype != mt.key_type:
+            fresh = NamedLambdaVariable(k.name, mt.key_type, k._nullable)
+            body = _substitute(body, k, fresh)
+            k = fresh
+        if v.dtype != mt.value_type:
+            fresh = NamedLambdaVariable(v.name, mt.value_type, v._nullable)
+            body = _substitute(body, v, fresh)
+            v = fresh
+        return type(self)(m, body.bind(schema), k, v)
+
+    # -- device -------------------------------------------------------------
+
+    def _entry_ctx(self, ctx: EvalContext, mcol: DeviceColumn):
+        rows = CK.element_row_ids(mcol)
+        live = CK.element_live_mask(mcol, ctx.batch.num_rows)
+        total = mcol.offsets[ctx.batch.num_rows]
+        ebatch = _gathered_outer_cols(ctx.batch, self.body, rows, live,
+                                      total)
+        ectx = EvalContext(ebatch, string_bucket=ctx.string_bucket,
+                           trace_consts=ctx.trace_consts)
+        kchild, vchild = mcol.children
+        ectx.lambda_bindings = {
+            self.key_var.var_id: DeviceColumn(
+                kchild.data, kchild.validity & live, kchild.dtype),
+            self.val_var.var_id: DeviceColumn(
+                vchild.data, vchild.validity & live, vchild.dtype),
+        }
+        return ectx, live
+
+    # -- host oracle --------------------------------------------------------
+
+    def _cpu_entries(self, ctx: CpuEvalContext):
+        """Flatten live map entries: ([(k, v, row)], per-row slices)."""
+        mv, mm = self.map_child.eval_cpu(ctx)
+        entries, slices = [], []
+        for i in range(len(mv)):
+            if not mm[i] or mv[i] is None:
+                slices.append(None)
+                continue
+            items = (list(mv[i].items()) if isinstance(mv[i], dict)
+                     else list(mv[i]))
+            start = len(entries)
+            for kk, vv in items:
+                entries.append((kk, vv, i))
+            slices.append((start, len(entries)))
+        return mm, entries, slices
+
+    def _cpu_eval_body(self, ctx: CpuEvalContext, entries):
+        n = len(entries)
+        rowids = np.array([r for _, _, r in entries], dtype=np.int64)
+        cols = [(v[rowids] if n else v[:0], m[rowids] if n else m[:0])
+                for (v, m) in ctx.cols]
+        ectx = CpuEvalContext(cols, n, ctx.schema)
+
+        def plane(vals, dt, force_valid=False):
+            valid = np.array([x is not None for x in vals], np.bool_)
+            if dt.variable_width or isinstance(dt, (T.ArrayType,
+                                                    T.MapType,
+                                                    T.StructType)):
+                data = _obj(list(vals))
+            else:
+                data = np.array([0 if x is None else x for x in vals],
+                                dtype=dt.np_dtype)
+            return data, (np.ones(n, np.bool_) if force_valid else valid)
+        ectx.lambda_bindings = {
+            self.key_var.var_id: plane([e[0] for e in entries],
+                                       self.key_var.dtype,
+                                       force_valid=True),
+            self.val_var.var_id: plane([e[1] for e in entries],
+                                       self.val_var.dtype),
+        }
+        return self.body.eval_cpu(ectx)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self.map_child!r}, "
+                f"({self.key_var!r}, {self.val_var!r}) -> {self.body!r})")
+
+
+class TransformValues(_MapHigherOrder):
+    """transform_values(map, (k, v) -> expr) (GpuTransformValues)."""
+
+    @property
+    def dtype(self):
+        mt = self.map_child.dtype
+        return T.MapType(mt.key_type, self.body.dtype)
+
+    @property
+    def nullable(self):
+        return self.map_child.nullable
+
+    def eval(self, ctx: EvalContext):
+        mcol = self.map_child.eval(ctx)
+        ectx, live = self._entry_ctx(ctx, mcol)
+        res = self.body.eval(ectx)
+        cvalid = res.validity & live
+        data = jnp.where(cvalid, res.data, jnp.zeros((), res.data.dtype))
+        kchild = mcol.children[0]
+        return DeviceColumn(
+            mcol.data, mcol.validity, self.dtype, mcol.offsets,
+            children=(kchild, DeviceColumn(data, cvalid, self.body.dtype)))
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        mm, entries, slices = self._cpu_entries(ctx)
+        bv, bm = self._cpu_eval_body(ctx, entries)
+        out = np.empty((len(slices),), dtype=object)
+        for i, sl in enumerate(slices):
+            if sl is None:
+                out[i] = None
+                continue
+            s, e = sl
+            out[i] = dict(
+                (entries[j][0],
+                 (bv[j].item() if bv.dtype != object else bv[j])
+                 if bm[j] else None)
+                for j in range(s, e))
+        return out, mm.copy()
+
+
+class TransformKeys(_MapHigherOrder):
+    """transform_keys(map, (k, v) -> expr) (GpuTransformKeys)."""
+
+    @property
+    def dtype(self):
+        mt = self.map_child.dtype
+        return T.MapType(self.body.dtype, mt.value_type)
+
+    @property
+    def nullable(self):
+        return self.map_child.nullable
+
+    def eval(self, ctx: EvalContext):
+        mcol = self.map_child.eval(ctx)
+        ectx, live = self._entry_ctx(ctx, mcol)
+        res = self.body.eval(ectx)
+        cvalid = res.validity & live
+        data = jnp.where(cvalid, res.data, jnp.zeros((), res.data.dtype))
+        vchild = mcol.children[1]
+        return DeviceColumn(
+            mcol.data, mcol.validity, self.dtype, mcol.offsets,
+            children=(DeviceColumn(data, cvalid, self.body.dtype), vchild))
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        mm, entries, slices = self._cpu_entries(ctx)
+        bv, bm = self._cpu_eval_body(ctx, entries)
+        out = np.empty((len(slices),), dtype=object)
+        for i, sl in enumerate(slices):
+            if sl is None:
+                out[i] = None
+                continue
+            s, e = sl
+            out[i] = dict(
+                ((bv[j].item() if bv.dtype != object else bv[j])
+                 if bm[j] else None,
+                 entries[j][1])
+                for j in range(s, e))
+        return out, mm.copy()
+
+
+class MapFilter(_MapHigherOrder):
+    """map_filter(map, (k, v) -> pred) (GpuMapFilter)."""
+
+    @property
+    def dtype(self):
+        return self.map_child.dtype
+
+    @property
+    def nullable(self):
+        return self.map_child.nullable
+
+    def eval(self, ctx: EvalContext):
+        mcol = self.map_child.eval(ctx)
+        ectx, _live = self._entry_ctx(ctx, mcol)
+        pred = self.body.eval(ectx)
+        keep = pred.data & pred.validity
+        return CK.segment_filter_map(mcol, keep, ctx.batch.num_rows)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        mm, entries, slices = self._cpu_entries(ctx)
+        bv, bm = self._cpu_eval_body(ctx, entries)
+        out = np.empty((len(slices),), dtype=object)
+        for i, sl in enumerate(slices):
+            if sl is None:
+                out[i] = None
+                continue
+            s, e = sl
+            out[i] = dict((entries[j][0], entries[j][1])
+                          for j in range(s, e) if bm[j] and bool(bv[j]))
+        return out, mm.copy()
+
+
+class MapZipWith(Expression):
+    """map_zip_with(m1, m2, (k, v1, v2) -> expr) (GpuMapZipWith).
+
+    Key-union alignment per row: keys from both maps in m1-then-new-m2
+    order (matching Spark), missing values null.  Host-evaluated (CPU
+    bridge on device plans — the union geometry is row-ragged)."""
+
+    def __init__(self, m1: Expression, m2: Expression, body: Expression,
+                 key_var: NamedLambdaVariable,
+                 v1_var: NamedLambdaVariable,
+                 v2_var: NamedLambdaVariable):
+        self.children = (m1, m2, body)
+        self.key_var = key_var
+        self.v1_var = v1_var
+        self.v2_var = v2_var
+
+    def with_children(self, children):
+        return MapZipWith(children[0], children[1], children[2],
+                          self.key_var, self.v1_var, self.v2_var)
+
+    @classmethod
+    def make(cls, m1: Expression, m2: Expression, fn: Callable):
+        def dt_of(e, attr):
+            try:
+                t = e.dtype
+                return getattr(t, attr)
+            except Exception:
+                return T.NULL
+        k = NamedLambdaVariable("k", dt_of(m1, "key_type"),
+                                nullable_=False)
+        v1 = NamedLambdaVariable("v1", dt_of(m1, "value_type"))
+        v2 = NamedLambdaVariable("v2", dt_of(m2, "value_type"))
+        return cls(m1, m2, fn(k, v1, v2), k, v1, v2)
+
+    @property
+    def dtype(self):
+        mt = self.children[0].dtype
+        return T.MapType(mt.key_type, self.children[2].dtype)
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable or self.children[1].nullable
+
+    def bind(self, schema):
+        m1 = self.children[0].bind(schema)
+        m2 = self.children[1].bind(schema)
+        body = self.children[2]
+        k, v1, v2 = self.key_var, self.v1_var, self.v2_var
+        if k.dtype != m1.dtype.key_type:
+            fresh = NamedLambdaVariable(k.name, m1.dtype.key_type, False)
+            body = _substitute(body, k, fresh)
+            k = fresh
+        if v1.dtype != m1.dtype.value_type:
+            fresh = NamedLambdaVariable(v1.name, m1.dtype.value_type, True)
+            body = _substitute(body, v1, fresh)
+            v1 = fresh
+        if v2.dtype != m2.dtype.value_type:
+            fresh = NamedLambdaVariable(v2.name, m2.dtype.value_type, True)
+            body = _substitute(body, v2, fresh)
+            v2 = fresh
+        return MapZipWith(m1, m2, body.bind(schema), k, v1, v2)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        m1v, m1m = self.children[0].eval_cpu(ctx)
+        m2v, m2m = self.children[1].eval_cpu(ctx)
+        n = len(m1v)
+        # per-row key union in m1-then-new-m2 order
+        entries = []          # (key, v1, v2, row)
+        slices = []
+        valid = np.zeros((n,), np.bool_)
+        for i in range(n):
+            if not m1m[i] or not m2m[i] or m1v[i] is None or m2v[i] is None:
+                slices.append(None)
+                continue
+            valid[i] = True
+            d1 = dict(m1v[i].items() if isinstance(m1v[i], dict)
+                      else m1v[i])
+            d2 = dict(m2v[i].items() if isinstance(m2v[i], dict)
+                      else m2v[i])
+            keys = list(d1.keys()) + [kk for kk in d2.keys()
+                                      if kk not in d1]
+            start = len(entries)
+            for kk in keys:
+                entries.append((kk, d1.get(kk), d2.get(kk), i))
+            slices.append((start, len(entries)))
+        ne = len(entries)
+        rowids = np.array([e[3] for e in entries], dtype=np.int64)
+        cols = [(v[rowids] if ne else v[:0], m[rowids] if ne else m[:0])
+                for (v, m) in ctx.cols]
+        ectx = CpuEvalContext(cols, ne, ctx.schema)
+
+        def plane(vals, dt, force_valid=False):
+            vv = np.array([x is not None for x in vals], np.bool_)
+            if dt.variable_width or isinstance(dt, (T.ArrayType, T.MapType,
+                                                    T.StructType)):
+                data = _obj(list(vals))
+            else:
+                data = np.array([0 if x is None else x for x in vals],
+                                dtype=dt.np_dtype)
+            return data, (np.ones(ne, np.bool_) if force_valid else vv)
+        ectx.lambda_bindings = {
+            self.key_var.var_id: plane([e[0] for e in entries],
+                                       self.key_var.dtype,
+                                       force_valid=True),
+            self.v1_var.var_id: plane([e[1] for e in entries],
+                                      self.v1_var.dtype),
+            self.v2_var.var_id: plane([e[2] for e in entries],
+                                      self.v2_var.dtype),
+        }
+        bv, bm = self.children[2].eval_cpu(ectx)
+        out = np.empty((n,), dtype=object)
+        for i, sl in enumerate(slices):
+            if sl is None:
+                out[i] = None
+                continue
+            s, e = sl
+            out[i] = dict(
+                (entries[j][0],
+                 (bv[j].item() if bv.dtype != object else bv[j])
+                 if bm[j] else None)
+                for j in range(s, e))
+        return out, valid
+
+    def __repr__(self):
+        return (f"MapZipWith({self.children[0]!r}, {self.children[1]!r}, "
+                f"({self.key_var!r}, {self.v1_var!r}, {self.v2_var!r}) -> "
+                f"{self.children[2]!r})")
+
+
+class ZipWith(Expression):
+    """zip_with(a1, a2, (x, y) -> expr) (GpuZipWith): positional zip of
+    two arrays; result length is the LONGER of the two, the shorter
+    side's missing elements are null."""
+
+    def __init__(self, a1: Expression, a2: Expression, body: Expression,
+                 x_var: NamedLambdaVariable, y_var: NamedLambdaVariable):
+        self.children = (a1, a2, body)
+        self.x_var = x_var
+        self.y_var = y_var
+
+    def with_children(self, children):
+        return ZipWith(children[0], children[1], children[2],
+                       self.x_var, self.y_var)
+
+    @classmethod
+    def make(cls, a1: Expression, a2: Expression, fn: Callable):
+        def et(e):
+            try:
+                return e.dtype.element_type
+            except Exception:
+                return T.NULL
+        x = NamedLambdaVariable("x", et(a1))
+        y = NamedLambdaVariable("y", et(a2))
+        return cls(a1, a2, fn(x, y), x, y)
+
+    @property
+    def dtype(self):
+        return T.ArrayType(self.children[2].dtype)
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable or self.children[1].nullable
+
+    def bind(self, schema):
+        a1 = self.children[0].bind(schema)
+        a2 = self.children[1].bind(schema)
+        body = self.children[2]
+        x, y = self.x_var, self.y_var
+        if x.dtype != a1.dtype.element_type:
+            fresh = NamedLambdaVariable(x.name, a1.dtype.element_type, True)
+            body = _substitute(body, x, fresh)
+            x = fresh
+        if y.dtype != a2.dtype.element_type:
+            fresh = NamedLambdaVariable(y.name, a2.dtype.element_type, True)
+            body = _substitute(body, y, fresh)
+            y = fresh
+        return ZipWith(a1, a2, body.bind(schema), x, y)
+
+    def eval(self, ctx: EvalContext):
+        from spark_rapids_tpu.columnar.column import round_up_pow2
+        a1 = self.children[0].eval(ctx)
+        a2 = self.children[1].eval(ctx)
+        n = ctx.batch.num_rows
+        cap = a1.capacity
+        l1 = a1.offsets[1:] - a1.offsets[:-1]
+        l2 = a2.offsets[1:] - a2.offsets[:-1]
+        lens = jnp.maximum(l1, l2)
+        offsets = jnp.zeros((cap + 1,), jnp.int32).at[1:].set(
+            jnp.cumsum(lens))
+        ecap = round_up_pow2(max(a1.byte_capacity + a2.byte_capacity, 1))
+        pos = jnp.arange(ecap, dtype=jnp.int32)
+        rows = jnp.clip(
+            jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32)
+            - 1, 0, cap - 1)
+        live = pos < offsets[n]
+        within = pos - offsets[rows]
+
+        def side(a, l):
+            present = live & (within < l[rows])
+            idx = jnp.where(present, a.offsets[rows] + within, 0)
+            idx = jnp.clip(idx, 0, a.byte_capacity - 1)
+            valid = jnp.where(present, a.child_validity[idx], False)
+            data = jnp.where(valid, a.data[idx],
+                             jnp.zeros((), a.data.dtype))
+            return DeviceColumn(data, valid, a.dtype.element_type)
+        ebatch = _gathered_outer_cols(ctx.batch, self.children[2], rows,
+                                      live, offsets[n])
+        ectx = EvalContext(ebatch, string_bucket=ctx.string_bucket,
+                           trace_consts=ctx.trace_consts)
+        ectx.lambda_bindings = {self.x_var.var_id: side(a1, l1),
+                                self.y_var.var_id: side(a2, l2)}
+        res = self.children[2].eval(ectx)
+        cvalid = res.validity & live
+        data = jnp.where(cvalid, res.data, jnp.zeros((), res.data.dtype))
+        validity = a1.validity & a2.validity
+        return DeviceColumn(data, validity, self.dtype, offsets, cvalid)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        a1v, a1m = self.children[0].eval_cpu(ctx)
+        a2v, a2m = self.children[1].eval_cpu(ctx)
+        n = len(a1v)
+        elems = []      # (x, y, row)
+        slices = []
+        valid = np.zeros((n,), np.bool_)
+        for i in range(n):
+            if not a1m[i] or not a2m[i] or a1v[i] is None or a2v[i] is None:
+                slices.append(None)
+                continue
+            valid[i] = True
+            ln = max(len(a1v[i]), len(a2v[i]))
+            start = len(elems)
+            for j in range(ln):
+                elems.append((a1v[i][j] if j < len(a1v[i]) else None,
+                              a2v[i][j] if j < len(a2v[i]) else None, i))
+            slices.append((start, len(elems)))
+        ne = len(elems)
+        rowids = np.array([e[2] for e in elems], dtype=np.int64)
+        cols = [(v[rowids] if ne else v[:0], m[rowids] if ne else m[:0])
+                for (v, m) in ctx.cols]
+        ectx = CpuEvalContext(cols, ne, ctx.schema)
+
+        def plane(vals, dt):
+            vv = np.array([x is not None for x in vals], np.bool_)
+            if dt.variable_width or isinstance(dt, (T.ArrayType, T.MapType,
+                                                    T.StructType)):
+                data = _obj(list(vals))
+            else:
+                data = np.array([0 if x is None else x for x in vals],
+                                dtype=dt.np_dtype)
+            return data, vv
+        ectx.lambda_bindings = {
+            self.x_var.var_id: plane([e[0] for e in elems],
+                                     self.x_var.dtype),
+            self.y_var.var_id: plane([e[1] for e in elems],
+                                     self.y_var.dtype),
+        }
+        bv, bm = self.children[2].eval_cpu(ectx)
+        out = np.empty((n,), dtype=object)
+        for i, sl in enumerate(slices):
+            if sl is None:
+                out[i] = None
+                continue
+            s, e = sl
+            out[i] = [(bv[j].item() if bv.dtype != object else bv[j])
+                      if bm[j] else None for j in range(s, e)]
+        return out, valid
+
+    def __repr__(self):
+        return (f"ZipWith({self.children[0]!r}, {self.children[1]!r}, "
+                f"({self.x_var!r}, {self.y_var!r}) -> "
+                f"{self.children[2]!r})")
+
+
+# -- DSL helpers --------------------------------------------------------------
+
+def _col(e):
+    from spark_rapids_tpu.expressions.core import Col
+    return Col(e) if isinstance(e, str) else e
+
+
+def transform_values(m, fn) -> TransformValues:
+    return TransformValues.make(_col(m), fn)
+
+
+def transform_keys(m, fn) -> TransformKeys:
+    return TransformKeys.make(_col(m), fn)
+
+
+def map_filter(m, fn) -> MapFilter:
+    return MapFilter.make(_col(m), fn)
+
+
+def map_zip_with(m1, m2, fn) -> MapZipWith:
+    return MapZipWith.make(_col(m1), _col(m2), fn)
+
+
+def zip_with(a1, a2, fn) -> ZipWith:
+    return ZipWith.make(_col(a1), _col(a2), fn)

@@ -6,11 +6,11 @@ range-partition ids) and zorder/GpuPartitionerExpr.scala; used by Delta
 OPTIMIZE ZORDER BY (delta-lake/.../GpuOptimizeExecutor via ZOrderRules).
 
 TPU-first divergence: the reference emits a BINARY of 4*N interleaved
-bytes and range-partitions by it; we emit one LONG sort key (bits
-interleaved MSB-first, round-robin across columns, truncated to 64 bits)
-which XLA sorts natively — lossless while each column's bucket count
-stays under 2**(64//N), which the default 1024-bucket partitioner always
-satisfies.  Inputs are signed-flipped so negative values order correctly.
+bytes and range-partitions by it; we emit one LONG sort key (the low
+``source_bits`` of each column interleaved window-MSB-first, truncated
+to 64 bits) which XLA sorts natively — lossless while
+N * source_bits <= 64; OPTIMIZE passes source_bits = ceil(log2(buckets))
+so the default 1024-bucket partitioner is lossless up to 6 columns.
 """
 from __future__ import annotations
 
@@ -67,28 +67,43 @@ class RangeBucketId(Expression):
         return f"RangeBucketId({self.child!r}, {self.bounds.tolist()!r})"
 
 
-def _interleave_u32_np(cols, xp):
-    """Interleave 32-bit unsigned words MSB-first into a uint64 key."""
+def _interleave_np(cols, source_bits, xp):
+    """Interleave the low `source_bits` bits of each word (MSB of that
+    window first, round-robin across columns) into a uint64 key."""
     n = len(cols)
-    bits_per_col = min(32, 64 // n)
+    bits_per_col = min(source_bits, 64 // n)
     out = xp.zeros(cols[0].shape, xp.uint64)
     for b in range(bits_per_col):
         for k, u in enumerate(cols):
-            bit = ((u >> xp.uint32(31 - b)) & xp.uint32(1)).astype(xp.uint64)
+            src = source_bits - 1 - b
+            bit = ((u >> xp.uint32(src)) & xp.uint32(1)).astype(xp.uint64)
             out = out | (bit << xp.uint64(63 - (b * n + k)))
     return out
 
 
 class ZOrderKey(Expression):
-    """LONG Morton key over N integer columns (nulls treated as 0)."""
+    """LONG Morton key over N integer columns (nulls treated as 0).
 
-    def __init__(self, children):
+    ``source_bits`` declares how many low-order bits of each input carry
+    the ordering information; the key interleaves exactly those bits,
+    window-MSB first.  OPTIMIZE passes ceil(log2(buckets)) so bucket ids
+    (which live in the LOW bits) survive the 64-bit truncation for any
+    column count — with the default 32, three or more columns would
+    discard the id bits entirely.  At source_bits=32 inputs are
+    signed-flipped so negative values order correctly; below 32 inputs
+    must be non-negative and are clamped into the window.
+    """
+
+    def __init__(self, children, source_bits: int = 32):
         self.children = tuple(children)
         if not self.children:
             raise ValueError("zorder_key needs at least one column")
+        if not 1 <= source_bits <= 32:
+            raise ValueError(f"source_bits {source_bits} out of [1,32]")
+        self.source_bits = source_bits
 
     def with_children(self, children):
-        return ZOrderKey(children)
+        return ZOrderKey(children, self.source_bits)
 
     @property
     def dtype(self):
@@ -98,29 +113,32 @@ class ZOrderKey(Expression):
     def nullable(self):
         return False
 
-    def _flip(self, data, validity, xp):
+    def _word(self, data, validity, xp):
         x = data.astype(xp.int64)
         x = xp.where(validity, x, 0)
-        # signed flip -> unsigned order, clamped into 32-bit range
-        x = xp.clip(x, -(2 ** 31), 2 ** 31 - 1)
-        return (x + 2 ** 31).astype(xp.uint32)
+        if self.source_bits == 32:
+            # signed flip -> unsigned order, clamped into 32-bit range
+            x = xp.clip(x, -(2 ** 31), 2 ** 31 - 1)
+            return (x + 2 ** 31).astype(xp.uint32)
+        x = xp.clip(x, 0, 2 ** self.source_bits - 1)
+        return x.astype(xp.uint32)
 
     def eval(self, ctx: EvalContext):
         cols = [self.children[i].eval(ctx) for i in range(len(self.children))]
-        words = [self._flip(c.data, c.validity, jnp) for c in cols]
-        key = _interleave_u32_np(words, jnp).astype(jnp.int64)
-        # restore signed order: MSB of the key is the first column's
-        # flipped sign bit, so shift back into signed-long space
+        words = [self._word(c.data, c.validity, jnp) for c in cols]
+        key = _interleave_np(words, self.source_bits, jnp).astype(jnp.int64)
+        # shift back into signed-long space so the key column sorts the
+        # same as the unsigned interleaving
         key = key ^ jnp.int64(-2 ** 63)
         return make_column(key, ctx.live_mask(), T.LONG)
 
     def eval_cpu(self, ctx: CpuEvalContext):
         pairs = [c.eval_cpu(ctx) for c in self.children]
-        words = [self._flip(v, valid, np) for v, valid in pairs]
-        key = _interleave_u32_np(words, np).astype(np.int64)
+        words = [self._word(v, valid, np) for v, valid in pairs]
+        key = _interleave_np(words, self.source_bits, np).astype(np.int64)
         key = key ^ np.int64(-2 ** 63)
         return key, np.ones(len(key), np.bool_)
 
     def __repr__(self):
         inner = ", ".join(repr(c) for c in self.children)
-        return f"ZOrderKey({inner})"
+        return f"ZOrderKey({inner}, bits={self.source_bits})"

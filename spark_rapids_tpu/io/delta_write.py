@@ -336,9 +336,30 @@ def optimize(session, table_path: str, zorder_by: Sequence[str] = (),
     snap = load_snapshot(table_path)
     df = session.read_delta(table_path)
     if zorder_by:
-        # one scan collects every z-order column's split points (the
-        # partitioner-expr analog samples; we read the data once)
-        sampled = df.select(*[col(c) for c in zorder_by]).collect()
+        for c in zorder_by:
+            dt = snap.schema.dtype_of(c)
+            if not (dt.is_integral or isinstance(
+                    dt, (T.FloatType, T.DoubleType, T.DateType,
+                         T.TimestampType))):
+                raise NotImplementedError(
+                    f"ZORDER BY over {dt!r} column {c!r} not supported "
+                    "(numeric/date/timestamp only; the reference range-"
+                    "partitions strings too)")
+        # one SAMPLED scan collects every z-order column's split points
+        # (the partitioner-expr analog: bounds need only be approximate).
+        # Row estimate from parquet footers — no data scan.
+        import pyarrow.parquet as pq
+        sample_df = df.select(*[col(c) for c in zorder_by])
+        stats_rows = 0
+        for abs_path, _pv, _dv in snap.files:
+            try:
+                stats_rows += pq.ParquetFile(abs_path).metadata.num_rows
+            except Exception:
+                pass
+        if stats_rows and stats_rows > 64 * buckets:
+            sample_df = sample_df.sample(
+                min(1.0, (64.0 * buckets) / stats_rows), seed=7)
+        sampled = sample_df.collect()
         keys = []
         for ci, c in enumerate(zorder_by):
             vals = np.sort(np.asarray(
@@ -349,7 +370,10 @@ def optimize(session, table_path: str, zorder_by: Sequence[str] = (),
             else:
                 bounds = vals[:0]
             keys.append(RangeBucketId(col(c), bounds))
-        df = df.order_by(ZOrderKey(keys))
+        import math
+        source_bits = max(1, math.ceil(math.log2(
+            max(2, max(len(k.bounds) + 1 for k in keys)))))
+        df = df.order_by(ZOrderKey(keys, source_bits=source_bits))
     files = _write_data_files(df, table_path, snap.partition_columns)
     now = int(time.time() * 1000)
     actions: List[dict] = []

@@ -540,7 +540,10 @@ class DeleteFilter:
     """
 
     def __init__(self, schema: Schema, id_to_name: Dict[int, str],
-                 delete_files: List[dict]):
+                 delete_files: List[dict], positions_only: bool = False):
+        """``positions_only`` skips loading equality-delete parquet files
+        entirely (used by DELETE's rerun-no-op check, which only needs
+        already-covered position ordinals)."""
         import numpy as np
         import pyarrow.parquet as pq
         self.schema = schema
@@ -551,6 +554,8 @@ class DeleteFilter:
         for df in delete_files:
             seq = df.get("_seq") or 0
             content = df.get("content") or 0
+            if positions_only and content != 1:
+                continue
             table = pq.read_table(df["file_path"])
             if content == 1:
                 paths = np.asarray(table.column("file_path").to_pylist(),
@@ -570,6 +575,16 @@ class DeleteFilter:
     @property
     def has_deletes(self) -> bool:
         return bool(self._pos or self._eq)
+
+    def positions_for(self, data_file_path: str, data_seq: int):
+        """int64 ndarray of position-delete ordinals applicable to the
+        given data file (empty when none apply)."""
+        import numpy as np
+        covered = [pos for seq, pos in self._pos.get(data_file_path, ())
+                   if seq >= data_seq]
+        if not covered:
+            return np.empty(0, np.int64)
+        return np.unique(np.concatenate(covered))
 
     def eq_columns(self) -> List[str]:
         out: List[str] = []

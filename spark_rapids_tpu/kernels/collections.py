@@ -305,3 +305,36 @@ def segment_distinct(col: DeviceColumn, num_rows) -> DeviceColumn:
     # map back to element order: keep[perm[i]] = first_occurrence[i]
     keep = jnp.zeros((ecap,), jnp.bool_).at[perm].set(first_occurrence)
     return segment_filter(col, keep, num_rows)
+
+
+def segment_filter_map(mcol: DeviceColumn, keep: jax.Array,
+                       num_rows) -> DeviceColumn:
+    """map_filter compaction: keep entries where `keep` is True,
+    compacting BOTH the key and value planes with one emap (the map twin
+    of segment_filter; GpuMapFilter).  Fixed-width planes only — the
+    planner gates var-width maps to the CPU bridge."""
+    rows = element_row_ids(mcol)
+    live = element_live_mask(mcol, num_rows)
+    k = keep & live
+    counts = jax.ops.segment_sum(k.astype(jnp.int32), rows,
+                                 num_segments=mcol.capacity)
+    new_offsets = jnp.zeros((mcol.capacity + 1,), jnp.int32).at[1:].set(
+        jnp.cumsum(counts))
+    ecap = mcol.byte_capacity
+    ki = k.astype(jnp.int32)
+    dest = jnp.cumsum(ki) - ki
+    src = jnp.arange(ecap, dtype=jnp.int32)
+    emap = jnp.full((ecap,), OOB, dtype=jnp.int32)
+    emap = emap.at[jnp.where(k, dest, ecap)].set(src, mode="drop")
+    total = new_offsets[num_rows]
+    inb = (emap >= 0) & (emap < ecap) & \
+        (jnp.arange(ecap, dtype=jnp.int32) < total)
+    safe = jnp.where(inb, emap, 0)
+    new_children = []
+    for child in mcol.children:
+        cvalid = jnp.where(inb, child.validity[safe], False)
+        zero = jnp.zeros((), child.data.dtype)
+        data = jnp.where(cvalid, child.data[safe], zero)
+        new_children.append(DeviceColumn(data, cvalid, child.dtype))
+    return DeviceColumn(mcol.data, mcol.validity, mcol.dtype, new_offsets,
+                        children=tuple(new_children))

@@ -521,11 +521,13 @@ class TpuWindowExec(TpuExec):
                         lambda: step)(b))
             h.unpin()
             ng = int(ngroups)
-            if len(state) + ng > _TWO_PASS_MAX_KEYS:
-                # high-cardinality partitioning: bail BEFORE paying the
-                # O(groups) host loop below — key-batching splits such
-                # data fine on device.  The "tiny per-key states"
-                # assumption is CHECKED, not hoped.
+            if ng > _TWO_PASS_MAX_KEYS:
+                # a single batch already exceeds the key budget: bail
+                # BEFORE paying the O(groups) host loop below.  (ng alone,
+                # not len(state)+ng — groups repeat across batches, and
+                # double-counting them would spuriously evict workloads
+                # the two-pass path handles; the post-merge check below
+                # remains the authoritative cumulative bound.)
                 rebatched = [hh.release_device_copy() for hh in handles]
                 total = sum(bb.capacity for bb in rebatched)
                 yield from self._execute_out_of_core(rebatched, total)
@@ -545,6 +547,13 @@ class TpuWindowExec(TpuExec):
                     originals[key] = raw
                 state[key] = slots if cur is None else \
                     _merge_slots(cur, slots, specs)
+            if len(state) > _TWO_PASS_MAX_KEYS:
+                # cumulative distinct keys blew the budget: the host
+                # merge would dominate — reroute to key-batching.
+                rebatched = [hh.release_device_copy() for hh in handles]
+                total = sum(bb.capacity for bb in rebatched)
+                yield from self._execute_out_of_core(rebatched, total)
+                return
 
         # finalize per-key window values (keyed by the REPRESENTATIVE raw
         # key so NaN re-materializes as a float in the build table)

@@ -161,6 +161,12 @@ _SUPPORTED_EXPRS |= {
     MapValues,
 }
 
+from spark_rapids_tpu.expressions.map_hof import (
+    MapFilter, TransformKeys, TransformValues, ZipWith, _MapHigherOrder)
+
+# MapZipWith stays out: it evaluates through the CPU bridge
+_SUPPORTED_EXPRS |= {TransformValues, TransformKeys, MapFilter, ZipWith}
+
 from spark_rapids_tpu.expressions.hashing import (
     BloomFilterMightContain, Murmur3Hash, XxHash64)
 from spark_rapids_tpu.expressions.strings import GetJsonObject
@@ -465,16 +471,27 @@ class ExprMeta:
                             "(add explicit casts)")
                 except (TypeError, ValueError, NotImplementedError):
                     pass
-            if isinstance(e, _HigherOrder):
-                body = e.right
+            if isinstance(e, (_HigherOrder, _MapHigherOrder, ZipWith)):
+                body = e.right if isinstance(e, _HigherOrder) \
+                    else e.children[-1]
 
                 def _body_bad(x) -> Optional[str]:
-                    if isinstance(x, _HigherOrder):
+                    if isinstance(x, (_HigherOrder, _MapHigherOrder,
+                                      ZipWith)):
                         return "nested higher-order functions"
                     if isinstance(x, E.BoundReference):
-                        if x.dtype.variable_width:
+                        dt = x.dtype
+                        if dt.variable_width:
                             return (f"lambda body references variable-width "
                                     f"outer column {x!r}")
+                        # nested/two-limb columns carry children planes the
+                        # element-level gather does not thread through
+                        if isinstance(dt, (T.StructType, T.MapType,
+                                           T.ArrayType)) or (
+                                isinstance(dt, T.DecimalType)
+                                and dt.uses_two_limbs):
+                            return (f"lambda body references nested outer "
+                                    f"column {x!r}")
                     for c in x.children:
                         r = _body_bad(c)
                         if r:

@@ -271,6 +271,14 @@ def _build_registry() -> None:
     register(ST.MapKeys, ExprSig(ARR, MAP))
     register(ST.MapValues, ExprSig(ARR, MAP))
 
+    # map / two-array higher-order functions (MapZipWith is deliberately
+    # unregistered: key-union alignment evaluates via the CPU bridge)
+    from spark_rapids_tpu.expressions import map_hof as MH
+    register(MH.TransformValues, ExprSig(MAP, MAP, ELEMENTABLE + BOOL))
+    register(MH.TransformKeys, ExprSig(MAP, MAP, ELEMENTABLE + BOOL))
+    register(MH.MapFilter, ExprSig(MAP, MAP, BOOL))
+    register(MH.ZipWith, ExprSig(ARR, ARR, ARR, ELEMENTABLE + BOOL))
+
     # z-order (OPTIMIZE ZORDER BY sort keys)
     from spark_rapids_tpu.expressions import zorder as Z
     register(Z.RangeBucketId, ExprSig(TypeSig("int"), NUMERIC))

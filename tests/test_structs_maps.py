@@ -190,3 +190,89 @@ def test_struct_parquet_roundtrip(tmp_path):
 def test_struct_group_by_with_injected_oom():
     assert_tpu_cpu_equal(lambda s: df(s).group_by("s").agg(
         Alias(sum_(col("v")), "sv")))
+
+
+# ---------------------------------------------------------------------------
+# map / two-array higher-order functions
+
+
+def test_transform_values():
+    from spark_rapids_tpu.expressions import transform_values
+    assert_tpu_cpu_equal(
+        lambda s: df(s).select(
+            col("k"),
+            Alias(transform_values(col("m"), lambda k, v: v * lit(2) + k),
+                  "tv")))
+
+
+def test_transform_keys():
+    from spark_rapids_tpu.expressions import transform_keys
+    assert_tpu_cpu_equal(
+        lambda s: df(s).select(
+            Alias(transform_keys(col("m"), lambda k, v: k + lit(100)),
+                  "tk")))
+
+
+def test_map_filter():
+    from spark_rapids_tpu.expressions import map_filter
+    assert_tpu_cpu_equal(
+        lambda s: df(s).select(
+            Alias(map_filter(col("m"), lambda k, v: v % lit(2) == lit(0)),
+                  "mf")))
+
+
+def test_map_filter_with_outer_reference():
+    from spark_rapids_tpu.expressions import map_filter
+    assert_tpu_cpu_equal(
+        lambda s: df(s).select(
+            Alias(map_filter(col("m"),
+                             lambda k, v: v > col("v")), "mf")))
+
+
+def test_map_zip_with_bridge():
+    """map_zip_with runs host-side via the CPU bridge on device plans."""
+    from spark_rapids_tpu.expressions import map_zip_with, transform_values
+    assert_tpu_cpu_equal(
+        lambda s: df(s).select(
+            Alias(map_zip_with(
+                col("m"),
+                transform_values(col("m"), lambda k, v: v + lit(1)),
+                lambda k, v1, v2: v1 + v2), "mz")))
+
+
+ARRT = T.ArrayType(T.LONG)
+ZSCHEMA = Schema(("a1", "a2", "w"), (ARRT, ARRT, T.LONG))
+
+
+def _zip_df(s, n=200):
+    rng = np.random.RandomState(11)
+    a1, a2 = [], []
+    for i in range(n):
+        if i % 17 == 0:
+            a1.append(None)
+        else:
+            a1.append([int(x) for x in rng.randint(0, 50, i % 5)])
+        if i % 19 == 0:
+            a2.append(None)
+        else:
+            a2.append([int(x) for x in rng.randint(0, 50, i % 4)])
+    return s.create_dataframe(
+        {"a1": a1, "a2": a2, "w": list(range(n))}, ZSCHEMA,
+        num_partitions=2)
+
+
+def test_zip_with_uneven_lengths():
+    from spark_rapids_tpu.expressions import zip_with
+    assert_tpu_cpu_equal(
+        lambda s: _zip_df(s).select(
+            col("w"),
+            Alias(zip_with(col("a1"), col("a2"),
+                           lambda x, y: x + y), "z")))
+
+
+def test_zip_with_outer_reference():
+    from spark_rapids_tpu.expressions import zip_with
+    assert_tpu_cpu_equal(
+        lambda s: _zip_df(s).select(
+            Alias(zip_with(col("a1"), col("a2"),
+                           lambda x, y: x * lit(10) + col("w")), "z")))

@@ -106,6 +106,13 @@ class TpuSession:
         # semaphore/injection settings from this session's conf
         from spark_rapids_tpu.memory import initialize_memory
         initialize_memory(self.conf)
+        from spark_rapids_tpu.shuffle.transport import (
+            set_completeness_timeout)
+        set_completeness_timeout(self.conf.shuffle_completeness_timeout)
+        if self.conf.diag_dump_dir:
+            from spark_rapids_tpu.utils import crashdump
+            crashdump.install(self.conf.diag_dump_dir,
+                              context={"session": "standalone"})
         self.last_query_metrics = None
 
     def set_conf(self, key: str, value) -> None:
@@ -461,14 +468,35 @@ class DataFrame:
                 else np.zeros((num_bits,), np.bool_))
         return BK.PyBloomFilter(num_bits, k, np.array(host, copy=True))
 
-    def persist(self) -> "DataFrame":
+    def persist(self, serializer: str = "device") -> "DataFrame":
         """Materialize once and reuse (the InMemoryTableScan / cached
-        batch analog: reference GpuInMemoryTableScanExec.scala).  Batches
-        are collected per partition on the current engine and become an
-        InMemoryRelation source for subsequent queries."""
+        batch analog: reference GpuInMemoryTableScanExec.scala).
+
+        serializer='device' keeps live batches (fast rescan, full HBM
+        cost); serializer='parquet' stores each partition as compressed
+        in-memory parquet blobs (the ParquetCachedBatchSerializer analog,
+        reference parquet/ParquetCachedBatchSerializer.scala:266) —
+        ~10x smaller resident cache, decode on each rescan."""
         parts = self.collect_partitions()
-        return DataFrame(L.InMemoryRelation(
-            [list(p) for p in parts], self.schema), self.session)
+        if serializer == "device":
+            return DataFrame(L.InMemoryRelation(
+                [list(p) for p in parts], self.schema), self.session)
+        if serializer != "parquet":
+            raise ValueError(f"unknown cache serializer {serializer!r} "
+                             "(device/parquet)")
+        import io as _io
+
+        import pyarrow.parquet as pq
+        blobs = []
+        for p in parts:
+            bl = []
+            for b in p:
+                sink = _io.BytesIO()
+                pq.write_table(b.to_arrow(), sink, compression="zstd")
+                bl.append(sink.getvalue())
+            blobs.append(bl)
+        return DataFrame(L.CachedParquetRelation(blobs, self.schema),
+                         self.session)
 
     def group_by(self, *keys) -> GroupedData:
         return GroupedData(self, [_to_expr(k) for k in keys])

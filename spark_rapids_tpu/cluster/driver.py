@@ -10,9 +10,15 @@ plan with the SAME conf (the planner is deterministic), executes only its
 rank's share of leaf-scan partitions, exchanges cross-process over the
 TCP block plane, and returns the rows of its share of ROOT partitions.
 The driver forces conf that keeps per-executor planning decisions
-identical and data-complete: broadcast joins off (a local-only build side
-would be partial) and AQE partition coalescing off (group boundaries
-would be computed from local sizes).
+identical and data-complete: the RUNTIME adaptive join choice off (it
+reads local build-side row counts, so ranks could pick different
+physical shapes) and AQE partition coalescing off (group boundaries
+would be computed from local sizes).  STATIC broadcast joins are
+allowed: the estimate is deterministic across ranks, and every rank
+materializes the full build side — locally above the nearest exchange,
+via complete reduce reads below one (executor._wrap_build_side).
+Executor loss mid-query re-dispatches the whole query over survivors
+under a fresh query id (submit()).
 """
 from __future__ import annotations
 
@@ -26,25 +32,34 @@ from spark_rapids_tpu.shuffle.net import (
     ShuffleExecutor, _recv_msg, _send_msg)
 
 #: conf forced on every executor so distributed planning stays identical
-#: and data-complete (see module doc)
+#: and data-complete (see module doc).  Broadcast joins ARE allowed: the
+#: static estimate is deterministic across ranks (same plan, same footer
+#: stats) and every rank materializes the full build side locally; only
+#: the RUNTIME adaptive choice is forced off (it reads local row counts).
 _CLUSTER_CONF = {
     "spark.rapids.shuffle.mode": "MULTIPROCESS",
-    "spark.rapids.sql.join.broadcastRowThreshold": "0",
+    "spark.rapids.sql.join.adaptive.enabled": "false",
     "spark.rapids.sql.adaptive.coalescePartitions.enabled": "false",
 }
+
+
+class ExecutorLostError(RuntimeError):
+    """An executor owing results stopped heartbeating mid-query."""
 
 
 class TpuClusterDriver:
     """Driver process object: start, submit queries, close."""
 
     def __init__(self, conf: Optional[Dict[str, str]] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 heartbeat_timeout_s: float = 60.0):
         self.conf_map = dict(conf or {})
         self.conf_map.update(_CLUSTER_CONF)
         # the driver hosts the shuffle registry too: one address for
         # executors to register against (Plugin.scala:523-536 shape)
         self.shuffle = ShuffleExecutor("driver", serve_registry=True,
                                        role="driver", host=host)
+        self.shuffle.registry.timeout_s = heartbeat_timeout_s
         self._lock = threading.Lock()
         self._next_query = 0
         self._tasks: Dict[str, dict] = {}       # executor_id -> task
@@ -79,9 +94,13 @@ class TpuClusterDriver:
                 elif op == "task_result":
                     qid = header["query_id"]
                     with driver._lock:
-                        driver._results.setdefault(qid, {})[
-                            header["executor_id"]] = (
-                            header.get("error") or pickle.loads(payload))
+                        # ignore stragglers from aborted attempts: only
+                        # queries still awaited accept results
+                        if qid in driver._expected:
+                            driver._results.setdefault(qid, {})[
+                                header["executor_id"]] = (
+                                header.get("error")
+                                or pickle.loads(payload))
                     _send_msg(self.request, {"ok": True})
                 else:
                     _send_msg(self.request, {"error": f"bad op {op!r}"})
@@ -109,9 +128,30 @@ class TpuClusterDriver:
             f"only {len(self.shuffle.registry.peers(workers_only=True))} "
             f"of {n} executors registered")
 
-    def submit(self, logical_plan, timeout_s: float = 300.0) -> list:
+    def submit(self, logical_plan, timeout_s: float = 300.0,
+               max_retries: int = 1) -> list:
         """Dispatch one logical plan to every registered executor; block
-        for and combine their row results (rank order)."""
+        for and combine their row results (rank order).
+
+        Executor-loss recovery: if a rank stops heartbeating while it
+        still owes results, the attempt aborts and the WHOLE query
+        re-dispatches over the surviving executors under a fresh query id
+        (fresh deterministic shuffle ids, so the dead attempt's stale
+        blocks can never satisfy a retry read) — the cluster analog of
+        Spark recomputing lost-shuffle stages, at whole-query granularity.
+        """
+        last: Optional[ExecutorLostError] = None
+        for _attempt in range(max_retries + 1):
+            if last is not None and not \
+                    self.shuffle.registry.peers(workers_only=True):
+                raise last      # no survivors to retry on
+            try:
+                return self._submit_once(logical_plan, timeout_s)
+            except ExecutorLostError as e:
+                last = e
+        raise last
+
+    def _submit_once(self, logical_plan, timeout_s: float) -> list:
         executors = sorted(
             self.shuffle.registry.peers(workers_only=True))
         assert executors, "no executors registered"
@@ -127,15 +167,30 @@ class TpuClusterDriver:
                                     "participants": executors,
                                     "plan": plan_bytes}
         deadline = time.monotonic() + timeout_s
+        lost: List[str] = []
         while time.monotonic() < deadline:
             with self._lock:
                 got = self._results.get(qid, {})
                 if len(got) == world:
                     break
+            live = self.shuffle.registry.peers(workers_only=True)
+            lost = [eid for eid in executors
+                    if eid not in live and eid not in got]
+            if lost:
+                break
             time.sleep(0.05)
         with self._lock:
             got = self._results.pop(qid, {})
             self._expected.pop(qid, None)
+            # drop any task a lost executor never picked up
+            for eid in executors:
+                t = self._tasks.get(eid)
+                if t is not None and t["query_id"] == qid:
+                    self._tasks.pop(eid, None)
+        if lost:
+            raise ExecutorLostError(
+                f"query {qid}: executor(s) {lost} lost mid-query "
+                f"({len(got)}/{world} results)")
         if len(got) != world:
             raise TimeoutError(
                 f"query {qid}: {len(got)}/{world} executor results")

@@ -55,6 +55,26 @@ class _RankFilteredScan:
         return " " * indent + self.describe()
 
 
+def _wrap_build_side(node, rank: int, world: int):
+    """Below a broadcast BUILD side: leaf scans stay UNFILTERED (every
+    rank materializes the full build input locally — the cluster analog
+    of Spark shipping the broadcast to every executor), until an exchange
+    is crossed, below which normal rank splitting resumes: the exchange's
+    reduce reads reassemble complete data regardless of which rank asks,
+    so an exchange-fed build side is complete on every rank while its map
+    work still splits."""
+    from spark_rapids_tpu.plan.execs.exchange import TpuShuffleExchangeExec
+    kids = []
+    for c in node.children:
+        if isinstance(node, TpuShuffleExchangeExec):
+            _wrap_scans(c, rank, world)
+            kids.append(_RankFilteredScan(c, rank, world))
+        else:
+            _wrap_build_side(c, rank, world)
+            kids.append(c)
+    node.children = tuple(kids)
+
+
 def _wrap_scans(exec_node, rank: int, world: int):
     """Rank-split the plan in place: every EXCHANGE's map-side input and
     every leaf scan serves only partitions p with p % world == rank.
@@ -65,10 +85,18 @@ def _wrap_scans(exec_node, rank: int, world: int):
     exchange and the downstream join would see every build row once PER
     RANK (duplicates).  Exchange READS stay unfiltered — the TCP plane
     reassembles complete reduce partitions.  Double-wrapping a leaf that
-    already sits under an exchange child is harmless (same predicate)."""
+    already sits under an exchange child is harmless (same predicate).
+
+    BROADCAST build sides route through _wrap_build_side: full local
+    reads above the nearest exchange, normal splitting below it."""
     from spark_rapids_tpu.plan.execs.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.plan.execs.join import TpuBroadcastHashJoinExec
     kids = []
-    for c in exec_node.children:
+    for ci, c in enumerate(exec_node.children):
+        if isinstance(exec_node, TpuBroadcastHashJoinExec) and ci == 1:
+            _wrap_build_side(c, rank, world)
+            kids.append(c)
+            continue
         _wrap_scans(c, rank, world)
         if isinstance(exec_node, TpuShuffleExchangeExec):
             kids.append(_RankFilteredScan(c, rank, world))
@@ -86,6 +114,7 @@ def _check_distributable(physical) -> None:
     refuse loudly instead (the networked global-stage path is the
     follow-on)."""
     from spark_rapids_tpu.plan.execs.exchange import TpuSinglePartitionExec
+    from spark_rapids_tpu.plan.execs.join import TpuAdaptiveJoinExec
     from spark_rapids_tpu.plan.execs.range_sort import TpuRangeSortExec
 
     def walk(n):
@@ -94,6 +123,11 @@ def _check_distributable(physical) -> None:
                 f"cluster v1 cannot distribute {type(n).__name__} (global "
                 "single-partition / sampled stages): rewrite with a "
                 "grouped aggregation or collect-and-sort on the driver")
+        if isinstance(n, TpuAdaptiveJoinExec):
+            raise NotImplementedError(
+                "cluster planning must not produce adaptive joins (the "
+                "runtime choice diverges per rank); the driver forces "
+                "spark.rapids.sql.join.adaptive.enabled=false")
         for c in n.children:
             walk(c)
     walk(physical)
@@ -112,6 +146,8 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict) -> list:
     set_cluster_query(task["query_id"])
     conf = RapidsConf(dict(conf_map))
     initialize_memory(conf)
+    from spark_rapids_tpu.shuffle.transport import set_completeness_timeout
+    set_completeness_timeout(conf.shuffle_completeness_timeout)
     logical = pickle.loads(plan_bytes)
     physical, _meta = plan_query(logical, conf)
     if world > 1:
@@ -159,41 +195,73 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
     node = ShuffleExecutor(executor_id, driver_addr=shuffle_addr)
     set_process_shuffle_executor(node)
 
-    last_hb = 0.0
-    pending_cleanup = None
-    while not (stop_check and stop_check()):
-        header, payload = _request(
-            driver_rpc_addr, {"op": "get_task",
-                              "executor_id": node.executor_id})
-        task = header.get("task")
-        if task is None:
-            now = time.monotonic()
-            if now - last_hb > 5.0:
-                node.heartbeat()
-                last_hb = now
-            time.sleep(poll_s)
-            continue
-        # previous query fully collected by the driver (it handed us a
-        # new task) -> its shuffle blocks are safe to drop now
-        if pending_cleanup is not None:
+    # liveness beats independent of task execution (Spark executors
+    # heartbeat off the task thread): refresh ONLY the driver-side
+    # last-seen stamp — never the local peer view, which a mid-shuffle
+    # replacement could shrink under an in-flight fetch
+    import threading
+
+    from spark_rapids_tpu.shuffle.net import PeerClient
+    _beat_stop = threading.Event()
+
+    def _beat():
+        while not _beat_stop.is_set():
             try:
-                pending_cleanup.cleanup()
+                PeerClient(shuffle_addr).heartbeat(node.executor_id)
             except Exception:
                 pass
-            pending_cleanup = None
-        try:
-            # refresh the peer view FIRST: reduce-side fetches enumerate
-            # peers, and a task can arrive before the first idle-loop
-            # heartbeat (half-data hazard: completeness is driver-side,
-            # fetch targets are the local view)
-            node.heartbeat()
-            rows, pending_cleanup = run_task(task, payload, conf_map)
-            _request(driver_rpc_addr,
-                     {"op": "task_result", "query_id": task["query_id"],
-                      "executor_id": node.executor_id},
-                     pickle.dumps(rows))
-        except Exception:  # noqa: BLE001 — report, don't kill the worker
-            _request(driver_rpc_addr,
-                     {"op": "task_result", "query_id": task["query_id"],
-                      "executor_id": node.executor_id,
-                      "error": traceback.format_exc()})
+            _beat_stop.wait(2.0)
+    threading.Thread(target=_beat, daemon=True).start()
+
+    # fatal-diagnostics capture (GpuCoreDumpHandler analog): bundles go
+    # to the conf'd dump dir on unhandled worker errors
+    from spark_rapids_tpu.utils import crashdump
+    crashdump.install(conf_map.get("spark.rapids.diagnostics.dumpDir")
+                      or "", context={"executor_id": node.executor_id})
+
+    last_hb = 0.0
+    pending_cleanup = None
+    try:
+        while not (stop_check and stop_check()):
+            header, payload = _request(
+                driver_rpc_addr, {"op": "get_task",
+                                  "executor_id": node.executor_id})
+            task = header.get("task")
+            if task is None:
+                now = time.monotonic()
+                if now - last_hb > 5.0:
+                    node.heartbeat()
+                    last_hb = now
+                time.sleep(poll_s)
+                continue
+            # previous query fully collected by the driver (it handed us a
+            # new task) -> its shuffle blocks are safe to drop now
+            if pending_cleanup is not None:
+                try:
+                    pending_cleanup.cleanup()
+                except Exception:
+                    pass
+                pending_cleanup = None
+            try:
+                # refresh the peer view FIRST: reduce-side fetches enumerate
+                # peers, and a task can arrive before the first idle-loop
+                # heartbeat (half-data hazard: completeness is driver-side,
+                # fetch targets are the local view)
+                node.heartbeat()
+                rows, pending_cleanup = run_task(task, payload, conf_map)
+                _request(driver_rpc_addr,
+                         {"op": "task_result", "query_id": task["query_id"],
+                          "executor_id": node.executor_id},
+                         pickle.dumps(rows))
+            except Exception:  # noqa: BLE001 — report, don't kill the worker
+                crashdump.dump_now("task_failure",
+                                   extra={"query_id": task["query_id"],
+                                          "error": traceback.format_exc()})
+                _request(driver_rpc_addr,
+                         {"op": "task_result", "query_id": task["query_id"],
+                          "executor_id": node.executor_id,
+                          "error": traceback.format_exc()})
+    finally:
+        # stop the liveness beat on ANY exit path (a dead driver's
+        # ConnectionError must not leak the thread)
+        _beat_stop.set()

@@ -210,6 +210,28 @@ BROADCAST_ROW_THRESHOLD = conf("spark.rapids.sql.join.broadcastRowThreshold").do
     "GpuBroadcastHashJoinExec)."
 ).int_conf(500_000)
 
+JOIN_ADAPTIVE_ENABLED = conf("spark.rapids.sql.join.adaptive.enabled").doc(
+    "Allow the runtime broadcast-vs-shuffled choice for joins whose "
+    "static estimate sits in the ambiguous zone (reference: "
+    "GpuShuffledSizedHashJoinExec.scala:829).  Cluster mode forces this "
+    "off: the choice is made from the LOCAL build-side row count, so two "
+    "ranks could pick different physical shapes for the same plan."
+).boolean_conf(True)
+
+SHUFFLE_COMPLETENESS_TIMEOUT = conf(
+    "spark.rapids.shuffle.completenessTimeout").doc(
+    "Seconds a cross-process reduce read waits for every declared map "
+    "participant before failing (the MapOutputTracker wait bound; lost "
+    "executors surface as this timeout on surviving ranks)."
+).double_conf(120.0)
+
+DIAG_DUMP_DIR = conf("spark.rapids.diagnostics.dumpDir").doc(
+    "Directory for crash/diagnostic bundles (the GpuCoreDumpHandler "
+    "analog, reference GpuCoreDumpHandler.scala:38): fatal executor "
+    "errors write a compressed bundle of thread stacks, device state, "
+    "config and recent trace ranges here.  Empty disables capture."
+).string_conf("")
+
 TEST_INJECT_RETRY_OOM = conf("spark.rapids.sql.test.injectRetryOOM").doc(
     "Fault injection: make the allocator throw synthetic retry OOMs "
     "(reference: RapidsConf.scala:3041-3083, used by the @inject_oom pytest "
@@ -388,6 +410,18 @@ class RapidsConf:
     @property
     def broadcast_row_threshold(self) -> int:
         return self.get(BROADCAST_ROW_THRESHOLD)
+
+    @property
+    def join_adaptive_enabled(self) -> bool:
+        return self.get(JOIN_ADAPTIVE_ENABLED)
+
+    @property
+    def shuffle_completeness_timeout(self) -> float:
+        return self.get(SHUFFLE_COMPLETENESS_TIMEOUT)
+
+    @property
+    def diag_dump_dir(self) -> str:
+        return self.get(DIAG_DUMP_DIR) or ""
 
     @property
     def shuffle_writer_threads(self) -> int:

@@ -50,16 +50,23 @@ class BufferSlot:
     dtype: T.DataType
     update_op: str   # how raw input rows fold into this buffer
     merge_op: str    # how partial buffers fold together (sum for counts)
+    input_index: int = 0   # which of the agg's `inputs` this slot consumes
 
 
 class AggregateFunction(Expression):
-    """Base: children[0] (if any) is the input value expression."""
+    """Base: children[0] (if any) is the input value expression.
+    Multi-input aggregates (percentile with frequency) override
+    ``inputs``; slot.input_index picks the column each buffer folds."""
 
     name = "agg"
 
     @property
     def input(self) -> Optional[Expression]:
         return self.children[0] if self.children else None
+
+    @property
+    def inputs(self) -> Tuple[Expression, ...]:
+        return (self.children[0],) if self.children else ()
 
     def with_children(self, children):
         return type(self)(children[0]) if children else type(self)()
@@ -554,28 +561,83 @@ def approx_count_distinct(e, rsd: float = 0.05):
     return ApproximateCountDistinct(col(e) if isinstance(e, str) else e, rsd)
 
 
+def _fixed_stride_array(vals, valid, et):
+    """K per-group value arrays -> one segmented ARRAY DeviceColumn with
+    exactly K elements per valid row (array-percentage results)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    cap = vals[0].shape[0]
+    k = len(vals)
+    stacked = jnp.stack(vals, axis=1).reshape(cap * k) \
+        .astype(et.jnp_dtype)
+    lengths = jnp.where(valid, k, 0).astype(jnp.int32)
+    offsets = jnp.zeros((cap + 1,), jnp.int32).at[1:].set(
+        jnp.cumsum(lengths))
+    # compact the element buffer so offsets stay dense
+    elem_keep = jnp.repeat(valid, k)
+    ki = elem_keep.astype(jnp.int32)
+    dest = jnp.cumsum(ki) - ki
+    data = jnp.zeros((cap * k,), et.jnp_dtype).at[
+        jnp.where(elem_keep, dest, cap * k)].set(stacked, mode="drop")
+    cvalid = jnp.zeros((cap * k,), jnp.bool_).at[
+        jnp.where(elem_keep, dest, cap * k)].set(True, mode="drop")
+    return DeviceColumn(data, valid,
+                        T.ArrayType(et, contains_null=False), offsets,
+                        cvalid)
+
+
 class Percentile(AggregateFunction):
-    """percentile(col, p) — EXACT percentile with linear interpolation
-    (Spark's Percentile agg; the reference evaluates it via sorted group
-    arrays, aggregate/GpuPercentileEvaluation area).
+    """percentile(col, p [, frequency]) — EXACT percentile with linear
+    interpolation (Spark's Percentile agg; the reference evaluates it via
+    sorted group arrays / the jni Histogram kernel for the frequency
+    form, aggregate/GpuPercentile.scala CudfHistogram).
 
     Buffer: the group's valid values collected into one array row (the
-    same holistic-buffer shape Spark uses); finalize sorts each row's
-    entries and interpolates at rank p*(n-1)."""
+    same holistic-buffer shape Spark uses); with a frequency column a
+    SECOND aligned array row collects the weights (rows where either side
+    is null are masked out of both planes so they stay paired).  p may be
+    a list (array percentages -> ARRAY result).  Negative frequencies
+    raise in the oracle; the device kernel clamps them to 0 (planner
+    note)."""
 
     name = "percentile"
 
-    def __init__(self, child: Expression, percentage: float):
-        assert 0.0 <= percentage <= 1.0, percentage
-        self.children = (child,)
-        self.percentage = float(percentage)
+    def __init__(self, child: Expression, percentage,
+                 frequency: Optional[Expression] = None):
+        self.is_array = isinstance(percentage, (list, tuple))
+        ps = [float(p) for p in (percentage if self.is_array
+                                 else [percentage])]
+        assert all(0.0 <= p <= 1.0 for p in ps), percentage
+        self.children = (child,) if frequency is None \
+            else (child, frequency)
+        self.percentages = ps
+        self.percentage = ps[0]
+        self.frequency = frequency
 
     def with_children(self, children):
-        return Percentile(children[0], self.percentage)
+        return Percentile(
+            children[0],
+            self.percentages if self.is_array else self.percentage,
+            children[1] if len(children) > 1 else None)
+
+    @property
+    def inputs(self):
+        if self.frequency is None:
+            return (self.children[0],)
+        # mask BOTH planes where either side is null so the collected
+        # value/weight rows stay element-aligned
+        from spark_rapids_tpu.expressions.conditional import If
+        from spark_rapids_tpu.expressions.core import Literal
+        v, f = self.children
+        both = v.is_not_null() & f.is_not_null()
+        return (If(both, v, Literal(None, v.dtype)),
+                If(both, f, Literal(None, f.dtype)))
 
     @property
     def dtype(self):
-        return T.DOUBLE
+        return T.ArrayType(T.DOUBLE, contains_null=False) \
+            if self.is_array else T.DOUBLE
 
     @property
     def nullable(self):
@@ -583,51 +645,159 @@ class Percentile(AggregateFunction):
 
     @property
     def buffers(self):
-        return (BufferSlot(T.ArrayType(T.DOUBLE, contains_null=False),
-                           COLLECT, COLLECT_MERGE),)
+        arr = T.ArrayType(T.DOUBLE, contains_null=False)
+        slots = [BufferSlot(arr, COLLECT, COLLECT_MERGE, input_index=0)]
+        if self.frequency is not None:
+            slots.append(BufferSlot(arr, COLLECT, COLLECT_MERGE,
+                                    input_index=1))
+        return tuple(slots)
+
+    def _weighted_np(self, vals, freqs, p):
+        """Exact percentile of vals expanded by integer freqs (Spark's
+        frequency semantics), without materializing the expansion."""
+        order = np.argsort(vals, kind="stable")
+        v = vals[order]
+        w = freqs[order].astype(np.int64)
+        if np.any(w < 0):
+            raise ValueError("percentile frequency must be >= 0")
+        cw = np.cumsum(w)
+        total = cw[-1] if len(cw) else 0
+        if total <= 0:
+            return None
+        rank = p * (total - 1)
+        lo, hi = int(np.floor(rank)), int(np.ceil(rank))
+        frac = rank - np.floor(rank)
+        k_lo = int(np.searchsorted(cw, lo, side="right"))
+        k_hi = int(np.searchsorted(cw, hi, side="right"))
+        return float(v[k_lo] + (v[k_hi] - v[k_lo]) * frac)
 
     def finalize_np(self, bufs):
-        (rows, valid), = bufs    # object array of float lists
+        if self.frequency is not None:
+            (rows, valid), (frows, _) = bufs
+        else:
+            (rows, valid), = bufs
+            frows = None
         n = len(rows)
-        out = np.zeros((n,), np.float64)
         ok = np.zeros((n,), np.bool_)
+        out = np.empty((n,), object) if self.is_array \
+            else np.zeros((n,), np.float64)
+
+        def one(vals, freqs, p):
+            if freqs is None:
+                return float(np.percentile(vals, p * 100.0,
+                                           method="linear"))
+            return self._weighted_np(vals, freqs, p)
         for i in range(n):
             vals = rows[i]
             if not valid[i] or vals is None or len(vals) == 0:
+                if self.is_array:
+                    out[i] = None
                 continue
-            out[i] = float(np.percentile(np.asarray(vals, np.float64),
-                                         self.percentage * 100.0,
-                                         method="linear"))
+            va = np.asarray(vals, np.float64)
+            fa = (np.asarray(frows[i], np.float64)
+                  if frows is not None else None)
+            rs = [one(va, fa, p) for p in self.percentages]
+            if any(r is None for r in rs):
+                if self.is_array:
+                    out[i] = None
+                continue
+            out[i] = rs if self.is_array else rs[0]
             ok[i] = True
         return out, ok
 
-    def finalize_jnp(self, bufs):
+    def _device_ranks(self, s, weights, nrows):
+        """Per-group sorted values + cumulative weights machinery shared
+        by every percentage: returns a closure computing one p."""
         import jax.numpy as jnp
-        (col, valid), = bufs     # array DeviceColumn: one row per group
-        from spark_rapids_tpu.kernels.collections import segment_sort
-        cap = col.capacity
-        nrows = jnp.sum(valid.astype(jnp.int32))
-        s = segment_sort(col, nrows, ascending=True)
-        lengths = (s.offsets[1:] - s.offsets[:-1]).astype(jnp.float64)
-        rank = self.percentage * jnp.maximum(lengths - 1.0, 0.0)
-        lo = jnp.floor(rank).astype(jnp.int32)
-        hi = jnp.ceil(rank).astype(jnp.int32)
-        frac = rank - jnp.floor(rank)
+
+        from spark_rapids_tpu.kernels.collections import (
+            element_live_mask, element_row_ids)
         base = s.offsets[:-1]
         ecap = max(s.data.shape[0] - 1, 0)
-        lo_v = s.data[jnp.clip(base + lo, 0, ecap)]
-        hi_v = s.data[jnp.clip(base + hi, 0, ecap)]
-        out = lo_v + (hi_v - lo_v) * frac
-        ok = valid & (lengths > 0)
-        return out.astype(jnp.float64), ok
+        if weights is None:
+            lengths = (s.offsets[1:] - s.offsets[:-1]).astype(jnp.float64)
+
+            def at(p):
+                rank = p * jnp.maximum(lengths - 1.0, 0.0)
+                lo = jnp.floor(rank).astype(jnp.int32)
+                hi = jnp.ceil(rank).astype(jnp.int32)
+                frac = rank - jnp.floor(rank)
+                lo_v = s.data[jnp.clip(base + lo, 0, ecap)]
+                hi_v = s.data[jnp.clip(base + hi, 0, ecap)]
+                return lo_v + (hi_v - lo_v) * frac
+            return at, lengths > 0
+        # weighted: per-element cumulative weights within each group
+        # (global cumsum minus the cumsum just before the segment start)
+        import jax
+        rows = element_row_ids(s)
+        live = element_live_mask(s, nrows)
+        w = jnp.where(live, jnp.maximum(weights, 0.0), 0.0)
+        cw_glob = jnp.cumsum(w)
+        start_cum = jnp.take(
+            jnp.concatenate([jnp.zeros((1,), cw_glob.dtype), cw_glob]),
+            base[rows])
+        cw = jnp.where(live, cw_glob - start_cum, 0.0)
+        totals = jax.ops.segment_max(
+            cw, rows, num_segments=s.capacity)
+
+        def at(p):
+            rank = p * jnp.maximum(totals - 1.0, 0.0)
+            lo_t = jnp.floor(rank)
+            hi_t = jnp.ceil(rank)
+            frac = rank - lo_t
+            k_lo = jax.ops.segment_sum(
+                (cw <= lo_t[rows]).astype(jnp.int32) * live, rows,
+                num_segments=s.capacity)
+            k_hi = jax.ops.segment_sum(
+                (cw <= hi_t[rows]).astype(jnp.int32) * live, rows,
+                num_segments=s.capacity)
+            lo_v = s.data[jnp.clip(base + k_lo, 0, ecap)]
+            hi_v = s.data[jnp.clip(base + k_hi, 0, ecap)]
+            return lo_v + (hi_v - lo_v) * frac
+        return at, totals > 0
+
+    def finalize_jnp(self, bufs):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+        from spark_rapids_tpu.kernels.collections import segment_sort
+        if self.frequency is not None:
+            (col, valid), (fcol, _) = bufs
+        else:
+            (col, valid), = bufs
+            fcol = None
+        nrows = jnp.sum(valid.astype(jnp.int32))
+        if fcol is None:
+            s = segment_sort(col, nrows, ascending=True)
+            weights = None
+        else:
+            # freqs ride the value sort as a carry plane; truncate to
+            # integral like the oracle (Spark frequencies are integral)
+            s, weights = segment_sort(col, nrows, ascending=True,
+                                      carry=jnp.floor(
+                                          fcol.data.astype(jnp.float64)))
+        at, nonempty = self._device_ranks(s, weights, nrows)
+        ok = valid & nonempty
+        if not self.is_array:
+            return at(self.percentage).astype(jnp.float64), ok
+        vals = [at(p).astype(jnp.float64) for p in self.percentages]
+        return _fixed_stride_array(vals, ok, T.DOUBLE), ok
 
     def __repr__(self):
-        return f"percentile({self.input!r}, {self.percentage})"
+        ps = self.percentages if self.is_array else self.percentage
+        if self.frequency is not None:
+            return f"percentile({self.children[0]!r}, {ps}, " \
+                   f"{self.frequency!r})"
+        return f"percentile({self.children[0]!r}, {ps})"
 
 
-def percentile(e, p: float) -> Percentile:
+def percentile(e, p, frequency=None) -> Percentile:
+    """p may be a float or list of floats; frequency an optional column
+    of non-negative weights (Spark percentile(col, p, freq))."""
     from spark_rapids_tpu.expressions.core import col as _col
-    return Percentile(_col(e) if isinstance(e, str) else e, p)
+    return Percentile(_col(e) if isinstance(e, str) else e, p,
+                      _col(frequency) if isinstance(frequency, str)
+                      else frequency)
 
 
 class ApproxPercentile(AggregateFunction):
@@ -647,11 +817,15 @@ class ApproxPercentile(AggregateFunction):
 
     name = "approx_percentile"
 
-    def __init__(self, child: Expression, percentage: float,
+    def __init__(self, child: Expression, percentage,
                  accuracy: int = 10000):
-        assert 0.0 <= percentage <= 1.0, percentage
+        self.is_array = isinstance(percentage, (list, tuple))
+        ps = [float(p) for p in (percentage if self.is_array
+                                 else [percentage])]
+        assert all(0.0 <= p <= 1.0 for p in ps), percentage
         self.children = (child,)
-        self.percentage = float(percentage)
+        self.percentages = ps
+        self.percentage = ps[0]     # back-compat for scalar callers
         self.accuracy = int(accuracy)
         # delta caps the centroid count; beyond ~500 the array rows cost
         # more than the rank error buys (reference passes accuracy as the
@@ -659,11 +833,21 @@ class ApproxPercentile(AggregateFunction):
         self.delta = max(20, min(self.accuracy, 500))
 
     def with_children(self, children):
-        return ApproxPercentile(children[0], self.percentage, self.accuracy)
+        return ApproxPercentile(
+            children[0],
+            self.percentages if self.is_array else self.percentage,
+            self.accuracy)
 
     @property
     def dtype(self):
-        return T.DOUBLE
+        # Spark returns the INPUT type (double math cast back, reference
+        # GpuApproximatePercentile.scala:103-119), and an array of it for
+        # array percentages
+        et = self.children[0].dtype
+        if not (et.is_integral or isinstance(et, (T.FloatType,
+                                                  T.DoubleType))):
+            et = T.DOUBLE
+        return T.ArrayType(et, contains_null=False) if self.is_array else et
 
     @property
     def nullable(self):
@@ -677,14 +861,37 @@ class ApproxPercentile(AggregateFunction):
                 BufferSlot(T.DOUBLE, MIN, MIN),
                 BufferSlot(T.DOUBLE, MAX, MAX))
 
+    def _cast_np(self, x):
+        et = self.dtype.element_type if self.is_array else self.dtype
+        if et.is_integral:
+            return int(x)       # double -> integral cast truncates
+        if isinstance(et, T.FloatType):
+            return np.float32(x).item()
+        return float(x)
+
     def finalize_np(self, bufs):
         import numpy as np
 
         from spark_rapids_tpu.kernels import tdigest as TD
         (means, mv), (weights, _), (mn, _), (mx, _) = bufs
         n = len(means)
-        out = np.zeros((n,), np.float64)
         ok = np.zeros((n,), np.bool_)
+        if self.is_array:
+            out = np.empty((n,), object)
+            for i in range(n):
+                if not mv[i] or means[i] is None:
+                    out[i] = None
+                    continue
+                rs = [TD.np_interpolate(means[i], weights[i],
+                                        float(mn[i]), float(mx[i]), p)
+                      for p in self.percentages]
+                if all(r is not None for r in rs):
+                    out[i] = [self._cast_np(r) for r in rs]
+                    ok[i] = True
+                else:
+                    out[i] = None
+            return out, ok
+        out = np.zeros((n,), self.dtype.np_dtype)
         for i in range(n):
             if not mv[i] or means[i] is None:
                 continue
@@ -692,17 +899,35 @@ class ApproxPercentile(AggregateFunction):
                                   float(mn[i]), float(mx[i]),
                                   self.percentage)
             if r is not None:
-                out[i] = r
+                out[i] = self._cast_np(r)
                 ok[i] = True
         return out, ok
 
     def finalize_jnp(self, bufs):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columnar.column import DeviceColumn
         from spark_rapids_tpu.kernels import tdigest as TD
         (mc, _), (wc, _), (mn, mn_ok), (mx, _) = bufs
-        val, ok = TD.interpolate(mc, wc, mn, mx, self.percentage)
-        return val, ok & mn_ok
+        if not self.is_array:
+            val, ok = TD.interpolate(mc, wc, mn, mx, self.percentage)
+            et = self.dtype
+            return val.astype(et.jnp_dtype), ok & mn_ok
+        # array percentages: K values per group -> fixed-stride array
+        # column (every valid row has exactly len(percentages) elements)
+        vals, oks = [], []
+        for p in self.percentages:
+            v, o = TD.interpolate(mc, wc, mn, mx, p)
+            vals.append(v)
+            oks.append(o)
+        valid = mn_ok
+        for o in oks:
+            valid = valid & o
+        col = _fixed_stride_array(vals, valid, self.dtype.element_type)
+        return col, valid
 
 
-def approx_percentile(e, p: float, accuracy: int = 10000) -> ApproxPercentile:
+def approx_percentile(e, p, accuracy: int = 10000) -> ApproxPercentile:
+    """p may be a float or a list of floats (array percentages)."""
     from spark_rapids_tpu.expressions.core import col
     return ApproxPercentile(col(e) if isinstance(e, str) else e, p, accuracy)

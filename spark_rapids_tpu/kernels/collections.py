@@ -245,10 +245,15 @@ def segment_position(
     return jnp.where(validity, posn, 0), validity
 
 
-def segment_sort(col: DeviceColumn, num_rows, ascending: bool) -> DeviceColumn:
+def segment_sort(col: DeviceColumn, num_rows, ascending: bool,
+                 carry: "jax.Array" = None):
     """sort_array: sort elements within each row.  Spark semantics: asc ->
     nulls first, desc -> nulls last (collectionOperations.scala GpuSortArray).
-    """
+
+    ``carry`` (optional [elem_cap] plane) rides through the same
+    permutation — the weighted-percentile path sorts values carrying
+    their frequencies; with carry given the return is
+    (sorted col, permuted carry)."""
     from spark_rapids_tpu.kernels.sort import _data_key_fixed, _null_key
     from spark_rapids_tpu.kernels.sort import SortOrder
     rows = element_row_ids(col)
@@ -268,7 +273,11 @@ def segment_sort(col: DeviceColumn, num_rows, ascending: bool) -> DeviceColumn:
     cvalid = col.child_validity[perm] & live_after
     zero = jnp.zeros((), col.data.dtype)
     data = jnp.where(cvalid, data, zero)
-    return DeviceColumn(data, col.validity, col.dtype, col.offsets, cvalid)
+    out = DeviceColumn(data, col.validity, col.dtype, col.offsets, cvalid)
+    if carry is None:
+        return out
+    w = jnp.where(cvalid, carry[perm], jnp.zeros((), carry.dtype))
+    return out, w
 
 
 def segment_distinct(col: DeviceColumn, num_rows) -> DeviceColumn:

@@ -292,6 +292,19 @@ class CpuEngine:
             out.append(CpuTable.concat(tables, plan.schema))
         return out or [CpuTable.empty(plan.schema)]
 
+    def _exec_cachedparquetrelation(self, plan: L.CachedParquetRelation):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from spark_rapids_tpu.columnar.arrow import arrow_to_batch
+        out = []
+        for part in plan.partitions:
+            tables = [CpuTable.from_batch(
+                arrow_to_batch(pq.read_table(pa.BufferReader(blob))))
+                for blob in part]
+            out.append(CpuTable.concat(tables, plan.schema))
+        return out or [CpuTable.empty(plan.schema)]
+
     def _exec_parquetrelation(self, plan: L.ParquetRelation):
         from spark_rapids_tpu.columnar import arrow as arrow_interop
         from spark_rapids_tpu.io.parquet import _open_parquet
@@ -377,8 +390,9 @@ class CpuEngine:
         # evaluate each aggregate's input over the full table once
         agg_inputs = {}
         for agg in plan.aggregates:
-            if agg.input is not None and id(agg) not in agg_inputs:
-                agg_inputs[id(agg)] = agg.input.eval_cpu(ctx)
+            for ii, inp in enumerate(agg.inputs):
+                if (id(agg), ii) not in agg_inputs:
+                    agg_inputs[(id(agg), ii)] = inp.eval_cpu(ctx)
 
         groups: Dict[tuple, List[int]] = {}
         order: List[tuple] = []
@@ -418,7 +432,7 @@ class CpuEngine:
                     from spark_rapids_tpu.kernels import hll as HLL
                     bv = np.empty((n_groups,), object)
                     bm = np.ones((n_groups,), np.bool_)
-                    vals, valid = agg_inputs[id(agg)]
+                    vals, valid = agg_inputs[(id(agg), 0)]
                     for gi, k in enumerate(order):
                         idx = np.array(groups[k], dtype=np.int64)
                         bv[gi] = HLL.update_np(
@@ -438,7 +452,8 @@ class CpuEngine:
                     if slot.update_op == COUNT_STAR:
                         bv[gi] = len(idx)
                         continue
-                    vals, valid = agg_inputs[id(agg)]
+                    vals, valid = agg_inputs[(id(agg),
+                                               slot.input_index)]
                     sel = idx[valid[idx]] if len(idx) else idx
                     if slot.update_op == COUNT_VALID:
                         bv[gi] = len(sel)

@@ -394,8 +394,9 @@ class _AggDeviceSpec:
         key_cols = tuple(e.eval(ctx) for e in self.group_exprs)
         agg_in = {}
         for agg in self.aggregates:
-            if agg.input is not None and id(agg) not in agg_in:
-                agg_in[id(agg)] = agg.input.eval(ctx)
+            for ii, inp in enumerate(agg.inputs):
+                if (id(agg), ii) not in agg_in:
+                    agg_in[(id(agg), ii)] = inp.eval(ctx)
         nkeys = len(key_cols)
 
         if nkeys == 0:
@@ -403,7 +404,7 @@ class _AggDeviceSpec:
             cols = []
             for ai, slot in self.slot_specs:
                 agg = self.aggregates[ai]
-                col = agg_in.get(id(agg))
+                col = agg_in.get((id(agg), slot.input_index))
                 if slot.update_op == HLL_UPDATE:
                     from spark_rapids_tpu.kernels import hll as HLL
                     regs = HLL.global_update(col, live, agg.p)
@@ -439,9 +440,9 @@ class _AggDeviceSpec:
         work_cols = list(key_cols)
         col_of_agg = {}
         for agg in self.aggregates:
-            if agg.input is not None:
-                col_of_agg[id(agg)] = len(work_cols)
-                work_cols.append(agg_in[id(agg)])
+            for ii in range(len(agg.inputs)):
+                col_of_agg[(id(agg), ii)] = len(work_cols)
+                work_cols.append(agg_in[(id(agg), ii)])
         work_names = tuple(f"c{i}" for i in range(len(work_cols)))
         work = ColumnarBatch(tuple(work_cols), batch.num_rows,
                              Schema(work_names, tuple(c.dtype for c in work_cols)))
@@ -451,8 +452,9 @@ class _AggDeviceSpec:
         cols = list(out_keys)
         for ai, slot in self.slot_specs:
             agg = self.aggregates[ai]
-            col = (layout.sorted_batch.columns[col_of_agg[id(agg)]]
-                   if agg.input is not None else None)
+            col = (layout.sorted_batch.columns[
+                       col_of_agg[(id(agg), slot.input_index)]]
+                   if agg.inputs else None)
             if slot.update_op == HLL_UPDATE:
                 from spark_rapids_tpu.kernels import hll as HLL
                 regs2d = HLL.seg_update(col, layout, agg.p)
@@ -614,7 +616,12 @@ class _AggDeviceSpec:
             v, valid = agg.finalize_jnp(bufs)
             live = merged.live_mask()
             valid = valid & live
-            if isinstance(v, DeviceColumn):
+            if isinstance(v, DeviceColumn) and v.offsets is not None:
+                # array-valued result (approx_percentile with array
+                # percentages): finalize built the segmented column
+                mapping[id(agg)] = DeviceColumn(
+                    v.data, valid, v.dtype, v.offsets, v.child_validity)
+            elif isinstance(v, DeviceColumn):
                 from spark_rapids_tpu.kernels import decimal as DK
                 mapping[id(agg)] = DK.make_column128(
                     v.children[0].data, v.children[1].data, valid,

@@ -93,6 +93,39 @@ class _PooledScanExec(TpuExec):
                 sem.acquire_if_necessary()
 
 
+class TpuCachedParquetScanExec(_PooledScanExec):
+    """Scan of .persist(serializer='parquet') blobs: decode each
+    partition's in-memory parquet back to device batches (reference
+    GpuInMemoryTableScanExec over ParquetCachedBatchSerializer data).
+    Runs on the pooled-scan body so blob decompression happens OFF the
+    device semaphore with prefetch overlap, like every other scan."""
+
+    def __init__(self, partitions, schema: Schema,
+                 reader_threads: int = 2):
+        super().__init__((), schema)
+        self.partitions = partitions   # List[List[bytes]]
+        self.reader_threads = reader_threads
+
+    def num_partitions(self) -> int:
+        return max(len(self.partitions), 1)
+
+    def _host_iter(self, idx: int):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        for blob in self.partitions[idx]:
+            yield pq.read_table(pa.BufferReader(blob))
+
+    def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        if idx >= len(self.partitions):
+            return
+        yield from self._scan_batches(idx, self.reader_threads)
+
+    def describe(self):
+        total = sum(len(b) for p in self.partitions for b in p)
+        return f"TpuCachedParquetScan{self.schema!r} [{total} bytes]"
+
+
+
 class TpuParquetScanExec(_PooledScanExec):
     """One partition per file; host decode runs MULTITHREADED-style on the
     shared reader pool (GpuParquetScan.scala:3134 analog)."""

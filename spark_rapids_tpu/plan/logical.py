@@ -58,6 +58,34 @@ class InMemoryRelation(LogicalPlan):
         return f"InMemoryRelation{self._schema!r} x{len(self.partitions)} partitions"
 
 
+class CachedParquetRelation(LogicalPlan):
+    """Leaf: .persist(serializer='parquet') storage — each partition held
+    as compressed in-memory parquet blobs instead of live device batches.
+
+    Reference: sql-plugin/.../parquet/ParquetCachedBatchSerializer.scala
+    (:266 onward) — the plugin replaces Spark's .cache() format with
+    GPU-written parquet so cached data is compressed and runs through the
+    columnar scan path on re-read.  Same trade here: ~10x smaller resident
+    cache for a decode on each rescan."""
+
+    def __init__(self, partitions: Sequence[List[bytes]], schema: Schema):
+        self.partitions = [list(p) for p in partitions]
+        self._schema = schema
+        self.children = ()
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def cached_bytes(self) -> int:
+        return sum(len(b) for p in self.partitions for b in p)
+
+    def describe(self):
+        return (f"CachedParquetRelation{self._schema!r} "
+                f"x{len(self.partitions)} partitions, "
+                f"{self.cached_bytes()} bytes")
+
+
 class ParquetRelation(LogicalPlan):
     """Leaf: parquet files on disk (or object store)."""
 

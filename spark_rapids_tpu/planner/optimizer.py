@@ -201,7 +201,8 @@ def _exprs_refs(exprs) -> Set[str]:
 
 def _prune(plan: L.LogicalPlan, required: Set[str]) -> L.LogicalPlan:
     p = plan
-    if isinstance(p, (L.InMemoryRelation, L.ParquetRelation, L.FileRelation,
+    if isinstance(p, (L.InMemoryRelation, L.CachedParquetRelation,
+                      L.ParquetRelation, L.FileRelation,
                       L.DeltaRelation, L.IcebergRelation)):
         have = list(p.schema.names)
         keep = [n for n in have if n in required]

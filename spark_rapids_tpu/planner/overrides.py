@@ -713,6 +713,10 @@ class PlanMeta:
         p = self.plan
         if isinstance(p, L.InMemoryRelation):
             return TpuInMemoryScanExec(p.partitions, p.schema)
+        if isinstance(p, L.CachedParquetRelation):
+            from spark_rapids_tpu.plan.execs.scan import (
+                TpuCachedParquetScanExec)
+            return TpuCachedParquetScanExec(p.partitions, p.schema)
         if isinstance(p, L.ParquetRelation):
             return TpuParquetScanExec(
                 p.paths, p.schema, p.column_pruning,
@@ -910,7 +914,8 @@ class PlanMeta:
                 join = TpuFilterExec(p.condition, join)
             return join
         if (broadcastable and left.num_partitions() > 1 and p.left_keys
-                and p.join_type != "cross" and est <= thr * 8):
+                and p.join_type != "cross" and est <= thr * 8
+                and self.conf.join_adaptive_enabled):
             # ambiguous zone: the static estimate can't be trusted either
             # way — defer the broadcast-vs-shuffled choice to runtime,
             # decided from the MATERIALIZED build-side row count

@@ -307,14 +307,20 @@ def _build_registry() -> None:
                      note="long-representable inputs; strings fall back"))
     for cls in (A.BoolAnd, A.BoolOr):
         register(cls, ExprSig(BOOL, BOOL))
-    register(A.Percentile, ExprSig(TypeSig("double"), NUMERIC,
+    register(A.Percentile, ExprSig(TypeSig("double") + ARR, NUMERIC,
+                                   INTEGRAL,
                                    note="exact percentile via sorted "
-                                   "group arrays"))
+                                   "group arrays; optional INTEGRAL "
+                                   "frequency column (Spark requires "
+                                   "integral; negative frequencies raise "
+                                   "in the oracle, clamp to 0 on "
+                                   "device); array percentages"))
     register(A.ApproxPercentile,
-             ExprSig(TypeSig("double"), NUMERIC,
-                     note="t-digest; results within accuracy tolerance "
-                     "of Spark (reference documents the same for its "
-                     "cuDF t-digest offload)"))
+             ExprSig(NUMERIC + ARR, NUMERIC,
+                     note="t-digest, input-typed result (array of it for "
+                     "array percentages); results within accuracy "
+                     "tolerance of Spark (reference documents the same "
+                     "for its cuDF t-digest offload)"))
 
     # window functions
     for cls in (W.RowNumber, W.Rank, W.DenseRank, W.Ntile):

@@ -162,6 +162,16 @@ def set_cluster_participants(participants) -> None:
     _cluster_participants = list(participants) if participants else None
 
 
+#: reduce-read completeness wait (seconds); cluster executors set it from
+#: the broadcast conf (spark.rapids.shuffle.completenessTimeout)
+_completeness_timeout_s: float = 120.0
+
+
+def set_completeness_timeout(seconds: float) -> None:
+    global _completeness_timeout_s
+    _completeness_timeout_s = float(seconds)
+
+
 def set_process_shuffle_executor(executor) -> None:
     """Install the process-wide shuffle node (cluster executor bootstrap:
     the node registered with the DRIVER's registry must be the one the
@@ -197,5 +207,7 @@ def make_transport(mode: str, num_partitions: int, schema: Schema,
         return TcpShuffleTransport(process_shuffle_executor(),
                                    num_partitions, schema, codec,
                                    shuffle_id=sid,
+                                   completeness_timeout_s=(
+                                       _completeness_timeout_s),
                                    participants=_cluster_participants)
     return CacheOnlyTransport(num_partitions)

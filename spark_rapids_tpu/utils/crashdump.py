@@ -1,0 +1,119 @@
+"""Fatal-error diagnostic bundles — the GPU core-dump handler analog.
+
+Reference: sql-plugin/.../GpuCoreDumpHandler.scala:38 — on a GPU crash
+the plugin streams a compressed core dump through a named pipe to
+distributed storage (codump.zstd), coordinated by driver RPC, so the
+post-mortem survives the dying executor.  A TPU/XLA process has no CUDA
+core dump; the equivalent forensic artifact is a bundle of what a
+post-mortem actually needs: every thread's Python stack, the JAX
+backend/device state, live arena + task-metric accounting, the session
+config, and the most recent named trace ranges.  Bundles are gzip'd JSON
+written to the configured dump directory (local path or any fsspec URL
+the object-store layer handles), named like the reference's
+`gpucore-<appid>-<executor>.zstd` artifacts.
+
+Two entry points:
+  install(dump_dir, context) — once per process; hooks sys.excepthook
+      (keeping the previous hook) so any uncaught exception dumps.
+  dump_now(reason, extra)    — explicit capture (task failures, watchdog
+      triggers, debugging).
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+_state = {"dir": "", "context": {}, "prev_hook": None, "installed": False}
+_lock = threading.Lock()
+
+
+def install(dump_dir: str, context: Optional[Dict] = None) -> None:
+    """Enable capture.  Empty dump_dir disables (dump_now no-ops)."""
+    with _lock:
+        _state["dir"] = dump_dir or ""
+        _state["context"] = dict(context or {})
+        if dump_dir and not _state["installed"]:
+            _state["prev_hook"] = sys.excepthook
+            sys.excepthook = _excepthook
+            _state["installed"] = True
+
+
+def _excepthook(exc_type, exc, tb):
+    try:
+        dump_now("uncaught_exception", extra={
+            "error": "".join(traceback.format_exception(exc_type, exc, tb))})
+    except Exception:
+        pass
+    prev = _state.get("prev_hook")
+    (prev or sys.__excepthook__)(exc_type, exc, tb)
+
+
+def _thread_stacks() -> Dict[str, list]:
+    out = {}
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        out[f"{names.get(tid, '?')}({tid})"] = \
+            traceback.format_stack(frame)
+    return out
+
+
+def _device_state() -> Dict:
+    info: Dict = {}
+    try:
+        import jax
+        info["backend"] = jax.default_backend()
+        info["devices"] = [str(d) for d in jax.devices()]
+    except Exception as e:  # jax may itself be the crashing component
+        info["backend_error"] = repr(e)
+    try:
+        from spark_rapids_tpu.memory.arena import device_arena
+        a = device_arena()
+        info["arena"] = {"used_bytes": int(a.used_bytes),
+                         "budget_bytes": int(a.budget_bytes)}
+    except Exception:
+        pass
+    try:
+        from spark_rapids_tpu.utils.tracing import span_log
+        info["recent_ranges"] = span_log.snapshot()[-50:]
+    except Exception:
+        pass
+    return info
+
+
+def dump_now(reason: str, extra: Optional[Dict] = None) -> Optional[str]:
+    """Write one bundle; returns its path (None when disabled/failed)."""
+    dump_dir = _state["dir"]
+    if not dump_dir:
+        return None
+    bundle = {
+        "reason": reason,
+        "timestamp": time.time(),
+        "pid": os.getpid(),
+        "context": _state["context"],
+        "threads": _thread_stacks(),
+        "device": _device_state(),
+        "extra": extra or {},
+    }
+    name = (f"tpucore-{_state['context'].get('executor_id', 'local')}"
+            f"-{os.getpid()}-{int(time.time() * 1000)}.json.gz")
+    try:
+        data = gzip.compress(
+            json.dumps(bundle, default=str).encode("utf-8"))
+        if "://" in dump_dir:
+            import fsspec
+            with fsspec.open(dump_dir.rstrip("/") + "/" + name, "wb") as f:
+                f.write(data)
+            return dump_dir.rstrip("/") + "/" + name
+        os.makedirs(dump_dir, exist_ok=True)
+        path = os.path.join(dump_dir, name)
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+    except Exception:
+        return None

@@ -105,3 +105,47 @@ def test_global_no_keys():
         Alias(approx_percentile(col("v"), 0.9, 200), "p90")).collect()
     err = _rank_error(data["v"], row[0], 0.9)
     assert err <= 0.02, (row, err)
+
+
+def test_integer_input_returns_integer():
+    """Spark returns the INPUT type; verify long-typed results."""
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    schema = Schema.of(k=T.INT, v=T.LONG)
+    b = ColumnarBatch.from_pydict(
+        {"k": [0] * 100, "v": list(range(100))}, schema)
+    df = s.create_dataframe([b], num_partitions=1)
+    rows = df.group_by("k").agg(
+        Alias(approx_percentile(col("v"), 0.5), "p")).collect()
+    assert isinstance(rows[0][1], int), rows
+    assert 45 <= rows[0][1] <= 55
+
+
+def test_array_percentages_both_engines():
+    so = TpuSession({"spark.rapids.sql.enabled": "false"})
+    st = TpuSession({"spark.rapids.sql.enabled": "true"})
+    for s in (st, so):
+        df, data = pdf(s)
+        rows = df.group_by("k").agg(
+            Alias(approx_percentile(col("v"), [0.1, 0.5, 0.9]), "ps")
+        ).collect()
+        for k, ps in rows:
+            assert isinstance(ps, list) and len(ps) == 3
+            vals = [v for kk, v in zip(data["k"], data["v"])
+                    if kk == k and v is not None]
+            for p, r in zip([0.1, 0.5, 0.9], ps):
+                err = _rank_error(vals, r, p)
+                assert err <= 0.05, (k, p, r, err)
+            assert ps[0] <= ps[1] <= ps[2]
+
+
+def test_array_percentages_int_type():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    schema = Schema.of(k=T.INT, v=T.INT)
+    b = ColumnarBatch.from_pydict(
+        {"k": [0] * 50 + [1] * 50,
+         "v": list(range(50)) + list(range(100, 150))}, schema)
+    df = s.create_dataframe([b], num_partitions=1)
+    rows = sorted(df.group_by("k").agg(
+        Alias(approx_percentile(col("v"), [0.0, 1.0]), "ps")).collect())
+    assert rows[0][1] == [0, 49] and rows[1][1] == [100, 149]
+    assert all(isinstance(x, int) for x in rows[0][1])

@@ -105,3 +105,80 @@ def test_cluster_shuffled_join(cluster, tmp_path):
     plan = q(s.read_parquet(*paths)).plan
     got = sorted(tuple(r) for r in cluster.submit(plan, timeout_s=240))
     assert got == _expected(paths, q)
+
+
+def test_cluster_broadcast_join(cluster, tmp_path):
+    """Dimension-table broadcast: small exchange-free build side read in
+    FULL by every rank, stream side rank-split."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.expressions import col
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    paths = _write_inputs(tmp_path)
+    dim = os.path.join(str(tmp_path), "dim.parquet")
+    pq.write_table(pa.table({
+        "k": np.arange(9, dtype=np.int64),
+        "name": [f"dim-{i}" for i in range(9)],
+    }), dim)
+
+    def q_cluster(s):
+        fact = s.read_parquet(*paths)
+        d = s.read_parquet(dim)
+        return fact.filter(col("v") >= 0).join(d, on="k", how="inner")
+
+    s = TpuSession({})
+    plan = q_cluster(s).plan
+    got = sorted(tuple(r) for r in cluster.submit(plan, timeout_s=240))
+
+    def q_single(df):
+        # same query against the single-process engine for the oracle
+        s2 = TpuSession({"spark.rapids.sql.enabled": "true"})
+        d = s2.read_parquet(dim)
+        return df.filter(col("v") >= 0).join(d, on="k", how="inner")
+    exp = _expected(paths, q_single)
+    assert got == exp and len(got) > 0
+
+
+def test_cluster_executor_loss_redispatch(tmp_path):
+    """Kill one of two executors; the driver detects the lost rank via
+    heartbeat timeout and re-dispatches the whole query over the
+    survivor (fresh query id => fresh shuffle ids)."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    from spark_rapids_tpu.expressions import col, count, sum_
+    from spark_rapids_tpu.expressions.core import Alias
+
+    ctx = mp.get_context("spawn")
+    driver = TpuClusterDriver(
+        conf={"spark.sql.shuffle.partitions": "4",
+              "spark.rapids.shuffle.completenessTimeout": "8"},
+        heartbeat_timeout_s=4.0)
+    stop_ev = ctx.Event()
+    procs = [ctx.Process(target=_executor_proc,
+                         args=(driver.rpc_addr, stop_ev), daemon=True)
+             for _ in range(2)]
+    for p in procs:
+        p.start()
+    try:
+        driver.wait_for_executors(2, timeout_s=120)
+        paths = _write_inputs(tmp_path)
+
+        def q(df):
+            return df.group_by("k").agg(Alias(sum_(col("v")), "sv"),
+                                        Alias(count(), "n"))
+        s = TpuSession({})
+        plan = q(s.read_parquet(*paths)).plan
+        # hard-kill one executor, then submit: its task is never picked
+        # up, the heartbeat expires, and the query retries on the other
+        procs[1].terminate()
+        procs[1].join(timeout=10)
+        got = sorted(tuple(r) for r in driver.submit(plan, timeout_s=180))
+        assert got == _expected(paths, q)
+    finally:
+        stop_ev.set()
+        for p in procs:
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+        driver.close()

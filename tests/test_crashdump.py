@@ -1,0 +1,47 @@
+"""Fatal-diagnostics bundle tests (the GpuCoreDumpHandler analog,
+reference GpuCoreDumpHandler.scala:38)."""
+import gzip
+import json
+import os
+
+from spark_rapids_tpu.utils import crashdump
+
+
+def test_dump_now_writes_bundle(tmp_path):
+    d = str(tmp_path / "dumps")
+    crashdump.install(d, context={"executor_id": "test-exec"})
+    path = crashdump.dump_now("unit_test", extra={"k": "v"})
+    assert path and os.path.exists(path)
+    bundle = json.loads(gzip.decompress(open(path, "rb").read()))
+    assert bundle["reason"] == "unit_test"
+    assert bundle["extra"] == {"k": "v"}
+    assert bundle["context"]["executor_id"] == "test-exec"
+    # at least this thread's stack, with this test in it
+    assert any("test_dump_now_writes_bundle" in "".join(frames)
+               for frames in bundle["threads"].values())
+    assert "backend" in bundle["device"] or \
+        "backend_error" in bundle["device"]
+
+
+def test_dump_disabled_is_noop(tmp_path):
+    crashdump.install("")
+    assert crashdump.dump_now("nothing") is None
+
+
+def test_dump_fsspec_url(tmp_path):
+    crashdump.install("memory://dumps", context={})
+    path = crashdump.dump_now("via_fsspec")
+    assert path and path.startswith("memory://dumps/")
+    import fsspec
+    with fsspec.open(path, "rb") as f:
+        bundle = json.loads(gzip.decompress(f.read()))
+    assert bundle["reason"] == "via_fsspec"
+
+
+def test_session_installs_handler(tmp_path):
+    from spark_rapids_tpu.api.session import TpuSession
+    d = str(tmp_path / "sess_dumps")
+    TpuSession({"spark.rapids.sql.enabled": "true",
+                "spark.rapids.diagnostics.dumpDir": d})
+    path = crashdump.dump_now("session_check")
+    assert path and path.startswith(d)

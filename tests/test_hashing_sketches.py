@@ -153,3 +153,65 @@ def test_percentile_exact():
     assert_tpu_cpu_equal(lambda s: _df(s).agg(
         Alias(percentile(col("l"), 0.0), "mn"),
         Alias(percentile(col("l"), 1.0), "mx")))
+
+
+def test_percentile_with_frequency():
+    """percentile(col, p, freq) — the jni Histogram analog.  Ground
+    truth: numpy over the freq-expanded values."""
+    import numpy as np
+
+    from spark_rapids_tpu.expressions import percentile
+
+    from spark_rapids_tpu.expressions import lit
+    freq = (col("i") % lit(5) + lit(5)) % lit(5)   # pmod: 0..4
+
+    def q(s):
+        return _df(s).group_by("g").agg(
+            Alias(percentile(col("l"), 0.5, freq), "wp"))
+    rows = assert_tpu_cpu_equal(q)
+    # independent expansion check on one engine's data
+    s = TpuSession({"spark.rapids.sql.enabled": "false"})
+    raw = _df(s).select(col("g"), col("l"),
+                        Alias(freq, "f")).collect()
+    for g, wp in rows:
+        expanded = []
+        for gg, l, i in raw:
+            if gg == g and l is not None and i is not None and i > 0:
+                expanded.extend([l] * int(i))
+        if expanded:
+            exp = float(np.percentile(np.asarray(expanded, np.float64),
+                                      50.0, method="linear"))
+            assert wp is not None and abs(wp - exp) < 1e-9, (g, wp, exp)
+
+
+def test_percentile_array_percentages():
+    from spark_rapids_tpu.expressions import percentile
+
+    def q(s):
+        return _df(s).group_by("g").agg(
+            Alias(percentile(col("l"), [0.25, 0.5, 0.75]), "ps"))
+    rows = assert_tpu_cpu_equal(q)
+    for _g, ps in rows:
+        assert ps is None or (len(ps) == 3 and ps[0] <= ps[1] <= ps[2])
+
+
+def test_percentile_frequency_zero_and_null():
+    """freq 0 rows contribute nothing; null freq rows are skipped."""
+    import numpy as np
+
+    from spark_rapids_tpu.expressions import percentile
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+
+    schema = Schema.of(v=T.DOUBLE, f=T.LONG)
+    b = ColumnarBatch.from_pydict(
+        {"v": [1.0, 2.0, 3.0, 4.0, 100.0, 200.0],
+         "f": [1, 0, 2, 1, None, 0]}, schema)
+
+    def q(s):
+        df = s.create_dataframe([ColumnarBatch.from_pydict(
+            {"v": [1.0, 2.0, 3.0, 4.0, 100.0, 200.0],
+             "f": [1, 0, 2, 1, None, 0]}, schema)], num_partitions=1)
+        return df.agg(Alias(percentile(col("v"), 0.5, col("f")), "p"))
+    rows = assert_tpu_cpu_equal(q)
+    # expanded: [1, 3, 3, 4] -> median 3.0
+    assert abs(rows[0][0] - 3.0) < 1e-12, rows

@@ -150,3 +150,33 @@ def test_grouping_id_in_expression():
     with _pytest.raises(NotImplementedError):
         df.rollup("k").agg(
             Alias(count() + grouping_id(), "bad")).collect()
+
+
+def test_persist_parquet_serializer():
+    """ParquetCachedBatchSerializer analog: .persist(serializer='parquet')
+    round-trips through compressed in-memory parquet on both engines."""
+    from tests.test_queries import assert_tpu_cpu_equal
+
+    def q(s):
+        df = s.create_dataframe(
+            {"k": [i % 3 for i in range(50)],
+             "v": [float(i) for i in range(50)],
+             "name": [f"n{i % 7}" for i in range(50)]},
+            Schema.of(k=T.INT, v=T.DOUBLE, name=T.STRING),
+            num_partitions=2)
+        cached = df.persist(serializer="parquet")
+        return cached.group_by("k").agg(
+            Alias(sum_("v"), "sv"), Alias(count(), "n"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_persist_parquet_smaller_than_device():
+    from spark_rapids_tpu.plan import logical as L
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = s.create_dataframe(
+        {"v": [1.0] * 10000}, Schema.of(v=T.DOUBLE), num_partitions=1)
+    cached = df.persist(serializer="parquet")
+    assert isinstance(cached.plan, L.CachedParquetRelation)
+    # constant column compresses far below the 80KB raw footprint
+    assert cached.plan.cached_bytes() < 20_000
+    assert cached.count() == 10000

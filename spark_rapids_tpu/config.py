@@ -232,6 +232,25 @@ DIAG_DUMP_DIR = conf("spark.rapids.diagnostics.dumpDir").doc(
     "config and recent trace ranges here.  Empty disables capture."
 ).string_conf("")
 
+PYTHON_WORKER_ENABLED = conf("spark.rapids.python.worker.enabled").doc(
+    "Run pandas/Arrow UDFs in separate reusable worker processes (the "
+    "GPU-aware PySpark worker analog, reference python/rapids/daemon.py): "
+    "crash isolation + per-worker memory rlimit; functions ship via "
+    "cloudpickle, data as Arrow IPC.  Off = in-process evaluation."
+).boolean_conf(False)
+
+PYTHON_WORKER_COUNT = conf(
+    "spark.rapids.python.concurrentPythonWorkers").doc(
+    "Size of the Python UDF worker pool (same key as the reference's "
+    "gate on concurrent Python workers)."
+).int_conf(2)
+
+PYTHON_WORKER_MEM = conf("spark.rapids.python.memory.maxBytes").doc(
+    "Address-space rlimit applied in each Python UDF worker before user "
+    "code runs (the memory.gpu.allocFraction analog for host memory; "
+    "0 = unlimited)."
+).bytes_conf(0)
+
 TEST_INJECT_RETRY_OOM = conf("spark.rapids.sql.test.injectRetryOOM").doc(
     "Fault injection: make the allocator throw synthetic retry OOMs "
     "(reference: RapidsConf.scala:3041-3083, used by the @inject_oom pytest "
@@ -422,6 +441,18 @@ class RapidsConf:
     @property
     def diag_dump_dir(self) -> str:
         return self.get(DIAG_DUMP_DIR) or ""
+
+    @property
+    def python_worker_enabled(self) -> bool:
+        return self.get(PYTHON_WORKER_ENABLED)
+
+    @property
+    def python_worker_count(self) -> int:
+        return self.get(PYTHON_WORKER_COUNT)
+
+    @property
+    def python_worker_mem(self) -> int:
+        return self.get(PYTHON_WORKER_MEM)
 
     @property
     def shuffle_writer_threads(self) -> int:

@@ -262,7 +262,9 @@ class GetMapValue(BinaryExpression):
         n = len(mv)
         dt = self.dtype
         valid = np.zeros((n,), np.bool_)
-        out = np.zeros((n,), dt.np_dtype)
+        obj = (dt.variable_width or isinstance(
+            dt, (T.ArrayType, T.MapType, T.StructType)))
+        out = np.zeros((n,), object if obj else dt.np_dtype)
         for i in range(n):
             if not (mm[i] and km[i]) or mv[i] is None:
                 continue

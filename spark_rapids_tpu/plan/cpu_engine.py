@@ -297,10 +297,12 @@ class CpuEngine:
         import pyarrow.parquet as pq
 
         from spark_rapids_tpu.columnar.arrow import arrow_to_batch
+        cols = list(plan.projection) if plan.projection else None
         out = []
         for part in plan.partitions:
             tables = [CpuTable.from_batch(
-                arrow_to_batch(pq.read_table(pa.BufferReader(blob))))
+                arrow_to_batch(pq.read_table(pa.BufferReader(blob),
+                                             columns=cols)))
                 for blob in part]
             out.append(CpuTable.concat(tables, plan.schema))
         return out or [CpuTable.empty(plan.schema)]

@@ -26,6 +26,12 @@ def cpu_table_to_batch(table) -> ColumnarBatch:
         if isinstance(dt, T.ArrayType):
             cols.append(DeviceColumn.from_arrays(
                 [v if m else None for v, m in zip(vals, valid)], dt))
+        elif isinstance(dt, T.MapType):
+            cols.append(DeviceColumn.from_maps(
+                [v if m else None for v, m in zip(vals, valid)], dt))
+        elif isinstance(dt, T.StructType):
+            cols.append(DeviceColumn.from_structs(
+                [v if m else None for v, m in zip(vals, valid)], dt))
         elif dt.variable_width:
             cols.append(DeviceColumn.from_strings(
                 list(vals), validity=valid, dtype=dt))

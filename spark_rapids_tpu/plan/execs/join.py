@@ -135,8 +135,11 @@ class _JoinKernel:
             import jax.numpy as jnp
 
             from spark_rapids_tpu.kernels.selection import (
-                OOB, gather_column, required_gather_bytes)
+                OOB, gather_column, required_gather_bytes_at)
             bc = dict(byte_caps)
+            # deterministic (input, path) order shared with the driver's
+            # retry loop (it zips requirements against the same sort)
+            pair_key_list = sorted(k[1] for k in bc if k[0] == "pair")
 
             def run(l: ColumnarBatch, r: ColumnarBatch, state):
                 cand_type = "inner" if self.left_key_idx else "cross"
@@ -151,15 +154,21 @@ class _JoinKernel:
                     for j, (side, o) in enumerate(self.cond_inputs):
                         c = (l if side == 0 else r).columns[o]
                         idx = li if side == 0 else ri
-                        if c.offsets is not None:
+                        caps_j = {p: bc[("pair", (jj, p))]
+                                  for jj, p in pair_key_list if jj == j}
+                        if caps_j:
                             cols.append(gather_column(
                                 c, idx, cnt, out_capacity=pair_capacity,
-                                out_byte_capacity=bc[("pair", j)]))
-                            pair_bytes.append(
-                                required_gather_bytes(c, idx, cnt))
+                                byte_caps=caps_j))
                         else:
                             cols.append(gather_column(
                                 c, idx, cnt, out_capacity=pair_capacity))
+                    for jj, p in pair_key_list:
+                        side, o = self.cond_inputs[jj]
+                        c = (l if side == 0 else r).columns[o]
+                        idx = li if side == 0 else ri
+                        pair_bytes.append(
+                            required_gather_bytes_at(c, p, idx, cnt))
                     pb = ColumnarBatch(tuple(cols), cnt, self.cond_schema)
                     cond = self.cond_remapped.eval(EvalContext(pb))
                     pass_mask = ((li != OOB) & (ri != OOB)
@@ -218,12 +227,18 @@ class _JoinKernel:
         return out
 
     def _pair_string_cols(self, l: ColumnarBatch, r: ColumnarBatch):
-        """condition-input index -> byte capacity for string inputs."""
+        """(condition-input index, nested path) -> plane capacity for
+        EVERY offsets plane of each condition input — top-level strings
+        and planes nested inside struct/map/array inputs (the same
+        per-plane capacity-retry discipline the payload gather uses;
+        unlocks conditions over nested columns)."""
+        from spark_rapids_tpu.kernels.selection import (
+            nested_offset_paths, path_plane_capacity)
         out = {}
         for j, (side, o) in enumerate(self.cond_inputs):
             c = (l if side == 0 else r).columns[o]
-            if c.offsets is not None:
-                out[j] = c.byte_capacity
+            for p in nested_offset_paths(c):
+                out[(j, p)] = path_plane_capacity(c, p)
         return out
 
     def _call_conditional(self, l: ColumnarBatch,
@@ -246,10 +261,20 @@ class _JoinKernel:
             pair_cap = rup(max(nl * max(nr, 1), 1))
         else:
             pair_cap = max(rup(max(nl, nr, 1)), rup(max(int(required), 1)))
+        # out_cap upper bounds are ANALYTIC, so the row capacities never
+        # ladder (one compiled program per batch shape even though the
+        # pass count is unknown pre-eval): the pair region holds at most
+        # pair_cap passing pairs, plus one row per unmatched row of each
+        # null-extending side.  Byte capacities (strings) may still
+        # retry — those requirements are only known post-gather.
         if self.join_type in ("left_semi", "left_anti", "existence"):
             out_cap = rup(max(nl, 1))
         elif self.join_type == "full":
-            out_cap = rup(max(nl + nr, 1))
+            out_cap = rup(max(pair_cap + nl + nr, 1))
+        elif self.join_type == "left":
+            out_cap = rup(max(pair_cap + nl, 1))
+        elif self.join_type == "right":
+            out_cap = rup(max(pair_cap + nr, 1))
         else:
             out_cap = pair_cap
         byte_caps = {("out", o): v

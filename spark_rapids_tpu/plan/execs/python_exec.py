@@ -17,10 +17,23 @@ from spark_rapids_tpu.plan.execs.base import TpuExec, timed
 
 class TpuMapBatchesExec(TpuExec):
     def __init__(self, fn, child: TpuExec, schema: Schema,
-                 whole_partition: bool = False):
+                 whole_partition: bool = False, worker_conf=None):
         super().__init__((child,), schema)
         self.fn = fn
         self.whole_partition = whole_partition
+        #: optional (pool size, mem limit): UDFs run out-of-process with
+        #: crash isolation + memory rlimit (python_worker.py).  The pool
+        #: is created LAZILY on first execution — planning/explain must
+        #: never spawn processes.
+        self.worker_conf = worker_conf
+
+    @property
+    def worker_pool(self):
+        if self.worker_conf is None:
+            return None
+        from spark_rapids_tpu.plan.execs.python_worker import (
+            PythonWorkerPool)
+        return PythonWorkerPool.shared(*self.worker_conf)
 
     def _input_batches(self, idx: int):
         if not self.whole_partition:
@@ -46,7 +59,10 @@ class TpuMapBatchesExec(TpuExec):
                 # (PythonWorkerSemaphore.scala analog)
                 sem.release_if_necessary()
                 try:
-                    result = self.fn(table)
+                    if self.worker_pool is not None:
+                        result = self.worker_pool.run(self.fn, table)
+                    else:
+                        result = self.fn(table)
                 finally:
                     sem.acquire_if_necessary()
                 out = arrow_to_batch(result)  # host Arrow -> device

@@ -101,9 +101,10 @@ class TpuCachedParquetScanExec(_PooledScanExec):
     device semaphore with prefetch overlap, like every other scan."""
 
     def __init__(self, partitions, schema: Schema,
-                 reader_threads: int = 2):
+                 projection=None, reader_threads: int = 2):
         super().__init__((), schema)
         self.partitions = partitions   # List[List[bytes]]
+        self.projection = list(projection) if projection else None
         self.reader_threads = reader_threads
 
     def num_partitions(self) -> int:
@@ -113,7 +114,8 @@ class TpuCachedParquetScanExec(_PooledScanExec):
         import pyarrow as pa
         import pyarrow.parquet as pq
         for blob in self.partitions[idx]:
-            yield pq.read_table(pa.BufferReader(blob))
+            yield pq.read_table(pa.BufferReader(blob),
+                                columns=self.projection)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         if idx >= len(self.partitions):

@@ -68,9 +68,18 @@ class CachedParquetRelation(LogicalPlan):
     columnar scan path on re-read.  Same trade here: ~10x smaller resident
     cache for a decode on each rescan."""
 
-    def __init__(self, partitions: Sequence[List[bytes]], schema: Schema):
+    def __init__(self, partitions: Sequence[List[bytes]], schema: Schema,
+                 projection=None):
         self.partitions = [list(p) for p in partitions]
-        self._schema = schema
+        self.full_schema = schema
+        self.projection = tuple(projection) if projection else None
+        if self.projection:
+            idx = [schema.index_of(n) for n in self.projection]
+            self._schema = Schema(
+                tuple(self.projection),
+                tuple(schema.dtypes[i] for i in idx))
+        else:
+            self._schema = schema
         self.children = ()
 
     @property

@@ -225,6 +225,10 @@ def _prune(plan: L.LogicalPlan, required: Set[str]) -> L.LogicalPlan:
         if isinstance(p, L.IcebergRelation):
             return L.IcebergRelation(p.table_path, p.snapshot, p.files,
                                      projection=keep, deletes=p.deletes)
+        if isinstance(p, L.CachedParquetRelation):
+            # parquet decode is columnar: prune at the blob reader
+            return L.CachedParquetRelation(p.partitions, p.full_schema,
+                                           projection=keep)
         # in-memory / delta: select on top (BoundReference re-pick is
         # zero-copy in the exec)
         return L.Project([Col(n) for n in keep], p)

@@ -167,6 +167,10 @@ from spark_rapids_tpu.expressions.map_hof import (
 # MapZipWith stays out: it evaluates through the CPU bridge
 _SUPPORTED_EXPRS |= {TransformValues, TransformKeys, MapFilter, ZipWith}
 
+from spark_rapids_tpu.expressions.zorder import RangeBucketId, ZOrderKey
+
+_SUPPORTED_EXPRS |= {RangeBucketId, ZOrderKey}
+
 from spark_rapids_tpu.expressions.hashing import (
     BloomFilterMightContain, Murmur3Hash, XxHash64)
 from spark_rapids_tpu.expressions.strings import GetJsonObject
@@ -605,20 +609,10 @@ class PlanMeta:
                 self.will_not_work(
                     f"keyless {p.join_type} join without a condition "
                     "(use cross join)")
-            # struct payloads with variable-width leaves are fine: nested
-            # gathers carry per-plane byte capacities through the join's
-            # capacity-retry loop (kernels/selection.py byte_caps)
-            if p.condition is not None:
-                for ref_dt in _leaf_ref_dtypes(p.condition):
-                    if isinstance(ref_dt, (T.ArrayType, T.StructType,
-                                           T.MapType)):
-                        # the conditional pair gather tracks byte-capacity
-                        # overflow for strings only; nested inputs could
-                        # silently truncate on repeated matches
-                        self.will_not_work(
-                            f"join condition over nested type {ref_dt!r} "
-                            "not supported yet")
-                        break
+            # nested payloads AND nested condition inputs are fine: the
+            # pair gather and the output gather both carry per-plane byte
+            # capacities through the join's capacity-retry loop
+            # (kernels/selection.py byte_caps; _pair_string_cols)
         if isinstance(p, L.Aggregate):
             for e in p.group_exprs:
                 if not _key_expr_ok(e):
@@ -716,7 +710,8 @@ class PlanMeta:
         if isinstance(p, L.CachedParquetRelation):
             from spark_rapids_tpu.plan.execs.scan import (
                 TpuCachedParquetScanExec)
-            return TpuCachedParquetScanExec(p.partitions, p.schema)
+            return TpuCachedParquetScanExec(p.partitions, p.schema,
+                                            projection=p.projection)
         if isinstance(p, L.ParquetRelation):
             return TpuParquetScanExec(
                 p.paths, p.schema, p.column_pruning,
@@ -797,9 +792,13 @@ class PlanMeta:
         if isinstance(p, L.MapBatches):
             from spark_rapids_tpu.plan.execs.python_exec import (
                 TpuMapBatchesExec)
+            wconf = ((self.conf.python_worker_count,
+                      self.conf.python_worker_mem)
+                     if self.conf.python_worker_enabled else None)
             return TpuMapBatchesExec(p.fn, self.children[0].convert(),
                                      p.schema,
-                                     whole_partition=p.whole_partition)
+                                     whole_partition=p.whole_partition,
+                                     worker_conf=wconf)
         return self._fallback()
 
     def _tag_window(self, p: "L.Window") -> None:

@@ -77,3 +77,26 @@ def test_both_nested_payloads_full_join():
         r = right_df(s, n=30, nkeys=20)
         return l.join(r, on="k", how="full")
     assert_tpu_cpu_equal(q)
+
+
+def test_join_condition_over_struct_field():
+    """Residual conditions referencing struct fields — the pair gather
+    threads per-plane byte caps for nested condition inputs (planner
+    gate removed)."""
+    from spark_rapids_tpu.expressions import lit, struct_field
+
+    def q(s):
+        return left_df(s).join(
+            right_df(s).select(col("k")), on=([col("k")], [col("k")]), how="inner",
+            condition=struct_field(col("sv"), "score") > lit(0))
+    assert_tpu_cpu_equal(q)
+
+
+def test_join_condition_over_map_value():
+    from spark_rapids_tpu.expressions import lit, map_value
+
+    def q(s):
+        return left_df(s).select(col("k")).join(
+            right_df(s), on=([col("k")], [col("k")]), how="left",
+            condition=map_value(col("m"), lit("key0")) == lit("v0"))
+    assert_tpu_cpu_equal(q)

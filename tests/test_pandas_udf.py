@@ -98,3 +98,88 @@ def test_apply_in_pandas_expanding():
     assert_tpu_cpu_equal(
         lambda sess: src(sess).group_by(col("k"))
         .apply_in_pandas(top2, out_schema))
+
+
+# ---------------------------------------------------------------------------
+# out-of-process Python workers (python/rapids daemon analog)
+
+
+def _worker_session():
+    return TpuSession({"spark.rapids.sql.enabled": "true",
+                       "spark.rapids.python.worker.enabled": "true",
+                       "spark.rapids.python.concurrentPythonWorkers": "2"})
+
+
+def test_worker_runs_out_of_process():
+    import os
+    s = _worker_session()
+    df = src(s)
+
+    def tag_pid(table):
+        import os as _os
+        import pyarrow as pa
+        return table.append_column(
+            "pid", pa.array([_os.getpid()] * table.num_rows, pa.int64()))
+    out_schema = Schema.of(k=T.INT, v=T.LONG, x=T.DOUBLE, pid=T.LONG)
+    rows = df.map_batches(tag_pid, out_schema).collect()
+    pids = {r[3] for r in rows}
+    assert pids and os.getpid() not in pids, \
+        "UDF must run in a separate worker process"
+
+
+def test_worker_lambda_ships_via_cloudpickle():
+    s = _worker_session()
+    df = src(s)
+    factor = 7
+    rows = df.map_in_pandas(
+        lambda pdf: pdf.assign(v=pdf["v"] * factor), SCHEMA).collect()
+    base = src(TpuSession({"spark.rapids.sql.enabled": "true"})).collect()
+    def key(t):
+        return (t[0], t[1] is None, t[1] if t[1] is not None else 0)
+    got = sorted(((r[0], r[1]) for r in rows), key=key)
+    exp = sorted(((r[0], None if r[1] is None else r[1] * factor)
+                  for r in base), key=key)
+    assert got == exp
+
+
+def test_worker_udf_error_surfaces_cleanly():
+    s = _worker_session()
+    df = src(s)
+
+    def boom(table):
+        raise ValueError("intentional UDF failure")
+    with pytest.raises(RuntimeError, match="intentional UDF failure"):
+        df.map_batches(boom, SCHEMA).collect()
+    # the pool survives: a next query still works
+    assert len(df.map_batches(lambda t: t, SCHEMA).collect()) == 300
+
+
+def test_worker_crash_is_isolated():
+    """A hard worker death (os._exit) fails the task but not the engine,
+    and the pool respawns for the next query."""
+    s = _worker_session()
+    df = src(s)
+
+    def die(table):
+        import os as _os
+        _os._exit(42)
+    with pytest.raises(RuntimeError, match="python worker died"):
+        df.map_batches(die, SCHEMA).collect()
+    assert len(df.map_batches(lambda t: t, SCHEMA).collect()) == 300
+
+
+def test_worker_memory_limit_enforced():
+    """An allocation beyond the rlimit MemoryErrors inside the worker —
+    reported as a task failure, engine intact (the allocFraction bound)."""
+    s = TpuSession({"spark.rapids.sql.enabled": "true",
+                    "spark.rapids.python.worker.enabled": "true",
+                    "spark.rapids.python.concurrentPythonWorkers": "1",
+                    "spark.rapids.python.memory.maxBytes": "536870912"})
+    df = src(s)
+
+    def hog(table):
+        big = bytearray(2 << 30)   # 2 GiB > 512 MiB rlimit
+        return table
+    with pytest.raises(RuntimeError,
+                       match="MemoryError|python worker died"):
+        df.map_batches(hog, SCHEMA).collect()

@@ -232,6 +232,16 @@ DIAG_DUMP_DIR = conf("spark.rapids.diagnostics.dumpDir").doc(
     "config and recent trace ranges here.  Empty disables capture."
 ).string_conf("")
 
+MEMORY_LEAK_AUDIT = conf("spark.rapids.memory.debug.leakAudit").doc(
+    "Track every spillable handle's creation stack and expose "
+    "SpillFramework.assert_no_leaks() / leaked_handles(); unclosed "
+    "handles also warn at interpreter exit.  The reference's leak "
+    "tracking analog (cuDF MemoryCleaner refcount discipline, "
+    "docs/dev/mem_debug.md; spark.rapids.memory.gpu.debug "
+    "RapidsConf.scala:393).  Debug-only: stack capture costs ~us per "
+    "handle."
+).boolean_conf(False)
+
 PYTHON_WORKER_ENABLED = conf("spark.rapids.python.worker.enabled").doc(
     "Run pandas/Arrow UDFs in separate reusable worker processes (the "
     "GPU-aware PySpark worker analog, reference python/rapids/daemon.py): "

@@ -41,6 +41,8 @@ def initialize_memory(conf) -> None:
     _retry.MAX_RETRIES = conf.retry_max_attempts
     _sem.configure(conf.concurrent_tpu_tasks)
     spill_framework().host_limit_bytes = conf.get(C.HOST_SPILL_STORAGE_SIZE)
+    from spark_rapids_tpu.memory.spill import set_leak_audit
+    set_leak_audit(conf.get(C.MEMORY_LEAK_AUDIT))
     device_arena().check_retry_context = conf.retry_context_check
     # HBM-budget sizing from the chip's memory stats (GpuDeviceManager):
     # always on, like the reference's default-fraction pool sizing —

@@ -111,6 +111,10 @@ class SpillableBatchHandle:
         self.size_bytes = batch.device_size_bytes()
         self.closed = False
         self._pins = 0
+        self.creation_site: Optional[str] = None
+        if _leak_audit_enabled():
+            import traceback
+            self.creation_site = "".join(traceback.format_stack(limit=14))
         device_arena().reserve(self.size_bytes)
         framework._register(self)
 
@@ -344,3 +348,61 @@ def spill_framework() -> SpillFramework:
 
 def make_spillable(batch: ColumnarBatch, priority: int = 0) -> SpillableBatchHandle:
     return SpillableBatchHandle(batch, spill_framework(), priority=priority)
+
+
+# -- leak audit (reference: cuDF MemoryCleaner refcount discipline /
+#    spark.rapids.memory.gpu.debug, docs/dev/mem_debug.md) ------------------
+
+_LEAK_AUDIT = [False]
+
+
+def _leak_audit_enabled() -> bool:
+    return _LEAK_AUDIT[0]
+
+
+def set_leak_audit(enabled: bool) -> None:
+    """Toggle creation-stack capture on new handles (conf
+    spark.rapids.memory.debug.leakAudit; memory.initialize_memory)."""
+    _LEAK_AUDIT[0] = bool(enabled)
+    if enabled and not getattr(set_leak_audit, "_atexit", False):
+        import atexit
+
+        def _warn_at_exit():
+            if not _leak_audit_enabled():
+                return      # audit was turned off again before exit
+            leaks = spill_framework().leaked_handles()
+            if leaks:
+                import sys
+                print(f"[spark-rapids-tpu] LEAK AUDIT: {len(leaks)} "
+                      "spillable handle(s) never closed:", file=sys.stderr)
+                for h in leaks[:10]:
+                    site = h.creation_site or "(enable leakAudit before "\
+                        "creation for stacks)"
+                    print(f"  - {h.size_bytes} bytes\n{site}",
+                          file=sys.stderr)
+        atexit.register(_warn_at_exit)
+        set_leak_audit._atexit = True
+
+
+def _fw_leaked_handles(self) -> list:
+    """Open (never-closed) handles currently registered."""
+    return [h for h in self._snapshot() if not h.closed]
+
+
+def _fw_assert_no_leaks(self, context: str = "") -> None:
+    """Raise when any handle remains open, listing creation sites (the
+    post-query/test assertion surface of the audit)."""
+    leaks = self.leaked_handles()
+    if not leaks:
+        return
+    lines = [f"{len(leaks)} spillable handle(s) leaked"
+             + (f" after {context}" if context else "") + ":"]
+    for h in leaks[:10]:
+        lines.append(f"  - {h.size_bytes} bytes, pins={h._pins}")
+        if h.creation_site:
+            lines.append(h.creation_site)
+    raise AssertionError("\n".join(lines))
+
+
+SpillFramework.leaked_handles = _fw_leaked_handles
+SpillFramework.assert_no_leaks = _fw_assert_no_leaks

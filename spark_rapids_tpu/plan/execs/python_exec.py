@@ -24,16 +24,19 @@ class TpuMapBatchesExec(TpuExec):
         #: optional (pool size, mem limit): UDFs run out-of-process with
         #: crash isolation + memory rlimit (python_worker.py).  The pool
         #: is created LAZILY on first execution — planning/explain must
-        #: never spawn processes.
+        #: never spawn processes — then cached on the exec.
         self.worker_conf = worker_conf
+        self._pool = None
 
     @property
     def worker_pool(self):
         if self.worker_conf is None:
             return None
-        from spark_rapids_tpu.plan.execs.python_worker import (
-            PythonWorkerPool)
-        return PythonWorkerPool.shared(*self.worker_conf)
+        if self._pool is None:
+            from spark_rapids_tpu.plan.execs.python_worker import (
+                PythonWorkerPool)
+            self._pool = PythonWorkerPool.shared(*self.worker_conf)
+        return self._pool
 
     def _input_batches(self, idx: int):
         if not self.whole_partition:

@@ -23,9 +23,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
-import struct
 import threading
-from typing import Optional, Tuple
 
 
 def _send(conn, *parts: bytes) -> None:
@@ -191,6 +189,15 @@ class PythonWorkerPool:
                     f"{getattr(fn, '__name__', 'fn')} — the engine "
                     "survives; rerun or raise "
                     "spark.rapids.python.memory.maxBytes")
+            except BaseException:
+                # interrupted mid-protocol (KeyboardInterrupt while
+                # blocked, MemoryError on a huge payload): the pipe may
+                # hold a half-read reply — NEVER return a desynced worker
+                # to the pool, its stale reply would become the NEXT
+                # task's result.  Replace it.
+                w.close()
+                w = _Worker(self.mem_limit_bytes)
+                raise
             if status == b"err":
                 raise RuntimeError(
                     "python worker UDF failed:\n"

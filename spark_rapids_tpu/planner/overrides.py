@@ -72,16 +72,6 @@ _NON_GROWING_STRING_EXPRS = {
 }
 
 
-def _leaf_ref_dtypes(e) -> List[T.DataType]:
-    """dtypes of every column reference in an expression tree."""
-    out = []
-    if isinstance(e, E.BoundReference):
-        out.append(e.dtype)
-    for c in e.children:
-        out.extend(_leaf_ref_dtypes(c))
-    return out
-
-
 def _regex_child_ok(e) -> bool:
     """Only STRING-typed subtrees feed bytes into a regex/byte-window
     kernel, so only they must be non-growing; non-string children (an If

@@ -278,3 +278,65 @@ def test_non_oom_exceptions_propagate_unchanged():
 
     with pytest.raises(ValueError):
         with_retry([None], fn)
+
+
+def test_leak_audit_tracks_and_asserts():
+    """spark.rapids.memory.debug.leakAudit: creation stacks + the
+    assert_no_leaks surface (the MemoryCleaner refcount-audit analog)."""
+    from spark_rapids_tpu.memory.spill import (
+        make_spillable, set_leak_audit, spill_framework)
+    fw = spill_framework()
+    baseline = len(fw.leaked_handles())
+    set_leak_audit(True)
+    try:
+        b = ColumnarBatch.from_pydict({"v": [1.0, 2.0]},
+                                      Schema.of(v=T.DOUBLE))
+        h = make_spillable(b)
+        assert h.creation_site is not None
+        assert "test_leak_audit_tracks_and_asserts" in h.creation_site
+        leaks = [x for x in fw.leaked_handles() if x is h]
+        assert leaks, "open handle must be reported"
+        # assert_no_leaks must raise while OUR handle is open, regardless
+        # of ambient fixtures (they only add to the leak list)
+        with pytest.raises(AssertionError, match="leaked"):
+            fw.assert_no_leaks("unit test")
+        h.close()
+        assert not [x for x in fw.leaked_handles() if x is h]
+    finally:
+        set_leak_audit(False)
+
+
+def test_leak_audit_off_by_default_no_stack_capture():
+    from spark_rapids_tpu.memory.spill import make_spillable
+    b = ColumnarBatch.from_pydict({"v": [1.0]}, Schema.of(v=T.DOUBLE))
+    h = make_spillable(b)
+    try:
+        assert h.creation_site is None
+    finally:
+        h.close()
+
+
+def test_query_leaves_no_leaked_handles():
+    """End-to-end audit: a shuffle+agg query closes every handle it made."""
+    from spark_rapids_tpu.memory.spill import (
+        set_leak_audit, spill_framework)
+    from spark_rapids_tpu.expressions import col, count, sum_
+    from spark_rapids_tpu.expressions.core import Alias
+    fw = spill_framework()
+    before = set(id(h) for h in fw.leaked_handles())
+    set_leak_audit(True)
+    try:
+        from spark_rapids_tpu.api.session import TpuSession
+        s = TpuSession({"spark.rapids.sql.enabled": "true",
+                        "spark.rapids.memory.debug.leakAudit": "true"})
+        df = s.create_dataframe(
+            {"k": [i % 5 for i in range(200)],
+             "v": list(range(200))},
+            Schema.of(k=T.INT, v=T.LONG), num_partitions=2)
+        rows = df.group_by("k").agg(Alias(sum_(col("v")), "s"),
+                                    Alias(count(), "n")).collect()
+        assert len(rows) == 5
+        new = [h for h in fw.leaked_handles() if id(h) not in before]
+        assert not new, f"query leaked {len(new)} handles"
+    finally:
+        set_leak_audit(False)

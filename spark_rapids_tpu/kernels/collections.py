@@ -296,11 +296,17 @@ def segment_distinct(col: DeviceColumn, num_rows) -> DeviceColumn:
         # -0.0 -> 0.0 (an explicit select: XLA folds x+0.0 to x, which
         # would keep the sign bit)
         x = jnp.where(vkey == 0, jnp.zeros((), vkey.dtype), vkey)
-        uint = jnp.uint64 if x.dtype == jnp.float64 else jnp.uint32
-        bits = jax.lax.bitcast_convert_type(x, uint)
-        nan_bits = jax.lax.bitcast_convert_type(
-            jnp.array(jnp.nan, x.dtype), uint)
-        vkey = jnp.where(jnp.isnan(x), nan_bits, bits)
+        if x.dtype == jnp.float64:
+            from spark_rapids_tpu.kernels.sort import f64_injective_u64
+            bits = f64_injective_u64(x)
+            nan_key = f64_injective_u64(
+                jnp.array(jnp.nan, x.dtype).reshape(1))[0]
+            vkey = jnp.where(jnp.isnan(x), nan_key, bits)
+        else:
+            bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+            nan_bits = jax.lax.bitcast_convert_type(
+                jnp.array(jnp.nan, x.dtype), jnp.uint32)
+            vkey = jnp.where(jnp.isnan(x), nan_bits, bits)
     nullk = (~col.child_validity).astype(jnp.int32)
     rkey = jnp.where(live, rows, jnp.int32(col.capacity))
     perm = jnp.lexsort((within, vkey, nullk, rkey))

@@ -320,7 +320,10 @@ def segment_extreme128(hi, lo, valid, segment_ids, num_segments: int,
     Returns (hi, lo, any_valid) per segment.  Unlocks min/max(decimal128)
     aggregation (reference: cudf min/max via GpuMin/GpuMax,
     aggregate/aggregateFunctions.scala)."""
-    lou = jax.lax.bitcast_convert_type(lo.astype(I64), jnp.uint64)
+    # same-width int reinterpret: a wrapping CONVERT equals the bitcast
+    # and stays implementable under TPU's X64 emulation (a 64-bit
+    # bitcast-convert HLO is not)
+    lou = lo.astype(I64).astype(jnp.uint64)
     if is_min:
         ident_h = jnp.int64(0x7FFFFFFFFFFFFFFF)
         ident_l = jnp.uint64(0xFFFFFFFFFFFFFFFF)

@@ -59,8 +59,11 @@ def _rows_equal_prev(col: DeviceColumn) -> jax.Array:
             kid_eq = kid_eq & _rows_equal_prev(c)
         return same_null & (kid_eq | ~both_valid)
     if isinstance(col.dtype, (T.FloatType, T.DoubleType)):
-        w = jnp.uint64 if col.data.dtype == jnp.float64 else jnp.uint32
-        bits = jax.lax.bitcast_convert_type(col.data, w)
+        if col.data.dtype == jnp.float64:
+            from spark_rapids_tpu.kernels.sort import f64_injective_u64
+            bits = f64_injective_u64(col.data)
+        else:
+            bits = jax.lax.bitcast_convert_type(col.data, jnp.uint32)
         eq = bits == jnp.roll(bits, 1)
     else:
         eq = col.data == jnp.roll(col.data, 1)

@@ -85,8 +85,14 @@ def _f32_bits(x):
 
 
 def _f64_bits(x):
+    """Double bits for hashing.  On TPU the injective split-pack stands
+    in for the impossible f64->u64 bitcast (kernels/sort.py
+    f64_injective_u64): self-consistent partitioning/grouping on chip,
+    but double-key hashes DIVERGE from Spark's doubleToLongBits-based
+    values there (differential tests run on CPU's exact path)."""
+    from spark_rapids_tpu.kernels.sort import f64_injective_u64
     x = jnp.where(x == jnp.float64(0.0), jnp.float64(0.0), x)
-    bits = jax.lax.bitcast_convert_type(x, jnp.uint64)
+    bits = f64_injective_u64(x)
     return jnp.where(jnp.isnan(x), jnp.uint64(0x7FF8000000000000), bits)
 
 

@@ -42,16 +42,55 @@ class SortOrder:
                 f"NULLS {'FIRST' if self.nulls_first else 'LAST'}")
 
 
+def _f32_total_order_bits(x: jax.Array) -> jax.Array:
+    """float32 -> uint32 preserving Java Float.compare total order."""
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    sign = jnp.uint32(1) << 31
+    return jnp.where((bits & sign) != 0, ~bits, bits | sign)
+
+
+def f64_total_order_u64(x: jax.Array) -> jax.Array:
+    """float64 -> uint64 total-order key (-0.0 < 0.0, NaN above +Inf).
+
+    TPU has no native float64: X64 values are emulated (float32 pairs)
+    and the X64-rewrite pass cannot implement a f64->u64 bitcast (raw
+    IEEE-754 double bits do not exist on chip).  There the key is built
+    from the double-double split — hi = f32(x), lo = f32(x - hi) — each
+    totalized through the SUPPORTED f32->u32 bitcast and packed with u64
+    arithmetic (which IS emulated).  The split is lossless for every
+    value representable under the emulation, so ordering matches; on
+    CPU/GPU the exact bitcast path keeps true f64 tie-breaking."""
+    if jax.default_backend() == "tpu":
+        hi = x.astype(jnp.float32)
+        lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
+        hk = _f32_total_order_bits(hi).astype(jnp.uint64)
+        lk = _f32_total_order_bits(lo).astype(jnp.uint64)
+        return (hk << jnp.uint64(32)) | lk
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint64)
+    sign = jnp.uint64(1) << 63
+    return jnp.where((bits & sign) != 0, ~bits, bits | sign)
+
+
+def f64_injective_u64(x: jax.Array) -> jax.Array:
+    """float64 -> uint64 INJECTIVE bit key (equality/identity uses, not
+    ordering).  Raw IEEE bits on CPU/GPU; the double-double split's f32
+    bit patterns packed with u64 arithmetic on TPU (see
+    f64_total_order_u64 for why the direct bitcast cannot exist there)."""
+    if jax.default_backend() == "tpu":
+        hi = x.astype(jnp.float32)
+        lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
+        return (jax.lax.bitcast_convert_type(hi, jnp.uint32)
+                .astype(jnp.uint64) << jnp.uint64(32)) | \
+            jax.lax.bitcast_convert_type(lo, jnp.uint32).astype(jnp.uint64)
+    return jax.lax.bitcast_convert_type(x, jnp.uint64)
+
+
 def _float_total_order_bits(x: jax.Array) -> jax.Array:
     """Map float32/float64 to same-width uint preserving Java's
     Float/Double.compare total order (-0.0 < 0.0, NaN above +Inf)."""
     if x.dtype == jnp.float64:
-        bits = jax.lax.bitcast_convert_type(x, jnp.uint64)
-        sign = jnp.uint64(1) << 63
-        return jnp.where((bits & sign) != 0, ~bits, bits | sign)
-    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
-    sign = jnp.uint32(1) << 31
-    return jnp.where((bits & sign) != 0, ~bits, bits | sign)
+        return f64_total_order_u64(x)
+    return _f32_total_order_bits(x)
 
 
 def _signed_to_unsigned(x: jax.Array) -> jax.Array:

@@ -33,11 +33,10 @@ DEFAULT_DELTA = 100
 
 
 def _orderable_f64(x: jax.Array) -> jax.Array:
-    """float64 -> uint64 monotone sort key (sign-flip trick)."""
-    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.int64)
-    flipped = jnp.where(bits < 0, ~bits,
-                        bits | jnp.int64(-0x8000000000000000))
-    return jax.lax.bitcast_convert_type(flipped, jnp.uint64)
+    """float64 -> uint64 monotone sort key (TPU-safe: no f64 bitcast —
+    kernels/sort.py f64_total_order_u64)."""
+    from spark_rapids_tpu.kernels.sort import f64_total_order_u64
+    return f64_total_order_u64(x.astype(jnp.float64))
 
 
 def _cluster_of(q: jax.Array, delta: int) -> jax.Array:

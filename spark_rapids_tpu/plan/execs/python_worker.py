@@ -24,6 +24,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import threading
+from typing import Optional
 
 
 def _send(conn, *parts: bytes) -> None:
@@ -152,28 +153,39 @@ class PythonWorkerPool:
         with self._cv:
             while not self._free:
                 self._cv.wait()
-            return self._free.pop()
+            w = self._free.pop()
+        if w is None:
+            # lazy revival of a slot whose worker died/desynced: spawn
+            # OUTSIDE the condition lock (other borrows stay unblocked),
+            # and never during exception unwinding
+            w = _Worker(self.mem_limit_bytes)
+        return w
 
-    def _give_back(self, w: _Worker) -> None:
+    def _give_back(self, w: Optional[_Worker]) -> None:
+        """None = the slot's worker was retired; _borrow revives it."""
         with self._cv:
             self._free.append(w)
             self._cv.notify()
 
     def run(self, fn, arrow_table):
         """Apply fn to one Arrow table in a worker; returns the result
-        table.  A dead worker (hard crash / rlimit kill) is respawned
-        and the task gets a RuntimeError instead of a dead engine."""
+        table.  A dead worker (hard crash / rlimit kill) retires its
+        slot — revived lazily on the next borrow — and the task gets a
+        RuntimeError instead of a dead engine."""
         import io
 
         import cloudpickle
         import pyarrow as pa
+        # serialize BEFORE borrowing: an unpicklable UDF must fail
+        # without touching (or retiring) any worker
+        fn_bytes = cloudpickle.dumps(fn)
         sink = io.BytesIO()
         with pa.ipc.new_stream(sink, arrow_table.schema) as wtr:
             wtr.write_table(arrow_table)
         w = self._borrow()
         try:
             try:
-                _send(w.conn, cloudpickle.dumps(fn), sink.getvalue())
+                _send(w.conn, fn_bytes, sink.getvalue())
                 status = w.conn.recv_bytes()
                 payload = w.conn.recv_bytes()
             except (EOFError, BrokenPipeError, ConnectionResetError,
@@ -183,7 +195,7 @@ class PythonWorkerPool:
                     w.proc.join(timeout=1)
                     code = w.proc.exitcode
                 w.close()
-                w = _Worker(self.mem_limit_bytes)   # respawn for next task
+                w = None                      # retire the slot
                 raise RuntimeError(
                     f"python worker died (exit code {code}) while running "
                     f"{getattr(fn, '__name__', 'fn')} — the engine "
@@ -194,9 +206,9 @@ class PythonWorkerPool:
                 # blocked, MemoryError on a huge payload): the pipe may
                 # hold a half-read reply — NEVER return a desynced worker
                 # to the pool, its stale reply would become the NEXT
-                # task's result.  Replace it.
+                # task's result.  Retire the slot.
                 w.close()
-                w = _Worker(self.mem_limit_bytes)
+                w = None
                 raise
             if status == b"err":
                 raise RuntimeError(
@@ -210,5 +222,6 @@ class PythonWorkerPool:
     def close(self) -> None:
         with self._cv:
             for w in self._free:
-                w.close()
+                if w is not None:
+                    w.close()
             self._free = []

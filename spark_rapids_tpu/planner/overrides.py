@@ -415,6 +415,27 @@ class ExprMeta:
                             "BloomFilterImpl putLong semantics)")
                 except (TypeError, ValueError, NotImplementedError):
                     pass
+            if isinstance(e, (Murmur3Hash, XxHash64, HiveHash)):
+                # USER-VISIBLE hash values must equal Apache Spark's.
+                # TPU has no raw IEEE double bits (f64 is emulated), so
+                # double inputs hash via the split-pack stand-in there —
+                # self-consistent for internal partitioning but NOT
+                # doubleToLongBits; route such expressions to the CPU
+                # bridge instead of silently diverging.
+                import jax as _jax
+                if _jax.default_backend() == "tpu":
+                    for c in e.children:
+                        try:
+                            if isinstance(c.dtype, T.DoubleType):
+                                self.will_not_work(
+                                    f"{type(e).__name__} over double "
+                                    f"input {c!r}: no raw float64 bits "
+                                    "on TPU (doubleToLongBits parity "
+                                    "needs the CPU bridge)")
+                                break
+                        except (TypeError, ValueError,
+                                NotImplementedError):
+                            pass
             if isinstance(e, (Murmur3Hash, XxHash64)):
                 for c in e.children:
                     try:

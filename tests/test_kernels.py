@@ -346,3 +346,36 @@ def test_groupby_min_max_nan_spark_semantics():
     assert math.isnan(got[1][1])      # max is NaN (NaN greatest)
     assert math.isnan(got[2][0]) and math.isnan(got[2][1])  # all-NaN group
     assert got[3] == (5.0, 5.0)
+
+
+def test_f64_tpu_split_key_order_and_injectivity(monkeypatch):
+    """The TPU double-double sort key (no f64 bitcast exists on chip)
+    must order like the exact-bits key for every value REPRESENTABLE
+    under the f32-pair emulation, and stay injective on them."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from spark_rapids_tpu.kernels import sort as SK
+
+    vals = np.array(
+        [float("-inf"), -1e30, -3.5, -1.0000001, -1.0, -0.0, 0.0,
+         1e-38, 1.0, 1.5, 2.0 ** 20 + 0.25, 1e30, float("inf"),
+         float("nan")], np.float64)
+    # exact path (CPU backend default)
+    exact = np.asarray(SK.f64_total_order_u64(jnp.asarray(vals)))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    split = np.asarray(SK.f64_total_order_u64(jnp.asarray(vals)))
+    # same relative order
+    assert list(np.argsort(exact, kind="stable")) == \
+        list(np.argsort(split, kind="stable"))
+    # near-injective: at most one sub-f32-resolution tie among these
+    # values (the split loses residuals below the f32 denormal floor —
+    # exactly the values the f32-pair emulation cannot hold either)
+    finite = split[:-1]
+    assert len(np.unique(finite)) >= len(finite) - 1
+    # -0.0 < 0.0 must hold in BOTH encodings
+    i_neg0, i_pos0 = 5, 6
+    assert exact[i_neg0] < exact[i_pos0]
+    assert split[i_neg0] < split[i_pos0]
+    # NaN above +inf
+    assert split[-1] > split[-2]

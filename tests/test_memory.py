@@ -286,7 +286,6 @@ def test_leak_audit_tracks_and_asserts():
     from spark_rapids_tpu.memory.spill import (
         make_spillable, set_leak_audit, spill_framework)
     fw = spill_framework()
-    baseline = len(fw.leaked_handles())
     set_leak_audit(True)
     try:
         b = ColumnarBatch.from_pydict({"v": [1.0, 2.0]},

@@ -183,10 +183,13 @@ def _spawn(backend: str, mode: str, timeout_s: int,
     env[CHILD_ENV] = f"{backend}:{mode}@{os.getpid()}"
     if backend == "tpu" and mode != "probe":
         # persistent XLA cache across bench runs: TPU compiles are 20-40s
-        # each.  (Cache write crashes are a known jaxlib hazard — see
+        # each over the tunnel.  In-repo (gitignored) so the round-end
+        # driver run reuses programs compiled during the session.
+        # (Cache write crashes are a known jaxlib hazard — see
         # spark_rapids_tpu/__init__.py — hence opt-in by env var.)
         env.setdefault("SPARK_RAPIDS_TPU_COMPILE_CACHE",
-                       os.path.expanduser("~/.cache/spark_rapids_tpu_xla"))
+                       os.path.join(os.path.dirname(
+                           os.path.abspath(__file__)), ".jax_cache"))
     if extra_env:
         env.update(extra_env)
     try:

@@ -194,12 +194,17 @@ class TpuClusterDriver:
         if len(got) != world:
             raise TimeoutError(
                 f"query {qid}: {len(got)}/{world} executor results")
-        rows: list = []
+        # results arrive PARTITION-TAGGED: reassemble partition-major so
+        # ordered outputs (range sorts) concatenate into the global order
+        tagged: List[tuple] = []
         for eid in executors:
             r = got[eid]
             if isinstance(r, str):
                 raise RuntimeError(f"executor {eid} failed: {r}")
-            rows.extend(r)
+            tagged.extend(r)
+        rows: list = []
+        for _p, part_rows in sorted(tagged, key=lambda t: t[0]):
+            rows.extend(part_rows)
         return rows
 
     def close(self) -> None:

@@ -115,14 +115,13 @@ def _check_distributable(physical) -> None:
     follow-on)."""
     from spark_rapids_tpu.plan.execs.exchange import TpuSinglePartitionExec
     from spark_rapids_tpu.plan.execs.join import TpuAdaptiveJoinExec
-    from spark_rapids_tpu.plan.execs.range_sort import TpuRangeSortExec
 
     def walk(n):
-        if isinstance(n, (TpuSinglePartitionExec, TpuRangeSortExec)):
+        if isinstance(n, TpuSinglePartitionExec):
             raise NotImplementedError(
                 f"cluster v1 cannot distribute {type(n).__name__} (global "
-                "single-partition / sampled stages): rewrite with a "
-                "grouped aggregation or collect-and-sort on the driver")
+                "single-partition gather stages): rewrite with a grouped "
+                "aggregation or collect-and-sort on the driver")
         if isinstance(n, TpuAdaptiveJoinExec):
             raise NotImplementedError(
                 "cluster planning must not produce adaptive joins (the "
@@ -152,18 +151,50 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict) -> list:
     physical, _meta = plan_query(logical, conf)
     if world > 1:
         _check_distributable(physical)
+        # global sorts distribute via the cross-rank range exchange
+        # (range_sort.py ClusterRangeSortMixin): boundaries agreed from
+        # an exchanged sample, partition p owned by rank p % world
+        from spark_rapids_tpu.plan.execs.range_sort import TpuRangeSortExec
+
+        def _configure(n):
+            if isinstance(n, TpuRangeSortExec):
+                n.cluster = (rank, world)
+            for c in n.children:
+                _configure(c)
+        _configure(physical)
         if not physical.children:
             physical = _RankFilteredScan(physical, rank, world)
         else:
             _wrap_scans(physical, rank, world)
-    rows: list = []
+        # every rank must run every MAP side even when it owns zero
+        # output/reduce partitions (world > n_out): peers' completeness
+        # waits count this rank as a declared participant.  Post-order =
+        # pipeline order, so transport construction (and therefore the
+        # deterministic shuffle-id sequence) is identical on every rank.
+        from spark_rapids_tpu.plan.execs.exchange import (
+            TpuShuffleExchangeExec)
+
+        def _map_sides(n):
+            for c in n.children:
+                _map_sides(c)
+            if isinstance(n, TpuShuffleExchangeExec):
+                n._materialize()
+            elif isinstance(n, TpuRangeSortExec):
+                n.ensure_cluster_mapside()
+        _map_sides(physical)
+    # results are PARTITION-TAGGED so the driver can reassemble
+    # partition-major — the concatenation across ranks of a range sort's
+    # partitions in partition order IS the global order
+    parts: list = []
     try:
         n_out = physical.num_partitions()
         for p in range(n_out):
             if p % world != rank:
                 continue
+            rows_p: list = []
             for batch in physical.execute_partition(p):
-                rows.extend(CpuTable.from_batch(batch).rows())
+                rows_p.extend(CpuTable.from_batch(batch).rows())
+            parts.append((p, rows_p))
     except Exception:
         physical.cleanup()
         raise
@@ -175,7 +206,7 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict) -> list:
     # shuffle files until the driver's ShuffleCleanupManager says drop,
     # Plugin.scala:497-521).  The worker loop disposes it before the next
     # task, when the driver has necessarily collected every rank.
-    return rows, physical
+    return parts, physical
 
 
 def executor_main(driver_rpc_addr: Tuple[str, int],

@@ -157,8 +157,14 @@ class PythonWorkerPool:
         if w is None:
             # lazy revival of a slot whose worker died/desynced: spawn
             # OUTSIDE the condition lock (other borrows stay unblocked),
-            # and never during exception unwinding
-            w = _Worker(self.mem_limit_bytes)
+            # and never during exception unwinding.  A failed spawn must
+            # return the token — losing it would shrink the pool until
+            # every caller blocks forever.
+            try:
+                w = _Worker(self.mem_limit_bytes)
+            except BaseException:
+                self._give_back(None)
+                raise
         return w
 
     def _give_back(self, w: Optional[_Worker]) -> None:

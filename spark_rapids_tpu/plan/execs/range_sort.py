@@ -124,12 +124,16 @@ def make_router(orders, schema: Schema, n_out: int):
 
 
 def sample_boundaries(batches: List[ColumnarBatch], orders, encoder,
-                      n_out: int):
+                      n_out: int, bucket: Optional[int] = None):
     """Sample encoded keys from every batch and pick n_out-1 splitters.
-    Returns (string_bucket, boundaries tuple)."""
-    bucket = 0
-    for b in batches:
-        bucket = max(bucket, string_key_bucket(b, [e for e, _ in orders]))
+    Returns (string_bucket, boundaries tuple).  ``bucket`` overrides the
+    sample-derived string bucket (the cluster path must encode with the
+    globally agreed DATA-wide bucket, not the local samples')."""
+    if bucket is None:
+        bucket = 0
+        for b in batches:
+            bucket = max(bucket,
+                         string_key_bucket(b, [e for e, _ in orders]))
     samples: List[np.ndarray] = []
     n_keys = None
     for b in batches:
@@ -188,6 +192,23 @@ class TpuRangeSortExec(TpuExec):
         self._lock = threading.Lock()
         self._buckets: Optional[List[List[SpillableBatchHandle]]] = None
         self._local_sort = TpuSortExec(self.orders, child)  # reuse its jit
+        #: (rank, world) when distributed — set by the cluster executor;
+        #: switches materialization to the cross-rank exchange path
+        self.cluster: Optional[Tuple[int, int]] = None
+        self._cluster_transport = None
+        self._cluster_sample_transport = None
+
+    def ensure_cluster_mapside(self) -> None:
+        """Run the cross-rank map side (sample publish + routed shard
+        writes) NOW.  Every rank must do this even when it owns zero
+        output partitions (world > out_partitions): peers' completeness
+        waits count this rank as a declared participant."""
+        if self.cluster is None:
+            return
+        with self._lock:
+            if self._cluster_transport is None:
+                self._cluster_transport = \
+                    self._materialize_cluster(*self.cluster)
 
     def num_partitions(self) -> int:
         return self.out_partitions
@@ -210,6 +231,23 @@ class TpuRangeSortExec(TpuExec):
             return buckets
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        if self.cluster is not None:
+            with self._lock:
+                if self._cluster_transport is None:
+                    self._cluster_transport = \
+                        self._materialize_cluster(*self.cluster)
+                transport = self._cluster_transport
+            with timed(self.op_time):
+                batches = transport.read(idx)
+            if not batches:
+                return
+            with timed(self.op_time):
+                merged = coalesce_to_one(batches)
+                out = with_retry_no_split(
+                    lambda: self._local_sort._run(merged))
+            self.output_rows.add(out.num_rows)
+            yield self._count_out(out)
+            return
         handles = self._materialize()[idx]
         if not handles:
             return
@@ -228,9 +266,138 @@ class TpuRangeSortExec(TpuExec):
                     for h in bucket:
                         h.close()
                 self._buckets = None
+            if self._cluster_transport is not None:
+                self._cluster_transport.cleanup()
+                self._cluster_transport = None
+            if self._cluster_sample_transport is not None:
+                self._cluster_sample_transport.cleanup()
+                self._cluster_sample_transport = None
         super().cleanup()
 
     def describe(self):
         inner = ", ".join(f"{e!r} {'ASC' if o.ascending else 'DESC'}"
                           for e, o in self.orders)
         return f"TpuRangeSort[{self.out_partitions}, {inner}]"
+
+
+# -- cluster (multi-rank) path ------------------------------------------------
+
+def _sample_value_batch(batches: List[ColumnarBatch], orders,
+                        local_bucket: int) -> Optional[ColumnarBatch]:
+    """Evaluate the sort-key expressions and gather a strided sample of
+    their VALUES into one small host-built batch (+ a constant column
+    carrying this rank's string-key bucket).  Raw values — not encoded
+    keys — cross the wire so every rank can re-encode the union with one
+    agreed bucket."""
+    names = tuple([f"k{i}" for i in range(len(orders))] + ["_bucket"])
+    from spark_rapids_tpu import types as _T
+    dtypes = tuple([e.dtype for e, _ in orders] + [_T.INT])
+    schema = Schema(names, dtypes)
+    data = {n: [] for n in names}
+    for b in batches:
+        ctx = EvalContext(b)
+        cols = [e.eval(ctx) for e, _ in orders]
+        n = b.host_num_rows()
+        if n == 0:
+            continue
+        stride = max(n // SAMPLE_PER_PARTITION, 1)
+        idx = list(range(0, n, stride))
+        col_lists = [c.to_pylist(n) for c in cols]
+        for i in idx:
+            for ci, n_ in enumerate(names[:-1]):
+                data[n_].append(col_lists[ci][i])
+            data["_bucket"].append(local_bucket)
+    if not data[names[0]]:
+        return None
+    return ColumnarBatch.from_pydict(data, schema)
+
+
+class ClusterRangeSortMixin:
+    """Cross-rank global sort: exchanged samples -> identical boundaries
+    on every rank -> range exchange over the TCP block plane -> each
+    OWNER rank (p % world == rank) locally sorts its partitions.
+
+    The cluster analog of Spark's RangePartitioner + per-partition sort
+    (reference GpuRangePartitioner.scala; the executor's worker loop
+    already assigns output partition p to rank p % world, and the driver
+    reassembles partition-major, so the concatenation across ranks IS
+    the global order)."""
+
+    def _materialize_cluster(self, rank: int, world: int):
+        from spark_rapids_tpu.shuffle.serializer import wire_supported
+        from spark_rapids_tpu.shuffle.transport import make_transport
+        child = self.children[0]
+        bad = [str(d) for d in child.schema.dtypes
+               if not wire_supported(d)]
+        if bad:
+            raise NotImplementedError(
+                f"cluster range sort cannot serialize {bad} on the wire")
+        local: List[ColumnarBatch] = []
+        for p in range(child.num_partitions()):
+            local.extend(child.execute_partition(p))
+
+        # 1. sample exchange (broadcast pattern: every rank writes
+        #    partition 0, every rank reads it from all participants)
+        local_bucket = 0
+        for b in local:
+            local_bucket = max(local_bucket, string_key_bucket(
+                b, [e for e, _ in self.orders]))
+        sample = _sample_value_batch(local, self.orders, local_bucket)
+        sschema = (sample.schema if sample is not None else None)
+        if sschema is None:
+            # still must participate: build an empty-shaped schema
+            from spark_rapids_tpu import types as _T
+            sschema = Schema(
+                tuple([f"k{i}" for i in range(len(self.orders))]
+                      + ["_bucket"]),
+                tuple([e.dtype for e, _ in self.orders] + [_T.INT]))
+        t_samples = make_transport("MULTIPROCESS", 1, sschema)
+        t_samples.write(iter([(0, sample)] if sample is not None
+                             else []))
+        gathered = t_samples.read(0)
+
+        # 2. identical boundaries on every rank: re-encode the union of
+        #    raw sampled values with ONE agreed bucket (max of every
+        #    rank's data-wide bucket, carried in the _bucket column)
+        from spark_rapids_tpu.expressions.core import BoundReference
+        bound_orders = tuple(
+            (BoundReference(i, e.dtype), o)
+            for i, (e, o) in enumerate(self.orders))
+        union: List[ColumnarBatch] = []
+        agreed_bucket = local_bucket
+        for b in gathered:
+            vals = b.to_pydict()
+            agreed_bucket = max(agreed_bucket,
+                                *(x for x in vals["_bucket"] if x
+                                  is not None), 0)
+            union.append(b)
+        key_schema = Schema(sschema.names[:-1], sschema.dtypes[:-1])
+        key_batches = [ColumnarBatch(b.columns[:-1], b.num_rows,
+                                     key_schema) for b in union]
+        encoder = make_encoder(bound_orders, key_schema)
+        _bkt, boundaries = sample_boundaries(
+            key_batches, bound_orders, encoder, self.out_partitions,
+            bucket=agreed_bucket)
+
+        # 3. range exchange: route local batches, write slices, owners
+        #    read complete partitions from every rank
+        t_data = make_transport("MULTIPROCESS", self.out_partitions,
+                                child.schema)
+        route = make_router(self.orders, child.schema,
+                            self.out_partitions)(agreed_bucket, boundaries)
+        from spark_rapids_tpu.plan.execs.out_of_core import slice_by_counts
+
+        def slices():
+            for b in local:
+                reordered, counts = with_retry_no_split(lambda: route(b))
+                for p, piece in enumerate(slice_by_counts(
+                        reordered, counts, self.out_partitions)):
+                    if piece is not None:
+                        yield p, piece
+        t_data.write(slices())
+        self._cluster_sample_transport = t_samples
+        return t_data
+
+
+TpuRangeSortExec._materialize_cluster = \
+    ClusterRangeSortMixin._materialize_cluster

@@ -182,3 +182,65 @@ def test_cluster_executor_loss_redispatch(tmp_path):
             if p.is_alive():
                 p.terminate()
         driver.close()
+
+
+def test_cluster_global_range_sort(cluster, tmp_path):
+    """order_by distributes: exchanged samples -> shared boundaries ->
+    range exchange -> per-owner local sorts; the driver's
+    partition-major reassembly IS the global order."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.expressions import col
+
+    paths = _write_inputs(tmp_path)
+
+    def q(df):
+        return df.order_by(col("v"), col("k"))
+
+    s = TpuSession({})
+    plan = q(s.read_parquet(*paths)).plan
+    got = [tuple(r) for r in cluster.submit(plan, timeout_s=240)]
+
+    from spark_rapids_tpu.api.session import TpuSession as TS
+    s2 = TS({"spark.rapids.sql.enabled": "true"})
+    exp = [tuple(r) for r in q(s2.read_parquet(*paths)).collect()]
+    assert len(got) == len(exp)
+    # EXACT sequence equality: the global order must hold end to end
+    assert [r[1] for r in got] == [r[1] for r in exp]
+
+
+def test_cluster_sort_more_ranks_than_partitions(tmp_path):
+    """world=2, ONE output partition: the rank owning nothing must still
+    run the map side (sample publish + shard writes) or the owner's
+    completeness wait would time out (regression)."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    from spark_rapids_tpu.expressions import col
+
+    ctx = mp.get_context("spawn")
+    driver = TpuClusterDriver(
+        conf={"spark.sql.shuffle.partitions": "1",
+              "spark.rapids.shuffle.completenessTimeout": "30"})
+    stop_ev = ctx.Event()
+    procs = [ctx.Process(target=_executor_proc,
+                         args=(driver.rpc_addr, stop_ev), daemon=True)
+             for _ in range(2)]
+    for p in procs:
+        p.start()
+    try:
+        driver.wait_for_executors(2, timeout_s=120)
+        paths = _write_inputs(tmp_path)
+        s = TpuSession({})
+        plan = s.read_parquet(*paths).order_by(col("v"), col("k")).plan
+        got = [tuple(r) for r in driver.submit(plan, timeout_s=240)]
+        s2 = TpuSession({"spark.rapids.sql.enabled": "true"})
+        exp = [tuple(r) for r in
+               s2.read_parquet(*paths).order_by(col("v"),
+                                                col("k")).collect()]
+        assert [r[1] for r in got] == [r[1] for r in exp]
+    finally:
+        stop_ev.set()
+        for p in procs:
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+        driver.close()

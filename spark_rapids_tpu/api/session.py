@@ -240,6 +240,31 @@ class TpuSession:
             return snap.snapshot_id
         return commit_position_deletes(table_path, per_file)
 
+    def iceberg_optimize(self, table_path: str) -> int:
+        """Compact an Iceberg table: read the current snapshot (applying
+        any v2 merge-on-read delete files), rewrite the surviving rows
+        as fresh data files, and commit an overwrite snapshot — dropping
+        both the fragmented data files and the delete files (the
+        rewrite-data-files action the reference accelerates as
+        copy-on-write compaction).  Returns rows written; 0 when the
+        table is already compact (single data file, no delete files —
+        no snapshot is committed).  Partitioned tables are rejected:
+        the writer's overwrite path emits unpartitioned manifests, which
+        would silently discard the declared partition spec."""
+        from spark_rapids_tpu.io.iceberg import IcebergTable
+        table = IcebergTable.load(table_path)
+        specs = table.meta.get("partition-specs") or []
+        if any(s.get("fields") for s in specs):
+            raise NotImplementedError(
+                "iceberg_optimize over identity-partitioned tables: the "
+                "overwrite writer emits unpartitioned manifests and would "
+                "drop the partition layout")
+        snap = table.snapshot()
+        if not snap.delete_files() and len(snap.data_files()) <= 1:
+            return 0            # already compact: no-op, no new snapshot
+        df = self.read_iceberg(table_path)
+        return df.write_iceberg(table_path, mode="overwrite")
+
     def read_avro(self, *paths: str, columns=None) -> "DataFrame":
         """Avro container scan (reference GpuAvroScan analog): records
         decode host-side through io/avro.py and upload as one batch per

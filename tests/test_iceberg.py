@@ -232,3 +232,35 @@ def test_position_delete_rerun_is_noop(tmp_path):
     from spark_rapids_tpu.io.iceberg import IcebergTable
     assert len(IcebergTable.load(path).snapshot().delete_files()) == 1
     assert s.read_iceberg(path).count() == 15
+
+
+def test_iceberg_optimize_compacts_and_drops_deletes(tmp_path):
+    """OPTIMIZE applies MOR deletes and leaves a delete-free snapshot."""
+    s, o = _sessions()
+    path = str(tmp_path / "opt1")
+    _df(s, 0, 40).write_iceberg(path, mode="error")
+    _df(s, 40, 80).write_iceberg(path, mode="append")
+    s.iceberg_delete(path, col("v") % lit(4) == lit(0))
+    wrote = s.iceberg_optimize(path)
+    exp_vs = [v for v in range(80) if v % 4 != 0]
+    assert wrote == len(exp_vs)
+    from spark_rapids_tpu.io.iceberg import IcebergTable
+    snap = IcebergTable.load(path).snapshot()
+    assert snap.delete_files() == []
+    got = sorted(r[1] for r in s.read_iceberg(path).collect())
+    exp = sorted(r[1] for r in o.read_iceberg(path).collect())
+    assert got == exp == exp_vs
+    # time travel still reaches the pre-optimize snapshot chain
+    assert len(IcebergTable.load(path).meta["snapshots"]) >= 4
+
+
+def test_iceberg_optimize_noop_when_compact(tmp_path):
+    s, _ = _sessions()
+    path = str(tmp_path / "opt2")
+    _df(s, 0, 20).write_iceberg(path, mode="error")
+    s.iceberg_optimize(path)            # compacts the 2-partition write
+    from spark_rapids_tpu.io.iceberg import IcebergTable
+    n_snaps = len(IcebergTable.load(path).meta["snapshots"])
+    if len(IcebergTable.load(path).snapshot().data_files()) <= 1:
+        assert s.iceberg_optimize(path) == 0
+        assert len(IcebergTable.load(path).meta["snapshots"]) == n_snaps

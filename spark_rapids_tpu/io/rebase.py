@@ -16,11 +16,12 @@ piecewise-constant Julian/Gregorian day difference (one step per Julian
 century leap day that Gregorian skips), applied via one searchsorted over
 a ~120-entry breakpoint table.
 
-Timestamp rebase here is UTC-based (micros shifted by the whole-day
-difference of their UTC Julian day).  Spark's JVM rebase consults the
-writer's time zone for sub-day effects on ancient zone offsets; for the
-pre-1582 timestamps this affects, the divergence is bounded by the zone
-offset and documented here.
+Timestamp rebase selects the whole-day shift by the LOCAL Julian day in
+the session timezone (Spark's RebaseDateTime localizes in the JVM zone;
+for the pre-1582 instants rebase touches, every zone sits at its fixed
+LMT offset, so localization is one constant shift — see
+rebase_julian_to_gregorian_micros).  Residual divergence from Spark is
+limited to tzdb-vs-JVM differences in the LMT value itself.
 """
 from __future__ import annotations
 
@@ -95,22 +96,53 @@ def rebase_julian_to_gregorian_days(days: np.ndarray) -> np.ndarray:
     return np.where(old, days + _DIFFS[idx], days)
 
 
-def rebase_julian_to_gregorian_micros(micros: np.ndarray) -> np.ndarray:
-    """Hybrid-calendar micros -> proleptic Gregorian, shifting by the UTC
-    day's rebase difference."""
+def _ancient_offset_micros(tz: str) -> int:
+    """The zone's fixed pre-standardization (LMT) UTC offset in micros.
+    Every instant the Julian rebase touches predates 1582, long before
+    any zone had transitions, so one lookup at 1500-01-01 suffices."""
+    if not tz or tz.upper() == "UTC":
+        return 0
+    try:
+        from datetime import datetime, timezone as _tzu
+        from zoneinfo import ZoneInfo
+        off = ZoneInfo(tz).utcoffset(
+            datetime(1500, 1, 1, tzinfo=_tzu.utc))
+        return int(off.total_seconds() * 1_000_000)
+    except Exception:
+        return 0
+
+
+def rebase_julian_to_gregorian_micros(micros: np.ndarray,
+                                      tz: str = "UTC") -> np.ndarray:
+    """Hybrid-calendar micros -> proleptic Gregorian.
+
+    The whole-day rebase shift is selected by the LOCAL Julian day in
+    ``tz`` (Spark's RebaseDateTime localizes in the JVM zone before
+    re-interpreting the civil datetime; pre-1582 zone offsets are the
+    constant LMT, so localization reduces to one fixed offset).  With
+    tz=UTC this is the previous UTC-day behavior; a session zone only
+    changes results for instants within |offset| of a Julian-century
+    breakpoint, which is exactly where the UTC-based shift diverged
+    from Spark."""
     micros = np.asarray(micros, np.int64)
     old = micros < CUTOVER_MICROS
     if not old.any():
         return micros
-    days = np.floor_divide(micros, MICROS_PER_DAY)
+    local = micros + _ancient_offset_micros(tz)
+    days = np.floor_divide(local, MICROS_PER_DAY)
     idx = np.clip(np.searchsorted(_THRESH, days, side="right") - 1,
                   0, len(_DIFFS) - 1)
     return np.where(old, micros + _DIFFS[idx] * MICROS_PER_DAY, micros)
 
 
-def rebase_arrow_table(table):
-    """Apply Julian->Gregorian rebase to every date32/timestamp column of a
-    pyarrow table (used by the scan when needs_rebase(footer))."""
+def rebase_arrow_table(table, tz: str = None):
+    """Apply Julian->Gregorian rebase to every date32/timestamp column of
+    a pyarrow table (used by the scan when needs_rebase(footer)).
+    ``tz`` defaults to the SESSION timezone: timestamp shifts localize
+    like Spark's JVM-zone rebase (see rebase_julian_to_gregorian_micros)."""
+    if tz is None:
+        from spark_rapids_tpu.config import current_session_timezone
+        tz = current_session_timezone()
     import pyarrow as pa
     cols = []
     changed = False
@@ -138,11 +170,11 @@ def rebase_arrow_table(table):
                 # and re-attach the sub-microsecond remainder exactly
                 rem = vals % 1_000
                 micros = vals // 1_000
-                rebased = (rebase_julian_to_gregorian_micros(micros)
+                rebased = (rebase_julian_to_gregorian_micros(micros, tz)
                            * 1_000 + rem)
             else:
                 rebased = rebase_julian_to_gregorian_micros(
-                    vals * scale) // scale
+                    vals * scale, tz) // scale
             mask = arr.is_null().to_numpy(zero_copy_only=False)
             cols.append(pa.array(rebased, pa.int64(),
                                  mask=mask).cast(field.type))

@@ -123,3 +123,42 @@ def test_tpu_and_oracle_agree_on_legacy_file(tmp_path):
     def q(s):
         return s.read_parquet(path)
     assert_tpu_cpu_equal(q)
+
+
+def test_timestamp_rebase_uses_local_julian_day():
+    """An instant whose UTC day and LOCAL day straddle a Julian-century
+    breakpoint must take the LOCAL day's shift (Spark localizes in the
+    JVM zone before rebasing)."""
+    import numpy as np
+    from spark_rapids_tpu.io.rebase import (
+        MICROS_PER_DAY, _ancient_offset_micros, _DIFFS, _THRESH,
+        rebase_julian_to_gregorian_micros)
+
+    # find a breakpoint day b where the shift changes
+    bi = len(_THRESH) // 2
+    b = int(_THRESH[bi])
+    # one hour BEFORE local midnight of the breakpoint day in a +8 zone:
+    # UTC day = b-1, local day (UTC+8) = b
+    off = _ancient_offset_micros("Asia/Shanghai")
+    assert off > 0
+    t = b * MICROS_PER_DAY - off + MICROS_PER_DAY - 3_600_000_000
+    utc_day = (t) // MICROS_PER_DAY
+    local_day = (t + off) // MICROS_PER_DAY
+    if utc_day == local_day:      # arithmetic guard; pick exact straddle
+        t = b * MICROS_PER_DAY - off // 2
+        local_day = (t + off) // MICROS_PER_DAY
+        utc_day = t // MICROS_PER_DAY
+    assert utc_day != local_day
+    arr = np.array([t], np.int64)
+    got_utc = rebase_julian_to_gregorian_micros(arr, "UTC")[0]
+    got_sh = rebase_julian_to_gregorian_micros(arr, "Asia/Shanghai")[0]
+    shift_utc = int(_DIFFS[np.clip(
+        np.searchsorted(_THRESH, utc_day, side="right") - 1, 0,
+        len(_DIFFS) - 1)])
+    shift_local = int(_DIFFS[np.clip(
+        np.searchsorted(_THRESH, local_day, side="right") - 1, 0,
+        len(_DIFFS) - 1)])
+    assert got_utc == t + shift_utc * MICROS_PER_DAY
+    assert got_sh == t + shift_local * MICROS_PER_DAY
+    if shift_utc != shift_local:
+        assert got_utc != got_sh

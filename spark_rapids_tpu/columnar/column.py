@@ -563,9 +563,13 @@ class DeviceColumn:
             bcap = byte_capacity if byte_capacity is not None else self.byte_capacity
             offsets = jnp.zeros((capacity + 1,), dtype=jnp.int32)
             ncopy = min(capacity + 1, self.offsets.shape[0])
-            offsets = offsets.at[:ncopy].set(self.offsets[:ncopy])
+            # source offsets may be int64 (cumsum of int64 lengths on a
+            # wide path); scattering int64 into int32 becomes a hard
+            # error in future jax — cast explicitly
+            src_off = self.offsets.astype(jnp.int32)
+            offsets = offsets.at[:ncopy].set(src_off[:ncopy])
             if capacity + 1 > ncopy:
-                offsets = offsets.at[ncopy:].set(self.offsets[ncopy - 1])
+                offsets = offsets.at[ncopy:].set(src_off[ncopy - 1])
             validity = jnp.zeros((capacity,), dtype=jnp.bool_)
             nv = min(capacity, self.capacity)
             validity = validity.at[:nv].set(self.validity[:nv])
@@ -581,9 +585,13 @@ class DeviceColumn:
             )
             offsets = jnp.zeros((capacity + 1,), dtype=jnp.int32)
             ncopy = min(capacity + 1, self.offsets.shape[0])
-            offsets = offsets.at[:ncopy].set(self.offsets[:ncopy])
+            # source offsets may be int64 (cumsum of int64 lengths on a
+            # wide path); scattering int64 into int32 becomes a hard
+            # error in future jax — cast explicitly
+            src_off = self.offsets.astype(jnp.int32)
+            offsets = offsets.at[:ncopy].set(src_off[:ncopy])
             if capacity + 1 > ncopy:
-                offsets = offsets.at[ncopy:].set(self.offsets[ncopy - 1])
+                offsets = offsets.at[ncopy:].set(src_off[ncopy - 1])
             validity = jnp.zeros((capacity,), dtype=jnp.bool_)
             validity = validity.at[: min(capacity, self.capacity)].set(
                 self.validity[: min(capacity, self.capacity)]

@@ -387,7 +387,11 @@ def concat_batches_device(
                                 validity, dtype, children=kids)
 
         if dtype.variable_width:
-            stacked_off = jnp.stack([c.offsets for c in cols])    # [n_in, cap+1]
+            # normalize to int32: a stray int64 offsets plane (cumsum of
+            # int64 lengths upstream) would promote every derived index
+            # and turn the offsets scatter into a future-jax hard error
+            stacked_off = jnp.stack(
+                [c.offsets.astype(jnp.int32) for c in cols])  # [n_in, cap+1]
             stacked_dat = jnp.stack([c.data for c in cols])       # [n_in, bcap]
             is_arr = cols[0].child_validity is not None
             is_map = cols[0].children is not None
@@ -396,7 +400,8 @@ def concat_batches_device(
             out_bcap = sum(c.byte_capacity for c in cols)
             row_len = stacked_off[which, within + 1] - stacked_off[which, within]
             lengths = jnp.where(live, row_len, 0)
-            new_offsets = jnp.zeros((out_capacity + 1,), jnp.int32).at[1:].set(jnp.cumsum(lengths))
+            new_offsets = jnp.zeros((out_capacity + 1,), jnp.int32).at[1:].set(
+                jnp.cumsum(lengths).astype(jnp.int32))
             bpos = jnp.arange(out_bcap, dtype=jnp.int32)
             brow = jnp.clip(jnp.searchsorted(new_offsets, bpos, side="right").astype(jnp.int32) - 1,
                             0, out_capacity - 1)

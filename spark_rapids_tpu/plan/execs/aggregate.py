@@ -184,7 +184,8 @@ def _collect_update(col: DeviceColumn, layout: Optional[G.GroupedLayout],
     data = jnp.where(cvalid, vals, 0.0)
     if layout is None:
         offsets = jnp.minimum(
-            jnp.arange(cap + 1, dtype=jnp.int32), 1) * total
+            jnp.arange(cap + 1, dtype=jnp.int32),
+            1) * total.astype(jnp.int32)
         validity = jnp.arange(cap, dtype=jnp.int32) < 1
         ng = 1
     else:
@@ -225,7 +226,8 @@ def _collect_merge(col: DeviceColumn, layout: Optional[G.GroupedLayout],
     cvalid = jnp.arange(ecap, dtype=jnp.int32) < etotal
     if layout is None:
         offsets = jnp.minimum(
-            jnp.arange(cap + 1, dtype=jnp.int32), 1) * etotal
+            jnp.arange(cap + 1, dtype=jnp.int32),
+            1) * etotal.astype(jnp.int32)
         validity = jnp.arange(cap, dtype=jnp.int32) < 1
     else:
         gcounts = jax.ops.segment_sum(keep_len.astype(jnp.int32),

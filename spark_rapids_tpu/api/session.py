@@ -612,6 +612,15 @@ class DataFrame:
     def _collect_impl(self) -> List[tuple]:
         if self.session.conf.sql_enabled:
             exec_plan, _ = plan_query(self.plan, self.session.conf)
+            from spark_rapids_tpu.plan.execs.fallback import (
+                TpuCpuFallbackExec)
+            if isinstance(exec_plan, TpuCpuFallbackExec):
+                # the WHOLE plan is a CPU island: collect its oracle rows
+                # directly — a device round-trip would be pure overhead
+                # and device columns cannot even represent some bridged
+                # output types (array<string>)
+                self.session.last_query_metrics = None  # no device run
+                return exec_plan.collect_rows()
             if (self.session.conf.shuffle_mode == "ICI"
                     and self.session.mesh is not None):
                 from spark_rapids_tpu.parallel.stage import (

@@ -181,7 +181,7 @@ from spark_rapids_tpu.expressions.aggregates import (
     ApproxPercentile, Percentile, approx_percentile, percentile)
 from spark_rapids_tpu.expressions.hashing import HiveHash, hive_hash
 from spark_rapids_tpu.expressions.strings import (
-    Conv, ParseUrl, conv, parse_url)
+    Conv, FormatNumber, ParseUrl, conv, format_number, parse_url)
 from spark_rapids_tpu.expressions.window import (
     CumeDist, FirstValue, LastValue, NthValue, Ntile, PercentRank)
 from spark_rapids_tpu.expressions.map_hof import (
@@ -189,3 +189,14 @@ from spark_rapids_tpu.expressions.map_hof import (
     map_filter, map_zip_with, transform_keys, transform_values, zip_with)
 from spark_rapids_tpu.expressions.zorder import (
     RangeBucketId, ZOrderKey)
+from spark_rapids_tpu.expressions.parity import (
+    ArrayExcept, ArrayIntersect, ArrayJoin, ArrayUnion, Bin, BitwiseCount,
+    BRound, DateFormat, FromUnixTime, Hex, MapConcat, MapFromArrays, Md5,
+    RegexpExtract, RegexpExtractAll, RegexpReplace, Sha1, Sha2, StringSplit,
+    StringToMap, SubstringIndex, ToUnixTimestamp, TruncTimestamp,
+    UnaryPositive, UnixTimestamp, WeekDay, array_except, array_intersect,
+    array_join, array_union, bin_, bit_count, bround, date_format,
+    date_trunc, from_unixtime, hex_, map_concat, map_from_arrays, md5,
+    regexp_extract, regexp_extract_all, regexp_replace, sha1, sha2, split,
+    str_to_map, substring_index, to_unix_timestamp, unary_positive,
+    weekday)

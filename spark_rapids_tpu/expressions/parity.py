@@ -1,0 +1,929 @@
+"""Expression-parity sweep: the remaining common reference expr rules.
+
+Reference: GpuOverrides.scala's expr table — GpuUnaryPositive, GpuWeekDay,
+GpuBRound, GpuBitwiseCount, GpuRegExpExtract/ExtractAll/Replace (via the
+transpiler, stringFunctions.scala), GpuStringSplit, GpuSubstringIndex,
+array set ops + array_join (collectionOperations.scala), map builders
+(complexTypeCreator.scala), Md5/Sha1/Sha2/Hex/Bin, and the unix-time
+format family (datetimeExpressions.scala).
+
+Device evaluation where the kernel is a one-liner (unary_positive,
+weekday, bround, bit_count via lax.population_count); everything
+var-width/format-string/regex-capture runs through the expression-level
+CPU bridge (unregistered => bridged in project/filter), matching the
+reference's own fallback posture for several of these
+(docs/compatibility.md).  Format strings accept the common Java tokens
+(yyyy MM dd HH mm ss) and reject others at CONSTRUCTION time so the
+error is a clear plan-time failure, not a null.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.core import (
+    BinaryExpression,
+    CpuEvalContext,
+    EvalContext,
+    Expression,
+    Literal,
+    UnaryExpression,
+    make_column,
+)
+
+MICROS = 1_000_000
+
+
+# ---------------------------------------------------------------------------
+# device-evaluated
+
+
+class UnaryPositive(UnaryExpression):
+    """+x (GpuUnaryPositive): identity."""
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext):
+        return self.child.eval(ctx)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        return self.child.eval_cpu(ctx)
+
+
+class WeekDay(UnaryExpression):
+    """weekday(date): Monday=0..Sunday=6 (GpuWeekDay; DayOfWeek is the
+    1-based-Sunday sibling).  Timestamp inputs bridge (typesig is
+    date-only on device) and cast to a session-zone date first, like
+    Spark's implicit timestamp->date cast."""
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        # 1970-01-01 is a Thursday = weekday 3
+        wd = ((c.data.astype(jnp.int64) % 7) + 7 + 3) % 7
+        return make_column(wd.astype(jnp.int32),
+                           c.validity & ctx.live_mask(), T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, m = self.child.eval_cpu(ctx)
+        days = np.asarray(v, np.int64)
+        if isinstance(self.child.dtype, T.TimestampType):
+            from spark_rapids_tpu.expressions.datetime import (
+                MICROS_PER_DAY, _session_local_np)
+            days = np.floor_divide(_session_local_np(days), MICROS_PER_DAY)
+        wd = (((days % 7) + 7 + 3) % 7).astype(np.int32)
+        return wd, m.copy()
+
+
+class BRound(BinaryExpression):
+    """bround(x, d): HALF_EVEN rounding at scale d (GpuBRound).
+
+    Double path only (like Round's float caveats): scale/round/unscale in
+    float64 — sub-ulp divergence from Spark's BigDecimal math is possible
+    at the tie boundary and documented."""
+
+    symbol = "bround"
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    def _scale(self):
+        assert isinstance(self.right, Literal), "bround scale must be literal"
+        return int(self.right.value)
+
+    def eval(self, ctx: EvalContext):
+        c = self.left.eval(ctx)
+        d = self._scale()
+        if self.left.dtype.is_integral:
+            if d >= 0:
+                return c
+            p = jnp.asarray(10 ** (-d), c.data.dtype)
+            half = p // 2
+            q = c.data // p
+            rem = c.data - q * p
+            # HALF_EVEN on exact integer remainders
+            up = (rem > half) | ((rem == half) & (q % 2 != 0))
+            out = (q + up.astype(q.dtype)) * p
+            return make_column(out, c.validity & ctx.live_mask(),
+                               self.dtype)
+        f = 10.0 ** d
+        # multiply by the reciprocal EXPLICITLY: XLA strength-reduces a
+        # constant division to this anyway inside fused programs, so
+        # writing it out keeps device and oracle bit-identical (1-ulp
+        # from BigDecimal at some scales; documented)
+        out = jnp.round(c.data * f) * (1.0 / f)   # jnp.round is HALF_EVEN
+        return make_column(out, c.validity & ctx.live_mask(), self.dtype)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, m = self.left.eval_cpu(ctx)
+        d = self._scale()
+        if self.left.dtype.is_integral:
+            if d >= 0:
+                return v.copy(), m.copy()
+            p = 10 ** (-d)
+            vv = np.asarray(v, np.int64)
+            q, rem = np.divmod(vv, p)
+            up = (rem > p // 2) | ((rem == p // 2) & (q % 2 != 0))
+            return ((q + up.astype(np.int64)) * p).astype(v.dtype), m.copy()
+        f = 10.0 ** d
+        return (np.round(np.asarray(v, np.float64) * f) * (1.0 / f),
+                m.copy())
+
+
+class BitwiseCount(UnaryExpression):
+    """bit_count(x) (GpuBitwiseCount): set bits, INT result."""
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def eval(self, ctx: EvalContext):
+        c = self.child.eval(ctx)
+        dt = c.data.dtype
+        u = c.data.astype({jnp.int64: jnp.uint64, jnp.int32: jnp.uint32,
+                           jnp.int16: jnp.uint16, jnp.int8: jnp.uint8,
+                           jnp.bool_: jnp.uint8}.get(dt.type, jnp.uint32)
+                          if dt != jnp.bool_ else jnp.uint8)
+        cnt = jax.lax.population_count(u).astype(jnp.int32)
+        return make_column(cnt, c.validity & ctx.live_mask(), T.INT)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        v, m = self.child.eval_cpu(ctx)
+        if v.dtype == np.bool_:
+            cnt = v.astype(np.int32)
+        else:
+            w = v.dtype.itemsize
+            u = v.astype({1: np.uint8, 2: np.uint16, 4: np.uint32,
+                          8: np.uint64}[w])
+            cnt = np.zeros(v.shape, np.int32)
+            for _ in range(w * 8):
+                cnt += (u & 1).astype(np.int32)
+                u = u >> 1
+        return cnt, m.copy()
+
+
+# ---------------------------------------------------------------------------
+# CPU-bridge evaluated (var-width / regex-capture / format strings)
+
+
+class _BridgeExpr(Expression):
+    """Base for host-evaluated expressions: subclasses implement
+    _row(*values) -> python value (None = null); null inputs propagate
+    unless null_tolerant."""
+
+    null_tolerant = False
+
+    @property
+    def nullable(self):
+        return True
+
+    def _out_array(self, n):
+        return np.empty((n,), object)
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        pairs = [c.eval_cpu(ctx) for c in self.children]
+        n = ctx.num_rows
+        out = self._out_array(n)
+        if out.dtype == object:
+            out[:] = [None] * n
+        ok = np.zeros((n,), np.bool_)
+        for i in range(n):
+            vals = []
+            null = False
+            for v, m in pairs:
+                if not m[i] or (v.dtype == object and v[i] is None):
+                    null = True
+                    vals.append(None)
+                else:
+                    vals.append(v[i].item() if hasattr(v[i], "item")
+                                else v[i])
+            if null and not self.null_tolerant:
+                continue
+            r = self._row(*vals)
+            if r is not None:
+                out[i] = r
+                ok[i] = True
+        return out, ok
+
+    def __repr__(self):
+        inner = ", ".join(repr(c) for c in self.children)
+        return f"{type(self).__name__}({inner})"
+
+
+def _compile_java_regex(pattern: str):
+    """Java-dialect regex -> python re (shared dialect subset; the device
+    transpiler handles matching, this path handles captures)."""
+    import re
+    return re.compile(pattern)
+
+
+class RegexpExtract(_BridgeExpr):
+    """regexp_extract(s, pattern, idx) (GpuRegExpExtract): group idx of
+    the FIRST match; no match -> empty string (Spark semantics)."""
+
+    def __init__(self, child, pattern: str, idx: int = 1):
+        self.children = (child,)
+        self.pattern = pattern
+        self.idx = int(idx)
+        self._re = _compile_java_regex(pattern)
+
+    def with_children(self, children):
+        return RegexpExtract(children[0], self.pattern, self.idx)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _row(self, s):
+        m = self._re.search(str(s))
+        if m is None:
+            return ""
+        g = m.group(self.idx)
+        return g if g is not None else ""
+
+    def __repr__(self):
+        return (f"regexp_extract({self.children[0]!r}, "
+                f"{self.pattern!r}, {self.idx})")
+
+
+class RegexpExtractAll(_BridgeExpr):
+    """regexp_extract_all(s, pattern, idx): every match's group idx."""
+
+    def __init__(self, child, pattern: str, idx: int = 1):
+        self.children = (child,)
+        self.pattern = pattern
+        self.idx = int(idx)
+        self._re = _compile_java_regex(pattern)
+
+    def with_children(self, children):
+        return RegexpExtractAll(children[0], self.pattern, self.idx)
+
+    @property
+    def dtype(self):
+        return T.ArrayType(T.STRING)
+
+    def _row(self, s):
+        out = []
+        for m in self._re.finditer(str(s)):
+            g = m.group(self.idx)
+            out.append(g if g is not None else "")
+        return out
+
+    def __repr__(self):
+        return (f"regexp_extract_all({self.children[0]!r}, "
+                f"{self.pattern!r}, {self.idx})")
+
+
+class RegexpReplace(_BridgeExpr):
+    """regexp_replace(s, pattern, replacement) (GpuRegExpReplace).
+    Java $1 backreferences translate to python \\1."""
+
+    def __init__(self, child, pattern: str, replacement: str):
+        self.children = (child,)
+        self.pattern = pattern
+        self.replacement = replacement
+        self._re = _compile_java_regex(pattern)
+        import re as _re
+        self._repl = _re.sub(r"\$(\d)", r"\\\1", replacement)
+
+    def with_children(self, children):
+        return RegexpReplace(children[0], self.pattern, self.replacement)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _row(self, s):
+        return self._re.sub(self._repl, str(s))
+
+    def __repr__(self):
+        return (f"regexp_replace({self.children[0]!r}, {self.pattern!r}, "
+                f"{self.replacement!r})")
+
+
+class StringSplit(_BridgeExpr):
+    """split(s, pattern[, limit]) (GpuStringSplit): regex split, Spark
+    limit semantics (limit<=0: trailing empties trimmed only for -1? —
+    Spark keeps all for limit<=0 except the java split(-1) contract:
+    limit<0 keeps trailing empty strings, limit=0 drops them)."""
+
+    def __init__(self, child, pattern: str, limit: int = -1):
+        self.children = (child,)
+        self.pattern = pattern
+        self.limit = int(limit)
+        self._re = _compile_java_regex(pattern)
+
+    def with_children(self, children):
+        return StringSplit(children[0], self.pattern, self.limit)
+
+    @property
+    def dtype(self):
+        return T.ArrayType(T.STRING)
+
+    def _row(self, s):
+        s = str(s)
+        if self.limit > 0:
+            return self._re.split(s, self.limit - 1)
+        parts = self._re.split(s)
+        if self.limit == 0:
+            while parts and parts[-1] == "":
+                parts.pop()
+        return parts
+
+    def __repr__(self):
+        return (f"split({self.children[0]!r}, {self.pattern!r}, "
+                f"{self.limit})")
+
+
+class SubstringIndex(_BridgeExpr):
+    """substring_index(s, delim, count) (GpuSubstringIndex)."""
+
+    def __init__(self, child, delim: str, count: int):
+        self.children = (child,)
+        self.delim = delim
+        self.count = int(count)
+
+    def with_children(self, children):
+        return SubstringIndex(children[0], self.delim, self.count)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _row(self, s):
+        s = str(s)
+        if not self.delim or self.count == 0:
+            return ""
+        if self.count > 0:
+            parts = s.split(self.delim)
+            return self.delim.join(parts[:self.count])
+        parts = s.split(self.delim)
+        return self.delim.join(parts[self.count:])
+
+    def __repr__(self):
+        return (f"substring_index({self.children[0]!r}, {self.delim!r}, "
+                f"{self.count})")
+
+
+class ArrayJoin(_BridgeExpr):
+    """array_join(arr, delim[, null_replacement])."""
+
+    def __init__(self, child, delim: str,
+                 null_replacement: Optional[str] = None):
+        self.children = (child,)
+        self.delim = delim
+        self.null_replacement = null_replacement
+
+    def with_children(self, children):
+        return ArrayJoin(children[0], self.delim, self.null_replacement)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _row(self, arr):
+        parts = []
+        for x in arr:
+            if x is None:
+                if self.null_replacement is not None:
+                    parts.append(self.null_replacement)
+            else:
+                parts.append(str(x))
+        return self.delim.join(parts)
+
+
+class _ArraySetOp(BinaryExpression):
+    """Base of array_except/intersect/union: null-aware set semantics,
+    FIRST-occurrence order, one null element kept (Spark)."""
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        a, am = self.left.eval_cpu(ctx)
+        b, bm = self.right.eval_cpu(ctx)
+        n = ctx.num_rows
+        out = np.empty((n,), object)
+        out[:] = [None] * n
+        ok = np.zeros((n,), np.bool_)
+        for i in range(n):
+            if not am[i] or a[i] is None or not bm[i] or b[i] is None:
+                continue
+            out[i] = self._combine(list(a[i]), list(b[i]))
+            ok[i] = True
+        return out, ok
+
+    @staticmethod
+    def _dedupe(vals):
+        seen = set()
+        saw_null = False
+        out = []
+        for x in vals:
+            if x is None:
+                if not saw_null:
+                    saw_null = True
+                    out.append(None)
+                continue
+            if x not in seen:
+                seen.add(x)
+                out.append(x)
+        return out
+
+
+class ArrayExcept(_ArraySetOp):
+    def _combine(self, a, b):
+        bs = set(x for x in b if x is not None)
+        bnull = any(x is None for x in b)
+        return self._dedupe([x for x in a
+                             if (x is None and not bnull)
+                             or (x is not None and x not in bs)])
+
+
+class ArrayIntersect(_ArraySetOp):
+    def _combine(self, a, b):
+        bs = set(x for x in b if x is not None)
+        bnull = any(x is None for x in b)
+        return self._dedupe([x for x in a
+                             if (x is None and bnull)
+                             or (x is not None and x in bs)])
+
+
+class ArrayUnion(_ArraySetOp):
+    def _combine(self, a, b):
+        return self._dedupe(a + b)
+
+
+class MapConcat(_BridgeExpr):
+    """map_concat(m1, m2, ...): later maps win duplicate keys (Spark
+    LAST_WIN default)."""
+
+    def __init__(self, children):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return MapConcat(children)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def _row(self, *maps):
+        out = {}
+        for m in maps:
+            out.update(dict(m.items() if isinstance(m, dict) else m))
+        return out
+
+
+class MapFromArrays(_BridgeExpr):
+    """map_from_arrays(keys, values)."""
+
+    def __init__(self, keys, values):
+        self.children = (keys, values)
+
+    def with_children(self, children):
+        return MapFromArrays(children[0], children[1])
+
+    @property
+    def dtype(self):
+        return T.MapType(self.children[0].dtype.element_type,
+                         self.children[1].dtype.element_type)
+
+    def _row(self, ks, vs):
+        if len(ks) != len(vs):
+            raise ValueError("map_from_arrays: length mismatch")
+        return dict(zip(ks, vs))
+
+
+class StringToMap(_BridgeExpr):
+    """str_to_map(s, pair_delim, kv_delim)."""
+
+    def __init__(self, child, pair_delim: str = ",", kv_delim: str = ":"):
+        self.children = (child,)
+        self.pair_delim = pair_delim
+        self.kv_delim = kv_delim
+
+    def with_children(self, children):
+        return StringToMap(children[0], self.pair_delim, self.kv_delim)
+
+    @property
+    def dtype(self):
+        return T.MapType(T.STRING, T.STRING)
+
+    def _row(self, s):
+        out = {}
+        for pair in str(s).split(self.pair_delim):
+            k, sep, v = pair.partition(self.kv_delim)
+            out[k] = v if sep else None
+        return out
+
+
+class _DigestBase(UnaryExpression):
+    ALGO = "md5"
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        import hashlib
+        v, m = self.child.eval_cpu(ctx)
+        n = len(v)
+        out = np.empty((n,), object)
+        out[:] = [None] * n
+        ok = np.zeros((n,), np.bool_)
+        for i in range(n):
+            if not m[i] or v[i] is None:
+                continue
+            raw = v[i] if isinstance(v[i], (bytes, bytearray)) \
+                else str(v[i]).encode("utf-8")
+            out[i] = hashlib.new(self.ALGO, raw).hexdigest()
+            ok[i] = True
+        return out, ok
+
+
+class Md5(_DigestBase):
+    ALGO = "md5"
+
+
+class Sha1(_DigestBase):
+    ALGO = "sha1"
+
+
+class Sha2(UnaryExpression):
+    """sha2(s, bits): 224/256/384/512; invalid bits -> null (Spark)."""
+
+    def __init__(self, child, bits: int = 256):
+        super().__init__(child)
+        self.bits = int(bits)
+
+    def with_children(self, children):
+        return Sha2(children[0], self.bits)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval_cpu(self, ctx: CpuEvalContext):
+        import hashlib
+        v, m = self.child.eval_cpu(ctx)
+        n = len(v)
+        out = np.empty((n,), object)
+        out[:] = [None] * n
+        ok = np.zeros((n,), np.bool_)
+        algo = {224: "sha224", 256: "sha256", 384: "sha384",
+                512: "sha512", 0: "sha256"}.get(self.bits)
+        if algo is None:
+            return out, ok
+        for i in range(n):
+            if not m[i] or v[i] is None:
+                continue
+            raw = v[i] if isinstance(v[i], (bytes, bytearray)) \
+                else str(v[i]).encode("utf-8")
+            out[i] = hashlib.new(algo, raw).hexdigest()
+            ok[i] = True
+        return out, ok
+
+    def __repr__(self):
+        return f"sha2({self.child!r}, {self.bits})"
+
+
+class Hex(_BridgeExpr):
+    """hex(long|string|binary) -> uppercase hex string."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Hex(children[0])
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _row(self, v):
+        if isinstance(v, (bytes, bytearray)):
+            return v.hex().upper()
+        if isinstance(v, str):
+            return v.encode("utf-8").hex().upper()
+        return format(int(v) & ((1 << 64) - 1), "X")
+
+
+class Bin(_BridgeExpr):
+    """bin(long) -> binary string."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Bin(children[0])
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _row(self, v):
+        return format(int(v) & ((1 << 64) - 1), "b")
+
+
+# -- unix-time format family -------------------------------------------------
+
+_JAVA_TOKENS = (("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
+                ("HH", "%H"), ("mm", "%M"), ("ss", "%S"))
+
+
+def _java_to_strftime(fmt: str) -> str:
+    """Common Java datetime tokens -> strftime; anything else rejected at
+    construction so unsupported formats fail at PLAN time."""
+    out = fmt
+    for j, p in _JAVA_TOKENS:
+        out = out.replace(j, p)
+    import re
+    if re.search(r"[A-Za-z]", out.replace("%Y", "").replace("%m", "")
+                 .replace("%d", "").replace("%H", "").replace("%M", "")
+                 .replace("%S", "")):
+        raise NotImplementedError(
+            f"datetime format {fmt!r}: only yyyy/MM/dd/HH/mm/ss tokens "
+            "supported")
+    return out
+
+
+def _session_zone():
+    from zoneinfo import ZoneInfo
+
+    from spark_rapids_tpu.config import current_session_timezone
+    return ZoneInfo(current_session_timezone() or "UTC")
+
+
+class FromUnixTime(_BridgeExpr):
+    """from_unixtime(seconds, fmt): formatted in the session zone."""
+
+    def __init__(self, child, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+        self.children = (child,)
+        self.fmt = fmt
+        self._strf = _java_to_strftime(fmt)
+
+    def with_children(self, children):
+        return FromUnixTime(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _row(self, secs):
+        from datetime import datetime, timezone
+        dt = datetime.fromtimestamp(int(secs), tz=timezone.utc) \
+            .astimezone(_session_zone())
+        return dt.strftime(self._strf)
+
+    def __repr__(self):
+        return f"from_unixtime({self.children[0]!r}, {self.fmt!r})"
+
+
+class ToUnixTimestamp(_BridgeExpr):
+    """to_unix_timestamp(s, fmt) -> seconds; unparseable -> null.  The
+    UnixTimestamp expression is the same semantics (GpuToUnixTimestamp /
+    GpuUnixTimestamp)."""
+
+    def __init__(self, child, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+        self.children = (child,)
+        self.fmt = fmt
+        self._strf = _java_to_strftime(fmt)
+
+    def with_children(self, children):
+        return ToUnixTimestamp(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    def _out_array(self, n):
+        return np.zeros((n,), np.int64)
+
+    def _row(self, s):
+        from datetime import datetime
+        try:
+            dt = datetime.strptime(str(s), self._strf)
+        except ValueError:
+            return None
+        dt = dt.replace(tzinfo=_session_zone())
+        return int(dt.timestamp())
+
+    def __repr__(self):
+        return f"to_unix_timestamp({self.children[0]!r}, {self.fmt!r})"
+
+
+UnixTimestamp = ToUnixTimestamp
+
+
+class DateFormat(_BridgeExpr):
+    """date_format(ts, fmt) (GpuDateFormatClass): session-zone format of
+    a TIMESTAMP (int64 micros)."""
+
+    def __init__(self, child, fmt: str):
+        self.children = (child,)
+        self.fmt = fmt
+        self._strf = _java_to_strftime(fmt)
+
+    def with_children(self, children):
+        return DateFormat(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _row(self, micros):
+        from datetime import datetime, timezone
+        dt = datetime.fromtimestamp(int(micros) / MICROS,
+                                    tz=timezone.utc) \
+            .astimezone(_session_zone())
+        return dt.strftime(self._strf)
+
+    def __repr__(self):
+        return f"date_format({self.children[0]!r}, {self.fmt!r})"
+
+
+class TruncTimestamp(_BridgeExpr):
+    """date_trunc(fmt, ts) (GpuTruncTimestamp): session-zone truncation
+    to year/quarter/month/week/day/hour/minute/second."""
+
+    UNITS = ("year", "yyyy", "yy", "quarter", "month", "mon", "mm",
+             "week", "day", "dd", "hour", "minute", "second")
+
+    def __init__(self, fmt: str, child):
+        self.children = (child,)
+        self.fmt = fmt.lower()
+        if self.fmt not in self.UNITS:
+            raise NotImplementedError(f"date_trunc unit {fmt!r}")
+
+    def with_children(self, children):
+        return TruncTimestamp(self.fmt, children[0])
+
+    @property
+    def dtype(self):
+        return T.TIMESTAMP
+
+    def _out_array(self, n):
+        return np.zeros((n,), np.int64)
+
+    def _row(self, micros):
+        from datetime import datetime, timedelta, timezone
+        z = _session_zone()
+        dt = datetime.fromtimestamp(int(micros) / MICROS,
+                                    tz=timezone.utc).astimezone(z)
+        f = self.fmt
+        if f in ("year", "yyyy", "yy"):
+            dt = dt.replace(month=1, day=1, hour=0, minute=0, second=0,
+                            microsecond=0)
+        elif f == "quarter":
+            dt = dt.replace(month=(dt.month - 1) // 3 * 3 + 1, day=1,
+                            hour=0, minute=0, second=0, microsecond=0)
+        elif f in ("month", "mon", "mm"):
+            dt = dt.replace(day=1, hour=0, minute=0, second=0,
+                            microsecond=0)
+        elif f == "week":
+            dt = (dt - timedelta(days=dt.weekday())).replace(
+                hour=0, minute=0, second=0, microsecond=0)
+        elif f in ("day", "dd"):
+            dt = dt.replace(hour=0, minute=0, second=0, microsecond=0)
+        elif f == "hour":
+            dt = dt.replace(minute=0, second=0, microsecond=0)
+        elif f == "minute":
+            dt = dt.replace(second=0, microsecond=0)
+        elif f == "second":
+            dt = dt.replace(microsecond=0)
+        return int(dt.timestamp() * MICROS)
+
+    def __repr__(self):
+        return f"date_trunc({self.fmt!r}, {self.children[0]!r})"
+
+
+# ---------------------------------------------------------------------------
+# DSL helpers
+
+
+def _c(e):
+    from spark_rapids_tpu.expressions.core import Col
+    return Col(e) if isinstance(e, str) else e
+
+
+def unary_positive(e):
+    return UnaryPositive(_c(e))
+
+
+def weekday(e):
+    return WeekDay(_c(e))
+
+
+def bround(e, d: int = 0):
+    return BRound(_c(e), Literal(int(d)))
+
+
+def bit_count(e):
+    return BitwiseCount(_c(e))
+
+
+def regexp_extract(e, pattern: str, idx: int = 1):
+    return RegexpExtract(_c(e), pattern, idx)
+
+
+def regexp_extract_all(e, pattern: str, idx: int = 1):
+    return RegexpExtractAll(_c(e), pattern, idx)
+
+
+def regexp_replace(e, pattern: str, replacement: str):
+    return RegexpReplace(_c(e), pattern, replacement)
+
+
+def split(e, pattern: str, limit: int = -1):
+    return StringSplit(_c(e), pattern, limit)
+
+
+def substring_index(e, delim: str, count: int):
+    return SubstringIndex(_c(e), delim, count)
+
+
+def array_join(e, delim: str, null_replacement=None):
+    return ArrayJoin(_c(e), delim, null_replacement)
+
+
+def array_except(a, b):
+    return ArrayExcept(_c(a), _c(b))
+
+
+def array_intersect(a, b):
+    return ArrayIntersect(_c(a), _c(b))
+
+
+def array_union(a, b):
+    return ArrayUnion(_c(a), _c(b))
+
+
+def map_concat(*maps):
+    return MapConcat([_c(m) for m in maps])
+
+
+def map_from_arrays(keys, values):
+    return MapFromArrays(_c(keys), _c(values))
+
+
+def str_to_map(e, pair_delim: str = ",", kv_delim: str = ":"):
+    return StringToMap(_c(e), pair_delim, kv_delim)
+
+
+def md5(e):
+    return Md5(_c(e))
+
+
+def sha1(e):
+    return Sha1(_c(e))
+
+
+def sha2(e, bits: int = 256):
+    return Sha2(_c(e), bits)
+
+
+def hex_(e):
+    return Hex(_c(e))
+
+
+def bin_(e):
+    return Bin(_c(e))
+
+
+def from_unixtime(e, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+    return FromUnixTime(_c(e), fmt)
+
+
+def to_unix_timestamp(e, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+    return ToUnixTimestamp(_c(e), fmt)
+
+
+def date_format(e, fmt: str):
+    return DateFormat(_c(e), fmt)
+
+
+def date_trunc(fmt: str, e):
+    return TruncTimestamp(fmt, _c(e))

@@ -1299,3 +1299,53 @@ def parse_url(e, part: str, key=None):
 def conv(e, from_base: int, to_base: int):
     from spark_rapids_tpu.expressions.core import col as _col
     return Conv(_col(e) if isinstance(e, str) else e, from_base, to_base)
+
+
+from spark_rapids_tpu.expressions.parity import _BridgeExpr as _PB
+
+
+class FormatNumber(_PB):
+    """format_number(x, d) — x formatted as '#,###,###.##' with d decimal
+    places (reference: GpuFormatNumber; Spark's java.text.DecimalFormat
+    semantics).  Runs through the expression-level CPU bridge on device
+    plans (var-width locale-style formatting); rounding is HALF_EVEN like
+    DecimalFormat's default.  d < 0 or null d -> null; NaN -> 'NaN',
+    infinities -> the DecimalFormat infinity sign."""
+
+    def __init__(self, child: Expression, d: Expression):
+        self.children = (child, d)
+
+    def with_children(self, children):
+        return FormatNumber(children[0], children[1])
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return True
+
+    def _row(self, x, d):
+        d = int(d)
+        if d < 0:
+            return None
+        import math as _m
+        xf = float(x) if not isinstance(x, (int, np.integer)) else int(x)
+        if isinstance(xf, float):
+            if _m.isnan(xf):
+                return "NaN"
+            if _m.isinf(xf):
+                return ("-" if xf < 0 else "") + "\u221e"
+        return f"{xf:,.{d}f}"
+
+    def __repr__(self):
+        return f"format_number({self.children[0]!r}, {self.children[1]!r})"
+
+
+def format_number(e, d):
+    from spark_rapids_tpu.expressions.core import Literal
+    from spark_rapids_tpu.expressions.core import col as _col
+    e = _col(e) if isinstance(e, str) else e
+    d = Literal(int(d)) if isinstance(d, int) else d
+    return FormatNumber(e, d)

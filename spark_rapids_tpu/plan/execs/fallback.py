@@ -60,6 +60,14 @@ class TpuCpuFallbackExec(TpuExec):
             self._parts = engine.execute(self.logical_plan)
         return self._parts
 
+    def collect_rows(self) -> list:
+        """Oracle rows directly — the root-island collect path (device
+        columns cannot represent every bridged output type)."""
+        rows: list = []
+        for t in self._materialize():
+            rows.extend(t.rows())
+        return rows
+
     def num_partitions(self) -> int:
         return max(len(self._materialize()), 1)
 

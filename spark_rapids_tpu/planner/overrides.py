@@ -161,6 +161,13 @@ from spark_rapids_tpu.expressions.zorder import RangeBucketId, ZOrderKey
 
 _SUPPORTED_EXPRS |= {RangeBucketId, ZOrderKey}
 
+from spark_rapids_tpu.expressions.parity import (
+    BitwiseCount, BRound, UnaryPositive, WeekDay)
+
+# the parity module's bridge-only expressions stay unregistered (they
+# resolve to the CPU bridge); these four have device kernels
+_SUPPORTED_EXPRS |= {UnaryPositive, WeekDay, BRound, BitwiseCount}
+
 from spark_rapids_tpu.expressions.hashing import (
     BloomFilterMightContain, Murmur3Hash, XxHash64)
 from spark_rapids_tpu.expressions.strings import GetJsonObject
@@ -353,6 +360,10 @@ class ExprMeta:
                     not isinstance(e.right, E.Literal):
                 self.will_not_work(
                     "non-literal match patterns are not supported yet")
+            if isinstance(e, BRound) and \
+                    not isinstance(e.right, E.Literal):
+                self.will_not_work(
+                    "bround scale must be a literal")
             if isinstance(e, (NullIf, Greatest, Least)):
                 try:
                     if e.children[0].dtype.variable_width:

@@ -284,6 +284,17 @@ def _build_registry() -> None:
     register(Z.RangeBucketId, ExprSig(TypeSig("int"), NUMERIC))
     register(Z.ZOrderKey, ExprSig(TypeSig("long"), INTEGRAL))
 
+    # parity sweep device kernels
+    from spark_rapids_tpu.expressions import parity as PY
+    register(PY.UnaryPositive, ExprSig(NUMERIC_DEC + DEC128,
+                                       NUMERIC_DEC + DEC128))
+    register(PY.WeekDay, ExprSig(TypeSig("int"), TypeSig("date")))
+    register(PY.BRound, ExprSig(NUMERIC, NUMERIC, TypeSig("int"),
+                                note="HALF_EVEN; double path rounds in "
+                                "float64 (sub-ulp ties may differ from "
+                                "BigDecimal)"))
+    register(PY.BitwiseCount, ExprSig(TypeSig("int"), INTEGRAL + BOOL))
+
     # hashing / sketches
     register(H.Murmur3Hash, ExprSig(TypeSig("int"), ORDERED))
     register(H.HiveHash, ExprSig(TypeSig("int"), ORDERED))

@@ -1,0 +1,200 @@
+"""Parity-sweep expressions (reference GpuOverrides expr rules):
+device kernels (unary_positive, weekday, bround, bit_count) run on
+device; regex-capture/format-string/var-width builders run through the
+CPU bridge — all differential across engines."""
+import datetime
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import (
+    array_except, array_intersect, array_join, array_union, bin_,
+    bit_count, bround, col, date_format, date_trunc, from_unixtime, hex_,
+    lit, map_concat, map_from_arrays, md5, regexp_extract,
+    regexp_extract_all, regexp_replace, sha1, sha2, split, str_to_map,
+    substring_index, to_unix_timestamp, unary_positive, weekday)
+from spark_rapids_tpu.expressions.core import Alias
+from tests.test_queries import assert_tpu_cpu_equal
+
+
+def _num_df(s, n=120):
+    rng = np.random.RandomState(7)
+    return s.create_dataframe(
+        {"i": [int(x) if x % 9 else None
+               for x in rng.randint(-10**6, 10**6, n)],
+         "l": rng.randint(-2**40, 2**40, n).tolist(),
+         "d": [float(x) for x in rng.uniform(-1e4, 1e4, n)],
+         "dt": rng.randint(0, 20000, n).tolist()},
+        Schema.of(i=T.INT, l=T.LONG, d=T.DOUBLE, dt=T.DATE),
+        num_partitions=2)
+
+
+def test_device_parity_kernels():
+    rows = assert_tpu_cpu_equal(lambda s: _num_df(s).select(
+        Alias(unary_positive(col("i")), "up"),
+        Alias(weekday(col("dt")), "wd"),
+        Alias(bround(col("d"), 2), "br"),
+        Alias(bround(col("i"), -3), "bri"),
+        Alias(bit_count(col("l")), "bc")))
+    assert all(r[1] is None or 0 <= r[1] <= 6 for r in rows)
+
+
+def test_bround_half_even():
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = s.create_dataframe({"x": [2.5, 3.5, -2.5, 1.25, 1.35]},
+                            Schema.of(x=T.DOUBLE), num_partitions=1)
+    got = [r[0] for r in df.select(Alias(bround(col("x"), 0), "b"))
+           .collect()]
+    assert got[:3] == [2.0, 4.0, -2.0]        # ties to even
+    got2 = [r[0] for r in df.select(Alias(bround(col("x"), 1), "b"))
+            .collect()]
+    # reciprocal-multiply formulation: within 1ulp of BigDecimal's 1.2
+    assert abs(got2[3] - 1.2) < 1e-12
+
+
+def _str_df(s):
+    vals = ["a1b22c333", "2024-01-15 10:20:30", "x,y,z", "no-digits",
+            None, "k1:v1,k2:v2", "aaa-bbb-ccc-ddd"]
+    return s.create_dataframe({"s": vals}, Schema.of(s=T.STRING),
+                              num_partitions=2)
+
+
+def test_regex_capture_family():
+    rows = assert_tpu_cpu_equal(lambda s: _str_df(s).select(
+        Alias(regexp_extract(col("s"), r"(\d+)", 1), "first_num"),
+        Alias(regexp_extract_all(col("s"), r"(\d+)", 1), "all_nums"),
+        Alias(regexp_replace(col("s"), r"\d+", "#"), "masked")))
+    by_val = {r[0]: r for r in rows if r[0] is not None or True}
+    assert ("1", ["1", "22", "333"], "a#b#c#") in [tuple(r) for r in rows]
+    assert ("", [], "no-digits") in [tuple(r) for r in rows]
+
+
+def test_split_and_substring_index():
+    rows = assert_tpu_cpu_equal(lambda s: _str_df(s).select(
+        Alias(split(col("s"), ","), "parts"),
+        Alias(substring_index(col("s"), "-", 2), "si")))
+    assert (["x", "y", "z"], "x,y,z") in [tuple(r) for r in rows]
+    assert any(r[1] == "aaa-bbb" for r in rows if r[1] is not None)
+
+
+def test_array_set_ops_and_join():
+    def q(s):
+        df = s.create_dataframe(
+            {"a": [[1, 2, 2, None], [5, 6], None, []],
+             "b": [[2, 3], [6, 6, 7], [1], [None]]},
+            Schema(("a", "b"), (T.ArrayType(T.LONG), T.ArrayType(T.LONG))),
+            num_partitions=1)
+        return df.select(
+            Alias(array_except(col("a"), col("b")), "ex"),
+            Alias(array_intersect(col("a"), col("b")), "ix"),
+            Alias(array_union(col("a"), col("b")), "un"),
+            Alias(array_join(col("a"), "|", "NULL"), "aj"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0][0] == [1, None]
+    assert rows[0][1] == [2]
+    assert rows[0][2] == [1, 2, None, 3]
+    assert rows[0][3] == "1|2|2|NULL"
+    assert rows[2] == (None, None, None, None)
+
+
+def test_map_builders():
+    def q(s):
+        df = s.create_dataframe(
+            {"m1": [{1: 10}, {2: 20}], "m2": [{1: 99, 3: 30}, {}],
+             "ks": [[7, 8], [9]], "vs": [[70, 80], [90]],
+             "s": ["a:1,b:2", "x:9"]},
+            Schema(("m1", "m2", "ks", "vs", "s"),
+                   (T.MapType(T.INT, T.LONG), T.MapType(T.INT, T.LONG),
+                    T.ArrayType(T.INT), T.ArrayType(T.LONG), T.STRING)),
+            num_partitions=1)
+        return df.select(
+            Alias(map_concat(col("m1"), col("m2")), "mc"),
+            Alias(map_from_arrays(col("ks"), col("vs")), "mfa"),
+            Alias(str_to_map(col("s"), ",", ":"), "stm"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0][0] == {1: 99, 3: 30}       # later map wins
+    assert rows[0][1] == {7: 70, 8: 80}
+    assert rows[0][2] == {"a": "1", "b": "2"}
+
+
+def test_digests_hex_bin():
+    rows = assert_tpu_cpu_equal(lambda s: _str_df(s).select(
+        Alias(md5(col("s")), "m"), Alias(sha1(col("s")), "s1"),
+        Alias(sha2(col("s"), 256), "s2")))
+    import hashlib
+    assert any(r[0] == hashlib.md5(b"x,y,z").hexdigest() for r in rows
+               if r[0] is not None)
+
+    def q(s):
+        df = s.create_dataframe({"l": [255, 0, -1, None]},
+                                Schema.of(l=T.LONG), num_partitions=1)
+        return df.select(Alias(hex_(col("l")), "h"),
+                         Alias(bin_(col("l")), "b"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0] == ("FF", "11111111")
+    assert rows[2][0] == "F" * 16
+
+
+def test_unix_time_family():
+    def q(s):
+        df = s.create_dataframe(
+            {"secs": [0, 86400, 1700000000, None],
+             "txt": ["2024-01-15 10:20:30", "not a date",
+                     "1970-01-01 00:00:00", None]},
+            Schema.of(secs=T.LONG, txt=T.STRING), num_partitions=1)
+        return df.select(
+            Alias(from_unixtime(col("secs")), "fu"),
+            Alias(to_unix_timestamp(col("txt")), "tu"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0][0] == "1970-01-01 00:00:00"
+    assert rows[1][1] is None                 # unparseable -> null
+    assert rows[2][1] == 0
+
+
+def test_date_format_and_trunc():
+    base = 1_700_000_000 * 1_000_000 + 123_456   # micros
+    def q(s):
+        df = s.create_dataframe({"ts": [base, None]},
+                                Schema.of(ts=T.TIMESTAMP),
+                                num_partitions=1)
+        return df.select(
+            Alias(date_format(col("ts"), "yyyy-MM-dd"), "df"),
+            Alias(date_trunc("hour", col("ts")), "tr"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0][0] == "2023-11-14"
+    tr = rows[0][1]
+    assert tr % (3600 * 1_000_000) == 0
+    assert rows[1] == (None, None)
+
+
+def test_unsupported_format_fails_at_construction():
+    with pytest.raises(NotImplementedError, match="format"):
+        from_unixtime(col("x"), "yyyy-MM-dd EEE")
+    with pytest.raises(NotImplementedError, match="trunc"):
+        date_trunc("millennium", col("x"))
+
+
+def test_weekday_over_timestamp_bridges():
+    """Timestamp input bridges and casts to a session-zone date first
+    (1970-01-02 00:00:01 is a Friday = 4)."""
+    def q(s):
+        df = s.create_dataframe(
+            {"ts": [86_400_000_001, 0, None]},
+            Schema.of(ts=T.TIMESTAMP), num_partitions=1)
+        return df.select(Alias(weekday(col("ts")), "wd"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0][0] == 4 and rows[1][0] == 3 and rows[2][0] is None
+
+
+def test_format_number_specials():
+    from spark_rapids_tpu.expressions import format_number
+    def q(s):
+        df = s.create_dataframe(
+            {"x": [float("nan"), float("inf"), float("-inf"), 1.5]},
+            Schema.of(x=T.DOUBLE), num_partitions=1)
+        return df.select(Alias(format_number(col("x"), 1), "f"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert [r[0] for r in rows] == ["NaN", "∞", "-∞", "1.5"]

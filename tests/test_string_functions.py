@@ -122,3 +122,25 @@ def test_conv_number_bases():
     assert rows[0][0] == "257"                     # 0x101
     assert rows[1][0] == "18446744073709551361"    # -0xff unsigned wrap
     assert rows[1][3] == "-255"                    # signed target base
+
+
+def test_format_number():
+    """format_number via the CPU bridge: grouping + fixed decimals +
+    null/negative-d semantics (reference GpuFormatNumber)."""
+    from spark_rapids_tpu.expressions import format_number
+    from spark_rapids_tpu.expressions.core import Alias
+
+    def q(s):
+        df = s.create_dataframe(
+            {"x": [1234567.891, 0.5, -9876543.21, None, 2.0],
+             "d": [2, 0, 3, 1, None]},
+            Schema.of(x=T.DOUBLE, d=T.INT), num_partitions=1)
+        return df.select(Alias(format_number(col("x"), 2), "fixed"),
+                         Alias(format_number(col("x"), col("d")), "per_row"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0][0] == "1,234,567.89"
+    assert rows[2][0] == "-9,876,543.21"
+    assert rows[0][1] == "1,234,567.89"
+    assert rows[1][1] == "0"            # d=0 drops the decimal point
+    assert rows[3] == (None, None)
+    assert rows[4][1] is None           # null d -> null

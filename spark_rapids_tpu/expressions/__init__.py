@@ -178,7 +178,8 @@ from spark_rapids_tpu.expressions.datetime import (
     FromUtcTimestamp, ToUtcTimestamp, from_utc_timestamp,
     to_utc_timestamp)
 from spark_rapids_tpu.expressions.aggregates import (
-    ApproxPercentile, Percentile, approx_percentile, percentile)
+    ApproxPercentile, CollectList, CollectSet, Percentile,
+    approx_percentile, collect_list, collect_set, percentile)
 from spark_rapids_tpu.expressions.hashing import HiveHash, hive_hash
 from spark_rapids_tpu.expressions.strings import (
     Conv, FormatNumber, ParseUrl, conv, format_number, parse_url)

@@ -931,3 +931,115 @@ def approx_percentile(e, p, accuracy: int = 10000) -> ApproxPercentile:
     """p may be a float or a list of floats (array percentages)."""
     from spark_rapids_tpu.expressions.core import col
     return ApproxPercentile(col(e) if isinstance(e, str) else e, p, accuracy)
+
+
+class CollectList(AggregateFunction):
+    """collect_list(col) (GpuCollectList): the group's non-null values as
+    an array, input order preserved within each partial.
+
+    Buffer: the existing COLLECT machinery (float64 element plane), so
+    elements are gated to types float64 represents EXACTLY (int/short/
+    byte/float/double/date/boolean — not long/decimal; typesig note).
+    Empty/only-null groups produce an EMPTY array (Spark), not null."""
+
+    name = "collect_list"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.ArrayType(self.children[0].dtype, contains_null=False)
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def buffers(self):
+        return (BufferSlot(T.ArrayType(T.DOUBLE, contains_null=False),
+                           COLLECT, COLLECT_MERGE),)
+
+    def _cast_row(self, vals):
+        et = self.dtype.element_type
+        if et.is_integral or isinstance(et, (T.DateType, T.BooleanType)):
+            caster = bool if isinstance(et, T.BooleanType) else int
+            return [caster(x) for x in vals]
+        if isinstance(et, T.FloatType):
+            return [np.float32(x).item() for x in vals]
+        return [float(x) for x in vals]
+
+    def finalize_np(self, bufs):
+        (rows, valid), = bufs
+        n = len(rows)
+        out = np.empty((n,), object)
+        for i in range(n):
+            out[i] = self._cast_row(rows[i]) if valid[i] and \
+                rows[i] is not None else []
+        return out, np.ones((n,), np.bool_)
+
+    def _element_plane(self, col):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+        et = self.dtype.element_type
+        data = col.data.astype(et.jnp_dtype)
+        return DeviceColumn(data, col.validity, self.dtype, col.offsets,
+                            col.child_validity)
+
+    def finalize_jnp(self, bufs):
+        (col, valid), = bufs
+        return self._element_plane(col), valid
+
+    def __repr__(self):
+        return f"collect_list({self.children[0]!r})"
+
+
+class CollectSet(CollectList):
+    """collect_set(col) (GpuCollectSet): distinct values per group
+    (first-occurrence order; NaN one value, -0.0 == 0.0 like Spark's
+    normalized equality)."""
+
+    name = "collect_set"
+
+    def finalize_np(self, bufs):
+        import math as _m
+        (rows, valid), = bufs
+        n = len(rows)
+        out = np.empty((n,), object)
+        for i in range(n):
+            if not valid[i] or rows[i] is None:
+                out[i] = []
+                continue
+            seen = set()
+            uniq = []
+            for x in rows[i]:
+                key = ("nan",) if isinstance(x, float) and _m.isnan(x) \
+                    else (0.0 if x == 0 else x)
+                if key not in seen:
+                    seen.add(key)
+                    uniq.append(x)
+            out[i] = self._cast_row(uniq)
+        return out, np.ones((n,), np.bool_)
+
+    def finalize_jnp(self, bufs):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.kernels.collections import segment_distinct
+        (col, valid), = bufs
+        nrows = jnp.sum(valid.astype(jnp.int32))
+        distinct = segment_distinct(col, nrows)
+        return self._element_plane(distinct), valid
+
+    def __repr__(self):
+        return f"collect_set({self.children[0]!r})"
+
+
+def collect_list(e) -> CollectList:
+    from spark_rapids_tpu.expressions.core import col as _col
+    return CollectList(_col(e) if isinstance(e, str) else e)
+
+
+def collect_set(e) -> CollectSet:
+    from spark_rapids_tpu.expressions.core import col as _col
+    return CollectSet(_col(e) if isinstance(e, str) else e)

@@ -149,26 +149,19 @@ class BitwiseCount(UnaryExpression):
 
     def eval(self, ctx: EvalContext):
         c = self.child.eval(ctx)
-        dt = c.data.dtype
-        u = c.data.astype({jnp.int64: jnp.uint64, jnp.int32: jnp.uint32,
-                           jnp.int16: jnp.uint16, jnp.int8: jnp.uint8,
-                           jnp.bool_: jnp.uint8}.get(dt.type, jnp.uint32)
-                          if dt != jnp.bool_ else jnp.uint8)
+        # SIGN-EXTEND to 64 bits first (Spark = Long.bitCount: -1 in any
+        # width counts 64, not the native width)
+        u = c.data.astype(jnp.int64).astype(jnp.uint64)
         cnt = jax.lax.population_count(u).astype(jnp.int32)
         return make_column(cnt, c.validity & ctx.live_mask(), T.INT)
 
     def eval_cpu(self, ctx: CpuEvalContext):
         v, m = self.child.eval_cpu(ctx)
-        if v.dtype == np.bool_:
-            cnt = v.astype(np.int32)
-        else:
-            w = v.dtype.itemsize
-            u = v.astype({1: np.uint8, 2: np.uint16, 4: np.uint32,
-                          8: np.uint64}[w])
-            cnt = np.zeros(v.shape, np.int32)
-            for _ in range(w * 8):
-                cnt += (u & 1).astype(np.int32)
-                u = u >> 1
+        u = np.asarray(v).astype(np.int64).astype(np.uint64)
+        cnt = np.zeros(u.shape, np.int32)
+        for _ in range(64):
+            cnt += (u & 1).astype(np.int32)
+            u = u >> 1
         return cnt, m.copy()
 
 
@@ -284,6 +277,36 @@ class RegexpExtractAll(_BridgeExpr):
                 f"{self.pattern!r}, {self.idx})")
 
 
+def _java_replacement_to_python(repl: str) -> str:
+    """Java Matcher.replaceAll replacement -> python re.sub template:
+    $<digits> group refs become \\g<n>; java \\X escapes become the
+    LITERAL X; stray backslashes/dollars escape safely."""
+    out = []
+    i = 0
+    while i < len(repl):
+        ch = repl[i]
+        if ch == "\\" and i + 1 < len(repl):
+            nxt = repl[i + 1]
+            out.append("\\\\" if nxt == "\\" else nxt.replace(
+                "\\", "\\\\"))
+            i += 2
+            continue
+        if ch == "$":
+            j = i + 1
+            while j < len(repl) and repl[j].isdigit():
+                j += 1
+            if j > i + 1:
+                out.append(f"\\g<{repl[i + 1:j]}>")
+                i = j
+                continue
+            out.append("$")
+            i += 1
+            continue
+        out.append("\\\\" if ch == "\\" else ch)
+        i += 1
+    return "".join(out)
+
+
 class RegexpReplace(_BridgeExpr):
     """regexp_replace(s, pattern, replacement) (GpuRegExpReplace).
     Java $1 backreferences translate to python \\1."""
@@ -293,8 +316,7 @@ class RegexpReplace(_BridgeExpr):
         self.pattern = pattern
         self.replacement = replacement
         self._re = _compile_java_regex(pattern)
-        import re as _re
-        self._repl = _re.sub(r"\$(\d)", r"\\\1", replacement)
+        self._repl = _java_replacement_to_python(replacement)
 
     def with_children(self, children):
         return RegexpReplace(children[0], self.pattern, self.replacement)
@@ -429,7 +451,18 @@ class _ArraySetOp(BinaryExpression):
         return out, ok
 
     @staticmethod
-    def _dedupe(vals):
+    def _key(x):
+        """Spark normalized equality: NaN == NaN, -0.0 == 0.0."""
+        import math as _m
+        if isinstance(x, float):
+            if _m.isnan(x):
+                return ("nan",)
+            if x == 0.0:
+                return 0.0
+        return x
+
+    @classmethod
+    def _dedupe(cls, vals):
         seen = set()
         saw_null = False
         out = []
@@ -439,28 +472,30 @@ class _ArraySetOp(BinaryExpression):
                     saw_null = True
                     out.append(None)
                 continue
-            if x not in seen:
-                seen.add(x)
+            k = cls._key(x)
+            if k not in seen:
+                seen.add(k)
                 out.append(x)
         return out
 
 
 class ArrayExcept(_ArraySetOp):
     def _combine(self, a, b):
-        bs = set(x for x in b if x is not None)
+        bs = set(self._key(x) for x in b if x is not None)
         bnull = any(x is None for x in b)
         return self._dedupe([x for x in a
                              if (x is None and not bnull)
-                             or (x is not None and x not in bs)])
+                             or (x is not None
+                                 and self._key(x) not in bs)])
 
 
 class ArrayIntersect(_ArraySetOp):
     def _combine(self, a, b):
-        bs = set(x for x in b if x is not None)
+        bs = set(self._key(x) for x in b if x is not None)
         bnull = any(x is None for x in b)
         return self._dedupe([x for x in a
                              if (x is None and bnull)
-                             or (x is not None and x in bs)])
+                             or (x is not None and self._key(x) in bs)])
 
 
 class ArrayUnion(_ArraySetOp):
@@ -469,14 +504,17 @@ class ArrayUnion(_ArraySetOp):
 
 
 class MapConcat(_BridgeExpr):
-    """map_concat(m1, m2, ...): later maps win duplicate keys (Spark
-    LAST_WIN default)."""
+    """map_concat(m1, m2, ...).  Duplicate keys RAISE like Spark's
+    default spark.sql.mapKeyDedupPolicy=EXCEPTION; pass
+    dedup_policy="LAST_WIN" for the opt-in overwrite behavior."""
 
-    def __init__(self, children):
+    def __init__(self, children, dedup_policy: str = "EXCEPTION"):
         self.children = tuple(children)
+        assert dedup_policy in ("EXCEPTION", "LAST_WIN"), dedup_policy
+        self.dedup_policy = dedup_policy
 
     def with_children(self, children):
-        return MapConcat(children)
+        return MapConcat(children, self.dedup_policy)
 
     @property
     def dtype(self):
@@ -485,7 +523,13 @@ class MapConcat(_BridgeExpr):
     def _row(self, *maps):
         out = {}
         for m in maps:
-            out.update(dict(m.items() if isinstance(m, dict) else m))
+            for k, v in (m.items() if isinstance(m, dict) else m):
+                if k in out and self.dedup_policy == "EXCEPTION":
+                    raise ValueError(
+                        f"duplicate map key {k!r} (Spark "
+                        "mapKeyDedupPolicy=EXCEPTION; build with "
+                        'dedup_policy="LAST_WIN" to overwrite)')
+                out[k] = v
         return out
 
 
@@ -506,6 +550,9 @@ class MapFromArrays(_BridgeExpr):
     def _row(self, ks, vs):
         if len(ks) != len(vs):
             raise ValueError("map_from_arrays: length mismatch")
+        if len(set(ks)) != len(ks):
+            raise ValueError("map_from_arrays: duplicate map key (Spark "
+                             "mapKeyDedupPolicy=EXCEPTION)")
         return dict(zip(ks, vs))
 
 
@@ -755,10 +802,10 @@ class DateFormat(_BridgeExpr):
         return T.STRING
 
     def _row(self, micros):
-        from datetime import datetime, timezone
-        dt = datetime.fromtimestamp(int(micros) / MICROS,
-                                    tz=timezone.utc) \
-            .astimezone(_session_zone())
+        from datetime import datetime, timedelta, timezone
+        secs, rem = divmod(int(micros), MICROS)
+        dt = (datetime.fromtimestamp(secs, tz=timezone.utc)
+              + timedelta(microseconds=rem)).astimezone(_session_zone())
         return dt.strftime(self._strf)
 
     def __repr__(self):
@@ -791,8 +838,9 @@ class TruncTimestamp(_BridgeExpr):
     def _row(self, micros):
         from datetime import datetime, timedelta, timezone
         z = _session_zone()
-        dt = datetime.fromtimestamp(int(micros) / MICROS,
-                                    tz=timezone.utc).astimezone(z)
+        secs, rem = divmod(int(micros), MICROS)
+        dt = (datetime.fromtimestamp(secs, tz=timezone.utc)
+              + timedelta(microseconds=rem)).astimezone(z)
         f = self.fmt
         if f in ("year", "yyyy", "yy"):
             dt = dt.replace(month=1, day=1, hour=0, minute=0, second=0,
@@ -881,8 +929,8 @@ def array_union(a, b):
     return ArrayUnion(_c(a), _c(b))
 
 
-def map_concat(*maps):
-    return MapConcat([_c(m) for m in maps])
+def map_concat(*maps, dedup_policy: str = "EXCEPTION"):
+    return MapConcat([_c(m) for m in maps], dedup_policy)
 
 
 def map_from_arrays(keys, values):

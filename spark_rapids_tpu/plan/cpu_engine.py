@@ -460,7 +460,11 @@ class CpuEngine:
                     if slot.update_op == COUNT_VALID:
                         bv[gi] = len(sel)
                     elif slot.update_op == COLLECT:
-                        bv[gi] = [float(x) for x in vals[sel]]
+                        # keep the NATIVE element type: collect_list over
+                        # longs must stay exact (Percentile's finalize
+                        # re-floats for its own math)
+                        bv[gi] = [x.item() if hasattr(x, "item") else x
+                                  for x in vals[sel]]
                     elif slot.update_op in (TD_MEANS, TD_WEIGHTS):
                         from spark_rapids_tpu.kernels.tdigest import np_digest
                         ms, ws = np_digest(

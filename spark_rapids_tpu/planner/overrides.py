@@ -176,7 +176,7 @@ from spark_rapids_tpu.expressions.hashing import HiveHash
 
 _SUPPORTED_EXPRS |= {Murmur3Hash, XxHash64, BloomFilterMightContain,
                      GetJsonObject, HiveHash, A.Percentile,
-                     A.ApproxPercentile}
+                     A.ApproxPercentile, A.CollectList, A.CollectSet}
 
 # dtypes device kernels support in expression compute
 _COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,

@@ -326,6 +326,16 @@ def _build_registry() -> None:
                                    "integral; negative frequencies raise "
                                    "in the oracle, clamp to 0 on "
                                    "device); array percentages"))
+    _F64_EXACT = (TypeSig("byte", "short", "int", "float", "double",
+                          "date", "boolean"))
+    register(A.CollectList,
+             ExprSig(ARR, _F64_EXACT,
+                     note="float64 collect plane: element types beyond "
+                     "its exact range (long, decimal) fall back"))
+    register(A.CollectSet,
+             ExprSig(ARR, _F64_EXACT,
+                     note="distinct via segment_distinct (NaN one value, "
+                     "-0.0 == 0.0); same element gate as collect_list"))
     register(A.ApproxPercentile,
              ExprSig(NUMERIC + ARR, NUMERIC,
                      note="t-digest, input-typed result (array of it for "

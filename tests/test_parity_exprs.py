@@ -111,11 +111,12 @@ def test_map_builders():
                     T.ArrayType(T.INT), T.ArrayType(T.LONG), T.STRING)),
             num_partitions=1)
         return df.select(
-            Alias(map_concat(col("m1"), col("m2")), "mc"),
+            Alias(map_concat(col("m1"), col("m2"),
+                             dedup_policy="LAST_WIN"), "mc"),
             Alias(map_from_arrays(col("ks"), col("vs")), "mfa"),
             Alias(str_to_map(col("s"), ",", ":"), "stm"))
     rows = assert_tpu_cpu_equal(q, ignore_order=False)
-    assert rows[0][0] == {1: 99, 3: 30}       # later map wins
+    assert rows[0][0] == {1: 99, 3: 30}       # LAST_WIN opt-in
     assert rows[0][1] == {7: 70, 8: 80}
     assert rows[0][2] == {"a": "1", "b": "2"}
 
@@ -198,3 +199,109 @@ def test_format_number_specials():
         return df.select(Alias(format_number(col("x"), 1), "f"))
     rows = assert_tpu_cpu_equal(q, ignore_order=False)
     assert [r[0] for r in rows] == ["NaN", "∞", "-∞", "1.5"]
+
+
+def test_collect_list_and_set():
+    from spark_rapids_tpu.expressions import (col, collect_list,
+                                              collect_set, count)
+
+    def q(s):
+        df = s.create_dataframe(
+            {"k": [0, 0, 0, 1, 1, 2, 2, 2],
+             "v": [3, 1, 3, None, 5, 0, -0, 7],
+             "d": [1.5, float("nan"), float("nan"), 2.0, None, -0.0,
+                   0.0, 1.5]},
+            Schema.of(k=T.INT, v=T.INT, d=T.DOUBLE), num_partitions=2)
+        return df.group_by("k").agg(
+            Alias(collect_list(col("v")), "cl"),
+            Alias(collect_set(col("v")), "cs"),
+            Alias(collect_set(col("d")), "cds"))
+    rows = {r[0]: r for r in assert_tpu_cpu_equal(q)}
+    assert sorted(rows[0][1]) == [1, 3, 3]          # list keeps dups
+    assert sorted(rows[0][2]) == [1, 3]             # set dedups
+    assert rows[1][1] == [5]                        # nulls skipped
+    import math
+    # k=2 doubles: [-0.0, 0.0, 1.5] -> {0.0, 1.5}
+    assert len(rows[2][3]) == 2
+    cds0 = rows[0][3]
+    assert sum(1 for x in cds0 if math.isnan(x)) == 1  # NaN one value
+
+
+def test_collect_list_empty_group_is_empty_array():
+    from spark_rapids_tpu.expressions import col, collect_list
+
+    def q(s):
+        df = s.create_dataframe(
+            {"k": [0, 1], "v": [None, 4]},
+            Schema.of(k=T.INT, v=T.INT), num_partitions=1)
+        return df.group_by("k").agg(Alias(collect_list(col("v")), "cl"))
+    rows = {r[0]: r[1] for r in assert_tpu_cpu_equal(q)}
+    assert rows[0] == [] and rows[1] == [4]
+
+
+def test_collect_long_falls_back():
+    """LONG elements exceed the float64 plane's exact range: the agg
+    must fall back (whole plan on oracle), not silently lose precision."""
+    from spark_rapids_tpu.expressions import col, collect_list
+    big = (1 << 60) + 1
+
+    def q(s):
+        df = s.create_dataframe(
+            {"k": [0, 0], "v": [big, big + 2]},
+            Schema.of(k=T.INT, v=T.LONG), num_partitions=1)
+        return df.group_by("k").agg(Alias(collect_list(col("v")), "cl"))
+    rows = assert_tpu_cpu_equal(q)
+    assert sorted(rows[0][1]) == [big, big + 2]     # exact, via fallback
+
+
+
+def test_map_concat_duplicate_raises_by_default():
+    from spark_rapids_tpu.expressions import map_concat
+    s = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = s.create_dataframe(
+        {"m1": [{1: 10}], "m2": [{1: 99}]},
+        Schema(("m1", "m2"),
+               (T.MapType(T.INT, T.LONG), T.MapType(T.INT, T.LONG))),
+        num_partitions=1)
+    with pytest.raises(Exception, match="[Dd]uplicate map key"):
+        df.select(Alias(map_concat(col("m1"), col("m2")), "mc")).collect()
+
+
+def test_bit_count_sign_extends():
+    from spark_rapids_tpu.expressions import bit_count
+    def q(s):
+        df = s.create_dataframe({"i": [-1, 0, 5]}, Schema.of(i=T.INT),
+                                num_partitions=1)
+        return df.select(Alias(bit_count(col("i")), "bc"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert [r[0] for r in rows] == [64, 0, 2]   # Long.bitCount semantics
+
+
+def test_regexp_replace_java_dollars():
+    from spark_rapids_tpu.expressions import regexp_replace
+    def q(s):
+        df = s.create_dataframe({"s": ["ab12cd"]}, Schema.of(s=T.STRING),
+                                num_partitions=1)
+        return df.select(
+            Alias(regexp_replace(col("s"), r"(\d+)", "[$1]"), "grp"),
+            Alias(regexp_replace(col("s"), r"\d+", "\\$"), "lit_dollar"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0][0] == "ab[12]cd"
+    assert rows[0][1] == "ab$cd"
+
+
+def test_array_set_ops_nan_semantics():
+    from spark_rapids_tpu.expressions import array_except, array_union
+    nan = float("nan")
+    def q(s):
+        df = s.create_dataframe(
+            {"a": [[nan, 1.0]], "b": [[nan]]},
+            Schema(("a", "b"),
+                   (T.ArrayType(T.DOUBLE), T.ArrayType(T.DOUBLE))),
+            num_partitions=1)
+        return df.select(Alias(array_except(col("a"), col("b")), "ex"),
+                         Alias(array_union(col("a"), col("b")), "un"))
+    rows = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert rows[0][0] == [1.0]                # NaN == NaN removes it
+    import math
+    assert sum(1 for x in rows[0][1] if math.isnan(x)) == 1

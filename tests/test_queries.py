@@ -54,6 +54,10 @@ def _eq_val(a, b):
         if math.isnan(a) and math.isnan(b):
             return True
         return a == b or math.isclose(a, b, rel_tol=1e-9, abs_tol=1e-12)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        # element-wise so NaN/float tolerance applies inside arrays
+        return len(a) == len(b) and all(_eq_val(x, y)
+                                        for x, y in zip(a, b))
     return a == b
 
 

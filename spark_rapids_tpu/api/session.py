@@ -253,7 +253,14 @@ class TpuSession:
         would silently discard the declared partition spec."""
         from spark_rapids_tpu.io.iceberg import IcebergTable
         table = IcebergTable.load(table_path)
-        specs = table.meta.get("partition-specs") or []
+        specs = list(table.meta.get("partition-specs") or [])
+        # v1 metadata can declare partitioning ONLY via the singular
+        # 'partition-spec' field (ADVICE r4 #2: a legacy table slipping
+        # past the v2-only check would be rewritten unpartitioned —
+        # exactly the silent layout loss this guard exists to prevent)
+        v1_fields = table.meta.get("partition-spec") or []
+        if v1_fields:
+            specs.append({"fields": v1_fields})
         if any(s.get("fields") for s in specs):
             raise NotImplementedError(
                 "iceberg_optimize over identity-partitioned tables: the "

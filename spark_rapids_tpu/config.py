@@ -147,6 +147,14 @@ BATCH_SIZE_ROWS = conf("spark.rapids.sql.batchSizeRows").doc(
     "up to a power of two so XLA re-compiles at most log2(n) variants."
 ).int_conf(1 << 20)
 
+STAGE_FUSION = conf("spark.rapids.sql.tpu.fuseStages").doc(
+    "Fuse exchange-free operator chains (project/filter/broadcast-join/"
+    "partial-agg) into one XLA program per batch, eliminating per-operator "
+    "program launches and host round trips (the reference keeps per-batch "
+    "operator chains device-side, GpuExec.scala:393; on a tunneled TPU "
+    "each launch is a host round trip)."
+).boolean_conf(True)
+
 CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentGpuTasks").doc(
     "Number of tasks that can hold the device semaphore concurrently "
     "(reference: RapidsConf.scala:637, GpuSemaphore)."
@@ -479,6 +487,10 @@ class RapidsConf:
     @property
     def concurrent_tpu_tasks(self) -> int:
         return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def fuse_stages(self) -> bool:
+        return self.get(STAGE_FUSION)
 
     @property
     def multithreaded_read_threads(self) -> int:

@@ -214,10 +214,57 @@ class _BridgeExpr(Expression):
 
 
 def _compile_java_regex(pattern: str):
-    """Java-dialect regex -> python re (shared dialect subset; the device
-    transpiler handles matching, this path handles captures)."""
+    """Java-dialect regex -> python re, restricted to the shared-dialect
+    subset (ADVICE r4 #3: passing patterns verbatim silently diverged for
+    dialect differences).  Rules:
+
+      * compiled with re.ASCII so \\d/\\w/\\s/\\b match Java's ASCII
+        defaults instead of Python's unicode-aware classes;
+      * Java \\z (absolute end) translates to Python \\Z;
+      * Java \\Z (end before final terminator) and character-class
+        intersection [a&&[b]] have no Python equivalent -> rejected at
+        construction (plan-time, like the datetime-format rejection);
+      * Java-only syntax Python cannot parse (possessive quantifiers,
+        \\p{javaLowerCase}, ...) raises re.error at construction — loud,
+        never a silent divergence.
+    """
     import re
-    return re.compile(pattern)
+    out = []
+    i = 0
+    in_class = False
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\" and i + 1 < len(pattern):
+            esc = pattern[i + 1]
+            if esc == "Z":
+                raise NotImplementedError(
+                    "Java \\Z (end before final line terminator) differs "
+                    "from Python \\Z (absolute end)")
+            out.append("\\Z" if esc == "z" else "\\" + esc)
+            i += 2
+            continue
+        if ch == "[":
+            if in_class:
+                # Java nests classes ([a[b]] is union); Python re treats
+                # the inner '[' as a literal — a silent divergence, and
+                # also how intersection operands hide ([[a-c]&&[b]])
+                raise NotImplementedError(
+                    "Java nested character class ([a[b]]) has no Python "
+                    "re equivalent")
+            in_class = True
+        elif ch == "]":
+            in_class = False
+        elif (in_class and ch == "&" and i + 1 < len(pattern)
+              and pattern[i + 1] == "&"):
+            # only INSIDE an unescaped class is && Java intersection
+            # syntax; a literal && elsewhere means the same in both
+            # dialects and must keep working
+            raise NotImplementedError(
+                "Java character-class intersection ([a&&[b]]) has no "
+                "Python re equivalent")
+        out.append(ch)
+        i += 1
+    return re.compile("".join(out), re.ASCII)
 
 
 class RegexpExtract(_BridgeExpr):
@@ -575,6 +622,13 @@ class StringToMap(_BridgeExpr):
         out = {}
         for pair in str(s).split(self.pair_delim):
             k, sep, v = pair.partition(self.kv_delim)
+            if k in out:
+                # Spark's default mapKeyDedupPolicy=EXCEPTION: str_to_map
+                # raises on duplicate keys, same as MapFromArrays/MapConcat
+                # above (ADVICE r4 #1 — last-wins silently diverged)
+                raise ValueError(
+                    f"str_to_map: duplicate map key {k!r} (Spark "
+                    "mapKeyDedupPolicy=EXCEPTION)")
             out[k] = v if sep else None
         return out
 

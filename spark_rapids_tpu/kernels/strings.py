@@ -39,6 +39,21 @@ def live_string_bucket(col: DeviceColumn, num_rows) -> int:
     return bucket_for(int(max_live_string_bytes(col, num_rows)))
 
 
+def max_live_bytes_multi(pairs) -> int:
+    """Max live string byte length over ``(column, num_rows)`` pairs in
+    ONE device sync (per-column int() syncs would stall the dispatch
+    pipeline once per column); 0 when no pair is string-like.  The single
+    shared reduction behind every bucket derivation — fused segments,
+    aggregate merge/combine buckets — so a future change to bucket policy
+    lands in one place."""
+    vals = [max_live_string_bytes(c, n) for c, n in pairs
+            if c.is_string_like]
+    if not vals:
+        return 0
+    return int(jax.device_get(
+        jnp.max(jnp.stack([jnp.asarray(v) for v in vals]))))
+
+
 def live_string_bucket_for_batch(batch, col_indices) -> int:
     """Common bucket covering several string columns of a batch."""
     m = 0

@@ -100,6 +100,13 @@ def _exec_signature(node) -> str:
                 expr_cache_key(t[0]) + "/" + repr(t[1]) for t in v) + "]")
         elif isinstance(v, (str, int, float, bool, type(None))):
             atoms.append(f"{k}={v!r}")
+        elif (isinstance(v, (tuple, list)) and all(
+                isinstance(t, (str, int, float, bool, type(None)))
+                for t in v)):
+            # scalar lists (join key ordinals!) must enter the signature:
+            # two joins differing only in key columns would otherwise
+            # share a cached program
+            atoms.append(f"{k}={list(v)!r}")
     return ("|".join(atoms) + "("
             + ",".join(_exec_signature(c) for c in node.children) + ")")
 

@@ -380,14 +380,11 @@ class _AggDeviceSpec:
 
     def _merge_bucket(self, partial: ColumnarBatch) -> int:
         from spark_rapids_tpu.kernels import strings as SK
-        m = 0
-        has_string = False
-        for i in range(len(self.group_exprs)):
-            c = partial.columns[i]
-            if c.is_string_like:
-                has_string = True
-                m = max(m, int(SK.max_live_string_bytes(c, partial.num_rows)))
-        return SK.bucket_for(m) if has_string else 0
+        pairs = [(partial.columns[i], partial.num_rows)
+                 for i in range(len(self.group_exprs))]
+        if not any(c.is_string_like for c, _ in pairs):
+            return 0
+        return SK.bucket_for(SK.max_live_bytes_multi(pairs))
 
     def _partial_step(self, batch: ColumnarBatch,
                       string_bucket: int = 0) -> ColumnarBatch:
@@ -688,6 +685,35 @@ class TpuHashAggregateExec(TpuExec):
         self._jit_finalize = lambda b, _k=key: shared_jit(
             f"{_k}|finalize", lambda: spec._finalize)(b)
 
+        # in-core reduce path as ONE program: concat + merge + finalize.
+        # The per-op path pays three launches per reduce partition; on a
+        # tunneled TPU each is a host round trip (VERDICT r4 #1).  OOC
+        # paths keep the split functions (they need merge sans finalize).
+        def combine(partials, string_bucket: int = 0):
+            if len(partials) == 1:
+                merged_in = partials[0]
+            else:
+                from spark_rapids_tpu.kernels.selection import (
+                    concat_batches_device)
+                cap = round_up_pow2(
+                    max(sum(p.capacity for p in partials), 1))
+                merged_in, _ = concat_batches_device(
+                    list(partials), cap)
+            return spec._finalize(
+                spec._merge_step(merged_in, string_bucket=string_bucket))
+
+        def _combine_bucket(partials) -> int:
+            from spark_rapids_tpu.kernels import strings as SK
+            pairs = [(p.columns[i], p.num_rows) for p in partials
+                     for i in range(len(spec.group_exprs))]
+            if not any(c.is_string_like for c, _ in pairs):
+                return 0
+            return SK.bucket_for(SK.max_live_bytes_multi(pairs))
+
+        self._jit_combine = lambda ps, _k=key: shared_jit(
+            f"{_k}|combine|{len(ps)}|{(bkt := _combine_bucket(ps))}",
+            lambda: _partial(combine, string_bucket=bkt))(tuple(ps))
+
     # -- host-side orchestration -------------------------------------------
 
     def _identity_partial(self) -> ColumnarBatch:
@@ -737,7 +763,9 @@ class TpuHashAggregateExec(TpuExec):
                 if not partials and len(self.group_exprs) == 0:
                     partials = [self._identity_partial()]
                 for p in partials:
-                    self.output_rows.add(p.host_num_rows())
+                    # device scalar: Metric.add defers the sync (a per-batch
+                    # host_num_rows here cost one round trip per batch)
+                    self.output_rows.add(p.num_rows)
                     yield self._count_out(p)
                 return
             if not partials:
@@ -750,8 +778,7 @@ class TpuHashAggregateExec(TpuExec):
             yield from self._execute_out_of_core(partials, total)
             return
         with timed(self.op_time):
-            merged = self._merge_partials(partials)
-            out = with_retry_no_split(lambda: self._jit_finalize(merged))
+            out = with_retry_no_split(lambda: self._jit_combine(partials))
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 

@@ -128,12 +128,15 @@ class TpuShuffleExchangeExec(TpuExec):
                         lambda: self._jit_slice(batch))
                     from spark_rapids_tpu.plan.execs.out_of_core import (
                         slice_by_counts)
-                    pieces = slice_by_counts(reordered, counts,
+                    host_counts = np.asarray(counts)   # ONE sync per batch
+                    pieces = slice_by_counts(reordered, host_counts,
                                              self.out_partitions)
                     for p, piece in enumerate(pieces):
                         if piece is not None:
                             if self._want_part_stats:
-                                self._part_rows[p] += piece.host_num_rows()
+                                # piece rows == the slice count; a per-piece
+                                # host_num_rows would re-sync per partition
+                                self._part_rows[p] += int(host_counts[p])
                             yield p, piece
 
     def partition_row_counts(self) -> List[int]:

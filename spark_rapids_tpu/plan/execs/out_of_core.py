@@ -65,6 +65,32 @@ def slice_by_counts(
     np.cumsum(host_counts, out=offsets[1:])
     bcaps = ",".join(str(c.byte_capacity) for c in reordered.columns
                      if c.offsets is not None)
+    max_cnt = int(host_counts.max()) if num_buckets else 0
+    if max_cnt == 0:
+        return [None] * num_buckets
+    ucap = round_up_pow2(max_cnt)
+    if num_buckets > 1 and ucap * num_buckets <= 4 * reordered.capacity:
+        # balanced pieces (the hash-partition common case): gather ALL
+        # buckets at one uniform capacity in ONE program — the per-piece
+        # loop costs one launch per bucket per batch (a host round trip
+        # each on a tunneled TPU, the q3 launch-storm driver).  Offsets
+        # and counts enter as dynamic args so re-slicing never recompiles;
+        # the 4x capacity guard routes skewed splits to the per-piece path.
+        def slice_all(rb, offs, cnts):
+            pieces = []
+            for p in range(num_buckets):
+                idx = jnp.arange(ucap, dtype=jnp.int32) + offs[p]
+                pieces.append(gather_batch(rb, idx, cnts[p],
+                                           out_capacity=ucap))
+            return tuple(pieces)
+        key = (f"oocsliceall|{schema_cache_key(reordered.schema)}|"
+               f"{reordered.capacity}|{bcaps}|{ucap}|{num_buckets}")
+        pieces = shared_jit(key, lambda: slice_all)(
+            reordered,
+            jnp.asarray(offsets[:num_buckets].astype(np.int32)),
+            jnp.asarray(host_counts.astype(np.int32)))
+        return [pieces[p] if int(host_counts[p]) else None
+                for p in range(num_buckets)]
     out: List[Optional[ColumnarBatch]] = []
     for p in range(num_buckets):
         cnt = int(host_counts[p])

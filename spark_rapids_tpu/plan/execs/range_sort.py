@@ -185,10 +185,14 @@ class TpuRangeSortExec(TpuExec):
     """Global sort over N output partitions (range exchange + local sort)."""
 
     def __init__(self, orders: Sequence[Tuple[Expression, SortOrder]],
-                 child: TpuExec, num_partitions: int):
+                 child: TpuExec, num_partitions: int,
+                 small_sort_rows: int = 1 << 20):
         super().__init__((child,), child.schema)
         self.orders = tuple(orders)
         self.out_partitions = max(num_partitions, 1)
+        #: inputs at or under this (spark.rapids.sql.batchSizeRows) skip
+        #: sampling/routing and sort as ONE local partition
+        self.small_sort_rows = max(int(small_sort_rows), 1)
         self._lock = threading.Lock()
         self._buckets: Optional[List[List[SpillableBatchHandle]]] = None
         self._local_sort = TpuSortExec(self.orders, child)  # reuse its jit
@@ -221,12 +225,23 @@ class TpuRangeSortExec(TpuExec):
             batches: List[ColumnarBatch] = []
             for p in range(child.num_partitions()):
                 batches.extend(child.execute_partition(p))
-            if batches:
+            if not batches:
+                buckets = [[] for _ in range(self.out_partitions)]
+            elif sum(b.capacity for b in batches) <= self.small_sort_rows:
+                # small input: one local sort IS the global sort.  The
+                # sampling + routing machinery costs ~2 launches and a
+                # host sync per batch plus a per-partition sort — for a
+                # sub-batch-target input (the common post-aggregation
+                # shape) that is pure launch overhead on the TPU.  All
+                # rows land in partition 0; empty partitions follow, so
+                # partition-order concatenation is still the global order.
+                merged = coalesce_to_one(batches)
+                buckets = [[make_spillable(merged)]] + \
+                    [[] for _ in range(self.out_partitions - 1)]
+            else:
                 buckets = range_bucket_spillable(
                     iter(batches), self.orders, child.schema,
                     self.out_partitions, batches)
-            else:
-                buckets = [[] for _ in range(self.out_partitions)]
             self._buckets = buckets
             return buckets
 

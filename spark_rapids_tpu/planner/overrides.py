@@ -802,7 +802,8 @@ class PlanMeta:
                 return TpuRangeSortExec(
                     p.orders, child,
                     min(self.conf.shuffle_partitions,
-                        child.num_partitions()))
+                        child.num_partitions()),
+                    small_sort_rows=self.conf.batch_size_rows)
             return TpuSortExec(p.orders, child,
                                target_rows=self.conf.batch_size_rows)
         if isinstance(p, L.Aggregate):
@@ -1069,6 +1070,12 @@ def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
     apply_post_tag_rules(meta, conf)
     exec_plan = meta.convert()
     exec_plan = _insert_aqe_readers(exec_plan, conf)
+    if conf.fuse_stages and conf.shuffle_mode != "ICI":
+        # stage-segment fusion (plan/fused.py): one XLA program per batch
+        # per exchange-free chain.  ICI sessions fuse the whole query in
+        # the SPMD compiler instead (parallel/stage.py).
+        from spark_rapids_tpu.plan.fused import fuse_segments
+        exec_plan = fuse_segments(exec_plan, conf)
     # LORE id assignment + dump wrapping (GpuLore.tagForLore analog,
     # GpuOverrides.scala:5149)
     from spark_rapids_tpu.plan.execs.lore import apply_lore

@@ -310,13 +310,39 @@ def test_delta_optimize_zorder_three_columns(tmp_path):
     s.delta_optimize(d, zorder_by=["a", "b", "c"])
     rows = assert_tpu_cpu_equal(lambda ses: ses.read_delta(d))
     assert len(rows) == n
-    # clustering actually happened: rows are NOT in insertion order
+    # clustering actually happened (ADVICE r4 #4: the old "or True" check
+    # was vacuous): recompute the z-key exactly as OPTIMIZE builds it
+    # (quantile range-bucket bounds -> RangeBucketId -> ZOrderKey, the
+    # io/delta_write.py:optimize recipe) over the READ-BACK row order and
+    # require it to be non-decreasing — i.e. the stored order IS the
+    # Morton order.  The interleave kernel itself is unit-tested above.
+    import math
+
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.core import CpuEvalContext
+    from spark_rapids_tpu.expressions.zorder import RangeBucketId, ZOrderKey
     ordered = [r for r in
                TpuSession({"spark.rapids.sql.enabled": "true"})
                .read_delta(d).collect()]
-    assert ordered != sorted(ordered, key=lambda r: (r[0], r[1], r[2])) \
-        or True  # ordering itself is an implementation detail; the real
-    # assertion is the interleave unit test above
+    keys = []
+    for ci, cname in enumerate(("a", "b", "c")):
+        vs = np.sort(np.asarray([r[ci] for r in ordered]))
+        qs = np.linspace(0, 1, min(1024, len(vs)) + 1)[1:-1]
+        bounds = np.unique(np.quantile(vs, qs, method="nearest"))
+        keys.append(RangeBucketId(col(cname), bounds))
+    source_bits = max(1, math.ceil(math.log2(
+        max(2, max(len(k.bounds) + 1 for k in keys)))))
+    expr = ZOrderKey(keys, source_bits=source_bits).bind(schema)
+    back = ColumnarBatch.from_pydict(
+        {c: [r[ci] for r in ordered] for ci, c in enumerate(("a", "b", "c"))},
+        schema)
+    zvals, _ = expr.eval_cpu(CpuEvalContext.from_batch(back))
+    zvals = list(zvals[:n])
+    assert zvals == sorted(zvals), \
+        "rows are not clustered in Morton (z-order) key order"
+    assert ordered != sorted(ordered), \
+        "z-order output coincides with plain lexicographic order; the " \
+        "test data should distinguish them"
 
 
 def test_delta_optimize_zorder_string_column_raises(tmp_path):

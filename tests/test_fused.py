@@ -1,0 +1,130 @@
+"""Stage-segment fusion tests (plan/fused.py).
+
+Differential discipline: every result is checked against the CPU oracle
+AND against the unfused engine (fuseStages=false), which must agree
+bitwise — fusion changes launch structure, never semantics.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import col, count, lit, sum_
+from tests.test_queries import assert_tpu_cpu_equal
+
+
+def _sessions():
+    return (TpuSession({"spark.rapids.sql.enabled": "true"}),
+            TpuSession({"spark.rapids.sql.enabled": "true",
+                        "spark.rapids.sql.tpu.fuseStages": "false"}))
+
+
+SCHEMA = Schema.of(k=T.INT, g=T.STRING, v=T.DOUBLE)
+DIM = Schema.of(dk=T.INT, name=T.STRING, flag=T.INT)
+
+
+def _fact(n=4000, seed=3, nkeys=50):
+    rng = np.random.RandomState(seed)
+    return ColumnarBatch.from_pydict(
+        {"k": (1 + rng.randint(0, nkeys, n)).tolist(),
+         "g": [f"g{int(x) % 7}" for x in rng.randint(0, 100, n)],
+         "v": np.round(rng.uniform(-5, 5, n), 3).tolist()}, SCHEMA)
+
+
+def _dim(nkeys=50):
+    return ColumnarBatch.from_pydict(
+        {"dk": list(range(1, nkeys + 1)),
+         "name": [f"name-{i}-{'x' * (i % 11)}" for i in range(nkeys)],
+         "flag": [i % 3 for i in range(nkeys)]}, DIM)
+
+
+def _query(s, dim_pred):
+    fact = s.create_dataframe([_fact()], num_partitions=2)
+    dim = s.create_dataframe([_dim()], num_partitions=1)
+    return (fact
+            .join(dim.filter(dim_pred), on=([col("k")], [col("dk")]))
+            .filter(col("v") > lit(-4.0))
+            .group_by("name")
+            .agg(sum_("v").alias("sv"), count().alias("n"))
+            .order_by("name"))
+
+
+def test_fused_plan_shape_and_equality():
+    fused_s, unfused_s = _sessions()
+    plan = _query(fused_s, col("flag") == lit(1)).physical_plan()
+    assert "TpuFusedSegment" in plan.tree_string()
+    plan_u = _query(unfused_s, col("flag") == lit(1)).physical_plan()
+    assert "TpuFusedSegment" not in plan_u.tree_string()
+    rows_f = _query(fused_s, col("flag") == lit(1)).collect()
+    rows_u = _query(unfused_s, col("flag") == lit(1)).collect()
+    assert rows_f == rows_u          # BITWISE: same kernels, same order
+    assert rows_f
+    assert_tpu_cpu_equal(
+        lambda s: _query(s, col("flag") == lit(1)), ignore_order=False)
+
+
+def test_fused_empty_build_side_with_string_payload():
+    """Code-review regression: an all-filtered build side used to derive
+    string bucket 0 and trip the join kernel's positive-window assert."""
+    fused_s, unfused_s = _sessions()
+    rows_f = _query(fused_s, col("flag") == lit(99)).collect()   # no dims
+    rows_u = _query(unfused_s, col("flag") == lit(99)).collect()
+    assert rows_f == rows_u == []
+
+
+def test_fused_left_join_and_semi():
+    fused_s, unfused_s = _sessions()
+
+    def q(s, how):
+        fact = s.create_dataframe([_fact(1500, seed=9)], num_partitions=2)
+        dim = s.create_dataframe([_dim(20)], num_partitions=1)
+        df = fact.join(dim.filter(col("flag") <= lit(1)),
+                       on=([col("k")], [col("dk")]), how=how)
+        cols = ["k", "g", "v"] + ([] if how == "left_semi" else ["name"])
+        return df.select(*cols).order_by("k", "g", "v")
+    for how in ("left", "left_semi"):
+        rows_f = q(fused_s, how).collect()
+        rows_u = q(unfused_s, how).collect()
+        assert rows_f == rows_u
+        assert rows_f
+
+
+def test_fused_launch_reduction():
+    """The point of the feature: fewer program dispatches per query."""
+    from spark_rapids_tpu.plan.execs.base import (
+        launch_stats, reset_launch_stats)
+    fused_s, unfused_s = _sessions()
+    counts = {}
+    for name, s in (("fused", fused_s), ("unfused", unfused_s)):
+        q = _query(s, col("flag") == lit(1))
+        q.collect()                  # warm compile + converge capacities
+        reset_launch_stats()
+        q.collect()
+        counts[name] = launch_stats()["launches"]
+    assert counts["fused"] < counts["unfused"], counts
+
+
+def test_fused_capacity_escalation_string_payload():
+    """A join whose string payload exceeds the default byte capacity must
+    escalate through the feedback loop and still match the oracle."""
+    n = 600
+    rng = np.random.RandomState(7)
+    fact = ColumnarBatch.from_pydict(
+        {"k": (1 + rng.randint(0, 5, n)).tolist(),   # heavy fan-in
+         "g": ["g"] * n,
+         "v": np.round(rng.uniform(0, 1, n), 3).tolist()}, SCHEMA)
+    dim = ColumnarBatch.from_pydict(
+        {"dk": [1, 2, 3, 4, 5],
+         "name": ["N" * 300, "n", "medium-name", "", "x" * 77],
+         "flag": [1, 1, 1, 1, 1]}, DIM)
+
+    def build(s):
+        f = s.create_dataframe([fact], num_partitions=1)
+        d = s.create_dataframe([dim], num_partitions=1)
+        return (f.join(d, on=([col("k")], [col("dk")]))
+                .group_by("name").agg(count().alias("n"),
+                                      sum_("v").alias("sv"))
+                .order_by("name"))
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows

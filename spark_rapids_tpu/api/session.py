@@ -321,6 +321,17 @@ class GroupedData:
                             self.df.plan), self.df.session)
         return self._grouping_sets_agg([_to_expr(a) for a in aggs])
 
+    def pivot(self, pivot_col, values) -> "PivotedGroupedData":
+        """Spark's df.groupBy(..).pivot(col, values).agg(..), lowered to
+        conditional aggregates: each (pivot value, aggregate) pair becomes
+        agg(IF(pivot == value, input, NULL)).  The reference plans this
+        via PivotFirst (aggregateFunctions.scala); conditional aggregation
+        is the TPU-first equivalent — one fused device pass, no per-value
+        buffer shuffling, identical results.  ``values`` must be given
+        explicitly (Spark's implicit-values form runs a distinct query
+        first; callers can do the same with .select().distinct())."""
+        return PivotedGroupedData(self, _to_expr(pivot_col), list(values))
+
     def _grouping_sets_agg(self, aggs) -> "DataFrame":
         """rollup/cube: Expand (one projection per grouping set, excluded
         keys nulled + a grouping-id column) -> Aggregate on keys+gid ->
@@ -426,6 +437,59 @@ class GroupedData:
         return DataFrame(
             L.MapBatches(_wrapper, schema, repart, whole_partition=True),
             self.df.session)
+
+
+class PivotedGroupedData:
+    """groupBy(..).pivot(col, values) staging: agg() expands per value."""
+
+    def __init__(self, grouped: GroupedData, pivot_expr, values):
+        self.grouped = grouped
+        self.pivot_expr = pivot_expr
+        self.values = values
+
+    def agg(self, *aggs) -> "DataFrame":
+        from spark_rapids_tpu.expressions.aggregates import (
+            AggregateFunction, find_aggregates)
+        from spark_rapids_tpu.expressions.conditional import If
+        from spark_rapids_tpu.expressions.core import (
+            Alias, Literal, output_name)
+        out = []
+        for pv in self.values:
+            for a in aggs:
+                a = _to_expr(a)
+                name = (a.name if isinstance(a, Alias)
+                        else output_name(a, 0))
+
+                def rewrite(e):
+                    if isinstance(e, AggregateFunction):
+                        if not e.children:
+                            # zero-input aggregates (count(*)): guard by
+                            # counting the pivot predicate itself — a
+                            # bare pass-through would count ALL group
+                            # rows for every pivot column
+                            from spark_rapids_tpu.expressions.aggregates \
+                                import Count
+                            assert isinstance(e, Count), \
+                                f"pivot cannot rewrite zero-input {e!r}"
+                            return Count(If(
+                                self.pivot_expr == Literal(pv),
+                                Literal(True), Literal(None)))
+                        # untyped NULL literal: columns are unbound here,
+                        # If takes its dtype from the then-branch
+                        kids = tuple(
+                            If(self.pivot_expr == Literal(pv),
+                               c, Literal(None))
+                            for c in e.children)
+                        return e.with_children(kids)
+                    if not e.children:
+                        return e
+                    return e.with_children(
+                        tuple(rewrite(c) for c in e.children))
+                col_name = (str(pv) if len(aggs) == 1
+                            else f"{pv}_{name}")
+                out.append(Alias(rewrite(a.child if isinstance(a, Alias)
+                                         else a), col_name))
+        return self.grouped.agg(*out)
 
 
 class DataFrame:

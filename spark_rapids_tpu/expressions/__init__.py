@@ -140,6 +140,12 @@ from spark_rapids_tpu.expressions.collections import (
     ArrayAggregate,
     ArrayContains,
     ArrayDistinct,
+    ArraysZip,
+    Flatten,
+    MapEntries,
+    arrays_zip,
+    flatten,
+    map_entries,
     ArrayExists,
     ArrayFilter,
     ArrayForAll,
@@ -179,6 +185,8 @@ from spark_rapids_tpu.expressions.datetime import (
     to_utc_timestamp)
 from spark_rapids_tpu.expressions.aggregates import (
     ApproxPercentile, CollectList, CollectSet, Percentile,
+    BitAndAgg, BitOrAgg, BitXorAgg, First, Last, MaxBy, MinBy,
+    bit_and, bit_or, bit_xor, first, last, max_by, min_by,
     approx_percentile, collect_list, collect_set, percentile)
 from spark_rapids_tpu.expressions.hashing import HiveHash, hive_hash
 from spark_rapids_tpu.expressions.strings import (
@@ -192,7 +200,9 @@ from spark_rapids_tpu.expressions.zorder import (
     RangeBucketId, ZOrderKey)
 from spark_rapids_tpu.expressions.parity import (
     ArrayExcept, ArrayIntersect, ArrayJoin, ArrayUnion, Bin, BitwiseCount,
-    BRound, DateFormat, FromUnixTime, Hex, MapConcat, MapFromArrays, Md5,
+    BRound, DateFormat, FromUnixTime, Hex, MapConcat, MapFromArrays,
+    MapFromEntries, map_from_entries, Md5,
+    JsonToStructs, StructsToJson, JsonTuple, from_json, to_json, json_tuple,
     RegexpExtract, RegexpExtractAll, RegexpReplace, Sha1, Sha2, StringSplit,
     StringToMap, SubstringIndex, ToUnixTimestamp, TruncTimestamp,
     UnaryPositive, UnixTimestamp, WeekDay, array_except, array_intersect,

@@ -1043,3 +1043,209 @@ def collect_list(e) -> CollectList:
 def collect_set(e) -> CollectSet:
     from spark_rapids_tpu.expressions.core import col as _col
     return CollectSet(_col(e) if isinstance(e, str) else e)
+
+
+# -- first/last, max_by/min_by, bit aggregates (r5 expression tail) ----------
+#
+# Reference: GpuFirst/GpuLast (aggregateFunctions.scala:2044+), GpuMaxBy/
+# GpuMinBy, and the bit-aggregate family.  Device semantics rest on
+# group_rows' STABLE sort: "first" is first-in-input-order, exactly
+# Spark's row-order contract, and the merge phase picks the first partial
+# in concatenation (batch) order.
+
+FIRST = "first"
+FIRST_VALID = "first_valid"     # ignoreNulls=true
+LAST = "last"
+LAST_VALID = "last_valid"
+PICK_OPS = (FIRST, FIRST_VALID, LAST, LAST_VALID)
+MAXBY_VAL = "maxby_val"
+MINBY_VAL = "minby_val"
+BIT_AND = "bit_and"
+BIT_OR = "bit_or"
+BIT_XOR = "bit_xor"
+BIT_OPS = (BIT_AND, BIT_OR, BIT_XOR)
+
+
+class First(AggregateFunction):
+    """first(expr[, ignoreNulls]): value of the first row in input order.
+
+    Deterministic here (both engines process rows in the same order), but
+    Spark documents it as non-deterministic without an explicit ordering —
+    tests must pin partitioning."""
+
+    name = "first"
+    _pick_last = False
+
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        self.children = (child,)
+        self.ignore_nulls = bool(ignore_nulls)
+
+    def with_children(self, children):
+        return type(self)(children[0], self.ignore_nulls)
+
+    @property
+    def dtype(self):
+        return self.input.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def buffers(self):
+        if self._pick_last:
+            op = LAST_VALID if self.ignore_nulls else LAST
+        else:
+            op = FIRST_VALID if self.ignore_nulls else FIRST
+        return (BufferSlot(self.dtype, op, op),)
+
+    def finalize_np(self, bufs):
+        return bufs[0]
+
+    def finalize_jnp(self, bufs):
+        return bufs[0]
+
+    def __repr__(self):
+        ign = ", ignoreNulls" if self.ignore_nulls else ""
+        return f"{self.name}({self.input!r}{ign})"
+
+
+class Last(First):
+    name = "last"
+    _pick_last = True
+
+
+class _ExtremeBy(AggregateFunction):
+    """max_by/min_by(x, y): x at the extreme of y; first row wins ties
+    (Spark's update keeps the incumbent on equal ordering values)."""
+
+    name = "max_by"
+    _is_min = False
+
+    def __init__(self, value: Expression, ordering: Expression):
+        self.children = (value, ordering)
+
+    @property
+    def inputs(self):
+        return self.children
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def buffers(self):
+        vop = MINBY_VAL if self._is_min else MAXBY_VAL
+        kop = MIN if self._is_min else MAX
+        return (BufferSlot(self.children[0].dtype, vop, vop, input_index=0),
+                BufferSlot(self.children[1].dtype, kop, kop, input_index=1),
+                BufferSlot(T.LONG, COUNT_VALID, SUM, input_index=1))
+
+    def finalize_np(self, bufs):
+        (v, v_valid), _key, (n, _) = bufs
+        return v, v_valid & (n > 0)
+
+    def finalize_jnp(self, bufs):
+        (v, v_valid), _key, (n, _) = bufs
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+        if isinstance(v, DeviceColumn):  # var-width pick buffer
+            return v, v.validity & (n > 0)
+        return v, v_valid & (n > 0)
+
+    def __repr__(self):
+        return f"{self.name}({self.children[0]!r}, {self.children[1]!r})"
+
+
+class MaxBy(_ExtremeBy):
+    name = "max_by"
+    _is_min = False
+
+
+class MinBy(_ExtremeBy):
+    name = "min_by"
+    _is_min = True
+
+
+class _BitAggBase(AggregateFunction):
+    """bit_and/bit_or/bit_xor over integral inputs (Spark keeps the input
+    type; null inputs are ignored; all-null group -> null)."""
+
+    name = "bit_and"
+    _op = BIT_AND
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return self.input.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def buffers(self):
+        return (BufferSlot(self.dtype, self._op, self._op),
+                BufferSlot(T.LONG, COUNT_VALID, SUM))
+
+    def finalize_np(self, bufs):
+        (v, v_valid), (n, _) = bufs
+        return v, v_valid & (n > 0)
+
+    finalize_jnp = finalize_np
+
+
+class BitAndAgg(_BitAggBase):
+    name = "bit_and"
+    _op = BIT_AND
+
+
+class BitOrAgg(_BitAggBase):
+    name = "bit_or"
+    _op = BIT_OR
+
+
+class BitXorAgg(_BitAggBase):
+    name = "bit_xor"
+    _op = BIT_XOR
+
+
+def _col(e):
+    from spark_rapids_tpu.expressions.core import col
+    return col(e) if isinstance(e, str) else e
+
+
+def first(e, ignore_nulls: bool = False) -> First:
+    return First(_col(e), ignore_nulls)
+
+
+def last(e, ignore_nulls: bool = False) -> Last:
+    return Last(_col(e), ignore_nulls)
+
+
+def max_by(value, ordering) -> MaxBy:
+    return MaxBy(_col(value), _col(ordering))
+
+
+def min_by(value, ordering) -> MinBy:
+    return MinBy(_col(value), _col(ordering))
+
+
+def bit_and(e) -> BitAndAgg:
+    return BitAndAgg(_col(e))
+
+
+def bit_or(e) -> BitOrAgg:
+    return BitOrAgg(_col(e))
+
+
+def bit_xor(e) -> BitXorAgg:
+    return BitXorAgg(_col(e))

@@ -603,6 +603,43 @@ class MapFromArrays(_BridgeExpr):
         return dict(zip(ks, vs))
 
 
+class MapFromEntries(_BridgeExpr):
+    """map_from_entries(array<struct<k,v>>) — bridge-evaluated like its
+    siblings MapFromArrays/MapConcat so Spark's EXCEPTION dedup policy
+    and null-entry error can raise at eval."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return MapFromEntries(children[0])
+
+    @property
+    def dtype(self):
+        at = self.children[0].dtype
+        st = at.element_type
+        return T.MapType(st.fields[0].dtype, st.fields[1].dtype)
+
+    def _row(self, entries):
+        out = {}
+        for e in entries:
+            if e is None:
+                raise ValueError(
+                    "map_from_entries: null entry (Spark raises)")
+            k, v = e
+            if k is None:
+                raise ValueError("map_from_entries: null map key")
+            if k in out:
+                raise ValueError(
+                    f"map_from_entries: duplicate map key {k!r} (Spark "
+                    "mapKeyDedupPolicy=EXCEPTION)")
+            out[k] = v
+        return out
+
+    def __repr__(self):
+        return f"map_from_entries({self.children[0]!r})"
+
+
 class StringToMap(_BridgeExpr):
     """str_to_map(s, pair_delim, kv_delim)."""
 
@@ -987,6 +1024,11 @@ def map_concat(*maps, dedup_policy: str = "EXCEPTION"):
     return MapConcat([_c(m) for m in maps], dedup_policy)
 
 
+def map_from_entries(e):
+    from spark_rapids_tpu.expressions.core import col as _col
+    return MapFromEntries(_col(e) if isinstance(e, str) else e)
+
+
 def map_from_arrays(keys, values):
     return MapFromArrays(_c(keys), _c(values))
 
@@ -1029,3 +1071,186 @@ def date_format(e, fmt: str):
 
 def date_trunc(fmt: str, e):
     return TruncTimestamp(fmt, _c(e))
+
+
+# -- JSON struct family (r5: VERDICT r4 #4) ----------------------------------
+#
+# Reference: GpuJsonToStructs.scala / GpuStructsToJson / GpuJsonTuple.
+# Bridge-evaluated (host JSON parse/format), the posture this module uses
+# for every format-string family; results materialize through the
+# bridge's struct/map-capable path.
+
+
+def _coerce_json(v, dt):
+    """PERMISSIVE coercion of a parsed JSON value into dtype dt; mismatch
+    -> None (Spark's null-on-bad-field)."""
+    if v is None:
+        return None
+    if isinstance(dt, T.StructType):
+        if not isinstance(v, dict):
+            return None
+        return tuple(_coerce_json(v.get(f.name), f.dtype)
+                     for f in dt.fields)
+    if isinstance(dt, T.MapType):
+        if not isinstance(v, dict):
+            return None
+        return {k: _coerce_json(x, dt.value_type) for k, x in v.items()}
+    if isinstance(dt, T.ArrayType):
+        if not isinstance(v, list):
+            return None
+        return [_coerce_json(x, dt.element_type) for x in v]
+    if isinstance(dt, T.StringType):
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (dict, list)):
+            import json as _json
+            return _json.dumps(v, separators=(",", ":"))
+        return str(v)
+    if isinstance(dt, T.BooleanType):
+        return v if isinstance(v, bool) else None
+    if dt.is_integral:
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if isinstance(v, float) and not v.is_integer():
+            return None
+        return int(v)
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        return float(v)
+    return None
+
+
+class JsonToStructs(_BridgeExpr):
+    """from_json(s, schema): PERMISSIVE — malformed JSON -> null row."""
+
+    def __init__(self, child, schema_dtype):
+        self.children = (child,)
+        self.schema_dtype = schema_dtype
+        assert isinstance(schema_dtype, (T.StructType, T.MapType,
+                                         T.ArrayType)), schema_dtype
+
+    def with_children(self, children):
+        return JsonToStructs(children[0], self.schema_dtype)
+
+    @property
+    def dtype(self):
+        return self.schema_dtype
+
+    def _row(self, s):
+        import json as _json
+        try:
+            v = _json.loads(s)
+        except Exception:
+            return None
+        return _coerce_json(v, self.schema_dtype)
+
+    def __repr__(self):
+        return f"from_json({self.children[0]!r}, {self.schema_dtype!r})"
+
+
+def _to_json_value(v, dt):
+    if v is None:
+        return None
+    if isinstance(dt, T.StructType):
+        out = {}
+        for f, x in zip(dt.fields, v):
+            j = _to_json_value(x, f.dtype)
+            if j is not None:        # Spark ignoreNullFields=true default
+                out[f.name] = j
+        return out
+    if isinstance(dt, T.MapType):
+        return {str(k): _to_json_value(x, dt.value_type)
+                for k, x in v.items()}
+    if isinstance(dt, T.ArrayType):
+        return [_to_json_value(x, dt.element_type) for x in v]
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        return float(v)
+    if dt.is_integral:
+        return int(v)
+    if isinstance(dt, T.BooleanType):
+        return bool(v)
+    return str(v)
+
+
+class StructsToJson(_BridgeExpr):
+    """to_json(struct|map|array) with Spark's default ignoreNullFields."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return StructsToJson(children[0])
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def _row(self, v):
+        import json as _json
+        return _json.dumps(_to_json_value(v, self.children[0].dtype),
+                           separators=(",", ":"))
+
+    def __repr__(self):
+        return f"to_json({self.children[0]!r})"
+
+
+class JsonTuple(_BridgeExpr):
+    """json_tuple(json, f1..fk) -> struct<c0..ck-1: string>.
+
+    Adaptation note: Spark plans json_tuple as a GENERATOR emitting one
+    row of k columns; here it is a struct-valued expression carrying the
+    same k values (select the fields to flatten) — documented divergence,
+    same information."""
+
+    def __init__(self, child, fields):
+        self.children = (child,)
+        self.fields = tuple(fields)
+
+    def with_children(self, children):
+        return JsonTuple(children[0], self.fields)
+
+    @property
+    def dtype(self):
+        return T.StructType(tuple(
+            T.StructField(f"c{i}", T.STRING)
+            for i in range(len(self.fields))))
+
+    def _row(self, s):
+        import json as _json
+        try:
+            v = _json.loads(s)
+        except Exception:
+            v = None
+        if not isinstance(v, dict):
+            return tuple(None for _ in self.fields)
+        out = []
+        for f in self.fields:
+            x = v.get(f)
+            if x is None:
+                out.append(None)
+            elif isinstance(x, (dict, list)):
+                out.append(_json.dumps(x, separators=(",", ":")))
+            elif isinstance(x, bool):
+                out.append("true" if x else "false")
+            else:
+                out.append(str(x))
+        return tuple(out)
+
+    def __repr__(self):
+        return f"json_tuple({self.children[0]!r}, {self.fields})"
+
+
+def from_json(e, schema_dtype):
+    from spark_rapids_tpu.expressions.core import col as _col
+    return JsonToStructs(_col(e) if isinstance(e, str) else e, schema_dtype)
+
+
+def to_json(e):
+    from spark_rapids_tpu.expressions.core import col as _col
+    return StructsToJson(_col(e) if isinstance(e, str) else e)
+
+
+def json_tuple(e, *fields):
+    from spark_rapids_tpu.expressions.core import col as _col
+    return JsonTuple(_col(e) if isinstance(e, str) else e, fields)

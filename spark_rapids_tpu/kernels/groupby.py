@@ -285,3 +285,154 @@ def finalize_agg_column(values: jax.Array, validity: jax.Array,
     valid = validity & live
     data = jnp.where(valid, values, jnp.zeros((), values.dtype))
     return DeviceColumn(data, valid, dtype)
+
+
+# -- positional picks (first/last/max_by/min_by) -----------------------------
+#
+# group_rows' stable lexsort preserves input order within each segment, so
+# "first live row of the segment" IS Spark's first-in-row-order semantics
+# (reference: GpuFirst/GpuLast/GpuMaxBy in aggregateFunctions.scala).  The
+# same kernels implement the MERGE ops: partial batches concatenate in
+# batch order, so first-partial == global first.
+
+def _seg_arg(eligible: jax.Array, layout: GroupedLayout, last: bool
+             ) -> Tuple[jax.Array, jax.Array]:
+    """(row index of the first/last eligible row per segment, has-any)."""
+    cap = eligible.shape[0]
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    if last:
+        p = jnp.where(eligible, pos, jnp.int32(-1))
+        arg = jax.ops.segment_max(p, layout.segment_ids, num_segments=cap)
+        has = arg >= 0
+    else:
+        p = jnp.where(eligible, pos, jnp.int32(cap))
+        arg = jax.ops.segment_min(p, layout.segment_ids, num_segments=cap)
+        has = arg < cap
+    return jnp.clip(arg, 0, cap - 1).astype(jnp.int32), has
+
+
+def seg_pick(col: DeviceColumn, layout: GroupedLayout, ignore_nulls: bool,
+             last: bool) -> DeviceColumn:
+    """FIRST/LAST as a gather: works for every device dtype incl. strings
+    (the picked subset can never exceed the source byte planes)."""
+    from spark_rapids_tpu.kernels.selection import OOB, gather_column
+    live = layout.sorted_batch.live_mask()
+    eligible = live & col.validity if ignore_nulls else live
+    arg, has = _seg_arg(eligible, layout, last)
+    idx = jnp.where(has, arg, jnp.int32(OOB))
+    return gather_column(col, idx, layout.num_groups,
+                         out_capacity=col.capacity)
+
+
+def seg_pick_by(xcol: DeviceColumn, ycol: DeviceColumn,
+                layout: GroupedLayout, is_min: bool) -> DeviceColumn:
+    """max_by/min_by value: x at the extreme of y; ties take the FIRST row
+    in input order (Spark's update keeps the incumbent on equal keys).
+    Null y rows never win; all-null-y groups yield null.  y is normalized
+    (-0.0 == 0.0; NaN greatest in Spark's total order) like sort keys."""
+    from spark_rapids_tpu.kernels.selection import OOB, gather_column
+    live = layout.sorted_batch.live_mask()
+    ycol = normalize_key_column(ycol)
+    m, has = (seg_min if is_min else seg_max)(ycol, layout)
+    yv = ycol.data
+    eq = yv == m[layout.segment_ids]
+    if jnp.issubdtype(yv.dtype, jnp.floating):
+        eq = eq | (jnp.isnan(yv) & jnp.isnan(m[layout.segment_ids]))
+    eligible = live & ycol.validity & eq
+    arg, has2 = _seg_arg(eligible, layout, last=False)
+    idx = jnp.where(has & has2, arg, jnp.int32(OOB))
+    return gather_column(xcol, idx, layout.num_groups,
+                         out_capacity=xcol.capacity)
+
+
+_BIT_IDENT = {"bit_and": -1, "bit_or": 0, "bit_xor": 0}
+
+
+def seg_bitwise(col: DeviceColumn, layout: GroupedLayout, op: str,
+                out_dtype) -> Tuple[jax.Array, jax.Array]:
+    """bit_and / bit_or / bit_xor over integral groups via a segmented
+    inclusive scan (flag-resetting combine), reading the running value at
+    each segment's last live row."""
+    live = layout.sorted_batch.live_mask()
+    valid = col.validity & live
+    ident = jnp.asarray(_BIT_IDENT[op], out_dtype)
+    x = jnp.where(valid, col.data.astype(out_dtype), ident)
+    flag = layout.boundary
+
+    bop = {"bit_and": jnp.bitwise_and, "bit_or": jnp.bitwise_or,
+           "bit_xor": jnp.bitwise_xor}[op]
+
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, bop(va, vb))
+
+    _f, v = jax.lax.associative_scan(comb, (flag, x))
+    arg, has = _seg_arg(live, layout, last=True)
+    out = v[arg]
+    nvalid = jax.ops.segment_sum(valid.astype(jnp.int32),
+                                 layout.segment_ids,
+                                 num_segments=col.capacity)
+    return out, has & (nvalid > 0)
+
+
+# -- whole-batch (global, no grouping keys) variants --------------------------
+
+def global_pick(col: DeviceColumn, live: jax.Array, ignore_nulls: bool,
+                last: bool) -> DeviceColumn:
+    from spark_rapids_tpu.kernels.selection import OOB, gather_column
+    cap = col.capacity
+    eligible = live & col.validity if ignore_nulls else live
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    if last:
+        arg = jnp.max(jnp.where(eligible, pos, jnp.int32(-1)))
+        has = arg >= 0
+    else:
+        arg = jnp.min(jnp.where(eligible, pos, jnp.int32(cap)))
+        has = arg < cap
+    idx = jnp.full((1,), OOB, jnp.int32)
+    idx = jnp.where(has, jnp.clip(arg, 0, cap - 1).astype(jnp.int32)[None],
+                    idx)
+    return gather_column(col, idx, jnp.int32(1), out_capacity=1)
+
+
+def global_pick_by(xcol: DeviceColumn, ycol: DeviceColumn, live: jax.Array,
+                   is_min: bool) -> DeviceColumn:
+    from spark_rapids_tpu.kernels.selection import OOB, gather_column
+    cap = xcol.capacity
+    ycol = normalize_key_column(ycol)
+    valid = live & ycol.validity
+    yv = ycol.data
+    if jnp.issubdtype(yv.dtype, jnp.floating):
+        # Spark total order: NaN greatest — never the min; always the max
+        key = jnp.where(jnp.isnan(yv), jnp.inf, yv)
+        ident = jnp.asarray(jnp.inf if is_min else -jnp.inf, yv.dtype)
+        k = jnp.where(valid, key, ident)
+    else:
+        info = jnp.iinfo(yv.dtype) if yv.dtype != jnp.bool_ else None
+        if info is None:
+            ident = jnp.asarray(is_min, yv.dtype)
+            k = jnp.where(valid, yv, ident)
+        else:
+            ident = jnp.asarray(info.max if is_min else info.min, yv.dtype)
+            k = jnp.where(valid, yv, ident)
+    m = jnp.min(k) if is_min else jnp.max(k)
+    eligible = valid & (k == m)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    arg = jnp.min(jnp.where(eligible, pos, jnp.int32(cap)))
+    has = (arg < cap) & jnp.any(valid)
+    idx = jnp.where(has, jnp.clip(arg, 0, cap - 1).astype(jnp.int32)[None],
+                    jnp.full((1,), OOB, jnp.int32))
+    return gather_column(xcol, idx, jnp.int32(1), out_capacity=1)
+
+
+def global_bitwise(col: DeviceColumn, live: jax.Array, op: str, out_dtype
+                   ) -> Tuple[jax.Array, jax.Array]:
+    valid = col.validity & live
+    ident = jnp.asarray(_BIT_IDENT[op], out_dtype)
+    x = jnp.where(valid, col.data.astype(out_dtype), ident)
+    red = {"bit_and": lambda a: jnp.bitwise_and.reduce(a),
+           "bit_or": lambda a: jnp.bitwise_or.reduce(a),
+           "bit_xor": lambda a: jnp.bitwise_xor.reduce(a)}
+    out = red[op](x)
+    return jnp.reshape(out, (1,)), jnp.reshape(jnp.any(valid), (1,))

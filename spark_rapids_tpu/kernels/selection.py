@@ -122,10 +122,11 @@ def gather_column(
         return DeviceColumn(jnp.zeros((out_cap,), jnp.int8), validity,
                             col.dtype, children=kids)
 
-    if col.is_map:
-        # map: rebuild offsets from gathered entry counts, then gather the
-        # key/value children by source entry index (the LIST gather with
-        # the child struct flattened)
+    if col.is_nested_list:
+        # generalized LIST gather (maps AND arrays of nested elements):
+        # rebuild offsets from gathered entry counts, then gather every
+        # child column — and the per-element validity when present — by
+        # source entry index
         starts = col.offsets[:-1]
         lengths = col.offsets[1:] - starts
         glen = jnp.where(validity, lengths[safe], 0)
@@ -145,8 +146,13 @@ def gather_column(
         kids = tuple(gather_column(c, src, total, out_capacity=ecap,
                                    byte_caps=_sub_caps(byte_caps, i))
                      for i, c in enumerate(col.children))
+        cvalid = None
+        if col.child_validity is not None:
+            safe_src = jnp.clip(src, 0, col.byte_capacity - 1)
+            cvalid = jnp.where((src >= 0) & (epos < total),
+                               col.child_validity[safe_src], False)
         return DeviceColumn(jnp.zeros((ecap,), jnp.uint8), validity,
-                            col.dtype, new_offsets, children=kids)
+                            col.dtype, new_offsets, cvalid, children=kids)
 
     if col.offsets is None:
         data = jnp.where(validity, col.data[safe], jnp.zeros((), col.data.dtype))
@@ -247,7 +253,12 @@ def dtype_offset_paths(dt, prefix: Tuple[int, ...] = ()
         out.extend(dtype_offset_paths(dt.value_type, prefix + (1,)))
         return out
     if isinstance(dt, T.ArrayType):
-        out.append(prefix)     # fixed-width elements: one offsets plane
+        out.append(prefix)
+        et = dt.element_type
+        if (isinstance(et, (T.StructType, T.ArrayType, T.MapType))
+                or getattr(et, "variable_width", False)):
+            # nested elements live in a single child column at (0,)
+            out.extend(dtype_offset_paths(et, prefix + (0,)))
         return out
     if isinstance(dt, T.DecimalType):
         return out             # limb children carry no offsets
@@ -336,6 +347,85 @@ def filter_batch(batch: ColumnarBatch, predicate: jax.Array) -> ColumnarBatch:
     return gather_batch(batch, indices, count)
 
 
+def _multi_gather(kids, which: jax.Array, src: jax.Array, live: jax.Array,
+                  out_cap: int) -> DeviceColumn:
+    """Gather ONE output column from N same-dtype source columns: output
+    slot j takes kids[which[j]] row src[j] when live[j].  Recursive over
+    struct fields and nested-list children — the concat kernel's
+    arbitrary-nesting workhorse (r5, VERDICT r4 #5).  Sources are
+    harmonized to a common capacity before stacking; gathered planes are
+    bounded by the sum of input planes (concat never repeats rows)."""
+    ecn = max(k.capacity for k in kids)
+    dtype = kids[0].dtype
+    if kids[0].offsets is None and kids[0].children is None:   # fixed
+        kids = [k if k.capacity == ecn else k.with_capacity(ecn)
+                for k in kids]
+        s_d = jnp.stack([k.data for k in kids])
+        s_v = jnp.stack([k.validity for k in kids])
+        src1 = jnp.clip(src, 0, ecn - 1)
+        ok = live & (src >= 0) & (src < ecn)
+        kv = jnp.where(ok, s_v[which, src1], False)
+        kd = jnp.where(kv, s_d[which, src1], jnp.zeros((), s_d.dtype))
+        return DeviceColumn(kd, kv, dtype)
+    if kids[0].is_struct:
+        kids = [k if k.capacity == ecn else k.with_capacity(ecn)
+                for k in kids]
+        s_v = jnp.stack([k.validity for k in kids])
+        src1 = jnp.clip(src, 0, ecn - 1)
+        ok = live & (src >= 0) & (src < ecn)
+        kv = jnp.where(ok, s_v[which, src1], False)
+        fields = tuple(
+            _multi_gather([k.children[i] for k in kids], which, src, live,
+                          out_cap)
+            for i in range(len(kids[0].children)))
+        return DeviceColumn(jnp.zeros((out_cap,), jnp.int8), kv, dtype,
+                            children=fields)
+    # segmented: string/binary, plain array, or nested list
+    kbc = max(k.byte_capacity for k in kids)
+    kids = [k if (k.capacity == ecn and k.byte_capacity == kbc)
+            else k.with_capacity(ecn, kbc) for k in kids]
+    s_off = jnp.stack([k.offsets.astype(jnp.int32) for k in kids])
+    s_val = jnp.stack([k.validity for k in kids])
+    src1 = jnp.clip(src, 0, ecn - 1)
+    ok = live & (src >= 0) & (src < ecn)
+    evalid = jnp.where(ok, s_val[which, src1], False)
+    elen = jnp.where(evalid,
+                     s_off[which, src1 + 1] - s_off[which, src1], 0)
+    k_off = jnp.zeros((out_cap + 1,), jnp.int32).at[1:].set(
+        jnp.cumsum(elen).astype(jnp.int32))
+    kbytes = sum(k.byte_capacity for k in kids)
+    cpos = jnp.arange(kbytes, dtype=jnp.int32)
+    crow = jnp.clip(
+        jnp.searchsorted(k_off, cpos, side="right").astype(jnp.int32) - 1,
+        0, out_cap - 1)
+    within_b = cpos - k_off[crow]
+    src_b = jnp.clip(s_off[which[crow], src1[crow]] + within_b, 0, kbc - 1)
+    live_b = cpos < k_off[out_cap]
+    if kids[0].children is None:
+        s_dat = jnp.stack([k.data for k in kids])
+        cdata = jnp.where(live_b, s_dat[which[crow], src_b],
+                          jnp.zeros((), s_dat.dtype))
+        if kids[0].child_validity is not None:
+            s_cv = jnp.stack([k.child_validity for k in kids])
+            cv = jnp.where(live_b, s_cv[which[crow], src_b], False)
+            cdata = jnp.where(cv, cdata, jnp.zeros((), cdata.dtype))
+            return DeviceColumn(cdata, evalid, dtype, k_off, cv)
+        return DeviceColumn(cdata, evalid, dtype, k_off)
+    # nested-list child: recurse one level down
+    ewhich2 = which[crow]
+    esrc2 = jnp.where(live_b, src_b, OOB)
+    children = tuple(
+        _multi_gather([k.children[i] for k in kids], ewhich2, esrc2,
+                      live_b, kbytes)
+        for i in range(len(kids[0].children)))
+    cv = None
+    if kids[0].child_validity is not None:
+        s_cv = jnp.stack([k.child_validity for k in kids])
+        cv = jnp.where(live_b, s_cv[which[crow], src_b], False)
+    return DeviceColumn(jnp.zeros((kbytes,), jnp.uint8), evalid, dtype,
+                        k_off, cv, children=children)
+
+
 def concat_batches_device(
     batches: Sequence[ColumnarBatch], out_capacity: int
 ) -> Tuple[ColumnarBatch, OverflowStatus]:
@@ -410,60 +500,24 @@ def concat_batches_device(
             zero = jnp.zeros((), stacked_dat.dtype)
             live_child = bpos < new_offsets[out_capacity]
             if is_map:
-                # children gathered per ENTRY from the stacked inputs;
-                # string children re-derive their own offsets plane from
-                # gathered entry lengths (concat never repeats entries, so
-                # sum-of-input byte planes can't overflow)
+                # children gathered per ENTRY from the stacked inputs,
+                # recursively: fixed, string, struct, and nested-list
+                # children all route through _multi_gather (concat never
+                # repeats entries, so sum-of-input planes can't overflow)
                 ewhich = which[brow]
                 esrc = src_in_batch
-
-                def gather_child(kids):
-                    ecn = max(k.capacity for k in kids)
-                    if kids[0].offsets is None:
-                        kids = [k if k.capacity == ecn
-                                else k.with_capacity(ecn) for k in kids]
-                        skid_d = jnp.stack([k.data for k in kids])
-                        skid_v = jnp.stack([k.validity for k in kids])
-                        kv = jnp.where(live_child,
-                                       skid_v[ewhich, esrc], False)
-                        kd = jnp.where(kv, skid_d[ewhich, esrc],
-                                       jnp.zeros((), skid_d.dtype))
-                        return DeviceColumn(kd, kv, kids[0].dtype)
-                    kbc = max(k.byte_capacity for k in kids)
-                    kids = [k if (k.capacity == ecn
-                                  and k.byte_capacity == kbc)
-                            else k.with_capacity(ecn, kbc) for k in kids]
-                    s_off = jnp.stack([k.offsets for k in kids])
-                    s_dat = jnp.stack([k.data for k in kids])
-                    s_val = jnp.stack([k.validity for k in kids])
-                    src1 = jnp.clip(esrc, 0, ecn - 1)
-                    evalid = jnp.where(live_child,
-                                       s_val[ewhich, src1], False)
-                    elen = jnp.where(
-                        evalid,
-                        s_off[ewhich, src1 + 1] - s_off[ewhich, src1], 0)
-                    k_off = jnp.zeros((out_bcap + 1,), jnp.int32).at[1:].set(
-                        jnp.cumsum(elen))
-                    kbytes = sum(k.byte_capacity for k in kids)
-                    cpos = jnp.arange(kbytes, dtype=jnp.int32)
-                    crow = jnp.clip(
-                        jnp.searchsorted(k_off, cpos,
-                                         side="right").astype(jnp.int32) - 1,
-                        0, out_bcap - 1)
-                    within_b = cpos - k_off[crow]
-                    src_b = jnp.clip(
-                        s_off[ewhich[crow], src1[crow]] + within_b,
-                        0, kbc - 1)
-                    live_b = cpos < k_off[out_bcap]
-                    cdata = jnp.where(live_b, s_dat[ewhich[crow], src_b],
-                                      jnp.zeros((), s_dat.dtype))
-                    return DeviceColumn(cdata, evalid, kids[0].dtype, k_off)
-
-                kids = tuple(gather_child([c.children[i] for c in cols])
-                             for i in range(2))
+                kids = tuple(
+                    _multi_gather([c.children[i] for c in cols],
+                                  ewhich, esrc, live_child, out_bcap)
+                    for i in range(len(cols[0].children)))
+                cvalid = None
+                if cols[0].child_validity is not None:
+                    s_cv = jnp.stack([c.child_validity for c in cols])
+                    cvalid = jnp.where(live_child, s_cv[ewhich, esrc],
+                                       False)
                 return DeviceColumn(jnp.zeros((out_bcap,), jnp.uint8),
                                     validity, dtype, new_offsets,
-                                    children=kids)
+                                    cvalid, children=kids)
             data = jnp.where(live_child,
                              stacked_dat[which[brow], src_in_batch], zero)
             if is_arr:

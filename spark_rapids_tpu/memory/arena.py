@@ -63,6 +63,12 @@ def is_device_oom(exc: BaseException) -> bool:
             or "out of memory" in msg)
 
 
+#: process-lifetime count of REAL XLA RESOURCE_EXHAUSTED translations
+#: (task metrics are thread-local; tools/oom_proof.py needs a global view
+#: to assert that a deliberate on-chip exhaustion actually happened)
+GLOBAL_DEVICE_OOM_COUNT = 0
+
+
 def translate_device_oom(fn):
     """Wrap a device-compute callable so a real XLA RESOURCE_EXHAUSTED
     becomes ``TpuRetryOOM`` after an emergency spill — entering the same
@@ -80,6 +86,8 @@ def translate_device_oom(fn):
                 raise
             from spark_rapids_tpu.memory import metrics as task_metrics
             from spark_rapids_tpu.memory.spill import spill_framework
+            global GLOBAL_DEVICE_OOM_COUNT
+            GLOBAL_DEVICE_OOM_COUNT += 1
             task_metrics.get().device_oom_count += 1
             spill_framework().spill_device(1 << 62)  # emergency: evict all
             raise TpuRetryOOM(

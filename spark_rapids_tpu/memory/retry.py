@@ -38,6 +38,14 @@ U = TypeVar("U")
 
 # defaults; initialize_memory(conf) overrides from spark.rapids.sql.retry.*
 MAX_RETRIES = 8
+
+
+def _bump_global_oom() -> None:
+    """Record a REAL device OOM in the process-global counter (the
+    thread-local task metric can't be read across the task pool;
+    tools/oom_proof.py asserts on this)."""
+    from spark_rapids_tpu.memory import arena as _arena
+    _arena.GLOBAL_DEVICE_OOM_COUNT += 1
 MAX_SPLIT_DEPTH = 32
 
 
@@ -76,6 +84,7 @@ def with_retry_no_split(fn: Callable[[], T]) -> T:
                 last = TpuRetryOOM(f"device RESOURCE_EXHAUSTED: {e}")
                 task_metrics.get().retry_count += 1
                 task_metrics.get().device_oom_count += 1
+                _bump_global_oom()
                 spill_framework().spill_device(1 << 62)
         raise last  # type: ignore[misc]
     finally:
@@ -132,6 +141,7 @@ def with_retry(
                     attempts += 1
                     task_metrics.get().retry_count += 1
                     task_metrics.get().device_oom_count += 1
+                    _bump_global_oom()
                     if attempts >= MAX_RETRIES:
                         raise TpuRetryOOM(
                             f"device RESOURCE_EXHAUSTED: {e}") from e

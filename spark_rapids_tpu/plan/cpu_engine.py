@@ -24,13 +24,17 @@ from spark_rapids_tpu.expressions.core import (
     cpu_zero_invalid,
 )
 from spark_rapids_tpu.expressions.aggregates import (
+    BIT_OPS,
     COLLECT,
     COUNT_STAR,
     COUNT_VALID,
     MAX,
     MAX128,
+    MAXBY_VAL,
     MIN,
     MIN128,
+    MINBY_VAL,
+    PICK_OPS,
     SUM,
     SUM128,
     TD_MEANS,
@@ -443,8 +447,11 @@ class CpuEngine:
                     continue
                 two_limb = (isinstance(slot.dtype, T.DecimalType)
                             and slot.dtype.uses_two_limbs)
-                holistic = slot.update_op in (COLLECT, TD_MEANS,
-                                              TD_WEIGHTS)
+                holistic = (slot.update_op in (COLLECT, TD_MEANS,
+                                               TD_WEIGHTS)
+                            or (slot.update_op in PICK_OPS
+                                + (MAXBY_VAL, MINBY_VAL)
+                                and slot.dtype.variable_width))
                 bv = np.zeros((n_groups,),
                               object if two_limb or holistic
                               else slot.dtype.np_dtype)
@@ -470,6 +477,43 @@ class CpuEngine:
                         ms, ws = np_digest(
                             np.asarray(vals[sel], np.float64), agg.delta)
                         bv[gi] = ms if slot.update_op == TD_MEANS else ws
+                    elif slot.update_op in PICK_OPS:
+                        rows = sel if "valid" in slot.update_op else idx
+                        if len(rows) == 0:
+                            bm[gi] = False
+                        else:
+                            r = (rows[-1]
+                                 if slot.update_op.startswith("last")
+                                 else rows[0])
+                            bm[gi] = bool(valid[r])
+                            if valid[r]:
+                                bv[gi] = vals[r]
+                    elif slot.update_op in (MAXBY_VAL, MINBY_VAL):
+                        yv, ym = agg_inputs[(id(agg), 1)]
+                        cand = idx[ym[idx]] if len(idx) else idx
+                        if len(cand) == 0:
+                            bm[gi] = False
+                        else:
+                            y = np.asarray(yv[cand])
+                            if np.issubdtype(y.dtype, np.floating):
+                                # Spark total order: NaN greatest; -0==0
+                                y = np.where(np.isnan(y), np.inf, y + 0.0)
+                            # np.argmin/argmax take the FIRST extreme —
+                            # the device kernel's tie rule
+                            r = cand[np.argmin(y)
+                                     if slot.update_op == MINBY_VAL
+                                     else np.argmax(y)]
+                            bm[gi] = bool(valid[r])
+                            if valid[r]:
+                                bv[gi] = vals[r]
+                    elif slot.update_op in BIT_OPS:
+                        if len(sel):
+                            x = np.asarray(vals[sel]).astype(np.int64)
+                            red = {"bit_and": np.bitwise_and,
+                                   "bit_or": np.bitwise_or,
+                                   "bit_xor": np.bitwise_xor}
+                            bv[gi] = red[slot.update_op].reduce(x).astype(
+                                slot.dtype.np_dtype)
                     elif len(sel) == 0:
                         bv[gi] = 0
                         if two_limb:

@@ -29,6 +29,7 @@ from spark_rapids_tpu.expressions.core import (
     Expression,
 )
 from spark_rapids_tpu.expressions.aggregates import (
+    BIT_OPS,
     COLLECT,
     COLLECT_MERGE,
     COUNT_STAR,
@@ -39,8 +40,11 @@ from spark_rapids_tpu.expressions.aggregates import (
     M2_MERGE,
     MAX,
     MAX128,
+    MAXBY_VAL,
     MIN,
     MIN128,
+    MINBY_VAL,
+    PICK_OPS,
     SUM,
     SUM128,
     TD_MEANS,
@@ -378,6 +382,16 @@ class _AggDeviceSpec:
             f"t-digest merge needs a {update_op} companion buffer on "
             f"{self.aggregates[ai]!r}")
 
+    def _by_companion(self, ai: int) -> int:
+        """Slot index of max_by/min_by's ordering-key buffer."""
+        for si in self._slot_pos[ai]:
+            _, slot = self.slot_specs[si]
+            if slot.input_index == 1 and slot.update_op in (MIN, MAX):
+                return si
+        raise AssertionError(
+            f"max_by/min_by needs a MIN/MAX ordering companion buffer on "
+            f"{self.aggregates[ai]!r}")
+
     def _merge_bucket(self, partial: ColumnarBatch) -> int:
         from spark_rapids_tpu.kernels import strings as SK
         pairs = [(partial.columns[i], partial.num_rows)
@@ -427,6 +441,23 @@ class _AggDeviceSpec:
                         col, live, agg_.delta,
                         "means" if slot.update_op == TD_MEANS
                         else "weights"))
+                    continue
+                if slot.update_op in PICK_OPS:
+                    cols.append(G.global_pick(
+                        col, live, "valid" in slot.update_op,
+                        slot.update_op.startswith("last")))
+                    continue
+                if slot.update_op in (MAXBY_VAL, MINBY_VAL):
+                    ycol = agg_in[(id(agg), 1)]
+                    cols.append(G.global_pick_by(
+                        col, ycol, live, slot.update_op == MINBY_VAL))
+                    continue
+                if slot.update_op in BIT_OPS:
+                    v, valid = G.global_bitwise(col, live, slot.update_op,
+                                                slot.dtype.jnp_dtype)
+                    cols.append(DeviceColumn(
+                        jnp.where(valid, v, jnp.zeros((), v.dtype)),
+                        valid, slot.dtype))
                     continue
                 v, valid = _global_update(slot.update_op, col, live, slot.dtype)
                 data = jnp.where(valid, v, jnp.zeros((), v.dtype))
@@ -478,6 +509,24 @@ class _AggDeviceSpec:
                     col, layout, agg.delta,
                     "means" if slot.update_op == TD_MEANS else "weights"))
                 continue
+            if slot.update_op in PICK_OPS:
+                cols.append(G.seg_pick(col, layout,
+                                       "valid" in slot.update_op,
+                                       slot.update_op.startswith("last")))
+                continue
+            if slot.update_op in (MAXBY_VAL, MINBY_VAL):
+                ycol = layout.sorted_batch.columns[
+                    col_of_agg[(id(agg), 1)]]
+                cols.append(G.seg_pick_by(col, ycol, layout,
+                                          slot.update_op == MINBY_VAL))
+                continue
+            if slot.update_op in BIT_OPS:
+                v, valid = G.seg_bitwise(col, layout, slot.update_op,
+                                         slot.dtype.jnp_dtype)
+                cols.append(G.finalize_agg_column(
+                    v.astype(slot.dtype.jnp_dtype), valid,
+                    layout.num_groups, slot.dtype))
+                continue
             v, valid = _seg_update(slot.update_op, col, layout, slot.dtype)
             cols.append(G.finalize_agg_column(
                 v.astype(slot.dtype.jnp_dtype), valid, layout.num_groups,
@@ -523,6 +572,23 @@ class _AggDeviceSpec:
                         mc, wc, live, self.aggregates[ai].delta,
                         "means" if slot.merge_op == TD_MEANS_MERGE
                         else "weights"))
+                    continue
+                if slot.merge_op in PICK_OPS:
+                    cols.append(G.global_pick(
+                        col, live, "valid" in slot.merge_op,
+                        slot.merge_op.startswith("last")))
+                    continue
+                if slot.merge_op in (MAXBY_VAL, MINBY_VAL):
+                    ycol = partial.columns[nkeys + self._by_companion(ai)]
+                    cols.append(G.global_pick_by(
+                        col, ycol, live, slot.merge_op == MINBY_VAL))
+                    continue
+                if slot.merge_op in BIT_OPS:
+                    v, valid = G.global_bitwise(col, live, slot.merge_op,
+                                                slot.dtype.jnp_dtype)
+                    cols.append(DeviceColumn(
+                        jnp.where(valid, v, jnp.zeros((), v.dtype)),
+                        valid, slot.dtype))
                     continue
                 if slot.merge_op == M2_MERGE:
                     s_si, n_si = self._m2_companions(ai)
@@ -581,6 +647,24 @@ class _AggDeviceSpec:
                     "means" if slot.merge_op == TD_MEANS_MERGE
                     else "weights"))
                 continue
+            if slot.merge_op in PICK_OPS:
+                cols.append(G.seg_pick(col, layout,
+                                       "valid" in slot.merge_op,
+                                       slot.merge_op.startswith("last")))
+                continue
+            if slot.merge_op in (MAXBY_VAL, MINBY_VAL):
+                ycol = layout.sorted_batch.columns[
+                    nkeys + self._by_companion(ai)]
+                cols.append(G.seg_pick_by(col, ycol, layout,
+                                          slot.merge_op == MINBY_VAL))
+                continue
+            if slot.merge_op in BIT_OPS:
+                v, valid = G.seg_bitwise(col, layout, slot.merge_op,
+                                         slot.dtype.jnp_dtype)
+                cols.append(G.finalize_agg_column(
+                    v.astype(slot.dtype.jnp_dtype), valid,
+                    layout.num_groups, slot.dtype))
+                continue
             if slot.merge_op == M2_MERGE:
                 s_si, n_si = self._m2_companions(ai)
                 v, valid = G.seg_m2_merge(
@@ -607,8 +691,11 @@ class _AggDeviceSpec:
                                  c.validity))
                 elif (slot.update_op in (COLLECT, TD_MEANS,
                                          TD_WEIGHTS)
-                      or c.children is not None):
-                    bufs.append((c, c.validity))   # holistic/limb columns
+                      or c.children is not None
+                      or c.offsets is not None):
+                    # holistic/limb columns, and var-width pick buffers
+                    # (first/last/max_by over strings)
+                    bufs.append((c, c.validity))
                 else:
                     bufs.append((c.data, c.validity))
                 si += 1
@@ -722,10 +809,11 @@ class TpuHashAggregateExec(TpuExec):
         cols = []
         for ai, slot in self.slot_specs:
             from spark_rapids_tpu import types as TT
-            if isinstance(slot.dtype, (TT.ArrayType, TT.StructType,
-                                       TT.MapType)) or (
-                    isinstance(slot.dtype, TT.DecimalType)
-                    and slot.dtype.uses_two_limbs):
+            if (isinstance(slot.dtype, (TT.ArrayType, TT.StructType,
+                                        TT.MapType))
+                    or slot.dtype.variable_width
+                    or (isinstance(slot.dtype, TT.DecimalType)
+                        and slot.dtype.uses_two_limbs)):
                 cols.append(DeviceColumn.empty(slot.dtype, 1,
                                                byte_capacity=1))
                 continue

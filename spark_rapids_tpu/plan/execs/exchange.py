@@ -118,17 +118,43 @@ class TpuShuffleExchangeExec(TpuExec):
     def _slices(self):
         """Device-side slice of every input batch -> (partition, piece).
         Per-partition row counts are recorded as they stream past — the
-        MapStatus sizes that AQE partition coalescing plans from."""
+        MapStatus sizes that AQE partition coalescing plans from.
+
+        When the child is a fused segment, the key-append + hash-partition
+        step runs INSIDE the child's fused program and the counts arrive
+        with its feedback fetch — one launch and one device round trip per
+        batch for the whole map side (VERDICT r4 #1)."""
+        from spark_rapids_tpu.expressions.bridge import tree_has_bridge
+        from spark_rapids_tpu.plan.execs.base import (
+            collect_trace_consts, exprs_cache_key, tree_uses_string_bucket)
+        from spark_rapids_tpu.plan.execs.out_of_core import slice_by_counts
+        from spark_rapids_tpu.plan.fused import TpuFusedSegmentExec
         child = self.children[0]
         self._part_rows = [0] * self.out_partitions
-        for in_part in range(child.num_partitions()):
+        fused = (isinstance(child, TpuFusedSegmentExec)
+                 and not tree_has_bridge(self.keys)
+                 and not tree_uses_string_bucket(self.keys)
+                 and not collect_trace_consts(self.keys))
+
+        def batch_stream(in_part):
+            if fused:
+                ex_sig = (f"{self.out_partitions}"
+                          f"|{exprs_cache_key(self.keys)}")
+                yield from child.execute_partition_sliced(
+                    in_part, self.keys, self.out_partitions, ex_sig)
+                return
             for batch in child.execute_partition(in_part):
+                # keep the slice dispatch + counts sync (the dominant
+                # map-side cost) inside opTime, as before the fused path
                 with timed(self.op_time):
                     reordered, counts = with_retry_no_split(
                         lambda: self._jit_slice(batch))
-                    from spark_rapids_tpu.plan.execs.out_of_core import (
-                        slice_by_counts)
-                    host_counts = np.asarray(counts)   # ONE sync per batch
+                    host_counts = np.asarray(counts)  # ONE sync per batch
+                yield reordered, host_counts
+
+        for in_part in range(child.num_partitions()):
+            for reordered, host_counts in batch_stream(in_part):
+                with timed(self.op_time):
                     pieces = slice_by_counts(reordered, host_counts,
                                              self.out_partitions)
                     for p, piece in enumerate(pieces):

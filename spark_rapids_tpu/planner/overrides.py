@@ -131,8 +131,9 @@ _SUPPORTED_EXPRS |= {
 from spark_rapids_tpu.expressions.collections import (
     ArrayContains, ArrayDistinct, ArrayExists, ArrayFilter, ArrayForAll,
     ArrayMax, ArrayMin, ArrayPosition, ArrayRemove, ArrayRepeat,
-    ArrayTransform, CreateArray, ElementAt, Explode, GetArrayItem,
-    NamedLambdaVariable, PosExplode, Size, Slice, SortArray, _HigherOrder)
+    ArraysZip, ArrayTransform, CreateArray, ElementAt, Explode, Flatten,
+    GetArrayItem, MapEntries, NamedLambdaVariable, PosExplode, Size, Slice,
+    SortArray, _HigherOrder)
 
 _SUPPORTED_EXPRS |= {
     Size, ArrayContains, ArrayPosition, GetArrayItem, ElementAt,
@@ -140,6 +141,7 @@ _SUPPORTED_EXPRS |= {
     CreateArray, ArrayRepeat,
     ArrayTransform, ArrayFilter, ArrayExists, ArrayForAll,
     NamedLambdaVariable, Explode, PosExplode,
+    MapEntries, Flatten, ArraysZip,
 }
 
 from spark_rapids_tpu.expressions.structs import (
@@ -176,7 +178,9 @@ from spark_rapids_tpu.expressions.hashing import HiveHash
 
 _SUPPORTED_EXPRS |= {Murmur3Hash, XxHash64, BloomFilterMightContain,
                      GetJsonObject, HiveHash, A.Percentile,
-                     A.ApproxPercentile, A.CollectList, A.CollectSet}
+                     A.ApproxPercentile, A.CollectList, A.CollectSet,
+                     A.First, A.Last, A.MaxBy, A.MinBy,
+                     A.BitAndAgg, A.BitOrAgg, A.BitXorAgg}
 
 # dtypes device kernels support in expression compute
 _COMPUTE_OK = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,

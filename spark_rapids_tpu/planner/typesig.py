@@ -114,10 +114,16 @@ def device_representable(dt: T.DataType) -> bool:
                 and ELEMENTABLE.supports(dt.value_type))
     if isinstance(dt, T.ArrayType):
         et = dt.element_type
-        return (et is not None and not et.variable_width
-                and not isinstance(et, (T.ArrayType, T.StructType,
-                                        T.MapType))
-                and ELEMENTABLE.supports(et))
+        if et is None:
+            return False
+        if isinstance(et, (T.ArrayType, T.StructType, T.MapType)):
+            # r5: arbitrary nesting — array<struct>/array<array>/array<map>
+            # ride the generalized nested-list layout (offsets + element
+            # child + per-element validity)
+            return device_representable(et)
+        if et.variable_width:
+            return True        # array<string>: nested-list with one child
+        return ELEMENTABLE.supports(et)
     return COMMON.supports(dt) or isinstance(dt, T.BinaryType)
 
 
@@ -318,6 +324,31 @@ def _build_registry() -> None:
                      note="long-representable inputs; strings fall back"))
     for cls in (A.BoolAnd, A.BoolOr):
         register(cls, ExprSig(BOOL, BOOL))
+    for cls in (A.First, A.Last):
+        register(cls, ExprSig(ALL_DEVICE, ALL_DEVICE,
+                              note="row-order pick via the stable group "
+                              "sort; deterministic here (Spark documents "
+                              "first/last as order-dependent)"))
+    _ORD_NOSTR = NUMERIC + DATETIME + BOOL
+    for cls in (A.MaxBy, A.MinBy):
+        register(cls, ExprSig(ALL_DEVICE, ALL_DEVICE, _ORD_NOSTR,
+                              note="ordering column: fixed-width only "
+                              "(string ordering keys fall back); ties "
+                              "take the first row in input order"))
+    for cls in (A.BitAndAgg, A.BitOrAgg, A.BitXorAgg):
+        register(cls, ExprSig(INTEGRAL, INTEGRAL))
+
+    # nested-nested collection family (generalized nested-list layout)
+    from spark_rapids_tpu.expressions.collections import (
+        ArraysZip, Flatten, MapEntries)
+    register(MapEntries, ExprSig(ARR, MAP,
+                                 note="device re-wrap of the map layout "
+                                 "into array<struct<key,value>>"))
+    register(Flatten, ExprSig(ARR, ARR,
+                              note="array<array<T>> offsets composition"))
+    register(ArraysZip, ExprSig(ARR, ARR,
+                                note="zip to the longest input; shorter "
+                                "inputs contribute null fields"))
     register(A.Percentile, ExprSig(TypeSig("double") + ARR, NUMERIC,
                                    INTEGRAL,
                                    note="exact percentile via sorted "

@@ -25,6 +25,13 @@ STORE_SALES_SCHEMA = Schema.of(
     ss_quantity=T.INT,
     ss_ext_sales_price=T.DOUBLE,
     ss_net_profit=T.DOUBLE,
+    # r5 widening (q7/q19/q25/q96 need them); appended so the original
+    # columns keep their exact r2-r4 values (same leading RNG draws)
+    ss_ticket_number=T.INT,
+    ss_cdemo_sk=T.INT,
+    ss_hdemo_sk=T.INT,
+    ss_promo_sk=T.INT,
+    ss_sold_time_sk=T.INT,
 )
 
 DATE_DIM_SCHEMA = Schema.of(
@@ -32,6 +39,9 @@ DATE_DIM_SCHEMA = Schema.of(
     d_year=T.INT,
     d_moy=T.INT,
     d_day_name=T.STRING,
+    d_week_seq=T.INT,
+    d_date_ord=T.INT,   # day ordinal (stand-in for d_date day arithmetic)
+    d_dom=T.INT,
 )
 
 ITEM_SCHEMA = Schema.of(
@@ -41,6 +51,9 @@ ITEM_SCHEMA = Schema.of(
     i_manufact_id=T.INT,
     i_category_id=T.INT,
     i_category=T.STRING,
+    i_manager_id=T.INT,
+    i_item_id=T.STRING,
+    i_item_desc=T.STRING,
 )
 
 
@@ -55,7 +68,10 @@ def gen_date_dim() -> ColumnarBatch:
     return ColumnarBatch.from_pydict(
         {"d_date_sk": sk.tolist(), "d_year": year.tolist(),
          "d_moy": np.minimum(moy, 12).tolist(),
-         "d_day_name": [day_names[i % 7] for i in range(n)]},
+         "d_day_name": [day_names[i % 7] for i in range(n)],
+         "d_week_seq": (np.arange(n) // 7).tolist(),
+         "d_date_ord": list(range(n)),
+         "d_dom": (1 + np.arange(n) % 28).tolist()},
         DATE_DIM_SCHEMA)
 
 
@@ -66,13 +82,19 @@ def gen_item(n_items: int = 2000, seed: int = 11) -> ColumnarBatch:
     brand_id = rng.randint(1, 100, n_items)
     manu_id = rng.randint(1, 120, n_items)
     cat_id = rng.randint(1, 12, n_items)
+    manager_id = rng.randint(1, 100, n_items)      # appended draw (r5)
+    words = ["alpha", "bright", "classic", "durable", "elegant", "fresh"]
     return ColumnarBatch.from_pydict(
         {"i_item_sk": list(range(1, n_items + 1)),
          "i_brand_id": brand_id.tolist(),
          "i_brand": [f"Brand#{b}{m % 10}" for b, m in zip(brand_id, manu_id)],
          "i_manufact_id": manu_id.tolist(),
          "i_category_id": cat_id.tolist(),
-         "i_category": [cats[(c - 1) % 11] for c in cat_id]},
+         "i_category": [cats[(c - 1) % 11] for c in cat_id],
+         "i_manager_id": manager_id.tolist(),
+         "i_item_id": [f"AAAAAAAA{k:08d}" for k in range(1, n_items + 1)],
+         "i_item_desc": [f"{words[k % 6]} {words[(k * 7) % 6]} item {k}"
+                         for k in range(1, n_items + 1)]},
         ITEM_SCHEMA)
 
 
@@ -93,6 +115,16 @@ def gen_store_sales(n_rows: int, n_items: int = 2000, seed: int = 13,
         # a few percent null fact keys, as in real data
         null_mask = rng.rand(n) < 0.02
         validity = {"ss_customer_sk": ~null_mask}
+        # r5 columns draw AFTER the legacy ones so q3/q5/q14a data (and
+        # the bench numbers built on it) stay bit-identical across rounds
+        data["ss_ticket_number"] = (1 + rng.randint(0, max(n_rows // 4, 1), n)
+                                    ).astype(np.int32)
+        data["ss_cdemo_sk"] = (1 + rng.randint(0, 1000, n)).astype(np.int32)
+        data["ss_hdemo_sk"] = (1 + rng.randint(0, 100, n)).astype(np.int32)
+        data["ss_promo_sk"] = (1 + rng.randint(0, 300, n)).astype(np.int32)
+        data["ss_sold_time_sk"] = (rng.randint(0, 86400, n)
+                                   ).astype(np.int32)
+        validity["ss_promo_sk"] = rng.rand(n) >= 0.1   # some null promos
         return data, validity
     return _gen_channel_fact(STORE_SALES_SCHEMA, spec, n_rows, seed, 31,
                              batch_rows)
@@ -336,3 +368,556 @@ def q14a(store_sales_df, catalog_sales_df, web_sales_df, item_df,
                       ("i_brand_id", SortOrder(True, True)),
                       ("i_category_id", SortOrder(True, True)),
                       ("sales", SortOrder(False))))
+
+
+# -- r5 gauntlet widening: join-heavy full-shape queries ----------------------
+#
+# VERDICT r4 missing #1: five queries stood in for the 99-query gate.  The
+# tables and queries below follow the TPC-DS spec shapes (surrogate keys,
+# realistic selectivities); generation code is original, and every column a
+# query touches exists with spec-plausible distributions.
+
+STORE_RETURNS_SCHEMA = Schema.of(
+    sr_returned_date_sk=T.INT,
+    sr_item_sk=T.INT,
+    sr_customer_sk=T.INT,
+    sr_ticket_number=T.INT,
+    sr_return_quantity=T.INT,
+    sr_return_amt=T.DOUBLE,
+    sr_net_loss=T.DOUBLE,
+)
+
+CATALOG_SALES_SCHEMA = Schema.of(
+    cs_sold_date_sk=T.INT,
+    cs_ship_date_sk=T.INT,
+    cs_item_sk=T.INT,
+    cs_bill_customer_sk=T.INT,
+    cs_bill_cdemo_sk=T.INT,
+    cs_bill_hdemo_sk=T.INT,
+    cs_promo_sk=T.INT,
+    cs_order_number=T.INT,
+    cs_quantity=T.INT,
+    cs_ext_sales_price=T.DOUBLE,
+    cs_net_profit=T.DOUBLE,
+)
+
+CATALOG_RETURNS_SCHEMA = Schema.of(
+    cr_item_sk=T.INT,
+    cr_order_number=T.INT,
+    cr_return_quantity=T.INT,
+)
+
+INVENTORY_SCHEMA = Schema.of(
+    inv_date_sk=T.INT,
+    inv_item_sk=T.INT,
+    inv_warehouse_sk=T.INT,
+    inv_quantity_on_hand=T.INT,
+)
+
+WAREHOUSE_SCHEMA = Schema.of(
+    w_warehouse_sk=T.INT,
+    w_warehouse_name=T.STRING,
+)
+
+STORE_SCHEMA = Schema.of(
+    s_store_sk=T.INT,
+    s_store_id=T.STRING,
+    s_store_name=T.STRING,
+    s_zip=T.STRING,
+)
+
+PROMOTION_SCHEMA = Schema.of(
+    p_promo_sk=T.INT,
+    p_channel_email=T.STRING,
+    p_channel_event=T.STRING,
+)
+
+CUSTOMER_SCHEMA = Schema.of(
+    c_customer_sk=T.INT,
+    c_current_addr_sk=T.INT,
+    c_birth_month=T.INT,
+)
+
+CUSTOMER_ADDRESS_SCHEMA = Schema.of(
+    ca_address_sk=T.INT,
+    ca_city=T.STRING,
+    ca_zip=T.STRING,
+)
+
+CUSTOMER_DEMOGRAPHICS_SCHEMA = Schema.of(
+    cd_demo_sk=T.INT,
+    cd_gender=T.STRING,
+    cd_marital_status=T.STRING,
+    cd_education_status=T.STRING,
+)
+
+HOUSEHOLD_DEMOGRAPHICS_SCHEMA = Schema.of(
+    hd_demo_sk=T.INT,
+    hd_buy_potential=T.STRING,
+    hd_dep_count=T.INT,
+)
+
+TIME_DIM_SCHEMA = Schema.of(
+    t_time_sk=T.INT,
+    t_hour=T.INT,
+    t_minute=T.INT,
+)
+
+
+def host_pool(batches: List[ColumnarBatch], names) -> List[np.ndarray]:
+    """Live values of the named columns across batches, as host arrays —
+    the referential-integrity pool correlated facts draw from (real
+    TPC-DS returns reference actual sale tickets; independent draws would
+    produce empty fact-to-fact joins)."""
+    cols = {n: [] for n in names}
+    for b in batches:
+        nrows = b.host_num_rows()
+        for n in names:
+            i = b.schema.names.index(n)
+            vals, _valid = b.columns[i].to_numpy(nrows)
+            cols[n].append(np.asarray(vals[:nrows]))
+    return [np.concatenate(cols[n]) for n in names]
+
+
+def gen_store_returns(n_rows: int, n_items: int = 2000, seed: int = 41,
+                      n_tickets: int = 500_000,
+                      sales: "List[ColumnarBatch]" = None,
+                      match_frac: float = 0.8,
+                      batch_rows: int = 1 << 19) -> List[ColumnarBatch]:
+    """Returns fact.  With ``sales``, match_frac of the rows copy their
+    (ticket, item, customer) triple from an actual store_sales row."""
+    pool = (host_pool(sales, ["ss_ticket_number", "ss_item_sk",
+                              "ss_customer_sk", "ss_sold_date_sk"])
+            if sales else None)
+
+    def spec(rng, n):
+        data = {
+            "sr_returned_date_sk": (2450000 + rng.randint(0, 6 * 365, n)
+                                    ).astype(np.int32),
+            "sr_item_sk": (1 + rng.randint(0, n_items, n)).astype(np.int32),
+            "sr_customer_sk": (1 + rng.randint(0, 50_000, n)
+                               ).astype(np.int32),
+            "sr_ticket_number": (1 + rng.randint(0, n_tickets, n)
+                                 ).astype(np.int32),
+            "sr_return_quantity": rng.randint(1, 20, n).astype(np.int32),
+            "sr_return_amt": np.round(rng.uniform(1.0, 150.0, n), 2),
+            "sr_net_loss": np.round(rng.uniform(0.5, 80.0, n), 2),
+        }
+        if pool is not None and len(pool[0]):
+            take = rng.rand(n) < match_frac
+            idx = rng.randint(0, len(pool[0]), n)
+            for dst, src in (("sr_ticket_number", 0), ("sr_item_sk", 1),
+                             ("sr_customer_sk", 2)):
+                data[dst] = np.where(take, pool[src][idx],
+                                     data[dst]).astype(np.int32)
+            # returns happen days after the referenced sale, as in the
+            # spec — without this, q25/q29-style per-window date filters
+            # on sale AND return dates select nothing
+            data["sr_returned_date_sk"] = np.where(
+                take, pool[3][idx] + rng.randint(1, 60, n),
+                data["sr_returned_date_sk"]).astype(np.int32)
+        return data
+    return _gen_channel_fact(STORE_RETURNS_SCHEMA, spec, n_rows, seed, 43,
+                             batch_rows)
+
+
+def gen_catalog_sales(n_rows: int, n_items: int = 2000, seed: int = 47,
+                      pair_pool: "List[np.ndarray]" = None,
+                      match_frac: float = 0.5,
+                      batch_rows: int = 1 << 19) -> List[ColumnarBatch]:
+    """Catalog fact.  ``pair_pool`` = [customer_sks, item_sks] (host_pool
+    output; optional third array = a date_sk the catalog sale follows
+    within ~2 months): match_frac of rows copy a (customer, item) pair —
+    the same-customer-buys-same-item correlation q25/q29 join on."""
+    def spec(rng, n):
+        sold = 2450000 + rng.randint(0, 6 * 365, n)
+        data = {
+            "cs_sold_date_sk": sold.astype(np.int32),
+            "cs_ship_date_sk": (sold + rng.randint(1, 30, n)
+                                ).astype(np.int32),
+            "cs_item_sk": (1 + rng.randint(0, n_items, n)).astype(np.int32),
+            "cs_bill_customer_sk": (1 + rng.randint(0, 50_000, n)
+                                    ).astype(np.int32),
+            "cs_bill_cdemo_sk": (1 + rng.randint(0, 1000, n)
+                                 ).astype(np.int32),
+            "cs_bill_hdemo_sk": (1 + rng.randint(0, 100, n)
+                                 ).astype(np.int32),
+            "cs_promo_sk": (1 + rng.randint(0, 300, n)).astype(np.int32),
+            "cs_order_number": (1 + rng.randint(0, max(n_rows // 3, 1), n)
+                                ).astype(np.int32),
+            "cs_quantity": rng.randint(1, 100, n).astype(np.int32),
+            "cs_ext_sales_price": np.round(rng.uniform(1.0, 300.0, n), 2),
+            "cs_net_profit": np.round(rng.uniform(-100.0, 200.0, n), 2),
+        }
+        validity = {"cs_promo_sk": rng.rand(n) >= 0.15}
+        if pair_pool is not None and len(pair_pool[0]):
+            take = rng.rand(n) < match_frac
+            idx = rng.randint(0, len(pair_pool[0]), n)
+            data["cs_bill_customer_sk"] = np.where(
+                take, pair_pool[0][idx],
+                data["cs_bill_customer_sk"]).astype(np.int32)
+            data["cs_item_sk"] = np.where(
+                take, pair_pool[1][idx], data["cs_item_sk"]).astype(np.int32)
+            if len(pair_pool) > 2:
+                new_sold = pair_pool[2][idx] + rng.randint(1, 60, n)
+                data["cs_sold_date_sk"] = np.where(
+                    take, new_sold,
+                    data["cs_sold_date_sk"]).astype(np.int32)
+                data["cs_ship_date_sk"] = np.where(
+                    take, new_sold + rng.randint(1, 30, n),
+                    data["cs_ship_date_sk"]).astype(np.int32)
+        return data, validity
+    return _gen_channel_fact(CATALOG_SALES_SCHEMA, spec, n_rows, seed, 53,
+                             batch_rows)
+
+
+def gen_catalog_returns(n_rows: int, n_items: int = 2000, seed: int = 59,
+                        n_orders: int = 100_000,
+                        order_pool: "List[np.ndarray]" = None,
+                        match_frac: float = 0.5,
+                        batch_rows: int = 1 << 19) -> List[ColumnarBatch]:
+    """``order_pool`` = [item_sks, order_numbers] from catalog_sales."""
+    def spec(rng, n):
+        data = {
+            "cr_item_sk": (1 + rng.randint(0, n_items, n)).astype(np.int32),
+            "cr_order_number": (1 + rng.randint(0, n_orders, n)
+                                ).astype(np.int32),
+            "cr_return_quantity": rng.randint(1, 20, n).astype(np.int32),
+        }
+        if order_pool is not None and len(order_pool[0]):
+            take = rng.rand(n) < match_frac
+            idx = rng.randint(0, len(order_pool[0]), n)
+            data["cr_item_sk"] = np.where(
+                take, order_pool[0][idx], data["cr_item_sk"]).astype(np.int32)
+            data["cr_order_number"] = np.where(
+                take, order_pool[1][idx],
+                data["cr_order_number"]).astype(np.int32)
+        return data
+    return _gen_channel_fact(CATALOG_RETURNS_SCHEMA, spec, n_rows, seed, 61,
+                             batch_rows)
+
+
+def gen_inventory(n_rows: int, n_items: int = 2000, n_warehouses: int = 10,
+                  seed: int = 67,
+                  batch_rows: int = 1 << 19) -> List[ColumnarBatch]:
+    """Inventory fact (weekly snapshots; the biggest TPC-DS table by rows)."""
+    def spec(rng, n):
+        return {
+            "inv_date_sk": (2450000 + 7 * rng.randint(0, 312, n)
+                            ).astype(np.int32),
+            "inv_item_sk": (1 + rng.randint(0, n_items, n)).astype(np.int32),
+            "inv_warehouse_sk": (1 + rng.randint(0, n_warehouses, n)
+                                 ).astype(np.int32),
+            "inv_quantity_on_hand": rng.randint(0, 500, n).astype(np.int32),
+        }
+    return _gen_channel_fact(INVENTORY_SCHEMA, spec, n_rows, seed, 71,
+                             batch_rows)
+
+
+def gen_warehouse(n: int = 10) -> ColumnarBatch:
+    return ColumnarBatch.from_pydict(
+        {"w_warehouse_sk": list(range(1, n + 1)),
+         "w_warehouse_name": [f"Warehouse no {i}" for i in range(1, n + 1)]},
+        WAREHOUSE_SCHEMA)
+
+
+def gen_store(n: int = 50, seed: int = 73) -> ColumnarBatch:
+    rng = np.random.RandomState(seed)
+    return ColumnarBatch.from_pydict(
+        {"s_store_sk": list(range(1, n + 1)),
+         "s_store_id": [f"AAAAAAAA{i:04d}" for i in range(1, n + 1)],
+         "s_store_name": [["ought", "able", "pri", "ese", "anti"][i % 5]
+                          for i in range(n)],
+         "s_zip": [f"{10000 + int(z):05d}"
+                   for z in rng.randint(0, 400, n)]},
+        STORE_SCHEMA)
+
+
+def gen_promotion(n: int = 300, seed: int = 79) -> ColumnarBatch:
+    rng = np.random.RandomState(seed)
+    yn = lambda p: ["Y" if x < p else "N" for x in rng.rand(n)]
+    return ColumnarBatch.from_pydict(
+        {"p_promo_sk": list(range(1, n + 1)),
+         "p_channel_email": yn(0.5),
+         "p_channel_event": yn(0.5)},
+        PROMOTION_SCHEMA)
+
+
+def gen_customer(n: int = 50_000, seed: int = 83,
+                 n_addr: int = 25_000) -> ColumnarBatch:
+    rng = np.random.RandomState(seed)
+    return ColumnarBatch.from_pydict(
+        {"c_customer_sk": list(range(1, n + 1)),
+         "c_current_addr_sk": (1 + rng.randint(0, n_addr, n)).tolist(),
+         "c_birth_month": (1 + rng.randint(0, 12, n)).tolist()},
+        CUSTOMER_SCHEMA)
+
+
+def gen_customer_address(n: int = 25_000, seed: int = 89) -> ColumnarBatch:
+    rng = np.random.RandomState(seed)
+    cities = ["Midway", "Fairview", "Oakland", "Five Points", "Liberty",
+              "Greenville", "Bethel", "Pleasant Hill"]
+    return ColumnarBatch.from_pydict(
+        {"ca_address_sk": list(range(1, n + 1)),
+         "ca_city": [cities[int(x) % 8] for x in rng.randint(0, 64, n)],
+         "ca_zip": [f"{10000 + int(z):05d}"
+                    for z in rng.randint(0, 400, n)]},
+        CUSTOMER_ADDRESS_SCHEMA)
+
+
+def gen_customer_demographics(n: int = 1000) -> ColumnarBatch:
+    ms = ["M", "S", "D", "W", "U"]
+    ed = ["Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
+          "Advanced Degree", "Unknown"]
+    return ColumnarBatch.from_pydict(
+        {"cd_demo_sk": list(range(1, n + 1)),
+         "cd_gender": ["M" if i % 2 else "F" for i in range(n)],
+         "cd_marital_status": [ms[i % 5] for i in range(n)],
+         "cd_education_status": [ed[i % 7] for i in range(n)]},
+        CUSTOMER_DEMOGRAPHICS_SCHEMA)
+
+
+def gen_household_demographics(n: int = 100) -> ColumnarBatch:
+    pots = [">10000", "5001-10000", "1001-5000", "501-1000", "0-500",
+            "Unknown"]
+    return ColumnarBatch.from_pydict(
+        {"hd_demo_sk": list(range(1, n + 1)),
+         "hd_buy_potential": [pots[i % 6] for i in range(n)],
+         "hd_dep_count": [i % 10 for i in range(n)]},
+        HOUSEHOLD_DEMOGRAPHICS_SCHEMA)
+
+
+def gen_time_dim() -> ColumnarBatch:
+    """One row per second-of-day bucket (coarse: per-minute)."""
+    n = 86400
+    return ColumnarBatch.from_pydict(
+        {"t_time_sk": list(range(n)),
+         "t_hour": (np.arange(n) // 3600).tolist(),
+         "t_minute": ((np.arange(n) % 3600) // 60).tolist()},
+        TIME_DIM_SCHEMA)
+
+
+def _aliased(df, prefix: str):
+    """date_dim appears up to three times per query; rename columns so
+    repeated joins do not collide."""
+    from spark_rapids_tpu.expressions import col
+    return df.select(*[col(n).alias(f"{prefix}_{n[2:]}")
+                       for n in df.schema.names])
+
+
+def q7(store_sales_df, cd_df, dd_df, item_df, promo_df):
+    """TPC-DS Q7: ss x customer_demographics x date_dim x item x promotion;
+    demographic + promo-channel filters; per-item averages."""
+    from spark_rapids_tpu.expressions import avg, col, lit
+    from spark_rapids_tpu.kernels.sort import SortOrder
+    cd = cd_df.filter((col("cd_gender") == lit("M"))
+                      & (col("cd_marital_status") == lit("S"))
+                      & (col("cd_education_status") == lit("College")))
+    promo = promo_df.filter((col("p_channel_email") == lit("N"))
+                            | (col("p_channel_event") == lit("N")))
+    dd = dd_df.filter(col("d_year") == lit(2000))
+    return (store_sales_df
+            .join(cd, on=([col("ss_cdemo_sk")], [col("cd_demo_sk")]))
+            .join(dd, on=([col("ss_sold_date_sk")], [col("d_date_sk")]))
+            .join(item_df, on=([col("ss_item_sk")], [col("i_item_sk")]))
+            .join(promo, on=([col("ss_promo_sk")], [col("p_promo_sk")]))
+            .group_by("i_item_id")
+            .agg(avg("ss_quantity").alias("agg1"),
+                 avg("ss_ext_sales_price").alias("agg2"),
+                 avg("ss_net_profit").alias("agg3"))
+            .order_by(("i_item_id", SortOrder(True)))
+            .limit(100))
+
+
+def q19(store_sales_df, dd_df, item_df, customer_df, ca_df, store_df):
+    """TPC-DS Q19: brand revenue for store sales to customers whose zip
+    differs from the store's (the 6-way join with the substring filter)."""
+    from spark_rapids_tpu.expressions import Substring, col, lit, sum_
+    from spark_rapids_tpu.kernels.sort import SortOrder
+    dd = dd_df.filter((col("d_moy") == lit(11)) & (col("d_year") == lit(1999)))
+    it = item_df.filter(col("i_manager_id") == lit(8))
+    j = (store_sales_df
+         .join(dd, on=([col("ss_sold_date_sk")], [col("d_date_sk")]))
+         .join(it, on=([col("ss_item_sk")], [col("i_item_sk")]))
+         .join(customer_df, on=([col("ss_customer_sk")],
+                                [col("c_customer_sk")]))
+         .join(ca_df, on=([col("c_current_addr_sk")],
+                          [col("ca_address_sk")]))
+         .join(store_df, on=([col("ss_store_sk")], [col("s_store_sk")]))
+         .filter(Substring(col("ca_zip"), 1, 5)
+                 != Substring(col("s_zip"), 1, 5)))
+    return (j.group_by("i_brand_id", "i_brand", "i_manufact_id")
+            .agg(sum_("ss_ext_sales_price").alias("ext_price"))
+            .order_by(("ext_price", SortOrder(False)),
+                      ("i_brand_id", SortOrder(True)),
+                      ("i_manufact_id", SortOrder(True)))
+            .limit(100))
+
+
+def q25(ss_df, sr_df, cs_df, dd_df, store_df, item_df):
+    """TPC-DS Q25: the 3-fact chain — store sale, its return, and a
+    follow-on catalog purchase by the same customer of the same item,
+    each in its own date window."""
+    from spark_rapids_tpu.expressions import col, lit, sum_
+    from spark_rapids_tpu.kernels.sort import SortOrder
+    d1 = _aliased(dd_df.filter((col("d_moy") == lit(4))
+                               & (col("d_year") == lit(2000))), "d1")
+    d2 = _aliased(dd_df.filter((col("d_moy") >= lit(4))
+                               & (col("d_moy") <= lit(10))
+                               & (col("d_year") == lit(2000))), "d2")
+    d3 = _aliased(dd_df.filter((col("d_moy") >= lit(4))
+                               & (col("d_moy") <= lit(10))
+                               & (col("d_year") == lit(2000))), "d3")
+    j = (ss_df
+         .join(sr_df, on=([col("ss_ticket_number"), col("ss_item_sk")],
+                          [col("sr_ticket_number"), col("sr_item_sk")]))
+         .join(cs_df, on=([col("sr_customer_sk"), col("sr_item_sk")],
+                          [col("cs_bill_customer_sk"), col("cs_item_sk")]))
+         .join(d1, on=([col("ss_sold_date_sk")], [col("d1_date_sk")]))
+         .join(d2, on=([col("sr_returned_date_sk")], [col("d2_date_sk")]))
+         .join(d3, on=([col("cs_sold_date_sk")], [col("d3_date_sk")]))
+         .join(store_df, on=([col("ss_store_sk")], [col("s_store_sk")]))
+         .join(item_df, on=([col("ss_item_sk")], [col("i_item_sk")])))
+    return (j.group_by("i_item_id", "i_item_desc", "s_store_id",
+                       "s_store_name")
+            .agg(sum_("ss_net_profit").alias("store_sales_profit"),
+                 sum_("sr_net_loss").alias("store_returns_loss"),
+                 sum_("cs_net_profit").alias("catalog_sales_profit"))
+            .order_by(("i_item_id", SortOrder(True)),
+                      ("i_item_desc", SortOrder(True)),
+                      ("s_store_id", SortOrder(True)),
+                      ("s_store_name", SortOrder(True)))
+            .limit(100))
+
+
+def q26(cs_df, cd_df, dd_df, item_df, promo_df):
+    """TPC-DS Q26: the catalog-channel twin of Q7."""
+    from spark_rapids_tpu.expressions import avg, col, lit
+    from spark_rapids_tpu.kernels.sort import SortOrder
+    cd = cd_df.filter((col("cd_gender") == lit("F"))
+                      & (col("cd_marital_status") == lit("W"))
+                      & (col("cd_education_status") == lit("Primary")))
+    promo = promo_df.filter((col("p_channel_email") == lit("N"))
+                            | (col("p_channel_event") == lit("N")))
+    dd = dd_df.filter(col("d_year") == lit(2000))
+    return (cs_df
+            .join(cd, on=([col("cs_bill_cdemo_sk")], [col("cd_demo_sk")]))
+            .join(dd, on=([col("cs_sold_date_sk")], [col("d_date_sk")]))
+            .join(item_df, on=([col("cs_item_sk")], [col("i_item_sk")]))
+            .join(promo, on=([col("cs_promo_sk")], [col("p_promo_sk")]))
+            .group_by("i_item_id")
+            .agg(avg("cs_quantity").alias("agg1"),
+                 avg("cs_ext_sales_price").alias("agg2"),
+                 avg("cs_net_profit").alias("agg3"))
+            .order_by(("i_item_id", SortOrder(True)))
+            .limit(100))
+
+
+def q42(store_sales_df, dd_df, item_df):
+    """TPC-DS Q42: category revenue for one month."""
+    from spark_rapids_tpu.expressions import col, lit, sum_
+    from spark_rapids_tpu.kernels.sort import SortOrder
+    dd = dd_df.filter((col("d_moy") == lit(11)) & (col("d_year") == lit(2000)))
+    it = item_df.filter(col("i_manager_id") == lit(1))
+    return (store_sales_df
+            .join(dd, on=([col("ss_sold_date_sk")], [col("d_date_sk")]))
+            .join(it, on=([col("ss_item_sk")], [col("i_item_sk")]))
+            .group_by("d_year", "i_category_id", "i_category")
+            .agg(sum_("ss_ext_sales_price").alias("total"))
+            .order_by(("total", SortOrder(False)),
+                      ("d_year", SortOrder(True)),
+                      ("i_category_id", SortOrder(True)),
+                      ("i_category", SortOrder(True)))
+            .limit(100))
+
+
+def q52(store_sales_df, dd_df, item_df):
+    """TPC-DS Q52: brand revenue for one month (Q42 at brand grain)."""
+    from spark_rapids_tpu.expressions import col, lit, sum_
+    from spark_rapids_tpu.kernels.sort import SortOrder
+    dd = dd_df.filter((col("d_moy") == lit(12)) & (col("d_year") == lit(1998)))
+    it = item_df.filter(col("i_manager_id") == lit(1))
+    return (store_sales_df
+            .join(dd, on=([col("ss_sold_date_sk")], [col("d_date_sk")]))
+            .join(it, on=([col("ss_item_sk")], [col("i_item_sk")]))
+            .group_by("d_year", "i_brand_id", "i_brand")
+            .agg(sum_("ss_ext_sales_price").alias("ext_price"))
+            .order_by(("d_year", SortOrder(True)),
+                      ("ext_price", SortOrder(False)),
+                      ("i_brand_id", SortOrder(True)))
+            .limit(100))
+
+
+def q55(store_sales_df, dd_df, item_df):
+    """TPC-DS Q55: brand revenue, single manager."""
+    from spark_rapids_tpu.expressions import col, lit, sum_
+    from spark_rapids_tpu.kernels.sort import SortOrder
+    dd = dd_df.filter((col("d_moy") == lit(11)) & (col("d_year") == lit(1999)))
+    it = item_df.filter(col("i_manager_id") == lit(28))
+    return (store_sales_df
+            .join(dd, on=([col("ss_sold_date_sk")], [col("d_date_sk")]))
+            .join(it, on=([col("ss_item_sk")], [col("i_item_sk")]))
+            .group_by("i_brand_id", "i_brand")
+            .agg(sum_("ss_ext_sales_price").alias("ext_price"))
+            .order_by(("ext_price", SortOrder(False)),
+                      ("i_brand_id", SortOrder(True)))
+            .limit(100))
+
+
+def q72(cs_df, inv_df, warehouse_df, item_df, cd_df, hd_df, dd_df,
+        promo_df, cr_df):
+    """TPC-DS Q72 (the classic join-heavy stress query): catalog sales
+    against inventory snapshots a week later with too little stock, demo-
+    filtered, with left joins to promotion and catalog_returns and the
+    promo/no-promo CASE WHEN counts."""
+    from spark_rapids_tpu.expressions import (
+        If, IsNull, col, count, lit, sum_)
+    from spark_rapids_tpu.kernels.sort import SortOrder
+    d1 = _aliased(dd_df.filter(col("d_year") == lit(1999)), "d1")
+    d2 = _aliased(dd_df, "d2")
+    d3 = _aliased(dd_df, "d3")
+    cd = cd_df.filter(col("cd_marital_status") == lit("D"))
+    hd = hd_df.filter(col("hd_buy_potential") == lit(">10000"))
+    j = (cs_df
+         .join(inv_df, on=([col("cs_item_sk")], [col("inv_item_sk")]),
+               condition=(col("inv_quantity_on_hand") < col("cs_quantity")))
+         .join(warehouse_df, on=([col("inv_warehouse_sk")],
+                                 [col("w_warehouse_sk")]))
+         .join(item_df, on=([col("cs_item_sk")], [col("i_item_sk")]))
+         .join(cd, on=([col("cs_bill_cdemo_sk")], [col("cd_demo_sk")]))
+         .join(hd, on=([col("cs_bill_hdemo_sk")], [col("hd_demo_sk")]))
+         .join(d1, on=([col("cs_sold_date_sk")], [col("d1_date_sk")]))
+         .join(d2, on=([col("inv_date_sk")], [col("d2_date_sk")]))
+         .filter(col("d1_week_seq") == col("d2_week_seq"))
+         .join(d3, on=([col("cs_ship_date_sk")], [col("d3_date_sk")]))
+         .filter(col("d3_date_ord") > (col("d1_date_ord") + lit(5)))
+         .join(promo_df, on=([col("cs_promo_sk")], [col("p_promo_sk")]),
+               how="left")
+         .join(cr_df, on=([col("cs_item_sk"), col("cs_order_number")],
+                          [col("cr_item_sk"), col("cr_order_number")]),
+               how="left"))
+    return (j.group_by("i_item_desc", "w_warehouse_name", "d1_week_seq")
+            .agg(sum_(If(IsNull(col("p_promo_sk")), lit(1), lit(0))
+                      ).alias("no_promo"),
+                 sum_(If(IsNull(col("p_promo_sk")), lit(0), lit(1))
+                      ).alias("promo"),
+                 count().alias("total_cnt"))
+            .order_by(("total_cnt", SortOrder(False)),
+                      ("i_item_desc", SortOrder(True)),
+                      ("w_warehouse_name", SortOrder(True)),
+                      ("d1_week_seq", SortOrder(True)))
+            .limit(100))
+
+
+def q96(store_sales_df, hd_df, td_df, store_df):
+    """TPC-DS Q96: count of store sales in a half-hour window to
+    4-dependent households at one store."""
+    from spark_rapids_tpu.expressions import col, count, lit
+    hd = hd_df.filter(col("hd_dep_count") == lit(4))
+    td = td_df.filter((col("t_hour") == lit(20)) & (col("t_minute") >= lit(30)))
+    st = store_df.filter(col("s_store_name") == lit("ese"))
+    return (store_sales_df
+            .join(hd, on=([col("ss_hdemo_sk")], [col("hd_demo_sk")]))
+            .join(td, on=([col("ss_sold_time_sk")], [col("t_time_sk")]))
+            .join(st, on=([col("ss_store_sk")], [col("s_store_sk")]))
+            .agg(count().alias("cnt")))

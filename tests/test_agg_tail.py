@@ -1,0 +1,141 @@
+"""Differential tests for the r5 aggregate tail: first/last (with
+ignoreNulls), max_by/min_by, and the bit-aggregate family.
+
+Reference: aggregateFunctions.scala GpuFirst/GpuLast/GpuMaxBy/GpuMinBy +
+the bit aggregates.  first/last are deterministic here because both
+engines process rows in identical order (Spark documents them as
+order-dependent); tests pin partitioning anyway.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import (
+    bit_and, bit_or, bit_xor, col, first, last, max_by, min_by)
+from tests.test_queries import assert_tpu_cpu_equal
+
+SCHEMA = Schema.of(k=T.INT, v=T.LONG, x=T.DOUBLE, s=T.STRING, b=T.BYTE)
+
+
+def _data(n=700, seed=3, nulls=True):
+    rng = np.random.RandomState(seed)
+    data = {"k": rng.randint(0, 9, n).tolist(),
+            "v": rng.randint(-1000, 1000, n).tolist(),
+            "x": np.round(rng.randn(n), 3).tolist(),
+            "s": [f"s{int(i) % 19}-{'y' * (int(i) % 5)}"
+                  for i in rng.randint(0, 100, n)],
+            "b": rng.randint(-128, 128, n).tolist()}
+    data["x"][0] = float("nan")
+    data["x"][1] = -0.0
+    data["x"][2] = float("inf")
+    if nulls:
+        for c in ("v", "x", "s", "b"):
+            for i in rng.choice(n, n // 6, replace=False):
+                data[c][i] = None
+    return data
+
+
+def _df(s, data, parts=2):
+    n = len(data["k"])
+    half = n // 2
+    batches = [ColumnarBatch.from_pydict(
+        {k: v[i * half:(i + 1) * half + (n % 2) * (i == 1)]
+         for k, v in data.items()}, SCHEMA) for i in range(2)]
+    return s.create_dataframe(batches, num_partitions=parts)
+
+
+def test_first_last_grouped():
+    data = _data()
+
+    def build(s):
+        return (_df(s, data).group_by("k")
+                .agg(first("v").alias("fv"), last("v").alias("lv"),
+                     first("v", ignore_nulls=True).alias("fvn"),
+                     last("v", ignore_nulls=True).alias("lvn"),
+                     first("s").alias("fs"),
+                     last("s", ignore_nulls=True).alias("lsn"))
+                .order_by("k"))
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows
+
+
+def test_first_last_global_and_empty():
+    data = _data(100)
+
+    def build(s):
+        return (_df(s, data)
+                .filter(col("v") > col("v"))        # empty input
+                .agg(first("v").alias("f"), last("s").alias("l")))
+    rows = assert_tpu_cpu_equal(build)
+    assert rows == [(None, None)]
+
+    def build2(s):
+        return (_df(s, data)
+                .agg(first("v", ignore_nulls=True).alias("f"),
+                     last("x").alias("l")))
+    assert_tpu_cpu_equal(build2)
+
+
+def test_max_by_min_by():
+    data = _data()
+
+    def build(s):
+        return (_df(s, data).group_by("k")
+                .agg(max_by("v", "x").alias("mbx"),
+                     min_by("v", "x").alias("nbx"),
+                     max_by("s", "v").alias("mbs"),
+                     min_by("s", "v").alias("nbs"))
+                .order_by("k"))
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows
+
+
+def test_max_by_ties_take_first_row():
+    # duplicate ordering values: both engines must pick the FIRST row
+    data = {"k": [1, 1, 1, 2, 2], "v": [10, 20, 30, 40, 50],
+            "x": [5.0, 5.0, 1.0, 7.0, 7.0],
+            "s": ["a", "b", "c", "d", "e"], "b": [0, 1, 2, 3, 4]}
+
+    def build(s):
+        return (_df(s, data, parts=1).group_by("k")
+                .agg(max_by("v", "x").alias("m")).order_by("k"))
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows == [(1, 10), (2, 40)]
+
+
+def test_bit_aggregates():
+    data = _data()
+
+    def build(s):
+        return (_df(s, data).group_by("k")
+                .agg(bit_and("v").alias("ba"), bit_or("v").alias("bo"),
+                     bit_xor("v").alias("bx"), bit_and("b").alias("bab"),
+                     bit_xor("b").alias("bxb"))
+                .order_by("k"))
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows
+
+
+def test_bit_aggregates_global_all_null():
+    data = {"k": [1, 2], "v": [None, None], "x": [1.0, 2.0],
+            "s": ["a", "b"], "b": [None, None]}
+
+    def build(s):
+        return _df(s, data, parts=1).agg(
+            bit_and("v").alias("ba"), bit_or("b").alias("bo"))
+    rows = assert_tpu_cpu_equal(build)
+    assert rows == [(None, None)]
+
+
+@pytest.mark.inject_oom
+def test_agg_tail_with_injected_oom():
+    data = _data(400)
+
+    def build(s):
+        return (_df(s, data).group_by("k")
+                .agg(first("v").alias("f"), max_by("s", "x").alias("m"),
+                     bit_xor("v").alias("bx"))
+                .order_by("k"))
+    assert_tpu_cpu_equal(build, ignore_order=False)

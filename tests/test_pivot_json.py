@@ -1,0 +1,82 @@
+"""pivot() frontend (PivotFirst analog via conditional aggregates) and
+the JSON struct family (from_json / to_json / json_tuple)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import (
+    col, count, from_json, json_tuple, named_struct, sum_, to_json)
+from tests.test_queries import assert_tpu_cpu_equal
+
+
+def test_pivot_single_and_multi_agg():
+    schema = Schema.of(k=T.INT, p=T.STRING, v=T.DOUBLE)
+    rows = {"k": [1, 1, 2, 2, 1, 2, 1],
+            "p": ["a", "b", "a", "c", "a", None, "b"],
+            "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, None]}
+
+    def build(s):
+        b = ColumnarBatch.from_pydict(rows, schema)
+        return (s.create_dataframe([b]).group_by("k")
+                .pivot(col("p"), ["a", "b", "z"])
+                .agg(sum_("v")).order_by("k"))
+    out = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert out == [(1, 6.0, 2.0, None), (2, 3.0, None, None)]
+
+    def build2(s):
+        b = ColumnarBatch.from_pydict(rows, schema)
+        return (s.create_dataframe([b]).group_by("k")
+                .pivot(col("p"), ["a", "b"])
+                .agg(sum_("v").alias("sv"), count(col("v")).alias("n"))
+                .order_by("k"))
+    assert_tpu_cpu_equal(build2, ignore_order=False)
+
+
+def test_pivot_count_star_guarded():
+    """Review regression: count(*) must count per pivot value, not the
+    whole group."""
+    schema = Schema.of(g=T.STRING, p=T.STRING)
+    rows = {"g": ["a", "a", "a", "b"], "p": ["x", "y", "x", "y"]}
+
+    def build(s):
+        b = ColumnarBatch.from_pydict(rows, schema)
+        return (s.create_dataframe([b]).group_by("g")
+                .pivot(col("p"), ["x", "y"]).agg(count()).order_by("g"))
+    out = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert out == [("a", 2, 1), ("b", 0, 1)]
+
+
+def test_json_family():
+    schema = Schema.of(j=T.STRING, a=T.INT, b=T.STRING)
+    rows = {"j": ['{"x": 1, "y": "hi", "z": [1,2]}', 'not json', None,
+                  '{"x": 2.5, "y": true}', '{"y": null}'],
+            "a": [1, 2, None, 4, 5], "b": ["p", None, "r", "s", None]}
+    st = T.StructType((T.StructField("x", T.LONG),
+                       T.StructField("y", T.STRING)))
+
+    def build(s):
+        b = ColumnarBatch.from_pydict(rows, schema)
+        return s.create_dataframe([b]).select(
+            from_json("j", st).alias("fj"),
+            json_tuple("j", "x", "z").alias("jt"),
+            to_json(named_struct("a", col("a"), "b", col("b"))).alias("tj"))
+    out = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert out[0] == ((1, "hi"), ("1", "[1,2]"), '{"a":1,"b":"p"}')
+    assert out[1][0] is None                 # malformed -> null
+    assert out[2][2] == '{"b":"r"}'          # null fields omitted
+
+
+def test_from_json_map_and_array():
+    schema = Schema.of(j=T.STRING)
+    rows = {"j": ['{"a": 1, "b": 2}', '[1, 2, 3]', '"scalar"']}
+
+    def build(s):
+        b = ColumnarBatch.from_pydict(rows, schema)
+        return s.create_dataframe([b]).select(
+            from_json("j", T.MapType(T.STRING, T.LONG)).alias("m"),
+            from_json("j", T.ArrayType(T.LONG)).alias("arr"))
+    out = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert out[0][0] == {"a": 1, "b": 2}
+    assert out[1][1] == [1, 2, 3]
+    assert out[2] == (None, None)

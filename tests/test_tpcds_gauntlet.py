@@ -1,0 +1,138 @@
+"""Join-heavy full-shape TPC-DS gauntlet (VERDICT r4 missing #1 / next #2).
+
+Eight additional full-shape queries (q7, q19, q25, q26, q42, q52, q55,
+q72, q96) differential-tested against the CPU oracle at small scale.
+Composition coverage: multi-dim star joins, 3-fact chains with composite
+keys, repeated (aliased) date_dim joins, residual non-equi join
+conditions, left joins with CASE WHEN over null build rows, and a
+substring-mismatch filter.
+"""
+import pytest
+
+from spark_rapids_tpu.testing import tpcds
+from tests.test_queries import assert_tpu_cpu_equal
+
+N_FACT = 24_000
+BATCH = N_FACT // 3 + 1
+
+
+def _dims(s):
+    return {
+        "dd": s.create_dataframe([tpcds.gen_date_dim()]),
+        "item": s.create_dataframe([tpcds.gen_item()]),
+        "store": s.create_dataframe([tpcds.gen_store()]),
+        "promo": s.create_dataframe([tpcds.gen_promotion()]),
+        "cd": s.create_dataframe([tpcds.gen_customer_demographics()]),
+        "hd": s.create_dataframe([tpcds.gen_household_demographics()]),
+    }
+
+
+def _ss(s, n=N_FACT):
+    return s.create_dataframe(
+        tpcds.gen_store_sales(n, batch_rows=BATCH), num_partitions=2)
+
+
+def test_q7():
+    def build(s):
+        d = _dims(s)
+        return tpcds.q7(_ss(s), d["cd"], d["dd"], d["item"], d["promo"])
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows
+
+
+def test_q19():
+    def build(s):
+        d = _dims(s)
+        cust = s.create_dataframe([tpcds.gen_customer(8000, n_addr=4000)])
+        ca = s.create_dataframe([tpcds.gen_customer_address(4000)])
+        return tpcds.q19(_ss(s), d["dd"], d["item"], cust, ca, d["store"])
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows
+
+
+def test_q25_three_fact_chain():
+    ss_b = tpcds.gen_store_sales(N_FACT, batch_rows=BATCH)
+    sr_b = tpcds.gen_store_returns(8000, sales=ss_b, match_frac=0.9,
+                                   batch_rows=4001)
+    pool = tpcds.host_pool(sr_b, ["sr_customer_sk", "sr_item_sk",
+                              "sr_returned_date_sk"])
+    cs_b = tpcds.gen_catalog_sales(12_000, pair_pool=pool, match_frac=0.7,
+                                   batch_rows=6001)
+
+    def build(s):
+        d = _dims(s)
+        return tpcds.q25(
+            s.create_dataframe(ss_b, num_partitions=2),
+            s.create_dataframe(sr_b, num_partitions=2),
+            s.create_dataframe(cs_b, num_partitions=2),
+            d["dd"], d["store"], d["item"])
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows, "q25 must join through the 3-fact chain at this scale"
+
+
+def test_q26():
+    def build(s):
+        d = _dims(s)
+        cs = s.create_dataframe(
+            tpcds.gen_catalog_sales(N_FACT, batch_rows=BATCH),
+            num_partitions=2)
+        return tpcds.q26(cs, d["cd"], d["dd"], d["item"], d["promo"])
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows
+
+
+@pytest.mark.parametrize("q", [tpcds.q42, tpcds.q52, tpcds.q55])
+def test_q42_q52_q55(q):
+    def build(s):
+        d = _dims(s)
+        return q(_ss(s), d["dd"], d["item"])
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows
+
+
+def test_q72_inventory_stress():
+    cs_b = tpcds.gen_catalog_sales(8000, batch_rows=4001)
+    order_pool = tpcds.host_pool(cs_b, ["cs_item_sk", "cs_order_number"])
+    cr_b = tpcds.gen_catalog_returns(3000, order_pool=order_pool,
+                                     match_frac=0.6, batch_rows=1501)
+    inv_b = tpcds.gen_inventory(20_000, batch_rows=10_001)
+
+    def build(s):
+        d = _dims(s)
+        return tpcds.q72(
+            s.create_dataframe(cs_b, num_partitions=2),
+            s.create_dataframe(inv_b, num_partitions=2),
+            s.create_dataframe([tpcds.gen_warehouse()]),
+            d["item"], d["cd"], d["hd"], d["dd"], d["promo"],
+            s.create_dataframe(cr_b, num_partitions=1))
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows, "q72 must produce rows at this scale"
+
+
+def test_q96():
+    def build(s):
+        d = _dims(s)
+        td = s.create_dataframe([tpcds.gen_time_dim()])
+        return tpcds.q96(_ss(s), d["hd"], td, d["store"])
+    rows = assert_tpu_cpu_equal(build)
+    assert rows and rows[0][0] >= 0
+
+
+@pytest.mark.inject_oom
+def test_q25_with_injected_oom():
+    ss_b = tpcds.gen_store_sales(12_000, batch_rows=6001)
+    sr_b = tpcds.gen_store_returns(4000, sales=ss_b, match_frac=0.9,
+                                   batch_rows=2001)
+    pool = tpcds.host_pool(sr_b, ["sr_customer_sk", "sr_item_sk",
+                              "sr_returned_date_sk"])
+    cs_b = tpcds.gen_catalog_sales(6000, pair_pool=pool, match_frac=0.7,
+                                   batch_rows=3001)
+
+    def build(s):
+        d = _dims(s)
+        return tpcds.q25(
+            s.create_dataframe(ss_b, num_partitions=2),
+            s.create_dataframe(sr_b, num_partitions=2),
+            s.create_dataframe(cs_b, num_partitions=2),
+            d["dd"], d["store"], d["item"])
+    assert_tpu_cpu_equal(build, ignore_order=False)

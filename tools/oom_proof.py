@@ -1,0 +1,150 @@
+"""Deliberate on-chip HBM exhaustion -> retry/spill recovery proof.
+
+VERDICT r4 weak #4 / next #6: the real-OOM path had only ever been tested
+with a faked exception class.  This tool, run against the REAL TPU chip:
+
+  1. builds a query input and computes the expected answer on the CPU
+     oracle first (so the expectation never depends on the device);
+  2. fills most of HBM with spillable ballast batches (registered with
+     the SpillFramework and unpinned — evictable, exactly like cached
+     shuffle/broadcast data);
+  3. runs the query on the chip.  The working set no longer fits, XLA
+     raises a genuine RESOURCE_EXHAUSTED, translate_device_oom turns it
+     into TpuRetryOOM, the emergency spill evicts the ballast to host,
+     and the retry succeeds;
+  4. asserts: at least one REAL device OOM was translated
+     (arena.GLOBAL_DEVICE_OOM_COUNT), ballast bytes were spilled, and
+     the recovered result matches the oracle row-for-row;
+  5. writes the evidence to OOMPROOF_r05.json at the repo root.
+
+Reference being proven: DeviceMemoryEventHandler.scala — the allocator
+failure callback that spills and retries instead of failing the query.
+
+Usage:  python tools/oom_proof.py          (axon/TPU default platform)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BALLAST_BATCH_BYTES = 512 << 20      # 8 doubles/row * 8M rows
+OUT = os.path.join(REPO, "OOMPROOF_r05.json")
+
+
+def _result(**kw) -> None:
+    kw.setdefault("timestamp", time.strftime("%Y-%m-%d %H:%M:%S"))
+    with open(OUT, "w") as f:
+        json.dump(kw, f, indent=1)
+    print(json.dumps(kw, indent=1))
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        _result(ok=False, skipped=True,
+                reason=f"not a TPU (platform={dev.platform}); the proof "
+                       "needs real HBM to exhaust")
+        return 0
+    # HBM size from the device when available; v5e default 16 GiB
+    hbm = getattr(dev, "memory_stats", lambda: {})() or {}
+    hbm_limit = int(hbm.get("bytes_limit", 16 << 30))
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+    from spark_rapids_tpu.expressions import col, count, lit, sum_
+    from spark_rapids_tpu.memory import arena
+    from spark_rapids_tpu.memory.spill import make_spillable, spill_framework
+
+    # 1. query input + oracle expectation (before any ballast)
+    n = 1 << 20
+    rng = np.random.RandomState(5)
+    schema = Schema.of(k=T.INT, v=T.DOUBLE)
+    data = {"k": (1 + rng.randint(0, 1000, n)).tolist(),
+            "v": np.round(rng.uniform(0, 10, n), 3).tolist()}
+
+    def build(sess):
+        b = ColumnarBatch.from_pydict(data, schema)
+        df = sess.create_dataframe([b], num_partitions=1)
+        return (df.filter(col("v") > lit(1.0)).group_by("k")
+                .agg(sum_("v").alias("sv"), count().alias("n"))
+                .order_by("k"))
+
+    expected = build(TpuSession({"spark.rapids.sql.enabled": "false"})
+                     ).collect()
+
+    sess = TpuSession({"spark.rapids.sql.enabled": "true"})
+    warm = build(sess).collect()        # compile everything BEFORE ballast
+    assert warm == expected or len(warm) == len(expected)
+
+    # 2. ballast: fill HBM to the brim with evictable batches
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    rows = BALLAST_BATCH_BYTES // 8
+    bschema = Schema.of(x=T.DOUBLE)
+    handles = []
+    filled = 0
+    target = hbm_limit - (1 << 30)      # leave < the query's working set
+    while filled < target:
+        try:
+            col_ = DeviceColumn(
+                jnp.zeros((rows,), jnp.float64) + float(len(handles)),
+                jnp.ones((rows,), jnp.bool_), T.DOUBLE)
+            b = ColumnarBatch((col_,), jnp.int32(rows), bschema)
+            jax.block_until_ready(b.columns[0].data)
+            h = make_spillable(b)
+            h.unpin()
+            handles.append(h)
+            filled += BALLAST_BATCH_BYTES
+        except Exception as e:  # noqa: BLE001 — device full during fill
+            print(f"ballast stopped at {filled >> 20} MiB: "
+                  f"{type(e).__name__}", file=sys.stderr)
+            break
+    baseline_oom = arena.GLOBAL_DEVICE_OOM_COUNT
+    spilled_before = spill_framework().metrics.spill_to_host_bytes
+
+    # 3. the run that must exhaust and recover
+    got = build(sess).collect()
+
+    ooms = arena.GLOBAL_DEVICE_OOM_COUNT - baseline_oom
+    spilled = (spill_framework().metrics.spill_to_host_bytes
+               - spilled_before)
+
+    def rows_close(a, b):     # TPU f64 emulation: ~3-ulp double error
+        if len(a) != len(b):
+            return False
+        for ra, rb in zip(a, b):
+            for x, y in zip(ra, rb):
+                if isinstance(x, float):
+                    if not (x == y or abs(x - y) <= 1e-9 * max(1.0, abs(y))):
+                        return False
+                elif x != y:
+                    return False
+        return True
+    match = rows_close(got, expected)
+    for h in handles:
+        h.close()
+    _result(ok=bool(match and ooms >= 1 and spilled > 0),
+            backend="tpu", device=str(dev),
+            hbm_limit_bytes=hbm_limit,
+            ballast_bytes=filled,
+            real_device_oom_translations=ooms,
+            ballast_bytes_spilled=int(spilled),
+            rows=len(got), rows_match_oracle=bool(match),
+            note=("genuine XLA RESOURCE_EXHAUSTED -> TpuRetryOOM -> "
+                  "emergency spill -> retry succeeded"
+                  if ooms else
+                  "query completed WITHOUT hitting a real OOM — ballast "
+                  "did not crowd HBM enough; raise ballast target"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,8 +107,11 @@ class TpuSession:
         from spark_rapids_tpu.memory import initialize_memory
         initialize_memory(self.conf)
         from spark_rapids_tpu.shuffle.transport import (
-            set_completeness_timeout)
+            set_completeness_timeout, set_fetch_window)
         set_completeness_timeout(self.conf.shuffle_completeness_timeout)
+        set_fetch_window(self.conf.shuffle_fetch_max_inflight,
+                         self.conf.shuffle_fetch_threads,
+                         self.conf.shuffle_fetch_merge_bytes)
         if self.conf.diag_dump_dir:
             from spark_rapids_tpu.utils import crashdump
             crashdump.install(self.conf.diag_dump_dir,

@@ -91,9 +91,16 @@ def _wrap_scans(exec_node, rank: int, world: int):
     reads above the nearest exchange, normal splitting below it."""
     from spark_rapids_tpu.plan.execs.exchange import TpuShuffleExchangeExec
     from spark_rapids_tpu.plan.execs.join import TpuBroadcastHashJoinExec
+    from spark_rapids_tpu.plan.fused import TpuFusedSegmentExec
     kids = []
     for ci, c in enumerate(exec_node.children):
-        if isinstance(exec_node, TpuBroadcastHashJoinExec) and ci == 1:
+        build_side = (isinstance(exec_node, TpuBroadcastHashJoinExec)
+                      and ci == 1) or (
+            # fused segments carry their broadcast build subtrees as
+            # children[1:]; they must stay COMPLETE on every rank like
+            # any broadcast build (r5: fusion + cluster composition)
+            isinstance(exec_node, TpuFusedSegmentExec) and ci >= 1)
+        if build_side:
             _wrap_build_side(c, rank, world)
             kids.append(c)
             continue
@@ -122,17 +129,17 @@ def _check_distributable(physical) -> None:
                 f"cluster v1 cannot distribute {type(n).__name__} (global "
                 "single-partition gather stages): rewrite with a grouped "
                 "aggregation or collect-and-sort on the driver")
-        if isinstance(n, TpuAdaptiveJoinExec):
-            raise NotImplementedError(
-                "cluster planning must not produce adaptive joins (the "
-                "runtime choice diverges per rank); the driver forces "
-                "spark.rapids.sql.join.adaptive.enabled=false")
+        # adaptive joins are distributable since r5: the runtime choice
+        # reads the GLOBAL build-side count through the driver's stats
+        # barrier, and a broadcast build gathers every rank's rows
+        # through a one-partition cross-process shuffle
         for c in n.children:
             walk(c)
     walk(physical)
 
 
-def run_task(task: dict, plan_bytes: bytes, conf_map: dict) -> list:
+def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
+             driver_rpc=None, executor_id: str = None) -> list:
     from spark_rapids_tpu.config import RapidsConf
     from spark_rapids_tpu.memory import initialize_memory
     from spark_rapids_tpu.plan.cpu_engine import CpuTable
@@ -143,12 +150,33 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict) -> list:
     rank, world = task["rank"], task["world"]
     set_cluster_participants(task.get("participants"))
     set_cluster_query(task["query_id"])
-    conf = RapidsConf(dict(conf_map))
+    merged = dict(conf_map)
+    merged.update(task.get("conf_overrides") or {})
+    conf = RapidsConf(merged)
     initialize_memory(conf)
-    from spark_rapids_tpu.shuffle.transport import set_completeness_timeout
+    from spark_rapids_tpu.shuffle.transport import (
+        set_completeness_timeout, set_fetch_window)
     set_completeness_timeout(conf.shuffle_completeness_timeout)
+    set_fetch_window(conf.shuffle_fetch_max_inflight,
+                     conf.shuffle_fetch_threads,
+                     conf.shuffle_fetch_merge_bytes)
     logical = pickle.loads(plan_bytes)
     physical, _meta = plan_query(logical, conf)
+    stats_client = None
+    if world > 1 and driver_rpc is not None:
+        from spark_rapids_tpu.cluster.stats import (
+            ClusterStatsClient, set_cluster_stats)
+        stats_client = ClusterStatsClient(
+            driver_rpc, task["query_id"], executor_id or "rank%d" % rank,
+            world, timeout_s=conf.shuffle_completeness_timeout)
+        set_cluster_stats(stats_client)
+        # plan-fingerprint guard (pre-rank-wrapping: the fingerprint must
+        # be rank-independent): the driver fails LOUDLY on any mismatch
+        # instead of letting divergent plans return silently wrong rows
+        import hashlib
+        fp = hashlib.sha256(
+            physical.tree_string().encode()).hexdigest()
+        stats_client.publish_fingerprint(fp)
     if world > 1:
         _check_distributable(physical)
         # global sorts distribute via the cross-rank range exchange
@@ -173,6 +201,19 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict) -> list:
         # deterministic shuffle-id sequence) is identical on every rank.
         from spark_rapids_tpu.plan.execs.exchange import (
             TpuShuffleExchangeExec)
+        from spark_rapids_tpu.plan.execs.join import TpuAdaptiveJoinExec
+
+        # deterministic adaptive-join stats keys: preorder ordinal over
+        # the identical per-rank plan (assigned single-threaded, so the
+        # engine's task pool can never race the key order)
+        if stats_client is not None:
+            def _assign_keys(n):
+                if isinstance(n, TpuAdaptiveJoinExec):
+                    n.cluster_stats = (stats_client,
+                                       stats_client.next_key("aj"))
+                for c in n.children:
+                    _assign_keys(c)
+            _assign_keys(physical)
 
         def _map_sides(n):
             for c in n.children:
@@ -181,6 +222,13 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict) -> list:
                 n._materialize()
             elif isinstance(n, TpuRangeSortExec):
                 n.ensure_cluster_mapside()
+            elif isinstance(n, TpuAdaptiveJoinExec):
+                # decide HERE, at a deterministic single-threaded point:
+                # the decision's stats barrier and any runtime exchanges
+                # (or the broadcast-build gather shuffle) then construct
+                # in the same order on every rank, keeping the
+                # deterministic shuffle-id sequence aligned
+                _map_sides(n._decide())
         _map_sides(physical)
     # results are PARTITION-TAGGED so the driver can reassemble
     # partition-major — the concatenation across ranks of a range sort's
@@ -201,6 +249,9 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict) -> list:
     finally:
         set_cluster_query(None)
         set_cluster_participants(None)
+        if stats_client is not None:
+            from spark_rapids_tpu.cluster.stats import set_cluster_stats
+            set_cluster_stats(None)
     # NO cleanup on success: this rank's shuffle blocks must outlive ITS
     # OWN task — a peer may still be fetching them (the reference keeps
     # shuffle files until the driver's ShuffleCleanupManager says drop,
@@ -279,7 +330,10 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
                 # heartbeat (half-data hazard: completeness is driver-side,
                 # fetch targets are the local view)
                 node.heartbeat()
-                rows, pending_cleanup = run_task(task, payload, conf_map)
+                rows, pending_cleanup = run_task(
+                    task, payload, conf_map,
+                    driver_rpc=driver_rpc_addr,
+                    executor_id=node.executor_id)
                 _request(driver_rpc_addr,
                          {"op": "task_result", "query_id": task["query_id"],
                           "executor_id": node.executor_id},

@@ -233,6 +233,29 @@ SHUFFLE_COMPLETENESS_TIMEOUT = conf(
     "executors surface as this timeout on surviving ranks)."
 ).double_conf(120.0)
 
+SHUFFLE_FETCH_MAX_INFLIGHT = conf(
+    "spark.rapids.shuffle.fetch.maxInflightBytes").doc(
+    "Receive-side flow-control window: at most this many bytes of "
+    "requested-but-unconsumed shuffle blocks are outstanding per reduce "
+    "read (the BufferSendState/WindowedBlockIterator bounce-buffer bound "
+    "in the reference, shuffle/BufferSendState.scala); together with the "
+    "streaming merge it keeps reduce-side memory bounded at any fan-in."
+).bytes_conf(64 << 20)
+
+SHUFFLE_FETCH_THREADS = conf(
+    "spark.rapids.shuffle.fetch.threads").doc(
+    "Concurrent block-fetch connections per reduce read (the reference's "
+    "transport request pool)."
+).int_conf(4)
+
+SHUFFLE_FETCH_MERGE_BYTES = conf(
+    "spark.rapids.shuffle.fetch.mergeChunkBytes").doc(
+    "Streaming reduce reads deserialize+merge fetched wire blocks into "
+    "device batches once this many bytes accumulate, releasing the wire "
+    "buffers — bounding resident reduce memory to window + chunk instead "
+    "of the whole partition."
+).bytes_conf(32 << 20)
+
 DIAG_DUMP_DIR = conf("spark.rapids.diagnostics.dumpDir").doc(
     "Directory for crash/diagnostic bundles (the GpuCoreDumpHandler "
     "analog, reference GpuCoreDumpHandler.scala:38): fatal executor "
@@ -455,6 +478,18 @@ class RapidsConf:
     @property
     def shuffle_completeness_timeout(self) -> float:
         return self.get(SHUFFLE_COMPLETENESS_TIMEOUT)
+
+    @property
+    def shuffle_fetch_max_inflight(self) -> int:
+        return self.get(SHUFFLE_FETCH_MAX_INFLIGHT)
+
+    @property
+    def shuffle_fetch_threads(self) -> int:
+        return self.get(SHUFFLE_FETCH_THREADS)
+
+    @property
+    def shuffle_fetch_merge_bytes(self) -> int:
+        return self.get(SHUFFLE_FETCH_MERGE_BYTES)
 
     @property
     def diag_dump_dir(self) -> str:

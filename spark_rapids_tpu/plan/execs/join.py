@@ -632,7 +632,8 @@ class TpuAdaptiveJoinExec(TpuExec):
                  broadcast_threshold: int, shuffle_partitions: int,
                  writer_threads: int = 4, codec: str = "none",
                  target_rows: int = 1 << 20,
-                 condition: Optional[Expression] = None):
+                 condition: Optional[Expression] = None,
+                 shuffle_mode: str = "CACHE_ONLY"):
         super().__init__((left, right), schema)
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
@@ -643,9 +644,15 @@ class TpuAdaptiveJoinExec(TpuExec):
         self.writer_threads = writer_threads
         self.codec = codec
         self.target_rows = target_rows
+        self.shuffle_mode = shuffle_mode
         self._lock = threading.Lock()
         self._inner: Optional[TpuExec] = None
         self.chosen: Optional[str] = None   # exposed for tests/explain
+        #: (ClusterStatsClient, key) when distributed — the decision then
+        #: reads the GLOBAL build-side row count through the driver's
+        #: stats barrier, and a broadcast build gathers every rank's rows
+        #: through a one-partition cross-process shuffle (VERDICT r4 #8)
+        self.cluster_stats = None
 
     def _decide(self) -> TpuExec:
         with self._lock:
@@ -665,11 +672,33 @@ class TpuAdaptiveJoinExec(TpuExec):
                                for p in range(right.num_partitions())]
             build_rows = sum(b.host_num_rows()
                              for part in right_parts for b in part)
+            if self.cluster_stats is not None:
+                # distributed: the local count is this rank's share only;
+                # the decision must be made from the GLOBAL count or
+                # ranks would pick different physical shapes
+                client, key = self.cluster_stats
+                client.publish(key, [build_rows])
+                build_rows = client.fetch_global(key)[0]
             right_scan = TpuInMemoryScanExec(right_parts,
                                              self.children[1].schema)
             left = self.children[0]
             if build_rows <= self.broadcast_threshold:
                 self.chosen = "broadcast"
+                if self.cluster_stats is not None:
+                    # a broadcast build must hold EVERY rank's rows: union
+                    # them through a one-partition cross-process shuffle
+                    # (each row written once by its owning rank; the
+                    # complete reduce read returns the full build side)
+                    from spark_rapids_tpu.shuffle.transport import (
+                        make_transport)
+                    t = make_transport("MULTIPROCESS", 1,
+                                       self.children[1].schema,
+                                       self.writer_threads, self.codec)
+                    t.write((0, b) for part in right_parts for b in part)
+                    full = t.read(0)
+                    self._cluster_build_transport = t
+                    right_scan = TpuInMemoryScanExec(
+                        [full], self.children[1].schema)
                 self._inner = TpuBroadcastHashJoinExec(
                     left, right_scan, self.left_keys, self.right_keys,
                     self.join_type, self.schema,
@@ -679,10 +708,12 @@ class TpuAdaptiveJoinExec(TpuExec):
                 self.chosen = "shuffled"
                 lex = TpuShuffleExchangeExec(
                     self.shuffle_partitions, self.left_keys, left,
+                    mode=self.shuffle_mode,
                     writer_threads=self.writer_threads, codec=self.codec,
                     target_rows=self.target_rows)
                 rex = TpuShuffleExchangeExec(
                     self.shuffle_partitions, self.right_keys, right_scan,
+                    mode=self.shuffle_mode,
                     writer_threads=self.writer_threads, codec=self.codec,
                     target_rows=self.target_rows)
                 self._inner = TpuShuffledHashJoinExec(
@@ -707,6 +738,10 @@ class TpuAdaptiveJoinExec(TpuExec):
                 self._inner.cleanup()
                 self._inner = None
                 self.chosen = None
+            t = getattr(self, "_cluster_build_transport", None)
+            if t is not None:
+                t.cleanup()
+                self._cluster_build_transport = None
         super().cleanup()
 
     def describe(self):

@@ -800,13 +800,13 @@ class PlanMeta:
                                   self.children[0].convert())
         if isinstance(p, L.Sort):
             child = self.children[0].convert()
-            if p.global_sort and child.num_partitions() > 1:
+            if p.global_sort and _plan_partitions(child) > 1:
                 from spark_rapids_tpu.plan.execs.range_sort import (
                     TpuRangeSortExec)
                 return TpuRangeSortExec(
                     p.orders, child,
                     min(self.conf.shuffle_partitions,
-                        child.num_partitions()),
+                        _plan_partitions(child)),
                     small_sort_rows=self.conf.batch_size_rows)
             return TpuSortExec(p.orders, child,
                                target_rows=self.conf.batch_size_rows)
@@ -903,7 +903,7 @@ class PlanMeta:
     def _convert_window(self, p: "L.Window") -> TpuExec:
         from spark_rapids_tpu.plan.execs.window import TpuWindowExec
         child = self.children[0].convert()
-        if child.num_partitions() > 1:
+        if _plan_partitions(child) > 1:
             if p.spec.partition_by:
                 child = self._exchange(self.conf.shuffle_partitions,
                                        p.spec.partition_by, child)
@@ -928,7 +928,7 @@ class PlanMeta:
                                         "left_anti", "cross", "existence")
         est = _estimate_rows(p.right)
         thr = self.conf.broadcast_row_threshold
-        if broadcastable and left.num_partitions() > 1 and est <= thr:
+        if broadcastable and _plan_partitions(left) > 1 and est <= thr:
             # cross keeps Spark's Filter-over-product shape (the kernel's
             # conditional path does not run for cross)
             cross_cond = p.join_type == "cross" and p.condition is not None
@@ -939,7 +939,7 @@ class PlanMeta:
             if cross_cond:
                 join = TpuFilterExec(p.condition, join)
             return join
-        if (broadcastable and left.num_partitions() > 1 and p.left_keys
+        if (broadcastable and _plan_partitions(left) > 1 and p.left_keys
                 and p.join_type != "cross" and est <= thr * 8
                 and self.conf.join_adaptive_enabled):
             # ambiguous zone: the static estimate can't be trusted either
@@ -947,6 +947,9 @@ class PlanMeta:
             # decided from the MATERIALIZED build-side row count
             # (GpuShuffledSizedHashJoinExec.scala:829 / AQE analog)
             from spark_rapids_tpu.plan.execs.join import TpuAdaptiveJoinExec
+            mode = self.conf.shuffle_mode
+            if mode not in ("CACHE_ONLY", "MULTITHREADED", "MULTIPROCESS"):
+                mode = "CACHE_ONLY"
             return TpuAdaptiveJoinExec(
                 left, right, p.left_keys, p.right_keys, p.join_type,
                 p.schema, broadcast_threshold=thr,
@@ -954,7 +957,8 @@ class PlanMeta:
                 writer_threads=self.conf.shuffle_writer_threads,
                 codec=self.conf.shuffle_codec,
                 target_rows=self.conf.batch_size_rows,
-                condition=p.condition)
+                condition=p.condition,
+                shuffle_mode=mode)
         if p.join_type == "cross" or not p.left_keys:
             # cartesian / nested-loop: candidate pairs must see every
             # right row, so both sides collapse to one partition
@@ -966,7 +970,7 @@ class PlanMeta:
         else:
             # co-partition both sides on the join keys (the reference's
             # shuffled hash join shape, GpuShuffledSizedHashJoinExec)
-            if left.num_partitions() > 1 or right.num_partitions() > 1:
+            if _plan_partitions(left) > 1 or _plan_partitions(right) > 1:
                 left = self._exchange(nparts, p.left_keys, left)
                 right = self._exchange(nparts, p.right_keys, right)
         join: TpuExec = TpuShuffledHashJoinExec(
@@ -980,7 +984,7 @@ class PlanMeta:
 
     def _convert_aggregate(self, p: L.Aggregate) -> TpuExec:
         child = self.children[0].convert()
-        single = child.num_partitions() == 1
+        single = _plan_partitions(child) == 1
         if single:
             return TpuHashAggregateExec(
                 p.group_exprs, p.agg_exprs, p.aggregates, child, p.schema,
@@ -1046,6 +1050,33 @@ def _estimate_rows(plan: L.LogicalPlan) -> int:
     return 1 << 62
 
 
+def _plan_partitions(node: TpuExec) -> int:
+    """Plan-time partition-count probe that NEVER materializes.
+
+    ``TpuAdaptiveJoinExec.num_partitions()`` triggers the runtime
+    broadcast-vs-shuffled decision (it materializes the build side) —
+    calling it during planning would cache an inner exec pointing at
+    PRE-rewrite children, which later passes (stage fusion) detach;
+    execution then crashes on the stale references.  Both runtime
+    choices of an adaptive join keep multiple partitions, so the probe
+    answers from static shape alone."""
+    from spark_rapids_tpu.plan.execs.join import TpuAdaptiveJoinExec
+    if isinstance(node, TpuAdaptiveJoinExec):
+        return max(_plan_partitions(node.children[0]),
+                   node.shuffle_partitions)
+    if node.children:
+        # structural nodes defer to children without side effects; any
+        # exec that OWNS its partitioning (exchange, range sort) answers
+        # num_partitions statically already
+        from spark_rapids_tpu.plan.execs.exchange import (
+            TpuCoalescedShuffleReaderExec)
+        if isinstance(node, TpuCoalescedShuffleReaderExec):
+            # reader.num_partitions() IS the AQE staging point — probing
+            # it would materialize the map side at plan time
+            return _plan_partitions(node.children[0])
+    return node.num_partitions()
+
+
 def _non_agg_leaf_refs(e: E.Expression) -> List[E.Expression]:
     """Column refs in agg output exprs that are outside aggregate calls."""
     if isinstance(e, A.AggregateFunction):
@@ -1080,11 +1111,35 @@ def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
         # the SPMD compiler instead (parallel/stage.py).
         from spark_rapids_tpu.plan.fused import fuse_segments
         exec_plan = fuse_segments(exec_plan, conf)
+    _reset_adaptive_decisions(exec_plan)
     # LORE id assignment + dump wrapping (GpuLore.tagForLore analog,
     # GpuOverrides.scala:5149)
     from spark_rapids_tpu.plan.execs.lore import apply_lore
     exec_plan = apply_lore(exec_plan, conf)
     return exec_plan, meta
+
+
+def _reset_adaptive_decisions(root: TpuExec) -> None:
+    """Safety net behind _plan_partitions: if ANYTHING triggered an
+    adaptive join's runtime decision during planning, the cached inner
+    exec references PRE-rewrite children (later passes detach fused chain
+    nodes) — discard it so execution re-decides over the final tree."""
+    from spark_rapids_tpu.plan.execs.join import TpuAdaptiveJoinExec
+    from spark_rapids_tpu.plan.fused import TpuFusedSegmentExec
+
+    def walk(n: TpuExec) -> None:
+        if isinstance(n, TpuAdaptiveJoinExec):
+            with n._lock:
+                if n._inner is not None:
+                    n._inner = None
+                    n.chosen = None
+        kids = list(n.children)
+        if isinstance(n, TpuFusedSegmentExec):
+            kids.extend(n.chain)
+        for c in kids:
+            walk(c)
+
+    walk(root)
 
 
 def _insert_aqe_readers(root: TpuExec, conf: RapidsConf) -> TpuExec:

@@ -41,6 +41,13 @@ class ShuffleTransport(abc.ABC):
     def write(self, pieces: Iterable[Tuple[int, ColumnarBatch]]) -> None:
         """Consume the map side's partition slices (called once)."""
 
+    def read_iter(self, partition: int):
+        """Streaming read: yield a partition's batches incrementally so
+        the consumer's coalesce window — not the whole partition — bounds
+        resident memory.  Default delegates to read(); flow-controlled
+        transports override with true incremental merge."""
+        yield from self.read(partition)
+
     @abc.abstractmethod
     def read(self, partition: int) -> List[ColumnarBatch]:
         """All pieces routed to `partition`, as device batches."""
@@ -172,6 +179,18 @@ def set_completeness_timeout(seconds: float) -> None:
     _completeness_timeout_s = float(seconds)
 
 
+#: receive-side flow-control window (spark.rapids.shuffle.fetch.*):
+#: (max in-flight bytes, fetch threads, streaming merge chunk bytes)
+_fetch_window = (64 << 20, 4, 32 << 20)
+
+
+def set_fetch_window(max_inflight_bytes: int, threads: int,
+                     merge_chunk_bytes: int) -> None:
+    global _fetch_window
+    _fetch_window = (int(max_inflight_bytes), int(threads),
+                     int(merge_chunk_bytes))
+
+
 def set_process_shuffle_executor(executor) -> None:
     """Install the process-wide shuffle node (cluster executor bootstrap:
     the node registered with the DRIVER's registry must be the one the
@@ -204,8 +223,12 @@ def make_transport(mode: str, num_partitions: int, schema: Schema,
             qid, ordinal = _cluster_shuffle_seq
             _cluster_shuffle_seq[1] += 1
             sid = (qid << 16) | ordinal
+        mi, ft, mc = _fetch_window
         return TcpShuffleTransport(process_shuffle_executor(),
                                    num_partitions, schema, codec,
+                                   max_inflight_bytes=mi,
+                                   fetch_threads=ft,
+                                   merge_chunk_bytes=mc,
                                    shuffle_id=sid,
                                    completeness_timeout_s=(
                                        _completeness_timeout_s),

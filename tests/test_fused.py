@@ -128,3 +128,56 @@ def test_fused_capacity_escalation_string_payload():
                 .order_by("name"))
     rows = assert_tpu_cpu_equal(build, ignore_order=False)
     assert rows
+
+
+def test_adaptive_join_over_fused_chain_replans_cleanly():
+    """Regression (r5 bench q25 crash): plan-time probes used to trigger
+    TpuAdaptiveJoinExec._decide BEFORE stage fusion, caching an inner
+    exec that referenced chain nodes fusion later detached — execution
+    then hit a childless join.  _plan_partitions + the post-pass reset
+    keep the decision at runtime, over the post-fusion tree."""
+    schema_f = Schema.of(a=T.INT, b=T.INT, v=T.DOUBLE)
+    schema_m = Schema.of(ma=T.INT, mb=T.INT, w=T.DOUBLE)
+    schema_d = Schema.of(dk=T.INT, tag=T.STRING)
+    n = 4000
+    rng = np.random.RandomState(5)
+    fact = ColumnarBatch.from_pydict(
+        {"a": (1 + rng.randint(0, 50, n)).tolist(),
+         "b": (1 + rng.randint(0, 40, n)).tolist(),
+         "v": np.round(rng.uniform(0, 9, n), 2).tolist()}, schema_f)
+    mid = ColumnarBatch.from_pydict(
+        {"ma": (1 + rng.randint(0, 50, 900)).tolist(),
+         "mb": (1 + rng.randint(0, 40, 900)).tolist(),
+         "w": np.round(rng.uniform(0, 9, 900), 2).tolist()}, schema_m)
+    dim = ColumnarBatch.from_pydict(
+        {"dk": list(range(1, 41)),
+         "tag": [f"t{i % 7}" for i in range(40)]}, schema_d)
+
+    def build(s):
+        f = s.create_dataframe([fact], num_partitions=2)
+        m = s.create_dataframe([mid], num_partitions=2)
+        d = s.create_dataframe([dim], num_partitions=1)
+        # bjoin (dim under threshold) BELOW an adaptive join (mid in the
+        # ambiguous zone), with a group-by above — the q25 shape
+        j = (f.join(d, on=([col("b")], [col("dk")]))
+             .join(m, on=([col("a"), col("b")], [col("ma"), col("mb")]))
+             .group_by("tag").agg(sum_("v").alias("sv"),
+                                  sum_("w").alias("sw"))
+             .order_by("tag"))
+        return j
+
+    import tests.test_queries as TQ
+
+    def build_conf(s):
+        return build(s)
+    # route through the tolerant comparator (float summation order
+    # differs between the fused two-phase agg and the row-order oracle)
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false",
+                      "spark.rapids.sql.join.broadcastRowThreshold": "500"})
+    tpu = TpuSession({"spark.rapids.sql.enabled": "true",
+                      "spark.rapids.sql.join.broadcastRowThreshold": "500"})
+    rows_c = build(cpu).collect()
+    rows_t = build(tpu).collect()
+    assert len(rows_t) == len(rows_c) and rows_t
+    for rt, rc in zip(rows_t, rows_c):
+        assert all(TQ._eq_val(a, b) for a, b in zip(rt, rc)), (rt, rc)

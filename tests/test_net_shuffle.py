@@ -235,3 +235,41 @@ def test_multiprocess_engine_shuffle_differential():
             p.join(timeout=10)
             p.terminate()
         driver.close()
+
+
+def test_streaming_read_iter_bounded_chunks():
+    """VERDICT r4 #7: the reduce read streams — wire blocks merge into
+    device batches every merge_chunk_bytes, so resident memory is bounded
+    by window + chunk, not the whole partition."""
+    from spark_rapids_tpu.shuffle.net import TcpShuffleTransport
+    from spark_rapids_tpu.shuffle.serializer import serialize_batch
+    ex = ShuffleExecutor(serve_registry=True)
+    try:
+        t = TcpShuffleTransport(ex, 1, SCHEMA, merge_chunk_bytes=1)
+        # 6 blocks, chunk budget of 1 byte -> one merged batch PER block
+        t.write((0, _batch(i * 10, i * 10 + 10)) for i in range(6))
+        seen = []
+        for out in t.read_iter(0):
+            seen.append(out.host_num_rows())
+        assert len(seen) == 6 and sum(seen) == 60
+        # generous chunk -> a single merged batch, same rows
+        t2 = TcpShuffleTransport(ex, 1, SCHEMA, merge_chunk_bytes=1 << 30,
+                                 shuffle_id=t.shuffle_id)
+        outs = t2.read(0)
+        assert len(outs) == 1 and outs[0].host_num_rows() == 60
+    finally:
+        ex.close()
+
+
+def test_fetch_window_conf_wiring():
+    """spark.rapids.shuffle.fetch.* flow through session init to the
+    transport factory."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.shuffle import transport as TR
+    TpuSession({"spark.rapids.sql.enabled": "true",
+                "spark.rapids.shuffle.fetch.maxInflightBytes": "12345",
+                "spark.rapids.shuffle.fetch.threads": "2",
+                "spark.rapids.shuffle.fetch.mergeChunkBytes": "777"})
+    assert TR._fetch_window == (12345, 2, 777)
+    # restore defaults for other tests
+    TR.set_fetch_window(64 << 20, 4, 32 << 20)

@@ -65,14 +65,23 @@ QUERIES = ("q6",) if SMOKE else ("q6", "q1", "q3", "q25", "q72")
 METRIC = ("tpch_q6_smoke_rows_per_sec" if SMOKE
           else "tpch_q6_q1_tpcds_q3_q25_q72_geomean_rows_per_sec")
 # Absolute per-query rows/s floors (VERDICT r3 weak #2: the oracle-ratio
-# alone is gameable — a slower oracle "improves" it).  Floors are the r2
-# CPU-backend numbers; a cpu-backend run below floor is a REGRESSION and
-# is reported loudly in the output line.
-CPU_FLOORS = {"q6": 28_969_059, "q1": 1_113_023, "q3": 483_248}
+# alone is gameable — a slower oracle "improves" it).  Re-pinned in r6
+# from the current container (BENCH_r06_cpu.json): its XLA CPU runs
+# ~5-7x slower than the machine that produced the r2 numbers (old q6
+# floor 28.9M vs 4.3M measured at equivalent code), so the old floors
+# flagged every run as a regression.  Floors sit ~0.9x the r6 measured
+# values; q25/q72 now covered (ADVICE r5 low #3) — q72's is provisional
+# (its CPU ORACLE exceeds the child timeout at default rows; raise it
+# from the first completed run).
+CPU_FLOORS = {"q6": 3_900_000, "q1": 180_000, "q3": 150_000,
+              "q25": 36_000, "q72": 1_000}
 # TPU floors pinned from the r4 on-chip numbers (VERDICT r4 weak #3):
 # q6 1.22M / q1 220k / q3 77k rows/s, floored at ~0.95x so single-chip
 # regressions are self-detecting.  Raise these as rounds improve.
-TPU_FLOORS = {"q6": 1_160_000, "q1": 205_000, "q3": 73_000}
+# q25/q72 are PLACEHOLDERS until an on-chip run records them (no TPU
+# number exists yet for either; see VERDICT r5 on the missing artifact).
+TPU_FLOORS = {"q6": 1_160_000, "q1": 205_000, "q3": 73_000,
+              "q25": 10_000, "q72": 1_000}
 
 
 # -- child side ---------------------------------------------------------------
@@ -200,6 +209,8 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
     from spark_rapids_tpu.api.session import TpuSession
     from spark_rapids_tpu.plan.execs.base import (
         launch_stats, reset_launch_stats)
+    from spark_rapids_tpu.cluster.stats import (
+        local_shuffle_counters, reset_local_shuffle_counters)
     run, input_bytes = _build_query(qname, n_rows)
     tpu_sess = TpuSession({"spark.rapids.sql.enabled": "true"})
     cpu_sess = TpuSession({"spark.rapids.sql.enabled": "false"})
@@ -207,10 +218,12 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
     tpu_rows = run(tpu_sess)        # warmup: compile + correctness
 
     reset_launch_stats()
+    reset_local_shuffle_counters()
     t0 = time.perf_counter()
     tpu_rows = run(tpu_sess)
     tpu_time = time.perf_counter() - t0
     stats = launch_stats()          # exact program-dispatch counts
+    shuffle = local_shuffle_counters()  # data-plane behavior per query
 
     util = None
     profile_dir = os.environ.get("SPARK_RAPIDS_TPU_BENCH_PROFILE")
@@ -240,6 +253,7 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
         "tpu_s": round(tpu_time, 4), "oracle_s": round(cpu_time, 4),
         "speedup": round(cpu_time / tpu_time, 3),
         "launches": stats["launches"], "programs": stats["programs"],
+        "shuffle": shuffle,
         "input_bytes": input_bytes,
         **({"util": util} if util else {}),
         **({"profile_dir": profile_dir} if profile_dir else {}),

@@ -32,22 +32,26 @@ import threading as _threading
 import jax._src.compiler as _jax_compiler
 
 if not getattr(_jax_compiler, "_srtpu_compile_lock_installed", False):
-    # RLock: _compile_and_write_cache calls backend_compile_and_load
-    # internally, and both are wrapped
+    # RLock: _compile_and_write_cache calls the backend compile entry
+    # internally, and both are wrapped.  The entry point is named
+    # backend_compile_and_load on new jax and backend_compile on 0.4.x —
+    # wrap whichever this image ships.
     _compile_lock = _threading.RLock()
-    _orig_backend_compile = _jax_compiler.backend_compile_and_load
-    _orig_compile_and_write = _jax_compiler._compile_and_write_cache
 
-    def _serialized_backend_compile(*args, **kwargs):
-        with _compile_lock:
-            return _orig_backend_compile(*args, **kwargs)
+    def _serialize(name):
+        orig = getattr(_jax_compiler, name, None)
+        if orig is None:
+            return
 
-    def _serialized_compile_and_write(*args, **kwargs):
-        with _compile_lock:
-            return _orig_compile_and_write(*args, **kwargs)
+        def wrapped(*args, _orig=orig, **kwargs):
+            with _compile_lock:
+                return _orig(*args, **kwargs)
 
-    _jax_compiler.backend_compile_and_load = _serialized_backend_compile
-    _jax_compiler._compile_and_write_cache = _serialized_compile_and_write
+        setattr(_jax_compiler, name, wrapped)
+
+    for _name in ("backend_compile_and_load", "backend_compile",
+                  "_compile_and_write_cache"):
+        _serialize(_name)
     _jax_compiler._srtpu_compile_lock_installed = True
 
 # Persistent XLA compilation cache — OPT-IN via

@@ -111,7 +111,8 @@ class TpuSession:
         set_completeness_timeout(self.conf.shuffle_completeness_timeout)
         set_fetch_window(self.conf.shuffle_fetch_max_inflight,
                          self.conf.shuffle_fetch_threads,
-                         self.conf.shuffle_fetch_merge_bytes)
+                         self.conf.shuffle_fetch_merge_bytes,
+                         self.conf.shuffle_fetch_request_bytes)
         if self.conf.diag_dump_dir:
             from spark_rapids_tpu.utils import crashdump
             crashdump.install(self.conf.diag_dump_dir,
@@ -463,6 +464,15 @@ class PivotedGroupedData:
                 name = (a.name if isinstance(a, Alias)
                         else output_name(a, 0))
 
+                def matched_count():
+                    # rows of the group matching this pivot value — the
+                    # per-value guard for zero-input aggregates AND the
+                    # any-row-matches indicator below
+                    from spark_rapids_tpu.expressions.aggregates import (
+                        Count)
+                    return Count(If(self.pivot_expr == Literal(pv),
+                                    Literal(True), Literal(None)))
+
                 def rewrite(e):
                     if isinstance(e, AggregateFunction):
                         if not e.children:
@@ -474,9 +484,7 @@ class PivotedGroupedData:
                                 import Count
                             assert isinstance(e, Count), \
                                 f"pivot cannot rewrite zero-input {e!r}"
-                            return Count(If(
-                                self.pivot_expr == Literal(pv),
-                                Literal(True), Literal(None)))
+                            return matched_count()
                         # untyped NULL literal: columns are unbound here,
                         # If takes its dtype from the then-branch
                         kids = tuple(
@@ -488,10 +496,31 @@ class PivotedGroupedData:
                         return e
                     return e.with_children(
                         tuple(rewrite(c) for c in e.children))
+
+                def null_when_absent(e):
+                    # Spark/PivotFirst semantics: a group×pivot-value
+                    # combination with NO matching rows is NULL, not 0 —
+                    # count-family rewrites alone would emit 0 (ADVICE r5
+                    # medium).  0 still appears when rows match but every
+                    # input is null.
+                    from spark_rapids_tpu.expressions.aggregates import (
+                        Count)
+                    has_count = [False]
+
+                    def walk(x):
+                        if isinstance(x, Count):
+                            has_count[0] = True
+                        for c in x.children:
+                            walk(c)
+                    walk(e)
+                    if not has_count[0]:
+                        return e    # sum/min/... are NULL-on-absent already
+                    return If(matched_count() > Literal(0), e,
+                              Literal(None))
                 col_name = (str(pv) if len(aggs) == 1
                             else f"{pv}_{name}")
-                out.append(Alias(rewrite(a.child if isinstance(a, Alias)
-                                         else a), col_name))
+                rewritten = rewrite(a.child if isinstance(a, Alias) else a)
+                out.append(Alias(null_when_absent(rewritten), col_name))
         return self.grouped.agg(*out)
 
 

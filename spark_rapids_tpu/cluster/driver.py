@@ -82,10 +82,21 @@ class TpuClusterDriver:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                try:
-                    header, payload = _recv_msg(self.request)
-                except ConnectionError:
-                    return
+                # persistent connections: executors RPC through the
+                # process-wide pooled socket (shuffle/net.py), so serve
+                # this connection until the peer hangs up
+                import struct as _struct
+                while True:
+                    try:
+                        header, payload = _recv_msg(self.request)
+                    except (ConnectionError, OSError, _struct.error):
+                        return
+                    try:
+                        self._dispatch(header, payload)
+                    except (ConnectionError, OSError):
+                        return
+
+            def _dispatch(self, header: dict, payload: bytes) -> None:
                 op = header.get("op")
                 if op == "exec_register":
                     # registration response IS the config broadcast

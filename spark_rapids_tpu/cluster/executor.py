@@ -159,7 +159,8 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
     set_completeness_timeout(conf.shuffle_completeness_timeout)
     set_fetch_window(conf.shuffle_fetch_max_inflight,
                      conf.shuffle_fetch_threads,
-                     conf.shuffle_fetch_merge_bytes)
+                     conf.shuffle_fetch_merge_bytes,
+                     conf.shuffle_fetch_request_bytes)
     logical = pickle.loads(plan_bytes)
     physical, _meta = plan_query(logical, conf)
     stats_client = None
@@ -303,11 +304,29 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
 
     last_hb = 0.0
     pending_cleanup = None
+    poll_failures = 0
     try:
         while not (stop_check and stop_check()):
-            header, payload = _request(
-                driver_rpc_addr, {"op": "get_task",
-                                  "executor_id": node.executor_id})
+            # NON-retriable: get_task destructively pops the task at the
+            # driver; a pooled-connection auto-retry after a response-
+            # phase failure could re-issue the pop and silently lose the
+            # task.  One consecutive failure is tolerated instead — a
+            # stale pooled socket (driver closed it idle) just costs one
+            # poll; the NEXT poll is a fresh request on a fresh connect,
+            # so at-most-once holds.  Two consecutive failures mean the
+            # driver is really gone: exit like the pre-pooling code did.
+            try:
+                header, payload = _request(
+                    driver_rpc_addr, {"op": "get_task",
+                                      "executor_id": node.executor_id},
+                    retriable=False)
+                poll_failures = 0
+            except (ConnectionError, OSError):
+                poll_failures += 1
+                if poll_failures >= 2:
+                    raise
+                time.sleep(poll_s)
+                continue
             task = header.get("task")
             if task is None:
                 now = time.monotonic()

@@ -19,8 +19,6 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
-from spark_rapids_tpu.shuffle.net import _recv_msg, _send_msg
-
 _active: Optional["ClusterStatsClient"] = None
 _lock = threading.Lock()
 
@@ -34,6 +32,20 @@ def set_cluster_stats(client: Optional["ClusterStatsClient"]) -> None:
 def cluster_stats() -> Optional["ClusterStatsClient"]:
     with _lock:
         return _active
+
+
+def local_shuffle_counters() -> dict:
+    """This rank's shuffle data-plane counters (shuffle/stats.py):
+    connections opened, fetch round-trips, blocks/bytes per round-trip,
+    prefetch stall time, merge/concat count.  Surfaced here so cluster
+    diagnostics and the bench artifact read one snapshot shape."""
+    from spark_rapids_tpu.shuffle.stats import shuffle_counters
+    return shuffle_counters()
+
+
+def reset_local_shuffle_counters() -> None:
+    from spark_rapids_tpu.shuffle.stats import reset_shuffle_counters
+    reset_shuffle_counters()
 
 
 class ClusterStatsClient:
@@ -56,11 +68,12 @@ class ClusterStatsClient:
         return f"{namespace}:{i}"
 
     def _request(self, header: dict) -> dict:
-        import socket
-        with socket.create_connection(self.rpc_addr, timeout=30.0) as sock:
-            _send_msg(sock, header)
-            h, _ = _recv_msg(sock)
-            return h
+        # pooled persistent connection (shuffle/net.py): the stats
+        # barrier polls fetch_global every 20ms — a cold connect per poll
+        # would hammer the driver with connection churn
+        from spark_rapids_tpu.shuffle.net import _request as pooled
+        h, _ = pooled(self.rpc_addr, header)
+        return h
 
     def publish(self, key: str, values: List[int]) -> None:
         self._request({"op": "stats_publish", "query_id": self.query_id,

@@ -244,9 +244,20 @@ SHUFFLE_FETCH_MAX_INFLIGHT = conf(
 
 SHUFFLE_FETCH_THREADS = conf(
     "spark.rapids.shuffle.fetch.threads").doc(
-    "Concurrent block-fetch connections per reduce read (the reference's "
-    "transport request pool)."
+    "Concurrent fetch round-trips per reduce read ACROSS peers: the "
+    "pipelined fetch runs one prefetch thread per peer, each serialized "
+    "on its pooled connection (per-peer parallelism comes from batching "
+    "many blocks per requestBytes round-trip, not parallel sockets); "
+    "this caps how many of those round-trips run at once."
 ).int_conf(4)
+
+SHUFFLE_FETCH_REQUEST_BYTES = conf(
+    "spark.rapids.shuffle.fetch.requestBytes").doc(
+    "Byte budget per fetch_many round-trip on the binary hot path: the "
+    "per-peer prefetcher batches this many bytes of blocks into ONE "
+    "request so small map-side slices amortize the network round-trip "
+    "(the reference's BufferSendState packs bounce buffers the same way)."
+).bytes_conf(4 << 20)
 
 SHUFFLE_FETCH_MERGE_BYTES = conf(
     "spark.rapids.shuffle.fetch.mergeChunkBytes").doc(
@@ -490,6 +501,10 @@ class RapidsConf:
     @property
     def shuffle_fetch_merge_bytes(self) -> int:
         return self.get(SHUFFLE_FETCH_MERGE_BYTES)
+
+    @property
+    def shuffle_fetch_request_bytes(self) -> int:
+        return self.get(SHUFFLE_FETCH_REQUEST_BYTES)
 
     @property
     def diag_dump_dir(self) -> str:

@@ -1261,6 +1261,12 @@ def flatten(e):
 
 
 def arrays_zip(*es, names=None):
+    from spark_rapids_tpu.expressions.core import Alias, BoundReference, Col
     from spark_rapids_tpu.expressions.core import col as _col
-    return ArraysZip([(_col(e) if isinstance(e, str) else e) for e in es],
-                     names=names)
+    exprs = [(_col(e) if isinstance(e, str) else e) for e in es]
+    if names is None:
+        # Spark names result struct fields after the input columns (or
+        # aliases); ordinals remain only for anonymous expressions
+        names = [e.name if isinstance(e, (Col, Alias, BoundReference))
+                 else str(i) for i, e in enumerate(exprs)]
+    return ArraysZip(exprs, names=names)

@@ -124,6 +124,13 @@ class IciQueryExecutor:
     def execute(self, root) -> List[ColumnarBatch]:
         """Run the plan; returns the result as a list of host-side batches."""
         from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.plan.fused import unfuse_segments
+
+        # per-batch segment fusion belongs to the task engine; this
+        # compiler inlines the whole query as one program, so fused
+        # wrappers rebuild to their raw chains first (the fusion pass is
+        # keyed to the executing backend, not the session shuffle mode)
+        root = unfuse_segments(root)
 
         def _nested_ok(dt) -> bool:
             # the exchange kernels redistribute arrays/maps by the same
@@ -284,7 +291,8 @@ class IciQueryExecutor:
                          for k in build.arg_kinds)
         fb_spec = {k: PS(self.axis) for k in build.feedback_keys}
 
-        sm = jax.shard_map(
+        from spark_rapids_tpu.utils.jax_compat import shard_map
+        sm = shard_map(
             device_program, mesh=self.mesh,
             in_specs=in_specs,
             out_specs=(PS(self.axis), fb_spec),

@@ -202,12 +202,16 @@ class TpuShuffleExchangeExec(TpuExec):
         unbounded concat.  Consumption is STREAMING (transport.read_iter):
         with the flow-controlled TCP plane at most fetch-window + merge-
         chunk + one coalesce group of memory is resident, never the whole
-        partition (VERDICT r4 #7)."""
+        partition (VERDICT r4 #7).  The transport receives this exec's
+        coalesce target so its merge flushes land ON the target — the
+        common case then yields single-batch groups below and the extra
+        concat_batches_jit pass never runs (concat-once)."""
         transport = self._materialize()
 
         def batches():
             with timed(self.op_time):
-                it = iter(transport.read_iter(idx))
+                it = iter(transport.read_iter(
+                    idx, target_rows=self.target_rows))
             while True:
                 with timed(self.op_time):
                     try:
@@ -231,6 +235,9 @@ class TpuShuffleExchangeExec(TpuExec):
                 else:
                     from spark_rapids_tpu.plan.execs.coalesce import (
                         concat_batches_jit)
+                    from spark_rapids_tpu.shuffle.stats import (
+                        SHUFFLE_COUNTERS)
+                    SHUFFLE_COUNTERS.add(reduce_concats=1)
                     cap = round_up_pow2(max(acc, 1))
                     out = concat_batches_jit(group, cap)
             self.output_rows.add(out.num_rows)

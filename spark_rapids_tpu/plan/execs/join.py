@@ -432,10 +432,21 @@ class TpuShuffledHashJoinExec(TpuExec):
         return self._kernel(left, right)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
-        left_batches = list(self.children[0].execute_partition(idx))
+        # build (right) side first: when it fits the batch target and the
+        # join type decomposes by probe rows, the probe side STREAMS —
+        # each fetched-and-merged chunk joins against the build while the
+        # shuffle prefetcher is pulling the next one (fetch/compute
+        # overlap on the reduce side; the reference streams the probe
+        # iterator the same way, GpuHashJoin.scala:1868)
         right_batches = list(self.children[1].execute_partition(idx))
-        total = (sum(b.capacity for b in left_batches)
-                 + sum(b.capacity for b in right_batches))
+        right_total = sum(b.capacity for b in right_batches)
+        if (self.left_key_idx
+                and self.join_type in self._LEFT_SPLITTABLE
+                and right_total <= self.target_rows):
+            yield from self._execute_streamed_probe(idx, right_batches)
+            return
+        left_batches = list(self.children[0].execute_partition(idx))
+        total = (sum(b.capacity for b in left_batches) + right_total)
         if (total > self.target_rows and self.join_type != "cross"
                 and self.left_key_idx):
             yield from self._execute_out_of_core(left_batches, right_batches,
@@ -451,6 +462,49 @@ class TpuShuffledHashJoinExec(TpuExec):
             return
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
+
+    def _execute_streamed_probe(self, idx: int,
+                                right_batches) -> Iterator[ColumnarBatch]:
+        """Probe-side streaming: group probe batches to the batch target
+        and join each group against the (small) build side as it arrives.
+        Correct exactly for _LEFT_SPLITTABLE types — every left row's
+        output depends only on the full right side — and doubles as the
+        skew guard: an oversized probe partition joins in bounded chunks
+        instead of one unbounded concat."""
+        from spark_rapids_tpu.plan.execs.coalesce import maybe_shrink
+        with timed(self.op_time):
+            build = coalesce_to_one(right_batches)
+        # an empty build side still DRAINS the probe child (no early
+        # return): in cluster mode the probe exchange's map-side write
+        # runs lazily under execute_partition, and other ranks' reduce
+        # reads await this rank's map_complete — skipping the drain on a
+        # locally-empty build would stall them until the completeness
+        # timeout.  _join_pair returns None per group for the
+        # no-output-possible types below.
+        group: List[ColumnarBatch] = []
+        acc = 0
+
+        def flush():
+            with timed(self.op_time):
+                out = self._join_pair(coalesce_to_one(group), build)
+                if out is not None:
+                    out = maybe_shrink(out)
+            return out
+
+        for b in self.children[0].execute_partition(idx):
+            if group and acc + b.capacity > self.target_rows:
+                out = flush()
+                group, acc = [], 0
+                if out is not None:
+                    self.output_rows.add(out.num_rows)
+                    yield self._count_out(out)
+            group.append(b)
+            acc += b.capacity
+        if group:
+            out = flush()
+            if out is not None:
+                self.output_rows.add(out.num_rows)
+                yield self._count_out(out)
 
     def _execute_out_of_core(self, left_batches, right_batches,
                              total) -> Iterator[ColumnarBatch]:

@@ -143,6 +143,22 @@ def fuse_segments(root: TpuExec, conf) -> TpuExec:
     ICI/SPMD sessions (parallel/stage.py fuses the whole query instead)."""
     from spark_rapids_tpu.plan.execs.join import TpuBroadcastHashJoinExec
 
+    from spark_rapids_tpu.plan.execs.exchange import (
+        TpuCoalescedShuffleReaderExec, TpuShuffleExchangeExec,
+        TpuSinglePartitionExec)
+    from spark_rapids_tpu.plan.execs.join import (
+        TpuAdaptiveJoinExec, TpuShuffledHashJoinExec)
+
+    # a stream child on the far side of a shuffle: fusing even a single
+    # op above it is worth a segment — the reduce side then runs ONE
+    # program per merged batch, giving the pipelined fetch actual device
+    # compute to overlap with (the VERDICT r5 "fusion stops at
+    # broadcast-join chains" gap; shuffled joins are first-class in the
+    # reference, GpuShuffledSizedHashJoinExec.scala)
+    _SHUFFLE_BOUNDARY = (TpuShuffleExchangeExec, TpuCoalescedShuffleReaderExec,
+                         TpuSinglePartitionExec, TpuShuffledHashJoinExec,
+                         TpuAdaptiveJoinExec)
+
     def visit(node: TpuExec) -> TpuExec:
         if _fusable(node):
             chain = [node]
@@ -152,11 +168,42 @@ def fuse_segments(root: TpuExec, conf) -> TpuExec:
                 chain.append(cur)
             n_joins = sum(isinstance(n, TpuBroadcastHashJoinExec)
                           for n in chain)
-            if n_joins >= 1 or len(chain) >= 2:
+            crosses_shuffle = bool(cur.children) and isinstance(
+                cur.children[0], _SHUFFLE_BOUNDARY)
+            if n_joins >= 1 or len(chain) >= 2 or crosses_shuffle:
                 stream_child = visit(cur.children[0])
                 builds = [visit(n.children[1]) for n in chain
                           if isinstance(n, TpuBroadcastHashJoinExec)]
                 return TpuFusedSegmentExec(chain, stream_child, builds)
+        node.children = tuple(visit(c) for c in node.children)
+        return node
+
+    return visit(root)
+
+
+def unfuse_segments(root: TpuExec) -> TpuExec:
+    """Inverse of fuse_segments: rebuild the raw exec chain from every
+    fused segment (re-attaching the children the segment detached).
+
+    The SPMD stage compiler lowers raw nodes itself — a whole-query XLA
+    program subsumes per-batch segment fusion — so plans headed for
+    IciQueryExecutor unfuse first instead of dying on UnsupportedSpmd
+    (the fusion pass is keyed to the executing backend, not the session
+    shuffle mode)."""
+    from spark_rapids_tpu.plan.execs.join import TpuBroadcastHashJoinExec
+
+    def visit(node: TpuExec) -> TpuExec:
+        if isinstance(node, TpuFusedSegmentExec):
+            cur = visit(node.children[0])
+            builds = [visit(b) for b in node.children[1:]]
+            for n in reversed(node.chain):       # bottom-up re-link
+                if isinstance(n, TpuBroadcastHashJoinExec):
+                    n.children = (cur,
+                                  builds[node._join_build_ix[id(n)]])
+                else:
+                    n.children = (cur,)
+                cur = n
+            return cur
         node.children = tuple(visit(c) for c in node.children)
         return node
 
@@ -223,8 +270,14 @@ class TpuFusedSegmentExec(TpuExec):
 
     def signature(self) -> str:
         if self._sig is None:
+            from spark_rapids_tpu.plan.execs.base import schema_cache_key
             parts = [_exec_signature_shallow(n) for n in self.chain]
-            self._sig = "fused[" + ">".join(parts) + "]"
+            # the STREAM schema must key the program too: chain-identical
+            # segments over different stream schemas read different
+            # string-ordinal feedback (the r5 fuzz cross-query cache
+            # pollution — a DATE column indexed as variable-width)
+            stream = schema_cache_key(self.children[0].schema)
+            self._sig = "fused[" + ">".join(parts) + f"|stream={stream}]"
         return self._sig
 
     def _all_exprs(self) -> List[Expression]:

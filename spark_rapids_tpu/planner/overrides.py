@@ -1060,20 +1060,37 @@ def _plan_partitions(node: TpuExec) -> int:
     execution then crashes on the stale references.  Both runtime
     choices of an adaptive join keep multiple partitions, so the probe
     answers from static shape alone."""
-    from spark_rapids_tpu.plan.execs.join import TpuAdaptiveJoinExec
+    from spark_rapids_tpu.plan.execs.base import TpuExec as _Base
+    from spark_rapids_tpu.plan.execs.basic import TpuUnionExec
+    from spark_rapids_tpu.plan.execs.exchange import (
+        TpuCoalescedShuffleReaderExec)
+    from spark_rapids_tpu.plan.execs.join import (
+        TpuAdaptiveJoinExec, TpuBroadcastHashJoinExec,
+        TpuShuffledHashJoinExec)
+    from spark_rapids_tpu.plan.execs.lore import TpuLoreDumpExec
+    from spark_rapids_tpu.plan.fused import TpuFusedSegmentExec
     if isinstance(node, TpuAdaptiveJoinExec):
         return max(_plan_partitions(node.children[0]),
                    node.shuffle_partitions)
-    if node.children:
-        # structural nodes defer to children without side effects; any
-        # exec that OWNS its partitioning (exchange, range sort) answers
-        # num_partitions statically already
-        from spark_rapids_tpu.plan.execs.exchange import (
-            TpuCoalescedShuffleReaderExec)
-        if isinstance(node, TpuCoalescedShuffleReaderExec):
-            # reader.num_partitions() IS the AQE staging point — probing
-            # it would materialize the map side at plan time
-            return _plan_partitions(node.children[0])
+    if isinstance(node, TpuUnionExec):
+        return sum(_plan_partitions(c) for c in node.children)
+    if isinstance(node, (TpuCoalescedShuffleReaderExec,
+                         TpuShuffledHashJoinExec, TpuBroadcastHashJoinExec,
+                         TpuFusedSegmentExec, TpuLoreDumpExec)):
+        # partition-DELEGATING nodes: reader.num_partitions() IS the AQE
+        # staging point (materializes the map side), and the joins/fused
+        # wrappers just forward to children[0] — recurse ourselves so an
+        # adaptive join anywhere below never sees num_partitions() at
+        # plan time
+        return _plan_partitions(node.children[0])
+    if node.children and type(node).num_partitions is _Base.num_partitions:
+        # structural nodes (project/filter/sort/...) inherit the base
+        # delegation; recurse for the same reason — a select() between an
+        # adaptive join and its consumer must not trigger the runtime
+        # decision during planning (ADVICE r5 low #2)
+        return _plan_partitions(node.children[0])
+    # any exec that OWNS its partitioning (exchange, range sort, scans)
+    # answers num_partitions statically
     return node.num_partitions()
 
 
@@ -1107,8 +1124,12 @@ def plan_query(plan: L.LogicalPlan, conf: Optional[RapidsConf] = None
     exec_plan = _insert_aqe_readers(exec_plan, conf)
     if conf.fuse_stages and conf.shuffle_mode != "ICI":
         # stage-segment fusion (plan/fused.py): one XLA program per batch
-        # per exchange-free chain.  ICI sessions fuse the whole query in
-        # the SPMD compiler instead (parallel/stage.py).
+        # per fusable chain (including single ops across a shuffle
+        # boundary).  Fusion is a TASK-ENGINE shape: IciQueryExecutor
+        # unfuses any segment it receives (the backend, not the session
+        # shuffle mode, decides — a non-ICI-session plan handed to the
+        # SPMD compiler must still compile, VERDICT r5 #1a), and ICI
+        # sessions fuse the whole query in the SPMD compiler instead.
         from spark_rapids_tpu.plan.fused import fuse_segments
         exec_plan = fuse_segments(exec_plan, conf)
     _reset_adaptive_decisions(exec_plan)
@@ -1131,8 +1152,22 @@ def _reset_adaptive_decisions(root: TpuExec) -> None:
         if isinstance(n, TpuAdaptiveJoinExec):
             with n._lock:
                 if n._inner is not None:
+                    # release what the premature decision retained (a
+                    # shuffled choice holds live shuffle transports, a
+                    # broadcast choice the materialized build) before
+                    # dropping the reference — execution re-decides over
+                    # the final tree
+                    n._inner.cleanup()
                     n._inner = None
                     n.chosen = None
+                t = getattr(n, "_cluster_build_transport", None)
+                if t is not None:
+                    # a premature DISTRIBUTED broadcast decision also
+                    # created the one-partition build-union shuffle;
+                    # re-deciding would overwrite the reference and leak
+                    # its blocks for the process lifetime
+                    t.cleanup()
+                    n._cluster_build_transport = None
         kids = list(n.children)
         if isinstance(n, TpuFusedSegmentExec):
             kids.extend(n.chain)

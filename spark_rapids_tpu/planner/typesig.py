@@ -346,9 +346,14 @@ def _build_registry() -> None:
                                  "into array<struct<key,value>>"))
     register(Flatten, ExprSig(ARR, ARR,
                               note="array<array<T>> offsets composition"))
+    # variadic: the single ARR param cycles over every child (the
+    # Coalesce/ConcatStrings idiom — params repeat the last entry)
     register(ArraysZip, ExprSig(ARR, ARR,
-                                note="zip to the longest input; shorter "
-                                "inputs contribute null fields"))
+                                note="variadic; zip to the longest input; "
+                                "shorter inputs contribute null fields; "
+                                "result struct fields named after input "
+                                "columns/aliases (ordinals for anonymous "
+                                "expressions)"))
     register(A.Percentile, ExprSig(TypeSig("double") + ARR, NUMERIC,
                                    INTEGRAL,
                                    note="exact percentile via sorted "

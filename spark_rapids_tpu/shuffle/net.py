@@ -17,10 +17,15 @@ Reference architecture reproduced (over DCN sockets instead of UCX/RDMA):
   * TcpShuffleTransport   — the ShuffleTransport SPI impl gluing these
                             under the exchange exec (mode=MULTIPROCESS)
 
-Wire protocol: 4-byte big-endian header length, JSON header, optional raw
-payload (length in the header).  Requests: register, heartbeat, list_blocks,
-fetch.  One socket per request keeps the server loop trivial; peers are
-expected to batch via list_blocks + pipelined fetches.
+Wire protocol: control messages are 4-byte big-endian header length +
+JSON header + optional raw payload (length in the header); the hot fetch
+path uses BINARY fixed-width framing (``fetch_many``: one round-trip
+streams many blocks) so the JSON encode/decode cost is paid only on
+control messages (register, heartbeat, list_blocks, shuffle membership).
+Connections are PERSISTENT: one pooled socket per peer, reused across
+requests and shuffles, with reconnect-on-error — the reference keeps UCX
+endpoints warm the same way; cold connects per request were the dominant
+reduce-side cost of the v1 plane.
 """
 from __future__ import annotations
 
@@ -34,6 +39,7 @@ import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
 
 
 # -- framing ------------------------------------------------------------------
@@ -63,12 +69,156 @@ def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
     return header, payload
 
 
-def _request(addr: Tuple[str, int], header: dict,
-             payload: bytes = b"", timeout: float = 30.0
-             ) -> Tuple[dict, bytes]:
-    with socket.create_connection(addr, timeout=timeout) as sock:
-        _send_msg(sock, header, payload)
-        return _recv_msg(sock)
+# Binary fetch framing.  The leading word distinguishes a binary request
+# from a JSON header length: real JSON headers are small, so a word with
+# the top bit set can never be a header length.
+#   request:  >I BIN_FETCH | >Q shuffle_id | >I partition | >I nblocks
+#             | nblocks * >I block index
+#   response: >I nblocks | per block (>Q length, raw bytes)
+BIN_FETCH = 0xFFFF_FE7C
+_BIN_REQ_FIXED = struct.Struct(">QII")
+
+
+def _send_fetch_many(sock: socket.socket, shuffle_id: int, partition: int,
+                     blocks: List[int]) -> None:
+    sock.sendall(struct.pack(">I", BIN_FETCH)
+                 + _BIN_REQ_FIXED.pack(shuffle_id, partition, len(blocks))
+                 + struct.pack(f">{len(blocks)}I", *blocks))
+
+
+def _recv_fetch_many(sock: socket.socket) -> List[bytes]:
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    out = []
+    for _ in range(n):
+        (ln,) = struct.unpack(">Q", _recv_exact(sock, 8))
+        out.append(_recv_exact(sock, ln))
+    return out
+
+
+# -- persistent per-peer connections ------------------------------------------
+
+class PooledConnection:
+    """One long-lived socket to a peer, serialized by a lock and reused
+    across requests and shuffles.  On any transport error the socket is
+    dropped and the request retried once on a fresh connect (the server
+    may have restarted, or an idle connection may have been reaped)."""
+
+    def __init__(self, addr: Tuple[str, int], timeout: float = 60.0):
+        self.addr = tuple(addr)
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        self._sock = socket.create_connection(self.addr,
+                                              timeout=self.timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        SHUFFLE_COUNTERS.add(connections_opened=1)
+        return self._sock
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _roundtrip(self, send, recv, retriable: bool = True):
+        """``retriable=False`` for NON-IDEMPOTENT ops (e.g. the driver's
+        destructive get_task pop): a retry after a response-phase failure
+        would re-execute a request the server may already have processed,
+        silently losing its effect.  The socket is dropped either way, so
+        the CALLER's next (distinct) request reconnects cleanly — callers
+        of non-retriable ops decide themselves whether a single failure
+        is tolerable (executor_main tolerates one stale-socket poll)."""
+        with self._lock:
+            for attempt in ((0, 1) if retriable else (1,)):
+                try:
+                    sock = self._sock or self._connect()
+                    send(sock)
+                    return recv(sock)
+                except (ConnectionError, OSError, struct.error,
+                        socket.timeout):
+                    self._drop()
+                    if attempt:
+                        raise
+            raise AssertionError("unreachable")
+
+    def request(self, header: dict, payload: bytes = b"",
+                retriable: bool = True) -> Tuple[dict, bytes]:
+        return self._roundtrip(
+            lambda s: _send_msg(s, header, payload), _recv_msg,
+            retriable=retriable)
+
+    def fetch_many(self, shuffle_id: int, partition: int,
+                   blocks: List[int]) -> List[bytes]:
+        """Binary hot path: many blocks per round-trip, no JSON.
+        Idempotent, so safe to retry on a fresh connection."""
+        out = self._roundtrip(
+            lambda s: _send_fetch_many(s, shuffle_id, partition, blocks),
+            _recv_fetch_many)
+        if len(out) != len(blocks):
+            # the server drops unknown indices rather than erroring; a
+            # short response means the peer lost map output (e.g. a
+            # restart the reconnect path papered over) — fail LOUDLY,
+            # silently-partial reduce data is the one unacceptable outcome
+            raise KeyError(
+                f"peer {self.addr} returned {len(out)}/{len(blocks)} "
+                f"blocks for shuffle {shuffle_id} partition {partition} "
+                "(map output lost?)")
+        SHUFFLE_COUNTERS.add(fetch_requests=1, blocks_fetched=len(out),
+                             bytes_fetched=sum(len(b) for b in out))
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+
+class ConnectionPool:
+    """addr -> PooledConnection, process-wide (connections survive
+    individual transports AND shuffles; RapidsShuffleTransport keeps its
+    UCX endpoint cache the same way)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conns: Dict[Tuple[str, int], PooledConnection] = {}
+
+    def get(self, addr: Tuple[str, int]) -> PooledConnection:
+        addr = tuple(addr)
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is None:
+                conn = self._conns[addr] = PooledConnection(addr)
+            return conn
+
+    def connection_count(self, addr: Tuple[str, int]) -> int:
+        """Live pooled connections for addr (0 or 1 by construction)."""
+        with self._lock:
+            conn = self._conns.get(tuple(addr))
+        return int(conn is not None and conn._sock is not None)
+
+    def close_all(self) -> None:
+        with self._lock:
+            conns, self._conns = list(self._conns.values()), {}
+        for c in conns:
+            c.close()
+
+
+_POOL = ConnectionPool()
+
+
+def connection_pool() -> ConnectionPool:
+    return _POOL
+
+
+def _request(addr: Tuple[str, int], header: dict, payload: bytes = b"",
+             retriable: bool = True) -> Tuple[dict, bytes]:
+    """Control-message RPC over the pooled persistent connection (its
+    fixed timeout applies; a per-call timeout would need its own
+    socket and defeat the pooling)."""
+    return _POOL.get(addr).request(header, payload, retriable=retriable)
 
 
 # -- block store + server -----------------------------------------------------
@@ -199,21 +349,46 @@ class ShuffleBlockServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # persistent connection: serve requests until the peer
+                # hangs up (the pooled-client contract; one socket per
+                # peer, reused across requests and shuffles)
+                while True:
+                    try:
+                        if not self._serve_one():
+                            return
+                    except (ConnectionError, OSError, struct.error):
+                        return
+
+            def _serve_one(self) -> bool:
                 try:
-                    header, _ = _recv_msg(self.request)
-                except (ConnectionError, struct.error):
-                    return
+                    first = _recv_exact(self.request, 4)
+                except ConnectionError:
+                    return False
+                (word,) = struct.unpack(">I", first)
+                if word == BIN_FETCH:
+                    sid, part, n = _BIN_REQ_FIXED.unpack(
+                        _recv_exact(self.request, _BIN_REQ_FIXED.size))
+                    idxs = struct.unpack(f">{n}I",
+                                         _recv_exact(self.request, 4 * n))
+                    blocks = outer.store.get(sid, part)
+                    picked = [blocks[i] for i in idxs if i < len(blocks)]
+                    parts = [struct.pack(">I", len(picked))]
+                    for b in picked:
+                        parts.append(struct.pack(">Q", len(b)))
+                        parts.append(b)
+                    self.request.sendall(b"".join(parts))
+                    return True
+                header = json.loads(
+                    _recv_exact(self.request, word).decode("utf-8"))
+                _recv_exact(self.request, header.get("payload_len", 0))
+                self._dispatch(header)
+                return True
+
+            def _dispatch(self, header: dict) -> None:
+                # block fetches ride the binary framing exclusively
+                # (_serve_one's BIN_FETCH path); no JSON fetch op exists
                 op = header.get("op")
-                if op == "fetch":
-                    blocks = outer.store.get(header["shuffle_id"],
-                                             header["partition"])
-                    idx = header.get("block")
-                    if idx is not None:
-                        blocks = blocks[idx:idx + 1]
-                    _send_msg(self.request, {"n": len(blocks)})
-                    for b in blocks:
-                        _send_msg(self.request, {}, b)
-                elif op == "list_blocks":
+                if op == "list_blocks":
                     sid = header["shuffle_id"]
                     sizes = outer.store.sizes(sid, header["partition"])
                     _send_msg(self.request, {
@@ -270,10 +445,15 @@ class ShuffleBlockServer:
 # -- client side --------------------------------------------------------------
 
 class PeerClient:
-    """RPCs against one peer's block server."""
+    """RPCs against one peer's block server (over the pooled, persistent
+    per-peer connection)."""
 
     def __init__(self, addr: Tuple[str, int]):
         self.addr = tuple(addr)
+
+    @property
+    def conn(self) -> PooledConnection:
+        return _POOL.get(self.addr)
 
     def list_blocks(self, shuffle_id: int, partition: int,
                     require_complete: bool = False) -> List[int]:
@@ -290,17 +470,15 @@ class PeerClient:
         h, _ = _request(self.addr, {"op": "new_shuffle"})
         return h["shuffle_id"]
 
+    def fetch_many(self, shuffle_id: int, partition: int,
+                   blocks: List[int]) -> List[bytes]:
+        """Binary hot path: all requested blocks in one round-trip."""
+        return self.conn.fetch_many(shuffle_id, partition, list(blocks))
+
     def fetch_block(self, shuffle_id: int, partition: int,
                     block: int) -> bytes:
-        with socket.create_connection(self.addr, timeout=60.0) as sock:
-            _send_msg(sock, {"op": "fetch", "shuffle_id": shuffle_id,
-                             "partition": partition, "block": block})
-            head, _ = _recv_msg(sock)
-            if head.get("n", 0) < 1:
-                raise KeyError(
-                    f"block {(shuffle_id, partition, block)} missing")
-            _, payload = _recv_msg(sock)
-            return payload
+        # fetch_many raises KeyError itself when the block is missing
+        return self.fetch_many(shuffle_id, partition, [block])[0]
 
     def register(self, executor_id: str, host: str, port: int,
                  role: str = "worker") -> None:
@@ -336,46 +514,119 @@ class BlockFetchIterator:
     in-flight byte budget (the reference's receive-side throttle:
     RapidsShuffleIterator + BufferReceiveState bounce buffers).
 
-    Enumerates (peer, block sizes) first, then keeps at most
-    `max_inflight_bytes` of requested-but-unconsumed data outstanding on a
-    small fetch pool; yields raw wire blocks in arrival order."""
+    PIPELINED: one background prefetch thread per peer streams that peer's
+    blocks through ``fetch_many`` (multiple blocks per round-trip, up to
+    ``request_bytes`` each), filling a shared queue bounded by
+    ``max_inflight_bytes`` of fetched-but-unconsumed data.  The consumer
+    pops in arrival order, so network fetch runs CONCURRENTLY with
+    whatever device compute the consumer interleaves — the fetch/compute
+    overlap the reference gets from BufferReceiveState's async transfers.
+    Consumer wait time on an empty queue is recorded as prefetch stall."""
 
     def __init__(self, peers: List[PeerClient], shuffle_id: int,
                  partition: int, max_inflight_bytes: int = 64 << 20,
-                 fetch_threads: int = 4):
+                 fetch_threads: int = 4, request_bytes: int = 4 << 20):
         self.peers = peers
         self.shuffle_id = shuffle_id
         self.partition = partition
-        self.max_inflight = max_inflight_bytes
-        self.fetch_threads = fetch_threads
+        self.max_inflight = max(int(max_inflight_bytes), 1)
+        #: cap on CONCURRENT fetch round-trips across peers (one prefetch
+        #: thread per peer, but at most this many in a request at once)
+        self.fetch_threads = max(int(fetch_threads), 1)
+        self.request_bytes = max(int(request_bytes), 1)
 
     def __iter__(self):
-        from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
-        work: List[Tuple[PeerClient, int, int]] = []
-        for peer in self.peers:
-            for bi, size in enumerate(
-                    peer.list_blocks(self.shuffle_id, self.partition)):
-                work.append((peer, bi, size))
-        if not work:
+        import collections
+        sizes = {peer: peer.list_blocks(self.shuffle_id, self.partition)
+                 for peer in self.peers}
+        if not any(sizes.values()):
             return
-        with ThreadPoolExecutor(max_workers=self.fetch_threads) as pool:
-            pending = {}
-            inflight = 0
-            qi = 0
-            while qi < len(work) or pending:
-                while qi < len(work) and (
-                        inflight + work[qi][2] <= self.max_inflight
-                        or not pending):
-                    peer, bi, size = work[qi]
-                    fut = pool.submit(peer.fetch_block, self.shuffle_id,
-                                      self.partition, bi)
-                    pending[fut] = size
-                    inflight += size
-                    qi += 1
-                done, _ = wait(list(pending), return_when=FIRST_COMPLETED)
-                for fut in done:
-                    inflight -= pending.pop(fut)
-                    yield fut.result()
+        cv = threading.Condition()
+        queue: "collections.deque[bytes]" = collections.deque()
+        state = {"inflight": 0, "live_workers": 0, "error": None,
+                 "stopped": False}
+
+        # a round-trip's batch may not exceed the flow-control window —
+        # otherwise one fetch_many could hold more than max_inflight bytes
+        batch_budget = min(self.request_bytes, self.max_inflight)
+        # spark.rapids.shuffle.fetch.threads: bound on concurrent
+        # round-trips (acquired per request, so a stalled peer holds at
+        # most one slot)
+        request_slots = threading.BoundedSemaphore(self.fetch_threads)
+
+        def worker(peer: PeerClient, block_sizes: List[int]) -> None:
+            try:
+                i = 0
+                while i < len(block_sizes):
+                    # batch blocks into one round-trip up to the budget
+                    take, batch_bytes = [i], block_sizes[i]
+                    i += 1
+                    while (i < len(block_sizes)
+                           and batch_bytes + block_sizes[i]
+                           <= batch_budget):
+                        take.append(i)
+                        batch_bytes += block_sizes[i]
+                        i += 1
+                    with cv:
+                        # window: wait for room; an oversized batch may
+                        # proceed alone so progress is always possible
+                        while (state["inflight"] > 0
+                               and state["inflight"] + batch_bytes
+                               > self.max_inflight
+                               and not state["stopped"]):
+                            cv.wait()
+                        if state["stopped"]:
+                            return
+                        state["inflight"] += batch_bytes
+                    with request_slots:
+                        got = peer.fetch_many(self.shuffle_id,
+                                              self.partition, take)
+                    with cv:
+                        queue.extend(got)
+                        cv.notify_all()
+            except BaseException as e:  # noqa: BLE001 — surfaced to consumer
+                with cv:
+                    if state["error"] is None:
+                        state["error"] = e
+                    cv.notify_all()
+            finally:
+                with cv:
+                    state["live_workers"] -= 1
+                    cv.notify_all()
+
+        threads = []
+        with cv:
+            for peer, bs in sizes.items():
+                if not bs:
+                    continue
+                state["live_workers"] += 1
+                t = threading.Thread(target=worker, args=(peer, bs),
+                                     daemon=True)
+                threads.append(t)
+        for t in threads:
+            t.start()
+        try:
+            while True:
+                with cv:
+                    t0 = time.perf_counter_ns()
+                    while (not queue and state["live_workers"] > 0
+                           and state["error"] is None):
+                        cv.wait()
+                    SHUFFLE_COUNTERS.add(
+                        prefetch_stall_ns=time.perf_counter_ns() - t0)
+                    if state["error"] is not None:
+                        raise state["error"]
+                    if not queue:
+                        return      # all workers drained
+                    block = queue.popleft()
+                    state["inflight"] -= len(block)
+                    cv.notify_all()
+                yield block         # outside the lock: consumer compute
+                                    # overlaps the workers' next fetches
+        finally:
+            with cv:
+                state["stopped"] = True
+                cv.notify_all()
 
 
 # -- SPI implementation -------------------------------------------------------
@@ -394,7 +645,8 @@ class TcpShuffleTransport:
                  merge_chunk_bytes: int = 32 << 20,
                  shuffle_id: Optional[int] = None,
                  completeness_timeout_s: float = 120.0,
-                 participants=None):
+                 participants=None,
+                 request_bytes: int = 4 << 20):
         self.shuffle_id = (shuffle_id if shuffle_id is not None
                            else executor.new_shuffle_id())
         self.executor = executor
@@ -404,6 +656,7 @@ class TcpShuffleTransport:
         self.max_inflight = max_inflight_bytes
         self.fetch_threads = fetch_threads
         self.merge_chunk_bytes = max(int(merge_chunk_bytes), 1)
+        self.request_bytes = max(int(request_bytes), 1)
         self.completeness_timeout_s = completeness_timeout_s
         # declare map-side participation up front: readers only await
         # completeness from executors that actually participate in this
@@ -458,15 +711,22 @@ class TcpShuffleTransport:
             remote.append(peer)
         return remote
 
-    def read_iter(self, partition: int):
-        """STREAMING reduce read (VERDICT r4 #7): own blocks
+    def read_iter(self, partition: int, target_rows: Optional[int] = None):
+        """STREAMING reduce read with CONCAT-ONCE merge: own blocks
         short-circuit through the in-process store; remote blocks arrive
-        through the flow-controlled window (bounded in-flight bytes) and
-        are merged to device batches every `merge_chunk_bytes` of wire
-        data, releasing the wire buffers — resident memory is bounded by
-        window + chunk regardless of partition fan-in.  Reference:
+        through the pipelined per-peer prefetch (bounded in-flight bytes)
+        and accumulate as RAW wire buffers until a flush boundary, then
+        materialize with a SINGLE merge_batches call — one HBM upload and
+        one canonicalize per reduce partition in the common case, instead
+        of a per-fetch merge+concat chain.  Flush boundaries: every
+        `merge_chunk_bytes` of wire data (the VERDICT r4 #7 memory bound:
+        resident memory stays window + chunk at any fan-in), and — when
+        the wire headers are readable — every `target_rows` rows, so
+        merged batches land on the consumer's coalesce target and the
+        exchange exec never re-concats them.  Reference:
         BufferSendState.scala / WindowedBlockIterator.scala."""
-        from spark_rapids_tpu.shuffle.serializer import merge_batches
+        from spark_rapids_tpu.shuffle.serializer import (
+            merge_batches, wire_row_count)
         remote = self._await_and_resolve_peers()
 
         def wire_blocks():
@@ -474,16 +734,23 @@ class TcpShuffleTransport:
             if remote:
                 yield from BlockFetchIterator(
                     remote, self.shuffle_id, partition, self.max_inflight,
-                    fetch_threads=self.fetch_threads)
+                    fetch_threads=self.fetch_threads,
+                    request_bytes=self.request_bytes)
 
         chunk: List[bytes] = []
         acc = 0
+        rows = 0                 # None once a block's row count is opaque
         for raw in wire_blocks():
             chunk.append(raw)
             acc += len(raw)
-            if acc >= self.merge_chunk_bytes:
+            if rows is not None and target_rows:
+                rc = wire_row_count(raw)
+                rows = None if rc is None else rows + rc
+            if acc >= self.merge_chunk_bytes or (
+                    target_rows and rows is not None
+                    and rows >= target_rows):
                 out = merge_batches(chunk, self.schema)
-                chunk, acc = [], 0
+                chunk, acc, rows = [], 0, 0
                 if out is not None:
                     yield out
         if chunk:

@@ -99,11 +99,22 @@ def serialize_batch(batch: ColumnarBatch, codec: str = "none") -> bytes:
     return _compress(payload, codec)
 
 
+def wire_row_count(block: bytes) -> Optional[int]:
+    """Row count of one wire block WITHOUT decompressing (None when a
+    codec hides the header).  Lets the reduce read align merge flushes to
+    the consumer's row target at zero parse cost."""
+    if block[:1] != b"N" or len(block) < 17:
+        return None
+    return struct.unpack("<Q", block[9:17])[0]
+
+
 def merge_batches(buffers: List[bytes], schema: Schema) -> Optional[ColumnarBatch]:
     """Concat-merge wire buffers into one device batch."""
     import jax.numpy as jnp
     if not buffers:
         return None
+    from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+    SHUFFLE_COUNTERS.add(merges=1, merge_input_blocks=len(buffers))
     if _has_nested(schema):
         return _py_merge_nested([_decompress(b) for b in buffers], schema)
     raw = [_decompress(b) for b in buffers]

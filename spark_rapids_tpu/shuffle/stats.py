@@ -1,0 +1,64 @@
+"""Process-wide shuffle data-plane counters.
+
+The reduce-side fast path (pooled connections, pipelined fetch_many,
+concat-once merge) is a perf claim: these counters make it checkable —
+in tests (connection reuse, one merge per reduce partition), in the
+cluster stats snapshot (cluster/stats.py) and in the bench artifact
+(bench.py emits them per query).  The reference keeps the same numbers
+as shuffle-manager metrics (RapidsShuffleInternalManagerBase metrics /
+UCX transport counters).
+
+Counting is lock-guarded: fetch threads, writer pools and reduce tasks
+all touch these concurrently and ``+=`` is not atomic bytecode.
+"""
+from __future__ import annotations
+
+import threading
+
+_FIELDS = (
+    # transport
+    "connections_opened",     # TCP connects (reuse keeps this ~1/peer)
+    "fetch_requests",         # fetch round-trips (fetch_many = 1)
+    "blocks_fetched",         # wire blocks received over the network
+    "bytes_fetched",          # wire bytes received over the network
+    # overlap
+    "prefetch_stall_ns",      # consumer blocked on an empty prefetch queue
+    # merge
+    "merges",                 # merge_batches materializations (HBM uploads)
+    "merge_input_blocks",     # wire blocks consumed by those merges
+    "reduce_concats",         # exchange-side concat passes over already-
+                              # merged batches (0 when concat-once holds)
+)
+
+
+class ShuffleCounters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        for f in _FIELDS:
+            setattr(self, f, 0)
+
+    def add(self, **deltas: int) -> None:
+        with self._lock:
+            for k, v in deltas.items():
+                setattr(self, k, getattr(self, k) + int(v))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {f: getattr(self, f) for f in _FIELDS}
+
+    def reset(self) -> None:
+        with self._lock:
+            for f in _FIELDS:
+                setattr(self, f, 0)
+
+
+SHUFFLE_COUNTERS = ShuffleCounters()
+
+
+def shuffle_counters() -> dict:
+    """Snapshot of the process-wide counters (bench/test accessor)."""
+    return SHUFFLE_COUNTERS.snapshot()
+
+
+def reset_shuffle_counters() -> None:
+    SHUFFLE_COUNTERS.reset()

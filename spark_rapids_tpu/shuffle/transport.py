@@ -41,11 +41,14 @@ class ShuffleTransport(abc.ABC):
     def write(self, pieces: Iterable[Tuple[int, ColumnarBatch]]) -> None:
         """Consume the map side's partition slices (called once)."""
 
-    def read_iter(self, partition: int):
+    def read_iter(self, partition: int, target_rows: Optional[int] = None):
         """Streaming read: yield a partition's batches incrementally so
         the consumer's coalesce window — not the whole partition — bounds
-        resident memory.  Default delegates to read(); flow-controlled
-        transports override with true incremental merge."""
+        resident memory.  ``target_rows`` is the consumer's coalesce
+        target: a transport that merges wire blocks aligns its flush
+        boundaries to it so the consumer never re-concats (concat-once).
+        Default delegates to read(); flow-controlled transports override
+        with true incremental merge."""
         yield from self.read(partition)
 
     @abc.abstractmethod
@@ -183,12 +186,19 @@ def set_completeness_timeout(seconds: float) -> None:
 #: (max in-flight bytes, fetch threads, streaming merge chunk bytes)
 _fetch_window = (64 << 20, 4, 32 << 20)
 
+#: byte budget per fetch_many round-trip (spark.rapids.shuffle.fetch
+#: .requestBytes): how many blocks the prefetcher batches per request
+_fetch_request_bytes = 4 << 20
+
 
 def set_fetch_window(max_inflight_bytes: int, threads: int,
-                     merge_chunk_bytes: int) -> None:
-    global _fetch_window
+                     merge_chunk_bytes: int,
+                     request_bytes: Optional[int] = None) -> None:
+    global _fetch_window, _fetch_request_bytes
     _fetch_window = (int(max_inflight_bytes), int(threads),
                      int(merge_chunk_bytes))
+    if request_bytes is not None:
+        _fetch_request_bytes = int(request_bytes)
 
 
 def set_process_shuffle_executor(executor) -> None:
@@ -232,5 +242,6 @@ def make_transport(mode: str, num_partitions: int, schema: Schema,
                                    shuffle_id=sid,
                                    completeness_timeout_s=(
                                        _completeness_timeout_s),
-                                   participants=_cluster_participants)
+                                   participants=_cluster_participants,
+                                   request_bytes=_fetch_request_bytes)
     return CacheOnlyTransport(num_partitions)

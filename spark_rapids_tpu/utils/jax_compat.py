@@ -1,0 +1,47 @@
+"""Version compatibility shims for the jax API surface this repo uses.
+
+The codebase targets the modern jax API (``jax.shard_map``, the
+``jax_num_cpu_devices`` config); older jaxlibs (0.4.x, as shipped in some
+containers) expose the same functionality under different names.  Every
+call site goes through this module so the skew is handled in exactly one
+place.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def shard_map(f=None, /, **kwargs):
+    """``jax.shard_map`` when available, else the experimental spelling.
+
+    The 0.4.x experimental version rejects unknown kwargs like
+    ``check_vma`` (renamed from ``check_rep``), so translate those too.
+    """
+    impl = getattr(jax, "shard_map", None)
+    if impl is None:
+        from jax.experimental.shard_map import shard_map as impl  # type: ignore
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return lambda g: impl(g, **kwargs)
+    return impl(f, **kwargs)
+
+
+def set_host_device_count(n: int) -> None:
+    """Expose ``n`` virtual CPU devices for sharding tests.
+
+    New jax: the ``jax_num_cpu_devices`` config.  Old jax: the XLA flag,
+    which must land in the environment before the CPU backend
+    initializes — callers must invoke this before any device query.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+        return
+    except AttributeError:
+        pass
+    flag = f"--xla_force_host_platform_device_count={int(n)}"
+    existing = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in existing:
+        os.environ["XLA_FLAGS"] = (existing + " " + flag).strip()

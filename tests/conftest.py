@@ -20,7 +20,9 @@ import jax  # noqa: E402
 # Tests run on CPU with 8 virtual devices: fast compiles, true float64
 # (bit-exactness oracle), and the multi-chip sharding paths execute.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from spark_rapids_tpu.utils.jax_compat import set_host_device_count  # noqa: E402
+
+set_host_device_count(8)
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

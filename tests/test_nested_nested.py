@@ -103,6 +103,12 @@ def test_map_entries_flatten_arrays_zip():
     assert out[0][0] == [("a", 1), ("b", 2)]
     assert out[0][1] == [1, 2, 3]
     assert out[0][2] == [(1, 1.5), (2, None), (3, None)]
+    # Spark parity: result struct fields are named after the input
+    # columns (ordinals only for anonymous expressions)
+    from spark_rapids_tpu.api.session import TpuSession
+    sch = build(TpuSession({"spark.rapids.sql.enabled": "false"})).schema
+    assert [f.name for f in sch.dtype_of("z12").element_type.fields] \
+        == ["a1", "a2"]
 
 
 def test_flatten_null_inner_array_nulls_row():

@@ -269,7 +269,111 @@ def test_fetch_window_conf_wiring():
     TpuSession({"spark.rapids.sql.enabled": "true",
                 "spark.rapids.shuffle.fetch.maxInflightBytes": "12345",
                 "spark.rapids.shuffle.fetch.threads": "2",
-                "spark.rapids.shuffle.fetch.mergeChunkBytes": "777"})
+                "spark.rapids.shuffle.fetch.mergeChunkBytes": "777",
+                "spark.rapids.shuffle.fetch.requestBytes": "9999"})
     assert TR._fetch_window == (12345, 2, 777)
+    assert TR._fetch_request_bytes == 9999
     # restore defaults for other tests
-    TR.set_fetch_window(64 << 20, 4, 32 << 20)
+    TR.set_fetch_window(64 << 20, 4, 32 << 20, 4 << 20)
+
+
+def test_connection_reuse_across_shuffles():
+    """Reduce-side fast path: ONE persistent pooled connection per peer,
+    reused across requests AND shuffles (cold connect-per-request was the
+    v1 plane's dominant cost)."""
+    from spark_rapids_tpu.shuffle.net import connection_pool
+    from spark_rapids_tpu.shuffle.serializer import serialize_batch
+    from spark_rapids_tpu.shuffle.stats import (
+        reset_shuffle_counters, shuffle_counters)
+    ex = ShuffleExecutor(serve_registry=True)
+    try:
+        for sid in (31, 32):            # two shuffles on the same peer
+            for i in range(4):
+                ex.store.put(sid, 0, serialize_batch(_batch(i * 5,
+                                                            i * 5 + 5)))
+        peer = PeerClient(ex.server.addr)
+        connection_pool().close_all()   # deterministic cold start
+        reset_shuffle_counters()
+        for sid in (31, 32):
+            assert len(peer.list_blocks(sid, 0)) == 4
+            blocks = list(BlockFetchIterator([peer], sid, 0))
+            assert len(blocks) == 4
+        c = shuffle_counters()
+        # 2 list_blocks + all fetches rode ONE socket
+        assert c["connections_opened"] == 1, c
+        assert c["blocks_fetched"] == 8, c
+        # fetch_many batched blocks: strictly fewer round-trips than blocks
+        assert c["fetch_requests"] < c["blocks_fetched"], c
+        assert connection_pool().connection_count(ex.server.addr) == 1
+    finally:
+        ex.close()
+
+
+def test_prefetch_overlap_slow_peer():
+    """Pipelined fetch: the iterator yields a fast peer's blocks while a
+    slow peer is stalled — fetch runs in background threads, not serially
+    before consumption."""
+    import threading as th
+
+    from spark_rapids_tpu.shuffle.net import BlockStore
+    from spark_rapids_tpu.shuffle.serializer import serialize_batch
+    gate = th.Event()
+
+    class GatedStore(BlockStore):
+        def get(self, shuffle_id, partition):
+            gate.wait(timeout=60)
+            return super().get(shuffle_id, partition)
+
+    fast = ShuffleExecutor(serve_registry=True)
+    slow = ShuffleExecutor(serve_registry=True)
+    try:
+        gated = GatedStore()
+        slow.store = slow.server.store = gated
+        for i in range(3):
+            fast.store.put(5, 0, serialize_batch(_batch(i * 10,
+                                                        i * 10 + 10)))
+        gated.put(5, 0, serialize_batch(_batch(100, 120)))
+        gated.put(5, 0, serialize_batch(_batch(120, 130)))
+        it = iter(BlockFetchIterator(
+            [PeerClient(fast.server.addr), PeerClient(slow.server.addr)],
+            5, 0))
+        got_while_stalled = [next(it), next(it), next(it)]
+        assert not gate.is_set()
+        gate.set()
+        rest = list(it)
+        assert len(got_while_stalled) == 3 and len(rest) == 2
+        from spark_rapids_tpu.shuffle.serializer import merge_batches
+        merged = merge_batches(got_while_stalled + rest, SCHEMA)
+        assert sorted(merged.to_pydict()["v"]) == sorted(
+            list(range(30)) + list(range(100, 130)))
+    finally:
+        gate.set()
+        fast.close()
+        slow.close()
+
+
+def test_concat_once_per_reduce_partition():
+    """Concat-once merge: a reduce partition's wire blocks accumulate RAW
+    and materialize with exactly ONE merge_batches call (one HBM upload)
+    when they fit the chunk budget, and the exchange-facing read yields a
+    single batch so no downstream concat runs either."""
+    from spark_rapids_tpu.shuffle.net import TcpShuffleTransport
+    from spark_rapids_tpu.shuffle.stats import (
+        reset_shuffle_counters, shuffle_counters)
+    ex = ShuffleExecutor(serve_registry=True)
+    try:
+        t = TcpShuffleTransport(ex, 2, SCHEMA)
+        pieces = [(0, _batch(i * 10, i * 10 + 10)) for i in range(4)]
+        pieces += [(1, _batch(100 + i * 10, 110 + i * 10))
+                   for i in range(3)]
+        t.write(iter(pieces))
+        reset_shuffle_counters()
+        outs0 = list(t.read_iter(0, target_rows=1 << 20))
+        outs1 = list(t.read_iter(1, target_rows=1 << 20))
+        assert len(outs0) == 1 and outs0[0].host_num_rows() == 40
+        assert len(outs1) == 1 and outs1[0].host_num_rows() == 30
+        c = shuffle_counters()
+        assert c["merges"] == 2, c            # one per reduce partition
+        assert c["merge_input_blocks"] == 7, c
+    finally:
+        ex.close()

@@ -34,8 +34,10 @@ def test_pivot_single_and_multi_agg():
 
 
 def test_pivot_count_star_guarded():
-    """Review regression: count(*) must count per pivot value, not the
-    whole group."""
+    """Review regressions: count(*) must count per pivot value, not the
+    whole group, and a group×pivot-value combination with NO matching
+    rows is NULL, not 0 (Spark PivotFirst semantics, ADVICE r5 medium) —
+    group b has no p='x' row."""
     schema = Schema.of(g=T.STRING, p=T.STRING)
     rows = {"g": ["a", "a", "a", "b"], "p": ["x", "y", "x", "y"]}
 
@@ -44,7 +46,7 @@ def test_pivot_count_star_guarded():
         return (s.create_dataframe([b]).group_by("g")
                 .pivot(col("p"), ["x", "y"]).agg(count()).order_by("g"))
     out = assert_tpu_cpu_equal(build, ignore_order=False)
-    assert out == [("a", 2, 1), ("b", 0, 1)]
+    assert out == [("a", 2, 1), ("b", None, 1)]
 
 
 def test_json_family():

@@ -45,6 +45,7 @@ if not getattr(_jax_compiler, "_srtpu_compile_lock_installed", False):
 
         def wrapped(*args, _orig=orig, **kwargs):
             with _compile_lock:
+                # tpu-lint: allow-lock-order(serializing XLA compiles IS this lock's purpose; old jaxlib CPU backends crash on concurrent compile)
                 return _orig(*args, **kwargs)
 
         setattr(_jax_compiler, name, wrapped)

@@ -74,19 +74,30 @@ class CpuEvalContext:
 
     @staticmethod
     def from_batch(batch: ColumnarBatch) -> "CpuEvalContext":
-        n = batch.host_num_rows()
+        # ONE device->host transfer for the row count and every column
+        # buffer (DeviceColumn is a pytree, so device_get returns host
+        # mirrors with numpy leaves).  The old per-column
+        # to_numpy/to_pylist loop issued 2+ blocking syncs per column,
+        # each draining the XLA dispatch queue — the dominant cost of
+        # entering the CPU bridge on wide schemas.
+        # tpu-lint: allow-host-sync(one batched download at the bridge boundary)
+        n_dev, host_cols = jax.device_get((batch.num_rows,
+                                           list(batch.columns)))
+        n = int(n_dev)
         cols = []
-        for col in batch.columns:
+        for col in host_cols:
             if col.dtype.variable_width or isinstance(col.dtype,
                                                       T.StructType) \
                     or (isinstance(col.dtype, T.DecimalType)
                         and col.dtype.uses_two_limbs):
+                # tpu-lint: allow-host-sync(host mirror: already downloaded)
                 pylist = col.to_pylist(n)
                 vals = np.empty((n,), dtype=object)
                 vals[:] = pylist
                 valid = np.array([v is not None for v in pylist],
                                  dtype=np.bool_)
             else:
+                # tpu-lint: allow-host-sync(host mirror: already downloaded)
                 vals, valid = col.to_numpy(n)
                 vals = vals.copy()
             cols.append((vals, valid))

@@ -74,12 +74,20 @@ def cached_path(path: str, conf) -> str:
     except Exception:
         return path
     entry = os.path.join(cache_dir, _entry_name(path, st))
-    with _lock:
-        if os.path.exists(entry):
-            _metrics["hits"] += 1
+    # hit probe + LRU touch happen OUTSIDE _lock: disk IO under the
+    # process-wide metrics lock serialized every concurrent scan's path
+    # resolution behind one slow stat (the blocking-under-lock defect
+    # tpu-lint's lock checker flags).  The lock now guards counters only.
+    hit = os.path.exists(entry)
+    if hit:
+        try:
             os.utime(entry)          # LRU touch
-            return entry
-        _metrics["misses"] += 1
+        except OSError:
+            hit = False              # lost a race with eviction: re-fetch
+    with _lock:
+        _metrics["hits" if hit else "misses"] += 1
+    if hit:
+        return entry
     tmp = entry + f".tmp{os.getpid()}"
     try:
         if remote:

@@ -81,6 +81,7 @@ def lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is None and not _tried:
             _tried = True
+            # tpu-lint: allow-lock-order(one-time double-checked build; holding the lock prevents two threads compiling the native lib)
             so = _build()
             if so:
                 l = ctypes.CDLL(so)

@@ -784,6 +784,7 @@ class TpuHashAggregateExec(TpuExec):
                     concat_batches_device)
                 cap = round_up_pow2(
                     max(sum(p.capacity for p in partials), 1))
+                # tpu-lint: allow-retry-discipline(traced body of _jit_combine; its one call site runs under with_retry_no_split)
                 merged_in, _ = concat_batches_device(
                     list(partials), cap)
             return spec._finalize(
@@ -835,12 +836,14 @@ class TpuHashAggregateExec(TpuExec):
 
     def _merge_partials(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
         if len(partials) == 1:
-            merged_in = partials[0]
-        else:
-            from spark_rapids_tpu.plan.execs.coalesce import concat_batches_jit
-            cap = round_up_pow2(max(sum(p.capacity for p in partials), 1))
-            merged_in = concat_batches_jit(partials, cap)
-        return with_retry_no_split(lambda: self._jit_merge(merged_in))
+            return with_retry_no_split(
+                lambda: self._jit_merge(partials[0]))
+        from spark_rapids_tpu.plan.execs.coalesce import concat_batches_jit
+        cap = round_up_pow2(max(sum(p.capacity for p in partials), 1))
+        # concat INSIDE the retry body: the discarded concat result
+        # re-runs after a spill instead of pinning HBM from the closure
+        return with_retry_no_split(
+            lambda: self._jit_merge(concat_batches_jit(partials, cap)))
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         with timed(self.op_time):

@@ -363,11 +363,10 @@ def regex_bucket(batch, exprs) -> int:
             walk(c)
     for e in exprs:
         walk(e)
-    m = lit_len[0]
-    for ci in ordinals:
-        col = batch.columns[ci]
-        if col.is_string_like:
-            m = max(m, int(SK.max_live_string_bytes(col, batch.num_rows)))
+    # ONE device sync over every referenced string column (the previous
+    # per-column int() loop stalled dispatch once per column)
+    m = max(lit_len[0], SK.max_live_bytes_multi(
+        (batch.columns[ci], batch.num_rows) for ci in ordinals))
     return SK.bucket_for(m)
 
 
@@ -402,13 +401,13 @@ def string_key_bucket(batch, exprs) -> int:
     computable before the jitted kernel runs."""
     from spark_rapids_tpu.expressions.core import Alias, BoundReference
     from spark_rapids_tpu.kernels import strings as SK
-    m = 0
-    has_string = False
+    pairs = []
     for e in exprs:
         while isinstance(e, Alias):
             e = e.child
         if isinstance(e, BoundReference) and e.dtype.variable_width:
-            has_string = True
-            m = max(m, int(SK.max_live_string_bytes(
-                batch.columns[e.ordinal], batch.num_rows)))
-    return SK.bucket_for(m) if has_string else 0
+            pairs.append((batch.columns[e.ordinal], batch.num_rows))
+    if not pairs:
+        return 0
+    # ONE device sync across every string key column
+    return SK.bucket_for(SK.max_live_bytes_multi(pairs))

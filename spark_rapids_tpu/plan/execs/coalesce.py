@@ -59,6 +59,7 @@ def maybe_shrink(batch: ColumnarBatch,
     # ONE device->host transfer for num_rows + every string column's live
     # byte count (per-scalar syncs would stall the dispatch pipeline once
     # per column on the filter hot path)
+    # tpu-lint: allow-host-sync(documented ONE batched transfer for num_rows + live byte counts)
     scalars = jax.device_get(
         (batch.num_rows,
          [c.offsets[batch.num_rows] for c in batch.columns
@@ -92,6 +93,43 @@ def maybe_shrink(batch: ColumnarBatch,
     key = (f"shrink|{schema_cache_key(batch.schema)}|{cap}|{bcaps}|"
            f"{target}|{out_bcaps}")
     return shared_jit(key, lambda: shrink)(batch, jnp.int32(n))
+
+
+def retry_over_spillable(handles, body):
+    """Run ``body(coalesce_to_one(materialized handles))`` under
+    with_retry_no_split with PIN-BALANCED attempts.
+
+    Every attempt re-materializes the handles (pin +1 each) and ALWAYS
+    unpins its own pins before the attempt ends — after ``body`` returns
+    on success, before the retry's spill on failure.  That makes the
+    re-materialize contract real: a mid-attempt OOM leaves the handles
+    unpinned and therefore spillable, so the spill can free exactly the
+    inputs the next attempt will bring back (the reference's
+    withRetry-over-SpillableColumnarBatch discipline).  Materializing
+    inside a retry body WITHOUT this balancing leaks one pin per extra
+    attempt and permanently unspills the handles.
+
+    ``body`` must not keep the coalesced batch (or the materialized
+    inputs) alive past its return; callers still own close().
+    """
+    from spark_rapids_tpu.memory.retry import with_retry_no_split
+
+    handles = list(handles)   # attempts re-iterate: a generator would be
+                              # exhausted by attempt 1 and retry nothing
+
+    def attempt():
+        pinned = []
+        try:
+            mats = []
+            for h in handles:
+                mats.append(h.materialize())
+                pinned.append(h)
+            return body(coalesce_to_one(mats))
+        finally:
+            for h in pinned:
+                h.unpin()
+
+    return with_retry_no_split(attempt)
 
 
 def coalesce_to_one(batches: List[ColumnarBatch]) -> Optional[ColumnarBatch]:

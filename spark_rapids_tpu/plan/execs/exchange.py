@@ -239,7 +239,8 @@ class TpuShuffleExchangeExec(TpuExec):
                         SHUFFLE_COUNTERS)
                     SHUFFLE_COUNTERS.add(reduce_concats=1)
                     cap = round_up_pow2(max(acc, 1))
-                    out = concat_batches_jit(group, cap)
+                    out = with_retry_no_split(
+                        lambda: concat_batches_jit(group, cap))
             self.output_rows.add(out.num_rows)
             yield self._count_out(out)
             if b is not None:

@@ -314,16 +314,15 @@ class _JoinKernel:
 
     def _key_bucket(self, l: ColumnarBatch, r: ColumnarBatch) -> int:
         from spark_rapids_tpu.kernels import strings as SK
-        m = 0
-        has_string = False
+        pairs = []
         for lk, rk in zip(self.left_key_idx, self.right_key_idx):
             if l.columns[lk].is_string_like:
-                has_string = True
-                m = max(m, int(SK.max_live_string_bytes(l.columns[lk],
-                                                        l.num_rows)))
-                m = max(m, int(SK.max_live_string_bytes(r.columns[rk],
-                                                        r.num_rows)))
-        return SK.bucket_for(m) if has_string else 0
+                pairs.append((l.columns[lk], l.num_rows))
+                pairs.append((r.columns[rk], r.num_rows))
+        if not pairs:
+            return 0
+        # ONE device sync across both sides' string keys (was 2 per pair)
+        return SK.bucket_for(SK.max_live_bytes_multi(pairs))
 
     def __call__(self, l: ColumnarBatch, r: ColumnarBatch) -> ColumnarBatch:
         if self.conditional:
@@ -453,8 +452,11 @@ class TpuShuffledHashJoinExec(TpuExec):
                                                  total)
             return
         with timed(self.op_time):
-            out = self._join_pair(coalesce_to_one(left_batches),
-                                  coalesce_to_one(right_batches))
+            # both coalesces under retry: the two concats are this exec's
+            # big materializations (the join kernel retries internally)
+            out = self._join_pair(
+                with_retry_no_split(lambda: coalesce_to_one(left_batches)),
+                with_retry_no_split(lambda: coalesce_to_one(right_batches)))
             if out is not None:
                 from spark_rapids_tpu.plan.execs.coalesce import maybe_shrink
                 out = maybe_shrink(out)
@@ -473,7 +475,8 @@ class TpuShuffledHashJoinExec(TpuExec):
         instead of one unbounded concat."""
         from spark_rapids_tpu.plan.execs.coalesce import maybe_shrink
         with timed(self.op_time):
-            build = coalesce_to_one(right_batches)
+            build = with_retry_no_split(
+                lambda: coalesce_to_one(right_batches))
         # an empty build side still DRAINS the probe child (no early
         # return): in cluster mode the probe exchange's map-side write
         # runs lazily under execute_partition, and other ranks' reduce
@@ -486,7 +489,9 @@ class TpuShuffledHashJoinExec(TpuExec):
 
         def flush():
             with timed(self.op_time):
-                out = self._join_pair(coalesce_to_one(group), build)
+                out = self._join_pair(
+                    with_retry_no_split(lambda: coalesce_to_one(group)),
+                    build)
                 if out is not None:
                     out = maybe_shrink(out)
             return out
@@ -523,9 +528,20 @@ class TpuShuffledHashJoinExec(TpuExec):
         try:
             for lq, rq in zip(lbuckets, rbuckets):
                 with timed(self.op_time):
-                    left = (coalesce_to_one([h.materialize() for h in lq])
+                    # NOT retry-wrapped: the coalesced batches (which may
+                    # alias a single handle's batch) feed the skew-aware
+                    # join below, so the handles must stay pinned past
+                    # this statement — materializing inside a retry body
+                    # would leak one pin per attempt (pinned handles
+                    # refuse to spill), and unpinning per attempt would
+                    # let the spill free a batch the join still reads
+                    # tpu-lint: allow-retry-discipline(handles stay pinned through the join; per-attempt pin balance is impossible while the result outlives the coalesce)
+                    left = (coalesce_to_one(
+                        [h.materialize() for h in lq])
                             if lq else None)
-                    right = (coalesce_to_one([h.materialize() for h in rq])
+                    # tpu-lint: allow-retry-discipline(handles stay pinned through the join; per-attempt pin balance is impossible while the result outlives the coalesce)
+                    right = (coalesce_to_one(
+                        [h.materialize() for h in rq])
                              if rq else None)
                 try:
                     yield from self._join_bucket_skew_aware(left, right)
@@ -624,7 +640,8 @@ class TpuBroadcastHashJoinExec(TpuExec):
                 right = self.children[1]
                 for p in range(right.num_partitions()):
                     batches.extend(right.execute_partition(p))
-                self._build = coalesce_to_one(batches)
+                self._build = with_retry_no_split(
+                    lambda: coalesce_to_one(batches))
                 self._build_done = True
             return self._build
 
@@ -652,7 +669,7 @@ class TpuBroadcastHashJoinExec(TpuExec):
         for group in chunks:
             if not group:
                 continue
-            left = coalesce_to_one(group)
+            left = with_retry_no_split(lambda: coalesce_to_one(group))
             with timed(self.op_time):
                 out = self._kernel(left, build)
             self.output_rows.add(out.num_rows)

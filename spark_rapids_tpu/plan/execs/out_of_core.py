@@ -137,14 +137,12 @@ def sub_partition_spillable(
     key_idx = tuple(key_idx)
     buckets: List[List[SpillableBatchHandle]] = [[] for _ in range(num_buckets)]
     for batch in batches:
-        sb = 0
-        has_string = False
-        for ci in key_idx:
-            c = batch.columns[ci]
-            if c.is_string_like:
-                has_string = True
-                sb = max(sb, int(SK.max_live_string_bytes(c, batch.num_rows)))
-        string_bucket = SK.bucket_for(sb) if has_string else 0
+        has_string = any(batch.columns[ci].is_string_like
+                         for ci in key_idx)
+        # ONE device sync per batch across all string key columns
+        string_bucket = SK.bucket_for(SK.max_live_bytes_multi(
+            (batch.columns[ci], batch.num_rows) for ci in key_idx)) \
+            if has_string else 0
         fn = shared_jit(
             f"subpart|{schema_cache_key(schema)}|{key_idx}|{num_buckets}"
             f"|{string_bucket}",

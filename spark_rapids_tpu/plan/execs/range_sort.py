@@ -36,7 +36,8 @@ from spark_rapids_tpu.kernels.groupby import normalize_key_column
 from spark_rapids_tpu.memory.retry import with_retry_no_split
 from spark_rapids_tpu.memory.spill import SpillableBatchHandle, make_spillable
 from spark_rapids_tpu.plan.execs.base import TpuExec, string_key_bucket, timed
-from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
+from spark_rapids_tpu.plan.execs.coalesce import (
+    coalesce_to_one, retry_over_spillable)
 from spark_rapids_tpu.plan.execs.sort import TpuSortExec
 
 SAMPLE_PER_PARTITION = 64
@@ -235,7 +236,8 @@ class TpuRangeSortExec(TpuExec):
                 # shape) that is pure launch overhead on the TPU.  All
                 # rows land in partition 0; empty partitions follow, so
                 # partition-order concatenation is still the global order.
-                merged = coalesce_to_one(batches)
+                merged = with_retry_no_split(
+                    lambda: coalesce_to_one(batches))
                 buckets = [[make_spillable(merged)]] + \
                     [[] for _ in range(self.out_partitions - 1)]
             else:
@@ -257,9 +259,11 @@ class TpuRangeSortExec(TpuExec):
             if not batches:
                 return
             with timed(self.op_time):
-                merged = coalesce_to_one(batches)
+                # coalesce INSIDE the retry body (discard-and-rerun on
+                # OOM instead of an unspillable closure capture)
                 out = with_retry_no_split(
-                    lambda: self._local_sort._run(merged))
+                    lambda: self._local_sort._run(
+                        coalesce_to_one(batches)))
             self.output_rows.add(out.num_rows)
             yield self._count_out(out)
             return
@@ -267,10 +271,10 @@ class TpuRangeSortExec(TpuExec):
         if not handles:
             return
         with timed(self.op_time):
-            merged = coalesce_to_one([h.materialize() for h in handles])
-            out = with_retry_no_split(lambda: self._local_sort._run(merged))
-            for h in handles:
-                h.unpin()
+            # pin-balanced retry: each attempt re-materializes the
+            # handles and unpins before it ends (see
+            # coalesce.retry_over_spillable); handles close in cleanup()
+            out = retry_over_spillable(handles, self._local_sort._run)
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
@@ -317,6 +321,7 @@ def _sample_value_batch(batches: List[ColumnarBatch], orders,
             continue
         stride = max(n // SAMPLE_PER_PARTITION, 1)
         idx = list(range(0, n, stride))
+        # tpu-lint: allow-host-sync(driver-side range-bound sampling: a few rows per partition, off the hot path)
         col_lists = [c.to_pylist(n) for c in cols]
         for i in idx:
             for ci, n_ in enumerate(names[:-1]):

@@ -84,17 +84,21 @@ class TpuSortExec(TpuExec):
             return
         with timed(self.op_time):
             if len(batches) == 1:
-                merged = batches[0]
+                out = with_retry_no_split(lambda: self._run(batches[0]))
             else:
                 cap = round_up_pow2(max(total, 1))
-                merged, _ = concat_batches_device(batches, cap)
-            out = with_retry_no_split(lambda: self._run(merged))
+                # concat INSIDE the retry body: on OOM the discarded
+                # concat result is re-run after the spill instead of
+                # sitting unspillably in the closure
+                out = with_retry_no_split(lambda: self._run(
+                    concat_batches_device(batches, cap)[0]))
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
     def _execute_out_of_core(self, batches: List[ColumnarBatch],
                              total: int) -> Iterator[ColumnarBatch]:
-        from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
+        from spark_rapids_tpu.plan.execs.coalesce import (
+            coalesce_to_one, retry_over_spillable)
         from spark_rapids_tpu.plan.execs.out_of_core import (
             close_all, num_sub_buckets)
         from spark_rapids_tpu.plan.execs.range_sort import (
@@ -110,10 +114,12 @@ class TpuSortExec(TpuExec):
                 if not q:
                     continue
                 with timed(self.op_time):
-                    merged = coalesce_to_one([h.materialize() for h in q])
-                    out = with_retry_no_split(lambda: self._run(merged))
+                    # pin-balanced retry (retry_over_spillable): each
+                    # attempt re-materializes the handles and unpins
+                    # before it ends, so an OOM's spill can free exactly
+                    # these inputs before the re-run
+                    out = retry_over_spillable(q, self._run)
                     for h in q:
-                        h.unpin()
                         h.close()
                     q.clear()
                 self.output_rows.add(out.num_rows)

@@ -29,7 +29,8 @@ from spark_rapids_tpu.kernels.selection import gather_batch
 from spark_rapids_tpu.kernels.sort import SortOrder, sort_indices
 from spark_rapids_tpu.memory.retry import with_retry_no_split
 from spark_rapids_tpu.plan.execs.base import TpuExec, string_key_bucket, timed
-from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
+from spark_rapids_tpu.plan.execs.coalesce import (
+    coalesce_to_one, retry_over_spillable)
 
 
 def _unwrap(e: Expression) -> WindowExpression:
@@ -391,9 +392,12 @@ class TpuWindowExec(TpuExec):
             if self._partition_ordinals() is not None:
                 yield from self._execute_out_of_core(batches, total)
                 return
-        merged = coalesce_to_one(batches)
         with timed(self.op_time):
-            out = with_retry_no_split(lambda: self._run(merged))
+            # coalesce INSIDE the retry body: a discarded concat result
+            # re-runs after the spill instead of pinning HBM from the
+            # closure
+            out = with_retry_no_split(
+                lambda: self._run(coalesce_to_one(batches)))
         self.output_rows.add(out.num_rows)
         yield self._count_out(out)
 
@@ -713,10 +717,11 @@ class TpuWindowExec(TpuExec):
                 if not q:
                     continue
                 with timed(self.op_time):
-                    merged = coalesce_to_one([h.materialize() for h in q])
-                    out = with_retry_no_split(lambda: self._run(merged))
+                    # pin-balanced retry: each attempt re-materializes
+                    # the handles and unpins before it ends (see
+                    # coalesce.retry_over_spillable)
+                    out = retry_over_spillable(q, self._run)
                     for h in q:
-                        h.unpin()
                         h.close()
                     q.clear()
                 self.output_rows.add(out.num_rows)

@@ -306,7 +306,8 @@ class TpuFusedSegmentExec(TpuExec):
                     batches = []
                     for p in range(b.num_partitions()):
                         batches.extend(b.execute_partition(p))
-                    merged = coalesce_to_one(batches)
+                    merged = with_retry_no_split(
+                        lambda: coalesce_to_one(batches))
                     if merged is None:
                         merged = ColumnarBatch.empty(b.schema)
                     outs.append(merged)
@@ -387,6 +388,7 @@ class TpuFusedSegmentExec(TpuExec):
                             lambda: self._make(bucket, caps, slice_spec))
             out, counts, fb = with_retry_no_split(
                 lambda: fn(batch, tuple(builds), self._consts))
+            # tpu-lint: allow-host-sync(overflow feedback must reach the host; one batched sync per attempt)
             fetched, host_counts = jax.device_get((fb, counts))
             observed = int(fetched.pop("__stream_bytes", 0))
             if observed or bucket:

@@ -235,6 +235,35 @@ def _build_registry() -> None:
         register(getattr(DT, name),
                  ExprSig(TypeSig("timestamp"), TypeSig("timestamp"),
                          note="transition-table lookup on device"))
+    _DATE = TypeSig("date")
+    _TS = TypeSig("timestamp")
+    for cls in (DT.DateAdd, DT.DateSub):
+        register(cls, ExprSig(_DATE, _DATE, INTEGRAL))
+    register(DT.DateDiff, ExprSig(TypeSig("int"), _DATE, _DATE))
+    register(DT.AddMonths, ExprSig(_DATE, _DATE, INTEGRAL,
+                                   note="day clamped to target month end"))
+    register(DT.LastDay, ExprSig(_DATE, _DATE))
+    register(DT.MakeDate, ExprSig(_DATE, INTEGRAL, INTEGRAL, INTEGRAL))
+    register(DT.TruncDate, ExprSig(_DATE, _DATE, note="fmt literal"))
+    register(DT.NextDay, ExprSig(_DATE, _DATE, note="day-name literal"))
+    register(DT.MonthsBetween, ExprSig(TypeSig("double"), _DATE, _DATE))
+    for name in ("UnixSeconds", "UnixMillis", "UnixMicros"):
+        register(getattr(DT, name), ExprSig(TypeSig("long"), _TS))
+    for name in ("SecondsToTimestamp", "MillisToTimestamp",
+                 "MicrosToTimestamp"):
+        register(getattr(DT, name), ExprSig(_TS, INTEGRAL))
+    register(DT.UnixDate, ExprSig(TypeSig("int"), _DATE))
+    register(DT.DateFromUnixDate, ExprSig(_DATE, INTEGRAL))
+
+    # bitwise
+    for cls in (B.BitwiseAnd, B.BitwiseOr, B.BitwiseXor):
+        register(cls, ExprSig(INTEGRAL, INTEGRAL, INTEGRAL))
+    register(B.BitwiseNot, ExprSig(INTEGRAL, INTEGRAL))
+    for cls in (B.ShiftLeft, B.ShiftRight, B.ShiftRightUnsigned):
+        register(cls, ExprSig(TypeSig("int", "long"),
+                              TypeSig("int", "long"), TypeSig("int"),
+                              note="shift distance masked to the value "
+                              "width (Spark semantics)"))
 
     # strings
     for name in ("Upper", "Lower", "Trim", "LTrim", "RTrim", "Reverse",
@@ -248,6 +277,29 @@ def _build_registry() -> None:
     register(S.GetJsonObject, ExprSig(STR, STR,
                                       note="dotted paths on device; "
                                       "indexed paths via CPU bridge"))
+    register(S.Ascii, ExprSig(TypeSig("int"), STR))
+    register(S.BitLength, ExprSig(TypeSig("int"), STR))
+    register(S.OctetLength, ExprSig(TypeSig("int"), STR))
+    register(S.Concat, ExprSig(STR, STR, note="variadic; null if any "
+                               "input is null"))
+    register(S.ConcatWs, ExprSig(STR, STR,
+                                 note="variadic; separator literal; "
+                                 "nulls skipped"))
+    register(S.Left, ExprSig(STR, STR, note="n literal"))
+    register(S.Right, ExprSig(STR, STR, note="n literal"))
+    register(S.Lpad, ExprSig(STR, STR, note="length/pad literals"))
+    register(S.Rpad, ExprSig(STR, STR, note="length/pad literals"))
+    register(S.StringInstr, ExprSig(TypeSig("int"), STR,
+                                    note="substr literal"))
+    register(S.StringLocate, ExprSig(TypeSig("int"), STR,
+                                     note="substr/pos literals"))
+    register(S.StringRepeat, ExprSig(STR, STR,
+                                     note="n literal (static growth "
+                                     "bound)"))
+    register(S.StringReplace, ExprSig(STR, STR,
+                                      note="search/replace literals"))
+    register(S.Translate, ExprSig(STR, STR,
+                                  note="ASCII from/to literals"))
 
     # collections
     register(C.Size, ExprSig(TypeSig("int"), ARR + MAP))
@@ -267,6 +319,14 @@ def _build_registry() -> None:
     register(C.ArrayFilter, ExprSig(ARR, ARR, BOOL))
     register(C.ArrayExists, ExprSig(BOOL, ARR, BOOL))
     register(C.ArrayForAll, ExprSig(BOOL, ARR, BOOL))
+    # generators (output row counts are data-dependent; the exec handles
+    # the capacity retry) and lambda plumbing
+    for cls in (C.Explode, C.PosExplode):
+        register(cls, ExprSig(ALL_DEVICE, ARR + MAP,
+                              note="element type of the input"))
+    register(C.NamedLambdaVariable,
+             ExprSig(ALL_DEVICE, note="typed by its binder (transform/"
+                     "filter/exists HOFs)"))
 
     # structs / maps
     from spark_rapids_tpu.expressions import structs as ST
@@ -389,6 +449,11 @@ def _build_registry() -> None:
     for cls in (W.FirstValue, W.LastValue, W.NthValue):
         register(cls, ExprSig(NUMERIC_DEC + DATETIME + BOOL,
                               NUMERIC_DEC + DATETIME + BOOL))
+    register(W.WindowExpression,
+             ExprSig(ALL_DEVICE, ALL_DEVICE,
+                     note="structural wrapper: result type is the "
+                     "wrapped function's; children are the function "
+                     "plus partition/order keys"))
 
 
 _build_registry()

@@ -98,31 +98,62 @@ def _recv_fetch_many(sock: socket.socket) -> List[bytes]:
 # -- persistent per-peer connections ------------------------------------------
 
 class PooledConnection:
-    """One long-lived socket to a peer, serialized by a lock and reused
-    across requests and shuffles.  On any transport error the socket is
-    dropped and the request retried once on a fresh connect (the server
-    may have restarted, or an idle connection may have been reaped)."""
+    """One long-lived socket to a peer, reused across requests and
+    shuffles.  On any transport error the socket is dropped and the
+    request retried once on a fresh connect (the server may have
+    restarted, or an idle connection may have been reaped).
+
+    Requests are serialized by socket OWNERSHIP HANDOFF, not by holding
+    a lock across the IO: a round-trip checks the socket out under the
+    condition, runs connect/send/recv with NO lock held, and checks it
+    back in.  Holding the lock through the IO (the previous design) let
+    one peer's 60s socket timeout block close()/connection_count() and
+    any other thread touching this connection's state — the
+    blocking-under-lock defect tpu-lint's lock checker flags."""
 
     def __init__(self, addr: Tuple[str, int], timeout: float = 60.0):
         self.addr = tuple(addr)
         self.timeout = timeout
-        self._lock = threading.Lock()
+        self._cv = threading.Condition()
         self._sock: Optional[socket.socket] = None
+        self._busy = False
+        self._closed = False
 
     def _connect(self) -> socket.socket:
-        self._sock = socket.create_connection(self.addr,
-                                              timeout=self.timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock = socket.create_connection(self.addr, timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         SHUFFLE_COUNTERS.add(connections_opened=1)
-        return self._sock
+        return sock
 
-    def _drop(self) -> None:
-        if self._sock is not None:
+    @staticmethod
+    def _close_sock(sock: Optional[socket.socket]) -> None:
+        if sock is not None:
             try:
-                self._sock.close()
+                sock.close()
             except OSError:
                 pass
-            self._sock = None
+
+    def _checkout(self) -> Optional[socket.socket]:
+        """Take exclusive ownership of the pooled socket (may be None =
+        caller connects).  A new request also un-latches close(): reuse
+        after close means the caller wants the connection back."""
+        with self._cv:
+            while self._busy:
+                self._cv.wait()
+            self._busy = True
+            self._closed = False
+            sock, self._sock = self._sock, None
+        return sock
+
+    def _checkin(self, sock: Optional[socket.socket]) -> None:
+        """Return ownership; pool the healthy socket unless close() was
+        called while the request was in flight."""
+        with self._cv:
+            self._busy = False
+            if sock is not None and not self._closed:
+                self._sock, sock = sock, None
+            self._cv.notify()
+        self._close_sock(sock)   # socket close runs outside the lock too
 
     def _roundtrip(self, send, recv, retriable: bool = True):
         """``retriable=False`` for NON-IDEMPOTENT ops (e.g. the driver's
@@ -132,18 +163,33 @@ class PooledConnection:
         the CALLER's next (distinct) request reconnects cleanly — callers
         of non-retriable ops decide themselves whether a single failure
         is tolerable (executor_main tolerates one stale-socket poll)."""
-        with self._lock:
+        sock = self._checkout()
+        clean = False
+        try:
             for attempt in ((0, 1) if retriable else (1,)):
                 try:
-                    sock = self._sock or self._connect()
+                    if sock is None:
+                        sock = self._connect()
                     send(sock)
-                    return recv(sock)
+                    out = recv(sock)
+                    clean = True
+                    return out
                 except (ConnectionError, OSError, struct.error,
                         socket.timeout):
-                    self._drop()
+                    self._close_sock(sock)
+                    sock = None
                     if attempt:
                         raise
             raise AssertionError("unreachable")
+        finally:
+            if not clean and sock is not None:
+                # an exception OUTSIDE the transport-error tuple (e.g. a
+                # malformed JSON header) left the socket mid-protocol
+                # with unread bytes buffered; pooling it would desync
+                # every later request on this peer
+                self._close_sock(sock)
+                sock = None
+            self._checkin(sock)
 
     def request(self, header: dict, payload: bytes = b"",
                 retriable: bool = True) -> Tuple[dict, bytes]:
@@ -172,8 +218,10 @@ class PooledConnection:
         return out
 
     def close(self) -> None:
-        with self._lock:
-            self._drop()
+        with self._cv:
+            self._closed = True
+            sock, self._sock = self._sock, None
+        self._close_sock(sock)
 
 
 class ConnectionPool:
@@ -612,15 +660,21 @@ class BlockFetchIterator:
                     while (not queue and state["live_workers"] > 0
                            and state["error"] is None):
                         cv.wait()
-                    SHUFFLE_COUNTERS.add(
-                        prefetch_stall_ns=time.perf_counter_ns() - t0)
-                    if state["error"] is not None:
-                        raise state["error"]
-                    if not queue:
-                        return      # all workers drained
-                    block = queue.popleft()
-                    state["inflight"] -= len(block)
-                    cv.notify_all()
+                    stall_ns = time.perf_counter_ns() - t0
+                    err = state["error"]
+                    block = None
+                    if err is None and queue:
+                        block = queue.popleft()
+                        state["inflight"] -= len(block)
+                        cv.notify_all()
+                # stall accounting outside cv: the counter add takes the
+                # process-wide stats lock, which must never nest under
+                # the fetch condition
+                SHUFFLE_COUNTERS.add(prefetch_stall_ns=stall_ns)
+                if err is not None:
+                    raise err
+                if block is None:
+                    return          # all workers drained
                 yield block         # outside the lock: consumer compute
                                     # overlaps the workers' next fetches
         finally:
@@ -725,6 +779,7 @@ class TcpShuffleTransport:
         merged batches land on the consumer's coalesce target and the
         exchange exec never re-concats them.  Reference:
         BufferSendState.scala / WindowedBlockIterator.scala."""
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
         from spark_rapids_tpu.shuffle.serializer import (
             merge_batches, wire_row_count)
         remote = self._await_and_resolve_peers()
@@ -749,12 +804,17 @@ class TcpShuffleTransport:
             if acc >= self.merge_chunk_bytes or (
                     target_rows and rows is not None
                     and rows >= target_rows):
-                out = merge_batches(chunk, self.schema)
+                # under retry: the merge is THE reduce-side HBM upload;
+                # its inputs are host wire bytes, so a spill-and-rerun
+                # is safe and an OOM here must not fail the query
+                out = with_retry_no_split(
+                    lambda: merge_batches(chunk, self.schema))
                 chunk, acc, rows = [], 0, 0
                 if out is not None:
                     yield out
         if chunk:
-            out = merge_batches(chunk, self.schema)
+            out = with_retry_no_split(
+                lambda: merge_batches(chunk, self.schema))
             if out is not None:
                 yield out
 

@@ -109,14 +109,19 @@ def wire_row_count(block: bytes) -> Optional[int]:
 
 
 def merge_batches(buffers: List[bytes], schema: Schema) -> Optional[ColumnarBatch]:
-    """Concat-merge wire buffers into one device batch."""
+    """Concat-merge wire buffers into one device batch.
+
+    Counters are bumped by ``_count_merge`` on COMPLETION (not entry):
+    call sites run this under with_retry_no_split, and an OOM-discarded
+    attempt must not inflate the merge stats the chunk-size tuning reads.
+    """
     import jax.numpy as jnp
     if not buffers:
         return None
-    from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
-    SHUFFLE_COUNTERS.add(merges=1, merge_input_blocks=len(buffers))
     if _has_nested(schema):
-        return _py_merge_nested([_decompress(b) for b in buffers], schema)
+        return _count_merge(
+            _py_merge_nested([_decompress(b) for b in buffers], schema),
+            len(buffers))
     raw = [_decompress(b) for b in buffers]
     col_specs = [(np.dtype(dt.np_dtype), dt.variable_width)
                  for dt in schema.dtypes]
@@ -138,8 +143,16 @@ def merge_batches(buffers: List[bytes], schema: Schema) -> Optional[ColumnarBatc
         else:
             device_cols.append(DeviceColumn(
                 jnp.asarray(data), jnp.asarray(valid.astype(np.bool_)), dt))
-    return ColumnarBatch(tuple(device_cols), jnp.asarray(rows, jnp.int32),
-                         schema)
+    return _count_merge(
+        ColumnarBatch(tuple(device_cols), jnp.asarray(rows, jnp.int32),
+                      schema),
+        len(buffers))
+
+
+def _count_merge(batch: ColumnarBatch, n_blocks: int) -> ColumnarBatch:
+    from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+    SHUFFLE_COUNTERS.add(merges=1, merge_input_blocks=n_blocks)
+    return batch
 
 
 # ---------------------------------------------------------------------------

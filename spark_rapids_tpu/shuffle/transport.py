@@ -101,11 +101,15 @@ class KudoWireTransport(ShuffleTransport):
                 self._buckets[p].append(fut.result())
 
     def read(self, partition: int) -> List[ColumnarBatch]:
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
         from spark_rapids_tpu.shuffle.serializer import merge_batches
         buffers = self._buckets[partition]
         if not buffers:
             return []
-        return [merge_batches(buffers, self.schema)]
+        # under retry: inputs are host wire bytes (idempotent to re-merge),
+        # and the merge is the read side's one big HBM materialization
+        return [with_retry_no_split(
+            lambda: merge_batches(buffers, self.schema))]
 
     def cleanup(self) -> None:
         for b in self._buckets:

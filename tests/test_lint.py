@@ -1,0 +1,440 @@
+"""Tier-1 gate for tpu-lint (tools/tpulint): the four invariant checkers
+run against the live tree, each checker is proven to fire on a synthetic
+violation fixture, and the real defects fixed while building the linter
+are pinned as regression fixtures (their PRE-FIX shapes must fire; the
+fixed files must be clean rather than baselined).
+
+Reference analog: the TypeChecks / ApiValidation / retry-suite tooling
+the reference uses instead of review for its hardest invariants.
+"""
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.tpulint import core as lint_core
+from tools.tpulint import drift, host_sync, locks, retry_discipline
+
+
+def _src(path: str, text: str) -> lint_core.SourceFile:
+    import ast
+    text = textwrap.dedent(text)
+    lines = text.splitlines()
+    allows, problems = lint_core._parse_allows(lines)
+    s = lint_core.SourceFile(path=path, text=text, lines=lines,
+                             tree=ast.parse(text), allows=allows)
+    s.suppression_problems = problems
+    return s
+
+
+def _unsuppressed(rule_violations, src):
+    return [v for v in rule_violations if not src.allowed(v.rule, v.line)]
+
+
+# -- the repo gate -----------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """New violations in the AST rules fail tier-1 (drift rules run in
+    their own tests below so a doc drift reports as exactly one failure)."""
+    violations = lint_core.run_all(REPO, with_drift=False)
+    baseline = lint_core.load_baseline()
+    fresh, _stale = lint_core.apply_baseline(violations, baseline)
+    assert not fresh, "new tpu-lint violations:\n" + "\n".join(
+        v.render() for v in fresh)
+
+
+def test_baseline_entries_are_reviewed():
+    baseline = lint_core.load_baseline()
+    bad = [e["fingerprint"] for e in baseline.values()
+           if not e.get("reason")
+           or e["reason"] == lint_core.PLACEHOLDER_REASON]
+    assert not bad, f"baseline entries without a reviewed reason: {bad}"
+
+
+def test_baseline_has_no_stale_entries():
+    violations = lint_core.run_all(REPO, with_drift=False)
+    _fresh, stale = lint_core.apply_baseline(violations,
+                                             lint_core.load_baseline())
+    assert not stale, f"stale baseline entries: {stale}"
+
+
+# -- drift rules against the live tree (satellite: api_check coverage) -------
+
+def test_supported_ops_and_configs_not_drifted():
+    assert drift._check_generated_docs(REPO) == []
+
+
+def test_every_override_has_a_typesig_row():
+    assert drift._check_typesig_rows() == []
+
+
+def test_api_surface_matches_snapshot():
+    """tools/api_check.py against the committed api_surface.json."""
+    assert drift._check_api_surface(REPO) == []
+
+
+def test_drift_fires_on_unregistered_expr():
+    from spark_rapids_tpu.planner import overrides as O
+
+    class _FakeExpr:   # deliberately absent from typesig
+        pass
+
+    O._SUPPORTED_EXPRS.add(_FakeExpr)
+    try:
+        vs = drift._check_typesig_rows()
+    finally:
+        O._SUPPORTED_EXPRS.discard(_FakeExpr)
+    assert any("_FakeExpr" in v.message for v in vs)
+
+
+def test_api_check_detects_removal_and_signature_change():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "api_check_under_test", os.path.join(REPO, "tools", "api_check.py"))
+    ac = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ac)
+    recorded = {"functions": ["a", "b"],
+                "DataFrame": {"select": "(cols)"}}
+    live = {"functions": ["a"],
+            "DataFrame": {"select": "(cols, how)"}}
+    problems = ac.diff_surface(recorded, live)
+    assert "functions: b removed" in problems
+    assert any("select signature changed" in p for p in problems)
+
+
+# -- synthetic fixture per AST rule (each checker must FIRE) -----------------
+
+def test_retry_checker_fires_on_unprotected_materializer():
+    src = _src("spark_rapids_tpu/plan/execs/_fixture.py", """
+        def execute_partition(batches, schema):
+            merged = coalesce_to_one(batches)
+            return merged
+    """)
+    vs = retry_discipline.check([src])
+    assert any("coalesce_to_one" in v.message for v in vs)
+
+
+def test_retry_checker_fires_on_unspillable_closure():
+    src = _src("spark_rapids_tpu/plan/execs/_fixture.py", """
+        def execute_partition(batches, run):
+            merged = coalesce_to_one(batches)
+            return with_retry_no_split(lambda: run(merged))
+    """)
+    vs = retry_discipline.check([src])
+    assert any("closes over unspillable local 'merged'" in v.message
+               for v in vs)
+
+
+def test_retry_checker_accepts_protected_idiom():
+    """The repo idiom: materializer inside the retry lambda, and inside a
+    helper referenced only from retry lambdas."""
+    src = _src("spark_rapids_tpu/plan/execs/_fixture.py", """
+        class Exec:
+            def _run(self, batches):
+                return coalesce_to_one(batches)
+
+            def execute_partition(self, batches):
+                return with_retry_no_split(lambda: self._run(batches))
+    """)
+    assert retry_discipline.check([src]) == []
+
+
+def test_host_sync_checker_fires_on_each_form():
+    src = _src("spark_rapids_tpu/kernels/_fixture.py", """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def hot_path(col, batch):
+            n = int(jnp.max(col.data))
+            x = jax.device_get(col.data)
+            col.data.block_until_ready()
+            buf = np.asarray(col.offsets)
+            out = []
+            for c in batch.columns:
+                out.append(c.to_numpy(4))
+            return n, x, buf, out
+    """)
+    msgs = [v.message for v in host_sync.check([src])]
+    assert any("hidden scalar sync" in m for m in msgs)
+    assert any("device_get" in m for m in msgs)
+    assert any("block_until_ready" in m for m in msgs)
+    assert any("downloads it synchronously" in m for m in msgs)
+    assert any("inside a loop" in m for m in msgs)
+
+
+def test_lock_checker_fires_on_blocking_and_order():
+    src = _src("spark_rapids_tpu/shuffle/_fixture.py", """
+        import threading
+        import time
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def sleep_under_lock():
+            with _a:
+                time.sleep(1)
+
+        def order_ab():
+            with _a:
+                with _b:
+                    pass
+
+        def order_ba():
+            with _b:
+                with _a:
+                    pass
+    """)
+    msgs = [v.message for v in locks.check([src])]
+    assert any("sleep" in m and "while holding" in m for m in msgs)
+    assert any("inconsistent lock order" in m for m in msgs)
+
+
+def test_lock_checker_fires_on_callback_under_lock():
+    src = _src("spark_rapids_tpu/shuffle/_fixture.py", """
+        import threading
+
+        class Conn:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def roundtrip(self, send):
+                with self._lock:
+                    return send()
+    """)
+    vs = locks.check([src])
+    assert any("callback parameter 'send'" in v.message for v in vs)
+
+
+def test_lock_checker_fires_on_self_deadlock():
+    src = _src("spark_rapids_tpu/io/_fixture.py", """
+        import threading
+
+        _a = threading.Lock()
+
+        def recurse():
+            with _a:
+                with _a:
+                    pass
+    """)
+    vs = locks.check([src])
+    assert any("self-deadlock" in v.message for v in vs)
+
+
+# -- suppression mechanics ---------------------------------------------------
+
+def test_suppression_requires_a_reason():
+    src = _src("spark_rapids_tpu/kernels/_fixture.py", """
+        import jax
+
+        def f(x):
+            # tpu-lint: allow-host-sync()
+            return jax.device_get(x)
+    """)
+    assert any(p[1].startswith("allow-host-sync")
+               for p in src.suppression_problems)
+    # and the reasonless comment does NOT suppress
+    vs = _unsuppressed(host_sync.check([src]), src)
+    assert vs
+
+
+def test_suppression_with_reason_suppresses():
+    src = _src("spark_rapids_tpu/kernels/_fixture.py", """
+        import jax
+
+        def f(x):
+            # tpu-lint: allow-host-sync(documented single batched sync)
+            return jax.device_get(x)
+    """)
+    assert _unsuppressed(host_sync.check([src]), src) == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    entries = {"host-sync|a.py|f|m": {
+        "fingerprint": "host-sync|a.py|f|m", "rule": "host-sync",
+        "file": "a.py", "scope": "f", "message": "m",
+        "reason": "reviewed: historical"}}
+    lint_core.save_baseline(entries, path)
+    loaded = lint_core.load_baseline(path)
+    assert loaded == entries
+    v = lint_core.Violation("host-sync", "a.py", 3, "f", "m")
+    fresh, stale = lint_core.apply_baseline([v], loaded)
+    assert fresh == [] and stale == []
+    fresh, stale = lint_core.apply_baseline([], loaded)
+    assert stale == ["host-sync|a.py|f|m"]
+
+
+# -- regression pins: real defects found by the linter were FIXED ------------
+# Each fixture is the PRE-FIX shape of real repo code; the checker must
+# fire on it, and the fixed file must be clean WITHOUT a baseline entry.
+
+def test_filecache_io_under_lock_was_fixed():
+    pre_fix = _src("spark_rapids_tpu/io/filecache.py", """
+        import os
+        import threading
+
+        _lock = threading.Lock()
+        _metrics = {"hits": 0, "misses": 0}
+
+        def cached_path(entry):
+            with _lock:
+                if os.path.exists(entry):
+                    _metrics["hits"] += 1
+                    os.utime(entry)
+                    return entry
+                _metrics["misses"] += 1
+            return None
+    """)
+    assert any("filesystem IO" in v.message for v in locks.check([pre_fix]))
+    real = lint_core.load_source(REPO, "spark_rapids_tpu/io/filecache.py")
+    assert _unsuppressed(locks.check([real]), real) == []
+
+
+def test_pooled_connection_socket_io_under_lock_was_fixed():
+    pre_fix = _src("spark_rapids_tpu/shuffle/net.py", """
+        import socket
+        import threading
+
+        class PooledConnection:
+            def __init__(self, addr):
+                self._lock = threading.Lock()
+                self._sock = None
+
+            def _connect(self):
+                self._sock = socket.create_connection(self.addr)
+                return self._sock
+
+            def _roundtrip(self, send, recv):
+                with self._lock:
+                    sock = self._sock or self._connect()
+                    send(sock)
+                    return recv(sock)
+    """)
+    msgs = [v.message for v in locks.check([pre_fix])]
+    assert any("socket connect" in m for m in msgs)
+    assert any("callback parameter" in m for m in msgs)
+    real = lint_core.load_source(REPO, "spark_rapids_tpu/shuffle/net.py")
+    assert _unsuppressed(locks.check([real]), real) == []
+
+
+def test_per_column_download_loop_was_fixed():
+    pre_fix = _src("spark_rapids_tpu/expressions/_fixture.py", """
+        def from_batch(batch):
+            cols = []
+            for col in batch.columns:
+                vals, valid = col.to_numpy(3)
+                cols.append((vals, valid))
+            return cols
+    """)
+    assert any("inside a loop" in v.message
+               for v in host_sync.check([pre_fix]))
+    real = lint_core.load_source(REPO,
+                                 "spark_rapids_tpu/expressions/core.py")
+    assert _unsuppressed(host_sync.check([real]), real) == []
+
+
+def test_shuffle_merge_runs_under_retry():
+    """net.py read_iter / transport.py read were fixed to wrap their
+    merge_batches in with_retry_no_split; keep them that way."""
+    for rel in ("spark_rapids_tpu/shuffle/net.py",
+                "spark_rapids_tpu/shuffle/transport.py"):
+        src = lint_core.load_source(REPO, rel)
+        vs = _unsuppressed(retry_discipline.check([src]), src)
+        assert vs == [], f"{rel}:\n" + "\n".join(v.render() for v in vs)
+
+
+def test_retry_over_spillable_is_pin_balanced():
+    """Each retry attempt re-materializes (pin +1) AND unpins before it
+    ends: after an injected OOM + retry the handles are back to pins=0
+    and still spillable.  Naively materializing inside a retry body leaks
+    one pin per extra attempt, permanently unspilling the handles."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.memory.arena import TpuRetryOOM
+    from spark_rapids_tpu.memory.spill import make_spillable
+    from spark_rapids_tpu.plan.execs.coalesce import retry_over_spillable
+
+    def mkbatch(lo):
+        col = DeviceColumn(data=jnp.arange(lo, lo + 4, dtype=jnp.int64),
+                           validity=jnp.ones(4, bool), dtype=T.LONG)
+        return ColumnarBatch((col,), jnp.int32(4),
+                             Schema(("n",), (T.LONG,)))
+
+    handles = [make_spillable(mkbatch(0)), make_spillable(mkbatch(4))]
+    for h in handles:
+        h.unpin()   # make_spillable hands the batch back pinned-or-not;
+                    # normalize to the spillable resting state
+    base_pins = [h._pins for h in handles]
+    attempts = [0]
+
+    def body(merged):
+        attempts[0] += 1
+        if attempts[0] == 1:
+            raise TpuRetryOOM("injected mid-attempt")
+        return merged
+
+    out = retry_over_spillable(handles, body)
+    assert attempts[0] == 2
+    assert int(out.num_rows) == 8
+    assert [h._pins for h in handles] == base_pins, "pin leak on retry"
+    # still spillable and re-materializable after the retried attempt
+    assert handles[0].spill_to_host() > 0
+    again = retry_over_spillable(handles, lambda m: m)
+    assert int(again.num_rows) == 8
+    for h in handles:
+        h.close()
+
+
+# -- functional check of the lock fix (handoff semantics) --------------------
+
+def test_pooled_connection_close_does_not_wait_for_inflight():
+    """close() must return while a round-trip is blocked in IO (the old
+    lock-across-IO design deadlocked this for the socket timeout)."""
+    import threading
+    import time as _time
+
+    from spark_rapids_tpu.shuffle.net import PooledConnection
+
+    conn = PooledConnection(("127.0.0.1", 1))
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_send(sock):
+        started.set()
+        release.wait(5.0)
+
+    def fake_recv(sock):
+        return None
+
+    class _FakeSock:
+        def close(self):
+            pass
+
+    def run():
+        sock = conn._checkout()
+        try:
+            slow_send(_FakeSock())
+        finally:
+            conn._checkin(_FakeSock())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(5.0)
+    t0 = _time.monotonic()
+    conn.close()                      # must not block on the in-flight IO
+    assert _time.monotonic() - t0 < 1.0
+    release.set()
+    t.join(5.0)
+    assert not t.is_alive()
+    # the in-flight socket was checked in after close() latched: dropped
+    assert conn._sock is None

@@ -1,0 +1,43 @@
+"""tpu-lint: AST-based invariant checkers for the repro's hard contracts.
+
+The reference enforces its hardest invariants with dedicated tooling
+rather than review (RmmRapidsRetryIterator discipline via tests,
+TypeChecks-generated supported_ops.md, ApiValidation drift detection).
+This package is the analog for this repo: four checkers over the
+stdlib-``ast`` tree plus the live registries, wired into tier-1 through
+tests/test_lint.py so new violations fail the suite.
+
+Rules (see docs/linting.md):
+
+  retry-discipline   device-memory-materializing calls (merge_batches,
+                     batch concats) reachable only under the
+                     memory/retry.py wrappers; retry bodies must not
+                     close over unspillable locals
+  host-sync          no device->host syncs (jax.device_get,
+                     block_until_ready, int()/float() on device scalars,
+                     per-column download loops) in expression/kernel/
+                     exec hot paths
+  lock-order         consistent lock acquisition order across modules;
+                     no socket/subprocess/file/device-sync calls while
+                     holding a lock
+  drift              docs/supported_ops.md byte-matches its generator,
+                     every planner/overrides.py registration has a
+                     planner/typesig.py row, tools/api_check.py is clean
+                     against its snapshot
+
+Suppression: ``# tpu-lint: allow-<rule>(reason)`` inline on the flagged
+line (or alone on the line above); pre-existing debt lives in
+tools/generated_files/tpulint_baseline.json with a reviewed reason per
+entry.
+
+Run: ``python -m tools.tpulint [--update-baseline]``
+"""
+from tools.tpulint.core import (  # noqa: F401
+    BASELINE_PATH,
+    Violation,
+    load_baseline,
+    run_all,
+    save_baseline,
+)
+
+RULES = ("retry-discipline", "host-sync", "lock-order", "drift")

@@ -1,0 +1,87 @@
+"""CLI: ``python -m tools.tpulint [--update-baseline] [--rules a,b] [--no-drift]``.
+
+Exit status 0 when every violation is either inline-suppressed or
+baselined; 1 otherwise.  ``--update-baseline`` rewrites the baseline to
+the current violation set (existing reasons preserved, new entries get a
+``TODO: review`` placeholder to be replaced during review, stale entries
+pruned) and exits 0.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tools.tpulint.core import (
+    BASELINE_PATH,
+    PLACEHOLDER_REASON,
+    REPO,
+    apply_baseline,
+    load_baseline,
+    run_all,
+    save_baseline,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tools.tpulint")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current violations")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--no-drift", action="store_true",
+                        help="skip the registry/doc/API drift checker "
+                        "(the one that imports the live package)")
+    parser.add_argument("--baseline", default=BASELINE_PATH)
+    args = parser.parse_args(argv)
+
+    rules = args.rules.split(",") if args.rules else None
+    violations = run_all(REPO, rules=rules, with_drift=not args.no_drift)
+    baseline = load_baseline(args.baseline)
+
+    if args.update_baseline:
+        entries = {}
+        for v in violations:
+            old = baseline.get(v.fingerprint)
+            entries[v.fingerprint] = {
+                "fingerprint": v.fingerprint,
+                "rule": v.rule,
+                "file": v.file,
+                "scope": v.scope,
+                "message": v.message,
+                "reason": (old or {}).get("reason", PLACEHOLDER_REASON),
+            }
+        save_baseline(entries, args.baseline)
+        todo = sum(1 for e in entries.values()
+                   if e["reason"] == PLACEHOLDER_REASON)
+        print(f"baseline updated: {len(entries)} entries "
+              f"({todo} need review) -> {args.baseline}")
+        return 0
+
+    fresh, stale = apply_baseline(violations, baseline)
+    for fp in stale:
+        print(f"note: stale baseline entry (no longer fires): {fp}")
+    todo = [e for e in baseline.values()
+            if e.get("reason", "") in ("", PLACEHOLDER_REASON)]
+    for e in todo:
+        print(f"warning: baseline entry without a reviewed reason: "
+              f"{e['fingerprint']}")
+    if fresh:
+        print(f"tpu-lint: {len(fresh)} violation(s):")
+        for v in sorted(fresh, key=lambda v: (v.file, v.line)):
+            print("  " + v.render())
+        print("\nfix the code, add `# tpu-lint: allow-<rule>(reason)`, or "
+              "run `python -m tools.tpulint --update-baseline` and review "
+              "the new entries.")
+        return 1
+    n = len(violations)
+    print(f"tpu-lint OK ({n} baselined, {len(stale)} stale, "
+          f"{len(todo)} unreviewed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""Shared tpu-lint machinery: violations, suppressions, baseline, runner.
+
+Design notes:
+
+* Violations fingerprint by (rule, file, scope, message) — no line
+  numbers, so unrelated edits above a baselined site don't churn the
+  baseline file.  Two byte-identical violations in one scope share a
+  fingerprint; one baseline entry covers both (acceptable for a linter
+  whose goal is "no NEW debt").
+* Inline suppressions require a reason: ``# tpu-lint: allow-<rule>(why)``
+  on the flagged line, or alone on the line directly above it.  A
+  reasonless suppression is itself reported (rule ``bad-suppression``).
+* The baseline (tools/generated_files/tpulint_baseline.json) holds
+  reviewed pre-existing debt.  ``--update-baseline`` preserves existing
+  reasons, adds new entries with a ``TODO: review`` placeholder, and
+  prunes entries that no longer fire; tests/test_lint.py refuses a
+  committed baseline containing placeholders.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(REPO, "tools", "generated_files",
+                             "tpulint_baseline.json")
+PLACEHOLDER_REASON = "TODO: review"
+
+_ALLOW_RE = re.compile(
+    r"#\s*tpu-lint:\s*allow-([a-z0-9-]+)\s*\(([^)]*)\)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str          # e.g. "retry-discipline"
+    file: str          # repo-relative, "/"-separated
+    line: int          # 1-based; informational only (not fingerprinted)
+    scope: str         # qualified enclosing def ("Class.method") or "<module>"
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.file}|{self.scope}|{self.message}"
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: [{self.rule}] {self.scope}: "
+                f"{self.message}")
+
+
+@dataclass
+class SourceFile:
+    """One parsed python source handed to each AST checker."""
+    path: str                       # repo-relative
+    text: str
+    lines: List[str]
+    tree: ast.AST
+    #: line -> list of (rule, reason) suppressions covering that line
+    allows: Dict[int, List[Tuple[str, str]]] = field(default_factory=dict)
+
+    def allowed(self, rule: str, line: int) -> bool:
+        return any(r == rule for r, _ in self.allows.get(line, ()))
+
+
+def _parse_allows(lines: List[str]) -> Tuple[Dict[int, List[Tuple[str, str]]],
+                                             List[Tuple[int, str]]]:
+    """Return ({line: [(rule, reason)]}, [(line, problem)]).
+
+    A comment-only line's suppression covers the NEXT line (the flagged
+    statement); an end-of-line comment covers its own line.
+    """
+    allows: Dict[int, List[Tuple[str, str]]] = {}
+    problems: List[Tuple[int, str]] = []
+    for i, raw in enumerate(lines, start=1):
+        for m in _ALLOW_RE.finditer(raw):
+            rule, reason = m.group(1), m.group(2).strip()
+            if not reason:
+                problems.append(
+                    (i, f"allow-{rule} suppression without a reason"))
+                continue
+            target = i + 1 if raw.lstrip().startswith("#") else i
+            allows.setdefault(target, []).append((rule, reason))
+    return allows, problems
+
+
+def load_source(repo_root: str, rel_path: str) -> Optional[SourceFile]:
+    abs_path = os.path.join(repo_root, rel_path)
+    try:
+        with open(abs_path, encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=rel_path)
+    except (OSError, SyntaxError):
+        return None
+    lines = text.splitlines()
+    allows, problems = _parse_allows(lines)
+    src = SourceFile(path=rel_path.replace(os.sep, "/"), text=text,
+                     lines=lines, tree=tree, allows=allows)
+    src.suppression_problems = problems  # type: ignore[attr-defined]
+    return src
+
+
+def iter_py_files(repo_root: str, top: str = "spark_rapids_tpu") -> \
+        Iterable[str]:
+    base = os.path.join(repo_root, top)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.relpath(os.path.join(dirpath, name),
+                                      repo_root).replace(os.sep, "/")
+
+
+# -- scope helper shared by the AST checkers ---------------------------------
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor tracking the qualified name of the enclosing def."""
+
+    def __init__(self):
+        self._names: List[str] = []
+
+    @property
+    def scope(self) -> str:
+        return ".".join(self._names) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._names.append(node.name)
+        self.generic_visit(node)
+        self._names.pop()
+
+    def _visit_def(self, node):
+        self._names.append(node.name)
+        self.generic_visit(node)
+        self._names.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+
+def callee_dotted(call: ast.Call) -> str:
+    """Best-effort dotted name of a call's callee ("jax.device_get",
+    "self._run", "merge_batches"); "" when dynamic."""
+    return dotted(call.func)
+
+
+def dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("<expr>")
+    return ".".join(reversed(parts))
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: str = BASELINE_PATH) -> Dict[str, dict]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("entries", [])}
+
+
+def save_baseline(entries: Dict[str, dict],
+                  path: str = BASELINE_PATH) -> None:
+    data = {
+        "comment": ("tpu-lint baseline: reviewed pre-existing debt. "
+                    "Every entry needs a real reason; fix the code or "
+                    "review+justify, never ship 'TODO: review'."),
+        "entries": sorted(entries.values(),
+                          key=lambda e: e["fingerprint"]),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+
+
+# -- runner ------------------------------------------------------------------
+
+def run_all(repo_root: str = REPO,
+            rules: Optional[Iterable[str]] = None,
+            with_drift: bool = True) -> List[Violation]:
+    """Run every enabled checker; returns raw violations (inline
+    suppressions already applied, baseline NOT yet applied)."""
+    from tools.tpulint import drift, host_sync, locks, retry_discipline
+
+    enabled = set(rules) if rules else None
+
+    def on(rule: str) -> bool:
+        return enabled is None or rule in enabled
+
+    sources: List[SourceFile] = []
+    violations: List[Violation] = []
+    for rel in iter_py_files(repo_root):
+        src = load_source(repo_root, rel)
+        if src is None:
+            continue
+        sources.append(src)
+        for line, problem in src.suppression_problems:
+            violations.append(Violation("bad-suppression", src.path,
+                                        line, "<module>", problem))
+
+    checkers: List[Tuple[str, Callable[[List[SourceFile]],
+                                       List[Violation]]]] = [
+        ("retry-discipline", retry_discipline.check),
+        ("host-sync", host_sync.check),
+        ("lock-order", locks.check),
+    ]
+    for rule, fn in checkers:
+        if on(rule):
+            violations.extend(fn(sources))
+    if with_drift and on("drift"):
+        violations.extend(drift.check(repo_root))
+
+    by_path = {s.path: s for s in sources}
+    out = []
+    for v in violations:
+        src = by_path.get(v.file)
+        if src is not None and src.allowed(v.rule, v.line):
+            continue
+        out.append(v)
+    return out
+
+
+def apply_baseline(violations: List[Violation],
+                   baseline: Dict[str, dict]) -> Tuple[List[Violation],
+                                                       List[str]]:
+    """Split into (new violations, stale baseline fingerprints)."""
+    fps = {v.fingerprint for v in violations}
+    fresh = [v for v in violations if v.fingerprint not in baseline]
+    stale = sorted(fp for fp in baseline if fp not in fps)
+    return fresh, stale

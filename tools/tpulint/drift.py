@@ -1,0 +1,109 @@
+"""registry/doc/API drift checker.
+
+The reference generates docs from code (TypeChecks -> supported_ops.md,
+RapidsConf -> configs.md) and validates its API surface against shims
+(ApiValidation) precisely so the three can never silently diverge.  This
+checker wires the same guarantees into tier-1:
+
+  * docs/supported_ops.md and docs/configs.md must byte-match what
+    tools/generate_docs.py emits from the live registries;
+  * every expression class registered in planner/overrides.py
+    (_SUPPORTED_EXPRS) must have a planner/typesig.py signature row —
+    an op the tagging pass accepts but the TypeSig table doesn't know is
+    exactly the drift TypeChecks exists to prevent;
+  * tools/api_check.py must be clean against its committed
+    api_surface.json snapshot.
+
+This checker imports the live package (unlike the AST checkers), so it
+forces the CPU backend first — lint must never wait on a TPU runtime.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+from tools.tpulint.core import Violation
+
+RULE = "drift"
+
+
+def _force_cpu() -> None:
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass   # already initialized by the host process (tests do this)
+
+
+def check(repo_root: str) -> List[Violation]:
+    _force_cpu()
+    out: List[Violation] = []
+    out.extend(_check_generated_docs(repo_root))
+    out.extend(_check_typesig_rows())
+    out.extend(_check_api_surface(repo_root))
+    return out
+
+
+def _check_generated_docs(repo_root: str) -> List[Violation]:
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "tpulint_generate_docs",
+        os.path.join(repo_root, "tools", "generate_docs.py"))
+    gd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gd)
+
+    from spark_rapids_tpu.config import generate_config_docs
+
+    out: List[Violation] = []
+    for rel, want in (("docs/supported_ops.md", gd.generate_supported_ops()),
+                      ("docs/configs.md", generate_config_docs())):
+        path = os.path.join(repo_root, rel)
+        have = None
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                have = f.read()
+        if have != want:
+            out.append(Violation(
+                RULE, rel, 1, "<generated>",
+                f"{rel} does not match tools/generate_docs.py output; "
+                f"run `python tools/generate_docs.py`"))
+    return out
+
+
+def _check_typesig_rows() -> List[Violation]:
+    from spark_rapids_tpu.planner import overrides as O
+    from spark_rapids_tpu.planner import typesig
+
+    out: List[Violation] = []
+    for cls in sorted(O._SUPPORTED_EXPRS, key=lambda c: c.__name__):
+        if typesig.sig_for(cls) is None:
+            out.append(Violation(
+                RULE, "spark_rapids_tpu/planner/typesig.py", 1,
+                "_build_registry",
+                f"{cls.__name__} is registered in planner/overrides.py "
+                f"but has no typesig row"))
+    return out
+
+
+def _check_api_surface(repo_root: str) -> List[Violation]:
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "tpulint_api_check",
+        os.path.join(repo_root, "tools", "api_check.py"))
+    ac = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ac)
+
+    snapshot = os.path.join(repo_root, "tools", "generated_files",
+                            "api_surface.json")
+    if not os.path.exists(snapshot):
+        return [Violation(RULE, "tools/generated_files/api_surface.json", 1,
+                          "<generated>",
+                          "api surface snapshot missing; run "
+                          "`python tools/api_check.py --update`")]
+    with open(snapshot, encoding="utf-8") as f:
+        recorded = json.load(f)
+    problems = ac.diff_surface(recorded, ac.current_surface())
+    return [Violation(RULE, "tools/generated_files/api_surface.json", 1,
+                      "<api>", f"api surface drift: {p}")
+            for p in problems]

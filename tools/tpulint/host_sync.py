@@ -1,0 +1,131 @@
+"""host-sync hygiene checker.
+
+A device->host sync stalls the XLA dispatch pipeline: every queued kernel
+must drain before the scalar/buffer arrives, so one stray sync in an
+expression/kernel/exec hot path serializes the whole operator graph (the
+reference's equivalent sin is calling .getRowCount on an unmaterialized
+cuDF column per batch).  Flagged forms:
+
+  (a) ``jax.device_get(...)`` / ``.block_until_ready()`` anywhere in a
+      hot-path module — legitimate single batched syncs carry an inline
+      ``# tpu-lint: allow-host-sync(reason)``;
+  (b) ``int()/float()/bool()`` coercions whose argument contains a
+      ``jnp.*`` call or a known device-scalar producer
+      (``max_live_string_bytes``) — a hidden scalar sync; per-column
+      loops of these were the repro's worst dispatch stalls;
+  (c) ``np.asarray/np.array`` over DeviceColumn buffers (``.data``,
+      ``.validity``, ``.offsets``, ``.child_validity``) — a full buffer
+      download;
+  (d) per-column download loops: ``.to_numpy(`` / ``.to_pylist(``
+      lexically inside a for/while body — batch the downloads into one
+      ``jax.device_get`` of the whole pytree instead.
+
+Scope: expressions/, kernels/, plan/ (execs + fused engine), parallel/.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.tpulint.core import ScopedVisitor, SourceFile, Violation, dotted
+
+RULE = "host-sync"
+
+SCOPE_PREFIXES = (
+    "spark_rapids_tpu/expressions/",
+    "spark_rapids_tpu/kernels/",
+    "spark_rapids_tpu/plan/",
+    "spark_rapids_tpu/parallel/",
+)
+
+DEVICE_SCALAR_FNS = {"max_live_string_bytes", "max_live_bytes_multi"}
+DEVICE_BUFFER_ATTRS = {"data", "validity", "offsets", "child_validity"}
+COLUMN_DOWNLOADERS = {"to_numpy", "to_pylist"}
+
+
+def in_scope(path: str) -> bool:
+    return path.startswith(SCOPE_PREFIXES)
+
+
+def _contains_jnp_call(node: ast.AST) -> str:
+    """Dotted name of the first jnp./jax.lax./device-scalar call under
+    node, else ""."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = dotted(sub.func)
+        if name.startswith(("jnp.", "jax.numpy.", "jax.lax.")):
+            return name
+        if name.rsplit(".", 1)[-1] in DEVICE_SCALAR_FNS:
+            return name
+    return ""
+
+
+def _contains_device_buffer(node: ast.AST) -> str:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and \
+                sub.attr in DEVICE_BUFFER_ATTRS:
+            return sub.attr
+    return ""
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, src: SourceFile):
+        super().__init__()
+        self.src = src
+        self.out: List[Violation] = []
+        self.loop_depth = 0
+
+    def _emit(self, line: int, message: str) -> None:
+        self.out.append(Violation(RULE, self.src.path, line, self.scope,
+                                  message))
+
+    def _visit_loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_Call(self, node: ast.Call):
+        name = dotted(node.func)
+        bare = name.rsplit(".", 1)[-1]
+        if name.endswith("jax.device_get") or name == "jax.device_get":
+            self._emit(node.lineno,
+                       "jax.device_get stalls the dispatch pipeline; "
+                       "batch it or move it off the hot path")
+        elif bare == "block_until_ready":
+            self._emit(node.lineno,
+                       ".block_until_ready() forces a full device sync")
+        elif bare in ("int", "float", "bool") and "." not in name \
+                and len(node.args) == 1:
+            inner = _contains_jnp_call(node.args[0])
+            if inner:
+                self._emit(node.lineno,
+                           f"{bare}() over device value ({inner}) is a "
+                           f"hidden scalar sync; fold it into one "
+                           f"batched device_get")
+        elif bare in ("asarray", "array") and name.startswith("np."):
+            if node.args:
+                attr = _contains_device_buffer(node.args[0])
+                if attr:
+                    self._emit(node.lineno,
+                               f"np.{bare} over a device buffer "
+                               f"(.{attr}) downloads it synchronously")
+        elif bare in COLUMN_DOWNLOADERS and self.loop_depth > 0:
+            self._emit(node.lineno,
+                       f".{bare}() inside a loop syncs per iteration; "
+                       f"download the whole batch in one device_get")
+        self.generic_visit(node)
+
+
+def check(sources: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for src in sources:
+        if not in_scope(src.path):
+            continue
+        v = _Visitor(src)
+        v.visit(src.tree)
+        out.extend(v.out)
+    return out

@@ -1,0 +1,240 @@
+"""retry-discipline checker.
+
+Contract (memory/retry.py, reference RmmRapidsRetryIterator.scala): any
+call that materializes device memory with a data-dependent footprint —
+``merge_batches`` (wire blocks -> HBM upload), the batch concats — must
+be reachable only under ``with_retry`` / ``with_retry_no_split`` /
+``with_capacity_retry`` so an OOM spills-and-reruns instead of failing
+the query.  Two sub-rules:
+
+  (a) a MATERIALIZER call outside any retry context is a violation.  A
+      call counts as protected when it sits lexically inside an argument
+      to a retry wrapper, or inside a function whose every in-module
+      reference is itself protected (the ``with_retry_no_split(lambda:
+      self._run(batch))`` idiom: ``_run`` bodies are retry bodies).
+  (b) a retry body (lambda or named function passed to a wrapper) must
+      not close over a local that was assigned from a MATERIALIZER in
+      the enclosing scope: on retry the framework can spill registered
+      handles, but a raw materialized batch captured by the closure is
+      unspillable — the retry cannot free the very memory it needs.
+
+Scope: the device hot paths — plan/execs/, plan/fused.py, kernels/, and
+the shuffle data plane (its merge_batches is the biggest single
+allocation in a reduce task).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.tpulint.core import ScopedVisitor, SourceFile, Violation, dotted
+
+RULE = "retry-discipline"
+
+MATERIALIZERS = {
+    "merge_batches",
+    "concat_batches_jit",
+    "concat_batches_device",
+    "coalesce_to_one",
+}
+
+RETRY_WRAPPERS = {"with_retry", "with_retry_no_split", "with_capacity_retry"}
+
+SCOPE_PREFIXES = (
+    "spark_rapids_tpu/plan/execs/",
+    "spark_rapids_tpu/plan/fused.py",
+    "spark_rapids_tpu/kernels/",
+    "spark_rapids_tpu/shuffle/",
+)
+
+
+def in_scope(path: str) -> bool:
+    return path.startswith(SCOPE_PREFIXES)
+
+
+def _bare(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+class _Index(ScopedVisitor):
+    """One pass collecting, per module:
+
+    * function defs (bare name -> scopes defining it)
+    * every reference to a bare name, annotated with (enclosing function
+      chain, whether the reference sits inside a retry-wrapper argument)
+    * MATERIALIZER call sites with the same annotations
+    * retry wrapper calls (for closure hygiene)
+    """
+
+    def __init__(self, src: SourceFile):
+        super().__init__()
+        self.src = src
+        #: stack of ast function nodes enclosing the visit point
+        self.fn_stack: List[ast.AST] = []
+        #: depth of enclosing retry-wrapper-call argument subtrees
+        self.retry_arg_depth = 0
+        self.defs: Set[str] = set()
+        # bare name -> list of (protected_lexically, enclosing_fn_names)
+        self.refs: Dict[str, List[dict]] = {}
+        self.mat_calls: List[dict] = []
+        self.retry_calls: List[dict] = []
+
+    def _fn_names(self) -> List[str]:
+        out = []
+        for f in self.fn_stack:
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(f.name)
+        return out
+
+    def _visit_def(self, node):
+        self.defs.add(node.name)
+        self.fn_stack.append(node)
+        ScopedVisitor._visit_def(self, node)
+        self.fn_stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self.fn_stack.append(node)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    def visit_Call(self, node: ast.Call):
+        name = _bare(dotted(node.func))
+        if name in RETRY_WRAPPERS:
+            self.retry_calls.append({
+                "node": node, "scope": self.scope,
+                "enclosing_fn": self.fn_stack[-1] if self.fn_stack else None,
+            })
+            # the callee itself is not a protected region; its arguments are
+            for sub in node.args + [kw.value for kw in node.keywords]:
+                self.retry_arg_depth += 1
+                self.visit(sub)
+                self.retry_arg_depth -= 1
+            self.visit(node.func)
+            return
+        if name in MATERIALIZERS:
+            self.mat_calls.append({
+                "node": node, "name": name, "scope": self.scope,
+                "line": node.lineno,
+                "protected": self.retry_arg_depth > 0,
+                "fns": self._fn_names(),
+            })
+        self._record_ref(node.func)
+        for sub in node.args + [kw.value for kw in node.keywords]:
+            self.visit(sub)
+        self.visit(node.func)
+
+    def _record_ref(self, func: ast.AST) -> None:
+        name = _bare(dotted(func))
+        if not name:
+            return
+        self.refs.setdefault(name, []).append({
+            "protected": self.retry_arg_depth > 0,
+            "fns": list(self._fn_names()),
+        })
+
+    def visit_Name(self, node: ast.Name):
+        # a bare function name passed around (e.g. with_retry(inputs, fn))
+        if isinstance(node.ctx, ast.Load):
+            self.refs.setdefault(node.id, []).append({
+                "protected": self.retry_arg_depth > 0,
+                "fns": list(self._fn_names()),
+            })
+
+
+def _protected_functions(idx: _Index) -> Set[str]:
+    """Least fixpoint GROUNDED in lexical evidence: a function is
+    retry-protected when it has at least one reference and EVERY
+    reference is either lexically inside a retry-wrapper argument or
+    inside an already-protected function.  Starting pessimistic matters:
+    an optimistic start lets mutually-recursive clusters with no actual
+    retry root (execute_partition <-> _execute_out_of_core) vouch for
+    each other and hide real violations."""
+    protected: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name in sorted(idx.defs - protected):
+            refs = idx.refs.get(name)
+            if not refs:
+                continue
+            if all(r["protected"]
+                   or any(fn in protected for fn in r["fns"])
+                   for r in refs):
+                protected.add(name)
+                changed = True
+    return protected
+
+
+def _closure_violations(idx: _Index, src: SourceFile) -> List[Violation]:
+    out = []
+    for rc in idx.retry_calls:
+        call: ast.Call = rc["node"]
+        encl = rc["enclosing_fn"]
+        if encl is None:
+            continue
+        # names assigned from a MATERIALIZER anywhere in the enclosing fn
+        mat_locals: Dict[str, str] = {}
+        for stmt in ast.walk(encl):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            value = stmt.value
+            # unpack `merged, _ = concat_batches_device(...)` too
+            if isinstance(value, ast.Call) and \
+                    _bare(dotted(value.func)) in MATERIALIZERS:
+                for tgt in stmt.targets:
+                    for leaf in ast.walk(tgt):
+                        if isinstance(leaf, ast.Name):
+                            mat_locals[leaf.id] = _bare(dotted(value.func))
+        if not mat_locals:
+            continue
+        for arg in call.args + [kw.value for kw in call.keywords]:
+            if not isinstance(arg, ast.Lambda):
+                continue
+            bound = {a.arg for a in arg.args.args}
+            for leaf in ast.walk(arg.body):
+                if isinstance(leaf, ast.Name) and \
+                        isinstance(leaf.ctx, ast.Load) and \
+                        leaf.id in mat_locals and leaf.id not in bound:
+                    out.append(Violation(
+                        RULE, src.path, arg.lineno, rc["scope"],
+                        f"retry body closes over unspillable local "
+                        f"'{leaf.id}' (result of {mat_locals[leaf.id]}); "
+                        f"pass a spillable handle instead"))
+    return out
+
+
+def check(sources: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for src in sources:
+        if not in_scope(src.path):
+            continue
+        idx = _Index(src)
+        idx.visit(src.tree)
+        protected = _protected_functions(idx)
+        for mc in idx.mat_calls:
+            if mc["protected"]:
+                continue
+            if any(fn in protected for fn in mc["fns"]):
+                continue
+            # a materializer's own definition delegating to another
+            # materializer is the callee's responsibility at call sites
+            if any(fn in MATERIALIZERS for fn in mc["fns"]):
+                continue
+            out.append(Violation(
+                RULE, src.path, mc["line"], mc["scope"],
+                f"{mc['name']} materializes device memory outside any "
+                f"with_retry/with_retry_no_split/with_capacity_retry "
+                f"context"))
+        out.extend(_closure_violations(idx, src))
+    # de-dup identical (fingerprint, line) pairs from double visits
+    seen: Set[tuple] = set()
+    uniq = []
+    for v in out:
+        key = (v.fingerprint, v.line)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(v)
+    return uniq

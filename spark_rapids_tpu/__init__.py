@@ -75,8 +75,9 @@ if _cache_dir:
         # load/verify than they save
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # tpu-lint: allow-swallow(compile cache is an optimization; failing import over it would take down every entry point)
     except Exception:
-        pass  # cache is an optimization; never fail import over it
+        pass
 
 from spark_rapids_tpu import types  # noqa: F401
 from spark_rapids_tpu.config import RapidsConf  # noqa: F401

@@ -24,6 +24,7 @@ under a fresh query id (submit()).
 """
 from __future__ import annotations
 
+import logging
 import pickle
 import socketserver
 import threading
@@ -31,7 +32,11 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu.shuffle.net import (
-    ShuffleExecutor, _recv_msg, _send_msg)
+    PeerClient, ShuffleExecutor, _recv_msg, _send_msg)
+from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+from spark_rapids_tpu.utils.retry_budget import RetryBudget
+
+log = logging.getLogger(__name__)
 
 #: conf forced on every executor so distributed planning stays identical
 #: and data-complete (see module doc).  Broadcast joins ARE allowed: the
@@ -52,6 +57,22 @@ _CLUSTER_CONF = {
 class ExecutorLostError(RuntimeError):
     """An executor owing results stopped heartbeating mid-query."""
 
+    def __init__(self, message: str, query_id: int = -1,
+                 lost: Optional[List[str]] = None):
+        super().__init__(message)
+        self.query_id = query_id
+        self.lost = list(lost or [])
+
+
+class TaskRetryableError(RuntimeError):
+    """An executor reported a task failure the driver may retry (fetch
+    failure, injected fault, budget exhaustion) — as opposed to a
+    deterministic query error, which re-raising would only repeat."""
+
+    def __init__(self, message: str, query_id: int = -1):
+        super().__init__(message)
+        self.query_id = query_id
+
 
 class TpuClusterDriver:
     """Driver process object: start, submit queries, close."""
@@ -61,13 +82,22 @@ class TpuClusterDriver:
                  heartbeat_timeout_s: float = 60.0):
         self.conf_map = dict(conf or {})
         self.conf_map.update(_CLUSTER_CONF)
+        from spark_rapids_tpu.config import RapidsConf
+        _rc = RapidsConf(self.conf_map)
         # the driver hosts the shuffle registry too: one address for
         # executors to register against (Plugin.scala:523-536 shape)
         self.shuffle = ShuffleExecutor("driver", serve_registry=True,
                                        role="driver", host=host)
         self.shuffle.registry.timeout_s = heartbeat_timeout_s
+        self.shuffle.registry.exclude_threshold = \
+            _rc.peer_exclude_after_failures
+        #: per-query wall-clock bound across resubmission attempts
+        self.query_deadline_s = _rc.cluster_query_deadline
         self._lock = threading.Lock()
-        self._next_query = 0
+        # query ids start at 1: a standalone next_shuffle_id() sid is a
+        # small integer whose qid slot (sid >> 16) is 0, so qid 0 would
+        # make drop_query(0) collect unrelated standalone shuffles
+        self._next_query = 1
         self._tasks: Dict[str, dict] = {}       # executor_id -> task
         self._results: Dict[int, Dict[str, object]] = {}
         self._expected: Dict[int, List[str]] = {}
@@ -116,14 +146,22 @@ class TpuClusterDriver:
                                   task["plan"])
                 elif op == "task_result":
                     qid = header["query_id"]
+                    err = header.get("error")
+                    if err is not None:
+                        # retryable marks failures worth a scoped
+                        # re-dispatch (fetch/budget/injected faults);
+                        # deterministic query errors stay fatal
+                        result = {"error": err,
+                                  "retryable": bool(
+                                      header.get("retryable", False))}
+                    else:
+                        result = pickle.loads(payload)
                     with driver._lock:
                         # ignore stragglers from aborted attempts: only
                         # queries still awaited accept results
                         if qid in driver._expected:
                             driver._results.setdefault(qid, {})[
-                                header["executor_id"]] = (
-                                header.get("error")
-                                or pickle.loads(payload))
+                                header["executor_id"]] = result
                     _send_msg(self.request, {"ok": True})
                 elif op == "plan_fingerprint":
                     # fail-loudly guard: every rank's canonical physical-
@@ -194,29 +232,84 @@ class TpuClusterDriver:
             f"of {n} executors registered")
 
     def submit(self, logical_plan, timeout_s: float = 300.0,
-               max_retries: int = 1, conf: Optional[Dict[str, str]] = None
-               ) -> list:
+               max_retries: int = 1, conf: Optional[Dict[str, str]] = None,
+               deadline_s: Optional[float] = None) -> list:
         """Dispatch one logical plan to every registered executor; block
         for and combine their row results (rank order).
 
-        Executor-loss recovery: if a rank stops heartbeating while it
-        still owes results, the attempt aborts and the WHOLE query
-        re-dispatches over the surviving executors under a fresh query id
-        (fresh deterministic shuffle ids, so the dead attempt's stale
-        blocks can never satisfy a retry read) — the cluster analog of
-        Spark recomputing lost-shuffle stages, at whole-query granularity.
+        SCOPED recovery under a per-query ``RetryBudget`` (attempts =
+        ``max_retries``, deadline = ``deadline_s`` or
+        spark.rapids.cluster.query.deadline — exhaustion raises a
+        ``RetryBudgetExhausted`` naming the query's budget, never a
+        hang):
+
+        * Executor loss (a rank stops heartbeating while it owes
+          results): the lost executor is EXCLUDED from the registry
+          immediately, its query's shuffle state is invalidated on every
+          survivor (drop_query broadcast — stale blocks can neither leak
+          nor satisfy a retry read), and the query re-dispatches over
+          the SURVIVORS ONLY under a fresh query id (fresh deterministic
+          shuffle ids).
+        * Retryable task failure (fetch failure, budget exhaustion,
+          injected fault — the executor is alive): the attempt's shuffle
+          state is invalidated the same way and the query re-dispatches
+          over the same live set.
+
+        Each recovery path increments its shuffle/stats.py counter
+        (scoped_resubmits / task_retries / executors_excluded /
+        shuffle_invalidations).
         """
-        last: Optional[ExecutorLostError] = None
-        for _attempt in range(max_retries + 1):
-            if last is not None and not \
-                    self.shuffle.registry.peers(workers_only=True):
-                raise last      # no survivors to retry on
+        budget = RetryBudget(
+            "cluster.submit", max_attempts=max_retries,
+            base_delay_s=0.05, max_delay_s=1.0,
+            deadline_s=(deadline_s if deadline_s is not None
+                        else self.query_deadline_s))
+        while True:
             try:
                 return self._submit_once(logical_plan, timeout_s,
-                                          conf_overrides=conf)
+                                         conf_overrides=conf)
             except ExecutorLostError as e:
-                last = e
-        raise last
+                self._recover_lost(e)
+                if not self.shuffle.registry.peers(workers_only=True):
+                    raise      # no survivors to retry on
+                budget.backoff(error=e)
+                SHUFFLE_COUNTERS.add(scoped_resubmits=1)
+                log.warning("query %d: resubmitting over survivors "
+                            "(lost %s)", e.query_id, e.lost)
+            except TaskRetryableError as e:
+                self._invalidate_query(e.query_id)
+                budget.backoff(error=e)
+                SHUFFLE_COUNTERS.add(task_retries=1)
+                log.warning("query %d: retrying after retryable task "
+                            "failure: %s", e.query_id, e)
+
+    def _recover_lost(self, e: ExecutorLostError) -> None:
+        """Scope the next attempt: exclude the lost executors from the
+        registry NOW (don't wait for their records to age out) and
+        invalidate the failed attempt's shuffle state everywhere."""
+        for eid in e.lost:
+            self.shuffle.registry.exclude(eid)
+        SHUFFLE_COUNTERS.add(executors_excluded=len(e.lost))
+        self._invalidate_query(e.query_id)
+
+    def _invalidate_query(self, query_id: int) -> None:
+        """Broadcast drop_query to every live worker's block server (and
+        the driver's own store): the torn-down attempt's shuffles must
+        not leak in the BlockStore, and a resubmitted attempt's reads
+        must never be satisfied by its stale blocks."""
+        if query_id < 0:
+            return
+        dropped = self.shuffle.store.drop_query(query_id)
+        for eid, addr in sorted(
+                self.shuffle.registry.peers(workers_only=True).items()):
+            try:
+                dropped += PeerClient(addr).drop_query(query_id)
+            except OSError as err:
+                # the survivor may be dying too; its loss surfaces via
+                # the next attempt's heartbeat check
+                log.warning("drop_query(%d) to %s failed: %s",
+                            query_id, eid, err)
+        SHUFFLE_COUNTERS.add(shuffle_invalidations=dropped)
 
     def _submit_once(self, logical_plan, timeout_s: float,
                      conf_overrides: Optional[Dict[str, str]] = None
@@ -266,18 +359,29 @@ class TpuClusterDriver:
         if lost:
             raise ExecutorLostError(
                 f"query {qid}: executor(s) {lost} lost mid-query "
-                f"({len(got)}/{world} results)")
+                f"({len(got)}/{world} results)", query_id=qid, lost=lost)
         if len(got) != world:
             raise TimeoutError(
                 f"query {qid}: {len(got)}/{world} executor results")
+        # failures first: a retryable one re-dispatches the query (scoped
+        # — same live executors, invalidated shuffle state, fresh qid)
+        errors = {eid: r for eid, r in got.items()
+                  if isinstance(r, (str, dict))}
+        if errors:
+            detail = "; ".join(
+                f"{eid}: {r['error'] if isinstance(r, dict) else r}"
+                for eid, r in sorted(errors.items()))
+            if any(isinstance(r, dict) and r.get("retryable")
+                   for r in errors.values()):
+                raise TaskRetryableError(
+                    f"query {qid}: retryable task failure(s): {detail}",
+                    query_id=qid)
+            raise RuntimeError(f"query {qid}: executor(s) failed: {detail}")
         # results arrive PARTITION-TAGGED: reassemble partition-major so
         # ordered outputs (range sorts) concatenate into the global order
         tagged: List[tuple] = []
         for eid in executors:
-            r = got[eid]
-            if isinstance(r, str):
-                raise RuntimeError(f"executor {eid} failed: {r}")
-            tagged.extend(r)
+            tagged.extend(got[eid])
         rows: list = []
         for _p, part_rows in sorted(tagged, key=lambda t: t[0]):
             rows.extend(part_rows)

@@ -14,12 +14,58 @@ processes.  Root output is split the same way.
 """
 from __future__ import annotations
 
+import logging
 import pickle
 import time
 import traceback
 from typing import Iterator, Tuple
 
 from spark_rapids_tpu.shuffle.net import _request
+from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+from spark_rapids_tpu.testing.chaos import CHAOS, InjectedFault
+
+log = logging.getLogger(__name__)
+
+
+class HeartbeatPacer:
+    """Backoff/streak accounting for the liveness beat.
+
+    On failure the delay doubles (bounded) so a dead driver isn't
+    hammered; the FIRST failure of a streak and the recovery are each
+    logged ONCE (a tight except-pass loop was the old behavior: silent,
+    full-rate).  The consecutive-failure streak is surfaced as a
+    high-watermark gauge in the cluster stats counters."""
+
+    def __init__(self, base_delay_s: float = 2.0,
+                 max_delay_s: float = 30.0):
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.delay_s = float(base_delay_s)
+        self.streak = 0
+
+    def success(self) -> None:
+        if self.streak:
+            log.info("heartbeat recovered after %d consecutive "
+                     "failure(s)", self.streak)
+        self.streak = 0
+        self.delay_s = self.base_delay_s
+
+    def failure(self, error: BaseException) -> None:
+        self.streak += 1
+        SHUFFLE_COUNTERS.add(heartbeat_failures=1)
+        SHUFFLE_COUNTERS.set_max(heartbeat_failure_streak=self.streak)
+        if self.streak == 1:    # log the TRANSITION, not every beat
+            log.warning("heartbeat failed (backing off up to %.0fs "
+                        "between retries): %s", self.max_delay_s, error)
+        self.delay_s = min(self.delay_s * 2.0, self.max_delay_s)
+
+
+def _is_retryable_task_error(e: BaseException) -> bool:
+    """Failures worth a driver-side scoped re-dispatch: injected faults
+    and the OSError family (connection loss, fetch/budget exhaustion,
+    corrupt blocks, lost peers) — transient by nature.  Anything else is
+    treated as a deterministic query error that a retry would repeat."""
+    return isinstance(e, (InjectedFault, OSError))
 
 
 class _RankFilteredScan:
@@ -140,6 +186,10 @@ def _check_distributable(physical) -> None:
 
 def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
              driver_rpc=None, executor_id: str = None) -> list:
+    # injected task death (chaos site cluster.task): fires BEFORE any
+    # state is built, like a worker dying between pickup and execution;
+    # the driver must recover by scoped re-dispatch, not lose the query
+    CHAOS.raise_if("cluster.task")
     from spark_rapids_tpu.config import RapidsConf
     from spark_rapids_tpu.memory import initialize_memory
     from spark_rapids_tpu.plan.cpu_engine import CpuTable
@@ -281,19 +331,24 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
     # liveness beats independent of task execution (Spark executors
     # heartbeat off the task thread): refresh ONLY the driver-side
     # last-seen stamp — never the local peer view, which a mid-shuffle
-    # replacement could shrink under an in-flight fetch
+    # replacement could shrink under an in-flight fetch.  Failures back
+    # off exponentially and are logged once per streak transition
+    # (HeartbeatPacer); the streak is a gauge in the cluster stats.
     import threading
 
     from spark_rapids_tpu.shuffle.net import PeerClient
     _beat_stop = threading.Event()
 
     def _beat():
+        pacer = HeartbeatPacer()
         while not _beat_stop.is_set():
             try:
+                CHAOS.raise_if("cluster.heartbeat")
                 PeerClient(shuffle_addr).heartbeat(node.executor_id)
-            except Exception:
-                pass
-            _beat_stop.wait(2.0)
+                pacer.success()
+            except Exception as e:  # noqa: BLE001 — pacer logs+accounts
+                pacer.failure(e)
+            _beat_stop.wait(pacer.delay_s)
     threading.Thread(target=_beat, daemon=True).start()
 
     # fatal-diagnostics capture (GpuCoreDumpHandler analog): bundles go
@@ -340,8 +395,9 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
             if pending_cleanup is not None:
                 try:
                     pending_cleanup.cleanup()
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — best-effort drop
+                    log.warning("previous query's shuffle cleanup "
+                                "failed: %s", e)
                 pending_cleanup = None
             try:
                 # refresh the peer view FIRST: reduce-side fetches enumerate
@@ -357,14 +413,18 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
                          {"op": "task_result", "query_id": task["query_id"],
                           "executor_id": node.executor_id},
                          pickle.dumps(rows))
-            except Exception:  # noqa: BLE001 — report, don't kill the worker
+            except Exception as e:  # noqa: BLE001 — report, don't kill
                 crashdump.dump_now("task_failure",
                                    extra={"query_id": task["query_id"],
                                           "error": traceback.format_exc()})
+                # the failed attempt's local shuffle state must not leak
+                # (or satisfy a stale read if this qid ever reappears)
+                node.store.drop_query(task["query_id"])
                 _request(driver_rpc_addr,
                          {"op": "task_result", "query_id": task["query_id"],
                           "executor_id": node.executor_id,
-                          "error": traceback.format_exc()})
+                          "error": traceback.format_exc(),
+                          "retryable": _is_retryable_task_error(e)})
     finally:
         # stop the liveness beat on ANY exit path (a dead driver's
         # ConnectionError must not leak the thread)

@@ -226,6 +226,56 @@ JOIN_ADAPTIVE_ENABLED = conf("spark.rapids.sql.join.adaptive.enabled").doc(
     "ranks could pick different physical shapes for the same plan."
 ).boolean_conf(True)
 
+SHUFFLE_CHECKSUM_ENABLED = conf("spark.rapids.shuffle.checksum.enabled").doc(
+    "Verify every fetched shuffle frame against the CRC computed when its "
+    "map output was stored (utils/checksum.py: CRC32C when available, CRC32 "
+    "otherwise). A mismatch raises a typed BlockCorruptionError and the "
+    "block is re-fetched from the serving peer under the network retry "
+    "budget before the error escalates. Frames always carry a checksum "
+    "slot on the wire (0 = unchecksummed), so toggling this never desyncs "
+    "framing."
+).boolean_conf(True)
+
+SPILL_CHECKSUM_ENABLED = conf(
+    "spark.rapids.memory.spill.checksum.enabled").doc(
+    "Checksum spill files at write time and verify on reload; a mismatch "
+    "raises SpillCorruptionError instead of resurrecting corrupt data as "
+    "wrong query results."
+).boolean_conf(True)
+
+NETWORK_RETRY_MAX_ATTEMPTS = conf(
+    "spark.rapids.network.retry.maxAttempts").doc(
+    "Retries of one RPC/fetch against one peer before the shared "
+    "RetryBudget raises RetryBudgetExhausted (bounded exponential backoff; "
+    "utils/retry_budget.py). Applies to pooled-connection reconnects and "
+    "corrupt-block refetches."
+).int_conf(4)
+
+NETWORK_RETRY_BASE_DELAY = conf(
+    "spark.rapids.network.retry.baseDelay").doc(
+    "First backoff delay in seconds for network retry budgets; doubles per "
+    "retry up to spark.rapids.network.retry.maxDelay."
+).double_conf(0.05)
+
+NETWORK_RETRY_MAX_DELAY = conf(
+    "spark.rapids.network.retry.maxDelay").doc(
+    "Upper bound in seconds on one network-retry backoff sleep."
+).double_conf(2.0)
+
+PEER_EXCLUDE_AFTER_FAILURES = conf(
+    "spark.rapids.shuffle.peer.excludeAfterFailures").doc(
+    "Budget-exhausted fetch failures reported against one peer before the "
+    "heartbeat registry excludes it from the live view (a fresh register() "
+    "clears the record and re-admits a genuinely restarted executor)."
+).int_conf(3)
+
+CLUSTER_QUERY_DEADLINE = conf("spark.rapids.cluster.query.deadline").doc(
+    "Per-query wall-clock deadline in seconds across ALL driver "
+    "resubmission attempts (executor loss, retryable task failures). "
+    "Exhaustion raises RetryBudgetExhausted naming the query's budget "
+    "instead of hanging."
+).double_conf(600.0)
+
 SHUFFLE_COMPLETENESS_TIMEOUT = conf(
     "spark.rapids.shuffle.completenessTimeout").doc(
     "Seconds a cross-process reduce read waits for every declared map "
@@ -489,6 +539,34 @@ class RapidsConf:
     @property
     def shuffle_completeness_timeout(self) -> float:
         return self.get(SHUFFLE_COMPLETENESS_TIMEOUT)
+
+    @property
+    def shuffle_checksum_enabled(self) -> bool:
+        return self.get(SHUFFLE_CHECKSUM_ENABLED)
+
+    @property
+    def spill_checksum_enabled(self) -> bool:
+        return self.get(SPILL_CHECKSUM_ENABLED)
+
+    @property
+    def network_retry_max_attempts(self) -> int:
+        return self.get(NETWORK_RETRY_MAX_ATTEMPTS)
+
+    @property
+    def network_retry_base_delay(self) -> float:
+        return self.get(NETWORK_RETRY_BASE_DELAY)
+
+    @property
+    def network_retry_max_delay(self) -> float:
+        return self.get(NETWORK_RETRY_MAX_DELAY)
+
+    @property
+    def peer_exclude_after_failures(self) -> int:
+        return self.get(PEER_EXCLUDE_AFTER_FAILURES)
+
+    @property
+    def cluster_query_deadline(self) -> float:
+        return self.get(CLUSTER_QUERY_DEADLINE)
 
     @property
     def shuffle_fetch_max_inflight(self) -> int:

@@ -77,6 +77,7 @@ class _MapHigherOrder(Expression):
         mt = None
         try:
             mt = m.dtype
+        # tpu-lint: allow-swallow(dtype probe during tracing; unresolvable inputs fall back to NULL typing below)
         except Exception:
             pass
         kt = mt.key_type if isinstance(mt, T.MapType) else T.NULL

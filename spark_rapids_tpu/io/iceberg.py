@@ -343,6 +343,7 @@ class IcebergWriter:
                     try:
                         lo = pc.min(col).as_py()
                         hi = pc.max(col).as_py()
+                    # tpu-lint: allow-swallow(column stats are optional manifest metadata; scans work without them)
                     except Exception:
                         continue
                     if isinstance(dt, T.DecimalType):

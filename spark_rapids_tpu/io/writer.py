@@ -278,6 +278,7 @@ def write_dataframe(df, path: str, fmt: str = "parquet",
             # writers would orphan files / mask the real error
             try:
                 throttle.wait()
+            # tpu-lint: allow-swallow(drain errors must not mask the original failure being re-raised below)
             except BaseException:
                 pass
         protocol.abort_job()

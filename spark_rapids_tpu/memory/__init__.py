@@ -41,8 +41,19 @@ def initialize_memory(conf) -> None:
     _retry.MAX_RETRIES = conf.retry_max_attempts
     _sem.configure(conf.concurrent_tpu_tasks)
     spill_framework().host_limit_bytes = conf.get(C.HOST_SPILL_STORAGE_SIZE)
-    from spark_rapids_tpu.memory.spill import set_leak_audit
+    from spark_rapids_tpu.memory.spill import set_leak_audit, \
+        set_spill_checksum
     set_leak_audit(conf.get(C.MEMORY_LEAK_AUDIT))
+    set_spill_checksum(conf.spill_checksum_enabled)
+    # integrity/recovery knobs of the shuffle data plane ride the same
+    # conf snapshot (both the session path and the cluster executor's
+    # broadcast-conf path run through here)
+    from spark_rapids_tpu.shuffle.net import (set_checksum_enabled,
+                                              set_network_retry)
+    set_checksum_enabled(conf.shuffle_checksum_enabled)
+    set_network_retry(conf.network_retry_max_attempts,
+                      conf.network_retry_base_delay,
+                      conf.network_retry_max_delay)
     device_arena().check_retry_context = conf.retry_context_check
     # HBM-budget sizing from the chip's memory stats (GpuDeviceManager):
     # always on, like the reference's default-fraction pool sizing —

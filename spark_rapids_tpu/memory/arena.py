@@ -12,14 +12,18 @@ framework to evict device handles in priority order and then (2) raises
 control flow the reference gets from the RMM alloc-failed callback
 (DeviceMemoryEventHandler.scala) + RmmSpark's thread state machine.
 
-The same arena implements the synthetic OOM-injection hooks that the
-differential test oracle relies on (reference: RapidsConf.scala:3041-3083
-``spark.rapids.sql.test.injectRetryOOM``; pytest marker ``@inject_oom``).
+The arena's synthetic OOM-injection hooks (reference:
+RapidsConf.scala:3041-3083 ``spark.rapids.sql.test.injectRetryOOM``;
+pytest marker ``@inject_oom``) keep their API here but route through the
+unified chaos registry (testing/chaos.py, site ``memory.oom``) — one
+deterministic, seedable registry owns every injection point.
 """
 from __future__ import annotations
 
 import threading
 from typing import Callable, List, Optional
+
+from spark_rapids_tpu.testing.chaos import CHAOS
 
 
 class TpuOOM(RuntimeError):
@@ -97,16 +101,6 @@ def translate_device_oom(fn):
     return wrapper
 
 
-class _Injection:
-    """Synthetic-OOM state (reference: RmmSpark OOM injection)."""
-
-    def __init__(self, num_ooms: int, skip: int, kind: str):
-        assert kind in ("retry", "split")
-        self.remaining = num_ooms
-        self.skip = skip
-        self.kind = kind
-
-
 _RETRY_SCOPE = threading.local()
 
 
@@ -144,7 +138,6 @@ class DeviceArena:
         self.check_retry_context = False
         self._lock = threading.RLock()
         self._spill_cb: Optional[Callable[[int], int]] = None
-        self._injection: Optional[_Injection] = None
 
     # -- spill integration ---------------------------------------------------
 
@@ -155,29 +148,24 @@ class DeviceArena:
     # -- OOM injection -------------------------------------------------------
 
     def inject_ooms(self, num_ooms: int, skip: int = 0, kind: str = "retry") -> None:
-        with self._lock:
-            self._injection = _Injection(num_ooms, skip, kind)
+        """Arm the chaos registry's ``memory.oom`` site (the legacy
+        injectRetryOOM surface; one registry owns every fault)."""
+        assert kind in ("retry", "split")
+        CHAOS.install("memory.oom", count=num_ooms, skip=skip, kind=kind)
 
     def clear_injection(self) -> None:
-        with self._lock:
-            self._injection = None
+        CHAOS.clear("memory.oom")
 
     def maybe_throw_injected(self) -> None:
-        """Called from allocation points and retry blocks."""
+        """Called from allocation points and retry blocks.  Fires only
+        inside retry scopes (code outside withRetry has no recovery
+        path), so armed injections never consume hits elsewhere."""
         if not in_retry_scope():
             return
-        with self._lock:
-            inj = self._injection
-            if inj is None:
-                return
-            if inj.skip > 0:
-                inj.skip -= 1
-                return
-            if inj.remaining <= 0:
-                return
-            inj.remaining -= 1
-            kind = inj.kind
-        if kind == "retry":
+        hit = CHAOS.fire("memory.oom")
+        if hit is None:
+            return
+        if hit.get("kind", "retry") == "retry":
             raise TpuRetryOOM("injected retry OOM")
         raise TpuSplitAndRetryOOM("injected split-and-retry OOM")
 

@@ -20,6 +20,10 @@ class TaskMetrics:
     device_oom_count: int = 0   # real XLA RESOURCE_EXHAUSTED translations
     semaphore_wait_ns: int = 0
     op_time_ns: int = 0
+    spill_write_failures: int = 0    # disk spills that failed (survived:
+                                     # the host copy was kept)
+    spill_corruption_errors: int = 0  # spill files that failed their
+                                      # reload checksum (typed error)
 
     def merge(self, other: "TaskMetrics") -> None:
         self.retry_count += other.retry_count
@@ -28,6 +32,8 @@ class TaskMetrics:
         self.device_oom_count += other.device_oom_count
         self.semaphore_wait_ns += other.semaphore_wait_ns
         self.op_time_ns += other.op_time_ns
+        self.spill_write_failures += other.spill_write_failures
+        self.spill_corruption_errors += other.spill_corruption_errors
 
     def as_dict(self) -> Dict[str, int]:
         return dataclasses.asdict(self)

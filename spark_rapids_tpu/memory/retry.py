@@ -14,9 +14,11 @@ Requirements on ``fn`` mirror the reference: it must be idempotent (safe to
 re-run), and its inputs must be spillable handles so a retry can materialize
 them again after a spill.
 
-OOM injection (``@inject_oom`` tests): enable_oom_injection routes to the
-arena's synthetic-OOM state (reference: spark.rapids.sql.test.injectRetryOOM,
-RapidsConf.scala:3041-3083).
+OOM injection (``@inject_oom`` tests): enable_oom_injection arms the
+``memory.oom`` site of the unified chaos registry (testing/chaos.py) via
+the arena (reference: spark.rapids.sql.test.injectRetryOOM,
+RapidsConf.scala:3041-3083) — one deterministic, seedable registry owns
+every fault-injection point in the system.
 """
 from __future__ import annotations
 

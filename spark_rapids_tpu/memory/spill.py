@@ -22,6 +22,8 @@ role HostMemoryBuffer/RapidsDiskBlockManager play in the reference.
 """
 from __future__ import annotations
 
+import io
+import logging
 import os
 import shutil
 import tempfile
@@ -38,6 +40,30 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.memory.arena import device_arena
+from spark_rapids_tpu.memory import metrics as task_metrics
+from spark_rapids_tpu.testing.chaos import CHAOS
+from spark_rapids_tpu.utils.checksum import file_checksum, verify_frame
+
+log = logging.getLogger(__name__)
+
+
+class SpillCorruptionError(IOError):
+    """A spill file's bytes no longer match the checksum recorded when
+    they were written: the batch CANNOT be reloaded (silent storage
+    corruption would otherwise become silently wrong query results)."""
+
+
+#: verify spill files against their write-time checksum on reload
+#: (spark.rapids.memory.spill.checksum.enabled)
+_SPILL_CHECKSUM = [True]
+
+
+def set_spill_checksum(enabled: bool) -> None:
+    _SPILL_CHECKSUM[0] = bool(enabled)
+
+
+def spill_checksum_enabled() -> bool:
+    return _SPILL_CHECKSUM[0]
 
 
 def _batch_to_host(batch: ColumnarBatch) -> Tuple[dict, Schema]:
@@ -105,6 +131,7 @@ class SpillableBatchHandle:
         self._device: Optional[ColumnarBatch] = batch
         self._host: Optional[Tuple[dict, Schema]] = None
         self._disk_path: Optional[str] = None
+        self._disk_crc = 0              # 0 = file not checksummed
         self._schema = batch.schema
         self.priority = priority
         self.last_use = time.monotonic()
@@ -138,15 +165,45 @@ class SpillableBatchHandle:
             return self.size_bytes
 
     def spill_to_disk(self) -> int:
-        """Host -> disk.  Returns host bytes freed."""
+        """Host -> disk.  Returns host bytes freed (0 when not on host
+        or when the write FAILED — a failed spill keeps the host copy, so
+        an IO error degrades host-memory relief, never correctness).
+
+        The npz stream goes straight to disk (no in-memory staging — a
+        spill happens exactly when host memory is short) and the
+        checksum is then computed over the landed bytes in constant
+        memory; ``materialize`` verifies it on reload and raises
+        ``SpillCorruptionError`` on mismatch instead of resurrecting
+        corrupt data."""
         with self._lock:
             if self._host is None or self.closed:
                 return 0
             arrays, _ = self._host
-            fd, path = tempfile.mkstemp(suffix=".npz", dir=self._fw.spill_dir)
-            os.close(fd)
-            np.savez(path, **arrays)
+            path = None
+            try:
+                CHAOS.raise_if("spill.write", OSError)
+                fd, path = tempfile.mkstemp(suffix=".npz",
+                                            dir=self._fw.spill_dir)
+                with os.fdopen(fd, "wb") as f:
+                    np.savez(f, **arrays)
+                crc = (file_checksum(path) if spill_checksum_enabled()
+                       else 0)
+                # chaos corrupts AFTER checksumming: the crc describes
+                # the clean bytes, so reload-time verify must catch it
+                CHAOS.corrupt_file("spill.corrupt", path)
+            except OSError as e:
+                if path is not None:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                self._fw.metrics.write_failures += 1
+                task_metrics.get().spill_write_failures += 1
+                log.warning("spill-to-disk failed (keeping host copy): %s",
+                            e)
+                return 0
             self._disk_path = path
+            self._disk_crc = crc
             freed = sum(a.nbytes for a in arrays.values())
             self._host = None
             self._fw.metrics.spill_to_disk_bytes += freed
@@ -178,11 +235,24 @@ class SpillableBatchHandle:
                 self._pins += 1
                 return self._device
             if self._host is None and self._disk_path is not None:
-                with np.load(self._disk_path) as z:
+                # tpu-lint: allow-lock-order(disk-tier IO has always run under the per-handle lock — np.load did this open internally before checksumming; the lock is handle-granular with no cross-handle order)
+                with open(self._disk_path, "rb") as f:
+                    data = f.read()
+                if not verify_frame(data, self._disk_crc):
+                    self._fw.metrics.corruption_errors += 1
+                    task_metrics.get().spill_corruption_errors += 1
+                    device_arena().release(self.size_bytes)
+                    raise SpillCorruptionError(
+                        f"spill file {self._disk_path} failed its "
+                        f"checksum ({len(data)} bytes, expected crc "
+                        f"{self._disk_crc:#010x}): refusing to "
+                        "resurrect corrupt data")
+                with np.load(io.BytesIO(data)) as z:
                     arrays = {k: z[k] for k in z.files}
                 self._host = (arrays, self._schema)
                 os.unlink(self._disk_path)
                 self._disk_path = None
+                self._disk_crc = 0
                 self._fw.metrics.read_spill_bytes += sum(
                     a.nbytes for a in arrays.values())
             assert self._host is not None
@@ -254,6 +324,8 @@ class SpillMetrics:
         self.spill_to_host_bytes = 0
         self.spill_to_disk_bytes = 0
         self.read_spill_bytes = 0
+        self.write_failures = 0         # disk spills that failed (survived)
+        self.corruption_errors = 0      # spill files that failed verify
 
 
 class SpillFramework:

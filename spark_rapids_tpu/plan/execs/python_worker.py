@@ -39,6 +39,7 @@ def _worker_main(conn, mem_limit_bytes: int) -> None:
             import resource
             resource.setrlimit(resource.RLIMIT_AS,
                                (mem_limit_bytes, mem_limit_bytes))
+    # tpu-lint: allow-swallow(rlimit is best-effort hardening; platforms without RLIMIT_AS still run UDFs)
     except Exception:
         pass
     import io
@@ -117,6 +118,7 @@ class _Worker:
     def close(self) -> None:
         try:
             self.conn.close()
+        # tpu-lint: allow-swallow(teardown of a possibly-dead pipe; the terminate below is the real cleanup)
         except Exception:
             pass
         if self.proc.is_alive():

@@ -40,6 +40,53 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
 from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+from spark_rapids_tpu.testing.chaos import CHAOS
+from spark_rapids_tpu.utils.checksum import frame_checksum, verify_frame
+from spark_rapids_tpu.utils.retry_budget import (
+    RetryBudget, RetryBudgetExhausted)
+
+
+class BlockCorruptionError(OSError):
+    """A fetched shuffle frame failed its checksum.  OSError family so
+    transport-level retry/peer-loss handling covers it without new
+    plumbing; the fetch path re-fetches from the serving peer before
+    letting it escalate."""
+
+
+class PeerLostError(OSError):
+    """A shuffle participant that owes map output is unreachable.
+    OSError family: the cluster layer treats it as retryable (the driver
+    resubmits scoped to survivors)."""
+
+
+#: verify checksums on received frames (spark.rapids.shuffle.checksum
+#: .enabled).  Frames always CARRY a checksum slot on the wire — a crc
+#: of 0 means "not checksummed" — so toggling this never desyncs framing.
+_CHECKSUM = [True]
+
+
+def set_checksum_enabled(enabled: bool) -> None:
+    _CHECKSUM[0] = bool(enabled)
+
+
+def checksum_enabled() -> bool:
+    return _CHECKSUM[0]
+
+
+#: network retry-budget shape (spark.rapids.network.retry.*): retries of
+#: one RPC/fetch against one peer, bounded exponential backoff.
+_NET_BUDGET = {"max_attempts": 4, "base_delay_s": 0.05, "max_delay_s": 2.0}
+
+
+def set_network_retry(max_attempts: int, base_delay_s: float,
+                      max_delay_s: float) -> None:
+    _NET_BUDGET.update(max_attempts=int(max_attempts),
+                       base_delay_s=float(base_delay_s),
+                       max_delay_s=float(max_delay_s))
+
+
+def network_budget(name: str) -> RetryBudget:
+    return RetryBudget(name, **_NET_BUDGET)
 
 
 # -- framing ------------------------------------------------------------------
@@ -52,20 +99,30 @@ def _send_msg(sock: socket.socket, header: dict,
     sock.sendall(struct.pack(">I", len(raw)) + raw + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int, what: str = "",
+                peer=None) -> bytes:
     out = bytearray()
     while len(out) < n:
         chunk = sock.recv(n - len(out))
         if not chunk:
-            raise ConnectionError("peer closed")
+            # name the peer, the progress, and the in-flight request so
+            # a truncated stream is diagnosable from the error alone
+            raise ConnectionError(
+                f"short read{' from ' + repr(peer) if peer else ''}: "
+                f"peer closed after {len(out)}/{n} bytes"
+                + (f" during {what}" if what else ""))
         out.extend(chunk)
     return bytes(out)
 
 
-def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
-    (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
-    header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
-    payload = _recv_exact(sock, header.get("payload_len", 0))
+def _recv_msg(sock: socket.socket, peer=None) -> Tuple[dict, bytes]:
+    (hlen,) = struct.unpack(
+        ">I", _recv_exact(sock, 4, "control header length", peer))
+    header = json.loads(
+        _recv_exact(sock, hlen, "control header", peer).decode("utf-8"))
+    payload = _recv_exact(sock, header.get("payload_len", 0),
+                          f"control payload (op={header.get('op')!r})",
+                          peer)
     return header, payload
 
 
@@ -74,9 +131,11 @@ def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
 # the top bit set can never be a header length.
 #   request:  >I BIN_FETCH | >Q shuffle_id | >I partition | >I nblocks
 #             | nblocks * >I block index
-#   response: >I nblocks | per block (>Q length, raw bytes)
+#   response: >I nblocks | per block (>Q length, >I crc32, raw bytes)
+#             (crc 0 = frame not checksummed; see utils/checksum.py)
 BIN_FETCH = 0xFFFF_FE7C
 _BIN_REQ_FIXED = struct.Struct(">QII")
+_BIN_BLOCK_HDR = struct.Struct(">QI")
 
 
 def _send_fetch_many(sock: socket.socket, shuffle_id: int, partition: int,
@@ -86,12 +145,19 @@ def _send_fetch_many(sock: socket.socket, shuffle_id: int, partition: int,
                  + struct.pack(f">{len(blocks)}I", *blocks))
 
 
-def _recv_fetch_many(sock: socket.socket) -> List[bytes]:
-    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+def _recv_fetch_many(sock: socket.socket,
+                     peer=None, ctx: str = "") -> List[Tuple[bytes, int]]:
+    """Receive the binary fetch response: [(payload, stored crc)]."""
+    CHAOS.raise_if("shuffle.fetch.disconnect", ConnectionResetError)
+    what = f"fetch response{' for ' + ctx if ctx else ''}"
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4, what, peer))
     out = []
-    for _ in range(n):
-        (ln,) = struct.unpack(">Q", _recv_exact(sock, 8))
-        out.append(_recv_exact(sock, ln))
+    for i in range(n):
+        ln, crc = _BIN_BLOCK_HDR.unpack(
+            _recv_exact(sock, _BIN_BLOCK_HDR.size,
+                        f"{what} block {i}/{n} header", peer))
+        out.append((_recv_exact(sock, ln, f"{what} block {i}/{n} "
+                                f"({ln} bytes)", peer), crc))
     return out
 
 
@@ -120,6 +186,7 @@ class PooledConnection:
         self._closed = False
 
     def _connect(self) -> socket.socket:
+        CHAOS.raise_if("shuffle.connect", ConnectionRefusedError)
         sock = socket.create_connection(self.addr, timeout=self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         SHUFFLE_COUNTERS.add(connections_opened=1)
@@ -162,11 +229,19 @@ class PooledConnection:
         silently losing its effect.  The socket is dropped either way, so
         the CALLER's next (distinct) request reconnects cleanly — callers
         of non-retriable ops decide themselves whether a single failure
-        is tolerable (executor_main tolerates one stale-socket poll)."""
+        is tolerable (executor_main tolerates one stale-socket poll).
+
+        Retriable ops retry on a fresh connect under a bounded-backoff
+        ``RetryBudget`` (spark.rapids.network.retry.*); exhaustion raises
+        ``RetryBudgetExhausted`` naming the budget, chained from the last
+        transport error — never an unbounded reconnect loop."""
         sock = self._checkout()
         clean = False
         try:
-            for attempt in ((0, 1) if retriable else (1,)):
+            budget = (network_budget(f"shuffle.rpc:{self.addr[0]}:"
+                                     f"{self.addr[1]}")
+                      if retriable else None)
+            while True:
                 try:
                     if sock is None:
                         sock = self._connect()
@@ -175,12 +250,13 @@ class PooledConnection:
                     clean = True
                     return out
                 except (ConnectionError, OSError, struct.error,
-                        socket.timeout):
+                        socket.timeout) as e:
                     self._close_sock(sock)
                     sock = None
-                    if attempt:
+                    if budget is None:
                         raise
-            raise AssertionError("unreachable")
+                    budget.backoff(error=e)   # raises RetryBudgetExhausted
+                    SHUFFLE_COUNTERS.add(fetch_retries=1)
         finally:
             if not clean and sock is not None:
                 # an exception OUTSIDE the transport-error tuple (e.g. a
@@ -194,28 +270,46 @@ class PooledConnection:
     def request(self, header: dict, payload: bytes = b"",
                 retriable: bool = True) -> Tuple[dict, bytes]:
         return self._roundtrip(
-            lambda s: _send_msg(s, header, payload), _recv_msg,
+            lambda s: _send_msg(s, header, payload),
+            lambda s: _recv_msg(s, peer=self.addr),
             retriable=retriable)
 
     def fetch_many(self, shuffle_id: int, partition: int,
                    blocks: List[int]) -> List[bytes]:
         """Binary hot path: many blocks per round-trip, no JSON.
-        Idempotent, so safe to retry on a fresh connection."""
+        Idempotent, so safe to retry on a fresh connection.  Each frame
+        is verified against its map-side checksum (when enabled); a
+        mismatch raises ``BlockCorruptionError`` — the fetch iterator
+        re-fetches from the serving peer before escalating."""
+        ctx = f"shuffle {shuffle_id} partition {partition}"
         out = self._roundtrip(
             lambda s: _send_fetch_many(s, shuffle_id, partition, blocks),
-            _recv_fetch_many)
+            lambda s: _recv_fetch_many(s, peer=self.addr, ctx=ctx))
         if len(out) != len(blocks):
             # the server drops unknown indices rather than erroring; a
             # short response means the peer lost map output (e.g. a
             # restart the reconnect path papered over) — fail LOUDLY,
-            # silently-partial reduce data is the one unacceptable outcome
-            raise KeyError(
+            # silently-partial reduce data is the one unacceptable outcome.
+            # PeerLostError (OSError family) so the cluster layer treats
+            # it as retryable and resubmits scoped to survivors
+            raise PeerLostError(
                 f"peer {self.addr} returned {len(out)}/{len(blocks)} "
                 f"blocks for shuffle {shuffle_id} partition {partition} "
                 "(map output lost?)")
+        if checksum_enabled():
+            bad = [i for i, (b, crc) in enumerate(out)
+                   if not verify_frame(b, crc)]
+            SHUFFLE_COUNTERS.add(
+                checksums_verified=sum(1 for _, crc in out if crc))
+            if bad:
+                SHUFFLE_COUNTERS.add(checksum_failures=len(bad))
+                raise BlockCorruptionError(
+                    f"checksum mismatch on block(s) {bad} of {ctx} from "
+                    f"peer {self.addr} (frame corrupted in transit or "
+                    "at rest)")
         SHUFFLE_COUNTERS.add(fetch_requests=1, blocks_fetched=len(out),
-                             bytes_fetched=sum(len(b) for b in out))
-        return out
+                             bytes_fetched=sum(len(b) for b, _ in out))
+        return [b for b, _ in out]
 
     def close(self) -> None:
         with self._cv:
@@ -272,17 +366,23 @@ def _request(addr: Tuple[str, int], header: dict, payload: bytes = b"",
 # -- block store + server -----------------------------------------------------
 
 class BlockStore:
-    """Local map-output store: (shuffle_id, partition) -> list of wire
-    blocks.  Thread-safe; shared between the writer and the server."""
+    """Local map-output store: (shuffle_id, partition) -> list of
+    (wire block, checksum).  Thread-safe; shared between the writer and
+    the server.  Checksums are computed ONCE at put() (the map side) and
+    travel with every serve, so re-fetches never recompute them."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._blocks: Dict[Tuple[int, int], List[bytes]] = {}
+        self._blocks: Dict[Tuple[int, int], List[Tuple[bytes, int]]] = {}
         self._complete: set = set()
 
     def put(self, shuffle_id: int, partition: int, block: bytes) -> None:
+        crc = frame_checksum(block) if checksum_enabled() else 0
+        if crc:
+            SHUFFLE_COUNTERS.add(checksums_computed=1)
         with self._lock:
-            self._blocks.setdefault((shuffle_id, partition), []).append(block)
+            self._blocks.setdefault((shuffle_id, partition), []).append(
+                (block, crc))
 
     def mark_complete(self, shuffle_id: int) -> None:
         """Map output for this shuffle is fully written on this node."""
@@ -295,11 +395,17 @@ class BlockStore:
 
     def get(self, shuffle_id: int, partition: int) -> List[bytes]:
         with self._lock:
+            return [b for b, _ in
+                    self._blocks.get((shuffle_id, partition), [])]
+
+    def get_with_crcs(self, shuffle_id: int,
+                      partition: int) -> List[Tuple[bytes, int]]:
+        with self._lock:
             return list(self._blocks.get((shuffle_id, partition), []))
 
     def sizes(self, shuffle_id: int, partition: int) -> List[int]:
         with self._lock:
-            return [len(b) for b in
+            return [len(b) for b, _ in
                     self._blocks.get((shuffle_id, partition), [])]
 
     def drop_shuffle(self, shuffle_id: int) -> None:
@@ -308,16 +414,44 @@ class BlockStore:
                 del self._blocks[k]
             self._complete.discard(shuffle_id)
 
+    def shuffle_ids(self) -> List[int]:
+        with self._lock:
+            return sorted({k[0] for k in self._blocks} | self._complete)
+
+    def drop_query(self, query_id: int) -> int:
+        """Drop every shuffle belonging to a cluster query (deterministic
+        id scheme: sid = query_id << 16 | exchange ordinal — see
+        transport.set_cluster_query).  Returns the number of shuffles
+        dropped; the driver broadcasts this on query teardown so a
+        failed attempt can't leak its blocks (or satisfy a retry read)."""
+        dropped = 0
+        if int(query_id) < 1:
+            # qid slot 0 is where standalone next_shuffle_id() sids live
+            # (sid < 2**16); dropping "query 0" would collect them
+            return 0
+        for sid in self.shuffle_ids():
+            if sid >> 16 == int(query_id):
+                self.drop_shuffle(sid)
+                dropped += 1
+        return dropped
+
 
 class HeartbeatRegistry:
     """Executor discovery: id -> (host, port, last-seen).  The driver-side
     registry; executors poll `peers` to learn about new members
     (RapidsShuffleHeartbeatManager.executorHeartbeat)."""
 
-    def __init__(self, timeout_s: float = 60.0):
+    def __init__(self, timeout_s: float = 60.0,
+                 exclude_threshold: int = 3):
         self._lock = threading.Lock()
         self._peers: Dict[str, Tuple[str, int, float]] = {}
         self.timeout_s = timeout_s
+        #: reported fetch failures after which a peer is excluded from
+        #: the live view (spark.rapids.shuffle.peer.excludeAfterFailures);
+        #: a fresh register() clears the record (a genuinely restarted
+        #: executor may rejoin)
+        self.exclude_threshold = int(exclude_threshold)
+        self._failures: Dict[str, int] = {}
         self._next_shuffle = 0
         # per-shuffle participation: which executors WILL write map output
         # (declared at transport construction) and which have finished.
@@ -366,6 +500,38 @@ class HeartbeatRegistry:
                  role: str = "worker") -> None:
         with self._lock:
             self._peers[executor_id] = (host, port, time.time(), role)
+            self._failures.pop(executor_id, None)
+
+    def report_failure(self, executor_id: str) -> bool:
+        """An executor reported repeated fetch failures against this
+        peer.  After ``exclude_threshold`` reports the peer is dropped
+        from the live view so later reads stop fetching from it (the
+        reference's BlockManager blacklisting role).  Returns True when
+        this report excluded the peer."""
+        with self._lock:
+            n = self._failures.get(executor_id, 0) + 1
+            self._failures[executor_id] = n
+            excluded = (n >= self.exclude_threshold
+                        and executor_id in self._peers)
+            if excluded:
+                del self._peers[executor_id]
+        SHUFFLE_COUNTERS.add(peer_failures_reported=1,
+                             peers_excluded=int(excluded))
+        return excluded
+
+    def exclude(self, executor_id: str) -> bool:
+        """Drop a peer immediately (driver-observed executor loss: don't
+        wait for its heartbeat record to age out before resubmitting).
+        Returns True when the peer was present."""
+        with self._lock:
+            present = executor_id in self._peers
+            if present:
+                del self._peers[executor_id]
+            self._failures[executor_id] = max(
+                self._failures.get(executor_id, 0), self.exclude_threshold)
+        if present:
+            SHUFFLE_COUNTERS.add(peers_excluded=1)
+        return present
 
     def heartbeat(self, executor_id: str) -> None:
         with self._lock:
@@ -409,26 +575,37 @@ class ShuffleBlockServer:
 
             def _serve_one(self) -> bool:
                 try:
-                    first = _recv_exact(self.request, 4)
+                    first = _recv_exact(self.request, 4, "request word",
+                                        self.client_address)
                 except ConnectionError:
                     return False
                 (word,) = struct.unpack(">I", first)
                 if word == BIN_FETCH:
                     sid, part, n = _BIN_REQ_FIXED.unpack(
-                        _recv_exact(self.request, _BIN_REQ_FIXED.size))
-                    idxs = struct.unpack(f">{n}I",
-                                         _recv_exact(self.request, 4 * n))
-                    blocks = outer.store.get(sid, part)
+                        _recv_exact(self.request, _BIN_REQ_FIXED.size,
+                                    "fetch request", self.client_address))
+                    idxs = struct.unpack(
+                        f">{n}I",
+                        _recv_exact(self.request, 4 * n, "fetch indices",
+                                    self.client_address))
+                    CHAOS.stall("shuffle.serve.stall")
+                    blocks = outer.store.get_with_crcs(sid, part)
                     picked = [blocks[i] for i in idxs if i < len(blocks)]
                     parts = [struct.pack(">I", len(picked))]
-                    for b in picked:
-                        parts.append(struct.pack(">Q", len(b)))
+                    for b, crc in picked:
+                        # chaos corrupts the PAYLOAD only: the stored crc
+                        # still describes the clean bytes, so the client's
+                        # verify is what must catch the flip
+                        b = CHAOS.corrupt("shuffle.fetch.corrupt", b)
+                        parts.append(_BIN_BLOCK_HDR.pack(len(b), crc))
                         parts.append(b)
                     self.request.sendall(b"".join(parts))
                     return True
                 header = json.loads(
-                    _recv_exact(self.request, word).decode("utf-8"))
-                _recv_exact(self.request, header.get("payload_len", 0))
+                    _recv_exact(self.request, word, "control header",
+                                self.client_address).decode("utf-8"))
+                _recv_exact(self.request, header.get("payload_len", 0),
+                            "control payload", self.client_address)
                 self._dispatch(header)
                 return True
 
@@ -472,6 +649,19 @@ class ShuffleBlockServer:
                     _send_msg(self.request,
                               {"peers": outer.registry.peers(
                                   workers_only=True)})
+                elif op == "peer_failure" and outer.registry is not None:
+                    excluded = outer.registry.report_failure(
+                        header["executor_id"])
+                    _send_msg(self.request, {"excluded": excluded})
+                elif op == "drop_query":
+                    # query-teardown broadcast (driver failure path):
+                    # drop the failed attempt's shuffles so the store
+                    # can't leak them or satisfy a stale retry read
+                    dropped = outer.store.drop_query(header["query_id"])
+                    _send_msg(self.request, {"dropped": dropped})
+                elif op == "store_info":
+                    _send_msg(self.request,
+                              {"shuffle_ids": outer.store.shuffle_ids()})
                 else:
                     _send_msg(self.request, {"error": f"bad op {op}"})
 
@@ -494,10 +684,13 @@ class ShuffleBlockServer:
 
 class PeerClient:
     """RPCs against one peer's block server (over the pooled, persistent
-    per-peer connection)."""
+    per-peer connection).  ``executor_id`` is carried when known so
+    failure reports can name the peer in the heartbeat registry."""
 
-    def __init__(self, addr: Tuple[str, int]):
+    def __init__(self, addr: Tuple[str, int],
+                 executor_id: Optional[str] = None):
         self.addr = tuple(addr)
+        self.executor_id = executor_id
 
     @property
     def conn(self) -> PooledConnection:
@@ -525,7 +718,7 @@ class PeerClient:
 
     def fetch_block(self, shuffle_id: int, partition: int,
                     block: int) -> bytes:
-        # fetch_many raises KeyError itself when the block is missing
+        # fetch_many raises PeerLostError itself when the block is missing
         return self.fetch_many(shuffle_id, partition, [block])[0]
 
     def register(self, executor_id: str, host: str, port: int,
@@ -556,6 +749,26 @@ class PeerClient:
                                     "shuffle_id": shuffle_id})
         return h["participants"], h["complete"]
 
+    def report_peer_failure(self, executor_id: str) -> bool:
+        """Tell this registry host that ``executor_id`` keeps failing
+        fetches; returns True when the registry excluded it."""
+        h, _ = _request(self.addr, {"op": "peer_failure",
+                                    "executor_id": executor_id})
+        return bool(h.get("excluded", False))
+
+    def drop_query(self, query_id: int) -> int:
+        """Drop every shuffle of a cluster query from this peer's block
+        store; returns the number of shuffles dropped."""
+        h, _ = _request(self.addr, {"op": "drop_query",
+                                    "query_id": int(query_id)})
+        return int(h.get("dropped", 0))
+
+    def store_info(self) -> List[int]:
+        """Shuffle ids currently resident in this peer's block store
+        (diagnostics + the leak-regression tests)."""
+        h, _ = _request(self.addr, {"op": "store_info"})
+        return [int(s) for s in h.get("shuffle_ids", [])]
+
 
 class BlockFetchIterator:
     """Pull all of a partition's blocks from a set of peers under a bounded
@@ -573,7 +786,8 @@ class BlockFetchIterator:
 
     def __init__(self, peers: List[PeerClient], shuffle_id: int,
                  partition: int, max_inflight_bytes: int = 64 << 20,
-                 fetch_threads: int = 4, request_bytes: int = 4 << 20):
+                 fetch_threads: int = 4, request_bytes: int = 4 << 20,
+                 report_failure=None):
         self.peers = peers
         self.shuffle_id = shuffle_id
         self.partition = partition
@@ -582,11 +796,50 @@ class BlockFetchIterator:
         #: thread per peer, but at most this many in a request at once)
         self.fetch_threads = max(int(fetch_threads), 1)
         self.request_bytes = max(int(request_bytes), 1)
+        #: callable(peer) invoked when a peer exhausts its fetch budget
+        #: (the transport reports it to the heartbeat registry so
+        #: repeat offenders get excluded)
+        self.report_failure = report_failure
+
+    def _fetch_batch(self, peer: PeerClient, take: List[int]) -> List[bytes]:
+        """One batch round-trip with CORRUPTION recovery: a checksum
+        mismatch re-fetches the batch from the serving peer under a
+        bounded budget (transport errors already retry inside the pooled
+        connection's own budget).  Budget exhaustion and lost map output
+        report the peer before escalating."""
+        budget = network_budget(
+            f"shuffle.fetch:{self.shuffle_id}/{self.partition}"
+            f"@{peer.addr[0]}:{peer.addr[1]}")
+        try:
+            while True:
+                try:
+                    return peer.fetch_many(self.shuffle_id,
+                                           self.partition, take)
+                except BlockCorruptionError as e:
+                    budget.backoff(error=e)  # RetryBudgetExhausted if dry
+                    SHUFFLE_COUNTERS.add(blocks_refetched=len(take))
+        except (RetryBudgetExhausted, PeerLostError):
+            # corruption persisted past the budget, the pooled
+            # connection's reconnect budget ran out, or the peer lost
+            # map output: this peer cannot serve — report it so the
+            # registry can exclude repeat offenders, then escalate
+            if self.report_failure is not None:
+                self.report_failure(peer)
+            raise
 
     def __iter__(self):
         import collections
-        sizes = {peer: peer.list_blocks(self.shuffle_id, self.partition)
-                 for peer in self.peers}
+        sizes = {}
+        for peer in self.peers:
+            try:
+                sizes[peer] = peer.list_blocks(self.shuffle_id,
+                                               self.partition)
+            except OSError:
+                # the peer's reconnect budget ran dry before the read
+                # even started: report it (exclusion input) and escalate
+                if self.report_failure is not None:
+                    self.report_failure(peer)
+                raise
         if not any(sizes.values()):
             return
         cv = threading.Condition()
@@ -627,8 +880,7 @@ class BlockFetchIterator:
                             return
                         state["inflight"] += batch_bytes
                     with request_slots:
-                        got = peer.fetch_many(self.shuffle_id,
-                                              self.partition, take)
+                        got = self._fetch_batch(peer, take)
                     with cv:
                         queue.extend(got)
                         cv.notify_all()
@@ -732,20 +984,24 @@ class TcpShuffleTransport:
 
     def _await_and_resolve_peers(self) -> List[PeerClient]:
         """Wait for every declared participant's map completion, then
-        resolve reachable peer clients (excluding self)."""
+        resolve reachable peer clients (excluding self).  The wait is a
+        named ``RetryBudget`` deadline (unlimited polls, bounded delay):
+        a lost participant surfaces as a budget error naming the shuffle
+        and the pending executors, never a silent hang."""
         self.executor.heartbeat()
-        deadline = time.time() + self.completeness_timeout_s
+        budget = RetryBudget(
+            f"shuffle.completeness:{self.shuffle_id}",
+            max_attempts=None, base_delay_s=0.02, max_delay_s=0.25,
+            deadline_s=self.completeness_timeout_s)
         while True:
             participants, complete = self.executor.shuffle_status(
                 self.shuffle_id)
             if set(participants) <= set(complete):
                 break
-            if time.time() >= deadline:
-                raise RuntimeError(
-                    f"shuffle {self.shuffle_id}: map output incomplete "
-                    f"after {self.completeness_timeout_s}s: "
-                    f"{sorted(set(participants) - set(complete))} pending")
-            time.sleep(0.05)
+            pending = RuntimeError(
+                f"shuffle {self.shuffle_id}: map output incomplete: "
+                f"{sorted(set(participants) - set(complete))} pending")
+            budget.backoff(error=pending)   # exhaustion names the budget
         # re-learn peers AFTER the wait: a participant may have registered
         # while we were waiting for map output
         self.executor.heartbeat()
@@ -759,7 +1015,7 @@ class TcpShuffleTransport:
                 # reachable: failing loudly beats silently dropping its
                 # blocks (fetch-failed -> recompute is the upper layer's
                 # job, as in Spark)
-                raise RuntimeError(
+                raise PeerLostError(
                     f"shuffle {self.shuffle_id}: completed participant "
                     f"{eid} has no reachable address (peer lost)")
             remote.append(peer)
@@ -790,7 +1046,8 @@ class TcpShuffleTransport:
                 yield from BlockFetchIterator(
                     remote, self.shuffle_id, partition, self.max_inflight,
                     fetch_threads=self.fetch_threads,
-                    request_bytes=self.request_bytes)
+                    request_bytes=self.request_bytes,
+                    report_failure=self.executor.report_peer_failure)
 
         chunk: List[bytes] = []
         acc = 0
@@ -868,8 +1125,23 @@ class ShuffleExecutor:
         self._peers = peers
 
     def peer_clients(self, include_self: bool = True) -> List[PeerClient]:
-        return [PeerClient(addr) for eid, addr in self._peers.items()
+        return [PeerClient(addr, executor_id=eid)
+                for eid, addr in self._peers.items()
                 if include_self or eid != self.executor_id]
+
+    def report_peer_failure(self, peer) -> None:
+        """A fetch against ``peer`` exhausted its budget: report it to
+        the heartbeat registry (driver-hosted when remote) so repeat
+        offenders are excluded from later reads.  Best-effort — the
+        registry may itself be unreachable while things are on fire."""
+        eid = getattr(peer, "executor_id", None) or str(peer)
+        try:
+            if self._driver is not None:
+                PeerClient(self._driver).report_peer_failure(eid)
+            elif self.registry is not None:
+                self.registry.report_failure(eid)
+        except OSError:
+            pass  # best-effort: the fetch error itself still escalates
 
     def new_shuffle_id(self) -> int:
         """Driver-coordinated when remote; registry-local standalone."""
@@ -908,7 +1180,8 @@ class ShuffleExecutor:
 
     def peer_client_for(self, executor_id: str) -> Optional[PeerClient]:
         addr = self._peers.get(executor_id)
-        return PeerClient(addr) if addr is not None else None
+        return (PeerClient(addr, executor_id=executor_id)
+                if addr is not None else None)
 
     def close(self) -> None:
         self.server.close()

@@ -28,6 +28,25 @@ _FIELDS = (
     "merge_input_blocks",     # wire blocks consumed by those merges
     "reduce_concats",         # exchange-side concat passes over already-
                               # merged batches (0 when concat-once holds)
+    # integrity (checksummed frames; docs/fault_tolerance.md)
+    "checksums_computed",     # map-side frame checksums stored at put()
+    "checksums_verified",     # reduce-side frames verified on receive
+    "checksum_failures",      # mismatches detected (BlockCorruptionError)
+    # recovery
+    "fetch_retries",          # reconnect/retry round-trips beyond the first
+    "blocks_refetched",       # blocks re-fetched after a corrupt/failed read
+    "peer_failures_reported", # budget-exhausted peers reported upstream
+    "peers_excluded",         # peers the heartbeat registry excluded
+    # executor liveness
+    "heartbeat_failures",     # failed liveness beats (cumulative)
+    "heartbeat_failure_streak",  # max consecutive failed beats (gauge)
+    # driver-side scoped recovery
+    "scoped_resubmits",       # query re-dispatches after executor loss
+    "task_retries",           # query re-dispatches after a retryable task
+                              # failure (no executor lost)
+    "executors_excluded",     # lost executors excluded from resubmission
+    "shuffle_invalidations",  # shuffles dropped from peers' block stores
+                              # when a query attempt was torn down
 )
 
 
@@ -41,6 +60,12 @@ class ShuffleCounters:
         with self._lock:
             for k, v in deltas.items():
                 setattr(self, k, getattr(self, k) + int(v))
+
+    def set_max(self, **values: int) -> None:
+        """High-watermark gauges (e.g. heartbeat failure streak)."""
+        with self._lock:
+            for k, v in values.items():
+                setattr(self, k, max(getattr(self, k), int(v)))
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -177,7 +177,11 @@ def set_cluster_participants(participants) -> None:
 
 
 #: reduce-read completeness wait (seconds); cluster executors set it from
-#: the broadcast conf (spark.rapids.shuffle.completenessTimeout)
+#: the broadcast conf (spark.rapids.shuffle.completenessTimeout).  The
+#: wait itself runs as a named RetryBudget deadline (net.py
+#: _await_and_resolve_peers), so a lost participant surfaces as a
+#: RetryBudgetExhausted naming the shuffle and the pending executors —
+#: never an anonymous fixed-timeout hang.
 _completeness_timeout_s: float = 120.0
 
 

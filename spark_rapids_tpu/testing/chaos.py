@@ -1,0 +1,222 @@
+"""Deterministic fault injection: one seedable registry of chaos sites.
+
+The reference proves its resilience machinery with a fault-injection
+tool (RmmSpark OOM injection, the shuffle transport's error-path tests);
+this module is the repro's unified analog.  Every injectable fault in
+the system is a named SITE registered in ``SITES``; production code
+marks the site with one cheap call (``CHAOS.raise_if`` / ``CHAOS.stall``
+/ ``CHAOS.corrupt``) and tests arm it with ``CHAOS.install``.  The
+legacy ad-hoc OOM hooks (``memory/retry.enable_oom_injection``, conf
+``spark.rapids.sql.test.injectRetryOOM``, the ``@inject_oom`` marker)
+now route through the ``memory.oom`` site, so one registry owns every
+injection point.
+
+Determinism: a plan fires on exact hit counts (``skip`` then ``count``)
+by default; probabilistic plans draw from a ``random.Random`` seeded
+per-install, so a seeded chaos run replays bit-identically.  Corruption
+picks its flipped bit from the same stream.  No wall-clock, no global
+randomness — the chaos test suite is tier-1 and must never flake.
+
+Disarmed cost: one attribute load and branch per site visit
+(``self._armed`` is False unless something is installed).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class InjectedFault(RuntimeError):
+    """An injected fault with no more specific exception type.  The
+    cluster layer treats it as retryable (the injected analog of a task
+    dying to a transient cause)."""
+
+
+#: injection-point catalog: site name -> (where it fires, what it does).
+#: ``install`` rejects unknown names so a renamed site can never leave a
+#: test silently injecting nothing.  docs/fault_tolerance.md renders
+#: this table.
+SITES: Dict[str, str] = {
+    "shuffle.connect":
+        "PooledConnection._connect: raise ConnectionRefusedError before "
+        "the TCP connect (peer down / connect refused).",
+    "shuffle.fetch.disconnect":
+        "client fetch_many response phase: raise ConnectionResetError "
+        "after the request was sent (peer died mid-stream).",
+    "shuffle.serve.stall":
+        "server BIN_FETCH handler: sleep args['seconds'] before "
+        "responding (stalled peer; exercises fetch/compute overlap and "
+        "timeout bounds).",
+    "shuffle.fetch.corrupt":
+        "server BIN_FETCH handler: flip one deterministic bit in a "
+        "served block's payload, leaving its stored checksum intact "
+        "(wire corruption).",
+    "spill.write":
+        "SpillableBatchHandle.spill_to_disk: raise OSError instead of "
+        "writing the spill file (disk full / IO error).",
+    "spill.corrupt":
+        "SpillableBatchHandle.spill_to_disk: flip one deterministic bit "
+        "in the spill file's bytes after checksumming (silent storage "
+        "corruption, detected on reload).",
+    "cluster.task":
+        "cluster executor run_task entry: raise InjectedFault (task "
+        "death; the driver must retry without losing the query).",
+    "cluster.heartbeat":
+        "executor liveness beat: raise InjectedFault instead of "
+        "heartbeating (dropped beats; exercises backoff and the "
+        "failure-streak accounting).",
+    "memory.oom":
+        "DeviceArena.maybe_throw_injected (inside retry scopes): raise "
+        "TpuRetryOOM / TpuSplitAndRetryOOM per args['kind'] — the "
+        "unified form of the legacy injectRetryOOM hooks.",
+}
+
+
+class _Plan:
+    def __init__(self, count: int, skip: int, probability: float,
+                 seed: Optional[int], args: dict):
+        self.remaining = count          # -1 = unlimited
+        self.skip = skip
+        self.probability = float(probability)
+        self.rng = random.Random(0 if seed is None else seed)
+        self.args = dict(args)
+        self.hits = 0                   # times the site was visited armed
+        self.fired = 0                  # times the fault actually fired
+
+
+class ChaosRegistry:
+    """Process-wide injection registry (``CHAOS`` singleton)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plans: Dict[str, _Plan] = {}
+        self._fired_total: Dict[str, int] = {}
+        self._armed = False             # lock-free fast-path guard
+
+    # -- arming ---------------------------------------------------------------
+
+    def install(self, site: str, count: int = 1, skip: int = 0,
+                probability: float = 1.0, seed: Optional[int] = None,
+                **args) -> None:
+        """Arm ``site``: after ``skip`` armed visits, fire on each visit
+        (with ``probability``, drawn from a seeded stream) until
+        ``count`` faults fired (-1 = unlimited).  ``args`` are
+        site-specific (e.g. ``seconds=`` for stalls, ``kind=`` for
+        OOMs).  Unknown sites are rejected loudly."""
+        if site not in SITES:
+            raise KeyError(
+                f"unknown chaos site {site!r}; known sites: "
+                f"{sorted(SITES)}")
+        with self._lock:
+            self._plans[site] = _Plan(count, skip, probability, seed, args)
+            self._armed = True
+
+    def clear(self, site: Optional[str] = None) -> None:
+        with self._lock:
+            if site is None:
+                self._plans.clear()
+            else:
+                self._plans.pop(site, None)
+            self._armed = bool(self._plans)
+
+    @contextmanager
+    def scoped(self, site: str, **kw):
+        """``with CHAOS.scoped("spill.write", count=2):`` — armed for the
+        block only (cleared even on error)."""
+        self.install(site, **kw)
+        try:
+            yield self
+        finally:
+            self.clear(site)
+
+    # -- firing ---------------------------------------------------------------
+
+    def fire(self, site: str) -> Optional[dict]:
+        """Visit ``site``; returns the plan's args dict when the fault
+        fires, else None.  Cheap no-op while nothing is installed."""
+        if not self._armed:
+            return None
+        with self._lock:
+            plan = self._plans.get(site)
+            if plan is None:
+                return None
+            plan.hits += 1
+            if plan.skip > 0:
+                plan.skip -= 1
+                return None
+            if plan.remaining == 0:
+                return None
+            if plan.probability < 1.0 and \
+                    plan.rng.random() >= plan.probability:
+                return None
+            if plan.remaining > 0:
+                plan.remaining -= 1
+            plan.fired += 1
+            self._fired_total[site] = self._fired_total.get(site, 0) + 1
+            return dict(plan.args, _rng=plan.rng)
+
+    def raise_if(self, site: str, default: type = InjectedFault,
+                 message: str = "") -> None:
+        """Raise the site's configured exception when the fault fires.
+        Plans may override the exception class via ``exc=``."""
+        hit = self.fire(site)
+        if hit is None:
+            return
+        exc = hit.get("exc", default)
+        raise exc(message or f"chaos: injected fault at {site!r}")
+
+    def stall(self, site: str) -> None:
+        """Sleep ``args['seconds']`` (default 0.2) when the fault fires."""
+        hit = self.fire(site)
+        if hit is not None:
+            time.sleep(float(hit.get("seconds", 0.2)))
+
+    def corrupt(self, site: str, data: bytes) -> bytes:
+        """Flip one deterministic bit of ``data`` when the fault fires
+        (position drawn from the plan's seeded stream)."""
+        hit = self.fire(site)
+        if hit is None or not data:
+            return data
+        rng: random.Random = hit["_rng"]
+        pos = rng.randrange(len(data))
+        out = bytearray(data)
+        out[pos] ^= 1 << rng.randrange(8)
+        return bytes(out)
+
+    def corrupt_file(self, site: str, path: str) -> None:
+        """Flip one deterministic bit of the file at ``path`` in place
+        when the fault fires (position from the seeded stream) — the
+        file-granular twin of ``corrupt``, so writers that stream to
+        disk never have to stage the bytes just to corrupt them."""
+        hit = self.fire(site)
+        if hit is None:
+            return
+        size = os.path.getsize(path)
+        if not size:
+            return
+        rng: random.Random = hit["_rng"]
+        pos = rng.randrange(size)
+        with open(path, "r+b") as f:
+            f.seek(pos)
+            (b,) = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b ^ (1 << rng.randrange(8))]))
+
+    # -- observation ----------------------------------------------------------
+
+    def fired_count(self, site: str) -> int:
+        """Total faults fired at ``site`` since process start (survives
+        ``clear``; tests assert on it)."""
+        with self._lock:
+            return self._fired_total.get(site, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._fired_total)
+
+
+CHAOS = ChaosRegistry()

@@ -1,0 +1,54 @@
+"""Frame/file integrity checksums for shuffle and spill IO.
+
+CRC32C when the hardware-accelerated ``google_crc32c`` wheel is present
+(the checksum the reference's UCX transport and parquet both use);
+zlib's CRC32 otherwise — same 32-bit error-detection role, C speed,
+always available.  Both ends of a connection run the same build inside
+one deployment, so the algorithm never mixes across a wire.
+
+A checksum of 0 is reserved as "not checksummed": producers that
+compute a real CRC of 0 remap it (one in 2**32 frames pays a second
+pass over a remap constant, not over the data), and verifiers skip
+frames carrying 0 — which is also how a checksum-disabled writer
+interoperates with a checksum-enabled reader.
+"""
+from __future__ import annotations
+
+try:                                    # hardware CRC32C when available
+    from google_crc32c import value as _crc
+    from google_crc32c import extend as _crc_extend
+    CHECKSUM_ALGO = "crc32c"
+except ImportError:                     # stdlib fallback, same role
+    from zlib import crc32 as _crc
+    CHECKSUM_ALGO = "crc32"
+
+    def _crc_extend(crc: int, chunk: bytes) -> int:
+        return _crc(chunk, crc)
+
+
+def frame_checksum(data: bytes) -> int:
+    """32-bit integrity checksum of ``data``; never returns 0 (reserved
+    for "not checksummed")."""
+    c = _crc(data) & 0xFFFFFFFF
+    return c if c else 0xFFFFFFFF
+
+
+def file_checksum(path: str, chunk_bytes: int = 1 << 20) -> int:
+    """``frame_checksum`` of a file's bytes, streamed in constant memory
+    — the spill writer checksums multi-GB files without staging them."""
+    c = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_bytes)
+            if not chunk:
+                break
+            c = _crc_extend(c, chunk) & 0xFFFFFFFF
+    return c if c else 0xFFFFFFFF
+
+
+def verify_frame(data: bytes, expected: int) -> bool:
+    """True when ``data`` matches ``expected``; an expected checksum of
+    0 means the producer didn't checksum — always accepted."""
+    if not expected:
+        return True
+    return frame_checksum(data) == int(expected)

@@ -48,6 +48,7 @@ def _excepthook(exc_type, exc, tb):
     try:
         dump_now("uncaught_exception", extra={
             "error": "".join(traceback.format_exception(exc_type, exc, tb))})
+    # tpu-lint: allow-swallow(the crash dumper must never raise from an excepthook; the original error still propagates)
     except Exception:
         pass
     prev = _state.get("prev_hook")
@@ -76,11 +77,13 @@ def _device_state() -> Dict:
         a = device_arena()
         info["arena"] = {"used_bytes": int(a.used_bytes),
                          "budget_bytes": int(a.budget_bytes)}
+    # tpu-lint: allow-swallow(diagnostics collection inside the crash path; a missing section beats a second crash)
     except Exception:
         pass
     try:
         from spark_rapids_tpu.utils.tracing import span_log
         info["recent_ranges"] = span_log.snapshot()[-50:]
+    # tpu-lint: allow-swallow(diagnostics collection inside the crash path; a missing section beats a second crash)
     except Exception:
         pass
     return info

@@ -1,0 +1,102 @@
+"""Bounded retry budgets: exponential backoff under a shared deadline.
+
+Replaces the scattered fixed timeouts and hand-rolled retry loops in the
+shuffle client (`shuffle/net.py`), the transport completeness wait, and
+the driver's resubmission loop with ONE discipline: every recovery path
+consumes attempts from a named ``RetryBudget`` whose exhaustion raises a
+``RetryBudgetExhausted`` that NAMES the budget — a recovery path can
+therefore never hang past its budget, and a stuck query's error says
+which budget ran out instead of timing out anonymously.
+
+``RetryBudgetExhausted`` subclasses ``TimeoutError`` (itself an
+``OSError``), so transport-level callers that treat connection errors as
+peer loss handle budget exhaustion the same way without new plumbing.
+
+Delays are deterministic (pure exponential, no jitter): the chaos suite
+replays recovery schedules bit-identically.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class RetryBudgetExhausted(TimeoutError):
+    """A named retry budget ran out of attempts or deadline."""
+
+
+class RetryBudget:
+    """Attempt/backoff/deadline accounting for one recovery scope.
+
+    ``max_attempts`` bounds RETRIES (not first tries): a budget of 4
+    allows one initial attempt plus four backoff-separated retries.
+    ``max_attempts=None`` means unlimited retries (bounded only by the
+    deadline, if any) — the shape a forever-heartbeat wants.
+    """
+
+    def __init__(self, name: str, max_attempts: Optional[int] = 4,
+                 base_delay_s: float = 0.05, max_delay_s: float = 2.0,
+                 deadline_s: Optional[float] = None,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.name = name
+        self.max_attempts = max_attempts
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.deadline_s = deadline_s
+        self.used = 0
+        self._clock = clock
+        self._sleep = sleep
+        self._t0 = clock()
+
+    # -- state ----------------------------------------------------------------
+
+    def elapsed_s(self) -> float:
+        return self._clock() - self._t0
+
+    def next_delay_s(self) -> float:
+        # cap the exponent: an unlimited budget (max_attempts=None) can
+        # accumulate 1000+ retries, and 2**used would overflow float
+        return min(self.base_delay_s * (2 ** min(self.used, 30)),
+                   self.max_delay_s)
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        return self.deadline_s - self.elapsed_s()
+
+    def _exhausted_reason(self, about_to_sleep: float) -> Optional[str]:
+        if self.max_attempts is not None and self.used >= self.max_attempts:
+            return f"attempts exhausted ({self.used}/{self.max_attempts})"
+        rem = self.remaining_s()
+        if rem is not None and about_to_sleep > rem:
+            return (f"deadline exceeded ({self.elapsed_s():.2f}s of "
+                    f"{self.deadline_s:.2f}s, {self.used} retries)")
+        return None
+
+    def _raise_exhausted(self, reason: str,
+                         error: Optional[BaseException]) -> None:
+        exc = RetryBudgetExhausted(
+            f"retry budget {self.name!r} exhausted: {reason}"
+            + (f"; last error: {error}" if error is not None else ""))
+        raise exc from error
+
+    def check_deadline(self, error: Optional[BaseException] = None) -> None:
+        """Raise when past the deadline (poll loops call this each turn)."""
+        rem = self.remaining_s()
+        if rem is not None and rem <= 0:
+            self._raise_exhausted(
+                f"deadline exceeded ({self.elapsed_s():.2f}s of "
+                f"{self.deadline_s:.2f}s, {self.used} retries)", error)
+
+    def backoff(self, error: Optional[BaseException] = None) -> float:
+        """Consume one retry: sleep the next bounded-exponential delay
+        and return it, or raise ``RetryBudgetExhausted`` (chained from
+        ``error``) when no attempt or deadline headroom remains."""
+        delay = self.next_delay_s()
+        reason = self._exhausted_reason(delay)
+        if reason is not None:
+            self._raise_exhausted(reason, error)
+        self.used += 1
+        if delay > 0:
+            self._sleep(delay)
+        return delay

@@ -1,0 +1,693 @@
+"""Chaos suite: deterministic fault injection across shuffle, spill and
+cluster recovery (testing/chaos.py).
+
+Every test is CPU-only, in-process and SEEDED — injected faults fire on
+exact hit counts (or seeded draws), so the suite can never flake.  The
+contract under test: every injected fault class either RECOVERS with
+correct results (and bumps its recovery counter) or fails LOUDLY with a
+typed error naming what ran out — never silent wrong answers, never a
+hang past the retry budget.
+
+Cluster recovery runs against protocol-level fake executors (threads
+speaking the driver RPC protocol over real sockets, with real per-node
+BlockStores) so the driver's scoped resubmission, peer exclusion and
+shuffle invalidation are exercised end-to-end without spawning JAX
+worker processes.
+"""
+import pickle
+import socket
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.memory.retry import (
+    disable_oom_injection, enable_oom_injection, with_retry_no_split)
+from spark_rapids_tpu.shuffle import net
+from spark_rapids_tpu.shuffle.net import (
+    BlockCorruptionError, BlockFetchIterator, PeerClient, ShuffleExecutor,
+    _recv_exact, connection_pool, set_network_retry)
+from spark_rapids_tpu.shuffle.stats import (
+    SHUFFLE_COUNTERS, reset_shuffle_counters, shuffle_counters)
+from spark_rapids_tpu.testing.chaos import CHAOS, SITES, InjectedFault
+from spark_rapids_tpu.utils.checksum import frame_checksum, verify_frame
+from spark_rapids_tpu.utils.retry_budget import (
+    RetryBudget, RetryBudgetExhausted)
+
+SCHEMA = Schema.of(k=T.INT, v=T.LONG)
+
+
+def _batch(lo, hi):
+    return ColumnarBatch.from_pydict(
+        {"k": [i % 3 for i in range(lo, hi)],
+         "v": list(range(lo, hi))}, SCHEMA)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    """Every test starts disarmed, with fresh counters, default network
+    budgets, and no pooled sockets left over from a failure test."""
+    CHAOS.clear()
+    reset_shuffle_counters()
+    set_network_retry(4, 0.01, 0.05)   # fast budgets: tests never sleep long
+    yield
+    CHAOS.clear()
+    disable_oom_injection()
+    set_network_retry(4, 0.05, 2.0)
+    connection_pool().close_all()
+
+
+# -- the registry itself ------------------------------------------------------
+
+def test_registry_count_skip_determinism():
+    CHAOS.install("memory.oom", count=2, skip=1)
+    from spark_rapids_tpu.memory.arena import (
+        enter_retry_scope, exit_retry_scope, device_arena)
+    enter_retry_scope()
+    try:
+        fired = []
+        for _ in range(5):
+            try:
+                device_arena().maybe_throw_injected()
+                fired.append(False)
+            except Exception:
+                fired.append(True)
+        # skip 1 visit, fire exactly 2, then disarmed
+        assert fired == [False, True, True, False, False]
+    finally:
+        exit_retry_scope()
+
+
+def test_registry_rejects_unknown_site():
+    with pytest.raises(KeyError, match="unknown chaos site"):
+        CHAOS.install("no.such.site")
+
+
+def test_probability_and_corruption_are_seeded():
+    def draw(seed):
+        CHAOS.install("cluster.task", count=-1, probability=0.5, seed=seed)
+        pattern = [CHAOS.fire("cluster.task") is not None
+                   for _ in range(32)]
+        CHAOS.clear("cluster.task")
+        return pattern
+
+    p1, p2, p3 = draw(7), draw(7), draw(8)
+    assert p1 == p2 and p1 != p3 and any(p1) and not all(p1)
+
+    def flip(seed):
+        with CHAOS.scoped("shuffle.fetch.corrupt", count=1, seed=seed):
+            return CHAOS.corrupt("shuffle.fetch.corrupt", b"x" * 64)
+
+    data = b"x" * 64
+    c1, c2, c3 = flip(3), flip(3), flip(4)
+    assert c1 == c2 and c1 != data and c3 != data
+
+
+def test_every_site_is_documented():
+    for site, doc in SITES.items():
+        assert doc and ":" in doc, f"site {site} needs a real catalog entry"
+
+
+# -- checksummed frames + network fault recovery ------------------------------
+
+@pytest.fixture()
+def node():
+    ex = ShuffleExecutor(serve_registry=True)
+    for i in range(6):
+        ex.store.put(11, 0, bytes([i]) * (200 + i))
+    yield ex
+    ex.close()
+
+
+def test_checksum_roundtrip_counters(node):
+    peer = PeerClient(node.server.addr)
+    blocks = list(BlockFetchIterator([peer], 11, 0))
+    assert [len(b) for b in sorted(blocks, key=len)] == [200 + i
+                                                         for i in range(6)]
+    c = shuffle_counters()
+    assert c["checksums_computed"] == 6
+    assert c["checksums_verified"] >= 6
+    assert c["checksum_failures"] == 0
+
+
+def test_corrupted_frame_refetched_from_peer(node):
+    """Chaos case (a): one corrupted wire frame -> checksum failure is
+    DETECTED, the batch is re-fetched from the serving peer, the read
+    completes with correct bytes, and every counter tells the story."""
+    CHAOS.install("shuffle.fetch.corrupt", count=1, seed=42)
+    peer = PeerClient(node.server.addr)
+    blocks = list(BlockFetchIterator([peer], 11, 0))
+    assert sorted(len(b) for b in blocks) == [200 + i for i in range(6)]
+    for b in blocks:                      # payload bytes are pristine
+        assert len(set(b)) == 1
+    c = shuffle_counters()
+    assert c["checksum_failures"] == 1
+    assert c["blocks_refetched"] >= 1
+    assert CHAOS.fired_count("shuffle.fetch.corrupt") >= 1
+
+
+def test_persistent_corruption_is_loud_and_reports_peer(node):
+    """Corruption past the refetch budget raises the typed budget error
+    (naming the budget) and reports the peer for exclusion — never a
+    silent wrong answer, never a hang."""
+    CHAOS.install("shuffle.fetch.corrupt", count=-1, seed=1)
+    reported = []
+    peer = PeerClient(node.server.addr, executor_id="badpeer")
+    with pytest.raises(RetryBudgetExhausted, match="shuffle.fetch"):
+        list(BlockFetchIterator([peer], 11, 0,
+                                report_failure=reported.append))
+    assert reported and reported[0] is peer
+    assert shuffle_counters()["checksum_failures"] >= 2
+
+
+def test_connect_refused_recovered(node):
+    connection_pool().close_all()      # force a fresh connect
+    CHAOS.install("shuffle.connect", count=1)
+    blocks = list(BlockFetchIterator([PeerClient(node.server.addr)], 11, 0))
+    assert len(blocks) == 6
+    assert shuffle_counters()["fetch_retries"] >= 1
+
+
+def test_midstream_disconnect_recovered(node):
+    CHAOS.install("shuffle.fetch.disconnect", count=1)
+    blocks = list(BlockFetchIterator([PeerClient(node.server.addr)], 11, 0))
+    assert len(blocks) == 6
+    assert shuffle_counters()["fetch_retries"] >= 1
+
+
+def test_stalled_peer_still_completes(node):
+    CHAOS.install("shuffle.serve.stall", count=1, seconds=0.15)
+    t0 = time.monotonic()
+    blocks = list(BlockFetchIterator([PeerClient(node.server.addr)], 11, 0))
+    assert len(blocks) == 6
+    assert time.monotonic() - t0 >= 0.15
+    assert CHAOS.fired_count("shuffle.serve.stall") == 1
+
+
+def test_retry_budget_exhaustion_names_budget(node):
+    """Chaos case (d): a peer that refuses every connect exhausts the
+    budget quickly (bounded backoff, no hang) and the error names the
+    budget and the last cause."""
+    connection_pool().close_all()
+    set_network_retry(2, 0.01, 0.02)
+    CHAOS.install("shuffle.connect", count=-1)
+    t0 = time.monotonic()
+    with pytest.raises(RetryBudgetExhausted) as ei:
+        PeerClient(node.server.addr).fetch_many(11, 0, [0])
+    assert time.monotonic() - t0 < 2.0          # bounded, not a hang
+    msg = str(ei.value)
+    assert "retry budget" in msg and "shuffle.rpc" in msg
+    assert "attempts exhausted" in msg
+
+
+def test_peer_death_mid_fetch_is_typed_and_reported():
+    """Chaos case (c), transport half: the serving peer dies between
+    list_blocks and fetch; the read fails with the typed budget error
+    and the peer is reported — the cluster layer's scoped re-execution
+    (tested below) turns that into a correct re-run."""
+    ex = ShuffleExecutor(serve_registry=True)
+    ex.store.put(3, 0, b"z" * 128)
+    peer = PeerClient(ex.server.addr, executor_id="dying")
+    sizes = peer.list_blocks(3, 0)
+    assert sizes == [128]
+    ex.close()                       # peer dies mid-read
+    connection_pool().close_all()
+    set_network_retry(2, 0.01, 0.02)
+    reported = []
+    with pytest.raises((RetryBudgetExhausted, OSError)):
+        list(BlockFetchIterator([peer], 3, 0,
+                                report_failure=reported.append))
+    assert reported and reported[0] is peer
+
+
+def test_lost_map_output_is_peer_lost_error(node):
+    """A short fetch response (the peer no longer has the map output)
+    must be the OSError-family PeerLostError so the driver's scoped
+    re-execution covers it — a KeyError would classify as a
+    deterministic query bug and fail the whole query."""
+    from spark_rapids_tpu.shuffle.net import PeerLostError
+    peer = PeerClient(node.server.addr)
+    with pytest.raises(PeerLostError, match="map output lost"):
+        peer.fetch_many(11, 0, [0, 99])     # 99 was never stored
+
+
+def test_short_read_error_is_diagnosable():
+    """Satellite: a truncated stream names the peer, the byte progress
+    and the in-flight request."""
+    a, b = socket.socketpair()
+    try:
+        b.sendall(b"xy")
+        b.close()
+        with pytest.raises(ConnectionError) as ei:
+            _recv_exact(a, 10, "fetch response block 2/6",
+                        ("10.0.0.9", 4040))
+        msg = str(ei.value)
+        assert "10.0.0.9" in msg and "2/10 bytes" in msg
+        assert "fetch response block 2/6" in msg
+    finally:
+        a.close()
+
+
+def test_registry_excludes_peer_after_threshold():
+    ex = ShuffleExecutor(serve_registry=True)
+    try:
+        reg = ex.registry
+        reg.exclude_threshold = 3
+        reg.register("w9", "127.0.0.1", 1234)
+        assert "w9" in reg.peers()
+        assert not reg.report_failure("w9")
+        assert not reg.report_failure("w9")
+        assert reg.report_failure("w9")          # third strike excludes
+        assert "w9" not in reg.peers()
+        assert shuffle_counters()["peers_excluded"] == 1
+        reg.register("w9", "127.0.0.1", 1234)    # a restart may rejoin
+        assert "w9" in reg.peers()
+    finally:
+        ex.close()
+
+
+# -- spill integrity ----------------------------------------------------------
+
+def test_spill_bitflip_is_typed_error_not_wrong_results():
+    """Chaos case (b): a bit-flipped spill file raises
+    SpillCorruptionError on reload — corrupt data is never resurrected
+    into query results."""
+    from spark_rapids_tpu.memory import metrics as task_metrics
+    from spark_rapids_tpu.memory.spill import (
+        SpillCorruptionError, make_spillable, spill_framework)
+    task_metrics.reset()
+    before = spill_framework().metrics.corruption_errors
+    h = make_spillable(_batch(0, 64))
+    h.spill_to_host()
+    with CHAOS.scoped("spill.corrupt", count=1, seed=9):
+        assert h.spill_to_disk() > 0
+    with pytest.raises(SpillCorruptionError, match="checksum"):
+        h.materialize()
+    assert spill_framework().metrics.corruption_errors == before + 1
+    assert task_metrics.get().spill_corruption_errors == 1
+    h.close()
+
+
+def test_spill_write_failure_survives_with_host_copy():
+    from spark_rapids_tpu.memory import metrics as task_metrics
+    from spark_rapids_tpu.memory.spill import make_spillable, spill_framework
+    task_metrics.reset()
+    before = spill_framework().metrics.write_failures
+    h = make_spillable(_batch(0, 32))
+    h.spill_to_host()
+    with CHAOS.scoped("spill.write", count=1):
+        assert h.spill_to_disk() == 0            # failed but survived
+    assert spill_framework().metrics.write_failures == before + 1
+    assert task_metrics.get().spill_write_failures == 1
+    got = h.materialize()                        # host copy intact
+    assert got.to_pydict()["v"] == list(range(32))
+    h.unpin()
+    h.close()
+
+
+def test_spill_roundtrip_checksum_clean():
+    from spark_rapids_tpu.memory.spill import make_spillable
+    h = make_spillable(_batch(5, 40))
+    h.spill_to_host()
+    assert h.spill_to_disk() > 0
+    got = h.materialize()
+    assert got.to_pydict()["v"] == list(range(5, 40))
+    h.unpin()
+    h.close()
+
+
+def test_oom_storm_through_unified_registry():
+    """The legacy OOM hooks now ride the chaos registry: an injected
+    storm spills-and-reruns to the correct result, and the registry's
+    fired counts line up with the retry metrics."""
+    from spark_rapids_tpu.memory import metrics as task_metrics
+    from spark_rapids_tpu.shuffle.serializer import (
+        merge_batches, serialize_batch)
+    wire = [serialize_batch(_batch(0, 50)), serialize_batch(_batch(50, 80))]
+    task_metrics.reset()
+    fired0 = CHAOS.fired_count("memory.oom")
+    enable_oom_injection(num_ooms=4)
+    out = with_retry_no_split(lambda: merge_batches(wire, SCHEMA))
+    assert sorted(out.to_pydict()["v"]) == list(range(80))
+    assert task_metrics.get().retry_count == 4
+    assert CHAOS.fired_count("memory.oom") - fired0 == 4
+
+
+# -- checksum helpers ---------------------------------------------------------
+
+def test_frame_checksum_contract():
+    data = b"some frame bytes" * 10
+    crc = frame_checksum(data)
+    assert crc != 0                        # 0 is reserved
+    assert verify_frame(data, crc)
+    assert not verify_frame(data + b"!", crc)
+    assert verify_frame(data, 0)           # 0 = unchecksummed, accepted
+
+
+# -- retry budget -------------------------------------------------------------
+
+def test_retry_budget_backoff_shape():
+    sleeps = []
+    b = RetryBudget("unit", max_attempts=3, base_delay_s=0.1,
+                    max_delay_s=0.25, sleep=sleeps.append)
+    assert b.backoff() == 0.1
+    assert b.backoff() == 0.2
+    assert b.backoff() == 0.25             # capped
+    with pytest.raises(RetryBudgetExhausted, match="'unit'.*attempts"):
+        b.backoff(error=ValueError("boom"))
+    assert sleeps == [0.1, 0.2, 0.25]
+
+
+def test_retry_budget_deadline_names_budget():
+    now = [0.0]
+    b = RetryBudget("deadline-unit", max_attempts=None, base_delay_s=10.0,
+                    max_delay_s=10.0, deadline_s=5.0,
+                    clock=lambda: now[0], sleep=lambda s: None)
+    with pytest.raises(RetryBudgetExhausted,
+                       match="'deadline-unit'.*deadline"):
+        b.backoff()                        # next 10s sleep > 5s deadline
+    now[0] = 6.0
+    with pytest.raises(RetryBudgetExhausted):
+        b.check_deadline()
+
+
+def test_retry_budget_huge_used_never_overflows():
+    """An unlimited budget (max_attempts=None) can accumulate thousands
+    of retries — e.g. a long completeness poll; 2**used must saturate at
+    max_delay_s, not overflow float."""
+    b = RetryBudget("poll", max_attempts=None, base_delay_s=0.02,
+                    max_delay_s=0.25, sleep=lambda s: None)
+    b.used = 5000
+    assert b.next_delay_s() == 0.25
+
+
+# -- cluster recovery (protocol-level fake executors) -------------------------
+
+class FakeExecutor:
+    """A thread speaking the executor<->driver protocol over real
+    sockets, with a real ShuffleExecutor node (block server + registry
+    membership) but NO engine: ``behavior(task)`` decides the outcome.
+
+    behavior returns:
+      list           -> partition-tagged rows, pushed as success
+      ("error", msg, retryable) -> pushed as a task failure
+      "die"          -> stop polling AND heartbeating (process death)
+    """
+
+    def __init__(self, driver, name, behavior):
+        self.driver = driver
+        self.name = name
+        self.behavior = behavior
+        self.node = ShuffleExecutor(name,
+                                    driver_addr=driver.shuffle.server.addr)
+        self.stop_ev = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        from spark_rapids_tpu.shuffle.net import _request
+        while not self.stop_ev.is_set():
+            try:
+                PeerClient(self.driver.shuffle.server.addr).heartbeat(
+                    self.name)
+                header, payload = _request(
+                    self.driver.rpc_addr,
+                    {"op": "get_task", "executor_id": self.name},
+                    retriable=False)
+            except OSError:
+                time.sleep(0.02)
+                continue
+            task = header.get("task")
+            if task is None:
+                time.sleep(0.02)
+                continue
+            out = self.behavior(self, task)
+            if out == "die":
+                return                      # no result, no more beats
+            if isinstance(out, tuple) and out[0] == "error":
+                _request(self.driver.rpc_addr,
+                         {"op": "task_result",
+                          "query_id": task["query_id"],
+                          "executor_id": self.name,
+                          "error": out[1], "retryable": out[2]})
+            else:
+                _request(self.driver.rpc_addr,
+                         {"op": "task_result",
+                          "query_id": task["query_id"],
+                          "executor_id": self.name},
+                         pickle.dumps(out))
+
+    def close(self):
+        self.stop_ev.set()
+        self.thread.join(timeout=5)
+        self.node.close()
+
+
+def _rows_for(task):
+    """This rank's partition-tagged share of a 4-partition result."""
+    rank, world = task["rank"], task["world"]
+    return [(p, [[p, 10 * p]]) for p in range(4) if p % world == rank]
+
+
+def _normal(ex, task):
+    # simulate map output so the invalidation broadcast has something
+    # to drop: one block under this query's deterministic sid scheme
+    ex.node.store.put((task["query_id"] << 16) | 0, 0, b"map-output")
+    return _rows_for(task)
+
+
+def test_scoped_resubmission_on_executor_loss():
+    """Chaos case (c) + acceptance: a lost executor no longer re-runs
+    the query from scratch on a stale world — the driver EXCLUDES the
+    dead peer immediately, INVALIDATES only its query's shuffle state on
+    the survivors (BlockStore leak regression), and re-dispatches over
+    the survivors, returning correct results.  Stats counters prove each
+    step."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=1.0)
+    w1 = w2 = None
+    try:
+        w1 = FakeExecutor(driver, "w1", _normal)
+        died = threading.Event()
+
+        def die_once(ex, task):
+            _normal(ex, task)               # wrote map output, then died
+            died.set()
+            return "die"
+        w2 = FakeExecutor(driver, "w2", die_once)
+        driver.wait_for_executors(2, timeout_s=30)
+        rows = driver.submit({"fake": "plan"}, timeout_s=60, max_retries=2)
+        assert died.is_set()
+        assert sorted(tuple(r) for r in rows) == [
+            (p, 10 * p) for p in range(4)]
+        c = shuffle_counters()
+        assert c["scoped_resubmits"] == 1
+        assert c["executors_excluded"] == 1
+        assert c["shuffle_invalidations"] >= 1
+        # the dead peer is OUT of the registry (scoped world for the
+        # retry), and the failed attempt's blocks were dropped from the
+        # SURVIVOR's store (no BlockStore leak)
+        assert "w2" not in driver.shuffle.registry.peers(workers_only=True)
+        failed_qid = 0
+        assert not [s for s in w1.node.store.shuffle_ids()
+                    if s >> 16 == failed_qid]
+    finally:
+        for w in (w1, w2):
+            if w is not None:
+                w.close()
+        driver.close()
+
+
+def test_task_death_retries_without_losing_query():
+    """An executor whose TASK dies (process alive) reports a retryable
+    failure; the driver invalidates the attempt's shuffle state and
+    re-dispatches over the same live set — correct results, counter
+    proof, no stale blocks."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=5.0)
+    w1 = w2 = None
+    try:
+        w1 = FakeExecutor(driver, "w1", _normal)
+        fails = [1]
+
+        def flaky(ex, task):
+            _normal(ex, task)
+            if fails[0]:
+                fails[0] -= 1
+                return ("error", "injected task death", True)
+            return _rows_for(task)
+        w2 = FakeExecutor(driver, "w2", flaky)
+        driver.wait_for_executors(2, timeout_s=30)
+        rows = driver.submit({"fake": "plan"}, timeout_s=60, max_retries=2)
+        assert sorted(tuple(r) for r in rows) == [
+            (p, 10 * p) for p in range(4)]
+        c = shuffle_counters()
+        assert c["task_retries"] == 1
+        assert c["scoped_resubmits"] == 0       # nobody was lost
+        assert c["shuffle_invalidations"] >= 1
+        for w in (w1, w2):                      # failed qid fully dropped
+            assert not [s for s in w.node.store.shuffle_ids()
+                        if s >> 16 == 0]
+    finally:
+        for w in (w1, w2):
+            if w is not None:
+                w.close()
+        driver.close()
+
+
+def test_nonretryable_task_error_stays_fatal():
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=5.0)
+    w1 = None
+    try:
+        w1 = FakeExecutor(
+            driver, "w1",
+            lambda ex, task: ("error", "deterministic bug", False))
+        driver.wait_for_executors(1, timeout_s=30)
+        with pytest.raises(RuntimeError, match="deterministic bug"):
+            driver.submit({"fake": "plan"}, timeout_s=60, max_retries=2)
+    finally:
+        if w1 is not None:
+            w1.close()
+        driver.close()
+
+
+def test_query_deadline_names_budget():
+    """Acceptance: resubmission cannot loop past the per-query deadline;
+    exhaustion raises the budget's name, not a hang or a bare timeout."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=5.0)
+    w1 = None
+    try:
+        w1 = FakeExecutor(
+            driver, "w1",
+            lambda ex, task: ("error", "always flaky", True))
+        driver.wait_for_executors(1, timeout_s=30)
+        with pytest.raises(RetryBudgetExhausted,
+                           match="'cluster.submit'"):
+            driver.submit({"fake": "plan"}, timeout_s=60, max_retries=50,
+                          deadline_s=1.0)
+    finally:
+        if w1 is not None:
+            w1.close()
+        driver.close()
+
+
+def test_run_task_chaos_site_fires_before_any_state():
+    from spark_rapids_tpu.cluster.executor import run_task
+    CHAOS.install("cluster.task", count=1)
+    with pytest.raises(InjectedFault, match="cluster.task"):
+        run_task({"rank": 0, "world": 1, "query_id": 1}, b"", {})
+
+
+def test_retryable_classification():
+    from spark_rapids_tpu.cluster.executor import _is_retryable_task_error
+    from spark_rapids_tpu.shuffle.net import PeerLostError
+    assert _is_retryable_task_error(InjectedFault("x"))
+    assert _is_retryable_task_error(ConnectionError("x"))
+    assert _is_retryable_task_error(RetryBudgetExhausted("x"))
+    assert _is_retryable_task_error(BlockCorruptionError("x"))
+    assert _is_retryable_task_error(PeerLostError("x"))
+    assert not _is_retryable_task_error(ValueError("x"))
+    assert not _is_retryable_task_error(AssertionError("x"))
+
+
+# -- executor heartbeat backoff (satellite) -----------------------------------
+
+def test_heartbeat_pacer_backoff_and_streak(caplog):
+    import logging
+    from spark_rapids_tpu.cluster.executor import HeartbeatPacer
+    pacer = HeartbeatPacer(base_delay_s=2.0, max_delay_s=30.0)
+    with caplog.at_level(logging.INFO,
+                         logger="spark_rapids_tpu.cluster.executor"):
+        for _ in range(6):
+            pacer.failure(ConnectionError("driver down"))
+        assert pacer.streak == 6
+        assert pacer.delay_s == 30.0           # capped backoff
+        pacer.success()
+        assert pacer.streak == 0 and pacer.delay_s == 2.0
+    # one warning at the failure TRANSITION (not six), one recovery info
+    warns = [r for r in caplog.records if r.levelname == "WARNING"]
+    infos = [r for r in caplog.records if r.levelname == "INFO"]
+    assert len(warns) == 1 and "heartbeat failed" in warns[0].message
+    assert len(infos) == 1 and "recovered after 6" in infos[0].message
+    c = shuffle_counters()
+    assert c["heartbeat_failures"] == 6
+    assert c["heartbeat_failure_streak"] == 6
+
+
+def test_heartbeat_chaos_site_counts():
+    from spark_rapids_tpu.cluster.executor import HeartbeatPacer
+    CHAOS.install("cluster.heartbeat", count=2)
+    pacer = HeartbeatPacer()
+    for _ in range(4):
+        try:
+            CHAOS.raise_if("cluster.heartbeat")
+            pacer.success()
+        except InjectedFault as e:
+            pacer.failure(e)
+    assert CHAOS.fired_count("cluster.heartbeat") == 2
+    assert shuffle_counters()["heartbeat_failures"] == 2
+
+
+# -- BlockStore query teardown (satellite) ------------------------------------
+
+def test_blockstore_drop_query_scoped():
+    store = net.BlockStore()
+    store.put((7 << 16) | 0, 0, b"a")
+    store.put((7 << 16) | 1, 2, b"b")
+    store.put((8 << 16) | 0, 0, b"c")
+    store.mark_complete((7 << 16) | 0)
+    assert store.drop_query(7) == 2
+    assert store.shuffle_ids() == [(8 << 16) | 0]   # only query 7 dropped
+    assert store.get((8 << 16) | 0, 0) == [b"c"]
+    assert store.drop_query(7) == 0
+
+
+def test_blockstore_drop_query_zero_spares_standalone_sids():
+    """qid slot 0 is where standalone next_shuffle_id() sids live
+    (sid < 2**16): drop_query(0) must collect nothing, and cluster
+    query ids start at 1 so the broadcast can never name qid 0."""
+    store = net.BlockStore()
+    store.put(1, 0, b"standalone")      # registry-allocated sid
+    assert store.drop_query(0) == 0
+    assert store.shuffle_ids() == [1]
+
+
+def test_file_checksum_streams_identically(tmp_path):
+    """The spill writer's streamed file checksum must equal the frame
+    checksum of the same bytes, whatever the chunking."""
+    from spark_rapids_tpu.utils.checksum import file_checksum
+    data = bytes(range(256)) * 41
+    p = tmp_path / "blob"
+    p.write_bytes(data)
+    assert file_checksum(str(p)) == frame_checksum(data)
+    assert file_checksum(str(p), chunk_bytes=7) == frame_checksum(data)
+
+
+def test_driver_invalidation_broadcast_empties_peer_stores():
+    """The driver's drop_query broadcast reaches every live worker's
+    block server (the failure-path teardown the BlockStore used to
+    leak through)."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=30.0)
+    nodes = []
+    try:
+        for name in ("wa", "wb"):
+            n = ShuffleExecutor(name,
+                                driver_addr=driver.shuffle.server.addr)
+            n.store.put((5 << 16) | 0, 0, b"stale")
+            n.store.put((5 << 16) | 1, 0, b"stale2")
+            nodes.append(n)
+        driver._invalidate_query(5)
+        for n in nodes:
+            assert n.store.shuffle_ids() == []
+        assert shuffle_counters()["shuffle_invalidations"] == 4
+        # store_info RPC surfaces the same view remotely
+        assert PeerClient(nodes[0].server.addr).store_info() == []
+    finally:
+        for n in nodes:
+            n.close()
+        driver.close()

@@ -19,7 +19,8 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 from tools.tpulint import core as lint_core
-from tools.tpulint import drift, host_sync, locks, retry_discipline
+from tools.tpulint import (drift, host_sync, locks, retry_discipline,
+                           swallow)
 
 
 def _src(path: str, text: str) -> lint_core.SourceFile:
@@ -228,6 +229,96 @@ def test_lock_checker_fires_on_self_deadlock():
 
 
 # -- suppression mechanics ---------------------------------------------------
+
+def test_swallow_fires_on_silent_broad_except():
+    src = _src("spark_rapids_tpu/cluster/_fixture.py", """
+        def poll(peer):
+            try:
+                peer.heartbeat()
+            except Exception:
+                pass
+            try:
+                peer.cleanup()
+            except (ValueError, BaseException):
+                continue
+    """)
+    msgs = [v.message for v in swallow.check([src])]
+    assert len(msgs) == 2
+    assert all("silently swallowed" in m for m in msgs)
+
+
+def test_swallow_fires_on_bare_except():
+    src = _src("spark_rapids_tpu/cluster/_fixture.py", """
+        def f(x):
+            try:
+                return x.close()
+            except:
+                return None
+    """)
+    msgs = [v.message for v in swallow.check([src])]
+    assert len(msgs) == 1 and "bare `except:`" in msgs[0]
+
+
+def test_swallow_accepts_logged_handled_narrow_and_raising():
+    src = _src("spark_rapids_tpu/cluster/_fixture.py", """
+        import logging
+        log = logging.getLogger(__name__)
+
+        def f(x, state):
+            try:
+                x.run()
+            except Exception as e:
+                log.warning("run failed: %s", e)     # logged
+            try:
+                x.run()
+            except Exception as e:
+                state["error"] = e                   # handled (stored)
+                return None
+            try:
+                x.run()
+            except OSError:
+                pass                                 # narrow catch
+            try:
+                x.run()
+            except BaseException:
+                raise                                # re-raised
+            except:
+                log.exception("boom")                # bare but logged
+    """)
+    assert swallow.check([src]) == []
+
+
+def test_swallow_suppression_with_reason():
+    src = _src("spark_rapids_tpu/cluster/_fixture.py", """
+        def f(x):
+            try:
+                x.close()
+            # tpu-lint: allow-swallow(teardown of a possibly-dead handle)
+            except Exception:
+                pass
+    """)
+    assert _unsuppressed(swallow.check([src]), src) == []
+
+
+def test_heartbeat_swallow_was_fixed():
+    """Regression pin: the executor liveness beat's old shape — a tight
+    ``except Exception: pass`` loop, silent at full rate against a dead
+    driver — is exactly what the swallow rule flags.  The current
+    executor_main paces failures (HeartbeatPacer: backoff + one log per
+    streak transition + streak gauge) and stays lint-clean (the repo
+    gate above proves it)."""
+    src = _src("spark_rapids_tpu/cluster/_fixture.py", """
+        def _beat(stop, client, executor_id):
+            while not stop.is_set():
+                try:
+                    client.heartbeat(executor_id)
+                except Exception:
+                    pass
+                stop.wait(2.0)
+    """)
+    vs = swallow.check([src])
+    assert len(vs) == 1 and vs[0].scope == "_beat"
+
 
 def test_suppression_requires_a_reason():
     src = _src("spark_rapids_tpu/kernels/_fixture.py", """

@@ -189,7 +189,8 @@ def run_all(repo_root: str = REPO,
             with_drift: bool = True) -> List[Violation]:
     """Run every enabled checker; returns raw violations (inline
     suppressions already applied, baseline NOT yet applied)."""
-    from tools.tpulint import drift, host_sync, locks, retry_discipline
+    from tools.tpulint import (drift, host_sync, locks, retry_discipline,
+                               swallow)
 
     enabled = set(rules) if rules else None
 
@@ -212,6 +213,7 @@ def run_all(repo_root: str = REPO,
         ("retry-discipline", retry_discipline.check),
         ("host-sync", host_sync.check),
         ("lock-order", locks.check),
+        ("swallow", swallow.check),
     ]
     for rule, fn in checkers:
         if on(rule):

@@ -1,0 +1,109 @@
+"""swallow checker: silent broad exception swallows.
+
+An ``except Exception: pass`` in a recovery path is how a distributed
+system converts a diagnosable failure into a silent wrong answer or an
+unexplained hang (the repro's executor heartbeat thread did exactly this
+in a tight loop).  Flagged forms:
+
+  (a) a BARE ``except:`` — it also swallows SystemExit and
+      KeyboardInterrupt — unless its body raises or logs;
+  (b) ``except Exception`` / ``except BaseException`` (alone or in a
+      tuple) whose body does NOTHING but ``pass`` / ``...`` /
+      ``continue`` and makes no log-ish call.
+
+"Log-ish" is any call whose dotted name mentions log/warn/print/dump —
+``log.warning``, ``logging.exception``, ``print``, ``crashdump.
+dump_now`` all count.  A handler that stores, wraps or re-raises the
+exception is HANDLING it, not swallowing, and is never flagged.
+
+Deliberate swallows carry ``# tpu-lint: allow-swallow(reason)`` — the
+reason is the review artifact (why silence is correct HERE).
+Scope: all of spark_rapids_tpu/.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.tpulint.core import ScopedVisitor, SourceFile, Violation, dotted
+
+RULE = "swallow"
+
+BROAD_NAMES = {"Exception", "BaseException"}
+LOG_HINTS = ("log", "warn", "print", "dump")
+
+
+def _is_broad(type_node) -> bool:
+    """True when the handler catches Exception/BaseException (possibly
+    via a tuple)."""
+    if type_node is None:
+        return True
+    nodes = (type_node.elts if isinstance(type_node, ast.Tuple)
+             else [type_node])
+    for n in nodes:
+        name = dotted(n)
+        if name.rsplit(".", 1)[-1] in BROAD_NAMES:
+            return True
+    return False
+
+
+def _has_logish_call(handler: ast.ExceptHandler) -> bool:
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Call):
+            callee = dotted(sub.func).lower()
+            if any(h in callee for h in LOG_HINTS):
+                return True
+    return False
+
+
+def _has_raise(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(sub, ast.Raise) for sub in ast.walk(handler))
+
+
+def _body_is_pure_swallow(handler: ast.ExceptHandler) -> bool:
+    """Body consists only of pass / ... / continue (no handling at all)."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant):
+            continue    # docstring or bare `...`
+        return False
+    return True
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, src: SourceFile):
+        super().__init__()
+        self.src = src
+        self.out: List[Violation] = []
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        bare = node.type is None
+        if bare and not (_has_raise(node) or _has_logish_call(node)):
+            self.out.append(Violation(
+                RULE, self.src.path, node.lineno, self.scope,
+                "bare `except:` swallows SystemExit/KeyboardInterrupt "
+                "and hides the failure; catch a type, log, or suppress "
+                "with a reason"))
+        elif not bare and _is_broad(node.type) \
+                and _body_is_pure_swallow(node) \
+                and not _has_logish_call(node):
+            caught = dotted(node.type) if not isinstance(node.type,
+                                                         ast.Tuple) \
+                else "broad tuple"
+            self.out.append(Violation(
+                RULE, self.src.path, node.lineno, self.scope,
+                f"`except {caught}` silently swallowed (body is only "
+                "pass/continue, no log call): a failure here vanishes "
+                "without a trace; log it or suppress with a reason"))
+        self.generic_visit(node)
+
+
+def check(sources: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for src in sources:
+        v = _Visitor(src)
+        v.visit(src.tree)
+        out.extend(v.out)
+    return out

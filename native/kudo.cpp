@@ -53,9 +53,9 @@ uint64_t tk_serialized_size(const TkCol* cols, uint32_t num_cols,
   return n;
 }
 
-// Serialize one batch.  Returns bytes written.
-uint64_t tk_serialize(const TkCol* cols, uint32_t num_cols, uint64_t rows,
-                      uint8_t* out) {
+static uint64_t serialize_impl(const TkCol* cols, uint32_t num_cols,
+                               uint64_t rows, uint8_t* out,
+                               int rebase_offsets) {
   uint8_t* p = out;
   memcpy(p, &TK_MAGIC, 4); p += 4;
   memcpy(p, &num_cols, 4); p += 4;
@@ -81,13 +81,41 @@ uint64_t tk_serialize(const TkCol* cols, uint32_t num_cols, uint64_t rows,
       if (c->validity[r]) p[r >> 3] |= (uint8_t)(1u << (r & 7));
     p += vb;
     if (c->offsets) {
-      memcpy(p, c->offsets, (rows + 1) * sizeof(int32_t));
+      if (rebase_offsets) {
+        // range mode: the block must be self-contained, so offsets are
+        // written relative to the range's first byte (memcpy per value:
+        // p is not int32-aligned when the bitmap length is odd)
+        int32_t base = c->offsets[0];
+        for (uint64_t r = 0; r <= rows; r++) {
+          int32_t v = c->offsets[r] - base;
+          memcpy(p + r * sizeof(int32_t), &v, sizeof(int32_t));
+        }
+      } else {
+        memcpy(p, c->offsets, (rows + 1) * sizeof(int32_t));
+      }
       p += (rows + 1) * sizeof(int32_t);
     }
     memcpy(p, c->data, c->data_bytes);
     p += c->data_bytes;
   }
   return (uint64_t)(p - out);
+}
+
+// Serialize one batch.  Returns bytes written.
+uint64_t tk_serialize(const TkCol* cols, uint32_t num_cols, uint64_t rows,
+                      uint8_t* out) {
+  return serialize_impl(cols, num_cols, rows, out, 0);
+}
+
+// Range variant (map-side contiguous-split wire path): the caller points
+// each column's buffers at a ROW RANGE of one partition-ordered host
+// batch — validity at the range's first row, offsets at the range's
+// first entry, data at the range's first byte — and string offsets are
+// written rebased to the range, so every partition's wire block comes
+// from one host copy of the batch with no per-partition device gather.
+uint64_t tk_serialize_range(const TkCol* cols, uint32_t num_cols,
+                            uint64_t rows, uint8_t* out) {
+  return serialize_impl(cols, num_cols, rows, out, 1);
 }
 
 uint64_t tk_row_count(const uint8_t* buf) {

@@ -36,12 +36,14 @@ def cluster_stats() -> Optional["ClusterStatsClient"]:
 
 def local_shuffle_counters() -> dict:
     """This rank's shuffle data-plane counters (shuffle/stats.py):
-    connections opened, fetch round-trips, blocks/bytes per round-trip,
-    prefetch stall time, merge/concat count, plus the integrity and
-    recovery counters (checksums computed/verified/failed, refetches,
-    peer exclusions, heartbeat failure streak, scoped resubmits —
-    docs/fault_tolerance.md).  Surfaced here so cluster diagnostics and
-    the bench artifact read one snapshot shape."""
+    map-side serializer behavior (range batches/blocks, D2H syncs, wire
+    bytes, serialize wall time), connections opened, fetch round-trips,
+    blocks/bytes per round-trip, prefetch stall time, merge/concat
+    count, plus the integrity and recovery counters (checksums
+    computed/verified/failed, refetches, peer exclusions, heartbeat
+    failure streak, scoped resubmits — docs/fault_tolerance.md).
+    Surfaced here so cluster diagnostics and the bench artifact read one
+    snapshot shape."""
     from spark_rapids_tpu.shuffle.stats import shuffle_counters
     return shuffle_counters()
 

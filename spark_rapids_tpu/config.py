@@ -205,6 +205,17 @@ SHUFFLE_READER_THREADS = conf("spark.rapids.shuffle.multiThreaded.reader.threads
     "Deserializer/reader thread-pool size for the multithreaded shuffle."
 ).int_conf(4)
 
+SHUFFLE_RANGE_SERIALIZE = conf("spark.rapids.shuffle.write.rangeSerialize").doc(
+    "Map-side range serialization for the wire transports (MULTITHREADED/"
+    "MULTIPROCESS): download each partition-ordered map batch ONCE (a "
+    "single batched device-to-host transfer) and frame every partition's "
+    "wire block from host row ranges — no per-partition gather launches, "
+    "no per-column download syncs, no pow2-padded piece staging (the "
+    "reference serializes a row range of the contiguous-split table the "
+    "same way, GpuPartitioning.scala:66 + Kudo). Escape hatch, default "
+    "on; CACHE_ONLY always keeps device-resident spillable slices."
+).boolean_conf(True)
+
 SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").doc(
     "Compression for shuffle wire buffers: none, zstd, lz4 (reference: "
     "TableCompressionCodec.scala; device nvcomp is N/A on TPU so compression "
@@ -543,6 +554,10 @@ class RapidsConf:
     @property
     def shuffle_checksum_enabled(self) -> bool:
         return self.get(SHUFFLE_CHECKSUM_ENABLED)
+
+    @property
+    def shuffle_range_serialize(self) -> bool:
+        return self.get(SHUFFLE_RANGE_SERIALIZE)
 
     @property
     def spill_checksum_enabled(self) -> bool:

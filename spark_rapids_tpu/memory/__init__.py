@@ -54,6 +54,8 @@ def initialize_memory(conf) -> None:
     set_network_retry(conf.network_retry_max_attempts,
                       conf.network_retry_base_delay,
                       conf.network_retry_max_delay)
+    from spark_rapids_tpu.shuffle.transport import set_range_serialize
+    set_range_serialize(conf.shuffle_range_serialize)
     device_arena().check_retry_context = conf.retry_context_check
     # HBM-budget sizing from the chip's memory stats (GpuDeviceManager):
     # always on, like the reference's default-fraction pool sizing —

@@ -87,6 +87,7 @@ def lib() -> Optional[ctypes.CDLL]:
                 l = ctypes.CDLL(so)
                 l.tk_serialized_size.restype = ctypes.c_uint64
                 l.tk_serialize.restype = ctypes.c_uint64
+                l.tk_serialize_range.restype = ctypes.c_uint64
                 l.tk_row_count.restype = ctypes.c_uint64
                 l.tk_col_count.restype = ctypes.c_uint32
                 l.tk_merge.restype = ctypes.c_uint64
@@ -139,6 +140,64 @@ def kudo_serialize(cols: List[Tuple[np.ndarray, Optional[np.ndarray],
     written = l.tk_serialize(carr, n, num_rows, _ptr(out))
     assert written == size
     return out.tobytes()
+
+
+def kudo_serialize_ranges(cols: List[Tuple[np.ndarray, Optional[np.ndarray],
+                                           np.ndarray]],
+                          bounds: np.ndarray,
+                          prefix: bytes = b"") -> List[Optional[bytes]]:
+    """Range serialization: frame one wire block per row range of a
+    partition-ordered batch (the map-side contiguous-split path).
+
+    cols: [(validity bool/u8[total_rows], offsets i32[total_rows+1]|None,
+    data)] host arrays of the WHOLE batch; bounds: int[nparts+1] row
+    bounds (exclusive cumsum of per-partition counts).  Returns one
+    payload per partition (None for empty ranges), each byte-identical
+    to serializing that range's rows alone — string offsets are rebased
+    in C, everything else is pointer arithmetic into the shared arrays.
+    ``prefix`` bytes (e.g. the uncompressed-codec wire tag) are laid
+    down in the output buffer before serialization so the caller's
+    final block needs no second full-payload copy.
+    """
+    l = lib()
+    assert l is not None
+    ncols = len(cols)
+    prepared = []
+    for valid, offsets, data in cols:
+        prepared.append((np.ascontiguousarray(valid, dtype=np.uint8),
+                         None if offsets is None else
+                         np.ascontiguousarray(offsets, dtype=np.int32),
+                         np.ascontiguousarray(data)))
+    carr = (TkCol * ncols)()
+    out: List[Optional[bytes]] = []
+    for p in range(len(bounds) - 1):
+        s, e = int(bounds[p]), int(bounds[p + 1])
+        n = e - s
+        if n == 0:
+            out.append(None)
+            continue
+        # the views below are pointer arithmetic only; the buffers stay
+        # alive because `prepared` owns every base for the whole call
+        for i, (valid, offsets, data) in enumerate(prepared):
+            carr[i].validity = _ptr(valid[s:]).value
+            if offsets is not None:
+                carr[i].offsets = _ptr(offsets[s:]).value
+                carr[i].data = _ptr(data[int(offsets[s]):]).value
+                carr[i].data_bytes = int(offsets[e]) - int(offsets[s])
+            else:
+                carr[i].offsets = None
+                carr[i].data = _ptr(data[s:]).value
+                carr[i].data_bytes = n * data.dtype.itemsize
+            carr[i].dtype_code = 0
+        size = l.tk_serialized_size(carr, ncols, n)
+        np_ = len(prefix)
+        buf = np.zeros((np_ + size,), np.uint8)
+        if np_:
+            buf[:np_] = np.frombuffer(prefix, np.uint8)
+        written = l.tk_serialize_range(carr, ncols, n, _ptr(buf[np_:]))
+        assert written == size
+        out.append(buf.tobytes())
+    return out
 
 
 def kudo_merge(buffers: List[bytes], col_specs, row_capacity: int):

@@ -94,11 +94,16 @@ class TpuShuffleExchangeExec(TpuExec):
 
         keys_t, n_out = self.keys, self.out_partitions  # no self-capture
 
-        def slice_step(batch: ColumnarBatch, string_bucket: int = 0):
+        def slice_step(batch: ColumnarBatch, rr_start, string_bucket: int = 0):
             """Device: append key columns, partition, return reordered batch
-            + per-partition counts."""
+            + per-partition counts.  ``rr_start`` is the round-robin start
+            partition — a DYNAMIC scalar rotated across batches (reference
+            GpuRoundRobinPartitioning rotates per task) so every batch's
+            remainder rows don't pile into partition 0; keyed routing
+            ignores it."""
             if not keys_t:
-                return round_robin_partition(batch, n_out)
+                return round_robin_partition(batch, n_out,
+                                             start_partition=rr_start)
             work, key_idx = append_key_columns(batch, keys_t)
             reordered, counts = hash_partition(
                 work, key_idx, n_out, string_max_bytes=string_bucket)
@@ -112,28 +117,25 @@ class TpuShuffleExchangeExec(TpuExec):
             exprs_cache_key, schema_cache_key, shared_jit)
         key = (f"exchange|{num_partitions}|{schema_cache_key(child.schema)}|"
                f"{exprs_cache_key(self.keys)}")
-        self._jit_slice = lambda b, _k=key: shared_jit(
+        self._jit_slice = lambda b, rr, _k=key: shared_jit(
             f"{_k}|{(bkt := string_key_bucket(b, self.keys))}",
-            lambda: _p(slice_step, string_bucket=bkt))(b)
+            lambda: _p(slice_step, string_bucket=bkt))(b, rr)
 
     def num_partitions(self) -> int:
         return self.out_partitions
 
     # -- map side -----------------------------------------------------------
 
-    def _slices(self):
-        """Device-side slice of every input batch -> (partition, piece).
-        Per-partition row counts are recorded as they stream past — the
-        MapStatus sizes that AQE partition coalescing plans from.
-
-        When the child is a fused segment, the key-append + hash-partition
-        step runs INSIDE the child's fused program and the counts arrive
-        with its feedback fetch — one launch and one device round trip per
-        batch for the whole map side (VERDICT r4 #1)."""
+    def _partitioned(self):
+        """Device-side partition of every input batch ->
+        (reordered_batch, counts).  ``counts`` is a DEVICE array on the
+        task-engine path (consumers choose how to sync it) and already-
+        host numpy on the fused path (the fused program ships counts
+        with its feedback fetch — one launch and one device round trip
+        per batch for the whole map side, VERDICT r4 #1)."""
         from spark_rapids_tpu.expressions.bridge import tree_has_bridge
         from spark_rapids_tpu.plan.execs.base import (
             collect_trace_consts, exprs_cache_key, tree_uses_string_bucket)
-        from spark_rapids_tpu.plan.execs.out_of_core import slice_by_counts
         from spark_rapids_tpu.plan.fused import TpuFusedSegmentExec
         child = self.children[0]
         self._part_rows = [0] * self.out_partitions
@@ -141,35 +143,65 @@ class TpuShuffleExchangeExec(TpuExec):
                  and not tree_has_bridge(self.keys)
                  and not tree_uses_string_bucket(self.keys)
                  and not collect_trace_consts(self.keys))
-
-        def batch_stream(in_part):
-            if fused:
-                ex_sig = (f"{self.out_partitions}"
-                          f"|{exprs_cache_key(self.keys)}")
+        if fused:
+            ex_sig = f"{self.out_partitions}|{exprs_cache_key(self.keys)}"
+            for in_part in range(child.num_partitions()):
                 yield from child.execute_partition_sliced(
                     in_part, self.keys, self.out_partitions, ex_sig)
-                return
-            for batch in child.execute_partition(in_part):
-                # keep the slice dispatch + counts sync (the dominant
-                # map-side cost) inside opTime, as before the fused path
-                with timed(self.op_time):
-                    reordered, counts = with_retry_no_split(
-                        lambda: self._jit_slice(batch))
-                    host_counts = np.asarray(counts)  # ONE sync per batch
-                yield reordered, host_counts
-
+            return
+        ordinal = 0    # rotates the round-robin start across batches
         for in_part in range(child.num_partitions()):
-            for reordered, host_counts in batch_stream(in_part):
+            for batch in child.execute_partition(in_part):
+                # keep the slice dispatch (the dominant map-side cost)
+                # inside opTime, as before the fused path
                 with timed(self.op_time):
-                    pieces = slice_by_counts(reordered, host_counts,
-                                             self.out_partitions)
-                    for p, piece in enumerate(pieces):
-                        if piece is not None:
-                            if self._want_part_stats:
-                                # piece rows == the slice count; a per-piece
-                                # host_num_rows would re-sync per partition
-                                self._part_rows[p] += int(host_counts[p])
-                            yield p, piece
+                    rr = jnp.asarray(ordinal % self.out_partitions,
+                                     jnp.int32)
+                    reordered, counts = with_retry_no_split(
+                        lambda: self._jit_slice(batch, rr))
+                ordinal += 1
+                yield reordered, counts
+
+    def _record_part_rows(self, host_counts) -> None:
+        if self._want_part_stats:
+            # host_counts is already on host; a per-piece host_num_rows
+            # would re-sync per partition
+            for p in range(self.out_partitions):
+                self._part_rows[p] += int(host_counts[p])
+
+    def _slices(self):
+        """Device-slice write path: (partition, device piece) per
+        non-empty partition of every input batch.  CACHE_ONLY keeps this
+        (its handles must stay device-resident and spillable); wire
+        transports only fall back here when range serialization is off
+        or the schema is nested.  Per-partition row counts are recorded
+        as they stream past — the MapStatus sizes AQE coalescing plans
+        from."""
+        from spark_rapids_tpu.plan.execs.out_of_core import slice_by_counts
+        for reordered, counts in self._partitioned():
+            with timed(self.op_time):
+                host_counts = np.asarray(counts)  # ONE sync per batch
+                pieces = slice_by_counts(reordered, host_counts,
+                                         self.out_partitions)
+                self._record_part_rows(host_counts)
+                for p, piece in enumerate(pieces):
+                    if piece is not None:
+                        yield p, piece
+
+    def _range_stream(self):
+        """Range-serialization write path: (host batch, host counts) per
+        map batch, downloaded in ONE batched device_get — no per-
+        partition gather launches, no per-column syncs, no pow2-padded
+        piece staging.  The transport frames each partition's wire block
+        from host row ranges (GpuPartitioning.scala:66 contiguous_split
+        + Kudo row-range serialization analog)."""
+        from spark_rapids_tpu.shuffle.serializer import download_partitioned
+        for reordered, counts in self._partitioned():
+            with timed(self.op_time):
+                host_batch, host_counts = download_partitioned(
+                    reordered, counts)
+            self._record_part_rows(host_counts)
+            yield host_batch, host_counts
 
     def partition_row_counts(self) -> List[int]:
         """Materialize the map side and return rows per reduce partition
@@ -182,13 +214,19 @@ class TpuShuffleExchangeExec(TpuExec):
         """Run the map side once, writing slices through the transport SPI
         (RapidsShuffleTransport.scala:303 analog — the data plane is
         pluggable; this exec never touches its storage)."""
-        from spark_rapids_tpu.shuffle.transport import make_transport
+        from spark_rapids_tpu.shuffle.serializer import range_supported
+        from spark_rapids_tpu.shuffle.transport import (
+            make_transport, range_serialize_enabled)
         with self._lock:
             if self._transport is None:
                 t = make_transport(self.mode, self.out_partitions,
                                    self.schema, self.writer_threads,
                                    self.codec)
-                t.write(self._slices())
+                if (t.supports_range_write and range_serialize_enabled()
+                        and range_supported(self.schema)):
+                    t.write_batches(self._range_stream())
+                else:
+                    t.write(self._slices())
                 self._transport = t
             return self._transport
 

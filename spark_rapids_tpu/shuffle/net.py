@@ -974,11 +974,27 @@ class TcpShuffleTransport:
         if participants:
             self.executor.declare_shuffle(self.shuffle_id, participants)
 
+    supports_range_write = True
+
     def write(self, pieces: Iterable[Tuple[int, ColumnarBatch]]) -> None:
         from spark_rapids_tpu.shuffle.serializer import serialize_batch
         for p, piece in pieces:
             self.executor.store.put(self.shuffle_id, p,
                                     serialize_batch(piece, self.codec))
+        self.executor.store.mark_complete(self.shuffle_id)
+        self.executor.map_complete(self.shuffle_id)
+
+    def write_batches(self, batches) -> None:
+        """Range write (MULTIPROCESS): every partition's wire block is
+        framed from row ranges of one downloaded map batch; map-side CRC
+        is still computed once per block at BlockStore.put."""
+        from spark_rapids_tpu.shuffle.serializer import serialize_batch_ranges
+        for host_batch, host_counts in batches:
+            blocks = serialize_batch_ranges(host_batch, host_counts,
+                                            self.codec)
+            for p, block in enumerate(blocks):
+                if block is not None:
+                    self.executor.store.put(self.shuffle_id, p, block)
         self.executor.store.mark_complete(self.shuffle_id)
         self.executor.map_complete(self.shuffle_id)
 

@@ -14,6 +14,7 @@ compression is not a TPU primitive.
 from __future__ import annotations
 
 import struct
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -22,6 +23,7 @@ from spark_rapids_tpu import native
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
 from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
+from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
 
 MAGIC = 0x54414431
 
@@ -44,18 +46,45 @@ def wire_supported(dt: T.DataType) -> bool:
     return dt.np_dtype is not None
 
 
+def _is_host_batch(batch: ColumnarBatch) -> bool:
+    """True once every leaf is host numpy (after _download_batch)."""
+    return isinstance(batch.num_rows, (np.ndarray, np.generic, int))
+
+
+def _download_batch(batch: ColumnarBatch) -> ColumnarBatch:
+    """ONE batched D2H transfer of the whole batch pytree (num_rows +
+    every buffer of every column, nested children included).  Everything
+    downstream then works on host numpy views — the serializers must
+    never sync per column (the pre-r6 path paid 2-3 blocking np.asarray
+    syncs per column per partition)."""
+    if _is_host_batch(batch):
+        return batch
+    import jax
+    # tpu-lint: allow-host-sync(THE map-side download: one batched device_get per serialized batch, counted in map_d2h_syncs)
+    host = jax.device_get(batch)
+    SHUFFLE_COUNTERS.add(map_d2h_syncs=1)
+    return host
+
+
 def _host_cols(batch: ColumnarBatch):
-    """Download device batch -> [(validity, offsets|None, data)] trimmed to
-    live rows (the wire carries no padding)."""
+    """Download device batch (one batched device_get) ->
+    [(validity, offsets|None, data)] trimmed to live rows (the wire
+    carries no padding)."""
+    batch = _download_batch(batch)
     n = batch.host_num_rows()
     cols = []
     for c in batch.columns:
+        # numpy views over the already-downloaded host batch from here on
+        # tpu-lint: allow-host-sync(host numpy views; _download_batch above did the one real D2H)
         valid = np.asarray(c.validity)[:n]
         if c.is_string_like:
+            # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
             offsets = np.asarray(c.offsets)[:n + 1]
+            # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
             data = np.asarray(c.data)[:int(offsets[n]) if n else 0]
             cols.append((valid, offsets, data))
         else:
+            # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
             cols.append((valid, None, np.asarray(c.data)[:n]))
     return cols, n
 
@@ -88,6 +117,11 @@ def _has_nested(schema: Schema) -> bool:
 
 
 def serialize_batch(batch: ColumnarBatch, codec: str = "none") -> bytes:
+    # download before the timer starts so map_serialize_ns means the same
+    # thing on both write paths: host framing only, never the D2H wait
+    # (serialize_batch_ranges times after download_partitioned the same way)
+    batch = _download_batch(batch)
+    t0 = time.perf_counter_ns()
     if _has_nested(batch.schema):
         payload = _py_serialize_nested(batch)
     else:
@@ -96,7 +130,144 @@ def serialize_batch(batch: ColumnarBatch, codec: str = "none") -> bytes:
             payload = native.kudo_serialize(cols, n)
         else:
             payload = _py_serialize(cols, n)
-    return _compress(payload, codec)
+    out = _compress(payload, codec)
+    SHUFFLE_COUNTERS.add(map_serialize_bytes=len(out),
+                         map_serialize_ns=time.perf_counter_ns() - t0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# range serialization (map-side contiguous-split wire path)
+#
+# The reference never materializes per-partition sub-tables on the map
+# side: GpuPartitioning.scala:66 runs one device partition, and the Kudo
+# serializer writes a ROW RANGE of the packed table straight onto the
+# wire.  The analog here: the partition program already leaves the batch
+# partition-contiguous, so the wire writer downloads it ONCE (a single
+# batched jax.device_get) and frames every partition's block from host
+# row ranges — per-range validity packbits, per-range offset rebase, and
+# the fixed-width/string payloads ride as zero-copy numpy views until the
+# final b"".join.  Replaces 1 + O(partitions) gather launches and
+# O(partitions x columns) blocking downloads per map batch with the one
+# (already fused) partition program plus one download.
+
+
+def range_supported(schema: Schema) -> bool:
+    """Schemas the range writer can frame: the flat wire layout (nested
+    schemas keep the per-piece path; its downloads are batched too)."""
+    return (not _has_nested(schema)
+            and all(wire_supported(d) for d in schema.dtypes))
+
+
+def download_partitioned(batch: ColumnarBatch, counts):
+    """ONE batched D2H transfer of (partition-ordered batch, counts) —
+    the single map-side sync of the range write path.  ``counts`` may
+    already be host numpy (the fused map path ships counts with its
+    feedback fetch); the batch download is folded in either way."""
+    if _is_host_batch(batch):
+        return batch, np.asarray(counts)
+    import jax
+    # tpu-lint: allow-host-sync(THE map-side download: one batched device_get of batch+counts per map batch, counted in map_d2h_syncs)
+    host_batch, host_counts = jax.device_get((batch, counts))
+    SHUFFLE_COUNTERS.add(map_d2h_syncs=1)
+    return host_batch, np.asarray(host_counts)
+
+
+def serialize_batch_ranges(batch: ColumnarBatch, host_counts,
+                           codec: str = "none") -> List[Optional[bytes]]:
+    """Frame one wire block per partition from a partition-ordered batch:
+    partition p's rows occupy [bounds[p], bounds[p+1]) where bounds is
+    the exclusive cumsum of ``host_counts``.  Returns [block|None] per
+    partition (None = empty), each block merge-equal to serializing a
+    device slice of the same rows.  Accepts a device batch (downloads it
+    with download_partitioned) or an already-downloaded host batch."""
+    assert range_supported(batch.schema), batch.schema
+    batch, host_counts = download_partitioned(batch, host_counts)
+    t0 = time.perf_counter_ns()
+    bounds = np.zeros(len(host_counts) + 1, np.int64)
+    np.cumsum(host_counts, out=bounds[1:])
+    cols = []
+    for c in batch.columns:
+        # host numpy views; the one real download happened above
+        # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
+        valid = np.asarray(c.validity)
+        if c.is_string_like:
+            # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
+            cols.append((valid, np.asarray(c.offsets), np.asarray(c.data)))
+        else:
+            # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
+            cols.append((valid, None, np.ascontiguousarray(c.data)))
+    if native.available():
+        if codec in ("zstd", "lz4"):
+            raw = native.kudo_serialize_ranges(cols, bounds)
+            blocks = [None if r is None else _compress(r, codec) for r in raw]
+        else:
+            # uncompressed: the wire tag is laid down in the native output
+            # buffer, so each block is ONE copy total (mirrors
+            # _compress_parts on the numpy path)
+            blocks = native.kudo_serialize_ranges(cols, bounds, prefix=b"N")
+    else:
+        parts = _py_serialize_ranges(cols, bounds)
+        blocks = [None if pl is None else _compress_parts(pl, codec)
+                  for pl in parts]
+    SHUFFLE_COUNTERS.add(
+        map_range_batches=1,
+        map_range_blocks=sum(b is not None for b in blocks),
+        map_serialize_bytes=sum(len(b) for b in blocks if b is not None),
+        map_serialize_ns=time.perf_counter_ns() - t0)
+    return blocks
+
+
+def _py_serialize_ranges(cols, bounds) -> List[Optional[list]]:
+    """Numpy range writer (no-toolchain fallback + differential oracle
+    for tk_serialize_range).  cols: [(validity, offsets|None, data)] full
+    host arrays of the partition-ordered batch.  Returns a PARTS LIST per
+    partition — struct headers plus zero-copy views into the shared data
+    arrays — so the only copy of fixed-width/string payload bytes is the
+    final b"".join in _compress_parts."""
+    ncols = len(cols)
+    out: List[Optional[list]] = []
+    for p in range(len(bounds) - 1):
+        s, e = int(bounds[p]), int(bounds[p + 1])
+        n = e - s
+        if n == 0:
+            out.append(None)
+            continue
+        vb = (n + 7) // 8
+        metas, bodies = [], []
+        for valid, offsets, data in cols:
+            if offsets is not None:
+                ob = (n + 1) * 4
+                db = int(offsets[e]) - int(offsets[s])
+            else:
+                ob = 0
+                db = n * data.dtype.itemsize
+            metas.append(struct.pack("<BBHQQQ", 0,
+                                     1 if offsets is not None else 0,
+                                     0, vb, ob, db))
+            bits = np.packbits(valid[s:e].astype(np.uint8),
+                               bitorder="little")
+            body = [bits.tobytes().ljust(vb, b"\0")]
+            if offsets is not None:
+                # rebase to the range (the one small copy strings need)
+                body.append((offsets[s:e + 1]
+                             - offsets[s]).astype(np.int32))
+                body.append(data[int(offsets[s]):int(offsets[e])])
+            else:
+                body.append(data[s:e])
+            bodies.append(body)
+        out.append([struct.pack("<IIQ", MAGIC, ncols, n)] + metas
+                   + [part for body in bodies for part in body])
+    return out
+
+
+def _compress_parts(parts: list, codec: str) -> bytes:
+    """Assemble a parts-list wire block: for the uncompressed codec the
+    tag joins with the views (ONE copy total); codecs need the payload
+    materialized first."""
+    if codec not in ("zstd", "lz4"):
+        return b"".join([b"N"] + parts)
+    return _compress(b"".join(parts), codec)
 
 
 def wire_row_count(block: bytes) -> Optional[int]:
@@ -150,7 +321,6 @@ def merge_batches(buffers: List[bytes], schema: Schema) -> Optional[ColumnarBatc
 
 
 def _count_merge(batch: ColumnarBatch, n_blocks: int) -> ColumnarBatch:
-    from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
     SHUFFLE_COUNTERS.add(merges=1, merge_input_blocks=n_blocks)
     return batch
 
@@ -262,26 +432,36 @@ MAGIC2 = 0x54414432
 
 
 def _col_host_nested(col, n: int):
-    """Download one device column (recursively) trimmed to n live rows."""
+    """View one column of an already-downloaded host batch (recursively)
+    trimmed to n live rows.  Callers run _download_batch first — every
+    np.asarray below is a free view over host numpy, never a sync."""
+    # tpu-lint: allow-host-sync(host numpy views; the caller's _download_batch did the one real D2H)
     valid = np.asarray(col.validity)[:n]
     if col.is_struct:
         kids = [_col_host_nested(c, n) for c in col.children]
         return ("struct", valid, None, kids)
     if col.is_map:
+        # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
         offsets = np.asarray(col.offsets)[:n + 1]
         ne = int(offsets[n]) if n else 0
         kids = [_col_host_nested(c, ne) for c in col.children]
         return ("map", valid, offsets, kids)
     if col.is_array:
+        # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
         offsets = np.asarray(col.offsets)[:n + 1]
         ne = int(offsets[n]) if n else 0
+        # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
         data = np.asarray(col.data)[:ne]
+        # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
         cvalid = np.asarray(col.child_validity)[:ne]
         return ("array", valid, offsets, [("fixed", cvalid, None, data)])
     if col.offsets is not None:
+        # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
         offsets = np.asarray(col.offsets)[:n + 1]
         nb = int(offsets[n]) if n else 0
+        # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
         return ("string", valid, offsets, np.asarray(col.data)[:nb])
+    # tpu-lint: allow-host-sync(host numpy view of the downloaded batch)
     return ("fixed", valid, None, np.asarray(col.data)[:n])
 
 
@@ -308,6 +488,11 @@ def _write_block(parts: list, block) -> None:
 
 
 def _py_serialize_nested(batch: ColumnarBatch) -> bytes:
+    # ONE batched download of the whole nested pytree (every child's
+    # buffers included), then pure host framing — the nested/CACHE-
+    # fallback schemas the range path doesn't take still pay exactly one
+    # D2H sync per serialized piece
+    batch = _download_batch(batch)
     n = batch.host_num_rows()
     parts = [struct.pack("<IIQ", MAGIC2, len(batch.columns), n)]
     for col in batch.columns:

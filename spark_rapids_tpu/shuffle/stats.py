@@ -23,6 +23,13 @@ _FIELDS = (
     "bytes_fetched",          # wire bytes received over the network
     # overlap
     "prefetch_stall_ns",      # consumer blocked on an empty prefetch queue
+    # map side (range-serialization write path; serializer.py)
+    "map_range_batches",      # map batches written via range framing
+    "map_range_blocks",       # partition wire blocks framed from row ranges
+    "map_d2h_syncs",          # serializer device->host downloads (range
+                              # path: exactly 1 per map batch)
+    "map_serialize_bytes",    # wire bytes produced by the map serializer
+    "map_serialize_ns",       # wall time in map-side wire framing
     # merge
     "merges",                 # merge_batches materializations (HBM uploads)
     "merge_input_blocks",     # wire blocks consumed by those merges

@@ -37,9 +37,25 @@ class ShuffleTransport(abc.ABC):
     """Store-and-forward data plane: map side writes (partition, batch)
     pieces; reduce side reads every piece for one partition."""
 
+    #: True when the transport implements write_batches — the range-
+    #: serialization write path (one download per map batch, partition
+    #: blocks framed from host row ranges).  CacheOnlyTransport stays
+    #: False: its handles must remain device-resident and spillable, so
+    #: it keeps the device-slice write.
+    supports_range_write = False
+
     @abc.abstractmethod
     def write(self, pieces: Iterable[Tuple[int, ColumnarBatch]]) -> None:
         """Consume the map side's partition slices (called once)."""
+
+    def write_batches(self, batches) -> None:
+        """Range-serialization write path (called once, instead of
+        write()): consume (partition-ordered host batch, host
+        per-partition counts) pairs — the exchange hands each map batch
+        over WITHOUT slicing and the transport frames every partition's
+        wire block from row ranges (serializer.serialize_batch_ranges).
+        Only called when ``supports_range_write``."""
+        raise NotImplementedError(type(self).__name__)
 
     def read_iter(self, partition: int, target_rows: Optional[int] = None):
         """Streaming read: yield a partition's batches incrementally so
@@ -84,6 +100,8 @@ class CacheOnlyTransport(ShuffleTransport):
 class KudoWireTransport(ShuffleTransport):
     """Host-staged kudo wire bytes, threaded serialize (MULTITHREADED)."""
 
+    supports_range_write = True
+
     def __init__(self, num_partitions: int, schema: Schema,
                  writer_threads: int = 4, codec: str = "none"):
         self._buckets: List[List[bytes]] = [[] for _ in range(num_partitions)]
@@ -99,6 +117,70 @@ class KudoWireTransport(ShuffleTransport):
                        for p, piece in pieces]
             for p, fut in futures:
                 self._buckets[p].append(fut.result())
+
+    def write_batches(self, batches):
+        """Range write: each map batch arrives host-resident with its
+        partition counts (ONE download upstream); framing is pure host
+        work and parallelizes across batches on the writer pool.  In-
+        flight submissions are bounded to ~2x the pool so a large map
+        side holds O(writer_threads) uncompressed host batches, not all
+        of them, while the framed blocks still land in batch order."""
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+        from spark_rapids_tpu.shuffle.serializer import serialize_batch_ranges
+
+        def drain(fut):
+            for p, block in enumerate(fut.result()):
+                if block is not None:
+                    self._buckets[p].append(block)
+
+        pending = deque()
+        with ThreadPoolExecutor(max_workers=self.writer_threads) as pool:
+            for hb, counts in batches:
+                pending.append(pool.submit(serialize_batch_ranges, hb,
+                                           counts, self.codec))
+                if len(pending) >= 2 * self.writer_threads:
+                    drain(pending.popleft())
+            while pending:
+                drain(pending.popleft())
+
+    def read_iter(self, partition: int, target_rows: Optional[int] = None):
+        """Streaming read: merge wire blocks in chunks aligned to the
+        consumer's coalesce target (wire_row_count reads rows without
+        decompressing), so an oversized reduce partition streams like
+        the TCP plane instead of materializing in ONE merge.  A codec
+        that hides the header falls back to the whole-partition merge."""
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
+        from spark_rapids_tpu.shuffle.serializer import (
+            merge_batches, wire_row_count)
+        buffers = self._buckets[partition]
+        if not buffers:
+            return
+        if not target_rows:
+            yield from self.read(partition)
+            return
+        chunk: List[bytes] = []
+        rows = 0
+        for raw in buffers:
+            rc = wire_row_count(raw)
+            if rc is None:
+                yield from self.read(partition)
+                return
+            chunk.append(raw)
+            rows += rc
+            if rows >= target_rows:
+                # under retry: inputs are host wire bytes (idempotent),
+                # the merge is this chunk's one HBM materialization
+                out = with_retry_no_split(
+                    lambda c=chunk: merge_batches(c, self.schema))
+                chunk, rows = [], 0
+                if out is not None:
+                    yield out
+        if chunk:
+            out = with_retry_no_split(
+                lambda: merge_batches(chunk, self.schema))
+            if out is not None:
+                yield out
 
     def read(self, partition: int) -> List[ColumnarBatch]:
         from spark_rapids_tpu.memory.retry import with_retry_no_split
@@ -188,6 +270,21 @@ _completeness_timeout_s: float = 120.0
 def set_completeness_timeout(seconds: float) -> None:
     global _completeness_timeout_s
     _completeness_timeout_s = float(seconds)
+
+
+#: map-side range serialization (spark.rapids.shuffle.write.rangeSerialize):
+#: frame partition wire blocks from row ranges of ONE downloaded batch
+#: instead of downloading a gathered device slice per partition.  Escape
+#: hatch, default on; CACHE_ONLY ignores it (device-resident handles).
+_RANGE_SERIALIZE = [True]
+
+
+def set_range_serialize(enabled: bool) -> None:
+    _RANGE_SERIALIZE[0] = bool(enabled)
+
+
+def range_serialize_enabled() -> bool:
+    return _RANGE_SERIALIZE[0]
 
 
 #: receive-side flow-control window (spark.rapids.shuffle.fetch.*):

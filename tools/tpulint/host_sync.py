@@ -20,7 +20,11 @@ cuDF column per batch).  Flagged forms:
       lexically inside a for/while body — batch the downloads into one
       ``jax.device_get`` of the whole pytree instead.
 
-Scope: expressions/, kernels/, plan/ (execs + fused engine), parallel/.
+Scope: expressions/, kernels/, plan/ (execs + fused engine), parallel/,
+plus the shuffle wire hot paths (shuffle/serializer.py,
+shuffle/transport.py) — the map-side range-serialization contract is ONE
+batched download per map batch, and an unsuppressed per-column download
+loop regrowing there is exactly the regression this rule exists to stop.
 """
 from __future__ import annotations
 
@@ -36,6 +40,11 @@ SCOPE_PREFIXES = (
     "spark_rapids_tpu/kernels/",
     "spark_rapids_tpu/plan/",
     "spark_rapids_tpu/parallel/",
+    # shuffle wire hot paths: contractual syncs (the one batched map-side
+    # download) carry reasoned inline suppressions; anything else is a
+    # per-column download loop trying to grow back
+    "spark_rapids_tpu/shuffle/serializer.py",
+    "spark_rapids_tpu/shuffle/transport.py",
 )
 
 DEVICE_SCALAR_FNS = {"max_live_string_bytes", "max_live_bytes_multi"}

@@ -99,8 +99,20 @@ class TpuClusterDriver:
         # make drop_query(0) collect unrelated standalone shuffles
         self._next_query = 1
         self._tasks: Dict[str, dict] = {}       # executor_id -> task
-        self._results: Dict[int, Dict[str, object]] = {}
+        #: qid -> {rank: {"result", "eid", "attempt", "t"}} — FIRST
+        #: result per rank wins (speculation: the loser's late push is
+        #: dropped here)
+        self._results: Dict[int, Dict[int, dict]] = {}
         self._expected: Dict[int, List[str]] = {}
+        #: qid -> {rank: [attempt records {eid, attempt, kind,
+        #: t_dispatch, t_pickup, failed}]} — the driver's view of who is
+        #: (or was) running each rank, feeding loss detection,
+        #: speculation and idle-executor selection
+        self._attempts: Dict[int, Dict[int, List[dict]]] = {}
+        #: qid -> [{rank, attempt, eid, error, retryable}]
+        self._task_failures: Dict[int, List[dict]] = {}
+        #: qid -> next query-unique attempt id (non-primary dispatches)
+        self._attempt_seq: Dict[int, int] = {}
         #: (query_id, key) -> {executor_id: [int, ...]} — the runtime
         #: statistics barrier adaptive decisions aggregate through
         self._stats: Dict[Tuple[int, str], Dict[str, List[int]]] = {}
@@ -134,9 +146,11 @@ class TpuClusterDriver:
                         "ok": True, "conf": driver.conf_map,
                         "shuffle_addr": list(driver.shuffle.server.addr)})
                 elif op == "get_task":
+                    eid = header["executor_id"]
                     with driver._lock:
-                        task = driver._tasks.pop(header["executor_id"],
-                                                 None)
+                        task = driver._tasks.pop(eid, None)
+                        if task is not None:
+                            driver._note_pickup_locked(task, eid)
                     if task is None:
                         _send_msg(self.request, {"task": None})
                     else:
@@ -146,22 +160,43 @@ class TpuClusterDriver:
                                   task["plan"])
                 elif op == "task_result":
                     qid = header["query_id"]
+                    eid = header["executor_id"]
                     err = header.get("error")
-                    if err is not None:
-                        # retryable marks failures worth a scoped
-                        # re-dispatch (fetch/budget/injected faults);
-                        # deterministic query errors stay fatal
-                        result = {"error": err,
-                                  "retryable": bool(
-                                      header.get("retryable", False))}
-                    else:
-                        result = pickle.loads(payload)
+                    accept = None
                     with driver._lock:
                         # ignore stragglers from aborted attempts: only
                         # queries still awaited accept results
                         if qid in driver._expected:
-                            driver._results.setdefault(qid, {})[
-                                header["executor_id"]] = result
+                            rank, attempt = driver._resolve_attempt_locked(
+                                qid, eid, header.get("rank"),
+                                header.get("attempt"))
+                            if err is not None:
+                                # retryable marks failures worth a
+                                # re-dispatch (fetch/budget/injected
+                                # faults); deterministic query errors
+                                # stay fatal
+                                driver._note_failure_locked(
+                                    qid, rank, attempt, eid, err,
+                                    bool(header.get("retryable", False)))
+                            elif rank is not None and rank not in \
+                                    driver._results.setdefault(qid, {}):
+                                accept = (rank, attempt)
+                    if accept is not None:
+                        # FIRST result per rank wins; a beaten attempt's
+                        # late rows never even deserialize.  The loads
+                        # runs OUTSIDE the driver lock (a multi-MB result
+                        # must not stall get_task/heartbeat handlers);
+                        # setdefault re-arbitrates the rare concurrent
+                        # push for the same rank.
+                        rank, attempt = accept
+                        result = pickle.loads(payload)
+                        with driver._lock:
+                            if qid in driver._expected:
+                                driver._results.setdefault(
+                                    qid, {}).setdefault(rank, {
+                                        "result": result, "eid": eid,
+                                        "attempt": attempt,
+                                        "t": time.monotonic()})
                     _send_msg(self.request, {"ok": True})
                 elif op == "plan_fingerprint":
                     # fail-loudly guard: every rank's canonical physical-
@@ -287,9 +322,12 @@ class TpuClusterDriver:
         """Scope the next attempt: exclude the lost executors from the
         registry NOW (don't wait for their records to age out) and
         invalidate the failed attempt's shuffle state everywhere."""
-        for eid in e.lost:
-            self.shuffle.registry.exclude(eid)
-        SHUFFLE_COUNTERS.add(executors_excluded=len(e.lost))
+        # exclude() returns False for peers already gone (the durable
+        # path may have excluded them before escalating here) — count
+        # only fresh exclusions
+        newly = sum(1 for eid in e.lost
+                    if self.shuffle.registry.exclude(eid))
+        SHUFFLE_COUNTERS.add(executors_excluded=newly)
         self._invalidate_query(e.query_id)
 
     def _invalidate_query(self, query_id: int) -> None:
@@ -311,77 +349,281 @@ class TpuClusterDriver:
                             query_id, eid, err)
         SHUFFLE_COUNTERS.add(shuffle_invalidations=dropped)
 
+    # -- attempt bookkeeping (all _locked helpers run under self._lock) ------
+
+    def _note_pickup_locked(self, task: dict, eid: str) -> None:
+        recs = self._attempts.get(task["query_id"], {}).get(
+            task.get("rank", -1), [])
+        for a in recs:
+            if a["eid"] == eid and a["attempt"] == task.get("attempt", 0):
+                a["t_pickup"] = time.monotonic()
+
+    def _resolve_attempt_locked(self, qid: int, eid: str, rank, attempt):
+        """(rank, attempt) for an executor's result push.  Executors echo
+        both; legacy harnesses that don't are resolved from the latest
+        attempt record naming this executor."""
+        if rank is not None:
+            return int(rank), int(attempt or 0)
+        for r, recs in self._attempts.get(qid, {}).items():
+            for a in reversed(recs):
+                if a["eid"] == eid:
+                    return r, a["attempt"]
+        return None, 0
+
+    def _note_failure_locked(self, qid: int, rank, attempt: int, eid: str,
+                             error: str, retryable: bool) -> None:
+        self._task_failures.setdefault(qid, []).append(
+            {"rank": rank, "attempt": attempt, "eid": eid,
+             "error": error, "retryable": retryable})
+        if rank is None:
+            return
+        for a in self._attempts.get(qid, {}).get(rank, []):
+            if a["eid"] == eid and a["attempt"] == attempt:
+                a["failed"] = True
+
+    def _dispatch_attempt_locked(self, qid: int, rank: int, eid: str,
+                                 attempt: Optional[int], kind: str,
+                                 proto: dict) -> int:
+        """Queue one attempt of ``rank`` on ``eid``.  ``proto`` carries
+        the query-constant fields (world/participants/conf/plan); ``as``
+        pins the LOGICAL participant slot so the shuffle registry sees
+        one consistent membership whichever executor physically runs.
+
+        ``attempt`` None allocates the next QUERY-UNIQUE attempt id
+        (speculation/re-dispatch).  Attempt ids tag map-output blocks in
+        the executors' stores, and one node may hold several ranks'
+        blocks for one shuffle (its own primary plus adopted copies) —
+        per-RANK numbering would collide there, and a losing attempt's
+        drop could delete another rank's committed blocks.  Primaries
+        all use 0: exactly one primary runs per node, so 0 never
+        collides within a store."""
+        if attempt is None:
+            attempt = self._attempt_seq.get(qid, 1)
+            self._attempt_seq[qid] = attempt + 1
+        self._tasks[eid] = dict(proto, rank=rank, attempt=attempt,
+                                **{"as": proto["participants"][rank]})
+        self._attempts.setdefault(qid, {}).setdefault(rank, []).append(
+            {"eid": eid, "attempt": attempt, "kind": kind,
+             "t_dispatch": time.monotonic(), "t_pickup": None,
+             "failed": False})
+        return attempt
+
+    def _idle_executors_locked(self, qid: int, live) -> List[str]:
+        """Live workers with no queued task and no unfinished attempt of
+        this query — speculation/re-dispatch targets.  Late joiners sort
+        first: a rank that registered mid-session is the natural adoption
+        target (it is idle by construction)."""
+        results = self._results.get(qid, {})
+        busy = set()
+        for r, recs in self._attempts.get(qid, {}).items():
+            if r in results:
+                continue
+            for a in recs:
+                if not a["failed"]:
+                    busy.add(a["eid"])
+        original = set(self._expected.get(qid, ()))
+        idle = [eid for eid in sorted(live)
+                if eid not in busy and eid not in self._tasks]
+        return ([e for e in idle if e not in original]
+                + [e for e in idle if e in original])
+
+    @staticmethod
+    def _quantile(durations: List[float], q: float) -> float:
+        xs = sorted(durations)
+        idx = min(int(len(xs) * max(min(q, 1.0), 0.0)), len(xs) - 1)
+        return xs[idx]
+
     def _submit_once(self, logical_plan, timeout_s: float,
                      conf_overrides: Optional[Dict[str, str]] = None
                      ) -> list:
+        from spark_rapids_tpu.config import RapidsConf
         executors = sorted(
             self.shuffle.registry.peers(workers_only=True))
         assert executors, "no executors registered"
         world = len(executors)
+        merged = dict(self.conf_map)
+        merged.update(conf_overrides or {})
+        rc = RapidsConf(merged)
+        #: replication makes map output durable: executor loss then costs
+        #: a single-rank re-dispatch + replica re-fetches instead of the
+        #: scoped whole-query resubmit
+        durable = rc.shuffle_replication_factor > 1
+        spec_on = rc.speculation_enabled and world > 1
         plan_bytes = pickle.dumps(logical_plan)
+        proto = {"world": world, "participants": executors,
+                 # per-query conf (the registration broadcast is static;
+                 # these override)
+                 "conf_overrides": dict(conf_overrides or {}),
+                 "plan": plan_bytes}
         with self._lock:
             qid = self._next_query
             self._next_query += 1
+            proto["query_id"] = qid
             self._expected[qid] = executors
+            self._attempts[qid] = {}
+            self._task_failures[qid] = []
+            self._results[qid] = {}
             for rank, eid in enumerate(executors):
-                self._tasks[eid] = {"query_id": qid, "rank": rank,
-                                    "world": world,
-                                    "participants": executors,
-                                    # per-query conf (the registration
-                                    # broadcast is static; these override)
-                                    "conf_overrides": dict(
-                                        conf_overrides or {}),
-                                    "plan": plan_bytes}
+                self._dispatch_attempt_locked(qid, rank, eid, 0,
+                                              "primary", proto)
         deadline = time.monotonic() + timeout_s
-        lost: List[str] = []
-        while time.monotonic() < deadline:
-            with self._lock:
-                got = self._results.get(qid, {})
-                if len(got) == world:
+        lost_exc: Optional[ExecutorLostError] = None
+        retry_exc: Optional[TaskRetryableError] = None
+        fatal: Optional[str] = None
+        excluded: set = set()
+        spec_counted: set = set()
+        durations: Dict[int, float] = {}
+        try:
+            while time.monotonic() < deadline:
+                live = self.shuffle.registry.peers(workers_only=True)
+                now = time.monotonic()
+                with self._lock:
+                    results = dict(self._results.get(qid, {}))
+                    failures = list(self._task_failures.get(qid, []))
+                    attempts = {r: [dict(a) for a in recs] for r, recs
+                                in self._attempts.get(qid, {}).items()}
+                # completion accounting (speculative wins + durations
+                # feed the straggler baseline)
+                for r, res in results.items():
+                    if r in durations:
+                        continue
+                    t0 = next((a["t_pickup"] or a["t_dispatch"]
+                               for a in attempts.get(r, [])
+                               if a["eid"] == res["eid"]
+                               and a["attempt"] == res["attempt"]),
+                              None)
+                    durations[r] = res["t"] - t0 if t0 else 0.0
+                    kind = next((a["kind"] for a in attempts.get(r, [])
+                                 if a["eid"] == res["eid"]
+                                 and a["attempt"] == res["attempt"]), "")
+                    if kind == "spec" and r not in spec_counted:
+                        spec_counted.add(r)
+                        SHUFFLE_COUNTERS.add(speculative_wins=1)
+                if len(results) == world:
                     break
-            live = self.shuffle.registry.peers(workers_only=True)
-            lost = [eid for eid in executors
-                    if eid not in live and eid not in got]
-            if lost:
-                break
-            time.sleep(0.05)
-        with self._lock:
-            got = self._results.pop(qid, {})
-            self._expected.pop(qid, None)
-            self._fingerprints.pop(qid, None)
-            for k in [k for k in self._stats if k[0] == qid]:
-                self._stats.pop(k, None)
-            # drop any task a lost executor never picked up
-            for eid in executors:
-                t = self._tasks.get(eid)
-                if t is not None and t["query_id"] == qid:
+                # deterministic failures stay fatal
+                hard = [f for f in failures if not f["retryable"]]
+                if hard:
+                    fatal = "; ".join(f"{f['eid']}: {f['error']}"
+                                      for f in hard)
+                    break
+                pending = [r for r in range(world) if r not in results]
+                # a retryable failure only fails the ATTEMPT; the query
+                # retries (scoped, fresh qid) when a rank has no other
+                # attempt left to decide it
+                for f in failures:
+                    r = f.get("rank")
+                    if r is None or r in results:
+                        continue
+                    others = [a for a in attempts.get(r, [])
+                              if not a["failed"] and a["eid"] in live]
+                    if not others:
+                        retry_exc = TaskRetryableError(
+                            f"query {qid}: retryable task failure(s): "
+                            f"{f['eid']}: {f['error']}", query_id=qid)
+                        break
+                if retry_exc is not None:
+                    break
+                # executor loss: every attempt of a pending rank is dead
+                lost_ranks = [
+                    r for r in pending
+                    if attempts.get(r) and all(
+                        a["failed"] or a["eid"] not in live
+                        for a in attempts[r])
+                    and any(a["eid"] not in live for a in attempts[r])]
+                if lost_ranks:
+                    dead = sorted({a["eid"] for r in lost_ranks
+                                   for a in attempts[r]
+                                   if a["eid"] not in live})
+                    if not durable or any(len(attempts[r]) >= 3
+                                          for r in lost_ranks):
+                        lost_exc = ExecutorLostError(
+                            f"query {qid}: executor(s) {dead} lost "
+                            f"mid-query ({len(results)}/{world} results)",
+                            query_id=qid, lost=dead)
+                        break
+                    # durable path: the dead rank's committed map outputs
+                    # survive as replicas, so re-dispatch ONLY that rank
+                    # (attempt+1, same qid => same shuffle ids) and let
+                    # survivors re-fetch instead of re-executing
+                    for eid in dead:
+                        if eid not in excluded:
+                            excluded.add(eid)
+                            self.shuffle.registry.exclude(eid)
+                            SHUFFLE_COUNTERS.add(executors_excluded=1)
+                    live = self.shuffle.registry.peers(workers_only=True)
+                    with self._lock:
+                        idle = self._idle_executors_locked(qid, live)
+                        for r in lost_ranks:
+                            if not idle:
+                                break   # wait for a survivor to free up
+                            cand = idle.pop(0)
+                            self._dispatch_attempt_locked(
+                                qid, r, cand, None, "redispatch", proto)
+                            SHUFFLE_COUNTERS.add(rank_redispatches=1)
+                            log.warning(
+                                "query %d: rank %d re-dispatched to %s "
+                                "after loss of %s (replica re-fetch "
+                                "path)", qid, r, cand, dead)
+                # straggler speculation: one extra attempt per rank once
+                # enough tasks completed to trust the duration baseline
+                if spec_on and len(durations) >= max(
+                        rc.speculation_min_tasks, 1):
+                    baseline = self._quantile(list(durations.values()),
+                                              rc.speculation_quantile)
+                    threshold = max(baseline
+                                    * rc.speculation_multiplier, 1e-3)
+                    with self._lock:
+                        idle = self._idle_executors_locked(qid, live)
+                        for r in pending:
+                            recs = self._attempts[qid].get(r, [])
+                            if len(recs) != 1 or not idle:
+                                continue    # already speculated, or
+                                            # nobody to run the copy
+                            a0 = recs[0]
+                            t0 = a0["t_pickup"] or a0["t_dispatch"]
+                            if now - t0 <= threshold:
+                                continue
+                            cand = next((e for e in idle
+                                         if e != a0["eid"]), None)
+                            if cand is None:
+                                continue
+                            idle.remove(cand)
+                            self._dispatch_attempt_locked(
+                                qid, r, cand, None, "spec", proto)
+                            SHUFFLE_COUNTERS.add(speculative_launches=1)
+                            log.info("query %d: rank %d speculating on "
+                                     "%s (elapsed %.2fs > %.2fs)",
+                                     qid, r, cand, now - t0, threshold)
+                time.sleep(0.05)
+        finally:
+            with self._lock:
+                results = self._results.pop(qid, {})
+                self._expected.pop(qid, None)
+                self._fingerprints.pop(qid, None)
+                self._attempts.pop(qid, None)
+                self._task_failures.pop(qid, None)
+                self._attempt_seq.pop(qid, None)
+                for k in [k for k in self._stats if k[0] == qid]:
+                    self._stats.pop(k, None)
+                # drop any queued attempt nobody picked up
+                for eid in [eid for eid, t in self._tasks.items()
+                            if t["query_id"] == qid]:
                     self._tasks.pop(eid, None)
-        if lost:
-            raise ExecutorLostError(
-                f"query {qid}: executor(s) {lost} lost mid-query "
-                f"({len(got)}/{world} results)", query_id=qid, lost=lost)
-        if len(got) != world:
+        if fatal is not None:
+            raise RuntimeError(f"query {qid}: executor(s) failed: {fatal}")
+        if retry_exc is not None:
+            raise retry_exc
+        if lost_exc is not None:
+            raise lost_exc
+        if len(results) != world:
             raise TimeoutError(
-                f"query {qid}: {len(got)}/{world} executor results")
-        # failures first: a retryable one re-dispatches the query (scoped
-        # — same live executors, invalidated shuffle state, fresh qid)
-        errors = {eid: r for eid, r in got.items()
-                  if isinstance(r, (str, dict))}
-        if errors:
-            detail = "; ".join(
-                f"{eid}: {r['error'] if isinstance(r, dict) else r}"
-                for eid, r in sorted(errors.items()))
-            if any(isinstance(r, dict) and r.get("retryable")
-                   for r in errors.values()):
-                raise TaskRetryableError(
-                    f"query {qid}: retryable task failure(s): {detail}",
-                    query_id=qid)
-            raise RuntimeError(f"query {qid}: executor(s) failed: {detail}")
+                f"query {qid}: {len(results)}/{world} rank results")
         # results arrive PARTITION-TAGGED: reassemble partition-major so
         # ordered outputs (range sorts) concatenate into the global order
         tagged: List[tuple] = []
-        for eid in executors:
-            tagged.extend(got[eid])
+        for r in range(world):
+            tagged.extend(results[r]["result"])
         rows: list = []
         for _p, part_rows in sorted(tagged, key=lambda t: t[0]):
             rows.extend(part_rows)

@@ -186,6 +186,10 @@ def _check_distributable(physical) -> None:
 
 def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
              driver_rpc=None, executor_id: str = None) -> list:
+    # injected straggler latency (chaos site cluster.task.delay): fires
+    # FIRST so a delayed task looks exactly like a slow worker — the
+    # driver's speculation watches pickup-to-result wall time
+    CHAOS.delay("cluster.task.delay")
     # injected task death (chaos site cluster.task): fires BEFORE any
     # state is built, like a worker dying between pickup and execution;
     # the driver must recover by scoped re-dispatch, not lose the query
@@ -196,10 +200,15 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
     from spark_rapids_tpu.planner.overrides import plan_query
 
     from spark_rapids_tpu.shuffle.transport import (
-        set_cluster_participants, set_cluster_query)
+        set_cluster_identity, set_cluster_participants, set_cluster_query)
     rank, world = task["rank"], task["world"]
     set_cluster_participants(task.get("participants"))
-    set_cluster_query(task["query_id"])
+    # attempt tags this attempt's map blocks (first-commit-wins drops the
+    # loser's by this tag); "as" is the LOGICAL participant slot — a
+    # speculative copy or post-loss re-dispatch commits against the
+    # original assignee's slot so readers see one membership
+    set_cluster_query(task["query_id"], attempt=task.get("attempt", 0))
+    set_cluster_identity(task.get("as"))
     merged = dict(conf_map)
     merged.update(task.get("conf_overrides") or {})
     conf = RapidsConf(merged)
@@ -217,8 +226,12 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
     if world > 1 and driver_rpc is not None:
         from spark_rapids_tpu.cluster.stats import (
             ClusterStatsClient, set_cluster_stats)
+        # stats (and the fingerprint) publish under the LOGICAL slot:
+        # a speculative attempt then OVERWRITES its original's identical
+        # vector instead of summing the rank twice into global decisions
         stats_client = ClusterStatsClient(
-            driver_rpc, task["query_id"], executor_id or "rank%d" % rank,
+            driver_rpc, task["query_id"],
+            task.get("as") or executor_id or "rank%d" % rank,
             world, timeout_s=conf.shuffle_completeness_timeout)
         set_cluster_stats(stats_client)
         # plan-fingerprint guard (pre-rank-wrapping: the fingerprint must
@@ -300,6 +313,7 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
     finally:
         set_cluster_query(None)
         set_cluster_participants(None)
+        set_cluster_identity(None)
         if stats_client is not None:
             from spark_rapids_tpu.cluster.stats import set_cluster_stats
             set_cluster_stats(None)
@@ -411,18 +425,26 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
                     executor_id=node.executor_id)
                 _request(driver_rpc_addr,
                          {"op": "task_result", "query_id": task["query_id"],
-                          "executor_id": node.executor_id},
+                          "executor_id": node.executor_id,
+                          "rank": task.get("rank"),
+                          "attempt": task.get("attempt", 0)},
                          pickle.dumps(rows))
             except Exception as e:  # noqa: BLE001 — report, don't kill
                 crashdump.dump_now("task_failure",
                                    extra={"query_id": task["query_id"],
                                           "error": traceback.format_exc()})
-                # the failed attempt's local shuffle state must not leak
-                # (or satisfy a stale read if this qid ever reappears)
-                node.store.drop_query(task["query_id"])
+                # the failed ATTEMPT's local shuffle state must not leak
+                # (or satisfy a stale read if this qid ever reappears) —
+                # but replicas held for peers, and blocks another attempt
+                # committed here, may be the only surviving copy: drop by
+                # attempt, not the whole query
+                node.store.drop_attempt(task["query_id"],
+                                        task.get("attempt", 0))
                 _request(driver_rpc_addr,
                          {"op": "task_result", "query_id": task["query_id"],
                           "executor_id": node.executor_id,
+                          "rank": task.get("rank"),
+                          "attempt": task.get("attempt", 0),
                           "error": traceback.format_exc(),
                           "retryable": _is_retryable_task_error(e)})
     finally:

@@ -280,6 +280,63 @@ PEER_EXCLUDE_AFTER_FAILURES = conf(
     "clears the record and re-admits a genuinely restarted executor)."
 ).int_conf(3)
 
+SHUFFLE_REPLICATION_FACTOR = conf(
+    "spark.rapids.shuffle.replication.factor").doc(
+    "Copies of each map-output block kept across the cluster (1 = primary "
+    "only, no replication). After a map task commits its blocks, they are "
+    "asynchronously pushed to factor-1 peers chosen by a rendezvous hash "
+    "and announced to the heartbeat registry's replica catalog; reduce "
+    "reads fail over to a replica on peer loss or persistent corruption, "
+    "so losing an executor costs a re-fetch instead of a re-execution "
+    "(the reference's shuffle data surviving its producer, "
+    "RapidsShuffleManager block catalog)."
+).int_conf(1)
+
+SHUFFLE_PERSIST_DIR = conf(
+    "spark.rapids.shuffle.replication.persistDir").doc(
+    "Spill-backed map-output persistence: when set, every block put into "
+    "the local BlockStore is also written under this directory (with its "
+    "CRC), and a restarted executor with the same directory re-serves "
+    "them from disk. The durability fallback when replication.factor is "
+    "1 (no peers to replicate to). Empty disables persistence."
+).string_conf("")
+
+CLUSTER_DRAIN_TIMEOUT = conf("spark.rapids.cluster.drain.timeout").doc(
+    "Seconds a graceful executor leave may spend draining: waiting for "
+    "pending replications and re-replicating its primary map-output "
+    "blocks to surviving peers before deregistering. Exceeding the bound "
+    "leaves anyway (the scoped-recovery path then covers any reads its "
+    "departure orphaned)."
+).double_conf(30.0)
+
+CLUSTER_SPECULATION_ENABLED = conf(
+    "spark.rapids.cluster.speculation.enabled").doc(
+    "Speculative re-dispatch of straggler tasks: the driver compares each "
+    "running task's elapsed time against a quantile of completed-task "
+    "durations and launches ONE speculative copy on an idle executor past "
+    "the threshold; whichever attempt's map outputs commit first wins "
+    "(first-commit-wins at the registry; the loser's blocks are dropped "
+    "by attempt id)."
+).boolean_conf(False)
+
+CLUSTER_SPECULATION_QUANTILE = conf(
+    "spark.rapids.cluster.speculation.quantile").doc(
+    "Quantile of completed-task durations used as the speculation "
+    "baseline (0.5 = median, like Spark's speculation.quantile role)."
+).double_conf(0.5)
+
+CLUSTER_SPECULATION_MULTIPLIER = conf(
+    "spark.rapids.cluster.speculation.multiplier").doc(
+    "A running task is a straggler when its elapsed time exceeds "
+    "multiplier x the baseline quantile of completed-task durations."
+).double_conf(2.0)
+
+CLUSTER_SPECULATION_MIN_TASKS = conf(
+    "spark.rapids.cluster.speculation.minTasks").doc(
+    "Completed tasks required before the duration baseline is considered "
+    "meaningful; no speculation happens below this count."
+).int_conf(2)
+
 CLUSTER_QUERY_DEADLINE = conf("spark.rapids.cluster.query.deadline").doc(
     "Per-query wall-clock deadline in seconds across ALL driver "
     "resubmission attempts (executor loss, retryable task failures). "
@@ -582,6 +639,34 @@ class RapidsConf:
     @property
     def cluster_query_deadline(self) -> float:
         return self.get(CLUSTER_QUERY_DEADLINE)
+
+    @property
+    def shuffle_replication_factor(self) -> int:
+        return self.get(SHUFFLE_REPLICATION_FACTOR)
+
+    @property
+    def shuffle_persist_dir(self) -> str:
+        return self.get(SHUFFLE_PERSIST_DIR) or ""
+
+    @property
+    def cluster_drain_timeout(self) -> float:
+        return self.get(CLUSTER_DRAIN_TIMEOUT)
+
+    @property
+    def speculation_enabled(self) -> bool:
+        return self.get(CLUSTER_SPECULATION_ENABLED)
+
+    @property
+    def speculation_quantile(self) -> float:
+        return self.get(CLUSTER_SPECULATION_QUANTILE)
+
+    @property
+    def speculation_multiplier(self) -> float:
+        return self.get(CLUSTER_SPECULATION_MULTIPLIER)
+
+    @property
+    def speculation_min_tasks(self) -> int:
+        return self.get(CLUSTER_SPECULATION_MIN_TASKS)
 
     @property
     def shuffle_fetch_max_inflight(self) -> int:

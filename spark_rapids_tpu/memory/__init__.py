@@ -54,8 +54,12 @@ def initialize_memory(conf) -> None:
     set_network_retry(conf.network_retry_max_attempts,
                       conf.network_retry_base_delay,
                       conf.network_retry_max_delay)
-    from spark_rapids_tpu.shuffle.transport import set_range_serialize
+    from spark_rapids_tpu.shuffle.transport import (set_range_serialize,
+                                                    set_replication)
     set_range_serialize(conf.shuffle_range_serialize)
+    set_replication(conf.shuffle_replication_factor,
+                    conf.shuffle_persist_dir,
+                    conf.cluster_drain_timeout)
     device_arena().check_retry_context = conf.retry_context_check
     # HBM-budget sizing from the chip's memory stats (GpuDeviceManager):
     # always on, like the reference's default-fraction pool sizing —

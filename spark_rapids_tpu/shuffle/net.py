@@ -369,67 +369,436 @@ class BlockStore:
     """Local map-output store: (shuffle_id, partition) -> list of
     (wire block, checksum).  Thread-safe; shared between the writer and
     the server.  Checksums are computed ONCE at put() (the map side) and
-    travel with every serve, so re-fetches never recompute them."""
+    travel with every serve, so re-fetches never recompute them.
 
-    def __init__(self):
+    Durability extensions (docs/fault_tolerance.md durable shuffle):
+
+      * every shuffle's primary blocks carry the task ATTEMPT that wrote
+        them, so a lost first-commit race can drop exactly its own
+        attempt's blocks (``drop_attempt``) without touching replicas or
+        other attempts' data;
+      * a REPLICA side-table holds other executors' replicated blocks
+        keyed by (shuffle, partition, source logical id).  Replicas are
+        served only by explicit replica reads — never by the primary
+        fetch path, which would double every reduce row;
+      * an optional PERSIST DIR (spill-backed fallback when the
+        replication factor is 1): every primary put also lands on local
+        disk with its CRC in the filename, and a restarted executor with
+        the same directory re-serves blocks it no longer has in memory.
+    """
+
+    def __init__(self, persist_dir: Optional[str] = None):
         self._lock = threading.Lock()
-        self._blocks: Dict[Tuple[int, int], List[Tuple[bytes, int]]] = {}
+        #: (sid, partition) -> [(block, crc, attempt)].  One node may
+        #: legitimately hold blocks of SEVERAL attempts for one shuffle
+        #: (its own rank's output plus an adopted rank's re-dispatch), so
+        #: the attempt tag is per BLOCK: a lost commit race or failed
+        #: task drops exactly its own attempt's blocks and nothing else.
+        self._blocks: Dict[Tuple[int, int],
+                           List[Tuple[bytes, int, int]]] = {}
         self._complete: set = set()
+        #: sid -> {logical slot id -> committed attempt}.  One node may
+        #: COMMIT several logical slots of one shuffle (its own rank plus
+        #: adopted speculative/re-dispatch wins); serving is filtered to
+        #: committed attempts per slot, so an uncommitted (or beaten)
+        #: attempt's blocks can never reach a reader.
+        self._commits: Dict[int, Dict[str, int]] = {}
+        #: (sid, partition, src) -> (blocks [(bytes, crc, attempt)],
+        #: commit-map snapshot {slot: attempt} at push time).  The
+        #: snapshot makes staleness DETECTABLE: a replica pushed before
+        #: some slot committed simply has no entry for it, and the
+        #: reader escalates instead of silently serving fewer rows.
+        self._replicas: Dict[Tuple[int, int, str],
+                             Tuple[List[Tuple[bytes, int, int]],
+                                   Dict[str, int]]] = {}
+        self._persist_dir: Optional[str] = None
+        #: (sid, partition) persist-dir lookups that found nothing — the
+        #: common case for partitions this node never wrote; caching the
+        #: miss avoids an os.listdir per read
+        self._persist_miss: set = set()
+        if persist_dir:
+            self.set_persist_dir(persist_dir)
 
-    def put(self, shuffle_id: int, partition: int, block: bytes) -> None:
+    # -- persistence (spill-backed durability fallback) -----------------------
+
+    def set_persist_dir(self, persist_dir: str) -> None:
+        """Enable spill-backed persistence: primary puts also write
+        ``<dir>/<sid>_<partition>_<idx>_<attempt>_<crc08x>.blk`` and
+        reads fall back to disk when memory misses (an executor
+        restarted with the same directory re-serves its committed map
+        output).  The attempt tag in the name lets ``drop_shuffle_attempt``
+        remove exactly the loser's files — a dropped attempt must never
+        resurrect from disk next to the winner's remote copy."""
+        persist_dir = str(persist_dir or "")
+        if persist_dir:
+            os.makedirs(persist_dir, exist_ok=True)  # before publishing:
+            # a put() racing this call must never write into a missing dir
+        with self._lock:
+            self._persist_dir = persist_dir or None
+            self._persist_miss.clear()
+
+    def _persist_block(self, shuffle_id: int, partition: int, idx: int,
+                       block: bytes, crc: int, attempt: int) -> None:
+        path = os.path.join(
+            self._persist_dir,
+            f"{shuffle_id}_{partition}_{idx}_{attempt}_{crc:08x}.blk")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(block)
+        os.replace(tmp, path)       # readers never see a torn block
+        SHUFFLE_COUNTERS.add(blocks_persisted=1)
+
+    def _load_persisted(self, shuffle_id: int,
+                        partition: int) -> List[Tuple[bytes, int]]:
+        """Reload a partition's persisted blocks (index order).  Caller
+        holds no lock; results are cached back into memory."""
+        prefix = f"{shuffle_id}_{partition}_"
+        found = []
+        try:
+            names = os.listdir(self._persist_dir)
+        except OSError:
+            return []
+        for name in names:
+            if not (name.startswith(prefix) and name.endswith(".blk")):
+                continue
+            parts = name[:-4].split("_")
+            if len(parts) != 5 or parts[1] != str(partition):
+                continue
+            try:
+                idx, attempt, crc = (int(parts[2]), int(parts[3]),
+                                     int(parts[4], 16))
+            except ValueError:
+                continue
+            try:
+                with open(os.path.join(self._persist_dir, name),
+                          "rb") as f:
+                    found.append((idx, (f.read(), crc, attempt)))
+            except OSError:
+                continue
+        found.sort(key=lambda t: t[0])
+        blocks = [t for _, t in found]
+        if blocks:
+            SHUFFLE_COUNTERS.add(blocks_recovered_disk=len(blocks))
+            with self._lock:
+                self._blocks.setdefault((shuffle_id, partition), blocks)
+        return [(b, crc) for b, crc, _ in blocks]
+
+    def _drop_persisted(self, shuffle_id: int,
+                        attempt: Optional[int] = None) -> None:
+        """Remove persisted files for a shuffle — all of them, or (with
+        ``attempt``) only the files that attempt wrote."""
+        prefix = f"{shuffle_id}_"
+        try:
+            names = os.listdir(self._persist_dir)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith(prefix) and (
+                    name.endswith(".blk") or name.endswith(".complete")
+                    or name.endswith(".commits"))):
+                continue
+            if attempt is not None:
+                # attempt-scoped drop removes only that attempt's .blk
+                # files; the .complete/.commits markers stay valid for
+                # the surviving slots (drop_commit rewrites .commits)
+                if not name.endswith(".blk"):
+                    continue
+                parts = name[:-4].split("_")
+                if len(parts) != 5 or parts[3] != str(attempt):
+                    continue
+            try:
+                os.remove(os.path.join(self._persist_dir, name))
+            except OSError:
+                pass
+
+    # -- primary blocks -------------------------------------------------------
+
+    def put(self, shuffle_id: int, partition: int, block: bytes,
+            attempt: int = 0) -> None:
         crc = frame_checksum(block) if checksum_enabled() else 0
         if crc:
             SHUFFLE_COUNTERS.add(checksums_computed=1)
+        persist = None
         with self._lock:
-            self._blocks.setdefault((shuffle_id, partition), []).append(
-                (block, crc))
+            lst = self._blocks.setdefault((shuffle_id, partition), [])
+            lst.append((block, crc, int(attempt)))
+            self._persist_miss.discard((shuffle_id, partition))
+            if self._persist_dir:
+                persist = (len(lst) - 1, self._persist_dir)
+        if persist is not None:
+            self._persist_block(shuffle_id, partition, persist[0],
+                                block, crc, int(attempt))
 
     def mark_complete(self, shuffle_id: int) -> None:
         """Map output for this shuffle is fully written on this node."""
         with self._lock:
             self._complete.add(shuffle_id)
+            persist_dir = self._persist_dir
+        if persist_dir:
+            try:
+                with open(os.path.join(persist_dir,
+                                       f"{shuffle_id}_.complete"),
+                          "w") as f:
+                    f.write("1")
+            except OSError:
+                pass    # persistence is best-effort; memory copy serves
 
     def is_complete(self, shuffle_id: int) -> bool:
         with self._lock:
-            return shuffle_id in self._complete
+            if shuffle_id in self._complete:
+                return True
+            persist_dir = self._persist_dir
+        if persist_dir and os.path.exists(
+                os.path.join(persist_dir, f"{shuffle_id}_.complete")):
+            with self._lock:
+                self._complete.add(shuffle_id)
+            return True
+        return False
+
+    def note_commit(self, shuffle_id: int, slot: str,
+                    attempt: int) -> None:
+        """Record that ``slot``'s map output on this node is the blocks
+        tagged ``attempt`` (called when a map commit WINS its logical
+        slot).  Slot-filtered serving reads only committed attempts."""
+        with self._lock:
+            self._commits.setdefault(int(shuffle_id), {})[str(slot)] = \
+                int(attempt)
+        self._persist_commits(int(shuffle_id))
+
+    def drop_commit(self, shuffle_id: int, slot: str) -> None:
+        with self._lock:
+            self._commits.get(int(shuffle_id), {}).pop(str(slot), None)
+        self._persist_commits(int(shuffle_id))
+
+    def _persist_commits(self, shuffle_id: int) -> None:
+        """Mirror the commit map next to the persisted blocks — a
+        restarted executor must keep serving SLOT-FILTERED reads, not
+        just raw blocks.  Best effort, like the .complete marker."""
+        with self._lock:
+            persist_dir = self._persist_dir
+            snap = dict(self._commits.get(shuffle_id, {}))
+        if not persist_dir:
+            return
+        try:
+            path = os.path.join(persist_dir, f"{shuffle_id}_.commits")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def commits(self, shuffle_id: int) -> Dict[str, int]:
+        """{logical slot -> committed attempt} for this node's store."""
+        with self._lock:
+            got = self._commits.get(int(shuffle_id))
+            persist_dir = self._persist_dir
+        if got is None and persist_dir:
+            try:
+                with open(os.path.join(persist_dir,
+                                       f"{shuffle_id}_.commits")) as f:
+                    got = {str(k): int(v) for k, v in json.load(f).items()}
+            except (OSError, ValueError):
+                got = None
+            if got is not None:
+                with self._lock:
+                    got = self._commits.setdefault(int(shuffle_id), got)
+        return dict(got or {})
 
     def get(self, shuffle_id: int, partition: int) -> List[bytes]:
+        return [b for b, _ in self.get_with_crcs(shuffle_id, partition)]
+
+    def _entries(self, shuffle_id: int,
+                 partition: int) -> List[Tuple[bytes, int, int]]:
         with self._lock:
-            return [b for b, _ in
-                    self._blocks.get((shuffle_id, partition), [])]
+            got = self._blocks.get((shuffle_id, partition))
+            persist_dir = self._persist_dir
+            missed = (shuffle_id, partition) in self._persist_miss
+        if got is None and persist_dir and not missed:
+            self._load_persisted(shuffle_id, partition)
+            with self._lock:
+                got = self._blocks.get((shuffle_id, partition))
+                if got is None:
+                    self._persist_miss.add((shuffle_id, partition))
+        return list(got or [])
 
     def get_with_crcs(self, shuffle_id: int,
                       partition: int) -> List[Tuple[bytes, int]]:
-        with self._lock:
-            return list(self._blocks.get((shuffle_id, partition), []))
+        return [(b, crc) for b, crc, _ in self._entries(shuffle_id,
+                                                        partition)]
+
+    def get_entries(self, shuffle_id: int, partition: int
+                    ) -> List[Tuple[bytes, int, int]]:
+        """[(block, crc, attempt)] — the replication push needs the
+        attempt tags to frame a slot-filtered snapshot."""
+        return self._entries(shuffle_id, partition)
+
+    def get_committed(self, shuffle_id: int,
+                      partition: int) -> List[bytes]:
+        """Local read of every COMMITTED slot's blocks (the reduce
+        side's own-store short-circuit).  Falls back to the unfiltered
+        list when no commit map exists (standalone shuffles)."""
+        entries = self._entries(shuffle_id, partition)
+        committed = set(self.commits(shuffle_id).values())
+        if not committed:
+            return [b for b, _, _ in entries]
+        return [b for b, _, a in entries if a in committed]
 
     def sizes(self, shuffle_id: int, partition: int) -> List[int]:
-        with self._lock:
-            return [len(b) for b, _ in
-                    self._blocks.get((shuffle_id, partition), [])]
+        return [len(b) for b, _ in self.get_with_crcs(shuffle_id,
+                                                      partition)]
 
-    def drop_shuffle(self, shuffle_id: int) -> None:
+    def sizes_ex(self, shuffle_id: int, partition: int
+                 ) -> Tuple[List[int], List[int], Dict[str, int]]:
+        """(sizes, per-block attempt tags, {slot -> committed attempt})
+        — everything a reader needs to select exactly ONE slot's blocks
+        by index from this node's union list."""
+        entries = self._entries(shuffle_id, partition)
+        return ([len(b) for b, _, _ in entries],
+                [a for _, _, a in entries],
+                self.commits(shuffle_id))
+
+    def partitions(self, shuffle_id: int) -> List[int]:
+        """Partitions with resident primary blocks for this shuffle
+        (the replication push enumerates these)."""
+        with self._lock:
+            return sorted(p for sid, p in self._blocks
+                          if sid == shuffle_id)
+
+    # -- replica side-table ---------------------------------------------------
+
+    def put_replica(self, shuffle_id: int, partition: int, src: str,
+                    blocks: List[Tuple[bytes, int]],
+                    attempts: Optional[List[int]] = None,
+                    commits: Optional[Dict[str, int]] = None) -> None:
+        """Store a peer's replicated partition block list (REPLACES any
+        previous copy: replication pushes whole partitions, so a retried
+        push stays idempotent).  Block order matches the source's primary
+        list — replica fetches address the same indices.  ``attempts``
+        tags each block and ``commits`` snapshots the source's
+        slot->attempt commit map at push time, so a reader can both
+        select one slot's blocks and DETECT a snapshot that predates a
+        slot's commit (no entry -> escalate, never under-serve)."""
+        attempts = list(attempts) if attempts is not None \
+            else [0] * len(blocks)
+        tagged = [(b, crc, a) for (b, crc), a in zip(blocks, attempts)]
+        with self._lock:
+            self._replicas[(shuffle_id, partition, str(src))] = (
+                tagged, dict(commits or {}))
+
+    def get_replica_with_crcs(self, shuffle_id: int, partition: int,
+                              src: str) -> List[Tuple[bytes, int]]:
+        with self._lock:
+            tagged, _ = self._replicas.get(
+                (shuffle_id, partition, str(src)), ([], {}))
+            return [(b, crc) for b, crc, _ in tagged]
+
+    def replica_sizes(self, shuffle_id: int, partition: int,
+                      src: str) -> List[int]:
+        with self._lock:
+            tagged, _ = self._replicas.get(
+                (shuffle_id, partition, str(src)), ([], {}))
+            return [len(b) for b, _, _ in tagged]
+
+    def replica_sizes_ex(self, shuffle_id: int, partition: int, src: str
+                         ) -> Tuple[List[int], List[int], Dict[str, int]]:
+        with self._lock:
+            tagged, commits = self._replicas.get(
+                (shuffle_id, partition, str(src)), ([], {}))
+            return ([len(b) for b, _, _ in tagged],
+                    [a for _, _, a in tagged], dict(commits))
+
+    def replica_keys(self) -> List[Tuple[int, int, str]]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    # -- teardown -------------------------------------------------------------
+
+    def drop_shuffle(self, shuffle_id: int,
+                     include_replicas: bool = True) -> None:
         with self._lock:
             for k in [k for k in self._blocks if k[0] == shuffle_id]:
                 del self._blocks[k]
             self._complete.discard(shuffle_id)
+            self._commits.pop(shuffle_id, None)
+            for k in [k for k in self._persist_miss
+                      if k[0] == shuffle_id]:
+                self._persist_miss.discard(k)
+            if include_replicas:
+                for k in [k for k in self._replicas if k[0] == shuffle_id]:
+                    del self._replicas[k]
+            persist_dir = self._persist_dir
+        if persist_dir:
+            self._drop_persisted(shuffle_id)
+
+    def drop_shuffle_attempt(self, shuffle_id: int, attempt: int) -> int:
+        """Drop only ``attempt``'s blocks for one shuffle (the loser of
+        a first-commit race): blocks other attempts wrote on this node —
+        e.g. this executor's OWN rank output when it also adopted a lost
+        rank under the same shuffle id — and replicas held for peers all
+        survive.  Returns blocks dropped."""
+        dropped = 0
+        commits_changed = False
+        with self._lock:
+            for k in [k for k in self._blocks if k[0] == shuffle_id]:
+                kept = [t for t in self._blocks[k] if t[2] != int(attempt)]
+                dropped += len(self._blocks[k]) - len(kept)
+                if kept:
+                    self._blocks[k] = kept
+                else:
+                    del self._blocks[k]
+            # commit records pointing at the dropped attempt go WITH the
+            # blocks: a record left behind would make readers see "slot
+            # committed here, zero matching blocks" — indistinguishable
+            # from a legitimately empty partition, so they'd be silently
+            # under-served instead of failing over to a replica
+            cm = self._commits.get(shuffle_id, {})
+            for slot in [s for s, a in cm.items() if a == int(attempt)]:
+                del cm[slot]
+                commits_changed = True
+            persist_dir = self._persist_dir
+        if persist_dir:
+            # the loser's persisted files must go too, or a later memory
+            # miss would resurrect them from disk beside the winner's
+            # remote copy (doubled rows)
+            self._drop_persisted(shuffle_id, attempt=int(attempt))
+        if commits_changed:
+            self._persist_commits(shuffle_id)
+        return dropped
 
     def shuffle_ids(self) -> List[int]:
         with self._lock:
             return sorted({k[0] for k in self._blocks} | self._complete)
 
+    def drop_attempt(self, query_id: int, attempt: int) -> int:
+        """Drop only the PRIMARY blocks this node wrote for ``query_id``
+        under ``attempt`` (the failed-task / lost-commit cleanup).
+        Replicas held for other executors, and blocks other attempts
+        committed on this node, are kept — they may be the only
+        surviving copy of a committed map output."""
+        dropped = 0
+        if int(query_id) < 1:
+            return 0
+        for sid in self.shuffle_ids():
+            if sid >> 16 == int(query_id):
+                dropped += bool(self.drop_shuffle_attempt(sid,
+                                                          int(attempt)))
+        return dropped
+
     def drop_query(self, query_id: int) -> int:
         """Drop every shuffle belonging to a cluster query (deterministic
         id scheme: sid = query_id << 16 | exchange ordinal — see
-        transport.set_cluster_query).  Returns the number of shuffles
-        dropped; the driver broadcasts this on query teardown so a
-        failed attempt can't leak its blocks (or satisfy a retry read)."""
+        transport.set_cluster_query), including any replicas held for
+        peers.  Returns the number of shuffles dropped; the driver
+        broadcasts this on query teardown so a failed attempt can't leak
+        its blocks (or satisfy a retry read)."""
         dropped = 0
         if int(query_id) < 1:
             # qid slot 0 is where standalone next_shuffle_id() sids live
             # (sid < 2**16); dropping "query 0" would collect them
             return 0
-        for sid in self.shuffle_ids():
+        replica_sids = {k[0] for k in self.replica_keys()}
+        for sid in set(self.shuffle_ids()) | replica_sids:
             if sid >> 16 == int(query_id):
                 self.drop_shuffle(sid)
                 dropped += 1
@@ -453,27 +822,93 @@ class HeartbeatRegistry:
         self.exclude_threshold = int(exclude_threshold)
         self._failures: Dict[str, int] = {}
         self._next_shuffle = 0
-        # per-shuffle participation: which executors WILL write map output
-        # (declared at transport construction) and which have finished.
-        # Readers await completeness only from declared participants, so a
-        # registered-but-idle worker can't stall every read
-        # (MapOutputTracker role).
+        # per-shuffle participation: which LOGICAL participants WILL write
+        # map output (declared at transport construction) and which have
+        # finished.  Readers await completeness only from declared
+        # participants, so a registered-but-idle worker can't stall every
+        # read (MapOutputTracker role).
         self._participants: Dict[int, set] = {}
         self._map_complete: Dict[int, set] = {}
+        #: first-commit-wins serving map: sid -> {logical participant ->
+        #: physical executor that committed its map output}.  Speculative
+        #: attempts and post-loss rank re-dispatches run AS a logical
+        #: slot; the first physical commit wins, later ones are told so
+        #: and drop their blocks by attempt.
+        self._map_servers: Dict[int, Dict[str, str]] = {}
+        #: replica catalog: (sid, source logical id) -> holder executor
+        #: ids (the RapidsShuffleManager block-catalog role: where a map
+        #: output's surviving copies live)
+        self._replica_holders: Dict[Tuple[int, str], set] = {}
 
     def join_shuffle(self, shuffle_id: int, executor_id: str) -> None:
         with self._lock:
             self._participants.setdefault(shuffle_id, set()).add(executor_id)
 
-    def map_complete(self, shuffle_id: int, executor_id: str) -> None:
+    def map_complete(self, shuffle_id: int, executor_id: str,
+                     physical_id: Optional[str] = None) -> bool:
+        """Commit ``executor_id``'s (logical) map output for this
+        shuffle, served by ``physical_id`` (defaults to the logical id).
+        FIRST COMMIT WINS: returns True when this physical executor now
+        serves the slot, False when another attempt already committed —
+        the loser must drop its blocks (they'd double the reduce data if
+        both copies ever served)."""
+        physical = physical_id or executor_id
         with self._lock:
             self._participants.setdefault(shuffle_id, set()).add(executor_id)
+            servers = self._map_servers.setdefault(shuffle_id, {})
+            cur = servers.setdefault(executor_id, physical)
+            won = cur == physical
             self._map_complete.setdefault(shuffle_id, set()).add(executor_id)
+        return won
 
-    def shuffle_status(self, shuffle_id: int) -> Tuple[List[str], List[str]]:
+    def shuffle_status(self, shuffle_id: int
+                       ) -> Tuple[List[str], List[str], Dict[str, str]]:
         with self._lock:
             return (sorted(self._participants.get(shuffle_id, ())),
-                    sorted(self._map_complete.get(shuffle_id, ())))
+                    sorted(self._map_complete.get(shuffle_id, ())),
+                    dict(self._map_servers.get(shuffle_id, {})))
+
+    # -- replica catalog ------------------------------------------------------
+
+    def replica_announce(self, shuffle_id: int, src: str,
+                         holder: str) -> None:
+        with self._lock:
+            self._replica_holders.setdefault(
+                (int(shuffle_id), str(src)), set()).add(str(holder))
+        SHUFFLE_COUNTERS.add(replica_announces=1)
+
+    def replica_holders(self, shuffle_id: int, src: str) -> List[str]:
+        with self._lock:
+            return sorted(self._replica_holders.get(
+                (int(shuffle_id), str(src)), ()))
+
+    def catalog(self) -> dict:
+        """The shuffle/replica catalog a joining executor syncs at
+        registration: which shuffles exist, who committed what, and where
+        the replicas live."""
+        with self._lock:
+            return {
+                "shuffles": sorted(self._map_complete),
+                "servers": {str(sid): dict(m)
+                            for sid, m in self._map_servers.items()},
+                "replicas": [[sid, src, sorted(holders)]
+                             for (sid, src), holders
+                             in sorted(self._replica_holders.items())],
+            }
+
+    def leave(self, executor_id: str) -> bool:
+        """Graceful departure: remove the peer WITHOUT a failure record
+        (unlike exclude) — it drained its blocks and may rejoin later.
+        Its map commits and replica announcements survive, so readers
+        resolve its slots through replicas."""
+        with self._lock:
+            present = executor_id in self._peers
+            if present:
+                del self._peers[executor_id]
+            self._failures.pop(executor_id, None)
+        if present:
+            SHUFFLE_COUNTERS.add(executors_left=1)
+        return present
 
     def next_shuffle_id(self) -> int:
         """Driver-coordinated shuffle ids: every host sees the same id for
@@ -499,8 +934,11 @@ class HeartbeatRegistry:
     def register(self, executor_id: str, host: str, port: int,
                  role: str = "worker") -> None:
         with self._lock:
+            joined = executor_id not in self._peers and role == "worker"
             self._peers[executor_id] = (host, port, time.time(), role)
             self._failures.pop(executor_id, None)
+        if joined:
+            SHUFFLE_COUNTERS.add(executors_joined=1)
 
     def report_failure(self, executor_id: str) -> bool:
         """An executor reported repeated fetch failures against this
@@ -604,20 +1042,24 @@ class ShuffleBlockServer:
                 header = json.loads(
                     _recv_exact(self.request, word, "control header",
                                 self.client_address).decode("utf-8"))
-                _recv_exact(self.request, header.get("payload_len", 0),
-                            "control payload", self.client_address)
-                self._dispatch(header)
+                payload = _recv_exact(self.request,
+                                      header.get("payload_len", 0),
+                                      "control payload",
+                                      self.client_address)
+                self._dispatch(header, payload)
                 return True
 
-            def _dispatch(self, header: dict) -> None:
+            def _dispatch(self, header: dict, payload: bytes = b"") -> None:
                 # block fetches ride the binary framing exclusively
                 # (_serve_one's BIN_FETCH path); no JSON fetch op exists
                 op = header.get("op")
                 if op == "list_blocks":
                     sid = header["shuffle_id"]
-                    sizes = outer.store.sizes(sid, header["partition"])
+                    sizes, attempts, commits = outer.store.sizes_ex(
+                        sid, header["partition"])
                     _send_msg(self.request, {
-                        "sizes": sizes,
+                        "sizes": sizes, "attempts": attempts,
+                        "commits": commits,
                         "complete": outer.store.is_complete(sid)})
                 elif op == "register" and outer.registry is not None:
                     outer.registry.register(header["executor_id"],
@@ -636,14 +1078,30 @@ class ShuffleBlockServer:
                                                 header["executor_id"])
                     _send_msg(self.request, {"ok": True})
                 elif op == "map_complete" and outer.registry is not None:
-                    outer.registry.map_complete(header["shuffle_id"],
-                                                header["executor_id"])
-                    _send_msg(self.request, {"ok": True})
+                    won = outer.registry.map_complete(
+                        header["shuffle_id"], header["executor_id"],
+                        header.get("physical_id"))
+                    _send_msg(self.request, {"ok": True, "won": won})
                 elif op == "shuffle_status" and outer.registry is not None:
-                    parts, comp = outer.registry.shuffle_status(
+                    parts, comp, servers = outer.registry.shuffle_status(
                         header["shuffle_id"])
                     _send_msg(self.request,
-                              {"participants": parts, "complete": comp})
+                              {"participants": parts, "complete": comp,
+                               "servers": servers})
+                elif op == "replica_announce" and outer.registry is not None:
+                    outer.registry.replica_announce(header["shuffle_id"],
+                                                    header["src"],
+                                                    header["holder"])
+                    _send_msg(self.request, {"ok": True})
+                elif op == "replica_holders" and outer.registry is not None:
+                    _send_msg(self.request, {
+                        "holders": outer.registry.replica_holders(
+                            header["shuffle_id"], header["src"])})
+                elif op == "catalog" and outer.registry is not None:
+                    _send_msg(self.request, outer.registry.catalog())
+                elif op == "leave" and outer.registry is not None:
+                    left = outer.registry.leave(header["executor_id"])
+                    _send_msg(self.request, {"ok": True, "left": left})
                 elif op == "heartbeat" and outer.registry is not None:
                     outer.registry.heartbeat(header["executor_id"])
                     _send_msg(self.request,
@@ -653,6 +1111,37 @@ class ShuffleBlockServer:
                     excluded = outer.registry.report_failure(
                         header["executor_id"])
                     _send_msg(self.request, {"excluded": excluded})
+                elif op == "put_replica":
+                    # replica push: payload is the source partition's
+                    # block list concatenated; lens/crcs (computed ONCE
+                    # at the source's put) frame it back apart
+                    blocks, off = [], 0
+                    for ln, crc in zip(header["lens"], header["crcs"]):
+                        blocks.append((payload[off:off + ln], int(crc)))
+                        off += ln
+                    outer.store.put_replica(
+                        header["shuffle_id"], header["partition"],
+                        header["src"], blocks,
+                        attempts=header.get("attempts"),
+                        commits=header.get("commits"))
+                    _send_msg(self.request, {"ok": True})
+                elif op == "replica_sizes":
+                    sizes, attempts, commits = outer.store.replica_sizes_ex(
+                        header["shuffle_id"], header["partition"],
+                        header["src"])
+                    _send_msg(self.request, {
+                        "sizes": sizes, "attempts": attempts,
+                        "commits": commits})
+                elif op == "fetch_replica":
+                    got = outer.store.get_replica_with_crcs(
+                        header["shuffle_id"], header["partition"],
+                        header["src"])
+                    picked = [got[i] for i in header["blocks"]
+                              if i < len(got)]
+                    _send_msg(self.request,
+                              {"lens": [len(b) for b, _ in picked],
+                               "crcs": [crc for _, crc in picked]},
+                              b"".join(b for b, _ in picked))
                 elif op == "drop_query":
                     # query-teardown broadcast (driver failure path):
                     # drop the failed attempt's shuffles so the store
@@ -691,6 +1180,11 @@ class PeerClient:
                  executor_id: Optional[str] = None):
         self.addr = tuple(addr)
         self.executor_id = executor_id
+        #: the LOGICAL slot this client reads (set by the transport's
+        #: peer resolution): reads then select only that slot's committed
+        #: blocks from the node's union list.  None = unfiltered legacy
+        #: reads (standalone shuffles, diagnostics).
+        self.serve_src: Optional[str] = None
 
     @property
     def conn(self) -> PooledConnection:
@@ -706,6 +1200,17 @@ class PeerClient:
                 f"peer {self.addr} map output for shuffle {shuffle_id} "
                 "not complete")
         return h["sizes"]
+
+    def list_blocks_ex(self, shuffle_id: int, partition: int
+                       ) -> Tuple[List[int], List[int], Dict[str, int]]:
+        """(sizes, per-block attempt tags, {slot -> committed attempt})
+        of the peer's primary list for this partition."""
+        h, _ = _request(self.addr, {"op": "list_blocks",
+                                    "shuffle_id": shuffle_id,
+                                    "partition": partition})
+        return (list(h["sizes"]),
+                [int(a) for a in h.get("attempts", [0] * len(h["sizes"]))],
+                {str(k): int(v) for k, v in h.get("commits", {}).items()})
 
     def new_shuffle_id(self) -> int:
         h, _ = _request(self.addr, {"op": "new_shuffle"})
@@ -740,14 +1245,83 @@ class PeerClient:
                              "shuffle_id": shuffle_id,
                              "participants": list(participants)})
 
-    def map_complete(self, shuffle_id: int, executor_id: str) -> None:
-        _request(self.addr, {"op": "map_complete", "shuffle_id": shuffle_id,
-                             "executor_id": executor_id})
+    def map_complete(self, shuffle_id: int, executor_id: str,
+                     physical_id: Optional[str] = None) -> bool:
+        h, _ = _request(self.addr,
+                        {"op": "map_complete", "shuffle_id": shuffle_id,
+                         "executor_id": executor_id,
+                         "physical_id": physical_id})
+        return bool(h.get("won", True))
 
-    def shuffle_status(self, shuffle_id: int) -> Tuple[List[str], List[str]]:
+    def shuffle_status(self, shuffle_id: int
+                       ) -> Tuple[List[str], List[str], Dict[str, str]]:
         h, _ = _request(self.addr, {"op": "shuffle_status",
                                     "shuffle_id": shuffle_id})
-        return h["participants"], h["complete"]
+        return h["participants"], h["complete"], dict(h.get("servers", {}))
+
+    def put_replica(self, shuffle_id: int, partition: int, src: str,
+                    blocks: List[Tuple[bytes, int]],
+                    attempts: Optional[List[int]] = None,
+                    commits: Optional[Dict[str, int]] = None) -> None:
+        """Push one partition's replicated block list to this holder
+        (idempotent: replaces any previous copy).  ``attempts``/``commits``
+        carry the source's block tags and slot commit-map snapshot so
+        replica reads stay slot-filtered and staleness is detectable."""
+        header = {"op": "put_replica", "shuffle_id": shuffle_id,
+                  "partition": partition, "src": src,
+                  "lens": [len(b) for b, _ in blocks],
+                  "crcs": [crc for _, crc in blocks]}
+        if attempts is not None:
+            header["attempts"] = list(attempts)
+        if commits is not None:
+            header["commits"] = dict(commits)
+        _request(self.addr, header, b"".join(b for b, _ in blocks))
+
+    def replica_sizes(self, shuffle_id: int, partition: int,
+                      src: str) -> List[int]:
+        return self.replica_sizes_ex(shuffle_id, partition, src)[0]
+
+    def replica_sizes_ex(self, shuffle_id: int, partition: int, src: str
+                         ) -> Tuple[List[int], List[int], Dict[str, int]]:
+        h, _ = _request(self.addr, {"op": "replica_sizes",
+                                    "shuffle_id": shuffle_id,
+                                    "partition": partition, "src": src})
+        return (list(h["sizes"]),
+                [int(a) for a in h.get("attempts", [0] * len(h["sizes"]))],
+                {str(k): int(v) for k, v in h.get("commits", {}).items()})
+
+    def fetch_replica(self, shuffle_id: int, partition: int, src: str,
+                      blocks: List[int]) -> List[Tuple[bytes, int]]:
+        h, payload = _request(self.addr,
+                              {"op": "fetch_replica",
+                               "shuffle_id": shuffle_id,
+                               "partition": partition, "src": src,
+                               "blocks": list(blocks)})
+        out, off = [], 0
+        for ln, crc in zip(h["lens"], h["crcs"]):
+            out.append((payload[off:off + ln], int(crc)))
+            off += ln
+        return out
+
+    def replica_announce(self, shuffle_id: int, src: str,
+                         holder: str) -> None:
+        _request(self.addr, {"op": "replica_announce",
+                             "shuffle_id": shuffle_id, "src": src,
+                             "holder": holder})
+
+    def replica_holders(self, shuffle_id: int, src: str) -> List[str]:
+        h, _ = _request(self.addr, {"op": "replica_holders",
+                                    "shuffle_id": shuffle_id, "src": src})
+        return [str(x) for x in h.get("holders", [])]
+
+    def catalog(self) -> dict:
+        h, _ = _request(self.addr, {"op": "catalog"})
+        return h
+
+    def leave(self, executor_id: str) -> bool:
+        h, _ = _request(self.addr, {"op": "leave",
+                                    "executor_id": executor_id})
+        return bool(h.get("left", False))
 
     def report_peer_failure(self, executor_id: str) -> bool:
         """Tell this registry host that ``executor_id`` keeps failing
@@ -770,6 +1344,95 @@ class PeerClient:
         return [int(s) for s in h.get("shuffle_ids", [])]
 
 
+class ReplicaClient:
+    """Duck-typed peer serving ``src``'s replicated map output from its
+    holder set (the failover target when the primary is lost or serves
+    persistently corrupt frames).  Block indices and order match the
+    source's primary list — replication copies whole partition lists —
+    so a reader can swap this in mid-partition and keep its indices.
+
+    Holders are tried in order; each fetched frame verifies against the
+    CRC computed at the SOURCE's put (replication never recomputes), so
+    a corrupt replica fails over to the next holder rather than serving
+    wrong bytes."""
+
+    def __init__(self, src: str, holders: List[Tuple[str, Tuple[str, int]]]):
+        self.src = str(src)
+        self.holders = list(holders)          # [(holder eid, addr)]
+        self.executor_id = f"replica<{self.src}>"
+        self.addr = self.holders[0][1] if self.holders else ("?", 0)
+        #: logical slot the reader selects (same contract as PeerClient)
+        self.serve_src: Optional[str] = None
+
+    def _try_each(self, fn, what: str):
+        last: Optional[BaseException] = None
+        for eid, addr in self.holders:
+            try:
+                return fn(PeerClient(addr, executor_id=eid))
+            except (OSError, RetryBudgetExhausted) as e:
+                last = e
+        raise PeerLostError(
+            f"no replica holder of {self.src} could serve {what} "
+            f"(tried {[eid for eid, _ in self.holders]})") from last
+
+    def list_blocks(self, shuffle_id: int, partition: int,
+                    require_complete: bool = False) -> List[int]:
+        def go(peer: PeerClient):
+            sizes = peer.replica_sizes(shuffle_id, partition, self.src)
+            return sizes
+        return self._try_each(
+            go, f"replica sizes of shuffle {shuffle_id} "
+                f"partition {partition}")
+
+    def list_blocks_ex(self, shuffle_id: int, partition: int
+                       ) -> Tuple[List[int], List[int], Dict[str, int]]:
+        def go(peer: PeerClient):
+            return peer.replica_sizes_ex(shuffle_id, partition, self.src)
+        return self._try_each(
+            go, f"replica listing of shuffle {shuffle_id} "
+                f"partition {partition}")
+
+    def fetch_many(self, shuffle_id: int, partition: int,
+                   blocks: List[int]) -> List[bytes]:
+        want = list(blocks)
+
+        def go(peer: PeerClient):
+            got = peer.fetch_replica(shuffle_id, partition, self.src, want)
+            if len(got) != len(want):
+                raise PeerLostError(
+                    f"replica holder {peer.addr} has "
+                    f"{len(got)}/{len(want)} blocks of {self.src}'s "
+                    f"shuffle {shuffle_id} partition {partition}")
+            if checksum_enabled():
+                bad = [i for i, (b, crc) in enumerate(got)
+                       if not verify_frame(b, crc)]
+                SHUFFLE_COUNTERS.add(
+                    checksums_verified=sum(1 for _, crc in got if crc))
+                if bad:
+                    SHUFFLE_COUNTERS.add(checksum_failures=len(bad))
+                    raise BlockCorruptionError(
+                        f"checksum mismatch on replica block(s) {bad} of "
+                        f"{self.src}'s shuffle {shuffle_id} partition "
+                        f"{partition} from holder {peer.addr}")
+            return [b for b, _ in got]
+
+        def attempt(peer: PeerClient):
+            # one corruption retry per holder, then the next holder
+            try:
+                return go(peer)
+            except BlockCorruptionError:
+                SHUFFLE_COUNTERS.add(blocks_refetched=len(want))
+                return go(peer)
+
+        out = self._try_each(
+            attempt, f"shuffle {shuffle_id} partition {partition} "
+                     f"blocks {want}")
+        SHUFFLE_COUNTERS.add(blocks_refetched_replica=len(out),
+                             bytes_fetched=sum(len(b) for b in out),
+                             fetch_requests=1, blocks_fetched=len(out))
+        return out
+
+
 class BlockFetchIterator:
     """Pull all of a partition's blocks from a set of peers under a bounded
     in-flight byte budget (the reference's receive-side throttle:
@@ -787,7 +1450,7 @@ class BlockFetchIterator:
     def __init__(self, peers: List[PeerClient], shuffle_id: int,
                  partition: int, max_inflight_bytes: int = 64 << 20,
                  fetch_threads: int = 4, request_bytes: int = 4 << 20,
-                 report_failure=None):
+                 report_failure=None, replica_resolver=None):
         self.peers = peers
         self.shuffle_id = shuffle_id
         self.partition = partition
@@ -800,47 +1463,105 @@ class BlockFetchIterator:
         #: (the transport reports it to the heartbeat registry so
         #: repeat offenders get excluded)
         self.report_failure = report_failure
+        #: callable(peer) -> Optional[ReplicaClient]: where this peer's
+        #: map output can be re-fetched from if the peer itself cannot
+        #: serve it (replication failover — re-fetch, not re-execute)
+        self.replica_resolver = replica_resolver
 
-    def _fetch_batch(self, peer: PeerClient, take: List[int]) -> List[bytes]:
-        """One batch round-trip with CORRUPTION recovery: a checksum
-        mismatch re-fetches the batch from the serving peer under a
-        bounded budget (transport errors already retry inside the pooled
-        connection's own budget).  Budget exhaustion and lost map output
-        report the peer before escalating."""
+    def _slot_pairs(self, peer) -> Optional[List[Tuple[int, int]]]:
+        """(index, size) pairs of the blocks ``peer`` serves for the
+        reader's slot, out of the node's (or replica record's) union
+        listing.  ``peer.serve_src`` None means unfiltered legacy reads.
+        None return: the listing has NO commit record for the slot — a
+        replica snapshot that predates the slot's commit, or a restarted
+        node that lost it — the caller must escalate, never under-serve."""
+        sizes, attempts, commits = peer.list_blocks_ex(self.shuffle_id,
+                                                       self.partition)
+        slot = getattr(peer, "serve_src", None)
+        if slot is None:
+            return list(enumerate(sizes))
+        att = commits.get(slot)
+        if att is None:
+            return None
+        return [(i, s) for i, (s, a) in enumerate(zip(sizes, attempts))
+                if a == att]
+
+    def _require_pairs(self, peer) -> List[Tuple[int, int]]:
+        pairs = self._slot_pairs(peer)
+        if pairs is None:
+            raise PeerLostError(
+                f"{peer.executor_id or peer.addr} has no commit record "
+                f"for slot {getattr(peer, 'serve_src', None)} of shuffle "
+                f"{self.shuffle_id} (stale or restarted copy)")
+        return pairs
+
+    def _failover(self, peer):
+        """Resolve the replica standing in for ``peer``'s slot, with the
+        slot's pair listing — or re-raise the active error when none
+        exists (escalation to scoped recovery)."""
+        if self.report_failure is not None:
+            self.report_failure(peer)
+        replica = (self.replica_resolver(peer)
+                   if self.replica_resolver is not None
+                   and not isinstance(peer, ReplicaClient) else None)
+        if replica is None:
+            raise
+        replica.serve_src = getattr(peer, "serve_src", None)
+        pairs = self._require_pairs(replica)
+        SHUFFLE_COUNTERS.add(replica_failovers=1)
+        return replica, pairs
+
+    def _fetch_batch(self, state: dict, take: List[int]) -> List[bytes]:
+        """One batch round-trip (``take`` is slot-ORDINAL positions into
+        ``state['pairs']``) with CORRUPTION recovery: a checksum mismatch
+        re-fetches the batch from the serving peer under a bounded budget
+        (transport errors already retry inside the pooled connection's
+        own budget).  When the peer cannot serve at all (budget dry, map
+        output gone) and a replica exists, the worker PERMANENTLY
+        switches to it — ordinals re-resolve against the replica's OWN
+        listing, so index drift between snapshots cannot mis-address
+        blocks — and escalation to the scoped re-execution path happens
+        only with no usable replica left.  Budget exhaustion and lost
+        map output report the peer before failing over."""
+        peer = state["peer"]
+        CHAOS.delay("shuffle.fetch.delay")
         budget = network_budget(
             f"shuffle.fetch:{self.shuffle_id}/{self.partition}"
             f"@{peer.addr[0]}:{peer.addr[1]}")
+        idxs = [state["pairs"][o][0] for o in take]
         try:
             while True:
                 try:
                     return peer.fetch_many(self.shuffle_id,
-                                           self.partition, take)
+                                           self.partition, idxs)
                 except BlockCorruptionError as e:
                     budget.backoff(error=e)  # RetryBudgetExhausted if dry
                     SHUFFLE_COUNTERS.add(blocks_refetched=len(take))
         except (RetryBudgetExhausted, PeerLostError):
-            # corruption persisted past the budget, the pooled
-            # connection's reconnect budget ran out, or the peer lost
-            # map output: this peer cannot serve — report it so the
-            # registry can exclude repeat offenders, then escalate
-            if self.report_failure is not None:
-                self.report_failure(peer)
-            raise
+            replica, pairs = self._failover(peer)
+            if len(pairs) != len(state["pairs"]):
+                raise PeerLostError(
+                    f"replica of slot {getattr(peer, 'serve_src', None)} "
+                    f"serves {len(pairs)} blocks where the primary "
+                    f"served {len(state['pairs'])} (inconsistent copy)")
+            state["peer"], state["pairs"] = replica, pairs
+            return replica.fetch_many(self.shuffle_id, self.partition,
+                                      [pairs[o][0] for o in take])
 
     def __iter__(self):
         import collections
-        sizes = {}
+        sources = []                # [{"peer": ..., "pairs": [(idx, sz)]}]
         for peer in self.peers:
             try:
-                sizes[peer] = peer.list_blocks(self.shuffle_id,
-                                               self.partition)
+                sources.append({"peer": peer,
+                                "pairs": self._require_pairs(peer)})
             except OSError:
-                # the peer's reconnect budget ran dry before the read
-                # even started: report it (exclusion input) and escalate
-                if self.report_failure is not None:
-                    self.report_failure(peer)
-                raise
-        if not any(sizes.values()):
+                # the peer's reconnect budget ran dry (or its commit
+                # record is gone) before the read even started: report
+                # it, then serve the slot from a replica when one exists
+                replica, pairs = self._failover(peer)
+                sources.append({"peer": replica, "pairs": pairs})
+        if not any(s["pairs"] for s in sources):
             return
         cv = threading.Condition()
         queue: "collections.deque[bytes]" = collections.deque()
@@ -855,18 +1576,20 @@ class BlockFetchIterator:
         # most one slot)
         request_slots = threading.BoundedSemaphore(self.fetch_threads)
 
-        def worker(peer: PeerClient, block_sizes: List[int]) -> None:
+        def worker(src_state: dict) -> None:
             try:
+                # ordinals index src_state["pairs"] — _fetch_batch may
+                # swap in a replica (re-resolving indices) mid-iteration
+                sizes = [s for _, s in src_state["pairs"]]
                 i = 0
-                while i < len(block_sizes):
+                while i < len(sizes):
                     # batch blocks into one round-trip up to the budget
-                    take, batch_bytes = [i], block_sizes[i]
+                    take, batch_bytes = [i], sizes[i]
                     i += 1
-                    while (i < len(block_sizes)
-                           and batch_bytes + block_sizes[i]
-                           <= batch_budget):
+                    while (i < len(sizes)
+                           and batch_bytes + sizes[i] <= batch_budget):
                         take.append(i)
-                        batch_bytes += block_sizes[i]
+                        batch_bytes += sizes[i]
                         i += 1
                     with cv:
                         # window: wait for room; an oversized batch may
@@ -880,7 +1603,7 @@ class BlockFetchIterator:
                             return
                         state["inflight"] += batch_bytes
                     with request_slots:
-                        got = self._fetch_batch(peer, take)
+                        got = self._fetch_batch(src_state, take)
                     with cv:
                         queue.extend(got)
                         cv.notify_all()
@@ -896,11 +1619,11 @@ class BlockFetchIterator:
 
         threads = []
         with cv:
-            for peer, bs in sizes.items():
-                if not bs:
+            for src_state in sources:
+                if not src_state["pairs"]:
                     continue
                 state["live_workers"] += 1
-                t = threading.Thread(target=worker, args=(peer, bs),
+                t = threading.Thread(target=worker, args=(src_state,),
                                      daemon=True)
                 threads.append(t)
         for t in threads:
@@ -952,7 +1675,11 @@ class TcpShuffleTransport:
                  shuffle_id: Optional[int] = None,
                  completeness_timeout_s: float = 120.0,
                  participants=None,
-                 request_bytes: int = 4 << 20):
+                 request_bytes: int = 4 << 20,
+                 attempt: int = 0,
+                 logical_id: Optional[str] = None,
+                 replication: int = 1,
+                 persist_dir: str = ""):
         self.shuffle_id = (shuffle_id if shuffle_id is not None
                            else executor.new_shuffle_id())
         self.executor = executor
@@ -964,25 +1691,65 @@ class TcpShuffleTransport:
         self.merge_chunk_bytes = max(int(merge_chunk_bytes), 1)
         self.request_bytes = max(int(request_bytes), 1)
         self.completeness_timeout_s = completeness_timeout_s
+        #: task attempt writing this shuffle (speculation/re-dispatch);
+        #: tags blocks in the store so a lost first-commit race drops
+        #: exactly this attempt's output
+        self.attempt = int(attempt)
+        #: the LOGICAL participant slot this task fills (its own id
+        #: unless it is a speculative copy / re-dispatch of another
+        #: executor's rank)
+        self.logical_id = logical_id or executor.executor_id
+        #: replication factor k: after the map commit wins, blocks are
+        #: pushed asynchronously to k-1 rendezvous-chosen peers
+        self.replication = max(int(replication), 1)
+        if persist_dir:
+            executor.store.set_persist_dir(persist_dir)
         # declare map-side participation up front: readers only await
         # completeness from executors that actually participate in this
         # shuffle, so a registered-but-idle worker never stalls reads
         # (ADVICE r2 #5).  A coordinator that knows the full worker set
         # passes `participants` so a reader racing a slow worker's
         # transport construction still waits for it.
-        self.executor.join_shuffle(self.shuffle_id)
+        self.executor.join_shuffle(self.shuffle_id, as_id=self.logical_id)
         if participants:
             self.executor.declare_shuffle(self.shuffle_id, participants)
 
     supports_range_write = True
 
+    def _commit_map(self) -> None:
+        """Commit this attempt's map output: FIRST COMMIT WINS at the
+        registry.  A win replicates the blocks to k-1 peers (async — the
+        reduce phase overlaps the push); a loss means another attempt
+        already serves this logical slot, so this attempt's blocks are
+        dropped by attempt id (serving both copies would double every
+        reduce row)."""
+        # record slot -> attempt BEFORE the registry win is visible, so
+        # a reader that sees the commit always finds the serving record
+        self.executor.store.note_commit(self.shuffle_id, self.logical_id,
+                                        self.attempt)
+        self.executor.store.mark_complete(self.shuffle_id)
+        won = self.executor.map_complete(self.shuffle_id,
+                                         as_id=self.logical_id)
+        if not won:
+            SHUFFLE_COUNTERS.add(map_commits_lost=1)
+            self.executor.store.drop_commit(self.shuffle_id,
+                                            self.logical_id)
+            self.executor.store.drop_shuffle_attempt(self.shuffle_id,
+                                                     self.attempt)
+            return
+        SHUFFLE_COUNTERS.add(map_commits_won=1)
+        if self.replication > 1:
+            self.executor.replicate_shuffle_async(
+                self.shuffle_id, self.replication,
+                src=self.logical_id)
+
     def write(self, pieces: Iterable[Tuple[int, ColumnarBatch]]) -> None:
         from spark_rapids_tpu.shuffle.serializer import serialize_batch
         for p, piece in pieces:
             self.executor.store.put(self.shuffle_id, p,
-                                    serialize_batch(piece, self.codec))
-        self.executor.store.mark_complete(self.shuffle_id)
-        self.executor.map_complete(self.shuffle_id)
+                                    serialize_batch(piece, self.codec),
+                                    attempt=self.attempt)
+        self._commit_map()
 
     def write_batches(self, batches) -> None:
         """Range write (MULTIPROCESS): every partition's wire block is
@@ -994,23 +1761,31 @@ class TcpShuffleTransport:
                                             self.codec)
             for p, block in enumerate(blocks):
                 if block is not None:
-                    self.executor.store.put(self.shuffle_id, p, block)
-        self.executor.store.mark_complete(self.shuffle_id)
-        self.executor.map_complete(self.shuffle_id)
+                    self.executor.store.put(self.shuffle_id, p, block,
+                                            attempt=self.attempt)
+        self._commit_map()
 
     def _await_and_resolve_peers(self) -> List[PeerClient]:
         """Wait for every declared participant's map completion, then
         resolve reachable peer clients (excluding self).  The wait is a
         named ``RetryBudget`` deadline (unlimited polls, bounded delay):
         a lost participant surfaces as a budget error naming the shuffle
-        and the pending executors, never a silent hang."""
+        and the pending executors, never a silent hang.
+
+        Resolution goes through the registry's SERVING MAP (logical
+        participant -> physical committer: first-commit-wins under
+        speculation/re-dispatch).  A committed slot whose server is
+        unreachable resolves to its REPLICA holders when the catalog has
+        any — executor loss then costs a re-fetch, not a re-execution;
+        only a slot with no surviving copy escalates to PeerLostError
+        (the scoped-recovery path)."""
         self.executor.heartbeat()
         budget = RetryBudget(
             f"shuffle.completeness:{self.shuffle_id}",
             max_attempts=None, base_delay_s=0.02, max_delay_s=0.25,
             deadline_s=self.completeness_timeout_s)
         while True:
-            participants, complete = self.executor.shuffle_status(
+            participants, complete, servers = self.executor.shuffle_status(
                 self.shuffle_id)
             if set(participants) <= set(complete):
                 break
@@ -1022,18 +1797,37 @@ class TcpShuffleTransport:
         # while we were waiting for map output
         self.executor.heartbeat()
         remote = []
-        for eid in complete:
-            if eid == self.executor.executor_id:
-                continue
-            peer = self.executor.peer_client_for(eid)
+        for logical in complete:
+            physical = servers.get(logical, logical)
+            if physical == self.executor.executor_id:
+                continue        # served by the local store
+            # ONE slot-filtered client per logical participant: a node
+            # serving several slots (it adopted a lost/straggling rank)
+            # gets one client per slot, each selecting only that slot's
+            # committed blocks from the union listing — slots can never
+            # double-serve or under-serve each other
+            peer = self.executor.peer_client_for(physical)
             if peer is None:
-                # a participant completed its map output but is no longer
-                # reachable: failing loudly beats silently dropping its
-                # blocks (fetch-failed -> recompute is the upper layer's
-                # job, as in Spark)
-                raise PeerLostError(
-                    f"shuffle {self.shuffle_id}: completed participant "
-                    f"{eid} has no reachable address (peer lost)")
+                # committed but unreachable: re-fetch from replicas when
+                # any were announced; only a slot with NO surviving copy
+                # escalates (fetch-failed -> scoped recompute is the
+                # upper layer's job, as in Spark).  Replicas are cataloged
+                # under the pushing slot's id — usually the logical slot,
+                # but a drain of standalone blocks announces under the
+                # holder's physical id, so try both.
+                peer = (self.executor.replica_client_for(self.shuffle_id,
+                                                         logical)
+                        or (self.executor.replica_client_for(
+                            self.shuffle_id, physical)
+                            if physical != logical else None))
+                if peer is None:
+                    raise PeerLostError(
+                        f"shuffle {self.shuffle_id}: completed "
+                        f"participant {logical} (server {physical}) has "
+                        "no reachable address and no replicas "
+                        "(peer lost)")
+                SHUFFLE_COUNTERS.add(replica_failovers=1)
+            peer.serve_src = logical
             remote.append(peer)
         return remote
 
@@ -1056,14 +1850,30 @@ class TcpShuffleTransport:
             merge_batches, wire_row_count)
         remote = self._await_and_resolve_peers()
 
+        def resolve_replica(peer):
+            # replicas are cataloged under the pushing slot's id; the
+            # holder's physical id covers drained standalone blocks
+            for src in dict.fromkeys(
+                    [getattr(peer, "serve_src", None) or peer.executor_id,
+                     peer.executor_id]):
+                replica = self.executor.replica_client_for(
+                    self.shuffle_id, src)
+                if replica is not None:
+                    return replica
+            return None
+
         def wire_blocks():
-            yield from self.executor.store.get(self.shuffle_id, partition)
+            # local short-circuit serves every slot THIS node committed
+            # (own rank + adopted wins), never an uncommitted attempt's
+            yield from self.executor.store.get_committed(self.shuffle_id,
+                                                         partition)
             if remote:
                 yield from BlockFetchIterator(
                     remote, self.shuffle_id, partition, self.max_inflight,
                     fetch_threads=self.fetch_threads,
                     request_bytes=self.request_bytes,
-                    report_failure=self.executor.report_peer_failure)
+                    report_failure=self.executor.report_peer_failure,
+                    replica_resolver=resolve_replica)
 
         chunk: List[bytes] = []
         acc = 0
@@ -1108,21 +1918,31 @@ class ShuffleExecutor:
     def __init__(self, executor_id: Optional[str] = None,
                  driver_addr: Optional[Tuple[str, int]] = None,
                  serve_registry: bool = False, host: str = "127.0.0.1",
-                 role: str = "worker"):
+                 role: str = "worker",
+                 persist_dir: Optional[str] = None):
         self.executor_id = executor_id or f"exec-{os.getpid()}"
         self.role = role
-        self.store = BlockStore()
+        self.store = BlockStore(persist_dir=persist_dir)
         self.registry = HeartbeatRegistry() if serve_registry else None
         self.server = ShuffleBlockServer(self.store, self.registry,
                                          host=host)
         self._peers: Dict[str, Tuple[str, int]] = {
             self.executor_id: self.server.addr}
         self._driver = driver_addr
+        #: in-flight async replication pushes: sid -> Event set when the
+        #: push (and its catalog announcements) finished
+        self._repl_lock = threading.Lock()
+        #: (shuffle_id, src) -> done event for an async replica push
+        self._repl_done: Dict[Tuple[int, str], threading.Event] = {}
+        #: shuffle/replica catalog snapshot pulled at registration (a
+        #: joiner's warm view; live lookups still go to the registry)
+        self._catalog: dict = {}
         if driver_addr is not None:
             PeerClient(driver_addr).register(
                 self.executor_id, self.server.addr[0], self.server.addr[1],
                 role=role)
             self.heartbeat()
+            self.sync_catalog()
         elif self.registry is not None:
             self.registry.register(self.executor_id, *self.server.addr,
                                    role=role)
@@ -1166,12 +1986,13 @@ class ShuffleExecutor:
         assert self.registry is not None
         return self.registry.next_shuffle_id()
 
-    def join_shuffle(self, shuffle_id: int) -> None:
+    def join_shuffle(self, shuffle_id: int,
+                     as_id: Optional[str] = None) -> None:
+        logical = as_id or self.executor_id
         if self._driver is not None:
-            PeerClient(self._driver).join_shuffle(shuffle_id,
-                                                  self.executor_id)
+            PeerClient(self._driver).join_shuffle(shuffle_id, logical)
         elif self.registry is not None:
-            self.registry.join_shuffle(shuffle_id, self.executor_id)
+            self.registry.join_shuffle(shuffle_id, logical)
 
     def declare_shuffle(self, shuffle_id: int, participants) -> None:
         if self._driver is not None:
@@ -1180,24 +2001,235 @@ class ShuffleExecutor:
         elif self.registry is not None:
             self.registry.declare_shuffle(shuffle_id, participants)
 
-    def map_complete(self, shuffle_id: int) -> None:
+    def map_complete(self, shuffle_id: int,
+                     as_id: Optional[str] = None) -> bool:
+        """Commit map output for the logical slot ``as_id`` (default:
+        self), served by THIS executor.  Returns whether the commit won
+        (first-commit-wins under speculation/re-dispatch)."""
+        logical = as_id or self.executor_id
         if self._driver is not None:
-            PeerClient(self._driver).map_complete(shuffle_id,
-                                                  self.executor_id)
-        elif self.registry is not None:
-            self.registry.map_complete(shuffle_id, self.executor_id)
+            return PeerClient(self._driver).map_complete(
+                shuffle_id, logical, physical_id=self.executor_id)
+        if self.registry is not None:
+            return self.registry.map_complete(
+                shuffle_id, logical, physical_id=self.executor_id)
+        return True
 
     def shuffle_status(self, shuffle_id: int):
         if self._driver is not None:
             return PeerClient(self._driver).shuffle_status(shuffle_id)
         if self.registry is not None:
             return self.registry.shuffle_status(shuffle_id)
-        return [self.executor_id], [self.executor_id]
+        return ([self.executor_id], [self.executor_id],
+                {self.executor_id: self.executor_id})
 
     def peer_client_for(self, executor_id: str) -> Optional[PeerClient]:
         addr = self._peers.get(executor_id)
         return (PeerClient(addr, executor_id=executor_id)
                 if addr is not None else None)
+
+    # -- durability: replication + catalog ------------------------------------
+
+    def _rendezvous_targets(self, shuffle_id: int, src: str,
+                            k: int) -> List[str]:
+        """The k-1 replica holders for (shuffle, src): highest rendezvous
+        hash over the live worker set excluding self.  Every node ranks
+        peers identically, so holders are discoverable by recomputation
+        as well as through the registry catalog."""
+        import hashlib
+        candidates = [eid for eid in self._peers
+                      if eid != self.executor_id]
+        candidates.sort(
+            key=lambda eid: hashlib.md5(
+                f"{shuffle_id}:{src}:{eid}".encode()).hexdigest(),
+            reverse=True)
+        return candidates[:max(k - 1, 0)]
+
+    def replicate_shuffle(self, shuffle_id: int, k: int,
+                          src: Optional[str] = None,
+                          drain: bool = False) -> int:
+        """Push every partition's committed block list for ``shuffle_id``
+        to k-1 rendezvous-chosen peers and announce them in the
+        registry's replica catalog.  Idempotent (put_replica replaces).
+        Returns the UNIQUE blocks secured (pushed to at least one
+        holder); ``drain=True`` counts them as drained (graceful-leave
+        accounting) instead of per-copy replicated."""
+        src = src or self.executor_id
+        targets = self._rendezvous_targets(shuffle_id, src, k)
+        if not targets:
+            return 0
+        # snapshot once, filtered to the SLOT's committed attempt when
+        # one is recorded (a node may hold several slots' blocks for one
+        # shuffle — each slot replicates its own blocks under its own
+        # src, so replica records stay disjoint and indexable); with no
+        # commit record (standalone blocks in a drain) the whole list
+        # goes under the caller's src
+        commits = self.store.commits(shuffle_id)
+        att = commits.get(str(src))
+        parts: Dict[int, List[Tuple[bytes, int, int]]] = {}
+        for p in self.store.partitions(shuffle_id):
+            entries = self.store.get_entries(shuffle_id, p)
+            if att is not None:
+                entries = [t for t in entries if t[2] == att]
+            if entries:
+                parts[p] = entries
+        snap = {str(src): att} if att is not None else dict(commits)
+        total_blocks = sum(len(e) for e in parts.values())
+        ok_targets = 0
+        for eid in targets:
+            peer = self.peer_client_for(eid)
+            if peer is None:
+                continue
+            try:
+                for p, entries in sorted(parts.items()):
+                    peer.put_replica(
+                        shuffle_id, p, src,
+                        [(b, crc) for b, crc, _ in entries],
+                        attempts=[a for _, _, a in entries],
+                        commits=snap)
+                    if not drain:
+                        # replicated counters are PER COPY (fan-out cost)
+                        SHUFFLE_COUNTERS.add(
+                            blocks_replicated=len(entries),
+                            bytes_replicated=sum(len(b)
+                                                 for b, _, _ in entries))
+                self.replica_announce(shuffle_id, src, eid)
+                ok_targets += 1
+            except OSError:
+                # best-effort: a holder that died mid-push just isn't
+                # announced; the remaining copies still protect the data
+                continue
+        if drain and ok_targets:
+            # drained counts UNIQUE primary blocks secured (>=1 copy),
+            # not copies — factor>=3 must not multi-count the drain
+            SHUFFLE_COUNTERS.add(blocks_drained=total_blocks)
+        return total_blocks if ok_targets else 0
+
+    def replicate_shuffle_async(self, shuffle_id: int, k: int,
+                                src: Optional[str] = None) -> None:
+        """Asynchronous replication: the reduce phase (and the task's
+        result push) overlap the replica push.  ``wait_replicated`` joins
+        it — graceful leave and deterministic tests need the blocks
+        durable before the node may die.  Deduped per (shuffle, SOURCE):
+        a node serving two logical slots of one shuffle (it adopted a
+        lost rank) must push and announce under BOTH srcs — deduping by
+        shuffle id alone would silently skip the adopted slot's copy."""
+        key = (int(shuffle_id), str(src or self.executor_id))
+        with self._repl_lock:
+            ev = self._repl_done.get(key)
+            if ev is not None and not ev.is_set():
+                return      # a push for this (shuffle, src) is in flight
+            ev = self._repl_done[key] = threading.Event()
+
+        def _push():
+            try:
+                self.replicate_shuffle(shuffle_id, k, src=src)
+            finally:
+                ev.set()
+        threading.Thread(target=_push, daemon=True).start()
+
+    def wait_replicated(self, shuffle_id: int,
+                        timeout_s: float = 30.0) -> bool:
+        """Join every in-flight replica push for ``shuffle_id`` (all
+        sources this node writes for)."""
+        with self._repl_lock:
+            evs = [ev for (sid, _), ev in self._repl_done.items()
+                   if sid == int(shuffle_id)]
+        deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        return all(ev.wait(max(deadline - time.monotonic(), 0.0))
+                   for ev in evs)
+
+    def replica_announce(self, shuffle_id: int, src: str,
+                         holder: str) -> None:
+        if self._driver is not None:
+            PeerClient(self._driver).replica_announce(shuffle_id, src,
+                                                      holder)
+        elif self.registry is not None:
+            self.registry.replica_announce(shuffle_id, src, holder)
+
+    def replica_holders(self, shuffle_id: int, src: str) -> List[str]:
+        try:
+            if self._driver is not None:
+                return PeerClient(self._driver).replica_holders(
+                    shuffle_id, src)
+            if self.registry is not None:
+                return self.registry.replica_holders(shuffle_id, src)
+        except OSError:
+            pass
+        # registry unreachable (or none): fall back to the catalog
+        # snapshot pulled at registration
+        for sid, csrc, holders in self._catalog.get("replicas", []):
+            if int(sid) == int(shuffle_id) and csrc == src:
+                return list(holders)
+        return []
+
+    def replica_client_for(self, shuffle_id: int,
+                           src: str) -> Optional["ReplicaClient"]:
+        """A duck-typed peer serving ``src``'s map output for this
+        shuffle from its replica holders — None when no reachable holder
+        is cataloged (the caller then escalates to scoped recovery)."""
+        holders = [(eid, self._peers[eid])
+                   for eid in self.replica_holders(shuffle_id, src)
+                   if eid in self._peers and eid != src]
+        # this node may itself hold a replica (common at small worlds):
+        # serving it through its own server keeps one code path
+        return ReplicaClient(src, holders) if holders else None
+
+    def sync_catalog(self) -> None:
+        """Pull the registry's shuffle/replica catalog (joiner warm-up:
+        a rank that registers mid-session learns where every committed
+        shuffle's copies live before its first task)."""
+        if self._driver is None:
+            return
+        try:
+            self._catalog = PeerClient(self._driver).catalog()
+            SHUFFLE_COUNTERS.add(catalog_syncs=1)
+        except OSError:
+            self._catalog = {}
+
+    def leave(self, drain: bool = True,
+              timeout_s: Optional[float] = None) -> int:
+        """Graceful departure: wait for in-flight replication pushes,
+        re-replicate every primary shuffle this node still holds (so its
+        map output survives it), then deregister.  Returns blocks
+        drained.  In-flight queries keep completing through the replica
+        catalog — the scoped-recovery path is never touched.
+
+        The drain bound defaults to ``spark.rapids.cluster.drain.timeout``
+        and the copy count to the configured replication factor (at least
+        2 — a drain with replication off must still leave one surviving
+        copy behind)."""
+        # lazy: transport imports this module at load time
+        from spark_rapids_tpu.shuffle.transport import replication_config
+        factor, _persist, drain_timeout = replication_config()
+        if timeout_s is None:
+            timeout_s = drain_timeout
+        deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        drained = 0
+        if drain:
+            with self._repl_lock:
+                pending = list(self._repl_done.values())
+            for ev in pending:
+                ev.wait(max(deadline - time.monotonic(), 0.0))
+            for sid in self.store.shuffle_ids():
+                if time.monotonic() >= deadline:
+                    break       # leave anyway; scoped recovery covers
+                # each committed slot drains under its OWN src (readers
+                # resolve replicas by slot); uncommitted standalone
+                # blocks go under this node's id
+                srcs = sorted(self.store.commits(sid)) \
+                    or [self.executor_id]
+                for s in srcs:
+                    drained += self.replicate_shuffle(
+                        sid, k=max(factor, 2), src=s, drain=True)
+        try:
+            if self._driver is not None:
+                PeerClient(self._driver).leave(self.executor_id)
+            elif self.registry is not None:
+                self.registry.leave(self.executor_id)
+        except OSError:
+            pass        # registry gone too; nothing left to tell
+        return drained
 
     def close(self) -> None:
         self.server.close()

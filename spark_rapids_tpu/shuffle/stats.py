@@ -44,6 +44,35 @@ _FIELDS = (
     "blocks_refetched",       # blocks re-fetched after a corrupt/failed read
     "peer_failures_reported", # budget-exhausted peers reported upstream
     "peers_excluded",         # peers the heartbeat registry excluded
+    # durability (map-output replication + spill-backed persistence;
+    # docs/fault_tolerance.md durable-shuffle rows)
+    "blocks_replicated",      # map blocks pushed to replica holders
+    "bytes_replicated",       # wire bytes pushed to replica holders
+    "replica_announces",      # (shuffle, source)->holder records announced
+    "blocks_refetched_replica",  # blocks served from a replica after the
+                              # primary was lost/corrupt (re-fetch, NOT
+                              # re-execution — the acceptance counter)
+    "replica_failovers",      # fetch paths that switched primary->replica
+    "blocks_persisted",       # map blocks also written to the persist dir
+    "blocks_recovered_disk",  # blocks reloaded from the persist dir after
+                              # a restart emptied the in-memory store
+    # elasticity (dynamic membership)
+    "executors_joined",       # workers registered into a live registry
+    "executors_left",         # workers that gracefully left (drained)
+    "blocks_drained",         # primary blocks re-replicated by a drain
+    "catalog_syncs",          # joiners that pulled the shuffle/replica
+                              # catalog at registration
+    # speculation + first-commit-wins
+    "speculative_launches",   # straggler tasks given a second attempt
+    "speculative_wins",       # ranks whose speculative attempt finished
+                              # first
+    "map_commits_won",        # map-output commits that won their logical
+                              # slot at the registry
+    "map_commits_lost",       # commits that lost the race (the loser's
+                              # blocks are dropped by attempt)
+    "rank_redispatches",      # single-rank re-dispatches after executor
+                              # loss (the durable path: survivors re-fetch
+                              # instead of re-executing the whole query)
     # executor liveness
     "heartbeat_failures",     # failed liveness beats (cumulative)
     "heartbeat_failure_streak",  # max consecutive failed beats (gauge)

@@ -235,18 +235,37 @@ def process_shuffle_executor():
 
 _cluster_participants = None
 _cluster_shuffle_seq = None   # [query_id, next_exchange_ordinal]
+_cluster_attempt = 0          # task attempt id (speculation/re-dispatch)
+_cluster_logical = None       # logical participant id this task runs AS
 
 
-def set_cluster_query(query_id) -> None:
+def set_cluster_query(query_id, attempt: int = 0) -> None:
     """Enter (or leave, with None) a cluster task: exchanges then take
     DETERMINISTIC shuffle ids (query_id << 16 | ordinal-of-materialization)
     so every rank names the same exchange identically — a driver-counter
     allocation would hand each requesting rank a different id and reduce
     reads would wait on a shuffle nobody else knows (the role of Spark's
-    driver-assigned shuffleId in the reference's heartbeat registry)."""
-    global _cluster_shuffle_seq
+    driver-assigned shuffleId in the reference's heartbeat registry).
+
+    ``attempt`` tags this task attempt's map-output blocks (speculative
+    copies and rank re-dispatches run the SAME shuffle ids under a higher
+    attempt; first-commit-wins at the registry decides which attempt's
+    blocks serve, and the loser's are dropped by this tag)."""
+    global _cluster_shuffle_seq, _cluster_attempt
     _cluster_shuffle_seq = [int(query_id), 0] if query_id is not None \
         else None
+    _cluster_attempt = int(attempt)
+
+
+def set_cluster_identity(logical_id) -> None:
+    """The logical participant slot this task fills (defaults to the
+    executor's own id).  A speculative attempt or a post-loss rank
+    re-dispatch runs AS the original assignee: its map completions commit
+    against that logical slot, so readers' completeness waits and server
+    resolution see one consistent participant set whoever physically ran
+    the work."""
+    global _cluster_logical
+    _cluster_logical = logical_id
 
 
 def set_cluster_participants(participants) -> None:
@@ -285,6 +304,28 @@ def set_range_serialize(enabled: bool) -> None:
 
 def range_serialize_enabled() -> bool:
     return _RANGE_SERIALIZE[0]
+
+
+#: map-output durability (spark.rapids.shuffle.replication.* +
+#: spark.rapids.cluster.drain.timeout): (replication factor k, persist
+#: dir, drain timeout seconds).  k>1: after a map commit the blocks
+#: replicate asynchronously to k-1 rendezvous-chosen peers and reduce
+#: reads fail over to replicas on peer loss; persist dir is the
+#: spill-backed fallback when k=1 (blocks also land on local disk and a
+#: restarted executor re-serves them); the drain timeout bounds a
+#: graceful leave's re-replication pass.
+_replication = (1, "", 30.0)
+
+
+def set_replication(factor: int, persist_dir: str = "",
+                    drain_timeout_s: float = 30.0) -> None:
+    global _replication
+    _replication = (max(int(factor), 1), str(persist_dir or ""),
+                    max(float(drain_timeout_s), 0.0))
+
+
+def replication_config():
+    return _replication
 
 
 #: receive-side flow-control window (spark.rapids.shuffle.fetch.*):
@@ -339,6 +380,7 @@ def make_transport(mode: str, num_partitions: int, schema: Schema,
             _cluster_shuffle_seq[1] += 1
             sid = (qid << 16) | ordinal
         mi, ft, mc = _fetch_window
+        repl, persist, _drain = _replication
         return TcpShuffleTransport(process_shuffle_executor(),
                                    num_partitions, schema, codec,
                                    max_inflight_bytes=mi,
@@ -348,5 +390,9 @@ def make_transport(mode: str, num_partitions: int, schema: Schema,
                                    completeness_timeout_s=(
                                        _completeness_timeout_s),
                                    participants=_cluster_participants,
-                                   request_bytes=_fetch_request_bytes)
+                                   request_bytes=_fetch_request_bytes,
+                                   attempt=_cluster_attempt,
+                                   logical_id=_cluster_logical,
+                                   replication=repl,
+                                   persist_dir=persist)
     return CacheOnlyTransport(num_partitions)

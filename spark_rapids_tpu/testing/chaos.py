@@ -65,6 +65,14 @@ SITES: Dict[str, str] = {
     "cluster.task":
         "cluster executor run_task entry: raise InjectedFault (task "
         "death; the driver must retry without losing the query).",
+    "cluster.task.delay":
+        "cluster executor run_task entry: sleep args['seconds'] before "
+        "executing (deterministic straggler; exercises the driver's "
+        "speculative re-dispatch).",
+    "shuffle.fetch.delay":
+        "client fetch batch path: sleep args['seconds'] before the "
+        "round-trip (slow link to one peer; exercises per-peer overlap "
+        "and straggler-fetch accounting).",
     "cluster.heartbeat":
         "executor liveness beat: raise InjectedFault instead of "
         "heartbeating (dropped beats; exercises backoff and the "
@@ -95,6 +103,7 @@ class ChaosRegistry:
         self._lock = threading.Lock()
         self._plans: Dict[str, _Plan] = {}
         self._fired_total: Dict[str, int] = {}
+        self._delayed_s: Dict[str, float] = {}
         self._armed = False             # lock-free fast-path guard
 
     # -- arming ---------------------------------------------------------------
@@ -170,10 +179,25 @@ class ChaosRegistry:
         raise exc(message or f"chaos: injected fault at {site!r}")
 
     def stall(self, site: str) -> None:
-        """Sleep ``args['seconds']`` (default 0.2) when the fault fires."""
+        """Sleep ``args['seconds']`` (default 0.2) when the fault fires.
+        Alias of ``delay`` kept for its role name: stall models a
+        one-off hiccup, delay a standing straggler."""
+        self.delay(site)
+
+    def delay(self, site: str) -> float:
+        """Additive latency injection: sleep ``args['seconds']`` (default
+        0.2) when the fault fires and return the injected delay (0.0 when
+        disarmed).  Plans typically arm with ``count=-1`` to make EVERY
+        visit slow (a straggler).  Total injected seconds per site is
+        observable via ``delayed_seconds``."""
         hit = self.fire(site)
-        if hit is not None:
-            time.sleep(float(hit.get("seconds", 0.2)))
+        if hit is None:
+            return 0.0
+        seconds = float(hit.get("seconds", 0.2))
+        time.sleep(seconds)
+        with self._lock:
+            self._delayed_s[site] = self._delayed_s.get(site, 0.0) + seconds
+        return seconds
 
     def corrupt(self, site: str, data: bytes) -> bytes:
         """Flip one deterministic bit of ``data`` when the fault fires
@@ -207,6 +231,12 @@ class ChaosRegistry:
             f.write(bytes([b ^ (1 << rng.randrange(8))]))
 
     # -- observation ----------------------------------------------------------
+
+    def delayed_seconds(self, site: str) -> float:
+        """Total latency injected at ``site`` since process start
+        (survives ``clear``; the speculation tests assert on it)."""
+        with self._lock:
+            return self._delayed_s.get(site, 0.0)
 
     def fired_count(self, site: str) -> int:
         """Total faults fired at ``site`` since process start (survives

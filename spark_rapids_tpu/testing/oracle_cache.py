@@ -1,0 +1,105 @@
+"""Differential-oracle result cache (NOTES_r05: the ORACLE's CPU pass —
+not the TPU engine — is the wall on q72-sized gauntlet tests and chaos
+soak reruns).
+
+The CPU oracle is deterministic for a given (query, seed, nrows):
+memoizing its collected rows to disk makes reruns pay only the TPU side.
+Keys are caller-chosen tuples; the cache file carries a format version
+so a layout change can never resurrect stale rows.  Corrupt or
+unreadable entries silently recompute — the cache can only ever save
+time, never change results.
+
+Scope guard: ONLY oracle outputs belong here (rows produced with
+spark.rapids.sql.enabled=false).  Caching the device side would defeat
+the differential test entirely.
+
+Env knobs:
+  * TPU_ORACLE_CACHE=0        disable (compute every time)
+  * TPU_ORACLE_CACHE_DIR=...  cache directory (default under /tmp)
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import re
+import tempfile
+from typing import Callable, Iterable, List
+
+CACHE_FORMAT_VERSION = 1
+
+#: observability for tests: (hits, misses) since process start
+_STATS = {"hits": 0, "misses": 0}
+
+_FP_CACHE: dict = {}
+
+
+def source_fingerprint(*modules) -> str:
+    """Short digest of the given modules' source files.  Folded into
+    cache keys so an edit to a query builder or data generator
+    INVALIDATES its memoized oracle rows — a stale oracle would make the
+    differential test compare new engine output against old truth."""
+    key = tuple(getattr(m, "__name__", str(m)) for m in modules)
+    got = _FP_CACHE.get(key)
+    if got is None:
+        h = hashlib.sha256()
+        for m in modules:
+            path = getattr(m, "__file__", None)
+            try:
+                with open(path, "rb") as f:
+                    h.update(f.read())
+            except (OSError, TypeError):
+                h.update(repr(path).encode())
+        got = _FP_CACHE[key] = h.hexdigest()[:12]
+    return got
+
+
+def cache_enabled() -> bool:
+    return os.environ.get("TPU_ORACLE_CACHE", "1").strip().lower() \
+        not in ("0", "false", "no")
+
+
+def cache_dir() -> str:
+    return os.environ.get("TPU_ORACLE_CACHE_DIR",
+                          "/tmp/spark_rapids_tpu_oracle_cache")
+
+
+def cache_stats() -> dict:
+    return dict(_STATS)
+
+
+def _entry_path(key_parts: Iterable) -> str:
+    parts = [str(p) for p in key_parts]
+    slug = re.sub(r"[^A-Za-z0-9._-]+", "_", "-".join(parts))[:80]
+    digest = hashlib.sha256(
+        repr((CACHE_FORMAT_VERSION, parts)).encode()).hexdigest()[:16]
+    return os.path.join(cache_dir(), f"{slug}-{digest}.pkl")
+
+
+def get_or_compute(key_parts: Iterable,
+                   compute: Callable[[], List]) -> List:
+    """Rows for ``key_parts`` — from the cache when present, else from
+    ``compute()`` (stored atomically afterwards).  Row order is
+    preserved exactly, so ordered differential comparisons stay valid."""
+    if not cache_enabled():
+        return compute()
+    path = _entry_path(key_parts)
+    try:
+        with open(path, "rb") as f:
+            version, rows = pickle.load(f)
+        if version == CACHE_FORMAT_VERSION:
+            _STATS["hits"] += 1
+            return rows
+    except (OSError, pickle.UnpicklingError, EOFError, ValueError):
+        pass        # absent or corrupt: recompute (and overwrite)
+    _STATS["misses"] += 1
+    rows = compute()
+    try:
+        os.makedirs(cache_dir(), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=cache_dir(), suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump((CACHE_FORMAT_VERSION, rows), f)
+        os.replace(tmp, path)       # readers never see a torn entry
+    except OSError:
+        pass        # cache is best-effort; the computed rows still serve
+    return rows

@@ -268,6 +268,73 @@ def test_registry_excludes_peer_after_threshold():
         ex.close()
 
 
+def test_registry_revive_after_exclude_gets_fresh_streak():
+    """An excluded peer that RE-REGISTERS is fetchable again and its
+    failure record starts over: it takes a full fresh threshold of
+    reports to exclude it again (pin of the exclude/revive contract).
+    A mere heartbeat, by contrast, never resurrects an excluded peer."""
+    ex = ShuffleExecutor(serve_registry=True)
+    try:
+        reg = ex.registry
+        reg.exclude_threshold = 2
+        reg.register("wx", "127.0.0.1", 4321)
+        assert reg.exclude("wx")                 # driver-observed loss
+        assert "wx" not in reg.peers()
+        # heartbeats from a zombie don't re-admit it
+        reg.heartbeat("wx")
+        assert "wx" not in reg.peers()
+        # reports against an absent peer never re-exclude (no double
+        # counting), even though its failure record is saturated
+        before = shuffle_counters()["peers_excluded"]
+        assert not reg.report_failure("wx")
+        assert shuffle_counters()["peers_excluded"] == before
+        # a genuine restart re-registers: live again, record cleared
+        reg.register("wx", "127.0.0.1", 4322)
+        assert reg.peers()["wx"] == ("127.0.0.1", 4322)
+        assert not reg.report_failure("wx")      # 1/2: fresh streak
+        assert reg.report_failure("wx")          # 2/2 excludes again
+        assert "wx" not in reg.peers()
+    finally:
+        ex.close()
+
+
+# -- latency injection (delay hook) -------------------------------------------
+
+def test_chaos_delay_hook_injects_and_accounts():
+    t0 = time.monotonic()
+    base = CHAOS.delayed_seconds("shuffle.fetch.delay")
+    CHAOS.install("shuffle.fetch.delay", count=2, seconds=0.05)
+    assert CHAOS.delay("shuffle.fetch.delay") == 0.05
+    assert CHAOS.delay("shuffle.fetch.delay") == 0.05
+    assert CHAOS.delay("shuffle.fetch.delay") == 0.0    # plan exhausted
+    assert time.monotonic() - t0 >= 0.1
+    assert CHAOS.delayed_seconds("shuffle.fetch.delay") - base == \
+        pytest.approx(0.1)
+
+
+def test_fetch_delay_site_slows_read_without_breaking_it(node):
+    CHAOS.install("shuffle.fetch.delay", count=1, seconds=0.15)
+    t0 = time.monotonic()
+    blocks = list(BlockFetchIterator([PeerClient(node.server.addr)], 11, 0))
+    assert len(blocks) == 6
+    assert time.monotonic() - t0 >= 0.15
+    assert CHAOS.fired_count("shuffle.fetch.delay") >= 1
+
+
+def test_task_delay_site_fires_before_task_state():
+    """run_task visits cluster.task.delay FIRST: an armed delay makes the
+    task look exactly like a slow worker (then the armed task-death site
+    proves the visit order without building engine state)."""
+    from spark_rapids_tpu.cluster.executor import run_task
+    CHAOS.install("cluster.task.delay", count=1, seconds=0.12)
+    CHAOS.install("cluster.task", count=1)
+    t0 = time.monotonic()
+    with pytest.raises(InjectedFault, match="cluster.task"):
+        run_task({"rank": 0, "world": 1, "query_id": 1}, b"", {})
+    assert time.monotonic() - t0 >= 0.12
+    assert CHAOS.fired_count("cluster.task.delay") >= 1
+
+
 # -- spill integrity ----------------------------------------------------------
 
 def test_spill_bitflip_is_typed_error_not_wrong_results():

@@ -1,0 +1,602 @@
+"""Elastic scale-out with durable shuffle (ROADMAP open item 4).
+
+Scenario tests over protocol-level fake executors (threads speaking the
+driver RPC protocol, each with a REAL ShuffleExecutor node and REAL
+TcpShuffleTransports — only the query engine is faked, so replication,
+first-commit-wins, replica failover and the driver's speculation /
+rank re-dispatch logic are exercised end-to-end):
+
+  * executor loss with replication ON completes by RE-FETCHING replicas
+    and re-dispatching one rank — counters prove re-fetch, not
+    re-execution (blocks_refetched_replica > 0, scoped_resubmits == 0);
+  * the same loss with replication OFF still recovers through the PR 4
+    scoped path (scoped_resubmits >= 1);
+  * a chaos-delayed straggler triggers EXACTLY ONE speculative attempt
+    on a rank that joined mid-query; first-commit-wins leaves a single
+    attempt's blocks in the BlockStores;
+  * graceful leave drains primary blocks to peers and an in-flight
+    query finishes through the replica catalog without scoped recovery.
+
+Every test is seeded/event-gated and CPU-only; the dataset is a fixed
+union independent of the world size, so any recovery shape must produce
+identical rows.
+"""
+import pickle
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.shuffle.net import (
+    PeerClient, ShuffleExecutor, TcpShuffleTransport, _request,
+    connection_pool, set_network_retry)
+from spark_rapids_tpu.shuffle.stats import (
+    reset_shuffle_counters, shuffle_counters)
+from spark_rapids_tpu.testing.chaos import CHAOS, InjectedFault
+
+SCHEMA = Schema.of(k=T.INT, v=T.LONG)
+N = 160                 # dataset rows; partition 0 = [0, 80), 1 = [80, 160)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    CHAOS.clear()
+    reset_shuffle_counters()
+    set_network_retry(2, 0.01, 0.05)    # fast failover in tests
+    yield
+    CHAOS.clear()
+    set_network_retry(4, 0.05, 2.0)
+    connection_pool().close_all()
+
+
+def _share(rank: int, world: int):
+    """Rank r's map share of the fixed dataset — the union over ranks is
+    [0, N) for ANY world, so a scoped re-run at a smaller world must
+    produce the same rows as the elastic path."""
+    return [i for i in range(N) if (i // 10) % world == rank]
+
+
+def _pbatch(vals):
+    return ColumnarBatch.from_pydict(
+        {"k": [v % 3 for v in vals], "v": list(vals)}, SCHEMA)
+
+
+def _transport(node, task, replication=1):
+    node.heartbeat()    # learn the current peer set before writing
+    sid = (task["query_id"] << 16) | 0
+    return TcpShuffleTransport(
+        node, 2, SCHEMA, shuffle_id=sid,
+        participants=task["participants"],
+        attempt=task.get("attempt", 0),
+        logical_id=task.get("as"),
+        replication=replication,
+        completeness_timeout_s=30)
+
+
+def _write_share(t, task):
+    vals = _share(task["rank"], task["world"])
+    t.write([(0, _pbatch([v for v in vals if v < N // 2])),
+             (1, _pbatch([v for v in vals if v >= N // 2]))])
+
+
+def _reduce_rows(t, task):
+    """Read the partitions this rank owns; partition-tagged rows."""
+    out = []
+    for p in range(2):
+        if task["world"] > 1 and p % task["world"] != task["rank"]:
+            continue
+        vals = []
+        for b in t.read(p):
+            vals.extend(int(v) for v in b.to_pydict()["v"])
+        out.append((p, [[v] for v in sorted(vals)]))
+    return out
+
+
+class ElasticExecutor:
+    """FakeExecutor with rank/attempt echo, real shuffle node, and
+    graceful-leave support (tests/test_chaos.py lineage)."""
+
+    def __init__(self, driver, name, behavior):
+        self.driver = driver
+        self.name = name
+        self.behavior = behavior
+        self.node = ShuffleExecutor(name,
+                                    driver_addr=driver.shuffle.server.addr)
+        self.tasks_seen = []            # (rank, attempt, as)
+        self.leave_after_result = False
+        self.drained = None
+        self._closed = False
+        self.stop_ev = threading.Event()
+        # liveness beats off the task thread (executor_main does the
+        # same): a behavior blocked in a long read must not age out of
+        # the registry and look dead to the driver
+        self.beat_thread = threading.Thread(target=self._beat, daemon=True)
+        self.beat_thread.start()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _beat(self):
+        while not self.stop_ev.is_set() and not self._closed:
+            try:
+                PeerClient(self.driver.shuffle.server.addr).heartbeat(
+                    self.name)
+            except OSError:
+                pass
+            self.stop_ev.wait(0.15)
+
+    def _push(self, task, header_extra, payload=b""):
+        _request(self.driver.rpc_addr,
+                 dict({"op": "task_result",
+                       "query_id": task["query_id"],
+                       "executor_id": self.name,
+                       "rank": task.get("rank"),
+                       "attempt": task.get("attempt", 0)},
+                      **header_extra), payload)
+
+    def _run(self):
+        while not self.stop_ev.is_set():
+            try:
+                header, payload = _request(
+                    self.driver.rpc_addr,
+                    {"op": "get_task", "executor_id": self.name},
+                    retriable=False)
+            except OSError:
+                time.sleep(0.02)
+                continue
+            task = header.get("task")
+            if task is None:
+                time.sleep(0.02)
+                continue
+            self.tasks_seen.append((task["rank"], task.get("attempt", 0),
+                                    task.get("as")))
+            try:
+                out = self.behavior(self, task)
+            except (InjectedFault, OSError) as e:    # retryable family
+                out = ("error", repr(e), True)
+            except Exception as e:  # noqa: BLE001 — deterministic error
+                out = ("error", repr(e), False)
+            if out == "die":
+                self._close_node()
+                return
+            if isinstance(out, tuple) and out[0] == "error":
+                self._push(task, {"error": out[1], "retryable": out[2]})
+            else:
+                self._push(task, {}, pickle.dumps(out))
+            if self.leave_after_result:
+                self.drained = self.node.leave(drain=True, timeout_s=10)
+                self._close_node()
+                return
+
+    def _close_node(self):
+        if not self._closed:
+            self._closed = True
+            self.node.close()
+
+    def close(self):
+        self.stop_ev.set()
+        self.thread.join(timeout=10)
+        self._close_node()
+
+
+def _expected_rows():
+    return [[v] for v in range(N)]
+
+
+def _flat(rows):
+    return [list(r) for r in rows]
+
+
+# -- acceptance: re-fetch instead of re-execute -------------------------------
+
+def test_executor_loss_with_replication_refetches_not_reexecutes():
+    """Chaos soak (acceptance): kill an executor mid-query with
+    replication on.  The query completes; blocks_refetched_replica > 0
+    and scoped_resubmits == 0 prove the recovery was a replica re-fetch
+    plus ONE rank re-dispatch — never the whole-query scoped resubmit."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(
+        conf={"spark.rapids.shuffle.replication.factor": "2"},
+        heartbeat_timeout_s=0.7)
+    died = threading.Event()
+    w1 = w2 = None
+
+    def w2_behavior(ex, task):
+        t = _transport(ex.node, task, replication=2)
+        _write_share(t, task)
+        # the map output must be durable BEFORE the death for the
+        # re-fetch path to exist at all (async push joined here)
+        assert ex.node.wait_replicated((task["query_id"] << 16) | 0, 10)
+        died.set()
+        return "die"
+
+    def w1_behavior(ex, task):
+        t = _transport(ex.node, task, replication=2)
+        _write_share(t, task)
+        died.wait(20)
+        time.sleep(1.0)     # let the registry age the dead peer out
+        return _reduce_rows(t, task)
+
+    try:
+        w1 = ElasticExecutor(driver, "w1", w1_behavior)
+        w2 = ElasticExecutor(driver, "w2", w2_behavior)
+        driver.wait_for_executors(2, timeout_s=30)
+        rows = driver.submit({"fake": "plan"}, timeout_s=60, max_retries=2)
+        assert _flat(rows) == _expected_rows()
+        c = shuffle_counters()
+        assert c["blocks_replicated"] > 0
+        assert c["blocks_refetched_replica"] > 0, \
+            "recovery must re-fetch replicas"
+        assert c["scoped_resubmits"] == 0, \
+            "durable loss must not re-execute the whole query"
+        assert c["rank_redispatches"] == 1
+        assert c["executors_excluded"] == 1
+        assert c["map_commits_lost"] >= 1   # the re-dispatch lost the
+        # already-committed slot and dropped its own duplicate blocks
+        # the adopted rank ran on the survivor, AS the dead executor
+        assert (1, 1, "w2") in w1.tasks_seen
+    finally:
+        for w in (w1, w2):
+            if w is not None:
+                w.close()
+        driver.close()
+
+
+def test_executor_loss_without_replication_uses_scoped_path():
+    """Same kill with replication OFF: the PR 4 scoped path (exclude,
+    invalidate, resubmit over survivors) still recovers to correct
+    rows — and no replica counter moves, because none exist."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=0.7)
+    died = threading.Event()
+    w1 = w2 = None
+
+    def w2_behavior(ex, task):
+        t = _transport(ex.node, task)
+        _write_share(t, task)
+        died.set()
+        return "die"
+
+    def w1_behavior(ex, task):
+        t = _transport(ex.node, task)
+        _write_share(t, task)
+        if task["world"] > 1:
+            died.wait(20)
+            time.sleep(1.0)
+        return _reduce_rows(t, task)
+
+    try:
+        w1 = ElasticExecutor(driver, "w1", w1_behavior)
+        w2 = ElasticExecutor(driver, "w2", w2_behavior)
+        driver.wait_for_executors(2, timeout_s=30)
+        rows = driver.submit({"fake": "plan"}, timeout_s=90, max_retries=3)
+        assert _flat(rows) == _expected_rows()
+        c = shuffle_counters()
+        assert c["scoped_resubmits"] >= 1
+        assert c["blocks_refetched_replica"] == 0
+        assert c["rank_redispatches"] == 0
+    finally:
+        for w in (w1, w2):
+            if w is not None:
+                w.close()
+        driver.close()
+
+
+# -- acceptance: speculation + first-commit-wins ------------------------------
+
+def test_straggler_speculation_first_commit_wins():
+    """A chaos-delayed straggler triggers EXACTLY ONE speculative
+    attempt; the speculative copy (on a rank that joined mid-query)
+    wins the map-commit race, the straggler's late blocks are dropped by
+    attempt id, and the counters prove the whole story."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(
+        conf={"spark.rapids.cluster.speculation.enabled": "true",
+              "spark.rapids.cluster.speculation.minTasks": "1",
+              "spark.rapids.cluster.speculation.multiplier": "1.5",
+              "spark.rapids.cluster.speculation.quantile": "1.0"},
+        heartbeat_timeout_s=30.0)
+    CHAOS.install("cluster.task.delay", count=1, seconds=2.5)
+    w1 = w2 = w3 = None
+
+    def behavior(ex, task):
+        # the straggler's first visit eats the injected latency; every
+        # other attempt passes straight through (count=1)
+        if task["rank"] == 1 and task.get("attempt", 0) == 0:
+            CHAOS.delay("cluster.task.delay")
+        if task["rank"] == 0:
+            # slow-ish baseline task: its duration sets the speculation
+            # threshold AFTER the spare rank has joined, so the joiner
+            # (idle by construction, preferred candidate) adopts the
+            # straggler's copy deterministically
+            time.sleep(0.8)
+        t = _transport(ex.node, task)
+        _write_share(t, task)
+        if task["rank"] == 0:
+            return []                       # map-only rank: no reduce
+        out = []
+        for p in range(2):                  # rank 1 reduces everything
+            vals = []
+            for b in t.read(p):
+                vals.extend(int(v) for v in b.to_pydict()["v"])
+            out.append((p, [[v] for v in sorted(vals)]))
+        return out
+
+    try:
+        w1 = ElasticExecutor(driver, "w1", behavior)
+        w2 = ElasticExecutor(driver, "w2", behavior)
+        driver.wait_for_executors(2, timeout_s=30)
+        result = {}
+
+        def run():
+            result["rows"] = driver.submit({"fake": "plan"}, timeout_s=60,
+                                           max_retries=1)
+        runner = threading.Thread(target=run)
+        runner.start()
+        time.sleep(0.4)                 # query in flight, w2 straggling
+        w3 = ElasticExecutor(driver, "w3", behavior)   # joins mid-query
+        runner.join(timeout=60)
+        assert not runner.is_alive() and "rows" in result
+        assert _flat(result["rows"]) == _expected_rows()
+        c = shuffle_counters()
+        assert c["speculative_launches"] == 1, "exactly one speculation"
+        assert c["speculative_wins"] == 1
+        assert c["executors_joined"] >= 3
+        assert c["catalog_syncs"] >= 1      # the joiner pulled the catalog
+        # the joiner ran the speculative copy AS the straggler
+        assert (1, 1, "w2") in w3.tasks_seen
+        # first-commit-wins: wait out the straggler's injected delay —
+        # its late commit is refused and its blocks dropped, leaving
+        # exactly one attempt's blocks (the winner's, on w3)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and \
+                shuffle_counters()["map_commits_lost"] < 1:
+            time.sleep(0.05)
+        assert shuffle_counters()["map_commits_lost"] >= 1
+        assert CHAOS.delayed_seconds("cluster.task.delay") >= 2.5
+        assert not any(w2.node.store.partitions(s)
+                       for s in w2.node.store.shuffle_ids()), \
+            "the losing attempt's blocks must be dropped"
+        assert any(w3.node.store.partitions(s)
+                   for s in w3.node.store.shuffle_ids())
+        assert shuffle_counters()["map_commits_lost"] >= 1
+    finally:
+        for w in (w1, w2, w3):
+            if w is not None:
+                w.close()
+        driver.close()
+
+
+# -- acceptance: elastic join / graceful leave --------------------------------
+
+def test_graceful_leave_drains_and_query_completes_via_replicas():
+    """A rank finishes its task, gracefully LEAVES (drains its primary
+    blocks to a peer), and an in-flight reducer still completes through
+    the replica catalog — scoped recovery untouched."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(
+        conf={"spark.rapids.shuffle.replication.factor": "2"},
+        heartbeat_timeout_s=30.0)
+    gate = threading.Event()
+    w1 = w2 = None
+
+    def w2_behavior(ex, task):
+        t = _transport(ex.node, task, replication=2)
+        _write_share(t, task)
+        ex.node.wait_replicated((task["query_id"] << 16) | 0, 10)
+        ex.leave_after_result = True    # push result, then drain + leave
+        return _reduce_rows(t, task)
+
+    def w1_behavior(ex, task):
+        t = _transport(ex.node, task, replication=2)
+        _write_share(t, task)
+        gate.wait(30)                   # read only after w2 has left
+        return _reduce_rows(t, task)
+
+    try:
+        w1 = ElasticExecutor(driver, "w1", w1_behavior)
+        w2 = ElasticExecutor(driver, "w2", w2_behavior)
+        driver.wait_for_executors(2, timeout_s=30)
+        result = {}
+
+        def run():
+            result["rows"] = driver.submit({"fake": "plan"}, timeout_s=60,
+                                           max_retries=1)
+        runner = threading.Thread(target=run)
+        runner.start()
+        # wait for w2's graceful departure, then release the reducer
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and "w2" in \
+                driver.shuffle.registry.peers(workers_only=True):
+            time.sleep(0.05)
+        assert "w2" not in driver.shuffle.registry.peers(workers_only=True)
+        gate.set()
+        runner.join(timeout=60)
+        assert not runner.is_alive() and "rows" in result
+        assert _flat(result["rows"]) == _expected_rows()
+        c = shuffle_counters()
+        assert c["executors_left"] == 1
+        assert c["blocks_drained"] > 0
+        assert c["blocks_refetched_replica"] > 0
+        assert c["scoped_resubmits"] == 0
+        assert c["rank_redispatches"] == 0
+        assert w2.drained is not None and w2.drained > 0
+    finally:
+        for w in (w1, w2):
+            if w is not None:
+                w.close()
+        driver.close()
+
+
+# -- durability unit coverage -------------------------------------------------
+
+def test_persist_dir_survives_store_restart(tmp_path):
+    """Spill-backed persistence (the k=1 fallback): a store restarted
+    with the same persist dir re-serves committed blocks from disk."""
+    from spark_rapids_tpu.shuffle.net import BlockStore
+    d = str(tmp_path / "persist")
+    store = BlockStore(persist_dir=d)
+    store.put(7, 0, b"alpha" * 20)
+    store.put(7, 0, b"beta" * 25)
+    store.put(7, 1, b"gamma" * 10)
+    store.mark_complete(7)
+    # a fresh store on the same dir = restarted executor
+    revived = BlockStore(persist_dir=d)
+    assert revived.is_complete(7)
+    assert revived.sizes(7, 0) == [100, 100]
+    assert revived.get(7, 1) == [b"gamma" * 10]
+    assert shuffle_counters()["blocks_recovered_disk"] >= 3
+    # teardown removes the files too
+    revived.drop_shuffle(7)
+    third = BlockStore(persist_dir=d)
+    assert third.get(7, 0) == []
+
+
+def test_persisted_blocks_of_dropped_attempt_do_not_resurrect(tmp_path):
+    """Attempt drops must reach the persist dir: a first-commit loser's
+    block left on disk would resurrect on the next memory miss and serve
+    NEXT TO the winner's remote copy (doubled rows)."""
+    from spark_rapids_tpu.shuffle.net import BlockStore
+    d = str(tmp_path / "persist")
+    store = BlockStore(persist_dir=d)
+    store.put(7, 0, b"win" * 30, attempt=0)
+    store.put(7, 0, b"lose" * 25, attempt=1)
+    assert store.drop_shuffle_attempt(7, 1) == 1
+    assert store.get(7, 0) == [b"win" * 30]
+    # a fresh store on the same dir (restart, or the original's memory
+    # miss) must reload ONLY the surviving attempt's block
+    revived = BlockStore(persist_dir=d)
+    assert revived.get(7, 0) == [b"win" * 30]
+
+
+def test_replication_dedupes_per_source_not_per_shuffle():
+    """A node serving two logical slots of ONE shuffle (adopted rank)
+    must push replicas under BOTH srcs — deduping the async push by
+    shuffle id alone silently skipped the second slot's copy."""
+    a = ShuffleExecutor(serve_registry=True)
+    b = ShuffleExecutor("holder", driver_addr=a.server.addr)
+    try:
+        a.heartbeat()
+        a.store.put(11, 0, b"mine" * 20)
+        a.replicate_shuffle_async(11, 2, src="slot-own")
+        a.replicate_shuffle_async(11, 2, src="slot-adopted")
+        assert a.wait_replicated(11, 10)
+        peer = PeerClient(b.server.addr)
+        assert peer.replica_sizes(11, 0, "slot-own") == [80]
+        assert peer.replica_sizes(11, 0, "slot-adopted") == [80]
+    finally:
+        b.close()
+        a.close()
+
+
+def test_replica_push_and_fetch_roundtrip():
+    """put_replica / fetch_replica wire roundtrip with CRC verification,
+    and replica reads never mix into the primary fetch path."""
+    a = ShuffleExecutor(serve_registry=True)
+    b = ShuffleExecutor("holder", driver_addr=a.server.addr)
+    try:
+        a.store.put(9, 0, b"x" * 100)
+        a.store.put(9, 0, b"y" * 50)
+        blocks = a.store.get_with_crcs(9, 0)
+        peer = PeerClient(b.server.addr)
+        peer.put_replica(9, 0, "src-exec", blocks)
+        assert peer.replica_sizes(9, 0, "src-exec") == [100, 50]
+        got = peer.fetch_replica(9, 0, "src-exec", [0, 1])
+        assert [bytes(x) for x, _ in got] == [b"x" * 100, b"y" * 50]
+        # the primary fetch path of the holder stays empty: replicas are
+        # served only by explicit replica reads
+        assert peer.list_blocks(9, 0) == []
+    finally:
+        b.close()
+        a.close()
+
+
+def test_drop_attempt_also_drops_its_commit_records():
+    """A failed task's cleanup (drop by attempt) must remove the commit
+    records pointing at that attempt: a record left behind would serve
+    an EMPTY pair list — indistinguishable from an empty partition — and
+    readers would be silently under-served instead of failing over."""
+    from spark_rapids_tpu.shuffle.net import BlockStore
+    store = BlockStore()
+    store.put(21, 0, b"x" * 10, attempt=0)
+    store.note_commit(21, "slot-a", 0)
+    store.put(21, 0, b"y" * 10, attempt=3)
+    store.note_commit(21, "slot-b", 3)
+    store.drop_shuffle_attempt(21, 0)
+    assert store.commits(21) == {"slot-b": 3}
+    assert store.get_committed(21, 0) == [b"y" * 10]
+
+
+def test_slot_filtered_serving_on_multi_slot_node():
+    """One node holding SEVERAL slots' blocks for one shuffle (own rank
+    + adopted win + an uncommitted loser) serves each reader exactly its
+    slot's committed blocks — never the union, never the loser's."""
+    from spark_rapids_tpu.shuffle.net import BlockFetchIterator
+    a = ShuffleExecutor(serve_registry=True)
+    try:
+        a.store.put(13, 0, b"own" * 10, attempt=0)
+        a.store.note_commit(13, "slot-own", 0)
+        a.store.put(13, 0, b"adopted" * 5, attempt=7)
+        a.store.note_commit(13, "slot-adopted", 7)
+        a.store.put(13, 0, b"loser" * 4, attempt=9)    # never committed
+
+        def read(src):
+            peer = PeerClient(a.server.addr)
+            peer.serve_src = src
+            return [bytes(b) for b in BlockFetchIterator([peer], 13, 0)]
+
+        assert read("slot-own") == [b"own" * 10]
+        assert read("slot-adopted") == [b"adopted" * 5]
+        # legacy unfiltered read still sees the raw union
+        assert len(read(None)) == 3
+        # a slot with NO commit record on this node must escalate, not
+        # silently serve nothing
+        from spark_rapids_tpu.shuffle.net import PeerLostError
+        with pytest.raises(PeerLostError):
+            read("slot-unknown")
+        # the local reduce short-circuit serves only committed slots
+        assert a.store.get_committed(13, 0) == [b"own" * 10,
+                                                b"adopted" * 5]
+    finally:
+        a.close()
+
+
+def test_stale_replica_snapshot_escalates_not_underserves():
+    """A replica pushed BEFORE a slot committed carries no commit entry
+    for it; a reader failing over to that snapshot must get
+    PeerLostError (-> scoped recovery), never silently fewer blocks."""
+    from spark_rapids_tpu.shuffle.net import (BlockFetchIterator,
+                                              PeerLostError, ReplicaClient)
+    a = ShuffleExecutor(serve_registry=True)
+    b = ShuffleExecutor("holder", driver_addr=a.server.addr)
+    try:
+        peer = PeerClient(b.server.addr)
+        peer.put_replica(15, 0, "src", [(b"x" * 10, 0)],
+                         attempts=[0], commits={"other-slot": 0})
+        rc = ReplicaClient("src", [("holder", b.server.addr)])
+        rc.serve_src = "late-slot"          # committed after the push
+        with pytest.raises(PeerLostError):
+            list(BlockFetchIterator([rc], 15, 0))
+        # the slot the snapshot DOES cover serves fine
+        rc2 = ReplicaClient("src", [("holder", b.server.addr)])
+        rc2.serve_src = "other-slot"
+        assert [bytes(x) for x in BlockFetchIterator([rc2], 15, 0)] \
+            == [b"x" * 10]
+    finally:
+        b.close()
+        a.close()
+
+
+def test_registry_first_commit_wins_and_servers_map():
+    from spark_rapids_tpu.shuffle.net import HeartbeatRegistry
+    reg = HeartbeatRegistry()
+    assert reg.map_complete(5, "w2", physical_id="w2") is True
+    assert reg.map_complete(5, "w2", physical_id="w3") is False
+    assert reg.map_complete(5, "w2", physical_id="w2") is True  # idempotent
+    parts, complete, servers = reg.shuffle_status(5)
+    assert complete == ["w2"] and servers == {"w2": "w2"}
+    # a speculative winner for a slot nobody committed yet
+    assert reg.map_complete(5, "w9", physical_id="w3") is True
+    _, _, servers = reg.shuffle_status(5)
+    assert servers["w9"] == "w3"

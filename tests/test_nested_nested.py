@@ -111,6 +111,73 @@ def test_map_entries_flatten_arrays_zip():
         == ["a1", "a2"]
 
 
+def test_arrays_zip_over_array_of_struct():
+    """ArraysZip with array<struct> inputs (NOTES_r05: explicitly
+    untested until now; plain + string inputs already pinned): the zip's
+    output struct nests the input's struct element type, zip-to-longest
+    pads the shorter side with null fields, and a null input array still
+    nulls the whole row."""
+    st = T.StructType((T.StructField("x", T.INT),
+                       T.StructField("y", T.STRING)))
+    schema = Schema.of(
+        xs=T.ArrayType(st),
+        a=T.ArrayType(T.LONG),
+        ys=T.ArrayType(st),
+    )
+    rows = {
+        "xs": [[(1, "a"), (2, "b")], None, [], [(3, None), None]],
+        "a": [[10, 20, 30], [1], None, [7]],
+        "ys": [[(9, "z")], [], [(8, "w")], None],
+    }
+
+    def build(s):
+        b = ColumnarBatch.from_pydict(rows, schema)
+        return s.create_dataframe([b]).select(
+            arrays_zip("xs", "a").alias("z_sa"),
+            arrays_zip("xs", "ys").alias("z_ss"),
+            arrays_zip("xs").alias("z_s"))
+
+    out = assert_tpu_cpu_equal(build, ignore_order=False)
+    # zip-to-longest: xs row 0 has 2 structs, a has 3 longs -> the third
+    # entry carries a NULL struct field next to the long
+    assert out[0][0] == [((1, "a"), 10), ((2, "b"), 20), (None, 30)]
+    # struct x struct zip, and the struct's inner null field survives
+    assert out[3][0] == [((3, None), 7), (None, None)]
+    assert out[0][1] == [((1, "a"), (9, "z")), ((2, "b"), None)]
+    # any null input array -> null row (both orders)
+    assert out[1][0] is None and out[2][0] is None and out[3][1] is None
+    # single-input zip over array<struct> round-trips the structs
+    assert out[0][2] == [((1, "a"),), ((2, "b"),)]
+    # field naming parity on the nested case
+    from spark_rapids_tpu.api.session import TpuSession
+    sch = build(TpuSession({"spark.rapids.sql.enabled": "false"})).schema
+    assert [f.name for f in sch.dtype_of("z_sa").element_type.fields] \
+        == ["xs", "a"]
+
+
+def test_arrays_zip_array_of_struct_after_shuffle():
+    """array<struct> zip output survives a repartition (wire/concat
+    paths over the nested result)."""
+    st = T.StructType((T.StructField("x", T.INT),
+                       T.StructField("y", T.STRING)))
+    schema = Schema.of(k=T.INT, xs=T.ArrayType(st), a=T.ArrayType(T.LONG))
+    rows = {
+        "k": [1, 2, 3, 4],
+        "xs": [[(1, "a")], None, [(2, "b"), (3, "c")], []],
+        "a": [[5], [6, 7], [8], []],
+    }
+
+    def build(s):
+        b = ColumnarBatch.from_pydict(rows, schema)
+        return (s.create_dataframe([b], num_partitions=2).repartition(3)
+                .select("k", arrays_zip("xs", "a").alias("z"))
+                .order_by("k"))
+
+    out = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert out[0][1] == [((1, "a"), 5)]
+    assert out[1][1] is None
+
+
 def test_flatten_null_inner_array_nulls_row():
     schema = Schema.of(aa=T.ArrayType(T.ArrayType(T.INT)))
     rows = {"aa": [[[1], None, [2]], [[3]]]}

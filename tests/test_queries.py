@@ -6,6 +6,7 @@ run it with spark.rapids.sql.enabled on and off, compare collected rows
 exactly (sorted, since output order is unspecified without a sort).
 """
 import math
+import sys
 
 import numpy as np
 import pytest
@@ -61,11 +62,28 @@ def _eq_val(a, b):
     return a == b
 
 
-def assert_tpu_cpu_equal(build, ignore_order=True):
-    """build(session) -> DataFrame.  Runs on both engines, compares."""
+def assert_tpu_cpu_equal(build, ignore_order=True, oracle_key=None):
+    """build(session) -> DataFrame.  Runs on both engines, compares.
+
+    ``oracle_key`` (e.g. ``("q25", seed, nrows)``) memoizes the CPU
+    ORACLE's rows to disk (testing/oracle_cache.py): the oracle pass —
+    not the TPU — is the wall on gauntlet-sized queries, and it is
+    deterministic for a fixed key.  The TPU side always runs."""
     cpu_sess = TpuSession({"spark.rapids.sql.enabled": "false"})
     tpu_sess = TpuSession({"spark.rapids.sql.enabled": "true"})
-    cpu_rows = build(cpu_sess).collect()
+    if oracle_key is not None:
+        from spark_rapids_tpu.testing import tpcds
+        from spark_rapids_tpu.testing.oracle_cache import (
+            get_or_compute, source_fingerprint)
+        # the generator/query source digest invalidates memoized rows
+        # when tpcds.py (or this module's builders) change — a stale
+        # oracle would silently compare against old truth
+        oracle_key = tuple(oracle_key) + (
+            source_fingerprint(tpcds, sys.modules[__name__]),)
+        cpu_rows = get_or_compute(oracle_key,
+                                  lambda: build(cpu_sess).collect())
+    else:
+        cpu_rows = build(cpu_sess).collect()
     tpu_rows = build(tpu_sess).collect()
     if ignore_order:
         cpu_rows = _normalize(cpu_rows)

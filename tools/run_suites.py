@@ -1,0 +1,150 @@
+"""Targeted suite runner: the practical verify loop for this container.
+
+The 870s tier-1 slice covers ~10% of the test suite on this machine
+(ROADMAP container notes), so builders verify touched areas with
+targeted per-suite runs.  This tool records those suites ONCE — files,
+per-suite timeout — and runs any subset serially with a summary table,
+so "run the shuffle and cluster suites" stops being a hand-maintained
+shell history.
+
+Run:
+    python tools/run_suites.py                  # every suite
+    python tools/run_suites.py shuffle cluster  # a subset
+    python tools/run_suites.py --list
+    python tools/run_suites.py --timeout-scale 2.0   # slow container
+
+Exit code: number of failing suites (0 = all green).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: suite -> (test files, timeout seconds).  Timeouts are ~2x observed
+#: wall on this container's CPU backend (memory: ~5x slower than the
+#: r5-era machines); --timeout-scale adjusts them wholesale.
+SUITES = {
+    "shuffle": (["tests/test_net_shuffle.py", "tests/test_range_shuffle.py",
+                 "tests/test_chaos.py", "tests/test_elastic.py"], 600),
+    "query": (["tests/test_queries.py", "tests/test_tpch.py",
+               "tests/test_tpcds.py"], 900),
+    "cluster": (["tests/test_cluster.py", "tests/test_distributed.py",
+                 "tests/test_ici_exchange.py"], 900),
+    "fused": (["tests/test_fused.py", "tests/test_spmd_stage.py"], 600),
+    "ooc": (["tests/test_out_of_core.py",
+             "tests/test_out_of_core_joins_full.py",
+             "tests/test_memory.py"], 900),
+    "gauntlet": (["tests/test_tpcds_gauntlet.py"], 1200),
+    "lint": (["tests/test_lint.py"], 300),
+}
+
+def _parse_tail(tail: str):
+    """(passed, failed, skipped) from pytest's summary line, best
+    effort — a crashed run reports (0, 0, 0) and the exit code rules."""
+    for line in reversed(tail.splitlines()):
+        if " passed" in line or " failed" in line or " error" in line:
+            passed = failed = skipped = 0
+            m = re.search(r"(\d+) passed", line)
+            passed = int(m.group(1)) if m else 0
+            m = re.search(r"(\d+) failed", line)
+            failed = int(m.group(1)) if m else 0
+            m = re.search(r"(\d+) skipped", line)
+            skipped = int(m.group(1)) if m else 0
+            m = re.search(r"(\d+) error", line)
+            failed += int(m.group(1)) if m else 0
+            return passed, failed, skipped
+    return 0, 0, 0
+
+
+def run_suite(name: str, files, timeout_s: float, extra_args):
+    cmd = [sys.executable, "-m", "pytest", "-q",
+           "-p", "no:cacheprovider", *files, *extra_args]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT,
+                              timeout=timeout_s)
+        out = proc.stdout.decode("utf-8", "replace")
+        rc = proc.returncode
+        timed_out = False
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode("utf-8", "replace")
+        rc, timed_out = -1, True
+    wall = time.monotonic() - t0
+    passed, failed, skipped = _parse_tail(out[-4000:])
+    status = ("TIMEOUT" if timed_out
+              else "PASS" if rc == 0
+              else "FAIL")
+    return {"suite": name, "status": status, "passed": passed,
+            "failed": failed, "skipped": skipped, "wall_s": wall,
+            "rc": rc, "tail": out[-2500:]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("suites", nargs="*",
+                    help=f"subset to run (default all): {sorted(SUITES)}")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--timeout-scale", type=float, default=1.0,
+                    help="multiply every suite timeout (slow containers)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print each suite's output tail even on PASS")
+    ap.add_argument("-m", dest="marker", default="not slow",
+                    help="pytest -m expression (default: 'not slow')")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name, (files, tmo) in SUITES.items():
+            print(f"{name:10s} {tmo:5d}s  {' '.join(files)}")
+        return 0
+    names = args.suites or list(SUITES)
+    unknown = [n for n in names if n not in SUITES]
+    if unknown:
+        ap.error(f"unknown suite(s) {unknown}; known: {sorted(SUITES)}")
+    extra = ["-m", args.marker] if args.marker else []
+    results = []
+    for name in names:
+        files, tmo = SUITES[name]
+        missing = [f for f in files
+                   if not os.path.exists(os.path.join(REPO, f))]
+        if missing:
+            # a renamed test file must FAIL the suite loudly — silently
+            # narrowing it (or worse, handing pytest zero file args and
+            # collecting the whole repo) would report the wrong thing
+            # under this suite's name
+            print(f"== {name} ==\n   -> FAIL (missing files: {missing})",
+                  flush=True)
+            results.append({"suite": name, "status": "FAIL", "passed": 0,
+                            "failed": 0, "skipped": 0, "wall_s": 0.0,
+                            "rc": 2, "tail": f"missing files: {missing}"})
+            continue
+        print(f"== {name} ({len(files)} files, "
+              f"timeout {int(tmo * args.timeout_scale)}s) ==", flush=True)
+        r = run_suite(name, files, tmo * args.timeout_scale, extra)
+        results.append(r)
+        if r["status"] != "PASS" or args.verbose:
+            print(r["tail"])
+        print(f"   -> {r['status']} ({r['passed']} passed, "
+              f"{r['failed']} failed, {r['skipped']} skipped, "
+              f"{r['wall_s']:.0f}s)", flush=True)
+    print("\n| suite | status | passed | failed | skipped | wall |")
+    print("|-------|--------|--------|--------|---------|------|")
+    for r in results:
+        print(f"| {r['suite']} | {r['status']} | {r['passed']} "
+              f"| {r['failed']} | {r['skipped']} | {r['wall_s']:.0f}s |")
+    bad = [r for r in results if r["status"] != "PASS"]
+    if bad:
+        print(f"\n{len(bad)} suite(s) not green: "
+              f"{[r['suite'] for r in bad]}")
+    return len(bad)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

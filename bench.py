@@ -273,6 +273,107 @@ def _child_prewarm(backend: str) -> None:
     print(json.dumps({"prewarm": True}))
 
 
+# -- concurrent serving bench (bench.py --concurrent) ------------------------
+#
+# Measures the serving layer (serving/admission.py) under N parallel
+# queries mixed across tenants on the CPU backend (ROADMAP container
+# notes: judge by counters and relative deltas): aggregate rows/s of
+# concurrent submission vs the SERIALIZED baseline over the same query
+# mix, plus per-tenant latency percentiles and the serving counters.
+# Runs fully in-process (no probe/child machinery — the comparison is
+# relative, same process, warm compile cache for both passes).
+
+CONCURRENT_QUERIES = int(os.environ.get(
+    "SPARK_RAPIDS_TPU_BENCH_CONCURRENT_QUERIES", 8))
+CONCURRENT_ROWS = int(os.environ.get(
+    "SPARK_RAPIDS_TPU_BENCH_CONCURRENT_ROWS", 1 << 19))
+
+
+def _percentiles(xs):
+    xs = sorted(xs)
+
+    def pick(q):
+        return round(xs[min(int(len(xs) * q), len(xs) - 1)], 4)
+    return {"p50": pick(0.50), "p90": pick(0.90), "p99": pick(0.99)}
+
+
+def _concurrent_bench() -> None:
+    _init_backend("cpu")
+    from spark_rapids_tpu.serving import LocalSessionRunner, QueryQueue
+    from spark_rapids_tpu.cluster.stats import (
+        local_shuffle_counters, reset_local_shuffle_counters)
+    from spark_rapids_tpu.testing import tpch
+
+    n_rows = CONCURRENT_ROWS
+    batches = tpch.gen_lineitem(n_rows, batch_rows=min(BATCH_ROWS, n_rows))
+    runner = LocalSessionRunner({})
+    session = runner.session
+
+    def make_plan(qname):
+        df = session.create_dataframe(list(batches), num_partitions=2)
+        return {"q6": tpch.q6, "q1": tpch.q1}[qname](df).plan
+
+    # the MIX: alternating q6/q1 across two tenants
+    mix = [("q6" if i % 2 == 0 else "q1",
+            "tenant%d" % (i % 2)) for i in range(CONCURRENT_QUERIES)]
+    plans = [(make_plan(q), q, t) for q, t in mix]
+
+    ctxless = QueryQueue(runner, conf={
+        "spark.rapids.serving.cache.enabled": "false"})
+    # warm the compile cache so both timed passes run warm (one plan of
+    # each shape)
+    ctxless.submit(plans[0][0], tenant="warm")
+    ctxless.submit(plans[1][0], tenant="warm")
+
+    # serialized baseline: the same mix, one query at a time
+    t0 = time.perf_counter()
+    for plan, _q, tenant in plans:
+        ctxless.submit(plan, tenant=tenant)
+    serialized_s = time.perf_counter() - t0
+
+    # concurrent: all queries submitted at once through admission
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+    reset_local_shuffle_counters()
+    lat = {}
+    lat_lock = threading.Lock()
+
+    def timed_submit(plan, tenant):
+        s = time.perf_counter()
+        rows = ctxless.submit(plan, tenant=tenant)
+        with lat_lock:
+            lat.setdefault(tenant, []).append(time.perf_counter() - s)
+        return rows
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=len(plans),
+                            thread_name_prefix="bench-serving") as pool:
+        futs = [pool.submit(timed_submit, plan, tenant)
+                for plan, _q, tenant in plans]
+        for f in futs:
+            f.result(timeout=QUERY_TIMEOUT_S["cpu"])
+    concurrent_s = time.perf_counter() - t0
+    counters = local_shuffle_counters()
+    total_rows = n_rows * len(plans)
+    out = {
+        "metric": "serving_concurrent_rows_per_sec",
+        "value": round(total_rows / concurrent_s),
+        "unit": "rows/s",
+        "serialized_rows_per_sec": round(total_rows / serialized_s),
+        "speedup_vs_serialized": round(serialized_s / concurrent_s, 3),
+        "backend": "cpu",
+        "n_queries": len(plans),
+        "rows_per_query": n_rows,
+        "mix": sorted({q for _p, q, _t in plans}),
+        "per_tenant_latency_s": {t: _percentiles(v)
+                                 for t, v in sorted(lat.items())},
+        "serving_counters": {k: v for k, v in counters.items()
+                             if k.startswith(("queries_", "cache_",
+                                              "tenant_", "budget_"))},
+    }
+    print(json.dumps(out))
+
+
 # -- parent side --------------------------------------------------------------
 
 def _spawn(backend: str, mode: str, timeout_s: int,
@@ -391,6 +492,17 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--concurrent" in sys.argv:
+        # serving-layer mode: in-process, CPU backend, never exits
+        # non-zero (same resilience contract as the main harness)
+        try:
+            _concurrent_bench()
+        except Exception as e:  # noqa: BLE001 — resilience contract
+            print(json.dumps({
+                "metric": "serving_concurrent_rows_per_sec",
+                "value": 0, "unit": "rows/s", "backend": "none",
+                "error": [f"concurrent: {type(e).__name__}: {e}"]}))
+        sys.exit(0)
     _spec = _child_mode()
     if _spec is not None:
         # child: crash loudly (rc!=0) so the parent records the error and

@@ -98,7 +98,13 @@ class TpuClusterDriver:
         # small integer whose qid slot (sid >> 16) is 0, so qid 0 would
         # make drop_query(0) collect unrelated standalone shuffles
         self._next_query = 1
-        self._tasks: Dict[str, dict] = {}       # executor_id -> task
+        #: executor_id -> FIFO of queued attempts.  A QUEUE, not a slot:
+        #: concurrent submit() calls (the serving layer) each dispatch
+        #: their rank tasks per executor, and a second query's dispatch
+        #: must never clobber an undelivered first — executors drain
+        #: their queue in order, so independent queries interleave
+        #: across executors instead of serializing at the driver
+        self._tasks: Dict[str, List[dict]] = {}
         #: qid -> {rank: {"result", "eid", "attempt", "t"}} — FIRST
         #: result per rank wins (speculation: the loser's late push is
         #: dropped here)
@@ -148,7 +154,10 @@ class TpuClusterDriver:
                 elif op == "get_task":
                     eid = header["executor_id"]
                     with driver._lock:
-                        task = driver._tasks.pop(eid, None)
+                        q = driver._tasks.get(eid)
+                        task = q.pop(0) if q else None
+                        if q is not None and not q:
+                            del driver._tasks[eid]
                         if task is not None:
                             driver._note_pickup_locked(task, eid)
                     if task is None:
@@ -271,6 +280,12 @@ class TpuClusterDriver:
                deadline_s: Optional[float] = None) -> list:
         """Dispatch one logical plan to every registered executor; block
         for and combine their row results (rank order).
+
+        THREAD-SAFE: concurrent submit() calls (the serving layer's
+        QueryQueue) each get a fresh query id, their rank tasks QUEUE
+        per executor (never clobbering another query's undelivered
+        dispatch), and their polling loops run independently — so
+        independent queries interleave across executors.
 
         SCOPED recovery under a per-query ``RetryBudget`` (attempts =
         ``max_retries``, deadline = ``deadline_s`` or
@@ -400,8 +415,9 @@ class TpuClusterDriver:
         if attempt is None:
             attempt = self._attempt_seq.get(qid, 1)
             self._attempt_seq[qid] = attempt + 1
-        self._tasks[eid] = dict(proto, rank=rank, attempt=attempt,
-                                **{"as": proto["participants"][rank]})
+        self._tasks.setdefault(eid, []).append(
+            dict(proto, rank=rank, attempt=attempt,
+                 **{"as": proto["participants"][rank]}))
         self._attempts.setdefault(qid, {}).setdefault(rank, []).append(
             {"eid": eid, "attempt": attempt, "kind": kind,
              "t_dispatch": time.monotonic(), "t_pickup": None,
@@ -606,10 +622,15 @@ class TpuClusterDriver:
                 self._attempt_seq.pop(qid, None)
                 for k in [k for k in self._stats if k[0] == qid]:
                     self._stats.pop(k, None)
-                # drop any queued attempt nobody picked up
-                for eid in [eid for eid, t in self._tasks.items()
-                            if t["query_id"] == qid]:
-                    self._tasks.pop(eid, None)
+                # drop any queued attempt of THIS query nobody picked up
+                # (other queries' queued tasks stay)
+                for eid in list(self._tasks):
+                    q = [t for t in self._tasks[eid]
+                         if t["query_id"] != qid]
+                    if q:
+                        self._tasks[eid] = q
+                    else:
+                        del self._tasks[eid]
         if fatal is not None:
             raise RuntimeError(f"query {qid}: executor(s) failed: {fatal}")
         if retry_exc is not None:

@@ -220,8 +220,22 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
                      conf.shuffle_fetch_threads,
                      conf.shuffle_fetch_merge_bytes,
                      conf.shuffle_fetch_request_bytes)
-    logical = pickle.loads(plan_bytes)
-    physical, _meta = plan_query(logical, conf)
+    # serving tenancy: the QueryQueue rides the submitting tenant on the
+    # per-query conf overrides; the whole task then executes under that
+    # tenant's scope so its device residency charges the right budget
+    # and spills attribute to the right tenant (memory/tenant.py)
+    from spark_rapids_tpu.memory.tenant import TENANT_CONF_KEY, TENANTS
+    tenant = conf.raw(TENANT_CONF_KEY)
+    TENANTS.configure(conf.serving_tenant_default_budget,
+                      conf.serving_tenant_default_weight,
+                      conf.serving_tenants_spec)
+    # every ALLOCATING phase of the task runs under the tenant scope —
+    # planning, the map-side exchange materialization, and the output
+    # loop — as three bounded withs (never a bare __enter__ that an
+    # exception between phases could leak onto the reused worker thread)
+    with TENANTS.scope(tenant):
+        logical = pickle.loads(plan_bytes)
+        physical, _meta = plan_query(logical, conf)
     stats_client = None
     if world > 1 and driver_rpc is not None:
         from spark_rapids_tpu.cluster.stats import (
@@ -293,20 +307,25 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
                 # in the same order on every rank, keeping the
                 # deterministic shuffle-id sequence aligned
                 _map_sides(n._decide())
-        _map_sides(physical)
+        # the map side is the task's HEAVIEST device residency
+        # (CACHE_ONLY keeps partition slices as spillable handles) —
+        # it must charge the tenant like everything else
+        with TENANTS.scope(tenant):
+            _map_sides(physical)
     # results are PARTITION-TAGGED so the driver can reassemble
     # partition-major — the concatenation across ranks of a range sort's
     # partitions in partition order IS the global order
     parts: list = []
     try:
-        n_out = physical.num_partitions()
-        for p in range(n_out):
-            if p % world != rank:
-                continue
-            rows_p: list = []
-            for batch in physical.execute_partition(p):
-                rows_p.extend(CpuTable.from_batch(batch).rows())
-            parts.append((p, rows_p))
+        with TENANTS.scope(tenant):
+            n_out = physical.num_partitions()
+            for p in range(n_out):
+                if p % world != rank:
+                    continue
+                rows_p: list = []
+                for batch in physical.execute_partition(p):
+                    rows_p.extend(CpuTable.from_batch(batch).rows())
+                parts.append((p, rows_p))
     except Exception:
         physical.cleanup()
         raise

@@ -41,9 +41,11 @@ def local_shuffle_counters() -> dict:
     blocks/bytes per round-trip, prefetch stall time, merge/concat
     count, plus the integrity and recovery counters (checksums
     computed/verified/failed, refetches, peer exclusions, heartbeat
-    failure streak, scoped resubmits — docs/fault_tolerance.md).
-    Surfaced here so cluster diagnostics and the bench artifact read one
-    snapshot shape."""
+    failure streak, scoped resubmits — docs/fault_tolerance.md), and the
+    serving-layer family (queries admitted/queued/rejected, cache
+    hits/misses/evictions/invalidations, tenant spills, budget denials
+    — docs/ARCHITECTURE.md §11).  Surfaced here so cluster diagnostics
+    and the bench artifact read one snapshot shape."""
     from spark_rapids_tpu.shuffle.stats import shuffle_counters
     return shuffle_counters()
 

@@ -541,6 +541,78 @@ LORE_DUMP_PATH = conf("spark.rapids.sql.lore.dumpPath").doc(
     "Directory receiving LORE batch dumps (one subdir per exec id)."
 ).string_conf("/tmp/spark_rapids_tpu_lore")
 
+SERVING_MAX_CONCURRENT = conf("spark.rapids.serving.maxConcurrentQueries").doc(
+    "Queries allowed past admission control at once (the serving-layer "
+    "slot bound; serving/admission.py QueryQueue). Waiters queue in "
+    "priority-then-FIFO order behind a WeightedPrioritySemaphore — the "
+    "same wake discipline as the device semaphore."
+).int_conf(4)
+
+SERVING_QUEUE_MAX_DEPTH = conf("spark.rapids.serving.queue.maxDepth").doc(
+    "Queries allowed to WAIT for admission; one more is rejected "
+    "immediately with AdmissionRejected(queue_full) — bounded "
+    "backpressure instead of unbounded buffering under overload."
+).int_conf(32)
+
+SERVING_QUEUE_TIMEOUT = conf("spark.rapids.serving.queue.timeout").doc(
+    "Seconds one query may wait for admission before it is rejected "
+    "with AdmissionRejected(timeout)."
+).double_conf(30.0)
+
+SERVING_ADMISSION_MEMORY_FRACTION = conf(
+    "spark.rapids.serving.admission.memoryFraction").doc(
+    "Memory-aware admission: fraction of the device arena's byte budget "
+    "admitted queries may collectively claim (each query reserves its "
+    "estimated bytes, spark.rapids.serving.admission.queryBytes by "
+    "default). With an unbudgeted arena, admission is slot-only."
+).double_conf(0.6)
+
+SERVING_ADMISSION_QUERY_BYTES = conf(
+    "spark.rapids.serving.admission.queryBytes").doc(
+    "Default per-query device-byte estimate the admission controller "
+    "reserves when submit() does not declare one; estimates above the "
+    "admission budget clamp to it (the query runs alone)."
+).bytes_conf(64 << 20)
+
+SERVING_CACHE_ENABLED = conf("spark.rapids.serving.cache.enabled").doc(
+    "Serve repeated identical plans from the fingerprint-keyed result "
+    "cache (serving/cache.py): a hit returns without admission or task "
+    "dispatch; file sources fold (mtime, size) into the key so changed "
+    "data misses, and invalidate_source() drops entries explicitly."
+).boolean_conf(True)
+
+SERVING_CACHE_MAX_BYTES = conf("spark.rapids.serving.cache.maxBytes").doc(
+    "LRU size bound of the serving result cache (pickled payload "
+    "bytes)."
+).bytes_conf(256 << 20)
+
+SERVING_CACHE_TTL = conf("spark.rapids.serving.cache.ttl").doc(
+    "Seconds a cached result stays servable; 0 disables expiry (source "
+    "invalidation still applies)."
+).double_conf(0.0)
+
+SERVING_TENANT_DEFAULT_BUDGET = conf(
+    "spark.rapids.serving.tenant.defaultBudgetBytes").doc(
+    "Device-byte budget for tenants not named in "
+    "spark.rapids.serving.tenants; 0 = unlimited. Exceeding a tenant "
+    "budget spills that tenant's own handles then raises a retryable "
+    "TenantBudgetExceeded into its own task — never a neighbor's "
+    "(memory/tenant.py)."
+).bytes_conf(0)
+
+SERVING_TENANT_DEFAULT_WEIGHT = conf(
+    "spark.rapids.serving.tenant.defaultWeight").doc(
+    "Spill weight for tenants not named in spark.rapids.serving.tenants "
+    "(and for untagged allocations): under GLOBAL arena pressure, "
+    "lighter tenants' handles spill before heavier ones."
+).double_conf(1.0)
+
+SERVING_TENANTS = conf("spark.rapids.serving.tenants").doc(
+    "Per-tenant budget/weight spec: "
+    "'name:weight=2:budget=64m,name2:weight=1'. Unnamed tenants use the "
+    "defaultBudgetBytes/defaultWeight knobs."
+).string_conf("")
+
 TEST_RETRY_CONTEXT_CHECK = conf("spark.rapids.sql.test.retryContextCheck.enabled").doc(
     "Assert that every device allocation site is covered by a retry block "
     "(reference: AllocationRetryCoverageTracker.scala)."
@@ -807,6 +879,50 @@ class RapidsConf:
     @property
     def cpu_bridge_enabled(self) -> bool:
         return self.get(CPU_BRIDGE_ENABLED)
+
+    @property
+    def serving_max_concurrent(self) -> int:
+        return self.get(SERVING_MAX_CONCURRENT)
+
+    @property
+    def serving_queue_max_depth(self) -> int:
+        return self.get(SERVING_QUEUE_MAX_DEPTH)
+
+    @property
+    def serving_queue_timeout(self) -> float:
+        return self.get(SERVING_QUEUE_TIMEOUT)
+
+    @property
+    def serving_admission_memory_fraction(self) -> float:
+        return self.get(SERVING_ADMISSION_MEMORY_FRACTION)
+
+    @property
+    def serving_admission_query_bytes(self) -> int:
+        return self.get(SERVING_ADMISSION_QUERY_BYTES)
+
+    @property
+    def serving_cache_enabled(self) -> bool:
+        return self.get(SERVING_CACHE_ENABLED)
+
+    @property
+    def serving_cache_max_bytes(self) -> int:
+        return self.get(SERVING_CACHE_MAX_BYTES)
+
+    @property
+    def serving_cache_ttl(self) -> float:
+        return self.get(SERVING_CACHE_TTL)
+
+    @property
+    def serving_tenant_default_budget(self) -> int:
+        return self.get(SERVING_TENANT_DEFAULT_BUDGET)
+
+    @property
+    def serving_tenant_default_weight(self) -> float:
+        return self.get(SERVING_TENANT_DEFAULT_WEIGHT)
+
+    @property
+    def serving_tenants_spec(self) -> str:
+        return self.get(SERVING_TENANTS) or ""
 
     def with_overrides(self, **kv) -> "RapidsConf":
         m = dict(self._map)

@@ -287,6 +287,85 @@ def finalize_agg_column(values: jax.Array, validity: jax.Array,
     return DeviceColumn(data, valid, dtype)
 
 
+# -- string ordering surrogate ------------------------------------------------
+#
+# Aggregations that ORDER by a string column (min/max over strings, the
+# max_by/min_by ordering key) reduce over a dense int32 rank instead of
+# the byte planes: one stable lexsort of the string chunk keys assigns
+# every row the ordinal of its distinct value (equal strings share a
+# rank), and segment extremes of the rank ARE extremes of the string.
+# The reference compares UTF8 bytes directly in libcudf; on TPU the rank
+# surrogate keeps the reduction a plain fixed-width segment_min/max.
+
+def string_order_rank(col: DeviceColumn, max_bytes: int) -> jax.Array:
+    """int32 [capacity] dense rank of each row's string value in
+    lexicographic byte order (Spark UTF8String.binaryCompare); equal
+    strings share a rank.  max_bytes must cover the longest live string
+    or ordering truncates (same contract as sort_indices).  Null rows
+    rank arbitrarily — callers gate on validity."""
+    from spark_rapids_tpu.kernels.sort import _string_data_keys
+    cap = col.capacity
+    chunks = _string_data_keys(col, SortOrder(True), max_bytes)
+    # jnp.lexsort: LAST key is primary -> feed least-significant first
+    order = jnp.lexsort(tuple(reversed(chunks)))
+    eq = jnp.ones((cap,), dtype=jnp.bool_)
+    for c in chunks:
+        sc = c[order]
+        eq = eq & (sc == jnp.roll(sc, 1))
+    boundary = (jnp.arange(cap, dtype=jnp.int32) == 0) | ~eq
+    ranks_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    return jnp.zeros((cap,), jnp.int32).at[order].set(ranks_sorted)
+
+
+def _string_rank_column(col: DeviceColumn, max_bytes: int) -> DeviceColumn:
+    """Fixed-width surrogate for a string ordering column: the rank with
+    the original validity, so the fixed-width pick/extreme kernels apply
+    unchanged."""
+    return DeviceColumn(string_order_rank(col, max_bytes), col.validity,
+                        T.INT)
+
+
+def seg_extreme_string(col: DeviceColumn, layout: GroupedLayout,
+                       is_min: bool, max_bytes: int) -> DeviceColumn:
+    """Per-group MIN/MAX over a string column as a gather: the extreme
+    RANK per segment selects the first row (input order) holding the
+    extreme value; all-null groups yield null."""
+    from spark_rapids_tpu.kernels.selection import OOB, gather_column
+    live = layout.sorted_batch.live_mask()
+    cap = col.capacity
+    rank = string_order_rank(col, max_bytes)
+    valid = col.validity & live
+    ident = jnp.int32(cap) if is_min else jnp.int32(-1)
+    contrib = jnp.where(valid, rank, ident)
+    reduce = jax.ops.segment_min if is_min else jax.ops.segment_max
+    m = reduce(contrib, layout.segment_ids, num_segments=cap)
+    has = (m < cap) if is_min else (m >= 0)
+    eligible = valid & (rank == m[layout.segment_ids])
+    arg, has2 = _seg_arg(eligible, layout, last=False)
+    idx = jnp.where(has & has2, arg, jnp.int32(OOB))
+    return gather_column(col, idx, layout.num_groups,
+                         out_capacity=cap)
+
+
+def global_extreme_string(col: DeviceColumn, live: jax.Array,
+                          is_min: bool, max_bytes: int) -> DeviceColumn:
+    """Whole-batch MIN/MAX over a string column -> one-row string column."""
+    from spark_rapids_tpu.kernels.selection import OOB, gather_column
+    cap = col.capacity
+    rank = string_order_rank(col, max_bytes)
+    valid = live & col.validity
+    ident = jnp.int32(cap) if is_min else jnp.int32(-1)
+    contrib = jnp.where(valid, rank, ident)
+    m = jnp.min(contrib) if is_min else jnp.max(contrib)
+    eligible = valid & (rank == m)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    arg = jnp.min(jnp.where(eligible, pos, jnp.int32(cap)))
+    has = (arg < cap) & jnp.any(valid)
+    idx = jnp.where(has, jnp.clip(arg, 0, cap - 1).astype(jnp.int32)[None],
+                    jnp.full((1,), OOB, jnp.int32))
+    return gather_column(col, idx, jnp.int32(1), out_capacity=1)
+
+
 # -- positional picks (first/last/max_by/min_by) -----------------------------
 #
 # group_rows' stable lexsort preserves input order within each segment, so
@@ -325,13 +404,18 @@ def seg_pick(col: DeviceColumn, layout: GroupedLayout, ignore_nulls: bool,
 
 
 def seg_pick_by(xcol: DeviceColumn, ycol: DeviceColumn,
-                layout: GroupedLayout, is_min: bool) -> DeviceColumn:
+                layout: GroupedLayout, is_min: bool,
+                string_max_bytes: int = 0) -> DeviceColumn:
     """max_by/min_by value: x at the extreme of y; ties take the FIRST row
     in input order (Spark's update keeps the incumbent on equal keys).
     Null y rows never win; all-null-y groups yield null.  y is normalized
-    (-0.0 == 0.0; NaN greatest in Spark's total order) like sort keys."""
+    (-0.0 == 0.0; NaN greatest in Spark's total order) like sort keys.
+    String ordering keys reduce over their rank surrogate
+    (string_order_rank; string_max_bytes must cover the longest live y)."""
     from spark_rapids_tpu.kernels.selection import OOB, gather_column
     live = layout.sorted_batch.live_mask()
+    if ycol.is_string_like:
+        ycol = _string_rank_column(ycol, string_max_bytes)
     ycol = normalize_key_column(ycol)
     m, has = (seg_min if is_min else seg_max)(ycol, layout)
     yv = ycol.data
@@ -397,9 +481,11 @@ def global_pick(col: DeviceColumn, live: jax.Array, ignore_nulls: bool,
 
 
 def global_pick_by(xcol: DeviceColumn, ycol: DeviceColumn, live: jax.Array,
-                   is_min: bool) -> DeviceColumn:
+                   is_min: bool, string_max_bytes: int = 0) -> DeviceColumn:
     from spark_rapids_tpu.kernels.selection import OOB, gather_column
     cap = xcol.capacity
+    if ycol.is_string_like:
+        ycol = _string_rank_column(ycol, string_max_bytes)
     ycol = normalize_key_column(ycol)
     valid = live & ycol.validity
     yv = ycol.data

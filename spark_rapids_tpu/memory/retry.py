@@ -59,6 +59,19 @@ def disable_oom_injection() -> None:
     device_arena().clear_injection()
 
 
+def _spill_for_retry(e: Optional[BaseException]) -> None:
+    """Recovery spill between retry attempts.  A tenant-budget OOM spills
+    ONLY that tenant's handles (memory/tenant.py: a budget breach must
+    never evict a neighbor tenant's residency); everything else keeps the
+    spill-all behavior."""
+    from spark_rapids_tpu.memory.spill import spill_framework
+    from spark_rapids_tpu.memory.tenant import TenantBudgetExceeded
+    if isinstance(e, TenantBudgetExceeded):
+        spill_framework().spill_tenant(e.tenant, 1 << 62)
+    else:
+        spill_framework().spill_device(1 << 62)  # spill all spillable
+
+
 def with_retry_no_split(fn: Callable[[], T]) -> T:
     """Run fn; on TpuRetryOOM spill and re-run (no split path).
     Reference: withRetryNoSplit (RmmRapidsRetryIterator.scala:66)."""
@@ -74,7 +87,7 @@ def with_retry_no_split(fn: Callable[[], T]) -> T:
             except TpuRetryOOM as e:
                 last = e
                 task_metrics.get().retry_count += 1
-                spill_framework().spill_device(1 << 62)  # spill all spillable
+                _spill_for_retry(e)
             except TpuSplitAndRetryOOM as e:
                 raise TpuSplitAndRetryOOM(
                     "split-and-retry OOM in a no-split context") from e
@@ -116,12 +129,12 @@ def with_retry(
                     device_arena().maybe_throw_injected()
                     out.append(fn(item))
                     break
-                except TpuRetryOOM:
+                except TpuRetryOOM as e:
                     attempts += 1
                     task_metrics.get().retry_count += 1
                     if attempts >= MAX_RETRIES:
                         raise
-                    spill_framework().spill_device(1 << 62)
+                    _spill_for_retry(e)
                 except TpuSplitAndRetryOOM:
                     task_metrics.get().split_retry_count += 1
                     if split_policy is None:

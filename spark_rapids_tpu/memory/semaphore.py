@@ -16,37 +16,97 @@ import itertools
 import threading
 import time
 from contextlib import contextmanager
+from typing import Optional
 
 from spark_rapids_tpu.memory import metrics as task_metrics
 
 
 class PrioritySemaphore:
+    #: charge waits to the task metric semaphore_wait_ns — DEVICE
+    #: semaphores only; admission semaphores (WeightedPrioritySemaphore)
+    #: must not pollute a metric that means chip contention
+    _record_wait_metric = True
+
     def __init__(self, permits: int):
         self._permits = permits
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._waiters = []  # heap of (priority, seq)
+        self._dead = set()  # timed-out tickets, lazily popped
         self._seq = itertools.count()
 
-    def acquire(self, priority: int = 0) -> None:
+    def _drop_dead_locked(self) -> None:
+        while self._waiters and tuple(self._waiters[0]) in self._dead:
+            self._dead.discard(tuple(heapq.heappop(self._waiters)))
+
+    def acquire(self, priority: int = 0, cost: int = 1,
+                deadline: Optional[float] = None) -> bool:
+        """Block until this ticket is at the head of the priority-then-
+        FIFO queue AND ``cost`` permits are free, then take them.  With a
+        ``deadline`` (time.monotonic() instant) returns False instead of
+        blocking past it (the ticket is withdrawn).  cost > 1 is the
+        weighted form the serving admission controller builds on — a
+        head-of-line ticket holds its place until its full cost fits
+        (no starvation of big requests by a stream of small ones)."""
         start = time.monotonic_ns()
+        acquired = True
         with self._cv:
             ticket = (priority, next(self._seq))
             heapq.heappush(self._waiters, ticket)
-            while not (self._permits > 0 and self._waiters[0] == ticket):
-                self._cv.wait()
-            heapq.heappop(self._waiters)
-            self._permits -= 1
-            if self._permits > 0 and self._waiters:
-                # wake the next head: it may have re-slept while we were
-                # still queued even though a permit is free
-                self._cv.notify_all()
-        task_metrics.get().semaphore_wait_ns += time.monotonic_ns() - start
+            while True:
+                self._drop_dead_locked()
+                if self._waiters and self._waiters[0] == ticket \
+                        and self._permits >= cost:
+                    heapq.heappop(self._waiters)
+                    self._permits -= cost
+                    if self._permits > 0 and self._waiters:
+                        # wake the next head: it may have re-slept while
+                        # we were still queued even though a permit is
+                        # free
+                        self._cv.notify_all()
+                    break
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self._dead.add(ticket)
+                        self._drop_dead_locked()
+                        # a withdrawn head unblocks whoever is next
+                        self._cv.notify_all()
+                        acquired = False
+                        break
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait()
+        if self._record_wait_metric:
+            task_metrics.get().semaphore_wait_ns += \
+                time.monotonic_ns() - start
+        return acquired
 
-    def release(self) -> None:
+    def release(self, cost: int = 1) -> None:
         with self._cv:
-            self._permits += 1
+            self._permits += cost
             self._cv.notify_all()
+
+    def available(self) -> int:
+        with self._cv:
+            return self._permits
+
+    def waiting(self) -> int:
+        with self._cv:
+            return len(self._waiters) - len(self._dead)
+
+
+class WeightedPrioritySemaphore(PrioritySemaphore):
+    """Byte-weighted admission form of the device semaphore: permits are
+    a RESOURCE QUANTITY (admission bytes, queue slots), each acquire
+    names its cost, and waiters drain in priority-then-FIFO order with a
+    deadline.  The serving layer's admission controller
+    (serving/admission.py) gates concurrent queries through two of
+    these — the same wake discipline the device semaphore pins, grown to
+    weighted costs.  Waits here are ADMISSION time, not chip contention:
+    they stay out of the semaphore_wait_ns task metric."""
+
+    _record_wait_metric = False
 
 
 class TpuSemaphore:
@@ -80,6 +140,27 @@ class TpuSemaphore:
             yield
         finally:
             self.release_if_necessary()
+
+
+#: thread-ambient device priority: the serving layer sets it around a
+#: query's execution; the engine captures it at execute() entry and
+#: acquires the semaphore for every partition task at that priority
+#: (lower value = earlier wake, the PrioritySemaphore convention)
+_PRIORITY = threading.local()
+
+
+def current_task_priority() -> int:
+    return getattr(_PRIORITY, "value", 0)
+
+
+@contextmanager
+def task_priority(priority: int):
+    prev = getattr(_PRIORITY, "value", 0)
+    _PRIORITY.value = int(priority)
+    try:
+        yield
+    finally:
+        _PRIORITY.value = prev
 
 
 _SEMAPHORE_SIZE = 2

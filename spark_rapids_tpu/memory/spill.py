@@ -40,6 +40,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.memory.arena import device_arena
+from spark_rapids_tpu.memory.tenant import TENANTS
 from spark_rapids_tpu.memory import metrics as task_metrics
 from spark_rapids_tpu.testing.chaos import CHAOS
 from spark_rapids_tpu.utils.checksum import file_checksum, verify_frame
@@ -138,12 +139,31 @@ class SpillableBatchHandle:
         self.size_bytes = batch.device_size_bytes()
         self.closed = False
         self._pins = 0
+        #: tenant ambient at creation (memory/tenant.py): budget charge,
+        #: spill-order weight and tenant_spills attribution; None outside
+        #: any serving scope (pre-tenant behavior exactly)
+        self.tenant = TENANTS.current()
         self.creation_site: Optional[str] = None
         if _leak_audit_enabled():
             import traceback
             self.creation_site = "".join(traceback.format_stack(limit=14))
-        device_arena().reserve(self.size_bytes)
+        self._reserve_device()
         framework._register(self)
+
+    def _reserve_device(self) -> None:
+        """Arena reserve + tenant charge as one unit (the charge may
+        self-spill this tenant and raise TenantBudgetExceeded; an arena
+        failure must roll the charge back)."""
+        TENANTS.charge(self.tenant, self.size_bytes)
+        try:
+            device_arena().reserve(self.size_bytes)
+        except BaseException:
+            TENANTS.credit(self.tenant, self.size_bytes)
+            raise
+
+    def _release_device(self) -> None:
+        device_arena().release(self.size_bytes)
+        TENANTS.credit(self.tenant, self.size_bytes)
 
     # -- tier movement -------------------------------------------------------
 
@@ -160,8 +180,9 @@ class SpillableBatchHandle:
                 return 0
             self._host = _batch_to_host(self._device)
             self._device = None
-            device_arena().release(self.size_bytes)
+            self._release_device()
             self._fw.metrics.spill_to_host_bytes += self.size_bytes
+            TENANTS.note_spill(self.tenant)
             return self.size_bytes
 
     def spill_to_disk(self) -> int:
@@ -225,13 +246,13 @@ class SpillableBatchHandle:
             if self._device is not None:
                 self._pins += 1
                 return self._device
-        device_arena().reserve(self.size_bytes)  # may spill / raise TpuOOM
+        self._reserve_device()  # may spill / raise TpuOOM
         with self._lock:
             if self.closed:
-                device_arena().release(self.size_bytes)
+                self._release_device()
                 raise AssertionError("handle closed during materialize")
             if self._device is not None:  # concurrent materialize won
-                device_arena().release(self.size_bytes)
+                self._release_device()
                 self._pins += 1
                 return self._device
             if self._host is None and self._disk_path is not None:
@@ -241,7 +262,7 @@ class SpillableBatchHandle:
                 if not verify_frame(data, self._disk_crc):
                     self._fw.metrics.corruption_errors += 1
                     task_metrics.get().spill_corruption_errors += 1
-                    device_arena().release(self.size_bytes)
+                    self._release_device()
                     raise SpillCorruptionError(
                         f"spill file {self._disk_path} failed its "
                         f"checksum ({len(data)} bytes, expected crc "
@@ -288,7 +309,7 @@ class SpillableBatchHandle:
             self.closed = True
         self._fw._unregister(self)
         # accounting ownership passes to the caller's scope; release here
-        device_arena().release(self.size_bytes)
+        self._release_device()
         return batch
 
     def on_device(self) -> bool:
@@ -307,7 +328,7 @@ class SpillableBatchHandle:
                 return
             self.closed = True
             if self._device is not None:
-                device_arena().release(self.size_bytes)
+                self._release_device()
                 self._device = None
             self._host = None
             if self._disk_path is not None:
@@ -360,14 +381,11 @@ class SpillFramework:
         with self._lock:
             return list(self._handles)
 
-    def spill_device(self, need_bytes: int) -> int:
-        """Spill device-resident handles (oldest-use first) until
-        need_bytes freed or nothing left.  Reference:
-        SpillableDeviceStore.spill (SpillFramework.scala:1742)."""
+    def _spill_until(self, candidates: List[SpillableBatchHandle],
+                     need_bytes: int) -> int:
+        """Spill pre-sorted candidates until need_bytes freed or nothing
+        left; cascade to the host limit afterwards."""
         freed = 0
-        candidates = sorted(
-            [h for h in self._snapshot() if h.on_device()],
-            key=lambda h: (h.priority, h.last_use))
         for h in candidates:
             if freed >= need_bytes:
                 break
@@ -375,6 +393,29 @@ class SpillFramework:
         if self.host_limit_bytes:
             self._enforce_host_limit()
         return freed
+
+    def spill_device(self, need_bytes: int) -> int:
+        """Spill device-resident handles until need_bytes freed or
+        nothing left, ordered tenant-weight-first (lighter tenants spill
+        before heavier ones; untagged handles carry the default weight,
+        so non-serving runs keep the pre-tenant order exactly), then the
+        existing (priority, oldest-use) order.  Reference:
+        SpillableDeviceStore.spill (SpillFramework.scala:1742) with the
+        TaskPriority dimension promoted to tenants."""
+        weights, default_w = TENANTS.weights_snapshot()
+        return self._spill_until(sorted(
+            [h for h in self._snapshot() if h.on_device()],
+            key=lambda h: (weights.get(h.tenant, default_w), h.priority,
+                           h.last_use)), need_bytes)
+
+    def spill_tenant(self, tenant: str, need_bytes: int) -> int:
+        """Spill ONLY ``tenant``'s device-resident handles (its budget
+        breach must never evict a neighbor) in (priority, oldest-use)
+        order until need_bytes freed or the tenant has nothing left."""
+        return self._spill_until(sorted(
+            [h for h in self._snapshot()
+             if h.tenant == tenant and h.on_device()],
+            key=lambda h: (h.priority, h.last_use)), need_bytes)
 
     def _enforce_host_limit(self) -> None:
         sized = [(h, h.host_nbytes()) for h in self._snapshot()]

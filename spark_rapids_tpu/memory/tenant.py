@@ -1,0 +1,243 @@
+"""Per-tenant device-memory budgets and spill weights.
+
+The serving layer (serving/admission.py) multiplexes many tenants'
+queries over one device.  This module is the MEMORY side of that
+isolation, extending the arena/spill layer the way the reference's
+RmmSpark per-task tracking extends RMM:
+
+  * every ``SpillableBatchHandle`` created while a tenant scope is
+    active is TAGGED with the tenant (memory/spill.py), and its device
+    bytes are charged against the tenant's budget;
+  * a tenant exceeding its OWN budget first spills its OWN handles,
+    then takes a ``TenantBudgetExceeded`` (a retryable ``TpuRetryOOM``)
+    into ITS OWN task — the retry loop (memory/retry.py) spills only
+    that tenant's handles and re-runs.  A neighbor tenant's device
+    residency is never evicted by someone else's budget breach;
+  * under GLOBAL arena pressure the spill order is tenant-weight-first
+    (lighter tenants spill before heavier ones), then the existing
+    (priority, last-use) order — the TaskPriority-ordered spill of the
+    reference, promoted to a tenant dimension.
+
+Tenant scopes are thread-ambient (the serving layer runs each admitted
+query's execution on one thread); allocations outside any scope stay
+untagged with the default weight, so non-serving workloads see exactly
+the pre-tenant behavior.  Counters: ``tenant_spills`` and
+``budget_denials`` (shuffle/stats.py) attribute pressure to the tenant
+that caused it, plus per-tenant used/peak/spill/denial numbers here.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from spark_rapids_tpu.memory.arena import TpuRetryOOM
+
+#: spill-order weight for untagged handles and unregistered tenants
+DEFAULT_WEIGHT = 1.0
+
+#: per-query conf key carrying the submitting tenant to cluster
+#: executors (set by serving/admission.py ClusterDriverRunner, read by
+#: cluster/executor.run_task — lives HERE so the executor never imports
+#: the serving tier just for a string)
+TENANT_CONF_KEY = "spark.rapids.serving.query.tenant"
+
+
+class TenantBudgetExceeded(TpuRetryOOM):
+    """A tenant's device-byte budget is exhausted even after spilling its
+    own handles.  Retryable: the retry loop spills THIS tenant's handles
+    and re-runs the task — the breach never evicts a neighbor."""
+
+    def __init__(self, message: str, tenant: str):
+        super().__init__(message)
+        self.tenant = tenant
+
+
+class TenantState:
+    """One tenant's budget/weight and live accounting (registry-locked)."""
+
+    def __init__(self, name: str, weight: float = DEFAULT_WEIGHT,
+                 budget_bytes: int = 0):
+        self.name = name
+        self.weight = float(weight)
+        self.budget_bytes = int(budget_bytes)   # 0 = unlimited
+        self.used_bytes = 0
+        self.peak_bytes = 0
+        self.spills = 0
+        self.budget_denials = 0
+
+    def snapshot(self) -> dict:
+        return {"weight": self.weight, "budget_bytes": self.budget_bytes,
+                "used_bytes": self.used_bytes, "peak_bytes": self.peak_bytes,
+                "spills": self.spills, "budget_denials": self.budget_denials}
+
+
+class TenantRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, TenantState] = {}
+        self._tls = threading.local()
+        self.default_weight = DEFAULT_WEIGHT
+        self.default_budget_bytes = 0
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, default_budget_bytes: int = 0,
+                  default_weight: float = DEFAULT_WEIGHT,
+                  spec: str = "") -> None:
+        """Apply the serving conf: defaults plus a per-tenant spec string
+        ``name:weight=2:budget=64m,name2:weight=1`` (see
+        spark.rapids.serving.tenants).  Existing tenants keep their live
+        accounting; budgets/weights update in place."""
+        from spark_rapids_tpu.config import _to_bytes
+        with self._lock:
+            self.default_budget_bytes = int(default_budget_bytes)
+            self.default_weight = float(default_weight)
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            name = fields[0].strip()
+            weight, budget = None, None
+            for f in fields[1:]:
+                k, _, v = f.partition("=")
+                try:
+                    if k.strip() == "weight":
+                        weight = float(v)
+                    elif k.strip() == "budget":
+                        budget = _to_bytes(v)
+                except ValueError as e:
+                    # a malformed spec must name the KEY, not surface as
+                    # a bare float() error from every executor task
+                    raise ValueError(
+                        "spark.rapids.serving.tenants: bad segment "
+                        f"{part!r} ({f!r}): {e}") from e
+            st = self.get(name)
+            with self._lock:
+                if weight is not None:
+                    st.weight = weight
+                if budget is not None:
+                    st.budget_bytes = budget
+
+    def get(self, name: str) -> TenantState:
+        with self._lock:
+            st = self._tenants.get(name)
+            if st is None:
+                st = TenantState(name, self.default_weight,
+                                 self.default_budget_bytes)
+                self._tenants[name] = st
+            return st
+
+    def set_budget(self, name: str, budget_bytes: int,
+                   weight: Optional[float] = None) -> TenantState:
+        st = self.get(name)
+        with self._lock:
+            st.budget_bytes = int(budget_bytes)
+            if weight is not None:
+                st.weight = float(weight)
+        return st
+
+    # -- ambient scope -------------------------------------------------------
+
+    @contextmanager
+    def scope(self, name: Optional[str]):
+        """Tag allocations on this thread with ``name`` for the block
+        (None = explicitly untagged, e.g. maintenance work inside a
+        serving worker)."""
+        prev = getattr(self._tls, "current", None)
+        self._tls.current = name
+        try:
+            yield self.get(name) if name is not None else None
+        finally:
+            self._tls.current = prev
+
+    def current(self) -> Optional[str]:
+        return getattr(self._tls, "current", None)
+
+    def weight_of(self, name: Optional[str]) -> float:
+        if name is None:
+            return self.default_weight
+        with self._lock:
+            st = self._tenants.get(name)
+            return st.weight if st is not None else self.default_weight
+
+    def weights_snapshot(self):
+        """({tenant: weight}, default) in ONE lock round-trip — the
+        global-pressure spill sorts thousands of handles and must not
+        take the registry lock once per handle."""
+        with self._lock:
+            return ({n: st.weight for n, st in self._tenants.items()},
+                    self.default_weight)
+
+    # -- device-byte accounting (called from memory/spill.py) ----------------
+
+    def charge(self, name: Optional[str], nbytes: int) -> None:
+        """Account ``nbytes`` of device residency to ``name``.  Over
+        budget: spill the tenant's OWN handles, recheck, then raise
+        ``TenantBudgetExceeded`` (counted as a budget denial) — the
+        self-spill/self-retry contract."""
+        if name is None:
+            return
+        st = self.get(name)
+        with self._lock:
+            if not st.budget_bytes or \
+                    st.used_bytes + nbytes <= st.budget_bytes:
+                st.used_bytes += nbytes
+                st.peak_bytes = max(st.peak_bytes, st.used_bytes)
+                return
+            need = st.used_bytes + nbytes - st.budget_bytes
+        # spill outside the registry lock: handle locks must never nest
+        # inside it (same discipline as the arena's pressure callback)
+        from spark_rapids_tpu.memory.spill import spill_framework
+        spill_framework().spill_tenant(name, need)
+        with self._lock:
+            if st.used_bytes + nbytes <= st.budget_bytes:
+                st.used_bytes += nbytes
+                st.peak_bytes = max(st.peak_bytes, st.used_bytes)
+                return
+            st.budget_denials += 1
+            used = st.used_bytes
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+        SHUFFLE_COUNTERS.add(budget_denials=1)
+        raise TenantBudgetExceeded(
+            f"tenant {name!r} over its device budget: need {nbytes}b, "
+            f"using {used}b of {st.budget_bytes}b after spilling its own "
+            "handles", tenant=name)
+
+    def credit(self, name: Optional[str], nbytes: int) -> None:
+        if name is None:
+            return
+        st = self.get(name)
+        with self._lock:
+            st.used_bytes = max(st.used_bytes - nbytes, 0)
+
+    def note_spill(self, name: Optional[str]) -> None:
+        if name is None:
+            return
+        st = self.get(name)
+        with self._lock:
+            st.spills += 1
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+        SHUFFLE_COUNTERS.add(tenant_spills=1)
+
+    # -- observation ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {name: st.snapshot()
+                    for name, st in sorted(self._tenants.items())}
+
+    def reset(self) -> None:
+        """Drop all tenants and live accounting (tests)."""
+        with self._lock:
+            self._tenants.clear()
+            self.default_weight = DEFAULT_WEIGHT
+            self.default_budget_bytes = 0
+
+
+TENANTS = TenantRegistry()
+
+
+def tenant_registry() -> TenantRegistry:
+    return TENANTS

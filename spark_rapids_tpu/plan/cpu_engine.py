@@ -450,7 +450,7 @@ class CpuEngine:
                 holistic = (slot.update_op in (COLLECT, TD_MEANS,
                                                TD_WEIGHTS)
                             or (slot.update_op in PICK_OPS
-                                + (MAXBY_VAL, MINBY_VAL)
+                                + (MAXBY_VAL, MINBY_VAL, MIN, MAX)
                                 and slot.dtype.variable_width))
                 bv = np.zeros((n_groups,),
                               object if two_limb or holistic

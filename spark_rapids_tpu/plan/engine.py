@@ -23,13 +23,20 @@ class TpuEngine:
     def execute(self, plan: TpuExec) -> List[List[ColumnarBatch]]:
         """Materialize all partitions (list of batches per partition)."""
         nparts = plan.num_partitions()
+        # partition tasks are PART of the submitting query: pool threads
+        # must inherit its tenant ambient or their allocations would
+        # escape the tenant's budget/spill accounting (memory/tenant.py)
+        from spark_rapids_tpu.memory.semaphore import current_task_priority
+        from spark_rapids_tpu.memory.tenant import TENANTS
+        tenant = TENANTS.current()
+        priority = current_task_priority()
 
         def run_one(p: int) -> List[ColumnarBatch]:
             from spark_rapids_tpu.memory.task_completion import task_scope
             sem = tpu_semaphore()
-            sem.acquire_if_necessary()
+            sem.acquire_if_necessary(priority)
             try:
-                with task_scope():
+                with TENANTS.scope(tenant), task_scope():
                     return list(plan.execute_partition(p))
             finally:
                 sem.release_if_necessary()

@@ -343,6 +343,32 @@ class _AggDeviceSpec:
         self._slot_pos = slot_pos
         self.partial_schema = partial_schema
         self.schema = out_schema
+        # string columns that get ORDER-compared (min/max over strings,
+        # max_by/min_by string ordering keys): the max-bytes bucket must
+        # cover them too, not just the group keys — a truncated rank
+        # would silently mis-order long strings
+        self.string_order_exprs = tuple(self._string_order_exprs())
+
+    def _string_order_exprs(self):
+        from spark_rapids_tpu.expressions import aggregates as A
+        out = []
+        for agg in self.aggregates:
+            try:
+                if isinstance(agg, (A.Min, A.Max)) and \
+                        agg.children[0].dtype.variable_width:
+                    out.append(agg.children[0])
+                elif isinstance(agg, (A.MaxBy, A.MinBy)) and \
+                        agg.children[1].dtype.variable_width:
+                    out.append(agg.children[1])
+            except (TypeError, ValueError, NotImplementedError):
+                pass
+        return out
+
+    def _string_order_slots(self):
+        """Slot indices whose PARTIAL buffer column is a string that gets
+        order-compared at merge time (the min/max string buffers)."""
+        return [si for si, (_, slot) in enumerate(self.slot_specs)
+                if slot.merge_op in (MIN, MAX) and slot.dtype.variable_width]
 
     def _m2_companions(self, ai: int):
         """Slot indices of the M2 buffer's sum and count companions,
@@ -394,8 +420,12 @@ class _AggDeviceSpec:
 
     def _merge_bucket(self, partial: ColumnarBatch) -> int:
         from spark_rapids_tpu.kernels import strings as SK
+        nkeys = len(self.group_exprs)
         pairs = [(partial.columns[i], partial.num_rows)
-                 for i in range(len(self.group_exprs))]
+                 for i in range(nkeys)]
+        # min/max STRING buffer columns are order-compared again at merge
+        pairs += [(partial.columns[nkeys + si], partial.num_rows)
+                  for si in self._string_order_slots()]
         if not any(c.is_string_like for c, _ in pairs):
             return 0
         return SK.bucket_for(SK.max_live_bytes_multi(pairs))
@@ -450,7 +480,12 @@ class _AggDeviceSpec:
                 if slot.update_op in (MAXBY_VAL, MINBY_VAL):
                     ycol = agg_in[(id(agg), 1)]
                     cols.append(G.global_pick_by(
-                        col, ycol, live, slot.update_op == MINBY_VAL))
+                        col, ycol, live, slot.update_op == MINBY_VAL,
+                        string_max_bytes=string_bucket))
+                    continue
+                if slot.update_op in (MIN, MAX) and col.is_string_like:
+                    cols.append(G.global_extreme_string(
+                        col, live, slot.update_op == MIN, string_bucket))
                     continue
                 if slot.update_op in BIT_OPS:
                     v, valid = G.global_bitwise(col, live, slot.update_op,
@@ -518,7 +553,12 @@ class _AggDeviceSpec:
                 ycol = layout.sorted_batch.columns[
                     col_of_agg[(id(agg), 1)]]
                 cols.append(G.seg_pick_by(col, ycol, layout,
-                                          slot.update_op == MINBY_VAL))
+                                          slot.update_op == MINBY_VAL,
+                                          string_max_bytes=string_bucket))
+                continue
+            if slot.update_op in (MIN, MAX) and col.is_string_like:
+                cols.append(G.seg_extreme_string(
+                    col, layout, slot.update_op == MIN, string_bucket))
                 continue
             if slot.update_op in BIT_OPS:
                 v, valid = G.seg_bitwise(col, layout, slot.update_op,
@@ -581,7 +621,12 @@ class _AggDeviceSpec:
                 if slot.merge_op in (MAXBY_VAL, MINBY_VAL):
                     ycol = partial.columns[nkeys + self._by_companion(ai)]
                     cols.append(G.global_pick_by(
-                        col, ycol, live, slot.merge_op == MINBY_VAL))
+                        col, ycol, live, slot.merge_op == MINBY_VAL,
+                        string_max_bytes=string_bucket))
+                    continue
+                if slot.merge_op in (MIN, MAX) and col.is_string_like:
+                    cols.append(G.global_extreme_string(
+                        col, live, slot.merge_op == MIN, string_bucket))
                     continue
                 if slot.merge_op in BIT_OPS:
                     v, valid = G.global_bitwise(col, live, slot.merge_op,
@@ -656,7 +701,12 @@ class _AggDeviceSpec:
                 ycol = layout.sorted_batch.columns[
                     nkeys + self._by_companion(ai)]
                 cols.append(G.seg_pick_by(col, ycol, layout,
-                                          slot.merge_op == MINBY_VAL))
+                                          slot.merge_op == MINBY_VAL,
+                                          string_max_bytes=string_bucket))
+                continue
+            if slot.merge_op in (MIN, MAX) and col.is_string_like:
+                cols.append(G.seg_extreme_string(
+                    col, layout, slot.merge_op == MIN, string_bucket))
                 continue
             if slot.merge_op in BIT_OPS:
                 v, valid = G.seg_bitwise(col, layout, slot.merge_op,
@@ -763,8 +813,12 @@ class TpuHashAggregateExec(TpuExec):
                + "|" + schema_cache_key(out_schema)
                + "|" + exprs_cache_key(self.group_exprs)
                + "|" + exprs_cache_key(self.agg_exprs))
+        # the bucket covers every ORDER-compared string column: group
+        # keys plus min/max string inputs and max_by/min_by string
+        # ordering keys (plain column refs by the planner gate)
+        bucket_exprs = tuple(spec.group_exprs) + spec.string_order_exprs
         self._jit_partial = lambda b, _k=key: shared_jit(
-            f"{_k}|partial|{(bkt := string_key_bucket(b, spec.group_exprs))}",
+            f"{_k}|partial|{(bkt := string_key_bucket(b, bucket_exprs))}",
             lambda: _partial(spec._partial_step, string_bucket=bkt))(b)
         self._jit_merge = lambda b, _k=key: shared_jit(
             f"{_k}|merge|{(bkt := spec._merge_bucket(b))}",
@@ -792,8 +846,11 @@ class TpuHashAggregateExec(TpuExec):
 
         def _combine_bucket(partials) -> int:
             from spark_rapids_tpu.kernels import strings as SK
+            nkeys = len(spec.group_exprs)
             pairs = [(p.columns[i], p.num_rows) for p in partials
-                     for i in range(len(spec.group_exprs))]
+                     for i in range(nkeys)]
+            pairs += [(p.columns[nkeys + si], p.num_rows) for p in partials
+                      for si in spec._string_order_slots()]
             if not any(c.is_string_like for c, _ in pairs):
                 return 0
             return SK.bucket_for(SK.max_live_bytes_multi(pairs))

@@ -672,9 +672,34 @@ class PlanMeta:
                             "batchSizeRows * 2^p <= 64M register slots "
                             f"(have {self.conf.batch_size_rows} * {agg.m}); "
                             "lower spark.rapids.sql.batchSizeBytes/rows")
+            for e in p.agg_exprs:
+                for agg in find_aggregates(e):
+                    # ORDER-compared string inputs (min/max over strings,
+                    # max_by/min_by string ordering keys) reduce over the
+                    # rank surrogate whose max-bytes bucket is computed
+                    # from the referenced column BEFORE the jitted kernel
+                    # runs — so like string group keys they must be plain
+                    # column refs (the _key_expr_ok contract)
+                    ordered = []
+                    if isinstance(agg, (A.Min, A.Max)):
+                        ordered = [agg.children[0]]
+                    elif isinstance(agg, (A.MaxBy, A.MinBy)):
+                        ordered = [agg.children[1]]
+                    for oe in ordered:
+                        try:
+                            var = oe.dtype.variable_width
+                        except (TypeError, ValueError,
+                                NotImplementedError):
+                            var = False
+                        inner = oe
+                        while isinstance(inner, E.Alias):
+                            inner = inner.child
+                        if var and not isinstance(inner, E.BoundReference):
+                            self.will_not_work(
+                                f"{agg.name} string ordering input {oe!r} "
+                                "must be a plain column reference "
+                                "(project it first)")
             if not self.conf.variable_float_agg_enabled:
-                from spark_rapids_tpu.expressions.aggregates import (
-                    find_aggregates)
                 for e in p.agg_exprs:
                     for agg in find_aggregates(e):
                         try:

@@ -389,12 +389,13 @@ def _build_registry() -> None:
                               note="row-order pick via the stable group "
                               "sort; deterministic here (Spark documents "
                               "first/last as order-dependent)"))
-    _ORD_NOSTR = NUMERIC + DATETIME + BOOL
+    _ORD_BY = NUMERIC + DATETIME + BOOL + STR
     for cls in (A.MaxBy, A.MinBy):
-        register(cls, ExprSig(ALL_DEVICE, ALL_DEVICE, _ORD_NOSTR,
-                              note="ordering column: fixed-width only "
-                              "(string ordering keys fall back); ties "
-                              "take the first row in input order"))
+        register(cls, ExprSig(ALL_DEVICE, ALL_DEVICE, _ORD_BY,
+                              note="string ordering keys reduce over a "
+                              "dense rank surrogate (plain column refs "
+                              "only); ties take the first row in input "
+                              "order"))
     for cls in (A.BitAndAgg, A.BitOrAgg, A.BitXorAgg):
         register(cls, ExprSig(INTEGRAL, INTEGRAL))
 

@@ -1,0 +1,22 @@
+"""Multi-tenant serving layer: admission control, tenant-budgeted
+scheduling, and the plan-fingerprint result cache (ROADMAP open item 3).
+
+  QueryQueue          admission + cache + tenant scheduling front door
+  LocalSessionRunner  in-process execution under the device semaphore
+  ClusterDriverRunner execution through TpuClusterDriver.submit
+  ResultCache         fingerprint-keyed LRU with source invalidation
+
+See docs/ARCHITECTURE.md §11 for the data path.
+"""
+from spark_rapids_tpu.serving.admission import (  # noqa: F401
+    AdmissionRejected,
+    ClusterDriverRunner,
+    LocalSessionRunner,
+    QueryContext,
+    QueryQueue,
+)
+from spark_rapids_tpu.serving.cache import (  # noqa: F401
+    ResultCache,
+    UncacheableError,
+    plan_fingerprint,
+)

@@ -1,0 +1,347 @@
+"""Admission control + tenant-budgeted scheduling: the serving tier.
+
+``QueryQueue`` is the driver-side front door that turns the engine from
+a batch runner into a server: concurrent ``submit()`` calls tagged with
+a tenant and priority are
+
+  1. served from the plan-fingerprint result cache when possible (a hit
+     never consumes admission or dispatches work — serving/cache.py);
+  2. gated by a MEMORY-AWARE admission policy: a slots semaphore
+     (``spark.rapids.serving.maxConcurrentQueries``) and, when the
+     device arena has a byte budget, a byte-weighted semaphore sized at
+     ``admission.memoryFraction`` of it — both are
+     ``WeightedPrioritySemaphore``s (memory/semaphore.py), so waiters
+     drain in priority-then-FIFO order, the discipline the device
+     semaphore pins (reference: GpuSemaphore/PrioritySemaphore,
+     GpuSemaphore.scala:183,512);
+  3. queued with timeout/backpressure: more than ``queue.maxDepth``
+     waiting queries rejects immediately, an admission wait past
+     ``queue.timeout`` rejects with ``AdmissionRejected`` — overload is
+     surfaced, never silently buffered without bound;
+  4. executed under the tenant's ambient scope (memory/tenant.py): the
+     query's device residency charges the tenant's budget, its spill
+     order follows the tenant's weight, and a budget breach self-spills
+     and self-retries instead of OOM-killing a neighbor.
+
+Execution itself is pluggable: ``LocalSessionRunner`` runs plans
+in-process under the device semaphore (one serving process = one chip),
+``ClusterDriverRunner`` dispatches through ``TpuClusterDriver.submit``
+(whose per-executor task queues interleave independent queries across
+executors).  Counters: queries_admitted/queued/rejected plus the cache
+and tenant families (shuffle/stats.py) ride the cluster-stats snapshot
+and the bench artifact.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, Optional
+
+from spark_rapids_tpu.memory.semaphore import WeightedPrioritySemaphore
+from spark_rapids_tpu.memory.tenant import TENANT_CONF_KEY, TENANTS
+from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+from spark_rapids_tpu.testing.chaos import CHAOS
+
+from spark_rapids_tpu.serving.cache import (
+    ResultCache, UncacheableError, plan_fingerprint)
+
+
+class AdmissionRejected(RuntimeError):
+    """Admission control refused the query.  ``reason`` is
+    ``"queue_full"`` (backpressure: too many queries already waiting) or
+    ``"timeout"`` (waited past the queue timeout without being
+    admitted)."""
+
+    def __init__(self, message: str, reason: str, tenant: str):
+        super().__init__(message)
+        self.reason = reason
+        self.tenant = tenant
+
+
+class QueryContext:
+    """What a runner gets alongside the plan."""
+
+    def __init__(self, tenant: str, priority: int, conf_overrides: dict):
+        self.tenant = tenant
+        self.priority = priority
+        self.conf_overrides = dict(conf_overrides)
+
+
+class LocalSessionRunner:
+    """In-process execution: one serving process drives one device.
+    Device gating stays where it lives — the engine acquires the device
+    semaphore per partition task — but at THIS query's priority via the
+    ``task_priority`` ambient, so concurrent admitted queries time-share
+    the chip in serving-priority order, inside the tenant scope the
+    QueryQueue already established."""
+
+    def __init__(self, conf: Optional[dict] = None):
+        from spark_rapids_tpu.api.session import TpuSession
+        self.session = TpuSession(dict(conf or {}))
+
+    def __call__(self, plan, ctx: QueryContext) -> list:
+        import copy
+
+        from spark_rapids_tpu.api.session import DataFrame
+        from spark_rapids_tpu.memory.semaphore import task_priority
+        sess = self.session
+        if ctx.conf_overrides:
+            sess = copy.copy(self.session)
+            sess.conf = self.session.conf.with_overrides(
+                **ctx.conf_overrides)
+        with task_priority(ctx.priority):
+            return DataFrame(plan, sess).collect()
+
+
+class ClusterDriverRunner:
+    """Cluster execution through TpuClusterDriver.submit (thread-safe:
+    concurrent queries queue per executor and interleave).  The tenant
+    rides the per-query conf overrides so executors run the task under
+    the tenant's scope."""
+
+    def __init__(self, driver, timeout_s: float = 300.0):
+        self.driver = driver
+        self.timeout_s = timeout_s
+
+    def __call__(self, plan, ctx: QueryContext) -> list:
+        conf = dict(ctx.conf_overrides)
+        conf[TENANT_CONF_KEY] = ctx.tenant
+        return self.driver.submit(plan, timeout_s=self.timeout_s,
+                                  conf=conf)
+
+
+class QueryQueue:
+    """Admission controller + serving facade (see module doc).
+
+    ``runner(plan, ctx)`` executes one admitted query and returns rows;
+    priority is LOWER-FIRST (the PrioritySemaphore convention)."""
+
+    def __init__(self, runner: Callable, conf=None,
+                 cache: Optional[ResultCache] = None):
+        from spark_rapids_tpu.config import RapidsConf
+        if conf is None or isinstance(conf, dict):
+            conf = RapidsConf(conf or {})
+        self.conf = conf
+        self.runner = runner
+        self.max_concurrent = max(conf.serving_max_concurrent, 1)
+        self.queue_max_depth = max(conf.serving_queue_max_depth, 0)
+        self.queue_timeout_s = conf.serving_queue_timeout
+        self._slots = WeightedPrioritySemaphore(self.max_concurrent)
+        #: atomic admission-queue depth: the maxDepth bound must hold
+        #: under a stampede, so the count-and-enter is one locked step
+        #: (reading the semaphore's waiting() then enqueueing would let
+        #: every racer pass the same snapshot)
+        self._depth = 0
+        self._depth_lock = threading.Lock()
+        # memory-aware admission: only meaningful when the arena has a
+        # byte budget (unbudgeted arenas admit on slots alone).  Sized
+        # LAZILY on first admission, not at construction: a cluster-side
+        # QueryQueue is often built before initialize_memory configures
+        # the arena, and a constructor-time snapshot of budget 0 would
+        # silently disable the byte bound forever
+        self.admission_bytes = 0
+        self._bytes: Optional[WeightedPrioritySemaphore] = None
+        self._bytes_init = threading.Lock()
+        self.default_query_bytes = conf.serving_admission_query_bytes
+        self.cache = cache if cache is not None else (
+            ResultCache(conf.serving_cache_max_bytes,
+                        conf.serving_cache_ttl)
+            if conf.serving_cache_enabled else None)
+        TENANTS.configure(conf.serving_tenant_default_budget,
+                          conf.serving_tenant_default_weight,
+                          conf.serving_tenants_spec)
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_lock = threading.Lock()
+        #: single-flight: fingerprint -> the LEADER's completion future.
+        #: Concurrent identical submissions (a dashboard miss-storm)
+        #: wait for the leader instead of each executing the same plan
+        self._inflight: Dict[str, Future] = {}
+        self._inflight_lock = threading.Lock()
+
+    # -- admission -----------------------------------------------------------
+
+    def _ensure_bytes_sem(self) -> None:
+        """Size the byte-admission semaphore from the arena's CURRENT
+        budget on first use (one-shot: later arena reconfiguration keeps
+        the first sizing — outstanding reservations couldn't survive a
+        resize)."""
+        if self._bytes is not None:
+            return
+        from spark_rapids_tpu.memory.arena import device_arena
+        with self._bytes_init:
+            if self._bytes is not None:
+                return
+            budget = device_arena().budget_bytes
+            if not budget:
+                return      # unbudgeted arena: slots-only (retry later)
+            frac = self.conf.serving_admission_memory_fraction
+            self.admission_bytes = max(int(budget * frac), 1)
+            self._bytes = WeightedPrioritySemaphore(self.admission_bytes)
+
+    def _admit(self, tenant: str, priority: int, est_bytes: int,
+               timeout_s: float) -> int:
+        """Take (slot, bytes) or raise AdmissionRejected.  Returns the
+        byte cost actually reserved (release must match)."""
+        self._ensure_bytes_sem()
+        # ONE capture: cost computation and the acquire/release pair
+        # must see the same semaphore — racing the lazy init could
+        # otherwise compute cost 0 then "acquire" from the now-created
+        # semaphore, bypassing the byte bound
+        bytes_sem = self._bytes
+        now = time.monotonic()
+        cost = 0
+        if bytes_sem is not None:
+            # a query estimated over the whole admission budget runs
+            # alone (full budget) instead of never admitting
+            cost = min(max(int(est_bytes), 1), self.admission_bytes)
+        instant = self._slots.acquire(priority, deadline=now)
+        if not instant:
+            with self._depth_lock:
+                if self._depth >= self.queue_max_depth:
+                    full = True
+                else:
+                    full = False
+                    self._depth += 1
+            if full:
+                SHUFFLE_COUNTERS.add(queries_rejected=1)
+                raise AdmissionRejected(
+                    f"admission queue full ({self.queue_max_depth} "
+                    f"waiting): tenant {tenant!r} rejected",
+                    reason="queue_full", tenant=tenant)
+            SHUFFLE_COUNTERS.add(queries_queued=1)
+            try:
+                ok = self._slots.acquire(priority,
+                                         deadline=now + timeout_s)
+            finally:
+                with self._depth_lock:
+                    self._depth -= 1
+            if not ok:
+                SHUFFLE_COUNTERS.add(queries_rejected=1)
+                raise AdmissionRejected(
+                    f"admission wait exceeded {timeout_s:.1f}s: tenant "
+                    f"{tenant!r} rejected", reason="timeout",
+                    tenant=tenant)
+        if bytes_sem is not None:
+            if not bytes_sem.acquire(priority, cost=cost,
+                                     deadline=now + timeout_s):
+                self._slots.release()
+                SHUFFLE_COUNTERS.add(queries_rejected=1)
+                raise AdmissionRejected(
+                    f"admission byte budget wait exceeded "
+                    f"{timeout_s:.1f}s ({cost}b of "
+                    f"{self.admission_bytes}b): tenant {tenant!r} "
+                    "rejected", reason="timeout", tenant=tenant)
+        SHUFFLE_COUNTERS.add(queries_admitted=1)
+        return cost
+
+    def _release(self, cost: int) -> None:
+        # cost > 0 implies the byte semaphore existed at admission (it
+        # is created once and never replaced, so this is the same one)
+        if cost and self._bytes is not None:
+            self._bytes.release(cost)
+        self._slots.release()
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, plan, tenant: str = "default", priority: int = 0,
+               est_bytes: Optional[int] = None,
+               timeout_s: Optional[float] = None,
+               conf: Optional[dict] = None,
+               cacheable: bool = True) -> list:
+        """Run one logical plan for ``tenant`` and return its rows.
+        Blocks through admission (bounded by ``timeout_s`` or the
+        queue.timeout conf) and runs the query on THIS thread.  Cache
+        hits return without consuming admission or dispatching work."""
+        CHAOS.delay("serving.admit.delay")
+        overrides = dict(conf or {})
+        # ONE deadline bounds the whole submission (single-flight wait
+        # AND admission): a follower whose leader wedges must not spend
+        # a full timeout on the future and then a second one in _admit
+        budget_s = self.queue_timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + budget_s
+        key = sources = None
+        leader_future = None
+        if self.cache is not None and cacheable:
+            try:
+                key, sources = plan_fingerprint(plan, overrides)
+            except UncacheableError:
+                key = None
+            if key is not None:
+                hit = self.cache.get(key, tenant=tenant)
+                if hit is not None:
+                    return hit
+                # single-flight: the FIRST miss becomes the leader; the
+                # concurrent rest wait for it and serve from the entry
+                # it stores — a dashboard miss-storm executes once
+                with self._inflight_lock:
+                    existing = self._inflight.get(key)
+                    if existing is None:
+                        leader_future = Future()
+                        self._inflight[key] = leader_future
+                if leader_future is None and existing is not None:
+                    # follower: the leader's finally always completes
+                    # this future; its failure (or a wait past OUR
+                    # timeout bound — a wedged leader must not hold
+                    # followers hostage) falls through to a normal
+                    # execution of our own, bounded by admission
+                    try:
+                        existing.result(timeout=budget_s)
+                    except Exception:  # noqa: BLE001  # tpu-lint: allow-swallow(the leader raises its own failure to its own caller; a follower deliberately falls through to execute the query itself, which surfaces any real error)
+                        pass
+                    else:
+                        hit = self.cache.get(key, tenant=tenant)
+                        if hit is not None:
+                            return hit
+        try:
+            cost = self._admit(
+                tenant, priority,
+                self.default_query_bytes if est_bytes is None
+                else est_bytes,
+                max(deadline - time.monotonic(), 0.001))
+            try:
+                ctx = QueryContext(tenant, priority, overrides)
+                with TENANTS.scope(tenant):
+                    rows = self.runner(plan, ctx)
+            finally:
+                self._release(cost)
+            if key is not None:
+                self.cache.put(key, rows, sources, tenant=tenant)
+            if leader_future is not None:
+                leader_future.set_result(True)
+            return rows
+        except BaseException as e:
+            if leader_future is not None:
+                leader_future.set_exception(e)
+            raise
+        finally:
+            if leader_future is not None:
+                with self._inflight_lock:
+                    if self._inflight.get(key) is leader_future:
+                        del self._inflight[key]
+
+    def submit_async(self, plan, **kw):
+        """``submit`` on a worker thread; returns a Future.  The pool is
+        sized past the admission bound so queued queries can WAIT in the
+        admission queue (where priority ordering lives) rather than in
+        the pool's FIFO."""
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.max_concurrent
+                    + self.queue_max_depth,
+                    thread_name_prefix="serving")
+        return self._pool.submit(self.submit, plan, **kw)
+
+    def invalidate_source(self, source: str) -> int:
+        """Explicit cache invalidation for one source (file path, table
+        path, or ResultCache.source_token of an in-memory relation)."""
+        if self.cache is None:
+            return 0
+        return self.cache.invalidate_source(source)
+
+    def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)

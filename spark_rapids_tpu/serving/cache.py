@@ -1,0 +1,308 @@
+"""Plan-fingerprint-keyed result cache.
+
+The millions-of-users case (ROADMAP open item 3) is dominated by
+REPEATED traffic: the same dashboard queries over slowly-changing data.
+This module keys collected results by a canonical fingerprint of the
+LOGICAL plan — the driver-side twin of the physical-plan fingerprint
+guard (`cluster/driver.py _fingerprints`), computed BEFORE execution so
+a hit never dispatches a task — with:
+
+  * a size-bounded LRU over the PICKLED payload bytes (exact byte
+    accounting, and the payload carries a CRC so the chaos site
+    ``serving.cache.corrupt`` can prove corrupt entries are dropped,
+    never served);
+  * per-tenant hit/miss/eviction counters (plus the process-wide
+    cache_* counters in shuffle/stats.py);
+  * explicit invalidation when source data changes: every entry records
+    the SOURCES its plan read (file paths, table paths, in-memory
+    relation tokens); ``invalidate_source`` drops all entries touching
+    one.  File sources additionally fold (mtime, size) into the KEY, so
+    a rewritten file misses naturally even without an explicit call.
+
+Reference grounding: "Accelerating Presto with GPUs" (PAPERS.md) —
+interactive multi-query analytics lives or dies on serving repeated
+fragments from cache.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Dict, FrozenSet, Optional, Tuple
+
+from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+from spark_rapids_tpu.testing.chaos import CHAOS
+from spark_rapids_tpu.utils.checksum import frame_checksum, verify_frame
+
+#: bump when the fingerprint recipe changes (stale keys must not collide)
+_FP_VERSION = "fp1"
+
+
+class UncacheableError(ValueError):
+    """The plan cannot be fingerprinted safely (opaque functions, or an
+    expression whose repr is identity-based and could alias another
+    after address reuse) — the serving layer bypasses the cache."""
+
+
+_TOKEN_LOCK = threading.Lock()
+
+
+def _source_token(rel) -> str:
+    """Stable identity for an in-memory relation: same OBJECT -> same
+    token across submissions (repeated traffic over one registered
+    dataset), distinct objects -> distinct tokens.  Minted under a lock:
+    a concurrent first fingerprint of one relation (the miss-storm case)
+    must agree on ONE token or the storm's keys would all differ and
+    single-flight coalescing would never match."""
+    tok = getattr(rel, "_serving_source_token", None)
+    if tok is None:
+        with _TOKEN_LOCK:
+            tok = getattr(rel, "_serving_source_token", None)
+            if tok is None:
+                tok = f"mem:{uuid.uuid4().hex}"
+                rel._serving_source_token = tok
+    return tok
+
+
+def _file_version(path: str) -> str:
+    try:
+        st = os.stat(path)
+        return f"{st.st_mtime_ns}:{st.st_size}"
+    except OSError:
+        return "missing"
+
+
+def plan_fingerprint(plan, conf_overrides: Optional[dict] = None
+                     ) -> Tuple[str, FrozenSet[str]]:
+    """(hex key, invalidation sources) for one logical plan.
+
+    Walks the plan preorder hashing node class names and attribute reprs
+    (expressions repr deterministically); leaf relations contribute
+    their source identity — file paths WITH (mtime, size) so a rewritten
+    file changes the key, table paths with their snapshot version,
+    in-memory relations via a per-object token.  Raises
+    ``UncacheableError`` on opaque nodes (MapBatches functions) or any
+    identity-based repr (``<X object at 0x...>``): a reused address must
+    never make two different plans collide.
+    """
+    from spark_rapids_tpu.expressions.core import Expression
+    from spark_rapids_tpu.plan import logical as L
+    h = hashlib.sha256()
+    h.update(_FP_VERSION.encode())
+    sources = set()
+
+    def feed(s: str) -> None:
+        if " object at 0x" in s:
+            raise UncacheableError(
+                f"identity-based repr in plan fingerprint: {s[:120]!r}")
+        h.update(s.encode("utf-8", "replace"))
+        h.update(b"\x00")
+
+    def check_expr(e) -> None:
+        # opaque callables (python/pandas UDFs) cannot be fingerprinted:
+        # their reprs are NAME-based ("pyudf:<lambda>(...)"), so two
+        # different lambdas would alias one key and the cache would
+        # serve one query's rows for the other
+        for v in vars(e).values():
+            if callable(v) and not isinstance(v, (type, Expression)):
+                raise UncacheableError(
+                    f"opaque callable in plan expression {e!r}")
+        for c in getattr(e, "children", ()):
+            if isinstance(c, Expression):
+                check_expr(c)
+
+    def check_node_exprs(node) -> None:
+        for v in vars(node).values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for x in vs:
+                if isinstance(x, tuple):      # e.g. Sort's (expr, order)
+                    for y in x:
+                        if isinstance(y, Expression):
+                            check_expr(y)
+                elif isinstance(x, Expression):
+                    check_expr(x)
+
+    def walk(node) -> None:
+        feed(type(node).__name__)
+        check_node_exprs(node)
+        if isinstance(node, (L.ParquetRelation, L.FileRelation)):
+            for p in node.paths:
+                sources.add(p)
+                feed(f"{p}@{_file_version(p)}")
+            feed(repr(getattr(node, "column_pruning", None)))
+            feed(repr(getattr(node, "options", None)))
+        elif isinstance(node, (L.InMemoryRelation, L.CachedParquetRelation)):
+            tok = _source_token(node)
+            sources.add(tok)
+            feed(tok)
+            feed(repr(node.schema))
+        elif isinstance(node, L.DeltaRelation):
+            sources.add(node.table_path)
+            feed(node.table_path)
+            feed(repr(getattr(node.snapshot, "version", None)))
+        elif isinstance(node, L.IcebergRelation):
+            sources.add(node.table_path)
+            feed(node.table_path)
+            feed(repr(getattr(node.snapshot, "snapshot_id", None)))
+        elif isinstance(node, L.MapBatches):
+            raise UncacheableError(
+                "MapBatches plans carry opaque functions and cannot be "
+                "fingerprinted")
+        else:
+            for k in sorted(vars(node)):
+                if k == "children" or k.startswith("_"):
+                    continue
+                v = getattr(node, k)
+                # child plan nodes are covered by the recursive walk
+                if isinstance(v, L.LogicalPlan) or (
+                        isinstance(v, (list, tuple)) and any(
+                            isinstance(x, L.LogicalPlan) for x in v)):
+                    continue
+                feed(f"{k}={v!r}")
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    for k in sorted(conf_overrides or {}):
+        feed(f"conf:{k}={conf_overrides[k]!r}")
+    return h.hexdigest(), frozenset(sources)
+
+
+class _Entry:
+    __slots__ = ("payload", "crc", "nbytes", "sources", "stored_at",
+                 "tenant")
+
+    def __init__(self, payload: bytes, crc: int, sources: FrozenSet[str],
+                 stored_at: float, tenant: str):
+        self.payload = payload
+        self.crc = crc
+        self.nbytes = len(payload)
+        self.sources = sources
+        self.stored_at = stored_at
+        self.tenant = tenant            # owner: evictions charge HIM
+
+
+class ResultCache:
+    """Size-bounded LRU of pickled query results keyed by plan
+    fingerprint, with TTL, per-tenant counters and source invalidation."""
+
+    def __init__(self, max_bytes: int = 256 << 20, ttl_s: float = 0.0):
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = float(ttl_s)       # 0 = no expiry
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._used_bytes = 0
+        #: tenant -> {"hits", "misses", "evictions"}
+        self._tenant: Dict[str, Dict[str, int]] = {}
+
+    # -- internals (locked) --------------------------------------------------
+
+    def _bump_locked(self, tenant: str, field: str, n: int = 1) -> None:
+        t = self._tenant.setdefault(
+            tenant, {"hits": 0, "misses": 0, "evictions": 0})
+        t[field] += n
+
+    def _drop_locked(self, key: str) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self._used_bytes -= e.nbytes
+
+    def _evict_to_fit_locked(self, incoming: int) -> None:
+        while self._entries and \
+                self._used_bytes + incoming > self.max_bytes:
+            old_key, victim = next(iter(self._entries.items()))
+            self._drop_locked(old_key)
+            # the eviction charges the entry's OWNER, not the inserter
+            self._bump_locked(victim.tenant, "evictions")
+            SHUFFLE_COUNTERS.add(cache_evictions=1)
+
+    # -- public --------------------------------------------------------------
+
+    def get(self, key: str, tenant: str = "default"):
+        """Cached rows or None.  Verifies the payload CRC (the chaos site
+        ``serving.cache.corrupt`` flips a bit here): a corrupt entry is
+        dropped and counted as an invalidation + miss — recompute, never
+        serve wrong rows.  TTL-expired entries likewise miss."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and self.ttl_s and \
+                    time.monotonic() - e.stored_at > self.ttl_s:
+                self._drop_locked(key)
+                SHUFFLE_COUNTERS.add(cache_evictions=1)
+                self._bump_locked(e.tenant, "evictions")
+                e = None
+            if e is None:
+                self._bump_locked(tenant, "misses")
+                SHUFFLE_COUNTERS.add(cache_misses=1)
+                return None
+            payload, crc = e.payload, e.crc
+        payload = CHAOS.corrupt("serving.cache.corrupt", payload)
+        if not verify_frame(payload, crc):
+            with self._lock:
+                self._drop_locked(key)
+                self._bump_locked(tenant, "misses")
+            SHUFFLE_COUNTERS.add(cache_invalidations=1, cache_misses=1)
+            return None
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+            self._bump_locked(tenant, "hits")
+        SHUFFLE_COUNTERS.add(cache_hits=1)
+        return pickle.loads(payload)
+
+    def put(self, key: str, rows, sources: FrozenSet[str],
+            tenant: str = "default") -> bool:
+        """Store rows; returns False when the payload alone exceeds the
+        size bound (oversized results are simply not cached)."""
+        payload = pickle.dumps(rows)
+        if len(payload) > self.max_bytes:
+            return False
+        crc = frame_checksum(payload)
+        with self._lock:
+            self._drop_locked(key)       # replace, don't double-count
+            self._evict_to_fit_locked(len(payload))
+            self._entries[key] = _Entry(payload, crc, frozenset(sources),
+                                        time.monotonic(), tenant)
+            self._used_bytes += len(payload)
+        return True
+
+    def invalidate_source(self, source: str) -> int:
+        """Drop every entry whose plan read ``source`` (a file path, a
+        table path, or an in-memory relation token via
+        ``source_token``).  Returns the number of entries dropped."""
+        with self._lock:
+            victims = [k for k, e in self._entries.items()
+                       if source in e.sources]
+            for k in victims:
+                self._drop_locked(k)
+        if victims:
+            SHUFFLE_COUNTERS.add(cache_invalidations=len(victims))
+        return len(victims)
+
+    def invalidate_all(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._used_bytes = 0
+        if n:
+            SHUFFLE_COUNTERS.add(cache_invalidations=n)
+        return n
+
+    @staticmethod
+    def source_token(relation) -> str:
+        """The invalidation token of an in-memory relation (pass a
+        DataFrame's leaf relation, or the DataFrame itself)."""
+        rel = getattr(relation, "plan", relation)
+        return _source_token(rel)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "used_bytes": self._used_bytes,
+                    "max_bytes": self.max_bytes,
+                    "per_tenant": {t: dict(v)
+                                   for t, v in sorted(self._tenant.items())}}

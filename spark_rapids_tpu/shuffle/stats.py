@@ -83,6 +83,21 @@ _FIELDS = (
     "executors_excluded",     # lost executors excluded from resubmission
     "shuffle_invalidations",  # shuffles dropped from peers' block stores
                               # when a query attempt was torn down
+    # serving layer (admission / tenant budgets / result cache;
+    # serving/admission.py + serving/cache.py + memory/tenant.py)
+    "queries_admitted",       # queries that passed admission control
+    "queries_queued",         # queries that had to WAIT for admission
+    "queries_rejected",       # queries rejected (queue full / admission
+                              # timeout) — backpressure made visible
+    "cache_hits",             # result-cache hits (served without running)
+    "cache_misses",           # result-cache misses (executed + stored)
+    "cache_evictions",        # entries evicted by the LRU size bound/TTL
+    "cache_invalidations",    # entries dropped by explicit source
+                              # invalidation or corruption detection
+    "tenant_spills",          # spills of tenant-tagged handles (pressure
+                              # attributed to the tenant that held data)
+    "budget_denials",         # tenant-budget breaches surfaced as
+                              # self-retry OOMs (never a neighbor kill)
 )
 
 

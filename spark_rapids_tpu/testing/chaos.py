@@ -81,6 +81,14 @@ SITES: Dict[str, str] = {
         "DeviceArena.maybe_throw_injected (inside retry scopes): raise "
         "TpuRetryOOM / TpuSplitAndRetryOOM per args['kind'] — the "
         "unified form of the legacy injectRetryOOM hooks.",
+    "serving.admit.delay":
+        "QueryQueue.submit admission entry: sleep args['seconds'] before "
+        "admission control runs (slow admission under a stampede; "
+        "exercises queue timeout/backpressure bounds).",
+    "serving.cache.corrupt":
+        "ResultCache.get: flip one deterministic bit in the cached "
+        "payload before its checksum verify — the entry must be dropped "
+        "and recomputed, never served corrupt.",
 }
 
 

@@ -105,6 +105,65 @@ def test_max_by_ties_take_first_row():
     assert rows == [(1, 10), (2, 40)]
 
 
+def test_max_by_min_by_string_ordering_keys():
+    """r8 (NOTES_r05 gap): STRING ordering keys run on device via the
+    rank surrogate — grouped, two partitions so the partial buffers cross
+    the merge path (the min/max string buffer is order-compared again)."""
+    data = _data()
+
+    def build(s):
+        return (_df(s, data).group_by("k")
+                .agg(max_by("v", "s").alias("mvs"),
+                     min_by("v", "s").alias("nvs"),
+                     max_by("s", "s").alias("mss"),
+                     min_by("x", "s").alias("nxs"))
+                .order_by("k"))
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows
+
+
+def test_max_by_string_keys_global_and_ties():
+    # global (no grouping) + duplicate string keys: first row wins
+    data = {"k": [1, 1, 1, 2], "v": [10, 20, 30, 40],
+            "x": [1.0, 2.0, 3.0, 4.0],
+            "s": ["zz", "zz", "aa", "mm"], "b": [0, 1, 2, 3]}
+
+    def build(s):
+        return _df(s, data, parts=1).agg(
+            max_by("v", "s").alias("m"), min_by("v", "s").alias("n"))
+    rows = assert_tpu_cpu_equal(build)
+    assert rows == [(10, 30)]
+
+
+def test_max_by_string_keys_all_null_group():
+    data = {"k": [1, 1, 2, 2], "v": [10, 20, 30, 40],
+            "x": [1.0, 2.0, 3.0, 4.0],
+            "s": [None, None, "b", "a"], "b": [0, 1, 2, 3]}
+
+    def build(s):
+        return (_df(s, data, parts=1).group_by("k")
+                .agg(max_by("v", "s").alias("m")).order_by("k"))
+    rows = assert_tpu_cpu_equal(build, ignore_order=False)
+    assert rows == [(1, None), (2, 30)]
+
+
+def test_min_max_over_strings():
+    """min/max over STRING values (typesig always advertised it; the
+    device kernel is the r8 rank-surrogate gather) — grouped across the
+    merge path, plus empty-vs-prefix ordering ('a' < 'ab')."""
+    data = _data()
+    data["s"][3] = ""          # empty string sorts before everything
+    data["s"][4] = "s1"        # prefix of "s1-..." values
+
+    def build2(s):
+        from spark_rapids_tpu.expressions.aggregates import Max, Min
+        return (_df(s, data).group_by("k")
+                .agg(Min(col("s")).alias("mn"), Max(col("s")).alias("mx"))
+                .order_by("k"))
+    rows = assert_tpu_cpu_equal(build2, ignore_order=False)
+    assert rows
+
+
 def test_bit_aggregates():
     data = _data()
 

@@ -41,6 +41,7 @@ SUITES = {
              "tests/test_out_of_core_joins_full.py",
              "tests/test_memory.py"], 900),
     "gauntlet": (["tests/test_tpcds_gauntlet.py"], 1200),
+    "serving": (["tests/test_serving.py", "tests/test_agg_tail.py"], 600),
     "lint": (["tests/test_lint.py"], 300),
 }
 

@@ -61,6 +61,23 @@ QUERY_TIMEOUT_S = {
     "tpu": int(os.environ.get("SPARK_RAPIDS_TPU_BENCH_TIMEOUT", 900)),
     "cpu": int(os.environ.get("SPARK_RAPIDS_TPU_BENCH_CPU_TIMEOUT", 600)),
 }
+# Per-query child-timeout overrides (SPARK_RAPIDS_TPU_BENCH_TIMEOUT_<QUERY>,
+# both backends): q72's CPU-oracle conditional-join pass is far slower than
+# every other query's whole child, and one knob for all five queries meant
+# raising EVERY ceiling to accommodate it.  The default override gives q72
+# the headroom for its one-time COLD oracle pass (warm runs hit the oracle
+# result cache below and fit easily).
+QUERY_TIMEOUT_OVERRIDES_S = {"q72": 2400}
+
+
+def _query_timeout_s(backend: str, qname: str) -> int:
+    env = os.environ.get(f"SPARK_RAPIDS_TPU_BENCH_TIMEOUT_{qname.upper()}")
+    if env is not None:
+        return int(env)
+    return max(QUERY_TIMEOUT_S[backend],
+               QUERY_TIMEOUT_OVERRIDES_S.get(qname, 0))
+
+
 QUERIES = ("q6",) if SMOKE else ("q6", "q1", "q3", "q25", "q72")
 METRIC = ("tpch_q6_smoke_rows_per_sec" if SMOKE
           else "tpch_q6_q1_tpcds_q3_q25_q72_geomean_rows_per_sec")
@@ -242,9 +259,25 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
         except Exception as e:  # digest is evidence, never a bench failure
             util = {"error": f"{type(e).__name__}: {e}"}
 
-    t0 = time.perf_counter()
-    cpu_rows = run(cpu_sess)
-    cpu_time = time.perf_counter() - t0
+    # the CPU ORACLE pass rides the differential-oracle result cache
+    # (testing/oracle_cache.py): it is deterministic for (query, rows,
+    # batch) and — on q72 — the bench wall (its conditional-join pass
+    # dwarfs OUR execution).  The measured oracle wall is cached WITH the
+    # rows so a cache hit still reports the honest first-run speedup
+    # instead of the cache-read time.  TPU_ORACLE_CACHE=0 disables.
+    from spark_rapids_tpu.testing import tpcds as _tpcds, tpch as _tpch
+    from spark_rapids_tpu.testing.oracle_cache import (
+        get_or_compute, source_fingerprint)
+
+    def _oracle():
+        t0 = time.perf_counter()
+        rows = run(cpu_sess)
+        return {"rows": rows, "oracle_s": time.perf_counter() - t0}
+
+    payload = get_or_compute(
+        ("bench", qname, n_rows, BATCH_ROWS,
+         source_fingerprint(_tpcds, _tpch)), _oracle)
+    cpu_rows, cpu_time = payload["rows"], payload["oracle_s"]
     _check_rows(qname, tpu_rows, cpu_rows)
 
     print(json.dumps({
@@ -253,6 +286,9 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
         "tpu_s": round(tpu_time, 4), "oracle_s": round(cpu_time, 4),
         "speedup": round(cpu_time / tpu_time, 3),
         "launches": stats["launches"], "programs": stats["programs"],
+        "launches_per_stage": round(
+            stats["launches"] / max(shuffle.get("exchange_stages", 0), 1),
+            1),
         "shuffle": shuffle,
         "input_bytes": input_bytes,
         **({"util": util} if util else {}),
@@ -445,7 +481,7 @@ def main() -> None:
                     os.environ.get("SPARK_RAPIDS_TPU_BENCH_PROFILE_DIR",
                                    "bench_profile"))
             result, err = _spawn("tpu", f"query:{q}",
-                                 QUERY_TIMEOUT_S["tpu"], extra)
+                                 _query_timeout_s("tpu", q), extra)
             if result is not None:
                 per_query[q] = result
                 profiled = profiled or "profile_dir" in result
@@ -455,7 +491,8 @@ def main() -> None:
     for q in QUERIES:   # cpu fallback for anything the tpu didn't deliver
         if q in per_query:
             continue
-        result, err = _spawn("cpu", f"query:{q}", QUERY_TIMEOUT_S["cpu"])
+        result, err = _spawn("cpu", f"query:{q}",
+                             _query_timeout_s("cpu", q))
         if result is not None:
             per_query[q] = result
         else:

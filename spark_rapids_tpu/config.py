@@ -155,6 +155,27 @@ STAGE_FUSION = conf("spark.rapids.sql.tpu.fuseStages").doc(
     "each launch is a host round trip)."
 ).boolean_conf(True)
 
+FUSION_ACROSS_SHUFFLE = conf("spark.rapids.sql.fusion.acrossShuffle").doc(
+    "Extend stage-segment fusion THROUGH shuffled joins and shuffle "
+    "reads: a fused segment takes a shuffled join's streamed probe side "
+    "as its stream child (the co-partition build side enters the program "
+    "per reduce partition), segments and final aggregates over an "
+    "exchange consume RAW shuffle pieces and concat them inside their "
+    "one program, so reduce-side merge + probe + aggregate (+ the next "
+    "exchange's partition step) launch once per coalesced partition "
+    "group.  Escape hatch for the fused-across-shuffle reduce path; "
+    "per-op execution is identical with it off."
+).boolean_conf(True)
+
+SHUFFLE_PIPELINE_ENABLED = conf("spark.rapids.shuffle.pipeline.enabled").doc(
+    "Pipeline consecutive exchanges: run the map side's child iteration "
+    "(the previous stage's reduce fetch + compute) on a producer thread "
+    "bounded by the fetch in-flight byte window so wire framing/serialize "
+    "overlaps it, and prefetch the next coalesced stream group on the "
+    "fused reduce path.  Counter-proven by pipeline_overlap_ns / "
+    "stage_drain_ns (shuffle/stats.py)."
+).boolean_conf(True)
+
 CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentGpuTasks").doc(
     "Number of tasks that can hold the device semaphore concurrently "
     "(reference: RapidsConf.scala:637, GpuSemaphore)."
@@ -791,6 +812,14 @@ class RapidsConf:
     @property
     def fuse_stages(self) -> bool:
         return self.get(STAGE_FUSION)
+
+    @property
+    def fusion_across_shuffle(self) -> bool:
+        return self.get(FUSION_ACROSS_SHUFFLE)
+
+    @property
+    def shuffle_pipeline_enabled(self) -> bool:
+        return self.get(SHUFFLE_PIPELINE_ENABLED)
 
     @property
     def multithreaded_read_threads(self) -> int:

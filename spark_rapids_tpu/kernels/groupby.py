@@ -98,11 +98,23 @@ def group_rows(
     batch: ColumnarBatch,
     key_cols: Sequence[int],
     string_max_bytes: Optional[int] = None,
+    allow_split_groups: bool = False,
 ) -> GroupedLayout:
     """Sort rows by keys and delimit groups.
 
     string_max_bytes must cover the longest live string key or distinct
     groups silently merge; None derives it from the data (host sync).
+
+    ``allow_split_groups``: sort string keys by ONE hashed key each
+    instead of their full chunk sequence — ceil(max_bytes/7) sort passes
+    per string column collapse to one (the q25 partial-agg wall: 4 string
+    group keys × 128-byte bucket was ~130 lexsort passes per batch).
+    Group BOUNDARIES still compare the actual bytes, so distinct keys
+    can never merge; a rare hash collision interleaves two keys in one
+    hash run and SPLITS a group into several segments instead.  Valid
+    ONLY for consumers whose downstream re-merges equal keys — the
+    partial aggregate step, whose per-batch partials meet the final/merge
+    step exactly like partials of different batches always have.
     """
     if string_max_bytes is None:
         from spark_rapids_tpu.kernels import strings as strkern
@@ -114,7 +126,8 @@ def group_rows(
     nb = ColumnarBatch(tuple(cols), batch.num_rows, batch.schema)
 
     orders = [SortOrder(True, True) for _ in key_cols]
-    idx = sort_indices(nb, key_cols, orders, string_max_bytes)
+    idx = sort_indices(nb, key_cols, orders, string_max_bytes,
+                       hash_string_keys=allow_split_groups)
     sb = gather_batch(nb, idx, nb.num_rows)
 
     live = sb.live_mask()

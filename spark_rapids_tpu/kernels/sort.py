@@ -180,24 +180,48 @@ def _string_data_keys(col: DeviceColumn, order: SortOrder, max_bytes: int) -> Li
     return keys
 
 
+def _string_hash_key(col: DeviceColumn, max_bytes: int) -> jax.Array:
+    """ONE uint64 GROUPING key per string column: an FNV-1a-style fold of
+    the lexicographic chunk keys.  Equal strings always hash equal;
+    distinct strings may collide — so this key is ONLY valid for callers
+    that need EQUAL-KEYS-CONTIGUOUS rather than byte order, and whose
+    group boundaries re-verify the actual bytes (groupby's exact
+    adjacent-row compare).  A collision then SPLITS a group (stable sort
+    interleaves the colliding values), it can never merge two groups —
+    split-tolerant consumers (partial aggregation, whose per-batch
+    partials merge again downstream) trade that for sorting 1 key pass
+    per string column instead of ceil(max_bytes/7) passes."""
+    h = jnp.full((col.capacity,), jnp.uint64(14695981039346656037))
+    for chunk in _string_data_keys(col, SortOrder(True), max_bytes):
+        h = (h ^ chunk) * jnp.uint64(1099511628211)
+    return jnp.where(col.validity, h, jnp.uint64(0))
+
+
 def sort_indices(
     batch: ColumnarBatch,
     key_cols: Sequence[int],
     orders: Sequence[SortOrder],
     string_max_bytes: Optional[int] = None,
+    hash_string_keys: bool = False,
 ) -> jax.Array:
     """Stable argsort of live rows by the given keys; padding rows at end.
     Returns int32 [capacity] gather indices.
 
     string_max_bytes must cover the longest live string key or ordering
-    truncates; None derives it from the data (host sync)."""
+    truncates; None derives it from the data (host sync).
+
+    ``hash_string_keys``: sort strings by ONE hashed key each instead of
+    their chunk sequence — equal-keys-contiguous (up to rare collision
+    SPLITS), not byte order; see _string_hash_key for the contract."""
     if string_max_bytes is None:
         from spark_rapids_tpu.kernels import strings as strkern
         string_max_bytes = strkern.live_string_bucket_for_batch(batch, key_cols)
     keys = []  # least significant first (jnp.lexsort: last key is primary)
     for ci, order in zip(reversed(list(key_cols)), reversed(list(orders))):
         col = batch.columns[ci]
-        if col.is_string_like:
+        if col.is_string_like and hash_string_keys:
+            keys.append(_string_hash_key(col, string_max_bytes))
+        elif col.is_string_like:
             for chunk in reversed(_string_data_keys(col, order, string_max_bytes)):
                 keys.append(chunk)
         elif col.is_struct and isinstance(col.dtype, T.DecimalType):

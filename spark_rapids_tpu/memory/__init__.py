@@ -54,9 +54,11 @@ def initialize_memory(conf) -> None:
     set_network_retry(conf.network_retry_max_attempts,
                       conf.network_retry_base_delay,
                       conf.network_retry_max_delay)
-    from spark_rapids_tpu.shuffle.transport import (set_range_serialize,
+    from spark_rapids_tpu.shuffle.transport import (set_pipeline_enabled,
+                                                    set_range_serialize,
                                                     set_replication)
     set_range_serialize(conf.shuffle_range_serialize)
+    set_pipeline_enabled(conf.shuffle_pipeline_enabled)
     set_replication(conf.shuffle_replication_factor,
                     conf.shuffle_persist_dir,
                     conf.cluster_drain_timeout)

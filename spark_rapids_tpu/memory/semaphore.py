@@ -117,15 +117,24 @@ class TpuSemaphore:
         self._tls = threading.local()
 
     def held_count(self) -> int:
-        """This thread's reentrant hold count (0 for non-task threads)."""
-        return getattr(self._tls, "held", 0)
+        """This thread's reentrant hold count, INCLUDING a borrowed
+        cover (0 for non-task threads)."""
+        return (getattr(self._tls, "held", 0)
+                + getattr(self._tls, "covered", 0))
 
     def acquire_if_necessary(self, priority: int = 0) -> None:
+        if getattr(self._tls, "covered", 0) > 0:
+            return   # riding the spawning task's slot (borrowed_cover)
         if getattr(self._tls, "held", 0) == 0:
             self._sem.acquire(priority)
         self._tls.held = getattr(self._tls, "held", 0) + 1
 
     def release_if_necessary(self) -> None:
+        if getattr(self._tls, "covered", 0) > 0:
+            # the slot belongs to the spawning task: a covered worker's
+            # release (e.g. a scan dropping the device during host work)
+            # must not free a permit this thread never took
+            return
         held = getattr(self._tls, "held", 0)
         if held <= 0:
             return
@@ -140,6 +149,26 @@ class TpuSemaphore:
             yield
         finally:
             self.release_if_necessary()
+
+    @contextmanager
+    def borrowed_cover(self):
+        """Mark this WORKER thread as covered by its spawning task's
+        slot: acquire_if_necessary/release_if_necessary become NO-OPS
+        for the block (no permit taken — and, critically, none
+        RELEASED: the cover is tracked separately from the real held
+        count so a covered scan's release-during-host-work can never
+        free the consumer task's permit).  For pipeline producer threads
+        (shuffle/pipeline.py) doing device work ON BEHALF of a task that
+        already holds a slot and is blocked waiting for this producer's
+        output — taking a second permit there deadlocks the moment every
+        permit is held by such blocked consumers (parquet scan inside a
+        pipelined exchange map side)."""
+        prev = getattr(self._tls, "covered", 0)
+        self._tls.covered = prev + 1
+        try:
+            yield
+        finally:
+            self._tls.covered = prev
 
 
 #: thread-ambient device priority: the serving layer sets it around a

@@ -511,8 +511,13 @@ class _AggDeviceSpec:
         work_names = tuple(f"c{i}" for i in range(len(work_cols)))
         work = ColumnarBatch(tuple(work_cols), batch.num_rows,
                              Schema(work_names, tuple(c.dtype for c in work_cols)))
+        # split-tolerant fast grouping: the partial step's per-batch
+        # groups merge again at the final/merge step, so string keys sort
+        # by one hashed pass each (a collision splits a group — exactly
+        # what a batch boundary does anyway); boundaries stay byte-exact
         layout = G.group_rows(work, list(range(nkeys)),
-                              string_max_bytes=string_bucket)
+                              string_max_bytes=string_bucket,
+                              allow_split_groups=True)
         out_keys = G.group_keys_output(layout, list(range(nkeys)))
         cols = list(out_keys)
         for ai, slot in self.slot_specs:
@@ -779,7 +784,13 @@ class TpuHashAggregateExec(TpuExec):
                  agg_exprs: Sequence[Expression],
                  aggregates: List[AggregateFunction],
                  child: TpuExec, schema: Schema, mode: str = "complete",
-                 target_capacity: int = 1 << 20):
+                 target_capacity: int = 1 << 20,
+                 fuse_across_shuffle: bool = True):
+        #: final mode over an exchange/reader: consume RAW shuffle pieces
+        #: and run concat + merge + finalize as ONE program per reduce
+        #: partition (the reduce-side merge joins the aggregate program;
+        #: spark.rapids.sql.fusion.acrossShuffle)
+        self.fuse_across_shuffle = fuse_across_shuffle
         self.group_exprs = tuple(group_exprs)
         self.agg_exprs = tuple(agg_exprs)
         self.aggregates = list(aggregates)
@@ -902,7 +913,52 @@ class TpuHashAggregateExec(TpuExec):
         return with_retry_no_split(
             lambda: self._jit_merge(concat_batches_jit(partials, cap)))
 
+    def _execute_final_fused(self, idx: int) -> Iterator[ColumnarBatch]:
+        """Final mode over a shuffle: ONE program per reduce partition —
+        the partition's raw wire/cache pieces concat + merge + finalize
+        inside _jit_combine, pin-balanced per attempt
+        (coalesce.retry_over_stream_pieces), instead of the exchange
+        merging groups first and the combine concatenating them again.
+        Oversized partitions fall back to the default path (out-of-core
+        sub-partition merge)."""
+        from spark_rapids_tpu.plan.execs.coalesce import (
+            retry_over_stream_pieces)
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+        with timed(self.op_time):
+            # accumulate with an INCREMENTAL size check: the moment the
+            # partition exceeds the in-core bound, stop pulling, DROP
+            # what was pulled (wire pieces hold real device batches —
+            # keeping them across the re-read would double residency on
+            # exactly the oversized path the fallback protects), and let
+            # the default path's out-of-core merge re-read the partition
+            pieces, total, oversized = [], 0, False
+            for p in self.children[0].stream_pieces(idx):
+                pieces.append(p)
+                total += p.capacity
+                if total > self.target_capacity:
+                    oversized = True
+                    del pieces, p
+                    break
+        if oversized:
+            yield from self._execute_default(idx)
+            return
+        if not pieces:
+            return
+        with timed(self.op_time):
+            out = retry_over_stream_pieces(
+                [pieces], lambda mats: self._jit_combine(mats[0]))
+        SHUFFLE_COUNTERS.add(fused_reduce_programs=1)
+        self.output_rows.add(out.num_rows)
+        yield self._count_out(out)
+
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
+        if (self.mode == "final" and self.fuse_across_shuffle
+                and hasattr(self.children[0], "stream_pieces")):
+            yield from self._execute_final_fused(idx)
+            return
+        yield from self._execute_default(idx)
+
+    def _execute_default(self, idx: int) -> Iterator[ColumnarBatch]:
         with timed(self.op_time):
             partials = self._partials_for(idx)
             if self.mode == "partial":

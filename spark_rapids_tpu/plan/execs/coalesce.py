@@ -132,6 +132,46 @@ def retry_over_spillable(handles, body):
     return with_retry_no_split(attempt)
 
 
+def retry_over_stream_pieces(piece_lists, body):
+    """``body(lists of materialized batches)`` under with_retry_no_split
+    with PIN-BALANCED attempts over shuffle StreamPieces
+    (shuffle/transport.py).
+
+    The fused-across-shuffle reduce path concats its stream group and its
+    per-partition build pieces INSIDE one program, so the pieces must be
+    device-resident for exactly the attempt: every attempt materializes
+    each piece (pin +1 on spillable handles) and ALWAYS unpins its own
+    pins before the attempt ends — the retry_over_spillable discipline
+    generalized to piece lists with the coalesce moved into the caller's
+    program.  A mid-attempt OOM therefore leaves every piece spillable,
+    so the spill can free exactly the inputs the next attempt will bring
+    back.
+
+    ``body`` must not keep the materialized batches alive past its
+    return; piece ownership (close) stays with the transport.
+    """
+    from spark_rapids_tpu.memory.retry import with_retry_no_split
+
+    piece_lists = [list(lst) for lst in piece_lists]
+
+    def attempt():
+        pinned = []
+        try:
+            mats = []
+            for lst in piece_lists:
+                cur = []
+                for p in lst:
+                    cur.append(p.materialize_pinned())
+                    pinned.append(p)
+                mats.append(cur)
+            return body(mats)
+        finally:
+            for p in pinned:
+                p.unpin()
+
+    return with_retry_no_split(attempt)
+
+
 def coalesce_to_one(batches: List[ColumnarBatch]) -> Optional[ColumnarBatch]:
     """Concat same-schema batches into one (None for empty input)."""
     if not batches:

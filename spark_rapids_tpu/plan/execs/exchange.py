@@ -88,6 +88,10 @@ class TpuShuffleExchangeExec(TpuExec):
         self.target_rows = max(int(target_rows), 1)
         self._lock = threading.Lock()
         self._transport = None   # built lazily per query (the SPI seam)
+        #: materialization generation: bumped on cleanup so epoch-keyed
+        #: consumers (SharedCoalesceSpec) never serve groups computed from
+        #: a previous execution's map statistics
+        self._epoch = 0
         # per-partition row stats cost a host sync per piece: collected
         # only when an AQE coalescing spec registered interest
         self._want_part_stats = False
@@ -213,24 +217,77 @@ class TpuShuffleExchangeExec(TpuExec):
     def _materialize(self):
         """Run the map side once, writing slices through the transport SPI
         (RapidsShuffleTransport.scala:303 analog — the data plane is
-        pluggable; this exec never touches its storage)."""
+        pluggable; this exec never touches its storage).
+
+        On wire transports the map generator (child compute + device
+        partition + download — which includes the UPSTREAM exchange's
+        reduce fetch when stages are consecutive) runs on a producer
+        thread bounded by the fetch in-flight byte window, so this
+        exchange's host framing/serialize overlaps the previous stage's
+        reduce instead of draining the pipeline at every hand-off
+        (shuffle/pipeline.py; counter-proven by stage_drain_ns)."""
+        import jax as _jax
+
         from spark_rapids_tpu.shuffle.serializer import range_supported
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
         from spark_rapids_tpu.shuffle.transport import (
-            make_transport, range_serialize_enabled)
+            CacheOnlyTransport, fetch_window_bytes, make_transport,
+            pipeline_enabled, range_serialize_enabled)
         with self._lock:
             if self._transport is None:
+                SHUFFLE_COUNTERS.add(exchange_stages=1)
                 t = make_transport(self.mode, self.out_partitions,
                                    self.schema, self.writer_threads,
                                    self.codec)
+                pipe = (pipeline_enabled()
+                        and not isinstance(t, CacheOnlyTransport))
+
+                def nbytes(item) -> int:
+                    return sum(getattr(x, "nbytes", 0)
+                               for x in _jax.tree_util.tree_leaves(item))
+
                 if (t.supports_range_write and range_serialize_enabled()
                         and range_supported(self.schema)):
-                    t.write_batches(self._range_stream())
+                    gen = self._range_stream()
+                    if pipe:
+                        from spark_rapids_tpu.shuffle.pipeline import (
+                            pipelined)
+                        gen = pipelined(gen, nbytes, fetch_window_bytes(),
+                                        name="exchange-map-range")
+                    t.write_batches(gen)
                 else:
-                    t.write(self._slices())
+                    gen = self._slices()
+                    if pipe:
+                        from spark_rapids_tpu.shuffle.pipeline import (
+                            pipelined)
+                        gen = pipelined(gen, nbytes, fetch_window_bytes(),
+                                        name="exchange-map-slices")
+                    t.write(gen)
                 self._transport = t
             return self._transport
 
     # -- reduce side --------------------------------------------------------
+
+    @property
+    def coalesce_target_rows(self) -> int:
+        return self.target_rows
+
+    def stream_pieces(self, idx: int):
+        """Raw reduce pieces for the fused-across-shuffle path
+        (plan/fused.py): StreamPiece items (shuffle/transport.py) with NO
+        merge/concat — the fused consumer concats them INSIDE its one
+        program per coalesced partition group, pin-balanced via
+        coalesce.retry_over_stream_pieces.  execute_partition() remains
+        the merged path for per-op consumers."""
+        transport = self._materialize()
+        it = iter(transport.read_pieces(idx, target_rows=self.target_rows))
+        while True:
+            with timed(self.op_time):
+                try:
+                    piece = next(it)
+                except StopIteration:
+                    return
+            yield piece
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         """Reduce side: coalesce fetched slices up to the batch target and
@@ -290,6 +347,7 @@ class TpuShuffleExchangeExec(TpuExec):
             if self._transport is not None:
                 self._transport.cleanup()
                 self._transport = None
+                self._epoch += 1
         super().cleanup()
 
     def describe(self):
@@ -312,6 +370,7 @@ class SharedCoalesceSpec:
         self.target_rows = max(int(target_rows), 1)
         self.exchanges: List[TpuShuffleExchangeExec] = []
         self._groups: Optional[List[List[int]]] = None
+        self._epoch_key: Optional[tuple] = None
         self._lock = threading.Lock()
 
     def register(self, ex: "TpuShuffleExchangeExec") -> None:
@@ -319,8 +378,18 @@ class SharedCoalesceSpec:
         self.exchanges.append(ex)     # post-pass runs pre-execution)
 
     def groups(self) -> List[List[int]]:
+        # materialize OUTSIDE the spec lock: each exchange's own lock
+        # makes this idempotent, and concurrent readers (serving-layer
+        # submissions, engine partition tasks) must not serialize behind
+        # one reader holding the spec lock across the whole map side
+        for ex in self.exchanges:
+            ex._materialize()
+        # groups are memoized PER EXCHANGE EPOCH: a re-executed plan
+        # (cleanup bumped the epochs) re-plans from the fresh map
+        # statistics instead of serving the previous run's grouping
+        key = tuple(ex._epoch for ex in self.exchanges)
         with self._lock:
-            if self._groups is not None:
+            if self._groups is not None and self._epoch_key == key:
                 return self._groups
             counts = None
             for ex in self.exchanges:
@@ -340,9 +409,9 @@ class SharedCoalesceSpec:
                 # call-order assumption.
                 sids = sorted(ex._transport.shuffle_id
                               for ex in self.exchanges)
-                key = "aqe:" + "-".join(map(str, sids))
-                client.publish(key, counts)
-                counts = client.fetch_global(key)
+                stats_key = "aqe:" + "-".join(map(str, sids))
+                client.publish(stats_key, counts)
+                counts = client.fetch_global(stats_key)
             groups: List[List[int]] = []
             cur: List[int] = []
             acc = 0
@@ -358,6 +427,7 @@ class SharedCoalesceSpec:
             if not groups:
                 groups = [[p] for p in range(len(counts))]
             self._groups = groups
+            self._epoch_key = key
             return groups
 
 
@@ -376,6 +446,17 @@ class TpuCoalescedShuffleReaderExec(TpuExec):
 
     def num_partitions(self) -> int:
         return len(self.spec.groups())
+
+    @property
+    def coalesce_target_rows(self) -> int:
+        return self.children[0].coalesce_target_rows
+
+    def stream_pieces(self, idx: int):
+        """Raw pieces of every member partition of coalesced group
+        ``idx`` (fused-across-shuffle path; see the exchange's
+        stream_pieces)."""
+        for p in self.spec.groups()[idx]:
+            yield from self.children[0].stream_pieces(p)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
         for p in self.spec.groups()[idx]:

@@ -395,6 +395,12 @@ class TpuShuffledHashJoinExec(TpuExec):
         self.left_key_idx = [self._ordinal(k, left.schema) for k in left_keys]
         self.right_key_idx = [self._ordinal(k, right.schema) for k in right_keys]
         self.condition = condition
+        # stashed side schemas: segment fusion (plan/fused.py) detaches
+        # chain nodes from their children, but the out-of-core fallback
+        # still runs THIS node's per-op machinery — which must not reach
+        # through self.children for schema
+        self.left_schema = left.schema
+        self.right_schema = right.schema
         self._kernel = _JoinKernel(self.left_key_idx, self.right_key_idx,
                                    join_type, schema,
                                    left_schema=left.schema,
@@ -421,13 +427,13 @@ class TpuShuffledHashJoinExec(TpuExec):
             if self.join_type in ("inner", "left", "left_semi", "left_anti",
                                   "cross", "existence"):
                 return None
-            left = ColumnarBatch.empty(self.children[0].schema)
+            left = ColumnarBatch.empty(self.left_schema)
         if right is None:
             if self.join_type in ("inner", "right", "cross", "left_semi"):
                 return None
             # left/full/anti/existence still emit left rows against an
             # empty build side
-            right = ColumnarBatch.empty(self.children[1].schema)
+            right = ColumnarBatch.empty(self.right_schema)
         return self._kernel(left, right)
 
     def execute_partition(self, idx: int) -> Iterator[ColumnarBatch]:
@@ -519,11 +525,11 @@ class TpuShuffledHashJoinExec(TpuExec):
         with timed(self.op_time):
             lbuckets = sub_partition_spillable(
                 iter(left_batches), self.left_key_idx, n_b,
-                self.children[0].schema)
+                self.left_schema)
             del left_batches
             rbuckets = sub_partition_spillable(
                 iter(right_batches), self.right_key_idx, n_b,
-                self.children[1].schema)
+                self.right_schema)
             del right_batches
         try:
             for lq, rq in zip(lbuckets, rbuckets):
@@ -704,7 +710,10 @@ class TpuAdaptiveJoinExec(TpuExec):
                  writer_threads: int = 4, codec: str = "none",
                  target_rows: int = 1 << 20,
                  condition: Optional[Expression] = None,
-                 shuffle_mode: str = "CACHE_ONLY"):
+                 shuffle_mode: str = "CACHE_ONLY",
+                 aqe_coalesce: bool = True,
+                 fuse_inner: bool = False,
+                 fuse_across_shuffle: bool = True):
         super().__init__((left, right), schema)
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
@@ -716,6 +725,15 @@ class TpuAdaptiveJoinExec(TpuExec):
         self.codec = codec
         self.target_rows = target_rows
         self.shuffle_mode = shuffle_mode
+        #: the planner's post-passes (AQE reader insertion, segment
+        #: fusion) run at PLAN time and never see the exchanges/join this
+        #: node creates at runtime — without re-applying them here, the
+        #: worst query shapes (q25's fact-fact join lands exactly in the
+        #: adaptive ambiguous zone) pay per-op launches for every reduce
+        #: partition while the rest of the plan is fused
+        self.aqe_coalesce = aqe_coalesce
+        self.fuse_inner = fuse_inner
+        self.fuse_across_shuffle = fuse_across_shuffle
         self._lock = threading.Lock()
         self._inner: Optional[TpuExec] = None
         self.chosen: Optional[str] = None   # exposed for tests/explain
@@ -787,11 +805,30 @@ class TpuAdaptiveJoinExec(TpuExec):
                     mode=self.shuffle_mode,
                     writer_threads=self.writer_threads, codec=self.codec,
                     target_rows=self.target_rows)
-                self._inner = TpuShuffledHashJoinExec(
-                    lex, rex, self.left_keys, self.right_keys,
+                jl: TpuExec = lex
+                jr: TpuExec = rex
+                if self.aqe_coalesce:
+                    # the runtime exchanges deserve the same AQE partition
+                    # coalescing the plan-time pass gives planned shuffled
+                    # joins (one SHARED spec keeps co-partitioning)
+                    from spark_rapids_tpu.plan.execs.exchange import (
+                        SharedCoalesceSpec, TpuCoalescedShuffleReaderExec)
+                    spec = SharedCoalesceSpec(self.target_rows)
+                    jl = TpuCoalescedShuffleReaderExec(lex, spec)
+                    jr = TpuCoalescedShuffleReaderExec(rex, spec)
+                inner: TpuExec = TpuShuffledHashJoinExec(
+                    jl, jr, self.left_keys, self.right_keys,
                     self.join_type, self.schema,
                     target_rows=self.target_rows,
                     condition=self.condition)
+                if self.fuse_inner:
+                    # re-apply segment fusion over the runtime tree so the
+                    # reduce side runs fused (across the shuffle when the
+                    # join qualifies) instead of per-op
+                    from spark_rapids_tpu.plan.fused import fuse_segments
+                    inner = fuse_segments(
+                        inner, across_shuffle=self.fuse_across_shuffle)
+                self._inner = inner
             return self._inner
 
     def num_partitions(self) -> int:

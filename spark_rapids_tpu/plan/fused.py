@@ -136,11 +136,39 @@ def _fusable(node: TpuExec) -> bool:
     return False
 
 
-def fuse_segments(root: TpuExec, conf) -> TpuExec:
+def _fusable_shuffled_join(node: TpuExec) -> bool:
+    """Can this SHUFFLED join be a fused segment's stream-side tail?
+
+    The fused program runs the join per coalesced probe-side group
+    against the full co-partition build, so the join type must decompose
+    by probe rows (the join's own _LEFT_SPLITTABLE contract minus
+    ``existence``, which the fused emitter does not lower) and the
+    condition must be empty (the conditional path is a multi-program
+    shape).  The build side's size is a RUNTIME property — an oversized
+    partition falls back to the per-op out-of-core path at execution."""
+    from spark_rapids_tpu.plan.execs.join import TpuShuffledHashJoinExec
+    return (isinstance(node, TpuShuffledHashJoinExec)
+            and node.condition is None
+            and bool(node.left_key_idx)
+            and node.join_type in ("inner", "left", "left_semi",
+                                   "left_anti"))
+
+
+def fuse_segments(root: TpuExec, conf=None,
+                  across_shuffle: Optional[bool] = None) -> TpuExec:
     """Planner post-pass: wrap maximal fusable chains (top-down greedy).
 
     Runs after AQE reader insertion and before LORE wrapping.  Skipped for
-    ICI/SPMD sessions (parallel/stage.py fuses the whole query instead)."""
+    ICI/SPMD sessions (parallel/stage.py fuses the whole query instead).
+
+    ``across_shuffle`` (spark.rapids.sql.fusion.acrossShuffle): extend
+    segments THROUGH shuffled joins — the join becomes the chain's tail,
+    its streamed probe side the segment's stream child and its
+    co-partition build a per-partition program argument — and let
+    segments whose stream child is an exchange/reader consume RAW shuffle
+    pieces, so reduce-side merge + probe + aggregate (+ the next
+    exchange's partition step) run as ONE program per coalesced
+    partition group (ROADMAP open item 1)."""
     from spark_rapids_tpu.plan.execs.join import TpuBroadcastHashJoinExec
 
     from spark_rapids_tpu.plan.execs.exchange import (
@@ -148,6 +176,10 @@ def fuse_segments(root: TpuExec, conf) -> TpuExec:
         TpuSinglePartitionExec)
     from spark_rapids_tpu.plan.execs.join import (
         TpuAdaptiveJoinExec, TpuShuffledHashJoinExec)
+
+    if across_shuffle is None:
+        across_shuffle = (conf.fusion_across_shuffle
+                          if conf is not None else True)
 
     # a stream child on the far side of a shuffle: fusing even a single
     # op above it is worth a segment — the reduce side then runs ONE
@@ -159,23 +191,44 @@ def fuse_segments(root: TpuExec, conf) -> TpuExec:
                          TpuSinglePartitionExec, TpuShuffledHashJoinExec,
                          TpuAdaptiveJoinExec)
 
-    def visit(node: TpuExec) -> TpuExec:
-        if _fusable(node):
+    def visit(node: TpuExec, under_exchange: bool = False) -> TpuExec:
+        fusable_top = _fusable(node) or (
+            across_shuffle and _fusable_shuffled_join(node))
+        if fusable_top:
             chain = [node]
             cur = node
-            while cur.children and _fusable(cur.children[0]):
-                cur = cur.children[0]
-                chain.append(cur)
-            n_joins = sum(isinstance(n, TpuBroadcastHashJoinExec)
+            if not _fusable_shuffled_join(node):
+                while cur.children and _fusable(cur.children[0]):
+                    cur = cur.children[0]
+                    chain.append(cur)
+                if (across_shuffle and cur.children
+                        and _fusable_shuffled_join(cur.children[0])):
+                    # the shuffled join joins the chain as its TAIL: its
+                    # probe (left) child becomes the stream child, its
+                    # build (right) child a per-partition build input
+                    cur = cur.children[0]
+                    chain.append(cur)
+            n_joins = sum(isinstance(n, (TpuBroadcastHashJoinExec,
+                                         TpuShuffledHashJoinExec))
                           for n in chain)
             crosses_shuffle = bool(cur.children) and isinstance(
                 cur.children[0], _SHUFFLE_BOUNDARY)
-            if n_joins >= 1 or len(chain) >= 2 or crosses_shuffle:
+            # a single-op chain directly under an exchange is worth a
+            # segment too: the exchange's fused map path then folds the
+            # op INTO the partition/slice program (one launch per map
+            # batch instead of op + slice), closing the standalone-launch
+            # gap on the map side of the next shuffle
+            if (n_joins >= 1 or len(chain) >= 2 or crosses_shuffle
+                    or under_exchange):
                 stream_child = visit(cur.children[0])
                 builds = [visit(n.children[1]) for n in chain
-                          if isinstance(n, TpuBroadcastHashJoinExec)]
-                return TpuFusedSegmentExec(chain, stream_child, builds)
-        node.children = tuple(visit(c) for c in node.children)
+                          if isinstance(n, (TpuBroadcastHashJoinExec,
+                                            TpuShuffledHashJoinExec))]
+                return TpuFusedSegmentExec(chain, stream_child, builds,
+                                           across_shuffle=across_shuffle)
+        is_exchange = isinstance(node, TpuShuffleExchangeExec)
+        node.children = tuple(visit(c, under_exchange=is_exchange)
+                              for c in node.children)
         return node
 
     return visit(root)
@@ -190,14 +243,16 @@ def unfuse_segments(root: TpuExec) -> TpuExec:
     IciQueryExecutor unfuse first instead of dying on UnsupportedSpmd
     (the fusion pass is keyed to the executing backend, not the session
     shuffle mode)."""
-    from spark_rapids_tpu.plan.execs.join import TpuBroadcastHashJoinExec
+    from spark_rapids_tpu.plan.execs.join import (
+        TpuBroadcastHashJoinExec, TpuShuffledHashJoinExec)
 
     def visit(node: TpuExec) -> TpuExec:
         if isinstance(node, TpuFusedSegmentExec):
             cur = visit(node.children[0])
             builds = [visit(b) for b in node.children[1:]]
             for n in reversed(node.chain):       # bottom-up re-link
-                if isinstance(n, TpuBroadcastHashJoinExec):
+                if isinstance(n, (TpuBroadcastHashJoinExec,
+                                  TpuShuffledHashJoinExec)):
                     n.children = (cur,
                                   builds[node._join_build_ix[id(n)]])
                 else:
@@ -218,20 +273,37 @@ class TpuFusedSegmentExec(TpuExec):
     """
 
     def __init__(self, chain: List[TpuExec], stream_child: TpuExec,
-                 builds: List[TpuExec]):
-        from spark_rapids_tpu.plan.execs.join import TpuBroadcastHashJoinExec
+                 builds: List[TpuExec], across_shuffle: bool = True):
+        from spark_rapids_tpu.plan.execs.join import (
+            TpuBroadcastHashJoinExec, TpuShuffledHashJoinExec)
         super().__init__((stream_child,) + tuple(builds), chain[0].schema)
         self.chain = chain
+        self.across_shuffle = across_shuffle
         self._lock = threading.Lock()
-        self._build_batches: Optional[List[ColumnarBatch]] = None
+        self._build_batches: Optional[List[Optional[ColumnarBatch]]] = None
         self._build_bytes = 0
-        # join node -> build argument index, in chain order
+        # join node -> build argument index, in chain order.  A SHUFFLED
+        # join's build is per-PARTITION ("part"): materialized per reduce
+        # partition from its co-partition reader, entering the program as
+        # a tuple of pieces concatenated in-trace.  Broadcast builds
+        # ("bcast") materialize once for all partitions, as before.
         self._join_build_ix: Dict[int, int] = {}
+        self._build_kind: List[str] = []
+        self._shuffled_join: Optional[TpuShuffledHashJoinExec] = None
         bi = 0
         for n in chain:
-            if isinstance(n, TpuBroadcastHashJoinExec):
+            if isinstance(n, (TpuBroadcastHashJoinExec,
+                              TpuShuffledHashJoinExec)):
                 self._join_build_ix[id(n)] = bi
+                self._build_kind.append(
+                    "part" if isinstance(n, TpuShuffledHashJoinExec)
+                    else "bcast")
+                if isinstance(n, TpuShuffledHashJoinExec):
+                    self._shuffled_join = n
                 bi += 1
+        assert self._shuffled_join is None or \
+            chain[-1] is self._shuffled_join, \
+            "a shuffled join fuses only as the chain tail"
         self._lit_bytes = self._collect_literal_bytes()
         # string columns ANYWHERE in the segment (stream, builds, or an
         # intermediate schema) force a non-zero bucket floor: the join and
@@ -275,9 +347,14 @@ class TpuFusedSegmentExec(TpuExec):
             # the STREAM schema must key the program too: chain-identical
             # segments over different stream schemas read different
             # string-ordinal feedback (the r5 fuzz cross-query cache
-            # pollution — a DATE column indexed as variable-width)
+            # pollution — a DATE column indexed as variable-width).  Build
+            # schemas likewise: the per-plane byte-capacity tags are laid
+            # out from the build columns' nested offset paths.
             stream = schema_cache_key(self.children[0].schema)
-            self._sig = "fused[" + ">".join(parts) + f"|stream={stream}]"
+            builds = ";".join(schema_cache_key(b.schema)
+                              for b in self.children[1:])
+            self._sig = ("fused[" + ">".join(parts)
+                         + f"|stream={stream}|builds={builds}]")
         return self._sig
 
     def _all_exprs(self) -> List[Expression]:
@@ -296,13 +373,19 @@ class TpuFusedSegmentExec(TpuExec):
     def num_partitions(self) -> int:
         return self.children[0].num_partitions()
 
-    def _materialize_builds(self) -> List[ColumnarBatch]:
+    def _materialize_builds(self) -> List[Optional[ColumnarBatch]]:
+        """Broadcast builds, materialized once for all partitions.  A
+        shuffled join's per-partition build slot stays None here — it is
+        filled per reduce partition by _partition_build_pieces."""
         from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
         with self._lock:
             if self._build_batches is None:
-                outs: List[ColumnarBatch] = []
+                outs: List[Optional[ColumnarBatch]] = []
                 mb = 0
-                for b in self.children[1:]:
+                for bi, b in enumerate(self.children[1:]):
+                    if self._build_kind[bi] == "part":
+                        outs.append(None)
+                        continue
                     batches = []
                     for p in range(b.num_partitions()):
                         batches.extend(b.execute_partition(p))
@@ -331,16 +414,72 @@ class TpuFusedSegmentExec(TpuExec):
 
     # -- execution ----------------------------------------------------------
 
+    def _uses_stream_pieces(self) -> bool:
+        """True when the stream child is an exchange/reader whose RAW
+        pieces this segment can concat inside its own program (the
+        reduce-side merge joins the fused program; across-shuffle path)."""
+        return (self.across_shuffle
+                and hasattr(self.children[0], "stream_pieces"))
+
+    def _stream_groups(self, idx: int):
+        """Coalesced piece groups of stream partition ``idx``, bounded by
+        the exchange's batch target.  The piece pull (stage k's reduce
+        fetch / unspill) runs on a lookahead thread bounded by the fetch
+        in-flight byte window, so it overlaps this segment's device
+        compute (shuffle/pipeline.py)."""
+        from spark_rapids_tpu.shuffle.transport import (fetch_window_bytes,
+                                                        pipeline_enabled)
+        target = max(int(getattr(self.children[0], "coalesce_target_rows",
+                                 1 << 20)), 1)
+        pieces = self.children[0].stream_pieces(idx)
+        if pipeline_enabled():
+            from spark_rapids_tpu.shuffle.pipeline import pipelined
+            pieces = pipelined(pieces, lambda p: p.nbytes,
+                               fetch_window_bytes(),
+                               name="fused-stream-prefetch")
+        group, acc = [], 0
+        for piece in pieces:
+            if group and acc + piece.capacity > target:
+                yield group
+                group, acc = [], 0
+            group.append(piece)
+            acc += piece.capacity
+        if group:
+            yield group
+
+    def _partition_build_pieces(self, idx: int) -> Dict[int, list]:
+        """Per-partition build inputs for the chain's shuffled join:
+        build-slot index -> this reduce partition's co-partition pieces."""
+        from spark_rapids_tpu.shuffle.transport import StreamPiece
+        out: Dict[int, list] = {}
+        for bi, root in enumerate(self.children[1:]):
+            if self._build_kind[bi] != "part":
+                continue
+            if self.across_shuffle and hasattr(root, "stream_pieces"):
+                pieces = list(root.stream_pieces(idx))
+            else:
+                pieces = [StreamPiece.of_batch(b)
+                          for b in root.execute_partition(idx)]
+            if not pieces:
+                pieces = [StreamPiece.of_batch(
+                    ColumnarBatch.empty(root.schema))]
+            out[bi] = pieces
+        return out
+
+    def _fuse_build_limit(self) -> int:
+        join = self._shuffled_join
+        return max(int(join.target_rows), 1) if join is not None \
+            else (1 << 62)
+
     def execute_partition(self, idx: int):
         from spark_rapids_tpu.plan.execs.aggregate import TpuHashAggregateExec
         from spark_rapids_tpu.plan.execs.coalesce import maybe_shrink
-        builds = self._materialize_builds()
         shrink = not isinstance(self.chain[0], TpuHashAggregateExec)
-        for batch in self.children[0].execute_partition(idx):
-            with timed(self.op_time):
-                out, _counts = self._run(batch, builds)
-                if shrink:
-                    out = maybe_shrink(out)
+
+        def finish(out):
+            return maybe_shrink(out) if shrink else out
+
+        for out in self._execute_fused(idx, slice_spec=None, finish=finish):
             self.output_rows.add(out.num_rows)
             yield self._count_out(out)
 
@@ -350,29 +489,160 @@ class TpuFusedSegmentExec(TpuExec):
         key-append + hash-partition run in the SAME program; yields
         (reordered_batch, host_counts) per input batch with ONE combined
         device fetch (feedback + per-partition counts)."""
-        builds = self._materialize_builds()
         spec = (tuple(keys), int(n_out), exchange_sig)
-        for batch in self.children[0].execute_partition(idx):
-            with timed(self.op_time):
-                out, counts = self._run(batch, builds, slice_spec=spec)
+        for out, counts in self._execute_fused(idx, slice_spec=spec):
             self.output_rows.add(out.num_rows)
             self.output_batches.add(1)
             yield out, counts
 
-    def _run(self, batch: ColumnarBatch, builds: List[ColumnarBatch],
-             slice_spec=None):
+    def _execute_fused(self, idx: int, slice_spec=None, finish=None):
+        """Common driver for both execute paths.  Without slice_spec it
+        yields finished output batches (through ``finish``); with one it
+        yields (reordered_batch, host_counts) pairs."""
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+        builds = self._materialize_builds()
+        part_pieces = self._partition_build_pieces(idx)
+        if part_pieces:
+            limit = self._fuse_build_limit()
+            if any(sum(p.capacity for p in pieces) > limit
+                   for pieces in part_pieces.values()):
+                # the co-partition build side outgrew the in-program
+                # bound (hot-key skew): this partition runs the per-op
+                # out-of-core join, with the rest of the chain still
+                # fused above it
+                SHUFFLE_COUNTERS.add(fused_reduce_fallbacks=1)
+                yield from self._execute_fallback(
+                    idx, part_pieces, slice_spec=slice_spec, finish=finish)
+                return
+        if self._uses_stream_pieces():
+            for group in self._stream_groups(idx):
+                with timed(self.op_time):
+                    full = self._assemble_builds(builds, part_pieces)
+                    out, counts = self._run(group, full,
+                                            slice_spec=slice_spec)
+                SHUFFLE_COUNTERS.add(fused_reduce_programs=1)
+                yield (out, counts) if slice_spec is not None \
+                    else finish(out)
+            return
+        for batch in self.children[0].execute_partition(idx):
+            with timed(self.op_time):
+                full = self._assemble_builds(builds, part_pieces)
+                out, counts = self._run(batch, full, slice_spec=slice_spec)
+            yield (out, counts) if slice_spec is not None else finish(out)
+
+    @staticmethod
+    def _assemble_builds(builds, part_pieces):
+        """Build argument list: broadcast batches + per-partition piece
+        lists in slot order."""
+        return [part_pieces[bi] if b is None else b
+                for bi, b in enumerate(builds)]
+
+    def _execute_fallback(self, idx: int, part_pieces, slice_spec=None,
+                          finish=None):
+        """Oversized co-partition build: run the shuffled join through
+        its own per-op machinery (sub-partitioned spillable co-buckets,
+        skew-aware splits) and keep the REST of the chain fused — each
+        join output batch runs the above-join program (which still folds
+        the next exchange's partition step when sliced).
+
+        The materialized inputs stay pinned through the join by the same
+        contract as the per-op path (the OOC sub-partitioning reads them
+        exactly once up front)."""
+        join = self._shuffled_join
+        assert join is not None and len(part_pieces) == 1
+        (bi, build_pieces), = part_pieces.items()
+        chain_above = self.chain[:-1]
+        # the shuffled join is the chain tail, so its build slot is the
+        # last one: everything before it is the above-chain's builds
+        builds_above = self._materialize_builds()[:bi]
+        stream_pieces = (list(self.children[0].stream_pieces(idx))
+                         if self._uses_stream_pieces() else None)
+        pinned = []
+        try:
+            if stream_pieces is not None:
+                left_batches = []
+                for p in stream_pieces:
+                    # tpu-lint: allow-retry-discipline(inputs stay pinned through the OOC sub-partition pass, which reads them exactly once up front; unpinned in the finally)
+                    left_batches.append(p.materialize_pinned())
+                    pinned.append(p)
+            else:
+                left_batches = list(self.children[0].execute_partition(idx))
+            right_batches = []
+            for p in build_pieces:
+                # tpu-lint: allow-retry-discipline(inputs stay pinned through the OOC sub-partition pass, which reads them exactly once up front; unpinned in the finally)
+                right_batches.append(p.materialize_pinned())
+                pinned.append(p)
+            total = (sum(b.capacity for b in left_batches)
+                     + sum(b.capacity for b in right_batches))
+            for jb in join._execute_out_of_core(left_batches, right_batches,
+                                                total):
+                if not chain_above and slice_spec is None:
+                    yield finish(jb)
+                    continue
+                with timed(self.op_time):
+                    out, counts = self._run(
+                        jb, builds_above, slice_spec=slice_spec,
+                        chain=chain_above,
+                        sig=self.signature() + "|above")
+                yield (out, counts) if slice_spec is not None \
+                    else finish(out)
+        finally:
+            for p in pinned:
+                p.unpin()
+
+    def _run(self, stream, builds, slice_spec=None, chain=None, sig=None):
+        """Converge-and-execute one program call.
+
+        ``stream`` is a single ColumnarBatch (per-batch path) or a LIST
+        of StreamPieces (across-shuffle path: the group concats inside
+        the program).  ``builds`` entries are broadcast batches or
+        per-partition StreamPiece lists (likewise concatenated
+        in-trace).  Pieces are materialized PIN-BALANCED per retry
+        attempt (coalesce.retry_over_stream_pieces), so a mid-attempt
+        OOM's spill can free exactly the inputs the next attempt brings
+        back."""
         from spark_rapids_tpu.kernels import strings as SK
         from spark_rapids_tpu.memory.arena import TpuSplitAndRetryOOM
-        sig = self.signature()
+        from spark_rapids_tpu.plan.execs.coalesce import (
+            retry_over_stream_pieces)
+        if chain is None:
+            chain = self.chain
+        base_sig = sig if sig is not None else self.signature()
+        sig = base_sig
         if slice_spec is not None:
             sig += f"|slice={slice_spec[2]}|{slice_spec[1]}"
         with _FUSED_CAPS_LOCK:
-            bucket = max(_FUSED_BUCKET.get(self.signature(), 0),
+            bucket = max(_FUSED_BUCKET.get(base_sig, 0),
                          self._bucket_floor())
         if self._consts is None:
             self._consts = tuple(jnp.asarray(a) for a in
                                  collect_trace_consts(self._all_exprs()))
         from spark_rapids_tpu.plan.execs.base import alias_shared_jit
+        group_mode = isinstance(stream, list)
+        builds = list(builds)
+        piece_build_ixs = [i for i, b in enumerate(builds)
+                           if isinstance(b, list)]
+        piece_lists = ([stream] if group_mode else []) + \
+            [builds[i] for i in piece_build_ixs]
+
+        def invoke(fn):
+            if not piece_lists:
+                return with_retry_no_split(
+                    lambda: fn(stream, tuple(builds), self._consts))
+
+            def body(mats):
+                k = 0
+                s = stream
+                if group_mode:
+                    s = tuple(mats[0])
+                    k = 1
+                bs = list(builds)
+                for i in piece_build_ixs:
+                    bs[i] = tuple(mats[k])
+                    k += 1
+                return fn(s, tuple(bs), self._consts)
+            return retry_over_stream_pieces(piece_lists, body)
+
         caps_key = None
         caps: Dict[str, int] = {}
         for _ in range(24):
@@ -385,9 +655,9 @@ class TpuFusedSegmentExec(TpuExec):
                         _FUSED_CAPS.move_to_end(caps_key)
             build_key = f"{caps_key}|caps={sorted(caps.items())}"
             fn = shared_jit(build_key,
-                            lambda: self._make(bucket, caps, slice_spec))
-            out, counts, fb = with_retry_no_split(
-                lambda: fn(batch, tuple(builds), self._consts))
+                            lambda: self._make(bucket, caps, slice_spec,
+                                               chain))
+            out, counts, fb = invoke(fn)
             # tpu-lint: allow-host-sync(overflow feedback must reach the host; one batched sync per attempt)
             fetched, host_counts = jax.device_get((fb, counts))
             observed = int(fetched.pop("__stream_bytes", 0))
@@ -395,10 +665,11 @@ class TpuFusedSegmentExec(TpuExec):
                 need = SK.bucket_for(max(observed, self._build_bytes,
                                          self._lit_bytes, 1))
                 if need > bucket:
-                    # bucket speculation too small (a live stream string
-                    # exceeds the window): discard, re-run larger
+                    # bucket speculation too small (a live stream or
+                    # co-partition build string exceeds the window):
+                    # discard, re-run larger
                     with _FUSED_CAPS_LOCK:
-                        _remember_bucket(self.signature(), need)
+                        _remember_bucket(base_sig, need)
                     bucket = need
                     continue
             escalated = False
@@ -421,15 +692,16 @@ class TpuFusedSegmentExec(TpuExec):
                 _FUSED_CAPS.move_to_end(caps_key)
                 if len(_FUSED_CAPS) > _FUSED_CAPS_MAX:
                     _FUSED_CAPS.popitem(last=False)
-                _remember_bucket(self.signature(), bucket)
+                _remember_bucket(base_sig, bucket)
             return out, host_counts
         raise TpuSplitAndRetryOOM(
             "fused segment capacities did not converge")
 
     # -- traceable program --------------------------------------------------
 
-    def _make(self, bucket: int, caps: Dict[str, int], slice_spec=None):
-        """Build the traceable fn(stream_batch, builds, consts).
+    def _make(self, bucket: int, caps: Dict[str, int], slice_spec=None,
+              chain=None):
+        """Build the traceable fn(stream, builds, consts).
 
         ``caps`` is mutated at trace time via setdefault (the SPMD
         _Caps.get discipline): identical plan+shapes derive identical
@@ -439,10 +711,18 @@ class TpuFusedSegmentExec(TpuExec):
         contract): cache entries outlive queries, and self.children pins
         the stream subtree's device batches.  It closes over the detached
         chain nodes + the build-index map only."""
+        # the program's stream input is the stream child's output for the
+        # full chain, but the SHUFFLED JOIN's output for the fallback's
+        # above-join chain — the string-ordinal feedback must index the
+        # schema the program actually receives
+        stream_schema = (self.children[0].schema
+                         if chain is None or chain is self.chain
+                         else self._shuffled_join.schema)
         stream_string_ords = tuple(
-            i for i, d in enumerate(self.children[0].schema.dtypes)
+            i for i, d in enumerate(stream_schema.dtypes)
             if getattr(d, "variable_width", False))
-        return _make_program(list(self.chain), dict(self._join_build_ix),
+        return _make_program(list(self.chain if chain is None else chain),
+                             dict(self._join_build_ix),
                              self._all_exprs(), bucket, caps,
                              slice_spec=slice_spec,
                              stream_string_ords=stream_string_ords)
@@ -467,27 +747,65 @@ class TpuFusedSegmentExec(TpuExec):
         return "\n".join(lines)
 
 
+def _concat_in_trace(batches: tuple) -> ColumnarBatch:
+    """Concat a pytree tuple of batches INSIDE the traced program (the
+    reduce-side merge fused into the compute program).  Capacity is the
+    static sum of the inputs' capacities, so the concat can never
+    overflow and needs no feedback."""
+    from spark_rapids_tpu.kernels.selection import concat_batches_device
+    if len(batches) == 1:
+        return batches[0]
+    cap = round_up_pow2(max(sum(b.capacity for b in batches), 1))
+    # tpu-lint: allow-retry-discipline(traced body of the fused program; every call site dispatches under with_retry_no_split via _run's invoke)
+    out, _ = concat_batches_device(list(batches), cap)
+    return out
+
+
 def _make_program(chain: List[TpuExec], join_build_ix: Dict[int, int],
                   exprs: List[Expression], bucket: int,
                   caps: Dict[str, int], slice_spec=None,
                   stream_string_ords: Tuple[int, ...] = ()):
-    """Traceable fn(stream_batch, builds, consts) -> (out, counts, fb).
+    """Traceable fn(stream, builds, consts) -> (out, counts, fb).
+
+    ``stream`` is one batch or a TUPLE of batches (a coalesced shuffle
+    group, concatenated in-trace — the reduce-side merge as part of the
+    same program).  ``builds`` entries are one batch (broadcast) or a
+    tuple of co-partition pieces (a shuffled join's per-partition build,
+    also concatenated in-trace).
 
     ``slice_spec`` = (keys, n_out, sig): additionally run the shuffle
     exchange's key-append + hash-partition INSIDE the program, returning
     per-partition counts (None otherwise).  ``stream_string_ords``: the
-    stream's variable-width columns, whose live byte max is reported in
+    stream's variable-width columns; their live byte max — together with
+    every tuple-build's variable-width columns — is reported in
     feedback["__stream_bytes"] to validate the speculative bucket."""
 
-    def fn(stream: ColumnarBatch, builds: tuple, consts: tuple):
+    def fn(stream, builds: tuple, consts: tuple):
         from spark_rapids_tpu.kernels.strings import max_live_string_bytes
         cmap = bind_trace_consts(exprs, consts)
         feedback: Dict[str, jax.Array] = {}
-        if stream_string_ords:
-            feedback["__stream_bytes"] = jnp.max(jnp.stack(
-                [jnp.asarray(max_live_string_bytes(stream.columns[i],
-                                                   stream.num_rows))
-                 for i in stream_string_ords])).astype(jnp.int64)
+        part_builds = [i for i, b in enumerate(builds)
+                       if isinstance(b, tuple)]
+        builds = tuple(_concat_in_trace(b) if isinstance(b, tuple) else b
+                       for b in builds)
+        if isinstance(stream, tuple):
+            stream = _concat_in_trace(stream)
+        byte_obs = [jnp.asarray(max_live_string_bytes(stream.columns[i],
+                                                      stream.num_rows))
+                    for i in stream_string_ords]
+        for i in part_builds:
+            # a per-partition build's string bytes are only known at
+            # execution: validate them through the same speculative-
+            # bucket feedback as the stream side
+            b = builds[i]
+            byte_obs.extend(
+                jnp.asarray(max_live_string_bytes(b.columns[ci],
+                                                  b.num_rows))
+                for ci, d in enumerate(b.schema.dtypes)
+                if getattr(d, "variable_width", False))
+        if byte_obs:
+            feedback["__stream_bytes"] = jnp.max(
+                jnp.stack(byte_obs)).astype(jnp.int64)
         cur = stream
         for pos in range(len(chain) - 1, -1, -1):
             cur = _emit_one(chain[pos], pos, cur, builds, join_build_ix,
@@ -518,7 +836,8 @@ def _emit_one(node, pos: int, cur: ColumnarBatch, builds: tuple,
     from spark_rapids_tpu.plan.execs.aggregate import TpuHashAggregateExec
     from spark_rapids_tpu.plan.execs.basic import (
         TpuFilterExec, TpuProjectExec)
-    from spark_rapids_tpu.plan.execs.join import TpuBroadcastHashJoinExec
+    from spark_rapids_tpu.plan.execs.join import (
+        TpuBroadcastHashJoinExec, TpuShuffledHashJoinExec)
 
     if isinstance(node, TpuProjectExec):
         ctx = EvalContext(cur, trace_consts=cmap)
@@ -532,7 +851,10 @@ def _emit_one(node, pos: int, cur: ColumnarBatch, builds: tuple,
         indices, count = compaction_map(mask)
         return gather_batch(cur, indices, count)
 
-    if isinstance(node, TpuBroadcastHashJoinExec):
+    if isinstance(node, (TpuBroadcastHashJoinExec, TpuShuffledHashJoinExec)):
+        # the shuffled join lowers through the SAME gather-map emitter as
+        # the broadcast join: its "build" is simply this reduce
+        # partition's co-partition side instead of a global broadcast
         return _emit_join(node, pos, cur, builds[join_build_ix[id(node)]],
                           bucket, caps, feedback)
 

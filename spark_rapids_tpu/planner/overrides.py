@@ -983,7 +983,14 @@ class PlanMeta:
                 codec=self.conf.shuffle_codec,
                 target_rows=self.conf.batch_size_rows,
                 condition=p.condition,
-                shuffle_mode=mode)
+                shuffle_mode=mode,
+                aqe_coalesce=self.conf.aqe_coalesce_partitions,
+                # the runtime-shuffled decision re-applies the planner's
+                # post-passes over the tree it builds (plan-time fusion
+                # cannot see it); same gating as plan_query's fusion pass
+                fuse_inner=(self.conf.fuse_stages
+                            and self.conf.shuffle_mode != "ICI"),
+                fuse_across_shuffle=self.conf.fusion_across_shuffle)
         if p.join_type == "cross" or not p.left_keys:
             # cartesian / nested-loop: candidate pairs must see every
             # right row, so both sides collapse to one partition
@@ -1027,7 +1034,8 @@ class PlanMeta:
             exchange = TpuSinglePartitionExec(partial)
         return TpuHashAggregateExec(
             p.group_exprs, p.agg_exprs, p.aggregates, exchange, p.schema,
-            mode="final", target_capacity=self.conf.batch_size_rows)
+            mode="final", target_capacity=self.conf.batch_size_rows,
+            fuse_across_shuffle=self.conf.fusion_across_shuffle)
 
     def _exchange(self, nparts, keys, child) -> TpuExec:
         mode = self.conf.shuffle_mode

@@ -1901,6 +1901,16 @@ class TcpShuffleTransport:
             if out is not None:
                 yield out
 
+    def read_pieces(self, partition: int,
+                    target_rows: Optional[int] = None):
+        """Piece stream for the fused reduce path: the flow-controlled
+        fetch + merge already bounds and uploads here, so pieces are the
+        merged device batches (the fused program still folds its concat
+        and compute into one launch per coalesced group)."""
+        from spark_rapids_tpu.shuffle.transport import StreamPiece
+        for b in self.read_iter(partition, target_rows=target_rows):
+            yield StreamPiece.of_batch(b)
+
     def read(self, partition: int) -> List[ColumnarBatch]:
         return list(self.read_iter(partition))
 

@@ -1,0 +1,164 @@
+"""Producer-thread pipelining for consecutive exchange stages.
+
+The task engine's lazy materialization runs stage k+1's map side as one
+serial loop over stage k's reduce output: while the map side frames and
+serializes a batch, the reduce fetch plane sits idle, and vice versa —
+the pipeline drains at every hand-off (ROADMAP open item 1; Theseus's
+thesis in PAPERS.md is that distributed query speed is won on exactly
+this data-movement overlap).
+
+``pipelined(gen)`` moves the PRODUCER side of such a hand-off onto a
+background thread with a byte-bounded hand-off queue (the shuffle fetch
+in-flight window bounds residency, shuffle/transport.py), so:
+
+  * map framing/serialize of stage k+1 overlaps stage k's reduce fetch
+    and compute (exchange._materialize wraps its map generator);
+  * the fused reduce path prefetches the NEXT coalesced group's pieces
+    while the current group's program runs (plan/fused.py).
+
+Counters make the overlap checkable (shuffle/stats.py):
+  * ``pipeline_overlap_ns`` — production time of items that were already
+    waiting when the consumer asked (work that genuinely ran under the
+    consumer's own processing);
+  * ``stage_drain_ns`` — time the consumer blocked on an empty queue
+    AFTER the first item (pipeline-fill excluded): ≈0 means the producer
+    kept ahead and the stage hand-off never drained.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, Iterator, Optional
+
+from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+
+_SENTINEL = object()
+
+
+class _Pipe:
+    """Byte-bounded single-producer/single-consumer hand-off."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max(int(max_bytes), 1)
+        self._cv = threading.Condition()
+        self._items = []           # (item, nbytes, produce_ns)
+        self._bytes = 0
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._closed = False       # consumer abandoned the stream
+
+    # -- producer side ------------------------------------------------------
+
+    def put(self, item, nbytes: int, produce_ns: int) -> bool:
+        with self._cv:
+            while (self._bytes >= self.max_bytes and self._items
+                   and not self._closed):
+                self._cv.wait(0.1)
+            if self._closed:
+                return False
+            self._items.append((item, nbytes, produce_ns))
+            self._bytes += nbytes
+            self._cv.notify_all()
+            return True
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        with self._cv:
+            self._error = error
+            self._done = True
+            self._cv.notify_all()
+
+    # -- consumer side ------------------------------------------------------
+
+    def get(self):
+        """(item, produce_ns, waited_ns) or (_SENTINEL, 0, waited_ns)."""
+        t0 = time.perf_counter_ns()
+        with self._cv:
+            while not self._items and not self._done:
+                self._cv.wait(0.1)
+            waited = time.perf_counter_ns() - t0
+            if self._items:
+                item, nbytes, produce_ns = self._items.pop(0)
+                self._bytes -= nbytes
+                self._cv.notify_all()
+                return item, produce_ns, waited
+            if self._error is not None:
+                raise self._error
+            return _SENTINEL, 0, waited
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+def pipelined(source: Iterable, nbytes_of: Callable[[object], int],
+              max_inflight_bytes: int,
+              name: str = "shuffle-pipeline") -> Iterator:
+    """Yield ``source``'s items, produced ahead on a background thread.
+
+    The producer inherits the caller's tenant scope and task priority
+    (its device allocations must charge the submitting query, exactly
+    like the engine's partition-pool threads).  Exceptions from the
+    source re-raise at the consumer's next pull; an abandoned consumer
+    (generator closed early) stops the producer at its next hand-off.
+    """
+    from contextlib import nullcontext
+
+    from spark_rapids_tpu.memory.semaphore import (current_task_priority,
+                                                   task_priority,
+                                                   tpu_semaphore)
+    from spark_rapids_tpu.memory.tenant import TENANTS
+
+    pipe = _Pipe(max_inflight_bytes)
+    tenant = TENANTS.current()
+    priority = current_task_priority()
+    # the producer works ON BEHALF of the calling task: when that task
+    # holds a device-semaphore slot, the producer rides it instead of
+    # taking a second one — the consumer blocks on this queue while
+    # holding its slot, so a producer-side acquire deadlocks once every
+    # slot is held by such blocked consumers (the reference's shuffle
+    # writer threads skip the GPU semaphore for the same reason)
+    covered = tpu_semaphore().held_count() > 0
+
+    def produce():
+        try:
+            cover = (tpu_semaphore().borrowed_cover() if covered
+                     else nullcontext())
+            with TENANTS.scope(tenant), task_priority(priority), cover:
+                it = iter(source)
+                while True:
+                    t0 = time.perf_counter_ns()
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
+                    dt = time.perf_counter_ns() - t0
+                    if not pipe.put(item, max(nbytes_of(item), 1), dt):
+                        break      # consumer gone: stop producing
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            pipe.finish(e)
+        else:
+            pipe.finish()
+
+    t = threading.Thread(target=produce, name=name, daemon=True)
+    t.start()
+    first = True
+    try:
+        while True:
+            item, produce_ns, waited_ns = pipe.get()
+            if item is _SENTINEL:
+                return
+            if first:
+                first = False   # pipeline fill, not a stage drain
+            elif waited_ns > produce_ns:
+                # the producer could not keep ahead: the hand-off drained
+                # for the part of the wait its own production can't cover
+                SHUFFLE_COUNTERS.add(stage_drain_ns=waited_ns - produce_ns)
+            if waited_ns < produce_ns:
+                # this item's production ran (at least partly) while the
+                # consumer was busy with earlier items — true overlap
+                SHUFFLE_COUNTERS.add(
+                    pipeline_overlap_ns=produce_ns - waited_ns)
+            yield item
+    finally:
+        pipe.close()

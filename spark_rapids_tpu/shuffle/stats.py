@@ -23,6 +23,20 @@ _FIELDS = (
     "bytes_fetched",          # wire bytes received over the network
     # overlap
     "prefetch_stall_ns",      # consumer blocked on an empty prefetch queue
+    # pipelined exchanges + reduce-side fusion (shuffle/pipeline.py +
+    # plan/fused.py; ROADMAP open item 1)
+    "pipeline_overlap_ns",    # producer work that ran WHILE the consumer
+                              # of a stage hand-off was busy (true overlap
+                              # of map compute/serialize with reduce fetch)
+    "stage_drain_ns",         # consumer blocked on an empty stage hand-off
+                              # after pipeline fill (≈0 = never drained)
+    "fused_reduce_programs",  # fused-across-shuffle program executions
+                              # (merge + probe + agg + next-map-slice as
+                              # ONE program per coalesced partition group)
+    "fused_reduce_fallbacks", # partitions that fell back to the per-op
+                              # join path (build side over the fuse limit)
+    "exchange_stages",        # exchanges materialized (launches-per-stage
+                              # = launches / exchange_stages in bench)
     # map side (range-serialization write path; serializer.py)
     "map_range_batches",      # map batches written via range framing
     "map_range_blocks",       # partition wire blocks framed from row ranges

@@ -33,6 +33,46 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
 
 
+class StreamPiece:
+    """One reduce-partition shuffle piece deliverable WITHOUT merging.
+
+    The fused-across-shuffle reduce path (plan/fused.py) concats pieces
+    INSIDE its one program per coalesced partition, so the transport's own
+    merge/concat pass never runs.  A piece wraps either a spillable handle
+    (CACHE_ONLY — the piece stays spillable between uses; consumers
+    materialize pin-balanced via coalesce.retry_over_stream_pieces) or an
+    already-device batch (wire transports pay their host->device upload in
+    read_iter regardless)."""
+
+    __slots__ = ("capacity", "nbytes", "_handle", "_batch")
+
+    def __init__(self, capacity: int, nbytes: int, handle=None, batch=None):
+        assert (handle is None) != (batch is None)
+        self.capacity = int(capacity)   # static row capacity (grouping)
+        self.nbytes = int(nbytes)       # in-flight byte accounting
+        self._handle = handle
+        self._batch = batch
+
+    @classmethod
+    def of_batch(cls, batch: ColumnarBatch) -> "StreamPiece":
+        return cls(batch.capacity, batch.device_size_bytes(), batch=batch)
+
+    @classmethod
+    def of_handle(cls, handle, capacity: int) -> "StreamPiece":
+        return cls(capacity, handle.size_bytes, handle=handle)
+
+    def materialize_pinned(self) -> ColumnarBatch:
+        """Device batch for this piece; a spillable handle gains a pin the
+        caller MUST return via unpin() before its retry attempt ends."""
+        if self._handle is not None:
+            return self._handle.materialize()
+        return self._batch
+
+    def unpin(self) -> None:
+        if self._handle is not None:
+            self._handle.unpin()
+
+
 class ShuffleTransport(abc.ABC):
     """Store-and-forward data plane: map side writes (partition, batch)
     pieces; reduce side reads every piece for one partition."""
@@ -67,6 +107,16 @@ class ShuffleTransport(abc.ABC):
         with true incremental merge."""
         yield from self.read(partition)
 
+    def read_pieces(self, partition: int,
+                    target_rows: Optional[int] = None):
+        """Unmerged piece stream for the fused reduce path: StreamPiece
+        items the consumer concats INSIDE its own program.  Default wraps
+        read_iter's (already merged/uploaded) batches; CACHE_ONLY
+        overrides with the raw spillable handles so nothing merges or
+        pins ahead of the consumer's pin-balanced attempt."""
+        for b in self.read_iter(partition, target_rows=target_rows):
+            yield StreamPiece.of_batch(b)
+
     @abc.abstractmethod
     def read(self, partition: int) -> List[ColumnarBatch]:
         """All pieces routed to `partition`, as device batches."""
@@ -80,19 +130,27 @@ class CacheOnlyTransport(ShuffleTransport):
     """Device-resident spillable handles (CACHE_ONLY mode)."""
 
     def __init__(self, num_partitions: int):
+        #: per partition: (handle, static row capacity) — the capacity is
+        #: recorded at write time so the piece stream can group to the
+        #: consumer's coalesce target without materializing anything
         self._buckets: List[List] = [[] for _ in range(num_partitions)]
 
     def write(self, pieces):
         from spark_rapids_tpu.memory.spill import make_spillable
         for p, piece in pieces:
-            self._buckets[p].append(make_spillable(piece))
+            self._buckets[p].append((make_spillable(piece), piece.capacity))
 
     def read(self, partition: int) -> List[ColumnarBatch]:
-        return [h.materialize() for h in self._buckets[partition]]
+        return [h.materialize() for h, _cap in self._buckets[partition]]
+
+    def read_pieces(self, partition: int,
+                    target_rows: Optional[int] = None):
+        for h, cap in self._buckets[partition]:
+            yield StreamPiece.of_handle(h, cap)
 
     def cleanup(self) -> None:
         for bucket in self._buckets:
-            for h in bucket:
+            for h, _cap in bucket:
                 h.close()
             bucket.clear()
 
@@ -304,6 +362,26 @@ def set_range_serialize(enabled: bool) -> None:
 
 def range_serialize_enabled() -> bool:
     return _RANGE_SERIALIZE[0]
+
+
+#: pipelined exchanges (spark.rapids.shuffle.pipeline.enabled): run the
+#: map side's child iteration (stage k's reduce fetch + compute) on a
+#: producer thread bounded by the fetch in-flight byte window so the
+#: transport's framing/serialize overlaps it, and prefetch the next
+#: stream group on the fused reduce path.  Escape hatch, default on.
+_PIPELINE = [True]
+
+
+def set_pipeline_enabled(enabled: bool) -> None:
+    _PIPELINE[0] = bool(enabled)
+
+
+def pipeline_enabled() -> bool:
+    return _PIPELINE[0]
+
+
+def fetch_window_bytes() -> int:
+    return _fetch_window[0]
 
 
 #: map-output durability (spark.rapids.shuffle.replication.* +

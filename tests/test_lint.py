@@ -486,6 +486,84 @@ def test_retry_over_spillable_is_pin_balanced():
         h.close()
 
 
+def test_retry_checker_fires_on_bare_materialize_in_fused_program():
+    """Sub-rule (c): a fused reduce program materializing a spillable
+    piece outside the pin-balanced wrappers is flagged."""
+    src = _src("spark_rapids_tpu/plan/fused.py", """
+        def _execute_fused(self, pieces, fn):
+            mats = [p.materialize_pinned() for p in pieces]
+            return fn(mats)
+    """)
+    vs = retry_discipline.check([src])
+    assert any("pin-balanced wrapper" in v.message for v in vs)
+
+
+def test_retry_checker_accepts_pin_balanced_piece_idiom():
+    """The blessed idiom: materialization flows through
+    retry_over_stream_pieces / retry_over_spillable arguments."""
+    src = _src("spark_rapids_tpu/plan/fused.py", """
+        def _execute_fused(self, pieces, fn):
+            return retry_over_stream_pieces(
+                [pieces], lambda mats: fn(tuple(mats[0])))
+
+        def _other(self, handles, body):
+            return retry_over_spillable(
+                handles, lambda m: body(m.materialize()))
+    """)
+    assert [v for v in retry_discipline.check([src])
+            if "pin-balanced" in v.message] == []
+
+
+def test_fused_py_pin_rule_is_clean_or_reasoned():
+    """The real plan/fused.py passes sub-rule (c) (held-pin contracts
+    carry inline reasons)."""
+    src = lint_core.load_source(REPO, "spark_rapids_tpu/plan/fused.py")
+    vs = _unsuppressed(retry_discipline.check([src]), src)
+    assert vs == [], "\n".join(v.render() for v in vs)
+
+
+def test_retry_over_stream_pieces_is_pin_balanced():
+    """Piece-list twin of the retry_over_spillable contract: an injected
+    mid-attempt OOM leaves every piece unpinned and spillable."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.memory.arena import TpuRetryOOM
+    from spark_rapids_tpu.memory.spill import make_spillable
+    from spark_rapids_tpu.plan.execs.coalesce import (
+        retry_over_stream_pieces)
+    from spark_rapids_tpu.shuffle.transport import StreamPiece
+
+    def mkbatch(lo):
+        col = DeviceColumn(data=jnp.arange(lo, lo + 4, dtype=jnp.int64),
+                           validity=jnp.ones(4, bool), dtype=T.LONG)
+        return ColumnarBatch((col,), jnp.int32(4),
+                             Schema(("n",), (T.LONG,)))
+
+    handles = [make_spillable(mkbatch(0)), make_spillable(mkbatch(4))]
+    for h in handles:
+        h.unpin()
+    pieces = [StreamPiece.of_handle(h, 4) for h in handles]
+    base_pins = [h._pins for h in handles]
+    attempts = [0]
+
+    def body(mats):
+        attempts[0] += 1
+        assert len(mats) == 1 and len(mats[0]) == 2
+        if attempts[0] == 1:
+            raise TpuRetryOOM("injected mid-attempt")
+        return sum(int(m.num_rows) for m in mats[0])
+
+    assert retry_over_stream_pieces([pieces], body) == 8
+    assert attempts[0] == 2
+    assert [h._pins for h in handles] == base_pins, "pin leak on retry"
+    assert handles[0].spill_to_host() > 0   # still spillable
+    for h in handles:
+        h.close()
+
+
 # -- functional check of the lock fix (handoff semantics) --------------------
 
 def test_pooled_connection_close_does_not_wait_for_inflight():

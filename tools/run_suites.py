@@ -26,9 +26,11 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: suite -> (test files, timeout seconds).  Timeouts are ~2x observed
-#: wall on this container's CPU backend (memory: ~5x slower than the
-#: r5-era machines); --timeout-scale adjusts them wholesale.
+#: suite -> (test files, timeout seconds[, marker override]).  Timeouts
+#: are ~2x observed wall on this container's CPU backend (memory: ~5x
+#: slower than the r5-era machines); --timeout-scale adjusts them
+#: wholesale.  A suite with a marker override ignores -m (the pipeline
+#: suite runs its slow-marked tests, which tier-1 skips by budget).
 SUITES = {
     "shuffle": (["tests/test_net_shuffle.py", "tests/test_range_shuffle.py",
                  "tests/test_chaos.py", "tests/test_elastic.py"], 600),
@@ -42,6 +44,8 @@ SUITES = {
              "tests/test_memory.py"], 900),
     "gauntlet": (["tests/test_tpcds_gauntlet.py"], 1200),
     "serving": (["tests/test_serving.py", "tests/test_agg_tail.py"], 600),
+    "pipeline": (["tests/test_fused_shuffle.py", "tests/test_fused.py",
+                  "tests/test_aqe_coalesce.py"], 1200, ""),
     "lint": (["tests/test_lint.py"], 300),
 }
 
@@ -102,17 +106,20 @@ def main(argv=None) -> int:
                     help="pytest -m expression (default: 'not slow')")
     args = ap.parse_args(argv)
     if args.list:
-        for name, (files, tmo) in SUITES.items():
+        for name, spec in SUITES.items():
+            files, tmo = spec[0], spec[1]
             print(f"{name:10s} {tmo:5d}s  {' '.join(files)}")
         return 0
     names = args.suites or list(SUITES)
     unknown = [n for n in names if n not in SUITES]
     if unknown:
         ap.error(f"unknown suite(s) {unknown}; known: {sorted(SUITES)}")
-    extra = ["-m", args.marker] if args.marker else []
     results = []
     for name in names:
-        files, tmo = SUITES[name]
+        spec = SUITES[name]
+        files, tmo = spec[0], spec[1]
+        marker = spec[2] if len(spec) > 2 else args.marker
+        extra = ["-m", marker] if marker else []
         missing = [f for f in files
                    if not os.path.exists(os.path.join(REPO, f))]
         if missing:

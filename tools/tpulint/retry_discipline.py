@@ -40,6 +40,22 @@ MATERIALIZERS = {
 
 RETRY_WRAPPERS = {"with_retry", "with_retry_no_split", "with_capacity_retry"}
 
+# -- sub-rule (c): pin balance in fused reduce programs ----------------------
+#
+# The fused-across-shuffle reduce path materializes spillable shuffle
+# pieces for exactly one program attempt; the ONLY safe way is through a
+# pin-balanced wrapper (each attempt pins, runs, and ALWAYS unpins before
+# it ends — coalesce.retry_over_spillable / retry_over_stream_pieces).  A
+# bare handle.materialize()/piece.materialize_pinned() in plan/fused.py
+# either leaks a pin per retry attempt (handle permanently unspillable)
+# or holds HBM the retry's spill cannot free.  Deliberate held-pin
+# contracts (the out-of-core fallback keeps inputs pinned through the
+# join) carry an inline allow-retry-discipline with the reason.
+
+PIN_BALANCED_WRAPPERS = {"retry_over_spillable", "retry_over_stream_pieces"}
+MATERIALIZE_METHODS = {"materialize", "materialize_pinned"}
+FUSED_PROGRAM_FILES = ("spark_rapids_tpu/plan/fused.py",)
+
 SCOPE_PREFIXES = (
     "spark_rapids_tpu/plan/execs/",
     "spark_rapids_tpu/plan/fused.py",
@@ -206,11 +222,51 @@ def _closure_violations(idx: _Index, src: SourceFile) -> List[Violation]:
     return out
 
 
+class _PinIndex(ScopedVisitor):
+    """Materialize-method calls in a fused-program file, annotated with
+    whether they sit lexically inside a pin-balanced wrapper argument."""
+
+    def __init__(self):
+        super().__init__()
+        self.pin_arg_depth = 0
+        self.hits: List[dict] = []
+
+    def visit_Call(self, node: ast.Call):
+        name = _bare(dotted(node.func))
+        if name in PIN_BALANCED_WRAPPERS:
+            for sub in node.args + [kw.value for kw in node.keywords]:
+                self.pin_arg_depth += 1
+                self.visit(sub)
+                self.pin_arg_depth -= 1
+            self.visit(node.func)
+            return
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in MATERIALIZE_METHODS
+                and self.pin_arg_depth == 0):
+            self.hits.append({"line": node.lineno, "scope": self.scope,
+                              "name": node.func.attr})
+        self.generic_visit(node)
+
+
+def _pin_violations(src: SourceFile) -> List[Violation]:
+    idx = _PinIndex()
+    idx.visit(src.tree)
+    return [Violation(
+        RULE, src.path, h["line"], h["scope"],
+        f"{h['name']}() materializes a spillable piece in a fused reduce "
+        f"program outside a pin-balanced wrapper "
+        f"(retry_over_spillable/retry_over_stream_pieces); a mid-attempt "
+        f"OOM then leaks a pin or holds memory the spill cannot free")
+        for h in idx.hits]
+
+
 def check(sources: List[SourceFile]) -> List[Violation]:
     out: List[Violation] = []
     for src in sources:
         if not in_scope(src.path):
             continue
+        if src.path in FUSED_PROGRAM_FILES:
+            out.extend(_pin_violations(src))
         idx = _Index(src)
         idx.visit(src.tree)
         protected = _protected_functions(idx)

@@ -34,7 +34,9 @@ from typing import Dict, List, Optional, Tuple
 from spark_rapids_tpu.shuffle.net import (
     PeerClient, ShuffleExecutor, _recv_msg, _send_msg)
 from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
-from spark_rapids_tpu.utils.retry_budget import RetryBudget
+from spark_rapids_tpu.utils.cancel import CancelToken, QueryCancelled
+from spark_rapids_tpu.utils.retry_budget import (
+    RetryBudget, RetryBudgetExhausted)
 
 log = logging.getLogger(__name__)
 
@@ -119,6 +121,9 @@ class TpuClusterDriver:
         self._task_failures: Dict[int, List[dict]] = {}
         #: qid -> next query-unique attempt id (non-primary dispatches)
         self._attempt_seq: Dict[int, int] = {}
+        #: qid -> live CancelToken — the public cancel(query_id) handle;
+        #: registered by _submit_once for exactly the attempt's lifetime
+        self._cancel_tokens: Dict[int, CancelToken] = {}
         #: (query_id, key) -> {executor_id: [int, ...]} — the runtime
         #: statistics barrier adaptive decisions aggregate through
         self._stats: Dict[Tuple[int, str], Dict[str, List[int]]] = {}
@@ -275,9 +280,27 @@ class TpuClusterDriver:
             f"only {len(self.shuffle.registry.peers(workers_only=True))} "
             f"of {n} executors registered")
 
+    def cancel(self, query_id: int,
+               reason: str = "cancelled by caller") -> bool:
+        """Cooperatively cancel a RUNNING query by id: flips its token,
+        which the polling loop observes — executors get a cancel_query
+        broadcast, the attempt's shuffle state is dropped everywhere,
+        and the submitting caller gets a typed ``QueryCancelled``.
+        Returns False for an unknown/finished id."""
+        with self._lock:
+            token = self._cancel_tokens.get(query_id)
+        if token is None:
+            return False
+        return token.cancel(reason)
+
+    def active_queries(self) -> List[int]:
+        with self._lock:
+            return sorted(self._cancel_tokens)
+
     def submit(self, logical_plan, timeout_s: float = 300.0,
                max_retries: int = 1, conf: Optional[Dict[str, str]] = None,
-               deadline_s: Optional[float] = None) -> list:
+               deadline_s: Optional[float] = None,
+               cancel_token: Optional[CancelToken] = None) -> list:
         """Dispatch one logical plan to every registered executor; block
         for and combine their row results (rank order).
 
@@ -309,29 +332,54 @@ class TpuClusterDriver:
         (scoped_resubmits / task_retries / executors_excluded /
         shuffle_invalidations).
         """
+        effective_deadline = (deadline_s if deadline_s is not None
+                              else self.query_deadline_s)
         budget = RetryBudget(
             "cluster.submit", max_attempts=max_retries,
             base_delay_s=0.05, max_delay_s=1.0,
-            deadline_s=(deadline_s if deadline_s is not None
-                        else self.query_deadline_s))
-        while True:
-            try:
-                return self._submit_once(logical_plan, timeout_s,
-                                         conf_overrides=conf)
-            except ExecutorLostError as e:
-                self._recover_lost(e)
-                if not self.shuffle.registry.peers(workers_only=True):
-                    raise      # no survivors to retry on
-                budget.backoff(error=e)
-                SHUFFLE_COUNTERS.add(scoped_resubmits=1)
-                log.warning("query %d: resubmitting over survivors "
-                            "(lost %s)", e.query_id, e.lost)
-            except TaskRetryableError as e:
-                self._invalidate_query(e.query_id)
-                budget.backoff(error=e)
-                SHUFFLE_COUNTERS.add(task_retries=1)
-                log.warning("query %d: retrying after retryable task "
-                            "failure: %s", e.query_id, e)
+            deadline_s=effective_deadline)
+        # the cancel token (the serving layer hands its own down): the
+        # query's tasks inherit it on every executor, so cancel/deadline
+        # don't just bound the driver's wait — they STOP running work.
+        # The DRIVER-side deadline stays owned by the RetryBudget above
+        # (exhaustion names the budget, the PR 4 contract); the token
+        # carries no driver deadline of its own, but every dispatch
+        # ships the budget's REMAINING seconds so executor-side tokens
+        # self-cancel past it.  QueryCancelled is deliberately outside
+        # the retry clauses below: a cancelled query never resubmits.
+        owns_token = cancel_token is None
+        token = cancel_token if not owns_token else CancelToken(
+            label="cluster query")
+        try:
+            while True:
+                try:
+                    return self._submit_once(
+                        logical_plan, timeout_s, conf_overrides=conf,
+                        cancel_token=token, count_cancel=owns_token,
+                        deadline_remaining_s=budget.remaining_s())
+                except ExecutorLostError as e:
+                    self._recover_lost(e)
+                    if not self.shuffle.registry.peers(workers_only=True):
+                        raise      # no survivors to retry on
+                    budget.backoff(error=e)
+                    SHUFFLE_COUNTERS.add(scoped_resubmits=1)
+                    log.warning("query %d: resubmitting over survivors "
+                                "(lost %s)", e.query_id, e.lost)
+                except TaskRetryableError as e:
+                    self._invalidate_query(e.query_id)
+                    budget.backoff(error=e)
+                    SHUFFLE_COUNTERS.add(task_retries=1)
+                    log.warning("query %d: retrying after retryable task "
+                                "failure: %s", e.query_id, e)
+        finally:
+            # the token stays registered under EVERY attempt's qid for
+            # the WHOLE submission (attempts share one token, and a
+            # resubmit must not orphan the id a caller already read from
+            # active_queries()); all of them unregister together here
+            with self._lock:
+                for k in [k for k, t in self._cancel_tokens.items()
+                          if t is token]:
+                    del self._cancel_tokens[k]
 
     def _recover_lost(self, e: ExecutorLostError) -> None:
         """Scope the next attempt: exclude the lost executors from the
@@ -349,20 +397,54 @@ class TpuClusterDriver:
         """Broadcast drop_query to every live worker's block server (and
         the driver's own store): the torn-down attempt's shuffles must
         not leak in the BlockStore, and a resubmitted attempt's reads
-        must never be satisfied by its stale blocks."""
+        must never be satisfied by its stale blocks.
+
+        A per-peer failure is retried ONCE under the shared RetryBudget
+        discipline and then COUNTED (``drop_query_failures``) instead of
+        vanishing into a log line: residual stale state on an
+        unreachable peer is a real hazard the cluster stats must
+        surface (the peer may also be dying — its loss still surfaces
+        via the next attempt's heartbeat check)."""
         if query_id < 0:
             return
         dropped = self.shuffle.store.drop_query(query_id)
         for eid, addr in sorted(
                 self.shuffle.registry.peers(workers_only=True).items()):
-            try:
-                dropped += PeerClient(addr).drop_query(query_id)
-            except OSError as err:
-                # the survivor may be dying too; its loss surfaces via
-                # the next attempt's heartbeat check
-                log.warning("drop_query(%d) to %s failed: %s",
+            budget = RetryBudget(f"cluster.drop_query:{query_id}@{eid}",
+                                 max_attempts=1, base_delay_s=0.05,
+                                 max_delay_s=0.2)
+            while True:
+                try:
+                    dropped += PeerClient(addr).drop_query(query_id)
+                    break
+                except OSError as err:
+                    try:
+                        budget.backoff(error=err)
+                    except RetryBudgetExhausted:
+                        SHUFFLE_COUNTERS.add(drop_query_failures=1)
+                        log.warning(
+                            "drop_query(%d) to %s failed after retry "
+                            "(stale shuffle state may remain there): %s",
                             query_id, eid, err)
+                        break
         SHUFFLE_COUNTERS.add(shuffle_invalidations=dropped)
+
+    def _broadcast_cancel(self, query_id: int, reason: str) -> None:
+        """Fan cancel_query out to every live worker (the wire op beside
+        drop_query): each peer's CANCELS registry flips the query's
+        running task tokens, so work stops at the next batch boundary or
+        blessed wait instead of running to completion."""
+        SHUFFLE_COUNTERS.add(cancel_broadcasts=1)
+        for eid, addr in sorted(
+                self.shuffle.registry.peers(workers_only=True).items()):
+            try:
+                PeerClient(addr).cancel_query(query_id, reason)
+            except OSError as err:
+                # best effort: an unreachable peer's tasks die with it,
+                # and the drop_query broadcast still scrubs its blocks
+                # if it comes back
+                log.warning("cancel_query(%d) to %s failed: %s",
+                            query_id, eid, err)
 
     # -- attempt bookkeeping (all _locked helpers run under self._lock) ------
 
@@ -450,7 +532,10 @@ class TpuClusterDriver:
         return xs[idx]
 
     def _submit_once(self, logical_plan, timeout_s: float,
-                     conf_overrides: Optional[Dict[str, str]] = None
+                     conf_overrides: Optional[Dict[str, str]] = None,
+                     cancel_token: Optional[CancelToken] = None,
+                     count_cancel: bool = True,
+                     deadline_remaining_s: Optional[float] = None
                      ) -> list:
         from spark_rapids_tpu.config import RapidsConf
         executors = sorted(
@@ -466,10 +551,24 @@ class TpuClusterDriver:
         durable = rc.shuffle_replication_factor > 1
         spec_on = rc.speculation_enabled and world > 1
         plan_bytes = pickle.dumps(logical_plan)
+        # submit() always passes the token; the stand-alone default only
+        # serves direct _submit_once calls (tests/tooling)
+        token = cancel_token if cancel_token is not None else CancelToken(
+            label="cluster query")
+        # ``count_cancel``: when the token came from a HIGHER layer (the
+        # serving QueryQueue), that layer owns the queries_cancelled
+        # count — one cancelled query must count exactly once
+        # deadline PROPAGATION: ship the remaining budget with the task
+        # so each executor's own token self-cancels past it — a deadline
+        # stops remote work, it doesn't just bound the driver's wait
+        task_deadline = min(
+            [d for d in (timeout_s, deadline_remaining_s,
+                         token.remaining_s()) if d is not None])
         proto = {"world": world, "participants": executors,
                  # per-query conf (the registration broadcast is static;
                  # these override)
                  "conf_overrides": dict(conf_overrides or {}),
+                 "deadline_s": task_deadline,
                  "plan": plan_bytes}
         with self._lock:
             qid = self._next_query
@@ -479,18 +578,30 @@ class TpuClusterDriver:
             self._attempts[qid] = {}
             self._task_failures[qid] = []
             self._results[qid] = {}
+            self._cancel_tokens[qid] = token
+            # driver-owned tokens name the LIVE attempt's qid (a scoped
+            # resubmit re-labels, so stall reports and QueryCancelled
+            # messages never name a torn-down query id)
+            if token.label.startswith("cluster query"):
+                token.label = f"cluster query {qid}"
             for rank, eid in enumerate(executors):
                 self._dispatch_attempt_locked(qid, rank, eid, 0,
                                               "primary", proto)
         deadline = time.monotonic() + timeout_s
         lost_exc: Optional[ExecutorLostError] = None
         retry_exc: Optional[TaskRetryableError] = None
+        cancel_exc: Optional[QueryCancelled] = None
         fatal: Optional[str] = None
         excluded: set = set()
         spec_counted: set = set()
         durations: Dict[int, float] = {}
         try:
             while time.monotonic() < deadline:
+                try:
+                    token.check()
+                except QueryCancelled as e:
+                    cancel_exc = e
+                    break
                 live = self.shuffle.registry.peers(workers_only=True)
                 now = time.monotonic()
                 with self._lock:
@@ -620,6 +731,12 @@ class TpuClusterDriver:
                 self._attempts.pop(qid, None)
                 self._task_failures.pop(qid, None)
                 self._attempt_seq.pop(qid, None)
+                if cancel_token is None:
+                    # standalone call owning its own token; submit()'s
+                    # finally otherwise unregisters every attempt's qid
+                    # at once, so cancel(first_qid) works across scoped
+                    # resubmits
+                    self._cancel_tokens.pop(qid, None)
                 for k in [k for k in self._stats if k[0] == qid]:
                     self._stats.pop(k, None)
                 # drop any queued attempt of THIS query nobody picked up
@@ -631,6 +748,18 @@ class TpuClusterDriver:
                         self._tasks[eid] = q
                     else:
                         del self._tasks[eid]
+        if cancel_exc is not None:
+            # ONE idempotent teardown path: stop remote work (the
+            # cancel_query broadcast flips each peer's task tokens),
+            # then scrub the attempt's shuffle state everywhere —
+            # including replicas — so nothing leaks and no stale read
+            # can ever be satisfied.  Admission/tenant cleanup runs on
+            # the submitting layer's unwind as QueryCancelled propagates.
+            if count_cancel:
+                SHUFFLE_COUNTERS.add(queries_cancelled=1)
+            self._broadcast_cancel(qid, str(cancel_exc))
+            self._invalidate_query(qid)
+            raise cancel_exc
         if fatal is not None:
             raise RuntimeError(f"query {qid}: executor(s) failed: {fatal}")
         if retry_exc is not None:

@@ -23,6 +23,8 @@ from typing import Iterator, Tuple
 from spark_rapids_tpu.shuffle.net import _request
 from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
 from spark_rapids_tpu.testing.chaos import CHAOS, InjectedFault
+from spark_rapids_tpu.utils.cancel import (
+    CANCELS, CancelToken, QueryCancelled)
 
 log = logging.getLogger(__name__)
 
@@ -64,7 +66,12 @@ def _is_retryable_task_error(e: BaseException) -> bool:
     """Failures worth a driver-side scoped re-dispatch: injected faults
     and the OSError family (connection loss, fetch/budget exhaustion,
     corrupt blocks, lost peers) — transient by nature.  Anything else is
-    treated as a deterministic query error that a retry would repeat."""
+    treated as a deterministic query error that a retry would repeat.
+    A cancelled task is a DELIBERATE stop, never retryable — one
+    executor's QueryCancelled must not re-dispatch work the driver is
+    tearing down."""
+    if isinstance(e, QueryCancelled):
+        return False
     return isinstance(e, (InjectedFault, OSError))
 
 
@@ -194,6 +201,39 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
     # state is built, like a worker dying between pickup and execution;
     # the driver must recover by scoped re-dispatch, not lose the query
     CHAOS.raise_if("cluster.task")
+    # cooperative cancellation: the task runs under a query-scoped token
+    # (deadline-derived — the driver ships the remaining budget with the
+    # task) registered so the driver's cancel_query broadcast reaches it
+    # mid-batch.  Everything under the scope inherits it: the engine's
+    # batch loop, pipeline producers, fetch workers, retry attempts.
+    qid = task["query_id"]
+    # a SHIPPED deadline of 0 means the budget is already exhausted at
+    # dispatch — an immediate self-cancel, NOT "no deadline" (`or None`
+    # would invert it); absent means the driver set no bound
+    shipped = task.get("deadline_s")
+    token = CancelToken(
+        label=f"cluster query {qid} rank {task.get('rank')}",
+        deadline_s=(None if shipped is None
+                    else max(float(shipped), 0.0)))
+    CANCELS.register(qid, token)
+    try:
+        with token.scope():
+            # entry cancellation point: an already-expired deadline (or
+            # a cancel that raced dispatch) aborts before any work
+            token.check()
+            return _run_task_body(task, plan_bytes, conf_map,
+                                  driver_rpc, executor_id)
+    except QueryCancelled:
+        # the acceptance counter: this task observed the cancel and
+        # stopped EARLY (typed), instead of running to completion
+        SHUFFLE_COUNTERS.add(tasks_cancelled=1)
+        raise
+    finally:
+        CANCELS.unregister(qid, token)
+
+
+def _run_task_body(task: dict, plan_bytes: bytes, conf_map: dict,
+                   driver_rpc=None, executor_id: str = None) -> list:
     from spark_rapids_tpu.config import RapidsConf
     from spark_rapids_tpu.memory import initialize_memory
     from spark_rapids_tpu.plan.cpu_engine import CpuTable
@@ -315,6 +355,7 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
     # results are PARTITION-TAGGED so the driver can reassemble
     # partition-major — the concatenation across ranks of a range sort's
     # partitions in partition order IS the global order
+    from spark_rapids_tpu.utils.cancel import check_cancelled
     parts: list = []
     try:
         with TENANTS.scope(tenant):
@@ -324,6 +365,10 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
                     continue
                 rows_p: list = []
                 for batch in physical.execute_partition(p):
+                    # batch-boundary cancellation point: a cancelled
+                    # query's task stops between batches, releasing
+                    # its device residency through the cleanup below
+                    check_cancelled()
                     rows_p.extend(CpuTable.from_batch(batch).rows())
                 parts.append((p, rows_p))
     except Exception:

@@ -628,6 +628,31 @@ SERVING_TENANT_DEFAULT_WEIGHT = conf(
     "lighter tenants' handles spill before heavier ones."
 ).double_conf(1.0)
 
+SERVING_QUERY_DEADLINE = conf("spark.rapids.serving.query.deadline").doc(
+    "Per-query EXECUTION deadline in seconds for serving submissions "
+    "(0 = none): QueryQueue.submit derives each query's CancelToken "
+    "from it, so a runaway query self-cancels at its next batch "
+    "boundary or blessed wait with a typed QueryCancelled instead of "
+    "running to completion holding admission slots and tenant bytes "
+    "(utils/cancel.py)."
+).double_conf(0.0)
+
+WATCHDOG_STALL_SECONDS = conf("spark.rapids.watchdog.stallSeconds").doc(
+    "Stall watchdog threshold in seconds (0 disables): every blessed "
+    "blocking site registers its wait (utils/cancel.cancellable_wait), "
+    "and a wait older than this bumps watchdog_stalls and writes a "
+    "crashdump-style stall report of all registered waits + thread "
+    "stacks (utils/watchdog.py) — a silent hang becomes an actionable, "
+    "typed artifact."
+).double_conf(300.0)
+
+WATCHDOG_CANCEL_ON_STALL = conf("spark.rapids.watchdog.cancelOnStall").doc(
+    "When the stall watchdog flags a wait, also CANCEL the stalled "
+    "query's token: the wedged query dies with QueryCancelled naming "
+    "the stalled site and the server frees its slots, instead of "
+    "wedging until operator intervention."
+).boolean_conf(False)
+
 SERVING_TENANTS = conf("spark.rapids.serving.tenants").doc(
     "Per-tenant budget/weight spec: "
     "'name:weight=2:budget=64m,name2:weight=1'. Unnamed tenants use the "
@@ -952,6 +977,18 @@ class RapidsConf:
     @property
     def serving_tenants_spec(self) -> str:
         return self.get(SERVING_TENANTS) or ""
+
+    @property
+    def serving_query_deadline(self) -> float:
+        return self.get(SERVING_QUERY_DEADLINE)
+
+    @property
+    def watchdog_stall_seconds(self) -> float:
+        return self.get(WATCHDOG_STALL_SECONDS)
+
+    @property
+    def watchdog_cancel_on_stall(self) -> bool:
+        return self.get(WATCHDOG_CANCEL_ON_STALL)
 
     def with_overrides(self, **kv) -> "RapidsConf":
         m = dict(self._map)

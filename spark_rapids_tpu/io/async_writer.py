@@ -34,11 +34,15 @@ class ThrottlingExecutor:
             raise err
 
     def submit(self, nbytes: int, fn: Callable[[], None]) -> None:
+        from spark_rapids_tpu.utils.cancel import cancellable_wait
         nbytes = min(max(int(nbytes), 0), self.budget)
         with self._cv:
             self._raise_pending()
-            while self._in_flight + nbytes > self.budget and self._in_flight:
-                self._cv.wait()
+            cancellable_wait(
+                self._cv,
+                predicate=lambda: not (self._in_flight + nbytes
+                                       > self.budget and self._in_flight),
+                site="io.write.throttle")
             self._in_flight += nbytes
 
         def run():
@@ -56,9 +60,11 @@ class ThrottlingExecutor:
 
     def wait(self) -> None:
         """Drain all in-flight work; re-raise the first error."""
+        from spark_rapids_tpu.utils.cancel import cancellable_wait
         with self._cv:
-            while self._in_flight:
-                self._cv.wait()
+            cancellable_wait(self._cv,
+                             predicate=lambda: not self._in_flight,
+                             site="io.write.drain")
             self._raise_pending()
 
     def shutdown(self) -> None:

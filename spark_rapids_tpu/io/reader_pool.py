@@ -56,8 +56,20 @@ def prefetched(host_iter_fn: Callable[[], Iterator], num_threads: int,
     blocking on the full queue forever — a stuck producer would pin one
     thread of the process-wide pool per abandoned scan.
     """
+    from spark_rapids_tpu.utils.cancel import (cancellable_wait,
+                                               current_cancel_token)
     q: "queue.Queue" = queue.Queue(maxsize=capacity)
     cancelled = threading.Event()
+    # the consuming task's cancel token: the producer polls it directly
+    # (NOT via token.on_cancel — a long query opens many scans and
+    # per-scan registrations would accumulate on the token for its
+    # whole lifetime); the consumer's unwind also sets ``cancelled``,
+    # so both exit signals converge on the same loop conditions
+    token = current_cancel_token()
+
+    def _stop() -> bool:
+        return cancelled.is_set() or \
+            (token is not None and token.cancelled())
 
     def produce():
         try:
@@ -65,23 +77,23 @@ def prefetched(host_iter_fn: Callable[[], Iterator], num_threads: int,
                              "host-side file decode on the reader pool "
                              "(no device semaphore held)"):
                 for item in host_iter_fn():
-                    while not cancelled.is_set():
+                    while not _stop():
                         try:
                             q.put(item, timeout=0.2)
                             break
                         except queue.Full:
                             continue
-                    if cancelled.is_set():
+                    if _stop():
                         return
         except BaseException as e:   # noqa: BLE001 — relayed to consumer
-            while not cancelled.is_set():
+            while not _stop():
                 try:
                     q.put(("__error__", e), timeout=0.2)
                     break
                 except queue.Full:
                     continue
         finally:
-            while not cancelled.is_set():
+            while not _stop():
                 try:
                     q.put(_SENTINEL, timeout=0.2)
                     break
@@ -98,7 +110,7 @@ def prefetched(host_iter_fn: Callable[[], Iterator], num_threads: int,
 
     try:
         while True:
-            item = q.get()
+            item = cancellable_wait(q, token=token, site="scan.prefetch")
             if item is _SENTINEL:
                 return
             if isinstance(item, tuple) and len(item) == 2 and \

@@ -267,6 +267,7 @@ def write_dataframe(df, path: str, fmt: str = "parquet",
                 nbytes = sum(b.device_size_bytes() for b in batches)
                 throttle.submit(nbytes, lambda t=task_id, bs=batches:
                                 task(t, bs))
+            # tpu-lint: allow-unbounded-wait(ThrottlingExecutor.wait drains through a blessed cancellable_wait internally — watchdog-registered, cancel-aware)
             throttle.wait()
         else:
             for task_id, batches in enumerate(batches_by_part):
@@ -277,6 +278,7 @@ def write_dataframe(df, path: str, fmt: str = "parquet",
             # drain in-flight tasks BEFORE aborting: rmtree racing live
             # writers would orphan files / mask the real error
             try:
+                # tpu-lint: allow-unbounded-wait(ThrottlingExecutor.wait drains through a blessed cancellable_wait internally — watchdog-registered, cancel-aware)
                 throttle.wait()
             # tpu-lint: allow-swallow(drain errors must not mask the original failure being re-raised below)
             except BaseException:

@@ -63,6 +63,12 @@ def initialize_memory(conf) -> None:
                     conf.shuffle_persist_dir,
                     conf.cluster_drain_timeout)
     device_arena().check_retry_context = conf.retry_context_check
+    # the stall watchdog rides the same conf snapshot: any blessed
+    # blocking site (utils/cancel.cancellable_wait) past the threshold
+    # becomes a typed stall report instead of a silent hang
+    from spark_rapids_tpu.utils.watchdog import WATCHDOG
+    WATCHDOG.configure(conf.watchdog_stall_seconds,
+                       conf.watchdog_cancel_on_stall)
     # HBM-budget sizing from the chip's memory stats (GpuDeviceManager):
     # always on, like the reference's default-fraction pool sizing —
     # backends with no memory stats (CPU tests) stay in bookkeeping mode

@@ -47,16 +47,32 @@ class PrioritySemaphore:
         blocking past it (the ticket is withdrawn).  cost > 1 is the
         weighted form the serving admission controller builds on — a
         head-of-line ticket holds its place until its full cost fits
-        (no starvation of big requests by a stream of small ones)."""
+        (no starvation of big requests by a stream of small ones).
+
+        CANCELLATION POINT: the wait IS a blessed ``cancellable_wait``
+        (utils/cancel.py) — bounded slices, ambient CancelToken checks
+        between slices (a cancelled query waiting for the device wakes
+        with QueryCancelled, its ticket withdrawn, instead of blocking
+        forever), watchdog-registered while actually waiting."""
+        from spark_rapids_tpu.utils.cancel import cancellable_wait
         start = time.monotonic_ns()
         acquired = True
         with self._cv:
             ticket = (priority, next(self._seq))
             heapq.heappush(self._waiters, ticket)
-            while True:
+
+            def ready() -> bool:
                 self._drop_dead_locked()
-                if self._waiters and self._waiters[0] == ticket \
-                        and self._permits >= cost:
+                return bool(self._waiters and self._waiters[0] == ticket
+                            and self._permits >= cost)
+            try:
+                if not ready():
+                    acquired = cancellable_wait(
+                        self._cv, predicate=ready,
+                        timeout=(None if deadline is None else
+                                 max(deadline - time.monotonic(), 0.0)),
+                        site="semaphore.acquire")
+                if acquired:
                     heapq.heappop(self._waiters)
                     self._permits -= cost
                     if self._permits > 0 and self._waiters:
@@ -64,19 +80,19 @@ class PrioritySemaphore:
                         # we were still queued even though a permit is
                         # free
                         self._cv.notify_all()
-                    break
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        self._dead.add(ticket)
-                        self._drop_dead_locked()
-                        # a withdrawn head unblocks whoever is next
-                        self._cv.notify_all()
-                        acquired = False
-                        break
-                    self._cv.wait(remaining)
-                else:
-                    self._cv.wait()
+            except BaseException:
+                # withdrawn ticket (cancel/interrupt): unblock the next
+                # head exactly like a deadline withdrawal
+                self._dead.add(ticket)
+                self._drop_dead_locked()
+                self._cv.notify_all()
+                raise
+            finally:
+                if not acquired:
+                    self._dead.add(ticket)
+                    self._drop_dead_locked()
+                    # a withdrawn head unblocks whoever is next
+                    self._cv.notify_all()
         if self._record_wait_metric:
             task_metrics.get().semaphore_wait_ns += \
                 time.monotonic_ns() - start

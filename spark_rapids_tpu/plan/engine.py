@@ -25,19 +25,36 @@ class TpuEngine:
         nparts = plan.num_partitions()
         # partition tasks are PART of the submitting query: pool threads
         # must inherit its tenant ambient or their allocations would
-        # escape the tenant's budget/spill accounting (memory/tenant.py)
+        # escape the tenant's budget/spill accounting (memory/tenant.py),
+        # and its CANCEL TOKEN or a cancelled query's tasks would run to
+        # completion holding semaphore slots (utils/cancel.py)
         from spark_rapids_tpu.memory.semaphore import current_task_priority
         from spark_rapids_tpu.memory.tenant import TENANTS
+        from spark_rapids_tpu.utils.cancel import (
+            QueryCancelled, cancel_scope, current_cancel_token)
         tenant = TENANTS.current()
         priority = current_task_priority()
+        token = current_cancel_token()
 
         def run_one(p: int) -> List[ColumnarBatch]:
             from spark_rapids_tpu.memory.task_completion import task_scope
             sem = tpu_semaphore()
             sem.acquire_if_necessary(priority)
             try:
-                with TENANTS.scope(tenant), task_scope():
-                    return list(plan.execute_partition(p))
+                with TENANTS.scope(tenant), cancel_scope(token), \
+                        task_scope():
+                    out: List[ColumnarBatch] = []
+                    for batch in plan.execute_partition(p):
+                        # batch-boundary cancellation point (the task
+                        # analog of Spark's cooperative interruption)
+                        if token is not None:
+                            token.check()
+                        out.append(batch)
+                    return out
+            except QueryCancelled:
+                from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+                SHUFFLE_COUNTERS.add(tasks_cancelled=1)
+                raise
             finally:
                 sem.release_if_necessary()
 

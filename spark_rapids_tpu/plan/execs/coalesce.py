@@ -113,11 +113,15 @@ def retry_over_spillable(handles, body):
     inputs) alive past its return; callers still own close().
     """
     from spark_rapids_tpu.memory.retry import with_retry_no_split
+    from spark_rapids_tpu.utils.cancel import check_cancelled
 
     handles = list(handles)   # attempts re-iterate: a generator would be
                               # exhausted by attempt 1 and retry nothing
 
     def attempt():
+        # cancellation point per ATTEMPT: a cancelled query must not
+        # spill-and-rerun its way through the remaining retries
+        check_cancelled()
         pinned = []
         try:
             mats = []
@@ -151,10 +155,13 @@ def retry_over_stream_pieces(piece_lists, body):
     return; piece ownership (close) stays with the transport.
     """
     from spark_rapids_tpu.memory.retry import with_retry_no_split
+    from spark_rapids_tpu.utils.cancel import check_cancelled
 
     piece_lists = [list(lst) for lst in piece_lists]
 
     def attempt():
+        # cancellation point per attempt (see retry_over_spillable)
+        check_cancelled()
         pinned = []
         try:
             mats = []

@@ -152,9 +152,11 @@ class PythonWorkerPool:
             return pool
 
     def _borrow(self) -> _Worker:
+        from spark_rapids_tpu.utils.cancel import cancellable_wait
         with self._cv:
-            while not self._free:
-                self._cv.wait()
+            cancellable_wait(self._cv,
+                             predicate=lambda: bool(self._free),
+                             site="python.worker.borrow")
             w = self._free.pop()
         if w is None:
             # lazy revival of a slot whose worker died/desynced: spawn

@@ -38,10 +38,14 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional
 
+import itertools
+
 from spark_rapids_tpu.memory.semaphore import WeightedPrioritySemaphore
 from spark_rapids_tpu.memory.tenant import TENANT_CONF_KEY, TENANTS
 from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
 from spark_rapids_tpu.testing.chaos import CHAOS
+from spark_rapids_tpu.utils.cancel import (
+    CancelToken, QueryCancelled, cancellable_wait)
 
 from spark_rapids_tpu.serving.cache import (
     ResultCache, UncacheableError, plan_fingerprint)
@@ -62,10 +66,12 @@ class AdmissionRejected(RuntimeError):
 class QueryContext:
     """What a runner gets alongside the plan."""
 
-    def __init__(self, tenant: str, priority: int, conf_overrides: dict):
+    def __init__(self, tenant: str, priority: int, conf_overrides: dict,
+                 cancel_token: Optional[CancelToken] = None):
         self.tenant = tenant
         self.priority = priority
         self.conf_overrides = dict(conf_overrides)
+        self.cancel_token = cancel_token
 
 
 class LocalSessionRunner:
@@ -107,8 +113,12 @@ class ClusterDriverRunner:
     def __call__(self, plan, ctx: QueryContext) -> list:
         conf = dict(ctx.conf_overrides)
         conf[TENANT_CONF_KEY] = ctx.tenant
+        # the serving token IS the cluster query's cancel handle: the
+        # driver's polling loop observes it, broadcasts cancel_query to
+        # executors and tears the query down (cluster/driver.py)
         return self.driver.submit(plan, timeout_s=self.timeout_s,
-                                  conf=conf)
+                                  conf=conf,
+                                  cancel_token=ctx.cancel_token)
 
 
 class QueryQueue:
@@ -158,6 +168,14 @@ class QueryQueue:
         #: wait for the leader instead of each executing the same plan
         self._inflight: Dict[str, Future] = {}
         self._inflight_lock = threading.Lock()
+        #: per-query execution deadline (0 = none): every submission's
+        #: CancelToken derives from it, so a runaway query self-cancels
+        #: instead of holding slots/bytes forever
+        self.query_deadline_s = conf.serving_query_deadline
+        #: query_id -> live CancelToken — the public cancel() handle
+        self._active: Dict[str, CancelToken] = {}
+        self._active_lock = threading.Lock()
+        self._qid_seq = itertools.count(1)
 
     # -- admission -----------------------------------------------------------
 
@@ -223,8 +241,17 @@ class QueryQueue:
                     f"{tenant!r} rejected", reason="timeout",
                     tenant=tenant)
         if bytes_sem is not None:
-            if not bytes_sem.acquire(priority, cost=cost,
-                                     deadline=now + timeout_s):
+            try:
+                ok = bytes_sem.acquire(priority, cost=cost,
+                                       deadline=now + timeout_s)
+            except BaseException:
+                # the byte wait is a CANCELLATION POINT: a cancel (or
+                # token deadline) raising out of it must give back the
+                # slot already held, or every such cancel leaks one
+                # admission slot permanently
+                self._slots.release()
+                raise
+            if not ok:
                 self._slots.release()
                 SHUFFLE_COUNTERS.add(queries_rejected=1)
                 raise AdmissionRejected(
@@ -244,15 +271,55 @@ class QueryQueue:
 
     # -- submission ----------------------------------------------------------
 
+    def cancel(self, query_id: str,
+               reason: str = "cancelled by caller") -> bool:
+        """Cancel an in-flight submission by its ``query_id``: the id
+        passed to submit(), the ``query_id`` attribute of the Future
+        submit_async() returned (auto-assigned ids are pre-minted
+        there), or one from ``active_queries()``.  Cooperative: the
+        query's token flips, every blessed wait and batch boundary
+        under it raises ``QueryCancelled``, and cleanup (admission
+        release, tenant refund, shuffle drop) runs on the submitting
+        thread's unwind.  Returns False for an unknown/finished id (an
+        async submission registers at submit entry on its worker
+        thread — a cancel racing that hand-off can simply retry)."""
+        with self._active_lock:
+            token = self._active.get(query_id)
+        if token is None:
+            return False
+        return token.cancel(reason)
+
+    def active_queries(self) -> list:
+        """Ids of submissions currently in flight (cancel() handles)."""
+        with self._active_lock:
+            return sorted(self._active)
+
+    def _mint_query_id(self) -> str:
+        """Fresh auto id, dodging caller-supplied ids (caller holds
+        ``_active_lock``)."""
+        qid = f"q{next(self._qid_seq)}"
+        while qid in self._active:
+            qid = f"q{next(self._qid_seq)}"
+        return qid
+
     def submit(self, plan, tenant: str = "default", priority: int = 0,
                est_bytes: Optional[int] = None,
                timeout_s: Optional[float] = None,
                conf: Optional[dict] = None,
-               cacheable: bool = True) -> list:
+               cacheable: bool = True,
+               query_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> list:
         """Run one logical plan for ``tenant`` and return its rows.
         Blocks through admission (bounded by ``timeout_s`` or the
         queue.timeout conf) and runs the query on THIS thread.  Cache
-        hits return without consuming admission or dispatching work."""
+        hits return without consuming admission or dispatching work.
+
+        Every submission runs under a deadline-derived ``CancelToken``
+        (``deadline_s`` or spark.rapids.serving.query.deadline; 0 =
+        no deadline), registered under ``query_id`` (auto-assigned when
+        None) so ``cancel(query_id)`` stops it mid-flight with a typed
+        ``QueryCancelled`` — releasing its admission slot/bytes and
+        tenant bytes on the way out instead of running to completion."""
         CHAOS.delay("serving.admit.delay")
         overrides = dict(conf or {})
         # ONE deadline bounds the whole submission (single-flight wait
@@ -260,8 +327,64 @@ class QueryQueue:
         # a full timeout on the future and then a second one in _admit
         budget_s = self.queue_timeout_s if timeout_s is None else timeout_s
         deadline = time.monotonic() + budget_s
+        exec_deadline = (self.query_deadline_s if deadline_s is None
+                         else deadline_s)
+        token = CancelToken(label="serving query",
+                            deadline_s=exec_deadline or None)
+        with self._active_lock:
+            if query_id is None:
+                query_id = self._mint_query_id()
+            elif query_id in self._active:
+                # overwriting would orphan the in-flight submission's
+                # token — it could never be cancelled again, the exact
+                # leak this layer exists to prevent
+                raise ValueError(
+                    f"query_id {query_id!r} is already in flight; "
+                    "cancel it first or choose a distinct id")
+            self._active[query_id] = token
+        token.label = f"serving query {query_id!r}"
+        #: single-flight state shared with the except/finally clauses
+        #: (the helper fills it in as it learns the key/role)
+        sf = {"key": None, "leader": None}
+        # the token is ambient for the WHOLE submission — admission
+        # waits, the single-flight follower wait, and the runner (whose
+        # engine threads inherit it) are all cancellation points
+        with token.scope():
+            try:
+                return self._submit_under_token(
+                    plan, tenant, priority, est_bytes, overrides,
+                    cacheable, deadline, budget_s, token, sf)
+            except QueryCancelled as e:
+                # count THIS submission only when ITS OWN token was
+                # cancelled: a single-flight follower unwinding with the
+                # leader's QueryCancelled is collateral, not a second
+                # cancelled query (and the cluster driver skips counting
+                # for a serving-owned token — one cancel, one count)
+                if token.cancelled():
+                    SHUFFLE_COUNTERS.add(queries_cancelled=1)
+                if sf["leader"] is not None:
+                    sf["leader"].set_exception(e)
+                raise
+            except BaseException as e:
+                if sf["leader"] is not None:
+                    sf["leader"].set_exception(e)
+                raise
+            finally:
+                with self._active_lock:
+                    if self._active.get(query_id) is token:
+                        del self._active[query_id]
+                if sf["leader"] is not None:
+                    with self._inflight_lock:
+                        if self._inflight.get(sf["key"]) is sf["leader"]:
+                            del self._inflight[sf["key"]]
+
+    def _submit_under_token(self, plan, tenant, priority, est_bytes,
+                            overrides, cacheable, deadline, budget_s,
+                            token, sf) -> list:
+        """Cache lookup + single-flight + admission + execution of one
+        submission (submit()'s body; the caller owns token registration
+        and leader-future completion on the error paths)."""
         key = sources = None
-        leader_future = None
         if self.cache is not None and cacheable:
             try:
                 key, sources = plan_fingerprint(plan, overrides)
@@ -277,61 +400,77 @@ class QueryQueue:
                 with self._inflight_lock:
                     existing = self._inflight.get(key)
                     if existing is None:
-                        leader_future = Future()
-                        self._inflight[key] = leader_future
-                if leader_future is None and existing is not None:
+                        sf["key"] = key
+                        sf["leader"] = Future()
+                        self._inflight[key] = sf["leader"]
+                if sf["leader"] is None and existing is not None:
                     # follower: the leader's finally always completes
-                    # this future; its failure (or a wait past OUR
-                    # timeout bound — a wedged leader must not hold
-                    # followers hostage) falls through to a normal
-                    # execution of our own, bounded by admission
+                    # this future; a CANCELLED leader unblocks its
+                    # followers with the QueryCancelled itself (the
+                    # fingerprint's one execution was deliberately
+                    # stopped — re-running it would defeat the cancel);
+                    # any other failure (or a wait past OUR timeout
+                    # bound) falls through to a normal execution of our
+                    # own, bounded by admission
                     try:
-                        existing.result(timeout=budget_s)
+                        cancellable_wait(
+                            existing, timeout=budget_s, token=token,
+                            site="serving.single_flight")
+                    except QueryCancelled:
+                        raise
                     except Exception:  # noqa: BLE001  # tpu-lint: allow-swallow(the leader raises its own failure to its own caller; a follower deliberately falls through to execute the query itself, which surfaces any real error)
                         pass
                     else:
                         hit = self.cache.get(key, tenant=tenant)
                         if hit is not None:
                             return hit
+        cost = self._admit(
+            tenant, priority,
+            self.default_query_bytes if est_bytes is None else est_bytes,
+            max(deadline - time.monotonic(), 0.001))
         try:
-            cost = self._admit(
-                tenant, priority,
-                self.default_query_bytes if est_bytes is None
-                else est_bytes,
-                max(deadline - time.monotonic(), 0.001))
-            try:
-                ctx = QueryContext(tenant, priority, overrides)
-                with TENANTS.scope(tenant):
-                    rows = self.runner(plan, ctx)
-            finally:
-                self._release(cost)
-            if key is not None:
-                self.cache.put(key, rows, sources, tenant=tenant)
-            if leader_future is not None:
-                leader_future.set_result(True)
-            return rows
-        except BaseException as e:
-            if leader_future is not None:
-                leader_future.set_exception(e)
-            raise
+            # chaos serving.runner.stall: the runner wedges in a
+            # REGISTERED wait (the stall the watchdog must catch;
+            # cancelOnStall then frees this very submission)
+            hit = CHAOS.fire("serving.runner.stall")
+            if hit is not None:
+                cancellable_wait(
+                    threading.Event(),
+                    timeout=float(hit.get("seconds", 30.0)),
+                    token=token, site="serving.runner.stall")
+            ctx = QueryContext(tenant, priority, overrides,
+                               cancel_token=token)
+            with TENANTS.scope(tenant):
+                rows = self.runner(plan, ctx)
+            token.check()   # a cancel that raced completion wins
         finally:
-            if leader_future is not None:
-                with self._inflight_lock:
-                    if self._inflight.get(key) is leader_future:
-                        del self._inflight[key]
+            self._release(cost)
+        if key is not None:
+            self.cache.put(key, rows, sources, tenant=tenant)
+        if sf["leader"] is not None:
+            sf["leader"].set_result(True)
+        return rows
 
     def submit_async(self, plan, **kw):
-        """``submit`` on a worker thread; returns a Future.  The pool is
-        sized past the admission bound so queued queries can WAIT in the
-        admission queue (where priority ordering lives) rather than in
-        the pool's FIFO."""
+        """``submit`` on a worker thread; returns a Future carrying the
+        submission's ``query_id`` attribute (auto ids are pre-minted
+        HERE so async callers have a cancel() handle — submit's return
+        value is the rows, so an id minted inside it would be
+        unreachable).  The pool is sized past the admission bound so
+        queued queries can WAIT in the admission queue (where priority
+        ordering lives) rather than in the pool's FIFO."""
+        if kw.get("query_id") is None:
+            with self._active_lock:
+                kw["query_id"] = self._mint_query_id()
         with self._pool_lock:
             if self._pool is None:
                 self._pool = ThreadPoolExecutor(
                     max_workers=self.max_concurrent
                     + self.queue_max_depth,
                     thread_name_prefix="serving")
-        return self._pool.submit(self.submit, plan, **kw)
+        fut = self._pool.submit(self.submit, plan, **kw)
+        fut.query_id = kw["query_id"]
+        return fut
 
     def invalidate_source(self, source: str) -> int:
         """Explicit cache invalidation for one source (file path, table

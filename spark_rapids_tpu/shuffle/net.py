@@ -203,10 +203,14 @@ class PooledConnection:
     def _checkout(self) -> Optional[socket.socket]:
         """Take exclusive ownership of the pooled socket (may be None =
         caller connects).  A new request also un-latches close(): reuse
-        after close means the caller wants the connection back."""
+        after close means the caller wants the connection back.  The
+        ownership wait is a blessed cancellable_wait: a cancelled query
+        queued behind another thread's in-flight round-trip wakes with
+        QueryCancelled instead of inheriting the peer's 60s timeout."""
+        from spark_rapids_tpu.utils.cancel import cancellable_wait
         with self._cv:
-            while self._busy:
-                self._cv.wait()
+            cancellable_wait(self._cv, predicate=lambda: not self._busy,
+                             site="shuffle.conn.checkout")
             self._busy = True
             self._closed = False
             sock, self._sock = self._sock, None
@@ -1148,6 +1152,16 @@ class ShuffleBlockServer:
                     # can't leak them or satisfy a stale retry read
                     dropped = outer.store.drop_query(header["query_id"])
                     _send_msg(self.request, {"dropped": dropped})
+                elif op == "cancel_query":
+                    # cooperative-cancel broadcast (beside drop_query):
+                    # flip every task token this node registered under
+                    # the query id — running tasks stop at their next
+                    # batch boundary / blessed wait (utils/cancel.py)
+                    from spark_rapids_tpu.utils.cancel import CANCELS
+                    n = CANCELS.cancel(
+                        int(header["query_id"]),
+                        header.get("reason") or "cancelled by driver")
+                    _send_msg(self.request, {"cancelled": n})
                 elif op == "store_info":
                     _send_msg(self.request,
                               {"shuffle_ids": outer.store.shuffle_ids()})
@@ -1336,6 +1350,15 @@ class PeerClient:
         h, _ = _request(self.addr, {"op": "drop_query",
                                     "query_id": int(query_id)})
         return int(h.get("dropped", 0))
+
+    def cancel_query(self, query_id: int, reason: str = "") -> int:
+        """Cooperatively cancel the query's running tasks on this peer
+        (flips its registered CancelTokens); returns how many tokens
+        transitioned to cancelled."""
+        h, _ = _request(self.addr, {"op": "cancel_query",
+                                    "query_id": int(query_id),
+                                    "reason": reason})
+        return int(h.get("cancelled", 0))
 
     def store_info(self) -> List[int]:
         """Shuffle ids currently resident in this peer's block store
@@ -1550,6 +1573,14 @@ class BlockFetchIterator:
 
     def __iter__(self):
         import collections
+
+        from spark_rapids_tpu.utils.cancel import (cancellable_wait,
+                                                   current_cancel_token)
+        # the consumer's ambient token governs the whole read: workers
+        # are plain threads (no ambient of their own), so they observe
+        # the SAME token explicitly — a cancelled query's fetch plane
+        # stops fetching instead of draining the partition
+        token = current_cancel_token()
         sources = []                # [{"peer": ..., "pairs": [(idx, sz)]}]
         for peer in self.peers:
             try:
@@ -1594,11 +1625,14 @@ class BlockFetchIterator:
                     with cv:
                         # window: wait for room; an oversized batch may
                         # proceed alone so progress is always possible
-                        while (state["inflight"] > 0
-                               and state["inflight"] + batch_bytes
-                               > self.max_inflight
-                               and not state["stopped"]):
-                            cv.wait()
+                        cancellable_wait(
+                            cv,
+                            predicate=lambda: not (
+                                state["inflight"] > 0
+                                and state["inflight"] + batch_bytes
+                                > self.max_inflight
+                                and not state["stopped"]),
+                            token=token, site="shuffle.fetch.window")
                         if state["stopped"]:
                             return
                         state["inflight"] += batch_bytes
@@ -1632,9 +1666,12 @@ class BlockFetchIterator:
             while True:
                 with cv:
                     t0 = time.perf_counter_ns()
-                    while (not queue and state["live_workers"] > 0
-                           and state["error"] is None):
-                        cv.wait()
+                    cancellable_wait(
+                        cv,
+                        predicate=lambda: (queue
+                                           or state["live_workers"] <= 0
+                                           or state["error"] is not None),
+                        token=token, site="shuffle.fetch.drain")
                     stall_ns = time.perf_counter_ns() - t0
                     err = state["error"]
                     block = None
@@ -1779,20 +1816,29 @@ class TcpShuffleTransport:
         any — executor loss then costs a re-fetch, not a re-execution;
         only a slot with no surviving copy escalates to PeerLostError
         (the scoped-recovery path)."""
+        from spark_rapids_tpu.utils.cancel import (check_cancelled,
+                                                   current_cancel_token)
+        from spark_rapids_tpu.utils.watchdog import WATCHDOG
         self.executor.heartbeat()
         budget = RetryBudget(
             f"shuffle.completeness:{self.shuffle_id}",
             max_attempts=None, base_delay_s=0.02, max_delay_s=0.25,
             deadline_s=self.completeness_timeout_s)
-        while True:
-            participants, complete, servers = self.executor.shuffle_status(
-                self.shuffle_id)
-            if set(participants) <= set(complete):
-                break
-            pending = RuntimeError(
-                f"shuffle {self.shuffle_id}: map output incomplete: "
-                f"{sorted(set(participants) - set(complete))} pending")
-            budget.backoff(error=pending)   # exhaustion names the budget
+        with WATCHDOG.waiting("shuffle.completeness",
+                              current_cancel_token()):
+            while True:
+                # cancellation point: a cancelled query must not sit out
+                # the completeness timeout waiting for map output that
+                # will never commit (its writers were cancelled too)
+                check_cancelled()
+                participants, complete, servers = \
+                    self.executor.shuffle_status(self.shuffle_id)
+                if set(participants) <= set(complete):
+                    break
+                pending = RuntimeError(
+                    f"shuffle {self.shuffle_id}: map output incomplete: "
+                    f"{sorted(set(participants) - set(complete))} pending")
+                budget.backoff(error=pending)  # exhaustion names budget
         # re-learn peers AFTER the wait: a participant may have registered
         # while we were waiting for map output
         self.executor.heartbeat()

@@ -31,15 +31,24 @@ import time
 from typing import Callable, Iterable, Iterator, Optional
 
 from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+from spark_rapids_tpu.testing.chaos import CHAOS
+from spark_rapids_tpu.utils.cancel import cancellable_wait
 
 _SENTINEL = object()
 
 
 class _Pipe:
-    """Byte-bounded single-producer/single-consumer hand-off."""
+    """Byte-bounded single-producer/single-consumer hand-off.
 
-    def __init__(self, max_bytes: int):
+    Both waits are blessed ``cancellable_wait``s observing ``token``
+    (the consumer task's cancel token, shared by the producer thread it
+    spawned): a cancelled query's hand-off unblocks BOTH sides with
+    ``QueryCancelled`` — the producer's surfaces at the consumer through
+    ``finish(error)``, the consumer's propagates directly."""
+
+    def __init__(self, max_bytes: int, token=None):
         self.max_bytes = max(int(max_bytes), 1)
+        self.token = token
         self._cv = threading.Condition()
         self._items = []           # (item, nbytes, produce_ns)
         self._bytes = 0
@@ -51,9 +60,12 @@ class _Pipe:
 
     def put(self, item, nbytes: int, produce_ns: int) -> bool:
         with self._cv:
-            while (self._bytes >= self.max_bytes and self._items
-                   and not self._closed):
-                self._cv.wait(0.1)
+            cancellable_wait(
+                self._cv,
+                predicate=lambda: not (self._bytes >= self.max_bytes
+                                       and self._items
+                                       and not self._closed),
+                token=self.token, site="shuffle.pipeline.put")
             if self._closed:
                 return False
             self._items.append((item, nbytes, produce_ns))
@@ -73,8 +85,10 @@ class _Pipe:
         """(item, produce_ns, waited_ns) or (_SENTINEL, 0, waited_ns)."""
         t0 = time.perf_counter_ns()
         with self._cv:
-            while not self._items and not self._done:
-                self._cv.wait(0.1)
+            cancellable_wait(
+                self._cv,
+                predicate=lambda: self._items or self._done,
+                token=self.token, site="shuffle.pipeline.handoff")
             waited = time.perf_counter_ns() - t0
             if self._items:
                 item, nbytes, produce_ns = self._items.pop(0)
@@ -108,8 +122,11 @@ def pipelined(source: Iterable, nbytes_of: Callable[[object], int],
                                                    task_priority,
                                                    tpu_semaphore)
     from spark_rapids_tpu.memory.tenant import TENANTS
+    from spark_rapids_tpu.utils.cancel import (cancel_scope,
+                                               current_cancel_token)
 
-    pipe = _Pipe(max_inflight_bytes)
+    token = current_cancel_token()
+    pipe = _Pipe(max_inflight_bytes, token=token)
     tenant = TENANTS.current()
     priority = current_task_priority()
     # the producer works ON BEHALF of the calling task: when that task
@@ -124,9 +141,21 @@ def pipelined(source: Iterable, nbytes_of: Callable[[object], int],
         try:
             cover = (tpu_semaphore().borrowed_cover() if covered
                      else nullcontext())
-            with TENANTS.scope(tenant), task_priority(priority), cover:
+            # the producer works ON BEHALF of the consumer task: it
+            # inherits the cancel token too, so a cancelled query's
+            # producer exits its loop (next token check inside source,
+            # the pipe's put wait, or the explicit probe below) instead
+            # of producing into a dead hand-off
+            with TENANTS.scope(tenant), task_priority(priority), \
+                    cancel_scope(token), cover:
                 it = iter(source)
                 while True:
+                    if token is not None:
+                        token.check()
+                    # chaos shuffle.pipeline.producer.fail: the producer
+                    # thread dies mid-stream — the error must surface at
+                    # the consumer's next pull, never hang the hand-off
+                    CHAOS.raise_if("shuffle.pipeline.producer.fail")
                     t0 = time.perf_counter_ns()
                     try:
                         item = next(it)
@@ -145,6 +174,7 @@ def pipelined(source: Iterable, nbytes_of: Callable[[object], int],
     first = True
     try:
         while True:
+            # tpu-lint: allow-unbounded-wait(_Pipe.get waits through a blessed cancellable_wait internally — watchdog-registered, cancel-aware)
             item, produce_ns, waited_ns = pipe.get()
             if item is _SENTINEL:
                 return

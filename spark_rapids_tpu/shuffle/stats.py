@@ -112,6 +112,19 @@ _FIELDS = (
                               # attributed to the tenant that held data)
     "budget_denials",         # tenant-budget breaches surfaced as
                               # self-retry OOMs (never a neighbor kill)
+    # cooperative cancellation + stall watchdog (utils/cancel.py +
+    # utils/watchdog.py; docs/fault_tolerance.md cancellation section)
+    "queries_cancelled",      # queries stopped by an explicit cancel, a
+                              # deadline, or the watchdog (driver/serving)
+    "tasks_cancelled",        # partition/executor tasks that observed the
+                              # cancel and stopped early (typed abort, not
+                              # run-to-completion)
+    "cancel_broadcasts",      # cancel_query fan-outs to executor peers
+    "watchdog_stalls",        # registered waits flagged past the stall
+                              # threshold (stall report written each time)
+    "drop_query_failures",    # drop_query broadcasts that failed on a peer
+                              # even after the retry (residual stale state
+                              # surfaced, not silently swallowed)
 )
 
 

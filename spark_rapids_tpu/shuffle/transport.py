@@ -170,11 +170,13 @@ class KudoWireTransport(ShuffleTransport):
     def write(self, pieces):
         from concurrent.futures import ThreadPoolExecutor
         from spark_rapids_tpu.shuffle.serializer import serialize_batch
+        from spark_rapids_tpu.utils.cancel import cancellable_wait
         with ThreadPoolExecutor(max_workers=self.writer_threads) as pool:
             futures = [(p, pool.submit(serialize_batch, piece, self.codec))
                        for p, piece in pieces]
             for p, fut in futures:
-                self._buckets[p].append(fut.result())
+                self._buckets[p].append(cancellable_wait(
+                    fut, site="shuffle.serialize.drain"))
 
     def write_batches(self, batches):
         """Range write: each map batch arrives host-resident with its
@@ -186,9 +188,11 @@ class KudoWireTransport(ShuffleTransport):
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
         from spark_rapids_tpu.shuffle.serializer import serialize_batch_ranges
+        from spark_rapids_tpu.utils.cancel import cancellable_wait
 
         def drain(fut):
-            for p, block in enumerate(fut.result()):
+            blocks = cancellable_wait(fut, site="shuffle.serialize.drain")
+            for p, block in enumerate(blocks):
                 if block is not None:
                     self._buckets[p].append(block)
 

@@ -89,6 +89,15 @@ SITES: Dict[str, str] = {
         "ResultCache.get: flip one deterministic bit in the cached "
         "payload before its checksum verify — the entry must be dropped "
         "and recomputed, never served corrupt.",
+    "shuffle.pipeline.producer.fail":
+        "pipelined() producer thread, per item: raise InjectedFault "
+        "mid-stream — the error must re-raise at the consumer's next "
+        "pull through the hand-off, never wedge the pipe.",
+    "serving.runner.stall":
+        "QueryQueue.submit, before invoking the runner: wedge in a "
+        "REGISTERED cancellable_wait for args['seconds'] — the stall "
+        "watchdog must flag it and (under cancelOnStall) cancel the "
+        "query, freeing the server.",
 }
 
 

@@ -1,0 +1,306 @@
+"""Cooperative query cancellation: one token, one blessed way to block.
+
+The stack has deadlines that REJECT (`AdmissionRejected`, the cluster
+query deadline) but until now nothing that STOPS work already running —
+a timed-out or abandoned query's tasks ran to completion holding
+semaphore slots, tenant bytes and pipeline threads.  The reference
+kills a runaway query through Spark's cooperative task interruption
+plus the RmmSpark thread-state machine (PAPER.md L1: GpuSemaphore /
+RmmSpark track which thread holds what so an aborted task releases the
+device cleanly); this module is the TPU analog:
+
+  * ``CancelToken`` — ``cancel(reason)`` (idempotent, runs registered
+    cleanups once), ``check()`` (raises typed ``QueryCancelled``), an
+    optional DEADLINE the token self-cancels past (checked lazily, so
+    no timer thread), and a thread-ambient scope inherited exactly like
+    ``task_priority`` / the tenant scope: engine partition tasks,
+    pipeline producers and fetch workers all observe the submitting
+    query's token.
+  * ``cancellable_wait(cv/event/queue/future, ...)`` — the ONE blessed
+    way to block in engine code: bounded wait slices so a cancel (or
+    token deadline) wakes the waiter without a notify, and every wait
+    registers with the stall watchdog (utils/watchdog.py) for exactly
+    the time it blocks.  tpu-lint's ``unbounded-wait`` rule flags raw
+    no-timeout ``Condition.wait()`` / ``Queue.get()`` / ``Event.wait()``
+    / ``future.result()`` calls so unkillable waits cannot creep back.
+  * ``CANCELS`` — a process-wide query-id -> token registry, the
+    executor-side target of the driver's ``cancel_query`` broadcast
+    (shuffle/net.py server op): a running task registers its token
+    under its query id, and a remote cancel reaches it mid-batch.
+"""
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+from spark_rapids_tpu.utils.watchdog import WATCHDOG
+
+
+class QueryCancelled(RuntimeError):
+    """The query this work belongs to was cancelled (explicitly, by its
+    deadline, or by the stall watchdog).  Deliberate and NON-retryable:
+    the cluster layer treats it as a deterministic stop, never a
+    transient fault worth a re-dispatch."""
+
+    def __init__(self, message: str, reason: str = "cancelled"):
+        super().__init__(message)
+        self.reason = reason
+
+
+class CancelToken:
+    """Query-scoped cancellation flag with an optional deadline.
+
+    The deadline is evaluated LAZILY: ``cancelled()``/``check()``
+    self-cancel once past it (reason names the deadline), and
+    ``cancellable_wait`` bounds its wait slices by the remaining time —
+    no timer thread, deterministic under test clocks."""
+
+    def __init__(self, label: str = "query",
+                 deadline_s: Optional[float] = None,
+                 clock=time.monotonic):
+        self.label = label
+        self.reason: Optional[str] = None
+        self._clock = clock
+        # None disables; 0.0 means ALREADY EXPIRED (a shipped remaining
+        # budget of zero must self-cancel, not run unbounded) — callers
+        # whose conf uses 0-means-disabled pass `x or None` themselves
+        self._deadline = (clock() + float(deadline_s)
+                          if deadline_s is not None else None)
+        self._deadline_s = deadline_s
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._cleanups: List[Callable[[], None]] = []
+
+    # -- state ---------------------------------------------------------------
+
+    def cancel(self, reason: str = "cancelled") -> bool:
+        """Idempotent: the FIRST cancel records the reason and runs the
+        registered cleanups exactly once; returns True only then."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self.reason = reason
+            self._event.set()
+            cleanups, self._cleanups = self._cleanups, []
+        for fn in cleanups:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                import logging
+                logging.getLogger(__name__).warning(
+                    "cancel cleanup for %s failed", self.label,
+                    exc_info=True)
+        return True
+
+    def cancelled(self) -> bool:
+        if self._event.is_set():
+            return True
+        if self._deadline is not None and self._clock() >= self._deadline:
+            self.cancel(f"deadline exceeded ({self._deadline_s:.1f}s)")
+            return True
+        return False
+
+    def check(self) -> None:
+        """Raise ``QueryCancelled`` when cancelled (the batch-boundary
+        and retry-attempt probe; one Event load when armed-but-clear)."""
+        if self.cancelled():
+            raise QueryCancelled(
+                f"{self.label} cancelled: {self.reason}",
+                reason=self.reason or "cancelled")
+
+    def remaining_s(self) -> Optional[float]:
+        if self._deadline is None:
+            return None
+        return max(self._deadline - self._clock(), 0.0)
+
+    def on_cancel(self, fn: Callable[[], None]) -> None:
+        """Register a cleanup run once at cancel time (immediately when
+        already cancelled)."""
+        with self._lock:
+            if not self._event.is_set():
+                self._cleanups.append(fn)
+                return
+        fn()
+
+    # -- ambient scope -------------------------------------------------------
+
+    @contextmanager
+    def scope(self):
+        with cancel_scope(self):
+            yield self
+
+
+_AMBIENT = threading.local()
+
+
+def current_cancel_token() -> Optional[CancelToken]:
+    return getattr(_AMBIENT, "token", None)
+
+
+@contextmanager
+def cancel_scope(token: Optional[CancelToken]):
+    """Make ``token`` the thread's ambient cancel token for the block
+    (None = explicitly token-free, e.g. maintenance work on a worker
+    thread).  Worker threads spawned on behalf of a query re-enter the
+    spawning thread's token through this, exactly like the tenant and
+    task-priority ambients."""
+    prev = getattr(_AMBIENT, "token", None)
+    _AMBIENT.token = token
+    try:
+        yield token
+    finally:
+        _AMBIENT.token = prev
+
+
+def check_cancelled() -> None:
+    """Probe the ambient token (no-op outside any cancel scope): the
+    one-liner for batch boundaries and retry-attempt entries."""
+    tok = getattr(_AMBIENT, "token", None)
+    if tok is not None:
+        tok.check()
+
+
+#: bounded wait slice: a cancel/deadline wakes a waiter within this many
+#: seconds even when no notify ever arrives
+_SLICE_S = 0.25
+
+
+def _effective_slice(token: Optional[CancelToken],
+                     remaining: Optional[float]) -> float:
+    s = _SLICE_S
+    if remaining is not None:
+        s = min(s, max(remaining, 0.001))
+    if token is not None:
+        tr = token.remaining_s()
+        if tr is not None:
+            s = min(s, max(tr, 0.001))
+    return s
+
+
+def cancellable_wait(waitable, predicate: Optional[Callable[[], bool]] = None,
+                     timeout: Optional[float] = None,
+                     token: Optional[CancelToken] = None,
+                     site: str = "wait"):
+    """Block on ``waitable`` cooperatively: bounded slices, ambient (or
+    explicit) token checks between slices, and the whole wait registered
+    with the stall watchdog under ``site``.
+
+    Supported waitables and their contracts:
+
+    * ``threading.Condition`` — the CALLER holds the lock; loops
+      ``cv.wait(slice)`` until ``predicate()`` holds (predicate is
+      required) or ``timeout`` elapses.  Returns the final predicate
+      value, exactly like ``Condition.wait_for``.
+    * ``threading.Event`` — returns the flag (False on timeout).
+    * ``queue.Queue`` — returns the item; raises ``queue.Empty`` on
+      timeout (timeout None = wait until an item or cancel).
+    * ``concurrent.futures.Future`` — returns the result (re-raising
+      the future's exception); ``concurrent.futures.TimeoutError`` on
+      timeout.
+
+    Raises ``QueryCancelled`` the moment the token reports cancelled —
+    this is what makes every blessed blocking site a cancellation
+    point."""
+    if token is None:
+        token = current_cancel_token()
+    deadline = None if timeout is None else time.monotonic() + timeout
+
+    def remaining() -> Optional[float]:
+        if deadline is None:
+            return None
+        return deadline - time.monotonic()
+
+    with WATCHDOG.waiting(site, token):
+        if isinstance(waitable, threading.Condition):
+            if predicate is None:
+                raise TypeError(
+                    "cancellable_wait over a Condition needs a predicate")
+            while not predicate():
+                if token is not None:
+                    token.check()
+                rem = remaining()
+                if rem is not None and rem <= 0:
+                    return predicate()
+                waitable.wait(_effective_slice(token, rem))
+            return True
+        if isinstance(waitable, threading.Event):
+            while not waitable.is_set():
+                if token is not None:
+                    token.check()
+                rem = remaining()
+                if rem is not None and rem <= 0:
+                    return False
+                waitable.wait(_effective_slice(token, rem))
+            return True
+        if isinstance(waitable, queue_mod.Queue):
+            while True:
+                if token is not None:
+                    token.check()
+                rem = remaining()
+                if rem is not None and rem <= 0:
+                    raise queue_mod.Empty
+                try:
+                    return waitable.get(
+                        timeout=_effective_slice(token, rem))
+                except queue_mod.Empty:
+                    continue
+        if isinstance(waitable, Future):
+            while True:
+                if token is not None:
+                    token.check()
+                rem = remaining()
+                if rem is not None and rem <= 0:
+                    raise FutureTimeoutError()
+                try:
+                    return waitable.result(
+                        timeout=_effective_slice(token, rem))
+                except FutureTimeoutError:
+                    continue
+        raise TypeError(
+            f"cancellable_wait: unsupported waitable {type(waitable)!r}")
+
+
+class CancelRegistry:
+    """Query-id -> live tokens, the executor-side target of the driver's
+    ``cancel_query`` broadcast.  One query may have several registered
+    tokens on one node (concurrent attempts, speculation copies) — a
+    cancel reaches all of them; registration survives until the task's
+    finally unregisters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tokens: Dict[object, List[CancelToken]] = {}
+
+    def register(self, key, token: CancelToken) -> None:
+        with self._lock:
+            self._tokens.setdefault(key, []).append(token)
+
+    def unregister(self, key, token: CancelToken) -> None:
+        with self._lock:
+            toks = self._tokens.get(key)
+            if toks is not None:
+                try:
+                    toks.remove(token)
+                except ValueError:
+                    pass
+                if not toks:
+                    del self._tokens[key]
+
+    def cancel(self, key, reason: str = "cancelled") -> int:
+        """Cancel every token registered under ``key``; returns how many
+        transitioned to cancelled (idempotent per token)."""
+        with self._lock:
+            toks = list(self._tokens.get(key, ()))
+        return sum(1 for t in toks if t.cancel(reason))
+
+    def active(self, key) -> int:
+        with self._lock:
+            return len(self._tokens.get(key, ()))
+
+
+CANCELS = CancelRegistry()

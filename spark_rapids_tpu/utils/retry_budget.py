@@ -65,8 +65,12 @@ class RetryBudget:
         return self.deadline_s - self.elapsed_s()
 
     def _exhausted_reason(self, about_to_sleep: float) -> Optional[str]:
+        # both reasons carry the budget's HISTORY — attempts made and
+        # total elapsed seconds — so stall reports and chaos tests can
+        # assert on how much recovery work preceded the give-up
         if self.max_attempts is not None and self.used >= self.max_attempts:
-            return f"attempts exhausted ({self.used}/{self.max_attempts})"
+            return (f"attempts exhausted ({self.used}/{self.max_attempts} "
+                    f"retries, {self.elapsed_s():.2f}s elapsed)")
         rem = self.remaining_s()
         if rem is not None and about_to_sleep > rem:
             return (f"deadline exceeded ({self.elapsed_s():.2f}s of "

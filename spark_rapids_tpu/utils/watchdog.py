@@ -1,0 +1,183 @@
+"""Stall watchdog: turn silent hangs into actionable, typed failures.
+
+PR 9's verify drive found the repo's dominant failure mode is no longer
+a crash but a HANG — a producer thread parked on a queue while every
+device-semaphore slot is held by consumers blocked on that same
+producer.  The reference stays healthy because RmmSpark/GpuSemaphore
+track which thread holds what, so a wedged task is visible and
+killable; this module is that visibility layer for the TPU stack.
+
+Every blessed blocking site (``utils/cancel.cancellable_wait``, the
+device-semaphore wait, the shuffle fetch windows) REGISTERS its wait
+here — ``(site, query label, thread, since)`` — for exactly the time it
+blocks.  A daemon thread scans the registry and, when any wait exceeds
+``spark.rapids.watchdog.stallSeconds``:
+
+  * bumps the ``watchdog_stalls`` counter (shuffle/stats.py);
+  * writes a crashdump-style STALL REPORT — every registered wait plus
+    all thread stacks (the lock-holder view) — via
+    ``utils/crashdump.dump_now`` and keeps it in ``last_report`` for
+    in-process assertions;
+  * under ``spark.rapids.watchdog.cancelOnStall``, CANCELS the stalled
+    wait's query token (utils/cancel.py), so the wedged query dies with
+    a typed ``QueryCancelled`` naming the stalled site instead of
+    wedging the server.
+
+The scan is also callable directly (``WATCHDOG.scan(now=...)``) so
+tests exercise stall detection deterministically without real time.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class _WaitRecord:
+    __slots__ = ("site", "token", "thread_name", "since", "reported")
+
+    def __init__(self, site: str, token, thread_name: str, since: float):
+        self.site = site
+        self.token = token          # Optional[CancelToken]
+        self.thread_name = thread_name
+        self.since = since
+        self.reported = False
+
+    def snapshot(self, now: float) -> dict:
+        return {"site": self.site,
+                "query": getattr(self.token, "label", None),
+                "thread": self.thread_name,
+                "waiting_s": round(now - self.since, 3)}
+
+
+class Watchdog:
+    """Process-wide wait registry + stall scanner (``WATCHDOG``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._waits: Dict[int, _WaitRecord] = {}
+        self._seq = itertools.count()
+        self.stall_seconds = 0.0        # 0 = disabled
+        self.cancel_on_stall = False
+        self.last_report: Optional[dict] = None
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, stall_seconds: float,
+                  cancel_on_stall: bool = False) -> None:
+        """Apply the watchdog conf.  Enabling STARTS the scanner daemon
+        right away (not just on the next registered wait): the operator
+        who turns the watchdog on mid-incident needs the waits that are
+        ALREADY wedged to be scanned."""
+        with self._lock:
+            self.stall_seconds = max(float(stall_seconds), 0.0)
+            self.cancel_on_stall = bool(cancel_on_stall)
+            if self.stall_seconds:
+                self._ensure_thread_locked()
+        self._wake.set()
+
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpu-watchdog")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                stall = self.stall_seconds
+            interval = min(max(stall / 4.0, 0.05), 2.0) if stall else 2.0
+            self._wake.wait(interval)
+            self._wake.clear()
+            if stall:
+                try:
+                    self.scan()
+                except Exception:  # noqa: BLE001
+                    # the watchdog must never die to a report failure —
+                    # a broken scan is logged by crashdump, not fatal
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "watchdog scan failed", exc_info=True)
+
+    # -- wait registration (called from cancellable_wait & friends) ----------
+
+    def begin_wait(self, site: str, token=None) -> int:
+        now = time.monotonic()
+        rec = _WaitRecord(site, token, threading.current_thread().name, now)
+        with self._lock:
+            wid = next(self._seq)
+            self._waits[wid] = rec
+            if self.stall_seconds:
+                self._ensure_thread_locked()
+        return wid
+
+    def end_wait(self, wid: int) -> None:
+        with self._lock:
+            self._waits.pop(wid, None)
+
+    @contextmanager
+    def waiting(self, site: str, token=None):
+        wid = self.begin_wait(site, token)
+        try:
+            yield
+        finally:
+            self.end_wait(wid)
+
+    # -- scanning ------------------------------------------------------------
+
+    def waits_snapshot(self, now: Optional[float] = None) -> List[dict]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return [r.snapshot(now) for r in self._waits.values()]
+
+    def scan(self, now: Optional[float] = None) -> List[dict]:
+        """Flag (once each) every registered wait older than the stall
+        threshold; returns the newly-flagged wait snapshots."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            stall = self.stall_seconds
+            if not stall:
+                return []
+            fresh = [r for r in self._waits.values()
+                     if not r.reported and now - r.since > stall]
+            for r in fresh:
+                r.reported = True
+            all_waits = [r.snapshot(now) for r in self._waits.values()]
+            cancel_on_stall = self.cancel_on_stall
+        flagged = []
+        for rec in fresh:
+            snap = rec.snapshot(now)
+            flagged.append(snap)
+            report = {"stalled": snap, "all_waits": all_waits,
+                      "stall_seconds": stall,
+                      "cancel_on_stall": cancel_on_stall}
+            with self._lock:
+                self.last_report = report
+            from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+            SHUFFLE_COUNTERS.add(watchdog_stalls=1)
+            # crashdump bundles the thread stacks (the lock-holder view)
+            # alongside the registered waits; a disabled dump dir keeps
+            # the in-memory last_report only
+            from spark_rapids_tpu.utils import crashdump
+            crashdump.dump_now("watchdog_stall", extra=report)
+            if cancel_on_stall and rec.token is not None:
+                rec.token.cancel(
+                    f"watchdog: stalled {snap['waiting_s']:.1f}s at "
+                    f"{rec.site!r} (threshold {stall:.1f}s)")
+        return flagged
+
+    def reset(self) -> None:
+        """Tests: drop report state (registered waits stay — their
+        owners unregister themselves)."""
+        with self._lock:
+            self.last_report = None
+            for r in self._waits.values():
+                r.reported = False
+
+
+WATCHDOG = Watchdog()

@@ -1,0 +1,996 @@
+"""Cooperative cancellation, deadline propagation, and the stall
+watchdog (ISSUE 10 tentpole).
+
+Acceptance (all tier-1, in-process, event-gated — no timing flakes):
+
+  * ``test_serving_cancel_mid_flight_concurrent_query`` — local serving
+    variant: a mid-flight query is cancelled while a sibling runs
+    concurrently; counters prove its partition tasks stopped EARLY
+    (``tasks_cancelled``), admission slots and the tenant ledger return
+    to zero, and the sibling finishes with oracle-correct rows.
+  * ``test_cluster_cancel_real_engine_task_stops_early`` — a REAL
+    executor (executor_main thread, real engine) wedges mid-task in a
+    blessed wait; driver.cancel() stops it (``tasks_cancelled``) and a
+    sibling real query completes correctly afterward.
+  * ``test_cluster_cancel_drops_shuffle_state_on_every_peer`` —
+    protocol-level 2-rank harness with REAL shuffle nodes: cancel
+    broadcasts reach both peers' registered task tokens, every peer's
+    BlockStore is scrubbed of the query's shuffles, and a concurrently
+    submitted sibling query still returns the full dataset.
+  * ``test_watchdog_cancels_wedged_query_and_frees_server`` — a query
+    wedged via chaos ``serving.runner.stall`` is flagged by the
+    watchdog (stall report fires) and, under ``cancelOnStall``, the
+    server frees within the threshold instead of wedging.
+"""
+import pickle
+import queue as queue_mod
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.expressions import col, count, sum_
+from spark_rapids_tpu.expressions.core import Alias
+from spark_rapids_tpu.memory.tenant import TENANTS
+from spark_rapids_tpu.shuffle.stats import (
+    reset_shuffle_counters, shuffle_counters)
+from spark_rapids_tpu.testing.chaos import CHAOS, InjectedFault
+from spark_rapids_tpu.utils.cancel import (
+    CANCELS, CancelToken, QueryCancelled, cancel_scope, cancellable_wait,
+    check_cancelled, current_cancel_token)
+from spark_rapids_tpu.utils.watchdog import WATCHDOG
+
+SCHEMA = Schema.of(k=T.INT, v=T.LONG)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    CHAOS.clear()
+    reset_shuffle_counters()
+    TENANTS.reset()
+    WATCHDOG.configure(0.0, False)
+    WATCHDOG.reset()
+    yield
+    CHAOS.clear()
+    TENANTS.reset()
+    WATCHDOG.configure(0.0, False)
+    WATCHDOG.reset()
+
+
+def _wait_for(cond, timeout_s=15.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while not cond():
+        assert time.monotonic() < deadline, f"{what} never held"
+        time.sleep(0.01)
+
+
+# -- CancelToken unit semantics ----------------------------------------------
+
+def test_cancel_token_idempotent_and_cleanups_once():
+    tok = CancelToken("q1")
+    ran = []
+    tok.on_cancel(lambda: ran.append("a"))
+    assert not tok.cancelled()
+    assert tok.cancel("stop") is True
+    assert tok.cancel("again") is False         # idempotent
+    assert tok.reason == "stop"                 # first reason wins
+    assert ran == ["a"]
+    tok.on_cancel(lambda: ran.append("late"))   # already cancelled: runs now
+    assert ran == ["a", "late"]
+    with pytest.raises(QueryCancelled, match="stop"):
+        tok.check()
+
+
+def test_cancel_token_deadline_self_cancels_lazily():
+    clock = [0.0]
+    tok = CancelToken("q", deadline_s=5.0, clock=lambda: clock[0])
+    assert not tok.cancelled()
+    assert tok.remaining_s() == 5.0
+    clock[0] = 5.1
+    with pytest.raises(QueryCancelled, match="deadline exceeded"):
+        tok.check()
+    assert tok.reason.startswith("deadline exceeded")
+
+
+def test_ambient_scope_nesting_and_check_cancelled():
+    assert current_cancel_token() is None
+    check_cancelled()                            # no-op outside any scope
+    outer, inner = CancelToken("outer"), CancelToken("inner")
+    with cancel_scope(outer):
+        assert current_cancel_token() is outer
+        with inner.scope():
+            assert current_cancel_token() is inner
+        assert current_cancel_token() is outer
+        outer.cancel("x")
+        with pytest.raises(QueryCancelled):
+            check_cancelled()
+    assert current_cancel_token() is None
+
+
+# -- cancellable_wait: the one blessed way to block ---------------------------
+
+def test_cancellable_wait_event_queue_future_condition():
+    ev = threading.Event()
+    ev.set()
+    assert cancellable_wait(ev, site="t") is True
+    assert cancellable_wait(threading.Event(), timeout=0.05,
+                            site="t") is False
+    q = queue_mod.Queue()
+    q.put("item")
+    assert cancellable_wait(q, site="t") == "item"
+    with pytest.raises(queue_mod.Empty):
+        cancellable_wait(queue_mod.Queue(), timeout=0.05, site="t")
+    fut = Future()
+    fut.set_result(41)
+    assert cancellable_wait(fut, site="t") == 41
+    cv = threading.Condition()
+    flag = []
+    with cv:
+        assert cancellable_wait(cv, predicate=lambda: True,
+                                site="t") is True
+        assert cancellable_wait(cv, predicate=lambda: bool(flag),
+                                timeout=0.05, site="t") is False
+
+
+def test_cancellable_wait_raises_on_cancel_without_notify():
+    """A cancel wakes a waiter that never gets a notify — the property
+    that makes silent hangs killable."""
+    tok = CancelToken("q")
+    done = []
+
+    def waiter():
+        try:
+            cancellable_wait(threading.Event(), token=tok, site="t.block")
+        except QueryCancelled as e:
+            done.append(e)
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    _wait_for(lambda: WATCHDOG.waits_snapshot(), what="wait registered")
+    tok.cancel("killed")
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert done and "killed" in str(done[0])
+    assert WATCHDOG.waits_snapshot() == []       # deregistered on exit
+
+
+def test_cancellable_wait_registers_site_with_watchdog():
+    ev = threading.Event()
+    seen = []
+
+    def waiter():
+        cancellable_wait(ev, site="my.site", token=CancelToken("q9"))
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    _wait_for(lambda: WATCHDOG.waits_snapshot(), what="registration")
+    seen = WATCHDOG.waits_snapshot()
+    assert seen[0]["site"] == "my.site"
+    assert seen[0]["query"] == "q9"
+    ev.set()
+    t.join(timeout=10)
+
+
+# -- the stall watchdog -------------------------------------------------------
+
+def test_watchdog_flags_once_reports_and_counts():
+    WATCHDOG.configure(10.0, cancel_on_stall=False)
+    tok = CancelToken("wedged query")
+    wid = WATCHDOG.begin_wait("test.site", tok)
+    try:
+        now = time.monotonic()
+        assert WATCHDOG.scan(now=now) == []            # not stalled yet
+        flagged = WATCHDOG.scan(now=now + 11.0)
+        assert [f["site"] for f in flagged] == ["test.site"]
+        assert WATCHDOG.scan(now=now + 12.0) == []     # flagged ONCE
+        assert shuffle_counters()["watchdog_stalls"] == 1
+        rep = WATCHDOG.last_report
+        assert rep["stalled"]["site"] == "test.site"
+        assert rep["stalled"]["query"] == "wedged query"
+        assert any(w["site"] == "test.site" for w in rep["all_waits"])
+        assert not tok.cancelled()                     # cancelOnStall off
+    finally:
+        WATCHDOG.end_wait(wid)
+
+
+def test_watchdog_cancel_on_stall_cancels_the_stalled_query():
+    WATCHDOG.configure(5.0, cancel_on_stall=True)
+    tok = CancelToken("doomed")
+    wid = WATCHDOG.begin_wait("stuck.site", tok)
+    try:
+        WATCHDOG.scan(now=time.monotonic() + 6.0)
+        assert tok.cancelled()
+        assert "stuck.site" in (tok.reason or "")
+    finally:
+        WATCHDOG.end_wait(wid)
+
+
+def test_watchdog_cancels_wedged_query_and_frees_server():
+    """ACCEPTANCE: a query wedged via chaos serving.runner.stall is
+    flagged by the REAL watchdog daemon; under cancelOnStall the server
+    frees within ~the threshold (not the 60s wedge), the stall report
+    names the site, and the next submission succeeds immediately."""
+    from spark_rapids_tpu.serving import QueryQueue
+    WATCHDOG.configure(0.3, cancel_on_stall=True)
+    CHAOS.install("serving.runner.stall", count=1, seconds=60.0)
+    q = QueryQueue(lambda plan, ctx: ["ok"], conf={
+        "spark.rapids.serving.maxConcurrentQueries": "1",
+        "spark.rapids.serving.cache.enabled": "false"})
+    t0 = time.monotonic()
+    with pytest.raises(QueryCancelled, match="watchdog"):
+        q.submit({"p": "wedged"}, cacheable=False)
+    wall = time.monotonic() - t0
+    assert wall < 10.0, f"server stayed wedged {wall:.1f}s"
+    c = shuffle_counters()
+    assert c["watchdog_stalls"] >= 1
+    assert c["queries_cancelled"] == 1
+    assert WATCHDOG.last_report["stalled"]["site"] == \
+        "serving.runner.stall"
+    # the slot is free again: a fresh query runs through immediately
+    assert q.submit({"p": "next"}, cacheable=False) == ["ok"]
+    q.close()
+
+
+# -- chaos sites for the PR 8/9 threads ---------------------------------------
+
+def test_chaos_pipeline_producer_fail_propagates_to_consumer():
+    """Chaos shuffle.pipeline.producer.fail: the producer thread dies
+    mid-stream and the error re-raises at the consumer's next pull —
+    typed recovery, never a wedged hand-off."""
+    from spark_rapids_tpu.shuffle.pipeline import pipelined
+    CHAOS.install("shuffle.pipeline.producer.fail", count=1, skip=1,
+                  seed=7)
+    got = []
+    with pytest.raises(InjectedFault, match="producer.fail"):
+        for item in pipelined(iter(range(10)), lambda _x: 8, 1 << 20):
+            got.append(item)
+    assert got == [0]                 # one item crossed, then the fault
+    assert CHAOS.fired_count("shuffle.pipeline.producer.fail") == 1
+
+
+def test_pipeline_producer_and_consumer_unblock_on_cancel():
+    """A cancelled query's pipeline hand-off unblocks BOTH sides: the
+    consumer raises QueryCancelled and the producer thread exits its
+    loop instead of producing into a dead pipe forever."""
+    from spark_rapids_tpu.shuffle.pipeline import pipelined
+    import itertools
+    tok = CancelToken("piped")
+    produced = []
+
+    def source():
+        for i in itertools.count():
+            produced.append(i)
+            yield i
+    with cancel_scope(tok):
+        gen = pipelined(source(), lambda _x: 1 << 30, 1)  # tiny window
+        assert next(gen) == 0
+        tok.cancel("stop")
+        with pytest.raises(QueryCancelled):
+            for _ in gen:
+                pass
+    n0 = len(produced)
+    time.sleep(0.6)                   # producer exits within a slice
+    assert len(produced) <= n0 + 2, "producer kept producing after cancel"
+
+
+def test_chaos_runner_stall_report_without_cancel():
+    """serving.runner.stall with cancelOnStall OFF: the query survives
+    (the wedge ends on its own) but the watchdog REPORT still fired —
+    hangs are observable even when not killed."""
+    from spark_rapids_tpu.serving import QueryQueue
+    WATCHDOG.configure(0.15, cancel_on_stall=False)
+    CHAOS.install("serving.runner.stall", count=1, seconds=0.7)
+    q = QueryQueue(lambda plan, ctx: ["ok"], conf={
+        "spark.rapids.serving.cache.enabled": "false"})
+    assert q.submit({"p": 1}, cacheable=False) == ["ok"]
+    assert shuffle_counters()["watchdog_stalls"] >= 1
+    assert WATCHDOG.last_report["stalled"]["site"] == \
+        "serving.runner.stall"
+    q.close()
+
+
+# -- retry budget history (satellite) -----------------------------------------
+
+def test_retry_budget_exhaustion_names_attempts_and_elapsed():
+    from spark_rapids_tpu.utils.retry_budget import (
+        RetryBudget, RetryBudgetExhausted)
+    b = RetryBudget("hist", max_attempts=2, base_delay_s=0.0,
+                    max_delay_s=0.0, sleep=lambda s: None)
+    b.backoff()
+    b.backoff()
+    with pytest.raises(RetryBudgetExhausted) as e:
+        b.backoff(error=RuntimeError("boom"))
+    msg = str(e.value)
+    assert "'hist'" in msg
+    assert "2/2 retries" in msg, msg             # attempts made
+    assert "s elapsed" in msg, msg               # total elapsed seconds
+    assert "boom" in msg
+
+
+# -- serving-layer cancellation (local variant) -------------------------------
+
+def _mk_batches(n=2, nrows=20_000):
+    out = []
+    for i in range(n):
+        rng = np.random.RandomState(40 + i)
+        out.append(ColumnarBatch.from_pydict(
+            {"k": rng.randint(0, nrows, nrows).tolist(),
+             "v": rng.randint(-100, 100, nrows).tolist()}, SCHEMA))
+    return out
+
+
+def test_serving_cancel_mid_flight_concurrent_query():
+    """ACCEPTANCE (local serving variant): cancel a mid-flight query
+    while a sibling runs concurrently.  Counters prove the victim's
+    partition tasks stopped early (tasks_cancelled), its admission slot
+    and tenant ledger returned to zero, and the sibling finished with
+    oracle-correct rows."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.serving import LocalSessionRunner, QueryQueue
+    runner = LocalSessionRunner({})
+    sess = runner.session
+    batches = _mk_batches()
+    started = threading.Event()
+
+    def blocking_map(b):
+        started.set()
+        # blessed wait on the AMBIENT token (the engine's partition task
+        # established the scope): the cancel reaches it mid-batch
+        cancellable_wait(threading.Event(), timeout=30.0,
+                         site="test.victim.block")
+        return b
+    # the blocking map sits ABOVE the aggregate: the exchange's
+    # tenant-tagged CACHE_ONLY residency is live when the partition
+    # tasks wedge, so the cancel exercises a real ledger refund — and
+    # the wedge itself sits inside the engine's partition tasks (the
+    # tasks_cancelled counting site), not the map-side materialization
+    victim_plan = (sess.create_dataframe(list(batches), num_partitions=4)
+                   .group_by("k").agg(Alias(sum_(col("v")), "sv"))
+                   .map_batches(blocking_map,
+                                Schema.of(k=T.INT, sv=T.LONG)).plan)
+    sibling_df = (sess.create_dataframe(list(batches), num_partitions=2)
+                  .group_by("k").agg(Alias(sum_(col("v")), "sv"),
+                                     Alias(count(), "n")))
+    oracle = sorted(
+        TpuSession({"spark.rapids.sql.enabled": "false"})
+        .create_dataframe(list(batches), num_partitions=2)
+        .group_by("k").agg(Alias(sum_(col("v")), "sv"),
+                           Alias(count(), "n")).collect())
+
+    q = QueryQueue(runner, conf={
+        "spark.rapids.serving.maxConcurrentQueries": "2",
+        "spark.rapids.serving.cache.enabled": "false"})
+    fut = q.submit_async(victim_plan, tenant="victim", cacheable=False,
+                         query_id="victim-1")
+    assert started.wait(30), "victim never reached mid-flight"
+    # sibling submitted CONCURRENTLY with the in-flight victim
+    sib_fut = q.submit_async(sibling_df.plan, tenant="sib",
+                             cacheable=False)
+    assert q.cancel("victim-1", "user hit stop")
+    with pytest.raises(QueryCancelled, match="user hit stop"):
+        fut.result(timeout=60)
+    assert sorted(sib_fut.result(timeout=60)) == oracle
+    c = shuffle_counters()
+    assert c["tasks_cancelled"] >= 1, \
+        "victim tasks must stop early, not run to completion"
+    assert c["queries_cancelled"] == 1
+    # admission slot returned (both queries released their slots)
+    assert q._slots.available() == 2
+    # tenant ledger refunded: the victim REALLY held device residency
+    # (peak > 0) and every byte was credited back as its handles closed
+    # on the cancel unwind
+    snap = TENANTS.snapshot()
+    assert snap["victim"]["peak_bytes"] > 0
+    assert snap["victim"]["used_bytes"] == 0
+    # unknown ids are a clean no-op
+    assert q.cancel("victim-1") is False
+    q.close()
+
+
+def test_cancel_during_byte_admission_wait_returns_the_slot():
+    """REGRESSION (review finding): a query cancelled while waiting on
+    the byte-budget semaphore already HOLDS a slot — the unwind must
+    give it back, or every such cancel shrinks admission permanently."""
+    from spark_rapids_tpu.memory.arena import configure, device_arena
+    from spark_rapids_tpu.serving import QueryQueue
+    gate = threading.Event()
+    old = device_arena().budget_bytes
+    configure(1 << 20)
+    q = QueryQueue(lambda plan, ctx: [gate.wait(30), "ok"][1:], conf={
+        "spark.rapids.serving.maxConcurrentQueries": "2",
+        "spark.rapids.serving.admission.memoryFraction": "0.5",
+        "spark.rapids.serving.cache.enabled": "false"})
+    try:
+        # A takes a slot AND the whole byte budget, then blocks
+        fa = q.submit_async({"p": "a"}, est_bytes=1 << 30,
+                            cacheable=False, query_id="hog")
+        _wait_for(lambda: shuffle_counters()["queries_admitted"] == 1,
+                  what="A admitted")
+        # B takes the second slot, then parks on the byte semaphore
+        fb = q.submit_async({"p": "b"}, est_bytes=1 << 18,
+                            cacheable=False, query_id="parked")
+        _wait_for(lambda: q._bytes is not None and q._bytes.waiting() == 1,
+                  what="B parked on bytes")
+        assert q._slots.available() == 0
+        assert q.cancel("parked", "stop the parked query")
+        with pytest.raises(QueryCancelled):
+            fb.result(timeout=30)
+        gate.set()
+        assert fa.result(timeout=30) == ["ok"]
+        # BOTH slots and the whole byte budget are back
+        assert q._slots.available() == 2
+        assert q._bytes.available() == q.admission_bytes
+    finally:
+        gate.set()
+        q.close()
+        configure(old)
+
+
+def test_async_auto_id_is_exposed_and_cancellable():
+    """REGRESSION (review finding): an auto-assigned query_id must be
+    REACHABLE — submit_async pre-mints it onto the returned Future and
+    active_queries() lists it, so the common no-kwargs path still has a
+    cancel() handle."""
+    from spark_rapids_tpu.serving import QueryQueue
+    started = threading.Event()
+
+    def runner(plan, ctx):
+        started.set()
+        cancellable_wait(threading.Event(), timeout=30.0,
+                         site="test.autoid.hold")
+        return ["done"]
+    q = QueryQueue(runner, conf={
+        "spark.rapids.serving.cache.enabled": "false"})
+    try:
+        fut = q.submit_async({"p": 1}, cacheable=False)
+        assert isinstance(fut.query_id, str) and fut.query_id
+        assert started.wait(30)
+        assert q.active_queries() == [fut.query_id]
+        assert q.cancel(fut.query_id, "cancel via future id")
+        with pytest.raises(QueryCancelled, match="cancel via future"):
+            fut.result(timeout=30)
+        assert q.active_queries() == []
+    finally:
+        q.close()
+
+
+def test_watchdog_enabled_after_wait_registered_still_scans():
+    """REGRESSION (review finding): turning the watchdog ON mid-incident
+    must start the scanner daemon immediately — the already-wedged wait
+    is exactly the stall the operator enabled it for."""
+    tok = CancelToken("pre-wedged")
+    wid = WATCHDOG.begin_wait("pre.enable.site", tok)  # watchdog OFF
+    try:
+        time.sleep(0.3)                      # the wait is already old
+        WATCHDOG.configure(0.2, cancel_on_stall=True)
+        _wait_for(lambda: tok.cancelled(), timeout_s=10,
+                  what="daemon scanned the pre-existing wait")
+        assert "pre.enable.site" in (tok.reason or "")
+        assert shuffle_counters()["watchdog_stalls"] >= 1
+    finally:
+        WATCHDOG.end_wait(wid)
+
+
+def test_duplicate_active_query_id_rejected_not_orphaned():
+    """REGRESSION (review finding): re-submitting a query_id that is
+    still in flight must be rejected loudly — silently overwriting the
+    registration would orphan the first submission's token, making it
+    uncancellable (the exact leak this layer exists to prevent)."""
+    from spark_rapids_tpu.serving import QueryQueue
+    gate = threading.Event()
+
+    def runner(plan, ctx):
+        cancellable_wait(gate, timeout=30.0, site="test.dup.hold")
+        return ["ok"]
+    q = QueryQueue(runner, conf={
+        "spark.rapids.serving.maxConcurrentQueries": "2",
+        "spark.rapids.serving.cache.enabled": "false"})
+    try:
+        f1 = q.submit_async({"p": 1}, cacheable=False, query_id="dup")
+        _wait_for(lambda: "dup" in q._active, what="first registered")
+        with pytest.raises(ValueError, match="already in flight"):
+            q.submit({"p": 2}, cacheable=False, query_id="dup")
+        assert q.cancel("dup")          # the FIRST is still cancellable
+        with pytest.raises(QueryCancelled):
+            f1.result(timeout=30)
+        # the id frees once the submission finishes
+        gate.set()
+        assert q.submit({"p": 3}, cacheable=False,
+                        query_id="dup") == ["ok"]
+    finally:
+        gate.set()
+        q.close()
+
+
+def test_executor_token_treats_zero_shipped_deadline_as_expired():
+    """REGRESSION (review finding): a task shipped with deadline_s=0.0
+    (budget exhausted at dispatch) must self-cancel at entry — `or
+    None` would have inverted it into NO deadline at all."""
+    from spark_rapids_tpu.cluster.executor import run_task
+    with pytest.raises(QueryCancelled, match="deadline exceeded"):
+        run_task({"rank": 0, "world": 1, "query_id": 91,
+                  "deadline_s": 0.0}, b"", {})
+    assert shuffle_counters()["tasks_cancelled"] == 1
+    assert CANCELS.active(91) == 0      # registration unwound
+
+
+def test_driver_cancel_by_first_qid_survives_scoped_resubmit():
+    """REGRESSION (review finding): attempts share one token, so the
+    qid a caller read from active_queries() must keep cancelling the
+    query even after a retryable failure re-ran it under a fresh qid."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=5.0)
+    w = None
+    calls = [0]
+
+    def flaky_then_wedge(ex, task):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise RuntimeError("injected retryable failure")
+        qid = task["query_id"]
+        token = CancelToken(label=f"fake task q{qid}")
+        CANCELS.register(qid, token)
+        try:
+            with token.scope():
+                cancellable_wait(threading.Event(), timeout=30.0,
+                                 token=token, site="test.resubmit.wait")
+        finally:
+            CANCELS.unregister(qid, token)
+        return []
+
+    class _Retryable(_ProtoExecutor):
+        def _run(self):     # report the first failure as RETRYABLE
+            from spark_rapids_tpu.shuffle.net import PeerClient, _request
+            while not self.stop_ev.is_set():
+                try:
+                    PeerClient(self.driver.shuffle.server.addr).heartbeat(
+                        self.name)
+                    header, _ = _request(
+                        self.driver.rpc_addr,
+                        {"op": "get_task", "executor_id": self.name},
+                        retriable=False)
+                except OSError:
+                    time.sleep(0.02)
+                    continue
+                task = header.get("task")
+                if task is None:
+                    time.sleep(0.02)
+                    continue
+                try:
+                    out = self.behavior(self, task)
+                    hdr, payload = {}, pickle.dumps(out)
+                except Exception as e:  # noqa: BLE001 — relayed
+                    hdr, payload = {"error": repr(e),
+                                    "retryable": True}, b""
+                _request(self.driver.rpc_addr,
+                         dict({"op": "task_result",
+                               "query_id": task["query_id"],
+                               "executor_id": self.name,
+                               "rank": task.get("rank"),
+                               "attempt": task.get("attempt", 0)},
+                              **hdr), payload)
+    try:
+        w = _Retryable(driver, "w1", flaky_then_wedge)
+        driver.wait_for_executors(1, timeout_s=30)
+        errs = []
+
+        def run():
+            try:
+                driver.submit({"p": 1}, timeout_s=60, max_retries=3)
+                errs.append(None)
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        # wait for the SECOND attempt (fresh qid 2) to be wedged
+        _wait_for(lambda: CANCELS.active(2) == 1,
+                  what="resubmitted attempt running")
+        assert sorted(driver.active_queries()) == [1, 2]
+        # cancel by the ORIGINAL qid the caller captured first
+        assert driver.cancel(1, "cancel by first qid")
+        t.join(timeout=60)
+        assert errs and isinstance(errs[0], QueryCancelled), errs
+        assert driver.active_queries() == []
+    finally:
+        if w is not None:
+            w.close()
+        driver.close()
+
+
+def test_single_flight_follower_unblocked_with_leaders_cancel():
+    """A cancelled single-flight LEADER unblocks its followers with the
+    QueryCancelled itself — the fingerprint's one execution was
+    deliberately stopped, so followers must not re-run it."""
+    import os
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.serving import QueryQueue
+    import tempfile
+    d = tempfile.mkdtemp()
+    p = os.path.join(d, "t.parquet")
+    pq.write_table(pa.table({"k": np.arange(10, dtype=np.int64)}), p)
+    plan = TpuSession({}).read_parquet(p).group_by("k").agg(
+        Alias(count(), "n")).plan
+    gate = threading.Event()
+    runs = [0]
+
+    def runner(pl, ctx):
+        runs[0] += 1
+        cancellable_wait(gate, timeout=30.0, site="test.leader.block")
+        check_cancelled()
+        return [("x",)]
+    q = QueryQueue(runner, conf={
+        "spark.rapids.serving.maxConcurrentQueries": "4"})
+    lead = q.submit_async(plan, query_id="leader")
+    _wait_for(lambda: runs[0] == 1, what="leader running")
+    follow = q.submit_async(plan, query_id="follower")
+    _wait_for(lambda: len(q._inflight) == 1, what="single-flight entry")
+    # the follower is parked on the leader's future; cancelling the
+    # LEADER must unblock it with QueryCancelled, not trigger a re-run
+    assert q.cancel("leader", "leader cancelled")
+    with pytest.raises(QueryCancelled):
+        lead.result(timeout=60)
+    with pytest.raises(QueryCancelled):
+        follow.result(timeout=60)
+    assert runs[0] == 1, "follower re-ran a deliberately cancelled plan"
+    q.close()
+
+
+# -- cluster variant: real engine, real executor loop -------------------------
+
+#: module-level events so the pickled plan (by-reference, same process)
+#: can gate the executor-side map function deterministically
+_CLUSTER_STARTED = threading.Event()
+
+
+def _cluster_blocking_map(b):
+    _CLUSTER_STARTED.set()
+    cancellable_wait(threading.Event(), timeout=30.0,
+                     site="test.cluster.victim.block")
+    return b
+
+
+def test_cluster_cancel_real_engine_task_stops_early(tmp_path):
+    """ACCEPTANCE (cluster variant, real engine): a real executor_main
+    worker runs a real plan that wedges in a blessed wait mid-task;
+    driver.cancel() broadcasts cancel_query, the task aborts with
+    tasks_cancelled, the submitter gets QueryCancelled, and a sibling
+    real query completes correctly on the same executor afterward."""
+    import os
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    from spark_rapids_tpu.cluster.executor import executor_main
+    from spark_rapids_tpu.shuffle.transport import (
+        set_process_shuffle_executor)
+
+    paths = []
+    rng = np.random.RandomState(3)
+    for i in range(2):
+        p = os.path.join(str(tmp_path), f"in{i}.parquet")
+        pq.write_table(pa.table({
+            "k": rng.randint(0, 9, 300).astype(np.int64),
+            "v": rng.randint(-50, 50, 300).astype(np.int64)}), p)
+        paths.append(p)
+
+    _CLUSTER_STARTED.clear()
+    driver = TpuClusterDriver(conf={"spark.sql.shuffle.partitions": "2"})
+    stop_ev = threading.Event()
+    worker = threading.Thread(
+        target=executor_main,
+        args=(driver.rpc_addr,), kwargs={"executor_id": "cw1",
+                                         "stop_check": stop_ev.is_set},
+        daemon=True)
+    worker.start()
+    try:
+        driver.wait_for_executors(1, timeout_s=60)
+        s = TpuSession({})
+        victim_plan = (s.read_parquet(*paths)
+                       .map_batches(_cluster_blocking_map,
+                                    Schema.of(k=T.LONG, v=T.LONG))
+                       .group_by("k").agg(Alias(sum_(col("v")),
+                                                "sv")).plan)
+        sib_df = s.read_parquet(*paths).group_by("k").agg(
+            Alias(sum_(col("v")), "sv"), Alias(count(), "n"))
+        oracle = sorted(
+            TpuSession({"spark.rapids.sql.enabled": "false"})
+            .read_parquet(*paths).group_by("k").agg(
+                Alias(sum_(col("v")), "sv"),
+                Alias(count(), "n")).collect())
+        errs = []
+
+        def submit_victim():
+            try:
+                driver.submit(victim_plan, timeout_s=120)
+                errs.append(None)
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+        t = threading.Thread(target=submit_victim, daemon=True)
+        t.start()
+        assert _CLUSTER_STARTED.wait(60), "victim never reached the map"
+        _wait_for(lambda: driver.active_queries(), what="query active")
+        qid = driver.active_queries()[0]
+        assert driver.cancel(qid, "operator cancel")
+        t.join(timeout=60)
+        assert errs and isinstance(errs[0], QueryCancelled), errs
+        # task observed the cancel and aborted early (product counter
+        # from the REAL run_task path)
+        _wait_for(lambda: shuffle_counters()["tasks_cancelled"] >= 1,
+                  what="executor task abort")
+        c = shuffle_counters()
+        assert c["queries_cancelled"] >= 1
+        assert c["cancel_broadcasts"] >= 1
+        assert driver.cancel(qid) is False      # finished: no handle
+        # the SAME executor serves a sibling query correctly afterward
+        got = sorted(tuple(r)
+                     for r in driver.submit(sib_df.plan, timeout_s=120))
+        assert got == oracle
+    finally:
+        stop_ev.set()
+        worker.join(timeout=15)
+        set_process_shuffle_executor(None)
+        driver.close()
+
+
+# -- cluster variant: protocol-level peers, shuffle-state teardown ------------
+
+class _ProtoExecutor:
+    """FakeExecutor speaking the driver protocol with a REAL shuffle
+    node (tests/test_elastic.py lineage), whose behavior registers a
+    REAL CancelToken in CANCELS — the product registry the driver's
+    cancel_query broadcast targets."""
+
+    def __init__(self, driver, name, behavior):
+        from spark_rapids_tpu.shuffle.net import ShuffleExecutor
+        self.driver = driver
+        self.name = name
+        self.behavior = behavior
+        self.node = ShuffleExecutor(
+            name, driver_addr=driver.shuffle.server.addr)
+        self.stop_ev = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        from spark_rapids_tpu.shuffle.net import PeerClient, _request
+        while not self.stop_ev.is_set():
+            try:
+                PeerClient(self.driver.shuffle.server.addr).heartbeat(
+                    self.name)
+                header, payload = _request(
+                    self.driver.rpc_addr,
+                    {"op": "get_task", "executor_id": self.name},
+                    retriable=False)
+            except OSError:
+                time.sleep(0.02)
+                continue
+            task = header.get("task")
+            if task is None:
+                time.sleep(0.02)
+                continue
+            try:
+                out = self.behavior(self, task)
+                if out == "die":        # process death: no push, no beat
+                    self.stop_ev.set()
+                    self.node.close()
+                    return
+                hdr, payload = {}, pickle.dumps(out)
+            except Exception as e:  # noqa: BLE001 — relayed as failure
+                hdr, payload = {"error": repr(e),
+                                "retryable": False}, b""
+            try:
+                _request(self.driver.rpc_addr,
+                         dict({"op": "task_result",
+                               "query_id": task["query_id"],
+                               "executor_id": self.name,
+                               "rank": task.get("rank"),
+                               "attempt": task.get("attempt", 0)},
+                              **hdr), payload)
+            except OSError:
+                pass
+
+    def close(self):
+        self.stop_ev.set()
+        self.thread.join(timeout=10)
+        self.node.close()
+
+
+def _proto_transport(ex, task):
+    from spark_rapids_tpu.shuffle.net import TcpShuffleTransport
+    ex.node.heartbeat()
+    return TcpShuffleTransport(
+        ex.node, 2, SCHEMA, shuffle_id=(task["query_id"] << 16) | 0,
+        participants=task["participants"],
+        attempt=task.get("attempt", 0), logical_id=task.get("as"),
+        completeness_timeout_s=30)
+
+
+def _proto_rows(ex, task, t):
+    """Write this rank's share, reduce its partitions (rows 0..159)."""
+    rank, world = task["rank"], task["world"]
+    vals = [i for i in range(160) if (i // 10) % world == rank]
+    t.write([(0, ColumnarBatch.from_pydict(
+        {"k": [v % 3 for v in vals if v < 80],
+         "v": [v for v in vals if v < 80]}, SCHEMA)),
+        (1, ColumnarBatch.from_pydict(
+            {"k": [v % 3 for v in vals if v >= 80],
+             "v": [v for v in vals if v >= 80]}, SCHEMA))])
+    out = []
+    for p in range(2):
+        if p % world != rank:
+            continue
+        got = []
+        for b in t.read(p):
+            got.extend(int(v) for v in b.to_pydict()["v"])
+        out.append((p, [[v] for v in sorted(got)]))
+    return out
+
+
+def test_cluster_cancel_drops_shuffle_state_on_every_peer():
+    """ACCEPTANCE (cluster variant, shuffle teardown): both ranks write
+    REAL map output then wedge in a registered blessed wait;
+    driver.cancel() reaches them through the cancel_query broadcast
+    (CANCELS registry), the submitter gets QueryCancelled, every peer's
+    BlockStore is scrubbed of the query's shuffles, and a sibling query
+    submitted concurrently completes with the full dataset."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=5.0)
+    w1 = w2 = None
+
+    def victim_or_sibling(ex, task):
+        qid = task["query_id"]
+        if qid == 1:                       # the victim query
+            t = _proto_transport(ex, task)
+            _proto_rows_written.set()
+            vals = list(range(80)) if task["rank"] == 0 else \
+                list(range(80, 160))
+            t.write([(0, ColumnarBatch.from_pydict(
+                {"k": [v % 3 for v in vals], "v": vals}, SCHEMA))])
+            token = CancelToken(label=f"fake task q{qid}")
+            CANCELS.register(qid, token)
+            try:
+                with token.scope():
+                    cancellable_wait(threading.Event(), timeout=30.0,
+                                     token=token, site="test.proto.wait")
+            finally:
+                CANCELS.unregister(qid, token)
+            return []                      # unreachable when cancelled
+        t = _proto_transport(ex, task)
+        return _proto_rows(ex, task, t)
+
+    _proto_rows_written = threading.Event()
+    try:
+        w1 = _ProtoExecutor(driver, "w1", victim_or_sibling)
+        w2 = _ProtoExecutor(driver, "w2", victim_or_sibling)
+        driver.wait_for_executors(2, timeout_s=30)
+        errs = []
+
+        def submit_victim():
+            try:
+                driver.submit({"victim": True}, timeout_s=60)
+                errs.append(None)
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+        tv = threading.Thread(target=submit_victim, daemon=True)
+        tv.start()
+        # both ranks registered their task tokens -> map output exists
+        _wait_for(lambda: CANCELS.active(1) == 2,
+                  what="both ranks registered")
+        assert any(s >> 16 == 1 for s in w1.node.store.shuffle_ids())
+        # sibling submitted CONCURRENTLY (queues behind the wedged
+        # victim tasks on both executors)
+        sib_rows = []
+        ts = threading.Thread(
+            target=lambda: sib_rows.extend(
+                driver.submit({"sibling": True}, timeout_s=60)),
+            daemon=True)
+        ts.start()
+        assert driver.cancel(1, "operator cancel")
+        tv.join(timeout=60)
+        assert errs and isinstance(errs[0], QueryCancelled), errs
+        ts.join(timeout=60)
+        assert [list(r) for r in sib_rows] == [[v] for v in range(160)]
+        # shuffle state of the cancelled query is GONE on every peer
+        for w in (w1, w2):
+            _wait_for(lambda w=w: not [s for s in
+                                       w.node.store.shuffle_ids()
+                                       if s >> 16 == 1],
+                      what=f"{w.name} store scrubbed")
+        c = shuffle_counters()
+        assert c["cancel_broadcasts"] >= 1
+        assert c["queries_cancelled"] >= 1
+    finally:
+        for w in (w1, w2):
+            if w is not None:
+                w.close()
+        driver.close()
+
+
+# -- deadline propagation -----------------------------------------------------
+
+def test_serving_query_deadline_cancels_runaway():
+    """spark.rapids.serving.query.deadline derives the token: a runaway
+    runner is stopped at its next blessed wait / check with a typed
+    QueryCancelled naming the deadline."""
+    from spark_rapids_tpu.serving import QueryQueue
+
+    def runaway(plan, ctx):
+        cancellable_wait(threading.Event(), timeout=30.0,
+                         site="test.runaway")
+        return ["never"]
+    q = QueryQueue(runaway, conf={
+        "spark.rapids.serving.query.deadline": "0.3",
+        "spark.rapids.serving.cache.enabled": "false"})
+    t0 = time.monotonic()
+    with pytest.raises(QueryCancelled, match="deadline exceeded"):
+        q.submit({"p": 1}, cacheable=False)
+    assert time.monotonic() - t0 < 10.0
+    assert shuffle_counters()["queries_cancelled"] == 1
+    q.close()
+
+
+def test_cluster_task_proto_carries_deadline():
+    """The driver ships the remaining budget with every task so
+    executor-side tokens self-cancel past it (deadline propagation)."""
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=5.0)
+    seen = {}
+
+    def record(ex, task):
+        seen.update(task)
+        return [(p, [[0]]) for p in range(4)
+                if p % task["world"] == task["rank"]]
+    w = None
+    try:
+        w = _ProtoExecutor(driver, "w1", record)
+        driver.wait_for_executors(1, timeout_s=30)
+        driver.submit({"plan": 1}, timeout_s=60, deadline_s=45.0)
+        assert 0 < seen.get("deadline_s", 0) <= 45.0
+    finally:
+        if w is not None:
+            w.close()
+        driver.close()
+
+
+# -- fetch plane: a cancelled consumer is not hostage to a stalled peer -------
+
+def test_fetch_consumer_unblocks_on_cancel_during_server_stall():
+    """Chaos-stalled peer + cancel: the BlockFetchIterator consumer
+    wakes with QueryCancelled within a wait slice, instead of sitting
+    out the peer's 60s socket timeout."""
+    from spark_rapids_tpu.shuffle.net import (
+        BlockFetchIterator, PeerClient, ShuffleExecutor)
+    a = ShuffleExecutor("fa", serve_registry=True)
+    b = ShuffleExecutor("fb", driver_addr=a.server.addr)
+    try:
+        b.store.put(9001, 0, b"x" * 1024)
+        b.store.note_commit(9001, "fb", 0)
+        b.store.mark_complete(9001)
+        CHAOS.install("shuffle.serve.stall", count=-1, seconds=20.0)
+        peer = PeerClient(b.server.addr, executor_id="fb")
+        peer.serve_src = "fb"
+        tok = CancelToken("fetching query")
+        out = []
+
+        def consume():
+            try:
+                with cancel_scope(tok):
+                    for blk in BlockFetchIterator([peer], 9001, 0):
+                        out.append(blk)
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                out.append(e)
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)                 # consumer parked on the queue
+        tok.cancel("user stop")
+        t.join(timeout=10)
+        assert not t.is_alive(), "consumer stayed hostage to the stall"
+        assert out and isinstance(out[-1], QueryCancelled)
+    finally:
+        CHAOS.clear()
+        b.close()
+        a.close()

@@ -20,7 +20,7 @@ if REPO not in sys.path:
 
 from tools.tpulint import core as lint_core
 from tools.tpulint import (drift, host_sync, locks, retry_discipline,
-                           swallow)
+                           swallow, waits)
 
 
 def _src(path: str, text: str) -> lint_core.SourceFile:
@@ -318,6 +318,79 @@ def test_heartbeat_swallow_was_fixed():
     """)
     vs = swallow.check([src])
     assert len(vs) == 1 and vs[0].scope == "_beat"
+
+
+def test_unbounded_wait_fires_on_each_form():
+    """The unbounded-wait rule flags every no-timeout blocking form the
+    cancellation/watchdog layer cannot see (ISSUE 10 satellite): raw
+    Condition/Event wait(), Future.result(), queue-ish get()."""
+    src = _src("spark_rapids_tpu/shuffle/_fixture.py", """
+        def f(cv, ev, fut, q):
+            with cv:
+                cv.wait()
+            ev.wait()
+            fut.result()
+            q.get()
+            fut.result(timeout=None)
+    """)
+    msgs = [v.message for v in waits.check([src])]
+    assert len(msgs) == 5, msgs
+    assert sum("`.wait()`" in m for m in msgs) == 2
+    assert sum("`.result()`" in m for m in msgs) == 2
+    assert sum("queue `.get()`" in m for m in msgs) == 1
+
+
+def test_unbounded_wait_accepts_bounded_and_nonqueue_forms():
+    src = _src("spark_rapids_tpu/shuffle/_fixture.py", """
+        from spark_rapids_tpu.utils.cancel import cancellable_wait
+
+        def f(cv, ev, fut, q, task_metrics, conf):
+            with cv:
+                cv.wait(0.25)                      # bounded slice
+            ev.wait(timeout=2.0)
+            fut.result(timeout=30)
+            q.get(timeout=0.1)
+            task_metrics.get()                     # accessor, not a queue
+            conf.get("key")                        # dict-style get
+            cancellable_wait(ev, site="x")         # the blessed form
+    """)
+    assert waits.check([src]) == []
+
+
+def test_unbounded_wait_pre_fix_semaphore_shape_fires():
+    """Regression pin: PrioritySemaphore.acquire's old no-deadline
+    branch — a bare ``self._cv.wait()`` a cancelled query could never
+    escape (the PR 9 deadlock class) — is exactly what this rule flags.
+    The live semaphore now waits in bounded slices with ambient-token
+    checks and watchdog registration (the repo gate proves it clean)."""
+    src = _src("spark_rapids_tpu/memory/_fixture.py", """
+        class Sem:
+            def acquire(self, deadline=None):
+                with self._cv:
+                    while not self._head():
+                        if deadline is not None:
+                            self._cv.wait(deadline)
+                        else:
+                            self._cv.wait()
+    """)
+    vs = waits.check([src])
+    assert len(vs) == 1 and vs[0].scope == "Sem.acquire"
+
+
+def test_unbounded_wait_suppression_and_exempt_module():
+    src = _src("spark_rapids_tpu/io/_fixture.py", """
+        def f(throttle):
+            # tpu-lint: allow-unbounded-wait(drains via a blessed cancellable_wait internally)
+            throttle.wait()
+    """)
+    assert _unsuppressed(waits.check([src]), src) == []
+    # utils/cancel.py IS the blessed implementation: exempt wholesale
+    exempt = _src("spark_rapids_tpu/utils/cancel.py", """
+        def f(cv):
+            with cv:
+                cv.wait()
+    """)
+    assert waits.check([exempt]) == []
 
 
 def test_suppression_requires_a_reason():

@@ -43,9 +43,14 @@ SUITES = {
              "tests/test_out_of_core_joins_full.py",
              "tests/test_memory.py"], 900),
     "gauntlet": (["tests/test_tpcds_gauntlet.py"], 1200),
-    "serving": (["tests/test_serving.py", "tests/test_agg_tail.py"], 600),
+    "serving": (["tests/test_serving.py", "tests/test_agg_tail.py",
+                 "tests/test_cancel.py"], 900),
     "pipeline": (["tests/test_fused_shuffle.py", "tests/test_fused.py",
                   "tests/test_aqe_coalesce.py"], 1200, ""),
+    # slow-marked chaos soak (kill/revive/delay at 6+ ranks under
+    # replication + speculation + watchdog): marker override runs what
+    # tier-1 skips by budget
+    "soak": (["tests/test_soak.py"], 1200, ""),
     "lint": (["tests/test_lint.py"], 300),
 }
 

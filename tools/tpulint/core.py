@@ -190,7 +190,7 @@ def run_all(repo_root: str = REPO,
     """Run every enabled checker; returns raw violations (inline
     suppressions already applied, baseline NOT yet applied)."""
     from tools.tpulint import (drift, host_sync, locks, retry_discipline,
-                               swallow)
+                               swallow, waits)
 
     enabled = set(rules) if rules else None
 
@@ -214,6 +214,7 @@ def run_all(repo_root: str = REPO,
         ("host-sync", host_sync.check),
         ("lock-order", locks.check),
         ("swallow", swallow.check),
+        ("unbounded-wait", waits.check),
     ]
     for rule, fn in checkers:
         if on(rule):

@@ -1,0 +1,105 @@
+"""unbounded-wait checker: no-timeout blocking calls in engine code.
+
+PR 9's verify drive found a real deadlock (every device-semaphore slot
+held by consumers parked on a producer's queue), and the watchdog /
+cancellation layer (utils/cancel.py, utils/watchdog.py) only sees waits
+that go through the blessed ``cancellable_wait`` — a raw no-timeout
+block is invisible to the watchdog AND immune to cancellation, so a
+wedge there is a silent, unkillable hang.  Flagged forms inside
+``spark_rapids_tpu/``:
+
+  (a) ``<expr>.wait()`` with no arguments — ``Condition.wait()`` /
+      ``Event.wait()`` with no timeout;
+  (b) ``<expr>.result()`` with no arguments — ``Future.result()`` with
+      no timeout;
+  (c) ``<queue-ish>.get()`` with no arguments, where the receiver's
+      name is queue-like (exactly ``q``/``queue``/``pipe`` or
+      containing ``queue``) — ``Queue.get()`` with no timeout.  The
+      name filter keeps zero-arg accessor idioms (``task_metrics.get()``
+      and friends) out of scope; a queue hidden behind another name is
+      what review is for.
+
+An explicit ``timeout=None`` keyword counts as unbounded.  The fix is
+``utils/cancel.cancellable_wait`` (bounded slices + token checks +
+watchdog registration); deliberate raw waits carry
+``# tpu-lint: allow-unbounded-wait(reason)``.  utils/cancel.py itself
+is exempt — it IS the blessed implementation.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.tpulint.core import ScopedVisitor, SourceFile, Violation, dotted
+
+RULE = "unbounded-wait"
+
+#: the one module allowed to implement raw bounded-slice waits
+EXEMPT_FILES = {"spark_rapids_tpu/utils/cancel.py"}
+
+QUEUEISH = ("q", "queue", "pipe")
+
+
+def _receiver_name(call: ast.Call) -> str:
+    """Last dotted component of the receiver ('q' for q.get(),
+    'self._cv' -> '_cv')."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        recv = dotted(func.value)
+        return recv.rsplit(".", 1)[-1] if recv else ""
+    return ""
+
+
+def _timeout_unbounded(call: ast.Call) -> bool:
+    """True when the call passes NO bound: zero positional args and no
+    timeout= keyword (or an explicit timeout=None)."""
+    if call.args:
+        return False
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return isinstance(kw.value, ast.Constant) and \
+                kw.value.value is None
+    return True
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, src: SourceFile):
+        super().__init__()
+        self.src = src
+        self.out: List[Violation] = []
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) and _timeout_unbounded(node):
+            attr = func.attr
+            recv = _receiver_name(node)
+            hit = None
+            if attr == "wait":
+                hit = ("`.wait()` with no timeout blocks unboundedly "
+                       "(invisible to the watchdog, immune to cancel); "
+                       "use utils/cancel.cancellable_wait or pass a "
+                       "timeout")
+            elif attr == "result":
+                hit = ("`.result()` with no timeout blocks unboundedly "
+                       "on the future; use cancellable_wait(future) or "
+                       "pass a timeout")
+            elif attr == "get" and (recv in QUEUEISH
+                                    or "queue" in recv.lower()):
+                hit = ("queue `.get()` with no timeout blocks "
+                       "unboundedly; use cancellable_wait(queue) or "
+                       "pass a timeout")
+            if hit is not None:
+                self.out.append(Violation(RULE, self.src.path,
+                                          node.lineno, self.scope, hit))
+        self.generic_visit(node)
+
+
+def check(sources: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for src in sources:
+        if src.path in EXEMPT_FILES:
+            continue
+        v = _Visitor(src)
+        v.visit(src.tree)
+        out.extend(v.out)
+    return out

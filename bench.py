@@ -27,6 +27,15 @@ mid-run tunnel death only loses the queries that hadn't finished yet:
 With SPARK_RAPIDS_TPU_BENCH_PROFILE=<dir> (set automatically for the
 first tpu query) the child wraps the timed run in jax.profiler.trace so
 step time/MFU are computable from the dump.
+
+With --profile (or SPARK_RAPIDS_TPU_BENCH_PROGPROF=1) each query child
+runs one EXTRA pass with per-program attribution armed (plan/execs/base
+enable_launch_profile: every shared_jit dispatch timed through
+block_until_ready + its output row capacity recorded) and emits the topN
+programs by wall time as "prog_profile" in its JSON line — the mode that
+names a query's structural wall by data instead of guesswork.  The
+attribution pass is separate from the timed run (blocking serializes the
+dispatch pipeline), so rows/s numbers are unaffected.
 """
 from __future__ import annotations
 
@@ -74,8 +83,15 @@ def _query_timeout_s(backend: str, qname: str) -> int:
     env = os.environ.get(f"SPARK_RAPIDS_TPU_BENCH_TIMEOUT_{qname.upper()}")
     if env is not None:
         return int(env)
-    return max(QUERY_TIMEOUT_S[backend],
+    base = max(QUERY_TIMEOUT_S[backend],
                QUERY_TIMEOUT_OVERRIDES_S.get(qname, 0))
+    if (os.environ.get("SPARK_RAPIDS_TPU_BENCH_PROGPROF")
+            or "--profile" in sys.argv):
+        # the attribution pass re-runs the whole query with every
+        # dispatch blocked — slower than the timed run itself, so a
+        # profiled child needs headroom beyond the unprofiled ceiling
+        base *= 2
+    return base
 
 
 QUERIES = ("q6",) if SMOKE else ("q6", "q1", "q3", "q25", "q72")
@@ -242,6 +258,24 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
     stats = launch_stats()          # exact program-dispatch counts
     shuffle = local_shuffle_counters()  # data-plane behavior per query
 
+    prog_profile = None
+    if os.environ.get("SPARK_RAPIDS_TPU_BENCH_PROGPROF"):
+        # per-program attribution runs a SEPARATE pass: dispatches block
+        # (block_until_ready per program) so execution time is charged to
+        # the program that ran it, which would distort the timed run
+        from spark_rapids_tpu.plan.execs.base import (
+            disable_launch_profile, enable_launch_profile)
+        enable_launch_profile()
+        try:
+            run(tpu_sess)
+        finally:
+            prof = disable_launch_profile()
+        prog_profile = [
+            {"program": k[:160], "launches": v["launches"], "ns": v["ns"],
+             "rows": v["rows"]}
+            for k, v in sorted(prof.items(),
+                               key=lambda kv: -kv[1]["ns"])[:12]]
+
     util = None
     profile_dir = os.environ.get("SPARK_RAPIDS_TPU_BENCH_PROFILE")
     if profile_dir:
@@ -291,6 +325,7 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
             1),
         "shuffle": shuffle,
         "input_bytes": input_bytes,
+        **({"prog_profile": prog_profile} if prog_profile else {}),
         **({"util": util} if util else {}),
         **({"profile_dir": profile_dir} if profile_dir else {}),
     }))
@@ -462,6 +497,13 @@ def _child_mode() -> Optional[tuple]:
 def main() -> None:
     errors = []
     per_query = {}
+    # --profile: arm per-program wall-clock/rows attribution in every
+    # query child (an extra pass per query; the timed numbers are
+    # unaffected — see module doc)
+    prof_env = ({"SPARK_RAPIDS_TPU_BENCH_PROGPROF": "1"}
+                if ("--profile" in sys.argv
+                    or os.environ.get("SPARK_RAPIDS_TPU_BENCH_PROGPROF"))
+                else {})
 
     probe, err = _spawn("tpu", "probe", PROBE_TIMEOUT_S)
     tpu_alive = probe is not None and probe.get("platform") not in (None, "cpu")
@@ -475,7 +517,7 @@ def main() -> None:
                 errors.append(werr)   # non-fatal: timed children compile
         profiled = False
         for q in QUERIES:
-            extra = {}
+            extra = dict(prof_env)
             if not profiled:
                 extra["SPARK_RAPIDS_TPU_BENCH_PROFILE"] = os.path.abspath(
                     os.environ.get("SPARK_RAPIDS_TPU_BENCH_PROFILE_DIR",
@@ -492,7 +534,7 @@ def main() -> None:
         if q in per_query:
             continue
         result, err = _spawn("cpu", f"query:{q}",
-                             _query_timeout_s("cpu", q))
+                             _query_timeout_s("cpu", q), prof_env)
         if result is not None:
             per_query[q] = result
         else:

@@ -237,6 +237,18 @@ SHUFFLE_RANGE_SERIALIZE = conf("spark.rapids.shuffle.write.rangeSerialize").doc(
     "on; CACHE_ONLY always keeps device-resident spillable slices."
 ).boolean_conf(True)
 
+SHUFFLE_CACHE_RANGE_VIEWS = conf("spark.rapids.shuffle.cacheOnly.rangeViews").doc(
+    "Device-resident range views for the CACHE_ONLY shuffle store — the "
+    "device twin of rangeSerialize: the map side stores ONE partition-"
+    "reordered spillable batch per map batch (plus host counts) and each "
+    "reduce partition's block is a (backing, start, count) range view; "
+    "fused consumers slice the view INSIDE their own program, so the "
+    "standalone per-partition slice/gather programs (slice_gather_"
+    "programs) never run.  Non-fused consumers (out-of-core joins, sort) "
+    "get a standalone slice at read time (range_view_materializes). "
+    "Escape hatch, default on; wire transports ignore it."
+).boolean_conf(True)
+
 SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").doc(
     "Compression for shuffle wire buffers: none, zstd, lz4 (reference: "
     "TableCompressionCodec.scala; device nvcomp is N/A on TPU so compression "
@@ -733,6 +745,10 @@ class RapidsConf:
     @property
     def shuffle_range_serialize(self) -> bool:
         return self.get(SHUFFLE_RANGE_SERIALIZE)
+
+    @property
+    def shuffle_cache_range_views(self) -> bool:
+        return self.get(SHUFFLE_CACHE_RANGE_VIEWS)
 
     @property
     def spill_checksum_enabled(self) -> bool:

@@ -46,6 +46,11 @@ class _LaunchStats:
     lock = __import__("threading").Lock()
     count = 0
     unique = set()      # distinct program keys dispatched since reset
+    #: per-program attribution mode (bench.py --profile): program key ->
+    #: [launches, blocked wall ns, output row capacity].  None = off (the
+    #: default — attribution BLOCKS on each dispatch to charge execution
+    #: to the program that ran it, so it must never time a real run).
+    profile = None
 
 
 def reset_launch_stats() -> None:
@@ -60,12 +65,59 @@ def launch_stats() -> dict:
                 "programs": len(_LaunchStats.unique)}
 
 
+def enable_launch_profile() -> None:
+    """Arm per-program wall-clock/rows attribution: every shared_jit
+    dispatch is timed THROUGH block_until_ready (async dispatch would
+    otherwise bill a program's execution to whoever syncs next) and its
+    output batch capacities recorded.  Profile runs are SEPARATE from
+    timed runs — blocking serializes the dispatch pipeline."""
+    with _LaunchStats.lock:
+        _LaunchStats.profile = {}
+
+
+def disable_launch_profile() -> dict:
+    """Disarm attribution and return {key: {launches, ns, rows}}."""
+    with _LaunchStats.lock:
+        prof = _LaunchStats.profile or {}
+        _LaunchStats.profile = None
+    return {k: {"launches": v[0], "ns": v[1], "rows": v[2]}
+            for k, v in prof.items()}
+
+
+def _out_row_capacity(out) -> int:
+    """Static output row capacity summed over every ColumnarBatch in a
+    program result pytree (capacity is static — no device sync)."""
+    if isinstance(out, ColumnarBatch):
+        return out.capacity
+    if isinstance(out, (tuple, list)):
+        return sum(_out_row_capacity(x) for x in out)
+    if isinstance(out, dict):
+        return sum(_out_row_capacity(x) for x in out.values())
+    return 0
+
+
 def _counted(key: str, fn):
     def wrapper(*a, **k):
         with _LaunchStats.lock:
             _LaunchStats.count += 1
             _LaunchStats.unique.add(key)
-        return fn(*a, **k)
+            profiling = _LaunchStats.profile is not None
+        if not profiling:
+            return fn(*a, **k)
+        t0 = time.perf_counter_ns()
+        out = fn(*a, **k)
+        import jax
+        # tpu-lint: allow-host-sync(attribution mode only: armed by enable_launch_profile for a dedicated profile run, never a timed one)
+        jax.block_until_ready(out)
+        ns = time.perf_counter_ns() - t0
+        rows = _out_row_capacity(out)
+        with _LaunchStats.lock:
+            if _LaunchStats.profile is not None:
+                ent = _LaunchStats.profile.setdefault(key, [0, 0, 0])
+                ent[0] += 1
+                ent[1] += ns
+                ent[2] += rows
+        return out
     wrapper.__wrapped__ = fn
     return wrapper
 

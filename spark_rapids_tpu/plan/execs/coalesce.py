@@ -151,6 +151,14 @@ def retry_over_stream_pieces(piece_lists, body):
     so the spill can free exactly the inputs the next attempt will bring
     back.
 
+    Range-view pieces (CACHE_ONLY range-view store) share one BACKING
+    handle across several views: the backing pins EXACTLY ONCE per
+    attempt — later views of a backing already materialized this attempt
+    reuse its batch through as_view() with no extra pin, so the unwind
+    leaves the backing's pin count exactly where the attempt found it
+    (N pins would still balance, but the dedup also collapses N
+    materialize calls on the shared handle to one).
+
     ``body`` must not keep the materialized batches alive past its
     return; piece ownership (close) stays with the transport.
     """
@@ -163,13 +171,21 @@ def retry_over_stream_pieces(piece_lists, body):
         # cancellation point per attempt (see retry_over_spillable)
         check_cancelled()
         pinned = []
+        backings = {}   # backing_key -> materialized backing batch
         try:
             mats = []
             for lst in piece_lists:
                 cur = []
                 for p in lst:
-                    cur.append(p.materialize_pinned())
+                    bk = p.backing_key()
+                    if bk is not None and bk in backings:
+                        cur.append(p.as_view(backings[bk]))
+                        continue
+                    m = p.materialize_pinned()
                     pinned.append(p)
+                    if bk is not None:
+                        backings[bk] = p.backing_of(m)
+                    cur.append(m)
                 mats.append(cur)
             return body(mats)
         finally:

@@ -175,9 +175,10 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def _slices(self):
         """Device-slice write path: (partition, device piece) per
-        non-empty partition of every input batch.  CACHE_ONLY keeps this
-        (its handles must stay device-resident and spillable); wire
-        transports only fall back here when range serialization is off
+        non-empty partition of every input batch.  CACHE_ONLY only falls
+        back here when range views are off (its handles must stay
+        device-resident and spillable, so it never takes the wire range
+        path); wire transports fall back when range serialization is off
         or the schema is nested.  Per-partition row counts are recorded
         as they stream past — the MapStatus sizes AQE coalescing plans
         from."""
@@ -186,11 +187,25 @@ class TpuShuffleExchangeExec(TpuExec):
             with timed(self.op_time):
                 host_counts = np.asarray(counts)  # ONE sync per batch
                 pieces = slice_by_counts(reordered, host_counts,
-                                         self.out_partitions)
+                                         self.out_partitions,
+                                         count_stat=True)
                 self._record_part_rows(host_counts)
                 for p, piece in enumerate(pieces):
                     if piece is not None:
                         yield p, piece
+
+    def _range_views(self):
+        """Range-view write path (CACHE_ONLY): (partition-reordered
+        batch, host counts) per map batch — NO slicing at all.  The
+        transport stores the batch as ONE spillable backing handle and
+        each partition's block becomes a (backing, start, count) range
+        view that fused consumers slice inside their own program (the
+        device twin of _range_stream's wire-range framing)."""
+        for reordered, counts in self._partitioned():
+            with timed(self.op_time):
+                host_counts = np.asarray(counts)  # ONE sync per batch
+            self._record_part_rows(host_counts)
+            yield reordered, host_counts
 
     def _range_stream(self):
         """Range-serialization write path: (host batch, host counts) per
@@ -232,7 +247,8 @@ class TpuShuffleExchangeExec(TpuExec):
         from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
         from spark_rapids_tpu.shuffle.transport import (
             CacheOnlyTransport, fetch_window_bytes, make_transport,
-            pipeline_enabled, range_serialize_enabled)
+            pipeline_enabled, range_serialize_enabled,
+            range_views_enabled)
         with self._lock:
             if self._transport is None:
                 SHUFFLE_COUNTERS.add(exchange_stages=1)
@@ -246,7 +262,13 @@ class TpuShuffleExchangeExec(TpuExec):
                     return sum(getattr(x, "nbytes", 0)
                                for x in _jax.tree_util.tree_leaves(item))
 
-                if (t.supports_range_write and range_serialize_enabled()
+                if (isinstance(t, CacheOnlyTransport)
+                        and range_views_enabled()):
+                    # device twin of the wire range path: one spillable
+                    # backing per map batch, per-partition range views —
+                    # zero slice/gather programs on the map side
+                    t.write_partitioned(self._range_views())
+                elif (t.supports_range_write and range_serialize_enabled()
                         and range_supported(self.schema)):
                     gen = self._range_stream()
                     if pipe:

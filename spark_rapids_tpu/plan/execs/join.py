@@ -261,22 +261,32 @@ class _JoinKernel:
             pair_cap = rup(max(nl * max(nr, 1), 1))
         else:
             pair_cap = max(rup(max(nl, nr, 1)), rup(max(int(required), 1)))
-        # out_cap upper bounds are ANALYTIC, so the row capacities never
-        # ladder (one compiled program per batch shape even though the
-        # pass count is unknown pre-eval): the pair region holds at most
-        # pair_cap passing pairs, plus one row per unmatched row of each
-        # null-extending side.  Byte capacities (strings) may still
-        # retry — those requirements are only known post-gather.
+        # The analytic out_cap bounds (pair_cap [+ null-extension rows])
+        # are SAFE but can be catastrophically loose: every candidate
+        # pair must fit the PAIR region, but the rows that PASS the
+        # condition are usually far fewer, and every downstream
+        # operator's cost scales with CAPACITY, not live rows (the
+        # static-shape tax).  q72's cs x inv join emitted 390k live rows
+        # in a 4.19M-capacity batch (the candidate-pair bound), and its
+        # whole dim-join chain then ran 10.7x oversized — the profiled
+        # q72 wall.  So out_cap STARTS at the equi-join FK guess
+        # (max(L, R), capped by the analytic bound) and the EXACT
+        # overflow feedback (conditional_join_maps reports unclamped
+        # required_rows) escalates in one jump when the guess is low —
+        # one extra program run, traded against a pow2-right-sized
+        # output for the entire downstream plan.
         if self.join_type in ("left_semi", "left_anti", "existence"):
             out_cap = rup(max(nl, 1))
-        elif self.join_type == "full":
-            out_cap = rup(max(pair_cap + nl + nr, 1))
-        elif self.join_type == "left":
-            out_cap = rup(max(pair_cap + nl, 1))
-        elif self.join_type == "right":
-            out_cap = rup(max(pair_cap + nr, 1))
         else:
-            out_cap = pair_cap
+            if self.join_type == "full":
+                analytic = pair_cap + nl + nr
+            elif self.join_type == "left":
+                analytic = pair_cap + nl
+            elif self.join_type == "right":
+                analytic = pair_cap + nr
+            else:
+                analytic = pair_cap
+            out_cap = min(rup(max(nl, nr, 1)), rup(max(analytic, 1)))
         byte_caps = {("out", o): v
                      for o, v in self._string_out_cols(l, r).items()}
         byte_caps.update({("pair", j): v

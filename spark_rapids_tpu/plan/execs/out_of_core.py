@@ -51,15 +51,28 @@ def num_sub_buckets(total_rows: int, target_rows: int, cap: int = 256) -> int:
 
 
 def slice_by_counts(
-    reordered: ColumnarBatch, counts: jax.Array, num_buckets: int
+    reordered: ColumnarBatch, counts: jax.Array, num_buckets: int,
+    count_stat: bool = False,
 ) -> List[Optional[ColumnarBatch]]:
     """Slice a partition-ordered batch into per-bucket batches.
 
     One host sync of `num_buckets` scalars decides each slice's static
     capacity (pow2-bucketed so the gather kernels stay cached).  Empty
     buckets yield None.
+
+    ``count_stat``: record the gather program dispatches in the
+    slice_gather_programs shuffle counter — set by the exchange's
+    device-slice map path, the count the CACHE_ONLY range-view store
+    drives to 0 (its views fold the slice into the consumer's program).
+    OOC sub-partitioning keeps its own slicing uncounted: that path is
+    not a map-side piece gather.
     """
     from spark_rapids_tpu.plan.execs.base import schema_cache_key, shared_jit
+
+    def _stat(n: int) -> None:
+        if count_stat and n:
+            from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+            SHUFFLE_COUNTERS.add(slice_gather_programs=n)
     host_counts = np.asarray(counts)
     offsets = np.zeros(num_buckets + 1, np.int64)
     np.cumsum(host_counts, out=offsets[1:])
@@ -85,12 +98,14 @@ def slice_by_counts(
             return tuple(pieces)
         key = (f"oocsliceall|{schema_cache_key(reordered.schema)}|"
                f"{reordered.capacity}|{bcaps}|{ucap}|{num_buckets}")
+        _stat(1)
         pieces = shared_jit(key, lambda: slice_all)(
             reordered,
             jnp.asarray(offsets[:num_buckets].astype(np.int32)),
             jnp.asarray(host_counts.astype(np.int32)))
         return [pieces[p] if int(host_counts[p]) else None
                 for p in range(num_buckets)]
+    _stat(int(np.count_nonzero(host_counts)))
     out: List[Optional[ColumnarBatch]] = []
     for p in range(num_buckets):
         cnt = int(host_counts[p])

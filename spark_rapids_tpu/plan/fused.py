@@ -191,6 +191,13 @@ def fuse_segments(root: TpuExec, conf=None,
                          TpuSinglePartitionExec, TpuShuffledHashJoinExec,
                          TpuAdaptiveJoinExec)
 
+    from spark_rapids_tpu.plan.execs.basic import (TpuFilterExec,
+                                                   TpuProjectExec)
+    # build-side chains fold project/filter only: a nested join or agg on
+    # the build side keeps its own program (its output size is dynamic,
+    # while the dim-build shapes this fold targets are pure row-wise ops)
+    _BUILD_CHAIN_OPS = (TpuProjectExec, TpuFilterExec)
+
     def visit(node: TpuExec, under_exchange: bool = False) -> TpuExec:
         fusable_top = _fusable(node) or (
             across_shuffle and _fusable_shuffled_join(node))
@@ -221,11 +228,30 @@ def fuse_segments(root: TpuExec, conf=None,
             if (n_joins >= 1 or len(chain) >= 2 or crosses_shuffle
                     or under_exchange):
                 stream_child = visit(cur.children[0])
-                builds = [visit(n.children[1]) for n in chain
-                          if isinstance(n, (TpuBroadcastHashJoinExec,
-                                            TpuShuffledHashJoinExec))]
+                builds, build_chains = [], []
+                for n in chain:
+                    if not isinstance(n, (TpuBroadcastHashJoinExec,
+                                          TpuShuffledHashJoinExec)):
+                        continue
+                    # dim-build fold: a project/filter chain feeding a
+                    # BROADCAST build runs INSIDE the consumer's program
+                    # (applied in-trace to the materialized raw build)
+                    # instead of as its own standalone program — the
+                    # same fold the exchange's map path gives single-op
+                    # chains, applied to the build side
+                    bchain: List[TpuExec] = []
+                    broot = n.children[1]
+                    if isinstance(n, TpuBroadcastHashJoinExec):
+                        while (_fusable(broot)
+                               and isinstance(broot, _BUILD_CHAIN_OPS)
+                               and broot.children):
+                            bchain.append(broot)
+                            broot = broot.children[0]
+                    builds.append(visit(broot))
+                    build_chains.append(bchain)
                 return TpuFusedSegmentExec(chain, stream_child, builds,
-                                           across_shuffle=across_shuffle)
+                                           across_shuffle=across_shuffle,
+                                           build_chains=build_chains)
         is_exchange = isinstance(node, TpuShuffleExchangeExec)
         node.children = tuple(visit(c, under_exchange=is_exchange)
                               for c in node.children)
@@ -250,6 +276,13 @@ def unfuse_segments(root: TpuExec) -> TpuExec:
         if isinstance(node, TpuFusedSegmentExec):
             cur = visit(node.children[0])
             builds = [visit(b) for b in node.children[1:]]
+            # re-link the detached dim-build chains over their raw builds
+            for bi, bc in enumerate(node.build_chains):
+                cur_b = builds[bi]
+                for op in reversed(bc):          # bottom-up re-link
+                    op.children = (cur_b,)
+                    cur_b = op
+                builds[bi] = cur_b
             for n in reversed(node.chain):       # bottom-up re-link
                 if isinstance(n, (TpuBroadcastHashJoinExec,
                                   TpuShuffledHashJoinExec)):
@@ -273,12 +306,24 @@ class TpuFusedSegmentExec(TpuExec):
     """
 
     def __init__(self, chain: List[TpuExec], stream_child: TpuExec,
-                 builds: List[TpuExec], across_shuffle: bool = True):
+                 builds: List[TpuExec], across_shuffle: bool = True,
+                 build_chains: Optional[List[List[TpuExec]]] = None):
         from spark_rapids_tpu.plan.execs.join import (
             TpuBroadcastHashJoinExec, TpuShuffledHashJoinExec)
         super().__init__((stream_child,) + tuple(builds), chain[0].schema)
         self.chain = chain
         self.across_shuffle = across_shuffle
+        #: per build slot: top-down project/filter chain applied IN-TRACE
+        #: to the materialized raw build before the join consumes it (the
+        #: dim-build fold — those ops previously ran as standalone
+        #: programs).  Empty list = build enters the program untouched.
+        self.build_chains: List[List[TpuExec]] = (
+            build_chains if build_chains is not None
+            else [[] for _ in builds])
+        #: runtime-EFFECTIVE fold chains, decided at _materialize_builds
+        #: (an oversized raw build applies its chain eagerly and empties
+        #: its slot); None until builds materialize
+        self._fold_chains: Optional[List[List[TpuExec]]] = None
         self._lock = threading.Lock()
         self._build_batches: Optional[List[Optional[ColumnarBatch]]] = None
         self._build_bytes = 0
@@ -305,13 +350,15 @@ class TpuFusedSegmentExec(TpuExec):
             chain[-1] is self._shuffled_join, \
             "a shuffled join fuses only as the chain tail"
         self._lit_bytes = self._collect_literal_bytes()
-        # string columns ANYWHERE in the segment (stream, builds, or an
-        # intermediate schema) force a non-zero bucket floor: the join and
-        # groupby kernels assert string_max_bytes > 0 for string keys, and
-        # an all-empty build side would otherwise derive bucket 0
+        # string columns ANYWHERE in the segment (stream, builds, build
+        # chains, or an intermediate schema) force a non-zero bucket
+        # floor: the join and groupby kernels assert string_max_bytes > 0
+        # for string keys, and an all-empty build side would otherwise
+        # derive bucket 0
         self._has_any_strings = any(
             getattr(d, "variable_width", False)
-            for n in [stream_child] + list(chain) + list(builds)
+            for n in ([stream_child] + list(chain) + list(builds)
+                      + [bn for bc in self.build_chains for bn in bc])
             for d in n.schema.dtypes)
         self._sig: Optional[str] = None
         self._consts: Optional[tuple] = None
@@ -323,6 +370,9 @@ class TpuFusedSegmentExec(TpuExec):
         # exec's own children tuple carries the live subtrees instead.
         for n in chain:
             n.children = ()
+        for bc in self.build_chains:
+            for n in bc:
+                n.children = ()
 
     # -- plan identity ------------------------------------------------------
 
@@ -331,7 +381,8 @@ class TpuFusedSegmentExec(TpuExec):
         from spark_rapids_tpu.plan.execs.basic import (
             TpuFilterExec, TpuProjectExec)
         m = 0
-        for n in self.chain:
+        for n in (list(self.chain)
+                  + [bn for bc in self.build_chains for bn in bc]):
             if isinstance(n, TpuProjectExec):
                 m = max(m, _literal_bytes(n.exprs))
             elif isinstance(n, TpuFilterExec):
@@ -349,10 +400,16 @@ class TpuFusedSegmentExec(TpuExec):
             # string-ordinal feedback (the r5 fuzz cross-query cache
             # pollution — a DATE column indexed as variable-width).  Build
             # schemas likewise: the per-plane byte-capacity tags are laid
-            # out from the build columns' nested offset paths.
+            # out from the build columns' nested offset paths.  Build
+            # CHAINS too: the in-trace dim-build ops are part of the
+            # program this signature names.
             stream = schema_cache_key(self.children[0].schema)
-            builds = ";".join(schema_cache_key(b.schema)
-                              for b in self.children[1:])
+            builds = ";".join(
+                schema_cache_key(b.schema)
+                + ("<" + ">".join(_exec_signature_shallow(n)
+                                  for n in self.build_chains[bi])
+                   if self.build_chains[bi] else "")
+                for bi, b in enumerate(self.children[1:]))
             self._sig = ("fused[" + ">".join(parts)
                          + f"|stream={stream}|builds={builds}]")
         return self._sig
@@ -361,7 +418,8 @@ class TpuFusedSegmentExec(TpuExec):
         from spark_rapids_tpu.plan.execs.basic import (
             TpuFilterExec, TpuProjectExec)
         out: List[Expression] = []
-        for n in self.chain:
+        for n in (list(self.chain)
+                  + [bn for bc in self.build_chains for bn in bc]):
             if isinstance(n, TpuProjectExec):
                 out.extend(n.exprs)
             elif isinstance(n, TpuFilterExec):
@@ -373,15 +431,34 @@ class TpuFusedSegmentExec(TpuExec):
     def num_partitions(self) -> int:
         return self.children[0].num_partitions()
 
+    def _build_fold_limit(self, bi: int) -> int:
+        """Raw-build row bound for the in-trace dim-build fold of slot
+        ``bi``: the consumer join's batch target."""
+        for n in self.chain:
+            if self._join_build_ix.get(id(n)) == bi:
+                return max(int(getattr(n, "target_rows", 1 << 20)), 1)
+        return 1 << 20
+
     def _materialize_builds(self) -> List[Optional[ColumnarBatch]]:
         """Broadcast builds, materialized once for all partitions.  A
         shuffled join's per-partition build slot stays None here — it is
-        filled per reduce partition by _partition_build_pieces."""
+        filled per reduce partition by _partition_build_pieces.
+
+        The dim-build fold is GATED here at runtime: the broadcast
+        planner sizes builds by their POST-chain estimate, so a raw dim
+        far larger than its filtered output can still plan as a
+        broadcast — folding its filter in-trace would re-filter the raw
+        dim (and run the join at raw capacity) on EVERY program call.
+        A raw build past the consumer join's batch target applies its
+        chain EAGERLY once (one standalone program, the pre-fold
+        behavior) and the slot's effective fold chain empties; small
+        dims (the q25/q72 shapes) keep the in-trace fold."""
         from spark_rapids_tpu.plan.execs.coalesce import coalesce_to_one
         with self._lock:
             if self._build_batches is None:
                 outs: List[Optional[ColumnarBatch]] = []
                 mb = 0
+                fold = [list(bc) for bc in self.build_chains]
                 for bi, b in enumerate(self.children[1:]):
                     if self._build_kind[bi] == "part":
                         outs.append(None)
@@ -393,11 +470,23 @@ class TpuFusedSegmentExec(TpuExec):
                         lambda: coalesce_to_one(batches))
                     if merged is None:
                         merged = ColumnarBatch.empty(b.schema)
+                    if (fold[bi]
+                            and merged.capacity > self._build_fold_limit(bi)):
+                        merged = with_retry_no_split(
+                            lambda: _apply_build_chain(fold[bi], merged))
+                        fold[bi] = []
                     outs.append(merged)
                     mb = max(mb, _max_live_bytes(merged))
                 self._build_batches = outs
                 self._build_bytes = mb
+                self._fold_chains = fold
             return self._build_batches
+
+    def _effective_chains(self) -> List[List[TpuExec]]:
+        """The runtime fold chains (decided by _materialize_builds); the
+        static chains until builds materialize."""
+        return (self._fold_chains if self._fold_chains is not None
+                else self.build_chains)
 
     def _bucket_floor(self) -> int:
         """Pre-launch bucket WITHOUT a stream sync (VERDICT r4 #1: each
@@ -421,12 +510,18 @@ class TpuFusedSegmentExec(TpuExec):
         return (self.across_shuffle
                 and hasattr(self.children[0], "stream_pieces"))
 
-    def _stream_groups(self, idx: int):
+    def _stream_groups(self, idx: int, extra_pieces=()):
         """Coalesced piece groups of stream partition ``idx``, bounded by
         the exchange's batch target.  The piece pull (stage k's reduce
         fetch / unspill) runs on a lookahead thread bounded by the fetch
         in-flight byte window, so it overlaps this segment's device
-        compute (shuffle/pipeline.py)."""
+        compute (shuffle/pipeline.py).
+
+        ``extra_pieces``: pieces pinned ALONGSIDE each group in the same
+        attempt (the partition's co-partition build pieces) — the
+        residency degrade check must see the COMBINED pinned set, shared
+        backings deduped, or two half-budget checks could jointly pin a
+        full budget."""
         from spark_rapids_tpu.shuffle.transport import (fetch_window_bytes,
                                                         pipeline_enabled)
         target = max(int(getattr(self.children[0], "coalesce_target_rows",
@@ -440,12 +535,12 @@ class TpuFusedSegmentExec(TpuExec):
         group, acc = [], 0
         for piece in pieces:
             if group and acc + piece.capacity > target:
-                yield group
+                yield _degrade_over_budget_group(group, extra_pieces)
                 group, acc = [], 0
             group.append(piece)
             acc += piece.capacity
         if group:
-            yield group
+            yield _degrade_over_budget_group(group, extra_pieces)
 
     def _partition_build_pieces(self, idx: int) -> Dict[int, list]:
         """Per-partition build inputs for the chain's shuffled join:
@@ -503,9 +598,17 @@ class TpuFusedSegmentExec(TpuExec):
         builds = self._materialize_builds()
         part_pieces = self._partition_build_pieces(idx)
         if part_pieces:
+            from spark_rapids_tpu.shuffle.transport import (
+                views_over_memory_budget)
             limit = self._fuse_build_limit()
-            if any(sum(p.capacity for p in pieces) > limit
-                   for pieces in part_pieces.values()):
+            # two bounds: the in-program join size (sum of view/piece
+            # capacities — what the in-trace concat is sized by) and the
+            # range-view RESIDENCY guard (an attempt pins full backings,
+            # deduped; near the arena budget the fallback's sliced
+            # materialization must run instead)
+            if (any(sum(p.capacity for p in pieces) > limit
+                    for pieces in part_pieces.values())
+                    or views_over_memory_budget(part_pieces.values())):
                 # the co-partition build side outgrew the in-program
                 # bound (hot-key skew): this partition runs the per-op
                 # out-of-core join, with the rest of the chain still
@@ -515,7 +618,8 @@ class TpuFusedSegmentExec(TpuExec):
                     idx, part_pieces, slice_spec=slice_spec, finish=finish)
                 return
         if self._uses_stream_pieces():
-            for group in self._stream_groups(idx):
+            extra = [p for ps in part_pieces.values() for p in ps]
+            for group in self._stream_groups(idx, extra_pieces=extra):
                 with timed(self.op_time):
                     full = self._assemble_builds(builds, part_pieces)
                     out, counts = self._run(group, full,
@@ -563,14 +667,14 @@ class TpuFusedSegmentExec(TpuExec):
                 left_batches = []
                 for p in stream_pieces:
                     # tpu-lint: allow-retry-discipline(inputs stay pinned through the OOC sub-partition pass, which reads them exactly once up front; unpinned in the finally)
-                    left_batches.append(p.materialize_pinned())
+                    left_batches.append(p.materialize_batch_pinned())
                     pinned.append(p)
             else:
                 left_batches = list(self.children[0].execute_partition(idx))
             right_batches = []
             for p in build_pieces:
                 # tpu-lint: allow-retry-discipline(inputs stay pinned through the OOC sub-partition pass, which reads them exactly once up front; unpinned in the finally)
-                right_batches.append(p.materialize_pinned())
+                right_batches.append(p.materialize_batch_pinned())
                 pinned.append(p)
             total = (sum(b.capacity for b in left_batches)
                      + sum(b.capacity for b in right_batches))
@@ -608,6 +712,12 @@ class TpuFusedSegmentExec(TpuExec):
         if chain is None:
             chain = self.chain
         base_sig = sig if sig is not None else self.signature()
+        if any(self.build_chains):
+            # the runtime fold decision (eager vs in-trace per slot) must
+            # key the compiled program: two executions of one static plan
+            # can fold differently when build sizes differ
+            base_sig += "|fold=" + "".join(
+                "1" if c else "0" for c in self._effective_chains())
         sig = base_sig
         if slice_spec is not None:
             sig += f"|slice={slice_spec[2]}|{slice_spec[1]}"
@@ -624,6 +734,13 @@ class TpuFusedSegmentExec(TpuExec):
                            if isinstance(b, list)]
         piece_lists = ([stream] if group_mode else []) + \
             [builds[i] for i in piece_build_ixs]
+        n_views = sum(1 for lst in piece_lists for p in lst
+                      if getattr(p, "is_range_view", False))
+        if n_views:
+            # CACHE_ONLY range views whose slice runs INSIDE this program
+            # (counted once per program call, not per retry attempt)
+            from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+            SHUFFLE_COUNTERS.add(range_view_folds=n_views)
 
         def invoke(fn):
             if not piece_lists:
@@ -725,12 +842,15 @@ class TpuFusedSegmentExec(TpuExec):
                              dict(self._join_build_ix),
                              self._all_exprs(), bucket, caps,
                              slice_spec=slice_spec,
-                             stream_string_ords=stream_string_ords)
+                             stream_string_ords=stream_string_ords,
+                             build_chains=[list(bc) for bc
+                                           in self._effective_chains()])
 
     def cleanup(self) -> None:
         with self._lock:
             self._build_batches = None
             self._build_bytes = 0
+            self._fold_chains = None
         super().cleanup()
 
     def describe(self):
@@ -742,17 +862,75 @@ class TpuFusedSegmentExec(TpuExec):
         lines = ["  " * indent + self.describe()]
         for n in self.chain:
             lines.append("  " * (indent + 1) + "* " + n.describe())
+        for bi, bc in enumerate(self.build_chains):
+            for n in bc:
+                lines.append("  " * (indent + 1) + f"b{bi}* "
+                             + n.describe())
         for c in self.children:
             lines.append(c.tree_string(indent + 1))
         return "\n".join(lines)
 
 
+def _apply_build_chain(bc: List[TpuExec],
+                       merged: ColumnarBatch) -> ColumnarBatch:
+    """Eager one-shot application of a dim-build chain — ONE standalone
+    jitted program over the raw merged build (the pre-fold behavior,
+    used when the raw build exceeds the in-trace fold bound)."""
+    from spark_rapids_tpu.plan.execs.base import schema_cache_key, shared_jit
+    from spark_rapids_tpu.plan.execs.basic import (
+        TpuFilterExec, TpuProjectExec)
+    exprs: List[Expression] = []
+    for n in bc:
+        if isinstance(n, TpuProjectExec):
+            exprs.extend(n.exprs)
+        elif isinstance(n, TpuFilterExec):
+            exprs.append(n.condition)
+    consts = tuple(jnp.asarray(a) for a in collect_trace_consts(exprs))
+    bcaps = ",".join(str(c.byte_capacity) for c in merged.columns
+                     if c.offsets is not None)
+    key = ("buildchain|" + ">".join(_exec_signature_shallow(n) for n in bc)
+           + f"|{schema_cache_key(merged.schema)}|{merged.capacity}|{bcaps}")
+
+    def make():
+        def fn(batch, consts_):
+            cmap = bind_trace_consts(exprs, consts_)
+            cur = batch
+            for op in reversed(bc):   # bottom-up, like the fused chain
+                cur = _emit_one(op, 0, cur, (), {}, cmap, 0, {}, {})
+            return cur
+        return fn
+    return shared_jit(key, make)(merged, consts)
+
+
+def _degrade_over_budget_group(group, extra_pieces=()):
+    """Range-view residency guard for a stream group: when materializing
+    the group's views — TOGETHER with ``extra_pieces`` pinned in the
+    same attempt (the partition's build pieces), shared backings deduped
+    — would pin backings past the arena budget bound
+    (transport.views_over_memory_budget), slice each of the group's
+    views to an INDEPENDENT batch pin-balanced (the materialize
+    fallback) so the attempt's residency is the group target, not the
+    deduped backings.  No budget / under budget: the group folds
+    in-trace untouched."""
+    from spark_rapids_tpu.shuffle.transport import (
+        StreamPiece, materialize_view_batch, views_over_memory_budget)
+    if not views_over_memory_budget([group, list(extra_pieces)]):
+        return group
+    return [StreamPiece.of_batch(materialize_view_batch(p))
+            if getattr(p, "is_range_view", False) else p
+            for p in group]
+
+
 def _concat_in_trace(batches: tuple) -> ColumnarBatch:
-    """Concat a pytree tuple of batches INSIDE the traced program (the
-    reduce-side merge fused into the compute program).  Capacity is the
-    static sum of the inputs' capacities, so the concat can never
-    overflow and needs no feedback."""
+    """Concat a pytree tuple of pieces INSIDE the traced program (the
+    reduce-side merge fused into the compute program).  A piece is a
+    batch or a RangeView of a shared CACHE_ONLY backing batch — views
+    slice in-trace first (the map-side piece gather folded into THIS
+    program).  Capacity is the static sum of the pieces' capacities, so
+    the concat can never overflow and needs no feedback."""
     from spark_rapids_tpu.kernels.selection import concat_batches_device
+    from spark_rapids_tpu.shuffle.transport import piece_batch_in_trace
+    batches = tuple(piece_batch_in_trace(b) for b in batches)
     if len(batches) == 1:
         return batches[0]
     cap = round_up_pow2(max(sum(b.capacity for b in batches), 1))
@@ -764,21 +942,29 @@ def _concat_in_trace(batches: tuple) -> ColumnarBatch:
 def _make_program(chain: List[TpuExec], join_build_ix: Dict[int, int],
                   exprs: List[Expression], bucket: int,
                   caps: Dict[str, int], slice_spec=None,
-                  stream_string_ords: Tuple[int, ...] = ()):
+                  stream_string_ords: Tuple[int, ...] = (),
+                  build_chains: Optional[List[List[TpuExec]]] = None):
     """Traceable fn(stream, builds, consts) -> (out, counts, fb).
 
     ``stream`` is one batch or a TUPLE of batches (a coalesced shuffle
     group, concatenated in-trace — the reduce-side merge as part of the
     same program).  ``builds`` entries are one batch (broadcast) or a
     tuple of co-partition pieces (a shuffled join's per-partition build,
-    also concatenated in-trace).
+    also concatenated in-trace); pieces may be CACHE_ONLY RangeViews,
+    sliced in-trace by the concat.
 
     ``slice_spec`` = (keys, n_out, sig): additionally run the shuffle
     exchange's key-append + hash-partition INSIDE the program, returning
     per-partition counts (None otherwise).  ``stream_string_ords``: the
     stream's variable-width columns; their live byte max — together with
     every tuple-build's variable-width columns — is reported in
-    feedback["__stream_bytes"] to validate the speculative bucket."""
+    feedback["__stream_bytes"] to validate the speculative bucket.
+
+    ``build_chains``: per build slot, a top-down project/filter chain
+    applied IN-TRACE to the (raw) build batch before the join reads it —
+    the dim-build fold; the byte maxima feeding the speculative bucket
+    are observed on the RAW build (a superset: the admitted ops never
+    grow strings)."""
 
     def fn(stream, builds: tuple, consts: tuple):
         from spark_rapids_tpu.kernels.strings import max_live_string_bytes
@@ -806,6 +992,19 @@ def _make_program(chain: List[TpuExec], join_build_ix: Dict[int, int],
         if byte_obs:
             feedback["__stream_bytes"] = jnp.max(
                 jnp.stack(byte_obs)).astype(jnp.int64)
+        if build_chains and any(build_chains):
+            # dim-build fold: each slot's project/filter chain transforms
+            # the raw build INSIDE this program (bottom-up, like the main
+            # chain) before the join gathers from it
+            bl = list(builds)
+            for bi in range(len(bl)):
+                bc = build_chains[bi] if bi < len(build_chains) else []
+                cur_b = bl[bi]
+                for op in reversed(bc):
+                    cur_b = _emit_one(op, 0, cur_b, (), {}, cmap, bucket,
+                                      caps, feedback)
+                bl[bi] = cur_b
+            builds = tuple(bl)
         cur = stream
         for pos in range(len(chain) - 1, -1, -1):
             cur = _emit_one(chain[pos], pos, cur, builds, join_build_ix,

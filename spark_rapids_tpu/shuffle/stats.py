@@ -37,6 +37,20 @@ _FIELDS = (
                               # join path (build side over the fuse limit)
     "exchange_stages",        # exchanges materialized (launches-per-stage
                               # = launches / exchange_stages in bench)
+    # CACHE_ONLY range-view store (transport.py RangeView; the device
+    # twin of the wire range path — ROADMAP open item 1)
+    "range_view_blocks",      # per-partition range views written (one
+                              # spillable BACKING batch per map batch;
+                              # blocks are (backing, start, count) views)
+    "range_view_folds",       # views whose slice ran INSIDE a consumer's
+                              # fused program (no standalone gather)
+    "slice_gather_programs",  # standalone map-side piece-gather program
+                              # dispatches (slice_by_counts on the
+                              # exchange's device-slice path — the count
+                              # range views drive to 0 on CACHE_ONLY)
+    "range_view_materializes",  # views sliced by a standalone gather for
+                              # a non-fused consumer (the materialize
+                              # fallback: OOC joins, sort, per-op reads)
     # map side (range-serialization write path; serializer.py)
     "map_range_batches",      # map batches written via range framing
     "map_range_blocks",       # partition wire blocks framed from row ranges

@@ -30,7 +30,78 @@ import abc
 import threading
 from typing import Iterable, List, Optional, Sequence, Tuple
 
+import jax
+
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+
+
+@jax.tree_util.register_pytree_node_class
+class RangeView:
+    """A row range [start, start+count) of a BACKING batch, deliverable
+    into a traced program WITHOUT a standalone gather.
+
+    The device twin of the wire path's row-range framing (PR 5,
+    serializer.serialize_batch_ranges): the CACHE_ONLY map side stores ONE
+    partition-reordered batch per map batch, and each reduce partition's
+    "block" is a view over it.  A fused consumer receives the view as a
+    program ARGUMENT — ``batch`` + dynamic ``start``/``count`` scalars with
+    the pow2 row ``capacity`` static in the treedef aux — and slices it
+    in-trace (``slice_in_trace``), so the per-partition gather launches of
+    the old ``slice_by_counts`` path fold into the consumer's one program.
+
+    Host-side accessors (columns/num_rows/schema) delegate to the backing
+    batch: bucket derivations over a view (string byte maxima) are then
+    computed over the backing's live rows — a superset of the view's, so
+    the derived bucket is always sufficient."""
+
+    __slots__ = ("batch", "start", "count", "capacity")
+
+    def __init__(self, batch: ColumnarBatch, start, count, capacity: int):
+        self.batch = batch      # backing batch (dynamic pytree)
+        self.start = start      # dynamic scalar: first backing row
+        self.count = count      # dynamic scalar: live rows in the view
+        self.capacity = int(capacity)   # static pow2 row capacity
+
+    def tree_flatten(self):
+        return (self.batch, self.start, self.count), self.capacity
+
+    @classmethod
+    def tree_unflatten(cls, capacity, children):
+        batch, start, count = children
+        return cls(batch, start, count, capacity)
+
+    # host-side accessors (backing superset; see class doc)
+    @property
+    def columns(self):
+        return self.batch.columns
+
+    @property
+    def num_rows(self):
+        return self.batch.num_rows
+
+    @property
+    def schema(self):
+        return self.batch.schema
+
+    def slice_in_trace(self) -> ColumnarBatch:
+        """Gather the view's rows INSIDE the current trace (the fold that
+        replaces the map side's standalone piece-gather program)."""
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.kernels.selection import gather_batch
+        idx = jnp.arange(self.capacity, dtype=jnp.int32) + \
+            jnp.asarray(self.start, jnp.int32)
+        return gather_batch(self.batch, idx,
+                            jnp.asarray(self.count, jnp.int32),
+                            out_capacity=self.capacity)
+
+
+def piece_batch_in_trace(x):
+    """Resolve a stream piece materialization to a plain batch inside a
+    traced program: RangeViews slice in-trace, batches pass through.  The
+    ONE resolution point shared by the fused-segment concat and the
+    final-aggregate combine."""
+    return x.slice_in_trace() if isinstance(x, RangeView) else x
 
 
 class StreamPiece:
@@ -38,20 +109,26 @@ class StreamPiece:
 
     The fused-across-shuffle reduce path (plan/fused.py) concats pieces
     INSIDE its one program per coalesced partition, so the transport's own
-    merge/concat pass never runs.  A piece wraps either a spillable handle
+    merge/concat pass never runs.  A piece wraps a spillable handle
     (CACHE_ONLY — the piece stays spillable between uses; consumers
-    materialize pin-balanced via coalesce.retry_over_stream_pieces) or an
+    materialize pin-balanced via coalesce.retry_over_stream_pieces), an
     already-device batch (wire transports pay their host->device upload in
-    read_iter regardless)."""
+    read_iter regardless), or a RANGE VIEW of a shared spillable backing
+    batch (CACHE_ONLY range-view store): materialize_pinned then returns a
+    RangeView the consumer's program slices in-trace, and pin balancing
+    dedupes by ``backing_key`` so a backing batch shared by several views
+    pins exactly once per attempt."""
 
-    __slots__ = ("capacity", "nbytes", "_handle", "_batch")
+    __slots__ = ("capacity", "nbytes", "_handle", "_batch", "_range")
 
-    def __init__(self, capacity: int, nbytes: int, handle=None, batch=None):
+    def __init__(self, capacity: int, nbytes: int, handle=None, batch=None,
+                 range_: Optional[Tuple[int, int]] = None):
         assert (handle is None) != (batch is None)
         self.capacity = int(capacity)   # static row capacity (grouping)
         self.nbytes = int(nbytes)       # in-flight byte accounting
         self._handle = handle
         self._batch = batch
+        self._range = range_            # (start_row, row_count) or None
 
     @classmethod
     def of_batch(cls, batch: ColumnarBatch) -> "StreamPiece":
@@ -61,16 +138,154 @@ class StreamPiece:
     def of_handle(cls, handle, capacity: int) -> "StreamPiece":
         return cls(capacity, handle.size_bytes, handle=handle)
 
-    def materialize_pinned(self) -> ColumnarBatch:
-        """Device batch for this piece; a spillable handle gains a pin the
-        caller MUST return via unpin() before its retry attempt ends."""
+    @classmethod
+    def of_range_view(cls, handle, start: int, count: int,
+                      nbytes: int) -> "StreamPiece":
+        from spark_rapids_tpu.columnar.column import round_up_pow2
+        return cls(round_up_pow2(max(int(count), 1)), nbytes,
+                   handle=handle, range_=(int(start), int(count)))
+
+    @property
+    def is_range_view(self) -> bool:
+        return self._range is not None
+
+    def backing_key(self):
+        """Identity of the shared backing handle (pin-dedup key), or None
+        when this piece owns its materialization alone."""
+        return id(self._handle) if self._range is not None else None
+
+    def resident_nbytes(self, seen: set) -> int:
+        """Bytes this piece ADDS to an attempt's pinned device residency.
+
+        A range view pins its FULL backing batch — once per backing,
+        however many views share it — so a group's true pinned residency
+        is the deduped sum of backing sizes, not the per-view byte
+        shares.  ``seen`` carries backing keys across a group; non-view
+        pieces contribute their own nbytes."""
+        bk = self.backing_key()
+        if bk is None:
+            return self.nbytes
+        if bk in seen:
+            return 0
+        seen.add(bk)
+        return self._handle.size_bytes
+
+    def materialize_pinned(self):
+        """Device data for this piece; a spillable handle gains a pin the
+        caller MUST return via unpin() before its retry attempt ends.
+        Range-view pieces return a RangeView (slice folds into the
+        consumer's program); others return the device batch."""
         if self._handle is not None:
-            return self._handle.materialize()
+            batch = self._handle.materialize()
+            if self._range is not None:
+                return self.as_view(batch)
+            return batch
         return self._batch
+
+    def as_view(self, backing: ColumnarBatch):
+        """The same value materialize_pinned would return, built from an
+        ALREADY-materialized backing batch — no extra pin (the shared-
+        backing dedup path of retry_over_stream_pieces)."""
+        import numpy as np
+        start, count = self._range
+        return RangeView(backing, np.int32(start), np.int32(count),
+                         self.capacity)
+
+    @staticmethod
+    def backing_of(mat):
+        """The backing batch inside a materialize_pinned result."""
+        return mat.batch if isinstance(mat, RangeView) else mat
+
+    def materialize_batch_pinned(self) -> ColumnarBatch:
+        """Device BATCH for this piece — the materialize fallback for
+        consumers that cannot fold a RangeView into their own program
+        (the fused OOC fallback, per-op reads): a view runs its slice as
+        a standalone gather here (counted: range_view_materializes).  The
+        backing pin is retained until unpin() like every other piece; the
+        gather itself retries under with_retry_no_split (idempotent over
+        the pinned backing — a mid-gather OOM spills OTHER handles)."""
+        mat = self.materialize_pinned()
+        if isinstance(mat, RangeView):
+            from spark_rapids_tpu.memory.retry import with_retry_no_split
+            from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+            SHUFFLE_COUNTERS.add(range_view_materializes=1)
+            try:
+                return with_retry_no_split(lambda: _slice_view(mat))
+            except BaseException:
+                # the caller only learns it holds a pin when this call
+                # RETURNS (its unwind lists pieces appended after
+                # success) — a failed fallback gather must release its
+                # own pin or the backing stays unspillable until cleanup
+                self.unpin()
+                raise
+        return mat
 
     def unpin(self) -> None:
         if self._handle is not None:
             self._handle.unpin()
+
+
+def views_over_memory_budget(piece_lists) -> bool:
+    """True when materializing ``piece_lists`` in ONE attempt would pin
+    backing batches past HALF the device arena's byte budget.
+
+    The range-view residency guard: an attempt pins each view's FULL
+    backing (deduped across shared backings) and pinned handles cannot
+    spill, so a group approaching the budget must take the materialize
+    fallback (slices release their backing pin) instead of the in-trace
+    fold — summing per-view shares would undercount by ~num_partitions x
+    and bypass the fallback exactly when memory is tightest.  Budget 0
+    (bookkeeping mode — no HBM stats) never trips: residency is then not
+    the binding constraint and the fold stays on."""
+    from spark_rapids_tpu.memory.arena import device_arena
+    budget = device_arena().budget_bytes
+    if not budget:
+        return False
+    seen: set = set()
+    total = 0
+    for lst in piece_lists:
+        for p in lst:
+            total += (p.resident_nbytes(seen)
+                      if hasattr(p, "resident_nbytes") else p.nbytes)
+    return total > budget // 2
+
+
+def materialize_view_batch(piece: StreamPiece) -> ColumnarBatch:
+    """Pin-balanced standalone slice of a piece into an INDEPENDENT
+    batch: the materialize fallback (counted range_view_materializes for
+    views).  The backing pin is taken and returned inside each retry
+    attempt, so a mid-attempt OOM can spill the backing itself."""
+    from spark_rapids_tpu.memory.retry import with_retry_no_split
+    if piece.is_range_view:
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+        SHUFFLE_COUNTERS.add(range_view_materializes=1)
+
+    def attempt():
+        # unpin only covers a SUCCESSFUL materialize: a raise inside
+        # materialize_pinned means no pin was taken, and an unmatched
+        # unpin would steal a concurrent consumer's pin
+        mat = piece.materialize_pinned()
+        try:
+            return (_slice_view(mat) if isinstance(mat, RangeView)
+                    else mat)
+        finally:
+            piece.unpin()
+    return with_retry_no_split(attempt)
+
+
+def _slice_view(view: RangeView) -> ColumnarBatch:
+    """Standalone (jitted) gather of a RangeView — the materialize
+    fallback only; the fused path slices in-trace instead."""
+    from spark_rapids_tpu.plan.execs.base import schema_cache_key, shared_jit
+    bcaps = ",".join(str(c.byte_capacity) for c in view.batch.columns
+                     if c.offsets is not None)
+    key = (f"rvslice|{schema_cache_key(view.batch.schema)}|"
+           f"{view.batch.capacity}|{bcaps}|{view.capacity}")
+    return shared_jit(key, lambda: _rv_slice_step)(view)
+
+
+def _rv_slice_step(view: RangeView) -> ColumnarBatch:
+    return view.slice_in_trace()
 
 
 class ShuffleTransport(abc.ABC):
@@ -127,32 +342,93 @@ class ShuffleTransport(abc.ABC):
 
 
 class CacheOnlyTransport(ShuffleTransport):
-    """Device-resident spillable handles (CACHE_ONLY mode)."""
+    """Device-resident spillable handles (CACHE_ONLY mode).
+
+    Two write shapes share the store:
+
+      * legacy device-slice blocks (``write``): one spillable handle per
+        non-empty (map batch, partition) gather — the fallback when range
+        views are off;
+      * RANGE-VIEW blocks (``write_partitioned``): ONE spillable handle
+        per map batch (the partition-reordered batch, exactly what the
+        device partition step already produced) plus host counts; each
+        partition's block is a (backing, start, count) view.  No gather
+        programs run on the map side at all — fused consumers slice the
+        view inside their own program (StreamPiece/RangeView), and
+        non-fused consumers get a standalone slice at read time (the
+        materialize fallback, counted range_view_materializes).
+
+    A backing handle is shared by every partition's view over its map
+    batch (partial handle reuse across partitions): the store owns it
+    exactly once (``_backings``) and cleanup closes it exactly once, no
+    matter how many views were consumed, pinned, or never read."""
 
     def __init__(self, num_partitions: int):
         #: per partition: (handle, static row capacity) — the capacity is
         #: recorded at write time so the piece stream can group to the
         #: consumer's coalesce target without materializing anything
         self._buckets: List[List] = [[] for _ in range(num_partitions)]
+        #: per partition: (backing handle, start row, row count, nbytes)
+        self._views: List[List] = [[] for _ in range(num_partitions)]
+        #: backing handles owned by the view store, one per map batch
+        self._backings: List = []
 
     def write(self, pieces):
         from spark_rapids_tpu.memory.spill import make_spillable
         for p, piece in pieces:
             self._buckets[p].append((make_spillable(piece), piece.capacity))
 
+    def write_partitioned(self, batches) -> None:
+        """Range-view write path (instead of write()): consume
+        (partition-reordered batch, host per-partition counts) pairs —
+        the exchange's device partition output WITHOUT slicing."""
+        from spark_rapids_tpu.memory.spill import make_spillable
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+        n_parts = len(self._views)
+        for reordered, host_counts in batches:
+            total = int(host_counts.sum())
+            if total == 0:
+                # no live rows: store nothing (the slice path dropped
+                # such batches too — a backing handle nobody views would
+                # hold dead spillable residency until cleanup)
+                continue
+            h = make_spillable(reordered)
+            self._backings.append(h)
+            start = 0
+            nblocks = 0
+            for p in range(n_parts):
+                cnt = int(host_counts[p])
+                if cnt:
+                    nbytes = max(h.size_bytes * cnt // total, 1)
+                    self._views[p].append((h, start, cnt, nbytes))
+                    nblocks += 1
+                start += cnt
+            SHUFFLE_COUNTERS.add(range_view_blocks=nblocks)
+
     def read(self, partition: int) -> List[ColumnarBatch]:
-        return [h.materialize() for h, _cap in self._buckets[partition]]
+        out = [h.materialize() for h, _cap in self._buckets[partition]]
+        for h, start, cnt, nbytes in self._views[partition]:
+            out.append(materialize_view_batch(
+                StreamPiece.of_range_view(h, start, cnt, nbytes)))
+        return out
 
     def read_pieces(self, partition: int,
                     target_rows: Optional[int] = None):
         for h, cap in self._buckets[partition]:
             yield StreamPiece.of_handle(h, cap)
+        for h, start, cnt, nbytes in self._views[partition]:
+            yield StreamPiece.of_range_view(h, start, cnt, nbytes)
 
     def cleanup(self) -> None:
         for bucket in self._buckets:
             for h, _cap in bucket:
                 h.close()
             bucket.clear()
+        for h in self._backings:
+            h.close()
+        self._backings.clear()
+        for views in self._views:
+            views.clear()
 
 
 class KudoWireTransport(ShuffleTransport):
@@ -366,6 +642,23 @@ def set_range_serialize(enabled: bool) -> None:
 
 def range_serialize_enabled() -> bool:
     return _RANGE_SERIALIZE[0]
+
+
+#: CACHE_ONLY range-view store (spark.rapids.shuffle.cacheOnly.rangeViews):
+#: store ONE partition-reordered spillable batch per map batch and hand
+#: consumers (backing, start, count) range views instead of running a
+#: standalone slice/gather program per partition — the device twin of the
+#: wire path's rangeSerialize.  Escape hatch, default on; wire transports
+#: ignore it.
+_RANGE_VIEWS = [True]
+
+
+def set_range_views(enabled: bool) -> None:
+    _RANGE_VIEWS[0] = bool(enabled)
+
+
+def range_views_enabled() -> bool:
+    return _RANGE_VIEWS[0]
 
 
 #: pipelined exchanges (spark.rapids.shuffle.pipeline.enabled): run the

@@ -388,3 +388,54 @@ def test_shared_coalesce_spec_memoizes_per_epoch():
     g2 = spec.groups()
     assert ex.calls == 2
     assert g2 == [[0], [1, 2, 3]]
+
+
+def test_dim_build_fold_gated_by_raw_build_size():
+    """Review pin (r11): the broadcast planner sizes builds by their
+    POST-chain estimate, so a raw build far larger than its filtered
+    output can still plan as broadcast — folding its filter in-trace
+    would re-filter the raw build on every program call.  Past the
+    consumer join's batch target the chain applies EAGERLY once (a
+    standalone 'buildchain' program); small raw builds keep the
+    in-trace fold (no such program).  Rows match per-op either way."""
+    from spark_rapids_tpu.expressions import col as _col, lit as _lit
+    from spark_rapids_tpu.plan.execs.base import (
+        disable_launch_profile, enable_launch_profile)
+
+    def q(s, dim_rows):
+        f = s.create_dataframe([_fact(seed=81, n=2000, null_frac=0.0)],
+                               num_partitions=2)
+        d = s.create_dataframe([_dim(seed=82, n=dim_rows, null_frac=0.0)],
+                               num_partitions=1)
+        return (f.join(d.filter(_col("w") < _lit(2.0)),
+                       on=([_col("k")], [_col("dk")]))
+                .group_by("tag").agg(sum_("v").alias("sv"),
+                                     count().alias("n"))
+                .order_by("tag"))
+
+    conf = {"spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.batchSizeRows": "1024"}
+
+    def profiled_collect(s, dim_rows):
+        df = q(s, dim_rows)
+        enable_launch_profile()
+        try:
+            rows = df.collect()
+        finally:
+            prof = disable_launch_profile()
+        return rows, prof
+
+    # raw build 3000 rows (cap 4096) > 1024 target: eager one-shot chain
+    rows_big, prof_big = profiled_collect(TpuSession(dict(conf)), 3000)
+    assert any(k.startswith("buildchain|") for k in prof_big), \
+        sorted(prof_big)[:6]
+    # raw build 600 rows (cap <= 1024): in-trace fold, no standalone run
+    rows_small, prof_small = profiled_collect(TpuSession(dict(conf)), 600)
+    assert not any(k.startswith("buildchain|") for k in prof_small), \
+        sorted(k for k in prof_small if k.startswith("buildchain"))
+    perop = TpuSession(dict(
+        conf, **{"spark.rapids.sql.tpu.fuseStages": "false",
+                 "spark.rapids.sql.fusion.acrossShuffle": "false"}))
+    assert _norm(rows_big) == _norm(q(perop, 3000).collect())
+    assert _norm(rows_small) == _norm(q(perop, 600).collect())
+    assert rows_big and rows_small

@@ -51,6 +51,10 @@ SUITES = {
     # replication + speculation + watchdog): marker override runs what
     # tier-1 skips by budget
     "soak": (["tests/test_soak.py"], 1200, ""),
+    # per-program attribution (bench.py --profile) + the CACHE_ONLY
+    # range-view store it was built to validate
+    "profile": (["tests/test_prog_profile.py",
+                 "tests/test_range_views.py"], 900),
     "lint": (["tests/test_lint.py"], 300),
 }
 

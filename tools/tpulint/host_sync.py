@@ -22,9 +22,13 @@ cuDF column per batch).  Flagged forms:
 
 Scope: expressions/, kernels/, plan/ (execs + fused engine), parallel/,
 plus the shuffle wire hot paths (shuffle/serializer.py,
-shuffle/transport.py) — the map-side range-serialization contract is ONE
-batched download per map batch, and an unsuppressed per-column download
-loop regrowing there is exactly the regression this rule exists to stop.
+shuffle/transport.py — the latter now also hosting the CACHE_ONLY
+range-view store: RangeView/StreamPiece/CacheOnlyTransport) — the
+map-side contract on BOTH write paths is ONE batched download per map
+batch (wire: download_partitioned; range views: the counts sync in the
+exchange's _range_views), and an unsuppressed per-column download loop
+or per-view sync regrowing there is exactly the regression this rule
+exists to stop.
 """
 from __future__ import annotations
 

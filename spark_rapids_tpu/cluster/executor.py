@@ -427,7 +427,11 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
             except Exception as e:  # noqa: BLE001 — pacer logs+accounts
                 pacer.failure(e)
             _beat_stop.wait(pacer.delay_s)
-    threading.Thread(target=_beat, daemon=True).start()
+    # the beat runs for the worker PROCESS, not any one query: capture
+    # at executor_main (no task ambients yet) keeps it token-free while
+    # staying on the blessed spawn point
+    from spark_rapids_tpu.utils.ambient import spawn_with_ambients
+    spawn_with_ambients(_beat, name="tpu-heartbeat")
 
     # fatal-diagnostics capture (GpuCoreDumpHandler analog): bundles go
     # to the conf'd dump dir on unhandled worker errors

@@ -34,6 +34,7 @@ class ThrottlingExecutor:
             raise err
 
     def submit(self, nbytes: int, fn: Callable[[], None]) -> None:
+        from spark_rapids_tpu.utils.ambient import submit_with_ambients
         from spark_rapids_tpu.utils.cancel import cancellable_wait
         nbytes = min(max(int(nbytes), 0), self.budget)
         with self._cv:
@@ -56,7 +57,11 @@ class ThrottlingExecutor:
                 with self._cv:
                     self._in_flight -= nbytes
                     self._cv.notify_all()
-        self._pool.submit(run)
+        # write-behind work runs under the SUBMITTER's tenant/priority/
+        # token (a cancelled query's queued encodes stop at their next
+        # blessed wait and surface here as the pending error); no
+        # semaphore cover — the task does not block on this write
+        submit_with_ambients(self._pool, run)
 
     def wait(self) -> None:
         """Drain all in-flight work; re-raise the first error."""

@@ -100,7 +100,11 @@ def prefetched(host_iter_fn: Callable[[], Iterator], num_threads: int,
                 except queue.Full:
                     continue
 
-    reader_pool(num_threads).submit(produce)
+    # the decode runs for the consuming scan task: inherit its tenant/
+    # priority/token (host-side decode NEVER takes the device semaphore
+    # — that is the point of the pool — so no cover)
+    from spark_rapids_tpu.utils.ambient import submit_with_ambients
+    submit_with_ambients(reader_pool(num_threads), produce)
     # belt-and-braces: the task-completion hook cancels the producer even
     # when the abandoning caller never closes the generator (GC-delayed
     # iterators under the engine's task scope;

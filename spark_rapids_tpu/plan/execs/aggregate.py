@@ -1059,10 +1059,20 @@ class TpuHashAggregateExec(TpuExec):
                 if not q:
                     continue
                 with timed(self.op_time):
-                    batches = [h.materialize() for h in q]
-                    merged = self._merge_partials(batches)
+                    # pinned-ledger unwind: a raise in materialize or
+                    # the merge must still unpin what WAS materialized,
+                    # or the handles stay unspillable until close
+                    batches = []
+                    pinned = []
+                    try:
+                        for h in q:
+                            batches.append(h.materialize())
+                            pinned.append(h)
+                        merged = self._merge_partials(batches)
+                    finally:
+                        for h in pinned:
+                            h.unpin()
                     for h in q:
-                        h.unpin()
                         h.close()
                     out = with_retry_no_split(
                         lambda: self._jit_finalize(merged))

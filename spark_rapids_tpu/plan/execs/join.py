@@ -543,31 +543,39 @@ class TpuShuffledHashJoinExec(TpuExec):
             del right_batches
         try:
             for lq, rq in zip(lbuckets, rbuckets):
-                with timed(self.op_time):
-                    # NOT retry-wrapped: the coalesced batches (which may
-                    # alias a single handle's batch) feed the skew-aware
-                    # join below, so the handles must stay pinned past
-                    # this statement — materializing inside a retry body
-                    # would leak one pin per attempt (pinned handles
-                    # refuse to spill), and unpinning per attempt would
-                    # let the spill free a batch the join still reads
-                    # tpu-lint: allow-retry-discipline(handles stay pinned through the join; per-attempt pin balance is impossible while the result outlives the coalesce)
-                    left = (coalesce_to_one(
-                        [h.materialize() for h in lq])
-                            if lq else None)
-                    # tpu-lint: allow-retry-discipline(handles stay pinned through the join; per-attempt pin balance is impossible while the result outlives the coalesce)
-                    right = (coalesce_to_one(
-                        [h.materialize() for h in rq])
-                             if rq else None)
+                # NOT retry-wrapped: the coalesced batches (which may
+                # alias a single handle's batch) feed the skew-aware
+                # join below, so the handles must stay pinned past the
+                # coalesce — materializing inside a retry body would
+                # leak one pin per attempt (pinned handles refuse to
+                # spill), and unpinning per attempt would let the spill
+                # free a batch the join still reads.  Pinned-ledger
+                # unwind: a raise while materializing the RIGHT side
+                # must still unpin the already-pinned left handles.
+                pinned = []
                 try:
+                    with timed(self.op_time):
+                        lmats = []
+                        for h in lq:
+                            lmats.append(h.materialize())
+                            pinned.append(h)
+                        rmats = []
+                        for h in rq:
+                            rmats.append(h.materialize())
+                            pinned.append(h)
+                        # tpu-lint: allow-retry-discipline(handles stay pinned through the join; per-attempt pin balance is impossible while the result outlives the coalesce)
+                        left = coalesce_to_one(lmats) if lq else None
+                        # tpu-lint: allow-retry-discipline(handles stay pinned through the join; per-attempt pin balance is impossible while the result outlives the coalesce)
+                        right = coalesce_to_one(rmats) if rq else None
                     yield from self._join_bucket_skew_aware(left, right)
                 finally:
                     # release arena reservations only after the join is
                     # done with the materialized inputs — closing earlier
                     # lets the arena admit new work against memory that
                     # is still physically resident
-                    for h in lq + rq:
+                    for h in pinned:
                         h.unpin()
+                    for h in lq + rq:
                         h.close()
         finally:
             close_all(lbuckets)

@@ -518,12 +518,17 @@ class TpuWindowExec(TpuExec):
         originals = {}  # canonical key tuple -> representative raw key
         for h in handles:
             b = h.materialize()
-            with timed(self.op_time):
-                cols, ngroups = with_retry_no_split(
-                    lambda: shared_jit(
-                        f"{base_key}|p1|{b.capacity}",
-                        lambda: step)(b))
-            h.unpin()
+            try:
+                with timed(self.op_time):
+                    cols, ngroups = with_retry_no_split(
+                        lambda: shared_jit(
+                            f"{base_key}|p1|{b.capacity}",
+                            lambda: step)(b))
+            finally:
+                # a retry-exhausted OOM must not leave this batch's pin
+                # held — the handle would refuse to spill for the rest
+                # of the query
+                h.unpin()
             ng = int(ngroups)
             if ng > _TWO_PASS_MAX_KEYS:
                 # a single batch already exceeds the key budget: bail
@@ -569,8 +574,10 @@ class TpuWindowExec(TpuExec):
             (vals,) = [values.get((), [(None, False)] * len(specs))]
             for h in handles:
                 b = h.materialize()
-                out = self._broadcast_constants(b, vals)
-                h.unpin()
+                try:
+                    out = self._broadcast_constants(b, vals)
+                finally:
+                    h.unpin()
                 h.close()
                 self.output_rows.add(out.num_rows)
                 yield self._count_out(out)
@@ -580,9 +587,11 @@ class TpuWindowExec(TpuExec):
         joiner = self._two_pass_joiner(key_ords, child_schema)
         for h in handles:
             b = h.materialize()
-            with timed(self.op_time):
-                out = self._join_values(b, build, joiner, key_ords)
-            h.unpin()
+            try:
+                with timed(self.op_time):
+                    out = self._join_values(b, build, joiner, key_ords)
+            finally:
+                h.unpin()
             h.close()
             self.output_rows.add(out.num_rows)
             yield self._count_out(out)

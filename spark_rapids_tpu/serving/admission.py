@@ -468,6 +468,7 @@ class QueryQueue:
                     max_workers=self.max_concurrent
                     + self.queue_max_depth,
                     thread_name_prefix="serving")
+        # tpu-lint: allow-ambient-propagation(submit() establishes its OWN token/tenant/priority scopes per submission; inheriting the async caller's ambients would leak one query's context into another's execution)
         fut = self._pool.submit(self.submit, plan, **kw)
         fut.query_id = kw["query_id"]
         return fut

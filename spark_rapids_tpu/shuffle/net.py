@@ -1651,14 +1651,20 @@ class BlockFetchIterator:
                     state["live_workers"] -= 1
                     cv.notify_all()
 
+        from spark_rapids_tpu.utils.ambient import (Ambients,
+                                                    spawn_with_ambients)
+        # fetch workers act for the consuming reduce task: same tenant,
+        # priority and cancel token (they never touch the device, so no
+        # semaphore cover); captured ONCE, on the consumer's thread
+        amb = Ambients.capture(inherit_semaphore_cover=False)
         threads = []
         with cv:
             for src_state in sources:
                 if not src_state["pairs"]:
                     continue
                 state["live_workers"] += 1
-                t = threading.Thread(target=worker, args=(src_state,),
-                                     daemon=True)
+                t = spawn_with_ambients(worker, src_state, start=False,
+                                        ambients=amb)
                 threads.append(t)
         for t in threads:
             t.start()
@@ -2182,6 +2188,11 @@ class ShuffleExecutor:
                 self.replicate_shuffle(shuffle_id, k, src=src)
             finally:
                 ev.set()
+        # node-level durability work: the replica push deliberately
+        # OUTLIVES the submitting task and its ambients — a cancelled or
+        # completed map task's committed blocks must still replicate
+        # (wait_replicated joins by event, not by task scope)
+        # tpu-lint: allow-ambient-propagation(replication outlives the submitting task by design; inheriting its CancelToken would kill a committed push mid-flight)
         threading.Thread(target=_push, daemon=True).start()
 
     def wait_replicated(self, shuffle_id: int,

@@ -110,67 +110,49 @@ def pipelined(source: Iterable, nbytes_of: Callable[[object], int],
               name: str = "shuffle-pipeline") -> Iterator:
     """Yield ``source``'s items, produced ahead on a background thread.
 
-    The producer inherits the caller's tenant scope and task priority
-    (its device allocations must charge the submitting query, exactly
-    like the engine's partition-pool threads).  Exceptions from the
-    source re-raise at the consumer's next pull; an abandoned consumer
-    (generator closed early) stops the producer at its next hand-off.
+    The producer works ON BEHALF of the calling task, so it runs under
+    the caller's full ambient snapshot (utils/ambient.py): tenant scope
+    (its device allocations charge the submitting query), task priority,
+    the cancel token (a cancelled query's producer exits its loop at the
+    next token check or hand-off wait instead of producing into a dead
+    hand-off), and the device-semaphore cover — the consumer blocks on
+    this queue while holding its slot, so a producer-side acquire would
+    deadlock once every slot is held by such blocked consumers (the
+    reference's shuffle writer threads skip the GPU semaphore for the
+    same reason).  Exceptions from the source re-raise at the consumer's
+    next pull; an abandoned consumer (generator closed early) stops the
+    producer at its next hand-off.
     """
-    from contextlib import nullcontext
-
-    from spark_rapids_tpu.memory.semaphore import (current_task_priority,
-                                                   task_priority,
-                                                   tpu_semaphore)
-    from spark_rapids_tpu.memory.tenant import TENANTS
-    from spark_rapids_tpu.utils.cancel import (cancel_scope,
-                                               current_cancel_token)
+    from spark_rapids_tpu.utils.ambient import spawn_with_ambients
+    from spark_rapids_tpu.utils.cancel import current_cancel_token
 
     token = current_cancel_token()
     pipe = _Pipe(max_inflight_bytes, token=token)
-    tenant = TENANTS.current()
-    priority = current_task_priority()
-    # the producer works ON BEHALF of the calling task: when that task
-    # holds a device-semaphore slot, the producer rides it instead of
-    # taking a second one — the consumer blocks on this queue while
-    # holding its slot, so a producer-side acquire deadlocks once every
-    # slot is held by such blocked consumers (the reference's shuffle
-    # writer threads skip the GPU semaphore for the same reason)
-    covered = tpu_semaphore().held_count() > 0
 
     def produce():
         try:
-            cover = (tpu_semaphore().borrowed_cover() if covered
-                     else nullcontext())
-            # the producer works ON BEHALF of the consumer task: it
-            # inherits the cancel token too, so a cancelled query's
-            # producer exits its loop (next token check inside source,
-            # the pipe's put wait, or the explicit probe below) instead
-            # of producing into a dead hand-off
-            with TENANTS.scope(tenant), task_priority(priority), \
-                    cancel_scope(token), cover:
-                it = iter(source)
-                while True:
-                    if token is not None:
-                        token.check()
-                    # chaos shuffle.pipeline.producer.fail: the producer
-                    # thread dies mid-stream — the error must surface at
-                    # the consumer's next pull, never hang the hand-off
-                    CHAOS.raise_if("shuffle.pipeline.producer.fail")
-                    t0 = time.perf_counter_ns()
-                    try:
-                        item = next(it)
-                    except StopIteration:
-                        break
-                    dt = time.perf_counter_ns() - t0
-                    if not pipe.put(item, max(nbytes_of(item), 1), dt):
-                        break      # consumer gone: stop producing
+            it = iter(source)
+            while True:
+                if token is not None:
+                    token.check()
+                # chaos shuffle.pipeline.producer.fail: the producer
+                # thread dies mid-stream — the error must surface at
+                # the consumer's next pull, never hang the hand-off
+                CHAOS.raise_if("shuffle.pipeline.producer.fail")
+                t0 = time.perf_counter_ns()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                dt = time.perf_counter_ns() - t0
+                if not pipe.put(item, max(nbytes_of(item), 1), dt):
+                    break      # consumer gone: stop producing
         except BaseException as e:  # noqa: BLE001 — re-raised at consumer
             pipe.finish(e)
         else:
             pipe.finish()
 
-    t = threading.Thread(target=produce, name=name, daemon=True)
-    t.start()
+    spawn_with_ambients(produce, name=name)
     first = True
     try:
         while True:

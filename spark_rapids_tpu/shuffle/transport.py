@@ -178,7 +178,15 @@ class StreamPiece:
         if self._handle is not None:
             batch = self._handle.materialize()
             if self._range is not None:
-                return self.as_view(batch)
+                try:
+                    return self.as_view(batch)
+                except BaseException:
+                    # the caller only owns the pin once the view is
+                    # RETURNED: a raise in view construction must give
+                    # the materialize pin back or the backing stays
+                    # unspillable with no owner to unpin it
+                    self._handle.unpin()
+                    raise
             return batch
         return self._batch
 
@@ -206,16 +214,19 @@ class StreamPiece:
         the pinned backing — a mid-gather OOM spills OTHER handles)."""
         mat = self.materialize_pinned()
         if isinstance(mat, RangeView):
-            from spark_rapids_tpu.memory.retry import with_retry_no_split
-            from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
-            SHUFFLE_COUNTERS.add(range_view_materializes=1)
             try:
+                from spark_rapids_tpu.memory.retry import (
+                    with_retry_no_split)
+                from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+                SHUFFLE_COUNTERS.add(range_view_materializes=1)
                 return with_retry_no_split(lambda: _slice_view(mat))
             except BaseException:
                 # the caller only learns it holds a pin when this call
                 # RETURNS (its unwind lists pieces appended after
-                # success) — a failed fallback gather must release its
-                # own pin or the backing stays unspillable until cleanup
+                # success) — ANY raise past the acquire (the failed
+                # fallback gather, even the import/counter) must release
+                # its own pin or the backing stays unspillable until
+                # cleanup
                 self.unpin()
                 raise
         return mat
@@ -406,6 +417,12 @@ class CacheOnlyTransport(ShuffleTransport):
             SHUFFLE_COUNTERS.add(range_view_blocks=nblocks)
 
     def read(self, partition: int) -> List[ColumnarBatch]:
+        # the returned batches ALIAS the handles' device buffers, so the
+        # pins deliberately hold until cleanup() closes the store —
+        # unpinning would let spill free data the consumer still reads,
+        # and a failed read tears down the whole query (cleanup closes
+        # pinned handles fine)
+        # tpu-lint: allow-pin-balance(CACHE_ONLY read hands out aliases of the handles' device batches; the pin IS the lifetime contract, released by cleanup/close)
         out = [h.materialize() for h, _cap in self._buckets[partition]]
         for h, start, cnt, nbytes in self._views[partition]:
             out.append(materialize_view_batch(
@@ -446,9 +463,17 @@ class KudoWireTransport(ShuffleTransport):
     def write(self, pieces):
         from concurrent.futures import ThreadPoolExecutor
         from spark_rapids_tpu.shuffle.serializer import serialize_batch
+        from spark_rapids_tpu.utils.ambient import (Ambients,
+                                                    submit_with_ambients)
         from spark_rapids_tpu.utils.cancel import cancellable_wait
+        # writer threads serialize for the map task: same tenant/
+        # priority/token (a cancelled query's framing stops at the next
+        # blessed wait); captured once for the whole batch of submits
+        amb = Ambients.capture(inherit_semaphore_cover=False)
         with ThreadPoolExecutor(max_workers=self.writer_threads) as pool:
-            futures = [(p, pool.submit(serialize_batch, piece, self.codec))
+            futures = [(p, submit_with_ambients(pool, serialize_batch,
+                                                piece, self.codec,
+                                                ambients=amb))
                        for p, piece in pieces]
             for p, fut in futures:
                 self._buckets[p].append(cancellable_wait(
@@ -464,6 +489,8 @@ class KudoWireTransport(ShuffleTransport):
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
         from spark_rapids_tpu.shuffle.serializer import serialize_batch_ranges
+        from spark_rapids_tpu.utils.ambient import (Ambients,
+                                                    submit_with_ambients)
         from spark_rapids_tpu.utils.cancel import cancellable_wait
 
         def drain(fut):
@@ -472,11 +499,13 @@ class KudoWireTransport(ShuffleTransport):
                 if block is not None:
                     self._buckets[p].append(block)
 
+        amb = Ambients.capture(inherit_semaphore_cover=False)
         pending = deque()
         with ThreadPoolExecutor(max_workers=self.writer_threads) as pool:
             for hb, counts in batches:
-                pending.append(pool.submit(serialize_batch_ranges, hb,
-                                           counts, self.codec))
+                pending.append(submit_with_ambients(
+                    pool, serialize_batch_ranges, hb, counts, self.codec,
+                    ambients=amb))
                 if len(pending) >= 2 * self.writer_threads:
                     drain(pending.popleft())
             while pending:

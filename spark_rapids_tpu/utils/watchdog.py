@@ -83,6 +83,7 @@ class Watchdog:
     def _ensure_thread_locked(self) -> None:
         if self._thread is not None and self._thread.is_alive():
             return
+        # tpu-lint: allow-ambient-propagation(the stall scanner is a process-wide daemon that must observe EVERY query's waits; binding it to one query's ambients would be wrong by construction)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpu-watchdog")
         self._thread.start()

@@ -1,0 +1,131 @@
+"""utils/ambient.py: the blessed ambient-inheriting spawn helpers.
+
+The contract tpu-lint's ambient-propagation rule points every spawn
+site at: a worker spawned through spawn_with_ambients /
+submit_with_ambients observes the SPAWNER's tenant scope, task
+priority, cancel token and (opt-in) device-semaphore cover — and the
+snapshot is taken at spawn time on the spawning thread, so the worker
+keeps the ambients even after the spawner leaves its scopes.
+"""
+import os
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from spark_rapids_tpu.memory.semaphore import (current_task_priority,
+                                               task_priority,
+                                               tpu_semaphore)
+from spark_rapids_tpu.memory.tenant import TENANTS
+from spark_rapids_tpu.utils.ambient import (Ambients, spawn_with_ambients,
+                                            submit_with_ambients)
+from spark_rapids_tpu.utils.cancel import (CancelToken, cancel_scope,
+                                           current_cancel_token)
+
+
+def _observe(out: dict, done: threading.Event):
+    out["tenant"] = TENANTS.current()
+    out["priority"] = current_task_priority()
+    out["token"] = current_cancel_token()
+    out["held"] = tpu_semaphore().held_count()
+    done.set()
+
+
+def test_spawn_inherits_tenant_priority_token():
+    token = CancelToken(label="t")
+    out, done = {}, threading.Event()
+    with TENANTS.scope("acme"), task_priority(7), cancel_scope(token):
+        spawn_with_ambients(_observe, out, done)
+        assert done.wait(5.0)
+    assert out["tenant"] == "acme"
+    assert out["priority"] == 7
+    assert out["token"] is token
+
+
+def test_spawn_captures_at_spawn_time_not_thread_start():
+    """The snapshot happens on the SPAWNING thread at call time: a
+    worker started (start=False) and run after the spawner left its
+    scopes still sees them."""
+    out, done = {}, threading.Event()
+    with TENANTS.scope("late"), task_priority(3):
+        t = spawn_with_ambients(_observe, out, done, start=False)
+    # spawner's scopes are gone now
+    assert TENANTS.current() is None
+    t.start()
+    assert done.wait(5.0)
+    assert out["tenant"] == "late"
+    assert out["priority"] == 3
+
+
+def test_spawn_inherits_semaphore_cover_only_when_held():
+    out, done = {}, threading.Event()
+    with tpu_semaphore().held():
+        spawn_with_ambients(_observe, out, done)
+        assert done.wait(5.0)
+    assert out["held"] > 0, "worker should ride the spawner's slot"
+
+    out2, done2 = {}, threading.Event()
+    spawn_with_ambients(_observe, out2, done2)
+    assert done2.wait(5.0)
+    assert out2["held"] == 0
+
+
+def test_covered_worker_release_cannot_free_spawners_permit():
+    """A covered worker's release_if_necessary is a no-op — the slot
+    belongs to the spawning task (the PR 9 lesson encoded in
+    borrowed_cover, reachable through the helper)."""
+    sem = tpu_semaphore()
+    base = sem._sem.available()
+    done = threading.Event()
+
+    def worker():
+        sem.release_if_necessary()    # must NOT free the spawner's slot
+        done.set()
+
+    with sem.held():
+        avail_held = sem._sem.available()
+        spawn_with_ambients(worker)
+        assert done.wait(5.0)
+        assert sem._sem.available() == avail_held
+    assert sem._sem.available() == base
+
+
+def test_submit_with_ambients_inherits_on_pool_thread():
+    token = CancelToken(label="pool")
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        with TENANTS.scope("poolco"), task_priority(2), \
+                cancel_scope(token):
+            fut = submit_with_ambients(
+                pool, lambda: (TENANTS.current(), current_task_priority(),
+                               current_cancel_token()))
+        tenant, prio, tok = fut.result(timeout=5.0)
+    assert tenant == "poolco"
+    assert prio == 2
+    assert tok is token
+
+
+def test_submit_cover_defaults_off():
+    """Pool tasks routinely outlive the submitting call; cover is only
+    sound while the spawner blocks holding its slot, so it is opt-in."""
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        with tpu_semaphore().held():
+            fut = submit_with_ambients(
+                pool, lambda: tpu_semaphore().held_count())
+            assert fut.result(timeout=5.0) == 0
+            fut2 = submit_with_ambients(
+                pool, lambda: tpu_semaphore().held_count(),
+                inherit_semaphore_cover=True)
+            assert fut2.result(timeout=5.0) > 0
+
+
+def test_ambients_scope_restores_previous_context():
+    amb = Ambients(tenant="x", priority=9, token=None, covered=False)
+    with TENANTS.scope("outer"), task_priority(1):
+        with amb.scope():
+            assert TENANTS.current() == "x"
+            assert current_task_priority() == 9
+        assert TENANTS.current() == "outer"
+        assert current_task_priority() == 1

@@ -19,8 +19,9 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 from tools.tpulint import core as lint_core
-from tools.tpulint import (drift, host_sync, locks, retry_discipline,
-                           swallow, waits)
+from tools.tpulint import (ambient_spawn, counter_discipline, drift,
+                           host_sync, locks, pin_balance,
+                           retry_discipline, swallow, waits)
 
 
 def _src(path: str, text: str) -> lint_core.SourceFile:
@@ -680,3 +681,531 @@ def test_pooled_connection_close_does_not_wait_for_inflight():
     assert not t.is_alive()
     # the in-flight socket was checked in after close() latched: dropped
     assert conn._sock is None
+
+
+# -- the flow engine: CFG construction on a golden mini-module ---------------
+# The exception-edge model is the part reviews kept getting wrong by
+# hand (ISSUE 12): pin these shapes — try/finally, with, early return
+# THROUGH a finally, loop break — as graph facts.
+
+def _golden_cfg(src_text, name):
+    import ast as _ast
+
+    from tools.tpulint.cfg import build_module_info
+    info = build_module_info(_ast.parse(textwrap.dedent(src_text)))
+    return info.functions[name].cfg
+
+
+def _node_containing(cfg, needle):
+    import ast as _ast
+    hits = []
+    for n in cfg.stmt_nodes():
+        try:
+            if needle in _ast.unparse(n.stmt):
+                hits.append(n)
+        except Exception:  # noqa: BLE001 — synthetic nodes
+            pass
+    assert hits, f"no CFG node contains {needle!r}"
+    return hits[0]
+
+
+def test_cfg_try_finally_exception_edge_routes_through_finally():
+    cfg = _golden_cfg("""
+        def f(h):
+            h.acquire()
+            try:
+                work(h)
+            finally:
+                h.release()
+            after(h)
+    """, "f")
+    work = _node_containing(cfg, "work(h)")
+    release = _node_containing(cfg, "h.release()")
+    # work can leave exceptionally...
+    assert any(e.kind == "exc" for e in cfg.successors(work.idx))
+    # ...and every exceptional continuation reaches the finally body,
+    # which in turn reaches BOTH the raise exit (propagation) and the
+    # fallthrough (normal completion)
+    reach_work = cfg.reachable_from(work.idx)
+    assert release.idx in reach_work
+    reach_rel = cfg.reachable_from(release.idx)
+    assert cfg.raise_exit in reach_rel
+    assert _node_containing(cfg, "after(h)").idx in reach_rel
+    # the acquire is OUTSIDE the try: its exception edge must NOT pass
+    # through the release
+    acq = _node_containing(cfg, "h.acquire()")
+    exc_targets = [e.dst for e in cfg.successors(acq.idx)
+                   if e.kind == "exc"]
+    assert exc_targets == [cfg.raise_exit]
+
+
+def test_cfg_early_return_tunnels_through_finally():
+    cfg = _golden_cfg("""
+        def f(h):
+            try:
+                return mk(h)
+            finally:
+                h.release()
+    """, "f")
+    ret = _node_containing(cfg, "return mk(h)")
+    release = _node_containing(cfg, "h.release()")
+    # the return does NOT go straight to the exit...
+    assert cfg.exit not in [e.dst for e in cfg.successors(ret.idx)]
+    # ...but the exit is reachable from it, via the finally body
+    assert release.idx in cfg.reachable_from(ret.idx)
+    assert cfg.exit in cfg.reachable_from(release.idx)
+
+
+def test_cfg_with_body_has_exception_edge():
+    cfg = _golden_cfg("""
+        def f(path):
+            with open(path) as fh:
+                parse(fh)
+            return done()
+    """, "f")
+    ctx = _node_containing(cfg, "open(path)")
+    body = _node_containing(cfg, "parse(fh)")
+    for n in (ctx, body):
+        assert [e.dst for e in cfg.successors(n.idx)
+                if e.kind == "exc"] == [cfg.raise_exit]
+
+
+def test_cfg_loop_break_and_back_edges():
+    cfg = _golden_cfg("""
+        def f(xs):
+            for x in xs:
+                if bad(x):
+                    break
+                use(x)
+            return tally()
+    """, "f")
+    brk = _node_containing(cfg, "break")
+    use = _node_containing(cfg, "use(x)")
+    ret = _node_containing(cfg, "return tally()")
+    # break jumps past the loop: the return is reachable without a
+    # back edge
+    assert ret.idx in cfg.reachable_from(brk.idx, skip_kinds=("back",))
+    # the body's fallthrough loops back (a back edge exists somewhere
+    # downstream of use)
+    assert any(e.kind == "back"
+               for n in cfg.nodes for e in cfg.successors(n.idx))
+    assert ret.idx in cfg.reachable_from(use.idx)
+
+
+def test_cfg_catch_all_handler_consumes_the_exception():
+    """`except BaseException` leaves no unmatched-handler path — the
+    imprecision that would otherwise fabricate leak reports from every
+    try/except unwind."""
+    cfg = _golden_cfg("""
+        def f(h):
+            try:
+                return work(h)
+            except BaseException:
+                h.unwind()
+                raise
+    """, "f")
+    work = _node_containing(cfg, "work(h)")
+    unwind = _node_containing(cfg, "h.unwind()")
+    # every exceptional path out of work passes through the handler
+    exc_dsts = [e.dst for e in cfg.successors(work.idx)
+                if e.kind == "exc"]
+    assert exc_dsts and all(
+        unwind.idx in ({d} | cfg.reachable_from(d)) for d in exc_dsts)
+
+
+# -- fixture corpus: the three HISTORICAL pre-fix bug shapes -----------------
+# Each is the shape of real repo code BEFORE its fix (PR 9/11); the
+# flow engine must catch all three (ISSUE 12 acceptance).
+
+def test_pin_balance_catches_pr11_unmatched_unpin_on_raise():
+    """PR 11: CacheOnlyTransport's read path unpinned in a finally that
+    also ran when materialize_pinned ITSELF raised — the unmatched unpin
+    stole a concurrent consumer's pin, so spill could free data
+    mid-use."""
+    src = _src("spark_rapids_tpu/shuffle/transport.py", """
+        class CacheOnlyTransport:
+            def read(self, partition):
+                out = []
+                for piece in self._pieces[partition]:
+                    try:
+                        mat = piece.materialize_pinned()
+                        out.append(slice_view(mat))
+                    finally:
+                        piece.unpin()
+                return out
+    """)
+    vs = pin_balance.check([src])
+    assert any("never acquired" in v.message for v in vs), \
+        "\n".join(v.render() for v in vs)
+
+
+def test_pin_balance_catches_pr11_failed_fallback_gather_leak():
+    """PR 11's second shape: the fallback gather after a successful
+    acquire could raise, leaving the backing pinned with no owner."""
+    src = _src("spark_rapids_tpu/shuffle/transport.py", """
+        class StreamPiece:
+            def materialize_batch_pinned(self):
+                mat = self.materialize_pinned()
+                return with_retry_no_split(lambda: slice_view(mat))
+    """)
+    vs = pin_balance.check([src])
+    assert any("exception path" in v.message for v in vs), \
+        "\n".join(v.render() for v in vs)
+
+
+def test_ambient_rule_catches_pr9_bare_thread_producer():
+    """PR 9: the pipelined producer ran on a bare Thread, acquired the
+    device semaphore at default priority with no cover and deadlocked
+    once every slot was held by blocked consumers."""
+    src = _src("spark_rapids_tpu/shuffle/pipeline.py", """
+        import threading
+
+        from spark_rapids_tpu.memory.semaphore import tpu_semaphore
+        from spark_rapids_tpu.memory.tenant import TENANTS
+
+        def pipelined(source, pipe):
+            def produce():
+                with TENANTS.scope(None), tpu_semaphore().held():
+                    for item in source:
+                        pipe.put(item)
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
+    """)
+    vs = ambient_spawn.check([src])
+    assert any("spawn_with_ambients" in v.message for v in vs), \
+        "\n".join(v.render() for v in vs)
+
+
+def test_counter_rule_catches_pr11_increment_inside_retry():
+    """PR 11: range_view_materializes counted inside a body retried by
+    with_retry_no_split — every OOM retry double-counted it."""
+    src = _src("spark_rapids_tpu/shuffle/transport.py", """
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+
+        def materialize_view_batch(piece):
+            def attempt():
+                SHUFFLE_COUNTERS.add(range_view_materializes=1)
+                return slice_view(piece.materialize_pinned())
+            return with_retry_no_split(attempt)
+    """)
+    vs = counter_discipline.check([src])
+    assert any("once per ATTEMPT" in v.message for v in vs), \
+        "\n".join(v.render() for v in vs)
+
+
+# -- the blessed/fixed shapes analyze clean ----------------------------------
+
+def test_pin_balance_accepts_acquire_before_try():
+    src = _src("spark_rapids_tpu/shuffle/transport.py", """
+        def materialize_view_batch(piece):
+            def attempt():
+                mat = piece.materialize_pinned()
+                try:
+                    return slice_view(mat)
+                finally:
+                    piece.unpin()
+            return with_retry_no_split(attempt)
+    """)
+    assert pin_balance.check([src]) == []
+
+
+def test_pin_balance_accepts_pinned_ledger_unwind():
+    src = _src("spark_rapids_tpu/plan/execs/_fixture.py", """
+        def merge_bucket(q, merge):
+            batches = []
+            pinned = []
+            try:
+                for h in q:
+                    batches.append(h.materialize())
+                    pinned.append(h)
+                return merge(batches)
+            finally:
+                for h in pinned:
+                    h.unpin()
+    """)
+    assert pin_balance.check([src]) == []
+
+
+def test_pin_balance_accepts_guarded_release():
+    """Path-condition-lite: the release guard correlates with the
+    acquire having run, so the join does not fabricate an unmatched
+    unpin."""
+    src = _src("spark_rapids_tpu/plan/execs/_fixture.py", """
+        def run_once(h, body):
+            mat = None
+            try:
+                mat = h.materialize()
+                return body(mat)
+            finally:
+                if mat is not None:
+                    h.unpin()
+    """)
+    assert pin_balance.check([src]) == []
+
+
+def test_pin_balance_accepts_transfer_api_and_except_unwind():
+    src = _src("spark_rapids_tpu/shuffle/transport.py", """
+        class StreamPiece:
+            def materialize_pinned(self):
+                batch = self._handle.materialize()
+                try:
+                    return self.as_view(batch)
+                except BaseException:
+                    self._handle.unpin()
+                    raise
+    """)
+    assert pin_balance.check([src]) == []
+
+
+def test_ambient_rule_accepts_blessed_spawn_and_infra_thread():
+    src = _src("spark_rapids_tpu/shuffle/pipeline.py", """
+        import threading
+
+        from spark_rapids_tpu.memory.tenant import TENANTS
+        from spark_rapids_tpu.utils.ambient import spawn_with_ambients
+
+        def pipelined(source, pipe):
+            def produce():
+                with TENANTS.scope(None):
+                    for item in source:
+                        pipe.put(item)
+            spawn_with_ambients(produce, name="producer")
+
+        def sampler():
+            def tick():
+                return 42
+            threading.Thread(target=tick, daemon=True).start()
+    """)
+    assert ambient_spawn.check([src]) == []
+
+
+def test_ambient_rule_flags_pool_by_provenance():
+    """A pool recognized by ThreadPoolExecutor provenance, not name."""
+    src = _src("spark_rapids_tpu/io/_fixture.py", """
+        from concurrent.futures import ThreadPoolExecutor
+
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+
+        _workers = ThreadPoolExecutor(2)
+
+        def kick():
+            def job():
+                SHUFFLE_COUNTERS.add(blocks_fetched=1)
+            _workers.submit(job)
+    """)
+    vs = ambient_spawn.check([src])
+    assert any("pool submit" in v.message for v in vs)
+
+
+def test_counter_rule_accepts_attempt_idempotent_increment():
+    """An increment with nothing fallible after it runs exactly once —
+    on the attempt that succeeds."""
+    src = _src("spark_rapids_tpu/shuffle/transport.py", """
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+
+        def materialize_view_batch(piece):
+            def attempt():
+                out = slice_view(piece.materialize_pinned())
+                SHUFFLE_COUNTERS.add(range_view_materializes=1)
+                return out
+            return with_retry_no_split(attempt)
+    """)
+    assert counter_discipline.check([src]) == []
+
+
+def test_counter_rule_accepts_increment_outside_retry():
+    src = _src("spark_rapids_tpu/shuffle/transport.py", """
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+
+        def materialize_view_batch(piece):
+            SHUFFLE_COUNTERS.add(range_view_materializes=1)
+            return with_retry_no_split(lambda: slice_view(piece))
+    """)
+    assert counter_discipline.check([src]) == []
+
+
+# -- regression pins: the pin leaks the new rule found were FIXED ------------
+
+def test_window_exception_path_pin_leak_was_fixed():
+    """Pre-fix shape of window.py's two-pass loops: a retry-exhausted
+    OOM between materialize and unpin left the batch pinned (and
+    therefore unspillable) for the rest of the query."""
+    pre_fix = _src("spark_rapids_tpu/plan/execs/window.py", """
+        def two_pass(handles, run):
+            for h in handles:
+                b = h.materialize()
+                out = run(b)
+                h.unpin()
+                h.close()
+    """)
+    assert any("exception path" in v.message
+               for v in pin_balance.check([pre_fix]))
+    for rel in ("spark_rapids_tpu/plan/execs/window.py",
+                "spark_rapids_tpu/plan/execs/aggregate.py",
+                "spark_rapids_tpu/plan/execs/join.py",
+                "spark_rapids_tpu/shuffle/transport.py"):
+        real = lint_core.load_source(REPO, rel)
+        vs = _unsuppressed(pin_balance.check([real]), real)
+        assert vs == [], f"{rel}:\n" + "\n".join(v.render() for v in vs)
+
+
+def test_spawn_sites_are_migrated_or_reasoned():
+    """Every engine-reaching spawn site goes through utils/ambient.py
+    (or carries a reasoned suppression) — the PR 9/10 class stays a
+    lint error."""
+    for rel in ("spark_rapids_tpu/shuffle/pipeline.py",
+                "spark_rapids_tpu/shuffle/net.py",
+                "spark_rapids_tpu/cluster/executor.py",
+                "spark_rapids_tpu/io/async_writer.py",
+                "spark_rapids_tpu/io/reader_pool.py",
+                "spark_rapids_tpu/serving/admission.py"):
+        real = lint_core.load_source(REPO, rel)
+        vs = _unsuppressed(ambient_spawn.check([real]), real)
+        assert vs == [], f"{rel}:\n" + "\n".join(v.render() for v in vs)
+
+
+# -- machine-readable output (--format sarif / github) -----------------------
+
+def test_sarif_output_matches_schema_shape():
+    from tools.tpulint.formats import to_sarif
+    vs = [lint_core.Violation("pin-balance", "a/b.py", 12, "C.m", "msg"),
+          lint_core.Violation("drift", "docs/x.md", 1, "<rules>", "m2")]
+    log = to_sarif(vs)
+    # the SARIF 2.1.0 shape CI ingesters require
+    assert log["version"] == "2.1.0"
+    assert log["$schema"].endswith("sarif-schema-2.1.0.json")
+    (run,) = log["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "tpu-lint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert set(lint_core.ALL_RULES) <= set(rule_ids)
+    assert all("shortDescription" in r and "text" in r["shortDescription"]
+               for r in driver["rules"])
+    assert len(run["results"]) == 2
+    for res, v in zip(run["results"], vs):
+        assert res["ruleId"] == v.rule
+        assert rule_ids[res["ruleIndex"]] == v.rule
+        assert res["level"] == "error"
+        assert v.message in res["message"]["text"]
+        (loc,) = res["locations"]
+        phys = loc["physicalLocation"]
+        assert phys["artifactLocation"]["uri"] == v.file
+        assert phys["region"]["startLine"] == max(v.line, 1)
+        assert res["partialFingerprints"]["tpulint/v1"] == v.fingerprint
+    # and it round-trips through json
+    json.loads(json.dumps(log))
+
+
+def test_github_annotation_format():
+    from tools.tpulint.formats import render_github
+    v = lint_core.Violation("swallow", "x/y.py", 7, "f",
+                            "multi%line\nmessage")
+    (line,) = render_github([v]).splitlines()
+    assert line.startswith("::error file=x/y.py,line=7,"
+                           "title=tpu-lint swallow::")
+    assert "\n" not in line and "%0A" in line and "%25" in line
+
+
+# -- runner plumbing: timing, file subsets, doc coverage ---------------------
+
+def test_run_all_timed_reports_every_ast_rule():
+    violations, timings = lint_core.run_all_timed(
+        REPO, with_drift=False,
+        files=["spark_rapids_tpu/shuffle/pipeline.py"])
+    expected = set(lint_core.ALL_RULES) - {"drift"}
+    assert expected <= set(timings)
+    assert all(t >= 0 for t in timings.values())
+    # the subset run sees only the named file
+    assert all(v.file == "spark_rapids_tpu/shuffle/pipeline.py"
+               for v in violations)
+
+
+def test_changed_files_is_well_formed():
+    from tools.tpulint.__main__ import changed_files
+    files = changed_files()
+    assert isinstance(files, list)
+    assert all(f.startswith("spark_rapids_tpu/") and f.endswith(".py")
+               for f in files)
+
+
+def test_lint_doc_covers_every_registered_rule():
+    assert drift._check_lint_doc(REPO) == []
+
+
+def test_lint_doc_drift_fires_on_undocumented_rule():
+    old = lint_core.ALL_RULES
+    lint_core.ALL_RULES = old + ("made-up-rule",)
+    try:
+        vs = drift._check_lint_doc(REPO)
+    finally:
+        lint_core.ALL_RULES = old
+    assert any("made-up-rule" in v.message for v in vs)
+
+
+def test_dataflow_backward_solver_release_reachability():
+    """The backward solver: 'does a release lie on every path from
+    here to an exit?' — YES downstream of the try (both continuations
+    pass the finally), MAYBE at the acquire (its own exception edge
+    bypasses the finally)."""
+    from tools.tpulint.dataflow import NO, YES, MAYBE, solve_backward, \
+        tri_join
+    cfg = _golden_cfg("""
+        def f(h):
+            h.acquire()
+            try:
+                work(h)
+            finally:
+                h.release()
+    """, "f")
+    release = _node_containing(cfg, "h.release()")
+    work = _node_containing(cfg, "work(h)")
+    acq = _node_containing(cfg, "h.acquire()")
+
+    def transfer(node, out_state):
+        return YES if node.idx == release.idx else out_state
+
+    out = solve_backward(cfg, NO, transfer, tri_join)
+    assert out[work.idx] == YES
+    assert out[acq.idx] == MAYBE
+
+
+def test_pin_balance_ledger_does_not_mask_unrelated_leak():
+    """A pinned-ledger unwind clears only ITS OWN receivers: an
+    unrelated acquire's exception-path leak in the same function must
+    still be flagged."""
+    src = _src("spark_rapids_tpu/plan/execs/_fixture.py", """
+        def merge_bucket(g, q, merge):
+            extra = g.materialize()
+            pinned = []
+            try:
+                batches = []
+                for h in q:
+                    batches.append(h.materialize())
+                    pinned.append(h)
+                return merge(batches, extra)
+            finally:
+                for h in pinned:
+                    h.unpin()
+    """)
+    vs = pin_balance.check([src])
+    assert any("g.materialize()" in v.message for v in vs), \
+        "\n".join(v.render() for v in vs)
+
+
+def test_pin_balance_catches_single_expression_acquire_then_raise():
+    """The one-statement spelling of the failed-fallback-gather leak:
+    the acquire succeeds and the consuming call raises in the same
+    expression."""
+    src = _src("spark_rapids_tpu/shuffle/transport.py", """
+        def materialize_view(h):
+            return slice_view(h.materialize())
+    """)
+    vs = pin_balance.check([src])
+    assert any("exception path" in v.message for v in vs), \
+        "\n".join(v.render() for v in vs)
+
+
+def test_changed_mode_refuses_baseline_update():
+    from tools.tpulint.__main__ import main as lint_main
+    with pytest.raises(SystemExit):
+        lint_main(["--changed", "--update-baseline"])

@@ -55,7 +55,15 @@ SUITES = {
     # range-view store it was built to validate
     "profile": (["tests/test_prog_profile.py",
                  "tests/test_range_views.py"], 900),
-    "lint": (["tests/test_lint.py"], 300),
+    "lint": (["tests/test_lint.py", "tests/test_ambient.py"], 300),
+}
+
+#: extra commands run (and required green) after a suite's pytest pass.
+#: The lint suite also runs the CLI with --timing so the per-rule wall
+#: clock shows up in every `run_suites.py lint` report — the flow rules
+#: (pin-balance etc.) must stay affordable in tier-1.
+POST_CMDS = {
+    "lint": [[sys.executable, "-m", "tools.tpulint", "--timing"]],
 }
 
 def _parse_tail(tail: str):
@@ -145,6 +153,19 @@ def main(argv=None) -> int:
         print(f"== {name} ({len(files)} files, "
               f"timeout {int(tmo * args.timeout_scale)}s) ==", flush=True)
         r = run_suite(name, files, tmo * args.timeout_scale, extra)
+        for cmd in POST_CMDS.get(name, ()):
+            try:
+                post = subprocess.run(cmd, cwd=REPO,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT,
+                                      timeout=tmo * args.timeout_scale)
+                print(post.stdout.decode("utf-8", "replace"), flush=True)
+                if post.returncode != 0 and r["status"] == "PASS":
+                    r["status"], r["rc"] = "FAIL", post.returncode
+            except (OSError, subprocess.TimeoutExpired) as e:
+                print(f"post command {cmd} failed: {e}", flush=True)
+                if r["status"] == "PASS":
+                    r["status"], r["rc"] = "FAIL", 2
         results.append(r)
         if r["status"] != "PASS" or args.verbose:
             print(r["tail"])

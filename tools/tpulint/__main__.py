@@ -1,15 +1,24 @@
-"""CLI: ``python -m tools.tpulint [--update-baseline] [--rules a,b] [--no-drift]``.
+"""CLI: ``python -m tools.tpulint [--update-baseline] [--rules a,b]
+[--no-drift] [--changed] [--format text|sarif|github] [--timing]``.
 
 Exit status 0 when every violation is either inline-suppressed or
 baselined; 1 otherwise.  ``--update-baseline`` rewrites the baseline to
 the current violation set (existing reasons preserved, new entries get a
 ``TODO: review`` placeholder to be replaced during review, stale entries
 pruned) and exits 0.
+
+``--changed`` lints only the files git reports changed against the
+merge-base with the main branch (plus uncommitted changes) -- the cheap
+pre-push mode; the full flow-sensitive pass stays in tier-1.
+``--format sarif`` / ``--format github`` emit machine-readable output
+for CI surfacing (tools/tpulint/formats.py).  ``--timing`` prints the
+per-rule wall-clock report.
 """
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
@@ -21,9 +30,40 @@ from tools.tpulint.core import (
     REPO,
     apply_baseline,
     load_baseline,
-    run_all,
+    run_all_timed,
     save_baseline,
 )
+from tools.tpulint.formats import (render_github, render_sarif,
+                                   render_timings)
+
+
+def _git(args, cwd=REPO) -> str:
+    try:
+        return subprocess.run(["git", *args], cwd=cwd, text=True,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.DEVNULL,
+                              check=True).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return ""
+
+
+def changed_files(base: str = "main") -> list:
+    """Repo-relative .py files under spark_rapids_tpu/ changed against
+    the merge-base with ``base``, plus working-tree changes (staged,
+    unstaged, untracked)."""
+    merge_base = _git(["merge-base", "HEAD", base]).strip()
+    names = set()
+    if merge_base:
+        names |= set(_git(["diff", "--name-only", merge_base,
+                           "--"]).splitlines())
+    names |= set(_git(["diff", "--name-only", "HEAD",
+                       "--"]).splitlines())
+    names |= set(_git(["ls-files", "--others",
+                       "--exclude-standard"]).splitlines())
+    return sorted(n for n in names
+                  if n.endswith(".py")
+                  and n.startswith("spark_rapids_tpu/")
+                  and os.path.exists(os.path.join(REPO, n)))
 
 
 def main(argv=None) -> int:
@@ -35,11 +75,47 @@ def main(argv=None) -> int:
     parser.add_argument("--no-drift", action="store_true",
                         help="skip the registry/doc/API drift checker "
                         "(the one that imports the live package)")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only files changed against the "
+                        "merge-base with --base (plus working tree); "
+                        "implies --no-drift")
+    parser.add_argument("--base", default="main",
+                        help="branch for --changed's merge-base "
+                        "(default: main)")
+    parser.add_argument("--format", default="text",
+                        choices=("text", "sarif", "github"),
+                        help="violation output format")
+    parser.add_argument("--timing", action="store_true",
+                        help="print the per-rule wall-clock report")
     parser.add_argument("--baseline", default=BASELINE_PATH)
     args = parser.parse_args(argv)
 
     rules = args.rules.split(",") if args.rules else None
-    violations = run_all(REPO, rules=rules, with_drift=not args.no_drift)
+    files = None
+    with_drift = not args.no_drift
+    if args.changed:
+        if args.update_baseline:
+            # a subset run only SEES the subset's violations: rewriting
+            # the baseline from it would silently drop every reviewed
+            # entry for unchanged files
+            parser.error("--update-baseline needs a full run; "
+                         "drop --changed")
+        if rules and "drift" in rules:
+            # drift checks global registries, not files — forcing it
+            # off here while honoring --rules would green-light a run
+            # where no checker executed at all
+            parser.error("the drift rule needs a full run; drop --changed")
+        files = changed_files(args.base)
+        with_drift = False      # drift checks global registries, not files
+        if not files:
+            if args.format == "sarif":
+                sys.stdout.write(render_sarif([]))
+            elif args.format == "text":
+                print("tpu-lint: no changed files to lint")
+            return 0
+    violations, timings = run_all_timed(REPO, rules=rules,
+                                        with_drift=with_drift,
+                                        files=files)
     baseline = load_baseline(args.baseline)
 
     if args.update_baseline:
@@ -62,8 +138,22 @@ def main(argv=None) -> int:
         return 0
 
     fresh, stale = apply_baseline(violations, baseline)
-    for fp in stale:
-        print(f"note: stale baseline entry (no longer fires): {fp}")
+    fresh.sort(key=lambda v: (v.file, v.line))
+    if args.timing:
+        # stderr: --format sarif/github need a clean machine-readable
+        # stdout, and run_suites captures both streams anyway
+        print(render_timings(timings), file=sys.stderr)
+
+    if args.format == "sarif":
+        sys.stdout.write(render_sarif(fresh))
+        return 1 if fresh else 0
+    if args.format == "github":
+        sys.stdout.write(render_github(fresh))
+        return 1 if fresh else 0
+
+    if not args.changed:
+        for fp in stale:
+            print(f"note: stale baseline entry (no longer fires): {fp}")
     todo = [e for e in baseline.values()
             if e.get("reason", "") in ("", PLACEHOLDER_REASON)]
     for e in todo:
@@ -71,14 +161,18 @@ def main(argv=None) -> int:
               f"{e['fingerprint']}")
     if fresh:
         print(f"tpu-lint: {len(fresh)} violation(s):")
-        for v in sorted(fresh, key=lambda v: (v.file, v.line)):
+        for v in fresh:
             print("  " + v.render())
         print("\nfix the code, add `# tpu-lint: allow-<rule>(reason)`, or "
               "run `python -m tools.tpulint --update-baseline` and review "
               "the new entries.")
         return 1
     n = len(violations)
-    print(f"tpu-lint OK ({n} baselined, {len(stale)} stale, "
+    scope = f" ({len(files)} changed file(s))" if files is not None else ""
+    # a subset run cannot judge staleness: entries for unchanged files
+    # simply were not checked
+    stale_part = "" if args.changed else f"{len(stale)} stale, "
+    print(f"tpu-lint OK{scope} ({n} baselined, {stale_part}"
           f"{len(todo)} unreviewed)")
     return 0
 

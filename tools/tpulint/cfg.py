@@ -1,0 +1,627 @@
+"""Intraprocedural CFG construction for the flow-sensitive tpu-lint rules.
+
+Statement-level control-flow graphs built from stdlib ``ast``: each simple
+statement (and each branch/loop test) is one node; edges carry a KIND --
+
+  * ``next``  -- ordinary fallthrough
+  * ``true`` / ``false`` -- branch edges out of a test node, optionally
+    carrying a GUARD ``(varname, sense)`` extracted from simple tests
+    (``if v:``, ``if v is None:``, ``if not v:``) so a dataflow client can
+    refine its state per branch (the path-condition-lite that makes
+    ``if ok: unpin()`` join correctly);
+  * ``exc``   -- the exceptional edge out of any statement that can raise
+    (contains a Call / Raise / Assert / yield), to the innermost enclosing
+    handler-or-finally, else to the function's RAISE EXIT;
+  * ``back``  -- loop back edge (marked so clients can widen or ignore).
+
+Exception modeling is deliberately merged-and-over-approximate (the right
+trade for a linter):
+
+  * ``try/except`` routes body exc edges to EVERY handler entry AND to the
+    outer exception target (a raised exception may match no handler);
+  * ``try/finally`` builds the finally body ONCE; every way of leaving the
+    try region (fallthrough, exception, return, break, continue) enters
+    it, and its exit fans out to every continuation that actually occurred
+    in the body (after-try / outer exc target / function exit / loop
+    targets).  Paths are merged, never lost;
+  * ``with`` bodies keep their exc edges to the enclosing target (the
+    ``__exit__`` call is not modeled as a node -- rules that care about
+    context-manager semantics match the ``with`` statement itself).
+
+Nested ``def``/``lambda`` bodies are NOT inlined -- each gets its own CFG
+(`build_module_cfgs`); `ModuleInfo` carries the same-module call/return
+summaries (who defines what, who references what) rules use to reason
+across helper boundaries.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# edge kinds
+NEXT, TRUE, FALSE, EXC, BACK = "next", "true", "false", "exc", "back"
+
+
+@dataclass(frozen=True)
+class Edge:
+    dst: int
+    kind: str
+    #: optional (varname, sense) guard on a branch edge: traversing this
+    #: edge means ``bool(varname) == sense`` held (is/is-not-None tests
+    #: normalize to truthiness of the name for the linter's purposes)
+    guard: Optional[Tuple[str, bool]] = None
+
+
+@dataclass
+class Node:
+    idx: int
+    kind: str                    # "entry" | "exit" | "raise" | "stmt" | "test"
+    stmt: Optional[ast.AST]      # the AST statement/test expr (None for
+                                 # the synthetic entry/exit/raise nodes)
+    line: int = 0
+
+
+class FunctionCFG:
+    """CFG of one function/lambda body."""
+
+    def __init__(self, qualname: str, func: ast.AST):
+        self.qualname = qualname
+        self.func = func
+        self.nodes: List[Node] = []
+        self.edges: Dict[int, List[Edge]] = {}
+        self.entry = self._new("entry", None)
+        self.exit = self._new("exit", None)
+        self.raise_exit = self._new("raise", None)
+
+    def _new(self, kind: str, stmt: Optional[ast.AST]) -> int:
+        idx = len(self.nodes)
+        self.nodes.append(Node(idx, kind, stmt,
+                               getattr(stmt, "lineno", 0)))
+        self.edges[idx] = []
+        return idx
+
+    def add_edge(self, src: int, dst: int, kind: str = NEXT,
+                 guard: Optional[Tuple[str, bool]] = None) -> None:
+        for e in self.edges[src]:
+            if e.dst == dst and e.kind == kind and e.guard == guard:
+                return
+        self.edges[src].append(Edge(dst, kind, guard))
+
+    def successors(self, idx: int) -> List[Edge]:
+        return self.edges[idx]
+
+    def preds(self) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {n.idx: [] for n in self.nodes}
+        for src, es in self.edges.items():
+            for e in es:
+                out[e.dst].append(src)
+        return out
+
+    # -- conveniences for rules/tests ----------------------------------------
+
+    def stmt_nodes(self) -> List[Node]:
+        return [n for n in self.nodes if n.stmt is not None]
+
+    def find(self, pred) -> List[Node]:
+        return [n for n in self.nodes if n.stmt is not None
+                and pred(n.stmt)]
+
+    def edge_kinds(self, src: int, dst: int) -> Set[str]:
+        return {e.kind for e in self.edges[src] if e.dst == dst}
+
+    def reachable_from(self, start: int,
+                       skip_kinds: Iterable[str] = ()) -> Set[int]:
+        """Nodes reachable from ``start`` (itself excluded unless on a
+        cycle), optionally ignoring some edge kinds."""
+        skip = set(skip_kinds)
+        seen: Set[int] = set()
+        stack = [e.dst for e in self.edges[start] if e.kind not in skip]
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(e.dst for e in self.edges[n]
+                         if e.kind not in skip)
+        return seen
+
+
+#: builtins whose calls the exceptional-edge heuristic treats as pure
+#: (an ``isinstance`` test must not manufacture a raise path)
+SAFE_BUILTIN_CALLS = {"isinstance", "len", "id", "type"}
+
+
+def _may_raise(stmt: ast.AST) -> bool:
+    """Conservative: a statement containing a call, raise, assert or
+    yield can leave via the exceptional edge.  Nested def/lambda bodies
+    do not count (they run later, elsewhere)."""
+    if stmt is None:
+        return False
+    for sub in _walk_shallow(stmt):
+        if isinstance(sub, ast.Call):
+            if isinstance(sub.func, ast.Name) and \
+                    sub.func.id in SAFE_BUILTIN_CALLS:
+                continue
+            return True
+        if isinstance(sub, (ast.Raise, ast.Assert,
+                            ast.Yield, ast.YieldFrom, ast.Await)):
+            return True
+    return False
+
+
+def _walk_shallow(node: ast.AST):
+    """ast.walk that does not descend into nested function/lambda
+    bodies (their statements execute under a different CFG)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # arguments/defaults evaluate here; bodies do not
+                continue
+            stack.append(child)
+
+
+def _guard_of(test: ast.AST) -> Optional[Tuple[str, bool]]:
+    """(varname, sense-of-true-branch) for the simple tests the
+    path-condition-lite refinement understands."""
+    if isinstance(test, ast.Name):
+        return (test.id, True)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and isinstance(test.operand, ast.Name):
+        return (test.operand.id, False)
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+            isinstance(test.left, ast.Name) and \
+            isinstance(test.comparators[0], ast.Constant) and \
+            test.comparators[0].value is None:
+        if isinstance(test.ops[0], ast.Is):
+            return (test.left.id, False)      # true branch => v is None
+        if isinstance(test.ops[0], ast.IsNot):
+            return (test.left.id, True)       # true branch => v is not None
+    return None
+
+
+def _has_catch_all(handlers) -> bool:
+    """True when some handler catches everything that matters for flow:
+    bare ``except:``, ``except BaseException``, or ``except Exception``
+    (linters treat Exception as catch-all; the KeyboardInterrupt residue
+    is not worth a spurious no-handler-matched path)."""
+    for h in handlers:
+        if h.type is None:
+            return True
+        types = (h.type.elts if isinstance(h.type, ast.Tuple)
+                 else [h.type])
+        for t in types:
+            name = dotted_name(t)
+            if name.rsplit(".", 1)[-1] in ("Exception", "BaseException"):
+                return True
+    return False
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class _Frame:
+    """One try/finally frame: records which continuations actually left
+    the try region so the (merged) finally exit can fan out to them."""
+
+    __slots__ = ("finally_entry", "continuations")
+
+    def __init__(self, finally_entry: int):
+        self.finally_entry = finally_entry
+        # set of (kind, target idx, loop frame-depth or -1); kinds:
+        # "after" | "exc" | "return" | "break" | "continue"
+        self.continuations: Set[Tuple[str, int, int]] = set()
+
+
+class _Builder:
+    """Exception edges always target ``exc_stack[-1]`` directly; the
+    stack is kept correct by construction (a try body pushes its handler
+    dispatch, a try/finally body pushes the finally entry, handler/else
+    bodies under a finally push the finally entry).  Only RETURN /
+    BREAK / CONTINUE tunnel through finally frames, hop by hop."""
+
+    def __init__(self, cfg: FunctionCFG):
+        self.cfg = cfg
+        #: innermost exception continuation
+        self.exc_stack: List[int] = [cfg.raise_exit]
+        #: (continue_target, break_target, frame_depth) per loop
+        self.loop_stack: List[Tuple[int, int, int]] = []
+        #: enclosing try/finally frames, innermost last
+        self.finally_stack: List[_Frame] = []
+
+    # -- exits that may tunnel through finally blocks ------------------------
+
+    def _route(self, kind: str, target: int, src: int,
+               loop_depth: int = -1) -> None:
+        """Route return/break/continue from ``src``: enters the
+        innermost finally when one encloses (recording the pending
+        continuation for hop-by-hop propagation), else edges directly.
+        ``loop_depth`` is the finally-stack depth at the target loop's
+        creation (break/continue stop tunneling there)."""
+        if self.finally_stack and (loop_depth < 0 or
+                                   len(self.finally_stack) > loop_depth):
+            frame = self.finally_stack[-1]
+            self.cfg.add_edge(src, frame.finally_entry)
+            frame.continuations.add((kind, target, loop_depth))
+        else:
+            self.cfg.add_edge(src, target)
+
+    def _wire_frame(self, frame: _Frame, fin_out: int) -> None:
+        """Connect a popped frame's finally exit to every continuation
+        that occurred, propagating through the next frame out when the
+        continuation's destination lies beyond it."""
+        for kind, target, loop_depth in sorted(frame.continuations):
+            if kind == "exc":
+                self.cfg.add_edge(fin_out, target, EXC)
+            elif kind == "after":
+                self.cfg.add_edge(fin_out, target)
+            else:
+                self._route(kind, target, fin_out, loop_depth)
+
+    def exc_target(self) -> int:
+        return self.exc_stack[-1]
+
+    # -- statement sequences --------------------------------------------------
+
+    def seq(self, stmts: List[ast.stmt], entry: int) -> int:
+        """Build ``stmts``; returns the node every fallthrough ends at
+        (a fresh join point), or -1 when no path falls through."""
+        cur = entry
+        for stmt in stmts:
+            if cur < 0:
+                # unreachable code after return/raise/break: still build
+                # nodes (rules may want them) from a dead entry
+                cur = self.cfg._new("stmt", None)
+            cur = self.stmt(stmt, cur)
+        return cur
+
+    def stmt(self, stmt: ast.stmt, cur: int) -> int:
+        cfg = self.cfg
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            n = cfg._new("stmt", stmt)     # the def statement itself
+            cfg.add_edge(cur, n)
+            return n
+        if isinstance(stmt, ast.Return):
+            n = cfg._new("stmt", stmt)
+            cfg.add_edge(cur, n)
+            if _may_raise(stmt):
+                cfg.add_edge(n, self.exc_target(), EXC)
+            self._route("return", cfg.exit, n)
+            return -1
+        if isinstance(stmt, ast.Raise):
+            n = cfg._new("stmt", stmt)
+            cfg.add_edge(cur, n)
+            cfg.add_edge(n, self.exc_target(), EXC)
+            return -1
+        if isinstance(stmt, ast.Break):
+            n = cfg._new("stmt", stmt)
+            cfg.add_edge(cur, n)
+            if self.loop_stack:
+                _, brk, depth = self.loop_stack[-1]
+                self._route("break", brk, n, depth)
+            return -1
+        if isinstance(stmt, ast.Continue):
+            n = cfg._new("stmt", stmt)
+            cfg.add_edge(cur, n)
+            if self.loop_stack:
+                cont, _, depth = self.loop_stack[-1]
+                self._route("continue", cont, n, depth)
+            return -1
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, cur)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, cur)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, cur)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, cur)
+        # simple statement
+        n = cfg._new("stmt", stmt)
+        cfg.add_edge(cur, n)
+        if _may_raise(stmt):
+            cfg.add_edge(n, self.exc_target(), EXC)
+        return n
+
+    def _if(self, stmt: ast.If, cur: int) -> int:
+        cfg = self.cfg
+        test = cfg._new("test", stmt.test)
+        cfg.add_edge(cur, test)
+        if _may_raise(stmt.test):
+            cfg.add_edge(test, self.exc_target(), EXC)
+        guard = _guard_of(stmt.test)
+        join = cfg._new("stmt", None)
+        body_in = cfg._new("stmt", None)
+        cfg.add_edge(test, body_in, TRUE, guard)
+        body_out = self.seq(stmt.body, body_in)
+        if body_out >= 0:
+            cfg.add_edge(body_out, join)
+        neg = (guard[0], not guard[1]) if guard else None
+        if stmt.orelse:
+            else_in = cfg._new("stmt", None)
+            cfg.add_edge(test, else_in, FALSE, neg)
+            else_out = self.seq(stmt.orelse, else_in)
+            if else_out >= 0:
+                cfg.add_edge(else_out, join)
+        else:
+            cfg.add_edge(test, join, FALSE, neg)
+        return join
+
+    def _loop(self, stmt, cur: int) -> int:
+        cfg = self.cfg
+        # the header node carries ONLY the loop's test/iterator
+        # expression -- storing the whole compound statement would make
+        # dataflow clients see the body's effects at the header
+        header_expr = getattr(stmt, "test", None)
+        if header_expr is None:
+            header_expr = stmt.iter
+        header = cfg._new("test", header_expr)
+        cfg.nodes[header].line = stmt.lineno
+        cfg.add_edge(cur, header)
+        # iterating / testing can raise (StopIteration is internal, but
+        # the iterable's __next__ can raise anything)
+        if _may_raise(header_expr):
+            cfg.add_edge(header, self.exc_target(), EXC)
+        after = cfg._new("stmt", None)
+        self.loop_stack.append((header, after, len(self.finally_stack)))
+        body_in = cfg._new("stmt", None)
+        cfg.add_edge(header, body_in, TRUE)
+        body_out = self.seq(stmt.body, body_in)
+        if body_out >= 0:
+            cfg.add_edge(body_out, header, BACK)
+        self.loop_stack.pop()
+        if stmt.orelse:
+            else_in = cfg._new("stmt", None)
+            cfg.add_edge(header, else_in, FALSE)
+            else_out = self.seq(stmt.orelse, else_in)
+            if else_out >= 0:
+                cfg.add_edge(else_out, after)
+        else:
+            cfg.add_edge(header, after, FALSE)
+        return after
+
+    def _with(self, stmt, cur: int) -> int:
+        cfg = self.cfg
+        # context-expr evaluation only (the body gets its own nodes; a
+        # node carrying the whole With would replay the body's effects)
+        ctx = ast.Expr(
+            value=ast.Tuple(
+                elts=[item.context_expr for item in stmt.items],
+                ctx=ast.Load()),
+            lineno=stmt.lineno, col_offset=stmt.col_offset)
+        n = cfg._new("stmt", ctx)
+        cfg.add_edge(cur, n)
+        if any(_may_raise(item.context_expr) for item in stmt.items):
+            cfg.add_edge(n, self.exc_target(), EXC)
+        out = self.seq(stmt.body, n)
+        return out
+
+    def _try(self, stmt: ast.Try, cur: int) -> int:
+        cfg = self.cfg
+        after = cfg._new("stmt", None)
+        outer_exc = self.exc_target()
+        has_finally = bool(stmt.finalbody)
+
+        frame: Optional[_Frame] = None
+        fin_entry = -1
+        if has_finally:
+            fin_entry = cfg._new("stmt", None)
+            frame = _Frame(fin_entry)
+        #: where handler bodies / else / unmatched exceptions continue:
+        #: through the finally when there is one, else directly
+        resume_exc = fin_entry if has_finally else outer_exc
+        resume_after = fin_entry if has_finally else after
+
+        handler_entries = [cfg._new("stmt", None) for _h in stmt.handlers]
+        if stmt.handlers:
+            body_exc = cfg._new("stmt", None)   # dispatch point
+            for he in handler_entries:
+                cfg.add_edge(body_exc, he)
+            if not _has_catch_all(stmt.handlers):
+                # may match no handler: propagate outward
+                cfg.add_edge(body_exc, resume_exc, EXC)
+                if frame is not None:
+                    frame.continuations.add(("exc", outer_exc, -1))
+        else:
+            body_exc = fin_entry                # try/finally only
+            if frame is not None:
+                frame.continuations.add(("exc", outer_exc, -1))
+
+        if frame is not None:
+            self.finally_stack.append(frame)
+
+        # BODY: exceptions go to the dispatch (or straight to finally)
+        self.exc_stack.append(body_exc)
+        body_in = cfg._new("stmt", None)
+        cfg.add_edge(cur, body_in)
+        body_out = self.seq(stmt.body, body_in)
+        self.exc_stack.pop()
+
+        # else runs after a clean body, under the resume target
+        self.exc_stack.append(resume_exc)
+        if frame is not None and resume_exc == fin_entry:
+            frame.continuations.add(("exc", outer_exc, -1))
+        if stmt.orelse and body_out >= 0:
+            body_out = self.seq(stmt.orelse, body_out)
+        if body_out >= 0:
+            cfg.add_edge(body_out, resume_after)
+
+        # HANDLER bodies: a raise inside one goes through the finally
+        # (when present) and onward to the outer target
+        for he, handler in zip(handler_entries, stmt.handlers):
+            h_out = self.seq(handler.body, he)
+            if h_out >= 0:
+                cfg.add_edge(h_out, resume_after)
+        self.exc_stack.pop()
+
+        if frame is not None:
+            self.finally_stack.pop()
+            frame.continuations.add(("after", after, -1))
+            fin_out = self.seq(stmt.finalbody, fin_entry)
+            if fin_out >= 0:
+                self._wire_frame(frame, fin_out)
+        return after
+
+
+def build_function_cfg(func: ast.AST, qualname: str = "") -> FunctionCFG:
+    """CFG for one FunctionDef/AsyncFunctionDef/Lambda."""
+    cfg = FunctionCFG(qualname or getattr(func, "name", "<lambda>"), func)
+    b = _Builder(cfg)
+    if isinstance(func, ast.Lambda):
+        n = cfg._new("stmt", ast.Return(value=func.body,
+                                        lineno=func.lineno,
+                                        col_offset=func.col_offset))
+        cfg.add_edge(cfg.entry, n)
+        if _may_raise(func.body):
+            cfg.add_edge(n, cfg.raise_exit, EXC)
+        cfg.add_edge(n, cfg.exit)
+        return cfg
+    out = b.seq(func.body, cfg.entry)
+    if out >= 0:
+        cfg.add_edge(out, cfg.exit)
+    return cfg
+
+
+# -- module-level summaries ---------------------------------------------------
+
+@dataclass
+class FunctionInfo:
+    qualname: str
+    node: ast.AST                       # FunctionDef/AsyncFunctionDef/Lambda
+    cfg: FunctionCFG
+    #: bare names this function references (loads + dotted roots)
+    refs: Set[str] = field(default_factory=set)
+    #: attribute/method names it calls (``self._run`` -> "_run")
+    called_attrs: Set[str] = field(default_factory=set)
+    #: whether it calls a bare name bound as a PARAMETER of itself or an
+    #: enclosing function (an opaque callback: reachability unknown)
+    calls_param: bool = False
+    #: parameter names (own + enclosing scopes')
+    params: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ModuleInfo:
+    """Same-module call/return summaries shared by the flow rules."""
+    tree: ast.AST
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    #: bare def name -> qualnames defining it
+    defs_by_name: Dict[str, List[str]] = field(default_factory=dict)
+    #: local name -> source module for ``from X import name`` /
+    #: ``import X[.Y] [as name]``
+    imports: Dict[str, str] = field(default_factory=dict)
+
+    def resolve(self, bare: str) -> List[FunctionInfo]:
+        return [self.functions[q]
+                for q in self.defs_by_name.get(bare, ())]
+
+
+def cached_module_info(src) -> ModuleInfo:
+    """ModuleInfo for a core.SourceFile, built once and memoized on it —
+    the three flow rules share one CFG construction pass per module."""
+    info = getattr(src, "_module_info", None)
+    if info is None or info.tree is not src.tree:
+        info = build_module_info(src.tree)
+        src._module_info = info
+    return info
+
+
+def build_module_info(tree: ast.AST) -> ModuleInfo:
+    info = ModuleInfo(tree)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                info.imports[alias.asname or
+                             alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for alias in node.names:
+                info.imports[alias.asname or alias.name] = mod
+
+    def visit_scope(node, qual_parts: List[str], outer_params: Set[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _add_function(info, child, qual_parts + [child.name],
+                              outer_params)
+            elif isinstance(child, ast.ClassDef):
+                visit_scope(child, qual_parts + [child.name], outer_params)
+            elif not isinstance(child, ast.Lambda):
+                visit_scope(child, qual_parts, outer_params)
+
+    visit_scope(tree, [], set())
+    return info
+
+
+def _param_names(func) -> Set[str]:
+    a = func.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _add_function(info: ModuleInfo, func, qual_parts: List[str],
+                  outer_params: Set[str]) -> None:
+    qualname = ".".join(qual_parts)
+    params = outer_params | _param_names(func)
+    fi = FunctionInfo(qualname=qualname, node=func,
+                      cfg=build_function_cfg(func, qualname),
+                      params=params)
+    for sub in _walk_shallow_body(func):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+            fi.refs.add(sub.id)
+        elif isinstance(sub, ast.Call):
+            fn = sub.func
+            if isinstance(fn, ast.Attribute):
+                fi.called_attrs.add(fn.attr)
+            elif isinstance(fn, ast.Name):
+                fi.refs.add(fn.id)
+                if fn.id in params:
+                    fi.calls_param = True
+    info.functions[qualname] = fi
+    bare = qual_parts[-1]
+    info.defs_by_name.setdefault(bare, []).append(qualname)
+    # DIRECTLY nested defs/lambdas get their own entries (params
+    # inherited); deeper nesting recurses through them
+    idx = 0
+    for sub in _direct_nested_functions(func):
+        if isinstance(sub, ast.Lambda):
+            idx += 1
+            _add_function(info, sub,
+                          qual_parts + [f"<lambda#{idx}>"], params)
+        else:
+            _add_function(info, sub, qual_parts + [sub.name], params)
+
+
+def _direct_nested_functions(func):
+    """Function/lambda nodes nested immediately inside ``func`` (not
+    inside a deeper function)."""
+    body = func.body if isinstance(func.body, list) else [func.body]
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            yield n
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _walk_shallow_body(func):
+    for stmt in (func.body if isinstance(func.body, list)
+                 else [func.body]):
+        yield from _walk_shallow(stmt)

@@ -184,22 +184,60 @@ def save_baseline(entries: Dict[str, dict],
 
 # -- runner ------------------------------------------------------------------
 
-def run_all(repo_root: str = REPO,
-            rules: Optional[Iterable[str]] = None,
-            with_drift: bool = True) -> List[Violation]:
-    """Run every enabled checker; returns raw violations (inline
-    suppressions already applied, baseline NOT yet applied)."""
-    from tools.tpulint import (drift, host_sync, locks, retry_discipline,
-                               swallow, waits)
+#: documented rule registry (order = report order).  Pattern rules are
+#: the original single-pass AST matchers; flow rules run on the
+#: CFG/dataflow engine (tools/tpulint/cfg.py + dataflow.py).  The drift
+#: rule is special (imports the live package).  docs/linting.md must
+#: carry a section per rule (the drift checker enforces it).
+ALL_RULES = (
+    "retry-discipline", "host-sync", "lock-order", "swallow",
+    "unbounded-wait", "pin-balance", "ambient-propagation",
+    "counter-discipline", "drift",
+)
+
+
+def _ast_checkers() -> List[Tuple[str, Callable[[List[SourceFile]],
+                                                List[Violation]]]]:
+    from tools.tpulint import (ambient_spawn, counter_discipline,
+                               host_sync, locks, pin_balance,
+                               retry_discipline, swallow, waits)
+    return [
+        ("retry-discipline", retry_discipline.check),
+        ("host-sync", host_sync.check),
+        ("lock-order", locks.check),
+        ("swallow", swallow.check),
+        ("unbounded-wait", waits.check),
+        ("pin-balance", pin_balance.check),
+        ("ambient-propagation", ambient_spawn.check),
+        ("counter-discipline", counter_discipline.check),
+    ]
+
+
+def run_all_timed(repo_root: str = REPO,
+                  rules: Optional[Iterable[str]] = None,
+                  with_drift: bool = True,
+                  files: Optional[Iterable[str]] = None
+                  ) -> Tuple[List[Violation], Dict[str, float]]:
+    """Run every enabled checker; returns (raw violations, per-rule wall
+    seconds).  Inline suppressions already applied, baseline NOT yet
+    applied.  ``files`` restricts the AST rules to a repo-relative
+    subset (the --changed mode); drift always checks the whole tree
+    (its registries are global)."""
+    import time as _time
+
+    from tools.tpulint import drift
 
     enabled = set(rules) if rules else None
 
     def on(rule: str) -> bool:
         return enabled is None or rule in enabled
 
+    t0 = _time.monotonic()
     sources: List[SourceFile] = []
     violations: List[Violation] = []
-    for rel in iter_py_files(repo_root):
+    rel_files = (list(files) if files is not None
+                 else list(iter_py_files(repo_root)))
+    for rel in rel_files:
         src = load_source(repo_root, rel)
         if src is None:
             continue
@@ -207,20 +245,18 @@ def run_all(repo_root: str = REPO,
         for line, problem in src.suppression_problems:
             violations.append(Violation("bad-suppression", src.path,
                                         line, "<module>", problem))
+    timings: Dict[str, float] = {"<parse>": _time.monotonic() - t0}
 
-    checkers: List[Tuple[str, Callable[[List[SourceFile]],
-                                       List[Violation]]]] = [
-        ("retry-discipline", retry_discipline.check),
-        ("host-sync", host_sync.check),
-        ("lock-order", locks.check),
-        ("swallow", swallow.check),
-        ("unbounded-wait", waits.check),
-    ]
-    for rule, fn in checkers:
-        if on(rule):
-            violations.extend(fn(sources))
+    for rule, fn in _ast_checkers():
+        if not on(rule):
+            continue
+        t0 = _time.monotonic()
+        violations.extend(fn(sources))
+        timings[rule] = _time.monotonic() - t0
     if with_drift and on("drift"):
+        t0 = _time.monotonic()
         violations.extend(drift.check(repo_root))
+        timings["drift"] = _time.monotonic() - t0
 
     by_path = {s.path: s for s in sources}
     out = []
@@ -229,7 +265,17 @@ def run_all(repo_root: str = REPO,
         if src is not None and src.allowed(v.rule, v.line):
             continue
         out.append(v)
-    return out
+    return out, timings
+
+
+def run_all(repo_root: str = REPO,
+            rules: Optional[Iterable[str]] = None,
+            with_drift: bool = True,
+            files: Optional[Iterable[str]] = None) -> List[Violation]:
+    """run_all_timed without the timing report (the historical API)."""
+    violations, _ = run_all_timed(repo_root, rules=rules,
+                                  with_drift=with_drift, files=files)
+    return violations
 
 
 def apply_baseline(violations: List[Violation],

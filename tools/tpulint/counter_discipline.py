@@ -1,0 +1,193 @@
+"""counter-discipline checker (flow-sensitive).
+
+A stats/metrics increment inside a RETRY-ATTEMPT body runs once per
+ATTEMPT, not once per logical event: an enclosing retry that spills and
+re-runs double-counts it (PR 11: ``range_view_materializes`` counted
+inside a body retried by ``with_retry_no_split``).  The rule flags
+
+  * ``SHUFFLE_COUNTERS.add(...)`` / ``*COUNTERS.add/set_max`` /
+    ``*stats.add`` calls, and
+  * ``task_metrics.get().<field> += ...`` augmented assigns,
+
+when they sit lexically inside a retry body -- a lambda or a same-module
+def passed (by value or by name) to ``with_retry`` /
+``with_retry_no_split`` / ``with_capacity_retry`` /
+``retry_over_spillable`` / ``retry_over_stream_pieces`` -- UNLESS the
+increment is provably ATTEMPT-IDEMPOTENT: no statement that can still
+raise (and thus fail the attempt and re-run it) is reachable from the
+increment on a forward path to the body's exit, so the increment
+executes exactly once, on the attempt that succeeds.  Proven on the
+body's CFG (cfg.py) by forward reachability over non-back edges.
+
+Everything else wants the increment MOVED OUTSIDE the retry (count the
+event, not the attempts), a per-attempt counter named for what it is
+(``retry_count`` style -- memory/retry.py, the retry machinery itself,
+is exempt), or a reasoned inline suppression.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.tpulint.cfg import BACK, ModuleInfo, cached_module_info
+from tools.tpulint.core import SourceFile, Violation, dotted
+
+RULE = "counter-discipline"
+
+RETRY_WRAPPERS = {
+    "with_retry", "with_retry_no_split", "with_capacity_retry",
+    "retry_over_spillable", "retry_over_stream_pieces",
+}
+
+#: the retry machinery counts attempts deliberately
+EXEMPT_FILES = {"spark_rapids_tpu/memory/retry.py"}
+
+
+def _is_counter_call(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in ("add", "set_max"):
+        return False
+    recv = dotted(call.func.value)
+    low = recv.lower()
+    return "counters" in low or low.endswith("stats") or \
+        low.endswith(".stats") or low == "stats"
+
+
+def _is_metrics_augassign(stmt: ast.AST) -> bool:
+    if not isinstance(stmt, ast.AugAssign):
+        return False
+    target = stmt.target
+    while isinstance(target, ast.Attribute):
+        target = target.value
+    if isinstance(target, ast.Call):
+        return dotted(target.func).endswith("metrics.get")
+    return False
+
+
+def _counter_nodes(stmt: ast.AST) -> List[ast.AST]:
+    """Counter increments inside one statement (not descending into
+    nested function bodies)."""
+    out: List[ast.AST] = []
+    stack = [stmt]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call) and _is_counter_call(n):
+            out.append(n)
+        if isinstance(n, ast.AugAssign) and _is_metrics_augassign(n):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _retry_body_quals(info: ModuleInfo) -> Set[str]:
+    """Qualnames of functions/lambdas used as retry-attempt bodies:
+    lambdas/defs lexically inside a retry wrapper's arguments, plus
+    same-module defs passed to a wrapper BY NAME."""
+    quals: Set[str] = set()
+    arg_funcs: List[ast.AST] = []
+    named: Set[str] = set()
+    for sub in ast.walk(info.tree):
+        if not isinstance(sub, ast.Call):
+            continue
+        if dotted(sub.func).rsplit(".", 1)[-1] not in RETRY_WRAPPERS:
+            continue
+        for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+            for a in ast.walk(arg):
+                if isinstance(a, (ast.Lambda, ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    arg_funcs.append(a)
+                elif isinstance(a, ast.Name) and \
+                        isinstance(a.ctx, ast.Load):
+                    named.add(a.id)
+    for q, fi in info.functions.items():
+        if fi.node in arg_funcs:
+            quals.add(q)
+        elif q.rsplit(".", 1)[-1] in named:
+            quals.add(q)
+    return quals
+
+
+def _may_still_raise(stmt: ast.AST, increment: ast.AST) -> bool:
+    """Does this statement contain anything that can raise, beyond the
+    increment itself?"""
+    stack = [stmt]
+    while stack:
+        n = stack.pop()
+        if n is increment or isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, (ast.Call, ast.Raise, ast.Assert,
+                          ast.Yield, ast.YieldFrom)):
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def check(sources: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for src in sources:
+        if not src.path.startswith("spark_rapids_tpu/") or \
+                src.path in EXEMPT_FILES:
+            continue
+        info = cached_module_info(src)
+        for qual in sorted(_retry_body_quals(info)):
+            fi = info.functions.get(qual)
+            if fi is None:
+                continue
+            out.extend(_check_body(src, info, qual, fi))
+    return out
+
+
+def _check_body(src: SourceFile, info: ModuleInfo, qual: str,
+                fi) -> List[Violation]:
+    cfg = fi.cfg
+    out: List[Violation] = []
+    # nodes that can raise AFTER an increment fail the attempt and rerun
+    # it; find each increment's node, then forward-reach over non-back
+    # edges for any other may-raise node
+    may_raise_nodes: Set[int] = set()
+    incr_sites = []       # (node_idx, increment ast, line)
+    for node in cfg.stmt_nodes():
+        incs = _counter_nodes(node.stmt)
+        for inc in incs:
+            incr_sites.append((node.idx, inc,
+                               getattr(inc, "lineno", node.line)))
+        if _stmt_may_raise_beyond(node.stmt, incs):
+            may_raise_nodes.add(node.idx)
+    for idx, inc, line in incr_sites:
+        reachable = cfg.reachable_from(idx, skip_kinds=(BACK,))
+        later_raisers = reachable & may_raise_nodes
+        # the increment's own statement can also re-raise after the
+        # count (e.g. the counted call follows in the same expression)
+        own = _may_still_raise(cfg.nodes[idx].stmt, inc)
+        if not later_raisers and not own:
+            continue       # attempt-idempotent: nothing can fail after
+        what = ("counter add" if isinstance(inc, ast.Call)
+                else "metrics increment")
+        out.append(Violation(
+            RULE, src.path, line, qual,
+            f"{what} inside a retry-attempt body runs once per ATTEMPT "
+            f"and work that can still fail follows it — an OOM retry "
+            f"double-counts; move the increment outside the retry or "
+            f"after the last fallible call, or suppress with a reason "
+            f"if it deliberately counts attempts"))
+    return out
+
+
+def _stmt_may_raise_beyond(stmt: ast.AST,
+                           own_incs: List[ast.AST]) -> bool:
+    stack = [stmt]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)) or n in own_incs:
+            continue
+        if isinstance(n, (ast.Call, ast.Raise, ast.Assert,
+                          ast.Yield, ast.YieldFrom)):
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False

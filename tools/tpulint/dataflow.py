@@ -1,0 +1,110 @@
+"""Worklist dataflow solvers over tools/tpulint/cfg.py graphs.
+
+Small, rule-oriented framework: states are whatever the client wants
+(dicts of tri-states in practice), joined by a client ``join`` and
+transformed by a client ``transfer`` that returns SEPARATE out-states for
+the normal and exceptional edges (an acquire that raises did NOT acquire
+-- the distinction the pin-balance rule is built on).  Branch edges can
+carry a guard ``(var, sense)``; the optional ``refine`` hook applies the
+path-condition-lite refinement while traversing such an edge.
+
+The tri-state lattice (NO < MAYBE > YES; join of NO and YES is MAYBE) is
+what every current rule uses, so it ships here.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from tools.tpulint.cfg import EXC, FunctionCFG
+
+# tri-state lattice values
+NO, YES, MAYBE = "no", "yes", "maybe"
+
+
+def tri_join(a: Optional[str], b: Optional[str]) -> str:
+    if a is None:
+        return b  # type: ignore[return-value]
+    if b is None or a == b:
+        return a
+    return MAYBE
+
+
+def join_maps(a: Optional[Dict[str, str]],
+              b: Dict[str, str]) -> Dict[str, str]:
+    """Pointwise tri-state join of token->state maps; a missing key
+    means NO (nothing acquired)."""
+    if a is None:
+        return dict(b)
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = tri_join(out.get(k, NO), v)
+    for k in a:
+        if k not in b:
+            out[k] = tri_join(a[k], NO)
+    return out
+
+
+def solve_forward(
+    cfg: FunctionCFG,
+    init_state,
+    transfer: Callable,        # (node, in_state) -> (normal_out, exc_out)
+    join: Callable = join_maps,
+    refine: Optional[Callable] = None,   # (guard, sense_kind, state) -> state
+    max_iters: int = 20000,
+) -> Dict[int, object]:
+    """Returns the IN state of every reached node (entry gets
+    ``init_state``).  ``transfer`` runs once per visit; out-states flow
+    along edges (exceptional edges take the exc out-state), guards
+    refine branch edges."""
+    in_states: Dict[int, object] = {cfg.entry: init_state}
+    work: List[int] = [cfg.entry]
+    iters = 0
+    while work:
+        iters += 1
+        if iters > max_iters:
+            break               # pathological function: stop refining
+        n = work.pop()
+        node = cfg.nodes[n]
+        normal_out, exc_out = transfer(node, in_states[n])
+        for e in cfg.successors(n):
+            s = exc_out if e.kind == EXC else normal_out
+            if s is None:
+                continue
+            if e.guard is not None and refine is not None:
+                s = refine(e.guard, s)
+            merged = join(in_states.get(e.dst), s)
+            if merged != in_states.get(e.dst):
+                in_states[e.dst] = merged
+                work.append(e.dst)
+    return in_states
+
+
+def solve_backward(
+    cfg: FunctionCFG,
+    exit_state,
+    transfer: Callable,        # (node, out_state) -> in_state
+    join: Callable = join_maps,
+    max_iters: int = 20000,
+) -> Dict[int, object]:
+    """Backward analogue: states flow from exits toward the entry.
+    Both the normal exit and the raise exit seed ``exit_state``."""
+    preds = cfg.preds()
+    out_states: Dict[int, object] = {cfg.exit: exit_state,
+                                     cfg.raise_exit: exit_state}
+    work: List[int] = [cfg.exit, cfg.raise_exit]
+    iters = 0
+    while work:
+        iters += 1
+        if iters > max_iters:
+            break
+        n = work.pop()
+        node = cfg.nodes[n]
+        in_state = transfer(node, out_states[n])
+        for p in preds[n]:
+            merged = join(out_states.get(p), in_state)
+            if merged != out_states.get(p):
+                out_states[p] = merged
+                work.append(p)
+    return out_states
+
+

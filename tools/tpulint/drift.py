@@ -42,6 +42,28 @@ def check(repo_root: str) -> List[Violation]:
     out.extend(_check_generated_docs(repo_root))
     out.extend(_check_typesig_rows())
     out.extend(_check_api_surface(repo_root))
+    out.extend(_check_lint_doc(repo_root))
+    return out
+
+
+def _check_lint_doc(repo_root: str) -> List[Violation]:
+    """docs/linting.md must carry a section per registered rule — a new
+    rule without documentation (or a renamed rule leaving its section
+    behind) is doc drift like any other."""
+    from tools.tpulint.core import ALL_RULES
+    path = os.path.join(repo_root, "docs", "linting.md")
+    if not os.path.exists(path):
+        return [Violation(RULE, "docs/linting.md", 1, "<generated>",
+                          "docs/linting.md missing")]
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    out: List[Violation] = []
+    for rule in ALL_RULES:
+        if f"### `{rule}`" not in text:
+            out.append(Violation(
+                RULE, "docs/linting.md", 1, "<rules>",
+                f"registered rule {rule!r} has no \"### `{rule}`\" "
+                f"section in docs/linting.md"))
     return out
 
 

@@ -1,0 +1,98 @@
+"""Machine-readable tpu-lint output: SARIF 2.1.0 and GitHub annotations.
+
+``--format sarif`` emits a static-analysis-results-interchange-format
+log CI dashboards ingest directly (one run, one result per violation,
+stable partial fingerprints so re-runs dedupe); ``--format github``
+emits ``::error`` workflow commands that surface as inline PR
+annotations.  Both render the POST-BASELINE violation set -- what the
+text mode would fail the build on.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from tools.tpulint.core import ALL_RULES, Violation
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+_RULE_DESCRIPTIONS: Dict[str, str] = {
+    "retry-discipline": "device-memory materializers must run under the "
+                        "memory/retry.py wrappers",
+    "host-sync": "no hidden device->host syncs on dispatch hot paths",
+    "lock-order": "consistent lock order; no blocking calls under locks",
+    "swallow": "no silent broad exception swallows",
+    "unbounded-wait": "every block must be a bounded, cancellable wait",
+    "pin-balance": "every pin acquire reaches a release on all paths, "
+                   "including exception edges",
+    "ambient-propagation": "engine-reaching thread spawns must inherit "
+                           "the task ambients (utils/ambient.py)",
+    "counter-discipline": "no per-attempt counter increments inside "
+                          "retry bodies",
+    "drift": "generated docs/registries/API surface must match the code",
+    "bad-suppression": "inline suppressions need a reason",
+}
+
+
+def to_sarif(violations: List[Violation]) -> dict:
+    rules_present = sorted({v.rule for v in violations} | set(ALL_RULES))
+    rule_index = {r: i for i, r in enumerate(rules_present)}
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpu-lint",
+                "informationUri": "docs/linting.md",
+                "rules": [{
+                    "id": r,
+                    "shortDescription": {
+                        "text": _RULE_DESCRIPTIONS.get(r, r)},
+                } for r in rules_present],
+            }},
+            "results": [{
+                "ruleId": v.rule,
+                "ruleIndex": rule_index[v.rule],
+                "level": "error",
+                "message": {"text": f"{v.scope}: {v.message}"},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": v.file},
+                        "region": {"startLine": max(v.line, 1)},
+                    },
+                }],
+                "partialFingerprints": {
+                    "tpulint/v1": v.fingerprint,
+                },
+            } for v in violations],
+        }],
+    }
+
+
+def render_sarif(violations: List[Violation]) -> str:
+    return json.dumps(to_sarif(violations), indent=1) + "\n"
+
+
+def render_github(violations: List[Violation]) -> str:
+    """GitHub Actions workflow commands (::error annotations)."""
+    lines = []
+    for v in violations:
+        # newlines/percents would break the command protocol
+        msg = (f"{v.scope}: {v.message}"
+               .replace("%", "%25").replace("\r", "")
+               .replace("\n", "%0A"))
+        lines.append(f"::error file={v.file},line={max(v.line, 1)},"
+                     f"title=tpu-lint {v.rule}::{msg}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_timings(timings: Dict[str, float]) -> str:
+    """Per-rule wall-clock table (the --timing report)."""
+    width = max((len(k) for k in timings), default=4)
+    total = sum(timings.values())
+    rows = [f"  {k:<{width}s}  {timings[k] * 1000.0:8.1f} ms"
+            for k in sorted(timings, key=timings.get, reverse=True)]
+    rows.append(f"  {'TOTAL':<{width}s}  {total * 1000.0:8.1f} ms")
+    return "per-rule wall clock:\n" + "\n".join(rows)

@@ -1,0 +1,382 @@
+"""pin-balance checker (flow-sensitive).
+
+Contract (memory/spill.py + shuffle/transport.py): every pin-acquiring
+call -- ``materialize()``, ``materialize_pinned()``,
+``materialize_batch_pinned()``, ``_reserve_device()`` -- must reach a
+matching release (``unpin()`` / ``_release_device()`` / ``close()``) on
+ALL paths out of the acquiring function, INCLUDING exception paths, and
+no release may execute on a path where its matching acquire never ran
+(an unmatched unpin steals a concurrent consumer's pin, letting spill
+free data mid-use -- the PR 11 CacheOnlyTransport defect class).
+
+Analysis: forward tri-state dataflow over the function CFG (cfg.py /
+dataflow.py), one token per acquire RECEIVER text (``h``, ``piece``,
+``self``).  The exceptional edge out of an acquire statement keeps the
+token un-acquired (a raise inside the acquire took no pin), which is
+exactly what distinguishes
+
+    try:                               mat = piece.materialize_pinned()
+        mat = piece.materialize_pinned()   vs.   try:
+        ...                                         ...
+    finally:                                    finally:
+        piece.unpin()    # FLAGGED                  piece.unpin()  # ok
+
+Recognized balanced idioms (no violation):
+
+  * the PINNED LEDGER: ``pinned.append(h)`` beside the acquire with a
+    ``for h in pinned: h.unpin()`` unwind -- the idiom of the blessed
+    wrappers ``coalesce.retry_over_spillable`` /
+    ``retry_over_stream_pieces`` (which therefore analyze clean on their
+    own bodies; callers see them as balanced summaries since a call
+    carries no acquire);
+  * GUARDED release: ``if mat is not None: h.unpin()`` where ``mat``
+    was assigned from the acquire -- the branch guard refines the token
+    state (path-condition-lite);
+  * PIN TRANSFER: a function whose name is itself an acquire method
+    (``materialize_pinned`` etc.) returns pinned data by contract --
+    its normal exit may hold the pin, but its exception paths must
+    still release (the PR 11 failed-fallback-gather defect);
+  * ESCAPE: an acquire result that is returned/yielded/stored escapes
+    the function -- the pin transfers with it on the NORMAL path; the
+    exception paths are still checked.
+
+Scope: the device/shuffle hot paths.  memory/spill.py (the pin
+implementation itself) is exempt, as are functions named like the
+acquire/release methods (they ARE the transfer/release APIs).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tpulint.cfg import FunctionCFG, ModuleInfo, cached_module_info
+from tools.tpulint.core import SourceFile, Violation, dotted
+from tools.tpulint.dataflow import (MAYBE, NO, YES, join_maps,
+                                    solve_forward, tri_join)
+
+RULE = "pin-balance"
+
+ACQUIRE_METHODS = {"materialize", "materialize_pinned",
+                   "materialize_batch_pinned", "_reserve_device"}
+RELEASE_METHODS = {"unpin", "_release_device"}
+CLOSE_METHODS = {"close"}
+
+SCOPE_PREFIXES = (
+    "spark_rapids_tpu/plan/",
+    "spark_rapids_tpu/shuffle/",
+    "spark_rapids_tpu/memory/",
+    "spark_rapids_tpu/kernels/",
+    "spark_rapids_tpu/io/",
+)
+#: the pin implementation itself (its _pins bookkeeping is the
+#: mechanism the rule checks everyone else against)
+EXEMPT_FILES = {"spark_rapids_tpu/memory/spill.py"}
+
+
+def in_scope(path: str) -> bool:
+    return path.startswith(SCOPE_PREFIXES) and path not in EXEMPT_FILES
+
+
+def _recv_of(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(receiver text, method) for an attribute call; None otherwise."""
+    if isinstance(call.func, ast.Attribute):
+        recv = dotted(call.func.value)
+        if recv:
+            return recv, call.func.attr
+    return None
+
+
+def _acquires_in(stmt: ast.AST) -> List[Tuple[str, str, int]]:
+    out = []
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Call):
+            rm = _recv_of(sub)
+            if rm and rm[1] in ACQUIRE_METHODS:
+                out.append((rm[0], rm[1], sub.lineno))
+    return out
+
+
+def _releases_in(stmt: ast.AST) -> List[Tuple[str, str, int]]:
+    out = []
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Call):
+            rm = _recv_of(sub)
+            if rm and rm[1] in (RELEASE_METHODS | CLOSE_METHODS):
+                out.append((rm[0], rm[1], sub.lineno))
+    return out
+
+
+def _ledger_lists(func: ast.AST, tokens: Set[str]) -> Dict[str, Set[str]]:
+    """Pin ledgers: ledger list name -> the acquire receivers appended
+    to it.  A list qualifies when some ``L.append(r)`` appends an
+    acquire receiver AND some ``for v in L:`` loop releases."""
+    appended: Dict[str, Set[str]] = {}
+    released_over: Set[str] = set()
+    for sub in ast.walk(func):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == "append" and \
+                isinstance(sub.func.value, ast.Name) and \
+                len(sub.args) == 1 and isinstance(sub.args[0], ast.Name) \
+                and sub.args[0].id in tokens:
+            appended.setdefault(sub.func.value.id,
+                                set()).add(sub.args[0].id)
+        if isinstance(sub, (ast.For, ast.AsyncFor)) and \
+                isinstance(sub.iter, ast.Name) and \
+                isinstance(sub.target, ast.Name):
+            var = sub.target.id
+            for s2 in ast.walk(sub):
+                if isinstance(s2, ast.Call):
+                    rm = _recv_of(s2)
+                    if rm and rm[0] == var and rm[1] in RELEASE_METHODS:
+                        released_over.add(sub.iter.id)
+    return {name: recvs for name, recvs in appended.items()
+            if name in released_over}
+
+
+def _ledger_loop_vars(func: ast.AST, ledgers: Set[str]) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(func):
+        if isinstance(sub, (ast.For, ast.AsyncFor)) and \
+                isinstance(sub.iter, ast.Name) and \
+                sub.iter.id in ledgers and \
+                isinstance(sub.target, ast.Name):
+            out.add(sub.target.id)
+    return out
+
+
+def _result_bindings(func: ast.AST) -> Dict[str, str]:
+    """var -> token for ``var = <receiver>.<acquire>()`` assignments
+    (the guard-refinement binding)."""
+    out: Dict[str, str] = {}
+    for sub in ast.walk(func):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                isinstance(sub.targets[0], ast.Name) and \
+                isinstance(sub.value, ast.Call):
+            rm = _recv_of(sub.value)
+            if rm and rm[1] in ACQUIRE_METHODS:
+                out[sub.targets[0].id] = rm[0]
+    return out
+
+
+def _escaping_tokens(func: ast.AST, bindings: Dict[str, str],
+                     tokens: Set[str]) -> Set[str]:
+    """Tokens whose acquire result escapes the function (returned,
+    yielded, stored to an attribute/subscript, or collected into a
+    container) -- pin ownership transfers with the value."""
+    esc: Set[str] = set()
+    bound_vars = set(bindings)
+
+    def names_and_acquires(expr) -> Set[str]:
+        found: Set[str] = set()
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in bound_vars:
+                found.add(bindings[sub.id])
+            if isinstance(sub, ast.Call):
+                rm = _recv_of(sub)
+                if rm and rm[1] in ACQUIRE_METHODS and rm[0] in tokens:
+                    found.add(rm[0])
+        return found
+
+    for sub in ast.walk(func):
+        if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)) and \
+                sub.value is not None:
+            esc |= names_and_acquires(sub.value)
+        elif isinstance(sub, ast.Assign):
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in sub.targets):
+                esc |= names_and_acquires(sub.value)
+        elif isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in ("append", "extend", "add", "put"):
+            for a in sub.args:
+                esc |= names_and_acquires(a)
+    return esc
+
+
+class _FnAnalysis:
+    def __init__(self, src: SourceFile, qualname: str, func: ast.AST,
+                 cfg: FunctionCFG):
+        self.src = src
+        self.qualname = qualname
+        self.func = func
+        self.cfg = cfg
+        acq = _acquires_in_body(func)
+        self.tokens: Set[str] = {r for r, _m, _l in acq}
+        self.acquire_lines: Dict[str, Tuple[str, int]] = {}
+        for r, m, line in acq:
+            self.acquire_lines.setdefault(r, (m, line))
+        self.bindings = _result_bindings(func)
+        self.ledgers = _ledger_lists(func, self.tokens)
+        self.ledger_vars = _ledger_loop_vars(func, self.ledgers)
+        self.escapes = _escaping_tokens(func, self.bindings, self.tokens)
+        self.violations: List[Violation] = []
+        self._flagged: Set[Tuple[str, str]] = set()
+
+    # -- dataflow hooks -------------------------------------------------------
+
+    def transfer(self, node, in_state):
+        if node.stmt is None:
+            return in_state, in_state
+        if node.kind == "test" and isinstance(node.stmt, ast.Name) and \
+                node.stmt.id in self.ledgers:
+            # entering a pinned-ledger unwind loop: the ledger holds
+            # EXACTLY the receivers acquired so far (zero iterations
+            # means zero acquires), so the loop as a whole balances —
+            # clear at the header so the zero-iteration edge balances
+            # too, a correlation the per-path states cannot carry.
+            # Only the receivers APPENDED to this ledger clear: an
+            # unrelated acquire's leak must not hide behind it.
+            ledger_tokens = self.ledgers[node.stmt.id]
+            state = {t: (NO if t in ledger_tokens else v)
+                     for t, v in in_state.items()}
+            return state, state
+        state = dict(in_state)
+        acqs = _acquires_in(node.stmt)
+        rels = _releases_in(node.stmt)
+        for r, method, line in rels:
+            if r in self.ledger_vars:
+                # pinned-ledger unwind: releases exactly what was
+                # acquired, however many; clears every token
+                for t in list(state):
+                    state[t] = NO
+                continue
+            if r not in self.tokens:
+                continue    # releases a pin acquired elsewhere: not ours
+            if method in RELEASE_METHODS and \
+                    in_state.get(r, NO) in (NO, MAYBE):
+                self._flag(
+                    ("release", r), node.line or line,
+                    f"{r}.{method}() may run on a path where its pin was "
+                    f"never acquired (e.g. when the acquire itself "
+                    f"raises) — an unmatched unpin steals a concurrent "
+                    f"consumer's pin; move the acquire before the try or "
+                    f"guard the release on the acquire's result")
+            state[r] = NO
+        # exceptional out-state: an acquire that ITSELF raises took no
+        # pin — but when the same statement also calls other fallible
+        # code (``return slice(h.materialize())``), the raise may come
+        # AFTER a successful acquire, so the token is MAYBE there (the
+        # one-expression spelling of the failed-fallback-gather leak)
+        exc_state = dict(state)
+        if acqs and _other_fallible_call(node.stmt):
+            for r, _method, _line in acqs:
+                exc_state[r] = tri_join(exc_state.get(r, NO), YES)
+        for r, _method, _line in acqs:
+            state[r] = YES
+        return state, exc_state
+
+    def refine(self, guard, state):
+        var, sense = guard
+        token = self.bindings.get(var)
+        if token is None or token not in state:
+            return state
+        if state[token] == MAYBE:
+            state = dict(state)
+            # bool(result-var) == sense correlates with the acquire
+            # having executed: True => acquired, False => never acquired
+            state[token] = YES if sense else NO
+        return state
+
+    def _flag(self, key: Tuple[str, str], line: int, msg: str) -> None:
+        if key in self._flagged:
+            return
+        self._flagged.add(key)
+        self.violations.append(Violation(
+            RULE, self.src.path, line, self.qualname, msg))
+
+    # -- exit checks ----------------------------------------------------------
+
+    def check_exits(self, in_states) -> None:
+        bare = self.qualname.rsplit(".", 1)[-1]
+        transfer_api = bare in ACQUIRE_METHODS
+        normal = in_states.get(self.cfg.exit)
+        raised = in_states.get(self.cfg.raise_exit)
+        for r in sorted(self.tokens):
+            method, line = self.acquire_lines[r]
+            if normal is not None and \
+                    normal.get(r, NO) in (YES, MAYBE) and \
+                    not transfer_api and r not in self.escapes:
+                self._flag(
+                    ("normal", r), line,
+                    f"pin acquired by {r}.{method}() does not reach a "
+                    f"release on every normal path — the handle stays "
+                    f"unspillable; add a try/finally unpin or a "
+                    f"pinned-ledger unwind")
+            if raised is not None and raised.get(r, NO) in (YES, MAYBE):
+                self._flag(
+                    ("raise", r), line,
+                    f"pin acquired by {r}.{method}() is not released on "
+                    f"an exception path — a raise mid-scope leaves the "
+                    f"backing unspillable until cleanup; add a "
+                    f"try/finally or except-unwind")
+
+
+def _other_fallible_call(stmt: ast.AST) -> bool:
+    """Does the statement contain a fallible call BESIDES its acquire
+    calls (and the pure builtins)?"""
+    from tools.tpulint.cfg import SAFE_BUILTIN_CALLS
+    stack = [stmt]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            rm = _recv_of(n)
+            if rm and rm[1] in ACQUIRE_METHODS:
+                # the acquire itself; its receiver expr may still
+                # contain other calls
+                stack.append(n.func.value)
+                stack.extend(n.args)
+                stack.extend(kw.value for kw in n.keywords)
+                continue
+            if isinstance(n.func, ast.Name) and \
+                    n.func.id in SAFE_BUILTIN_CALLS:
+                stack.extend(ast.iter_child_nodes(n))
+                continue
+            return True
+        if isinstance(n, (ast.Raise, ast.Assert, ast.Yield,
+                          ast.YieldFrom)):
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _acquires_in_body(func: ast.AST) -> List[Tuple[str, str, int]]:
+    """Acquire sites in THIS function's body only (nested defs/lambdas
+    are separate analysis units)."""
+    out: List[Tuple[str, str, int]] = []
+    body = func.body if isinstance(func.body, list) else [func.body]
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            rm = _recv_of(n)
+            if rm and rm[1] in ACQUIRE_METHODS:
+                out.append((rm[0], rm[1], n.lineno))
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def check(sources: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for src in sources:
+        if not in_scope(src.path):
+            continue
+        info: ModuleInfo = cached_module_info(src)
+        for qualname, fi in info.functions.items():
+            bare = qualname.rsplit(".", 1)[-1]
+            if bare in RELEASE_METHODS | CLOSE_METHODS:
+                continue       # the release APIs themselves
+            ana = _FnAnalysis(src, qualname, fi.node, fi.cfg)
+            if not ana.tokens:
+                continue
+            in_states = solve_forward(
+                fi.cfg, {}, ana.transfer, join_maps, ana.refine)
+            ana.check_exits(in_states)
+            out.extend(ana.violations)
+    return out

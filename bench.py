@@ -252,11 +252,31 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
 
     reset_launch_stats()
     reset_local_shuffle_counters()
+    # the timed run executes under a QueryTrace ambient (utils/obs.py):
+    # the artifact then carries the per-query ATTRIBUTED counter scope
+    # (exactly this query's deltas — meaningful even when other work
+    # shares the process) beside the global snapshot, plus a Perfetto
+    # trace export of the run's spans.  The tee is a dict update per
+    # counter add — well under measurement noise per query.
+    from spark_rapids_tpu.utils.obs import (
+        QueryTrace, export_trace_file, trace_scope)
+    trace = QueryTrace(f"bench_{qname}", enabled=True)
     t0 = time.perf_counter()
-    tpu_rows = run(tpu_sess)
+    with trace_scope(trace):
+        tpu_rows = run(tpu_sess)
     tpu_time = time.perf_counter() - t0
+    trace.finish()
     stats = launch_stats()          # exact program-dispatch counts
     shuffle = local_shuffle_counters()  # data-plane behavior per query
+    trace_counters = {k: v for k, v in trace.counters_snapshot().items()
+                      if v}
+    # the trace FILE is opt-in like the other bench_profile artifacts:
+    # a plain bench run must not litter the cwd — export only under
+    # --profile (PROGPROF rides to children) or an explicit dir
+    trace_dir = os.environ.get("SPARK_RAPIDS_TPU_BENCH_TRACE_DIR") or (
+        "bench_profile"
+        if os.environ.get("SPARK_RAPIDS_TPU_BENCH_PROGPROF") else None)
+    trace_export = export_trace_file(trace, trace_dir) if trace_dir else None
 
     prog_profile = None
     if os.environ.get("SPARK_RAPIDS_TPU_BENCH_PROGPROF"):
@@ -324,6 +344,8 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
             stats["launches"] / max(shuffle.get("exchange_stages", 0), 1),
             1),
         "shuffle": shuffle,
+        "trace_counters": trace_counters,
+        **({"trace_export": trace_export} if trace_export else {}),
         "input_bytes": input_bytes,
         **({"prog_profile": prog_profile} if prog_profile else {}),
         **({"util": util} if util else {}),
@@ -425,6 +447,8 @@ def _concurrent_bench() -> None:
             f.result(timeout=QUERY_TIMEOUT_S["cpu"])
     concurrent_s = time.perf_counter() - t0
     counters = local_shuffle_counters()
+    from spark_rapids_tpu.cluster.stats import local_histograms
+    hists = local_histograms()
     total_rows = n_rows * len(plans)
     out = {
         "metric": "serving_concurrent_rows_per_sec",
@@ -438,6 +462,11 @@ def _concurrent_bench() -> None:
         "mix": sorted({q for _p, q, _t in plans}),
         "per_tenant_latency_s": {t: _percentiles(v)
                                  for t, v in sorted(lat.items())},
+        # the product-side latency histogram (shuffle/stats.py), as a
+        # serving process would report it: submit->done p50/p90/p99 over
+        # the concurrent pass, plus the fetch-wait/stage-drain tails
+        "latency_histogram": hists["serving_submit_s"],
+        "fetch_wait_histogram": hists["fetch_wait_s"],
         "serving_counters": {k: v for k, v in counters.items()
                              if k.startswith(("queries_", "cache_",
                                               "tenant_", "budget_"))},

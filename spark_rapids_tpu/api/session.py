@@ -308,6 +308,60 @@ class TpuSession:
         from spark_rapids_tpu.io.delta_write import optimize
         return optimize(self, table_path, zorder_by=zorder_by)
 
+    def explain_analyze(self, plan) -> str:
+        """EXPLAIN ANALYZE: execute the plan (a DataFrame or logical
+        plan) under a query-scoped trace with every exec node's batch
+        seams instrumented, and render the physical plan tree annotated
+        with the MEASURED metrics — rows/batches/time per node (an exec's
+        own opTime where it keeps one, the analyzer's seam time where it
+        doesn't), plus a footer with the query-attributed launch counts
+        and counter deltas (spill/pin bytes, fetch stall, admission
+        wait...).  The distributed twin is ``driver.query_report(qid)``,
+        which renders the same tree from executor-merged telemetry.
+
+        The run is a REAL execution (the analyzer seam adds iterate
+        timing only, no device syncs); rows are discarded."""
+        import time as _time
+
+        from spark_rapids_tpu.plan.execs.base import launch_stats
+        from spark_rapids_tpu.utils.obs import (
+            QueryTrace, instrument_plan, metrics_tree,
+            render_metrics_tree, trace_scope)
+        df = plan if isinstance(plan, DataFrame) else DataFrame(plan, self)
+        trace = QueryTrace("explain_analyze", enabled=True,
+                           max_spans=self.conf.trace_max_spans,
+                           default_track="local")
+        with df._session_tz_scope():
+            exec_plan, _ = plan_query(df.plan, self.conf)
+            instrument_plan(exec_plan)
+            engine = TpuEngine(self.conf)
+            before = launch_stats()
+            t0 = _time.perf_counter()
+            with trace_scope(trace):
+                # execute, not collect: the rows are discarded, so the
+                # per-row CpuTable host conversion (which can dwarf the
+                # query itself on a wide result) is pure waste
+                engine.execute(exec_plan)
+            wall_s = _time.perf_counter() - t0
+            after = launch_stats()
+        trace.finish()
+        # the engine snapshots metrics at cleanup (last_metrics); the
+        # tree re-walk here picks up the SAME MetricSet objects, now
+        # holding both the execs' own metrics and the analyzer's seams
+        tree = (engine.last_metrics
+                if engine.last_metrics is not None
+                else metrics_tree(exec_plan))
+        footer = {
+            "wall_s": round(wall_s, 4),
+            "launches": after["launches"] - before["launches"],
+            # newly-compiled during THIS run (0 = fully warm cache);
+            # the cumulative process count would misattribute prior
+            # queries' programs to this report
+            "programs_compiled": after["programs"] - before["programs"],
+            "counters": trace.counters_snapshot(),
+        }
+        return render_metrics_tree(tree, footer=footer)
+
 
 class GroupedData:
     def __init__(self, df: "DataFrame", keys: Sequence[Expression],

@@ -124,6 +124,22 @@ class TpuClusterDriver:
         #: qid -> live CancelToken — the public cancel(query_id) handle;
         #: registered by _submit_once for exactly the attempt's lifetime
         self._cancel_tokens: Dict[int, CancelToken] = {}
+        #: qid -> [executor telemetry records] (task_result "telemetry"
+        #: headers: spans, counter deltas, per-exec metric snapshots,
+        #: tagged rank/attempt/eid) — merged under the originating
+        #: query's trace when the attempt resolves
+        self._telemetry: Dict[int, List[dict]] = {}
+        #: bounded qid -> merged observability report (query_report());
+        #: an OrderedDict so the oldest completed query ages out
+        import collections as _collections
+        self._reports: "_collections.OrderedDict[int, dict]" = \
+            _collections.OrderedDict()
+        self._reports_max = 16
+        #: trace knobs for driver-owned traces (a serving submission's
+        #: ambient trace takes precedence — one query, one trace)
+        self.trace_enabled = _rc.trace_enabled
+        self.trace_dir = _rc.trace_dir
+        self.trace_max_spans = _rc.trace_max_spans
         #: (query_id, key) -> {executor_id: [int, ...]} — the runtime
         #: statistics barrier adaptive decisions aggregate through
         self._stats: Dict[Tuple[int, str], Dict[str, List[int]]] = {}
@@ -184,6 +200,16 @@ class TpuClusterDriver:
                             rank, attempt = driver._resolve_attempt_locked(
                                 qid, eid, header.get("rank"),
                                 header.get("attempt"))
+                            tel = header.get("telemetry")
+                            if tel is not None and rank is not None:
+                                # executor-side spans/metrics/counters,
+                                # tagged so speculation copies and
+                                # re-dispatches stay distinguishable
+                                driver._telemetry.setdefault(
+                                    qid, []).append(
+                                        {"rank": int(rank),
+                                         "attempt": int(attempt),
+                                         "eid": eid, **tel})
                             if err is not None:
                                 # retryable marks failures worth a
                                 # re-dispatch (fetch/budget/injected
@@ -297,6 +323,65 @@ class TpuClusterDriver:
         with self._lock:
             return sorted(self._cancel_tokens)
 
+    def query_report(self, query_id: int) -> Optional[dict]:
+        """Merged observability report of a finished traced query: the
+        physical plan annotated with per-exec metrics summed across the
+        ranks' WINNING attempts, per-rank telemetry records (spans +
+        counter deltas, tagged rank/attempt/eid), and the query-scoped
+        counter attribution.  None for untraced/aged-out queries.
+        ``report["text"]`` is the EXPLAIN ANALYZE rendering."""
+        with self._lock:
+            rep = self._reports.get(query_id)
+            return dict(rep) if rep is not None else None
+
+    def _store_report_locked(self, qid: int, report: dict) -> None:
+        self._reports[qid] = report
+        while len(self._reports) > self._reports_max:
+            self._reports.popitem(last=False)
+
+    def _merge_telemetry(self, trace, qid: int, world: int,
+                         tel_records: List[dict], results: Dict[int, dict],
+                         t0: float) -> None:
+        """Fold the attempt's executor telemetry under the originating
+        query's trace (spans land on per-rank tracks tagged
+        rank/attempt/eid) and store the merged query_report().  Metric
+        trees sum across each rank's WINNING attempt only — a beaten
+        speculation copy's rows must not double the merged counts; its
+        spans still merge (tagged), so speculation stays visible on the
+        timeline."""
+        from spark_rapids_tpu.utils.obs import (
+            merge_metric_trees, render_metrics_tree)
+        winning = {r: res["attempt"] for r, res in results.items()}
+        trees = []
+        for rec in tel_records:
+            trace.merge_remote(rec, rec["rank"], rec["attempt"],
+                               rec["eid"])
+            if winning.get(rec["rank"]) == rec["attempt"] and \
+                    rec.get("metrics"):
+                trees.append([tuple(row) for row in rec["metrics"]])
+        trace.record_span("driver.query", t0, time.time(),
+                          track="driver", tags={"qid": qid},
+                          anchor=True)
+        merged = merge_metric_trees(trees)
+        report = {
+            "query_id": qid,
+            "trace_query_id": trace.query_id,
+            "world": world,
+            "ranks": sorted({rec["rank"] for rec in tel_records}),
+            "records": [{"rank": rec["rank"], "attempt": rec["attempt"],
+                         "eid": rec["eid"],
+                         "spans": len(rec.get("spans") or ()),
+                         "counters": rec.get("counters") or {}}
+                        for rec in tel_records],
+            "merged_metrics": merged,
+            "counters": trace.counters_snapshot(),
+        }
+        report["text"] = render_metrics_tree(
+            merged, footer={"query": qid,
+                            "counters": report["counters"]})
+        with self._lock:
+            self._store_report_locked(qid, report)
+
     def submit(self, logical_plan, timeout_s: float = 300.0,
                max_retries: int = 1, conf: Optional[Dict[str, str]] = None,
                deadline_s: Optional[float] = None,
@@ -350,28 +435,58 @@ class TpuClusterDriver:
         owns_token = cancel_token is None
         token = cancel_token if not owns_token else CancelToken(
             label="cluster query")
+        # one query, ONE trace: a serving submission's ambient trace
+        # (utils/obs.py) is reused so executor telemetry merges under
+        # the query the USER submitted; a direct driver.submit with
+        # spark.rapids.trace.enabled owns a trace of its own and
+        # exports/reports it when the submission resolves
+        from contextlib import nullcontext
+
+        from spark_rapids_tpu.utils.obs import (
+            current_query_trace, trace_scope)
+        from spark_rapids_tpu.utils.obs import QueryTrace
+        trace = current_query_trace()
+        owns_trace = trace is None and self.trace_enabled
+        if owns_trace:
+            trace = QueryTrace("cluster", enabled=True,
+                               max_spans=self.trace_max_spans,
+                               default_track="driver")
+            # explicit ownership flag, NOT a sentinel id: a serving
+            # submission whose caller picked query_id="cluster" must
+            # keep its id — only a driver-owned trace is renamed to the
+            # first attempt's qid in _submit_once
+            trace._driver_names_qid = True
         try:
-            while True:
-                try:
-                    return self._submit_once(
-                        logical_plan, timeout_s, conf_overrides=conf,
-                        cancel_token=token, count_cancel=owns_token,
-                        deadline_remaining_s=budget.remaining_s())
-                except ExecutorLostError as e:
-                    self._recover_lost(e)
-                    if not self.shuffle.registry.peers(workers_only=True):
-                        raise      # no survivors to retry on
-                    budget.backoff(error=e)
-                    SHUFFLE_COUNTERS.add(scoped_resubmits=1)
-                    log.warning("query %d: resubmitting over survivors "
-                                "(lost %s)", e.query_id, e.lost)
-                except TaskRetryableError as e:
-                    self._invalidate_query(e.query_id)
-                    budget.backoff(error=e)
-                    SHUFFLE_COUNTERS.add(task_retries=1)
-                    log.warning("query %d: retrying after retryable task "
-                                "failure: %s", e.query_id, e)
+            with (trace_scope(trace) if owns_trace else nullcontext()):
+                while True:
+                    try:
+                        return self._submit_once(
+                            logical_plan, timeout_s, conf_overrides=conf,
+                            cancel_token=token, count_cancel=owns_token,
+                            deadline_remaining_s=budget.remaining_s())
+                    except ExecutorLostError as e:
+                        self._recover_lost(e)
+                        if not self.shuffle.registry.peers(
+                                workers_only=True):
+                            raise      # no survivors to retry on
+                        budget.backoff(error=e)
+                        SHUFFLE_COUNTERS.add(scoped_resubmits=1)
+                        log.warning("query %d: resubmitting over "
+                                    "survivors (lost %s)",
+                                    e.query_id, e.lost)
+                    except TaskRetryableError as e:
+                        self._invalidate_query(e.query_id)
+                        budget.backoff(error=e)
+                        SHUFFLE_COUNTERS.add(task_retries=1)
+                        log.warning("query %d: retrying after retryable "
+                                    "task failure: %s", e.query_id, e)
         finally:
+            if owns_trace:
+                trace.finish()
+                if self.trace_dir:
+                    from spark_rapids_tpu.utils.obs import \
+                        export_trace_file
+                    export_trace_file(trace, self.trace_dir)
             # the token stays registered under EVERY attempt's qid for
             # the WHOLE submission (attempts share one token, and a
             # resubmit must not orphan the id a caller already read from
@@ -564,6 +679,9 @@ class TpuClusterDriver:
         task_deadline = min(
             [d for d in (timeout_s, deadline_remaining_s,
                          token.remaining_s()) if d is not None])
+        from spark_rapids_tpu.utils.obs import current_query_trace
+        trace = current_query_trace()
+        t_dispatch0 = time.time()
         proto = {"world": world, "participants": executors,
                  # per-query conf (the registration broadcast is static;
                  # these override)
@@ -574,10 +692,22 @@ class TpuClusterDriver:
             qid = self._next_query
             self._next_query += 1
             proto["query_id"] = qid
+            if trace is not None:
+                # the trace context ships BESIDE deadline_s: executors
+                # run the task under a trace of the same query id and
+                # return their telemetry in task_result
+                if getattr(trace, "_driver_names_qid", False):
+                    # driver-owned: name it after the FIRST attempt's
+                    # qid (resubmits keep the id a caller already saw)
+                    trace.query_id = str(qid)
+                    trace._driver_names_qid = False
+                proto["trace"] = {"qid": trace.query_id,
+                                  "max_spans": trace.max_spans}
             self._expected[qid] = executors
             self._attempts[qid] = {}
             self._task_failures[qid] = []
             self._results[qid] = {}
+            self._telemetry[qid] = []
             self._cancel_tokens[qid] = token
             # driver-owned tokens name the LIVE attempt's qid (a scoped
             # resubmit re-labels, so stall reports and QueryCancelled
@@ -587,6 +717,13 @@ class TpuClusterDriver:
             for rank, eid in enumerate(executors):
                 self._dispatch_attempt_locked(qid, rank, eid, 0,
                                               "primary", proto)
+        if trace is not None:
+            # recorded OUTSIDE the driver lock (the trace has its own
+            # lock; never nest them under self._lock)
+            trace.record_span("driver.dispatch", t_dispatch0,
+                              time.time(), track="driver",
+                              tags={"qid": qid, "world": world},
+                              anchor=True)
         deadline = time.monotonic() + timeout_s
         lost_exc: Optional[ExecutorLostError] = None
         retry_exc: Optional[TaskRetryableError] = None
@@ -726,6 +863,7 @@ class TpuClusterDriver:
         finally:
             with self._lock:
                 results = self._results.pop(qid, {})
+                tel_records = self._telemetry.pop(qid, [])
                 self._expected.pop(qid, None)
                 self._fingerprints.pop(qid, None)
                 self._attempts.pop(qid, None)
@@ -748,6 +886,17 @@ class TpuClusterDriver:
                         self._tasks[eid] = q
                     else:
                         del self._tasks[eid]
+            if trace is not None:
+                try:
+                    self._merge_telemetry(trace, qid, world, tel_records,
+                                          results, t_dispatch0)
+                except Exception:
+                    # diagnostics never fail (or mask) the query: a
+                    # malformed telemetry header from a skewed peer
+                    # costs the report, not the result
+                    log.warning("query %s: telemetry merge failed "
+                                "(diagnostics dropped)", qid,
+                                exc_info=True)
         if cancel_exc is not None:
             # ONE idempotent teardown path: stop remote work (the
             # cancel_query broadcast flips each peer's task tokens),

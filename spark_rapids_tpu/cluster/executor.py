@@ -101,7 +101,11 @@ class _RankFilteredScan:
         self.inner.cleanup()
 
     def describe(self):
-        return (f"RankFilteredScan[{self.rank}/{self.world}, "
+        # NO rank in the string: describe must be IDENTICAL across
+        # ranks or merge_metric_trees' positional (describe, depth)
+        # guard would silently keep only rank 0's scan metrics; the
+        # rank rides the telemetry record's rank tag instead
+        return (f"RankFilteredScan[world={self.world}, "
                 f"{self.inner.describe()}]")
 
     def tree_string(self, indent: int = 0) -> str:
@@ -192,7 +196,13 @@ def _check_distributable(physical) -> None:
 
 
 def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
-             driver_rpc=None, executor_id: str = None) -> list:
+             driver_rpc=None, executor_id: str = None) -> tuple:
+    """Returns (partition-tagged rows, physical plan for deferred
+    cleanup, telemetry dict or None).  Telemetry — task-side spans,
+    the scoped counter deltas, per-exec MetricSet snapshots — is
+    collected only when the task proto SHIPPED a trace context
+    (utils/obs.py; the driver merges it under the originating query's
+    trace with rank/attempt tags)."""
     # injected straggler latency (chaos site cluster.task.delay): fires
     # FIRST so a delayed task looks exactly like a slow worker — the
     # driver's speculation watches pickup-to-result wall time
@@ -215,19 +225,52 @@ def run_task(task: dict, plan_bytes: bytes, conf_map: dict,
         label=f"cluster query {qid} rank {task.get('rank')}",
         deadline_s=(None if shipped is None
                     else max(float(shipped), 0.0)))
+    # query-scoped trace context (shipped beside deadline_s): the whole
+    # task — engine batch loop, pipeline producers, fetch workers — runs
+    # under it, so counter deltas and trace ranges attribute to the
+    # originating query instead of this process's interleaved globals
+    from contextlib import nullcontext
+
+    from spark_rapids_tpu.utils.obs import (
+        QueryTrace, collect_task_telemetry, span, trace_scope)
+    tctx = task.get("trace")
+    trace = None
+    if tctx:
+        trace = QueryTrace(tctx.get("qid", qid), enabled=True,
+                           max_spans=tctx.get("max_spans"),
+                           default_track="executor")
     CANCELS.register(qid, token)
     try:
-        with token.scope():
-            # entry cancellation point: an already-expired deadline (or
-            # a cancel that raced dispatch) aborts before any work
-            token.check()
-            return _run_task_body(task, plan_bytes, conf_map,
-                                  driver_rpc, executor_id)
-    except QueryCancelled:
-        # the acceptance counter: this task observed the cancel and
-        # stopped EARLY (typed), instead of running to completion
-        SHUFFLE_COUNTERS.add(tasks_cancelled=1)
-        raise
+        with token.scope(), \
+                (trace_scope(trace) if trace is not None
+                 else nullcontext()):
+            try:
+                # entry cancellation point: an already-expired deadline
+                # (or a cancel that raced dispatch) aborts before any
+                # work
+                token.check()
+                # task-metrics attribution (the same utils/obs.py seam
+                # as engine.py run_one): the worker loop thread is
+                # REUSED across queries, so the shipped telemetry gets
+                # this task's DELTA as task_* counter-scope keys
+                from spark_rapids_tpu.utils.obs import task_metrics_tee
+                with task_metrics_tee(trace):
+                    with span("executor.task", anchor=True,
+                              tags={"rank": task.get("rank"),
+                                    "attempt": task.get("attempt", 0),
+                                    "eid": executor_id}):
+                        parts, physical = _run_task_body(
+                            task, plan_bytes, conf_map, driver_rpc,
+                            executor_id)
+                return parts, physical, collect_task_telemetry(
+                    trace, physical)
+            except QueryCancelled:
+                # the acceptance counter: this task observed the cancel
+                # and stopped EARLY (typed), instead of running to
+                # completion — counted inside the trace scope so the
+                # delta attributes to the cancelled query
+                SHUFFLE_COUNTERS.add(tasks_cancelled=1)
+                raise
     finally:
         CANCELS.unregister(qid, token)
 
@@ -273,9 +316,18 @@ def _run_task_body(task: dict, plan_bytes: bytes, conf_map: dict,
     # planning, the map-side exchange materialization, and the output
     # loop — as three bounded withs (never a bare __enter__ that an
     # exception between phases could leak onto the reused worker thread)
+    from spark_rapids_tpu.utils.obs import (
+        current_query_trace, instrument_plan, span)
     with TENANTS.scope(tenant):
-        logical = pickle.loads(plan_bytes)
-        physical, _meta = plan_query(logical, conf)
+        with span("executor.plan"):
+            logical = pickle.loads(plan_bytes)
+            physical, _meta = plan_query(logical, conf)
+    if current_query_trace() is not None:
+        # traced tasks report per-exec rows/batches/time at the batch
+        # seams (anRows/anBatches/anTimeNs) so the driver's merged
+        # EXPLAIN ANALYZE report has numbers for every node that ran,
+        # not just the execs with their own metric discipline
+        instrument_plan(physical)
     stats_client = None
     if world > 1 and driver_rpc is not None:
         from spark_rapids_tpu.cluster.stats import (
@@ -358,7 +410,7 @@ def _run_task_body(task: dict, plan_bytes: bytes, conf_map: dict,
     from spark_rapids_tpu.utils.cancel import check_cancelled
     parts: list = []
     try:
-        with TENANTS.scope(tenant):
+        with TENANTS.scope(tenant), span("executor.output"):
             n_out = physical.num_partitions()
             for p in range(n_out):
                 if p % world != rank:
@@ -487,15 +539,22 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
                 # heartbeat (half-data hazard: completeness is driver-side,
                 # fetch targets are the local view)
                 node.heartbeat()
-                rows, pending_cleanup = run_task(
+                rows, pending_cleanup, telemetry = run_task(
                     task, payload, conf_map,
                     driver_rpc=driver_rpc_addr,
                     executor_id=node.executor_id)
-                _request(driver_rpc_addr,
-                         {"op": "task_result", "query_id": task["query_id"],
-                          "executor_id": node.executor_id,
-                          "rank": task.get("rank"),
-                          "attempt": task.get("attempt", 0)},
+                result_header = {
+                    "op": "task_result", "query_id": task["query_id"],
+                    "executor_id": node.executor_id,
+                    "rank": task.get("rank"),
+                    "attempt": task.get("attempt", 0)}
+                if telemetry is not None:
+                    # task-side spans + scoped counter deltas + per-exec
+                    # metric snapshots ride the JSON header (bounded by
+                    # the shipped maxSpans); the driver merges them
+                    # under the originating query's trace
+                    result_header["telemetry"] = telemetry
+                _request(driver_rpc_addr, result_header,
                          pickle.dumps(rows))
             except Exception as e:  # noqa: BLE001 — report, don't kill
                 crashdump.dump_now("task_failure",

@@ -50,7 +50,17 @@ def local_shuffle_counters() -> dict:
     return shuffle_counters()
 
 
+def local_histograms() -> dict:
+    """This rank's fixed-bucket latency histograms (shuffle/stats.py):
+    serving submit->done latency and per-stage fetch wait / pipeline
+    drain, as count/sum/max + p50/p90/p99 snapshots — the tail-latency
+    view the counters can't give (ROADMAP item 5's SLO measurements)."""
+    from spark_rapids_tpu.shuffle.stats import histograms
+    return histograms()
+
+
 def reset_local_shuffle_counters() -> None:
+    """Resets counters AND the latency histograms (one snapshot epoch)."""
     from spark_rapids_tpu.shuffle.stats import reset_shuffle_counters
     reset_shuffle_counters()
 

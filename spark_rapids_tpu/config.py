@@ -671,6 +671,33 @@ SERVING_TENANTS = conf("spark.rapids.serving.tenants").doc(
     "defaultBudgetBytes/defaultWeight knobs."
 ).string_conf("")
 
+TRACE_ENABLED = conf("spark.rapids.trace.enabled").doc(
+    "Arm the query-scoped observability plane (utils/obs.py): every "
+    "serving/cluster submission runs under a QueryTrace ambient that "
+    "collects named spans (trace ranges), tees ShuffleCounters deltas "
+    "into a per-query counter scope, and — on the cluster path — ships "
+    "the trace context with each task so executors return task-side "
+    "spans and per-exec metric snapshots the driver merges under the "
+    "originating query with rank/attempt tags.  Off (the default) the "
+    "tee is a single thread-local read per counter add: ~zero overhead."
+).boolean_conf(False)
+
+TRACE_DIR = conf("spark.rapids.trace.dir").doc(
+    "Directory for per-query Perfetto/Chrome-trace JSON exports "
+    "(tools/trace_export.py): when set (and tracing is enabled), each "
+    "serving/driver submission writes <dir>/query_<id>.trace.json — a "
+    "timeline spanning serving admission, driver dispatch, per-rank "
+    "task spans and shuffle fetch/pipeline producer spans, loadable in "
+    "ui.perfetto.dev or chrome://tracing.  Empty disables export."
+).string_conf("")
+
+TRACE_MAX_SPANS = conf("spark.rapids.trace.maxSpans").doc(
+    "Per-query span-buffer bound: spans past it are dropped (and "
+    "counted in the trace's dropped_spans) so a long query can never "
+    "grow an unbounded buffer on the serving path.  Executor task "
+    "traces use the same bound, shipped with the trace context."
+).int_conf(4096)
+
 TEST_RETRY_CONTEXT_CHECK = conf("spark.rapids.sql.test.retryContextCheck.enabled").doc(
     "Assert that every device allocation site is covered by a retry block "
     "(reference: AllocationRetryCoverageTracker.scala)."
@@ -1005,6 +1032,18 @@ class RapidsConf:
     @property
     def watchdog_cancel_on_stall(self) -> bool:
         return self.get(WATCHDOG_CANCEL_ON_STALL)
+
+    @property
+    def trace_enabled(self) -> bool:
+        return self.get(TRACE_ENABLED)
+
+    @property
+    def trace_dir(self) -> str:
+        return self.get(TRACE_DIR)
+
+    @property
+    def trace_max_spans(self) -> int:
+        return self.get(TRACE_MAX_SPANS)
 
     def with_overrides(self, **kv) -> "RapidsConf":
         m = dict(self._map)

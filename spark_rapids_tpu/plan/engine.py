@@ -32,31 +32,49 @@ class TpuEngine:
         from spark_rapids_tpu.memory.tenant import TENANTS
         from spark_rapids_tpu.utils.cancel import (
             QueryCancelled, cancel_scope, current_cancel_token)
+        from spark_rapids_tpu.utils.obs import (
+            current_query_trace, trace_scope)
         tenant = TENANTS.current()
         priority = current_task_priority()
         token = current_cancel_token()
+        # the per-query trace rides along like the other ambients: a
+        # task thread's counter deltas and trace ranges must attribute
+        # to the submitting query (utils/obs.py)
+        trace = current_query_trace()
 
         def run_one(p: int) -> List[ColumnarBatch]:
             from spark_rapids_tpu.memory.task_completion import task_scope
+            from spark_rapids_tpu.utils.obs import task_metrics_tee
             sem = tpu_semaphore()
-            sem.acquire_if_necessary(priority)
-            try:
-                with TENANTS.scope(tenant), cancel_scope(token), \
-                        task_scope():
-                    out: List[ColumnarBatch] = []
-                    for batch in plan.execute_partition(p):
-                        # batch-boundary cancellation point (the task
-                        # analog of Spark's cooperative interruption)
-                        if token is not None:
-                            token.check()
-                        out.append(batch)
-                    return out
-            except QueryCancelled:
-                from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
-                SHUFFLE_COUNTERS.add(tasks_cancelled=1)
-                raise
-            finally:
-                sem.release_if_necessary()
+            # task_metrics_tee: this task's per-thread TaskMetrics
+            # DELTA (semaphore wait below included) lands in the
+            # per-query counter scope as task_* keys
+            with task_metrics_tee(trace):
+                sem.acquire_if_necessary(priority)
+                try:
+                    with TENANTS.scope(tenant), cancel_scope(token), \
+                            trace_scope(trace), task_scope():
+                        try:
+                            out: List[ColumnarBatch] = []
+                            for batch in plan.execute_partition(p):
+                                # batch-boundary cancellation point (the
+                                # task analog of Spark's cooperative
+                                # interruption)
+                                if token is not None:
+                                    token.check()
+                                out.append(batch)
+                            return out
+                        except QueryCancelled:
+                            # counted INSIDE the trace scope so the
+                            # delta tees into the query's attribution
+                            # (scope sums must equal global deltas even
+                            # for a run containing a cancel)
+                            from spark_rapids_tpu.shuffle.stats import (
+                                SHUFFLE_COUNTERS)
+                            SHUFFLE_COUNTERS.add(tasks_cancelled=1)
+                            raise
+                finally:
+                    sem.release_if_necessary()
 
         threads = min(nparts, max(self.conf.concurrent_tpu_tasks, 1))
         try:
@@ -68,15 +86,11 @@ class TpuEngine:
             self.last_metrics = self._metrics_report(plan)
             plan.cleanup()
 
-    def _metrics_report(self, plan: TpuExec, _out=None, _depth=0):
+    def _metrics_report(self, plan: TpuExec):
         """Per-exec metric snapshots at the configured verbosity
         (spark.rapids.sql.metrics.level; GpuMetrics levels analog)."""
-        level = self.conf.metrics_level
-        out = _out if _out is not None else []
-        out.append((plan.describe(), _depth, plan.metrics.snapshot(level)))
-        for c in plan.children:
-            self._metrics_report(c, out, _depth + 1)
-        return out
+        from spark_rapids_tpu.utils.obs import metrics_tree
+        return metrics_tree(plan, level=self.conf.metrics_level)
 
     def collect(self, plan: TpuExec) -> List[tuple]:
         from spark_rapids_tpu.plan.cpu_engine import CpuTable

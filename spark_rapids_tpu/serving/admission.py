@@ -176,6 +176,18 @@ class QueryQueue:
         self._active: Dict[str, CancelToken] = {}
         self._active_lock = threading.Lock()
         self._qid_seq = itertools.count(1)
+        #: query-scoped observability (utils/obs.py): every submission
+        #: runs under a QueryTrace ambient when enabled — spans +
+        #: attributed counters per query instead of interleaved globals;
+        #: finished snapshots are kept (bounded) for query_trace()
+        self.trace_enabled = conf.trace_enabled
+        self.trace_dir = conf.trace_dir
+        self.trace_max_spans = conf.trace_max_spans
+        import collections
+        self._traces: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self._traces_max = 32
+        self._traces_lock = threading.Lock()
 
     # -- admission -----------------------------------------------------------
 
@@ -294,6 +306,30 @@ class QueryQueue:
         with self._active_lock:
             return sorted(self._active)
 
+    def query_trace(self, query_id: str) -> Optional[dict]:
+        """Finished submission's trace snapshot (spans, per-query
+        attributed counters, duration, merged executor telemetry) —
+        None when tracing was off or the id aged out."""
+        with self._traces_lock:
+            snap = self._traces.get(query_id)
+            return dict(snap) if snap is not None else None
+
+    def _finish_trace(self, trace, query_id: str) -> None:
+        """Seal + stash + export one submission's trace (never fails
+        the submission: export IO errors are logged and swallowed by
+        obs.export_trace_file)."""
+        from spark_rapids_tpu.utils.obs import export_trace_file
+        trace.finish()
+        snap = trace.snapshot()
+        path = (export_trace_file(trace, self.trace_dir)
+                if self.trace_dir else None)
+        if path:
+            snap["export_path"] = path
+        with self._traces_lock:
+            self._traces[query_id] = snap
+            while len(self._traces) > self._traces_max:
+                self._traces.popitem(last=False)
+
     def _mint_query_id(self) -> str:
         """Fresh auto id, dodging caller-supplied ids (caller holds
         ``_active_lock``)."""
@@ -346,14 +382,34 @@ class QueryQueue:
         #: single-flight state shared with the except/finally clauses
         #: (the helper fills it in as it learns the key/role)
         sf = {"key": None, "leader": None}
+        # query-scoped observability: the submission runs under a
+        # QueryTrace ambient (utils/obs.py) — engine task threads,
+        # pipeline producers and fetch workers inherit it, so spans and
+        # counter deltas attribute to THIS query; the cluster runner
+        # ships the same context to executors and merges their task
+        # telemetry back under it
+        from contextlib import nullcontext
+
+        from spark_rapids_tpu.shuffle.stats import HISTOGRAMS
+        from spark_rapids_tpu.utils.obs import (
+            QueryTrace, span, trace_scope)
+        trace = (QueryTrace(query_id, enabled=True,
+                            max_spans=self.trace_max_spans,
+                            default_track="serving")
+                 if self.trace_enabled else None)
+        t_sub = time.monotonic()
         # the token is ambient for the WHOLE submission — admission
         # waits, the single-flight follower wait, and the runner (whose
         # engine threads inherit it) are all cancellation points
-        with token.scope():
+        with token.scope(), \
+                (trace_scope(trace) if trace is not None
+                 else nullcontext()):
             try:
-                return self._submit_under_token(
-                    plan, tenant, priority, est_bytes, overrides,
-                    cacheable, deadline, budget_s, token, sf)
+                with span("serving.submit", anchor=True,
+                          tags={"tenant": tenant, "priority": priority}):
+                    return self._submit_under_token(
+                        plan, tenant, priority, est_bytes, overrides,
+                        cacheable, deadline, budget_s, token, sf)
             except QueryCancelled as e:
                 # count THIS submission only when ITS OWN token was
                 # cancelled: a single-flight follower unwinding with the
@@ -370,6 +426,14 @@ class QueryQueue:
                     sf["leader"].set_exception(e)
                 raise
             finally:
+                # submit->done latency distribution: every submission
+                # (hits, rejections, cancels included — the latency the
+                # CALLER saw), p50/p90/p99 in cluster stats and the
+                # --concurrent bench artifact
+                HISTOGRAMS["serving_submit_s"].record(
+                    time.monotonic() - t_sub)
+                if trace is not None:
+                    self._finish_trace(trace, query_id)
                 with self._active_lock:
                     if self._active.get(query_id) is token:
                         del self._active[query_id]
@@ -424,10 +488,14 @@ class QueryQueue:
                         hit = self.cache.get(key, tenant=tenant)
                         if hit is not None:
                             return hit
-        cost = self._admit(
-            tenant, priority,
-            self.default_query_bytes if est_bytes is None else est_bytes,
-            max(deadline - time.monotonic(), 0.001))
+        from spark_rapids_tpu.utils.obs import span
+        with span("serving.admission", anchor=True,
+                  tags={"tenant": tenant}):
+            cost = self._admit(
+                tenant, priority,
+                self.default_query_bytes if est_bytes is None
+                else est_bytes,
+                max(deadline - time.monotonic(), 0.001))
         try:
             # chaos serving.runner.stall: the runner wedges in a
             # REGISTERED wait (the stall the watchdog must catch;
@@ -440,7 +508,8 @@ class QueryQueue:
                     token=token, site="serving.runner.stall")
             ctx = QueryContext(tenant, priority, overrides,
                                cancel_token=token)
-            with TENANTS.scope(tenant):
+            with TENANTS.scope(tenant), \
+                    span("serving.run", anchor=True, tags={"tenant": tenant}):
                 rows = self.runner(plan, ctx)
             token.check()   # a cancel that raced completion wins
         finally:

@@ -1689,6 +1689,12 @@ class BlockFetchIterator:
                 # process-wide stats lock, which must never nest under
                 # the fetch condition
                 SHUFFLE_COUNTERS.add(prefetch_stall_ns=stall_ns)
+                if stall_ns:
+                    # per-stage fetch-wait latency distribution: the tail
+                    # of these stalls is what the fleet-scale SLO story
+                    # needs visible (shuffle/stats.py Histogram)
+                    from spark_rapids_tpu.shuffle.stats import HISTOGRAMS
+                    HISTOGRAMS["fetch_wait_s"].record(stall_ns / 1e9)
                 if err is not None:
                     raise err
                 if block is None:

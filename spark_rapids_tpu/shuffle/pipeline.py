@@ -130,23 +130,29 @@ def pipelined(source: Iterable, nbytes_of: Callable[[object], int],
     pipe = _Pipe(max_inflight_bytes, token=token)
 
     def produce():
+        from spark_rapids_tpu.utils.obs import span
         try:
-            it = iter(source)
-            while True:
-                if token is not None:
-                    token.check()
-                # chaos shuffle.pipeline.producer.fail: the producer
-                # thread dies mid-stream — the error must surface at
-                # the consumer's next pull, never hang the hand-off
-                CHAOS.raise_if("shuffle.pipeline.producer.fail")
-                t0 = time.perf_counter_ns()
-                try:
-                    item = next(it)
-                except StopIteration:
-                    break
-                dt = time.perf_counter_ns() - t0
-                if not pipe.put(item, max(nbytes_of(item), 1), dt):
-                    break      # consumer gone: stop producing
+            # the producer span lands on the query's timeline (the
+            # ambient trace rides the spawn snapshot): a pipelined
+            # exchange's drain shows as a GAP between producer spans
+            # and consumer work instead of a counter to guess at
+            with span("shuffle.pipeline.produce", tags={"name": name}):
+                it = iter(source)
+                while True:
+                    if token is not None:
+                        token.check()
+                    # chaos shuffle.pipeline.producer.fail: the producer
+                    # thread dies mid-stream — the error must surface at
+                    # the consumer's next pull, never hang the hand-off
+                    CHAOS.raise_if("shuffle.pipeline.producer.fail")
+                    t0 = time.perf_counter_ns()
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
+                    dt = time.perf_counter_ns() - t0
+                    if not pipe.put(item, max(nbytes_of(item), 1), dt):
+                        break      # consumer gone: stop producing
         except BaseException as e:  # noqa: BLE001 — re-raised at consumer
             pipe.finish(e)
         else:
@@ -165,7 +171,10 @@ def pipelined(source: Iterable, nbytes_of: Callable[[object], int],
             elif waited_ns > produce_ns:
                 # the producer could not keep ahead: the hand-off drained
                 # for the part of the wait its own production can't cover
-                SHUFFLE_COUNTERS.add(stage_drain_ns=waited_ns - produce_ns)
+                drain_ns = waited_ns - produce_ns
+                SHUFFLE_COUNTERS.add(stage_drain_ns=drain_ns)
+                from spark_rapids_tpu.shuffle.stats import HISTOGRAMS
+                HISTOGRAMS["stage_drain_s"].record(drain_ns / 1e9)
             if waited_ns < produce_ns:
                 # this item's production ran (at least partly) while the
                 # consumer was busy with earlier items — true overlap

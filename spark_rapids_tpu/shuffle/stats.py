@@ -15,6 +15,10 @@ from __future__ import annotations
 
 import threading
 
+# module-level on purpose: add() runs per fetched block/batch on the
+# data plane, and obs.py's module imports are stdlib-only (no cycle)
+from spark_rapids_tpu.utils.obs import current_query_trace
+
 _FIELDS = (
     # transport
     "connections_opened",     # TCP connects (reuse keeps this ~1/peer)
@@ -143,6 +147,13 @@ _FIELDS = (
 
 
 class ShuffleCounters:
+    """add()/set_max() are the ONE blessed mutation entry point: beside
+    the global accumulation they TEE every delta into the thread-ambient
+    per-query counter scope (utils/obs.py QueryTrace), so two concurrent
+    serving queries get ATTRIBUTED counters instead of interleaved
+    globals.  tpu-lint's counter-discipline rule flags raw attribute
+    mutation that would bypass the tee."""
+
     def __init__(self):
         self._lock = threading.Lock()
         for f in _FIELDS:
@@ -152,12 +163,21 @@ class ShuffleCounters:
         with self._lock:
             for k, v in deltas.items():
                 setattr(self, k, getattr(self, k) + int(v))
+        # per-query tee OUTSIDE the counters lock (the trace has its own
+        # lock; never nest them).  No ambient trace = one thread-local
+        # read — the ~0-overhead disabled path.
+        tr = current_query_trace()
+        if tr is not None:
+            tr.counter_add(deltas)
 
     def set_max(self, **values: int) -> None:
         """High-watermark gauges (e.g. heartbeat failure streak)."""
         with self._lock:
             for k, v in values.items():
                 setattr(self, k, max(getattr(self, k), int(v)))
+        tr = current_query_trace()
+        if tr is not None:
+            tr.counter_set_max(values)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -172,6 +192,98 @@ class ShuffleCounters:
 SHUFFLE_COUNTERS = ShuffleCounters()
 
 
+class Histogram:
+    """Fixed-bucket latency histogram: exponential (x2) bucket bounds
+    from ``lowest_s`` up, with exact count/sum/max.  Counters answer
+    "how much"; serving needs "how long at the tail" — submit→done
+    latency and per-stage fetch wait p50/p90/p99 for the fleet-scale
+    SLO story (ROADMAP item 5), without storing every sample.
+
+    Percentiles report the UPPER bound of the bucket holding the
+    quantile (conservative: a reported p99 is >= the true p99), capped
+    at the observed max."""
+
+    def __init__(self, lowest_s: float = 0.0005, n_buckets: int = 28):
+        self.lowest_s = float(lowest_s)
+        self.bounds = [self.lowest_s * (2.0 ** i)
+                       for i in range(n_buckets)]
+        self._lock = threading.Lock()
+        self._counts = [0] * (n_buckets + 1)   # +1: overflow bucket
+        self.count = 0
+        self.sum_s = 0.0
+        self.max_s = 0.0
+
+    def _bucket(self, v: float) -> int:
+        import bisect
+        return bisect.bisect_left(self.bounds, v)
+
+    def record(self, seconds: float) -> None:
+        v = max(float(seconds), 0.0)
+        i = self._bucket(v)
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum_s += v
+            if v > self.max_s:
+                self.max_s = v
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            return self._percentile_locked(q)
+
+    def _percentile_locked(self, q: float) -> float:
+        if not self.count:
+            return 0.0
+        target = max(min(q, 1.0), 0.0) * self.count
+        cum = 0
+        for i, c in enumerate(self._counts):
+            cum += c
+            if cum >= target and c:
+                if i >= len(self.bounds):
+                    return self.max_s
+                return min(self.bounds[i], self.max_s)
+        return self.max_s
+
+    def snapshot(self) -> dict:
+        # ONE critical section: count/sum/max and the percentiles must
+        # come from the same sample set, or a concurrent record() tears
+        # the snapshot (count=N over N-1-sample percentiles)
+        with self._lock:
+            return {"count": self.count,
+                    "sum_s": round(self.sum_s, 6),
+                    "max_s": round(self.max_s, 6),
+                    "p50": round(self._percentile_locked(0.50), 6),
+                    "p90": round(self._percentile_locked(0.90), 6),
+                    "p99": round(self._percentile_locked(0.99), 6)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * len(self._counts)
+            self.count = 0
+            self.sum_s = 0.0
+            self.max_s = 0.0
+
+
+#: process-wide latency histograms, beside the counters in the cluster
+#: stats snapshot and the bench artifacts
+HISTOGRAMS = {
+    # serving submit()->rows wall time per submission (admission wait,
+    # execution, cache hits included — the user-visible latency)
+    "serving_submit_s": Histogram(),
+    # reduce-side fetch stalls: consumer blocked on an empty prefetch
+    # queue (each stall occurrence, seconds)
+    "fetch_wait_s": Histogram(),
+    # pipelined-exchange drains: consumer blocked on an empty stage
+    # hand-off after pipeline fill
+    "stage_drain_s": Histogram(),
+}
+
+
+def histograms() -> dict:
+    """{name: percentile snapshot} over the process-wide histograms."""
+    return {k: h.snapshot() for k, h in HISTOGRAMS.items()}
+
+
 def shuffle_counters() -> dict:
     """Snapshot of the process-wide counters (bench/test accessor)."""
     return SHUFFLE_COUNTERS.snapshot()
@@ -179,3 +291,5 @@ def shuffle_counters() -> dict:
 
 def reset_shuffle_counters() -> None:
     SHUFFLE_COUNTERS.reset()
+    for h in HISTOGRAMS.values():
+        h.reset()

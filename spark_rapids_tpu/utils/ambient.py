@@ -38,13 +38,18 @@ from typing import Callable, Optional
 class Ambients:
     """Immutable snapshot of the spawning thread's ambient context."""
 
-    __slots__ = ("tenant", "priority", "token", "covered")
+    __slots__ = ("tenant", "priority", "token", "covered", "trace")
 
-    def __init__(self, tenant, priority: int, token, covered: bool):
+    def __init__(self, tenant, priority: int, token, covered: bool,
+                 trace=None):
         self.tenant = tenant
         self.priority = priority
         self.token = token
         self.covered = covered
+        #: the per-query trace context (utils/obs.py QueryTrace): a
+        #: worker's counter deltas and spans must attribute to the
+        #: spawning query, or concurrent queries interleave again
+        self.trace = trace
 
     @classmethod
     def capture(cls, inherit_semaphore_cover: bool = True) -> "Ambients":
@@ -58,10 +63,12 @@ class Ambients:
             current_task_priority, tpu_semaphore)
         from spark_rapids_tpu.memory.tenant import TENANTS
         from spark_rapids_tpu.utils.cancel import current_cancel_token
+        from spark_rapids_tpu.utils.obs import current_query_trace
         covered = (inherit_semaphore_cover
                    and tpu_semaphore().held_count() > 0)
         return cls(TENANTS.current(), current_task_priority(),
-                   current_cancel_token(), covered)
+                   current_cancel_token(), covered,
+                   trace=current_query_trace())
 
     @contextmanager
     def scope(self):
@@ -70,10 +77,11 @@ class Ambients:
                                                        tpu_semaphore)
         from spark_rapids_tpu.memory.tenant import TENANTS
         from spark_rapids_tpu.utils.cancel import cancel_scope
+        from spark_rapids_tpu.utils.obs import trace_scope
         cover = (tpu_semaphore().borrowed_cover() if self.covered
                  else nullcontext())
         with TENANTS.scope(self.tenant), task_priority(self.priority), \
-                cancel_scope(self.token), cover:
+                cancel_scope(self.token), trace_scope(self.trace), cover:
             yield self
 
     def bind(self, fn: Callable) -> Callable:

@@ -70,21 +70,42 @@ def registered_ranges() -> Dict[str, str]:
 
 @contextlib.contextmanager
 def trace_range(name: str, doc: Optional[str] = None):
-    """Named range: registers (once), annotates the XLA trace, logs a span."""
+    """Named range: registers (once), annotates the XLA trace, logs a
+    span — and records into the ambient per-query trace (utils/obs.py)
+    so a range that ran on behalf of a query lands on that query's
+    timeline, with the open-span stack maintained for the stall
+    watchdog's "which query, where" reports."""
+    from spark_rapids_tpu.utils import obs
     if doc is not None and name not in _registry:
         register_range(name, doc)
     t0 = time.perf_counter()
+    t0_epoch = time.time()
+    obs.push_open_span(name)
     try:
         import jax.profiler
         cm = jax.profiler.TraceAnnotation(name)
     except Exception:
         cm = contextlib.nullcontext()
-    with cm:
-        yield
-    span_log.record(name, t0, time.perf_counter())
+    try:
+        with cm:
+            yield
+    finally:
+        # record in finally (matching obs.span): a range a query FAILED
+        # or was cancelled inside is exactly the one its timeline needs
+        obs.pop_open_span()
+        span_log.record(name, t0, time.perf_counter())
+        tr = obs.current_query_trace()
+        if tr is not None:
+            tr.record_span(name, t0_epoch, time.time())
 
 
 def generate_ranges_doc() -> str:
+    """docs/trace_ranges.md content, emitted from the STATIC range
+    table below — deterministic regardless of which modules ran (a
+    lazily trace_range-registered name would make the byte-matched doc
+    depend on import order; the drift lint instead requires every call
+    site's literal name to appear in the static table)."""
+    names = static_ranges()
     lines = [
         "# Trace range registry",
         "",
@@ -94,6 +115,55 @@ def generate_ranges_doc() -> str:
         "| Range | What it covers |",
         "|---|---|",
     ]
-    for name in sorted(_registry):
-        lines.append(f"| `{name}` | {_registry[name]} |")
+    for name in sorted(names):
+        lines.append(f"| `{name}` | {names[name]} |")
     return "\n".join(lines) + "\n"
+
+
+def static_ranges() -> Dict[str, str]:
+    """The statically registered range table (name -> doc)."""
+    return dict(_STATIC_RANGES)
+
+
+# -- static range registry -----------------------------------------------------
+#
+# Every span name used with trace_range() or obs.span() anywhere in the
+# package is registered HERE at import time, so docs/trace_ranges.md can
+# be generated deterministically (tools/generate_docs.py) and the
+# tpu-lint drift rule can byte-match it — the same docs-from-code
+# discipline configs.md pins.  Call sites may still pass doc= lazily,
+# but the doc string must match this table (register_range raises on a
+# conflicting re-registration).
+_STATIC_RANGES = (
+    # io / scan (plan/execs/scan.py + io/reader_pool.py)
+    ("scan.decode", "host-side file decode on the reader pool "
+                    "(no device semaphore held)"),
+    ("scan.wait", "task waiting for a decoded chunk "
+                  "(semaphore released)"),
+    ("scan.upload", "Arrow host chunk -> HBM batch upload "
+                    "(semaphore held)"),
+    # serving control plane (serving/admission.py; obs.span)
+    ("serving.submit", "one serving submission end-to-end: cache "
+                       "lookup, admission, execution"),
+    ("serving.admission", "admission wait: slots + byte-budget "
+                          "semaphores (priority-then-FIFO)"),
+    ("serving.run", "admitted query executing under its tenant scope "
+                    "(LocalSessionRunner or ClusterDriverRunner)"),
+    # driver control plane (cluster/driver.py; obs.span)
+    ("driver.query", "one cluster submission attempt: dispatch through "
+                     "last rank result"),
+    ("driver.dispatch", "driver queueing the per-rank task protos"),
+    # executor task path (cluster/executor.py; obs.span)
+    ("executor.task", "one rank's whole task: plan, map sides, output "
+                      "partitions"),
+    ("executor.plan", "executor-local planning of the shipped logical "
+                      "plan"),
+    ("executor.output", "executor output loop: this rank's share of "
+                        "root partitions"),
+    # shuffle data plane (shuffle/pipeline.py; obs.span)
+    ("shuffle.pipeline.produce", "pipelined exchange producer running "
+                                 "on its hand-off thread"),
+)
+for _n, _d in _STATIC_RANGES:
+    register_range(_n, _d)
+del _n, _d

@@ -36,20 +36,33 @@ from typing import Dict, List, Optional
 
 
 class _WaitRecord:
-    __slots__ = ("site", "token", "thread_name", "since", "reported")
+    __slots__ = ("site", "token", "thread_name", "since", "reported",
+                 "query_id", "open_span")
 
-    def __init__(self, site: str, token, thread_name: str, since: float):
+    def __init__(self, site: str, token, thread_name: str, since: float,
+                 query_id=None, open_span=None):
         self.site = site
         self.token = token          # Optional[CancelToken]
         self.thread_name = thread_name
         self.since = since
         self.reported = False
+        #: the wedged thread's ambient QueryTrace id (utils/obs.py) and
+        #: its innermost OPEN trace range at wait entry — a stall report
+        #: then names *which query, where*, not just the wait primitive
+        self.query_id = query_id
+        self.open_span = open_span  # Optional[(name, since_monotonic)]
 
     def snapshot(self, now: float) -> dict:
-        return {"site": self.site,
-                "query": getattr(self.token, "label", None),
-                "thread": self.thread_name,
-                "waiting_s": round(now - self.since, 3)}
+        out = {"site": self.site,
+               "query": getattr(self.token, "label", None),
+               "query_id": self.query_id,
+               "thread": self.thread_name,
+               "waiting_s": round(now - self.since, 3)}
+        if self.open_span is not None:
+            name, since = self.open_span
+            out["open_span"] = {"site": name,
+                                "elapsed_s": round(now - since, 3)}
+        return out
 
 
 class Watchdog:
@@ -109,7 +122,15 @@ class Watchdog:
 
     def begin_wait(self, site: str, token=None) -> int:
         now = time.monotonic()
-        rec = _WaitRecord(site, token, threading.current_thread().name, now)
+        # capture on the WAITING thread, before it blocks: its ambient
+        # query trace and innermost open trace range are exactly the
+        # "which query, where" a later stall report must name
+        from spark_rapids_tpu.utils.obs import (
+            current_query_trace, innermost_open_span)
+        tr = current_query_trace()
+        rec = _WaitRecord(site, token, threading.current_thread().name,
+                          now, query_id=(tr.query_id if tr else None),
+                          open_span=innermost_open_span())
         with self._lock:
             wid = next(self._seq)
             self._waits[wid] = rec

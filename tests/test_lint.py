@@ -1024,6 +1024,44 @@ def test_counter_rule_accepts_increment_outside_retry():
     assert counter_discipline.check([src]) == []
 
 
+def test_counter_rule_flags_raw_shuffle_counters_mutation():
+    """PR 13: add/set_max tee each delta into the per-query counter
+    scope (utils/obs.py); raw attribute mutation bypasses the tee and
+    silently loses per-query attribution."""
+    src = _src("spark_rapids_tpu/shuffle/transport.py", """
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+
+        def fast_path():
+            SHUFFLE_COUNTERS.merges += 1
+            SHUFFLE_COUNTERS.blocks_fetched = 7
+            setattr(SHUFFLE_COUNTERS, "bytes_fetched", 0)
+    """)
+    vs = counter_discipline.check([src])
+    assert len([v for v in vs if "scoped tee" in v.message]) == 3, \
+        "\n".join(v.render() for v in vs)
+
+
+def test_counter_rule_raw_mutation_allowed_only_in_stats_module():
+    """shuffle/stats.py itself owns the blessed entry points (add and
+    set_max mutate fields under the lock by construction)."""
+    src = _src("spark_rapids_tpu/shuffle/stats.py", """
+        def reset(self):
+            SHUFFLE_COUNTERS.merges = 0
+    """)
+    assert counter_discipline.check([src]) == []
+
+
+def test_counter_rule_blessed_add_is_clean():
+    src = _src("spark_rapids_tpu/shuffle/transport.py", """
+        from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+
+        def fast_path():
+            SHUFFLE_COUNTERS.add(merges=1)
+            SHUFFLE_COUNTERS.set_max(heartbeat_failure_streak=3)
+    """)
+    assert counter_discipline.check([src]) == []
+
+
 # -- regression pins: the pin leaks the new rule found were FIXED ------------
 
 def test_window_exception_path_pin_leak_was_fixed():

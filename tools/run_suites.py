@@ -55,6 +55,11 @@ SUITES = {
     # range-view store it was built to validate
     "profile": (["tests/test_prog_profile.py",
                  "tests/test_range_views.py"], 900),
+    # query-scoped observability plane: trace context + counter
+    # attribution, cross-process span round-trip, EXPLAIN ANALYZE,
+    # Perfetto export, latency histograms (utils/obs.py + trace_export)
+    "observability": (["tests/test_obs.py",
+                       "tests/test_prog_profile.py"], 900),
     "lint": (["tests/test_lint.py", "tests/test_ambient.py"], 300),
 }
 

@@ -255,7 +255,10 @@ def run_all_timed(repo_root: str = REPO,
         timings[rule] = _time.monotonic() - t0
     if with_drift and on("drift"):
         t0 = _time.monotonic()
-        violations.extend(drift.check(repo_root))
+        # hand drift the parsed sources ONLY on a full package scan —
+        # a file subset would silently narrow its trace-ranges walk
+        violations.extend(drift.check(
+            repo_root, sources=(sources if files is None else None)))
         timings["drift"] = _time.monotonic() - t0
 
     by_path = {s.path: s for s in sources}

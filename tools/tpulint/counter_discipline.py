@@ -23,6 +23,16 @@ Everything else wants the increment MOVED OUTSIDE the retry (count the
 event, not the attempts), a per-attempt counter named for what it is
 (``retry_count`` style -- memory/retry.py, the retry machinery itself,
 is exempt), or a reasoned inline suppression.
+
+The rule ALSO pins the scoped-tee discipline (PR 13): ``add``/``set_max``
+are ShuffleCounters' ONE blessed mutation entry point -- beside the
+global accumulation they tee each delta into the thread-ambient
+per-query counter scope (utils/obs.py QueryTrace), which is what gives
+concurrent serving queries attributed counters.  Raw attribute mutation
+of ``SHUFFLE_COUNTERS`` (``SHUFFLE_COUNTERS.x += 1``, plain assignment,
+``setattr(SHUFFLE_COUNTERS, ...)``) outside shuffle/stats.py bypasses
+the tee and silently breaks per-query attribution, so it is flagged
+wherever it appears.
 """
 from __future__ import annotations
 
@@ -127,12 +137,57 @@ def _may_still_raise(stmt: ast.AST, increment: ast.AST) -> bool:
     return False
 
 
+#: the counters module itself owns the blessed entry points (its add/
+#: set_max mutate fields under the lock by construction)
+TEE_EXEMPT_FILES = {"spark_rapids_tpu/shuffle/stats.py"}
+
+
+def _counters_receiver(node: ast.AST) -> bool:
+    """Is this expression (the attribute base / setattr target) the
+    process-wide counters object?"""
+    d = dotted(node)
+    return d == "SHUFFLE_COUNTERS" or d.endswith(".SHUFFLE_COUNTERS")
+
+
+def _raw_mutations(src: SourceFile) -> List[Violation]:
+    """Flag raw ShuffleCounters attribute mutation outside stats.py:
+    the add/set_max entry points tee deltas into the ambient per-query
+    scope (utils/obs.py), so a bare ``SHUFFLE_COUNTERS.x += 1`` (or
+    plain assignment / setattr) silently loses per-query attribution."""
+    out: List[Violation] = []
+
+    def flag(node, how: str) -> None:
+        out.append(Violation(
+            RULE, src.path, node.lineno, "<module>",
+            f"raw ShuffleCounters mutation ({how}) bypasses the "
+            f"per-query scoped tee -- SHUFFLE_COUNTERS.add/set_max is "
+            f"the one blessed entry point (utils/obs.py attribution)"))
+
+    for n in ast.walk(src.tree):
+        if isinstance(n, ast.AugAssign) and \
+                isinstance(n.target, ast.Attribute) and \
+                _counters_receiver(n.target.value):
+            flag(n, f"augmented assign to .{n.target.attr}")
+        elif isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) and \
+                        _counters_receiver(t.value):
+                    flag(n, f"assign to .{t.attr}")
+        elif isinstance(n, ast.Call) and \
+                dotted(n.func).endswith("setattr") and n.args and \
+                _counters_receiver(n.args[0]):
+            flag(n, "setattr")
+    return out
+
+
 def check(sources: List[SourceFile]) -> List[Violation]:
     out: List[Violation] = []
     for src in sources:
         if not src.path.startswith("spark_rapids_tpu/") or \
                 src.path in EXEMPT_FILES:
             continue
+        if src.path not in TEE_EXEMPT_FILES:
+            out.extend(_raw_mutations(src))
         info = cached_module_info(src)
         for qual in sorted(_retry_body_quals(info)):
             fi = info.functions.get(qual)

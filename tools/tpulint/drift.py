@@ -36,13 +36,92 @@ def _force_cpu() -> None:
         pass   # already initialized by the host process (tests do this)
 
 
-def check(repo_root: str) -> List[Violation]:
+def check(repo_root: str, sources=None) -> List[Violation]:
+    """``sources`` (the framework's already-parsed SourceFile list, when
+    the caller has a FULL package scan in hand) lets the trace-ranges
+    walk reuse those ASTs instead of re-reading every module."""
     _force_cpu()
     out: List[Violation] = []
     out.extend(_check_generated_docs(repo_root))
     out.extend(_check_typesig_rows())
     out.extend(_check_api_surface(repo_root))
     out.extend(_check_lint_doc(repo_root))
+    out.extend(_check_trace_ranges(repo_root, sources))
+    return out
+
+
+def _check_trace_ranges(repo_root: str,
+                        sources=None) -> List[Violation]:
+    """Trace-range registry drift (the NvtxRangeWithDoc discipline):
+
+      * docs/trace_ranges.md must byte-match
+        ``tracing.generate_ranges_doc()`` over the statically registered
+        table (same docs-from-code contract as configs.md);
+      * every LITERAL span name used with ``trace_range(...)`` or
+        ``obs.span(...)`` in the package must be registered — an
+        unregistered range is invisible to the generated doc and to
+        anyone navigating a Perfetto timeline.
+    """
+    import ast as _ast
+
+    from spark_rapids_tpu.utils import tracing
+
+    out: List[Violation] = []
+    want = tracing.generate_ranges_doc()
+    rel = "docs/trace_ranges.md"
+    path = os.path.join(repo_root, rel)
+    have = None
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            have = f.read()
+    if have != want:
+        out.append(Violation(
+            RULE, rel, 1, "<generated>",
+            f"{rel} does not match tracing.generate_ranges_doc(); "
+            f"run `python tools/generate_docs.py`"))
+
+    registered = set(tracing.static_ranges())
+    if sources is not None:
+        # reuse the framework's parsed ASTs (same file set:
+        # core.iter_py_files walks exactly spark_rapids_tpu/)
+        parsed = [(s.path, s.tree) for s in sources
+                  if s.path.startswith("spark_rapids_tpu/")]
+    else:
+        parsed = []
+        pkg = os.path.join(repo_root, "spark_rapids_tpu")
+        for dirpath, _dirs, files in os.walk(pkg):
+            for fn in sorted(files):
+                if not fn.endswith(".py"):
+                    continue
+                fpath = os.path.join(dirpath, fn)
+                with open(fpath, encoding="utf-8") as f:
+                    try:
+                        tree = _ast.parse(f.read())
+                    except SyntaxError:
+                        continue
+                parsed.append((os.path.relpath(fpath, repo_root), tree))
+    for relf, tree in parsed:
+        for node in _ast.walk(tree):
+            if not isinstance(node, _ast.Call):
+                continue
+            func = node.func
+            name = (func.attr if isinstance(func, _ast.Attribute)
+                    else func.id if isinstance(func, _ast.Name)
+                    else "")
+            if name not in ("trace_range", "span"):
+                continue
+            if not node.args or not isinstance(
+                    node.args[0], _ast.Constant) or not isinstance(
+                    node.args[0].value, str):
+                continue
+            rng = node.args[0].value
+            if rng not in registered:
+                out.append(Violation(
+                    RULE, relf, node.lineno, "<trace-ranges>",
+                    f"span name {rng!r} is not registered in "
+                    f"utils/tracing.py _STATIC_RANGES — register "
+                    f"it (with a doc) and regenerate "
+                    f"docs/trace_ranges.md"))
     return out
 
 

@@ -260,12 +260,21 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
     # counter add — well under measurement noise per query.
     from spark_rapids_tpu.utils.obs import (
         QueryTrace, export_trace_file, trace_scope)
+    # resource-plane timeline (utils/telemetry.py): the ring is reset so
+    # the timed run's samples alone feed the per-query timeline summary
+    # (peak arena/pinned/queue-depth + total spill) in the artifact —
+    # perf numbers carry their resource context
+    from spark_rapids_tpu.utils.telemetry import TELEMETRY
+    TELEMETRY.reset_ring()
+    TELEMETRY.sample()      # baseline tick: spill deltas measure from 0
     trace = QueryTrace(f"bench_{qname}", enabled=True)
     t0 = time.perf_counter()
     with trace_scope(trace):
         tpu_rows = run(tpu_sess)
     tpu_time = time.perf_counter() - t0
     trace.finish()
+    TELEMETRY.sample()      # >=1 sample even under a sub-interval run
+    timeline = TELEMETRY.timeline_summary()
     stats = launch_stats()          # exact program-dispatch counts
     shuffle = local_shuffle_counters()  # data-plane behavior per query
     trace_counters = {k: v for k, v in trace.counters_snapshot().items()
@@ -344,6 +353,7 @@ def _child_query(backend: str, qname: str, n_rows: int) -> None:
             stats["launches"] / max(shuffle.get("exchange_stages", 0), 1),
             1),
         "shuffle": shuffle,
+        "timeline": timeline,
         "trace_counters": trace_counters,
         **({"trace_export": trace_export} if trace_export else {}),
         "input_bytes": input_bytes,
@@ -427,7 +437,10 @@ def _concurrent_bench() -> None:
     # concurrent: all queries submitted at once through admission
     import threading
     from concurrent.futures import ThreadPoolExecutor
+    from spark_rapids_tpu.utils.telemetry import TELEMETRY
     reset_local_shuffle_counters()
+    TELEMETRY.reset_ring()
+    TELEMETRY.sample()      # baseline tick: spill deltas measure from 0
     lat = {}
     lat_lock = threading.Lock()
 
@@ -446,6 +459,8 @@ def _concurrent_bench() -> None:
         for f in futs:
             f.result(timeout=QUERY_TIMEOUT_S["cpu"])
     concurrent_s = time.perf_counter() - t0
+    TELEMETRY.sample()      # >=1 sample even under a sub-interval run
+    timeline = TELEMETRY.timeline_summary()
     counters = local_shuffle_counters()
     from spark_rapids_tpu.cluster.stats import local_histograms
     hists = local_histograms()
@@ -467,6 +482,9 @@ def _concurrent_bench() -> None:
         # the concurrent pass, plus the fetch-wait/stage-drain tails
         "latency_histogram": hists["serving_submit_s"],
         "fetch_wait_histogram": hists["fetch_wait_s"],
+        # the concurrent pass's resource context (peak arena/pinned/
+        # queue depth from the telemetry ring — the continuous plane)
+        "timeline": timeline,
         "serving_counters": {k: v for k, v in counters.items()
                              if k.startswith(("queries_", "cache_",
                                               "tenant_", "budget_"))},

@@ -506,6 +506,14 @@ class TpuClusterDriver:
         newly = sum(1 for eid in e.lost
                     if self.shuffle.registry.exclude(eid))
         SHUFFLE_COUNTERS.add(executors_excluded=newly)
+        # flight-recorder post-mortem (utils/telemetry.py): executor
+        # loss dumps the ring + event log stamped with the query id, so
+        # "what was the fleet doing when the rank died" is answerable
+        # without a rerun
+        from spark_rapids_tpu.utils.telemetry import TELEMETRY
+        TELEMETRY.flight_record("executor_loss",
+                                query_ids=[e.query_id],
+                                extra={"lost": e.lost})
         self._invalidate_query(e.query_id)
 
     def _invalidate_query(self, query_id: int) -> None:
@@ -815,6 +823,12 @@ class TpuClusterDriver:
                             excluded.add(eid)
                             self.shuffle.registry.exclude(eid)
                             SHUFFLE_COUNTERS.add(executors_excluded=1)
+                            # durable path: the loss costs a re-fetch,
+                            # not a resubmit — still a flight event
+                            from spark_rapids_tpu.utils.telemetry import \
+                                record_event
+                            record_event("executor_loss", eid=eid,
+                                         query_id=qid, durable=True)
                     live = self.shuffle.registry.peers(workers_only=True)
                     with self._lock:
                         idle = self._idle_executors_locked(qid, live)

@@ -470,11 +470,17 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
     _beat_stop = threading.Event()
 
     def _beat():
+        from spark_rapids_tpu.utils.telemetry import TELEMETRY
         pacer = HeartbeatPacer()
         while not _beat_stop.is_set():
             try:
                 CHAOS.raise_if("cluster.heartbeat")
-                PeerClient(shuffle_addr).heartbeat(node.executor_id)
+                # the beat PIGGYBACKS this rank's latest resource
+                # sample (utils/telemetry.py) for the driver's per-rank
+                # rings — None (sampler off / not ticked yet) keeps the
+                # exact legacy wire shape
+                PeerClient(shuffle_addr).heartbeat(
+                    node.executor_id, telemetry=TELEMETRY.latest())
                 pacer.success()
             except Exception as e:  # noqa: BLE001 — pacer logs+accounts
                 pacer.failure(e)

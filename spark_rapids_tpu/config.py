@@ -698,6 +698,30 @@ TRACE_MAX_SPANS = conf("spark.rapids.trace.maxSpans").doc(
     "traces use the same bound, shipped with the trace context."
 ).int_conf(4096)
 
+METRICS_ENABLED = conf("spark.rapids.metrics.enabled").doc(
+    "Arm the continuous resource-plane sampler (utils/telemetry.py): a "
+    "daemon snapshots arena/spill/semaphore/admission/in-flight gauges "
+    "plus the cumulative counters into a bounded ring every intervalMs, "
+    "executors piggyback their latest sample on the heartbeat for the "
+    "driver's per-rank rings, and tools/metrics_scrape.py renders the "
+    "cluster state as Prometheus text.  Off, no daemon samples and the "
+    "cost is zero (the flight recorder's event log stays on either "
+    "way)."
+).boolean_conf(True)
+
+METRICS_INTERVAL_MS = conf("spark.rapids.metrics.intervalMs").doc(
+    "Resource-plane sampling period in milliseconds (min 10).  One "
+    "sample is a handful of lock-guarded gauge reads — no device sync, "
+    "no I/O — measured within noise on the reduce-fetch micro-bench at "
+    "the default."
+).int_conf(250)
+
+METRICS_RING_SECONDS = conf("spark.rapids.metrics.ringSeconds").doc(
+    "Seconds of samples the telemetry ring retains (bounds the ring at "
+    "ringSeconds*1000/intervalMs samples).  The ring is what flight-"
+    "recorder post-mortems dump and bench timeline summaries read."
+).int_conf(60)
+
 TEST_RETRY_CONTEXT_CHECK = conf("spark.rapids.sql.test.retryContextCheck.enabled").doc(
     "Assert that every device allocation site is covered by a retry block "
     "(reference: AllocationRetryCoverageTracker.scala)."
@@ -1044,6 +1068,18 @@ class RapidsConf:
     @property
     def trace_max_spans(self) -> int:
         return self.get(TRACE_MAX_SPANS)
+
+    @property
+    def metrics_enabled(self) -> bool:
+        return self.get(METRICS_ENABLED)
+
+    @property
+    def metrics_interval_ms(self) -> int:
+        return self.get(METRICS_INTERVAL_MS)
+
+    @property
+    def metrics_ring_seconds(self) -> int:
+        return self.get(METRICS_RING_SECONDS)
 
     def with_overrides(self, **kv) -> "RapidsConf":
         m = dict(self._map)

@@ -71,6 +71,15 @@ def initialize_memory(conf) -> None:
     from spark_rapids_tpu.utils.watchdog import WATCHDOG
     WATCHDOG.configure(conf.watchdog_stall_seconds,
                        conf.watchdog_cancel_on_stall)
+    # the continuous resource-plane sampler rides the same conf
+    # snapshot: every intervalMs a daemon snapshots the arena/spill/
+    # semaphore/admission/in-flight gauges into a bounded ring —
+    # heartbeats piggyback the latest sample, the flight recorder dumps
+    # the ring on stall/OOM-exhaustion/executor loss (utils/telemetry)
+    from spark_rapids_tpu.utils.telemetry import TELEMETRY
+    TELEMETRY.configure(conf.metrics_enabled,
+                        conf.metrics_interval_ms,
+                        conf.metrics_ring_seconds)
     # HBM-budget sizing from the chip's memory stats (GpuDeviceManager):
     # always on, like the reference's default-fraction pool sizing —
     # backends with no memory stats (CPU tests) stay in bookkeeping mode

@@ -66,10 +66,25 @@ def _spill_for_retry(e: Optional[BaseException]) -> None:
     spill-all behavior."""
     from spark_rapids_tpu.memory.spill import spill_framework
     from spark_rapids_tpu.memory.tenant import TenantBudgetExceeded
+    from spark_rapids_tpu.utils.telemetry import record_event
+    # flight-recorder event: every OOM retry is a pressure signal the
+    # post-mortem timeline wants beside the spills it triggers
+    record_event("oom_retry", error=type(e).__name__ if e else "TpuOOM")
     if isinstance(e, TenantBudgetExceeded):
         spill_framework().spill_tenant(e.tenant, 1 << 62)
     else:
         spill_framework().spill_device(1 << 62)  # spill all spillable
+
+
+def _note_retry_exhausted(e: Optional[BaseException]) -> None:
+    """OOM-retry budget exhausted: the task is about to FAIL on memory
+    pressure — exactly a flight-recorder moment.  The post-mortem
+    (ring + events + active query ids) dumps through utils/crashdump
+    and lands in TELEMETRY.last_postmortem; never raises."""
+    from spark_rapids_tpu.utils.telemetry import TELEMETRY
+    TELEMETRY.flight_record(
+        "oom_retry_exhausted",
+        extra={"error": repr(e), "max_retries": MAX_RETRIES})
 
 
 def with_retry_no_split(fn: Callable[[], T]) -> T:
@@ -101,6 +116,7 @@ def with_retry_no_split(fn: Callable[[], T]) -> T:
                 task_metrics.get().device_oom_count += 1
                 _bump_global_oom()
                 spill_framework().spill_device(1 << 62)
+        _note_retry_exhausted(last)
         raise last  # type: ignore[misc]
     finally:
         exit_retry_scope()
@@ -133,6 +149,7 @@ def with_retry(
                     attempts += 1
                     task_metrics.get().retry_count += 1
                     if attempts >= MAX_RETRIES:
+                        _note_retry_exhausted(e)
                         raise
                     _spill_for_retry(e)
                 except TpuSplitAndRetryOOM:
@@ -158,6 +175,7 @@ def with_retry(
                     task_metrics.get().device_oom_count += 1
                     _bump_global_oom()
                     if attempts >= MAX_RETRIES:
+                        _note_retry_exhausted(e)
                         raise TpuRetryOOM(
                             f"device RESOURCE_EXHAUSTED: {e}") from e
                     spill_framework().spill_device(1 << 62)

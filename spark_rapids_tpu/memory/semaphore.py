@@ -29,6 +29,7 @@ class PrioritySemaphore:
 
     def __init__(self, permits: int):
         self._permits = permits
+        self._size = permits            # configured total (occupancy gauge)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._waiters = []  # heap of (priority, seq)
@@ -137,6 +138,15 @@ class TpuSemaphore:
         cover (0 for non-task threads)."""
         return (getattr(self._tls, "held", 0)
                 + getattr(self._tls, "covered", 0))
+
+    def occupancy(self) -> dict:
+        """Slot occupancy for the resource-plane sampler
+        (utils/telemetry.py): total/in-use permits + queued waiters."""
+        total = self._sem._size
+        return {"semaphore_slots_total": total,
+                "semaphore_slots_in_use": max(
+                    total - self._sem.available(), 0),
+                "semaphore_waiters": self._sem.waiting()}
 
     def acquire_if_necessary(self, priority: int = 0) -> None:
         if getattr(self._tls, "covered", 0) > 0:

@@ -133,6 +133,7 @@ class SpillableBatchHandle:
         self._host: Optional[Tuple[dict, Schema]] = None
         self._disk_path: Optional[str] = None
         self._disk_crc = 0              # 0 = file not checksummed
+        self._disk_nbytes = 0           # landed spill-file payload bytes
         self._schema = batch.schema
         self.priority = priority
         self.last_use = time.monotonic()
@@ -183,6 +184,11 @@ class SpillableBatchHandle:
             self._release_device()
             self._fw.metrics.spill_to_host_bytes += self.size_bytes
             TENANTS.note_spill(self.tenant)
+            # flight-recorder event (utils/telemetry.py): spills are a
+            # pressure signal a post-mortem always wants on its timeline
+            from spark_rapids_tpu.utils.telemetry import record_event
+            record_event("spill", bytes=self.size_bytes,
+                         tenant=self.tenant)
             return self.size_bytes
 
     def spill_to_disk(self) -> int:
@@ -226,6 +232,7 @@ class SpillableBatchHandle:
             self._disk_path = path
             self._disk_crc = crc
             freed = sum(a.nbytes for a in arrays.values())
+            self._disk_nbytes = freed
             self._host = None
             self._fw.metrics.spill_to_disk_bytes += freed
             return freed
@@ -274,6 +281,7 @@ class SpillableBatchHandle:
                 os.unlink(self._disk_path)
                 self._disk_path = None
                 self._disk_crc = 0
+                self._disk_nbytes = 0
                 self._fw.metrics.read_spill_bytes += sum(
                     a.nbytes for a in arrays.values())
             assert self._host is not None
@@ -316,6 +324,17 @@ class SpillableBatchHandle:
         with self._lock:
             return self._device is not None
 
+    def gauge_row(self) -> Tuple[int, int, int, int]:
+        """(device, pinned, host, disk) resident bytes — one consistent
+        per-handle reading for the telemetry sampler (utils/telemetry)."""
+        with self._lock:
+            dev = self.size_bytes if self._device is not None else 0
+            pinned = dev if self._pins > 0 else 0
+            host = (sum(a.nbytes for a in self._host[0].values())
+                    if self._host is not None else 0)
+            disk = self._disk_nbytes if self._disk_path is not None else 0
+            return dev, pinned, host, disk
+
     def host_nbytes(self) -> int:
         with self._lock:
             if self._host is None:
@@ -337,6 +356,7 @@ class SpillableBatchHandle:
                 except OSError:
                     pass
                 self._disk_path = None
+                self._disk_nbytes = 0
         self._fw._unregister(self)
 
 
@@ -426,6 +446,25 @@ class SpillFramework:
             if total <= self.host_limit_bytes:
                 break
             total -= h.spill_to_disk()
+
+    def gauges(self) -> dict:
+        """Resource-plane occupancy of the store (utils/telemetry.py
+        sampler): device-resident / pinned / host / disk bytes and the
+        live handle count.  Per-handle reads happen OUTSIDE the
+        framework lock (the usual handle-lock discipline)."""
+        dev = pinned = host = disk = 0
+        handles = self._snapshot()
+        for h in handles:
+            d, p, ho, di = h.gauge_row()
+            dev += d
+            pinned += p
+            host += ho
+            disk += di
+        return {"spill_device_resident_bytes": dev,
+                "spill_pinned_bytes": pinned,
+                "spill_host_bytes": host,
+                "spill_disk_bytes": disk,
+                "spill_handles": len(handles)}
 
     def spill_all_to_disk(self) -> None:
         for h in self._snapshot():

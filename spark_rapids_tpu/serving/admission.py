@@ -45,7 +45,8 @@ from spark_rapids_tpu.memory.tenant import TENANT_CONF_KEY, TENANTS
 from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
 from spark_rapids_tpu.testing.chaos import CHAOS
 from spark_rapids_tpu.utils.cancel import (
-    CancelToken, QueryCancelled, cancellable_wait)
+    CANCELS, CancelToken, QueryCancelled, cancellable_wait)
+from spark_rapids_tpu.utils.telemetry import record_event
 
 from spark_rapids_tpu.serving.cache import (
     ResultCache, UncacheableError, plan_fingerprint)
@@ -188,8 +189,28 @@ class QueryQueue:
             collections.OrderedDict()
         self._traces_max = 32
         self._traces_lock = threading.Lock()
+        # resource-plane telemetry (utils/telemetry.py): the sampler
+        # reads this queue's slot/byte/depth occupancy every tick —
+        # queue depth and admission waits are the autoscaler's signals
+        from spark_rapids_tpu.utils.telemetry import register_query_queue
+        register_query_queue(self)
 
     # -- admission -----------------------------------------------------------
+
+    def admission_gauges(self) -> dict:
+        """Instantaneous admission occupancy (telemetry sampler): slots
+        total/in-use, waiting depth, and the byte budget when sized."""
+        g = {"admission_slots_total": self.max_concurrent,
+             "admission_slots_in_use": max(
+                 self.max_concurrent - self._slots.available(), 0),
+             "admission_queue_depth": self._depth,
+             "admission_bytes_total": 0, "admission_bytes_in_use": 0}
+        bytes_sem = self._bytes
+        if bytes_sem is not None:
+            g["admission_bytes_total"] = self.admission_bytes
+            g["admission_bytes_in_use"] = max(
+                self.admission_bytes - bytes_sem.available(), 0)
+        return g
 
     def _ensure_bytes_sem(self) -> None:
         """Size the byte-admission semaphore from the arena's CURRENT
@@ -235,6 +256,8 @@ class QueryQueue:
                     self._depth += 1
             if full:
                 SHUFFLE_COUNTERS.add(queries_rejected=1)
+                record_event("rejection", tenant=tenant,
+                             reason="queue_full")
                 raise AdmissionRejected(
                     f"admission queue full ({self.queue_max_depth} "
                     f"waiting): tenant {tenant!r} rejected",
@@ -248,6 +271,8 @@ class QueryQueue:
                     self._depth -= 1
             if not ok:
                 SHUFFLE_COUNTERS.add(queries_rejected=1)
+                record_event("rejection", tenant=tenant,
+                             reason="timeout")
                 raise AdmissionRejected(
                     f"admission wait exceeded {timeout_s:.1f}s: tenant "
                     f"{tenant!r} rejected", reason="timeout",
@@ -266,12 +291,15 @@ class QueryQueue:
             if not ok:
                 self._slots.release()
                 SHUFFLE_COUNTERS.add(queries_rejected=1)
+                record_event("rejection", tenant=tenant,
+                             reason="timeout")
                 raise AdmissionRejected(
                     f"admission byte budget wait exceeded "
                     f"{timeout_s:.1f}s ({cost}b of "
                     f"{self.admission_bytes}b): tenant {tenant!r} "
                     "rejected", reason="timeout", tenant=tenant)
         SHUFFLE_COUNTERS.add(queries_admitted=1)
+        record_event("admission", tenant=tenant, cost_bytes=cost)
         return cost
 
     def _release(self, cost: int) -> None:
@@ -379,6 +407,11 @@ class QueryQueue:
                     "cancel it first or choose a distinct id")
             self._active[query_id] = token
         token.label = f"serving query {query_id!r}"
+        # the PROCESS-WIDE active-query registry (utils/cancel.py): the
+        # flight recorder stamps post-mortems from CANCELS.active_ids(),
+        # so a serving submission must be visible there even with
+        # tracing off (cluster tasks register executor-side already)
+        CANCELS.register(query_id, token)
         #: single-flight state shared with the except/finally clauses
         #: (the helper fills it in as it learns the key/role)
         sf = {"key": None, "leader": None}
@@ -434,6 +467,7 @@ class QueryQueue:
                     time.monotonic() - t_sub)
                 if trace is not None:
                     self._finish_trace(trace, query_id)
+                CANCELS.unregister(query_id, token)
                 with self._active_lock:
                     if self._active.get(query_id) is token:
                         del self._active[query_id]

@@ -36,6 +36,7 @@ import socketserver
 import struct
 import threading
 import time
+from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
@@ -826,6 +827,13 @@ class HeartbeatRegistry:
         self.exclude_threshold = int(exclude_threshold)
         self._failures: Dict[str, int] = {}
         self._next_shuffle = 0
+        #: per-rank telemetry rings (utils/telemetry.py): executors
+        #: piggyback their LATEST resource sample on the heartbeat (no
+        #: new RPC); the driver keeps a bounded ring per rank and the
+        #: `metrics` wire op serves them to tools/metrics_scrape.py.
+        #: Legacy peers that send no sample simply have no ring.
+        self._rank_rings: Dict[str, "deque"] = {}
+        self.rank_ring_max = 240
         # per-shuffle participation: which LOGICAL participants WILL write
         # map output (declared at transport construction) and which have
         # finished.  Readers await completeness only from declared
@@ -910,8 +918,11 @@ class HeartbeatRegistry:
             if present:
                 del self._peers[executor_id]
             self._failures.pop(executor_id, None)
+            self._rank_rings.pop(executor_id, None)
         if present:
             SHUFFLE_COUNTERS.add(executors_left=1)
+            from spark_rapids_tpu.utils.telemetry import record_event
+            record_event("executor_leave", eid=executor_id)
         return present
 
     def next_shuffle_id(self) -> int:
@@ -943,6 +954,8 @@ class HeartbeatRegistry:
             self._failures.pop(executor_id, None)
         if joined:
             SHUFFLE_COUNTERS.add(executors_joined=1)
+            from spark_rapids_tpu.utils.telemetry import record_event
+            record_event("executor_join", eid=executor_id)
 
     def report_failure(self, executor_id: str) -> bool:
         """An executor reported repeated fetch failures against this
@@ -957,6 +970,7 @@ class HeartbeatRegistry:
                         and executor_id in self._peers)
             if excluded:
                 del self._peers[executor_id]
+                self._rank_rings.pop(executor_id, None)
         SHUFFLE_COUNTERS.add(peer_failures_reported=1,
                              peers_excluded=int(excluded))
         return excluded
@@ -971,15 +985,46 @@ class HeartbeatRegistry:
                 del self._peers[executor_id]
             self._failures[executor_id] = max(
                 self._failures.get(executor_id, 0), self.exclude_threshold)
+            self._rank_rings.pop(executor_id, None)
         if present:
             SHUFFLE_COUNTERS.add(peers_excluded=1)
         return present
 
-    def heartbeat(self, executor_id: str) -> None:
+    def heartbeat(self, executor_id: str,
+                  telemetry: Optional[dict] = None) -> None:
+        """Refresh liveness; ``telemetry`` (the peer's latest resource
+        sample, piggybacked on the beat) lands in the per-rank ring.
+        Legacy peers pass None — liveness semantics are unchanged."""
         with self._lock:
             if executor_id in self._peers:
                 h, p, _, role = self._peers[executor_id]
                 self._peers[executor_id] = (h, p, time.time(), role)
+                # telemetry only for REGISTERED peers: a stray beat from
+                # an excluded/departed id must not resurrect its series
+                if telemetry is not None and isinstance(telemetry, dict):
+                    ring = self._rank_rings.get(executor_id)
+                    if ring is None:
+                        ring = deque(maxlen=self.rank_ring_max)
+                        self._rank_rings[executor_id] = ring
+                    # executors beat faster than they sample: dedupe by
+                    # the sample timestamp so the ring holds distinct
+                    # ticks
+                    if not ring or ring[-1].get("t") != telemetry.get("t"):
+                        ring.append(telemetry)
+
+    def rank_rings(self) -> Dict[str, List[dict]]:
+        """{executor_id: [samples...]} — the driver-held per-rank
+        telemetry rings (the `metrics` wire op's cluster view).  Only
+        LIVE peers report: a dead rank's last sample must not read as
+        live capacity to the autoscaler, so rings of peers outside the
+        heartbeat window are omitted (and dropped on leave/exclude)."""
+        now = time.time()
+        with self._lock:
+            live = {eid for eid, (_h, _p, seen, _r) in
+                    self._peers.items() if now - seen <= self.timeout_s}
+            return {eid: list(ring)
+                    for eid, ring in self._rank_rings.items()
+                    if eid in live}
 
     def peers(self, workers_only: bool = False) -> Dict[str, Tuple[str, int]]:
         """Live peers; workers_only excludes registry-only driver nodes
@@ -1107,10 +1152,23 @@ class ShuffleBlockServer:
                     left = outer.registry.leave(header["executor_id"])
                     _send_msg(self.request, {"ok": True, "left": left})
                 elif op == "heartbeat" and outer.registry is not None:
-                    outer.registry.heartbeat(header["executor_id"])
+                    # the beat optionally PIGGYBACKS the peer's latest
+                    # resource sample (utils/telemetry.py) — no new RPC;
+                    # legacy peers simply omit the field
+                    outer.registry.heartbeat(header["executor_id"],
+                                             header.get("telemetry"))
                     _send_msg(self.request,
                               {"peers": outer.registry.peers(
                                   workers_only=True)})
+                elif op == "metrics":
+                    # resource-plane scrape (tools/metrics_scrape.py):
+                    # this node's sample + ring, plus — on the registry
+                    # holder (the driver) — every rank's heartbeat ring
+                    from spark_rapids_tpu.utils.telemetry import TELEMETRY
+                    reply = {"local": TELEMETRY.local_metrics()}
+                    if outer.registry is not None:
+                        reply["ranks"] = outer.registry.rank_rings()
+                    _send_msg(self.request, reply)
                 elif op == "peer_failure" and outer.registry is not None:
                     excluded = outer.registry.report_failure(
                         header["executor_id"])
@@ -1245,10 +1303,24 @@ class PeerClient:
         _request(self.addr, {"op": "register", "executor_id": executor_id,
                              "host": host, "port": port, "role": role})
 
-    def heartbeat(self, executor_id: str) -> Dict[str, Tuple[str, int]]:
-        h, _ = _request(self.addr, {"op": "heartbeat",
-                                    "executor_id": executor_id})
+    def heartbeat(self, executor_id: str,
+                  telemetry: Optional[dict] = None
+                  ) -> Dict[str, Tuple[str, int]]:
+        """Liveness beat, optionally piggybacking this node's latest
+        resource sample (utils/telemetry.py) for the driver's per-rank
+        telemetry rings — the continuous plane rides the EXISTING RPC."""
+        header = {"op": "heartbeat", "executor_id": executor_id}
+        if telemetry is not None:
+            header["telemetry"] = telemetry
+        h, _ = _request(self.addr, header)
         return {k: tuple(v) for k, v in h["peers"].items()}
+
+    def metrics(self) -> dict:
+        """This peer's resource-plane scrape payload (`metrics` op):
+        {"local": {sample, ring}, "ranks": {eid: ring}} — ranks present
+        only when the peer hosts the registry (the driver)."""
+        h, _ = _request(self.addr, {"op": "metrics"})
+        return h
 
     def join_shuffle(self, shuffle_id: int, executor_id: str) -> None:
         _request(self.addr, {"op": "join_shuffle", "shuffle_id": shuffle_id,
@@ -1636,6 +1708,12 @@ class BlockFetchIterator:
                         if state["stopped"]:
                             return
                         state["inflight"] += batch_bytes
+                        # resource-plane gauge (utils/telemetry.py):
+                        # process-wide fetched-but-unconsumed bytes,
+                        # one add per round-trip batch
+                        from spark_rapids_tpu.utils.telemetry import \
+                            FETCH_INFLIGHT
+                        FETCH_INFLIGHT.add(batch_bytes)
                     with request_slots:
                         got = self._fetch_batch(src_state, take)
                     with cv:
@@ -1684,6 +1762,9 @@ class BlockFetchIterator:
                     if err is None and queue:
                         block = queue.popleft()
                         state["inflight"] -= len(block)
+                        from spark_rapids_tpu.utils.telemetry import \
+                            FETCH_INFLIGHT
+                        FETCH_INFLIGHT.add(-len(block))
                         cv.notify_all()
                 # stall accounting outside cv: the counter add takes the
                 # process-wide stats lock, which must never nest under
@@ -1704,6 +1785,13 @@ class BlockFetchIterator:
         finally:
             with cv:
                 state["stopped"] = True
+                # an abandoned read's residual in-flight bytes leave the
+                # process gauge (workers observe stopped before adding
+                # more, so the final adjustment cannot race an add)
+                from spark_rapids_tpu.utils.telemetry import \
+                    FETCH_INFLIGHT
+                FETCH_INFLIGHT.add(-state["inflight"])
+                state["inflight"] = 0
                 cv.notify_all()
 
 
@@ -2020,7 +2108,12 @@ class ShuffleExecutor:
         Replacing (rather than merging) drops peers the registry has timed
         out, so one crashed worker doesn't poison every later read."""
         if self._driver is not None:
-            peers = PeerClient(self._driver).heartbeat(self.executor_id)
+            # piggyback the latest resource sample (None while the
+            # sampler is disabled or hasn't ticked — the wire shape is
+            # then exactly the legacy beat)
+            from spark_rapids_tpu.utils.telemetry import TELEMETRY
+            peers = PeerClient(self._driver).heartbeat(
+                self.executor_id, telemetry=TELEMETRY.latest())
         elif self.registry is not None:
             peers = dict(self.registry.peers(workers_only=True))
         else:

@@ -33,6 +33,7 @@ from typing import Callable, Iterable, Iterator, Optional
 from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
 from spark_rapids_tpu.testing.chaos import CHAOS
 from spark_rapids_tpu.utils.cancel import cancellable_wait
+from spark_rapids_tpu.utils.telemetry import PIPELINE_INFLIGHT
 
 _SENTINEL = object()
 
@@ -70,6 +71,9 @@ class _Pipe:
                 return False
             self._items.append((item, nbytes, produce_ns))
             self._bytes += nbytes
+            # resource-plane gauge (utils/telemetry.py): hand-off bytes
+            # parked between producer and consumer, one add per item
+            PIPELINE_INFLIGHT.add(nbytes)
             self._cv.notify_all()
             return True
 
@@ -93,6 +97,7 @@ class _Pipe:
             if self._items:
                 item, nbytes, produce_ns = self._items.pop(0)
                 self._bytes -= nbytes
+                PIPELINE_INFLIGHT.add(-nbytes)
                 self._cv.notify_all()
                 return item, produce_ns, waited
             if self._error is not None:
@@ -102,6 +107,11 @@ class _Pipe:
     def close(self) -> None:
         with self._cv:
             self._closed = True
+            # an abandoned stream's parked bytes leave flight here (the
+            # producer's post-close put() never adds to the gauge)
+            PIPELINE_INFLIGHT.add(-self._bytes)
+            self._bytes = 0
+            self._items.clear()
             self._cv.notify_all()
 
 

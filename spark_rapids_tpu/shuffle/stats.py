@@ -247,14 +247,44 @@ class Histogram:
     def snapshot(self) -> dict:
         # ONE critical section: count/sum/max and the percentiles must
         # come from the same sample set, or a concurrent record() tears
-        # the snapshot (count=N over N-1-sample percentiles)
+        # the snapshot (count=N over N-1-sample percentiles).  The raw
+        # bucket counts ride along so remote snapshots can be merged
+        # bucket-wise (Histogram.merge) and rendered as native
+        # Prometheus histograms (tools/metrics_scrape.py).
         with self._lock:
             return {"count": self.count,
                     "sum_s": round(self.sum_s, 6),
                     "max_s": round(self.max_s, 6),
                     "p50": round(self._percentile_locked(0.50), 6),
                     "p90": round(self._percentile_locked(0.90), 6),
-                    "p99": round(self._percentile_locked(0.99), 6)}
+                    "p99": round(self._percentile_locked(0.99), 6),
+                    "counts": list(self._counts)}
+
+    def merge(self, other) -> "Histogram":
+        """Fold another histogram (or a wire SNAPSHOT of one) into this
+        one bucket-wise: the driver aggregates rank-local latency
+        histograms into cluster stats instead of reporting only its own.
+        Requires the same bucket layout (every histogram in the fleet is
+        built with the defaults); count/sum/max reconcile as sums/max."""
+        if isinstance(other, Histogram):
+            other = other.snapshot()
+        counts = other.get("counts")
+        if counts is None:
+            raise ValueError(
+                "Histogram.merge needs a snapshot with bucket counts "
+                "(a pre-merge-era peer sent a percentile-only snapshot)")
+        with self._lock:
+            if len(counts) != len(self._counts):
+                raise ValueError(
+                    f"bucket layout mismatch: {len(counts)} buckets vs "
+                    f"{len(self._counts)} (histograms must share "
+                    "lowest_s/n_buckets to merge)")
+            for i, c in enumerate(counts):
+                self._counts[i] += int(c)
+            self.count += int(other["count"])
+            self.sum_s += float(other["sum_s"])
+            self.max_s = max(self.max_s, float(other["max_s"]))
+        return self
 
     def reset(self) -> None:
         with self._lock:

@@ -87,6 +87,10 @@ class CancelToken:
             self.reason = reason
             self._event.set()
             cleanups, self._cleanups = self._cleanups, []
+        # flight-recorder event (utils/telemetry.py): cancels belong on
+        # the post-mortem timeline beside spills and OOM retries
+        from spark_rapids_tpu.utils.telemetry import record_event
+        record_event("cancel", label=self.label, reason=reason)
         for fn in cleanups:
             try:
                 fn()
@@ -301,6 +305,13 @@ class CancelRegistry:
     def active(self, key) -> int:
         with self._lock:
             return len(self._tokens.get(key, ()))
+
+    def active_ids(self) -> List[object]:
+        """Every query id with a live registered token — the flight
+        recorder stamps post-mortems with these so an artifact
+        correlates with the PR 13 trace exports."""
+        with self._lock:
+            return list(self._tokens)
 
 
 CANCELS = CancelRegistry()

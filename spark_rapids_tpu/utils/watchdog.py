@@ -175,18 +175,49 @@ class Watchdog:
         for rec in fresh:
             snap = rec.snapshot(now)
             flagged.append(snap)
+            # the LATEST resource sample rides the report beside the
+            # named span: a stall report alone then answers "wedged on
+            # memory or on admission" (arena used/pinned, queue depth,
+            # semaphore occupancy — utils/telemetry.py).  The RING's
+            # last sample is preferred over a fresh sample_now(): a
+            # fresh read takes per-handle/data-plane locks, and the
+            # very thread being reported may be wedged HOLDING one —
+            # the watchdog must never block behind the stall it exists
+            # to report.  Fresh sampling is the fallback only when no
+            # ring sample exists (sampler disabled).
+            resource = None
+            try:
+                from spark_rapids_tpu.utils.telemetry import (
+                    TELEMETRY, sample_now)
+                resource = TELEMETRY.latest()
+                if resource is None:
+                    resource = sample_now()
+            except Exception:  # noqa: BLE001
+                import logging
+                logging.getLogger(__name__).warning(
+                    "stall-report resource sample failed", exc_info=True)
             report = {"stalled": snap, "all_waits": all_waits,
                       "stall_seconds": stall,
-                      "cancel_on_stall": cancel_on_stall}
+                      "cancel_on_stall": cancel_on_stall,
+                      "resource_sample": resource}
             with self._lock:
                 self.last_report = report
             from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
             SHUFFLE_COUNTERS.add(watchdog_stalls=1)
-            # crashdump bundles the thread stacks (the lock-holder view)
-            # alongside the registered waits; a disabled dump dir keeps
-            # the in-memory last_report only
-            from spark_rapids_tpu.utils import crashdump
-            crashdump.dump_now("watchdog_stall", extra=report)
+            # the flight recorder (utils/telemetry.py) bundles the
+            # stall report with the telemetry ring, the recent-events
+            # log and the active query ids, and dumps the post-mortem
+            # through utils/crashdump (thread stacks included); a
+            # disabled dump dir keeps the in-memory artifacts only.
+            # The sample taken above is REUSED (sample=), and dropped
+            # from the extra copy — one gauge sweep, one embed.
+            from spark_rapids_tpu.utils.telemetry import TELEMETRY
+            TELEMETRY.flight_record(
+                "watchdog_stall",
+                query_ids=[rec.query_id] if rec.query_id else None,
+                extra={k: v for k, v in report.items()
+                       if k != "resource_sample"},
+                sample=resource)
             if cancel_on_stall and rec.token is not None:
                 rec.token.cancel(
                     f"watchdog: stalled {snap['waiting_s']:.1f}s at "

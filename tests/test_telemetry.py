@@ -1,0 +1,487 @@
+"""Continuous resource-plane telemetry (utils/telemetry.py +
+tools/metrics_scrape.py).
+
+Covers the PR-14 acceptance surface:
+  * the sampler: every emitted gauge name is registered, the ring is
+    bounded, and the spill-store gauges track device/pinned/host bytes;
+  * ``Histogram.merge`` (satellite): bucket-wise sum with
+    count/sum/max reconciliation, snapshot-form merges, layout guard;
+  * cluster collection: a 2-rank protocol run piggybacks samples on
+    the heartbeat, the driver serves the `metrics` wire op, and
+    ``tools/metrics_scrape.py`` renders well-formed Prometheus text
+    (validated by a parser here) with per-rank arena and queue-depth
+    series — legacy peers without telemetry stay compatible (pinned);
+  * flight-recorder post-mortems: injected OOM-retry exhaustion and a
+    seeded ``serving.runner.stall`` each produce a LOADABLE dump
+    carrying the ring, the event log and the active query id; watchdog
+    stall reports embed the latest resource sample;
+  * the scrape tool refuses unregistered metric names.
+"""
+import gzip
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.memory.tenant import TENANTS
+from spark_rapids_tpu.shuffle.stats import (
+    HISTOGRAMS, Histogram, reset_shuffle_counters)
+from spark_rapids_tpu.testing.chaos import CHAOS
+from spark_rapids_tpu.utils import crashdump
+from spark_rapids_tpu.utils import obs
+from spark_rapids_tpu.utils.telemetry import (
+    FETCH_INFLIGHT, PIPELINE_INFLIGHT, TELEMETRY, registered_metrics,
+    sample_now)
+from spark_rapids_tpu.utils.watchdog import WATCHDOG
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    CHAOS.clear()
+    reset_shuffle_counters()
+    TELEMETRY.reset()
+    WATCHDOG.configure(0.0, False)
+    WATCHDOG.reset()
+    yield
+    CHAOS.clear()
+    TELEMETRY.reset()
+    WATCHDOG.configure(0.0, False)
+    WATCHDOG.reset()
+    crashdump.install("")
+
+
+def _batch(n=64):
+    import jax.numpy as jnp
+    data = jnp.arange(n, dtype=jnp.int64)
+    valid = jnp.ones((n,), dtype=jnp.bool_)
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    col = DeviceColumn(data=data, validity=valid, dtype=T.LONG)
+    return ColumnarBatch((col,), jnp.asarray(n, jnp.int32),
+                         Schema.of(v=T.LONG))
+
+
+# -- sampler + registry -------------------------------------------------------
+
+def test_sample_emits_only_registered_names_and_ring_is_bounded():
+    reg = registered_metrics()
+    s = sample_now()
+    assert s["t"] > 0
+    unregistered = [k for k in s["gauges"] if reg.get(k) != "gauge"]
+    assert not unregistered, unregistered
+    bad_counters = [k for k in s["counters"] if reg.get(k) != "counter"]
+    assert not bad_counters, bad_counters
+    bad_hists = [k for k in s["histograms"]
+                 if reg.get(k) != "histogram"]
+    assert not bad_hists, bad_hists
+    # tenant gauge names are registered too (the scrape tool emits them)
+    assert reg.get("tenant_used_bytes") == "gauge"
+    assert reg.get("tenant_peak_bytes") == "gauge"
+    # ring bound: ringSeconds/intervalMs samples, oldest dropped
+    TELEMETRY.configure(True, interval_ms=100, ring_seconds=1)
+    for _ in range(25):
+        TELEMETRY.sample()
+    assert len(TELEMETRY.ring()) == 10
+    TELEMETRY.configure(False)
+
+
+def test_spill_store_gauges_track_device_pinned_and_host_bytes():
+    from spark_rapids_tpu.memory.spill import make_spillable
+    h = make_spillable(_batch())
+    try:
+        g = sample_now()["gauges"]
+        assert g["spill_handles"] >= 1
+        assert g["spill_device_resident_bytes"] >= h.size_bytes
+        base_pinned = g["spill_pinned_bytes"]
+        batch = h.materialize()     # pin: unspillable residency
+        assert batch is not None
+        g = sample_now()["gauges"]
+        assert g["spill_pinned_bytes"] >= base_pinned + h.size_bytes
+        h.unpin()
+        freed = h.spill_to_host()
+        assert freed == h.size_bytes
+        g = sample_now()["gauges"]
+        assert g["spill_host_bytes"] > 0
+        # the spill left a flight-recorder event
+        kinds = [e["kind"] for e in TELEMETRY.events()]
+        assert "spill" in kinds
+        # and the cumulative spill counter rides the sample
+        assert sample_now()["counters"]["spill_to_host_bytes"] >= freed
+    finally:
+        h.close()
+
+
+def test_semaphore_and_admission_gauges_reflect_occupancy():
+    from spark_rapids_tpu.memory.semaphore import tpu_semaphore
+    occ = tpu_semaphore().occupancy()
+    assert occ["semaphore_slots_total"] >= 1
+    assert occ["semaphore_slots_in_use"] == 0
+    from spark_rapids_tpu.serving import QueryQueue
+    running = threading.Event()
+    release = threading.Event()
+
+    def runner(plan, ctx):
+        running.set()
+        release.wait(30)
+        return ["ok"]
+
+    q = QueryQueue(runner, conf={
+        "spark.rapids.serving.maxConcurrentQueries": "2",
+        "spark.rapids.serving.cache.enabled": "false"})
+    fut = q.submit_async({"p": 1})
+    assert running.wait(20)
+    try:
+        g = sample_now()["gauges"]
+        assert g["admission_slots_total"] >= 2
+        assert g["admission_slots_in_use"] >= 1
+    finally:
+        release.set()
+        assert fut.result(timeout=30) == ["ok"]
+    g = sample_now()["gauges"]
+    assert g["admission_slots_in_use"] == 0
+    # an admission event landed in the flight-recorder log
+    assert any(e["kind"] == "admission" for e in TELEMETRY.events())
+    q.close()
+
+
+def test_pipeline_inflight_gauge_returns_to_base():
+    from spark_rapids_tpu.shuffle.pipeline import pipelined
+    base = PIPELINE_INFLIGHT.value()
+    items = [b"x" * 100 for _ in range(8)]
+    out = list(pipelined(items, len, max_inflight_bytes=250))
+    assert len(out) == 8
+    assert PIPELINE_INFLIGHT.value() == base
+    # abandoned consumer: parked bytes still leave the gauge
+    gen = pipelined([b"y" * 50 for _ in range(4)], len,
+                    max_inflight_bytes=1000)
+    next(gen)
+    gen.close()
+    deadline = time.monotonic() + 10
+    while PIPELINE_INFLIGHT.value() != base and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert PIPELINE_INFLIGHT.value() == base
+
+
+def test_timeline_summary_peaks_and_spill_delta():
+    TELEMETRY.configure(False, interval_ms=100, ring_seconds=60)
+    TELEMETRY.reset_ring()
+    from spark_rapids_tpu.memory.spill import make_spillable
+    TELEMETRY.sample()
+    h = make_spillable(_batch(256))
+    try:
+        TELEMETRY.sample()
+        h.spill_to_host()
+        TELEMETRY.sample()
+        tl = TELEMETRY.timeline_summary()
+        assert tl["samples"] == 3
+        assert tl["peak_arena_used_bytes"] >= h.size_bytes
+        assert tl["total_spill_bytes"] >= h.size_bytes
+    finally:
+        h.close()
+
+
+# -- Histogram.merge (satellite) ----------------------------------------------
+
+def test_histogram_merge_bucketwise_sum_and_reconciliation():
+    a, b = Histogram(), Histogram()
+    for v in (0.001, 0.004, 0.1, 2.0):
+        a.record(v)
+    for v in (0.001, 0.05):
+        b.record(v)
+    sa, sb = a.snapshot(), b.snapshot()
+    merged = Histogram().merge(a).merge(sb)   # instance AND snapshot
+    sm = merged.snapshot()
+    # bucket-wise sum pinned exactly
+    assert sm["counts"] == [x + y for x, y in
+                            zip(sa["counts"], sb["counts"])]
+    # count/sum/max reconcile
+    assert sm["count"] == sa["count"] + sb["count"] == 6
+    assert sm["sum_s"] == pytest.approx(sa["sum_s"] + sb["sum_s"])
+    assert sm["max_s"] == pytest.approx(max(sa["max_s"], sb["max_s"]))
+    # percentiles stay conservative and bounded by the merged max
+    assert 0 < sm["p50"] <= sm["p99"] <= sm["max_s"]
+    # layout guard: a different bucketing refuses to merge
+    with pytest.raises(ValueError, match="bucket layout"):
+        Histogram(n_buckets=4).merge(a)
+    # pre-merge-era snapshot (no counts) refuses loudly
+    with pytest.raises(ValueError, match="bucket counts"):
+        Histogram().merge({"count": 1, "sum_s": 1.0, "max_s": 1.0})
+
+
+# -- cluster collection + Prometheus rendering (ACCEPTANCE) -------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"            # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""  # first label
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+    r" -?[0-9.e+-]+(\.[0-9]+)?$")
+
+
+def _validate_prometheus(text):
+    """Minimal text-exposition parser: every non-comment line is
+    name{labels} value; every series is TYPEd; histogram buckets are
+    cumulative and end at +Inf == _count."""
+    typed = {}
+    series = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("gauge", "counter", "histogram"), line
+            typed[name] = kind
+            continue
+        m = _PROM_LINE.match(line.replace('le="+Inf"', 'le="Inf"'))
+        assert m, f"malformed exposition line: {line!r}"
+        series.append(line)
+    assert typed and series
+    # every sample line's base name is TYPEd
+    for line in series:
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in typed or base in typed, f"untyped series {name}"
+    # histogram buckets cumulative, +Inf equals _count
+    for name, kind in typed.items():
+        if kind != "histogram":
+            continue
+        buckets = [ln for ln in series
+                   if ln.startswith(f"{name}_bucket")]
+        vals = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+        assert vals == sorted(vals), f"{name} buckets not cumulative"
+        count = next(int(ln.rsplit(" ", 1)[1]) for ln in series
+                     if ln.startswith(f"{name}_count"))
+        assert vals[-1] == count
+    return typed, series
+
+
+def test_two_rank_scrape_renders_prometheus_with_per_rank_series():
+    """ACCEPTANCE: a 2-rank cluster's heartbeats piggyback telemetry
+    samples, the driver's `metrics` op serves per-rank rings, and the
+    scrape tool yields well-formed Prometheus text with per-rank arena
+    and queue-depth series."""
+    from spark_rapids_tpu.shuffle.net import PeerClient, ShuffleExecutor
+    from tools.metrics_scrape import render
+    TELEMETRY.configure(True, interval_ms=50, ring_seconds=5)
+    TELEMETRY.sample()
+    HISTOGRAMS["serving_submit_s"].record(0.05)
+    TELEMETRY.sample()
+    driver = ShuffleExecutor("driver", serve_registry=True,
+                             role="driver")
+    w1 = w2 = None
+    try:
+        w1 = ShuffleExecutor("w1", driver_addr=driver.server.addr)
+        w2 = ShuffleExecutor("w2", driver_addr=driver.server.addr)
+        w1.heartbeat()
+        w2.heartbeat()
+        payload = PeerClient(driver.server.addr).metrics()
+        assert set(payload["ranks"]) == {"w1", "w2"}
+        assert payload["local"]["sample"]["gauges"][
+            "arena_used_bytes"] >= 0
+        text = render(payload)
+        typed, series = _validate_prometheus(text)
+        for rank in ("driver", "w1", "w2"):
+            assert any(
+                ln.startswith("spark_rapids_arena_used_bytes")
+                and f'rank="{rank}"' in ln for ln in series), rank
+            assert any(
+                ln.startswith("spark_rapids_admission_queue_depth")
+                and f'rank="{rank}"' in ln for ln in series), rank
+        # the latency histogram renders as a native prometheus
+        # histogram, cluster-aggregated
+        assert typed.get("spark_rapids_serving_submit_s") == "histogram"
+        assert any(ln.startswith("spark_rapids_serving_submit_s_bucket")
+                   for ln in series)
+    finally:
+        for w in (w1, w2):
+            if w is not None:
+                w.close()
+        driver.close()
+    TELEMETRY.configure(False)
+
+
+def test_legacy_heartbeat_without_telemetry_stays_compatible():
+    """PINNED: a legacy peer's heartbeat (no telemetry field) keeps its
+    exact semantics — liveness refreshes, peers are served, and the
+    driver simply has no ring for it."""
+    from spark_rapids_tpu.shuffle.net import ShuffleExecutor, _request
+    driver = ShuffleExecutor("driver", serve_registry=True,
+                             role="driver")
+    try:
+        _request(driver.server.addr,
+                 {"op": "register", "executor_id": "legacy",
+                  "host": "127.0.0.1", "port": 1234, "role": "worker"})
+        h, _ = _request(driver.server.addr,
+                        {"op": "heartbeat", "executor_id": "legacy"})
+        assert "legacy" in h["peers"]
+        assert driver.registry.rank_rings() == {}
+        # a telemetry-bearing beat lands beside it without disturbing
+        # the legacy peer
+        h2, _ = _request(driver.server.addr,
+                         {"op": "heartbeat", "executor_id": "legacy",
+                          "telemetry": {"t": 1.0, "gauges": {}}})
+        assert "legacy" in h2["peers"]
+        assert list(driver.registry.rank_rings()) == ["legacy"]
+    finally:
+        driver.close()
+
+
+def test_rank_rings_dropped_on_leave_and_exclude():
+    """REGRESSION (review): a departed/excluded rank's last sample must
+    not read as live capacity — its ring is dropped on leave/exclude,
+    rank_rings() serves only peers inside the heartbeat window, and a
+    stray beat from an unregistered id cannot mint a ring."""
+    from spark_rapids_tpu.shuffle.net import HeartbeatRegistry
+    reg = HeartbeatRegistry(timeout_s=60.0)
+    for eid in ("w1", "w2", "w3"):
+        reg.register(eid, "127.0.0.1", 1, role="worker")
+        reg.heartbeat(eid, telemetry={"t": 1.0, "gauges": {}})
+    assert set(reg.rank_rings()) == {"w1", "w2", "w3"}
+    reg.leave("w1")
+    reg.exclude("w2")
+    assert set(reg.rank_rings()) == {"w3"}
+    # beats from the departed ids do not resurrect their series
+    reg.heartbeat("w1", telemetry={"t": 2.0, "gauges": {}})
+    reg.heartbeat("ghost", telemetry={"t": 2.0, "gauges": {}})
+    assert set(reg.rank_rings()) == {"w3"}
+    # a peer past the liveness window stops reporting (ring retained
+    # only while the rank is live)
+    reg.timeout_s = 0.0
+    assert reg.rank_rings() == {}
+
+
+def test_scrape_refuses_unregistered_metric_names():
+    from tools.metrics_scrape import render
+    s = sample_now()
+    s["gauges"]["totally_made_up_gauge"] = 1
+    with pytest.raises(ValueError, match="unregistered metric"):
+        render({"local": {"sample": s}})
+
+
+# -- flight recorder (ACCEPTANCE) ---------------------------------------------
+
+def _load_dump(path):
+    with gzip.open(path, "rb") as f:
+        return json.loads(f.read().decode("utf-8"))
+
+
+def test_oom_retry_exhaustion_dumps_postmortem_naming_query(tmp_path):
+    """ACCEPTANCE: injected OOM-retry exhaustion produces a loadable
+    post-mortem artifact carrying the ring, the event log (with the
+    oom_retry events) and the active query id."""
+    from spark_rapids_tpu.memory import retry as retry_mod
+    from spark_rapids_tpu.memory.arena import TpuRetryOOM, device_arena
+    crashdump.install(str(tmp_path), context={"executor_id": "t"})
+    TELEMETRY.configure(True, interval_ms=50, ring_seconds=5)
+    TELEMETRY.sample()
+    device_arena().inject_ooms(retry_mod.MAX_RETRIES + 1)
+    try:
+        with obs.trace_scope(obs.QueryTrace("oomq")):
+            with pytest.raises(TpuRetryOOM):
+                retry_mod.with_retry_no_split(lambda: 1)
+    finally:
+        device_arena().clear_injection()
+        TELEMETRY.configure(False)
+    pm = TELEMETRY.last_postmortem
+    assert pm is not None
+    assert pm["reason"] == "oom_retry_exhausted"
+    assert "oomq" in pm["active_query_ids"]
+    assert pm["ring"], "post-mortem must carry the telemetry ring"
+    assert any(e["kind"] == "oom_retry" for e in pm["events"])
+    # the artifact on disk loads and names the same query
+    path = pm.get("dump_path")
+    assert path, "crashdump path missing from the post-mortem"
+    bundle = _load_dump(path)
+    assert bundle["reason"] == "flight_recorder:oom_retry_exhausted"
+    assert "oomq" in bundle["extra"]["active_query_ids"]
+    assert bundle["extra"]["ring"]
+
+
+def test_watchdog_stall_dumps_postmortem_with_resource_sample(tmp_path):
+    """ACCEPTANCE + satellite: a seeded serving.runner.stall is flagged
+    by the real watchdog; the stall report embeds the latest resource
+    sample (arena/pinned/queue-depth/semaphore) beside the named span,
+    and the flight-recorder post-mortem on disk names the query id."""
+    from spark_rapids_tpu.serving import QueryQueue
+    from spark_rapids_tpu.utils.cancel import QueryCancelled
+    crashdump.install(str(tmp_path), context={"executor_id": "t"})
+    TELEMETRY.configure(True, interval_ms=50, ring_seconds=5)
+    TELEMETRY.sample()
+    WATCHDOG.configure(0.3, cancel_on_stall=True)
+    CHAOS.install("serving.runner.stall", count=1, seconds=60.0)
+    q = QueryQueue(lambda plan, ctx: ["ok"], conf={
+        "spark.rapids.serving.maxConcurrentQueries": "1",
+        "spark.rapids.serving.cache.enabled": "false",
+        "spark.rapids.trace.enabled": "true"})
+    try:
+        with pytest.raises(QueryCancelled, match="watchdog"):
+            q.submit({"p": "wedged"}, cacheable=False,
+                     query_id="stallq")
+        rep = WATCHDOG.last_report
+        assert rep["stalled"]["site"] == "serving.runner.stall"
+        rs = rep["resource_sample"]
+        assert rs is not None
+        for key in ("arena_used_bytes", "spill_pinned_bytes",
+                    "admission_queue_depth", "semaphore_slots_in_use"):
+            assert key in rs["gauges"], key
+        pm = TELEMETRY.last_postmortem
+        assert pm["reason"] == "watchdog_stall"
+        assert "stallq" in pm["active_query_ids"]
+        assert pm["ring"] and pm["events"] is not None
+        bundle = _load_dump(pm["dump_path"])
+        assert bundle["reason"] == "flight_recorder:watchdog_stall"
+        assert "stallq" in bundle["extra"]["active_query_ids"]
+        assert bundle["extra"]["extra"]["stalled"]["site"] == \
+            "serving.runner.stall"
+    finally:
+        q.close()
+        TELEMETRY.configure(False)
+
+
+def test_serving_submission_registers_in_cancels_for_flight_recorder():
+    """REGRESSION (verify drive): with tracing OFF a serving query's id
+    reached neither the ambient trace nor CANCELS, so a mid-flight
+    post-mortem was stamped with NO query id.  Submissions now register
+    their token in the process-wide CANCELS registry for exactly their
+    flight, so flight_record() sees them regardless of tracing."""
+    from spark_rapids_tpu.serving import QueryQueue
+    from spark_rapids_tpu.utils.cancel import CANCELS
+    seen = []
+
+    def runner(plan, ctx):
+        pm = TELEMETRY.flight_record("unit_mid_flight")
+        seen.append(pm["active_query_ids"])
+        return ["ok"]
+
+    q = QueryQueue(runner, conf={
+        "spark.rapids.serving.cache.enabled": "false"})
+    assert q.submit({"p": 1}, query_id="fr1") == ["ok"]
+    assert seen and "fr1" in seen[0]
+    # unregistered once the submission resolves
+    assert "fr1" not in [str(k) for k in CANCELS.active_ids()]
+    q.close()
+
+
+def test_executor_loss_triggers_flight_record():
+    from spark_rapids_tpu.cluster.driver import (
+        ExecutorLostError, TpuClusterDriver)
+    TELEMETRY.configure(True, interval_ms=50, ring_seconds=5)
+    TELEMETRY.sample()
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=5.0)
+    try:
+        driver._recover_lost(ExecutorLostError(
+            "lost", query_id=7, lost=["w9"]))
+        pm = TELEMETRY.last_postmortem
+        assert pm is not None
+        assert pm["reason"] == "executor_loss"
+        assert "7" in pm["active_query_ids"]
+        assert pm["extra"]["lost"] == ["w9"]
+    finally:
+        driver.close()
+        TELEMETRY.configure(False)

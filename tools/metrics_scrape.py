@@ -1,0 +1,157 @@
+"""Render cluster resource-plane telemetry as Prometheus text exposition.
+
+Scrapes the `metrics` wire op of a shuffle block server (the DRIVER's,
+for the cluster view: its reply carries the driver's own sample plus
+the per-rank rings executors piggyback on their heartbeats) and renders
+one text-exposition document a standard Prometheus scraper — and later
+the autoscaler (ROADMAP item 5) — consumes:
+
+  * gauges and counters per rank, labeled ``rank="driver"`` /
+    ``rank="<executor_id>"`` (tenant series additionally labeled
+    ``tenant="<name>"``);
+  * the PR 13 fixed-bucket latency ``Histogram``s as native Prometheus
+    histograms, CLUSTER-AGGREGATED bucket-wise across ranks via
+    ``Histogram.merge``.
+
+Every name is validated against the metric registry
+(utils/telemetry.py, rendered as docs/metrics.md): an unregistered
+name REFUSES to render — the same no-silent-drift discipline as
+configs.md.
+
+Run:
+    python tools/metrics_scrape.py HOST:PORT          # exposition text
+    python tools/metrics_scrape.py HOST:PORT --json   # raw payload
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PREFIX = "spark_rapids_"
+
+
+def fetch(addr: Tuple[str, int]) -> dict:
+    """One `metrics` round-trip against a block server."""
+    from spark_rapids_tpu.shuffle.net import PeerClient
+    return PeerClient(tuple(addr)).metrics()
+
+
+def _labels(**kv) -> str:
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(kv.items()))
+    return "{" + inner + "}"
+
+
+def _num(v) -> str:
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, float):
+        return repr(round(v, 9))
+    return str(int(v))
+
+
+def render(payload: dict) -> str:
+    """Prometheus text exposition over one `metrics` payload.  Raises
+    ``ValueError`` on any metric name absent from the registry —
+    register it in utils/telemetry.py and regenerate docs/metrics.md."""
+    from spark_rapids_tpu.shuffle.stats import Histogram
+    from spark_rapids_tpu.utils.telemetry import registered_metrics
+    registry = registered_metrics()
+
+    def require(name: str, want_kind: str) -> None:
+        kind = registry.get(name)
+        if kind is None:
+            raise ValueError(
+                f"unregistered metric name {name!r}: register it in "
+                "utils/telemetry.py and regenerate docs/metrics.md "
+                "(python tools/generate_docs.py)")
+        if kind != want_kind:
+            raise ValueError(
+                f"metric {name!r} is registered as a {kind}, rendered "
+                f"as a {want_kind}")
+
+    series: Dict[str, List[Tuple[str, object]]] = {}
+    kinds: Dict[str, str] = {}
+    merged_hists: Dict[str, Histogram] = {}
+
+    def add(name: str, kind: str, labels: str, value) -> None:
+        require(name, kind)
+        kinds[name] = kind
+        series.setdefault(name, []).append((labels, value))
+
+    def take_sample(rank: str, sample: dict) -> None:
+        lb = _labels(rank=rank)
+        for name in sorted(sample.get("gauges") or {}):
+            add(name, "gauge", lb, sample["gauges"][name])
+        for name in sorted(sample.get("counters") or {}):
+            add(name, "counter", lb, sample["counters"][name])
+        for tenant in sorted(sample.get("tenants") or {}):
+            tl = _labels(rank=rank, tenant=tenant)
+            tg = sample["tenants"][tenant]
+            add("tenant_used_bytes", "gauge", tl, tg["used_bytes"])
+            add("tenant_peak_bytes", "gauge", tl, tg["peak_bytes"])
+        for name in sorted(sample.get("histograms") or {}):
+            require(name, "histogram")
+            snap = sample["histograms"][name]
+            if snap.get("counts") is None:
+                continue    # pre-merge-era peer: percentile-only snap
+            merged_hists.setdefault(name, Histogram()).merge(snap)
+
+    local = (payload.get("local") or {}).get("sample")
+    if local:
+        take_sample("driver", local)
+    for eid in sorted(payload.get("ranks") or {}):
+        ring = payload["ranks"][eid]
+        if ring:
+            take_sample(eid, ring[-1])   # the scrape reads the LATEST
+
+    lines: List[str] = []
+    for name in sorted(series):
+        full = PREFIX + name
+        lines.append(f"# HELP {full} see docs/metrics.md")
+        lines.append(f"# TYPE {full} {kinds[name]}")
+        for labels, value in series[name]:
+            lines.append(f"{full}{labels} {_num(value)}")
+    for name in sorted(merged_hists):
+        h = merged_hists[name]
+        snap = h.snapshot()
+        full = PREFIX + name
+        lines.append(f"# HELP {full} see docs/metrics.md "
+                     f"(cluster-aggregated across ranks)")
+        lines.append(f"# TYPE {full} histogram")
+        cum = 0
+        for bound, c in zip(h.bounds, snap["counts"]):
+            cum += c
+            lines.append(
+                f"{full}_bucket{_labels(le=repr(round(bound, 9)))} {cum}")
+        lines.append(f"{full}_bucket{_labels(le='+Inf')} "
+                     f"{snap['count']}")
+        lines.append(f"{full}_sum {_num(snap['sum_s'])}")
+        lines.append(f"{full}_count {snap['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("addr", help="HOST:PORT of a shuffle block server "
+                                 "(the driver's for the cluster view)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw metrics payload instead of "
+                         "Prometheus text")
+    args = ap.parse_args(argv)
+    host, _, port = args.addr.rpartition(":")
+    payload = fetch((host or "127.0.0.1", int(port)))
+    if args.json:
+        print(json.dumps(payload, indent=1, sort_keys=True))
+    else:
+        sys.stdout.write(render(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

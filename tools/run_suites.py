@@ -55,11 +55,14 @@ SUITES = {
     # range-view store it was built to validate
     "profile": (["tests/test_prog_profile.py",
                  "tests/test_range_views.py"], 900),
-    # query-scoped observability plane: trace context + counter
+    # observability: the query-scoped plane (trace context + counter
     # attribution, cross-process span round-trip, EXPLAIN ANALYZE,
-    # Perfetto export, latency histograms (utils/obs.py + trace_export)
+    # Perfetto export, latency histograms — utils/obs.py) AND the
+    # continuous resource plane (sampler ring, heartbeat piggyback,
+    # Prometheus scrape, flight-recorder post-mortems — utils/telemetry)
     "observability": (["tests/test_obs.py",
-                       "tests/test_prog_profile.py"], 900),
+                       "tests/test_prog_profile.py",
+                       "tests/test_telemetry.py"], 900),
     "lint": (["tests/test_lint.py", "tests/test_ambient.py"], 300),
 }
 

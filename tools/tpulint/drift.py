@@ -47,7 +47,31 @@ def check(repo_root: str, sources=None) -> List[Violation]:
     out.extend(_check_api_surface(repo_root))
     out.extend(_check_lint_doc(repo_root))
     out.extend(_check_trace_ranges(repo_root, sources))
+    out.extend(_check_metrics_doc(repo_root))
     return out
+
+
+def _check_metrics_doc(repo_root: str) -> List[Violation]:
+    """Metric-name registry drift (utils/telemetry.py): docs/metrics.md
+    must byte-match ``telemetry.generate_metrics_doc()`` — the same
+    docs-from-code contract as trace_ranges.md.  The scrape tool
+    (tools/metrics_scrape.py) independently refuses to RENDER a name
+    absent from the registry, so a series can neither appear
+    undocumented nor survive a rename silently."""
+    from spark_rapids_tpu.utils.telemetry import generate_metrics_doc
+    rel = "docs/metrics.md"
+    path = os.path.join(repo_root, rel)
+    want = generate_metrics_doc()
+    have = None
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            have = f.read()
+    if have != want:
+        return [Violation(
+            RULE, rel, 1, "<generated>",
+            f"{rel} does not match telemetry.generate_metrics_doc(); "
+            f"run `python tools/generate_docs.py`")]
+    return []
 
 
 def _check_trace_ranges(repo_root: str,

@@ -113,6 +113,8 @@ class TpuSession:
                          self.conf.shuffle_fetch_threads,
                          self.conf.shuffle_fetch_merge_bytes,
                          self.conf.shuffle_fetch_request_bytes)
+        from spark_rapids_tpu.shuffle.serializer import set_reader_threads
+        set_reader_threads(self.conf.shuffle_reader_threads)
         if self.conf.diag_dump_dir:
             from spark_rapids_tpu.utils import crashdump
             crashdump.install(self.conf.diag_dump_dir,

@@ -303,6 +303,8 @@ def _run_task_body(task: dict, plan_bytes: bytes, conf_map: dict,
                      conf.shuffle_fetch_threads,
                      conf.shuffle_fetch_merge_bytes,
                      conf.shuffle_fetch_request_bytes)
+    from spark_rapids_tpu.shuffle.serializer import set_reader_threads
+    set_reader_threads(conf.shuffle_reader_threads)
     # serving tenancy: the QueryQueue rides the submitting tenant on the
     # per-query conf overrides; the whole task then executes under that
     # tenant's scope so its device residency charges the right budget

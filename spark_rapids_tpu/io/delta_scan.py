@@ -12,7 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.io.delta import DeltaSnapshot, partition_value_to_python
 from spark_rapids_tpu.plan.execs.base import TpuExec, timed
@@ -57,7 +58,7 @@ def read_delta_file_batch(path: str, pvals, snapshot: DeltaSnapshot,
                                                     capacity=cap))
         else:
             cols.append(batch.column(name))
-    return ColumnarBatch(tuple(cols), jnp.asarray(n, jnp.int32),
+    return ColumnarBatch(tuple(cols), host_scalar(n),
                          snapshot.schema)
 
 

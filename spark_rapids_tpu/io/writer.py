@@ -24,7 +24,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 
 HIVE_DEFAULT_PARTITION = "__HIVE_DEFAULT_PARTITION__"
 
@@ -123,8 +124,8 @@ def _partition_runs(batch: ColumnarBatch, part_idx: Sequence[int]):
         cnt = hi - lo
         cap = round_up_pow2(cnt)
         sl = gather_batch(ordered,
-                          jnp.arange(cap, dtype=jnp.int32) + jnp.int32(lo),
-                          jnp.int32(cnt), out_capacity=cap)
+                          jnp.arange(cap, dtype=jnp.int32) + host_scalar(lo),
+                          host_scalar(cnt), out_capacity=cap)
         out.append((values, sl))
     return out
 

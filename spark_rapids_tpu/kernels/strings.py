@@ -36,8 +36,10 @@ def bucket_for(max_len: int) -> int:
 
 def live_string_bucket(col: DeviceColumn, num_rows) -> int:
     """Host-side bucket for one column (forces a scalar sync)."""
-    # tpu-lint: allow-host-sync(single-column API: one scalar sync is its documented contract)
-    return bucket_for(int(max_live_string_bytes(col, num_rows)))
+    from spark_rapids_tpu.utils.sanitizer import blessed_sync
+    with blessed_sync("single-column bucket: documented scalar sync"):
+        # tpu-lint: allow-host-sync(single-column API: one scalar sync is its documented contract)
+        return bucket_for(int(max_live_string_bytes(col, num_rows)))
 
 
 def max_live_bytes_multi(pairs) -> int:
@@ -47,13 +49,15 @@ def max_live_bytes_multi(pairs) -> int:
     shared reduction behind every bucket derivation — fused segments,
     aggregate merge/combine buckets — so a future change to bucket policy
     lands in one place."""
-    vals = [max_live_string_bytes(c, n) for c, n in pairs
-            if c.is_string_like]
-    if not vals:
-        return 0
-    # tpu-lint: allow-host-sync(THE one batched sync every bucket derivation shares)
-    return int(jax.device_get(
-        jnp.max(jnp.stack([jnp.asarray(v) for v in vals]))))
+    from spark_rapids_tpu.utils.sanitizer import blessed_sync
+    with blessed_sync("bucket derivation: THE one batched sync"):
+        vals = [max_live_string_bytes(c, n) for c, n in pairs
+                if c.is_string_like]
+        if not vals:
+            return 0
+        # tpu-lint: allow-host-sync(THE one batched sync every bucket derivation shares)
+        return int(jax.device_get(
+            jnp.max(jnp.stack([jnp.asarray(v) for v in vals]))))
 
 
 def live_string_bucket_for_batch(batch, col_indices) -> int:

@@ -45,6 +45,12 @@ def initialize_memory(conf) -> None:
         set_spill_checksum
     set_leak_audit(conf.get(C.MEMORY_LEAK_AUDIT))
     set_spill_checksum(conf.spill_checksum_enabled)
+    # the runtime contract sanitizer rides the same conf snapshot as the
+    # checksum knobs (utils/sanitizer.py; SPARK_RAPIDS_TPU_SANITIZE=1
+    # forces it on regardless of the conf)
+    from spark_rapids_tpu.utils.sanitizer import configure_sanitizer
+    configure_sanitizer(conf.sanitizer_enabled,
+                        conf.sanitizer_compile_budget)
     # integrity/recovery knobs of the shuffle data plane ride the same
     # conf snapshot (both the session path and the cluster executor's
     # broadcast-conf path run through here)

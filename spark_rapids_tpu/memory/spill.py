@@ -67,6 +67,18 @@ def spill_checksum_enabled() -> bool:
     return _SPILL_CHECKSUM[0]
 
 
+#: runtime-sanitizer pin-ledger seam (utils/sanitizer.py): called with
+#: (handle, +1) on each materialize pin, (handle, -1) on unpin or
+#: ownership transfer, (handle, 0) on close.  None when the sanitizer is
+#: off -- the disabled path is one global load and a None test.
+_PIN_HOOK = None
+
+
+def set_pin_hook(fn) -> None:
+    global _PIN_HOOK
+    _PIN_HOOK = fn
+
+
 def _batch_to_host(batch: ColumnarBatch) -> Tuple[dict, Schema]:
     """Device batch -> dict of numpy arrays (full capacity, canonical).
 
@@ -252,6 +264,8 @@ class SpillableBatchHandle:
             self.last_use = time.monotonic()
             if self._device is not None:
                 self._pins += 1
+                if _PIN_HOOK is not None:
+                    _PIN_HOOK(self, +1)
                 return self._device
         self._reserve_device()  # may spill / raise TpuOOM
         with self._lock:
@@ -261,6 +275,8 @@ class SpillableBatchHandle:
             if self._device is not None:  # concurrent materialize won
                 self._release_device()
                 self._pins += 1
+                if _PIN_HOOK is not None:
+                    _PIN_HOOK(self, +1)
                 return self._device
             if self._host is None and self._disk_path is not None:
                 # tpu-lint: allow-lock-order(disk-tier IO has always run under the per-handle lock — np.load did this open internally before checksumming; the lock is handle-granular with no cross-handle order)
@@ -289,6 +305,8 @@ class SpillableBatchHandle:
             self._device = batch
             self._host = None
             self._pins += 1
+            if _PIN_HOOK is not None:
+                _PIN_HOOK(self, +1)
             self.last_use = time.monotonic()
             return batch
 
@@ -298,6 +316,8 @@ class SpillableBatchHandle:
         with self._lock:
             if self._pins > 0:
                 self._pins -= 1
+                if _PIN_HOOK is not None:
+                    _PIN_HOOK(self, -1)
 
     @contextmanager
     def borrowed(self):
@@ -315,6 +335,8 @@ class SpillableBatchHandle:
             assert self._device is batch
             self._device = None
             self.closed = True
+        if _PIN_HOOK is not None:
+            _PIN_HOOK(self, -1)   # materialize's pin is consumed with it
         self._fw._unregister(self)
         # accounting ownership passes to the caller's scope; release here
         self._release_device()
@@ -357,6 +379,8 @@ class SpillableBatchHandle:
                     pass
                 self._disk_path = None
                 self._disk_nbytes = 0
+        if _PIN_HOOK is not None:
+            _PIN_HOOK(self, 0)    # closed: device accounting released
         self._fw._unregister(self)
 
 

@@ -34,6 +34,8 @@ class TpuEngine:
             QueryCancelled, cancel_scope, current_cancel_token)
         from spark_rapids_tpu.utils.obs import (
             current_query_trace, trace_scope)
+        from spark_rapids_tpu.utils.sanitizer import (hot_section,
+                                                      query_scope)
         tenant = TENANTS.current()
         priority = current_task_priority()
         token = current_cancel_token()
@@ -56,13 +58,17 @@ class TpuEngine:
                             trace_scope(trace), task_scope():
                         try:
                             out: List[ColumnarBatch] = []
-                            for batch in plan.execute_partition(p):
-                                # batch-boundary cancellation point (the
-                                # task analog of Spark's cooperative
-                                # interruption)
-                                if token is not None:
-                                    token.check()
-                                out.append(batch)
+                            # sanitizer hot section: a task's batch loop
+                            # must dispatch device programs, never
+                            # implicitly sync (utils/sanitizer.py)
+                            with hot_section(f"task-partition[{p}]"):
+                                for batch in plan.execute_partition(p):
+                                    # batch-boundary cancellation point
+                                    # (the task analog of Spark's
+                                    # cooperative interruption)
+                                    if token is not None:
+                                        token.check()
+                                    out.append(batch)
                             return out
                         except QueryCancelled:
                             # counted INSIDE the trace scope so the
@@ -77,14 +83,18 @@ class TpuEngine:
                     sem.release_if_necessary()
 
         threads = min(nparts, max(self.conf.concurrent_tpu_tasks, 1))
-        try:
-            if threads <= 1 or nparts <= 1:
-                return [run_one(p) for p in range(nparts)]
-            with ThreadPoolExecutor(max_workers=threads) as pool:
-                return list(pool.map(run_one, range(nparts)))
-        finally:
-            self.last_metrics = self._metrics_report(plan)
-            plan.cleanup()
+        # sanitizer query scope: zero pin balance + zero tenant residue
+        # asserted at teardown (cleanup() runs INSIDE the scope -- execs
+        # release their handles there, so a leak is a real leak)
+        with query_scope("engine.execute"):
+            try:
+                if threads <= 1 or nparts <= 1:
+                    return [run_one(p) for p in range(nparts)]
+                with ThreadPoolExecutor(max_workers=threads) as pool:
+                    return list(pool.map(run_one, range(nparts)))
+            finally:
+                self.last_metrics = self._metrics_report(plan)
+                plan.cleanup()
 
     def _metrics_report(self, plan: TpuExec):
         """Per-exec metric snapshots at the configured verbosity

@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
 from spark_rapids_tpu.expressions.core import (
     EvalContext,
@@ -499,7 +500,7 @@ class _AggDeviceSpec:
                 cols.append(DeviceColumn(
                     jnp.reshape(data.astype(slot.dtype.jnp_dtype), (1,)),
                     jnp.reshape(valid, (1,)), slot.dtype))
-            return ColumnarBatch(tuple(cols), jnp.int32(1), self.partial_schema)
+            return ColumnarBatch(tuple(cols), host_scalar(1), self.partial_schema)
 
         # grouped: pack keys + inputs into a work batch, sort-group, reduce
         work_cols = list(key_cols)
@@ -652,7 +653,7 @@ class _AggDeviceSpec:
                 cols.append(DeviceColumn(
                     jnp.reshape(data.astype(slot.dtype.jnp_dtype), (1,)),
                     jnp.reshape(valid, (1,)), slot.dtype))
-            return ColumnarBatch(tuple(cols), jnp.int32(1), self.partial_schema)
+            return ColumnarBatch(tuple(cols), host_scalar(1), self.partial_schema)
         layout = G.group_rows(partial, list(range(nkeys)),
                               string_max_bytes=string_bucket)
         out_keys = G.group_keys_output(layout, list(range(nkeys)))
@@ -896,7 +897,7 @@ class TpuHashAggregateExec(TpuExec):
             if slot.update_op == COUNT_STAR or slot.update_op == COUNT_VALID:
                 valid = jnp.ones((1,), jnp.bool_)
             cols.append(DeviceColumn(data, valid, slot.dtype))
-        return ColumnarBatch(tuple(cols), jnp.int32(1), self.partial_schema)
+        return ColumnarBatch(tuple(cols), host_scalar(1), self.partial_schema)
 
     def _partials_for(self, idx: int) -> List[ColumnarBatch]:
         out = []

@@ -53,6 +53,17 @@ class _LaunchStats:
     profile = None
 
 
+#: runtime-sanitizer compile-budget seam (utils/sanitizer.py): called
+#: with the program key on every shared_jit cache MISS.  None when the
+#: sanitizer is off.
+_COMPILE_HOOK = None
+
+
+def set_compile_hook(fn) -> None:
+    global _COMPILE_HOOK
+    _COMPILE_HOOK = fn
+
+
 def reset_launch_stats() -> None:
     with _LaunchStats.lock:
         _LaunchStats.count = 0
@@ -145,6 +156,8 @@ def shared_jit(key: str, make_fn: Callable[[], Callable], **jit_kwargs):
             return fn
     import jax
     from spark_rapids_tpu.memory.arena import translate_device_oom
+    if _COMPILE_HOOK is not None:
+        _COMPILE_HOOK(key)   # may raise: compile budget exceeded
     # a REAL XLA RESOURCE_EXHAUSTED from any cached program enters the
     # retry/spill machinery as TpuRetryOOM (DeviceMemoryEventHandler analog)
     made = _counted(key, translate_device_oom(jax.jit(make_fn(), **jit_kwargs)))

@@ -9,7 +9,7 @@ from __future__ import annotations
 from functools import partial
 from typing import List, Optional
 
-from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, host_scalar
 from spark_rapids_tpu.columnar.column import round_up_pow2
 from spark_rapids_tpu.kernels.selection import concat_batches_device
 
@@ -92,7 +92,7 @@ def maybe_shrink(batch: ColumnarBatch,
                      if c.offsets is not None)
     key = (f"shrink|{schema_cache_key(batch.schema)}|{cap}|{bcaps}|"
            f"{target}|{out_bcaps}")
-    return shared_jit(key, lambda: shrink)(batch, jnp.int32(n))
+    return shared_jit(key, lambda: shrink)(batch, host_scalar(n))
 
 
 def retry_over_spillable(handles, body):

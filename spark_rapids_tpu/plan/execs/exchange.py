@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 from spark_rapids_tpu.columnar.column import round_up_pow2
 from spark_rapids_tpu.expressions.core import EvalContext, Expression
 from spark_rapids_tpu.kernels.partition import hash_partition, round_robin_partition
@@ -159,8 +160,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 # keep the slice dispatch (the dominant map-side cost)
                 # inside opTime, as before the fused path
                 with timed(self.op_time):
-                    rr = jnp.asarray(ordinal % self.out_partitions,
-                                     jnp.int32)
+                    rr = host_scalar(ordinal % self.out_partitions)
                     reordered, counts = with_retry_no_split(
                         lambda: self._jit_slice(batch, rr))
                 ordinal += 1
@@ -252,6 +252,7 @@ class TpuShuffleExchangeExec(TpuExec):
         with self._lock:
             if self._transport is None:
                 SHUFFLE_COUNTERS.add(exchange_stages=1)
+                # tpu-lint: allow-lock-order(once-per-epoch map materialization: the lock IS the idempotence guard; transport construction's makedirs happens once per process)
                 t = make_transport(self.mode, self.out_partitions,
                                    self.schema, self.writer_threads,
                                    self.codec)
@@ -270,6 +271,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     t.write_partitioned(self._range_views())
                 elif (t.supports_range_write and range_serialize_enabled()
                         and range_supported(self.schema)):
+                    # tpu-lint: allow-lock-order(the materialize lock deliberately covers the ONE map-side download per epoch; concurrent readers must wait for exactly this result)
                     gen = self._range_stream()
                     if pipe:
                         from spark_rapids_tpu.shuffle.pipeline import (
@@ -377,6 +379,22 @@ class TpuShuffleExchangeExec(TpuExec):
         return f"TpuShuffleExchange[{self.out_partitions}, keys=[{keys}]]"
 
 
+def _estimated_row_bytes(schema: Schema) -> int:
+    """Static per-row byte estimate for the byte-based coalesce goal:
+    fixed-width columns contribute their itemsize, variable-width ones a
+    flat 32-byte estimate (offset word + typical short payload), plus one
+    validity byte each.  An estimate is enough — the goal only has to
+    stop a WIDE schema from merging to target_rows-sized monsters."""
+    total = 0
+    for dt in schema.dtypes:
+        if dt.variable_width or dt.np_dtype is None:
+            total += 32
+        else:
+            total += int(np.dtype(dt.np_dtype).itemsize)
+        total += 1
+    return total
+
+
 class SharedCoalesceSpec:
     """ONE contiguous-partition grouping computed from the COMBINED
     materialized sizes of every exchange feeding a consumer.
@@ -388,8 +406,13 @@ class SharedCoalesceSpec:
     the right.  Greedy merge of adjacent partitions until the combined
     row count reaches the target."""
 
-    def __init__(self, target_rows: int):
+    def __init__(self, target_rows: int, target_bytes: int = 0):
         self.target_rows = max(int(target_rows), 1)
+        # byte-based coalesce goal (spark.rapids.sql.batchSizeBytes, the
+        # reference's TargetSize): converted to a row cap from the
+        # estimated schema row width once exchanges register, so a wide
+        # schema stops merging before target_rows would
+        self.target_bytes = max(int(target_bytes), 0)
         self.exchanges: List[TpuShuffleExchangeExec] = []
         self._groups: Optional[List[List[int]]] = None
         self._epoch_key: Optional[tuple] = None
@@ -434,13 +457,19 @@ class SharedCoalesceSpec:
                 stats_key = "aqe:" + "-".join(map(str, sids))
                 client.publish(stats_key, counts)
                 counts = client.fetch_global(stats_key)
+            target = self.target_rows
+            if self.target_bytes:
+                row_bytes = max(_estimated_row_bytes(
+                    self.exchanges[0].schema), 1)
+                target = min(target,
+                             max(self.target_bytes // row_bytes, 1))
             groups: List[List[int]] = []
             cur: List[int] = []
             acc = 0
             for p, n in enumerate(counts):
                 cur.append(p)
                 acc += n
-                if acc >= self.target_rows:
+                if acc >= target:
                     groups.append(cur)
                     cur = []
                     acc = 0

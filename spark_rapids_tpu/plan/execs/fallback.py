@@ -12,7 +12,8 @@ from typing import Iterator, List, Tuple
 
 import numpy as np
 
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.plan.execs.base import TpuExec, timed
 
@@ -42,7 +43,7 @@ def cpu_table_to_batch(table) -> ColumnarBatch:
         cap = max(c.capacity for c in cols)
         cols = [c if c.capacity == cap else c.with_capacity(cap) for c in cols]
     return ColumnarBatch(tuple(cols),
-                         jnp.asarray(table.num_rows, dtype=jnp.int32),
+                         host_scalar(table.num_rows),
                          table.schema)
 
 

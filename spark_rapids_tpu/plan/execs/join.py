@@ -798,6 +798,7 @@ class TpuAdaptiveJoinExec(TpuExec):
                     # complete reduce read returns the full build side)
                     from spark_rapids_tpu.shuffle.transport import (
                         make_transport)
+                    # tpu-lint: allow-lock-order(once-per-join strategy decision: the decide lock is the idempotence guard; the transport's makedirs is once per process)
                     t = make_transport("MULTIPROCESS", 1,
                                        self.children[1].schema,
                                        self.writer_threads, self.codec)

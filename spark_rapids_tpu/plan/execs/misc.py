@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
 from spark_rapids_tpu.expressions.core import EvalContext, Expression
 from spark_rapids_tpu.kernels.selection import compaction_map, gather_batch
@@ -107,12 +108,12 @@ class TpuRangeExec(TpuExec):
             def make(lo_=lo, emitted_=emitted, n_=n, cap_=cap):
                 fn = shared_jit(f"range|{cap_}",
                                 lambda: _partial(_range_kernel, cap=cap_))
-                return fn(jnp.int64(lo_ + emitted_ * step),
-                          jnp.int64(step), jnp.int32(n_))
+                return fn(host_scalar(lo_ + emitted_ * step, np.int64),
+                          host_scalar(step, np.int64), host_scalar(n_))
             with timed(self.op_time):
                 out_col, live = make()
             batch = ColumnarBatch((DeviceColumn(out_col, live, T.LONG),),
-                                  jnp.asarray(n, jnp.int32), self.schema)
+                                  host_scalar(n), self.schema)
             emitted += n
             self.output_rows.add(batch.num_rows)
             yield self._count_out(batch)

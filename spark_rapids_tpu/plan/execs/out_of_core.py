@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 from spark_rapids_tpu.columnar.column import round_up_pow2
 from spark_rapids_tpu.kernels.partition import hash_partition
 from spark_rapids_tpu.kernels.selection import gather_batch
@@ -120,7 +121,7 @@ def slice_by_counts(
         key = (f"oocslice|{schema_cache_key(reordered.schema)}|"
                f"{reordered.capacity}|{bcaps}|{cap}")
         out.append(shared_jit(key, lambda: slice_piece)(
-            reordered, jnp.int32(int(offsets[p])), jnp.int32(cnt)))
+            reordered, host_scalar(int(offsets[p])), host_scalar(cnt)))
     return out
 
 

@@ -10,7 +10,8 @@ from typing import Iterator, List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 from spark_rapids_tpu.columnar.column import round_up_pow2
 from spark_rapids_tpu.expressions.core import EvalContext, Expression
 from spark_rapids_tpu.kernels.selection import concat_batches_device, gather_batch
@@ -161,7 +162,7 @@ class TpuLimitExec(TpuExec):
                     take = remaining
                     remaining = 0
                     idx_arr = jnp.arange(batch.capacity, dtype=jnp.int32)
-                    out = gather_batch(batch, idx_arr, jnp.int32(take))
+                    out = gather_batch(batch, idx_arr, host_scalar(take))
                     self.output_rows.add(take)
                     yield self._count_out(out)
                     return

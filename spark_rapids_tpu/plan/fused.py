@@ -476,6 +476,7 @@ class TpuFusedSegmentExec(TpuExec):
                             lambda: _apply_build_chain(fold[bi], merged))
                         fold[bi] = []
                     outs.append(merged)
+                    # tpu-lint: allow-lock-order(once-per-exec build materialization: the sync sizes the memoized build batches, and every waiter needs exactly those results before proceeding)
                     mb = max(mb, _max_live_bytes(merged))
                 self._build_batches = outs
                 self._build_bytes = mb

@@ -763,17 +763,22 @@ class PlanMeta:
                 TpuCachedParquetScanExec)
             return TpuCachedParquetScanExec(p.partitions, p.schema,
                                             projection=p.projection)
+        # reader-facing row cap: spark.rapids.sql.reader.batchSizeRows can
+        # shrink scan batches below the pipeline-wide batchSizeRows without
+        # widening them (min(), so neither knob is silently ignored)
+        scan_rows = min(self.conf.batch_size_rows,
+                        self.conf.reader_batch_size_rows)
         if isinstance(p, L.ParquetRelation):
             return TpuParquetScanExec(
                 p.paths, p.schema, p.column_pruning,
-                self.conf.batch_size_rows,
+                scan_rows,
                 reader_threads=self.conf.multithreaded_read_threads,
                 conf=self.conf)
         if isinstance(p, L.FileRelation):
             from spark_rapids_tpu.plan.execs.scan import TpuFileScanExec
             return TpuFileScanExec(
                 p.paths, p.fmt, p.schema, p.column_pruning, p.options,
-                self.conf.batch_size_rows,
+                scan_rows,
                 reader_threads=self.conf.multithreaded_read_threads)
         if isinstance(p, L.DeltaRelation):
             from spark_rapids_tpu.io.delta_scan import TpuDeltaScanExec
@@ -785,7 +790,7 @@ class PlanMeta:
                 return TpuIcebergMorScanExec(p, p.schema)
             return TpuParquetScanExec(
                 [df["file_path"] for df in p.files], p.schema,
-                p.projection, self.conf.batch_size_rows,
+                p.projection, scan_rows,
                 reader_threads=self.conf.multithreaded_read_threads,
                 conf=self.conf)
         if isinstance(p, L.Project):
@@ -1233,11 +1238,13 @@ def _insert_aqe_readers(root: TpuExec, conf: RapidsConf) -> TpuExec:
                 and getattr(node, "mode", None) == "final"
                 and kids and isinstance(kids[0], TpuShuffleExchangeExec)):
             kids[0] = TpuCoalescedShuffleReaderExec(
-                kids[0], SharedCoalesceSpec(conf.batch_size_rows))
+                kids[0], SharedCoalesceSpec(conf.batch_size_rows,
+                                            conf.batch_size_bytes))
         elif (isinstance(node, TpuShuffledHashJoinExec) and len(kids) == 2
               and all(isinstance(k, TpuShuffleExchangeExec)
                       for k in kids)):
-            spec = SharedCoalesceSpec(conf.batch_size_rows)
+            spec = SharedCoalesceSpec(conf.batch_size_rows,
+                                      conf.batch_size_bytes)
             kids = [TpuCoalescedShuffleReaderExec(k, spec) for k in kids]
         node.children = tuple(kids)
         for k in node.children:

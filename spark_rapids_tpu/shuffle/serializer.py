@@ -14,6 +14,7 @@ compression is not a TPU primitive.
 from __future__ import annotations
 
 import struct
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -21,7 +22,8 @@ import numpy as np
 
 from spark_rapids_tpu import native
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 from spark_rapids_tpu.columnar.column import DeviceColumn, round_up_pow2
 from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
 
@@ -108,6 +110,48 @@ def _decompress(buf: bytes) -> bytes:
         import lz4.frame
         return lz4.frame.decompress(payload)
     return payload
+
+
+#: reduce-side deserializer pool width, wired from
+#: spark.rapids.shuffle.multiThreaded.reader.threads at session/executor
+#: init (the GpuShuffleEnv multiThreadedReader analog).  zstd/lz4 release
+#: the GIL, so parallel block decompression is real CPU overlap.
+_reader_threads = 4
+_reader_pool = None
+_reader_pool_lock = threading.Lock()
+
+
+def set_reader_threads(n: int) -> None:
+    """Resize the deserializer pool (takes effect lazily: the live pool
+    is replaced on the next merge that wants a different width)."""
+    global _reader_threads
+    _reader_threads = max(int(n), 1)
+
+
+def _decompress_all(buffers) -> List[bytes]:
+    """Decompress wire blocks, in parallel when a codec is in play.
+
+    Uncompressed blocks (tag ``N``) short-circuit to the serial path —
+    the "decompression" is a byte-slice and pool dispatch would only add
+    overhead.  The pool persists across merges (reduce reads arrive per
+    partition; per-call pools would pay thread spawn per partition)."""
+    bufs = list(buffers)
+    if (_reader_threads <= 1 or len(bufs) < 2
+            or not any(b[:1] in (b"Z", b"L") for b in bufs)):
+        return [_decompress(b) for b in bufs]
+    global _reader_pool
+    with _reader_pool_lock:
+        if (_reader_pool is None
+                or _reader_pool._max_workers != _reader_threads):
+            from concurrent.futures import ThreadPoolExecutor
+            # the old pool (if any) is NOT shut down here: a concurrent
+            # merge may still be submitting to it, and an executor's idle
+            # workers exit when the pool is garbage-collected
+            _reader_pool = ThreadPoolExecutor(
+                max_workers=_reader_threads,
+                thread_name_prefix="shuffle-reader")
+        pool = _reader_pool
+    return list(pool.map(_decompress, bufs))
 
 
 def _has_nested(schema: Schema) -> bool:
@@ -291,9 +335,9 @@ def merge_batches(buffers: List[bytes], schema: Schema) -> Optional[ColumnarBatc
         return None
     if _has_nested(schema):
         return _count_merge(
-            _py_merge_nested([_decompress(b) for b in buffers], schema),
+            _py_merge_nested(_decompress_all(buffers), schema),
             len(buffers))
-    raw = [_decompress(b) for b in buffers]
+    raw = _decompress_all(buffers)
     col_specs = [(np.dtype(dt.np_dtype), dt.variable_width)
                  for dt in schema.dtypes]
     total_rows = sum(_py_row_count(b) for b in raw)
@@ -315,8 +359,11 @@ def merge_batches(buffers: List[bytes], schema: Schema) -> Optional[ColumnarBatc
             device_cols.append(DeviceColumn(
                 jnp.asarray(data), jnp.asarray(valid.astype(np.bool_)), dt))
     return _count_merge(
-        ColumnarBatch(tuple(device_cols), jnp.asarray(rows, jnp.int32),
-                      schema),
+        # np scalar array first: committing a bare python int is an
+        # IMPLICIT transfer to jax (the sanitizer's transfer guard
+        # rejects it in hot sections); a 0-d ndarray is explicit
+        ColumnarBatch(tuple(device_cols),
+                      jnp.asarray(np.asarray(rows, np.int32)), schema),
         len(buffers))
 
 
@@ -625,4 +672,4 @@ def _py_merge_nested(raw: List[bytes], schema: Schema) -> ColumnarBatch:
     cols = tuple(
         _merge_block_list([p[i] for p in parsed], dt, row_capacity)
         for i, dt in enumerate(schema.dtypes))
-    return ColumnarBatch(cols, jnp.asarray(total_rows, jnp.int32), schema)
+    return ColumnarBatch(cols, host_scalar(total_rows), schema)

@@ -194,9 +194,16 @@ class StreamPiece:
         """The same value materialize_pinned would return, built from an
         ALREADY-materialized backing batch — no extra pin (the shared-
         backing dedup path of retry_over_stream_pieces)."""
+        import jax.numpy as jnp
         import numpy as np
         start, count = self._range
-        return RangeView(backing, np.int32(start), np.int32(count),
+        # commit the dynamic scalars explicitly HERE: np scalar leaves
+        # would be committed implicitly at every jit dispatch that takes
+        # the view as an argument (the sanitizer's transfer guard flags
+        # exactly that in hot sections)
+        return RangeView(backing,
+                         jnp.asarray(np.asarray(start, np.int32)),
+                         jnp.asarray(np.asarray(count, np.int32)),
                          self.capacity)
 
     @staticmethod
@@ -596,6 +603,7 @@ def process_shuffle_executor():
     with _default_executor_lock:
         if _default_executor is None:
             from spark_rapids_tpu.shuffle.net import ShuffleExecutor
+            # tpu-lint: allow-lock-order(canonical once-per-process init: double-checked executor construction; its persist-dir makedirs runs exactly once)
             _default_executor = ShuffleExecutor(serve_registry=True)
         return _default_executor
 

@@ -15,7 +15,8 @@ from typing import List
 import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 
 STORE_SALES_SCHEMA = Schema.of(
     ss_sold_date_sk=T.INT,
@@ -222,7 +223,7 @@ def _gen_channel_fact(schema, colspec, n_rows: int, seed: int,
             DeviceColumn.from_numpy(data[m], dt, validity.get(m),
                                     capacity=cap)
             for m, dt in zip(schema.names, schema.dtypes))
-        out.append(ColumnarBatch(cols, jnp.asarray(n, jnp.int32), schema))
+        out.append(ColumnarBatch(cols, host_scalar(n), schema))
         remaining -= n
         chunk += 1
     return out

@@ -18,7 +18,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, Schema,
+                                              host_scalar)
 
 EPOCH = datetime.date(1970, 1, 1)
 
@@ -95,7 +96,7 @@ def gen_lineitem(num_rows: int, seed: int = 42,
         device_cols = tuple(
             DeviceColumn.from_numpy(cols[name], dt, capacity=cap)
             for name, dt in zip(LINEITEM_SCHEMA.names, LINEITEM_SCHEMA.dtypes))
-        out.append(ColumnarBatch(device_cols, jnp.asarray(n, jnp.int32),
+        out.append(ColumnarBatch(device_cols, host_scalar(n),
                                  LINEITEM_SCHEMA))
         remaining -= n
         chunk_id += 1

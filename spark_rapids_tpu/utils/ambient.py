@@ -35,6 +35,18 @@ from contextlib import contextmanager, nullcontext
 from typing import Callable, Optional
 
 
+#: runtime-sanitizer ambient-integrity seam (utils/sanitizer.py): called
+#: with the Ambients snapshot on the WORKER thread, inside the
+#: re-entered scope, before the target runs.  None when the sanitizer is
+#: off.
+_AMBIENT_HOOK = None
+
+
+def set_ambient_hook(fn) -> None:
+    global _AMBIENT_HOOK
+    _AMBIENT_HOOK = fn
+
+
 class Ambients:
     """Immutable snapshot of the spawning thread's ambient context."""
 
@@ -88,6 +100,8 @@ class Ambients:
         """``fn`` wrapped to run under this snapshot."""
         def run(*args, **kwargs):
             with self.scope():
+                if _AMBIENT_HOOK is not None:
+                    _AMBIENT_HOOK(self)
                 return fn(*args, **kwargs)
         run.__name__ = getattr(fn, "__name__", "ambient_bound")
         return run

@@ -1,0 +1,545 @@
+"""Runtime contract sanitizer: the dynamic twin of tpulint's static rules.
+
+tpulint proves resource-discipline contracts where the AST lets it see
+them (tools/tpulint: pin-balance, lock-order, ambient-propagation,
+host-sync).  This module witnesses the SAME contracts at runtime in a
+debug mode, so the two check each other: a contract the static rules
+cannot reach (dynamic dispatch, getattr indirection, C callbacks) still
+fails loudly under the sanitizer, and a lock order the sanitizer
+witnesses that the static graph missed is a candidate lint fixture.
+
+Four checks, mirroring the four static rules:
+
+  * PIN LEDGER (pin-balance twin) -- every ``SpillableBatchHandle``
+    materialize/unpin is mirrored into a process-wide ledger recording
+    the acquiring stack; ``query_scope`` asserts zero balance and zero
+    tenant-ledger residue at query teardown, naming the stack that
+    pinned the leaked handle.
+  * LOCK WITNESS (lock-order twin) -- ``threading.Lock``/``RLock``
+    constructed in package code while the sanitizer is on are wrapped so
+    every nested acquisition records an (outer, inner) edge.  A witnessed
+    inversion (both AB and BA) raises immediately; edges absent from
+    ``tools.tpulint.interproc.static_lock_graph`` are reported by
+    ``lock_order_report`` as fixture candidates, not errors.
+  * AMBIENT INTEGRITY (ambient-propagation twin) -- at every blessed
+    spawn target entry (utils/ambient.py) the re-established
+    tenant/priority/token/trace are compared against the captured
+    snapshot; a dropped ambient raises before the target runs a single
+    line under the wrong attribution.
+  * TRANSFER/COMPILE GUARD (host-sync twin) -- ``hot_section`` wraps
+    hot paths in ``jax.transfer_guard("disallow")`` so an implicit
+    host transfer raises at the offending op, and every ``shared_jit``
+    cache miss counts against a compile budget (the launch-profile
+    plumbing's distinct-program metric) so a plan-key regression that
+    recompiles per query fails the suite instead of silently tanking it.
+
+Enabled by ``spark.rapids.sanitizer.enabled`` or the environment
+variable ``SPARK_RAPIDS_TPU_SANITIZE=1`` (how tools/run_suites.py arms
+whole suites), applied through ``memory.initialize_memory`` like the
+checksum knobs.  Every hook is a module-global function pointer that is
+``None`` when off, so the disabled path costs one load+test per seam.
+"""
+from __future__ import annotations
+
+import linecache
+import os
+import re
+import sys
+import threading
+import traceback
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Set, Tuple
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class SanitizerError(AssertionError):
+    """A runtime contract violation caught by the sanitizer."""
+
+
+class _State:
+    """Process-wide sanitizer state (lock-guarded; tls for held stacks)."""
+    lock = threading.Lock()
+    enabled = False
+    #: max DISTINCT shared_jit program keys per process; 0 = unlimited
+    compile_budget = 0
+    #: id(handle) -> [balance, label, acquiring-stack]
+    pins: Dict[int, list] = {}
+    #: witnessed (outer, inner) lock id pairs -> one-line acquire site
+    edges: Dict[Tuple[str, str], str] = {}
+    #: distinct shared_jit keys seen since process start / reset
+    compiled: Set[str] = set()
+    #: tokens of top-level query scopes currently inside their body
+    live_scopes: Set[int] = set()
+    #: tokens whose scope overlapped another (ledger checks downgrade:
+    #: pins and tenant bytes are process-global, so a concurrent query's
+    #: legitimately-live allocations would read as this one's leak)
+    overlapped_scopes: Set[int] = set()
+    tls = threading.local()
+
+
+_S = _State()
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+def sanitizer_enabled() -> bool:
+    return _S.enabled
+
+
+def env_forces_sanitize() -> bool:
+    return os.environ.get("SPARK_RAPIDS_TPU_SANITIZE", "") == "1"
+
+
+def configure_sanitizer(enabled: bool, compile_budget: int = 0) -> None:
+    """Apply the conf snapshot (memory.initialize_memory seam).  The
+    ``SPARK_RAPIDS_TPU_SANITIZE=1`` environment variable forces the
+    sanitizer ON regardless of the conf -- that is how run_suites arms
+    whole test suites without touching every session fixture."""
+    on = bool(enabled) or env_forces_sanitize()
+    env_budget = os.environ.get("SPARK_RAPIDS_TPU_SANITIZE_COMPILE_BUDGET")
+    if env_budget:
+        compile_budget = int(env_budget)
+    with _S.lock:
+        _S.compile_budget = max(int(compile_budget or 0), 0)
+        if on == _S.enabled:
+            return
+        _S.enabled = on
+    if on:
+        _install()
+    else:
+        _uninstall()
+
+
+def reset_sanitizer_state() -> None:
+    """Drop accumulated ledger/edge/compile state (tests)."""
+    with _S.lock:
+        _S.pins.clear()
+        _S.edges.clear()
+        _S.compiled.clear()
+        _S.live_scopes.clear()
+        _S.overlapped_scopes.clear()
+
+
+# -- hook installation --------------------------------------------------------
+
+
+def _install() -> None:
+    from spark_rapids_tpu.memory import spill as _spill
+    from spark_rapids_tpu.plan.execs import base as _base
+    from spark_rapids_tpu.utils import ambient as _ambient
+    _spill.set_pin_hook(_on_pin)
+    _base.set_compile_hook(_on_compile)
+    _ambient.set_ambient_hook(check_ambients)
+    threading.Lock = _make_witness_factory(_REAL_LOCK, reentrant=False)
+    threading.RLock = _make_witness_factory(_REAL_RLOCK, reentrant=True)
+
+
+def _uninstall() -> None:
+    from spark_rapids_tpu.memory import spill as _spill
+    from spark_rapids_tpu.plan.execs import base as _base
+    from spark_rapids_tpu.utils import ambient as _ambient
+    _spill.set_pin_hook(None)
+    _base.set_compile_hook(None)
+    _ambient.set_ambient_hook(None)
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+
+
+# -- lock witness -------------------------------------------------------------
+#
+# Lock ids are derived at construction time to MATCH the static table's
+# naming (tools/tpulint/locks.py _LockTable): module-relative path minus
+# the package prefix and ".py", then the binding scope and attribute --
+# ``memory/spill.SpillableBatchHandle._lock``,
+# ``shuffle/transport._default_executor_lock``.  Locks constructed at
+# import time (before the sanitizer is enabled) stay raw: coverage is
+# "locks born under the sanitizer", which is exactly the per-query exec/
+# handle instance locks the static interprocedural pass reasons about.
+
+_ASSIGN_RE = re.compile(r"\s*(?:self\.(\w+)|([A-Za-z_]\w*))\s*=")
+
+
+def _site_lock_id(frame) -> Optional[str]:
+    fname = frame.f_code.co_filename
+    try:
+        if not os.path.abspath(fname).startswith(_PKG_DIR + os.sep):
+            return None
+    except (ValueError, OSError):
+        return None
+    rel = os.path.relpath(os.path.abspath(fname), _PKG_DIR)
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace(os.sep, "/")
+    qual = getattr(frame.f_code, "co_qualname", frame.f_code.co_name)
+    line = linecache.getline(fname, frame.f_lineno)
+    m = _ASSIGN_RE.match(line)
+    if m is None:
+        return f"{mod}.{qual}.<line {frame.f_lineno}>"
+    self_attr, local_name = m.group(1), m.group(2)
+    if self_attr is not None:
+        if "." in qual:                       # co_qualname (3.11+)
+            cls = qual.split(".")[0]
+        else:                                 # co_name fallback: ask self
+            obj = frame.f_locals.get("self")
+            cls = type(obj).__name__ if obj is not None else qual
+        return f"{mod}.{cls}.{self_attr}"
+    if qual == "<module>":
+        return f"{mod}.{local_name}"
+    scope = qual.replace(".<locals>", "")
+    return f"{mod}.{scope}.{local_name}"
+
+
+def _make_witness_factory(real, reentrant: bool):
+    def factory():
+        if not _S.enabled:
+            return real()
+        lock_id = _site_lock_id(sys._getframe(1))
+        if lock_id is None:
+            return real()
+        return _WitnessLock(real(), lock_id, reentrant)
+    factory.__wrapped__ = real
+    return factory
+
+
+def _held_stack() -> List[str]:
+    held = getattr(_S.tls, "held", None)
+    if held is None:
+        held = _S.tls.held = []
+    return held
+
+
+def _note_acquire(lock_id: str) -> None:
+    if not _S.enabled:   # witness locks outlive a disable; go quiet
+        return
+    held = _held_stack()
+    if held and held[-1] != lock_id:
+        key = (held[-1], lock_id)
+        with _S.lock:
+            fresh = key not in _S.edges
+            if fresh:
+                site = _one_line_site()
+                _S.edges[key] = site
+                rev = _S.edges.get((lock_id, key[0]))
+            else:
+                rev = None
+        if fresh and rev is not None:
+            raise SanitizerError(
+                f"sanitizer: lock-order inversion witnessed at runtime: "
+                f"{key[0]} -> {lock_id} here ({_S.edges[key]}) but "
+                f"{lock_id} -> {key[0]} earlier ({rev}).  One of these "
+                "orders deadlocks under contention; fix the order and add "
+                "the shape as a tpulint lock-order fixture")
+    held.append(lock_id)
+
+
+def _note_release(lock_id: str) -> None:
+    held = getattr(_S.tls, "held", None)
+    if not held:
+        return
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == lock_id:
+            del held[i]
+            return
+
+
+def _one_line_site() -> str:
+    for fr in reversed(traceback.extract_stack(limit=10)[:-3]):
+        if fr.filename.startswith(_PKG_DIR):
+            rel = os.path.relpath(fr.filename, _PKG_DIR)
+            return f"{rel}:{fr.lineno} in {fr.name}"
+    return "<outside package>"
+
+
+class _WitnessLock:
+    """A real lock plus acquisition-order witnessing.  Everything the
+    stdlib Condition machinery probes for (``_is_owned``,
+    ``_acquire_restore``, ``_release_save``) delegates raw -- a cv wait's
+    release/reacquire cycle keeps the lock logically held, so the held
+    stack deliberately does not see it."""
+
+    def __init__(self, lk, lock_id: str, reentrant: bool):
+        self._lk = lk
+        self.lock_id = lock_id
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._lk.acquire(blocking, timeout)
+        if got:
+            try:
+                _note_acquire(self.lock_id)
+            except SanitizerError:
+                self._lk.release()
+                raise
+        return got
+
+    def release(self):
+        self._lk.release()
+        _note_release(self.lock_id)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lk.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._lk, name)
+
+
+def witnessed_lock_edges() -> Dict[Tuple[str, str], str]:
+    with _S.lock:
+        return dict(_S.edges)
+
+
+def lock_order_report(repo_root: Optional[str] = None) -> dict:
+    """Witnessed edges vs the static lock graph.  ``unexpected`` holds
+    (outer, inner, site) triples the static rule missed -- each is a
+    candidate tests/lint_fixtures shape, not (by itself) a bug.  Returns
+    ``{"witnessed": n, "unexpected": [...], "static": n | None}``;
+    ``static`` is None when the lint toolchain is not importable (the
+    sanitizer must work in deployments that do not ship tools/)."""
+    with _S.lock:
+        edges = dict(_S.edges)
+    try:
+        from tools.tpulint.interproc import static_lock_graph
+        static = (static_lock_graph() if repo_root is None
+                  else static_lock_graph(repo_root=repo_root))
+    except Exception:  # noqa: BLE001 -- tools/ absent or unparsable
+        return {"witnessed": len(edges), "unexpected": [], "static": None}
+    unexpected = sorted(
+        (outer, inner, site) for (outer, inner), site in edges.items()
+        if (outer, inner) not in static and "<line" not in outer
+        and "<line" not in inner)
+    return {"witnessed": len(edges), "unexpected": unexpected,
+            "static": len(static)}
+
+
+# -- pin ledger ---------------------------------------------------------------
+
+
+def _on_pin(handle, delta: int) -> None:
+    """spill.py seam: +1 materialize, -1 unpin/ownership-consume, 0 close
+    (a closed handle has released its device accounting; the ledger
+    forgets it so teardown reports live leaks only)."""
+    with _S.lock:
+        key = id(handle)
+        if delta == 0:
+            _S.pins.pop(key, None)
+            return
+        ent = _S.pins.get(key)
+        if ent is None:
+            if delta < 0:
+                return
+            label = (f"SpillableBatchHandle({handle.size_bytes}b, "
+                     f"tenant={handle.tenant!r})")
+            stack = "".join(traceback.format_stack(limit=14)[:-2])
+            ent = _S.pins[key] = [0, label, stack]
+        ent[0] += delta
+        if ent[0] <= 0:
+            _S.pins.pop(key, None)
+
+
+def outstanding_pins() -> List[Tuple[int, str, str]]:
+    with _S.lock:
+        return [(bal, label, stack)
+                for bal, label, stack in _S.pins.values()]
+
+
+# -- per-query scope ----------------------------------------------------------
+
+
+@contextmanager
+def query_scope(name: str = "query"):
+    """Assert zero pin balance and zero tenant-ledger residue at query
+    teardown.  Checks run only on CLEAN exit -- a query that raised is
+    already unwinding through cleanup and its own error wins.  Nested
+    scopes no-op (engine.execute under session.collect)."""
+    if not _S.enabled:
+        yield
+        return
+    depth = getattr(_S.tls, "qdepth", 0)
+    if depth:
+        _S.tls.qdepth = depth + 1
+        try:
+            yield
+        finally:
+            _S.tls.qdepth -= 1
+        return
+    _S.tls.qdepth = 1
+    token = id(object())
+    with _S.lock:
+        base_pins = set(_S.pins)
+        if _S.live_scopes:
+            # concurrent queries share the process-global pin/tenant
+            # ledgers: teardown deltas cannot be attributed to one
+            # query, so BOTH overlapping scopes downgrade to warnings
+            _S.overlapped_scopes.update(_S.live_scopes)
+            _S.overlapped_scopes.add(token)
+        _S.live_scopes.add(token)
+    tenant_base = _tenant_used()
+    try:
+        yield
+    finally:
+        _S.tls.qdepth = 0
+        with _S.lock:
+            _S.live_scopes.discard(token)
+            overlapped = token in _S.overlapped_scopes
+            _S.overlapped_scopes.discard(token)
+    leaked = []
+    with _S.lock:
+        for key, (bal, label, stack) in _S.pins.items():
+            if key not in base_pins and bal > 0:
+                leaked.append((bal, label, stack))
+    if leaked and not overlapped:
+        bal, label, stack = leaked[0]
+        raise SanitizerError(
+            f"sanitizer: pin leak at {name!r} teardown: {len(leaked)} "
+            f"handle(s) still pinned; first is {label} with balance "
+            f"{bal}, pinned at:\n{stack}")
+    residue = {t: used - tenant_base.get(t, 0)
+               for t, used in _tenant_used().items()
+               if used > tenant_base.get(t, 0)}
+    if residue and not overlapped:
+        raise SanitizerError(
+            f"sanitizer: tenant-ledger residue at {name!r} teardown: "
+            f"device bytes still charged after query end: {residue} "
+            "(a handle leaked, or a charge is missing its credit)")
+    if (leaked or residue) and overlapped:
+        import logging
+        logging.getLogger(__name__).warning(
+            "sanitizer: %r teardown overlapped another query; unattributable "
+            "ledger deltas downgraded (pins=%d, residue=%s)",
+            name, len(leaked), residue)
+    rep = lock_order_report()
+    if rep["unexpected"]:
+        import logging
+        logging.getLogger(__name__).warning(
+            "sanitizer: %d witnessed lock-order edge(s) missing from the "
+            "static graph (candidate tpulint fixtures): %s",
+            len(rep["unexpected"]), rep["unexpected"])
+
+
+def _tenant_used() -> Dict[str, int]:
+    from spark_rapids_tpu.memory.tenant import TENANTS
+    return {t: snap["used_bytes"]
+            for t, snap in TENANTS.snapshot().items()}
+
+
+# -- ambient integrity --------------------------------------------------------
+
+
+def check_ambients(amb) -> None:
+    """ambient.py seam, called on the WORKER inside ``amb.scope()``:
+    every captured ambient must actually be re-established before the
+    target runs, or its work mis-attributes exactly the way the static
+    ambient-propagation rule guards against."""
+    from spark_rapids_tpu.memory.semaphore import current_task_priority
+    from spark_rapids_tpu.memory.tenant import TENANTS
+    from spark_rapids_tpu.utils.cancel import current_cancel_token
+    from spark_rapids_tpu.utils.obs import current_query_trace
+    dropped = []
+    if TENANTS.current() != amb.tenant:
+        dropped.append(f"tenant (captured {amb.tenant!r}, "
+                       f"established {TENANTS.current()!r})")
+    if current_task_priority() != amb.priority:
+        dropped.append(f"priority (captured {amb.priority}, "
+                       f"established {current_task_priority()})")
+    if current_cancel_token() is not amb.token:
+        dropped.append("cancel token")
+    if current_query_trace() is not amb.trace:
+        dropped.append("query trace")
+    if dropped:
+        raise SanitizerError(
+            "sanitizer: ambient integrity violated at blessed-spawn "
+            f"target entry: dropped {', '.join(dropped)}.  The worker "
+            "would charge/queue/cancel under the wrong query")
+
+
+# -- transfer guard + compile budget ------------------------------------------
+
+
+@contextmanager
+def hot_section(name: str):
+    """``jax.transfer_guard("disallow")`` for the block when the
+    sanitizer is on: an implicit host transfer (``float(arr)``,
+    mixed np/jnp eager arithmetic) raises AT the offending op, re-typed
+    as SanitizerError naming the section.  Explicit movement
+    (``jnp.asarray``, ``jax.device_put/get``) stays allowed -- hot paths
+    legitimately stage host bytes, they must not silently SYNC."""
+    if not _S.enabled:
+        yield
+        return
+    import jax
+    try:
+        with jax.transfer_guard("disallow"):
+            yield
+    except SanitizerError:
+        raise
+    except Exception as e:  # noqa: BLE001 -- re-type guard trips only
+        msg = str(e)
+        if "Disallowed" in msg and "transfer" in msg:
+            raise SanitizerError(
+                f"sanitizer: implicit host transfer inside hot section "
+                f"{name!r}: {msg}.  Hoist the sync out of the hot path "
+                "or make the transfer explicit where it is deliberate"
+            ) from e
+        raise
+
+
+@contextmanager
+def blessed_sync(reason: str):
+    """Runtime twin of the static ``# tpu-lint: allow-host-sync(...)``
+    suppression: lifts an enclosing :func:`hot_section` guard for a
+    documented, deliberate sync (bucket derivations, batched feedback
+    downloads).  Like the static grammar, the blessing takes a reason --
+    it is the audit trail, not decoration.  No-op when the sanitizer is
+    off; outside a hot section it merely nests an allow guard."""
+    del reason  # documentation-only, mirrors the suppression grammar
+    if not _S.enabled:
+        yield
+        return
+    import jax
+    with jax.transfer_guard("allow"):
+        yield
+
+
+def _on_compile(key: str) -> None:
+    """base.py shared_jit seam, called once per program-cache MISS: the
+    distinct-key count is the launch-profile plumbing's 'programs'
+    metric, and the budget makes a per-query key regression (id() or a
+    timestamp leaking into a plan key) a hard failure."""
+    with _S.lock:
+        _S.compiled.add(key)
+        n = len(_S.compiled)
+        limit = getattr(_S.tls, "budget_limit", None)
+        if limit is None and _S.compile_budget:
+            limit = _S.compile_budget
+    if limit is not None and n > limit:
+        raise SanitizerError(
+            f"sanitizer: compile budget exceeded: {n} distinct programs "
+            f"compiled (budget {limit}).  A stable workload compiles a "
+            "bounded program set; an unbounded key stream means a plan "
+            f"key is not canonical.  Newest key: {key[:160]}")
+
+
+def compile_count() -> int:
+    with _S.lock:
+        return len(_S.compiled)
+
+
+@contextmanager
+def compile_budget_scope(extra: int):
+    """Tighten the budget for the calling thread: at most ``extra`` NEW
+    distinct programs may compile inside the block (tests)."""
+    with _S.lock:
+        base = len(_S.compiled)
+    _S.tls.budget_limit = base + int(extra)
+    try:
+        yield
+    finally:
+        _S.tls.budget_limit = None

@@ -1247,3 +1247,83 @@ def test_changed_mode_refuses_baseline_update():
     from tools.tpulint.__main__ import main as lint_main
     with pytest.raises(SystemExit):
         lint_main(["--changed", "--update-baseline"])
+
+
+# -- knob-wiring and counter-registry drift -----------------------------------
+
+def test_knob_wiring_drift_fires_both_directions():
+    """Dead registered key and unregistered read key both fire; a key
+    wired through its accessor property stays silent."""
+    cfg = _src("spark_rapids_tpu/config.py", """
+        DEAD = conf("spark.rapids.test.deadKnob").doc("d").int_conf(1)
+        LIVE = conf("spark.rapids.test.liveKnob").doc("d").int_conf(2)
+        DIRECT = conf("spark.rapids.test.directKnob").doc("d").int_conf(3)
+
+        class RapidsConf:
+            @property
+            def live_knob(self):
+                return self.get(LIVE)
+    """)
+    user = _src("spark_rapids_tpu/user.py", """
+        from spark_rapids_tpu import config as C
+
+        def f(conf):
+            n = conf.live_knob
+            d = conf.get(C.DIRECT)
+            raw = conf.raw("spark.rapids.test.notRegistered")
+            return n, d, raw
+    """)
+    vs = drift._check_knob_wiring(REPO, [cfg, user])
+    msgs = [v.message for v in vs]
+    assert any("spark.rapids.test.deadKnob" in m and "never read" in m
+               for m in msgs), msgs
+    assert any("spark.rapids.test.notRegistered" in m
+               and "not registered" in m for m in msgs), msgs
+    assert not any("liveKnob" in m or "directKnob" in m for m in msgs), msgs
+    # the unregistered-read finding points at the offending file
+    (unreg,) = [v for v in vs if "notRegistered" in v.message]
+    assert unreg.file == "spark_rapids_tpu/user.py"
+
+
+def test_knob_wiring_clean_on_real_tree():
+    """Every registered spark.rapids.* key is read somewhere and every
+    read key is registered (the check that found reader.batchSizeRows,
+    batchSizeBytes, multiThreaded.reader.threads dead and
+    serving.query.tenant unregistered, all since fixed)."""
+    vs = drift._check_knob_wiring(REPO, None)
+    assert vs == [], "\n".join(v.render() for v in vs)
+
+
+def test_unused_counter_drift_fires_and_real_tree_clean():
+    stats = _src("spark_rapids_tpu/shuffle/stats.py", """
+        _FIELDS = (
+            "used_counter",
+            "splat_counter",
+            "ghost_counter",
+        )
+    """)
+    user = _src("spark_rapids_tpu/shuffle/net.py", """
+        def g():
+            SHUFFLE_COUNTERS.add(used_counter=1)
+            SHUFFLE_COUNTERS.set_max(**{"splat_counter": 2})
+    """)
+    vs = drift._check_unused_counters(REPO, [stats, user])
+    assert len(vs) == 1 and "ghost_counter" in vs[0].message, \
+        "\n".join(v.render() for v in vs)
+    assert vs[0].file == "spark_rapids_tpu/shuffle/stats.py"
+    assert drift._check_unused_counters(REPO, None) == []
+
+
+def test_sarif_fingerprints_stable_across_runs():
+    """Re-rendering the same violations must byte-match — CI dedupe
+    keys on partialFingerprints, so any instability (dict order, ids)
+    would resurface every finding as new on every push."""
+    from tools.tpulint.formats import render_sarif
+    vs = [lint_core.Violation("pin-balance", "a/b.py", 12, "C.m", "msg"),
+          lint_core.Violation("drift", "docs/x.md", 1, "<rules>", "m2")]
+    again = [lint_core.Violation("pin-balance", "a/b.py", 12, "C.m", "msg"),
+             lint_core.Violation("drift", "docs/x.md", 1, "<rules>", "m2")]
+    assert render_sarif(vs) == render_sarif(again)
+    # empty log is still schema-shaped (the --changed no-files path)
+    log = json.loads(render_sarif([]))
+    assert log["version"] == "2.1.0" and log["runs"][0]["results"] == []

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# tpu-lint CI entry point.
+#
+# Two passes, both required green:
+#   1. --changed --format github : the fast (<5s) pass over files changed
+#      vs the merge base, emitting ::error workflow commands that land as
+#      inline PR annotations;
+#   2. the full run (all rules + drift) : the gate that also covers
+#      interprocedural findings whose CALL SITE is outside the diff.
+#
+# Exits nonzero when either pass reports a non-baseline finding.  SARIF
+# for dashboard ingestion: `python -m tools.tpulint --format sarif`.
+set -u
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+python -m tools.tpulint --changed --format github
+changed_rc=$?
+
+python -m tools.tpulint
+full_rc=$?
+
+if [ "$changed_rc" -ne 0 ] || [ "$full_rc" -ne 0 ]; then
+    exit 1
+fi
+exit 0

@@ -45,6 +45,9 @@ SUITES = {
     "gauntlet": (["tests/test_tpcds_gauntlet.py"], 1200),
     "serving": (["tests/test_serving.py", "tests/test_agg_tail.py",
                  "tests/test_cancel.py"], 900),
+    # cancellation alone (the serving suite's slowest cohabitant): a
+    # focused target for the sanitizer's ambient/teardown contracts
+    "cancel": (["tests/test_cancel.py"], 600),
     "pipeline": (["tests/test_fused_shuffle.py", "tests/test_fused.py",
                   "tests/test_aqe_coalesce.py"], 1200, ""),
     # slow-marked chaos soak (kill/revive/delay at 6+ ranks under
@@ -63,8 +66,16 @@ SUITES = {
     "observability": (["tests/test_obs.py",
                        "tests/test_prog_profile.py",
                        "tests/test_telemetry.py"], 900),
-    "lint": (["tests/test_lint.py", "tests/test_ambient.py"], 300),
+    "lint": (["tests/test_lint.py", "tests/test_ambient.py",
+              "tests/test_lint_interproc.py",
+              "tests/test_sanitizer.py"], 300),
 }
+
+#: suites that run with the runtime contract sanitizer armed
+#: (SPARK_RAPIDS_TPU_SANITIZE=1, utils/sanitizer.py) unless
+#: --no-sanitize: the shuffle/serving/cancel paths are where the pin/
+#: lock/ambient contracts the sanitizer witnesses actually concentrate.
+SANITIZE_SUITES = {"shuffle", "serving", "cancel"}
 
 #: extra commands run (and required green) after a suite's pytest pass.
 #: The lint suite also runs the CLI with --timing so the per-rule wall
@@ -92,10 +103,13 @@ def _parse_tail(tail: str):
     return 0, 0, 0
 
 
-def run_suite(name: str, files, timeout_s: float, extra_args):
+def run_suite(name: str, files, timeout_s: float, extra_args,
+              sanitize: bool = False):
     cmd = [sys.executable, "-m", "pytest", "-q",
            "-p", "no:cacheprovider", *files, *extra_args]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if sanitize:
+        env["SPARK_RAPIDS_TPU_SANITIZE"] = "1"
     t0 = time.monotonic()
     try:
         proc = subprocess.run(cmd, cwd=REPO, env=env,
@@ -129,6 +143,9 @@ def main(argv=None) -> int:
                     help="print each suite's output tail even on PASS")
     ap.add_argument("-m", dest="marker", default="not slow",
                     help="pytest -m expression (default: 'not slow')")
+    ap.add_argument("--no-sanitize", action="store_true",
+                    help="do not arm the runtime contract sanitizer for "
+                         f"the {sorted(SANITIZE_SUITES)} suites")
     args = ap.parse_args(argv)
     if args.list:
         for name, spec in SUITES.items():
@@ -158,9 +175,12 @@ def main(argv=None) -> int:
                             "failed": 0, "skipped": 0, "wall_s": 0.0,
                             "rc": 2, "tail": f"missing files: {missing}"})
             continue
+        sanitize = name in SANITIZE_SUITES and not args.no_sanitize
         print(f"== {name} ({len(files)} files, "
-              f"timeout {int(tmo * args.timeout_scale)}s) ==", flush=True)
-        r = run_suite(name, files, tmo * args.timeout_scale, extra)
+              f"timeout {int(tmo * args.timeout_scale)}s"
+              f"{', sanitized' if sanitize else ''}) ==", flush=True)
+        r = run_suite(name, files, tmo * args.timeout_scale, extra,
+                      sanitize=sanitize)
         for cmd in POST_CMDS.get(name, ()):
             try:
                 post = subprocess.run(cmd, cwd=REPO,

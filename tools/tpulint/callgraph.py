@@ -1,0 +1,386 @@
+"""Package-wide call graph for the interprocedural lint tier.
+
+The flow rules (pin-balance, ambient-propagation, counter-discipline,
+lock-order) were intraprocedural: every judgement stopped at the edge of
+one function's CFG, and the real review-round bugs (the PR 11 unmatched
+unpin hidden inside ``materialize_batch_pinned``, pin transfers through
+``retry_over_stream_pieces`` wrappers, ambients lost through a
+``reader_pool`` indirection) all crossed a call boundary.  This module
+provides the substrate the summary engine (tools/tpulint/summaries.py)
+runs on: a MODULE-QUALIFIED call graph over every function, method and
+lambda in ``spark_rapids_tpu/``.
+
+Resolution is deliberately conservative — an edge exists only when the
+callee is provable from the AST:
+
+  * bare-name calls resolve to same-module defs (innermost enclosing
+    scope preferred), then to ``from X import name`` / ``import X as n``
+    imports of in-package modules (top-level defs and class
+    constructors);
+  * ``self.m()`` / ``cls.m()`` resolve within the enclosing class, with
+    a same-module unique-name fallback (the one-level approximation the
+    lock rule already uses);
+  * the blessed spawn/submit indirections contribute edges to their
+    TARGETS: ``spawn_with_ambients(fn, ...)``,
+    ``submit_with_ambients(pool, fn, ...)``, ``threading.Thread(target=
+    fn)``, ``pool.submit(fn, ...)`` and ``Ambients.bind(fn)`` all call
+    ``fn`` on some thread eventually;
+  * anything else (attribute calls on arbitrary receivers, dynamic
+    dispatch) stays UNRESOLVED — the ``# tpu-lint: summary(...)``
+    annotation (summaries.py) is the escape hatch when a contract must
+    be stated for a callee the graph cannot see.
+
+The index is AST-light on purpose: no CFG construction happens here, so
+``--changed`` mode can afford to index the WHOLE package (the call
+graph is global even when only one file is linted) inside its 5s
+budget; the summary engine builds CFGs lazily for the few functions
+that need flow precision.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tpulint.core import SourceFile, dotted
+
+#: callables that invoke their function-valued argument (eventually, on
+#: some thread): argument position of the invoked target
+SPAWN_INDIRECTIONS = {
+    "spawn_with_ambients": 0,
+    "submit_with_ambients": 1,
+    "bind": 0,
+}
+
+
+def module_name(path: str) -> str:
+    """spark_rapids_tpu/shuffle/net.py -> spark_rapids_tpu.shuffle.net"""
+    p = path[:-3] if path.endswith(".py") else path
+    parts = p.split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclass
+class CallSite:
+    """One provable call (or spawn-target hand-off) inside a function."""
+    name: str                  # dotted callee text ("self._run", "fetch")
+    node: ast.Call             # the call expression
+    line: int
+    kind: str = "call"         # "call" | "spawn"
+    target: Optional[ast.AST] = None   # spawn target expr (kind=="spawn")
+
+
+@dataclass
+class FnRecord:
+    """One function/method/lambda, with shallow body facts (nested
+    defs/lambdas are their own records and excluded from these)."""
+    fid: str                   # "path:qualname" — globally unique
+    path: str
+    qualname: str
+    node: ast.AST
+    line: int
+    #: own positional parameter names, in order (releases-arg indexing)
+    pos_params: List[str] = field(default_factory=list)
+    #: own + enclosing-scope parameter names (opaque-callback detection)
+    all_params: Set[str] = field(default_factory=set)
+    refs: Set[str] = field(default_factory=set)
+    call_sites: List[CallSite] = field(default_factory=list)
+    calls_param: bool = False
+    #: shallow statement-shape inventories, filled in the same walk, so
+    #: the summary engine never re-walks a body for local facts
+    returns: List[ast.AST] = field(default_factory=list)
+    assigns: List[ast.Assign] = field(default_factory=list)
+    augassigns: List[ast.AugAssign] = field(default_factory=list)
+    with_items: List[ast.AST] = field(default_factory=list)
+    loops: List[ast.AST] = field(default_factory=list)
+
+
+@dataclass
+class ModuleIndex:
+    path: str
+    name: str                  # dotted module name
+    src: SourceFile
+    functions: Dict[str, FnRecord] = field(default_factory=dict)
+    defs_by_name: Dict[str, List[str]] = field(default_factory=dict)
+    imports: Dict[str, str] = field(default_factory=dict)
+    #: top-level class name -> method bare names
+    classes: Dict[str, Set[str]] = field(default_factory=dict)
+
+
+class PackageIndex:
+    """Every module's functions plus the resolver over them."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleIndex] = {}        # by path
+        self.by_module_name: Dict[str, ModuleIndex] = {}
+        self.functions: Dict[str, FnRecord] = {}         # by fid
+        #: ast function node (by id) -> fid, for lambda/def targets
+        self.by_node: Dict[int, str] = {}
+
+    def add_source(self, src: SourceFile) -> None:
+        mod = _index_module(src)
+        self.modules[mod.path] = mod
+        self.by_module_name[mod.name] = mod
+        for fid, rec in mod.functions.items():
+            self.functions[fid] = rec
+            self.by_node[id(rec.node)] = fid
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_expr(self, caller: FnRecord,
+                     expr: Optional[ast.AST]) -> Optional[str]:
+        """fid of a function-valued EXPRESSION (a spawn target): a
+        lambda/def node, or a name resolvable like a call."""
+        if expr is None:
+            return None
+        if isinstance(expr, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return self.by_node.get(id(expr))
+        name = dotted(expr)
+        if not name:
+            return None
+        hits = self.resolve(caller, name)
+        return hits[0] if hits else None
+
+    def resolve(self, caller: FnRecord, name: str) -> List[str]:
+        """fids a dotted callee text may denote from ``caller``'s module
+        (empty when unresolvable — dynamic dispatch)."""
+        mod = self.modules.get(caller.path)
+        if mod is None or not name:
+            return []
+        parts = name.split(".")
+        if len(parts) == 1:
+            return self._resolve_bare(mod, caller, parts[0])
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            return self._resolve_method(mod, caller, parts[1])
+        return self._resolve_dotted(mod, parts)
+
+    def _resolve_bare(self, mod: ModuleIndex, caller: FnRecord,
+                      bare: str) -> List[str]:
+        cands = mod.defs_by_name.get(bare, [])
+        if cands:
+            # prefer the definition nested inside the calling scope
+            for q in cands:
+                if q.startswith(caller.qualname + "."):
+                    return [f"{mod.path}:{q}"]
+            return [f"{mod.path}:{cands[0]}"]
+        if bare in mod.classes:
+            init = f"{bare}.__init__"
+            if init in mod.functions_by_qual():
+                return [f"{mod.path}:{init}"]
+            return []
+        src_mod = mod.imports.get(bare)
+        if src_mod is not None:
+            return self._resolve_in_module(src_mod, bare)
+        return []
+
+    def _resolve_method(self, mod: ModuleIndex, caller: FnRecord,
+                        meth: str) -> List[str]:
+        # enclosing class = the longest qualname prefix that is a class
+        parts = caller.qualname.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            cls = ".".join(parts[:i])
+            qual = f"{cls}.{meth}"
+            if qual in mod.functions_by_qual():
+                return [f"{mod.path}:{qual}"]
+        # inherited / other-class fallback: unique same-module def
+        cands = mod.defs_by_name.get(meth, [])
+        if len(cands) == 1:
+            return [f"{mod.path}:{cands[0]}"]
+        return []
+
+    def _resolve_dotted(self, mod: ModuleIndex,
+                        parts: List[str]) -> List[str]:
+        func = parts[-1]
+        prefix = parts[:-1]
+        cand_modules = [".".join(prefix)]
+        root_mod = mod.imports.get(prefix[0])
+        if root_mod is not None:
+            # `import X.Y as alias` -> alias maps to X.Y
+            cand_modules.append(".".join([root_mod] + prefix[1:]))
+            # `from X import submod` -> "submod" maps to X; the module
+            # actually called through is X.submod
+            cand_modules.append(".".join([root_mod] + prefix))
+        for m in cand_modules:
+            hits = self._resolve_in_module(m, func)
+            if hits:
+                return hits
+        return []
+
+    def _resolve_in_module(self, mod_name: str, func: str) -> List[str]:
+        target = self.by_module_name.get(mod_name)
+        if target is None:
+            return []
+        for q in target.defs_by_name.get(func, []):
+            if "." not in q:           # top-level defs only
+                return [f"{target.path}:{q}"]
+        if func in target.classes:
+            init = f"{func}.__init__"
+            if init in target.functions_by_qual():
+                return [f"{target.path}:{init}"]
+        return []
+
+    def edges_from(self, rec: FnRecord) -> List[Tuple[str, CallSite]]:
+        """Resolved (callee fid, call site) pairs out of one function."""
+        out: List[Tuple[str, CallSite]] = []
+        for site in rec.call_sites:
+            if site.kind == "spawn":
+                fid = self.resolve_expr(rec, site.target)
+                if fid is not None:
+                    out.append((fid, site))
+                continue
+            for fid in self.resolve(rec, site.name):
+                out.append((fid, site))
+        return out
+
+
+# ModuleIndex helper kept as a method-alike (cached per instance)
+def _functions_by_qual(self: ModuleIndex) -> Dict[str, FnRecord]:
+    cache = getattr(self, "_fq", None)
+    if cache is None:
+        cache = {rec.qualname: rec for rec in self.functions.values()}
+        self._fq = cache
+    return cache
+
+
+ModuleIndex.functions_by_qual = _functions_by_qual
+
+
+def _note_import(mod: ModuleIndex, node: ast.AST) -> None:
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            mod.imports[alias.asname or
+                        alias.name.split(".")[0]] = alias.name
+    elif isinstance(node, ast.ImportFrom):
+        m = node.module or ""
+        for alias in node.names:
+            mod.imports[alias.asname or alias.name] = m
+
+
+def _index_module(src: SourceFile) -> ModuleIndex:
+    mod = ModuleIndex(path=src.path, name=module_name(src.path), src=src)
+
+    def add_fn(node, qual_parts: List[str], outer_params: Set[str]):
+        qual = ".".join(qual_parts)
+        fid = f"{src.path}:{qual}"
+        args = node.args
+        pos = [a.arg for a in args.posonlyargs + args.args]
+        if pos and pos[0] in ("self", "cls"):
+            pos = pos[1:]
+        own = {a.arg for a in args.posonlyargs + args.args
+               + args.kwonlyargs}
+        for extra in (args.vararg, args.kwarg):
+            if extra is not None:
+                own.add(extra.arg)
+        rec = FnRecord(fid=fid, path=src.path, qualname=qual, node=node,
+                       line=getattr(node, "lineno", 0), pos_params=pos,
+                       all_params=own | outer_params)
+        mod.functions[fid] = rec
+        bare = qual_parts[-1]
+        mod.defs_by_name.setdefault(bare, []).append(qual)
+        _collect_body(rec, mod, qual_parts, own | outer_params, add_fn)
+
+    def visit_scope(node, qual_parts: List[str], outer_params: Set[str],
+                    class_name: Optional[str]):
+        lambda_n = [0]
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if class_name is not None:
+                    mod.classes.setdefault(class_name, set()).add(
+                        child.name)
+                add_fn(child, qual_parts + [child.name], outer_params)
+            elif isinstance(child, ast.ClassDef):
+                cls = child.name if not qual_parts else None
+                if not qual_parts:
+                    mod.classes.setdefault(child.name, set())
+                visit_scope(child, qual_parts + [child.name],
+                            outer_params, cls or child.name)
+            elif isinstance(child, ast.Lambda):
+                lambda_n[0] += 1
+                add_fn(child, qual_parts + [f"<lambda#{lambda_n[0]}>"],
+                       outer_params)
+            else:
+                _note_import(mod, child)
+                visit_scope(child, qual_parts, outer_params, None)
+
+    visit_scope(src.tree, [], set(), None)
+    return mod
+
+
+def _collect_body(rec: FnRecord, mod: ModuleIndex,
+                  qual_parts: List[str], params: Set[str],
+                  add_fn) -> None:
+    """Shallow facts of one function body; nested defs/lambdas become
+    their own records (registered through ``add_fn``)."""
+    node = rec.node
+    body = node.body if isinstance(node.body, list) else [node.body]
+    lambda_n = [0]
+
+    def walk(n):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add_fn(n, qual_parts + [n.name], params)
+            return
+        if isinstance(n, ast.Lambda):
+            lambda_n[0] += 1
+            add_fn(n, qual_parts + [f"<lambda#{lambda_n[0]}>"], params)
+            return
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            rec.refs.add(n.id)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            _note_import(mod, n)       # function-local imports count
+        elif isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+            rec.returns.append(n)
+        elif isinstance(n, ast.Assign):
+            rec.assigns.append(n)
+        elif isinstance(n, ast.AugAssign):
+            rec.augassigns.append(n)
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            rec.with_items.extend(item.context_expr for item in n.items)
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            rec.loops.append(n)
+        if isinstance(n, ast.Call):
+            name = dotted(n.func)
+            if name:
+                bare = name.rsplit(".", 1)[-1]
+                if "." not in name and name in params:
+                    rec.calls_param = True
+                rec.call_sites.append(CallSite(
+                    name=name, node=n, line=n.lineno))
+                spawn = _spawn_target(n, name, bare)
+                if spawn is not None:
+                    rec.call_sites.append(CallSite(
+                        name=name, node=n, line=n.lineno, kind="spawn",
+                        target=spawn))
+        for c in ast.iter_child_nodes(n):
+            walk(c)
+
+    for stmt in body:
+        walk(stmt)
+
+
+def _spawn_target(call: ast.Call, name: str,
+                  bare: str) -> Optional[ast.AST]:
+    """The function-valued argument a spawn/submit indirection will
+    eventually invoke, or None."""
+    if bare == "Thread" and ("threading" in name or name == "Thread"):
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return call.args[0] if call.args else None
+    if bare == "submit" and isinstance(call.func, ast.Attribute):
+        return call.args[0] if call.args else None
+    if bare in SPAWN_INDIRECTIONS:
+        pos = SPAWN_INDIRECTIONS[bare]
+        if len(call.args) > pos:
+            return call.args[pos]
+    return None
+
+
+def build_index(sources: List[SourceFile]) -> PackageIndex:
+    idx = PackageIndex()
+    for src in sources:
+        if src.path.startswith("spark_rapids_tpu/"):
+            idx.add_source(src)
+    return idx

@@ -199,8 +199,11 @@ ALL_RULES = (
 def _ast_checkers() -> List[Tuple[str, Callable[[List[SourceFile]],
                                                 List[Violation]]]]:
     from tools.tpulint import (ambient_spawn, counter_discipline,
-                               host_sync, locks, pin_balance,
+                               host_sync, interproc, locks, pin_balance,
                                retry_discipline, swallow, waits)
+    # the interprocedural tier (tools/tpulint/interproc.py) rides the
+    # same rule names: one rule = one contract, however many analyses
+    # enforce it.  run_all_timed accumulates timings per rule name.
     return [
         ("retry-discipline", retry_discipline.check),
         ("host-sync", host_sync.check),
@@ -210,6 +213,10 @@ def _ast_checkers() -> List[Tuple[str, Callable[[List[SourceFile]],
         ("pin-balance", pin_balance.check),
         ("ambient-propagation", ambient_spawn.check),
         ("counter-discipline", counter_discipline.check),
+        ("pin-balance", interproc.check_pins),
+        ("ambient-propagation", interproc.check_ambients),
+        ("counter-discipline", interproc.check_counters),
+        ("lock-order", interproc.check_locks),
     ]
 
 
@@ -252,7 +259,8 @@ def run_all_timed(repo_root: str = REPO,
             continue
         t0 = _time.monotonic()
         violations.extend(fn(sources))
-        timings[rule] = _time.monotonic() - t0
+        timings[rule] = timings.get(rule, 0.0) + \
+            (_time.monotonic() - t0)
     if with_drift and on("drift"):
         t0 = _time.monotonic()
         # hand drift the parsed sources ONLY on a full package scan —

@@ -1,0 +1,557 @@
+"""Function-summary engine: per-function effect contracts, bottom-up.
+
+Sits on the package call graph (tools/tpulint/callgraph.py) and gives
+the interprocedural tier (tools/tpulint/interproc.py) one ``Summary``
+per function — the facts a CALLER needs without re-analyzing the body:
+
+  * ``returns_pinned``      — calling this hands you a pinned handle (or
+                              a collection of them) you now own;
+  * ``releases_params``     — positional argument k is unpinned by the
+                              callee (ownership transfers IN);
+  * ``counters``            — ShuffleCounters fields this mutates,
+                              transitively, with the path;
+  * ``counters_tail``       — every counter effect is tail-positioned
+                              (nothing fallible can run after it), so the
+                              function is safe as a retry-attempt body;
+  * ``locks``               — lock ids acquired, transitively;
+  * ``engine``              — why this function reaches engine/shuffle/
+                              memory code (the ambient-propagation
+                              signal: such code expects tenant/priority/
+                              token/trace to be in scope);
+  * ``may_block``           — a known blocking category is reachable.
+
+Summaries are computed bottom-up over Tarjan SCCs with a union fixpoint
+inside each SCC, so mutual recursion converges (effects are monotone:
+sets only grow, ``counters_tail`` only falls).  CFGs are built lazily —
+only for functions with counter effects, where tail position needs flow
+precision — keeping the whole-package pass affordable for --changed.
+
+Dynamic dispatch the graph cannot see gets an explicit contract::
+
+    # tpu-lint: summary(returns-pinned, releases-arg 0)
+    def exotic_dispatch(handle): ...
+
+on the ``def`` line or the line directly above.  Clauses: ``pure``
+(no effects), ``returns-pinned``, ``releases-arg K``, ``counters: a b``,
+``engine-reaching``, ``acquires-lock ID``, ``may-block``.  An annotation
+REPLACES the computed summary for that function — it is a contract, not
+a hint — and a malformed clause is itself reported (like a reasonless
+suppression).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tpulint.callgraph import (CallSite, FnRecord, PackageIndex,
+                                     build_index)
+from tools.tpulint.cfg import BACK, build_function_cfg
+from tools.tpulint.counter_discipline import (_is_counter_call,
+                                              _is_metrics_augassign,
+                                              _may_still_raise,
+                                              _stmt_may_raise_beyond)
+from tools.tpulint.locks import (BLOCKING_SUFFIXES, EXTERNAL_ACQUIRERS,
+                                 _Analyzer, _LockTable)
+from tools.tpulint.pin_balance import (ACQUIRE_METHODS, RELEASE_METHODS,
+                                       _recv_of)
+from tools.tpulint.ambient_spawn import ENGINE_PKGS
+from tools.tpulint.core import dotted
+
+_SUMMARY_RE = re.compile(r"#\s*tpu-lint:\s*summary\(([^)]*)\)")
+_RELEASES_RE = re.compile(r"^releases-arg\s+(\d+)$")
+_COUNTERS_RE = re.compile(r"^counters:\s*([\w\s]+)$")
+_LOCK_RE = re.compile(r"^acquires-lock\s+(\S+)$")
+
+#: chained via-path strings stay readable in findings
+_PATH_CAP = 200
+
+
+def _chain(step: str, rest: str) -> str:
+    s = f"{step} -> {rest}" if rest else step
+    return s if len(s) <= _PATH_CAP else s[:_PATH_CAP] + "..."
+
+
+@dataclass
+class Summary:
+    fid: str
+    returns_pinned: bool = False
+    pin_path: str = ""                 # how the pinned handle is produced
+    releases_params: Dict[int, str] = field(default_factory=dict)
+    counters: Dict[str, str] = field(default_factory=dict)
+    counters_tail: bool = True
+    locks: Dict[str, str] = field(default_factory=dict)
+    engine: Optional[str] = None
+    may_block: Optional[str] = None
+    annotated: bool = False
+
+
+def _is_engine_module(modname: str) -> bool:
+    parts = modname.split(".")
+    return (len(parts) >= 2 and parts[0] == "spark_rapids_tpu"
+            and parts[1] in ENGINE_PKGS)
+
+
+def _shallow_walk(func: ast.AST):
+    """Every node in the function body, nested defs/lambdas excluded."""
+    body = func.body if isinstance(func.body, list) else [func.body]
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class SummaryEngine:
+    """Summaries for every function in the package index."""
+
+    def __init__(self, sources):
+        self.index: PackageIndex = build_index(sources)
+        self.summaries: Dict[str, Summary] = {}
+        #: fid -> resolved (callee fid, call site) pairs
+        self.edges: Dict[str, List[Tuple[str, CallSite]]] = {}
+        #: (path, line, message) for malformed summary annotations
+        self.annotation_problems: List[Tuple[str, int, str]] = []
+        self._cfg_cache: Dict[str, object] = {}
+        self._returned_cache: Dict[str, Set[ast.AST]] = {}
+        self._scc_order: List[List[str]] = []
+        self._compute()
+
+    def summary(self, fid: str) -> Optional[Summary]:
+        return self.summaries.get(fid)
+
+    def summary_of_call(self, caller: FnRecord,
+                        name: str) -> Optional[Summary]:
+        for fid in self.index.resolve(caller, name):
+            s = self.summaries.get(fid)
+            if s is not None:
+                return s
+        return None
+
+    def cfg_of(self, rec: FnRecord):
+        cfg = self._cfg_cache.get(rec.fid)
+        if cfg is None:
+            cfg = build_function_cfg(rec.node, rec.qualname)
+            self._cfg_cache[rec.fid] = cfg
+        return cfg
+
+    # -- computation ---------------------------------------------------------
+
+    def _compute(self) -> None:
+        idx = self.index
+        for fid, rec in idx.functions.items():
+            self.edges[fid] = idx.edges_from(rec)
+        for scc in _tarjan_sccs(
+                {f: [c for c, _ in self.edges[f]]
+                 for f in idx.functions}):
+            self._solve_scc(scc)
+        # counters_tail needs callee summaries finished, so it runs as a
+        # second pass in the same callee-first SCC order
+        for scc in self._scc_order:
+            self._tail_pass(scc)
+
+    def _solve_scc(self, scc: List[str]) -> None:
+        self._scc_order.append(scc)
+        for fid in scc:
+            self.summaries[fid] = self._local_summary(
+                self.index.functions[fid])
+        # acyclic (single node, no self-edge) converges in one pass;
+        # cyclic SCCs iterate the union fixpoint until stable
+        cyclic = len(scc) > 1 or any(
+            c == scc[0] for c, _ in self.edges[scc[0]])
+        changed = True
+        while changed:
+            changed = False
+            for fid in scc:
+                s = self.summaries[fid]
+                if s.annotated:
+                    continue
+                if self._propagate(self.index.functions[fid], s):
+                    changed = True
+            if not cyclic:
+                break
+
+    def _propagate(self, rec: FnRecord, s: Summary) -> bool:
+        changed = False
+        returned = self._returned_cache.get(rec.fid)
+        if returned is None:
+            returned = _returned_call_nodes(rec)
+            self._returned_cache[rec.fid] = returned
+        for callee_fid, site in self.edges[rec.fid]:
+            cs = self.summaries.get(callee_fid)
+            if cs is None:
+                continue        # other SCC not yet solved only if cyclic
+            bare = callee_fid.rsplit(":", 1)[-1].rsplit(".", 1)[-1]
+            step = f"{bare}()"
+            # pinned-handle production through a wrapper
+            if cs.returns_pinned and not s.returns_pinned and \
+                    site.kind == "call" and site.node in returned:
+                s.returns_pinned = True
+                s.pin_path = _chain(step, cs.pin_path)
+                changed = True
+            # releases-arg through a wrapper: our positional param passed
+            # straight into a releasing position of the callee
+            if cs.releases_params and site.kind == "call":
+                for j, arg in enumerate(site.node.args):
+                    if j in cs.releases_params and \
+                            isinstance(arg, ast.Name) and \
+                            arg.id in rec.pos_params:
+                        k = rec.pos_params.index(arg.id)
+                        if k not in s.releases_params:
+                            s.releases_params[k] = _chain(
+                                step, cs.releases_params[j])
+                            changed = True
+            for name, path in cs.counters.items():
+                if name not in s.counters:
+                    s.counters[name] = _chain(step, path)
+                    changed = True
+            for lock, path in cs.locks.items():
+                if lock not in s.locks:
+                    s.locks[lock] = _chain(step, path)
+                    changed = True
+            if s.engine is None:
+                callee_mod = self.index.functions[callee_fid].path
+                if callee_mod != rec.path and _is_engine_module(
+                        _mod_of(callee_mod)):
+                    s.engine = (f"calls {_mod_of(callee_mod)}."
+                                f"{_qual_of(callee_fid)}")
+                    changed = True
+                elif cs.engine is not None:
+                    s.engine = _chain(f"via {step}", cs.engine)
+                    changed = True
+            if s.may_block is None and cs.may_block is not None:
+                s.may_block = _chain(step, cs.may_block)
+                changed = True
+        return changed
+
+    def _tail_pass(self, scc: List[str]) -> None:
+        has_counters = [fid for fid in scc
+                        if self.summaries[fid].counters]
+        if not has_counters:
+            return
+        if len(scc) > 1:
+            # recursive counter mutation: conservatively not tail-safe
+            for fid in scc:
+                self.summaries[fid].counters_tail = False
+            return
+        fid = scc[0]
+        s = self.summaries[fid]
+        if s.annotated:
+            return
+        rec = self.index.functions[fid]
+        own_sites = list(_own_counter_sites(rec))
+        callee_sites = []
+        for callee_fid, site in self.edges[fid]:
+            cs = self.summaries.get(callee_fid)
+            if cs is None or site.kind != "call":
+                continue
+            if cs.counters:
+                if not cs.counters_tail or callee_fid == fid:
+                    s.counters_tail = False
+                    return
+                callee_sites.append(site.node)
+        sites = own_sites + callee_sites
+        if not sites:
+            # counters arrived via spawn edges only; treat as not tail
+            s.counters_tail = False
+            return
+        s.counters_tail = _sites_are_tail(self.cfg_of(rec), sites)
+
+    def _local_summary(self, rec: FnRecord) -> Summary:
+        ann = self._annotation_for(rec)
+        if ann is not None:
+            return ann
+        s = Summary(fid=rec.fid)
+        mod = self.index.modules[rec.path]
+        bare = rec.qualname.rsplit(".", 1)[-1]
+        qual_site = f"{_mod_of(rec.path)}.{rec.qualname}"
+
+        # pins: the package convention is that acquire-named functions
+        # ARE the pin-transfer APIs (pin_balance treats them so)
+        if bare in ACQUIRE_METHODS:
+            s.returns_pinned = True
+            s.pin_path = f"{qual_site} (acquire-named API)"
+        bound: Dict[str, str] = {}
+        for n in rec.assigns:
+            if len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    isinstance(n.value, ast.Call):
+                rm = _recv_of(n.value)
+                if rm and rm[1] in ACQUIRE_METHODS:
+                    bound[n.targets[0].id] = (
+                        f"{rm[0]}.{rm[1]}() in {qual_site}")
+        for n in rec.returns:
+            if s.returns_pinned or getattr(n, "value", None) is None:
+                continue
+            if isinstance(n.value, ast.Name) and n.value.id in bound:
+                s.returns_pinned = True
+                s.pin_path = bound[n.value.id]
+                continue
+            for sub in ast.walk(n.value):
+                if isinstance(sub, ast.Call):
+                    rm = _recv_of(sub)
+                    if rm and rm[1] in ACQUIRE_METHODS:
+                        s.returns_pinned = True
+                        s.pin_path = f"{rm[0]}.{rm[1]}() in {qual_site}"
+                        break
+
+        param_set = set(rec.pos_params)
+        for site in rec.call_sites:
+            if site.kind != "call":
+                continue
+            name = site.name
+            if "." in name:
+                recv, meth = name.rsplit(".", 1)
+                # releases-arg: a positional param unpinned here
+                if meth in RELEASE_METHODS and recv in param_set:
+                    s.releases_params.setdefault(
+                        rec.pos_params.index(recv),
+                        f"{recv}.{meth}() in {qual_site}")
+            if _is_counter_call(site.node):
+                for kw in site.node.keywords:
+                    if kw.arg:
+                        s.counters.setdefault(
+                            kw.arg, f"counter add in {qual_site}")
+            for suffix, lock_id in EXTERNAL_ACQUIRERS.items():
+                if name == suffix or name.endswith(suffix):
+                    s.locks.setdefault(
+                        lock_id, f"{name}() in {qual_site}")
+            if s.may_block is None:
+                for suffix, cat in BLOCKING_SUFFIXES.items():
+                    if name == suffix or name.endswith(suffix):
+                        s.may_block = f"{cat} ({name}) in {qual_site}"
+                        break
+        # element-wise release of a handle-collection param
+        for n in rec.loops:
+            if isinstance(n.iter, ast.Name) and \
+                    n.iter.id in param_set and \
+                    isinstance(n.target, ast.Name):
+                var = n.target.id
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Call):
+                        rm = _recv_of(sub)
+                        if rm and rm[0] == var and \
+                                rm[1] in RELEASE_METHODS:
+                            s.releases_params.setdefault(
+                                rec.pos_params.index(n.iter.id),
+                                f"element-wise {rm[1]}() in "
+                                f"{qual_site}")
+        for n in rec.augassigns:
+            if _is_metrics_augassign(n):
+                s.counters.setdefault(
+                    n.target.attr, f"metrics increment in {qual_site}")
+
+        # locks: lexical with-acquisitions
+        if rec.with_items:
+            table = self._lock_table(mod)
+            resolver = _Analyzer(mod.src, table, {})
+            resolver._names = [p for p in rec.qualname.split(".")
+                               if not p.startswith("<lambda")]
+            for expr in rec.with_items:
+                hit = resolver.resolve(expr)
+                if hit is not None:
+                    s.locks.setdefault(
+                        hit[0], f"with-block in {qual_site}")
+
+        # engine reach: references an engine import, or invokes an
+        # opaque callback (the one-module rule's own two signals)
+        engine_names = self._engine_names(mod)
+        hit_names = rec.refs & set(engine_names)
+        if hit_names:
+            n0 = sorted(hit_names)[0]
+            s.engine = (f"references engine import '{n0}' "
+                        f"({engine_names[n0]}) in {qual_site}")
+        elif rec.calls_param:
+            s.engine = (f"invokes an opaque callback parameter in "
+                        f"{qual_site}")
+        return s
+
+    def _lock_table(self, mod) -> _LockTable:
+        table = getattr(mod, "_lock_table", None)
+        if table is None:
+            table = _LockTable(mod.src)
+            table.visit(mod.src.tree)
+            mod._lock_table = table
+        return table
+
+    def _engine_names(self, mod) -> Dict[str, str]:
+        names = getattr(mod, "_engine_names", None)
+        if names is None:
+            names = {}
+            for name, src_mod in mod.imports.items():
+                for full in (src_mod, f"{src_mod}.{name}"):
+                    if _is_engine_module(full):
+                        names[name] = full
+                        break
+            mod._engine_names = names
+        return names
+
+    def _annotation_for(self, rec: FnRecord) -> Optional[Summary]:
+        lines = self.index.modules[rec.path].src.lines
+        m = None
+        for ln in (rec.line, rec.line - 1):
+            if 1 <= ln <= len(lines):
+                m = _SUMMARY_RE.search(lines[ln - 1])
+                if m:
+                    break
+        if m is None:
+            return None
+        s = Summary(fid=rec.fid, annotated=True)
+        site = f"summary annotation on {_mod_of(rec.path)}.{rec.qualname}"
+        for clause in m.group(1).split(","):
+            clause = clause.strip()
+            if not clause or clause == "pure":
+                continue
+            if clause == "returns-pinned":
+                s.returns_pinned, s.pin_path = True, site
+            elif clause == "engine-reaching":
+                s.engine = site
+            elif clause == "may-block":
+                s.may_block = f"declared blocking ({site})"
+            elif _RELEASES_RE.match(clause):
+                k = int(_RELEASES_RE.match(clause).group(1))
+                s.releases_params[k] = site
+            elif _COUNTERS_RE.match(clause):
+                for name in _COUNTERS_RE.match(clause).group(1).split():
+                    s.counters[name] = site
+                s.counters_tail = False
+            elif _LOCK_RE.match(clause):
+                s.locks[_LOCK_RE.match(clause).group(1)] = site
+            else:
+                self.annotation_problems.append(
+                    (rec.path, rec.line,
+                     f"summary annotation clause {clause!r} not "
+                     f"understood (see docs/linting.md for the "
+                     f"grammar)"))
+        return s
+
+
+def _mod_of(path: str) -> str:
+    p = path[len("spark_rapids_tpu/"):] if \
+        path.startswith("spark_rapids_tpu/") else path
+    return p[:-3] if p.endswith(".py") else p
+
+
+def _qual_of(fid: str) -> str:
+    return fid.rsplit(":", 1)[-1]
+
+
+def _returned_call_nodes(rec: FnRecord) -> Set[ast.AST]:
+    """Call nodes whose result is returned/yielded — directly, inside a
+    returned expression, or through a single local binding."""
+    out: Set[ast.AST] = set()
+    bound: Dict[str, ast.AST] = {}
+    for n in rec.assigns:
+        if len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name) and \
+                isinstance(n.value, ast.Call):
+            bound[n.targets[0].id] = n.value
+    for n in rec.returns:
+        value = getattr(n, "value", None)
+        if value is None:
+            continue
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                out.add(sub)
+            elif isinstance(sub, ast.Name) and sub.id in bound:
+                out.add(bound[sub.id])
+    return out
+
+
+def _own_counter_sites(rec: FnRecord) -> List[ast.AST]:
+    return ([site.node for site in rec.call_sites
+             if site.kind == "call" and _is_counter_call(site.node)]
+            + [n for n in rec.augassigns if _is_metrics_augassign(n)])
+
+
+def _sites_are_tail(cfg, sites: List[ast.AST]) -> bool:
+    """True when nothing fallible can run after ANY effect site (the
+    counter-discipline tail test, generalised to call-sites)."""
+    site_nodes = []            # (cfg node idx, site ast)
+    may_raise: Set[int] = set()
+    for node in cfg.stmt_nodes():
+        own = [s for s in sites
+               if any(sub is s for sub in ast.walk(node.stmt))]
+        for s in own:
+            site_nodes.append((node.idx, s))
+        if _stmt_may_raise_beyond(node.stmt, own):
+            may_raise.add(node.idx)
+    for idx, site in site_nodes:
+        if cfg.reachable_from(idx, skip_kinds=(BACK,)) & may_raise:
+            return False
+        if _may_still_raise(cfg.nodes[idx].stmt, site):
+            return False
+    return True
+
+
+def _tarjan_sccs(graph: Dict[str, List[str]]) -> List[List[str]]:
+    """Iterative Tarjan; SCCs emitted callees-first (reverse
+    topological order of the condensation)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in graph:
+        if root in index:
+            continue
+        work = [(root, iter(graph.get(root, ())))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in graph:
+                    continue
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(graph.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+# -- engine cache (keyed on tree identity, so edited fixtures re-index) ------
+
+_ENGINE_CACHE: Dict[tuple, SummaryEngine] = {}
+
+
+def build_engine(sources) -> SummaryEngine:
+    key = tuple(sorted((s.path, id(s.tree)) for s in sources
+                       if s.path.startswith("spark_rapids_tpu/")))
+    eng = _ENGINE_CACHE.get(key)
+    if eng is None:
+        if len(_ENGINE_CACHE) > 4:
+            _ENGINE_CACHE.clear()
+        eng = SummaryEngine(sources)
+        _ENGINE_CACHE[key] = eng
+    return eng

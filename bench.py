@@ -492,6 +492,141 @@ def _concurrent_bench() -> None:
     print(json.dumps(out))
 
 
+# -- open-loop load bench (bench.py --load) -----------------------------------
+#
+# Drives a real in-process mini cluster (TpuClusterDriver + executor
+# threads behind QueryQueue(ClusterDriverRunner)) with the open-loop
+# Poisson generator (tools/loadgen.py), overload protections and the
+# autoscaler armed.  The artifact is the serving-SLO story: offered vs
+# achieved rate, ok-latency p50/p99, the outcome taxonomy, and the
+# autoscale/shed/ratelimit/breaker event timeline from the telemetry
+# ring — written to BENCH_load_<ts>.json AND printed as the JSON line.
+
+LOAD_RATE = float(os.environ.get("SPARK_RAPIDS_TPU_BENCH_LOAD_RATE", 12.0))
+LOAD_DURATION_S = float(os.environ.get(
+    "SPARK_RAPIDS_TPU_BENCH_LOAD_DURATION", 15.0))
+LOAD_ROWS = int(os.environ.get("SPARK_RAPIDS_TPU_BENCH_LOAD_ROWS", 1 << 14))
+
+#: flight-recorder kinds that narrate the load story (the elasticity +
+#: overload decisions; see docs/fault_tolerance.md)
+LOAD_EVENT_KINDS = ("autoscale", "shed", "ratelimit", "breaker_trip",
+                    "breaker_fast_fail", "executor_join",
+                    "executor_leave", "executor_loss")
+
+
+def _load_bench() -> None:
+    import threading
+
+    _init_backend("cpu")
+    from tools import loadgen
+    from spark_rapids_tpu.cluster.autoscaler import attach_autoscaler
+    from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+    from spark_rapids_tpu.cluster.executor import executor_main
+    from spark_rapids_tpu.cluster.stats import (
+        local_shuffle_counters, reset_local_shuffle_counters)
+    from spark_rapids_tpu.serving import ClusterDriverRunner, QueryQueue
+    from spark_rapids_tpu.testing import tpch
+    from spark_rapids_tpu.utils.telemetry import TELEMETRY
+
+    conf = {
+        # cache off: an open-loop benchmark of IDENTICAL plans would
+        # otherwise measure the cache, not the serving tier
+        "spark.rapids.serving.cache.enabled": "false",
+        "spark.rapids.serving.maxConcurrent": "2",
+        "spark.rapids.serving.overload.enabled": "true",
+        "spark.rapids.serving.overload.sloP99Seconds": "2.0",
+        "spark.rapids.serving.overload.ratelimitQps": "8.0",
+        "spark.rapids.autoscale.enabled": "true",
+        "spark.rapids.autoscale.maxExecutors": "4",
+        "spark.rapids.autoscale.queueDepthHigh": "3",
+        "spark.rapids.autoscale.upCooldownSeconds": "2.0",
+        "spark.rapids.shuffle.replication.factor": "2",
+    }
+    stop = threading.Event()
+    driver = TpuClusterDriver(conf=conf, heartbeat_timeout_s=10.0)
+    seeds = []
+    for i in range(2):
+        t = threading.Thread(
+            target=executor_main, args=(driver.rpc_addr,),
+            kwargs={"executor_id": f"seed-{i}",
+                    "stop_check": stop.is_set, "poll_s": 0.05},
+            daemon=True, name=f"bench-exec-{i}")
+        t.start()
+        seeds.append(t)
+    driver.wait_for_executors(2, timeout_s=30)
+    TELEMETRY.configure(True, interval_ms=100, ring_seconds=120)
+    TELEMETRY.reset_events()
+    reset_local_shuffle_counters()
+
+    q = QueryQueue(ClusterDriverRunner(driver, timeout_s=60), conf=conf)
+    scaler = attach_autoscaler(driver, conf=conf, stop_event=stop)
+    batches = list(tpch.gen_lineitem(LOAD_ROWS,
+                                     batch_rows=max(LOAD_ROWS // 2, 1)))
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.serving import LocalSessionRunner
+    session = LocalSessionRunner({}).session
+
+    def submit(i, tenant, priority):
+        # map-only shape (filter + projection): executor ranks split the
+        # scan and return rows with NO exchange stage — the launched
+        # ranks here are threads of ONE process, and the process-wide
+        # shuffle transport cannot serve two exchanging ranks at once
+        # (real multi-rank shuffles run process-split: tests/
+        # test_cluster.py).  The load story is the serving control
+        # plane, which this shape exercises fully.
+        df = session.create_dataframe(list(batches), num_partitions=2)
+        plan = df.filter(col("l_linenumber") < lit(5)).select(
+            "l_orderkey", "l_linenumber").plan
+        return q.submit(plan, tenant=tenant, priority=priority,
+                        timeout_s=45.0)
+
+    t0 = time.time()
+    summary = loadgen.run_load(
+        submit, LOAD_RATE, LOAD_DURATION_S,
+        seed=int(os.environ.get("SPARK_RAPIDS_TPU_BENCH_LOAD_SEED", 0)),
+        mix=[("dash", 0), ("etl", 2), ("adhoc", 3)])
+    TELEMETRY.sample()
+    timeline = [e for e in TELEMETRY.events()
+                if e.get("kind") in LOAD_EVENT_KINDS]
+    counters = local_shuffle_counters()
+    rows_ok = LOAD_ROWS * summary["outcomes"]["ok"]
+    out = {
+        "metric": "serving_load_rows_per_sec",
+        "value": round(rows_ok / summary["wall_s"]) if summary["wall_s"]
+        else 0,
+        "unit": "rows/s",
+        "backend": "cpu",
+        "offered_qps": summary["offered_qps"],
+        "achieved_qps": summary["achieved_qps"],
+        "rows_per_query": LOAD_ROWS,
+        "ok_latency_s": summary["ok_latency_s"],
+        "outcomes": summary["outcomes"],
+        "per_tenant": summary["per_tenant"],
+        "elasticity_counters": {
+            k: counters[k] for k in
+            ("autoscale_up", "autoscale_down", "queries_shed",
+             "ratelimit_rejections", "breaker_trips",
+             "breaker_fast_fails", "scoped_resubmits")},
+        "event_timeline": [
+            {**{k: v for k, v in e.items() if k != "t"},
+             "t_s": round(e["t"] - t0, 3)} for e in timeline],
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_load_{int(t0)}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, records=summary["records"]), f, indent=1)
+    out["artifact"] = path
+    try:
+        q.close()
+        if scaler is not None:
+            scaler.stop()
+        stop.set()
+        driver.close()
+    except Exception:   # noqa: BLE001 — teardown must not eat the result
+        pass
+    print(json.dumps(out))
+
+
 # -- parent side --------------------------------------------------------------
 
 def _spawn(backend: str, mode: str, timeout_s: int,
@@ -618,6 +753,17 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--load" in sys.argv:
+        # open-loop serving-load mode: in-process mini cluster, CPU
+        # backend, same resilience contract as the main harness
+        try:
+            _load_bench()
+        except Exception as e:  # noqa: BLE001 — resilience contract
+            print(json.dumps({
+                "metric": "serving_load_rows_per_sec",
+                "value": 0, "unit": "rows/s", "backend": "none",
+                "error": [f"load: {type(e).__name__}: {e}"]}))
+        sys.exit(0)
     if "--concurrent" in sys.argv:
         # serving-layer mode: in-process, CPU backend, never exits
         # non-zero (same resilience contract as the main harness)

@@ -1,0 +1,443 @@
+"""Closed-loop elasticity: the autoscaler policy daemon.
+
+Spark-on-GPU clusters scale on executor counts through dynamic
+allocation (ExecutorAllocationManager: pending-task pressure scales
+out, sustained idle scales in, with request/remove cooldowns).  The
+TPU serving tier closes the same loop over its OWN telemetry plane:
+the policy consumes the resource ring (utils/telemetry.py — admission
+queue depth, windowed admission-wait p99 from ``admission_wait_s``
+bucket-count deltas, arena pressure) plus the heartbeat registry's
+live-capacity view (shuffle/net.py), and drives the cluster membership
+hooks — scale-out launches fresh executor ranks, scale-in ONLY ever
+drains gracefully (``TpuClusterDriver.request_drain`` → the rank
+re-replicates its primaries and deregisters; a scale-in must never
+cost a ``scoped_resubmits``).
+
+Control-loop discipline (the part that separates an autoscaler from a
+thrash generator):
+
+  * HYSTERESIS — scale-out triggers on breach of high thresholds
+    (``queueDepthHigh`` / ``admissionWaitP99High`` /
+    ``arenaPressureHigh``); scale-in requires a sustained
+    ``idleSeconds`` of ZERO pressure, not merely "below high".
+  * COOLDOWNS — ``upCooldownSeconds`` between scale-outs,
+    ``downCooldownSeconds`` between scale-ins.
+  * FLAP SUPPRESSION — ``flapSeconds`` minimum gap between
+    opposite-direction decisions (an up right after a down, or vice
+    versa, means the thresholds are arguing, not the load).
+  * PENDING-CAPACITY ACCOUNTING — a launched-but-not-yet-registered
+    rank counts toward capacity until ``joinTimeoutSeconds``, so a
+    slow join (chaos ``cluster.join.delay``) must not trigger a
+    second redundant scale-out; an expired pending is forgotten and
+    the policy may try again.
+  * BOUNDS — capacity stays within [minExecutors, maxExecutors].
+
+Every decision is a flight-recorder event (``autoscale`` kind), a
+counter (``autoscale_up``/``autoscale_down``), and a trace span
+(``autoscale.scale_out``/``autoscale.scale_in``); every tick runs
+under ``autoscale.decide``.  Launch failures (chaos
+``cluster.join.fail``) retry under the named ``cluster.join``
+RetryBudget.  Clock and sleep are injectable so the policy unit tests
+pin exact decisions deterministically.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS, Histogram
+from spark_rapids_tpu.testing.chaos import CHAOS
+from spark_rapids_tpu.utils.obs import span
+from spark_rapids_tpu.utils.retry_budget import (RetryBudget,
+                                                 RetryBudgetExhausted)
+from spark_rapids_tpu.utils.telemetry import TELEMETRY, record_event
+
+log = logging.getLogger("spark_rapids_tpu.autoscale")
+
+#: shared bucket bounds for windowed p99 reconstruction — the ring's
+#: ``admission_wait_s`` snapshots all come from stats.Histogram with
+#: default geometry, so the bounds are reconstructible offline
+_BOUNDS: List[float] = Histogram().bounds
+
+
+def windowed_admission_p99(ring: List[dict]) -> float:
+    """p99 of admission waits recorded ACROSS the ring window, from
+    ``admission_wait_s`` bucket-count deltas between the oldest and
+    newest samples.  Cumulative histograms only ever grow, so the
+    delta isolates exactly the waits of the window — the cumulative
+    p99 would never come back down after one bad epoch, and an
+    autoscaler keyed on it would never scale back in.  0.0 when the
+    window saw no admissions (no pressure signal)."""
+    if len(ring) < 2:
+        return 0.0
+    h0 = (ring[0].get("histograms") or {}).get("admission_wait_s")
+    h1 = (ring[-1].get("histograms") or {}).get("admission_wait_s")
+    if not h0 or not h1:
+        return 0.0
+    c0, c1 = h0.get("counts") or [], h1.get("counts") or []
+    delta = [max(b - a, 0) for a, b in zip(c0, c1)]
+    total = sum(delta)
+    if total == 0:
+        return 0.0
+    target = max(int(total * 0.99), 1)
+    cum = 0
+    for i, c in enumerate(delta):
+        cum += c
+        if cum >= target:
+            if i >= len(_BOUNDS):
+                return float(h1.get("max_s", _BOUNDS[-1]))
+            return min(_BOUNDS[i], float(h1.get("max_s", _BOUNDS[i])))
+    return float(h1.get("max_s", 0.0))
+
+
+class AutoscaleDecision:
+    """One policy verdict: ``action`` is ``scale_out``/``scale_in``/
+    ``hold``, ``count`` ranks affected (0 for hold), ``reason`` the
+    human-readable why — pinned verbatim by the policy unit tests and
+    carried on the flight-recorder event."""
+
+    def __init__(self, action: str, count: int, reason: str):
+        self.action = action
+        self.count = count
+        self.reason = reason
+
+    def __repr__(self):
+        return (f"AutoscaleDecision({self.action!r}, {self.count}, "
+                f"{self.reason!r})")
+
+
+class AutoscalePolicy:
+    """The pure decision function (no threads, no I/O): signals in,
+    ``AutoscaleDecision`` out, with hysteresis/cooldown/flap state
+    keyed off the injectable clock.  Separated from the daemon so the
+    unit tests drive it tick-by-tick against synthetic signals."""
+
+    def __init__(self, conf, clock: Callable[[], float] = time.monotonic):
+        self.min_executors = max(conf.autoscale_min_executors, 0)
+        self.max_executors = max(conf.autoscale_max_executors,
+                                 self.min_executors)
+        self.queue_depth_high = conf.autoscale_queue_depth_high
+        self.wait_p99_high_s = conf.autoscale_wait_p99_high
+        self.arena_pressure_high = conf.autoscale_arena_pressure_high
+        self.scale_out_step = max(conf.autoscale_scale_out_step, 1)
+        self.up_cooldown_s = conf.autoscale_up_cooldown
+        self.down_cooldown_s = conf.autoscale_down_cooldown
+        self.idle_s = conf.autoscale_idle_seconds
+        self.flap_s = conf.autoscale_flap_seconds
+        self._clock = clock
+        self._last_up: Optional[float] = None
+        self._last_down: Optional[float] = None
+        #: start of the current zero-pressure streak (None while under
+        #: any pressure) — the scale-in hysteresis
+        self._idle_since: Optional[float] = None
+
+    def decide(self, queue_depth: int, wait_p99_s: float,
+               arena_pressure: float, available: int, draining: int,
+               pending: int) -> AutoscaleDecision:
+        now = self._clock()
+        capacity = available + pending
+        reasons = []
+        if queue_depth >= self.queue_depth_high:
+            reasons.append(f"queue_depth {queue_depth} >= "
+                           f"{self.queue_depth_high}")
+        if wait_p99_s > self.wait_p99_high_s:
+            reasons.append(f"admission-wait p99 {wait_p99_s:.3f}s > "
+                           f"{self.wait_p99_high_s:.3f}s")
+        if arena_pressure > self.arena_pressure_high:
+            reasons.append(f"arena pressure {arena_pressure:.2f} > "
+                           f"{self.arena_pressure_high:.2f}")
+        pressure = bool(reasons)
+        # the idle streak resets on ANY pressure, including pressure
+        # that could not act (cooldown/bounds): "idle" means the
+        # cluster truly had nothing to complain about
+        if pressure or queue_depth > 0:
+            self._idle_since = None
+        elif self._idle_since is None:
+            self._idle_since = now
+
+        if pressure:
+            if capacity >= self.max_executors:
+                return AutoscaleDecision(
+                    "hold", 0, f"at maxExecutors={self.max_executors} "
+                    f"({'; '.join(reasons)})")
+            if pending > 0:
+                # pending-capacity accounting: the rank answering this
+                # pressure is still joining (maybe slowly — chaos
+                # cluster.join.delay); a second scale-out now would be
+                # redundant capacity the moment it lands
+                return AutoscaleDecision("hold", 0,
+                                         "pending join in flight")
+            if (self._last_up is not None
+                    and now - self._last_up < self.up_cooldown_s):
+                return AutoscaleDecision("hold", 0, "up-cooldown")
+            if (self._last_down is not None
+                    and now - self._last_down < self.flap_s):
+                return AutoscaleDecision("hold", 0, "flap-suppressed "
+                                         "(recent scale-in)")
+            count = min(self.scale_out_step,
+                        self.max_executors - capacity)
+            self._last_up = now
+            return AutoscaleDecision("scale_out", count,
+                                     "; ".join(reasons))
+
+        # no pressure: consider scale-in, one graceful drain at a time
+        if (self._idle_since is not None
+                and now - self._idle_since >= self.idle_s
+                and available > self.min_executors
+                and pending == 0 and draining == 0):
+            if (self._last_down is not None
+                    and now - self._last_down < self.down_cooldown_s):
+                return AutoscaleDecision("hold", 0, "down-cooldown")
+            if (self._last_up is not None
+                    and now - self._last_up < self.flap_s):
+                return AutoscaleDecision("hold", 0, "flap-suppressed "
+                                         "(recent scale-out)")
+            self._last_down = now
+            return AutoscaleDecision(
+                "scale_in", 1,
+                f"idle {now - self._idle_since:.1f}s >= "
+                f"{self.idle_s:.1f}s")
+        return AutoscaleDecision("hold", 0, "steady")
+
+
+class Autoscaler:
+    """The daemon around the policy: reads signals, actuates decisions
+    through pluggable ``launcher(eid)`` / ``drainer(eid)`` hooks,
+    tracks pending launches.  ``tick()`` is the deterministic test
+    entry; ``start()`` runs it on the conf'd interval.
+
+    ``launcher`` spawns one executor that will register under ``eid``
+    (see :func:`thread_launcher`); it runs on a worker thread under
+    the chaos sites + the ``cluster.join`` RetryBudget, so a slow or
+    failing join never wedges the control loop.  ``drainer`` begins a
+    graceful drain (``TpuClusterDriver.request_drain``)."""
+
+    def __init__(self, registry, launcher: Callable[[str], None],
+                 drainer: Callable[[str], bool], conf=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 signals: Optional[Callable[[], dict]] = None):
+        from spark_rapids_tpu.config import RapidsConf
+        if conf is None or isinstance(conf, dict):
+            conf = RapidsConf(conf or {})
+        self.registry = registry
+        self.launcher = launcher
+        self.drainer = drainer
+        self.policy = AutoscalePolicy(conf, clock=clock)
+        self.interval_s = conf.autoscale_interval_ms / 1000.0
+        self.join_timeout_s = conf.autoscale_join_timeout
+        self.join_retries = conf.autoscale_join_retries
+        self._signals = signals if signals is not None \
+            else self._signals_from_ring
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: eid -> launch time: capacity the policy already paid for but
+        #: the registry cannot see yet; expires at join_timeout
+        self._pending: Dict[str, float] = {}
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._launch_threads: List[threading.Thread] = []
+
+    # -- signals ------------------------------------------------------------
+
+    def _signals_from_ring(self) -> dict:
+        """Live signals from the process-wide telemetry ring: latest
+        queue depth + arena pressure, windowed admission-wait p99."""
+        ring = TELEMETRY.ring()
+        latest = ring[-1] if ring else None
+        gauges = (latest or {}).get("gauges") or {}
+        budget = gauges.get("arena_budget_bytes") or 0
+        used = gauges.get("arena_used_bytes") or 0
+        return {
+            "queue_depth": int(gauges.get("admission_queue_depth") or 0),
+            "wait_p99_s": windowed_admission_p99(ring),
+            "arena_pressure": (used / budget) if budget else 0.0,
+        }
+
+    def pending(self) -> List[str]:
+        """Launches in flight (pruned of expired/landed)."""
+        self._prune_pending()
+        with self._lock:
+            return sorted(self._pending)
+
+    def _prune_pending(self) -> None:
+        now = self._clock()
+        known = set(self.registry.peers())
+        with self._lock:
+            for eid in list(self._pending):
+                if eid in known:
+                    del self._pending[eid]       # join landed
+                elif now - self._pending[eid] > self.join_timeout_s:
+                    del self._pending[eid]       # join presumed dead
+                    record_event("autoscale", action="join_timeout",
+                                 eid=eid)
+
+    # -- one policy tick ----------------------------------------------------
+
+    def tick(self) -> AutoscaleDecision:
+        """One control-loop iteration: prune pending, read signals,
+        decide, actuate.  Deterministic given injected clock/signals —
+        the policy unit tests call this directly."""
+        with span("autoscale.decide"):
+            self._prune_pending()
+            cap = self.registry.live_capacity()
+            with self._lock:
+                n_pending = len(self._pending)
+            sig = self._signals()
+            decision = self.policy.decide(
+                queue_depth=sig["queue_depth"],
+                wait_p99_s=sig["wait_p99_s"],
+                arena_pressure=sig["arena_pressure"],
+                available=len(cap["available"]),
+                draining=len(cap["draining"]),
+                pending=n_pending)
+            if decision.action == "scale_out":
+                self._scale_out(decision, sig)
+            elif decision.action == "scale_in":
+                self._scale_in(decision, cap["available"], sig)
+            return decision
+
+    def _scale_out(self, decision: AutoscaleDecision, sig: dict) -> None:
+        with span("autoscale.scale_out"):
+            SHUFFLE_COUNTERS.add(autoscale_up=1)
+            eids = []
+            now = self._clock()
+            with self._lock:
+                for _ in range(decision.count):
+                    self._seq += 1
+                    eid = f"autoscale-{self._seq}"
+                    self._pending[eid] = now
+                    eids.append(eid)
+            record_event("autoscale", action="scale_out", eids=eids,
+                         reason=decision.reason,
+                         queue_depth=sig["queue_depth"],
+                         wait_p99_s=round(sig["wait_p99_s"], 4))
+            log.info("autoscale: scale-out %s (%s)", eids,
+                     decision.reason)
+            for eid in eids:
+                # launches run off-thread: a slow join (chaos
+                # cluster.join.delay) must not stall the policy loop —
+                # pending-capacity accounting covers the gap
+                # tpu-lint: allow-ambient-propagation(the launcher spawns a process-wide executor rank, not query work; binding it to one query's ambients would be wrong by construction)
+                t = threading.Thread(
+                    target=self._launch_with_retry, args=(eid,),
+                    daemon=True, name=f"tpu-autoscale-launch-{eid}")
+                t.start()
+                self._launch_threads.append(t)
+
+    def _launch_with_retry(self, eid: str) -> None:
+        """The launch wrapper: chaos sites + the named RetryBudget.
+        Exhaustion forgets the pending slot (so the policy may scale
+        out again) and records the failure — it never raises into the
+        daemon."""
+        budget = RetryBudget("cluster.join",
+                             max_attempts=max(self.join_retries, 1))
+        while True:
+            try:
+                CHAOS.delay("cluster.join.delay")
+                CHAOS.raise_if("cluster.join.fail")
+                self.launcher(eid)
+                return
+            except Exception as e:  # noqa: BLE001 — budget decides
+                try:
+                    budget.backoff(error=e)
+                except RetryBudgetExhausted as exhausted:
+                    with self._lock:
+                        self._pending.pop(eid, None)
+                    record_event("autoscale", action="join_failed",
+                                 eid=eid, error=str(exhausted))
+                    log.warning("autoscale: launch of %s failed: %s",
+                                eid, exhausted)
+                    return
+
+    def _scale_in(self, decision: AutoscaleDecision,
+                  available: List[str], sig: dict) -> None:
+        with span("autoscale.scale_in"):
+            # prefer draining ranks this autoscaler launched (scale-in
+            # unwinds scale-out before touching the seed topology);
+            # fall back to the highest-sorted rank — deterministic
+            # either way
+            own = [e for e in available if e.startswith("autoscale-")]
+            victim = sorted(own)[-1] if own else sorted(available)[-1]
+            if not self.drainer(victim):
+                record_event("autoscale", action="drain_refused",
+                             eid=victim)
+                return
+            SHUFFLE_COUNTERS.add(autoscale_down=1)
+            record_event("autoscale", action="scale_in", eid=victim,
+                         reason=decision.reason,
+                         queue_depth=sig["queue_depth"])
+            log.info("autoscale: scale-in draining %s (%s)", victim,
+                     decision.reason)
+
+    # -- daemon lifecycle ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        # tpu-lint: allow-ambient-propagation(the autoscaler is a process-wide control loop over shared cluster capacity; binding it to one query's ambients would be wrong by construction)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpu-autoscaler")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the control loop must
+                # outlive one bad tick (a torn ring sample, a racing
+                # registry mutation); the NEXT tick re-reads everything
+                log.warning("autoscaler tick failed", exc_info=True)
+            self._stop.wait(self.interval_s)
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+        for lt in list(self._launch_threads):
+            lt.join(timeout=timeout_s)
+
+
+def thread_launcher(driver, stop_event: Optional[threading.Event] = None,
+                    poll_s: float = 0.05) -> Callable[[str], None]:
+    """``launcher(eid)`` for in-process elasticity (tests, bench, the
+    single-host serving posture): runs a real ``executor_main`` against
+    ``driver.rpc_addr`` on a daemon thread.  ``stop_event`` tears the
+    launched ranks down with the harness."""
+    def launch(eid: str) -> None:
+        from spark_rapids_tpu.cluster.executor import executor_main
+        # tpu-lint: allow-ambient-propagation(launches a process-wide executor rank serving every query, not one query's work)
+        t = threading.Thread(
+            target=executor_main, args=(driver.rpc_addr,),
+            kwargs={"executor_id": eid,
+                    "stop_check": (stop_event.is_set
+                                   if stop_event is not None else None),
+                    "poll_s": poll_s},
+            daemon=True, name=f"tpu-exec-{eid}")
+        t.start()
+    return launch
+
+
+def attach_autoscaler(driver, conf=None,
+                      stop_event: Optional[threading.Event] = None,
+                      signals: Optional[Callable[[], dict]] = None
+                      ) -> Optional[Autoscaler]:
+    """Convenience wiring for the common shape: policy over the
+    driver's registry, thread-launched executors, graceful drains via
+    ``request_drain``.  Returns None (and builds nothing) unless
+    ``spark.rapids.autoscale.enabled`` — with the knob off the cluster
+    runs exactly the fixed-topology code path."""
+    from spark_rapids_tpu.config import RapidsConf
+    if conf is None or isinstance(conf, dict):
+        conf = RapidsConf(conf or {})
+    if not conf.autoscale_enabled:
+        return None
+    scaler = Autoscaler(driver.shuffle.registry,
+                        thread_launcher(driver, stop_event=stop_event),
+                        driver.request_drain, conf=conf, signals=signals)
+    scaler.start()
+    return scaler

@@ -182,7 +182,14 @@ class TpuClusterDriver:
                         if task is not None:
                             driver._note_pickup_locked(task, eid)
                     if task is None:
-                        _send_msg(self.request, {"task": None})
+                        reply = {"task": None}
+                        if eid in driver.shuffle.registry.draining():
+                            # scale-in handshake: the rank is marked
+                            # draining AND its queue is empty — tell it
+                            # to leave gracefully (idempotent: the mark
+                            # clears when its wire `leave` lands)
+                            reply["drain"] = True
+                        _send_msg(self.request, reply)
                     else:
                         _send_msg(self.request,
                                   {"task": {k: v for k, v in task.items()
@@ -305,6 +312,15 @@ class TpuClusterDriver:
         raise TimeoutError(
             f"only {len(self.shuffle.registry.peers(workers_only=True))} "
             f"of {n} executors registered")
+
+    def request_drain(self, executor_id: str) -> bool:
+        """Begin a graceful scale-in drain of one rank (the autoscaler's
+        scale-in actuation): the registry marks it draining (out of
+        available capacity immediately — new submissions plan around
+        it), and the next empty `get_task` poll tells the executor to
+        re-replicate its primaries, deregister, and exit.  Returns False
+        for an unknown/stale rank."""
+        return self.shuffle.registry.begin_drain(executor_id)
 
     def cancel(self, query_id: int,
                reason: str = "cancelled by caller") -> bool:
@@ -661,8 +677,11 @@ class TpuClusterDriver:
                      deadline_remaining_s: Optional[float] = None
                      ) -> list:
         from spark_rapids_tpu.config import RapidsConf
-        executors = sorted(
-            self.shuffle.registry.peers(workers_only=True))
+        # dispatch to AVAILABLE capacity only (the registry's single
+        # live-capacity definition): a draining rank finishes what it
+        # already holds and keeps serving fetches, but a query planned
+        # across it would lose a participant mid-run
+        executors = self.shuffle.registry.live_capacity()["available"]
         assert executors, "no executors registered"
         world = len(executors)
         merged = dict(self.conf_map)
@@ -748,6 +767,12 @@ class TpuClusterDriver:
                     cancel_exc = e
                     break
                 live = self.shuffle.registry.peers(workers_only=True)
+                # adoption targets (re-dispatch/speculation) come from
+                # AVAILABLE capacity: a draining rank still counts as
+                # live (its in-flight attempt may finish; its blocks
+                # serve) but must never be handed new work
+                avail = set(
+                    self.shuffle.registry.live_capacity()["available"])
                 now = time.monotonic()
                 with self._lock:
                     results = dict(self._results.get(qid, {}))
@@ -830,8 +855,10 @@ class TpuClusterDriver:
                             record_event("executor_loss", eid=eid,
                                          query_id=qid, durable=True)
                     live = self.shuffle.registry.peers(workers_only=True)
+                    avail = set(
+                        self.shuffle.registry.live_capacity()["available"])
                     with self._lock:
-                        idle = self._idle_executors_locked(qid, live)
+                        idle = self._idle_executors_locked(qid, avail)
                         for r in lost_ranks:
                             if not idle:
                                 break   # wait for a survivor to free up
@@ -852,7 +879,7 @@ class TpuClusterDriver:
                     threshold = max(baseline
                                     * rc.speculation_multiplier, 1e-3)
                     with self._lock:
-                        idle = self._idle_executors_locked(qid, live)
+                        idle = self._idle_executors_locked(qid, avail)
                         for r in pending:
                             recs = self._attempts[qid].get(r, [])
                             if len(recs) != 1 or not idle:

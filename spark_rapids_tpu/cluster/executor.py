@@ -526,6 +526,24 @@ def executor_main(driver_rpc_addr: Tuple[str, int],
                 continue
             task = header.get("task")
             if task is None:
+                if header.get("drain"):
+                    # graceful scale-in (cluster/autoscaler.py): the
+                    # driver marked this rank draining and its queue is
+                    # empty — re-replicate primary blocks so surviving
+                    # peers keep every partition reachable, deregister,
+                    # exit.  NO cleanup of pending_cleanup here: a peer
+                    # may still be fetching those blocks, and
+                    # leave(drain=True) re-homes them first.
+                    log.info("executor %s: drain requested; leaving "
+                             "gracefully", node.executor_id)
+                    try:
+                        node.leave(drain=True)
+                    except Exception as e:  # noqa: BLE001 — drain is
+                        # best-effort; a failed re-replication must not
+                        # strand the process (the driver excludes us on
+                        # heartbeat timeout either way)
+                        log.warning("drain leave failed: %s", e)
+                    return
                 now = time.monotonic()
                 if now - last_hb > 5.0:
                     node.heartbeat()

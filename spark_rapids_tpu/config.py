@@ -708,6 +708,165 @@ SERVING_TENANTS = conf("spark.rapids.serving.tenants").doc(
     "defaultBudgetBytes/defaultWeight knobs."
 ).string_conf("")
 
+SERVING_OVERLOAD_ENABLED = conf("spark.rapids.serving.overload.enabled").doc(
+    "Arm the serving-layer overload protections (serving/overload.py): "
+    "priority-aware load shedding when admission-wait p99 exceeds the "
+    "SLO target, per-tenant token-bucket rate limits, and the per-plan-"
+    "fingerprint circuit breaker.  Off (the default) no overload state "
+    "is constructed and the submit path is byte-identical to the "
+    "pre-overload behavior."
+).boolean_conf(False)
+
+SERVING_OVERLOAD_SLO_P99 = conf(
+    "spark.rapids.serving.overload.sloP99Seconds").doc(
+    "Admission-wait p99 SLO target in seconds: when the windowed p99 "
+    "of admission_wait_s exceeds it, the shedder starts rejecting "
+    "shed-eligible submissions with AdmissionRejected(shed) instead of "
+    "letting every tenant's tail latency grow unboundedly."
+).double_conf(2.0)
+
+SERVING_OVERLOAD_SHED_WINDOW = conf(
+    "spark.rapids.serving.overload.shedWindowSeconds").doc(
+    "Sliding window in seconds over which the shedder computes the "
+    "admission-wait p99 it compares against sloP99Seconds."
+).double_conf(30.0)
+
+SERVING_OVERLOAD_SHED_PRIORITY_FLOOR = conf(
+    "spark.rapids.serving.overload.shedPriorityFloor").doc(
+    "Only submissions at this priority or WORSE (priority is lower-"
+    "first, so numerically >= floor) are shed-eligible: latency-"
+    "critical work above the floor rides through an overload un-shed."
+).int_conf(1)
+
+SERVING_OVERLOAD_SHED_GUARANTEE = conf(
+    "spark.rapids.serving.overload.shedGuaranteeSeconds").doc(
+    "Anti-starvation bound: a tenant that has had no admitted "
+    "submission within this many seconds is exempt from shedding — "
+    "under sustained overload every tenant still makes progress at a "
+    "trickle instead of the lowest-priority tenant starving to zero."
+).double_conf(10.0)
+
+SERVING_OVERLOAD_RATELIMIT_QPS = conf(
+    "spark.rapids.serving.overload.ratelimitQps").doc(
+    "Per-tenant token-bucket refill rate in submissions/second (0 = "
+    "no rate limit).  A tenant submitting faster than its bucket "
+    "refills is rejected with AdmissionRejected(ratelimited) before "
+    "admission — abusive arrival rates never reach the queue."
+).double_conf(0.0)
+
+SERVING_OVERLOAD_RATELIMIT_BURST = conf(
+    "spark.rapids.serving.overload.ratelimitBurst").doc(
+    "Token-bucket capacity per tenant: bursts up to this many "
+    "submissions pass before the ratelimitQps refill rate governs."
+).int_conf(10)
+
+SERVING_OVERLOAD_BREAKER_FAILURES = conf(
+    "spark.rapids.serving.overload.breakerFailures").doc(
+    "Consecutive failures of one plan fingerprint after which its "
+    "circuit breaker OPENS: further identical submissions fail fast "
+    "with AdmissionRejected(breaker) instead of re-burning cluster "
+    "capacity on a query that keeps crashing."
+).int_conf(3)
+
+SERVING_OVERLOAD_BREAKER_RESET = conf(
+    "spark.rapids.serving.overload.breakerResetSeconds").doc(
+    "Seconds an OPEN breaker waits before HALF-OPEN: one probe "
+    "submission is let through — success closes the breaker, failure "
+    "re-opens it for another reset interval."
+).double_conf(30.0)
+
+AUTOSCALE_ENABLED = conf("spark.rapids.autoscale.enabled").doc(
+    "Arm the elasticity control loop (cluster/autoscaler.py): a policy "
+    "daemon consumes the telemetry rings (admission queue depth, "
+    "admission-wait p99, arena pressure) and drives executor launches "
+    "and graceful drains within [minExecutors, maxExecutors].  Off "
+    "(the default) no daemon runs and cluster behavior is byte-"
+    "identical to the pre-autoscaler loop."
+).boolean_conf(False)
+
+AUTOSCALE_MIN_EXECUTORS = conf("spark.rapids.autoscale.minExecutors").doc(
+    "Lower capacity bound: scale-in never drains below this many "
+    "available executors."
+).int_conf(1)
+
+AUTOSCALE_MAX_EXECUTORS = conf("spark.rapids.autoscale.maxExecutors").doc(
+    "Upper capacity bound: scale-out never launches past this many "
+    "executors counting available AND pending (launched, not yet "
+    "joined) ranks."
+).int_conf(8)
+
+AUTOSCALE_INTERVAL_MS = conf("spark.rapids.autoscale.intervalMs").doc(
+    "Autoscaler policy tick period in milliseconds (min 50)."
+).int_conf(500)
+
+AUTOSCALE_QUEUE_DEPTH_HIGH = conf(
+    "spark.rapids.autoscale.queueDepthHigh").doc(
+    "Scale-out trigger: admission queue depth (queries WAITING for a "
+    "slot, from the telemetry ring) at or above this breaches the "
+    "policy's pressure threshold."
+).int_conf(4)
+
+AUTOSCALE_WAIT_P99_HIGH = conf(
+    "spark.rapids.autoscale.admissionWaitP99High").doc(
+    "Scale-out trigger: windowed admission-wait p99 in seconds (from "
+    "the admission_wait_s histogram bucket deltas across the telemetry "
+    "ring) above this breaches the policy's pressure threshold."
+).double_conf(1.0)
+
+AUTOSCALE_ARENA_PRESSURE_HIGH = conf(
+    "spark.rapids.autoscale.arenaPressureHigh").doc(
+    "Scale-out trigger: arena_used_bytes/arena_budget_bytes above this "
+    "fraction (on a budgeted arena) breaches the policy's pressure "
+    "threshold — memory pressure scales out before queue depth shows "
+    "it."
+).double_conf(0.9)
+
+AUTOSCALE_SCALE_OUT_STEP = conf("spark.rapids.autoscale.scaleOutStep").doc(
+    "Executors launched per scale-out decision (bounded by "
+    "maxExecutors minus available+pending capacity)."
+).int_conf(1)
+
+AUTOSCALE_UP_COOLDOWN = conf(
+    "spark.rapids.autoscale.upCooldownSeconds").doc(
+    "Minimum seconds between scale-out decisions: launched capacity "
+    "gets time to join and absorb load before the policy re-evaluates "
+    "(hysteresis against launch stampedes)."
+).double_conf(10.0)
+
+AUTOSCALE_DOWN_COOLDOWN = conf(
+    "spark.rapids.autoscale.downCooldownSeconds").doc(
+    "Minimum seconds between scale-in decisions (drains are deliberate "
+    "and rare: each one re-replicates the rank's blocks)."
+).double_conf(30.0)
+
+AUTOSCALE_IDLE_SECONDS = conf("spark.rapids.autoscale.idleSeconds").doc(
+    "Scale-in trigger: the cluster must show ZERO admission pressure "
+    "(empty queue, no breach) continuously for this many seconds "
+    "before one rank is drained — momentary idleness never scales in."
+).double_conf(20.0)
+
+AUTOSCALE_FLAP_SECONDS = conf("spark.rapids.autoscale.flapSeconds").doc(
+    "Flap suppression: minimum seconds between OPPOSITE-direction "
+    "decisions (a scale-out forbids any scale-in for this long and "
+    "vice versa), so oscillating load can't thrash launch/drain "
+    "cycles."
+).double_conf(60.0)
+
+AUTOSCALE_JOIN_TIMEOUT = conf(
+    "spark.rapids.autoscale.joinTimeoutSeconds").doc(
+    "Seconds a launched executor may take to register before its "
+    "PENDING capacity expires: a slow join holds its slot (no second "
+    "redundant scale-out, chaos site cluster.join.delay) until this "
+    "bound, after which the policy may launch a replacement."
+).double_conf(30.0)
+
+AUTOSCALE_JOIN_RETRIES = conf("spark.rapids.autoscale.joinRetries").doc(
+    "Launch attempts per scale-out decision under the named "
+    "cluster.join RetryBudget (chaos site cluster.join.fail): a failed "
+    "spawn retries with backoff instead of silently shrinking the "
+    "decision."
+).int_conf(3)
+
 TRACE_ENABLED = conf("spark.rapids.trace.enabled").doc(
     "Arm the query-scoped observability plane (utils/obs.py): every "
     "serving/cluster submission runs under a QueryTrace ambient that "
@@ -1129,6 +1288,98 @@ class RapidsConf:
     @property
     def metrics_ring_seconds(self) -> int:
         return self.get(METRICS_RING_SECONDS)
+
+    @property
+    def serving_overload_enabled(self) -> bool:
+        return self.get(SERVING_OVERLOAD_ENABLED)
+
+    @property
+    def serving_overload_slo_p99(self) -> float:
+        return self.get(SERVING_OVERLOAD_SLO_P99)
+
+    @property
+    def serving_overload_shed_window(self) -> float:
+        return self.get(SERVING_OVERLOAD_SHED_WINDOW)
+
+    @property
+    def serving_overload_shed_priority_floor(self) -> int:
+        return self.get(SERVING_OVERLOAD_SHED_PRIORITY_FLOOR)
+
+    @property
+    def serving_overload_shed_guarantee(self) -> float:
+        return self.get(SERVING_OVERLOAD_SHED_GUARANTEE)
+
+    @property
+    def serving_overload_ratelimit_qps(self) -> float:
+        return self.get(SERVING_OVERLOAD_RATELIMIT_QPS)
+
+    @property
+    def serving_overload_ratelimit_burst(self) -> int:
+        return self.get(SERVING_OVERLOAD_RATELIMIT_BURST)
+
+    @property
+    def serving_overload_breaker_failures(self) -> int:
+        return self.get(SERVING_OVERLOAD_BREAKER_FAILURES)
+
+    @property
+    def serving_overload_breaker_reset(self) -> float:
+        return self.get(SERVING_OVERLOAD_BREAKER_RESET)
+
+    @property
+    def autoscale_enabled(self) -> bool:
+        return self.get(AUTOSCALE_ENABLED)
+
+    @property
+    def autoscale_min_executors(self) -> int:
+        return self.get(AUTOSCALE_MIN_EXECUTORS)
+
+    @property
+    def autoscale_max_executors(self) -> int:
+        return self.get(AUTOSCALE_MAX_EXECUTORS)
+
+    @property
+    def autoscale_interval_ms(self) -> int:
+        return self.get(AUTOSCALE_INTERVAL_MS)
+
+    @property
+    def autoscale_queue_depth_high(self) -> int:
+        return self.get(AUTOSCALE_QUEUE_DEPTH_HIGH)
+
+    @property
+    def autoscale_wait_p99_high(self) -> float:
+        return self.get(AUTOSCALE_WAIT_P99_HIGH)
+
+    @property
+    def autoscale_arena_pressure_high(self) -> float:
+        return self.get(AUTOSCALE_ARENA_PRESSURE_HIGH)
+
+    @property
+    def autoscale_scale_out_step(self) -> int:
+        return self.get(AUTOSCALE_SCALE_OUT_STEP)
+
+    @property
+    def autoscale_up_cooldown(self) -> float:
+        return self.get(AUTOSCALE_UP_COOLDOWN)
+
+    @property
+    def autoscale_down_cooldown(self) -> float:
+        return self.get(AUTOSCALE_DOWN_COOLDOWN)
+
+    @property
+    def autoscale_idle_seconds(self) -> float:
+        return self.get(AUTOSCALE_IDLE_SECONDS)
+
+    @property
+    def autoscale_flap_seconds(self) -> float:
+        return self.get(AUTOSCALE_FLAP_SECONDS)
+
+    @property
+    def autoscale_join_timeout(self) -> float:
+        return self.get(AUTOSCALE_JOIN_TIMEOUT)
+
+    @property
+    def autoscale_join_retries(self) -> int:
+        return self.get(AUTOSCALE_JOIN_RETRIES)
 
     def with_overrides(self, **kv) -> "RapidsConf":
         m = dict(self._map)

@@ -42,7 +42,7 @@ import itertools
 
 from spark_rapids_tpu.memory.semaphore import WeightedPrioritySemaphore
 from spark_rapids_tpu.memory.tenant import TENANT_CONF_KEY, TENANTS
-from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+from spark_rapids_tpu.shuffle.stats import HISTOGRAMS, SHUFFLE_COUNTERS
 from spark_rapids_tpu.testing.chaos import CHAOS
 from spark_rapids_tpu.utils.cancel import (
     CANCELS, CancelToken, QueryCancelled, cancellable_wait)
@@ -50,13 +50,18 @@ from spark_rapids_tpu.utils.telemetry import record_event
 
 from spark_rapids_tpu.serving.cache import (
     ResultCache, UncacheableError, plan_fingerprint)
+from spark_rapids_tpu.serving.overload import OverloadController
 
 
 class AdmissionRejected(RuntimeError):
     """Admission control refused the query.  ``reason`` is
-    ``"queue_full"`` (backpressure: too many queries already waiting) or
+    ``"queue_full"`` (backpressure: too many queries already waiting),
     ``"timeout"`` (waited past the queue timeout without being
-    admitted)."""
+    admitted), or — with overload protection armed
+    (serving/overload.py) — ``"shed"`` (priority-aware load shedding
+    under SLO pressure), ``"ratelimited"`` (tenant over its token-
+    bucket rate), or ``"breaker"`` (this plan fingerprint's circuit
+    breaker is open)."""
 
     def __init__(self, message: str, reason: str, tenant: str):
         super().__init__(message)
@@ -162,6 +167,13 @@ class QueryQueue:
         TENANTS.configure(conf.serving_tenant_default_budget,
                           conf.serving_tenant_default_weight,
                           conf.serving_tenants_spec)
+        #: overload protections (serving/overload.py): None unless
+        #: spark.rapids.serving.overload.enabled — with the knob off no
+        #: overload state exists and the submit path is byte-identical
+        #: to the pre-overload tier (pinned by test)
+        self.overload: Optional[OverloadController] = (
+            OverloadController(conf)
+            if conf.serving_overload_enabled else None)
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pool_lock = threading.Lock()
         #: single-flight: fingerprint -> the LEADER's completion future.
@@ -233,7 +245,29 @@ class QueryQueue:
     def _admit(self, tenant: str, priority: int, est_bytes: int,
                timeout_s: float) -> int:
         """Take (slot, bytes) or raise AdmissionRejected.  Returns the
-        byte cost actually reserved (release must match)."""
+        byte cost actually reserved (release must match).  The wall
+        time spent here — admitted, rejected or cancelled alike — is
+        the admission-wait distribution: it feeds the admission_wait_s
+        histogram (whose ring-sampled bucket counts the autoscaler
+        diffs for its windowed p99) and the overload shedder's sliding
+        window."""
+        t0 = time.monotonic()
+        try:
+            cost = self._admit_inner(tenant, priority, est_bytes,
+                                     timeout_s)
+        finally:
+            waited = time.monotonic() - t0
+            HISTOGRAMS["admission_wait_s"].record(waited)
+            if self.overload is not None:
+                self.overload.record_wait(waited)
+        if self.overload is not None:
+            # anti-starvation bookkeeping: the shed exemption reads the
+            # tenant's last ADMITTED time
+            self.overload.note_admitted(tenant)
+        return cost
+
+    def _admit_inner(self, tenant: str, priority: int, est_bytes: int,
+                     timeout_s: float) -> int:
         self._ensure_bytes_sem()
         # ONE capture: cost computation and the acquire/release pair
         # must see the same semaphore — racing the lazy init could
@@ -423,7 +457,6 @@ class QueryQueue:
         # telemetry back under it
         from contextlib import nullcontext
 
-        from spark_rapids_tpu.shuffle.stats import HISTOGRAMS
         from spark_rapids_tpu.utils.obs import (
             QueryTrace, span, trace_scope)
         trace = (QueryTrace(query_id, enabled=True,
@@ -522,6 +555,19 @@ class QueryQueue:
                         hit = self.cache.get(key, tenant=tenant)
                         if hit is not None:
                             return hit
+        # overload gate (serving/overload.py): rate limit -> breaker ->
+        # shed, each a typed rejection BEFORE any slot is queued for.
+        # The breaker keys on the plan fingerprint even when the result
+        # cache is off/uncacheable-for-caching reasons didn't fire —
+        # an unfingerprintable plan simply has no breaker.
+        fp = key
+        if self.overload is not None:
+            if fp is None:
+                try:
+                    fp, _ = plan_fingerprint(plan, overrides)
+                except UncacheableError:
+                    fp = None
+            self.overload.check(tenant, priority, fp)
         from spark_rapids_tpu.utils.obs import span
         with span("serving.admission", anchor=True,
                   tags={"tenant": tenant}):
@@ -546,8 +592,18 @@ class QueryQueue:
                     span("serving.run", anchor=True, tags={"tenant": tenant}):
                 rows = self.runner(plan, ctx)
             token.check()   # a cancel that raced completion wins
+        except QueryCancelled:
+            # a deliberate stop says nothing about the plan: the
+            # breaker must not trip toward open on cancels
+            raise
+        except BaseException:
+            if self.overload is not None:
+                self.overload.record_outcome(fp, ok=False)
+            raise
         finally:
             self._release(cost)
+        if self.overload is not None:
+            self.overload.record_outcome(fp, ok=True)
         if key is not None:
             self.cache.put(key, rows, sources, tenant=tenant)
         if sf["leader"] is not None:

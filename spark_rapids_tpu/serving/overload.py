@@ -1,0 +1,272 @@
+"""Serving-layer overload protection: shed, rate-limit, break.
+
+Admission control (serving/admission.py) bounds how much work RUNS;
+this module bounds how much work is ACCEPTED when capacity cannot
+follow load (the autoscaler may be scaling out, at maxExecutors, or
+off).  Three protections, each independently knobbed under
+``spark.rapids.serving.overload.*`` and each surfacing as a typed
+``AdmissionRejected`` reason, a counter, and a flight-recorder event —
+degradation is explicit, never a silently growing queue:
+
+  * priority-aware LOAD SHEDDING — when the sliding-window p99 of
+    ``admission_wait_s`` exceeds ``sloP99Seconds``, shed-eligible
+    submissions (priority at or below ``shedPriorityFloor``; priority
+    is lower-first, so numerically >=) are rejected with reason
+    ``"shed"`` BEFORE they queue.  Anti-starvation: a tenant with no
+    admitted submission within ``shedGuaranteeSeconds`` is exempt, so
+    every tenant keeps a trickle of progress under sustained overload
+    (Presto-on-GPU's interactive serving posture — excess load is shed
+    early and cheaply, not absorbed as tail latency).
+  * per-tenant TOKEN-BUCKET rate limits — a tenant arriving faster
+    than ``ratelimitQps`` (burst up to ``ratelimitBurst``) is rejected
+    with reason ``"ratelimited"`` before its submissions consume queue
+    depth other tenants need.
+  * per-plan-fingerprint CIRCUIT BREAKER — ``breakerFailures``
+    consecutive failures of one fingerprint OPEN its breaker: further
+    identical submissions fail fast with reason ``"breaker"`` instead
+    of re-burning cluster capacity; after ``breakerResetSeconds`` one
+    HALF-OPEN probe runs — success closes, failure re-opens.
+
+``OverloadController`` is constructed only when
+``spark.rapids.serving.overload.enabled`` is set: disabled, the submit
+path carries no overload state and behaves byte-identically to the
+pre-overload serving tier (pinned by test).  The clock is injectable so
+the policy unit tests are deterministic.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from spark_rapids_tpu.shuffle.stats import SHUFFLE_COUNTERS
+from spark_rapids_tpu.utils.telemetry import record_event
+
+
+class TokenBucket:
+    """Classic token bucket: ``burst`` capacity refilled at ``qps``
+    tokens/second; ``try_take`` is the whole API (no blocking — an
+    over-rate arrival is REJECTED, not delayed: delaying it would be
+    exactly the unbounded buffering this layer exists to prevent)."""
+
+    def __init__(self, qps: float, burst: int,
+                 clock: Callable[[], float] = time.monotonic):
+        self.qps = float(qps)
+        self.burst = max(float(burst), 1.0)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def try_take(self) -> bool:
+        now = self._clock()
+        with self._lock:
+            self._tokens = min(
+                self.burst,
+                self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+class CircuitBreaker:
+    """Per-fingerprint breaker lifecycle: CLOSED --(``failures``
+    consecutive failures)--> OPEN --(``reset_s`` elapsed)--> HALF_OPEN
+    --(one probe: success)--> CLOSED / --(probe fails)--> OPEN.
+
+    ``allow()`` answers "may this submission run?"; the caller reports
+    the outcome through ``record_success``/``record_failure`` (which
+    returns True when the failure OPENED the breaker, so the controller
+    owns the counting)."""
+
+    def __init__(self, failures: int, reset_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(int(failures), 1)
+        self.reset_s = float(reset_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    def allow(self) -> bool:
+        now = self._clock()
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if now - self._opened_at < self.reset_s:
+                    return False
+                self.state = "half_open"
+                self._probe_inflight = True
+                return True     # the one half-open probe
+            # half_open: exactly one probe decides; the rest fail fast
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probe_inflight = False
+            self.state = "closed"
+
+    def record_failure(self) -> bool:
+        """Returns True when THIS failure opened (or re-opened) the
+        breaker."""
+        with self._lock:
+            self._consecutive += 1
+            self._probe_inflight = False
+            if self.state == "half_open" or (
+                    self.state == "closed"
+                    and self._consecutive >= self.failure_threshold):
+                self.state = "open"
+                self._opened_at = self._clock()
+                return True
+            return False
+
+
+class OverloadController:
+    """The pre-admission gate QueryQueue consults when overload
+    protection is armed (see module doc).  Check order is cheapest-
+    rejection-first: rate limit (per-tenant arrival control), breaker
+    (known-crashing plan), shed (SLO pressure) — each raises a typed
+    ``AdmissionRejected`` with its own reason."""
+
+    def __init__(self, conf, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.slo_p99_s = conf.serving_overload_slo_p99
+        self.shed_window_s = max(conf.serving_overload_shed_window, 0.1)
+        self.shed_priority_floor = \
+            conf.serving_overload_shed_priority_floor
+        self.shed_guarantee_s = conf.serving_overload_shed_guarantee
+        self.ratelimit_qps = conf.serving_overload_ratelimit_qps
+        self.ratelimit_burst = conf.serving_overload_ratelimit_burst
+        self.breaker_failures = conf.serving_overload_breaker_failures
+        self.breaker_reset_s = conf.serving_overload_breaker_reset
+        #: sliding window of (t, admission wait seconds) — the shed
+        #: signal (the same distribution admission_wait_s accumulates,
+        #: windowed here so the SLO comparison forgets old quiet/busy
+        #: epochs)
+        self._waits: deque = deque(maxlen=4096)
+        #: tenant -> last ADMITTED submission time (anti-starvation:
+        #: absent or stale => exempt from shedding)
+        self._last_admit: Dict[str, float] = {}
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    # -- signal feeds (QueryQueue calls these) -------------------------------
+
+    def record_wait(self, wait_s: float) -> None:
+        with self._lock:
+            self._waits.append((self._clock(), float(wait_s)))
+
+    def note_admitted(self, tenant: str) -> None:
+        with self._lock:
+            self._last_admit[tenant] = self._clock()
+
+    def windowed_wait_p99(self) -> float:
+        """p99 of admission waits within the shed window (0.0 empty)."""
+        cutoff = self._clock() - self.shed_window_s
+        with self._lock:
+            xs = sorted(w for t, w in self._waits if t >= cutoff)
+        if not xs:
+            return 0.0
+        return xs[min(int(len(xs) * 0.99), len(xs) - 1)]
+
+    # -- the pre-admission gate ----------------------------------------------
+
+    def check(self, tenant: str, priority: int,
+              fingerprint: Optional[str]) -> None:
+        """Raise ``AdmissionRejected`` (reason ratelimited/breaker/shed)
+        when a protection refuses this submission; return silently when
+        it may proceed to admission."""
+        from spark_rapids_tpu.serving.admission import AdmissionRejected
+        if self.ratelimit_qps > 0:
+            with self._lock:
+                bucket = self._buckets.get(tenant)
+                if bucket is None:
+                    bucket = TokenBucket(self.ratelimit_qps,
+                                         self.ratelimit_burst,
+                                         clock=self._clock)
+                    self._buckets[tenant] = bucket
+            if not bucket.try_take():
+                SHUFFLE_COUNTERS.add(ratelimit_rejections=1)
+                record_event("ratelimit", tenant=tenant)
+                raise AdmissionRejected(
+                    f"tenant {tenant!r} over its rate limit "
+                    f"({self.ratelimit_qps:g}/s, burst "
+                    f"{self.ratelimit_burst})", reason="ratelimited",
+                    tenant=tenant)
+        if fingerprint is not None:
+            breaker = self._breaker(fingerprint)
+            if not breaker.allow():
+                SHUFFLE_COUNTERS.add(breaker_fast_fails=1)
+                record_event("breaker_fast_fail", tenant=tenant,
+                             fingerprint=fingerprint[:16])
+                raise AdmissionRejected(
+                    f"circuit breaker OPEN for this plan fingerprint "
+                    f"({self.breaker_failures} consecutive failures; "
+                    f"retry after {self.breaker_reset_s:.0f}s)",
+                    reason="breaker", tenant=tenant)
+        if priority >= self.shed_priority_floor:
+            p99 = self.windowed_wait_p99()
+            if p99 > self.slo_p99_s and not self._starving(tenant):
+                SHUFFLE_COUNTERS.add(queries_shed=1)
+                record_event("shed", tenant=tenant, priority=priority,
+                             wait_p99_s=round(p99, 4))
+                raise AdmissionRejected(
+                    f"shed under overload: admission-wait p99 "
+                    f"{p99:.3f}s exceeds the {self.slo_p99_s:.3f}s SLO "
+                    f"target (tenant {tenant!r}, priority {priority})",
+                    reason="shed", tenant=tenant)
+
+    def _starving(self, tenant: str) -> bool:
+        """True when the tenant had no admitted submission within the
+        guarantee window (a never-seen tenant counts as starving) — the
+        shed exemption that keeps every tenant's trickle alive."""
+        with self._lock:
+            last = self._last_admit.get(tenant)
+        return (last is None
+                or self._clock() - last > self.shed_guarantee_s)
+
+    # -- breaker outcome feedback --------------------------------------------
+
+    def _breaker(self, fingerprint: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(fingerprint)
+            if b is None:
+                b = CircuitBreaker(self.breaker_failures,
+                                   self.breaker_reset_s,
+                                   clock=self._clock)
+                self._breakers[fingerprint] = b
+            return b
+
+    def record_outcome(self, fingerprint: Optional[str],
+                       ok: bool) -> None:
+        """Feed one execution's outcome to its fingerprint's breaker
+        (cancellations are NOT failures — a deliberate stop says
+        nothing about the plan)."""
+        if fingerprint is None:
+            return
+        breaker = self._breaker(fingerprint)
+        if ok:
+            breaker.record_success()
+        else:
+            if breaker.record_failure():
+                SHUFFLE_COUNTERS.add(breaker_trips=1)
+                record_event("breaker_trip",
+                             fingerprint=fingerprint[:16])
+
+    def breaker_state(self, fingerprint: str) -> str:
+        """Test/observability accessor (``closed|open|half_open``;
+        ``closed`` for an unseen fingerprint)."""
+        with self._lock:
+            b = self._breakers.get(fingerprint)
+        return b.state if b is not None else "closed"

@@ -818,8 +818,16 @@ class HeartbeatRegistry:
     def __init__(self, timeout_s: float = 60.0,
                  exclude_threshold: int = 3):
         self._lock = threading.Lock()
-        self._peers: Dict[str, Tuple[str, int, float]] = {}
+        #: eid -> (host, port, last_seen, role)
+        self._peers: Dict[str, Tuple[str, int, float, str]] = {}
         self.timeout_s = timeout_s
+        #: ranks mid graceful drain (begin_drain..leave): still LIVE as
+        #: fetch targets — their blocks serve until the drain completes
+        #: — but never AVAILABLE capacity (_available_locked), so the
+        #: autoscaler and rank_rings share one capacity definition and a
+        #: draining rank can't be counted as a scale-in candidate twice
+        #: or receive fresh primary dispatches
+        self._draining: set = set()
         #: reported fetch failures after which a peer is excluded from
         #: the live view (spark.rapids.shuffle.peer.excludeAfterFailures);
         #: a fresh register() clears the record (a genuinely restarted
@@ -919,6 +927,7 @@ class HeartbeatRegistry:
                 del self._peers[executor_id]
             self._failures.pop(executor_id, None)
             self._rank_rings.pop(executor_id, None)
+            self._draining.discard(executor_id)
         if present:
             SHUFFLE_COUNTERS.add(executors_left=1)
             from spark_rapids_tpu.utils.telemetry import record_event
@@ -952,6 +961,10 @@ class HeartbeatRegistry:
             joined = executor_id not in self._peers and role == "worker"
             self._peers[executor_id] = (host, port, time.time(), role)
             self._failures.pop(executor_id, None)
+            # a (re)registration is a fresh membership: any stale drain
+            # mark from a previous incarnation must not hide the rank
+            # from capacity forever
+            self._draining.discard(executor_id)
         if joined:
             SHUFFLE_COUNTERS.add(executors_joined=1)
             from spark_rapids_tpu.utils.telemetry import record_event
@@ -986,6 +999,9 @@ class HeartbeatRegistry:
             self._failures[executor_id] = max(
                 self._failures.get(executor_id, 0), self.exclude_threshold)
             self._rank_rings.pop(executor_id, None)
+            # kill-during-scale-in: an excluded rank's drain mark dies
+            # with it (it is no capacity of ANY kind now)
+            self._draining.discard(executor_id)
         if present:
             SHUFFLE_COUNTERS.add(peers_excluded=1)
         return present
@@ -1012,23 +1028,72 @@ class HeartbeatRegistry:
                     if not ring or ring[-1].get("t") != telemetry.get("t"):
                         ring.append(telemetry)
 
+    # -- live capacity (ONE definition; the autoscaler's view) ----------------
+
+    def _available_locked(self, now: float) -> set:
+        """THE capacity predicate (caller holds the lock): a worker
+        within the heartbeat window AND not mid-drain.  rank_rings,
+        live_capacity and the driver's dispatch targeting all route
+        through here — a draining or just-excluded rank can never be
+        counted as available capacity by any of them."""
+        return {eid for eid, (_h, _p, seen, role) in self._peers.items()
+                if now - seen <= self.timeout_s and role == "worker"
+                and eid not in self._draining}
+
+    def begin_drain(self, executor_id: str) -> bool:
+        """Mark a rank mid graceful drain: it stays a live fetch target
+        (its blocks serve until it leaves) but stops counting as
+        available capacity and must receive no fresh primary dispatch.
+        Returns False for an unknown/stale peer."""
+        now = time.time()
+        with self._lock:
+            rec = self._peers.get(executor_id)
+            if rec is None or now - rec[2] > self.timeout_s:
+                return False
+            self._draining.add(executor_id)
+        return True
+
+    def end_drain(self, executor_id: str) -> None:
+        """Un-mark a drain that was aborted (the rank stays a member)."""
+        with self._lock:
+            self._draining.discard(executor_id)
+
+    def draining(self) -> List[str]:
+        with self._lock:
+            return sorted(self._draining)
+
+    def live_capacity(self) -> Dict[str, List[str]]:
+        """{"available": [...], "draining": [...]} over LIVE workers —
+        the autoscaler's capacity view, same predicate as rank_rings."""
+        now = time.time()
+        with self._lock:
+            avail = self._available_locked(now)
+            draining = {eid for eid in self._draining
+                        if eid in self._peers
+                        and now - self._peers[eid][2] <= self.timeout_s}
+            return {"available": sorted(avail),
+                    "draining": sorted(draining)}
+
     def rank_rings(self) -> Dict[str, List[dict]]:
         """{executor_id: [samples...]} — the driver-held per-rank
         telemetry rings (the `metrics` wire op's cluster view).  Only
-        LIVE peers report: a dead rank's last sample must not read as
-        live capacity to the autoscaler, so rings of peers outside the
-        heartbeat window are omitted (and dropped on leave/exclude)."""
+        AVAILABLE peers report (_available_locked: heartbeat-windowed,
+        not draining): a dead or draining rank's last sample must not
+        read as live capacity to the autoscaler, so those rings are
+        omitted (and dropped on leave/exclude)."""
         now = time.time()
         with self._lock:
-            live = {eid for eid, (_h, _p, seen, _r) in
-                    self._peers.items() if now - seen <= self.timeout_s}
+            live = self._available_locked(now)
             return {eid: list(ring)
                     for eid, ring in self._rank_rings.items()
                     if eid in live}
 
     def peers(self, workers_only: bool = False) -> Dict[str, Tuple[str, int]]:
         """Live peers; workers_only excludes registry-only driver nodes
-        (they serve no map output and must not be fetched from)."""
+        (they serve no map output and must not be fetched from).
+        DRAINING ranks stay listed: readers still fetch their blocks
+        until the drain completes — use live_capacity()/rank_rings()
+        for the capacity view that excludes them."""
         now = time.time()
         with self._lock:
             return {eid: (h, p)

@@ -143,6 +143,22 @@ _FIELDS = (
     "drop_query_failures",    # drop_query broadcasts that failed on a peer
                               # even after the retry (residual stale state
                               # surfaced, not silently swallowed)
+    # elasticity control loop (cluster/autoscaler.py) + overload
+    # protection (serving/overload.py); docs/fault_tolerance.md
+    # "overload & elasticity"
+    "autoscale_up",           # scale-out decisions (executor launches
+                              # requested by the policy)
+    "autoscale_down",         # scale-in decisions (graceful drains
+                              # requested by the policy)
+    "queries_shed",           # submissions rejected by priority-aware
+                              # load shedding (admission-wait p99 over
+                              # the SLO target; lowest priority first)
+    "ratelimit_rejections",   # submissions rejected by a tenant's
+                              # token-bucket rate limit
+    "breaker_trips",          # plan-fingerprint circuit breakers that
+                              # opened (repeated failures of one plan)
+    "breaker_fast_fails",     # submissions failed fast by an OPEN
+                              # breaker (capacity NOT re-burned)
 )
 
 
@@ -306,6 +322,11 @@ HISTOGRAMS = {
     # pipelined-exchange drains: consumer blocked on an empty stage
     # hand-off after pipeline fill
     "stage_drain_s": Histogram(),
+    # admission wait alone (inside serving_submit_s): time one
+    # submission spent in QueryQueue._admit — the autoscaler's and the
+    # load shedder's SLO signal (its p99 rides every telemetry sample,
+    # so windowed tails come from ring bucket-count deltas)
+    "admission_wait_s": Histogram(),
 }
 
 

@@ -77,6 +77,15 @@ SITES: Dict[str, str] = {
         "executor liveness beat: raise InjectedFault instead of "
         "heartbeating (dropped beats; exercises backoff and the "
         "failure-streak accounting).",
+    "cluster.join.delay":
+        "autoscaler launch path, before the launcher runs: sleep "
+        "args['seconds'] (slow-joining executor; the policy's pending-"
+        "capacity accounting must not trigger a second redundant "
+        "scale-out while the join is in flight).",
+    "cluster.join.fail":
+        "autoscaler launch path: raise InjectedFault instead of "
+        "launching (executor spawn failed; the launch must retry under "
+        "the named cluster.join RetryBudget).",
     "memory.oom":
         "DeviceArena.maybe_throw_injected (inside retry scopes): raise "
         "TpuRetryOOM / TpuSplitAndRetryOOM per args['kind'] — the "

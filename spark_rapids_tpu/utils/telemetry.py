@@ -192,6 +192,9 @@ def generate_metrics_doc() -> str:
         "",
         "| Name | What it measures |",
         "|---|---|",
+        "| `admission_wait_s` | time one serving submission spent in "
+        "admission (QueryQueue._admit) — the autoscaler/shedder SLO "
+        "signal |",
         "| `fetch_wait_s` | reduce consumer blocked on an empty "
         "prefetch queue |",
         "| `serving_submit_s` | serving submit()->rows wall time per "

@@ -163,6 +163,13 @@ _STATIC_RANGES = (
     # shuffle data plane (shuffle/pipeline.py; obs.span)
     ("shuffle.pipeline.produce", "pipelined exchange producer running "
                                  "on its hand-off thread"),
+    # elasticity control loop (cluster/autoscaler.py; obs.span)
+    ("autoscale.decide", "one autoscaler policy tick: read signals, "
+                         "apply hysteresis/cooldowns, emit a decision"),
+    ("autoscale.scale_out", "executor launch requested by a scale-out "
+                            "decision (pending until the join lands)"),
+    ("autoscale.scale_in", "graceful drain of a sustained-idle rank "
+                           "requested by a scale-in decision"),
 )
 for _n, _d in _STATIC_RANGES:
     register_range(_n, _d)

@@ -742,7 +742,13 @@ class _ProtoExecutor:
     """FakeExecutor speaking the driver protocol with a REAL shuffle
     node (tests/test_elastic.py lineage), whose behavior registers a
     REAL CancelToken in CANCELS — the product registry the driver's
-    cancel_query broadcast targets."""
+    cancel_query broadcast targets.
+
+    Liveness beats run on their OWN thread, like the real executor
+    (cluster/executor.py executor_main): a long-running behavior must
+    not read as a dead rank to the driver's staleness-based loss
+    detection.  The ``die`` path stops the beats with the poll loop —
+    a dead process goes silent everywhere at once."""
 
     def __init__(self, driver, name, behavior):
         from spark_rapids_tpu.shuffle.net import ShuffleExecutor
@@ -754,6 +760,18 @@ class _ProtoExecutor:
         self.stop_ev = threading.Event()
         self.thread = threading.Thread(target=self._run, daemon=True)
         self.thread.start()
+        self.beat_thread = threading.Thread(target=self._beat,
+                                            daemon=True)
+        self.beat_thread.start()
+
+    def _beat(self):
+        from spark_rapids_tpu.shuffle.net import PeerClient
+        while not self.stop_ev.wait(0.2):
+            try:
+                PeerClient(self.driver.shuffle.server.addr).heartbeat(
+                    self.name)
+            except OSError:
+                pass
 
     def _run(self):
         from spark_rapids_tpu.shuffle.net import PeerClient, _request

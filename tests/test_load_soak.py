@@ -1,0 +1,379 @@
+"""Open-loop load soak: elasticity + overload protection end to end
+(ISSUE 19 acceptance).
+
+Two tiers over PROTOCOL-LEVEL fake executors (a real TpuClusterDriver
+with Echo-style workers that speak heartbeat/get_task/task_result but
+fabricate results — the soak exercises the serving/cluster control
+planes, not kernels):
+
+  * a tier-1-sized mini-soak: open-loop Poisson load through
+    QueryQueue(ClusterDriverRunner) drives the autoscaler around the
+    full loop — scale-out under queue pressure, graceful drain after
+    sustained idle, ``scoped_resubmits == 0`` throughout;
+  * the full chaos soak (``slow``; ``tools/run_suites.py soak``, run
+    with the runtime-contract sanitizer armed): one executor killed
+    mid-schedule and a fresh one revived later, asserting the four
+    ISSUE-19 guarantees — autoscale-up fires under load, scale-in
+    drain completes with zero scoped resubmits, ok-latency p99 stays
+    under target THROUGH the kill (replicated map output makes the
+    loss a single-rank re-dispatch), and the shed / ratelimit /
+    breaker protections each engaged.
+
+The load generator (tools/loadgen.py) is open-loop: the Poisson
+schedule is drawn up front and arrivals fire on their own threads
+regardless of completions, so overload shows up as queueing and typed
+rejections instead of coordinated omission."""
+import pickle
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.cluster.autoscaler import Autoscaler
+from spark_rapids_tpu.cluster.driver import TpuClusterDriver
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.expressions import Alias, col, lit
+from spark_rapids_tpu.memory.tenant import TENANTS
+from spark_rapids_tpu.serving import ClusterDriverRunner, QueryQueue
+from spark_rapids_tpu.shuffle.net import (PeerClient, ShuffleExecutor,
+                                          _request)
+from spark_rapids_tpu.shuffle.stats import (
+    reset_shuffle_counters, shuffle_counters)
+from spark_rapids_tpu.testing import tpch
+from spark_rapids_tpu.utils.telemetry import TELEMETRY
+from tools import loadgen
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_shuffle_counters()
+    TENANTS.reset()
+    TELEMETRY.reset_events()
+    yield
+    TENANTS.reset()
+
+
+def _wait_for(cond, timeout_s=20.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval_s)
+    raise AssertionError("condition not met within timeout")
+
+
+class SoakEcho:
+    """Protocol-level executor: registers a real ShuffleExecutor node,
+    heartbeats, polls get_task, sleeps ``work_s`` per task, fabricates
+    a result.  Understands the drain handshake (``drain: true`` on an
+    empty poll → graceful ``leave``), fails any task whose plan payload
+    contains ``poison_marker`` (non-retryable — the breaker's food),
+    and ``die()`` freezes it mid-flight (a hard kill: no leave, no
+    more heartbeats)."""
+
+    def __init__(self, driver, name, work_s=0.0,
+                 poison_marker=None):
+        self.driver, self.name = driver, name
+        self.work_s = work_s
+        self.poison_marker = poison_marker
+        self.node = ShuffleExecutor(
+            name, driver_addr=driver.shuffle.server.addr)
+        self.stop = threading.Event()
+        self.dead = threading.Event()
+        self.drained = False
+        self.tasks = []
+        self.t = threading.Thread(target=self._run, daemon=True,
+                                  name=f"soak-echo-{name}")
+        self.t.start()
+
+    def die(self):
+        self.dead.set()
+
+    def _run(self):
+        while not self.stop.is_set():
+            if self.dead.is_set():
+                time.sleep(0.02)
+                continue
+            try:
+                PeerClient(self.driver.shuffle.server.addr).heartbeat(
+                    self.name)
+                h, payload = _request(
+                    self.driver.rpc_addr,
+                    {"op": "get_task", "executor_id": self.name},
+                    retriable=False)
+            except OSError:
+                time.sleep(0.02)
+                continue
+            task = h.get("task")
+            if task is None:
+                if h.get("drain"):
+                    self.node.leave(drain=True)
+                    self.drained = True
+                    return
+                time.sleep(0.02)
+                continue
+            self.tasks.append(task["query_id"])
+            if self.work_s:
+                time.sleep(self.work_s)
+            if self.dead.is_set():
+                continue                # killed mid-task: result lost
+            rank, world = task["rank"], task["world"]
+            if (self.poison_marker is not None
+                    and self.poison_marker in (payload or b"")):
+                _request(self.driver.rpc_addr,
+                         {"op": "task_result",
+                          "query_id": task["query_id"],
+                          "executor_id": self.name, "rank": rank,
+                          "attempt": task.get("attempt", 0),
+                          "error": "InjectedFault: poison plan",
+                          "retryable": False})
+                continue
+            out = [(pp, [[pp, 1]])
+                   for pp in range(2) if pp % world == rank]
+            _request(self.driver.rpc_addr,
+                     {"op": "task_result", "query_id": task["query_id"],
+                      "executor_id": self.name, "rank": rank,
+                      "attempt": task.get("attempt", 0)},
+                     pickle.dumps(out))
+
+    def close(self):
+        self.stop.set()
+        self.t.join(timeout=5)
+        try:
+            self.node.close()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+
+def _autoscale_conf(**knobs):
+    base = {"minExecutors": "1", "maxExecutors": "2",
+            "queueDepthHigh": "2", "admissionWaitP99High": "100",
+            "arenaPressureHigh": "100", "scaleOutStep": "1",
+            "upCooldownSeconds": "0.5", "downCooldownSeconds": "0.5",
+            "idleSeconds": "0.4", "flapSeconds": "0",
+            "intervalMs": "30", "joinTimeoutSeconds": "10"}
+    base.update({k: str(v) for k, v in knobs.items()})
+    return RapidsConf({f"spark.rapids.autoscale.{k}": v
+                       for k, v in base.items()})
+
+
+def _plans():
+    """(ok_plan, poison_plan) over a tiny in-memory relation — the
+    Echoes never run them, but the poison plan's PICKLE carries the
+    marker string its alias plants, and each plan object keeps a
+    stable serving fingerprint (breaker keying)."""
+    s = TpuSession({})
+    batches = list(tpch.gen_lineitem(64, batch_rows=64))
+    ok = s.create_dataframe(list(batches), num_partitions=2) \
+        .filter(col("l_linenumber") < lit(5)).plan
+    poison = s.create_dataframe(list(batches), num_partitions=2) \
+        .select(Alias(col("l_orderkey"), "poison_marker")).plan
+    return ok, poison
+
+
+def test_mini_soak_scale_out_then_drain():
+    """Tier-1 mini-soak: ~1.2s of open-loop load at 2-3x the single
+    rank's service rate forces a scale-out; the post-load idle streak
+    drains the autoscaled rank gracefully; every arrival completes ok
+    and no scoped resubmit ever fires."""
+    driver = TpuClusterDriver(conf={}, heartbeat_timeout_s=30.0)
+    echoes = {}
+    elock = threading.Lock()
+
+    def add_echo(name):
+        with elock:
+            echoes[name] = SoakEcho(driver, name, work_s=0.04)
+
+    q = None
+    a = None
+    try:
+        add_echo("w0")
+        driver.wait_for_executors(1, timeout_s=30)
+        q = QueryQueue(ClusterDriverRunner(driver, timeout_s=30),
+                       conf={
+            "spark.rapids.serving.maxConcurrentQueries": "1",
+            "spark.rapids.serving.cache.enabled": "false",
+            "spark.rapids.serving.queue.maxDepth": "128",
+            "spark.rapids.serving.queue.timeout": "30",
+        })
+
+        def signals():
+            g = q.admission_gauges()
+            # waiting + running: the idle streak must not start while
+            # a query is still in flight (a drain racing the last
+            # dispatch would lose a task)
+            return {"queue_depth": (g["admission_queue_depth"]
+                                    + g["admission_slots_in_use"]),
+                    "wait_p99_s": 0.0, "arena_pressure": 0.0}
+
+        a = Autoscaler(driver.shuffle.registry, add_echo,
+                       driver.request_drain, conf=_autoscale_conf(),
+                       signals=signals)
+        a.start()
+        plan, _ = _plans()
+
+        def submit(i, tenant, priority):
+            return q.submit(plan, tenant=tenant, priority=priority,
+                            timeout_s=25.0)
+
+        out = loadgen.run_load(submit, rate_qps=30.0, duration_s=1.2,
+                               seed=7, mix=[("dash", 0), ("etl", 2)],
+                               drain_timeout_s=40.0)
+        assert out["arrivals"] > 10
+        assert out["unfinished"] == 0
+        assert out["outcomes"]["ok"] == out["arrivals"], out["outcomes"]
+        c = shuffle_counters()
+        assert c["autoscale_up"] >= 1, "load never triggered scale-out"
+        assert "autoscale-1" in echoes
+        # sustained idle now: the autoscaled rank drains gracefully
+        _wait_for(lambda: shuffle_counters()["autoscale_down"] >= 1)
+        _wait_for(lambda: echoes["autoscale-1"].drained)
+        _wait_for(lambda: "autoscale-1"
+                  not in driver.shuffle.registry.peers())
+        assert shuffle_counters()["scoped_resubmits"] == 0
+    finally:
+        if a is not None:
+            a.stop()
+        if q is not None:
+            q.close()
+        for e in echoes.values():
+            e.close()
+        driver.close()
+
+
+@pytest.mark.slow
+def test_soak_chaos_kill_revive_under_slo():
+    """The full ISSUE-19 soak: 8s of open-loop load over a replicated
+    cluster with every protection armed, one executor KILLED a third of
+    the way through the schedule and a fresh one revived at two thirds.
+    Asserts all four acceptance guarantees (see module doc)."""
+    driver = TpuClusterDriver(
+        conf={"spark.rapids.shuffle.replication.factor": "2"},
+        heartbeat_timeout_s=1.5)
+    echoes = {}
+    elock = threading.Lock()
+
+    def add_echo(name):
+        with elock:
+            echoes[name] = SoakEcho(driver, name, work_s=0.08,
+                                    poison_marker=b"poison_marker")
+
+    q = None
+    a = None
+    try:
+        add_echo("w0")
+        add_echo("w1")
+        driver.wait_for_executors(2, timeout_s=30)
+        # plans FIRST: TpuSession init re-applies the default metrics
+        # conf (interval 250ms, ring 60s), which would clobber the
+        # short ring configured below
+        ok_plan, poison_plan = _plans()
+        # a SHORT ring: windowed_admission_p99 spans the whole ring, so
+        # the storm's waits must age out within a few seconds of the
+        # load ending or post-load "pressure" would block scale-in
+        TELEMETRY.configure(True, interval_ms=100, ring_seconds=6)
+        TELEMETRY.reset_ring()
+        q = QueryQueue(ClusterDriverRunner(driver, timeout_s=60),
+                       conf={
+            "spark.rapids.serving.maxConcurrentQueries": "2",
+            "spark.rapids.serving.cache.enabled": "false",
+            "spark.rapids.serving.queue.maxDepth": "512",
+            "spark.rapids.serving.queue.timeout": "60",
+            "spark.rapids.serving.overload.enabled": "true",
+            "spark.rapids.serving.overload.sloP99Seconds": "0.05",
+            "spark.rapids.serving.overload.shedPriorityFloor": "5",
+            # generous guarantee: the kill's backlog spaces batch
+            # admissions out, and a tight window would mark batch
+            # perpetually starving (exempt) — no shed ever fires
+            "spark.rapids.serving.overload.shedGuaranteeSeconds": "10",
+            "spark.rapids.serving.overload.ratelimitQps": "6",
+            "spark.rapids.serving.overload.ratelimitBurst": "3",
+            "spark.rapids.serving.overload.breakerFailures": "2",
+            "spark.rapids.serving.overload.breakerResetSeconds": "60",
+        })
+        # ring-driven signals: the REAL production path (telemetry
+        # sampler gauges + admission_wait_s bucket deltas)
+        a = Autoscaler(driver.shuffle.registry, add_echo,
+                       driver.request_drain,
+                       conf=_autoscale_conf(
+                           minExecutors="2", maxExecutors="3",
+                           queueDepthHigh="3",
+                           admissionWaitP99High="0.5",
+                           upCooldownSeconds="1",
+                           idleSeconds="0.5", intervalMs="50"))
+        a.start()
+
+        def submit(i, tenant, priority):
+            p = poison_plan if tenant == "poison" else ok_plan
+            return q.submit(p, tenant=tenant, priority=priority,
+                            timeout_s=60.0)
+
+        # poison rides at priority 0: its failures complete FAST
+        # (priority-ordered admission), so the breaker trips early in
+        # the schedule and later poison arrivals fast-fail in-band
+        mix = [("dash", 0), ("etl", 2), ("batch", 5), ("poison", 0)]
+        rate, duration, seed = 30.0, 8.0, 11
+        n = len(loadgen.poisson_schedule(rate, duration, seed, mix))
+        kill_at, revive_at = n // 3, (2 * n) // 3
+
+        def on_arrival(i):
+            if i == kill_at:
+                echoes["w1"].die()
+            elif i == revive_at:
+                add_echo("w2")
+
+        # prime the batch tenant with one served query before the storm:
+        # under sustained overload the priority queue admits batch LAST,
+        # so without a prior admission it would stay "never seen" and the
+        # anti-starvation exemption would hide the shed path entirely
+        q.submit(ok_plan, tenant="batch", priority=5, timeout_s=60.0)
+
+        out = loadgen.run_load(submit, rate_qps=rate,
+                               duration_s=duration, seed=seed, mix=mix,
+                               drain_timeout_s=120.0,
+                               on_arrival=on_arrival)
+        assert out["unfinished"] == 0
+        assert out["outcomes"]["ok"] > 50, out["outcomes"]
+        assert out["outcomes"]["timeout"] == 0, out["outcomes"]
+        c = shuffle_counters()
+        # (1) autoscale-up fired under load
+        assert c["autoscale_up"] >= 1
+        # (3) the kill was absorbed durably: loss detected, the dead
+        # rank re-dispatched (replica re-fetch path), p99 under target
+        # through it — and NEVER a scoped whole-query resubmit
+        assert c["executors_excluded"] >= 1
+        assert c["rank_redispatches"] >= 1
+        assert c["scoped_resubmits"] == 0
+        assert out["ok_latency_s"]["p99"] < 10.0, out["ok_latency_s"]
+        # (4) each protection engaged
+        assert c["queries_shed"] > 0
+        assert c["ratelimit_rejections"] > 0
+        assert c["breaker_trips"] >= 1
+        assert c["breaker_fast_fails"] >= 1
+        assert out["outcomes"]["shed"] > 0
+        assert out["outcomes"]["ratelimited"] > 0
+        assert out["outcomes"]["breaker"] > 0
+        # the shed floor protected latency-critical tenants: dash and
+        # etl (priority < floor) were never shed
+        assert out["per_tenant"]["dash"]["shed"] == 0
+        assert out["per_tenant"]["etl"]["shed"] == 0
+        # (2) sustained idle after the load: graceful scale-in, drain
+        # completes, still zero scoped resubmits
+        _wait_for(lambda: shuffle_counters()["autoscale_down"] >= 1,
+                  timeout_s=30.0)
+        _wait_for(lambda: any(e.drained for e in echoes.values()),
+                  timeout_s=30.0)
+        assert shuffle_counters()["scoped_resubmits"] == 0
+        kinds = [e["kind"] for e in TELEMETRY.events()]
+        assert "executor_loss" in kinds
+        assert "shed" in kinds and "ratelimit" in kinds
+        assert "breaker_trip" in kinds
+    finally:
+        if a is not None:
+            a.stop()
+        if q is not None:
+            q.close()
+        for e in echoes.values():
+            e.close()
+        driver.close()

@@ -1,0 +1,286 @@
+"""Serving overload protection (serving/overload.py; ISSUE 19).
+
+Deterministic policy units with injected clocks — token-bucket rate
+limits, the circuit-breaker trip/half-open/reset lifecycle, priority-
+aware shedding with the anti-starvation guarantee — plus the
+QueryQueue integration (typed ``AdmissionRejected`` reasons, counters,
+breaker feedback from real submission outcomes) and the knobs-off pin:
+with ``spark.rapids.serving.overload.enabled`` unset, NO overload
+state exists and the submit path behaves exactly as before."""
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory.tenant import TENANTS
+from spark_rapids_tpu.serving import AdmissionRejected, QueryQueue
+from spark_rapids_tpu.serving.overload import (
+    CircuitBreaker, OverloadController, TokenBucket)
+from spark_rapids_tpu.shuffle.stats import (
+    reset_shuffle_counters, shuffle_counters)
+from spark_rapids_tpu.utils.telemetry import TELEMETRY
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_shuffle_counters()
+    TENANTS.reset()
+    TELEMETRY.reset_events()
+    yield
+    TENANTS.reset()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _conf(**over):
+    base = {"spark.rapids.serving.overload.enabled": "true"}
+    base.update({f"spark.rapids.serving.overload.{k}": str(v)
+                 for k, v in over.items()})
+    return RapidsConf(base)
+
+
+# -- token bucket --------------------------------------------------------------
+
+def test_token_bucket_burst_then_refill():
+    clk = FakeClock()
+    b = TokenBucket(qps=1.0, burst=2, clock=clk)
+    assert b.try_take() and b.try_take()
+    assert not b.try_take()             # burst spent, no time passed
+    clk.t += 1.0
+    assert b.try_take()                 # one token refilled
+    assert not b.try_take()
+    clk.t += 100.0
+    assert b.try_take() and b.try_take()
+    assert not b.try_take()             # refill caps at burst
+
+
+# -- circuit breaker lifecycle -------------------------------------------------
+
+def test_breaker_trip_half_open_reset_lifecycle():
+    clk = FakeClock()
+    br = CircuitBreaker(failures=2, reset_s=10.0, clock=clk)
+    assert br.state == "closed" and br.allow()
+    assert not br.record_failure()      # 1 of 2: still closed
+    assert br.state == "closed"
+    assert br.record_failure()          # 2nd consecutive: OPENS (True)
+    assert br.state == "open"
+    assert not br.allow()               # fast fail while open
+    clk.t += 9.9
+    assert not br.allow()               # reset not yet elapsed
+    clk.t += 0.2
+    assert br.allow()                   # the ONE half-open probe
+    assert br.state == "half_open"
+    assert not br.allow()               # second caller fails fast
+    br.record_success()                 # probe succeeded
+    assert br.state == "closed" and br.allow()
+    # success reset the consecutive count: one failure stays closed
+    assert not br.record_failure()
+    assert br.state == "closed"
+
+
+def test_breaker_half_open_failure_reopens():
+    clk = FakeClock()
+    br = CircuitBreaker(failures=1, reset_s=5.0, clock=clk)
+    assert br.record_failure()
+    clk.t += 5.1
+    assert br.allow()                   # half-open probe
+    assert br.record_failure()          # probe failed: RE-OPENS (True)
+    assert br.state == "open"
+    assert not br.allow()
+
+
+# -- controller policy (shed / ratelimit / breaker via check()) ----------------
+
+def test_ratelimit_rejects_over_rate_tenant():
+    clk = FakeClock()
+    c = OverloadController(_conf(ratelimitQps=1.0, ratelimitBurst=2),
+                           clock=clk)
+    c.check("t1", 0, None)
+    c.check("t1", 0, None)              # burst of 2 passes
+    with pytest.raises(AdmissionRejected) as ei:
+        c.check("t1", 0, None)
+    assert ei.value.reason == "ratelimited"
+    c.check("t2", 0, None)              # buckets are PER-tenant
+    assert shuffle_counters()["ratelimit_rejections"] == 1
+    clk.t += 1.0
+    c.check("t1", 0, None)              # refilled
+
+
+def test_shed_priority_floor_and_slo_signal():
+    clk = FakeClock()
+    c = OverloadController(
+        _conf(sloP99Seconds=0.5, shedPriorityFloor=2,
+              shedGuaranteeSeconds=30.0), clock=clk)
+    for _ in range(50):
+        c.record_wait(2.0)              # p99 well over the 0.5s SLO
+    c.note_admitted("lowpri")           # recently served => sheddable
+    c.note_admitted("highpri")
+    assert c.windowed_wait_p99() == pytest.approx(2.0)
+    with pytest.raises(AdmissionRejected) as ei:
+        c.check("lowpri", 3, None)      # priority 3 >= floor 2: shed
+    assert ei.value.reason == "shed"
+    c.check("highpri", 0, None)         # priority 0 < floor: NEVER shed
+    c.check("highpri", 1, None)
+    assert shuffle_counters()["queries_shed"] == 1
+    # below the SLO there is no shedding at any priority
+    clk.t += 100.0                      # the window forgets the waits
+    assert c.windowed_wait_p99() == 0.0
+    c.check("lowpri", 3, None)
+
+
+def test_shed_never_starves_a_tenant():
+    """Anti-starvation: a tenant with no admitted submission within
+    shedGuaranteeSeconds is exempt from shedding, so sustained overload
+    degrades every tenant to a trickle instead of zeroing one out."""
+    clk = FakeClock()
+    c = OverloadController(
+        _conf(sloP99Seconds=0.5, shedPriorityFloor=1,
+              shedGuaranteeSeconds=10.0), clock=clk)
+    for _ in range(50):
+        c.record_wait(2.0)
+    c.check("never-seen", 5, None)      # brand-new tenant: exempt
+    c.note_admitted("t1")
+    with pytest.raises(AdmissionRejected):
+        c.check("t1", 5, None)          # just served: sheddable
+    clk.t += 10.1                       # guarantee window expires...
+    for _ in range(50):
+        c.record_wait(2.0)              # (keep the SLO breached)
+    c.check("t1", 5, None)              # ...and t1 is exempt again
+
+
+def test_breaker_through_controller_outcomes():
+    clk = FakeClock()
+    c = OverloadController(_conf(breakerFailures=2,
+                                 breakerResetSeconds=5.0), clock=clk)
+    fp = "a" * 64
+    c.check("t", 0, fp)
+    c.record_outcome(fp, ok=False)
+    c.record_outcome(fp, ok=False)      # trips
+    assert shuffle_counters()["breaker_trips"] == 1
+    assert c.breaker_state(fp) == "open"
+    with pytest.raises(AdmissionRejected) as ei:
+        c.check("t", 0, fp)
+    assert ei.value.reason == "breaker"
+    assert shuffle_counters()["breaker_fast_fails"] == 1
+    c.check("t", 0, "b" * 64)           # breakers are PER-fingerprint
+    clk.t += 5.1
+    c.check("t", 0, fp)                 # half-open probe admitted
+    c.record_outcome(fp, ok=True)
+    assert c.breaker_state(fp) == "closed"
+    # success wiped the streak; ok outcomes on an untracked fp no-op
+    c.record_outcome(None, ok=True)
+
+
+# -- QueryQueue integration ----------------------------------------------------
+
+def _mini_plan(rows=32):
+    from spark_rapids_tpu.serving import LocalSessionRunner
+    from spark_rapids_tpu.testing import tpch
+    runner = LocalSessionRunner({})
+    batches = list(tpch.gen_lineitem(rows, batch_rows=rows))
+    df = runner.session.create_dataframe(batches, num_partitions=1)
+    from spark_rapids_tpu.expressions import col, lit
+    return runner, df.filter(col("l_linenumber") < lit(5)).plan
+
+
+def test_queryqueue_breaker_trips_on_failing_plan():
+    """Integration: a plan that keeps failing trips its fingerprint's
+    breaker through the REAL submit path; further submissions fail fast
+    with reason ``breaker`` (capacity not re-burned), and cancels do
+    NOT count toward the trip."""
+    runner, plan = _mini_plan()
+    calls = []
+
+    class _Flaky:
+        def __call__(self, p, ctx):
+            calls.append(1)
+            raise RuntimeError("boom")
+
+    q = QueryQueue(_Flaky(), conf={
+        "spark.rapids.serving.cache.enabled": "false",
+        "spark.rapids.serving.overload.enabled": "true",
+        "spark.rapids.serving.overload.breakerFailures": "2",
+        "spark.rapids.serving.overload.breakerResetSeconds": "60",
+    })
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            q.submit(plan, tenant="t")
+    assert shuffle_counters()["breaker_trips"] == 1
+    with pytest.raises(AdmissionRejected) as ei:
+        q.submit(plan, tenant="t")
+    assert ei.value.reason == "breaker"
+    assert len(calls) == 2, "open breaker must not re-burn capacity"
+    assert shuffle_counters()["breaker_fast_fails"] == 1
+    kinds = [e["kind"] for e in TELEMETRY.events()]
+    assert "breaker_trip" in kinds and "breaker_fast_fail" in kinds
+    q.close()
+
+
+def test_queryqueue_shed_and_ratelimit_reasons():
+    runner, plan = _mini_plan()
+    q = QueryQueue(runner, conf={
+        "spark.rapids.serving.cache.enabled": "false",
+        "spark.rapids.serving.overload.enabled": "true",
+        "spark.rapids.serving.overload.ratelimitQps": "0.001",
+        "spark.rapids.serving.overload.ratelimitBurst": "1",
+    })
+    q.submit(plan, tenant="t")          # burst of 1
+    with pytest.raises(AdmissionRejected) as ei:
+        q.submit(plan, tenant="t")
+    assert ei.value.reason == "ratelimited"
+    assert shuffle_counters()["ratelimit_rejections"] == 1
+    # shed path: breach the SLO signal directly (the windowed p99 is
+    # the controller's own), then a sheddable tenant is refused
+    q.overload.slo_p99_s = 0.01
+    for _ in range(50):
+        q.overload.record_wait(1.0)
+    q.overload.note_admitted("shedme")
+    with pytest.raises(AdmissionRejected) as ei:
+        q.submit(plan, tenant="shedme", priority=5)
+    assert ei.value.reason == "shed"
+    assert shuffle_counters()["queries_shed"] == 1
+    q.close()
+
+
+def test_overload_off_is_inert():
+    """The byte-identical pin (ISSUE 19 acceptance): with the knob OFF
+    no overload state is constructed, no overload counter can move, and
+    heavy admission waits cause queueing — never shedding."""
+    runner, plan = _mini_plan()
+    q = QueryQueue(runner, conf={
+        "spark.rapids.serving.cache.enabled": "false"})
+    assert q.overload is None
+    rows1 = q.submit(plan, tenant="a", priority=9)
+    for _ in range(5):
+        assert q.submit(plan, tenant="a", priority=9) == rows1
+    c = shuffle_counters()
+    assert c["queries_shed"] == 0 and c["ratelimit_rejections"] == 0
+    assert c["breaker_trips"] == 0 and c["breaker_fast_fails"] == 0
+    # admission_wait_s telemetry still accumulates (observability is
+    # not behavior): the histogram saw every admit
+    from spark_rapids_tpu.cluster.stats import local_histograms
+    assert local_histograms()["admission_wait_s"]["count"] >= 6
+    q.close()
+
+
+def test_admission_wait_histogram_feeds_shed_window():
+    """The controller's windowed p99 comes from the SAME waits the
+    admission_wait_s histogram records — one signal, two consumers
+    (the ring for the autoscaler, the window for the shedder)."""
+    runner, plan = _mini_plan()
+    q = QueryQueue(runner, conf={
+        "spark.rapids.serving.cache.enabled": "false",
+        "spark.rapids.serving.overload.enabled": "true"})
+    assert q.overload is not None
+    q.submit(plan, tenant="t")
+    assert len(q.overload._waits) == 1
+    from spark_rapids_tpu.cluster.stats import local_histograms
+    assert local_histograms()["admission_wait_s"]["count"] >= 1
+    q.close()

@@ -50,10 +50,15 @@ SUITES = {
     "cancel": (["tests/test_cancel.py"], 600),
     "pipeline": (["tests/test_fused_shuffle.py", "tests/test_fused.py",
                   "tests/test_aqe_coalesce.py"], 1200, ""),
-    # slow-marked chaos soak (kill/revive/delay at 6+ ranks under
-    # replication + speculation + watchdog): marker override runs what
-    # tier-1 skips by budget
-    "soak": (["tests/test_soak.py"], 1200, ""),
+    # slow-marked chaos soaks (kill/revive/delay at 6+ ranks under
+    # replication + speculation + watchdog, plus the open-loop load
+    # soak with autoscaler + overload protections armed): marker
+    # override runs what tier-1 skips by budget
+    "soak": (["tests/test_soak.py", "tests/test_load_soak.py"], 1200, ""),
+    # closed-loop elasticity + overload protection (ISSUE 19): policy
+    # units, shed/ratelimit/breaker, drain handshake, tier-1 mini-soak
+    "elasticity": (["tests/test_autoscaler.py", "tests/test_overload.py",
+                    "tests/test_load_soak.py"], 600),
     # per-program attribution (bench.py --profile) + the CACHE_ONLY
     # range-view store it was built to validate
     "profile": (["tests/test_prog_profile.py",
@@ -75,7 +80,7 @@ SUITES = {
 #: (SPARK_RAPIDS_TPU_SANITIZE=1, utils/sanitizer.py) unless
 #: --no-sanitize: the shuffle/serving/cancel paths are where the pin/
 #: lock/ambient contracts the sanitizer witnesses actually concentrate.
-SANITIZE_SUITES = {"shuffle", "serving", "cancel"}
+SANITIZE_SUITES = {"shuffle", "serving", "cancel", "soak", "elasticity"}
 
 #: extra commands run (and required green) after a suite's pytest pass.
 #: The lint suite also runs the CLI with --timing so the per-rule wall
